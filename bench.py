@@ -1,0 +1,54 @@
+"""Benchmark driver: prints ONE JSON line
+{"metric": ..., "value": N, "unit": ..., "vs_baseline": N}.
+
+Primary metric this round: `dot` (1024×1024)·(1024×1024) fp32 forward
+latency through the framework's op path — the reference's published anchor
+is 0.215 ms on a V100 (BASELINE.md, `benchmark/opperf/results/..._gpu.md:82`)
+and 14.56 ms on a 32-core CPU. vs_baseline = V100_ms / our_ms (>1 ⇒ faster
+than the reference's GPU number).
+"""
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as onp
+
+BASELINE_V100_DOT_MS = 0.215
+
+
+def bench_dot(n=1024, iters=200, warmup=20):
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import np
+
+    rng = onp.random.RandomState(0)
+    a = np.array(rng.uniform(-1, 1, (n, n)).astype("float32"))
+    b = np.array(rng.uniform(-1, 1, (n, n)).astype("float32"))
+
+    import jax
+
+    f = jax.jit(lambda x, y: x @ y)
+    for _ in range(warmup):
+        f(a._data, b._data).block_until_ready()
+    t0 = time.perf_counter()
+    out = None
+    for _ in range(iters):
+        out = f(a._data, b._data)
+    out.block_until_ready()
+    dt = (time.perf_counter() - t0) / iters
+    mx.waitall()
+    return dt * 1000.0
+
+
+def main():
+    ms = bench_dot()
+    print(json.dumps({
+        "metric": "dot_1024x1024_fwd_latency",
+        "value": round(ms, 4),
+        "unit": "ms",
+        "vs_baseline": round(BASELINE_V100_DOT_MS / ms, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,51 @@
+"""incubator_mxnet_tpu: a TPU-native deep learning framework with Apache
+MXNet 2.0 capability parity, built on jax/XLA/pallas/pjit.
+
+Typical use mirrors the reference:
+
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import np, npx, autograd, gluon
+
+    net = gluon.nn.Dense(10)
+    net.initialize()
+    with autograd.record():
+        loss = net(np.ones((2, 4))).sum()
+    loss.backward()
+"""
+from __future__ import annotations
+
+__version__ = "0.1.0"
+
+from . import base  # noqa: F401
+from .base import MXNetError  # noqa: F401
+from .device import (  # noqa: F401
+    Context,
+    Device,
+    cpu,
+    current_device,
+    gpu,
+    gpu_memory_info,
+    num_gpus,
+    num_tpus,
+    tpu,
+)
+from . import ndarray  # noqa: F401
+from . import ndarray as nd  # noqa: F401
+from .ndarray.ndarray import NDArray, waitall  # noqa: F401
+from . import numpy  # noqa: F401
+from . import numpy as np  # noqa: F401
+from . import numpy_extension  # noqa: F401
+from . import numpy_extension as npx  # noqa: F401
+from . import autograd  # noqa: F401
+from . import random  # noqa: F401
+from . import initializer  # noqa: F401
+from . import initializer as init  # noqa: F401
+from . import optimizer  # noqa: F401
+from . import gluon  # noqa: F401
+from . import kvstore  # noqa: F401
+from . import kvstore as kv  # noqa: F401
+from . import io  # noqa: F401
+from . import parallel  # noqa: F401
+from . import profiler  # noqa: F401
+from . import util  # noqa: F401
+from . import test_utils  # noqa: F401

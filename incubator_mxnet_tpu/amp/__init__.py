@@ -1,0 +1,116 @@
+"""AMP — automatic mixed precision (reference: `python/mxnet/amp/amp.py:106`,
+allow/deny lists `amp/lists/symbol_bf16.py`, C++ cast pass
+`src/nnvm/low_precision_pass.cc`).
+
+TPU-native: bf16 is the MXU-native format, so AMP = cast the inputs of
+matmul-class ops (FC/conv/batch_dot — the reference's FP16_FUNCS list) to
+bfloat16 and leave reductions/norms/softmax in fp32 (the reference's
+FP32_FUNCS / WIDEST_TYPE_CASTS discipline). The cast happens inside the op
+funnel, so it applies to eager, hybridized and pallas paths alike. Loss
+scaling (needed for fp16, optional for bf16) ports the reference's dynamic
+LossScaler (`amp/loss_scaler.py:26`)."""
+from __future__ import annotations
+
+import threading
+
+from .loss_scaler import LossScaler
+
+__all__ = ["init", "scale_loss", "unscale", "convert_model", "LossScaler",
+           "amp_active", "amp_dtype", "lists"]
+
+
+class _State(threading.local):
+    def __init__(self):
+        self.active = False
+        self.dtype = None
+
+
+_STATE = _State()
+
+# Op-name lists mirroring the reference's amp/lists/symbol_bf16.py roles
+TARGET_DTYPE_OPS = ["fully_connected", "convolution", "deconvolution",
+                    "batch_dot", "matmul", "dot", "rnn", "embedding"]
+FP32_OPS = ["softmax", "log_softmax", "masked_softmax", "layer_norm",
+            "batch_norm", "group_norm", "instance_norm", "l2_normalization",
+            "norm", "mean", "sum", "exp", "log", "erf", "gammaln"]
+
+
+class lists:
+    TARGET_DTYPE_OPS = TARGET_DTYPE_OPS
+    FP32_OPS = FP32_OPS
+
+
+def init(target_dtype="bfloat16"):
+    """Enable mixed precision globally (reference: amp.init)."""
+    if target_dtype not in ("bfloat16", "float16"):
+        raise ValueError("target_dtype must be bfloat16 or float16")
+    _STATE.active = True
+    _STATE.dtype = target_dtype
+
+
+def deinit():
+    _STATE.active = False
+    _STATE.dtype = None
+
+
+def amp_active() -> bool:
+    return _STATE.active
+
+
+def amp_dtype():
+    import jax.numpy as jnp
+
+    return jnp.bfloat16 if _STATE.dtype == "bfloat16" else jnp.float16
+
+
+def cast_for_matmul(*vals):
+    """Cast float32 operands of a matmul-class op to the AMP dtype."""
+    if not _STATE.active:
+        return vals
+    import jax.numpy as jnp
+
+    dt = amp_dtype()
+    out = []
+    for v in vals:
+        if v is not None and hasattr(v, "dtype") and v.dtype == jnp.float32:
+            out.append(v.astype(dt))
+        else:
+            out.append(v)
+    return tuple(out)
+
+
+class scale_loss:
+    """Context manager scaling loss up and gradients down
+    (reference: amp.scale_loss)."""
+
+    _scaler = None
+
+    def __init__(self, loss, trainer=None):
+        if scale_loss._scaler is None:
+            scale_loss._scaler = LossScaler()
+        self._trainer = trainer
+        self.loss = loss * scale_loss._scaler.loss_scale
+        self._entered = False
+
+    def __enter__(self):
+        self._entered = True
+        return self.loss
+
+    def __exit__(self, *exc):
+        if self._trainer is not None:
+            scaler = scale_loss._scaler
+            trainer = self._trainer
+            # fold 1/scale into the next step's rescale
+            trainer._scale = 1.0 / scaler.loss_scale
+        return False
+
+
+def unscale(trainer):
+    trainer._scale = 1.0
+
+
+def convert_model(net, target_dtype="bfloat16"):
+    """Cast a model's parameters for low-precision inference
+    (reference: amp.convert_model)."""
+    net.cast(target_dtype)
+    return net

@@ -1,0 +1,36 @@
+"""Dynamic loss scaler (reference: `python/mxnet/amp/loss_scaler.py:26`)."""
+from __future__ import annotations
+
+__all__ = ["LossScaler"]
+
+
+class LossScaler:
+    def __init__(self, init_scale=2.0 ** 16, scale_factor=2.0,
+                 scale_window=2000, min_scale=1.0):
+        self.loss_scale = init_scale
+        self._scale_factor = scale_factor
+        self._scale_window = scale_window
+        self._min_scale = min_scale
+        self._unskipped = 0
+
+    def has_overflow(self, params):
+        """True if any gradient is non-finite."""
+        import numpy as onp
+
+        for p in params:
+            d = p.data() if hasattr(p, "data") else p
+            g = getattr(d, "_grad", None)
+            if g is not None and not onp.isfinite(g.asnumpy()).all():
+                return True
+        return False
+
+    def update_scale(self, overflow: bool):
+        if overflow:
+            self.loss_scale = max(self.loss_scale / self._scale_factor,
+                                  self._min_scale)
+            self._unskipped = 0
+        else:
+            self._unskipped += 1
+            if self._unskipped >= self._scale_window:
+                self.loss_scale *= self._scale_factor
+                self._unskipped = 0

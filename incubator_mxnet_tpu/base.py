@@ -1,0 +1,103 @@
+"""Base utilities: dtype handling, errors, registries.
+
+TPU-native re-design of the reference's bootstrap layer
+(`python/mxnet/base.py` in Apache MXNet 2.0). Where the reference loads
+`libmxnet.so` over ctypes and code-generates op modules from the C registry
+(`python/mxnet/base.py:633`), we register ops in pure Python over jax and
+keep an introspectable registry for signature/docs parity.
+"""
+from __future__ import annotations
+
+import numpy as onp
+
+__all__ = [
+    "MXNetError",
+    "NotSupportedForSparseNDArray",
+    "np_dtype",
+    "dtype_name",
+    "string_types",
+    "numeric_types",
+    "integer_types",
+    "_OP_REGISTRY",
+    "register_op_meta",
+    "list_ops",
+]
+
+
+class MXNetError(RuntimeError):
+    """Framework-level error (parity with mxnet.base.MXNetError)."""
+
+
+class NotSupportedForSparseNDArray(MXNetError):
+    def __init__(self, function, alias, *args):
+        super().__init__(f"Function {function.__name__} is not supported for sparse NDArray")
+
+
+string_types = (str,)
+numeric_types = (float, int, onp.generic)
+integer_types = (int, onp.integer)
+
+_DTYPE_ALIASES = {
+    "float32": "float32",
+    "float64": "float64",
+    "float16": "float16",
+    "bfloat16": "bfloat16",
+    "uint8": "uint8",
+    "uint16": "uint16",
+    "uint32": "uint32",
+    "uint64": "uint64",
+    "int8": "int8",
+    "int16": "int16",
+    "int32": "int32",
+    "int64": "int64",
+    "bool": "bool",
+}
+
+
+def np_dtype(dtype):
+    """Normalize a dtype-like object to a numpy/jax dtype."""
+    import jax.numpy as jnp
+
+    if dtype is None:
+        return None
+    if isinstance(dtype, str):
+        name = _DTYPE_ALIASES.get(dtype, dtype)
+        if name == "bfloat16":
+            return jnp.bfloat16
+        return onp.dtype(name)
+    if dtype in (float,):
+        return onp.dtype("float32")
+    if dtype in (int,):
+        return onp.dtype("int32")
+    if dtype in (bool,):
+        return onp.dtype("bool")
+    return onp.dtype(dtype) if not hasattr(dtype, "dtype") else dtype
+
+
+def dtype_name(dtype) -> str:
+    import jax.numpy as jnp
+
+    if dtype is None:
+        return "None"
+    if dtype == jnp.bfloat16:
+        return "bfloat16"
+    return onp.dtype(dtype).name
+
+
+# ---------------------------------------------------------------------------
+# Op registry: keeps (name, namespace, fn, doc) so `list_ops` and docs tools
+# can introspect, mirroring the reference's NNVM registry role
+# (`src/operator/` NNVM_REGISTER_OP) without code generation.
+# ---------------------------------------------------------------------------
+_OP_REGISTRY: dict = {}
+
+
+def register_op_meta(name: str, namespace: str, fn) -> None:
+    _OP_REGISTRY[f"{namespace}.{name}"] = fn
+
+
+def list_ops(namespace: str | None = None):
+    if namespace is None:
+        return sorted(_OP_REGISTRY)
+    prefix = namespace + "."
+    return sorted(k[len(prefix):] for k in _OP_REGISTRY if k.startswith(prefix))

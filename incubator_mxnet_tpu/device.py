@@ -1,0 +1,145 @@
+"""Device abstraction (parity with mxnet/device.py).
+
+Reference: `python/mxnet/device.py:24` defines `Device(device_type, device_id)`
+with `cpu()`/`gpu()` helpers and a thread-local current-device stack. The
+TPU-native build maps `tpu` to jax TPU devices and keeps `gpu()` as an alias
+for the accelerator so reference-style scripts run unchanged.
+"""
+from __future__ import annotations
+
+import threading
+
+__all__ = [
+    "Device",
+    "Context",
+    "cpu",
+    "gpu",
+    "tpu",
+    "num_gpus",
+    "num_tpus",
+    "current_device",
+    "gpu_memory_info",
+]
+
+_DEVTYPE_TO_JAX = {"cpu": "cpu", "tpu": "tpu", "gpu": "tpu"}
+
+
+class Device:
+    """A compute device: ``Device('tpu', 0)``, ``Device('cpu', 0)``.
+
+    Usable as a context manager to set the default device, like the
+    reference's ``with mx.gpu(1):`` pattern.
+    """
+
+    _default = None
+    _tls = threading.local()
+
+    def __init__(self, device_type: str, device_id: int = 0):
+        if isinstance(device_type, Device):
+            device_id = device_type.device_id
+            device_type = device_type.device_type
+        if device_type not in ("cpu", "gpu", "tpu", "cpu_pinned", "cpu_shared"):
+            raise ValueError(f"unknown device type {device_type!r}")
+        if device_type in ("cpu_pinned", "cpu_shared"):
+            device_type = "cpu"
+        self.device_type = device_type
+        self.device_id = int(device_id)
+
+    # -- jax bridge ---------------------------------------------------------
+    @property
+    def jax_device(self):
+        import jax
+
+        kind = _DEVTYPE_TO_JAX[self.device_type]
+        devs = [d for d in jax.devices() if d.platform == kind]
+        if not devs:
+            if kind == "tpu":
+                # accelerator platforms other than literal "tpu" (e.g. tunneled)
+                devs = [d for d in jax.devices() if d.platform != "cpu"]
+            if not devs:
+                devs = jax.devices("cpu")
+        return devs[min(self.device_id, len(devs) - 1)]
+
+    # -- protocol -----------------------------------------------------------
+    def __eq__(self, other):
+        return (
+            isinstance(other, Device)
+            and self.device_type == other.device_type
+            and self.device_id == other.device_id
+        )
+
+    def __hash__(self):
+        return hash((self.device_type, self.device_id))
+
+    def __repr__(self):
+        return f"{self.device_type}({self.device_id})"
+
+    __str__ = __repr__
+
+    def __enter__(self):
+        if not hasattr(Device._tls, "stack"):
+            Device._tls.stack = []
+        Device._tls.stack.append(self)
+        return self
+
+    def __exit__(self, *exc):
+        Device._tls.stack.pop()
+        return False
+
+
+# Back-compat alias, as the reference keeps `Context` (`python/mxnet/context.py`).
+Context = Device
+
+
+def _accelerator_present() -> bool:
+    import jax
+
+    try:
+        return any(d.platform != "cpu" for d in jax.devices())
+    except RuntimeError:
+        return False
+
+
+def cpu(device_id: int = 0) -> Device:
+    return Device("cpu", device_id)
+
+
+def tpu(device_id: int = 0) -> Device:
+    return Device("tpu", device_id)
+
+
+def gpu(device_id: int = 0) -> Device:
+    """Alias for the accelerator device (TPU on this framework)."""
+    return Device("tpu", device_id)
+
+
+def num_tpus() -> int:
+    import jax
+
+    return sum(1 for d in jax.devices() if d.platform != "cpu")
+
+
+num_gpus = num_tpus
+
+
+def current_device() -> Device:
+    stack = getattr(Device._tls, "stack", None)
+    if stack:
+        return stack[-1]
+    if Device._default is None:
+        Device._default = tpu(0) if _accelerator_present() else cpu(0)
+    return Device._default
+
+
+def gpu_memory_info(device_id: int = 0):
+    """(free, total) bytes on the accelerator (reference: device.py:249)."""
+    import jax
+
+    dev = tpu(device_id).jax_device
+    try:
+        stats = dev.memory_stats()
+        total = stats.get("bytes_limit", 0)
+        used = stats.get("bytes_in_use", 0)
+        return (total - used, total)
+    except Exception:
+        return (0, 0)

@@ -1,0 +1,364 @@
+"""Gluon Block / HybridBlock (reference: `python/mxnet/gluon/block.py:202,1006`).
+
+TPU-native design of `hybridize()`:
+
+Reference path: first call traces forward under deferred-compute into an
+nnvm::Symbol, wraps it in a C++ CachedOp which optimizes (CSE, fusion,
+memory plan) and replays through the imperative engine
+(`block.py:1104 _build_cache`, `src/imperative/cached_op.cc:833`).
+
+Here: first call runs eagerly (completing deferred parameter shape
+inference), then the whole forward is traced by `jax.jit` into StableHLO —
+XLA owns CSE/fusion/memory-planning. Mutable state is functionalized:
+parameter values enter as jit arguments, auxiliary-state updates (BatchNorm
+running stats) are collected by a TraceContext and returned as extra
+outputs, and RNG draws fold a traced key (see `random.trace_key_scope`).
+Under `autograd.record()`, one compiled call records as a single tape node
+whose vjp is `jax.vjp` of the whole compiled function.
+"""
+from __future__ import annotations
+
+from collections import OrderedDict
+
+import numpy as onp
+
+from .. import autograd
+from ..device import Device
+from ..ndarray.ndarray import NDArray, apply_op
+from ..random import next_key, trace_key_scope
+from ..utils.trace import TraceContext
+from .parameter import DeferredInitializationError, Parameter
+
+__all__ = ["Block", "HybridBlock", "SymbolBlock"]
+
+
+class Block:
+    """Base building block (reference: gluon/block.py:202)."""
+
+    def __init__(self):
+        self._children: OrderedDict[str, Block] = OrderedDict()
+        self._reg_params: OrderedDict[str, Parameter] = OrderedDict()
+
+    # -- attribute magic: registering children/params on assignment ---------
+    def __setattr__(self, name, value):
+        if isinstance(value, Block):
+            existing = self.__dict__.get("_children")
+            if existing is not None:
+                existing[name] = value
+        elif isinstance(value, Parameter):
+            existing = self.__dict__.get("_reg_params")
+            if existing is not None:
+                value.name = name
+                existing[name] = value
+        super().__setattr__(name, value)
+
+    # -- params -------------------------------------------------------------
+    def collect_params(self, select=None) -> dict:
+        """name → Parameter for self and descendants (reference: block.py:340)."""
+        import re
+
+        out = {}
+
+        def walk(block, prefix):
+            for n, p in block._reg_params.items():
+                out[prefix + n] = p
+            for n, c in block._children.items():
+                walk(c, f"{prefix}{n}.")
+
+        walk(self, "")
+        if select is not None:
+            pat = re.compile(select)
+            out = {k: v for k, v in out.items() if pat.match(k)}
+        return out
+
+    @property
+    def params(self):
+        return dict(self._reg_params)
+
+    def initialize(self, init=None, device=None, ctx=None, verbose=False,
+                   force_reinit=False):  # noqa: ARG002
+        for name, p in self.collect_params().items():
+            p.name = name
+            p.initialize(init=None if p.init is not None else init,
+                         device=device or ctx, force_reinit=force_reinit)
+
+    def setattr(self, name, value):
+        for p in self.collect_params().values():
+            setattr(p, name, value)
+
+    def register_child(self, block, name=None):
+        name = name or str(len(self._children))
+        self._children[name] = block
+
+    def register_block(self, name, block):
+        self._children[name] = block
+        super().__setattr__(name, block)
+
+    def apply(self, fn):
+        for c in self._children.values():
+            c.apply(fn)
+        fn(self)
+        return self
+
+    # -- lifecycle ----------------------------------------------------------
+    def cast(self, dtype):
+        for p in self.collect_params().values():
+            p.cast(dtype)
+        for c in self._children.values():
+            c.cast(dtype)
+
+    def reset_device(self, device):
+        for p in self.collect_params().values():
+            p.reset_device(device)
+
+    reset_ctx = reset_device
+
+    def hybridize(self, active=True, **kwargs):
+        for c in self._children.values():
+            c.hybridize(active, **kwargs)
+
+    def zero_grad(self):
+        for p in self.collect_params().values():
+            p.zero_grad()
+
+    # -- checkpointing (reference: block.py:340 save_parameters / :379) -----
+    def save_parameters(self, filename, deduplicate=False):  # noqa: ARG002
+        params = self.collect_params()
+        payload = {}
+        for name, p in params.items():
+            if p._data is not None:
+                payload[name] = p.data().asnumpy()
+        onp.savez(filename + ".npz" if not filename.endswith(".npz") else filename,
+                  **payload)
+        import os
+
+        if not filename.endswith(".npz") and os.path.exists(filename + ".npz"):
+            os.replace(filename + ".npz", filename)
+
+    def load_parameters(self, filename, device=None, ctx=None,
+                        allow_missing=False, ignore_extra=False,
+                        cast_dtype=False, dtype_source="current"):  # noqa: ARG002
+        params = self.collect_params()
+        with onp.load(filename, allow_pickle=False) as z:
+            loaded = {k: z[k] for k in z.keys()}
+        for name, p in params.items():
+            if name in loaded:
+                p.set_data(loaded[name])
+            elif not allow_missing:
+                raise KeyError(f"Parameter {name} missing in file {filename}")
+        extra = set(loaded) - set(params)
+        if extra and not ignore_extra:
+            raise KeyError(f"file {filename} contains extra parameters: {sorted(extra)}")
+
+    def load_dict(self, param_dict, device=None, allow_missing=False,
+                  ignore_extra=False):  # noqa: ARG002
+        params = self.collect_params()
+        for name, p in params.items():
+            if name in param_dict:
+                v = param_dict[name]
+                p.set_data(v if not isinstance(v, NDArray) else v)
+            elif not allow_missing:
+                raise KeyError(f"Parameter {name} missing in dict")
+
+    # -- call ---------------------------------------------------------------
+    def __call__(self, *args, **kwargs):
+        try:
+            return self.forward(*args, **kwargs)
+        except DeferredInitializationError:
+            self._deferred_infer_shape(*args, **kwargs)
+            for p in self._reg_params.values():
+                p._finish_deferred_init()
+            return self.forward(*args, **kwargs)
+
+    def _deferred_infer_shape(self, *args, **kwargs):
+        if hasattr(self, "infer_shape"):
+            self.infer_shape(*args, **kwargs)
+        else:
+            raise
+
+    def forward(self, *args, **kwargs):
+        raise NotImplementedError
+
+    def summary(self, *inputs):
+        """Print a per-layer summary (reference: block.py summary)."""
+        rows = []
+
+        def hook(block, indent):
+            name = type(block).__name__
+            n_params = sum(int(onp.prod(p.shape)) for p in
+                           block._reg_params.values()
+                           if p.shape is not None and all(s > 0 for s in p.shape))
+            rows.append(("  " * indent + name, n_params))
+            for c in block._children.values():
+                hook(c, indent + 1)
+
+        hook(self, 0)
+        total = sum(int(onp.prod(p.shape)) for p in self.collect_params().values()
+                    if p.shape is not None and all(s > 0 for s in p.shape))
+        lines = [f"{'Layer':<48}{'Params':>12}", "-" * 60]
+        lines += [f"{n:<48}{p:>12}" for n, p in rows]
+        lines += ["-" * 60, f"{'Total params':<48}{total:>12}"]
+        out = "\n".join(lines)
+        print(out)
+        return out
+
+    def __repr__(self):
+        s = f"{type(self).__name__}(\n"
+        for name, c in self._children.items():
+            s += f"  ({name}): {type(c).__name__}\n"
+        return s + ")"
+
+
+class _CachedGraph:
+    """Compiled forward (the CachedOp analogue). One compiled graph per
+    (training-mode, input-signature); jax.jit's shape cache provides the
+    per-signature part."""
+
+    def __init__(self, block):
+        self.block = block
+        self.param_arrays = [p.data() for p in block.collect_params().values()]
+        self._modes = {}  # training(bool) -> mode dict
+
+    def _mode(self, training: bool):
+        mode = self._modes.get(training)
+        if mode is not None:
+            return mode
+        import jax
+
+        block = self.block
+        param_arrays = self.param_arrays
+        probe = {}
+
+        def fn(param_vals, key, *input_vals):
+            saved = [(a, a._data) for a in param_arrays]
+            for a, v in zip(param_arrays, param_vals):
+                a._data = v
+            tc = TraceContext()
+            try:
+                with tc, trace_key_scope(key), autograd.pause(train_mode=training):
+                    wrapped = [NDArray(v) for v in input_vals]
+                    out = block.forward(*wrapped)
+            finally:
+                for a, v in saved:
+                    a._data = v
+            if isinstance(out, (list, tuple)):
+                out_vals = tuple(o._data for o in out)
+                probe["tree"] = ("tuple", len(out_vals))
+            else:
+                out_vals = (out._data,)
+                probe["tree"] = "single"
+            aux_pairs = list(tc.updates.values())
+            probe["aux_arrays"] = [a for a, _ in aux_pairs]
+            return out_vals + tuple(nv for _, nv in aux_pairs)
+
+        mode = {"jitted": jax.jit(fn), "probe": probe, "ready": False}
+        self._modes[training] = mode
+        return mode
+
+    def __call__(self, args):
+        mode = self._mode(autograd.is_training())
+        param_vals = [a._data for a in self.param_arrays]
+        input_vals = [a._data if isinstance(a, NDArray) else a for a in args]
+        key = next_key()
+
+        if not mode["ready"]:
+            # warmup call populates probe (output structure + aux set)
+            mode["jitted"](tuple(param_vals), key, *input_vals)
+            probe = mode["probe"]
+            mode["aux_arrays"] = probe["aux_arrays"]
+            mode["out_tree"] = probe["tree"]
+            mode["n_out"] = (1 if probe["tree"] == "single" else probe["tree"][1])
+            mode["ready"] = True
+
+        jit = mode["jitted"]
+        n_out = mode["n_out"]
+        aux_arrays = mode["aux_arrays"]
+        n_param = len(self.param_arrays)
+        n_in = len(input_vals)
+
+        def pure(*tensor_vals):
+            pv = tensor_vals[:n_param]
+            iv = tensor_vals[n_param:n_param + n_in]
+            return jit(tuple(pv), key, *iv)
+
+        op_args = list(self.param_arrays) + list(args)
+        outs = apply_op("cached_op", pure, tuple(op_args),
+                        n_outputs=n_out + len(aux_arrays))
+        if not isinstance(outs, tuple):
+            outs = (outs,)
+        main = outs[:n_out]
+        aux_new = outs[n_out:]
+        from ..utils.trace import register_aux_update
+
+        for a, nv in zip(aux_arrays, aux_new):
+            register_aux_update(a, nv._data)
+        if mode["out_tree"] == "single":
+            return main[0]
+        return tuple(main)
+
+
+class HybridBlock(Block):
+    """Block that can compile its forward with XLA (reference: block.py:1006)."""
+
+    def __init__(self):
+        super().__init__()
+        self._active = False
+        self._cached_graph: _CachedGraph | None = None
+        self._flags = {}
+
+    def hybridize(self, active=True, static_alloc=False, static_shape=False,
+                  backend=None, backend_opts=None, **kwargs):
+        self._active = active
+        self._flags = dict(static_alloc=static_alloc, static_shape=static_shape,
+                           backend=backend, backend_opts=backend_opts, **kwargs)
+        self._cached_graph = None
+        for c in self._children.values():
+            if isinstance(c, Block) and not isinstance(c, HybridBlock):
+                c.hybridize(active, **kwargs)
+        # children of a hybridized block execute inside the parent's trace
+
+    def optimize_for(self, x, *args, backend=None, **kwargs):
+        self.hybridize(True, backend=backend, **kwargs)
+        return self(x, *args)
+
+    def __call__(self, *args, **kwargs):
+        if not self._active or kwargs:
+            return super().__call__(*args, **kwargs)
+        if any(not isinstance(a, NDArray) for a in args):
+            return super().__call__(*args, **kwargs)
+        if self._cached_graph is None:
+            # eager first call completes deferred init; then compile
+            out = super().__call__(*args)
+            self._cached_graph = _CachedGraph(self)
+            return out
+        return self._cached_graph(args)
+
+    def export(self, path, epoch=0, remove_amp_cast=True):  # noqa: ARG002
+        """Serialize for deployment (reference: block.py:1480 writes
+        model-symbol.json + params; here: params + a config manifest)."""
+        import json
+
+        self.save_parameters(f"{path}-{epoch:04d}.params")
+        manifest = {"class": type(self).__name__, "format": "tpu-native-v1"}
+        with open(f"{path}-symbol.json", "w") as f:
+            json.dump(manifest, f)
+        return f"{path}-symbol.json", f"{path}-{epoch:04d}.params"
+
+    def infer_shape(self, *args):
+        """Subclasses with deferred params override this."""
+        raise DeferredInitializationError(
+            f"{type(self).__name__} cannot infer parameter shapes")
+
+    def forward(self, *args, **kwargs):
+        raise NotImplementedError
+
+
+class SymbolBlock(HybridBlock):
+    """Reference parity stub: importing reference-format symbol files is not
+    supported (the symbolic JSON IR is replaced by XLA/StableHLO)."""
+
+    @staticmethod
+    def imports(symbol_file, input_names, param_file=None, device=None):
+        raise NotImplementedError(
+            "SymbolBlock.imports: legacy nnvm JSON graphs are not portable to "
+            "the TPU-native build; re-export the model with HybridBlock.export")

@@ -1,0 +1,115 @@
+"""DataLoader (reference: `python/mxnet/gluon/data/dataloader.py`, 816 LoC —
+multiprocessing workers with POSIX-shm NDArray transfer).
+
+TPU-native design: worker processes produce *numpy* batches (host memory);
+the main process uploads to device HBM asynchronously (`jax.device_put`),
+which double-buffers naturally because jax dispatch is async. The reference's
+CPUSharedStorage + ForkingPickler machinery is replaced by a
+multiprocessing.Pool returning numpy arrays (pickled via shared mmap by the
+OS); decode/augment stays in workers exactly as in the reference.
+"""
+from __future__ import annotations
+
+import multiprocessing as mp
+
+from .batchify import default_batchify_fn
+from .sampler import BatchSampler, RandomSampler, SequentialSampler
+
+__all__ = ["DataLoader"]
+
+_worker_dataset = None
+_worker_batchify = None
+
+
+def _worker_init(dataset, batchify_fn):
+    global _worker_dataset, _worker_batchify
+    _worker_dataset = dataset
+    _worker_batchify = batchify_fn
+
+
+def _worker_fn(samples):
+    import numpy as onp
+
+    from ...ndarray.ndarray import NDArray
+
+    batch = _worker_batchify([_worker_dataset[i] for i in samples])
+
+    def to_numpy(b):
+        if isinstance(b, (tuple, list)):
+            return tuple(to_numpy(x) for x in b)
+        if isinstance(b, NDArray):
+            return b.asnumpy()
+        return onp.asarray(b)
+
+    return to_numpy(batch)
+
+
+class DataLoader:
+    def __init__(self, dataset, batch_size=None, shuffle=False, sampler=None,
+                 last_batch=None, batch_sampler=None, batchify_fn=None,
+                 num_workers=0, pin_memory=False, pin_device_id=0,
+                 prefetch=None, thread_pool=False, timeout=120,
+                 try_nopython=None):  # noqa: ARG002
+        self._dataset = dataset
+        self._timeout = timeout
+        if batch_sampler is None:
+            if batch_size is None:
+                raise ValueError("batch_size required when batch_sampler is None")
+            if sampler is None:
+                sampler = RandomSampler(len(dataset)) if shuffle \
+                    else SequentialSampler(len(dataset))
+            elif shuffle:
+                raise ValueError("shuffle must be False with explicit sampler")
+            batch_sampler = BatchSampler(sampler, batch_size,
+                                         last_batch or "keep")
+        self._batch_sampler = batch_sampler
+        self._batchify_fn = batchify_fn or default_batchify_fn
+        self._num_workers = max(0, num_workers)
+        self._prefetch = max(0, prefetch or 2 * self._num_workers)
+        self._pool = None
+        if self._num_workers > 0:
+            ctx = mp.get_context("fork")
+            self._pool = ctx.Pool(self._num_workers, initializer=_worker_init,
+                                  initargs=(dataset, self._batchify_fn))
+
+    def __iter__(self):
+        from ...ndarray.ndarray import NDArray
+
+        def wrap(b):
+            if isinstance(b, (tuple, list)):
+                return tuple(wrap(x) for x in b)
+            if isinstance(b, NDArray):
+                return b
+            return NDArray(b)
+
+        if self._pool is None:
+            for batch_idx in self._batch_sampler:
+                yield wrap(self._batchify_fn([self._dataset[i]
+                                              for i in batch_idx]))
+            return
+
+        # pipelined: keep `prefetch` batches in flight in the pool
+        batches = iter(self._batch_sampler)
+        in_flight = []
+        try:
+            for _ in range(self._prefetch):
+                b = next(batches, None)
+                if b is None:
+                    break
+                in_flight.append(self._pool.apply_async(_worker_fn, (b,)))
+            while in_flight:
+                result = in_flight.pop(0).get(self._timeout)
+                b = next(batches, None)
+                if b is not None:
+                    in_flight.append(self._pool.apply_async(_worker_fn, (b,)))
+                yield wrap(result)
+        except mp.TimeoutError as e:
+            raise RuntimeError(
+                f"DataLoader worker timed out after {self._timeout}s") from e
+
+    def __len__(self):
+        return len(self._batch_sampler)
+
+    def __del__(self):
+        if self._pool is not None:
+            self._pool.terminate()

@@ -1,0 +1,2 @@
+from . import transforms  # noqa: F401
+from .datasets import CIFAR10, CIFAR100, MNIST, FashionMNIST, ImageFolderDataset  # noqa: F401

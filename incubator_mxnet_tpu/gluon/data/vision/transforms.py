@@ -1,0 +1,216 @@
+"""Vision transforms (reference: `gluon/data/vision/transforms/`)."""
+from __future__ import annotations
+
+import numpy as onp
+
+from ....ndarray.ndarray import NDArray, apply_op
+from ...nn.basic_layers import HybridSequential
+from ...block import Block, HybridBlock
+
+__all__ = ["Compose", "Cast", "ToTensor", "Normalize", "Resize", "CenterCrop",
+           "RandomResizedCrop", "RandomFlipLeftRight", "RandomFlipTopBottom",
+           "RandomBrightness", "RandomContrast", "RandomSaturation", "CropResize"]
+
+
+def _jnp():
+    import jax.numpy as jnp
+
+    return jnp
+
+
+class Compose(HybridSequential):
+    def __init__(self, transforms=None):
+        super().__init__()
+        for t in transforms or []:
+            self.add(t)
+
+
+class Cast(HybridBlock):
+    def __init__(self, dtype="float32"):
+        super().__init__()
+        self._dtype = dtype
+
+    def forward(self, x):
+        return x.astype(self._dtype)
+
+
+class ToTensor(HybridBlock):
+    """HWC uint8 [0,255] → CHW float32 [0,1] (reference: transforms ToTensor)."""
+
+    def forward(self, x):
+        jnp = _jnp()
+
+        def f(v):
+            v = v.astype(jnp.float32) / 255.0
+            if v.ndim == 3:
+                return jnp.transpose(v, (2, 0, 1))
+            return jnp.transpose(v, (0, 3, 1, 2))
+
+        return apply_op("to_tensor", f, (x,))
+
+
+class Normalize(HybridBlock):
+    def __init__(self, mean=0.0, std=1.0):
+        super().__init__()
+        self._mean = onp.asarray(mean, dtype="float32")
+        self._std = onp.asarray(std, dtype="float32")
+
+    def forward(self, x):
+        jnp = _jnp()
+        mean = self._mean.reshape(-1, 1, 1) if self._mean.ndim else self._mean
+        std = self._std.reshape(-1, 1, 1) if self._std.ndim else self._std
+        return apply_op("normalize", lambda v: (v - mean) / std, (x,))
+
+
+def _resize_hwc(v, size):
+    import jax
+
+    jnp = _jnp()
+    h, w = (size, size) if isinstance(size, int) else (size[1], size[0])
+    if v.ndim == 3:
+        return jax.image.resize(v.astype(jnp.float32), (h, w, v.shape[2]),
+                                method="bilinear").astype(v.dtype)
+    return jax.image.resize(v.astype(jnp.float32),
+                            (v.shape[0], h, w, v.shape[3]),
+                            method="bilinear").astype(v.dtype)
+
+
+class Resize(HybridBlock):
+    def __init__(self, size, keep_ratio=False, interpolation=1):  # noqa: ARG002
+        super().__init__()
+        self._size = size
+
+    def forward(self, x):
+        return apply_op("resize", lambda v: _resize_hwc(v, self._size), (x,))
+
+
+class CenterCrop(HybridBlock):
+    def __init__(self, size, interpolation=1):  # noqa: ARG002
+        super().__init__()
+        self._size = (size, size) if isinstance(size, int) else size
+
+    def forward(self, x):
+        w, h = self._size
+
+        def f(v):
+            H, W = v.shape[-3], v.shape[-2]
+            y0 = max((H - h) // 2, 0)
+            x0 = max((W - w) // 2, 0)
+            out = v[..., y0:y0 + h, x0:x0 + w, :]
+            if out.shape[-3] != h or out.shape[-2] != w:
+                out = _resize_hwc(out, (w, h))
+            return out
+
+        return apply_op("center_crop", f, (x,))
+
+
+class CropResize(HybridBlock):
+    def __init__(self, x, y, width, height, size=None, interpolation=None):  # noqa: ARG002
+        super().__init__()
+        self._x, self._y, self._w, self._h = x, y, width, height
+        self._size = size
+
+    def forward(self, img):
+        x0, y0, w, h = self._x, self._y, self._w, self._h
+        size = self._size
+
+        def f(v):
+            out = v[..., y0:y0 + h, x0:x0 + w, :]
+            if size is not None:
+                out = _resize_hwc(out, size)
+            return out
+
+        return apply_op("crop_resize", f, (img,))
+
+
+class RandomResizedCrop(Block):
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3 / 4, 4 / 3),
+                 interpolation=1):  # noqa: ARG002
+        super().__init__()
+        self._size = (size, size) if isinstance(size, int) else size
+        self._scale = scale
+        self._ratio = ratio
+
+    def forward(self, x):
+        import math
+        import random as pyrandom
+
+        H, W = x.shape[-3], x.shape[-2]
+        area = H * W
+        for _ in range(10):
+            target_area = pyrandom.uniform(*self._scale) * area
+            log_ratio = (math.log(self._ratio[0]), math.log(self._ratio[1]))
+            aspect = math.exp(pyrandom.uniform(*log_ratio))
+            w = int(round(math.sqrt(target_area * aspect)))
+            h = int(round(math.sqrt(target_area / aspect)))
+            if 0 < w <= W and 0 < h <= H:
+                x0 = pyrandom.randint(0, W - w)
+                y0 = pyrandom.randint(0, H - h)
+                crop = x[..., y0:y0 + h, x0:x0 + w, :]
+                return apply_op("rrc",
+                                lambda v: _resize_hwc(v, self._size), (crop,))
+        return apply_op("rrc", lambda v: _resize_hwc(v, self._size), (x,))
+
+
+class _RandomFlip(Block):
+    _axis = -2
+
+    def forward(self, x):
+        import random as pyrandom
+
+        if pyrandom.random() < 0.5:
+            return x
+        jnp = _jnp()
+        ax = self._axis
+        return apply_op("flip", lambda v: jnp.flip(v, axis=ax), (x,))
+
+
+class RandomFlipLeftRight(_RandomFlip):
+    _axis = -2
+
+
+class RandomFlipTopBottom(_RandomFlip):
+    _axis = -3
+
+
+class _RandomJitter(Block):
+    def __init__(self, value):
+        super().__init__()
+        self._value = value
+
+
+class RandomBrightness(_RandomJitter):
+    def forward(self, x):
+        import random as pyrandom
+
+        alpha = 1.0 + pyrandom.uniform(-self._value, self._value)
+        return apply_op("brightness", lambda v: v * alpha, (x,))
+
+
+class RandomContrast(_RandomJitter):
+    def forward(self, x):
+        import random as pyrandom
+
+        jnp = _jnp()
+        alpha = 1.0 + pyrandom.uniform(-self._value, self._value)
+
+        def f(v):
+            gray = jnp.mean(v, axis=tuple(range(v.ndim - 3, v.ndim)),
+                            keepdims=True)
+            return v * alpha + gray * (1 - alpha)
+
+        return apply_op("contrast", f, (x,))
+
+
+class RandomSaturation(_RandomJitter):
+    def forward(self, x):
+        import random as pyrandom
+
+        jnp = _jnp()
+        alpha = 1.0 + pyrandom.uniform(-self._value, self._value)
+
+        def f(v):
+            gray = jnp.mean(v, axis=-1, keepdims=True)
+            return v * alpha + gray * (1 - alpha)
+
+        return apply_op("saturation", f, (x,))

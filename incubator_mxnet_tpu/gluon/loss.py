@@ -1,0 +1,370 @@
+"""Loss blocks (reference: `python/mxnet/gluon/loss.py`, 1009 LoC —
+L1/L2/SigmoidBCE/SoftmaxCE/KL/CTC/Huber/Hinge/Triplet/Cosine/Poisson)."""
+from __future__ import annotations
+
+from .. import numpy_extension as npx
+from ..ndarray.ndarray import NDArray, apply_op
+from .block import HybridBlock
+
+__all__ = [
+    "Loss", "L2Loss", "L1Loss", "SigmoidBinaryCrossEntropyLoss", "SigmoidBCELoss",
+    "SoftmaxCrossEntropyLoss", "SoftmaxCELoss", "KLDivLoss", "HuberLoss",
+    "HingeLoss", "SquaredHingeLoss", "LogisticLoss", "TripletLoss",
+    "PoissonNLLLoss", "CosineEmbeddingLoss", "CTCLoss",
+]
+
+
+def _jnp():
+    import jax.numpy as jnp
+
+    return jnp
+
+
+def _apply_weighting(loss, weight=None, sample_weight=None):
+    if sample_weight is not None:
+        loss = loss * sample_weight
+    if weight is not None:
+        loss = loss * weight
+    return loss
+
+
+def _reshape_like(pred, label):
+    return label.reshape(pred.shape) if label.shape != pred.shape else label
+
+
+class Loss(HybridBlock):
+    def __init__(self, weight=None, batch_axis=0, **kwargs):  # noqa: ARG002
+        super().__init__()
+        self._weight = weight
+        self._batch_axis = batch_axis
+
+    def __repr__(self):
+        return f"{type(self).__name__}(batch_axis={self._batch_axis}, w={self._weight})"
+
+
+class L2Loss(Loss):
+    def __init__(self, weight=1.0, batch_axis=0, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+
+    def forward(self, pred, label, sample_weight=None):
+        label = _reshape_like(pred, label)
+        loss = (pred - label) ** 2
+        loss = _apply_weighting(loss, self._weight / 2, sample_weight)
+        axes = tuple(i for i in range(loss.ndim) if i != self._batch_axis)
+        return loss.mean(axis=axes) if axes else loss
+
+
+class L1Loss(Loss):
+    def forward(self, pred, label, sample_weight=None):
+        label = _reshape_like(pred, label)
+        loss = (pred - label).abs()
+        loss = _apply_weighting(loss, self._weight, sample_weight)
+        axes = tuple(i for i in range(loss.ndim) if i != self._batch_axis)
+        return loss.mean(axis=axes) if axes else loss
+
+
+class SigmoidBinaryCrossEntropyLoss(Loss):
+    def __init__(self, from_sigmoid=False, weight=None, batch_axis=0, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._from_sigmoid = from_sigmoid
+
+    def forward(self, pred, label, sample_weight=None, pos_weight=None):
+        jnp = _jnp()
+        label = _reshape_like(pred, label)
+        if not self._from_sigmoid:
+            if pos_weight is None:
+                def f(p, l):
+                    return jnp.maximum(p, 0) - p * l + jnp.log1p(jnp.exp(-jnp.abs(p)))
+
+                loss = apply_op("sigmoid_bce", f, (pred, label))
+            else:
+                def f(p, l, pw):
+                    log_wt = (pw - 1) * l + 1
+                    return (1 - l) * p + log_wt * (
+                        jnp.log1p(jnp.exp(-jnp.abs(p))) + jnp.maximum(-p, 0))
+
+                loss = apply_op("sigmoid_bce", f, (pred, label, pos_weight))
+        else:
+            eps = 1e-12
+
+            def f(p, l):
+                w = 1.0 if pos_weight is None else None
+                del w
+                return -(jnp.log(p + eps) * l + jnp.log(1 - p + eps) * (1 - l))
+
+            loss = apply_op("sigmoid_bce", f, (pred, label))
+        loss = _apply_weighting(loss, self._weight, sample_weight)
+        axes = tuple(i for i in range(loss.ndim) if i != self._batch_axis)
+        return loss.mean(axis=axes) if axes else loss
+
+
+SigmoidBCELoss = SigmoidBinaryCrossEntropyLoss
+
+
+class SoftmaxCrossEntropyLoss(Loss):
+    """(reference: loss.py SoftmaxCrossEntropyLoss; sparse_label picks the
+    label logit; fused as one XLA graph instead of the reference's
+    softmax+pick kernel pair)."""
+
+    def __init__(self, axis=-1, sparse_label=True, from_logits=False,
+                 weight=None, batch_axis=0, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._axis = axis
+        self._sparse_label = sparse_label
+        self._from_logits = from_logits
+
+    def forward(self, pred, label, sample_weight=None):
+        import jax
+
+        jnp = _jnp()
+        axis = self._axis
+        sparse = self._sparse_label
+        from_logits = self._from_logits
+
+        def f(p, l):
+            logp = p if from_logits else jax.nn.log_softmax(p, axis=axis)
+            if sparse:
+                li = jnp.expand_dims(l.astype(jnp.int32), axis)
+                pick = jnp.take_along_axis(logp, li, axis=axis)
+                return -jnp.squeeze(pick, axis=axis)
+            return -jnp.sum(logp * l, axis=axis)
+
+        loss = apply_op("softmax_ce", f, (pred, label))
+        loss = _apply_weighting(loss, self._weight, sample_weight)
+        axes = tuple(i for i in range(loss.ndim) if i != self._batch_axis)
+        return loss.mean(axis=axes) if axes else loss
+
+
+SoftmaxCELoss = SoftmaxCrossEntropyLoss
+
+
+class KLDivLoss(Loss):
+    def __init__(self, from_logits=True, axis=-1, weight=None, batch_axis=0,
+                 **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._from_logits = from_logits
+        self._axis = axis
+
+    def forward(self, pred, label, sample_weight=None):
+        jnp = _jnp()
+        if not self._from_logits:
+            pred = npx.log_softmax(pred, axis=self._axis)
+
+        def f(p, l):
+            return l * (jnp.log(l + 1e-12) - p)
+
+        loss = apply_op("kldiv", f, (pred, label))
+        loss = _apply_weighting(loss, self._weight, sample_weight)
+        axes = tuple(i for i in range(loss.ndim) if i != self._batch_axis)
+        return loss.mean(axis=axes) if axes else loss
+
+
+class HuberLoss(Loss):
+    def __init__(self, rho=1.0, weight=None, batch_axis=0, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._rho = rho
+
+    def forward(self, pred, label, sample_weight=None):
+        jnp = _jnp()
+        label = _reshape_like(pred, label)
+        rho = self._rho
+
+        def f(p, l):
+            d = jnp.abs(p - l)
+            return jnp.where(d > rho, d - 0.5 * rho, (0.5 / rho) * d * d)
+
+        loss = apply_op("huber", f, (pred, label))
+        loss = _apply_weighting(loss, self._weight, sample_weight)
+        axes = tuple(i for i in range(loss.ndim) if i != self._batch_axis)
+        return loss.mean(axis=axes) if axes else loss
+
+
+class HingeLoss(Loss):
+    def __init__(self, margin=1, weight=None, batch_axis=0, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._margin = margin
+
+    def forward(self, pred, label, sample_weight=None):
+        jnp = _jnp()
+        label = _reshape_like(pred, label)
+        m = self._margin
+        loss = apply_op("hinge", lambda p, l: jnp.maximum(0.0, m - p * l),
+                        (pred, label))
+        loss = _apply_weighting(loss, self._weight, sample_weight)
+        axes = tuple(i for i in range(loss.ndim) if i != self._batch_axis)
+        return loss.mean(axis=axes) if axes else loss
+
+
+class SquaredHingeLoss(HingeLoss):
+    def forward(self, pred, label, sample_weight=None):
+        jnp = _jnp()
+        label = _reshape_like(pred, label)
+        m = self._margin
+        loss = apply_op("sq_hinge",
+                        lambda p, l: jnp.maximum(0.0, m - p * l) ** 2,
+                        (pred, label))
+        loss = _apply_weighting(loss, self._weight, sample_weight)
+        axes = tuple(i for i in range(loss.ndim) if i != self._batch_axis)
+        return loss.mean(axis=axes) if axes else loss
+
+
+class LogisticLoss(Loss):
+    def __init__(self, weight=None, batch_axis=0, label_format="signed", **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._label_format = label_format
+
+    def forward(self, pred, label, sample_weight=None):
+        jnp = _jnp()
+        label = _reshape_like(pred, label)
+        fmt = self._label_format
+
+        def f(p, l):
+            if fmt == "binary":
+                l = 2 * l - 1
+            return jnp.log1p(jnp.exp(-p * l))
+
+        loss = apply_op("logistic", f, (pred, label))
+        loss = _apply_weighting(loss, self._weight, sample_weight)
+        axes = tuple(i for i in range(loss.ndim) if i != self._batch_axis)
+        return loss.mean(axis=axes) if axes else loss
+
+
+class TripletLoss(Loss):
+    def __init__(self, margin=1, weight=None, batch_axis=0, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._margin = margin
+
+    def forward(self, pred, positive, negative, sample_weight=None):
+        jnp = _jnp()
+        m = self._margin
+
+        def f(p, pos, neg):
+            axes = tuple(range(1, p.ndim))
+            d = jnp.sum((p - pos) ** 2 - (p - neg) ** 2, axis=axes)
+            return jnp.maximum(d + m, 0.0)
+
+        loss = apply_op("triplet", f, (pred, positive, negative))
+        return _apply_weighting(loss, self._weight, sample_weight)
+
+
+class PoissonNLLLoss(Loss):
+    def __init__(self, weight=None, from_logits=True, batch_axis=0,
+                 compute_full=False, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._from_logits = from_logits
+        self._compute_full = compute_full
+
+    def forward(self, pred, target, sample_weight=None, epsilon=1e-08):
+        jnp = _jnp()
+        target = _reshape_like(pred, target)
+        from_logits = self._from_logits
+        full = self._compute_full
+
+        def f(p, t):
+            if from_logits:
+                loss = jnp.exp(p) - t * p
+            else:
+                loss = p - t * jnp.log(p + epsilon)
+            if full:
+                stirling = t * jnp.log(t + 1e-12) - t + 0.5 * jnp.log(
+                    2 * jnp.pi * (t + 1e-12))
+                loss = loss + jnp.where(t > 1, stirling, 0.0)
+            return loss
+
+        loss = apply_op("poisson_nll", f, (pred, target))
+        loss = _apply_weighting(loss, self._weight, sample_weight)
+        return loss.mean()
+
+
+class CosineEmbeddingLoss(Loss):
+    def __init__(self, weight=None, batch_axis=0, margin=0, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._margin = margin
+
+    def forward(self, input1, input2, label, sample_weight=None):
+        jnp = _jnp()
+        m = self._margin
+
+        def f(a, b, l):
+            a2 = a.reshape(a.shape[0], -1)
+            b2 = b.reshape(b.shape[0], -1)
+            cos = jnp.sum(a2 * b2, axis=1) / (
+                jnp.linalg.norm(a2, axis=1) * jnp.linalg.norm(b2, axis=1) + 1e-12)
+            lf = l.reshape(-1)
+            return jnp.where(lf == 1, 1 - cos, jnp.maximum(0.0, cos - m))
+
+        loss = apply_op("cosine_embedding", f, (input1, input2, label))
+        return _apply_weighting(loss, self._weight, sample_weight)
+
+
+class CTCLoss(Loss):
+    """Connectionist Temporal Classification (reference: loss.py CTCLoss →
+    `src/operator/nn/ctc_loss.cc`). Forward algorithm implemented as a
+    lax.scan dynamic program over time — compiles to one XLA while-loop."""
+
+    def __init__(self, layout="NTC", label_layout="NT", weight=None, **kwargs):
+        super().__init__(weight, 0, **kwargs)
+        self._layout = layout
+        self._label_layout = label_layout
+
+    def forward(self, pred, label, pred_lengths=None, label_lengths=None,
+                sample_weight=None):
+        import jax
+
+        jnp = _jnp()
+        layout = self._layout
+
+        def f(p, l, pl, ll):
+            if layout == "TNC":
+                p = jnp.moveaxis(p, 0, 1)  # -> NTC
+            N, T, C = p.shape
+            L = l.shape[1]
+            blank = 0
+            logp = jax.nn.log_softmax(p, axis=-1)
+            # extended label sequence: blank, l1, blank, l2, ... blank
+            S = 2 * L + 1
+            lab = l.astype(jnp.int32)
+            ext = jnp.full((N, S), blank, dtype=jnp.int32)
+            ext = ext.at[:, 1::2].set(lab)
+            pl_ = (jnp.full((N,), T, jnp.int32) if pl is None
+                   else pl.astype(jnp.int32))
+            ll_ = (jnp.full((N,), L, jnp.int32) if ll is None
+                   else ll.astype(jnp.int32))
+            S_len = 2 * ll_ + 1
+            neg_inf = -1e30
+            alpha0 = jnp.full((N, S), neg_inf)
+            alpha0 = alpha0.at[:, 0].set(logp[:, 0, blank])
+            alpha0 = alpha0.at[:, 1].set(
+                jnp.take_along_axis(logp[:, 0, :], ext[:, 1:2], axis=1)[:, 0])
+
+            same = jnp.concatenate(
+                [jnp.zeros((N, 2), bool),
+                 ext[:, 2:] == ext[:, :-2]], axis=1)
+
+            def step(alpha, t):
+                lp = jnp.take_along_axis(logp[:, t, :], ext, axis=1)
+                a_shift1 = jnp.concatenate(
+                    [jnp.full((N, 1), neg_inf), alpha[:, :-1]], axis=1)
+                a_shift2 = jnp.concatenate(
+                    [jnp.full((N, 2), neg_inf), alpha[:, :-2]], axis=1)
+                a_shift2 = jnp.where(same, neg_inf, a_shift2)
+                m = jnp.maximum(jnp.maximum(alpha, a_shift1), a_shift2)
+                new = m + jnp.log(
+                    jnp.exp(alpha - m) + jnp.exp(a_shift1 - m)
+                    + jnp.exp(a_shift2 - m) + 1e-38) + lp
+                # freeze past pl_
+                new = jnp.where((t < pl_)[:, None], new, alpha)
+                return new, None
+
+            alpha, _ = jax.lax.scan(step, alpha0, jnp.arange(1, T))
+            idx_last = S_len - 1
+            idx_prev = jnp.maximum(S_len - 2, 0)
+            a_last = jnp.take_along_axis(alpha, idx_last[:, None], axis=1)[:, 0]
+            a_prev = jnp.take_along_axis(alpha, idx_prev[:, None], axis=1)[:, 0]
+            m = jnp.maximum(a_last, a_prev)
+            ll_total = m + jnp.log(jnp.exp(a_last - m) + jnp.exp(a_prev - m)
+                                   + 1e-38)
+            return -ll_total
+
+        loss = apply_op("ctc", f, (pred, label, pred_lengths, label_lengths))
+        return _apply_weighting(loss, self._weight, sample_weight)

@@ -1,0 +1,321 @@
+"""Basic NN layers (reference: `python/mxnet/gluon/nn/basic_layers.py` —
+Dense, Dropout, BatchNorm, LayerNorm, GroupNorm, InstanceNorm, Embedding,
+Flatten, Sequential/HybridSequential, Lambda blocks)."""
+from __future__ import annotations
+
+from ... import numpy_extension as npx
+from ..block import Block, HybridBlock
+from ..parameter import Parameter
+
+__all__ = [
+    "Sequential", "HybridSequential", "Dense", "Dropout", "Flatten",
+    "BatchNorm", "LayerNorm", "GroupNorm", "InstanceNorm", "Embedding",
+    "Lambda", "HybridLambda", "Identity", "Concatenate", "HybridConcatenate",
+]
+
+
+class Sequential(Block):
+    """Stack of blocks (reference: basic_layers.py Sequential)."""
+
+    def __init__(self):
+        super().__init__()
+        self._layers = []
+
+    def add(self, *blocks):
+        for b in blocks:
+            self._layers.append(b)
+            self.register_child(b)
+
+    def forward(self, x, *args):
+        for block in self._layers:
+            x = block(x, *args)
+            args = ()
+        return x
+
+    def __call__(self, x, *args):
+        # containers delegate deferred-shape handling to children
+        return self.forward(x, *args)
+
+    def __len__(self):
+        return len(self._layers)
+
+    def __getitem__(self, key):
+        if isinstance(key, slice):
+            net = type(self)()
+            net.add(*self._layers[key])
+            return net
+        return self._layers[key]
+
+    def __iter__(self):
+        return iter(self._layers)
+
+    def hybridize(self, active=True, **kwargs):
+        for b in self._layers:
+            b.hybridize(active, **kwargs)
+
+
+class HybridSequential(HybridBlock):
+    def __init__(self):
+        super().__init__()
+        self._layers = []
+
+    def add(self, *blocks):
+        for b in blocks:
+            self._layers.append(b)
+            self.register_child(b)
+
+    def forward(self, x, *args):
+        for block in self._layers:
+            x = block(x, *args)
+            args = ()
+        return x
+
+    def __call__(self, *args, **kwargs):
+        if not self._active:
+            # run children directly so their deferred-init handling fires
+            return self.forward(*args, **kwargs)
+        return super().__call__(*args, **kwargs)
+
+    def __len__(self):
+        return len(self._layers)
+
+    def __getitem__(self, key):
+        if isinstance(key, slice):
+            net = type(self)()
+            net.add(*self._layers[key])
+            return net
+        return self._layers[key]
+
+    def __iter__(self):
+        return iter(self._layers)
+
+
+class Dense(HybridBlock):
+    """Fully-connected layer (reference: basic_layers.py Dense;
+    kernel `src/operator/nn/fully_connected.cc` → jnp.matmul on the MXU)."""
+
+    def __init__(self, units, activation=None, use_bias=True, flatten=True,
+                 dtype="float32", weight_initializer=None, bias_initializer="zeros",
+                 in_units=0):
+        super().__init__()
+        self._units = units
+        self._flatten = flatten
+        self.act = Activation(activation) if activation else None
+        self.weight = Parameter(shape=(units, in_units), dtype=dtype,
+                                init=weight_initializer, allow_deferred_init=True)
+        self.bias = Parameter(shape=(units,), dtype=dtype,
+                              init=bias_initializer,
+                              allow_deferred_init=True) if use_bias else None
+        if self.act is not None:
+            self.register_child(self.act, "act")
+
+    def infer_shape(self, x, *args):
+        in_units = x.size // x.shape[0] if self._flatten else x.shape[-1]
+        self.weight.shape = (self._units, in_units)
+        if self.bias is not None:
+            self.bias.shape = (self._units,)
+
+    def forward(self, x):
+        out = npx.fully_connected(
+            x, self.weight.data(), None if self.bias is None else self.bias.data(),
+            num_hidden=self._units, no_bias=self.bias is None,
+            flatten=self._flatten)
+        if self.act is not None:
+            out = self.act(out)
+        return out
+
+    def __repr__(self):
+        return f"Dense({self._units}, flatten={self._flatten})"
+
+
+class Dropout(HybridBlock):
+    def __init__(self, rate, axes=()):
+        super().__init__()
+        self._rate = rate
+        self._axes = axes
+
+    def forward(self, x):
+        return npx.dropout(x, p=self._rate, axes=self._axes)
+
+
+class Flatten(HybridBlock):
+    def forward(self, x):
+        return x.reshape(x.shape[0], -1)
+
+
+class _NormBase(HybridBlock):
+    def __init__(self, in_channels, scale=True, center=True, dtype="float32",
+                 gamma_initializer="ones", beta_initializer="zeros"):
+        super().__init__()
+        self.gamma = Parameter(shape=(in_channels,), dtype=dtype,
+                               init=gamma_initializer, allow_deferred_init=True,
+                               differentiable=scale)
+        self.beta = Parameter(shape=(in_channels,), dtype=dtype,
+                              init=beta_initializer, allow_deferred_init=True,
+                              differentiable=center)
+
+
+class BatchNorm(_NormBase):
+    """Batch normalization with running stats (reference: basic_layers.py
+    BatchNorm → `src/operator/nn/batch_norm.cc`; running stats are
+    FMutateInputs aux state, functionalized under jit via TraceContext)."""
+
+    def __init__(self, axis=1, momentum=0.9, epsilon=1e-5, center=True,
+                 scale=True, use_global_stats=False, in_channels=0,
+                 dtype="float32", **kwargs):
+        super().__init__(in_channels, scale, center, dtype)
+        self._axis = axis
+        self._momentum = momentum
+        self._epsilon = epsilon
+        self._use_global_stats = use_global_stats
+        self._scale = scale
+        self.running_mean = Parameter(shape=(in_channels,), dtype=dtype,
+                                      init="zeros", allow_deferred_init=True,
+                                      differentiable=False)
+        self.running_var = Parameter(shape=(in_channels,), dtype=dtype,
+                                     init="ones", allow_deferred_init=True,
+                                     differentiable=False)
+
+    def infer_shape(self, x, *args):
+        c = x.shape[self._axis]
+        for p in (self.gamma, self.beta, self.running_mean, self.running_var):
+            p.shape = (c,)
+
+    def forward(self, x):
+        return npx.batch_norm(
+            x, self.gamma.data(), self.beta.data(), self.running_mean.data(),
+            self.running_var.data(), eps=self._epsilon, momentum=self._momentum,
+            fix_gamma=not self._scale, use_global_stats=self._use_global_stats,
+            axis=self._axis)
+
+
+class LayerNorm(HybridBlock):
+    def __init__(self, axis=-1, epsilon=1e-5, center=True, scale=True,
+                 in_channels=0, dtype="float32", **kwargs):
+        super().__init__()
+        self._axis = axis
+        self._epsilon = epsilon
+        self.gamma = Parameter(shape=(in_channels,), dtype=dtype, init="ones",
+                               allow_deferred_init=True, differentiable=scale)
+        self.beta = Parameter(shape=(in_channels,), dtype=dtype, init="zeros",
+                              allow_deferred_init=True, differentiable=center)
+
+    def infer_shape(self, x, *args):
+        c = x.shape[self._axis]
+        self.gamma.shape = (c,)
+        self.beta.shape = (c,)
+
+    def forward(self, x):
+        return npx.layer_norm(x, self.gamma.data(), self.beta.data(),
+                              axis=self._axis, eps=self._epsilon)
+
+
+class GroupNorm(HybridBlock):
+    def __init__(self, num_groups=1, epsilon=1e-5, center=True, scale=True,
+                 in_channels=0, dtype="float32", **kwargs):
+        super().__init__()
+        self._num_groups = num_groups
+        self._epsilon = epsilon
+        self.gamma = Parameter(shape=(in_channels,), dtype=dtype, init="ones",
+                               allow_deferred_init=True, differentiable=scale)
+        self.beta = Parameter(shape=(in_channels,), dtype=dtype, init="zeros",
+                              allow_deferred_init=True, differentiable=center)
+
+    def infer_shape(self, x, *args):
+        c = x.shape[1]
+        self.gamma.shape = (c,)
+        self.beta.shape = (c,)
+
+    def forward(self, x):
+        return npx.group_norm(x, self.gamma.data(), self.beta.data(),
+                              num_groups=self._num_groups, eps=self._epsilon)
+
+
+class InstanceNorm(HybridBlock):
+    def __init__(self, axis=1, epsilon=1e-5, center=True, scale=False,
+                 in_channels=0, dtype="float32", **kwargs):
+        super().__init__()
+        self._epsilon = epsilon
+        self.gamma = Parameter(shape=(in_channels,), dtype=dtype, init="ones",
+                               allow_deferred_init=True, differentiable=scale)
+        self.beta = Parameter(shape=(in_channels,), dtype=dtype, init="zeros",
+                              allow_deferred_init=True, differentiable=center)
+
+    def infer_shape(self, x, *args):
+        c = x.shape[1]
+        self.gamma.shape = (c,)
+        self.beta.shape = (c,)
+
+    def forward(self, x):
+        return npx.instance_norm(x, self.gamma.data(), self.beta.data(),
+                                 eps=self._epsilon)
+
+
+class Embedding(HybridBlock):
+    """Index → vector lookup (reference: basic_layers.py Embedding; the
+    backward scatter-add is XLA's native embedding-gradient path, replacing
+    the reference's row_sparse gradient option)."""
+
+    def __init__(self, input_dim, output_dim, dtype="float32",
+                 weight_initializer=None, sparse_grad=False, **kwargs):
+        super().__init__()
+        self._input_dim = input_dim
+        self._output_dim = output_dim
+        self.weight = Parameter(shape=(input_dim, output_dim), dtype=dtype,
+                                init=weight_initializer)
+
+    def forward(self, x):
+        return npx.embedding(x, self.weight.data(), input_dim=self._input_dim,
+                             output_dim=self._output_dim)
+
+
+class Lambda(Block):
+    def __init__(self, function):
+        super().__init__()
+        self._func = function
+
+    def forward(self, *args):
+        return self._func(*args)
+
+
+class HybridLambda(HybridBlock):
+    def __init__(self, function):
+        super().__init__()
+        self._func = function
+
+    def forward(self, *args):
+        return self._func(*args)
+
+
+class Identity(HybridBlock):
+    def forward(self, x):
+        return x
+
+
+class Concatenate(Sequential):
+    """Run children on the same input, concat outputs along `axis`."""
+
+    def __init__(self, axis=-1):
+        super().__init__()
+        self._axis = axis
+
+    def forward(self, x):
+        from ... import numpy as np
+
+        return np.concatenate([block(x) for block in self._layers],
+                              axis=self._axis)
+
+
+class HybridConcatenate(HybridSequential):
+    def __init__(self, axis=-1):
+        super().__init__()
+        self._axis = axis
+
+    def forward(self, x):
+        from ... import numpy as np
+
+        return np.concatenate([block(x) for block in self._layers],
+                              axis=self._axis)
+
+
+from .activations import Activation  # noqa: E402  (used by Dense)

@@ -1,0 +1,192 @@
+"""Gluon Parameter (reference: `python/mxnet/gluon/parameter.py` — lazy shape
+inference, grad_req, per-device copies).
+
+TPU-native notes: a Parameter holds ONE NDArray; multi-device replication is
+expressed with jax sharding over a Mesh (see `parallel/`) instead of the
+reference's explicit per-GPU copies (`_init_impl`), so `list_data()` returns
+a single logical array whose buffer may be device-sharded.
+"""
+from __future__ import annotations
+
+import numpy as onp
+
+from .. import initializer as init_mod
+from ..base import np_dtype
+from ..device import Device, current_device
+from ..ndarray.ndarray import NDArray
+
+__all__ = ["Parameter", "Constant", "DeferredInitializationError"]
+
+
+class DeferredInitializationError(Exception):
+    """Accessing a parameter whose shape is not yet known."""
+
+
+def _shape_complete(shape):
+    return shape is not None and all(isinstance(s, int) and s > 0 for s in shape)
+
+
+class Parameter:
+    def __init__(self, shape=None, dtype="float32", init=None,
+                 grad_req="write", lr_mult=1.0, wd_mult=1.0,
+                 allow_deferred_init=False, differentiable=True,
+                 stype="default", grad_stype="default", name=None):  # noqa: ARG002
+        self._name = name or "param"
+        if isinstance(shape, int):
+            shape = (shape,)
+        self._shape = tuple(shape) if shape is not None else None
+        self.dtype = np_dtype(dtype)
+        self.init = init
+        self.lr_mult = lr_mult
+        self.wd_mult = wd_mult
+        self.grad_req = grad_req if differentiable else "null"
+        self._allow_deferred_init = allow_deferred_init
+        self._data: NDArray | None = None
+        self._deferred_init = None  # (initializer, device)
+
+    # -- identity -----------------------------------------------------------
+    @property
+    def name(self):
+        return self._name
+
+    @name.setter
+    def name(self, v):
+        self._name = v
+
+    @property
+    def shape(self):
+        return self._shape
+
+    @shape.setter
+    def shape(self, new_shape):
+        if self._shape is not None and _shape_complete(self._shape):
+            if tuple(new_shape) != self._shape:
+                raise ValueError(
+                    f"cannot reset shape of initialized Parameter {self._name} "
+                    f"from {self._shape} to {tuple(new_shape)}")
+        self._shape = tuple(new_shape)
+
+    # -- init ---------------------------------------------------------------
+    def initialize(self, init=None, device=None, ctx=None,
+                   default_init=init_mod.Uniform, force_reinit=False):
+        device = device or ctx
+        if self._data is not None and not force_reinit:
+            return
+        initializer = init_mod.create(init) if init is not None else (
+            init_mod.create(self.init) if self.init is not None
+            else default_init())
+        if not _shape_complete(self._shape):
+            if not self._allow_deferred_init:
+                raise ValueError(
+                    f"Parameter {self._name} has unknown shape {self._shape} and "
+                    "allow_deferred_init=False")
+            self._deferred_init = (initializer, device)
+            return
+        self._init_impl(initializer, device)
+
+    def _init_impl(self, initializer, device):
+        import jax.numpy as jnp
+
+        dev = Device(device) if device is not None else current_device()
+        arr = NDArray(jnp.zeros(self._shape, self.dtype), device=dev)
+        if callable(initializer) and not isinstance(initializer, init_mod.Initializer):
+            initializer(self._name, arr)
+        else:
+            initializer(self._name, arr)
+        self._data = arr
+        if self.grad_req != "null":
+            arr.attach_grad(self.grad_req)
+        self._deferred_init = None
+
+    def _finish_deferred_init(self):
+        if self._deferred_init is None:
+            return
+        if not _shape_complete(self._shape):
+            raise DeferredInitializationError(self._name)
+        initializer, device = self._deferred_init
+        self._init_impl(initializer, device)
+
+    # -- access -------------------------------------------------------------
+    def data(self, device=None, ctx=None):  # noqa: ARG002
+        if self._data is None:
+            if self._deferred_init is not None:
+                if _shape_complete(self._shape):
+                    self._finish_deferred_init()
+                    return self._data
+                raise DeferredInitializationError(
+                    f"Parameter {self._name} has not been initialized yet: "
+                    "unknown shape")
+            raise RuntimeError(
+                f"Parameter {self._name} has not been initialized. "
+                "Call .initialize() first")
+        return self._data
+
+    def list_data(self):
+        return [self.data()]
+
+    @property
+    def grad_or_none(self):
+        return self._data._grad if self._data is not None else None
+
+    def grad(self, device=None, ctx=None):  # noqa: ARG002
+        d = self.data()
+        if d._grad is None:
+            raise RuntimeError(
+                f"Parameter {self._name} does not have gradient (grad_req="
+                f"{self.grad_req!r})")
+        return d._grad
+
+    def list_grad(self):
+        return [self.grad()]
+
+    def zero_grad(self):
+        if self._data is not None and self._data._grad is not None:
+            import jax.numpy as jnp
+
+            g = self._data._grad
+            g._set_data(jnp.zeros(g.shape, g._data.dtype))
+
+    def set_data(self, data):
+        d = self.data() if self._data is not None else None
+        value = data._data if isinstance(data, NDArray) else data
+        if d is None:
+            self._shape = tuple(value.shape)
+            self._data = NDArray(value)
+            if self.grad_req != "null":
+                self._data.attach_grad(self.grad_req)
+        else:
+            d._set_data(value.astype(d._data.dtype)
+                        if hasattr(value, "astype") else value)
+
+    def reset_device(self, device):  # single logical device — placement no-op
+        if self._data is not None:
+            self._data = self._data.to_device(device)
+
+    reset_ctx = reset_device
+
+    def cast(self, dtype):
+        self.dtype = np_dtype(dtype)
+        if self._data is not None:
+            had_grad = self._data._grad is not None
+            self._data = NDArray(self._data._data.astype(self.dtype))
+            if had_grad:
+                self._data.attach_grad(self.grad_req)
+
+    def var(self):
+        raise NotImplementedError("symbol API not supported; use hybridize()")
+
+    def __repr__(self):
+        return (f"Parameter {self._name} (shape={self._shape}, "
+                f"dtype={onp.dtype(self.dtype).name if self.dtype is not None and str(self.dtype) != 'bfloat16' else self.dtype})")
+
+
+class Constant(Parameter):
+    """Non-learnable parameter holding a constant (reference: parameter.py)."""
+
+    def __init__(self, value, name=None):
+        if not isinstance(value, NDArray):
+            value = NDArray(value)
+        self.value = value
+        super().__init__(shape=value.shape, dtype=value.dtype,
+                         init=init_mod.Constant(value.asnumpy()),
+                         grad_req="null", name=name)

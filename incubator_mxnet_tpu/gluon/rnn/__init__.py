@@ -1,0 +1,5 @@
+from .rnn_cell import (  # noqa: F401
+    BidirectionalCell, DropoutCell, GRUCell, HybridRecurrentCell, LSTMCell,
+    RecurrentCell, ResidualCell, RNNCell, SequentialRNNCell, ZoneoutCell,
+)
+from .rnn_layer import GRU, LSTM, RNN  # noqa: F401

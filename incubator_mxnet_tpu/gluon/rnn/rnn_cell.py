@@ -1,0 +1,258 @@
+"""Unrolled RNN cells (reference: `python/mxnet/gluon/rnn/rnn_cell.py`)."""
+from __future__ import annotations
+
+from ... import numpy as np
+from ... import numpy_extension as npx
+from ...ndarray.ndarray import NDArray
+from ..block import HybridBlock
+from ..parameter import Parameter
+
+__all__ = ["RecurrentCell", "HybridRecurrentCell", "RNNCell", "LSTMCell",
+           "GRUCell", "SequentialRNNCell", "DropoutCell", "ZoneoutCell",
+           "ResidualCell", "BidirectionalCell"]
+
+
+class RecurrentCell(HybridBlock):
+    def __init__(self):
+        super().__init__()
+        self._modified = False
+
+    def reset(self):
+        self._modified = False
+
+    def state_info(self, batch_size=0):
+        raise NotImplementedError
+
+    def begin_state(self, batch_size=0, func=None, **kwargs):  # noqa: ARG002
+        import jax.numpy as jnp
+
+        return [NDArray(jnp.zeros(info["shape"]))
+                for info in self.state_info(batch_size)]
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None, valid_length=None):  # noqa: ARG002
+        axis = layout.find("T")
+        batch = inputs.shape[layout.find("N")]
+        states = begin_state or self.begin_state(batch)
+        outputs = []
+        for t in range(length):
+            x_t = inputs[t] if axis == 0 else inputs[:, t]
+            out, states = self(x_t, states)
+            outputs.append(out)
+        if merge_outputs is not False:
+            outputs = np.stack(outputs, axis=axis)
+        return outputs, states
+
+
+HybridRecurrentCell = RecurrentCell
+
+
+class _BaseCell(RecurrentCell):
+    def __init__(self, hidden_size, ngates, input_size=0, dtype="float32",
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros"):
+        super().__init__()
+        self._hidden_size = hidden_size
+        self._input_size = input_size
+        self.i2h_weight = Parameter(shape=(ngates * hidden_size, input_size),
+                                    dtype=dtype, init=i2h_weight_initializer,
+                                    allow_deferred_init=True)
+        self.h2h_weight = Parameter(shape=(ngates * hidden_size, hidden_size),
+                                    dtype=dtype, init=h2h_weight_initializer)
+        self.i2h_bias = Parameter(shape=(ngates * hidden_size,), dtype=dtype,
+                                  init=i2h_bias_initializer)
+        self.h2h_bias = Parameter(shape=(ngates * hidden_size,), dtype=dtype,
+                                  init=h2h_bias_initializer)
+        self._ngates = ngates
+
+    def infer_shape(self, x, *args):
+        self._input_size = x.shape[-1]
+        self.i2h_weight.shape = (self._ngates * self._hidden_size,
+                                 self._input_size)
+
+
+class RNNCell(_BaseCell):
+    def __init__(self, hidden_size, activation="tanh", input_size=0, **kwargs):
+        super().__init__(hidden_size, 1, input_size, **kwargs)
+        self._activation = activation
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._hidden_size)}]
+
+    def forward(self, x, states):
+        h = states[0]
+        i2h = npx.fully_connected(x, self.i2h_weight.data(),
+                                  self.i2h_bias.data(),
+                                  num_hidden=self._ngates * self._hidden_size)
+        h2h = npx.fully_connected(h, self.h2h_weight.data(),
+                                  self.h2h_bias.data(),
+                                  num_hidden=self._ngates * self._hidden_size)
+        out = npx.activation(i2h + h2h, act_type=self._activation)
+        return out, [out]
+
+
+class LSTMCell(_BaseCell):
+    def __init__(self, hidden_size, input_size=0, **kwargs):
+        super().__init__(hidden_size, 4, input_size, **kwargs)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._hidden_size)},
+                {"shape": (batch_size, self._hidden_size)}]
+
+    def forward(self, x, states):
+        h, c = states
+        H = self._hidden_size
+        gates = (npx.fully_connected(x, self.i2h_weight.data(),
+                                     self.i2h_bias.data(), num_hidden=4 * H)
+                 + npx.fully_connected(h, self.h2h_weight.data(),
+                                       self.h2h_bias.data(), num_hidden=4 * H))
+        i = npx.sigmoid(gates[:, :H])
+        f = npx.sigmoid(gates[:, H:2 * H])
+        g = np.tanh(gates[:, 2 * H:3 * H])
+        o = npx.sigmoid(gates[:, 3 * H:])
+        c_new = f * c + i * g
+        h_new = o * np.tanh(c_new)
+        return h_new, [h_new, c_new]
+
+
+class GRUCell(_BaseCell):
+    def __init__(self, hidden_size, input_size=0, **kwargs):
+        super().__init__(hidden_size, 3, input_size, **kwargs)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._hidden_size)}]
+
+    def forward(self, x, states):
+        h = states[0]
+        H = self._hidden_size
+        i2h = npx.fully_connected(x, self.i2h_weight.data(),
+                                  self.i2h_bias.data(), num_hidden=3 * H)
+        h2h = npx.fully_connected(h, self.h2h_weight.data(),
+                                  self.h2h_bias.data(), num_hidden=3 * H)
+        r = npx.sigmoid(i2h[:, :H] + h2h[:, :H])
+        z = npx.sigmoid(i2h[:, H:2 * H] + h2h[:, H:2 * H])
+        n = np.tanh(i2h[:, 2 * H:] + r * h2h[:, 2 * H:])
+        h_new = (1 - z) * n + z * h
+        return h_new, [h_new]
+
+
+class SequentialRNNCell(RecurrentCell):
+    def __init__(self):
+        super().__init__()
+        self._cells = []
+
+    def add(self, cell):
+        self._cells.append(cell)
+        self.register_child(cell)
+
+    def state_info(self, batch_size=0):
+        return sum((c.state_info(batch_size) for c in self._cells), [])
+
+    def begin_state(self, batch_size=0, **kwargs):
+        return sum((c.begin_state(batch_size, **kwargs) for c in self._cells), [])
+
+    def __len__(self):
+        return len(self._cells)
+
+    def __getitem__(self, i):
+        return self._cells[i]
+
+    def forward(self, x, states):
+        next_states = []
+        pos = 0
+        for cell in self._cells:
+            n = len(cell.state_info())
+            x, new_s = cell(x, states[pos:pos + n])
+            pos += n
+            next_states.extend(new_s)
+        return x, next_states
+
+
+class DropoutCell(RecurrentCell):
+    def __init__(self, rate, axes=()):
+        super().__init__()
+        self._rate = rate
+        self._axes = axes
+
+    def state_info(self, batch_size=0):  # noqa: ARG002
+        return []
+
+    def forward(self, x, states):
+        return npx.dropout(x, p=self._rate, axes=self._axes), states
+
+
+class ZoneoutCell(RecurrentCell):
+    def __init__(self, base_cell, zoneout_outputs=0.0, zoneout_states=0.0):
+        super().__init__()
+        self.base_cell = base_cell
+        self._zo = zoneout_outputs
+        self._zs = zoneout_states
+        self._prev_output = None
+
+    def state_info(self, batch_size=0):
+        return self.base_cell.state_info(batch_size)
+
+    def forward(self, x, states):
+        from ... import autograd
+
+        out, next_states = self.base_cell(x, states)
+        if autograd.is_training():
+            import jax.random as jr
+
+            from ...random import next_key
+
+            def mask(p, new, old):
+                keep = NDArray(jr.bernoulli(next_key(), 1 - p, new.shape))
+                return keep * new + (1 - keep) * old
+
+            if self._zo:
+                prev = self._prev_output if self._prev_output is not None \
+                    else out.zeros_like()
+                out = mask(self._zo, out, prev)
+            if self._zs:
+                next_states = [mask(self._zs, ns, s)
+                               for ns, s in zip(next_states, states)]
+        self._prev_output = out
+        return out, next_states
+
+
+class ResidualCell(RecurrentCell):
+    def __init__(self, base_cell):
+        super().__init__()
+        self.base_cell = base_cell
+
+    def state_info(self, batch_size=0):
+        return self.base_cell.state_info(batch_size)
+
+    def forward(self, x, states):
+        out, next_states = self.base_cell(x, states)
+        return out + x, next_states
+
+
+class BidirectionalCell(RecurrentCell):
+    def __init__(self, l_cell, r_cell):
+        super().__init__()
+        self.l_cell = l_cell
+        self.r_cell = r_cell
+
+    def state_info(self, batch_size=0):
+        return (self.l_cell.state_info(batch_size)
+                + self.r_cell.state_info(batch_size))
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None, valid_length=None):
+        axis = layout.find("T")
+        batch = inputs.shape[layout.find("N")]
+        states = begin_state or self.begin_state(batch)
+        n_l = len(self.l_cell.state_info())
+        l_out, l_states = self.l_cell.unroll(length, inputs, states[:n_l],
+                                             layout, True, valid_length)
+        rev = np.flip(inputs, axis=axis)
+        r_out, r_states = self.r_cell.unroll(length, rev, states[n_l:],
+                                             layout, True, valid_length)
+        r_out = np.flip(r_out, axis=axis)
+        out = np.concatenate([l_out, r_out], axis=-1)
+        return out, l_states + r_states
+
+    def forward(self, x, states):
+        raise NotImplementedError("BidirectionalCell supports unroll() only")

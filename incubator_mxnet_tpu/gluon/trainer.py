@@ -1,0 +1,150 @@
+"""Gluon Trainer (reference: `python/mxnet/gluon/trainer.py:32` — kvstore
+setup, `step` :341 → `_allreduce_grads` :392 → `_update` :451).
+
+TPU-native: gradient reduction goes through the KVStore facade whose
+'device'/'dist' backends are ICI/DCN collectives (jax.lax.psum under
+shard_map) instead of ps-lite/NCCL; on a single chip it is a no-op."""
+from __future__ import annotations
+
+from .. import optimizer as opt_mod
+from ..ndarray.ndarray import NDArray
+
+__all__ = ["Trainer"]
+
+
+class Trainer:
+    def __init__(self, params, optimizer, optimizer_params=None, kvstore="device",
+                 compression_params=None, update_on_kvstore=None):  # noqa: ARG002
+        if isinstance(params, (dict,)):
+            param_dict = dict(params)
+        else:
+            param_dict = {getattr(p, "name", str(i)): p
+                          for i, p in enumerate(params)}
+        self._params = []
+        self._params_by_name = {}
+        for name, p in sorted(param_dict.items()):
+            p.name = name
+            self._params.append(p)
+            self._params_by_name[name] = p
+        self._scale = 1.0
+        optimizer_params = optimizer_params or {}
+        if isinstance(optimizer, opt_mod.Optimizer):
+            self._optimizer = optimizer
+        else:
+            self._optimizer = opt_mod.create(optimizer, **optimizer_params)
+        self._optimizer.param_dict = {p.name: p for p in self._params}
+        self._states = [None] * len(self._params)
+        self._states_initialized = [False] * len(self._params)
+        self._kvstore = None
+        self._kvstore_type = kvstore
+        self._update_on_kvstore = False
+        self._kv_initialized = False
+
+    # -- kvstore ------------------------------------------------------------
+    def _init_kvstore(self):
+        from .. import kvstore as kv_mod
+
+        if self._kvstore_type is None:
+            self._kvstore = None
+        elif isinstance(self._kvstore_type, str):
+            self._kvstore = kv_mod.create(self._kvstore_type)
+        else:
+            self._kvstore = self._kvstore_type
+        self._kv_initialized = True
+
+    @property
+    def learning_rate(self):
+        return self._optimizer.learning_rate
+
+    @property
+    def optimizer(self):
+        return self._optimizer
+
+    def set_learning_rate(self, lr):
+        self._optimizer.set_learning_rate(lr)
+
+    # -- step ---------------------------------------------------------------
+    def step(self, batch_size, ignore_stale_grad=False):
+        """Rescale grads by 1/batch_size, allreduce, apply optimizer."""
+        if not self._kv_initialized:
+            self._init_kvstore()
+        self._optimizer.rescale_grad = self._scale / batch_size
+        self._allreduce_grads()
+        self._update(ignore_stale_grad)
+
+    def allreduce_grads(self):
+        if not self._kv_initialized:
+            self._init_kvstore()
+        self._allreduce_grads()
+
+    def _allreduce_grads(self):
+        if self._kvstore is None:
+            return
+        for i, p in enumerate(self._params):
+            if p.grad_req != "null" and p._data is not None \
+                    and p._data._grad is not None:
+                self._kvstore.pushpull(i, p.data()._grad, out=p.data()._grad)
+
+    def update(self, batch_size, ignore_stale_grad=False):
+        if not self._kv_initialized:
+            self._init_kvstore()
+        self._optimizer.rescale_grad = self._scale / batch_size
+        self._update(ignore_stale_grad)
+
+    def _update(self, ignore_stale_grad=False):  # noqa: ARG002
+        for i, p in enumerate(self._params):
+            if p.grad_req == "null" or p._data is None:
+                continue
+            if p._data._grad is None:
+                continue
+            if not self._states_initialized[i]:
+                self._states[i] = self._optimizer.create_state_multi_precision(
+                    i, p.data())
+                self._states_initialized[i] = True
+            self._optimizer.idx2name[i] = p.name
+            new_state = self._optimizer.update_multi_precision(
+                i, p.data(), p.data()._grad, self._states[i])
+            if new_state is not None:
+                self._states[i] = new_state
+
+    # -- checkpointing (reference: trainer.py:489,518) -----------------------
+    def save_states(self, fname):
+        import pickle
+
+        import numpy as onp
+
+        payload = []
+        for s in self._states:
+            if s is None:
+                payload.append(None)
+            elif isinstance(s, list):
+                payload.append([onp.asarray(x) for x in s])
+            elif isinstance(s, tuple):
+                payload.append(("mp", onp.asarray(s[0]),
+                                [onp.asarray(x) for x in s[1]]))
+            else:
+                payload.append(onp.asarray(s))
+        with open(fname, "wb") as f:
+            pickle.dump({"states": payload,
+                         "num_update": self._optimizer.num_update}, f)
+
+    def load_states(self, fname):
+        import pickle
+
+        import jax.numpy as jnp
+
+        with open(fname, "rb") as f:
+            payload = pickle.load(f)
+        states = []
+        for s in payload["states"]:
+            if s is None:
+                states.append(None)
+            elif isinstance(s, list):
+                states.append([jnp.asarray(x) for x in s])
+            elif isinstance(s, tuple) and len(s) == 3 and s[0] == "mp":
+                states.append((jnp.asarray(s[1]), [jnp.asarray(x) for x in s[2]]))
+            else:
+                states.append(jnp.asarray(s))
+        self._states = states
+        self._states_initialized = [s is not None for s in states]
+        self._optimizer.num_update = payload.get("num_update", 0)

@@ -1,0 +1,65 @@
+"""Gluon utilities (reference: `python/mxnet/gluon/utils.py`)."""
+from __future__ import annotations
+
+from ..ndarray.ndarray import NDArray
+
+__all__ = ["split_data", "split_and_load", "clip_global_norm", "download",
+           "check_sha1"]
+
+
+def split_data(data, num_slice, batch_axis=0, even_split=True):
+    size = data.shape[batch_axis]
+    if even_split and size % num_slice != 0:
+        raise ValueError(
+            f"data with shape {data.shape} cannot be evenly split into "
+            f"{num_slice} slices along axis {batch_axis}")
+    step = size // num_slice
+    slices = []
+    for i in range(num_slice):
+        begin = i * step
+        end = (i + 1) * step if i < num_slice - 1 else size
+        idx = [slice(None)] * data.ndim
+        idx[batch_axis] = slice(begin, end)
+        slices.append(data[tuple(idx)])
+    return slices
+
+
+def split_and_load(data, ctx_list=None, device_list=None, batch_axis=0,
+                   even_split=True):
+    """Split a batch across devices (reference: utils.py split_and_load).
+
+    On TPU the idiomatic equivalent is a sharded array over the mesh; this
+    helper keeps API parity by returning per-device NDArray slices."""
+    devices = device_list or ctx_list
+    if not isinstance(data, NDArray):
+        data = NDArray(data)
+    if len(devices) == 1:
+        return [data.to_device(devices[0])]
+    slices = split_data(data, len(devices), batch_axis, even_split)
+    return [s.to_device(d) for s, d in zip(slices, devices)]
+
+
+def clip_global_norm(arrays, max_norm, check_isfinite=True):
+    from ..numpy_extension import clip_global_norm as _impl
+
+    return _impl(arrays, max_norm, check_isfinite)
+
+
+def check_sha1(filename, sha1_hash):
+    import hashlib
+
+    sha1 = hashlib.sha1()
+    with open(filename, "rb") as f:
+        while True:
+            data = f.read(1048576)
+            if not data:
+                break
+            sha1.update(data)
+    return sha1.hexdigest() == sha1_hash
+
+
+def download(url, path=None, overwrite=False, sha1_hash=None, retries=5,
+             verify_ssl=True):  # noqa: ARG001
+    raise RuntimeError(
+        "download() is unavailable: this environment has no network egress. "
+        "Place files locally and pass their path instead.")

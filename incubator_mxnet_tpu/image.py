@@ -1,0 +1,103 @@
+"""Imperative image API (reference: `python/mxnet/image/` — imread, imresize,
+augmenters). The reference decodes JPEG with OpenCV; here PIL is used when
+available, with raw `.npy` as the always-available container format."""
+from __future__ import annotations
+
+import numpy as onp
+
+from .ndarray.ndarray import NDArray
+
+__all__ = ["imread", "imdecode", "imresize", "resize_short", "fixed_crop",
+           "center_crop", "random_crop", "color_normalize"]
+
+
+def _pil():
+    try:
+        from PIL import Image
+
+        return Image
+    except ImportError:
+        return None
+
+
+def imdecode(buf, flag=1, to_rgb=True):  # noqa: ARG001
+    if isinstance(buf, (bytes, bytearray)) and bytes(buf[:6]) == b"\x93NUMPY":
+        import io as _io
+
+        return NDArray(onp.load(_io.BytesIO(bytes(buf))))
+    Image = _pil()
+    if Image is None:
+        raise RuntimeError("JPEG/PNG decode requires PIL, which is not "
+                           "installed; use .npy images")
+    import io as _io
+
+    img = Image.open(_io.BytesIO(bytes(buf)))
+    if flag == 1:
+        img = img.convert("RGB")
+    else:
+        img = img.convert("L")
+    arr = onp.asarray(img)
+    if arr.ndim == 2:
+        arr = arr[:, :, None]
+    return NDArray(arr)
+
+
+def imread(filename, flag=1, to_rgb=True):
+    if filename.endswith(".npy"):
+        return NDArray(onp.load(filename))
+    with open(filename, "rb") as f:
+        return imdecode(f.read(), flag, to_rgb)
+
+
+def imresize(src, w, h, interp=1):  # noqa: ARG001
+    import jax
+
+    import jax.numpy as jnp
+
+    v = src._data if isinstance(src, NDArray) else jnp.asarray(src)
+    out = jax.image.resize(v.astype(jnp.float32), (h, w, v.shape[2]),
+                           method="bilinear")
+    return NDArray(out.astype(v.dtype))
+
+
+def resize_short(src, size, interp=1):
+    h, w = src.shape[0], src.shape[1]
+    if h > w:
+        new_h, new_w = size * h // w, size
+    else:
+        new_h, new_w = size, size * w // h
+    return imresize(src, new_w, new_h, interp)
+
+
+def fixed_crop(src, x0, y0, w, h, size=None, interp=1):
+    out = src[y0:y0 + h, x0:x0 + w]
+    if size is not None and (w, h) != size:
+        out = imresize(out, size[0], size[1], interp)
+    return out
+
+
+def center_crop(src, size, interp=1):
+    h, w = src.shape[0], src.shape[1]
+    new_w, new_h = size
+    x0 = (w - new_w) // 2
+    y0 = (h - new_h) // 2
+    out = fixed_crop(src, x0, y0, new_w, new_h, None, interp)
+    return out, (x0, y0, new_w, new_h)
+
+
+def random_crop(src, size, interp=1):
+    import random as pyrandom
+
+    h, w = src.shape[0], src.shape[1]
+    new_w, new_h = size
+    x0 = pyrandom.randint(0, max(w - new_w, 0))
+    y0 = pyrandom.randint(0, max(h - new_h, 0))
+    out = fixed_crop(src, x0, y0, new_w, new_h, None, interp)
+    return out, (x0, y0, new_w, new_h)
+
+
+def color_normalize(src, mean, std=None):
+    src = src - mean
+    if std is not None:
+        src = src / std
+    return src

@@ -1,0 +1,253 @@
+"""Weight initializers (reference: `python/mxnet/initializer.py`).
+
+Same registry + `InitDesc`-style dispatch as the reference: parameter names
+ending in specific suffixes get conventional defaults (bias→zero, gamma→one,
+running_mean→zero, running_var→one) unless the initializer overrides.
+"""
+from __future__ import annotations
+
+import math
+import re
+
+import numpy as onp
+
+__all__ = [
+    "Initializer", "Zero", "One", "Constant", "Uniform", "Normal", "Orthogonal",
+    "Xavier", "MSRAPrelu", "Bilinear", "LSTMBias", "register", "create",
+]
+
+_REGISTRY: dict = {}
+
+
+def register(cls):
+    _REGISTRY[cls.__name__.lower()] = cls
+    return cls
+
+
+def create(name, **kwargs):
+    if isinstance(name, Initializer):
+        return name
+    if name is None:
+        return Uniform()
+    if callable(name) and not isinstance(name, type):
+        return name
+    key = str(name).lower()
+    if key not in _REGISTRY:
+        raise ValueError(f"unknown initializer {name!r}; known: {sorted(_REGISTRY)}")
+    return _REGISTRY[key](**kwargs)
+
+
+class Initializer:
+    """Base initializer. Call with (name, NDArray) to fill in place."""
+
+    def __init__(self, **kwargs):
+        self._kwargs = kwargs
+
+    def __call__(self, name, arr):
+        self.init_weight(name, arr)
+
+    def init_weight(self, name, arr):
+        name = name or ""
+        if name.endswith("bias"):
+            self._init_zero(arr)
+        elif name.endswith("gamma"):
+            self._init_one(arr)
+        elif name.endswith("beta"):
+            self._init_zero(arr)
+        elif name.endswith("running_mean") or name.endswith("moving_mean"):
+            self._init_zero(arr)
+        elif name.endswith("running_var") or name.endswith("moving_var"):
+            self._init_one(arr)
+        else:
+            self._init_weight(name, arr)
+
+    # -- default fills ------------------------------------------------------
+    def _init_zero(self, arr):
+        import jax.numpy as jnp
+
+        arr._set_data(jnp.zeros(arr.shape, arr._data.dtype))
+
+    def _init_one(self, arr):
+        import jax.numpy as jnp
+
+        arr._set_data(jnp.ones(arr.shape, arr._data.dtype))
+
+    def _init_weight(self, name, arr):
+        raise NotImplementedError
+
+    def __repr__(self):
+        return f"{type(self).__name__}({self._kwargs})"
+
+
+@register
+class Zero(Initializer):
+    def _init_weight(self, name, arr):
+        self._init_zero(arr)
+
+
+Zeros = Zero
+_REGISTRY["zeros"] = Zero
+
+
+@register
+class One(Initializer):
+    def _init_weight(self, name, arr):
+        self._init_one(arr)
+
+
+Ones = One
+_REGISTRY["ones"] = One
+
+
+@register
+class Constant(Initializer):
+    def __init__(self, value=0.0):
+        super().__init__(value=value)
+        self.value = value
+
+    def _init_weight(self, name, arr):
+        import jax.numpy as jnp
+
+        v = self.value
+        if hasattr(v, "asnumpy"):
+            v = v.asnumpy()
+        arr._set_data(jnp.broadcast_to(jnp.asarray(v, arr._data.dtype),
+                                       arr.shape).copy())
+
+
+@register
+class Uniform(Initializer):
+    def __init__(self, scale=0.07):
+        super().__init__(scale=scale)
+        self.scale = scale
+
+    def _init_weight(self, name, arr):
+        import jax.random as jr
+
+        from .random import next_key
+
+        arr._set_data(jr.uniform(next_key(), arr.shape, arr._data.dtype,
+                                 -self.scale, self.scale))
+
+
+@register
+class Normal(Initializer):
+    def __init__(self, sigma=0.01):
+        super().__init__(sigma=sigma)
+        self.sigma = sigma
+
+    def _init_weight(self, name, arr):
+        import jax.random as jr
+
+        from .random import next_key
+
+        arr._set_data(jr.normal(next_key(), arr.shape, arr._data.dtype) * self.sigma)
+
+
+@register
+class Orthogonal(Initializer):
+    def __init__(self, scale=1.414, rand_type="uniform"):
+        super().__init__(scale=scale, rand_type=rand_type)
+        self.scale = scale
+        self.rand_type = rand_type
+
+    def _init_weight(self, name, arr):
+        import jax.numpy as jnp
+        import jax.random as jr
+
+        from .random import next_key
+
+        nout = arr.shape[0]
+        nin = int(onp.prod(arr.shape[1:])) if len(arr.shape) > 1 else 1
+        if self.rand_type == "uniform":
+            tmp = jr.uniform(next_key(), (nout, nin), minval=-1.0, maxval=1.0)
+        else:
+            tmp = jr.normal(next_key(), (nout, nin))
+        u, _, v = jnp.linalg.svd(tmp, full_matrices=False)
+        q = u if u.shape == (nout, nin) else v
+        arr._set_data((self.scale * q).reshape(arr.shape).astype(arr._data.dtype))
+
+
+@register
+class Xavier(Initializer):
+    def __init__(self, rnd_type="uniform", factor_type="avg", magnitude=3):
+        super().__init__(rnd_type=rnd_type, factor_type=factor_type,
+                         magnitude=magnitude)
+        self.rnd_type = rnd_type
+        self.factor_type = factor_type
+        self.magnitude = float(magnitude)
+
+    def _init_weight(self, name, arr):
+        import jax.random as jr
+
+        from .random import next_key
+
+        shape = arr.shape
+        hw_scale = 1.0
+        if len(shape) < 2:
+            raise ValueError(f"Xavier requires ndim >= 2, got shape {shape} for {name}")
+        if len(shape) > 2:
+            hw_scale = float(onp.prod(shape[2:]))
+        fan_in, fan_out = shape[1] * hw_scale, shape[0] * hw_scale
+        factor = {"avg": (fan_in + fan_out) / 2.0, "in": fan_in,
+                  "out": fan_out}[self.factor_type]
+        scale = math.sqrt(self.magnitude / factor)
+        if self.rnd_type == "uniform":
+            arr._set_data(jr.uniform(next_key(), shape, arr._data.dtype,
+                                     -scale, scale))
+        else:
+            arr._set_data(jr.normal(next_key(), shape, arr._data.dtype) * scale)
+
+
+@register
+class MSRAPrelu(Xavier):
+    def __init__(self, factor_type="avg", slope=0.25):
+        magnitude = 2.0 / (1 + slope ** 2)
+        super().__init__("gaussian", factor_type, magnitude)
+        self._kwargs = {"factor_type": factor_type, "slope": slope}
+
+
+@register
+class Bilinear(Initializer):
+    def _init_weight(self, name, arr):
+        import jax.numpy as jnp
+
+        shape = arr.shape
+        weight = onp.zeros(int(onp.prod(shape)), dtype="float32")
+        f = onp.ceil(shape[3] / 2.0)
+        c = (2 * f - 1 - f % 2) / (2.0 * f)
+        for i in range(int(onp.prod(shape))):
+            x = i % shape[3]
+            y = (i // shape[3]) % shape[2]
+            weight[i] = (1 - abs(x / f - c)) * (1 - abs(y / f - c))
+        arr._set_data(jnp.asarray(weight.reshape(shape), arr._data.dtype))
+
+
+@register
+class LSTMBias(Initializer):
+    """Initialize LSTM biases with forget-gate bias = forget_bias."""
+
+    def __init__(self, forget_bias=1.0):
+        super().__init__(forget_bias=forget_bias)
+        self.forget_bias = forget_bias
+
+    def _init_weight(self, name, arr):
+        import jax.numpy as jnp
+
+        b = jnp.zeros(arr.shape, arr._data.dtype)
+        num_hidden = arr.shape[0] // 4
+        b = b.at[num_hidden:2 * num_hidden].set(self.forget_bias)
+        arr._set_data(b)
+
+
+class InitDesc(str):
+    """Parameter-name descriptor carrying init attrs (reference parity)."""
+
+    def __new__(cls, name, attrs=None, global_init=None):
+        obj = super().__new__(cls, name)
+        obj.attrs = attrs or {}
+        obj.global_init = global_init
+        return obj
+
+
+_NAME_RE = re.compile(r".*")

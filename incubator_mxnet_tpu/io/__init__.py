@@ -1,0 +1,1 @@
+from .io import DataBatch, DataDesc, DataIter, NDArrayIter, ResizeIter  # noqa: F401

@@ -1,0 +1,2 @@
+from .base import KVStoreBase, create, register  # noqa: F401
+from .kvstore import KVStore, KVStoreDevice, KVStoreDist, KVStoreLocal  # noqa: F401

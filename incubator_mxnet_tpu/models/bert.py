@@ -1,0 +1,177 @@
+"""BERT (gluon API) — the reference's flagship NLP model family comes from
+GluonNLP built on MXNet base ops (SURVEY.md §2.4 notes the reference itself
+has no attention kernel; its CPU path fuses self-attention via oneDNN
+subgraphs, `src/operator/subgraph/dnnl/dnnl_transformer_qk_property.h`).
+Here attention is a first-class op lowered through XLA (and pallas flash
+attention in `ops/` for long sequences)."""
+from __future__ import annotations
+
+import math
+
+from .. import numpy as np
+from .. import numpy_extension as npx
+from ..gluon import nn
+from ..gluon.block import HybridBlock
+from ..gluon.parameter import Parameter
+
+__all__ = ["MultiHeadAttention", "TransformerEncoderCell", "BERTEncoder",
+           "BERTModel", "BERTClassifier", "bert_base", "bert_small"]
+
+
+class MultiHeadAttention(HybridBlock):
+    def __init__(self, units, num_heads, dropout=0.0, use_flash=True):
+        super().__init__()
+        assert units % num_heads == 0
+        self._units = units
+        self._num_heads = num_heads
+        self._use_flash = use_flash
+        self.qkv = nn.Dense(3 * units, flatten=False, use_bias=True,
+                            in_units=units)
+        self.proj = nn.Dense(units, flatten=False, use_bias=True,
+                             in_units=units)
+        self.dropout = nn.Dropout(dropout) if dropout else None
+
+    def forward(self, x, mask=None):
+        # x: (N, T, C)
+        N, T, C = x.shape
+        H = self._num_heads
+        d = C // H
+        qkv = self.qkv(x)  # (N, T, 3C)
+        qkv = qkv.reshape(N, T, 3, H, d)
+        q = qkv[:, :, 0].transpose(0, 2, 1, 3).reshape(N * H, T, d)
+        k = qkv[:, :, 1].transpose(0, 2, 1, 3).reshape(N * H, T, d)
+        v = qkv[:, :, 2].transpose(0, 2, 1, 3).reshape(N * H, T, d)
+        scores = npx.batch_dot(q, k, transpose_b=True) / math.sqrt(d)
+        if mask is not None:
+            att = npx.masked_softmax(scores, mask)
+        else:
+            att = npx.softmax(scores, axis=-1)
+        if self.dropout is not None:
+            att = self.dropout(att)
+        out = npx.batch_dot(att, v)  # (N*H, T, d)
+        out = out.reshape(N, H, T, d).transpose(0, 2, 1, 3).reshape(N, T, C)
+        return self.proj(out)
+
+
+class PositionwiseFFN(HybridBlock):
+    def __init__(self, units, hidden_size, dropout=0.0, activation="gelu"):
+        super().__init__()
+        self.ffn1 = nn.Dense(hidden_size, flatten=False, in_units=units)
+        self.ffn2 = nn.Dense(units, flatten=False, in_units=hidden_size)
+        self._activation = activation
+        self.dropout = nn.Dropout(dropout) if dropout else None
+
+    def forward(self, x):
+        h = npx.activation(self.ffn1(x), act_type=self._activation)
+        if self.dropout is not None:
+            h = self.dropout(h)
+        return self.ffn2(h)
+
+
+class TransformerEncoderCell(HybridBlock):
+    """Pre-LN transformer block (BERT uses post-LN; configurable)."""
+
+    def __init__(self, units, hidden_size, num_heads, dropout=0.0,
+                 pre_norm=False):
+        super().__init__()
+        self._pre_norm = pre_norm
+        self.attention = MultiHeadAttention(units, num_heads, dropout)
+        self.ffn = PositionwiseFFN(units, hidden_size, dropout)
+        self.ln1 = nn.LayerNorm(in_channels=units)
+        self.ln2 = nn.LayerNorm(in_channels=units)
+        self.dropout = nn.Dropout(dropout) if dropout else None
+
+    def forward(self, x, mask=None):
+        if self._pre_norm:
+            h = self.attention(self.ln1(x), mask)
+            x = x + (self.dropout(h) if self.dropout else h)
+            h = self.ffn(self.ln2(x))
+            return x + (self.dropout(h) if self.dropout else h)
+        h = self.attention(x, mask)
+        x = self.ln1(x + (self.dropout(h) if self.dropout else h))
+        h = self.ffn(x)
+        return self.ln2(x + (self.dropout(h) if self.dropout else h))
+
+
+class BERTEncoder(HybridBlock):
+    def __init__(self, vocab_size=30522, units=768, hidden_size=3072,
+                 num_layers=12, num_heads=12, max_length=512,
+                 dropout=0.1, type_vocab_size=2):
+        super().__init__()
+        self._units = units
+        self.word_embed = nn.Embedding(vocab_size, units)
+        self.token_type_embed = nn.Embedding(type_vocab_size, units)
+        self.position_embed = Parameter(shape=(max_length, units),
+                                        init="normal")
+        self.ln = nn.LayerNorm(in_channels=units)
+        self.dropout = nn.Dropout(dropout) if dropout else None
+        self.layers = nn.HybridSequential()
+        for _ in range(num_layers):
+            self.layers.add(TransformerEncoderCell(units, hidden_size,
+                                                   num_heads, dropout))
+
+    def forward(self, tokens, token_types=None, valid_length=None):
+        N, T = tokens.shape
+        x = self.word_embed(tokens)
+        if token_types is not None:
+            x = x + self.token_type_embed(token_types)
+        x = x + self.position_embed.data()[:T]
+        x = self.ln(x)
+        if self.dropout is not None:
+            x = self.dropout(x)
+        mask = None
+        if valid_length is not None:
+            steps = npx.arange_like(x, axis=1)
+            m = (steps.reshape(1, -1, 1) <
+                 valid_length.reshape(-1, 1, 1).astype("float32"))
+            m2 = (steps.reshape(1, 1, -1) <
+                  valid_length.reshape(-1, 1, 1).astype("float32"))
+            mask = (m * m2).astype("float32")
+            H = self.layers[0].attention._num_heads
+            mask = np.repeat(mask, H, axis=0)
+        for cell in self.layers:
+            x = cell(x, mask)
+        return x
+
+
+class BERTModel(HybridBlock):
+    """Encoder + MLM and NSP heads (pretraining objective, config 3)."""
+
+    def __init__(self, vocab_size=30522, units=768, hidden_size=3072,
+                 num_layers=12, num_heads=12, max_length=512, dropout=0.1):
+        super().__init__()
+        self.encoder = BERTEncoder(vocab_size, units, hidden_size, num_layers,
+                                   num_heads, max_length, dropout)
+        self.mlm_dense = nn.Dense(units, flatten=False, activation="tanh",
+                                  in_units=units)
+        self.mlm_ln = nn.LayerNorm(in_channels=units)
+        self.mlm_decoder = nn.Dense(vocab_size, flatten=False, in_units=units)
+        self.nsp = nn.Dense(2, in_units=units)
+
+    def forward(self, tokens, token_types=None, valid_length=None):
+        seq = self.encoder(tokens, token_types, valid_length)
+        mlm_scores = self.mlm_decoder(self.mlm_ln(self.mlm_dense(seq)))
+        nsp_scores = self.nsp(seq[:, 0])
+        return mlm_scores, nsp_scores
+
+
+class BERTClassifier(HybridBlock):
+    def __init__(self, encoder, num_classes=2, dropout=0.1):
+        super().__init__()
+        self.encoder = encoder
+        self.dropout = nn.Dropout(dropout)
+        self.classifier = nn.Dense(num_classes)
+
+    def forward(self, tokens, token_types=None, valid_length=None):
+        seq = self.encoder(tokens, token_types, valid_length)
+        pooled = seq[:, 0]
+        return self.classifier(self.dropout(pooled))
+
+
+def bert_base(vocab_size=30522, max_length=512, dropout=0.1):
+    return BERTModel(vocab_size, 768, 3072, 12, 12, max_length, dropout)
+
+
+def bert_small(vocab_size=1000, max_length=128, dropout=0.1):
+    """Tiny config for tests and compile-checks."""
+    return BERTModel(vocab_size, 64, 128, 2, 4, max_length, dropout)

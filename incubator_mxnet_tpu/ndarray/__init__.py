@@ -1,0 +1,90 @@
+"""Legacy `mx.nd` namespace (reference: `python/mxnet/ndarray/`).
+
+The modern `np`/`npx` namespaces are the primary API (as in MXNet 2.0);
+this module re-exports the NDArray type plus legacy-named ops. Unknown
+attributes lazily forward to the numpy namespace so the long tail of
+`mx.nd.*` names resolves without duplication.
+"""
+from __future__ import annotations
+
+from .ndarray import NDArray, apply_op, apply_op_flat, array, from_jax, waitall  # noqa: F401
+
+# legacy CamelCase op names → npx equivalents
+_LEGACY_TO_NPX = {
+    "FullyConnected": "fully_connected",
+    "Convolution": "convolution",
+    "Deconvolution": "deconvolution",
+    "BatchNorm": "batch_norm",
+    "LayerNorm": "layer_norm",
+    "InstanceNorm": "instance_norm",
+    "GroupNorm": "group_norm",
+    "Activation": "activation",
+    "LeakyReLU": "leaky_relu",
+    "Pooling": "pooling",
+    "Dropout": "dropout",
+    "Embedding": "embedding",
+    "SoftmaxOutput": "softmax",
+    "softmax": "softmax",
+    "log_softmax": "log_softmax",
+    "SequenceMask": "sequence_mask",
+    "SequenceLast": "sequence_last",
+    "SequenceReverse": "sequence_reverse",
+    "RNN": "rnn",
+    "one_hot": "one_hot",
+    "pick": "pick",
+    "topk": "topk",
+    "batch_dot": "batch_dot",
+    "gather_nd": "gather_nd",
+    "scatter_nd": "scatter_nd",
+    "L2Normalization": "l2_normalization",
+    "Cast": "cast",
+    "cast": "cast",
+}
+
+
+def __getattr__(name):
+    if name in _LEGACY_TO_NPX:
+        from .. import numpy_extension as npx
+
+        return getattr(npx, _LEGACY_TO_NPX[name])
+    from .. import numpy as _np
+
+    if hasattr(_np, name):
+        return getattr(_np, name)
+    raise AttributeError(f"module 'nd' has no attribute {name!r}")
+
+
+def save(fname, data):
+    """Save NDArrays to the reference's `.params`-style container.
+
+    Reference format: `src/ndarray/ndarray.cc` Save/Load. The TPU build uses
+    a numpy `.npz`-based container with a name-manifest, readable by
+    `nd.load`; `.npy`/`.npz` parity matches `src/serialization/cnpy.cc`.
+    """
+    import numpy as onp
+
+    if isinstance(data, NDArray):
+        data = [data]
+    if isinstance(data, (list, tuple)):
+        payload = {f"arr:{i}": d.asnumpy() for i, d in enumerate(data)}
+    elif isinstance(data, dict):
+        payload = {f"named:{k}": v.asnumpy() for k, v in data.items()}
+    else:
+        raise TypeError("save expects NDArray, list of NDArray, or dict")
+    onp.savez(fname if fname.endswith(".npz") else fname, **payload)
+    import os
+
+    if not fname.endswith(".npz") and os.path.exists(fname + ".npz"):
+        os.replace(fname + ".npz", fname)
+
+
+def load(fname):
+    import numpy as onp
+
+    with onp.load(fname, allow_pickle=False) as z:
+        keys = list(z.keys())
+        if keys and keys[0].startswith("named:"):
+            return {k[len("named:"):]: array(z[k]) for k in keys}
+        if keys and keys[0].startswith("arr:"):
+            return [array(z[k]) for k in sorted(keys, key=lambda s: int(s.split(":")[1]))]
+        return {k: array(z[k]) for k in keys}

@@ -1,0 +1,687 @@
+"""NDArray: imperative tensor over jax.Array with mutation semantics.
+
+Reference: `include/mxnet/ndarray.h:81` / `python/mxnet/ndarray/ndarray.py:249`.
+The reference NDArray owns an engine variable; every op is pushed to an async
+dependency engine and the frontend never blocks until an explicit sync
+(`WaitToRead`, `asnumpy`). The TPU-native design keeps those semantics for
+free: jax dispatch is already async (XLA device streams order operations),
+so `wait_to_read()` maps to `block_until_ready()` and the version counter
+models the reference's versioned engine vars (`include/mxnet/engine.h:124`).
+
+Mutation (`x[:] = v`, `x += y`, optimizer in-place updates) is implemented by
+rebinding the underlying immutable jax buffer and bumping `_version` — the
+copy-on-write discipline that replaces kWriteInplace (`op_attr_types.h:45`).
+"""
+from __future__ import annotations
+
+import numpy as onp
+
+from .. import autograd
+from ..autograd import TapeNode
+from ..base import np_dtype
+from ..device import Device, current_device
+
+__all__ = ["NDArray", "apply_op", "array", "from_jax", "waitall"]
+
+
+def _jnp():
+    import jax.numpy as jnp
+
+    return jnp
+
+
+def _is_tracer(x) -> bool:
+    import jax
+
+    return isinstance(x, jax.core.Tracer)
+
+
+class NDArray:
+    """Imperative, mutable-facade tensor backed by an immutable jax buffer."""
+
+    __slots__ = ("_data", "_device", "_version", "_grad", "_grad_req", "_node",
+                 "_out_idx", "__weakref__")
+
+    # make NDArray win against numpy broadcasting in mixed expressions
+    __array_priority__ = 1000.0
+
+    def __init__(self, data, device: Device | None = None, dtype=None):
+        jnp = _jnp()
+        if isinstance(data, NDArray):
+            data = data._data
+        if dtype is not None:
+            data = jnp.asarray(data, dtype=np_dtype(dtype))
+        elif not hasattr(data, "dtype"):
+            data = jnp.asarray(data)
+        else:
+            data = jnp.asarray(data)
+        if device is not None and not _is_tracer(data):
+            import jax
+
+            data = jax.device_put(data, device.jax_device)
+        self._data = data
+        self._device = device
+        self._version = 0
+        self._grad = None
+        self._grad_req = "write"
+        self._node = None
+        self._out_idx = 0
+
+    # ------------------------------------------------------------------ core
+    @property
+    def shape(self):
+        return tuple(self._data.shape)
+
+    @property
+    def dtype(self):
+        return onp.dtype(self._data.dtype) if self._data.dtype != _jnp().bfloat16 \
+            else _jnp().bfloat16
+
+    @property
+    def size(self):
+        return int(onp.prod(self.shape)) if self.shape else 1
+
+    @property
+    def ndim(self):
+        return self._data.ndim
+
+    @property
+    def itemsize(self):
+        return onp.dtype(self._data.dtype).itemsize if self._data.dtype != _jnp().bfloat16 else 2
+
+    @property
+    def stype(self):
+        return "default"
+
+    @property
+    def device(self):
+        if self._device is not None:
+            return self._device
+        if _is_tracer(self._data):
+            return current_device()
+        try:
+            d = list(self._data.devices())[0]
+            return Device("cpu" if d.platform == "cpu" else "tpu", d.id)
+        except Exception:
+            return current_device()
+
+    ctx = device
+    context = device
+
+    @property
+    def T(self):
+        return self.transpose()
+
+    @property
+    def grad(self):
+        return self._grad
+
+    @property
+    def version(self):
+        return self._version
+
+    def _set_data(self, value):
+        """Rebind the buffer (the mutation primitive). Bumps the version."""
+        self._data = value
+        self._version += 1
+
+    # ------------------------------------------------------------- conversion
+    def asnumpy(self) -> onp.ndarray:
+        """Synchronize and copy to host (reference: ndarray.py asnumpy)."""
+        jnp = _jnp()
+        d = self._data
+        if d.dtype == jnp.bfloat16:
+            return onp.asarray(d.astype(jnp.float32))
+        return onp.asarray(d)
+
+    def item(self):
+        return self.asnumpy().item()
+
+    def asscalar(self):
+        if self.size != 1:
+            raise ValueError("The current array is not a scalar")
+        return self.item()
+
+    def tolist(self):
+        return self.asnumpy().tolist()
+
+    def astype(self, dtype, copy=True):
+        dt = np_dtype(dtype)
+        if not copy and self._data.dtype == dt:
+            return self
+        return apply_op("astype", lambda x: x.astype(dt), (self,))
+
+    def copy(self):
+        return apply_op("copy", lambda x: x + 0 if x.dtype != onp.bool_ else x.copy(),
+                        (self,))
+
+    def copyto(self, other):
+        if isinstance(other, NDArray):
+            other._set_data(_jnp().asarray(self._data, dtype=other._data.dtype))
+            return other
+        if isinstance(other, Device):
+            return self.to_device(other)
+        raise TypeError(f"copyto does not support type {type(other)}")
+
+    def to_device(self, device):
+        import jax
+
+        if _is_tracer(self._data):
+            return self
+        out = NDArray(jax.device_put(self._data, Device(device).jax_device))
+        out._device = Device(device)
+        return out
+
+    as_in_ctx = to_device
+    as_in_context = to_device
+    as_nd_ndarray = lambda self: self
+    as_np_ndarray = lambda self: self
+
+    def wait_to_read(self):
+        if not _is_tracer(self._data):
+            self._data.block_until_ready()
+
+    def wait_to_write(self):
+        self.wait_to_read()
+
+    # ---------------------------------------------------------------- autograd
+    def attach_grad(self, grad_req="write", stype=None):  # noqa: ARG002
+        """Allocate a gradient buffer updated by backward (MXNet parity)."""
+        jnp = _jnp()
+        self._grad = NDArray(jnp.zeros(self.shape, self._data.dtype))
+        self._grad_req = grad_req
+        self._node = None  # becomes a leaf from autograd's perspective
+
+    def drop_grad(self):
+        self._grad = None
+
+    def detach(self):
+        out = NDArray(self._data)
+        out._device = self._device
+        return out
+
+    def backward(self, out_grad=None, retain_graph=False, train_mode=True):
+        autograd.backward([self], [out_grad] if out_grad is not None else None,
+                          retain_graph=retain_graph, train_mode=train_mode)
+
+    # ------------------------------------------------------------- reshaping
+    def reshape(self, *shape, **kwargs):  # noqa: ARG002
+        if len(shape) == 1 and isinstance(shape[0], (tuple, list)):
+            shape = tuple(shape[0])
+        shape = tuple(int(s) for s in shape)
+        return apply_op("reshape", lambda x: x.reshape(shape), (self,))
+
+    def reshape_like(self, other):
+        return self.reshape(other.shape)
+
+    def transpose(self, *axes):
+        if len(axes) == 1 and isinstance(axes[0], (tuple, list)):
+            axes = tuple(axes[0])
+        ax = axes if axes else None
+        return apply_op("transpose", lambda x: _jnp().transpose(x, ax), (self,))
+
+    def flatten(self):
+        return self.reshape(self.shape[0] if self.ndim > 0 else 1, -1)
+
+    def squeeze(self, axis=None):
+        return apply_op("squeeze", lambda x: _jnp().squeeze(x, axis), (self,))
+
+    def expand_dims(self, axis):
+        return apply_op("expand_dims", lambda x: _jnp().expand_dims(x, axis), (self,))
+
+    def broadcast_to(self, shape):
+        return apply_op("broadcast_to", lambda x: _jnp().broadcast_to(x, shape), (self,))
+
+    def broadcast_like(self, other):
+        return self.broadcast_to(other.shape)
+
+    def repeat(self, repeats, axis=None):
+        return apply_op("repeat", lambda x: _jnp().repeat(x, repeats, axis), (self,))
+
+    def tile(self, reps):
+        return apply_op("tile", lambda x: _jnp().tile(x, reps), (self,))
+
+    def swapaxes(self, a1, a2):
+        return apply_op("swapaxes", lambda x: _jnp().swapaxes(x, a1, a2), (self,))
+
+    def split(self, indices_or_sections, axis=0):
+        n = len(_jnp().split(self._data, indices_or_sections, axis))
+        return apply_op("split",
+                        lambda x: tuple(_jnp().split(x, indices_or_sections, axis)),
+                        (self,), n_outputs=n)
+
+    # ------------------------------------------------------------- reductions
+    def _reduce(self, name, fn, axis=None, keepdims=False):
+        return apply_op(name, lambda x: fn(x, axis=axis, keepdims=keepdims), (self,))
+
+    def sum(self, axis=None, keepdims=False, **kw):  # noqa: ARG002
+        return self._reduce("sum", _jnp().sum, axis, keepdims)
+
+    def mean(self, axis=None, keepdims=False, **kw):  # noqa: ARG002
+        return self._reduce("mean", _jnp().mean, axis, keepdims)
+
+    def max(self, axis=None, keepdims=False):
+        return self._reduce("max", _jnp().max, axis, keepdims)
+
+    def min(self, axis=None, keepdims=False):
+        return self._reduce("min", _jnp().min, axis, keepdims)
+
+    def prod(self, axis=None, keepdims=False):
+        return self._reduce("prod", _jnp().prod, axis, keepdims)
+
+    def std(self, axis=None, keepdims=False, ddof=0):
+        return apply_op("std", lambda x: _jnp().std(x, axis=axis, keepdims=keepdims,
+                                                    ddof=ddof), (self,))
+
+    def var(self, axis=None, keepdims=False, ddof=0):
+        return apply_op("var", lambda x: _jnp().var(x, axis=axis, keepdims=keepdims,
+                                                    ddof=ddof), (self,))
+
+    def argmax(self, axis=None, keepdims=False):
+        return apply_op("argmax", lambda x: _jnp().argmax(x, axis=axis,
+                                                          keepdims=keepdims), (self,))
+
+    def argmin(self, axis=None, keepdims=False):
+        return apply_op("argmin", lambda x: _jnp().argmin(x, axis=axis,
+                                                          keepdims=keepdims), (self,))
+
+    def argsort(self, axis=-1):
+        return apply_op("argsort", lambda x: _jnp().argsort(x, axis=axis), (self,))
+
+    def sort(self, axis=-1):
+        return apply_op("sort", lambda x: _jnp().sort(x, axis=axis), (self,))
+
+    def cumsum(self, axis=None, dtype=None):
+        return apply_op("cumsum", lambda x: _jnp().cumsum(x, axis=axis, dtype=dtype),
+                        (self,))
+
+    def clip(self, a_min=None, a_max=None):
+        return apply_op("clip", lambda x: _jnp().clip(x, a_min, a_max), (self,))
+
+    def abs(self):
+        return apply_op("abs", _jnp().abs, (self,))
+
+    def round(self, decimals=0):
+        return apply_op("round", lambda x: _jnp().round(x, decimals), (self,))
+
+    def dot(self, other):
+        return apply_op("dot", _jnp().dot, (self, other))
+
+    def norm(self, ord=None, axis=None, keepdims=False):
+        return apply_op("norm", lambda x: _jnp().linalg.norm(x, ord=ord, axis=axis,
+                                                             keepdims=keepdims), (self,))
+
+    def take(self, indices, axis=None, mode="clip"):
+        return apply_op("take", lambda x, i: _jnp().take(x, i, axis=axis, mode=mode),
+                        (self, indices))
+
+    def zeros_like(self):
+        return NDArray(_jnp().zeros_like(self._data))
+
+    def ones_like(self):
+        return NDArray(_jnp().ones_like(self._data))
+
+    def full_like(self, fill_value):
+        return NDArray(_jnp().full_like(self._data, fill_value))
+
+    def tostype(self, stype):
+        if stype != "default":
+            raise ValueError("only dense ('default') storage is supported on TPU")
+        return self
+
+    # ------------------------------------------------------------- indexing
+    def __getitem__(self, key):
+        key = _unwrap_index(key)
+        return apply_op("getitem", lambda x: x[key], (self,))
+
+    def __setitem__(self, key, value):
+        jnp = _jnp()
+        key = _unwrap_index(key)
+        if isinstance(value, NDArray):
+            if autograd.is_recording() and (value._node is not None or value._grad is not None
+                                            or self._node is not None):
+                src = self._snapshot()
+                out = apply_op("setitem", lambda x, v: x.at[key].set(
+                    v.astype(x.dtype) if hasattr(v, "astype") else v), (src, value))
+                self._adopt(out)
+                return
+            value = value._data
+        newval = self._data.at[key].set(
+            jnp.asarray(value, dtype=self._data.dtype)
+            if not hasattr(value, "dtype") else value.astype(self._data.dtype))
+        self._set_data(newval)
+
+    def _adopt(self, other: "NDArray"):
+        """Take over another array's value+tape linkage (in-place op result)."""
+        self._data = other._data
+        self._node = other._node
+        self._out_idx = other._out_idx
+        self._version += 1
+
+    def _snapshot(self) -> "NDArray":
+        """Pre-mutation view for tape recording: keeps the CURRENT buffer and
+        tape linkage so in-place ops on recorded arrays don't create cycles
+        (the versioned-var discipline of the reference engine)."""
+        snap = NDArray(self._data)
+        snap._node = self._node
+        snap._out_idx = self._out_idx
+        snap._grad = self._grad
+        snap._grad_req = self._grad_req
+        return snap
+
+    # ------------------------------------------------------------- operators
+    def _binop(self, name, fn, other, reverse=False):
+        a, b = (other, self) if reverse else (self, other)
+        return apply_op(name, fn, (a, b))
+
+    def __add__(self, o):
+        return self._binop("add", _jnp().add, o)
+
+    __radd__ = __add__
+
+    def __sub__(self, o):
+        return self._binop("subtract", _jnp().subtract, o)
+
+    def __rsub__(self, o):
+        return self._binop("subtract", _jnp().subtract, o, reverse=True)
+
+    def __mul__(self, o):
+        return self._binop("multiply", _jnp().multiply, o)
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, o):
+        return self._binop("true_divide", _jnp().true_divide, o)
+
+    def __rtruediv__(self, o):
+        return self._binop("true_divide", _jnp().true_divide, o, reverse=True)
+
+    def __floordiv__(self, o):
+        return self._binop("floor_divide", _jnp().floor_divide, o)
+
+    def __rfloordiv__(self, o):
+        return self._binop("floor_divide", _jnp().floor_divide, o, reverse=True)
+
+    def __mod__(self, o):
+        return self._binop("mod", _jnp().mod, o)
+
+    def __rmod__(self, o):
+        return self._binop("mod", _jnp().mod, o, reverse=True)
+
+    def __pow__(self, o):
+        return self._binop("power", _jnp().power, o)
+
+    def __rpow__(self, o):
+        return self._binop("power", _jnp().power, o, reverse=True)
+
+    def __matmul__(self, o):
+        return self._binop("matmul", _jnp().matmul, o)
+
+    def __rmatmul__(self, o):
+        return self._binop("matmul", _jnp().matmul, o, reverse=True)
+
+    def __neg__(self):
+        return apply_op("negative", _jnp().negative, (self,))
+
+    def __abs__(self):
+        return self.abs()
+
+    def _inplace(self, name, fn, other):
+        src = self._snapshot() if autograd.is_recording() and (
+            self._node is not None or self._grad is not None) else self
+        out = src._binop(name, fn, other)
+        self._adopt(out)
+        return self
+
+    def __iadd__(self, o):
+        return self._inplace("add", _jnp().add, o)
+
+    def __isub__(self, o):
+        return self._inplace("subtract", _jnp().subtract, o)
+
+    def __imul__(self, o):
+        return self._inplace("multiply", _jnp().multiply, o)
+
+    def __itruediv__(self, o):
+        return self._inplace("true_divide", _jnp().true_divide, o)
+
+    def __imod__(self, o):
+        return self._inplace("mod", _jnp().mod, o)
+
+    # comparisons (not differentiable; no tape)
+    def _cmp(self, fn, other):
+        b = other._data if isinstance(other, NDArray) else other
+        return NDArray(fn(self._data, b))
+
+    def __eq__(self, o):  # noqa: D105
+        return self._cmp(_jnp().equal, o)
+
+    def __ne__(self, o):
+        return self._cmp(_jnp().not_equal, o)
+
+    def __lt__(self, o):
+        return self._cmp(_jnp().less, o)
+
+    def __le__(self, o):
+        return self._cmp(_jnp().less_equal, o)
+
+    def __gt__(self, o):
+        return self._cmp(_jnp().greater, o)
+
+    def __ge__(self, o):
+        return self._cmp(_jnp().greater_equal, o)
+
+    def __hash__(self):
+        return id(self)
+
+    # ------------------------------------------------------------- protocol
+    def __len__(self):
+        if self.ndim == 0:
+            raise TypeError("len() of unsized object")
+        return self.shape[0]
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+    def __bool__(self):
+        if self.size != 1:
+            raise ValueError("The truth value of an NDArray with multiple elements "
+                             "is ambiguous")
+        return bool(self.item())
+
+    def __float__(self):
+        return float(self.item())
+
+    def __int__(self):
+        return int(self.item())
+
+    def __index__(self):
+        if self.size == 1 and onp.issubdtype(onp.dtype(self._data.dtype), onp.integer):
+            return int(self.item())
+        raise TypeError("only integer scalar arrays can be converted to an index")
+
+    def __array__(self, dtype=None):
+        a = self.asnumpy()
+        return a.astype(dtype) if dtype is not None else a
+
+    def __dlpack__(self, stream=None):  # noqa: ARG002
+        return self._data.__dlpack__()
+
+    def __repr__(self):
+        try:
+            vals = str(self.asnumpy())
+        except Exception as e:  # tracing
+            vals = f"<traced {self.shape} {self._data.dtype}>{e and ''}"
+        return f"{vals}\n<NDArray {self.shape} @{self.device}, dtype={onp.dtype(self._data.dtype).name if self._data.dtype != _jnp().bfloat16 else 'bfloat16'}>"
+
+    def __getstate__(self):
+        return {"data": self.asnumpy(), "device": None}
+
+    def __setstate__(self, state):
+        self._data = _jnp().asarray(state["data"])
+        self._device = None
+        self._version = 0
+        self._grad = None
+        self._grad_req = "write"
+        self._node = None
+        self._out_idx = 0
+
+
+def _unwrap_index(key):
+    if isinstance(key, NDArray):
+        return key._data
+    if isinstance(key, tuple):
+        return tuple(k._data if isinstance(k, NDArray) else k for k in key)
+    return key
+
+
+# ---------------------------------------------------------------------------
+# Op invocation: the single funnel every op goes through (the analogue of
+# Imperative::Invoke → Engine::PushAsync, src/imperative/imperative.cc:105).
+# ---------------------------------------------------------------------------
+
+def apply_op(name, jfn, args, kwargs=None, n_outputs=1, out=None):
+    """Execute `jfn` over unwrapped jax values; wrap outputs; record on tape.
+
+    - args: mixed NDArray / python scalars / numpy / jax values. Only NDArray
+      positions participate in autograd.
+    - kwargs: static (non-differentiable) parameters, closed over.
+    - n_outputs: number of outputs if jfn returns a tuple.
+    """
+    kwargs = kwargs or {}
+    tensor_idx = [i for i, a in enumerate(args) if isinstance(a, NDArray)]
+    parents = [args[i] for i in tensor_idx]
+    tensor_vals = [p._data for p in parents]
+    static_args = [None if isinstance(a, NDArray) else a for a in args]
+
+    def pure_fn(*tvals):
+        call = list(static_args)
+        for j, i in enumerate(tensor_idx):
+            call[i] = tvals[j]
+        return jfn(*call, **kwargs)
+
+    outs = pure_fn(*tensor_vals)
+    tuple_out = isinstance(outs, tuple)
+    out_list = list(outs) if tuple_out else [outs]
+
+    record = autograd.is_recording() and any(
+        p._node is not None or p._grad is not None for p in parents)
+    wrapped = [NDArray(o) if not isinstance(o, NDArray) else o for o in out_list]
+    if record:
+        node = TapeNode(pure_fn, tensor_vals, parents, len(out_list), name)
+        node.out_avals = [_ShapeDtype(o) for o in out_list]
+        node.tuple_out = tuple_out
+        for i, w in enumerate(wrapped):
+            w._node = node
+            w._out_idx = i
+
+    if out is not None:
+        targets = out if isinstance(out, (list, tuple)) else [out]
+        for t, w in zip(targets, wrapped):
+            t._adopt(w)
+        return out
+    if tuple_out:
+        return tuple(wrapped)
+    return wrapped[0]
+
+
+def apply_op_flat(name, jfn, args, kwargs=None, n_outputs=None):
+    """Like apply_op but flattens NDArrays nested one level inside list/tuple
+    positional args (e.g. ``concatenate([a, b], axis=0)``)."""
+    kwargs = kwargs or {}
+    paths = []       # (i,) or (i, j) positions of NDArray leaves
+    parents = []
+    for i, a in enumerate(args):
+        if isinstance(a, NDArray):
+            paths.append((i,))
+            parents.append(a)
+        elif isinstance(a, (list, tuple)):
+            for j, b in enumerate(a):
+                if isinstance(b, NDArray):
+                    paths.append((i, j))
+                    parents.append(b)
+    tensor_vals = [p._data for p in parents]
+
+    def pure_fn(*tvals):
+        call = [list(a) if isinstance(a, (list, tuple)) else a for a in args]
+        for path, v in zip(paths, tvals):
+            if len(path) == 1:
+                call[path[0]] = v
+            else:
+                call[path[0]][path[1]] = v
+        outs = jfn(*call, **kwargs)
+        return tuple(outs) if isinstance(outs, list) else outs
+
+    outs = pure_fn(*tensor_vals)
+    tuple_out = isinstance(outs, tuple)
+    out_list = list(outs) if tuple_out else [outs]
+    wrapped = [NDArray(o) for o in out_list]
+
+    if autograd.is_recording() and any(
+            p._node is not None or p._grad is not None for p in parents):
+        node = TapeNode(pure_fn, tensor_vals, parents, len(out_list), name)
+        node.out_avals = [_ShapeDtype(o) for o in out_list]
+        node.tuple_out = tuple_out
+        for i, w in enumerate(wrapped):
+            w._node = node
+            w._out_idx = i
+    if tuple_out:
+        return tuple(wrapped) if n_outputs is None else list(wrapped)
+    return wrapped[0]
+
+
+class _ShapeDtype:
+    __slots__ = ("shape", "dtype")
+
+    def __init__(self, arr):
+        self.shape = tuple(arr.shape)
+        self.dtype = arr.dtype
+
+
+def _wrap_with_node(value, fn, parents, input_values, n_outputs, out_idx, name):
+    arr = NDArray(value)
+    node = TapeNode(fn, input_values, parents, n_outputs, name)
+    node.out_avals = [_ShapeDtype(value)] * n_outputs
+    arr._node = node
+    arr._out_idx = out_idx
+    return arr
+
+
+def _attach_custom_node(func, inputs, outputs):
+    """Attach a tape node whose vjp calls a user Function.backward."""
+    parents = [a for a in inputs if isinstance(a, NDArray)]
+
+    def vjp_fn(cots):
+        cots = cots if isinstance(cots, tuple) else (cots,)
+        grads = func.backward(*[NDArray(c) for c in cots])
+        if not isinstance(grads, (list, tuple)):
+            grads = [grads]
+        return tuple(g._data if isinstance(g, NDArray) else _jnp().asarray(g)
+                     for g in grads)
+
+    node = TapeNode(None, [p._data for p in parents], parents,
+                    len(outputs), type(func).__name__, vjp_fn=vjp_fn)
+    node.out_avals = [_ShapeDtype(o._data) for o in outputs]
+    for i, o in enumerate(outputs):
+        o._node = node
+        o._out_idx = i
+
+
+def array(source, dtype=None, device=None, ctx=None):
+    return NDArray(source, device=device or ctx, dtype=dtype)
+
+
+def from_jax(value) -> NDArray:
+    return NDArray(value)
+
+
+def waitall():
+    """Block until all async work completes (reference: Engine::WaitForAll)."""
+    import jax
+
+    try:
+        for d in jax.live_arrays():
+            d.block_until_ready()
+    except Exception:
+        (jax.device_put(0.0) + 0).block_until_ready()

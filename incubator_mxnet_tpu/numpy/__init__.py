@@ -1,0 +1,225 @@
+"""NumPy-compatible `np` namespace over jax (reference: `python/mxnet/numpy/`,
+`multiarray.py:278` — mx.np.ndarray with the official NumPy API).
+
+Where the reference code-generates 218 numpy-namespace ops from the C++
+registry (`src/operator/numpy/`), the TPU build maps each name onto the
+equivalent jax.numpy function through the autograd-aware invocation funnel
+(`apply_op_flat`), so every op is differentiable, async-dispatched and
+XLA-fused for free.
+"""
+from __future__ import annotations
+
+import numpy as _onp
+
+from ..base import np_dtype, register_op_meta
+from ..device import Device, current_device
+from ..ndarray.ndarray import NDArray, apply_op_flat, waitall  # noqa: F401
+
+ndarray = NDArray
+
+# dtype aliases for parity with `mx.np.float32` style usage
+float16 = _onp.float16
+float32 = _onp.float32
+float64 = _onp.float64
+int8 = _onp.int8
+int16 = _onp.int16
+int32 = _onp.int32
+int64 = _onp.int64
+uint8 = _onp.uint8
+uint16 = _onp.uint16
+uint32 = _onp.uint32
+uint64 = _onp.uint64
+bool_ = _onp.bool_
+pi = _onp.pi
+e = _onp.e
+euler_gamma = _onp.euler_gamma
+inf = _onp.inf
+nan = _onp.nan
+newaxis = None
+integer = _onp.integer
+floating = _onp.floating
+
+
+def _jnp():
+    import jax.numpy as jnp
+
+    return jnp
+
+
+def _device_of(device=None, ctx=None):
+    d = device or ctx
+    return Device(d) if d is not None and not isinstance(d, Device) else d
+
+
+# ---------------------------------------------------------------------------
+# creation ops
+# ---------------------------------------------------------------------------
+
+def array(obj, dtype=None, device=None, ctx=None, copy=True):  # noqa: ARG001
+    return NDArray(obj, device=_device_of(device, ctx), dtype=dtype)
+
+
+def asarray(obj, dtype=None, device=None):
+    if isinstance(obj, NDArray) and dtype is None and device is None:
+        return obj
+    return array(obj, dtype=dtype, device=device)
+
+
+def _creation(fn_name):
+    def op(*args, dtype=None, device=None, ctx=None, **kwargs):
+        jnp = _jnp()
+        fn = getattr(jnp, fn_name)
+        dt = np_dtype(dtype) if dtype is not None else None
+        out = fn(*args, dtype=dt, **kwargs) if dt is not None else fn(*args, **kwargs)
+        return NDArray(out, device=_device_of(device, ctx))
+
+    op.__name__ = fn_name
+    register_op_meta(fn_name, "np", op)
+    return op
+
+
+zeros = _creation("zeros")
+ones = _creation("ones")
+empty = _creation("empty")
+eye = _creation("eye")
+identity = _creation("identity")
+arange = _creation("arange")
+linspace = _creation("linspace")
+logspace = _creation("logspace")
+tri = _creation("tri")
+
+
+def full(shape, fill_value, dtype=None, device=None, ctx=None):
+    jnp = _jnp()
+    fv = fill_value._data if isinstance(fill_value, NDArray) else fill_value
+    return NDArray(jnp.full(shape, fv, dtype=np_dtype(dtype) if dtype else None),
+                   device=_device_of(device, ctx))
+
+
+def zeros_like(a, dtype=None):
+    return apply_op_flat("zeros_like", lambda x: _jnp().zeros_like(
+        x, dtype=np_dtype(dtype) if dtype else None), (a,))
+
+
+def ones_like(a, dtype=None):
+    return apply_op_flat("ones_like", lambda x: _jnp().ones_like(
+        x, dtype=np_dtype(dtype) if dtype else None), (a,))
+
+
+def full_like(a, fill_value, dtype=None):
+    return apply_op_flat("full_like", lambda x: _jnp().full_like(
+        x, fill_value, dtype=np_dtype(dtype) if dtype else None), (a,))
+
+
+def empty_like(a, dtype=None):
+    return zeros_like(a, dtype)
+
+
+# ---------------------------------------------------------------------------
+# generated ops: one generic autograd-aware wrapper per jax.numpy function
+# ---------------------------------------------------------------------------
+
+def _make(name, jnp_name=None):
+    jnp_name = jnp_name or name
+
+    def op(*args, **kwargs):
+        jnp = _jnp()
+        if "dtype" in kwargs and kwargs["dtype"] is not None:
+            kwargs["dtype"] = np_dtype(kwargs["dtype"])
+        kwargs.pop("out", None)
+        kwargs.pop("where", None)
+        kwargs = {k: (v._data if isinstance(v, NDArray) else v)
+                  for k, v in kwargs.items()}
+        return apply_op_flat(name, getattr(jnp, jnp_name), args, kwargs)
+
+    op.__name__ = name
+    register_op_meta(name, "np", op)
+    return op
+
+
+_ELEMWISE_AND_FRIENDS = [
+    # ufuncs
+    "abs", "absolute", "add", "subtract", "multiply", "divide", "true_divide",
+    "floor_divide", "mod", "remainder", "fmod", "power", "float_power", "sqrt",
+    "cbrt", "square", "exp", "expm1", "exp2", "log", "log2", "log10", "log1p",
+    "sin", "cos", "tan", "arcsin", "arccos", "arctan", "arctan2", "sinh",
+    "cosh", "tanh", "arcsinh", "arccosh", "arctanh", "floor", "ceil", "trunc",
+    "rint", "fix", "around", "round", "sign", "signbit", "reciprocal", "negative",
+    "positive", "maximum", "minimum", "fmax", "fmin", "clip", "hypot", "copysign",
+    "deg2rad", "rad2deg", "degrees", "radians", "ldexp", "frexp", "gcd", "lcm",
+    "logaddexp", "logaddexp2", "sinc", "heaviside", "nan_to_num", "real", "imag",
+    "conj", "conjugate", "angle", "invert", "bitwise_and", "bitwise_or",
+    "bitwise_xor", "bitwise_not", "left_shift", "right_shift", "matmul", "dot",
+    "vdot", "inner", "outer", "tensordot", "kron", "cross", "trace", "diag",
+    "diagonal", "diagflat", "tril", "triu", "vander",
+    # comparisons / logic
+    "equal", "not_equal", "greater", "greater_equal", "less", "less_equal",
+    "logical_and", "logical_or", "logical_not", "logical_xor", "isnan", "isinf",
+    "isfinite", "isposinf", "isneginf", "isclose", "array_equal", "allclose",
+    # reductions
+    "sum", "prod", "mean", "std", "var", "min", "max", "amin", "amax", "ptp",
+    "argmin", "argmax", "nanargmin", "nanargmax", "nansum", "nanprod", "nanmean",
+    "nanstd", "nanvar", "nanmin", "nanmax", "all", "any", "count_nonzero",
+    "cumsum", "cumprod", "nancumsum", "nancumprod", "average", "median",
+    "quantile", "percentile", "nanmedian", "nanquantile", "nanpercentile",
+    # shape manipulation
+    "reshape", "ravel", "transpose", "swapaxes", "moveaxis", "rollaxis",
+    "squeeze", "expand_dims", "broadcast_to", "concatenate", "stack", "vstack",
+    "hstack", "dstack", "column_stack", "row_stack", "tile", "repeat", "flip",
+    "flipud", "fliplr", "roll", "rot90", "atleast_1d", "atleast_2d",
+    "atleast_3d", "append", "resize", "pad",
+    # indexing / search / sort
+    "where", "take", "take_along_axis", "choose", "compress", "extract",
+    "searchsorted", "argsort", "sort", "lexsort", "partition", "argpartition",
+    "nonzero", "argwhere", "flatnonzero", "unravel_index", "ravel_multi_index",
+    "diag_indices", "tril_indices", "triu_indices", "indices",
+    # sets / statistics
+    "unique", "intersect1d", "union1d", "setdiff1d", "setxor1d", "in1d", "isin",
+    "bincount", "histogram", "histogram2d", "digitize", "corrcoef", "cov",
+    # misc
+    "einsum", "diff", "ediff1d", "gradient", "interp", "convolve", "correlate",
+    "polyval", "polyfit", "meshgrid", "broadcast_arrays", "array_split", "split",
+    "hsplit", "vsplit", "dsplit", "delete", "insert", "trim_zeros", "flat",
+    "may_share_memory", "shares_memory", "result_type", "promote_types",
+    "can_cast", "iscomplexobj", "isrealobj", "isscalar", "ndim", "shape", "size",
+]
+
+_g = globals()
+for _name in _ELEMWISE_AND_FRIENDS:
+    import jax.numpy as _jnp_mod
+
+    if hasattr(_jnp_mod, _name):
+        if _name not in _g:  # don't clobber hand-written versions
+            _g[_name] = _make(_name)
+
+del _g, _name, _jnp_mod
+
+
+def astype(a, dtype):
+    return a.astype(dtype)
+
+
+def copy(a):
+    return a.copy()
+
+
+def expand_dims(a, axis):  # hand version: axis required positional
+    return apply_op_flat("expand_dims", lambda x: _jnp().expand_dims(x, axis), (a,))
+
+
+def may_share_memory(a, b):  # noqa: ARG001 - jax buffers never alias views
+    return False
+
+
+def shares_memory(a, b):  # noqa: ARG001
+    return False
+
+
+def bfloat16(x=None):
+    import jax.numpy as jnp
+
+    return jnp.bfloat16 if x is None else NDArray(jnp.asarray(x, jnp.bfloat16))
+
+
+from . import linalg  # noqa: E402,F401
+from . import random  # noqa: E402,F401

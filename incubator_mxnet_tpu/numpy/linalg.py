@@ -1,0 +1,31 @@
+"""`mx.np.linalg` over jax.numpy.linalg (reference: `src/operator/numpy/linalg/`,
+`python/mxnet/numpy/linalg.py`). LAPACK/cuSolver kernels are replaced by
+XLA's native decompositions, which map QR/SVD/Cholesky onto the MXU."""
+from __future__ import annotations
+
+from ..ndarray.ndarray import apply_op_flat
+
+_NAMES = [
+    "norm", "svd", "cholesky", "qr", "inv", "pinv", "det", "slogdet", "solve",
+    "lstsq", "eig", "eigh", "eigvals", "eigvalsh", "matrix_rank", "matrix_power",
+    "multi_dot", "tensorinv", "tensorsolve", "cond",
+]
+
+
+def _make(name):
+    def op(*args, **kwargs):
+        import jax.numpy as jnp
+
+        from ..ndarray.ndarray import NDArray
+
+        kwargs = {k: (v._data if isinstance(v, NDArray) else v)
+                  for k, v in kwargs.items()}
+        return apply_op_flat(f"linalg.{name}", getattr(jnp.linalg, name), args, kwargs)
+
+    op.__name__ = name
+    return op
+
+
+for _n in _NAMES:
+    globals()[_n] = _make(_n)
+del _n

@@ -1,0 +1,205 @@
+"""`mx.np.random` over jax.random (reference: `python/mxnet/numpy/random.py`,
+kernels `src/operator/numpy/random/`).
+
+Every draw consumes a fresh key from the global RNG state
+(`incubator_mxnet_tpu.random`), which under jit-trace folds a counter into a
+traced base key — see that module for how hybridized randomness stays fresh.
+"""
+from __future__ import annotations
+
+import numpy as _onp
+
+from ..base import np_dtype
+from ..ndarray.ndarray import NDArray
+from ..random import next_key, seed  # noqa: F401  (re-export seed)
+
+__all__ = [
+    "seed", "uniform", "normal", "randn", "rand", "randint", "choice",
+    "shuffle", "permutation", "beta", "gamma", "exponential", "chisquare",
+    "multinomial", "laplace", "logistic", "lognormal", "pareto", "power",
+    "rayleigh", "weibull", "gumbel", "multivariate_normal", "binomial",
+    "poisson", "geometric", "negative_binomial", "bernoulli", "f", "standard_normal",
+]
+
+
+def _jr():
+    import jax.random as jr
+
+    return jr
+
+
+def _shape(size):
+    if size is None:
+        return ()
+    if isinstance(size, int):
+        return (size,)
+    return tuple(size)
+
+
+def _val(x):
+    return x._data if isinstance(x, NDArray) else x
+
+
+def uniform(low=0.0, high=1.0, size=None, dtype=None, device=None, ctx=None):  # noqa: ARG001
+    import jax.numpy as jnp
+
+    dt = np_dtype(dtype) if dtype else jnp.float32
+    u = _jr().uniform(next_key(), _shape(size) or jnp.broadcast_shapes(
+        jnp.shape(_val(low)), jnp.shape(_val(high))), dtype=dt)
+    return NDArray(u * (_val(high) - _val(low)) + _val(low))
+
+
+def normal(loc=0.0, scale=1.0, size=None, dtype=None, device=None, ctx=None):  # noqa: ARG001
+    import jax.numpy as jnp
+
+    dt = np_dtype(dtype) if dtype else jnp.float32
+    n = _jr().normal(next_key(), _shape(size) or jnp.broadcast_shapes(
+        jnp.shape(_val(loc)), jnp.shape(_val(scale))), dtype=dt)
+    return NDArray(n * _val(scale) + _val(loc))
+
+
+def standard_normal(size=None, dtype=None):
+    return normal(0.0, 1.0, size=size, dtype=dtype)
+
+
+def randn(*shape):
+    return normal(size=shape)
+
+
+def rand(*shape):
+    return uniform(size=shape)
+
+
+def randint(low, high=None, size=None, dtype=None):
+    import jax.numpy as jnp
+
+    if high is None:
+        low, high = 0, low
+    dt = np_dtype(dtype) if dtype else jnp.int64
+    if dt == _onp.dtype("int64"):
+        dt = jnp.int32  # x64 disabled
+    return NDArray(_jr().randint(next_key(), _shape(size), int(low), int(high), dtype=dt))
+
+
+def choice(a, size=None, replace=True, p=None):
+    import jax.numpy as jnp
+
+    a_val = _val(a)
+    if isinstance(a_val, int):
+        a_val = jnp.arange(a_val)
+    p_val = _val(p) if p is not None else None
+    return NDArray(_jr().choice(next_key(), a_val, _shape(size), replace=replace, p=p_val))
+
+
+def shuffle(x):
+    """In-place row shuffle (matches mx.np.random.shuffle semantics)."""
+    perm = _jr().permutation(next_key(), x.shape[0])
+    x._set_data(x._data[perm])
+
+
+def permutation(x):
+    if isinstance(x, int):
+        return NDArray(_jr().permutation(next_key(), x))
+    return NDArray(_jr().permutation(next_key(), _val(x)))
+
+
+def beta(a, b, size=None):
+    return NDArray(_jr().beta(next_key(), _val(a), _val(b), _shape(size) or None))
+
+
+def gamma(shape, scale=1.0, size=None):
+    g = _jr().gamma(next_key(), _val(shape), _shape(size) or None)
+    return NDArray(g * _val(scale))
+
+
+def exponential(scale=1.0, size=None):
+    return NDArray(_jr().exponential(next_key(), _shape(size)) * _val(scale))
+
+
+def chisquare(df, size=None):
+    return NDArray(_jr().chisquare(next_key(), _val(df), shape=_shape(size) or None))
+
+
+def multinomial(n, pvals, size=None):
+    import jax.numpy as jnp
+
+    pv = jnp.asarray(_val(pvals))
+    shape = _shape(size) + pv.shape if size is not None else pv.shape
+    draws = _jr().categorical(next_key(), jnp.log(pv), shape=_shape(size) + (n,) if size
+                              is not None else (n,))
+    counts = (draws[..., None] == jnp.arange(pv.shape[-1])).sum(axis=-2)
+    del shape
+    return NDArray(counts)
+
+
+def laplace(loc=0.0, scale=1.0, size=None):
+    return NDArray(_jr().laplace(next_key(), _shape(size)) * _val(scale) + _val(loc))
+
+
+def logistic(loc=0.0, scale=1.0, size=None):
+    return NDArray(_jr().logistic(next_key(), _shape(size)) * _val(scale) + _val(loc))
+
+
+def lognormal(mean=0.0, sigma=1.0, size=None):
+    import jax.numpy as jnp
+
+    return NDArray(jnp.exp(_jr().normal(next_key(), _shape(size)) * _val(sigma)
+                           + _val(mean)))
+
+
+def pareto(a, size=None):
+    return NDArray(_jr().pareto(next_key(), _val(a), shape=_shape(size) or None))
+
+
+def power(a, size=None):
+    import jax.numpy as jnp
+
+    u = _jr().uniform(next_key(), _shape(size))
+    return NDArray(jnp.power(u, 1.0 / _val(a)))
+
+
+def rayleigh(scale=1.0, size=None):
+    return NDArray(_jr().rayleigh(next_key(), _shape(size)) * _val(scale))
+
+
+def weibull(a, size=None):
+    return NDArray(_jr().weibull_min(next_key(), 1.0, _val(a), _shape(size)))
+
+
+def gumbel(loc=0.0, scale=1.0, size=None):
+    return NDArray(_jr().gumbel(next_key(), _shape(size)) * _val(scale) + _val(loc))
+
+
+def multivariate_normal(mean, cov, size=None):
+    return NDArray(_jr().multivariate_normal(next_key(), _val(mean), _val(cov),
+                                             _shape(size) or None))
+
+
+def binomial(n, p, size=None):
+    return NDArray(_jr().binomial(next_key(), _val(n), _val(p), shape=_shape(size) or None))
+
+
+def poisson(lam=1.0, size=None):
+    return NDArray(_jr().poisson(next_key(), _val(lam), shape=_shape(size) or None))
+
+
+def geometric(p, size=None):
+    return NDArray(_jr().geometric(next_key(), _val(p), shape=_shape(size) or None))
+
+
+def negative_binomial(n, p, size=None):
+    g = _jr().gamma(next_key(), _val(n), _shape(size) or None)
+    import jax.numpy as jnp
+
+    rate = g * (1.0 - _val(p)) / _val(p)
+    return NDArray(_jr().poisson(next_key(), rate).astype(jnp.int32))
+
+
+def bernoulli(p, size=None):
+    return NDArray(_jr().bernoulli(next_key(), _val(p), shape=_shape(size) or None))
+
+
+def f(dfnum, dfden, size=None):
+    n1 = chisquare(dfnum, size)._data / _val(dfnum)
+    n2 = chisquare(dfden, size)._data / _val(dfden)
+    return NDArray(n1 / n2)

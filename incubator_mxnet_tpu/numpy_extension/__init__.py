@@ -1,0 +1,1034 @@
+"""`mx.npx` — numpy-extension namespace: the NN operator corpus.
+
+Reference: `python/mxnet/numpy_extension/` + kernels under `src/operator/nn/`
+(Convolution, FullyConnected, BatchNorm, Pooling, softmax family, Dropout —
+see SURVEY.md §2.3). TPU-native design notes:
+
+- every op lowers to jax/lax primitives so XLA tiles matmuls/convs onto the
+  MXU and fuses the elementwise epilogues (the role oneDNN/cuDNN fusion plays
+  in the reference, `src/operator/subgraph/dnnl/`);
+- ops that mutate auxiliary state (BatchNorm running stats — FMutateInputs in
+  the reference) funnel through `utils.trace.register_aux_update` so they
+  functionalize correctly under jit;
+- dropout/random ops draw from the global RNG (`random.next_key`), which
+  remains fresh under jit tracing (traced key + fold-in counter).
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as onp
+
+from .. import autograd
+from ..base import np_dtype
+from ..ndarray.ndarray import NDArray, apply_op, apply_op_flat
+from ..random import next_key
+from ..utils.trace import register_aux_update
+
+__all__ = [
+    "activation", "relu", "sigmoid", "softmax", "log_softmax", "masked_softmax",
+    "masked_log_softmax", "leaky_relu", "fully_connected", "convolution",
+    "deconvolution", "pooling", "batch_norm", "layer_norm", "group_norm",
+    "instance_norm", "l2_normalization", "dropout", "embedding", "one_hot",
+    "pick", "topk", "batch_dot", "gather_nd", "scatter_nd", "sequence_mask",
+    "sequence_last", "sequence_reverse", "rnn", "erf", "erfinv", "gamma",
+    "gammaln", "digamma", "cast", "reshape", "arange_like", "shape_array",
+    "stop_gradient", "foreach", "while_loop", "cond", "set_np", "reset_np",
+    "is_np_array", "is_np_shape", "waitall", "load", "save", "seed",
+    "gelu", "smooth_l1", "clip_global_norm",
+]
+
+
+def _jnp():
+    import jax.numpy as jnp
+
+    return jnp
+
+
+def _lax():
+    import jax.lax as lax
+
+    return lax
+
+
+def _tuple(x, n):
+    if x is None:
+        return (1,) * n
+    if isinstance(x, int):
+        return (x,) * n
+    return tuple(x)
+
+
+# ---------------------------------------------------------------------------
+# activations / softmax family
+# ---------------------------------------------------------------------------
+
+def relu(data):
+    return apply_op("relu", lambda x: _jnp().maximum(x, 0), (data,))
+
+
+def sigmoid(data):
+    import jax
+
+    return apply_op("sigmoid", jax.nn.sigmoid, (data,))
+
+
+def gelu(data, approximate=True):
+    import jax
+
+    return apply_op("gelu", lambda x: jax.nn.gelu(x, approximate=approximate), (data,))
+
+
+def activation(data, act_type="relu", **kwargs):  # noqa: ARG001
+    import jax
+
+    fns = {
+        "relu": lambda x: _jnp().maximum(x, 0),
+        "sigmoid": jax.nn.sigmoid,
+        "tanh": _jnp().tanh,
+        "softrelu": jax.nn.softplus,
+        "softsign": lambda x: x / (1 + _jnp().abs(x)),
+        "log_sigmoid": jax.nn.log_sigmoid,
+        "mish": lambda x: x * _jnp().tanh(jax.nn.softplus(x)),
+        "gelu": jax.nn.gelu,
+        "silu": jax.nn.silu,
+    }
+    if act_type not in fns:
+        raise ValueError(f"unknown activation {act_type!r}")
+    return apply_op(f"activation.{act_type}", fns[act_type], (data,))
+
+
+def leaky_relu(data, gamma=None, act_type="leaky", slope=0.25,
+               lower_bound=0.125, upper_bound=0.334, **kwargs):  # noqa: ARG001
+    import jax
+
+    jnp = _jnp()
+    if act_type == "leaky":
+        return apply_op("leaky_relu", lambda x: jnp.where(x >= 0, x, slope * x), (data,))
+    if act_type == "elu":
+        return apply_op("elu", lambda x: jax.nn.elu(x, alpha=slope), (data,))
+    if act_type == "selu":
+        return apply_op("selu", jax.nn.selu, (data,))
+    if act_type == "gelu":
+        return apply_op("gelu", lambda x: jax.nn.gelu(x, approximate=False), (data,))
+    if act_type == "prelu":
+        def f(x, g):
+            g2 = g.reshape((1, -1) + (1,) * (x.ndim - 2)) if g.ndim == 1 and x.ndim > 2 else g
+            return jnp.where(x >= 0, x, g2 * x)
+
+        return apply_op("prelu", f, (data, gamma))
+    if act_type == "rrelu":
+        if autograd.is_training():
+            import jax.random as jr
+
+            def f(x):
+                u = jr.uniform(next_key(), x.shape, minval=lower_bound,
+                               maxval=upper_bound)
+                return jnp.where(x >= 0, x, u * x)
+
+            return apply_op("rrelu", f, (data,))
+        mid = (lower_bound + upper_bound) / 2.0
+        return apply_op("rrelu", lambda x: jnp.where(x >= 0, x, mid * x), (data,))
+    raise ValueError(f"unknown leaky_relu act_type {act_type!r}")
+
+
+def softmax(data, axis=-1, length=None, temperature=None, use_length=False,
+            dtype=None, **kwargs):  # noqa: ARG001
+    import jax
+
+    jnp = _jnp()
+
+    def f(x, ln):
+        if temperature is not None and temperature != 1.0:
+            x = x / temperature
+        if ln is not None:
+            idx = jnp.arange(x.shape[axis])
+            shape = [1] * x.ndim
+            shape[axis] = -1
+            mask = idx.reshape(shape) < jnp.expand_dims(ln, axis=axis)
+            x = jnp.where(mask, x, -jnp.inf)
+            out = jax.nn.softmax(x, axis=axis)
+            return jnp.where(mask, out, 0.0)
+        out = jax.nn.softmax(x, axis=axis)
+        return out.astype(np_dtype(dtype)) if dtype else out
+
+    ln = length if (use_length or length is not None) else None
+    return apply_op("softmax", f, (data, ln) if ln is not None else (data, None))
+
+
+def log_softmax(data, axis=-1, temperature=None, dtype=None, **kwargs):  # noqa: ARG001
+    import jax
+
+    def f(x):
+        if temperature is not None and temperature != 1.0:
+            x = x / temperature
+        out = jax.nn.log_softmax(x, axis=axis)
+        return out.astype(np_dtype(dtype)) if dtype else out
+
+    return apply_op("log_softmax", f, (data,))
+
+
+def masked_softmax(data, mask=None, axis=-1, temperature=1.0, **kwargs):  # noqa: ARG001
+    import jax
+
+    jnp = _jnp()
+
+    def f(x, m):
+        if temperature != 1.0:
+            x = x / temperature
+        if m is not None:
+            x = jnp.where(m.astype(bool), x, -jnp.inf)
+        out = jax.nn.softmax(x, axis=axis)
+        if m is not None:
+            out = jnp.where(m.astype(bool), out, 0.0)
+        return out
+
+    return apply_op("masked_softmax", f, (data, mask))
+
+
+def masked_log_softmax(data, mask=None, axis=-1, temperature=1.0):
+    import jax
+
+    jnp = _jnp()
+
+    def f(x, m):
+        if temperature != 1.0:
+            x = x / temperature
+        if m is not None:
+            x = jnp.where(m.astype(bool), x, -jnp.inf)
+        return jax.nn.log_softmax(x, axis=axis)
+
+    return apply_op("masked_log_softmax", f, (data, mask))
+
+
+# ---------------------------------------------------------------------------
+# dense / conv / pooling  (the MXU path)
+# ---------------------------------------------------------------------------
+
+def fully_connected(x, weight, bias=None, num_hidden=None, no_bias=False,
+                    flatten=True, **kwargs):  # noqa: ARG001
+    jnp = _jnp()
+
+    def f(x, w, b):
+        from ..amp import amp_active, cast_for_matmul
+
+        if flatten and x.ndim > 2:
+            x = x.reshape(x.shape[0], -1)
+        if amp_active():
+            x, w = cast_for_matmul(x, w)
+        y = jnp.matmul(x, w.T) if not flatten or x.ndim <= 2 else x @ w.T
+        if b is not None:
+            y = y + b.astype(y.dtype)
+        return y
+
+    if no_bias or bias is None:
+        return apply_op("fully_connected", lambda x, w: f(x, w, None), (x, weight))
+    return apply_op("fully_connected", f, (x, weight, bias))
+
+
+def _conv_dn(ndim, layout):
+    if layout is None:
+        layout = {1: "NCW", 2: "NCHW", 3: "NCDHW"}[ndim]
+    kernel_layout = {"NCW": "OIW", "NCHW": "OIHW", "NCDHW": "OIDHW",
+                     "NWC": "WIO", "NHWC": "HWIO", "NDHWC": "DHWIO"}[layout]
+    return layout, kernel_layout
+
+
+def convolution(data, weight, bias=None, kernel=None, stride=None, dilate=None,
+                pad=None, num_filter=None, num_group=1, no_bias=False,
+                layout=None, **kwargs):  # noqa: ARG001
+    lax = _lax()
+    ndim = len(kernel) if kernel is not None else data.ndim - 2
+    stride = _tuple(stride, ndim)
+    dilate = _tuple(dilate, ndim)
+    pad = _tuple(pad, ndim) if pad is not None else (0,) * ndim
+    lhs_l, rhs_l = _conv_dn(ndim, layout)
+
+    def f(x, w, b):
+        from ..amp import amp_active, cast_for_matmul
+
+        if amp_active():
+            x, w = cast_for_matmul(x, w)
+        y = lax.conv_general_dilated(
+            x, w, window_strides=stride,
+            padding=[(p, p) for p in pad],
+            rhs_dilation=dilate,
+            dimension_numbers=(lhs_l, rhs_l, lhs_l),
+            feature_group_count=num_group,
+            preferred_element_type=None,
+        )
+        if b is not None:
+            c_axis = lhs_l.index("C")
+            shape = [1] * y.ndim
+            shape[c_axis] = -1
+            y = y + b.reshape(shape).astype(y.dtype)
+        return y
+
+    if no_bias or bias is None:
+        return apply_op("convolution", lambda x, w: f(x, w, None), (data, weight))
+    return apply_op("convolution", f, (data, weight, bias))
+
+
+def deconvolution(data, weight, bias=None, kernel=None, stride=None, dilate=None,
+                  pad=None, adj=None, num_filter=None, num_group=1, no_bias=False,
+                  layout=None, target_shape=None, **kwargs):  # noqa: ARG001
+    lax = _lax()
+    ndim = len(kernel) if kernel is not None else data.ndim - 2
+    stride = _tuple(stride, ndim)
+    dilate = _tuple(dilate, ndim)
+    pad = _tuple(pad, ndim) if pad is not None else (0,) * ndim
+    lhs_l, rhs_l = _conv_dn(ndim, layout)
+
+    def f(x, w, b):
+        # transposed conv: weight stored as (in, out/g, *k) in the reference
+        y = lax.conv_transpose(
+            x, w, strides=stride,
+            padding=[(d * (k - 1) - p, d * (k - 1) - p)
+                     for k, p, d in zip(kernel, pad, dilate)],
+            rhs_dilation=dilate,
+            dimension_numbers=(lhs_l, rhs_l.replace("O", "X").replace("I", "O").replace("X", "I"), lhs_l),
+            transpose_kernel=True,
+        )
+        if b is not None:
+            c_axis = lhs_l.index("C")
+            shape = [1] * y.ndim
+            shape[c_axis] = -1
+            y = y + b.reshape(shape)
+        return y
+
+    if no_bias or bias is None:
+        return apply_op("deconvolution", lambda x, w: f(x, w, None), (data, weight))
+    return apply_op("deconvolution", f, (data, weight, bias))
+
+
+def pooling(data, kernel=None, stride=None, pad=None, pool_type="max",
+            global_pool=False, layout=None, count_include_pad=True,
+            pooling_convention="valid", **kwargs):  # noqa: ARG001
+    jnp = _jnp()
+    lax = _lax()
+    ndim = data.ndim - 2
+    lhs_l, _ = _conv_dn(ndim, layout)
+    spatial_axes = tuple(i for i, c in enumerate(lhs_l) if c not in ("N", "C"))
+
+    if global_pool:
+        red = {"max": jnp.max, "avg": jnp.mean, "sum": jnp.sum,
+               "lp": lambda x, axis, keepdims: jnp.power(
+                   jnp.sum(jnp.power(jnp.abs(x), 2), axis=axis, keepdims=keepdims), 0.5)}
+        fn = red[pool_type]
+        return apply_op("global_pool",
+                        lambda x: fn(x, axis=spatial_axes, keepdims=True), (data,))
+
+    kernel = _tuple(kernel, ndim)
+    stride = _tuple(stride, ndim)
+    pad = _tuple(pad, ndim) if pad is not None else (0,) * ndim
+    window = [1] * data.ndim
+    strides = [1] * data.ndim
+    padding = [(0, 0)] * data.ndim
+    for ax, k, s, p in zip(spatial_axes, kernel, stride, pad):
+        window[ax] = k
+        strides[ax] = s
+        padding[ax] = (p, p)
+
+    if pool_type == "max":
+        def f(x):
+            return lax.reduce_window(x, -jnp.inf, lax.max, tuple(window),
+                                     tuple(strides), padding)
+    elif pool_type in ("avg", "sum"):
+        def f(x):
+            s = lax.reduce_window(x, 0.0, lax.add, tuple(window), tuple(strides),
+                                  padding)
+            if pool_type == "sum":
+                return s
+            if count_include_pad:
+                return s / float(onp.prod(kernel))
+            ones = jnp.ones(x.shape, x.dtype)
+            cnt = lax.reduce_window(ones, 0.0, lax.add, tuple(window),
+                                    tuple(strides), padding)
+            return s / cnt
+    else:
+        raise ValueError(f"unsupported pool_type {pool_type!r}")
+    return apply_op(f"pooling.{pool_type}", f, (data,))
+
+
+# ---------------------------------------------------------------------------
+# normalization
+# ---------------------------------------------------------------------------
+
+def batch_norm(x, gamma, beta, running_mean, running_var, eps=1e-5,
+               momentum=0.9, fix_gamma=True, use_global_stats=False,
+               output_mean_var=False, axis=1, **kwargs):  # noqa: ARG001
+    jnp = _jnp()
+    training = autograd.is_training() and not use_global_stats
+    reduce_axes = tuple(i for i in range(x.ndim) if i != axis)
+    shape = [1] * x.ndim
+    shape[axis] = -1
+
+    if training:
+        def f(xv, g, b, rm, rv):
+            mean = jnp.mean(xv, axis=reduce_axes)
+            var = jnp.var(xv, axis=reduce_axes)
+            gg = jnp.ones_like(g) if fix_gamma else g
+            inv = gg * (1.0 / jnp.sqrt(var + eps))
+            out = (xv - mean.reshape(shape)) * inv.reshape(shape) + b.reshape(shape)
+            return out, mean, var
+
+        out, bmean, bvar = apply_op("batch_norm", f,
+                                    (x, gamma, beta, running_mean, running_var),
+                                    n_outputs=3)
+        # running-stat update (FMutateInputs semantics), functionalized under jit
+        m = momentum
+        register_aux_update(running_mean,
+                            running_mean._data * m + bmean._data * (1 - m))
+        register_aux_update(running_var,
+                            running_var._data * m + bvar._data * (1 - m))
+        if output_mean_var:
+            return out, bmean, bvar
+        return out
+
+    def f(xv, g, b, rm, rv):
+        gg = jnp.ones_like(g) if fix_gamma else g
+        inv = gg * (1.0 / jnp.sqrt(rv + eps))
+        return (xv - rm.reshape(shape)) * inv.reshape(shape) + b.reshape(shape)
+
+    out = apply_op("batch_norm", f, (x, gamma, beta, running_mean, running_var))
+    if output_mean_var:
+        return out, running_mean, running_var
+    return out
+
+
+def layer_norm(data, gamma=None, beta=None, axis=-1, eps=1e-5, **kwargs):  # noqa: ARG001
+    jnp = _jnp()
+
+    def f(x, g, b):
+        mean = jnp.mean(x, axis=axis, keepdims=True)
+        var = jnp.var(x, axis=axis, keepdims=True)
+        out = (x - mean) / jnp.sqrt(var + eps)
+        if g is not None:
+            out = out * jnp.expand_dims(g, tuple(i for i in range(x.ndim)
+                                                 if i != (axis % x.ndim))) \
+                if g.ndim == 1 and x.ndim > 1 else out * g
+        if b is not None:
+            out = out + (jnp.expand_dims(b, tuple(i for i in range(x.ndim)
+                                                  if i != (axis % x.ndim)))
+                         if b.ndim == 1 and x.ndim > 1 else b)
+        return out
+
+    return apply_op("layer_norm", f, (data, gamma, beta))
+
+
+def group_norm(data, gamma=None, beta=None, num_groups=1, eps=1e-5, **kwargs):  # noqa: ARG001
+    jnp = _jnp()
+
+    def f(x, g, b):
+        n, c = x.shape[0], x.shape[1]
+        rest = x.shape[2:]
+        xg = x.reshape((n, num_groups, c // num_groups) + rest)
+        axes = tuple(range(2, xg.ndim))
+        mean = jnp.mean(xg, axis=axes, keepdims=True)
+        var = jnp.var(xg, axis=axes, keepdims=True)
+        out = ((xg - mean) / jnp.sqrt(var + eps)).reshape(x.shape)
+        shape = [1, c] + [1] * len(rest)
+        if g is not None:
+            out = out * g.reshape(shape)
+        if b is not None:
+            out = out + b.reshape(shape)
+        return out
+
+    return apply_op("group_norm", f, (data, gamma, beta))
+
+
+def instance_norm(data, gamma=None, beta=None, eps=1e-5, **kwargs):  # noqa: ARG001
+    jnp = _jnp()
+
+    def f(x, g, b):
+        axes = tuple(range(2, x.ndim))
+        mean = jnp.mean(x, axis=axes, keepdims=True)
+        var = jnp.var(x, axis=axes, keepdims=True)
+        out = (x - mean) / jnp.sqrt(var + eps)
+        shape = [1, x.shape[1]] + [1] * (x.ndim - 2)
+        if g is not None:
+            out = out * g.reshape(shape)
+        if b is not None:
+            out = out + b.reshape(shape)
+        return out
+
+    return apply_op("instance_norm", f, (data, gamma, beta))
+
+
+def l2_normalization(data, eps=1e-10, mode="instance"):
+    jnp = _jnp()
+
+    def f(x):
+        if mode == "instance":
+            axes = tuple(range(1, x.ndim))
+        elif mode == "channel":
+            axes = (1,)
+        elif mode == "spatial":
+            axes = tuple(range(2, x.ndim))
+        else:
+            raise ValueError(mode)
+        norm = jnp.sqrt(jnp.sum(x * x, axis=axes, keepdims=True) + eps)
+        return x / norm
+
+    return apply_op("l2_normalization", f, (data,))
+
+
+# ---------------------------------------------------------------------------
+# dropout / embedding / indexing helpers
+# ---------------------------------------------------------------------------
+
+def dropout(data, p=0.5, axes=(), mode="training", **kwargs):  # noqa: ARG001
+    jnp = _jnp()
+    apply = (mode == "always") or autograd.is_training()
+    if not apply or p == 0:
+        return data if isinstance(data, NDArray) else NDArray(data)
+    import jax.random as jr
+
+    key = next_key()
+
+    def f(x):
+        shape = list(x.shape)
+        if axes:
+            for ax in axes:
+                shape[ax] = 1
+        keep = jr.bernoulli(key, 1.0 - p, tuple(shape))
+        return jnp.where(keep, x / (1.0 - p), 0.0)
+
+    return apply_op("dropout", f, (data,))
+
+
+def embedding(data, weight, input_dim=None, output_dim=None, dtype=None,
+              sparse_grad=False, **kwargs):  # noqa: ARG001
+    jnp = _jnp()
+
+    def f(idx, w):
+        out = jnp.take(w, idx.astype(jnp.int32), axis=0)
+        return out.astype(np_dtype(dtype)) if dtype else out
+
+    return apply_op("embedding", f, (data, weight))
+
+
+def one_hot(data, depth, on_value=1.0, off_value=0.0, dtype="float32"):
+    import jax
+
+    def f(idx):
+        oh = jax.nn.one_hot(idx.astype("int32"), depth, dtype=np_dtype(dtype))
+        return oh * (on_value - off_value) + off_value
+
+    return apply_op("one_hot", f, (data,))
+
+
+def pick(data, index, axis=-1, mode="clip", keepdims=False):
+    jnp = _jnp()
+
+    def f(x, idx):
+        idx = idx.astype(jnp.int32)
+        if mode == "clip":
+            idx = jnp.clip(idx, 0, x.shape[axis] - 1)
+        else:
+            idx = idx % x.shape[axis]
+        out = jnp.take_along_axis(x, jnp.expand_dims(idx, axis=axis), axis=axis)
+        return out if keepdims else jnp.squeeze(out, axis=axis)
+
+    return apply_op("pick", f, (data, index))
+
+
+def topk(data, axis=-1, k=1, ret_typ="indices", is_ascend=False, dtype="float32"):
+    jnp = _jnp()
+    lax = _lax()
+
+    def f(x):
+        xm = jnp.moveaxis(x, axis, -1)
+        vals, idx = lax.top_k(-xm if is_ascend else xm, k)
+        if is_ascend:
+            vals = -vals
+        vals = jnp.moveaxis(vals, -1, axis)
+        idx = jnp.moveaxis(idx, -1, axis)
+        if ret_typ == "value":
+            return vals
+        if ret_typ == "indices":
+            return idx.astype(np_dtype(dtype))
+        if ret_typ == "both":
+            return vals, idx.astype(np_dtype(dtype))
+        if ret_typ == "mask":
+            m = jnp.zeros(xm.shape, dtype=np_dtype(dtype))
+            m = m.at[..., idx].set(1)  # approximate
+            return jnp.moveaxis(m, -1, axis)
+        raise ValueError(ret_typ)
+
+    n_outputs = 2 if ret_typ == "both" else 1
+    return apply_op("topk", f, (data,), n_outputs=n_outputs)
+
+
+def batch_dot(a, b, transpose_a=False, transpose_b=False, **kwargs):  # noqa: ARG001
+    jnp = _jnp()
+
+    def f(x, y):
+        from ..amp import amp_active, cast_for_matmul
+
+        if amp_active():
+            x, y = cast_for_matmul(x, y)
+        if transpose_a:
+            x = jnp.swapaxes(x, -1, -2)
+        if transpose_b:
+            y = jnp.swapaxes(y, -1, -2)
+        return jnp.matmul(x, y)
+
+    return apply_op("batch_dot", f, (a, b))
+
+
+def gather_nd(data, indices):
+    jnp = _jnp()
+
+    def f(x, idx):
+        idx = idx.astype(jnp.int32)
+        return x[tuple(idx[i] for i in range(idx.shape[0]))]
+
+    return apply_op("gather_nd", f, (data, indices))
+
+
+def scatter_nd(data, indices, shape):
+    jnp = _jnp()
+
+    def f(d, idx):
+        idx = idx.astype(jnp.int32)
+        out = jnp.zeros(shape, d.dtype)
+        return out.at[tuple(idx[i] for i in range(idx.shape[0]))].add(d)
+
+    return apply_op("scatter_nd", f, (data, indices))
+
+
+# ---------------------------------------------------------------------------
+# sequence ops (reference: src/operator/sequence_*.cc)
+# ---------------------------------------------------------------------------
+
+def sequence_mask(data, sequence_length=None, use_sequence_length=False,
+                  value=0.0, axis=0):
+    jnp = _jnp()
+    if not use_sequence_length or sequence_length is None:
+        return data if isinstance(data, NDArray) else NDArray(data)
+
+    def f(x, ln):
+        steps = jnp.arange(x.shape[axis])
+        batch_axis = 1 - axis  # sequence ops are (T, N, ...) or (N, T, ...)
+        shape = [1] * x.ndim
+        shape[axis] = -1
+        steps = steps.reshape(shape)
+        lshape = [1] * x.ndim
+        lshape[batch_axis] = -1
+        mask = steps < ln.reshape(lshape)
+        return jnp.where(mask, x, value)
+
+    return apply_op("sequence_mask", f, (data, sequence_length))
+
+
+def sequence_last(data, sequence_length=None, use_sequence_length=False, axis=0):
+    jnp = _jnp()
+
+    def f(x, ln):
+        if ln is None:
+            return jnp.take(x, -1, axis=axis)
+        idx = (ln - 1).astype(jnp.int32)
+        return jnp.take_along_axis(
+            x, idx.reshape((1, -1) + (1,) * (x.ndim - 2)) if axis == 0
+            else idx.reshape((-1, 1) + (1,) * (x.ndim - 2)),
+            axis=axis).squeeze(axis)
+
+    ln = sequence_length if use_sequence_length else None
+    return apply_op("sequence_last", f, (data, ln))
+
+
+def sequence_reverse(data, sequence_length=None, use_sequence_length=False, axis=0):
+    jnp = _jnp()
+
+    def f(x, ln):
+        if ln is None:
+            return jnp.flip(x, axis=axis)
+        T = x.shape[axis]
+        steps = jnp.arange(T)
+        ln_i = ln.astype(jnp.int32)
+        # reversed index within each valid prefix, identity beyond
+        rev = jnp.where(steps[None, :] < ln_i[:, None],
+                        ln_i[:, None] - 1 - steps[None, :], steps[None, :])
+        # data is (T, N, ...): gather along time per batch
+        xm = jnp.moveaxis(x, axis, 0)
+        out = jnp.take_along_axis(
+            xm, jnp.moveaxis(rev, -1, 0).reshape((T, -1) + (1,) * (xm.ndim - 2)),
+            axis=0)
+        return jnp.moveaxis(out, 0, axis)
+
+    ln = sequence_length if use_sequence_length else None
+    return apply_op("sequence_reverse", f, (data, ln))
+
+
+# ---------------------------------------------------------------------------
+# fused RNN (reference: src/operator/rnn.cc:296 — LSTM/GRU/vanilla over a
+# packed parameter vector). TPU design: lax.scan over time, weights unpacked
+# from the flat vector with cuDNN-compatible gate order (LSTM: i f g o,
+# GRU: r z n), so checkpoints trained on the reference load bit-compatibly.
+# ---------------------------------------------------------------------------
+
+def _rnn_gates(mode):
+    return {"rnn_relu": 1, "rnn_tanh": 1, "lstm": 4, "gru": 3}[mode]
+
+
+def _unpack_rnn_params(params, mode, num_layers, input_size, state_size, bidirectional,
+                       projection_size=None):  # noqa: ARG001
+    jnp = _jnp()
+    ngates = _rnn_gates(mode)
+    dirs = 2 if bidirectional else 1
+    layers = []
+    pos = 0
+    for layer in range(num_layers):
+        lsize = input_size if layer == 0 else state_size * dirs
+        for _ in range(dirs):
+            w_i2h = _lax().dynamic_slice(params, (pos,), (ngates * state_size * lsize,)) \
+                .reshape(ngates * state_size, lsize)
+            pos += ngates * state_size * lsize
+            w_h2h = _lax().dynamic_slice(params, (pos,), (ngates * state_size * state_size,)) \
+                .reshape(ngates * state_size, state_size)
+            pos += ngates * state_size * state_size
+            layers.append([w_i2h, w_h2h, None, None])
+    idx = 0
+    for layer in range(num_layers):
+        for _ in range(dirs):
+            b_i2h = _lax().dynamic_slice(params, (pos,), (ngates * state_size,))
+            pos += ngates * state_size
+            b_h2h = _lax().dynamic_slice(params, (pos,), (ngates * state_size,))
+            pos += ngates * state_size
+            layers[idx][2] = b_i2h
+            layers[idx][3] = b_h2h
+            idx += 1
+    del jnp
+    return layers
+
+
+def rnn_param_size(mode, num_layers, input_size, state_size, bidirectional=False):
+    ngates = _rnn_gates(mode)
+    dirs = 2 if bidirectional else 1
+    total = 0
+    for layer in range(num_layers):
+        lsize = input_size if layer == 0 else state_size * dirs
+        total += dirs * ngates * state_size * (lsize + state_size + 2)
+    return total
+
+
+def _cell_step(mode, x_t, h, c, w_i2h, w_h2h, b_i2h, b_h2h):
+    import jax
+
+    jnp = _jnp()
+    gates = x_t @ w_i2h.T + b_i2h + h @ w_h2h.T + b_h2h
+    H = h.shape[-1]
+    if mode == "lstm":
+        i, f, g, o = (gates[..., :H], gates[..., H:2 * H], gates[..., 2 * H:3 * H],
+                      gates[..., 3 * H:])
+        i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+        g = jnp.tanh(g)
+        c_new = f * c + i * g
+        h_new = o * jnp.tanh(c_new)
+        return h_new, c_new
+    if mode == "gru":
+        # cuDNN-style gru: r, z from combined; n uses r * (h W_hn + b_hn)
+        xr, xz, xn = jnp.split(x_t @ w_i2h.T + b_i2h, 3, axis=-1)
+        hr, hz, hn = jnp.split(h @ w_h2h.T + b_h2h, 3, axis=-1)
+        r = jax.nn.sigmoid(xr + hr)
+        z = jax.nn.sigmoid(xz + hz)
+        n = jnp.tanh(xn + r * hn)
+        h_new = (1 - z) * n + z * h
+        return h_new, c
+    act = jnp.tanh if mode == "rnn_tanh" else (lambda v: jnp.maximum(v, 0))
+    h_new = act(gates)
+    return h_new, c
+
+
+def rnn(data=None, parameters=None, state=None, state_cell=None, mode="lstm",
+        state_size=None, num_layers=1, bidirectional=False, p=0.0,
+        state_outputs=False, projection_size=None, lstm_state_clip_min=None,
+        lstm_state_clip_max=None, sequence_length=None, use_sequence_length=False,
+        **kwargs):  # noqa: ARG001
+    """Fused multi-layer RNN over time-major input (T, N, C)."""
+    import jax
+
+    jnp = _jnp()
+    lax = _lax()
+    dirs = 2 if bidirectional else 1
+    input_size = data.shape[-1]
+
+    dropout_keys = [next_key() for _ in range(max(0, num_layers - 1))] if p > 0 else []
+
+    def f(x, params, h0, c0):
+        layers = _unpack_rnn_params(params, mode, num_layers, input_size,
+                                    state_size, bidirectional)
+        out = x
+        h_finals, c_finals = [], []
+        for layer in range(num_layers):
+            layer_outs = []
+            for d in range(dirs):
+                li = layer * dirs + d
+                w_i2h, w_h2h, b_i2h, b_h2h = layers[li]
+                h_init = h0[li]
+                c_init = c0[li] if c0 is not None else jnp.zeros_like(h_init)
+                seq = out if d == 0 else jnp.flip(out, axis=0)
+
+                def step(carry, x_t, _w_i2h=w_i2h, _w_h2h=w_h2h, _b_i2h=b_i2h,
+                         _b_h2h=b_h2h):
+                    h, c = carry
+                    h2, c2 = _cell_step(mode, x_t, h, c, _w_i2h, _w_h2h, _b_i2h,
+                                        _b_h2h)
+                    if mode == "lstm" and lstm_state_clip_min is not None:
+                        c2 = jnp.clip(c2, lstm_state_clip_min, lstm_state_clip_max)
+                    return (h2, c2), h2
+
+                (h_f, c_f), ys = lax.scan(step, (h_init, c_init), seq)
+                if d == 1:
+                    ys = jnp.flip(ys, axis=0)
+                layer_outs.append(ys)
+                h_finals.append(h_f)
+                c_finals.append(c_f)
+            out = layer_outs[0] if dirs == 1 else jnp.concatenate(layer_outs, axis=-1)
+            if p > 0 and layer < num_layers - 1:
+                keep = jax.random.bernoulli(dropout_keys[layer], 1.0 - p, out.shape) \
+                    if autograd.is_training() else None
+                if keep is not None:
+                    out = jnp.where(keep, out / (1.0 - p), 0.0)
+        h_out = jnp.stack(h_finals, axis=0)
+        if mode == "lstm":
+            c_out = jnp.stack(c_finals, axis=0)
+            return out, h_out, c_out
+        return out, h_out
+
+    n_outputs = 3 if mode == "lstm" else 2
+    outs = apply_op("rnn", f, (data, parameters, state, state_cell),
+                    n_outputs=n_outputs)
+    if state_outputs:
+        return outs
+    return outs[0]
+
+
+# ---------------------------------------------------------------------------
+# scalar special functions
+# ---------------------------------------------------------------------------
+
+def erf(data):
+    import jax
+
+    return apply_op("erf", jax.scipy.special.erf, (data,))
+
+
+def erfinv(data):
+    import jax
+
+    return apply_op("erfinv", jax.scipy.special.erfinv, (data,))
+
+
+def gamma(data):
+    import jax
+
+    return apply_op("gamma", lambda x: _jnp().exp(jax.scipy.special.gammaln(x)), (data,))
+
+
+def gammaln(data):
+    import jax
+
+    return apply_op("gammaln", jax.scipy.special.gammaln, (data,))
+
+
+def digamma(data):
+    import jax
+
+    return apply_op("digamma", jax.scipy.special.digamma, (data,))
+
+
+def smooth_l1(data, scalar=1.0):
+    jnp = _jnp()
+    s2 = scalar * scalar
+
+    def f(x):
+        return jnp.where(jnp.abs(x) < 1.0 / s2, 0.5 * s2 * x * x,
+                         jnp.abs(x) - 0.5 / s2)
+
+    return apply_op("smooth_l1", f, (data,))
+
+
+# ---------------------------------------------------------------------------
+# shape utilities
+# ---------------------------------------------------------------------------
+
+def cast(data, dtype):
+    return data.astype(dtype)
+
+
+def reshape(data, newshape, reverse=False, **kwargs):  # noqa: ARG001
+    """npx.reshape with MXNet magic codes (-2 copy rest, -3 merge two,
+    -4 split, -5 merge all remaining, -6 split into two)."""
+    shape = list(newshape) if isinstance(newshape, (list, tuple)) else [newshape]
+    in_shape = list(data.shape)
+    if all(isinstance(s, int) and s >= -1 for s in shape):
+        # handle 0 = copy input dim (MXNet legacy reshape semantic)
+        out = [in_shape[i] if s == 0 and i < len(in_shape) else s
+               for i, s in enumerate(shape)]
+        return data.reshape(tuple(out))
+    out = []
+    i = 0
+    it = iter(range(len(shape)))
+    for si in it:
+        s = shape[si]
+        if s == -2:
+            out.extend(in_shape[i:])
+            i = len(in_shape)
+        elif s == -3:
+            out.append(in_shape[i] * in_shape[i + 1])
+            i += 2
+        elif s == -5:
+            prod = 1
+            for d in in_shape[i:]:
+                prod *= d
+            out.append(prod)
+            i = len(in_shape)
+        elif s == -4:
+            d1 = shape[si + 1]
+            d2 = shape[si + 2]
+            next(it)
+            next(it)
+            if d1 == -1:
+                d1 = in_shape[i] // d2
+            if d2 == -1:
+                d2 = in_shape[i] // d1
+            out.extend([d1, d2])
+            i += 1
+        elif s == -1:
+            out.append(-1)
+            i += 1
+        elif s == 0:
+            out.append(in_shape[i])
+            i += 1
+        else:
+            out.append(s)
+            i += 1
+    return data.reshape(tuple(out))
+
+
+def arange_like(data, start=0.0, step=1.0, repeat=1, axis=None):  # noqa: ARG001
+    jnp = _jnp()
+    if axis is None:
+        n = data.size
+        return NDArray(jnp.arange(start, start + step * n, step,
+                                  dtype=data._data.dtype).reshape(data.shape))
+    n = data.shape[axis]
+    return NDArray(jnp.arange(start, start + step * n, step, dtype=data._data.dtype))
+
+
+def shape_array(data):
+    jnp = _jnp()
+    return NDArray(jnp.asarray(data.shape, dtype=jnp.int64 if False else jnp.int32))
+
+
+def stop_gradient(data):
+    return data.detach()
+
+
+# ---------------------------------------------------------------------------
+# control flow (reference: src/operator/control_flow.cc — foreach/_while_loop/
+# _cond as stateful sub-graph ops; here they bridge to lax.scan/while/cond in
+# eager mode by direct Python execution, and trace cleanly under jit)
+# ---------------------------------------------------------------------------
+
+def foreach(body, data, init_states):
+    """Run body over axis-0 slices, threading states (≈ lax.scan)."""
+    states = init_states if isinstance(init_states, (list, tuple)) else [init_states]
+    states = list(states)
+    outputs = []
+    n = data.shape[0] if not isinstance(data, (list, tuple)) else data[0].shape[0]
+    for i in range(n):
+        x_i = data[i] if not isinstance(data, (list, tuple)) else [d[i] for d in data]
+        out, states = body(x_i, states)
+        outputs.append(out)
+    from .. import numpy as np_mod
+
+    if outputs and isinstance(outputs[0], (list, tuple)):
+        stacked = [np_mod.stack([o[j] for o in outputs])
+                   for j in range(len(outputs[0]))]
+    else:
+        stacked = np_mod.stack(outputs)
+    return stacked, states
+
+
+def while_loop(cond, func, loop_vars, max_iterations=None):
+    steps = 0
+    loop_vars = list(loop_vars)
+    outputs = []
+    while bool(cond(*loop_vars)):
+        if max_iterations is not None and steps >= max_iterations:
+            break
+        out, loop_vars = func(*loop_vars)
+        if out is not None:
+            outputs.append(out)
+        steps += 1
+    from .. import numpy as np_mod
+
+    stacked = np_mod.stack(outputs) if outputs else None
+    return stacked, loop_vars
+
+
+def cond(pred, then_func, else_func):
+    return then_func() if bool(pred) else else_func()
+
+
+# ---------------------------------------------------------------------------
+# misc module-level utilities
+# ---------------------------------------------------------------------------
+
+def clip_global_norm(arrays, max_norm, check_isfinite=True):
+    """Rescale arrays in-place so their global L2 norm ≤ max_norm
+    (reference: gluon/utils.py clip_global_norm)."""
+    jnp = _jnp()
+    total = sum(float(jnp.sum(a._data.astype(jnp.float32) ** 2)) for a in arrays)
+    total_norm = math.sqrt(total)
+    if check_isfinite and not math.isfinite(total_norm):
+        raise ValueError("global norm is not finite")
+    scale = max_norm / (total_norm + 1e-8)
+    if scale < 1.0:
+        for a in arrays:
+            a._set_data(a._data * scale)
+    return total_norm
+
+
+def set_np(shape=True, array=True, dtype=False):  # noqa: ARG001
+    """No-op for parity: this framework is numpy-semantics-native."""
+    return True
+
+
+def reset_np():
+    return True
+
+
+def is_np_array():
+    return True
+
+
+def is_np_shape():
+    return True
+
+
+def waitall():
+    from ..ndarray.ndarray import waitall as _w
+
+    _w()
+
+
+def seed(s):
+    from ..random import seed as _s
+
+    _s(s)
+
+
+def load(fname):
+    from ..ndarray import load as _load
+
+    return _load(fname)
+
+
+def save(fname, data):
+    from ..ndarray import save as _save
+
+    return _save(fname, data)

@@ -1,0 +1,10 @@
+from .optimizer import (  # noqa: F401
+    Optimizer, create, register,
+    SGD, NAG, Adam, AdamW, AdaBelief, AdaDelta, AdaGrad, Adamax, DCASGD,
+    FTML, FTRL, LAMB, LANS, LARS, Nadam, RMSProp, SGLD, Signum,
+    Updater, get_updater,
+)
+from ..lr_scheduler import (  # noqa: F401
+    CosineScheduler, FactorScheduler, LRScheduler, MultiFactorScheduler,
+    PolyScheduler,
+)

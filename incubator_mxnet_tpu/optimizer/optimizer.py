@@ -1,0 +1,606 @@
+"""Optimizers (reference: `python/mxnet/optimizer/` — 20 optimizers, fused
+update kernels in `src/operator/optimizer_op.cc:1137`).
+
+TPU-native design: each optimizer's update rule is a pure jax function,
+compiled once per (shape, dtype) by `jax.jit` — the analogue of the
+reference's fused multi-tensor update kernels. Hyperparameters that change
+across steps (lr, wd) are passed as traced scalars so schedulers never
+trigger recompilation.
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as onp
+
+from ..ndarray.ndarray import NDArray
+
+__all__ = [
+    "Optimizer", "create", "register", "SGD", "NAG", "Adam", "AdamW",
+    "AdaBelief", "AdaDelta", "AdaGrad", "Adamax", "DCASGD", "FTML", "FTRL",
+    "LAMB", "LANS", "LARS", "Nadam", "RMSProp", "SGLD", "Signum",
+    "Updater", "get_updater",
+]
+
+
+def _jnp():
+    import jax.numpy as jnp
+
+    return jnp
+
+
+_JIT_CACHE: dict = {}
+
+
+def _jitted(cls, fn_name):
+    key = (cls, fn_name)
+    if key not in _JIT_CACHE:
+        import jax
+
+        _JIT_CACHE[key] = jax.jit(getattr(cls, fn_name).__func__
+                                  if hasattr(getattr(cls, fn_name), "__func__")
+                                  else getattr(cls, fn_name))
+    return _JIT_CACHE[key]
+
+
+class Optimizer:
+    """Base optimizer (reference: `python/mxnet/optimizer/optimizer.py:29`)."""
+
+    opt_registry: dict = {}
+
+    def __init__(self, rescale_grad=1.0, param_idx2name=None, wd=0.0,
+                 clip_gradient=None, learning_rate=None, lr_scheduler=None,
+                 begin_num_update=0, multi_precision=False, param_dict=None,
+                 aggregate_num=0, use_fused_step=True, **kwargs):  # noqa: ARG002
+        self.rescale_grad = rescale_grad
+        self.lr = 0.01 if learning_rate is None else learning_rate
+        self.lr_scheduler = lr_scheduler
+        if lr_scheduler is not None and learning_rate is not None:
+            self.lr_scheduler.base_lr = learning_rate
+        self.wd = wd
+        self.clip_gradient = clip_gradient
+        self.begin_num_update = begin_num_update
+        self.num_update = begin_num_update
+        self._index_update_count: dict = {}
+        self.multi_precision = multi_precision
+        self.aggregate_num = aggregate_num
+        self.param_dict = param_dict or {}
+        self.idx2name = param_idx2name or {}
+        self.lr_mult: dict = {}
+        self.wd_mult: dict = {}
+
+    # -- registry -----------------------------------------------------------
+    @staticmethod
+    def register(cls):
+        Optimizer.opt_registry[cls.__name__.lower()] = cls
+        return cls
+
+    @staticmethod
+    def create_optimizer(name, **kwargs):
+        key = name.lower()
+        if key not in Optimizer.opt_registry:
+            raise ValueError(f"unknown optimizer {name!r}")
+        return Optimizer.opt_registry[key](**kwargs)
+
+    # -- lr / wd ------------------------------------------------------------
+    @property
+    def learning_rate(self):
+        if self.lr_scheduler is not None:
+            return self.lr_scheduler(self.num_update)
+        return self.lr
+
+    def set_learning_rate(self, lr):
+        if self.lr_scheduler is not None:
+            raise UserWarning("LRScheduler of the optimizer has already been defined.")
+        self.lr = lr
+
+    def set_lr_mult(self, args_lr_mult):
+        self.lr_mult = dict(args_lr_mult)
+
+    def set_wd_mult(self, args_wd_mult):
+        self.wd_mult = dict(args_wd_mult)
+
+    def _get_lr(self, index):
+        lr = self.learning_rate
+        name = self.idx2name.get(index, index)
+        if name in self.param_dict:
+            lr *= self.param_dict[name].lr_mult
+        return lr * self.lr_mult.get(name, 1.0)
+
+    def _get_wd(self, index):
+        wd = self.wd
+        name = self.idx2name.get(index, index)
+        if name in self.param_dict:
+            wd *= self.param_dict[name].wd_mult
+        return wd * self.wd_mult.get(name, 1.0)
+
+    def _update_count(self, index):
+        if index not in self._index_update_count:
+            self._index_update_count[index] = self.begin_num_update
+        self._index_update_count[index] += 1
+        self.num_update = max(self._index_update_count[index], self.num_update)
+
+    # -- state --------------------------------------------------------------
+    def create_state(self, index, weight):  # noqa: ARG002
+        return None
+
+    def create_state_multi_precision(self, index, weight):
+        if self.multi_precision and weight._data.dtype == _jnp().float16:
+            master = weight._data.astype(_jnp().float32)
+            return (master, self.create_state(index, NDArray(master)))
+        return self.create_state(index, weight)
+
+    # -- update -------------------------------------------------------------
+    def _preprocess(self, grad_val, weight_val, wd):
+        jnp = _jnp()
+        g = grad_val * self.rescale_grad
+        if self.clip_gradient is not None:
+            g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
+        return g, wd
+
+    def update(self, index, weight, grad, state):
+        """Single-param update; mutates `weight` (and state) in place."""
+        if isinstance(index, (list, tuple)):
+            for i, w, g, s in zip(index, weight, grad, state):
+                self.update(i, w, g, s)
+            return
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        t = self._index_update_count[index]
+        new_w, new_state = self.step(weight._data, grad._data, state, lr, wd, t)
+        weight._set_data(new_w)
+        if state is not None and new_state is not None:
+            if isinstance(state, list):
+                state[:] = new_state
+        return new_state
+
+    def update_multi_precision(self, index, weight, grad, state):
+        jnp = _jnp()
+        if self.multi_precision and isinstance(state, tuple) and len(state) == 2 \
+                and hasattr(state[0], "dtype") and state[0].dtype == jnp.float32 \
+                and weight._data.dtype == jnp.float16:
+            master, inner = state
+            mw = NDArray(master)
+            g32 = NDArray(grad._data.astype(jnp.float32))
+            self.update(index, mw, g32, inner)
+            weight._set_data(mw._data.astype(jnp.float16))
+            return (mw._data, inner)
+        return self.update(index, weight, grad, state)
+
+    def step(self, w, g, state, lr, wd, t):
+        raise NotImplementedError
+
+    def __repr__(self):
+        return f"{type(self).__name__}(learning_rate={self.learning_rate})"
+
+
+register = Optimizer.register
+create = Optimizer.create_optimizer
+
+
+def _zeros_like(w):
+    return _jnp().zeros_like(w)
+
+
+@register
+class SGD(Optimizer):
+    """SGD with momentum (reference: optimizer/sgd.py; kernel optimizer_op.cc)."""
+
+    def __init__(self, learning_rate=0.01, momentum=0.0, lazy_update=False, **kwargs):  # noqa: ARG002
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.momentum = momentum
+
+    def create_state(self, index, weight):
+        return [_zeros_like(weight._data)] if self.momentum != 0.0 else []
+
+    def step(self, w, g, state, lr, wd, t):  # noqa: ARG002
+        jnp = _jnp()
+        g, wd = self._preprocess(g, w, wd)
+        g = g + wd * w
+        if self.momentum != 0.0:
+            mom = state[0]
+            mom = self.momentum * mom - lr * g
+            return w + mom, [mom]
+        return w - lr * g, []
+
+
+@register
+class NAG(SGD):
+    """Nesterov accelerated SGD."""
+
+    def step(self, w, g, state, lr, wd, t):  # noqa: ARG002
+        g, wd = self._preprocess(g, w, wd)
+        g = g + wd * w
+        if self.momentum != 0.0:
+            mom = state[0]
+            mom = self.momentum * mom + g
+            return w - lr * (g + self.momentum * mom), [mom]
+        return w - lr * g, []
+
+
+@register
+class Adam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, lazy_update=False, **kwargs):  # noqa: ARG002
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+
+    def create_state(self, index, weight):
+        return [_zeros_like(weight._data), _zeros_like(weight._data)]
+
+    def step(self, w, g, state, lr, wd, t):
+        jnp = _jnp()
+        g, wd = self._preprocess(g, w, wd)
+        g = g + wd * w
+        m, v = state
+        m = self.beta1 * m + (1 - self.beta1) * g
+        v = self.beta2 * v + (1 - self.beta2) * g * g
+        lr_t = lr * math.sqrt(1 - self.beta2 ** t) / (1 - self.beta1 ** t)
+        return w - lr_t * m / (jnp.sqrt(v) + self.epsilon), [m, v]
+
+
+@register
+class AdamW(Adam):
+    """Adam with decoupled weight decay (reference: contrib adamw op)."""
+
+    def step(self, w, g, state, lr, wd, t):
+        jnp = _jnp()
+        g, _ = self._preprocess(g, w, 0.0)
+        m, v = state
+        m = self.beta1 * m + (1 - self.beta1) * g
+        v = self.beta2 * v + (1 - self.beta2) * g * g
+        mhat = m / (1 - self.beta1 ** t)
+        vhat = v / (1 - self.beta2 ** t)
+        return w - lr * (mhat / (jnp.sqrt(vhat) + self.epsilon) + wd * w), [m, v]
+
+
+@register
+class AdaBelief(Adam):
+    def step(self, w, g, state, lr, wd, t):
+        jnp = _jnp()
+        g, wd = self._preprocess(g, w, wd)
+        g = g + wd * w
+        m, s = state
+        m = self.beta1 * m + (1 - self.beta1) * g
+        s = self.beta2 * s + (1 - self.beta2) * (g - m) ** 2 + self.epsilon
+        lr_t = lr * math.sqrt(1 - self.beta2 ** t) / (1 - self.beta1 ** t)
+        return w - lr_t * m / (jnp.sqrt(s) + self.epsilon), [m, s]
+
+
+@register
+class AdaDelta(Optimizer):
+    def __init__(self, learning_rate=1.0, rho=0.9, epsilon=1e-5, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.rho, self.epsilon = rho, epsilon
+
+    def create_state(self, index, weight):
+        return [_zeros_like(weight._data), _zeros_like(weight._data)]
+
+    def step(self, w, g, state, lr, wd, t):  # noqa: ARG002
+        jnp = _jnp()
+        g, wd = self._preprocess(g, w, wd)
+        g = g + wd * w
+        acc_g, acc_d = state
+        acc_g = self.rho * acc_g + (1 - self.rho) * g * g
+        delta = jnp.sqrt(acc_d + self.epsilon) / jnp.sqrt(acc_g + self.epsilon) * g
+        acc_d = self.rho * acc_d + (1 - self.rho) * delta * delta
+        return w - lr * delta, [acc_g, acc_d]
+
+
+@register
+class AdaGrad(Optimizer):
+    def __init__(self, learning_rate=0.01, epsilon=1e-7, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.epsilon = epsilon
+
+    def create_state(self, index, weight):
+        return [_zeros_like(weight._data)]
+
+    def step(self, w, g, state, lr, wd, t):  # noqa: ARG002
+        jnp = _jnp()
+        g, wd = self._preprocess(g, w, wd)
+        g = g + wd * w
+        hist = state[0] + g * g
+        return w - lr * g / (jnp.sqrt(hist) + self.epsilon), [hist]
+
+
+@register
+class Adamax(Adam):
+    def __init__(self, learning_rate=0.002, beta1=0.9, beta2=0.999, **kwargs):
+        super().__init__(learning_rate=learning_rate, beta1=beta1, beta2=beta2,
+                         **kwargs)
+
+    def step(self, w, g, state, lr, wd, t):
+        jnp = _jnp()
+        g, wd = self._preprocess(g, w, wd)
+        g = g + wd * w
+        m, u = state
+        m = self.beta1 * m + (1 - self.beta1) * g
+        u = jnp.maximum(self.beta2 * u, jnp.abs(g))
+        return w - lr / (1 - self.beta1 ** t) * m / (u + self.epsilon), [m, u]
+
+
+@register
+class DCASGD(Optimizer):
+    """Delay-compensated async SGD (reference: optimizer/dcasgd.py)."""
+
+    def __init__(self, learning_rate=0.01, momentum=0.0, lamda=0.04, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.momentum = momentum
+        self.lamda = lamda
+
+    def create_state(self, index, weight):
+        return [_zeros_like(weight._data), weight._data + 0]
+
+    def step(self, w, g, state, lr, wd, t):  # noqa: ARG002
+        g, wd = self._preprocess(g, w, wd)
+        mom, prev_w = state
+        g = g + wd * w + self.lamda * g * g * (w - prev_w)
+        mom = self.momentum * mom - lr * g
+        new_w = w + mom
+        return new_w, [mom, new_w]
+
+
+@register
+class FTML(Optimizer):
+    def __init__(self, learning_rate=0.0025, beta1=0.6, beta2=0.999,
+                 epsilon=1e-8, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+
+    def create_state(self, index, weight):
+        z = _zeros_like(weight._data)
+        return [z, z + 0, z + 0]
+
+    def step(self, w, g, state, lr, wd, t):
+        jnp = _jnp()
+        g, wd = self._preprocess(g, w, wd)
+        g = g + wd * w
+        d_prev, v, z = state
+        v = self.beta2 * v + (1 - self.beta2) * g * g
+        d = (1 - self.beta1 ** t) / lr * (
+            jnp.sqrt(v / (1 - self.beta2 ** t)) + self.epsilon)
+        sigma = d - self.beta1 * d_prev
+        z = self.beta1 * z + (1 - self.beta1) * g - sigma * w
+        return -z / d, [d, v, z]
+
+
+@register
+class FTRL(Optimizer):
+    def __init__(self, learning_rate=0.1, lamda1=0.01, beta=1.0, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.lamda1, self.beta = lamda1, beta
+
+    def create_state(self, index, weight):
+        return [_zeros_like(weight._data), _zeros_like(weight._data)]
+
+    def step(self, w, g, state, lr, wd, t):  # noqa: ARG002
+        jnp = _jnp()
+        g, wd = self._preprocess(g, w, wd)
+        z, n = state
+        n_new = n + g * g
+        sigma = (jnp.sqrt(n_new) - jnp.sqrt(n)) / lr
+        z = z + g - sigma * w
+        new_w = jnp.where(
+            jnp.abs(z) > self.lamda1,
+            -(z - jnp.sign(z) * self.lamda1) / ((self.beta + jnp.sqrt(n_new)) / lr + wd),
+            0.0)
+        return new_w, [z, n_new]
+
+
+@register
+class LAMB(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-6, lower_bound=None, upper_bound=None,
+                 bias_correction=True, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+        self.lower_bound, self.upper_bound = lower_bound, upper_bound
+        self.bias_correction = bias_correction
+
+    def create_state(self, index, weight):
+        return [_zeros_like(weight._data), _zeros_like(weight._data)]
+
+    def step(self, w, g, state, lr, wd, t):
+        jnp = _jnp()
+        g, wd = self._preprocess(g, w, wd)
+        m, v = state
+        m = self.beta1 * m + (1 - self.beta1) * g
+        v = self.beta2 * v + (1 - self.beta2) * g * g
+        if self.bias_correction:
+            mhat = m / (1 - self.beta1 ** t)
+            vhat = v / (1 - self.beta2 ** t)
+        else:
+            mhat, vhat = m, v
+        update = mhat / (jnp.sqrt(vhat) + self.epsilon) + wd * w
+        wnorm = jnp.linalg.norm(w)
+        unorm = jnp.linalg.norm(update)
+        ratio = jnp.where(unorm > 0, jnp.where(wnorm > 0, wnorm / unorm, 1.0), 1.0)
+        if self.lower_bound is not None:
+            ratio = jnp.maximum(ratio, self.lower_bound)
+        if self.upper_bound is not None:
+            ratio = jnp.minimum(ratio, self.upper_bound)
+        return w - lr * ratio * update, [m, v]
+
+
+@register
+class LANS(LAMB):
+    """LAMB with per-layer gradient normalization (reference: lans.py)."""
+
+    def step(self, w, g, state, lr, wd, t):
+        jnp = _jnp()
+        gnorm = jnp.linalg.norm(g * self.rescale_grad)
+        g = g / jnp.maximum(gnorm, 1e-12) / max(self.rescale_grad, 1e-30)
+        return LAMB.step(self, w, g, state, lr, wd, t)
+
+
+@register
+class LARS(Optimizer):
+    def __init__(self, learning_rate=0.1, momentum=0.9, eta=0.001,
+                 epsilon=1e-8, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.momentum, self.eta, self.epsilon = momentum, eta, epsilon
+
+    def create_state(self, index, weight):
+        return [_zeros_like(weight._data)]
+
+    def step(self, w, g, state, lr, wd, t):  # noqa: ARG002
+        jnp = _jnp()
+        g, wd = self._preprocess(g, w, wd)
+        wnorm = jnp.linalg.norm(w)
+        gnorm = jnp.linalg.norm(g)
+        trust = jnp.where(
+            (wnorm > 0) & (gnorm > 0),
+            self.eta * wnorm / (gnorm + wd * wnorm + self.epsilon), 1.0)
+        mom = state[0]
+        mom = self.momentum * mom + trust * lr * (g + wd * w)
+        return w - mom, [mom]
+
+
+@register
+class Nadam(Adam):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, schedule_decay=0.004, **kwargs):
+        super().__init__(learning_rate=learning_rate, beta1=beta1, beta2=beta2,
+                         epsilon=epsilon, **kwargs)
+        self.schedule_decay = schedule_decay
+        self.m_schedule = 1.0
+
+    def step(self, w, g, state, lr, wd, t):
+        jnp = _jnp()
+        g, wd = self._preprocess(g, w, wd)
+        g = g + wd * w
+        m, v = state
+        momentum_t = self.beta1 * (1 - 0.5 * 0.96 ** (t * self.schedule_decay))
+        momentum_t1 = self.beta1 * (1 - 0.5 * 0.96 ** ((t + 1) * self.schedule_decay))
+        self.m_schedule = self.m_schedule * momentum_t
+        m_schedule_next = self.m_schedule * momentum_t1
+        ghat = g / (1 - self.m_schedule)
+        m = self.beta1 * m + (1 - self.beta1) * g
+        v = self.beta2 * v + (1 - self.beta2) * g * g
+        mhat = m / (1 - m_schedule_next)
+        vhat = v / (1 - self.beta2 ** t)
+        mbar = (1 - momentum_t) * ghat + momentum_t1 * mhat
+        return w - lr * mbar / (jnp.sqrt(vhat) + self.epsilon), [m, v]
+
+
+@register
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate=0.001, rho=0.9, momentum=0.9,
+                 epsilon=1e-8, centered=False, clip_weights=None, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.rho, self.momentum, self.epsilon = rho, momentum, epsilon
+        self.centered = centered
+        self.clip_weights = clip_weights
+
+    def create_state(self, index, weight):
+        z = _zeros_like(weight._data)
+        if self.centered:
+            return [z, z + 0, z + 0]
+        return [z]
+
+    def step(self, w, g, state, lr, wd, t):  # noqa: ARG002
+        jnp = _jnp()
+        g, wd = self._preprocess(g, w, wd)
+        g = g + wd * w
+        if self.centered:
+            n, gbar, delta = state
+            n = self.rho * n + (1 - self.rho) * g * g
+            gbar = self.rho * gbar + (1 - self.rho) * g
+            delta = self.momentum * delta - lr * g / jnp.sqrt(
+                n - gbar * gbar + self.epsilon)
+            new_w = w + delta
+            state = [n, gbar, delta]
+        else:
+            n = state[0]
+            n = self.rho * n + (1 - self.rho) * g * g
+            new_w = w - lr * g / (jnp.sqrt(n) + self.epsilon)
+            state = [n]
+        if self.clip_weights:
+            new_w = jnp.clip(new_w, -self.clip_weights, self.clip_weights)
+        return new_w, state
+
+
+@register
+class SGLD(Optimizer):
+    """Stochastic Gradient Langevin Dynamics."""
+
+    def __init__(self, learning_rate=0.1, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+
+    def step(self, w, g, state, lr, wd, t):  # noqa: ARG002
+        import jax.random as jr
+
+        from ..random import next_key
+
+        g, wd = self._preprocess(g, w, wd)
+        g = g + wd * w
+        noise = jr.normal(next_key(), w.shape, w.dtype) * math.sqrt(lr)
+        return w - lr / 2 * g + noise, state
+
+
+@register
+class Signum(Optimizer):
+    def __init__(self, learning_rate=0.01, momentum=0.9, wd_lh=0.0, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.momentum = momentum
+        self.wd_lh = wd_lh
+
+    def create_state(self, index, weight):
+        return [_zeros_like(weight._data)] if self.momentum != 0.0 else []
+
+    def step(self, w, g, state, lr, wd, t):  # noqa: ARG002
+        jnp = _jnp()
+        g, wd = self._preprocess(g, w, wd)
+        if self.momentum != 0.0:
+            mom = state[0]
+            mom = self.momentum * mom - (1 - self.momentum) * (g + wd * w)
+            new_w = (1 - lr * self.wd_lh) * w + lr * jnp.sign(mom)
+            return new_w, [mom]
+        return (1 - lr * self.wd_lh) * w - lr * jnp.sign(g + wd * w), []
+
+
+# aliases matching reference casing
+sgd = SGD
+adam = Adam
+
+
+class Updater:
+    """KVStore-side updater (reference: `python/mxnet/optimizer/updater.py`)."""
+
+    def __init__(self, optimizer):
+        self.optimizer = optimizer
+        self.states: dict = {}
+        self.states_synced: dict = {}
+
+    def __call__(self, index, grad, weight):
+        if index not in self.states:
+            self.states[index] = self.optimizer.create_state_multi_precision(
+                index, weight)
+        self.optimizer.update_multi_precision(index, weight, grad,
+                                              self.states[index])
+
+    def get_states(self, dump_optimizer=False):  # noqa: ARG002
+        import pickle
+
+        serializable = {
+            k: ([onp.asarray(s) for s in v] if isinstance(v, list) else v)
+            for k, v in self.states.items()
+        }
+        return pickle.dumps(serializable)
+
+    def set_states(self, states):
+        import pickle
+
+        import jax.numpy as jnp
+
+        loaded = pickle.loads(states)
+        self.states = {
+            k: ([jnp.asarray(s) for s in v] if isinstance(v, list) else v)
+            for k, v in loaded.items()
+        }
+
+
+def get_updater(optimizer):
+    return Updater(optimizer)

@@ -1,0 +1,10 @@
+"""Parallelism: device mesh, collectives, sharded training steps.
+
+Replaces the reference's distributed stack (SURVEY.md §2.4): ps-lite/NCCL/
+Horovod → `jax.sharding.Mesh` + XLA collectives over ICI/DCN.
+"""
+from .mesh import Mesh, current_mesh, make_mesh, mesh_scope  # noqa: F401
+from .collectives import (  # noqa: F401
+    all_gather, all_reduce, broadcast, reduce_scatter, ring_permute,
+)
+from .sharded import DataParallel, shard_train_step  # noqa: F401

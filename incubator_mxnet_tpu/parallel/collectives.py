@@ -1,0 +1,77 @@
+"""Collective ops over the mesh (replacement for `src/kvstore/comm.h` reduce
+trees and NCCL/ps-lite: `psum`/`all_gather`/`ppermute` ride ICI links and XLA
+overlaps them with compute — the latency-hiding the reference built P3 for).
+
+These are meant to be called INSIDE a shard_map'ed/pjit'ed function; thin
+wrappers around jax.lax so user code never imports jax directly."""
+from __future__ import annotations
+
+__all__ = ["all_reduce", "all_gather", "reduce_scatter", "broadcast",
+           "ring_permute"]
+
+
+def all_reduce(x, axis_name, op="sum"):
+    import jax
+
+    from ..ndarray.ndarray import NDArray
+
+    v = x._data if isinstance(x, NDArray) else x
+    if op == "sum":
+        out = jax.lax.psum(v, axis_name)
+    elif op == "mean":
+        out = jax.lax.pmean(v, axis_name)
+    elif op == "max":
+        out = jax.lax.pmax(v, axis_name)
+    elif op == "min":
+        out = jax.lax.pmin(v, axis_name)
+    else:
+        raise ValueError(f"unknown op {op!r}")
+    return NDArray(out) if isinstance(x, NDArray) else out
+
+
+def all_gather(x, axis_name, axis=0, tiled=True):
+    import jax
+
+    from ..ndarray.ndarray import NDArray
+
+    v = x._data if isinstance(x, NDArray) else x
+    out = jax.lax.all_gather(v, axis_name, axis=axis, tiled=tiled)
+    return NDArray(out) if isinstance(x, NDArray) else out
+
+
+def reduce_scatter(x, axis_name, axis=0):
+    import jax
+
+    from ..ndarray.ndarray import NDArray
+
+    v = x._data if isinstance(x, NDArray) else x
+    out = jax.lax.psum_scatter(v, axis_name, scatter_dimension=axis, tiled=True)
+    return NDArray(out) if isinstance(x, NDArray) else out
+
+
+def broadcast(x, axis_name, src=0):
+    import jax
+
+    from ..ndarray.ndarray import NDArray
+
+    v = x._data if isinstance(x, NDArray) else x
+    idx = jax.lax.axis_index(axis_name)
+    n = jax.lax.psum(1, axis_name)
+    mask = (idx == src).astype(v.dtype)
+    out = jax.lax.psum(v * mask, axis_name)
+    del n
+    return NDArray(out) if isinstance(x, NDArray) else out
+
+
+def ring_permute(x, axis_name, shift=1):
+    """Send each shard to the next device on the ring (the building block of
+    ring attention / ring allreduce; rides neighbor ICI links)."""
+    import jax
+
+    from ..ndarray.ndarray import NDArray
+
+    v = x._data if isinstance(x, NDArray) else x
+    n = jax.lax.psum(1, axis_name)
+    perm = [(i, (i + shift) % n) for i in range(n)]
+    out = jax.lax.ppermute(v, axis_name, perm)
+    return NDArray(out) if isinstance(x, NDArray) else out

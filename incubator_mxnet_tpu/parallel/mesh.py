@@ -1,0 +1,65 @@
+"""Device mesh management (the TPU-native replacement for the reference's
+device topology handling, `src/kvstore/gpu_topology.h` — on TPU the ICI
+topology is expressed as a `jax.sharding.Mesh` and XLA routes collectives)."""
+from __future__ import annotations
+
+import threading
+
+__all__ = ["Mesh", "make_mesh", "mesh_scope", "current_mesh"]
+
+
+class _TLS(threading.local):
+    def __init__(self):
+        self.stack = []
+
+
+_STATE = _TLS()
+
+
+def Mesh(devices, axis_names):
+    import jax
+
+    return jax.sharding.Mesh(devices, axis_names)
+
+
+def make_mesh(axis_shapes: dict, devices=None):
+    """Build a mesh from {'axis': size}; e.g. {'dp': 2, 'tp': 4}.
+
+    Uses all available devices by default. Sizes must multiply to the device
+    count (a -1 wildcard axis is allowed)."""
+    import numpy as onp
+
+    import jax
+
+    devices = devices if devices is not None else jax.devices()
+    names = list(axis_shapes)
+    sizes = list(axis_shapes.values())
+    n = len(devices)
+    if -1 in sizes:
+        known = int(onp.prod([s for s in sizes if s != -1]))
+        sizes[sizes.index(-1)] = n // known
+    total = int(onp.prod(sizes))
+    if total != n:
+        raise ValueError(f"mesh {dict(zip(names, sizes))} needs {total} devices, "
+                         f"have {n}")
+    arr = onp.asarray(devices[:total]).reshape(sizes)
+    return jax.sharding.Mesh(arr, names)
+
+
+class mesh_scope:
+    """Context manager installing a mesh as the active one."""
+
+    def __init__(self, mesh):
+        self.mesh = mesh
+
+    def __enter__(self):
+        _STATE.stack.append(self.mesh)
+        return self.mesh
+
+    def __exit__(self, *exc):
+        _STATE.stack.pop()
+        return False
+
+
+def current_mesh():
+    return _STATE.stack[-1] if _STATE.stack else None

@@ -1,0 +1,143 @@
+"""Sharded training steps (the TPU-native equivalent of the reference's
+data-parallel Trainer+KVStore pipeline, SURVEY.md §2.4).
+
+Design: instead of per-device parameter copies + explicit allreduce
+(`CommDevice::Reduce`, `src/kvstore/comm.h:482`), the WHOLE train step
+(forward, backward, optimizer) is one jit program over a `Mesh`. Batch
+arrays are sharded over the 'dp' axis, parameters are replicated (pure DP)
+or sharded over 'tp' (tensor parallel); XLA inserts the psum/all-gathers on
+ICI and overlaps them with compute — subsuming the reference's P3
+priority-overlap scheme (`src/kvstore/p3store_dist.h`)."""
+from __future__ import annotations
+
+from ..ndarray.ndarray import NDArray
+
+__all__ = ["DataParallel", "shard_train_step"]
+
+
+def _build_pure_step(net, loss_fn, optimizer):
+    """(param_vals, opt_states, t, x, y) -> (loss, new_params, new_states).
+
+    Pure function suitable for jit: parameters are substituted into the
+    gluon net during tracing (same mechanism as the CachedOp), the loss is
+    differentiated with jax.grad, and the optimizer's pure `step` applies
+    updates — everything fuses into one XLA program."""
+    import jax
+
+    from .. import autograd
+    from ..random import trace_key_scope
+    from ..utils.trace import TraceContext
+
+    params = [p for p in net.collect_params().values()
+              if p.grad_req != "null"]
+    frozen = [p for p in net.collect_params().values()
+              if p.grad_req == "null"]
+    param_arrays = [p.data() for p in params]
+    frozen_arrays = [p.data() for p in frozen]
+
+    def forward_loss(param_vals, frozen_vals, key, x, y):
+        saved = [(a, a._data) for a in param_arrays + frozen_arrays]
+        for a, v in zip(param_arrays, param_vals):
+            a._data = v
+        for a, v in zip(frozen_arrays, frozen_vals):
+            a._data = v
+        tc = TraceContext()
+        try:
+            with tc, trace_key_scope(key), autograd.pause(train_mode=True):
+                out = net.forward(NDArray(x))
+                loss = loss_fn(out, NDArray(y))
+        finally:
+            for a, v in saved:
+                a._data = v
+        aux_new = tuple(nv for _, nv in tc.updates.values())
+        return loss.mean()._data, aux_new
+
+    def step(param_vals, frozen_vals, opt_states, t, key, x, y):
+        (loss, aux_new), grads = jax.value_and_grad(
+            forward_loss, has_aux=True)(param_vals, frozen_vals, key, x, y)
+        new_params, new_states = [], []
+        lr = optimizer.learning_rate
+        wd = optimizer.wd
+        for w, g, s in zip(param_vals, grads, opt_states):
+            nw, ns = optimizer.step(w, g, s, lr, wd, t)
+            new_params.append(nw)
+            new_states.append(ns)
+        return loss, new_params, new_states, aux_new
+
+    return step, params, param_arrays, frozen_arrays
+
+
+class DataParallel:
+    """Compiled data-parallel trainer for a gluon net.
+
+    Usage::
+
+        dp = DataParallel(net, loss_fn, optimizer, mesh=make_mesh({'dp': 8}))
+        loss = dp.step(x_batch, y_batch)   # updates net parameters in place
+    """
+
+    def __init__(self, net, loss_fn, optimizer, mesh=None, data_axis="dp",
+                 param_shardings=None):
+        import jax
+
+        self.net = net
+        self.optimizer = optimizer
+        self.mesh = mesh
+        self._t = 0
+        step, params, param_arrays, frozen_arrays = _build_pure_step(
+            net, loss_fn, optimizer)
+        self.params = params
+        self.param_arrays = param_arrays
+        self.frozen_arrays = frozen_arrays
+        self.opt_states = [optimizer.create_state(i, a)
+                           for i, a in enumerate(param_arrays)]
+
+        if mesh is not None:
+            P = jax.sharding.PartitionSpec
+            NS = jax.sharding.NamedSharding
+            repl = NS(mesh, P())
+            batch_sh = NS(mesh, P(data_axis))
+            if param_shardings is None:
+                param_sh = [repl] * len(param_arrays)
+            else:
+                param_sh = [NS(mesh, ps) for ps in param_shardings]
+            self._jit = jax.jit(
+                step,
+                in_shardings=(param_sh, [repl] * len(frozen_arrays), None,
+                              None, repl, batch_sh, batch_sh),
+                out_shardings=None)
+            self._batch_sharding = batch_sh
+        else:
+            self._jit = jax.jit(step)
+            self._batch_sharding = None
+
+    def step(self, x, y):
+        from ..random import next_key
+
+        self._t += 1
+        xv = x._data if isinstance(x, NDArray) else x
+        yv = y._data if isinstance(y, NDArray) else y
+        param_vals = [a._data for a in self.param_arrays]
+        frozen_vals = [a._data for a in self.frozen_arrays]
+        loss, new_params, new_states, aux_new = self._jit(
+            param_vals, frozen_vals, self.opt_states, self._t, next_key(),
+            xv, yv)
+        for a, nv in zip(self.param_arrays, new_params):
+            a._set_data(nv)
+        self.opt_states = new_states
+        return NDArray(loss)
+
+
+def shard_train_step(step_fn, mesh, in_specs, out_specs):
+    """shard_map a raw per-device step over the mesh (for SPMD code that
+    calls collectives explicitly — ring attention, expert parallel, etc.)."""
+    import jax
+    from jax.experimental.shard_map import shard_map
+
+    P = jax.sharding.PartitionSpec
+    in_specs = tuple(s if isinstance(s, P) else P(*s) if s else P()
+                     for s in in_specs)
+    out_specs = (out_specs if isinstance(out_specs, P)
+                 else P(*out_specs) if out_specs else P())
+    return jax.jit(shard_map(step_fn, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs))

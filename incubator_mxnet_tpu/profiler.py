@@ -1,0 +1,136 @@
+"""Profiler (reference: `python/mxnet/profiler.py` + `src/profiler/` — chrome
+tracing JSON, per-op aggregate stats).
+
+TPU-native: wraps the jax/XLA profiler (XPlane → TensorBoard / Perfetto) and
+keeps the reference's `set_config / start / stop / dump / dumps` API shape.
+Python-level op timing (the aggregate table) is collected by timing the
+apply_op funnel when profiling is on."""
+from __future__ import annotations
+
+import atexit
+import json
+import os
+import threading
+import time
+from collections import defaultdict
+
+__all__ = ["set_config", "set_state", "start", "stop", "dump", "dumps",
+           "pause", "resume", "Scope", "profiler_scope"]
+
+_CONFIG = {"filename": "profile.json", "profile_all": False,
+           "profile_imperative": True, "aggregate_stats": True}
+_STATE = {"running": False, "jax_tracing": False}
+_EVENTS: list = []
+_AGG = defaultdict(lambda: [0, 0.0, float("inf"), 0.0])  # count, total, min, max
+_LOCK = threading.Lock()
+
+
+def set_config(**kwargs):
+    _CONFIG.update(kwargs)
+
+
+def set_state(state="stop", profile_process="worker"):  # noqa: ARG001
+    if state in ("run", "start"):
+        start()
+    else:
+        stop()
+
+
+def start(profile_process="worker"):  # noqa: ARG001
+    _STATE["running"] = True
+    logdir = _CONFIG.get("tensorboard_logdir")
+    if logdir:
+        import jax
+
+        try:
+            jax.profiler.start_trace(logdir)
+            _STATE["jax_tracing"] = True
+        except Exception:
+            _STATE["jax_tracing"] = False
+
+
+def stop(profile_process="worker"):  # noqa: ARG001
+    _STATE["running"] = False
+    if _STATE.get("jax_tracing"):
+        import jax
+
+        try:
+            jax.profiler.stop_trace()
+        except Exception:
+            pass
+        _STATE["jax_tracing"] = False
+
+
+def pause(profile_process="worker"):  # noqa: ARG001
+    _STATE["running"] = False
+
+
+def resume(profile_process="worker"):  # noqa: ARG001
+    _STATE["running"] = True
+
+
+def is_running():
+    return _STATE["running"]
+
+
+def record_op(name, dur_s):
+    """Called from the op funnel when profiling is active."""
+    with _LOCK:
+        _EVENTS.append({"name": name, "ph": "X", "pid": 0, "tid": 0,
+                        "ts": time.time() * 1e6, "dur": dur_s * 1e6})
+        agg = _AGG[name]
+        agg[0] += 1
+        agg[1] += dur_s
+        agg[2] = min(agg[2], dur_s)
+        agg[3] = max(agg[3], dur_s)
+
+
+def dump(finished=True, profile_process="worker"):  # noqa: ARG001
+    """Write chrome://tracing JSON (reference: profiler.py:125)."""
+    path = _CONFIG["filename"]
+    with _LOCK:
+        payload = {"traceEvents": list(_EVENTS), "displayTimeUnit": "ms"}
+    with open(path, "w") as f:
+        json.dump(payload, f)
+    return path
+
+
+def dumps(reset=False, format="table", sort_by="total", ascending=False):  # noqa: ARG001
+    """Aggregate per-op stats table (reference: profiler.py:154)."""
+    with _LOCK:
+        rows = [(name, c, tot * 1000, mn * 1000, mx * 1000)
+                for name, (c, tot, mn, mx) in _AGG.items()]
+        if reset:
+            _AGG.clear()
+            _EVENTS.clear()
+    key = {"total": 2, "count": 1, "min": 3, "max": 4}.get(sort_by, 2)
+    rows.sort(key=lambda r: r[key], reverse=not ascending)
+    lines = [f"{'Name':<40}{'Count':>8}{'Total(ms)':>12}{'Min(ms)':>10}"
+             f"{'Max(ms)':>10}", "=" * 80]
+    for name, c, tot, mn, mx in rows:
+        lines.append(f"{name[:39]:<40}{c:>8}{tot:>12.3f}{mn:>10.3f}{mx:>10.3f}")
+    return "\n".join(lines)
+
+
+class Scope:
+    """RAII profiling scope (ProfileTask/ProfileEvent parity)."""
+
+    def __init__(self, name="<unk>:"):
+        self.name = name
+        self._t0 = None
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        if _STATE["running"]:
+            record_op(self.name, time.perf_counter() - self._t0)
+        return False
+
+
+profiler_scope = Scope
+
+if os.environ.get("MXNET_PROFILER_AUTOSTART", "0") == "1":
+    start()
+    atexit.register(dump)

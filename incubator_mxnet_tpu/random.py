@@ -1,0 +1,71 @@
+"""Global RNG state (reference: `python/mxnet/random.py`, per-device
+`RandGenerator` in `include/mxnet/random_generator.h`).
+
+Design: a single global PRNG key split on each draw in eager mode. Inside a
+jit trace (hybridized blocks), a *traced* base key is pushed onto a stack and
+draws fold a call counter into it — so compiled graphs get fresh randomness
+per invocation (the key is an argument of the compiled function, not a baked
+constant).
+"""
+from __future__ import annotations
+
+import threading
+
+__all__ = ["seed", "next_key", "trace_key_scope", "get_state"]
+
+
+class _State(threading.local):
+    def __init__(self):
+        self.key = None
+        self.trace_stack = []  # list of [base_key, counter]
+
+
+_STATE = _State()
+
+
+def _jr():
+    import jax.random as jr
+
+    return jr
+
+
+def seed(seed_state: int):
+    """Seed the global RNG (reference: mx.random.seed)."""
+    _STATE.key = _jr().PRNGKey(int(seed_state))
+    for frame in _STATE.trace_stack:
+        frame[1] = 0
+
+
+def get_state():
+    if _STATE.key is None:
+        _STATE.key = _jr().PRNGKey(0)
+    return _STATE.key
+
+
+def next_key():
+    """A fresh PRNG key: split from global state, or fold-in under tracing."""
+    jr = _jr()
+    if _STATE.trace_stack:
+        frame = _STATE.trace_stack[-1]
+        k = jr.fold_in(frame[0], frame[1])
+        frame[1] += 1
+        return k
+    if _STATE.key is None:
+        _STATE.key = jr.PRNGKey(0)
+    _STATE.key, sub = jr.split(_STATE.key)
+    return sub
+
+
+class trace_key_scope:
+    """Push a traced base key during jit tracing of a hybridized block."""
+
+    def __init__(self, base_key):
+        self._frame = [base_key, 0]
+
+    def __enter__(self):
+        _STATE.trace_stack.append(self._frame)
+        return self
+
+    def __exit__(self, *exc):
+        _STATE.trace_stack.pop()
+        return False

@@ -1,0 +1,206 @@
+"""RecordIO container (reference: `python/mxnet/recordio.py` + dmlc recordio
+`src/io/image_recordio.h`). Pure-python implementation of the same on-disk
+format: [magic u32][cflag:3|len:29 u32][payload][pad to 4B], so record files
+packed by the reference's tools/im2rec are readable byte-compatibly."""
+from __future__ import annotations
+
+import os
+import struct
+
+import numpy as onp
+
+__all__ = ["MXRecordIO", "MXIndexedRecordIO", "IRHeader", "pack", "unpack",
+           "pack_img", "unpack_img", "IndexCreator"]
+
+_MAGIC = 0xCED7230A
+_HEADER_FMT = "IfQQ"  # flag, label, id, id2
+_HEADER_SIZE = struct.calcsize(_HEADER_FMT)
+
+
+class IRHeader:
+    __slots__ = ("flag", "label", "id", "id2")
+
+    def __init__(self, flag, label, id, id2=0):  # noqa: A002
+        self.flag = flag
+        self.label = label
+        self.id = id
+        self.id2 = id2
+
+
+def pack(header, s):
+    """Serialize header + payload into a record string."""
+    label = header.label
+    if isinstance(label, (int, float)):
+        hdr = struct.pack(_HEADER_FMT, 0, float(label), header.id, header.id2)
+        return hdr + s
+    label = onp.asarray(label, dtype=onp.float32)
+    hdr = struct.pack(_HEADER_FMT, label.size, 0.0, header.id, header.id2)
+    return hdr + label.tobytes() + s
+
+
+def unpack(s):
+    flag, label, id_, id2 = struct.unpack(_HEADER_FMT, s[:_HEADER_SIZE])
+    s = s[_HEADER_SIZE:]
+    if flag > 0:
+        label = onp.frombuffer(s[:flag * 4], dtype=onp.float32)
+        s = s[flag * 4:]
+    header = IRHeader(flag, label, id_, id2)
+    return header, s
+
+
+def pack_img(header, img, quality=95, img_fmt=".jpg"):  # noqa: ARG001
+    """Pack a HWC uint8 image. Without OpenCV/PIL the payload is raw .npy."""
+    import io as _io
+
+    buf = _io.BytesIO()
+    onp.save(buf, onp.asarray(img, dtype=onp.uint8))
+    return pack(header, buf.getvalue())
+
+
+def unpack_img(s, iscolor=-1):  # noqa: ARG001
+    import io as _io
+
+    header, payload = unpack(s)
+    if payload[:6] == b"\x93NUMPY":
+        img = onp.load(_io.BytesIO(payload))
+    else:
+        from .image import imdecode
+
+        img = imdecode(payload).asnumpy()
+    return header, img
+
+
+class MXRecordIO:
+    """Sequential reader/writer (reference: recordio.py MXRecordIO)."""
+
+    def __init__(self, uri, flag):
+        self.uri = uri
+        self.flag = flag
+        self.open()
+
+    def open(self):
+        if self.flag == "w":
+            self._fp = open(self.uri, "wb")
+            self.writable = True
+        elif self.flag == "r":
+            self._fp = open(self.uri, "rb")
+            self.writable = False
+        else:
+            raise ValueError("flag must be 'r' or 'w'")
+        self.is_open = True
+
+    def close(self):
+        if self.is_open:
+            self._fp.close()
+            self.is_open = False
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+    def __getstate__(self):
+        d = dict(self.__dict__)
+        d.pop("_fp", None)
+        d["is_open"] = False
+        return d
+
+    def __setstate__(self, d):
+        self.__dict__.update(d)
+        self.open()
+
+    def reset(self):
+        self.close()
+        self.open()
+
+    def tell(self):
+        return self._fp.tell()
+
+    def write(self, buf):
+        assert self.writable
+        self._fp.write(struct.pack("<I", _MAGIC))
+        self._fp.write(struct.pack("<I", len(buf) & ((1 << 29) - 1)))
+        self._fp.write(buf)
+        pad = (4 - len(buf) % 4) % 4
+        if pad:
+            self._fp.write(b"\x00" * pad)
+
+    def read(self):
+        assert not self.writable
+        magic_raw = self._fp.read(4)
+        if len(magic_raw) < 4:
+            return None
+        magic = struct.unpack("<I", magic_raw)[0]
+        if magic != _MAGIC:
+            raise IOError(f"invalid magic {magic:#x} in {self.uri}")
+        lrec = struct.unpack("<I", self._fp.read(4))[0]
+        length = lrec & ((1 << 29) - 1)
+        buf = self._fp.read(length)
+        pad = (4 - length % 4) % 4
+        if pad:
+            self._fp.read(pad)
+        return buf
+
+
+class MXIndexedRecordIO(MXRecordIO):
+    """Random-access reader via .idx file (reference: MXIndexedRecordIO)."""
+
+    def __init__(self, idx_path, uri, flag):
+        self.idx_path = idx_path
+        self.idx = {}
+        self.keys = []
+        super().__init__(uri, flag)
+        if not self.writable and os.path.isfile(idx_path):
+            with open(idx_path) as f:
+                for line in f:
+                    parts = line.strip().split("\t")
+                    if len(parts) >= 2:
+                        key = int(parts[0])
+                        self.idx[key] = int(parts[1])
+                        self.keys.append(key)
+
+    def close(self):
+        if self.writable and getattr(self, "is_open", False):
+            with open(self.idx_path, "w") as f:
+                for k in self.keys:
+                    f.write(f"{k}\t{self.idx[k]}\n")
+        super().close()
+
+    def seek(self, idx):
+        self._fp.seek(self.idx[idx])
+
+    def read_idx(self, idx):
+        self.seek(idx)
+        return self.read()
+
+    def write_idx(self, idx, buf):
+        pos = self.tell()
+        self.write(buf)
+        self.idx[idx] = pos
+        self.keys.append(idx)
+
+
+class IndexCreator:
+    """Build a .idx for an existing .rec (reference: tools/rec2idx)."""
+
+    def __init__(self, uri, idx_path):
+        self.reader = MXRecordIO(uri, "r")
+        self.idx_path = idx_path
+
+    def create_index(self):
+        entries = []
+        i = 0
+        while True:
+            pos = self.reader.tell()
+            buf = self.reader.read()
+            if buf is None:
+                break
+            entries.append((i, pos))
+            i += 1
+        with open(self.idx_path, "w") as f:
+            for k, pos in entries:
+                f.write(f"{k}\t{pos}\n")
+
+    def close(self):
+        self.reader.close()

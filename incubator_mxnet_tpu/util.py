@@ -1,0 +1,74 @@
+"""Utility functions (reference: `python/mxnet/util.py` — np-shape/np-array
+global switches; always-on here since the framework is numpy-native)."""
+from __future__ import annotations
+
+import functools
+
+__all__ = ["is_np_array", "is_np_shape", "set_np", "reset_np", "use_np",
+           "np_shape", "np_array", "getenv", "setenv", "default_array"]
+
+
+def is_np_array():
+    return True
+
+
+def is_np_shape():
+    return True
+
+
+def set_np(shape=True, array=True, dtype=False):  # noqa: ARG001
+    return True
+
+
+def reset_np():
+    return True
+
+
+def use_np(func):
+    """Decorator parity: numpy semantics are always on."""
+    if isinstance(func, type):
+        return func
+
+    @functools.wraps(func)
+    def wrapper(*args, **kwargs):
+        return func(*args, **kwargs)
+
+    return wrapper
+
+
+class _AlwaysOnScope:
+    def __init__(self, active=True):
+        self._active = active
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+def np_shape(active=True):
+    return _AlwaysOnScope(active)
+
+
+def np_array(active=True):
+    return _AlwaysOnScope(active)
+
+
+def getenv(name):
+    import os
+
+    v = os.environ.get(name)
+    return None if v is None else v
+
+
+def setenv(name, value):
+    import os
+
+    os.environ[name] = str(value)
+
+
+def default_array(source_array, ctx=None, dtype=None):
+    from .ndarray.ndarray import NDArray
+
+    return NDArray(source_array, device=ctx, dtype=dtype)

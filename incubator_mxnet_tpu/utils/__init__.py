@@ -1,0 +1,2 @@
+"""Utility subpackage: trace context, download helpers, misc."""
+from .trace import TraceContext, is_tracing, register_aux_update  # noqa: F401

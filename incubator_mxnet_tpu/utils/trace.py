@@ -1,0 +1,55 @@
+"""Trace context: functionalizes in-place aux-state updates under jit.
+
+The reference marks ops that mutate inputs with FMutateInputs
+(`include/mxnet/op_attr_types.h`) — e.g. BatchNorm's running mean/var — and
+the dependency engine serializes those writes. Under jax tracing a side
+effect would be silently dropped, so ops that update auxiliary state call
+`register_aux_update(arr, new_value)`:
+
+- eager: the array's buffer is rebound immediately (versioned mutation);
+- tracing (inside a CachedOp/jit build): the update is recorded in the
+  active TraceContext; the CachedOp returns the new values as extra outputs
+  and writes them back after each compiled call.
+"""
+from __future__ import annotations
+
+import threading
+
+
+class _TLS(threading.local):
+    def __init__(self):
+        self.stack = []
+
+
+_STATE = _TLS()
+
+
+class TraceContext:
+    """Collects functionalized aux-state updates during a jit trace."""
+
+    def __init__(self):
+        # id(arr) -> (arr, traced_new_value); insertion-ordered
+        self.updates = {}
+
+    def __enter__(self):
+        _STATE.stack.append(self)
+        return self
+
+    def __exit__(self, *exc):
+        _STATE.stack.pop()
+        return False
+
+
+def is_tracing() -> bool:
+    return bool(_STATE.stack)
+
+
+def register_aux_update(arr, new_value) -> None:
+    if _STATE.stack:
+        _STATE.stack[-1].updates[id(arr)] = (arr, new_value)
+    else:
+        arr._set_data(new_value)
+
+
+def current_trace() -> TraceContext | None:
+    return _STATE.stack[-1] if _STATE.stack else None

@@ -1,0 +1,39 @@
+"""Test config: force a virtual 8-device CPU platform BEFORE jax initializes
+(the reference's analogue: CPU is the reference implementation, SURVEY.md §4),
+and wait for async work between modules (reference: conftest.py:61
+`mx.nd.waitall()` between modules to catch async leakage)."""
+import os
+
+# The host sitecustomize pins JAX_PLATFORMS to the TPU plugin; tests run on a
+# virtual 8-device CPU platform, so override through every channel jax reads.
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["JAX_PLATFORM_NAME"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags +
+                               " --xla_force_host_platform_device_count=8")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True, scope="module")
+def waitall_between_modules():
+    yield
+    import incubator_mxnet_tpu as mx
+
+    mx.waitall()
+
+
+@pytest.fixture(autouse=True)
+def seed_rng():
+    import numpy as onp
+
+    import incubator_mxnet_tpu as mx
+
+    onp.random.seed(0)
+    mx.random.seed(0)
+    yield

@@ -1,0 +1,168 @@
+"""Autograd tests (modeled on tests/python/unittest/test_autograd.py)."""
+import numpy as onp
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import autograd, np
+from incubator_mxnet_tpu.test_utils import assert_almost_equal, check_numeric_gradient
+
+
+def test_record_flags():
+    assert not autograd.is_recording()
+    assert not autograd.is_training()
+    with autograd.record():
+        assert autograd.is_recording()
+        assert autograd.is_training()
+        with autograd.pause():
+            assert not autograd.is_recording()
+        assert autograd.is_recording()
+    assert not autograd.is_recording()
+    with autograd.record(train_mode=False):
+        assert autograd.is_recording()
+        assert not autograd.is_training()
+
+
+def test_simple_backward():
+    x = np.array([1.0, 2.0, 3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = (x * x).sum()
+    y.backward()
+    assert_almost_equal(x.grad.asnumpy(), 2 * onp.array([1.0, 2.0, 3.0]))
+
+
+def test_chain_backward():
+    x = np.array([0.5, -1.0])
+    x.attach_grad()
+    with autograd.record():
+        y = np.exp(x)
+        z = y * y
+        w = z.sum()
+    w.backward()
+    assert_almost_equal(x.grad.asnumpy(), 2 * onp.exp(2 * onp.array([0.5, -1.0])),
+                        rtol=1e-5)
+
+
+def test_branching_accumulation():
+    x = np.array([2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * 3 + x * x  # two paths into x
+    y.backward()
+    assert_almost_equal(x.grad.asnumpy(), onp.array([3 + 2 * 2.0]))
+
+
+def test_grad_req_add():
+    x = np.array([1.0, 2.0])
+    x.attach_grad(grad_req="add")
+    for _ in range(3):
+        with autograd.record():
+            y = (x * x).sum()
+        y.backward()
+    assert_almost_equal(x.grad.asnumpy(), 3 * 2 * onp.array([1.0, 2.0]))
+
+
+def test_grad_req_null():
+    x = np.array([1.0])
+    x.attach_grad(grad_req="null")
+    with autograd.record():
+        y = x * 2
+    y.backward()
+    assert_almost_equal(x.grad.asnumpy(), onp.zeros(1))
+
+
+def test_head_grads():
+    x = np.array([1.0, 2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * 3
+    y.backward(np.array([10.0, 100.0]))
+    assert_almost_equal(x.grad.asnumpy(), onp.array([30.0, 300.0]))
+
+
+def test_detach():
+    x = np.array([2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x
+        z = y.detach() * x
+    z.backward()
+    # z = const(4) * x → dz/dx = 4
+    assert_almost_equal(x.grad.asnumpy(), onp.array([4.0]))
+
+
+def test_multi_output_ops():
+    x = np.arange(6.0).reshape(2, 3)
+    x.attach_grad()
+    with autograd.record():
+        a, b = np.split(x, 2, axis=0) if hasattr(np, "split") else x.split(2)
+        y = (a * 2).sum() + (b * 3).sum()
+    y.backward()
+    expected = onp.concatenate([onp.full((1, 3), 2.0), onp.full((1, 3), 3.0)])
+    assert_almost_equal(x.grad.asnumpy(), expected)
+
+
+def test_backward_through_mutation():
+    x = np.array([1.0, 2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * 2
+        y += 1  # in-place on recorded array
+        z = (y * y).sum()
+    z.backward()
+    # z = (2x+1)^2 → dz/dx = 2(2x+1)*2
+    assert_almost_equal(x.grad.asnumpy(), 4 * (2 * onp.array([1.0, 2.0]) + 1))
+
+
+def test_autograd_grad_api():
+    x = np.array([3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x * x
+    (g,) = autograd.grad([y], [x])
+    assert_almost_equal(g.asnumpy(), onp.array([27.0]))
+
+
+def test_higher_order_grad():
+    x = np.array([2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x * x
+        (g,) = autograd.grad([y], [x], create_graph=True)
+        z = g.sum()
+    z.backward()
+    # d2/dx2 x^3 = 6x
+    assert_almost_equal(x.grad.asnumpy(), onp.array([12.0]), rtol=1e-5)
+
+
+def test_custom_function():
+    class Square(autograd.Function):
+        def forward(self, x):
+            self.save_for_backward(x)
+            return x * x
+
+        def backward(self, dy):
+            (x,) = self.saved_tensors
+            return 2 * x * dy
+
+    x = np.array([3.0, 4.0])
+    x.attach_grad()
+    sq = Square()
+    with autograd.record():
+        y = sq(x)
+        z = y.sum()
+    z.backward()
+    assert_almost_equal(x.grad.asnumpy(), 2 * onp.array([3.0, 4.0]))
+
+
+def test_numeric_gradient():
+    check_numeric_gradient(lambda x: (x * x + 3 * x).sum(),
+                           [np.array([0.3, -0.4, 0.9])])
+
+
+def test_no_record_no_grad():
+    x = np.array([1.0])
+    x.attach_grad()
+    y = x * 5  # not recorded
+    with pytest.raises(ValueError):
+        y.backward()  # nothing on tape

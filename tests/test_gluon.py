@@ -1,0 +1,264 @@
+"""Gluon tests (modeled on tests/python/unittest/test_gluon.py)."""
+import numpy as onp
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import autograd, gluon, np, npx
+from incubator_mxnet_tpu.gluon import nn
+from incubator_mxnet_tpu.test_utils import assert_almost_equal
+
+
+def test_parameter():
+    p = gluon.Parameter(shape=(5, 4))
+    p.initialize(init="xavier")
+    assert p.data().shape == (5, 4)
+    assert p.grad().shape == (5, 4)
+    p.zero_grad()
+    assert p.grad().asnumpy().sum() == 0
+
+
+def test_parameter_deferred_init():
+    dense = nn.Dense(8)
+    dense.initialize()
+    x = np.ones((2, 3))
+    out = dense(x)
+    assert out.shape == (2, 8)
+    assert dense.weight.shape == (8, 3)
+
+
+def test_dense_forward():
+    dense = nn.Dense(4, in_units=3, use_bias=True)
+    dense.initialize(init="ones")
+    # weight all ones, bias zero
+    out = dense(np.ones((2, 3)))
+    assert_almost_equal(out.asnumpy(), onp.full((2, 4), 3.0))
+
+
+def test_sequential_and_collect_params():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, activation="relu"), nn.Dense(8))
+    net.initialize()
+    _ = net(np.ones((2, 4)))
+    params = net.collect_params()
+    assert len(params) == 4
+    names = set(params)
+    assert any("weight" in n for n in names)
+
+
+def test_block_save_load_parameters(tmp_path):
+    net = nn.HybridSequential()
+    net.add(nn.Dense(6, in_units=4), nn.Dense(2, in_units=6))
+    net.initialize()
+    fname = str(tmp_path / "net.params")
+    net.save_parameters(fname)
+
+    net2 = nn.HybridSequential()
+    net2.add(nn.Dense(6, in_units=4), nn.Dense(2, in_units=6))
+    net2.initialize()
+    net2.load_parameters(fname)
+    x = np.ones((1, 4))
+    assert_almost_equal(net(x).asnumpy(), net2(x).asnumpy())
+
+
+def test_batchnorm_running_stats():
+    bn = nn.BatchNorm(in_channels=3)
+    bn.initialize()
+    x = np.array(onp.random.RandomState(0).uniform(1, 2, (4, 3, 5, 5))
+                 .astype("float32"))
+    rm0 = bn.running_mean.data().asnumpy().copy()
+    with autograd.record():
+        y = bn(x)
+    # training mode: running stats must move toward the batch mean
+    rm1 = bn.running_mean.data().asnumpy()
+    assert not onp.allclose(rm0, rm1)
+    # inference mode: uses running stats, output differs from training out
+    y2 = bn(x)
+    assert y.shape == y2.shape
+
+
+def test_batchnorm_hybridized_updates_stats():
+    bn = nn.BatchNorm(in_channels=3)
+    bn.initialize()
+    bn.hybridize()
+    x = np.array(onp.random.RandomState(0).uniform(1, 2, (4, 3, 5, 5))
+                 .astype("float32"))
+    with autograd.record():
+        _ = bn(x)
+    rm1 = bn.running_mean.data().asnumpy().copy()
+    with autograd.record():
+        _ = bn(x)
+    rm2 = bn.running_mean.data().asnumpy()
+    assert not onp.allclose(rm1, rm2), "aux update lost under jit"
+
+
+def test_conv2d():
+    conv = nn.Conv2D(8, kernel_size=3, padding=1, in_channels=3)
+    conv.initialize()
+    out = conv(np.ones((2, 3, 16, 16)))
+    assert out.shape == (2, 8, 16, 16)
+    # stride
+    conv2 = nn.Conv2D(4, kernel_size=3, strides=2)
+    conv2.initialize()
+    out2 = conv2(np.ones((2, 3, 16, 16)))
+    assert out2.shape == (2, 4, 7, 7)
+
+
+def test_pooling():
+    x = np.ones((2, 3, 8, 8))
+    assert nn.MaxPool2D(2, 2)(x).shape == (2, 3, 4, 4)
+    assert nn.AvgPool2D(2, 2)(x).shape == (2, 3, 4, 4)
+    assert nn.GlobalAvgPool2D()(x).shape == (2, 3, 1, 1)
+    out = nn.GlobalMaxPool2D()(x)
+    assert out.shape == (2, 3, 1, 1)
+
+
+def test_dropout_modes():
+    do = nn.Dropout(0.5)
+    x = np.ones((100, 100))
+    y_eval = do(x)
+    assert_almost_equal(y_eval.asnumpy(), x.asnumpy())  # identity in inference
+    with autograd.record():
+        y_train = do(x)
+    frac_zero = float((y_train.asnumpy() == 0).mean())
+    assert 0.3 < frac_zero < 0.7
+
+
+def test_embedding():
+    emb = nn.Embedding(10, 4)
+    emb.initialize()
+    idx = np.array([[1, 2], [3, 4]], dtype="int32")
+    out = emb(idx)
+    assert out.shape == (2, 2, 4)
+    # gradient flows into the rows used
+    with autograd.record():
+        loss = emb(idx).sum()
+    loss.backward()
+    g = emb.weight.grad().asnumpy()
+    assert g[1].sum() != 0 and g[0].sum() == 0
+
+
+def test_layernorm():
+    ln = nn.LayerNorm(in_channels=8)
+    ln.initialize()
+    x = np.array(onp.random.RandomState(0).normal(3, 2, (4, 8)).astype("float32"))
+    y = ln(x).asnumpy()
+    assert abs(y.mean()) < 1e-5
+    assert abs(y.std() - 1.0) < 1e-1
+
+
+def test_hybridize_consistency():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, activation="relu"), nn.Dense(4))
+    net.initialize()
+    x = np.array(onp.random.RandomState(0).uniform(-1, 1, (2, 8))
+                 .astype("float32"))
+    eager = net(x).asnumpy()
+    net.hybridize()
+    hybrid1 = net(x).asnumpy()   # first call (warmup/eager)
+    hybrid2 = net(x).asnumpy()   # compiled path
+    assert_almost_equal(eager, hybrid1, rtol=1e-5, atol=1e-6)
+    assert_almost_equal(eager, hybrid2, rtol=1e-5, atol=1e-6)
+
+
+def test_hybridized_training_matches_eager():
+    def make_net():
+        net = nn.HybridSequential()
+        net.add(nn.Dense(16, activation="tanh"), nn.Dense(2))
+        return net
+
+    X = np.array(onp.random.RandomState(0).uniform(-1, 1, (8, 4))
+                 .astype("float32"))
+    Y = np.array(onp.random.RandomState(1).randint(0, 2, (8,)))
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    results = []
+    for hybridize in (False, True):
+        mx.random.seed(42)
+        net = make_net()
+        net.initialize()
+        _ = net(X)
+        if hybridize:
+            net.hybridize()
+        trainer = gluon.Trainer(net.collect_params(), "sgd",
+                                {"learning_rate": 0.5})
+        for _ in range(5):
+            with autograd.record():
+                loss = loss_fn(net(X), Y)
+            loss.backward()
+            trainer.step(8)
+        results.append(float(loss.mean().item()))
+    assert abs(results[0] - results[1]) < 1e-4
+
+
+def test_trainer_learns():
+    net = nn.Dense(1, in_units=2)
+    net.initialize()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.5})
+    X = np.array(onp.random.RandomState(0).uniform(-1, 1, (64, 2))
+                 .astype("float32"))
+    true_w = onp.array([[2.0, -3.0]], dtype="float32")
+    Y = np.array(X.asnumpy() @ true_w.T)
+    loss_fn = gluon.loss.L2Loss()
+    for _ in range(100):
+        with autograd.record():
+            loss = loss_fn(net(X), Y)
+        loss.backward()
+        trainer.step(64)
+    assert_almost_equal(net.weight.data().asnumpy(), true_w, rtol=1e-2,
+                        atol=1e-2)
+
+
+def test_losses():
+    pred = np.array([[1.0, 2.0], [3.0, 4.0]])
+    label = np.array([[1.5, 2.5], [2.0, 3.0]])
+    l2 = gluon.loss.L2Loss()(pred, label)
+    assert_almost_equal(l2.asnumpy(),
+                        ((onp.array([[1, 2], [3, 4.0]])
+                          - onp.array([[1.5, 2.5], [2, 3.0]])) ** 2 / 2)
+                        .mean(axis=1))
+    l1 = gluon.loss.L1Loss()(pred, label)
+    assert l1.shape == (2,)
+    sce = gluon.loss.SoftmaxCrossEntropyLoss()
+    out = sce(np.array([[10.0, 0.0], [0.0, 10.0]]), np.array([0, 1]))
+    assert float(out.mean().item()) < 0.01
+    bce = gluon.loss.SigmoidBinaryCrossEntropyLoss()
+    out = bce(np.array([10.0, -10.0]), np.array([1.0, 0.0]))
+    assert float(out.mean().item()) < 0.01
+    huber = gluon.loss.HuberLoss()(pred, label)
+    assert huber.shape == (2,)
+    kl = gluon.loss.KLDivLoss()
+    p = npx.log_softmax(np.array([[1.0, 2.0, 3.0]]))
+    q = npx.softmax(np.array([[1.0, 2.0, 3.0]]))
+    assert abs(float(kl(p, q).item())) < 1e-6
+
+
+def test_metrics():
+    acc = gluon.metric.Accuracy()
+    acc.update(np.array([1, 0]), np.array([[0.1, 0.9], [0.8, 0.2]]))
+    assert acc.get()[1] == 1.0
+    acc.update(np.array([0]), np.array([[0.1, 0.9]]))
+    assert acc.get()[1] == 2 / 3
+    mae = gluon.metric.MAE()
+    mae.update(np.array([1.0, 2.0]), np.array([1.5, 2.5]))
+    assert abs(mae.get()[1] - 0.5) < 1e-6
+    comp = gluon.metric.create(["accuracy", "mae"])
+    assert isinstance(comp, gluon.metric.CompositeEvalMetric)
+
+
+def test_constant_param():
+    c = gluon.Constant(np.array([1.0, 2.0]))
+    c.initialize()
+    assert_almost_equal(c.data().asnumpy(), onp.array([1.0, 2.0]))
+
+
+def test_model_export(tmp_path):
+    net = nn.HybridSequential()
+    net.add(nn.Dense(4, in_units=3))
+    net.initialize()
+    net.hybridize()
+    _ = net(np.ones((1, 3)))
+    sym_file, param_file = net.export(str(tmp_path / "model"))
+    import os
+
+    assert os.path.exists(sym_file)
+    assert os.path.exists(param_file)

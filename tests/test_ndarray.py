@@ -1,0 +1,162 @@
+"""NDArray semantics tests (modeled on the reference's
+tests/python/unittest/test_ndarray.py)."""
+import numpy as onp
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import np
+from incubator_mxnet_tpu.test_utils import assert_almost_equal
+
+
+def test_creation():
+    a = np.zeros((3, 4))
+    assert a.shape == (3, 4)
+    assert a.dtype == onp.float32
+    b = np.ones((2,), dtype="int32")
+    assert b.dtype == onp.int32
+    c = np.array([[1, 2], [3, 4]], dtype="float64")
+    assert c.shape == (2, 2)
+    d = np.full((2, 2), 7.0)
+    assert d.asnumpy().tolist() == [[7.0, 7.0], [7.0, 7.0]]
+    e = np.arange(10)
+    assert e.size == 10
+    f = np.eye(3)
+    assert f.asnumpy()[1, 1] == 1.0
+
+
+def test_arithmetic():
+    a = np.array([[1.0, 2.0], [3.0, 4.0]])
+    b = np.array([[5.0, 6.0], [7.0, 8.0]])
+    assert_almost_equal((a + b).asnumpy(), onp.array([[6, 8], [10, 12]]))
+    assert_almost_equal((a - b).asnumpy(), onp.array([[-4, -4], [-4, -4]]))
+    assert_almost_equal((a * b).asnumpy(), onp.array([[5, 12], [21, 32]]))
+    assert_almost_equal((b / a).asnumpy(), onp.array([[5, 3], [7 / 3, 2]]),
+                        rtol=1e-6)
+    assert_almost_equal((a ** 2).asnumpy(), onp.array([[1, 4], [9, 16]]))
+    assert_almost_equal((2 + a).asnumpy(), onp.array([[3, 4], [5, 6]]))
+    assert_almost_equal((2 - a).asnumpy(), onp.array([[1, 0], [-1, -2]]))
+    assert_almost_equal((-a).asnumpy(), -onp.array([[1.0, 2], [3, 4]]))
+    assert_almost_equal((a @ b).asnumpy(),
+                        onp.array([[1.0, 2], [3, 4]]) @ onp.array([[5.0, 6], [7, 8]]))
+
+
+def test_inplace_mutation_versioning():
+    a = np.array([1.0, 2.0, 3.0])
+    v0 = a.version
+    a += 1
+    assert a.version > v0
+    assert_almost_equal(a.asnumpy(), onp.array([2.0, 3.0, 4.0]))
+    a *= 2
+    assert_almost_equal(a.asnumpy(), onp.array([4.0, 6.0, 8.0]))
+    a[1] = 100.0
+    assert_almost_equal(a.asnumpy(), onp.array([4.0, 100.0, 8.0]))
+    a[:] = 0.0
+    assert_almost_equal(a.asnumpy(), onp.zeros(3))
+
+
+def test_indexing():
+    a = np.arange(24).reshape(2, 3, 4)
+    assert a[1, 2, 3].item() == 23
+    assert a[0].shape == (3, 4)
+    assert a[:, 1].shape == (2, 4)
+    assert a[..., -1].shape == (2, 3)
+    assert a[0, ::2].shape == (2, 4)
+    # boolean mask
+    b = np.array([1.0, -2.0, 3.0])
+    assert (b[b > 0]).shape == (2,)
+    # integer array indexing
+    idx = np.array([0, 2], dtype="int32")
+    assert_almost_equal(b[idx].asnumpy(), onp.array([1.0, 3.0]))
+
+
+def test_reshape_transpose():
+    a = np.arange(12).reshape(3, 4)
+    assert a.T.shape == (4, 3)
+    assert a.reshape(2, 6).shape == (2, 6)
+    assert a.reshape(-1).shape == (12,)
+    assert a.transpose(1, 0).shape == (4, 3)
+    assert a.flatten().shape == (3, 4)
+    assert np.expand_dims(a, 0).shape == (1, 3, 4)
+    assert a.squeeze().shape == (3, 4)
+
+
+def test_reductions():
+    a = np.array([[1.0, 2.0], [3.0, 4.0]])
+    assert a.sum().item() == 10.0
+    assert a.mean().item() == 2.5
+    assert a.max().item() == 4.0
+    assert a.min().item() == 1.0
+    assert_almost_equal(a.sum(axis=0).asnumpy(), onp.array([4.0, 6.0]))
+    assert a.sum(axis=1, keepdims=True).shape == (2, 1)
+    assert a.argmax().item() == 3
+    assert a.prod().item() == 24.0
+
+
+def test_astype_copy():
+    a = np.array([1.5, 2.5])
+    b = a.astype("int32")
+    assert b.dtype == onp.int32
+    c = a.copy()
+    c += 1
+    assert a.asnumpy()[0] == 1.5
+    d = a.astype("float16")
+    assert d.dtype == onp.float16
+
+
+def test_conversion_protocols():
+    a = np.array([[1.0, 2.0]])
+    assert isinstance(a.asnumpy(), onp.ndarray)
+    assert a.tolist() == [[1.0, 2.0]]
+    s = np.array([3.5])
+    assert float(s) == 3.5
+    assert s.asscalar() == 3.5
+    with pytest.raises(ValueError):
+        a.asscalar()
+    assert len(a) == 1
+    assert onp.asarray(a).shape == (1, 2)
+
+
+def test_wait_and_async():
+    a = np.ones((64, 64))
+    for _ in range(10):
+        a = a @ a * 0.01
+    a.wait_to_read()  # must not raise
+    mx.waitall()
+
+
+def test_save_load(tmp_path):
+    fname = str(tmp_path / "arrays.params")
+    a = np.array([1.0, 2.0])
+    b = np.arange(6).reshape(2, 3)
+    mx.nd.save(fname, {"a": a, "b": b})
+    loaded = mx.nd.load(fname)
+    assert set(loaded) == {"a", "b"}
+    assert_almost_equal(loaded["a"].asnumpy(), a.asnumpy())
+    fname2 = str(tmp_path / "list.params")
+    mx.nd.save(fname2, [a, b])
+    lst = mx.nd.load(fname2)
+    assert len(lst) == 2
+    assert_almost_equal(lst[1].asnumpy(), b.asnumpy())
+
+
+def test_device_placement():
+    a = np.ones((2, 2), device=mx.cpu())
+    assert a.device.device_type == "cpu"
+    b = a.to_device(mx.cpu(0))
+    assert b.shape == (2, 2)
+
+
+def test_comparisons():
+    a = np.array([1.0, 2.0, 3.0])
+    b = np.array([2.0, 2.0, 2.0])
+    assert (a == b).asnumpy().tolist() == [False, True, False]
+    assert (a < b).asnumpy().tolist() == [True, False, False]
+    assert (a >= 2).asnumpy().tolist() == [False, True, True]
+
+
+def test_legacy_nd_namespace():
+    a = mx.nd.zeros((2, 2))
+    assert a.shape == (2, 2)
+    b = mx.nd.dot(np.ones((2, 3)), np.ones((3, 4)))
+    assert b.shape == (2, 4)
+    assert_almost_equal(b.asnumpy(), onp.full((2, 4), 3.0))

@@ -1,0 +1,240 @@
+"""Operator correctness against NumPy golden values (modeled on the
+reference's test_numpy_op.py / test_operator.py pattern, SURVEY.md §4)."""
+import numpy as onp
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import np, npx
+from incubator_mxnet_tpu.test_utils import assert_almost_equal
+
+
+def _rand(*shape):
+    return onp.random.RandomState(sum(shape) + 7).uniform(-2, 2, shape) \
+        .astype("float32")
+
+
+@pytest.mark.parametrize("name", [
+    "exp", "log1p", "sqrt", "sin", "cos", "tanh", "abs", "sign", "floor",
+    "ceil", "square",
+])
+def test_unary_vs_numpy(name):
+    x = onp.abs(_rand(3, 4)) + 0.5 if name in ("log1p", "sqrt") else _rand(3, 4)
+    got = getattr(np, name)(np.array(x)).asnumpy()
+    want = getattr(onp, name)(x)
+    assert_almost_equal(got, want, rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize("name", ["add", "subtract", "multiply", "maximum",
+                                  "minimum", "arctan2", "hypot"])
+def test_binary_vs_numpy(name):
+    a, b = _rand(3, 4), _rand(3, 4)
+    got = getattr(np, name)(np.array(a), np.array(b)).asnumpy()
+    assert_almost_equal(got, getattr(onp, name)(a, b), rtol=1e-5, atol=1e-6)
+
+
+def test_broadcasting():
+    a, b = _rand(3, 1, 4), _rand(2, 1)
+    got = (np.array(a) * np.array(b)).asnumpy()
+    assert_almost_equal(got, a * b, rtol=1e-6)
+
+
+@pytest.mark.parametrize("red,kwargs", [
+    ("sum", {}), ("mean", {}), ("max", {}), ("min", {}), ("prod", {}),
+    ("std", {}), ("var", {}), ("sum", {"axis": 1}), ("mean", {"axis": 0}),
+    ("sum", {"axis": (0, 2), "keepdims": True}),
+])
+def test_reductions_vs_numpy(red, kwargs):
+    x = _rand(2, 3, 4)
+    got = getattr(np, red)(np.array(x), **kwargs).asnumpy()
+    want = getattr(onp, red)(x, **kwargs)
+    assert_almost_equal(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_matmul_einsum_tensordot():
+    a, b = _rand(3, 4), _rand(4, 5)
+    assert_almost_equal(np.matmul(np.array(a), np.array(b)).asnumpy(), a @ b,
+                        rtol=1e-5)
+    assert_almost_equal(np.dot(np.array(a), np.array(b)).asnumpy(), a @ b,
+                        rtol=1e-5)
+    got = np.einsum("ij,jk->ik", np.array(a), np.array(b)).asnumpy()
+    assert_almost_equal(got, a @ b, rtol=1e-5)
+    t = np.tensordot(np.array(a), np.array(b), axes=1).asnumpy()
+    assert_almost_equal(t, a @ b, rtol=1e-5)
+
+
+def test_concat_stack_split():
+    a, b = _rand(2, 3), _rand(2, 3)
+    c = np.concatenate([np.array(a), np.array(b)], axis=0)
+    assert_almost_equal(c.asnumpy(), onp.concatenate([a, b], axis=0))
+    s = np.stack([np.array(a), np.array(b)], axis=1)
+    assert s.shape == (2, 2, 3)
+    parts = np.split(np.array(a), 3, axis=1)
+    assert len(parts) == 3 and parts[0].shape == (2, 1)
+
+
+def test_sort_argsort_topk():
+    x = _rand(4, 6)
+    assert_almost_equal(np.sort(np.array(x), axis=1).asnumpy(),
+                        onp.sort(x, axis=1))
+    assert (np.argsort(np.array(x), axis=1).asnumpy()
+            == onp.argsort(x, axis=1)).all()
+    vals = npx.topk(np.array(x), k=2, ret_typ="value", axis=1).asnumpy()
+    want = onp.sort(x, axis=1)[:, -2:][:, ::-1]
+    assert_almost_equal(vals, want)
+
+
+def test_where_clip_round():
+    x = _rand(3, 3)
+    got = np.where(np.array(x) > 0, np.array(x), np.zeros((3, 3))).asnumpy()
+    assert_almost_equal(got, onp.where(x > 0, x, 0))
+    assert_almost_equal(np.clip(np.array(x), -1, 1).asnumpy(),
+                        onp.clip(x, -1, 1))
+
+
+def test_linalg():
+    a = _rand(4, 4)
+    spd = a @ a.T + 4 * onp.eye(4, dtype="float32")
+    assert_almost_equal(np.linalg.inv(np.array(spd)).asnumpy(),
+                        onp.linalg.inv(spd), rtol=1e-3, atol=1e-4)
+    l = np.linalg.cholesky(np.array(spd)).asnumpy()
+    assert_almost_equal(l @ l.T, spd, rtol=1e-4, atol=1e-4)
+    assert_almost_equal(np.linalg.norm(np.array(a)).asnumpy(),
+                        onp.linalg.norm(a), rtol=1e-5)
+    det = np.linalg.det(np.array(spd)).asnumpy()
+    assert_almost_equal(det, onp.linalg.det(spd), rtol=1e-3)
+    q, r = np.linalg.qr(np.array(a))
+    assert_almost_equal((q @ r).asnumpy(), a, rtol=1e-4, atol=1e-5)
+
+
+def test_npx_softmax_family():
+    x = _rand(3, 5)
+    got = npx.softmax(np.array(x), axis=-1).asnumpy()
+    e = onp.exp(x - x.max(-1, keepdims=True))
+    want = e / e.sum(-1, keepdims=True)
+    assert_almost_equal(got, want, rtol=1e-5, atol=1e-6)
+    got_log = npx.log_softmax(np.array(x), axis=-1).asnumpy()
+    assert_almost_equal(got_log, onp.log(want), rtol=1e-4, atol=1e-5)
+    # masked softmax zeros masked positions
+    mask = onp.array([[1, 1, 0, 0, 0]] * 3, dtype="bool")
+    got_m = npx.masked_softmax(np.array(x), np.array(mask)).asnumpy()
+    assert (got_m[:, 2:] == 0).all()
+    assert_almost_equal(got_m.sum(-1), onp.ones(3), rtol=1e-5)
+
+
+def test_npx_one_hot_pick():
+    idx = np.array([0, 2, 1], dtype="int32")
+    oh = npx.one_hot(idx, 4).asnumpy()
+    assert oh.shape == (3, 4)
+    assert (oh.argmax(-1) == onp.array([0, 2, 1])).all()
+    x = _rand(3, 4)
+    picked = npx.pick(np.array(x), np.array([1, 2, 3]), axis=1).asnumpy()
+    assert_almost_equal(picked, x[onp.arange(3), [1, 2, 3]])
+
+
+def test_npx_fully_connected():
+    x, w, b = _rand(2, 5), _rand(3, 5), _rand(3)
+    got = npx.fully_connected(np.array(x), np.array(w), np.array(b),
+                              num_hidden=3).asnumpy()
+    assert_almost_equal(got, x @ w.T + b, rtol=1e-5)
+
+
+def test_npx_convolution_vs_manual():
+    x = _rand(1, 1, 5, 5)
+    w = _rand(1, 1, 3, 3)
+    got = npx.convolution(np.array(x), np.array(w), None, kernel=(3, 3),
+                          num_filter=1, no_bias=True).asnumpy()
+    # manual valid conv
+    want = onp.zeros((1, 1, 3, 3), dtype="float32")
+    for i in range(3):
+        for j in range(3):
+            want[0, 0, i, j] = (x[0, 0, i:i + 3, j:j + 3] * w[0, 0]).sum()
+    assert_almost_equal(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_npx_batch_dot():
+    a, b = _rand(4, 2, 3), _rand(4, 3, 5)
+    got = npx.batch_dot(np.array(a), np.array(b)).asnumpy()
+    assert_almost_equal(got, onp.einsum("bij,bjk->bik", a, b), rtol=1e-5)
+
+
+def test_npx_sequence_mask():
+    x = np.ones((4, 2, 3))  # (T, N, ...)
+    out = npx.sequence_mask(x, sequence_length=np.array([2, 4]),
+                            use_sequence_length=True, value=-1.0).asnumpy()
+    assert (out[:2, 0] == 1).all()
+    assert (out[2:, 0] == -1).all()
+    assert (out[:, 1] == 1).all()
+
+
+def test_npx_rnn_shapes():
+    T, N, C, H = 5, 3, 4, 6
+    x = np.array(_rand(T, N, C))
+    for mode, nst in [("lstm", 2), ("gru", 1), ("rnn_tanh", 1)]:
+        psize = npx.rnn_param_size(mode, 2, C, H, bidirectional=False)
+        params = np.array(_rand(psize))
+        h0 = np.zeros((2, N, H))
+        c0 = np.zeros((2, N, H)) if mode == "lstm" else None
+        out = npx.rnn(data=x, parameters=params, state=h0, state_cell=c0,
+                      mode=mode, state_size=H, num_layers=2,
+                      state_outputs=True)
+        assert out[0].shape == (T, N, H)
+        assert out[1].shape == (2, N, H)
+        if mode == "lstm":
+            assert out[2].shape == (2, N, H)
+
+
+def test_npx_reshape_magic():
+    x = np.ones((2, 3, 4, 5))
+    assert npx.reshape(x, (-2,)).shape == (2, 3, 4, 5)
+    assert npx.reshape(x, (0, -3, 0)).shape == (2, 12, 5)
+    assert npx.reshape(x, (-1,)).shape == (120,)
+    assert npx.reshape(x, (0, 0, -5)).shape == (2, 3, 20)
+
+
+def test_npx_gather_scatter():
+    x = np.array(_rand(3, 4))
+    idx = np.array([[0, 2], [1, 3]], dtype="int32")
+    got = npx.gather_nd(x, idx).asnumpy()
+    assert_almost_equal(got, x.asnumpy()[[0, 2], [1, 3]])
+
+
+def test_random_ops():
+    mx.random.seed(7)
+    u = np.random.uniform(0, 1, size=(1000,))
+    assert 0.4 < float(u.mean().item()) < 0.6
+    n = np.random.normal(0, 1, size=(1000,))
+    assert abs(float(n.mean().item())) < 0.15
+    r = np.random.randint(0, 10, size=(100,))
+    assert int(r.min().item()) >= 0 and int(r.max().item()) < 10
+    # determinism under fixed seed
+    mx.random.seed(42)
+    a = np.random.uniform(size=(5,)).asnumpy()
+    mx.random.seed(42)
+    b = np.random.uniform(size=(5,)).asnumpy()
+    assert_almost_equal(a, b)
+
+
+def test_control_flow():
+    data = np.array(_rand(4, 3))
+
+    def body(x, states):
+        return x * 2, [states[0] + x.sum()]
+
+    outs, states = npx.foreach(body, data, [np.zeros(())])
+    assert outs.shape == (4, 3)
+    assert_almost_equal(states[0].asnumpy(),
+                        data.asnumpy().sum(), rtol=1e-5)
+
+    def cond(i, total):
+        return i < 5
+
+    def func(i, total):
+        return None, (i + 1, total + i)
+
+    _, (i, total) = npx.while_loop(cond, func, (np.array(0), np.array(0)),
+                                   max_iterations=10)
+    assert int(i.item()) == 5
+    assert int(total.item()) == 10
+
+    out = npx.cond(np.array(True), lambda: np.ones(2), lambda: np.zeros(2))
+    assert out.asnumpy().sum() == 2

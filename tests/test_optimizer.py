@@ -1,0 +1,119 @@
+"""Optimizer tests (modeled on tests/python/unittest/test_optimizer.py)."""
+import numpy as onp
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import np, optimizer as opt
+from incubator_mxnet_tpu.ndarray.ndarray import NDArray
+from incubator_mxnet_tpu.test_utils import assert_almost_equal
+
+ALL_OPTS = ["sgd", "nag", "adam", "adamw", "adabelief", "adadelta", "adagrad",
+            "adamax", "dcasgd", "ftml", "ftrl", "lamb", "lans", "lars",
+            "nadam", "rmsprop", "sgld", "signum"]
+
+
+@pytest.mark.parametrize("name", ALL_OPTS)
+def test_optimizer_decreases_quadratic(name):
+    """Every optimizer must make progress on f(w) = ||w - w*||^2."""
+    mx.random.seed(0)
+    target = onp.array([1.0, -2.0, 3.0], dtype="float32")
+    w = NDArray(onp.zeros(3, dtype="float32"))
+    o = opt.create(name)
+    state = o.create_state(0, w)
+    f0 = float(((w.asnumpy() - target) ** 2).sum())
+    for _ in range(200):
+        g = NDArray(2 * (w.asnumpy() - target))
+        o.update(0, w, g, state)
+    f1 = float(((w.asnumpy() - target) ** 2).sum())
+    assert f1 < f0, f"{name}: {f0} -> {f1}"
+
+
+def test_sgd_momentum_math():
+    w = NDArray(onp.array([1.0], dtype="float32"))
+    g = NDArray(onp.array([0.5], dtype="float32"))
+    o = opt.SGD(learning_rate=0.1, momentum=0.9)
+    state = o.create_state(0, w)
+    o.update(0, w, g, state)
+    # mom = -lr*g = -0.05 ; w = 1 - 0.05
+    assert_almost_equal(w.asnumpy(), onp.array([0.95]), rtol=1e-6)
+    o.update(0, w, g, state)
+    # mom = 0.9*(-0.05) - 0.05 = -0.095 ; w = 0.95 - 0.095
+    assert_almost_equal(w.asnumpy(), onp.array([0.855]), rtol=1e-6)
+
+
+def test_adam_first_step_is_lr():
+    w = NDArray(onp.array([0.0], dtype="float32"))
+    g = NDArray(onp.array([10.0], dtype="float32"))
+    o = opt.Adam(learning_rate=0.001)
+    state = o.create_state(0, w)
+    o.update(0, w, g, state)
+    # adam's first step magnitude ≈ lr regardless of grad scale
+    assert abs(abs(float(w.asnumpy()[0])) - 0.001) < 1e-4
+
+
+def test_rescale_and_clip():
+    w = NDArray(onp.array([0.0], dtype="float32"))
+    g = NDArray(onp.array([100.0], dtype="float32"))
+    o = opt.SGD(learning_rate=1.0, rescale_grad=0.01)
+    o.update(0, w, g, o.create_state(0, w))
+    assert_almost_equal(w.asnumpy(), onp.array([-1.0]), rtol=1e-6)
+    w2 = NDArray(onp.array([0.0], dtype="float32"))
+    o2 = opt.SGD(learning_rate=1.0, clip_gradient=0.1)
+    o2.update(0, w2, NDArray(onp.array([100.0], dtype="float32")),
+              o2.create_state(0, w2))
+    assert_almost_equal(w2.asnumpy(), onp.array([-0.1]), rtol=1e-6)
+
+
+def test_weight_decay():
+    w = NDArray(onp.array([1.0], dtype="float32"))
+    g = NDArray(onp.array([0.0], dtype="float32"))
+    o = opt.SGD(learning_rate=0.1, wd=0.1)
+    o.update(0, w, g, o.create_state(0, w))
+    assert_almost_equal(w.asnumpy(), onp.array([0.99]), rtol=1e-6)
+
+
+def test_lr_scheduler():
+    sched = opt.FactorScheduler(step=10, factor=0.5, base_lr=1.0)
+    o = opt.SGD(lr_scheduler=sched, learning_rate=1.0)
+    assert o.learning_rate == 1.0
+    o.num_update = 15
+    assert o.learning_rate == 0.5
+    cos = opt.CosineScheduler(max_update=100, base_lr=1.0, final_lr=0.0)
+    assert abs(cos(0) - 1.0) < 1e-6
+    assert abs(cos(100)) < 1e-6
+    assert 0.4 < cos(50) < 0.6
+    multi = opt.MultiFactorScheduler(step=[5, 10], factor=0.1, base_lr=1.0)
+    assert abs(multi(1) - 1.0) < 1e-9
+    assert abs(multi(7) - 0.1) < 1e-9
+    assert abs(multi(12) - 0.01) < 1e-9
+
+
+def test_updater_states_roundtrip():
+    o = opt.Adam()
+    u = opt.get_updater(o)
+    w = NDArray(onp.ones(4, dtype="float32"))
+    g = NDArray(onp.full(4, 0.1, dtype="float32"))
+    u(0, g, w)
+    u(0, g, w)
+    blob = u.get_states()
+    u2 = opt.get_updater(opt.Adam())
+    u2.set_states(blob)
+    assert 0 in u2.states
+
+
+def test_trainer_save_load_states(tmp_path):
+    from incubator_mxnet_tpu import autograd, gluon
+
+    net = gluon.nn.Dense(2, in_units=3)
+    net.initialize()
+    tr = gluon.Trainer(net.collect_params(), "adam", {"learning_rate": 0.01})
+    X = np.ones((4, 3))
+    with autograd.record():
+        loss = net(X).sum()
+    loss.backward()
+    tr.step(4)
+    fname = str(tmp_path / "trainer.states")
+    tr.save_states(fname)
+    tr2 = gluon.Trainer(net.collect_params(), "adam", {"learning_rate": 0.01})
+    tr2.load_states(fname)
+    assert tr2._states_initialized[0]
