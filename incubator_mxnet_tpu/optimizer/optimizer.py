@@ -9,8 +9,6 @@ trigger recompilation.
 """
 from __future__ import annotations
 
-import math
-
 import numpy as onp
 
 from ..ndarray.ndarray import NDArray
@@ -236,7 +234,8 @@ class Adam(Optimizer):
         m, v = state
         m = self.beta1 * m + (1 - self.beta1) * g
         v = self.beta2 * v + (1 - self.beta2) * g * g
-        lr_t = lr * math.sqrt(1 - self.beta2 ** t) / (1 - self.beta1 ** t)
+        # jnp (not math) so t may be a tracer (DataParallel passes it traced)
+        lr_t = lr * jnp.sqrt(1 - self.beta2 ** t) / (1 - self.beta1 ** t)
         return w - lr_t * m / (jnp.sqrt(v) + self.epsilon), [m, v]
 
 
@@ -264,7 +263,7 @@ class AdaBelief(Adam):
         m, s = state
         m = self.beta1 * m + (1 - self.beta1) * g
         s = self.beta2 * s + (1 - self.beta2) * (g - m) ** 2 + self.epsilon
-        lr_t = lr * math.sqrt(1 - self.beta2 ** t) / (1 - self.beta1 ** t)
+        lr_t = lr * jnp.sqrt(1 - self.beta2 ** t) / (1 - self.beta1 ** t)
         return w - lr_t * m / (jnp.sqrt(s) + self.epsilon), [m, s]
 
 
@@ -467,16 +466,26 @@ class Nadam(Adam):
         self.schedule_decay = schedule_decay
         self.m_schedule = 1.0
 
+    def _mu(self, i):
+        return self.beta1 * (1 - 0.5 * 0.96 ** (i * self.schedule_decay))
+
     def step(self, w, g, state, lr, wd, t):
+        import jax
+
         jnp = _jnp()
         g, wd = self._preprocess(g, w, wd)
         g = g + wd * w
         m, v = state
-        momentum_t = self.beta1 * (1 - 0.5 * 0.96 ** (t * self.schedule_decay))
-        momentum_t1 = self.beta1 * (1 - 0.5 * 0.96 ** ((t + 1) * self.schedule_decay))
-        self.m_schedule = self.m_schedule * momentum_t
-        m_schedule_next = self.m_schedule * momentum_t1
-        ghat = g / (1 - self.m_schedule)
+        momentum_t = self._mu(t)
+        momentum_t1 = self._mu(t + 1)
+        # m_schedule(t) = prod_{i<=t} mu_i, computed as a pure function of t
+        # (stateful accumulation on self would leak tracers under jit and
+        # double-count when step() runs once per parameter).
+        m_schedule = jax.lax.fori_loop(
+            1, t + 1, lambda i, acc: acc * self._mu(i),
+            jnp.asarray(1.0, dtype=w.dtype))
+        m_schedule_next = m_schedule * momentum_t1
+        ghat = g / (1 - m_schedule)
         m = self.beta1 * m + (1 - self.beta1) * g
         v = self.beta2 * v + (1 - self.beta2) * g * g
         mhat = m / (1 - m_schedule_next)
@@ -536,7 +545,7 @@ class SGLD(Optimizer):
 
         g, wd = self._preprocess(g, w, wd)
         g = g + wd * w
-        noise = jr.normal(next_key(), w.shape, w.dtype) * math.sqrt(lr)
+        noise = jr.normal(next_key(), w.shape, w.dtype) * _jnp().sqrt(lr)
         return w - lr / 2 * g + noise, state
 
 

@@ -34,6 +34,9 @@ def _build_pure_step(net, loss_fn, optimizer):
               if p.grad_req == "null"]
     param_arrays = [p.data() for p in params]
     frozen_arrays = [p.data() for p in frozen]
+    # Identities of the aux arrays whose functionalized updates the traced
+    # step returns; populated at trace time (jit re-traces set it again).
+    aux_arrays_cell: list = []
 
     def forward_loss(param_vals, frozen_vals, key, x, y):
         saved = [(a, a._data) for a in param_arrays + frozen_arrays]
@@ -49,22 +52,22 @@ def _build_pure_step(net, loss_fn, optimizer):
         finally:
             for a, v in saved:
                 a._data = v
-        aux_new = tuple(nv for _, nv in tc.updates.values())
+        aux_pairs = list(tc.updates.values())
+        aux_arrays_cell[:] = [a for a, _ in aux_pairs]
+        aux_new = tuple(nv for _, nv in aux_pairs)
         return loss.mean()._data, aux_new
 
-    def step(param_vals, frozen_vals, opt_states, t, key, x, y):
+    def step(param_vals, frozen_vals, opt_states, t, lr, wd, key, x, y):
         (loss, aux_new), grads = jax.value_and_grad(
             forward_loss, has_aux=True)(param_vals, frozen_vals, key, x, y)
         new_params, new_states = [], []
-        lr = optimizer.learning_rate
-        wd = optimizer.wd
         for w, g, s in zip(param_vals, grads, opt_states):
             nw, ns = optimizer.step(w, g, s, lr, wd, t)
             new_params.append(nw)
             new_states.append(ns)
         return loss, new_params, new_states, aux_new
 
-    return step, params, param_arrays, frozen_arrays
+    return step, params, param_arrays, frozen_arrays, aux_arrays_cell
 
 
 class DataParallel:
@@ -84,11 +87,12 @@ class DataParallel:
         self.optimizer = optimizer
         self.mesh = mesh
         self._t = 0
-        step, params, param_arrays, frozen_arrays = _build_pure_step(
-            net, loss_fn, optimizer)
+        (step, params, param_arrays, frozen_arrays,
+         aux_arrays_cell) = _build_pure_step(net, loss_fn, optimizer)
         self.params = params
         self.param_arrays = param_arrays
         self.frozen_arrays = frozen_arrays
+        self._aux_arrays_cell = aux_arrays_cell
         self.opt_states = [optimizer.create_state(i, a)
                            for i, a in enumerate(param_arrays)]
 
@@ -104,7 +108,7 @@ class DataParallel:
             self._jit = jax.jit(
                 step,
                 in_shardings=(param_sh, [repl] * len(frozen_arrays), None,
-                              None, repl, batch_sh, batch_sh),
+                              None, None, None, repl, batch_sh, batch_sh),
                 out_shardings=None)
             self._batch_sharding = batch_sh
         else:
@@ -115,14 +119,23 @@ class DataParallel:
         from ..random import next_key
 
         self._t += 1
+        # Mirror Trainer semantics: lr/wd are re-evaluated every update (the
+        # scheduler sees the bumped num_update) and enter the compiled step
+        # as traced scalars, so set_learning_rate/lr_scheduler take effect
+        # without retracing.
+        self.optimizer.num_update += 1
+        lr = float(self.optimizer.learning_rate)
+        wd = float(self.optimizer.wd)
         xv = x._data if isinstance(x, NDArray) else x
         yv = y._data if isinstance(y, NDArray) else y
         param_vals = [a._data for a in self.param_arrays]
         frozen_vals = [a._data for a in self.frozen_arrays]
         loss, new_params, new_states, aux_new = self._jit(
-            param_vals, frozen_vals, self.opt_states, self._t, next_key(),
-            xv, yv)
+            param_vals, frozen_vals, self.opt_states, self._t, lr, wd,
+            next_key(), xv, yv)
         for a, nv in zip(self.param_arrays, new_params):
+            a._set_data(nv)
+        for a, nv in zip(self._aux_arrays_cell, aux_new):
             a._set_data(nv)
         self.opt_states = new_states
         return NDArray(loss)
