@@ -16,6 +16,17 @@ from __future__ import annotations
 
 __version__ = "0.1.0"
 
+# Multi-process rendezvous must happen before anything touches the XLA
+# backend; join from env at import time when a coordinator is configured
+# (the reference's analogue: ps-lite rendezvous from DMLC_* env on
+# `mx.kv.create('dist_*')`, SURVEY.md §3.5).
+import os as _os
+
+if _os.environ.get("COORDINATOR_ADDRESS") or _os.environ.get("DMLC_PS_ROOT_URI"):
+    from .parallel import dist as _dist
+
+    _dist.initialize()
+
 from . import base  # noqa: F401
 from .base import MXNetError  # noqa: F401
 from .device import (  # noqa: F401

@@ -66,16 +66,26 @@ class _SingleProcessStore(KVStoreBase):
         return results if isinstance(key, (list, tuple)) else results[0]
 
     def pushpull(self, key, value, out=None, priority=0):
-        """Allreduce: the fused push+pull path (reference: kvstore.h:58)."""
-        keys = key if isinstance(key, (list, tuple)) else [key]
-        values = value if isinstance(value, (list, tuple)) else [value]
-        outs = out if isinstance(out, (list, tuple)) else [out]
-        for k, v, o in zip(keys, values, outs):  # noqa: B007
-            red = self._reduce(v)
-            if o is not None:
-                o._set_data(red._data)
-            elif isinstance(v, NDArray):
-                v._set_data(red._data)
+        """Allreduce: the fused push+pull path (reference: kvstore.h:58).
+
+        For a single key, `value` may be a LIST of per-device gradient
+        copies (the reference's `CommDevice::Reduce` input shape,
+        `src/kvstore/comm.h:482`): they are summed, then the result is
+        written to every entry of `out`."""
+        if not isinstance(key, (list, tuple)):
+            key, value, out = [key], [value], [out]
+        for k, v, o in zip(key, value, out):  # noqa: B007
+            vs = v if isinstance(v, (list, tuple)) else [v]
+            agg = vs[0]
+            for extra in vs[1:]:
+                agg = agg + extra
+            red = self._reduce(agg)
+            targets = o if isinstance(o, (list, tuple)) else [o]
+            for t in targets:
+                if t is not None:
+                    t._set_data(red._data)
+            if all(t is None for t in targets) and isinstance(vs[0], NDArray):
+                vs[0]._set_data(red._data)
 
     def broadcast(self, key, value, out=None, priority=0):  # noqa: ARG002
         self.init(key, value)
@@ -123,14 +133,12 @@ class KVStoreDevice(_SingleProcessStore):
     with psum (ICI); identity when no mesh is active."""
 
     def _reduce(self, value):
-        from ..parallel.mesh import current_mesh
-
-        mesh = current_mesh()
-        if mesh is None or not isinstance(value, NDArray):
-            return value
-        # data-parallel gradients inside shard_map are reduced by the train
-        # step itself; out-of-step reduction applies mean over devices holding
-        # replicas. A single logical array is already globally consistent.
+        # A single logical jax array is already globally consistent across
+        # the mesh (sharded train steps psum gradients in-program; a
+        # replicated array has identical values on every device), so
+        # single-array reduce is the identity BY DESIGN. Aggregation of
+        # per-device gradient COPIES — the reference's CommDevice role —
+        # happens in push/pushpull over list-valued inputs.
         return value
 
 
@@ -138,30 +146,50 @@ class KVStoreDevice(_SingleProcessStore):
 class KVStoreDist(_SingleProcessStore):
     """type='dist*' — multi-host data parallel over DCN.
 
-    Requires `jax.distributed.initialize` (driven by `tools/launch.py`-style
-    env: COORDINATOR_ADDRESS, PROCESS_ID, NUM_PROCESSES). Reduction happens
-    inside the pjit'ed train step over the mesh's data axis; this facade
-    carries rank/num_workers bookkeeping and optimizer state."""
+    Joins the jax multi-process runtime on construction (rendezvous driven
+    by `tools/launch.py`-style env: COORDINATOR_ADDRESS, PROCESS_ID,
+    NUM_PROCESSES — or the reference's DMLC_* names). `pushpull`/`push`
+    REALLY reduce across processes with an XLA collective over the global
+    device mesh (the ps-lite ZPush/ZPull replacement,
+    `src/kvstore/kvstore_dist.h:266`); `init`/`broadcast` ship rank 0's
+    value to everyone (the server broadcast role,
+    `kvstore_dist_server.h:157`). 'dist_async' degrades to synchronous —
+    collectives have no async-PS analogue (documented divergence)."""
 
     def __init__(self):
         super().__init__()
-        import jax
+        from ..parallel import dist
 
-        self._rank = getattr(jax, "process_index", lambda: 0)()
-        self._num = getattr(jax, "process_count", lambda: 1)()
+        dist.initialize()
+        self._dist = dist
 
     @property
     def rank(self):
-        return self._rank
+        return self._dist.rank()
 
     @property
     def num_workers(self):
-        return self._num
+        return self._dist.num_processes()
+
+    def _reduce(self, value):
+        if self._dist.num_processes() == 1 or not isinstance(value, NDArray):
+            return value
+        return NDArray(self._dist.allreduce(value._data, op="sum"))
+
+    def init(self, key, value):
+        keys = key if isinstance(key, (list, tuple)) else [key]
+        values = value if isinstance(value, (list, tuple)) else [value]
+        for k, v in zip(keys, values):
+            arr = v if isinstance(v, NDArray) else NDArray(v)
+            if self._dist.num_processes() > 1:
+                arr = NDArray(self._dist.broadcast(arr._data, root=0))
+            self._store[k] = arr.copy()
 
     def barrier(self):
         from ..ndarray.ndarray import waitall
 
         waitall()
+        self._dist.barrier()
 
 
 KVStore = KVStoreLocal

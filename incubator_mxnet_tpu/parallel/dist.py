@@ -1,0 +1,160 @@
+"""Multi-host (multi-process) collectives over DCN.
+
+The reference's distributed backend is ps-lite: a scheduler plus server and
+worker processes wired by `tools/launch.py` env vars (`DMLC_ROLE`,
+`DMLC_PS_ROOT_URI`, ... — `src/kvstore/kvstore_dist.h:266`,
+`kvstore_dist_server.h:157`). The TPU-native replacement is the jax
+multi-process runtime: `jax.distributed.initialize` is the rendezvous
+(≈ scheduler), and reductions are XLA collectives over the global device
+mesh (ICI within a slice, DCN/gloo across hosts) — there are no server
+processes because allreduce subsumes the push/pull round trip.
+
+`allreduce` here is the facade used by `KVStoreDist` for arrays that live
+outside a pjit'ed train step: each process contributes its host-local
+value as one shard of a global array along a 'host' axis, and a tiny jit
+program sums over that axis with replicated output.
+"""
+from __future__ import annotations
+
+import os
+
+__all__ = ["initialize", "is_initialized", "rank", "num_processes",
+           "allreduce", "broadcast", "barrier"]
+
+_STATE = {"initialized": False, "mesh": None, "reducers": {}}
+
+
+def _env(*names, default=None):
+    for n in names:
+        v = os.environ.get(n)
+        if v is not None:
+            return v
+    return default
+
+
+def initialize(coordinator_address=None, num_processes=None, process_id=None):
+    """Join the multi-process runtime (idempotent).
+
+    Env fallbacks accept both jax-style names (what `tools/launch.py` sets)
+    and the reference's DMLC names so launch scripts written for the
+    reference keep working: COORDINATOR_ADDRESS | DMLC_PS_ROOT_URI:PORT,
+    NUM_PROCESSES | DMLC_NUM_WORKER, PROCESS_ID | DMLC_RANK.
+    """
+    if _STATE["initialized"]:
+        return
+    coordinator_address = coordinator_address or _env("COORDINATOR_ADDRESS")
+    if coordinator_address is None:
+        uri = _env("DMLC_PS_ROOT_URI")
+        port = _env("DMLC_PS_ROOT_PORT", default="9000")
+        if uri is not None:
+            coordinator_address = f"{uri}:{port}"
+    if num_processes is None:
+        v = _env("NUM_PROCESSES", "DMLC_NUM_WORKER")
+        num_processes = int(v) if v is not None else None
+    if process_id is None:
+        v = _env("PROCESS_ID", "DMLC_RANK")
+        process_id = int(v) if v is not None else None
+    if coordinator_address is None:
+        return  # single-process: nothing to join
+    import jax
+
+    try:
+        jax.distributed.initialize(coordinator_address=coordinator_address,
+                                   num_processes=num_processes,
+                                   process_id=process_id)
+    except RuntimeError:
+        # backend already up: either distributed was initialized earlier
+        # (fine) or jax was touched single-process first (stay local)
+        if jax.process_count() <= 1:
+            return
+    _STATE["initialized"] = True
+
+
+def is_initialized():
+    return _STATE["initialized"]
+
+
+def rank():
+    import jax
+
+    return jax.process_index()
+
+
+def num_processes():
+    import jax
+
+    return jax.process_count()
+
+
+def _host_mesh():
+    """Global 1-axis-per-scope mesh: ('host', 'local') over every device."""
+    if _STATE["mesh"] is None:
+        import jax
+        import numpy as onp
+
+        devs = onp.array(jax.devices()).reshape(jax.process_count(), -1)
+        _STATE["mesh"] = jax.sharding.Mesh(devs, ("host", "local"))
+    return _STATE["mesh"]
+
+
+def _reducer(op):
+    if op not in _STATE["reducers"]:
+        import jax
+
+        mesh = _host_mesh()
+        repl = jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec())
+        fn = {"sum": lambda x: x.sum(axis=0),
+              "max": lambda x: x.max(axis=0)}[op]
+        _STATE["reducers"][op] = jax.jit(fn, out_shardings=repl)
+    return _STATE["reducers"][op]
+
+
+def allreduce(x, op="sum"):
+    """Reduce a host-local array across all processes; every process gets
+    the full result. Single-process: returns x unchanged."""
+    import jax
+    import jax.numpy as jnp
+
+    if jax.process_count() == 1:
+        return jnp.asarray(x)
+    mesh = _host_mesh()
+    P = jax.sharding.PartitionSpec
+    sh = jax.sharding.NamedSharding(mesh, P(("host", "local")))
+    x = jnp.asarray(x)
+    local = jax.local_devices()
+    if op in ("sum", "mean"):
+        # the host's value rides on local device 0; zeros elsewhere, so the
+        # row-sum counts each host exactly once (dtype-preserving)
+        zero = jnp.zeros_like(x)[None]
+        shards = [jax.device_put(x[None] if i == 0 else zero, d)
+                  for i, d in enumerate(local)]
+        red = "sum"
+    else:
+        shards = [jax.device_put(x[None], d) for d in local]
+        red = op
+    ga = jax.make_array_from_single_device_arrays(
+        (jax.device_count(),) + x.shape, sh, shards)
+    out = _reducer(red)(ga)
+    out = jnp.asarray(out.addressable_data(0))
+    if op == "mean":
+        out = out / jax.process_count()
+    return out
+
+
+def broadcast(x, root=0):
+    """Send root's host-local array to every process."""
+    import jax
+    import jax.numpy as jnp
+
+    if jax.process_count() == 1:
+        return jnp.asarray(x)
+    x = jnp.asarray(x)
+    contrib = x if jax.process_index() == root else jnp.zeros_like(x)
+    return allreduce(contrib, op="sum")
+
+
+def barrier(tag="barrier"):
+    import jax
+
+    if jax.process_count() > 1:
+        allreduce(jax.numpy.zeros((1,), "float32")).block_until_ready()

@@ -1,0 +1,68 @@
+"""Multi-process distributed kvstore test (reference:
+`tests/nightly/dist_sync_kvstore.py` run via `tools/launch.py --launcher
+local` — asserts EXACT aggregated values across worker processes).
+
+Here: tools/launch.py forks 2 CPU processes that join jax.distributed and
+allreduce through KVStoreDist; each asserts the exact cross-process sums.
+"""
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+WORKER = textwrap.dedent("""
+    import os
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["JAX_PLATFORM_NAME"] = "cpu"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as onp
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import np
+
+    kv = mx.kv.create("dist_sync")
+    rank, n = kv.rank, kv.num_workers
+    assert n == 2, n
+
+    # pushpull: exact sum of per-rank values
+    g = np.full((4,), float(rank + 1))
+    out = np.zeros((4,))
+    kv.pushpull("grad", g, out=out)
+    onp.testing.assert_allclose(out.asnumpy(), onp.full(4, 3.0))
+
+    # init broadcasts rank 0's value
+    init_val = np.full((3,), 7.0) if rank == 0 else np.full((3,), -1.0)
+    kv.init("w", init_val)
+    pulled = np.zeros((3,))
+    kv.pull("w", out=pulled)
+    onp.testing.assert_allclose(pulled.asnumpy(), onp.full(3, 7.0))
+
+    # push applies the cross-process-summed gradient through the updater
+    kv.set_optimizer(mx.optimizer.SGD(learning_rate=0.1))
+    kv.push("w", np.full((3,), float(rank + 1)))  # summed grad = 3
+    kv.pull("w", out=pulled)
+    onp.testing.assert_allclose(pulled.asnumpy(), onp.full(3, 7.0 - 0.3),
+                                rtol=1e-6)
+    kv.barrier()
+    print(f"worker {rank} ok", flush=True)
+""")
+
+
+def test_dist_sync_kvstore_two_processes(tmp_path):
+    script = tmp_path / "worker.py"
+    script.write_text(WORKER)
+    env = dict(os.environ)
+    # children must NOT inherit the parent's forced 8-device flag config
+    env.pop("XLA_FLAGS", None)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    res = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "launch.py"),
+         "-n", "2", "--port", "19817", sys.executable, str(script)],
+        env=env, cwd=REPO, capture_output=True, text=True, timeout=280)
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "worker 0 ok" in res.stdout
+    assert "worker 1 ok" in res.stdout

@@ -1,0 +1,79 @@
+#!/usr/bin/env python
+"""Multi-process launcher (reference: `tools/launch.py:10-38`, which drives
+dmlc_tracker to set DMLC_ROLE/DMLC_PS_ROOT_URI and exec the user script on
+every node).
+
+TPU-native: there are no server/scheduler roles — every process is a worker
+that joins the jax multi-process runtime. This launcher sets the rendezvous
+env (COORDINATOR_ADDRESS, NUM_PROCESSES, PROCESS_ID) and execs the command
+N times:
+
+- `--launcher local` (default): N processes on this machine, used by the
+  distributed kvstore tests (the analogue of the reference's
+  `tests/nightly/dist_sync_kvstore.py` local runs).
+- `--launcher ssh -H hostfile`: one process per host over ssh (each TPU
+  host in a pod slice runs the same program; jax discovers the global
+  topology at initialize()).
+
+Usage: python tools/launch.py -n 2 [--port 9123] python train.py ...
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import subprocess
+import sys
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("-n", "--num-workers", type=int, required=True)
+    ap.add_argument("--launcher", choices=["local", "ssh"], default="local")
+    ap.add_argument("-H", "--hostfile", default=None)
+    ap.add_argument("--port", type=int, default=9123)
+    ap.add_argument("--env", action="append", default=[],
+                    help="extra KEY=VALUE to pass through")
+    ap.add_argument("command", nargs=argparse.REMAINDER)
+    args = ap.parse_args()
+    if not args.command:
+        ap.error("no command given")
+
+    extra = dict(kv.split("=", 1) for kv in args.env)
+
+    if args.launcher == "local":
+        coordinator = f"127.0.0.1:{args.port}"
+        procs = []
+        for rank in range(args.num_workers):
+            env = dict(os.environ, **extra)
+            env.update(COORDINATOR_ADDRESS=coordinator,
+                       NUM_PROCESSES=str(args.num_workers),
+                       PROCESS_ID=str(rank))
+            procs.append(subprocess.Popen(args.command, env=env))
+        rc = 0
+        for p in procs:
+            rc = p.wait() or rc
+        sys.exit(rc)
+
+    hosts = [h.strip() for h in open(args.hostfile)
+             if h.strip() and not h.startswith("#")]
+    if len(hosts) < args.num_workers:
+        sys.exit(f"hostfile has {len(hosts)} hosts < -n {args.num_workers}")
+    coordinator = f"{hosts[0]}:{args.port}"
+    procs = []
+    for rank in range(args.num_workers):
+        envs = " ".join(
+            [f"COORDINATOR_ADDRESS={coordinator}",
+             f"NUM_PROCESSES={args.num_workers}", f"PROCESS_ID={rank}"]
+            + [f"{k}={v}" for k, v in extra.items()])
+        cmd = " ".join(args.command)
+        procs.append(subprocess.Popen(
+            ["ssh", "-o", "StrictHostKeyChecking=no", hosts[rank],
+             f"cd {os.getcwd()} && {envs} {cmd}"]))
+    rc = 0
+    for p in procs:
+        rc = p.wait() or rc
+    sys.exit(rc)
+
+
+if __name__ == "__main__":
+    main()
