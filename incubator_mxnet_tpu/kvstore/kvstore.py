@@ -74,6 +74,8 @@ class _SingleProcessStore(KVStoreBase):
         written to every entry of `out`."""
         if not isinstance(key, (list, tuple)):
             key, value, out = [key], [value], [out]
+        elif out is None:
+            out = [None] * len(key)
         for k, v, o in zip(key, value, out):  # noqa: B007
             vs = v if isinstance(v, (list, tuple)) else [v]
             agg = vs[0]

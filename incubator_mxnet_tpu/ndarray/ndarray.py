@@ -14,6 +14,9 @@ copy-on-write discipline that replaces kWriteInplace (`op_attr_types.h:45`).
 """
 from __future__ import annotations
 
+import sys
+import time
+
 import numpy as onp
 
 from .. import autograd
@@ -541,6 +544,27 @@ def _unwrap_index(key):
 # Imperative::Invoke → Engine::PushAsync, src/imperative/imperative.cc:105).
 # ---------------------------------------------------------------------------
 
+def _active_profiler():
+    """The profiler module iff it is imported AND running (cheap hot-path
+    check: no import cost when profiling was never enabled)."""
+    mod = sys.modules.get("incubator_mxnet_tpu.profiler")
+    if mod is not None and mod._STATE["running"] \
+            and mod._CONFIG.get("profile_imperative", True):
+        return mod
+    return None
+
+
+def _call_profiled(name, pure_fn, tensor_vals):
+    """Run the funnel body, feeding `profiler.record_op` when profiling."""
+    prof = _active_profiler()
+    if prof is None:
+        return pure_fn(*tensor_vals)
+    t0 = time.perf_counter()
+    outs = pure_fn(*tensor_vals)
+    prof.record_op(name, time.perf_counter() - t0)
+    return outs
+
+
 def apply_op(name, jfn, args, kwargs=None, n_outputs=1, out=None):
     """Execute `jfn` over unwrapped jax values; wrap outputs; record on tape.
 
@@ -548,6 +572,11 @@ def apply_op(name, jfn, args, kwargs=None, n_outputs=1, out=None):
       positions participate in autograd.
     - kwargs: static (non-differentiable) parameters, closed over.
     - n_outputs: number of outputs if jfn returns a tuple.
+
+    When the profiler is running (reference: engine op profiling,
+    `src/engine/threaded_engine.h:356` ExecuteOprBlock wrapping), each funnel
+    call is timed and fed to `profiler.record_op` — dispatch+trace time, since
+    execution itself is async on the device stream.
     """
     kwargs = kwargs or {}
     tensor_idx = [i for i, a in enumerate(args) if isinstance(a, NDArray)]
@@ -561,7 +590,7 @@ def apply_op(name, jfn, args, kwargs=None, n_outputs=1, out=None):
             call[i] = tvals[j]
         return jfn(*call, **kwargs)
 
-    outs = pure_fn(*tensor_vals)
+    outs = _call_profiled(name, pure_fn, tensor_vals)
     tuple_out = isinstance(outs, tuple)
     out_list = list(outs) if tuple_out else [outs]
 
@@ -613,7 +642,7 @@ def apply_op_flat(name, jfn, args, kwargs=None, n_outputs=None):
         outs = jfn(*call, **kwargs)
         return tuple(outs) if isinstance(outs, list) else outs
 
-    outs = pure_fn(*tensor_vals)
+    outs = _call_profiled(name, pure_fn, tensor_vals)
     tuple_out = isinstance(outs, tuple)
     out_list = list(outs) if tuple_out else [outs]
     wrapped = [NDArray(o) for o in out_list]
@@ -677,11 +706,20 @@ def from_jax(value) -> NDArray:
 
 
 def waitall():
-    """Block until all async work completes (reference: Engine::WaitForAll)."""
+    """Block until all async work completes (reference: Engine::WaitForAll,
+    `src/engine/threaded_engine.cc`).
+
+    O(num_devices), not O(live arrays): XLA executes programs in enqueue
+    order per device stream, so dispatching one trivial computation per local
+    device and blocking on it drains everything queued before it."""
     import jax
 
     try:
-        for d in jax.live_arrays():
-            d.block_until_ready()
+        jax.effects_barrier()
     except Exception:
-        (jax.device_put(0.0) + 0).block_until_ready()
+        pass
+    for dev in jax.local_devices():
+        try:
+            (jax.device_put(0.0, dev) + 0).block_until_ready()
+        except Exception:  # device wedged / backend torn down at exit
+            pass

@@ -62,10 +62,20 @@ def initialize(coordinator_address=None, num_processes=None, process_id=None):
         jax.distributed.initialize(coordinator_address=coordinator_address,
                                    num_processes=num_processes,
                                    process_id=process_id)
-    except RuntimeError:
-        # backend already up: either distributed was initialized earlier
-        # (fine) or jax was touched single-process first (stay local)
-        if jax.process_count() <= 1:
+    except RuntimeError as e:
+        # Two recoverable shapes: distributed already initialized (fine), or
+        # jax was touched single-process first while a single process was
+        # requested. Anything else (coordinator unreachable, rendezvous
+        # timeout with peers expected) must FAIL LOUDLY — degrading to
+        # process_count()==1 would silently train with unreduced gradients.
+        if "already initialized" in str(e).lower():
+            pass
+        elif jax.process_count() <= 1:
+            if num_processes and num_processes > 1:
+                raise RuntimeError(
+                    f"jax.distributed.initialize failed with {num_processes} "
+                    f"expected processes (coordinator "
+                    f"{coordinator_address}): {e}") from e
             return
     _STATE["initialized"] = True
 

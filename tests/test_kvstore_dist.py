@@ -52,6 +52,14 @@ WORKER = textwrap.dedent("""
 """)
 
 
+def _free_port():
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
 def test_dist_sync_kvstore_two_processes(tmp_path):
     script = tmp_path / "worker.py"
     script.write_text(WORKER)
@@ -61,7 +69,7 @@ def test_dist_sync_kvstore_two_processes(tmp_path):
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
     res = subprocess.run(
         [sys.executable, os.path.join(REPO, "tools", "launch.py"),
-         "-n", "2", "--port", "19817", sys.executable, str(script)],
+         "-n", "2", "--port", str(_free_port()), sys.executable, str(script)],
         env=env, cwd=REPO, capture_output=True, text=True, timeout=280)
     assert res.returncode == 0, res.stdout + res.stderr
     assert "worker 0 ok" in res.stdout

@@ -1,0 +1,49 @@
+"""Profiler: per-op aggregate stats populated from the apply_op funnel and
+chrome-trace dump (reference: tests/python/unittest/test_profiler.py)."""
+import json
+import os
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import np, profiler
+
+
+def test_record_op_from_funnel(tmp_path):
+    profiler.set_config(filename=str(tmp_path / "profile.json"),
+                        aggregate_stats=True)
+    profiler.set_state("run")
+    try:
+        a = np.array([[1.0, 2.0], [3.0, 4.0]])
+        b = np.array([[5.0, 6.0], [7.0, 8.0]])
+        c = np.dot(a, b)
+        d = c + a
+        d.wait_to_read()
+    finally:
+        profiler.set_state("stop")
+
+    table = profiler.dumps()
+    assert "dot" in table
+    path = profiler.dump()
+    with open(path) as f:
+        payload = json.load(f)
+    names = {e["name"] for e in payload["traceEvents"]}
+    assert any("dot" in n for n in names)
+    assert os.path.exists(path)
+
+
+def test_profiler_off_records_nothing():
+    profiler.dumps(reset=True)
+    a = np.array([1.0, 2.0])
+    (a * 2).wait_to_read()
+    assert profiler.dumps().count("\n") <= 1 or "mul" not in profiler.dumps()
+
+
+def test_scope_records():
+    profiler.set_state("run")
+    try:
+        with profiler.Scope("custom_region"):
+            np.array([1.0]).wait_to_read()
+    finally:
+        profiler.set_state("stop")
+    assert "custom_region" in profiler.dumps()
+    profiler.dumps(reset=True)
+    mx.waitall()

@@ -15,14 +15,37 @@ N times:
   host in a pod slice runs the same program; jax discovers the global
   topology at initialize()).
 
+Fail-fast: if any worker exits non-zero, the remaining workers are killed
+(the reference tracker kills the process group on first failure).
+
 Usage: python tools/launch.py -n 2 [--port 9123] python train.py ...
 """
 from __future__ import annotations
 
 import argparse
 import os
+import shlex
 import subprocess
 import sys
+import time
+
+
+def _wait_fail_fast(procs):
+    """Wait for all procs; on first non-zero exit, terminate the rest."""
+    rc = 0
+    pending = list(procs)
+    while pending:
+        for p in list(pending):
+            code = p.poll()
+            if code is None:
+                continue
+            pending.remove(p)
+            if code != 0:
+                rc = rc or code
+                for q in pending:
+                    q.terminate()
+        time.sleep(0.05)
+    return rc
 
 
 def main():
@@ -49,11 +72,10 @@ def main():
                        NUM_PROCESSES=str(args.num_workers),
                        PROCESS_ID=str(rank))
             procs.append(subprocess.Popen(args.command, env=env))
-        rc = 0
-        for p in procs:
-            rc = p.wait() or rc
-        sys.exit(rc)
+        sys.exit(_wait_fail_fast(procs))
 
+    if args.hostfile is None:
+        ap.error("--launcher ssh requires -H/--hostfile")
     hosts = [h.strip() for h in open(args.hostfile)
              if h.strip() and not h.startswith("#")]
     if len(hosts) < args.num_workers:
@@ -62,17 +84,14 @@ def main():
     procs = []
     for rank in range(args.num_workers):
         envs = " ".join(
-            [f"COORDINATOR_ADDRESS={coordinator}",
+            [f"COORDINATOR_ADDRESS={shlex.quote(coordinator)}",
              f"NUM_PROCESSES={args.num_workers}", f"PROCESS_ID={rank}"]
-            + [f"{k}={v}" for k, v in extra.items()])
-        cmd = " ".join(args.command)
+            + [f"{k}={shlex.quote(v)}" for k, v in extra.items()])
+        cmd = " ".join(shlex.quote(c) for c in args.command)
         procs.append(subprocess.Popen(
             ["ssh", "-o", "StrictHostKeyChecking=no", hosts[rank],
-             f"cd {os.getcwd()} && {envs} {cmd}"]))
-    rc = 0
-    for p in procs:
-        rc = p.wait() or rc
-    sys.exit(rc)
+             f"cd {shlex.quote(os.getcwd())} && {envs} {cmd}"]))
+    sys.exit(_wait_fail_fast(procs))
 
 
 if __name__ == "__main__":
