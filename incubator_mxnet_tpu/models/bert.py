@@ -2,8 +2,14 @@
 GluonNLP built on MXNet base ops (SURVEY.md §2.4 notes the reference itself
 has no attention kernel; its CPU path fuses self-attention via oneDNN
 subgraphs, `src/operator/subgraph/dnnl/dnnl_transformer_qk_property.h`).
-Here attention is a first-class op lowered through XLA (and pallas flash
-attention in `ops/` for long sequences)."""
+
+Here attention is a first-class op: `use_flash=True` (default) routes
+through the pallas flash-attention kernel (`npx.flash_attention` →
+`ops/flash_attention.py`), taking `valid_length` directly instead of a
+dense (T, T) mask; `use_flash=False` keeps the XLA softmax path with
+`npx.masked_softmax`. Note: the flash path applies dropout to the
+attention *output* rather than the probability matrix (documented
+divergence — prob-dropout would break the online softmax recurrence)."""
 from __future__ import annotations
 
 import math
@@ -31,16 +37,27 @@ class MultiHeadAttention(HybridBlock):
                              in_units=units)
         self.dropout = nn.Dropout(dropout) if dropout else None
 
-    def forward(self, x, mask=None):
+    def forward(self, x, mask=None, valid_length=None):
         # x: (N, T, C)
         N, T, C = x.shape
         H = self._num_heads
         d = C // H
         qkv = self.qkv(x)  # (N, T, 3C)
         qkv = qkv.reshape(N, T, 3, H, d)
-        q = qkv[:, :, 0].transpose(0, 2, 1, 3).reshape(N * H, T, d)
-        k = qkv[:, :, 1].transpose(0, 2, 1, 3).reshape(N * H, T, d)
-        v = qkv[:, :, 2].transpose(0, 2, 1, 3).reshape(N * H, T, d)
+        q = qkv[:, :, 0].transpose(0, 2, 1, 3)         # (N, H, T, d)
+        k = qkv[:, :, 1].transpose(0, 2, 1, 3)
+        v = qkv[:, :, 2].transpose(0, 2, 1, 3)
+        if self._use_flash and mask is None:
+            out = npx.flash_attention(q, k, v, valid_length=valid_length)
+            out = out.transpose(0, 2, 1, 3).reshape(N, T, C)
+            if self.dropout is not None:
+                out = self.dropout(out)
+            return self.proj(out)
+        q = q.reshape(N * H, T, d)
+        k = k.reshape(N * H, T, d)
+        v = v.reshape(N * H, T, d)
+        if mask is None and valid_length is not None:
+            mask = _dense_mask_from_valid_length(x, valid_length, H)
         scores = npx.batch_dot(q, k, transpose_b=True) / math.sqrt(d)
         if mask is not None:
             att = npx.masked_softmax(scores, mask)
@@ -51,6 +68,17 @@ class MultiHeadAttention(HybridBlock):
         out = npx.batch_dot(att, v)  # (N*H, T, d)
         out = out.reshape(N, H, T, d).transpose(0, 2, 1, 3).reshape(N, T, C)
         return self.proj(out)
+
+
+def _dense_mask_from_valid_length(x, valid_length, num_heads):
+    """(N*H, T, T) pairwise validity mask from (N,) lengths — the
+    masked_softmax fallback when flash is disabled."""
+    steps = npx.arange_like(x, axis=1)
+    m = (steps.reshape(1, -1, 1)
+         < valid_length.reshape(-1, 1, 1).astype("float32"))
+    m2 = (steps.reshape(1, 1, -1)
+          < valid_length.reshape(-1, 1, 1).astype("float32"))
+    return np.repeat((m * m2).astype("float32"), num_heads, axis=0)
 
 
 class PositionwiseFFN(HybridBlock):
@@ -72,22 +100,23 @@ class TransformerEncoderCell(HybridBlock):
     """Pre-LN transformer block (BERT uses post-LN; configurable)."""
 
     def __init__(self, units, hidden_size, num_heads, dropout=0.0,
-                 pre_norm=False):
+                 pre_norm=False, use_flash=True):
         super().__init__()
         self._pre_norm = pre_norm
-        self.attention = MultiHeadAttention(units, num_heads, dropout)
+        self.attention = MultiHeadAttention(units, num_heads, dropout,
+                                            use_flash=use_flash)
         self.ffn = PositionwiseFFN(units, hidden_size, dropout)
         self.ln1 = nn.LayerNorm(in_channels=units)
         self.ln2 = nn.LayerNorm(in_channels=units)
         self.dropout = nn.Dropout(dropout) if dropout else None
 
-    def forward(self, x, mask=None):
+    def forward(self, x, mask=None, valid_length=None):
         if self._pre_norm:
-            h = self.attention(self.ln1(x), mask)
+            h = self.attention(self.ln1(x), mask, valid_length)
             x = x + (self.dropout(h) if self.dropout else h)
             h = self.ffn(self.ln2(x))
             return x + (self.dropout(h) if self.dropout else h)
-        h = self.attention(x, mask)
+        h = self.attention(x, mask, valid_length)
         x = self.ln1(x + (self.dropout(h) if self.dropout else h))
         h = self.ffn(x)
         return self.ln2(x + (self.dropout(h) if self.dropout else h))
@@ -96,9 +125,10 @@ class TransformerEncoderCell(HybridBlock):
 class BERTEncoder(HybridBlock):
     def __init__(self, vocab_size=30522, units=768, hidden_size=3072,
                  num_layers=12, num_heads=12, max_length=512,
-                 dropout=0.1, type_vocab_size=2):
+                 dropout=0.1, type_vocab_size=2, use_flash=True):
         super().__init__()
         self._units = units
+        self._use_flash = use_flash
         self.word_embed = nn.Embedding(vocab_size, units)
         self.token_type_embed = nn.Embedding(type_vocab_size, units)
         self.position_embed = Parameter(shape=(max_length, units),
@@ -108,7 +138,8 @@ class BERTEncoder(HybridBlock):
         self.layers = nn.HybridSequential()
         for _ in range(num_layers):
             self.layers.add(TransformerEncoderCell(units, hidden_size,
-                                                   num_heads, dropout))
+                                                   num_heads, dropout,
+                                                   use_flash=use_flash))
 
     def forward(self, tokens, token_types=None, valid_length=None):
         N, T = tokens.shape
@@ -119,16 +150,15 @@ class BERTEncoder(HybridBlock):
         x = self.ln(x)
         if self.dropout is not None:
             x = self.dropout(x)
+        if self._use_flash:
+            # flash path: (B,) lengths straight into the kernel, no dense mask
+            for cell in self.layers:
+                x = cell(x, None, valid_length)
+            return x
         mask = None
         if valid_length is not None:
-            steps = npx.arange_like(x, axis=1)
-            m = (steps.reshape(1, -1, 1) <
-                 valid_length.reshape(-1, 1, 1).astype("float32"))
-            m2 = (steps.reshape(1, 1, -1) <
-                  valid_length.reshape(-1, 1, 1).astype("float32"))
-            mask = (m * m2).astype("float32")
             H = self.layers[0].attention._num_heads
-            mask = np.repeat(mask, H, axis=0)
+            mask = _dense_mask_from_valid_length(x, valid_length, H)
         for cell in self.layers:
             x = cell(x, mask)
         return x
@@ -138,10 +168,12 @@ class BERTModel(HybridBlock):
     """Encoder + MLM and NSP heads (pretraining objective, config 3)."""
 
     def __init__(self, vocab_size=30522, units=768, hidden_size=3072,
-                 num_layers=12, num_heads=12, max_length=512, dropout=0.1):
+                 num_layers=12, num_heads=12, max_length=512, dropout=0.1,
+                 use_flash=True):
         super().__init__()
         self.encoder = BERTEncoder(vocab_size, units, hidden_size, num_layers,
-                                   num_heads, max_length, dropout)
+                                   num_heads, max_length, dropout,
+                                   use_flash=use_flash)
         self.mlm_dense = nn.Dense(units, flatten=False, activation="tanh",
                                   in_units=units)
         self.mlm_ln = nn.LayerNorm(in_channels=units)
@@ -168,10 +200,12 @@ class BERTClassifier(HybridBlock):
         return self.classifier(self.dropout(pooled))
 
 
-def bert_base(vocab_size=30522, max_length=512, dropout=0.1):
-    return BERTModel(vocab_size, 768, 3072, 12, 12, max_length, dropout)
+def bert_base(vocab_size=30522, max_length=512, dropout=0.1, use_flash=True):
+    return BERTModel(vocab_size, 768, 3072, 12, 12, max_length, dropout,
+                     use_flash=use_flash)
 
 
-def bert_small(vocab_size=1000, max_length=128, dropout=0.1):
+def bert_small(vocab_size=1000, max_length=128, dropout=0.1, use_flash=True):
     """Tiny config for tests and compile-checks."""
-    return BERTModel(vocab_size, 64, 128, 2, 4, max_length, dropout)
+    return BERTModel(vocab_size, 64, 128, 2, 4, max_length, dropout,
+                     use_flash=use_flash)

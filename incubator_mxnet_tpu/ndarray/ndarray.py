@@ -712,14 +712,17 @@ def waitall():
     O(num_devices), not O(live arrays): XLA executes programs in enqueue
     order per device stream, so dispatching one trivial computation per local
     device and blocking on it drains everything queued before it."""
+    import sys as _sys
+
     import jax
 
     try:
         jax.effects_barrier()
-    except Exception:
-        pass
-    for dev in jax.local_devices():
-        try:
+        for dev in jax.local_devices():
             (jax.device_put(0.0, dev) + 0).block_until_ready()
-        except Exception:  # device wedged / backend torn down at exit
-            pass
+    except Exception:
+        # Reference semantics: WaitForAll RETHROWS async failures
+        # (`src/engine/threaded_engine.cc:529 Throw`). Only swallow during
+        # interpreter teardown, when the backend may already be gone.
+        if not _sys.is_finalizing():
+            raise

@@ -30,7 +30,8 @@ __all__ = [
     "masked_log_softmax", "leaky_relu", "fully_connected", "convolution",
     "deconvolution", "pooling", "batch_norm", "layer_norm", "group_norm",
     "instance_norm", "l2_normalization", "dropout", "embedding", "one_hot",
-    "pick", "topk", "batch_dot", "gather_nd", "scatter_nd", "sequence_mask",
+    "pick", "topk", "batch_dot", "flash_attention", "gather_nd",
+    "scatter_nd", "sequence_mask",
     "sequence_last", "sequence_reverse", "rnn", "erf", "erfinv", "gamma",
     "gammaln", "digamma", "cast", "reshape", "arange_like", "shape_array",
     "stop_gradient", "foreach", "while_loop", "cond", "set_np", "reset_np",
@@ -558,6 +559,28 @@ def topk(data, axis=-1, k=1, ret_typ="indices", is_ascend=False, dtype="float32"
 
     n_outputs = 2 if ret_typ == "both" else 1
     return apply_op("topk", f, (data,), n_outputs=n_outputs)
+
+
+def flash_attention(query, key, value, valid_length=None, causal=False,
+                    sm_scale=None):
+    """Fused memory-linear attention over (B, H, T, D) tensors — the pallas
+    kernel in `ops/flash_attention.py` (reference role:
+    `src/operator/subgraph/dnnl/dnnl_transformer_qk_property.h`).
+
+    `valid_length`: (B,) valid sequence lengths (replaces a dense mask).
+    Differentiable (flash backward kernels via custom_vjp)."""
+    from ..ops.flash_attention import flash_attention as _flash
+
+    if valid_length is None:
+        return apply_op(
+            "flash_attention",
+            lambda q, k, v: _flash(q, k, v, causal=causal, sm_scale=sm_scale),
+            (query, key, value))
+    return apply_op(
+        "flash_attention",
+        lambda q, k, v, vl: _flash(q, k, v, lengths=vl, causal=causal,
+                                   sm_scale=sm_scale),
+        (query, key, value, valid_length))
 
 
 def batch_dot(a, b, transpose_a=False, transpose_b=False, **kwargs):  # noqa: ARG001

@@ -1,0 +1,12 @@
+"""Custom pallas TPU kernels — the perf-critical fused ops.
+
+Reference role: the hand-fused kernels the reference gets from
+oneDNN/cuDNN subgraph properties (`src/operator/subgraph/dnnl/
+dnnl_transformer_qk_property.h`, `dnnl_conv.cc`) and NVRTC pointwise
+fusion (`src/operator/fusion/fused_op.cc`). On TPU, XLA already fuses
+elementwise epilogues; pallas covers what XLA cannot schedule well by
+itself — memory-linear (flash) attention over long sequences.
+"""
+from .flash_attention import flash_attention, mha_flash
+
+__all__ = ["flash_attention", "mha_flash"]

@@ -63,20 +63,23 @@ def initialize(coordinator_address=None, num_processes=None, process_id=None):
                                    num_processes=num_processes,
                                    process_id=process_id)
     except RuntimeError as e:
-        # Two recoverable shapes: distributed already initialized (fine), or
-        # jax was touched single-process first while a single process was
-        # requested. Anything else (coordinator unreachable, rendezvous
-        # timeout with peers expected) must FAIL LOUDLY — degrading to
-        # process_count()==1 would silently train with unreduced gradients.
-        if "already initialized" in str(e).lower():
-            pass
-        elif jax.process_count() <= 1:
-            if num_processes and num_processes > 1:
-                raise RuntimeError(
-                    f"jax.distributed.initialize failed with {num_processes} "
-                    f"expected processes (coordinator "
-                    f"{coordinator_address}): {e}") from e
-            return
+        # Recoverable: the runtime is already up (double-init — jax raises
+        # "...should only be called once", or the backend reports multiple
+        # processes). Anything else (coordinator unreachable, rendezvous
+        # timeout) must FAIL LOUDLY when a coordinator was configured —
+        # degrading to process_count()==1 would silently train with
+        # unreduced gradients. Explicit num_processes==1 is the only
+        # single-process escape hatch.
+        msg = str(e).lower()
+        already_up = ("already" in msg or "only be called once" in msg
+                      or jax.process_count() > 1)
+        if not already_up:
+            if num_processes == 1:
+                return
+            raise RuntimeError(
+                f"jax.distributed.initialize failed (coordinator "
+                f"{coordinator_address}, num_processes={num_processes}): "
+                f"{e}") from e
     _STATE["initialized"] = True
 
 

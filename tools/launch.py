@@ -30,20 +30,37 @@ import sys
 import time
 
 
+def _kill_group(p, sig):
+    try:
+        os.killpg(os.getpgid(p.pid), sig)
+    except (ProcessLookupError, PermissionError, OSError):
+        p.kill() if sig == 9 else p.terminate()
+
+
 def _wait_fail_fast(procs):
-    """Wait for all procs; on first non-zero exit, terminate the rest."""
+    """Wait for all procs; on first non-zero exit, kill the remaining
+    process groups (SIGTERM, then SIGKILL after a grace period — workers
+    blocked in a native rendezvous ignore SIGTERM)."""
+    import signal
+
     rc = 0
     pending = list(procs)
+    deadline = None
     while pending:
         for p in list(pending):
             code = p.poll()
             if code is None:
                 continue
             pending.remove(p)
-            if code != 0:
-                rc = rc or code
+            if code != 0 and rc == 0:
+                rc = code
+                deadline = time.monotonic() + 10.0
                 for q in pending:
-                    q.terminate()
+                    _kill_group(q, signal.SIGTERM)
+        if deadline is not None and time.monotonic() > deadline:
+            for q in pending:
+                _kill_group(q, signal.SIGKILL)
+            deadline = float("inf")
         time.sleep(0.05)
     return rc
 
@@ -71,7 +88,8 @@ def main():
             env.update(COORDINATOR_ADDRESS=coordinator,
                        NUM_PROCESSES=str(args.num_workers),
                        PROCESS_ID=str(rank))
-            procs.append(subprocess.Popen(args.command, env=env))
+            procs.append(subprocess.Popen(args.command, env=env,
+                                          start_new_session=True))
         sys.exit(_wait_fail_fast(procs))
 
     if args.hostfile is None:
@@ -90,7 +108,8 @@ def main():
         cmd = " ".join(shlex.quote(c) for c in args.command)
         procs.append(subprocess.Popen(
             ["ssh", "-o", "StrictHostKeyChecking=no", hosts[rank],
-             f"cd {shlex.quote(os.getcwd())} && {envs} {cmd}"]))
+             f"cd {shlex.quote(os.getcwd())} && {envs} {cmd}"],
+            start_new_session=True))
     sys.exit(_wait_fail_fast(procs))
 
 
