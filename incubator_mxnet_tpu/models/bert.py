@@ -21,7 +21,39 @@ from ..gluon.block import HybridBlock
 from ..gluon.parameter import Parameter
 
 __all__ = ["MultiHeadAttention", "TransformerEncoderCell", "BERTEncoder",
-           "BERTModel", "BERTClassifier", "bert_base", "bert_small"]
+           "BERTModel", "BERTClassifier", "bert_base", "bert_small",
+           "tp_param_shardings"]
+
+
+def tp_param_shardings(net, tp_axis="tp"):
+    """Megatron-style tensor-parallel PartitionSpecs for a gluon BERT.
+
+    Returns a list aligned with `DataParallel`'s trainable-parameter order
+    (collect_params values with grad_req != 'null'). Column-parallel layers
+    (qkv, ffn1) shard their output dim; row-parallel layers (proj, ffn2)
+    shard their input dim; embeddings and the MLM decoder shard the vocab
+    dim; norms/bias-only params replicate. XLA's GSPMD inserts the
+    all-reduces the reference would route through NCCL."""
+    import jax
+
+    P = jax.sharding.PartitionSpec
+    specs = []
+    for name, p in net.collect_params().items():
+        if p.grad_req == "null":
+            continue
+        if name.endswith(("qkv.weight", "ffn1.weight")):
+            specs.append(P(tp_axis, None))
+        elif name.endswith(("qkv.bias", "ffn1.bias")):
+            specs.append(P(tp_axis))
+        elif name.endswith(("proj.weight", "ffn2.weight")):
+            specs.append(P(None, tp_axis))
+        elif name.endswith(("word_embed.weight", "mlm_decoder.weight")):
+            specs.append(P(tp_axis, None))
+        elif name.endswith("mlm_decoder.bias"):
+            specs.append(P(tp_axis))
+        else:
+            specs.append(P())
+    return specs
 
 
 class MultiHeadAttention(HybridBlock):
@@ -125,10 +157,16 @@ class TransformerEncoderCell(HybridBlock):
 class BERTEncoder(HybridBlock):
     def __init__(self, vocab_size=30522, units=768, hidden_size=3072,
                  num_layers=12, num_heads=12, max_length=512,
-                 dropout=0.1, type_vocab_size=2, use_flash=True):
+                 dropout=0.1, type_vocab_size=2, use_flash=True,
+                 seq_shard_axis=None, batch_shard_axis="dp"):
         super().__init__()
         self._units = units
         self._use_flash = use_flash
+        # sequence parallelism: shard the T axis of activations between
+        # blocks (Megatron-SP layout); axis names resolved against the
+        # active mesh, dropped when absent
+        self._seq_shard_axis = seq_shard_axis
+        self._batch_shard_axis = batch_shard_axis
         self.word_embed = nn.Embedding(vocab_size, units)
         self.token_type_embed = nn.Embedding(type_vocab_size, units)
         self.position_embed = Parameter(shape=(max_length, units),
@@ -150,10 +188,15 @@ class BERTEncoder(HybridBlock):
         x = self.ln(x)
         if self.dropout is not None:
             x = self.dropout(x)
+        sp, ba = self._seq_shard_axis, self._batch_shard_axis
+        if sp is not None:
+            x = npx.sharding_constraint(x, (ba, sp, None))
         if self._use_flash:
             # flash path: (B,) lengths straight into the kernel, no dense mask
             for cell in self.layers:
                 x = cell(x, None, valid_length)
+                if sp is not None:
+                    x = npx.sharding_constraint(x, (ba, sp, None))
             return x
         mask = None
         if valid_length is not None:
@@ -169,11 +212,13 @@ class BERTModel(HybridBlock):
 
     def __init__(self, vocab_size=30522, units=768, hidden_size=3072,
                  num_layers=12, num_heads=12, max_length=512, dropout=0.1,
-                 use_flash=True):
+                 use_flash=True, seq_shard_axis=None, batch_shard_axis="dp"):
         super().__init__()
         self.encoder = BERTEncoder(vocab_size, units, hidden_size, num_layers,
                                    num_heads, max_length, dropout,
-                                   use_flash=use_flash)
+                                   use_flash=use_flash,
+                                   seq_shard_axis=seq_shard_axis,
+                                   batch_shard_axis=batch_shard_axis)
         self.mlm_dense = nn.Dense(units, flatten=False, activation="tanh",
                                   in_units=units)
         self.mlm_ln = nn.LayerNorm(in_channels=units)
@@ -200,12 +245,14 @@ class BERTClassifier(HybridBlock):
         return self.classifier(self.dropout(pooled))
 
 
-def bert_base(vocab_size=30522, max_length=512, dropout=0.1, use_flash=True):
+def bert_base(vocab_size=30522, max_length=512, dropout=0.1, use_flash=True,
+              seq_shard_axis=None):
     return BERTModel(vocab_size, 768, 3072, 12, 12, max_length, dropout,
-                     use_flash=use_flash)
+                     use_flash=use_flash, seq_shard_axis=seq_shard_axis)
 
 
-def bert_small(vocab_size=1000, max_length=128, dropout=0.1, use_flash=True):
+def bert_small(vocab_size=1000, max_length=128, dropout=0.1, use_flash=True,
+               seq_shard_axis=None):
     """Tiny config for tests and compile-checks."""
     return BERTModel(vocab_size, 64, 128, 2, 4, max_length, dropout,
-                     use_flash=use_flash)
+                     use_flash=use_flash, seq_shard_axis=seq_shard_axis)

@@ -30,8 +30,8 @@ __all__ = [
     "masked_log_softmax", "leaky_relu", "fully_connected", "convolution",
     "deconvolution", "pooling", "batch_norm", "layer_norm", "group_norm",
     "instance_norm", "l2_normalization", "dropout", "embedding", "one_hot",
-    "pick", "topk", "batch_dot", "flash_attention", "gather_nd",
-    "scatter_nd", "sequence_mask",
+    "pick", "topk", "batch_dot", "flash_attention", "sharding_constraint",
+    "gather_nd", "scatter_nd", "sequence_mask",
     "sequence_last", "sequence_reverse", "rnn", "erf", "erfinv", "gamma",
     "gammaln", "digamma", "cast", "reshape", "arange_like", "shape_array",
     "stop_gradient", "foreach", "while_loop", "cond", "set_np", "reset_np",
@@ -581,6 +581,42 @@ def flash_attention(query, key, value, valid_length=None, causal=False,
         lambda q, k, v, vl: _flash(q, k, v, lengths=vl, causal=causal,
                                    sm_scale=sm_scale),
         (query, key, value, valid_length))
+
+
+def sharding_constraint(data, spec):
+    """Annotate an activation with a mesh sharding (sequence/tensor parallel
+    layout hints inside a traced step). Identity when no mesh is active or
+    when executing eagerly — the constraint only matters under jit where
+    GSPMD propagates it. Axes not present in the active mesh are dropped,
+    so model code can name 'sp'/'tp' axes unconditionally."""
+    import jax
+
+    from ..parallel.mesh import current_mesh
+
+    mesh = current_mesh()
+    if mesh is None:
+        return data
+    P = jax.sharding.PartitionSpec
+    spec = spec if isinstance(spec, P) else P(*spec)
+    names = set(mesh.axis_names)
+
+    def _clean(axis):
+        if axis is None:
+            return None
+        if isinstance(axis, (list, tuple)):
+            kept = [a for a in axis if a in names]
+            return tuple(kept) if kept else None
+        return axis if axis in names else None
+
+    cleaned = P(*[_clean(a) for a in spec])
+    sharding = jax.sharding.NamedSharding(mesh, cleaned)
+
+    def f(x):
+        if not isinstance(x, jax.core.Tracer):
+            return x  # eager: placement is the runtime's business
+        return jax.lax.with_sharding_constraint(x, sharding)
+
+    return apply_op("sharding_constraint", f, (data,))
 
 
 def batch_dot(a, b, transpose_a=False, transpose_b=False, **kwargs):  # noqa: ARG001

@@ -56,7 +56,8 @@ def _dot(a, b, ta=False, tb=False):
 
 def _fwd_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
                 m_scr, l_scr, acc_scr, *,
-                sm_scale, causal, block_q, block_k, n_kv, need_mask):
+                sm_scale, causal, block_q, block_k, n_kv, need_mask,
+                have_lengths):
     qi, kj = pl.program_id(1), pl.program_id(2)
     kv_len = len_ref[pl.program_id(0)]
 
@@ -100,14 +101,18 @@ def _fwd_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
         m, l, acc = m_scr[...], l_scr[...], acc_scr[...]
         l_safe = jnp.where(l > 0, l, 1.0)
         o = acc / l_safe
-        if need_mask:
+        if have_lengths:
+            # self-attention row-validity: zero rows past the sequence
+            # length; +inf lse makes backward's exp(s - lse) vanish there
             rows = (qi * block_q
                     + jax.lax.broadcasted_iota(jnp.int32, (block_q, 1), 0))
             valid = rows < kv_len
             o = jnp.where(valid, o, 0.0)
-            # +inf on dead rows: backward's exp(s - lse) vanishes there
             lse = jnp.where(jnp.logical_and(l > 0, valid),
                             m + jnp.log(l_safe), jnp.inf)
+        elif need_mask:
+            # kv padding / causal only: rows stay live; guard empty rows
+            lse = jnp.where(l > 0, m + jnp.log(l_safe), jnp.inf)
         else:
             lse = m + jnp.log(l_safe)
         o_ref[0] = o.astype(o_ref.dtype)
@@ -115,13 +120,14 @@ def _fwd_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
 
 
 def _fwd(q, k, v, lens, sm_scale, causal, block_q, block_k, interpret,
-         need_mask):
+         need_mask, have_lengths):
     bh, tq, d = q.shape
     tk = k.shape[1]
     n_q, n_kv = tq // block_q, tk // block_k
     kernel = functools.partial(
         _fwd_kernel, sm_scale=sm_scale, causal=causal, block_q=block_q,
-        block_k=block_k, n_kv=n_kv, need_mask=need_mask)
+        block_k=block_k, n_kv=n_kv, need_mask=need_mask,
+        have_lengths=have_lengths)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=(bh, n_q, n_kv),
@@ -322,23 +328,24 @@ def _bwd(q, k, v, o, lse, lens, do, sm_scale, causal, block_q, block_k,
 # public API
 # ---------------------------------------------------------------------------
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9))
+@functools.partial(jax.custom_vjp,
+                   nondiff_argnums=(4, 5, 6, 7, 8, 9, 10))
 def _flash_core(q, k, v, lens, sm_scale, causal, block_q, block_k, interpret,
-                need_mask):
+                need_mask, have_lengths):
     o, _ = _fwd(q, k, v, lens, sm_scale, causal, block_q, block_k, interpret,
-                need_mask)
+                need_mask, have_lengths)
     return o
 
 
 def _flash_core_fwd(q, k, v, lens, sm_scale, causal, block_q, block_k,
-                    interpret, need_mask):
+                    interpret, need_mask, have_lengths):
     o, lse = _fwd(q, k, v, lens, sm_scale, causal, block_q, block_k,
-                  interpret, need_mask)
+                  interpret, need_mask, have_lengths)
     return o, (q, k, v, o, lse, lens)
 
 
 def _flash_core_bwd(sm_scale, causal, block_q, block_k, interpret, need_mask,
-                    res, do):
+                    have_lengths, res, do):
     q, k, v, o, lse, lens = res
     dq, dk, dv = _bwd(q, k, v, o, lse, lens, do, sm_scale, causal,
                       block_q, block_k, interpret, need_mask)
@@ -391,7 +398,7 @@ def flash_attention(q, k, v, lengths=None, causal=False, sm_scale=None,
     need_mask = bool(causal) or lengths is not None or tk_pad != tk
     o = _flash_core(qr, kr, vr, lens, float(sm_scale), bool(causal),
                     int(block_q), int(block_k), bool(interpret),
-                    need_mask)
+                    need_mask, lengths is not None)
     return o[:, :tq].reshape(b, h, tq, d)
 
 

@@ -105,11 +105,24 @@ class DataParallel:
                 param_sh = [repl] * len(param_arrays)
             else:
                 param_sh = [NS(mesh, ps) for ps in param_shardings]
+            # optimizer-state leaves matching the param shape (adam m/v,
+            # momentum buffers) shard like the param; scalars replicate
+            state_sh = [
+                jax.tree.map(
+                    lambda leaf, _sh=sh, _shape=tuple(a.shape):
+                        _sh if tuple(getattr(leaf, "shape", ())) == _shape
+                        else repl,
+                    s)
+                for s, sh, a in zip(self.opt_states, param_sh, param_arrays)
+            ]
+            # params/states are fed back in every step: outputs must carry
+            # the SAME shardings as the declared inputs, or the second call
+            # fails with a committed-sharding mismatch
             self._jit = jax.jit(
                 step,
-                in_shardings=(param_sh, [repl] * len(frozen_arrays), None,
+                in_shardings=(param_sh, None, state_sh,
                               None, None, None, repl, batch_sh, batch_sh),
-                out_shardings=None)
+                out_shardings=(None, param_sh, state_sh, None))
             self._batch_sharding = batch_sh
         else:
             self._jit = jax.jit(step)
@@ -130,9 +143,17 @@ class DataParallel:
         yv = y._data if isinstance(y, NDArray) else y
         param_vals = [a._data for a in self.param_arrays]
         frozen_vals = [a._data for a in self.frozen_arrays]
-        loss, new_params, new_states, aux_new = self._jit(
-            param_vals, frozen_vals, self.opt_states, self._t, lr, wd,
-            next_key(), xv, yv)
+        # the mesh is active during tracing so npx.sharding_constraint
+        # (sequence/tensor-parallel activation hints) can resolve axes
+        import contextlib
+
+        from .mesh import mesh_scope
+
+        with (mesh_scope(self.mesh) if self.mesh is not None
+              else contextlib.nullcontext()):
+            loss, new_params, new_states, aux_new = self._jit(
+                param_vals, frozen_vals, self.opt_states, self._t, lr, wd,
+                next_key(), xv, yv)
         for a, nv in zip(self.param_arrays, new_params):
             a._set_data(nv)
         for a, nv in zip(self._aux_arrays_cell, aux_new):

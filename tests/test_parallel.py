@@ -246,3 +246,47 @@ def test_data_parallel_matches_single_device():
 
     assert_almost_equal(net_a.weight.data().asnumpy(),
                         net_b.weight.data().asnumpy(), rtol=1e-5, atol=1e-6)
+
+
+def test_gluon_bert_tp_dataparallel_matches_replicated():
+    """Gluon BERT trained through DataParallel with Megatron TP param
+    shardings + SP activation constraints must match the fully-replicated
+    DataParallel run (same init/data) — TP/SP is a layout, not math."""
+    _need_8()
+    from incubator_mxnet_tpu import gluon, optimizer
+    from incubator_mxnet_tpu.models.bert import bert_small, tp_param_shardings
+    from incubator_mxnet_tpu.parallel import DataParallel
+
+    rng = onp.random.RandomState(0)
+    tokens = np.array(rng.randint(0, 64, (8, 16)).astype("int32"))
+    labels = np.array(rng.randint(0, 64, (8, 16)).astype("int32"))
+    ce = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    def mlm_loss(out, y):
+        mlm_scores, _ = out
+        return ce(mlm_scores.reshape(-1, 64), y.reshape(-1))
+
+    def run(shardings, mesh_axes, seq_axis):
+        from incubator_mxnet_tpu import npx
+
+        npx.seed(7)
+        net = bert_small(vocab_size=64, max_length=32, dropout=0.0,
+                         seq_shard_axis=seq_axis)
+        net.initialize()
+        mesh = make_mesh(mesh_axes)
+        dp = DataParallel(net, mlm_loss, optimizer.SGD(learning_rate=0.1),
+                          mesh=mesh,
+                          param_shardings=(tp_param_shardings(net)
+                                           if shardings else None))
+        losses = [float(dp.step(tokens, labels).asnumpy())
+                  for _ in range(3)]
+        return losses, net
+
+    losses_tp, net_tp = run(True, {"dp": 2, "tp": 4}, "tp")
+    losses_rep, net_rep = run(False, {"dp": 8}, None)
+    onp.testing.assert_allclose(losses_tp, losses_rep, rtol=2e-4, atol=2e-4)
+    for (n1, p1), (n2, p2) in zip(net_tp.collect_params().items(),
+                                  net_rep.collect_params().items()):
+        onp.testing.assert_allclose(p1.data().asnumpy(), p2.data().asnumpy(),
+                                    rtol=3e-3, atol=3e-4, err_msg=n1)
+    assert losses_tp[-1] < losses_tp[0]  # it actually learns
