@@ -1,52 +1,209 @@
 """Benchmark driver: prints ONE JSON line
-{"metric": ..., "value": N, "unit": ..., "vs_baseline": N}.
+{"metric": ..., "value": N, "unit": ..., "vs_baseline": N, "extras": {...}}.
 
-Primary metric this round: `dot` (1024×1024)·(1024×1024) fp32 forward
-latency through the framework's op path — the reference's published anchor
-is 0.215 ms on a V100 (BASELINE.md, `benchmark/opperf/results/..._gpu.md:82`)
-and 14.56 ms on a 32-core CPU. vs_baseline = V100_ms / our_ms (>1 ⇒ faster
-than the reference's GPU number).
+Primary metric: `dot` (1024x1024)·(1024x1024) fp32 forward latency through
+the FRAMEWORK op path (NDArray funnel -> apply_op -> XLA), the reference's
+published anchor: 0.215 ms on a V100 / 14.56 ms on a 32-core CPU
+(BASELINE.md, `benchmark/opperf/results/..._gpu.md:82`).
+vs_baseline = V100_ms / our_ms (>1 => faster than the reference's GPU).
+
+extras (model-level, VERDICT r1 item 2):
+- dot_rawjax_ms: same matmul jitted over raw jax arrays — the gap to
+  dot_framework_ms is the eager per-op dispatch overhead.
+- resnet50_train_img_s: gluon model_zoo ResNet-50-v1 fwd+bwd+SGD update,
+  whole step jit-compiled (DataParallel), batch 32 @ 224².
+- bert_base_train_tokens_s: gluon BERT-base (110M params, flash
+  attention) fwd+bwd+Adam, batch 8 @ seq 128.
+- bert_mfu: model FLOPs utilization, 6·N·tokens/step_time vs the chip's
+  bf16 peak (v5e: 197 TFLOP/s) — conservative for fp32 runs.
 """
 from __future__ import annotations
 
 import json
+import sys
 import time
 
 import numpy as onp
 
 BASELINE_V100_DOT_MS = 0.215
+PEAK_BF16_TFLOPS = 197.0  # TPU v5e
 
 
-def bench_dot(n=1024, iters=200, warmup=20):
+def _sync():
     import incubator_mxnet_tpu as mx
+
+    mx.waitall()
+
+
+# NOTE on methodology: on the tunneled TPU, `block_until_ready` returns
+# before remote execution finishes; only a value transfer (asnumpy) is a
+# true sync. Every bench below therefore CHAINS its iterations through a
+# data dependency and ends with ONE scalar fetch, so the measured wall
+# time covers the whole chain (amortizing the ~RPC round trip over iters).
+
+
+def bench_dot_framework(n=1024, iters=100, warmup=10):
+    """dot through the NDArray funnel — measures the full eager path."""
     from incubator_mxnet_tpu import np
 
     rng = onp.random.RandomState(0)
     a = np.array(rng.uniform(-1, 1, (n, n)).astype("float32"))
-    b = np.array(rng.uniform(-1, 1, (n, n)).astype("float32"))
-
-    import jax
-
-    f = jax.jit(lambda x, y: x @ y)
+    # pre-contracted b: chained dots decay toward zero instead of
+    # overflowing, so the loop body is exactly ONE op dispatch
+    b = np.array((rng.uniform(-1, 1, (n, n)) / n).astype("float32"))
+    acc = a
     for _ in range(warmup):
-        f(a._data, b._data).block_until_ready()
+        acc = np.dot(acc, b)
+    float(acc[0, 0].asnumpy())  # true sync
     t0 = time.perf_counter()
-    out = None
     for _ in range(iters):
-        out = f(a._data, b._data)
-    out.block_until_ready()
+        acc = np.dot(acc, b)   # chained: each dot feeds the next
+    float(acc[0, 0].asnumpy())
+    return (time.perf_counter() - t0) / iters * 1000.0
+
+
+def bench_dot_rawjax(n=1024, iters=100, warmup=10):
+    import jax
+    import jax.numpy as jnp
+
+    rng = onp.random.RandomState(0)
+    a = jnp.asarray(rng.uniform(-1, 1, (n, n)).astype("float32"))
+    b = jnp.asarray((rng.uniform(-1, 1, (n, n)) / n).astype("float32"))
+    f = jax.jit(lambda x, y: x @ y)
+    acc = a
+    for _ in range(warmup):
+        acc = f(acc, b)
+    float(jax.device_get(acc[0, 0]))
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        acc = f(acc, b)
+    float(jax.device_get(acc[0, 0]))
+    return (time.perf_counter() - t0) / iters * 1000.0
+
+
+def bench_dispatch_floor(iters=100):
+    """Per-program dispatch+execute floor: a trivial chained jitted op.
+    On the tunneled chip this is ~1 ms — the lower bound every per-op
+    latency metric above inherits (on a directly-attached TPU it is tens
+    of µs)."""
+    import jax
+    import jax.numpy as jnp
+
+    f = jax.jit(lambda x: x + 1.0)
+    acc = jnp.zeros(())
+    for _ in range(10):
+        acc = f(acc)
+    float(jax.device_get(acc))
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        acc = f(acc)
+    float(jax.device_get(acc))
+    return (time.perf_counter() - t0) / iters * 1000.0
+
+
+def bench_resnet50_train(batch=32, iters=20, warmup=2):
+    """images/sec: compiled train step (fwd+bwd+SGD) on gluon ResNet-50."""
+    from incubator_mxnet_tpu import gluon, np, optimizer
+    from incubator_mxnet_tpu.gluon.model_zoo.vision import resnet50_v1
+    from incubator_mxnet_tpu.parallel.sharded import DataParallel
+
+    net = resnet50_v1()
+    net.initialize()
+    rng = onp.random.RandomState(0)
+    # deferred shape inference before the compiled step traces
+    net(np.array(rng.uniform(-1, 1, (1, 3, 224, 224)).astype("float32")))
+    dp = DataParallel(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                      optimizer.SGD(learning_rate=0.01, momentum=0.9))
+    x = np.array(rng.uniform(-1, 1, (batch, 3, 224, 224)).astype("float32"))
+    y = np.array(rng.randint(0, 1000, (batch,)).astype("int32"))
+    loss = None
+    for _ in range(warmup):
+        loss = dp.step(x, y)
+    float(loss.asnumpy())  # true sync
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        loss = dp.step(x, y)   # steps chain through the parameters
+    float(loss.asnumpy())
     dt = (time.perf_counter() - t0) / iters
-    mx.waitall()
-    return dt * 1000.0
+    return batch / dt
+
+
+def bench_bert_train(batch=8, seq=128, iters=20, warmup=2):
+    """tokens/sec + MFU: compiled train step on gluon BERT-base (flash)."""
+    from incubator_mxnet_tpu import gluon, np, optimizer
+    from incubator_mxnet_tpu.models.bert import bert_base
+    from incubator_mxnet_tpu.parallel.sharded import DataParallel
+
+    vocab = 30522
+    net = bert_base(max_length=seq, dropout=0.1)
+    net.initialize()
+    ce = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    def mlm_loss(out, y):
+        mlm_scores, _ = out
+        return ce(mlm_scores.reshape(-1, vocab), y.reshape(-1))
+
+    dp = DataParallel(net, mlm_loss, optimizer.Adam(learning_rate=1e-4))
+    rng = onp.random.RandomState(0)
+    tokens = np.array(rng.randint(0, vocab, (batch, seq)).astype("int32"))
+    labels = np.array(rng.randint(0, vocab, (batch, seq)).astype("int32"))
+    loss = None
+    for _ in range(warmup):
+        loss = dp.step(tokens, labels)
+    float(loss.asnumpy())  # true sync
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        loss = dp.step(tokens, labels)   # chained through the parameters
+    float(loss.asnumpy())
+    dt = (time.perf_counter() - t0) / iters
+    tokens_s = batch * seq / dt
+    n_params = sum(onp.prod(p.shape)
+                   for p in net.collect_params().values())
+    # 6·N per token (fwd 2N + bwd 4N), ignoring attention's T² term
+    mfu = 6.0 * float(n_params) * tokens_s / (PEAK_BF16_TFLOPS * 1e12)
+    return tokens_s, mfu
 
 
 def main():
-    ms = bench_dot()
+    extras = {}
+    try:
+        extras["dot_rawjax_ms"] = round(bench_dot_rawjax(), 4)
+    except Exception as e:  # pragma: no cover
+        print(f"rawjax dot bench failed: {e}", file=sys.stderr)
+    try:
+        extras["dispatch_floor_ms"] = round(bench_dispatch_floor(), 4)
+    except Exception as e:  # pragma: no cover
+        print(f"dispatch floor bench failed: {e}", file=sys.stderr)
+    def _retry(fn, tries=2):
+        # the tunneled remote-compile service occasionally drops a response
+        for i in range(tries):
+            try:
+                return fn()
+            except Exception as e:  # pragma: no cover
+                err = e
+                print(f"{fn.__name__} attempt {i + 1} failed: {e}",
+                      file=sys.stderr)
+        raise err
+
+    try:
+        extras["resnet50_train_img_s"] = round(_retry(bench_resnet50_train), 1)
+    except Exception as e:  # pragma: no cover
+        print(f"resnet50 bench failed: {e}", file=sys.stderr)
+    try:
+        tokens_s, mfu = _retry(bench_bert_train)
+        extras["bert_base_train_tokens_s"] = round(tokens_s, 1)
+        extras["bert_mfu"] = round(mfu, 4)
+    except Exception as e:  # pragma: no cover
+        print(f"bert bench failed: {e}", file=sys.stderr)
+
+    ms = bench_dot_framework()
+    _sync()
     print(json.dumps({
-        "metric": "dot_1024x1024_fwd_latency",
+        "metric": "dot_1024x1024_fwd_latency_framework",
         "value": round(ms, 4),
         "unit": "ms",
         "vs_baseline": round(BASELINE_V100_DOT_MS / ms, 3),
+        "extras": extras,
     }))
 
 

@@ -615,7 +615,71 @@ def apply_op(name, jfn, args, kwargs=None, n_outputs=1, out=None):
     return wrapped[0]
 
 
-def apply_op_flat(name, jfn, args, kwargs=None, n_outputs=None):
+_JIT_CACHE: dict = {}
+_JIT_DENY: set = set()
+
+
+def _static_marker(a):
+    """Hashable, type-tagged stand-in for a non-tensor static value (cache
+    key part). The type tag keeps 1 / 1.0 / True from colliding (Python
+    hash-equality would otherwise reuse a closure with the wrong constant
+    baked in). Raises TypeError for unhashable values — caller falls back
+    to eager."""
+    if isinstance(a, NDArray):
+        return "<T>"
+    if isinstance(a, (list, tuple)):
+        return (type(a).__name__,) + tuple(_static_marker(b) for b in a)
+    hash(a)
+    return (type(a).__name__, a)
+
+
+def _cached_jit(name, jfn, args, kwargs, pure_fn, tensor_vals):
+    """Op-call cache for the eager path (SURVEY §7 'op-call cache keyed by
+    (op, shapes, dtypes)'): jit-compile pure_fn once per (op fn, static
+    args/kwargs) and let jax's own executable cache key on tensor avals.
+    Returns None when this call isn't cacheable — caller runs eagerly.
+
+    Only used for ops whose jfn has stable identity and fully-explicit
+    static parameters (the generated `np` namespace); ops with values
+    closed over in the jfn MUST NOT opt in."""
+    if name in _JIT_DENY:
+        return None
+    import jax
+
+    try:
+        key = (jfn, tuple(_static_marker(a) for a in args),
+               tuple((k, _static_marker(v)) for k, v in
+                     sorted(kwargs.items())))
+        jitted = _JIT_CACHE.get(key)
+    except TypeError:
+        return None
+    if jitted is None:
+        jitted = jax.jit(pure_fn)
+        _JIT_CACHE[key] = jitted
+    try:
+        outs = jitted(*tensor_vals)
+        leaves = outs if isinstance(outs, tuple) else (outs,)
+        if all(isinstance(o, jax.Array) for o in leaves):
+            return outs
+    except (jax.errors.JAXTypeError, TypeError):
+        # dynamic-shape ops (unique, nonzero, boolean indexing…) trace-fail
+        # under jit: run this op eagerly from now on
+        _JIT_CACHE.pop(key, None)
+        _JIT_DENY.add(name)
+        return None
+    except Exception:
+        # transient failure (dropped remote compile, OOM…) or a genuine
+        # user error: fall back to eager WITHOUT poisoning the deny list —
+        # user errors re-raise identically from the eager path
+        return None
+    # non-array outputs (ndim, shape, result_type…) keep python semantics
+    _JIT_CACHE.pop(key, None)
+    _JIT_DENY.add(name)
+    return None
+
+
+def apply_op_flat(name, jfn, args, kwargs=None, n_outputs=None,
+                  cacheable=False):
     """Like apply_op but flattens NDArrays nested one level inside list/tuple
     positional args (e.g. ``concatenate([a, b], axis=0)``)."""
     kwargs = kwargs or {}
@@ -631,9 +695,15 @@ def apply_op_flat(name, jfn, args, kwargs=None, n_outputs=None):
                     paths.append((i, j))
                     parents.append(b)
     tensor_vals = [p._data for p in parents]
+    # tensor slots stripped so pure_fn's closure (kept alive by the tape
+    # AND by the op-call jit cache) never pins input buffers
+    args_static = [None if isinstance(a, NDArray)
+                   else ([None if isinstance(b, NDArray) else b for b in a]
+                         if isinstance(a, (list, tuple)) else a)
+                   for a in args]
 
     def pure_fn(*tvals):
-        call = [list(a) if isinstance(a, (list, tuple)) else a for a in args]
+        call = [list(a) if isinstance(a, list) else a for a in args_static]
         for path, v in zip(paths, tvals):
             if len(path) == 1:
                 call[path[0]] = v
@@ -642,7 +712,15 @@ def apply_op_flat(name, jfn, args, kwargs=None, n_outputs=None):
         outs = jfn(*call, **kwargs)
         return tuple(outs) if isinstance(outs, list) else outs
 
-    outs = _call_profiled(name, pure_fn, tensor_vals)
+    outs = None
+    if cacheable and not any(_is_tracer(v) for v in tensor_vals):
+        prof = _active_profiler()
+        t0 = time.perf_counter() if prof is not None else 0
+        outs = _cached_jit(name, jfn, args, kwargs, pure_fn, tensor_vals)
+        if outs is not None and prof is not None:
+            prof.record_op(name, time.perf_counter() - t0)
+    if outs is None:
+        outs = _call_profiled(name, pure_fn, tensor_vals)
     tuple_out = isinstance(outs, tuple)
     out_list = list(outs) if tuple_out else [outs]
     wrapped = [NDArray(o) for o in out_list]

@@ -130,7 +130,10 @@ def _make(name, jnp_name=None):
         kwargs.pop("where", None)
         kwargs = {k: (v._data if isinstance(v, NDArray) else v)
                   for k, v in kwargs.items()}
-        return apply_op_flat(name, getattr(jnp, jnp_name), args, kwargs)
+        # jnp functions have stable identity and fully-explicit statics →
+        # eligible for the eager op-call jit cache
+        return apply_op_flat(name, getattr(jnp, jnp_name), args, kwargs,
+                             cacheable=True)
 
     op.__name__ = name
     register_op_meta(name, "np", op)

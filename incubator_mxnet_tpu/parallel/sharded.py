@@ -118,6 +118,12 @@ class DataParallel:
             # params/states are fed back in every step: outputs must carry
             # the SAME shardings as the declared inputs, or the second call
             # fails with a committed-sharding mismatch
+            # frozen params (incl. BN aux stats) start committed to a single
+            # device; replicate them onto the mesh ONCE here. Their
+            # in_sharding stays None (= follow the arg) because aux updates
+            # come back with compiler-chosen shardings and re-enter.
+            for a in frozen_arrays:
+                a._set_data(jax.device_put(a._data, repl))
             self._jit = jax.jit(
                 step,
                 in_shardings=(param_sh, None, state_sh,
