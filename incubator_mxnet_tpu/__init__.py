@@ -60,3 +60,4 @@ from . import parallel  # noqa: F401
 from . import profiler  # noqa: F401
 from . import util  # noqa: F401
 from . import test_utils  # noqa: F401
+from . import contrib  # noqa: F401

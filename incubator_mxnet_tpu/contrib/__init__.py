@@ -1,0 +1,5 @@
+"""contrib — quantization, and other extensions outside the core namespace
+(reference: `python/mxnet/contrib/`)."""
+from . import quantization
+
+__all__ = ["quantization"]

@@ -617,27 +617,39 @@ def apply_op(name, jfn, args, kwargs=None, n_outputs=1, out=None):
 
 _JIT_CACHE: dict = {}
 _JIT_DENY: set = set()
+_JIT_FAILS: dict = {}
+_JIT_MAX_FAILS = 3
 
 
 def _static_marker(a):
     """Hashable, type-tagged stand-in for a non-tensor static value (cache
     key part). The type tag keeps 1 / 1.0 / True from colliding (Python
     hash-equality would otherwise reuse a closure with the wrong constant
-    baked in). Raises TypeError for unhashable values — caller falls back
-    to eager."""
+    baked in). Plain int/float scalars are NOT baked in — they are lifted
+    to traced weak-typed operands (see apply_op_flat), so a per-step
+    varying scalar does not trigger one XLA compile per value. Raises
+    TypeError for unhashable values — caller falls back to eager."""
     if isinstance(a, NDArray):
         return "<T>"
+    if type(a) in (int, float):          # bool excluded: stays static
+        return f"<S:{type(a).__name__}>"
     if isinstance(a, (list, tuple)):
         return (type(a).__name__,) + tuple(_static_marker(b) for b in a)
     hash(a)
     return (type(a).__name__, a)
 
 
-def _cached_jit(name, jfn, args, kwargs, pure_fn, tensor_vals):
+def _jit_deny(name, key):
+    _JIT_CACHE.pop(key, None)
+    _JIT_DENY.add(name)
+
+
+def _cached_jit(name, jfn, args, kwargs, pure_fn, call_vals):
     """Op-call cache for the eager path (SURVEY §7 'op-call cache keyed by
     (op, shapes, dtypes)'): jit-compile pure_fn once per (op fn, static
-    args/kwargs) and let jax's own executable cache key on tensor avals.
-    Returns None when this call isn't cacheable — caller runs eagerly.
+    args/kwargs shape) and let jax's own executable cache key on operand
+    avals. Returns None when this call isn't cacheable — caller runs
+    eagerly.
 
     Only used for ops whose jfn has stable identity and fully-explicit
     static parameters (the generated `np` namespace); ops with values
@@ -657,24 +669,27 @@ def _cached_jit(name, jfn, args, kwargs, pure_fn, tensor_vals):
         jitted = jax.jit(pure_fn)
         _JIT_CACHE[key] = jitted
     try:
-        outs = jitted(*tensor_vals)
+        outs = jitted(*call_vals)
         leaves = outs if isinstance(outs, tuple) else (outs,)
         if all(isinstance(o, jax.Array) for o in leaves):
             return outs
     except (jax.errors.JAXTypeError, TypeError):
         # dynamic-shape ops (unique, nonzero, boolean indexing…) trace-fail
         # under jit: run this op eagerly from now on
-        _JIT_CACHE.pop(key, None)
-        _JIT_DENY.add(name)
+        _jit_deny(name, key)
         return None
     except Exception:
         # transient failure (dropped remote compile, OOM…) or a genuine
-        # user error: fall back to eager WITHOUT poisoning the deny list —
-        # user errors re-raise identically from the eager path
+        # user error: evict and fall back to eager — user errors re-raise
+        # identically there. Repeated deterministic failures stop paying
+        # the trace cost via the deny list.
+        _JIT_CACHE.pop(key, None)
+        _JIT_FAILS[name] = _JIT_FAILS.get(name, 0) + 1
+        if _JIT_FAILS[name] >= _JIT_MAX_FAILS:
+            _JIT_DENY.add(name)
         return None
     # non-array outputs (ndim, shape, result_type…) keep python semantics
-    _JIT_CACHE.pop(key, None)
-    _JIT_DENY.add(name)
+    _jit_deny(name, key)
     return None
 
 

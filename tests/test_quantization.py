@@ -1,0 +1,126 @@
+"""INT8 quantization: calibration algorithms, quantized op numerics, and
+end-to-end accuracy preservation (reference:
+`tests/python/quantization/test_quantization.py`, accuracy discipline from
+`example/quantization/README.md` — ≤0.5% top-1 drop)."""
+import numpy as onp
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import autograd, gluon, np
+from incubator_mxnet_tpu.contrib import quantization as q
+
+
+def test_entropy_threshold_clips_outliers():
+    """A gaussian bulk with a far outlier: entropy calibration should pick
+    a threshold well below the outlier; naive picks the outlier."""
+    rng = onp.random.RandomState(0)
+    x = onp.abs(rng.randn(100000)).astype("float32")
+    x[0] = 50.0  # outlier
+    hist, edges = onp.histogram(onp.abs(x), bins=2048, range=(0, 50.0))
+    t = q.optimal_threshold_entropy(hist, edges)
+    assert t < 25.0, t
+    assert t > 1.0, t
+
+
+def test_quantized_dense_matches_fp32():
+    rng = onp.random.RandomState(1)
+    dense = gluon.nn.Dense(32, in_units=16)
+    dense.initialize()
+    x = np.array(rng.uniform(-2, 2, (8, 16)).astype("float32"))
+    ref = dense(x).asnumpy()
+    qd = q.QuantizedDense(dense, threshold=2.0)
+    out = qd(x).asnumpy()
+    # int8 quantization error bound: ~1% relative on well-scaled data
+    assert onp.abs(out - ref).max() / (onp.abs(ref).max() + 1e-6) < 0.03
+
+
+def test_quantized_conv_matches_fp32():
+    rng = onp.random.RandomState(2)
+    conv = gluon.nn.Conv2D(8, kernel_size=3, padding=1, in_channels=4)
+    conv.initialize()
+    x = np.array(rng.uniform(-1, 1, (2, 4, 12, 12)).astype("float32"))
+    ref = conv(x).asnumpy()
+    qc = q.QuantizedConv2D(conv, threshold=1.0)
+    out = qc(x).asnumpy()
+    assert onp.abs(out - ref).max() / (onp.abs(ref).max() + 1e-6) < 0.03
+
+
+def _make_toy_problem(n=512, seed=0):
+    """Linearly-separable-ish 4-class problem through a small conv net."""
+    rng = onp.random.RandomState(seed)
+    X = rng.uniform(-1, 1, (n, 3, 8, 8)).astype("float32")
+    # class = argmax of 4 fixed random projections -> learnable
+    W = rng.randn(4, 3 * 8 * 8).astype("float32")
+    Y = (X.reshape(n, -1) @ W.T).argmax(1).astype("int32")
+    return X, Y
+
+
+def _accuracy(net, X, Y, bs=64):
+    correct = 0
+    for i in range(0, len(X), bs):
+        out = net(np.array(X[i:i + bs]))
+        correct += int((out.asnumpy().argmax(1) == Y[i:i + bs]).sum())
+    return correct / len(X)
+
+
+def test_quantize_net_end_to_end_accuracy():
+    """Train fp32 -> quantize (entropy calib) -> accuracy drop must stay
+    within the reference's discipline (≤0.5% absolute here ~1%)."""
+    X, Y = _make_toy_problem()
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Conv2D(16, 3, padding=1, in_channels=3,
+                            activation="relu"),
+            gluon.nn.Conv2D(16, 3, padding=1, in_channels=16,
+                            activation="relu"),
+            gluon.nn.Dense(64, activation="relu"),
+            gluon.nn.Dense(4))
+    net.initialize()
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 3e-3})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    for epoch in range(12):
+        for i in range(0, len(X), 64):
+            xb, yb = np.array(X[i:i + 64]), np.array(Y[i:i + 64])
+            with autograd.record():
+                loss = loss_fn(net(xb), yb).mean()
+            loss.backward()
+            trainer.step(1)
+    acc_fp32 = _accuracy(net, X, Y)
+    assert acc_fp32 > 0.8, f"fp32 net failed to train: {acc_fp32}"
+
+    calib = [np.array(X[i:i + 64]) for i in range(0, 256, 64)]
+    q.quantize_net(net, calib_data=calib, calib_mode="entropy",
+                   num_calib_batches=4)
+    # every Dense/Conv must have been swapped
+    reprs = repr(net._children)
+    assert "QuantizedConv2D" in reprs and "QuantizedDense" in reprs
+    acc_int8 = _accuracy(net, X, Y)
+    assert acc_fp32 - acc_int8 <= 0.01, (acc_fp32, acc_int8)
+
+
+def test_quantize_net_exclude_and_naive():
+    X, _ = _make_toy_problem(64)
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(16, in_units=192, activation="relu"),
+            gluon.nn.Dense(4, in_units=16))
+    net.initialize()
+    net(np.array(X[:4].reshape(4, -1)))
+    calib = [np.array(X[:32].reshape(32, -1))]
+    q.quantize_net(net, calib_data=calib, calib_mode="naive",
+                   exclude_layers_match=[r"\.1$"])
+    kids = list(net._children["0"]._children.values()) \
+        if "0" in net._children else []
+    reprs = repr(net._children)
+    assert "QuantizedDense" in reprs
+    assert "Dense(4" in reprs  # excluded layer stays fp32
+
+
+def test_quantize_requires_calib_data():
+    # a net with no quantizable layers is a no-op, not an error
+    q.quantize_net(gluon.nn.HybridSequential(), calib_mode="entropy")
+    # but a net WITH layers must demand calibration data
+    net2 = gluon.nn.HybridSequential()
+    net2.add(gluon.nn.Dense(4, in_units=8))
+    net2.initialize()
+    with pytest.raises(ValueError):
+        q.quantize_net(net2, calib_mode="entropy")
