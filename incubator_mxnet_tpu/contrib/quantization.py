@@ -15,7 +15,9 @@ executes the integer contraction with `lax.dot_general` /
 int8 path (2× bf16 throughput) — and XLA fuses the scale/bias epilogue.
 Weights use symmetric per-output-channel scales; activations use one
 calibrated symmetric scale (minmax or KL-entropy, same algorithms as the
-reference).
+reference). Quantized weights/scales/thresholds live in registered
+`Constant` parameters, so `save_parameters`/`load_parameters` round-trip
+the quantized net.
 """
 from __future__ import annotations
 
@@ -25,6 +27,7 @@ import numpy as onp
 
 from ..gluon import nn
 from ..gluon.block import HybridBlock
+from ..gluon.parameter import Constant
 from ..ndarray.ndarray import NDArray, apply_op
 
 __all__ = ["quantize_net", "quantize_model", "QuantizedDense",
@@ -36,46 +39,69 @@ __all__ = ["quantize_net", "quantize_model", "QuantizedDense",
 # calibration
 # ---------------------------------------------------------------------------
 
+def _smooth_distribution(p, eps=1e-4):
+    """Redistribute a little mass from nonzero to zero entries so the KL
+    term is defined everywhere (reference: the calibration smoothing in
+    `src/operator/quantization/calibrate.cc`). Returns None when the
+    distribution can't absorb the smoothing."""
+    is_zero = p == 0
+    n_nonzero = p.size - is_zero.sum()
+    if n_nonzero == 0:
+        return None
+    eps1 = eps * is_zero.sum() / n_nonzero
+    out = p.astype(onp.float64).copy()
+    out[is_zero] = eps
+    out[~is_zero] -= eps1
+    if (out[~is_zero] <= 0).any():
+        return None
+    return out
+
+
 def optimal_threshold_entropy(hist, bin_edges, num_quantized_bins=255):
     """KL-divergence-optimal clip threshold over an |activation| histogram
     (reference: `src/operator/quantization/calibrate.cc` GetOptimalThreshold
-    — the TensorRT-style entropy calibration)."""
+    — the TensorRT-style entropy calibration). For each candidate clip bin
+    `i`, the first `i` bins (outlier mass folded into bin i-1) are merged
+    into `num_quantized_bins` equal-width groups; each nonzero position
+    gets its group's nonzero-average; both distributions are eps-smoothed
+    and the KL(P||Q)-minimizing threshold wins."""
     hist = onp.asarray(hist, dtype=onp.float64)
     num_bins = hist.size
     if num_bins <= num_quantized_bins:
         return float(bin_edges[-1])
-    best_kl = onp.inf
-    best_i = num_bins
     total = hist.sum()
     if total == 0:
         return float(bin_edges[-1])
+    # suffix[i] = hist[i:].sum(); csum/cnz give O(1) range sums below
+    suffix = onp.concatenate([hist[::-1].cumsum()[::-1], [0.0]])
+    csum = onp.concatenate([[0.0], hist.cumsum()])
+    cnz = onp.concatenate([[0], (hist > 0).cumsum()])
+    best_kl = onp.inf
+    best_i = num_bins
     for i in range(num_quantized_bins, num_bins + 1):
         p = hist[:i].copy()
-        p[i - 1] += hist[i:].sum()          # clip outliers into last bin
-        p_sum = p.sum()
-        if p_sum == 0 or p[:i].max() == 0:
+        p[i - 1] += suffix[i]            # clip outliers into last bin
+        nm = i // num_quantized_bins     # merged bins per quantized bin
+        starts = onp.arange(num_quantized_bins) * nm
+        stops = onp.concatenate([starts[1:], [i]])  # last absorbs remainder
+        sums = csum[stops] - csum[starts]
+        norms = cnz[stops] - cnz[starts]
+        nzp = hist[:i] > 0
+        if suffix[i] > 0 and hist[i - 1] == 0:
+            # folding outliers made position i-1 (in the last group) nonzero
+            nzp = nzp.copy()
+            nzp[i - 1] = True
+            norms[-1] += 1
+        vals = onp.where(norms > 0, sums / onp.maximum(norms, 1), 0.0)
+        owner = onp.minimum(onp.arange(i) // nm, num_quantized_bins - 1)
+        q = vals[owner] * nzp
+        ps = _smooth_distribution(p)
+        qs = _smooth_distribution(q)
+        if ps is None or qs is None:
             continue
-        # quantize the i reference bins down to num_quantized_bins
-        q = onp.zeros(i, dtype=onp.float64)
-        factor = i / num_quantized_bins
-        for j in range(num_quantized_bins):
-            lo = int(onp.floor(j * factor))
-            hi = int(onp.ceil((j + 1) * factor))
-            hi = min(hi, i)
-            chunk = hist[lo:hi]
-            nz = (chunk > 0).sum()
-            if nz:
-                q[lo:hi] = onp.where(chunk > 0, chunk.sum() / nz, 0.0)
-        # smoothed KL(P || Q)
-        p_norm = p / p_sum
-        q_sum = q.sum()
-        if q_sum == 0:
-            continue
-        q_norm = q / q_sum
-        mask = p_norm > 0
-        eps = 1e-10
-        kl = float((p_norm[mask]
-                    * onp.log(p_norm[mask] / (q_norm[mask] + eps))).sum())
+        ps /= ps.sum()
+        qs /= qs.sum()
+        kl = float((ps * onp.log(ps / qs)).sum())
         if kl < best_kl:
             best_kl = kl
             best_i = i
@@ -121,12 +147,26 @@ def _iter_calib(calib_data, num_batches):
         n += 1
 
 
+def _hybrid_blocks(block, out=None):
+    if out is None:
+        out = []
+    if hasattr(block, "_active"):
+        out.append(block)
+    for child in block._children.values():
+        _hybrid_blocks(child, out)
+    return out
+
+
 def collect_thresholds(net, layers, calib_data, calib_mode="entropy",
                        num_calib_batches=10, num_bins=2048):
     """Run calibration forwards, recording each target layer's INPUT
-    activation distribution; returns {layer_id: threshold}."""
+    activation distribution; returns {layer_id: threshold}.
+
+    Calibration must execute eagerly — a cached/hybridized graph would
+    bypass the per-layer hooks (and `asnumpy` on a tracer raises) — so
+    hybridization is suspended for the duration and restored after.
+    """
     stats = {id(layer): _ActivationStats(num_bins) for _, _, layer in layers}
-    originals = {}
 
     def _hook(layer, phase):
         orig = layer.forward
@@ -139,19 +179,28 @@ def collect_thresholds(net, layers, calib_data, calib_mode="entropy",
                 stats[id(layer)].update_hist(xv)
             return orig(x, *args, **kwargs)
 
-        return orig, wrapped
+        return wrapped
 
     phases = ["minmax"] + (["hist"] if calib_mode == "entropy" else [])
     batches = list(_iter_calib(calib_data, num_calib_batches))
-    for phase in phases:
-        for _, _, layer in layers:
-            orig, wrapped = _hook(layer, phase)
-            originals[id(layer)] = orig
-            layer.forward = wrapped
-        for x in batches:
-            net(x if isinstance(x, NDArray) else NDArray(x))
-        for _, _, layer in layers:
-            del layer.forward        # restore the class method
+    hybrids = _hybrid_blocks(net)
+    was_active = [(b, b._active) for b in hybrids]
+    try:
+        for b in hybrids:
+            b._active = False
+            b._cached_graph = None
+        for phase in phases:
+            try:
+                for _, _, layer in layers:
+                    layer.forward = _hook(layer, phase)
+                for x in batches:
+                    net(x if isinstance(x, NDArray) else NDArray(x))
+            finally:
+                for _, _, layer in layers:
+                    layer.__dict__.pop("forward", None)
+    finally:
+        for b, active in was_active:
+            b._active = active
     return {lid: s.threshold(calib_mode) for lid, s in stats.items()}
 
 
@@ -184,20 +233,25 @@ def _int8_contract(contract):
     return run
 
 
+def _constant(value):
+    return Constant(NDArray(value))
+
+
 class QuantizedDense(HybridBlock):
     """INT8 Dense (reference: quantized_fully_connected.cc). Holds int8
-    weights + per-channel scales; forward quantizes the activation with the
-    calibrated threshold and contracts on the MXU int8 path."""
+    weights + per-channel scales in Constant parameters; forward quantizes
+    the activation with the calibrated threshold and contracts on the MXU
+    int8 path."""
 
     def __init__(self, dense, threshold):
         super().__init__()
         w = dense.weight.data().asnumpy()
         wq, w_scale = _quantize_weight(w, axes=1)   # (units, in), scale (units,1)
-        self._wq = wq
-        self._w_scale = w_scale[:, 0]
-        self._bias = (dense.bias.data().asnumpy()
+        self.qweight = _constant(wq)
+        self.qscale = _constant(w_scale[:, 0])
+        self.qthreshold = _constant(onp.float32(threshold))
+        self.qbias = (_constant(dense.bias.data().asnumpy())
                       if dense.bias is not None else None)
-        self._threshold = float(threshold)
         self._units = dense._units
         self._flatten = dense._flatten
         self.act = dense.act
@@ -208,32 +262,34 @@ class QuantizedDense(HybridBlock):
         import jax
         import jax.numpy as jnp
 
-        wq = self._wq
-        w_scale = self._w_scale
-        bias = self._bias
-        s_x = self._threshold / 127.0
         flatten = self._flatten
 
-        def f(xv):
+        def f(xv, wq, w_scale, thresh, *rest):
+            s_x = thresh.astype(jnp.float32) / 127.0
             if flatten and xv.ndim > 2:
                 xv = xv.reshape(xv.shape[0], -1)
             xq = jnp.clip(jnp.round(xv / s_x), -127, 127).astype(jnp.int8)
             dot = _int8_contract(lambda a, b: jax.lax.dot_general(
                 a, b, (((a.ndim - 1,), (1,)), ((), ())),
                 preferred_element_type=jnp.int32))
-            acc = dot(xq, jnp.asarray(wq))
-            y = acc.astype(jnp.float32) * (s_x * jnp.asarray(w_scale))
-            if bias is not None:
-                y = y + jnp.asarray(bias)
+            acc = dot(xq, wq)
+            y = acc.astype(jnp.float32) * (s_x * w_scale)
+            if rest:
+                y = y + rest[0]
             return y.astype(xv.dtype)
 
-        out = apply_op("quantized_dense", f, (x,))
+        args = (x, self.qweight.data(), self.qscale.data(),
+                self.qthreshold.data())
+        if self.qbias is not None:
+            args = args + (self.qbias.data(),)
+        out = apply_op("quantized_dense", f, args)
         if self.act is not None:
             out = self.act(out)
         return out
 
     def __repr__(self):
-        return f"QuantizedDense({self._units}, threshold={self._threshold:.4g})"
+        t = float(self.qthreshold.data().asnumpy())
+        return f"QuantizedDense({self._units}, threshold={t:.4g})"
 
 
 class QuantizedConv2D(HybridBlock):
@@ -243,11 +299,11 @@ class QuantizedConv2D(HybridBlock):
         super().__init__()
         w = conv.weight.data().asnumpy()            # (O, I, kh, kw)
         wq, w_scale = _quantize_weight(w, axes=(1, 2, 3))
-        self._wq = wq
-        self._w_scale = w_scale.reshape(-1)         # (O,)
-        self._bias = (conv.bias.data().asnumpy()
+        self.qweight = _constant(wq)
+        self.qscale = _constant(w_scale.reshape(-1))  # (O,)
+        self.qthreshold = _constant(onp.float32(threshold))
+        self.qbias = (_constant(conv.bias.data().asnumpy())
                       if conv.bias is not None else None)
-        self._threshold = float(threshold)
         self._stride = conv._stride
         self._pad = conv._pad
         self._dilate = conv._dilate
@@ -260,14 +316,11 @@ class QuantizedConv2D(HybridBlock):
         import jax
         import jax.numpy as jnp
 
-        wq = self._wq
-        w_scale = self._w_scale
-        bias = self._bias
-        s_x = self._threshold / 127.0
         stride, pad, dilate, groups = (self._stride, self._pad,
                                        self._dilate, self._groups)
 
-        def f(xv):
+        def f(xv, wq, w_scale, thresh, *rest):
+            s_x = thresh.astype(jnp.float32) / 127.0
             xq = jnp.clip(jnp.round(xv / s_x), -127, 127).astype(jnp.int8)
             conv = _int8_contract(lambda a, b: jax.lax.conv_general_dilated(
                 a, b, window_strides=stride,
@@ -275,20 +328,25 @@ class QuantizedConv2D(HybridBlock):
                 feature_group_count=groups,
                 dimension_numbers=("NCHW", "OIHW", "NCHW"),
                 preferred_element_type=jnp.int32))
-            acc = conv(xq, jnp.asarray(wq))
+            acc = conv(xq, wq)
             y = acc.astype(jnp.float32) * (
-                s_x * jnp.asarray(w_scale)[None, :, None, None])
-            if bias is not None:
-                y = y + jnp.asarray(bias)[None, :, None, None]
+                s_x * w_scale[None, :, None, None])
+            if rest:
+                y = y + rest[0][None, :, None, None]
             return y.astype(xv.dtype)
 
-        out = apply_op("quantized_conv", f, (x,))
+        args = (x, self.qweight.data(), self.qscale.data(),
+                self.qthreshold.data())
+        if self.qbias is not None:
+            args = args + (self.qbias.data(),)
+        out = apply_op("quantized_conv", f, args)
         if self.act is not None:
             out = self.act(out)
         return out
 
     def __repr__(self):
-        return f"QuantizedConv2D(threshold={self._threshold:.4g})"
+        t = float(self.qthreshold.data().asnumpy())
+        return f"QuantizedConv2D(threshold={t:.4g})"
 
 
 # ---------------------------------------------------------------------------
@@ -310,10 +368,15 @@ def _find_target_layers(block, prefix="", exclude=None):
 
 def _replace_child(parent, name, old, new):
     parent._children[name] = new
-    # forward() reaches children through attributes, not _children
+    # forward() reaches children through attributes (`self.fc`) or through
+    # container lists (Sequential._layers) — patch both
     for attr, val in list(parent.__dict__.items()):
         if val is old:
             parent.__dict__[attr] = new
+        elif isinstance(val, list):
+            for i, item in enumerate(val):
+                if item is old:
+                    val[i] = new
 
 
 def quantize_net(net, calib_data=None, calib_mode="entropy",
@@ -348,6 +411,9 @@ def quantize_net(net, calib_data=None, calib_mode="entropy",
         _replace_child(parent, name, layer, q)
         if logger:
             logger.info("quantized %s (threshold=%.5g)", name, t)
+    # stale traced graphs still reference the fp32 layers — force re-trace
+    for b in _hybrid_blocks(net):
+        b._cached_graph = None
     return net
 
 

@@ -181,12 +181,15 @@ class Parameter:
 
 
 class Constant(Parameter):
-    """Non-learnable parameter holding a constant (reference: parameter.py)."""
+    """Non-learnable parameter holding a constant (reference: parameter.py).
+    The device buffer is shared between `value`, the initializer, and the
+    working `_data` — one copy, ready to use without `initialize()`."""
 
     def __init__(self, value, name=None):
         if not isinstance(value, NDArray):
             value = NDArray(value)
         self.value = value
         super().__init__(shape=value.shape, dtype=value.dtype,
-                         init=init_mod.Constant(value.asnumpy()),
+                         init=init_mod.Constant(value),
                          grad_req="null", name=name)
+        self._data = value

@@ -616,6 +616,7 @@ def apply_op(name, jfn, args, kwargs=None, n_outputs=1, out=None):
 
 
 _JIT_CACHE: dict = {}
+_JIT_CACHE_CAP = 2048
 _JIT_DENY: set = set()
 _JIT_FAILS: dict = {}
 _JIT_MAX_FAILS = 3
@@ -625,14 +626,13 @@ def _static_marker(a):
     """Hashable, type-tagged stand-in for a non-tensor static value (cache
     key part). The type tag keeps 1 / 1.0 / True from colliding (Python
     hash-equality would otherwise reuse a closure with the wrong constant
-    baked in). Plain int/float scalars are NOT baked in — they are lifted
-    to traced weak-typed operands (see apply_op_flat), so a per-step
-    varying scalar does not trigger one XLA compile per value. Raises
-    TypeError for unhashable values — caller falls back to eager."""
+    baked in). Every non-tensor value participates in the key by VALUE:
+    scalars stay baked into pure_fn's closure (jnp structural params like
+    axis/sections must be static), so two calls differing only in a scalar
+    must compile separately. Raises TypeError for unhashable values —
+    caller falls back to eager."""
     if isinstance(a, NDArray):
         return "<T>"
-    if type(a) in (int, float):          # bool excluded: stays static
-        return f"<S:{type(a).__name__}>"
     if isinstance(a, (list, tuple)):
         return (type(a).__name__,) + tuple(_static_marker(b) for b in a)
     hash(a)
@@ -666,6 +666,11 @@ def _cached_jit(name, jfn, args, kwargs, pure_fn, call_vals):
     except TypeError:
         return None
     if jitted is None:
+        if len(_JIT_CACHE) >= _JIT_CACHE_CAP:
+            # scalar-valued keys can be unbounded (e.g. x * python_scalar
+            # with a per-step value) — drop the oldest half, insertion order
+            for stale in list(_JIT_CACHE)[:_JIT_CACHE_CAP // 2]:
+                _JIT_CACHE.pop(stale, None)
         jitted = jax.jit(pure_fn)
         _JIT_CACHE[key] = jitted
     try:
