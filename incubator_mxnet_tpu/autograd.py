@@ -176,6 +176,20 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True):  # no
     import jax.numpy as jnp
 
     from .ndarray.ndarray import NDArray
+    from .ndarray.sparse import RowSparseNDArray
+
+    def _acc(a, b):
+        """Cotangent accumulation that keeps row-sparse cots sparse when
+        both sides are sparse (embedding grads), densifying otherwise."""
+        sa = isinstance(a, RowSparseNDArray)
+        sb = isinstance(b, RowSparseNDArray)
+        if sa and sb:
+            return a + b                      # concat rows, sums on use
+        if sa:
+            return a._data + b
+        if sb:
+            return a + b._data
+        return a + b
 
     if isinstance(heads, NDArray):
         heads = [heads]
@@ -198,12 +212,12 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True):  # no
     def _seed(arr, cot):
         if arr._node is not None:
             key = (id(arr._node), arr._out_idx)
-            node_cots[key] = cot if key not in node_cots else node_cots[key] + cot
+            node_cots[key] = cot if key not in node_cots else _acc(node_cots[key], cot)
         if arr._grad is not None:
             k = id(arr)
             leaf_arrays[k] = arr
             if arr._node is None:
-                leaf_cots[k] = cot if k not in leaf_cots else leaf_cots[k] + cot
+                leaf_cots[k] = cot if k not in leaf_cots else _acc(leaf_cots[k], cot)
 
     for h, hg in zip(heads, head_grads):
         if hg is None:
@@ -228,7 +242,9 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True):  # no
         if not any_ct:
             continue
         cots = [
-            jnp.zeros(av.shape, av.dtype) if c is None else jnp.asarray(c, av.dtype)
+            jnp.zeros(av.shape, av.dtype) if c is None
+            else jnp.asarray(c._data if isinstance(c, RowSparseNDArray) else c,
+                             av.dtype)
             for c, av in zip(cots, node.out_avals)
         ]
         if node.vjp_fn is not None:
@@ -243,17 +259,17 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True):  # no
             pn = parent._node
             if pn is not None and id(pn) in node_map:
                 key = (id(pn), parent._out_idx)
-                node_cots[key] = ict if key not in node_cots else node_cots[key] + ict
+                node_cots[key] = ict if key not in node_cots else _acc(node_cots[key], ict)
             if parent._grad is not None and parent._node is None:
                 k = id(parent)
                 leaf_arrays[k] = parent
-                leaf_cots[k] = ict if k not in leaf_cots else leaf_cots[k] + ict
+                leaf_cots[k] = ict if k not in leaf_cots else _acc(leaf_cots[k], ict)
             elif parent._grad is not None and pn is not None and id(pn) not in node_map:
                 # attached-grad array whose producing node is outside this
                 # backward's reachable set: treat as leaf
                 k = id(parent)
                 leaf_arrays[k] = parent
-                leaf_cots[k] = ict if k not in leaf_cots else leaf_cots[k] + ict
+                leaf_cots[k] = ict if k not in leaf_cots else _acc(leaf_cots[k], ict)
 
     # handle attached-grad arrays that are themselves intermediates: their
     # cotangent equals the node output cotangent remaining after traversal is
@@ -266,6 +282,24 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True):  # no
         if req == "null":
             continue
         g = arr._grad
+        if isinstance(ict, RowSparseNDArray):
+            if isinstance(g, RowSparseNDArray):
+                # sparse cot into sparse grad: no densify on this path
+                dt = g._sp_values.dtype
+                if req == "add" and g._sp_values.shape[0]:
+                    merged = g + ict
+                    g._set_sparse(merged._sp_values.astype(dt),
+                                  merged._sp_indices)
+                else:
+                    g._set_sparse(ict._sp_values.astype(dt), ict._sp_indices)
+            else:
+                dense = ict._data
+                if req == "add":
+                    g._data = g._data + dense.astype(g._data.dtype)
+                else:
+                    g._data = dense.astype(g._data.dtype)
+                g._version += 1
+            continue
         if req == "add":
             g._data = g._data + ict.astype(g._data.dtype)
         else:

@@ -8,6 +8,7 @@ attributes lazily forward to the numpy namespace so the long tail of
 from __future__ import annotations
 
 from .ndarray import NDArray, apply_op, apply_op_flat, array, from_jax, waitall  # noqa: F401
+from . import sparse  # noqa: F401  (mx.nd.sparse namespace)
 
 # legacy CamelCase op names → npx equivalents
 _LEGACY_TO_NPX = {
@@ -54,21 +55,47 @@ def __getattr__(name):
     raise AttributeError(f"module 'nd' has no attribute {name!r}")
 
 
-def save(fname, data):
-    """Save NDArrays to the reference's `.params`-style container.
+def _save_entries(payload, key, d):
+    from .sparse import CSRNDArray, RowSparseNDArray
 
-    Reference format: `src/ndarray/ndarray.cc` Save/Load. The TPU build uses
-    a numpy `.npz`-based container with a name-manifest, readable by
+    if isinstance(d, RowSparseNDArray):
+        import numpy as onp
+
+        u, v = d._canonical()
+        payload[f"rs!{key}!indices"] = onp.asarray(u)
+        payload[f"rs!{key}!values"] = onp.asarray(v)
+        payload[f"rs!{key}!shape"] = onp.asarray(d.shape)
+    elif isinstance(d, CSRNDArray):
+        import numpy as onp
+
+        payload[f"csr!{key}!data"] = onp.asarray(d._sp_data)
+        payload[f"csr!{key}!indices"] = onp.asarray(d._sp_col_indices)
+        payload[f"csr!{key}!indptr"] = onp.asarray(d._sp_indptr)
+        payload[f"csr!{key}!shape"] = onp.asarray(d.shape)
+    else:
+        payload[key] = d.asnumpy()
+
+
+def save(fname, data):
+    """Save NDArrays (dense, row_sparse, csr) to the `.params`-style
+    container.
+
+    Reference format: `src/ndarray/ndarray.cc` Save/Load (magic + dense
+    AND sparse chunks). The TPU build uses a numpy `.npz`-based container
+    with a name-manifest and per-stype component entries, readable by
     `nd.load`; `.npy`/`.npz` parity matches `src/serialization/cnpy.cc`.
     """
     import numpy as onp
 
     if isinstance(data, NDArray):
         data = [data]
+    payload: dict = {}
     if isinstance(data, (list, tuple)):
-        payload = {f"arr:{i}": d.asnumpy() for i, d in enumerate(data)}
+        for i, d in enumerate(data):
+            _save_entries(payload, f"arr:{i}", d)
     elif isinstance(data, dict):
-        payload = {f"named:{k}": v.asnumpy() for k, v in data.items()}
+        for k, v in data.items():
+            _save_entries(payload, f"named:{k}", v)
     else:
         raise TypeError("save expects NDArray, list of NDArray, or dict")
     onp.savez(fname if fname.endswith(".npz") else fname, **payload)
@@ -81,10 +108,30 @@ def save(fname, data):
 def load(fname):
     import numpy as onp
 
+    from .sparse import CSRNDArray, RowSparseNDArray
+
     with onp.load(fname, allow_pickle=False) as z:
-        keys = list(z.keys())
-        if keys and keys[0].startswith("named:"):
-            return {k[len("named:"):]: array(z[k]) for k in keys}
-        if keys and keys[0].startswith("arr:"):
-            return [array(z[k]) for k in sorted(keys, key=lambda s: int(s.split(":")[1]))]
-        return {k: array(z[k]) for k in keys}
+        entries: dict = {}
+        for k in z.keys():
+            if k.startswith(("rs!", "csr!")):
+                stype, key, comp = k.split("!", 2)
+                entries.setdefault(key, {"stype": stype})[comp] = z[k]
+            else:
+                entries[k] = {"stype": "default", "value": z[k]}
+
+    def build(e):
+        if e["stype"] == "rs":
+            return RowSparseNDArray(e["values"], e["indices"],
+                                    tuple(e["shape"]))
+        if e["stype"] == "csr":
+            return CSRNDArray(e["data"], e["indices"], e["indptr"],
+                              tuple(e["shape"]))
+        return array(e["value"])
+
+    keys = list(entries)
+    if keys and keys[0].startswith("named:"):
+        return {k[len("named:"):]: build(entries[k]) for k in keys}
+    if keys and keys[0].startswith("arr:"):
+        return [build(entries[k])
+                for k in sorted(keys, key=lambda s: int(s.split(":")[1]))]
+    return {k: build(entries[k]) for k in keys}

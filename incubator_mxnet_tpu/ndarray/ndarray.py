@@ -188,10 +188,18 @@ class NDArray:
         self.wait_to_read()
 
     # ---------------------------------------------------------------- autograd
-    def attach_grad(self, grad_req="write", stype=None):  # noqa: ARG002
-        """Allocate a gradient buffer updated by backward (MXNet parity)."""
+    def attach_grad(self, grad_req="write", stype=None):
+        """Allocate a gradient buffer updated by backward (MXNet parity).
+        stype='row_sparse' allocates an empty row-sparse grad so sparse
+        cotangents (embedding with sparse_grad=True) never densify."""
         jnp = _jnp()
-        self._grad = NDArray(jnp.zeros(self.shape, self._data.dtype))
+        if stype == "row_sparse":
+            from .sparse import zeros as sparse_zeros
+
+            self._grad = sparse_zeros("row_sparse", self.shape,
+                                      dtype=self._data.dtype)
+        else:
+            self._grad = NDArray(jnp.zeros(self.shape, self._data.dtype))
         self._grad_req = grad_req
         self._node = None  # becomes a leaf from autograd's perspective
 
@@ -328,9 +336,17 @@ class NDArray:
         return NDArray(_jnp().full_like(self._data, fill_value))
 
     def tostype(self, stype):
-        if stype != "default":
-            raise ValueError("only dense ('default') storage is supported on TPU")
-        return self
+        if stype == "default":
+            return self
+        if stype == "row_sparse":
+            from .sparse import row_sparse_array
+
+            return row_sparse_array(self)
+        if stype == "csr":
+            from .sparse import csr_matrix
+
+            return csr_matrix(self)
+        raise ValueError(f"unknown storage type {stype!r}")
 
     # ------------------------------------------------------------- indexing
     def __getitem__(self, key):

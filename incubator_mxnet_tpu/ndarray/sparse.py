@@ -1,0 +1,426 @@
+"""Sparse NDArray storage: row_sparse + CSR (reference:
+`include/mxnet/ndarray.h:60-64` kRowSparseStorage/kCSRStorage,
+`python/mxnet/ndarray/sparse.py` RowSparseNDArray/CSRNDArray).
+
+TPU-native design: XLA has no first-class sparse kernels, so sparse
+storage is a *representation* choice, not a kernel dialect —
+`RowSparseNDArray` keeps `(indices, values)` jax buffers and densifies
+lazily on first dense use (the reference's storage-fallback,
+`src/common/exec_utils.h` DefaultStorage conversion). The payoff paths
+never densify:
+
+- embedding gradients (`npx.embedding(sparse_grad=True)`) flow to the
+  optimizer as `(rows, grad_rows)`, and the sgd/adam/adagrad lazy
+  updates scatter only the live rows on device
+  (reference: sparse variants in `src/operator/optimizer_op.cc`),
+- `retain` / `row_sparse_pull` slice rows without a (vocab, dim) buffer.
+
+CSR matmul rides `jax.experimental.sparse` BCOO (jax's native sparse
+lowering), everything else falls back to dense compute.
+"""
+from __future__ import annotations
+
+import numpy as onp
+
+from .ndarray import NDArray, apply_op
+
+__all__ = ["RowSparseNDArray", "CSRNDArray", "row_sparse_array",
+           "csr_matrix", "zeros", "array", "retain", "dot"]
+
+
+def _jnp():
+    import jax.numpy as jnp
+
+    return jnp
+
+
+# ---------------------------------------------------------------------------
+# row_sparse
+# ---------------------------------------------------------------------------
+
+class RowSparseNDArray(NDArray):
+    """Rows-compressed tensor: `indices` (nnz,) int32 row ids + `values`
+    (nnz, *row_shape). Duplicate indices are allowed internally (gradient
+    accumulation concatenates) and sum on densify; `tostype`/`data`
+    canonicalize to sorted unique rows like the reference's storage."""
+
+    __slots__ = ("_sp_indices", "_sp_values", "_sp_shape")
+
+    def __init__(self, values, indices, shape, dtype=None):
+        jnp = _jnp()
+        vals = jnp.asarray(values, dtype=dtype) if dtype is not None \
+            else jnp.asarray(values)
+        idx = jnp.asarray(indices, jnp.int32).reshape(-1)
+        if vals.ndim == 0 or vals.shape[0] != idx.shape[0]:
+            raise ValueError(
+                f"values rows {vals.shape} must match indices {idx.shape}")
+        shape = tuple(int(s) for s in shape)
+        if tuple(vals.shape[1:]) != shape[1:]:
+            raise ValueError(
+                f"value row shape {vals.shape[1:]} != array row shape {shape[1:]}")
+        # base slots, without densifying (dense buffer stays None until used)
+        NDArray._data.__set__(self, None)
+        self._device = None
+        self._version = 0
+        self._grad = None
+        self._grad_req = "write"
+        self._node = None
+        self._out_idx = 0
+        self._sp_indices = idx
+        self._sp_values = vals
+        self._sp_shape = shape
+
+    # -- storage ------------------------------------------------------------
+    @property
+    def _data(self):
+        d = NDArray._data.__get__(self)
+        if d is None:
+            jnp = _jnp()
+            d = jnp.zeros(self._sp_shape, self._sp_values.dtype).at[
+                self._sp_indices].add(self._sp_values)
+            NDArray._data.__set__(self, d)
+        return d
+
+    @_data.setter
+    def _data(self, value):
+        # explicit dense assignment (mutation funnel, zero_grad fallback…)
+        # re-expresses the array as all-rows-stored so the sparse fields
+        # never go stale; the buffer is shared, not copied
+        NDArray._data.__set__(self, value)
+        if value is not None:
+            jnp = _jnp()
+            self._sp_indices = jnp.arange(value.shape[0], dtype=jnp.int32)
+            self._sp_values = value
+
+    def _set_sparse(self, values, indices):
+        """Rebind the sparse payload in place (the sparse mutation
+        primitive — used by backward's gradient deposit)."""
+        self._sp_values = values
+        self._sp_indices = indices
+        NDArray._data.__set__(self, None)
+        self._version += 1
+
+    @property
+    def stype(self):
+        return "row_sparse"
+
+    @property
+    def shape(self):
+        return self._sp_shape
+
+    @property
+    def dtype(self):
+        jnp = _jnp()
+        dt = self._sp_values.dtype
+        return onp.dtype(dt) if dt != jnp.bfloat16 else jnp.bfloat16
+
+    @property
+    def ndim(self):
+        return len(self._sp_shape)
+
+    def _canonical(self):
+        """(sorted unique indices, summed values) — eager only."""
+        jnp = _jnp()
+        u, inv = jnp.unique(self._sp_indices, return_inverse=True)
+        vals = jnp.zeros((u.shape[0],) + self._sp_shape[1:],
+                         self._sp_values.dtype).at[inv].add(self._sp_values)
+        return u.astype(jnp.int32), vals
+
+    @property
+    def indices(self):
+        u, _ = self._canonical()
+        return NDArray(u)
+
+    @property
+    def data(self):
+        _, v = self._canonical()
+        return NDArray(v)
+
+    @property
+    def num_rows(self):
+        return int(self.indices.shape[0])
+
+    def tostype(self, stype):
+        if stype == "row_sparse":
+            return self
+        if stype == "default":
+            out = NDArray(self._data)
+            return out
+        raise ValueError(f"cannot convert row_sparse to {stype!r}")
+
+    def retain(self, indices):
+        return retain(self, indices)
+
+    def copy(self):
+        return RowSparseNDArray(self._sp_values, self._sp_indices,
+                                self._sp_shape)
+
+    def asnumpy(self):
+        return onp.asarray(self._data) if self._sp_values.dtype != _jnp().bfloat16 \
+            else onp.asarray(self._data.astype(_jnp().float32))
+
+    def __repr__(self):
+        return (f"\n<RowSparseNDArray {self._sp_shape} "
+                f"({self._sp_indices.shape[0]} stored rows)>")
+
+    # sparse + sparse keeps sparsity (gradient accumulation path);
+    # anything else falls back to dense compute
+    def __add__(self, other):
+        jnp = _jnp()
+        if isinstance(other, RowSparseNDArray):
+            if other._sp_shape != self._sp_shape:
+                raise ValueError("shape mismatch")
+            return RowSparseNDArray(
+                jnp.concatenate([self._sp_values,
+                                 other._sp_values.astype(self._sp_values.dtype)]),
+                jnp.concatenate([self._sp_indices, other._sp_indices]),
+                self._sp_shape)
+        return NDArray.__add__(self, other)
+
+    __radd__ = __add__
+
+
+# ---------------------------------------------------------------------------
+# CSR
+# ---------------------------------------------------------------------------
+
+class CSRNDArray(NDArray):
+    """Compressed sparse row matrix (2-D): data (nnz,), indices (nnz,)
+    column ids, indptr (rows+1,). Dense fallback is lazy; `dot` with a
+    dense rhs stays sparse via jax BCOO."""
+
+    __slots__ = ("_sp_data", "_sp_col_indices", "_sp_indptr", "_sp_shape")
+
+    def __init__(self, data, indices, indptr, shape, dtype=None):
+        jnp = _jnp()
+        vals = jnp.asarray(data, dtype=dtype) if dtype is not None \
+            else jnp.asarray(data)
+        col = jnp.asarray(indices, jnp.int32).reshape(-1)
+        ptr = jnp.asarray(indptr, jnp.int32).reshape(-1)
+        shape = tuple(int(s) for s in shape)
+        if len(shape) != 2:
+            raise ValueError("CSRNDArray must be 2-D")
+        if ptr.shape[0] != shape[0] + 1:
+            raise ValueError(f"indptr length {ptr.shape[0]} != rows+1")
+        NDArray._data.__set__(self, None)
+        self._device = None
+        self._version = 0
+        self._grad = None
+        self._grad_req = "write"
+        self._node = None
+        self._out_idx = 0
+        self._sp_data = vals
+        self._sp_col_indices = col
+        self._sp_indptr = ptr
+        self._sp_shape = shape
+
+    def _row_ids(self):
+        jnp = _jnp()
+        counts = self._sp_indptr[1:] - self._sp_indptr[:-1]
+        return jnp.repeat(jnp.arange(self._sp_shape[0], dtype=jnp.int32),
+                          counts, total_repeat_length=self._sp_data.shape[0])
+
+    def _bcoo(self):
+        import jax.experimental.sparse as jsparse
+        jnp = _jnp()
+
+        coords = jnp.stack([self._row_ids(), self._sp_col_indices], axis=1)
+        return jsparse.BCOO((self._sp_data, coords), shape=self._sp_shape)
+
+    @property
+    def _data(self):
+        d = NDArray._data.__get__(self)
+        if d is None:
+            jnp = _jnp()
+            d = jnp.zeros(self._sp_shape, self._sp_data.dtype).at[
+                self._row_ids(), self._sp_col_indices].add(self._sp_data)
+            NDArray._data.__set__(self, d)
+        return d
+
+    @_data.setter
+    def _data(self, value):
+        NDArray._data.__set__(self, value)
+
+    @property
+    def stype(self):
+        return "csr"
+
+    @property
+    def shape(self):
+        return self._sp_shape
+
+    @property
+    def dtype(self):
+        jnp = _jnp()
+        dt = self._sp_data.dtype
+        return onp.dtype(dt) if dt != jnp.bfloat16 else jnp.bfloat16
+
+    @property
+    def ndim(self):
+        return 2
+
+    @property
+    def data(self):
+        return NDArray(self._sp_data)
+
+    @property
+    def indices(self):
+        return NDArray(self._sp_col_indices)
+
+    @property
+    def indptr(self):
+        return NDArray(self._sp_indptr)
+
+    def tostype(self, stype):
+        if stype == "csr":
+            return self
+        if stype == "default":
+            return NDArray(self._data)
+        if stype == "row_sparse":
+            return NDArray(self._data).tostype("row_sparse")
+        raise ValueError(f"cannot convert csr to {stype!r}")
+
+    def copy(self):
+        return CSRNDArray(self._sp_data, self._sp_col_indices,
+                          self._sp_indptr, self._sp_shape)
+
+    def asnumpy(self):
+        return onp.asarray(self._data)
+
+    def __repr__(self):
+        return (f"\n<CSRNDArray {self._sp_shape} "
+                f"({self._sp_data.shape[0]} stored elements)>")
+
+
+# ---------------------------------------------------------------------------
+# creation / conversion
+# ---------------------------------------------------------------------------
+
+def row_sparse_array(arg1, shape=None, dtype=None, ctx=None, device=None):  # noqa: ARG001
+    """Create a RowSparseNDArray from (data, indices) or a dense source
+    (reference: `python/mxnet/ndarray/sparse.py` row_sparse_array)."""
+    if isinstance(arg1, RowSparseNDArray):
+        return arg1
+    if isinstance(arg1, tuple) and len(arg1) == 2 and not onp.isscalar(arg1[0]):
+        values, indices = arg1
+        if shape is None:
+            raise ValueError("shape is required with (data, indices)")
+        if isinstance(values, NDArray):
+            values = values._data
+        if isinstance(indices, NDArray):
+            indices = indices._data
+        return RowSparseNDArray(values, indices, shape, dtype=dtype)
+    dense = arg1._data if isinstance(arg1, NDArray) else onp.asarray(arg1)
+    return _dense_to_row_sparse(dense, shape, dtype)
+
+
+def _dense_to_row_sparse(dense, shape=None, dtype=None):
+    a = onp.asarray(dense, dtype=dtype)
+    shape = tuple(shape) if shape is not None else a.shape
+    nz = onp.nonzero(a.reshape(a.shape[0], -1).any(axis=1))[0]
+    return RowSparseNDArray(a[nz], nz.astype(onp.int32), shape)
+
+
+def csr_matrix(arg1, shape=None, dtype=None, ctx=None, device=None):  # noqa: ARG001
+    """Create a CSRNDArray from (data, indices, indptr), a dense source, or
+    a scipy.sparse matrix (reference: sparse.py csr_matrix)."""
+    if isinstance(arg1, CSRNDArray):
+        return arg1
+    if isinstance(arg1, tuple) and len(arg1) == 3:
+        data, indices, indptr = arg1
+        if shape is None:
+            raise ValueError("shape is required with (data, indices, indptr)")
+        vals = [v._data if isinstance(v, NDArray) else v
+                for v in (data, indices, indptr)]
+        return CSRNDArray(vals[0], vals[1], vals[2], shape, dtype=dtype)
+    if hasattr(arg1, "tocsr"):               # scipy.sparse matrix
+        m = arg1.tocsr()
+        return CSRNDArray(m.data, m.indices, m.indptr, m.shape, dtype=dtype)
+    dense = arg1.asnumpy() if isinstance(arg1, NDArray) else onp.asarray(arg1)
+    if dtype is not None:
+        dense = dense.astype(dtype)
+    if dense.ndim != 2:
+        raise ValueError("csr_matrix requires a 2-D source")
+    rows, cols = onp.nonzero(dense)
+    order = onp.lexsort((cols, rows))
+    rows, cols = rows[order], cols[order]
+    data = dense[rows, cols]
+    indptr = onp.zeros(dense.shape[0] + 1, dtype=onp.int32)
+    onp.add.at(indptr, rows + 1, 1)
+    indptr = onp.cumsum(indptr).astype(onp.int32)
+    return CSRNDArray(data, cols.astype(onp.int32), indptr, dense.shape)
+
+
+def zeros(stype, shape, ctx=None, device=None, dtype="float32"):  # noqa: ARG001
+    jnp = _jnp()
+    from ..base import np_dtype
+
+    dt = np_dtype(dtype)
+    shape = (shape,) if isinstance(shape, int) else tuple(shape)
+    if stype == "row_sparse":
+        return RowSparseNDArray(jnp.zeros((0,) + shape[1:], dt),
+                                jnp.zeros((0,), jnp.int32), shape)
+    if stype == "csr":
+        return CSRNDArray(jnp.zeros((0,), dt), jnp.zeros((0,), jnp.int32),
+                          jnp.zeros((shape[0] + 1,), jnp.int32), shape)
+    if stype == "default":
+        return NDArray(jnp.zeros(shape, dt))
+    raise ValueError(f"unknown stype {stype!r}")
+
+
+def array(source, stype="csr", shape=None, dtype=None, **kwargs):  # noqa: ARG001
+    if stype == "csr":
+        return csr_matrix(source, shape=shape, dtype=dtype)
+    if stype == "row_sparse":
+        return row_sparse_array(source, shape=shape, dtype=dtype)
+    return NDArray(source, dtype=dtype)
+
+
+def empty(stype, shape, ctx=None, device=None, dtype="float32"):
+    return zeros(stype, shape, ctx=ctx, device=device, dtype=dtype)
+
+
+# ---------------------------------------------------------------------------
+# ops
+# ---------------------------------------------------------------------------
+
+def retain(rsp, indices):
+    """Keep only the requested rows (reference: `_retain` sparse op) —
+    the row_sparse_pull building block."""
+    jnp = _jnp()
+    if not isinstance(rsp, RowSparseNDArray):
+        raise TypeError("retain expects a RowSparseNDArray")
+    want = indices._data if isinstance(indices, NDArray) else jnp.asarray(indices)
+    want = want.reshape(-1).astype(jnp.int32)
+    u, vals = rsp._canonical()
+    # membership of each stored row in the wanted set (eager, shapes concrete)
+    keep = jnp.isin(u, want)
+    kept_idx = u[keep]
+    kept_vals = vals[keep]
+    return RowSparseNDArray(kept_vals, kept_idx, rsp._sp_shape)
+
+
+def dot(lhs, rhs, transpose_a=False, transpose_b=False):
+    """Sparse-aware dot (reference: `src/operator/tensor/dot-inl.h`):
+    csr @ dense and csr.T @ dense run through jax BCOO without
+    densifying; other combinations fall back to dense."""
+    jnp = _jnp()
+    if isinstance(lhs, CSRNDArray) and isinstance(rhs, NDArray) \
+            and not isinstance(rhs, (CSRNDArray, RowSparseNDArray)):
+        m = lhs._bcoo()
+        if transpose_a:
+            m = m.T
+        r = rhs._data.T if transpose_b else rhs._data
+        out = m @ r
+        return NDArray(out)
+    a = lhs.tostype("default") if hasattr(lhs, "tostype") else lhs
+    b = rhs.tostype("default") if hasattr(rhs, "tostype") else rhs
+    av = a._data.T if transpose_a else a._data
+    bv = b._data.T if transpose_b else b._data
+    return apply_op("dot", lambda x, y: x @ y, (NDArray(av), NDArray(bv)))
+
+
+def add(lhs, rhs):
+    if isinstance(lhs, RowSparseNDArray) and isinstance(rhs, RowSparseNDArray):
+        return lhs + rhs
+    return NDArray(lhs._data + rhs._data)
