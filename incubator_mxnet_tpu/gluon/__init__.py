@@ -9,3 +9,4 @@ from . import loss  # noqa: F401
 from . import metric  # noqa: F401
 from . import data  # noqa: F401
 from . import model_zoo  # noqa: F401
+from . import probability  # noqa: F401

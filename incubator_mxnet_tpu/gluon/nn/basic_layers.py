@@ -252,21 +252,27 @@ class InstanceNorm(HybridBlock):
 
 
 class Embedding(HybridBlock):
-    """Index → vector lookup (reference: basic_layers.py Embedding; the
-    backward scatter-add is XLA's native embedding-gradient path, replacing
-    the reference's row_sparse gradient option)."""
+    """Index → vector lookup (reference: basic_layers.py Embedding).
+    Default backward is XLA's native scatter-add; `sparse_grad=True` keeps
+    the reference's row_sparse gradient option: the weight grad is a
+    RowSparseNDArray holding only looked-up rows, and sgd/adam apply lazy
+    row updates (reference `src/operator/optimizer_op.cc` sparse variants)."""
 
     def __init__(self, input_dim, output_dim, dtype="float32",
                  weight_initializer=None, sparse_grad=False, **kwargs):
         super().__init__()
         self._input_dim = input_dim
         self._output_dim = output_dim
+        self._sparse_grad = sparse_grad
         self.weight = Parameter(shape=(input_dim, output_dim), dtype=dtype,
-                                init=weight_initializer)
+                                init=weight_initializer,
+                                grad_stype="row_sparse" if sparse_grad
+                                else "default")
 
     def forward(self, x):
         return npx.embedding(x, self.weight.data(), input_dim=self._input_dim,
-                             output_dim=self._output_dim)
+                             output_dim=self._output_dim,
+                             sparse_grad=self._sparse_grad)
 
 
 class Lambda(Block):

@@ -40,6 +40,8 @@ class Parameter:
         self.lr_mult = lr_mult
         self.wd_mult = wd_mult
         self.grad_req = grad_req if differentiable else "null"
+        self._stype = stype
+        self._grad_stype = grad_stype
         self._allow_deferred_init = allow_deferred_init
         self._data: NDArray | None = None
         self._deferred_init = None  # (initializer, device)
@@ -95,7 +97,9 @@ class Parameter:
             initializer(self._name, arr)
         self._data = arr
         if self.grad_req != "null":
-            arr.attach_grad(self.grad_req)
+            arr.attach_grad(self.grad_req,
+                            stype=self._grad_stype
+                            if self._grad_stype != "default" else None)
         self._deferred_init = None
 
     def _finish_deferred_init(self):
@@ -143,8 +147,15 @@ class Parameter:
         if self._data is not None and self._data._grad is not None:
             import jax.numpy as jnp
 
+            from ..ndarray.sparse import RowSparseNDArray
+
             g = self._data._grad
-            g._set_data(jnp.zeros(g.shape, g._data.dtype))
+            if isinstance(g, RowSparseNDArray):
+                g._set_sparse(
+                    jnp.zeros((0,) + g.shape[1:], g._sp_values.dtype),
+                    jnp.zeros((0,), jnp.int32))
+            else:
+                g._set_data(jnp.zeros(g.shape, g._data.dtype))
 
     def set_data(self, data):
         d = self.data() if self._data is not None else None

@@ -1,0 +1,16 @@
+"""`gluon.probability` — distributions, bijectors and stochastic blocks
+(reference: `python/mxnet/gluon/probability/__init__.py`; ~5k LoC package).
+
+TPU-native design notes:
+- densities/entropies compose autograd-aware `np` ops (tape + XLA fusion);
+- samplers are single fused `jax.random` kernels recorded on the tape, with
+  pathwise gradients where jax supplies them (normal/uniform family via
+  location-scale, gamma/beta/dirichlet via implicit reparameterization);
+- everything traces under hybridize: parameters are traced arrays, PRNG keys
+  come from the traced global key stack (`random.trace_key_scope`).
+"""
+from .distributions import *  # noqa: F401,F403
+from .transformation import *  # noqa: F401,F403
+from .block import *  # noqa: F401,F403
+
+from . import distributions, transformation, block  # noqa: F401

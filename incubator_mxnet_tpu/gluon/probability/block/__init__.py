@@ -1,0 +1,5 @@
+"""Stochastic blocks (reference:
+`python/mxnet/gluon/probability/block/stochastic_block.py`)."""
+from .stochastic_block import StochasticBlock, StochasticSequential  # noqa: F401
+
+__all__ = ["StochasticBlock", "StochasticSequential"]

@@ -1,0 +1,108 @@
+"""StochasticBlock — HybridBlock with forward-phase loss accumulation
+(reference: `python/mxnet/gluon/probability/block/stochastic_block.py:28-135`).
+
+Used for Bayesian networks where the objective combines a data loss with KL
+terms produced inside `forward`. The decorated forward returns
+`(output, collected_losses)`; `__call__` stores the losses on the block and
+hands back the plain output.
+"""
+from __future__ import annotations
+
+from functools import wraps
+
+from ...block import HybridBlock
+
+__all__ = ["StochasticBlock", "StochasticSequential"]
+
+
+class StochasticBlock(HybridBlock):
+    """HybridBlock that accumulates auxiliary losses during forward."""
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self._losses = []
+        self._losscache = []
+        self._flag = False  # whether collectLoss ran this call
+
+    def add_loss(self, loss):
+        self._losscache.append(loss)
+
+    @staticmethod
+    def collectLoss(func):
+        """Decorator for `forward`: collects losses added via `add_loss`.
+
+        Example::
+
+            @StochasticBlock.collectLoss
+            def forward(self, loc, scale):
+                qz = mgp.Normal(loc, scale)
+                pz = mgp.Normal(np.zeros_like(loc), np.ones_like(scale))
+                self.add_loss(mgp.kl_divergence(qz, pz))
+                return qz.sample()
+        """
+
+        @wraps(func)
+        def inner(self, *args, **kwargs):
+            func_out = func(self, *args, **kwargs)
+            collected_loss = self._losscache
+            self._losscache = []
+            self._flag = True
+            return (func_out, collected_loss)
+
+        return inner
+
+    def __call__(self, *args, **kwargs):
+        self._flag = False
+        out = super().__call__(*args, **kwargs)
+        if not self._flag:
+            raise ValueError("The forward function should be decorated by "
+                             "StochasticBlock.collectLoss")
+        self._losses = out[1]
+        return out[0]
+
+    @property
+    def losses(self):
+        return self._losses
+
+
+class StochasticSequential(StochasticBlock):
+    """Stack of blocks, propagating child StochasticBlock losses."""
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self._layers = []
+
+    def add(self, *blocks):
+        for block in blocks:
+            self._layers.append(block)
+            self.register_child(block)
+
+    @StochasticBlock.collectLoss
+    def forward(self, x, *args):
+        for block in self._children.values():
+            x = block(x, *args)
+            args = []
+            if isinstance(x, (tuple, list)):
+                args = x[1:]
+                x = x[0]
+        if args:
+            x = tuple([x] + list(args))
+        for block in self._layers:
+            if getattr(block, "_losses", None):
+                self.add_loss(block._losses)
+        return x
+
+    def __repr__(self):
+        mods = "\n".join(f"  ({k}): {b!r}" for k, b in self._children.items())
+        return f"{type(self).__name__}(\n{mods}\n)"
+
+    def __getitem__(self, key):
+        layers = list(self._children.values())[key]
+        if isinstance(layers, list):
+            net = type(self)()
+            net.add(*layers)
+            return net
+        return layers
+
+    def __len__(self):
+        return len(self._children)

@@ -1,0 +1,23 @@
+"""Distributions (reference: `python/mxnet/gluon/probability/distributions/`)."""
+from . import constraint  # noqa: F401
+from .distribution import Distribution, ExponentialFamily  # noqa: F401
+from .continuous import (Beta, Cauchy, Chi2, Dirichlet, Exponential,  # noqa: F401
+                         FisherSnedecor, Gamma, Gumbel, HalfCauchy,
+                         HalfNormal, Laplace, MultivariateNormal, Normal,
+                         Pareto, StudentT, Uniform, Weibull)
+from .discrete import (Bernoulli, Binomial, Categorical, Geometric,  # noqa: F401
+                       Multinomial, NegativeBinomial, OneHotCategorical,
+                       Poisson, RelaxedBernoulli, RelaxedOneHotCategorical)
+from .compound import Independent, TransformedDistribution  # noqa: F401
+from .divergence import empirical_kl, kl_divergence, register_kl  # noqa: F401
+
+__all__ = [
+    "Distribution", "ExponentialFamily", "Normal", "Laplace", "Cauchy",
+    "HalfCauchy", "HalfNormal", "Uniform", "Exponential", "Pareto", "Gamma",
+    "Chi2", "FisherSnedecor", "StudentT", "Weibull", "Gumbel", "Beta",
+    "Dirichlet", "MultivariateNormal", "Bernoulli", "Binomial", "Geometric",
+    "NegativeBinomial", "Poisson", "Categorical", "OneHotCategorical",
+    "Multinomial", "RelaxedBernoulli", "RelaxedOneHotCategorical",
+    "Independent", "TransformedDistribution", "register_kl", "kl_divergence",
+    "empirical_kl", "constraint",
+]

@@ -1,0 +1,126 @@
+"""Independent and TransformedDistribution (reference:
+`python/mxnet/gluon/probability/distributions/independent.py:28-100`,
+`transformed_distribution.py:28-102`)."""
+from __future__ import annotations
+
+from .distribution import Distribution
+from .utils import sum_right_most
+
+__all__ = ["Independent", "TransformedDistribution"]
+
+
+class Independent(Distribution):
+    """Reinterpret the rightmost `reinterpreted_batch_ndims` batch dims of a
+    distribution as event dims (log_prob sums over them)."""
+
+    def __init__(self, base_distribution, reinterpreted_batch_ndims,
+                 validate_args=None):
+        self.base_dist = base_distribution
+        self.reinterpreted_batch_ndims = int(reinterpreted_batch_ndims)
+        event_dim = (base_distribution.event_dim or 0) + self.reinterpreted_batch_ndims
+        super().__init__(event_dim=event_dim, validate_args=validate_args)
+
+    @property
+    def has_grad(self):
+        return self.base_dist.has_grad
+
+    @property
+    def support(self):
+        return self.base_dist.support
+
+    def log_prob(self, value):
+        lp = self.base_dist.log_prob(value)
+        return sum_right_most(lp, self.reinterpreted_batch_ndims)
+
+    def sample(self, size=None):
+        return self.base_dist.sample(size)
+
+    def sample_n(self, size=None):
+        return self.base_dist.sample_n(size)
+
+    def broadcast_to(self, batch_shape):
+        return Independent(self.base_dist.broadcast_to(batch_shape),
+                           self.reinterpreted_batch_ndims)
+
+    @property
+    def mean(self):
+        return self.base_dist.mean
+
+    @property
+    def variance(self):
+        return self.base_dist.variance
+
+    def entropy(self):
+        ent = self.base_dist.entropy()
+        return sum_right_most(ent, self.reinterpreted_batch_ndims)
+
+    def __repr__(self):
+        return (f"Independent({self.base_dist!r}, "
+                f"{self.reinterpreted_batch_ndims})")
+
+
+class TransformedDistribution(Distribution):
+    """Distribution of T(X) for invertible T via change of variables:
+    log p_Y(y) = log p_X(T^-1(y)) - log|det J_T(T^-1(y))|."""
+
+    def __init__(self, base_dist, transforms, validate_args=None):
+        from ..transformation import Transformation
+
+        self._base_dist = base_dist
+        if isinstance(transforms, Transformation):
+            transforms = [transforms]
+        self._transforms = list(transforms)
+        event_dim = max([base_dist.event_dim or 0]
+                        + [t.event_dim for t in self._transforms])
+        super().__init__(event_dim=event_dim, validate_args=validate_args)
+
+    @property
+    def has_grad(self):
+        return self._base_dist.has_grad
+
+    def sample(self, size=None):
+        x = self._base_dist.sample(size)
+        for t in self._transforms:
+            x = t(x)
+        return x
+
+    def sample_n(self, size=None):
+        x = self._base_dist.sample_n(size)
+        for t in self._transforms:
+            x = t(x)
+        return x
+
+    def log_prob(self, value):
+        event_dim = self.event_dim or 0
+        lp = 0.0
+        y = value
+        for t in reversed(self._transforms):
+            x = t.inv(y)
+            ldj = t.log_det_jacobian(x, y)
+            lp = lp - sum_right_most(ldj, event_dim - t.event_dim)
+            y = x
+        base_ld = self._base_dist.log_prob(y)
+        lp = lp + sum_right_most(
+            base_ld, event_dim - (self._base_dist.event_dim or 0))
+        return lp
+
+    def cdf(self, value):
+        sign = 1
+        y = value
+        for t in reversed(self._transforms):
+            y = t.inv(y)
+            s = t.sign
+            sign = sign * (s if isinstance(s, (int, float)) else 1)
+        c = self._base_dist.cdf(y)
+        if isinstance(sign, (int, float)) and sign < 0:
+            c = 1 - c
+        return c
+
+    def icdf(self, value):
+        if any((isinstance(t.sign, (int, float)) and t.sign < 0)
+               for t in self._transforms):
+            value = 1 - value
+        x = self._base_dist.icdf(value)
+        for t in self._transforms:
+            x = t(x)
+        return x

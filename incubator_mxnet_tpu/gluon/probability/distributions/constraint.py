@@ -1,0 +1,272 @@
+"""Parameter/support constraints (reference:
+`python/mxnet/gluon/probability/distributions/constraint.py`).
+
+`check(value)` returns `value` when it satisfies the constraint and raises
+`ValueError` otherwise. Under a jit trace the data-dependent check is skipped
+(tracers have no concrete truth value); validation is an eager-mode debugging
+aid, exactly like the reference's `validate_args` contract.
+"""
+from __future__ import annotations
+
+__all__ = [
+    "Constraint", "Real", "Boolean", "Positive", "NonNegative", "GreaterThan",
+    "GreaterThanEq", "LessThan", "LessThanEq", "Interval", "HalfOpenInterval",
+    "OpenInterval", "UnitInterval", "IntegerInterval", "NonNegativeInteger",
+    "PositiveInteger", "IntegerGreaterThan", "IntegerGreaterThanEq", "Simplex",
+    "LowerTriangular", "LowerCholesky", "PositiveDefinite", "Cat", "Stack",
+]
+
+
+def _concrete(cond):
+    """Evaluate a boolean NDArray condition; None if tracing (skip check)."""
+    try:
+        import numpy as onp
+
+        return bool(onp.all(onp.asarray(cond.asnumpy() if hasattr(cond, "asnumpy")
+                                        else cond)))
+    except Exception:  # tracer or abstract value: cannot validate
+        return None
+
+
+class Constraint:
+    """Base class. Subclasses define `_cond(value)` returning a boolean array."""
+
+    _err = "Constraint violated"
+
+    def check(self, value):
+        cond = self._cond(value)
+        ok = _concrete(cond)
+        if ok is False:
+            raise ValueError(self._err)
+        return value
+
+    def _cond(self, value):  # pragma: no cover - abstract
+        raise NotImplementedError
+
+
+class Real(Constraint):
+    _err = "Expected real-valued tensor without NaN"
+
+    def _cond(self, value):
+        return value == value  # NaN != NaN
+
+
+class Boolean(Constraint):
+    _err = "Expected values in {0, 1}"
+
+    def _cond(self, value):
+        from .... import numpy as np
+
+        return np.logical_or(value == 0, value == 1)
+
+
+class Positive(Constraint):
+    _err = "Expected value > 0"
+
+    def _cond(self, value):
+        return value > 0
+
+
+class NonNegative(Constraint):
+    _err = "Expected value >= 0"
+
+    def _cond(self, value):
+        return value >= 0
+
+
+class GreaterThan(Constraint):
+    def __init__(self, lower_bound):
+        self._lower_bound = lower_bound
+        self._err = f"Expected value > {lower_bound}"
+
+    def _cond(self, value):
+        lb = self._lower_bound
+        return value > (lb._data if hasattr(lb, "_data") else lb)
+
+
+class GreaterThanEq(GreaterThan):
+    def __init__(self, lower_bound):
+        super().__init__(lower_bound)
+        self._err = f"Expected value >= {lower_bound}"
+
+    def _cond(self, value):
+        lb = self._lower_bound
+        return value >= (lb._data if hasattr(lb, "_data") else lb)
+
+
+class LessThan(Constraint):
+    def __init__(self, upper_bound):
+        self._upper_bound = upper_bound
+        self._err = f"Expected value < {upper_bound}"
+
+    def _cond(self, value):
+        return value < self._upper_bound
+
+
+class LessThanEq(LessThan):
+    def __init__(self, upper_bound):
+        super().__init__(upper_bound)
+        self._err = f"Expected value <= {upper_bound}"
+
+    def _cond(self, value):
+        return value <= self._upper_bound
+
+
+class Interval(Constraint):
+    def __init__(self, lower_bound, upper_bound):
+        self._lower_bound = lower_bound
+        self._upper_bound = upper_bound
+        self._err = f"Expected value in [{lower_bound}, {upper_bound}]"
+
+    def _cond(self, value):
+        from .... import numpy as np
+
+        return np.logical_and(value >= self._lower_bound,
+                              value <= self._upper_bound)
+
+
+class HalfOpenInterval(Interval):
+    def __init__(self, lower_bound, upper_bound):
+        super().__init__(lower_bound, upper_bound)
+        self._err = f"Expected value in [{lower_bound}, {upper_bound})"
+
+    def _cond(self, value):
+        from .... import numpy as np
+
+        return np.logical_and(value >= self._lower_bound,
+                              value < self._upper_bound)
+
+
+class OpenInterval(Interval):
+    def __init__(self, lower_bound, upper_bound):
+        super().__init__(lower_bound, upper_bound)
+        self._err = f"Expected value in ({lower_bound}, {upper_bound})"
+
+    def _cond(self, value):
+        from .... import numpy as np
+
+        return np.logical_and(value > self._lower_bound,
+                              value < self._upper_bound)
+
+
+class UnitInterval(Interval):
+    def __init__(self):
+        super().__init__(0.0, 1.0)
+
+
+class IntegerInterval(Interval):
+    def _cond(self, value):
+        from .... import numpy as np
+
+        return np.logical_and(value == np.floor(value), super()._cond(value))
+
+
+class IntegerGreaterThan(GreaterThan):
+    def _cond(self, value):
+        from .... import numpy as np
+
+        return np.logical_and(value == np.floor(value), super()._cond(value))
+
+
+class IntegerGreaterThanEq(GreaterThanEq):
+    def _cond(self, value):
+        from .... import numpy as np
+
+        return np.logical_and(value == np.floor(value), super()._cond(value))
+
+
+class NonNegativeInteger(IntegerGreaterThanEq):
+    def __init__(self):
+        super().__init__(0)
+
+
+class PositiveInteger(IntegerGreaterThanEq):
+    def __init__(self):
+        super().__init__(1)
+
+
+class Simplex(Constraint):
+    _err = "Expected vector summing to 1 with nonnegative entries"
+
+    def _cond(self, value):
+        from .... import numpy as np
+
+        return np.logical_and(np.all(value >= 0, axis=-1),
+                              np.abs(np.sum(value, axis=-1) - 1) < 1e-5)
+
+
+class LowerTriangular(Constraint):
+    _err = "Expected lower-triangular matrix"
+
+    def _cond(self, value):
+        from .... import numpy as np
+
+        return np.all(np.abs(np.triu(value, 1)) < 1e-6, axis=(-2, -1))
+
+
+class LowerCholesky(Constraint):
+    _err = "Expected lower-triangular matrix with positive diagonal"
+
+    def _cond(self, value):
+        from .... import numpy as np
+
+        tri = np.all(np.abs(np.triu(value, 1)) < 1e-6, axis=(-2, -1))
+        diag = np.all(np.diagonal(value, axis1=-2, axis2=-1) > 0, axis=-1)
+        return np.logical_and(tri, diag)
+
+
+class PositiveDefinite(Constraint):
+    _err = "Expected positive-definite matrix"
+
+    def _cond(self, value):
+        from .... import numpy as np
+
+        sym = np.all(np.abs(value - np.swapaxes(value, -1, -2)) < 1e-5,
+                     axis=(-2, -1))
+        import numpy.linalg as onl  # eager eigvals check only
+
+        try:
+            ev = onl.eigvalsh(value.asnumpy())
+            import numpy as onp
+
+            return np.logical_and(sym, np.array(onp.all(ev > 0)))
+        except Exception:
+            return sym
+
+
+class Cat(Constraint):
+    """Concatenation of constraints applied to slices along `axis`."""
+
+    def __init__(self, constraints, axis=0, lengths=None):
+        self._constraints = list(constraints)
+        self._axis = axis
+        self._lengths = lengths
+
+    def check(self, value):
+        lengths = self._lengths or [1] * len(self._constraints)
+        start = 0
+        for c, n in zip(self._constraints, lengths):
+            sl = [slice(None)] * (self._axis + 1)
+            sl[self._axis] = slice(start, start + n)
+            c.check(value[tuple(sl)])
+            start += n
+        return value
+
+    def _cond(self, value):  # pragma: no cover
+        raise NotImplementedError
+
+
+class Stack(Constraint):
+    def __init__(self, constraints, axis=0):
+        self._constraints = list(constraints)
+        self._axis = axis
+
+    def check(self, value):
+        for i, c in enumerate(self._constraints):
+            sl = [slice(None)] * (self._axis + 1)
+            sl[self._axis] = i
+            c.check(value[tuple(sl)])
+        return value
+
+    def _cond(self, value):  # pragma: no cover
+        raise NotImplementedError

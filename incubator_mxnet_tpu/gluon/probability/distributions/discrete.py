@@ -1,0 +1,671 @@
+"""Discrete distributions (reference:
+`python/mxnet/gluon/probability/distributions/{bernoulli,binomial,geometric,
+negative_binomial,poisson,categorical,one_hot_categorical,multinomial,
+relaxed_bernoulli,relaxed_one_hot_categorical}.py`).
+
+Dual prob/logit parameterization with lazily-derived twins (cached_property),
+mirroring the reference's utils.prob2logit/logit2prob contract. Samplers are
+single fused `jax.random` kernels; relaxed (Gumbel-softmax) variants carry
+pathwise gradients for variational training.
+"""
+from __future__ import annotations
+
+import math
+
+from . import constraint as C
+from .distribution import Distribution, ExponentialFamily
+from .utils import (as_ndarray, broadcast_param, cached_property, clip_prob,
+                    gammaln, log_softmax, logit2prob, norm_size, prob2logit,
+                    sample_op, softmax, softplus, xlogy)
+
+__all__ = [
+    "Bernoulli", "Binomial", "Geometric", "NegativeBinomial", "Poisson",
+    "Categorical", "OneHotCategorical", "Multinomial", "RelaxedBernoulli",
+    "RelaxedOneHotCategorical",
+]
+
+
+def _np():
+    from .... import numpy as np
+
+    return np
+
+
+def _bshape(*params):
+    import jax.numpy as jnp
+
+    return jnp.broadcast_shapes(*[getattr(p, "shape", ()) for p in params])
+
+
+class _DualParam(Distribution):
+    """Shared prob/logit dual parameterization (binary=True → sigmoid link,
+    False → softmax link over the trailing axis)."""
+
+    _binary = True
+
+    def __init__(self, prob=None, logit=None, event_dim=0, validate_args=None):
+        if (prob is None) == (logit is None):
+            raise ValueError("Either `prob` or `logit` must be specified "
+                             "(but not both).")
+        if prob is not None:
+            self.prob = as_ndarray(prob)
+        else:
+            self.logit = as_ndarray(logit)
+        super().__init__(event_dim=event_dim, validate_args=validate_args)
+
+    @cached_property
+    def prob(self):
+        return logit2prob(self.logit, self._binary)
+
+    @cached_property
+    def logit(self):
+        return prob2logit(self.prob, self._binary)
+
+
+class Bernoulli(_DualParam, ExponentialFamily):
+    """Bernoulli distribution (reference bernoulli.py:29-150)."""
+
+    support = C.Boolean()
+    arg_constraints = {"prob": C.UnitInterval(), "logit": C.Real()}
+    has_enumerate_support = True
+
+    def __init__(self, prob=None, logit=None, validate_args=None):
+        super().__init__(prob=prob, logit=logit, event_dim=0,
+                         validate_args=validate_args)
+
+    def log_prob(self, value):
+        self._validate_samples(value)
+        # value*logit - softplus(logit): numerically-stable BCE form
+        return value * self.logit - softplus(self.logit)
+
+    def sample(self, size=None):
+        import jax.numpy as jnp
+        import jax.random as jr
+
+        def fn(key, sz, p):
+            shape = sz if sz is not None else jnp.shape(p)
+            return jr.bernoulli(key, p, shape).astype(jnp.float32)
+
+        return sample_op("bernoulli_sample", fn, self.prob, size=size)
+
+    def sample_n(self, size=None):
+        sz = norm_size(size) or ()
+        return self.sample(tuple(sz) + _bshape(self.prob))
+
+    def broadcast_to(self, batch_shape):
+        return Bernoulli(prob=broadcast_param(self.prob, batch_shape))
+
+    def enumerate_support(self):
+        np = _np()
+        shape = (2,) + tuple(_bshape(self.prob))
+        import numpy as onp
+
+        vals = onp.zeros(shape, dtype="float32")
+        vals[1] = 1.0
+        return np.array(vals)
+
+    @property
+    def mean(self):
+        return self.prob
+
+    @property
+    def variance(self):
+        return self.prob * (1 - self.prob)
+
+    def entropy(self):
+        p = clip_prob(self.prob)
+        return -(xlogy(p, p) + xlogy(1 - p, 1 - p))
+
+    @property
+    def _natural_params(self):
+        return (self.logit,)
+
+    def _log_normalizer(self, x):
+        import jax.nn as jnn
+
+        return jnn.softplus(x)
+
+
+class Geometric(_DualParam):
+    """Number of failures before first success (reference geometric.py)."""
+
+    support = C.NonNegativeInteger()
+    arg_constraints = {"prob": C.UnitInterval(), "logit": C.Real()}
+
+    def __init__(self, prob=None, logit=None, validate_args=None):
+        super().__init__(prob=prob, logit=logit, event_dim=0,
+                         validate_args=validate_args)
+
+    def log_prob(self, value):
+        np = _np()
+        self._validate_samples(value)
+        p = clip_prob(self.prob)
+        return value * np.log1p(-p) + np.log(p)
+
+    def sample(self, size=None):
+        import jax.numpy as jnp
+        import jax.random as jr
+
+        def fn(key, sz, p):
+            shape = sz if sz is not None else jnp.shape(p)
+            u = jr.uniform(key, shape, minval=1e-7, maxval=1.0)
+            return jnp.floor(jnp.log(u) / jnp.log1p(-p))
+
+        return sample_op("geometric_sample", fn, self.prob, size=size)
+
+    def sample_n(self, size=None):
+        sz = norm_size(size) or ()
+        return self.sample(tuple(sz) + _bshape(self.prob))
+
+    def broadcast_to(self, batch_shape):
+        return Geometric(prob=broadcast_param(self.prob, batch_shape))
+
+    @property
+    def mean(self):
+        return (1 - self.prob) / self.prob
+
+    @property
+    def variance(self):
+        return (1 - self.prob) / self.prob ** 2
+
+    def entropy(self):
+        p = clip_prob(self.prob)
+        return -(xlogy(p, p) + xlogy(1 - p, 1 - p)) / p
+
+
+class Binomial(_DualParam):
+    """Binomial(n, p) (reference binomial.py:30-170)."""
+
+    arg_constraints = {"prob": C.UnitInterval(), "logit": C.Real()}
+
+    def __init__(self, n=1, prob=None, logit=None, validate_args=None):
+        self.n = as_ndarray(n)
+        self.support = C.IntegerInterval(0, n)
+        super().__init__(prob=prob, logit=logit, event_dim=0,
+                         validate_args=validate_args)
+
+    def log_prob(self, value):
+        np = _np()
+        self._validate_samples(value)
+        p = clip_prob(self.prob)
+        binomln = (gammaln(self.n + 1) - gammaln(value + 1)
+                   - gammaln(self.n - value + 1))
+        return binomln + xlogy(value, p) + xlogy(self.n - value, 1 - p)
+
+    def sample(self, size=None):
+        import jax.numpy as jnp
+        import jax.random as jr
+
+        def fn(key, sz, n, p):
+            shape = sz if sz is not None else jnp.broadcast_shapes(
+                jnp.shape(n), jnp.shape(p))
+            return jr.binomial(key, jnp.broadcast_to(n, shape),
+                               jnp.broadcast_to(p, shape)).astype(jnp.float32)
+
+        return sample_op("binomial_sample", fn, self.n, self.prob, size=size)
+
+    def sample_n(self, size=None):
+        sz = norm_size(size) or ()
+        return self.sample(tuple(sz) + _bshape(self.n, self.prob))
+
+    def broadcast_to(self, batch_shape):
+        return Binomial(broadcast_param(self.n, batch_shape),
+                        prob=broadcast_param(self.prob, batch_shape))
+
+    @property
+    def mean(self):
+        return self.n * self.prob
+
+    @property
+    def variance(self):
+        return self.n * self.prob * (1 - self.prob)
+
+
+class NegativeBinomial(_DualParam):
+    """Number of successes before `n` failures (reference
+    negative_binomial.py:32-140)."""
+
+    support = C.NonNegativeInteger()
+    arg_constraints = {"prob": C.UnitInterval(), "logit": C.Real()}
+
+    def __init__(self, n, prob=None, logit=None, validate_args=None):
+        self.n = as_ndarray(n)
+        super().__init__(prob=prob, logit=logit, event_dim=0,
+                         validate_args=validate_args)
+
+    def log_prob(self, value):
+        self._validate_samples(value)
+        p = clip_prob(self.prob)
+        comb = (gammaln(value + self.n) - gammaln(value + 1)
+                - gammaln(self.n))
+        return comb + xlogy(self.n, 1 - p) + xlogy(value, p)
+
+    def sample(self, size=None):
+        import jax.numpy as jnp
+        import jax.random as jr
+
+        def fn(key, sz, n, p):
+            shape = sz if sz is not None else jnp.broadcast_shapes(
+                jnp.shape(n), jnp.shape(p))
+            k1, k2 = jr.split(key)
+            # gamma-poisson mixture
+            lam = jr.gamma(k1, jnp.broadcast_to(n, shape)) * p / (1 - p)
+            return jr.poisson(k2, lam).astype(jnp.float32)
+
+        return sample_op("negbinomial_sample", fn, self.n, self.prob,
+                         size=size)
+
+    def sample_n(self, size=None):
+        sz = norm_size(size) or ()
+        return self.sample(tuple(sz) + _bshape(self.n, self.prob))
+
+    def broadcast_to(self, batch_shape):
+        return NegativeBinomial(broadcast_param(self.n, batch_shape),
+                                prob=broadcast_param(self.prob, batch_shape))
+
+    @property
+    def mean(self):
+        return self.n * self.prob / (1 - self.prob)
+
+    @property
+    def variance(self):
+        return self.n * self.prob / (1 - self.prob) ** 2
+
+
+class Poisson(ExponentialFamily):
+    """Poisson distribution (reference poisson.py:30-120)."""
+
+    support = C.NonNegativeInteger()
+    arg_constraints = {"rate": C.Positive()}
+
+    def __init__(self, rate=1.0, validate_args=None):
+        self.rate = as_ndarray(rate)
+        super().__init__(event_dim=0, validate_args=validate_args)
+
+    def log_prob(self, value):
+        np = _np()
+        self._validate_samples(value)
+        return xlogy(value, self.rate) - self.rate - gammaln(value + 1)
+
+    def sample(self, size=None):
+        import jax.numpy as jnp
+        import jax.random as jr
+
+        def fn(key, sz, lam):
+            shape = sz if sz is not None else jnp.shape(lam)
+            return jr.poisson(key, lam, shape).astype(jnp.float32)
+
+        return sample_op("poisson_sample", fn, self.rate, size=size)
+
+    def sample_n(self, size=None):
+        sz = norm_size(size) or ()
+        return self.sample(tuple(sz) + _bshape(self.rate))
+
+    def broadcast_to(self, batch_shape):
+        return Poisson(broadcast_param(self.rate, batch_shape))
+
+    @property
+    def mean(self):
+        return self.rate
+
+    @property
+    def variance(self):
+        return self.rate
+
+    @property
+    def _natural_params(self):
+        np = _np()
+        return (np.log(self.rate),)
+
+    def _log_normalizer(self, x):
+        import jax.numpy as jnp
+
+        return jnp.exp(x)
+
+
+class Categorical(Distribution):
+    """Categorical over {0..num_events-1} (reference categorical.py:29-230)."""
+
+    has_enumerate_support = True
+    arg_constraints = {"prob": C.Real(), "logit": C.Real()}
+
+    def __init__(self, num_events, prob=None, logit=None, validate_args=None):
+        if (prob is None) == (logit is None):
+            raise ValueError("Either `prob` or `logit` must be specified "
+                             "(but not both).")
+        self.num_events = int(num_events)
+        if prob is not None:
+            self.prob = as_ndarray(prob)
+        else:
+            self.logit = as_ndarray(logit)
+        self.support = C.IntegerInterval(0, num_events - 1)
+        super().__init__(event_dim=0, validate_args=validate_args)
+
+    @cached_property
+    def prob(self):
+        return softmax(self.logit, axis=-1)
+
+    @cached_property
+    def logit(self):
+        np = _np()
+        return np.log(clip_prob(self.prob)) - np.log(
+            np.sum(clip_prob(self.prob), axis=-1, keepdims=True))
+
+    def log_prob(self, value):
+        import jax.numpy as jnp
+        import jax.nn as jnn
+
+        from ....ndarray.ndarray import apply_op_flat
+
+        self._validate_samples(value)
+
+        def lp(logit, v):
+            norm = jnn.log_softmax(logit, axis=-1)
+            norm = jnp.broadcast_to(norm, jnp.shape(v) + (norm.shape[-1],))
+            return jnp.take_along_axis(
+                norm, v.astype(jnp.int32)[..., None], axis=-1)[..., 0]
+
+        return apply_op_flat("categorical_log_prob", lp, (self.logit, value))
+
+    def sample(self, size=None):
+        import jax.numpy as jnp
+        import jax.random as jr
+
+        def fn(key, sz, logit):
+            shape = sz if sz is not None else jnp.shape(logit)[:-1]
+            return jr.categorical(key, logit, shape=shape).astype(jnp.float32)
+
+        return sample_op("categorical_sample", fn, self.logit, size=size)
+
+    def sample_n(self, size=None):
+        sz = norm_size(size) or ()
+        return self.sample(tuple(sz) + _bshape(self.logit)[:-1])
+
+    def broadcast_to(self, batch_shape):
+        return Categorical(
+            self.num_events,
+            logit=broadcast_param(self.logit,
+                                  tuple(batch_shape) + (self.num_events,)))
+
+    def enumerate_support(self):
+        np = _np()
+        import numpy as onp
+
+        batch = _bshape(self.logit)[:-1]
+        vals = onp.arange(self.num_events, dtype="float32").reshape(
+            (self.num_events,) + (1,) * len(batch))
+        return np.array(onp.broadcast_to(vals, (self.num_events,) + batch))
+
+    @property
+    def mean(self):
+        raise ValueError("Categorical distribution has no mean")
+
+    @property
+    def variance(self):
+        raise ValueError("Categorical distribution has no variance")
+
+    def entropy(self):
+        np = _np()
+        logp = log_softmax(self.logit, axis=-1)
+        return -np.sum(np.exp(logp) * logp, axis=-1)
+
+
+class OneHotCategorical(Categorical):
+    """One-hot-coded categorical (reference one_hot_categorical.py:30-160)."""
+
+    def __init__(self, num_events, prob=None, logit=None, validate_args=None):
+        super().__init__(num_events, prob=prob, logit=logit,
+                         validate_args=validate_args)
+        self.support = C.Simplex()  # one-hot vectors live on simplex vertices
+        self.event_dim = 1
+
+    def log_prob(self, value):
+        np = _np()
+        logp = log_softmax(self.logit, axis=-1)
+        return np.sum(logp * value, axis=-1)
+
+    def sample(self, size=None):
+        import jax.numpy as jnp
+        import jax.nn as jnn
+        import jax.random as jr
+
+        n = self.num_events
+
+        def fn(key, sz, logit):
+            shape = sz if sz is not None else jnp.shape(logit)[:-1]
+            idx = jr.categorical(key, logit, shape=shape)
+            return jnn.one_hot(idx, n, dtype=jnp.float32)
+
+        return sample_op("one_hot_categorical_sample", fn, self.logit,
+                         size=size)
+
+    def broadcast_to(self, batch_shape):
+        return OneHotCategorical(
+            self.num_events,
+            logit=broadcast_param(self.logit,
+                                  tuple(batch_shape) + (self.num_events,)))
+
+    def enumerate_support(self):
+        np = _np()
+        import numpy as onp
+
+        batch = tuple(_bshape(self.logit)[:-1])
+        eye = onp.eye(self.num_events, dtype="float32").reshape(
+            (self.num_events,) + (1,) * len(batch) + (self.num_events,))
+        return np.array(onp.broadcast_to(
+            eye, (self.num_events,) + batch + (self.num_events,)))
+
+    @property
+    def mean(self):
+        return self.prob
+
+    @property
+    def variance(self):
+        return self.prob * (1 - self.prob)
+
+
+class Multinomial(Distribution):
+    """Multinomial counts over `num_events` categories (reference
+    multinomial.py:30-170)."""
+
+    def __init__(self, num_events, prob=None, logit=None, total_count=1,
+                 validate_args=None):
+        if (prob is None) == (logit is None):
+            raise ValueError("Either `prob` or `logit` must be specified "
+                             "(but not both).")
+        self.num_events = int(num_events)
+        self.total_count = total_count
+        if prob is not None:
+            self.prob = as_ndarray(prob)
+        else:
+            self.logit = as_ndarray(logit)
+        super().__init__(event_dim=1, validate_args=validate_args)
+
+    @cached_property
+    def prob(self):
+        return softmax(self.logit, axis=-1)
+
+    @cached_property
+    def logit(self):
+        np = _np()
+        return np.log(clip_prob(self.prob))
+
+    def log_prob(self, value):
+        np = _np()
+        n = np.sum(value, axis=-1)
+        return (gammaln(n + 1) - np.sum(gammaln(value + 1), axis=-1)
+                + np.sum(xlogy(value, clip_prob(self.prob)), axis=-1))
+
+    def sample(self, size=None):
+        import jax.numpy as jnp
+        import jax.random as jr
+
+        tc = int(self.total_count)
+        n_ev = self.num_events
+
+        def fn(key, sz, p):
+            batch = sz if sz is not None else jnp.shape(p)[:-1]
+            p_b = jnp.broadcast_to(p, tuple(batch) + (n_ev,))
+            idx = jr.categorical(
+                key, jnp.log(jnp.clip(p_b, 1e-12, 1.0)),
+                shape=(tc,) + tuple(batch))
+            import jax.nn as jnn
+
+            oh = jnn.one_hot(idx, n_ev, dtype=jnp.float32)
+            return jnp.sum(oh, axis=0)
+
+        return sample_op("multinomial_sample", fn, self.prob, size=size)
+
+    def sample_n(self, size=None):
+        sz = norm_size(size) or ()
+        return self.sample(tuple(sz) + _bshape(self.prob)[:-1])
+
+    def broadcast_to(self, batch_shape):
+        return Multinomial(
+            self.num_events,
+            prob=broadcast_param(self.prob,
+                                 tuple(batch_shape) + (self.num_events,)),
+            total_count=self.total_count)
+
+    @property
+    def mean(self):
+        return self.total_count * self.prob
+
+    @property
+    def variance(self):
+        return self.total_count * self.prob * (1 - self.prob)
+
+
+class RelaxedBernoulli(Distribution):
+    """Concrete / Gumbel-sigmoid relaxation of Bernoulli at temperature `T`
+    (reference relaxed_bernoulli.py:31-140). Fully reparameterized."""
+
+    has_grad = True
+    support = C.UnitInterval()
+    arg_constraints = {"prob": C.UnitInterval(), "logit": C.Real()}
+
+    def __init__(self, T, prob=None, logit=None, validate_args=None):
+        if (prob is None) == (logit is None):
+            raise ValueError("Either `prob` or `logit` must be specified "
+                             "(but not both).")
+        self.T = as_ndarray(T)
+        if prob is not None:
+            self.prob = as_ndarray(prob)
+        else:
+            self.logit = as_ndarray(logit)
+        super().__init__(event_dim=0, validate_args=validate_args)
+
+    @cached_property
+    def prob(self):
+        return logit2prob(self.logit, True)
+
+    @cached_property
+    def logit(self):
+        return prob2logit(self.prob, True)
+
+    def log_prob(self, value):
+        np = _np()
+        # density of the logistic-transformed relaxed variable
+        t, logit = self.T, self.logit
+        y = np.log(value) - np.log1p(-value)
+        diff = logit - t * y
+        return np.log(t) + diff - 2 * softplus(diff) - np.log(
+            value * (1 - value))
+
+    def sample(self, size=None):
+        import jax.numpy as jnp
+        import jax.nn as jnn
+        import jax.random as jr
+
+        def fn(key, sz, t, logit):
+            shape = sz if sz is not None else jnp.broadcast_shapes(
+                jnp.shape(t), jnp.shape(logit))
+            u = jr.uniform(key, shape, minval=1e-7, maxval=1.0 - 1e-7)
+            gl = jnp.log(u) - jnp.log1p(-u)  # logistic noise
+            return jnn.sigmoid((logit + gl) / t)
+
+        return sample_op("relaxed_bernoulli_sample", fn, self.T, self.logit,
+                         size=size)
+
+    def sample_n(self, size=None):
+        sz = norm_size(size) or ()
+        return self.sample(tuple(sz) + _bshape(self.logit))
+
+    def broadcast_to(self, batch_shape):
+        return RelaxedBernoulli(self.T,
+                                logit=broadcast_param(self.logit, batch_shape))
+
+    @property
+    def mean(self):
+        return self.prob
+
+
+class RelaxedOneHotCategorical(Distribution):
+    """Gumbel-softmax relaxation of OneHotCategorical at temperature `T`
+    (reference relaxed_one_hot_categorical.py:32-200). Reparameterized."""
+
+    has_grad = True
+    support = C.Simplex()
+
+    def __init__(self, T, num_events, prob=None, logit=None,
+                 validate_args=None):
+        if (prob is None) == (logit is None):
+            raise ValueError("Either `prob` or `logit` must be specified "
+                             "(but not both).")
+        self.T = as_ndarray(T)
+        self.num_events = int(num_events)
+        if prob is not None:
+            self.prob = as_ndarray(prob)
+        else:
+            self.logit = as_ndarray(logit)
+        super().__init__(event_dim=1, validate_args=validate_args)
+
+    @cached_property
+    def prob(self):
+        return softmax(self.logit, axis=-1)
+
+    @cached_property
+    def logit(self):
+        np = _np()
+        return np.log(clip_prob(self.prob))
+
+    def log_prob(self, value):
+        # Gumbel-softmax density (Maddison et al. 2017, eq. 6):
+        # log p(y) = log(k-1)! + (k-1)logT + Σ(logπ-(T+1)logy) - k·lse(logπ-T·logy)
+        from .utils import logsumexp
+
+        np = _np()
+        k = self.num_events
+        t = self.T
+        logp = log_softmax(self.logit, axis=-1)
+        score = np.sum(logp - (t + 1) * np.log(value), axis=-1)
+        denom = k * logsumexp(logp - t * np.log(value), axis=-1)
+        return math.lgamma(k) + (k - 1) * np.log(t) + score - denom
+
+    def sample(self, size=None):
+        import jax.numpy as jnp
+        import jax.nn as jnn
+        import jax.random as jr
+
+        def fn(key, sz, t, logit):
+            batch = sz if sz is not None else jnp.shape(logit)[:-1]
+            shape = tuple(batch) + (jnp.shape(logit)[-1],)
+            g = jr.gumbel(key, shape)
+            return jnn.softmax((logit + g) / t, axis=-1)
+
+        return sample_op("relaxed_one_hot_sample", fn, self.T, self.logit,
+                         size=size)
+
+    def sample_n(self, size=None):
+        sz = norm_size(size) or ()
+        return self.sample(tuple(sz) + _bshape(self.logit)[:-1])
+
+    def broadcast_to(self, batch_shape):
+        return RelaxedOneHotCategorical(
+            self.T, self.num_events,
+            logit=broadcast_param(self.logit,
+                                  tuple(batch_shape) + (self.num_events,)))
+
+    @property
+    def mean(self):
+        return self.prob

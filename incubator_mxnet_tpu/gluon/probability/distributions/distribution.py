@@ -1,0 +1,168 @@
+"""Distribution base classes (reference:
+`python/mxnet/gluon/probability/distributions/distribution.py:28-210`,
+`exp_family.py`).
+
+TPU-native design: every method is a composition of autograd-aware `np` ops /
+fused `apply_op_flat` kernels, so log_prob/entropy/sample are differentiable
+and jit-safe — a `Distribution` can be constructed and consumed inside a
+hybridized forward (parameters are traced NDArrays; draws pull fresh traced
+keys from the global RNG).
+"""
+from __future__ import annotations
+
+from .utils import cached_property  # noqa: F401
+
+__all__ = ["Distribution", "ExponentialFamily"]
+
+
+class Distribution:
+    """Base class for probability distributions.
+
+    Parameters
+    ----------
+    event_dim : int, default None
+        Number of rightmost dims that define one event of the distribution.
+    validate_args : bool, default None
+        Whether to validate distribution parameters eagerly.
+    """
+
+    # Whether `sample` has pathwise (reparameterized) gradient.
+    has_grad = False
+    support = None
+    has_enumerate_support = False
+    arg_constraints = {}
+    _validate_args = False
+
+    @staticmethod
+    def set_default_validate_args(value):
+        if value not in (True, False):
+            raise ValueError("validate_args must be True or False")
+        Distribution._validate_args = value
+
+    def __init__(self, event_dim=None, validate_args=None):
+        self.event_dim = event_dim
+        if validate_args is not None:
+            self._validate_args = validate_args
+        if self._validate_args:
+            for param, constraint in self.arg_constraints.items():
+                if param not in self.__dict__ and isinstance(
+                        getattr(type(self), param, None), cached_property):
+                    continue  # lazily-derived param (e.g. logit from prob)
+                setattr(self, param, constraint.check(getattr(self, param)))
+        super().__init__()
+
+    # -- densities ---------------------------------------------------------
+    def log_prob(self, value):
+        """Log of the probability density/mass function at `value`."""
+        raise NotImplementedError
+
+    def prob(self, value):
+        from .... import numpy as np
+
+        return np.exp(self.log_prob(value))
+
+    pdf = prob
+
+    def cdf(self, value):
+        raise NotImplementedError
+
+    def icdf(self, value):
+        raise NotImplementedError
+
+    # -- sampling ----------------------------------------------------------
+    def sample(self, size=None):
+        """Generate a sample of shape `size + batch_shape + event_shape`."""
+        raise NotImplementedError
+
+    def sample_n(self, size):
+        """Generate `(size,) + batch_shape + event_shape` samples."""
+        if size is None:
+            return self.sample()
+        if isinstance(size, int):
+            size = (size,)
+        return self.sample(tuple(size) + tuple(self._batch_shape()))
+
+    def _batch_shape(self):
+        m = self.mean
+        return getattr(m, "shape", ())
+
+    def broadcast_to(self, batch_shape):
+        """New distribution instance with parameters broadcast to `batch_shape`."""
+        raise NotImplementedError
+
+    def enumerate_support(self):
+        raise NotImplementedError
+
+    # -- moments -----------------------------------------------------------
+    @property
+    def mean(self):
+        raise NotImplementedError
+
+    @property
+    def variance(self):
+        raise NotImplementedError
+
+    @property
+    def stddev(self):
+        from .... import numpy as np
+
+        return np.sqrt(self.variance)
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def perplexity(self):
+        from .... import numpy as np
+
+        return np.exp(self.entropy())
+
+    def __repr__(self):
+        args = ", ".join(
+            f"{k}={getattr(self, k, None)!r}" for k in self.arg_constraints)
+        return f"{type(self).__name__}({args})"
+
+    def _validate_samples(self, value):
+        if self._validate_args and self.support is not None:
+            return self.support.check(value)
+        return value
+
+
+class ExponentialFamily(Distribution):
+    r"""Distributions of form
+    :math:`p(x;\theta) = h(x)\exp(\eta(\theta)\cdot T(x) - A(\eta))`
+    (reference `exp_family.py`). Entropy via the Bregman-divergence identity:
+    the gradient of the log-normalizer w.r.t. natural parameters gives
+    E[T(x)], so entropy falls out of one `jax.grad` call — the TPU analogue
+    of the reference's autograd-over-`_log_normalizer` trick.
+    """
+
+    @property
+    def _natural_params(self):
+        """Tuple of NDArray natural parameters."""
+        raise NotImplementedError
+
+    def _log_normalizer(self, *natural_params):
+        """Log-normalizer A(η) over RAW jnp buffers (pure, jit-safe)."""
+        raise NotImplementedError
+
+    _mean_carrier_measure = 0.0
+
+    def entropy(self):
+        import jax
+
+        from ....ndarray.ndarray import apply_op_flat
+
+        log_norm = self._log_normalizer
+        carrier = self._mean_carrier_measure
+
+        def _ent(*nps):
+            lg = log_norm(*nps)
+            grads = jax.grad(lambda *ps: log_norm(*ps).sum(),
+                             argnums=tuple(range(len(nps))))(*nps)
+            result = lg - carrier
+            for np_i, g_i in zip(nps, grads):
+                result = result - np_i * g_i
+            return result
+
+        return apply_op_flat("exp_family_entropy", _ent,
+                             tuple(self._natural_params))

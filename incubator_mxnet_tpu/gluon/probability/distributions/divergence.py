@@ -1,0 +1,299 @@
+"""KL divergences (reference:
+`python/mxnet/gluon/probability/distributions/divergence.py:21-360`).
+
+Closed-form KL for the same distribution pairs the reference registers, plus
+`empirical_kl` Monte-Carlo fallback. Dispatch resolves the most specific
+registered (type_p, type_q) pair over the MRO, so subclasses (e.g. Chi2 →
+Gamma) reuse parent formulas.
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as _onp
+
+from .compound import Independent
+from .continuous import (Beta, Cauchy, Dirichlet, Exponential, Gamma, Gumbel,
+                         HalfNormal, Laplace, MultivariateNormal, Normal,
+                         Pareto, Uniform)
+from .discrete import (Bernoulli, Binomial, Categorical, Geometric,
+                       OneHotCategorical, Poisson)
+from .utils import digamma, gammaln, sum_right_most
+
+__all__ = ["register_kl", "kl_divergence", "empirical_kl"]
+
+_KL_REGISTRY = {}
+
+
+def register_kl(type_p, type_q):
+    """Decorator registering a KL(p||q) implementation for a class pair."""
+
+    def decorator(fn):
+        _KL_REGISTRY[(type_p, type_q)] = fn
+        return fn
+
+    return decorator
+
+
+def _dispatch_kl(type_p, type_q):
+    fn = _KL_REGISTRY.get((type_p, type_q))
+    if fn is not None:
+        return fn
+    # most-specific match over the MRO (subclass reuses parent formula)
+    best = None
+    for (tp, tq), cand in _KL_REGISTRY.items():
+        if issubclass(type_p, tp) and issubclass(type_q, tq):
+            if best is None or (issubclass(tp, best[0][0])
+                                and issubclass(tq, best[0][1])):
+                best = ((tp, tq), cand)
+    if best is None:
+        raise NotImplementedError(
+            f"KL divergence between {type_p.__name__} and "
+            f"{type_q.__name__} is not implemented.")
+    return best[1]
+
+
+def kl_divergence(p, q):
+    r"""Closed-form KL(p||q) for registered distribution pairs."""
+    return _dispatch_kl(type(p), type(q))(p, q)
+
+
+def empirical_kl(p, q, n_samples=1):
+    r"""Monte-Carlo estimate of KL(p||q): mean of log p(x) - log q(x) over
+    `n_samples` draws x ~ p."""
+    from .... import numpy as np
+
+    x = p.sample_n(n_samples)
+    return np.mean(p.log_prob(x) - q.log_prob(x), axis=0)
+
+
+def _np():
+    from .... import numpy as np
+
+    return np
+
+
+@register_kl(Normal, Normal)
+def _kl_normal_normal(p, q):
+    np = _np()
+    var_ratio = (p.scale / q.scale) ** 2
+    t1 = ((p.loc - q.loc) / q.scale) ** 2
+    return 0.5 * (var_ratio + t1 - 1 - np.log(var_ratio))
+
+
+@register_kl(Bernoulli, Bernoulli)
+def _kl_bernoulli_bernoulli(p, q):
+    from .utils import clip_prob
+
+    np = _np()
+    pp, pq = clip_prob(p.prob), clip_prob(q.prob)
+    return pp * np.log(pp / pq) + (1 - pp) * np.log((1 - pp) / (1 - pq))
+
+
+@register_kl(Categorical, Categorical)
+def _kl_categorical_categorical(p, q):
+    from .utils import log_softmax
+
+    np = _np()
+    lp = log_softmax(p.logit, axis=-1)
+    lq = log_softmax(q.logit, axis=-1)
+    return np.sum(np.exp(lp) * (lp - lq), axis=-1)
+
+
+@register_kl(OneHotCategorical, OneHotCategorical)
+def _kl_onehot_onehot(p, q):
+    return _kl_categorical_categorical(p, q)
+
+
+@register_kl(Uniform, Uniform)
+def _kl_uniform_uniform(p, q):
+    np = _np()
+    result = np.log((q.high - q.low) / (p.high - p.low))
+    bad = np.logical_or(q.low > p.low, q.high < p.high)
+    return np.where(bad, np.full_like(result, _onp.inf), result)
+
+
+@register_kl(Cauchy, Cauchy)
+def _kl_cauchy_cauchy(p, q):
+    np = _np()
+    t1 = np.log((p.scale + q.scale) ** 2 + (p.loc - q.loc) ** 2)
+    t2 = np.log(4 * p.scale * q.scale)
+    return t1 - t2
+
+
+@register_kl(Laplace, Laplace)
+def _kl_laplace_laplace(p, q):
+    np = _np()
+    scale_ratio = p.scale / q.scale
+    loc_abs_diff = np.abs(p.loc - q.loc)
+    return (-np.log(scale_ratio) + loc_abs_diff / q.scale
+            + scale_ratio * np.exp(-loc_abs_diff / p.scale) - 1)
+
+
+@register_kl(Poisson, Poisson)
+def _kl_poisson_poisson(p, q):
+    np = _np()
+    return p.rate * (np.log(p.rate) - np.log(q.rate)) - (p.rate - q.rate)
+
+
+@register_kl(Geometric, Geometric)
+def _kl_geometric_geometric(p, q):
+    np = _np()
+    return -p.entropy() - np.log1p(-q.prob) / p.prob - q.logit
+
+
+@register_kl(Exponential, Exponential)
+def _kl_exponential_exponential(p, q):
+    np = _np()
+    scale_ratio = p.scale / q.scale
+    return -np.log(scale_ratio) + scale_ratio - 1
+
+
+@register_kl(Pareto, Pareto)
+def _kl_pareto_pareto(p, q):
+    np = _np()
+    scale_ratio = p.scale / q.scale
+    alpha_ratio = q.alpha / p.alpha
+    result = (q.alpha * np.log(scale_ratio) - np.log(alpha_ratio)
+              + alpha_ratio - 1)
+    return np.where(p.scale < q.scale, np.full_like(result, _onp.nan), result)
+
+
+@register_kl(Gumbel, Gumbel)
+def _kl_gumbel_gumbel(p, q):
+    np = _np()
+    eg = _onp.euler_gamma
+    ct1 = p.scale / q.scale
+    ct2 = q.loc / q.scale
+    ct3 = p.loc / q.scale
+    t1 = -np.log(ct1) - ct2 + ct3
+    t2 = ct1 * eg
+    t3 = np.exp(ct2 + gammaln(1 + ct1) - ct3)
+    return t1 + t2 + t3 - (1 + eg)
+
+
+@register_kl(Gamma, Gamma)
+def _kl_gamma_gamma(p, q):
+    np = _np()
+    return (q.shape * np.log(q.scale / p.scale)
+            + gammaln(q.shape) - gammaln(p.shape)
+            + (p.shape - q.shape) * digamma(p.shape)
+            + (p.shape * p.scale) * (1 / q.scale - 1 / p.scale))
+
+
+@register_kl(Beta, Beta)
+def _kl_beta_beta(p, q):
+    sum_p = p.beta + p.alpha
+    sum_q = q.beta + q.alpha
+    t1 = gammaln(q.alpha) + gammaln(q.beta) + gammaln(sum_p)
+    t2 = gammaln(p.alpha) + gammaln(p.beta) + gammaln(sum_q)
+    t3 = (p.beta - q.beta) * digamma(p.beta)
+    t4 = (p.alpha - q.alpha) * digamma(p.alpha)
+    t5 = (sum_q - sum_p) * digamma(sum_p)
+    return t1 - t2 + t3 + t4 + t5
+
+
+@register_kl(Dirichlet, Dirichlet)
+def _kl_dirichlet_dirichlet(p, q):
+    np = _np()
+    sum_p = np.sum(p.alpha, axis=-1)
+    sum_q = np.sum(q.alpha, axis=-1)
+    t1 = gammaln(sum_p) - gammaln(sum_q)
+    t2 = np.sum(gammaln(p.alpha) - gammaln(q.alpha), axis=-1)
+    t3 = p.alpha - q.alpha
+    t4 = digamma(p.alpha) - np.expand_dims(digamma(sum_p), -1)
+    return t1 - t2 + np.sum(t3 * t4, axis=-1)
+
+
+@register_kl(HalfNormal, HalfNormal)
+def _kl_halfnormal_halfnormal(p, q):
+    np = _np()
+    var_ratio = (p.scale / q.scale) ** 2
+    return 0.5 * (var_ratio - 1 - np.log(var_ratio))
+
+
+@register_kl(Binomial, Binomial)
+def _kl_binomial_binomial(p, q):
+    np = _np()
+    kl = p.n * (p.prob * (p.logit - q.logit)
+                + np.log1p(-p.prob) - np.log1p(-q.prob))
+    return np.where(p.n > q.n, np.full_like(kl, _onp.inf), kl)
+
+
+@register_kl(MultivariateNormal, MultivariateNormal)
+def _kl_mvn_mvn(p, q):
+    np = _np()
+
+    def log_det(mvn):
+        return np.sum(np.log(np.diagonal(mvn.scale_tril, axis1=-2, axis2=-1)),
+                      axis=-1)
+
+    term1 = log_det(q) - log_det(p)
+    term2 = np.trace(np.matmul(q.precision, p.cov), axis1=-2, axis2=-1)
+    diff = q.loc - p.loc
+    term3 = np.einsum("...i,...i->...", diff,
+                      np.einsum("...jk,...j->...k", q.precision, diff))
+    n = p.loc.shape[-1]
+    return 0.5 * (term2 + term3 - n) + term1
+
+
+@register_kl(Uniform, Normal)
+def _kl_uniform_normal(p, q):
+    np = _np()
+    common_term = p.high - p.low
+    t1 = np.log(math.sqrt(math.pi * 2) * q.scale / common_term)
+    t2 = common_term ** 2 / 12
+    t3 = ((p.high + p.low - 2 * q.loc) / 2) ** 2
+    return t1 + 0.5 * (t2 + t3) / (q.scale ** 2)
+
+
+@register_kl(Uniform, Gumbel)
+def _kl_uniform_gumbel(p, q):
+    np = _np()
+    common_term = q.scale / (p.high - p.low)
+    high_loc_diff = (p.high - q.loc) / q.scale
+    low_loc_diff = (p.low - q.loc) / q.scale
+    t1 = np.log(common_term) + 0.5 * (high_loc_diff + low_loc_diff)
+    t2 = common_term * (np.exp(-high_loc_diff) - np.exp(-low_loc_diff))
+    return t1 - t2
+
+
+@register_kl(Exponential, Gumbel)
+def _kl_exponential_gumbel(p, q):
+    np = _np()
+    scale_rate_prod = q.scale / p.scale
+    loc_scale_ratio = q.loc / q.scale
+    t1 = np.log(scale_rate_prod) - 1
+    t2 = np.exp(loc_scale_ratio) * scale_rate_prod / (scale_rate_prod + 1)
+    t3 = 1 / scale_rate_prod
+    return t1 - loc_scale_ratio + t2 + t3
+
+
+@register_kl(Exponential, Normal)
+def _kl_exponential_normal(p, q):
+    np = _np()
+    var_normal = q.variance
+    rate_sqr = p.scale ** (-2)
+    t1 = 0.5 * np.log(rate_sqr * var_normal * 2 * math.pi)
+    t2 = 1 / rate_sqr
+    t3 = q.loc * p.scale
+    t4 = (q.loc ** 2) * 0.5
+    return t1 - 1 + (t2 - t3 + t4) / var_normal
+
+
+@register_kl(Exponential, Gamma)
+def _kl_exponential_gamma(p, q):
+    np = _np()
+    eg = _onp.euler_gamma
+    ratio = p.scale / q.scale
+    return (-q.shape * np.log(ratio) + ratio + gammaln(q.shape)
+            + q.shape * eg - (1 + eg))
+
+
+@register_kl(Independent, Independent)
+def _kl_independent_independent(p, q):
+    if p.reinterpreted_batch_ndims != q.reinterpreted_batch_ndims:
+        raise NotImplementedError(
+            "KL between Independents with different event dims")
+    kl = kl_divergence(p.base_dist, q.base_dist)
+    return sum_right_most(kl, p.reinterpreted_batch_ndims)

@@ -1,0 +1,211 @@
+"""Shared math for `gluon.probability` (reference:
+`python/mxnet/gluon/probability/distributions/utils.py:1-185`).
+
+The reference routes special functions through npx ops; here each one is a
+differentiable `apply_op_flat` wrapper over `jax.scipy.special`, so log-probs
+and entropies participate in the autograd tape and fuse under jit.
+
+Sampling helper `sample_op` records the draw on the tape with the
+distribution parameters as inputs, so reparameterized (pathwise) gradients
+flow: jax supplies implicit reparameterization for gamma/beta/dirichlet draws.
+"""
+from __future__ import annotations
+
+from functools import cached_property  # noqa: F401  (re-export, parity name)
+
+from ....ndarray.ndarray import NDArray, apply_op_flat
+from ....random import next_key
+
+
+def _special(name, jfn_name=None):
+    def op(x, *rest):
+        import jax.scipy.special as jsp
+
+        fn = getattr(jsp, jfn_name or name)
+        return apply_op_flat(name, fn, (x, *rest), cacheable=True)
+
+    op.__name__ = name
+    return op
+
+
+gammaln = _special("gammaln")
+digamma = _special("digamma")
+erf = _special("erf")
+erfc = _special("erfc")
+erfinv = _special("erfinv")
+xlogy = _special("xlogy")
+xlog1py = _special("xlog1py")
+expit = _special("expit")  # sigmoid
+logit_fn = _special("logit")
+
+
+# Module-level pure functions (NOT per-call lambdas) so the op-call jit
+# cache keys on a stable jfn identity — statics ride in as kwargs.
+
+def _betaln_fn(x, y):
+    import jax.scipy.special as jsp
+
+    return jsp.gammaln(x) + jsp.gammaln(y) - jsp.gammaln(x + y)
+
+
+def betaln(a, b):
+    return apply_op_flat("betaln", _betaln_fn, (a, b), cacheable=True)
+
+
+def _logsumexp_fn(v, axis=-1, keepdims=False):
+    import jax.scipy.special as jsp
+
+    return jsp.logsumexp(v, axis=axis, keepdims=keepdims)
+
+
+def logsumexp(x, axis=-1, keepdims=False):
+    return apply_op_flat("logsumexp", _logsumexp_fn, (x,),
+                         {"axis": axis, "keepdims": keepdims}, cacheable=True)
+
+
+def _log_softmax_fn(v, axis=-1):
+    import jax.nn as jnn
+
+    return jnn.log_softmax(v, axis=axis)
+
+
+def log_softmax(x, axis=-1):
+    return apply_op_flat("log_softmax", _log_softmax_fn, (x,),
+                         {"axis": axis}, cacheable=True)
+
+
+def _softmax_fn(v, axis=-1):
+    import jax.nn as jnn
+
+    return jnn.softmax(v, axis=axis)
+
+
+def softmax(x, axis=-1):
+    return apply_op_flat("softmax", _softmax_fn, (x,), {"axis": axis},
+                         cacheable=True)
+
+
+def _softplus_fn(v):
+    import jax.nn as jnn
+
+    return jnn.softplus(v)
+
+
+def softplus(x):
+    return apply_op_flat("softplus", _softplus_fn, (x,), cacheable=True)
+
+
+_EPS = 1.19e-7  # float32 machine epsilon; reference clips probs the same way
+
+
+def _clip_prob_fn(p):
+    import jax.numpy as jnp
+
+    return jnp.clip(p, _EPS, 1.0 - _EPS)
+
+
+def clip_prob(prob):
+    return apply_op_flat("clip_prob", _clip_prob_fn, (prob,), cacheable=True)
+
+
+def _prob2logit_fn(p):
+    import jax.numpy as jnp
+
+    pc = jnp.clip(p, _EPS, 1 - _EPS)
+    return jnp.log(pc) - jnp.log1p(-pc)
+
+
+def _prob2logit_multi_fn(p):
+    import jax.numpy as jnp
+
+    return jnp.log(jnp.clip(p, _EPS, 1.0))
+
+
+def prob2logit(prob, binary=True):
+    """Convert probability to logit (reference utils.py prob2logit)."""
+    if binary:
+        return apply_op_flat("prob2logit", _prob2logit_fn, (prob,),
+                             cacheable=True)
+    return apply_op_flat("prob2logit_multi", _prob2logit_multi_fn, (prob,),
+                         cacheable=True)
+
+
+def _sigmoid_fn(v):
+    import jax.nn as jnn
+
+    return jnn.sigmoid(v)
+
+
+def logit2prob(logit, binary=True):
+    if binary:
+        return apply_op_flat("logit2prob", _sigmoid_fn, (logit,),
+                             cacheable=True)
+    return apply_op_flat("logit2prob_multi", _softmax_fn, (logit,),
+                         {"axis": -1}, cacheable=True)
+
+
+def _sum_right_most_fn(v, ndim=1):
+    import jax.numpy as jnp
+
+    return jnp.sum(v, axis=tuple(range(-ndim, 0)))
+
+
+def sum_right_most(x, ndim):
+    """Sum out the rightmost `ndim` event dims of a log-prob tensor."""
+    if ndim == 0:
+        return x
+    return apply_op_flat("sum_right_most", _sum_right_most_fn, (x,),
+                         {"ndim": ndim}, cacheable=True)
+
+
+def norm_size(size):
+    """Normalize a user `size` argument: None | int | tuple → None | tuple."""
+    if size is None:
+        return None
+    if isinstance(size, int):
+        return (size,)
+    return tuple(size)
+
+
+def sample_op(name, fn, *params, size=None):
+    """Record a random draw on the autograd tape.
+
+    ``fn(key, size, *param_buffers) -> buffer`` where `size` is None (use the
+    broadcast parameter shape) or a full output-shape tuple. The PRNG key is
+    taken from the global RNG (traced-fresh under hybridize via
+    `trace_key_scope`) and held in the op closure; the distribution parameters
+    are tape inputs so pathwise/implicit gradients flow to them.
+    """
+    key = next_key()
+    sz = norm_size(size)
+    return apply_op_flat(name, lambda *p: fn(key, sz, *p), params)
+
+
+def as_ndarray(x, dtype=None):
+    if isinstance(x, NDArray):
+        return x if dtype is None else x.astype(dtype)
+    return NDArray(x, dtype=dtype or "float32")
+
+
+def promote_param(x):
+    """Scalars stay Python numbers (cheap broadcasting); arrays become NDArray."""
+    from numbers import Number
+
+    if isinstance(x, Number):
+        return x
+    return as_ndarray(x)
+
+
+def pshape(x):
+    """Shape of a parameter that may be a Python scalar."""
+    return getattr(x, "shape", ())
+
+
+def broadcast_param(x, batch_shape):
+    from ....numpy import broadcast_to as _bto
+
+    if isinstance(x, NDArray):
+        return _bto(x, batch_shape)
+    import numpy as onp
+
+    return as_ndarray(onp.broadcast_to(onp.asarray(x, dtype="float32"), batch_shape))

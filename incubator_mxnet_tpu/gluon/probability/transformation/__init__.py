@@ -1,0 +1,14 @@
+"""Transformations / bijectors (reference:
+`python/mxnet/gluon/probability/transformation/`)."""
+from .transformation import (AbsTransform, AffineTransform,  # noqa: F401
+                             ComposeTransform, ExpTransform, PowerTransform,
+                             SigmoidTransform, SoftmaxTransform,
+                             TransformBlock, Transformation)
+from .domain_map import biject_to, domain_map, transform_to  # noqa: F401
+
+__all__ = [
+    "Transformation", "TransformBlock", "ComposeTransform", "ExpTransform",
+    "AffineTransform", "PowerTransform", "SigmoidTransform",
+    "SoftmaxTransform", "AbsTransform", "domain_map", "biject_to",
+    "transform_to",
+]

@@ -1,0 +1,155 @@
+"""Registry mapping constraints to transformations from unconstrained space
+(reference: `python/mxnet/gluon/probability/transformation/domain_map.py`)."""
+from __future__ import annotations
+
+from numbers import Number
+
+from ..distributions.constraint import (Constraint, GreaterThan,
+                                        GreaterThanEq, HalfOpenInterval,
+                                        Interval, LessThan, LowerCholesky,
+                                        NonNegative, Positive, Real, Simplex,
+                                        UnitInterval)
+from .transformation import (AffineTransform, ComposeTransform, ExpTransform,
+                             SigmoidTransform, SoftmaxTransform,
+                             Transformation)
+
+__all__ = ["domain_map", "biject_to", "transform_to"]
+
+
+class domain_map:
+    """Registry: constraint type → factory producing a Transformation that
+    maps unconstrained reals onto the constrained domain."""
+
+    def __init__(self):
+        self._storage = {}
+        super().__init__()
+
+    def register(self, constraint, factory=None):
+        if factory is None:
+            return lambda f: self.register(constraint, f)
+        if isinstance(constraint, Constraint):
+            constraint = type(constraint)
+        if not (isinstance(constraint, type)
+                and issubclass(constraint, Constraint)):
+            raise TypeError(
+                "Expected constraint to be either a Constraint subclass or "
+                f"instance, but got {constraint}")
+        self._storage[constraint] = factory
+        return factory
+
+    def __call__(self, constraint):
+        try:
+            factory = self._storage[type(constraint)]
+        except KeyError:
+            raise NotImplementedError(
+                f"Cannot transform {type(constraint).__name__} constraints")
+        return factory(constraint)
+
+
+biject_to = domain_map()
+transform_to = domain_map()
+
+
+class _IdentityTransform(Transformation):
+    bijective = True
+    sign = 1
+
+    def _forward_compute(self, x):
+        return x
+
+    def _inverse_compute(self, y):
+        return y
+
+    def log_det_jacobian(self, x, y):  # noqa: ARG002
+        from .... import numpy as np
+
+        return np.zeros_like(x)
+
+
+@biject_to.register(Real)
+@transform_to.register(Real)
+def _transform_to_real(constraint):  # noqa: ARG001
+    return _IdentityTransform()
+
+
+@biject_to.register(Positive)
+@biject_to.register(NonNegative)
+@transform_to.register(Positive)
+@transform_to.register(NonNegative)
+def _transform_to_positive(constraint):  # noqa: ARG001
+    return ExpTransform()
+
+
+@biject_to.register(GreaterThan)
+@biject_to.register(GreaterThanEq)
+@transform_to.register(GreaterThan)
+@transform_to.register(GreaterThanEq)
+def _transform_to_greater_than(constraint):
+    return ComposeTransform([ExpTransform(),
+                             AffineTransform(constraint._lower_bound, 1)])
+
+
+@biject_to.register(LessThan)
+@transform_to.register(LessThan)
+def _transform_to_less_than(constraint):
+    return ComposeTransform([ExpTransform(),
+                             AffineTransform(constraint._upper_bound, -1)])
+
+
+@biject_to.register(UnitInterval)
+@biject_to.register(Interval)
+@biject_to.register(HalfOpenInterval)
+@transform_to.register(UnitInterval)
+@transform_to.register(Interval)
+@transform_to.register(HalfOpenInterval)
+def _transform_to_interval(constraint):
+    lower = getattr(constraint, "_lower_bound", 0)
+    upper = getattr(constraint, "_upper_bound", 1)
+    lower_is_0 = isinstance(lower, Number) and lower == 0
+    upper_is_1 = isinstance(upper, Number) and upper == 1
+    if lower_is_0 and upper_is_1:
+        return SigmoidTransform()
+    return ComposeTransform([SigmoidTransform(),
+                             AffineTransform(lower, upper - lower)])
+
+
+@biject_to.register(Simplex)
+@transform_to.register(Simplex)
+def _transform_to_simplex(constraint):  # noqa: ARG001
+    return SoftmaxTransform()
+
+
+@biject_to.register(LowerCholesky)
+@transform_to.register(LowerCholesky)
+def _transform_to_lower_cholesky(constraint):  # noqa: ARG001
+    class _LowerCholeskyTransform(Transformation):
+        event_dim = 2
+
+        def _forward_compute(self, x):
+            from .... import numpy as np
+            from ....ndarray.ndarray import apply_op_flat
+
+            import jax.numpy as jnp
+
+            def f(m):
+                tril = jnp.tril(m, -1)
+                diag = jnp.exp(jnp.diagonal(m, axis1=-2, axis2=-1))
+                return tril + jnp.vectorize(jnp.diag,
+                                            signature="(k)->(k,k)")(diag)
+
+            return apply_op_flat("lower_cholesky_fwd", f, (x,))
+
+        def _inverse_compute(self, y):
+            from ....ndarray.ndarray import apply_op_flat
+
+            import jax.numpy as jnp
+
+            def f(m):
+                tril = jnp.tril(m, -1)
+                diag = jnp.log(jnp.diagonal(m, axis1=-2, axis2=-1))
+                return tril + jnp.vectorize(jnp.diag,
+                                            signature="(k)->(k,k)")(diag)
+
+            return apply_op_flat("lower_cholesky_inv", f, (y,))
+
+    return _LowerCholeskyTransform()

@@ -1,0 +1,276 @@
+"""Invertible transformations / bijectors (reference:
+`python/mxnet/gluon/probability/transformation/transformation.py:32-290`).
+
+Each transformation is a composition of autograd-aware `np` ops, so
+`TransformedDistribution.log_prob` is differentiable end to end and traces
+cleanly under hybridize/jit.
+"""
+from __future__ import annotations
+
+import math
+
+__all__ = [
+    "Transformation", "TransformBlock", "ComposeTransform", "ExpTransform",
+    "AffineTransform", "PowerTransform", "SigmoidTransform",
+    "SoftmaxTransform", "AbsTransform",
+]
+
+
+def _np():
+    from .... import numpy as np
+
+    return np
+
+
+class Transformation:
+    """Abstract invertible transformation with computable log-det-Jacobian."""
+
+    bijective = False
+    event_dim = 0
+
+    def __init__(self):
+        self._inv = None
+        super().__init__()
+
+    @property
+    def sign(self):
+        """Sign of the derivative (+1/-1) for monotonic transforms."""
+        raise NotImplementedError
+
+    @property
+    def inv(self):
+        inv = None
+        if self._inv is not None:
+            inv = self._inv()
+        if inv is None:
+            inv = _InverseTransformation(self)
+            import weakref
+
+            self._inv = weakref.ref(inv)
+        return inv
+
+    def __call__(self, x):
+        return self._forward_compute(x)
+
+    def _inv_call(self, y):
+        return self._inverse_compute(y)
+
+    def _forward_compute(self, x):
+        raise NotImplementedError
+
+    def _inverse_compute(self, y):
+        raise NotImplementedError
+
+    def log_det_jacobian(self, x, y):
+        """log|dy/dx| evaluated at (x, y=T(x))."""
+        raise NotImplementedError
+
+
+class _InverseTransformation(Transformation):
+    """The inverse of a Transformation, sharing its state."""
+
+    def __init__(self, forward_transformation):
+        super().__init__()
+        self._fn = forward_transformation
+
+    @property
+    def inv(self):
+        return self._fn
+
+    @property
+    def sign(self):
+        return self._fn.sign
+
+    @property
+    def event_dim(self):
+        return self._fn.event_dim
+
+    def __call__(self, x):
+        return self._fn._inv_call(x)
+
+    def log_det_jacobian(self, x, y):
+        return -self._fn.log_det_jacobian(y, x)
+
+
+class TransformBlock(Transformation):
+    """Transformation that is also a gluon HybridBlock (can hold Parameters,
+    e.g. learned flows). Reference transformation.py:113-122."""
+
+    def __init__(self, *args, **kwargs):
+        from ...block import HybridBlock
+
+        Transformation.__init__(self)
+        # cooperative: behave as a HybridBlock too
+        self._block = HybridBlock(*args, **kwargs)
+
+    def __getattr__(self, name):
+        return getattr(self.__dict__["_block"], name)
+
+
+class ComposeTransform(Transformation):
+    """Composition T_n ∘ ... ∘ T_1."""
+
+    def __init__(self, parts):
+        super().__init__()
+        self._parts = list(parts)
+
+    def _forward_compute(self, x):
+        for t in self._parts:
+            x = t(x)
+        return x
+
+    def _inverse_compute(self, y):
+        for t in reversed(self._parts):
+            y = t.inv(y)
+        return y
+
+    @property
+    def sign(self):
+        s = 1
+        for t in self._parts:
+            s = s * t.sign
+        return s
+
+    @property
+    def event_dim(self):
+        return max(t.event_dim for t in self._parts) if self._parts else 0
+
+    @property
+    def inv(self):
+        inv = None
+        if self._inv is not None:
+            inv = self._inv()
+        if inv is None:
+            inv = ComposeTransform([t.inv for t in reversed(self._parts)])
+            import weakref
+
+            self._inv = weakref.ref(inv)
+            inv._inv = weakref.ref(self)
+        return inv
+
+    def log_det_jacobian(self, x, y):
+        from ..distributions.utils import sum_right_most
+
+        result = 0.0
+        event_dim = self.event_dim
+        for t in self._parts:
+            y_t = t(x)
+            result = result + sum_right_most(t.log_det_jacobian(x, y_t),
+                                             event_dim - t.event_dim)
+            x = y_t
+        return result
+
+
+class ExpTransform(Transformation):
+    r"""y = exp(x)."""
+
+    bijective = True
+    sign = 1
+
+    def _forward_compute(self, x):
+        return _np().exp(x)
+
+    def _inverse_compute(self, y):
+        return _np().log(y)
+
+    def log_det_jacobian(self, x, y):  # noqa: ARG002
+        return x
+
+
+class AffineTransform(Transformation):
+    r"""y = loc + scale * x."""
+
+    bijective = True
+
+    def __init__(self, loc, scale, event_dim=0):
+        super().__init__()
+        self._loc = loc
+        self._scale = scale
+        self.event_dim = event_dim
+
+    def _forward_compute(self, x):
+        return self._loc + self._scale * x
+
+    def _inverse_compute(self, y):
+        return (y - self._loc) / self._scale
+
+    def log_det_jacobian(self, x, y):  # noqa: ARG002
+        np = _np()
+        scale = self._scale
+        if isinstance(scale, (int, float)):
+            return np.full_like(x, math.log(abs(scale)))
+        return np.broadcast_to(np.log(np.abs(scale)), x.shape)
+
+    @property
+    def sign(self):
+        np = _np()
+        if isinstance(self._scale, (int, float)):
+            return 1 if self._scale > 0 else -1
+        return np.sign(self._scale)
+
+
+class PowerTransform(Transformation):
+    r"""y = x ** exponent (for x > 0)."""
+
+    bijective = True
+    sign = 1
+
+    def __init__(self, exponent):
+        super().__init__()
+        self._exponent = exponent
+
+    def _forward_compute(self, x):
+        return x ** self._exponent
+
+    def _inverse_compute(self, y):
+        return y ** (1.0 / self._exponent)
+
+    def log_det_jacobian(self, x, y):
+        np = _np()
+        return np.log(np.abs(self._exponent * y / x))
+
+
+class SigmoidTransform(Transformation):
+    r"""y = 1 / (1 + exp(-x))."""
+
+    bijective = True
+    sign = 1
+
+    def _forward_compute(self, x):
+        from ..distributions.utils import expit
+
+        return expit(x)
+
+    def _inverse_compute(self, y):
+        np = _np()
+        return np.log(y) - np.log1p(-y)
+
+    def log_det_jacobian(self, x, y):  # noqa: ARG002
+        from ..distributions.utils import softplus
+
+        return -softplus(-x) - softplus(x)
+
+
+class SoftmaxTransform(Transformation):
+    r"""y = softmax(x) over the trailing axis (not bijective; used for
+    transform_to simplex constraints)."""
+
+    event_dim = 1
+
+    def _forward_compute(self, x):
+        from ..distributions.utils import softmax
+
+        return softmax(x, axis=-1)
+
+    def _inverse_compute(self, y):
+        return _np().log(y)
+
+
+class AbsTransform(Transformation):
+    r"""y = |x| (not bijective)."""
+
+    def _forward_compute(self, x):
+        return _np().abs(x)
+
+    def _inverse_compute(self, y):
+        return y

@@ -189,7 +189,8 @@ class CSRNDArray(NDArray):
     column ids, indptr (rows+1,). Dense fallback is lazy; `dot` with a
     dense rhs stays sparse via jax BCOO."""
 
-    __slots__ = ("_sp_data", "_sp_col_indices", "_sp_indptr", "_sp_shape")
+    __slots__ = ("_sp_data", "_sp_col_indices", "_sp_indptr", "_sp_shape",
+                 "_sp_stale")
 
     def __init__(self, data, indices, indptr, shape, dtype=None):
         jnp = _jnp()
@@ -213,8 +214,28 @@ class CSRNDArray(NDArray):
         self._sp_col_indices = col
         self._sp_indptr = ptr
         self._sp_shape = shape
+        self._sp_stale = False
+
+    def _sp_refresh(self):
+        """Recompute the CSR payload from the dense buffer after an in-place
+        dense mutation (the funnel writes through `_data`), so sparse views
+        never serve stale values."""
+        if not self._sp_stale:
+            return
+        d = onp.asarray(NDArray._data.__get__(self))
+        rows, cols = onp.nonzero(d)
+        order = onp.lexsort((cols, rows))
+        rows, cols = rows[order], cols[order]
+        jnp = _jnp()
+        self._sp_data = jnp.asarray(d[rows, cols])
+        self._sp_col_indices = jnp.asarray(cols.astype(onp.int32))
+        indptr = onp.zeros(d.shape[0] + 1, dtype=onp.int32)
+        onp.add.at(indptr, rows + 1, 1)
+        self._sp_indptr = jnp.asarray(onp.cumsum(indptr).astype(onp.int32))
+        self._sp_stale = False
 
     def _row_ids(self):
+        self._sp_refresh()
         jnp = _jnp()
         counts = self._sp_indptr[1:] - self._sp_indptr[:-1]
         return jnp.repeat(jnp.arange(self._sp_shape[0], dtype=jnp.int32),
@@ -239,7 +260,11 @@ class CSRNDArray(NDArray):
 
     @_data.setter
     def _data(self, value):
+        # dense write-through (mutation funnel): mark the CSR payload stale;
+        # it is lazily re-derived from the dense buffer on next sparse use
         NDArray._data.__set__(self, value)
+        if value is not None:
+            self._sp_stale = True
 
     @property
     def stype(self):
@@ -261,14 +286,17 @@ class CSRNDArray(NDArray):
 
     @property
     def data(self):
+        self._sp_refresh()
         return NDArray(self._sp_data)
 
     @property
     def indices(self):
+        self._sp_refresh()
         return NDArray(self._sp_col_indices)
 
     @property
     def indptr(self):
+        self._sp_refresh()
         return NDArray(self._sp_indptr)
 
     def tostype(self, stype):
@@ -281,6 +309,7 @@ class CSRNDArray(NDArray):
         raise ValueError(f"cannot convert csr to {stype!r}")
 
     def copy(self):
+        self._sp_refresh()
         return CSRNDArray(self._sp_data, self._sp_col_indices,
                           self._sp_indptr, self._sp_shape)
 
@@ -402,22 +431,30 @@ def retain(rsp, indices):
 
 def dot(lhs, rhs, transpose_a=False, transpose_b=False):
     """Sparse-aware dot (reference: `src/operator/tensor/dot-inl.h`):
-    csr @ dense and csr.T @ dense run through jax BCOO without
-    densifying; other combinations fall back to dense."""
-    jnp = _jnp()
+    csr @ dense and csr.T @ dense run through jax BCOO without densifying;
+    other combinations fall back to dense. Either way the op is recorded on
+    the autograd tape, so gradients flow to dense (tracked) operands."""
     if isinstance(lhs, CSRNDArray) and isinstance(rhs, NDArray) \
             and not isinstance(rhs, (CSRNDArray, RowSparseNDArray)):
         m = lhs._bcoo()
         if transpose_a:
             m = m.T
-        r = rhs._data.T if transpose_b else rhs._data
-        out = m @ r
-        return NDArray(out)
-    a = lhs.tostype("default") if hasattr(lhs, "tostype") else lhs
-    b = rhs.tostype("default") if hasattr(rhs, "tostype") else rhs
-    av = a._data.T if transpose_a else a._data
-    bv = b._data.T if transpose_b else b._data
-    return apply_op("dot", lambda x, y: x @ y, (NDArray(av), NDArray(bv)))
+
+        def spmm(y):
+            return m @ (y.T if transpose_b else y)
+
+        return apply_op("sparse_dot", spmm, (rhs,))
+    # dense fallback: sparse operands densify (they carry no tape), dense
+    # operands pass through tracked so backward reaches them
+    a = lhs.tostype("default") \
+        if isinstance(lhs, (CSRNDArray, RowSparseNDArray)) else lhs
+    b = rhs.tostype("default") \
+        if isinstance(rhs, (CSRNDArray, RowSparseNDArray)) else rhs
+
+    def dense_dot(x, y):
+        return (x.T if transpose_a else x) @ (y.T if transpose_b else y)
+
+    return apply_op("dot", dense_dot, (a, b))
 
 
 def add(lhs, rhs):

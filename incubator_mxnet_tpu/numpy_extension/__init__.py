@@ -506,7 +506,47 @@ def embedding(data, weight, input_dim=None, output_dim=None, dtype=None,
         out = jnp.take(w, idx.astype(jnp.int32), axis=0)
         return out.astype(np_dtype(dtype)) if dtype else out
 
-    return apply_op("embedding", f, (data, weight))
+    from ..ndarray.ndarray import _is_tracer
+
+    if not sparse_grad or _is_tracer(getattr(data, "_data", data)) \
+            or _is_tracer(weight._data):
+        # dense path; under a hybridize/jit trace XLA's scatter-add IS the
+        # efficient embedding gradient, so sparse bookkeeping is eager-only
+        return apply_op("embedding", f, (data, weight))
+
+    # sparse_grad=True (reference: EmbeddingOp row_sparse gradient,
+    # `src/operator/tensor/indexing_op.cc`): custom tape node whose backward
+    # emits a RowSparseNDArray cotangent for `weight` — only the looked-up
+    # rows are stored, never a (vocab, dim) dense buffer.
+    from .. import autograd as _ag
+    from ..autograd import TapeNode
+    from ..ndarray.ndarray import _ShapeDtype
+    from ..ndarray.sparse import RowSparseNDArray
+
+    idx_val = data._data if isinstance(data, NDArray) else jnp.asarray(data)
+    w_arr = weight
+    out = NDArray(f(idx_val, w_arr._data))
+
+    if _ag.is_recording() and (w_arr._node is not None
+                               or w_arr._grad is not None):
+        w_shape = tuple(w_arr.shape)
+
+        def vjp_fn(cot):
+            cot = cot[0] if isinstance(cot, tuple) else cot
+            flat_idx = idx_val.reshape(-1).astype(jnp.int32)
+            flat_cot = cot.reshape(-1, cot.shape[-1])
+            return (None,
+                    RowSparseNDArray(flat_cot, flat_idx, w_shape))
+
+        node = TapeNode(None, [idx_val, w_arr._data],
+                        [data if isinstance(data, NDArray) else NDArray(idx_val),
+                         w_arr],
+                        1, "embedding_sparse", vjp_fn=vjp_fn)
+        node.out_avals = [_ShapeDtype(out._data)]
+        node.tuple_out = False
+        out._node = node
+        out._out_idx = 0
+    return out
 
 
 def one_hot(data, depth, on_value=1.0, off_value=0.0, dtype="float32"):

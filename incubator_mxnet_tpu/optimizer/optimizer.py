@@ -146,12 +146,38 @@ class Optimizer:
         lr = self._get_lr(index)
         wd = self._get_wd(index)
         t = self._index_update_count[index]
+        from ..ndarray.sparse import RowSparseNDArray
+
+        if isinstance(grad, RowSparseNDArray) \
+                and not isinstance(weight, RowSparseNDArray):
+            return self._sparse_update(weight, grad, state, lr, wd, t)
         new_w, new_state = self.step(weight._data, grad._data, state, lr, wd, t)
         weight._set_data(new_w)
         if state is not None and new_state is not None:
             if isinstance(state, list):
                 state[:] = new_state
         return new_state
+
+    def _sparse_update(self, weight, grad, state, lr, wd, t):
+        """Lazy row-sparse update (reference: sparse sgd/adam variants in
+        `src/operator/optimizer_op.cc`): run the dense step() on ONLY the
+        rows present in the row_sparse gradient and scatter the results
+        back — weight rows and optimizer state for untouched rows stay
+        untouched, the reference's lazy_update semantics."""
+        rows, gvals = grad._canonical()
+        if rows.shape[0] == 0:
+            return state
+        wv = weight._data
+        w_rows = wv[rows]
+        st_rows = ([s[rows] for s in state]
+                   if isinstance(state, list) else state)
+        new_w_rows, new_st_rows = self.step(
+            w_rows, gvals.astype(wv.dtype), st_rows, lr, wd, t)
+        weight._set_data(wv.at[rows].set(new_w_rows.astype(wv.dtype)))
+        if isinstance(state, list) and new_st_rows:
+            for i, s_new in enumerate(new_st_rows):
+                state[i] = state[i].at[rows].set(s_new.astype(state[i].dtype))
+        return state
 
     def update_multi_precision(self, index, weight, grad, state):
         jnp = _jnp()

@@ -1,0 +1,401 @@
+"""gluon.probability tests (reference test strategy:
+`tests/python/unittest/test_gluon_probability_v2.py` — sampling moments,
+log_prob vs scipy, KL numerics vs empirical, autograd through densities)."""
+import math
+
+import numpy as onp
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import autograd, np
+from incubator_mxnet_tpu.gluon import probability as mgp
+
+mx.random.seed(7)
+
+
+def A(x):
+    return onp.asarray(x.asnumpy() if hasattr(x, "asnumpy") else x)
+
+
+# ---------------------------------------------------------------------------
+# log_prob vs scipy
+# ---------------------------------------------------------------------------
+
+def test_normal_log_prob_cdf_icdf():
+    from scipy import stats
+
+    loc, scale = 0.7, 1.3
+    d = mgp.Normal(loc, scale)
+    x = onp.linspace(-3, 3, 11).astype("float32")
+    ref = stats.norm(loc, scale)
+    onp.testing.assert_allclose(A(d.log_prob(np.array(x))), ref.logpdf(x),
+                                rtol=1e-5, atol=1e-5)
+    onp.testing.assert_allclose(A(d.cdf(np.array(x))), ref.cdf(x),
+                                rtol=1e-5, atol=1e-5)
+    q = onp.linspace(0.05, 0.95, 7).astype("float32")
+    onp.testing.assert_allclose(A(d.icdf(np.array(q))), ref.ppf(q),
+                                rtol=1e-4, atol=1e-4)
+
+
+@pytest.mark.parametrize("dist,ref_fn,xs", [
+    (lambda: mgp.Laplace(0.5, 2.0),
+     lambda s: s.laplace(0.5, 2.0), onp.linspace(-4, 4, 9)),
+    (lambda: mgp.Cauchy(0.0, 1.5),
+     lambda s: s.cauchy(0.0, 1.5), onp.linspace(-4, 4, 9)),
+    (lambda: mgp.Exponential(2.0),
+     lambda s: s.expon(scale=2.0), onp.linspace(0.1, 5, 9)),
+    (lambda: mgp.Gamma(3.0, 0.5),
+     lambda s: s.gamma(3.0, scale=0.5), onp.linspace(0.1, 5, 9)),
+    (lambda: mgp.Beta(2.0, 3.0),
+     lambda s: s.beta(2.0, 3.0), onp.linspace(0.05, 0.95, 9)),
+    (lambda: mgp.Gumbel(0.5, 1.2),
+     lambda s: s.gumbel_r(0.5, 1.2), onp.linspace(-3, 5, 9)),
+    (lambda: mgp.Weibull(1.7, 2.0),
+     lambda s: s.weibull_min(1.7, scale=2.0), onp.linspace(0.1, 5, 9)),
+    (lambda: mgp.StudentT(4.0, 0.0, 1.0),
+     lambda s: s.t(4.0), onp.linspace(-4, 4, 9)),
+    (lambda: mgp.Pareto(3.0, 1.0),
+     lambda s: s.pareto(3.0), onp.linspace(1.1, 5, 9)),
+    (lambda: mgp.HalfNormal(1.5),
+     lambda s: s.halfnorm(scale=1.5), onp.linspace(0.1, 4, 9)),
+    (lambda: mgp.HalfCauchy(1.0),
+     lambda s: s.halfcauchy(scale=1.0), onp.linspace(0.1, 4, 9)),
+    (lambda: mgp.Chi2(5.0),
+     lambda s: s.chi2(5.0), onp.linspace(0.5, 10, 9)),
+    (lambda: mgp.FisherSnedecor(5.0, 7.0),
+     lambda s: s.f(5.0, 7.0), onp.linspace(0.2, 4, 9)),
+    (lambda: mgp.Poisson(3.0),
+     lambda s: s.poisson(3.0), onp.arange(0, 9)),
+    (lambda: mgp.Geometric(prob=0.3),
+     lambda s: s.geom(0.3, loc=-1), onp.arange(0, 9)),
+    (lambda: mgp.Binomial(10, prob=0.4),
+     lambda s: s.binom(10, 0.4), onp.arange(0, 11)),
+])
+def test_log_prob_vs_scipy(dist, ref_fn, xs):
+    from scipy import stats
+
+    d = dist()
+    ref = ref_fn(stats)
+    xs = xs.astype("float32")
+    got = A(d.log_prob(np.array(xs)))
+    want = (ref.logpmf(xs) if hasattr(ref, "logpmf") else ref.logpdf(xs))
+    onp.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+
+
+def test_uniform_log_prob_support():
+    d = mgp.Uniform(1.0, 3.0)
+    lp = A(d.log_prob(np.array([0.5, 2.0, 3.5], dtype="float32")))
+    assert lp[0] == -onp.inf and lp[2] == -onp.inf
+    onp.testing.assert_allclose(lp[1], -math.log(2.0), rtol=1e-6)
+
+
+def test_categorical_and_onehot():
+    from scipy import stats  # noqa: F401
+
+    logits = onp.log(onp.array([0.2, 0.3, 0.5], dtype="float32"))
+    c = mgp.Categorical(3, logit=np.array(logits))
+    lp = A(c.log_prob(np.array([0.0, 1.0, 2.0])))
+    onp.testing.assert_allclose(onp.exp(lp), [0.2, 0.3, 0.5], rtol=1e-5)
+    s = c.sample((1000,))
+    assert set(onp.unique(A(s))).issubset({0.0, 1.0, 2.0})
+    sup = A(c.enumerate_support())
+    onp.testing.assert_allclose(sup, [0.0, 1.0, 2.0])
+
+    oh = mgp.OneHotCategorical(3, prob=np.array([0.2, 0.3, 0.5],
+                                                dtype="float32"))
+    v = onp.eye(3, dtype="float32")
+    onp.testing.assert_allclose(onp.exp(A(oh.log_prob(np.array(v)))),
+                                [0.2, 0.3, 0.5], rtol=1e-5)
+    assert A(oh.sample((50,))).shape == (50, 3)
+
+
+def test_mvn_log_prob_and_sample():
+    from scipy import stats
+
+    loc = onp.array([0.5, -0.3], dtype="float32")
+    cov = onp.array([[1.2, 0.4], [0.4, 0.9]], dtype="float32")
+    d = mgp.MultivariateNormal(np.array(loc), cov=np.array(cov))
+    x = onp.array([[0.0, 0.0], [1.0, -1.0]], dtype="float32")
+    ref = stats.multivariate_normal(loc, cov)
+    onp.testing.assert_allclose(A(d.log_prob(np.array(x))), ref.logpdf(x),
+                                rtol=1e-4)
+    s = A(d.sample((4000,)))
+    onp.testing.assert_allclose(s.mean(0), loc, atol=0.1)
+    onp.testing.assert_allclose(onp.cov(s.T), cov, atol=0.15)
+    # scale_tril / precision parameterizations agree
+    lt = onp.linalg.cholesky(cov).astype("float32")
+    d2 = mgp.MultivariateNormal(np.array(loc), scale_tril=np.array(lt))
+    d3 = mgp.MultivariateNormal(np.array(loc),
+                                precision=np.array(onp.linalg.inv(cov)))
+    onp.testing.assert_allclose(A(d2.log_prob(np.array(x))), ref.logpdf(x),
+                                rtol=1e-4)
+    onp.testing.assert_allclose(A(d3.log_prob(np.array(x))), ref.logpdf(x),
+                                rtol=1e-3, atol=1e-3)
+
+
+# ---------------------------------------------------------------------------
+# sampling moments + shapes
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("dist,mean,var", [
+    (lambda: mgp.Normal(1.0, 2.0), 1.0, 4.0),
+    (lambda: mgp.Laplace(0.0, 1.0), 0.0, 2.0),
+    (lambda: mgp.Exponential(2.0), 2.0, 4.0),
+    (lambda: mgp.Gamma(2.0, 1.5), 3.0, 4.5),
+    (lambda: mgp.Beta(2.0, 2.0), 0.5, 0.05),
+    (lambda: mgp.Poisson(4.0), 4.0, 4.0),
+    (lambda: mgp.Bernoulli(prob=0.3), 0.3, 0.21),
+    (lambda: mgp.Uniform(0.0, 2.0), 1.0, 1.0 / 3),
+    (lambda: mgp.Gumbel(0.0, 1.0), onp.euler_gamma, math.pi ** 2 / 6),
+])
+def test_sample_moments(dist, mean, var):
+    d = dist()
+    s = A(d.sample((6000,)))
+    assert abs(s.mean() - mean) < 6 * math.sqrt(var / 6000) + 0.02
+    assert abs(s.var() - var) < 0.25 * max(var, 0.15)
+    onp.testing.assert_allclose(A(d.mean), mean, rtol=1e-4, atol=1e-5)
+    onp.testing.assert_allclose(A(d.variance), var, rtol=1e-4, atol=1e-5)
+
+
+def test_sample_shapes_and_sample_n():
+    d = mgp.Normal(np.zeros((3, 2)), np.ones((3, 2)))
+    assert d.sample().shape == (3, 2)
+    assert d.sample((5, 3, 2)).shape == (5, 3, 2)
+    assert d.sample_n(7).shape == (7, 3, 2)
+    dd = mgp.Dirichlet(np.ones((4, 3)))
+    assert dd.sample().shape == (4, 3)
+    s = A(dd.sample())
+    onp.testing.assert_allclose(s.sum(-1), onp.ones(4), rtol=1e-5)
+    b = d.broadcast_to((5, 3, 2))
+    assert b.sample().shape == (5, 3, 2)
+
+
+# ---------------------------------------------------------------------------
+# entropy / KL
+# ---------------------------------------------------------------------------
+
+def test_entropy_closed_forms():
+    from scipy import stats
+
+    pairs = [
+        (mgp.Normal(0.5, 1.5), stats.norm(0.5, 1.5)),
+        (mgp.Laplace(0.0, 2.0), stats.laplace(0.0, 2.0)),
+        (mgp.Exponential(0.7), stats.expon(scale=0.7)),
+        (mgp.Gamma(2.5, 1.2), stats.gamma(2.5, scale=1.2)),
+        (mgp.Beta(2.0, 3.0), stats.beta(2.0, 3.0)),
+        (mgp.Gumbel(0.0, 1.3), stats.gumbel_r(0.0, 1.3)),
+        (mgp.Uniform(1.0, 4.0), stats.uniform(1.0, 3.0)),
+    ]
+    for d, ref in pairs:
+        onp.testing.assert_allclose(A(d.entropy()), ref.entropy(),
+                                    rtol=1e-4, atol=1e-4)
+
+
+def test_bernoulli_exponential_family_entropy():
+    # generic ExponentialFamily.entropy (Bregman identity) matches closed form
+    p = 0.3
+    d = mgp.Bernoulli(prob=p)
+    want = -(p * math.log(p) + (1 - p) * math.log(1 - p))
+    onp.testing.assert_allclose(A(mgp.ExponentialFamily.entropy(d)), want,
+                                rtol=1e-4)
+    onp.testing.assert_allclose(A(d.entropy()), want, rtol=1e-4)
+
+
+@pytest.mark.parametrize("p,q", [
+    (lambda: mgp.Normal(0.0, 1.0), lambda: mgp.Normal(1.0, 2.0)),
+    (lambda: mgp.Gamma(2.0, 1.0), lambda: mgp.Gamma(3.0, 0.5)),
+    (lambda: mgp.Beta(2.0, 2.0), lambda: mgp.Beta(3.0, 1.5)),
+    (lambda: mgp.Laplace(0.0, 1.0), lambda: mgp.Laplace(0.5, 2.0)),
+    (lambda: mgp.Poisson(3.0), lambda: mgp.Poisson(5.0)),
+    (lambda: mgp.Bernoulli(prob=0.3), lambda: mgp.Bernoulli(prob=0.6)),
+    (lambda: mgp.Exponential(1.0), lambda: mgp.Exponential(2.0)),
+    (lambda: mgp.Geometric(prob=0.4), lambda: mgp.Geometric(prob=0.2)),
+    (lambda: mgp.Categorical(3, prob=np.array([0.2, 0.3, 0.5])),
+     lambda: mgp.Categorical(3, prob=np.array([0.5, 0.25, 0.25]))),
+])
+def test_kl_vs_empirical(p, q):
+    mx.random.seed(11)
+    P, Q = p(), q()
+    kl = A(mgp.kl_divergence(P, Q))
+    est = A(mgp.empirical_kl(P, Q, n_samples=40000))
+    assert abs(kl - est) < max(0.08, 0.15 * abs(kl)), (kl, est)
+
+
+def test_kl_mvn():
+    loc = onp.array([0.0, 0.0], dtype="float32")
+    c1 = onp.array([[1.0, 0.2], [0.2, 1.0]], dtype="float32")
+    c2 = onp.array([[2.0, -0.3], [-0.3, 1.5]], dtype="float32")
+    P = mgp.MultivariateNormal(np.array(loc), cov=np.array(c1))
+    Q = mgp.MultivariateNormal(np.array(loc) + 0.5, cov=np.array(c2))
+    kl = A(mgp.kl_divergence(P, Q))
+    # closed form cross-check in numpy
+    ic2 = onp.linalg.inv(c2)
+    diff = onp.array([0.5, 0.5])
+    want = 0.5 * (onp.log(onp.linalg.det(c2) / onp.linalg.det(c1)) - 2
+                  + onp.trace(ic2 @ c1) + diff @ ic2 @ diff)
+    onp.testing.assert_allclose(kl, want, rtol=1e-4)
+
+
+def test_kl_independent_and_chi2_dispatch():
+    P = mgp.Independent(mgp.Normal(np.zeros(4), np.ones(4)), 1)
+    Q = mgp.Independent(mgp.Normal(np.ones(4), np.ones(4)), 1)
+    onp.testing.assert_allclose(A(mgp.kl_divergence(P, Q)), 2.0, rtol=1e-5)
+    # Chi2 → Gamma formula via MRO dispatch
+    kl = A(mgp.kl_divergence(mgp.Chi2(4.0), mgp.Gamma(2.0, 2.0)))
+    assert abs(kl) < 1e-5  # Chi2(4) IS Gamma(2, 2)
+
+
+# ---------------------------------------------------------------------------
+# gradients
+# ---------------------------------------------------------------------------
+
+def test_pathwise_gradient_normal():
+    loc = np.array([0.5])
+    scale = np.array([1.0])
+    loc.attach_grad()
+    scale.attach_grad()
+    mx.random.seed(3)
+    with autograd.record():
+        d = mgp.Normal(loc, scale)
+        s = d.sample((256,))
+        loss = np.sum(s * s) / 256  # E[x^2] = loc^2 + scale^2
+    loss.backward()
+    # d/dloc E[x^2] = 2*loc;  d/dscale = 2*scale
+    assert abs(float(A(loc.grad)) - 2 * 0.5) < 0.4
+    assert abs(float(A(scale.grad)) - 2 * 1.0) < 0.5
+
+
+def test_log_prob_gradient():
+    loc = np.array(0.0)
+    loc.attach_grad()
+    with autograd.record():
+        lp = mgp.Normal(loc, 1.0).log_prob(np.array(1.5))
+    lp.backward()
+    onp.testing.assert_allclose(A(loc.grad), 1.5, rtol=1e-5)
+
+
+def test_gamma_implicit_reparam_grad():
+    a = np.array(2.0)
+    a.attach_grad()
+    mx.random.seed(5)
+    with autograd.record():
+        s = mgp.Gamma(a, 1.0).sample((512,))
+        m = np.mean(s)
+    m.backward()
+    # dE[x]/da = scale = 1
+    assert abs(float(A(a.grad)) - 1.0) < 0.35
+
+
+def test_relaxed_bernoulli_pathwise():
+    logit = np.array(0.3)
+    logit.attach_grad()
+    with autograd.record():
+        d = mgp.RelaxedBernoulli(0.5, logit=logit)
+        s = d.sample((128,))
+        m = np.mean(s)
+    m.backward()
+    assert float(A(logit.grad)) > 0  # increasing logit increases samples
+
+
+# ---------------------------------------------------------------------------
+# transformations
+# ---------------------------------------------------------------------------
+
+def test_transformed_distribution_lognormal():
+    from scipy import stats
+
+    base = mgp.Normal(0.2, 0.5)
+    d = mgp.TransformedDistribution(base, mgp.ExpTransform())
+    ref = stats.lognorm(0.5, scale=math.exp(0.2))
+    x = onp.linspace(0.3, 3, 9).astype("float32")
+    onp.testing.assert_allclose(A(d.log_prob(np.array(x))), ref.logpdf(x),
+                                rtol=1e-4)
+    onp.testing.assert_allclose(A(d.cdf(np.array(x))), ref.cdf(x), rtol=1e-4)
+    s = A(d.sample((2000,)))
+    assert (s > 0).all()
+
+
+def test_compose_and_inverse_transform():
+    t = mgp.ComposeTransform([mgp.ExpTransform(),
+                              mgp.AffineTransform(1.0, 2.0)])
+    x = np.array([0.0, 0.5], dtype="float32")
+    y = t(x)
+    onp.testing.assert_allclose(A(y), 1 + 2 * onp.exp(A(x)), rtol=1e-5)
+    x_back = t.inv(y)
+    onp.testing.assert_allclose(A(x_back), A(x), rtol=1e-5, atol=1e-6)
+    ldj = A(t.log_det_jacobian(x, y))
+    onp.testing.assert_allclose(ldj, A(x) + math.log(2.0), rtol=1e-5)
+
+
+def test_biject_to_domains():
+    from incubator_mxnet_tpu.gluon.probability import biject_to
+    from incubator_mxnet_tpu.gluon.probability.distributions import constraint
+
+    x = np.array([-2.0, 0.0, 2.0], dtype="float32")
+    pos = biject_to(constraint.Positive())(x)
+    assert (A(pos) > 0).all()
+    unit = biject_to(constraint.UnitInterval())(x)
+    assert ((A(unit) > 0) & (A(unit) < 1)).all()
+    gt = biject_to(constraint.GreaterThan(3.0))(x)
+    assert (A(gt) > 3).all()
+    simplex = biject_to(constraint.Simplex())(np.array([[0.1, 0.2, 0.3]]))
+    onp.testing.assert_allclose(A(simplex).sum(-1), 1.0, rtol=1e-5)
+
+
+def test_validate_args():
+    with pytest.raises(ValueError):
+        mgp.Normal(0.0, -1.0, validate_args=True)
+    d = mgp.Bernoulli(prob=0.5, validate_args=True)
+    with pytest.raises(ValueError):
+        d.log_prob(np.array([0.5]))  # not in {0,1}
+
+
+# ---------------------------------------------------------------------------
+# StochasticBlock
+# ---------------------------------------------------------------------------
+
+def test_stochastic_block_vae_style():
+    from incubator_mxnet_tpu.gluon import nn
+
+    class Sampler(mgp.StochasticBlock):
+        def __init__(self):
+            super().__init__()
+            self.dense = nn.Dense(4)
+
+        @mgp.StochasticBlock.collectLoss
+        def forward(self, x):
+            h = self.dense(x)
+            loc, logs = h[:, :2], h[:, 2:]
+            scale = np.exp(logs)
+            qz = mgp.Normal(loc, scale)
+            pz = mgp.Normal(np.zeros_like(loc), np.ones_like(scale))
+            self.add_loss(mgp.kl_divergence(qz, pz))
+            return qz.sample()
+
+    net = Sampler()
+    net.initialize()
+    x = np.ones((3, 5))
+    out = net(x)
+    assert out.shape == (3, 2)
+    assert len(net.losses) == 1
+    assert net.losses[0].shape == (3, 2)
+
+    # losses participate in autograd
+    with autograd.record():
+        out = net(x)
+        loss = np.sum(out * 0) + np.sum(net.losses[0])
+    loss.backward()
+    g = net.dense.weight.grad()
+    assert float(np.sum(np.abs(g)).asnumpy() if hasattr(g, "asnumpy")
+                 else onp.abs(A(g)).sum()) > 0
+
+
+def test_stochastic_block_requires_decorator():
+    class Bad(mgp.StochasticBlock):
+        def forward(self, x):
+            return x
+
+    net = Bad()
+    net.initialize()
+    with pytest.raises(ValueError):
+        net(np.ones((2, 2)))

@@ -1,0 +1,184 @@
+"""Sparse NDArray tests (reference strategy:
+`tests/python/unittest/test_sparse_ndarray.py`, `test_sparse_operator.py`)."""
+import numpy as onp
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import autograd, np
+from incubator_mxnet_tpu.ndarray import sparse
+
+
+def A(x):
+    return onp.asarray(x.asnumpy() if hasattr(x, "asnumpy") else x)
+
+
+def test_row_sparse_creation_and_densify():
+    d = onp.array([[1, 2], [0, 0], [3, 4]], dtype="float32")
+    rs = sparse.row_sparse_array(d)
+    assert rs.stype == "row_sparse"
+    assert rs.shape == (3, 2)
+    onp.testing.assert_allclose(A(rs), d)
+    onp.testing.assert_allclose(A(rs.indices), [0, 2])
+    onp.testing.assert_allclose(A(rs.data), [[1, 2], [3, 4]])
+    rs2 = sparse.row_sparse_array(
+        (onp.array([[5.0, 6.0]], dtype="float32"), onp.array([1])),
+        shape=(3, 2))
+    onp.testing.assert_allclose(A(rs2), [[0, 0], [5, 6], [0, 0]])
+
+
+def test_csr_creation_and_densify():
+    d = onp.array([[1, 0, 2], [0, 0, 0], [0, 3, 0]], dtype="float32")
+    c = sparse.csr_matrix(d)
+    assert c.stype == "csr"
+    onp.testing.assert_allclose(A(c), d)
+    onp.testing.assert_allclose(A(c.data), [1, 2, 3])
+    onp.testing.assert_allclose(A(c.indices), [0, 2, 1])
+    onp.testing.assert_allclose(A(c.indptr), [0, 2, 2, 3])
+    dense = c.tostype("default")
+    onp.testing.assert_allclose(A(dense), d)
+
+
+def test_csr_stays_consistent_after_inplace_mutation():
+    # code-review finding: dense in-place mutation must not leave the CSR
+    # payload stale
+    c = sparse.csr_matrix(onp.array([[1, 0], [0, 2]], dtype="float32"))
+    c *= 2
+    onp.testing.assert_allclose(A(c), [[2, 0], [0, 4]])
+    onp.testing.assert_allclose(A(c.data), [2, 4])
+    out = sparse.dot(c, np.array(onp.eye(2, dtype="float32")))
+    onp.testing.assert_allclose(A(out), [[2, 0], [0, 4]])
+    c2 = c.copy()
+    onp.testing.assert_allclose(A(c2.data), [2, 4])
+
+
+def test_retain():
+    d = onp.array([[1, 1], [2, 2], [3, 3], [4, 4]], dtype="float32")
+    rs = sparse.row_sparse_array(d)
+    kept = sparse.retain(rs, np.array([0, 3]))
+    onp.testing.assert_allclose(A(kept.indices), [0, 3])
+    onp.testing.assert_allclose(A(kept), [[1, 1], [0, 0], [0, 0], [4, 4]])
+
+
+def test_sparse_dot_matches_dense():
+    rng = onp.random.RandomState(0)
+    d = rng.rand(5, 4).astype("float32") * (rng.rand(5, 4) > 0.5)
+    w = rng.rand(4, 3).astype("float32")
+    c = sparse.csr_matrix(d)
+    out = sparse.dot(c, np.array(w))
+    onp.testing.assert_allclose(A(out), d @ w, rtol=1e-5)
+    outT = sparse.dot(c, np.array(w.T), transpose_b=True)
+    onp.testing.assert_allclose(A(outT), d @ w, rtol=1e-5)
+
+
+def test_sparse_dot_autograd_flows_to_dense_rhs():
+    # code-review finding: gradients must reach the dense operand
+    d = onp.array([[1, 0], [0, 2], [3, 0]], dtype="float32")
+    c = sparse.csr_matrix(d)
+    w = np.array(onp.ones((2, 4), dtype="float32"))
+    w.attach_grad()
+    with autograd.record():
+        out = sparse.dot(c, w)
+        loss = np.sum(out)
+    loss.backward()
+    # dL/dw = csr^T @ ones(3,4)
+    onp.testing.assert_allclose(A(w.grad), d.T @ onp.ones((3, 4)), rtol=1e-5)
+
+
+def test_sparse_dot_dense_fallback_autograd():
+    d = onp.array([[1.0, 2.0], [3.0, 4.0]], dtype="float32")
+    a = np.array(d)
+    b = np.array(onp.eye(2, dtype="float32"))
+    a.attach_grad()
+    with autograd.record():
+        out = sparse.dot(a, b)
+        loss = np.sum(out * out)
+    loss.backward()
+    onp.testing.assert_allclose(A(a.grad), 2 * d, rtol=1e-5)
+
+
+def test_embedding_sparse_grad_row_sparse_cotangent():
+    # code-review finding: sparse_grad=True must produce a RowSparse grad
+    # storing only looked-up rows
+    from incubator_mxnet_tpu import npx
+
+    vocab, dim = 50, 4
+    w = np.array(onp.random.RandomState(1).rand(vocab, dim).astype("float32"))
+    w.attach_grad(stype="row_sparse")
+    idx = np.array(onp.array([1, 3, 3], dtype="float32"))
+    with autograd.record():
+        e = npx.embedding(idx, w, input_dim=vocab, output_dim=dim,
+                          sparse_grad=True)
+        loss = np.sum(e)
+    loss.backward()
+    g = w.grad
+    assert isinstance(g, sparse.RowSparseNDArray)
+    assert g.num_rows == 2  # only rows 1 and 3 stored
+    onp.testing.assert_allclose(A(g.indices), [1, 3])
+    want = onp.zeros((vocab, dim), dtype="float32")
+    want[1] += 1
+    want[3] += 2
+    onp.testing.assert_allclose(A(g), want)
+
+
+def test_embedding_sparse_grad_trainer_lazy_update():
+    # End-to-end: gluon Embedding(sparse_grad=True) + Trainer sgd — only
+    # touched rows move (reference lazy_update semantics)
+    from incubator_mxnet_tpu import gluon
+
+    vocab, dim = 30, 3
+    emb = gluon.nn.Embedding(vocab, dim, sparse_grad=True)
+    emb.initialize()
+    w0 = A(emb.weight.data()).copy()
+    trainer = gluon.Trainer(emb.collect_params(), "sgd",
+                            {"learning_rate": 0.5})
+    x = np.array(onp.array([2, 7], dtype="float32"))
+    with autograd.record():
+        out = emb(x)
+        loss = np.sum(out)
+    loss.backward()
+    assert isinstance(emb.weight.data()._grad, sparse.RowSparseNDArray)
+    trainer.step(1)
+    w1 = A(emb.weight.data())
+    moved = onp.where(onp.abs(w1 - w0).sum(axis=1) > 0)[0]
+    onp.testing.assert_array_equal(moved, [2, 7])
+    onp.testing.assert_allclose(w1[2], w0[2] - 0.5, rtol=1e-5)
+
+
+def test_embedding_sparse_grad_adam_lazy_update():
+    from incubator_mxnet_tpu import gluon
+
+    vocab, dim = 20, 2
+    emb = gluon.nn.Embedding(vocab, dim, sparse_grad=True)
+    emb.initialize()
+    w0 = A(emb.weight.data()).copy()
+    trainer = gluon.Trainer(emb.collect_params(), "adam",
+                            {"learning_rate": 0.1})
+    for _ in range(2):
+        x = np.array(onp.array([4], dtype="float32"))
+        with autograd.record():
+            loss = np.sum(emb(x))
+        loss.backward()
+        trainer.step(1)
+    w1 = A(emb.weight.data())
+    moved = onp.where(onp.abs(w1 - w0).sum(axis=1) > 0)[0]
+    onp.testing.assert_array_equal(moved, [4])
+
+
+def test_sparse_zeros_and_add():
+    z = sparse.zeros("row_sparse", (4, 2))
+    assert z.stype == "row_sparse"
+    assert A(z).sum() == 0
+    rs = sparse.row_sparse_array(
+        (onp.array([[1.0, 1.0]], dtype="float32"), onp.array([2])),
+        shape=(4, 2))
+    s = z + rs
+    assert isinstance(s, sparse.RowSparseNDArray)
+    onp.testing.assert_allclose(A(s), A(rs))
+
+
+def test_scipy_interop():
+    import scipy.sparse as sp
+
+    m = sp.random(6, 5, density=0.4, format="csr", dtype="float32",
+                  random_state=3)
+    c = sparse.csr_matrix(m)
+    onp.testing.assert_allclose(A(c), m.toarray(), rtol=1e-6)
