@@ -230,6 +230,8 @@ class _CachedGraph:
         probe = {}
 
         def fn(param_vals, key, *input_vals):
+            import jax.tree_util as jtu
+
             saved = [(a, a._data) for a in param_arrays]
             for a, v in zip(param_arrays, param_vals):
                 a._data = v
@@ -241,12 +243,14 @@ class _CachedGraph:
             finally:
                 for a, v in saved:
                     a._data = v
-            if isinstance(out, (list, tuple)):
-                out_vals = tuple(o._data for o in out)
-                probe["tree"] = ("tuple", len(out_vals))
-            else:
-                out_vals = (out._data,)
-                probe["tree"] = "single"
+            # outputs may be any pytree of NDArrays (tuple, nested list —
+            # e.g. StochasticBlock returns (out, [losses])); flatten and
+            # remember the structure for replay
+            flat, treedef = jtu.tree_flatten(
+                out, is_leaf=lambda x: isinstance(x, NDArray))
+            out_vals = tuple(o._data for o in flat)
+            probe["treedef"] = treedef
+            probe["n_out"] = len(out_vals)
             aux_pairs = list(tc.updates.values())
             probe["aux_arrays"] = [a for a, _ in aux_pairs]
             return out_vals + tuple(nv for _, nv in aux_pairs)
@@ -266,8 +270,8 @@ class _CachedGraph:
             mode["jitted"](tuple(param_vals), key, *input_vals)
             probe = mode["probe"]
             mode["aux_arrays"] = probe["aux_arrays"]
-            mode["out_tree"] = probe["tree"]
-            mode["n_out"] = (1 if probe["tree"] == "single" else probe["tree"][1])
+            mode["treedef"] = probe["treedef"]
+            mode["n_out"] = probe["n_out"]
             mode["ready"] = True
 
         jit = mode["jitted"]
@@ -292,9 +296,9 @@ class _CachedGraph:
 
         for a, nv in zip(aux_arrays, aux_new):
             register_aux_update(a, nv._data)
-        if mode["out_tree"] == "single":
-            return main[0]
-        return tuple(main)
+        import jax.tree_util as jtu
+
+        return jtu.tree_unflatten(mode["treedef"], main)
 
 
 class HybridBlock(Block):
@@ -322,6 +326,9 @@ class HybridBlock(Block):
         return self(x, *args)
 
     def __call__(self, *args, **kwargs):
+        if args and all(isinstance(a, NDArray) for a in args):
+            self._in_sig = [(tuple(a._data.shape), str(a._data.dtype))
+                            for a in args]
         if not self._active or kwargs:
             return super().__call__(*args, **kwargs)
         if any(not isinstance(a, NDArray) for a in args):
@@ -335,14 +342,91 @@ class HybridBlock(Block):
 
     def export(self, path, epoch=0, remove_amp_cast=True):  # noqa: ARG002
         """Serialize for deployment (reference: block.py:1480 writes
-        model-symbol.json + params; here: params + a config manifest)."""
+        model-symbol.json + binary params).
+
+        TPU-native: the inference forward is traced once and serialized as a
+        portable StableHLO artifact via `jax.export` (`<path>-symbol.stablehlo`),
+        with a JSON manifest (`<path>-symbol.json`) describing inputs/outputs
+        and parameter order, plus the parameters themselves
+        (`<path>-<epoch>.params`). `SymbolBlock.imports` reloads and runs the
+        artifact without the original Python class."""
         import json
 
-        self.save_parameters(f"{path}-{epoch:04d}.params")
-        manifest = {"class": type(self).__name__, "format": "tpu-native-v1"}
+        import jax
+        from jax import export as jexport
+
+        if getattr(self, "_in_sig", None) is None:
+            raise RuntimeError(
+                "HybridBlock.export: run at least one forward pass first so "
+                "input shapes/dtypes are known")
+        params = self.collect_params()
+        param_names = list(params)
+        param_vals = [params[n].data()._data for n in param_names]
+
+        cg = self._cached_graph
+        if cg is None:
+            cg = _CachedGraph(self)
+        mode = cg._mode(False)
+        jitted = mode["jitted"]
+        key = jax.random.PRNGKey(0)
+
+        def infer_fn(param_vals, *input_vals):
+            return jitted(tuple(param_vals), key, *input_vals)
+
+        import numpy as _np
+
+        import jax.tree_util as jtu
+
+        in_sds = [jax.ShapeDtypeStruct(s, _np.dtype(d))
+                  for (s, d) in self._in_sig]
+        param_sds = [jax.ShapeDtypeStruct(v.shape, v.dtype) for v in param_vals]
+        out_sds = jax.eval_shape(infer_fn, param_sds, *in_sds)
+        probe = mode["probe"]
+        n_out = probe["n_out"]
+        single = jtu.treedef_is_leaf(probe["treedef"])
+
+        # Export the leading (batch) dimension symbolically so the artifact
+        # runs at any batch size (reference SymbolBlock accepts arbitrary
+        # batches). Falls back to the concrete shapes if any op in the graph
+        # cannot be lowered with a symbolic dimension.
+        exported = None
+        dynamic_batch = False
+        batch0 = self._in_sig[0][0][0] if self._in_sig[0][0] else None
+        if batch0 is not None:
+            try:
+                (b,) = jexport.symbolic_shape("b")
+                sym_sds = [
+                    jax.ShapeDtypeStruct((b,) + s[1:], _np.dtype(d))
+                    if s and s[0] == batch0 else
+                    jax.ShapeDtypeStruct(s, _np.dtype(d))
+                    for (s, d) in self._in_sig
+                ]
+                exported = jexport.export(jax.jit(infer_fn))(param_sds, *sym_sds)
+                dynamic_batch = True
+            except Exception:
+                exported = None
+        if exported is None:
+            exported = jexport.export(jax.jit(infer_fn))(param_sds, *in_sds)
+        hlo_path = f"{path}-symbol.stablehlo"
+        with open(hlo_path, "wb") as f:
+            f.write(exported.serialize())
+
+        params_path = f"{path}-{epoch:04d}.params"
+        self.save_parameters(params_path)
+        manifest = {
+            "class": type(self).__name__,
+            "format": "tpu-native-stablehlo-v1",
+            "artifact": hlo_path.split("/")[-1],
+            "param_names": param_names,
+            "inputs": [[list(s), d] for (s, d) in self._in_sig],
+            "n_outputs": int(n_out),
+            "n_total_outputs": len(out_sds),
+            "out_tree": "single" if single else "tuple",
+            "dynamic_batch": dynamic_batch,
+        }
         with open(f"{path}-symbol.json", "w") as f:
-            json.dump(manifest, f)
-        return f"{path}-symbol.json", f"{path}-{epoch:04d}.params"
+            json.dump(manifest, f, indent=2)
+        return f"{path}-symbol.json", params_path
 
     def infer_shape(self, *args):
         """Subclasses with deferred params override this."""
@@ -354,11 +438,68 @@ class HybridBlock(Block):
 
 
 class SymbolBlock(HybridBlock):
-    """Reference parity stub: importing reference-format symbol files is not
-    supported (the symbolic JSON IR is replaced by XLA/StableHLO)."""
+    """Runs a serialized model without its original Python class
+    (reference: gluon/block.py:1713 SymbolBlock over symbol JSON).
+
+    TPU-native: wraps a deserialized `jax.export` StableHLO artifact produced
+    by `HybridBlock.export`. The compiled program is the "symbol"; parameters
+    are plain arrays fed positionally in manifest order."""
+
+    def __init__(self, exported, manifest, param_vals):
+        super().__init__()
+        self._exported = exported
+        self._manifest = manifest
+        self._param_vals = param_vals  # list of jax arrays, manifest order
+        from .parameter import Parameter
+
+        for name, v in zip(manifest["param_names"], param_vals):
+            p = Parameter(shape=v.shape, dtype=str(v.dtype), name=name,
+                          grad_req="null")  # inference-only: no grad buffers
+            p.set_data(NDArray(v))
+            self._reg_params[name] = p
+
+    def forward(self, *args):
+        vals = [a._data if isinstance(a, NDArray) else a for a in args]
+        pvals = [self._reg_params[n].data()._data
+                 for n in self._manifest["param_names"]]
+        outs = self._exported.call(pvals, *vals)
+        if not isinstance(outs, (list, tuple)):
+            outs = (outs,)
+        n_out = self._manifest["n_outputs"]
+        main = [NDArray(o) for o in outs[:n_out]]
+        if self._manifest["out_tree"] == "single":
+            return main[0]
+        return tuple(main)
 
     @staticmethod
-    def imports(symbol_file, input_names, param_file=None, device=None):
-        raise NotImplementedError(
-            "SymbolBlock.imports: legacy nnvm JSON graphs are not portable to "
-            "the TPU-native build; re-export the model with HybridBlock.export")
+    def imports(symbol_file, input_names=None, param_file=None, device=None):  # noqa: ARG004
+        """Load a model exported by `HybridBlock.export`
+        (reference: gluon/block.py:1795)."""
+        import json
+        import os
+
+        from jax import export as jexport
+
+        with open(symbol_file) as f:
+            manifest = json.load(f)
+        if manifest.get("format") != "tpu-native-stablehlo-v1":
+            raise ValueError(
+                f"SymbolBlock.imports: unsupported format "
+                f"{manifest.get('format')!r}; re-export with HybridBlock.export")
+        base = os.path.dirname(os.path.abspath(symbol_file))
+        with open(os.path.join(base, manifest["artifact"]), "rb") as f:
+            exported = jexport.deserialize(f.read())
+        param_vals = []
+        if param_file is None and manifest["param_names"]:
+            raise ValueError("SymbolBlock.imports: model has parameters; "
+                             "pass param_file")
+        if param_file is not None:
+            import jax.numpy as jnp
+
+            with onp.load(param_file, allow_pickle=False) as z:
+                loaded = {k: z[k] for k in z.keys()}
+            for name in manifest["param_names"]:
+                if name not in loaded:
+                    raise KeyError(f"parameter {name} missing in {param_file}")
+                param_vals.append(jnp.asarray(loaded[name]))
+        return SymbolBlock(exported, manifest, param_vals)

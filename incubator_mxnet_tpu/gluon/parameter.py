@@ -164,7 +164,9 @@ class Parameter:
             self._shape = tuple(value.shape)
             self._data = NDArray(value)
             if self.grad_req != "null":
-                self._data.attach_grad(self.grad_req)
+                self._data.attach_grad(self.grad_req,
+                                       stype=self._grad_stype
+                                       if self._grad_stype != "default" else None)
         else:
             d._set_data(value.astype(d._data.dtype)
                         if hasattr(value, "astype") else value)
@@ -181,7 +183,9 @@ class Parameter:
             had_grad = self._data._grad is not None
             self._data = NDArray(self._data._data.astype(self.dtype))
             if had_grad:
-                self._data.attach_grad(self.grad_req)
+                self._data.attach_grad(self.grad_req,
+                                       stype=self._grad_stype
+                                       if self._grad_stype != "default" else None)
 
     def var(self):
         raise NotImplementedError("symbol API not supported; use hybridize()")

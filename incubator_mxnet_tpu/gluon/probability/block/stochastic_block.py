@@ -54,10 +54,14 @@ class StochasticBlock(HybridBlock):
     def __call__(self, *args, **kwargs):
         self._flag = False
         out = super().__call__(*args, **kwargs)
-        if not self._flag:
+        # On a compiled replay (_CachedGraph cache hit) the Python forward —
+        # and hence the collectLoss decorator — does not run, so _flag stays
+        # False; the (output, losses) structure is still replayed faithfully
+        # by the cached graph's pytree.
+        if not self._flag and self._cached_graph is None:
             raise ValueError("The forward function should be decorated by "
                              "StochasticBlock.collectLoss")
-        self._losses = out[1]
+        self._losses = list(out[1])
         return out[0]
 
     @property

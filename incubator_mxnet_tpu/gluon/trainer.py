@@ -80,10 +80,20 @@ class Trainer:
     def _allreduce_grads(self):
         if self._kvstore is None:
             return
+        from ..ndarray.sparse import RowSparseNDArray
+
         for i, p in enumerate(self._params):
             if p.grad_req != "null" and p._data is not None \
                     and p._data._grad is not None:
-                self._kvstore.pushpull(i, p.data()._grad, out=p.data()._grad)
+                grad = p.data()._grad
+                if isinstance(grad, RowSparseNDArray):
+                    # Keep row-sparse grads sparse: the kvstore reduce would
+                    # densify them, defeating the lazy optimizer update
+                    # (reference keeps row_sparse through kvstore push/pull,
+                    # kvstore_local.h:232). Single-process reduction is a
+                    # no-op anyway; DataParallel reduces inside its own step.
+                    continue
+                self._kvstore.pushpull(i, grad, out=grad)
 
     def update(self, batch_size, ignore_stale_grad=False):
         if not self._kv_initialized:

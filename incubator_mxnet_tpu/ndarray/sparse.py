@@ -28,6 +28,20 @@ __all__ = ["RowSparseNDArray", "CSRNDArray", "row_sparse_array",
            "csr_matrix", "zeros", "array", "retain", "dot"]
 
 
+def _dense_to_csr_fields(dense):
+    """Dense 2-D numpy → (data, col_indices, indptr) in canonical
+    row-major CSR order. Shared by `CSRNDArray._sp_refresh` and
+    `csr_matrix`."""
+    rows, cols = onp.nonzero(dense)
+    order = onp.lexsort((cols, rows))
+    rows, cols = rows[order], cols[order]
+    data = dense[rows, cols]
+    indptr = onp.zeros(dense.shape[0] + 1, dtype=onp.int32)
+    onp.add.at(indptr, rows + 1, 1)
+    indptr = onp.cumsum(indptr).astype(onp.int32)
+    return data, cols.astype(onp.int32), indptr
+
+
 def _jnp():
     import jax.numpy as jnp
 
@@ -223,15 +237,11 @@ class CSRNDArray(NDArray):
         if not self._sp_stale:
             return
         d = onp.asarray(NDArray._data.__get__(self))
-        rows, cols = onp.nonzero(d)
-        order = onp.lexsort((cols, rows))
-        rows, cols = rows[order], cols[order]
+        data, cols, indptr = _dense_to_csr_fields(d)
         jnp = _jnp()
-        self._sp_data = jnp.asarray(d[rows, cols])
-        self._sp_col_indices = jnp.asarray(cols.astype(onp.int32))
-        indptr = onp.zeros(d.shape[0] + 1, dtype=onp.int32)
-        onp.add.at(indptr, rows + 1, 1)
-        self._sp_indptr = jnp.asarray(onp.cumsum(indptr).astype(onp.int32))
+        self._sp_data = jnp.asarray(data)
+        self._sp_col_indices = jnp.asarray(cols)
+        self._sp_indptr = jnp.asarray(indptr)
         self._sp_stale = False
 
     def _row_ids(self):
@@ -370,14 +380,8 @@ def csr_matrix(arg1, shape=None, dtype=None, ctx=None, device=None):  # noqa: AR
         dense = dense.astype(dtype)
     if dense.ndim != 2:
         raise ValueError("csr_matrix requires a 2-D source")
-    rows, cols = onp.nonzero(dense)
-    order = onp.lexsort((cols, rows))
-    rows, cols = rows[order], cols[order]
-    data = dense[rows, cols]
-    indptr = onp.zeros(dense.shape[0] + 1, dtype=onp.int32)
-    onp.add.at(indptr, rows + 1, 1)
-    indptr = onp.cumsum(indptr).astype(onp.int32)
-    return CSRNDArray(data, cols.astype(onp.int32), indptr, dense.shape)
+    data, cols, indptr = _dense_to_csr_fields(dense)
+    return CSRNDArray(data, cols, indptr, dense.shape)
 
 
 def zeros(stype, shape, ctx=None, device=None, dtype="float32"):  # noqa: ARG001

@@ -1,0 +1,77 @@
+"""HybridBlock.export → StableHLO artifact → SymbolBlock.imports.
+
+Reference parity: gluon/block.py:1480 `export` (model-symbol.json + params)
+and gluon/block.py:1713 `SymbolBlock`. Here the "symbol" is a portable
+serialized StableHLO program (jax.export), so a model can be reloaded and
+run without its original Python class.
+"""
+import os
+
+import numpy as onp
+import pytest
+
+from incubator_mxnet_tpu import np
+from incubator_mxnet_tpu.gluon import nn
+from incubator_mxnet_tpu.gluon.block import SymbolBlock
+
+
+def _make_net():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, activation="relu"), nn.BatchNorm(), nn.Dense(4))
+    net.initialize()
+    net.hybridize()
+    return net
+
+
+def test_export_roundtrip_numerics(tmp_path):
+    net = _make_net()
+    x = np.random.uniform(size=(2, 8))
+    y0 = net(x)
+    y0 = net(x)  # compiled path
+    sym, params = net.export(str(tmp_path / "model"))
+    assert os.path.exists(sym)
+    assert os.path.exists(params)
+    assert os.path.exists(str(tmp_path / "model-symbol.stablehlo"))
+
+    blk = SymbolBlock.imports(sym, ["data"], param_file=params)
+    y1 = blk(x)
+    onp.testing.assert_allclose(y0.asnumpy(), y1.asnumpy(),
+                                rtol=1e-5, atol=1e-5)
+
+
+def test_export_requires_forward(tmp_path):
+    net = _make_net()
+    with pytest.raises(RuntimeError, match="forward"):
+        net.export(str(tmp_path / "model"))
+
+
+def test_imports_requires_params(tmp_path):
+    net = _make_net()
+    x = np.random.uniform(size=(2, 8))
+    net(x)
+    sym, _ = net.export(str(tmp_path / "model"))
+    with pytest.raises(ValueError, match="param_file"):
+        SymbolBlock.imports(sym, ["data"])
+
+
+def test_imports_bad_format(tmp_path):
+    import json
+
+    p = tmp_path / "bogus-symbol.json"
+    p.write_text(json.dumps({"format": "nnvm-json-v1"}))
+    with pytest.raises(ValueError, match="unsupported format"):
+        SymbolBlock.imports(str(p), ["data"])
+
+
+def test_symbolblock_collect_params(tmp_path):
+    net = _make_net()
+    x = np.random.uniform(size=(3, 8))
+    net(x)
+    sym, params = net.export(str(tmp_path / "model"))
+    blk = SymbolBlock.imports(sym, ["data"], param_file=params)
+    got = blk.collect_params()
+    want = net.collect_params()
+    assert set(got) == set(want)
+    for k in want:
+        onp.testing.assert_allclose(got[k].data().asnumpy(),
+                                    want[k].data().asnumpy())
