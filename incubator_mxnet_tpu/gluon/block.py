@@ -349,8 +349,14 @@ class HybridBlock(Block):
         with a JSON manifest (`<path>-symbol.json`) describing inputs/outputs
         and parameter order, plus the parameters themselves
         (`<path>-<epoch>.params`). `SymbolBlock.imports` reloads and runs the
-        artifact without the original Python class."""
+        artifact without the original Python class.
+
+        Note: nested output pytrees are flattened — a reimported SymbolBlock
+        returns a flat tuple of output arrays (single array for one output),
+        matching the reference SymbolBlock's flat-output contract even when
+        the original block returned a nested structure."""
         import json
+        import os
 
         import jax
         from jax import export as jexport
@@ -416,7 +422,7 @@ class HybridBlock(Block):
         manifest = {
             "class": type(self).__name__,
             "format": "tpu-native-stablehlo-v1",
-            "artifact": hlo_path.split("/")[-1],
+            "artifact": os.path.basename(hlo_path),
             "param_names": param_names,
             "inputs": [[list(s), d] for (s, d) in self._in_sig],
             "n_outputs": int(n_out),
@@ -449,7 +455,6 @@ class SymbolBlock(HybridBlock):
         super().__init__()
         self._exported = exported
         self._manifest = manifest
-        self._param_vals = param_vals  # list of jax arrays, manifest order
         from .parameter import Parameter
 
         for name, v in zip(manifest["param_names"], param_vals):

@@ -53,12 +53,14 @@ class StochasticBlock(HybridBlock):
 
     def __call__(self, *args, **kwargs):
         self._flag = False
+        was_compiled = getattr(self, "_cached_graph", None) is not None
         out = super().__call__(*args, **kwargs)
         # On a compiled replay (_CachedGraph cache hit) the Python forward —
         # and hence the collectLoss decorator — does not run, so _flag stays
         # False; the (output, losses) structure is still replayed faithfully
-        # by the cached graph's pytree.
-        if not self._flag and self._cached_graph is None:
+        # by the cached graph's pytree. The decoration check applies whenever
+        # the Python forward actually ran (i.e. not a compiled replay).
+        if not self._flag and not was_compiled:
             raise ValueError("The forward function should be decorated by "
                              "StochasticBlock.collectLoss")
         self._losses = list(out[1])

@@ -82,16 +82,21 @@ class Trainer:
             return
         from ..ndarray.sparse import RowSparseNDArray
 
+        multi_process = getattr(self._kvstore, "num_workers", 1) > 1
         for i, p in enumerate(self._params):
             if p.grad_req != "null" and p._data is not None \
                     and p._data._grad is not None:
                 grad = p.data()._grad
-                if isinstance(grad, RowSparseNDArray):
-                    # Keep row-sparse grads sparse: the kvstore reduce would
-                    # densify them, defeating the lazy optimizer update
+                if isinstance(grad, RowSparseNDArray) and not multi_process:
+                    # Keep row-sparse grads sparse: the single-process kvstore
+                    # reduce is an identity but its out-write would densify
+                    # the stored rows, defeating the lazy optimizer update
                     # (reference keeps row_sparse through kvstore push/pull,
-                    # kvstore_local.h:232). Single-process reduction is a
-                    # no-op anyway; DataParallel reduces inside its own step.
+                    # kvstore_local.h:232). DataParallel reduces inside its
+                    # own compiled step. Under a dist kvstore the cross-
+                    # process allreduce is required for correctness, so the
+                    # grad does go through (densifying — documented
+                    # divergence from the reference's sparse ZPush).
                     continue
                 self._kvstore.pushpull(i, grad, out=grad)
 

@@ -219,7 +219,9 @@ class NDArray:
     def reshape(self, *shape, **kwargs):  # noqa: ARG002
         if len(shape) == 1 and isinstance(shape[0], (tuple, list)):
             shape = tuple(shape[0])
-        shape = tuple(int(s) for s in shape)
+        # keep symbolic dims (jax.export shape polymorphism) as-is
+        shape = tuple(int(s) if isinstance(s, (int, float, onp.integer))
+                      else s for s in shape)
         return apply_op("reshape", lambda x: x.reshape(shape), (self,))
 
     def reshape_like(self, other):

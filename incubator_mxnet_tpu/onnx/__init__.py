@@ -1,0 +1,154 @@
+"""ONNX export (reference: `python/mxnet/onnx/__init__.py`, mx2onnx).
+
+TPU-native: instead of per-symbol translation tables over nnvm graphs
+(reference `python/mxnet/onnx/mx2onnx/_op_translations/`), the hybridized
+forward is traced to a jaxpr and each primitive is translated to ONNX
+opset-13 nodes (`translate.py`); serialization is a self-contained protobuf
+wire encoder (`proto.py`) since the `onnx` pip package is unavailable.
+A numpy evaluator (`runtime.py`) executes exported models for verification.
+"""
+from __future__ import annotations
+
+import numpy as onp
+
+from . import proto, runtime, translate
+from .proto import decode, encode
+from .translate import UnsupportedOp
+
+__all__ = ["export_model", "get_model_metadata", "proto", "translate",
+           "runtime", "UnsupportedOp"]
+
+_IR_VERSION = 8  # pairs with opset 13
+
+
+def export_model(net, onnx_file, inputs=None, input_shapes=None,
+                 input_dtypes=None, dynamic_batch=False,
+                 model_name="incubator_mxnet_tpu"):
+    """Export a gluon (Hybrid)Block to an ONNX file
+    (reference: `python/mxnet/onnx/mx2onnx/_export_model.py:export_model`).
+
+    Either pass `inputs` (example NDArrays) or `input_shapes` (+ optional
+    `input_dtypes`, default float32). The net must be initialized; it is
+    traced in inference mode.
+    """
+    import jax
+
+    from ..gluon.block import _CachedGraph
+    from ..ndarray.ndarray import NDArray
+
+    if inputs is not None:
+        if not isinstance(inputs, (list, tuple)):
+            inputs = [inputs]
+        example = [a if isinstance(a, NDArray) else NDArray(a) for a in inputs]
+    else:
+        if input_shapes is None:
+            raise ValueError("export_model: pass inputs or input_shapes")
+        if not isinstance(input_shapes[0], (list, tuple)):
+            input_shapes = [input_shapes]
+        input_dtypes = input_dtypes or ["float32"] * len(input_shapes)
+        import jax.numpy as jnp
+
+        example = [NDArray(jnp.zeros(tuple(s), onp.dtype(d)))
+                   for s, d in zip(input_shapes, input_dtypes)]
+
+    net(*example)  # complete deferred init
+    cg = _CachedGraph(net)
+    mode = cg._mode(False)
+    key = jax.random.PRNGKey(0)
+    jitted = mode["jitted"]
+
+    param_names = list(net.collect_params())
+    param_vals = [a._data for a in cg.param_arrays]
+    in_vals = [a._data for a in example]
+
+    fn = lambda pv, *iv: jitted(tuple(pv), key, *iv)  # noqa: E731
+    onnx_param_names = [n.replace(".", "_") for n in param_names]
+    data_names = ([f"data{i}" for i in range(len(in_vals))]
+                  if len(in_vals) > 1 else ["data"])
+
+    def _translate(trace_inputs, batch_input):
+        closed = jax.make_jaxpr(fn)(param_vals, *trace_inputs)
+        builder = translate.GraphBuilder()
+        builder.batch_input = batch_input
+        for name, val in zip(onnx_param_names, param_vals):
+            builder.initializer(name, onp.asarray(val))
+        builder, out_names = translate.translate_jaxpr(
+            closed, onnx_param_names + data_names, builder=builder)
+        return closed, builder, out_names
+
+    if dynamic_batch:
+        # Trace with a symbolic batch dimension so batch-dependent reshape /
+        # broadcast targets are emitted as runtime Shape computations
+        # instead of baked constants. Falls back to a static export if some
+        # op cannot be expressed dynamically.
+        from jax import export as jexport
+
+        (bsym,) = jexport.symbolic_shape("b")
+        batch0 = in_vals[0].shape[0] if in_vals[0].ndim else None
+        sym_inputs = [
+            jax.ShapeDtypeStruct((bsym,) + v.shape[1:], v.dtype)
+            if v.ndim and v.shape[0] == batch0 else
+            jax.ShapeDtypeStruct(v.shape, v.dtype)
+            for v in in_vals
+        ]
+        try:
+            closed, builder, out_names = _translate(sym_inputs, data_names[0])
+        except translate.UnsupportedOp:
+            dynamic_batch = False
+            closed, builder, out_names = _translate(in_vals, None)
+    else:
+        closed, builder, out_names = _translate(in_vals, None)
+
+    n_out = mode["probe"]["n_out"]
+    out_names = out_names[:n_out]  # drop aux (BN stats) outputs
+
+    def vshape(shape):
+        return [d if isinstance(d, (int, onp.integer)) else "batch"
+                for d in shape]
+
+    in_avals = [v.aval for v in closed.jaxpr.invars[-len(in_vals):]]
+    graph_inputs = [
+        proto.value_info(n, v.dtype, vshape(v.shape))
+        for n, v in zip(data_names, in_avals)
+    ]
+    out_avals = closed.jaxpr.outvars[:n_out]
+    graph_outputs = [
+        proto.value_info(n, v.aval.dtype, vshape(v.aval.shape))
+        for n, v in zip(out_names, out_avals)
+    ]
+    model = {
+        "ir_version": _IR_VERSION,
+        "producer_name": model_name,
+        "producer_version": "0.1",
+        "opset_import": [{"domain": "", "version": translate.OPSET}],
+        "graph": {
+            "name": type(net).__name__,
+            "node": builder.nodes,
+            "initializer": builder.initializers,
+            "input": graph_inputs,
+            "output": graph_outputs,
+        },
+    }
+    with open(onnx_file, "wb") as f:
+        f.write(encode("ModelProto", model))
+    return onnx_file
+
+
+def get_model_metadata(model_file):
+    """Input/output signatures of an ONNX file
+    (reference: `python/mxnet/onnx/mx2onnx/_export_model.py:get_model_metadata`)."""
+    with open(model_file, "rb") as f:
+        model = decode("ModelProto", f.read())
+    graph = model["graph"]
+
+    def sig(infos):
+        out = []
+        for vi in infos:
+            tt = vi["type"]["tensor_type"]
+            dims = [d.get("dim_value", d.get("dim_param"))
+                    for d in tt.get("shape", {}).get("dim", [])]
+            out.append((vi["name"], tuple(dims)))
+        return out
+
+    return {"input_tensor_data": sig(graph.get("input", [])),
+            "output_tensor_data": sig(graph.get("output", []))}

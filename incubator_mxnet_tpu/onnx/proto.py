@@ -1,0 +1,291 @@
+"""Minimal ONNX protobuf serializer/deserializer (pure Python).
+
+The environment has no `onnx` package, so the exporter encodes ModelProto
+directly in protobuf wire format using the public ONNX schema's field
+numbers (onnx/onnx.proto, Apache-2.0 standard). `onnx_subset.proto` in this
+directory mirrors the subset we emit; tests validate emitted bytes against
+it with `protoc --decode`.
+
+Reference parity: the reference's exporter relies on the `onnx` pip package
+(`python/mxnet/onnx/mx2onnx/_export_model.py`); here serde is self-contained.
+
+Messages are plain dicts: `{"field_name": value}` with nested dicts for
+sub-messages and lists for repeated fields. Schema below maps field name →
+(field_number, kind, type).
+"""
+from __future__ import annotations
+
+import struct
+
+# -- ONNX enums ---------------------------------------------------------------
+
+# TensorProto.DataType
+FLOAT, UINT8, INT8, UINT16, INT16, INT32, INT64 = 1, 2, 3, 4, 5, 6, 7
+STRING, BOOL, FLOAT16, DOUBLE, UINT32, UINT64 = 8, 9, 10, 11, 12, 13
+BFLOAT16 = 16
+
+# AttributeProto.AttributeType
+ATTR_FLOAT, ATTR_INT, ATTR_STRING, ATTR_TENSOR = 1, 2, 3, 4
+ATTR_GRAPH, ATTR_FLOATS, ATTR_INTS, ATTR_STRINGS = 5, 6, 7, 8
+
+_NP_TO_ONNX = {
+    "float32": FLOAT, "uint8": UINT8, "int8": INT8, "uint16": UINT16,
+    "int16": INT16, "int32": INT32, "int64": INT64, "bool": BOOL,
+    "float16": FLOAT16, "float64": DOUBLE, "uint32": UINT32,
+    "uint64": UINT64, "bfloat16": BFLOAT16,
+}
+_ONNX_TO_NP = {v: k for k, v in _NP_TO_ONNX.items()}
+
+
+def onnx_dtype(np_dtype) -> int:
+    name = str(np_dtype)
+    if name not in _NP_TO_ONNX:
+        raise ValueError(f"dtype {name} has no ONNX mapping")
+    return _NP_TO_ONNX[name]
+
+
+def np_dtype_of(onnx_type: int) -> str:
+    return _ONNX_TO_NP[onnx_type]
+
+
+# -- schema ------------------------------------------------------------------
+# kind: "" scalar, "rep" repeated; type: varint|float|bytes|string|msg:Name
+
+SCHEMA = {
+    "ModelProto": {
+        "ir_version": (1, "", "varint"),
+        "producer_name": (2, "", "string"),
+        "producer_version": (3, "", "string"),
+        "domain": (4, "", "string"),
+        "model_version": (5, "", "varint"),
+        "doc_string": (6, "", "string"),
+        "graph": (7, "", "msg:GraphProto"),
+        "opset_import": (8, "rep", "msg:OperatorSetIdProto"),
+    },
+    "OperatorSetIdProto": {
+        "domain": (1, "", "string"),
+        "version": (2, "", "varint"),
+    },
+    "GraphProto": {
+        "node": (1, "rep", "msg:NodeProto"),
+        "name": (2, "", "string"),
+        "initializer": (5, "rep", "msg:TensorProto"),
+        "doc_string": (10, "", "string"),
+        "input": (11, "rep", "msg:ValueInfoProto"),
+        "output": (12, "rep", "msg:ValueInfoProto"),
+        "value_info": (13, "rep", "msg:ValueInfoProto"),
+    },
+    "NodeProto": {
+        "input": (1, "rep", "string"),
+        "output": (2, "rep", "string"),
+        "name": (3, "", "string"),
+        "op_type": (4, "", "string"),
+        "attribute": (5, "rep", "msg:AttributeProto"),
+        "doc_string": (6, "", "string"),
+        "domain": (7, "", "string"),
+    },
+    "AttributeProto": {
+        "name": (1, "", "string"),
+        "f": (2, "", "float"),
+        "i": (3, "", "varint"),
+        "s": (4, "", "bytes"),
+        "t": (5, "", "msg:TensorProto"),
+        "floats": (7, "rep", "float"),
+        "ints": (8, "rep", "varint"),
+        "strings": (9, "rep", "bytes"),
+        "type": (20, "", "varint"),
+    },
+    "TensorProto": {
+        "dims": (1, "rep", "varint"),
+        "data_type": (2, "", "varint"),
+        "float_data": (4, "rep", "float"),
+        "int32_data": (5, "rep", "varint"),
+        "string_data": (6, "rep", "bytes"),
+        "int64_data": (7, "rep", "varint"),
+        "name": (8, "", "string"),
+        "raw_data": (9, "", "bytes"),
+    },
+    "ValueInfoProto": {
+        "name": (1, "", "string"),
+        "type": (2, "", "msg:TypeProto"),
+        "doc_string": (3, "", "string"),
+    },
+    "TypeProto": {
+        "tensor_type": (1, "", "msg:TypeProtoTensor"),
+    },
+    "TypeProtoTensor": {
+        "elem_type": (1, "", "varint"),
+        "shape": (2, "", "msg:TensorShapeProto"),
+    },
+    "TensorShapeProto": {
+        "dim": (1, "rep", "msg:Dimension"),
+    },
+    "Dimension": {
+        "dim_value": (1, "", "varint"),
+        "dim_param": (2, "", "string"),
+    },
+}
+
+
+# -- wire encoding ------------------------------------------------------------
+
+def _varint(n: int) -> bytes:
+    n &= (1 << 64) - 1  # two's-complement for negative int64
+    out = bytearray()
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _tag(field: int, wire: int) -> bytes:
+    return _varint((field << 3) | wire)
+
+
+def _encode_value(field: int, typ: str, value) -> bytes:
+    if typ == "varint":
+        return _tag(field, 0) + _varint(int(value))
+    if typ == "float":
+        return _tag(field, 5) + struct.pack("<f", float(value))
+    if typ in ("bytes", "string"):
+        data = value.encode("utf-8") if isinstance(value, str) else bytes(value)
+        return _tag(field, 2) + _varint(len(data)) + data
+    if typ.startswith("msg:"):
+        payload = encode(typ[4:], value)
+        return _tag(field, 2) + _varint(len(payload)) + payload
+    raise ValueError(f"unknown field type {typ}")
+
+
+def encode(msg_name: str, d: dict) -> bytes:
+    schema = SCHEMA[msg_name]
+    out = bytearray()
+    for key, value in d.items():
+        field, kind, typ = schema[key]
+        if kind == "rep":
+            for v in value:
+                out += _encode_value(field, typ, v)
+        else:
+            out += _encode_value(field, typ, value)
+    return bytes(out)
+
+
+# -- wire decoding ------------------------------------------------------------
+
+def _read_varint(data: bytes, pos: int):
+    result = shift = 0
+    while True:
+        b = data[pos]
+        pos += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return result, pos
+        shift += 7
+
+
+def decode(msg_name: str, data: bytes) -> dict:
+    schema = SCHEMA[msg_name]
+    by_num = {f: (name, kind, typ) for name, (f, kind, typ) in schema.items()}
+    out: dict = {}
+    pos = 0
+    while pos < len(data):
+        key, pos = _read_varint(data, pos)
+        field, wire = key >> 3, key & 7
+        if wire == 0:
+            raw, pos = _read_varint(data, pos)
+        elif wire == 5:
+            raw = struct.unpack_from("<f", data, pos)[0]
+            pos += 4
+        elif wire == 1:
+            raw = struct.unpack_from("<d", data, pos)[0]
+            pos += 8
+        elif wire == 2:
+            ln, pos = _read_varint(data, pos)
+            raw = data[pos:pos + ln]
+            pos += ln
+        else:
+            raise ValueError(f"unsupported wire type {wire}")
+        if field not in by_num:
+            continue  # unknown field: skip (forward compat)
+        name, kind, typ = by_num[field]
+        if typ == "varint":
+            if wire == 2:  # packed repeated varints
+                vals, p = [], 0
+                while p < len(raw):
+                    v, p = _read_varint(raw, p)
+                    vals.append(_signed64(v))
+                if kind == "rep":
+                    out.setdefault(name, []).extend(vals)
+                    continue
+                raw = vals[-1]
+            else:
+                raw = _signed64(raw)
+        elif typ == "string" and isinstance(raw, (bytes, bytearray)):
+            raw = raw.decode("utf-8")
+        elif typ.startswith("msg:"):
+            raw = decode(typ[4:], raw)
+        elif typ == "float" and wire == 2:  # packed floats
+            vals = list(struct.unpack(f"<{len(raw) // 4}f", raw))
+            if kind == "rep":
+                out.setdefault(name, []).extend(vals)
+                continue
+            raw = vals[-1]
+        if kind == "rep":
+            out.setdefault(name, []).append(raw)
+        else:
+            out[name] = raw
+    return out
+
+
+def _signed64(v: int) -> int:
+    return v - (1 << 64) if v >= (1 << 63) else v
+
+
+# -- tensor helpers -----------------------------------------------------------
+
+def tensor_proto(name: str, array) -> dict:
+    """numpy array → TensorProto dict (raw_data little-endian)."""
+    import numpy as onp
+
+    arr = onp.asarray(array)
+    dt = onnx_dtype(arr.dtype)
+    if str(arr.dtype) == "bfloat16":
+        raw = arr.tobytes()
+    else:
+        raw = arr.astype(arr.dtype.newbyteorder("<")).tobytes()
+    return {"name": name, "dims": list(arr.shape), "data_type": dt,
+            "raw_data": raw}
+
+
+def tensor_value(t: dict):
+    """TensorProto dict → numpy array."""
+    import numpy as onp
+
+    dt = np_dtype_of(t["data_type"])
+    dims = t.get("dims", [])
+    if "raw_data" in t:
+        if dt == "bfloat16":
+            import ml_dtypes
+
+            arr = onp.frombuffer(t["raw_data"], dtype=ml_dtypes.bfloat16)
+        else:
+            arr = onp.frombuffer(t["raw_data"], dtype=onp.dtype(dt))
+        return arr.reshape(dims).copy()
+    if "float_data" in t:
+        return onp.array(t["float_data"], onp.float32).reshape(dims)
+    if "int64_data" in t:
+        return onp.array(t["int64_data"], onp.int64).reshape(dims)
+    if "int32_data" in t:
+        return onp.array(t["int32_data"], onp.int32).reshape(dims)
+    return onp.zeros(dims, onp.dtype(dt))
+
+
+def value_info(name: str, dtype, shape) -> dict:
+    dims = [{"dim_param": d} if isinstance(d, str) else {"dim_value": int(d)}
+            for d in shape]
+    return {"name": name,
+            "type": {"tensor_type": {"elem_type": onnx_dtype(dtype),
+                                     "shape": {"dim": dims}}}}

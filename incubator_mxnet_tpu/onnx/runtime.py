@@ -1,0 +1,272 @@
+"""Minimal numpy evaluator for exported ONNX models.
+
+Used to verify exported graphs numerically (no onnxruntime in this
+environment) and as an import-lite execution path. Implements exactly the
+opset-13 subset the translator emits, with ONNX-spec semantics implemented
+independently of the exporter so translation bugs don't self-cancel.
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as onp
+
+from .proto import decode, np_dtype_of, tensor_value
+
+_erf = onp.vectorize(math.erf, otypes=[onp.float64])
+
+
+def _attrs(node):
+    out = {}
+    for a in node.get("attribute", []):
+        t = a.get("type")
+        if t == 1:
+            out[a["name"]] = a["f"]
+        elif t == 2:
+            out[a["name"]] = a["i"]
+        elif t == 3:
+            out[a["name"]] = (a["s"].decode()
+                              if isinstance(a["s"], (bytes, bytearray))
+                              else a["s"])
+        elif t == 4:
+            out[a["name"]] = tensor_value(a["t"])
+        elif t == 6:
+            out[a["name"]] = list(a.get("floats", []))
+        elif t == 7:
+            out[a["name"]] = list(a.get("ints", []))
+        else:
+            out[a["name"]] = a
+    return out
+
+
+def _pool_patches(x, kernel, strides, pads):
+    """(N,C,spatial...) → windows array (N,C,out_spatial...,k1*k2*...) plus a
+    mask of valid (non-pad) positions; pads are [begins..., ends...]."""
+    nd = len(kernel)
+    pad_width = [(0, 0), (0, 0)] + [(pads[i], pads[nd + i]) for i in range(nd)]
+    xp = onp.pad(x, pad_width, constant_values=0)
+    from numpy.lib.stride_tricks import sliding_window_view
+
+    win = sliding_window_view(xp, kernel, axis=tuple(range(2, 2 + nd)))
+    slicer = (slice(None), slice(None)) + tuple(
+        slice(None, None, s) for s in strides)
+    win = win[slicer]
+    return win.reshape(win.shape[:2 + nd] + (-1,))
+
+
+def _gemm(a, b, attrs):
+    if attrs.get("transA"):
+        a = a.T
+    if attrs.get("transB"):
+        b = b.T
+    y = attrs.get("alpha", 1.0) * (a @ b)
+    return y
+
+
+_BINOP = {"Add": onp.add, "Sub": onp.subtract, "Mul": onp.multiply,
+          "Div": lambda a, b: (a / b if a.dtype.kind == "f"
+                               else a // b),
+          "Pow": onp.power,
+          "Equal": onp.equal, "Less": onp.less, "Greater": onp.greater,
+          "LessOrEqual": onp.less_equal, "GreaterOrEqual": onp.greater_equal,
+          "And": onp.logical_and, "Or": onp.logical_or,
+          "Xor": onp.logical_xor, "Mod": onp.fmod}
+
+_UNOP = {"Exp": onp.exp, "Log": onp.log, "Tanh": onp.tanh,
+         "Sqrt": onp.sqrt, "Neg": onp.negative, "Abs": onp.abs,
+         "Sign": onp.sign, "Floor": onp.floor, "Ceil": onp.ceil,
+         "Round": onp.round, "Reciprocal": onp.reciprocal,
+         "Not": onp.logical_not, "Identity": lambda x: x,
+         "Sin": onp.sin, "Cos": onp.cos, "Tan": onp.tan,
+         "Sigmoid": lambda x: 1.0 / (1.0 + onp.exp(-x)),
+         "Erf": lambda x: _erf(x).astype(x.dtype)}
+
+
+def run_model(model_bytes_or_file, inputs: dict) -> list:
+    """Execute an ONNX model on numpy inputs; returns outputs in graph
+    order."""
+    if isinstance(model_bytes_or_file, (bytes, bytearray)):
+        data = bytes(model_bytes_or_file)
+    else:
+        with open(model_bytes_or_file, "rb") as f:
+            data = f.read()
+    model = decode("ModelProto", data)
+    graph = model["graph"]
+    env: dict = {}
+    for t in graph.get("initializer", []):
+        env[t["name"]] = tensor_value(t)
+    for vi in graph.get("input", []):
+        name = vi["name"]
+        if name in inputs:
+            env[name] = onp.asarray(inputs[name])
+        elif name not in env:
+            raise KeyError(f"missing graph input {name}")
+
+    for node in graph.get("node", []):
+        op = node["op_type"]
+        ins = [env[n] for n in node.get("input", []) if n]
+        at = _attrs(node)
+        if op in _BINOP:
+            out = _BINOP[op](ins[0], ins[1])
+        elif op in _UNOP:
+            out = _UNOP[op](ins[0])
+        elif op in ("Max", "Min"):
+            fn = onp.maximum if op == "Max" else onp.minimum
+            out = ins[0]
+            for x in ins[1:]:
+                out = fn(out, x)
+        elif op == "MatMul":
+            out = onp.matmul(ins[0], ins[1])
+        elif op == "Gemm":
+            out = _gemm(ins[0], ins[1], at)
+            if len(ins) > 2:
+                out = out + at.get("beta", 1.0) * ins[2]
+        elif op == "Einsum":
+            out = onp.einsum(at["equation"], *ins)
+        elif op == "Reshape":
+            shape = [int(s) for s in ins[1]]
+            out = ins[0].reshape(shape)
+        elif op == "Transpose":
+            out = onp.transpose(ins[0], at.get("perm"))
+        elif op == "Expand":
+            target = [int(s) for s in ins[1]]
+            # ONNX Expand: mutual broadcast of input shape and target
+            shape = list(onp.broadcast_shapes(ins[0].shape, tuple(target)))
+            out = onp.broadcast_to(ins[0], shape)
+        elif op == "Squeeze":
+            axes = tuple(int(a) for a in ins[1]) if len(ins) > 1 else None
+            out = onp.squeeze(ins[0], axis=axes)
+        elif op == "Unsqueeze":
+            out = onp.expand_dims(ins[0], tuple(int(a) for a in ins[1]))
+        elif op == "Concat":
+            out = onp.concatenate(ins, axis=at["axis"])
+        elif op == "Shape":
+            out = onp.asarray(ins[0].shape, onp.int64)
+        elif op == "Cast":
+            out = ins[0].astype(onp.dtype(np_dtype_of(at["to"])))
+        elif op == "Where":
+            out = onp.where(ins[0], ins[1], ins[2])
+        elif op == "Gather":
+            out = onp.take(ins[0], ins[1].astype(onp.int64),
+                           axis=at.get("axis", 0))
+        elif op == "Slice":
+            starts = [int(v) for v in ins[1]]
+            ends = [int(v) for v in ins[2]]
+            axes = ([int(v) for v in ins[3]] if len(ins) > 3
+                    else list(range(len(starts))))
+            steps = [int(v) for v in ins[4]] if len(ins) > 4 else [1] * len(starts)
+            sl = [slice(None)] * ins[0].ndim
+            imin = -(1 << 62)
+            for s, e, a, st in zip(starts, ends, axes, steps):
+                sl[a] = slice(s, None if (st < 0 and e <= imin) else e, st)
+            out = ins[0][tuple(sl)]
+        elif op == "Pad":
+            pads = [int(v) for v in ins[1]]
+            cval = ins[2].item() if len(ins) > 2 else 0
+            nd = ins[0].ndim
+            pw = [(pads[i], pads[nd + i]) for i in range(nd)]
+            out = onp.pad(ins[0], pw, constant_values=cval)
+        elif op in ("ReduceSum", "ReduceMax", "ReduceMin", "ReduceProd",
+                    "ReduceMean"):
+            if op == "ReduceSum" and len(ins) > 1:
+                axes = tuple(int(a) for a in ins[1])
+            else:
+                axes = tuple(at.get("axes", range(ins[0].ndim)))
+            fn = {"ReduceSum": onp.sum, "ReduceMax": onp.max,
+                  "ReduceMin": onp.min, "ReduceProd": onp.prod,
+                  "ReduceMean": onp.mean}[op]
+            out = fn(ins[0], axis=axes, keepdims=bool(at.get("keepdims", 1)))
+        elif op in ("ArgMax", "ArgMin"):
+            fn = onp.argmax if op == "ArgMax" else onp.argmin
+            out = fn(ins[0], axis=at.get("axis", 0))
+            if at.get("keepdims", 1):
+                out = onp.expand_dims(out, at.get("axis", 0))
+        elif op == "Conv":
+            out = _conv(ins, at)
+        elif op == "MaxPool":
+            k = at["kernel_shape"]
+            win = _pool_patches(ins[0], tuple(k),
+                                tuple(at.get("strides", [1] * len(k))),
+                                at.get("pads", [0] * (2 * len(k))))
+            # pad positions contribute 0; for max over possibly-negative
+            # activations re-pad with -inf
+            pads = at.get("pads", [0] * (2 * len(k)))
+            if any(pads):
+                x = ins[0]
+                nd = len(k)
+                pw = ([(0, 0), (0, 0)]
+                      + [(pads[i], pads[nd + i]) for i in range(nd)])
+                xp = onp.pad(x, pw, constant_values=-onp.inf)
+                from numpy.lib.stride_tricks import sliding_window_view
+
+                win = sliding_window_view(xp, tuple(k),
+                                          axis=tuple(range(2, 2 + nd)))
+                slicer = (slice(None), slice(None)) + tuple(
+                    slice(None, None, s)
+                    for s in at.get("strides", [1] * nd))
+                win = win[slicer].reshape(
+                    win[slicer].shape[:2 + nd] + (-1,))
+            out = win.max(axis=-1)
+        elif op == "AveragePool":
+            k = at["kernel_shape"]
+            win = _pool_patches(ins[0], tuple(k),
+                                tuple(at.get("strides", [1] * len(k))),
+                                at.get("pads", [0] * (2 * len(k))))
+            if not at.get("count_include_pad", 0):
+                raise NotImplementedError(
+                    "AveragePool count_include_pad=0 not implemented")
+            out = win.mean(axis=-1)
+        else:
+            raise NotImplementedError(f"ONNX op {op} not implemented "
+                                      "in the numpy runtime")
+        outs = node["output"]
+        if isinstance(out, tuple):
+            for n, o in zip(outs, out):
+                env[n] = onp.asarray(o)
+        else:
+            env[outs[0]] = onp.asarray(out)
+
+    return [env[vi["name"]] for vi in graph.get("output", [])]
+
+
+def _conv(ins, at):
+    x, w = ins[0], ins[1]
+    bias = ins[2] if len(ins) > 2 else None
+    group = at.get("group", 1)
+    nd = x.ndim - 2
+    strides = at.get("strides", [1] * nd)
+    dil = at.get("dilations", [1] * nd)
+    pads = at.get("pads", [0] * (2 * nd))
+    pw = [(0, 0), (0, 0)] + [(pads[i], pads[nd + i]) for i in range(nd)]
+    xp = onp.pad(x, pw, constant_values=0)
+    from numpy.lib.stride_tricks import sliding_window_view
+
+    # dilate the kernel's effective footprint by slicing the window view
+    keff = [(w.shape[2 + i] - 1) * dil[i] + 1 for i in range(nd)]
+    win = sliding_window_view(xp, tuple(keff), axis=tuple(range(2, 2 + nd)))
+    slicer = (slice(None), slice(None)) + tuple(
+        slice(None, None, s) for s in strides)
+    win = win[slicer]
+    dslice = (Ellipsis,) + tuple(slice(None, None, d) for d in dil)
+    win = win[dslice]  # (N, C, out..., k...)
+    n, c = x.shape[0], x.shape[1]
+    out_spatial = win.shape[2:2 + nd]
+    cout = w.shape[0]
+    cin_g = w.shape[1]
+    win = win.reshape((n, group, c // group) + out_spatial
+                      + tuple(w.shape[2:]))
+    wg = w.reshape((group, cout // group, cin_g) + tuple(w.shape[2:]))
+    # contract over (cin_g, k...) — einsum with explicit axes
+    letters = "spq"  # n, group, cin
+    kaxes = "ijk"[:nd]
+    oaxes = "xyz"[:nd]
+    eq = (f"s p q {' '.join(o for o in oaxes)} {' '.join(kaxes)}".replace(" ", "")
+          + ","
+          + f"p o q {' '.join(kaxes)}".replace(" ", "")
+          + "->"
+          + f"s p o {' '.join(oaxes)}".replace(" ", ""))
+    out = onp.einsum(eq, win, wg)
+    out = out.reshape((n, cout) + out_spatial)
+    if bias is not None:
+        out = out + bias.reshape((1, -1) + (1,) * nd)
+    return out

@@ -1,0 +1,513 @@
+"""jaxpr → ONNX GraphProto translation.
+
+Reference parity: `python/mxnet/onnx/mx2onnx/_op_translations/` translates
+the reference's nnvm symbol graph node-by-node into ONNX. Here the traced
+StableHLO-level jaxpr is the graph IR: each jax primitive equation becomes
+one or a few ONNX nodes (opset 13). Call-like primitives (pjit,
+custom_jvp/vjp, remat) are inlined recursively.
+
+The translation is layout-exact for this framework's conv stack (NCHW /
+OIHW, matching ONNX natively) — no transposes are inserted.
+"""
+from __future__ import annotations
+
+import numpy as onp
+
+from . import proto
+
+OPSET = 13
+
+
+class UnsupportedOp(NotImplementedError):
+    pass
+
+
+class GraphBuilder:
+    def __init__(self):
+        self.nodes: list[dict] = []
+        self.initializers: list[dict] = []
+        self._n = 0
+        self._const_cache: dict = {}
+        # dynamic-batch support: name of a graph input whose dim 0 is the
+        # batch symbol, and the cached 1-D int64 tensor holding it
+        self.batch_input: str | None = None
+        self._batch_dim_name: str | None = None
+
+    def fresh(self, hint="t"):
+        self._n += 1
+        return f"{hint}_{self._n}"
+
+    def node(self, op_type, inputs, n_out=1, **attrs):
+        outs = [self.fresh(op_type.lower()) for _ in range(n_out)]
+        attributes = [_attr(k, v) for k, v in attrs.items() if v is not None]
+        self.nodes.append({"op_type": op_type, "input": list(inputs),
+                           "output": outs, "name": self.fresh(op_type),
+                           "attribute": attributes})
+        return outs[0] if n_out == 1 else outs
+
+    def initializer(self, name, array):
+        self.initializers.append(proto.tensor_proto(name, array))
+        return name
+
+    def const(self, array, hint="const"):
+        """Deduplicated constant initializer."""
+        arr = onp.asarray(array)
+        key = (arr.dtype.str, arr.shape, arr.tobytes())
+        if key in self._const_cache:
+            return self._const_cache[key]
+        name = self.initializer(self.fresh(hint), arr)
+        self._const_cache[key] = name
+        return name
+
+    def i64(self, values, hint="axes"):
+        vals = list(values)
+        if not all(isinstance(v, (int, onp.integer)) for v in vals):
+            raise UnsupportedOp(f"symbolic value in {hint}: {vals}")
+        return self.const(onp.asarray(vals, onp.int64), hint)
+
+    def batch_dim(self):
+        """1-D int64 tensor holding the runtime batch size (Shape→Slice of
+        the batch-carrying graph input); emitted once and cached."""
+        if self.batch_input is None:
+            raise UnsupportedOp("symbolic dimension outside dynamic_batch")
+        if self._batch_dim_name is None:
+            shp = self.node("Shape", [self.batch_input])
+            self._batch_dim_name = self.node(
+                "Slice", [shp, self.i64([0], "starts"), self.i64([1], "ends"),
+                          self.i64([0], "axes")])
+        return self._batch_dim_name
+
+    def shape_vector(self, dims, hint="shape"):
+        """1-D int64 shape tensor from dims that may contain the symbolic
+        batch dimension. Static dims become a constant; a symbolic dim is
+        replaced by the runtime batch size. Symbolic expressions other than
+        the plain batch symbol (e.g. b*49) are unsupported."""
+        if all(isinstance(d, (int, onp.integer)) for d in dims):
+            return self.i64(dims, hint)
+        parts = []
+        run: list[int] = []
+        for d in dims:
+            if isinstance(d, (int, onp.integer)):
+                run.append(int(d))
+            else:
+                if _dim_is_plain_symbol(d):
+                    if run:
+                        parts.append(self.i64(run, hint))
+                        run = []
+                    parts.append(self.batch_dim())
+                else:
+                    raise UnsupportedOp(
+                        f"symbolic shape expression {d} (only the plain "
+                        "batch symbol is supported)")
+        if run:
+            parts.append(self.i64(run, hint))
+        if len(parts) == 1:
+            return parts[0]
+        return self.node("Concat", parts, axis=0)
+
+
+def _dim_is_plain_symbol(d) -> bool:
+    """True when d is a bare symbolic dimension variable (not an
+    expression like b*49)."""
+    return str(d).isidentifier()
+
+
+def _attr(name, v):
+    if isinstance(v, bool):
+        return {"name": name, "i": int(v), "type": proto.ATTR_INT}
+    if isinstance(v, int):
+        return {"name": name, "i": v, "type": proto.ATTR_INT}
+    if isinstance(v, float):
+        return {"name": name, "f": v, "type": proto.ATTR_FLOAT}
+    if isinstance(v, str):
+        return {"name": name, "s": v.encode(), "type": proto.ATTR_STRING}
+    if isinstance(v, (list, tuple)):
+        if all(isinstance(x, (int, onp.integer)) for x in v):
+            return {"name": name, "ints": [int(x) for x in v],
+                    "type": proto.ATTR_INTS}
+        if all(isinstance(x, float) for x in v):
+            return {"name": name, "floats": list(v), "type": proto.ATTR_FLOATS}
+    raise ValueError(f"cannot encode attribute {name}={v!r}")
+
+
+# -- per-primitive handlers ---------------------------------------------------
+# handler(builder, eqn, in_names) -> list of output names
+
+_SIMPLE = {
+    "add": "Add", "sub": "Sub", "mul": "Mul", "div": "Div", "pow": "Pow",
+    "max": "Max", "min": "Min", "exp": "Exp", "log": "Log", "tanh": "Tanh",
+    "logistic": "Sigmoid", "sqrt": "Sqrt", "neg": "Neg", "abs": "Abs",
+    "sign": "Sign", "floor": "Floor", "ceil": "Ceil", "round": "Round",
+    "erf": "Erf", "eq": "Equal", "lt": "Less", "le": "LessOrEqual",
+    "gt": "Greater", "ge": "GreaterOrEqual", "and": "And", "or": "Or",
+    "xor": "Xor", "not": "Not", "sin": "Sin", "cos": "Cos", "tan": "Tan",
+    "copy": "Identity", "stop_gradient": "Identity",
+}
+
+
+def _simple(b, eqn, ins):
+    return [b.node(_SIMPLE[eqn.primitive.name], ins)]
+
+
+def _ne(b, eqn, ins):
+    return [b.node("Not", [b.node("Equal", ins)])]
+
+
+def _rsqrt(b, eqn, ins):
+    return [b.node("Reciprocal", [b.node("Sqrt", ins)])]
+
+
+def _square(b, eqn, ins):
+    return [b.node("Mul", [ins[0], ins[0]])]
+
+
+def _log1p(b, eqn, ins):
+    one = b.const(onp.asarray(1, eqn.invars[0].aval.dtype))
+    return [b.node("Log", [b.node("Add", [ins[0], one])])]
+
+
+def _expm1(b, eqn, ins):
+    one = b.const(onp.asarray(1, eqn.invars[0].aval.dtype))
+    return [b.node("Sub", [b.node("Exp", ins), one])]
+
+
+def _integer_pow(b, eqn, ins):
+    y = b.const(onp.asarray(eqn.params["y"], eqn.invars[0].aval.dtype))
+    return [b.node("Pow", [ins[0], y])]
+
+
+def _select_n(b, eqn, ins):
+    if len(ins) != 3:
+        raise UnsupportedOp("select_n with more than 2 cases")
+    # select_n(pred, a, b) yields a when pred==0; Where(c, X, Y): X where true
+    return [b.node("Where", [ins[0], ins[2], ins[1]])]
+
+
+def _convert(b, eqn, ins):
+    to = proto.onnx_dtype(onp.dtype(eqn.params["new_dtype"]))
+    return [b.node("Cast", ins, to=to)]
+
+
+def _reshape(b, eqn, ins):
+    if eqn.params.get("dimensions") is not None:
+        perm = list(eqn.params["dimensions"])
+        ins = [b.node("Transpose", ins, perm=perm)]
+    shape = b.shape_vector(eqn.params["new_sizes"], "shape")
+    return [b.node("Reshape", [ins[0], shape])]
+
+
+def _transpose(b, eqn, ins):
+    return [b.node("Transpose", ins, perm=list(eqn.params["permutation"]))]
+
+
+def _squeeze(b, eqn, ins):
+    axes = b.i64(eqn.params["dimensions"])
+    return [b.node("Squeeze", [ins[0], axes])]
+
+
+def _broadcast_in_dim(b, eqn, ins):
+    shape = tuple(eqn.params["shape"])
+    bdims = tuple(eqn.params["broadcast_dimensions"])
+    in_shape = eqn.invars[0].aval.shape
+    inter = [1] * len(shape)
+    for i, d in enumerate(bdims):
+        inter[d] = in_shape[i]
+    x = ins[0]
+    if tuple(inter) != tuple(in_shape):
+        x = b.node("Reshape", [x, b.shape_vector(inter, "shape")])
+    if tuple(inter) != shape:
+        x = b.node("Expand", [x, b.shape_vector(shape, "shape")])
+    elif x == ins[0]:
+        x = b.node("Identity", [x])
+    return [x]
+
+
+def _concatenate(b, eqn, ins):
+    return [b.node("Concat", ins, axis=int(eqn.params["dimension"]))]
+
+
+def _pad(b, eqn, ins):
+    cfg = eqn.params["padding_config"]
+    if any(i != 0 for _, _, i in cfg):
+        raise UnsupportedOp("pad with interior (dilation) padding")
+    x = ins[0]
+    los = [lo for lo, _, _ in cfg]
+    his = [hi for _, hi, _ in cfg]
+    if any(lo < 0 for lo in los) or any(hi < 0 for hi in his):
+        in_shape = eqn.invars[0].aval.shape
+        starts = [max(0, -lo) for lo in los]
+        ends = [s + min(0, hi) for s, hi in zip(in_shape, his)]
+        x = b.node("Slice", [x, b.i64(starts, "starts"), b.i64(ends, "ends"),
+                             b.i64(range(len(cfg)), "axes")])
+        los = [max(0, lo) for lo in los]
+        his = [max(0, hi) for hi in his]
+    if any(los) or any(his):
+        pads = b.i64(list(los) + list(his), "pads")
+        x = b.node("Pad", [x, pads, ins[1]], mode="constant")
+    elif x == ins[0]:
+        x = b.node("Identity", [x])
+    return [x]
+
+
+def _slice(b, eqn, ins):
+    starts = eqn.params["start_indices"]
+    ends = eqn.params["limit_indices"]
+    strides = eqn.params["strides"] or [1] * len(starts)
+    return [b.node("Slice", [ins[0], b.i64(starts, "starts"),
+                             b.i64(ends, "ends"),
+                             b.i64(range(len(starts)), "axes"),
+                             b.i64(strides, "steps")])]
+
+
+def _rev(b, eqn, ins):
+    dims = list(eqn.params["dimensions"])
+    imin = -(1 << 62)
+    return [b.node("Slice", [ins[0], b.i64([-1] * len(dims), "starts"),
+                             b.i64([imin] * len(dims), "ends"),
+                             b.i64(dims, "axes"),
+                             b.i64([-1] * len(dims), "steps")])]
+
+
+def _reduce(op_attr_axes):
+    def handler(b, eqn, ins):
+        axes = list(eqn.params["axes"])
+        if op_attr_axes == "ReduceSum":  # opset 13: axes is an input
+            return [b.node("ReduceSum", [ins[0], b.i64(axes)], keepdims=0)]
+        return [b.node(op_attr_axes, ins, axes=axes, keepdims=0)]
+
+    return handler
+
+
+def _argminmax(op):
+    def handler(b, eqn, ins):
+        axes = eqn.params["axes"]
+        out = b.node(op, ins, axis=int(axes[0]), keepdims=0)
+        to = proto.onnx_dtype(onp.dtype(eqn.outvars[0].aval.dtype))
+        return [b.node("Cast", [out], to=to)]
+
+    return handler
+
+
+def _dot_general(b, eqn, ins):
+    (lc, rc), (lb, rb) = eqn.params["dimension_numbers"]
+    lhs, rhs = eqn.invars[0].aval, eqn.invars[1].aval
+    ln, rn = len(lhs.shape), len(rhs.shape)
+    if not lb and not rb and ln == 2 and rn == 2 and len(lc) == 1:
+        trans_a = int(lc[0] == 0)
+        trans_b = int(rc[0] == 1)
+        return [b.node("Gemm", ins, transA=trans_a, transB=trans_b)]
+    if (tuple(lb) == tuple(range(len(lb))) and tuple(rb) == tuple(lb)
+            and lc == (ln - 1,) and rc == (rn - 2,)):
+        return [b.node("MatMul", ins)]
+    # general contraction → Einsum
+    letters = iter("abcdefghijklmnopqrstuvwxyz")
+    lhs_l = [None] * ln
+    rhs_l = [None] * rn
+    for i, j in zip(lb, rb):
+        c = next(letters)
+        lhs_l[i] = rhs_l[j] = c
+    for i, j in zip(lc, rc):
+        c = next(letters)
+        lhs_l[i] = rhs_l[j] = c
+    for i in range(ln):
+        if lhs_l[i] is None:
+            lhs_l[i] = next(letters)
+    for j in range(rn):
+        if rhs_l[j] is None:
+            rhs_l[j] = next(letters)
+    out_l = ([lhs_l[i] for i in lb]
+             + [lhs_l[i] for i in range(ln) if i not in lb and i not in lc]
+             + [rhs_l[j] for j in range(rn) if j not in rb and j not in rc])
+    eq = f"{''.join(lhs_l)},{''.join(rhs_l)}->{''.join(out_l)}"
+    return [b.node("Einsum", ins, equation=eq)]
+
+
+def _conv(b, eqn, ins):
+    p = eqn.params
+    dn = p["dimension_numbers"]
+    nd = len(eqn.invars[0].aval.shape)
+    iden = tuple(range(nd))
+    if (tuple(dn.lhs_spec) != iden or tuple(dn.rhs_spec) != iden
+            or tuple(dn.out_spec) != iden):
+        raise UnsupportedOp(f"conv layout {dn} (exporter expects NCHW/OIHW)")
+    if p["batch_group_count"] != 1:
+        raise UnsupportedOp("conv batch_group_count > 1")
+    if any(d != 1 for d in p["lhs_dilation"]):
+        raise UnsupportedOp("transposed convolution (lhs_dilation > 1)")
+    pads = ([lo for lo, _ in p["padding"]] + [hi for _, hi in p["padding"]])
+    return [b.node("Conv", ins,
+                   strides=list(p["window_strides"]),
+                   pads=pads,
+                   dilations=list(p["rhs_dilation"]),
+                   group=int(p["feature_group_count"]))]
+
+
+def _window_reduce(kind):
+    def handler(b, eqn, ins):
+        p = eqn.params
+        wd = tuple(p["window_dimensions"])
+        ws = tuple(p["window_strides"])
+        pad = tuple(p["padding"])
+        if any(d != 1 for d in p.get("base_dilation", (1,) * len(wd))):
+            raise UnsupportedOp("pooling base_dilation")
+        if any(d != 1 for d in p.get("window_dilation", (1,) * len(wd))):
+            raise UnsupportedOp("pooling window_dilation")
+        if wd[0] != 1 or wd[1] != 1:
+            raise UnsupportedOp("pooling window over batch/channel dims")
+        pads = [lo for lo, _ in pad[2:]] + [hi for _, hi in pad[2:]]
+        if kind == "max":
+            return [b.node("MaxPool", ins, kernel_shape=list(wd[2:]),
+                           strides=list(ws[2:]), pads=pads)]
+        # sum window = AveragePool * window_size (count_include_pad matches
+        # lax's zero-padded sum semantics)
+        avg = b.node("AveragePool", ins, kernel_shape=list(wd[2:]),
+                     strides=list(ws[2:]), pads=pads, count_include_pad=1)
+        n = float(onp.prod(wd[2:]))
+        scale = b.const(onp.asarray(n, eqn.invars[0].aval.dtype))
+        return [b.node("Mul", [avg, scale])]
+
+    return handler
+
+
+def _gather(b, eqn, ins):
+    p = eqn.params
+    dn = p["dimension_numbers"]
+    operand = eqn.invars[0].aval
+    indices = eqn.invars[1].aval
+    n = len(operand.shape)
+    idx_nd = len(indices.shape)
+    ok = (tuple(dn.collapsed_slice_dims) == (0,)
+          and tuple(dn.start_index_map) == (0,)
+          and not getattr(dn, "operand_batching_dims", ())
+          and tuple(p["slice_sizes"]) == (1,) + tuple(operand.shape[1:])
+          and indices.shape[-1] == 1
+          and tuple(dn.offset_dims) == tuple(range(idx_nd - 1,
+                                                   idx_nd - 1 + n - 1)))
+    if not ok:
+        raise UnsupportedOp(f"general gather {dn} (only axis-0 take exported)")
+    idx = b.node("Squeeze", [ins[1], b.i64([idx_nd - 1])])
+    return [b.node("Gather", [ins[0], idx], axis=0)]
+
+
+def _iota(b, eqn, ins):  # noqa: ARG001
+    p = eqn.params
+    if not all(isinstance(d, (int, onp.integer)) for d in p["shape"]):
+        raise UnsupportedOp("iota with a symbolic dimension")
+    arr = onp.reshape(
+        onp.broadcast_to(
+            onp.expand_dims(
+                onp.arange(p["shape"][p["dimension"]],
+                           dtype=onp.dtype(p["dtype"])),
+                [a for a in range(len(p["shape"])) if a != p["dimension"]]),
+            p["shape"]),
+        p["shape"])
+    return [b.const(arr, "iota")]
+
+
+_HANDLERS = {name: _simple for name in _SIMPLE}
+_HANDLERS.update({
+    "ne": _ne,
+    "rsqrt": _rsqrt,
+    "square": _square,
+    "log1p": _log1p,
+    "expm1": _expm1,
+    "integer_pow": _integer_pow,
+    "select_n": _select_n,
+    "convert_element_type": _convert,
+    "reshape": _reshape,
+    "transpose": _transpose,
+    "squeeze": _squeeze,
+    "broadcast_in_dim": _broadcast_in_dim,
+    "concatenate": _concatenate,
+    "pad": _pad,
+    "slice": _slice,
+    "rev": _rev,
+    "reduce_sum": _reduce("ReduceSum"),
+    "reduce_max": _reduce("ReduceMax"),
+    "reduce_min": _reduce("ReduceMin"),
+    "reduce_prod": _reduce("ReduceProd"),
+    "argmax": _argminmax("ArgMax"),
+    "argmin": _argminmax("ArgMin"),
+    "dot_general": _dot_general,
+    "conv_general_dilated": _conv,
+    "reduce_window_max": _window_reduce("max"),
+    "reduce_window_sum": _window_reduce("sum"),
+    "gather": _gather,
+    "iota": _iota,
+})
+
+_CALL_PRIMS = {"jit", "pjit", "closed_call", "core_call", "remat",
+               "checkpoint", "custom_jvp_call", "custom_vjp_call",
+               "custom_jvp_call_jaxpr", "custom_vjp_call_jaxpr"}
+_NOOP_PRIMS = {"sharding_constraint", "device_put", "copy_p"}
+
+
+def _sub_jaxpr(eqn):
+    for key in ("jaxpr", "call_jaxpr", "fun_jaxpr"):
+        if key in eqn.params and eqn.params[key] is not None:
+            return eqn.params[key]
+    raise UnsupportedOp(f"call primitive {eqn.primitive.name} without jaxpr")
+
+
+def translate_jaxpr(closed_jaxpr, input_names, builder=None):
+    """ClosedJaxpr → (GraphBuilder, output names).
+
+    `input_names`: names for jaxpr.invars, in order. Entries may be
+    (name, array) tuples for parameters — those become initializers.
+    """
+    from jax.extend.core import Literal
+
+    b = builder or GraphBuilder()
+    env: dict = {}
+
+    def read(v):
+        if isinstance(v, Literal):
+            return b.const(onp.asarray(v.val), "lit")
+        return env[v]
+
+    jaxpr = closed_jaxpr.jaxpr
+    consts = closed_jaxpr.consts
+    for var, const in zip(jaxpr.constvars, consts):
+        env[var] = b.const(onp.asarray(const), "c")
+    assert len(jaxpr.invars) == len(input_names), \
+        f"{len(jaxpr.invars)} invars vs {len(input_names)} names"
+    for var, name in zip(jaxpr.invars, input_names):
+        env[var] = name
+
+    def run(jx, const_env):
+        for eqn in jx.eqns:
+            name = eqn.primitive.name
+            ins = [read(v) for v in eqn.invars]
+            if name in _CALL_PRIMS:
+                sub = _sub_jaxpr(eqn)
+                if hasattr(sub, "jaxpr"):  # ClosedJaxpr
+                    inner, inner_consts = sub.jaxpr, sub.consts
+                else:
+                    inner, inner_consts = sub, ()
+                for var, const in zip(inner.constvars, inner_consts):
+                    env[var] = b.const(onp.asarray(const), "c")
+                n_skip = len(eqn.invars) - len(inner.invars)
+                if n_skip < 0:
+                    raise UnsupportedOp(f"{name}: arity mismatch")
+                for var, nm in zip(inner.invars, ins[n_skip:]):
+                    env[var] = nm
+                run(inner, const_env)
+                for outer_v, inner_v in zip(eqn.outvars, inner.outvars):
+                    env[outer_v] = read(inner_v)
+                continue
+            if name in _NOOP_PRIMS:
+                for ov, nm in zip(eqn.outvars, ins):
+                    env[ov] = nm
+                continue
+            handler = _HANDLERS.get(name)
+            if handler is None:
+                raise UnsupportedOp(
+                    f"jax primitive {name!r} has no ONNX translation")
+            outs = handler(b, eqn, ins)
+            for ov, nm in zip(eqn.outvars, outs):
+                env[ov] = nm
+        return None
+
+    run(jaxpr, {})
+    out_names = [read(v) for v in jaxpr.outvars]
+    return b, out_names

@@ -1,0 +1,149 @@
+"""ONNX export tests (reference: tests under
+`tests/python-pytest/onnx/test_onnxruntime*.py` validate mx2onnx exports by
+running them in onnxruntime; here the exported protobuf is executed by the
+package's own numpy ONNX runtime and validated structurally with protoc)."""
+import os
+import shutil
+import subprocess
+
+import numpy as onp
+import pytest
+
+import incubator_mxnet_tpu.onnx as mxonnx
+from incubator_mxnet_tpu import np
+from incubator_mxnet_tpu import gluon
+
+
+def _roundtrip(net, x, tol=1e-4, **kw):
+    y = net(x)
+    import tempfile
+
+    d = tempfile.mkdtemp()
+    f = os.path.join(d, "m.onnx")
+    mxonnx.export_model(net, f, inputs=[x], **kw)
+    outs = mxonnx.runtime.run_model(f, {"data": x.asnumpy()})
+    onp.testing.assert_allclose(y.asnumpy(), outs[0], rtol=tol, atol=tol)
+    return f
+
+
+def test_mlp_batchnorm_export():
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(16, activation="relu"), gluon.nn.BatchNorm(),
+            gluon.nn.Dense(4))
+    net.initialize()
+    _roundtrip(net, np.random.uniform(size=(3, 8)))
+
+
+def test_convnet_export():
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Conv2D(8, 3, padding=1, activation="relu"),
+            gluon.nn.MaxPool2D(2),
+            gluon.nn.Conv2D(4, 3, padding=1, strides=2),
+            gluon.nn.GlobalAvgPool2D(),
+            gluon.nn.Flatten(),
+            gluon.nn.Dense(3))
+    net.initialize()
+    _roundtrip(net, np.random.uniform(size=(2, 3, 16, 16)))
+
+
+def test_dynamic_batch_export_runs_other_batch_sizes():
+    # Flatten bakes the batch into a reshape unless exported symbolically —
+    # exactly the case dynamic_batch must handle.
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Conv2D(8, 3, padding=1, activation="relu"),
+            gluon.nn.MaxPool2D(2), gluon.nn.Flatten(), gluon.nn.Dense(3))
+    net.initialize()
+    x2 = np.random.uniform(size=(2, 3, 8, 8))
+    import tempfile
+
+    f = os.path.join(tempfile.mkdtemp(), "m.onnx")
+    mxonnx.export_model(net, f, inputs=[x2], dynamic_batch=True)
+    x5 = np.random.uniform(size=(5, 3, 8, 8))
+    outs = mxonnx.runtime.run_model(f, {"data": x5.asnumpy()})
+    onp.testing.assert_allclose(net(x5).asnumpy(), outs[0],
+                                rtol=1e-4, atol=1e-5)
+
+
+def test_resnet18_export_and_protoc_validation():
+    from incubator_mxnet_tpu.gluon.model_zoo.vision import resnet18_v1
+
+    net = resnet18_v1()
+    net.initialize()
+    x = np.random.uniform(size=(1, 3, 32, 32))
+    f = _roundtrip(net, x, tol=1e-3, dynamic_batch=True)
+
+    meta = mxonnx.get_model_metadata(f)
+    assert meta["input_tensor_data"][0][0] == "data"
+    assert meta["input_tensor_data"][0][1][0] == "batch"  # dynamic batch dim
+
+    # exported at batch 1, must run at batch 2
+    x2 = np.random.uniform(size=(2, 3, 32, 32))
+    outs = mxonnx.runtime.run_model(f, {"data": x2.asnumpy()})
+    onp.testing.assert_allclose(net(x2).asnumpy(), outs[0],
+                                rtol=1e-3, atol=1e-4)
+
+    if shutil.which("protoc") is None:
+        pytest.skip("protoc not available")
+    proto_dir = os.path.dirname(mxonnx.proto.__file__)
+    with open(f, "rb") as fh:
+        r = subprocess.run(
+            ["protoc", f"--proto_path={proto_dir}",
+             "--decode=onnx.ModelProto", "onnx_subset.proto"],
+            stdin=fh, capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr
+    assert 'op_type: "Conv"' in r.stdout
+    assert 'op_type: "MaxPool"' in r.stdout
+    assert 'op_type: "Gemm"' in r.stdout
+
+
+def test_activations_export():
+    for act in ["sigmoid", "tanh", "softrelu", "relu"]:
+        net = gluon.nn.HybridSequential()
+        net.add(gluon.nn.Dense(6), gluon.nn.Activation(act), gluon.nn.Dense(2))
+        net.initialize()
+        _roundtrip(net, np.random.uniform(low=-1, size=(2, 4)))
+
+
+def test_embedding_softmax_export():
+    from incubator_mxnet_tpu.gluon.block import HybridBlock
+
+    class Net2(HybridBlock):
+        def __init__(self):
+            super().__init__()
+            self.emb = gluon.nn.Embedding(20, 8)
+            self.dense = gluon.nn.Dense(5)
+
+        def forward(self, x):
+            from incubator_mxnet_tpu import npx
+
+            h = self.emb(x)
+            return npx.softmax(self.dense(h.reshape((h.shape[0], -1))))
+
+    net = Net2()
+    net.initialize()
+    x = np.random.randint(0, 20, (3, 4))
+    y = net(x)
+    import tempfile
+
+    f = os.path.join(tempfile.mkdtemp(), "m.onnx")
+    mxonnx.export_model(net, f, inputs=[x])
+    outs = mxonnx.runtime.run_model(f, {"data": x.asnumpy()})
+    onp.testing.assert_allclose(y.asnumpy(), outs[0], rtol=1e-4, atol=1e-5)
+
+
+def test_unsupported_op_raises():
+    from incubator_mxnet_tpu.gluon.block import HybridBlock
+
+    class Weird(HybridBlock):
+        def forward(self, x):
+            from incubator_mxnet_tpu import np as mnp
+
+            return mnp.sort(x, axis=-1)
+
+    net = Weird()
+    x = np.random.uniform(size=(2, 5))
+    import tempfile
+
+    f = os.path.join(tempfile.mkdtemp(), "m.onnx")
+    with pytest.raises((mxonnx.UnsupportedOp, NotImplementedError)):
+        mxonnx.export_model(net, f, inputs=[x])
