@@ -1,0 +1,4 @@
+"""gluon.contrib (reference: `python/mxnet/gluon/contrib/__init__.py`)."""
+from . import estimator
+
+__all__ = ["estimator"]

@@ -1,0 +1,13 @@
+"""Gluon Estimator — high-level train/eval loop
+(reference: `python/mxnet/gluon/contrib/estimator/__init__.py`)."""
+from .estimator import Estimator
+from .event_handler import (BatchBegin, BatchEnd, CheckpointHandler,
+                            EarlyStoppingHandler, EpochBegin, EpochEnd,
+                            EventHandler, LoggingHandler, MetricHandler,
+                            StoppingHandler, TrainBegin, TrainEnd,
+                            ValidationHandler)
+
+__all__ = ["Estimator", "EventHandler", "TrainBegin", "TrainEnd",
+           "EpochBegin", "EpochEnd", "BatchBegin", "BatchEnd",
+           "StoppingHandler", "MetricHandler", "ValidationHandler",
+           "LoggingHandler", "CheckpointHandler", "EarlyStoppingHandler"]

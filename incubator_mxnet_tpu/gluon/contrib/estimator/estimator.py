@@ -1,0 +1,255 @@
+"""Gluon Estimator — batteries-included fit/evaluate loop
+(reference: `python/mxnet/gluon/contrib/estimator/estimator.py:42-517`).
+
+TPU-native: one logical device (XLA shards under the hood via
+DataParallel/pjit when the user passes a sharded train step); the train
+loop is the framework's standard autograd.record → backward →
+Trainer.step path, so everything the funnel provides (profiler hooks, AMP,
+sparse grads) applies here too.
+"""
+from __future__ import annotations
+
+import logging
+
+from ... import loss as gluon_loss
+from ... import metric as metric_mod
+from ...trainer import Trainer
+from .event_handler import (BatchBegin, BatchEnd, EpochBegin, EpochEnd,
+                            LoggingHandler, MetricHandler, StoppingHandler,
+                            TrainBegin, TrainEnd, ValidationHandler,
+                            _check_event_handlers)
+
+__all__ = ["Estimator"]
+
+
+class Estimator:
+    """Train and evaluate a gluon net with event handlers
+    (reference: estimator.py:42)."""
+
+    def __init__(self, net, loss, train_metrics=None, val_metrics=None,
+                 initializer=None, trainer=None, context=None, device=None,
+                 evaluation_loss=None, val_loss=None, val_net=None,
+                 batch_processor=None):  # noqa: ARG002
+        self.net = net
+        self.loss = self._check_loss(loss)
+        self._train_metrics = _as_list(train_metrics)
+        self._val_metrics = _as_list(val_metrics)
+        self.evaluation_loss = self._check_loss(
+            evaluation_loss or val_loss or loss)
+        self.val_net = val_net or net
+        self.logger = logging.getLogger("incubator_mxnet_tpu.estimator")
+        if not self.logger.handlers:
+            self.logger.addHandler(logging.StreamHandler())
+            self.logger.setLevel(logging.INFO)
+        self.device = device or context
+        self._initialize(initializer)
+        self.trainer = self._check_trainer(trainer)
+        self.stop_training = False
+        self.max_epoch = None
+        self.max_batch = None
+        self._add_default_training_metrics()
+        self._add_validation_metrics()
+
+    # -- setup ---------------------------------------------------------------
+    def _check_loss(self, loss):
+        if loss is None:
+            return None
+        if not isinstance(loss, gluon_loss.Loss):
+            raise ValueError("loss must be a gluon.loss.Loss instance")
+        return loss
+
+    def _initialize(self, initializer):
+        params = self.net.collect_params()
+        uninitialized = any(p._data is None and p._deferred_init is None
+                            for p in params.values())
+        if uninitialized:
+            self.net.initialize(init=initializer, device=self.device)
+        elif initializer is not None:
+            self.logger.warning(
+                "Network already initialized; ignoring initializer")
+
+    def _check_trainer(self, trainer):
+        if trainer is None:
+            self.logger.warning(
+                "No trainer specified; using sgd with learning_rate=0.001")
+            trainer = Trainer(self.net.collect_params(), "sgd",
+                              {"learning_rate": 1e-3})
+        elif not isinstance(trainer, Trainer):
+            raise ValueError("trainer must be a gluon.Trainer instance")
+        return trainer
+
+    def _add_default_training_metrics(self):
+        import copy
+
+        if not self._train_metrics:
+            self._train_metrics = [metric_mod.Accuracy()]
+        # deep-copy so caller-owned metric objects are not renamed in place
+        # (and reuse across Estimators doesn't double-prefix)
+        self._train_metrics = [copy.deepcopy(m) for m in self._train_metrics]
+        for m in self._train_metrics:
+            m.name = "training " + m.name
+        self._train_metrics.append(
+            metric_mod.Loss("training " + type(self.loss).__name__.lower()))
+
+    def _add_validation_metrics(self):
+        import copy
+
+        if not self._val_metrics:
+            self._val_metrics = [type(m)() for m in self._train_metrics[:-1]]
+        else:
+            self._val_metrics = [copy.deepcopy(m) for m in self._val_metrics]
+        for m in self._val_metrics:
+            m.name = "validation " + m.name
+        self._val_metrics.append(metric_mod.Loss(
+            "validation " + type(self.evaluation_loss).__name__.lower()))
+
+    @property
+    def train_metrics(self):
+        return self._train_metrics
+
+    @property
+    def val_metrics(self):
+        return self._val_metrics
+
+    # -- data ----------------------------------------------------------------
+    @staticmethod
+    def _get_data_and_label(batch, batch_axis=0):  # noqa: ARG004
+        return batch[0], batch[1]
+
+    # -- evaluate ------------------------------------------------------------
+    def evaluate_batch(self, val_batch, val_metrics, batch_axis=0):
+        data, label = self._get_data_and_label(val_batch, batch_axis)
+        pred = self.val_net(data)
+        loss = self.evaluation_loss(pred, label)
+        from ...metric import Loss as LossMetric
+
+        for m in val_metrics:
+            if isinstance(m, LossMetric):
+                m.update(0, loss)
+            else:
+                m.update(label, pred)
+
+    def evaluate(self, val_data, val_metrics=None, batch_axis=0,
+                 event_handlers=None):
+        """Run one pass over val_data updating val_metrics; fires
+        epoch/batch hooks on any handlers passed
+        (reference: estimator.py:279)."""
+        val_metrics = val_metrics or self._val_metrics
+        for m in val_metrics:
+            m.reset()
+        event_handlers = _check_event_handlers(event_handlers)
+        _, epoch_begin, batch_begin, batch_end, epoch_end, _ = \
+            self._categorize_handlers(event_handlers)
+        for handler in epoch_begin:
+            handler.epoch_begin(self)
+        for batch in val_data:
+            for handler in batch_begin:
+                handler.batch_begin(self, batch=batch)
+            self.evaluate_batch(batch, val_metrics, batch_axis)
+            for handler in batch_end:
+                handler.batch_end(self, batch=batch)
+        for handler in epoch_end:
+            handler.epoch_end(self)
+        return {name: value
+                for name, value in (m.get() for m in val_metrics)}
+
+    # -- fit -----------------------------------------------------------------
+    def fit_batch(self, train_batch, batch_axis=0):
+        from .... import autograd
+
+        data, label = self._get_data_and_label(train_batch, batch_axis)
+        with autograd.record():
+            pred = self.net(data)
+            loss = self.loss(pred, label)
+        loss.backward()
+        return data, label, pred, loss
+
+    def fit(self, train_data, val_data=None, epochs=None, event_handlers=None,
+            batches=None, batch_axis=0):
+        """Train the net (reference: estimator.py:333). Pass `epochs` or
+        `batches` (mutually exclusive semantics: whichever hits first)."""
+        if not epochs and not batches:
+            raise ValueError("pass `epochs` and/or `batches`")
+        self.max_epoch = epochs
+        self.max_batch = batches
+        self.stop_training = False
+
+        event_handlers = self._prepare_default_handlers(
+            val_data, event_handlers)
+        train_begin, epoch_begin, batch_begin, batch_end, epoch_end, \
+            train_end = self._categorize_handlers(event_handlers)
+
+        for handler in train_begin:
+            handler.train_begin(self)
+
+        while not self.stop_training:
+            for handler in epoch_begin:
+                handler.epoch_begin(self)
+            for batch in train_data:
+                for handler in batch_begin:
+                    handler.batch_begin(self, batch=batch)
+                data, label, pred, loss = self.fit_batch(batch, batch_axis)
+                n = data.shape[batch_axis] if hasattr(data, "shape") else 1
+                self.trainer.step(n)
+                for handler in batch_end:
+                    handler.batch_end(self, batch=batch, pred=pred,
+                                      label=label, loss=loss)
+                if self.stop_training:
+                    break
+            for handler in epoch_end:
+                handler.epoch_end(self)
+
+        for handler in train_end:
+            handler.train_end(self)
+
+    # -- handler plumbing ----------------------------------------------------
+    def _prepare_default_handlers(self, val_data, event_handlers):
+        event_handlers = _check_event_handlers(event_handlers)
+        added_default = []
+        if not any(isinstance(h, StoppingHandler) for h in event_handlers):
+            event_handlers.append(StoppingHandler(self.max_epoch,
+                                                  self.max_batch))
+            added_default.append("StoppingHandler")
+        if not any(isinstance(h, MetricHandler) for h in event_handlers):
+            event_handlers.append(MetricHandler(self._train_metrics))
+            added_default.append("MetricHandler")
+        if val_data is not None and not any(
+                isinstance(h, ValidationHandler) for h in event_handlers):
+            event_handlers.append(ValidationHandler(
+                val_data=val_data, eval_fn=self.evaluate))
+            added_default.append("ValidationHandler")
+        if not any(isinstance(h, LoggingHandler) for h in event_handlers):
+            event_handlers.append(LoggingHandler(
+                metrics=self._train_metrics + self._val_metrics))
+            added_default.append("LoggingHandler")
+        if added_default:
+            self.logger.info("added default handlers: %s",
+                             ", ".join(added_default))
+        event_handlers.sort(key=lambda h: getattr(h, "priority", 0))
+        return event_handlers
+
+    @staticmethod
+    def _categorize_handlers(event_handlers):
+        train_begin, epoch_begin, batch_begin = [], [], []
+        batch_end, epoch_end, train_end = [], [], []
+        for h in event_handlers:
+            if isinstance(h, TrainBegin):
+                train_begin.append(h)
+            if isinstance(h, EpochBegin):
+                epoch_begin.append(h)
+            if isinstance(h, BatchBegin):
+                batch_begin.append(h)
+            if isinstance(h, BatchEnd):
+                batch_end.append(h)
+            if isinstance(h, EpochEnd):
+                epoch_end.append(h)
+            if isinstance(h, TrainEnd):
+                train_end.append(h)
+        return (train_begin, epoch_begin, batch_begin, batch_end, epoch_end,
+                train_end)
+
+
+def _as_list(x):
+    if x is None:
+        return []
+    return list(x) if isinstance(x, (list, tuple)) else [x]

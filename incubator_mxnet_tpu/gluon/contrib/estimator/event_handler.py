@@ -1,0 +1,381 @@
+"""Estimator event handlers
+(reference: `python/mxnet/gluon/contrib/estimator/event_handler.py:37-746`).
+
+Handlers are mixin classes keyed by lifecycle hook; the Estimator sorts them
+by priority and invokes each hook across the train/eval loop. TPU-native
+notes: checkpointing goes through `Block.save_parameters` (npz) and
+`HybridBlock.export` (StableHLO artifact) rather than symbol JSON.
+"""
+from __future__ import annotations
+
+import logging
+import os
+import time
+import warnings
+
+import numpy as onp
+
+__all__ = ["EventHandler", "TrainBegin", "TrainEnd", "EpochBegin", "EpochEnd",
+           "BatchBegin", "BatchEnd", "StoppingHandler", "MetricHandler",
+           "ValidationHandler", "LoggingHandler", "CheckpointHandler",
+           "EarlyStoppingHandler"]
+
+
+class EventHandler:
+    pass
+
+
+def _check_event_handlers(handlers):
+    if isinstance(handlers, EventHandler):
+        handlers = [handlers]
+    else:
+        handlers = handlers or []
+        if not all(isinstance(h, EventHandler) for h in handlers):
+            raise ValueError("event_handlers must be EventHandler instances")
+    return handlers
+
+
+class TrainBegin(EventHandler):
+    def train_begin(self, estimator, *args, **kwargs):
+        pass
+
+
+class TrainEnd(EventHandler):
+    def train_end(self, estimator, *args, **kwargs):
+        pass
+
+
+class EpochBegin(EventHandler):
+    def epoch_begin(self, estimator, *args, **kwargs):
+        pass
+
+
+class EpochEnd(EventHandler):
+    def epoch_end(self, estimator, *args, **kwargs):
+        pass
+
+
+class BatchBegin(EventHandler):
+    def batch_begin(self, estimator, *args, **kwargs):
+        pass
+
+
+class BatchEnd(EventHandler):
+    def batch_end(self, estimator, *args, **kwargs):
+        pass
+
+
+class StoppingHandler(TrainBegin, BatchEnd, EpochEnd):
+    """Stop after max_epoch epochs or max_batch batches
+    (reference: event_handler.py:82)."""
+
+    def __init__(self, max_epoch=None, max_batch=None):
+        self.max_epoch = max_epoch
+        self.max_batch = max_batch
+        self.current_batch = 0
+        self.current_epoch = 0
+        self.stop_training = False
+
+    def train_begin(self, estimator, *args, **kwargs):
+        self.max_epoch = estimator.max_epoch
+        self.max_batch = estimator.max_batch
+        self.current_batch = 0
+        self.current_epoch = 0
+
+    def batch_end(self, estimator, *args, **kwargs):
+        self.current_batch += 1
+        if self.max_batch and self.current_batch == self.max_batch:
+            estimator.stop_training = True
+
+    def epoch_end(self, estimator, *args, **kwargs):
+        self.current_epoch += 1
+        if self.max_epoch and self.current_epoch == self.max_epoch:
+            estimator.stop_training = True
+
+
+class MetricHandler(EpochBegin, BatchEnd):
+    """Reset metrics at epoch begin, update at batch end
+    (reference: event_handler.py:122)."""
+
+    def __init__(self, metrics, priority=-1000):
+        self.metrics = metrics
+        self.priority = priority
+
+    def epoch_begin(self, estimator, *args, **kwargs):
+        for metric in self.metrics:
+            metric.reset()
+
+    def batch_end(self, estimator, *args, **kwargs):
+        pred = kwargs["pred"]
+        label = kwargs["label"]
+        loss = kwargs["loss"]
+        from ....gluon.metric import Loss
+
+        for metric in self.metrics:
+            if isinstance(metric, Loss):
+                metric.update(0, loss)
+            else:
+                metric.update(label, pred)
+
+
+class ValidationHandler(TrainBegin, BatchEnd, EpochEnd):
+    """Run validation every `epoch_period` epochs / `batch_period` batches
+    (reference: event_handler.py:160)."""
+
+    def __init__(self, val_data, eval_fn, epoch_period=1, batch_period=None,
+                 priority=-1000, event_handlers=None):
+        self.val_data = val_data
+        self.eval_fn = eval_fn
+        self.epoch_period = epoch_period
+        self.batch_period = batch_period
+        self.priority = priority
+        self.event_handlers = event_handlers
+        self.current_batch = 0
+        self.current_epoch = 0
+
+    def train_begin(self, estimator, *args, **kwargs):
+        self.current_batch = 0
+        self.current_epoch = 0
+
+    def batch_end(self, estimator, *args, **kwargs):
+        self.current_batch += 1
+        if self.batch_period and self.current_batch % self.batch_period == 0:
+            self.eval_fn(val_data=self.val_data,
+                         event_handlers=self.event_handlers)
+
+    def epoch_end(self, estimator, *args, **kwargs):
+        self.current_epoch += 1
+        if self.epoch_period and self.current_epoch % self.epoch_period == 0:
+            self.eval_fn(val_data=self.val_data,
+                         event_handlers=self.event_handlers)
+
+
+class LoggingHandler(TrainBegin, TrainEnd, EpochBegin, EpochEnd, BatchBegin,
+                     BatchEnd):
+    """Log training progress (reference: event_handler.py:226)."""
+
+    LOG_PER_EPOCH = 1
+    LOG_PER_BATCH = 2
+
+    def __init__(self, log_interval="epoch", metrics=None, priority=-1000):
+        self.metrics = metrics or []
+        self.priority = priority
+        self.batch_index = 0
+        self.current_epoch = 0
+        self.processed_samples = 0
+        if log_interval == "epoch":
+            self.log_interval = self.LOG_PER_EPOCH
+        elif isinstance(log_interval, int):
+            self.log_interval = log_interval
+        else:
+            raise ValueError("log_interval must be 'epoch' or an int")
+        self.log_interval_time = 0
+
+    def train_begin(self, estimator, *args, **kwargs):
+        self.train_start = time.time()
+        estimator.logger.info(
+            "Training begin: using optimizer %s with current learning rate"
+            " %.4f", type(estimator.trainer.optimizer).__name__,
+            estimator.trainer.learning_rate)
+        if estimator.max_epoch:
+            estimator.logger.info("Train for %d epochs.", estimator.max_epoch)
+        else:
+            estimator.logger.info("Train for %d batches.", estimator.max_batch)
+
+    def train_end(self, estimator, *args, **kwargs):
+        train_time = time.time() - self.train_start
+        msg = f"Train finished using total {train_time:.0f}s at epoch " \
+              f"{self.current_epoch}. "
+        for m in self.metrics:
+            name, value = m.get()
+            msg += f"{name}: {_fmt(value)}, "
+        estimator.logger.info(msg.rstrip(", "))
+
+    def batch_begin(self, estimator, *args, **kwargs):
+        if self.log_interval != self.LOG_PER_EPOCH:
+            self.batch_start = time.time()
+
+    def batch_end(self, estimator, *args, **kwargs):
+        if self.log_interval == self.LOG_PER_EPOCH:
+            return
+        batch_time = time.time() - self.batch_start
+        batch = kwargs["batch"]
+        self.batch_index += 1
+        self.processed_samples += len(batch[0]) if isinstance(
+            batch, (list, tuple)) else len(batch)
+        self.log_interval_time += batch_time
+        if self.batch_index % self.log_interval == 0:
+            msg = f"[Epoch {self.current_epoch}][Batch {self.batch_index}]" \
+                  f"[Samples {self.processed_samples}] " \
+                  f"time/interval: {self.log_interval_time:.3f}s "
+            self.log_interval_time = 0
+            for m in self.metrics:
+                name, value = m.get()
+                msg += f"{name}: {_fmt(value)}, "
+            estimator.logger.info(msg.rstrip(", "))
+
+    def epoch_begin(self, estimator, *args, **kwargs):
+        if self.log_interval is not None:
+            self.epoch_start = time.time()
+            estimator.logger.info("[Epoch %d] Begin, current learning rate: "
+                                  "%.4f", self.current_epoch,
+                                  estimator.trainer.learning_rate)
+
+    def epoch_end(self, estimator, *args, **kwargs):
+        if self.log_interval is not None:
+            epoch_time = time.time() - self.epoch_start
+            msg = f"[Epoch {self.current_epoch}] Finished in {epoch_time:.3f}s, "
+            for m in self.metrics:
+                name, value = m.get()
+                msg += f"{name}: {_fmt(value)}, "
+            estimator.logger.info(msg.rstrip(", "))
+        self.current_epoch += 1
+        self.batch_index = 0
+
+
+def _fmt(value):
+    return f"{value:.4f}" if isinstance(value, float) else str(value)
+
+
+class CheckpointHandler(TrainBegin, BatchEnd, EpochEnd):
+    """Save params (+trainer states) periodically; keep best by monitored
+    metric (reference: event_handler.py:336)."""
+
+    def __init__(self, model_dir, model_prefix="model", monitor=None,
+                 verbose=0, save_best=False, mode="auto", epoch_period=1,
+                 batch_period=None, max_checkpoints=5, resume_from_checkpoint=False):
+        self.model_dir = model_dir
+        self.model_prefix = model_prefix
+        self.monitor = monitor
+        self.verbose = verbose
+        self.save_best = save_best
+        self.epoch_period = epoch_period
+        self.batch_period = batch_period
+        self.max_checkpoints = max_checkpoints
+        self.resume_from_checkpoint = resume_from_checkpoint
+        self.saved_checkpoints: list[str] = []
+        if self.save_best and monitor is None:
+            raise ValueError("save_best requires a monitor metric")
+        self.current_batch = 0
+        self.current_epoch = 0
+        if mode not in ("auto", "min", "max"):
+            warnings.warn(f"unknown mode {mode}; fallback to auto")
+            mode = "auto"
+        if mode == "min":
+            self.monitor_op = onp.less
+        elif mode == "max":
+            self.monitor_op = onp.greater
+        else:
+            name = monitor.get()[0] if monitor is not None else ""
+            self.monitor_op = (onp.greater if "acc" in name or "f1" in name
+                               else onp.less)
+        self.best = (onp.inf if self.monitor_op == onp.less else -onp.inf)
+
+    def train_begin(self, estimator, *args, **kwargs):
+        self.current_batch = 0
+        self.current_epoch = 0
+        os.makedirs(self.model_dir, exist_ok=True)
+
+    def batch_end(self, estimator, *args, **kwargs):
+        self.current_batch += 1
+        if self.batch_period and self.current_batch % self.batch_period == 0:
+            self._save_checkpoint(estimator)
+
+    def epoch_end(self, estimator, *args, **kwargs):
+        self.current_epoch += 1
+        if self.epoch_period and self.current_epoch % self.epoch_period == 0:
+            self._save_checkpoint(estimator)
+
+    def _save_checkpoint(self, estimator):
+        prefix = (f"{self.model_prefix}-epoch{self.current_epoch}"
+                  f"batch{self.current_batch}")
+        self._save_params_and_trainer(estimator, prefix)
+        self.saved_checkpoints.append(prefix)
+        while len(self.saved_checkpoints) > self.max_checkpoints:
+            old = self.saved_checkpoints.pop(0)
+            for suffix in (".params", ".states"):
+                p = os.path.join(self.model_dir, old + suffix)
+                if os.path.exists(p):
+                    os.remove(p)
+        if self.save_best:
+            name, value = self.monitor.get()
+            if self.monitor_op(value, self.best):
+                self.best = value
+                self._save_params_and_trainer(
+                    estimator, f"{self.model_prefix}-best")
+                if self.verbose > 0:
+                    estimator.logger.info(
+                        "[Epoch %d] %s improved to %.5f; saving best model",
+                        self.current_epoch, name, value)
+
+    def _save_params_and_trainer(self, estimator, file_prefix):
+        param_file = os.path.join(self.model_dir, file_prefix + ".params")
+        estimator.net.save_parameters(param_file)
+        if estimator.trainer is not None:
+            estimator.trainer.save_states(
+                os.path.join(self.model_dir, file_prefix + ".states"))
+
+
+class EarlyStoppingHandler(TrainBegin, EpochEnd, TrainEnd):
+    """Stop training when the monitored metric stops improving
+    (reference: event_handler.py:614)."""
+
+    def __init__(self, monitor, min_delta=0, patience=0, mode="auto",
+                 baseline=None):
+        self.monitor = monitor
+        self.min_delta = min_delta
+        self.patience = patience
+        self.baseline = baseline
+        self.wait = 0
+        self.stopped_epoch = 0
+        self.current_epoch = 0
+        self.stop_training = False
+        if mode not in ("auto", "min", "max"):
+            warnings.warn(f"unknown mode {mode}; fallback to auto")
+            mode = "auto"
+        if mode == "min":
+            self.monitor_op = onp.less
+        elif mode == "max":
+            self.monitor_op = onp.greater
+        else:
+            name = monitor.get()[0]
+            self.monitor_op = (onp.greater if "acc" in name or "f1" in name
+                               else onp.less)
+        if self.monitor_op == onp.greater:
+            self.min_delta *= 1
+        else:
+            self.min_delta *= -1
+
+    def train_begin(self, estimator, *args, **kwargs):
+        self.wait = 0
+        self.stopped_epoch = 0
+        self.current_epoch = 0
+        self.stop_training = False
+        if self.baseline is not None:
+            self.best = self.baseline
+        else:
+            self.best = (onp.inf if self.monitor_op == onp.less else -onp.inf)
+
+    def epoch_end(self, estimator, *args, **kwargs):
+        _, current = self.monitor.get()
+        if current is None or (isinstance(current, float)
+                               and onp.isnan(current)):
+            return
+        if self.monitor_op(current - self.min_delta, self.best):
+            self.best = current
+            self.wait = 0
+        else:
+            self.wait += 1
+            if self.wait >= self.patience:
+                self.stopped_epoch = self.current_epoch
+                estimator.stop_training = True
+        self.current_epoch += 1
+
+    def train_end(self, estimator, *args, **kwargs):
+        if self.stopped_epoch > 0:
+            estimator.logger.info(
+                "[Epoch %d] EarlyStoppingHandler: early stopping due to %s "
+                "not improving", self.stopped_epoch, self.monitor.get()[0])
+
+
+_DEFAULT_LOGGER = logging.getLogger("incubator_mxnet_tpu.estimator")

@@ -7,8 +7,10 @@ from ..ndarray.ndarray import NDArray
 
 __all__ = [
     "EvalMetric", "CompositeEvalMetric", "Accuracy", "TopKAccuracy", "F1",
-    "MCC", "MAE", "MSE", "RMSE", "CrossEntropy", "NegativeLogLikelihood",
-    "Perplexity", "PearsonCorrelation", "Loss", "create",
+    "Fbeta", "BinaryAccuracy", "MCC", "MAE", "MSE", "RMSE", "CrossEntropy",
+    "NegativeLogLikelihood", "Perplexity", "PearsonCorrelation", "PCC",
+    "MeanPairwiseDistance", "MeanCosineSimilarity", "Loss", "CustomMetric",
+    "create", "np",
 ]
 
 _REGISTRY: dict = {}
@@ -138,10 +140,23 @@ class TopKAccuracy(EvalMetric):
             self.num_inst += len(label)
 
 
+def _binarize(pred, threshold=0.5):
+    pred = _to_numpy(pred)
+    if pred.ndim > 1 and pred.shape[-1] > 1:
+        return pred.argmax(axis=-1).ravel()
+    return (pred.ravel() > threshold).astype("int32")
+
+
 @register
-class F1(EvalMetric):
-    def __init__(self, name="f1", average="macro", threshold=0.5, **kwargs):
+class Fbeta(EvalMetric):
+    """F-beta score with micro/macro averaging (reference: metric.py:816
+    Fbeta over metric.py:551 _ClassificationMetrics). `average='micro'`
+    accumulates global tp/fp/fn; `'macro'` averages the per-update score."""
+
+    def __init__(self, name="fbeta", beta=1, average="macro", threshold=0.5,
+                 **kwargs):
         self.average = average
+        self.beta = beta
         self.threshold = threshold
         super().__init__(name, **kwargs)
 
@@ -150,25 +165,58 @@ class F1(EvalMetric):
         self.num_inst = 0
         self.sum_metric = 0.0
 
+    def _score(self, tp, fp, fn):
+        prec = tp / max(tp + fp, 1)
+        rec = tp / max(tp + fn, 1)
+        b2 = self.beta ** 2
+        denom = b2 * prec + rec
+        return ((1 + b2) * prec * rec / denom) if denom > 0 else 0.0
+
     def update(self, labels, preds):
         for label, pred in zip(_as_list(labels), _as_list(preds)):
-            pred = _to_numpy(pred)
             label = _to_numpy(label).ravel().astype("int32")
-            if pred.ndim > 1 and pred.shape[-1] > 1:
-                pred = pred.argmax(axis=-1)
+            pred = _binarize(pred, self.threshold)
+            tp = int(((pred == 1) & (label == 1)).sum())
+            fp = int(((pred == 1) & (label == 0)).sum())
+            fn = int(((pred == 0) & (label == 1)).sum())
+            if self.average == "micro":
+                self.tp += tp
+                self.fp += fp
+                self.fn += fn
             else:
-                pred = (pred.ravel() > self.threshold).astype("int32")
-            pred = pred.ravel()
-            self.tp += int(((pred == 1) & (label == 1)).sum())
-            self.fp += int(((pred == 1) & (label == 0)).sum())
-            self.fn += int(((pred == 0) & (label == 1)).sum())
+                self.sum_metric += self._score(tp, fp, fn)
             self.num_inst += 1
 
     def get(self):
-        prec = self.tp / max(self.tp + self.fp, 1)
-        rec = self.tp / max(self.tp + self.fn, 1)
-        f1 = 2 * prec * rec / max(prec + rec, 1e-12)
-        return (self.name, f1)
+        if self.num_inst == 0:
+            return (self.name, float("nan"))
+        if self.average == "micro":
+            return (self.name, self._score(self.tp, self.fp, self.fn))
+        return (self.name, self.sum_metric / self.num_inst)
+
+
+@register
+class F1(Fbeta):
+    def __init__(self, name="f1", average="macro", threshold=0.5, **kwargs):
+        super().__init__(name=name, beta=1, average=average,
+                         threshold=threshold, **kwargs)
+
+
+@register
+class BinaryAccuracy(EvalMetric):
+    """Accuracy of thresholded binary predictions
+    (reference: metric.py:877)."""
+
+    def __init__(self, name="binary_accuracy", threshold=0.5, **kwargs):
+        self.threshold = threshold
+        super().__init__(name, **kwargs)
+
+    def update(self, labels, preds):
+        for label, pred in zip(_as_list(labels), _as_list(preds)):
+            label = _to_numpy(label).ravel().astype("int32")
+            pred = _binarize(pred, self.threshold)
+            self.sum_metric += int((pred == label).sum())
+            self.num_inst += len(label)
 
 
 @register
@@ -314,6 +362,94 @@ class PearsonCorrelation(EvalMetric):
 
 
 @register
+class PCC(EvalMetric):
+    """Multiclass Pearson correlation (Gorodkin's K-category correlation
+    over the running confusion matrix; reference: metric.py:1595)."""
+
+    def __init__(self, name="pcc", **kwargs):
+        self.k = 2
+        super().__init__(name, **kwargs)
+
+    def reset(self):
+        self.cm = onp.zeros((2, 2), dtype=onp.int64)
+        self.num_inst = 0
+        self.sum_metric = 0.0
+
+    def _grow(self, k):
+        if k > self.cm.shape[0]:
+            new = onp.zeros((k, k), dtype=onp.int64)
+            new[:self.cm.shape[0], :self.cm.shape[1]] = self.cm
+            self.cm = new
+
+    def update(self, labels, preds):
+        for label, pred in zip(_as_list(labels), _as_list(preds)):
+            label = _to_numpy(label).ravel().astype("int64")
+            pred = _to_numpy(pred)
+            if pred.ndim > 1 and pred.shape[-1] > 1:
+                pred = pred.argmax(axis=-1)
+            pred = pred.ravel().astype("int64")
+            k = int(max(label.max(initial=0), pred.max(initial=0))) + 1
+            self._grow(k)
+            onp.add.at(self.cm, (label, pred), 1)
+            self.num_inst += len(label)
+
+    def get(self):
+        if self.num_inst == 0:
+            return (self.name, float("nan"))
+        c = self.cm.astype(onp.float64)
+        n = c.sum()
+        trace = onp.trace(c)
+        row = c.sum(axis=1)
+        col = c.sum(axis=0)
+        cov_xy = trace * n - row @ col
+        cov_xx = n * n - row @ row
+        cov_yy = n * n - col @ col
+        denom = onp.sqrt(cov_xx * cov_yy)
+        return (self.name, float(cov_xy / denom) if denom > 0 else 0.0)
+
+
+@register
+class MeanPairwiseDistance(EvalMetric):
+    """Mean p-norm distance between predictions and labels
+    (reference: metric.py:1202)."""
+
+    def __init__(self, name="mpd", p=2, **kwargs):
+        self.p = p
+        super().__init__(name, **kwargs)
+
+    def update(self, labels, preds):
+        for label, pred in zip(_as_list(labels), _as_list(preds)):
+            pred = _to_numpy(pred)
+            label = _to_numpy(label).reshape(pred.shape)
+            pred = pred.reshape(pred.shape[0], -1)
+            label = label.reshape(label.shape[0], -1)
+            d = (onp.abs(pred - label) ** self.p).sum(axis=1) ** (1 / self.p)
+            self.sum_metric += d.sum()
+            self.num_inst += len(d)
+
+
+@register
+class MeanCosineSimilarity(EvalMetric):
+    """Mean cosine similarity along the last axis
+    (reference: metric.py:1269)."""
+
+    def __init__(self, name="cos_sim", eps=1e-8, **kwargs):
+        self.eps = eps
+        super().__init__(name, **kwargs)
+
+    def update(self, labels, preds):
+        for label, pred in zip(_as_list(labels), _as_list(preds)):
+            pred = _to_numpy(pred)
+            label = _to_numpy(label).reshape(pred.shape)
+            num = (pred * label).sum(axis=-1)
+            den = (onp.linalg.norm(pred, axis=-1)
+                   * onp.linalg.norm(label, axis=-1))
+            sim = num / onp.maximum(den, self.eps)
+            self.sum_metric += sim.sum()
+            self.num_inst += sim.size
+
+
+@register
 class Loss(EvalMetric):
     def __init__(self, name="loss", **kwargs):
         super().__init__(name, **kwargs)
@@ -323,6 +459,13 @@ class Loss(EvalMetric):
             loss = _to_numpy(pred)
             self.sum_metric += loss.sum()
             self.num_inst += loss.size
+
+
+def np(numpy_feval, name=None, allow_extra_outputs=False):
+    """Wrap a numpy feval(label, pred) into a metric
+    (reference: metric.py:1807)."""
+    return CustomMetric(numpy_feval, name or numpy_feval.__name__,
+                        allow_extra_outputs)
 
 
 class CustomMetric(EvalMetric):

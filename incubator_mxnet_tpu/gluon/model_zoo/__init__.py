@@ -1,1 +1,2 @@
+from . import model_store  # noqa: F401
 from . import vision  # noqa: F401

@@ -1,0 +1,125 @@
+"""Pretrained-weight store (reference:
+`python/mxnet/gluon/model_zoo/model_store.py:31-140`).
+
+TPU-native/no-egress design: the reference downloads .params archives from
+an S3 repo and verifies sha1. This environment has zero network egress, so
+the store resolves ONLY against local caches: `$MXNET_HOME/models` (or
+`~/.incubator_mxnet_tpu/models`) plus any directory on
+`INCUBATOR_MXNET_TPU_MODEL_PATH`. `get_model_file` verifies sha1 when a
+checksum is registered; `export_to_store` registers locally-trained weights
+so `get_model(..., pretrained=True)` round-trips."""
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+
+__all__ = ["get_model_file", "purge", "data_dir", "register_sha1",
+           "export_to_store", "short_hash"]
+
+# name -> sha1 of the .params payload; populated from the registry file and
+# `register_sha1`. (The reference ships a hardcoded table for its S3 assets;
+# local-first stores persist theirs next to the cache.)
+_model_sha1: dict[str, str] = {}
+
+
+def data_dir():
+    return os.environ.get(
+        "MXNET_HOME",
+        os.path.join(os.path.expanduser("~"), ".incubator_mxnet_tpu"))
+
+
+def _registry_path(root):
+    return os.path.join(root, "registry.json")
+
+
+def _load_registry(root):
+    path = _registry_path(root)
+    if os.path.exists(path):
+        with open(path) as f:
+            _model_sha1.update(json.load(f))
+
+
+def _save_registry(root):
+    os.makedirs(root, exist_ok=True)
+    with open(_registry_path(root), "w") as f:
+        json.dump(_model_sha1, f, indent=2, sort_keys=True)
+
+
+def short_hash(name):
+    if name not in _model_sha1:
+        raise ValueError(f"pretrained model for {name} is not available")
+    return _model_sha1[name][:8]
+
+
+def _sha1(path):
+    h = hashlib.sha1()  # noqa: S324 — content checksum, not security
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def _search_roots(root=None):
+    roots = [root] if root else []
+    roots.append(os.path.join(data_dir(), "models"))
+    extra = os.environ.get("INCUBATOR_MXNET_TPU_MODEL_PATH", "")
+    roots += [p for p in extra.split(os.pathsep) if p]
+    return roots
+
+
+def get_model_file(name, root=None):
+    """Locate (and checksum-verify) `<name>.params` in the local store
+    (reference: model_store.py:75 downloads+verifies; here: local-only,
+    no egress)."""
+    for r in _search_roots(root):
+        _load_registry(r)
+        for fname in (f"{name}-{short_hash(name)}.params"
+                      if name in _model_sha1 else None,
+                      f"{name}.params"):
+            if fname is None:
+                continue
+            path = os.path.join(r, fname)
+            if os.path.exists(path):
+                want = _model_sha1.get(name)
+                if want and _sha1(path) != want:
+                    raise ValueError(
+                        f"checksum mismatch for {path}; delete the file and "
+                        "re-export it")
+                return path
+    raise FileNotFoundError(
+        f"pretrained weights for {name!r} not found in "
+        f"{_search_roots(root)}; this build has no network egress — place "
+        f"{name}.params there or train locally and call export_to_store")
+
+
+def register_sha1(name, sha1_hash, root=None):
+    """Register a checksum for `name` (persisted in the cache registry)."""
+    root = root or os.path.join(data_dir(), "models")
+    _load_registry(root)
+    _model_sha1[name] = sha1_hash
+    _save_registry(root)
+
+
+def export_to_store(net, name, root=None):
+    """Save a trained net's parameters into the store under `name` and
+    register the checksum, making `pretrained=True` loads work offline."""
+    root = root or os.path.join(data_dir(), "models")
+    os.makedirs(root, exist_ok=True)
+    tmp = os.path.join(root, f"{name}.params.tmp")
+    net.save_parameters(tmp)
+    sha = _sha1(tmp)
+    final = os.path.join(root, f"{name}-{sha[:8]}.params")
+    os.replace(tmp, final)
+    register_sha1(name, sha, root)
+    return final
+
+
+def purge(root=None):
+    """Delete cached model files (reference: model_store.py:129)."""
+    root = root or os.path.join(data_dir(), "models")
+    if not os.path.isdir(root):
+        return
+    for f in os.listdir(root):
+        if f.endswith(".params"):
+            os.remove(os.path.join(root, f))

@@ -1,9 +1,29 @@
 """Vision model zoo (reference: `python/mxnet/gluon/model_zoo/vision/`).
 
 Pretrained-weight download is unavailable (no egress); `pretrained=True`
-raises with instructions to load local .params files instead.
+resolves against the local model_store cache (see
+`model_zoo/model_store.py`) or raises with instructions.
 """
-from .alexnet import AlexNet, alexnet  # noqa: F401
+
+
+def _split_store_kwargs(kwargs):
+    """Split model-store kwargs (root/device/ctx) from model kwargs."""
+    store_kw = {k: kwargs.pop(k) for k in ("root", "device", "ctx")
+                if k in kwargs}
+    return store_kw, kwargs
+
+
+def _load_pretrained(net, name, store_kw):
+    """Load weights for `name` from the local model_store cache
+    (`model_zoo/model_store.py`: no-egress, local-first)."""
+    from ..model_store import get_model_file
+
+    net.load_parameters(get_model_file(name, root=store_kw.get("root")),
+                        device=store_kw.get("device", store_kw.get("ctx")))
+
+
+from .alexnet import AlexNet, alexnet  # noqa: F401,E402
+from .inception import Inception3, inception_v3  # noqa: F401
 from .mobilenet import (  # noqa: F401
     MobileNet, MobileNetV2, mobilenet0_25, mobilenet0_5, mobilenet0_75,
     mobilenet1_0, mobilenet_v2_0_25, mobilenet_v2_0_5, mobilenet_v2_0_75,
@@ -39,6 +59,7 @@ _models = {
     "squeezenet1.0": squeezenet1_0, "squeezenet1.1": squeezenet1_1,
     "densenet121": densenet121, "densenet161": densenet161,
     "densenet169": densenet169, "densenet201": densenet201,
+    "inceptionv3": inception_v3,
 }
 
 

@@ -27,6 +27,10 @@ class AlexNet(HybridBlock):
 
 
 def alexnet(pretrained=False, **kwargs):
+    from . import _load_pretrained, _split_store_kwargs
+
+    store_kw, kwargs = _split_store_kwargs(kwargs)
+    net = AlexNet(**kwargs)
     if pretrained:
-        raise RuntimeError("pretrained weights unavailable (no network egress)")
-    return AlexNet(**kwargs)
+        _load_pretrained(net, "alexnet", store_kw)
+    return net

@@ -76,10 +76,14 @@ _densenet_spec = {
 
 
 def get_densenet(num_layers, pretrained=False, **kwargs):
-    if pretrained:
-        raise RuntimeError("pretrained weights unavailable (no network egress)")
+    from . import _load_pretrained, _split_store_kwargs
+
+    store_kw, kwargs = _split_store_kwargs(kwargs)
     num_init_features, growth_rate, block_config = _densenet_spec[num_layers]
-    return DenseNet(num_init_features, growth_rate, block_config, **kwargs)
+    net = DenseNet(num_init_features, growth_rate, block_config, **kwargs)
+    if pretrained:
+        _load_pretrained(net, f"densenet{num_layers}", store_kw)
+    return net
 
 
 def densenet121(**kwargs):

@@ -88,15 +88,25 @@ class MobileNetV2(HybridBlock):
 
 
 def get_mobilenet(multiplier, pretrained=False, **kwargs):
+    from . import _load_pretrained, _split_store_kwargs
+
+    store_kw, kwargs = _split_store_kwargs(kwargs)
+    net = MobileNet(multiplier, **kwargs)
     if pretrained:
-        raise RuntimeError("pretrained weights unavailable (no network egress)")
-    return MobileNet(multiplier, **kwargs)
+        version_suffix = f"{multiplier:.2f}".rstrip("0").rstrip(".")
+        _load_pretrained(net, f"mobilenet{version_suffix}", store_kw)
+    return net
 
 
 def get_mobilenet_v2(multiplier, pretrained=False, **kwargs):
+    from . import _load_pretrained, _split_store_kwargs
+
+    store_kw, kwargs = _split_store_kwargs(kwargs)
+    net = MobileNetV2(multiplier, **kwargs)
     if pretrained:
-        raise RuntimeError("pretrained weights unavailable (no network egress)")
-    return MobileNetV2(multiplier, **kwargs)
+        version_suffix = f"{multiplier:.2f}".rstrip("0").rstrip(".")
+        _load_pretrained(net, f"mobilenetv2_{version_suffix}", store_kw)
+    return net
 
 
 def mobilenet1_0(**kwargs):

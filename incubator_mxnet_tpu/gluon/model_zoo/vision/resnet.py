@@ -9,11 +9,6 @@ from ... import nn
 from ...block import HybridBlock
 
 
-def _err_pretrained():
-    raise RuntimeError("pretrained weights unavailable (no network egress); "
-                       "use load_parameters() with a local file")
-
-
 class BasicBlockV1(HybridBlock):
     def __init__(self, channels, stride, downsample=False, in_channels=0):
         super().__init__()
@@ -220,12 +215,16 @@ _resnet_net_versions = [ResNetV1, ResNetV2]
 
 
 def get_resnet(version, num_layers, pretrained=False, **kwargs):
-    if pretrained:
-        _err_pretrained()
+    from . import _load_pretrained, _split_store_kwargs
+
+    store_kw, kwargs = _split_store_kwargs(kwargs)
     block_type, layers, channels = _resnet_spec[num_layers]
     resnet_class = _resnet_net_versions[version - 1]
     block_class = _resnet_block_versions[version - 1][block_type]
-    return resnet_class(block_class, layers, channels, **kwargs)
+    net = resnet_class(block_class, layers, channels, **kwargs)
+    if pretrained:
+        _load_pretrained(net, f"resnet{num_layers}_v{version}", store_kw)
+    return net
 
 
 def resnet18_v1(**kwargs):

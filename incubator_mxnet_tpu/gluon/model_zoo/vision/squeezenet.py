@@ -58,13 +58,19 @@ class SqueezeNet(HybridBlock):
         return self.output(self.features(x))
 
 
-def squeezenet1_0(pretrained=False, **kwargs):
+def _squeezenet(version, pretrained, kwargs):
+    from . import _load_pretrained, _split_store_kwargs
+
+    store_kw, kwargs = _split_store_kwargs(kwargs)
+    net = SqueezeNet(version, **kwargs)
     if pretrained:
-        raise RuntimeError("pretrained weights unavailable (no network egress)")
-    return SqueezeNet("1.0", **kwargs)
+        _load_pretrained(net, f"squeezenet{version}", store_kw)
+    return net
+
+
+def squeezenet1_0(pretrained=False, **kwargs):
+    return _squeezenet("1.0", pretrained, kwargs)
 
 
 def squeezenet1_1(pretrained=False, **kwargs):
-    if pretrained:
-        raise RuntimeError("pretrained weights unavailable (no network egress)")
-    return SqueezeNet("1.1", **kwargs)
+    return _squeezenet("1.1", pretrained, kwargs)

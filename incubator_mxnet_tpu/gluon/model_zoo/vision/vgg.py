@@ -33,10 +33,15 @@ class VGG(HybridBlock):
 
 
 def get_vgg(num_layers, pretrained=False, batch_norm=False, **kwargs):
-    if pretrained:
-        raise RuntimeError("pretrained weights unavailable (no network egress)")
+    from . import _load_pretrained, _split_store_kwargs
+
+    store_kw, kwargs = _split_store_kwargs(kwargs)
     layers, filters = _vgg_spec[num_layers]
-    return VGG(layers, filters, batch_norm=batch_norm, **kwargs)
+    net = VGG(layers, filters, batch_norm=batch_norm, **kwargs)
+    if pretrained:
+        suffix = "_bn" if batch_norm else ""
+        _load_pretrained(net, f"vgg{num_layers}{suffix}", store_kw)
+    return net
 
 
 def vgg11(**kwargs):

@@ -1,0 +1,212 @@
+"""Estimator + expanded metrics tests (reference:
+`tests/python/unittest/test_gluon_estimator.py`,
+`test_gluon_event_handler.py`, `test_metric.py`)."""
+import logging
+import os
+
+import numpy as onp
+import pytest
+
+from incubator_mxnet_tpu import gluon, np
+from incubator_mxnet_tpu.gluon import metric
+from incubator_mxnet_tpu.gluon.contrib.estimator import (
+    CheckpointHandler, EarlyStoppingHandler, Estimator, EpochEnd,
+    LoggingHandler, StoppingHandler)
+
+
+def _make_data(n=256, d=4):
+    X = np.random.uniform(size=(n, d))
+    W = np.random.uniform(size=(d, 1))
+    Y = X @ W
+    ds = gluon.data.ArrayDataset(X, Y)
+    return gluon.data.DataLoader(ds, batch_size=32), X, Y
+
+
+def _make_est(net=None, lr=0.05):
+    if net is None:
+        net = gluon.nn.HybridSequential()
+        net.add(gluon.nn.Dense(16, activation="relu"), gluon.nn.Dense(1))
+        net.initialize()
+        net.hybridize()
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": lr})
+    return Estimator(net, loss=gluon.loss.L2Loss(), trainer=trainer,
+                     train_metrics=metric.MSE())
+
+
+def test_estimator_fit_learns():
+    loader, _, _ = _make_data()
+    est = _make_est()
+    est.logger.setLevel(logging.ERROR)
+    est.fit(loader, epochs=20)
+    _, mse = est.train_metrics[0].get()
+    assert mse < 0.01, mse
+
+
+def test_estimator_evaluate():
+    loader, _, _ = _make_data()
+    est = _make_est()
+    est.logger.setLevel(logging.ERROR)
+    est.fit(loader, epochs=5)
+    res = est.evaluate(loader)
+    assert "validation mse" in res
+    assert res["validation mse"] == pytest.approx(
+        est.val_metrics[0].get()[1])
+
+
+def test_estimator_max_batch_stops():
+    loader, _, _ = _make_data()
+    est = _make_est()
+    est.logger.setLevel(logging.ERROR)
+    seen = []
+
+    class Counter(EpochEnd):
+        def epoch_end(self, estimator, *a, **k):
+            seen.append(1)
+
+    est.fit(loader, batches=3, event_handlers=[Counter()])
+    # 3 batches < 1 epoch: must stop before any epoch completes more than once
+    assert len(seen) <= 1
+
+
+def test_estimator_checkpoint(tmp_path):
+    loader, _, _ = _make_data()
+    est = _make_est()
+    est.logger.setLevel(logging.ERROR)
+    ckpt = CheckpointHandler(str(tmp_path), model_prefix="m", epoch_period=1)
+    est.fit(loader, epochs=2, event_handlers=[ckpt])
+    saved = os.listdir(tmp_path)
+    assert any(f.endswith(".params") for f in saved)
+    assert any(f.endswith(".states") for f in saved)
+
+
+def test_estimator_early_stopping():
+    loader, _, _ = _make_data()
+    est = _make_est(lr=0.0)  # frozen → no improvement → stop after patience
+    est.logger.setLevel(logging.ERROR)
+    monitor = est.train_metrics[0]
+    handler = EarlyStoppingHandler(monitor=monitor, patience=2, mode="min")
+    est.fit(loader, epochs=50, event_handlers=[handler])
+    assert handler.current_epoch < 50
+
+
+def test_estimator_does_not_mutate_caller_metrics():
+    m = metric.MSE()
+    _make_est_with_metric(m)
+    assert m.name == "mse"
+    _make_est_with_metric(m)
+    assert m.name == "mse"
+
+
+def _make_est_with_metric(m):
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(1))
+    net.initialize()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1})
+    return Estimator(net, loss=gluon.loss.L2Loss(), trainer=trainer,
+                     train_metrics=m)
+
+
+def test_evaluate_fires_handlers():
+    from incubator_mxnet_tpu.gluon.contrib.estimator import BatchEnd
+
+    loader, _, _ = _make_data(n=64)
+    est = _make_est()
+    est.logger.setLevel(logging.ERROR)
+    calls = []
+
+    class H(BatchEnd):
+        def batch_end(self, estimator, *a, **k):
+            calls.append(1)
+
+    est.evaluate(loader, event_handlers=[H()])
+    assert len(calls) == 2  # 64 samples / batch 32
+
+
+# -- metrics ------------------------------------------------------------------
+
+def test_f1_micro_macro():
+    label = onp.array([1, 0, 1, 1, 0])
+    pred = onp.array([0.8, 0.2, 0.6, 0.3, 0.7])
+    for average in ("micro", "macro"):
+        m = metric.F1(average=average)
+        m.update(label, pred)
+        tp, fp, fn = 2, 1, 1
+        prec, rec = tp / (tp + fp), tp / (tp + fn)
+        want = 2 * prec * rec / (prec + rec)
+        assert m.get()[1] == pytest.approx(want)
+    # macro averages per-update scores; micro aggregates counts
+    m_micro, m_macro = metric.F1(average="micro"), metric.F1(average="macro")
+    l2, p2 = onp.array([1, 1]), onp.array([0.9, 0.9])
+    for m in (m_micro, m_macro):
+        m.update(label, pred)
+        m.update(l2, p2)
+    assert m_micro.get()[1] != pytest.approx(m_macro.get()[1])
+
+
+def test_fbeta():
+    label = onp.array([1, 0, 1, 1])
+    pred = onp.array([0.9, 0.8, 0.7, 0.1])
+    m = metric.Fbeta(beta=2, average="micro")
+    m.update(label, pred)
+    tp, fp, fn = 2, 1, 1
+    prec, rec = tp / (tp + fp), tp / (tp + fn)
+    want = 5 * prec * rec / (4 * prec + rec)
+    assert m.get()[1] == pytest.approx(want)
+
+
+def test_binary_accuracy():
+    m = metric.BinaryAccuracy(threshold=0.6)
+    m.update(onp.array([1, 0, 1, 0]), onp.array([0.7, 0.2, 0.5, 0.8]))
+    assert m.get()[1] == pytest.approx(0.5)
+
+
+def test_pcc_matches_mcc_binary():
+    rng = onp.random.RandomState(0)
+    label = rng.randint(0, 2, 100)
+    pred = (label ^ (rng.uniform(size=100) > 0.8)).astype("int32")
+    pcc, mcc = metric.PCC(), metric.MCC()
+    pcc.update(label, pred)
+    mcc.update(label, pred.astype("float32"))
+    assert pcc.get()[1] == pytest.approx(mcc.get()[1], abs=1e-6)
+
+
+def test_pcc_multiclass():
+    label = onp.array([0, 1, 2, 2, 1, 0])
+    pred = onp.eye(3)[[0, 1, 2, 2, 1, 0]]
+    m = metric.PCC()
+    m.update(label, pred)
+    assert m.get()[1] == pytest.approx(1.0)
+
+
+def test_mean_pairwise_distance():
+    m = metric.MeanPairwiseDistance()
+    pred = onp.array([[3.0, 4.0], [0.0, 0.0]])
+    label = onp.array([[0.0, 0.0], [0.0, 0.0]])
+    m.update(label, pred)
+    assert m.get()[1] == pytest.approx(2.5)  # (5 + 0) / 2
+
+
+def test_mean_cosine_similarity():
+    m = metric.MeanCosineSimilarity()
+    pred = onp.array([[1.0, 0.0], [0.0, 2.0]])
+    label = onp.array([[2.0, 0.0], [0.0, 1.0]])
+    m.update(label, pred)
+    assert m.get()[1] == pytest.approx(1.0)
+
+
+def test_np_custom_metric():
+    def zero_one(label, pred):
+        return float((label != (pred > 0.5)).mean())
+
+    m = metric.np(zero_one)
+    m.update(onp.array([1, 0]), onp.array([0.9, 0.8]))
+    assert m.get()[1] == pytest.approx(0.5)
+    assert "zero_one" in m.get()[0]
+
+
+def test_create_by_name():
+    assert isinstance(metric.create("f1"), metric.F1)
+    assert isinstance(metric.create("pcc"), metric.PCC)
+    assert isinstance(metric.create("binaryaccuracy"), metric.BinaryAccuracy)

@@ -1,0 +1,215 @@
+"""Image pipeline tests: augmenters, CreateAugmenter, ImageIter, im2rec
+(reference: `tests/python/unittest/test_image.py`)."""
+import os
+import subprocess
+import sys
+
+import numpy as onp
+import pytest
+
+from incubator_mxnet_tpu import image
+from incubator_mxnet_tpu.ndarray.ndarray import NDArray
+
+
+def _img(h=32, w=32, c=3, seed=0):
+    rng = onp.random.RandomState(seed)
+    return rng.randint(0, 255, (h, w, c)).astype(onp.uint8)
+
+
+def test_resize_and_crops():
+    src = NDArray(_img(40, 60))
+    out = image.imresize(src, 30, 20)
+    assert out.shape == (20, 30, 3)
+    short = image.resize_short(src, 24)
+    assert min(short.shape[:2]) == 24
+    crop, rect = image.center_crop(src, (20, 20))
+    assert crop.shape[:2] == (20, 20) and rect[2:] == (20, 20)
+    crop, _ = image.random_crop(src, (16, 16))
+    assert crop.shape[:2] == (16, 16)
+    crop, _ = image.random_size_crop(src, (16, 16), (0.3, 1.0), (0.75, 1.33))
+    assert crop.shape[:2] == (16, 16)
+
+
+def test_scale_down_and_border():
+    assert image.scale_down((30, 40), (50, 50)) == (30, 30)
+    out = image.copyMakeBorder(NDArray(_img(10, 10)), 2, 3, 4, 5)
+    assert out.shape == (15, 19, 3)
+
+
+def test_augmenter_suite_shapes():
+    src = _img(48, 48).astype(onp.float32)
+    augs = [image.BrightnessJitterAug(0.3), image.ContrastJitterAug(0.3),
+            image.SaturationJitterAug(0.3), image.HueJitterAug(0.3),
+            image.ColorJitterAug(0.2, 0.2, 0.2),
+            image.LightingAug(0.1, onp.array([55.46, 4.794, 1.148]),
+                              onp.eye(3)),
+            image.RandomGrayAug(1.0), image.HorizontalFlipAug(1.0),
+            image.CastAug(), image.ColorNormalizeAug(
+                onp.array([123.0, 117.0, 104.0]),
+                onp.array([58.0, 57.0, 57.0]))]
+    for aug in augs:
+        out = aug.apply_np(src.copy())
+        assert out.shape == src.shape, type(aug).__name__
+        assert onp.isfinite(out).all(), type(aug).__name__
+
+
+def test_horizontal_flip_flips():
+    src = onp.arange(12, dtype=onp.float32).reshape(2, 2, 3)
+    out = image.HorizontalFlipAug(1.0).apply_np(src)
+    onp.testing.assert_array_equal(out, src[:, ::-1])
+
+
+def test_random_gray_is_gray():
+    out = image.RandomGrayAug(1.0).apply_np(_img().astype(onp.float32))
+    onp.testing.assert_allclose(out[..., 0], out[..., 1], rtol=1e-5)
+
+
+def test_create_augmenter_pipeline():
+    augs = image.CreateAugmenter((3, 24, 24), resize=28, rand_crop=True,
+                                 rand_mirror=True, mean=True, std=True,
+                                 brightness=0.1, contrast=0.1,
+                                 saturation=0.1, hue=0.1, pca_noise=0.05,
+                                 rand_gray=0.1)
+    src = _img(64, 48).astype(onp.float32)
+    for aug in augs:
+        src = aug.apply_np(src)
+    assert src.shape == (24, 24, 3)
+    assert src.dtype == onp.float32
+
+
+def test_augmenter_dumps():
+    s = image.ResizeAug(28).dumps()
+    assert "ResizeAug" in s
+
+
+def _write_npy_tree(root, n_per_class=3):
+    for cls in ("cat", "dog"):
+        os.makedirs(os.path.join(root, cls), exist_ok=True)
+        for i in range(n_per_class):
+            onp.save(os.path.join(root, cls, f"{i}.npy"), _img(seed=i))
+
+
+def test_imageiter_from_imglist(tmp_path):
+    _write_npy_tree(str(tmp_path))
+    imglist = [(0, "cat/0.npy"), (0, "cat/1.npy"), (1, "dog/0.npy"),
+               (1, "dog/1.npy"), (1, "dog/2.npy")]
+    it = image.ImageIter(batch_size=2, data_shape=(3, 24, 24),
+                         imglist=imglist, path_root=str(tmp_path),
+                         aug_list=[image.CastAug()])
+    batches = list(it)
+    assert len(batches) == 3  # 5 images, pad to 6
+    assert batches[0].data[0].shape == (2, 3, 24, 24)
+    assert batches[-1].pad == 1
+    it.reset()
+    assert len(list(it)) == 3
+
+
+def test_im2rec_roundtrip(tmp_path):
+    _write_npy_tree(str(tmp_path / "imgs"))
+    prefix = str(tmp_path / "data")
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    tool = os.path.join(repo, "tools", "im2rec.py")
+    r = subprocess.run([sys.executable, tool, prefix, str(tmp_path / "imgs"),
+                        "--list"], capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr
+    assert os.path.exists(prefix + ".lst")
+    r = subprocess.run([sys.executable, tool, prefix, str(tmp_path / "imgs")],
+                       capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr
+    assert os.path.exists(prefix + ".rec")
+    assert os.path.exists(prefix + ".idx")
+
+    it = image.ImageIter(batch_size=3, data_shape=(3, 32, 32),
+                         path_imgrec=prefix + ".rec",
+                         aug_list=[image.CastAug()])
+    batch = next(it)
+    assert batch.data[0].shape == (3, 3, 32, 32)
+    labels = set()
+    it.reset()
+    for b in it:
+        labels.update(b.label[0].asnumpy().ravel().tolist())
+    assert labels == {0.0, 1.0}
+
+
+def test_imageiter_tiny_dataset_pads(tmp_path):
+    # dataset smaller than batch_size: pad must wrap with modulo, not crash
+    _write_npy_tree(str(tmp_path), n_per_class=1)
+    imglist = [(0, "cat/0.npy"), (1, "dog/0.npy")]
+    it = image.ImageIter(batch_size=8, data_shape=(3, 16, 16),
+                         imglist=imglist, path_root=str(tmp_path),
+                         aug_list=[image.CastAug()])
+    batch = next(it)
+    assert batch.data[0].shape == (8, 3, 16, 16)
+    assert batch.pad == 6
+
+
+def test_imageiter_bad_data_shape():
+    with pytest.raises(ValueError, match="data_shape"):
+        image.ImageIter(batch_size=2, data_shape=(3, 224), imglist=[])
+
+
+def test_resize_np_matches_jax():
+    from incubator_mxnet_tpu.image import _resize_np
+
+    src = _img(17, 23).astype(onp.float32)
+    host = _resize_np(src, 11, 9)
+    dev = image.imresize(NDArray(src), 11, 9).asnumpy()
+    onp.testing.assert_allclose(host, dev, rtol=1e-4, atol=1e-3)
+
+
+def test_pretrained_roundtrip_via_model_store(tmp_path, monkeypatch):
+    from incubator_mxnet_tpu import np as mnp
+    from incubator_mxnet_tpu.gluon.model_zoo import model_store
+    from incubator_mxnet_tpu.gluon.model_zoo.vision import get_model
+
+    monkeypatch.setenv("MXNET_HOME", str(tmp_path))
+    net = get_model("resnet18_v1", classes=4)
+    net.initialize()
+    x = mnp.random.uniform(size=(1, 3, 32, 32))
+    y0 = net(x)
+    model_store.export_to_store(net, "resnet18_v1")
+    net2 = get_model("resnet18_v1", classes=4, pretrained=True)
+    onp.testing.assert_allclose(net2(x).asnumpy(), y0.asnumpy(),
+                                rtol=1e-5, atol=1e-5)
+
+
+def test_model_store_roundtrip(tmp_path, monkeypatch):
+    from incubator_mxnet_tpu import gluon
+    from incubator_mxnet_tpu.gluon.model_zoo import model_store
+
+    monkeypatch.setenv("MXNET_HOME", str(tmp_path))
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(4))
+    net.initialize()
+    from incubator_mxnet_tpu import np as mnp
+
+    net(mnp.zeros((1, 3)))
+    path = model_store.export_to_store(net, "tiny")
+    assert os.path.exists(path)
+    found = model_store.get_model_file("tiny")
+    assert found == path
+    net2 = gluon.nn.HybridSequential()
+    net2.add(gluon.nn.Dense(4))
+    net2.load_parameters(found)
+    onp.testing.assert_allclose(net2(mnp.zeros((1, 3))).asnumpy(),
+                                net(mnp.zeros((1, 3))).asnumpy())
+    # corrupt → checksum error
+    with open(found, "r+b") as f:
+        f.seek(0)
+        f.write(b"x")
+    with pytest.raises(ValueError, match="checksum"):
+        model_store.get_model_file("tiny")
+    model_store.purge()
+    with pytest.raises(FileNotFoundError):
+        model_store.get_model_file("tiny")
+
+
+def test_inception_v3_forward():
+    from incubator_mxnet_tpu import np as mnp
+    from incubator_mxnet_tpu.gluon.model_zoo.vision import inception_v3
+
+    net = inception_v3(classes=10)
+    net.initialize()
+    x = mnp.random.uniform(size=(1, 3, 299, 299))
+    y = net(x)
+    assert y.shape == (1, 10)

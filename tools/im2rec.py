@@ -1,0 +1,137 @@
+#!/usr/bin/env python
+"""im2rec — build .lst image lists and pack images into RecordIO
+(reference: `tools/im2rec.py` — list generation + multiprocess packing).
+
+Usage:
+    python tools/im2rec.py PREFIX ROOT --list          # make PREFIX.lst
+    python tools/im2rec.py PREFIX ROOT                 # pack PREFIX.lst → .rec/.idx
+
+Images may be .jpg/.png (requires PIL) or .npy arrays (always supported).
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import random
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+EXTS = (".jpg", ".jpeg", ".png", ".npy")
+
+
+def list_image(root, recursive, exts):
+    """Yield (index, relpath, label) walking `root`
+    (reference: tools/im2rec.py list_image)."""
+    i = 0
+    if recursive:
+        cat = {}
+        for path, _, files in sorted(os.walk(root, followlinks=True)):
+            dpath = os.path.relpath(path, root)
+            for fname in sorted(files):
+                if os.path.splitext(fname)[1].lower() in exts:
+                    if dpath not in cat:
+                        cat[dpath] = len(cat)
+                    yield (i, os.path.join(dpath, fname), cat[dpath])
+                    i += 1
+    else:
+        for fname in sorted(os.listdir(root)):
+            if os.path.splitext(fname)[1].lower() in exts:
+                yield (i, fname, 0)
+                i += 1
+
+
+def write_list(path_out, image_list):
+    """PREFIX.lst lines: index \\t label(s) \\t relpath
+    (reference: tools/im2rec.py write_list)."""
+    with open(path_out, "w") as f:
+        for idx, relpath, label in image_list:
+            f.write(f"{idx}\t{label}\t{relpath}\n")
+
+
+def read_list(path_in):
+    with open(path_in) as f:
+        for line in f:
+            parts = line.strip().split("\t")
+            if len(parts) < 3:
+                continue
+            yield (int(parts[0]), parts[-1], [float(x) for x in parts[1:-1]])
+
+
+def make_list(args):
+    image_list = list(list_image(args.root, args.recursive, EXTS))
+    if args.shuffle:
+        random.seed(100)
+        random.shuffle(image_list)
+        image_list = [(i, rel, lab) for i, (_, rel, lab)
+                      in enumerate(image_list)]
+    n_total = len(image_list)
+    n_test = int(n_total * args.test_ratio)
+    n_train = int(n_total * args.train_ratio)
+    chunks = {
+        "_test": image_list[:n_test],
+        "_train": image_list[n_test:n_test + n_train],
+        "_val": image_list[n_test + n_train:],
+    }
+    if args.test_ratio == 0 and args.train_ratio == 1.0:
+        write_list(args.prefix + ".lst", image_list)
+        return
+    for suffix, chunk in chunks.items():
+        if chunk:
+            write_list(args.prefix + suffix + ".lst", chunk)
+
+
+def pack(args, lst_path, rec_prefix):
+    import numpy as onp
+
+    from incubator_mxnet_tpu.image import imread
+    from incubator_mxnet_tpu.recordio import (IRHeader, MXIndexedRecordIO,
+                                              pack_img)
+
+    rec = MXIndexedRecordIO(rec_prefix + ".idx", rec_prefix + ".rec", "w")
+    cnt = 0
+    for idx, relpath, labels in read_list(lst_path):
+        path = os.path.join(args.root, relpath)
+        try:
+            img = imread(path).asnumpy()
+        except Exception as e:  # noqa: BLE001
+            print(f"skip {path}: {e}", file=sys.stderr)
+            continue
+        label = labels[0] if len(labels) == 1 else onp.asarray(labels)
+        header = IRHeader(0, label, idx, 0)
+        rec.write_idx(idx, pack_img(header, img.astype(onp.uint8),
+                                    quality=args.quality,
+                                    img_fmt=args.encoding))
+        cnt += 1
+    rec.close()
+    print(f"packed {cnt} images into {rec_prefix}.rec")
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("prefix", help="prefix of .lst/.rec files")
+    p.add_argument("root", help="image root directory")
+    p.add_argument("--list", action="store_true",
+                   help="create image list instead of packing")
+    p.add_argument("--recursive", action="store_true", default=True)
+    p.add_argument("--no-recursive", dest="recursive", action="store_false")
+    p.add_argument("--shuffle", action="store_true", default=True)
+    p.add_argument("--no-shuffle", dest="shuffle", action="store_false")
+    p.add_argument("--test-ratio", type=float, default=0.0)
+    p.add_argument("--train-ratio", type=float, default=1.0)
+    p.add_argument("--quality", type=int, default=95)
+    p.add_argument("--encoding", default=".jpg")
+    args = p.parse_args()
+
+    if args.list:
+        make_list(args)
+        return
+    lst = args.prefix if args.prefix.endswith(".lst") else args.prefix + ".lst"
+    if not os.path.exists(lst):
+        raise SystemExit(f"{lst} not found; run with --list first")
+    prefix = lst[:-4]
+    pack(args, lst, prefix)
+
+
+if __name__ == "__main__":
+    main()

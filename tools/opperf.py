@@ -1,0 +1,134 @@
+#!/usr/bin/env python
+"""opperf — per-operator micro-benchmark harness
+(reference: `benchmark/opperf/opperf.py` — runs every op with standard
+inputs and reports forward/backward latency).
+
+Measures the FRAMEWORK path (NDArray funnel → jit cache → device), not raw
+jax, so dispatch overhead is included — the number a user's eager code sees.
+
+Usage:
+    python tools/opperf.py                  # default op set, JSON to stdout
+    python tools/opperf.py --ops dot,relu --shape 1024,1024
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _ops_registry():
+    from incubator_mxnet_tpu import np, npx
+
+    def u(*shape):
+        return np.random.uniform(size=shape, low=-1.0, high=1.0)
+
+    # op name -> (fn, args-thunk); shapes chosen per reference opperf defaults
+    return {
+        "add": (lambda a, b: a + b, lambda s: (u(*s), u(*s))),
+        "mul": (lambda a, b: a * b, lambda s: (u(*s), u(*s))),
+        "dot": (np.dot, lambda s: (u(*s), u(*s))),
+        "exp": (np.exp, lambda s: (u(*s),)),
+        "log": (lambda x: np.log(np.abs(x) + 1e-3), lambda s: (u(*s),)),
+        "sum": (np.sum, lambda s: (u(*s),)),
+        "mean": (np.mean, lambda s: (u(*s),)),
+        "relu": (npx.relu, lambda s: (u(*s),)),
+        "sigmoid": (npx.sigmoid, lambda s: (u(*s),)),
+        "softmax": (npx.softmax, lambda s: (u(*s),)),
+        "fully_connected": (
+            lambda x, w, b: npx.fully_connected(x, w, b,
+                                                num_hidden=w.shape[0]),
+            lambda s: (u(*s), u(s[-1], s[-1]), u(s[-1]))),
+        "batch_norm": (
+            lambda x, g, b, m, v: npx.batch_norm(x, g, b, m, v),
+            lambda s: (u(*s), np.ones((s[1],)), np.zeros((s[1],)),
+                       np.zeros((s[1],)), np.ones((s[1],)))),
+        "transpose": (lambda x: x.T, lambda s: (u(*s),)),
+        "concat": (lambda a, b: np.concatenate([a, b]),
+                   lambda s: (u(*s), u(*s))),
+    }
+
+
+def benchmark_op(name, fn, args, warmup=5, runs=50, with_backward=True):
+    from incubator_mxnet_tpu import autograd
+    from incubator_mxnet_tpu.ndarray.ndarray import waitall
+
+    for a in args:
+        a.attach_grad()
+    # forward
+    for _ in range(warmup):
+        fn(*args)
+    waitall()
+    t0 = time.perf_counter()
+    for _ in range(runs):
+        fn(*args)
+    waitall()
+    fwd_ms = (time.perf_counter() - t0) / runs * 1e3
+
+    bwd_ms = None
+    if with_backward:
+        try:
+            for _ in range(warmup):
+                with autograd.record():
+                    out = fn(*args)
+                out.backward()
+            waitall()
+            t0 = time.perf_counter()
+            for _ in range(runs):
+                with autograd.record():
+                    out = fn(*args)
+                out.backward()
+            waitall()
+            total_ms = (time.perf_counter() - t0) / runs * 1e3
+            bwd_ms = max(total_ms - fwd_ms, 0.0)
+        except Exception:  # op has no grad path
+            bwd_ms = None
+    return {"op": name, "avg_fwd_ms": round(fwd_ms, 4),
+            "avg_bwd_ms": round(bwd_ms, 4) if bwd_ms is not None else None}
+
+
+def run_performance_test(ops=None, shape=(1024, 1024), warmup=5, runs=50):
+    """Benchmark `ops` (all by default) at `shape`; returns list of dicts
+    (reference: benchmark/opperf/opperf.py run_op_benchmarks)."""
+    registry = _ops_registry()
+    names = ops or list(registry)
+    results = []
+    for name in names:
+        if name not in registry:
+            raise ValueError(f"unknown op {name!r}; known: {sorted(registry)}")
+        fn, make_args = registry[name]
+        try:
+            args = make_args(tuple(shape))
+        except Exception as e:  # shape unsupported for this op
+            results.append({"op": name, "error": str(e)})
+            continue
+        results.append(benchmark_op(name, fn, args, warmup, runs))
+    return results
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--ops", default=None,
+                   help="comma-separated op names (default: all)")
+    p.add_argument("--shape", default="1024,1024")
+    p.add_argument("--runs", type=int, default=50)
+    p.add_argument("--warmup", type=int, default=5)
+    p.add_argument("--output", default=None, help="write JSON here")
+    args = p.parse_args()
+
+    shape = tuple(int(s) for s in args.shape.split(","))
+    ops = args.ops.split(",") if args.ops else None
+    results = run_performance_test(ops, shape, args.warmup, args.runs)
+    out = json.dumps({"shape": list(shape), "results": results}, indent=2)
+    if args.output:
+        with open(args.output, "w") as f:
+            f.write(out)
+    print(out)
+
+
+if __name__ == "__main__":
+    main()
