@@ -27,17 +27,68 @@ class _State(threading.local):
 
 _STATE = _State()
 
-# Op-name lists mirroring the reference's amp/lists/symbol_bf16.py roles
+# Op-name lists mirroring the reference's amp/lists/symbol_bf16.py roles.
+# Enforcement lives in the NDArray funnel (`ndarray.py apply_op`), so EVERY
+# listed op participates — eager, hybridized, cached — not just ops that
+# call a cast helper explicitly.
 TARGET_DTYPE_OPS = ["fully_connected", "convolution", "deconvolution",
-                    "batch_dot", "matmul", "dot", "rnn", "embedding"]
-FP32_OPS = ["softmax", "log_softmax", "masked_softmax", "layer_norm",
-            "batch_norm", "group_norm", "instance_norm", "l2_normalization",
-            "norm", "mean", "sum", "exp", "log", "erf", "gammaln"]
+                    "batch_dot", "matmul", "dot", "rnn", "embedding",
+                    "einsum", "tensordot", "inner", "vdot",
+                    "linalg_gemm2", "linalg_trmm", "linalg_syrk",
+                    "flash_attention", "interleaved_matmul_selfatt_qk",
+                    "interleaved_matmul_selfatt_valatt"]
+FP32_OPS = ["softmax", "log_softmax", "masked_softmax", "softmin",
+            "layer_norm", "batch_norm", "group_norm", "instance_norm",
+            "l2_normalization", "norm", "mean", "sum", "prod", "cumsum",
+            "exp", "expm1", "log", "log1p", "log2", "log10", "erf",
+            "erfinv", "gammaln", "power", "sqrt", "rsqrt", "cbrt",
+            "square", "var", "std", "ctc_loss", "smooth_l1", "softmax_cross_entropy",
+            "linalg.norm", "linalg.svd", "linalg.cholesky", "linalg.qr",
+            "linalg.inv", "linalg.det", "linalg.slogdet", "linalg.solve",
+            "linalg_potrf", "linalg_potri", "linalg_sumlogdiag"]
+
+_TARGET_SET = frozenset(TARGET_DTYPE_OPS)
+_FP32_SET = frozenset(FP32_OPS)
 
 
 class lists:
     TARGET_DTYPE_OPS = TARGET_DTYPE_OPS
     FP32_OPS = FP32_OPS
+
+
+def op_cast_mode(name):
+    """Funnel hook: returns None (no casting), ("target", dtype-name), or
+    ("fp32",) for the given op name under the current AMP state."""
+    if not _STATE.active:
+        return None
+    if name in _TARGET_SET:
+        return ("target", _STATE.dtype)
+    if name in _FP32_SET:
+        return ("fp32",)
+    return None
+
+
+def cast_vals(mode, vals):
+    """Apply an `op_cast_mode` result to a sequence of jax values. Runs
+    INSIDE the op's pure function so autograd sees the casts (cotangents
+    come back float32 through the convert_element_type vjp)."""
+    import jax.numpy as jnp
+
+    if mode[0] == "target":
+        dt = jnp.bfloat16 if mode[1] == "bfloat16" else jnp.float16
+        return [v.astype(dt)
+                if hasattr(v, "dtype") and v.dtype == jnp.float32 else v
+                for v in vals]
+    return [v.astype(jnp.float32)
+            if hasattr(v, "dtype") and v.dtype in (jnp.bfloat16, jnp.float16)
+            else v
+            for v in vals]
+
+
+def state_key():
+    """Hashable AMP state for op-call jit-cache keys (a compiled op bakes
+    its casts in, so toggling AMP must miss the cache)."""
+    return (_STATE.active, _STATE.dtype)
 
 
 def init(target_dtype="bfloat16"):
@@ -114,3 +165,74 @@ def convert_model(net, target_dtype="bfloat16"):
     (reference: amp.convert_model)."""
     net.cast(target_dtype)
     return net
+
+
+def convert_hybrid_block(net, target_dtype="bfloat16",
+                         cast_params_offline=True):
+    """Selective low-precision rewrite of a gluon net (reference:
+    `amp.convert_hybrid_block` over the C++ cast pass
+    `src/nnvm/low_precision_pass.cc`).
+
+    TPU-native: instead of inserting amp_cast graph nodes, matmul-class
+    layers' parameters (Dense/Conv/Embedding/RNN) are cast to the target
+    dtype while normalization layers (BatchNorm/LayerNorm/GroupNorm
+    /InstanceNorm) keep float32 params and running stats; inputs are cast
+    on entry and outputs restored to float32. XLA fuses the interleaved
+    casts. Returns a wrapper HybridBlock."""
+    if target_dtype not in ("bfloat16", "float16"):
+        raise ValueError("target_dtype must be bfloat16 or float16")
+    from ..gluon import nn, rnn
+    from ..gluon.block import Block, HybridBlock
+
+    low_types = (nn.Dense, nn.Embedding)
+    conv_types = tuple(t for t in (getattr(nn, n, None) for n in
+                       ("Conv1D", "Conv2D", "Conv3D", "Conv1DTranspose",
+                        "Conv2DTranspose", "Conv3DTranspose")) if t)
+    rnn_types = tuple(t for t in (getattr(rnn, n, None) for n in
+                      ("RNN", "LSTM", "GRU")) if t)
+    keep_types = tuple(t for t in (getattr(nn, n, None) for n in
+                       ("BatchNorm", "LayerNorm", "GroupNorm",
+                        "InstanceNorm")) if t)
+
+    def walk(block):
+        if isinstance(block, keep_types):
+            return
+        if isinstance(block, low_types + conv_types + rnn_types):
+            block.cast(target_dtype)
+            return
+        for child in block._children.values():
+            walk(child)
+
+    if cast_params_offline:
+        walk(net)
+
+    class _AMPWrapped(HybridBlock):
+        """With cast_params_offline=False the params stay float32 and the
+        funnel AMP lists cast operands at runtime inside each listed op
+        (the reference's online amp_cast mode)."""
+
+        def __init__(self, inner):
+            super().__init__()
+            self.net = inner
+
+        def forward(self, *args):
+            cast_args = [a.astype(target_dtype)
+                         if hasattr(a, "dtype") and str(a.dtype) == "float32"
+                         else a for a in args]
+            if cast_params_offline:
+                out = self.net(*cast_args)
+            else:
+                was_active, was_dtype = _STATE.active, _STATE.dtype
+                _STATE.active, _STATE.dtype = True, target_dtype
+                try:
+                    out = self.net(*cast_args)
+                finally:
+                    _STATE.active, _STATE.dtype = was_active, was_dtype
+            if isinstance(out, (list, tuple)):
+                return type(out)(o.astype("float32") for o in out)
+            return out.astype("float32")
+
+    wrapped = _AMPWrapped(net)
+    if isinstance(net, HybridBlock) and net._active:
+        wrapped.hybridize()
+    return wrapped

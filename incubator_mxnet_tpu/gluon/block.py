@@ -138,9 +138,25 @@ class Block:
     def load_parameters(self, filename, device=None, ctx=None,
                         allow_missing=False, ignore_extra=False,
                         cast_dtype=False, dtype_source="current"):  # noqa: ARG002
+        """Load parameters from npz (native) or the reference's binary
+        .params container (auto-detected; `ndarray/legacy_io.py`).
+        Reference checkpoints with `arg:`/`aux:` name prefixes load
+        transparently (reference: block.py:419)."""
         params = self.collect_params()
-        with onp.load(filename, allow_pickle=False) as z:
-            loaded = {k: z[k] for k in z.keys()}
+        from ..ndarray import legacy_io
+
+        if legacy_io.is_legacy_file(filename):
+            raw = legacy_io.load(filename)
+            if not isinstance(raw, dict):
+                raise ValueError(f"{filename} carries no parameter names")
+            loaded = {}
+            for k, v in raw.items():
+                if k.startswith(("arg:", "aux:")):
+                    k = k[4:]
+                loaded[k] = v.asnumpy()
+        else:
+            with onp.load(filename, allow_pickle=False) as z:
+                loaded = {k: z[k] for k in z.keys()}
         for name, p in params.items():
             if name in loaded:
                 p.set_data(loaded[name])

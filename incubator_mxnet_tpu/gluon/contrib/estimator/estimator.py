@@ -95,7 +95,13 @@ class Estimator:
         import copy
 
         if not self._val_metrics:
-            self._val_metrics = [type(m)() for m in self._train_metrics[:-1]]
+            # deep-copy (not type(m)()) so metric config — top_k, feval,
+            # thresholds — carries over to validation
+            self._val_metrics = [copy.deepcopy(m)
+                                 for m in self._train_metrics[:-1]]
+            for m in self._val_metrics:
+                m.name = m.name.removeprefix("training ")
+                m.reset()
         else:
             self._val_metrics = [copy.deepcopy(m) for m in self._val_metrics]
         for m in self._val_metrics:
@@ -185,7 +191,9 @@ class Estimator:
         while not self.stop_training:
             for handler in epoch_begin:
                 handler.epoch_begin(self)
+            n_batches = 0
             for batch in train_data:
+                n_batches += 1
                 for handler in batch_begin:
                     handler.batch_begin(self, batch=batch)
                 data, label, pred, loss = self.fit_batch(batch, batch_axis)
@@ -196,6 +204,10 @@ class Estimator:
                                       label=label, loss=loss)
                 if self.stop_training:
                     break
+            if n_batches == 0:
+                raise ValueError(
+                    "Estimator.fit: train_data yielded no batches "
+                    "(an empty loader would loop forever)")
             for handler in epoch_end:
                 handler.epoch_end(self)
 

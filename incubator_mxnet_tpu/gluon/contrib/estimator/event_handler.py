@@ -154,21 +154,20 @@ class LoggingHandler(TrainBegin, TrainEnd, EpochBegin, EpochEnd, BatchBegin,
                      BatchEnd):
     """Log training progress (reference: event_handler.py:226)."""
 
-    LOG_PER_EPOCH = 1
-    LOG_PER_BATCH = 2
-
     def __init__(self, log_interval="epoch", metrics=None, priority=-1000):
         self.metrics = metrics or []
         self.priority = priority
         self.batch_index = 0
         self.current_epoch = 0
         self.processed_samples = 0
+        # log_interval: "epoch" → epoch-level logs only; int N ≥ 1 → a log
+        # line every N batches (N=1 logs every batch)
         if log_interval == "epoch":
-            self.log_interval = self.LOG_PER_EPOCH
-        elif isinstance(log_interval, int):
+            self.log_interval = None
+        elif isinstance(log_interval, int) and log_interval >= 1:
             self.log_interval = log_interval
         else:
-            raise ValueError("log_interval must be 'epoch' or an int")
+            raise ValueError("log_interval must be 'epoch' or a positive int")
         self.log_interval_time = 0
 
     def train_begin(self, estimator, *args, **kwargs):
@@ -192,11 +191,11 @@ class LoggingHandler(TrainBegin, TrainEnd, EpochBegin, EpochEnd, BatchBegin,
         estimator.logger.info(msg.rstrip(", "))
 
     def batch_begin(self, estimator, *args, **kwargs):
-        if self.log_interval != self.LOG_PER_EPOCH:
+        if self.log_interval is not None:
             self.batch_start = time.time()
 
     def batch_end(self, estimator, *args, **kwargs):
-        if self.log_interval == self.LOG_PER_EPOCH:
+        if self.log_interval is None:
             return
         batch_time = time.time() - self.batch_start
         batch = kwargs["batch"]
@@ -215,20 +214,18 @@ class LoggingHandler(TrainBegin, TrainEnd, EpochBegin, EpochEnd, BatchBegin,
             estimator.logger.info(msg.rstrip(", "))
 
     def epoch_begin(self, estimator, *args, **kwargs):
-        if self.log_interval is not None:
-            self.epoch_start = time.time()
-            estimator.logger.info("[Epoch %d] Begin, current learning rate: "
-                                  "%.4f", self.current_epoch,
-                                  estimator.trainer.learning_rate)
+        self.epoch_start = time.time()
+        estimator.logger.info("[Epoch %d] Begin, current learning rate: "
+                              "%.4f", self.current_epoch,
+                              estimator.trainer.learning_rate)
 
     def epoch_end(self, estimator, *args, **kwargs):
-        if self.log_interval is not None:
-            epoch_time = time.time() - self.epoch_start
-            msg = f"[Epoch {self.current_epoch}] Finished in {epoch_time:.3f}s, "
-            for m in self.metrics:
-                name, value = m.get()
-                msg += f"{name}: {_fmt(value)}, "
-            estimator.logger.info(msg.rstrip(", "))
+        epoch_time = time.time() - self.epoch_start
+        msg = f"[Epoch {self.current_epoch}] Finished in {epoch_time:.3f}s, "
+        for m in self.metrics:
+            name, value = m.get()
+            msg += f"{name}: {_fmt(value)}, "
+        estimator.logger.info(msg.rstrip(", "))
         self.current_epoch += 1
         self.batch_index = 0
 
@@ -275,6 +272,42 @@ class CheckpointHandler(TrainBegin, BatchEnd, EpochEnd):
         self.current_batch = 0
         self.current_epoch = 0
         os.makedirs(self.model_dir, exist_ok=True)
+        if self.resume_from_checkpoint:
+            self._resume_from_checkpoint(estimator)
+
+    def _resume_from_checkpoint(self, estimator):
+        """Reload the newest matching checkpoint's params (+trainer states)
+        and continue the epoch/batch counters from it
+        (reference: event_handler.py:542)."""
+        import re
+
+        pat = re.compile(
+            rf"^{re.escape(self.model_prefix)}-epoch(\d+)batch(\d+)\.params$")
+        best = None
+        for f in os.listdir(self.model_dir):
+            m = pat.match(f)
+            if m:
+                key = (int(m.group(1)), int(m.group(2)))
+                if best is None or key > best[0]:
+                    best = (key, f)
+        if best is None:
+            estimator.logger.info(
+                "CheckpointHandler: no checkpoint found in %s to resume from",
+                self.model_dir)
+            return
+        (epoch, batch), fname = best
+        estimator.net.load_parameters(os.path.join(self.model_dir, fname))
+        states = os.path.join(self.model_dir, fname[:-7] + ".states")
+        if estimator.trainer is not None and os.path.exists(states):
+            estimator.trainer.load_states(states)
+        self.current_epoch = epoch
+        self.current_batch = batch
+        prefix = fname[:-7]
+        if prefix not in self.saved_checkpoints:
+            self.saved_checkpoints.append(prefix)
+        estimator.logger.info(
+            "CheckpointHandler: resumed from %s (epoch %d, batch %d)",
+            fname, epoch, batch)
 
     def batch_end(self, estimator, *args, **kwargs):
         self.current_batch += 1

@@ -143,6 +143,11 @@ class TopKAccuracy(EvalMetric):
 def _binarize(pred, threshold=0.5):
     pred = _to_numpy(pred)
     if pred.ndim > 1 and pred.shape[-1] > 1:
+        if pred.shape[-1] > 2:
+            raise ValueError(
+                "F1/Fbeta/BinaryAccuracy currently only support binary "
+                "classification (got predictions over "
+                f"{pred.shape[-1]} classes)")
         return pred.argmax(axis=-1).ravel()
     return (pred.ravel() > threshold).astype("int32")
 

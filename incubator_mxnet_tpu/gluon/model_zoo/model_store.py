@@ -17,10 +17,10 @@ import os
 __all__ = ["get_model_file", "purge", "data_dir", "register_sha1",
            "export_to_store", "short_hash"]
 
-# name -> sha1 of the .params payload; populated from the registry file and
-# `register_sha1`. (The reference ships a hardcoded table for its S3 assets;
-# local-first stores persist theirs next to the cache.)
-_model_sha1: dict[str, str] = {}
+# Each store root carries its own registry.json mapping name -> sha1 of the
+# .params payload. Registries are root-scoped on disk AND in use — a sha
+# registered in one root must not constrain lookups in another. (The
+# reference ships a hardcoded table for its S3 assets.)
 
 
 def data_dir():
@@ -33,23 +33,26 @@ def _registry_path(root):
     return os.path.join(root, "registry.json")
 
 
-def _load_registry(root):
+def _load_registry(root) -> dict:
     path = _registry_path(root)
     if os.path.exists(path):
         with open(path) as f:
-            _model_sha1.update(json.load(f))
+            return json.load(f)
+    return {}
 
 
-def _save_registry(root):
+def _save_registry(root, registry):
     os.makedirs(root, exist_ok=True)
     with open(_registry_path(root), "w") as f:
-        json.dump(_model_sha1, f, indent=2, sort_keys=True)
+        json.dump(registry, f, indent=2, sort_keys=True)
 
 
-def short_hash(name):
-    if name not in _model_sha1:
-        raise ValueError(f"pretrained model for {name} is not available")
-    return _model_sha1[name][:8]
+def short_hash(name, root=None):
+    for r in _search_roots(root):
+        reg = _load_registry(r)
+        if name in reg:
+            return reg[name][:8]
+    raise ValueError(f"pretrained model for {name} is not available")
 
 
 def _sha1(path):
@@ -71,17 +74,18 @@ def _search_roots(root=None):
 def get_model_file(name, root=None):
     """Locate (and checksum-verify) `<name>.params` in the local store
     (reference: model_store.py:75 downloads+verifies; here: local-only,
-    no egress)."""
+    no egress). Checksums apply per root: a file is verified only against
+    the registry of the root it was found in."""
     for r in _search_roots(root):
-        _load_registry(r)
-        for fname in (f"{name}-{short_hash(name)}.params"
-                      if name in _model_sha1 else None,
-                      f"{name}.params"):
-            if fname is None:
-                continue
+        reg = _load_registry(r)
+        want = reg.get(name)
+        candidates = []
+        if want:
+            candidates.append(f"{name}-{want[:8]}.params")
+        candidates.append(f"{name}.params")
+        for fname in candidates:
             path = os.path.join(r, fname)
             if os.path.exists(path):
-                want = _model_sha1.get(name)
                 if want and _sha1(path) != want:
                     raise ValueError(
                         f"checksum mismatch for {path}; delete the file and "
@@ -94,11 +98,11 @@ def get_model_file(name, root=None):
 
 
 def register_sha1(name, sha1_hash, root=None):
-    """Register a checksum for `name` (persisted in the cache registry)."""
+    """Register a checksum for `name` in `root`'s registry."""
     root = root or os.path.join(data_dir(), "models")
-    _load_registry(root)
-    _model_sha1[name] = sha1_hash
-    _save_registry(root)
+    registry = _load_registry(root)
+    registry[name] = sha1_hash
+    _save_registry(root, registry)
 
 
 def export_to_store(net, name, root=None):

@@ -93,8 +93,7 @@ def get_mobilenet(multiplier, pretrained=False, **kwargs):
     store_kw, kwargs = _split_store_kwargs(kwargs)
     net = MobileNet(multiplier, **kwargs)
     if pretrained:
-        version_suffix = f"{multiplier:.2f}".rstrip("0").rstrip(".")
-        _load_pretrained(net, f"mobilenet{version_suffix}", store_kw)
+        _load_pretrained(net, f"mobilenet{float(multiplier)}", store_kw)
     return net
 
 
@@ -104,8 +103,7 @@ def get_mobilenet_v2(multiplier, pretrained=False, **kwargs):
     store_kw, kwargs = _split_store_kwargs(kwargs)
     net = MobileNetV2(multiplier, **kwargs)
     if pretrained:
-        version_suffix = f"{multiplier:.2f}".rstrip("0").rstrip(".")
-        _load_pretrained(net, f"mobilenetv2_{version_suffix}", store_kw)
+        _load_pretrained(net, f"mobilenetv2_{float(multiplier)}", store_kw)
     return net
 
 

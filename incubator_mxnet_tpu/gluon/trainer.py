@@ -15,6 +15,7 @@ __all__ = ["Trainer"]
 class Trainer:
     def __init__(self, params, optimizer, optimizer_params=None, kvstore="device",
                  compression_params=None, update_on_kvstore=None):  # noqa: ARG002
+        self._compression_params = compression_params
         if isinstance(params, (dict,)):
             param_dict = dict(params)
         else:
@@ -50,6 +51,12 @@ class Trainer:
             self._kvstore = kv_mod.create(self._kvstore_type)
         else:
             self._kvstore = self._kvstore_type
+        if self._kvstore is not None and self._compression_params:
+            if not hasattr(self._kvstore, "set_gradient_compression"):
+                raise ValueError(
+                    f"kvstore {type(self._kvstore).__name__} does not "
+                    "support gradient compression")
+            self._kvstore.set_gradient_compression(self._compression_params)
         self._kv_initialized = True
 
     @property

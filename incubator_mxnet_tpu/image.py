@@ -172,15 +172,15 @@ def copyMakeBorder(src, top, bot, left, right, _type=0, values=0):  # noqa: N802
     return NDArray(out)
 
 
-def random_size_crop(src, size, area, ratio, interp=1, **kwargs):  # noqa: ARG001
-    """Random crop of random area/aspect-ratio, resized to `size`
-    (reference: image.py:563)."""
+def _sample_size_crop_rect(h, w, area, ratio):
+    """Sample (x0, y0, new_w, new_h) for a random area/aspect-ratio crop, or
+    None after 10 failed attempts (reference: image.py:563 retry loop).
+    Single source of truth for `random_size_crop` and RandomSizedCropAug."""
     import random as pyrandom
 
-    h, w = src.shape[0], src.shape[1]
-    src_area = h * w
     if isinstance(area, (int, float)):
         area = (area, 1.0)
+    src_area = h * w
     for _ in range(10):
         target_area = pyrandom.uniform(area[0], area[1]) * src_area
         log_ratio = (onp.log(ratio[0]), onp.log(ratio[1]))
@@ -190,9 +190,19 @@ def random_size_crop(src, size, area, ratio, interp=1, **kwargs):  # noqa: ARG00
         if new_w <= w and new_h <= h:
             x0 = pyrandom.randint(0, w - new_w)
             y0 = pyrandom.randint(0, h - new_h)
-            out = fixed_crop(src, x0, y0, new_w, new_h, size, interp)
-            return out, (x0, y0, new_w, new_h)
-    return center_crop(src, size, interp)
+            return x0, y0, new_w, new_h
+    return None
+
+
+def random_size_crop(src, size, area, ratio, interp=1, **kwargs):  # noqa: ARG001
+    """Random crop of random area/aspect-ratio, resized to `size`
+    (reference: image.py:563)."""
+    rect = _sample_size_crop_rect(src.shape[0], src.shape[1], area, ratio)
+    if rect is None:
+        return center_crop(src, size, interp)
+    x0, y0, new_w, new_h = rect
+    out = fixed_crop(src, x0, y0, new_w, new_h, size, interp)
+    return out, rect
 
 
 # -- augmenters (reference: image.py:761-1170) --------------------------------
@@ -297,24 +307,13 @@ class RandomSizedCropAug(Augmenter):
         self.interp = interp
 
     def apply_np(self, src):
-        import random as pyrandom
-
-        h, w = src.shape[:2]
-        area = self.area
-        if isinstance(area, (int, float)):
-            area = (area, 1.0)
-        for _ in range(10):
-            target_area = pyrandom.uniform(area[0], area[1]) * h * w
-            log_ratio = (onp.log(self.ratio[0]), onp.log(self.ratio[1]))
-            new_ratio = onp.exp(pyrandom.uniform(*log_ratio))
-            new_w = int(round(onp.sqrt(target_area * new_ratio)))
-            new_h = int(round(onp.sqrt(target_area / new_ratio)))
-            if new_w <= w and new_h <= h:
-                x0 = pyrandom.randint(0, w - new_w)
-                y0 = pyrandom.randint(0, h - new_h)
-                return _resize_np(src[y0:y0 + new_h, x0:x0 + new_w],
-                                  self.size[0], self.size[1])
-        return CenterCropAug(self.size, self.interp).apply_np(src)
+        rect = _sample_size_crop_rect(src.shape[0], src.shape[1],
+                                      self.area, self.ratio)
+        if rect is None:
+            return CenterCropAug(self.size, self.interp).apply_np(src)
+        x0, y0, new_w, new_h = rect
+        return _resize_np(src[y0:y0 + new_h, x0:x0 + new_w],
+                          self.size[0], self.size[1])
 
 
 class CenterCropAug(Augmenter):
@@ -668,6 +667,19 @@ class ImageIter:
         self._pending.clear()
         self._aug_pool.shutdown(wait=False)
         self._builder.shutdown(wait=False)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:  # interpreter teardown
+            pass
 
     def reset(self):
         for f in self._pending:

@@ -28,6 +28,23 @@ class _SingleProcessStore(KVStoreBase):
         self._store: dict = {}
         self._updater = None
         self._optimizer = None
+        self._compression = None
+
+    def set_gradient_compression(self, compression_params):
+        """Enable gradient compression on the push leg (reference:
+        kvstore.py set_gradient_compression → gradient_compression.cc)."""
+        from . import compression
+
+        self._compression = compression.create(compression_params)
+
+    def _maybe_compress(self, key, value):
+        if self._compression is None or not isinstance(value, NDArray):
+            return value
+        from ..ndarray.sparse import RowSparseNDArray
+
+        if isinstance(value, RowSparseNDArray):
+            return value  # reference: sparse grads are never compressed
+        return self._compression.compress(key, value)
 
     # -- legacy init/push/pull ---------------------------------------------
     def init(self, key, value):
@@ -44,6 +61,7 @@ class _SingleProcessStore(KVStoreBase):
             agg = vs[0]
             for extra in vs[1:]:
                 agg = agg + extra
+            agg = self._maybe_compress(k, agg)
             agg = self._reduce(agg)
             if self._updater is not None and k in self._store:
                 self._updater(k, agg, self._store[k])
@@ -81,6 +99,7 @@ class _SingleProcessStore(KVStoreBase):
             agg = vs[0]
             for extra in vs[1:]:
                 agg = agg + extra
+            agg = self._maybe_compress(k, agg)
             red = self._reduce(agg)
             targets = o if isinstance(o, (list, tuple)) else [o]
             for t in targets:

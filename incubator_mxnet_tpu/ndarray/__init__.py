@@ -76,15 +76,19 @@ def _save_entries(payload, key, d):
         payload[key] = d.asnumpy()
 
 
-def save(fname, data):
-    """Save NDArrays (dense, row_sparse, csr) to the `.params`-style
-    container.
+def save(fname, data, format="npz"):  # noqa: A002
+    """Save NDArrays (dense, row_sparse, csr).
 
-    Reference format: `src/ndarray/ndarray.cc` Save/Load (magic + dense
-    AND sparse chunks). The TPU build uses a numpy `.npz`-based container
-    with a name-manifest and per-stype component entries, readable by
-    `nd.load`; `.npy`/`.npz` parity matches `src/serialization/cnpy.cc`.
+    `format="npz"` (default): numpy `.npz` container with a name-manifest
+    and per-stype component entries (`.npy`/`.npz` parity matches
+    `src/serialization/cnpy.cc`). `format="legacy"`: the reference's binary
+    container (`src/ndarray/ndarray.cc:2136`), readable by reference
+    builds — see `ndarray/legacy_io.py`. `nd.load` auto-detects both.
     """
+    if format == "legacy":
+        from . import legacy_io
+
+        return legacy_io.save(fname, data)
     import numpy as onp
 
     if isinstance(data, NDArray):
@@ -108,8 +112,11 @@ def save(fname, data):
 def load(fname):
     import numpy as onp
 
+    from . import legacy_io
     from .sparse import CSRNDArray, RowSparseNDArray
 
+    if legacy_io.is_legacy_file(fname):
+        return legacy_io.load(fname)
     with onp.load(fname, allow_pickle=False) as z:
         entries: dict = {}
         for k in z.keys():

@@ -1,0 +1,271 @@
+"""Reference-binary `.params`/`.ndarray` serialization.
+
+Byte-compatible reimplementation of the reference's NDArray container
+format (`src/ndarray/ndarray.cc:1862-2155`):
+
+    file   := uint64 0x112 | uint64 0 | vec<blob> data | vec<string> names
+    vec<T> := uint64 count | T...                (dmlc::Stream convention)
+    string := uint64 length | bytes
+    blob   := uint32 magic (V3 0xF993faca np-shape / V2 0xF993fac9)
+            | int32 stype (0 dense, 1 row_sparse, 2 csr)
+            | [storage_shape: tshape]            (sparse only)
+            | shape: tshape
+            | int32 dev_type=1 (cpu) | int32 dev_id=0
+            | int32 type_flag (mshadow enum)
+            | [per-aux: int32 aux_type | tshape aux_shape]  (sparse only)
+            | raw row-major data bytes
+            | [raw aux data bytes...]            (sparse only)
+    tshape := int32 ndim | int64[ndim]
+
+Checkpoints written by the reference load here and vice versa. The native
+container remains npz (`ndarray/__init__.py` save/load); this module is the
+migration path.
+"""
+from __future__ import annotations
+
+import struct
+
+import numpy as onp
+
+NDARRAY_FILE_MAGIC = 0x112
+NDARRAY_V2_MAGIC = 0xF993FAC9
+NDARRAY_V3_MAGIC = 0xF993FACA
+
+# mshadow type flags (3rdparty/mshadow/mshadow/base.h:352)
+_FLAG_TO_DTYPE = {
+    0: "float32", 1: "float64", 2: "float16", 3: "uint8", 4: "int32",
+    5: "int8", 6: "int64", 7: "bool", 8: "int16", 9: "uint16",
+    10: "uint32", 11: "uint64", 12: "bfloat16",
+}
+_DTYPE_TO_FLAG = {v: k for k, v in _FLAG_TO_DTYPE.items()}
+
+
+def _np_dtype(name):
+    if name == "bfloat16":
+        import ml_dtypes
+
+        return onp.dtype(ml_dtypes.bfloat16)
+    return onp.dtype(name)
+
+
+class _Writer:
+    def __init__(self):
+        self.parts: list[bytes] = []
+
+    def u32(self, v):
+        self.parts.append(struct.pack("<I", v))
+
+    def i32(self, v):
+        self.parts.append(struct.pack("<i", v))
+
+    def u64(self, v):
+        self.parts.append(struct.pack("<Q", v))
+
+    def raw(self, b):
+        self.parts.append(bytes(b))
+
+    def tshape(self, shape):
+        self.i32(len(shape))
+        for d in shape:
+            self.parts.append(struct.pack("<q", int(d)))
+
+    def string(self, s):
+        b = s.encode("utf-8")
+        self.u64(len(b))
+        self.raw(b)
+
+    def getvalue(self):
+        return b"".join(self.parts)
+
+
+class _Reader:
+    def __init__(self, data):
+        self.data = data
+        self.pos = 0
+
+    def _take(self, n):
+        if self.pos + n > len(self.data):
+            raise ValueError("truncated NDArray file")
+        out = self.data[self.pos:self.pos + n]
+        self.pos += n
+        return out
+
+    def u32(self):
+        return struct.unpack("<I", self._take(4))[0]
+
+    def i32(self):
+        return struct.unpack("<i", self._take(4))[0]
+
+    def u64(self):
+        return struct.unpack("<Q", self._take(8))[0]
+
+    def tshape(self):
+        ndim = self.i32()
+        if ndim < 0:
+            return None
+        return tuple(struct.unpack(f"<{ndim}q", self._take(8 * ndim)))
+
+    def string(self):
+        return self._take(self.u64()).decode("utf-8")
+
+
+def _write_dense_blob(w: _Writer, arr: onp.ndarray):
+    w.u32(NDARRAY_V3_MAGIC)
+    w.i32(0)  # kDefaultStorage
+    w.tshape(arr.shape)
+    w.i32(1)  # dev_type cpu
+    w.i32(0)  # dev_id
+    name = str(arr.dtype)
+    if name not in _DTYPE_TO_FLAG:
+        raise ValueError(f"dtype {name} has no reference type flag")
+    w.i32(_DTYPE_TO_FLAG[name])
+    w.raw(onp.ascontiguousarray(arr).tobytes())
+
+
+def _write_row_sparse_blob(w: _Writer, values, indices, shape):
+    w.u32(NDARRAY_V2_MAGIC)  # sparse disallowed under np-shape semantics
+    w.i32(1)  # kRowSparseStorage
+    w.tshape(values.shape)  # storage shape
+    w.tshape(shape)
+    w.i32(1)
+    w.i32(0)
+    w.i32(_DTYPE_TO_FLAG[str(values.dtype)])
+    # one aux: indices (int64 in the reference)
+    idx = onp.asarray(indices, onp.int64)
+    w.i32(_DTYPE_TO_FLAG["int64"])
+    w.tshape(idx.shape)
+    w.raw(onp.ascontiguousarray(values).tobytes())
+    w.raw(idx.tobytes())
+
+
+def _write_csr_blob(w: _Writer, data, col_indices, indptr, shape):
+    w.u32(NDARRAY_V2_MAGIC)
+    w.i32(2)  # kCSRStorage
+    w.tshape(data.shape)
+    w.tshape(shape)
+    w.i32(1)
+    w.i32(0)
+    w.i32(_DTYPE_TO_FLAG[str(data.dtype)])
+    # aux order (reference csr): indptr then indices, both int64
+    indptr = onp.asarray(indptr, onp.int64)
+    cols = onp.asarray(col_indices, onp.int64)
+    w.i32(_DTYPE_TO_FLAG["int64"])
+    w.tshape(indptr.shape)
+    w.i32(_DTYPE_TO_FLAG["int64"])
+    w.tshape(cols.shape)
+    w.raw(onp.ascontiguousarray(data).tobytes())
+    w.raw(indptr.tobytes())
+    w.raw(cols.tobytes())
+
+
+def _read_blob(r: _Reader):
+    from .ndarray import NDArray
+    from .sparse import CSRNDArray, RowSparseNDArray
+
+    magic = r.u32()
+    if magic not in (NDARRAY_V2_MAGIC, NDARRAY_V3_MAGIC):
+        raise ValueError(f"unsupported NDArray blob magic {magic:#x} "
+                         "(V1/legacy formats not implemented)")
+    stype = r.i32()
+    storage_shape = None
+    n_aux = {0: 0, 1: 1, 2: 2}.get(stype)
+    if n_aux is None:
+        raise ValueError(f"unknown storage type {stype}")
+    if n_aux > 0:
+        storage_shape = r.tshape()
+    shape = r.tshape()
+    if shape is None:
+        return NDArray(onp.zeros((0,), onp.float32))
+    r.i32()  # dev_type
+    r.i32()  # dev_id
+    dtype = _np_dtype(_FLAG_TO_DTYPE[r.i32()])
+    aux = []
+    for _ in range(n_aux):
+        aux_dtype = _np_dtype(_FLAG_TO_DTYPE[r.i32()])
+        aux_shape = r.tshape()
+        aux.append((aux_dtype, aux_shape))
+    data_shape = storage_shape if n_aux > 0 else shape
+    count = int(onp.prod(data_shape)) if data_shape else 1
+    data = onp.frombuffer(r._take(count * dtype.itemsize),
+                          dtype=dtype).reshape(data_shape).copy()
+    aux_arrays = []
+    for aux_dtype, aux_shape in aux:
+        n = int(onp.prod(aux_shape)) if aux_shape else 1
+        aux_arrays.append(onp.frombuffer(
+            r._take(n * aux_dtype.itemsize),
+            dtype=aux_dtype).reshape(aux_shape).copy())
+    if stype == 0:
+        return NDArray(data)
+    if stype == 1:
+        return RowSparseNDArray(data, aux_arrays[0].astype(onp.int32), shape)
+    indptr, cols = aux_arrays
+    return CSRNDArray(data, cols.astype(onp.int32),
+                      indptr.astype(onp.int32), shape)
+
+
+def save(fname, data):
+    """Write arrays in the reference binary container
+    (`src/ndarray/ndarray.cc:2136 NDArray::Save`)."""
+    from .ndarray import NDArray
+    from .sparse import CSRNDArray, RowSparseNDArray
+
+    if isinstance(data, NDArray):
+        data = [data]
+    if isinstance(data, dict):
+        names = list(data.keys())
+        arrays = [data[k] for k in names]
+    else:
+        names = []
+        arrays = list(data)
+
+    w = _Writer()
+    w.u64(NDARRAY_FILE_MAGIC)
+    w.u64(0)
+    w.u64(len(arrays))
+    for a in arrays:
+        if isinstance(a, RowSparseNDArray):
+            u, v = a._canonical()
+            _write_row_sparse_blob(w, onp.asarray(v), onp.asarray(u), a.shape)
+        elif isinstance(a, CSRNDArray):
+            a._sp_refresh()
+            _write_csr_blob(w, onp.asarray(a._sp_data),
+                            onp.asarray(a._sp_col_indices),
+                            onp.asarray(a._sp_indptr), a.shape)
+        elif isinstance(a, NDArray):
+            _write_dense_blob(w, a.asnumpy())
+        else:
+            _write_dense_blob(w, onp.asarray(a))
+    w.u64(len(names))
+    for n in names:
+        w.string(n)
+    with open(fname, "wb") as f:
+        f.write(w.getvalue())
+
+
+def load(fname):
+    """Load a reference binary container
+    (`src/ndarray/ndarray.cc:2146 NDArray::Load`). Returns a dict when the
+    file carries names, else a list."""
+    with open(fname, "rb") as f:
+        r = _Reader(f.read())
+    if r.u64() != NDARRAY_FILE_MAGIC:
+        raise ValueError(f"{fname} is not a reference NDArray file")
+    r.u64()  # reserved
+    arrays = [_read_blob(r) for _ in range(r.u64())]
+    n_names = r.u64()
+    if n_names == 0:
+        return arrays
+    if n_names != len(arrays):
+        raise ValueError("corrupt NDArray file: name/array count mismatch")
+    names = [r.string() for _ in range(n_names)]
+    return dict(zip(names, arrays))
+
+
+def is_legacy_file(fname):
+    try:
+        with open(fname, "rb") as f:
+            head = f.read(8)
+        return len(head) == 8 and struct.unpack("<Q", head)[0] == \
+            NDARRAY_FILE_MAGIC
+    except OSError:
+        return False

@@ -572,6 +572,21 @@ def _active_profiler():
     return None
 
 
+def _amp_mode(name):
+    """AMP participation for op `name` (None when AMP is off). Funnel-level
+    so every listed op participates (reference: low_precision_pass.cc cast
+    insertion; here the cast happens inside each op's pure function)."""
+    from .. import amp as amp_mod
+
+    return amp_mod.op_cast_mode(name)
+
+
+def _amp_cast(mode, tvals):
+    from .. import amp as amp_mod
+
+    return amp_mod.cast_vals(mode, tvals)
+
+
 def _call_profiled(name, pure_fn, tensor_vals):
     """Run the funnel body, feeding `profiler.record_op` when profiling."""
     prof = _active_profiler()
@@ -601,8 +616,11 @@ def apply_op(name, jfn, args, kwargs=None, n_outputs=1, out=None):
     parents = [args[i] for i in tensor_idx]
     tensor_vals = [p._data for p in parents]
     static_args = [None if isinstance(a, NDArray) else a for a in args]
+    amp_mode = _amp_mode(name)
 
     def pure_fn(*tvals):
+        if amp_mode is not None:
+            tvals = _amp_cast(amp_mode, tvals)
         call = list(static_args)
         for j, i in enumerate(tensor_idx):
             call[i] = tvals[j]
@@ -677,7 +695,10 @@ def _cached_jit(name, jfn, args, kwargs, pure_fn, call_vals):
     import jax
 
     try:
-        key = (jfn, tuple(_static_marker(a) for a in args),
+        from .. import amp as amp_mod
+
+        key = (jfn, amp_mod.state_key(),
+               tuple(_static_marker(a) for a in args),
                tuple((k, _static_marker(v)) for k, v in
                      sorted(kwargs.items())))
         jitted = _JIT_CACHE.get(key)
@@ -740,7 +761,11 @@ def apply_op_flat(name, jfn, args, kwargs=None, n_outputs=None,
                          if isinstance(a, (list, tuple)) else a)
                    for a in args]
 
+    amp_mode = _amp_mode(name)
+
     def pure_fn(*tvals):
+        if amp_mode is not None:
+            tvals = _amp_cast(amp_mode, tvals)
         call = [list(a) if isinstance(a, list) else a for a in args_static]
         for path, v in zip(paths, tvals):
             if len(path) == 1:

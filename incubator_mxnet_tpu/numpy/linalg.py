@@ -1,14 +1,29 @@
-"""`mx.np.linalg` over jax.numpy.linalg (reference: `src/operator/numpy/linalg/`,
-`python/mxnet/numpy/linalg.py`). LAPACK/cuSolver kernels are replaced by
-XLA's native decompositions, which map QR/SVD/Cholesky onto the MXU."""
+"""`mx.np.linalg` + the reference's `linalg_*` operator family.
+
+Reference: `python/mxnet/numpy/linalg.py` (numpy-interface wrappers) and
+`src/operator/tensor/la_op.cc` (gemm2/potrf/potri/trsm/trmm/syrk/gelqf/
+sumlogdiag/extractdiag/maketrian — LAPACK/cuSolver kernels). TPU-native:
+XLA's native decompositions run the factorizations; triangular solves map
+to `jax.scipy.linalg.solve_triangular`; all ops flow through the NDArray
+funnel so autograd/vjp (provided by jax) applies end-to-end.
+"""
 from __future__ import annotations
 
 from ..ndarray.ndarray import apply_op_flat
 
-_NAMES = [
-    "norm", "svd", "cholesky", "qr", "inv", "pinv", "det", "slogdet", "solve",
-    "lstsq", "eig", "eigh", "eigvals", "eigvalsh", "matrix_rank", "matrix_power",
-    "multi_dot", "tensorinv", "tensorsolve", "cond",
+__all__ = [
+    "norm", "svd", "cholesky", "qr", "inv", "pinv", "det", "slogdet",
+    "solve", "lstsq", "eig", "eigh", "eigvals", "eigvalsh", "matrix_rank",
+    "matrix_power", "multi_dot", "tensorinv", "tensorsolve", "cond",
+    "gemm2", "potrf", "potri", "trsm", "trmm", "syrk", "gelqf",
+    "sumlogdiag", "extractdiag", "makediag", "extracttrian", "maketrian",
+    "inverse",
+]
+
+_JNP_NAMES = [
+    "norm", "svd", "cholesky", "qr", "inv", "pinv", "det", "slogdet",
+    "solve", "lstsq", "eig", "eigh", "eigvals", "eigvalsh", "matrix_rank",
+    "matrix_power", "multi_dot", "tensorinv", "tensorsolve", "cond",
 ]
 
 
@@ -18,14 +33,221 @@ def _make(name):
 
         from ..ndarray.ndarray import NDArray
 
+        jfn = getattr(jnp.linalg, name)
+
+        def fn(*a, **k):
+            res = jfn(*a, **k)
+            # jnp.linalg returns NamedTuples (SlogdetResult, EighResult…);
+            # normalize to plain tuples so the vjp output tree matches
+            if isinstance(res, tuple) and type(res) is not tuple:
+                return tuple(res)
+            return res
+
         kwargs = {k: (v._data if isinstance(v, NDArray) else v)
                   for k, v in kwargs.items()}
-        return apply_op_flat(f"linalg.{name}", getattr(jnp.linalg, name), args, kwargs)
+        return apply_op_flat(f"linalg.{name}", fn, args, kwargs)
 
     op.__name__ = name
     return op
 
 
-for _n in _NAMES:
+for _n in _JNP_NAMES:
     globals()[_n] = _make(_n)
 del _n
+
+
+# -- reference linalg_* op family (la_op.cc) ---------------------------------
+
+def gemm2(A, B, transpose_a=False, transpose_b=False, alpha=1.0, axis=-2):
+    """alpha * op(A) @ op(B) (reference: la_op.cc linalg_gemm2). `axis`
+    names the axis holding the matrix rows (reference semantics); matrices
+    live on (axis, axis+1) and are moved to the trailing two dims."""
+    def fn(a, b):
+        import jax.numpy as jnp
+
+        if axis != -2:
+            a = jnp.moveaxis(a, (axis, axis + 1), (-2, -1))
+            b = jnp.moveaxis(b, (axis, axis + 1), (-2, -1))
+        if transpose_a:
+            a = jnp.swapaxes(a, -1, -2)
+        if transpose_b:
+            b = jnp.swapaxes(b, -1, -2)
+        out = alpha * jnp.matmul(a, b)
+        if axis != -2:
+            out = jnp.moveaxis(out, (-2, -1), (axis, axis + 1))
+        return out
+
+    return apply_op_flat("linalg_gemm2", fn, (A, B), {})
+
+
+def potrf(A, lower=True):
+    """Cholesky factor (reference: la_op.cc linalg_potrf)."""
+    def fn(a):
+        import jax.numpy as jnp
+
+        chol = jnp.linalg.cholesky(a)
+        return chol if lower else jnp.swapaxes(chol, -1, -2)
+
+    return apply_op_flat("linalg_potrf", fn, (A,), {})
+
+
+def potri(L, lower=True):
+    """Inverse of A from its Cholesky factor L: inv(L L^T)
+    (reference: la_op.cc linalg_potri)."""
+    def fn(l):
+        import jax.numpy as jnp
+        import jax.scipy.linalg as jsl
+
+        fac = l if lower else jnp.swapaxes(l, -1, -2)
+        eye = jnp.broadcast_to(jnp.eye(fac.shape[-1], dtype=fac.dtype),
+                               fac.shape)
+        linv = jsl.solve_triangular(fac, eye, lower=True)
+        return jnp.matmul(jnp.swapaxes(linv, -1, -2), linv)
+
+    return apply_op_flat("linalg_potri", fn, (L,), {})
+
+
+def trsm(A, B, transpose=False, rightside=False, lower=True, alpha=1.0):
+    """Solve op(A) X = alpha B (or X op(A) = alpha B)
+    (reference: la_op.cc linalg_trsm)."""
+    def fn(a, b):
+        import jax.numpy as jnp
+        import jax.scipy.linalg as jsl
+
+        rhs = alpha * b
+        if rightside:
+            # X op(A) = rhs  ⇔  op(A)^T X^T = rhs^T
+            x_t = jsl.solve_triangular(
+                jnp.swapaxes(a, -1, -2), jnp.swapaxes(rhs, -1, -2),
+                lower=not lower, trans=1 if transpose else 0)
+            return jnp.swapaxes(x_t, -1, -2)
+        return jsl.solve_triangular(a, rhs, lower=lower,
+                                    trans=1 if transpose else 0)
+
+    return apply_op_flat("linalg_trsm", fn, (A, B), {})
+
+
+def trmm(A, B, transpose=False, rightside=False, lower=True, alpha=1.0):
+    """Triangular matrix multiply alpha op(A) B (or alpha B op(A))
+    (reference: la_op.cc linalg_trmm)."""
+    def fn(a, b):
+        import jax.numpy as jnp
+
+        tri = jnp.tril(a) if lower else jnp.triu(a)
+        if transpose:
+            tri = jnp.swapaxes(tri, -1, -2)
+        out = (jnp.matmul(b, tri) if rightside else jnp.matmul(tri, b))
+        return alpha * out
+
+    return apply_op_flat("linalg_trmm", fn, (A, B), {})
+
+
+def syrk(A, transpose=False, alpha=1.0):
+    """Symmetric rank-k: alpha A A^T (or alpha A^T A)
+    (reference: la_op.cc linalg_syrk)."""
+    def fn(a):
+        import jax.numpy as jnp
+
+        at = jnp.swapaxes(a, -1, -2)
+        return alpha * (jnp.matmul(at, a) if transpose else jnp.matmul(a, at))
+
+    return apply_op_flat("linalg_syrk", fn, (A,), {})
+
+
+def gelqf(A):
+    """LQ factorization A = L Q with Q orthonormal rows
+    (reference: la_op.cc linalg_gelqf)."""
+    def fn(a):
+        import jax.numpy as jnp
+
+        q_t, r_t = jnp.linalg.qr(jnp.swapaxes(a, -1, -2))
+        return jnp.swapaxes(r_t, -1, -2), jnp.swapaxes(q_t, -1, -2)
+
+    return apply_op_flat("linalg_gelqf", fn, (A,), {}, n_outputs=2)
+
+
+def sumlogdiag(A):
+    """sum(log(diag(A))) (reference: la_op.cc linalg_sumlogdiag)."""
+    def fn(a):
+        import jax.numpy as jnp
+
+        return jnp.sum(jnp.log(jnp.diagonal(a, axis1=-2, axis2=-1)),
+                       axis=-1)
+
+    return apply_op_flat("linalg_sumlogdiag", fn, (A,), {})
+
+
+def extractdiag(A, offset=0):
+    """Extract a diagonal as a vector (reference: la_op.cc)."""
+    def fn(a):
+        import jax.numpy as jnp
+
+        return jnp.diagonal(a, offset=offset, axis1=-2, axis2=-1)
+
+    return apply_op_flat("linalg_extractdiag", fn, (A,), {})
+
+
+def makediag(v, offset=0):
+    """Vector → diagonal matrix (reference: la_op.cc)."""
+    def fn(x):
+        import jax.numpy as jnp
+
+        n = x.shape[-1] + abs(offset)
+        base = jnp.zeros(x.shape[:-1] + (n, n), x.dtype)
+        idx = jnp.arange(x.shape[-1])
+        rows = idx + max(-offset, 0)
+        cols = idx + max(offset, 0)
+        return base.at[..., rows, cols].set(x)
+
+    return apply_op_flat("linalg_makediag", fn, (v,), {})
+
+
+def extracttrian(A, offset=0, lower=True):
+    """Extract a triangle's entries row-major into a vector
+    (reference: la_op.cc linalg_extracttrian)."""
+    def fn(a):
+        import jax.numpy as jnp
+        import numpy as onp
+
+        n = a.shape[-1]
+        mask = (onp.tril(onp.ones((n, n), bool), k=offset) if lower
+                else onp.triu(onp.ones((n, n), bool), k=offset))
+        rows, cols = onp.nonzero(mask)
+        return a[..., rows, cols]
+
+    return apply_op_flat("linalg_extracttrian", fn, (A,), {})
+
+
+def maketrian(v, offset=0, lower=True):
+    """Vector → triangular matrix (inverse of extracttrian)
+    (reference: la_op.cc linalg_maketrian)."""
+    def fn(x):
+        import jax.numpy as jnp
+        import numpy as onp
+
+        k = x.shape[-1]
+        # solve n from count of triangle entries with offset
+        n = 1
+        while True:
+            mask = (onp.tril(onp.ones((n, n), bool), k=offset) if lower
+                    else onp.triu(onp.ones((n, n), bool), k=offset))
+            if mask.sum() == k:
+                break
+            n += 1
+            if n > 4096:
+                raise ValueError("cannot infer matrix size from vector")
+        rows, cols = onp.nonzero(mask)
+        base = jnp.zeros(x.shape[:-1] + (n, n), x.dtype)
+        return base.at[..., rows, cols].set(x)
+
+    return apply_op_flat("linalg_maketrian", fn, (v,), {})
+
+
+def inverse(A):
+    """Matrix inverse (reference: la_op.cc linalg_inverse)."""
+    def fn(a):
+        import jax.numpy as jnp
+
+        return jnp.linalg.inv(a)
+
+    return apply_op_flat("linalg_inverse", fn, (A,), {})

@@ -1028,19 +1028,73 @@ def stop_gradient(data):
 
 # ---------------------------------------------------------------------------
 # control flow (reference: src/operator/control_flow.cc — foreach/_while_loop/
-# _cond as stateful sub-graph ops; here they bridge to lax.scan/while/cond in
-# eager mode by direct Python execution, and trace cleanly under jit)
+# _cond as stateful sub-graph ops). TPU-native: in eager mode these run as
+# Python loops (tape-friendly); under a jit trace (hybridized block) they
+# lower to lax.scan / lax.while_loop / lax.cond so the compiled program
+# contains real XLA loop constructs instead of a fully unrolled graph.
 # ---------------------------------------------------------------------------
 
+def _is_tracer(x):
+    import jax
+
+    from ..ndarray.ndarray import NDArray
+
+    if isinstance(x, NDArray):
+        x = x._data
+    return isinstance(x, jax.core.Tracer)
+
+
+def _any_traced(*vals):
+    for v in vals:
+        if isinstance(v, (list, tuple)):
+            if any(_is_tracer(x) for x in v):
+                return True
+        elif _is_tracer(v):
+            return True
+    return False
+
+
 def foreach(body, data, init_states):
-    """Run body over axis-0 slices, threading states (≈ lax.scan)."""
-    states = init_states if isinstance(init_states, (list, tuple)) else [init_states]
-    states = list(states)
+    """Run body over axis-0 slices, threading states
+    (reference: control_flow.cc foreach ≈ lax.scan; lowers to a real
+    lax.scan when traced)."""
+    from ..ndarray.ndarray import NDArray
+
+    multi_data = isinstance(data, (list, tuple))
+    multi_state = isinstance(init_states, (list, tuple))
+    states = list(init_states) if multi_state else [init_states]
+
+    if _any_traced(data, init_states):
+        import jax.lax as lax
+
+        xs = ([d._data for d in data] if multi_data else data._data)
+
+        def scan_body(carry, x):
+            st = [NDArray(c) for c in carry]
+            xi = ([NDArray(v) for v in x] if multi_data else NDArray(x))
+            out, new_st = body(xi, st if multi_state else st[0])
+            new_st = (list(new_st) if isinstance(new_st, (list, tuple))
+                      else [new_st])
+            if isinstance(out, (list, tuple)):
+                out_vals = tuple(o._data for o in out)
+            else:
+                out_vals = out._data
+            return tuple(s._data for s in new_st), out_vals
+
+        carry0 = tuple(s._data for s in states)
+        carry, ys = lax.scan(scan_body, carry0, xs)
+        stacked = ([NDArray(y) for y in ys] if isinstance(ys, tuple)
+                   else NDArray(ys))
+        final = [NDArray(c) for c in carry]
+        return stacked, (final if multi_state else final[0])
+
     outputs = []
-    n = data.shape[0] if not isinstance(data, (list, tuple)) else data[0].shape[0]
+    n = data[0].shape[0] if multi_data else data.shape[0]
     for i in range(n):
-        x_i = data[i] if not isinstance(data, (list, tuple)) else [d[i] for d in data]
-        out, states = body(x_i, states)
+        x_i = [d[i] for d in data] if multi_data else data[i]
+        out, states = body(x_i, states if multi_state else states[0])
+        states = (list(states) if isinstance(states, (list, tuple))
+                  else [states])
         outputs.append(out)
     from .. import numpy as np_mod
 
@@ -1049,12 +1103,78 @@ def foreach(body, data, init_states):
                    for j in range(len(outputs[0]))]
     else:
         stacked = np_mod.stack(outputs)
-    return stacked, states
+    return stacked, (states if multi_state else states[0])
 
 
 def while_loop(cond, func, loop_vars, max_iterations=None):
-    steps = 0
+    """Loop func while cond holds (reference: control_flow.cc _while_loop).
+    Traced: lowers to lax.while_loop; per the reference contract, the
+    stacked per-step outputs require `max_iterations` (the output buffer is
+    preallocated to that length, tail untouched)."""
+    from ..ndarray.ndarray import NDArray
+
     loop_vars = list(loop_vars)
+    if _any_traced(loop_vars):
+        import jax
+        import jax.lax as lax
+        import jax.numpy as jnp
+
+        vals0 = tuple(v._data for v in loop_vars)
+
+        # probe func's output structure with abstract eval
+        def _func_flat(*vals):
+            out, new_vars = func(*[NDArray(v) for v in vals])
+            new_vals = tuple(v._data for v in new_vars)
+            if out is None:
+                return None, new_vals
+            out_vals = (tuple(o._data for o in out)
+                        if isinstance(out, (list, tuple)) else out._data)
+            return out_vals, new_vals
+
+        out_shape, _ = jax.eval_shape(_func_flat, *vals0)
+        has_out = out_shape is not None
+        if has_out and max_iterations is None:
+            raise ValueError("while_loop with per-step outputs requires "
+                             "max_iterations under jit (static buffer size)")
+
+        def cond_fn(carry):
+            step, vals, _ = carry
+            c = cond(*[NDArray(v) for v in vals])
+            c = c._data if isinstance(c, NDArray) else c
+            c = jnp.squeeze(c).astype(bool)
+            if max_iterations is not None:
+                c = jnp.logical_and(c, step < max_iterations)
+            return c
+
+        def body_fn(carry):
+            step, vals, bufs = carry
+            out_vals, new_vals = _func_flat(*vals)
+            if has_out:
+                if not isinstance(out_vals, tuple):
+                    out_vals = (out_vals,)
+                bufs = tuple(
+                    lax.dynamic_update_index_in_dim(b, o, step, 0)
+                    for b, o in zip(bufs, out_vals))
+            return step + 1, new_vals, bufs
+
+        if has_out:
+            outs = (out_shape if isinstance(out_shape, tuple)
+                    else (out_shape,))
+            bufs0 = tuple(jnp.zeros((max_iterations,) + o.shape, o.dtype)
+                          for o in outs)
+        else:
+            bufs0 = ()
+        steps, vals, bufs = lax.while_loop(
+            cond_fn, body_fn, (jnp.asarray(0, jnp.int32), vals0, bufs0))
+        new_loop_vars = [NDArray(v) for v in vals]
+        if not has_out:
+            return None, new_loop_vars
+        stacked = [NDArray(b) for b in bufs]
+        if not isinstance(out_shape, tuple):
+            stacked = stacked[0]
+        return stacked, new_loop_vars
+
+    steps = 0
     outputs = []
     while bool(cond(*loop_vars)):
         if max_iterations is not None and steps >= max_iterations:
@@ -1065,11 +1185,53 @@ def while_loop(cond, func, loop_vars, max_iterations=None):
         steps += 1
     from .. import numpy as np_mod
 
-    stacked = np_mod.stack(outputs) if outputs else None
+    if not outputs:
+        return None, loop_vars
+    stacked = np_mod.stack(outputs)
+    if max_iterations is not None and len(outputs) < max_iterations:
+        # pad to max_iterations so eager and traced (lax.while_loop with a
+        # preallocated buffer) agree on the output shape — the reference
+        # contract: outputs have length max_iterations, tail zeros
+        import jax.numpy as jnp
+
+        from ..ndarray.ndarray import NDArray as _ND
+
+        pad_n = max_iterations - len(outputs)
+        pad_shape = (pad_n,) + tuple(stacked.shape[1:])
+        stacked = np_mod.concatenate(
+            [stacked, _ND(jnp.zeros(pad_shape, stacked._data.dtype))])
     return stacked, loop_vars
 
 
 def cond(pred, then_func, else_func):
+    """Conditional (reference: control_flow.cc _cond). Traced: lax.cond."""
+    from ..ndarray.ndarray import NDArray
+
+    if _is_tracer(pred):
+        import jax.lax as lax
+        import jax.numpy as jnp
+        import jax.tree_util as jtu
+
+        is_leaf = lambda x: isinstance(x, NDArray)  # noqa: E731
+        cell = {}  # captures the output treedef while lax.cond traces
+
+        def leaf_val(o):
+            return o._data if isinstance(o, NDArray) else jnp.asarray(o)
+
+        def then_branch(_):
+            flat, tree = jtu.tree_flatten(then_func(), is_leaf=is_leaf)
+            cell["tree"] = tree
+            return tuple(leaf_val(o) for o in flat)
+
+        def else_branch(_):
+            flat, _ = jtu.tree_flatten(else_func(), is_leaf=is_leaf)
+            return tuple(leaf_val(o) for o in flat)
+
+        p = pred._data if isinstance(pred, NDArray) else pred
+        p = jnp.squeeze(p).astype(bool)
+        vals = lax.cond(p, then_branch, else_branch, None)
+        return jtu.tree_unflatten(cell["tree"], [NDArray(v) for v in vals])
+
     return then_func() if bool(pred) else else_func()
 
 

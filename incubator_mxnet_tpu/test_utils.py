@@ -89,7 +89,13 @@ def check_numeric_gradient(fn, inputs, eps=1e-3, rtol=1e-2, atol=1e-4):
     """Central finite differences vs autograd (reference: test_utils.py:1044).
 
     `fn(*inputs)` must return a scalar-reducible NDArray; inputs are NDArrays
-    with float dtype."""
+    with float dtype.
+
+    Both the analytic backward AND the numeric evaluations run in training
+    mode (`autograd.record`) so mode-dependent ops (BatchNorm, Dropout-free
+    nets) compare the same function; numeric evaluations are batched per
+    perturbed element with float32 ops, so `eps` should stay ≥1e-3 to clear
+    rounding noise."""
     from . import autograd
 
     inputs = [x if isinstance(x, NDArray) else NDArray(x) for x in inputs]
@@ -101,6 +107,12 @@ def check_numeric_gradient(fn, inputs, eps=1e-3, rtol=1e-2, atol=1e-4):
     loss.backward()
     analytic = [x.grad.asnumpy().copy() for x in inputs]
 
+    def eval_scalar(args):
+        # training-mode forward without backward: the same function the
+        # analytic gradient differentiated
+        with autograd.record():
+            return float(fn(*args).sum().item())
+
     for i, x in enumerate(inputs):
         base = x.asnumpy().astype("float64")
         num = onp.zeros_like(base)
@@ -109,11 +121,11 @@ def check_numeric_gradient(fn, inputs, eps=1e-3, rtol=1e-2, atol=1e-4):
         for j in range(flat.size):
             orig = flat[j]
             flat[j] = orig + eps
-            fp = float(fn(*[NDArray(base.astype(x.dtype)) if k == i else inputs[k]
-                            for k in range(len(inputs))]).sum().item())
+            fp = eval_scalar([NDArray(base.astype(x.dtype)) if k == i
+                              else inputs[k] for k in range(len(inputs))])
             flat[j] = orig - eps
-            fm = float(fn(*[NDArray(base.astype(x.dtype)) if k == i else inputs[k]
-                            for k in range(len(inputs))]).sum().item())
+            fm = eval_scalar([NDArray(base.astype(x.dtype)) if k == i
+                              else inputs[k] for k in range(len(inputs))])
             flat[j] = orig
             num_flat[j] = (fp - fm) / (2 * eps)
         assert_almost_equal(analytic[i], num, rtol=rtol, atol=atol,

@@ -1,0 +1,116 @@
+"""Control flow ops: eager Python path and lax lowering under hybridize
+(reference: `tests/python/unittest/test_contrib_control_flow.py`)."""
+import numpy as onp
+
+from incubator_mxnet_tpu import np, npx
+from incubator_mxnet_tpu.gluon.block import HybridBlock
+
+RNG = onp.random.RandomState(7)
+
+
+def _body(xi, states):
+    s = states[0]
+    return xi + s, [s + xi.sum()]
+
+
+def test_foreach_eager():
+    x = np.array(RNG.randn(4, 3).astype("float32"))
+    outs, states = npx.foreach(_body, x, [np.zeros(())])
+    acc = 0.0
+    expect = []
+    xn = x.asnumpy()
+    for i in range(4):
+        expect.append(xn[i] + acc)
+        acc += xn[i].sum()
+    onp.testing.assert_allclose(outs.asnumpy(), onp.stack(expect),
+                                rtol=1e-5, atol=1e-6)
+    assert float(states[0].item()) == onp.float32(acc)
+
+
+def test_foreach_lowers_to_scan():
+    class Net(HybridBlock):
+        def forward(self, x):
+            outs, st = npx.foreach(_body, x, [np.zeros(())])
+            return outs + st[0]
+
+    net = Net()
+    net.hybridize()
+    x = np.array(RNG.randn(5, 2).astype("float32"))
+    net(x)          # eager warmup
+    y_compiled = net(x)  # compiled replay
+    outs_e, st_e = npx.foreach(_body, x, [np.zeros(())])
+    onp.testing.assert_allclose(y_compiled.asnumpy(),
+                                (outs_e + st_e[0]).asnumpy(),
+                                rtol=1e-5, atol=1e-5)
+
+
+def test_foreach_multi_data():
+    a = np.array(RNG.randn(3, 2).astype("float32"))
+    b = np.array(RNG.randn(3, 2).astype("float32"))
+
+    def body(xs, states):
+        return xs[0] * xs[1], states
+
+    outs, _ = npx.foreach(body, [a, b], [np.zeros(())])
+    onp.testing.assert_allclose(outs.asnumpy(), a.asnumpy() * b.asnumpy(),
+                                rtol=1e-5)
+
+
+def test_while_loop_eager():
+    def cond_fn(i, total):
+        return i < 4
+
+    def body_fn(i, total):
+        return total, (i + 1, total + i)
+
+    outs, (i, total) = npx.while_loop(
+        cond_fn, body_fn,
+        (np.zeros((), dtype="int32"), np.zeros((), dtype="int32")))
+    assert int(i.item()) == 4
+    assert int(total.item()) == 0 + 1 + 2 + 3
+    onp.testing.assert_array_equal(outs.asnumpy(), [0, 0, 1, 3])
+
+
+def test_while_loop_lowers():
+    class Net(HybridBlock):
+        def forward(self, x):
+            def body_fn(i, acc):
+                return acc, (i + 1, acc + x.sum())
+
+            outs, (i, acc) = npx.while_loop(
+                lambda i, acc: i < 5, body_fn,
+                (np.zeros((), dtype="int32"), np.zeros(())),
+                max_iterations=8)
+            return acc
+
+        infer_shape = None
+
+    net = Net()
+    net.hybridize()
+    x = np.ones((2, 2))
+    net(x)
+    y = net(x)
+    assert float(y.asnumpy()) == 5 * 4.0
+
+
+def test_cond_eager():
+    x = np.ones((2,))
+    out = npx.cond(np.array(1.0), lambda: x * 3, lambda: x)
+    onp.testing.assert_array_equal(out.asnumpy(), [3, 3])
+    out = npx.cond(np.array(0.0), lambda: x * 3, lambda: x)
+    onp.testing.assert_array_equal(out.asnumpy(), [1, 1])
+
+
+def test_cond_lowers_both_branches():
+    class Net(HybridBlock):
+        def forward(self, x):
+            return npx.cond(x.sum() > 0, lambda: x * 2, lambda: x - 1)
+
+    net = Net()
+    net.hybridize()
+    xp = np.ones((2, 2))
+    xn = np.ones((2, 2)) * -1
+    net(xp)  # warmup
+    onp.testing.assert_allclose(net(xp).asnumpy(), 2 * onp.ones((2, 2)))
+    # same compiled program must take the else branch on negative input
+    onp.testing.assert_allclose(net(xn).asnumpy(), -2 * onp.ones((2, 2)))

@@ -1,0 +1,160 @@
+"""Finite-difference gradient checks for the structured ops the round-1
+verdict flagged as never numerically checked: conv, pooling, BN/LN, RNN,
+CTC (reference: `tests/python/unittest/test_operator.py` check_numeric_
+gradient usage), plus bf16/fp16 dtype sweeps."""
+import numpy as onp
+import pytest
+
+from incubator_mxnet_tpu import np, npx
+from incubator_mxnet_tpu import gluon
+from incubator_mxnet_tpu.test_utils import check_numeric_gradient
+
+RNG = onp.random.RandomState(11)
+
+
+def _arr(*shape):
+    return np.array(RNG.randn(*shape).astype("float32") * 0.5)
+
+
+def test_grad_conv2d():
+    x = _arr(2, 3, 8, 8)
+    w = _arr(4, 3, 3, 3)
+    check_numeric_gradient(
+        lambda x, w: (npx.convolution(x, w, kernel=(3, 3), num_filter=4,
+                                      no_bias=True) ** 2).sum(),
+        [x, w], eps=1e-2, rtol=5e-2, atol=2e-2)
+
+
+def test_grad_pooling():
+    rng = onp.random.RandomState(3)
+    x = np.array(rng.randn(2, 2, 6, 6).astype("float32") * 0.5)
+    check_numeric_gradient(
+        lambda x: (npx.pooling(x, kernel=(2, 2), stride=(2, 2),
+                               pool_type="avg") ** 2).sum(),
+        [x], eps=1e-2, rtol=5e-2, atol=5e-3)
+    # max pool: keep in-window gaps >> eps so perturbations can't flip the
+    # argmax (which would corrupt the finite difference)
+    base = rng.permutation(2 * 2 * 6 * 6).astype("float32").reshape(2, 2, 6, 6)
+    xm = np.array(base)  # all values ≥1 apart, eps=1e-2 can't create ties
+    check_numeric_gradient(
+        lambda x: npx.pooling(x, kernel=(2, 2), stride=(2, 2),
+                              pool_type="max").sum(),
+        [xm], eps=1e-2, rtol=5e-2, atol=5e-3)
+
+
+def test_grad_batch_norm():
+    # sum(out²) of batch-normalized values is near-invariant (grads ~1e-6,
+    # under f32 finite-difference noise), so weight the objective to make
+    # the gradient through the batch statistics O(1)
+    x = _arr(4, 3, 5, 5)
+    w = np.array(RNG.randn(4, 3, 5, 5).astype("float32"))
+    gamma, beta = np.ones((3,)), np.zeros((3,))
+    mean, var = np.zeros((3,)), np.ones((3,))
+    check_numeric_gradient(
+        lambda x: (npx.batch_norm(x, gamma, beta, mean, var) * w).sum(),
+        [x], eps=1e-2, rtol=5e-2, atol=5e-3)
+
+
+def test_grad_layer_norm():
+    x = _arr(4, 6)
+    g, b = np.ones((6,)), np.zeros((6,))
+    check_numeric_gradient(
+        lambda x: (npx.layer_norm(x, g, b, axis=-1) ** 2).sum(),
+        [x], rtol=3e-2, atol=2e-3)
+
+
+def test_grad_softmax_logsoftmax():
+    x = _arr(3, 7)
+    check_numeric_gradient(
+        lambda x: (npx.softmax(x) ** 2).sum(), [x], eps=1e-2,
+        rtol=5e-2, atol=2e-3)
+    check_numeric_gradient(
+        lambda x: (npx.log_softmax(x) * npx.log_softmax(x)).sum(), [x],
+        eps=1e-2, rtol=5e-2, atol=5e-3)
+
+
+def test_grad_rnn_lstm():
+    T, B, I, H = 3, 2, 4, 5
+    x = _arr(T, B, I)
+    n_params = 4 * H * (I + H + 2)
+    params = np.array(RNG.randn(n_params).astype("float32") * 0.1)
+    state = np.zeros((1, B, H))
+    cell = np.zeros((1, B, H))
+
+    def fn(x, params):
+        out = npx.rnn(data=x, parameters=params, state=state,
+                      state_cell=cell, mode="lstm", state_size=H,
+                      num_layers=1)
+        return (out ** 2).sum()
+
+    check_numeric_gradient(fn, [x, params], rtol=4e-2, atol=2e-3)
+
+
+def test_grad_ctc_loss():
+    T, B, C = 6, 2, 5
+    logits = _arr(T, B, C)
+    labels = np.array(onp.array([[1, 2], [3, 4]], "int32"))
+
+    def fn(logits):
+        return gluon.loss.CTCLoss(layout="TNC")(logits, labels).sum()
+
+    check_numeric_gradient(fn, [logits], rtol=5e-2, atol=5e-3)
+
+
+def test_grad_embedding_dense():
+    w = _arr(10, 4)
+    idx = np.array(onp.array([1, 3, 3], "int32"))
+    check_numeric_gradient(
+        lambda w: (npx.embedding(idx, w, input_dim=10, output_dim=4)
+                   ** 2).sum(),
+        [w], rtol=2e-2, atol=1e-3)
+
+
+# -- dtype sweeps -------------------------------------------------------------
+
+@pytest.mark.parametrize("dtype", ["float16", "bfloat16"])
+def test_dense_forward_dtypes(dtype):
+    net = gluon.nn.Dense(8)
+    net.initialize()
+    net.cast(dtype)
+    x = np.array(RNG.randn(2, 4).astype("float32")).astype(dtype)
+    y = net(x)
+    assert onp.dtype(y.dtype) == onp.dtype(getattr(
+        __import__("ml_dtypes"), "bfloat16") if dtype == "bfloat16"
+        else dtype)
+    assert onp.isfinite(y.asnumpy().astype("float32")).all()
+
+
+@pytest.mark.parametrize("dtype", ["float16", "bfloat16"])
+def test_conv_bn_forward_dtypes(dtype):
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Conv2D(4, 3, padding=1), gluon.nn.BatchNorm(),
+            gluon.nn.Activation("relu"))
+    net.initialize()
+    net.cast(dtype)
+    x = np.ones((1, 3, 8, 8)).astype(dtype)
+    y = net(x)
+    import ml_dtypes
+
+    want = onp.dtype(ml_dtypes.bfloat16 if dtype == "bfloat16" else dtype)
+    assert onp.dtype(y.dtype) == want
+
+
+def test_backward_float32():
+    from incubator_mxnet_tpu import autograd
+
+    x = np.array(RNG.randn(3, 3).astype("float32"))
+    x.attach_grad()
+    with autograd.record():
+        y = (x * x).sum()
+    y.backward()
+    assert onp.dtype(x.grad.dtype) == onp.float32
+    onp.testing.assert_allclose(x.grad.asnumpy(), 2 * x.asnumpy(),
+                                rtol=1e-5)
+
+
+def test_float64_degrades_to_float32():
+    # Documented TPU-native divergence: without jax x64 mode, float64
+    # requests execute in float32 (the TPU has no f64 units).
+    x = np.array(onp.ones((2, 2), "float64"))
+    assert onp.dtype(x.dtype) == onp.float32

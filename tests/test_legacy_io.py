@@ -1,0 +1,109 @@
+"""Reference binary .params container (ndarray/legacy_io.py byte-format
+reimplementation of `src/ndarray/ndarray.cc:1862-2155`)."""
+import struct
+
+import numpy as onp
+import pytest
+
+from incubator_mxnet_tpu import nd, np
+from incubator_mxnet_tpu.ndarray import legacy_io
+from incubator_mxnet_tpu.ndarray.sparse import (CSRNDArray, RowSparseNDArray,
+                                                csr_matrix)
+
+
+def test_dense_roundtrip(tmp_path):
+    f = str(tmp_path / "m.params")
+    data = {
+        "w": np.array(onp.arange(6, dtype="float32").reshape(2, 3)),
+        "b16": np.array(onp.ones((2, 2), dtype="float16")),
+        "i64": np.array(onp.arange(4, dtype="int64")),
+    }
+    legacy_io.save(f, data)
+    back = legacy_io.load(f)
+    assert set(back) == set(data)
+    for k in data:
+        onp.testing.assert_array_equal(back[k].asnumpy(), data[k].asnumpy())
+        assert back[k].asnumpy().dtype == data[k].asnumpy().dtype
+
+
+def test_list_roundtrip_unnamed(tmp_path):
+    f = str(tmp_path / "l.params")
+    legacy_io.save(f, [np.ones((2,)), np.zeros((3, 1))])
+    back = legacy_io.load(f)
+    assert isinstance(back, list) and len(back) == 2
+    onp.testing.assert_array_equal(back[0].asnumpy(), onp.ones((2,)))
+
+
+def test_sparse_roundtrip(tmp_path):
+    f = str(tmp_path / "s.params")
+    rs = RowSparseNDArray(onp.arange(6, dtype="float32").reshape(2, 3),
+                          onp.array([1, 4], onp.int32), (6, 3))
+    csr = csr_matrix(onp.array([[0, 1.5, 0], [2.0, 0, 0]], onp.float32))
+    legacy_io.save(f, {"rs": rs, "csr": csr})
+    back = legacy_io.load(f)
+    assert isinstance(back["rs"], RowSparseNDArray)
+    assert isinstance(back["csr"], CSRNDArray)
+    onp.testing.assert_array_equal(back["rs"].asnumpy(), rs.asnumpy())
+    onp.testing.assert_array_equal(back["csr"].asnumpy(), csr.asnumpy())
+
+
+def test_wire_framing(tmp_path):
+    """The emitted bytes follow the reference framing exactly: 0x112 magic,
+    reserved, uint64 count, per-array V3 magic + stype + shape..."""
+    f = str(tmp_path / "w.params")
+    legacy_io.save(f, {"x": np.ones((2, 3), dtype="float32")})
+    raw = open(f, "rb").read()
+    magic, reserved, count = struct.unpack_from("<QQQ", raw, 0)
+    assert magic == 0x112 and reserved == 0 and count == 1
+    blob_magic, stype, ndim = struct.unpack_from("<IiI", raw, 24)
+    assert blob_magic == 0xF993FACA  # V3 np-shape
+    assert stype == 0
+    assert ndim == 2
+    d0, d1 = struct.unpack_from("<qq", raw, 36)
+    assert (d0, d1) == (2, 3)
+    dev_type, dev_id, type_flag = struct.unpack_from("<iii", raw, 52)
+    assert dev_type == 1 and type_flag == 0  # cpu, float32
+    payload = struct.unpack_from("<6f", raw, 64)
+    assert payload == (1.0,) * 6
+    # names vector: uint64 count, uint64 len, bytes
+    name_off = 64 + 24
+    n_names, = struct.unpack_from("<Q", raw, name_off)
+    assert n_names == 1
+    ln, = struct.unpack_from("<Q", raw, name_off + 8)
+    assert raw[name_off + 16:name_off + 16 + ln] == b"x"
+
+
+def test_nd_save_load_legacy_autodetect(tmp_path):
+    f = str(tmp_path / "auto.params")
+    nd.save(f, {"x": np.full((2, 2), 7.0)}, format="legacy")
+    back = nd.load(f)
+    onp.testing.assert_array_equal(back["x"].asnumpy(),
+                                   onp.full((2, 2), 7.0))
+
+
+def test_block_load_parameters_legacy(tmp_path):
+    from incubator_mxnet_tpu import gluon
+
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(4))
+    net.initialize()
+    x = np.random.uniform(size=(1, 3))
+    y0 = net(x)
+    # write a reference-style .params with arg: prefixes
+    f = str(tmp_path / "ref.params")
+    legacy_io.save(f, {"arg:" + k: p.data()
+                       for k, p in net.collect_params().items()})
+    net2 = gluon.nn.HybridSequential()
+    net2.add(gluon.nn.Dense(4))
+    net2.load_parameters(f)
+    onp.testing.assert_allclose(net2(x).asnumpy(), y0.asnumpy(),
+                                rtol=1e-6)
+
+
+def test_bad_magic_raises(tmp_path):
+    f = str(tmp_path / "junk.params")
+    with open(f, "wb") as fh:
+        fh.write(b"\x00" * 32)
+    with pytest.raises(ValueError, match="not a reference NDArray file"):
+        legacy_io.load(f)
+    assert not legacy_io.is_legacy_file(f)

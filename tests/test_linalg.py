@@ -1,0 +1,149 @@
+"""linalg tests: np.linalg wrappers + the reference linalg_* op family with
+finite-difference gradient checks (reference:
+`tests/python/unittest/test_operator.py` test_laop_* suites)."""
+import numpy as onp
+import pytest
+
+from incubator_mxnet_tpu import np
+from incubator_mxnet_tpu.numpy import linalg as la
+from incubator_mxnet_tpu.test_utils import check_numeric_gradient
+
+RNG = onp.random.RandomState(42)
+
+
+def _spd(n, jitter=3.0):
+    a = RNG.randn(n, n).astype("float32")
+    return np.array(a @ a.T + jitter * onp.eye(n, dtype="float32"))
+
+
+def _mat(*shape):
+    return np.array(RNG.randn(*shape).astype("float32"))
+
+
+def test_gemm2():
+    A, B = _mat(3, 4), _mat(4, 5)
+    out = la.gemm2(A, B, alpha=2.0)
+    onp.testing.assert_allclose(out.asnumpy(),
+                                2.0 * A.asnumpy() @ B.asnumpy(),
+                                rtol=1e-4, atol=1e-5)
+    out_t = la.gemm2(A, A, transpose_b=True)
+    onp.testing.assert_allclose(out_t.asnumpy(),
+                                A.asnumpy() @ A.asnumpy().T,
+                                rtol=1e-4, atol=1e-5)
+
+
+def test_potrf_potri():
+    S = _spd(4)
+    L = la.potrf(S)
+    onp.testing.assert_allclose((L @ L.T).asnumpy(), S.asnumpy(),
+                                rtol=1e-4, atol=1e-4)
+    U = la.potrf(S, lower=False)
+    onp.testing.assert_allclose(U.asnumpy(), L.asnumpy().T,
+                                rtol=1e-5, atol=1e-6)
+    Sinv = la.potri(L)
+    onp.testing.assert_allclose((Sinv @ S).asnumpy(), onp.eye(4),
+                                atol=2e-3)
+
+
+def test_trsm_trmm():
+    S = _spd(4)
+    L = la.potrf(S)
+    B = _mat(4, 3)
+    X = la.trsm(L, B, alpha=2.0)
+    onp.testing.assert_allclose((L @ X).asnumpy(), 2.0 * B.asnumpy(),
+                                rtol=1e-4, atol=1e-4)
+    Br = _mat(3, 4)
+    Xr = la.trsm(L, Br, rightside=True)
+    onp.testing.assert_allclose((Xr @ L).asnumpy(), Br.asnumpy(),
+                                rtol=1e-4, atol=1e-4)
+    M = la.trmm(L, B)
+    onp.testing.assert_allclose(M.asnumpy(),
+                                onp.tril(L.asnumpy()) @ B.asnumpy(),
+                                rtol=1e-4, atol=1e-4)
+
+
+def test_syrk_sumlogdiag_diag_trian():
+    A = _mat(3, 5)
+    onp.testing.assert_allclose(la.syrk(A).asnumpy(),
+                                A.asnumpy() @ A.asnumpy().T,
+                                rtol=1e-4, atol=1e-4)
+    onp.testing.assert_allclose(la.syrk(A, transpose=True).asnumpy(),
+                                A.asnumpy().T @ A.asnumpy(),
+                                rtol=1e-4, atol=1e-4)
+    S = _spd(4)
+    L = la.potrf(S)
+    onp.testing.assert_allclose(
+        float(la.sumlogdiag(L).item()),
+        float(onp.log(onp.diag(L.asnumpy())).sum()), rtol=1e-5)
+    d = la.extractdiag(S)
+    onp.testing.assert_allclose(d.asnumpy(), onp.diag(S.asnumpy()))
+    D = la.makediag(d)
+    onp.testing.assert_allclose(D.asnumpy(), onp.diag(onp.diag(S.asnumpy())))
+    v = la.extracttrian(S)
+    back = la.maketrian(v)
+    onp.testing.assert_allclose(back.asnumpy(), onp.tril(S.asnumpy()))
+
+
+def test_gelqf():
+    A = _mat(3, 5)
+    L, Q = la.gelqf(A)
+    onp.testing.assert_allclose((L @ Q).asnumpy(), A.asnumpy(),
+                                rtol=1e-4, atol=1e-4)
+    onp.testing.assert_allclose((Q @ Q.T).asnumpy(), onp.eye(3), atol=1e-5)
+
+
+def test_np_linalg_wrappers():
+    S = _spd(3)
+    onp.testing.assert_allclose(la.inv(S).asnumpy(),
+                                onp.linalg.inv(S.asnumpy()),
+                                rtol=1e-3, atol=1e-4)
+    sign, logdet = la.slogdet(S)
+    s_ref, l_ref = onp.linalg.slogdet(S.asnumpy())
+    assert float(sign.item()) == pytest.approx(float(s_ref))
+    assert float(logdet.item()) == pytest.approx(float(l_ref), rel=1e-4)
+    b = _mat(3, 2)
+    x = la.solve(S, b)
+    onp.testing.assert_allclose((S @ x).asnumpy(), b.asnumpy(),
+                                rtol=1e-3, atol=1e-3)
+    w = la.eigvalsh(S)
+    onp.testing.assert_allclose(onp.sort(w.asnumpy()),
+                                onp.sort(onp.linalg.eigvalsh(S.asnumpy())),
+                                rtol=1e-4, atol=1e-4)
+
+
+# -- gradient checks ----------------------------------------------------------
+
+def test_grad_gemm2():
+    check_numeric_gradient(
+        lambda a, b: la.gemm2(a, b).sum(), [_mat(3, 4), _mat(4, 2)])
+
+
+def test_grad_potrf_sumlogdiag():
+    # d/dA sum(log(diag(chol(A)))) = 0.5 inv(A) for SPD A
+    check_numeric_gradient(
+        lambda a: la.sumlogdiag(la.potrf(a)).sum(), [_spd(3)],
+        rtol=3e-2, atol=1e-3)
+
+
+def test_grad_trsm():
+    S = _spd(3)
+    L = la.potrf(S)
+    check_numeric_gradient(
+        lambda b: (la.trsm(L, b) ** 2).sum(), [_mat(3, 2)])
+
+
+def test_grad_solve():
+    check_numeric_gradient(
+        lambda a, b: (la.solve(a, b) ** 2).sum(), [_spd(3), _mat(3, 2)],
+        rtol=3e-2, atol=1e-3)
+
+
+def test_grad_inverse_det():
+    check_numeric_gradient(
+        lambda a: la.inverse(a).sum(), [_spd(3)], rtol=3e-2, atol=1e-3)
+    check_numeric_gradient(
+        lambda a: la.slogdet(a)[1].sum(), [_spd(3)], rtol=3e-2, atol=1e-3)
+
+
+def test_grad_norm():
+    check_numeric_gradient(lambda a: la.norm(a).sum(), [_mat(4, 3)])
