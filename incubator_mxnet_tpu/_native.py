@@ -1,0 +1,140 @@
+"""Native runtime bindings (reference role: the C API / FFI layer,
+`src/c_api/` — here a thin ctypes bridge to `src/rtio/rtio.cc`).
+
+`librtio.so` is built on demand with `make -C src` (g++ is in the image;
+pybind11 is not, hence ctypes). Everything degrades gracefully: callers use
+`rtio()` and fall back to the pure-Python path when it returns None.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_LOCK = threading.Lock()
+_RTIO = None
+_RTIO_TRIED = False
+
+
+def repo_root():
+    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _build_native(target=None):
+    src = os.path.join(repo_root(), "src")
+    if not os.path.isdir(src):
+        return False
+    try:
+        res = subprocess.run(["make", "-C", src] + ([target] if target else []),
+                             capture_output=True, text=True, timeout=120)
+        return res.returncode == 0
+    except (OSError, subprocess.TimeoutExpired):
+        return False
+
+
+def rtio():
+    """ctypes handle to librtio, or None when unavailable."""
+    global _RTIO, _RTIO_TRIED
+    with _LOCK:
+        if _RTIO_TRIED:
+            return _RTIO
+        _RTIO_TRIED = True
+        path = os.environ.get(
+            "INCUBATOR_MXNET_TPU_RTIO",
+            os.path.join(repo_root(), "build", "librtio.so"))
+        if not os.path.exists(path):
+            _build_native()
+        if not os.path.exists(path):
+            return None
+        try:
+            lib = ctypes.CDLL(path)
+        except OSError:
+            return None
+        lib.rtio_open.restype = ctypes.c_void_p
+        lib.rtio_open.argtypes = [ctypes.c_char_p]
+        lib.rtio_close.argtypes = [ctypes.c_void_p]
+        lib.rtio_num_records.restype = ctypes.c_int64
+        lib.rtio_num_records.argtypes = [ctypes.c_void_p]
+        lib.rtio_record.restype = ctypes.c_int
+        lib.rtio_record.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64,
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
+            ctypes.POINTER(ctypes.c_int64)]
+        lib.rtio_record_start.restype = ctypes.c_int64
+        lib.rtio_record_start.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+        lib.rtio_batch_bytes.restype = ctypes.c_int64
+        lib.rtio_batch_bytes.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_int64), ctypes.c_int64]
+        lib.rtio_read_batch.restype = ctypes.c_int
+        lib.rtio_read_batch.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_int64), ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_uint8), ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64)]
+        lib.rtio_build_index.restype = ctypes.c_int64
+        lib.rtio_build_index.argtypes = [ctypes.c_char_p, ctypes.c_char_p]
+        _RTIO = lib
+        return _RTIO
+
+
+class NativeRecordFile:
+    """mmap-backed random-access RecordIO reader over librtio
+    (reference: dmlc::RecordIOReader + iter_image_recordio_2.cc's
+    prefetching reader)."""
+
+    def __init__(self, rec_path):
+        lib = rtio()
+        if lib is None:
+            raise RuntimeError("librtio unavailable (g++/make missing?)")
+        self._lib = lib
+        self._h = lib.rtio_open(rec_path.encode())
+        if not self._h:
+            raise IOError(f"rtio_open failed for {rec_path}")
+
+    def __len__(self):
+        return int(self._lib.rtio_num_records(self._h))
+
+    def read(self, i) -> bytes:
+        data = ctypes.POINTER(ctypes.c_uint8)()
+        ln = ctypes.c_int64()
+        if self._lib.rtio_record(self._h, i, ctypes.byref(data),
+                                 ctypes.byref(ln)) != 0:
+            raise IndexError(i)
+        return ctypes.string_at(data, ln.value)
+
+    def read_batch(self, idxs) -> list[bytes]:
+        """One C call for the whole batch (single copy out of page cache)."""
+        n = len(idxs)
+        idx_arr = (ctypes.c_int64 * n)(*idxs)
+        total = self._lib.rtio_batch_bytes(self._h, idx_arr, n)
+        if total < 0:
+            raise IndexError(list(idxs))
+        buf = (ctypes.c_uint8 * total)()
+        offs = (ctypes.c_int64 * n)()
+        lens = (ctypes.c_int64 * n)()
+        rc = self._lib.rtio_read_batch(self._h, idx_arr, n, buf, total,
+                                       offs, lens)
+        if rc != 0:
+            raise IOError(f"rtio_read_batch rc={rc}")
+        raw = bytes(buf)
+        return [raw[offs[j]:offs[j] + lens[j]] for j in range(n)]
+
+    def close(self):
+        if getattr(self, "_h", None):
+            self._lib.rtio_close(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+def build_index(rec_path, idx_path):
+    """Native .idx builder; returns record count or None if unavailable."""
+    lib = rtio()
+    if lib is None:
+        return None
+    n = lib.rtio_build_index(rec_path.encode(), idx_path.encode())
+    return None if n < 0 else int(n)

@@ -207,9 +207,12 @@ def convert_hybrid_block(net, target_dtype="bfloat16",
         walk(net)
 
     class _AMPWrapped(HybridBlock):
-        """With cast_params_offline=False the params stay float32 and the
-        funnel AMP lists cast operands at runtime inside each listed op
-        (the reference's online amp_cast mode)."""
+        """Funnel AMP is active for the wrapped forward in BOTH modes:
+        norm layers keep f32 params, so their f32 outputs would promote
+        every later bf16-weight matmul back to f32 — the funnel's
+        TARGET_DTYPE_OPS casts re-lower those activations (the reference's
+        amp_cast node insertion). Offline mode additionally pre-casts
+        matmul-class params so no per-step weight cast remains."""
 
         def __init__(self, inner):
             super().__init__()
@@ -219,15 +222,12 @@ def convert_hybrid_block(net, target_dtype="bfloat16",
             cast_args = [a.astype(target_dtype)
                          if hasattr(a, "dtype") and str(a.dtype) == "float32"
                          else a for a in args]
-            if cast_params_offline:
+            was_active, was_dtype = _STATE.active, _STATE.dtype
+            _STATE.active, _STATE.dtype = True, target_dtype
+            try:
                 out = self.net(*cast_args)
-            else:
-                was_active, was_dtype = _STATE.active, _STATE.dtype
-                _STATE.active, _STATE.dtype = True, target_dtype
-                try:
-                    out = self.net(*cast_args)
-                finally:
-                    _STATE.active, _STATE.dtype = was_active, was_dtype
+            finally:
+                _STATE.active, _STATE.dtype = was_active, was_dtype
             if isinstance(out, (list, tuple)):
                 return type(out)(o.astype("float32") for o in out)
             return out.astype("float32")

@@ -276,35 +276,44 @@ class CheckpointHandler(TrainBegin, BatchEnd, EpochEnd):
             self._resume_from_checkpoint(estimator)
 
     def _resume_from_checkpoint(self, estimator):
-        """Reload the newest matching checkpoint's params (+trainer states)
-        and continue the epoch/batch counters from it
+        """Reload the newest matching checkpoint's params (+trainer states),
+        continue the epoch/batch counters, re-seed the rotation window with
+        ALL on-disk checkpoints, and restore the best-monitor value
         (reference: event_handler.py:542)."""
+        import json
         import re
 
         pat = re.compile(
             rf"^{re.escape(self.model_prefix)}-epoch(\d+)batch(\d+)\.params$")
-        best = None
+        found = []
         for f in os.listdir(self.model_dir):
             m = pat.match(f)
             if m:
-                key = (int(m.group(1)), int(m.group(2)))
-                if best is None or key > best[0]:
-                    best = (key, f)
-        if best is None:
+                found.append(((int(m.group(1)), int(m.group(2))), f))
+        if not found:
             estimator.logger.info(
                 "CheckpointHandler: no checkpoint found in %s to resume from",
                 self.model_dir)
             return
-        (epoch, batch), fname = best
+        found.sort()
+        (epoch, batch), fname = found[-1]
         estimator.net.load_parameters(os.path.join(self.model_dir, fname))
         states = os.path.join(self.model_dir, fname[:-7] + ".states")
         if estimator.trainer is not None and os.path.exists(states):
             estimator.trainer.load_states(states)
         self.current_epoch = epoch
         self.current_batch = batch
-        prefix = fname[:-7]
-        if prefix not in self.saved_checkpoints:
-            self.saved_checkpoints.append(prefix)
+        # oldest-first so the max_checkpoints rotation keeps deleting the
+        # right files across crash/resume cycles
+        for _, f in found:
+            prefix = f[:-7]
+            if prefix not in self.saved_checkpoints:
+                self.saved_checkpoints.append(prefix)
+        best_info = os.path.join(self.model_dir,
+                                 f"{self.model_prefix}-best.info")
+        if self.save_best and os.path.exists(best_info):
+            with open(best_info) as f:
+                self.best = json.load(f)["best"]
         estimator.logger.info(
             "CheckpointHandler: resumed from %s (epoch %d, batch %d)",
             fname, epoch, batch)
@@ -336,6 +345,12 @@ class CheckpointHandler(TrainBegin, BatchEnd, EpochEnd):
                 self.best = value
                 self._save_params_and_trainer(
                     estimator, f"{self.model_prefix}-best")
+                import json
+
+                with open(os.path.join(self.model_dir,
+                                       f"{self.model_prefix}-best.info"),
+                          "w") as f:
+                    json.dump({"best": float(value), "metric": name}, f)
                 if self.verbose > 0:
                     estimator.logger.info(
                         "[Epoch %d] %s improved to %.5f; saving best model",

@@ -1,0 +1,151 @@
+"""Custom-operator extension loader (reference:
+`python/mxnet/library.py load` → C API MXLoadLib, ABI
+`include/mxnet/lib_api.h`; ABI here: `src/ext/mx_ext.h`).
+
+`load(path)` dlopens an extension library, validates the ABI version, and
+registers each exported op as a callable on `incubator_mxnet_tpu.npx`.
+TPU-native bridging: the C function runs on host buffers inside
+`jax.pure_callback`, so extension ops work eagerly AND inside jit-compiled
+(hybridized) graphs — XLA treats them as host callbacks. Forward-only
+(gradients raise; write a `custom Function` for differentiable ops).
+"""
+from __future__ import annotations
+
+import ctypes
+
+import numpy as onp
+
+__all__ = ["load"]
+
+_DTYPE_CODES = {"float32": 0, "float64": 1, "int32": 2, "int64": 3,
+                "uint8": 4, "bool": 5}
+_MAX_NDIM = 8
+_ABI_VERSION = 1
+
+
+class _MXExtTensor(ctypes.Structure):
+    _fields_ = [("dtype", ctypes.c_int),
+                ("ndim", ctypes.c_int),
+                ("shape", ctypes.POINTER(ctypes.c_int64)),
+                ("data", ctypes.c_void_p)]
+
+
+def _bind(lib):
+    lib.mx_ext_abi_version.restype = ctypes.c_int
+    lib.mx_ext_num_ops.restype = ctypes.c_int
+    lib.mx_ext_op_name.restype = ctypes.c_char_p
+    lib.mx_ext_op_name.argtypes = [ctypes.c_int]
+    lib.mx_ext_op_infer_shape.restype = ctypes.c_int
+    lib.mx_ext_op_infer_shape.argtypes = [
+        ctypes.c_int, ctypes.c_int,
+        ctypes.POINTER(ctypes.POINTER(ctypes.c_int64)),
+        ctypes.POINTER(ctypes.c_int),
+        ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int)]
+    lib.mx_ext_op_forward.restype = ctypes.c_int
+    lib.mx_ext_op_forward.argtypes = [
+        ctypes.c_int, ctypes.c_int, ctypes.POINTER(_MXExtTensor),
+        ctypes.POINTER(_MXExtTensor)]
+
+
+def _infer_shape(lib, op_idx, in_shapes):
+    n_in = len(in_shapes)
+    shape_arrays = [(ctypes.c_int64 * len(s))(*s) for s in in_shapes]
+    shape_ptrs = (ctypes.POINTER(ctypes.c_int64) * n_in)(
+        *[ctypes.cast(a, ctypes.POINTER(ctypes.c_int64))
+          for a in shape_arrays])
+    ndims = (ctypes.c_int * n_in)(*[len(s) for s in in_shapes])
+    out_shape = (ctypes.c_int64 * _MAX_NDIM)()
+    out_ndim = ctypes.c_int()
+    rc = lib.mx_ext_op_infer_shape(op_idx, n_in, shape_ptrs, ndims,
+                                   out_shape, ctypes.byref(out_ndim))
+    if rc != 0:
+        raise ValueError(f"extension infer_shape failed (rc={rc})")
+    return tuple(out_shape[i] for i in range(out_ndim.value))
+
+
+def _run_forward(lib, op_idx, arrays, out_shape, out_dtype):
+    n_in = len(arrays)
+    keep = []  # keep ctypes shape buffers alive through the call
+    tensors = (_MXExtTensor * n_in)()
+    for j, a in enumerate(arrays):
+        a = onp.ascontiguousarray(a)
+        keep.append(a)
+        shp = (ctypes.c_int64 * a.ndim)(*a.shape)
+        keep.append(shp)
+        tensors[j] = _MXExtTensor(
+            _DTYPE_CODES[str(a.dtype)], a.ndim,
+            ctypes.cast(shp, ctypes.POINTER(ctypes.c_int64)),
+            a.ctypes.data_as(ctypes.c_void_p))
+    out = onp.empty(out_shape, out_dtype)
+    out_shp = (ctypes.c_int64 * out.ndim)(*out.shape)
+    out_t = _MXExtTensor(
+        _DTYPE_CODES[str(out.dtype)], out.ndim,
+        ctypes.cast(out_shp, ctypes.POINTER(ctypes.c_int64)),
+        out.ctypes.data_as(ctypes.c_void_p))
+    rc = lib.mx_ext_op_forward(op_idx, n_in, tensors, ctypes.byref(out_t))
+    if rc != 0:
+        raise RuntimeError(f"extension op forward failed (rc={rc})")
+    return out
+
+
+def _make_op(lib, op_idx, name):
+    def op(*args):
+        import jax
+        import jax.numpy as jnp
+
+        from .ndarray.ndarray import NDArray, apply_op
+
+        def jfn(*vals):
+            in_shapes = [tuple(v.shape) for v in vals]
+            out_shape = _infer_shape(lib, op_idx, in_shapes)
+            out_dtype = onp.dtype(str(vals[0].dtype))
+
+            def host(*host_arrays):
+                return _run_forward(lib, op_idx,
+                                    [onp.asarray(a) for a in host_arrays],
+                                    out_shape, out_dtype)
+
+            if any(isinstance(v, jax.core.Tracer) for v in vals):
+                # inside a jit trace (hybridize): bridge via pure_callback.
+                # NOTE: some TPU PJRT plugins (axon) don't implement host
+                # callbacks — hybridized extension ops then fail at run
+                # time there; the eager path below always works.
+                return jax.pure_callback(
+                    host, jax.ShapeDtypeStruct(out_shape, out_dtype), *vals)
+            # eager: run the C op directly on host buffers (device→host→
+            # device roundtrip, like the reference's CPU-fallback custom op)
+            return jnp.asarray(host(*vals))
+
+        wrapped = [a if isinstance(a, NDArray) else NDArray(a) for a in args]
+        return apply_op(f"ext_{name}", jfn, tuple(wrapped))
+
+    op.__name__ = name
+    op.__doc__ = f"Custom extension op {name!r} (host callback; see " \
+                 "library.load)."
+    return op
+
+
+def load(path, verbose=True):
+    """Load a custom-op extension library and register its ops on `npx`
+    (reference: library.py:28 load). Returns {name: callable}."""
+    lib = ctypes.CDLL(path)
+    for sym in ("mx_ext_abi_version", "mx_ext_num_ops", "mx_ext_op_name",
+                "mx_ext_op_infer_shape", "mx_ext_op_forward"):
+        if not hasattr(lib, sym):
+            raise ValueError(f"{path} is not a valid extension library "
+                             f"(missing {sym})")
+    _bind(lib)
+    abi = lib.mx_ext_abi_version()
+    if abi != _ABI_VERSION:
+        raise ValueError(f"extension ABI {abi} != supported {_ABI_VERSION}")
+    from . import numpy_extension as npx
+
+    ops = {}
+    for i in range(lib.mx_ext_num_ops()):
+        name = lib.mx_ext_op_name(i).decode()
+        fn = _make_op(lib, i, name)
+        ops[name] = fn
+        setattr(npx, name, fn)
+    if verbose:
+        print(f"loaded library {path}: ops {sorted(ops)}")
+    return ops

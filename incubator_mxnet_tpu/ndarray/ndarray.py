@@ -697,7 +697,10 @@ def _cached_jit(name, jfn, args, kwargs, pure_fn, call_vals):
     try:
         from .. import amp as amp_mod
 
-        key = (jfn, amp_mod.state_key(),
+        # key on THIS op's cast mode (None for unlisted ops), so toggling
+        # AMP only invalidates entries whose compiled program actually
+        # contains casts
+        key = (jfn, amp_mod.op_cast_mode(name),
                tuple(_static_marker(a) for a in args),
                tuple((k, _static_marker(v)) for k, v in
                      sorted(kwargs.items())))

@@ -1185,17 +1185,31 @@ def while_loop(cond, func, loop_vars, max_iterations=None):
         steps += 1
     from .. import numpy as np_mod
 
+    import jax.numpy as jnp
+
+    from ..ndarray.ndarray import NDArray as _ND
+
     if not outputs:
-        return None, loop_vars
+        if max_iterations is None:
+            return None, loop_vars
+        # zero iterations but a padded-output contract: probe func (pure by
+        # the reference contract) for the per-step output structure so the
+        # eager result matches the traced path's zero-filled buffers
+        probe_out, _ = func(*loop_vars)
+        if probe_out is None:
+            return None, loop_vars
+        outs = (probe_out if isinstance(probe_out, (list, tuple))
+                else [probe_out])
+        zeros = [_ND(jnp.zeros((max_iterations,) + tuple(o.shape),
+                               o._data.dtype)) for o in outs]
+        if isinstance(probe_out, (list, tuple)):
+            return zeros, loop_vars
+        return zeros[0], loop_vars
     stacked = np_mod.stack(outputs)
     if max_iterations is not None and len(outputs) < max_iterations:
         # pad to max_iterations so eager and traced (lax.while_loop with a
         # preallocated buffer) agree on the output shape — the reference
         # contract: outputs have length max_iterations, tail zeros
-        import jax.numpy as jnp
-
-        from ..ndarray.ndarray import NDArray as _ND
-
         pad_n = max_iterations - len(outputs)
         pad_shape = (pad_n,) + tuple(stacked.shape[1:])
         stacked = np_mod.concatenate(

@@ -8,3 +8,4 @@ from .collectives import (  # noqa: F401
     all_gather, all_reduce, broadcast, reduce_scatter, ring_permute,
 )
 from .sharded import DataParallel, shard_train_step  # noqa: F401
+from .ring_attention import ring_attention, ring_self_attention  # noqa: F401

@@ -174,6 +174,42 @@ class MXIndexedRecordIO(MXRecordIO):
         self.seek(idx)
         return self.read()
 
+    def _native(self):
+        """Lazy mmap-backed native reader (librtio) with a key→ordinal map;
+        None when the native runtime is unavailable."""
+        nat = getattr(self, "_native_file", False)
+        if nat is not False:
+            return nat
+        self._native_file = None
+        if not self.writable:
+            try:
+                from ._native import NativeRecordFile
+
+                f = NativeRecordFile(self.uri)
+                start_to_ord = {}
+                lib = f._lib
+                for i in range(len(f)):
+                    start_to_ord[int(lib.rtio_record_start(f._h, i))] = i
+                self._native_ord = {k: start_to_ord[off]
+                                    for k, off in self.idx.items()
+                                    if off in start_to_ord}
+                if len(self._native_ord) == len(self.idx):
+                    self._native_file = f
+                else:
+                    f.close()
+            except Exception:
+                self._native_file = None
+        return self._native_file
+
+    def read_batch(self, keys):
+        """Read many records in one call. Uses the native mmap runtime
+        (`src/rtio/rtio.cc`) when available — one C call, one copy out of
+        the page cache — else falls back to per-key Python reads."""
+        nat = self._native()
+        if nat is not None:
+            return nat.read_batch([self._native_ord[k] for k in keys])
+        return [self.read_idx(k) for k in keys]
+
     def write_idx(self, idx, buf):
         pos = self.tell()
         self.write(buf)
@@ -189,6 +225,15 @@ class IndexCreator:
         self.idx_path = idx_path
 
     def create_index(self):
+        # native fast path: one mmap scan in C (src/rtio/rtio.cc)
+        try:
+            from ._native import build_index
+
+            n = build_index(self.reader.uri, self.idx_path)
+            if n is not None:
+                return
+        except Exception:
+            pass
         entries = []
         i = 0
         while True:

@@ -1,0 +1,69 @@
+// Example custom-op extension (reference: example/extensions/lib_custom_op)
+// — two elementwise float32 ops, used by tests/test_native.py to exercise
+// the MXLoadLib-analogue loader end-to-end.
+#include <cmath>
+#include <cstring>
+
+#include "mx_ext.h"
+
+namespace {
+
+int same_shape_infer(int n_in, const int64_t* const* in_shapes,
+                     const int* in_ndims, int64_t* out_shape, int* out_ndim) {
+  if (n_in < 1) return -1;
+  *out_ndim = in_ndims[0];
+  for (int d = 0; d < in_ndims[0]; ++d) out_shape[d] = in_shapes[0][d];
+  return 0;
+}
+
+int64_t numel(const MXExtTensor* t) {
+  int64_t n = 1;
+  for (int d = 0; d < t->ndim; ++d) n *= t->shape[d];
+  return n;
+}
+
+}  // namespace
+
+extern "C" {
+
+int mx_ext_abi_version(void) { return MX_EXT_ABI_VERSION; }
+
+int mx_ext_num_ops(void) { return 2; }
+
+const char* mx_ext_op_name(int op) {
+  switch (op) {
+    case 0: return "my_relu";
+    case 1: return "my_gelu";
+    default: return nullptr;
+  }
+}
+
+int mx_ext_op_infer_shape(int op, int n_in, const int64_t* const* in_shapes,
+                          const int* in_ndims, int64_t* out_shape,
+                          int* out_ndim) {
+  (void)op;
+  return same_shape_infer(n_in, in_shapes, in_ndims, out_shape, out_ndim);
+}
+
+int mx_ext_op_forward(int op, int n_in, const MXExtTensor* inputs,
+                      MXExtTensor* output) {
+  if (n_in != 1 || inputs[0].dtype != MX_EXT_FLOAT32) return -1;
+  const float* x = static_cast<const float*>(inputs[0].data);
+  float* y = static_cast<float*>(output->data);
+  const int64_t n = numel(&inputs[0]);
+  if (op == 0) {
+    for (int64_t i = 0; i < n; ++i) y[i] = x[i] > 0.f ? x[i] : 0.f;
+    return 0;
+  }
+  if (op == 1) {  // tanh-approximation GELU
+    constexpr float k = 0.7978845608028654f;  // sqrt(2/pi)
+    for (int64_t i = 0; i < n; ++i) {
+      const float v = x[i];
+      y[i] = 0.5f * v * (1.f + std::tanh(k * (v + 0.044715f * v * v * v)));
+    }
+    return 0;
+  }
+  return -1;
+}
+
+}  // extern "C"

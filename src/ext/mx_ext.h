@@ -1,0 +1,52 @@
+// Custom-operator extension ABI (reference: include/mxnet/lib_api.h —
+// MXLoadLib loads a shared library exporting op registrations).
+//
+// TPU-native contract: extension ops run on HOST buffers (the framework
+// bridges them onto the device via jax.pure_callback, so they compose with
+// jit/hybridize); the compute path proper stays XLA. An extension exports:
+//
+//   int mx_ext_abi_version(void);                 // must return MX_EXT_ABI_VERSION
+//   int mx_ext_num_ops(void);
+//   const char* mx_ext_op_name(int op);
+//   int mx_ext_op_infer_shape(int op, int n_in,
+//                             const int64_t* const* in_shapes,
+//                             const int* in_ndims,
+//                             int64_t* out_shape, int* out_ndim);
+//   int mx_ext_op_forward(int op, int n_in, const MXExtTensor* inputs,
+//                         MXExtTensor* output);
+//
+// All hooks return 0 on success. Single-output ops; out_shape has room for
+// MX_EXT_MAX_NDIM dims.
+#ifndef MX_EXT_H_
+#define MX_EXT_H_
+
+#include <stdint.h>
+
+#define MX_EXT_ABI_VERSION 1
+#define MX_EXT_MAX_NDIM 8
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef enum {
+  MX_EXT_FLOAT32 = 0,
+  MX_EXT_FLOAT64 = 1,
+  MX_EXT_INT32 = 2,
+  MX_EXT_INT64 = 3,
+  MX_EXT_UINT8 = 4,
+  MX_EXT_BOOL = 5,
+} MXExtDType;
+
+typedef struct {
+  int dtype;             // MXExtDType
+  int ndim;
+  const int64_t* shape;
+  void* data;            // contiguous row-major
+} MXExtTensor;
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif  // MX_EXT_H_

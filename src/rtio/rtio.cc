@@ -1,0 +1,154 @@
+// Native RecordIO runtime (reference: src/recordio.cc + the C++ IO layer
+// dmlc::RecordIOReader). mmap-based: the whole .rec is mapped read-only,
+// records are located by one scan (or the .idx), and batch reads memcpy
+// straight out of the page cache — no per-record Python framing overhead.
+//
+// Exposed as a C ABI for ctypes (no pybind11 in this environment).
+// Framing (recordio.py / reference src/recordio.cc):
+//   uint32 magic = 0xced7230a | uint32 lrec (low 29 bits = payload length)
+//   | payload | pad to 4-byte boundary
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+#include <vector>
+
+namespace {
+
+constexpr uint32_t kMagic = 0xced7230a;
+
+struct Handle {
+  int fd = -1;
+  const uint8_t* base = nullptr;
+  size_t size = 0;
+  std::vector<int64_t> offsets;  // payload offsets
+  std::vector<int64_t> lengths;
+  std::vector<int64_t> starts;   // header (record) offsets
+};
+
+}  // namespace
+
+extern "C" {
+
+// Open + scan a .rec file. Returns nullptr on failure.
+void* rtio_open(const char* rec_path) {
+  Handle* h = new Handle();
+  h->fd = ::open(rec_path, O_RDONLY);
+  if (h->fd < 0) {
+    delete h;
+    return nullptr;
+  }
+  struct stat st;
+  if (fstat(h->fd, &st) != 0 || st.st_size == 0) {
+    ::close(h->fd);
+    delete h;
+    return nullptr;
+  }
+  h->size = static_cast<size_t>(st.st_size);
+  void* m = mmap(nullptr, h->size, PROT_READ, MAP_PRIVATE, h->fd, 0);
+  if (m == MAP_FAILED) {
+    ::close(h->fd);
+    delete h;
+    return nullptr;
+  }
+  h->base = static_cast<const uint8_t*>(m);
+  size_t pos = 0;
+  while (pos + 8 <= h->size) {
+    uint32_t magic, lrec;
+    std::memcpy(&magic, h->base + pos, 4);
+    if (magic != kMagic) break;
+    std::memcpy(&lrec, h->base + pos + 4, 4);
+    const size_t len = lrec & ((1u << 29) - 1);
+    if (pos + 8 + len > h->size) break;
+    h->starts.push_back(static_cast<int64_t>(pos));
+    h->offsets.push_back(static_cast<int64_t>(pos + 8));
+    h->lengths.push_back(static_cast<int64_t>(len));
+    pos += 8 + len + ((4 - len % 4) % 4);
+  }
+  return h;
+}
+
+void rtio_close(void* hp) {
+  if (!hp) return;
+  Handle* h = static_cast<Handle*>(hp);
+  if (h->base) munmap(const_cast<uint8_t*>(h->base), h->size);
+  if (h->fd >= 0) ::close(h->fd);
+  delete h;
+}
+
+int64_t rtio_num_records(void* hp) {
+  return static_cast<Handle*>(hp)->offsets.size();
+}
+
+// Zero-copy view of record i (valid while the handle is open).
+int rtio_record(void* hp, int64_t i, const uint8_t** data, int64_t* len) {
+  Handle* h = static_cast<Handle*>(hp);
+  if (i < 0 || i >= static_cast<int64_t>(h->offsets.size())) return -1;
+  *data = h->base + h->offsets[i];
+  *len = h->lengths[i];
+  return 0;
+}
+
+int64_t rtio_record_start(void* hp, int64_t i) {
+  Handle* h = static_cast<Handle*>(hp);
+  if (i < 0 || i >= static_cast<int64_t>(h->starts.size())) return -1;
+  return h->starts[i];
+}
+
+// Total payload bytes for a batch (to size the caller's buffer).
+int64_t rtio_batch_bytes(void* hp, const int64_t* idxs, int64_t n) {
+  Handle* h = static_cast<Handle*>(hp);
+  int64_t total = 0;
+  for (int64_t j = 0; j < n; ++j) {
+    const int64_t i = idxs[j];
+    if (i < 0 || i >= static_cast<int64_t>(h->lengths.size())) return -1;
+    total += h->lengths[i];
+  }
+  return total;
+}
+
+// Copy a batch of records into `out`, filling per-record offsets/lengths.
+int rtio_read_batch(void* hp, const int64_t* idxs, int64_t n, uint8_t* out,
+                    int64_t cap, int64_t* offsets, int64_t* lengths) {
+  Handle* h = static_cast<Handle*>(hp);
+  int64_t pos = 0;
+  for (int64_t j = 0; j < n; ++j) {
+    const int64_t i = idxs[j];
+    if (i < 0 || i >= static_cast<int64_t>(h->offsets.size())) return -1;
+    const int64_t len = h->lengths[i];
+    if (pos + len > cap) return -2;
+    std::memcpy(out + pos, h->base + h->offsets[i], len);
+    offsets[j] = pos;
+    lengths[j] = len;
+    pos += len;
+  }
+  return 0;
+}
+
+// Scan a .rec and write a "<key>\t<header offset>\n" .idx file
+// (reference: tools/rec2idx / recordio.py IndexCreator).
+int64_t rtio_build_index(const char* rec_path, const char* idx_path) {
+  void* hp = rtio_open(rec_path);
+  if (!hp) return -1;
+  Handle* h = static_cast<Handle*>(hp);
+  FILE* f = std::fopen(idx_path, "w");
+  if (!f) {
+    rtio_close(hp);
+    return -1;
+  }
+  const int64_t n = static_cast<int64_t>(h->starts.size());
+  for (int64_t i = 0; i < n; ++i) {
+    std::fprintf(f, "%lld\t%lld\n", static_cast<long long>(i),
+                 static_cast<long long>(h->starts[i]));
+  }
+  std::fclose(f);
+  rtio_close(hp);
+  return n;
+}
+
+}  // extern "C"

@@ -1,0 +1,142 @@
+"""Native runtime tests: librtio RecordIO reader + the custom-op extension
+ABI (reference: C++ IO layer + `tests/python/unittest/test_extensions.py`
+MXLoadLib cases). Skipped when no C++ toolchain is present."""
+import os
+import shutil
+import subprocess
+
+import numpy as onp
+import pytest
+
+from incubator_mxnet_tpu import np
+from incubator_mxnet_tpu import _native
+from incubator_mxnet_tpu.recordio import (IndexCreator, IRHeader,
+                                          MXIndexedRecordIO, MXRecordIO,
+                                          pack, unpack)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+pytestmark = pytest.mark.skipif(shutil.which("g++") is None,
+                                reason="no C++ toolchain")
+
+
+@pytest.fixture(scope="module")
+def native_libs():
+    subprocess.run(["make", "-C", os.path.join(REPO, "src")],
+                   check=True, capture_output=True)
+    return os.path.join(REPO, "build")
+
+
+def _write_rec(tmp_path, n=20):
+    rec_path = str(tmp_path / "data.rec")
+    idx_path = str(tmp_path / "data.idx")
+    rec = MXIndexedRecordIO(idx_path, rec_path, "w")
+    payloads = []
+    for i in range(n):
+        payload = pack(IRHeader(0, float(i), i, 0),
+                       bytes([i % 251]) * (10 + 13 * i))
+        rec.write_idx(i, payload)
+        payloads.append(payload)
+    rec.close()
+    return rec_path, idx_path, payloads
+
+
+def test_rtio_reader_matches_python(tmp_path, native_libs):
+    rec_path, idx_path, payloads = _write_rec(tmp_path)
+    f = _native.NativeRecordFile(rec_path)
+    assert len(f) == len(payloads)
+    for i, want in enumerate(payloads):
+        assert f.read(i) == want
+    f.close()
+
+
+def test_rtio_batch_read(tmp_path, native_libs):
+    rec_path, idx_path, payloads = _write_rec(tmp_path)
+    f = _native.NativeRecordFile(rec_path)
+    idxs = [3, 0, 17, 17, 5]
+    got = f.read_batch(idxs)
+    assert got == [payloads[i] for i in idxs]
+    f.close()
+
+
+def test_rtio_build_index_matches_python(tmp_path, native_libs):
+    rec_path, idx_path, _ = _write_rec(tmp_path)
+    native_idx = str(tmp_path / "native.idx")
+    n = _native.build_index(rec_path, native_idx)
+    assert n == 20
+    assert open(native_idx).read() == open(idx_path).read()
+
+
+def test_indexed_recordio_read_batch(tmp_path, native_libs):
+    rec_path, idx_path, payloads = _write_rec(tmp_path)
+    rec = MXIndexedRecordIO(idx_path, rec_path, "r")
+    got = rec.read_batch([2, 9, 2])
+    assert got == [payloads[2], payloads[9], payloads[2]]
+    # payloads still unpack correctly
+    header, content = unpack(got[1])
+    assert header.label == 9.0
+    rec.close()
+
+
+def test_index_creator_uses_native(tmp_path, native_libs):
+    rec_path, idx_path, _ = _write_rec(tmp_path)
+    out_idx = str(tmp_path / "rebuilt.idx")
+    c = IndexCreator(rec_path, out_idx)
+    c.create_index()
+    c.close()
+    assert open(out_idx).read() == open(idx_path).read()
+
+
+# -- extension ABI ------------------------------------------------------------
+
+def test_extension_load_and_run(native_libs):
+    from incubator_mxnet_tpu import library, npx
+
+    ops = library.load(os.path.join(native_libs, "libexample_ext.so"),
+                       verbose=False)
+    assert set(ops) == {"my_relu", "my_gelu"}
+    x = np.array(onp.array([-1.0, 0.5, 2.0], "float32"))
+    out = npx.my_relu(x)
+    onp.testing.assert_array_equal(out.asnumpy(), [0.0, 0.5, 2.0])
+    gelu = npx.my_gelu(x).asnumpy()
+    import math
+
+    want = [0.5 * v * (1 + math.tanh(0.7978845608 * (v + 0.044715 * v ** 3)))
+            for v in [-1.0, 0.5, 2.0]]
+    onp.testing.assert_allclose(gelu, want, rtol=1e-5)
+
+
+def test_extension_op_under_hybridize(native_libs):
+    """pure_callback bridging: the C op must run inside a jit-compiled
+    (hybridized) forward."""
+    from incubator_mxnet_tpu import gluon, library
+    from incubator_mxnet_tpu.gluon.block import HybridBlock
+
+    ops = library.load(os.path.join(native_libs, "libexample_ext.so"),
+                       verbose=False)
+    my_relu = ops["my_relu"]
+
+    class Net(HybridBlock):
+        def __init__(self):
+            super().__init__()
+            self.dense = gluon.nn.Dense(4)
+
+        def forward(self, x):
+            return my_relu(self.dense(x))
+
+    net = Net()
+    net.initialize()
+    net.hybridize()
+    x = np.random.uniform(low=-1, size=(2, 3))
+    y_eager = net(x)          # eager (completes deferred init)
+    y_jit = net(x)            # compiled replay through pure_callback
+    onp.testing.assert_allclose(y_eager.asnumpy(), y_jit.asnumpy(),
+                                rtol=1e-6)
+    assert (y_jit.asnumpy() >= 0).all()
+
+
+def test_extension_bad_library_rejected(tmp_path, native_libs):
+    from incubator_mxnet_tpu import library
+
+    with pytest.raises((ValueError, OSError)):
+        library.load(os.path.join(native_libs, "librtio.so"))
