@@ -63,6 +63,10 @@ def rtio():
             ctypes.POINTER(ctypes.c_int64)]
         lib.rtio_record_start.restype = ctypes.c_int64
         lib.rtio_record_start.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+        lib.rtio_record_starts.restype = ctypes.c_int64
+        lib.rtio_record_starts.argtypes = [ctypes.c_void_p,
+                                           ctypes.POINTER(ctypes.c_int64),
+                                           ctypes.c_int64]
         lib.rtio_batch_bytes.restype = ctypes.c_int64
         lib.rtio_batch_bytes.argtypes = [
             ctypes.c_void_p, ctypes.POINTER(ctypes.c_int64), ctypes.c_int64]
@@ -101,6 +105,15 @@ class NativeRecordFile:
                                  ctypes.byref(ln)) != 0:
             raise IndexError(i)
         return ctypes.string_at(data, ln.value)
+
+    def record_starts(self):
+        """All record header offsets in one native call."""
+        n = len(self)
+        out = (ctypes.c_int64 * n)()
+        got = self._lib.rtio_record_starts(self._h, out, n)
+        if got != n:
+            raise IOError("rtio_record_starts failed")
+        return list(out)
 
     def read_batch(self, idxs) -> list[bytes]:
         """One C call for the whole batch (single copy out of page cache)."""

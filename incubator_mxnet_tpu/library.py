@@ -49,6 +49,11 @@ def _bind(lib):
 
 def _infer_shape(lib, op_idx, in_shapes):
     n_in = len(in_shapes)
+    for s in in_shapes:
+        if len(s) > _MAX_NDIM:
+            raise ValueError(
+                f"extension ops support at most {_MAX_NDIM} dims, got "
+                f"{len(s)} (the ABI's out_shape buffer is fixed-size)")
     shape_arrays = [(ctypes.c_int64 * len(s))(*s) for s in in_shapes]
     shape_ptrs = (ctypes.POINTER(ctypes.c_int64) * n_in)(
         *[ctypes.cast(a, ctypes.POINTER(ctypes.c_int64))
@@ -97,6 +102,12 @@ def _make_op(lib, op_idx, name):
 
         def jfn(*vals):
             in_shapes = [tuple(v.shape) for v in vals]
+            for v in vals:
+                if str(v.dtype) not in _DTYPE_CODES:
+                    raise ValueError(
+                        f"extension ops support dtypes "
+                        f"{sorted(_DTYPE_CODES)}; got {v.dtype} — cast "
+                        "inputs (e.g. .astype('float32')) before the op")
             out_shape = _infer_shape(lib, op_idx, in_shapes)
             out_dtype = onp.dtype(str(vals[0].dtype))
 

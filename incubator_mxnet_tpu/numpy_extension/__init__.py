@@ -1110,7 +1110,13 @@ def while_loop(cond, func, loop_vars, max_iterations=None):
     """Loop func while cond holds (reference: control_flow.cc _while_loop).
     Traced: lowers to lax.while_loop; per the reference contract, the
     stacked per-step outputs require `max_iterations` (the output buffer is
-    preallocated to that length, tail untouched)."""
+    preallocated to that length, tail zeros).
+
+    `cond` and `func` must be PURE (the reference builds them into
+    sub-graphs, src/operator/control_flow.cc): with `max_iterations` set,
+    `func` may be invoked once as a shape probe even when the loop runs
+    zero iterations, so its output shape can match the traced path's
+    preallocated buffers."""
     from ..ndarray.ndarray import NDArray
 
     loop_vars = list(loop_vars)

@@ -103,6 +103,10 @@ class MXRecordIO:
     def __getstate__(self):
         d = dict(self.__dict__)
         d.pop("_fp", None)
+        # native handles (ctypes CDLL + raw pointers) cannot pickle;
+        # they re-materialize lazily after unpickling
+        d.pop("_native_file", None)
+        d.pop("_native_ord", None)
         d["is_open"] = False
         return d
 
@@ -186,10 +190,8 @@ class MXIndexedRecordIO(MXRecordIO):
                 from ._native import NativeRecordFile
 
                 f = NativeRecordFile(self.uri)
-                start_to_ord = {}
-                lib = f._lib
-                for i in range(len(f)):
-                    start_to_ord[int(lib.rtio_record_start(f._h, i))] = i
+                start_to_ord = {off: i
+                                for i, off in enumerate(f.record_starts())}
                 self._native_ord = {k: start_to_ord[off]
                                     for k, off in self.idx.items()
                                     if off in start_to_ord}

@@ -100,6 +100,16 @@ int64_t rtio_record_start(void* hp, int64_t i) {
   return h->starts[i];
 }
 
+// Fill `out` (capacity cap) with all record header offsets in one call —
+// avoids one FFI round trip per record on large files.
+int64_t rtio_record_starts(void* hp, int64_t* out, int64_t cap) {
+  Handle* h = static_cast<Handle*>(hp);
+  const int64_t n = static_cast<int64_t>(h->starts.size());
+  if (cap < n) return -1;
+  std::memcpy(out, h->starts.data(), n * sizeof(int64_t));
+  return n;
+}
+
 // Total payload bytes for a batch (to size the caller's buffer).
 int64_t rtio_batch_bytes(void* hp, const int64_t* idxs, int64_t n) {
   Handle* h = static_cast<Handle*>(hp);
