@@ -56,6 +56,7 @@ from . import gluon  # noqa: F401
 from . import kvstore  # noqa: F401
 from . import kvstore as kv  # noqa: F401
 from . import library  # noqa: F401
+from . import operator  # noqa: F401
 from . import io  # noqa: F401
 from . import parallel  # noqa: F401
 from . import profiler  # noqa: F401

@@ -47,10 +47,29 @@ def rtio():
             _build_native()
         if not os.path.exists(path):
             return None
-        try:
-            lib = ctypes.CDLL(path)
-        except OSError:
-            return None
+        lib = _load_and_bind(path)
+        if lib is None and _build_native():
+            # stale prebuilt .so missing a newer symbol: dlopen caches by
+            # pathname (reloading the same path returns the stale handle),
+            # so load the rebuilt library through a unique temp copy
+            import shutil
+            import tempfile
+
+            try:
+                tmp = tempfile.NamedTemporaryFile(suffix=".so",
+                                                  delete=False)
+                tmp.close()
+                shutil.copy2(path, tmp.name)
+                lib = _load_and_bind(tmp.name)
+            except OSError:
+                lib = None
+        _RTIO = lib
+        return _RTIO
+
+
+def _load_and_bind(path):
+    try:
+        lib = ctypes.CDLL(path)
         lib.rtio_open.restype = ctypes.c_void_p
         lib.rtio_open.argtypes = [ctypes.c_char_p]
         lib.rtio_close.argtypes = [ctypes.c_void_p]
@@ -77,8 +96,10 @@ def rtio():
             ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64)]
         lib.rtio_build_index.restype = ctypes.c_int64
         lib.rtio_build_index.argtypes = [ctypes.c_char_p, ctypes.c_char_p]
-        _RTIO = lib
-        return _RTIO
+        return lib
+    except (OSError, AttributeError):
+        # unloadable, or a stale prebuilt .so missing a newer symbol
+        return None
 
 
 class NativeRecordFile:

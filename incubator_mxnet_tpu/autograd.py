@@ -112,7 +112,7 @@ class TapeNode:
     """
 
     __slots__ = ("fn", "input_values", "parents", "n_outputs", "name", "seq",
-                 "vjp_fn", "out_avals", "tuple_out")
+                 "vjp_fn", "out_avals", "tuple_out", "vjp_key")
 
     def __init__(self, fn, input_values, parents, n_outputs, name, vjp_fn=None):
         self.fn = fn
@@ -121,6 +121,7 @@ class TapeNode:
         self.n_outputs = n_outputs
         self.name = name
         self.vjp_fn = vjp_fn  # optional precomputed vjp
+        self.vjp_key = None   # stable cache key for a jitted vjp-applier
         self.out_avals = None
         self.tuple_out = n_outputs > 1  # fn returns a tuple even of length 1?
         _NODE_COUNTER[0] += 1
@@ -162,6 +163,47 @@ def _toposort(heads):
                 work.append((pn, False))
     order.sort(key=lambda n: n.seq, reverse=True)
     return order
+
+
+_VJP_CACHE: dict = {}
+_VJP_CACHE_CAP = 1024
+_VJP_DENY: set = set()
+_VJP_FAILS: dict = {}
+_VJP_MAX_FAILS = 3  # transient remote-compile drops shouldn't deny forever
+
+
+def _apply_vjp(node, arg):
+    """Compute a node's input cotangents. For ops with a stable cache key
+    (the numpy mapper path), the whole linearize+transpose is jit-compiled
+    once per (op, statics) and replayed on later backward passes — the
+    reference engine's replay-only-backward behavior; other nodes fall back
+    to a fresh jax.vjp (which re-runs the forward)."""
+    import jax
+
+    key = node.vjp_key
+    if key is not None and key not in _VJP_DENY:
+        try:
+            applier = _VJP_CACHE.get(key)
+            if applier is None:
+                if len(_VJP_CACHE) >= _VJP_CACHE_CAP:
+                    for stale in list(_VJP_CACHE)[:_VJP_CACHE_CAP // 2]:
+                        _VJP_CACHE.pop(stale, None)
+                fn = node.fn
+
+                @jax.jit
+                def applier(inputs, cot, fn=fn):
+                    _, vf = jax.vjp(fn, *inputs)
+                    return vf(cot)
+
+                _VJP_CACHE[key] = applier
+            return applier(tuple(node.input_values), arg)
+        except Exception:
+            _VJP_CACHE.pop(key, None)
+            _VJP_FAILS[key] = _VJP_FAILS.get(key, 0) + 1
+            if _VJP_FAILS[key] >= _VJP_MAX_FAILS:
+                _VJP_DENY.add(key)
+    _, vjp_fn = jax.vjp(node.fn, *node.input_values)
+    return vjp_fn(arg)
 
 
 def backward(heads, head_grads=None, retain_graph=False, train_mode=True):  # noqa: ARG001
@@ -247,12 +289,11 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True):  # no
                              av.dtype)
             for c, av in zip(cots, node.out_avals)
         ]
-        if node.vjp_fn is not None:
-            vjp_fn = node.vjp_fn
-        else:
-            _, vjp_fn = jax.vjp(node.fn, *node.input_values)
         arg = tuple(cots) if node.tuple_out else cots[0]
-        in_cots = vjp_fn(arg)
+        if node.vjp_fn is not None:
+            in_cots = node.vjp_fn(arg)
+        else:
+            in_cots = _apply_vjp(node, arg)
         for parent, ict in zip(node.parents, in_cots):
             if ict is None:
                 continue
@@ -427,7 +468,9 @@ class Function:
     def __call__(self, *inputs):
         from .ndarray.ndarray import NDArray, _attach_custom_node
 
-        with pause():
+        # stop recording but PRESERVE the training flag: custom forwards
+        # (CustomOp, dropout-bearing Functions) must see is_training()
+        with pause(train_mode=is_training()):
             outputs = self.forward(*inputs)
         single = not isinstance(outputs, (list, tuple))
         outs = [outputs] if single else list(outputs)

@@ -44,6 +44,10 @@ _LEGACY_TO_NPX = {
 
 
 def __getattr__(name):
+    if name == "Custom":
+        from ..operator import Custom
+
+        return Custom
     if name in _LEGACY_TO_NPX:
         from .. import numpy_extension as npx
 

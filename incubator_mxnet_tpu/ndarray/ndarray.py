@@ -680,12 +680,26 @@ def _jit_deny(name, key):
     _JIT_DENY.add(name)
 
 
-def _cached_jit(name, jfn, args, kwargs, pure_fn, call_vals):
+def _op_cache_key(jfn, name, args, kwargs):
+    """Shared cache key for the forward op-call jit cache AND the backward
+    vjp-applier cache — one definition so the two can't drift. Raises
+    TypeError for unhashable statics (caller falls back to eager)."""
+    from .. import amp as amp_mod
+
+    # the op's own AMP cast mode (None for unlisted ops), so toggling AMP
+    # only invalidates entries whose compiled program actually contains casts
+    return (jfn, amp_mod.op_cast_mode(name),
+            tuple(_static_marker(a) for a in args),
+            tuple((k, _static_marker(v)) for k, v in sorted(kwargs.items())))
+
+
+def _cached_jit(name, key, pure_fn, call_vals):
     """Op-call cache for the eager path (SURVEY §7 'op-call cache keyed by
     (op, shapes, dtypes)'): jit-compile pure_fn once per (op fn, static
     args/kwargs shape) and let jax's own executable cache key on operand
-    avals. Returns None when this call isn't cacheable — caller runs
-    eagerly.
+    avals. `key` is the caller-built `_op_cache_key` (shared with the
+    backward vjp cache). Returns None when this call isn't cacheable —
+    caller runs eagerly.
 
     Only used for ops whose jfn has stable identity and fully-explicit
     static parameters (the generated `np` namespace); ops with values
@@ -694,19 +708,7 @@ def _cached_jit(name, jfn, args, kwargs, pure_fn, call_vals):
         return None
     import jax
 
-    try:
-        from .. import amp as amp_mod
-
-        # key on THIS op's cast mode (None for unlisted ops), so toggling
-        # AMP only invalidates entries whose compiled program actually
-        # contains casts
-        key = (jfn, amp_mod.op_cast_mode(name),
-               tuple(_static_marker(a) for a in args),
-               tuple((k, _static_marker(v)) for k, v in
-                     sorted(kwargs.items())))
-        jitted = _JIT_CACHE.get(key)
-    except TypeError:
-        return None
+    jitted = _JIT_CACHE.get(key)
     if jitted is None:
         if len(_JIT_CACHE) >= _JIT_CACHE_CAP:
             # scalar-valued keys can be unbounded (e.g. x * python_scalar
@@ -779,10 +781,17 @@ def apply_op_flat(name, jfn, args, kwargs=None, n_outputs=None,
         return tuple(outs) if isinstance(outs, list) else outs
 
     outs = None
-    if cacheable and not any(_is_tracer(v) for v in tensor_vals):
+    cache_key = None
+    cacheable_now = cacheable and not any(_is_tracer(v) for v in tensor_vals)
+    if cacheable_now:
+        try:  # built ONCE, shared by the forward jit and backward vjp caches
+            cache_key = _op_cache_key(jfn, name, args, kwargs)
+        except TypeError:
+            cache_key = None
+    if cache_key is not None:
         prof = _active_profiler()
         t0 = time.perf_counter() if prof is not None else 0
-        outs = _cached_jit(name, jfn, args, kwargs, pure_fn, tensor_vals)
+        outs = _cached_jit(name, cache_key, pure_fn, tensor_vals)
         if outs is not None and prof is not None:
             prof.record_op(name, time.perf_counter() - t0)
     if outs is None:
@@ -796,6 +805,11 @@ def apply_op_flat(name, jfn, args, kwargs=None, n_outputs=None,
         node = TapeNode(pure_fn, tensor_vals, parents, len(out_list), name)
         node.out_avals = [_ShapeDtype(o) for o in out_list]
         node.tuple_out = tuple_out
+        if cache_key is not None and name not in _JIT_DENY:
+            # stable-identity op: backward can reuse a jitted vjp-applier
+            # keyed like the forward cache (VERDICT r1 weak 6 — without
+            # this every eager backward re-runs the op's forward)
+            node.vjp_key = ("vjp",) + cache_key
         for i, w in enumerate(wrapped):
             w._node = node
             w._out_idx = i

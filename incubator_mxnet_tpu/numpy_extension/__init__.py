@@ -37,7 +37,23 @@ __all__ = [
     "stop_gradient", "foreach", "while_loop", "cond", "set_np", "reset_np",
     "is_np_array", "is_np_shape", "waitall", "load", "save", "seed",
     "gelu", "smooth_l1", "clip_global_norm",
+    "box_iou", "box_nms", "box_encode", "box_decode", "bipartite_matching",
+    "roi_align", "slice_like", "broadcast_like", "batch_take",
 ]
+
+
+from ._boxes import (  # noqa: F401
+    batch_take, bipartite_matching, box_decode, box_encode, box_iou,
+    box_nms, broadcast_like, roi_align, slice_like,
+)
+
+
+def __getattr__(name):
+    if name == "Custom":  # lazy: operator.py imports back into this package
+        from ..operator import Custom
+
+        return Custom
+    raise AttributeError(f"module 'npx' has no attribute {name!r}")
 
 
 def _jnp():

@@ -1,0 +1,347 @@
+"""Bounding-box / detection operator family (reference:
+`src/operator/contrib/bounding_box.cc` — box_iou, box_nms, box_encode,
+box_decode, bipartite_matching — and `src/operator/contrib/roi_align.cc`).
+
+TPU-native: everything is expressed as fixed-shape tensor math (sort +
+masked suppression scans instead of data-dependent loops), so the whole
+family jit-compiles and batches on the MXU. Suppressed/invalid results use
+the reference's -1 sentinel convention.
+"""
+from __future__ import annotations
+
+from ..ndarray.ndarray import apply_op_flat
+
+__all__ = ["box_iou", "box_nms", "box_encode", "box_decode",
+           "bipartite_matching", "roi_align", "slice_like",
+           "broadcast_like", "batch_take"]
+
+
+def _jnp():
+    import jax.numpy as jnp
+
+    return jnp
+
+
+def _to_corner(b, fmt):
+    jnp = _jnp()
+    if fmt == "corner":
+        return b
+    # center: (x, y, w, h) → (xmin, ymin, xmax, ymax)
+    xy = b[..., :2]
+    wh = b[..., 2:4] / 2.0
+    return jnp.concatenate([xy - wh, xy + wh], axis=-1)
+
+
+def _corner_to_center(b):
+    jnp = _jnp()
+    wh = b[..., 2:4] - b[..., :2]
+    xy = (b[..., :2] + b[..., 2:4]) / 2.0
+    return jnp.concatenate([xy, wh], axis=-1)
+
+
+def _iou_corner(lhs, rhs):
+    """lhs (..., N, 4), rhs (..., M, 4) corners → (..., N, M) IoU."""
+    jnp = _jnp()
+    lt = jnp.maximum(lhs[..., :, None, :2], rhs[..., None, :, :2])
+    rb = jnp.minimum(lhs[..., :, None, 2:4], rhs[..., None, :, 2:4])
+    wh = jnp.clip(rb - lt, 0.0, None)
+    inter = wh[..., 0] * wh[..., 1]
+    area_l = ((lhs[..., 2] - lhs[..., 0])
+              * (lhs[..., 3] - lhs[..., 1]))[..., :, None]
+    area_r = ((rhs[..., 2] - rhs[..., 0])
+              * (rhs[..., 3] - rhs[..., 1]))[..., None, :]
+    return inter / jnp.maximum(area_l + area_r - inter, 1e-12)
+
+
+def box_iou(lhs, rhs, format="corner"):  # noqa: A002
+    """Pairwise IoU (reference: bounding_box.cc:118 _contrib_box_iou)."""
+    def fn(a, b):
+        return _iou_corner(_to_corner(a, format), _to_corner(b, format))
+
+    return apply_op_flat("box_iou", fn, (lhs, rhs), {})
+
+
+def box_nms(data, overlap_thresh=0.5, valid_thresh=0.0, topk=-1,
+            coord_start=2, score_index=1, id_index=-1, background_id=-1,
+            force_suppress=False, in_format="corner", out_format="corner"):  # noqa: ARG001
+    """Non-maximum suppression (reference: bounding_box.cc _contrib_box_nms).
+
+    data: (..., N, K) rows [id?, score, x1, y1, x2, y2, ...]. Reference
+    output semantics (bounding_box-inl.h:326): surviving rows compacted to
+    the top in score order, all remaining rows filled with -1."""
+    def fn(d):
+        jnp = _jnp()
+        batch_shape = d.shape[:-2]
+        n, k = d.shape[-2], d.shape[-1]
+        flat = d.reshape((-1, n, k))
+
+        def one(batch):
+            scores = batch[:, score_index]
+            order = jnp.argsort(-scores)  # descending
+            sorted_rows = batch[order]
+            s_scores = sorted_rows[:, score_index]
+            boxes = _to_corner(
+                sorted_rows[:, coord_start:coord_start + 4], in_format)
+            iou = _iou_corner(boxes, boxes)
+            valid = s_scores > valid_thresh
+            if topk > 0:
+                valid = valid & (jnp.arange(n) < topk)
+            if id_index >= 0 and not force_suppress:
+                ids = sorted_rows[:, id_index]
+                same_class = ids[:, None] == ids[None, :]
+            else:
+                same_class = jnp.ones((n, n), bool)
+            if id_index >= 0 and background_id >= 0:
+                valid = valid & (sorted_rows[:, id_index] != background_id)
+            suppress_pair = (iou > overlap_thresh) & same_class
+
+            # greedy scan in score order: row i survives unless suppressed
+            # by an earlier surviving row
+            def body(i, keep):
+                sup = (suppress_pair[:, i] & keep
+                       & (jnp.arange(n) < i)).any()
+                return keep.at[i].set(keep[i] & ~sup)
+
+            import jax
+
+            keep = jax.lax.fori_loop(0, n, body, valid)
+            if out_format != in_format:
+                conv = (boxes if out_format == "corner"
+                        else _corner_to_center(boxes))
+                sorted_rows = sorted_rows.at[
+                    :, coord_start:coord_start + 4].set(conv)
+            # compact survivors to the top (stable: argsort of ~keep keeps
+            # score order within each group), fill the tail with -1
+            perm = jnp.argsort(~keep, stable=True)
+            compacted = sorted_rows[perm]
+            row_valid = keep[perm]
+            return jnp.where(row_valid[:, None], compacted, -1.0)
+
+        import jax
+
+        out = jax.vmap(one)(flat)
+        return out.reshape(batch_shape + (n, k))
+
+    return apply_op_flat("box_nms", fn, (data,), {})
+
+
+def box_encode(samples, matches, anchors, refs, means=(0., 0., 0., 0.),
+               stds=(0.1, 0.1, 0.2, 0.2)):
+    """SSD-style box target encoding (reference: bounding_box.cc
+    _contrib_box_encode). anchors/refs are corner boxes; outputs
+    (targets, masks) with mask = sample>0.5."""
+    def fn(sm, mt, an, rf):
+        jnp = _jnp()
+        # gather the matched reference box per anchor
+        ref = jnp.take_along_axis(rf, mt[..., None].astype("int32"), axis=1)
+        a_w = an[..., 2] - an[..., 0]
+        a_h = an[..., 3] - an[..., 1]
+        a_x = (an[..., 0] + an[..., 2]) / 2.0
+        a_y = (an[..., 1] + an[..., 3]) / 2.0
+        r_w = ref[..., 2] - ref[..., 0]
+        r_h = ref[..., 3] - ref[..., 1]
+        r_x = (ref[..., 0] + ref[..., 2]) / 2.0
+        r_y = (ref[..., 1] + ref[..., 3]) / 2.0
+        t = jnp.stack([
+            ((r_x - a_x) / jnp.maximum(a_w, 1e-12) - means[0]) / stds[0],
+            ((r_y - a_y) / jnp.maximum(a_h, 1e-12) - means[1]) / stds[1],
+            (jnp.log(jnp.maximum(r_w, 1e-12)
+                     / jnp.maximum(a_w, 1e-12)) - means[2]) / stds[2],
+            (jnp.log(jnp.maximum(r_h, 1e-12)
+                     / jnp.maximum(a_h, 1e-12)) - means[3]) / stds[3],
+        ], axis=-1)
+        mask = (sm > 0.5).astype(t.dtype)[..., None]
+        return t * mask, jnp.broadcast_to(mask, t.shape)
+
+    return apply_op_flat("box_encode", fn, (samples, matches, anchors, refs),
+                         {}, n_outputs=2)
+
+
+def box_decode(data, anchors, std0=0.1, std1=0.1, std2=0.2, std3=0.2,
+               clip=-1.0, format="center"):  # noqa: A002
+    """Decode SSD regression deltas back to boxes (reference:
+    bounding_box.cc _contrib_box_decode). anchors in `format`; returns
+    corner boxes."""
+    def fn(d, an):
+        jnp = _jnp()
+        anc = _to_corner(an, format)
+        a_w = anc[..., 2] - anc[..., 0]
+        a_h = anc[..., 3] - anc[..., 1]
+        a_x = (anc[..., 0] + anc[..., 2]) / 2.0
+        a_y = (anc[..., 1] + anc[..., 3]) / 2.0
+        dx = d[..., 0] * std0 * a_w + a_x
+        dy = d[..., 1] * std1 * a_h + a_y
+        dw = d[..., 2] * std2
+        dh = d[..., 3] * std3
+        if clip > 0:
+            dw = jnp.minimum(dw, clip)
+            dh = jnp.minimum(dh, clip)
+        w = jnp.exp(dw) * a_w / 2.0
+        h = jnp.exp(dh) * a_h / 2.0
+        return jnp.stack([dx - w, dy - h, dx + w, dy + h], axis=-1)
+
+    return apply_op_flat("box_decode", fn, (data, anchors), {})
+
+
+def bipartite_matching(data, threshold, is_ascend=False, topk=-1):  # noqa: ARG001
+    """Greedy bipartite matching over a (..., N, M) affinity matrix
+    (reference: bounding_box.cc _contrib_bipartite_matching). Returns
+    (row_match, col_match): for each row, the matched column (or -1), and
+    for each column, the matched row (or -1)."""
+    def fn(d):
+        import jax
+
+        jnp = _jnp()
+        batch_shape = d.shape[:-2]
+        n, m = d.shape[-2], d.shape[-1]
+        flat = d.reshape((-1, n, m))
+        sign = 1.0 if is_ascend else -1.0
+        big = jnp.asarray(jnp.inf, d.dtype)
+
+        def one(mat):
+            work = sign * mat  # minimize
+
+            def body(_, carry):
+                work, row_m, col_m = carry
+                idx = jnp.argmin(work)
+                i, j = idx // m, idx % m
+                ok = work[i, j] < big
+                row_m = jnp.where(ok, row_m.at[i].set(j), row_m)
+                col_m = jnp.where(ok, col_m.at[j].set(i), col_m)
+                work = jnp.where(ok, work.at[i, :].set(big), work)
+                work = jnp.where(ok, work.at[:, j].set(big), work)
+                return work, row_m, col_m
+
+            row_m = jnp.full((n,), -1, jnp.int32)
+            col_m = jnp.full((m,), -1, jnp.int32)
+            steps = min(n, m) if topk <= 0 else min(topk, n, m)
+            _, row_m, col_m = jax.lax.fori_loop(0, steps, body,
+                                                (work, row_m, col_m))
+            if threshold is not None:
+                vals = jnp.take_along_axis(
+                    mat, jnp.clip(row_m, 0)[:, None].astype("int32"),
+                    axis=1)[:, 0]
+                bad = (row_m >= 0) & ((vals < threshold) if not is_ascend
+                                      else (vals > threshold))
+
+                def clear_col(k, cm):
+                    j = jnp.clip(row_m[k], 0)
+                    return jnp.where(bad[k], cm.at[j].set(-1), cm)
+
+                col_m = jax.lax.fori_loop(0, n, clear_col, col_m)
+                row_m = jnp.where(bad, -1, row_m)
+            return row_m, col_m
+
+        rows, cols = jax.vmap(one)(flat)
+        return (rows.reshape(batch_shape + (n,)),
+                cols.reshape(batch_shape + (m,)))
+
+    return apply_op_flat("bipartite_matching", fn, (data,), {}, n_outputs=2)
+
+
+def roi_align(data, rois, pooled_size, spatial_scale=1.0, sample_ratio=2,
+              position_sensitive=False):
+    """ROI Align with bilinear sampling (reference:
+    `src/operator/contrib/roi_align.cc`). data (N, C, H, W); rois (R, 5)
+    rows [batch_idx, x1, y1, x2, y2] in image coords; returns
+    (R, C, ph, pw).
+
+    Divergence from the reference: `sample_ratio <= 0` (the reference's
+    per-ROI adaptive ceil(roi_size/pooled_size) sampling) is data-dependent
+    and cannot compile to static shapes; it maps to a fixed 2×2 sample
+    grid per bin here. Pass an explicit positive sample_ratio for exact
+    reference parity."""
+    if position_sensitive:
+        raise NotImplementedError(
+            "roi_align: position_sensitive (PSRoIAlign) is not implemented")
+    def fn(x, r):
+        import jax
+
+        jnp = _jnp()
+        ph, pw = (pooled_size if isinstance(pooled_size, (tuple, list))
+                  else (pooled_size, pooled_size))
+        n, c, h, w = x.shape
+        ns = int(sample_ratio) if sample_ratio > 0 else 2
+
+        def one_roi(roi):
+            bidx = roi[0].astype("int32")
+            x1, y1, x2, y2 = (roi[1] * spatial_scale, roi[2] * spatial_scale,
+                              roi[3] * spatial_scale, roi[4] * spatial_scale)
+            rw = jnp.maximum(x2 - x1, 1.0)
+            rh = jnp.maximum(y2 - y1, 1.0)
+            bin_w = rw / pw
+            bin_h = rh / ph
+            # ns×ns bilinear samples per bin, averaged
+            gy = (y1 + (jnp.arange(ph)[:, None] + (jnp.arange(ns)[None, :]
+                  + 0.5) / ns) * bin_h).reshape(-1)  # (ph*ns,)
+            gx = (x1 + (jnp.arange(pw)[:, None] + (jnp.arange(ns)[None, :]
+                  + 0.5) / ns) * bin_w).reshape(-1)  # (pw*ns,)
+            img = x[bidx]  # (C, H, W)
+
+            def sample(yy, xx):
+                y0 = jnp.clip(jnp.floor(yy).astype("int32"), 0, h - 1)
+                x0 = jnp.clip(jnp.floor(xx).astype("int32"), 0, w - 1)
+                y1i = jnp.clip(y0 + 1, 0, h - 1)
+                x1i = jnp.clip(x0 + 1, 0, w - 1)
+                wy = jnp.clip(yy - y0, 0.0, 1.0)
+                wx = jnp.clip(xx - x0, 0.0, 1.0)
+                v = (img[:, y0][:, :, x0] * (1 - wy)[None, :, None]
+                     * (1 - wx)[None, None, :]
+                     + img[:, y0][:, :, x1i] * (1 - wy)[None, :, None]
+                     * wx[None, None, :]
+                     + img[:, y1i][:, :, x0] * wy[None, :, None]
+                     * (1 - wx)[None, None, :]
+                     + img[:, y1i][:, :, x1i] * wy[None, :, None]
+                     * wx[None, None, :])
+                return v  # (C, len(yy), len(xx))
+
+            v = sample(gy, gx)  # (C, ph*ns, pw*ns)
+            v = v.reshape(c, ph, ns, pw, ns).mean(axis=(2, 4))
+            return v
+
+        return jax.vmap(one_roi)(r)
+
+    return apply_op_flat("roi_align", fn, (data, rois), {})
+
+
+def slice_like(data, shape_like, axes=None):
+    """Slice `data` to match `shape_like`'s shape on `axes` (reference:
+    `src/operator/tensor/matrix_op.cc` slice_like)."""
+    target = tuple(shape_like.shape)
+
+    def fn(d, s):  # noqa: ARG001
+        sl = [slice(None)] * d.ndim
+        ax = range(d.ndim) if axes is None else axes
+        for a in ax:
+            sl[a] = slice(0, target[a])
+        return d[tuple(sl)]
+
+    return apply_op_flat("slice_like", fn, (data, shape_like), {})
+
+
+def broadcast_like(data, other, lhs_axes=None, rhs_axes=None):
+    """Broadcast `data` to `other`'s shape (reference: matrix_op.cc
+    broadcast_like)."""
+    target = tuple(other.shape)
+
+    def fn(d, o):  # noqa: ARG001
+        jnp = _jnp()
+        if lhs_axes is None:
+            return jnp.broadcast_to(d, target)
+        shape = list(d.shape)
+        for la, ra in zip(lhs_axes, rhs_axes):
+            shape[la] = target[ra]
+        return jnp.broadcast_to(d, tuple(shape))
+
+    return apply_op_flat("broadcast_like", fn, (data, other), {})
+
+
+def batch_take(a, indices):
+    """Per-row gather: out[i] = a[i, indices[i]] (reference:
+    `src/operator/tensor/indexing_op.cc` batch_take)."""
+    def fn(x, idx):
+        jnp = _jnp()
+        return jnp.take_along_axis(
+            x, idx[..., None].astype("int32"), axis=-1)[..., 0]
+
+    return apply_op_flat("batch_take", fn, (a, indices), {})

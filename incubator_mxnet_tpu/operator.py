@@ -1,0 +1,154 @@
+"""Python custom operators (reference: `python/mxnet/operator.py:434-760` —
+CustomOp/CustomOpProp executed via callbacks from the C++ custom-op worker
+pool, `src/operator/custom/custom.cc`).
+
+TPU-native: custom ops run eagerly on host (they are Python by definition);
+autograd integration goes through the tape's custom-node mechanism
+(`autograd.Function`), so `backward()` participates in `loss.backward()`
+like any framework op. For jit-compilable custom kernels write pallas or a
+C extension (`library.load`); this API is the maximum-flexibility path.
+"""
+from __future__ import annotations
+
+import numpy as onp
+
+from . import autograd
+from .ndarray.ndarray import NDArray
+
+__all__ = ["CustomOp", "CustomOpProp", "register", "Custom", "get_all_registered_operators"]
+
+_REGISTRY: dict = {}
+
+
+class CustomOp:
+    """Base class for custom operator implementations
+    (reference: operator.py:434)."""
+
+    def forward(self, is_train, req, in_data, out_data, aux):
+        raise NotImplementedError
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        raise NotImplementedError
+
+    def assign(self, dst, req, src):
+        """Write `src` into `dst` honoring the write/add/null request
+        (reference: operator.py:452)."""
+        if req in ("null", 0):
+            return
+        src = src if isinstance(src, NDArray) else NDArray(src)
+        if req in ("add", "add_to", 3):
+            dst._set_data(dst._data + src._data)
+        else:
+            dst._set_data(src._data)
+
+
+class CustomOpProp:
+    """Operator properties: argument lists, shape/type inference, and the
+    CustomOp factory (reference: operator.py:710)."""
+
+    def __init__(self, need_top_grad=True):
+        self.need_top_grad_ = need_top_grad
+
+    def list_arguments(self):
+        return ["data"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def list_auxiliary_states(self):
+        return []
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]], []
+
+    def infer_type(self, in_type):
+        return in_type, [in_type[0]] * len(self.list_outputs()), \
+            [in_type[0]] * len(self.list_auxiliary_states())
+
+    def create_operator(self, ctx, in_shapes, in_dtypes):  # noqa: ARG002
+        return CustomOp()
+
+    def declare_backward_dependency(self, out_grad, in_data, out_data):
+        deps = []
+        if self.need_top_grad_:
+            deps.extend(out_grad)
+        deps.extend(in_data)
+        deps.extend(out_data)
+        return deps
+
+
+def register(reg_name):
+    """Class decorator registering a CustomOpProp under `reg_name`
+    (reference: operator.py:778). The op is then invocable as
+    `operator.Custom(*inputs, op_type=reg_name)` or via the `nd.Custom` /
+    `npx.Custom` aliases."""
+    def wrap(prop_cls):
+        if not issubclass(prop_cls, CustomOpProp):
+            raise TypeError("register expects a CustomOpProp subclass")
+        _REGISTRY[reg_name] = prop_cls
+        return prop_cls
+
+    return wrap
+
+
+def get_all_registered_operators():
+    return sorted(_REGISTRY)
+
+
+class _CustomFunction(autograd.Function):
+    """Bridges CustomOp.forward/backward onto the autograd tape."""
+
+    def __init__(self, prop, op, n_out):
+        super().__init__()
+        self.prop = prop
+        self.op = op
+        self.n_out = n_out
+        self.in_data = None
+        self.out_data = None
+
+    def forward(self, *inputs):
+        out_shapes = self._out_shapes
+        out_dtypes = self._out_dtypes
+        import jax.numpy as jnp
+
+        outs = [NDArray(jnp.zeros(s, onp.dtype(d)))
+                for s, d in zip(out_shapes, out_dtypes)]
+        self.in_data = list(inputs)
+        self.out_data = outs
+        self.op.forward(is_train=autograd.is_training(),
+                        req=["write"] * len(outs),
+                        in_data=list(inputs), out_data=outs, aux=[])
+        return tuple(outs) if len(outs) > 1 else outs[0]
+
+    def backward(self, *output_grads):
+        in_grads = [NDArray(onp.zeros(tuple(x.shape),
+                                      onp.dtype(str(x.dtype))))
+                    for x in self.in_data]
+        self.op.backward(req=["write"] * len(in_grads),
+                         out_grad=list(output_grads),
+                         in_data=self.in_data, out_data=self.out_data,
+                         in_grad=in_grads, aux=[])
+        return tuple(in_grads) if len(in_grads) > 1 else in_grads[0]
+
+
+def Custom(*inputs, op_type, **kwargs):  # noqa: N802
+    """Invoke a registered custom op (reference: the generated `nd.Custom`,
+    `src/operator/custom/custom.cc` CustomOperator dispatch)."""
+    if op_type not in _REGISTRY:
+        raise ValueError(f"custom op {op_type!r} is not registered; "
+                         f"known: {get_all_registered_operators()}")
+    prop = _REGISTRY[op_type](**kwargs)
+    arrays = [a if isinstance(a, NDArray) else NDArray(a) for a in inputs]
+    n_args = len(prop.list_arguments())
+    if len(arrays) != n_args:
+        raise ValueError(f"{op_type} expects {n_args} inputs "
+                         f"({prop.list_arguments()}), got {len(arrays)}")
+    in_shapes = [tuple(a.shape) for a in arrays]
+    _, out_shapes, _ = prop.infer_shape(list(in_shapes))
+    in_types = [str(a.dtype) for a in arrays]
+    _, out_types, _ = prop.infer_type(list(in_types))
+    op = prop.create_operator(None, in_shapes, in_types)
+    fn = _CustomFunction(prop, op, len(out_shapes))
+    fn._out_shapes = [tuple(s) for s in out_shapes]
+    fn._out_dtypes = out_types
+    return fn(*arrays)

@@ -1,0 +1,172 @@
+"""Detection op family tests (reference:
+`tests/python/unittest/test_contrib_operator.py` box_* cases)."""
+import numpy as onp
+import pytest
+
+from incubator_mxnet_tpu import np, npx
+
+RNG = onp.random.RandomState(9)
+
+
+def _np_iou(a, b):
+    lt = onp.maximum(a[:, None, :2], b[None, :, :2])
+    rb = onp.minimum(a[:, None, 2:], b[None, :, 2:])
+    wh = onp.clip(rb - lt, 0, None)
+    inter = wh[..., 0] * wh[..., 1]
+    area_a = ((a[:, 2] - a[:, 0]) * (a[:, 3] - a[:, 1]))[:, None]
+    area_b = ((b[:, 2] - b[:, 0]) * (b[:, 3] - b[:, 1]))[None, :]
+    return inter / onp.maximum(area_a + area_b - inter, 1e-12)
+
+
+def test_box_iou_corner():
+    a = onp.array([[0, 0, 2, 2], [1, 1, 3, 3]], "float32")
+    b = onp.array([[0, 0, 2, 2], [2, 2, 4, 4]], "float32")
+    out = npx.box_iou(np.array(a), np.array(b))
+    onp.testing.assert_allclose(out.asnumpy(), _np_iou(a, b), rtol=1e-5)
+
+
+def test_box_iou_center_format():
+    a_center = onp.array([[1, 1, 2, 2]], "float32")  # == corner [0,0,2,2]
+    b_corner = onp.array([[0, 0, 2, 2]], "float32")
+    out = npx.box_iou(np.array(a_center),
+                      np.array(onp.array([[1, 1, 2, 2]], "float32")),
+                      format="center")
+    assert out.asnumpy()[0, 0] == pytest.approx(1.0)
+    del b_corner
+
+
+def test_box_nms_suppresses_and_compacts():
+    # rows: [id, score, x1, y1, x2, y2]
+    data = onp.array([
+        [0, 0.9, 0, 0, 2, 2],
+        [0, 0.8, 0.1, 0.1, 2.1, 2.1],   # heavy overlap with row 0 → out
+        [0, 0.7, 5, 5, 7, 7],           # far away → kept
+        [1, 0.6, 0.2, 0.2, 2.2, 2.2],   # other class → kept (per-class nms)
+    ], "float32")
+    out = npx.box_nms(np.array(data), overlap_thresh=0.5, coord_start=2,
+                      score_index=1, id_index=0).asnumpy()
+    # reference semantics: survivors compacted to the top in score order,
+    # tail rows entirely -1
+    onp.testing.assert_allclose(out[:, 1], [0.9, 0.7, 0.6, -1], rtol=1e-6)
+    onp.testing.assert_allclose(out[3], -onp.ones(6))
+    onp.testing.assert_allclose(out[1, 2:], [5, 5, 7, 7])
+
+
+def test_box_nms_force_suppress():
+    data = onp.array([
+        [0, 0.9, 0, 0, 2, 2],
+        [1, 0.8, 0.1, 0.1, 2.1, 2.1],
+    ], "float32")
+    out = npx.box_nms(np.array(data), overlap_thresh=0.5, coord_start=2,
+                      score_index=1, id_index=0,
+                      force_suppress=True).asnumpy()
+    onp.testing.assert_allclose(out[1], -onp.ones(6))
+
+
+def test_box_nms_out_format_conversion():
+    data = onp.array([[0.9, 1.0, 1.0, 2.0, 2.0]], "float32")  # center wh=2
+    out = npx.box_nms(np.array(data), overlap_thresh=0.5, coord_start=1,
+                      score_index=0, in_format="center",
+                      out_format="corner").asnumpy()
+    onp.testing.assert_allclose(out[0], [0.9, 0, 0, 2, 2], atol=1e-6)
+
+
+def test_box_encode_decode_roundtrip():
+    anchors = onp.array([[[0, 0, 2, 2], [1, 1, 4, 5]]], "float32")
+    refs = onp.array([[[0.5, 0.5, 2.5, 3.0], [1, 1, 3, 3]]], "float32")
+    samples = onp.ones((1, 2), "float32")
+    matches = onp.array([[0, 1]], "float32")
+    targets, masks = npx.box_encode(np.array(samples), np.array(matches),
+                                    np.array(anchors), np.array(refs))
+    assert masks.asnumpy().min() == 1.0
+    decoded = npx.box_decode(targets, np.array(anchors), format="corner")
+    onp.testing.assert_allclose(decoded.asnumpy(), refs, rtol=1e-4,
+                                atol=1e-4)
+
+
+def test_bipartite_matching_greedy():
+    scores = onp.array([[0.5, 0.6, 0.9],
+                        [0.8, 0.4, 0.3]], "float32")
+    rows, cols = npx.bipartite_matching(np.array(scores), threshold=0.1)
+    # greedy: (0,2)=0.9 first, then (1,0)=0.8
+    onp.testing.assert_array_equal(rows.asnumpy(), [2, 0])
+    onp.testing.assert_array_equal(cols.asnumpy(), [1, -1, 0])
+
+
+def test_bipartite_matching_threshold():
+    scores = onp.array([[0.9, 0.0], [0.0, 0.05]], "float32")
+    rows, cols = npx.bipartite_matching(np.array(scores), threshold=0.5)
+    onp.testing.assert_array_equal(rows.asnumpy(), [0, -1])
+    onp.testing.assert_array_equal(cols.asnumpy(), [0, -1])
+
+
+def test_roi_align_constant_image():
+    # pooling any ROI over a constant image returns that constant
+    img = onp.full((1, 1, 8, 8), 3.0, "float32")
+    rois = onp.array([[0, 2, 2, 6, 6]], "float32")
+    out = npx.roi_align(np.array(img), np.array(rois), pooled_size=(2, 2),
+                        spatial_scale=1.0, sample_ratio=2)
+    assert out.shape == (1, 1, 2, 2)
+    onp.testing.assert_allclose(out.asnumpy(), onp.full((1, 1, 2, 2), 3.0),
+                                rtol=1e-4)
+
+
+def test_roi_align_gradient_flows():
+    from incubator_mxnet_tpu import autograd
+
+    img = np.array(RNG.uniform(0, 1, (1, 2, 8, 8)).astype("float32"))
+    rois = np.array(onp.array([[0, 1, 1, 6, 6]], "float32"))
+    img.attach_grad()
+    with autograd.record():
+        out = npx.roi_align(img, rois, pooled_size=(3, 3)).sum()
+    out.backward()
+    g = img.grad.asnumpy()
+    assert onp.isfinite(g).all()
+    assert onp.abs(g).sum() > 0
+
+
+def test_roi_align_batch_index():
+    x = onp.stack([onp.full((1, 4, 4), 1.0), onp.full((1, 4, 4), 7.0)]) \
+        .astype("float32")
+    rois = onp.array([[1, 0, 0, 4, 4]], "float32")  # second image
+    out = npx.roi_align(np.array(x), np.array(rois), pooled_size=1)
+    assert out.asnumpy().ravel()[0] == pytest.approx(7.0)
+
+
+def test_slice_like():
+    a = np.array(RNG.randn(4, 6).astype("float32"))
+    ref = np.zeros((2, 3))
+    out = npx.slice_like(a, ref)
+    onp.testing.assert_array_equal(out.asnumpy(), a.asnumpy()[:2, :3])
+    out2 = npx.slice_like(a, ref, axes=(1,))
+    onp.testing.assert_array_equal(out2.asnumpy(), a.asnumpy()[:, :3])
+
+
+def test_broadcast_like():
+    a = np.ones((1, 3))
+    ref = np.zeros((4, 3))
+    out = npx.broadcast_like(a, ref)
+    assert out.shape == (4, 3)
+
+
+def test_batch_take():
+    a = np.array(onp.arange(12, dtype="float32").reshape(3, 4))
+    idx = np.array(onp.array([0, 2, 3], "int32"))
+    out = npx.batch_take(a, idx)
+    onp.testing.assert_array_equal(out.asnumpy(), [0, 6, 11])
+
+
+def test_box_nms_grad_safe_under_hybridize():
+    from incubator_mxnet_tpu.gluon.block import HybridBlock
+
+    class Net(HybridBlock):
+        def forward(self, x):
+            return npx.box_nms(x, overlap_thresh=0.5, coord_start=2,
+                               score_index=1)
+
+    net = Net()
+    net.hybridize()
+    data = np.array(RNG.uniform(0, 1, (2, 5, 6)).astype("float32"))
+    y0 = net(data)
+    y1 = net(data)  # compiled replay
+    onp.testing.assert_allclose(y0.asnumpy(), y1.asnumpy(), rtol=1e-5)

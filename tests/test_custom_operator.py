@@ -1,0 +1,121 @@
+"""mx.operator CustomOp/CustomOpProp tests (reference:
+`tests/python/unittest/test_operator.py` test_custom_op)."""
+import numpy as onp
+import pytest
+
+from incubator_mxnet_tpu import autograd, gluon, np, operator
+
+
+@operator.register("scale2")
+class Scale2Prop(operator.CustomOpProp):
+    def __init__(self):
+        super().__init__(need_top_grad=True)
+
+    def create_operator(self, ctx, in_shapes, in_dtypes):
+        return Scale2()
+
+
+class Scale2(operator.CustomOp):
+    def forward(self, is_train, req, in_data, out_data, aux):
+        self.assign(out_data[0], req[0], in_data[0] * 2.0)
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        self.assign(in_grad[0], req[0], out_grad[0] * 2.0)
+
+
+@operator.register("splitsum")
+class SplitSumProp(operator.CustomOpProp):
+    def list_arguments(self):
+        return ["a", "b"]
+
+    def list_outputs(self):
+        return ["sum", "diff"]
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0], in_shape[0]], []
+
+    def create_operator(self, ctx, in_shapes, in_dtypes):
+        return SplitSum()
+
+
+class SplitSum(operator.CustomOp):
+    def forward(self, is_train, req, in_data, out_data, aux):
+        a, b = in_data
+        self.assign(out_data[0], req[0], a + b)
+        self.assign(out_data[1], req[1], a - b)
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        gs, gd = out_grad
+        self.assign(in_grad[0], req[0], gs + gd)
+        self.assign(in_grad[1], req[1], gs - gd)
+
+
+def test_custom_forward():
+    x = np.array(onp.arange(6, dtype="float32").reshape(2, 3))
+    y = operator.Custom(x, op_type="scale2")
+    onp.testing.assert_allclose(y.asnumpy(), 2 * x.asnumpy())
+
+
+def test_custom_backward():
+    x = np.array(onp.arange(6, dtype="float32").reshape(2, 3))
+    x.attach_grad()
+    with autograd.record():
+        y = operator.Custom(x, op_type="scale2")
+        loss = (y * y).sum()
+    loss.backward()
+    # d/dx sum((2x)^2) = 8x
+    onp.testing.assert_allclose(x.grad.asnumpy(), 8 * x.asnumpy(),
+                                rtol=1e-5)
+
+
+def test_custom_multi_io():
+    a = np.array(onp.array([1.0, 2.0], "float32"))
+    b = np.array(onp.array([0.5, 1.0], "float32"))
+    a.attach_grad()
+    b.attach_grad()
+    with autograd.record():
+        s, d = operator.Custom(a, b, op_type="splitsum")
+        loss = (s * s).sum() + d.sum()
+    loss.backward()
+    onp.testing.assert_allclose(s.asnumpy(), [1.5, 3.0])
+    onp.testing.assert_allclose(d.asnumpy(), [0.5, 1.0])
+    # dL/da = 2s + 1; dL/db = 2s - 1
+    onp.testing.assert_allclose(a.grad.asnumpy(), 2 * s.asnumpy() + 1)
+    onp.testing.assert_allclose(b.grad.asnumpy(), 2 * s.asnumpy() - 1)
+
+
+def test_custom_in_gluon_net():
+    from incubator_mxnet_tpu.gluon.block import Block
+
+    class CustomNet(Block):
+        def __init__(self):
+            super().__init__()
+            self.dense = gluon.nn.Dense(4)
+
+        def forward(self, x):
+            return operator.Custom(self.dense(x), op_type="scale2")
+
+    net = CustomNet()
+    net.initialize()
+    x = np.random.uniform(size=(2, 3))
+    with autograd.record():
+        out = net(x).sum()
+    out.backward()
+    g = net.dense.weight.data()._grad
+    assert g is not None
+    assert onp.abs(g.asnumpy()).sum() > 0
+
+
+def test_custom_unknown_raises():
+    with pytest.raises(ValueError, match="not registered"):
+        operator.Custom(np.ones((2,)), op_type="nope")
+
+
+def test_register_requires_prop():
+    with pytest.raises(TypeError):
+        operator.register("bad")(int)
+
+
+def test_registry_listing():
+    ops = operator.get_all_registered_operators()
+    assert "scale2" in ops and "splitsum" in ops
