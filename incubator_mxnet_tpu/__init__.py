@@ -36,6 +36,7 @@ from .device import (  # noqa: F401
     current_device,
     gpu,
     gpu_memory_info,
+    memory_stats,
     num_gpus,
     num_tpus,
     tpu,

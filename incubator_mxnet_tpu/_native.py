@@ -55,6 +55,7 @@ def rtio():
             import shutil
             import tempfile
 
+            tmp = None
             try:
                 tmp = tempfile.NamedTemporaryFile(suffix=".so",
                                                   delete=False)
@@ -63,6 +64,14 @@ def rtio():
                 lib = _load_and_bind(tmp.name)
             except OSError:
                 lib = None
+            finally:
+                # dlopen keeps the mapping alive after unlink (Linux), so
+                # the temp copy never leaks whether load succeeded or not
+                if tmp is not None:
+                    try:
+                        os.unlink(tmp.name)
+                    except OSError:
+                        pass
         _RTIO = lib
         return _RTIO
 

@@ -18,6 +18,8 @@ __all__ = [
     "num_gpus",
     "num_tpus",
     "current_device",
+    "memory_stats",
+    "live_array_bytes",
     "gpu_memory_info",
 ]
 
@@ -143,3 +145,33 @@ def gpu_memory_info(device_id: int = 0):
         return (total - used, total)
     except Exception:
         return (0, 0)
+
+
+def memory_stats(device_id: int | None = None):
+    """Full allocator statistics for one device — the reference's storage
+    pool counters (`src/storage/pooled_storage_manager.h` pool stats, env
+    `MXNET_GPU_MEM_POOL_*`) map onto PJRT's BFC-allocator stats here:
+    bytes_in_use / peak_bytes_in_use / bytes_limit / num_allocs /
+    largest_alloc_size etc. Default (None) reads the CURRENT device;
+    pass an id for a specific accelerator. Returns {} when the backend
+    exposes none (pure-CPU platforms, some PJRT plugins)."""
+    try:
+        dev = current_device().jax_device if device_id is None else \
+            tpu(device_id).jax_device
+        return dict(dev.memory_stats() or {})
+    except Exception:
+        return {}
+
+
+def live_array_bytes():
+    """Total bytes of live jax arrays in this process — the engine-side
+    view the reference exposes via per-ndarray Chunk accounting."""
+    import jax
+
+    total = 0
+    for a in jax.live_arrays():
+        try:
+            total += a.nbytes
+        except Exception:
+            continue
+    return total

@@ -3,3 +3,4 @@ from .rnn_cell import (  # noqa: F401
     RecurrentCell, ResidualCell, RNNCell, SequentialRNNCell, ZoneoutCell,
 )
 from .rnn_layer import GRU, LSTM, RNN  # noqa: F401
+from .conv_rnn_cell import ConvGRUCell, ConvLSTMCell, ConvRNNCell  # noqa: F401

@@ -1,0 +1,149 @@
+"""Convolutional recurrent cells (reference:
+`python/mxnet/gluon/rnn/conv_rnn_cell.py` — ConvRNNCell/ConvLSTMCell/
+ConvGRUCell over 2-D feature maps, Shi et al. "Convolutional LSTM").
+
+TPU-native: gates are two NCHW convolutions (input→gates, hidden→gates)
+over `lax.conv_general_dilated` — both land on the MXU; the whole
+per-step cell fuses under hybridize (and `npx.foreach`, which lowers the
+time loop to lax.scan).
+"""
+from __future__ import annotations
+
+from ... import numpy as np
+from ... import numpy_extension as npx
+from ..parameter import Parameter
+from .rnn_cell import RecurrentCell
+
+__all__ = ["ConvRNNCell", "ConvLSTMCell", "ConvGRUCell"]
+
+
+class _ConvCellBase(RecurrentCell):
+    """Shared conv-gate machinery: state (B, hidden, H, W); input
+    (B, C, H, W); i2h and h2h are same-padded convs producing
+    ngates*hidden channels."""
+
+    def __init__(self, hidden_channels, ngates, kernel_size=(3, 3),
+                 input_shape=None, dtype="float32",
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros"):
+        super().__init__()
+        if isinstance(kernel_size, int):
+            kernel_size = (kernel_size, kernel_size)
+        self._hidden = hidden_channels
+        self._ngates = ngates
+        self._kernel = tuple(kernel_size)
+        # (H, W): from input_shape=(C, H, W) if given, else learned on the
+        # first forward
+        self._spatial = (tuple(input_shape[1:])
+                         if input_shape is not None and len(input_shape) >= 3
+                         else None)
+        in_ch = 0 if input_shape is None else input_shape[0]
+        kh, kw = self._kernel
+        self.i2h_weight = Parameter(
+            shape=(ngates * hidden_channels, in_ch, kh, kw), dtype=dtype,
+            init=i2h_weight_initializer, allow_deferred_init=True)
+        self.h2h_weight = Parameter(
+            shape=(ngates * hidden_channels, hidden_channels, kh, kw),
+            dtype=dtype, init=h2h_weight_initializer)
+        self.i2h_bias = Parameter(shape=(ngates * hidden_channels,),
+                                  dtype=dtype, init=i2h_bias_initializer)
+        self.h2h_bias = Parameter(shape=(ngates * hidden_channels,),
+                                  dtype=dtype, init=h2h_bias_initializer)
+
+    def infer_shape(self, x, *args):
+        kh, kw = self._kernel
+        self.i2h_weight.shape = (self._ngates * self._hidden, x.shape[1],
+                                 kh, kw)
+        self._spatial = tuple(x.shape[2:])
+
+    def state_info(self, batch_size=0):
+        spatial = self._spatial or (0, 0)
+        return [{"shape": (batch_size, self._hidden) + spatial}]
+
+    def begin_state(self, batch_size=0, func=None, **kwargs):
+        if self._spatial is None:
+            raise ValueError(
+                "conv cell spatial dims unknown — construct with "
+                "input_shape=(C, H, W) or run one forward first")
+        return super().begin_state(batch_size, func, **kwargs)
+
+    def _gates(self, x, h):
+        kh, kw = self._kernel
+        pad = (kh // 2, kw // 2)
+        n = self._ngates * self._hidden
+        i2h = npx.convolution(x, self.i2h_weight.data(),
+                              self.i2h_bias.data(), kernel=self._kernel,
+                              num_filter=n, pad=pad)
+        h2h = npx.convolution(h, self.h2h_weight.data(),
+                              self.h2h_bias.data(), kernel=self._kernel,
+                              num_filter=n, pad=pad)
+        return i2h + h2h
+
+
+class ConvRNNCell(_ConvCellBase):
+    """tanh conv-RNN cell (reference: conv_rnn_cell.py ConvRNNCell)."""
+
+    def __init__(self, hidden_channels, kernel_size=(3, 3),
+                 activation="tanh", **kwargs):
+        super().__init__(hidden_channels, 1, kernel_size, **kwargs)
+        self._activation = activation
+
+    def forward(self, x, states):
+        if self._spatial is None:
+            self._spatial = tuple(x.shape[2:])
+        out = npx.activation(self._gates(x, states[0]),
+                             act_type=self._activation)
+        return out, [out]
+
+
+class ConvLSTMCell(_ConvCellBase):
+    """Convolutional LSTM (reference: conv_rnn_cell.py ConvLSTMCell)."""
+
+    def __init__(self, hidden_channels, kernel_size=(3, 3), **kwargs):
+        super().__init__(hidden_channels, 4, kernel_size, **kwargs)
+
+    def state_info(self, batch_size=0):
+        spatial = self._spatial or (0, 0)
+        shape = (batch_size, self._hidden) + spatial
+        return [{"shape": shape}, {"shape": shape}]
+
+    def forward(self, x, states):
+        if self._spatial is None:
+            self._spatial = tuple(x.shape[2:])
+        h, c = states
+        gates = self._gates(x, h)
+        hc = self._hidden
+        i = npx.sigmoid(gates[:, :hc])
+        f = npx.sigmoid(gates[:, hc:2 * hc])
+        g = np.tanh(gates[:, 2 * hc:3 * hc])
+        o = npx.sigmoid(gates[:, 3 * hc:])
+        c_new = f * c + i * g
+        h_new = o * np.tanh(c_new)
+        return h_new, [h_new, c_new]
+
+
+class ConvGRUCell(_ConvCellBase):
+    """Convolutional GRU (reference: conv_rnn_cell.py ConvGRUCell)."""
+
+    def __init__(self, hidden_channels, kernel_size=(3, 3), **kwargs):
+        super().__init__(hidden_channels, 3, kernel_size, **kwargs)
+
+    def forward(self, x, states):
+        if self._spatial is None:
+            self._spatial = tuple(x.shape[2:])
+        h = states[0]
+        kh, kw = self._kernel
+        pad = (kh // 2, kw // 2)
+        n = self._ngates * self._hidden
+        i2h = npx.convolution(x, self.i2h_weight.data(),
+                              self.i2h_bias.data(), kernel=self._kernel,
+                              num_filter=n, pad=pad)
+        h2h = npx.convolution(h, self.h2h_weight.data(),
+                              self.h2h_bias.data(), kernel=self._kernel,
+                              num_filter=n, pad=pad)
+        hc = self._hidden
+        r = npx.sigmoid(i2h[:, :hc] + h2h[:, :hc])
+        z = npx.sigmoid(i2h[:, hc:2 * hc] + h2h[:, hc:2 * hc])
+        nvl = np.tanh(i2h[:, 2 * hc:] + r * h2h[:, 2 * hc:])
+        h_new = (1 - z) * nvl + z * h
+        return h_new, [h_new]

@@ -1,1 +1,4 @@
-from .io import DataBatch, DataDesc, DataIter, NDArrayIter, ResizeIter  # noqa: F401
+from .io import (  # noqa: F401
+    CSVIter, DataBatch, DataDesc, DataIter, LibSVMIter, NDArrayIter,
+    ResizeIter,
+)

@@ -9,7 +9,8 @@ import numpy as onp
 
 from ..ndarray.ndarray import NDArray
 
-__all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "ResizeIter"]
+__all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter",
+           "ResizeIter", "CSVIter", "LibSVMIter"]
 
 
 class DataDesc(namedtuple("DataDesc", ["name", "shape"])):
@@ -182,3 +183,80 @@ class ResizeIter(DataIter):
 
     def getpad(self):
         return self.current_batch.pad
+
+
+class CSVIter(NDArrayIter):
+    """Iterate rows of CSV files (reference: `src/io/iter_csv.cc:217` —
+    the C++ threaded CSV parser; here the file is parsed once on host and
+    batches stream from memory, the TPU-friendly layout since the device
+    wants whole batches anyway).
+
+    `data_csv`/`label_csv` are paths; `data_shape`/`label_shape` give the
+    per-row shapes (rows are reshaped accordingly)."""
+
+    def __init__(self, data_csv, data_shape, label_csv=None,
+                 label_shape=(1,), batch_size=1, round_batch=True, **kwargs):
+        data = onp.loadtxt(data_csv, delimiter=",", dtype=onp.float32,
+                           ndmin=2)
+        data = data.reshape((data.shape[0],) + tuple(data_shape))
+        label = None
+        if label_csv is not None:
+            label = onp.loadtxt(label_csv, delimiter=",",
+                                dtype=onp.float32, ndmin=2)
+            label = label.reshape((label.shape[0],) + tuple(label_shape))
+        super().__init__(NDArray(data),
+                         None if label is None else NDArray(label),
+                         batch_size=batch_size,
+                         last_batch_handle="pad" if round_batch
+                         else "discard", **kwargs)
+
+
+class LibSVMIter(NDArrayIter):
+    """Iterate rows of a LibSVM file (reference: `src/io/iter_libsvm.cc:201`).
+    Batches are served as dense slices (the TPU path densifies per batch —
+    XLA has no sparse matmul fast path); use `to_csr()` for a sparse view
+    when needed."""
+
+    def __init__(self, data_libsvm, data_shape, label_libsvm=None,
+                 batch_size=1, round_batch=True, **kwargs):
+        n_cols = int(onp.prod(data_shape))
+        rows, labels = [], []
+        with open(data_libsvm) as f:
+            for line in f:
+                parts = line.strip().split()
+                if not parts:
+                    continue
+                labels.append(float(parts[0]))
+                row = onp.zeros(n_cols, onp.float32)
+                for tok in parts[1:]:
+                    k, v = tok.split(":")
+                    row[int(k)] = float(v)
+                rows.append(row)
+        data = onp.stack(rows).reshape((len(rows),) + tuple(data_shape))
+        if label_libsvm is not None:
+            # separate label file (reference: label_libsvm param): one
+            # label (or label vector) per line, same LibSVM framing
+            ext_labels = []
+            with open(label_libsvm) as f:
+                for line in f:
+                    parts = line.strip().split()
+                    if parts:
+                        ext_labels.append(float(parts[0]))
+            if len(ext_labels) != len(rows):
+                raise ValueError(
+                    f"label_libsvm has {len(ext_labels)} rows but "
+                    f"data_libsvm has {len(rows)}")
+            label = onp.asarray(ext_labels, onp.float32).reshape(-1, 1)
+        else:
+            label = onp.asarray(labels, onp.float32).reshape(-1, 1)
+        super().__init__(NDArray(data), NDArray(label),
+                         batch_size=batch_size,
+                         last_batch_handle="pad" if round_batch
+                         else "discard", **kwargs)
+
+    def to_csr(self):
+        """CSR view of the full feature matrix (built on demand)."""
+        from ..ndarray.sparse import csr_matrix
+
+        d = self.data[0][1]
+        return csr_matrix(d.asnumpy().reshape(d.shape[0], -1))

@@ -1,0 +1,59 @@
+"""CSVIter / LibSVMIter tests (reference: tests/python/unittest/test_io.py)."""
+import numpy as onp
+
+from incubator_mxnet_tpu.io import CSVIter, LibSVMIter
+
+
+def test_csv_iter(tmp_path):
+    data = onp.arange(20, dtype=onp.float32).reshape(10, 2)
+    labels = onp.arange(10, dtype=onp.float32)
+    dpath, lpath = str(tmp_path / "d.csv"), str(tmp_path / "l.csv")
+    onp.savetxt(dpath, data, delimiter=",")
+    onp.savetxt(lpath, labels, delimiter=",")
+    it = CSVIter(data_csv=dpath, data_shape=(2,), label_csv=lpath,
+                 label_shape=(1,), batch_size=4)
+    batches = list(it)
+    assert len(batches) == 3  # 10 rows, pad to 12
+    onp.testing.assert_allclose(batches[0].data[0].asnumpy(), data[:4])
+    onp.testing.assert_allclose(batches[0].label[0].asnumpy().ravel(),
+                                labels[:4])
+    assert batches[-1].pad == 2
+    it.reset()
+    assert len(list(it)) == 3
+
+
+def test_csv_iter_no_label(tmp_path):
+    data = onp.ones((4, 3), onp.float32)
+    dpath = str(tmp_path / "d.csv")
+    onp.savetxt(dpath, data, delimiter=",")
+    it = CSVIter(data_csv=dpath, data_shape=(3,), batch_size=2)
+    b = next(it)
+    assert b.data[0].shape == (2, 3)
+
+
+def test_libsvm_iter(tmp_path):
+    path = str(tmp_path / "d.svm")
+    with open(path, "w") as f:
+        f.write("1 0:1.5 3:2.0\n")
+        f.write("0 1:0.5\n")
+        f.write("1 2:1.0 3:3.0\n")
+    it = LibSVMIter(data_libsvm=path, data_shape=(4,), batch_size=2)
+    b = next(it)
+    onp.testing.assert_allclose(b.data[0].asnumpy(),
+                                [[1.5, 0, 0, 2.0], [0, 0.5, 0, 0]])
+    onp.testing.assert_allclose(b.label[0].asnumpy().ravel(), [1, 0])
+    # sparse view on demand
+    assert it.to_csr().shape == (3, 4)
+
+
+def test_libsvm_iter_separate_label_file(tmp_path):
+    dpath = str(tmp_path / "d.svm")
+    lpath = str(tmp_path / "l.svm")
+    with open(dpath, "w") as f:
+        f.write("0 0:1.0\n0 1:2.0\n")
+    with open(lpath, "w") as f:
+        f.write("5\n7\n")
+    it = LibSVMIter(data_libsvm=dpath, data_shape=(2,),
+                    label_libsvm=lpath, batch_size=2)
+    b = next(it)
+    onp.testing.assert_allclose(b.label[0].asnumpy().ravel(), [5, 7])
