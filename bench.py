@@ -167,6 +167,33 @@ def bench_bert_train(batch=8, seq=128, iters=20, warmup=2):
     return tokens_s, mfu
 
 
+def bench_resnet50_infer(batch=32, iters=20, warmup=2, int8=False):
+    """images/sec inference, fp32 or post-training INT8 (BASELINE.json
+    config 5: 'INT8 quantized ResNet inference ... on TPU int8 matmul')."""
+    from incubator_mxnet_tpu import np
+    from incubator_mxnet_tpu.gluon.model_zoo.vision import resnet50_v1
+
+    rng = onp.random.RandomState(0)
+    net = resnet50_v1()
+    net.initialize()
+    x = np.array(rng.uniform(-1, 1, (batch, 3, 224, 224)).astype("float32"))
+    net(x[:1])
+    if int8:
+        from incubator_mxnet_tpu.contrib.quantization import quantize_net
+
+        quantize_net(net, calib_data=[x[:8]], calib_mode="naive")
+    net.hybridize()
+    y = None
+    for _ in range(warmup + 1):
+        y = net(x)
+    float(y.sum().item())  # true sync
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        y = net(x)
+    float(y.sum().item())
+    return batch * iters / (time.perf_counter() - t0)
+
+
 def main():
     extras = {}
 
@@ -199,6 +226,16 @@ def main():
         extras["bert_mfu"] = round(mfu, 4)
     except Exception as e:  # pragma: no cover
         print(f"bert bench failed: {e}", file=sys.stderr)
+    def bench_resnet50_infer_int8():
+        return bench_resnet50_infer(int8=True)
+
+    try:
+        extras["resnet50_fp32_infer_img_s"] = round(
+            _retry(bench_resnet50_infer), 1)
+        extras["resnet50_int8_infer_img_s"] = round(
+            _retry(bench_resnet50_infer_int8), 1)
+    except Exception as e:  # pragma: no cover
+        print(f"inference bench failed: {e}", file=sys.stderr)
 
     try:
         img_s = _retry(bench_resnet50_train)

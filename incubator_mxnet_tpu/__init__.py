@@ -58,6 +58,10 @@ from . import kvstore  # noqa: F401
 from . import kvstore as kv  # noqa: F401
 from . import library  # noqa: F401
 from . import operator  # noqa: F401
+from . import image  # noqa: F401
+from . import recordio  # noqa: F401
+from . import lr_scheduler  # noqa: F401
+from . import amp  # noqa: F401
 from . import io  # noqa: F401
 from . import parallel  # noqa: F401
 from . import profiler  # noqa: F401

@@ -53,6 +53,10 @@ def __getattr__(name):
         from ..operator import Custom
 
         return Custom
+    if name == "image":  # reference: numpy_extension/image.py re-exports
+        from .. import image
+
+        return image
     raise AttributeError(f"module 'npx' has no attribute {name!r}")
 
 
