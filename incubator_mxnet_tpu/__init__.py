@@ -65,6 +65,11 @@ from . import amp  # noqa: F401
 from . import io  # noqa: F401
 from . import parallel  # noqa: F401
 from . import profiler  # noqa: F401
+from . import symbol  # noqa: F401
+from . import symbol as sym  # noqa: F401
+from . import name  # noqa: F401
+from . import attribute  # noqa: F401
+from .attribute import AttrScope  # noqa: F401
 from . import util  # noqa: F401
 from . import test_utils  # noqa: F401
 from . import contrib  # noqa: F401

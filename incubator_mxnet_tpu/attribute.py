@@ -1,0 +1,51 @@
+"""Attribute scoping for symbol construction (reference:
+`python/mxnet/attribute.py` — `AttrScope` attaches key/value attributes to
+every symbol created inside the scope, e.g. ctx-group or lr_mult hints).
+"""
+from __future__ import annotations
+
+import threading
+
+__all__ = ["AttrScope", "current"]
+
+_TLS = threading.local()
+
+
+def _stack():
+    if not hasattr(_TLS, "stack"):
+        _TLS.stack = [AttrScope()]
+    return _TLS.stack
+
+
+class AttrScope:
+    """Merge-with-outer attribute scope (`attribute.py:27`)."""
+
+    def __init__(self, **kwargs):
+        for v in kwargs.values():
+            if not isinstance(v, str):
+                raise ValueError("AttrScope values must be strings")
+        self._attr = dict(kwargs)
+
+    def get(self, attr: dict | None) -> dict:
+        """Current scope attrs merged with (and overridden by) `attr`."""
+        merged = dict(self._attr)
+        if attr:
+            merged.update(attr)
+        return merged
+
+    def __enter__(self):
+        # push a MERGED view; self._attr stays pristine so a scope object
+        # can be reused without leaking attrs from a previous nesting
+        merged = AttrScope()
+        merged._attr = dict(_stack()[-1]._attr)
+        merged._attr.update(self._attr)
+        _stack().append(merged)
+        return self
+
+    def __exit__(self, *exc):
+        _stack().pop()
+        return False
+
+
+def current() -> AttrScope:
+    return _stack()[-1]

@@ -463,12 +463,28 @@ class SymbolBlock(HybridBlock):
     """Runs a serialized model without its original Python class
     (reference: gluon/block.py:1713 SymbolBlock over symbol JSON).
 
-    TPU-native: wraps a deserialized `jax.export` StableHLO artifact produced
-    by `HybridBlock.export`. The compiled program is the "symbol"; parameters
-    are plain arrays fed positionally in manifest order."""
+    TPU-native: wraps either (a) a deserialized `jax.export` StableHLO
+    artifact produced by `HybridBlock.export` — the compiled program is the
+    "symbol"; parameters are plain arrays fed positionally in manifest order —
+    or (b) a live `mx.sym.Symbol` graph via the reference constructor form
+    ``SymbolBlock(outputs, inputs, params=...)`` (gluon/block.py:1654), in
+    which case free symbol variables not listed in `inputs` become block
+    Parameters and forward evaluates the graph through the op funnel (so it
+    hybridizes/trains like any other block)."""
 
-    def __init__(self, exported, manifest, param_vals):
+    def __init__(self, outputs, inputs=None, params=None):
+        from ..symbol.symbol import Symbol as _Sym
+
+        if isinstance(outputs, _Sym) or (
+                isinstance(outputs, (list, tuple)) and outputs
+                and isinstance(outputs[0], _Sym)):
+            super().__init__()
+            self._init_from_symbol(outputs, inputs, params)
+            return
+        # internal form: (exported, manifest, param_vals)
+        exported, manifest, param_vals = outputs, inputs, params
         super().__init__()
+        self._sym = None
         self._exported = exported
         self._manifest = manifest
         from .parameter import Parameter
@@ -479,7 +495,43 @@ class SymbolBlock(HybridBlock):
             p.set_data(NDArray(v))
             self._reg_params[name] = p
 
+    def _init_from_symbol(self, outputs, inputs, params):
+        from ..symbol.symbol import Group, Symbol as _Sym
+        from .parameter import Parameter
+
+        if isinstance(outputs, (list, tuple)):
+            outputs = outputs[0] if len(outputs) == 1 else Group(outputs)
+        if inputs is None:
+            raise ValueError("SymbolBlock(symbol, ...) requires `inputs`")
+        if isinstance(inputs, _Sym):
+            inputs = [inputs]
+        self._sym = outputs
+        self._sym_inputs = [i.name if isinstance(i, _Sym) else str(i)
+                            for i in inputs]
+        self._exported = None
+        self._manifest = None
+        params = params or {}
+        for name in outputs.list_arguments():
+            if name in self._sym_inputs:
+                continue
+            v = params.get(name)
+            if v is None:
+                raise ValueError(
+                    f"SymbolBlock: no value for free variable {name!r}; "
+                    f"pass it in `params` or list it in `inputs`")
+            v = v if isinstance(v, NDArray) else NDArray(v)
+            p = Parameter(shape=v.shape, dtype=str(v.dtype), name=name)
+            p.set_data(v)
+            self._reg_params[name] = p
+
     def forward(self, *args):
+        if getattr(self, "_sym", None) is not None:
+            env = {n: (a if isinstance(a, NDArray) else NDArray(a))
+                   for n, a in zip(self._sym_inputs, args)}
+            for name, p in self._reg_params.items():
+                env[name] = p.data()
+            outs = self._sym._eval(env)
+            return outs[0] if len(outs) == 1 else tuple(outs)
         vals = [a._data if isinstance(a, NDArray) else a for a in args]
         pvals = [self._reg_params[n].data()._data
                  for n in self._manifest["param_names"]]

@@ -1,0 +1,59 @@
+"""Name manager for symbol construction (reference: `python/mxnet/name.py` —
+`NameManager` assigns unique names to unnamed symbols, `Prefix` prepends a
+scope prefix).
+
+TPU-native role: symbol nodes are pure-Python graph metadata (no C handles),
+so the manager is just a thread-local counter stack.
+"""
+from __future__ import annotations
+
+import threading
+
+__all__ = ["NameManager", "Prefix", "current"]
+
+_TLS = threading.local()
+
+
+def _stack():
+    if not hasattr(_TLS, "stack"):
+        _TLS.stack = [NameManager()]
+    return _TLS.stack
+
+
+class NameManager:
+    """Scope manager assigning unique names per hint (`name.py:29`)."""
+
+    def __init__(self):
+        self._counter: dict[str, int] = {}
+
+    def get(self, name: str | None, hint: str) -> str:
+        if name is not None:
+            return name
+        n = self._counter.get(hint, 0)
+        self._counter[hint] = n + 1
+        return f"{hint}{n}"
+
+    def __enter__(self):
+        _stack().append(self)
+        return self
+
+    def __exit__(self, *exc):
+        _stack().pop()
+        return False
+
+
+class Prefix(NameManager):
+    """Prepend a prefix to every auto-generated name (`name.py:74`)."""
+
+    def __init__(self, prefix: str):
+        super().__init__()
+        self._prefix = prefix
+
+    def get(self, name: str | None, hint: str) -> str:
+        if name is not None:
+            return name
+        return self._prefix + super().get(None, hint)
+
+
+def current() -> NameManager:
+    return _stack()[-1]

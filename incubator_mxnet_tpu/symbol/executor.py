@@ -1,0 +1,173 @@
+"""Symbol executor (reference: `python/mxnet/executor.py` — `Executor` with
+`forward`/`backward`/`outputs` over the C++ graph executor).
+
+TPU-native: `bind` does not build a memory plan or per-node executors — the
+whole symbol DAG is traced into one `jax.jit` program per training mode
+(XLA owns CSE/fusion/memory planning, replacing `src/nnvm/plan_memory.cc`
+and `src/imperative/cached_op.cc:833` Forward). `backward` is a second
+compiled program built from `jax.vjp` of the same trace; XLA dead-code
+eliminates the unused forward outputs, which reproduces the reference's
+"replay only backward kernels" behavior without a hand-built tape replay.
+"""
+from __future__ import annotations
+
+import numpy as onp
+
+from .. import autograd
+from ..ndarray.ndarray import NDArray
+from ..random import next_key, trace_key_scope
+
+__all__ = ["Executor"]
+
+
+class Executor:
+    def __init__(self, symbol, device=None, args=None, args_grad=None,
+                 grad_req="write", aux_states=None):
+        self._symbol = symbol
+        self._device = device
+        self._arg_names = symbol.list_arguments()
+
+        self.arg_dict = self._as_dict(args, "args")
+        if aux_states:
+            self.arg_dict.update(self._as_dict(aux_states, "aux_states",
+                                               names=symbol.list_auxiliary_states()))
+        missing = [a for a in self._arg_names if a not in self.arg_dict]
+        if missing:
+            raise ValueError(f"bind: missing arguments {missing}")
+
+        self.grad_dict = self._as_dict(args_grad, "args_grad") \
+            if args_grad is not None else {}
+        if isinstance(grad_req, str):
+            self._grad_req = {a: (grad_req if a in self.grad_dict else "null")
+                              for a in self._arg_names} if self.grad_dict else \
+                {a: grad_req for a in self._arg_names}
+        else:
+            self._grad_req = {a: grad_req.get(a, "null") for a in self._arg_names}
+
+        self._jit = {}       # (mode, kind) -> compiled fn
+        self.outputs: list[NDArray] = []
+
+    def _as_dict(self, value, what, names=None):
+        names = names if names is not None else self._arg_names
+        if value is None:
+            return {}
+        if isinstance(value, dict):
+            return {k: v if isinstance(v, NDArray) else NDArray(v)
+                    for k, v in value.items()}
+        value = list(value)
+        if len(value) != len(names):
+            raise ValueError(f"{what}: expected {len(names)} arrays "
+                             f"for {names}, got {len(value)}")
+        return {n: v if isinstance(v, NDArray) else NDArray(v)
+                for n, v in zip(names, value)}
+
+    # ------------------------------------------------------------- compile
+    def _forward_fn(self, train: bool):
+        fn = self._jit.get((train, "fwd"))
+        if fn is not None:
+            return fn
+        import jax
+
+        sym, names = self._symbol, self._arg_names
+
+        def run(key, *vals):
+            env = {n: NDArray(v) for n, v in zip(names, vals)}
+            with trace_key_scope(key), autograd.pause(train_mode=train):
+                outs = sym._eval(env)
+            return tuple(o._data for o in outs)
+
+        fn = jax.jit(run)
+        self._jit[(train, "fwd")] = fn
+        return fn
+
+    def _backward_fn(self):
+        fn = self._jit.get((True, "bwd"))
+        if fn is not None:
+            return fn
+        import jax
+
+        sym, names = self._symbol, self._arg_names
+        diff_idx = [i for i, n in enumerate(names)
+                    if self._grad_req.get(n, "null") != "null"]
+
+        def run(key, arg_vals, out_grads):
+            def f(diff_vals):
+                call = list(arg_vals)
+                for j, i in enumerate(diff_idx):
+                    call[i] = diff_vals[j]
+                env = {n: NDArray(v) for n, v in zip(names, call)}
+                with trace_key_scope(key), autograd.pause(train_mode=True):
+                    outs = sym._eval(env)
+                return tuple(o._data for o in outs)
+
+            primals = [arg_vals[i] for i in diff_idx]
+            outs, vjp = jax.vjp(f, primals)
+            import jax.numpy as jnp
+
+            cot = tuple(jnp.asarray(g, o.dtype) if g is not None
+                        else jnp.zeros_like(o)
+                        for o, g in zip(outs, out_grads))
+            (grads,) = vjp(cot)
+            return grads
+
+        fn = jax.jit(run)
+        self._jit[(True, "bwd")] = fn
+        return fn
+
+    # ------------------------------------------------------------- execute
+    def forward(self, is_train: bool = False, **kwargs):
+        for k, v in kwargs.items():
+            if k not in self.arg_dict:
+                raise ValueError(f"forward: unknown argument {k!r}")
+            self.arg_dict[k]._set_data(
+                v._data if isinstance(v, NDArray) else NDArray(v)._data)
+        vals = [self.arg_dict[n]._data for n in self._arg_names]
+        self._fwd_key = next_key()
+        outs = self._forward_fn(bool(is_train))(self._fwd_key, *vals)
+        self.outputs = [NDArray(o) for o in outs]
+        return self.outputs
+
+    def backward(self, out_grads=None):
+        if not self.outputs:
+            raise RuntimeError("backward called before forward")
+        if out_grads is None:
+            out_grads = [NDArray(onp.ones(o.shape, dtype=onp.float32))
+                         for o in self.outputs]
+        elif isinstance(out_grads, NDArray):
+            out_grads = [out_grads]
+        vals = [self.arg_dict[n]._data for n in self._arg_names]
+        ograd_vals = tuple(g._data if isinstance(g, NDArray) else NDArray(g)._data
+                           for g in out_grads)
+        # reuse the forward RNG key so stochastic ops (dropout, random
+        # samples) differentiate the SAME realization the loss was computed on
+        grads = self._backward_fn()(self._fwd_key, tuple(vals), ograd_vals)
+        diff_names = [n for n in self._arg_names
+                      if self._grad_req.get(n, "null") != "null"]
+        for n, g in zip(diff_names, grads):
+            req = self._grad_req[n]
+            buf = self.grad_dict.get(n)
+            if buf is None:
+                buf = NDArray(onp.zeros(g.shape, dtype=onp.dtype(str(g.dtype))
+                                        if str(g.dtype) != "bfloat16" else onp.float32))
+                self.grad_dict[n] = buf
+            if req == "add":
+                buf._set_data(buf._data + g)
+            else:
+                buf._set_data(g)
+        return [self.grad_dict[n] for n in diff_names]
+
+    @property
+    def grad_arrays(self):
+        return [self.grad_dict.get(n) for n in self._arg_names]
+
+    @property
+    def arg_arrays(self):
+        return [self.arg_dict[n] for n in self._arg_names]
+
+    def copy_params_from(self, arg_params, aux_params=None):
+        """(reference: `executor.py:331`)."""
+        for src in (arg_params or {}), (aux_params or {}):
+            for k, v in src.items():
+                if k in self.arg_dict:
+                    self.arg_dict[k]._set_data(
+                        v._data if isinstance(v, NDArray) else NDArray(v)._data)

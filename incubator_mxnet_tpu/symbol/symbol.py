@@ -1,0 +1,495 @@
+"""Symbolic graph API (reference: `python/mxnet/symbol/symbol.py` — the
+3313-LoC `Symbol` class over nnvm graph handles, plus `python/mxnet/symbol/
+numpy/_symbol.py` for the numpy-namespace symbols).
+
+TPU-native design: a Symbol is a pure-Python lazy DAG whose nodes name ops in
+the framework's own `np`/`npx` namespaces. There is no separate graph IR or
+executor backend — `bind()` lowers the whole DAG through ONE `jax.jit` trace
+(the reference's graph executor + memory planner + CSE/fusion passes are
+exactly what XLA does with the traced program), and `Executor.backward` is
+`jax.vjp` over that same traced function. This collapses the reference's
+symbol/NDArray duality: symbolic and imperative execution share the single
+`apply_op` funnel, so every op, AMP cast and autograd rule works identically
+in both.
+
+Graph JSON (`tojson`/`fromjson`) keeps the reference's node-list shape
+(`nodes`/`arg_nodes`/`heads`, cf. `src/nnvm/legacy_json_util.cc`) with op
+names qualified against this package ("np.dot", "npx.relu") instead of the
+C++ registry.
+"""
+from __future__ import annotations
+
+import json
+
+import numpy as onp
+
+from .. import attribute as _attribute
+from .. import name as _name
+from ..base import np_dtype
+from ..ndarray.ndarray import NDArray
+
+__all__ = ["Symbol", "Variable", "var", "Group", "fromjson", "load",
+           "load_json", "save"]
+
+# ops whose python signature takes a leading list of tensors
+# (np.concatenate style) — symbol inputs are re-packed into a list at eval
+_LIST_ARG_OPS = {
+    "np.concatenate", "np.stack", "np.vstack", "np.hstack", "np.dstack",
+    "np.column_stack", "np.row_stack", "npx.add_n", "np.linalg.multi_dot",
+}
+
+
+def _resolve_op(qualname: str):
+    """Resolve 'np.dot' / 'npx.relu' / 'np.linalg.svd' / 'np.random.normal'
+    against this package's op namespaces."""
+    from .. import numpy as _np
+    from .. import numpy_extension as _npx
+
+    root, *rest = qualname.split(".")
+    mod = {"np": _np, "npx": _npx}.get(root)
+    if mod is None:
+        raise ValueError(f"unknown op namespace in {qualname!r}")
+    obj = mod
+    for part in rest:
+        obj = getattr(obj, part, None)
+        if obj is None:
+            raise ValueError(f"unknown op {qualname!r}")
+    return obj
+
+
+def _json_safe(v):
+    try:
+        json.dumps(v)
+        return True
+    except (TypeError, ValueError):
+        return False
+
+
+class Symbol:
+    """A node (or an output slot of a node) in a lazy op graph."""
+
+    def __init__(self, op, inputs, args_static=None, kwargs=None, name=None,
+                 attrs=None, hint=None):
+        # op: None for variables, "__group__", or qualified op name
+        self._op = op
+        self._inputs: list[Symbol] = list(inputs)
+        # positional arg template: None marks a symbol slot (consumed from
+        # self._inputs in order); other entries are static python values
+        self._args_static = list(args_static) if args_static is not None else \
+            [None] * len(self._inputs)
+        self._kwargs = dict(kwargs or {})
+        hint = hint or (op.split(".")[-1].lower() if op else "var")
+        self._name = _name.current().get(name, hint + "_")
+        self._attrs = _attribute.current().get(attrs)
+
+    @classmethod
+    def _make(cls, op, inputs, args_static, kwargs, name, attrs):
+        """Raw reconstruction (fromjson, composition): bypasses NameManager
+        uniquing AND the ambient AttrScope so rebuilt nodes keep exactly
+        their stored name/attrs."""
+        s = cls.__new__(cls)
+        s._op = op
+        s._inputs = list(inputs)
+        s._args_static = list(args_static) if args_static is not None else \
+            [None] * len(s._inputs)
+        s._kwargs = dict(kwargs or {})
+        s._name = name
+        s._attrs = dict(attrs or {})
+        return s
+
+    # ------------------------------------------------------------- structure
+    @property
+    def name(self) -> str:
+        return self._name
+
+    def attr(self, key: str):
+        return self._attrs.get(key)
+
+    def list_attr(self) -> dict:
+        return dict(self._attrs)
+
+    def attr_dict(self) -> dict:
+        out = {}
+        for node in self._topo():
+            if node._attrs:
+                out[node._name] = dict(node._attrs)
+        return out
+
+    def _topo(self):
+        """Post-order unique walk of the DAG."""
+        seen, order, stack = set(), [], [(self, False)]
+        while stack:
+            node, done = stack.pop()
+            if done:
+                order.append(node)
+                continue
+            if id(node) in seen:
+                continue
+            seen.add(id(node))
+            stack.append((node, True))
+            for inp in reversed(node._inputs):
+                stack.append((inp, False))
+        return order
+
+    def list_arguments(self) -> list[str]:
+        """Free variables in first-use order (`symbol.py:820`)."""
+        out, seen = [], set()
+        for node in self._topo():
+            if node._op is None and node._name not in seen:
+                seen.add(node._name)
+                out.append(node._name)
+        return out
+
+    def list_auxiliary_states(self) -> list[str]:
+        """Aux states (BN running stats). The TPU symbol graph carries aux
+        state as ordinary variables (functional jax style), so this is the
+        subset of variables flagged `__aux__` via Variable(..., aux=True)."""
+        return [n._name for n in self._topo()
+                if n._op is None and n._attrs.get("__aux__") == "1"]
+
+    def list_outputs(self) -> list[str]:
+        if self._op == "__group__":
+            names = []
+            for s in self._inputs:
+                names.extend(s.list_outputs())
+            return names
+        return [self._name + "_output"]
+
+    @property
+    def num_outputs(self) -> int:
+        return len(self.list_outputs())
+
+    def get_internals(self):
+        """All nodes as a Group, mirroring `symbol.py:729` (debugging aid)."""
+        nodes = [n for n in self._topo() if n._op is not None]
+        return Group(nodes) if len(nodes) > 1 else self
+
+    def __getitem__(self, index):
+        if self._op == "__group__":
+            return self._inputs[index]
+        if isinstance(index, str):
+            for n in self._topo():
+                if n._name == index:
+                    return n
+            raise ValueError(f"no internal symbol named {index!r}")
+        return Symbol("__getitem__", [self], kwargs={"index": int(index)},
+                      name=f"{self._name}[{index}]")
+
+    def __iter__(self):
+        if self._op == "__group__":
+            return iter(list(self._inputs))
+        return iter([self])
+
+    # ----------------------------------------------------------- composition
+    def __call__(self, **kwargs):
+        """Compose: substitute named variables with other symbols
+        (`symbol.py:505` Symbol composition)."""
+        for v in kwargs.values():
+            if not isinstance(v, Symbol):
+                raise TypeError("composition requires Symbol values")
+        memo: dict[int, Symbol] = {}
+
+        def sub(node: Symbol) -> Symbol:
+            got = memo.get(id(node))
+            if got is not None:
+                return got
+            if node._op is None:
+                out = kwargs.get(node._name, node)
+            else:
+                new_inputs = [sub(i) for i in node._inputs]
+                if all(a is b for a, b in zip(new_inputs, node._inputs)):
+                    out = node
+                else:
+                    out = Symbol._make(node._op, new_inputs,
+                                       node._args_static, node._kwargs,
+                                       node._name, node._attrs)
+            memo[id(node)] = out
+            return out
+
+        return sub(self)
+
+    # ------------------------------------------------------------ evaluation
+    def _heads(self) -> list[Symbol]:
+        return list(self._inputs) if self._op == "__group__" else [self]
+
+    def _eval(self, env: dict[str, NDArray]):
+        """Execute the DAG over NDArray bindings (works on concrete buffers
+        and on tracers inside a jit trace — same funnel either way)."""
+        memo: dict[int, object] = {}
+
+        def ev(node: Symbol):
+            got = memo.get(id(node))
+            if got is not None:
+                return got
+            if node._op is None:
+                try:
+                    out = env[node._name]
+                except KeyError:
+                    raise ValueError(
+                        f"symbol argument {node._name!r} is not bound") from None
+            elif node._op == "__getitem__":
+                val = ev(node._inputs[0])
+                out = val[node._kwargs["index"]]
+            elif node._op == "__group__":
+                out = tuple(ev(i) for i in node._inputs)
+            else:
+                fn = _resolve_op(node._op)
+                vals = [ev(i) for i in node._inputs]
+                if node._op in _LIST_ARG_OPS:
+                    call_args = [vals] + [a for a in node._args_static[1:]
+                                          if a is not None]
+                else:
+                    call_args, vi = [], 0
+                    for a in node._args_static:
+                        if a is None:
+                            call_args.append(vals[vi])
+                            vi += 1
+                        else:
+                            call_args.append(a)
+                out = fn(*call_args, **node._kwargs)
+            memo[id(node)] = out
+            return out
+
+        outs = []
+        for head in self._heads():
+            v = ev(head)
+            if isinstance(v, tuple):
+                outs.extend(v)
+            else:
+                outs.append(v)
+        return outs
+
+    def eval(self, device=None, ctx=None, **bindings):  # noqa: ARG002
+        """Evaluate eagerly with NDArray bindings (`symbol.py:2831`)."""
+        env = {k: v if isinstance(v, NDArray) else NDArray(v)
+               for k, v in bindings.items()}
+        return self._eval(env)
+
+    def _declared(self, node_name: str, key: str):
+        """Shape/dtype declared on a Variable via `Variable(shape=..)`."""
+        for n in self._topo():
+            if n._op is None and n._name == node_name:
+                v = n._attrs.get(key)
+                if v is not None:
+                    import ast
+
+                    return ast.literal_eval(v) if key == "__shape__" else v
+        return None
+
+    def infer_shape(self, **shapes):
+        """(arg_shapes, out_shapes, aux_shapes) via `jax.eval_shape` — XLA's
+        abstract interpretation replaces the reference's FInferShape pass
+        (`symbol.py:1028`). Shapes declared on `Variable(shape=...)` are
+        used as defaults; kwargs override."""
+        import jax
+
+        args = self.list_arguments()
+        resolved = {}
+        for a in args:
+            s = shapes.get(a)
+            if s is None:
+                s = self._declared(a, "__shape__")
+            if s is None:
+                raise ValueError(f"infer_shape: missing shape for {a!r}")
+            resolved[a] = tuple(s)
+
+        def fn(vals):
+            env = {a: NDArray(v) for a, v in zip(args, vals)}
+            return [o._data for o in self._eval(env)]
+
+        specs = [jax.ShapeDtypeStruct(
+            resolved[a],
+            np_dtype(self._declared(a, "__dtype__") or "float32"))
+            for a in args]
+        outs = jax.eval_shape(fn, specs)
+        aux = self.list_auxiliary_states()
+        arg_shapes = [resolved[a] for a in args if a not in aux]
+        aux_shapes = [resolved[a] for a in args if a in aux]
+        return arg_shapes, [tuple(o.shape) for o in outs], aux_shapes
+
+    def infer_type(self, **dtypes):
+        """Probe dtypes with declared shapes when available, rank-1 probes
+        otherwise. Trace errors propagate — a broken graph should fail
+        loudly here, not return None."""
+        import jax
+
+        args = self.list_arguments()
+
+        def fn(vals):
+            env = {a: NDArray(v) for a, v in zip(args, vals)}
+            return [o._data for o in self._eval(env)]
+
+        def dt(a):
+            return np_dtype(dtypes.get(a) or self._declared(a, "__dtype__")
+                            or "float32")
+
+        specs = [jax.ShapeDtypeStruct(
+            tuple(self._declared(a, "__shape__") or (1,)), dt(a))
+            for a in args]
+        outs = jax.eval_shape(fn, specs)
+        return ([onp.dtype(dt(a)) for a in args],
+                [onp.dtype(o.dtype) if o.dtype != jax.numpy.bfloat16
+                 else jax.numpy.bfloat16 for o in outs], [])
+
+    # ----------------------------------------------------------------- bind
+    def bind(self, device=None, args=None, args_grad=None, grad_req="write",
+             aux_states=None, ctx=None):
+        from .executor import Executor
+
+        return Executor(self, device or ctx, args, args_grad, grad_req,
+                        aux_states)
+
+    def simple_bind(self, device=None, grad_req="write", ctx=None, **shapes):
+        """Allocate argument arrays from shapes and bind (`symbol.py:2042`)."""
+        from .executor import Executor
+
+        arg_names = self.list_arguments()
+        missing = [a for a in arg_names if a not in shapes]
+        if missing:
+            raise ValueError(f"simple_bind: missing shapes for {missing}")
+        args = {a: NDArray(onp.zeros(shapes[a], dtype=onp.float32))
+                for a in arg_names}
+        grads = None
+        if grad_req != "null":
+            grads = {a: NDArray(onp.zeros(shapes[a], dtype=onp.float32))
+                     for a in arg_names}
+        return Executor(self, device or ctx, args, grads, grad_req, None)
+
+    # -------------------------------------------------------------- ser/de
+    def tojson(self) -> str:
+        order = self._topo()
+        idx = {id(n): i for i, n in enumerate(order)}
+        nodes = []
+        for n in order:
+            for k, v in list(n._kwargs.items()):
+                if not _json_safe(v):
+                    raise ValueError(
+                        f"symbol {n._name}: kwarg {k!r} is not serializable")
+            for i, v in enumerate(n._args_static):
+                if not _json_safe(v):
+                    raise ValueError(
+                        f"symbol {n._name}: positional arg {i} "
+                        f"({type(v).__name__}) is not serializable")
+            nodes.append({
+                "op": n._op or "null",
+                "name": n._name,
+                "inputs": [[idx[id(i)], 0] for i in n._inputs],
+                "args_static": n._args_static,
+                "kwargs": n._kwargs,
+                "attrs": n._attrs,
+            })
+        heads = [[idx[id(h)], 0] for h in self._heads()]
+        return json.dumps({"format": "tpu-native-symbol-v1",
+                           "nodes": nodes,
+                           "arg_nodes": [i for i, n in enumerate(order)
+                                         if n._op is None],
+                           "heads": heads}, indent=2)
+
+    def save(self, fname: str):
+        with open(fname, "w") as f:
+            f.write(self.tojson())
+
+    # ---------------------------------------------------------- arithmetic
+    def _binop(self, other, opname, swap=False):
+        if isinstance(other, Symbol):
+            a, b = (other, self) if swap else (self, other)
+            return Symbol(opname, [a, b], hint=opname.split(".")[-1])
+        # scalar operand stays a static python value
+        args = ([None, other] if not swap else [other, None])
+        return Symbol(opname, [self], args_static=args,
+                      hint=opname.split(".")[-1])
+
+    def __add__(self, o): return self._binop(o, "np.add")
+    def __radd__(self, o): return self._binop(o, "np.add", swap=True)
+    def __sub__(self, o): return self._binop(o, "np.subtract")
+    def __rsub__(self, o): return self._binop(o, "np.subtract", swap=True)
+    def __mul__(self, o): return self._binop(o, "np.multiply")
+    def __rmul__(self, o): return self._binop(o, "np.multiply", swap=True)
+    def __truediv__(self, o): return self._binop(o, "np.true_divide")
+    def __rtruediv__(self, o): return self._binop(o, "np.true_divide", swap=True)
+    def __mod__(self, o): return self._binop(o, "np.mod")
+    def __pow__(self, o): return self._binop(o, "np.power")
+    def __matmul__(self, o): return self._binop(o, "np.matmul")
+    def __neg__(self): return Symbol("np.negative", [self], hint="neg")
+    def __eq__(self, o): return self._binop(o, "np.equal")
+    def __ne__(self, o): return self._binop(o, "np.not_equal")
+    def __lt__(self, o): return self._binop(o, "np.less")
+    def __le__(self, o): return self._binop(o, "np.less_equal")
+    def __gt__(self, o): return self._binop(o, "np.greater")
+    def __ge__(self, o): return self._binop(o, "np.greater_equal")
+    __hash__ = object.__hash__
+
+    def __getattr__(self, item):
+        """Method-style op forwarding: `s.reshape(...)` ≡ `sym.reshape(s, ...)`
+        (the reference autogenerates ndarray-style methods on Symbol)."""
+        if item.startswith("_"):
+            raise AttributeError(item)
+        from . import _op_namespace
+
+        fn = _op_namespace.get(item)
+        if fn is None:
+            raise AttributeError(f"Symbol has no op {item!r}")
+
+        def method(*args, **kwargs):
+            return fn(self, *args, **kwargs)
+
+        method.__name__ = item
+        return method
+
+    def __repr__(self):
+        kind = "Variable" if self._op is None else self._op
+        return f"<Symbol {self._name} ({kind})>"
+
+
+def Variable(name: str, attr=None, shape=None, dtype=None, aux=False,
+             **kwargs):  # noqa: ARG001
+    """A named free variable (`symbol.py:2987 var`)."""
+    attrs = dict(attr or {})
+    if shape is not None:
+        attrs["__shape__"] = str(tuple(shape))
+    if dtype is not None:
+        attrs["__dtype__"] = str(dtype)
+    if aux:
+        attrs["__aux__"] = "1"
+    return Symbol(None, [], name=name, attrs=attrs)
+
+
+var = Variable
+
+
+def Group(symbols):
+    """Group heads into one multi-output symbol (`symbol.py:3072`)."""
+    symbols = list(symbols)
+    if not symbols:
+        raise ValueError("Group needs at least one symbol")
+    if any(not isinstance(s, Symbol) for s in symbols):
+        raise TypeError("Group requires Symbols")
+    return Symbol("__group__", symbols, name="group")
+
+
+def fromjson(text: str) -> Symbol:
+    data = json.loads(text)
+    if data.get("format") != "tpu-native-symbol-v1":
+        raise ValueError("not a tpu-native symbol json")
+    nodes: list[Symbol] = []
+    for nd in data["nodes"]:
+        inputs = [] if nd["op"] == "null" else \
+            [nodes[i] for i, _ in nd["inputs"]]
+        s = Symbol._make(None if nd["op"] == "null" else nd["op"], inputs,
+                         nd.get("args_static"), nd.get("kwargs"),
+                         nd["name"], nd.get("attrs"))
+        nodes.append(s)
+    heads = [nodes[i] for i, _ in data["heads"]]
+    return heads[0] if len(heads) == 1 else Group(heads)
+
+
+load_json = fromjson
+
+
+def load(fname: str) -> Symbol:
+    with open(fname) as f:
+        return fromjson(f.read())
+
+
+def save(fname: str, sym: Symbol):
+    sym.save(fname)

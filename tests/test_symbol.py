@@ -1,0 +1,255 @@
+"""Symbol API tests (reference test model: tests/python/unittest/test_symbol.py)."""
+import numpy as onp
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import sym
+from incubator_mxnet_tpu.ndarray.ndarray import NDArray
+
+
+def A(x):
+    return x.asnumpy() if hasattr(x, "asnumpy") else onp.asarray(x)
+
+
+def test_variable_and_compose():
+    a = sym.Variable("a")
+    b = sym.Variable("b")
+    c = a + b
+    assert c.list_arguments() == ["a", "b"]
+    d = c(b=a * 2.0)
+    assert d.list_arguments() == ["a"]
+    out = d.eval(a=onp.full((2, 2), 3.0, onp.float32))[0]
+    onp.testing.assert_allclose(A(out), onp.full((2, 2), 9.0), rtol=1e-6)
+
+
+def test_arithmetic_scalars_and_ops():
+    a = sym.Variable("a")
+    expr = (2.0 * a + 1.0) ** 2 / 4.0 - a
+    x = onp.array([[1.0, 2.0]], onp.float32)
+    out = expr.eval(a=x)[0]
+    onp.testing.assert_allclose(A(out), (2 * x + 1) ** 2 / 4 - x, rtol=1e-6)
+
+
+def test_infer_shape_and_type():
+    a, b = sym.Variable("a"), sym.Variable("b")
+    c = sym.dot(a, b)
+    arg_shapes, out_shapes, aux = c.infer_shape(a=(5, 3), b=(3, 7))
+    assert out_shapes == [(5, 7)]
+    assert arg_shapes == [(5, 3), (3, 7)]
+    assert aux == []
+    arg_types, out_types, _ = c.infer_type(a="float32", b="float32")
+    assert out_types[0] == onp.float32
+
+
+def test_executor_forward_backward():
+    a, w = sym.Variable("a"), sym.Variable("w")
+    loss = (sym.dot(a, w)).sum()
+    ex = loss.simple_bind(grad_req="write", a=(2, 3), w=(3, 4))
+    av = onp.arange(6, dtype=onp.float32).reshape(2, 3)
+    wv = onp.ones((3, 4), onp.float32)
+    ex.forward(is_train=True, a=av, w=wv)
+    onp.testing.assert_allclose(A(ex.outputs[0]), (av @ wv).sum(), rtol=1e-6)
+    ex.backward()
+    onp.testing.assert_allclose(A(ex.grad_dict["w"]),
+                                onp.repeat(av.sum(0)[:, None], 4, 1), rtol=1e-6)
+    onp.testing.assert_allclose(A(ex.grad_dict["a"]),
+                                onp.full((2, 3), 4.0), rtol=1e-6)
+
+
+def test_executor_grad_req_add_and_null():
+    a, b = sym.Variable("a"), sym.Variable("b")
+    loss = (a * b).sum()
+    ex = loss.bind(args={"a": NDArray(onp.ones((2,), onp.float32)),
+                         "b": NDArray(onp.full((2,), 3.0, onp.float32))},
+                   args_grad={"a": NDArray(onp.zeros((2,), onp.float32))},
+                   grad_req={"a": "add", "b": "null"})
+    ex.forward(is_train=True)
+    ex.backward()
+    ex.backward()
+    onp.testing.assert_allclose(A(ex.grad_dict["a"]), onp.full((2,), 6.0))
+    assert "b" not in ex.grad_dict
+
+
+def test_json_roundtrip():
+    a, b = sym.Variable("a"), sym.Variable("b")
+    c = sym.relu(sym.dot(a, b) + 0.5)
+    js = c.tojson()
+    c2 = sym.fromjson(js)
+    assert c2.list_arguments() == ["a", "b"]
+    av = onp.random.RandomState(0).randn(2, 3).astype(onp.float32)
+    bv = onp.random.RandomState(1).randn(3, 2).astype(onp.float32)
+    onp.testing.assert_allclose(A(c.eval(a=av, b=bv)[0]),
+                                A(c2.eval(a=av, b=bv)[0]), rtol=1e-6)
+
+
+def test_save_load(tmp_path):
+    a = sym.Variable("a")
+    s = sym.exp(a)
+    f = str(tmp_path / "sym.json")
+    s.save(f)
+    s2 = sym.load(f)
+    x = onp.array([0.0, 1.0], onp.float32)
+    onp.testing.assert_allclose(A(s2.eval(a=x)[0]), onp.exp(x), rtol=1e-6)
+
+
+def test_group_and_getitem():
+    a = sym.Variable("a")
+    g = sym.Group([a + 1.0, a * 2.0])
+    assert g.num_outputs == 2
+    outs = g.eval(a=onp.ones((2,), onp.float32))
+    onp.testing.assert_allclose(A(outs[0]), [2.0, 2.0])
+    onp.testing.assert_allclose(A(outs[1]), [2.0, 2.0])
+    first = g[0]
+    onp.testing.assert_allclose(A(first.eval(a=onp.ones((2,), onp.float32))[0]),
+                                [2.0, 2.0])
+
+
+def test_multi_output_getitem():
+    a = sym.Variable("a")
+    s = sym.split(a, 2, axis=0)
+    part = s[0] + 10.0
+    out = part.eval(a=onp.arange(4, dtype=onp.float32))[0]
+    onp.testing.assert_allclose(A(out), [10.0, 11.0])
+
+
+def test_method_forwarding():
+    a = sym.Variable("a")
+    s = a.reshape((4,)).sum()
+    out = s.eval(a=onp.ones((2, 2), onp.float32))[0]
+    assert float(A(out)) == 4.0
+
+
+def test_attr_scope_and_attrs():
+    with mx.AttrScope(group="fc"):
+        a = sym.Variable("a")
+        b = a + 1.0
+    assert a.attr("group") == "fc"
+    assert b.attr("group") == "fc"
+    assert "a" in b.attr_dict()
+
+
+def test_name_manager_prefix():
+    from incubator_mxnet_tpu import name as nm
+
+    with nm.Prefix("enc_"):
+        a = sym.Variable("x") + 1.0
+    assert a.name.startswith("enc_")
+
+
+def test_list_arg_ops_concatenate():
+    a, b = sym.Variable("a"), sym.Variable("b")
+    c = sym.concatenate([a, b], axis=0)
+    out = c.eval(a=onp.ones((1, 2), onp.float32),
+                 b=onp.zeros((1, 2), onp.float32))[0]
+    onp.testing.assert_allclose(A(out), [[1, 1], [0, 0]])
+
+
+def test_npx_ops_in_symbol():
+    x = sym.Variable("x")
+    s = sym.softmax(x)
+    v = onp.array([[1.0, 2.0, 3.0]], onp.float32)
+    ref = onp.exp(v) / onp.exp(v).sum()
+    onp.testing.assert_allclose(A(s.eval(x=v)[0]), ref, rtol=1e-5)
+
+
+def test_random_namespace_symbol():
+    s = sym.random.normal(0.0, 1.0, (64, 64))
+    out = s.eval()[0]
+    assert out.shape == (64, 64)
+    assert abs(float(A(out).mean())) < 0.5
+
+
+def test_unbound_argument_raises():
+    a, b = sym.Variable("a"), sym.Variable("b")
+    c = a + b
+    with pytest.raises(ValueError, match="not bound"):
+        c.eval(a=onp.ones((1,), onp.float32))
+
+
+def test_symbolblock_from_symbol():
+    from incubator_mxnet_tpu import gluon
+
+    data = sym.Variable("data")
+    w = sym.Variable("w")
+    net_sym = sym.relu(sym.dot(data, w))
+    blk = gluon.SymbolBlock(net_sym, inputs=[data],
+                            params={"w": onp.ones((3, 2), onp.float32)})
+    x = NDArray(onp.ones((1, 3), onp.float32))
+    out = blk(x)
+    onp.testing.assert_allclose(A(out), [[3.0, 3.0]], rtol=1e-6)
+    # trains like any block
+    from incubator_mxnet_tpu import autograd
+
+    with autograd.record():
+        loss = blk(x).sum()
+    loss.backward()
+    g = blk.collect_params()["w"].grad()
+    onp.testing.assert_allclose(A(g), onp.ones((3, 2)), rtol=1e-6)
+
+
+def test_backward_reuses_forward_rng_key():
+    """Gradients must differentiate the SAME stochastic realization as the
+    reported loss (dropout/random ops)."""
+    x = sym.Variable("x")
+    s = (x * sym.random.normal(0.0, 1.0, (64,))).sum()
+    ex = s.bind(args={"x": NDArray(onp.ones((64,), onp.float32))},
+                args_grad={"x": NDArray(onp.zeros((64,), onp.float32))},
+                grad_req="write")
+    out = ex.forward(is_train=True)[0]
+    ex.backward()
+    # d/dx sum(x*n) = n, and loss = sum(n) for x=1 → grad sum == loss
+    onp.testing.assert_allclose(float(A(ex.grad_dict["x"]).sum()),
+                                float(A(out)), rtol=1e-5)
+
+
+def test_attr_scope_reuse_no_leak():
+    scope = mx.AttrScope(lr_mult="2")
+    with mx.AttrScope(ctx_group="dev1"):
+        with scope:
+            pass
+    with scope:
+        v = sym.Variable("v_leakcheck")
+    assert v.attr("ctx_group") is None
+    assert v.attr("lr_mult") == "2"
+
+
+def test_fromjson_ignores_ambient_attr_scope():
+    a = sym.Variable("a")
+    js = (a + 1.0).tojson()
+    with mx.AttrScope(ctx_group="dev9"):
+        s2 = sym.fromjson(js)
+    assert all("ctx_group" not in attrs for attrs in
+               ([n._attrs for n in s2._topo()]))
+
+
+def test_variable_declared_shape_used_by_infer():
+    a = sym.Variable("a", shape=(3, 4), dtype="float32")
+    b = sym.Variable("b", shape=(4, 2))
+    _, outs, _ = sym.dot(a, b).infer_shape()
+    assert outs == [(3, 2)]
+
+
+def test_tojson_rejects_array_static():
+    a = sym.Variable("a")
+    s = sym.dot(a, onp.ones((2, 2), onp.float32))
+    with pytest.raises(ValueError, match="not serializable"):
+        s.tojson()
+
+
+def test_infer_type_propagates_errors():
+    a, b = sym.Variable("a", shape=(2, 3)), sym.Variable("b", shape=(4, 5))
+    with pytest.raises(Exception):
+        sym.dot(a, b).infer_type()
+
+
+def test_eval_consistency_with_imperative():
+    """Symbolic and imperative paths share the funnel — results identical."""
+    from incubator_mxnet_tpu import np as mnp
+
+    rs = onp.random.RandomState(7)
+    av = rs.randn(4, 5).astype(onp.float32)
+    a = sym.Variable("a")
+    s = sym.tanh(a) * 2.0
+    sym_out = A(s.eval(a=av)[0])
+    imp_out = A(mnp.tanh(mnp.array(av)) * 2.0)
+    onp.testing.assert_array_equal(sym_out, imp_out)
