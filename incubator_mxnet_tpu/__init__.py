@@ -70,6 +70,12 @@ from . import symbol as sym  # noqa: F401
 from . import name  # noqa: F401
 from . import attribute  # noqa: F401
 from .attribute import AttrScope  # noqa: F401
+from . import runtime  # noqa: F401
+from . import model  # noqa: F401
+from . import visualization  # noqa: F401
+from . import visualization as viz  # noqa: F401
+from . import error  # noqa: F401
+from . import log  # noqa: F401
 from . import util  # noqa: F401
 from . import test_utils  # noqa: F401
 from . import contrib  # noqa: F401

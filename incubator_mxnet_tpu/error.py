@@ -1,0 +1,59 @@
+"""Typed framework exceptions (reference: `python/mxnet/error.py` — error
+classes mapped from the C-API error ring by kind; here they are ordinary
+Python exceptions raised directly, since there is no C error boundary)."""
+from __future__ import annotations
+
+from .base import MXNetError
+
+__all__ = ["MXNetError", "InternalError", "IndexError", "ValueError",
+           "TypeError", "AttributeError", "NotImplementedForSymbol",
+           "register_error"]
+
+
+class InternalError(MXNetError):
+    """Framework-internal invariant violation (`error.py:31`)."""
+
+
+class IndexError(MXNetError, IndexError):  # noqa: A001
+    pass
+
+
+class ValueError(MXNetError, ValueError):  # noqa: A001
+    pass
+
+
+class TypeError(MXNetError, TypeError):  # noqa: A001
+    pass
+
+
+class AttributeError(MXNetError, AttributeError):  # noqa: A001
+    pass
+
+
+class NotImplementedForSymbol(MXNetError):
+    def __init__(self, function, alias=None, *args):
+        super().__init__()
+        self.function = function.__name__ if callable(function) else str(function)
+        self.alias = alias
+
+    def __str__(self):
+        msg = f"Function {self.function} is not implemented for Symbol"
+        if self.alias:
+            msg += f" (use {self.alias})"
+        return msg
+
+
+_ERROR_REGISTRY: dict[str, type] = {}
+
+
+def register_error(cls_or_name=None):
+    """Register a custom error type by name (`error.py` register_error)."""
+    def _do(cls, name=None):
+        _ERROR_REGISTRY[name or cls.__name__] = cls
+        return cls
+
+    if isinstance(cls_or_name, str):
+        return lambda cls: _do(cls, cls_or_name)
+    if cls_or_name is not None:
+        return _do(cls_or_name)
+    return _do

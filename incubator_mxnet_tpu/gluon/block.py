@@ -511,7 +511,8 @@ class SymbolBlock(HybridBlock):
         self._exported = None
         self._manifest = None
         params = params or {}
-        for name in outputs.list_arguments():
+        aux = set(outputs.list_auxiliary_states())
+        for name in outputs._all_inputs():
             if name in self._sym_inputs:
                 continue
             v = params.get(name)
@@ -520,7 +521,9 @@ class SymbolBlock(HybridBlock):
                     f"SymbolBlock: no value for free variable {name!r}; "
                     f"pass it in `params` or list it in `inputs`")
             v = v if isinstance(v, NDArray) else NDArray(v)
-            p = Parameter(shape=v.shape, dtype=str(v.dtype), name=name)
+            # aux states (BN running stats) must not receive grads/updates
+            p = Parameter(shape=v.shape, dtype=str(v.dtype), name=name,
+                          grad_req="null" if name in aux else "write")
             p.set_data(v)
             self._reg_params[name] = p
 

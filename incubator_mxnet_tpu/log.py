@@ -1,0 +1,54 @@
+"""Logging helpers (reference: `python/mxnet/log.py` — colored formatter +
+`get_logger`)."""
+from __future__ import annotations
+
+import logging
+import sys
+
+__all__ = ["get_logger", "getLogger", "DEBUG", "INFO", "WARNING", "ERROR",
+           "NOTSET"]
+
+DEBUG = logging.DEBUG
+INFO = logging.INFO
+WARNING = logging.WARNING
+ERROR = logging.ERROR
+NOTSET = logging.NOTSET
+
+_LEVEL_COLOR = {logging.DEBUG: "\x1b[32m", logging.INFO: "\x1b[34m",
+                logging.WARNING: "\x1b[33m", logging.ERROR: "\x1b[31m"}
+
+
+class _Formatter(logging.Formatter):
+    """Level-colored formatter when attached to a tty (`log.py:34`)."""
+
+    def __init__(self, colored=True):
+        super().__init__(datefmt="%m%d %H:%M:%S")
+        self._colored = colored
+
+    def format(self, record):
+        label = record.levelname[0]
+        head = f"{label}{self.formatTime(record)} {record.process} " \
+               f"{record.filename}:{record.lineno}]"
+        if self._colored and record.levelno in _LEVEL_COLOR:
+            head = f"{_LEVEL_COLOR[record.levelno]}{head}\x1b[0m"
+        return f"{head} {record.getMessage()}"
+
+
+def get_logger(name=None, filename=None, filemode=None, level=WARNING):
+    """Configured logger (`log.py:84`)."""
+    logger = logging.getLogger(name)
+    if getattr(logger, "_mx_configured", False):
+        return logger
+    if filename:
+        handler: logging.Handler = logging.FileHandler(filename, filemode or "a")
+        handler.setFormatter(_Formatter(colored=False))
+    else:
+        handler = logging.StreamHandler(sys.stderr)
+        handler.setFormatter(_Formatter(colored=sys.stderr.isatty()))
+    logger.addHandler(handler)
+    logger.setLevel(level)
+    logger._mx_configured = True
+    return logger
+
+
+getLogger = get_logger

@@ -1,0 +1,58 @@
+"""Legacy model checkpoint helpers (reference: `python/mxnet/model.py:189`
+`save_checkpoint` / `:238` `load_checkpoint` — symbol json + `.params`
+epoch files).
+
+File layout matches the reference convention:
+  <prefix>-symbol.json           the architecture (mx.sym JSON)
+  <prefix>-%04d.params           arg/aux parameters for one epoch
+Parameter names are prefixed "arg:"/"aux:" exactly as the reference does, so
+`load_checkpoint` can split them back.
+"""
+from __future__ import annotations
+
+from . import symbol as sym
+from .ndarray import load as nd_load
+from .ndarray import save as nd_save
+from .ndarray.ndarray import NDArray
+
+__all__ = ["save_checkpoint", "load_params", "load_checkpoint",
+           "BatchEndParam"]
+
+import collections
+
+BatchEndParam = collections.namedtuple(
+    "BatchEndParam", ["epoch", "nbatch", "eval_metric", "locals"])
+
+
+def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params=None,
+                    remove_amp_cast=True):  # noqa: ARG001
+    """Save symbol + params for `epoch` (`model.py:189`)."""
+    if symbol is not None:
+        symbol.save(f"{prefix}-symbol.json")
+    payload = {f"arg:{k}": v if isinstance(v, NDArray) else NDArray(v)
+               for k, v in (arg_params or {}).items()}
+    payload.update({f"aux:{k}": v if isinstance(v, NDArray) else NDArray(v)
+                    for k, v in (aux_params or {}).items()})
+    nd_save(f"{prefix}-{epoch:04d}.params", payload)
+
+
+def load_params(prefix, epoch):
+    """(arg_params, aux_params) from an epoch file (`model.py:221`)."""
+    loaded = nd_load(f"{prefix}-{epoch:04d}.params")
+    arg_params, aux_params = {}, {}
+    for k, v in loaded.items():
+        kind, _, name = k.partition(":")
+        if kind == "arg":
+            arg_params[name] = v
+        elif kind == "aux":
+            aux_params[name] = v
+        else:
+            arg_params[k] = v
+    return arg_params, aux_params
+
+
+def load_checkpoint(prefix, epoch):
+    """(symbol, arg_params, aux_params) (`model.py:238`)."""
+    symbol = sym.load(f"{prefix}-symbol.json")
+    arg_params, aux_params = load_params(prefix, epoch)
+    return symbol, arg_params, aux_params

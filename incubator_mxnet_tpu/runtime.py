@@ -1,0 +1,96 @@
+"""Runtime feature introspection (reference: `python/mxnet/runtime.py` —
+`Features` OrderedDict of compiled-in flags backed by `src/libinfo.cc`).
+
+TPU-native: "compiled features" are what the jax installation and this
+package provide at import time — the TPU backend, pallas, distributed init,
+the native C++ runtime extensions — probed live instead of baked at compile
+time.
+"""
+from __future__ import annotations
+
+import collections
+
+__all__ = ["Feature", "feature_list", "Features"]
+
+
+class Feature:
+    """One named capability flag (`runtime.py:52`)."""
+
+    def __init__(self, name: str, enabled: bool):
+        self.name = name
+        self.enabled = enabled
+
+    def __bool__(self):
+        return self.enabled
+
+    def __repr__(self):
+        return f"[{'✔' if self.enabled else '✖'} {self.name}]"
+
+
+def _probe():
+    feats: dict[str, bool] = {}
+    import jax
+
+    platforms = set()
+    try:
+        platforms = {d.platform for d in jax.devices()}
+    except Exception:
+        pass
+    feats["TPU"] = "tpu" in platforms
+    feats["CPU"] = True
+    feats["CUDA"] = "gpu" in platforms or "cuda" in platforms
+    feats["INT64_TENSOR_SIZE"] = True
+    feats["F16C"] = True          # bf16/fp16 compute via XLA
+    feats["BLAS_OPEN"] = True     # XLA's dot lowering plays the BLAS role
+    feats["LAPACK"] = hasattr(jax.numpy.linalg, "solve")
+    try:
+        from jax.experimental import pallas  # noqa: F401
+
+        feats["PALLAS"] = True
+    except Exception:
+        feats["PALLAS"] = False
+    feats["DIST_KVSTORE"] = True  # jax.distributed-backed kvstore('dist')
+    try:
+        from . import _native
+
+        feats["NATIVE_RTIO"] = _native.available()
+    except Exception:
+        feats["NATIVE_RTIO"] = False
+    feats["OPENCV"] = False       # image ops are pure jax/PIL
+    feats["ONEDNN"] = False       # XLA owns CPU codegen
+    feats["TENSORRT"] = False
+    feats["PROFILER"] = True
+    feats["ONNX"] = True
+    feats["QUANTIZATION"] = True
+    return feats
+
+
+def feature_list():
+    """List of Feature objects (`runtime.py:75`)."""
+    return [Feature(k, v) for k, v in _probe().items()]
+
+
+class Features(collections.OrderedDict):
+    """name → Feature map with `is_enabled` (`runtime.py:89`)."""
+
+    instance = None
+
+    def __new__(cls):
+        if cls.instance is None:
+            inst = super().__new__(cls)
+            super(Features, inst).__init__(
+                [(f.name, f) for f in feature_list()])
+            cls.instance = inst
+        return cls.instance
+
+    def __init__(self):
+        pass
+
+    def __repr__(self):
+        return str(list(self.values()))
+
+    def is_enabled(self, feature_name: str) -> bool:
+        feature_name = feature_name.upper()
+        if feature_name not in self:
+            raise RuntimeError(f"Feature '{feature_name}' is unknown")
+        return bool(self[feature_name])

@@ -25,9 +25,14 @@ class Executor:
                  grad_req="write", aux_states=None):
         self._symbol = symbol
         self._device = device
-        self._arg_names = symbol.list_arguments()
+        # binding covers arguments AND aux states (both are env entries for
+        # the graph evaluation); grads only flow to non-aux names by default
+        self._arg_names = symbol._all_inputs()
 
-        self.arg_dict = self._as_dict(args, "args")
+        # list-form args align to list_arguments() (non-aux), list-form
+        # aux_states to list_auxiliary_states() — the reference bind contract
+        self.arg_dict = self._as_dict(args, "args",
+                                      names=symbol.list_arguments())
         if aux_states:
             self.arg_dict.update(self._as_dict(aux_states, "aux_states",
                                                names=symbol.list_auxiliary_states()))
@@ -35,12 +40,15 @@ class Executor:
         if missing:
             raise ValueError(f"bind: missing arguments {missing}")
 
-        self.grad_dict = self._as_dict(args_grad, "args_grad") \
+        self.grad_dict = self._as_dict(args_grad, "args_grad",
+                                       names=symbol.list_arguments()) \
             if args_grad is not None else {}
+        aux = set(symbol.list_auxiliary_states())
         if isinstance(grad_req, str):
             self._grad_req = {a: (grad_req if a in self.grad_dict else "null")
                               for a in self._arg_names} if self.grad_dict else \
-                {a: grad_req for a in self._arg_names}
+                {a: ("null" if a in aux else grad_req)
+                 for a in self._arg_names}
         else:
             self._grad_req = {a: grad_req.get(a, "null") for a in self._arg_names}
 
@@ -80,8 +88,8 @@ class Executor:
         self._jit[(train, "fwd")] = fn
         return fn
 
-    def _backward_fn(self):
-        fn = self._jit.get((True, "bwd"))
+    def _backward_fn(self, train: bool):
+        fn = self._jit.get((train, "bwd"))
         if fn is not None:
             return fn
         import jax
@@ -96,7 +104,7 @@ class Executor:
                 for j, i in enumerate(diff_idx):
                     call[i] = diff_vals[j]
                 env = {n: NDArray(v) for n, v in zip(names, call)}
-                with trace_key_scope(key), autograd.pause(train_mode=True):
+                with trace_key_scope(key), autograd.pause(train_mode=train):
                     outs = sym._eval(env)
                 return tuple(o._data for o in outs)
 
@@ -111,7 +119,7 @@ class Executor:
             return grads
 
         fn = jax.jit(run)
-        self._jit[(True, "bwd")] = fn
+        self._jit[(train, "bwd")] = fn
         return fn
 
     # ------------------------------------------------------------- execute
@@ -123,7 +131,8 @@ class Executor:
                 v._data if isinstance(v, NDArray) else NDArray(v)._data)
         vals = [self.arg_dict[n]._data for n in self._arg_names]
         self._fwd_key = next_key()
-        outs = self._forward_fn(bool(is_train))(self._fwd_key, *vals)
+        self._fwd_train = bool(is_train)
+        outs = self._forward_fn(self._fwd_train)(self._fwd_key, *vals)
         self.outputs = [NDArray(o) for o in outs]
         return self.outputs
 
@@ -138,9 +147,10 @@ class Executor:
         vals = [self.arg_dict[n]._data for n in self._arg_names]
         ograd_vals = tuple(g._data if isinstance(g, NDArray) else NDArray(g)._data
                            for g in out_grads)
-        # reuse the forward RNG key so stochastic ops (dropout, random
-        # samples) differentiate the SAME realization the loss was computed on
-        grads = self._backward_fn()(self._fwd_key, tuple(vals), ograd_vals)
+        # reuse the forward RNG key AND train mode so gradients differentiate
+        # exactly the function (and stochastic realization) the loss came from
+        grads = self._backward_fn(self._fwd_train)(
+            self._fwd_key, tuple(vals), ograd_vals)
         diff_names = [n for n in self._arg_names
                       if self._grad_req.get(n, "null") != "null"]
         for n, g in zip(diff_names, grads):

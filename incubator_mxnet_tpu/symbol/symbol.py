@@ -31,6 +31,23 @@ from ..ndarray.ndarray import NDArray
 __all__ = ["Symbol", "Variable", "var", "Group", "fromjson", "load",
            "load_json", "save"]
 
+
+class _SymSlot:
+    """Sentinel marking a symbol-input position in args_static — distinct
+    from a literal `None` static argument (e.g. numpy-style `axis=None`)."""
+
+    _JSON = {"__sym_slot__": 1}
+
+    def __repr__(self):
+        return "<sym>"
+
+
+SLOT = _SymSlot()
+
+
+def _is_slot(v):
+    return isinstance(v, _SymSlot)
+
 # ops whose python signature takes a leading list of tensors
 # (np.concatenate style) — symbol inputs are re-packed into a list at eval
 _LIST_ARG_OPS = {
@@ -73,10 +90,10 @@ class Symbol:
         # op: None for variables, "__group__", or qualified op name
         self._op = op
         self._inputs: list[Symbol] = list(inputs)
-        # positional arg template: None marks a symbol slot (consumed from
-        # self._inputs in order); other entries are static python values
+        # positional arg template: SLOT marks a symbol position (consumed
+        # from self._inputs in order); other entries are static values
         self._args_static = list(args_static) if args_static is not None else \
-            [None] * len(self._inputs)
+            [SLOT] * len(self._inputs)
         self._kwargs = dict(kwargs or {})
         hint = hint or (op.split(".")[-1].lower() if op else "var")
         self._name = _name.current().get(name, hint + "_")
@@ -91,7 +108,7 @@ class Symbol:
         s._op = op
         s._inputs = list(inputs)
         s._args_static = list(args_static) if args_static is not None else \
-            [None] * len(s._inputs)
+            [SLOT] * len(s._inputs)
         s._kwargs = dict(kwargs or {})
         s._name = name
         s._attrs = dict(attrs or {})
@@ -131,21 +148,30 @@ class Symbol:
                 stack.append((inp, False))
         return order
 
-    def list_arguments(self) -> list[str]:
-        """Free variables in first-use order (`symbol.py:820`)."""
+    def _free_vars(self) -> list["Symbol"]:
         out, seen = [], set()
         for node in self._topo():
             if node._op is None and node._name not in seen:
                 seen.add(node._name)
-                out.append(node._name)
+                out.append(node)
         return out
+
+    def list_arguments(self) -> list[str]:
+        """Free non-aux variables in first-use order (`symbol.py:820`) —
+        aligned index-for-index with `infer_shape()[0]`."""
+        return [n._name for n in self._free_vars()
+                if n._attrs.get("__aux__") != "1"]
 
     def list_auxiliary_states(self) -> list[str]:
         """Aux states (BN running stats). The TPU symbol graph carries aux
         state as ordinary variables (functional jax style), so this is the
         subset of variables flagged `__aux__` via Variable(..., aux=True)."""
-        return [n._name for n in self._topo()
-                if n._op is None and n._attrs.get("__aux__") == "1"]
+        return [n._name for n in self._free_vars()
+                if n._attrs.get("__aux__") == "1"]
+
+    def _all_inputs(self) -> list[str]:
+        """Arguments + aux states in first-use order (binding order)."""
+        return [n._name for n in self._free_vars()]
 
     def list_outputs(self) -> list[str]:
         if self._op == "__group__":
@@ -212,9 +238,13 @@ class Symbol:
     def _heads(self) -> list[Symbol]:
         return list(self._inputs) if self._op == "__group__" else [self]
 
-    def _eval(self, env: dict[str, NDArray]):
+    def _eval(self, env: dict[str, NDArray], record: dict | None = None):
         """Execute the DAG over NDArray bindings (works on concrete buffers
-        and on tracers inside a jit trace — same funnel either way)."""
+        and on tracers inside a jit trace — same funnel either way).
+
+        `record`, if given, is filled with {node_name: value} for every op
+        node — the single shared walk used by `mx.visualization` so the
+        dispatch convention lives in exactly one place."""
         memo: dict[int, object] = {}
 
         def ev(node: Symbol):
@@ -236,12 +266,14 @@ class Symbol:
                 fn = _resolve_op(node._op)
                 vals = [ev(i) for i in node._inputs]
                 if node._op in _LIST_ARG_OPS:
-                    call_args = [vals] + [a for a in node._args_static[1:]
-                                          if a is not None]
+                    # slot 0 is the symbol list; remaining statics pass
+                    # through verbatim (None may be a real value, e.g.
+                    # concatenate(..., axis=None))
+                    call_args = [vals] + list(node._args_static[1:])
                 else:
                     call_args, vi = [], 0
                     for a in node._args_static:
-                        if a is None:
+                        if _is_slot(a):
                             call_args.append(vals[vi])
                             vi += 1
                         else:
@@ -257,6 +289,10 @@ class Symbol:
                 outs.extend(v)
             else:
                 outs.append(v)
+        if record is not None:
+            for n in self._topo():
+                if n._op not in (None, "__group__"):
+                    record[n._name] = ev(n)
         return outs
 
     def eval(self, device=None, ctx=None, **bindings):  # noqa: ARG002
@@ -283,9 +319,9 @@ class Symbol:
         used as defaults; kwargs override."""
         import jax
 
-        args = self.list_arguments()
+        bind_names = self._all_inputs()
         resolved = {}
-        for a in args:
+        for a in bind_names:
             s = shapes.get(a)
             if s is None:
                 s = self._declared(a, "__shape__")
@@ -294,17 +330,17 @@ class Symbol:
             resolved[a] = tuple(s)
 
         def fn(vals):
-            env = {a: NDArray(v) for a, v in zip(args, vals)}
+            env = {a: NDArray(v) for a, v in zip(bind_names, vals)}
             return [o._data for o in self._eval(env)]
 
         specs = [jax.ShapeDtypeStruct(
             resolved[a],
             np_dtype(self._declared(a, "__dtype__") or "float32"))
-            for a in args]
+            for a in bind_names]
         outs = jax.eval_shape(fn, specs)
-        aux = self.list_auxiliary_states()
-        arg_shapes = [resolved[a] for a in args if a not in aux]
-        aux_shapes = [resolved[a] for a in args if a in aux]
+        # aligned index-for-index with list_arguments()/list_auxiliary_states()
+        arg_shapes = [resolved[a] for a in self.list_arguments()]
+        aux_shapes = [resolved[a] for a in self.list_auxiliary_states()]
         return arg_shapes, [tuple(o.shape) for o in outs], aux_shapes
 
     def infer_type(self, **dtypes):
@@ -313,10 +349,10 @@ class Symbol:
         loudly here, not return None."""
         import jax
 
-        args = self.list_arguments()
+        bind_names = self._all_inputs()
 
         def fn(vals):
-            env = {a: NDArray(v) for a, v in zip(args, vals)}
+            env = {a: NDArray(v) for a, v in zip(bind_names, vals)}
             return [o._data for o in self._eval(env)]
 
         def dt(a):
@@ -325,11 +361,12 @@ class Symbol:
 
         specs = [jax.ShapeDtypeStruct(
             tuple(self._declared(a, "__shape__") or (1,)), dt(a))
-            for a in args]
+            for a in bind_names]
         outs = jax.eval_shape(fn, specs)
-        return ([onp.dtype(dt(a)) for a in args],
+        return ([onp.dtype(dt(a)) for a in self.list_arguments()],
                 [onp.dtype(o.dtype) if o.dtype != jax.numpy.bfloat16
-                 else jax.numpy.bfloat16 for o in outs], [])
+                 else jax.numpy.bfloat16 for o in outs],
+                [onp.dtype(dt(a)) for a in self.list_auxiliary_states()])
 
     # ----------------------------------------------------------------- bind
     def bind(self, device=None, args=None, args_grad=None, grad_req="write",
@@ -343,16 +380,21 @@ class Symbol:
         """Allocate argument arrays from shapes and bind (`symbol.py:2042`)."""
         from .executor import Executor
 
-        arg_names = self.list_arguments()
-        missing = [a for a in arg_names if a not in shapes]
+        bind_names = self._all_inputs()
+        missing = [a for a in bind_names
+                   if a not in shapes and self._declared(a, "__shape__") is None]
         if missing:
             raise ValueError(f"simple_bind: missing shapes for {missing}")
-        args = {a: NDArray(onp.zeros(shapes[a], dtype=onp.float32))
-                for a in arg_names}
+
+        def shp(a):
+            return tuple(shapes.get(a) or self._declared(a, "__shape__"))
+
+        args = {a: NDArray(onp.zeros(shp(a), dtype=onp.float32))
+                for a in bind_names}
         grads = None
         if grad_req != "null":
-            grads = {a: NDArray(onp.zeros(shapes[a], dtype=onp.float32))
-                     for a in arg_names}
+            grads = {a: NDArray(onp.zeros(shp(a), dtype=onp.float32))
+                     for a in self.list_arguments()}
         return Executor(self, device or ctx, args, grads, grad_req, None)
 
     # -------------------------------------------------------------- ser/de
@@ -365,16 +407,21 @@ class Symbol:
                 if not _json_safe(v):
                     raise ValueError(
                         f"symbol {n._name}: kwarg {k!r} is not serializable")
+            ser_static = []
             for i, v in enumerate(n._args_static):
+                if _is_slot(v):
+                    ser_static.append(_SymSlot._JSON)
+                    continue
                 if not _json_safe(v):
                     raise ValueError(
                         f"symbol {n._name}: positional arg {i} "
                         f"({type(v).__name__}) is not serializable")
+                ser_static.append(v)
             nodes.append({
                 "op": n._op or "null",
                 "name": n._name,
                 "inputs": [[idx[id(i)], 0] for i in n._inputs],
-                "args_static": n._args_static,
+                "args_static": ser_static,
                 "kwargs": n._kwargs,
                 "attrs": n._attrs,
             })
@@ -395,7 +442,7 @@ class Symbol:
             a, b = (other, self) if swap else (self, other)
             return Symbol(opname, [a, b], hint=opname.split(".")[-1])
         # scalar operand stays a static python value
-        args = ([None, other] if not swap else [other, None])
+        args = ([SLOT, other] if not swap else [other, SLOT])
         return Symbol(opname, [self], args_static=args,
                       hint=opname.split(".")[-1])
 
@@ -475,12 +522,19 @@ def fromjson(text: str) -> Symbol:
     for nd in data["nodes"]:
         inputs = [] if nd["op"] == "null" else \
             [nodes[i] for i, _ in nd["inputs"]]
+        raw = nd.get("args_static")
+        statics = None if raw is None else \
+            [SLOT if v == _SymSlot._JSON else v for v in raw]
         s = Symbol._make(None if nd["op"] == "null" else nd["op"], inputs,
-                         nd.get("args_static"), nd.get("kwargs"),
+                         statics, nd.get("kwargs"),
                          nd["name"], nd.get("attrs"))
         nodes.append(s)
     heads = [nodes[i] for i, _ in data["heads"]]
-    return heads[0] if len(heads) == 1 else Group(heads)
+    if len(heads) == 1:
+        return heads[0]
+    # _make (not Group→Symbol()) so the rebuilt head ignores the ambient
+    # AttrScope, same as every other reconstructed node
+    return Symbol._make("__group__", heads, None, None, "group", None)
 
 
 load_json = fromjson

@@ -1,0 +1,161 @@
+"""Network visualization (reference: `python/mxnet/visualization.py:46`
+`print_summary`, `:210` `plot_network`).
+
+Works over `mx.sym.Symbol` graphs. `plot_network` emits graphviz DOT — via
+the `graphviz` python package when installed, else a lightweight stand-in
+exposing the same `.source`/`.save` surface (no rendering dependency
+required on TPU hosts).
+"""
+from __future__ import annotations
+
+import numpy as onp
+
+__all__ = ["print_summary", "plot_network"]
+
+
+def _node_label(node):
+    if node._op is None:
+        return node.name, "variable"
+    return node.name, node._op
+
+
+def print_summary(symbol, shape=None, line_length=120,
+                  positions=(.44, .64, .74, 1.)):
+    """Layer-table summary of a symbol graph (`visualization.py:46`)."""
+    out_shapes = {}
+    if shape is not None:
+        # infer every node's output shape by evaluating internals
+        import jax
+
+        from .ndarray.ndarray import NDArray
+
+        args = symbol._all_inputs()
+        missing = [a for a in args if a not in shape]
+        if missing:
+            raise ValueError(f"print_summary: missing shapes for {missing}")
+
+        def fn(vals):
+            env = {a: NDArray(v) for a, v in zip(args, vals)}
+            # the one shared DAG walk: Symbol._eval fills `record` with every
+            # op node's value in a single memoized pass
+            record: dict = {}
+            symbol._eval(env, record=record)
+            return {k: tuple(x._data for x in v) if isinstance(v, tuple)
+                    else v._data for k, v in record.items()}
+
+        specs = [jax.ShapeDtypeStruct(tuple(shape[a]), onp.float32)
+                 for a in args]
+        try:
+            shaped = jax.eval_shape(fn, specs)
+            out_shapes = {k: (tuple(tuple(x.shape) for x in v)
+                              if isinstance(v, tuple) else tuple(v.shape))
+                          for k, v in shaped.items()}
+        except Exception:
+            out_shapes = {}
+
+    positions = [int(line_length * p) for p in positions]
+    header = ["Layer (type)", "Output Shape", "Param #", "Previous Layer"]
+    lines = []
+
+    def fmt_row(fields):
+        line = ""
+        for f, pos in zip(fields, positions):
+            line = (line + str(f))[:pos - 1].ljust(pos)
+        return line.rstrip()
+
+    lines.append("_" * line_length)
+    lines.append(fmt_row(header))
+    lines.append("=" * line_length)
+    total_params = 0
+    order = symbol._topo()
+    arg_shapes = dict(shape or {})
+    for node in order:
+        if node._op == "__group__":
+            continue
+        nm, kind = _node_label(node)
+        if node._op is None:
+            oshape = arg_shapes.get(nm, "")
+            nparam = int(onp.prod(arg_shapes[nm])) if nm in arg_shapes else 0
+        else:
+            oshape = out_shapes.get(nm, "")
+            nparam = 0
+        total_params += nparam
+        prev = ",".join(i.name for i in node._inputs)
+        lines.append(fmt_row([f"{nm} ({kind})", oshape, nparam, prev]))
+        lines.append("-" * line_length)
+    lines.append(f"Total params: {total_params}")
+    lines.append("_" * line_length)
+    out = "\n".join(lines)
+    print(out)
+    return out
+
+
+class _Dot:
+    """Minimal graphviz.Digraph stand-in (source + save only)."""
+
+    def __init__(self, title):
+        self._title = title
+        self._lines = [f'digraph "{title}" {{']
+
+    def node(self, name, label, **attrs):
+        a = "".join(f' {k}="{v}"' for k, v in attrs.items())
+        self._lines.append(f'  "{name}" [label="{label}"{a}];')
+
+    def edge(self, a, b):
+        self._lines.append(f'  "{a}" -> "{b}";')
+
+    @property
+    def source(self):
+        return "\n".join(self._lines + ["}"])
+
+    def save(self, filename):
+        with open(filename, "w") as f:
+            f.write(self.source)
+        return filename
+
+    def render(self, *a, **k):  # noqa: ARG002
+        raise RuntimeError("graphviz binary not available; use .source/.save")
+
+
+_OP_COLOR = {"np.dot": "lightblue", "npx.fully_connected": "lightblue",
+             "npx.convolution": "royalblue1", "npx.relu": "salmon",
+             "npx.activation": "salmon", "npx.batch_norm": "orchid1",
+             "npx.pooling": "gold", "np.add": "palegreen"}
+
+
+def plot_network(symbol, title="plot", save_format="pdf", shape=None,  # noqa: ARG001
+                 dtype=None, node_attrs=None, hide_weights=True):  # noqa: ARG001
+    """DOT graph of a symbol (`visualization.py:210`)."""
+    try:
+        from graphviz import Digraph  # type: ignore
+
+        dot = Digraph(name=title)
+    except Exception:
+        dot = _Dot(title)
+    order = symbol._topo()
+    for node in order:
+        if node._op == "__group__":
+            continue
+        nm, kind = _node_label(node)
+        if node._op is None:
+            if hide_weights and any(
+                    nm == i.name for n in order for i in n._inputs) and \
+                    any(h in nm for h in ("weight", "bias", "gamma", "beta",
+                                          "moving", "running")):
+                continue
+            dot.node(nm, nm, shape="oval", fillcolor="#8dd3c7", style="filled")
+        else:
+            color = _OP_COLOR.get(node._op, "lightgrey")
+            dot.node(nm, f"{nm}\n{kind}", shape="box", fillcolor=color,
+                     style="filled")
+    drawn = {n._name for n in order
+             if not (n._op is None and hide_weights and any(
+                 h in n._name for h in ("weight", "bias", "gamma", "beta",
+                                        "moving", "running")))}
+    for node in order:
+        if node._op in (None, "__group__"):
+            continue
+        for inp in node._inputs:
+            if inp.name in drawn:
+                dot.edge(inp.name, node.name)
+    return dot

@@ -242,6 +242,86 @@ def test_infer_type_propagates_errors():
         sym.dot(a, b).infer_type()
 
 
+def test_backward_matches_forward_train_mode():
+    """backward() must differentiate the same (train/eval) graph as the
+    preceding forward."""
+    x = sym.Variable("x")
+    s = sym.dropout(x, 0.5).sum()
+    ex = s.bind(args={"x": NDArray(onp.ones((1000,), onp.float32))},
+                args_grad={"x": NDArray(onp.zeros((1000,), onp.float32))})
+    ex.forward(is_train=False)
+    ex.backward()
+    # eval-mode dropout is identity → grads are exactly 1
+    onp.testing.assert_array_equal(A(ex.grad_dict["x"]),
+                                   onp.ones((1000,), onp.float32))
+
+
+def test_aux_variable_alignment():
+    a = sym.Variable("a")
+    stat = sym.Variable("stat", aux=True)
+    w = sym.Variable("w")
+    s = sym.dot(a + stat, w)
+    assert s.list_arguments() == ["a", "w"]
+    assert s.list_auxiliary_states() == ["stat"]
+    arg_shapes, out_shapes, aux_shapes = s.infer_shape(
+        a=(2, 3), stat=(2, 3), w=(3, 4))
+    assert dict(zip(s.list_arguments(), arg_shapes)) == \
+        {"a": (2, 3), "w": (3, 4)}
+    assert aux_shapes == [(2, 3)]
+    assert out_shapes == [(2, 4)]
+    # executor binds aux but gives it no grad by default
+    ex = s.simple_bind(a=(2, 3), stat=(2, 3), w=(3, 4))
+    ex.forward(is_train=True)
+    ex.backward()
+    assert "stat" not in ex.grad_dict and "w" in ex.grad_dict
+
+
+def test_list_op_none_static_preserved():
+    a, b = sym.Variable("a"), sym.Variable("b")
+    s = sym.concatenate([a, b], None)
+    out = s.eval(a=onp.ones((2, 2), onp.float32),
+                 b=onp.zeros((2, 2), onp.float32))[0]
+    assert out.shape == (8,)  # axis=None flattens
+
+
+def test_fromjson_multihead_ignores_attr_scope():
+    a = sym.Variable("a")
+    js = sym.Group([a + 1.0, a * 2.0]).tojson()
+    with mx.AttrScope(ctx_group="dev9"):
+        g2 = sym.fromjson(js)
+    assert all("ctx_group" not in n._attrs for n in g2._topo())
+    outs = g2.eval(a=onp.ones((2,), onp.float32))
+    assert len(outs) == 2
+
+
+def test_positional_none_static_preserved():
+    a = sym.Variable("a")
+    s = sym.sum(a, None)  # numpy-style positional axis=None
+    out = s.eval(a=onp.ones((2, 3), onp.float32))[0]
+    assert float(A(out)) == 6.0
+    # survives a json roundtrip (SLOT sentinel vs literal None)
+    s2 = sym.fromjson(s.tojson())
+    assert float(A(s2.eval(a=onp.ones((2, 3), onp.float32))[0])) == 6.0
+
+
+def test_symbol_kwarg_rejected():
+    a, b = sym.Variable("a"), sym.Variable("b")
+    with pytest.raises(TypeError, match="positional"):
+        sym.dot(a, b=b)
+
+
+def test_bind_list_form_with_aux():
+    a = sym.Variable("a")
+    stat = sym.Variable("stat", aux=True)
+    w = sym.Variable("w")
+    s = sym.dot(a + stat, w)
+    ex = s.bind(args=[onp.ones((2, 3), onp.float32),
+                      onp.ones((3, 4), onp.float32)],
+                aux_states=[onp.zeros((2, 3), onp.float32)])
+    out = ex.forward()[0]
+    onp.testing.assert_allclose(A(out), onp.full((2, 4), 3.0))
+
+
 def test_eval_consistency_with_imperative():
     """Symbolic and imperative paths share the funnel — results identical."""
     from incubator_mxnet_tpu import np as mnp

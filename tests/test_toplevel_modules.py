@@ -1,0 +1,83 @@
+"""runtime / model / visualization / error / log module tests
+(reference models: tests/python/unittest/test_runtime.py, test_viz.py)."""
+import numpy as onp
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import sym
+
+
+def test_runtime_features():
+    feats = mx.runtime.Features()
+    assert feats.is_enabled("CPU")
+    assert "TPU" in feats
+    assert isinstance(mx.runtime.feature_list(), list)
+    with pytest.raises(RuntimeError):
+        feats.is_enabled("NO_SUCH_FEATURE")
+
+
+def test_runtime_features_singleton():
+    assert mx.runtime.Features() is mx.runtime.Features()
+
+
+def test_model_checkpoint_roundtrip(tmp_path):
+    a, w = sym.Variable("a"), sym.Variable("w")
+    net = sym.dot(a, w)
+    arg = {"w": onp.ones((3, 2), onp.float32)}
+    aux = {"stat": onp.zeros((2,), onp.float32)}
+    prefix = str(tmp_path / "model")
+    mx.model.save_checkpoint(prefix, 3, net, arg, aux)
+    s2, arg2, aux2 = mx.model.load_checkpoint(prefix, 3)
+    assert s2.list_arguments() == ["a", "w"]
+    onp.testing.assert_array_equal(arg2["w"].asnumpy(), arg["w"])
+    onp.testing.assert_array_equal(aux2["stat"].asnumpy(), aux["stat"])
+
+
+def test_print_summary():
+    a, w = sym.Variable("a"), sym.Variable("w")
+    out = sym.relu(sym.dot(a, w))
+    text = mx.visualization.print_summary(out, shape={"a": (2, 3), "w": (3, 4)})
+    assert "Total params: 18" in text
+    assert "relu" in text
+
+
+def test_print_summary_missing_shape_raises():
+    a, b = sym.Variable("a"), sym.Variable("b")
+    with pytest.raises(ValueError, match="missing shapes"):
+        mx.visualization.print_summary(a + b, shape={"a": (2,)})
+
+
+def test_plot_network_dot_source(tmp_path):
+    a = sym.Variable("data")
+    w = sym.Variable("fc_weight")
+    net = sym.relu(sym.dot(a, w))
+    dot = mx.visualization.plot_network(net)
+    src = dot.source
+    assert "digraph" in src and "data" in src
+    assert "fc_weight" not in src  # hide_weights
+    f = tmp_path / "net.dot"
+    dot.save(str(f))
+    assert f.exists()
+
+
+def test_error_types():
+    assert issubclass(mx.error.InternalError, mx.MXNetError)
+    with pytest.raises(mx.MXNetError):
+        raise mx.error.ValueError("bad")
+    with pytest.raises(ValueError):
+        raise mx.error.ValueError("also a builtin ValueError")
+
+    @mx.error.register_error("MyError")
+    class MyError(mx.MXNetError):
+        pass
+
+    assert mx.error._ERROR_REGISTRY["MyError"] is MyError
+
+
+def test_log_get_logger(tmp_path):
+    logger = mx.log.get_logger("mxtest", filename=str(tmp_path / "x.log"),
+                               level=mx.log.INFO)
+    logger.info("hello %d", 42)
+    assert logger is mx.log.get_logger("mxtest")
+    text = (tmp_path / "x.log").read_text()
+    assert "hello 42" in text
