@@ -185,6 +185,9 @@ _ELEMWISE_AND_FRIENDS = [
     "hsplit", "vsplit", "dsplit", "delete", "insert", "trim_zeros", "flat",
     "may_share_memory", "shares_memory", "result_type", "promote_types",
     "can_cast", "iscomplexobj", "isrealobj", "isscalar", "ndim", "shape", "size",
+    # window functions (reference: _npi_blackman/_npi_hamming/_npi_hanning)
+    "blackman", "hamming", "hanning", "bartlett", "kaiser",
+    "diag_indices_from",
 ]
 
 _g = globals()
@@ -216,6 +219,28 @@ def may_share_memory(a, b):  # noqa: ARG001 - jax buffers never alias views
 
 def shares_memory(a, b):  # noqa: ARG001
     return False
+
+
+def fill_diagonal(a, val, wrap=False):
+    """In-place diagonal fill (reference: `_npi_fill_diagonal`,
+    `src/operator/numpy/np_fill_diagonal_op.cc`) — mutates `a` via the
+    NDArray rebind discipline. `val` may be a scalar or an array (cycled,
+    numpy semantics)."""
+    # _snapshot() keeps the pre-mutation tape linkage so adopting the result
+    # doesn't create a self-referential node (same discipline as __setitem__)
+    src = a._snapshot()
+    if isinstance(val, NDArray):
+        out = apply_op_flat(
+            "fill_diagonal",
+            lambda x, v: _jnp().fill_diagonal(x, v, wrap=wrap, inplace=False),
+            (src, val))
+    else:
+        out = apply_op_flat(
+            "fill_diagonal",
+            lambda x: _jnp().fill_diagonal(x, val, wrap=wrap, inplace=False),
+            (src,))
+    a._adopt(out)
+    return None  # numpy semantics: in-place, returns None
 
 
 def bfloat16(x=None):

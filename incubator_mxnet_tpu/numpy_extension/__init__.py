@@ -46,6 +46,10 @@ from ._boxes import (  # noqa: F401
     batch_take, bipartite_matching, box_decode, box_encode, box_iou,
     box_nms, broadcast_like, roi_align, slice_like,
 )
+from ._spatial import (  # noqa: F401
+    bilinear_sampler, correlation, deformable_convolution, fft,
+    grid_generator, ifft, roi_pooling, spatial_transformer,
+)
 
 
 def __getattr__(name):
@@ -1278,6 +1282,32 @@ def cond(pred, then_func, else_func):
 # ---------------------------------------------------------------------------
 # misc module-level utilities
 # ---------------------------------------------------------------------------
+
+def boolean_mask(data, index, axis=0):
+    """Select rows where index != 0 (reference:
+    `src/operator/contrib/boolean_mask.cc` _contrib_boolean_mask — it has a
+    backward, so this must too).
+
+    Output shape is data-dependent → the mask is resolved eagerly (like the
+    reference's dynamic-shape NaiveRunGraph fallback, SURVEY §7 hard parts),
+    then the selection itself is a static gather through the funnel, so
+    gradients scatter back into the kept rows. Under jit use
+    `np.where`-style masking instead."""
+    import numpy as onp
+
+    from ..ndarray.ndarray import NDArray, apply_op_flat
+
+    m = index._data if isinstance(index, NDArray) else index
+    keep = onp.flatnonzero(onp.asarray(m))  # host sync: dynamic shape
+    data = data if isinstance(data, NDArray) else NDArray(data)
+
+    def fn(x):
+        import jax.numpy as jnp
+
+        return jnp.take(x, jnp.asarray(keep), axis=axis)
+
+    return apply_op_flat("boolean_mask", fn, (data,))
+
 
 def clip_global_norm(arrays, max_norm, check_isfinite=True):
     """Rescale arrays in-place so their global L2 norm ≤ max_norm

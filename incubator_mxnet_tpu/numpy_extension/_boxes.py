@@ -277,25 +277,12 @@ def roi_align(data, rois, pooled_size, spatial_scale=1.0, sample_ratio=2,
             gx = (x1 + (jnp.arange(pw)[:, None] + (jnp.arange(ns)[None, :]
                   + 0.5) / ns) * bin_w).reshape(-1)  # (pw*ns,)
             img = x[bidx]  # (C, H, W)
+            # shared bilinear gather (one sampler implementation for the
+            # whole roi/spatial family; border mode = ROI-op convention)
+            from ._spatial import _bilinear_nchw
 
-            def sample(yy, xx):
-                y0 = jnp.clip(jnp.floor(yy).astype("int32"), 0, h - 1)
-                x0 = jnp.clip(jnp.floor(xx).astype("int32"), 0, w - 1)
-                y1i = jnp.clip(y0 + 1, 0, h - 1)
-                x1i = jnp.clip(x0 + 1, 0, w - 1)
-                wy = jnp.clip(yy - y0, 0.0, 1.0)
-                wx = jnp.clip(xx - x0, 0.0, 1.0)
-                v = (img[:, y0][:, :, x0] * (1 - wy)[None, :, None]
-                     * (1 - wx)[None, None, :]
-                     + img[:, y0][:, :, x1i] * (1 - wy)[None, :, None]
-                     * wx[None, None, :]
-                     + img[:, y1i][:, :, x0] * wy[None, :, None]
-                     * (1 - wx)[None, None, :]
-                     + img[:, y1i][:, :, x1i] * wy[None, :, None]
-                     * wx[None, None, :])
-                return v  # (C, len(yy), len(xx))
-
-            v = sample(gy, gx)  # (C, ph*ns, pw*ns)
+            yy, xx = jnp.meshgrid(gy, gx, indexing="ij")
+            v = _bilinear_nchw(img, yy, xx, padding="border")
             v = v.reshape(c, ph, ns, pw, ns).mean(axis=(2, 4))
             return v
 

@@ -1,0 +1,314 @@
+"""Spatial-transform / optical-flow operator family (reference:
+`src/operator/spatial_transformer.cc`, `grid_generator.cc`,
+`bilinear_sampler.cc`, `roi_pooling.cc`, `correlation.cc`,
+`src/operator/contrib/deformable_convolution.cc`, `src/operator/contrib/fft/`).
+
+TPU-native: everything lowers to gathers + matmuls with static shapes —
+bilinear sampling is a 4-corner gather, deformable conv is im2col-at-offsets
+followed by one big MXU matmul, correlation is a displacement-stacked
+windowed reduction. All ops jit/grad cleanly through the funnel.
+"""
+from __future__ import annotations
+
+from ..ndarray.ndarray import apply_op_flat
+
+__all__ = ["grid_generator", "bilinear_sampler", "spatial_transformer",
+           "roi_pooling", "correlation", "deformable_convolution",
+           "fft", "ifft"]
+
+
+def _jnp():
+    import jax.numpy as jnp
+
+    return jnp
+
+
+def _bilinear_nchw(img, gy, gx, padding="zero"):
+    """Sample img (C, H, W) at float pixel coords gy/gx (...,) → (C, ...).
+
+    padding="zero": out-of-range corners contribute 0, matching the
+    reference sampler (`src/operator/bilinear_sampler-inl.h` accumulates
+    only corners inside [0, W-1]×[0, H-1]). padding="border": clamp to the
+    edge (the ROI-op convention)."""
+    jnp = _jnp()
+    c, h, w = img.shape
+    y0 = jnp.floor(gy)
+    x0 = jnp.floor(gx)
+    wy = gy - y0
+    wx = gx - x0
+
+    def at(yi, xi):
+        ci = jnp.clip(yi.astype("int32"), 0, h - 1)
+        cj = jnp.clip(xi.astype("int32"), 0, w - 1)
+        v = img[:, ci, cj]  # (C, ...)
+        if padding == "zero":
+            inside = ((yi >= 0) & (yi <= h - 1) & (xi >= 0)
+                      & (xi <= w - 1)).astype(img.dtype)
+            v = v * inside
+        return v
+
+    v00 = at(y0, x0)
+    v01 = at(y0, x0 + 1)
+    v10 = at(y0 + 1, x0)
+    v11 = at(y0 + 1, x0 + 1)
+    del c
+    return (v00 * (1 - wy) * (1 - wx) + v01 * (1 - wy) * wx
+            + v10 * wy * (1 - wx) + v11 * wy * wx)
+
+
+def grid_generator(data, transform_type="affine", target_shape=None):
+    """Generate a sampling grid (reference: `src/operator/grid_generator.cc`).
+
+    affine: data (N, 6) row-major 2×3 matrices → grid (N, 2, H, W) of
+    normalized [-1,1] (x, y) coords. warp: data (N, 2, H, W) pixel flow
+    added to the identity grid and normalized."""
+    if transform_type == "affine":
+        if target_shape is None:
+            raise ValueError("grid_generator(affine): target_shape required")
+        h, w = target_shape
+
+        def fn(theta):
+            jnp = _jnp()
+            n = theta.shape[0]
+            ys = jnp.linspace(-1.0, 1.0, h)
+            xs = jnp.linspace(-1.0, 1.0, w)
+            gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+            # elementwise affine (NOT a matmul): grid coordinates must stay
+            # full f32 — the TPU MXU's bf16 default would quantize them
+            t = theta.reshape(n, 6)[:, :, None, None]
+            ox = t[:, 0] * gx + t[:, 1] * gy + t[:, 2]
+            oy = t[:, 3] * gx + t[:, 4] * gy + t[:, 5]
+            return jnp.stack([ox, oy], 1)
+
+        return apply_op_flat("grid_generator", fn, (data,), {})
+
+    if transform_type == "warp":
+        def fn(flow):
+            jnp = _jnp()
+            n, _, h2, w2 = flow.shape
+            gy, gx = jnp.meshgrid(jnp.arange(h2, dtype=flow.dtype),
+                                  jnp.arange(w2, dtype=flow.dtype),
+                                  indexing="ij")
+            x = flow[:, 0] + gx
+            y = flow[:, 1] + gy
+            xn = x / max((w2 - 1) / 2.0, 1e-12) - 1.0
+            yn = y / max((h2 - 1) / 2.0, 1e-12) - 1.0
+            return jnp.stack([xn, yn], 1)
+
+        return apply_op_flat("grid_generator", fn, (data,), {})
+    raise ValueError(f"unknown transform_type {transform_type!r}")
+
+
+def bilinear_sampler(data, grid, cudnn_off=None):  # noqa: ARG001
+    """Sample data with a normalized grid (reference:
+    `src/operator/bilinear_sampler.cc`). data (N, C, H, W); grid
+    (N, 2, h, w) with channel 0 = x, 1 = y in [-1, 1]."""
+    def fn(x, g):
+        jnp = _jnp()
+        import jax
+
+        _, _, h, w = x.shape
+        gx = (g[:, 0] + 1.0) * (w - 1) / 2.0
+        gy = (g[:, 1] + 1.0) * (h - 1) / 2.0
+        return jax.vmap(_bilinear_nchw)(x, gy, gx)
+
+    return apply_op_flat("bilinear_sampler", fn, (data, grid), {})
+
+
+def spatial_transformer(data, loc, target_shape=None,
+                        transform_type="affine", sampler_type="bilinear",
+                        cudnn_off=None):  # noqa: ARG001
+    """Affine spatial transformer network head (reference:
+    `src/operator/spatial_transformer.cc`): grid_generator + sampler."""
+    if transform_type != "affine" or sampler_type != "bilinear":
+        raise ValueError("spatial_transformer supports affine/bilinear only")
+    if target_shape is None:
+        target_shape = data.shape[2:]
+    grid = grid_generator(loc, "affine", target_shape)
+    return bilinear_sampler(data, grid)
+
+
+def roi_pooling(data, rois, pooled_size, spatial_scale=1.0):
+    """Max ROI pooling (reference: `src/operator/roi_pooling.cc`).
+    data (N, C, H, W); rois (R, 5) [batch_idx, x1, y1, x2, y2].
+
+    Divergence from the reference: bins max over a fixed 2×2 bilinear
+    sample lattice per bin (static shapes for XLA) instead of the
+    data-dependent integer pixel partition; values agree for axis-aligned
+    integer ROIs and stay within one interpolation step otherwise."""
+    def fn(x, r):
+        jnp = _jnp()
+        import jax
+
+        ph, pw = (pooled_size if isinstance(pooled_size, (tuple, list))
+                  else (pooled_size, pooled_size))
+        ns = 2
+
+        def one_roi(roi):
+            bidx = roi[0].astype("int32")
+            x1, y1 = roi[1] * spatial_scale, roi[2] * spatial_scale
+            x2, y2 = roi[3] * spatial_scale, roi[4] * spatial_scale
+            rw = jnp.maximum(x2 - x1 + 1.0, 1.0)
+            rh = jnp.maximum(y2 - y1 + 1.0, 1.0)
+            gy = (y1 + (jnp.arange(ph)[:, None] + (jnp.arange(ns)[None, :]
+                  + 0.5) / ns) * (rh / ph)).reshape(-1)
+            gx = (x1 + (jnp.arange(pw)[:, None] + (jnp.arange(ns)[None, :]
+                  + 0.5) / ns) * (rw / pw)).reshape(-1)
+            yy, xx = jnp.meshgrid(gy, gx, indexing="ij")
+            samples = _bilinear_nchw(x[bidx], yy, xx,
+                                     padding="border")  # (C, ph*ns, pw*ns)
+            c = samples.shape[0]
+            samples = samples.reshape(c, ph, ns, pw, ns)
+            return samples.max(axis=(2, 4))
+
+        return jax.vmap(one_roi)(r)
+
+    return apply_op_flat("roi_pooling", fn, (data, rois), {})
+
+
+def correlation(data1, data2, kernel_size=1, max_displacement=1, stride1=1,
+                stride2=1, pad_size=0, is_multiply=True):
+    """FlowNet correlation layer (reference: `src/operator/correlation.cc`).
+    data1/data2 (N, C, H, W) → (N, D*D, H', W') where D = 2*(d//s2)+1.
+
+    Each displacement channel is mean over channels (and the k×k patch
+    window) of elementwise products (is_multiply) or |a−b| differences —
+    expressed as a shift + windowed average so the whole op is one fused
+    XLA program rather than a custom kernel."""
+    def fn(a, b):
+        jnp = _jnp()
+
+        _, _, h, w = a.shape
+        k = int(kernel_size)
+        d = int(max_displacement)
+        s1, s2, p = int(stride1), int(stride2), int(pad_size)
+        br = d // s2
+        disp = [(dy * s2, dx * s2) for dy in range(-br, br + 1)
+                for dx in range(-br, br + 1)]
+        ap = jnp.pad(a, ((0, 0), (0, 0), (p, p), (p, p)))
+        bp = jnp.pad(b, ((0, 0), (0, 0), (p, p), (p, p)))
+
+        def win_mean(x):
+            # k×k window mean via reduce_window
+            if k == 1:
+                return x
+            import jax.lax as lax
+
+            s = lax.reduce_window(x, 0.0, lax.add, (1, 1, k, k),
+                                  (1, 1, 1, 1), "SAME")
+            return s / float(k * k)
+
+        chans = []
+        for dy, dx in disp:
+            shifted = jnp.roll(bp, (-dy, -dx), axis=(2, 3))
+            prod = ap * shifted if is_multiply else jnp.abs(ap - shifted)
+            chans.append(win_mean(prod).mean(axis=1))  # (N, H+2p, W+2p)
+        out = jnp.stack(chans, axis=1)
+        # crop the displacement+kernel border (reference: border_size =
+        # max_displacement + kernel_radius; output = ceil((padded-2*border)
+        # / stride1)) — also guarantees the rolled reads never wrapped
+        kr = (k - 1) // 2
+        border = d + kr
+        ph_, pw_ = h + 2 * p, w + 2 * p
+        oh = max((ph_ - 2 * border + s1 - 1) // s1, 1)
+        ow = max((pw_ - 2 * border + s1 - 1) // s1, 1)
+        return out[:, :, border:border + oh * s1:s1,
+                   border:border + ow * s1:s1]
+
+    return apply_op_flat("correlation", fn, (data1, data2), {})
+
+
+def deformable_convolution(data, offset, weight, bias=None, kernel=(3, 3),
+                           stride=(1, 1), pad=(0, 0), dilate=(1, 1),
+                           num_filter=None, num_deformable_group=1,
+                           no_bias=False):
+    """Deformable convolution v1 (reference:
+    `src/operator/contrib/deformable_convolution.cc`).
+
+    data (N, C, H, W); offset (N, 2*G*kh*kw, OH, OW) with interleaved
+    (dy, dx) per kernel tap per deformable group G; weight
+    (F, C, kh, kw). Implemented as bilinear im2col at offset positions
+    followed by ONE (F, C*kh*kw) × (C*kh*kw, OH*OW) MXU matmul per image."""
+    def fn(x, off, wgt, *maybe_bias):
+        jnp = _jnp()
+        import jax
+
+        n, c, h, w = x.shape
+        f = wgt.shape[0]
+        # the weight tensor is authoritative for the tap geometry; `kernel`
+        # (and num_filter) are validation-only, like the reference's param
+        # struct cross-check
+        kh, kw = wgt.shape[2], wgt.shape[3]
+        if tuple(kernel) != (kh, kw):
+            raise ValueError(
+                f"deformable_convolution: kernel={tuple(kernel)} disagrees "
+                f"with weight shape {wgt.shape}")
+        if num_filter is not None and int(num_filter) != f:
+            raise ValueError(
+                f"deformable_convolution: num_filter={num_filter} disagrees "
+                f"with weight shape {wgt.shape}")
+        sh, sw = stride
+        ph, pw = pad
+        dh, dw = dilate
+        g = int(num_deformable_group)
+        oh = (h + 2 * ph - dh * (kh - 1) - 1) // sh + 1
+        ow = (w + 2 * pw - dw * (kw - 1) - 1) // sw + 1
+        cg = c // g
+
+        base_y = (jnp.arange(oh) * sh - ph)[:, None, None]  # (OH,1,1)
+        base_x = (jnp.arange(ow) * sw - pw)[None, :, None]  # (1,OW,1)
+        tap_y = (jnp.arange(kh) * dh)[None, None, :].repeat(kw, -1) \
+            .reshape(1, 1, kh * kw)
+        tap_x = jnp.tile(jnp.arange(kw) * dw, kh).reshape(1, 1, kh * kw)
+
+        def one(img, offs):
+            # offs (2*G*kh*kw, OH, OW) → (G, kh*kw, OH, OW, 2)
+            o = offs.reshape(g, kh * kw, 2, oh, ow)
+            dy = o[:, :, 0].transpose(0, 2, 3, 1)  # (G, OH, OW, K)
+            dx = o[:, :, 1].transpose(0, 2, 3, 1)
+            sy = base_y + tap_y + dy          # (G, OH, OW, K)
+            sx = base_x + tap_x + dx
+            cols = []
+            for gi in range(g):
+                grp = img[gi * cg:(gi + 1) * cg]  # (cg, H, W)
+                cols.append(_bilinear_nchw(grp, sy[gi], sx[gi],
+                                           padding="zero"))
+            col = jnp.concatenate(cols, 0)        # (C, OH, OW, K)
+            col = col.transpose(0, 3, 1, 2).reshape(c * kh * kw, oh * ow)
+            out = wgt.reshape(f, c * kh * kw) @ col
+            return out.reshape(f, oh, ow)
+
+        y = jax.vmap(one)(x, off)
+        if maybe_bias and not no_bias:
+            y = y + maybe_bias[0].reshape(1, f, 1, 1)
+        return y
+
+    args = (data, offset, weight) if bias is None or no_bias \
+        else (data, offset, weight, bias)
+    return apply_op_flat("deformable_convolution", fn, args, {})
+
+
+def fft(data, compute_size=None):  # noqa: ARG001
+    """FFT over the last axis, interleaved real/imag output (reference:
+    `src/operator/contrib/fft/fft.cc` — output last dim is 2×input)."""
+    def fn(x):
+        jnp = _jnp()
+        z = jnp.fft.fft(x.astype("float32"), axis=-1)
+        return jnp.stack([z.real, z.imag], axis=-1) \
+            .reshape(*x.shape[:-1], 2 * x.shape[-1]).astype(x.dtype)
+
+    return apply_op_flat("fft", fn, (data,), {})
+
+
+def ifft(data, compute_size=None):  # noqa: ARG001
+    """Inverse of `fft`'s interleaved layout (reference:
+    `src/operator/contrib/fft/ifft.cc` — returns the real part, scaled
+    by n like the reference's cuFFT (unnormalized) inverse)."""
+    def fn(x):
+        jnp = _jnp()
+        n = x.shape[-1] // 2
+        z = x.reshape(*x.shape[:-1], n, 2)
+        comp = z[..., 0] + 1j * z[..., 1]
+        return (jnp.fft.ifft(comp, axis=-1).real * n).astype(x.dtype)
+
+    return apply_op_flat("ifft", fn, (data,), {})

@@ -1,0 +1,243 @@
+"""Spatial-transform / flow / fft op family tests (reference model:
+tests/python/unittest/test_operator.py spatial transformer & correlation
+sections)."""
+import numpy as onp
+import pytest
+
+from incubator_mxnet_tpu import np as mnp
+from incubator_mxnet_tpu import numpy_extension as npx
+from incubator_mxnet_tpu import autograd
+from incubator_mxnet_tpu.ndarray.ndarray import NDArray
+
+
+def A(x):
+    return x.asnumpy() if hasattr(x, "asnumpy") else onp.asarray(x)
+
+
+def rand(*shape, seed=0):
+    return onp.random.RandomState(seed).randn(*shape).astype(onp.float32)
+
+
+def test_grid_generator_identity_affine():
+    theta = mnp.array(onp.tile(onp.array([1, 0, 0, 0, 1, 0], onp.float32),
+                               (2, 1)))
+    g = npx.grid_generator(theta, "affine", (5, 7))
+    assert g.shape == (2, 2, 5, 7)
+    onp.testing.assert_allclose(A(g)[0, 0, 0], onp.linspace(-1, 1, 7),
+                                rtol=1e-5, atol=1e-6)
+    onp.testing.assert_allclose(A(g)[0, 1, :, 0], onp.linspace(-1, 1, 5),
+                                rtol=1e-5, atol=1e-6)
+
+
+def test_grid_generator_warp_zero_flow_is_identity_grid():
+    flow = mnp.zeros((1, 2, 4, 4))
+    g = A(npx.grid_generator(flow, "warp"))
+    onp.testing.assert_allclose(g[0, 0, 0], onp.linspace(-1, 1, 4), rtol=1e-5,
+                                atol=1e-6)
+
+
+def test_bilinear_sampler_identity():
+    x = mnp.array(rand(2, 3, 8, 8))
+    theta = mnp.array(onp.tile(onp.array([1, 0, 0, 0, 1, 0], onp.float32),
+                               (2, 1)))
+    g = npx.grid_generator(theta, "affine", (8, 8))
+    y = npx.bilinear_sampler(x, g)
+    onp.testing.assert_allclose(A(y), A(x), rtol=1e-4, atol=1e-5)
+
+
+def test_bilinear_sampler_half_pixel_shift():
+    """A 0.5-pixel x-shift averages horizontal neighbors."""
+    x = onp.zeros((1, 1, 1, 4), onp.float32)
+    x[0, 0, 0] = [0.0, 2.0, 4.0, 6.0]
+    # grid: identity + shift of 0.5 px in x; w=4 → normalized shift = 1/3
+    gx = onp.linspace(-1, 1, 4) + (0.5 * 2 / 3)
+    g = onp.zeros((1, 2, 1, 4), onp.float32)
+    g[0, 0, 0] = gx
+    g[0, 1, 0] = 0.0
+    y = A(npx.bilinear_sampler(mnp.array(x), mnp.array(g)))
+    onp.testing.assert_allclose(y[0, 0, 0, :3], [1.0, 3.0, 5.0],
+                                rtol=1e-4, atol=1e-5)
+
+
+def test_spatial_transformer_downsample():
+    x = mnp.array(rand(1, 2, 8, 8))
+    theta = mnp.array(onp.array([[1, 0, 0, 0, 1, 0]], onp.float32))
+    y = npx.spatial_transformer(x, theta, (4, 4))
+    assert y.shape == (1, 2, 4, 4)
+
+
+def test_spatial_transformer_grad_flows():
+    x = NDArray(rand(1, 1, 6, 6))
+    theta = NDArray(onp.array([[1, 0, 0, 0, 1, 0]], onp.float32))
+    x.attach_grad()
+    theta.attach_grad()
+    with autograd.record():
+        out = npx.spatial_transformer(x, theta, (3, 3))
+        loss = out.sum()
+    loss.backward()
+    assert float(onp.abs(A(x.grad)).sum()) > 0
+    assert float(onp.abs(A(theta.grad)).sum()) > 0
+
+
+def test_roi_pooling_whole_image_is_global_max():
+    x = mnp.array(rand(1, 2, 8, 8))
+    rois = mnp.array(onp.array([[0, 0, 0, 7, 7]], onp.float32))
+    y = A(npx.roi_pooling(x, rois, (1, 1)))
+    # single 1x1 bin over the whole ROI ≈ global max (2x2 sample lattice
+    # divergence documented) — must be within one interpolation step
+    ref = A(x).max(axis=(2, 3))
+    assert y.shape == (1, 2, 1, 1)
+    assert (y.reshape(1, 2) <= ref + 1e-5).all()
+    assert (y.reshape(1, 2) >= ref - 2.0).all()
+
+
+def test_correlation_self_zero_displacement_is_mean_square():
+    x = rand(1, 4, 6, 6, seed=3)
+    out = A(npx.correlation(mnp.array(x), mnp.array(x), kernel_size=1,
+                            max_displacement=1, pad_size=1))
+    # D = 3 → 9 channels; center channel (index 4) = mean_c x*x
+    assert out.shape == (1, 9, 6, 6)
+    onp.testing.assert_allclose(out[0, 4], (x[0] ** 2).mean(0),
+                                rtol=1e-4, atol=1e-5)
+
+
+def test_correlation_shapes_reference_formula():
+    x = mnp.array(rand(2, 3, 8, 8))
+    out = npx.correlation(x, x, kernel_size=1, max_displacement=2,
+                          stride1=1, stride2=1, pad_size=2)
+    # padded 12, border 2 → 8×8 out, D=5 → 25 channels
+    assert out.shape == (2, 25, 8, 8)
+
+
+def test_deformable_conv_zero_offset_matches_conv():
+    x = rand(2, 3, 8, 8)
+    w = rand(4, 3, 3, 3, seed=1)
+    off = mnp.zeros((2, 2 * 9, 6, 6))
+    y = A(npx.deformable_convolution(mnp.array(x), off, mnp.array(w),
+                                     kernel=(3, 3), num_filter=4))
+    import jax.lax as lax
+    import jax.numpy as jnp
+
+    ref = onp.asarray(lax.conv_general_dilated(
+        jnp.asarray(x), jnp.asarray(w), (1, 1), [(0, 0), (0, 0)],
+        precision="highest"))
+    onp.testing.assert_allclose(y, ref, rtol=5e-2, atol=5e-2)
+
+
+def test_deformable_conv_integer_offset_shifts_input():
+    """Constant integer offset (dy=0, dx=1) equals conv over x shifted by 1."""
+    x = rand(1, 1, 8, 8, seed=5)
+    w = onp.ones((1, 1, 1, 1), onp.float32)
+    off = onp.zeros((1, 2, 8, 8), onp.float32)
+    off[0, 1] = 1.0  # dx = +1 for the single 1x1 tap
+    y = A(npx.deformable_convolution(mnp.array(x), mnp.array(off),
+                                     mnp.array(w), kernel=(1, 1),
+                                     num_filter=1))
+    onp.testing.assert_allclose(y[0, 0, :, :-1], x[0, 0, :, 1:],
+                                rtol=1e-4, atol=1e-4)
+
+
+def test_fft_matches_numpy():
+    x = rand(3, 16, seed=2)
+    out = A(npx.fft(mnp.array(x)))
+    z = onp.fft.fft(x, axis=-1)
+    inter = onp.stack([z.real, z.imag], -1).reshape(3, 32)
+    onp.testing.assert_allclose(out, inter, rtol=1e-3, atol=1e-3)
+
+
+def test_ifft_roundtrip_scaled_by_n():
+    x = rand(2, 8, seed=4)
+    r = A(npx.ifft(npx.fft(mnp.array(x))))
+    onp.testing.assert_allclose(r, x * 8, rtol=1e-3, atol=1e-3)
+
+
+def test_window_functions():
+    for name in ["blackman", "hamming", "hanning", "bartlett"]:
+        out = A(getattr(mnp, name)(12))
+        onp.testing.assert_allclose(out, getattr(onp, name)(12),
+                                    rtol=1e-5, atol=1e-6)
+
+
+def test_fill_diagonal_and_diag_indices_from():
+    a = mnp.zeros((4, 4))
+    mnp.fill_diagonal(a, 7.0)
+    onp.testing.assert_array_equal(A(a).diagonal(), onp.full(4, 7.0))
+    idx = mnp.diag_indices_from(a)
+    onp.testing.assert_array_equal(A(idx[0]), onp.arange(4))
+
+
+def test_bilinear_sampler_zero_pads_outside():
+    """Reference semantics: out-of-boundary samples contribute 0, not the
+    border value (`src/operator/bilinear_sampler-inl.h`)."""
+    x = mnp.ones((1, 1, 4, 4))
+    theta = mnp.array(onp.array([[2, 0, 0, 0, 2, 0]], onp.float32))  # zoom out
+    y = A(npx.spatial_transformer(x, theta, (4, 4)))
+    assert y[0, 0, 0, 0] == 0.0   # corner maps outside → zero
+    assert y[0, 0, 1, 1] > 0.0    # interior still sampled
+
+
+def test_boolean_mask_forward_and_grad():
+    d = NDArray(onp.arange(12, dtype=onp.float32).reshape(4, 3))
+    m = mnp.array(onp.array([1, 0, 1, 0], onp.float32))
+    d.attach_grad()
+    with autograd.record():
+        out = npx.boolean_mask(d, m)
+        loss = out.sum()
+    assert out.shape == (2, 3)
+    loss.backward()
+    g = A(d.grad)
+    onp.testing.assert_array_equal(g[0], onp.ones(3))
+    onp.testing.assert_array_equal(g[1], onp.zeros(3))
+    onp.testing.assert_array_equal(g[2], onp.ones(3))
+
+
+def test_fill_diagonal_grad_and_array_val():
+    a = NDArray(onp.ones((3, 3), onp.float32))
+    a.attach_grad()
+    with autograd.record():
+        mnp.fill_diagonal(a, 0.0)
+        loss = (a * a).sum()
+    loss.backward()
+    g = A(a.grad)
+    # diagonal was overwritten by a constant → zero grad there; 2*a elsewhere
+    onp.testing.assert_allclose(g, 2 * (1 - onp.eye(3)), rtol=1e-6)
+    b = mnp.zeros((3, 3))
+    mnp.fill_diagonal(b, mnp.array(onp.array([1., 2., 3.], onp.float32)))
+    onp.testing.assert_array_equal(A(b).diagonal(), [1., 2., 3.])
+
+
+def test_deformable_conv_kernel_mismatch_raises():
+    x = mnp.array(rand(1, 1, 6, 6))
+    w = mnp.array(rand(1, 1, 5, 5, seed=1))
+    off = mnp.zeros((1, 2 * 9, 4, 4))
+    with pytest.raises(ValueError, match="disagrees"):
+        npx.deformable_convolution(x, off, w, kernel=(3, 3), num_filter=1)
+
+
+def test_bilinear_sampler_grad_numeric():
+    """Finite-difference check on the sampler (reference discipline:
+    test_utils.check_numeric_gradient)."""
+    x0 = rand(1, 1, 5, 5, seed=7)
+    g0 = onp.zeros((1, 2, 3, 3), onp.float32)
+    g0[0, 0] = onp.linspace(-0.5, 0.5, 3)[None, :]
+    g0[0, 1] = onp.linspace(-0.5, 0.5, 3)[:, None]
+
+    def f(xv):
+        return float(A(npx.bilinear_sampler(mnp.array(xv),
+                                            mnp.array(g0)).sum()))
+
+    x = NDArray(x0)
+    x.attach_grad()
+    with autograd.record():
+        out = npx.bilinear_sampler(x, NDArray(g0)).sum()
+    out.backward()
+    eps = 1e-2
+    rs = onp.random.RandomState(0)
+    for _ in range(4):
+        i = tuple(rs.randint(0, s) for s in x0.shape)
+        xp = x0.copy()
+        xp[i] += eps
+        xm = x0.copy()
+        xm[i] -= eps
+        num = (f(xp) - f(xm)) / (2 * eps)
+        onp.testing.assert_allclose(A(x.grad)[i], num, rtol=1e-2, atol=1e-2)
