@@ -1,5 +1,32 @@
-"""contrib — quantization, and other extensions outside the core namespace
-(reference: `python/mxnet/contrib/`)."""
-from . import quantization
+"""contrib — quantization, text embeddings, tensorboard hook, and other
+extensions outside the core namespace (reference: `python/mxnet/contrib/`).
 
-__all__ = ["quantization"]
+`contrib.io` / `contrib.ndarray` / `contrib.symbol` in the reference are
+thin re-export shims over the main namespaces; here they resolve lazily to
+the same modules."""
+from . import quantization, tensorboard, text  # noqa: F401
+
+__all__ = ["quantization", "text", "tensorboard", "io", "ndarray", "symbol",
+           "onnx"]
+
+
+def __getattr__(name):
+    # shim modules (reference contrib/io.py, contrib/ndarray.py,
+    # contrib/symbol.py, contrib/onnx) — same objects as the main namespaces
+    if name == "io":
+        from .. import io as m
+
+        return m
+    if name == "ndarray":
+        from .. import ndarray as m
+
+        return m
+    if name == "symbol":
+        from .. import symbol as m
+
+        return m
+    if name == "onnx":
+        from .. import onnx as m
+
+        return m
+    raise AttributeError(f"module 'contrib' has no attribute {name!r}")

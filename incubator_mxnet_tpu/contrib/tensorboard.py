@@ -1,0 +1,68 @@
+"""TensorBoard metric logging hook (reference:
+`python/mxnet/contrib/tensorboard.py:24` LogMetricsCallback).
+
+Uses a `tensorboardX`/`torch.utils.tensorboard` SummaryWriter when one is
+importable; otherwise falls back to an append-only JSONL event file so
+training scripts keep working on minimal TPU hosts (the file converts
+trivially to TB events offline)."""
+from __future__ import annotations
+
+import json
+import os
+import time
+
+__all__ = ["LogMetricsCallback"]
+
+
+class _JsonlWriter:
+    def __init__(self, logging_dir):
+        os.makedirs(logging_dir, exist_ok=True)
+        self._f = open(os.path.join(logging_dir, "metrics.jsonl"), "a")
+
+    def add_scalar(self, tag, value, global_step=None):
+        self._f.write(json.dumps({"tag": tag, "value": float(value),
+                                  "step": global_step,
+                                  "ts": time.time()}) + "\n")
+        self._f.flush()
+
+    def close(self):
+        self._f.close()
+
+
+def _make_writer(logging_dir):
+    try:
+        from torch.utils.tensorboard import SummaryWriter  # type: ignore
+
+        return SummaryWriter(logging_dir)
+    except Exception:
+        return _JsonlWriter(logging_dir)
+
+
+class LogMetricsCallback:
+    """Batch-end callback pushing eval metrics to TensorBoard
+    (`tensorboard.py:24`)."""
+
+    def __init__(self, logging_dir, prefix=None):
+        self.prefix = prefix
+        self.step = 0
+        self.summary_writer = _make_writer(logging_dir)
+
+    def __call__(self, param):
+        """param: `BatchEndParam`-style object with `.eval_metric`."""
+        self.step += 1
+        if param.eval_metric is None:
+            return
+        for name, value in zip(*_name_value(param.eval_metric)):
+            if self.prefix is not None:
+                name = f"{self.prefix}-{name}"
+            self.summary_writer.add_scalar(name, value, self.step)
+
+
+def _name_value(metric):
+    names, values = [], []
+    got = metric.get()
+    pairs = zip(*got) if isinstance(got[0], (list, tuple)) else [got]
+    for name, value in pairs:
+        names.append(name)
+        values.append(value)
+    return names, values

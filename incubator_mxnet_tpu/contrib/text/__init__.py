@@ -1,0 +1,4 @@
+"""Text embeddings and vocabulary (reference:
+`python/mxnet/contrib/text/`)."""
+from . import embedding, utils, vocab  # noqa: F401
+from .vocab import Vocabulary  # noqa: F401

@@ -1,0 +1,222 @@
+"""Token embeddings (reference: `python/mxnet/contrib/text/embedding.py` —
+`_TokenEmbedding` over `Vocabulary`, GloVe/FastText loaders, custom and
+composite embeddings, registry with `register`/`create`).
+
+TPU-hosts run with zero egress, so the download path of the reference
+(`embedding.py:190 _get_pretrained_file`) becomes a local-file contract:
+`GloVe`/`FastText` read `pretrained_file_path` from disk (same text format:
+one token followed by elem_delim-separated floats per line) and raise a
+clear error when the file is absent instead of downloading."""
+from __future__ import annotations
+
+import io
+import os
+
+import numpy as onp
+
+from ...ndarray.ndarray import NDArray
+from . import vocab as _vocab
+
+__all__ = ["register", "create", "get_pretrained_file_names",
+           "TokenEmbedding", "GloVe", "FastText", "CustomEmbedding",
+           "CompositeEmbedding"]
+
+_REGISTRY: dict = {}
+
+
+def register(embedding_cls):
+    """Register an embedding class by lowercase name (`embedding.py:40`)."""
+    _REGISTRY[embedding_cls.__name__.lower()] = embedding_cls
+    return embedding_cls
+
+
+def create(embedding_name, **kwargs):
+    """Instantiate a registered embedding (`embedding.py:63`)."""
+    cls = _REGISTRY.get(embedding_name.lower())
+    if cls is None:
+        raise KeyError(f"unknown embedding {embedding_name!r}; "
+                       f"registered: {sorted(_REGISTRY)}")
+    return cls(**kwargs)
+
+
+def get_pretrained_file_names(embedding_name=None):
+    """Known pretrained file names (`embedding.py:90`). Local-file build:
+    returns the conventional names users should place on disk."""
+    table = {c: sorted(getattr(k, "pretrained_file_names", []))
+             for c, k in _REGISTRY.items()}
+    if embedding_name is not None:
+        return table[embedding_name.lower()]
+    return table
+
+
+class TokenEmbedding(_vocab.Vocabulary):
+    """Base embedding: vocabulary + idx_to_vec matrix
+    (`embedding.py:133 _TokenEmbedding`)."""
+
+    def __init__(self, init_unknown_vec=onp.zeros, **kwargs):
+        super().__init__(**kwargs)
+        self._init_unknown_vec = init_unknown_vec
+        self._vec_len = 0
+        self._idx_to_vec = None
+
+    @property
+    def vec_len(self):
+        return self._vec_len
+
+    @property
+    def idx_to_vec(self):
+        return self._idx_to_vec
+
+    def _load_embedding_file(self, path, elem_delim=" ", encoding="utf8"):
+        """Parse `token<delim>v1<delim>v2...` lines; first occurrence of a
+        token wins (`embedding.py:...` duplicate-skip behavior)."""
+        if not os.path.exists(path):
+            raise FileNotFoundError(
+                f"pretrained embedding file {path!r} not found. This build "
+                f"runs without network access: place the file locally "
+                f"(same text format as the reference) and pass its path.")
+        tok_vecs = {}
+        vec_len = None
+        with io.open(path, encoding=encoding) as f:
+            for line_num, line in enumerate(f):
+                parts = line.rstrip().split(elem_delim)
+                if len(parts) < 2:
+                    continue  # malformed line
+                if len(parts) == 2 and line_num == 0:
+                    try:  # fastText-style "count dim" header
+                        int(parts[0]), int(parts[1])
+                        continue
+                    except ValueError:
+                        pass
+                tok, vals = parts[0], parts[1:]
+                try:
+                    vec = onp.asarray([float(v) for v in vals],
+                                      dtype=onp.float32)
+                except ValueError:
+                    continue
+                if vec_len is None:
+                    vec_len = len(vec)
+                elif len(vec) != vec_len:
+                    raise ValueError(
+                        f"line {line_num}: vector length {len(vec)} != "
+                        f"{vec_len}")
+                tok_vecs.setdefault(tok, vec)
+        if vec_len is None:
+            raise ValueError(f"no vectors parsed from {path!r}")
+        self._vec_len = vec_len
+        return tok_vecs
+
+    def _build_vectors(self, tok_vecs, vocabulary=None):
+        if vocabulary is None:
+            # all file tokens become the index
+            for tok in tok_vecs:
+                if tok not in self._token_to_idx:
+                    self._token_to_idx[tok] = len(self._idx_to_token)
+                    self._idx_to_token.append(tok)
+        mat = onp.tile(
+            self._init_unknown_vec((self._vec_len,)).astype(onp.float32),
+            (len(self), 1))
+        for tok, vec in tok_vecs.items():
+            idx = self._token_to_idx.get(tok)
+            if idx is not None:
+                mat[idx] = vec
+        self._idx_to_vec = NDArray(mat)
+
+    def get_vecs_by_tokens(self, tokens, lower_case_backup=False):
+        """Embedding rows for token(s) (`embedding.py:316`)."""
+        single = isinstance(tokens, str)
+        toks = [tokens] if single else tokens
+        idx = []
+        for t in toks:
+            i = self._token_to_idx.get(t)
+            if i is None and lower_case_backup:
+                i = self._token_to_idx.get(t.lower())
+            idx.append(i if i is not None else _vocab.UNKNOWN_IDX)
+        rows = self._idx_to_vec.asnumpy()[onp.asarray(idx)]
+        out = NDArray(rows[0] if single else rows)
+        return out
+
+    def update_token_vectors(self, tokens, new_vectors):
+        """Overwrite rows for known tokens (`embedding.py:360`)."""
+        toks = [tokens] if isinstance(tokens, str) else tokens
+        vals = new_vectors.asnumpy() if isinstance(new_vectors, NDArray) \
+            else onp.asarray(new_vectors, dtype=onp.float32)
+        vals = vals.reshape(len(toks), self._vec_len)
+        mat = onp.array(self._idx_to_vec.asnumpy())  # writable copy
+        for t, v in zip(toks, vals):
+            i = self._token_to_idx.get(t)
+            if i is None:
+                raise ValueError(f"token {t!r} is unknown; only known "
+                                 f"tokens can be updated")
+            mat[i] = v
+        self._idx_to_vec = NDArray(mat)
+
+
+@register
+class GloVe(TokenEmbedding):
+    """GloVe text-format loader (`embedding.py:481`) — local file only."""
+
+    pretrained_file_names = ["glove.6B.50d.txt", "glove.6B.100d.txt",
+                             "glove.6B.200d.txt", "glove.6B.300d.txt",
+                             "glove.42B.300d.txt", "glove.840B.300d.txt"]
+
+    def __init__(self, pretrained_file_path, vocabulary=None, **kwargs):
+        super().__init__(**kwargs)
+        tok_vecs = self._load_embedding_file(pretrained_file_path, " ")
+        if vocabulary is not None:
+            self._adopt_vocab(vocabulary)
+        self._build_vectors(tok_vecs, vocabulary)
+
+    def _adopt_vocab(self, vocabulary):
+        self._idx_to_token = list(vocabulary.idx_to_token)
+        self._token_to_idx = dict(vocabulary.token_to_idx)
+        self._unknown_token = vocabulary.unknown_token
+        self._reserved_tokens = vocabulary.reserved_tokens
+
+
+@register
+class FastText(GloVe):
+    """FastText .vec text-format loader (`embedding.py:553`) — the format
+    is token + space-separated floats, identical parsing to GloVe text."""
+
+    pretrained_file_names = ["wiki.simple.vec", "wiki.en.vec"]
+
+
+@register
+class CustomEmbedding(TokenEmbedding):
+    """User-supplied embedding file with arbitrary delimiter
+    (`embedding.py:635`)."""
+
+    def __init__(self, pretrained_file_path, elem_delim=" ", encoding="utf8",
+                 vocabulary=None, **kwargs):
+        super().__init__(**kwargs)
+        tok_vecs = self._load_embedding_file(pretrained_file_path, elem_delim,
+                                             encoding)
+        if vocabulary is not None:
+            self._idx_to_token = list(vocabulary.idx_to_token)
+            self._token_to_idx = dict(vocabulary.token_to_idx)
+            self._unknown_token = vocabulary.unknown_token
+            self._reserved_tokens = vocabulary.reserved_tokens
+        self._build_vectors(tok_vecs, vocabulary)
+
+
+@register
+class CompositeEmbedding(TokenEmbedding):
+    """Concatenate several embeddings over one vocabulary
+    (`embedding.py:677`)."""
+
+    def __init__(self, vocabulary, token_embeddings, **kwargs):
+        super().__init__(**kwargs)
+        if not isinstance(token_embeddings, (list, tuple)):
+            token_embeddings = [token_embeddings]
+        self._idx_to_token = list(vocabulary.idx_to_token)
+        self._token_to_idx = dict(vocabulary.token_to_idx)
+        self._unknown_token = vocabulary.unknown_token
+        self._reserved_tokens = vocabulary.reserved_tokens
+        parts = []
+        for emb in token_embeddings:
+            rows = emb.get_vecs_by_tokens(self._idx_to_token).asnumpy()
+            parts.append(rows.reshape(len(self), emb.vec_len))
+        mat = onp.concatenate(parts, axis=1)
+        self._vec_len = mat.shape[1]
+        self._idx_to_vec = NDArray(mat)

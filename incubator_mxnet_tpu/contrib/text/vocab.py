@@ -1,0 +1,88 @@
+"""Text token indexing (reference: `python/mxnet/contrib/text/vocab.py:28`
+`Vocabulary` — unknown token at index 0, reserved tokens, frequency-ordered
+counter keys)."""
+from __future__ import annotations
+
+import collections
+
+__all__ = ["Vocabulary"]
+
+UNKNOWN_IDX = 0
+
+
+class Vocabulary:
+    """Frequency-ordered token index with an unknown slot at 0."""
+
+    def __init__(self, counter=None, most_freq_count=None, min_freq=1,
+                 unknown_token="<unk>", reserved_tokens=None):
+        if min_freq <= 0:
+            raise ValueError("`min_freq` must be positive")
+        if reserved_tokens is not None:
+            rset = set(reserved_tokens)
+            if unknown_token in rset:
+                raise ValueError("`reserved_tokens` cannot contain "
+                                 "`unknown_token`")
+            if len(rset) != len(reserved_tokens):
+                raise ValueError("`reserved_tokens` cannot contain duplicates")
+        self._unknown_token = unknown_token
+        self._idx_to_token = [unknown_token]
+        self._reserved_tokens = None if reserved_tokens is None \
+            else list(reserved_tokens)
+        if reserved_tokens is not None:
+            self._idx_to_token.extend(reserved_tokens)
+        self._token_to_idx = {t: i for i, t in enumerate(self._idx_to_token)}
+
+        if counter is not None:
+            if not isinstance(counter, collections.Counter):
+                raise TypeError("`counter` must be a collections.Counter")
+            skip = set(self._idx_to_token)
+            # frequency desc, then insertion order for ties (__cmp__ parity)
+            pairs = sorted(counter.items(), key=lambda kv: (-kv[1],))
+            budget = most_freq_count if most_freq_count is not None else \
+                len(pairs)
+            taken = 0
+            for tok, freq in pairs:
+                if freq < min_freq or taken >= budget:
+                    break
+                if tok in skip:
+                    continue
+                self._token_to_idx[tok] = len(self._idx_to_token)
+                self._idx_to_token.append(tok)
+                taken += 1
+
+    def __len__(self):
+        return len(self._idx_to_token)
+
+    @property
+    def token_to_idx(self):
+        return self._token_to_idx
+
+    @property
+    def idx_to_token(self):
+        return self._idx_to_token
+
+    @property
+    def unknown_token(self):
+        return self._unknown_token
+
+    @property
+    def reserved_tokens(self):
+        return self._reserved_tokens
+
+    def to_indices(self, tokens):
+        """Token(s) → index/indices; unknown maps to 0 (`vocab.py:163`)."""
+        single = isinstance(tokens, str)
+        toks = [tokens] if single else tokens
+        idx = [self._token_to_idx.get(t, UNKNOWN_IDX) for t in toks]
+        return idx[0] if single else idx
+
+    def to_tokens(self, indices):
+        """Index/indices → token(s) (`vocab.py:191`)."""
+        single = isinstance(indices, int)
+        idxs = [indices] if single else indices
+        toks = []
+        for i in idxs:
+            if not 0 <= i < len(self._idx_to_token):
+                raise ValueError(f"token index {i} out of range")
+            toks.append(self._idx_to_token[i])
+        return toks[0] if single else toks

@@ -1298,8 +1298,13 @@ def boolean_mask(data, index, axis=0):
     from ..ndarray.ndarray import NDArray, apply_op_flat
 
     m = index._data if isinstance(index, NDArray) else index
-    keep = onp.flatnonzero(onp.asarray(m))  # host sync: dynamic shape
+    m = onp.asarray(m)
     data = data if isinstance(data, NDArray) else NDArray(data)
+    if m.shape[0] != data.shape[axis]:
+        raise ValueError(
+            f"boolean_mask: mask length {m.shape[0]} != data.shape[{axis}] "
+            f"= {data.shape[axis]}")
+    keep = onp.flatnonzero(m)  # host sync: dynamic shape
 
     def fn(x):
         import jax.numpy as jnp

@@ -210,8 +210,13 @@ def correlation(data1, data2, kernel_size=1, max_displacement=1, stride1=1,
         kr = (k - 1) // 2
         border = d + kr
         ph_, pw_ = h + 2 * p, w + 2 * p
-        oh = max((ph_ - 2 * border + s1 - 1) // s1, 1)
-        ow = max((pw_ - 2 * border + s1 - 1) // s1, 1)
+        if ph_ - 2 * border < 1 or pw_ - 2 * border < 1:
+            raise ValueError(
+                f"correlation: input {h}x{w} with pad_size={p} is smaller "
+                f"than 2*(max_displacement+kernel_radius)={2 * border}; "
+                f"increase pad_size")
+        oh = (ph_ - 2 * border + s1 - 1) // s1
+        ow = (pw_ - 2 * border + s1 - 1) // s1
         return out[:, :, border:border + oh * s1:s1,
                    border:border + ow * s1:s1]
 

@@ -1,0 +1,130 @@
+"""contrib.text / contrib.tensorboard tests (reference model:
+tests/python/unittest/test_contrib_text.py)."""
+import collections
+import json
+
+import numpy as onp
+import pytest
+
+from incubator_mxnet_tpu import contrib
+
+
+def _write_emb(path, rows, delim=" "):
+    with open(path, "w") as f:
+        for tok, vec in rows:
+            f.write(tok + delim + delim.join(str(v) for v in vec) + "\n")
+
+
+def test_count_tokens_from_str():
+    c = contrib.text.utils.count_tokens_from_str("a b b\nc a A", to_lower=True)
+    assert c == collections.Counter({"a": 3, "b": 2, "c": 1})
+
+
+def test_vocabulary_ordering_and_limits():
+    counter = collections.Counter({"the": 10, "cat": 5, "sat": 5, "rare": 1})
+    v = contrib.text.Vocabulary(counter, most_freq_count=2, min_freq=2,
+                                reserved_tokens=["<pad>"])
+    assert v.idx_to_token[0] == "<unk>"
+    assert v.idx_to_token[1] == "<pad>"
+    assert len(v) == 4  # unk, pad + 2 most frequent
+    assert v.to_indices("the") == 2
+    assert v.to_indices("nope") == 0
+    assert v.to_tokens([0, 1]) == ["<unk>", "<pad>"]
+    with pytest.raises(ValueError):
+        v.to_tokens(99)
+
+
+def test_vocabulary_rejects_bad_reserved():
+    with pytest.raises(ValueError):
+        contrib.text.Vocabulary(unknown_token="<unk>",
+                                reserved_tokens=["<unk>"])
+    with pytest.raises(ValueError):
+        contrib.text.Vocabulary(reserved_tokens=["<pad>", "<pad>"])
+
+
+def test_custom_embedding_loads_file(tmp_path):
+    p = str(tmp_path / "emb.txt")
+    _write_emb(p, [("cat", [1.0, 2.0]), ("dog", [3.0, 4.0]),
+                   ("cat", [9.0, 9.0])])  # duplicate: first wins
+    emb = contrib.text.embedding.CustomEmbedding(p)
+    assert emb.vec_len == 2
+    onp.testing.assert_allclose(
+        emb.get_vecs_by_tokens("cat").asnumpy(), [1.0, 2.0])
+    onp.testing.assert_allclose(
+        emb.get_vecs_by_tokens(["dog", "unknown"]).asnumpy(),
+        [[3.0, 4.0], [0.0, 0.0]])
+
+
+def test_embedding_with_vocabulary(tmp_path):
+    p = str(tmp_path / "emb.txt")
+    _write_emb(p, [("cat", [1.0, 2.0]), ("dog", [3.0, 4.0])])
+    counter = collections.Counter({"cat": 3, "bird": 2})
+    voc = contrib.text.Vocabulary(counter)
+    emb = contrib.text.embedding.CustomEmbedding(p, vocabulary=voc)
+    assert len(emb) == len(voc)
+    onp.testing.assert_allclose(
+        emb.get_vecs_by_tokens("bird").asnumpy(), [0.0, 0.0])  # no vector
+    onp.testing.assert_allclose(
+        emb.get_vecs_by_tokens("cat").asnumpy(), [1.0, 2.0])
+
+
+def test_update_token_vectors(tmp_path):
+    p = str(tmp_path / "emb.txt")
+    _write_emb(p, [("cat", [1.0, 2.0])])
+    emb = contrib.text.embedding.CustomEmbedding(p)
+    emb.update_token_vectors("cat", onp.array([[5.0, 6.0]], onp.float32))
+    onp.testing.assert_allclose(
+        emb.get_vecs_by_tokens("cat").asnumpy(), [5.0, 6.0])
+    with pytest.raises(ValueError):
+        emb.update_token_vectors("nope", onp.zeros((1, 2), onp.float32))
+
+
+def test_composite_embedding(tmp_path):
+    p1, p2 = str(tmp_path / "a.txt"), str(tmp_path / "b.txt")
+    _write_emb(p1, [("cat", [1.0])])
+    _write_emb(p2, [("cat", [2.0, 3.0])])
+    voc = contrib.text.Vocabulary(collections.Counter({"cat": 1}))
+    comp = contrib.text.embedding.CompositeEmbedding(
+        voc, [contrib.text.embedding.CustomEmbedding(p1),
+              contrib.text.embedding.CustomEmbedding(p2)])
+    assert comp.vec_len == 3
+    onp.testing.assert_allclose(
+        comp.get_vecs_by_tokens("cat").asnumpy(), [1.0, 2.0, 3.0])
+
+
+def test_registry_create_and_missing_file():
+    with pytest.raises(FileNotFoundError, match="network"):
+        contrib.text.embedding.create("glove",
+                                      pretrained_file_path="/no/such/file")
+    with pytest.raises(KeyError):
+        contrib.text.embedding.create("nope")
+    assert "glove" in contrib.text.embedding.get_pretrained_file_names()
+
+
+def test_tensorboard_callback_jsonl(tmp_path):
+    import types
+
+    from incubator_mxnet_tpu import gluon
+
+    m = gluon.metric.Accuracy()
+    from incubator_mxnet_tpu import np as mnp
+
+    m.update(mnp.array([0, 1]), mnp.array([[0.9, 0.1], [0.1, 0.9]]))
+    cb = contrib.tensorboard.LogMetricsCallback(str(tmp_path / "tb"))
+    cb(types.SimpleNamespace(eval_metric=m))
+    if isinstance(cb.summary_writer, contrib.tensorboard._JsonlWriter):
+        events = [json.loads(line) for line in
+                  open(tmp_path / "tb" / "metrics.jsonl")]
+        assert events and events[0]["value"] == 1.0
+    else:  # real SummaryWriter available (torch tensorboard)
+        cb.summary_writer.close()
+        import os
+
+        assert any(f.startswith("events") for f in
+                   os.listdir(tmp_path / "tb"))
+
+
+def test_contrib_shim_modules():
+    assert contrib.io is not None
+    assert contrib.ndarray is not None
+    assert contrib.symbol is not None

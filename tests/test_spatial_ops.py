@@ -137,6 +137,19 @@ def test_deformable_conv_integer_offset_shifts_input():
                                 rtol=1e-4, atol=1e-4)
 
 
+def test_correlation_too_small_input_raises():
+    x = mnp.array(rand(1, 1, 2, 2))
+    with pytest.raises(ValueError, match="pad_size"):
+        npx.correlation(x, x, kernel_size=1, max_displacement=2, pad_size=0)
+
+
+def test_boolean_mask_length_mismatch_raises():
+    d = mnp.array(rand(4, 3))
+    with pytest.raises(ValueError, match="mask length"):
+        npx.boolean_mask(d, mnp.array(onp.array([1, 0, 0, 0, 0, 1],
+                                                onp.float32)))
+
+
 def test_fft_matches_numpy():
     x = rand(3, 16, seed=2)
     out = A(npx.fft(mnp.array(x)))

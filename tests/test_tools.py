@@ -1,0 +1,69 @@
+"""Tools tests: parse_log, bandwidth measure (reference model: the tools/
+utilities shipped alongside the framework)."""
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_parse_log(tmp_path):
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import parse_log
+    finally:
+        sys.path.pop(0)
+    log = tmp_path / "train.log"
+    log.write_text(
+        "INFO Epoch[0] Train-accuracy=0.91\n"
+        "INFO Epoch[0] Validation-accuracy=0.88\n"
+        "INFO Epoch[0] Time cost=12.3\n"
+        "INFO Epoch[1] Train-accuracy=0.95\n")
+    data = parse_log.parse(log.read_text().splitlines(), ["accuracy"])
+    assert data[0]["train-accuracy"] == 0.91
+    assert data[0]["val-accuracy"] == 0.88
+    assert data[0]["time"] == 12.3
+    assert data[1]["train-accuracy"] == 0.95
+    md = parse_log.to_markdown(data, ["accuracy"])
+    assert "| epoch |" in md and "0.91" in md
+
+
+def test_parse_log_metric_name_boundary(tmp_path):
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import parse_log
+    finally:
+        sys.path.pop(0)
+    data = parse_log.parse(["Epoch[0] Train-accuracy=0.70",
+                            "Epoch[0] Train-accuracy-top5=0.95"],
+                           ["accuracy"])
+    assert data[0]["train-accuracy"] == 0.70
+
+
+def test_parse_log_estimator_format(tmp_path):
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import parse_log
+    finally:
+        sys.path.pop(0)
+    lines = ["[Epoch 2] finished in 3.21s: train accuracy: 0.7712"]
+    data = parse_log.parse(lines, ["accuracy"])
+    assert data[2]["train-accuracy"] == 0.7712
+
+
+def test_bandwidth_measure_runs():
+    sys.path.insert(0, os.path.join(REPO, "tools", "bandwidth"))
+    try:
+        import measure
+    finally:
+        sys.path.pop(0)
+    bw = measure.measure(size_mb=1.0, repeat=2)
+    assert bw > 0
+
+
+def test_diagnose_runs():
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "diagnose.py")],
+        capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0
+    assert "Framework Info" in proc.stdout

@@ -1,0 +1,64 @@
+#!/usr/bin/env python
+"""Collective-bandwidth microbenchmark (reference role:
+`tools/bandwidth/measure.py` — measures kvstore push/pull GB/s across
+devices).
+
+TPU-native: measures allreduce (psum) bandwidth over the active mesh —
+ICI when multiple real chips exist, the virtual CPU mesh otherwise — and
+derives the usual algorithmic bandwidth 2*(n-1)/n * bytes / time.
+"""
+from __future__ import annotations
+
+import argparse
+import time
+
+
+def measure(size_mb: float = 64.0, repeat: int = 5, n_devices: int | None = None):
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    devs = jax.devices()
+    n = n_devices or len(devs)
+    devs = devs[:n]
+    if n < 2:
+        print(f"only {n} device(s); measuring on-chip reduction throughput")
+    elems = int(size_mb * 1e6 / 4)
+    mesh = Mesh(devs, ("dp",))
+    sharding = NamedSharding(mesh, P("dp"))
+    rep = NamedSharding(mesh, P())
+    x = jax.device_put(
+        jnp.ones((max(n, 1) * (elems // max(n, 1)),), jnp.float32), sharding)
+
+    @jax.jit
+    def allreduce(v):
+        # psum across the mesh via sharding constraint round-trip
+        return jax.lax.with_sharding_constraint(
+            v.reshape(n, -1).sum(axis=0), rep)
+
+    allreduce(x).block_until_ready()  # compile
+    times = []
+    for _ in range(repeat):
+        t0 = time.perf_counter()
+        allreduce(x).block_until_ready()
+        times.append(time.perf_counter() - t0)
+    t = min(times)
+    nbytes = x.nbytes
+    algbw = (2 * (n - 1) / max(n, 1)) * nbytes / t / 1e9 if n > 1 \
+        else nbytes / t / 1e9
+    print(f"devices={n} size={nbytes/1e6:.1f}MB time={t*1e3:.3f}ms "
+          f"algbw={algbw:.2f}GB/s")
+    return algbw
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--size-mb", type=float, default=64.0)
+    ap.add_argument("--repeat", type=int, default=5)
+    ap.add_argument("--num-devices", type=int, default=None)
+    args = ap.parse_args(argv)
+    return measure(args.size_mb, args.repeat, args.num_devices)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,83 @@
+#!/usr/bin/env python
+"""Environment diagnostics (reference role: `tools/diagnose.py` — dump
+platform, Python, package versions and hardware info for bug reports)."""
+from __future__ import annotations
+
+import os
+import platform
+import sys
+import time
+
+
+def check_python():
+    print("----------Python Info----------")
+    print("Version      :", platform.python_version())
+    print("Compiler     :", platform.python_compiler())
+    print("Build        :", platform.python_build())
+
+
+def check_pip():
+    print("------------Pip Info-----------")
+    try:
+        import pip
+
+        print("Version      :", pip.__version__)
+    except ImportError:
+        print("No corresponding pip install for current python.")
+
+
+def check_framework():
+    print("----------Framework Info----------")
+    t0 = time.time()
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    import incubator_mxnet_tpu as mx
+
+    print("Version      :", mx.__version__)
+    print("Import time  : %.3f s" % (time.time() - t0))
+    print("Directory    :", os.path.dirname(mx.__file__))
+    from incubator_mxnet_tpu import runtime
+
+    print("Features     :", runtime.Features())
+
+
+def check_hardware():
+    print("----------Hardware Info----------")
+    print("Machine      :", platform.machine())
+    print("CPU cores    :", os.cpu_count())
+    try:
+        import jax
+
+        for d in jax.devices():
+            print("Device       :", d.platform, d.device_kind, d.id)
+    except Exception as e:  # noqa: BLE001
+        print("jax devices unavailable:", e)
+
+
+def check_os():
+    print("----------System Info----------")
+    print("Platform     :", platform.platform())
+    print("system       :", platform.system())
+    print("release      :", platform.release())
+    print("version      :", platform.version())
+
+
+def check_environment():
+    print("----------Environment----------")
+    for k, v in sorted(os.environ.items()):
+        if k.startswith(("MXNET_", "JAX_", "XLA_", "TPU_", "LD_LIBRARY")):
+            print(f"{k}={v}")
+
+
+def main():
+    check_os()
+    check_hardware()
+    check_python()
+    check_pip()
+    check_framework()
+    check_environment()
+
+
+if __name__ == "__main__":
+    main()
+    sys.exit(0)

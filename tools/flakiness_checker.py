@@ -1,0 +1,42 @@
+#!/usr/bin/env python
+"""Flaky-test checker (reference role: `tools/flakiness_checker.py` — re-run
+a test many times with distinct seeds and report the failure rate)."""
+from __future__ import annotations
+
+import argparse
+import subprocess
+import sys
+
+
+def check(test: str, trials: int = 20, seed: int | None = None,
+          verbosity: str = "-q"):
+    failures = 0
+    for i in range(trials):
+        env_seed = str(seed if seed is not None else i)
+        proc = subprocess.run(
+            [sys.executable, "-m", "pytest", test, verbosity, "-x"],
+            env={**__import__("os").environ, "MXNET_TEST_SEED": env_seed},
+            capture_output=True, text=True)
+        if proc.returncode != 0:
+            failures += 1
+            print(f"trial {i} (seed {env_seed}): FAILED")
+            if failures == 1:
+                print(proc.stdout[-2000:])
+        else:
+            print(f"trial {i} (seed {env_seed}): passed")
+    print(f"\n{failures}/{trials} failures "
+          f"({100.0 * failures / trials:.1f}% flaky)")
+    return failures
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("test", help="pytest node id, e.g. tests/test_ops.py::test_x")
+    ap.add_argument("--trials", type=int, default=20)
+    ap.add_argument("--seed", type=int, default=None)
+    args = ap.parse_args(argv)
+    return 1 if check(args.test, args.trials, args.seed) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

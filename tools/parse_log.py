@@ -1,0 +1,79 @@
+#!/usr/bin/env python
+"""Parse training logs into a markdown table (reference role:
+`tools/parse_log.py` — extracts Epoch[N] Train-/Validation-metric=V and
+epoch time lines).
+
+Works on logs produced by `gluon.contrib.estimator` / `LoggingHandler`
+("[Epoch N] ... metric: value") as well as reference-style
+"Epoch[N] Train-accuracy=0.98" lines.
+"""
+from __future__ import annotations
+
+import argparse
+import re
+import sys
+
+
+def parse(lines, metric_names):
+    pats = []
+    for s in metric_names:
+        # exact metric-name boundary: "accuracy" must not match
+        # "accuracy-top5" (only [ =:] may follow the name)
+        pats += [
+            ("train-" + s, re.compile(
+                r".*Epoch\[(\d+)\] Train-" + s + r"\s*=([.\d]+)")),
+            ("val-" + s, re.compile(
+                r".*Epoch\[(\d+)\] Validation-" + s + r"\s*=([.\d]+)")),
+            ("train-" + s, re.compile(
+                r".*\[Epoch (\d+)\].*train " + s + r": ([.\d]+)")),
+            ("val-" + s, re.compile(
+                r".*\[Epoch (\d+)\].*validation " + s + r": ([.\d]+)")),
+        ]
+    pats.append(("time", re.compile(r".*Epoch\[(\d+)\] Time.*=([.\d]+)")))
+    pats.append(("time", re.compile(
+        r".*\[Epoch (\d+)\].*time: ([.\d]+)")))
+
+    data: dict[int, dict[str, float]] = {}
+    for line in lines:
+        for col, pat in pats:
+            m = pat.match(line)
+            if m is not None:
+                epoch, value = int(m.group(1)), float(m.group(2))
+                data.setdefault(epoch, {})[col] = value
+                break
+    return data
+
+
+def to_markdown(data, metric_names):
+    cols = []
+    for s in metric_names:
+        cols += ["train-" + s, "val-" + s]
+    cols.append("time")
+    lines = ["| epoch | " + " | ".join(cols) + " |",
+             "| --- |" + " --- |" * len(cols)]
+    for epoch in sorted(data):
+        row = [str(epoch)]
+        for c in cols:
+            v = data[epoch].get(c)
+            row.append("" if v is None else f"{v:.6g}")
+        lines.append("| " + " | ".join(row) + " |")
+    return "\n".join(lines)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description="Parse training log")
+    ap.add_argument("logfile", type=str)
+    ap.add_argument("--format", type=str, default="markdown",
+                    choices=["markdown", "none"])
+    ap.add_argument("--metric-names", type=str, nargs="+",
+                    default=["accuracy"])
+    args = ap.parse_args(argv)
+    with open(args.logfile) as f:
+        data = parse(f.readlines(), args.metric_names)
+    if args.format == "markdown":
+        print(to_markdown(data, args.metric_names))
+    return data
+
+
+if __name__ == "__main__":
+    sys.exit(0 if main() is not None else 1)
