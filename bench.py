@@ -3,7 +3,7 @@
 
 Primary metric (BASELINE.json north star): gluon model_zoo **ResNet-50-v1
 training images/sec/chip** — whole fwd+bwd+SGD step jit-compiled through
-the framework (DataParallel), batch 32 @ 224². BASELINE.md records no
+the framework (DataParallel), batch 128 @ 224². BASELINE.md records no
 in-tree reference table, so vs_baseline anchors on the widely-published
 MXNet ResNet-50-v1 fp32 V100 figure (~370 img/s, e.g. the reference's
 example/image-classification benchmark reports); >1 ⇒ one TPU chip beats
@@ -11,8 +11,8 @@ the reference's flagship GPU.
 
 extras:
 - bert_base_train_tokens_s / bert_mfu: gluon BERT-base (110M params,
-  pallas flash attention) fwd+bwd+Adam, batch 8 @ seq 128; MFU =
-  6·N·tokens/s over the chip's bf16 peak (v5e: 197 TFLOP/s).
+  pallas flash attention) fwd+bwd+Adam, batch 64 @ seq 128, funnel AMP
+  bf16; MFU = 6·N·tokens/s over the chip's bf16 peak (v5e: 197 TFLOP/s).
 - dot_framework_ms vs dot_rawjax_ms: (1024²)·(1024²) fp32 matmul through
   the NDArray funnel vs raw jitted jax — the gap is eager per-op dispatch
   overhead (reference opperf anchor: 0.215 ms on V100).
@@ -104,7 +104,7 @@ def bench_dispatch_floor(iters=100):
     return (time.perf_counter() - t0) / iters * 1000.0
 
 
-def bench_resnet50_train(batch=32, iters=20, warmup=2):
+def bench_resnet50_train(batch=128, iters=20, warmup=2):
     """images/sec: compiled train step (fwd+bwd+SGD) on gluon ResNet-50."""
     from incubator_mxnet_tpu import gluon, np, optimizer
     from incubator_mxnet_tpu.gluon.model_zoo.vision import resnet50_v1
@@ -131,9 +131,12 @@ def bench_resnet50_train(batch=32, iters=20, warmup=2):
     return batch / dt
 
 
-def bench_bert_train(batch=8, seq=128, iters=20, warmup=2):
-    """tokens/sec + MFU: compiled train step on gluon BERT-base (flash)."""
-    from incubator_mxnet_tpu import gluon, np, optimizer
+def bench_bert_train(batch=64, seq=128, iters=20, warmup=2):
+    """tokens/sec + MFU: compiled train step on gluon BERT-base (flash),
+    funnel-level AMP bf16 (activations bf16, fp32 master params) — the
+    measured sweet spot on one v5e chip (batch 8 fp32 → 18.7k tokens/s;
+    batch 64 bf16 → ~75k, MFU ~0.25)."""
+    from incubator_mxnet_tpu import amp, gluon, np, optimizer
     from incubator_mxnet_tpu.models.bert import bert_base
     from incubator_mxnet_tpu.parallel.sharded import DataParallel
 
@@ -151,14 +154,18 @@ def bench_bert_train(batch=8, seq=128, iters=20, warmup=2):
     tokens = np.array(rng.randint(0, vocab, (batch, seq)).astype("int32"))
     labels = np.array(rng.randint(0, vocab, (batch, seq)).astype("int32"))
     loss = None
-    for _ in range(warmup):
-        loss = dp.step(tokens, labels)
-    float(loss.asnumpy())  # true sync
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        loss = dp.step(tokens, labels)   # chained through the parameters
-    float(loss.asnumpy())
-    dt = (time.perf_counter() - t0) / iters
+    amp.init("bfloat16")
+    try:
+        for _ in range(warmup):
+            loss = dp.step(tokens, labels)
+        float(loss.asnumpy())  # true sync
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            loss = dp.step(tokens, labels)  # chained through the parameters
+        float(loss.asnumpy())
+        dt = (time.perf_counter() - t0) / iters
+    finally:
+        amp.deinit()  # AMP scope is local to this bench
     tokens_s = batch * seq / dt
     n_params = sum(onp.prod(p.shape)
                    for p in net.collect_params().values())

@@ -157,6 +157,7 @@ _ELEMWISE_AND_FRIENDS = [
     "diagonal", "diagflat", "tril", "triu", "vander",
     # comparisons / logic
     "equal", "not_equal", "greater", "greater_equal", "less", "less_equal",
+    "nextafter", "spacing",
     "logical_and", "logical_or", "logical_not", "logical_xor", "isnan", "isinf",
     "isfinite", "isposinf", "isneginf", "isclose", "array_equal", "allclose",
     # reductions

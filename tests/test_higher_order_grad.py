@@ -1,0 +1,103 @@
+"""Higher-order gradient tests (reference model:
+tests/python/unittest/test_higher_order_grad.py — record, take
+autograd.grad(..., create_graph=True), then backward the grad)."""
+import numpy as onp
+
+from incubator_mxnet_tpu import autograd
+from incubator_mxnet_tpu import np as mnp
+from incubator_mxnet_tpu.ndarray.ndarray import NDArray
+
+
+def A(x):
+    return x.asnumpy() if hasattr(x, "asnumpy") else onp.asarray(x)
+
+
+def second_order(fn, x0):
+    """d²/dx² of sum(fn(x)) via grad-of-grad, reference autograd.py:272
+    create_graph pattern."""
+    x = NDArray(x0)
+    x.attach_grad()
+    with autograd.record():
+        y = fn(x)
+        (dx,) = autograd.grad([y.sum()], [x], create_graph=True)
+        s = dx.sum()
+    s.backward()
+    return A(x.grad)
+
+
+def test_second_order_sin():
+    x0 = onp.linspace(-1.0, 1.0, 7).astype(onp.float32)
+    onp.testing.assert_allclose(second_order(mnp.sin, x0), -onp.sin(x0),
+                                rtol=1e-4, atol=1e-5)
+
+
+def test_second_order_polynomial():
+    x0 = onp.array([0.5, 1.0, 2.0], onp.float32)
+    onp.testing.assert_allclose(second_order(lambda x: x ** 3, x0),
+                                6 * x0, rtol=1e-4)
+
+
+def test_second_order_log():
+    x0 = onp.array([0.3, 0.7, 1.5], onp.float32)
+    onp.testing.assert_allclose(second_order(mnp.log, x0), -1.0 / x0 ** 2,
+                                rtol=1e-4)
+
+
+def test_second_order_exp():
+    x0 = onp.array([0.3, 0.7, 1.5], onp.float32)
+    onp.testing.assert_allclose(second_order(mnp.exp, x0), onp.exp(x0),
+                                rtol=1e-4)
+
+
+def test_second_order_sigmoid():
+    x0 = onp.array([-1.0, 0.0, 1.0], onp.float32)
+
+    def sigmoid(x):
+        return 1.0 / (1.0 + mnp.exp(-x))
+
+    s = 1.0 / (1.0 + onp.exp(-x0))
+    want = s * (1 - s) * (1 - 2 * s)
+    onp.testing.assert_allclose(second_order(sigmoid, x0), want,
+                                rtol=1e-3, atol=1e-5)
+
+
+def test_first_order_grad_values_with_create_graph():
+    x0 = onp.array([1.0, 2.0], onp.float32)
+    x = NDArray(x0)
+    x.attach_grad()
+    with autograd.record():
+        y = (x ** 4).sum()
+        (dx,) = autograd.grad([y], [x], create_graph=True)
+    onp.testing.assert_allclose(A(dx), 4 * x0 ** 3, rtol=1e-5)
+
+
+def test_grad_grad_matmul():
+    w0 = onp.array([[1.0, 2.0], [3.0, 4.0]], onp.float32)
+    w = NDArray(w0)
+    w.attach_grad()
+    with autograd.record():
+        y = mnp.dot(w, w).sum()
+        (dw,) = autograd.grad([y], [w], create_graph=True)
+        s = (dw * dw).sum()
+    s.backward()
+    # finite-difference check of d/dw sum(grad^2)
+    eps = 1e-3
+
+    def g_of(wv):
+        ww = NDArray(wv)
+        ww.attach_grad()
+        with autograd.record():
+            yy = mnp.dot(ww, ww).sum()
+            (d,) = autograd.grad([yy], [ww], create_graph=False)
+        return A(d)
+
+    num = onp.zeros_like(w0)
+    for i in range(2):
+        for j in range(2):
+            wp = w0.copy()
+            wp[i, j] += eps
+            wm = w0.copy()
+            wm[i, j] -= eps
+            num[i, j] = ((g_of(wp) ** 2).sum() - (g_of(wm) ** 2).sum()) \
+                / (2 * eps)
+    onp.testing.assert_allclose(A(w.grad), num, rtol=1e-2, atol=1e-2)

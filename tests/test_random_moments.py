@@ -1,0 +1,97 @@
+"""Random sampling statistical tests (reference model:
+tests/python/unittest/test_random.py — moment checks per distribution)."""
+import numpy as onp
+import pytest
+
+from incubator_mxnet_tpu import np as mnp
+from incubator_mxnet_tpu import random as mxrand
+
+
+def A(x):
+    return x.asnumpy() if hasattr(x, "asnumpy") else onp.asarray(x)
+
+
+N = 20000
+
+
+@pytest.fixture(autouse=True)
+def _seed():
+    mxrand.seed(7)
+
+
+def test_uniform_moments():
+    s = A(mnp.random.uniform(-2.0, 4.0, (N,)))
+    assert abs(s.mean() - 1.0) < 0.1
+    assert abs(s.var() - 36.0 / 12) < 0.2
+    assert s.min() >= -2.0 and s.max() < 4.0
+
+
+def test_normal_moments():
+    s = A(mnp.random.normal(3.0, 2.0, (N,)))
+    assert abs(s.mean() - 3.0) < 0.1
+    assert abs(s.std() - 2.0) < 0.1
+
+
+def test_gamma_moments():
+    s = A(mnp.random.gamma(4.0, 2.0, (N,)))
+    assert abs(s.mean() - 8.0) < 0.3          # k*theta
+    assert abs(s.var() - 16.0) < 2.0          # k*theta^2
+
+
+def test_exponential_moments():
+    s = A(mnp.random.exponential(2.0, (N,)))
+    assert abs(s.mean() - 2.0) < 0.1
+
+
+def test_poisson_moments():
+    s = A(mnp.random.poisson(5.0, (N,)))
+    assert abs(s.mean() - 5.0) < 0.15
+    assert abs(s.var() - 5.0) < 0.5
+
+
+def test_randint_range_and_uniformity():
+    s = A(mnp.random.randint(0, 10, (N,)))
+    assert s.min() == 0 and s.max() == 9
+    counts = onp.bincount(s.astype(onp.int64), minlength=10)
+    assert (abs(counts / N - 0.1) < 0.02).all()
+
+
+def test_bernoulli_mean():
+    s = A(mnp.random.bernoulli(0.3, size=(N,)))
+    assert abs(s.mean() - 0.3) < 0.02
+
+
+def test_multinomial_counts():
+    p = onp.array([0.2, 0.3, 0.5], onp.float32)
+    s = A(mnp.random.multinomial(N, p))
+    onp.testing.assert_allclose(s / N, p, atol=0.02)
+
+
+def test_shuffle_is_permutation():
+    x = mnp.array(onp.arange(100, dtype=onp.float32))
+    mnp.random.shuffle(x)
+    got = onp.sort(A(x))
+    onp.testing.assert_array_equal(got, onp.arange(100))
+
+
+def test_seed_reproducibility():
+    mxrand.seed(123)
+    a = A(mnp.random.normal(0, 1, (50,)))
+    mxrand.seed(123)
+    b = A(mnp.random.normal(0, 1, (50,)))
+    onp.testing.assert_array_equal(a, b)
+    c = A(mnp.random.normal(0, 1, (50,)))
+    assert not onp.array_equal(b, c)
+
+
+def test_beta_moments():
+    a, b = 2.0, 5.0
+    s = A(mnp.random.beta(a, b, (N,)))
+    assert abs(s.mean() - a / (a + b)) < 0.02
+    assert s.min() >= 0 and s.max() <= 1
+
+
+def test_laplace_moments():
+    s = A(mnp.random.laplace(1.0, 2.0, (N,)))
+    assert abs(s.mean() - 1.0) < 0.15
+    assert abs(s.var() - 8.0) < 1.0

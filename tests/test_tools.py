@@ -46,9 +46,24 @@ def test_parse_log_estimator_format(tmp_path):
         import parse_log
     finally:
         sys.path.pop(0)
-    lines = ["[Epoch 2] finished in 3.21s: train accuracy: 0.7712"]
+    # one LoggingHandler epoch_end line carries time + train + validation
+    lines = ["[Epoch 2] Finished in 3.211s, train accuracy: 0.7712, "
+             "validation accuracy: 0.7001"]
     data = parse_log.parse(lines, ["accuracy"])
     assert data[2]["train-accuracy"] == 0.7712
+    assert data[2]["val-accuracy"] == 0.7001
+    assert data[2]["time"] == 3.211
+
+
+def test_parse_log_escapes_metric_names():
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import parse_log
+    finally:
+        sys.path.pop(0)
+    # regex metachars in a metric name must not crash pattern building
+    data = parse_log.parse(["Epoch[0] Train-top_k(5)=0.9"], ["top_k(5)"])
+    assert data[0]["train-top_k(5)"] == 0.9
 
 
 def test_bandwidth_measure_runs():

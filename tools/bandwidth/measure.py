@@ -19,7 +19,7 @@ def measure(size_mb: float = 64.0, repeat: int = 5, n_devices: int | None = None
     from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
     devs = jax.devices()
-    n = n_devices or len(devs)
+    n = min(n_devices or len(devs), len(devs))
     devs = devs[:n]
     if n < 2:
         print(f"only {n} device(s); measuring on-chip reduction throughput")

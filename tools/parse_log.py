@@ -16,31 +16,34 @@ import sys
 
 def parse(lines, metric_names):
     pats = []
-    for s in metric_names:
+    for raw in metric_names:
+        s = re.escape(raw)  # user-supplied names may contain regex chars
         # exact metric-name boundary: "accuracy" must not match
         # "accuracy-top5" (only [ =:] may follow the name)
         pats += [
-            ("train-" + s, re.compile(
+            ("train-" + raw, re.compile(
                 r".*Epoch\[(\d+)\] Train-" + s + r"\s*=([.\d]+)")),
-            ("val-" + s, re.compile(
+            ("val-" + raw, re.compile(
                 r".*Epoch\[(\d+)\] Validation-" + s + r"\s*=([.\d]+)")),
-            ("train-" + s, re.compile(
+            ("train-" + raw, re.compile(
                 r".*\[Epoch (\d+)\].*train " + s + r": ([.\d]+)")),
-            ("val-" + s, re.compile(
+            ("val-" + raw, re.compile(
                 r".*\[Epoch (\d+)\].*validation " + s + r": ([.\d]+)")),
         ]
     pats.append(("time", re.compile(r".*Epoch\[(\d+)\] Time.*=([.\d]+)")))
+    # estimator LoggingHandler: "[Epoch N] Finished in 3.211s, ..."
     pats.append(("time", re.compile(
-        r".*\[Epoch (\d+)\].*time: ([.\d]+)")))
+        r".*\[Epoch (\d+)\] Finished in ([.\d]+)s")))
 
     data: dict[int, dict[str, float]] = {}
     for line in lines:
+        # one estimator line carries time + several metrics: every pattern
+        # gets a chance (no break)
         for col, pat in pats:
             m = pat.match(line)
             if m is not None:
                 epoch, value = int(m.group(1)), float(m.group(2))
                 data.setdefault(epoch, {})[col] = value
-                break
     return data
 
 
