@@ -105,6 +105,27 @@ def _load_and_bind(path):
             ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64)]
         lib.rtio_build_index.restype = ctypes.c_int64
         lib.rtio_build_index.argtypes = [ctypes.c_char_p, ctypes.c_char_p]
+        # threaded prefetch pipeline (src/rtio/pipeline.cc)
+        lib.rtio_pipeline_create.restype = ctypes.c_void_p
+        lib.rtio_pipeline_create.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_int64), ctypes.c_int64,
+            ctypes.c_int64, ctypes.c_int, ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_int]
+        lib.rtio_pipeline_num_batches.restype = ctypes.c_int64
+        lib.rtio_pipeline_num_batches.argtypes = [ctypes.c_void_p]
+        lib.rtio_pipeline_pop.restype = ctypes.c_void_p
+        lib.rtio_pipeline_pop.argtypes = [ctypes.c_void_p]
+        lib.rtio_pipeline_close.argtypes = [ctypes.c_void_p]
+        lib.rtio_batch_count.restype = ctypes.c_int64
+        lib.rtio_batch_count.argtypes = [ctypes.c_void_p]
+        lib.rtio_batch_total_bytes.restype = ctypes.c_int64
+        lib.rtio_batch_total_bytes.argtypes = [ctypes.c_void_p]
+        lib.rtio_batch_record.restype = ctypes.c_int
+        lib.rtio_batch_record.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64,
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
+            ctypes.POINTER(ctypes.c_int64)]
+        lib.rtio_batch_release.argtypes = [ctypes.c_void_p]
         return lib
     except (OSError, AttributeError):
         # unloadable, or a stale prebuilt .so missing a newer symbol
@@ -166,6 +187,68 @@ class NativeRecordFile:
         if getattr(self, "_h", None):
             self._lib.rtio_close(self._h)
             self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+class NativePrefetchPipeline:
+    """Threaded C++ prefetch pipeline over a NativeRecordFile (reference:
+    `src/io/iter_prefetcher.h` PrefetcherIter + `src/io/dataloader.cc`
+    ThreadedDataLoader). Worker threads batch records off the mmap into a
+    bounded queue; `__iter__` yields `list[bytes]` batches. The pipeline
+    must be closed (or exhausted) before the underlying file is closed."""
+
+    def __init__(self, rec_file: "NativeRecordFile", batch_size: int,
+                 indices=None, num_threads: int = 2, queue_cap: int = 4,
+                 shuffle_seed: int | None = None, drop_last: bool = True):
+        self._lib = rec_file._lib
+        self._file = rec_file  # keep alive: pipeline borrows its handle
+        idx_arr, n = None, 0
+        if indices is not None:
+            indices = list(indices)
+            n = len(indices)
+            idx_arr = (ctypes.c_int64 * n)(*indices)
+        self._p = self._lib.rtio_pipeline_create(
+            rec_file._h, idx_arr, n, int(batch_size), int(num_threads),
+            int(queue_cap),
+            -1 if shuffle_seed is None else int(shuffle_seed),
+            1 if drop_last else 0)
+        if not self._p:
+            raise RuntimeError("rtio_pipeline_create failed")
+
+    def __len__(self):
+        if not self._p:
+            return 0  # closed
+        return int(self._lib.rtio_pipeline_num_batches(self._p))
+
+    def __iter__(self):
+        while True:
+            if not self._p:
+                return
+            bp = self._lib.rtio_pipeline_pop(self._p)
+            if not bp:
+                return
+            try:
+                cnt = int(self._lib.rtio_batch_count(bp))
+                out = []
+                data = ctypes.POINTER(ctypes.c_uint8)()
+                ln = ctypes.c_int64()
+                for j in range(cnt):
+                    self._lib.rtio_batch_record(bp, j, ctypes.byref(data),
+                                                ctypes.byref(ln))
+                    out.append(ctypes.string_at(data, ln.value))
+            finally:
+                self._lib.rtio_batch_release(bp)
+            yield out
+
+    def close(self):
+        if getattr(self, "_p", None):
+            self._lib.rtio_pipeline_close(self._p)
+            self._p = None
 
     def __del__(self):
         try:

@@ -40,7 +40,62 @@ _LEGACY_TO_NPX = {
     "L2Normalization": "l2_normalization",
     "Cast": "cast",
     "cast": "cast",
+    # spatial / detection family (reference src/operator root + contrib)
+    "BilinearSampler": "bilinear_sampler",
+    "GridGenerator": "grid_generator",
+    "SpatialTransformer": "spatial_transformer",
+    "ROIPooling": "roi_pooling",
+    "Correlation": "correlation",
+    "ROIAlign": "roi_align",
+    "box_nms": "box_nms",
+    "box_iou": "box_iou",
+    "slice_like": "slice_like",
+    "broadcast_like": "broadcast_like",
+    "sequence_mask": "sequence_mask",
+    "erfinv": "erfinv",
 }
+
+# legacy names resolving to np-namespace ops under a different name
+_LEGACY_TO_NP = {
+    "Concat": "concatenate",
+    "concat": "concatenate",
+    "Reshape": "reshape",
+    "ElementWiseSum": "add_n",
+    "SwapAxis": "swapaxes",
+    "flip": "flip",
+    "sum_axis": "sum",
+    "max_axis": "max",
+    "min_axis": "min",
+    "broadcast_add": "add",
+    "broadcast_sub": "subtract",
+    "broadcast_mul": "multiply",
+    "broadcast_div": "true_divide",
+    "broadcast_maximum": "maximum",
+    "broadcast_minimum": "minimum",
+    "elemwise_add": "add",
+    "elemwise_sub": "subtract",
+    "elemwise_mul": "multiply",
+    "elemwise_div": "true_divide",
+}
+
+
+def add_n(*args):
+    """Sum of all inputs (reference: `src/operator/tensor/elemwise_sum.cc`)."""
+    from .. import numpy as _np
+
+    out = args[0]
+    for a in args[1:]:
+        out = _np.add(out, a)
+    return out
+
+
+def Flatten(data):  # noqa: N802
+    """Collapse all non-batch dims (reference `Flatten` semantics: output
+    is 2-D (batch, -1), NOT fully raveled)."""
+    return data.reshape((data.shape[0], -1))
+
+
+flatten = Flatten
 
 
 def __getattr__(name):
@@ -52,11 +107,24 @@ def __getattr__(name):
         from .. import numpy_extension as npx
 
         return getattr(npx, _LEGACY_TO_NPX[name])
+    if name in _LEGACY_TO_NP:
+        if _LEGACY_TO_NP[name] == "add_n":
+            return add_n
+        from .. import numpy as _np
+
+        return getattr(_np, _LEGACY_TO_NP[name])
     from .. import numpy as _np
 
     if hasattr(_np, name):
         return getattr(_np, name)
     raise AttributeError(f"module 'nd' has no attribute {name!r}")
+
+
+def __dir__():
+    from .. import numpy as _np
+
+    return sorted(set(globals()) | set(_LEGACY_TO_NPX) | set(_LEGACY_TO_NP)
+                  | {n for n in dir(_np) if not n.startswith("_")})
 
 
 def _save_entries(payload, key, d):

@@ -251,3 +251,54 @@ class IndexCreator:
 
     def close(self):
         self.reader.close()
+
+
+class MXRecordIOPrefetcher:
+    """Threaded native prefetch iterator over a .rec file (reference:
+    `src/io/iter_prefetcher.h` + `src/io/dataloader.cc` — C++ worker
+    threads batch raw records into a bounded queue ahead of the consumer).
+
+    Yields `list[bytes]` record payloads per batch; decode/augment on the
+    Python side (or feed `unpack`/`unpack_img`). Requires librtio (built on
+    demand); raises RuntimeError when the native runtime is unavailable.
+    """
+
+    def __init__(self, uri, batch_size, num_threads=2, queue_cap=4,
+                 shuffle=False, seed=0, drop_last=True, indices=None):
+        from ._native import NativePrefetchPipeline, NativeRecordFile
+
+        self._file = NativeRecordFile(uri)
+        self._pipe_args = dict(batch_size=batch_size,
+                               num_threads=num_threads, queue_cap=queue_cap,
+                               drop_last=drop_last, indices=indices)
+        self._shuffle = shuffle
+        self._seed = seed
+        self._epoch = 0
+        self._make = NativePrefetchPipeline
+        self._pipe = self._new_pipe()
+
+    def _new_pipe(self):
+        seed = (self._seed + self._epoch) if self._shuffle else None
+        return self._make(self._file, shuffle_seed=seed, **self._pipe_args)
+
+    def __len__(self):
+        return len(self._pipe)
+
+    def __iter__(self):
+        try:
+            yield from self._pipe
+        finally:
+            # epoch boundary — reached on full consumption AND on early
+            # break (GeneratorExit lands here): always start the next
+            # epoch fresh (reshuffled when shuffle=True)
+            self._pipe.close()
+            self._epoch += 1
+            self._pipe = self._new_pipe()
+
+    def close(self):
+        if getattr(self, "_pipe", None) is not None:
+            self._pipe.close()
+            self._pipe = None
+        if getattr(self, "_file", None) is not None:
+            self._file.close()
+            self._file = None

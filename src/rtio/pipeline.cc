@@ -1,0 +1,205 @@
+// Native threaded prefetch pipeline (reference: `src/io/iter_prefetcher.h`
+// PrefetcherIter + `src/io/dataloader.cc` ThreadedDataLoader). Worker
+// threads copy RecordIO batches out of the mmapped file into owned buffers
+// and push them onto a bounded queue; the consumer pops complete batches
+// without touching the GIL until the final memcpy into numpy.
+//
+// C ABI for ctypes (no pybind11 in this environment). Lifetime: a pipeline
+// borrows an rtio Handle (see rtio.cc) — close the pipeline BEFORE the
+// handle.
+#include <cstdint>
+#include <cstring>
+
+#include <algorithm>
+#include <atomic>
+#include <condition_variable>
+#include <mutex>
+#include <queue>
+#include <random>
+#include <thread>
+#include <vector>
+
+extern "C" {
+// from rtio.cc
+int64_t rtio_num_records(void* hp);
+int rtio_record(void* hp, int64_t i, const uint8_t** data, int64_t* len);
+}
+
+namespace {
+
+struct Batch {
+  int64_t seq = 0;                 // batch index (consumer reorders by it)
+  std::vector<uint8_t> data;       // concatenated payloads
+  std::vector<int64_t> offsets;    // per-record offset into data
+  std::vector<int64_t> lengths;    // per-record payload length
+};
+
+struct BatchSeqGreater {
+  bool operator()(const Batch* a, const Batch* b) const {
+    return a->seq > b->seq;  // min-heap on seq
+  }
+};
+
+struct Pipeline {
+  void* handle = nullptr;
+  std::vector<int64_t> order;      // record indices, epoch order
+  int64_t batch_size = 0;
+  int64_t n_batches = 0;
+  bool drop_last = true;
+
+  // min-heap by seq: consumer pops batches in production-index order even
+  // when workers finish out of order (the reference PrefetcherIter is
+  // order-preserving)
+  std::priority_queue<Batch*, std::vector<Batch*>, BatchSeqGreater> queue;
+  size_t queue_cap = 4;
+  std::mutex mu;
+  std::condition_variable cv_push, cv_pop;
+  std::atomic<int64_t> next_batch{0};   // producer batch dispenser
+  int64_t consumed = 0;                 // guarded by mu
+  std::atomic<bool> stop{false};
+  std::vector<std::thread> workers;
+};
+
+void worker_loop(Pipeline* p) {
+  for (;;) {
+    const int64_t b = p->next_batch.fetch_add(1);
+    if (b >= p->n_batches || p->stop.load()) return;
+    auto* batch = new Batch();
+    batch->seq = b;
+    const int64_t begin = b * p->batch_size;
+    const int64_t end = std::min<int64_t>(begin + p->batch_size,
+                                          p->order.size());
+    int64_t total = 0;
+    for (int64_t j = begin; j < end; ++j) {
+      const uint8_t* ptr;
+      int64_t len;
+      if (rtio_record(p->handle, p->order[j], &ptr, &len) != 0) continue;
+      total += len;
+    }
+    batch->data.reserve(total);
+    for (int64_t j = begin; j < end; ++j) {
+      const uint8_t* ptr;
+      int64_t len;
+      if (rtio_record(p->handle, p->order[j], &ptr, &len) != 0) continue;
+      batch->offsets.push_back(
+          static_cast<int64_t>(batch->data.size()));
+      batch->lengths.push_back(len);
+      batch->data.insert(batch->data.end(), ptr, ptr + len);
+    }
+    {
+      std::unique_lock<std::mutex> lk(p->mu);
+      // the head-of-sequence batch must ALWAYS be admitted, even with the
+      // queue at cap — otherwise cap out-of-order batches block the one
+      // batch the consumer is waiting for (deadlock)
+      p->cv_push.wait(lk, [p, batch] {
+        return p->queue.size() < p->queue_cap ||
+               batch->seq == p->consumed || p->stop.load();
+      });
+      if (p->stop.load()) {
+        delete batch;
+        return;
+      }
+      p->queue.push(batch);
+    }
+    p->cv_pop.notify_one();
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+// Create a pipeline over `handle`. indices==nullptr → all records in file
+// order; shuffle_seed >= 0 → epoch shuffle with that seed.
+void* rtio_pipeline_create(void* handle, const int64_t* indices, int64_t n,
+                           int64_t batch_size, int n_threads,
+                           int64_t queue_cap, int64_t shuffle_seed,
+                           int drop_last) {
+  if (!handle || batch_size <= 0) return nullptr;
+  auto* p = new Pipeline();
+  p->handle = handle;
+  p->batch_size = batch_size;
+  p->queue_cap = queue_cap > 0 ? static_cast<size_t>(queue_cap) : 4;
+  p->drop_last = drop_last != 0;
+  if (indices && n > 0) {
+    p->order.assign(indices, indices + n);
+  } else {
+    const int64_t total = rtio_num_records(handle);
+    p->order.resize(total);
+    for (int64_t i = 0; i < total; ++i) p->order[i] = i;
+  }
+  if (shuffle_seed >= 0) {
+    std::mt19937_64 rng(static_cast<uint64_t>(shuffle_seed));
+    std::shuffle(p->order.begin(), p->order.end(), rng);
+  }
+  const int64_t sz = static_cast<int64_t>(p->order.size());
+  p->n_batches = p->drop_last ? sz / batch_size
+                              : (sz + batch_size - 1) / batch_size;
+  const int nt = n_threads > 0 ? n_threads : 2;
+  for (int t = 0; t < nt; ++t) p->workers.emplace_back(worker_loop, p);
+  return p;
+}
+
+int64_t rtio_pipeline_num_batches(void* pp) {
+  return static_cast<Pipeline*>(pp)->n_batches;
+}
+
+// Blocking pop. Returns a Batch* or nullptr when the epoch is exhausted.
+// Every batch index is dispensed to exactly one worker, so exactly
+// n_batches batches reach the queue; the consumer counts them out.
+void* rtio_pipeline_pop(void* pp) {
+  auto* p = static_cast<Pipeline*>(pp);
+  std::unique_lock<std::mutex> lk(p->mu);
+  if (p->consumed >= p->n_batches) return nullptr;
+  // wait for the NEXT batch in sequence (heap top.seq == consumed); the
+  // +1 headroom on cap lets stragglers land while the head is missing
+  p->cv_pop.wait(lk, [p] {
+    return (!p->queue.empty() && p->queue.top()->seq == p->consumed) ||
+           p->stop.load();
+  });
+  if (p->queue.empty() || p->queue.top()->seq != p->consumed)
+    return nullptr;  // stopped
+  Batch* b = p->queue.top();
+  p->queue.pop();
+  p->consumed++;
+  // notify_all: the worker holding the NEW head batch may be any of them
+  p->cv_push.notify_all();
+  return b;
+}
+
+int64_t rtio_batch_count(void* bp) {
+  return static_cast<Batch*>(bp)->lengths.size();
+}
+
+int64_t rtio_batch_total_bytes(void* bp) {
+  return static_cast<Batch*>(bp)->data.size();
+}
+
+int rtio_batch_record(void* bp, int64_t j, const uint8_t** data,
+                      int64_t* len) {
+  auto* b = static_cast<Batch*>(bp);
+  if (j < 0 || j >= static_cast<int64_t>(b->lengths.size())) return -1;
+  *data = b->data.data() + b->offsets[j];
+  *len = b->lengths[j];
+  return 0;
+}
+
+void rtio_batch_release(void* bp) {
+  delete static_cast<Batch*>(bp);
+}
+
+void rtio_pipeline_close(void* pp) {
+  auto* p = static_cast<Pipeline*>(pp);
+  p->stop.store(true);
+  p->cv_push.notify_all();
+  p->cv_pop.notify_all();
+  for (auto& w : p->workers)
+    if (w.joinable()) w.join();
+  while (!p->queue.empty()) {
+    delete p->queue.top();
+    p->queue.pop();
+  }
+  delete p;
+}
+
+}  // extern "C"
