@@ -1,4 +1,4 @@
 """gluon.contrib (reference: `python/mxnet/gluon/contrib/__init__.py`)."""
-from . import estimator
+from . import data, estimator
 
-__all__ = ["estimator"]
+__all__ = ["estimator", "data"]

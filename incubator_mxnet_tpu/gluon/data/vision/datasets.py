@@ -161,3 +161,31 @@ class ImageFolderDataset(Dataset):
 
     def __len__(self):
         return len(self.items)
+
+
+class ImageRecordDataset(Dataset):
+    """Images packed in a RecordIO file by im2rec (reference:
+    `gluon/data/vision/datasets.py` ImageRecordDataset over
+    `RecordFileDataset` + `recordio.unpack_img`)."""
+
+    def __init__(self, filename, flag=1, transform=None):
+        from ..dataset import RecordFileDataset
+
+        self._record = RecordFileDataset(filename)
+        self._flag = flag
+        self._transform = transform
+
+    def __getitem__(self, idx):
+        from ....image import imdecode
+        from ....recordio import unpack
+
+        record = self._record[idx]
+        header, img_bytes = unpack(record)
+        img = imdecode(img_bytes, self._flag)
+        label = header.label
+        if self._transform is not None:
+            return self._transform(img, label)
+        return img, label
+
+    def __len__(self):
+        return len(self._record)

@@ -9,7 +9,8 @@ from ...block import Block, HybridBlock
 
 __all__ = ["Compose", "Cast", "ToTensor", "Normalize", "Resize", "CenterCrop",
            "RandomResizedCrop", "RandomFlipLeftRight", "RandomFlipTopBottom",
-           "RandomBrightness", "RandomContrast", "RandomSaturation", "CropResize"]
+           "RandomBrightness", "RandomContrast", "RandomSaturation",
+           "CropResize", "RandomCrop"]
 
 
 def _jnp():
@@ -214,3 +215,34 @@ class RandomSaturation(_RandomJitter):
             return v * alpha + gray * (1 - alpha)
 
         return apply_op("saturation", f, (x,))
+
+
+class RandomCrop(Block):
+    """Random spatial crop, padding when the image is smaller (reference:
+    `gluon/data/vision/transforms.py` RandomCrop)."""
+
+    def __init__(self, size, pad=None, interpolation=1):  # noqa: ARG002
+        super().__init__()
+        self._size = (size, size) if isinstance(size, int) else tuple(size)
+        self._pad = pad
+
+    def forward(self, x):
+        import random as pyrandom
+
+        w, h = self._size
+        if self._pad:
+            p = self._pad
+
+            def padf(v):
+                import jax.numpy as jnp
+
+                return jnp.pad(v, [(p, p), (p, p), (0, 0)])
+
+            x = apply_op("rc_pad", padf, (x,))
+        H, W = x.shape[-3], x.shape[-2]
+        if H < h or W < w:
+            return apply_op("rc_resize",
+                            lambda v: _resize_hwc(v, self._size), (x,))
+        y0 = pyrandom.randint(0, H - h)
+        x0 = pyrandom.randint(0, W - w)
+        return x[..., y0:y0 + h, x0:x0 + w, :]

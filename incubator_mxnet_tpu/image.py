@@ -36,7 +36,12 @@ def imdecode(buf, flag=1, to_rgb=True):  # noqa: ARG001
     if isinstance(buf, (bytes, bytearray)) and bytes(buf[:6]) == b"\x93NUMPY":
         import io as _io
 
-        return NDArray(onp.load(_io.BytesIO(bytes(buf))))
+        arr = onp.load(_io.BytesIO(bytes(buf)))
+        if flag == 0 and arr.ndim == 3 and arr.shape[2] >= 3:
+            # honor the grayscale flag on the .npy path too (ITU-R 601)
+            arr = (arr[..., 0] * 0.299 + arr[..., 1] * 0.587
+                   + arr[..., 2] * 0.114).astype(arr.dtype)[..., None]
+        return NDArray(arr)
     Image = _pil()
     if Image is None:
         raise RuntimeError("JPEG/PNG decode requires PIL, which is not "
@@ -52,6 +57,39 @@ def imdecode(buf, flag=1, to_rgb=True):  # noqa: ARG001
     if arr.ndim == 2:
         arr = arr[:, :, None]
     return NDArray(arr)
+
+
+def imencode(img, img_fmt=".jpg", quality=95):
+    """Encode an HWC uint8 image to JPEG/PNG bytes (reference role:
+    cv2.imencode in `python/mxnet/image/image.py`); falls back to the
+    `.npy` container when PIL is unavailable (imdecode reads both)."""
+    arr = img.asnumpy() if hasattr(img, "asnumpy") else onp.asarray(img)
+    arr = arr.astype(onp.uint8)
+    Image = _pil()
+    import io as _io
+
+    buf = _io.BytesIO()
+    if Image is None:
+        onp.save(buf, arr)
+        return buf.getvalue()
+    channels = arr.shape[2] if arr.ndim == 3 else 1
+    mode = {1: "L", 3: "RGB", 4: "RGBA"}.get(channels)
+    if mode is None:
+        raise ValueError(f"imencode: unsupported channel count {channels}")
+    pimg = Image.fromarray(arr.squeeze(-1) if (arr.ndim == 3 and mode == "L")
+                           else arr, mode)
+    fmt = {"jpg": "JPEG", "jpeg": "JPEG", "png": "PNG"}.get(
+        img_fmt.lstrip(".").lower())
+    if fmt is None:
+        raise ValueError(f"imencode: unsupported format {img_fmt!r} "
+                         f"(jpg/jpeg/png)")
+    if fmt == "JPEG" and mode == "RGBA":
+        pimg = pimg.convert("RGB")  # JPEG has no alpha
+    if fmt == "JPEG":
+        pimg.save(buf, format=fmt, quality=quality)
+    else:
+        pimg.save(buf, format=fmt)
+    return buf.getvalue()
 
 
 def imread(filename, flag=1, to_rgb=True):

@@ -44,7 +44,8 @@ __all__ = [
 
 from ._boxes import (  # noqa: F401
     batch_take, bipartite_matching, box_decode, box_encode, box_iou,
-    box_nms, broadcast_like, roi_align, slice_like,
+    box_nms, broadcast_like, multibox_detection, multibox_prior,
+    multibox_target, roi_align, slice_like,
 )
 from ._spatial import (  # noqa: F401
     bilinear_sampler, correlation, deformable_convolution, fft,

@@ -13,7 +13,8 @@ from ..ndarray.ndarray import apply_op_flat
 
 __all__ = ["box_iou", "box_nms", "box_encode", "box_decode",
            "bipartite_matching", "roi_align", "slice_like",
-           "broadcast_like", "batch_take"]
+           "broadcast_like", "batch_take", "multibox_prior",
+           "multibox_target", "multibox_detection"]
 
 
 def _jnp():
@@ -332,3 +333,184 @@ def batch_take(a, indices):
             x, idx[..., None].astype("int32"), axis=-1)[..., 0]
 
     return apply_op_flat("batch_take", fn, (a, indices), {})
+
+
+def multibox_prior(data, sizes=(1.0,), ratios=(1.0,), clip=False,
+                   steps=(-1.0, -1.0), offsets=(0.5, 0.5)):
+    """SSD anchor generation (reference:
+    `src/operator/contrib/multibox_prior.cc:30` MultiBoxPriorForward).
+
+    data: (N, C, H, W) feature map (only H/W used). Output (1, H*W*A, 4)
+    corner boxes in [0,1] coords, A = len(sizes) + len(ratios) - 1, laid
+    out exactly like the reference: per cell, all sizes at ratios[0],
+    then ratios[1:] at sizes[0]."""
+    sizes = [float(s) for s in (sizes if isinstance(sizes, (list, tuple))
+                                else [sizes])]
+    ratios = [float(r) for r in (ratios if isinstance(ratios, (list, tuple))
+                                 else [ratios])]
+
+    def fn(x):
+        jnp = _jnp()
+        h, w = x.shape[2], x.shape[3]
+        step_y = steps[0] if steps[0] > 0 else 1.0 / h
+        step_x = steps[1] if steps[1] > 0 else 1.0 / w
+        cy = (jnp.arange(h, dtype=jnp.float32) + offsets[0]) * step_y
+        cx = (jnp.arange(w, dtype=jnp.float32) + offsets[1]) * step_x
+        cyg, cxg = jnp.meshgrid(cy, cx, indexing="ij")  # (H, W)
+        # per-anchor half extents (reference: w = size*H/W*sqrt(r)/2,
+        # h = size/sqrt(r)/2)
+        hw, hh = [], []
+        r0 = (ratios[0] if ratios else 1.0) ** 0.5
+        for s in sizes:
+            hw.append(s * h / w * r0 / 2.0)
+            hh.append(s / r0 / 2.0)
+        for r in ratios[1:]:
+            rs = r ** 0.5
+            hw.append(sizes[0] * h / w * rs / 2.0)
+            hh.append(sizes[0] / rs / 2.0)
+        hw = jnp.asarray(hw, jnp.float32)   # (A,)
+        hh = jnp.asarray(hh, jnp.float32)
+        xmin = cxg[..., None] - hw
+        ymin = cyg[..., None] - hh
+        xmax = cxg[..., None] + hw
+        ymax = cyg[..., None] + hh
+        out = jnp.stack([xmin, ymin, xmax, ymax], -1).reshape(1, -1, 4)
+        if clip:
+            out = jnp.clip(out, 0.0, 1.0)
+        return out
+
+    return apply_op_flat("multibox_prior", fn, (data,), {})
+
+
+def multibox_target(anchor, label, cls_pred, overlap_threshold=0.5,
+                    ignore_label=-1.0, negative_mining_ratio=-1.0,
+                    negative_mining_thresh=0.5,
+                    minimum_negative_samples=0,
+                    variances=(0.1, 0.1, 0.2, 0.2)):  # noqa: ARG001
+    """SSD training target assignment (reference:
+    `src/operator/contrib/multibox_target.cc`).
+
+    anchor (1, N, 4) corners; label (B, M, 5) rows [cls, x1, y1, x2, y2]
+    with cls = -1 padding; cls_pred (B, num_cls+1, N) (used for shape/
+    negative-mining parity only — hard mining here is IoU-based:
+    anchors with best IoU < negative_mining_thresh stay background).
+    Returns (loc_target (B, N*4), loc_mask (B, N*4), cls_target (B, N))
+    where cls_target is gt_class+1 (0 = background), matching the
+    reference's label convention."""
+    def fn(anc, lab, _pred):
+        jnp = _jnp()
+        a = anc.reshape(-1, 4)
+        n = a.shape[0]
+        var = jnp.asarray(variances, jnp.float32)
+
+        def one(gt):
+            cls = gt[:, 0]
+            boxes = gt[:, 1:5]
+            valid = cls >= 0  # (M,)
+            iou = _iou_corner(a, boxes)  # (N, M)
+            iou = jnp.where(valid[None, :], iou, -1.0)
+            best_gt = jnp.argmax(iou, axis=1)          # (N,)
+            best_iou = jnp.take_along_axis(iou, best_gt[:, None],
+                                           1)[:, 0]   # (N,)
+            matched = best_iou >= overlap_threshold
+            # force-match: each VALID gt claims its best anchor. Padding
+            # rows (cls=-1) are routed to a dummy slot n so their scatter
+            # can neither claim an anchor nor clobber a valid gt's claim.
+            best_anchor = jnp.argmax(iou, axis=0)       # (M,)
+            scatter_idx = jnp.where(valid, best_anchor, n)
+            forced = jnp.zeros((n + 1,), bool).at[scatter_idx].set(True)[:n]
+            forced_gt = jnp.zeros((n + 1,), jnp.int32).at[scatter_idx].set(
+                jnp.arange(gt.shape[0], dtype=jnp.int32))[:n]
+            gt_idx = jnp.where(forced, forced_gt, best_gt)
+            matched = matched | forced
+            mb = boxes[gt_idx]                          # (N, 4)
+            # encode center-size offsets (reference TransformLocations)
+            aw = a[:, 2] - a[:, 0]
+            ah = a[:, 3] - a[:, 1]
+            acx = (a[:, 0] + a[:, 2]) / 2
+            acy = (a[:, 1] + a[:, 3]) / 2
+            gw = jnp.maximum(mb[:, 2] - mb[:, 0], 1e-12)
+            gh = jnp.maximum(mb[:, 3] - mb[:, 1], 1e-12)
+            gcx = (mb[:, 0] + mb[:, 2]) / 2
+            gcy = (mb[:, 1] + mb[:, 3]) / 2
+            t = jnp.stack([(gcx - acx) / aw / var[0],
+                           (gcy - acy) / ah / var[1],
+                           jnp.log(gw / aw) / var[2],
+                           jnp.log(gh / ah) / var[3]], -1)  # (N, 4)
+            loc_t = jnp.where(matched[:, None], t, 0.0).reshape(-1)
+            loc_m = jnp.where(matched[:, None],
+                              jnp.ones((n, 4), jnp.float32),
+                              0.0).reshape(-1)
+            cls_t = jnp.where(matched, cls[gt_idx] + 1.0, 0.0)
+            return loc_t, loc_m, cls_t
+
+        import jax
+
+        loc_t, loc_m, cls_t = jax.vmap(one)(lab)
+        return loc_t, loc_m, cls_t
+
+    return apply_op_flat("multibox_target", fn, (anchor, label, cls_pred),
+                         {}, n_outputs=3)
+
+
+def multibox_detection(cls_prob, loc_pred, anchor, clip=True,
+                       threshold=0.01, background_id=0, nms_threshold=0.5,
+                       force_suppress=False,
+                       variances=(0.1, 0.1, 0.2, 0.2), nms_topk=-1):
+    """SSD detection decode + per-class NMS (reference:
+    `src/operator/contrib/multibox_detection.cc`).
+
+    cls_prob (B, num_cls+1, N) softmax class scores (bg at background_id);
+    loc_pred (B, N*4); anchor (1, N, 4). Output (B, N, 6) rows
+    [cls_id, score, x1, y1, x2, y2], suppressed rows -1 (reference
+    convention)."""
+    def fn(cp, lp, anc):
+        jnp = _jnp()
+        a = anc.reshape(-1, 4)
+        n = a.shape[0]
+        var = jnp.asarray(variances, jnp.float32)
+
+        def one(scores, loc):
+            loc = loc.reshape(n, 4)
+            aw = a[:, 2] - a[:, 0]
+            ah = a[:, 3] - a[:, 1]
+            acx = (a[:, 0] + a[:, 2]) / 2
+            acy = (a[:, 1] + a[:, 3]) / 2
+            cx = loc[:, 0] * var[0] * aw + acx
+            cy = loc[:, 1] * var[1] * ah + acy
+            wdt = jnp.exp(loc[:, 2] * var[2]) * aw
+            hgt = jnp.exp(loc[:, 3] * var[3]) * ah
+            boxes = jnp.stack([cx - wdt / 2, cy - hgt / 2,
+                               cx + wdt / 2, cy + hgt / 2], -1)
+            if clip:
+                boxes = jnp.clip(boxes, 0.0, 1.0)
+            # winning non-background class: mask out the background row
+            masked = scores.at[background_id].set(-1.0)
+            cls_id = jnp.argmax(masked, axis=0)             # (N,)
+            score = jnp.take_along_axis(masked, cls_id[None, :],
+                                        0)[0]
+            # reference id convention: background excluded from the output
+            # id space (multibox_detection.cc: id = argmax shifted past bg)
+            out_id = (cls_id - (cls_id > background_id).astype(cls_id.dtype)
+                      ).astype(jnp.float32)
+            keep = score > threshold
+            rows = jnp.concatenate(
+                [jnp.where(keep, out_id, -1.0)[:, None],
+                 jnp.where(keep, score, -1.0)[:, None], boxes], -1)
+            return rows
+
+        import jax
+
+        rows = jax.vmap(one)(cp, lp)
+        from . import box_nms
+
+        from ..ndarray.ndarray import NDArray
+
+        out = box_nms(NDArray(rows), overlap_thresh=nms_threshold,
+                      valid_thresh=threshold, topk=nms_topk, coord_start=2,
+                      score_index=1, id_index=0, background_id=-1,
+                      force_suppress=force_suppress)
+        return out._data
+
+    return apply_op_flat("multibox_detection", fn, (cls_prob, loc_pred,
+                                                    anchor), {})

@@ -275,25 +275,41 @@ class MXRecordIOPrefetcher:
         self._seed = seed
         self._epoch = 0
         self._make = NativePrefetchPipeline
-        self._pipe = self._new_pipe()
+        self._pipe = self._new_pipe()  # eagerly warm the first epoch
 
     def _new_pipe(self):
         seed = (self._seed + self._epoch) if self._shuffle else None
         return self._make(self._file, shuffle_seed=seed, **self._pipe_args)
 
     def __len__(self):
-        return len(self._pipe)
+        if self._pipe is not None:
+            return len(self._pipe)
+        if self._file is None:
+            return 0  # closed
+        n = len(self._file)
+        bs = self._pipe_args["batch_size"]
+        if self._pipe_args.get("indices") is not None:
+            n = len(self._pipe_args["indices"])
+        return n // bs if self._pipe_args.get("drop_last", True) \
+            else (n + bs - 1) // bs
 
     def __iter__(self):
+        if self._file is None:
+            return  # closed
+        if self._pipe is None:
+            self._pipe = self._new_pipe()  # lazy: built at epoch start
         try:
             yield from self._pipe
         finally:
             # epoch boundary — reached on full consumption AND on early
-            # break (GeneratorExit lands here): always start the next
-            # epoch fresh (reshuffled when shuffle=True)
-            self._pipe.close()
+            # break (GeneratorExit lands here). Tear down only; the next
+            # epoch's pipeline is built lazily so the final epoch doesn't
+            # waste a prefetch round. Guarded: close() during iteration
+            # already cleared the fields.
+            if self._pipe is not None:
+                self._pipe.close()
+                self._pipe = None
             self._epoch += 1
-            self._pipe = self._new_pipe()
 
     def close(self):
         if getattr(self, "_pipe", None) is not None:

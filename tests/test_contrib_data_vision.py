@@ -1,0 +1,71 @@
+"""gluon.contrib.data.vision tests (reference model:
+tests/python/unittest/test_gluon_data.py + contrib dataloader tests)."""
+import numpy as onp
+import pytest
+
+from incubator_mxnet_tpu import gluon, image, recordio
+
+
+def _make_rec(tmp_path, n=12, size=16):
+    """Pack n synthetic images into a .rec with labels i%3."""
+    path = str(tmp_path / "imgs.rec")
+    w = recordio.MXRecordIO(path, "w")
+    rng = onp.random.RandomState(0)
+    for i in range(n):
+        img = rng.randint(0, 255, (size, size, 3)).astype(onp.uint8)
+        payload = image.imencode(img)
+        w.write(recordio.pack(recordio.IRHeader(0, float(i % 3), i, 0),
+                              payload))
+    w.close()
+    return path
+
+
+def test_image_record_dataset(tmp_path):
+    rec = _make_rec(tmp_path)
+    ds = gluon.data.vision.ImageRecordDataset(rec)
+    assert len(ds) == 12
+    img, label = ds[5]
+    assert img.shape == (16, 16, 3)
+    assert label == 5 % 3
+
+
+def test_random_crop_transform():
+    t = gluon.data.vision.transforms.RandomCrop(8)
+    from incubator_mxnet_tpu.ndarray.ndarray import NDArray
+
+    x = NDArray(onp.zeros((16, 16, 3), onp.float32))
+    out = t(x)
+    assert out.shape == (8, 8, 3)
+    # smaller than crop: resized up
+    small = NDArray(onp.zeros((4, 4, 3), onp.float32))
+    assert t(small).shape == (8, 8, 3)
+
+
+def test_create_image_augment_compose():
+    aug = gluon.contrib.data.vision.create_image_augment(
+        (3, 8, 8), rand_mirror=True, brightness=0.1,
+        mean=(0.5, 0.5, 0.5), std=(0.2, 0.2, 0.2))
+    from incubator_mxnet_tpu.ndarray.ndarray import NDArray
+
+    x = NDArray(onp.random.RandomState(0)
+                .randint(0, 255, (16, 16, 3)).astype(onp.uint8))
+    out = aug(x)
+    assert out.shape == (3, 8, 8)  # ToTensor → CHW float
+
+
+def test_create_image_augment_rejects_unsupported():
+    with pytest.raises(ValueError, match="not supported"):
+        gluon.contrib.data.vision.create_image_augment((3, 8, 8),
+                                                       pca_noise=0.1)
+
+
+def test_image_dataloader_end_to_end(tmp_path):
+    rec = _make_rec(tmp_path)
+    loader = gluon.contrib.data.vision.ImageDataLoader(
+        batch_size=4, data_shape=(3, 8, 8), path_imgrec=rec,
+        shuffle=True, rand_mirror=True)
+    batches = list(loader)
+    assert len(batches) == 3
+    data, label = batches[0]
+    assert data.shape == (4, 3, 8, 8)
+    assert label.shape == (4,)

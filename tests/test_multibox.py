@@ -1,0 +1,118 @@
+"""SSD multibox op tests (reference model:
+tests/python/unittest/test_contrib_operator.py multibox sections)."""
+import numpy as onp
+
+from incubator_mxnet_tpu import np as mnp
+from incubator_mxnet_tpu import numpy_extension as npx
+
+
+def A(x):
+    return x.asnumpy() if hasattr(x, "asnumpy") else onp.asarray(x)
+
+
+def test_multibox_prior_shapes_and_layout():
+    x = mnp.zeros((1, 8, 4, 4))
+    anchors = npx.multibox_prior(x, sizes=[0.5, 0.25], ratios=[1, 2, 0.5])
+    # A = 2 + 3 - 1 = 4 anchors per cell
+    assert anchors.shape == (1, 4 * 4 * 4, 4)
+    a = A(anchors)[0]
+    # first anchor of the first cell: centered at (0.5/4, 0.5/4), size 0.5
+    cx, cy = 0.5 / 4, 0.5 / 4
+    onp.testing.assert_allclose(a[0], [cx - 0.25, cy - 0.25,
+                                       cx + 0.25, cy + 0.25], atol=1e-6)
+    # reference layout: sizes first (at ratios[0]) then ratios[1:]
+    r2 = 2 ** 0.5
+    onp.testing.assert_allclose(a[2], [cx - 0.25 * r2, cy - 0.25 / r2,
+                                       cx + 0.25 * r2, cy + 0.25 / r2],
+                                atol=1e-5)
+
+
+def test_multibox_prior_clip():
+    x = mnp.zeros((1, 1, 2, 2))
+    anchors = A(npx.multibox_prior(x, sizes=[1.5], clip=True))
+    assert anchors.min() >= 0.0 and anchors.max() <= 1.0
+
+
+def test_multibox_target_perfect_match():
+    x = mnp.zeros((1, 1, 1, 1))
+    anchors = npx.multibox_prior(x, sizes=[1.0])  # one anchor ~ whole image
+    a = A(anchors)[0, 0]
+    label = mnp.array(onp.array(
+        [[[0.0, a[0], a[1], a[2], a[3]],
+          [-1.0, 0, 0, 0, 0]]], onp.float32))  # one gt + padding
+    cls_pred = mnp.zeros((1, 2, 1))
+    loc_t, loc_m, cls_t = npx.multibox_target(anchors, label, cls_pred)
+    assert cls_t.shape == (1, 1)
+    assert float(A(cls_t)[0, 0]) == 1.0          # class 0 → target 1
+    onp.testing.assert_allclose(A(loc_t)[0], onp.zeros(4), atol=1e-5)
+    onp.testing.assert_allclose(A(loc_m)[0], onp.ones(4))
+
+
+def test_multibox_target_no_gt_is_all_background():
+    x = mnp.zeros((1, 1, 2, 2))
+    anchors = npx.multibox_prior(x, sizes=[0.5])
+    label = mnp.array(onp.full((1, 2, 5), -1.0, onp.float32))
+    cls_pred = mnp.zeros((1, 3, 4))
+    loc_t, loc_m, cls_t = npx.multibox_target(anchors, label, cls_pred)
+    assert (A(cls_t) == 0).all()
+    assert (A(loc_m) == 0).all()
+
+
+def test_multibox_target_force_match_low_iou():
+    """Every valid gt claims its best anchor even below the threshold."""
+    x = mnp.zeros((1, 1, 2, 2))
+    anchors = npx.multibox_prior(x, sizes=[0.2])
+    # tiny gt box far from any anchor's 0.5-IoU reach, near cell (0,0)
+    label = mnp.array(onp.array(
+        [[[1.0, 0.0, 0.0, 0.1, 0.1]]], onp.float32))
+    cls_pred = mnp.zeros((1, 3, 4))
+    _, _, cls_t = npx.multibox_target(anchors, label, cls_pred,
+                                      overlap_threshold=0.9)
+    assert (A(cls_t) == 2.0).sum() == 1  # exactly the forced match
+
+
+def test_multibox_target_padding_does_not_clobber_force_match():
+    """Padding rows (cls=-1) must not cancel a valid gt's forced anchor."""
+    x = mnp.zeros((1, 1, 2, 1))
+    anchors = npx.multibox_prior(x, sizes=[0.2])  # 2 anchors
+    label = mnp.array(onp.array(
+        [[[1.0, 0.0, 0.0, 0.12, 0.12],       # low-IoU gt → forced match
+          [-1.0, 0.0, 0.0, 0.0, 0.0],        # padding
+          [-1.0, 0.0, 0.0, 0.0, 0.0]]], onp.float32))
+    cls_pred = mnp.zeros((1, 3, 2))
+    _, _, cls_t = npx.multibox_target(anchors, label, cls_pred,
+                                      overlap_threshold=0.95)
+    assert (A(cls_t) == 2.0).sum() == 1
+
+
+def test_multibox_detection_decodes_and_nms():
+    x = mnp.zeros((1, 1, 2, 2))
+    anchors = npx.multibox_prior(x, sizes=[0.4])          # (1, 4, 4)
+    n = 4
+    cls_prob = onp.zeros((1, 3, n), onp.float32)
+    cls_prob[0, 0] = 0.1                                   # background
+    cls_prob[0, 1] = [0.8, 0.7, 0.05, 0.05]                # class 0 strong
+    cls_prob[0, 2] = 0.05
+    loc_pred = onp.zeros((1, n * 4), onp.float32)          # no offset
+    out = npx.multibox_detection(mnp.array(cls_prob), mnp.array(loc_pred),
+                                 anchors, nms_threshold=0.9)
+    o = A(out)[0]
+    assert o.shape == (n, 6)
+    kept = o[o[:, 0] >= 0]
+    assert len(kept) >= 1
+    assert kept[0, 0] == 0.0          # class id (background removed)
+    assert abs(kept[0, 1] - 0.8) < 1e-5
+    # decoded box equals the anchor (zero deltas)
+    a = A(anchors)[0]
+    onp.testing.assert_allclose(kept[0, 2:6], a[0], atol=1e-5)
+
+
+def test_multibox_detection_threshold_filters():
+    x = mnp.zeros((1, 1, 1, 1))
+    anchors = npx.multibox_prior(x, sizes=[0.5])
+    cls_prob = onp.array([[[0.9], [0.1]]], onp.float32)  # bg wins
+    loc_pred = onp.zeros((1, 4), onp.float32)
+    out = A(npx.multibox_detection(mnp.array(cls_prob),
+                                   mnp.array(loc_pred), anchors,
+                                   threshold=0.5))
+    assert (out[0, :, 0] == -1).all()  # nothing above threshold

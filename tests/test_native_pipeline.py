@@ -113,6 +113,15 @@ def test_closed_pipeline_len_is_zero(rec_file):
     f.close()
 
 
+def test_prefetcher_close_during_iteration_is_safe(rec_file):
+    it = recordio.MXRecordIOPrefetcher(rec_file, batch_size=5)
+    for _ in it:
+        it.close()
+        break  # GeneratorExit cleanup must not raise on closed state
+    assert len(it) == 0
+    assert list(it) == []
+
+
 def test_prefetcher_payloads_match_sequential(rec_file):
     r = recordio.MXRecordIO(rec_file, "r")
     seq = []
