@@ -71,6 +71,7 @@ from . import name  # noqa: F401
 from . import attribute  # noqa: F401
 from .attribute import AttrScope  # noqa: F401
 from . import runtime  # noqa: F401
+from . import rtc  # noqa: F401
 from . import model  # noqa: F401
 from . import visualization  # noqa: F401
 from . import visualization as viz  # noqa: F401

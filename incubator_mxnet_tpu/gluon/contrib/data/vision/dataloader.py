@@ -29,13 +29,16 @@ def create_image_augment(data_shape, resize=0, rand_crop=False,
                          "are not supported in the TPU host pipeline")
     aug = transforms.Compose()
     size = (data_shape[2], data_shape[1])  # (W, H)
-    if resize > 0:
-        aug.add(transforms.Resize(resize))
     if rand_resize:
         aug.add(transforms.RandomResizedCrop(size))
     elif rand_crop:
-        aug.add(transforms.Resize((size[0] * 9 // 8, size[1] * 9 // 8)))
+        aug.add(transforms.Resize(resize if resize > 0
+                                  else (size[0] * 9 // 8, size[1] * 9 // 8)))
         aug.add(transforms.RandomCrop(size))
+    elif resize > 0:
+        # reference semantics: shorter-edge resize then center crop
+        aug.add(transforms.Resize(resize))
+        aug.add(transforms.CenterCrop(size))
     else:
         aug.add(transforms.Resize(size))
     if rand_mirror:

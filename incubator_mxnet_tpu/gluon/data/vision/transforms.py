@@ -224,19 +224,31 @@ class RandomCrop(Block):
     def __init__(self, size, pad=None, interpolation=1):  # noqa: ARG002
         super().__init__()
         self._size = (size, size) if isinstance(size, int) else tuple(size)
+        # pad: int (all four sides) or 4-tuple (left, top, right, bottom),
+        # applied to the H/W dims of an HWC image (reference RandomCrop)
+        if pad is not None and not isinstance(pad, int):
+            pad = tuple(pad)
+            if len(pad) != 4:
+                raise ValueError("RandomCrop: pad must be an int or a "
+                                 "(left, top, right, bottom) 4-tuple")
         self._pad = pad
 
     def forward(self, x):
         import random as pyrandom
 
+        if x.ndim != 3:
+            raise ValueError(f"RandomCrop expects an HWC image, got rank "
+                             f"{x.ndim}")
         w, h = self._size
         if self._pad:
             p = self._pad
+            widths = ((p, p), (p, p), (0, 0)) if isinstance(p, int) else \
+                ((p[1], p[3]), (p[0], p[2]), (0, 0))
 
             def padf(v):
                 import jax.numpy as jnp
 
-                return jnp.pad(v, [(p, p), (p, p), (0, 0)])
+                return jnp.pad(v, widths)
 
             x = apply_op("rc_pad", padf, (x,))
         H, W = x.shape[-3], x.shape[-2]

@@ -93,8 +93,8 @@ def imencode(img, img_fmt=".jpg", quality=95):
 
 
 def imread(filename, flag=1, to_rgb=True):
-    if filename.endswith(".npy"):
-        return NDArray(onp.load(filename))
+    # both paths route through imdecode so flag semantics (grayscale
+    # conversion) are identical for .npy and JPEG/PNG inputs
     with open(filename, "rb") as f:
         return imdecode(f.read(), flag, to_rgb)
 

@@ -62,66 +62,76 @@ def box_iou(lhs, rhs, format="corner"):  # noqa: A002
     return apply_op_flat("box_iou", fn, (lhs, rhs), {})
 
 
+def _nms_core(d, overlap_thresh, valid_thresh, topk, coord_start,
+              score_index, id_index, background_id, force_suppress,
+              in_format, out_format):
+    """jax-level NMS body shared by `box_nms` and `multibox_detection`
+    (no funnel/NDArray layering — safe to call inside another op's fn)."""
+    jnp = _jnp()
+    batch_shape = d.shape[:-2]
+    n, k = d.shape[-2], d.shape[-1]
+    flat = d.reshape((-1, n, k))
+
+    def one(batch):
+        scores = batch[:, score_index]
+        order = jnp.argsort(-scores)  # descending
+        sorted_rows = batch[order]
+        s_scores = sorted_rows[:, score_index]
+        boxes = _to_corner(
+            sorted_rows[:, coord_start:coord_start + 4], in_format)
+        iou = _iou_corner(boxes, boxes)
+        valid = s_scores > valid_thresh
+        if topk > 0:
+            valid = valid & (jnp.arange(n) < topk)
+        if id_index >= 0 and not force_suppress:
+            ids = sorted_rows[:, id_index]
+            same_class = ids[:, None] == ids[None, :]
+        else:
+            same_class = jnp.ones((n, n), bool)
+        if id_index >= 0 and background_id >= 0:
+            valid = valid & (sorted_rows[:, id_index] != background_id)
+        suppress_pair = (iou > overlap_thresh) & same_class
+
+        # greedy scan in score order: row i survives unless suppressed
+        # by an earlier surviving row
+        def body(i, keep):
+            sup = (suppress_pair[:, i] & keep
+                   & (jnp.arange(n) < i)).any()
+            return keep.at[i].set(keep[i] & ~sup)
+
+        import jax
+
+        keep = jax.lax.fori_loop(0, n, body, valid)
+        if out_format != in_format:
+            conv = (boxes if out_format == "corner"
+                    else _corner_to_center(boxes))
+            sorted_rows = sorted_rows.at[
+                :, coord_start:coord_start + 4].set(conv)
+        # compact survivors to the top (stable: argsort of ~keep keeps
+        # score order within each group), fill the tail with -1
+        perm = jnp.argsort(~keep, stable=True)
+        compacted = sorted_rows[perm]
+        row_valid = keep[perm]
+        return jnp.where(row_valid[:, None], compacted, -1.0)
+
+    import jax
+
+    out = jax.vmap(one)(flat)
+    return out.reshape(batch_shape + (n, k))
+
+
 def box_nms(data, overlap_thresh=0.5, valid_thresh=0.0, topk=-1,
             coord_start=2, score_index=1, id_index=-1, background_id=-1,
-            force_suppress=False, in_format="corner", out_format="corner"):  # noqa: ARG001
+            force_suppress=False, in_format="corner", out_format="corner"):
     """Non-maximum suppression (reference: bounding_box.cc _contrib_box_nms).
 
     data: (..., N, K) rows [id?, score, x1, y1, x2, y2, ...]. Reference
     output semantics (bounding_box-inl.h:326): surviving rows compacted to
     the top in score order, all remaining rows filled with -1."""
     def fn(d):
-        jnp = _jnp()
-        batch_shape = d.shape[:-2]
-        n, k = d.shape[-2], d.shape[-1]
-        flat = d.reshape((-1, n, k))
-
-        def one(batch):
-            scores = batch[:, score_index]
-            order = jnp.argsort(-scores)  # descending
-            sorted_rows = batch[order]
-            s_scores = sorted_rows[:, score_index]
-            boxes = _to_corner(
-                sorted_rows[:, coord_start:coord_start + 4], in_format)
-            iou = _iou_corner(boxes, boxes)
-            valid = s_scores > valid_thresh
-            if topk > 0:
-                valid = valid & (jnp.arange(n) < topk)
-            if id_index >= 0 and not force_suppress:
-                ids = sorted_rows[:, id_index]
-                same_class = ids[:, None] == ids[None, :]
-            else:
-                same_class = jnp.ones((n, n), bool)
-            if id_index >= 0 and background_id >= 0:
-                valid = valid & (sorted_rows[:, id_index] != background_id)
-            suppress_pair = (iou > overlap_thresh) & same_class
-
-            # greedy scan in score order: row i survives unless suppressed
-            # by an earlier surviving row
-            def body(i, keep):
-                sup = (suppress_pair[:, i] & keep
-                       & (jnp.arange(n) < i)).any()
-                return keep.at[i].set(keep[i] & ~sup)
-
-            import jax
-
-            keep = jax.lax.fori_loop(0, n, body, valid)
-            if out_format != in_format:
-                conv = (boxes if out_format == "corner"
-                        else _corner_to_center(boxes))
-                sorted_rows = sorted_rows.at[
-                    :, coord_start:coord_start + 4].set(conv)
-            # compact survivors to the top (stable: argsort of ~keep keeps
-            # score order within each group), fill the tail with -1
-            perm = jnp.argsort(~keep, stable=True)
-            compacted = sorted_rows[perm]
-            row_valid = keep[perm]
-            return jnp.where(row_valid[:, None], compacted, -1.0)
-
-        import jax
-
-        out = jax.vmap(one)(flat)
-        return out.reshape(batch_shape + (n, k))
+        return _nms_core(d, overlap_thresh, valid_thresh, topk, coord_start,
+                         score_index, id_index, background_id,
+                         force_suppress, in_format, out_format)
 
     return apply_op_flat("box_nms", fn, (data,), {})
 
@@ -391,21 +401,27 @@ def multibox_target(anchor, label, cls_pred, overlap_threshold=0.5,
     `src/operator/contrib/multibox_target.cc`).
 
     anchor (1, N, 4) corners; label (B, M, 5) rows [cls, x1, y1, x2, y2]
-    with cls = -1 padding; cls_pred (B, num_cls+1, N) (used for shape/
-    negative-mining parity only — hard mining here is IoU-based:
-    anchors with best IoU < negative_mining_thresh stay background).
-    Returns (loc_target (B, N*4), loc_mask (B, N*4), cls_target (B, N))
-    where cls_target is gt_class+1 (0 = background), matching the
-    reference's label convention."""
-    def fn(anc, lab, _pred):
+    with cls = -1 padding; cls_pred (B, num_cls+1, N) provides the
+    confidence ranking for hard negative mining (reference
+    multibox_target.cc: negatives ranked by max non-background score;
+    only the top `negative_mining_ratio × num_pos` — at least
+    `minimum_negative_samples` — stay trainable background, the rest get
+    `ignore_label`). Returns (loc_target (B, N*4), loc_mask (B, N*4),
+    cls_target (B, N)) where cls_target is gt_class+1 (0 = background).
+
+    Divergence: the reference matches gts to anchors sequentially
+    (best-remaining pair each round); this op runs TWO simultaneous
+    scatter rounds, which is exact unless >2 gts share one best anchor."""
+    def fn(anc, lab, pred):
         jnp = _jnp()
         a = anc.reshape(-1, 4)
         n = a.shape[0]
         var = jnp.asarray(variances, jnp.float32)
 
-        def one(gt):
+        def one(gt, scores):
             cls = gt[:, 0]
             boxes = gt[:, 1:5]
+            m_rows = gt.shape[0]
             valid = cls >= 0  # (M,)
             iou = _iou_corner(a, boxes)  # (N, M)
             iou = jnp.where(valid[None, :], iou, -1.0)
@@ -413,14 +429,29 @@ def multibox_target(anchor, label, cls_pred, overlap_threshold=0.5,
             best_iou = jnp.take_along_axis(iou, best_gt[:, None],
                                            1)[:, 0]   # (N,)
             matched = best_iou >= overlap_threshold
-            # force-match: each VALID gt claims its best anchor. Padding
-            # rows (cls=-1) are routed to a dummy slot n so their scatter
+            # force-match round 1: each VALID gt claims its best anchor.
+            # Padding rows (cls=-1) route to dummy slot n so their scatter
             # can neither claim an anchor nor clobber a valid gt's claim.
+            gt_range = jnp.arange(m_rows, dtype=jnp.int32)
             best_anchor = jnp.argmax(iou, axis=0)       # (M,)
             scatter_idx = jnp.where(valid, best_anchor, n)
             forced = jnp.zeros((n + 1,), bool).at[scatter_idx].set(True)[:n]
             forced_gt = jnp.zeros((n + 1,), jnp.int32).at[scatter_idx].set(
-                jnp.arange(gt.shape[0], dtype=jnp.int32))[:n]
+                gt_range)[:n]
+            # round 2: gts that LOST the round-1 scatter (another gt wrote
+            # the same anchor) claim their best anchor among unclaimed ones
+            won = valid & (forced_gt[jnp.where(valid, best_anchor, 0)]
+                           == gt_range) & forced[
+                               jnp.where(valid, best_anchor, 0)]
+            lost = valid & ~won
+            iou2 = jnp.where(forced[:, None], -1.0, iou)  # mask claimed
+            best_anchor2 = jnp.argmax(iou2, axis=0)
+            scatter2 = jnp.where(lost, best_anchor2, n)
+            forced2 = jnp.zeros((n + 1,), bool).at[scatter2].set(True)[:n]
+            forced_gt2 = jnp.zeros((n + 1,), jnp.int32).at[scatter2].set(
+                gt_range)[:n]
+            forced_gt = jnp.where(forced2 & ~forced, forced_gt2, forced_gt)
+            forced = forced | forced2
             gt_idx = jnp.where(forced, forced_gt, best_gt)
             matched = matched | forced
             mb = boxes[gt_idx]                          # (N, 4)
@@ -442,11 +473,27 @@ def multibox_target(anchor, label, cls_pred, overlap_threshold=0.5,
                               jnp.ones((n, 4), jnp.float32),
                               0.0).reshape(-1)
             cls_t = jnp.where(matched, cls[gt_idx] + 1.0, 0.0)
+            if negative_mining_ratio > 0:
+                # hard negative mining: unmatched anchors ranked by max
+                # non-background confidence; top-k stay background(0),
+                # the rest are set to ignore_label
+                conf = scores[1:].max(axis=0) if scores.shape[0] > 1 \
+                    else scores[0]
+                neg = ~matched
+                num_pos = matched.sum()
+                k = jnp.maximum(
+                    (negative_mining_ratio * num_pos).astype(jnp.int32),
+                    jnp.int32(minimum_negative_samples))
+                neg_conf = jnp.where(neg, conf, -jnp.inf)
+                rank = jnp.argsort(jnp.argsort(-neg_conf))  # 0 = hardest
+                keep_neg = neg & (rank < k)
+                cls_t = jnp.where(neg & ~keep_neg,
+                                  jnp.float32(ignore_label), cls_t)
             return loc_t, loc_m, cls_t
 
         import jax
 
-        loc_t, loc_m, cls_t = jax.vmap(one)(lab)
+        loc_t, loc_m, cls_t = jax.vmap(one)(lab, pred)
         return loc_t, loc_m, cls_t
 
     return apply_op_flat("multibox_target", fn, (anchor, label, cls_pred),
@@ -502,15 +549,11 @@ def multibox_detection(cls_prob, loc_pred, anchor, clip=True,
         import jax
 
         rows = jax.vmap(one)(cp, lp)
-        from . import box_nms
-
-        from ..ndarray.ndarray import NDArray
-
-        out = box_nms(NDArray(rows), overlap_thresh=nms_threshold,
-                      valid_thresh=threshold, topk=nms_topk, coord_start=2,
-                      score_index=1, id_index=0, background_id=-1,
-                      force_suppress=force_suppress)
-        return out._data
+        # shared jax-level NMS core (no nested funnel call inside this fn)
+        return _nms_core(rows, nms_threshold, threshold, nms_topk,
+                         coord_start=2, score_index=1, id_index=0,
+                         background_id=-1, force_suppress=force_suppress,
+                         in_format="corner", out_format="corner")
 
     return apply_op_flat("multibox_detection", fn, (cls_prob, loc_pred,
                                                     anchor), {})

@@ -48,13 +48,13 @@ def unpack(s):
     return header, s
 
 
-def pack_img(header, img, quality=95, img_fmt=".jpg"):  # noqa: ARG001
-    """Pack a HWC uint8 image. Without OpenCV/PIL the payload is raw .npy."""
-    import io as _io
+def pack_img(header, img, quality=95, img_fmt=".jpg"):
+    """Pack a HWC uint8 image as JPEG/PNG (reference: recordio.py pack_img
+    over cv2.imencode). Uses `image.imencode` (PIL), falling back to a raw
+    .npy payload only when PIL is unavailable; `unpack_img` reads both."""
+    from .image import imencode
 
-    buf = _io.BytesIO()
-    onp.save(buf, onp.asarray(img, dtype=onp.uint8))
-    return pack(header, buf.getvalue())
+    return pack(header, imencode(img, img_fmt=img_fmt, quality=quality))
 
 
 def unpack_img(s, iscolor=-1):  # noqa: ARG001

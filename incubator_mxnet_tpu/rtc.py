@@ -1,0 +1,96 @@
+"""Runtime kernel compilation (reference: `python/mxnet/rtc.py` —
+`CudaModule` compiles CUDA C with NVRTC at runtime and exposes kernels as
+callable ops; impl `src/common/rtc.cc:31`).
+
+TPU-native: the runtime-codegen role is played by **pallas**. `PallasModule`
+wraps user-written pallas kernel functions into framework ops that execute
+through the `apply_op` funnel (tape-recorded, AMP-aware, async). `CudaModule`
+exists for API parity and raises with a pointer to the pallas path — there
+is no CUDA on a TPU host.
+"""
+from __future__ import annotations
+
+from .ndarray.ndarray import NDArray, apply_op_flat
+
+__all__ = ["CudaModule", "PallasModule"]
+
+
+class CudaModule:
+    """Unsupported on TPU (`rtc.py:33` in the reference)."""
+
+    def __init__(self, *args, **kwargs):  # noqa: ARG002
+        raise RuntimeError(
+            "CudaModule (NVRTC runtime compilation) has no TPU equivalent; "
+            "write a pallas kernel and wrap it with mx.rtc.PallasModule — "
+            "see incubator_mxnet_tpu/ops/flash_attention.py for the "
+            "pattern.")
+
+
+class PallasKernel:
+    """One compiled-on-first-call pallas kernel bound to a grid/blockspec
+    factory. Create via `PallasModule.get_kernel`."""
+
+    def __init__(self, name, builder):
+        self._name = name
+        self._builder = builder
+
+    def __call__(self, *args, **static_kwargs):
+        def fn(*tensor_vals):
+            return self._builder(*tensor_vals, **static_kwargs)
+
+        return apply_op_flat(f"pallas:{self._name}", fn, args, {})
+
+    def launch(self, args, device=None, grid_dims=None, block_dims=None):  # noqa: ARG002
+        """Reference-signature launch (`rtc.py:116 CudaKernel.launch`);
+        grid/block dims are owned by the pallas BlockSpec, so they are
+        accepted and ignored."""
+        out = self(*args)
+        return out if isinstance(out, tuple) else (out,)
+
+
+class PallasModule:
+    """Collection of pallas kernels exposed as framework ops
+    (the `CudaModule` analogue).
+
+    `kernels` maps name → builder. A builder takes the unwrapped jax-array
+    operands (plus static keyword args) and returns the kernel result —
+    typically via `jax.experimental.pallas.pallas_call`. Example::
+
+        import jax, jax.numpy as jnp
+        from jax.experimental import pallas as pl
+
+        def add_one(x):
+            def kern(x_ref, o_ref):
+                o_ref[...] = x_ref[...] + 1.0
+            return pl.pallas_call(
+                kern, out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype))(x)
+
+        mod = mx.rtc.PallasModule({"add_one": add_one})
+        y = mod.get_kernel("add_one")(x_ndarray)
+
+    Autodiff: `pallas_call` has no automatic VJP — a builder that must be
+    differentiable should wrap its kernel in `jax.custom_vjp` with a
+    backward kernel (the pattern `ops/flash_attention.py` uses); the funnel
+    then records it on the tape like any other op.
+    """
+
+    def __init__(self, kernels: dict):
+        if not isinstance(kernels, dict) or not kernels:
+            raise ValueError("PallasModule expects a non-empty dict of "
+                             "name -> pallas builder callables")
+        self._kernels = {name: PallasKernel(name, fn)
+                         for name, fn in kernels.items()}
+
+    def get_kernel(self, name, signature=None):  # noqa: ARG002
+        """Look up a kernel (`rtc.py:74 CudaModule.get_kernel`; the
+        signature string is unnecessary — shapes/dtypes come from the
+        operands at call time)."""
+        try:
+            return self._kernels[name]
+        except KeyError:
+            raise ValueError(
+                f"kernel {name!r} not in module; have "
+                f"{sorted(self._kernels)}") from None
+
+    def __contains__(self, name):
+        return name in self._kernels

@@ -85,6 +85,40 @@ def test_multibox_target_padding_does_not_clobber_force_match():
     assert (A(cls_t) == 2.0).sum() == 1
 
 
+def test_multibox_target_negative_mining():
+    x = mnp.zeros((1, 1, 4, 4))
+    anchors = npx.multibox_prior(x, sizes=[0.3])   # 16 anchors
+    n = anchors.shape[1]
+    a0 = A(anchors)[0, 0]
+    label = mnp.array(onp.array(
+        [[[0.0, a0[0], a0[1], a0[2], a0[3]]]], onp.float32))
+    # confidence ranking: anchors 1..3 are "hard" negatives
+    pred = onp.zeros((1, 3, n), onp.float32)
+    pred[0, 1, 1:4] = 0.9
+    _, _, cls_t = npx.multibox_target(
+        anchors, label, mnp.array(pred), negative_mining_ratio=3.0,
+        ignore_label=-1.0)
+    c = A(cls_t)[0]
+    assert c[0] == 1.0                       # the positive
+    assert (c == 0.0).sum() == 3             # 3 kept negatives (ratio 3×1)
+    assert (c == -1.0).sum() == n - 4        # rest ignored
+
+
+def test_multibox_target_two_gts_same_best_anchor():
+    """Round-2 assignment: the losing gt gets its next-best anchor."""
+    x = mnp.zeros((1, 1, 2, 1))
+    anchors = npx.multibox_prior(x, sizes=[0.2])  # 2 anchors
+    # both gts overlap anchor 0 best; second round must place the loser
+    label = mnp.array(onp.array(
+        [[[0.0, 0.0, 0.05, 0.12, 0.17],
+          [1.0, 0.0, 0.08, 0.12, 0.20]]], onp.float32))
+    cls_pred = mnp.zeros((1, 3, 2))
+    _, _, cls_t = npx.multibox_target(anchors, label, cls_pred,
+                                      overlap_threshold=0.95)
+    c = A(cls_t)[0]
+    assert (c > 0).sum() == 2  # both gts matched to distinct anchors
+
+
 def test_multibox_detection_decodes_and_nms():
     x = mnp.zeros((1, 1, 2, 2))
     anchors = npx.multibox_prior(x, sizes=[0.4])          # (1, 4, 4)
