@@ -72,6 +72,13 @@ from . import attribute  # noqa: F401
 from .attribute import AttrScope  # noqa: F401
 from . import runtime  # noqa: F401
 from . import rtc  # noqa: F401
+from . import callback  # noqa: F401
+from . import engine  # noqa: F401
+from . import context  # noqa: F401
+from . import executor  # noqa: F401
+from . import dlpack  # noqa: F401
+from . import libinfo  # noqa: F401
+from . import registry  # noqa: F401
 from . import model  # noqa: F401
 from . import visualization  # noqa: F401
 from . import visualization as viz  # noqa: F401

@@ -1,0 +1,17 @@
+"""Back-compat context module (reference: `python/mxnet/context.py` — the
+pre-2.0 alias of `device.py`)."""
+from .device import (  # noqa: F401
+    Context,
+    Device,
+    cpu,
+    current_device,
+    gpu,
+    num_gpus,
+    num_tpus,
+    tpu,
+)
+
+current_context = current_device
+
+__all__ = ["Context", "Device", "cpu", "gpu", "tpu", "num_gpus",
+           "num_tpus", "current_context", "current_device"]

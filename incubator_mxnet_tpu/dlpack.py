@@ -1,0 +1,52 @@
+"""DLPack interop (reference: `python/mxnet/dlpack.py` —
+`to_dlpack_for_read/write`, `from_dlpack`; zero-copy tensor exchange with
+torch/cupy/tf).
+
+TPU-native: jax arrays implement the DLPack protocol directly
+(`__dlpack__`), so NDArray exchange is a thin passthrough. On CPU the
+exchange is zero-copy; device buffers follow jax's dlpack rules.
+"""
+from __future__ import annotations
+
+from .ndarray.ndarray import NDArray
+
+__all__ = ["to_dlpack_for_read", "to_dlpack_for_write", "from_dlpack",
+           "DLDeviceType"]
+
+
+class DLDeviceType:
+    """Device-type enum parity (`dlpack.py:35`)."""
+
+    DLCPU = 1
+    DLGPU = 2
+    DLCPUPINNED = 3
+
+
+def to_dlpack_for_read(data: NDArray):
+    """Export as a DLPack capsule; the buffer must not be written while
+    the capsule is alive (`dlpack.py:63`)."""
+    data.wait_to_read()
+    return data._data.__dlpack__()
+
+
+def to_dlpack_for_write(data: NDArray):
+    """Reference API distinguishes read/write exports for engine-ordering
+    (`dlpack.py:85`); jax buffers are immutable so the export is identical
+    — mutation after export rebinds a fresh buffer and cannot alias."""
+    data.wait_to_read()
+    return data._data.__dlpack__()
+
+
+def from_dlpack(dlpack) -> NDArray:
+    """Wrap a DLPack capsule (or any object with `__dlpack__`) into an
+    NDArray (`dlpack.py:107`)."""
+    import jax
+
+    if isinstance(dlpack, NDArray):
+        return NDArray(dlpack._data)  # shares the immutable buffer
+    if hasattr(dlpack, "__dlpack__"):
+        return NDArray(jax.numpy.from_dlpack(dlpack))
+    # raw capsule path
+    from jax import dlpack as jdlpack
+
+    return NDArray(jdlpack.from_dlpack(dlpack))

@@ -1,0 +1,35 @@
+"""Engine control surface (reference: `python/mxnet/engine.py` —
+`bulk`/`set_bulk_size` batch many small ops into one engine op to cut
+dispatch overhead).
+
+TPU-native: XLA fuses whole jit regions, and the eager path batches through
+the op-call jit cache, so bulking is implicit. The knobs keep API parity:
+`bulk` is a no-op scope whose *intent* (fewer, larger device programs) is
+realized by `hybridize()`/jit, and `set_bulk_size` records the value for
+introspection only.
+"""
+from __future__ import annotations
+
+import contextlib
+
+__all__ = ["bulk", "set_bulk_size"]
+
+_BULK_SIZE = 15  # reference default MXNET_ENGINE_BULK_SIZE
+
+
+def set_bulk_size(size: int) -> int:
+    """Set the bulk window; returns the previous value (`engine.py:58`)."""
+    global _BULK_SIZE
+    prev, _BULK_SIZE = _BULK_SIZE, int(size)
+    return prev
+
+
+@contextlib.contextmanager
+def bulk(size: int):
+    """Scope batching ops into one engine op (`engine.py:77`). Under XLA
+    the compiler owns op grouping — the scope is behavioral parity only."""
+    prev = set_bulk_size(size)
+    try:
+        yield
+    finally:
+        set_bulk_size(prev)

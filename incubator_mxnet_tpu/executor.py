@@ -1,0 +1,6 @@
+"""Executor module (reference: `python/mxnet/executor.py`). The class
+itself lives with the symbol package; this module mirrors the reference
+import path `mx.executor.Executor`."""
+from .symbol.executor import Executor  # noqa: F401
+
+__all__ = ["Executor"]

@@ -30,14 +30,17 @@ def create_image_augment(data_shape, resize=0, rand_crop=False,
     aug = transforms.Compose()
     size = (data_shape[2], data_shape[1])  # (W, H)
     if rand_resize:
+        if resize > 0:  # reference: pre-resize before the random crop
+            aug.add(transforms.Resize(resize, keep_ratio=True))
         aug.add(transforms.RandomResizedCrop(size))
     elif rand_crop:
-        aug.add(transforms.Resize(resize if resize > 0
-                                  else (size[0] * 9 // 8, size[1] * 9 // 8)))
+        aug.add(transforms.Resize(resize, keep_ratio=True) if resize > 0
+                else transforms.Resize((size[0] * 9 // 8,
+                                        size[1] * 9 // 8)))
         aug.add(transforms.RandomCrop(size))
     elif resize > 0:
         # reference semantics: shorter-edge resize then center crop
-        aug.add(transforms.Resize(resize))
+        aug.add(transforms.Resize(resize, keep_ratio=True))
         aug.add(transforms.CenterCrop(size))
     else:
         aug.add(transforms.Resize(size))

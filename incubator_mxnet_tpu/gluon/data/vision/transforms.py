@@ -77,11 +77,21 @@ def _resize_hwc(v, size):
 
 
 class Resize(HybridBlock):
+    """Resize to (W, H); int size + keep_ratio=True resizes the SHORTER
+    edge to `size` preserving aspect (reference transforms.Resize)."""
+
     def __init__(self, size, keep_ratio=False, interpolation=1):  # noqa: ARG002
         super().__init__()
         self._size = size
+        self._keep = keep_ratio and isinstance(size, int)
 
     def forward(self, x):
+        if self._keep:
+            h, w = x.shape[-3], x.shape[-2]
+            s = self._size
+            tw, th = (s, s * h // w) if h > w else (s * w // h, s)
+            return apply_op("resize",
+                            lambda v: _resize_hwc(v, (tw, th)), (x,))
         return apply_op("resize", lambda v: _resize_hwc(v, self._size), (x,))
 
 

@@ -1,0 +1,26 @@
+"""Version / library info (reference: `python/mxnet/libinfo.py` —
+`__version__` and `find_lib_path` for libmxnet.so)."""
+from __future__ import annotations
+
+import os
+
+__all__ = ["__version__", "find_lib_path", "find_include_path"]
+
+__version__ = "2.0.0-tpu"
+
+
+def find_lib_path():
+    """Paths of the native runtime libraries (here: librtio.so and any
+    custom-op extensions under build/) — the libmxnet.so analogue."""
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    build = os.path.join(root, "build")
+    if not os.path.isdir(build):
+        return []
+    return [os.path.join(build, f) for f in sorted(os.listdir(build))
+            if f.endswith(".so")]
+
+
+def find_include_path():
+    """C headers for the extension ABI (reference: include/mxnet)."""
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    return os.path.join(root, "src", "ext")

@@ -390,6 +390,16 @@ class NDArray:
         snap._grad_req = self._grad_req
         return snap
 
+    # -------------------------------------------------------------- dlpack
+    def __dlpack__(self, *args, **kwargs):
+        """DLPack protocol export (reference: `python/mxnet/dlpack.py`);
+        delegates to the underlying immutable jax buffer."""
+        self.wait_to_read()
+        return self._data.__dlpack__(*args, **kwargs)
+
+    def __dlpack_device__(self):
+        return self._data.__dlpack_device__()
+
     # ------------------------------------------------------------- operators
     def _binop(self, name, fn, other, reverse=False):
         a, b = (other, self) if reverse else (self, other)
@@ -525,9 +535,6 @@ class NDArray:
     def __array__(self, dtype=None):
         a = self.asnumpy()
         return a.astype(dtype) if dtype is not None else a
-
-    def __dlpack__(self, stream=None):  # noqa: ARG002
-        return self._data.__dlpack__()
 
     def __repr__(self):
         try:

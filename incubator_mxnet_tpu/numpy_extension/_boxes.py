@@ -474,12 +474,14 @@ def multibox_target(anchor, label, cls_pred, overlap_threshold=0.5,
                               0.0).reshape(-1)
             cls_t = jnp.where(matched, cls[gt_idx] + 1.0, 0.0)
             if negative_mining_ratio > 0:
-                # hard negative mining: unmatched anchors ranked by max
-                # non-background confidence; top-k stay background(0),
-                # the rest are set to ignore_label
+                # hard negative mining: candidates are unmatched anchors
+                # whose best IoU < negative_mining_thresh (the reference's
+                # in-between band [thresh, overlap) is never trained as
+                # background); top-k by max non-background confidence stay
+                # background(0), every other unmatched anchor is ignored
                 conf = scores[1:].max(axis=0) if scores.shape[0] > 1 \
                     else scores[0]
-                neg = ~matched
+                neg = ~matched & (best_iou < negative_mining_thresh)
                 num_pos = matched.sum()
                 k = jnp.maximum(
                     (negative_mining_ratio * num_pos).astype(jnp.int32),
@@ -487,7 +489,7 @@ def multibox_target(anchor, label, cls_pred, overlap_threshold=0.5,
                 neg_conf = jnp.where(neg, conf, -jnp.inf)
                 rank = jnp.argsort(jnp.argsort(-neg_conf))  # 0 = hardest
                 keep_neg = neg & (rank < k)
-                cls_t = jnp.where(neg & ~keep_neg,
+                cls_t = jnp.where(~matched & ~keep_neg,
                                   jnp.float32(ignore_label), cls_t)
             return loc_t, loc_m, cls_t
 

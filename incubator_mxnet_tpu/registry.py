@@ -1,0 +1,71 @@
+"""Generic object registry (reference: `python/mxnet/registry.py` —
+`get_register_func`/`get_create_func`/`get_alias_func`, used by
+initializers, optimizers and lr schedulers for string-config creation)."""
+from __future__ import annotations
+
+import json
+
+__all__ = ["get_register_func", "get_alias_func", "get_create_func"]
+
+_REGISTRIES: dict = {}
+
+
+def _registry(base_class):
+    return _REGISTRIES.setdefault(base_class, {})
+
+
+def get_register_func(base_class, nickname):
+    """Build a @register decorator for `base_class` (`registry.py:38`)."""
+    registry = _registry(base_class)
+
+    def register(klass, name=None):
+        if not issubclass(klass, base_class):
+            raise TypeError(f"{klass} must subclass {base_class}")
+        key = (name or klass.__name__).lower()
+        registry[key] = klass
+        return klass
+
+    register.__name__ = f"register_{nickname}"
+    return register
+
+
+def get_alias_func(base_class, nickname):
+    """Build an @alias("name", ...) decorator (`registry.py:90`)."""
+    register = get_register_func(base_class, nickname)
+
+    def alias(*aliases):
+        def reg(klass):
+            for name in aliases:
+                register(klass, name)
+            return klass
+
+        return reg
+
+    alias.__name__ = f"alias_{nickname}"
+    return alias
+
+
+def get_create_func(base_class, nickname):
+    """Build a create(name_or_instance, **kwargs) factory
+    (`registry.py:120`). Accepts an instance (returned as-is), a name, or
+    a json string ``["name", {kwargs}]``."""
+    registry = _registry(base_class)
+
+    def create(*args, **kwargs):
+        if args and isinstance(args[0], base_class):
+            return args[0]
+        if not args:
+            raise ValueError(f"create_{nickname} needs a name")
+        name, args = args[0], args[1:]
+        if isinstance(name, str) and name.startswith("["):
+            name, cfg = json.loads(name)
+            kwargs = {**cfg, **kwargs}
+        klass = registry.get(str(name).lower())
+        if klass is None:
+            raise ValueError(
+                f"{name!r} is not registered; known {nickname}s: "
+                f"{sorted(registry)}")
+        return klass(*args, **kwargs)
+
+    create.__name__ = f"create_{nickname}"
+    return create

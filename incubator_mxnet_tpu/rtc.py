@@ -10,7 +10,7 @@ is no CUDA on a TPU host.
 """
 from __future__ import annotations
 
-from .ndarray.ndarray import NDArray, apply_op_flat
+from .ndarray.ndarray import apply_op_flat
 
 __all__ = ["CudaModule", "PallasModule"]
 

@@ -1,0 +1,139 @@
+"""callback / engine / dlpack / registry / libinfo parity-module tests."""
+import logging
+import types
+
+import numpy as onp
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import np as mnp
+from incubator_mxnet_tpu.ndarray.ndarray import NDArray
+
+
+def test_speedometer_logs(caplog):
+    sp = mx.callback.Speedometer(batch_size=32, frequent=2, auto_reset=False)
+    from incubator_mxnet_tpu import gluon
+
+    m = gluon.metric.Accuracy()
+    m.update(mnp.array([1]), mnp.array([[0.1, 0.9]]))
+    with caplog.at_level(logging.INFO):
+        for nbatch in range(1, 5):
+            sp(types.SimpleNamespace(epoch=0, nbatch=nbatch, eval_metric=m))
+    assert any("samples/sec" in r.message for r in caplog.records)
+
+
+def test_do_checkpoint_callback(tmp_path):
+    from incubator_mxnet_tpu import sym
+
+    a = sym.Variable("a")
+    net = a * 2.0
+    cb = mx.callback.do_checkpoint(str(tmp_path / "m"), period=2)
+    cb(1, net, {"a": NDArray(onp.ones(2, onp.float32))}, {})
+    s2, arg, _ = mx.model.load_checkpoint(str(tmp_path / "m"), 2)
+    assert s2.list_arguments() == ["a"]
+    onp.testing.assert_array_equal(arg["a"].asnumpy(), onp.ones(2))
+
+
+def test_engine_bulk_scope():
+    prev = mx.engine.set_bulk_size(7)
+    assert mx.engine.set_bulk_size(prev) == 7
+    with mx.engine.bulk(32):
+        x = mnp.ones((4,)) + 1.0  # ops run normally inside the scope
+    onp.testing.assert_array_equal(x.asnumpy(), onp.full(4, 2.0))
+
+
+def test_context_module_alias():
+    assert mx.context.Context is mx.Context
+    assert mx.context.cpu().device_type in ("cpu",)
+    assert mx.context.current_context() is not None
+
+
+def test_executor_module_alias():
+    from incubator_mxnet_tpu.symbol.executor import Executor
+
+    assert mx.executor.Executor is Executor
+
+
+def test_dlpack_roundtrip():
+    x = NDArray(onp.arange(6, dtype=onp.float32).reshape(2, 3))
+    cap = mx.dlpack.to_dlpack_for_read(x)
+    assert cap is not None
+    y = mx.dlpack.from_dlpack(x)  # __dlpack__ protocol object
+    onp.testing.assert_array_equal(y.asnumpy(), x.asnumpy())
+
+
+def test_dlpack_torch_interop():
+    torch = pytest.importorskip("torch")
+    t = torch.arange(12, dtype=torch.float32).reshape(3, 4)
+    nd = mx.dlpack.from_dlpack(t)
+    onp.testing.assert_array_equal(nd.asnumpy(), t.numpy())
+
+
+def test_registry_register_create():
+    class Base:
+        pass
+
+    register = mx.registry.get_register_func(Base, "thing")
+    create = mx.registry.get_create_func(Base, "thing")
+    alias = mx.registry.get_alias_func(Base, "thing")
+
+    @register
+    class Foo(Base):
+        def __init__(self, v=1):
+            self.v = v
+
+    @alias("second")
+    class Bar(Base):
+        pass
+
+    assert isinstance(create("foo", v=3), Foo)
+    assert create("foo", v=3).v == 3
+    assert isinstance(create("second"), Bar)
+    inst = Foo()
+    assert create(inst) is inst
+    assert isinstance(create('["foo", {"v": 9}]'), Foo)
+    with pytest.raises(ValueError, match="not registered"):
+        create("nope")
+    with pytest.raises(TypeError):
+        register(int)
+
+
+def test_resize_keep_ratio_shorter_edge():
+    from incubator_mxnet_tpu import gluon
+
+    t = gluon.data.vision.transforms.Resize(8, keep_ratio=True)
+    x = NDArray(onp.zeros((6, 12, 3), onp.float32))  # H=6 < W=12
+    out = t(x)
+    assert out.shape == (8, 16, 3)  # shorter edge → 8, aspect preserved
+
+
+def test_multibox_mining_threshold_band():
+    from incubator_mxnet_tpu import numpy_extension as npx
+
+    x = mnp.zeros((1, 1, 2, 1))
+    anchors = npx.multibox_prior(x, sizes=[0.5])
+    # anchor 0 = [0,0,1,0.5], anchor 1 = [0,0.5,1,1]; gt [0,0,1,0.6]:
+    # IoU(a0)≈0.83 (forced positive), IoU(a1)≈0.10
+    label = mnp.array(onp.array([[[0.0, 0.0, 0.0, 1.0, 0.6]]], onp.float32))
+    pred = onp.zeros((1, 2, 2), onp.float32)
+    pred[0, 1, 1] = 0.99  # anchor 1 is a confident candidate
+
+    def run(thresh):
+        _, _, cls_t = npx.multibox_target(
+            anchors, label, mnp.array(pred), overlap_threshold=0.9,
+            negative_mining_ratio=3.0, negative_mining_thresh=thresh)
+        return cls_t.asnumpy()[0]
+
+    # thresh above anchor1's IoU → it's a mining candidate → background
+    c = run(0.5)
+    assert c[0] == 1.0 and c[1] == 0.0
+    # thresh below anchor1's IoU → in-between band → ignored
+    c = run(0.05)
+    assert c[0] == 1.0 and c[1] == -1.0
+
+
+def test_libinfo():
+    assert mx.libinfo.__version__.startswith("2.0")
+    libs = mx.libinfo.find_lib_path()
+    assert all(p.endswith(".so") for p in libs)
+    assert mx.libinfo.find_include_path().endswith("ext")
