@@ -22,11 +22,28 @@ class DLDeviceType:
     DLCPUPINNED = 3
 
 
+class _DLPackExport:
+    """Protocol-object export: modern consumers (torch.from_dlpack,
+    np.from_dlpack, jnp.from_dlpack) take objects implementing
+    `__dlpack__`/`__dlpack_device__`, not raw PyCapsules (capsule intake
+    was removed from jax). Pins the source buffer for its lifetime."""
+
+    def __init__(self, buf):
+        self._buf = buf
+
+    def __dlpack__(self, *args, **kwargs):
+        return self._buf.__dlpack__(*args, **kwargs)
+
+    def __dlpack_device__(self):
+        return self._buf.__dlpack_device__()
+
+
 def to_dlpack_for_read(data: NDArray):
-    """Export as a DLPack capsule; the buffer must not be written while
-    the capsule is alive (`dlpack.py:63`)."""
+    """Export for zero-copy consumption by another framework
+    (`dlpack.py:63`); the buffer must not be mutated while the export is
+    alive. Returns a DLPack protocol object (see `_DLPackExport`)."""
     data.wait_to_read()
-    return data._data.__dlpack__()
+    return _DLPackExport(data._data)
 
 
 def to_dlpack_for_write(data: NDArray):
@@ -34,19 +51,18 @@ def to_dlpack_for_write(data: NDArray):
     (`dlpack.py:85`); jax buffers are immutable so the export is identical
     — mutation after export rebinds a fresh buffer and cannot alias."""
     data.wait_to_read()
-    return data._data.__dlpack__()
+    return _DLPackExport(data._data)
 
 
 def from_dlpack(dlpack) -> NDArray:
-    """Wrap a DLPack capsule (or any object with `__dlpack__`) into an
-    NDArray (`dlpack.py:107`)."""
+    """Wrap a DLPack protocol object into an NDArray (`dlpack.py:107`)."""
     import jax
 
     if isinstance(dlpack, NDArray):
         return NDArray(dlpack._data)  # shares the immutable buffer
     if hasattr(dlpack, "__dlpack__"):
         return NDArray(jax.numpy.from_dlpack(dlpack))
-    # raw capsule path
-    from jax import dlpack as jdlpack
-
-    return NDArray(jdlpack.from_dlpack(dlpack))
+    raise TypeError(
+        "from_dlpack: raw PyCapsule intake is not supported by jax; pass "
+        "the source tensor itself (torch/numpy/jax arrays implement "
+        "__dlpack__) or this module's to_dlpack_for_read export")

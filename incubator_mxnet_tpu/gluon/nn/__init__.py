@@ -1,3 +1,7 @@
 from .activations import *  # noqa: F401,F403
 from .basic_layers import *  # noqa: F401,F403
 from .conv_layers import *  # noqa: F401,F403
+from .extra_layers import (  # noqa: F401
+    BatchNormReLU, DeformableConvolution, ModulatedDeformableConvolution,
+    PixelShuffle1D, PixelShuffle2D, PixelShuffle3D, SyncBatchNorm,
+)

@@ -25,25 +25,26 @@ class _ConvCellBase(RecurrentCell):
     def __init__(self, hidden_channels, ngates, kernel_size=(3, 3),
                  input_shape=None, dtype="float32",
                  i2h_weight_initializer=None, h2h_weight_initializer=None,
-                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros"):
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
+                 dims=2):
         super().__init__()
         if isinstance(kernel_size, int):
-            kernel_size = (kernel_size, kernel_size)
+            kernel_size = (kernel_size,) * dims
         self._hidden = hidden_channels
         self._ngates = ngates
         self._kernel = tuple(kernel_size)
-        # (H, W): from input_shape=(C, H, W) if given, else learned on the
-        # first forward
+        # spatial dims from input_shape=(C, *spatial) if given (1-3D),
+        # else learned on the first forward
         self._spatial = (tuple(input_shape[1:])
-                         if input_shape is not None and len(input_shape) >= 3
+                         if input_shape is not None and len(input_shape) >= 2
                          else None)
         in_ch = 0 if input_shape is None else input_shape[0]
-        kh, kw = self._kernel
+        k = self._kernel
         self.i2h_weight = Parameter(
-            shape=(ngates * hidden_channels, in_ch, kh, kw), dtype=dtype,
+            shape=(ngates * hidden_channels, in_ch) + k, dtype=dtype,
             init=i2h_weight_initializer, allow_deferred_init=True)
         self.h2h_weight = Parameter(
-            shape=(ngates * hidden_channels, hidden_channels, kh, kw),
+            shape=(ngates * hidden_channels, hidden_channels) + k,
             dtype=dtype, init=h2h_weight_initializer)
         self.i2h_bias = Parameter(shape=(ngates * hidden_channels,),
                                   dtype=dtype, init=i2h_bias_initializer)
@@ -51,13 +52,12 @@ class _ConvCellBase(RecurrentCell):
                                   dtype=dtype, init=h2h_bias_initializer)
 
     def infer_shape(self, x, *args):
-        kh, kw = self._kernel
-        self.i2h_weight.shape = (self._ngates * self._hidden, x.shape[1],
-                                 kh, kw)
+        self.i2h_weight.shape = (self._ngates * self._hidden,
+                                 x.shape[1]) + self._kernel
         self._spatial = tuple(x.shape[2:])
 
     def state_info(self, batch_size=0):
-        spatial = self._spatial or (0, 0)
+        spatial = self._spatial or (0,) * len(self._kernel)
         return [{"shape": (batch_size, self._hidden) + spatial}]
 
     def begin_state(self, batch_size=0, func=None, **kwargs):
@@ -68,8 +68,7 @@ class _ConvCellBase(RecurrentCell):
         return super().begin_state(batch_size, func, **kwargs)
 
     def _gates(self, x, h):
-        kh, kw = self._kernel
-        pad = (kh // 2, kw // 2)
+        pad = tuple(k // 2 for k in self._kernel)
         n = self._ngates * self._hidden
         i2h = npx.convolution(x, self.i2h_weight.data(),
                               self.i2h_bias.data(), kernel=self._kernel,
@@ -103,7 +102,7 @@ class ConvLSTMCell(_ConvCellBase):
         super().__init__(hidden_channels, 4, kernel_size, **kwargs)
 
     def state_info(self, batch_size=0):
-        spatial = self._spatial or (0, 0)
+        spatial = self._spatial or (0,) * len(self._kernel)
         shape = (batch_size, self._hidden) + spatial
         return [{"shape": shape}, {"shape": shape}]
 
@@ -132,8 +131,9 @@ class ConvGRUCell(_ConvCellBase):
         if self._spatial is None:
             self._spatial = tuple(x.shape[2:])
         h = states[0]
-        kh, kw = self._kernel
-        pad = (kh // 2, kw // 2)
+        # GRU needs i2h/h2h separately (reset gate multiplies h2h only),
+        # so it can't use _gates; padding generalizes over 1-3D kernels
+        pad = tuple(k // 2 for k in self._kernel)
         n = self._ngates * self._hidden
         i2h = npx.convolution(x, self.i2h_weight.data(),
                               self.i2h_bias.data(), kernel=self._kernel,
@@ -147,3 +147,28 @@ class ConvGRUCell(_ConvCellBase):
         nvl = np.tanh(i2h[:, 2 * hc:] + r * h2h[:, 2 * hc:])
         h_new = (1 - z) * nvl + z * h
         return h_new, [h_new]
+
+# Dimensional variants (reference: conv_rnn_cell.py Conv{1,2,3}D{RNN,LSTM,
+# GRU}Cell): the generic cells above are N-d; these fix `dims` and the
+# default kernel so signatures match the reference layer-per-rank classes.
+def _dim_variant(base, dims, name, default_kernel):
+    def __init__(self, hidden_channels, kernel_size=default_kernel,
+                 **kwargs):  # noqa: N807
+        kwargs.setdefault("dims", dims)
+        base.__init__(self, hidden_channels, kernel_size=kernel_size,
+                      **kwargs)
+
+    return type(name, (base,), {"__init__": __init__,
+                                "__doc__": f"{dims}-D {base.__name__} "
+                                           f"(reference conv_rnn_cell.py)"})
+
+
+Conv1DRNNCell = _dim_variant(ConvRNNCell, 1, "Conv1DRNNCell", (3,))
+Conv2DRNNCell = _dim_variant(ConvRNNCell, 2, "Conv2DRNNCell", (3, 3))
+Conv3DRNNCell = _dim_variant(ConvRNNCell, 3, "Conv3DRNNCell", (3, 3, 3))
+Conv1DLSTMCell = _dim_variant(ConvLSTMCell, 1, "Conv1DLSTMCell", (3,))
+Conv2DLSTMCell = _dim_variant(ConvLSTMCell, 2, "Conv2DLSTMCell", (3, 3))
+Conv3DLSTMCell = _dim_variant(ConvLSTMCell, 3, "Conv3DLSTMCell", (3, 3, 3))
+Conv1DGRUCell = _dim_variant(ConvGRUCell, 1, "Conv1DGRUCell", (3,))
+Conv2DGRUCell = _dim_variant(ConvGRUCell, 2, "Conv2DGRUCell", (3, 3))
+Conv3DGRUCell = _dim_variant(ConvGRUCell, 3, "Conv3DGRUCell", (3, 3, 3))

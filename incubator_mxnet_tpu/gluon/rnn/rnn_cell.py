@@ -8,8 +8,9 @@ from ..block import HybridBlock
 from ..parameter import Parameter
 
 __all__ = ["RecurrentCell", "HybridRecurrentCell", "RNNCell", "LSTMCell",
-           "GRUCell", "SequentialRNNCell", "DropoutCell", "ZoneoutCell",
-           "ResidualCell", "BidirectionalCell"]
+           "GRUCell", "SequentialRNNCell", "HybridSequentialRNNCell",
+           "DropoutCell", "ZoneoutCell", "ResidualCell", "BidirectionalCell",
+           "ModifierCell", "VariationalDropoutCell", "LSTMPCell"]
 
 
 class RecurrentCell(HybridBlock):
@@ -31,6 +32,9 @@ class RecurrentCell(HybridBlock):
 
     def unroll(self, length, inputs, begin_state=None, layout="NTC",
                merge_outputs=None, valid_length=None):  # noqa: ARG002
+        # reference RecurrentCell.unroll resets per-sequence state (e.g.
+        # VariationalDropoutCell resamples its masks each sequence)
+        self.reset()
         axis = layout.find("T")
         batch = inputs.shape[layout.find("N")]
         states = begin_state or self.begin_state(batch)
@@ -256,3 +260,114 @@ class BidirectionalCell(RecurrentCell):
 
     def forward(self, x, states):
         raise NotImplementedError("BidirectionalCell supports unroll() only")
+
+
+class ModifierCell(RecurrentCell):
+    """Base for cells wrapping another cell (reference: rnn_cell.py
+    ModifierCell — Dropout/Zoneout/Residual modifiers share it)."""
+
+    def __init__(self, base_cell):
+        super().__init__()
+        base_cell._modified = True
+        self.base_cell = base_cell
+
+    def state_info(self, batch_size=0):
+        return self.base_cell.state_info(batch_size)
+
+    def begin_state(self, batch_size=0, func=None, **kwargs):
+        self.base_cell._modified = False
+        begin = self.base_cell.begin_state(batch_size, func=func, **kwargs)
+        self.base_cell._modified = True
+        return begin
+
+    def forward(self, x, states):
+        raise NotImplementedError
+
+
+class VariationalDropoutCell(ModifierCell):
+    """Same dropout mask reused across time steps (reference: rnn_cell.py
+    VariationalDropoutCell / Gal & Ghahramani 2016)."""
+
+    def __init__(self, base_cell, drop_inputs=0.0, drop_states=0.0,
+                 drop_outputs=0.0):
+        super().__init__(base_cell)
+        self._di = drop_inputs
+        self._ds = drop_states
+        self._do = drop_outputs
+        self._mask_i = None
+        self._mask_s = None
+        self._mask_o = None
+
+    def reset(self):
+        super().reset()
+        self._mask_i = self._mask_s = self._mask_o = None
+
+    def _mask(self, cached, like, rate):
+        from ... import autograd as ag
+
+        if rate == 0.0 or not ag.is_training():
+            return None
+        if cached is None or cached.shape != like.shape:
+            keep = 1.0 - rate
+            cached = (np.random.uniform(0, 1, like.shape) < keep) / keep
+        return cached
+
+    def forward(self, x, states):
+        self._mask_i = self._mask(self._mask_i, x, self._di)
+        if self._mask_i is not None:
+            x = x * self._mask_i
+        if self._ds:
+            self._mask_s = self._mask(self._mask_s, states[0], self._ds)
+            if self._mask_s is not None:
+                states = [states[0] * self._mask_s] + list(states[1:])
+        out, states = self.base_cell(x, states)
+        self._mask_o = self._mask(self._mask_o, out, self._do)
+        if self._mask_o is not None:
+            out = out * self._mask_o
+        return out, states
+
+
+class LSTMPCell(_BaseCell):
+    """LSTM with a hidden-state projection (reference: rnn_cell.py
+    LSTMPCell / Sak et al. 2014 — h = r2h(o·tanh(c)), state h is the
+    projected vector)."""
+
+    def __init__(self, hidden_size, projection_size, input_size=0,
+                 h2r_weight_initializer=None, **kwargs):
+        super().__init__(hidden_size, 4, input_size, **kwargs)
+        self._projection_size = projection_size
+        dtype = kwargs.get("dtype", "float32")
+        # h2h operates on the PROJECTED state: rebuild the parameter with
+        # the projected input width (shape is fixed at Parameter creation)
+        self.h2h_weight = Parameter(
+            shape=(4 * hidden_size, projection_size), dtype=dtype,
+            init=kwargs.get("h2h_weight_initializer"))
+        self.h2r_weight = Parameter(
+            shape=(projection_size, hidden_size), dtype=dtype,
+            init=h2r_weight_initializer)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._projection_size)},
+                {"shape": (batch_size, self._hidden_size)}]
+
+    def forward(self, x, states):
+        h, c = states
+        H = self._hidden_size
+        gates = (npx.fully_connected(x, self.i2h_weight.data(),
+                                     self.i2h_bias.data(), num_hidden=4 * H)
+                 + npx.fully_connected(h, self.h2h_weight.data(),
+                                       self.h2h_bias.data(),
+                                       num_hidden=4 * H))
+        i = npx.sigmoid(gates[:, :H])
+        f = npx.sigmoid(gates[:, H:2 * H])
+        g = np.tanh(gates[:, 2 * H:3 * H])
+        o = npx.sigmoid(gates[:, 3 * H:])
+        c_new = f * c + i * g
+        h_full = o * np.tanh(c_new)
+        h_proj = npx.fully_connected(h_full, self.h2r_weight.data(), None,
+                                     num_hidden=self._projection_size,
+                                     no_bias=True)
+        return h_proj, [h_proj, c_new]
+
+
+HybridSequentialRNNCell = SequentialRNNCell

@@ -226,13 +226,15 @@ def correlation(data1, data2, kernel_size=1, max_displacement=1, stride1=1,
 def deformable_convolution(data, offset, weight, bias=None, kernel=(3, 3),
                            stride=(1, 1), pad=(0, 0), dilate=(1, 1),
                            num_filter=None, num_deformable_group=1,
-                           no_bias=False):
-    """Deformable convolution v1 (reference:
-    `src/operator/contrib/deformable_convolution.cc`).
+                           no_bias=False, mask=None):
+    """Deformable convolution v1/v2 (reference:
+    `src/operator/contrib/deformable_convolution.cc` and
+    `modulated_deformable_convolution.cc`).
 
     data (N, C, H, W); offset (N, 2*G*kh*kw, OH, OW) with interleaved
     (dy, dx) per kernel tap per deformable group G; weight
-    (F, C, kh, kw). Implemented as bilinear im2col at offset positions
+    (F, C, kh, kw); `mask` (N, G*kh*kw, OH, OW), if given, modulates each
+    sampled tap (v2). Implemented as bilinear im2col at offset positions
     followed by ONE (F, C*kh*kw) × (C*kh*kw, OH*OW) MXU matmul per image."""
     def fn(x, off, wgt, *maybe_bias):
         jnp = _jnp()
@@ -266,31 +268,47 @@ def deformable_convolution(data, offset, weight, bias=None, kernel=(3, 3),
             .reshape(1, 1, kh * kw)
         tap_x = jnp.tile(jnp.arange(kw) * dw, kh).reshape(1, 1, kh * kw)
 
-        def one(img, offs):
+        def one(img, offs, mk):
             # offs (2*G*kh*kw, OH, OW) → (G, kh*kw, OH, OW, 2)
             o = offs.reshape(g, kh * kw, 2, oh, ow)
             dy = o[:, :, 0].transpose(0, 2, 3, 1)  # (G, OH, OW, K)
             dx = o[:, :, 1].transpose(0, 2, 3, 1)
             sy = base_y + tap_y + dy          # (G, OH, OW, K)
             sx = base_x + tap_x + dx
+            if mk is not None:                # (G*kh*kw, OH, OW)
+                mods = mk.reshape(g, kh * kw, oh, ow) \
+                    .transpose(0, 2, 3, 1)    # (G, OH, OW, K)
             cols = []
             for gi in range(g):
                 grp = img[gi * cg:(gi + 1) * cg]  # (cg, H, W)
-                cols.append(_bilinear_nchw(grp, sy[gi], sx[gi],
-                                           padding="zero"))
+                sampled = _bilinear_nchw(grp, sy[gi], sx[gi],
+                                         padding="zero")
+                if mk is not None:
+                    sampled = sampled * mods[gi][None]  # modulate taps (v2)
+                cols.append(sampled)
             col = jnp.concatenate(cols, 0)        # (C, OH, OW, K)
             col = col.transpose(0, 3, 1, 2).reshape(c * kh * kw, oh * ow)
             out = wgt.reshape(f, c * kh * kw) @ col
             return out.reshape(f, oh, ow)
 
-        y = jax.vmap(one)(x, off)
-        if maybe_bias and not no_bias:
-            y = y + maybe_bias[0].reshape(1, f, 1, 1)
+        if has_mask:
+            mk_batch = maybe_bias[-1]
+            bias_vals = maybe_bias[:-1]
+            y = jax.vmap(one)(x, off, mk_batch)
+        else:
+            bias_vals = maybe_bias
+            y = jax.vmap(lambda i, o: one(i, o, None))(x, off)
+        if bias_vals and not no_bias:
+            y = y + bias_vals[0].reshape(1, f, 1, 1)
         return y
 
-    args = (data, offset, weight) if bias is None or no_bias \
-        else (data, offset, weight, bias)
-    return apply_op_flat("deformable_convolution", fn, args, {})
+    has_mask = mask is not None
+    args = [data, offset, weight]
+    if bias is not None and not no_bias:
+        args.append(bias)
+    if has_mask:
+        args.append(mask)
+    return apply_op_flat("deformable_convolution", fn, tuple(args), {})
 
 
 def fft(data, compute_size=None):  # noqa: ARG001

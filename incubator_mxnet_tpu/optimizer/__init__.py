@@ -1,7 +1,8 @@
 from .optimizer import (  # noqa: F401
     Optimizer, create, register,
     SGD, NAG, Adam, AdamW, AdaBelief, AdaDelta, AdaGrad, Adamax, DCASGD,
-    FTML, FTRL, LAMB, LANS, LARS, Nadam, RMSProp, SGLD, Signum,
+    FTML, FTRL, Ftrl, GroupAdaGrad, LAMB, LANS, LARS, Nadam, RMSProp,
+    SGLD, Signum,
     Updater, get_updater,
 )
 from ..lr_scheduler import (  # noqa: F401

@@ -637,5 +637,41 @@ class Updater:
         }
 
 
+@register
+class GroupAdaGrad(Optimizer):
+    """AdaGrad with ONE accumulated scalar history per output row
+    (reference: `python/mxnet/optimizer/contrib.py` GroupAdaGrad /
+    `src/operator/contrib/optimizer_op.cc` — designed for embedding
+    tables: history (V, 1) instead of (V, D))."""
+
+    def __init__(self, learning_rate=0.01, epsilon=1e-5, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.epsilon = epsilon
+
+    def create_state(self, index, weight):
+        import jax.numpy as jnp
+
+        if weight._data.ndim < 2:
+            return [_zeros_like(weight._data)]
+        return [jnp.zeros(weight.shape[:1] + (1,) * (weight._data.ndim - 1),
+                          weight._data.dtype)]
+
+    def step(self, w, g, state, lr, wd, t):  # noqa: ARG002
+        jnp = _jnp()
+        g, wd = self._preprocess(g, w, wd)
+        g = g + wd * w
+        if w.ndim < 2:
+            hist = state[0] + g * g
+        else:
+            hist = state[0] + (g * g).mean(
+                axis=tuple(range(1, g.ndim)), keepdims=True)
+        return w - lr * g / (jnp.sqrt(hist) + self.epsilon), [hist]
+
+
+# reference 2.0 class name (optimizer/ftrl.py); @register on FTRL already
+# mapped the "ftrl" key
+Ftrl = FTRL
+
+
 def get_updater(optimizer):
     return Updater(optimizer)

@@ -1,0 +1,168 @@
+"""PixelShuffle/SyncBN/deformable layers + new RNN cells + GroupAdaGrad
+tests (reference model: tests/python/unittest/test_gluon.py +
+test_contrib_operator.py)."""
+import numpy as onp
+import pytest
+
+from incubator_mxnet_tpu import autograd, gluon, optimizer
+from incubator_mxnet_tpu import np as mnp
+from incubator_mxnet_tpu.ndarray.ndarray import NDArray
+
+nn = gluon.nn
+rnn = gluon.rnn
+
+
+def A(x):
+    return x.asnumpy() if hasattr(x, "asnumpy") else onp.asarray(x)
+
+
+def rand(*s, seed=0):
+    return onp.random.RandomState(seed).randn(*s).astype(onp.float32)
+
+
+def test_pixel_shuffle_2d_golden():
+    # factor 2: channel c*4+2*dy+dx lands at spatial (2y+dy, 2x+dx)
+    x = onp.zeros((1, 4, 1, 1), onp.float32)
+    x[0, :, 0, 0] = [1, 2, 3, 4]
+    out = A(nn.PixelShuffle2D(2)(NDArray(x)))
+    onp.testing.assert_array_equal(out[0, 0], [[1, 2], [3, 4]])
+
+
+def test_pixel_shuffle_roundtrip_shapes():
+    assert nn.PixelShuffle1D(3)(NDArray(rand(2, 6, 5))).shape == (2, 2, 15)
+    assert nn.PixelShuffle2D((2, 3))(
+        NDArray(rand(2, 12, 4, 4))).shape == (2, 2, 8, 12)
+    assert nn.PixelShuffle3D(2)(
+        NDArray(rand(1, 8, 2, 2, 2))).shape == (1, 1, 4, 4, 4)
+
+
+def test_batchnorm_relu():
+    bn = nn.BatchNormReLU()
+    bn.initialize()
+    with autograd.record():
+        y = bn(NDArray(rand(8, 4, 3, 3)))
+    assert float(A(y).min()) >= 0.0
+
+
+def test_sync_batchnorm_matches_batchnorm():
+    x = rand(16, 3, 4, 4, seed=3)
+    bn, sbn = nn.BatchNorm(), nn.SyncBatchNorm(num_devices=8)
+    bn.initialize()
+    sbn.initialize()
+    with autograd.record():
+        a = bn(NDArray(x))
+    with autograd.record():
+        b = sbn(NDArray(x))
+    onp.testing.assert_allclose(A(a), A(b), rtol=1e-5, atol=1e-5)
+
+
+def test_deformable_layer_zero_offsets_equals_conv():
+    dc = nn.DeformableConvolution(5, (3, 3), padding=(1, 1), use_bias=False)
+    dc.initialize()
+    x = NDArray(rand(2, 3, 8, 8))
+    out = A(dc(x))
+    conv = nn.Conv2D(5, (3, 3), padding=(1, 1), use_bias=False)
+    conv.initialize()
+    conv(x)
+    conv.weight.set_data(dc.weight.data())
+    onp.testing.assert_allclose(out, A(conv(x)), rtol=2e-2, atol=2e-2)
+
+
+def test_modulated_deformable_layer_grad_flows():
+    mdc = nn.ModulatedDeformableConvolution(4, (3, 3), padding=(1, 1))
+    mdc.initialize()
+    x = NDArray(rand(1, 2, 6, 6))
+    with autograd.record():
+        loss = mdc(x).sum()
+    loss.backward()
+    g = mdc.weight.grad()
+    assert float(onp.abs(A(g)).sum()) > 0
+
+
+def test_conv_dim_cells():
+    # every rank × every cell type (1D/3D GRU hit the non-_gates path)
+    for cell_cls, cshape, xshape in [
+            (rnn.Conv1DRNNCell, (2, 8), (3, 2, 8)),
+            (rnn.Conv1DLSTMCell, (2, 8), (3, 2, 8)),
+            (rnn.Conv1DGRUCell, (2, 8), (3, 2, 8)),
+            (rnn.Conv2DGRUCell, (2, 4, 4), (3, 2, 4, 4)),
+            (rnn.Conv2DLSTMCell, (2, 4, 4), (3, 2, 4, 4)),
+            (rnn.Conv3DRNNCell, (1, 3, 3, 3), (2, 1, 3, 3, 3)),
+            (rnn.Conv3DLSTMCell, (1, 3, 3, 3), (2, 1, 3, 3, 3)),
+            (rnn.Conv3DGRUCell, (1, 3, 3, 3), (2, 1, 3, 3, 3))]:
+        cell = cell_cls(4, input_shape=cshape)
+        cell.initialize()
+        x = NDArray(rand(*xshape))
+        out, states = cell(x, cell.begin_state(xshape[0]))
+        assert out.shape[0] == xshape[0] and out.shape[1] == 4
+        # state_info rank matches the actual state rank pre-forward
+        fresh = cell_cls(4, input_shape=cshape)
+        info = fresh.state_info(2)
+        assert len(info[0]["shape"]) == len(cshape) + 1
+
+
+def test_variational_dropout_resamples_per_unroll():
+    import incubator_mxnet_tpu.autograd as ag
+
+    cell = rnn.VariationalDropoutCell(rnn.RNNCell(6, input_size=6),
+                                      drop_inputs=0.5)
+    cell.initialize()
+    x = NDArray(onp.ones((2, 4, 6), onp.float32))
+    with ag.record(train_mode=True):
+        cell.unroll(4, x)
+        m1 = A(cell._mask_i)
+        cell.unroll(4, x)
+        m2 = A(cell._mask_i)
+    assert not onp.array_equal(m1, m2)  # new mask per sequence
+
+
+def test_lstmp_cell_projection():
+    cell = rnn.LSTMPCell(16, 5, input_size=7)
+    cell.initialize()
+    x = NDArray(rand(4, 7))
+    out, states = cell(x, cell.begin_state(4))
+    assert out.shape == (4, 5)
+    assert states[0].shape == (4, 5) and states[1].shape == (4, 16)
+    out2, _ = cell.unroll(3, NDArray(rand(4, 3, 7)))
+    assert out2.shape == (4, 3, 5)
+
+
+def test_variational_dropout_same_mask_across_steps():
+    import incubator_mxnet_tpu.autograd as ag
+
+    base = rnn.RNNCell(6, input_size=6)
+    cell = rnn.VariationalDropoutCell(base, drop_inputs=0.5)
+    cell.initialize()
+    x = NDArray(onp.ones((2, 6), onp.float32))
+    with ag.record(train_mode=True):
+        cell(x, cell.begin_state(2))
+        m1 = cell._mask_i
+        cell(x, cell.begin_state(2))
+        m2 = cell._mask_i
+    assert m1 is not None
+    onp.testing.assert_array_equal(A(m1), A(m2))  # mask reused
+    cell.reset()
+    assert cell._mask_i is None
+
+
+def test_modifier_cell_state_info():
+    base = rnn.LSTMCell(8, input_size=4)
+    mod = rnn.VariationalDropoutCell(base)
+    assert mod.state_info(2) == base.state_info(2)
+    assert isinstance(mod, rnn.ModifierCell)
+
+
+def test_group_adagrad():
+    opt = optimizer.create("groupadagrad", learning_rate=0.1)
+    w = NDArray(rand(6, 4, seed=1))
+    g = NDArray(rand(6, 4, seed=2))
+    state = opt.create_state(0, w)
+    assert state[0].shape == (6, 1)  # one history scalar per row
+    w2, state2 = opt.step(w._data, g._data, state, 0.1, 0.0, 1)
+    assert w2.shape == (6, 4)
+    assert float(onp.abs(onp.asarray(state2[0])).sum()) > 0
+
+
+def test_ftrl_alias():
+    assert optimizer.Ftrl is optimizer.FTRL
+    assert isinstance(optimizer.create("ftrl"), optimizer.FTRL)

@@ -56,10 +56,14 @@ def test_executor_module_alias():
 
 def test_dlpack_roundtrip():
     x = NDArray(onp.arange(6, dtype=onp.float32).reshape(2, 3))
-    cap = mx.dlpack.to_dlpack_for_read(x)
-    assert cap is not None
-    y = mx.dlpack.from_dlpack(x)  # __dlpack__ protocol object
+    # the reference pattern: from_dlpack(to_dlpack_for_read(x))
+    y = mx.dlpack.from_dlpack(mx.dlpack.to_dlpack_for_read(x))
     onp.testing.assert_array_equal(y.asnumpy(), x.asnumpy())
+    # numpy can consume the export too
+    z = onp.from_dlpack(mx.dlpack.to_dlpack_for_write(x))
+    onp.testing.assert_array_equal(z, x.asnumpy())
+    with pytest.raises(TypeError, match="PyCapsule"):
+        mx.dlpack.from_dlpack(object())
 
 
 def test_dlpack_torch_interop():
