@@ -16,12 +16,6 @@ __all__ = ["PixelShuffle1D", "PixelShuffle2D", "PixelShuffle3D",
            "ModulatedDeformableConvolution"]
 
 
-def _jnp():
-    import jax.numpy as jnp
-
-    return jnp
-
-
 class PixelShuffle1D(HybridBlock):
     """(N, C·f, W) → (N, C, W·f) sub-pixel upsampling (reference:
     conv_layers.py PixelShuffle1D)."""
@@ -34,7 +28,6 @@ class PixelShuffle1D(HybridBlock):
         f = self._factor
 
         def fn(v):
-            jnp = _jnp()
             n, cf, w = v.shape
             c = cf // f
             return v.reshape(n, c, f, w).transpose(0, 1, 3, 2) \
@@ -56,7 +49,6 @@ class PixelShuffle2D(HybridBlock):
         f1, f2 = self._factors
 
         def fn(v):
-            jnp = _jnp()
             n, c_all, h, w = v.shape
             c = c_all // (f1 * f2)
             v = v.reshape(n, c, f1, f2, h, w)
@@ -79,7 +71,6 @@ class PixelShuffle3D(HybridBlock):
         f1, f2, f3 = self._factors
 
         def fn(v):
-            jnp = _jnp()
             n, c_all, d, h, w = v.shape
             c = c_all // (f1 * f2 * f3)
             v = v.reshape(n, c, f1, f2, f3, d, h, w)

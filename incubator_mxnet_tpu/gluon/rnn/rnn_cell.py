@@ -19,7 +19,17 @@ class RecurrentCell(HybridBlock):
         self._modified = False
 
     def reset(self):
+        """Clear per-sequence state, recursing into child cells (the
+        reference reset() walks _children so wrapped/stacked modifier
+        cells resample their masks etc. each sequence)."""
         self._modified = False
+        for child in self._children.values():
+            if isinstance(child, RecurrentCell):
+                child.reset()
+        for attr in ("base_cell",):
+            inner = getattr(self, attr, None)
+            if isinstance(inner, RecurrentCell):
+                inner.reset()
 
     def state_info(self, batch_size=0):
         raise NotImplementedError
@@ -192,6 +202,10 @@ class ZoneoutCell(RecurrentCell):
         self._zo = zoneout_outputs
         self._zs = zoneout_states
         self._prev_output = None
+
+    def reset(self):
+        super().reset()
+        self._prev_output = None  # don't zone out toward a past sequence
 
     def state_info(self, batch_size=0):
         return self.base_cell.state_info(batch_size)

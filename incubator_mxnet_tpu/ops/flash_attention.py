@@ -358,20 +358,63 @@ def _flash_core_bwd(sm_scale, causal, block_q, block_k, interpret, need_mask,
 _flash_core.defvjp(_flash_core_fwd, _flash_core_bwd)
 
 
+# attention matrices up to this many bytes take the XLA path under
+# impl="auto" — XLA's own fusion pipeline is flash-like and measured
+# faster than the pallas kernel on-chip (T=4096 f32: ~12 ms vs ~15 ms;
+# T=16384: ~76 ms vs ~3.3 s); past the cliff XLA fails to compile the
+# T² buffer (T=32768 f32 → 34 GB) and the streaming pallas kernel is
+# the only option.
+_XLA_ATTN_BYTES_LIMIT = 2 << 30
+
+
+def _xla_attention(q, k, v, lengths, causal, sm_scale):
+    """Same semantics as the pallas kernel, expressed as plain jnp ops —
+    XLA fuses the softmax(QKᵀ)V pipeline itself."""
+    b, h, tq, d = q.shape
+    tk = k.shape[2]
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * sm_scale
+    neg = jnp.asarray(-1e30, s.dtype)
+    if causal:
+        mask = jnp.tril(jnp.ones((tq, tk), bool))
+        s = jnp.where(mask, s, neg)
+    if lengths is not None:
+        lens = jnp.asarray(lengths, jnp.int32).reshape(b)
+        kmask = jnp.arange(tk)[None, :] < lens[:, None]      # (B, Tk)
+        s = jnp.where(kmask[:, None, None, :], s, neg)
+    p = jax.nn.softmax(s, axis=-1)
+    o = jnp.einsum("bhqk,bhkd->bhqd", p, v)
+    if lengths is not None:
+        qmask = jnp.arange(tq)[None, :] < lens[:, None]      # (B, Tq)
+        o = jnp.where(qmask[:, None, :, None], o, 0.0)
+    return o
+
+
 def flash_attention(q, k, v, lengths=None, causal=False, sm_scale=None,
-                    block_q=512, block_k=512, interpret=None):
+                    block_q=512, block_k=512, interpret=None, impl="auto"):
     """Fused scaled-dot-product attention over (B, H, T, D) tensors.
 
     - `lengths`: optional (B,) int32 valid sequence lengths (key padding AND
       query-row masking, self-attention semantics — the flash replacement
       for `npx.masked_softmax` with a valid_length mask).
     - `causal`: lower-triangular masking for decoder/LM use.
-    - Differentiable via flash backward kernels (custom_vjp).
+    - `impl`: "auto" picks the XLA-fused path while the T² attention
+      matrix fits (see `_XLA_ATTN_BYTES_LIMIT`) and the O(T)-memory
+      pallas streaming kernel beyond; "xla"/"pallas" force a path.
+    - Differentiable on both paths (pallas via custom_vjp backward
+      kernels, XLA via ordinary autodiff of the fused graph).
     """
     b, h, tq, d = q.shape
-    tk = k.shape[2]
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(d)
+    if impl == "auto":
+        attn_bytes = b * h * tq * k.shape[2] * jnp.dtype(q.dtype).itemsize
+        impl = "xla" if attn_bytes <= _XLA_ATTN_BYTES_LIMIT else "pallas"
+    if impl == "xla":
+        return _xla_attention(q, k, v, lengths, bool(causal),
+                              float(sm_scale))
+    if impl != "pallas":
+        raise ValueError(f"flash_attention: unknown impl {impl!r}")
+    tk = k.shape[2]
     if interpret is None:
         interpret = _interpret_default()
 

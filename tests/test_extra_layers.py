@@ -166,3 +166,37 @@ def test_group_adagrad():
 def test_ftrl_alias():
     assert optimizer.Ftrl is optimizer.FTRL
     assert isinstance(optimizer.create("ftrl"), optimizer.FTRL)
+
+
+def test_nested_modifier_reset_recurses():
+    """reset() must reach wrapped cells (reference reset walks children):
+    a VariationalDropoutCell inside a SequentialRNNCell resamples its
+    mask every unroll."""
+    import incubator_mxnet_tpu.autograd as ag
+
+    seq = rnn.SequentialRNNCell()
+    inner = rnn.VariationalDropoutCell(rnn.RNNCell(6, input_size=6),
+                                       drop_inputs=0.5)
+    seq.add(inner)
+    seq.initialize()
+    x = NDArray(onp.ones((2, 4, 6), onp.float32))
+    with ag.record(train_mode=True):
+        seq.unroll(4, x)
+        m1 = A(inner._mask_i)
+        seq.unroll(4, x)
+        m2 = A(inner._mask_i)
+    assert not onp.array_equal(m1, m2)
+
+
+def test_zoneout_reset_clears_prev_output():
+    import incubator_mxnet_tpu.autograd as ag
+
+    cell = rnn.ZoneoutCell(rnn.RNNCell(4, input_size=4),
+                           zoneout_outputs=0.5)
+    cell.initialize()
+    x = NDArray(onp.ones((2, 3, 4), onp.float32))
+    with ag.record(train_mode=True):
+        cell.unroll(3, x)
+        assert cell._prev_output is not None
+    cell.reset()
+    assert cell._prev_output is None
