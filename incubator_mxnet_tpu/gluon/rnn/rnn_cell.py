@@ -23,13 +23,11 @@ class RecurrentCell(HybridBlock):
         reference reset() walks _children so wrapped/stacked modifier
         cells resample their masks etc. each sequence)."""
         self._modified = False
+        # base_cell/wrapped cells are Block attributes, so they are all
+        # auto-registered in _children — one walk covers every nesting
         for child in self._children.values():
             if isinstance(child, RecurrentCell):
                 child.reset()
-        for attr in ("base_cell",):
-            inner = getattr(self, attr, None)
-            if isinstance(inner, RecurrentCell):
-                inner.reset()
 
     def state_info(self, batch_size=0):
         raise NotImplementedError

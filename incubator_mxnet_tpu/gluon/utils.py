@@ -4,7 +4,7 @@ from __future__ import annotations
 from ..ndarray.ndarray import NDArray
 
 __all__ = ["split_data", "split_and_load", "clip_global_norm", "download",
-           "check_sha1"]
+           "check_sha1", "shape_is_known", "split_rnn_params"]
 
 
 def split_data(data, num_slice, batch_axis=0, even_split=True):
@@ -63,3 +63,53 @@ def download(url, path=None, overwrite=False, sha1_hash=None, retries=5,
     raise RuntimeError(
         "download() is unavailable: this environment has no network egress. "
         "Place files locally and pass their path instead.")
+
+
+def shape_is_known(shape):
+    """True when a shape tuple has no unknown (0/-1/None) dims
+    (reference: gluon/utils.py shape_is_known)."""
+    if shape is None:
+        return False
+    shape = (shape,) if isinstance(shape, int) else tuple(shape)
+    return all(s is not None and s > 0 for s in shape)
+
+
+def split_rnn_params(params, mode, num_layers, input_size, hidden_size,
+                     bidirectional=False):
+    """Split a packed fused-RNN parameter vector into the per-layer
+    i2h/h2h weight/bias dict (reference: gluon/utils.py
+    split_rnn_params over the fused RNN op's packed layout)."""
+    import numpy as _onp
+
+    gates = {"rnn_relu": 1, "rnn_tanh": 1, "lstm": 4, "gru": 3}[mode]
+    dirs = 2 if bidirectional else 1
+    flat = params.asnumpy().reshape(-1) if isinstance(params, NDArray) \
+        else _onp.asarray(params).reshape(-1)
+    out, pos = {}, 0
+
+    def take(n, shape):
+        nonlocal pos
+        v = flat[pos:pos + n].reshape(shape)
+        pos += n
+        return NDArray(v)
+
+    gh = gates * hidden_size
+    for layer in range(num_layers):
+        for d in range(dirs):
+            suffix = "_r" if d else ""
+            in_sz = input_size if layer == 0 else hidden_size * dirs
+            out[f"l{layer}{suffix}_i2h_weight"] = take(gh * in_sz,
+                                                       (gh, in_sz))
+            out[f"l{layer}{suffix}_h2h_weight"] = take(gh * hidden_size,
+                                                       (gh, hidden_size))
+    for layer in range(num_layers):
+        for d in range(dirs):
+            suffix = "_r" if d else ""
+            out[f"l{layer}{suffix}_i2h_bias"] = take(gh, (gh,))
+            out[f"l{layer}{suffix}_h2h_bias"] = take(gh, (gh,))
+    if pos != flat.size:
+        raise ValueError(
+            f"split_rnn_params: packed vector has {flat.size} elements but "
+            f"the {mode} layout consumes {pos}; check mode/num_layers/"
+            f"input_size/hidden_size")
+    return out

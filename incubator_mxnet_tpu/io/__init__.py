@@ -1,4 +1,4 @@
 from .io import (  # noqa: F401
-    CSVIter, DataBatch, DataDesc, DataIter, LibSVMIter, NDArrayIter,
-    ResizeIter,
+    CSVIter, DataBatch, DataDesc, DataIter, ImageRecordIter, LibSVMIter,
+    MNISTIter, NDArrayIter, ResizeIter,
 )

@@ -10,6 +10,7 @@ import numpy as onp
 from ..ndarray.ndarray import NDArray
 
 __all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter",
+           "ImageRecordIter", "MNISTIter",
            "ResizeIter", "CSVIter", "LibSVMIter"]
 
 
@@ -260,3 +261,60 @@ class LibSVMIter(NDArrayIter):
 
         d = self.data[0][1]
         return csr_matrix(d.asnumpy().reshape(d.shape[0], -1))
+
+
+def ImageRecordIter(path_imgrec=None, data_shape=None, batch_size=1,  # noqa: N802
+                    shuffle=False, rand_crop=False, rand_mirror=False,
+                    mean_r=0.0, mean_g=0.0, mean_b=0.0, std_r=1.0,
+                    std_g=1.0, std_b=1.0, resize=-1, label_width=1,
+                    preprocess_threads=4, prefetch_buffer=2,
+                    part_index=0, num_parts=1, **kwargs):
+    """Reference C++ registered iterator facade (reference:
+    `src/io/iter_image_recordio_2.cc:890` `MXNET_REGISTER_IO_ITER(
+    ImageRecordIter)`): builds the equivalent `image.ImageIter` with the
+    matching augmenters over the host decode pool + prefetcher."""
+    from ..image import CreateAugmenter, ImageIter
+
+    if data_shape is None:
+        raise ValueError("ImageRecordIter: data_shape required")
+    mean = None
+    if mean_r or mean_g or mean_b:
+        mean = onp.array([mean_r, mean_g, mean_b], onp.float32)
+    std = None
+    if (std_r, std_g, std_b) != (1.0, 1.0, 1.0):
+        std = onp.array([std_r, std_g, std_b], onp.float32)
+    aug = CreateAugmenter(
+        data_shape, resize=resize if resize > 0 else 0,
+        rand_crop=rand_crop, rand_mirror=rand_mirror, mean=mean, std=std)
+    del preprocess_threads  # ImageIter sizes its decode pool internally
+    return ImageIter(batch_size=batch_size, data_shape=data_shape,
+                     label_width=label_width, path_imgrec=path_imgrec,
+                     shuffle=shuffle, aug_list=aug,
+                     part_index=part_index, num_parts=num_parts,
+                     prefetch=prefetch_buffer, **kwargs)
+
+
+def MNISTIter(image=None, label=None, batch_size=1, shuffle=False,  # noqa: N802
+              flat=False, seed=0, **kwargs):  # noqa: ARG001
+    """Reference MNISTIter facade (reference: `src/io/iter_mnist.cc:257`):
+    reads the idx-format files into one NDArrayIter."""
+    import gzip
+    import struct as _struct
+
+    def read_idx(path):
+        op = gzip.open if path.endswith(".gz") else open
+        with op(path, "rb") as f:
+            magic = _struct.unpack(">HBB", f.read(4))
+            ndim = magic[2]
+            dims = _struct.unpack(">" + "I" * ndim, f.read(4 * ndim))
+            return onp.frombuffer(f.read(), onp.uint8).reshape(dims)
+
+    if image is None or label is None:
+        raise ValueError("MNISTIter: image and label paths required")
+    x = read_idx(image).astype(onp.float32) / 255.0
+    y = read_idx(label).astype(onp.float32)
+    x = x.reshape(x.shape[0], -1) if flat else x[:, None]
+    if shuffle:
+        perm = onp.random.RandomState(seed).permutation(len(x))
+        x, y = x[perm], y[perm]
+    return NDArrayIter(data=x, label=y, batch_size=batch_size)

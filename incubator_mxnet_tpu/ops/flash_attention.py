@@ -373,7 +373,10 @@ def _xla_attention(q, k, v, lengths, causal, sm_scale):
     b, h, tq, d = q.shape
     tk = k.shape[2]
     s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * sm_scale
-    neg = jnp.asarray(-1e30, s.dtype)
+    # finite mask constant in the score dtype: -1e30 would overflow f16/
+    # bf16 to -inf and give NaN softmax rows (and NaN grads) on padded
+    # sequences — same finite-NEG_INF discipline as the pallas kernel
+    neg = jnp.asarray(jnp.finfo(s.dtype).min / 2, s.dtype)
     if causal:
         mask = jnp.tril(jnp.ones((tq, tk), bool))
         s = jnp.where(mask, s, neg)

@@ -1,0 +1,109 @@
+"""io facade tests: ImageRecordIter / MNISTIter / gluon.utils parity."""
+import gzip
+import struct
+
+import numpy as onp
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import image, recordio
+
+
+def _make_rec(tmp_path, n=10, size=12):
+    path = str(tmp_path / "imgs.rec")
+    w = recordio.MXRecordIO(path, "w")
+    rng = onp.random.RandomState(0)
+    for i in range(n):
+        img = rng.randint(0, 255, (size, size, 3)).astype(onp.uint8)
+        w.write(recordio.pack(recordio.IRHeader(0, float(i % 3), i, 0),
+                              image.imencode(img)))
+    w.close()
+    return path
+
+
+def test_image_record_iter(tmp_path):
+    rec = _make_rec(tmp_path)
+    it = mx.io.ImageRecordIter(path_imgrec=rec, data_shape=(3, 8, 8),
+                               batch_size=5, shuffle=True,
+                               rand_mirror=True, mean_r=0.5)
+    batch = next(iter(it))
+    data = batch.data[0] if isinstance(batch.data, (list, tuple)) \
+        else batch.data
+    assert tuple(data.shape) == (5, 3, 8, 8)
+
+
+def test_mnist_iter(tmp_path):
+    rng = onp.random.RandomState(0)
+    imgs = rng.randint(0, 255, (20, 28, 28)).astype(onp.uint8)
+    labels = rng.randint(0, 10, (20,)).astype(onp.uint8)
+    img_path = str(tmp_path / "train-images-idx3-ubyte.gz")
+    lbl_path = str(tmp_path / "train-labels-idx1-ubyte.gz")
+    with gzip.open(img_path, "wb") as f:
+        f.write(struct.pack(">HBB", 0, 8, 3))
+        f.write(struct.pack(">III", 20, 28, 28))
+        f.write(imgs.tobytes())
+    with gzip.open(lbl_path, "wb") as f:
+        f.write(struct.pack(">HBB", 0, 8, 1))
+        f.write(struct.pack(">I", 20))
+        f.write(labels.tobytes())
+    it = mx.io.MNISTIter(image=img_path, label=lbl_path, batch_size=4,
+                         flat=True)
+    batch = it.next()
+    assert tuple(batch.data[0].shape) == (4, 784)
+    onp.testing.assert_allclose(batch.data[0].asnumpy()[0],
+                                imgs[0].reshape(-1) / 255.0, rtol=1e-6)
+    onp.testing.assert_allclose(batch.label[0].asnumpy(),
+                                labels[:4].astype(onp.float32))
+
+
+def test_mnist_iter_missing_args():
+    with pytest.raises(ValueError):
+        mx.io.MNISTIter(batch_size=4)
+
+
+def test_shape_is_known():
+    gu = mx.gluon.utils
+    assert gu.shape_is_known((2, 3))
+    assert not gu.shape_is_known((2, 0))
+    assert not gu.shape_is_known(None)
+    assert gu.shape_is_known(5)
+
+
+def test_split_rnn_params_lstm():
+    gu = mx.gluon.utils
+    H, I = 3, 2
+    n = 4 * H * I + 4 * H * H + 8 * H  # 1-layer lstm packed size
+    params = onp.arange(n, dtype=onp.float32)
+    out = gu.split_rnn_params(mx.nd.array(params), "lstm", 1, I, H)
+    assert out["l0_i2h_weight"].shape == (4 * H, I)
+    assert out["l0_h2h_weight"].shape == (4 * H, H)
+    assert out["l0_i2h_bias"].shape == (4 * H,)
+    # packed order: weights first then biases (fused rnn layout)
+    onp.testing.assert_array_equal(
+        out["l0_i2h_weight"].asnumpy().reshape(-1),
+        params[:4 * H * I])
+
+
+def test_split_rnn_params_size_mismatch_raises():
+    gu = mx.gluon.utils
+    params = onp.zeros(999, onp.float32)
+    with pytest.raises(ValueError, match="consumes"):
+        gu.split_rnn_params(mx.nd.array(params), "lstm", 1, 2, 3)
+
+
+def test_xla_attention_f16_padded_grads_finite():
+    import jax
+    import jax.numpy as jnp
+    import importlib
+
+    fa = importlib.import_module("incubator_mxnet_tpu.ops.flash_attention")
+    q = jnp.asarray(onp.random.RandomState(0)
+                    .randn(1, 1, 16, 8).astype(onp.float16))
+    lens = jnp.asarray([10], jnp.int32)
+
+    def loss(x):
+        return fa.flash_attention(x, x, x, lengths=lens,
+                                  impl="xla").astype(jnp.float32).sum()
+
+    g = jax.grad(loss)(q)
+    assert bool(jnp.isfinite(g.astype(jnp.float32)).all())
