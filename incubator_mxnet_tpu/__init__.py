@@ -22,6 +22,18 @@ __version__ = "0.1.0"
 # `mx.kv.create('dist_*')`, SURVEY.md §3.5).
 import os as _os
 
+# Memory-reserve knob must be forwarded BEFORE anything can initialize the
+# XLA backend (profiler autostart, dist rendezvous below) — once a client
+# exists, XLA_PYTHON_CLIENT_MEM_FRACTION is read-only (SURVEY §5.6).
+if _os.environ.get("MXNET_GPU_MEM_POOL_RESERVE") and \
+        "XLA_PYTHON_CLIENT_MEM_FRACTION" not in _os.environ:
+    try:
+        _frac = max(0.0, min(
+            1.0, 1.0 - float(_os.environ["MXNET_GPU_MEM_POOL_RESERVE"]) / 100.0))
+        _os.environ["XLA_PYTHON_CLIENT_MEM_FRACTION"] = f"{_frac:.2f}"
+    except ValueError:
+        pass
+
 if _os.environ.get("COORDINATOR_ADDRESS") or _os.environ.get("DMLC_PS_ROOT_URI"):
     from .parallel import dist as _dist
 
@@ -85,5 +97,7 @@ from . import visualization as viz  # noqa: F401
 from . import error  # noqa: F401
 from . import log  # noqa: F401
 from . import util  # noqa: F401
+
+util._apply_env_config()  # honor MXNET_* knobs (SURVEY §5.6)
 from . import test_utils  # noqa: F401
 from . import contrib  # noqa: F401

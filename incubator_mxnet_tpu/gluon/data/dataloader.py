@@ -47,7 +47,7 @@ def _worker_fn(samples):
 class DataLoader:
     def __init__(self, dataset, batch_size=None, shuffle=False, sampler=None,
                  last_batch=None, batch_sampler=None, batchify_fn=None,
-                 num_workers=0, pin_memory=False, pin_device_id=0,
+                 num_workers=None, pin_memory=False, pin_device_id=0,
                  prefetch=None, thread_pool=False, timeout=120,
                  try_nopython=None):  # noqa: ARG002
         self._dataset = dataset
@@ -64,6 +64,13 @@ class DataLoader:
                                          last_batch or "keep")
         self._batch_sampler = batch_sampler
         self._batchify_fn = batchify_fn or default_batchify_fn
+        if num_workers is None:
+            # env-config default ONLY when the caller didn't choose:
+            # explicit num_workers=0 must stay worker-free (reference
+            # MXNET_CPU_WORKER_NTHREADS semantics)
+            from ...util import default_num_workers
+
+            num_workers = default_num_workers()
         self._num_workers = max(0, num_workers)
         self._prefetch = max(0, prefetch or 2 * self._num_workers)
         self._pool = None

@@ -72,3 +72,67 @@ def default_array(source_array, ctx=None, dtype=None):
     from .ndarray.ndarray import NDArray
 
     return NDArray(source_array, device=ctx, dtype=dtype)
+
+
+# ---------------------------------------------------------------------------
+# Env-var config registry (reference: ~80 MXNET_* knobs documented in
+# docs/.../env_var.md, read via dmlc::GetEnv at use sites — SURVEY §5.6).
+# The TPU build honors the knobs that still mean something under XLA and
+# documents the mapping for the rest; `env_knobs()` is the introspection
+# table (name → (honored_by, description)).
+# ---------------------------------------------------------------------------
+_ENV_KNOBS = {
+    "MXNET_PROFILER_AUTOSTART": (
+        "profiler", "start the profiler at import (honored)"),
+    "MXNET_ENGINE_BULK_SIZE": (
+        "engine.set_bulk_size", "initial bulk window (honored at import; "
+        "op grouping itself is XLA's jit fusion)"),
+    "MXNET_CPU_WORKER_NTHREADS": (
+        "gluon.data.DataLoader", "default num_workers when the caller "
+        "passes none (honored)"),
+    "MXNET_GPU_MEM_POOL_RESERVE": (
+        "XLA_PYTHON_CLIENT_MEM_FRACTION", "reserve fraction → forwarded "
+        "to the XLA allocator when set before first device use"),
+    "MXNET_ENGINE_TYPE": (
+        "(designed out)", "scheduling is XLA async dispatch; value ignored"),
+    "MXNET_EXEC_ENABLE_INPLACE": (
+        "(designed out)", "buffer reuse is XLA memory planning + donation"),
+    "MXNET_USE_FUSION": (
+        "(designed out)", "pointwise fusion is XLA's default behavior"),
+    "MXNET_KVSTORE_BIGARRAY_BOUND": (
+        "(designed out)", "collectives are whole-array XLA ops; chunking "
+        "is the partitioner's job"),
+}
+
+
+def env_knobs():
+    """The config-system mapping table (name → (honored_by, doc))."""
+    return dict(_ENV_KNOBS)
+
+
+def _apply_env_config():
+    """Honor the live knobs at import (reference: dmlc::GetEnv at use
+    sites; here one explicit pass)."""
+    import os
+
+    bulk = os.environ.get("MXNET_ENGINE_BULK_SIZE")
+    if bulk:
+        try:
+            from . import engine
+
+            engine.set_bulk_size(int(bulk))
+        except (ImportError, ValueError):
+            pass
+    # NOTE: MXNET_GPU_MEM_POOL_RESERVE is forwarded at the TOP of package
+    # __init__ (must precede any XLA backend init), not here.
+
+
+def default_num_workers():
+    """DataLoader default worker count (MXNET_CPU_WORKER_NTHREADS)."""
+    import os
+
+    v = os.environ.get("MXNET_CPU_WORKER_NTHREADS")
+    try:
+        return max(0, int(v)) if v else 0
+    except ValueError:
+        return 0

@@ -141,3 +141,18 @@ def test_libinfo():
     libs = mx.libinfo.find_lib_path()
     assert all(p.endswith(".so") for p in libs)
     assert mx.libinfo.find_include_path().endswith("ext")
+
+
+def test_env_knob_registry_and_bulk(monkeypatch):
+    table = mx.util.env_knobs()
+    assert "MXNET_ENGINE_BULK_SIZE" in table
+    monkeypatch.setenv("MXNET_ENGINE_BULK_SIZE", "42")
+    mx.util._apply_env_config()
+    assert mx.engine.set_bulk_size(15) == 42  # was applied
+
+
+def test_env_num_workers(monkeypatch):
+    monkeypatch.setenv("MXNET_CPU_WORKER_NTHREADS", "3")
+    assert mx.util.default_num_workers() == 3
+    monkeypatch.setenv("MXNET_CPU_WORKER_NTHREADS", "bogus")
+    assert mx.util.default_num_workers() == 0
