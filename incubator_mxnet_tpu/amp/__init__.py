@@ -44,8 +44,11 @@ TARGET_DTYPE_OPS = ["fully_connected", "convolution", "deconvolution",
                     "linalg_gemm2", "linalg_trmm", "linalg_syrk",
                     "flash_attention", "interleaved_matmul_selfatt_qk",
                     "interleaved_matmul_selfatt_valatt"]
+# layer_norm is NOT in FP32_OPS: the op itself computes statistics in f32
+# and writes back in the input dtype (numpy_extension.layer_norm), so the
+# funnel up-cast would only add HBM traffic under bf16 AMP.
 FP32_OPS = ["softmax", "log_softmax", "masked_softmax", "softmin",
-            "layer_norm", "batch_norm", "group_norm", "instance_norm",
+            "batch_norm", "group_norm", "instance_norm",
             "l2_normalization", "norm", "mean", "sum", "prod", "cumsum",
             "exp", "expm1", "log", "log1p", "log2", "log10", "erf",
             "erfinv", "gammaln", "power", "sqrt", "rsqrt", "cbrt",

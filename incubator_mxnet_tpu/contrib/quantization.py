@@ -32,7 +32,7 @@ from ..ndarray.ndarray import NDArray, apply_op
 
 __all__ = ["quantize_net", "quantize_model", "QuantizedDense",
            "QuantizedConv2D", "optimal_threshold_entropy",
-           "collect_thresholds"]
+           "collect_thresholds", "fold_conv_bn"]
 
 
 # ---------------------------------------------------------------------------
@@ -254,6 +254,7 @@ class QuantizedDense(HybridBlock):
                       if dense.bias is not None else None)
         self._units = dense._units
         self._flatten = dense._flatten
+        self._out_threshold = None   # set by requantize chaining
         self.act = dense.act
         if self.act is not None:
             self.register_child(self.act, "act")
@@ -263,25 +264,53 @@ class QuantizedDense(HybridBlock):
         import jax.numpy as jnp
 
         flatten = self._flatten
+        has_bias = self.qbias is not None
+        has_out = self._out_threshold is not None
+        # activation dtype carried across an int8 chain: an int8 input
+        # can't tell us what the net's float dtype is, so each producer
+        # records it on its consumer before that consumer traces
+        x_dt = (x._data if isinstance(x, NDArray) else x).dtype
+        if x_dt == onp.int8:
+            chain_dt = self.__dict__.get("_chain_in_dt", onp.float32)
+        else:
+            chain_dt = x_dt
+        consumer = self.__dict__.get("_chain_consumer")
+        if has_out and consumer is not None:
+            consumer.__dict__["_chain_in_dt"] = chain_dt
 
         def f(xv, wq, w_scale, thresh, *rest):
             s_x = thresh.astype(jnp.float32) / 127.0
             if flatten and xv.ndim > 2:
                 xv = xv.reshape(xv.shape[0], -1)
-            xq = jnp.clip(jnp.round(xv / s_x), -127, 127).astype(jnp.int8)
+            if xv.dtype == jnp.int8:
+                # requantize-chained producer already emitted at our scale
+                xq, out_dt = xv, chain_dt
+            else:
+                xq = jnp.clip(jnp.round(xv / s_x), -127, 127).astype(jnp.int8)
+                out_dt = xv.dtype
             dot = _int8_contract(lambda a, b: jax.lax.dot_general(
                 a, b, (((a.ndim - 1,), (1,)), ((), ())),
                 preferred_element_type=jnp.int32))
             acc = dot(xq, wq)
             y = acc.astype(jnp.float32) * (s_x * w_scale)
-            if rest:
-                y = y + rest[0]
-            return y.astype(xv.dtype)
+            rest = list(rest)
+            if has_bias:
+                y = y + rest.pop(0)
+            if has_out:
+                # emit int8 at the CONSUMER'S calibrated scale; relu /
+                # identity glue in between is monotonic so it commutes
+                # with the rounding
+                out_t = rest.pop(0).astype(jnp.float32)
+                return jnp.clip(jnp.round(y * (127.0 / out_t)),
+                                -127, 127).astype(jnp.int8)
+            return y.astype(out_dt)
 
         args = (x, self.qweight.data(), self.qscale.data(),
                 self.qthreshold.data())
-        if self.qbias is not None:
+        if has_bias:
             args = args + (self.qbias.data(),)
+        if has_out:
+            args = args + (self._out_threshold.data(),)
         out = apply_op("quantized_dense", f, args)
         if self.act is not None:
             out = self.act(out)
@@ -308,6 +337,7 @@ class QuantizedConv2D(HybridBlock):
         self._pad = conv._pad
         self._dilate = conv._dilate
         self._groups = conv._groups
+        self._out_threshold = None   # set by requantize chaining
         self.act = conv.act
         if self.act is not None:
             self.register_child(self.act, "act")
@@ -318,10 +348,24 @@ class QuantizedConv2D(HybridBlock):
 
         stride, pad, dilate, groups = (self._stride, self._pad,
                                        self._dilate, self._groups)
+        has_bias = self.qbias is not None
+        has_out = self._out_threshold is not None
+        x_dt = (x._data if isinstance(x, NDArray) else x).dtype
+        if x_dt == onp.int8:
+            chain_dt = self.__dict__.get("_chain_in_dt", onp.float32)
+        else:
+            chain_dt = x_dt
+        consumer = self.__dict__.get("_chain_consumer")
+        if has_out and consumer is not None:
+            consumer.__dict__["_chain_in_dt"] = chain_dt
 
         def f(xv, wq, w_scale, thresh, *rest):
             s_x = thresh.astype(jnp.float32) / 127.0
-            xq = jnp.clip(jnp.round(xv / s_x), -127, 127).astype(jnp.int8)
+            if xv.dtype == jnp.int8:
+                xq, out_dt = xv, chain_dt
+            else:
+                xq = jnp.clip(jnp.round(xv / s_x), -127, 127).astype(jnp.int8)
+                out_dt = xv.dtype
             conv = _int8_contract(lambda a, b: jax.lax.conv_general_dilated(
                 a, b, window_strides=stride,
                 padding=[(p, p) for p in pad], rhs_dilation=dilate,
@@ -331,14 +375,21 @@ class QuantizedConv2D(HybridBlock):
             acc = conv(xq, wq)
             y = acc.astype(jnp.float32) * (
                 s_x * w_scale[None, :, None, None])
-            if rest:
-                y = y + rest[0][None, :, None, None]
-            return y.astype(xv.dtype)
+            rest = list(rest)
+            if has_bias:
+                y = y + rest.pop(0)[None, :, None, None]
+            if has_out:
+                out_t = rest.pop(0).astype(jnp.float32)
+                return jnp.clip(jnp.round(y * (127.0 / out_t)),
+                                -127, 127).astype(jnp.int8)
+            return y.astype(out_dt)
 
         args = (x, self.qweight.data(), self.qscale.data(),
                 self.qthreshold.data())
-        if self.qbias is not None:
+        if has_bias:
             args = args + (self.qbias.data(),)
+        if has_out:
+            args = args + (self._out_threshold.data(),)
         out = apply_op("quantized_conv", f, args)
         if self.act is not None:
             out = self.act(out)
@@ -352,6 +403,125 @@ class QuantizedConv2D(HybridBlock):
 # ---------------------------------------------------------------------------
 # net rewrite
 # ---------------------------------------------------------------------------
+
+def fold_conv_bn(net, logger=None):
+    """Fold inference-mode BatchNorm into the preceding Conv2D/Dense
+    wherever the two are ADJACENT children of the same block (the
+    HybridSequential conv→bn idiom of every model_zoo CNN). The BN becomes
+    `nn.Identity`, and the conv's weights/bias absorb the affine:
+
+        w' = w * (gamma/sqrt(var+eps))[oc],  b' = beta - mean*gamma/sqrt(..)
+
+    Reference: the oneDNN quantize pass does the same fold before emitting
+    int8 kernels (`src/operator/subgraph/dnnl/dnnl_conv_property.h` — conv
+    +bn fusion), which is why its int8 chains have no f32 BN in between.
+    Safe only for inference: running stats are frozen into the weights.
+    Returns the number of folds performed."""
+    from ..gluon.parameter import Parameter
+
+    n_folds = 0
+    stack = [net]
+    while stack:
+        block = stack.pop()
+        # declaration order equals dataflow order ONLY inside
+        # HybridSequential — arbitrary blocks may declare parallel branches
+        # as adjacent attributes, so only sequential containers are folded
+        if isinstance(block, nn.HybridSequential):
+            names = list(block._children)
+        else:
+            names = []
+        for a, b in zip(names, names[1:]):
+            ca, cb = block._children[a], block._children[b]
+            # exact type: BatchNormReLU is a subclass whose fused relu
+            # must survive the fold as an explicit Activation
+            bn_relu = type(cb).__name__ == "BatchNormReLU"
+            if not (type(cb) is nn.BatchNorm or bn_relu):
+                continue
+            if not isinstance(ca, (nn.Conv2D, nn.Dense)):
+                continue
+            gamma = (cb.gamma.data().asnumpy() if cb._scale
+                     else onp.ones(cb.running_var.shape, onp.float32))
+            beta = cb.beta.data().asnumpy()
+            mean = cb.running_mean.data().asnumpy()
+            var = cb.running_var.data().asnumpy()
+            inv = gamma / onp.sqrt(var + cb._epsilon)
+            w = ca.weight.data().asnumpy()
+            w_shape = (-1,) + (1,) * (w.ndim - 1)
+            # keep the conv's declared dtype: w*inv promotes bf16/f16 to f32
+            ca.weight.set_data(
+                NDArray((w * inv.reshape(w_shape)).astype(w.dtype)))
+            bias = beta - mean * inv
+            if ca.bias is not None:
+                bias = bias + ca.bias.data().asnumpy() * inv
+                ca.bias.set_data(NDArray(bias.astype(w.dtype)))
+            else:
+                p = Parameter(shape=bias.shape, dtype=str(w.dtype))
+                p.set_data(NDArray(bias.astype(w.dtype)))
+                ca.bias = p
+            _replace_child(block, b, cb,
+                           nn.Activation("relu") if bn_relu else nn.Identity())
+            n_folds += 1
+            if logger:
+                logger.info("folded BatchNorm %s into %s", b, a)
+        stack.extend(c for c in block._children.values()
+                     if isinstance(c, HybridBlock))
+    for blk in _hybrid_blocks(net):
+        blk._cached_graph = None
+    return n_folds
+
+
+def _chain_requantize(net, logger=None):
+    """Where quantized layers follow each other through only monotonic
+    elementwise glue (relu Activations / Identity) inside one container,
+    make the producer emit int8 AT THE CONSUMER'S SCALE so no f32
+    activation materializes between MXU int8 ops (reference:
+    `src/operator/quantization/requantize-inl.h` chained through the
+    quantize_graph_pass). Returns the number of chained pairs."""
+    n_chained = 0
+    stack = [net]
+    while stack:
+        block = stack.pop()
+        # same restriction as fold_conv_bn: only HybridSequential children
+        # are guaranteed to run in declaration order
+        kids = ([block._children[n] for n in block._children]
+                if isinstance(block, nn.HybridSequential) else [])
+        for i, prod in enumerate(kids):
+            if not isinstance(prod, (QuantizedConv2D, QuantizedDense)):
+                continue
+            # the int8 emit happens BEFORE the producer's own fused
+            # activation; only a monotonic non-saturating act (relu) or
+            # none commutes with the rounding — sigmoid/tanh/gelu applied
+            # to int8 CODES would be nonsense
+            if prod.act is not None and getattr(
+                    prod.act, "_act_type", None) != "relu":
+                continue
+            j = i + 1
+            while j < len(kids) and (
+                    isinstance(kids[j], nn.Identity)
+                    or (isinstance(kids[j], nn.Activation)
+                        and kids[j]._act_type == "relu")):
+                j += 1
+            if j < len(kids) and isinstance(
+                    kids[j], (QuantizedConv2D, QuantizedDense)):
+                # share the consumer's qthreshold PARAMETER (not a baked
+                # float): load_parameters updates it in place and the
+                # producer's emit scale follows. __dict__ assignment on
+                # purpose — Block.__setattr__ would REGISTER the shared
+                # Parameter under the producer (duplicate checkpoint key,
+                # renamed parameter)
+                prod.__dict__["_out_threshold"] = kids[j].qthreshold
+                # back-ref so the producer can forward its activation
+                # dtype to the chain consumer (last layer of an int8
+                # chain must emit the NET'S dtype, not hardcoded f32)
+                prod.__dict__["_chain_consumer"] = kids[j]
+                n_chained += 1
+                if logger:
+                    logger.info("requantize-chained %s -> %s",
+                                type(prod).__name__, type(kids[j]).__name__)
+        stack.extend(c for c in block._children.values()
+                     if isinstance(c, HybridBlock))
+    return n_chained
+
 
 def _find_target_layers(block, prefix="", exclude=None):
     """(parent, child_name, layer) for every quantizable layer."""
@@ -381,7 +551,8 @@ def _replace_child(parent, name, old, new):
 
 def quantize_net(net, calib_data=None, calib_mode="entropy",
                  quantized_dtype="int8", exclude_layers_match=None,
-                 num_calib_batches=10, logger=None):
+                 num_calib_batches=10, fold_bn=True, requantize=True,
+                 logger=None):
     """Post-training INT8 quantization of a gluon net, in place.
 
     - `calib_data`: iterable of batches (or (data, label) pairs) for
@@ -390,10 +561,16 @@ def quantize_net(net, calib_data=None, calib_mode="entropy",
     - `calib_mode`: 'naive' (minmax) or 'entropy' (KL-optimal clip), per
       the reference's quantize_model modes.
     - `exclude_layers_match`: list of regexes of layer paths to keep fp32.
+    - `fold_bn`: fold adjacent Conv→BatchNorm pairs into the conv before
+      calibrating, so no f32 BN pass interrupts the int8 chain.
+    - `requantize`: chain consecutive quantized layers through int8 at the
+      consumer's scale instead of round-tripping f32.
     Returns the mutated net (reference returns a new symbol+params; the
     TPU build swaps the layers so hybridize/export keep working)."""
     if quantized_dtype != "int8":
         raise ValueError("only int8 is supported on the TPU build")
+    if fold_bn:
+        fold_conv_bn(net, logger=logger)
     layers = _find_target_layers(net, exclude=exclude_layers_match)
     if not layers:
         return net
@@ -411,6 +588,8 @@ def quantize_net(net, calib_data=None, calib_mode="entropy",
         _replace_child(parent, name, layer, q)
         if logger:
             logger.info("quantized %s (threshold=%.5g)", name, t)
+    if requantize:
+        _chain_requantize(net, logger=logger)
     # stale traced graphs still reference the fp32 layers — force re-trace
     for b in _hybrid_blocks(net):
         b._cached_graph = None

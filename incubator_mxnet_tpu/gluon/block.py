@@ -271,6 +271,14 @@ class _CachedGraph:
             probe["aux_arrays"] = [a for a, _ in aux_pairs]
             return out_vals + tuple(nv for _, nv in aux_pairs)
 
+        backend_name = getattr(block, "_flags", {}).get("backend")
+        if backend_name:
+            # partition backend (reference: optimize_for → subgraph
+            # property pass): trace with ops outlined, pattern-rewrite the
+            # op-level jaxpr, inline the result
+            from ..partition import apply_backend, get_backend
+
+            fn = apply_backend(fn, get_backend(backend_name))
         mode = {"jitted": jax.jit(fn), "probe": probe, "ready": False}
         self._modes[training] = mode
         return mode
@@ -337,9 +345,20 @@ class HybridBlock(Block):
                 c.hybridize(active, **kwargs)
         # children of a hybridized block execute inside the parent's trace
 
-    def optimize_for(self, x, *args, backend=None, **kwargs):
-        self.hybridize(True, backend=backend, **kwargs)
-        return self(x, *args)
+    def optimize_for(self, x, *args, backend=None, backend_opts=None,
+                     **kwargs):
+        """Apply a registered partition backend and compile (reference:
+        block.py:1190 optimize_for → C++ subgraph pass; here →
+        `incubator_mxnet_tpu.partition`). The backend's block-level
+        rewrite runs once, its dataflow patterns apply at trace time."""
+        if backend is not None:
+            from ..partition import get_backend
+
+            get_backend(backend).rewrite_block(self, **(backend_opts or {}))
+        self.hybridize(True, backend=backend, backend_opts=backend_opts,
+                       **kwargs)
+        self(x, *args)            # eager pass: deferred init + cache setup
+        return self(x, *args)     # compiled pass: backend rewrite applies
 
     def __call__(self, *args, **kwargs):
         if args and all(isinstance(a, NDArray) for a in args):

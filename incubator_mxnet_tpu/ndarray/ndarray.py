@@ -23,6 +23,8 @@ from .. import autograd
 from ..autograd import TapeNode
 from ..base import np_dtype
 from ..device import Device, current_device
+from ..partition import active_backend as _active_partition_backend
+from ..partition import outline_op as _outline_op
 
 __all__ = ["NDArray", "apply_op", "array", "from_jax", "waitall"]
 
@@ -632,6 +634,11 @@ def apply_op(name, jfn, args, kwargs=None, n_outputs=1, out=None):
         for j, i in enumerate(tensor_idx):
             call[i] = tvals[j]
         return jfn(*call, **kwargs)
+
+    if _active_partition_backend() is not None:
+        # partition-backend tracing: outline marked ops into single named
+        # eqns so subgraph patterns match framework ops, not primitives
+        pure_fn = _outline_op(name, pure_fn)
 
     outs = _call_profiled(name, pure_fn, tensor_vals)
     tuple_out = isinstance(outs, tuple)

@@ -57,7 +57,13 @@ def _build_pure_step(net, loss_fn, optimizer):
         aux_new = tuple(nv for _, nv in aux_pairs)
         return loss.mean()._data, aux_new
 
-    def step(param_vals, frozen_vals, opt_states, t, lr, wd, key, x, y):
+    def step(param_vals, frozen_vals, opt_states, t, lr, wd, base_key, x, y):
+        # t arrives as a device scalar and the per-step RNG key derives
+        # from (base_key, t) ON DEVICE: the host never uploads a counter
+        # or splits a key eagerly, so a steady-state step costs ONE
+        # execute RPC (each host->device scalar upload is a round trip on
+        # a tunneled chip — they measured ~8 ms/step of dead time)
+        key = jax.random.fold_in(base_key, t)
         (loss, aux_new), grads = jax.value_and_grad(
             forward_loss, has_aux=True)(param_vals, frozen_vals, key, x, y)
         new_params, new_states = [], []
@@ -65,7 +71,7 @@ def _build_pure_step(net, loss_fn, optimizer):
             nw, ns = optimizer.step(w, g, s, lr, wd, t)
             new_params.append(nw)
             new_states.append(ns)
-        return loss, new_params, new_states, aux_new
+        return loss, new_params, new_states, aux_new, t + 1
 
     return step, params, param_arrays, frozen_arrays, aux_arrays_cell
 
@@ -124,17 +130,40 @@ class DataParallel:
             # come back with compiler-chosen shardings and re-enter.
             for a in frozen_arrays:
                 a._set_data(jax.device_put(a._data, repl))
+            # donate params + optimizer states: they are consumed and
+            # rebound every step, so XLA updates them in place instead of
+            # materializing copies
             self._jit = jax.jit(
                 step,
                 in_shardings=(param_sh, None, state_sh,
                               None, None, None, repl, batch_sh, batch_sh),
-                out_shardings=(None, param_sh, state_sh, None))
+                out_shardings=(None, param_sh, state_sh, None, None),
+                donate_argnums=(0, 2, 3))
             self._batch_sharding = batch_sh
         else:
-            self._jit = jax.jit(step)
+            self._jit = jax.jit(step, donate_argnums=(0, 2, 3))
             self._batch_sharding = None
+        # device-resident step counter + cached lr/wd uploads (see step())
+        self._t_dev = None
+        self._lr_dev = (None, None)
+        self._wd_dev = (None, None)
+        self._base_key = None
+        self._key_epoch = None
+
+    def _dev_scalar(self, value, cache_name, dtype):
+        """Upload a python scalar only when it CHANGED since the last step —
+        steady-state training pays zero host->device transfers for lr/wd."""
+        import jax.numpy as jnp
+
+        cached_val, cached_buf = getattr(self, cache_name)
+        if cached_buf is None or cached_val != value:
+            cached_buf = jnp.asarray(value, dtype)
+            setattr(self, cache_name, (value, cached_buf))
+        return cached_buf
 
     def step(self, x, y):
+        import jax.numpy as jnp
+
         from ..random import next_key
 
         self._t += 1
@@ -149,6 +178,17 @@ class DataParallel:
         yv = y._data if isinstance(y, NDArray) else y
         param_vals = [a._data for a in self.param_arrays]
         frozen_vals = [a._data for a in self.frozen_arrays]
+        if self._t_dev is None:
+            self._t_dev = jnp.asarray(self._t, jnp.int32)
+        from ..random import seed_epoch
+
+        if self._base_key is None or self._key_epoch != seed_epoch():
+            # refresh after mx.random.seed() so reseeding mid-training
+            # changes the dropout streams (reference semantics)
+            self._base_key = next_key()
+            self._key_epoch = seed_epoch()
+        lr_dev = self._dev_scalar(lr, "_lr_dev", jnp.float32)
+        wd_dev = self._dev_scalar(wd, "_wd_dev", jnp.float32)
         # the mesh is active during tracing so npx.sharding_constraint
         # (sequence/tensor-parallel activation hints) can resolve axes
         import contextlib
@@ -157,9 +197,9 @@ class DataParallel:
 
         with (mesh_scope(self.mesh) if self.mesh is not None
               else contextlib.nullcontext()):
-            loss, new_params, new_states, aux_new = self._jit(
-                param_vals, frozen_vals, self.opt_states, self._t, lr, wd,
-                next_key(), xv, yv)
+            loss, new_params, new_states, aux_new, self._t_dev = self._jit(
+                param_vals, frozen_vals, self.opt_states, self._t_dev,
+                lr_dev, wd_dev, self._base_key, xv, yv)
         for a, nv in zip(self.param_arrays, new_params):
             a._set_data(nv)
         for a, nv in zip(self._aux_arrays_cell, aux_new):

@@ -1,0 +1,449 @@
+"""Pluggable graph-partition / subgraph-rewrite backends.
+
+Reference: `src/operator/subgraph/subgraph_property.h` — SubgraphSelector
+(`:88`, walks the nnvm graph selecting connected op sets), SubgraphProperty
+(`:265`, replaces the match with an accelerated fused node), and the named
+backend registry (`:543`, `MXNET_REGISTER_SUBGRAPH_PROPERTY`), driven by
+`HybridBlock.optimize_for(backend)` (`python/mxnet/gluon/block.py:1190`).
+
+TPU-native design. The reference matches patterns over the nnvm graph —
+a graph whose nodes ARE framework ops. A raw jaxpr is too low-level for
+that (one `softmax` becomes a reduce/sub/exp/sum/div DAG), so when a
+backend is active each funnel op is OUTLINED: `apply_op` wraps the op's
+pure function in `jax.jit`, making it a single `pjit` equation whose
+`name` param is the op name. The traced forward then yields a jaxpr whose
+equations correspond 1:1 to framework ops — the nnvm-graph analogue —
+and subgraph matching is a scan over op names with dataflow chaining.
+Matched chains are spliced out and replaced by the backend's fused
+implementation (re-traced in place); XLA inlines the nested pjit calls,
+so an un-matched outlined op costs nothing after compilation.
+
+Two hook levels, mirroring the reference:
+- `Backend.rewrite_block(block, **opts)` — structural rewrite before
+  tracing (the quantize pass level: swaps child blocks in place).
+- `Backend.patterns` — dataflow-level rewrites applied to the traced
+  graph at hybridize/compile time (the dnnl fuse-property level).
+
+Built-in backends:
+- "flash_attention": rewrites unfused batch_dot→softmax→batch_dot
+  attention written with framework ops into the pallas flash-attention
+  kernel (`ops/flash_attention.py`).
+- "int8": block-level post-training quantization
+  (`contrib.quantization.quantize_net`) — calibration data passed through
+  `optimize_for(..., backend_opts=...)`.
+"""
+from __future__ import annotations
+
+import threading
+
+__all__ = ["Pattern", "Backend", "register_backend", "get_backend",
+           "list_backends", "backend_scope", "active_backend",
+           "outline_op", "rewrite_jaxpr", "apply_backend"]
+
+_BACKENDS: dict = {}
+
+
+class Pattern:
+    """A dataflow chain of op names to fuse.
+
+    - `ops`: list of stages; each stage is an op name or a tuple of
+      acceptable names. A stage may be suffixed "?" (optional) when given
+      as a string, e.g. "true_divide?" — skipped if the next eqn doesn't
+      match it. Names match either outlined funnel ops (pjit name) or raw
+      jaxpr primitives (e.g. "div", "exp").
+    - `replace(eqns, invals)`: called with the MATCHED JaxprEqns and the
+      chain's input values (traced); returns the replacement output(s).
+      Must be trace-compatible (pure jax).
+    - `guard(eqns)`: optional predicate to reject matches (inspect params
+      / avals).
+    """
+
+    def __init__(self, name, ops, replace, guard=None):
+        self.name = name
+        self.ops = ops
+        self.replace = replace
+        self.guard = guard
+
+    def stage(self, i):
+        spec = self.ops[i]
+        optional = False
+        if isinstance(spec, str):
+            if spec.endswith("?"):
+                spec, optional = spec[:-1], True
+            names = (spec,)
+        else:
+            names = tuple(spec)
+        return names, optional
+
+
+class Backend:
+    """A named partition backend (reference: SubgraphProperty subclass +
+    MXNET_REGISTER_SUBGRAPH_PROPERTY)."""
+
+    name: str = ""
+    #: funnel ops to outline into single named eqns while tracing under
+    #: this backend; "*" outlines every funnel op
+    mark_ops: frozenset | str = frozenset()
+    patterns: list = []
+
+    def rewrite_block(self, block, **opts):   # noqa: ARG002
+        """Structural hook run by optimize_for BEFORE tracing."""
+        return block
+
+
+def register_backend(backend):
+    """Register a Backend instance (or class — instantiated); returns it,
+    usable as a class decorator."""
+    b = backend() if isinstance(backend, type) else backend
+    if not b.name:
+        raise ValueError("backend needs a name")
+    _BACKENDS[b.name] = b
+    return backend
+
+
+def get_backend(name):
+    try:
+        return _BACKENDS[name]
+    except KeyError:
+        raise ValueError(
+            f"unknown partition backend {name!r}; registered: "
+            f"{sorted(_BACKENDS)}") from None
+
+
+def list_backends():
+    return sorted(_BACKENDS)
+
+
+# ---------------------------------------------------------------------------
+# backend scope + op outlining (the graph-building half)
+# ---------------------------------------------------------------------------
+
+class _Scope(threading.local):
+    def __init__(self):
+        self.backend = None
+
+
+_SCOPE = _Scope()
+
+
+class backend_scope:
+    def __init__(self, backend):
+        self._b = backend
+
+    def __enter__(self):
+        self._prev = _SCOPE.backend
+        _SCOPE.backend = self._b
+        return self._b
+
+    def __exit__(self, *exc):
+        _SCOPE.backend = self._prev
+        return False
+
+
+def active_backend():
+    return _SCOPE.backend
+
+
+_OUTLINED_PREFIX = "mxop_"
+
+
+def outline_op(name, pure_fn):
+    """When a backend scope is active and `name` is marked, wrap the op's
+    pure function so it traces as ONE named pjit equation."""
+    b = _SCOPE.backend
+    if b is None:
+        return pure_fn
+    marked = b.mark_ops == "*" or name in b.mark_ops
+    if not marked:
+        return pure_fn
+    import jax
+
+    # the pjit eqn's `name` param comes from the wrapped fn's __name__
+    def _outlined(*args, **kwargs):
+        return pure_fn(*args, **kwargs)
+
+    _outlined.__name__ = _OUTLINED_PREFIX + name
+    return jax.jit(_outlined)
+
+
+def _eqn_op_name(eqn):
+    """Framework-op name of an eqn: outlined jit-call name (mxop_*) or the
+    raw primitive name. (jax names the call primitive 'jit' as of 0.9,
+    'pjit' before.)"""
+    if eqn.primitive.name in ("jit", "pjit"):
+        name = eqn.params.get("name", "")
+        if name.startswith(_OUTLINED_PREFIX):
+            return name[len(_OUTLINED_PREFIX):]
+        return f"pjit:{name}"
+    return eqn.primitive.name
+
+
+# ---------------------------------------------------------------------------
+# jaxpr chain matching + splicing (the SubgraphSelector/Property half)
+# ---------------------------------------------------------------------------
+
+def _match_chain(eqns, start, pattern, use_counts, outvars):
+    """Try to match `pattern` starting at eqns[start]. Chain rule: each
+    next stage consumes an output of the previous stage's eqn, and every
+    intermediate output is used EXACTLY once and is not a graph output
+    (same single-consumer discipline as SubgraphSelector::SelectOutput).
+    Returns (matched_eqns, skipped_optional_count) or None."""
+    from jax.extend.core import Var
+
+    matched = []
+    i = start
+    stage = 0
+    n = len(pattern.ops)
+    prev_outs: set = set()
+    while stage < n:
+        names, optional = pattern.stage(stage)
+        if i >= len(eqns):
+            if optional:
+                stage += 1
+                continue
+            return None
+        eqn = eqns[i]
+        name = _eqn_op_name(eqn)
+        consumes_prev = (not matched) or any(
+            isinstance(v, Var) and v in prev_outs for v in eqn.invars)
+        if name in names and consumes_prev:
+            if matched:
+                # intermediates: single consumer, not a graph output
+                for v in prev_outs:
+                    if use_counts.get(v, 0) != 1 or v in outvars:
+                        return None
+            matched.append(eqn)
+            prev_outs = set(eqn.outvars)
+            stage += 1
+            i += 1
+        elif optional:
+            stage += 1
+        elif not matched:
+            return None
+        else:
+            # a foreign eqn interleaved: only tolerable if it doesn't
+            # consume the chain (dead-simple scheduling independence);
+            # bail out to keep the match conservative
+            if any(isinstance(v, Var) and v in prev_outs for v in eqn.invars):
+                return None
+            i += 1
+            if i - start > len(pattern.ops) + 8:
+                return None
+    return matched if len(matched) >= 2 or n == 1 else None
+
+
+def rewrite_jaxpr(closed, patterns):
+    """Scan a ClosedJaxpr for pattern chains; splice each match out and
+    replace it with the pattern's fused implementation (traced in place).
+    Returns (new_closed_jaxpr, n_rewrites)."""
+    import jax
+    import jax.extend.core as jec
+    from jax.extend.core import Var
+
+    jaxpr = closed.jaxpr
+    eqns = list(jaxpr.eqns)
+    use_counts: dict = {}
+    for eqn in eqns:
+        for v in eqn.invars:
+            if isinstance(v, Var):
+                use_counts[v] = use_counts.get(v, 0) + 1
+    outvars = set(v for v in jaxpr.outvars if isinstance(v, Var))
+
+    n_rewrites = 0
+    for pattern in patterns:
+        i = 0
+        while i < len(eqns):
+            m = _match_chain(eqns, i, pattern, use_counts, outvars)
+            if not m:
+                i += 1
+                continue
+            if pattern.guard is not None and not pattern.guard(m):
+                i += 1
+                continue
+            produced = set()
+            for eqn in m:
+                produced.update(eqn.outvars)
+            # chain inputs: invars not produced inside the match
+            in_vars, seen = [], set()
+            for eqn in m:
+                for v in eqn.invars:
+                    if isinstance(v, Var) and v not in produced \
+                            and v not in seen:
+                        in_vars.append(v)
+                        seen.add(v)
+            final_outs = list(m[-1].outvars)
+
+            # trace the replacement against the input avals
+            def _repl(*invals, _m=m):
+                out = pattern.replace(_m, invals)
+                return out if isinstance(out, tuple) else (out,)
+
+            sub = jax.make_jaxpr(_repl)(*[v.aval for v in in_vars])
+            if [v.aval.shape for v in sub.jaxpr.outvars] != \
+               [v.aval.shape for v in final_outs]:
+                raise ValueError(
+                    f"partition backend pattern {pattern.name!r}: "
+                    "replacement output shapes "
+                    f"{[v.aval.shape for v in sub.jaxpr.outvars]} != matched "
+                    f"{[v.aval.shape for v in final_outs]}")
+            # splice: remap sub-jaxpr invars -> chain inputs, sub outvars ->
+            # chain outputs; constvars lift into the outer closed consts
+            mapping = dict(zip(sub.jaxpr.invars, in_vars))
+            const_vars = list(sub.jaxpr.constvars)
+            new_constvars = []
+            new_consts = []
+            for cv, cval in zip(const_vars, sub.consts):
+                new_constvars.append(cv)
+                new_consts.append(cval)
+            out_map = dict(zip(sub.jaxpr.outvars, final_outs))
+
+            def _sub_var(v, mapping=mapping, out_map=out_map):
+                if not isinstance(v, Var):
+                    return v
+                return out_map.get(v, mapping.get(v, v))
+
+            spliced = []
+            for eqn in sub.jaxpr.eqns:
+                spliced.append(eqn.replace(
+                    invars=[_sub_var(v) for v in eqn.invars],
+                    outvars=[_sub_var(v) for v in eqn.outvars]))
+            # a replacement outvar that is itself an invar/constant (pure
+            # pass-through) can't be expressed by splicing alone
+            for sv, ov in out_map.items():
+                if sv in mapping or not isinstance(sv, Var):
+                    raise ValueError(
+                        f"pattern {pattern.name!r}: replacement may not "
+                        "pass an input straight through to an output")
+
+            # insert the replacement where the LAST matched eqn sat: any
+            # interleaved (non-consuming) eqn between the matched ones may
+            # PRODUCE a chain input (e.g. a v projection traced after the
+            # softmax), so splicing at the chain head would use it before
+            # definition
+            last_pos = eqns.index(m[-1])
+            insert_at = sum(1 for e in eqns[:last_pos] if e not in m)
+            kept = [e for e in eqns if e not in m]
+            eqns = kept[:insert_at] + spliced + kept[insert_at:]
+            # rebuild use counts (splice changed the graph)
+            use_counts = {}
+            for eqn in eqns:
+                for v in eqn.invars:
+                    if isinstance(v, Var):
+                        use_counts[v] = use_counts.get(v, 0) + 1
+            jaxpr = jaxpr.replace(
+                eqns=eqns, constvars=list(jaxpr.constvars) + new_constvars)
+            closed = jec.ClosedJaxpr(jaxpr,
+                                     list(closed.consts) + new_consts)
+            n_rewrites += 1
+            i += 1
+    return closed, n_rewrites
+
+
+def apply_backend(fn, backend):
+    """Wrap a pure traced fn so that, at trace time, it is (1) traced with
+    the backend's ops outlined, (2) pattern-rewritten, (3) inlined back
+    into the surrounding trace. Shape-polymorphic via jax's own caching —
+    the rewrite happens per trace."""
+    import jax
+    import jax.tree_util as jtu
+
+    def wrapped(*args):
+        with backend_scope(backend):
+            closed, out_shape = jax.make_jaxpr(fn, return_shape=True)(*args)
+        if backend.patterns:
+            closed, n = rewrite_jaxpr(closed, backend.patterns)
+            backend.last_rewrites = n   # observability for tests/logging
+        flat, _ = jtu.tree_flatten(args)
+        out_flat = jax.core.eval_jaxpr(closed.jaxpr, closed.consts, *flat)
+        treedef = jtu.tree_structure(
+            out_shape, is_leaf=lambda x: hasattr(x, "shape"))
+        return jtu.tree_unflatten(treedef, out_flat)
+
+    return wrapped
+
+
+# ---------------------------------------------------------------------------
+# built-in backends
+# ---------------------------------------------------------------------------
+
+def _flash_guard(eqns):
+    """Shapes must identify the standard attention layout unambiguously:
+    scores=(B,T,Tk) from q=(B,T,d) @ k^T with k=(B,Tk,d)."""
+    qk = eqns[0]
+    q_aval, k_aval = qk.invars[0].aval, qk.invars[1].aval
+    s_aval = qk.outvars[0].aval
+    if len(q_aval.shape) != 3 or len(k_aval.shape) != 3:
+        return False
+    b, t, d = q_aval.shape
+    if k_aval.shape[0] != b or k_aval.shape[2] != d:
+        return False        # transpose_b=False layout: leave it unfused
+    tk = k_aval.shape[1]
+    if tuple(s_aval.shape) != (b, t, tk) or (tk == d and t == d):
+        return False        # ambiguous square case
+    # optional scale stage must be a literal scalar (the pallas kernel
+    # takes sm_scale as a static float)
+    for eqn in eqns[1:-2]:
+        from jax.extend.core import Literal
+
+        if _eqn_op_name(eqn) in ("div", "mul"):
+            if not isinstance(eqn.invars[1], Literal):
+                return False
+    # final stage consumes softmax output against v=(B,Tk,d)
+    v_aval = eqns[-1].invars[1].aval
+    return tuple(v_aval.shape) == (b, tk, d)
+
+
+def _flash_replace(eqns, invals):
+    from jax.extend.core import Literal
+
+    from .ops.flash_attention import flash_attention
+
+    q, k, v = invals[0], invals[1], invals[-1]
+    scale = 1.0   # no scale stage matched => the unfused math had none
+    for eqn in eqns[1:-2]:
+        name = _eqn_op_name(eqn)
+        if name in ("div", "mul") and isinstance(eqn.invars[1], Literal):
+            val = float(eqn.invars[1].val)
+            scale = (1.0 / val) if name == "div" else val
+    o = flash_attention(q[:, None], k[:, None], v[:, None],
+                        sm_scale=scale)
+    return o[:, 0]
+
+
+class FlashAttentionBackend(Backend):
+    """Rewrites unfused `batch_dot → (scale) → softmax → batch_dot`
+    attention written with framework ops into the fused flash-attention
+    kernel — the role the reference's dnnl transformer-QK subgraph
+    property plays (`src/operator/subgraph/dnnl/
+    dnnl_transformer_qk_property.h`), here targeting the pallas/XLA fused
+    kernel. Softmax is assumed on the last axis (the attention
+    convention); masked_softmax chains are NOT matched (a dense mask
+    cannot be recovered into the kernel's per-sequence lengths)."""
+
+    name = "flash_attention"
+    mark_ops = frozenset({"batch_dot", "softmax"})
+    # the scale stage is optional: a bare batch_dot→softmax→batch_dot
+    # chain fuses with sm_scale=1
+    patterns = [Pattern(
+        "qk_softmax_v",
+        ["batch_dot", "div?", "mul?", "softmax", "batch_dot"],
+        _flash_replace, guard=_flash_guard)]
+
+
+class Int8Backend(Backend):
+    """Block-level post-training INT8 quantization as a partition backend
+    (reference: the quantize pass registered as SG property 'ONEDNN_QUANTIZE',
+    `src/operator/subgraph/dnnl/dnnl_subgraph_property.cc`). Options are
+    forwarded to `contrib.quantization.quantize_net` — pass
+    `backend_opts={'calib_data': ..., 'calib_mode': 'entropy'}`."""
+
+    name = "int8"
+
+    def rewrite_block(self, block, **opts):
+        from .contrib.quantization import quantize_net
+
+        return quantize_net(block, **opts)
+
+
+register_backend(FlashAttentionBackend)
+register_backend(Int8Backend)

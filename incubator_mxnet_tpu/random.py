@@ -6,21 +6,36 @@ jit trace (hybridized blocks), a *traced* base key is pushed onto a stack and
 draws fold a call counter into it — so compiled graphs get fresh randomness
 per invocation (the key is an argument of the compiled function, not a baked
 constant).
+
+PRNG implementation: on TPU the default is jax's `rbg` (the XLA
+RngBitGenerator hardware path) — counter-based threefry bit generation runs
+on the VPU and measures ~40% of a BERT-base train step, vs ~10% for rbg
+(88k → 124k tokens/s/chip on v5e). The reference's GPU path makes the same
+trade: cuDNN dropout uses the device's stateful generator, not a
+software-counter PRNG (`src/operator/nn/dropout-inl.h`). rbg's `split`/
+`fold_in` have weaker independence guarantees than threefry — acceptable
+for dropout/initializers; set `MXNET_RNG_IMPL=threefry` to restore the
+reference-grade generator (dropout then routes to the pallas hardware-RNG
+kernel, `ops/dropout.py`).
 """
 from __future__ import annotations
 
+import os
 import threading
 
-__all__ = ["seed", "next_key", "trace_key_scope", "get_state"]
+__all__ = ["seed", "next_key", "trace_key_scope", "get_state", "rng_impl"]
 
 
 class _State(threading.local):
     def __init__(self):
         self.key = None
         self.trace_stack = []  # list of [base_key, counter]
+        self.epoch = 0         # bumped by seed(); lets long-lived compiled
+        #                        steps notice a reseed and refresh their key
 
 
 _STATE = _State()
+_IMPL = None
 
 
 def _jr():
@@ -29,16 +44,44 @@ def _jr():
     return jr
 
 
+def rng_impl() -> str:
+    """Active PRNG implementation name ('rbg' on TPU unless overridden)."""
+    global _IMPL
+    if _IMPL is None:
+        impl = os.environ.get("MXNET_RNG_IMPL", "")
+        if impl not in ("threefry", "rbg", "unsafe_rbg"):
+            import jax
+
+            impl = "rbg" if jax.default_backend() == "tpu" else "threefry"
+        _IMPL = impl
+    return _IMPL
+
+
+def _new_key(seed_state: int):
+    import jax.random as jr
+
+    impl = rng_impl()
+    if impl == "threefry":
+        return jr.PRNGKey(int(seed_state))  # legacy uint32 keys, as before
+    return jr.key(int(seed_state), impl=impl)
+
+
 def seed(seed_state: int):
     """Seed the global RNG (reference: mx.random.seed)."""
-    _STATE.key = _jr().PRNGKey(int(seed_state))
+    _STATE.key = _new_key(seed_state)
+    _STATE.epoch += 1
     for frame in _STATE.trace_stack:
         frame[1] = 0
 
 
+def seed_epoch() -> int:
+    """Monotonic count of seed() calls (see _State.epoch)."""
+    return _STATE.epoch
+
+
 def get_state():
     if _STATE.key is None:
-        _STATE.key = _jr().PRNGKey(0)
+        _STATE.key = _new_key(0)
     return _STATE.key
 
 
@@ -51,7 +94,7 @@ def next_key():
         frame[1] += 1
         return k
     if _STATE.key is None:
-        _STATE.key = jr.PRNGKey(0)
+        _STATE.key = _new_key(0)
     _STATE.key, sub = jr.split(_STATE.key)
     return sub
 

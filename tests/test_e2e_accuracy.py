@@ -1,0 +1,244 @@
+"""End-to-end accuracy validation (VERDICT r2 item 2; reference discipline:
+`example/quantization/README.md:113-121` — FP32 accuracy table + ≤0.5%
+INT8 top-1 drop).
+
+No-egress substitute for ImageNet/MNIST files: the sklearn `load_digits`
+corpus — 1797 REAL handwritten digit scans (8×8, the UCI test partition of
+NIST) — written to disk in the actual idx-ubyte format and read back
+through the `MNISTIter` facade, so the full file→iterator→train→accuracy
+path is exercised on real image data, not synthetic tensors.
+
+Thresholds: the reference's MNIST MLP tutorial trains to ≥97%
+(`example/gluon/mnist/mnist.py` --epochs 10 reaches ~98%); digits is an
+easier corpus, same bar. INT8 drop bound is the reference's ≤0.5% top-1.
+"""
+import gzip
+import os
+import struct
+
+import numpy as onp
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import autograd, gluon, np
+from incubator_mxnet_tpu.contrib import quantization as q
+from incubator_mxnet_tpu.io import MNISTIter
+
+sklearn = pytest.importorskip("sklearn")
+from sklearn.datasets import load_digits  # noqa: E402
+
+
+def _write_idx_images(path, arr, gz=False):
+    """idx3-ubyte writer (the format `src/io/iter_mnist.cc` parses)."""
+    op = gzip.open if gz else open
+    with op(path, "wb") as f:
+        f.write(struct.pack(">HBB", 0, 0x08, 3))
+        f.write(struct.pack(">III", *arr.shape))
+        f.write(arr.astype(onp.uint8).tobytes())
+
+
+def _write_idx_labels(path, arr, gz=False):
+    op = gzip.open if gz else open
+    with op(path, "wb") as f:
+        f.write(struct.pack(">HBB", 0, 0x08, 1))
+        f.write(struct.pack(">I", arr.shape[0]))
+        f.write(arr.astype(onp.uint8).tobytes())
+
+
+@pytest.fixture(scope="module")
+def digits_idx(tmp_path_factory):
+    """Real handwritten digits split 80/20 and written as idx files —
+    train images gzipped to cover both reader branches."""
+    d = load_digits()
+    images = (d.images * (255.0 / 16.0)).astype(onp.uint8)  # (N, 8, 8)
+    labels = d.target.astype(onp.uint8)
+    rng = onp.random.RandomState(0)
+    perm = rng.permutation(len(images))
+    images, labels = images[perm], labels[perm]
+    n_tr = int(0.8 * len(images))
+    root = tmp_path_factory.mktemp("digits")
+    paths = {
+        "train_images": str(root / "train-images-idx3-ubyte.gz"),
+        "train_labels": str(root / "train-labels-idx1-ubyte"),
+        "test_images": str(root / "t10k-images-idx3-ubyte"),
+        "test_labels": str(root / "t10k-labels-idx1-ubyte"),
+    }
+    _write_idx_images(paths["train_images"], images[:n_tr], gz=True)
+    _write_idx_labels(paths["train_labels"], labels[:n_tr])
+    _write_idx_images(paths["test_images"], images[n_tr:])
+    _write_idx_labels(paths["test_labels"], labels[n_tr:])
+    return paths
+
+
+def _accuracy(net, x, y, bs=256):
+    correct = 0
+    for i in range(0, len(x), bs):
+        out = net(np.array(x[i:i + bs]))
+        correct += int((out.asnumpy().argmax(1) == y[i:i + bs]).sum())
+    return correct / len(x)
+
+
+def test_mlp_trains_to_97pct_via_mnistiter(digits_idx):
+    """Gluon MLP through the MNISTIter facade on real handwritten digits:
+    ≥97% held-out accuracy (the reference MNIST tutorial bar)."""
+    mx.random.seed(42)
+    train_iter = MNISTIter(image=digits_idx["train_images"],
+                           label=digits_idx["train_labels"],
+                           batch_size=64, flat=True, shuffle=True)
+    # batch_size divides the 360-sample test split exactly: NDArrayIter's
+    # pad mode would otherwise duplicate samples into the tail batch
+    test_iter = MNISTIter(image=digits_idx["test_images"],
+                          label=digits_idx["test_labels"],
+                          batch_size=120, flat=True)
+
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(256, activation="relu"),
+            gluon.nn.Dense(128, activation="relu"),
+            gluon.nn.Dense(10))
+    net.initialize()
+    net.hybridize()
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 2e-3})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    for _epoch in range(40):
+        train_iter.reset()
+        for batch in train_iter:
+            data, label = batch.data[0], batch.label[0]
+            with autograd.record():
+                loss = loss_fn(net(data), label).mean()
+            loss.backward()
+            trainer.step(data.shape[0])
+
+    correct = total = 0
+    test_iter.reset()
+    for batch in test_iter:
+        out = net(batch.data[0])
+        lab = batch.label[0].asnumpy().astype(onp.int64)
+        pred = out.asnumpy().argmax(1)
+        correct += int((pred[:len(lab)] == lab).sum())
+        total += len(lab)
+    acc = correct / total
+    assert acc >= 0.97, f"MLP test accuracy {acc:.4f} < 0.97"
+
+
+def _convnet_arch():
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Conv2D(16, 3, padding=1, activation="relu"),
+            gluon.nn.Conv2D(32, 3, padding=1, activation="relu"),
+            gluon.nn.MaxPool2D(2),
+            gluon.nn.Flatten(),
+            gluon.nn.Dense(64, activation="relu"),
+            gluon.nn.Dense(10))
+    return net
+
+
+@pytest.fixture(scope="module")
+def trained_convnet():
+    """Small conv net trained through the full Dataset→transforms→
+    DataLoader path to convergence; shared by the accuracy and INT8 tests."""
+    from incubator_mxnet_tpu.gluon.data import ArrayDataset, DataLoader
+    from incubator_mxnet_tpu.gluon.data.vision import transforms
+
+    mx.random.seed(7)
+    d = load_digits()
+    images = (d.images * (255.0 / 16.0)).astype(onp.uint8)[..., None]
+    labels = d.target.astype(onp.int32)
+    rng = onp.random.RandomState(1)
+    perm = rng.permutation(len(images))
+    images, labels = images[perm], labels[perm]
+    n_tr = int(0.8 * len(images))
+
+    tf = transforms.Compose([transforms.ToTensor(),
+                             transforms.Normalize(0.13, 0.3)])
+    train_ds = ArrayDataset(images[:n_tr], labels[:n_tr]).transform_first(tf)
+    loader = DataLoader(train_ds, batch_size=64, shuffle=True)
+
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Conv2D(16, 3, padding=1, activation="relu"),
+            gluon.nn.Conv2D(32, 3, padding=1, activation="relu"),
+            gluon.nn.MaxPool2D(2),
+            gluon.nn.Flatten(),
+            gluon.nn.Dense(64, activation="relu"),
+            gluon.nn.Dense(10))
+    net.initialize()
+    net.hybridize()
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 2e-3})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    for _epoch in range(12):
+        for data, label in loader:
+            with autograd.record():
+                loss = loss_fn(net(data), label).mean()
+            loss.backward()
+            trainer.step(data.shape[0])
+
+    def prep(split):
+        raw = images[:n_tr] if split == "train" else images[n_tr:]
+        x = (raw.astype(onp.float32) / 255.0 - 0.13) / 0.3
+        return x.transpose(0, 3, 1, 2)
+
+    x_test = prep("test")
+    y_test = labels[n_tr:]
+    x_train = prep("train")
+    return net, x_train, x_test, y_test
+
+
+def test_convnet_converges_through_dataloader(trained_convnet):
+    net, _x_train, x_test, y_test = trained_convnet
+    acc = _accuracy(net, x_test, y_test)
+    assert acc >= 0.97, f"convnet test accuracy {acc:.4f} < 0.97"
+
+
+def test_int8_accuracy_drop_within_half_percent(trained_convnet, tmp_path):
+    """fp32→int8 on the TRAINED net: ≤0.5% absolute accuracy drop
+    (reference: example/quantization/README.md table discipline).
+    Quantizes a weight-identical COPY — quantize_net rewrites in place and
+    the module-scoped fixture net is shared with the round-trip test."""
+    net, x_train, x_test, y_test = trained_convnet
+    acc_fp32 = _accuracy(net, x_test, y_test)
+    f = str(tmp_path / "fp32.params")
+    net.save_parameters(f)
+    qnet = _convnet_arch()
+    qnet.load_parameters(f)
+    calib = [np.array(x_train[i:i + 64]) for i in range(0, 320, 64)]
+    q.quantize_net(qnet, calib_data=calib, calib_mode="entropy",
+                   num_calib_batches=5)
+    acc_int8 = _accuracy(qnet, x_test, y_test)
+    assert acc_fp32 - acc_int8 <= 0.005, (acc_fp32, acc_int8)
+
+
+def test_pretrained_roundtrip_through_model_store(trained_convnet, tmp_path):
+    """export_to_store → get_model_file → load_parameters round-trip, and
+    the model_zoo `get_model(..., pretrained=True)` path against a store
+    root holding locally-registered zoo weights."""
+    from incubator_mxnet_tpu.gluon.model_zoo import vision as zoo
+    from incubator_mxnet_tpu.gluon.model_zoo.model_store import (
+        export_to_store, get_model_file)
+
+    net, _x_train, x_test, y_test = trained_convnet
+    root = str(tmp_path / "store")
+    fname = str(tmp_path / "digits_cnn.params")
+    net.save_parameters(fname)
+    del fname
+    export_to_store(net, "digits_cnn", root=root)
+    located = get_model_file("digits_cnn", root=root)
+    net2 = gluon.nn.HybridSequential()
+    net2.add(gluon.nn.Conv2D(16, 3, padding=1, activation="relu"),
+             gluon.nn.Conv2D(32, 3, padding=1, activation="relu"),
+             gluon.nn.MaxPool2D(2),
+             gluon.nn.Flatten(),
+             gluon.nn.Dense(64, activation="relu"),
+             gluon.nn.Dense(10))
+    net2.load_parameters(located)
+    assert _accuracy(net2, x_test, y_test) == _accuracy(net, x_test, y_test)
+
+    # zoo path: register real (untrained-but-saved) weights for a zoo name
+    # and load them back through get_model(pretrained=True)
+    mlp = zoo.get_model("mobilenetv2_0.25", pretrained=False)
+    mlp.initialize()
+    mlp(np.array(onp.zeros((1, 3, 32, 32), "float32")))
+    export_to_store(mlp, "mobilenetv2_0.25", root=root)
+    loaded = zoo.get_model("mobilenetv2_0.25", pretrained=True, root=root)
+    ref_param = list(mlp.collect_params().values())[0].data().asnumpy()
+    got_param = list(loaded.collect_params().values())[0].data().asnumpy()
+    onp.testing.assert_array_equal(ref_param, got_param)

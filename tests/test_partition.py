@@ -1,0 +1,208 @@
+"""Partition/subgraph backend API (`incubator_mxnet_tpu/partition.py`;
+reference: `src/operator/subgraph/subgraph_property.h:88,265,543` +
+`HybridBlock.optimize_for`). Covers: the op-level jaxpr outlining, chain
+matching + splicing, the built-in flash-attention and int8 backends, and
+a custom out-of-tree backend swapping a matched subgraph."""
+import math
+
+import numpy as onp
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import gluon, np, npx, partition
+from incubator_mxnet_tpu.partition import (Backend, Pattern, get_backend,
+                                           register_backend, rewrite_jaxpr)
+
+
+class _Attn(gluon.HybridBlock):
+    """Unfused attention written with framework ops — the match target."""
+
+    def __init__(self, d, scale=True):
+        super().__init__()
+        self._d = d
+        self._scale = scale
+
+    def forward(self, q, k, v):
+        s = npx.batch_dot(q, k, transpose_b=True)
+        if self._scale:
+            s = s / math.sqrt(self._d)
+        p = npx.softmax(s, axis=-1)
+        return npx.batch_dot(p, v)
+
+
+def _qkv(B=4, T=32, D=16, seed=0):
+    rng = onp.random.RandomState(seed)
+    return tuple(np.array(rng.randn(B, T, D).astype("float32"))
+                 for _ in range(3))
+
+
+def test_builtin_backends_registered():
+    assert "flash_attention" in partition.list_backends()
+    assert "int8" in partition.list_backends()
+    with pytest.raises(ValueError):
+        get_backend("no_such_backend")
+
+
+@pytest.mark.parametrize("scale", [True, False])
+def test_flash_attention_rewrite_matches_unfused(scale):
+    q, k, v = _qkv()
+    net = _Attn(16, scale=scale)
+    ref = net(q, k, v).asnumpy()
+    b = get_backend("flash_attention")
+    b.last_rewrites = 0
+    out = net.optimize_for(q, k, v, backend="flash_attention").asnumpy()
+    assert b.last_rewrites == 1
+    onp.testing.assert_allclose(out, ref, rtol=2e-5, atol=2e-5)
+    # the compiled path replays on later calls
+    out2 = net(q, k, v).asnumpy()
+    onp.testing.assert_allclose(out2, out, rtol=1e-6, atol=1e-6)
+
+
+def test_flash_rewrite_keeps_gradients():
+    """The spliced kernel must be differentiable through autograd."""
+    from incubator_mxnet_tpu import autograd
+
+    q, k, v = _qkv(seed=3)
+    net = _Attn(16)
+    for a in (q, k, v):
+        a.attach_grad()
+    with autograd.record():
+        ref = net(q, k, v)
+    ref.backward()
+    g_ref = q.grad.asnumpy().copy()
+
+    net2 = _Attn(16)
+    net2.optimize_for(q, k, v, backend="flash_attention")
+    for a in (q, k, v):
+        a.attach_grad()   # reset grads
+    with autograd.record():
+        out = net2(q, k, v)
+    out.backward()
+    onp.testing.assert_allclose(q.grad.asnumpy(), g_ref,
+                                rtol=2e-4, atol=2e-5)
+
+
+def test_guard_rejects_nonstandard_layout():
+    """transpose_b=False attention (k already transposed) must NOT fuse —
+    the guard can't identify the layout, so the graph stays unfused but
+    CORRECT."""
+    class OddAttn(gluon.HybridBlock):
+        def forward(self, q, kt, v):
+            s = npx.batch_dot(q, kt)          # k pre-transposed
+            p = npx.softmax(s, axis=-1)
+            return npx.batch_dot(p, v)
+
+    rng = onp.random.RandomState(1)
+    q = np.array(rng.randn(4, 32, 16).astype("float32"))
+    kt = np.array(rng.randn(4, 16, 32).astype("float32"))
+    v = np.array(rng.randn(4, 32, 16).astype("float32"))
+    net = OddAttn()
+    ref = net(q, kt, v).asnumpy()
+    b = get_backend("flash_attention")
+    b.last_rewrites = -1
+    out = net.optimize_for(q, kt, v, backend="flash_attention").asnumpy()
+    assert b.last_rewrites == 0
+    onp.testing.assert_allclose(out, ref, rtol=1e-6, atol=1e-6)
+
+
+def test_int8_backend_block_rewrite():
+    """optimize_for(backend='int8') routes through quantize_net."""
+    from incubator_mxnet_tpu.contrib import quantization as q
+
+    rng = onp.random.RandomState(2)
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(16, in_units=8, activation="relu"),
+            gluon.nn.Dense(4, in_units=16))
+    net.initialize()
+    x = np.array(rng.uniform(-1, 1, (8, 8)).astype("float32"))
+    ref = net(x).asnumpy()
+    out = net.optimize_for(
+        x, backend="int8",
+        backend_opts={"calib_data": [x], "calib_mode": "naive"}).asnumpy()
+    assert type(net._children["0"]) is q.QuantizedDense
+    assert onp.abs(out - ref).max() / (onp.abs(ref).max() + 1e-6) < 0.05
+
+
+def test_custom_backend_swaps_matched_subgraph():
+    """An out-of-tree backend: outline `gelu`, replace exact-erf gelu with
+    the tanh approximation — the VERDICT's 'custom hook swapping a matched
+    subgraph' acceptance case."""
+    import jax.numpy as jnp
+
+    def tanh_gelu(eqns, invals):   # noqa: ARG001
+        (x,) = invals
+        c = math.sqrt(2.0 / math.pi)
+        return 0.5 * x * (1.0 + jnp.tanh(c * (x + 0.044715 * x ** 3)))
+
+    class TanhGeluBackend(Backend):
+        name = "tanh_gelu_test"
+        mark_ops = frozenset({"gelu"})
+        patterns = [Pattern("gelu", ["gelu"], tanh_gelu)]
+
+    register_backend(TanhGeluBackend)
+
+    class Net(gluon.HybridBlock):
+        def forward(self, x):
+            return npx.gelu(x) * 2.0
+
+    rng = onp.random.RandomState(4)
+    x = np.array(rng.randn(8, 64).astype("float32"))
+    net = Net()
+    ref = net(x).asnumpy()
+    b = get_backend("tanh_gelu_test")
+    b.last_rewrites = 0
+    out = net.optimize_for(x, backend="tanh_gelu_test").asnumpy()
+    assert b.last_rewrites == 1
+    # tanh-approx differs from erf-exact but only slightly
+    assert not onp.array_equal(out, ref)
+    onp.testing.assert_allclose(out, ref, rtol=5e-3, atol=5e-3)
+
+
+def test_rewrite_jaxpr_direct():
+    """Matcher unit test on a hand-built jaxpr: single-consumer discipline
+    (no fuse when an intermediate feeds two consumers)."""
+    import jax
+
+    from incubator_mxnet_tpu.partition import backend_scope
+
+    b = get_backend("flash_attention")
+    rng = onp.random.RandomState(0)
+    qv = onp.random.randn(2, 8, 4).astype("float32")
+
+    def two_consumer(q, k, v):
+        from incubator_mxnet_tpu.ndarray.ndarray import NDArray
+
+        s = npx.batch_dot(NDArray(q), NDArray(k), transpose_b=True)
+        p = npx.softmax(s, axis=-1)
+        o = npx.batch_dot(p, NDArray(v))
+        # second consumer of the softmax output => chain must NOT fuse
+        return (o + p.sum())._data
+
+    with backend_scope(b):
+        closed = jax.make_jaxpr(two_consumer)(qv, qv, qv)
+    _, n = rewrite_jaxpr(closed, b.patterns)
+    assert n == 0
+    del rng
+
+
+def test_chain_input_produced_between_matched_eqns():
+    """v traced AFTER the softmax (interleaved producer): the splice must
+    land after v's producer or eval_jaxpr hits use-before-def."""
+    class LateV(gluon.HybridBlock):
+        def forward(self, q, k, x):
+            s = npx.batch_dot(q, k, transpose_b=True)
+            p = npx.softmax(s / 4.0, axis=-1)
+            v = x * 2.0 + 1.0            # produced between match and use
+            return npx.batch_dot(p, v)
+
+    rng = onp.random.RandomState(6)
+    q = np.array(rng.randn(2, 16, 8).astype("float32"))
+    k = np.array(rng.randn(2, 16, 8).astype("float32"))
+    x = np.array(rng.randn(2, 16, 8).astype("float32"))
+    net = LateV()
+    ref = net(q, k, x).asnumpy()
+    b = get_backend("flash_attention")
+    b.last_rewrites = 0
+    out = net.optimize_for(q, k, x, backend="flash_attention").asnumpy()
+    assert b.last_rewrites == 1
+    onp.testing.assert_allclose(out, ref, rtol=2e-5, atol=2e-5)

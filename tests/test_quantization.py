@@ -163,3 +163,174 @@ def test_quantize_requires_calib_data():
     net2.initialize()
     with pytest.raises(ValueError):
         q.quantize_net(net2, calib_mode="entropy")
+
+
+def test_fold_conv_bn_matches_fp32():
+    """Conv→BN folding (fold_conv_bn): the folded net must reproduce the
+    conv+BN inference output exactly (affine algebra), with the BN replaced
+    by Identity; parallel-branch declarations outside HybridSequential must
+    NOT be folded."""
+    rng = onp.random.RandomState(0)
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Conv2D(8, 3, padding=1, in_channels=4, use_bias=False),
+            gluon.nn.BatchNorm(in_channels=8),
+            gluon.nn.Activation("relu"),
+            gluon.nn.Conv2D(8, 3, padding=1, in_channels=8),
+            gluon.nn.BatchNorm(in_channels=8))
+    net.initialize()
+    x = np.array(rng.uniform(-1, 1, (2, 4, 8, 8)).astype("float32"))
+    net(x)
+    # give the running stats / affine params nontrivial values
+    for name, p in net.collect_params().items():
+        if "running_mean" in name or "beta" in name:
+            p.set_data(np.array(rng.uniform(-0.5, 0.5,
+                                            p.shape).astype("float32")))
+        if "running_var" in name or "gamma" in name:
+            p.set_data(np.array(rng.uniform(0.5, 2.0,
+                                            p.shape).astype("float32")))
+    ref = net(x).asnumpy()
+    n = q.fold_conv_bn(net)
+    assert n == 2
+    assert type(net._children["1"]) is gluon.nn.Identity
+    assert type(net._children["4"]) is gluon.nn.Identity
+    out = net(x).asnumpy()
+    onp.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+    # parallel branches declared adjacently in a NON-sequential block: no fold
+    class Branchy(gluon.HybridBlock):
+        def __init__(self):
+            super().__init__()
+            self.conv = gluon.nn.Conv2D(4, 1, in_channels=4)
+            self.bn = gluon.nn.BatchNorm(in_channels=4)  # separate branch!
+
+        def forward(self, x):
+            return self.conv(x) + self.bn(x)
+
+    b = Branchy()
+    b.initialize()
+    b(x)
+    assert q.fold_conv_bn(b) == 0
+
+
+def test_requantize_chain_matches_unchained():
+    """conv-bn-relu-conv chain: quantize_net with fold_bn+requantize stays
+    within int8 error of fp32 and chains the two convs through int8 (the
+    producer emits int8). Checkpoint round-trip of chained nets is covered
+    by test_chained_net_save_load_roundtrip."""
+    rng = onp.random.RandomState(1)
+    def build():
+        net = gluon.nn.HybridSequential()
+        net.add(gluon.nn.Conv2D(8, 3, padding=1, in_channels=4),
+                gluon.nn.BatchNorm(in_channels=8),
+                gluon.nn.Activation("relu"),
+                gluon.nn.Conv2D(8, 3, padding=1, in_channels=8))
+        net.initialize()
+        return net
+
+    net = build()
+    x = np.array(rng.uniform(-1, 1, (4, 4, 8, 8)).astype("float32"))
+    net(x)
+    ref = net(x).asnumpy()
+    q.quantize_net(net, calib_data=[x], calib_mode="naive")
+    conv1 = net._children["0"]
+    conv2 = net._children["3"]
+    assert type(conv1) is q.QuantizedConv2D
+    assert type(conv2) is q.QuantizedConv2D
+    assert conv1._out_threshold is conv2.qthreshold  # chained, shared param
+    out = net(x).asnumpy()
+    assert out.dtype == onp.float32  # last layer still emits f32
+    rel = onp.abs(out - ref).max() / (onp.abs(ref).max() + 1e-6)
+    assert rel < 0.06, rel
+    # requantize=False must leave the producer unchained (f32 between layers)
+    net2 = build()
+    net2(x)
+    q.quantize_net(net2, calib_data=[x], calib_mode="naive",
+                   requantize=False)
+    assert net2._children["0"]._out_threshold is None
+
+
+def test_chain_skips_non_relu_fused_activation():
+    """A producer with a fused sigmoid must NOT be requantize-chained: the
+    int8 emit happens before self.act, and sigmoid over int8 CODES is
+    garbage. relu-fused producers chain fine."""
+    rng = onp.random.RandomState(5)
+    x = np.array(rng.uniform(-1, 1, (8, 16)).astype("float32"))
+    for act, chained in (("sigmoid", False), ("relu", True), (None, True)):
+        net = gluon.nn.HybridSequential()
+        net.add(gluon.nn.Dense(32, in_units=16, activation=act),
+                gluon.nn.Dense(4, in_units=32))
+        net.initialize()
+        ref = net(x).asnumpy()
+        q.quantize_net(net, calib_data=[x], calib_mode="naive")
+        assert (net._children["0"]._out_threshold is not None) == chained, act
+        out = net(x).asnumpy()
+        rel = onp.abs(out - ref).max() / (onp.abs(ref).max() + 1e-6)
+        assert rel < 0.06, (act, rel)
+
+
+def test_fold_conv_bn_preserves_weight_dtype():
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Conv2D(8, 3, padding=1, in_channels=4),
+            gluon.nn.BatchNorm(in_channels=8))
+    net.initialize()
+    net(np.array(onp.zeros((1, 4, 8, 8), "float32")))
+    net.cast("bfloat16")
+    assert q.fold_conv_bn(net) == 1
+    assert onp.dtype(net._children["0"].weight.data().dtype) == "bfloat16"
+
+
+def test_dropout_p_one_returns_zeros():
+    from incubator_mxnet_tpu import npx
+    z = npx.dropout(np.array(onp.ones((16, 128), "float32")),
+                    p=1.0, mode="always")
+    assert float(onp.abs(z.asnumpy()).max()) == 0.0
+
+
+def test_chained_net_save_load_roundtrip(tmp_path):
+    """save_parameters/load_parameters round-trip of a requantize-CHAINED
+    net: the shared out-threshold must not double-register (no duplicate
+    checkpoint key), and a freshly-quantized same-structure net must load
+    the checkpoint and reproduce outputs exactly."""
+    rng = onp.random.RandomState(9)
+
+    def build_q(calib):
+        net = gluon.nn.HybridSequential()
+        net.add(gluon.nn.Conv2D(8, 3, padding=1, in_channels=4),
+                gluon.nn.BatchNorm(in_channels=8),
+                gluon.nn.Activation("relu"),
+                gluon.nn.Conv2D(8, 3, padding=1, in_channels=8))
+        net.initialize()
+        net(calib)
+        q.quantize_net(net, calib_data=[calib], calib_mode="naive")
+        return net
+
+    x = np.array(rng.uniform(-1, 1, (4, 4, 8, 8)).astype("float32"))
+    net = build_q(x)
+    # the chained producer must NOT register the shared threshold under
+    # its own name (no '_out_threshold' key, no renamed parameter)
+    keys = list(net.collect_params())
+    assert not any("_out_threshold" in k for k in keys), keys
+    out = net(x).asnumpy()
+    f = str(tmp_path / "chained.params")
+    net.save_parameters(f)
+    net2 = build_q(x)  # different init/calib; structure identical
+    net2.load_parameters(f)
+    onp.testing.assert_allclose(net2(x).asnumpy(), out,
+                                rtol=1e-5, atol=1e-6)
+
+
+def test_chained_bf16_net_keeps_dtype():
+    """In a bf16 net, the LAST layer of an int8 chain must emit bf16 (the
+    net's activation dtype), not hardcoded f32."""
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(32, in_units=16, activation="relu"),
+            gluon.nn.Dense(16, in_units=32))
+    net.initialize()
+    net.cast("bfloat16")
+    x = np.array(onp.random.RandomState(3)
+                 .uniform(-1, 1, (4, 16)).astype("float32")).astype("bfloat16")
+    net(x)
+    q.quantize_net(net, calib_data=[x], calib_mode="naive")
+    assert net._children["0"]._out_threshold is not None  # chained
+    out = net(x)
+    assert onp.dtype(out.dtype) == onp.dtype("bfloat16"), out.dtype
