@@ -104,6 +104,52 @@ def bench_dispatch_floor(iters=100):
     return (time.perf_counter() - t0) / iters * 1000.0
 
 
+def bench_input_pipeline(n_images=512, batch=64, epochs=2):
+    """Real-JPEG input pipeline images/sec: RecordIO pack → ImageRecordIter
+    (cv2 decode, crop/mirror augment, uint8 batch upload, device-side
+    cast+NCHW). Reported next to the synthetic-tensor train number; on
+    this runner the HOST HAS ONE CPU CORE, so this is the per-core
+    pipeline throughput (the reference's C++ pipeline assumes tens of
+    vCPUs — scale linearly with cores)."""
+    import os
+    import tempfile
+
+    from incubator_mxnet_tpu import io as mxio
+    from incubator_mxnet_tpu import recordio
+
+    import shutil
+
+    rng = onp.random.RandomState(0)
+    d = tempfile.mkdtemp(prefix="bench_pipe_")
+    rec_path = os.path.join(d, "imgs.rec")
+    w = recordio.MXIndexedRecordIO(os.path.join(d, "imgs.idx"),
+                                   rec_path, "w")
+    for i in range(n_images):
+        img = rng.randint(0, 255, (256, 256, 3), dtype=onp.uint8)
+        w.write_idx(i, recordio.pack_img(
+            recordio.IRHeader(0, float(i % 10), i, 0), img, quality=90))
+    w.close()
+    it = mxio.ImageRecordIter(path_imgrec=rec_path,
+                              data_shape=(3, 224, 224), batch_size=batch,
+                              shuffle=True, rand_crop=True,
+                              rand_mirror=True, preprocess_threads=8,
+                              prefetch_buffer=4)
+    try:
+        best = 0.0
+        for _ in range(epochs + 1):   # first epoch warms decode pools
+            cnt = 0
+            t0 = time.perf_counter()
+            for b in it:
+                b.data[0].wait_to_read()
+                cnt += b.data[0].shape[0]
+            best = max(best, cnt / (time.perf_counter() - t0))
+            it.reset()
+    finally:
+        it.close()
+        shutil.rmtree(d, ignore_errors=True)
+    return best
+
+
 def bench_resnet50_train(batch=128, iters=20, warmup=2):
     """images/sec: compiled train step (fwd+bwd+SGD) on gluon ResNet-50."""
     from incubator_mxnet_tpu import gluon, np, optimizer
@@ -206,6 +252,14 @@ def bench_resnet50_infer(batch=64, iters=20, warmup=2, int8=False):
 
 def main():
     extras = {}
+
+    # input pipeline FIRST: the host has one CPU core, and the decode pool
+    # measures ~8x slower once the later benches' dispatch threads exist
+    try:
+        extras["input_pipeline_img_s_per_core"] = round(
+            bench_input_pipeline(), 1)
+    except Exception as e:  # pragma: no cover
+        print(f"input pipeline bench failed: {e}", file=sys.stderr)
 
     def _retry(fn, tries=2):
         # the tunneled remote-compile service occasionally drops a response

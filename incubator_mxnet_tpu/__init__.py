@@ -84,6 +84,8 @@ from . import attribute  # noqa: F401
 from .attribute import AttrScope  # noqa: F401
 from . import runtime  # noqa: F401
 from . import rtc  # noqa: F401
+from . import partition  # noqa: F401
+from . import remat  # noqa: F401
 from . import callback  # noqa: F401
 from . import engine  # noqa: F401
 from . import context  # noqa: F401

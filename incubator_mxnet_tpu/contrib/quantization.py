@@ -237,6 +237,22 @@ def _constant(value):
     return Constant(NDArray(value))
 
 
+def _chain_dtype(layer, x):
+    """Activation dtype carried across an int8 requantize chain: an int8
+    input can't say what the net's float dtype is, so each producer
+    records it on its consumer before that consumer traces. Returns the
+    dtype this layer's output should restore to."""
+    x_dt = (x._data if isinstance(x, NDArray) else x).dtype
+    if x_dt == onp.int8:
+        chain_dt = layer.__dict__.get("_chain_in_dt", onp.float32)
+    else:
+        chain_dt = x_dt
+    consumer = layer.__dict__.get("_chain_consumer")
+    if layer._out_threshold is not None and consumer is not None:
+        consumer.__dict__["_chain_in_dt"] = chain_dt
+    return chain_dt
+
+
 class QuantizedDense(HybridBlock):
     """INT8 Dense (reference: quantized_fully_connected.cc). Holds int8
     weights + per-channel scales in Constant parameters; forward quantizes
@@ -266,17 +282,7 @@ class QuantizedDense(HybridBlock):
         flatten = self._flatten
         has_bias = self.qbias is not None
         has_out = self._out_threshold is not None
-        # activation dtype carried across an int8 chain: an int8 input
-        # can't tell us what the net's float dtype is, so each producer
-        # records it on its consumer before that consumer traces
-        x_dt = (x._data if isinstance(x, NDArray) else x).dtype
-        if x_dt == onp.int8:
-            chain_dt = self.__dict__.get("_chain_in_dt", onp.float32)
-        else:
-            chain_dt = x_dt
-        consumer = self.__dict__.get("_chain_consumer")
-        if has_out and consumer is not None:
-            consumer.__dict__["_chain_in_dt"] = chain_dt
+        chain_dt = _chain_dtype(self, x)
 
         def f(xv, wq, w_scale, thresh, *rest):
             s_x = thresh.astype(jnp.float32) / 127.0
@@ -350,14 +356,7 @@ class QuantizedConv2D(HybridBlock):
                                        self._dilate, self._groups)
         has_bias = self.qbias is not None
         has_out = self._out_threshold is not None
-        x_dt = (x._data if isinstance(x, NDArray) else x).dtype
-        if x_dt == onp.int8:
-            chain_dt = self.__dict__.get("_chain_in_dt", onp.float32)
-        else:
-            chain_dt = x_dt
-        consumer = self.__dict__.get("_chain_consumer")
-        if has_out and consumer is not None:
-            consumer.__dict__["_chain_in_dt"] = chain_dt
+        chain_dt = _chain_dtype(self, x)
 
         def f(xv, wq, w_scale, thresh, *rest):
             s_x = thresh.astype(jnp.float32) / 127.0

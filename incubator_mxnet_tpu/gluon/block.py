@@ -279,6 +279,9 @@ class _CachedGraph:
             from ..partition import apply_backend, get_backend
 
             fn = apply_backend(fn, get_backend(backend_name))
+        from .. import remat as _remat
+
+        fn = _remat.wrap(fn, getattr(block, "_flags", {}).get("remat"))
         mode = {"jitted": jax.jit(fn), "probe": probe, "ready": False}
         self._modes[training] = mode
         return mode
@@ -335,10 +338,15 @@ class HybridBlock(Block):
         self._flags = {}
 
     def hybridize(self, active=True, static_alloc=False, static_shape=False,
-                  backend=None, backend_opts=None, **kwargs):
+                  backend=None, backend_opts=None, remat=None, **kwargs):
+        """`remat`: activation-rematerialization policy for the compiled
+        forward (True / policy name / callable — see
+        `incubator_mxnet_tpu.remat`; None consults MXNET_BACKWARD_DO_MIRROR
+        / MXNET_MEMORY_OPT)."""
         self._active = active
         self._flags = dict(static_alloc=static_alloc, static_shape=static_shape,
-                           backend=backend, backend_opts=backend_opts, **kwargs)
+                           backend=backend, backend_opts=backend_opts,
+                           remat=remat, **kwargs)
         self._cached_graph = None
         for c in self._children.values():
             if isinstance(c, Block) and not isinstance(c, HybridBlock):

@@ -402,6 +402,58 @@ class NDArray:
     def __dlpack_device__(self):
         return self._data.__dlpack_device__()
 
+    # ------------------------------------------- numpy interop protocols
+    # (reference: `python/mxnet/numpy_dispatch_protocol.py` — NEP-18
+    # __array_function__ + NEP-13 __array_ufunc__, so `onp.mean(mx_arr)`
+    # dispatches into the framework and returns an NDArray instead of
+    # silently densifying through a slow generic path)
+
+    def __array__(self, dtype=None, copy=None):  # noqa: ARG002
+        a = self.asnumpy()
+        return a.astype(dtype) if dtype is not None else a
+
+    def __array_ufunc__(self, ufunc, method, *inputs, **kwargs):
+        from .. import numpy as mxnp
+
+        fn = getattr(mxnp, ufunc.__name__, None)
+        if (method == "__call__" and kwargs.get("out") is None
+                and kwargs.get("where", True) is True
+                and fn is not None and callable(fn)):
+            kwargs.pop("where", None)
+            return fn(*inputs, **kwargs)
+        # anything the framework can't dispatch (ufunc methods like
+        # .reduce, out=, where=, unmapped ufuncs) keeps the pre-protocol
+        # coercion behavior — NEP-13 would otherwise turn these
+        # previously-working calls into TypeErrors
+
+        def conv(o):
+            return o.asnumpy() if isinstance(o, NDArray) else o
+
+        result = getattr(ufunc, method)(*[conv(i) for i in inputs],
+                                        **{k: conv(v)
+                                           for k, v in kwargs.items()})
+        return result
+
+    def __array_function__(self, func, types, args, kwargs):  # noqa: ARG002
+        from .. import numpy as mxnp
+
+        fn = getattr(mxnp, func.__name__, None)
+        if fn is not None and callable(fn):
+            return fn(*args, **kwargs)
+        # numpy functions the framework doesn't dispatch (np.save,
+        # np.apply_along_axis, ...) keep the PRE-protocol behavior:
+        # coerce NDArrays to host numpy and run plain numpy (NEP-18 would
+        # otherwise turn these previously-working calls into TypeErrors)
+        def conv(o):
+            if isinstance(o, NDArray):
+                return o.asnumpy()
+            if isinstance(o, (list, tuple)):
+                return type(o)(conv(x) for x in o)
+            return o
+
+        return func(*[conv(a) for a in args],
+                    **{k: conv(v) for k, v in kwargs.items()})
+
     # ------------------------------------------------------------- operators
     def _binop(self, name, fn, other, reverse=False):
         a, b = (other, self) if reverse else (self, other)
@@ -534,10 +586,6 @@ class NDArray:
             return int(self.item())
         raise TypeError("only integer scalar arrays can be converted to an index")
 
-    def __array__(self, dtype=None):
-        a = self.asnumpy()
-        return a.astype(dtype) if dtype is not None else a
-
     def __repr__(self):
         try:
             vals = str(self.asnumpy())
@@ -607,7 +655,8 @@ def _call_profiled(name, pure_fn, tensor_vals):
     return outs
 
 
-def apply_op(name, jfn, args, kwargs=None, n_outputs=1, out=None):
+def apply_op(name, jfn, args, kwargs=None, n_outputs=1, out=None,
+             static_info=None):
     """Execute `jfn` over unwrapped jax values; wrap outputs; record on tape.
 
     - args: mixed NDArray / python scalars / numpy / jax values. Only NDArray
@@ -638,7 +687,9 @@ def apply_op(name, jfn, args, kwargs=None, n_outputs=1, out=None):
     if _active_partition_backend() is not None:
         # partition-backend tracing: outline marked ops into single named
         # eqns so subgraph patterns match framework ops, not primitives
-        pure_fn = _outline_op(name, pure_fn)
+        # (static_info — e.g. softmax's axis — rides in the eqn name so
+        # pattern guards can see closed-over op parameters)
+        pure_fn = _outline_op(name, pure_fn, static_info)
 
     outs = _call_profiled(name, pure_fn, tensor_vals)
     tuple_out = isinstance(outs, tuple)

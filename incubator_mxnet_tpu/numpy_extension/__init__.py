@@ -179,7 +179,9 @@ def softmax(data, axis=-1, length=None, temperature=None, use_length=False,
         return out.astype(np_dtype(dtype)) if dtype else out
 
     ln = length if (use_length or length is not None) else None
-    return apply_op("softmax", f, (data, ln) if ln is not None else (data, None))
+    return apply_op("softmax", f,
+                    (data, ln) if ln is not None else (data, None),
+                    static_info={"axis": axis})
 
 
 def log_softmax(data, axis=-1, temperature=None, dtype=None, **kwargs):  # noqa: ARG001

@@ -1,0 +1,107 @@
+"""Mixture-of-Experts with expert parallelism over an `ep` mesh axis.
+
+Reference role: the reference has no MoE implementation (GluonNLP-era
+MXNet predates it); this is a capability the TPU build adds because the
+sharding machinery makes it natural — experts shard one-per-group over
+`ep`, and token dispatch/return ride `lax.all_to_all` on ICI (the
+standard Switch/GShard layout, see the public scaling-book recipe).
+
+Design (capacity-factor dispatch, top-1 gating):
+- gate: tokens -> expert logits; each token routed to its argmax expert,
+  dropped beyond `capacity` per expert (counted with a cumsum rank —
+  compiler-friendly, no dynamic shapes).
+- dispatch: one-hot combine matrix (tokens × experts × capacity) contracts
+  tokens into per-expert slots; `all_to_all` moves slots to the expert's
+  device group; experts run their FFN on their own tokens; the return
+  all_to_all + combine matrix scatter tokens back (weighted by gate prob).
+
+Everything is einsum/all_to_all — static shapes, MXU contractions.
+"""
+from __future__ import annotations
+
+__all__ = ["moe_dispatch_combine", "moe_ffn_apply", "top1_gating"]
+
+
+def top1_gating(logits, capacity):
+    """Top-1 gating with capacity: returns (combine, dispatch_mask, aux).
+
+    logits: (T, E). combine: (T, E, C) f32 — gate prob at the token's
+    (expert, slot), zero elsewhere. dispatch: same support, 1.0 entries.
+    aux: load-balancing loss (mean fraction·prob product, Switch eq. 4).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    t, e = logits.shape
+    probs = jax.nn.softmax(logits, axis=-1)
+    expert = jnp.argmax(probs, axis=-1)                    # (T,)
+    gate = jnp.take_along_axis(probs, expert[:, None], 1)[:, 0]
+    onehot = jax.nn.one_hot(expert, e, dtype=jnp.float32)  # (T, E)
+    # slot rank of each token within its expert (arrival order)
+    rank = (jnp.cumsum(onehot, axis=0) - onehot) * onehot  # (T, E)
+    kept = (rank < capacity) * onehot                      # within capacity
+    slot = jnp.sum(rank * kept, axis=-1).astype(jnp.int32)  # (T,)
+    slot_oh = jax.nn.one_hot(slot, capacity,
+                             dtype=jnp.float32)            # (T, C)
+    dispatch = kept[:, :, None] * slot_oh[:, None, :]      # (T, E, C)
+    combine = dispatch * gate[:, None, None]
+    # Switch load-balance aux: E * mean_e(frac_tokens_e * mean_prob_e)
+    frac = jnp.mean(onehot, axis=0)
+    mean_prob = jnp.mean(probs, axis=0)
+    aux = e * jnp.sum(frac * mean_prob)
+    return combine, dispatch, aux
+
+
+def moe_dispatch_combine(x, gate_logits, expert_fn, capacity_factor=1.25,
+                         axis_name=None):
+    """Top-1 MoE layer body: dispatch -> expert_fn -> combine (GShard
+    token-sharded layout).
+
+    x: (T_local, D) — this device's token shard (the `ep` axis usually
+    coincides with the data axis); gate_logits: (T_local, E).
+    expert_fn(slots) with slots (E_local, C_total, D) -> same shape —
+    applied AFTER the dispatch all_to_all, so under expert parallelism it
+    sees only this device's experts but EVERY device's tokens for them.
+    Returns (out (T_local, D), aux_loss).
+    """
+    import jax.numpy as jnp
+    from jax import lax
+
+    t, e = gate_logits.shape
+    n_groups = 1 if axis_name is None else lax.axis_size(axis_name)
+    if e % n_groups:
+        raise ValueError(f"{e} experts not divisible over {n_groups} "
+                         "expert-parallel groups")
+    capacity = max(1, int(capacity_factor * t / e))
+    combine, dispatch, aux = top1_gating(gate_logits, capacity)
+    # local tokens -> per-expert slots
+    slots = jnp.einsum("td,tec->ecd", x, dispatch)         # (E, C, D)
+    if axis_name is not None:
+        # dispatch: each device keeps slots for ITS experts and receives
+        # the matching slots from every peer — expert axis splits G-ways,
+        # peers' contributions concatenate along the capacity axis
+        slots = lax.all_to_all(slots, axis_name, split_axis=0,
+                               concat_axis=1, tiled=True)
+        # -> (E/G, G*C, D)
+    out_slots = expert_fn(slots)
+    if axis_name is not None:
+        # return: inverse permutation
+        out_slots = lax.all_to_all(out_slots, axis_name, split_axis=1,
+                                   concat_axis=0, tiled=True)
+        # -> (E, C, D), rows for OUR tokens back home
+    out = jnp.einsum("ecd,tec->td", out_slots, combine)
+    return out, aux
+
+
+def moe_ffn_apply(w1, b1, w2, b2):
+    """Per-expert FFN: returns expert_fn for moe_dispatch_combine.
+    w1: (E_local, D, H), w2: (E_local, H, D)."""
+    import jax
+    import jax.numpy as jnp
+
+    def expert_fn(slots):                                  # (E, C, D)
+        h = jnp.einsum("ecd,edh->ech", slots, w1) + b1[:, None, :]
+        h = jax.nn.gelu(h)
+        return jnp.einsum("ech,ehd->ecd", h, w2) + b2[:, None, :]
+
+    return expert_fn

@@ -1,0 +1,94 @@
+"""Pipeline parallelism (GPipe schedule) over a `pp` mesh axis.
+
+Reference role: MXNet's model-parallel story is manual device placement
+(`example/model-parallel/`, ctx lists per layer) with the engine's
+dependency graph overlapping the stages. The TPU-native design is an SPMD
+pipeline: stage parameters are SHARDED over the `pp` axis (each device
+holds one stage), microbatches circulate stage-to-stage over ICI with
+`lax.ppermute`, and the whole schedule is ONE `lax.scan` inside
+`shard_map` — XLA overlaps the permute collectives with stage compute,
+the same overlap the reference gets from its threaded engine.
+
+Schedule: classic GPipe fill-drain. For S stages and M microbatches the
+scan runs S+M-1 ticks; tick t has stage s working on microbatch t-s
+(bubble fraction (S-1)/(S+M-1)).
+
+The per-stage function must be shape-preserving ((microbatch, ...) ->
+(microbatch, ...)), the natural shape for stacked transformer blocks —
+scan-over-layers composes: `stage_fn` itself may be a `lax.scan` over the
+layers within the stage.
+"""
+from __future__ import annotations
+
+__all__ = ["pipeline_apply", "pipeline_stage_params"]
+
+
+def pipeline_stage_params(params_per_layer, n_stages):
+    """Stack per-layer param pytrees into per-stage stacks: layers are
+    split contiguously into `n_stages` groups of L/S layers; leaf arrays
+    gain a leading (S, L/S) pair of axes, ready to shard axis 0 over pp."""
+    import jax
+    import jax.numpy as jnp
+
+    n_layers = len(params_per_layer)
+    if n_layers % n_stages:
+        raise ValueError(f"{n_layers} layers not divisible into "
+                         f"{n_stages} stages")
+    per = n_layers // n_stages
+    stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *params_per_layer)
+    return jax.tree.map(
+        lambda x: x.reshape((n_stages, per) + x.shape[1:]), stacked)
+
+
+def pipeline_apply(stage_fn, stage_params, x, axis_name="pp"):
+    """Run the GPipe schedule inside shard_map over `axis_name`.
+
+    - `stage_fn(params, act) -> act`: one stage's forward on ONE
+      microbatch (already holding only this device's stage params).
+    - `stage_params`: this device's slice (leading stage axis removed by
+      shard_map's in_spec).
+    - `x`: (n_micro, micro_batch, ...) — the full minibatch split into
+      microbatches, replicated across pp (each stage reads only the
+      microbatch it needs at fill time; XLA DCEs the rest).
+    Returns (n_micro, micro_batch, ...) outputs (valid on the LAST stage;
+    callers all-gather or read from stage S-1).
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    n_stages = lax.axis_size(axis_name)
+    stage = lax.axis_index(axis_name)
+    n_micro = x.shape[0]
+    ticks = n_stages + n_micro - 1
+    perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+
+    def tick(carry, t):
+        recv, outputs = carry
+        # stage 0 injects microbatch t (while it exists); later stages
+        # consume what the previous stage sent last tick
+        mb_idx = jnp.clip(t, 0, n_micro - 1)
+        injected = lax.dynamic_index_in_dim(x, mb_idx, 0, keepdims=False)
+        act_in = jnp.where(stage == 0, injected, recv)
+        act_out = stage_fn(stage_params, act_in)
+        # last stage banks its result for microbatch t-(S-1)
+        out_idx = jnp.clip(t - (n_stages - 1), 0, n_micro - 1)
+        take = jnp.logical_and(stage == n_stages - 1,
+                               t >= n_stages - 1)
+        current = lax.dynamic_index_in_dim(outputs, out_idx, 0,
+                                           keepdims=False)
+        banked = jnp.where(take, act_out, current)
+        outputs = lax.dynamic_update_index_in_dim(outputs, banked,
+                                                  out_idx, 0)
+        sent = lax.ppermute(act_out, axis_name, perm)
+        return (sent, outputs), None
+
+    # the carry becomes device-varying (ppermute/axis_index inside the
+    # body); under shard_map's varying-manual-axes typing the INITIAL
+    # carry must be marked varying too
+    zero = lax.pvary(jnp.zeros_like(x[0]), axis_name)
+    outputs0 = lax.pvary(jnp.zeros_like(x), axis_name)
+    (_, outputs), _ = lax.scan(tick, (zero, outputs0),
+                               jnp.arange(ticks))
+    del jax
+    return outputs

@@ -15,7 +15,7 @@ from ..ndarray.ndarray import NDArray
 __all__ = ["DataParallel", "shard_train_step"]
 
 
-def _build_pure_step(net, loss_fn, optimizer):
+def _build_pure_step(net, loss_fn, optimizer, remat_spec=None):
     """(param_vals, opt_states, t, x, y) -> (loss, new_params, new_states).
 
     Pure function suitable for jit: parameters are substituted into the
@@ -57,6 +57,10 @@ def _build_pure_step(net, loss_fn, optimizer):
         aux_new = tuple(nv for _, nv in aux_pairs)
         return loss.mean()._data, aux_new
 
+    from .. import remat as _remat
+
+    forward_loss = _remat.wrap(forward_loss, remat_spec)
+
     def step(param_vals, frozen_vals, opt_states, t, lr, wd, base_key, x, y):
         # t arrives as a device scalar and the per-step RNG key derives
         # from (base_key, t) ON DEVICE: the host never uploads a counter
@@ -86,7 +90,7 @@ class DataParallel:
     """
 
     def __init__(self, net, loss_fn, optimizer, mesh=None, data_axis="dp",
-                 param_shardings=None):
+                 param_shardings=None, remat=None):
         import jax
 
         self.net = net
@@ -94,7 +98,8 @@ class DataParallel:
         self.mesh = mesh
         self._t = 0
         (step, params, param_arrays, frozen_arrays,
-         aux_arrays_cell) = _build_pure_step(net, loss_fn, optimizer)
+         aux_arrays_cell) = _build_pure_step(net, loss_fn, optimizer,
+                                             remat_spec=remat)
         self.params = params
         self.param_arrays = param_arrays
         self.frozen_arrays = frozen_arrays

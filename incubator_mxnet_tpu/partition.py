@@ -147,9 +147,12 @@ def active_backend():
 _OUTLINED_PREFIX = "mxop_"
 
 
-def outline_op(name, pure_fn):
+def outline_op(name, pure_fn, static_info=None):
     """When a backend scope is active and `name` is marked, wrap the op's
-    pure function so it traces as ONE named pjit equation."""
+    pure function so it traces as ONE named pjit equation. `static_info`
+    (closed-over op parameters like softmax's axis) is encoded into the
+    eqn name — "mxop_softmax|axis=-1" — so pattern guards can inspect it
+    via `eqn_op_info`."""
     b = _SCOPE.backend
     if b is None:
         return pure_fn
@@ -162,7 +165,11 @@ def outline_op(name, pure_fn):
     def _outlined(*args, **kwargs):
         return pure_fn(*args, **kwargs)
 
-    _outlined.__name__ = _OUTLINED_PREFIX + name
+    suffix = ""
+    if static_info:
+        suffix = "|" + ",".join(f"{k}={static_info[k]}"
+                                for k in sorted(static_info))
+    _outlined.__name__ = _OUTLINED_PREFIX + name + suffix
     return jax.jit(_outlined)
 
 
@@ -173,9 +180,25 @@ def _eqn_op_name(eqn):
     if eqn.primitive.name in ("jit", "pjit"):
         name = eqn.params.get("name", "")
         if name.startswith(_OUTLINED_PREFIX):
-            return name[len(_OUTLINED_PREFIX):]
+            return name[len(_OUTLINED_PREFIX):].split("|", 1)[0]
         return f"pjit:{name}"
     return eqn.primitive.name
+
+
+def eqn_op_info(eqn):
+    """Parse an outlined eqn's static_info suffix back into a dict of
+    strings ("mxop_softmax|axis=-1" -> {"axis": "-1"}); {} otherwise."""
+    if eqn.primitive.name not in ("jit", "pjit"):
+        return {}
+    name = eqn.params.get("name", "")
+    if not name.startswith(_OUTLINED_PREFIX) or "|" not in name:
+        return {}
+    out = {}
+    for part in name.split("|", 1)[1].split(","):
+        if "=" in part:
+            k, v = part.split("=", 1)
+            out[k] = v
+    return out
 
 
 # ---------------------------------------------------------------------------
@@ -380,6 +403,12 @@ def _flash_guard(eqns):
     tk = k_aval.shape[1]
     if tuple(s_aval.shape) != (b, t, tk) or (tk == d and t == d):
         return False        # ambiguous square case
+    # the fused kernel softmaxes the LAST axis; reject chains whose
+    # softmax ran on any other axis (the outliner encodes it in the name)
+    soft = eqns[-2]
+    axis = eqn_op_info(soft).get("axis")
+    if axis not in ("-1", str(len(s_aval.shape) - 1)):
+        return False
     # optional scale stage must be a literal scalar (the pallas kernel
     # takes sm_scale as a static float)
     for eqn in eqns[1:-2]:

@@ -1,26 +1,45 @@
 """Profiler (reference: `python/mxnet/profiler.py` + `src/profiler/` — chrome
-tracing JSON, per-op aggregate stats).
+tracing JSON, per-op aggregate stats, true per-op DEVICE cost
+`src/profiler/profiler.h:263`).
 
-TPU-native: wraps the jax/XLA profiler (XPlane → TensorBoard / Perfetto) and
-keeps the reference's `set_config / start / stop / dump / dumps` API shape.
-Python-level op timing (the aggregate table) is collected by timing the
-apply_op funnel when profiling is on."""
+TPU-native: two complementary sources, merged at `dump()`:
+
+- host funnel timing: `record_op` times each apply_op dispatch (imperative
+  op latency — on an async device this measures dispatch, not execution);
+- DEVICE timeline: `start()` begins a jax/XLA profiler trace (XPlane);
+  `stop()` ends it and parses the captured chrome-trace, pulling the
+  per-op device events (fusions, custom calls, pjit programs) and their
+  durations. `dump()` writes ONE chrome://tracing JSON containing both
+  lanes; `dumps()` appends a device-side aggregate table.
+
+`set_config(profile_device=False)` disables the device trace;
+`set_config(tensorboard_logdir=...)` additionally keeps the raw XPlane
+artifacts where TensorBoard/XProf can load them.
+"""
 from __future__ import annotations
 
 import atexit
+import glob
+import gzip
 import json
 import os
+import shutil
+import tempfile
 import threading
 import time
 from collections import defaultdict
 
 __all__ = ["set_config", "set_state", "start", "stop", "dump", "dumps",
-           "pause", "resume", "Scope", "profiler_scope"]
+           "pause", "resume", "Scope", "profiler_scope", "device_events"]
 
 _CONFIG = {"filename": "profile.json", "profile_all": False,
-           "profile_imperative": True, "aggregate_stats": True}
-_STATE = {"running": False, "jax_tracing": False}
+           "profile_imperative": True, "aggregate_stats": True,
+           "profile_device": True}
+_STATE = {"running": False, "jax_tracing": False, "trace_dir": None,
+          "own_trace_dir": False}
 _EVENTS: list = []
+_DEVICE_EVENTS: list = []
+_DEVICE_AGG = defaultdict(lambda: [0, 0.0])        # count, total_us
 _AGG = defaultdict(lambda: [0, 0.0, float("inf"), 0.0])  # count, total, min, max
 _LOCK = threading.Lock()
 
@@ -38,15 +57,28 @@ def set_state(state="stop", profile_process="worker"):  # noqa: ARG001
 
 def start(profile_process="worker"):  # noqa: ARG001
     _STATE["running"] = True
+    if not _CONFIG.get("profile_device", True):
+        return
     logdir = _CONFIG.get("tensorboard_logdir")
     if logdir:
-        import jax
+        _STATE["trace_dir"] = logdir
+        _STATE["own_trace_dir"] = False
+    else:
+        _STATE["trace_dir"] = tempfile.mkdtemp(prefix="mxtpu_prof_")
+        _STATE["own_trace_dir"] = True
+    import jax
 
-        try:
-            jax.profiler.start_trace(logdir)
-            _STATE["jax_tracing"] = True
-        except Exception:
-            _STATE["jax_tracing"] = False
+    try:
+        jax.profiler.start_trace(_STATE["trace_dir"])
+        # wall-clock anchor: XPlane event timestamps are relative to trace
+        # start; dump() rebases them onto the host lane's epoch-µs clock
+        _STATE["trace_t0_us"] = time.time() * 1e6
+        _STATE["jax_tracing"] = True
+    except Exception:
+        _STATE["jax_tracing"] = False
+        if _STATE.get("own_trace_dir") and _STATE.get("trace_dir"):
+            shutil.rmtree(_STATE["trace_dir"], ignore_errors=True)
+        _STATE["trace_dir"] = None
 
 
 def stop(profile_process="worker"):  # noqa: ARG001
@@ -56,9 +88,55 @@ def stop(profile_process="worker"):  # noqa: ARG001
 
         try:
             jax.profiler.stop_trace()
+            _ingest_device_trace(_STATE["trace_dir"])
         except Exception:
             pass
+        finally:
+            if _STATE.get("own_trace_dir") and _STATE.get("trace_dir"):
+                shutil.rmtree(_STATE["trace_dir"], ignore_errors=True)
+            _STATE["trace_dir"] = None
         _STATE["jax_tracing"] = False
+
+
+def _ingest_device_trace(trace_dir):
+    """Parse the captured XPlane chrome-trace: keep the device/runtime
+    lanes' complete events (+ their metadata rows, remapped clear of the
+    host-funnel pid 0) and accumulate per-op device aggregates."""
+    paths = sorted(glob.glob(
+        os.path.join(trace_dir, "**", "*.trace.json.gz"), recursive=True))
+    if not paths:
+        return
+    with gzip.open(paths[-1]) as f:
+        data = json.load(f)
+    events = data.get("traceEvents", [])
+    lanes = {}
+    for e in events:
+        if e.get("ph") == "M" and e.get("name") == "process_name":
+            lanes[e["pid"]] = e.get("args", {}).get("name", "")
+    t0 = _STATE.get("trace_t0_us", 0.0)
+    with _LOCK:
+        for e in events:
+            pid = e.get("pid")
+            if pid not in lanes:
+                continue
+            kept = dict(e)
+            kept["pid"] = 1000 + pid       # host funnel events own pid 0
+            if "ts" in kept:
+                # rebase trace-relative µs onto the host epoch clock so
+                # host dispatch and device execution correlate in one view
+                kept["ts"] = float(kept["ts"]) + t0
+            _DEVICE_EVENTS.append(kept)
+            if e.get("ph") == "X" and lanes[pid].startswith("/device:"):
+                agg = _DEVICE_AGG[e.get("name", "?")]
+                agg[0] += 1
+                agg[1] += float(e.get("dur", 0))
+
+
+def device_events():
+    """Parsed device-timeline events from the last stop() (list of chrome
+    trace events; empty before any device trace completes)."""
+    with _LOCK:
+        return list(_DEVICE_EVENTS)
 
 
 def pause(profile_process="worker"):  # noqa: ARG001
@@ -86,29 +164,46 @@ def record_op(name, dur_s):
 
 
 def dump(finished=True, profile_process="worker"):  # noqa: ARG001
-    """Write chrome://tracing JSON (reference: profiler.py:125)."""
+    """Write ONE chrome://tracing JSON holding the host dispatch lane
+    (pid 0) and the device/runtime lanes from the jax trace
+    (reference: profiler.py:125 writes the C++ profiler's chrome trace)."""
     path = _CONFIG["filename"]
     with _LOCK:
-        payload = {"traceEvents": list(_EVENTS), "displayTimeUnit": "ms"}
+        merged = [{"name": "process_name", "ph": "M", "pid": 0,
+                   "args": {"name": "host: op dispatch"}}]
+        merged += list(_EVENTS)
+        merged += list(_DEVICE_EVENTS)
+        payload = {"traceEvents": merged, "displayTimeUnit": "ms"}
     with open(path, "w") as f:
         json.dump(payload, f)
     return path
 
 
 def dumps(reset=False, format="table", sort_by="total", ascending=False):  # noqa: ARG001
-    """Aggregate per-op stats table (reference: profiler.py:154)."""
+    """Aggregate per-op stats (reference: profiler.py:154): host dispatch
+    table, then the device-timeline table when a trace was captured."""
     with _LOCK:
         rows = [(name, c, tot * 1000, mn * 1000, mx * 1000)
                 for name, (c, tot, mn, mx) in _AGG.items()]
+        dev_rows = [(name, c, tot_us / 1000.0)
+                    for name, (c, tot_us) in _DEVICE_AGG.items()]
         if reset:
             _AGG.clear()
             _EVENTS.clear()
+            _DEVICE_AGG.clear()
+            _DEVICE_EVENTS.clear()
     key = {"total": 2, "count": 1, "min": 3, "max": 4}.get(sort_by, 2)
     rows.sort(key=lambda r: r[key], reverse=not ascending)
     lines = [f"{'Name':<40}{'Count':>8}{'Total(ms)':>12}{'Min(ms)':>10}"
              f"{'Max(ms)':>10}", "=" * 80]
     for name, c, tot, mn, mx in rows:
         lines.append(f"{name[:39]:<40}{c:>8}{tot:>12.3f}{mn:>10.3f}{mx:>10.3f}")
+    if dev_rows:
+        dev_rows.sort(key=lambda r: r[2], reverse=not ascending)
+        lines += ["", f"{'Device op':<48}{'Count':>8}{'Total(ms)':>12}",
+                  "=" * 80]
+        for name, c, tot in dev_rows:
+            lines.append(f"{name[:47]:<48}{c:>8}{tot:>12.3f}")
     return "\n".join(lines)
 
 

@@ -64,9 +64,9 @@ def unpack_img(s, iscolor=-1):  # noqa: ARG001
     if payload[:6] == b"\x93NUMPY":
         img = onp.load(_io.BytesIO(payload))
     else:
-        from .image import imdecode
+        from .image import imdecode_np
 
-        img = imdecode(payload).asnumpy()
+        img = imdecode_np(payload)   # host decode: no device round trip
     return header, img
 
 

@@ -114,3 +114,42 @@ def test_cond_lowers_both_branches():
     onp.testing.assert_allclose(net(xp).asnumpy(), 2 * onp.ones((2, 2)))
     # same compiled program must take the else branch on negative input
     onp.testing.assert_allclose(net(xn).asnumpy(), -2 * onp.ones((2, 2)))
+
+
+def test_foreach_lowers_to_lax_scan_under_trace():
+    """The traced-lowering claim, pinned structurally: a jitted foreach
+    must contain ONE `scan` equation (not T unrolled body copies)."""
+    import jax
+
+    from incubator_mxnet_tpu.ndarray.ndarray import NDArray
+
+    def f(xs, s0):
+        def body(x, st):
+            return x * 2 + st, st + x.sum()
+        out, st = npx.foreach(body, NDArray(xs), NDArray(s0))
+        return out._data, st._data
+
+    xs = onp.ones((16, 3), "float32")
+    s0 = onp.zeros((3,), "float32")
+    jaxpr = jax.make_jaxpr(f)(xs, s0)
+    prims = [e.primitive.name for e in jaxpr.jaxpr.eqns]
+    assert prims.count("scan") == 1, prims
+    # and no unrolled arithmetic: far fewer eqns than sequence length
+    assert len(prims) < 10, prims
+
+
+def test_while_loop_lowers_to_lax_while_under_trace():
+    import jax
+
+    from incubator_mxnet_tpu.ndarray.ndarray import NDArray
+
+    def f(x):
+        outs, states = npx.while_loop(
+            cond=lambda st: (st.sum() < 100.0),
+            func=lambda st: (st, [st * NDArray(onp.float32(1.5))]),
+            loop_vars=[NDArray(x)], max_iterations=50)
+        return states[0]._data
+
+    jaxpr = jax.make_jaxpr(f)(onp.ones((3,), "float32"))
+    prims = [e.primitive.name for e in jaxpr.jaxpr.eqns]
+    assert "while" in prims or "scan" in prims, prims

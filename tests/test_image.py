@@ -213,3 +213,30 @@ def test_inception_v3_forward():
     x = mnp.random.uniform(size=(1, 3, 299, 299))
     y = net(x)
     assert y.shape == (1, 10)
+
+
+def test_imageiter_fast_path_honors_dtype(tmp_path):
+    """uint8 fast path (geometric augs + trailing CastAug) must still
+    deliver the iterator's requested dtype."""
+    import numpy as onp
+
+    from incubator_mxnet_tpu import recordio
+    from incubator_mxnet_tpu.image import CreateAugmenter, ImageIter
+
+    rec = str(tmp_path / "a.rec")
+    idx = str(tmp_path / "a.idx")
+    w = recordio.MXIndexedRecordIO(idx, rec, "w")
+    rng = onp.random.RandomState(0)
+    for i in range(8):
+        img = rng.randint(0, 255, (32, 32, 3), dtype=onp.uint8)
+        w.write_idx(i, recordio.pack_img(
+            recordio.IRHeader(0, float(i), i, 0), img, quality=90))
+    w.close()
+    it = ImageIter(batch_size=4, data_shape=(3, 28, 28), path_imgrec=rec,
+                   aug_list=CreateAugmenter((3, 28, 28), rand_crop=True),
+                   dtype="float16")
+    assert it._device_cast is not None   # fast path engaged
+    batch = next(it)
+    assert str(batch.data[0].dtype) == "float16"
+    assert batch.data[0].shape == (4, 3, 28, 28)
+    it.close()

@@ -1,0 +1,165 @@
+"""Detection / indexing / sequence op depth (reference:
+`tests/python/unittest/test_operator.py` box/NMS/sequence families +
+`test_numpy_op.py` indexing rows): value checks against straightforward
+numpy goldens over parametrized shapes and formats."""
+import numpy as onp
+import pytest
+
+from incubator_mxnet_tpu import np as mnp
+from incubator_mxnet_tpu import npx
+from incubator_mxnet_tpu.ndarray.ndarray import NDArray
+
+
+def A(x):
+    return x.asnumpy() if hasattr(x, "asnumpy") else onp.asarray(x)
+
+
+def _boxes(n, seed=0):
+    r = onp.random.RandomState(seed)
+    xy = r.uniform(0, 0.6, (n, 2)).astype(onp.float32)
+    wh = r.uniform(0.1, 0.4, (n, 2)).astype(onp.float32)
+    return onp.concatenate([xy, xy + wh], axis=1)          # corner format
+
+
+def _iou_np(a, b):
+    tl = onp.maximum(a[:, None, :2], b[None, :, :2])
+    br = onp.minimum(a[:, None, 2:], b[None, :, 2:])
+    wh = onp.clip(br - tl, 0, None)
+    inter = wh[..., 0] * wh[..., 1]
+    ar_a = (a[:, 2] - a[:, 0]) * (a[:, 3] - a[:, 1])
+    ar_b = (b[:, 2] - b[:, 0]) * (b[:, 3] - b[:, 1])
+    return inter / (ar_a[:, None] + ar_b[None, :] - inter)
+
+
+@pytest.mark.parametrize("na,nb", [(1, 1), (4, 6), (10, 3), (1, 8)])
+def test_box_iou_corner(na, nb):
+    a, b = _boxes(na, 1), _boxes(nb, 2)
+    out = npx.box_iou(NDArray(a), NDArray(b), format="corner")
+    onp.testing.assert_allclose(A(out), _iou_np(a, b), rtol=1e-5, atol=1e-6)
+
+
+def test_box_iou_center_format_matches_corner():
+    a, b = _boxes(5, 3), _boxes(4, 4)
+
+    def to_center(x):
+        ctr = (x[:, :2] + x[:, 2:]) / 2
+        wh = x[:, 2:] - x[:, :2]
+        return onp.concatenate([ctr, wh], 1)
+
+    ref = A(npx.box_iou(NDArray(a), NDArray(b), format="corner"))
+    out = A(npx.box_iou(NDArray(to_center(a)), NDArray(to_center(b)),
+                        format="center"))
+    onp.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_box_nms_suppresses_overlaps():
+    # three boxes: #0 high score, #1 overlaps #0 heavily, #2 disjoint
+    data = onp.array([[0.9, 0.0, 0.0, 0.5, 0.5],
+                      [0.8, 0.05, 0.05, 0.55, 0.55],
+                      [0.7, 0.6, 0.6, 0.9, 0.9]], onp.float32)[None]
+    out = A(npx.box_nms(NDArray(data), overlap_thresh=0.5,
+                        score_index=0, coord_start=1))
+    kept_scores = sorted(s for s in out[0, :, 0].tolist() if s > 0)
+    assert kept_scores == pytest.approx([0.7, 0.9])
+
+
+@pytest.mark.parametrize("depth", [3, 7])
+@pytest.mark.parametrize("shape", [(4,), (2, 3)])
+def test_one_hot_shapes(shape, depth):
+    r = onp.random.RandomState(0)
+    idx = r.randint(0, depth, shape).astype(onp.int32)
+    out = A(npx.one_hot(NDArray(idx), depth))
+    ref = onp.eye(depth, dtype=onp.float32)[idx]
+    onp.testing.assert_array_equal(out, ref)
+
+
+@pytest.mark.parametrize("axis", [0, 1, -1])
+def test_pick_axes(axis):
+    r = onp.random.RandomState(1)
+    x = r.uniform(-1, 1, (4, 5)).astype(onp.float32)
+    n = x.shape[axis]
+    idx = r.randint(0, n, (x.shape[1 - (axis % 2)],)).astype(onp.int32)
+    out = A(npx.pick(NDArray(x), NDArray(idx), axis=axis))
+    ref = onp.take_along_axis(
+        x, onp.expand_dims(idx, axis % 2), axis % 2).squeeze(axis % 2)
+    onp.testing.assert_allclose(out, ref, rtol=1e-6)
+
+
+@pytest.mark.parametrize("k", [1, 3])
+@pytest.mark.parametrize("ret_typ", ["value", "indices"])
+def test_topk(k, ret_typ):
+    r = onp.random.RandomState(2)
+    x = r.uniform(-1, 1, (3, 8)).astype(onp.float32)
+    out = A(npx.topk(NDArray(x), k=k, ret_typ=ret_typ, axis=-1))
+    order = onp.argsort(-x, axis=-1)[:, :k]
+    if ret_typ == "indices":
+        onp.testing.assert_array_equal(out.astype(onp.int64), order)
+    else:
+        ref = onp.take_along_axis(x, order, -1)
+        onp.testing.assert_allclose(out, ref, rtol=1e-6)
+
+
+@pytest.mark.parametrize("use_len", [True, False])
+def test_sequence_reverse(use_len):
+    r = onp.random.RandomState(3)
+    x = r.uniform(-1, 1, (4, 2, 3)).astype(onp.float32)   # (T, N, C)
+    if use_len:
+        lens = NDArray(onp.array([2, 4], onp.int32))
+        out = A(npx.sequence_reverse(NDArray(x), lens,
+                                     use_sequence_length=True))
+        ref = x.copy()
+        ref[:2, 0] = x[:2, 0][::-1]
+        ref[:, 1] = x[:, 1][::-1]
+    else:
+        out = A(npx.sequence_reverse(NDArray(x)))
+        ref = x[::-1]
+    onp.testing.assert_allclose(out, ref, rtol=1e-6)
+
+
+def test_sequence_last_with_lengths():
+    r = onp.random.RandomState(4)
+    x = r.uniform(-1, 1, (5, 3, 2)).astype(onp.float32)
+    lens = NDArray(onp.array([1, 3, 5], onp.int32))
+    out = A(npx.sequence_last(NDArray(x), lens, use_sequence_length=True))
+    ref = onp.stack([x[0, 0], x[2, 1], x[4, 2]])
+    onp.testing.assert_allclose(out, ref, rtol=1e-6)
+
+
+@pytest.mark.parametrize("shape,idx_shape", [((4, 3), (2, 5)),
+                                             ((2, 3, 4), (1, 6))])
+def test_gather_nd(shape, idx_shape):
+    r = onp.random.RandomState(5)
+    x = r.uniform(-1, 1, shape).astype(onp.float32)
+    idx = r.randint(0, shape[0], idx_shape).astype(onp.int32)
+    out = A(npx.gather_nd(NDArray(x), NDArray(idx)))
+    ref = x[tuple(idx)]
+    onp.testing.assert_allclose(out, ref, rtol=1e-6)
+
+
+def test_batch_take():
+    r = onp.random.RandomState(6)
+    x = r.uniform(-1, 1, (4, 5)).astype(onp.float32)
+    idx = r.randint(0, 5, (4,)).astype(onp.int32)
+    out = A(npx.batch_take(NDArray(x), NDArray(idx)))
+    ref = x[onp.arange(4), idx]
+    onp.testing.assert_allclose(out, ref, rtol=1e-6)
+
+
+@pytest.mark.parametrize("clip", [True, False])
+def test_box_encode_decode_roundtrip(clip):
+    anchors = _boxes(6, 7)[None]
+    r = onp.random.RandomState(8)
+    refs = _boxes(6, 9)[None]
+    means = (0.0, 0.0, 0.0, 0.0)
+    stds = (0.1, 0.1, 0.2, 0.2)
+    samples = onp.ones((1, 6), onp.float32)
+    matches = onp.arange(6, dtype=onp.int32).reshape(1, 6)
+    targets, masks = npx.box_encode(
+        NDArray(samples), NDArray(matches.astype(onp.float32)),
+        NDArray(anchors), NDArray(refs), means=means, stds=stds)
+    decoded = npx.box_decode(targets, NDArray(anchors), std0=stds[0],
+                             std1=stds[1], std2=stds[2], std3=stds[3],
+                             clip=-1.0 if not clip else 1.5,
+                             format="corner")
+    onp.testing.assert_allclose(A(decoded)[0], refs[0], rtol=1e-3, atol=2e-3)
+    del r

@@ -1,0 +1,89 @@
+"""NumPy dispatch protocol on NDArray (reference:
+`python/mxnet/numpy_dispatch_protocol.py` — NEP-18/NEP-13): plain-numpy
+functions called ON framework arrays dispatch into the framework and
+return NDArrays."""
+import numpy as onp
+
+from incubator_mxnet_tpu import np
+from incubator_mxnet_tpu.ndarray.ndarray import NDArray
+
+
+def _arr(shape, seed=0):
+    return np.array(onp.random.RandomState(seed)
+                    .uniform(-1, 1, shape).astype("float32"))
+
+
+def test_array_function_mean_stack_where():
+    x = _arr((4, 5))
+    y = _arr((4, 5), seed=1)
+
+    m = onp.mean(x, axis=1)
+    assert isinstance(m, NDArray)
+    onp.testing.assert_allclose(m.asnumpy(), x.asnumpy().mean(1), rtol=1e-6)
+
+    s = onp.stack([x, y])
+    assert isinstance(s, NDArray)
+    assert s.shape == (2, 4, 5)
+
+    c = onp.where(x.asnumpy() > 0)  # plain numpy stays plain numpy
+    w = onp.where(x > 0, x, y)
+    assert isinstance(w, NDArray)
+    onp.testing.assert_allclose(
+        w.asnumpy(), onp.where(x.asnumpy() > 0, x.asnumpy(), y.asnumpy()))
+    del c
+
+
+def test_array_ufunc_binary_and_unary():
+    x = _arr((3, 4))
+    y = _arr((3, 4), seed=2)
+    z = onp.add(x, y)
+    assert isinstance(z, NDArray)
+    onp.testing.assert_allclose(z.asnumpy(), x.asnumpy() + y.asnumpy(),
+                                rtol=1e-6)
+    e = onp.exp(x)
+    assert isinstance(e, NDArray)
+    onp.testing.assert_allclose(e.asnumpy(), onp.exp(x.asnumpy()), rtol=1e-6)
+    # mixed NDArray + numpy operand: still dispatches to the framework
+    z2 = onp.multiply(x, y.asnumpy())
+    assert isinstance(z2, NDArray)
+
+
+def test_array_coercion():
+    x = _arr((2, 3))
+    a = onp.asarray(x)
+    assert type(a) is onp.ndarray
+    onp.testing.assert_array_equal(a, x.asnumpy())
+    a64 = onp.asarray(x, dtype="float64")
+    assert a64.dtype == onp.float64
+
+
+def test_unsupported_protocol_paths_coerce_to_host():
+    x = _arr((2, 2))
+    # calls the framework can't dispatch (masked where=, out=, ufunc
+    # methods) degrade to HOST numpy via coercion — the pre-protocol
+    # behavior — returning plain numpy arrays
+    out = onp.add(x, x, where=onp.array([[True, False], [True, True]]))
+    assert type(out) is onp.ndarray
+    onp.testing.assert_allclose(out[0, 0], 2 * x.asnumpy()[0, 0])
+    red = onp.add.reduce(x)            # ufunc method
+    assert type(red) is onp.ndarray
+    onp.testing.assert_allclose(red, x.asnumpy().sum(0), rtol=1e-6)
+    buf = onp.zeros((2, 2), "float32")
+    onp.multiply(x, 2.0, out=buf)      # out= kwarg
+    onp.testing.assert_allclose(buf, 2 * x.asnumpy(), rtol=1e-6)
+
+
+def test_undispatched_numpy_functions_coerce():
+    """Functions absent from the framework namespace (np.save etc.) keep
+    the pre-protocol coercion behavior instead of raising under NEP-18."""
+    import os
+    import tempfile
+
+    x = _arr((3, 4))
+    f = tempfile.mktemp(suffix=".npy")
+    try:
+        onp.save(f, x)
+        onp.testing.assert_allclose(onp.load(f), x.asnumpy())
+    finally:
+        if os.path.exists(f):
+            os.remove(f)

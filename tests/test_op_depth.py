@@ -1,0 +1,391 @@
+"""Operator depth sweeps (VERDICT r2 item 6; reference model:
+`tests/python/unittest/test_numpy_op.py` + `test_operator.py` — dtype
+sweeps, broadcasting edge shapes, degenerate/empty inputs, and
+finite-difference gradient checks via `test_utils.check_numeric_gradient`
+(reference `python/mxnet/test_utils.py:1044`))."""
+import numpy as onp
+import pytest
+
+from incubator_mxnet_tpu import autograd
+from incubator_mxnet_tpu import np as mnp
+from incubator_mxnet_tpu import npx
+from incubator_mxnet_tpu.ndarray.ndarray import NDArray
+from incubator_mxnet_tpu.test_utils import check_numeric_gradient
+
+RS = onp.random.RandomState(7)
+
+
+def A(x):
+    return x.asnumpy() if hasattr(x, "asnumpy") else onp.asarray(x)
+
+
+def _golden(name, *args):
+    f = getattr(onp, name)
+    return f(*[a.astype(onp.float64) for a in args])
+
+
+# ---------------------------------------------------------------------------
+# low-precision dtype sweeps: bf16 has ~8 mantissa bits, f16 ~11 — XLA
+# numerics genuinely diverge from f32 here, which the f32-only sweep in
+# test_numpy_sweep.py cannot see
+# ---------------------------------------------------------------------------
+
+LOWP_UNARY = [
+    "negative", "abs", "sign", "floor", "ceil", "trunc", "sqrt", "square",
+    "exp", "log", "log1p", "sin", "cos", "tanh", "arctan", "sinh", "cosh",
+    "arcsinh", "reciprocal", "cbrt", "expm1", "log2", "log10", "rint",
+    "degrees", "radians",
+]
+_TOL = {"bfloat16": (4e-2, 4e-2), "float16": (4e-3, 4e-3)}
+
+
+@pytest.mark.parametrize("dtype", ["bfloat16", "float16"])
+@pytest.mark.parametrize("name", LOWP_UNARY)
+def test_unary_low_precision(name, dtype):
+    x = RS.uniform(0.3, 1.7, (4, 8)).astype(onp.float32)
+    ref = _golden(name, x)
+    out = getattr(mnp, name)(mnp.array(x).astype(dtype))
+    assert onp.dtype(out.dtype) == onp.dtype(dtype)
+    rtol, atol = _TOL[dtype]
+    onp.testing.assert_allclose(A(out).astype(onp.float64), ref,
+                                rtol=rtol, atol=atol)
+
+
+LOWP_BINARY = ["add", "subtract", "multiply", "divide", "maximum",
+               "minimum", "power", "hypot", "arctan2", "logaddexp"]
+
+
+@pytest.mark.parametrize("dtype", ["bfloat16", "float16"])
+@pytest.mark.parametrize("name", LOWP_BINARY)
+def test_binary_low_precision(name, dtype):
+    x = RS.uniform(0.3, 1.7, (4, 8)).astype(onp.float32)
+    y = RS.uniform(0.3, 1.7, (4, 8)).astype(onp.float32)
+    ref = _golden(name, x, y)
+    out = getattr(mnp, name)(mnp.array(x).astype(dtype),
+                             mnp.array(y).astype(dtype))
+    rtol, atol = _TOL[dtype]
+    onp.testing.assert_allclose(A(out).astype(onp.float64), ref,
+                                rtol=rtol, atol=atol)
+
+
+# ---------------------------------------------------------------------------
+# broadcasting / degenerate shapes
+# ---------------------------------------------------------------------------
+
+BCAST_PAIRS = [
+    ((4, 5), (5,)),
+    ((4, 1), (1, 5)),
+    ((1,), (4, 5)),
+    ((3, 1, 5), (1, 4, 1)),
+    ((2, 3, 4, 5), (5,)),
+    ((0, 5), (5,)),        # zero-size leading dim
+    ((4, 5), ()),          # scalar operand
+]
+BCAST_OPS = ["add", "subtract", "multiply", "divide", "maximum", "minimum",
+             "power", "arctan2"]
+
+
+@pytest.mark.parametrize("shapes", BCAST_PAIRS,
+                         ids=[f"{a}x{b}" for a, b in BCAST_PAIRS])
+@pytest.mark.parametrize("name", BCAST_OPS)
+def test_binary_broadcasting(name, shapes):
+    sa, sb = shapes
+    x = RS.uniform(0.3, 1.7, sa).astype(onp.float32)
+    y = RS.uniform(0.3, 1.7, sb).astype(onp.float32)
+    ref = _golden(name, x, y)
+    out = getattr(mnp, name)(mnp.array(x), mnp.array(y))
+    assert out.shape == ref.shape
+    onp.testing.assert_allclose(A(out).astype(onp.float64), ref,
+                                rtol=2e-5, atol=1e-6)
+
+
+REDUCTIONS = ["sum", "mean", "prod", "max", "min", "var", "std"]
+RED_CASES = [
+    ((4, 5), None, False),
+    ((4, 5), 0, False),
+    ((4, 5), 1, True),
+    ((3, 4, 5), (0, 2), False),
+    ((4, 0, 5), 1, False),     # reduce over an EMPTY axis
+    ((1,), 0, False),
+]
+
+
+@pytest.mark.parametrize("case", RED_CASES,
+                         ids=[f"{s}-ax{a}-k{k}" for s, a, k in RED_CASES])
+@pytest.mark.parametrize("name", REDUCTIONS)
+def test_reductions_shapes(name, case):
+    shape, axis, keepdims = case
+    if 0 in shape and name in ("max", "min"):
+        pytest.skip("max/min of empty slice is undefined (numpy raises)")
+    x = RS.uniform(0.5, 1.5, shape).astype(onp.float32)
+    ref = getattr(onp, name)(x.astype(onp.float64), axis=axis,
+                             keepdims=keepdims)
+    out = getattr(mnp, name)(mnp.array(x), axis=axis, keepdims=keepdims)
+    assert tuple(out.shape) == tuple(onp.shape(ref))
+    onp.testing.assert_allclose(A(out).astype(onp.float64), ref,
+                                rtol=3e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize("name", ["sum", "mean", "max"])
+def test_reductions_large(name):
+    """1M-element reduce: accumulation-order numerics at scale."""
+    x = RS.uniform(-1, 1, (1024, 1024)).astype(onp.float32)
+    ref = getattr(onp, name)(x.astype(onp.float64))
+    out = getattr(mnp, name)(mnp.array(x))
+    onp.testing.assert_allclose(float(A(out)), ref, rtol=1e-4)
+
+
+# ---------------------------------------------------------------------------
+# finite-difference gradient checks over the npx nn corpus
+# ---------------------------------------------------------------------------
+
+def _u(*s):
+    # order-independent inputs: seeded per shape, not from the shared
+    # module stream (tests must not change behavior with execution order)
+    r = onp.random.RandomState(abs(hash(("u",) + s)) % (2**31))
+    return NDArray(r.uniform(-0.9, 0.9, s).astype("float32"))
+
+
+def _up(*s):
+    r = onp.random.RandomState(abs(hash(("up",) + s)) % (2**31))
+    return NDArray(r.uniform(0.3, 1.5, s).astype("float32"))
+
+
+_W34 = NDArray(onp.random.RandomState(11)
+               .uniform(0.5, 2.0, (3, 4)).astype("float32"))
+
+
+def _cng(fn, inputs, **kw):
+    """check_numeric_gradient with f32-appropriate finite-difference
+    settings: losses evaluate in float32, so at eps=1e-3 the central
+    difference resolves only ~2e-4 absolute (ulp(loss)/2eps) and marginal
+    comparisons flip with XLA accumulation order. eps=5e-3 balances the
+    rounding term (ulp/eps ≈ 5e-5) against the truncation term
+    (f'''·eps²/6 ≈ 1e-5) for O(1)-smooth ops."""
+    kw.setdefault("eps", 5e-3)
+    kw.setdefault("rtol", 2e-2)
+    kw.setdefault("atol", 5e-4)
+    return check_numeric_gradient(fn, inputs, **kw)
+
+
+GRAD_UNARY = [
+    ("relu_shifted", lambda x: npx.relu(x + 1.3)),  # keep off the kink
+    ("sigmoid", npx.sigmoid),
+    ("tanh_act", lambda x: npx.activation(x, act_type="tanh")),
+    ("softrelu", lambda x: npx.activation(x, act_type="softrelu")),
+    ("softsign", lambda x: npx.activation(x, act_type="softsign")),
+    ("gelu", npx.gelu),
+    # softmax-family outputs sum to one per row, so a plain .sum() loss has
+    # an identically-zero gradient — weight the outputs to break the
+    # degeneracy (same trick as the reference's softmax grad tests)
+    ("softmax", lambda x: npx.softmax(x, axis=-1) * _W34),
+    ("log_softmax", lambda x: npx.log_softmax(x, axis=-1) * _W34),
+    ("softmin", lambda x: npx.softmax(-x, axis=-1) * _W34),
+    ("l2_normalization", npx.l2_normalization),
+    ("smooth_l1", npx.smooth_l1),
+    ("erf", npx.erf),
+]
+
+
+@pytest.mark.parametrize("case", GRAD_UNARY, ids=[c[0] for c in GRAD_UNARY])
+def test_numeric_grad_unary(case):
+    _name, fn = case
+    _cng(fn, [_u(3, 4)])
+
+
+def test_numeric_grad_leaky_relu_modes():
+    _cng(
+        lambda x: npx.leaky_relu(x + 1.1, act_type="leaky", slope=0.3),
+        [_u(3, 4)])
+    _cng(
+        lambda x: npx.leaky_relu(x + 1.1, act_type="elu", slope=0.4),
+        [_u(3, 4)])
+
+
+def test_numeric_grad_fully_connected():
+    _cng(
+        lambda x, w, b: npx.fully_connected(x, w, b, num_hidden=4),
+        [_u(2, 6), _u(4, 6), _u(4)])
+
+
+def test_numeric_grad_layer_norm():
+    _cng(
+        lambda x, g, b: npx.layer_norm(x, g, b, axis=-1),
+        [_u(3, 6), _up(6), _u(6)])
+
+
+def test_numeric_grad_group_norm():
+    # gamma/beta are per-CHANNEL (C=4), as in the reference GroupNorm
+    _cng(
+        lambda x, g, b: npx.group_norm(x, g, b, num_groups=2),
+        [_u(2, 4, 3), _up(4), _u(4)])
+
+
+def test_numeric_grad_batch_norm_inference():
+    mean, var = _u(3), _up(3)
+    _cng(
+        lambda x, g, b: npx.batch_norm(x, g, b, mean, var,
+                                       use_global_stats=True),
+        [_u(2, 3, 4), _up(3), _u(3)])
+
+
+def test_numeric_grad_convolution_2d():
+    _cng(
+        lambda x, w, b: npx.convolution(x, w, b, kernel=(3, 3),
+                                        num_filter=2, pad=(1, 1)),
+        [_u(1, 2, 4, 4), _u(2, 2, 3, 3), _u(2)])
+
+
+def test_numeric_grad_convolution_1d():
+    _cng(
+        lambda x, w, b: npx.convolution(x, w, b, kernel=(3,), num_filter=2),
+        [_u(1, 2, 6), _u(2, 2, 3), _u(2)])
+
+
+def test_numeric_grad_pooling():
+    _cng(
+        lambda x: npx.pooling(x, kernel=(2, 2), pool_type="avg",
+                              stride=(2, 2)),
+        [_u(1, 2, 4, 4)])
+    # max pooling: gradient defined a.e.; inputs drawn continuous so ties
+    # have probability ~0
+    _cng(
+        lambda x: npx.pooling(x, kernel=(2, 2), pool_type="max",
+                              stride=(2, 2)),
+        [_u(1, 2, 4, 4)])
+
+
+def test_numeric_grad_batch_dot():
+    _cng(
+        lambda a, b: npx.batch_dot(a, b),
+        [_u(2, 3, 4), _u(2, 4, 2)])
+    _cng(
+        lambda a, b: npx.batch_dot(a, b, transpose_b=True),
+        [_u(2, 3, 4), _u(2, 2, 4)])
+
+
+def test_numeric_grad_embedding():
+    idx = NDArray(onp.array([[0, 2], [1, 0]], onp.int32))
+    _cng(
+        lambda w: npx.embedding(idx, w, input_dim=3, output_dim=4),
+        [_u(3, 4)])
+
+
+def test_numeric_grad_sequence_mask():
+    lens = NDArray(onp.array([1, 2], onp.int32))
+    _cng(
+        lambda x: npx.sequence_mask(x, lens, use_sequence_length=True),
+        [_u(3, 2, 4)])
+
+
+def test_numeric_grad_roi_align():
+    rois = NDArray(onp.array([[0, 0.5, 0.5, 2.5, 2.5]], onp.float32))
+    _cng(
+        lambda x: npx.roi_align(x, rois, pooled_size=(2, 2),
+                                spatial_scale=1.0),
+        [_up(1, 2, 4, 4)])
+
+
+def test_numeric_grad_bilinear_sampler():
+    grid = NDArray(RS.uniform(-0.6, 0.6, (1, 2, 3, 3)).astype("float32"))
+    # sampler grads are sums of small f32 interpolation weights; widen eps
+    # and atol to clear central-difference rounding noise
+    _cng(
+        lambda x: npx.bilinear_sampler(x, grid),
+        [_up(1, 2, 4, 4)], atol=2e-3)
+
+
+def test_numeric_grad_grid_generator():
+    affine = NDArray(onp.array([[1.0, 0.1, 0.0, 0.1, 1.0, 0.0]],
+                               onp.float32))
+    out = npx.grid_generator(affine, transform_type="affine",
+                             target_shape=(3, 3))
+    assert out.shape == (1, 2, 3, 3)
+    _cng(
+        lambda a: npx.grid_generator(a, transform_type="affine",
+                                     target_shape=(3, 3)),
+        [NDArray(onp.array([[1.0, 0.1, 0.0, 0.1, 1.0, 0.0]], onp.float32))])
+
+
+# ---------------------------------------------------------------------------
+# fft / ifft value checks vs numpy
+# ---------------------------------------------------------------------------
+
+def test_fft_matches_numpy():
+    x = RS.uniform(-1, 1, (3, 8)).astype(onp.float32)
+    out = npx.fft(NDArray(x))
+    ref = onp.fft.fft(x)
+    got = A(out)
+    # reference layout: interleaved real/imag pairs along the last axis
+    onp.testing.assert_allclose(got[..., 0::2], ref.real, rtol=1e-4,
+                                atol=1e-4)
+    onp.testing.assert_allclose(got[..., 1::2], ref.imag, rtol=1e-4,
+                                atol=1e-4)
+
+
+def test_ifft_roundtrip():
+    x = RS.uniform(-1, 1, (2, 8)).astype(onp.float32)
+    freq = npx.fft(NDArray(x))
+    back = npx.ifft(freq)
+    onp.testing.assert_allclose(A(back)[:, :8] / 8.0, x, rtol=1e-4,
+                                atol=1e-4)
+
+
+# ---------------------------------------------------------------------------
+# integer / bool dtype coverage for elementwise ops
+# ---------------------------------------------------------------------------
+
+INT_UNARY = ["negative", "abs", "sign", "square"]
+
+
+# int64 omitted: the framework inherits jax's x64-disabled default
+@pytest.mark.parametrize("dtype", ["int32", "int8"])
+@pytest.mark.parametrize("name", INT_UNARY)
+def test_unary_integer_dtypes(name, dtype):
+    x = RS.randint(-5, 6, (4, 5)).astype(dtype)
+    ref = getattr(onp, name)(x)
+    out = getattr(mnp, name)(mnp.array(x))
+    assert onp.dtype(out.dtype) == onp.dtype(dtype)
+    onp.testing.assert_array_equal(A(out), ref)
+
+
+@pytest.mark.parametrize("name", ["logical_and", "logical_or",
+                                  "logical_xor"])
+def test_binary_bool(name):
+    a = RS.rand(4, 5) > 0.5
+    b = RS.rand(4, 5) > 0.5
+    ref = getattr(onp, name)(a, b)
+    out = getattr(mnp, name)(mnp.array(a), mnp.array(b))
+    onp.testing.assert_array_equal(A(out), ref)
+
+
+# ---------------------------------------------------------------------------
+# empty / singleton edge cases through common ops
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("name", ["exp", "tanh", "abs", "sqrt"])
+def test_unary_empty_input(name):
+    x = onp.zeros((0, 4), onp.float32)
+    out = getattr(mnp, name)(mnp.array(x))
+    assert out.shape == (0, 4)
+
+
+def test_concat_empty_with_nonempty():
+    a = mnp.array(onp.zeros((0, 3), onp.float32))
+    b = mnp.array(onp.ones((2, 3), onp.float32))
+    out = mnp.concatenate([a, b], axis=0)
+    assert out.shape == (2, 3)
+
+
+def test_matmul_degenerate_dims():
+    a = mnp.array(onp.ones((3, 0), onp.float32))
+    b = mnp.array(onp.ones((0, 4), onp.float32))
+    out = mnp.dot(a, b)
+    assert out.shape == (3, 4)
+    onp.testing.assert_array_equal(A(out), onp.zeros((3, 4)))
+
+
+def test_softmax_single_element_axis():
+    x = mnp.array(RS.uniform(-1, 1, (4, 1)).astype("float32"))
+    out = npx.softmax(x, axis=-1)
+    onp.testing.assert_allclose(A(out), onp.ones((4, 1)), rtol=1e-6)

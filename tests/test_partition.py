@@ -206,3 +206,25 @@ def test_chain_input_produced_between_matched_eqns():
     out = net.optimize_for(q, k, x, backend="flash_attention").asnumpy()
     assert b.last_rewrites == 1
     onp.testing.assert_allclose(out, ref, rtol=2e-5, atol=2e-5)
+
+
+def test_guard_rejects_wrong_softmax_axis():
+    """softmax over a non-last axis must NOT fuse — the kernel softmaxes
+    the last axis; the outliner carries the op's axis in the eqn name."""
+    class WrongAxis(gluon.HybridBlock):
+        def forward(self, q, k, v):
+            s = npx.batch_dot(q, k, transpose_b=True)
+            p = npx.softmax(s, axis=1)       # wrong axis on purpose
+            return npx.batch_dot(p, v)
+
+    rng = onp.random.RandomState(9)
+    q = np.array(rng.randn(2, 12, 8).astype("float32"))
+    k = np.array(rng.randn(2, 12, 8).astype("float32"))
+    v = np.array(rng.randn(2, 12, 8).astype("float32"))
+    net = WrongAxis()
+    ref = net(q, k, v).asnumpy()
+    b = get_backend("flash_attention")
+    b.last_rewrites = -1
+    out = net.optimize_for(q, k, v, backend="flash_attention").asnumpy()
+    assert b.last_rewrites == 0
+    onp.testing.assert_allclose(out, ref, rtol=1e-6, atol=1e-6)

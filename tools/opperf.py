@@ -53,20 +53,33 @@ def _ops_registry():
     }
 
 
+def _true_sync(x):
+    """On the tunneled chip `waitall`/block_until_ready can return before
+    remote execution finishes; a VALUE fetch is the only true sync. The
+    device stream executes in order, so fetching one scalar of the LAST
+    output fences every enqueued program (same methodology as bench.py)."""
+    import numpy as onp
+
+    v = x
+    while isinstance(v, (list, tuple)):
+        v = v[0]
+    arr = v.asnumpy() if hasattr(v, "asnumpy") else onp.asarray(v)
+    return float(arr.ravel()[0])
+
+
 def benchmark_op(name, fn, args, warmup=5, runs=50, with_backward=True):
     from incubator_mxnet_tpu import autograd
-    from incubator_mxnet_tpu.ndarray.ndarray import waitall
 
     for a in args:
         a.attach_grad()
-    # forward
+    out = None
     for _ in range(warmup):
-        fn(*args)
-    waitall()
+        out = fn(*args)
+    _true_sync(out)
     t0 = time.perf_counter()
     for _ in range(runs):
-        fn(*args)
-    waitall()
+        out = fn(*args)
+    _true_sync(out)
     fwd_ms = (time.perf_counter() - t0) / runs * 1e3
 
     bwd_ms = None
@@ -76,19 +89,125 @@ def benchmark_op(name, fn, args, warmup=5, runs=50, with_backward=True):
                 with autograd.record():
                     out = fn(*args)
                 out.backward()
-            waitall()
+            _true_sync(args[0].grad)
             t0 = time.perf_counter()
             for _ in range(runs):
                 with autograd.record():
                     out = fn(*args)
                 out.backward()
-            waitall()
+            _true_sync(args[0].grad)
             total_ms = (time.perf_counter() - t0) / runs * 1e3
             bwd_ms = max(total_ms - fwd_ms, 0.0)
         except Exception:  # op has no grad path
             bwd_ms = None
     return {"op": name, "avg_fwd_ms": round(fwd_ms, 4),
             "avg_bwd_ms": round(bwd_ms, 4) if bwd_ms is not None else None}
+
+
+def benchmark_op_compiled(name, fn, args, warmup=3, runs=30):
+    """Compiled-op cost: jit the op once, execute `runs` times, and read
+    the per-call DEVICE time from the profiler's XPlane timeline.
+
+    Rationale: this framework's execution model is compiled (hybridize /
+    jit) — and on a tunneled chip the eager per-op dispatch cost is
+    RPC/compile-bound (tens of ms), which measures the link, not the op.
+    The reference's opperf numbers are meaningful eagerly because its
+    engine dispatches precompiled kernels in-process; the compiled-mode
+    device number is the apples-to-apples one here."""
+    import jax
+
+    from incubator_mxnet_tpu import profiler
+    from incubator_mxnet_tpu.ndarray.ndarray import NDArray
+
+    vals = [a._data for a in args]
+
+    @jax.jit
+    def jfn(*vs):
+        out = fn(*[NDArray(v) for v in vs])
+        first = out
+        while isinstance(first, (list, tuple)):
+            first = first[0]
+        return first._data
+
+    out = None
+    for _ in range(warmup):
+        out = jfn(*vals)
+    _true_sync_jax(out)
+    profiler.dumps(reset=True)
+    profiler.start()
+    t0 = time.perf_counter()
+    for _ in range(runs):
+        out = jfn(*vals)
+    _true_sync_jax(out)
+    wall_ms = (time.perf_counter() - t0) / runs * 1e3
+    profiler.stop()
+    # the jitted program's umbrella event on the device lane IS the per-op
+    # device cost (its children would double-count)
+    evts = profiler.device_events()
+    lanes = {e["pid"]: e.get("args", {}).get("name", "")
+             for e in evts if e.get("ph") == "M"
+             and e.get("name") == "process_name"}
+    dev_us = 0.0
+    n_seen = 0
+    for e in evts:
+        if e.get("ph") == "X" and e.get("name", "").startswith("jit_jfn") \
+                and lanes.get(e.get("pid"), "").startswith("/device:"):
+            dev_us += float(e.get("dur", 0.0))
+            n_seen += 1
+    profiler.dumps(reset=True)
+    device_ms = (dev_us / n_seen / 1000.0) if n_seen else None
+    return {"op": name,
+            "device_ms": round(device_ms, 4) if device_ms else None,
+            "wall_ms": round(wall_ms, 4)}
+
+
+def _true_sync_jax(v):
+    import jax
+    import numpy as onp
+
+    return float(onp.asarray(jax.device_get(v.ravel()[0])))
+
+
+def anchor_configs():
+    """The BASELINE.md anchor rows (exact reference opperf shapes —
+    `benchmark/opperf/results/mxnet_operator_benchmark_results_{cpu,gpu}.md`)
+    plus a conv2d serving shape."""
+    from incubator_mxnet_tpu import np, npx
+
+    def u(*shape):
+        return np.random.uniform(size=shape, low=-1.0, high=1.0)
+
+    return {
+        "dot_1024x1024": (np.dot, lambda: (u(1024, 1024), u(1024, 1024))),
+        "fully_connected_32x3x256x256_h64": (
+            lambda x, w, b: npx.fully_connected(x, w, b, num_hidden=64),
+            lambda: (u(32, 3, 256, 256), u(64, 3 * 256 * 256), u(64))),
+        "softmax_1024x1024": (npx.softmax, lambda: (u(1024, 1024),)),
+        "batch_norm_32x3x256x256": (
+            lambda x, g, b, m, v: npx.batch_norm(x, g, b, m, v),
+            lambda: (u(32, 3, 256, 256), np.ones((3,)), np.zeros((3,)),
+                     np.zeros((3,)), np.ones((3,)))),
+        "conv1d_32x3x256_k3_f64": (
+            lambda x, w, b: npx.convolution(x, w, b, kernel=(3,),
+                                            num_filter=64),
+            lambda: (u(32, 3, 256), u(64, 3, 3), u(64))),
+        "conv2d_32x3x224x224_k3_f64": (
+            lambda x, w, b: npx.convolution(x, w, b, kernel=(3, 3),
+                                            num_filter=64),
+            lambda: (u(32, 3, 224, 224), u(64, 3, 3, 3), u(64))),
+        "sum_1024x1024": (lambda x: x.sum(), lambda: (u(1024, 1024),)),
+    }
+
+
+def run_anchor_benchmarks(warmup=5, runs=50, mode="eager"):
+    results = []
+    for name, (fn, make_args) in anchor_configs().items():
+        if mode == "compiled":
+            results.append(benchmark_op_compiled(name, fn, make_args(),
+                                                 min(warmup, 3), runs))
+        else:
+            results.append(benchmark_op(name, fn, make_args(), warmup, runs))
+    return results
 
 
 def run_performance_test(ops=None, shape=(1024, 1024), warmup=5, runs=50):
@@ -118,12 +237,22 @@ def main():
     p.add_argument("--runs", type=int, default=50)
     p.add_argument("--warmup", type=int, default=5)
     p.add_argument("--output", default=None, help="write JSON here")
+    p.add_argument("--anchors", action="store_true",
+                   help="run the BASELINE.md anchor-row configs instead")
+    p.add_argument("--mode", default="eager", choices=("eager", "compiled"),
+                   help="eager: NDArray funnel dispatch latency; compiled: "
+                        "jitted per-op DEVICE time from the profiler")
     args = p.parse_args()
 
-    shape = tuple(int(s) for s in args.shape.split(","))
-    ops = args.ops.split(",") if args.ops else None
-    results = run_performance_test(ops, shape, args.warmup, args.runs)
-    out = json.dumps({"shape": list(shape), "results": results}, indent=2)
+    if args.anchors:
+        results = run_anchor_benchmarks(args.warmup, args.runs, args.mode)
+        out = json.dumps({"anchors": True, "mode": args.mode,
+                          "results": results}, indent=2)
+    else:
+        shape = tuple(int(s) for s in args.shape.split(","))
+        ops = args.ops.split(",") if args.ops else None
+        results = run_performance_test(ops, shape, args.warmup, args.runs)
+        out = json.dumps({"shape": list(shape), "results": results}, indent=2)
     if args.output:
         with open(args.output, "w") as f:
             f.write(out)
