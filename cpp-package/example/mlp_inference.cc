@@ -1,0 +1,55 @@
+// C++ frontend end-to-end check (reference:
+// `cpp-package/example/mlp_cpu.cpp` shape): NDArray math + model_zoo
+// inference through the embedded runtime. Prints PASS lines consumed by
+// tests/test_cpp_package.py.
+#include <cstdio>
+#include <cstdlib>
+#include <vector>
+
+#include "mxnet-cpp/MxNetCpp.h"
+
+using mxnet::cpp::NDArray;
+using mxnet::cpp::Predictor;
+using mxnet::cpp::Runtime;
+
+int main(int argc, char** argv) {
+  const char* repo = argc > 1 ? argv[1] : ".";
+  Runtime rt(repo);
+
+  // --- NDArray math ---
+  NDArray a({1.f, 2.f, 3.f, 4.f}, {2, 2});
+  NDArray b = NDArray::Ones({2, 2});
+  NDArray c = a.Dot(b) + a;
+  std::vector<float> host;
+  c.CopyTo(&host);
+  // a@ones + a = [[3,3],[7,7]] + [[1,2],[3,4]] = [[4,5],[10,11]]
+  if (host.size() == 4 && host[0] == 4.f && host[1] == 5.f &&
+      host[2] == 10.f && host[3] == 11.f) {
+    std::printf("PASS ndarray_math\n");
+  } else {
+    std::printf("FAIL ndarray_math %f %f %f %f\n", host[0], host[1],
+                host[2], host[3]);
+    return 1;
+  }
+  float s = a.Sum().Scalar();
+  if (s == 10.f) {
+    std::printf("PASS ndarray_sum\n");
+  } else {
+    std::printf("FAIL ndarray_sum %f\n", s);
+    return 1;
+  }
+
+  // --- model_zoo inference ---
+  Predictor net = Predictor::FromModelZoo("mobilenetv2_0.25");
+  NDArray x = NDArray::Zeros({1, 3, 32, 32});
+  NDArray out = net.Forward(x);
+  std::vector<size_t> shape = out.Shape();
+  if (shape.size() == 2 && shape[0] == 1 && shape[1] == 1000) {
+    std::printf("PASS model_zoo_forward\n");
+  } else {
+    std::printf("FAIL model_zoo_forward\n");
+    return 1;
+  }
+  std::printf("ALL OK\n");
+  return 0;
+}

@@ -1,0 +1,321 @@
+// C++ frontend (reference: `cpp-package/include/mxnet-cpp/MxNetCpp.h` —
+// NDArray/Context/Predictor over the C API, ~10.7k LoC).
+//
+// TPU-native design: the reference's C++ frontend wraps libmxnet's C ABI;
+// this build's runtime is the Python/jax/XLA stack, so the C++ frontend
+// EMBEDS the CPython runtime (stable documented API only) and drives the
+// same framework objects a Python user gets — one implementation, no
+// drift between language frontends. Compute still runs on the TPU via
+// XLA; the embedding only crosses the API boundary, never the math.
+//
+// Scope (documented): inference + NDArray math.
+//   - Runtime        : interpreter lifecycle (RAII)
+//   - Context        : cpu()/tpu() device handles
+//   - NDArray        : construct / arithmetic / Dot / Sum / Argmax /
+//                      Softmax / CopyTo host
+//   - Predictor      : gluon model_zoo model (+ optional .params file) or
+//                      an exported SymbolBlock artifact; Forward()
+// Training from C++ is out of scope (SURVEY M6 "if required"); use the
+// Python frontend for training and export for serving.
+//
+// Build: g++ -std=c++17 app.cc $(python3-config --embed --cflags --ldflags)
+#ifndef MXNET_CPP_MXNETCPP_H_
+#define MXNET_CPP_MXNETCPP_H_
+
+#include <Python.h>
+
+#include <cstddef>
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+namespace mxnet {
+namespace cpp {
+
+inline void _throw_py(const std::string& where) {
+  PyErr_Print();
+  throw std::runtime_error("mxnet-cpp: python failure in " + where);
+}
+
+class Runtime {
+ public:
+  // module_path: directory holding the incubator_mxnet_tpu package
+  explicit Runtime(const std::string& module_path = "") {
+    if (!Py_IsInitialized()) {
+      Py_Initialize();
+    }
+    PyObject* sys_path = PySys_GetObject("path");  // borrowed
+    if (!module_path.empty()) {
+      PyObject* p = PyUnicode_FromString(module_path.c_str());
+      PyList_Insert(sys_path, 0, p);
+      Py_DECREF(p);
+    }
+    mx_ = PyImport_ImportModule("incubator_mxnet_tpu");
+    if (!mx_) _throw_py("import incubator_mxnet_tpu");
+    np_ = PyObject_GetAttrString(mx_, "np");
+    if (!np_) _throw_py("mx.np");
+  }
+
+  ~Runtime() {
+    Py_XDECREF(np_);
+    Py_XDECREF(mx_);
+    // Py_Finalize is deliberately NOT called: the jax/XLA runtime keeps
+    // background dispatch threads that make interpreter finalization
+    // unsafe (fatal "_Py_GetConfig without GIL" on teardown). Process
+    // exit reclaims everything — the policy most embedding hosts use.
+  }
+
+  PyObject* mx() const { return mx_; }
+  PyObject* np() const { return np_; }
+
+  static Runtime& Get() {
+    static Runtime rt;
+    return rt;
+  }
+
+ private:
+  PyObject* mx_ = nullptr;
+  PyObject* np_ = nullptr;
+};
+
+class Context {
+ public:
+  static Context cpu() { return Context("cpu"); }
+  static Context tpu() { return Context("tpu"); }
+  static Context gpu() { return Context("tpu"); }  // alias: accelerator
+  const std::string& type() const { return type_; }
+
+ private:
+  explicit Context(std::string t) : type_(std::move(t)) {}
+  std::string type_;
+};
+
+class NDArray {
+ public:
+  NDArray() = default;
+  // takes ownership of a framework NDArray PyObject
+  explicit NDArray(PyObject* obj) : obj_(obj) {}
+  NDArray(const NDArray& o) : obj_(o.obj_) { Py_XINCREF(obj_); }
+  NDArray& operator=(const NDArray& o) {
+    if (this != &o) {
+      Py_XDECREF(obj_);
+      obj_ = o.obj_;
+      Py_XINCREF(obj_);
+    }
+    return *this;
+  }
+  NDArray(NDArray&& o) noexcept : obj_(o.obj_) { o.obj_ = nullptr; }
+  NDArray& operator=(NDArray&& o) noexcept {
+    if (this != &o) {
+      Py_XDECREF(obj_);
+      obj_ = o.obj_;
+      o.obj_ = nullptr;
+    }
+    return *this;
+  }
+  ~NDArray() { Py_XDECREF(obj_); }
+
+  // host data -> device array
+  NDArray(const std::vector<float>& data, const std::vector<size_t>& shape) {
+    PyObject* list = PyList_New(static_cast<Py_ssize_t>(data.size()));
+    for (size_t i = 0; i < data.size(); ++i)
+      PyList_SET_ITEM(list, static_cast<Py_ssize_t>(i),
+                      PyFloat_FromDouble(data[i]));
+    PyObject* flat =
+        PyObject_CallMethod(Runtime::Get().np(), "array", "O", list);
+    Py_DECREF(list);
+    if (!flat) _throw_py("np.array");
+    PyObject* shp = PyTuple_New(static_cast<Py_ssize_t>(shape.size()));
+    for (size_t i = 0; i < shape.size(); ++i)
+      PyTuple_SET_ITEM(shp, static_cast<Py_ssize_t>(i),
+                       PyLong_FromSize_t(shape[i]));
+    obj_ = PyObject_CallMethod(flat, "reshape", "(O)", shp);
+    Py_DECREF(flat);
+    Py_DECREF(shp);
+    if (!obj_) _throw_py("reshape");
+  }
+
+  static NDArray Zeros(const std::vector<size_t>& shape) {
+    return FromFactory("zeros", shape);
+  }
+  static NDArray Ones(const std::vector<size_t>& shape) {
+    return FromFactory("ones", shape);
+  }
+
+  std::vector<size_t> Shape() const {
+    PyObject* shp = PyObject_GetAttrString(obj_, "shape");
+    if (!shp) _throw_py("shape");
+    std::vector<size_t> out(PyTuple_Size(shp));
+    for (size_t i = 0; i < out.size(); ++i)
+      out[i] = PyLong_AsSize_t(
+          PyTuple_GetItem(shp, static_cast<Py_ssize_t>(i)));
+    Py_DECREF(shp);
+    return out;
+  }
+
+  size_t Size() const {
+    size_t n = 1;
+    for (size_t s : Shape()) n *= s;
+    return n;
+  }
+
+  // synchronize + copy to host
+  void CopyTo(std::vector<float>* out) const {
+    PyObject* np_arr = PyObject_CallMethod(obj_, "asnumpy", nullptr);
+    if (!np_arr) _throw_py("asnumpy");
+    PyObject* flat = PyObject_CallMethod(np_arr, "ravel", nullptr);
+    Py_DECREF(np_arr);
+    PyObject* lst = PyObject_CallMethod(flat, "tolist", nullptr);
+    Py_DECREF(flat);
+    if (!lst) _throw_py("tolist");
+    Py_ssize_t n = PyList_Size(lst);
+    out->resize(static_cast<size_t>(n));
+    for (Py_ssize_t i = 0; i < n; ++i)
+      (*out)[static_cast<size_t>(i)] =
+          static_cast<float>(PyFloat_AsDouble(PyList_GetItem(lst, i)));
+    Py_DECREF(lst);
+  }
+
+  NDArray Binary(const char* op, const NDArray& rhs) const {
+    PyObject* r = PyObject_CallMethod(Runtime::Get().np(),
+                                      op, "OO", obj_, rhs.obj_);
+    if (!r) _throw_py(op);
+    return NDArray(r);
+  }
+
+  NDArray operator+(const NDArray& r) const { return Binary("add", r); }
+  NDArray operator-(const NDArray& r) const { return Binary("subtract", r); }
+  NDArray operator*(const NDArray& r) const { return Binary("multiply", r); }
+  NDArray operator/(const NDArray& r) const { return Binary("divide", r); }
+  NDArray Dot(const NDArray& r) const { return Binary("dot", r); }
+
+  NDArray Sum() const { return Unary("sum"); }
+  NDArray Exp() const { return Unary("exp"); }
+  NDArray ArgmaxChannel() const {
+    PyObject* r = PyObject_CallMethod(Runtime::Get().np(), "argmax", "Oi",
+                                      obj_, -1);
+    if (!r) _throw_py("argmax");
+    return NDArray(r);
+  }
+
+  float Scalar() const {
+    std::vector<float> v;
+    CopyTo(&v);
+    if (v.empty()) throw std::runtime_error("empty array");
+    return v[0];
+  }
+
+  void WaitToRead() const {
+    PyObject* r = PyObject_CallMethod(obj_, "wait_to_read", nullptr);
+    if (!r) _throw_py("wait_to_read");
+    Py_DECREF(r);
+  }
+
+  PyObject* handle() const { return obj_; }
+
+ private:
+  NDArray Unary(const char* op) const {
+    PyObject* r = PyObject_CallMethod(Runtime::Get().np(), op, "O", obj_);
+    if (!r) _throw_py(op);
+    return NDArray(r);
+  }
+
+  static NDArray FromFactory(const char* fn,
+                             const std::vector<size_t>& shape) {
+    PyObject* shp = PyTuple_New(static_cast<Py_ssize_t>(shape.size()));
+    for (size_t i = 0; i < shape.size(); ++i)
+      PyTuple_SET_ITEM(shp, static_cast<Py_ssize_t>(i),
+                       PyLong_FromSize_t(shape[i]));
+    // "(O)" so the shape TUPLE arrives as one argument (a bare "O"
+    // tuple would be unpacked as the whole argument list)
+    PyObject* r =
+        PyObject_CallMethod(Runtime::Get().np(), fn, "(O)", shp);
+    Py_DECREF(shp);
+    if (!r) _throw_py(fn);
+    return NDArray(r);
+  }
+
+  PyObject* obj_ = nullptr;
+};
+
+// Inference driver (reference: cpp-package Predictor examples):
+// either a gluon model_zoo architecture (+ optional trained .params), or
+// a SymbolBlock artifact produced by HybridBlock.export.
+class Predictor {
+ public:
+  static Predictor FromModelZoo(const std::string& name,
+                                const std::string& params_file = "") {
+    Runtime& rt = Runtime::Get();
+    PyObject* gluon = PyObject_GetAttrString(rt.mx(), "gluon");
+    PyObject* zoo = PyObject_GetAttrString(gluon, "model_zoo");
+    PyObject* vision = PyObject_GetAttrString(zoo, "vision");
+    PyObject* net = PyObject_CallMethod(vision, "get_model", "s",
+                                        name.c_str());
+    Py_DECREF(vision);
+    Py_DECREF(zoo);
+    Py_DECREF(gluon);
+    if (!net) _throw_py("get_model");
+    if (params_file.empty()) {
+      PyObject* r = PyObject_CallMethod(net, "initialize", nullptr);
+      if (!r) _throw_py("initialize");
+      Py_DECREF(r);
+    } else {
+      PyObject* r = PyObject_CallMethod(net, "load_parameters", "s",
+                                        params_file.c_str());
+      if (!r) _throw_py("load_parameters");
+      Py_DECREF(r);
+    }
+    return Predictor(net);
+  }
+
+  // exported artifact: `net.export(path)` wrote path-symbol.json (+params)
+  static Predictor FromExport(const std::string& symbol_json,
+                              const std::string& params_file = "") {
+    Runtime& rt = Runtime::Get();
+    PyObject* gluon = PyObject_GetAttrString(rt.mx(), "gluon");
+    PyObject* sb = PyObject_GetAttrString(gluon, "SymbolBlock");
+    Py_DECREF(gluon);
+    PyObject* net;
+    if (params_file.empty())
+      net = PyObject_CallMethod(sb, "imports", "s", symbol_json.c_str());
+    else
+      net = PyObject_CallMethod(sb, "imports", "sOs", symbol_json.c_str(),
+                                Py_None, params_file.c_str());
+    Py_DECREF(sb);
+    if (!net) _throw_py("SymbolBlock.imports");
+    return Predictor(net);
+  }
+
+  NDArray Forward(const NDArray& input) const {
+    PyObject* out = PyObject_CallFunctionObjArgs(net_, input.handle(),
+                                                 nullptr);
+    if (!out) _throw_py("forward");
+    if (PyTuple_Check(out)) {  // multi-output heads: take the first
+      PyObject* first = PyTuple_GetItem(out, 0);
+      Py_INCREF(first);
+      Py_DECREF(out);
+      return NDArray(first);
+    }
+    return NDArray(out);
+  }
+
+  void Hybridize() const {
+    PyObject* r = PyObject_CallMethod(net_, "hybridize", nullptr);
+    if (!r) _throw_py("hybridize");
+    Py_DECREF(r);
+  }
+
+  ~Predictor() { Py_XDECREF(net_); }
+  Predictor(const Predictor& o) : net_(o.net_) { Py_XINCREF(net_); }
+  Predictor& operator=(const Predictor&) = delete;
+  Predictor(Predictor&& o) noexcept : net_(o.net_) { o.net_ = nullptr; }
+
+ private:
+  explicit Predictor(PyObject* net) : net_(net) {}
+  PyObject* net_ = nullptr;
+};
+
+}  // namespace cpp
+}  // namespace mxnet
+
+#endif  // MXNET_CPP_MXNETCPP_H_

@@ -92,6 +92,8 @@ def imdecode_np(buf, flag=1, to_rgb=True):
     arr = onp.asarray(img)
     if arr.ndim == 2:
         arr = arr[:, :, None]
+    if flag == 1 and not to_rgb:
+        arr = arr[:, :, ::-1]   # BGR contract, same as the cv2 path
     return arr
 
 

@@ -408,7 +408,11 @@ class NDArray:
     # dispatches into the framework and returns an NDArray instead of
     # silently densifying through a slow generic path)
 
-    def __array__(self, dtype=None, copy=None):  # noqa: ARG002
+    def __array__(self, dtype=None, copy=None):
+        if copy is False:
+            # a device-backed array can never hand numpy a zero-copy view
+            raise ValueError(
+                "NDArray cannot be converted to numpy without a copy")
         a = self.asnumpy()
         return a.astype(dtype) if dtype is not None else a
 
@@ -416,8 +420,9 @@ class NDArray:
         from .. import numpy as mxnp
 
         fn = getattr(mxnp, ufunc.__name__, None)
-        if (method == "__call__" and kwargs.get("out") is None
-                and kwargs.get("where", True) is True
+        dispatchable = set(kwargs) <= {"dtype", "where"} \
+            and kwargs.get("where", True) is True
+        if (method == "__call__" and dispatchable
                 and fn is not None and callable(fn)):
             kwargs.pop("where", None)
             return fn(*inputs, **kwargs)

@@ -74,6 +74,10 @@ def moe_dispatch_combine(x, gate_logits, expert_fn, capacity_factor=1.25,
                          "expert-parallel groups")
     capacity = max(1, int(capacity_factor * t / e))
     combine, dispatch, aux = top1_gating(gate_logits, capacity)
+    # keep the layer's activation dtype: f32 one-hots would upcast bf16
+    # tokens and double the all_to_all bytes on ICI
+    dispatch = dispatch.astype(x.dtype)
+    combine = combine.astype(x.dtype)
     # local tokens -> per-expert slots
     slots = jnp.einsum("td,tec->ecd", x, dispatch)         # (E, C, D)
     if axis_name is not None:

@@ -53,7 +53,6 @@ def pipeline_apply(stage_fn, stage_params, x, axis_name="pp"):
     Returns (n_micro, micro_batch, ...) outputs (valid on the LAST stage;
     callers all-gather or read from stage S-1).
     """
-    import jax
     import jax.numpy as jnp
     from jax import lax
 
@@ -90,5 +89,4 @@ def pipeline_apply(stage_fn, stage_params, x, axis_name="pp"):
     outputs0 = lax.pvary(jnp.zeros_like(x), axis_name)
     (_, outputs), _ = lax.scan(tick, (zero, outputs0),
                                jnp.arange(ticks))
-    del jax
     return outputs

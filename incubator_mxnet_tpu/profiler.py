@@ -59,6 +59,11 @@ def start(profile_process="worker"):  # noqa: ARG001
     _STATE["running"] = True
     if not _CONFIG.get("profile_device", True):
         return
+    # each start/stop cycle REPLACES the device timeline (a per-epoch
+    # start/stop loop would otherwise grow the event list without bound)
+    with _LOCK:
+        _DEVICE_EVENTS.clear()
+        _DEVICE_AGG.clear()
     logdir = _CONFIG.get("tensorboard_logdir")
     if logdir:
         _STATE["trace_dir"] = logdir

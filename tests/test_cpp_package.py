@@ -1,0 +1,36 @@
+"""C++ frontend (`cpp-package/`): builds the example against the embedded
+CPython runtime and runs it end-to-end (NDArray math + model_zoo forward).
+Reference: `cpp-package/include/mxnet-cpp/` (~10.7k LoC C-API wrapper);
+here the frontend embeds the Python runtime instead — one implementation,
+no drift between language frontends."""
+import os
+import shutil
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(REPO, "cpp-package")
+
+
+@pytest.mark.skipif(shutil.which("g++") is None, reason="needs g++")
+@pytest.mark.skipif(shutil.which("python3-config") is None,
+                    reason="needs python3-config (embedding flags)")
+def test_cpp_frontend_builds_and_runs():
+    build = subprocess.run(["make"], cwd=PKG, capture_output=True, text=True)
+    assert build.returncode == 0, build.stderr[-2000:]
+    exe = os.path.join(PKG, "build", "mlp_inference")
+    assert os.path.exists(exe)
+    env = dict(os.environ)
+    # the embedded interpreter needs the same import roots as this one
+    env["PYTHONPATH"] = os.pathsep.join(
+        [p for p in sys.path if p] + [REPO])
+    run = subprocess.run([exe, REPO], capture_output=True, text=True,
+                         env=env, timeout=600)
+    out = run.stdout
+    assert "PASS ndarray_math" in out, (out, run.stderr[-2000:])
+    assert "PASS ndarray_sum" in out
+    assert "PASS model_zoo_forward" in out
+    assert "ALL OK" in out
+    assert run.returncode == 0

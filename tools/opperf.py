@@ -75,7 +75,8 @@ def benchmark_op(name, fn, args, warmup=5, runs=50, with_backward=True):
     out = None
     for _ in range(warmup):
         out = fn(*args)
-    _true_sync(out)
+    if out is not None:
+        _true_sync(out)
     t0 = time.perf_counter()
     for _ in range(runs):
         out = fn(*args)
@@ -157,7 +158,8 @@ def benchmark_op_compiled(name, fn, args, warmup=3, runs=30):
     profiler.dumps(reset=True)
     device_ms = (dev_us / n_seen / 1000.0) if n_seen else None
     return {"op": name,
-            "device_ms": round(device_ms, 4) if device_ms else None,
+            "device_ms": round(device_ms, 4) if device_ms is not None
+            else None,
             "wall_ms": round(wall_ms, 4)}
 
 
