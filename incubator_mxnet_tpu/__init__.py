@@ -86,6 +86,7 @@ from . import runtime  # noqa: F401
 from . import rtc  # noqa: F401
 from . import partition  # noqa: F401
 from . import remat  # noqa: F401
+from . import preemption  # noqa: F401
 from . import callback  # noqa: F401
 from . import engine  # noqa: F401
 from . import context  # noqa: F401

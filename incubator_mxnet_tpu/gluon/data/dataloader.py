@@ -3,10 +3,12 @@ multiprocessing workers with POSIX-shm NDArray transfer).
 
 TPU-native design: worker processes produce *numpy* batches (host memory);
 the main process uploads to device HBM asynchronously (`jax.device_put`),
-which double-buffers naturally because jax dispatch is async. The reference's
-CPUSharedStorage + ForkingPickler machinery is replaced by a
-multiprocessing.Pool returning numpy arrays (pickled via shared mmap by the
-OS); decode/augment stays in workers exactly as in the reference.
+which double-buffers naturally because jax dispatch is async. Large batch
+arrays cross the process boundary through POSIX shared memory (the
+reference's CPUSharedStorage role, `src/storage/cpu_shared_storage_
+manager.h`) instead of being serialized through the pool's result pipe;
+small leaves keep the plain pickle path (descriptor overhead would
+dominate).
 """
 from __future__ import annotations
 
@@ -19,12 +21,54 @@ __all__ = ["DataLoader"]
 
 _worker_dataset = None
 _worker_batchify = None
+_worker_use_shm = True
+_SHM_MIN_BYTES = 1 << 20   # leaves below 1 MB ship by pickle
+_SHM_TAG = "__mxshm__"
 
 
-def _worker_init(dataset, batchify_fn):
-    global _worker_dataset, _worker_batchify
+def _worker_init(dataset, batchify_fn, use_shm=True):
+    global _worker_dataset, _worker_batchify, _worker_use_shm
     _worker_dataset = dataset
     _worker_batchify = batchify_fn
+    _worker_use_shm = use_shm
+
+
+def _export_shm(arr):
+    """Worker side: copy `arr` into a fresh POSIX shm segment; ownership
+    (unlink) transfers to the consumer."""
+    import numpy as onp
+    from multiprocessing import resource_tracker, shared_memory
+
+    shm = shared_memory.SharedMemory(create=True, size=arr.nbytes)
+    onp.ndarray(arr.shape, arr.dtype, buffer=shm.buf)[...] = arr
+    name = shm.name
+    shm.close()
+    # the CONSUMER unlinks; stop this process's resource_tracker from
+    # reporting the segment as leaked at pool shutdown
+    try:
+        resource_tracker.unregister(shm._name, "shared_memory")  # noqa: SLF001
+    except Exception:
+        pass
+    return (_SHM_TAG, name, arr.shape, str(arr.dtype))
+
+
+def _import_shm(desc):
+    """Consumer side: attach, copy out, unlink."""
+    import numpy as onp
+    from multiprocessing import resource_tracker, shared_memory
+
+    _tag, name, shape, dtype = desc
+    shm = shared_memory.SharedMemory(name=name)
+    try:
+        resource_tracker.unregister(shm._name, "shared_memory")  # noqa: SLF001
+    except Exception:
+        pass
+    try:
+        arr = onp.array(onp.ndarray(shape, dtype, buffer=shm.buf))
+    finally:
+        shm.close()
+        shm.unlink()
+    return arr
 
 
 def _worker_fn(samples):
@@ -38,8 +82,11 @@ def _worker_fn(samples):
         if isinstance(b, (tuple, list)):
             return tuple(to_numpy(x) for x in b)
         if isinstance(b, NDArray):
-            return b.asnumpy()
-        return onp.asarray(b)
+            b = b.asnumpy()
+        arr = onp.ascontiguousarray(b)
+        if _worker_use_shm and arr.nbytes >= _SHM_MIN_BYTES:
+            return _export_shm(arr)
+        return arr
 
     return to_numpy(batch)
 
@@ -49,7 +96,7 @@ class DataLoader:
                  last_batch=None, batch_sampler=None, batchify_fn=None,
                  num_workers=None, pin_memory=False, pin_device_id=0,
                  prefetch=None, thread_pool=False, timeout=120,
-                 try_nopython=None):  # noqa: ARG002
+                 try_nopython=None, use_shared_memory=True):  # noqa: ARG002
         self._dataset = dataset
         self._timeout = timeout
         if batch_sampler is None:
@@ -77,12 +124,15 @@ class DataLoader:
         if self._num_workers > 0:
             ctx = mp.get_context("fork")
             self._pool = ctx.Pool(self._num_workers, initializer=_worker_init,
-                                  initargs=(dataset, self._batchify_fn))
+                                  initargs=(dataset, self._batchify_fn,
+                                            use_shared_memory))
 
     def __iter__(self):
         from ...ndarray.ndarray import NDArray
 
         def wrap(b):
+            if isinstance(b, tuple) and len(b) == 4 and b[0] == _SHM_TAG:
+                return NDArray(_import_shm(b))
             if isinstance(b, (tuple, list)):
                 return tuple(wrap(x) for x in b)
             if isinstance(b, NDArray):

@@ -4,6 +4,9 @@
   BERT-base, BASELINE.json config 3) built on npx attention ops.
 - `sharded_bert`: the same architecture as pure-jax functions with explicit
   dp/tp/sp shardings over a Mesh — the multi-chip flagship path.
+- `gpt`: decoder-only causal LM (GluonNLP GPT-2 role) over the causal
+  flash-attention path, with a sampling `generate` loop.
 """
 from .bert import BERTClassifier, BERTEncoder, BERTModel, TransformerEncoderCell  # noqa: F401
+from . import gpt  # noqa: F401
 from . import sharded_bert  # noqa: F401

