@@ -438,6 +438,13 @@ def fold_conv_bn(net, logger=None):
                 continue
             if not isinstance(ca, (nn.Conv2D, nn.Dense)):
                 continue
+            # a fused activation runs BETWEEN the conv output and the BN:
+            # folding would move the BN affine to before the relu, changing
+            # results. The reference oneDNN pass only folds bare conv->BN.
+            if getattr(ca, "act", None) is not None:
+                if logger:
+                    logger.info("skip BN fold into %s: fused activation", a)
+                continue
             gamma = (cb.gamma.data().asnumpy() if cb._scale
                      else onp.ones(cb.running_var.shape, onp.float32))
             beta = cb.beta.data().asnumpy()

@@ -71,17 +71,36 @@ def _import_shm(desc):
     return arr
 
 
+def _unlink_shm_tree(b):
+    """Release shm segments referenced by an un-imported result tree
+    (consumer abandoned the iterator before wrapping the batch)."""
+    if isinstance(b, tuple) and len(b) == 4 and b[0] == _SHM_TAG:
+        from multiprocessing import resource_tracker, shared_memory
+
+        try:
+            shm = shared_memory.SharedMemory(name=b[1])
+        except FileNotFoundError:
+            return
+        try:
+            resource_tracker.unregister(shm._name, "shared_memory")  # noqa: SLF001
+        except Exception:
+            pass
+        shm.close()
+        shm.unlink()
+    elif isinstance(b, (tuple, list)):
+        for x in b:
+            _unlink_shm_tree(x)
+
+
 def _worker_fn(samples):
     import numpy as onp
-
-    from ...ndarray.ndarray import NDArray
 
     batch = _worker_batchify([_worker_dataset[i] for i in samples])
 
     def to_numpy(b):
         if isinstance(b, (tuple, list)):
             return tuple(to_numpy(x) for x in b)
-        if isinstance(b, NDArray):
+        if hasattr(b, "asnumpy"):
             b = b.asnumpy()
         arr = onp.ascontiguousarray(b)
         if _worker_use_shm and arr.nbytes >= _SHM_MIN_BYTES:
@@ -89,6 +108,21 @@ def _worker_fn(samples):
         return arr
 
     return to_numpy(batch)
+
+
+def _mp_context():
+    """Worker start method. The parent process is JAX-multithreaded by the
+    time a DataLoader is built, so `fork` would deadlock in the child (the
+    reference re-initialises its engine in pthread_atfork handlers instead:
+    `src/initialize.cc:75-88`). `forkserver` forks workers from a clean
+    single-threaded server process; `spawn` is the portable fallback."""
+    import os
+
+    method = os.environ.get("MXNET_MP_START_METHOD")
+    if not method:
+        methods = mp.get_all_start_methods()
+        method = "forkserver" if "forkserver" in methods else "spawn"
+    return mp.get_context(method)
 
 
 class DataLoader:
@@ -122,10 +156,57 @@ class DataLoader:
         self._prefetch = max(0, prefetch or 2 * self._num_workers)
         self._pool = None
         if self._num_workers > 0:
-            ctx = mp.get_context("fork")
-            self._pool = ctx.Pool(self._num_workers, initializer=_worker_init,
-                                  initargs=(dataset, self._batchify_fn,
-                                            use_shared_memory))
+            import weakref
+
+            ctx = _mp_context()
+            self._pool = self._start_pool(ctx, dataset, use_shared_memory)
+            # finalizers run at atexit, BEFORE interpreter teardown strips
+            # the mp module globals a late __del__ would trip over
+            self._finalizer = weakref.finalize(
+                self, DataLoader._terminate_pool, self._pool)
+
+    @staticmethod
+    def _terminate_pool(pool):
+        try:
+            pool.terminate()
+            pool.join()
+        except Exception:
+            pass
+
+    def _start_pool(self, ctx, dataset, use_shared_memory):
+        import os
+        import sys
+
+        # spawn/forkserver workers re-run __main__ from its __file__; a
+        # heredoc/REPL parent reports "<stdin>", which the worker bootstrap
+        # tries to open as a real path and dies. Drop the phantom path for
+        # good — the pool respawns dead workers long after __init__ returns,
+        # so restoring it would re-arm the crash for them (workers only need
+        # importable modules, not the interactive main).
+        main_mod = sys.modules.get("__main__")
+        main_file = getattr(main_mod, "__file__", None)
+        if (main_mod is not None and main_file is not None
+                and getattr(main_mod, "__spec__", None) is None
+                and not os.path.exists(main_file)):
+            del main_mod.__file__
+
+        # workers do host-side decode/augment only; if the dataset pickles
+        # NDArray leaves, unpickling would initialise a jax backend in each
+        # worker — on a TPU host that contends for the chip's single-client
+        # lock. Children inherit env at creation: pin them to jax-CPU.
+        override = {"JAX_PLATFORMS": "cpu", "JAX_PLATFORM_NAME": "cpu"}
+        saved = {k: os.environ.get(k) for k in override}
+        os.environ.update(override)
+        try:
+            return ctx.Pool(self._num_workers, initializer=_worker_init,
+                            initargs=(dataset, self._batchify_fn,
+                                      use_shared_memory))
+        finally:
+            for k, v in saved.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
 
     def __iter__(self):
         from ...ndarray.ndarray import NDArray
@@ -155,18 +236,36 @@ class DataLoader:
                     break
                 in_flight.append(self._pool.apply_async(_worker_fn, (b,)))
             while in_flight:
-                result = in_flight.pop(0).get(self._timeout)
+                try:
+                    result = in_flight[0].get(self._timeout)
+                except mp.TimeoutError as e:
+                    raise RuntimeError(
+                        f"DataLoader worker timed out after "
+                        f"{self._timeout}s") from e
+                in_flight.pop(0)
                 b = next(batches, None)
                 if b is not None:
                     in_flight.append(self._pool.apply_async(_worker_fn, (b,)))
                 yield wrap(result)
-        except mp.TimeoutError as e:
-            raise RuntimeError(
-                f"DataLoader worker timed out after {self._timeout}s") from e
+        finally:
+            # consumer abandoned the iterator (generator close / exception /
+            # timeout) with batches still in flight: import-and-unlink their
+            # shm segments so nothing leaks in /dev/shm until reboot. One
+            # deadline across ALL futures — a stuck worker must not stall
+            # generator close by 5s per prefetched batch.
+            import time
+
+            deadline = time.monotonic() + 5.0
+            for fut in in_flight:
+                try:
+                    _unlink_shm_tree(
+                        fut.get(max(0.0, deadline - time.monotonic())))
+                except Exception:
+                    pass
 
     def __len__(self):
         return len(self._batch_sampler)
 
     def __del__(self):
-        if self._pool is not None:
-            self._pool.terminate()
+        if getattr(self, "_finalizer", None) is not None:
+            self._finalizer()

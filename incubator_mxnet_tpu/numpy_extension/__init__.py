@@ -723,7 +723,12 @@ def batch_dot(a, b, transpose_a=False, transpose_b=False, **kwargs):  # noqa: AR
             y = jnp.swapaxes(y, -1, -2)
         return jnp.matmul(x, y)
 
-    return apply_op("batch_dot", f, (a, b))
+    # transpose flags ride in the eqn name so partition-backend guards
+    # (e.g. flash attention's QK-stage check) can see them — shapes alone
+    # cannot distinguish q@k^T from q@k when k is square (r3 ADVICE)
+    return apply_op("batch_dot", f, (a, b),
+                    static_info={"transpose_a": bool(transpose_a),
+                                 "transpose_b": bool(transpose_b)})
 
 
 def gather_nd(data, indices):

@@ -393,16 +393,30 @@ def _flash_guard(eqns):
     """Shapes must identify the standard attention layout unambiguously:
     scores=(B,T,Tk) from q=(B,T,d) @ k^T with k=(B,Tk,d)."""
     qk = eqns[0]
+    # the QK stage must literally be q @ k^T: the transpose flags ride in
+    # the outlined eqn's static_info (shape inference alone cannot tell
+    # q@k^T from q@k when k is square — r3 ADVICE). Outlined batch_dot
+    # always carries the flags; their absence means an un-flagged matmul
+    # we refuse to rewrite.
+    qk_info = eqn_op_info(qk)
+    if qk_info.get("transpose_b") != "True" or \
+            qk_info.get("transpose_a") == "True":
+        return False
     q_aval, k_aval = qk.invars[0].aval, qk.invars[1].aval
     s_aval = qk.outvars[0].aval
     if len(q_aval.shape) != 3 or len(k_aval.shape) != 3:
         return False
     b, t, d = q_aval.shape
     if k_aval.shape[0] != b or k_aval.shape[2] != d:
-        return False        # transpose_b=False layout: leave it unfused
+        return False
     tk = k_aval.shape[1]
-    if tuple(s_aval.shape) != (b, t, tk) or (tk == d and t == d):
-        return False        # ambiguous square case
+    if tuple(s_aval.shape) != (b, t, tk):
+        return False
+    # the PV stage must be transpose-free: att(B,T,Tk) @ v(B,Tk,d)
+    pv_info = eqn_op_info(eqns[-1])
+    if pv_info.get("transpose_a") == "True" or \
+            pv_info.get("transpose_b") == "True":
+        return False
     # the fused kernel softmaxes the LAST axis; reject chains whose
     # softmax ran on any other axis (the outliner encodes it in the name)
     soft = eqns[-2]

@@ -105,6 +105,52 @@ def test_guard_rejects_nonstandard_layout():
     onp.testing.assert_allclose(out, ref, rtol=1e-6, atol=1e-6)
 
 
+def test_guard_rejects_square_k_without_transpose():
+    """batch_dot(q, k, transpose_b=False) with SQUARE k (Tk==head_dim,
+    T!=d) is shape-indistinguishable from q@k^T; the transpose flags in
+    the outlined eqn's static_info must reject the rewrite (r3 ADVICE:
+    this case silently corrupted results)."""
+    class SquareK(gluon.HybridBlock):
+        def forward(self, q, k, v):
+            s = npx.batch_dot(q, k)           # NO transpose; k is (B,d,d)
+            p = npx.softmax(s, axis=-1)
+            return npx.batch_dot(p, v)
+
+    rng = onp.random.RandomState(3)
+    B, T, d = 2, 24, 16                        # T != d, k square (d,d)
+    q = np.array(rng.randn(B, T, d).astype("float32"))
+    k = np.array(rng.randn(B, d, d).astype("float32"))
+    v = np.array(rng.randn(B, d, d).astype("float32"))
+    net = SquareK()
+    ref = net(q, k, v).asnumpy()
+    b = get_backend("flash_attention")
+    b.last_rewrites = -1
+    out = net.optimize_for(q, k, v, backend="flash_attention").asnumpy()
+    assert b.last_rewrites == 0
+    onp.testing.assert_allclose(out, ref, rtol=1e-6, atol=1e-6)
+
+
+def test_guard_rejects_transposed_pv_stage():
+    """att @ v^T must not fuse: the pallas kernel computes att @ v."""
+    class OddPV(gluon.HybridBlock):
+        def forward(self, q, k, v):
+            s = npx.batch_dot(q, k, transpose_b=True)
+            p = npx.softmax(s, axis=-1)
+            return npx.batch_dot(p, v, transpose_b=True)
+
+    rng = onp.random.RandomState(4)
+    q = np.array(rng.randn(2, 32, 32).astype("float32"))
+    k = np.array(rng.randn(2, 32, 32).astype("float32"))
+    v = np.array(rng.randn(2, 32, 32).astype("float32"))
+    net = OddPV()
+    ref = net(q, k, v).asnumpy()
+    b = get_backend("flash_attention")
+    b.last_rewrites = -1
+    out = net.optimize_for(q, k, v, backend="flash_attention").asnumpy()
+    assert b.last_rewrites == 0
+    onp.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-5)
+
+
 def test_int8_backend_block_rewrite():
     """optimize_for(backend='int8') routes through quantize_net."""
     from incubator_mxnet_tpu.contrib import quantization as q

@@ -212,6 +212,31 @@ def test_fold_conv_bn_matches_fp32():
     assert q.fold_conv_bn(b) == 0
 
 
+def test_fold_conv_bn_skips_fused_activation():
+    """Conv2D(activation='relu') -> BN must NOT fold: the relu sits between
+    the conv output and the BN, so moving the BN affine before it changes
+    results (r3 ADVICE; the reference oneDNN pass only folds bare conv->BN)."""
+    rng = onp.random.RandomState(5)
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Conv2D(8, 3, padding=1, in_channels=4,
+                            activation="relu"),
+            gluon.nn.BatchNorm(in_channels=8))
+    net.initialize()
+    x = np.array(rng.uniform(-1, 1, (2, 4, 8, 8)).astype("float32"))
+    net(x)
+    for name, p in net.collect_params().items():
+        if "running_mean" in name or "beta" in name:
+            p.set_data(np.array(rng.uniform(-0.5, 0.5,
+                                            p.shape).astype("float32")))
+        if "running_var" in name or "gamma" in name:
+            p.set_data(np.array(rng.uniform(0.5, 2.0,
+                                            p.shape).astype("float32")))
+    ref = net(x).asnumpy()
+    assert q.fold_conv_bn(net) == 0                  # skipped, not folded
+    assert type(net._children["1"]) is gluon.nn.BatchNorm
+    onp.testing.assert_allclose(net(x).asnumpy(), ref, rtol=0, atol=0)
+
+
 def test_requantize_chain_matches_unchained():
     """conv-bn-relu-conv chain: quantize_net with fold_bn+requantize stays
     within int8 error of fp32 and chains the two convs through int8 (the
