@@ -50,6 +50,20 @@ int main(int argc, char** argv) {
     std::printf("FAIL model_zoo_forward\n");
     return 1;
   }
+  // --- KV-cache text generation (serving path) ---
+  Predictor gpt = Predictor::FromFactory(
+      "incubator_mxnet_tpu.models.gpt", "gpt_tiny");
+  NDArray prompt =
+      NDArray({1.f, 2.f, 3.f, 4.f}, {1, 4}).AsType("int32");
+  NDArray seq = gpt.Generate(prompt, 6);
+  std::vector<size_t> gshape = seq.Shape();
+  if (gshape.size() == 2 && gshape[0] == 1 && gshape[1] == 10) {
+    std::printf("PASS gpt_generate\n");
+  } else {
+    std::printf("FAIL gpt_generate\n");
+    return 1;
+  }
+
   std::printf("ALL OK\n");
   return 0;
 }

@@ -191,6 +191,11 @@ class NDArray {
 
   NDArray Sum() const { return Unary("sum"); }
   NDArray Exp() const { return Unary("exp"); }
+  NDArray AsType(const std::string& dtype) const {
+    PyObject* r = PyObject_CallMethod(obj_, "astype", "s", dtype.c_str());
+    if (!r) _throw_py("astype");
+    return NDArray(r);
+  }
   NDArray ArgmaxChannel() const {
     PyObject* r = PyObject_CallMethod(Runtime::Get().np(), "argmax", "Oi",
                                       obj_, -1);
@@ -284,6 +289,61 @@ class Predictor {
     Py_DECREF(sb);
     if (!net) _throw_py("SymbolBlock.imports");
     return Predictor(net);
+  }
+
+  // any python-side model factory, e.g. ("incubator_mxnet_tpu.models.gpt",
+  // "gpt_tiny") — for architectures outside the vision zoo
+  static Predictor FromFactory(const std::string& module,
+                               const std::string& factory,
+                               const std::string& params_file = "") {
+    Runtime::Get();
+    PyObject* mod = PyImport_ImportModule(module.c_str());
+    if (!mod) _throw_py("import " + module);
+    PyObject* net = PyObject_CallMethod(mod, factory.c_str(), nullptr);
+    Py_DECREF(mod);
+    if (!net) _throw_py(factory);
+    PyObject* r = params_file.empty()
+        ? PyObject_CallMethod(net, "initialize", nullptr)
+        : PyObject_CallMethod(net, "load_parameters", "s",
+                              params_file.c_str());
+    if (!r) _throw_py(params_file.empty() ? "initialize"
+                                          : "load_parameters");
+    Py_DECREF(r);
+    return Predictor(net);
+  }
+
+  // KV-cache text generation (serving path, `models/decoding.py`): the
+  // wrapped net must expose .generate, e.g. GPTModel. One compiled XLA
+  // program per shape signature; greedy unless do_sample.
+  NDArray Generate(const NDArray& tokens, int max_new_tokens,
+                   bool do_sample = false, int top_k = 0,
+                   double temperature = 1.0, long seed = -1) const {
+    PyObject* kwargs = PyDict_New();
+    PyDict_SetItemString(kwargs, "do_sample",
+                         do_sample ? Py_True : Py_False);
+    if (top_k > 0) {
+      PyObject* k = PyLong_FromLong(top_k);
+      PyDict_SetItemString(kwargs, "top_k", k);
+      Py_DECREF(k);
+    }
+    PyObject* t = PyFloat_FromDouble(temperature);
+    PyDict_SetItemString(kwargs, "temperature", t);
+    Py_DECREF(t);
+    if (seed >= 0) {
+      PyObject* s = PyLong_FromLong(seed);
+      PyDict_SetItemString(kwargs, "seed", s);
+      Py_DECREF(s);
+    }
+    PyObject* meth = PyObject_GetAttrString(net_, "generate");
+    if (!meth) { Py_DECREF(kwargs); _throw_py("generate"); }
+    PyObject* args = Py_BuildValue("(Oi)", tokens.handle(),
+                                   max_new_tokens);
+    PyObject* out = PyObject_Call(meth, args, kwargs);
+    Py_DECREF(args);
+    Py_DECREF(kwargs);
+    Py_DECREF(meth);
+    if (!out) _throw_py("generate");
+    return NDArray(out);
   }
 
   NDArray Forward(const NDArray& input) const {

@@ -1,4 +1,5 @@
 """gluon.contrib (reference: `python/mxnet/gluon/contrib/__init__.py`)."""
 from . import data, estimator
+from .moe import MoEFFN
 
-__all__ = ["estimator", "data"]
+__all__ = ["estimator", "data", "MoEFFN"]

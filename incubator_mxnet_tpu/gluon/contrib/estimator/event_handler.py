@@ -234,7 +234,7 @@ def _fmt(value):
     return f"{value:.4f}" if isinstance(value, float) else str(value)
 
 
-class CheckpointHandler(TrainBegin, BatchEnd, EpochEnd):
+class CheckpointHandler(TrainBegin, TrainEnd, BatchEnd, EpochEnd):
     """Save params (+trainer states) periodically; keep best by monitored
     metric (reference: event_handler.py:336)."""
 
@@ -274,6 +274,20 @@ class CheckpointHandler(TrainBegin, BatchEnd, EpochEnd):
         os.makedirs(self.model_dir, exist_ok=True)
         if self.resume_from_checkpoint:
             self._resume_from_checkpoint(estimator)
+        # SIGTERM (TPU maintenance / preemption) saves immediately, so a
+        # `resume_from_checkpoint=True` restart loses at most one batch
+        from .... import preemption
+
+        self._preemption_hook = lambda: self._save_checkpoint(estimator)
+        preemption.on_preemption(self._preemption_hook)
+
+    def train_end(self, estimator, *args, **kwargs):
+        from .... import preemption
+
+        hook = getattr(self, "_preemption_hook", None)
+        if hook is not None:
+            preemption.remove_preemption_hook(hook)
+            self._preemption_hook = None
 
     def _resume_from_checkpoint(self, estimator):
         """Reload the newest matching checkpoint's params (+trainer states),

@@ -1,0 +1,79 @@
+"""Mixture-of-Experts gluon block (user-facing MoE).
+
+Reference role: the reference has no MoE (GluonNLP-era MXNet predates
+it); the TPU build adds it as a gluon layer because the sharding
+machinery makes expert parallelism natural (`parallel/moe.py` — GShard
+dispatch over an `ep` mesh axis). This block is the single-device /
+data-parallel form: experts live in one stacked parameter, tokens
+dispatch with capacity-factor top-1/top-2 gating, and the load-balance
+auxiliary loss is RETURNED so callers add it to the objective (Switch
+Transformer training recipe).
+"""
+from __future__ import annotations
+
+from ...gluon.block import HybridBlock
+from ...gluon.parameter import Parameter
+
+__all__ = ["MoEFFN"]
+
+
+class MoEFFN(HybridBlock):
+    """Token-routed expert FFN layer.
+
+    forward(x) with x (N, T, D) or (T, D) returns `(out, aux_loss)` —
+    out has x's shape, aux_loss is the scalar Switch load-balance term
+    (multiply by your chosen coefficient, typically 1e-2, and ADD to the
+    task loss; gradients through it train the gate toward balanced
+    routing).
+
+    Parameters
+    ----------
+    units : int            token dim D
+    hidden_size : int      per-expert FFN hidden dim H
+    num_experts : int      number of experts E
+    top_k : int            1 (Switch) or 2 (GShard) routing
+    capacity_factor : float  slots per expert = cf * top_k * T / E
+    """
+
+    def __init__(self, units, hidden_size, num_experts, top_k=2,
+                 capacity_factor=1.25):
+        super().__init__()
+        if top_k not in (1, 2):
+            raise ValueError("top_k must be 1 or 2")
+        self._units = units
+        self._hidden = hidden_size
+        self._experts = num_experts
+        self._top_k = top_k
+        self._cf = capacity_factor
+        self.gate_weight = Parameter(shape=(num_experts, units),
+                                     init="xavier")
+        self.w1 = Parameter(shape=(num_experts, units, hidden_size),
+                            init="xavier")
+        self.b1 = Parameter(shape=(num_experts, hidden_size), init="zeros")
+        self.w2 = Parameter(shape=(num_experts, hidden_size, units),
+                            init="xavier")
+        self.b2 = Parameter(shape=(num_experts, units), init="zeros")
+
+    def forward(self, x):
+        from ...ndarray.ndarray import apply_op
+        from ...parallel.moe import moe_dispatch_combine, moe_ffn_apply
+
+        top_k, cf = self._top_k, self._cf
+
+        def f(xv, gw, w1, b1, w2, b2):
+            shape = xv.shape
+            tokens = xv.reshape(-1, shape[-1])             # (T, D)
+            logits = tokens @ gw.T                          # (T, E)
+            out, aux = moe_dispatch_combine(
+                tokens, logits, moe_ffn_apply(w1, b1, w2, b2),
+                capacity_factor=cf, top_k=top_k)
+            return out.reshape(shape), aux
+
+        return apply_op("moe_ffn", f,
+                        (x, self.gate_weight.data(), self.w1.data(),
+                         self.b1.data(), self.w2.data(), self.b2.data()),
+                        n_outputs=2)
+
+    def __repr__(self):
+        return (f"MoEFFN({self._units} -> {self._hidden}, "
+                f"E={self._experts}, top{self._top_k})")

@@ -87,24 +87,18 @@ class Trainer:
     def _allreduce_grads(self):
         if self._kvstore is None:
             return
-        from ..ndarray.sparse import RowSparseNDArray
-
-        multi_process = getattr(self._kvstore, "num_workers", 1) > 1
         for i, p in enumerate(self._params):
             if p.grad_req != "null" and p._data is not None \
                     and p._data._grad is not None:
                 grad = p.data()._grad
-                if isinstance(grad, RowSparseNDArray) and not multi_process:
-                    # Keep row-sparse grads sparse: the single-process kvstore
-                    # reduce is an identity but its out-write would densify
-                    # the stored rows, defeating the lazy optimizer update
-                    # (reference keeps row_sparse through kvstore push/pull,
-                    # kvstore_local.h:232). DataParallel reduces inside its
-                    # own compiled step. Under a dist kvstore the cross-
-                    # process allreduce is required for correctness, so the
-                    # grad does go through (densifying — documented
-                    # divergence from the reference's sparse ZPush).
-                    continue
+                # Dense grads allreduce as usual. row_sparse grads ride the
+                # sparse pushpull: copies merge by gather-unique-sum and the
+                # out-write stays (indices, values), so the lazy optimizer
+                # update still touches only looked-up rows (reference:
+                # kvstore_local.h:232 PushImpl row_sparse merge). Under a
+                # dist store the cross-process allreduce densifies — the
+                # RowSparse out-write then re-expresses as all-rows-stored
+                # (documented divergence from the reference's sparse ZPush).
                 self._kvstore.pushpull(i, grad, out=grad)
 
     def update(self, batch_size, ignore_stale_grad=False):
@@ -148,7 +142,12 @@ class Trainer:
                 payload.append(onp.asarray(s))
         with open(fname, "wb") as f:
             pickle.dump({"states": payload,
-                         "num_update": self._optimizer.num_update}, f)
+                         "num_update": self._optimizer.num_update,
+                         # per-param update counts drive Adam-family bias
+                         # correction: losing them resets t and inflates
+                         # the post-resume step size
+                         "index_update_count":
+                             dict(self._optimizer._index_update_count)}, f)
 
     def load_states(self, fname):
         import pickle
@@ -170,3 +169,5 @@ class Trainer:
         self._states = states
         self._states_initialized = [s is not None for s in states]
         self._optimizer.num_update = payload.get("num_update", 0)
+        self._optimizer._index_update_count = dict(
+            payload.get("index_update_count", {}))

@@ -53,26 +53,115 @@ class _SingleProcessStore(KVStoreBase):
         for k, v in zip(keys, values):
             self._store[k] = v.copy() if isinstance(v, NDArray) else NDArray(v)
 
+    @staticmethod
+    def _merge_sparse(vs):
+        """Aggregate per-device row_sparse gradient copies: concatenate
+        (indices, values) and gather-unique-sum — the CommDevice reduce for
+        sparse values (reference: `src/kvstore/kvstore_local.h:232`
+        PushImpl row_sparse merge). Stays sparse: only touched rows are
+        materialized."""
+        import jax.numpy as jnp
+
+        from ..ndarray.sparse import RowSparseNDArray
+
+        sp = [v for v in vs if isinstance(v, RowSparseNDArray)]
+        if len(sp) != len(vs):
+            raise ValueError("cannot mix row_sparse and dense values for "
+                             "one key in a single push")
+        idx = jnp.concatenate([v._sp_indices for v in sp])      # noqa: SLF001
+        val = jnp.concatenate([v._sp_values for v in sp])       # noqa: SLF001
+        merged = RowSparseNDArray(val, idx, sp[0].shape)
+        u, v = merged._canonical()                              # noqa: SLF001
+        return RowSparseNDArray(v, u, sp[0].shape)
+
     def push(self, key, value, priority=0):  # noqa: ARG002
-        keys = key if isinstance(key, (list, tuple)) else [key]
-        values = value if isinstance(value, (list, tuple)) else [value]
+        from ..ndarray.sparse import RowSparseNDArray
+
+        if isinstance(key, (list, tuple)):
+            keys, values = key, value
+        else:
+            # scalar key: a list value is the per-device COPIES of that one
+            # key (reference push(key, [list]) aggregation semantics)
+            keys, values = [key], [value]
         for k, v in zip(keys, values):
             vs = v if isinstance(v, (list, tuple)) else [v]
-            agg = vs[0]
-            for extra in vs[1:]:
-                agg = agg + extra
-            agg = self._maybe_compress(k, agg)
+            if any(isinstance(x, RowSparseNDArray) for x in vs):
+                agg = self._merge_sparse(vs)
+            else:
+                agg = vs[0]
+                for extra in vs[1:]:
+                    agg = agg + extra
+                agg = self._maybe_compress(k, agg)
             agg = self._reduce(agg)
             if self._updater is not None and k in self._store:
                 self._updater(k, agg, self._store[k])
+            elif isinstance(agg, RowSparseNDArray):
+                # aggregated sparse gradient: the store entry keeps the
+                # row_sparse form (reference stores merged buffers in the
+                # value's stype) so a following pull/row_sparse_pull sees
+                # only touched rows
+                self._store[k] = agg.copy()
             elif k in self._store:
                 self._store[k]._set_data(agg._data)
             else:
                 self._store[k] = agg.copy()
 
+    def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):  # noqa: ARG002
+        """Pull ONLY `row_ids` rows of the stored value as row_sparse
+        (reference: `kvstore_local.h:279` PullRowSparseImpl — the
+        BERT-scale embedding path: never materialize (vocab, dim))."""
+        import jax.numpy as jnp
+
+        from ..ndarray.sparse import RowSparseNDArray
+
+        if row_ids is None:
+            raise ValueError("row_sparse_pull requires row_ids")
+        if isinstance(key, (list, tuple)):
+            keys = key
+            ids = row_ids if isinstance(row_ids, (list, tuple)) \
+                else [row_ids] * len(keys)
+            outs = out if isinstance(out, (list, tuple)) \
+                else [out] * len(keys)
+        else:
+            # scalar key: list out/row_ids are the per-device TARGETS for
+            # that one key, each with its own row set (reference:
+            # PullRowSparseImpl per-device row unions)
+            keys = [key]
+            ids = [row_ids]
+            outs = [out]
+        results = []
+        for k, rid, o in zip(keys, ids, outs):
+            v = self._store[k]
+            rids = rid if isinstance(rid, (list, tuple)) else [rid]
+            tgts = o if isinstance(o, (list, tuple)) else [o]
+            if len(rids) != len(tgts) and o is not None:
+                raise ValueError(
+                    f"row_sparse_pull key {k!r}: {len(tgts)} outs but "
+                    f"{len(rids)} row_ids")
+            per_key = []
+            for rj, t in zip(rids, tgts if o is not None
+                             else [None] * len(rids)):
+                rid_j = rj._data if isinstance(rj, NDArray) \
+                    else jnp.asarray(rj)
+                rows = jnp.unique(rid_j.reshape(-1)).astype(jnp.int32)
+                if isinstance(v, RowSparseNDArray):
+                    res = v.retain(NDArray(rows))
+                else:
+                    res = RowSparseNDArray(v._data[rows], rows, v.shape)
+                if t is not None:
+                    t._set_sparse(res._sp_values,     # noqa: SLF001
+                                  res._sp_indices)    # noqa: SLF001
+                per_key.append(res)
+            results.append(per_key if isinstance(rid, (list, tuple))
+                           else per_key[0])
+        return results if isinstance(key, (list, tuple)) else results[0]
+
     def pull(self, key, out=None, priority=0, ignore_sparse=True):  # noqa: ARG002
-        keys = key if isinstance(key, (list, tuple)) else [key]
-        outs = out if isinstance(out, (list, tuple)) else [out]
+        if isinstance(key, (list, tuple)):
+            keys, outs = key, out if out is not None else [None] * len(key)
+        else:
+            # scalar key: a list out is the per-device TARGETS for that key
+            keys, outs = [key], [out]
         results = []
         for k, o in zip(keys, outs):
             v = self._store[k]
@@ -90,18 +179,37 @@ class _SingleProcessStore(KVStoreBase):
         copies (the reference's `CommDevice::Reduce` input shape,
         `src/kvstore/comm.h:482`): they are summed, then the result is
         written to every entry of `out`."""
+        from ..ndarray.sparse import RowSparseNDArray
+
         if not isinstance(key, (list, tuple)):
             key, value, out = [key], [value], [out]
         elif out is None:
             out = [None] * len(key)
         for k, v, o in zip(key, value, out):  # noqa: B007
             vs = v if isinstance(v, (list, tuple)) else [v]
+            targets = o if isinstance(o, (list, tuple)) else [o]
+            if any(isinstance(x, RowSparseNDArray) for x in vs):
+                red = self._reduce(self._merge_sparse(vs))
+                for t in targets or [None]:
+                    if t is None:
+                        continue
+                    if isinstance(t, RowSparseNDArray) and \
+                            isinstance(red, RowSparseNDArray):
+                        t._set_sparse(red._sp_values,      # noqa: SLF001
+                                      red._sp_indices)     # noqa: SLF001
+                    else:
+                        t._set_data(red._data)
+                if all(t is None for t in targets) and \
+                        isinstance(vs[0], RowSparseNDArray) and \
+                        isinstance(red, RowSparseNDArray):
+                    vs[0]._set_sparse(red._sp_values,      # noqa: SLF001
+                                      red._sp_indices)     # noqa: SLF001
+                continue
             agg = vs[0]
             for extra in vs[1:]:
                 agg = agg + extra
             agg = self._maybe_compress(k, agg)
             red = self._reduce(agg)
-            targets = o if isinstance(o, (list, tuple)) else [o]
             for t in targets:
                 if t is not None:
                     t._set_data(red._data)

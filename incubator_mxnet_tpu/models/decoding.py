@@ -1,0 +1,308 @@
+"""KV-cache incremental decoding for causal LMs (the serving path).
+
+Reference role: the reference deploys frozen graphs through the
+cpp-package `Predictor` (`cpp-package/include/mxnet-cpp/`), and the
+GPT-2 generation of its era (GluonNLP) re-ran the full forward per
+token. TPU-native design instead compiles the WHOLE decode as one XLA
+program:
+
+- a static-shape KV cache `(L, N, H, max_length, d)` — no growing
+  shapes, so there is exactly ONE compile per (batch, prompt-bucket,
+  max_new_tokens) signature, not one per decoded length;
+- prefill = one causal flash-attention pass over the prompt that also
+  writes the prompt's K/V into the cache;
+- decode = `lax.scan` over steps; each step runs a scan-over-layers
+  single-token forward against the cache (O(T) work per token instead
+  of the O(T²) full re-forward) and samples the next token in-graph;
+- sampling (temperature / top-k) uses the framework RNG key so
+  `mx.random.seed` reproduces generations.
+
+The layer math mirrors `GPTModel.forward` exactly (pre-norm blocks,
+gelu FFN, tied LM head) — greedy decode emits the same tokens as the
+eager full-forward loop, asserted by `tests/test_gpt.py`.
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+__all__ = ["GPTDecoder"]
+
+
+def _j():
+    import jax
+
+    return jax
+
+
+def _ln(x, g, b, eps=1e-5):
+    """float32-internal layer norm matching `npx.layer_norm`."""
+    jnp = _j().numpy
+    xd = x.dtype
+    x32 = x.astype(jnp.float32)
+    mu = x32.mean(axis=-1, keepdims=True)
+    var = ((x32 - mu) ** 2).mean(axis=-1, keepdims=True)
+    y = (x32 - mu) / jnp.sqrt(var + eps)
+    return (y * g.astype(jnp.float32) + b.astype(jnp.float32)).astype(xd)
+
+
+def _dense(x, w, b=None):
+    """`npx.fully_connected(flatten=False)`: y = x @ W^T (+ b)."""
+    jnp = _j().numpy
+    y = x @ w.T
+    return y if b is None else y + b
+
+
+def _split_qkv(h, n_heads):
+    """(N, T, 3C) -> three (N, H, T, d), matching the gluon reshape."""
+    jnp = _j().numpy
+    N, T, C3 = h.shape
+    C = C3 // 3
+    d = C // n_heads
+    qkv = h.reshape(N, T, 3, n_heads, d)
+    q = jnp.transpose(qkv[:, :, 0], (0, 2, 1, 3))
+    k = jnp.transpose(qkv[:, :, 1], (0, 2, 1, 3))
+    v = jnp.transpose(qkv[:, :, 2], (0, 2, 1, 3))
+    return q, k, v
+
+
+class GPTDecoder:
+    """Compiled KV-cache text generation over a (trained) `GPTModel`.
+
+    Parameters are read from the model at construction (zero-copy jax
+    references); the jit cache persists across calls, so repeated
+    generation with the same shapes never recompiles.
+    """
+
+    def __init__(self, model):
+        self._model = model
+        self._n_heads = model.blocks[0].attn._num_heads
+        self._units = model.blocks[0].attn._units
+        self._tie = model._tie
+        self._max_length = int(model.position_embed.shape[0])
+        self._param_ids = None
+        self.refresh()
+
+    # -- parameters ---------------------------------------------------------
+
+    @staticmethod
+    def _leaf(p):
+        return p.data()._data  # noqa: SLF001 — jax value, zero-copy
+
+    def _extract_params(self, model):
+        jnp = _j().numpy
+        per_layer = []
+        for blk in model.blocks:
+            per_layer.append({
+                "ln1_g": self._leaf(blk.ln1.gamma),
+                "ln1_b": self._leaf(blk.ln1.beta),
+                "qkv_w": self._leaf(blk.attn.qkv.weight),
+                "qkv_b": self._leaf(blk.attn.qkv.bias),
+                "proj_w": self._leaf(blk.attn.proj.weight),
+                "proj_b": self._leaf(blk.attn.proj.bias),
+                "ln2_g": self._leaf(blk.ln2.gamma),
+                "ln2_b": self._leaf(blk.ln2.beta),
+                "ffn1_w": self._leaf(blk.ffn.ffn1.weight),
+                "ffn1_b": self._leaf(blk.ffn.ffn1.bias),
+                "ffn2_w": self._leaf(blk.ffn.ffn2.weight),
+                "ffn2_b": self._leaf(blk.ffn.ffn2.bias),
+            })
+        # stack per-layer leaves on a leading L axis: scan-over-layers
+        # keeps compile time flat in depth (one traced layer body)
+        stacked = {k: jnp.stack([lp[k] for lp in per_layer])
+                   for k in per_layer[0]}
+        params = {
+            "layers": stacked,
+            "embed": self._leaf(model.word_embed.weight),
+            "pos": self._leaf(model.position_embed),
+            "lnf_g": self._leaf(model.ln_f.gamma),
+            "lnf_b": self._leaf(model.ln_f.beta),
+        }
+        if not self._tie:
+            params["head_w"] = self._leaf(model.lm_head.weight)
+        return params
+
+    def _current_ids(self):
+        """Identity fingerprint of every live parameter buffer — jax
+        arrays are immutable, so any set_data / optimizer step rebinds the
+        buffer and changes its id."""
+        return tuple(id(self._leaf(p)) for p in
+                     self._model.collect_params().values())
+
+    def refresh(self):
+        """Re-read parameters from the model if any changed since the
+        last stack (cheap identity walk; the O(model) re-stack only runs
+        after an actual update — serving calls stay zero-copy)."""
+        ids = self._current_ids()
+        if ids != self._param_ids:
+            self._params = self._extract_params(self._model)
+            self._param_ids = ids
+
+    # -- math ---------------------------------------------------------------
+
+    def _logits(self, params, x):
+        x = _ln(x, params["lnf_g"], params["lnf_b"])
+        if self._tie:
+            return x @ params["embed"].T
+        return x @ params["head_w"].T
+
+    def _prefill_layer(self, x, lp, cache_len):
+        """Full-prompt causal attention; returns (x', k, v) padded to S."""
+        jax = _j()
+        jnp = jax.numpy
+        from ..ops.flash_attention import flash_attention
+
+        H = self._n_heads
+        h = _ln(x, lp["ln1_g"], lp["ln1_b"])
+        q, k, v = _split_qkv(_dense(h, lp["qkv_w"], lp["qkv_b"]), H)
+        d = q.shape[-1]
+        o = flash_attention(q, k, v, causal=True,
+                            sm_scale=1.0 / math.sqrt(d))
+        N, _, T, _ = o.shape
+        o = jnp.transpose(o, (0, 2, 1, 3)).reshape(N, T, H * d)
+        x = x + _dense(o, lp["proj_w"], lp["proj_b"])
+        h = _ln(x, lp["ln2_g"], lp["ln2_b"])
+        ffn = _dense(jax.nn.gelu(_dense(h, lp["ffn1_w"], lp["ffn1_b"])),
+                     lp["ffn2_w"], lp["ffn2_b"])
+        pad = [(0, 0), (0, 0), (0, cache_len - T), (0, 0)]
+        return x + ffn, jnp.pad(k, pad), jnp.pad(v, pad)
+
+    def _decode_layer(self, x, lp, ck, cv, pos):
+        """One-token forward against the cache; writes k/v at `pos`."""
+        jax = _j()
+        jnp = jax.numpy
+        lax = jax.lax
+
+        H = self._n_heads
+        h = _ln(x, lp["ln1_g"], lp["ln1_b"])
+        q, k, v = _split_qkv(_dense(h, lp["qkv_w"], lp["qkv_b"]), H)
+        d = q.shape[-1]
+        # write this token's k/v at position pos (static-shape update)
+        ck = lax.dynamic_update_slice(ck, k, (0, 0, pos, 0))
+        cv = lax.dynamic_update_slice(cv, v, (0, 0, pos, 0))
+        # attend to positions 0..pos; later slots hold zeros/garbage that
+        # the mask excludes (f32 scores for a stable softmax)
+        s = jnp.einsum("nhqd,nhkd->nhqk", q, ck,
+                       preferred_element_type=jnp.float32)
+        s = s / math.sqrt(d)
+        mask = jnp.arange(ck.shape[2]) <= pos
+        s = jnp.where(mask[None, None, None, :], s, -jnp.inf)
+        p = jax.nn.softmax(s, axis=-1).astype(cv.dtype)
+        o = jnp.einsum("nhqk,nhkd->nhqd", p, cv)
+        N = x.shape[0]
+        o = jnp.transpose(o, (0, 2, 1, 3)).reshape(N, 1, H * d)
+        x = x + _dense(o, lp["proj_w"], lp["proj_b"])
+        h = _ln(x, lp["ln2_g"], lp["ln2_b"])
+        ffn = _dense(jax.nn.gelu(_dense(h, lp["ffn1_w"], lp["ffn1_b"])),
+                     lp["ffn2_w"], lp["ffn2_b"])
+        return x + ffn, ck, cv
+
+    def _sample(self, logits, key, temperature, top_k, do_sample):
+        jax = _j()
+        jnp = jax.numpy
+        if not do_sample:
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        logits = logits.astype(jnp.float32) / temperature
+        if top_k is not None:
+            vals, idx = jax.lax.top_k(logits, top_k)
+            choice = jax.random.categorical(key, vals, axis=-1)
+            return jnp.take_along_axis(
+                idx, choice[:, None], axis=-1)[:, 0].astype(jnp.int32)
+        return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
+
+    # -- the compiled program ----------------------------------------------
+
+    @functools.cached_property
+    def _generate_fn(self):
+        jax = _j()
+        jnp = jax.numpy
+        lax = jax.lax
+
+        def generate(params, tokens, key, temperature, *, max_new, top_k,
+                     do_sample, cache_len):
+            N, T0 = tokens.shape
+            L = params["layers"]["ln1_g"].shape[0]
+
+            # ---- prefill: full causal pass over the prompt ----
+            x = params["embed"][tokens] + params["pos"][:T0]
+
+            def pre_layer(x, lp):
+                x, k, v = self._prefill_layer(x, lp, cache_len)
+                return x, (k, v)
+
+            x, (ck, cv) = lax.scan(pre_layer, x, params["layers"])
+            logits0 = self._logits(params, x[:, -1])     # (N, V)
+
+            # ---- decode: one scan step per new token ----
+            def step(carry, step_key):
+                ck, cv, pos, tok = carry
+
+                x = (params["embed"][tok][:, None]
+                     + lax.dynamic_slice_in_dim(params["pos"], pos, 1))
+
+                def dec_layer(x, layer):
+                    lp, ck_l, cv_l = layer
+                    x, ck_l, cv_l = self._decode_layer(x, lp, ck_l, cv_l,
+                                                       pos)
+                    return x, (ck_l, cv_l)
+
+                x, (ck, cv) = lax.scan(dec_layer, x,
+                                       (params["layers"], ck, cv))
+                logits = self._logits(params, x[:, 0])
+                nxt = self._sample(logits, step_key, temperature, top_k,
+                                   do_sample)
+                return (ck, cv, pos + 1, nxt), tok
+
+            first = self._sample(logits0, key, temperature, top_k,
+                                 do_sample)
+            # each step consumes the carried token and samples the next:
+            # `first` + (max_new - 1) steps = max_new generated tokens
+            keys = jax.random.split(jax.random.fold_in(key, 1),
+                                    max_new)[1:]
+            (_, _, _, last), toks = lax.scan(
+                step, (ck, cv, jnp.int32(T0), first), keys)
+            # toks holds the CARRIED token per step; append the final
+            # sample to complete max_new outputs
+            out = jnp.concatenate(
+                [jnp.transpose(toks, (1, 0)), last[:, None]], axis=1)
+            return out
+
+        return jax.jit(generate, static_argnames=("max_new", "top_k",
+                                                  "do_sample", "cache_len"))
+
+    def generate(self, tokens, max_new_tokens, temperature=1.0, top_k=None,
+                 do_sample=False, seed=None):
+        """Generate `max_new_tokens` continuations of `tokens` (N, T0).
+
+        Greedy by default; `do_sample=True` draws from the
+        temperature-scaled (optionally top-k-truncated) distribution
+        using the framework RNG (`mx.random.seed` reproduces runs).
+        """
+        jax = _j()
+        jnp = jax.numpy
+        from .. import random as mxrandom
+        from ..ndarray.ndarray import NDArray
+
+        toks = tokens._data if isinstance(tokens, NDArray) else \
+            jnp.asarray(tokens)
+        toks = toks.astype(jnp.int32)
+        if max_new_tokens <= 0:
+            return NDArray(toks)          # no-op budget: prompt unchanged
+        T0 = toks.shape[1]
+        total = T0 + max_new_tokens
+        if total > self._max_length:
+            raise ValueError(
+                f"prompt ({T0}) + max_new_tokens ({max_new_tokens}) "
+                f"exceeds max_length ({self._max_length})")
+        if seed is not None:
+            key = jax.random.PRNGKey(seed)
+        else:
+            key = mxrandom.next_key()
+        new = self._generate_fn(
+            self._params, toks, key,
+            jnp.float32(max(temperature, 1e-6)),
+            max_new=max_new_tokens,
+            top_k=None if top_k is None else int(top_k),
+            do_sample=bool(do_sample),
+            cache_len=total)
+        return NDArray(jnp.concatenate([toks, new], axis=1))

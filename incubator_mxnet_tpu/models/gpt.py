@@ -100,24 +100,57 @@ class GPTModel(HybridBlock):
             return np.dot(x, self.word_embed.weight.data().T)
         return self.lm_head(x)
 
-    def generate(self, tokens, max_new_tokens, temperature=1.0, top_k=None):
-        """Greedy / top-k sampling loop (eager — each step re-runs the
-        compiled forward on the grown prefix; a KV-cache decode loop is
-        the serving-path optimization, out of scope for parity)."""
+    def generate(self, tokens, max_new_tokens, temperature=1.0, top_k=None,
+                 do_sample=False, seed=None, use_cache=True):
+        """Generate continuations of `tokens` (N, T0).
+
+        `use_cache=True` (default) compiles the whole decode as ONE XLA
+        program over a static-shape KV cache (`models/decoding.py`) —
+        O(T) work per token, no per-length recompiles. `use_cache=False`
+        keeps the eager full-forward loop (O(T²); the parity reference
+        for tests).
+
+        Greedy unless `do_sample=True`, which draws from the
+        temperature-scaled, optionally top-k-truncated distribution
+        using the framework RNG (`mx.random.seed` / `seed=` reproduce).
+        """
+        if use_cache:
+            from .decoding import GPTDecoder
+
+            if getattr(self, "_decoder", None) is None:
+                self._decoder = GPTDecoder(self)
+            else:
+                self._decoder.refresh()
+            return self._decoder.generate(
+                tokens, max_new_tokens, temperature=temperature,
+                top_k=top_k, do_sample=do_sample, seed=seed)
+
         from .. import random as mxrandom
 
-        del mxrandom  # sampling uses np.random via npx.topk below
         out = tokens
-        for _ in range(max_new_tokens):
+        for i in range(max_new_tokens):
             logits = self(out)[:, -1]                       # (N, V)
-            if temperature != 1.0:
-                logits = logits / temperature
-            if top_k is not None:
-                kth = npx.topk(logits, k=top_k, ret_typ="value",
-                               axis=-1)[:, -1:]
-                logits = np.where(logits < kth,
-                                  np.full_like(logits, -1e30), logits)
-            nxt = np.argmax(logits, axis=-1).reshape(-1, 1).astype("int32")
+            if do_sample:
+                import jax
+
+                logits = logits / max(temperature, 1e-6)
+                lo = logits._data.astype("float32")  # noqa: SLF001
+                key = (jax.random.PRNGKey(seed) if seed is not None
+                       else mxrandom.next_key())
+                key = jax.random.fold_in(key, i)
+                if top_k is not None:
+                    vals, idx = jax.lax.top_k(lo, int(top_k))
+                    choice = jax.random.categorical(key, vals, axis=-1)
+                    import jax.numpy as jnp
+
+                    nxt_j = jnp.take_along_axis(
+                        idx, choice[:, None], axis=-1)[:, 0]
+                else:
+                    nxt_j = jax.random.categorical(key, lo, axis=-1)
+                nxt = np.array(nxt_j).reshape(-1, 1).astype("int32")
+            else:
+                nxt = np.argmax(logits, axis=-1).reshape(-1, 1) \
+                        .astype("int32")
             out = np.concatenate([out, nxt], axis=1)
         return out
 

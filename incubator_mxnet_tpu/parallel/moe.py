@@ -19,7 +19,8 @@ Everything is einsum/all_to_all — static shapes, MXU contractions.
 """
 from __future__ import annotations
 
-__all__ = ["moe_dispatch_combine", "moe_ffn_apply", "top1_gating"]
+__all__ = ["moe_dispatch_combine", "moe_ffn_apply", "top1_gating",
+           "top2_gating"]
 
 
 def top1_gating(logits, capacity):
@@ -52,8 +53,52 @@ def top1_gating(logits, capacity):
     return combine, dispatch, aux
 
 
+def top2_gating(logits, capacity):
+    """Top-2 gating with capacity (GShard §3.2 / Switch appendix): each
+    token routes to its two highest-probability experts; gate weights are
+    the two probs renormalized over the kept pair. Capacity ranks count
+    first-choice tokens before second-choice tokens (first choices are
+    dropped last). Returns (combine (T,E,C), dispatch, aux) — aux is the
+    Switch load-balance loss computed on FIRST choices."""
+    import jax
+    import jax.numpy as jnp
+
+    t, e = logits.shape
+    probs = jax.nn.softmax(logits, axis=-1)
+    e1 = jnp.argmax(probs, axis=-1)                        # (T,)
+    oh1 = jax.nn.one_hot(e1, e, dtype=jnp.float32)
+    probs2 = probs * (1.0 - oh1)
+    e2 = jnp.argmax(probs2, axis=-1)
+    oh2 = jax.nn.one_hot(e2, e, dtype=jnp.float32)
+    g1 = jnp.take_along_axis(probs, e1[:, None], 1)[:, 0]
+    g2 = jnp.take_along_axis(probs, e2[:, None], 1)[:, 0]
+    denom = jnp.maximum(g1 + g2, 1e-9)                     # renormalize pair
+    g1, g2 = g1 / denom, g2 / denom
+
+    # slot ranks: first choices fill before ANY second choice
+    rank1 = (jnp.cumsum(oh1, axis=0) - oh1) * oh1          # (T, E)
+    used1 = jnp.sum(oh1, axis=0, keepdims=True)            # (1, E)
+    rank2 = ((jnp.cumsum(oh2, axis=0) - oh2) + used1) * oh2
+    kept1 = (rank1 < capacity) * oh1
+    kept2 = (rank2 < capacity) * oh2
+
+    def to_dispatch(kept, rank):
+        slot = jnp.sum(rank * kept, axis=-1).astype(jnp.int32)
+        slot_oh = jax.nn.one_hot(slot, capacity, dtype=jnp.float32)
+        return kept[:, :, None] * slot_oh[:, None, :]      # (T, E, C)
+
+    d1 = to_dispatch(kept1, rank1)
+    d2 = to_dispatch(kept2, rank2)
+    dispatch = d1 + d2
+    combine = d1 * g1[:, None, None] + d2 * g2[:, None, None]
+    frac = jnp.mean(oh1, axis=0)
+    mean_prob = jnp.mean(probs, axis=0)
+    aux = e * jnp.sum(frac * mean_prob)
+    return combine, dispatch, aux
+
+
 def moe_dispatch_combine(x, gate_logits, expert_fn, capacity_factor=1.25,
-                         axis_name=None):
+                         axis_name=None, top_k=1):
     """Top-1 MoE layer body: dispatch -> expert_fn -> combine (GShard
     token-sharded layout).
 
@@ -72,8 +117,13 @@ def moe_dispatch_combine(x, gate_logits, expert_fn, capacity_factor=1.25,
     if e % n_groups:
         raise ValueError(f"{e} experts not divisible over {n_groups} "
                          "expert-parallel groups")
-    capacity = max(1, int(capacity_factor * t / e))
-    combine, dispatch, aux = top1_gating(gate_logits, capacity)
+    capacity = max(1, int(capacity_factor * top_k * t / e))
+    if top_k == 1:
+        combine, dispatch, aux = top1_gating(gate_logits, capacity)
+    elif top_k == 2:
+        combine, dispatch, aux = top2_gating(gate_logits, capacity)
+    else:
+        raise ValueError(f"top_k must be 1 or 2, got {top_k}")
     # keep the layer's activation dtype: f32 one-hots would upcast bf16
     # tokens and double the all_to_all bytes on ICI
     dispatch = dispatch.astype(x.dtype)

@@ -23,8 +23,9 @@ import os
 import signal
 import threading
 
-__all__ = ["on_preemption", "clear_preemption_hooks", "trigger",
-           "preempted", "atomic_save", "CheckpointManager"]
+__all__ = ["on_preemption", "remove_preemption_hook",
+           "clear_preemption_hooks", "trigger", "preempted", "atomic_save",
+           "CheckpointManager", "TrainingCheckpointer"]
 
 _HOOKS: list = []
 _LOCK = threading.Lock()
@@ -73,6 +74,13 @@ def on_preemption(save_fn):
     return save_fn
 
 
+def remove_preemption_hook(save_fn):
+    """Unregister a hook added by `on_preemption` (no-op if absent)."""
+    with _LOCK:
+        if save_fn in _HOOKS:
+            _HOOKS.remove(save_fn)
+
+
 def clear_preemption_hooks():
     with _LOCK:
         _HOOKS.clear()
@@ -113,6 +121,7 @@ class CheckpointManager:
         self._step = 0
         self._saved: list = []
         self._last_saved_step = None
+        self._saving = False
         if register_signal:
             on_preemption(self.save_now)
 
@@ -129,17 +138,28 @@ class CheckpointManager:
     def save_now(self):
         if self._last_saved_step == self._step:
             return None  # idempotent (signal during periodic save)
-        path = self.path_for(self._step)
-        atomic_save(path, self._save_state)
-        self._last_saved_step = self._step
-        self._saved.append(path)
-        while len(self._saved) > self._keep:
-            old = self._saved.pop(0)
-            try:
-                os.remove(old)
-            except OSError:
-                pass
-        return path
+        if self._saving:
+            # a signal landed MID-save (signal handlers run on the main
+            # thread between bytecodes): re-entering atomic_save would
+            # interleave writes on the same tmp path and corrupt the
+            # checkpoint being written — skip; the in-progress save is
+            # already persisting this step's state
+            return None
+        self._saving = True
+        try:
+            path = self.path_for(self._step)
+            atomic_save(path, self._save_state)
+            self._last_saved_step = self._step
+            self._saved.append(path)
+            while len(self._saved) > self._keep:
+                old = self._saved.pop(0)
+                try:
+                    os.remove(old)
+                except OSError:
+                    pass
+            return path
+        finally:
+            self._saving = False
 
     def latest(self):
         """Most recent checkpoint path on disk (None if none)."""
@@ -147,3 +167,88 @@ class CheckpointManager:
 
         found = sorted(glob.glob(f"{self._prefix}-*.ckpt"))
         return found[-1] if found else None
+
+
+class TrainingCheckpointer:
+    """Preemption-safe train-state checkpointing wired to Gluon.
+
+    One file per checkpoint holding net parameters, Trainer/optimizer
+    states (momenta, num_update), and the step counter — everything a
+    restarted process needs to continue the exact loss trajectory
+    (reference role: `--model-prefix` resume in
+    `example/image-classification/common/fit.py`, plus the estimator's
+    CheckpointHandler; here resume survives SIGTERM preemption).
+
+    Usage::
+
+        ckpt = TrainingCheckpointer(prefix, net, trainer, every_n=50)
+        start = ckpt.resume()            # 0 on a fresh run
+        for step in range(start, total):
+            ...train...
+            ckpt.step()                  # periodic + SIGTERM-triggered
+    """
+
+    def __init__(self, prefix, net, trainer=None, every_n=100, keep=3,
+                 register_signal=True):
+        self._net = net
+        self._trainer = trainer
+        self._mgr = CheckpointManager(prefix, self._write, every_n=every_n,
+                                      keep=keep,
+                                      register_signal=register_signal)
+
+    def _write(self, path):
+        import pickle
+        import tempfile
+
+        blob = {"step": self._mgr._step}  # noqa: SLF001
+        with tempfile.TemporaryDirectory() as d:
+            p = os.path.join(d, "net.params")
+            self._net.save_parameters(p)
+            with open(p, "rb") as f:
+                blob["params"] = f.read()
+            if self._trainer is not None:
+                t = os.path.join(d, "trainer.states")
+                self._trainer.save_states(t)
+                with open(t, "rb") as f:
+                    blob["trainer"] = f.read()
+        with open(path, "wb") as f:
+            pickle.dump(blob, f)
+
+    def step(self, n=1):
+        return self._mgr.step(n)
+
+    def save_now(self):
+        return self._mgr.save_now()
+
+    def resume(self):
+        """Load the most recent checkpoint if any; returns the step to
+        continue from (0 when starting fresh)."""
+        import pickle
+        import tempfile
+
+        path = self._mgr.latest()
+        if path is None:
+            return 0
+        with open(path, "rb") as f:
+            blob = pickle.load(f)
+        with tempfile.TemporaryDirectory() as d:
+            p = os.path.join(d, "net.params")
+            with open(p, "wb") as f:
+                f.write(blob["params"])
+            self._net.load_parameters(p)
+            if self._trainer is not None and "trainer" in blob:
+                t = os.path.join(d, "trainer.states")
+                with open(t, "wb") as f:
+                    f.write(blob["trainer"])
+                self._trainer.load_states(t)
+        import glob
+
+        step = int(blob["step"])
+        self._mgr._step = step              # noqa: SLF001
+        self._mgr._last_saved_step = step   # noqa: SLF001 — no resave
+        # seed rotation with EVERY on-disk checkpoint (oldest first) so the
+        # previous incarnation's files stay inside the `keep` bound instead
+        # of leaking across preemption/restart cycles
+        self._mgr._saved = sorted(          # noqa: SLF001
+            glob.glob(f"{self._mgr._prefix}-*.ckpt"))  # noqa: SLF001
+        return step

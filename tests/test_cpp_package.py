@@ -32,5 +32,6 @@ def test_cpp_frontend_builds_and_runs():
     assert "PASS ndarray_math" in out, (out, run.stderr[-2000:])
     assert "PASS ndarray_sum" in out
     assert "PASS model_zoo_forward" in out
+    assert "PASS gpt_generate" in out
     assert "ALL OK" in out
     assert run.returncode == 0

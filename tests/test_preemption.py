@@ -99,3 +99,122 @@ while True:          # train "forever" until preempted
     net2 = gluon.nn.Dense(4, in_units=8)
     net2.load_parameters(str(tmp_path / sorted(ckpts)[-1]))
     assert net2.weight.data().shape == (4, 8)
+
+
+def test_save_now_is_not_reentrant(tmp_path):
+    """A signal landing MID-save must not re-enter atomic_save on the same
+    tmp path (r3 ADVICE: interleaved writes corrupt the checkpoint)."""
+    clear_preemption_hooks()
+    prefix = str(tmp_path / "re")
+    entered = []
+
+    m = None
+
+    def save_state(p):
+        entered.append(p)
+        if len(entered) == 1:
+            # simulate SIGTERM arriving while the periodic save runs
+            result = m.save_now()
+            assert result is None          # skipped, not re-entered
+        open(p, "wb").write(b"S")
+
+    m = CheckpointManager(prefix, save_state, every_n=1,
+                          register_signal=False)
+    m.step()
+    assert len(entered) == 1               # the writer ran exactly once
+    assert os.path.exists(m.path_for(1))
+    clear_preemption_hooks()
+
+
+_TRAIN_SCRIPT = """
+import os, sys, time
+sys.path.insert(0, {repo!r})
+import numpy as onp
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import autograd, gluon, np, optimizer
+from incubator_mxnet_tpu.preemption import TrainingCheckpointer
+
+mx.random.seed(0)
+net = gluon.nn.HybridSequential()
+net.add(gluon.nn.Dense(16, activation="relu", in_units=8),
+        gluon.nn.Dense(1, in_units=16))
+net.initialize()
+trainer = gluon.Trainer(net.collect_params(), "adam",
+                        {{"learning_rate": 1e-2}})
+l2 = gluon.loss.L2Loss()
+rng = onp.random.RandomState(0)
+X = np.array(rng.uniform(-1, 1, (64, 8)).astype("float32"))
+W = rng.uniform(-1, 1, (8, 1)).astype("float32")
+Y = np.array(X.asnumpy() @ W)
+
+ckpt = TrainingCheckpointer({prefix!r}, net, trainer, every_n=5, keep=2)
+start = ckpt.resume()
+log = open({log!r}, "a")
+for step in range(start, {total}):
+    with autograd.record():
+        loss = l2(net(X), Y)
+    loss.backward()
+    trainer.step(64)
+    val = float(loss.mean().asnumpy())
+    print(step, repr(val), file=log, flush=True)
+    ckpt.step()
+    print("STEP", step, flush=True)
+    {sleep}
+print("DONE", flush=True)
+"""
+
+
+def _losses(path):
+    out = {}
+    for line in open(path):
+        s, v = line.split()
+        out[int(s)] = float(v)
+    return out
+
+
+def test_preemption_resume_roundtrip(tmp_path):
+    """Kill a training subprocess with SIGTERM mid-run; the restarted run
+    must continue from the saved step and reproduce the uninterrupted
+    run's loss trajectory (params + Adam state + step all restored)."""
+    import time
+
+    golden_log = str(tmp_path / "golden.log")
+    code = _TRAIN_SCRIPT.format(repo=REPO, prefix=str(tmp_path / "g" / "run"),
+                                log=golden_log, total=30, sleep="pass")
+    os.makedirs(tmp_path / "g")
+    subprocess.run([sys.executable, "-c", code], check=True, timeout=600,
+                   stdout=subprocess.DEVNULL)
+    golden = _losses(golden_log)
+    assert len(golden) == 30
+
+    # interrupted run: SIGTERM after a handful of steps
+    run_log = str(tmp_path / "resumed.log")
+    os.makedirs(tmp_path / "r")
+    code = _TRAIN_SCRIPT.format(repo=REPO, prefix=str(tmp_path / "r" / "run"),
+                                log=run_log, total=30,
+                                sleep="time.sleep(0.05)")
+    proc = subprocess.Popen([sys.executable, "-c", code],
+                            stdout=subprocess.PIPE, text=True)
+    seen = 0
+    for line in proc.stdout:
+        if line.startswith("STEP"):
+            seen += 1
+            if seen == 12:      # past the step-10 periodic checkpoint
+                proc.send_signal(signal.SIGTERM)
+                break
+    proc.wait(timeout=120)
+    ckpts = os.listdir(tmp_path / "r")
+    assert ckpts, "no checkpoint left by SIGTERM"
+
+    # restart: must resume from the signal-time checkpoint, not step 0
+    proc2 = subprocess.run([sys.executable, "-c", code], check=True,
+                           timeout=600, capture_output=True, text=True)
+    first_resumed = [ln for ln in proc2.stdout.splitlines()
+                     if ln.startswith("STEP")][0]
+    resumed_from = int(first_resumed.split()[1])
+    assert resumed_from >= 11, first_resumed   # not a cold start
+    resumed = _losses(run_log)
+    assert set(resumed) == set(range(30))
+    for s in range(resumed_from, 30):
+        onp.testing.assert_allclose(resumed[s], golden[s], rtol=1e-4,
+                                    atol=1e-6), s
