@@ -22,6 +22,7 @@ extras:
 from __future__ import annotations
 
 import json
+import os
 import sys
 import time
 
@@ -288,14 +289,29 @@ def bench_gpt_decode(batch=8, prompt=32, new=224, iters=3):
     return tokens_s, tokens_s / loop_tokens_s
 
 
+def _bench_input_pipeline_subprocess():
+    """Run the input-pipeline bench in its OWN process: the host has one
+    CPU core, so its cv2-decode/prefetch thread pool and the main
+    process's jax dispatch threads poison each other's numbers in either
+    order (round 3 measured fp32 inference 2365 img/s contended vs 4772
+    clean). A subprocess isolates both directions."""
+    import subprocess
+
+    out = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--pipeline-only"],
+        capture_output=True, text=True, timeout=900,
+        cwd=os.path.dirname(os.path.abspath(__file__)))
+    if out.returncode != 0:
+        raise RuntimeError(out.stderr[-500:])
+    return float(out.stdout.strip().splitlines()[-1])
+
+
 def main():
     extras = {}
 
-    # input pipeline FIRST: the host has one CPU core, and the decode pool
-    # measures ~8x slower once the later benches' dispatch threads exist
     try:
         extras["input_pipeline_img_s_per_core"] = round(
-            bench_input_pipeline(), 1)
+            _bench_input_pipeline_subprocess(), 1)
     except Exception as e:  # pragma: no cover
         print(f"input pipeline bench failed: {e}", file=sys.stderr)
 
@@ -382,4 +398,7 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    if "--pipeline-only" in sys.argv:
+        print(bench_input_pipeline())
+    else:
+        main()

@@ -68,6 +68,12 @@ def _search_roots(root=None):
     roots.append(os.path.join(data_dir(), "models"))
     extra = os.environ.get("INCUBATOR_MXNET_TPU_MODEL_PATH", "")
     roots += [p for p in extra.split(os.pathsep) if p]
+    # packaged store: small trained artifacts committed WITH the framework
+    # (the no-egress stand-in for the reference's S3 model repo; also the
+    # cross-version load-compatibility anchor,
+    # `tests/nightly/model_backwards_compatibility_check/`)
+    roots.append(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                              "_store"))
     return roots
 
 

@@ -1,2 +1,4 @@
 from .base import KVStoreBase, create, register  # noqa: F401
+from .byteps import BytePS  # noqa: F401
+from .horovod import Horovod  # noqa: F401
 from .kvstore import KVStore, KVStoreDevice, KVStoreDist, KVStoreLocal  # noqa: F401

@@ -73,14 +73,13 @@ def create(name="local"):
     (multi-host over DCN via jax.distributed; async degrades to sync —
     collectives are synchronous on TPU, documented in SURVEY.md §2.4),
     'nccl' (alias of 'device'; ICI collectives replace NCCL),
-    'horovod'/'byteps' aliases map to 'device'."""
+    'horovod'/'byteps' (compatibility facades, `kvstore/horovod.py` /
+    `byteps.py`, over the same collectives)."""
     if not isinstance(name, str):
         raise TypeError("name must be a string")
     key = name.lower()
     aliases = {
         "nccl": "device",
-        "horovod": "device",
-        "byteps": "device",
         "dist_sync": "dist",
         "dist_device_sync": "dist",
         "dist_sync_device": "dist",

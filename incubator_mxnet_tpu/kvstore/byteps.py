@@ -1,0 +1,30 @@
+"""BytePS-compatible kvstore facade (reference:
+`python/mxnet/kvstore/byteps.py:29`).
+
+The reference delegates to `byteps.mxnet` (RDMA/PS hybrid push-pull). On
+TPU the communication role collapses into the same synchronous
+collectives as every other store; this facade preserves the BytePS
+class's surface — notably that `broadcast` must be called before
+`pushpull` on a key, and `pull` is unsupported — over the mesh /
+`jax.distributed` transport.
+"""
+from __future__ import annotations
+
+from .base import register
+from .horovod import Horovod
+
+__all__ = ["BytePS"]
+
+
+@register
+class BytePS(Horovod):
+    """`kv = mx.kv.create('byteps')` — push-pull store, no raw pull."""
+
+    def pushpull(self, key, value, out=None, priority=0):
+        keys = key if isinstance(key, (list, tuple)) else [key]
+        for k in keys:
+            if k not in self._store:
+                raise ValueError(
+                    f"BytePS requires broadcast(key={k!r}) before pushpull "
+                    "(reference byteps.py contract)")
+        return super().pushpull(key, value, out=out, priority=priority)

@@ -1,0 +1,76 @@
+"""Horovod-compatible kvstore facade (reference:
+`python/mxnet/kvstore/horovod.py:27`).
+
+The reference delegates broadcast/pushpull to `horovod.mxnet`'s MPI
+allreduce ring. On TPU the same role — synchronous allreduce across all
+workers with no parameter server — is exactly what XLA collectives over
+ICI/DCN do, so this facade keeps the Horovod class's API surface
+(rank/local_rank/num_workers, broadcast, pushpull; `pull` unsupported,
+like the original) while the transport is the mesh/`jax.distributed`
+reduce of the device store.
+"""
+from __future__ import annotations
+
+from .base import register
+from .kvstore import KVStoreDevice
+
+__all__ = ["Horovod"]
+
+
+@register
+class Horovod(KVStoreDevice):
+    """`kv = mx.kv.create('horovod')` — allreduce-only store."""
+
+    def __init__(self):
+        super().__init__()
+        try:
+            from ..parallel import dist
+
+            dist.initialize()
+            self._dist = dist
+        except Exception:
+            self._dist = None
+
+    @property
+    def rank(self):
+        return self._dist.rank() if self._dist else 0
+
+    @property
+    def local_rank(self):
+        return self._dist.rank() if self._dist else 0
+
+    @property
+    def num_workers(self):
+        return self._dist.num_processes() if self._dist else 1
+
+    def _reduce(self, value):
+        from ..ndarray.ndarray import NDArray
+
+        if self._dist and self._dist.num_processes() > 1 \
+                and isinstance(value, NDArray):
+            return NDArray(self._dist.allreduce(value._data, op="sum"))
+        return super()._reduce(value)
+
+    def init(self, key, value):
+        from ..ndarray.ndarray import NDArray
+
+        keys = key if isinstance(key, (list, tuple)) else [key]
+        values = value if isinstance(value, (list, tuple)) else [value]
+        for k, v in zip(keys, values):
+            arr = v if isinstance(v, NDArray) else NDArray(v)
+            if self._dist and self._dist.num_processes() > 1:
+                # rank 0's tensor wins — the Horovod broadcast contract
+                # (reference horovod.py broadcast_parameters); without it
+                # per-rank random init silently diverges
+                arr = NDArray(self._dist.broadcast(arr._data, root=0))
+            self._store[k] = arr.copy()
+
+    def pull(self, key, out=None, priority=0, ignore_sparse=True):
+        # parity: the reference's Horovod store forbids pull (allreduce
+        # has no server-held value to read back); use pushpull/broadcast
+        raise NotImplementedError(
+            "Horovod kvstore does not support pull; use pushpull")
+
+    @staticmethod
+    def is_capable(capability):
+        return False          # no server-side optimizer (reference parity)

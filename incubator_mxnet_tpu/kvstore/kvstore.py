@@ -318,6 +318,12 @@ class KVStoreDist(_SingleProcessStore):
         from ..ndarray.ndarray import waitall
 
         waitall()
+        # sync point doubles as the command channel: queued
+        # profile_process='server' commands ship and apply here
+        # (reference: KVStoreServerProfilerCommand on ps-lite messages)
+        from .. import profiler
+
+        profiler.sync_remote_commands()
         self._dist.barrier()
 
 

@@ -292,13 +292,25 @@ class NDArray:
         return apply_op("var", lambda x: _jnp().var(x, axis=axis, keepdims=keepdims,
                                                     ddof=ddof), (self,))
 
+    def _arg_reduce_method(self, name, axis, keepdims):
+        from ..numpy import _needs_i64_index
+
+        if _needs_i64_index(self._data, axis):
+            # >2^31-element search axis: int32 result wraps (same x64
+            # escape as numpy.argmax/_arg_reduce)
+            import jax
+
+            with jax.enable_x64(True):
+                return NDArray(getattr(_jnp(), name)(
+                    self._data, axis=axis, keepdims=keepdims))
+        return apply_op(name, lambda x: getattr(_jnp(), name)(
+            x, axis=axis, keepdims=keepdims), (self,))
+
     def argmax(self, axis=None, keepdims=False):
-        return apply_op("argmax", lambda x: _jnp().argmax(x, axis=axis,
-                                                          keepdims=keepdims), (self,))
+        return self._arg_reduce_method("argmax", axis, keepdims)
 
     def argmin(self, axis=None, keepdims=False):
-        return apply_op("argmin", lambda x: _jnp().argmin(x, axis=axis,
-                                                          keepdims=keepdims), (self,))
+        return self._arg_reduce_method("argmin", axis, keepdims)
 
     def argsort(self, axis=-1):
         return apply_op("argsort", lambda x: _jnp().argsort(x, axis=axis), (self,))
@@ -355,6 +367,15 @@ class NDArray:
     # ------------------------------------------------------------- indexing
     def __getitem__(self, key):
         key = _unwrap_index(key)
+        if _needs_static_big_index(key, self.shape):
+            # int indices past the int32 range: jnp bakes integer indices
+            # into the gather as a (canonicalized-int32) ARGUMENT, which
+            # overflows on >2^31-element arrays. lax.slice keeps bounds as
+            # STATIC attributes, so the big-tensor path stays exact
+            # (reference: int64 tensor support, tests/nightly/
+            # test_large_array.py)
+            return apply_op("getitem",
+                            lambda x: _static_big_index(x, key), (self,))
         return apply_op("getitem", lambda x: x[key], (self,))
 
     def __setitem__(self, key, value):
@@ -609,6 +630,77 @@ class NDArray:
         self._grad_req = "write"
         self._node = None
         self._out_idx = 0
+
+
+_INT32_SAFE = 2 ** 31 - 16
+
+
+def _needs_static_big_index(key, shape):
+    """True when `key` is pure int/slice basic indexing touching offsets
+    beyond int32 (only possible on >2^31-element axes)."""
+    keys = key if isinstance(key, tuple) else (key,)
+    any_big = False
+    for i, k in enumerate(keys):
+        if isinstance(k, int):
+            dim = shape[i] if i < len(shape) else 0
+            if abs(k) > _INT32_SAFE or (k < 0 and dim > _INT32_SAFE):
+                any_big = True
+        elif isinstance(k, slice):
+            for b in (k.start, k.stop):
+                if b is not None and abs(b) > _INT32_SAFE:
+                    any_big = True
+        else:
+            return False    # advanced indexing: the normal path handles it
+    return any_big
+
+
+_BIG_SLICE_RUN = None
+
+
+def _big_slice_jit(x, starts, stops, out_shape):
+    """`lax.slice` under jit: eager lax.slice re-dispatches through
+    dynamic_slice whose start-index ARGS canonicalize to int32 and
+    overflow past 2^31; under jit the bounds stay static HLO attributes
+    (64-bit safe). One module-level jit so repeat slices hit the cache."""
+    global _BIG_SLICE_RUN
+    if _BIG_SLICE_RUN is None:
+        import functools
+
+        import jax
+        from jax import lax
+
+        @functools.partial(jax.jit,
+                           static_argnames=("starts", "stops", "out_shape"))
+        def run(x, *, starts, stops, out_shape):
+            return lax.slice(x, starts, stops).reshape(out_shape)
+
+        _BIG_SLICE_RUN = run
+    return _BIG_SLICE_RUN(x, starts=starts, stops=stops,
+                          out_shape=out_shape)
+
+
+def _static_big_index(x, key):
+    """Basic int/slice indexing with >int32 offsets (static bounds)."""
+    keys = list(key) if isinstance(key, tuple) else [key]
+    keys += [slice(None)] * (x.ndim - len(keys))
+    starts, stops, squeeze = [], [], []
+    for ax, k in enumerate(keys):
+        n = x.shape[ax]
+        if isinstance(k, int):
+            i = k + n if k < 0 else k
+            starts.append(i)
+            stops.append(i + 1)
+            squeeze.append(ax)
+        else:
+            s, e, step = k.indices(n)
+            if step != 1:
+                raise IndexError(
+                    "big-tensor indexing supports step=1 slices only")
+            starts.append(s)
+            stops.append(max(s, e))
+    out_shape = tuple(e - s for ax, (s, e) in enumerate(zip(starts, stops))
+                      if ax not in squeeze)
+    return _big_slice_jit(x, tuple(starts), tuple(stops), out_shape)
 
 
 def _unwrap_index(key):

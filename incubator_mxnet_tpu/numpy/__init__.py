@@ -142,7 +142,8 @@ def _make(name, jnp_name=None):
 
 _ELEMWISE_AND_FRIENDS = [
     # ufuncs
-    "abs", "absolute", "add", "subtract", "multiply", "divide", "true_divide",
+    "abs", "absolute", "fabs", "add", "subtract", "multiply", "divide",
+    "true_divide",
     "floor_divide", "mod", "remainder", "fmod", "power", "float_power", "sqrt",
     "cbrt", "square", "exp", "expm1", "exp2", "log", "log2", "log10", "log1p",
     "sin", "cos", "tan", "arcsin", "arccos", "arctan", "arctan2", "sinh",
@@ -202,6 +203,46 @@ for _name in _ELEMWISE_AND_FRIENDS:
 del _g, _name, _jnp_mod
 
 
+def _needs_i64_index(data, axis):
+    lim = 2 ** 31 - 1
+    if axis is None:
+        return data.size - 1 > lim
+    ax = axis if axis >= 0 else axis + data.ndim
+    return data.shape[ax] - 1 > lim
+
+
+def _arg_reduce(name, a, axis=None, out=None):  # noqa: ARG001
+    data = a._data if isinstance(a, NDArray) else None
+    if data is not None and _needs_i64_index(data, axis):
+        # >2^31-element search axis: the default int32 result dtype wraps
+        # (reference: int64 tensor builds, tests/nightly/
+        # test_large_array.py) — compute under an x64 scope so the index
+        # comes back int64
+        import jax
+
+        with jax.enable_x64(True):
+            return NDArray(getattr(_jnp(), name)(data, axis=axis))
+    return apply_op_flat(name,
+                         lambda x: getattr(_jnp(), name)(x, axis=axis),
+                         (a,))
+
+
+def argmax(a, axis=None, out=None):
+    return _arg_reduce("argmax", a, axis=axis, out=out)
+
+
+def argmin(a, axis=None, out=None):
+    return _arg_reduce("argmin", a, axis=axis, out=out)
+
+
+def nanargmax(a, axis=None, out=None):
+    return _arg_reduce("nanargmax", a, axis=axis, out=out)
+
+
+def nanargmin(a, axis=None, out=None):
+    return _arg_reduce("nanargmin", a, axis=axis, out=out)
+
+
 def astype(a, dtype):
     return a.astype(dtype)
 
@@ -242,6 +283,27 @@ def fill_diagonal(a, val, wrap=False):
             (src,))
     a._adopt(out)
     return None  # numpy semantics: in-place, returns None
+
+
+def put_along_axis(arr, indices, values, axis):
+    """In-place scatter along `axis` (reference: `_npi` put_along_axis,
+    numpy semantics: mutates `arr`, returns None). Same NDArray rebind
+    discipline as `fill_diagonal`."""
+    src = arr._snapshot()
+    args = [src, indices]
+    if isinstance(values, NDArray):
+        args.append(values)
+
+        def f(x, idx, v):
+            return _jnp().put_along_axis(x, idx.astype("int32"), v, axis,
+                                         inplace=False)
+    else:
+        def f(x, idx):
+            return _jnp().put_along_axis(x, idx.astype("int32"), values,
+                                         axis, inplace=False)
+    out = apply_op_flat("put_along_axis", f, tuple(args))
+    arr._adopt(out)
+    return None
 
 
 def bfloat16(x=None):

@@ -171,3 +171,52 @@ def barrier(tag="barrier"):
 
     if jax.process_count() > 1:
         allreduce(jax.numpy.zeros((1,), "float32")).block_until_ready()
+
+
+_EXCHANGE_OVERSIZE = "__exchange_objs_oversize__"
+
+
+def exchange_objs(obj, max_bytes=4096):
+    """Collectively exchange one small picklable object per process;
+    returns the list of every rank's object (index = rank). Rides the
+    same allreduce transport as the data path — each rank fills ITS slot
+    of a (P, max_bytes) byte matrix, the sum concatenates them. The
+    command channel for remote-process profiler control (reference:
+    `KVStoreServerProfilerCommand`, `include/mxnet/kvstore.h:48` —
+    commands ride ps-lite messages there, collectives here)."""
+    import pickle
+
+    import numpy as onp
+
+    import jax
+    import jax.numpy as jnp
+
+    if not is_initialized() or jax.process_count() == 1:
+        return [obj]
+    payload = pickle.dumps(obj)
+    oversize = len(payload) > max_bytes - 4
+    if oversize:
+        # raising BEFORE the collective would leave peers blocked in the
+        # allreduce (distributed hang); ship a small error marker instead
+        # and raise on EVERY rank after the exchange completes
+        payload = pickle.dumps(_EXCHANGE_OVERSIZE)
+    P = jax.process_count()
+    me = jax.process_index()
+    mat = onp.zeros((P, max_bytes), "uint8")
+    mat[me, :4] = onp.frombuffer(len(payload).to_bytes(4, "little"),
+                                 "uint8")
+    mat[me, 4:4 + len(payload)] = onp.frombuffer(payload, "uint8")
+    # disjoint slots: the element-wise sum reassembles each rank's row
+    # verbatim (jnp promotes uint8 sums to uint32 — cast back for tobytes)
+    summed = onp.asarray(allreduce(jnp.asarray(mat),
+                                   op="sum")).astype("uint8")
+    out = []
+    for r in range(P):
+        n = int.from_bytes(summed[r, :4].tobytes(), "little")
+        out.append(pickle.loads(summed[r, 4:4 + n].tobytes())
+                   if n else None)
+    if any(o == _EXCHANGE_OVERSIZE for o in out):
+        raise ValueError(
+            f"exchange_objs: a rank's object exceeded the {max_bytes}-byte "
+            "command slot (all ranks raised after the collective)")
+    return out

@@ -30,11 +30,13 @@ import time
 from collections import defaultdict
 
 __all__ = ["set_config", "set_state", "start", "stop", "dump", "dumps",
-           "pause", "resume", "Scope", "profiler_scope", "device_events"]
+           "pause", "resume", "Scope", "profiler_scope", "device_events",
+           "memory_stats", "live_buffer_table", "memory_snapshot",
+           "analyze_memory"]
 
 _CONFIG = {"filename": "profile.json", "profile_all": False,
            "profile_imperative": True, "aggregate_stats": True,
-           "profile_device": True}
+           "profile_device": True, "profile_memory": False}
 _STATE = {"running": False, "jax_tracing": False, "trace_dir": None,
           "own_trace_dir": False}
 _EVENTS: list = []
@@ -44,15 +46,61 @@ _AGG = defaultdict(lambda: [0, 0.0, float("inf"), 0.0])  # count, total, min, ma
 _LOCK = threading.Lock()
 
 
+_REMOTE_PENDING: list = []   # ('set_config', {...}) / ('set_state', 'run')
+
+
 def set_config(**kwargs):
+    """`profile_process='server'` queues the config as a REMOTE command:
+    it ships to every process of the dist job at the next kvstore sync
+    point and applies there (reference: `KVStoreServerProfilerCommand`
+    kSetConfig riding ps-lite, `include/mxnet/kvstore.h:48` — the TPU
+    build has no separate server processes, so 'server' means 'all
+    processes of the job')."""
+    if kwargs.pop("profile_process", "worker") == "server":
+        _REMOTE_PENDING.append(("set_config", dict(kwargs)))
+        if not _dist_active():      # degenerate job: we ARE the server
+            _CONFIG.update(kwargs)
+        return
     _CONFIG.update(kwargs)
 
 
-def set_state(state="stop", profile_process="worker"):  # noqa: ARG001
+def set_state(state="stop", profile_process="worker"):
+    if profile_process == "server":
+        _REMOTE_PENDING.append(("set_state", state))
+        if _dist_active():
+            return
     if state in ("run", "start"):
         start()
     else:
         stop()
+
+
+def _dist_active():
+    try:
+        from .parallel import dist
+
+        return dist.is_initialized() and dist.num_processes() > 1
+    except Exception:
+        return False
+
+
+def sync_remote_commands():
+    """Collective exchange+apply of queued 'server' profiler commands —
+    called from KVStoreDist sync points (every process must participate;
+    commands from ANY rank apply on ALL ranks)."""
+    global _REMOTE_PENDING
+    if not _dist_active():
+        _REMOTE_PENDING = []
+        return
+    from .parallel import dist
+
+    mine, _REMOTE_PENDING = _REMOTE_PENDING, []
+    for cmds in dist.exchange_objs(mine):
+        for kind, arg in cmds or []:
+            if kind == "set_config":
+                _CONFIG.update(arg)
+            elif kind == "set_state":
+                set_state(arg)
 
 
 def start(profile_process="worker"):  # noqa: ARG001
@@ -158,6 +206,9 @@ def is_running():
 
 def record_op(name, dur_s):
     """Called from the op funnel when profiling is active."""
+    mem = None
+    if _CONFIG.get("profile_memory"):
+        mem = _live_bytes()
     with _LOCK:
         _EVENTS.append({"name": name, "ph": "X", "pid": 0, "tid": 0,
                         "ts": time.time() * 1e6, "dur": dur_s * 1e6})
@@ -166,6 +217,107 @@ def record_op(name, dur_s):
         agg[1] += dur_s
         agg[2] = min(agg[2], dur_s)
         agg[3] = max(agg[3], dur_s)
+        if mem is not None:
+            m = _MEM_AGG[name]
+            m[0] = max(m[0], mem)
+            if mem > _MEM_STATE["peak"]:
+                _MEM_STATE["peak"] = mem
+                _MEM_STATE["peak_op"] = name
+
+
+# ---------------------------------------------------------------------------
+# memory profiler (reference: `src/profiler/storage_profiler.h:130`
+# GpuDeviceStorageProfiler per-alloc attribution + kMemory profile mode,
+# `src/profiler/profiler.h:265`)
+# ---------------------------------------------------------------------------
+
+_MEM_AGG = defaultdict(lambda: [0])                 # peak live bytes at op
+_MEM_STATE = {"peak": 0, "peak_op": None}
+
+
+def _live_bytes():
+    import jax
+
+    total = 0
+    for a in jax.live_arrays():
+        try:
+            total += a.nbytes
+        except Exception:
+            pass
+    return total
+
+
+def memory_stats(device=None):
+    """Per-device memory statistics. On TPU/GPU this surfaces the PJRT
+    allocator's `bytes_in_use` / `peak_bytes_in_use`; on backends without
+    allocator stats (CPU) it falls back to summed live-buffer bytes. The
+    reference's `GpuDeviceStorageProfiler` csv role."""
+    import jax
+
+    devices = [device] if device is not None else jax.devices()
+    out = {}
+    for d in devices:
+        stats = None
+        try:
+            stats = d.memory_stats()
+        except Exception:
+            stats = None
+        if not stats:
+            live = sum(a.nbytes for a in jax.live_arrays()
+                       if d in getattr(a, "devices", lambda: set())())
+            stats = {"bytes_in_use": live, "peak_bytes_in_use": live,
+                     "source": "live_arrays"}
+        out[str(d)] = dict(stats)
+    return out
+
+
+def live_buffer_table(top=20):
+    """The largest live device buffers (shape, dtype, bytes) — per-alloc
+    attribution in the spirit of the reference's storage profiler dump."""
+    import jax
+
+    rows = []
+    for a in jax.live_arrays():
+        try:
+            rows.append((tuple(a.shape), str(a.dtype), int(a.nbytes)))
+        except Exception:
+            continue
+    rows.sort(key=lambda r: -r[2])
+    return rows[:top]
+
+
+def memory_snapshot(path="memory.prof"):
+    """Write a pprof-format device memory profile
+    (`jax.profiler.device_memory_profile`) — loadable with `pprof` /
+    TensorBoard memory viewer. Returns the path."""
+    import jax
+
+    with open(path, "wb") as f:
+        f.write(jax.profiler.device_memory_profile())
+    return path
+
+
+def analyze_memory(fn, *args, static_argnums=None):
+    """Compile `fn(*args)` and return XLA's memory analysis — argument /
+    output / TEMP (activation) / alias bytes and the generated code size.
+    The temp size is the compiler's actual activation-buffer plan, so it
+    directly exposes what remat saves (used by `tests/test_profiler.py`
+    to pin remat peak < no-remat peak). Works on every backend —
+    compile-time analysis, nothing is executed."""
+    import jax
+
+    jitted = jax.jit(fn, static_argnums=static_argnums or ())
+    compiled = jitted.lower(*args).compile()
+    an = compiled.memory_analysis()
+    if an is None:                 # pragma: no cover - backend-dependent
+        return None
+    return {
+        "argument_size_in_bytes": an.argument_size_in_bytes,
+        "output_size_in_bytes": an.output_size_in_bytes,
+        "temp_size_in_bytes": an.temp_size_in_bytes,
+        "alias_size_in_bytes": an.alias_size_in_bytes,
+        "generated_code_size_in_bytes": an.generated_code_size_in_bytes,
+    }
 
 
 def dump(finished=True, profile_process="worker"):  # noqa: ARG001
@@ -184,19 +336,29 @@ def dump(finished=True, profile_process="worker"):  # noqa: ARG001
     return path
 
 
-def dumps(reset=False, format="table", sort_by="total", ascending=False):  # noqa: ARG001
+def dumps(reset=False, format="table", sort_by="total", ascending=False,
+          memory=False):  # noqa: ARG001
     """Aggregate per-op stats (reference: profiler.py:154): host dispatch
-    table, then the device-timeline table when a trace was captured."""
+    table, then the device-timeline table when a trace was captured;
+    `memory=True` appends the memory section (per-device allocator stats,
+    observed live-bytes peak + the op at peak when
+    `set_config(profile_memory=True)` sampled during the run, and the
+    largest live buffers — the reference's kMemory mode +
+    storage-profiler table)."""
     with _LOCK:
         rows = [(name, c, tot * 1000, mn * 1000, mx * 1000)
                 for name, (c, tot, mn, mx) in _AGG.items()]
         dev_rows = [(name, c, tot_us / 1000.0)
                     for name, (c, tot_us) in _DEVICE_AGG.items()]
+        mem_rows = [(name, peak[0]) for name, peak in _MEM_AGG.items()]
+        mem_peak = dict(_MEM_STATE)
         if reset:
             _AGG.clear()
             _EVENTS.clear()
             _DEVICE_AGG.clear()
             _DEVICE_EVENTS.clear()
+            _MEM_AGG.clear()
+            _MEM_STATE.update(peak=0, peak_op=None)
     key = {"total": 2, "count": 1, "min": 3, "max": 4}.get(sort_by, 2)
     rows.sort(key=lambda r: r[key], reverse=not ascending)
     lines = [f"{'Name':<40}{'Count':>8}{'Total(ms)':>12}{'Min(ms)':>10}"
@@ -209,6 +371,27 @@ def dumps(reset=False, format="table", sort_by="total", ascending=False):  # noq
                   "=" * 80]
         for name, c, tot in dev_rows:
             lines.append(f"{name[:47]:<48}{c:>8}{tot:>12.3f}")
+    if memory:
+        lines += ["", "Memory", "=" * 80]
+        for dev, st in memory_stats().items():
+            in_use = st.get("bytes_in_use", 0)
+            peak = st.get("peak_bytes_in_use", in_use)
+            lines.append(f"{dev:<40}{in_use / 2**20:>14.2f} MiB in use"
+                         f"{peak / 2**20:>14.2f} MiB peak")
+        if mem_peak["peak"]:
+            lines.append(
+                f"observed live-bytes peak: {mem_peak['peak'] / 2**20:.2f} "
+                f"MiB at op {mem_peak['peak_op']}")
+            mem_rows.sort(key=lambda r: -r[1])
+            lines += ["", f"{'Op (peak live bytes at dispatch)':<48}"
+                          f"{'MiB':>12}", "-" * 60]
+            for name, peak_b in mem_rows[:15]:
+                lines.append(f"{name[:47]:<48}{peak_b / 2**20:>12.2f}")
+        lines += ["", f"{'Largest live buffers':<40}{'dtype':>10}"
+                      f"{'MiB':>12}", "-" * 62]
+        for shape, dtype, nbytes in live_buffer_table(10):
+            lines.append(f"{str(shape)[:39]:<40}{dtype:>10}"
+                         f"{nbytes / 2**20:>12.2f}")
     return "\n".join(lines)
 
 

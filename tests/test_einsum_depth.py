@@ -1,0 +1,272 @@
+"""Einsum contraction corpus + gradients (reference:
+`src/operator/numpy/np_einsum_op.cc` and the einsum block of
+`test_numpy_op.py`)."""
+import numpy as onp
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import autograd, np
+
+RNG = onp.random.RandomState(17)
+
+
+def _a(*shape):
+    return RNG.uniform(-1, 1, shape).astype("float32")
+
+
+def _check(spec, *ops, rtol=1e-4, atol=1e-5):
+    got = np.einsum(spec, *[np.array(o) for o in ops]).asnumpy()
+    ref = onp.einsum(spec, *[o.astype("float64") for o in ops])
+    onp.testing.assert_allclose(got, ref, rtol=rtol, atol=atol)
+
+
+def _check_grad(spec, *ops):
+    arrs = [np.array(o) for o in ops]
+    for a in arrs:
+        a.attach_grad()
+    with autograd.record():
+        y = np.einsum(spec, *arrs)
+        s = np.sum(y)
+    s.backward()
+    eps = 1e-2
+    for i, o in enumerate(ops):
+        flat = o.reshape(-1)
+        for j in (0, flat.size // 2, flat.size - 1):
+            pert = [x.copy() for x in ops]
+            pert[i].reshape(-1)[j] += eps
+            up = onp.einsum(spec, *[x.astype("float64")
+                                    for x in pert]).sum()
+            pert[i].reshape(-1)[j] -= 2 * eps
+            dn = onp.einsum(spec, *[x.astype("float64")
+                                    for x in pert]).sum()
+            num = (up - dn) / (2 * eps)
+            got = arrs[i].grad.asnumpy().reshape(-1)[j]
+            onp.testing.assert_allclose(got, num, rtol=5e-2, atol=5e-3)
+
+
+# -- single operand ----------------------------------------------------------
+
+def test_einsum_trace():
+    _check("ii", _a(5, 5))
+
+
+def test_einsum_diag():
+    _check("ii->i", _a(5, 5))
+
+
+def test_einsum_transpose():
+    _check("ij->ji", _a(3, 4))
+
+
+def test_einsum_sum_all():
+    _check("ij->", _a(3, 4))
+
+
+def test_einsum_sum_axis0():
+    _check("ij->j", _a(3, 4))
+
+
+def test_einsum_sum_axis1():
+    _check("ij->i", _a(3, 4))
+
+
+def test_einsum_identity():
+    _check("ij->ij", _a(3, 4))
+
+
+def test_einsum_3d_partial_sum():
+    _check("ijk->ik", _a(2, 3, 4))
+
+
+def test_einsum_3d_transpose():
+    _check("ijk->kji", _a(2, 3, 4))
+
+
+# -- two operands ------------------------------------------------------------
+
+def test_einsum_matmul():
+    _check("ij,jk->ik", _a(3, 4), _a(4, 5))
+
+
+def test_einsum_matmul_transposed_out():
+    _check("ij,jk->ki", _a(3, 4), _a(4, 5))
+
+
+def test_einsum_inner():
+    _check("i,i->", _a(6), _a(6))
+
+
+def test_einsum_outer():
+    _check("i,j->ij", _a(3), _a(4))
+
+
+def test_einsum_matvec():
+    _check("ij,j->i", _a(3, 4), _a(4))
+
+
+def test_einsum_vecmat():
+    _check("i,ij->j", _a(3), _a(3, 4))
+
+
+def test_einsum_hadamard():
+    _check("ij,ij->ij", _a(3, 4), _a(3, 4))
+
+
+def test_einsum_hadamard_sum():
+    _check("ij,ij->", _a(3, 4), _a(3, 4))
+
+
+def test_einsum_batch_matmul():
+    _check("bij,bjk->bik", _a(2, 3, 4), _a(2, 4, 5))
+
+
+def test_einsum_batch_matmul_broadcast_free():
+    _check("bij,jk->bik", _a(2, 3, 4), _a(4, 5))
+
+
+def test_einsum_attention_scores():
+    _check("nqd,nkd->nqk", _a(2, 5, 8), _a(2, 7, 8))
+
+
+def test_einsum_attention_context():
+    _check("nqk,nkd->nqd", _a(2, 5, 7), _a(2, 7, 8))
+
+
+def test_einsum_bilinear():
+    _check("ik,jkl->ijl", _a(2, 3), _a(4, 3, 5))
+
+
+def test_einsum_tensordot_style():
+    _check("ijk,kl->ijl", _a(2, 3, 4), _a(4, 5))
+
+
+def test_einsum_contraction_over_two_axes():
+    _check("ijk,ijl->kl", _a(2, 3, 4), _a(2, 3, 5))
+
+
+def test_einsum_row_contract_keep_batch():
+    _check("bi,bi->b", _a(4, 6), _a(4, 6))
+
+
+# -- three operands ----------------------------------------------------------
+
+def test_einsum_three_matmul_chain():
+    _check("ij,jk,kl->il", _a(2, 3), _a(3, 4), _a(4, 5))
+
+
+def test_einsum_three_mixed():
+    _check("ij,kj,kl->il", _a(2, 3), _a(4, 3), _a(4, 5))
+
+
+def test_einsum_three_hadamard_contract():
+    _check("ij,ij,ij->", _a(3, 4), _a(3, 4), _a(3, 4))
+
+
+# -- ellipsis ----------------------------------------------------------------
+
+def test_einsum_ellipsis_identity():
+    _check("...i->...i", _a(2, 3, 4))
+
+
+def test_einsum_ellipsis_sum_last():
+    _check("...i->...", _a(2, 3, 4))
+
+
+def test_einsum_ellipsis_matmul():
+    _check("...ij,...jk->...ik", _a(2, 3, 4), _a(2, 4, 5))
+
+
+def test_einsum_ellipsis_transpose():
+    _check("...ij->...ji", _a(2, 3, 4))
+
+
+# -- gradients ---------------------------------------------------------------
+
+def test_einsum_matmul_grad():
+    _check_grad("ij,jk->ik", _a(3, 4), _a(4, 3))
+
+
+def test_einsum_batch_matmul_grad():
+    _check_grad("bij,bjk->bik", _a(2, 3, 3), _a(2, 3, 3))
+
+
+def test_einsum_inner_grad():
+    _check_grad("i,i->", _a(5), _a(5))
+
+
+def test_einsum_trace_grad():
+    _check_grad("ii", _a(4, 4))
+
+
+def test_einsum_sum_grad():
+    _check_grad("ij->", _a(3, 4))
+
+
+def test_einsum_attention_grad():
+    _check_grad("nqd,nkd->nqk", _a(2, 3, 4), _a(2, 3, 4))
+
+
+# -- dtype handling ----------------------------------------------------------
+
+def test_einsum_bf16():
+    a, b = _a(4, 8), _a(8, 4)
+    got = np.einsum("ij,jk->ik",
+                    np.array(a).astype("bfloat16"),
+                    np.array(b).astype("bfloat16"))
+    assert "bfloat16" in str(got.dtype)
+    onp.testing.assert_allclose(got.astype("float32").asnumpy(), a @ b,
+                                rtol=0.05, atol=0.05)
+
+
+def test_einsum_int32():
+    a = onp.arange(6, dtype="int32").reshape(2, 3)
+    b = onp.arange(12, dtype="int32").reshape(3, 4)
+    got = np.einsum("ij,jk->ik", np.array(a), np.array(b)).asnumpy()
+    onp.testing.assert_array_equal(got, onp.einsum("ij,jk->ik", a, b))
+
+
+# -- tensordot / kron cousins ------------------------------------------------
+
+def test_tensordot_axes_int():
+    a, b = _a(3, 4, 5), _a(5, 4, 2)
+    got = np.tensordot(np.array(a), np.array(b), axes=1).asnumpy()
+    onp.testing.assert_allclose(got, onp.tensordot(a, b, axes=1),
+                                rtol=1e-4, atol=1e-5)
+
+
+def test_tensordot_axes_pairs():
+    a, b = _a(3, 4, 5), _a(4, 3, 2)
+    got = np.tensordot(np.array(a), np.array(b),
+                       axes=([0, 1], [1, 0])).asnumpy()
+    onp.testing.assert_allclose(
+        got, onp.tensordot(a, b, axes=([0, 1], [1, 0])), rtol=1e-4,
+        atol=1e-5)
+
+
+def test_kron():
+    a, b = _a(2, 3), _a(3, 2)
+    got = np.kron(np.array(a), np.array(b)).asnumpy()
+    onp.testing.assert_allclose(got, onp.kron(a, b), rtol=1e-5)
+
+
+def test_outer_fn():
+    a, b = _a(4), _a(5)
+    got = np.outer(np.array(a), np.array(b)).asnumpy()
+    onp.testing.assert_allclose(got, onp.outer(a, b), rtol=1e-5)
+
+
+def test_inner_fn():
+    a, b = _a(3, 4), _a(5, 4)
+    got = np.inner(np.array(a), np.array(b)).asnumpy()
+    onp.testing.assert_allclose(got, onp.inner(a, b), rtol=1e-4, atol=1e-5)
+
+
+def test_vdot_flattens():
+    a, b = _a(3, 4), _a(3, 4)
+    got = float(np.vdot(np.array(a), np.array(b)).asnumpy())
+    onp.testing.assert_allclose(got, onp.vdot(a, b), rtol=1e-4)
+
+
+def test_cross_3d():
+    a, b = _a(4, 3), _a(4, 3)
+    got = np.cross(np.array(a), np.array(b)).asnumpy()
+    onp.testing.assert_allclose(got, onp.cross(a, b), rtol=1e-4, atol=1e-5)

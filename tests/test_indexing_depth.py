@@ -1,0 +1,430 @@
+"""Indexing depth: getitem/setitem forms, take/gather families, boolean
+masks, put_along_axis — checked against NumPy (reference:
+`tests/python/unittest/test_numpy_op.py` indexing corpus +
+`src/operator/tensor/indexing_op.h`)."""
+import numpy as onp
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import autograd, np, npx
+
+RNG = onp.random.RandomState(11)
+
+
+def _arr(*shape):
+    return RNG.uniform(-5, 5, shape).astype("float32")
+
+
+def _check_get(ref, key):
+    got = np.array(ref)[key].asnumpy()
+    onp.testing.assert_array_equal(got, ref[key])
+
+
+# -- basic slicing -----------------------------------------------------------
+
+def test_getitem_int():
+    _check_get(_arr(5, 4), 2)
+
+
+def test_getitem_negative_int():
+    _check_get(_arr(5, 4), -1)
+
+
+def test_getitem_slice():
+    _check_get(_arr(8, 4), slice(2, 6))
+
+
+def test_getitem_slice_step():
+    _check_get(_arr(8, 4), slice(1, 8, 2))
+
+
+def test_getitem_slice_negative_step():
+    _check_get(_arr(8, 4), slice(None, None, -1))
+
+
+def test_getitem_slice_negative_bounds():
+    _check_get(_arr(8, 4), slice(-6, -2))
+
+
+def test_getitem_tuple_mixed():
+    _check_get(_arr(6, 5, 4), (2, slice(1, 4)))
+
+
+def test_getitem_ellipsis():
+    _check_get(_arr(3, 4, 5), (Ellipsis, 2))
+
+
+def test_getitem_newaxis():
+    a = _arr(3, 4)
+    got = np.array(a)[:, None].asnumpy()
+    onp.testing.assert_array_equal(got, a[:, None])
+
+
+def test_getitem_full_slice_is_view_semantics():
+    a = _arr(4, 4)
+    x = np.array(a)
+    onp.testing.assert_array_equal(x[:].asnumpy(), a)
+
+
+def test_getitem_scalar_result():
+    a = _arr(3, 3)
+    assert float(np.array(a)[1, 2].asnumpy()) == pytest.approx(a[1, 2])
+
+
+# -- advanced indexing -------------------------------------------------------
+
+def test_getitem_int_array():
+    a = _arr(6, 4)
+    idx = onp.array([0, 3, 5])
+    got = np.array(a)[np.array(idx.astype("int32"))].asnumpy()
+    onp.testing.assert_array_equal(got, a[idx])
+
+
+def test_getitem_int_array_negative():
+    a = _arr(6, 4)
+    idx = onp.array([-1, -6])
+    got = np.array(a)[np.array(idx.astype("int32"))].asnumpy()
+    onp.testing.assert_array_equal(got, a[idx])
+
+
+def test_getitem_two_int_arrays():
+    a = _arr(5, 5)
+    r = onp.array([0, 2, 4])
+    c = onp.array([1, 3, 0])
+    got = np.array(a)[np.array(r.astype("int32")),
+                      np.array(c.astype("int32"))].asnumpy()
+    onp.testing.assert_array_equal(got, a[r, c])
+
+
+def test_getitem_bool_mask():
+    a = _arr(6, 3)
+    m = a[:, 0] > 0
+    got = np.array(a)[np.array(m)].asnumpy()
+    onp.testing.assert_array_equal(got, a[m])
+
+
+def test_getitem_bool_mask_full():
+    a = _arr(4, 3)
+    m = a > 0
+    got = np.array(a)[np.array(m)].asnumpy()
+    onp.testing.assert_array_equal(got, a[m])
+
+
+# -- setitem -----------------------------------------------------------------
+
+def test_setitem_int():
+    a = _arr(4, 3)
+    x = np.array(a)
+    x[1] = 9.0
+    a[1] = 9.0
+    onp.testing.assert_array_equal(x.asnumpy(), a)
+
+
+def test_setitem_slice():
+    a = _arr(6, 3)
+    x = np.array(a)
+    x[2:4] = 0.0
+    a[2:4] = 0.0
+    onp.testing.assert_array_equal(x.asnumpy(), a)
+
+
+def test_setitem_strided_slice():
+    a = _arr(6, 3)
+    x = np.array(a)
+    x[::2] = -1.0
+    a[::2] = -1.0
+    onp.testing.assert_array_equal(x.asnumpy(), a)
+
+
+def test_setitem_array_value():
+    a = _arr(4, 3)
+    v = _arr(3)
+    x = np.array(a)
+    x[2] = np.array(v)
+    a[2] = v
+    onp.testing.assert_array_equal(x.asnumpy(), a)
+
+
+def test_setitem_broadcast_row():
+    a = _arr(4, 3)
+    v = _arr(1, 3)
+    x = np.array(a)
+    x[1:3] = np.array(v)
+    a[1:3] = v
+    onp.testing.assert_array_equal(x.asnumpy(), a)
+
+
+def test_setitem_int_array():
+    a = _arr(6, 2)
+    x = np.array(a)
+    idx = onp.array([1, 4])
+    x[np.array(idx.astype("int32"))] = 5.0
+    a[idx] = 5.0
+    onp.testing.assert_array_equal(x.asnumpy(), a)
+
+
+def test_setitem_bool_mask():
+    a = _arr(5, 2)
+    m = a > 0
+    x = np.array(a)
+    x[np.array(m)] = 0.0
+    a[m] = 0.0
+    onp.testing.assert_array_equal(x.asnumpy(), a)
+
+
+def test_setitem_bumps_version():
+    x = np.array(_arr(3, 3))
+    v0 = x._version
+    x[0] = 1.0
+    assert x._version > v0
+
+
+# -- take family -------------------------------------------------------------
+
+def test_take_flat():
+    a = _arr(8)
+    idx = onp.array([0, 3, 7, 3])
+    got = np.take(np.array(a), np.array(idx.astype("int32"))).asnumpy()
+    onp.testing.assert_array_equal(got, onp.take(a, idx))
+
+
+def test_take_axis0():
+    a = _arr(5, 3)
+    idx = onp.array([4, 0])
+    got = np.take(np.array(a), np.array(idx.astype("int32")),
+                  axis=0).asnumpy()
+    onp.testing.assert_array_equal(got, onp.take(a, idx, axis=0))
+
+
+def test_take_axis1():
+    a = _arr(3, 6)
+    idx = onp.array([5, 2, 2])
+    got = np.take(np.array(a), np.array(idx.astype("int32")),
+                  axis=1).asnumpy()
+    onp.testing.assert_array_equal(got, onp.take(a, idx, axis=1))
+
+
+def test_take_clip_mode():
+    a = _arr(4)
+    idx = onp.array([0, 10, -10])
+    got = np.take(np.array(a), np.array(idx.astype("int32")),
+                  mode="clip").asnumpy()
+    onp.testing.assert_array_equal(got, onp.take(a, idx, mode="clip"))
+
+
+def test_take_along_axis():
+    a = _arr(4, 5)
+    idx = RNG.randint(0, 5, (4, 2))
+    got = np.take_along_axis(np.array(a), np.array(idx.astype("int64")),
+                             axis=1).asnumpy()
+    onp.testing.assert_array_equal(got, onp.take_along_axis(a, idx, axis=1))
+
+
+def test_take_grad_accumulates_duplicates():
+    a = np.array(_arr(4))
+    a.attach_grad()
+    idx = np.array(onp.array([1, 1, 2], "int32"))
+    with autograd.record():
+        y = np.take(a, idx)
+    y.backward()
+    onp.testing.assert_array_equal(a.grad.asnumpy(), [0.0, 2.0, 1.0, 0.0])
+
+
+def test_put_along_axis():
+    a = _arr(3, 4)
+    idx = onp.array([[1], [0], [3]])
+    x = np.array(a)
+    got = np.put_along_axis(x, np.array(idx.astype("int64")),
+                            np.array(onp.full((3, 1), 9.0, "float32")),
+                            axis=1)
+    ref = a.copy()
+    onp.put_along_axis(ref, idx, 9.0, axis=1)
+    onp.testing.assert_array_equal(x.asnumpy(), ref)
+    del got
+
+
+# -- gather_nd / pick (npx) --------------------------------------------------
+
+def test_gather_nd():
+    a = _arr(4, 5)
+    idx = onp.array([[0, 3], [1, 0]], "int32")   # (2 dims, 2 points)
+    got = npx.gather_nd(np.array(a), np.array(idx)).asnumpy()
+    onp.testing.assert_array_equal(got, a[idx[0], idx[1]])
+
+
+def test_pick():
+    a = _arr(4, 5)
+    idx = onp.array([0, 2, 4, 1], "float32")
+    got = npx.pick(np.array(a), np.array(idx)).asnumpy()
+    ref = a[onp.arange(4), idx.astype("int64")]
+    onp.testing.assert_array_equal(got, ref)
+
+
+def test_one_hot():
+    idx = onp.array([0, 2, 1], "float32")
+    got = npx.one_hot(np.array(idx), 4).asnumpy()
+    onp.testing.assert_array_equal(got, onp.eye(4, dtype="float32")[
+        idx.astype("int64")])
+
+
+# -- where / nonzero / searching ---------------------------------------------
+
+def test_where_three_arg():
+    c = _arr(3, 4) > 0
+    a, b = _arr(3, 4), _arr(3, 4)
+    got = np.where(np.array(c), np.array(a), np.array(b)).asnumpy()
+    onp.testing.assert_array_equal(got, onp.where(c, a, b))
+
+
+def test_nonzero():
+    a = onp.array([[1.0, 0.0], [0.0, 3.0]], "float32")
+    got = np.nonzero(np.array(a))
+    ref = onp.nonzero(a)
+    for g, r in zip(got, ref):
+        onp.testing.assert_array_equal(g.asnumpy(), r)
+
+
+def test_argwhere():
+    a = onp.array([[1.0, 0.0], [0.0, 3.0]], "float32")
+    got = np.argwhere(np.array(a)).asnumpy()
+    onp.testing.assert_array_equal(got, onp.argwhere(a))
+
+
+def test_flatnonzero():
+    a = onp.array([0.0, 2.0, 0.0, 1.0], "float32")
+    got = np.flatnonzero(np.array(a)).asnumpy()
+    onp.testing.assert_array_equal(got, onp.flatnonzero(a))
+
+
+def test_searchsorted():
+    a = onp.array([1.0, 3.0, 5.0, 7.0], "float32")
+    v = onp.array([0.0, 4.0, 9.0], "float32")
+    got = np.searchsorted(np.array(a), np.array(v)).asnumpy()
+    onp.testing.assert_array_equal(got, onp.searchsorted(a, v))
+
+
+def test_argmax_axis():
+    a = _arr(4, 5)
+    for ax in (0, 1, None):
+        got = np.argmax(np.array(a), axis=ax).asnumpy()
+        onp.testing.assert_array_equal(got, onp.argmax(a, axis=ax))
+
+
+def test_argmin_axis():
+    a = _arr(4, 5)
+    for ax in (0, 1, None):
+        got = np.argmin(np.array(a), axis=ax).asnumpy()
+        onp.testing.assert_array_equal(got, onp.argmin(a, axis=ax))
+
+
+def test_argsort_and_sort():
+    a = _arr(3, 6)
+    onp.testing.assert_array_equal(np.argsort(np.array(a)).asnumpy(),
+                                   onp.argsort(a, kind="stable"))
+    onp.testing.assert_allclose(np.sort(np.array(a)).asnumpy(),
+                                onp.sort(a), rtol=0)
+
+
+def test_topk_values():
+    a = _arr(3, 8)
+    got = npx.topk(np.array(a), k=3, ret_typ="value", axis=-1).asnumpy()
+    ref = -onp.sort(-a, axis=-1)[:, :3]
+    onp.testing.assert_allclose(got, ref, rtol=0)
+
+
+def test_unique():
+    a = onp.array([3.0, 1.0, 3.0, 2.0, 1.0], "float32")
+    got = np.unique(np.array(a)).asnumpy()
+    onp.testing.assert_array_equal(got, onp.unique(a))
+
+
+def test_unique_with_counts():
+    a = onp.array([3.0, 1.0, 3.0, 2.0, 1.0], "float32")
+    vals, counts = np.unique(np.array(a), return_counts=True)
+    rv, rc = onp.unique(a, return_counts=True)
+    onp.testing.assert_array_equal(vals.asnumpy(), rv)
+    onp.testing.assert_array_equal(counts.asnumpy(), rc)
+
+
+# -- boolean_mask / masking ops ----------------------------------------------
+
+def test_npx_boolean_mask():
+    a = _arr(5, 3)
+    m = onp.array([1, 0, 1, 0, 1], "float32")
+    got = npx.boolean_mask(np.array(a), np.array(m)).asnumpy()
+    onp.testing.assert_array_equal(got, a[m.astype(bool)])
+
+
+def test_npx_sequence_mask():
+    a = _arr(4, 3)     # (T, N)
+    vl = onp.array([2, 1, 3], "float32")
+    got = npx.sequence_mask(np.array(a), np.array(vl),
+                            use_sequence_length=True).asnumpy()
+    ref = a.copy()
+    for n, l in enumerate(vl.astype(int)):
+        ref[l:, n] = 0
+    onp.testing.assert_array_equal(got, ref)
+
+
+# -- grads through indexing --------------------------------------------------
+
+def test_getitem_slice_grad():
+    a = np.array(_arr(5, 3))
+    a.attach_grad()
+    with autograd.record():
+        y = a[1:4]
+    y.backward()
+    ref = onp.zeros((5, 3), "float32")
+    ref[1:4] = 1.0
+    onp.testing.assert_array_equal(a.grad.asnumpy(), ref)
+
+
+def test_getitem_int_array_grad():
+    a = np.array(_arr(5))
+    a.attach_grad()
+    idx = np.array(onp.array([0, 0, 4], "int32"))
+    with autograd.record():
+        y = a[idx]
+    y.backward()
+    onp.testing.assert_array_equal(a.grad.asnumpy(),
+                                   [2.0, 0.0, 0.0, 0.0, 1.0])
+
+
+def test_where_grad_routes_by_condition():
+    c = np.array(onp.array([True, False], dtype=bool))
+    a = np.array(onp.array([1.0, 2.0], "float32"))
+    b = np.array(onp.array([3.0, 4.0], "float32"))
+    a.attach_grad()
+    b.attach_grad()
+    with autograd.record():
+        y = np.where(c, a, b)
+    y.backward()
+    onp.testing.assert_array_equal(a.grad.asnumpy(), [1.0, 0.0])
+    onp.testing.assert_array_equal(b.grad.asnumpy(), [0.0, 1.0])
+
+
+# -- degenerate shapes -------------------------------------------------------
+
+def test_getitem_empty_slice():
+    a = _arr(4, 3)
+    got = np.array(a)[2:2].asnumpy()
+    assert got.shape == (0, 3)
+
+
+def test_take_empty_indices():
+    a = _arr(4)
+    got = np.take(np.array(a),
+                  np.array(onp.zeros((0,), "int32"))).asnumpy()
+    assert got.shape == (0,)
+
+
+def test_setitem_empty_slice_noop():
+    a = _arr(4, 3)
+    x = np.array(a)
+    x[2:2] = 7.0
+    onp.testing.assert_array_equal(x.asnumpy(), a)
+
+
+def test_index_1elem_array():
+    a = _arr(1, 1)
+    assert float(np.array(a)[0, 0].asnumpy()) == pytest.approx(a[0, 0])

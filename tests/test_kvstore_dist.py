@@ -47,6 +47,22 @@ WORKER = textwrap.dedent("""
     kv.pull("w", out=pulled)
     onp.testing.assert_allclose(pulled.asnumpy(), onp.full(3, 7.0 - 0.3),
                                 rtol=1e-6)
+    # server-profiler command channel (reference:
+    # KVStoreServerProfilerCommand kSetConfig/kState): rank 0 issues
+    # 'server' commands; the next sync point ships them to EVERY process
+    from incubator_mxnet_tpu import profiler
+    if rank == 0:
+        profiler.set_config(filename=f"remote_prof.json",
+                            profile_process="server")
+        profiler.set_state("run", profile_process="server")
+    kv.barrier()                         # command channel rides the sync
+    assert profiler.is_running(), f"rank {rank}: server 'run' not applied"
+    assert profiler._CONFIG["filename"] == "remote_prof.json", rank
+    if rank == 0:
+        profiler.set_state("stop", profile_process="server")
+    kv.barrier()
+    assert not profiler.is_running(), f"rank {rank}: 'stop' not applied"
+
     kv.barrier()
     print(f"worker {rank} ok", flush=True)
 """)

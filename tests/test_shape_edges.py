@@ -1,0 +1,460 @@
+"""Degenerate and boundary shapes through reductions, reshapes, joins and
+broadcasting — the reference's zero-size/one-element corpus
+(`tests/python/unittest/test_numpy_op.py` degenerate-shape sweeps)."""
+import numpy as onp
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import autograd, np
+
+RNG = onp.random.RandomState(13)
+
+
+def _arr(*shape):
+    return RNG.uniform(-2, 2, shape).astype("float32")
+
+
+# -- zero-size arrays --------------------------------------------------------
+
+def test_zeros_size_array_creation():
+    x = np.zeros((0, 3))
+    assert x.shape == (0, 3)
+    assert x.size == 0
+
+
+def test_empty_sum_is_zero():
+    assert float(np.sum(np.zeros((0,))).asnumpy()) == 0.0
+
+
+def test_empty_prod_is_one():
+    assert float(np.prod(np.zeros((0,))).asnumpy()) == 1.0
+
+
+def test_empty_mean_is_nan():
+    out = float(np.mean(np.zeros((0,))).asnumpy())
+    assert onp.isnan(out)
+
+
+def test_empty_concat():
+    a = np.zeros((0, 3))
+    b = np.array(_arr(2, 3))
+    got = np.concatenate([a, b], axis=0).asnumpy()
+    assert got.shape == (2, 3)
+
+
+def test_empty_reshape():
+    x = np.zeros((0, 4)).reshape(0, 2, 2)
+    assert x.shape == (0, 2, 2)
+
+
+def test_empty_transpose():
+    x = np.zeros((0, 4)).T
+    assert x.shape == (4, 0)
+
+
+def test_empty_elementwise():
+    out = np.exp(np.zeros((0, 2)))
+    assert out.shape == (0, 2)
+
+
+def test_empty_matmul():
+    a = np.zeros((0, 4))
+    b = np.array(_arr(4, 3))
+    assert np.matmul(a, b).shape == (0, 3)
+
+
+def test_empty_boolean_mask_result():
+    a = _arr(3)
+    m = onp.zeros(3, bool)
+    got = np.array(a)[np.array(m)].asnumpy()
+    assert got.shape == (0,)
+
+
+# -- reductions over axes incl. empty/keepdims -------------------------------
+
+def _check_reduce(name, shape, axis, keepdims=False, **kw):
+    a = _arr(*shape)
+    fn = getattr(np, name)
+    ref = getattr(onp, name)
+    got = fn(np.array(a), axis=axis, keepdims=keepdims).asnumpy()
+    onp.testing.assert_allclose(got, ref(a, axis=axis, keepdims=keepdims),
+                                rtol=1e-5, atol=1e-6, **kw)
+
+
+def test_sum_axis0():
+    _check_reduce("sum", (4, 5), 0)
+
+
+def test_sum_axis1_keepdims():
+    _check_reduce("sum", (4, 5), 1, keepdims=True)
+
+
+def test_sum_axis_tuple():
+    _check_reduce("sum", (3, 4, 5), (0, 2))
+
+
+def test_sum_axis_none():
+    _check_reduce("sum", (3, 4), None)
+
+
+def test_sum_negative_axis():
+    _check_reduce("sum", (3, 4, 5), -1)
+
+
+def test_mean_axis_tuple_keepdims():
+    _check_reduce("mean", (3, 4, 5), (1, 2), keepdims=True)
+
+
+def test_max_axis():
+    _check_reduce("max", (4, 6), 1)
+
+
+def test_min_axis():
+    _check_reduce("min", (4, 6), 0)
+
+
+def test_prod_axis():
+    _check_reduce("prod", (3, 4), 1)
+
+
+def test_var_axis():
+    _check_reduce("var", (5, 6), 0)
+
+
+def test_std_axis():
+    _check_reduce("std", (5, 6), 1)
+
+
+def test_var_ddof():
+    a = _arr(6, 3)
+    got = np.var(np.array(a), axis=0, ddof=1).asnumpy()
+    onp.testing.assert_allclose(got, onp.var(a, axis=0, ddof=1), rtol=1e-5)
+
+
+def test_cumsum_axis():
+    a = _arr(3, 4)
+    for ax in (0, 1, None):
+        got = np.cumsum(np.array(a), axis=ax).asnumpy()
+        onp.testing.assert_allclose(got, onp.cumsum(a, axis=ax), rtol=1e-5)
+
+
+def test_cumprod_axis():
+    a = _arr(3, 4)
+    got = np.cumprod(np.array(a), axis=1).asnumpy()
+    onp.testing.assert_allclose(got, onp.cumprod(a, axis=1), rtol=1e-5)
+
+
+def test_nansum():
+    a = _arr(3, 4)
+    a[0, 0] = onp.nan
+    got = np.nansum(np.array(a), axis=0).asnumpy()
+    onp.testing.assert_allclose(got, onp.nansum(a, axis=0), rtol=1e-5)
+
+
+def test_nanmean():
+    a = _arr(3, 4)
+    a[1, 2] = onp.nan
+    got = np.nanmean(np.array(a), axis=1).asnumpy()
+    onp.testing.assert_allclose(got, onp.nanmean(a, axis=1), rtol=1e-5)
+
+
+def test_nanmax_nanmin():
+    a = _arr(3, 4)
+    a[2, 1] = onp.nan
+    onp.testing.assert_allclose(np.nanmax(np.array(a), axis=0).asnumpy(),
+                                onp.nanmax(a, axis=0), rtol=1e-6)
+    onp.testing.assert_allclose(np.nanmin(np.array(a), axis=0).asnumpy(),
+                                onp.nanmin(a, axis=0), rtol=1e-6)
+
+
+def test_amax_alias():
+    a = _arr(4, 4)
+    onp.testing.assert_allclose(np.amax(np.array(a)).asnumpy(),
+                                onp.amax(a), rtol=1e-6)
+
+
+def test_ptp():
+    a = _arr(4, 5)
+    got = np.ptp(np.array(a), axis=1).asnumpy()
+    onp.testing.assert_allclose(got, onp.ptp(a, axis=1), rtol=1e-6)
+
+
+def test_median():
+    a = _arr(5, 4)
+    got = np.median(np.array(a), axis=0).asnumpy()
+    onp.testing.assert_allclose(got, onp.median(a, axis=0), rtol=1e-6)
+
+
+def test_quantile():
+    a = _arr(20)
+    got = np.quantile(np.array(a), 0.3).asnumpy()
+    onp.testing.assert_allclose(got, onp.quantile(a, 0.3), rtol=1e-5)
+
+
+def test_percentile():
+    a = _arr(20)
+    got = np.percentile(np.array(a), 75).asnumpy()
+    onp.testing.assert_allclose(got, onp.percentile(a, 75), rtol=1e-5)
+
+
+def test_average_weighted():
+    a = _arr(6)
+    w = onp.abs(_arr(6)) + 0.1
+    got = np.average(np.array(a), weights=np.array(w)).asnumpy()
+    onp.testing.assert_allclose(got, onp.average(a, weights=w), rtol=1e-5)
+
+
+def test_all_any():
+    a = onp.array([[1.0, 0.0], [1.0, 1.0]], "float32")
+    onp.testing.assert_array_equal(np.all(np.array(a), axis=1).asnumpy(),
+                                   onp.all(a, axis=1))
+    onp.testing.assert_array_equal(np.any(np.array(a), axis=0).asnumpy(),
+                                   onp.any(a, axis=0))
+
+
+def test_count_nonzero():
+    a = onp.array([[1.0, 0.0, 2.0], [0.0, 0.0, 3.0]], "float32")
+    got = np.count_nonzero(np.array(a), axis=1).asnumpy()
+    onp.testing.assert_array_equal(got, onp.count_nonzero(a, axis=1))
+
+
+# -- broadcasting edges ------------------------------------------------------
+
+def test_broadcast_scalar_to_matrix():
+    a = _arr(3, 4)
+    got = (np.array(a) + np.array(onp.float32(2.0))).asnumpy()
+    onp.testing.assert_allclose(got, a + 2.0, rtol=1e-6)
+
+
+def test_broadcast_column_row():
+    c = _arr(4, 1)
+    r = _arr(1, 5)
+    got = (np.array(c) * np.array(r)).asnumpy()
+    onp.testing.assert_allclose(got, c * r, rtol=1e-6)
+
+
+def test_broadcast_to():
+    a = _arr(1, 3)
+    got = np.broadcast_to(np.array(a), (4, 3)).asnumpy()
+    onp.testing.assert_array_equal(got, onp.broadcast_to(a, (4, 3)))
+
+
+def test_broadcast_incompatible_raises():
+    with pytest.raises(Exception):
+        (np.array(_arr(3, 2)) + np.array(_arr(3, 4))).asnumpy()
+
+
+def test_broadcast_grad_sums_over_broadcast_axes():
+    a = np.array(_arr(1, 3))
+    a.attach_grad()
+    b = np.array(_arr(4, 3))
+    with autograd.record():
+        y = a + b
+    y.backward()
+    onp.testing.assert_allclose(a.grad.asnumpy(), onp.full((1, 3), 4.0))
+
+
+# -- reshape / transpose edges -----------------------------------------------
+
+def test_reshape_minus_one():
+    a = _arr(4, 6)
+    assert np.array(a).reshape(-1, 3).shape == (8, 3)
+
+
+def test_reshape_to_scalar_like():
+    a = _arr(1, 1)
+    assert np.array(a).reshape(()).shape == ()
+
+
+def test_transpose_axes_perm():
+    a = _arr(2, 3, 4)
+    got = np.transpose(np.array(a), (2, 0, 1)).asnumpy()
+    onp.testing.assert_array_equal(got, onp.transpose(a, (2, 0, 1)))
+
+
+def test_swapaxes():
+    a = _arr(2, 3, 4)
+    got = np.swapaxes(np.array(a), 0, 2).asnumpy()
+    onp.testing.assert_array_equal(got, onp.swapaxes(a, 0, 2))
+
+
+def test_moveaxis():
+    a = _arr(2, 3, 4)
+    got = np.moveaxis(np.array(a), 0, -1).asnumpy()
+    onp.testing.assert_array_equal(got, onp.moveaxis(a, 0, -1))
+
+
+def test_squeeze_all_and_axis():
+    a = _arr(1, 3, 1)
+    assert np.squeeze(np.array(a)).shape == (3,)
+    assert np.squeeze(np.array(a), axis=0).shape == (3, 1)
+
+
+def test_expand_dims():
+    a = _arr(3, 4)
+    assert np.expand_dims(np.array(a), 1).shape == (3, 1, 4)
+    assert np.expand_dims(np.array(a), -1).shape == (3, 4, 1)
+
+
+def test_ravel_flatten():
+    a = _arr(3, 4)
+    onp.testing.assert_array_equal(np.ravel(np.array(a)).asnumpy(),
+                                   a.ravel())
+
+
+def test_flip():
+    a = _arr(3, 4)
+    got = np.flip(np.array(a), axis=1).asnumpy()
+    onp.testing.assert_array_equal(got, onp.flip(a, axis=1))
+
+
+def test_roll():
+    a = _arr(3, 4)
+    got = np.roll(np.array(a), 2, axis=1).asnumpy()
+    onp.testing.assert_array_equal(got, onp.roll(a, 2, axis=1))
+
+
+def test_rot90():
+    a = _arr(3, 4)
+    got = np.rot90(np.array(a)).asnumpy()
+    onp.testing.assert_array_equal(got, onp.rot90(a))
+
+
+def test_atleast_nd():
+    a = _arr(3)
+    assert np.atleast_2d(np.array(a)).shape == (1, 3)
+    assert np.atleast_3d(np.array(a)).shape == (1, 3, 1)
+
+
+# -- join / split edges ------------------------------------------------------
+
+def test_concatenate_axis1():
+    a, b = _arr(2, 3), _arr(2, 2)
+    got = np.concatenate([np.array(a), np.array(b)], axis=1).asnumpy()
+    onp.testing.assert_array_equal(got, onp.concatenate([a, b], axis=1))
+
+
+def test_stack_new_axis():
+    a, b = _arr(2, 3), _arr(2, 3)
+    for ax in (0, 1, 2, -1):
+        got = np.stack([np.array(a), np.array(b)], axis=ax).asnumpy()
+        onp.testing.assert_array_equal(got, onp.stack([a, b], axis=ax))
+
+
+def test_vstack_hstack_dstack():
+    a, b = _arr(2, 3), _arr(2, 3)
+    onp.testing.assert_array_equal(
+        np.vstack([np.array(a), np.array(b)]).asnumpy(), onp.vstack([a, b]))
+    onp.testing.assert_array_equal(
+        np.hstack([np.array(a), np.array(b)]).asnumpy(), onp.hstack([a, b]))
+    onp.testing.assert_array_equal(
+        np.dstack([np.array(a), np.array(b)]).asnumpy(), onp.dstack([a, b]))
+
+
+def test_split_equal():
+    a = _arr(6, 4)
+    got = np.split(np.array(a), 3, axis=0)
+    ref = onp.split(a, 3, axis=0)
+    for g, r in zip(got, ref):
+        onp.testing.assert_array_equal(g.asnumpy(), r)
+
+
+def test_split_by_indices():
+    a = _arr(7, 2)
+    got = np.split(np.array(a), [2, 5], axis=0)
+    ref = onp.split(a, [2, 5], axis=0)
+    for g, r in zip(got, ref):
+        onp.testing.assert_array_equal(g.asnumpy(), r)
+
+
+def test_array_split_uneven():
+    a = _arr(7, 2)
+    got = np.array_split(np.array(a), 3, axis=0)
+    ref = onp.array_split(a, 3, axis=0)
+    for g, r in zip(got, ref):
+        onp.testing.assert_array_equal(g.asnumpy(), r)
+
+
+def test_tile():
+    a = _arr(2, 3)
+    got = np.tile(np.array(a), (2, 2)).asnumpy()
+    onp.testing.assert_array_equal(got, onp.tile(a, (2, 2)))
+
+
+def test_repeat_axis():
+    a = _arr(2, 3)
+    got = np.repeat(np.array(a), 3, axis=1).asnumpy()
+    onp.testing.assert_array_equal(got, onp.repeat(a, 3, axis=1))
+
+
+def test_pad_constant():
+    a = _arr(2, 3)
+    got = np.pad(np.array(a), ((1, 1), (0, 2))).asnumpy()
+    onp.testing.assert_array_equal(got, onp.pad(a, ((1, 1), (0, 2))))
+
+
+def test_pad_edge_reflect():
+    a = _arr(3, 4)
+    for mode in ("edge", "reflect"):
+        got = np.pad(np.array(a), ((1, 1), (1, 1)), mode=mode).asnumpy()
+        onp.testing.assert_array_equal(got, onp.pad(a, ((1, 1), (1, 1)),
+                                                    mode=mode))
+
+
+# -- 1-element / scalar boundary ---------------------------------------------
+
+def test_scalar_array_reductions():
+    x = np.array(onp.float32(3.5))
+    assert float(np.sum(x).asnumpy()) == pytest.approx(3.5)
+    assert float(np.max(x).asnumpy()) == pytest.approx(3.5)
+
+
+def test_item_on_one_element():
+    assert np.array(onp.ones((1, 1), "float32")).item() == 1.0
+
+
+def test_float_conversion_requires_scalar():
+    with pytest.raises(Exception):
+        float(np.array(onp.ones((2,), "float32")))
+
+
+def test_matmul_vector_vector():
+    a, b = _arr(4), _arr(4)
+    got = float(np.matmul(np.array(a), np.array(b)).asnumpy())
+    assert got == pytest.approx(float(a @ b), rel=1e-5)
+
+
+def test_matmul_matrix_vector():
+    a, b = _arr(3, 4), _arr(4)
+    got = np.matmul(np.array(a), np.array(b)).asnumpy()
+    onp.testing.assert_allclose(got, a @ b, rtol=1e-5)
+
+
+def test_sum_grad_broadcasts_ones():
+    a = np.array(_arr(3, 4))
+    a.attach_grad()
+    with autograd.record():
+        y = np.sum(a)
+    y.backward()
+    onp.testing.assert_array_equal(a.grad.asnumpy(), onp.ones((3, 4)))
+
+
+def test_mean_grad_scales():
+    a = np.array(_arr(2, 5))
+    a.attach_grad()
+    with autograd.record():
+        y = np.mean(a)
+    y.backward()
+    onp.testing.assert_allclose(a.grad.asnumpy(), onp.full((2, 5), 0.1),
+                                rtol=1e-6)
+
+
+def test_max_grad_routes_to_argmax():
+    av = onp.array([[1.0, 3.0], [5.0, 2.0]], "float32")
+    a = np.array(av)
+    a.attach_grad()
+    with autograd.record():
+        y = np.max(a, axis=1)
+    y.backward()
+    onp.testing.assert_array_equal(a.grad.asnumpy(),
+                                   [[0.0, 1.0], [1.0, 0.0]])

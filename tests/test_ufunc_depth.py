@@ -1,0 +1,525 @@
+"""Per-ufunc depth: every `mx.np` elementwise op checked against the
+NumPy golden on float32, on bfloat16 (loose tol — verifies the op ACCEPTS
+and preserves bf16, the TPU compute dtype), and through autograd where
+differentiable (reference: the per-op functions of
+`tests/python/unittest/test_numpy_op.py`, the largest reference suite)."""
+import numpy as onp
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import autograd, np
+
+RNG = onp.random.RandomState(7)
+
+
+def _u(lo, hi, shape=(3, 4)):
+    return RNG.uniform(lo, hi, shape).astype("float32")
+
+
+def check_unary(name, ref, lo=-2.0, hi=2.0, grad=True, bf16=True,
+                shape=(3, 4), rtol=1e-5, atol=1e-6):
+    fn = getattr(np, name)
+    xv = _u(lo, hi, shape)
+    x = np.array(xv)
+    got = fn(x)
+    onp.testing.assert_allclose(got.asnumpy(), ref(xv.astype("float64")),
+                                rtol=rtol, atol=atol)
+    if bf16:
+        xb = np.array(xv).astype("bfloat16")
+        gb = fn(xb)
+        assert "bfloat16" in str(gb.dtype), (name, gb.dtype)
+        onp.testing.assert_allclose(
+            gb.astype("float32").asnumpy(), ref(xv.astype("float64")),
+            rtol=0.05, atol=0.05)
+    if grad:
+        xg = np.array(xv)
+        xg.attach_grad()
+        with autograd.record():
+            y = fn(xg)
+        y.backward()
+        eps = 1e-3
+        num = (ref(xv.astype("float64") + eps)
+               - ref(xv.astype("float64") - eps)) / (2 * eps)
+        onp.testing.assert_allclose(xg.grad.asnumpy(), num, rtol=2e-2,
+                                    atol=2e-3)
+
+
+def check_binary(name, ref, lo=-2.0, hi=2.0, lo2=None, hi2=None,
+                 rtol=1e-5, atol=1e-6):
+    fn = getattr(np, name)
+    av = _u(lo, hi)
+    bv = _u(lo if lo2 is None else lo2, hi if hi2 is None else hi2)
+    got = fn(np.array(av), np.array(bv))
+    onp.testing.assert_allclose(
+        got.asnumpy(), ref(av.astype("float64"), bv.astype("float64")),
+        rtol=rtol, atol=atol)
+    # broadcasting: row vector against the matrix
+    got2 = fn(np.array(av), np.array(bv[:1]))
+    onp.testing.assert_allclose(
+        got2.asnumpy(), ref(av.astype("float64"),
+                            bv[:1].astype("float64")),
+        rtol=rtol, atol=atol)
+
+
+# -- unary: algebraic --------------------------------------------------------
+
+def test_negative():
+    check_unary("negative", lambda x: -x)
+
+
+def test_abs():
+    check_unary("abs", onp.abs, grad=False)
+
+
+def test_absolute():
+    check_unary("absolute", onp.abs, grad=False)
+
+
+def test_sign():
+    check_unary("sign", onp.sign, grad=False)
+
+
+def test_square():
+    check_unary("square", onp.square)
+
+
+def test_sqrt():
+    check_unary("sqrt", onp.sqrt, lo=0.05, hi=4.0)
+
+
+def test_cbrt():
+    check_unary("cbrt", onp.cbrt, lo=0.05, hi=4.0)
+
+
+def test_reciprocal():
+    check_unary("reciprocal", onp.reciprocal, lo=0.2, hi=3.0)
+
+
+# -- unary: exponential / log ------------------------------------------------
+
+def test_exp():
+    check_unary("exp", onp.exp)
+
+
+def test_expm1():
+    check_unary("expm1", onp.expm1)
+
+
+def test_exp2():
+    check_unary("exp2", onp.exp2)
+
+
+def test_log():
+    check_unary("log", onp.log, lo=0.05, hi=5.0)
+
+
+def test_log2():
+    check_unary("log2", onp.log2, lo=0.05, hi=5.0)
+
+
+def test_log10():
+    check_unary("log10", onp.log10, lo=0.05, hi=5.0)
+
+
+def test_log1p():
+    check_unary("log1p", onp.log1p, lo=-0.5, hi=5.0)
+
+
+# -- unary: trig -------------------------------------------------------------
+
+def test_sin():
+    check_unary("sin", onp.sin)
+
+
+def test_cos():
+    check_unary("cos", onp.cos)
+
+
+def test_tan():
+    check_unary("tan", onp.tan, lo=-1.0, hi=1.0)
+
+
+def test_arcsin():
+    check_unary("arcsin", onp.arcsin, lo=-0.9, hi=0.9)
+
+
+def test_arccos():
+    check_unary("arccos", onp.arccos, lo=-0.9, hi=0.9)
+
+
+def test_arctan():
+    check_unary("arctan", onp.arctan)
+
+
+def test_degrees():
+    check_unary("degrees", onp.degrees)
+
+
+def test_radians():
+    check_unary("radians", onp.radians)
+
+
+# -- unary: hyperbolic -------------------------------------------------------
+
+def test_sinh():
+    check_unary("sinh", onp.sinh)
+
+
+def test_cosh():
+    check_unary("cosh", onp.cosh)
+
+
+def test_tanh():
+    check_unary("tanh", onp.tanh)
+
+
+def test_arcsinh():
+    check_unary("arcsinh", onp.arcsinh)
+
+
+def test_arccosh():
+    check_unary("arccosh", onp.arccosh, lo=1.1, hi=4.0)
+
+
+def test_arctanh():
+    check_unary("arctanh", onp.arctanh, lo=-0.9, hi=0.9)
+
+
+# -- unary: rounding (not differentiable) ------------------------------------
+
+def test_floor():
+    check_unary("floor", onp.floor, grad=False)
+
+
+def test_ceil():
+    check_unary("ceil", onp.ceil, grad=False)
+
+
+def test_trunc():
+    check_unary("trunc", onp.trunc, grad=False)
+
+
+def test_rint():
+    check_unary("rint", onp.rint, grad=False)
+
+
+def test_round():
+    check_unary("round", onp.round, grad=False)
+
+
+def test_fix():
+    check_unary("fix", onp.fix, grad=False)
+
+
+# -- binary arithmetic -------------------------------------------------------
+
+def test_add():
+    check_binary("add", onp.add)
+
+
+def test_subtract():
+    check_binary("subtract", onp.subtract)
+
+
+def test_multiply():
+    check_binary("multiply", onp.multiply)
+
+
+def test_divide():
+    check_binary("divide", onp.divide, lo2=0.2, hi2=3.0)
+
+
+def test_true_divide():
+    check_binary("true_divide", onp.true_divide, lo2=0.2, hi2=3.0)
+
+
+def test_floor_divide():
+    check_binary("floor_divide", onp.floor_divide, lo2=0.2, hi2=3.0)
+
+
+def test_mod():
+    check_binary("mod", onp.mod, lo2=0.2, hi2=3.0)
+
+
+def test_remainder():
+    check_binary("remainder", onp.remainder, lo2=0.2, hi2=3.0)
+
+
+def test_power():
+    check_binary("power", onp.power, lo=0.2, hi=2.0)
+
+
+def test_maximum():
+    check_binary("maximum", onp.maximum)
+
+
+def test_minimum():
+    check_binary("minimum", onp.minimum)
+
+
+def test_hypot():
+    check_binary("hypot", onp.hypot)
+
+
+def test_arctan2():
+    check_binary("arctan2", onp.arctan2)
+
+
+def test_fmod():
+    check_binary("fmod", onp.fmod, lo2=0.2, hi2=3.0)
+
+
+def test_copysign():
+    check_binary("copysign", onp.copysign)
+
+
+def test_logaddexp():
+    check_binary("logaddexp", onp.logaddexp)
+
+
+# -- comparisons -------------------------------------------------------------
+
+def test_equal():
+    check_binary("equal", onp.equal, rtol=0, atol=0)
+
+
+def test_not_equal():
+    check_binary("not_equal", onp.not_equal, rtol=0, atol=0)
+
+
+def test_greater():
+    check_binary("greater", onp.greater, rtol=0, atol=0)
+
+
+def test_greater_equal():
+    check_binary("greater_equal", onp.greater_equal, rtol=0, atol=0)
+
+
+def test_less():
+    check_binary("less", onp.less, rtol=0, atol=0)
+
+
+def test_less_equal():
+    check_binary("less_equal", onp.less_equal, rtol=0, atol=0)
+
+
+# -- logical -----------------------------------------------------------------
+
+def test_logical_and():
+    a = onp.array([[0.0, 1.0], [2.0, 0.0]], "float32")
+    b = onp.array([[1.0, 0.0], [3.0, 0.0]], "float32")
+    got = np.logical_and(np.array(a), np.array(b)).asnumpy()
+    onp.testing.assert_array_equal(got, onp.logical_and(a, b))
+
+
+def test_logical_or():
+    a = onp.array([[0.0, 1.0], [2.0, 0.0]], "float32")
+    b = onp.array([[1.0, 0.0], [3.0, 0.0]], "float32")
+    got = np.logical_or(np.array(a), np.array(b)).asnumpy()
+    onp.testing.assert_array_equal(got, onp.logical_or(a, b))
+
+
+def test_logical_xor():
+    a = onp.array([[0.0, 1.0], [2.0, 0.0]], "float32")
+    b = onp.array([[1.0, 0.0], [3.0, 0.0]], "float32")
+    got = np.logical_xor(np.array(a), np.array(b)).asnumpy()
+    onp.testing.assert_array_equal(got, onp.logical_xor(a, b))
+
+
+def test_logical_not():
+    a = onp.array([[0.0, 1.0], [2.0, 0.0]], "float32")
+    got = np.logical_not(np.array(a)).asnumpy()
+    onp.testing.assert_array_equal(got, onp.logical_not(a))
+
+
+# -- float inspection --------------------------------------------------------
+
+def test_isnan():
+    a = onp.array([1.0, onp.nan, onp.inf], "float32")
+    onp.testing.assert_array_equal(np.isnan(np.array(a)).asnumpy(),
+                                   onp.isnan(a))
+
+
+def test_isinf():
+    a = onp.array([1.0, onp.nan, onp.inf, -onp.inf], "float32")
+    onp.testing.assert_array_equal(np.isinf(np.array(a)).asnumpy(),
+                                   onp.isinf(a))
+
+
+def test_isfinite():
+    a = onp.array([1.0, onp.nan, onp.inf], "float32")
+    onp.testing.assert_array_equal(np.isfinite(np.array(a)).asnumpy(),
+                                   onp.isfinite(a))
+
+
+def test_isposinf():
+    a = onp.array([1.0, onp.inf, -onp.inf], "float32")
+    onp.testing.assert_array_equal(np.isposinf(np.array(a)).asnumpy(),
+                                   onp.isposinf(a))
+
+
+def test_isneginf():
+    a = onp.array([1.0, onp.inf, -onp.inf], "float32")
+    onp.testing.assert_array_equal(np.isneginf(np.array(a)).asnumpy(),
+                                   onp.isneginf(a))
+
+
+# -- scalar mixing / dtype promotion -----------------------------------------
+
+def test_scalar_add_keeps_dtype():
+    x = np.array(onp.ones((2, 2), "float32"))
+    assert str((x + 1).dtype) == "float32"
+    xb = x.astype("bfloat16")
+    assert "bfloat16" in str((xb + 1).dtype)
+
+
+def test_scalar_radd_rsub_rmul():
+    xv = _u(-2, 2)
+    x = np.array(xv)
+    onp.testing.assert_allclose((1.0 + x).asnumpy(), 1.0 + xv, rtol=1e-6)
+    onp.testing.assert_allclose((1.0 - x).asnumpy(), 1.0 - xv, rtol=1e-6)
+    onp.testing.assert_allclose((2.0 * x).asnumpy(), 2.0 * xv, rtol=1e-6)
+
+
+def test_scalar_rdiv_rpow():
+    xv = _u(0.5, 2.0)
+    x = np.array(xv)
+    onp.testing.assert_allclose((1.0 / x).asnumpy(), 1.0 / xv, rtol=1e-6)
+    onp.testing.assert_allclose((2.0 ** x).asnumpy(), 2.0 ** xv, rtol=1e-5)
+
+
+def test_int_float_promotion():
+    a = np.array(onp.arange(4, dtype="int32"))
+    b = np.array(onp.ones(4, "float32"))
+    assert "float" in str((a + b).dtype)
+
+
+def test_bf16_f32_promotion():
+    a = np.array(onp.ones((2, 2), "float32")).astype("bfloat16")
+    b = np.array(onp.ones((2, 2), "float32"))
+    out = a + b
+    assert str(out.dtype) == "float32"
+
+
+# -- binary grads ------------------------------------------------------------
+
+def _binary_grad(name, ref_da, ref_db, lo=0.5, hi=2.0):
+    fn = getattr(np, name)
+    av, bv = _u(lo, hi), _u(lo, hi)
+    a, b = np.array(av), np.array(bv)
+    a.attach_grad()
+    b.attach_grad()
+    with autograd.record():
+        y = fn(a, b)
+    y.backward()
+    onp.testing.assert_allclose(a.grad.asnumpy(), ref_da(av, bv),
+                                rtol=1e-4, atol=1e-5)
+    onp.testing.assert_allclose(b.grad.asnumpy(), ref_db(av, bv),
+                                rtol=1e-4, atol=1e-5)
+
+
+def test_add_grad():
+    _binary_grad("add", lambda a, b: onp.ones_like(a),
+                 lambda a, b: onp.ones_like(b))
+
+
+def test_subtract_grad():
+    _binary_grad("subtract", lambda a, b: onp.ones_like(a),
+                 lambda a, b: -onp.ones_like(b))
+
+
+def test_multiply_grad():
+    _binary_grad("multiply", lambda a, b: b, lambda a, b: a)
+
+
+def test_divide_grad():
+    _binary_grad("divide", lambda a, b: 1.0 / b, lambda a, b: -a / b ** 2)
+
+
+def test_power_grad():
+    _binary_grad("power", lambda a, b: b * a ** (b - 1),
+                 lambda a, b: a ** b * onp.log(a))
+
+
+def test_maximum_grad_routes_to_winner():
+    av = onp.array([[1.0, 5.0]], "float32")
+    bv = onp.array([[3.0, 2.0]], "float32")
+    a, b = np.array(av), np.array(bv)
+    a.attach_grad()
+    b.attach_grad()
+    with autograd.record():
+        y = np.maximum(a, b)
+    y.backward()
+    onp.testing.assert_array_equal(a.grad.asnumpy(), [[0.0, 1.0]])
+    onp.testing.assert_array_equal(b.grad.asnumpy(), [[1.0, 0.0]])
+
+
+# -- special values ----------------------------------------------------------
+
+def test_log_of_zero_is_neg_inf():
+    out = np.log(np.array(onp.zeros(2, "float32"))).asnumpy()
+    assert onp.all(onp.isneginf(out))
+
+
+def test_sqrt_of_negative_is_nan():
+    out = np.sqrt(np.array(onp.full(2, -1.0, "float32"))).asnumpy()
+    assert onp.all(onp.isnan(out))
+
+
+def test_divide_by_zero_is_inf():
+    out = np.divide(np.array(onp.ones(2, "float32")),
+                    np.array(onp.zeros(2, "float32"))).asnumpy()
+    assert onp.all(onp.isinf(out))
+
+
+def test_zero_over_zero_is_nan():
+    out = np.divide(np.array(onp.zeros(2, "float32")),
+                    np.array(onp.zeros(2, "float32"))).asnumpy()
+    assert onp.all(onp.isnan(out))
+
+
+def test_exp_overflow_to_inf():
+    out = np.exp(np.array(onp.full(2, 1e4, "float32"))).asnumpy()
+    assert onp.all(onp.isinf(out))
+
+
+def test_expit_like_sigmoid_saturates():
+    from incubator_mxnet_tpu import npx
+
+    out = npx.sigmoid(np.array(onp.array([-100.0, 100.0], "float32")))
+    onp.testing.assert_allclose(out.asnumpy(), [0.0, 1.0], atol=1e-6)
+
+
+# -- clip / interp-style -----------------------------------------------------
+
+def test_clip():
+    xv = _u(-3, 3)
+    got = np.clip(np.array(xv), -1.0, 1.0).asnumpy()
+    onp.testing.assert_allclose(got, onp.clip(xv, -1.0, 1.0))
+
+
+def test_clip_grad_zero_outside():
+    xv = onp.array([-2.0, 0.5, 2.0], "float32")
+    x = np.array(xv)
+    x.attach_grad()
+    with autograd.record():
+        y = np.clip(x, -1.0, 1.0)
+    y.backward()
+    onp.testing.assert_array_equal(x.grad.asnumpy(), [0.0, 1.0, 0.0])
+
+
+def test_fabs():
+    check_unary("fabs", onp.fabs, grad=False)
+
+
+def test_heaviside():
+    a = onp.array([-1.0, 0.0, 2.0], "float32")
+    got = np.heaviside(np.array(a), np.array(
+        onp.full(3, 0.5, "float32"))).asnumpy()
+    onp.testing.assert_allclose(got, onp.heaviside(a, 0.5))
+
+
+def test_nan_to_num():
+    a = onp.array([onp.nan, onp.inf, -onp.inf, 1.0], "float32")
+    got = np.nan_to_num(np.array(a)).asnumpy()
+    onp.testing.assert_allclose(got, onp.nan_to_num(a))

@@ -21,6 +21,12 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
+def _onp():
+    import numpy
+
+    return numpy
+
+
 def _ops_registry():
     from incubator_mxnet_tpu import np, npx
 
@@ -98,11 +104,19 @@ def benchmark_op(name, fn, args, warmup=5, runs=50, with_backward=True):
                 out.backward()
             _true_sync(args[0].grad)
             total_ms = (time.perf_counter() - t0) / runs * 1e3
-            bwd_ms = max(total_ms - fwd_ms, 0.0)
+            # derived bwd = total - fwd; dispatch noise can make the
+            # subtraction non-positive — report the MEASURED total and
+            # leave bwd null instead of publishing a fake 0.0 cell
+            bwd_ms = total_ms - fwd_ms if total_ms > fwd_ms else None
         except Exception:  # op has no grad path
+            total_ms = None
             bwd_ms = None
+    else:
+        total_ms = None
     return {"op": name, "avg_fwd_ms": round(fwd_ms, 4),
-            "avg_bwd_ms": round(bwd_ms, 4) if bwd_ms is not None else None}
+            "avg_bwd_ms": round(bwd_ms, 4) if bwd_ms is not None else None,
+            "avg_fwdbwd_ms": round(total_ms, 4)
+            if total_ms is not None else None}
 
 
 def benchmark_op_compiled(name, fn, args, warmup=3, runs=30):
@@ -198,6 +212,24 @@ def anchor_configs():
                                             num_filter=64),
             lambda: (u(32, 3, 224, 224), u(64, 3, 3, 3), u(64))),
         "sum_1024x1024": (lambda x: x.sum(), lambda: (u(1024, 1024),)),
+        # anchors the model corpus actually leans on (round-4 additions)
+        "pooling_max_32x64x56x56_k2s2": (
+            lambda x: npx.pooling(x, kernel=(2, 2), stride=(2, 2),
+                                  pool_type="max"),
+            lambda: (u(32, 64, 56, 56),)),
+        "layer_norm_8192x768": (
+            lambda x, g, b: npx.layer_norm(x, g, b),
+            lambda: (u(8192, 768), np.ones((768,)), np.zeros((768,)))),
+        "embedding_8192_30522x768": (
+            lambda idx, w: npx.embedding(idx, w, input_dim=30522,
+                                         output_dim=768),
+            lambda: (np.array(_onp().random.RandomState(0)
+                              .randint(0, 30522, (64, 128))
+                              .astype("float32")), u(30522, 768))),
+        "flash_attention_8x12x128x64": (
+            lambda q, k, v: npx.flash_attention(q, k, v),
+            lambda: (u(8, 12, 128, 64), u(8, 12, 128, 64),
+                     u(8, 12, 128, 64))),
     }
 
 
