@@ -54,10 +54,14 @@ class Block:
 
     # -- params -------------------------------------------------------------
     def collect_params(self, select=None) -> dict:
-        """name → Parameter for self and descendants (reference: block.py:340)."""
+        """name → Parameter for self and descendants (reference: block.py:340).
+        Returns a ParameterDict (dict subclass) so bulk helpers like
+        zero_grad()/setattr() work on the result."""
         import re
 
-        out = {}
+        from .parameter import ParameterDict
+
+        out = ParameterDict()
 
         def walk(block, prefix):
             for n, p in block._reg_params.items():
@@ -68,8 +72,29 @@ class Block:
         walk(self, "")
         if select is not None:
             pat = re.compile(select)
-            out = {k: v for k, v in out.items() if pat.match(k)}
+            return ParameterDict({k: v for k, v in out.items()
+                                  if pat.match(k)})
         return out
+
+    def share_parameters(self, shared):
+        """Rebind this block's parameters to `shared` (the dict another
+        block's collect_params() returned), matching by structured name —
+        tied-weight blocks after the fact (reference: block.py
+        share_parameters). Missing names keep their own parameters."""
+        if shared is None:
+            return self
+
+        def walk(block, prefix):
+            for n in list(block._reg_params):
+                full = prefix + n
+                if full in shared:
+                    block._reg_params[n] = shared[full]
+                    setattr(block, n, shared[full])
+            for n, c in block._children.items():
+                walk(c, f"{prefix}{n}.")
+
+        walk(self, "")
+        return self
 
     @property
     def params(self):

@@ -39,12 +39,38 @@ class Parameter:
         self.init = init
         self.lr_mult = lr_mult
         self.wd_mult = wd_mult
-        self.grad_req = grad_req if differentiable else "null"
+        self._grad_req = grad_req if differentiable else "null"
         self._stype = stype
         self._grad_stype = grad_stype
         self._allow_deferred_init = allow_deferred_init
         self._data: NDArray | None = None
         self._deferred_init = None  # (initializer, device)
+
+    @property
+    def grad_req(self):
+        return self._grad_req
+
+    @grad_req.setter
+    def grad_req(self, req):
+        """Changing grad_req after init takes effect immediately
+        (reference: parameter.py grad_req setter re-allocates grads):
+        'null' detaches the live gradient buffer; write/add re-attach."""
+        if req not in ("write", "add", "null"):
+            raise ValueError(f"invalid grad_req {req!r}")
+        self._grad_req = req
+        if self._data is not None:
+            if req == "null":
+                self._data._grad = None
+                self._data._grad_req = "write"
+            elif self._data._grad is None:
+                self._data.attach_grad(req,
+                                       stype=self._grad_stype
+                                       if self._grad_stype != "default"
+                                       else None)
+            else:
+                # existing buffer: switch its accumulation mode in place
+                # (write<->add), keeping the allocated gradient
+                self._data._grad_req = req
 
     # -- identity -----------------------------------------------------------
     @property
@@ -208,3 +234,23 @@ class Constant(Parameter):
                          init=init_mod.Constant(value),
                          grad_req="null", name=name)
         self._data = value
+
+
+class ParameterDict(dict):
+    """dict of name → Parameter with the reference ParameterDict's bulk
+    helpers (reference: `python/mxnet/gluon/parameter.py` ParameterDict —
+    collect_params() returns this so `net.collect_params().zero_grad()`
+    and friends keep working)."""
+
+    def zero_grad(self):
+        for p in self.values():
+            p.zero_grad()
+
+    def setattr(self, name, value):
+        for p in self.values():
+            setattr(p, name, value)
+
+    def reset_ctx(self, ctx):  # noqa: ARG002 - single logical device
+        return None
+
+    reset_device = reset_ctx

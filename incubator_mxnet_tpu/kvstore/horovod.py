@@ -65,6 +65,22 @@ class Horovod(KVStoreDevice):
                 arr = NDArray(self._dist.broadcast(arr._data, root=0))
             self._store[k] = arr.copy()
 
+    def broadcast(self, key, value, out=None, priority=0):  # noqa: ARG002
+        """init (rank 0's tensor wins) + write into `out` directly — the
+        base class routes through pull(), which this store forbids."""
+        self.init(key, value)
+        if out is None:
+            return
+        keys = key if isinstance(key, (list, tuple)) else [key]
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        if not isinstance(key, (list, tuple)):
+            outs = [out]
+        for k, o in zip(keys, outs):
+            v = self._store[k]
+            for t in (o if isinstance(o, (list, tuple)) else [o]):
+                if t is not None:
+                    t._set_data(v._data)
+
     def pull(self, key, out=None, priority=0, ignore_sparse=True):
         # parity: the reference's Horovod store forbids pull (allreduce
         # has no server-held value to read back); use pushpull/broadcast

@@ -89,6 +89,46 @@ def add_n(*args):
     return out
 
 
+def sample_multinomial(data, shape=None, get_prob=False, dtype="int32"):
+    """Draw category indices from probability row(s) (reference:
+    `mx.nd.sample_multinomial`, `src/operator/random/sample_multinomial_op.cc`).
+    `data`: (k,) or (batch, k) probabilities; `shape`: number (or tuple)
+    of draws per row."""
+    import jax.numpy as jnp
+
+    from ..random import next_key
+
+    pv = data._data if isinstance(data, NDArray) else jnp.asarray(data)
+    n = () if shape is None else (
+        (shape,) if isinstance(shape, int) else tuple(shape))
+    logits = jnp.log(jnp.maximum(pv, 1e-38))
+    import jax.random as jr
+
+    draws = jr.categorical(next_key(), logits, axis=-1,
+                           shape=n + pv.shape[:-1])
+    # jax puts the draw axes FIRST; pick log-probs in that layout (the
+    # batch logits broadcast across the leading draw axes), THEN move the
+    # draw axes last per the reference's output convention
+    if get_prob:
+        b_logits = jnp.broadcast_to(logits, n + logits.shape) \
+            if pv.ndim > 1 else logits
+        if pv.ndim > 1:
+            picked = jnp.take_along_axis(b_logits, draws[..., None],
+                                         axis=-1)[..., 0]
+        else:
+            picked = b_logits[draws]
+    if n and pv.ndim > 1:
+        draws = jnp.moveaxis(draws, tuple(range(len(n))),
+                             tuple(range(-len(n), 0)))
+        if get_prob:
+            picked = jnp.moveaxis(picked, tuple(range(len(n))),
+                                  tuple(range(-len(n), 0)))
+    out = NDArray(draws.astype(dtype))
+    if get_prob:
+        return out, NDArray(picked)
+    return out
+
+
 def Flatten(data):  # noqa: N802
     """Collapse all non-batch dims (reference `Flatten` semantics: output
     is 2-D (batch, -1), NOT fully raveled)."""

@@ -641,11 +641,16 @@ def _needs_static_big_index(key, shape):
     keys = key if isinstance(key, tuple) else (key,)
     any_big = False
     for i, k in enumerate(keys):
+        dim = shape[i] if i < len(shape) else 0
         if isinstance(k, int):
-            dim = shape[i] if i < len(shape) else 0
             if abs(k) > _INT32_SAFE or (k < 0 and dim > _INT32_SAFE):
                 any_big = True
         elif isinstance(k, slice):
+            # ANY slice on a >int32 axis must take the static path —
+            # x[-5:] resolves to a start past 2^31 even though the
+            # written bound is small
+            if dim > _INT32_SAFE:
+                any_big = True
             for b in (k.start, k.stop):
                 if b is not None and abs(b) > _INT32_SAFE:
                     any_big = True

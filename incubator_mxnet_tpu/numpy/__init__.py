@@ -288,20 +288,34 @@ def fill_diagonal(a, val, wrap=False):
 def put_along_axis(arr, indices, values, axis):
     """In-place scatter along `axis` (reference: `_npi` put_along_axis,
     numpy semantics: mutates `arr`, returns None). Same NDArray rebind
-    discipline as `fill_diagonal`."""
+    discipline as `fill_diagonal`. Axes past the int32 range route
+    through an x64 scope like `argmax` (int32 indices would wrap)."""
+    big = arr.shape[axis if axis >= 0 else axis + arr.ndim] - 1 > 2**31 - 1
+    idx_dt = "int64" if big else "int32"
     src = arr._snapshot()
     args = [src, indices]
     if isinstance(values, NDArray):
         args.append(values)
 
         def f(x, idx, v):
-            return _jnp().put_along_axis(x, idx.astype("int32"), v, axis,
+            return _jnp().put_along_axis(x, idx.astype(idx_dt), v, axis,
                                          inplace=False)
     else:
         def f(x, idx):
-            return _jnp().put_along_axis(x, idx.astype("int32"), values,
+            return _jnp().put_along_axis(x, idx.astype(idx_dt), values,
                                          axis, inplace=False)
-    out = apply_op_flat("put_along_axis", f, tuple(args))
+    if big:
+        import contextlib
+
+        import jax
+
+        cm = jax.enable_x64(True)
+    else:
+        import contextlib
+
+        cm = contextlib.nullcontext()
+    with cm:
+        out = apply_op_flat("put_along_axis", f, tuple(args))
     arr._adopt(out)
     return None
 

@@ -40,18 +40,22 @@ def _val(x):
     return x._data if isinstance(x, NDArray) else x
 
 
-def uniform(low=0.0, high=1.0, size=None, dtype=None, device=None, ctx=None):  # noqa: ARG001
+def uniform(low=0.0, high=1.0, size=None, dtype=None, device=None,
+            ctx=None, shape=None):  # noqa: ARG001
     import jax.numpy as jnp
 
+    size = size if size is not None else shape  # legacy mx.nd kwarg
     dt = np_dtype(dtype) if dtype else jnp.float32
     u = _jr().uniform(next_key(), _shape(size) or jnp.broadcast_shapes(
         jnp.shape(_val(low)), jnp.shape(_val(high))), dtype=dt)
     return NDArray(u * (_val(high) - _val(low)) + _val(low))
 
 
-def normal(loc=0.0, scale=1.0, size=None, dtype=None, device=None, ctx=None):  # noqa: ARG001
+def normal(loc=0.0, scale=1.0, size=None, dtype=None, device=None,
+           ctx=None, shape=None):  # noqa: ARG001
     import jax.numpy as jnp
 
+    size = size if size is not None else shape  # legacy mx.nd kwarg
     dt = np_dtype(dtype) if dtype else jnp.float32
     n = _jr().normal(next_key(), _shape(size) or jnp.broadcast_shapes(
         jnp.shape(_val(loc)), jnp.shape(_val(scale))), dtype=dt)
@@ -148,7 +152,10 @@ def lognormal(mean=0.0, sigma=1.0, size=None):
 
 
 def pareto(a, size=None):
-    return NDArray(_jr().pareto(next_key(), _val(a), shape=_shape(size) or None))
+    # numpy's pareto is the LOMAX (Pareto II, support [0, inf)): classical
+    # Pareto with x_m=1 shifted by -1. jax.random.pareto is classical.
+    return NDArray(_jr().pareto(next_key(), _val(a),
+                                shape=_shape(size) or None) - 1.0)
 
 
 def power(a, size=None):
@@ -159,7 +166,9 @@ def power(a, size=None):
 
 
 def rayleigh(scale=1.0, size=None):
-    return NDArray(_jr().rayleigh(next_key(), _shape(size)) * _val(scale))
+    # jax.random.rayleigh's second positional is SCALE, not shape
+    return NDArray(_jr().rayleigh(next_key(), 1.0, shape=_shape(size))
+                   * _val(scale))
 
 
 def weibull(a, size=None):

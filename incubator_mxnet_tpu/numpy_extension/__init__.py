@@ -58,10 +58,27 @@ def __getattr__(name):
         from ..operator import Custom
 
         return Custom
-    if name == "image":  # reference: numpy_extension/image.py re-exports
-        from .. import image
+    if name == "image":
+        # npx.image = the op namespace (to_tensor/normalize/resize/...,
+        # reference `src/operator/image/`) PLUS the imperative augmenter
+        # classes re-exported for back-compat (`mx.image`)
+        import importlib
+        import types
 
-        return image
+        from .. import image as _imperative
+
+        # importlib (not `from . import image`): the relative import form
+        # re-enters this __getattr__ and recurses
+        _ops = importlib.import_module(
+            "incubator_mxnet_tpu.numpy_extension.image")
+
+        mod = types.ModuleType("incubator_mxnet_tpu.npx.image")
+        for src in (_imperative, _ops):
+            for n in dir(src):
+                if not n.startswith("_"):
+                    setattr(mod, n, getattr(src, n))
+        globals()["image"] = mod          # cache: resolve once
+        return mod
     raise AttributeError(f"module 'npx' has no attribute {name!r}")
 
 
@@ -182,6 +199,46 @@ def softmax(data, axis=-1, length=None, temperature=None, use_length=False,
     return apply_op("softmax", f,
                     (data, ln) if ln is not None else (data, None),
                     static_info={"axis": axis})
+
+
+def softmin(data, axis=-1, temperature=None, dtype=None, **kwargs):  # noqa: ARG001
+    """softmax of the negated input (reference: `src/operator/nn/softmax.cc`
+    softmin registration)."""
+    import jax
+
+    def f(x):
+        if temperature is not None and temperature != 1.0:
+            x = x / temperature
+        out = jax.nn.softmax(-x, axis=axis)
+        return out.astype(np_dtype(dtype)) if dtype else out
+
+    return apply_op("softmin", f, (data,))
+
+
+def reshape_like(lhs, rhs, lhs_begin=None, lhs_end=None, rhs_begin=None,
+                 rhs_end=None, **kwargs):  # noqa: ARG001
+    """Reshape lhs to rhs's shape (reference:
+    `src/operator/tensor/elemwise_unary_op_basic.cc` reshape_like).
+    The range form replaces lhs.shape[lhs_begin:lhs_end] with
+    rhs.shape[rhs_begin:rhs_end] (reference ReshapeLikeParam)."""
+    lshape = tuple((lhs._data if hasattr(lhs, "_data") else lhs).shape)
+    rshape = tuple((rhs._data if hasattr(rhs, "_data") else rhs).shape)
+    lb = 0 if lhs_begin is None else lhs_begin
+    le = len(lshape) if lhs_end is None else lhs_end
+    rb = 0 if rhs_begin is None else rhs_begin
+    re_ = len(rshape) if rhs_end is None else rhs_end
+    lb += len(lshape) if lb < 0 else 0
+    le += len(lshape) if le < 0 else 0
+    rb += len(rshape) if rb < 0 else 0
+    re_ += len(rshape) if re_ < 0 else 0
+    shape = lshape[:lb] + rshape[rb:re_] + lshape[le:]
+    import math
+
+    if math.prod(shape) != math.prod(lshape):
+        raise ValueError(
+            f"reshape_like: target shape {shape} has "
+            f"{math.prod(shape)} elements, lhs has {math.prod(lshape)}")
+    return apply_op("reshape_like", lambda x: x.reshape(shape), (lhs,))
 
 
 def log_softmax(data, axis=-1, temperature=None, dtype=None, **kwargs):  # noqa: ARG001

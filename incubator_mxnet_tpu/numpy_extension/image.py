@@ -1,0 +1,130 @@
+"""`npx.image` op namespace (reference: `src/operator/image/` registered
+image ops — to_tensor/normalize/resize/crop/flips — the ops gluon's
+vision transforms call, `python/mxnet/gluon/data/vision/transforms/
+image.py:86,140,314`).
+
+TPU-native: thin autograd-aware jnp bodies through the funnel; resize
+uses `jax.image.resize` (bilinear) instead of OpenCV so it is jit-safe
+and differentiable. The imperative augmenter classes stay in
+`incubator_mxnet_tpu.image` and remain re-exported for back-compat."""
+from __future__ import annotations
+
+from ..ndarray.ndarray import NDArray, apply_op
+
+__all__ = ["to_tensor", "normalize", "resize", "crop", "flip_left_right",
+           "flip_top_bottom", "random_flip_left_right",
+           "random_flip_top_bottom", "random_crop", "random_resized_crop"]
+
+
+def _jnp():
+    import jax.numpy as jnp
+
+    return jnp
+
+
+def to_tensor(data):
+    """(H, W, C) [or (N, H, W, C)] uint8 → (C, H, W) float32 in [0, 1]."""
+    jnp = _jnp()
+
+    def f(x):
+        y = x.astype(jnp.float32) / 255.0
+        axes = (2, 0, 1) if y.ndim == 3 else (0, 3, 1, 2)
+        return jnp.transpose(y, axes)
+
+    return apply_op("image_to_tensor", f, (data,))
+
+
+def normalize(data, mean=0.0, std=1.0):
+    """Channel-wise (x - mean) / std on (C, H, W) [or (N, C, H, W)]."""
+    jnp = _jnp()
+
+    def f(x):
+        nch = x.shape[0] if x.ndim == 3 else x.shape[1]
+        m = jnp.broadcast_to(jnp.asarray(mean, jnp.float32), (nch,))
+        s = jnp.broadcast_to(jnp.asarray(std, jnp.float32), (nch,))
+        shape = (-1, 1, 1) if x.ndim == 3 else (1, -1, 1, 1)
+        return (x - m.reshape(shape)) / s.reshape(shape)
+
+    return apply_op("image_normalize", f, (data,))
+
+
+def resize(data, size, keep_ratio=False, interp=1):  # noqa: ARG001
+    """Resize (H, W, C) to `size` — int (short edge when keep_ratio, else
+    square) or (w, h) tuple (the reference's cv2 convention)."""
+    import jax
+
+    jnp = _jnp()
+    h, w = int(data.shape[0]), int(data.shape[1])
+    if isinstance(size, int):
+        if keep_ratio:
+            if h < w:
+                new_h, new_w = size, max(1, round(w * size / h))
+            else:
+                new_h, new_w = max(1, round(h * size / w)), size
+        else:
+            new_h = new_w = size
+    else:
+        new_w, new_h = int(size[0]), int(size[1])
+
+    def f(x):
+        y = jax.image.resize(x.astype(jnp.float32),
+                             (new_h, new_w) + tuple(x.shape[2:]),
+                             method="bilinear")
+        return jnp.clip(jnp.rint(y), 0, 255).astype(x.dtype) \
+            if jnp.issubdtype(x.dtype, jnp.integer) else y.astype(x.dtype)
+
+    return apply_op("image_resize", f, (data,))
+
+
+def crop(data, x, y, width, height):
+    """Fixed crop at (x, y) of size (width, height) — (H, W, C) layout."""
+    def f(im):
+        return im[y:y + height, x:x + width]
+
+    return apply_op("image_crop", f, (data,))
+
+
+def flip_left_right(data):
+    return apply_op("image_flip_lr", lambda x: x[:, ::-1], (data,))
+
+
+def flip_top_bottom(data):
+    return apply_op("image_flip_tb", lambda x: x[::-1], (data,))
+
+
+def random_flip_left_right(data, p=0.5):
+    import numpy as onp
+
+    from .. import random as mxrandom
+
+    del mxrandom  # host-side coin matches the reference's eager augmenters
+    return flip_left_right(data) if onp.random.uniform() < p else \
+        (data if isinstance(data, NDArray) else NDArray(data))
+
+
+def random_flip_top_bottom(data, p=0.5):
+    import numpy as onp
+
+    return flip_top_bottom(data) if onp.random.uniform() < p else \
+        (data if isinstance(data, NDArray) else NDArray(data))
+
+
+def random_crop(data, xrange=(0.0, 1.0), yrange=(0.0, 1.0), width=1,
+                height=1, **kwargs):  # noqa: ARG001
+    """Random (width, height) crop; returns (cropped, (x0, y0, w, h)) like
+    the imperative helper."""
+    from ..image import random_crop as _rc
+
+    return _rc(data if isinstance(data, NDArray) else NDArray(data),
+               (width, height))
+
+
+def random_resized_crop(data, size, area=(0.08, 1.0),
+                        ratio=(3 / 4, 4 / 3), **kwargs):  # noqa: ARG001
+    from ..image import random_size_crop as _rsc
+
+    if isinstance(size, int):
+        size = (size, size)
+    out, _ = _rsc(data if isinstance(data, NDArray) else NDArray(data),
+                  size, area, ratio)
+    return out

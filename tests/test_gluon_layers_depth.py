@@ -1,0 +1,373 @@
+"""Per-layer gluon depth: output shapes/values against hand math, train
+vs eval behavior, parameter shapes after deferred init, grads flow
+(reference: `tests/python/unittest/test_gluon.py` per-layer blocks)."""
+import numpy as onp
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import autograd, gluon, np
+from incubator_mxnet_tpu.gluon import nn
+
+RNG = onp.random.RandomState(23)
+
+
+def _x(*shape):
+    return np.array(RNG.uniform(-1, 1, shape).astype("float32"))
+
+
+def _init(layer, x):
+    layer.initialize()
+    out = layer(x)
+    return out
+
+
+# -- Dense -------------------------------------------------------------------
+
+def test_dense_shapes_flatten_true():
+    l = nn.Dense(7)
+    out = _init(l, _x(4, 3, 5))
+    assert out.shape == (4, 7)
+    assert l.weight.shape == (7, 15)
+
+
+def test_dense_shapes_flatten_false():
+    l = nn.Dense(7, flatten=False)
+    out = _init(l, _x(4, 3, 5))
+    assert out.shape == (4, 3, 7)
+    assert l.weight.shape == (7, 5)
+
+
+def test_dense_no_bias():
+    l = nn.Dense(3, use_bias=False, in_units=4)
+    l.initialize()
+    assert l.bias is None
+    x = _x(2, 4)
+    ref = x.asnumpy() @ l.weight.data().asnumpy().T
+    onp.testing.assert_allclose(l(x).asnumpy(), ref, rtol=1e-5)
+
+
+def test_dense_activation_applied():
+    l = nn.Dense(5, activation="relu", in_units=4)
+    l.initialize()
+    out = l(_x(8, 4)).asnumpy()
+    assert (out >= 0).all()
+
+
+def test_dense_grad_flows():
+    l = nn.Dense(3, in_units=4)
+    l.initialize()
+    x = _x(2, 4)
+    with autograd.record():
+        y = l(x).sum()
+    y.backward()
+    assert l.weight.data()._grad is not None
+
+
+# -- Conv / Pool -------------------------------------------------------------
+
+def test_conv2d_shape_same_pad():
+    l = nn.Conv2D(8, 3, padding=1, in_channels=3)
+    out = _init(l, _x(2, 3, 16, 16))
+    assert out.shape == (2, 8, 16, 16)
+
+
+def test_conv2d_stride_shape():
+    l = nn.Conv2D(4, 3, strides=2, in_channels=3)
+    out = _init(l, _x(2, 3, 17, 17))
+    assert out.shape == (2, 4, 8, 8)
+
+
+def test_conv2d_dilation_shape():
+    l = nn.Conv2D(4, 3, dilation=2, in_channels=3)
+    out = _init(l, _x(2, 3, 16, 16))
+    assert out.shape == (2, 4, 12, 12)
+
+
+def test_conv2d_groups():
+    l = nn.Conv2D(8, 3, padding=1, groups=2, in_channels=4)
+    out = _init(l, _x(1, 4, 8, 8))
+    assert out.shape == (1, 8, 8, 8)
+    assert l.weight.shape == (8, 2, 3, 3)
+
+
+def test_conv1d_shape():
+    l = nn.Conv1D(6, 3, in_channels=2)
+    out = _init(l, _x(2, 2, 20))
+    assert out.shape == (2, 6, 18)
+
+
+def test_conv3d_shape():
+    l = nn.Conv3D(4, 2, in_channels=1)
+    out = _init(l, _x(1, 1, 6, 6, 6))
+    assert out.shape == (1, 4, 5, 5, 5)
+
+
+def test_conv2d_transpose_shape():
+    l = nn.Conv2DTranspose(3, 3, strides=2, in_channels=4)
+    out = _init(l, _x(1, 4, 8, 8))
+    assert out.shape[1] == 3 and out.shape[2] > 8
+
+
+def test_maxpool_value():
+    l = nn.MaxPool2D(2)
+    x = np.array(onp.arange(16, dtype="float32").reshape(1, 1, 4, 4))
+    out = l(x).asnumpy()
+    onp.testing.assert_array_equal(out[0, 0], [[5, 7], [13, 15]])
+
+
+def test_avgpool_value():
+    l = nn.AvgPool2D(2)
+    x = np.array(onp.arange(16, dtype="float32").reshape(1, 1, 4, 4))
+    out = l(x).asnumpy()
+    onp.testing.assert_allclose(out[0, 0], [[2.5, 4.5], [10.5, 12.5]])
+
+
+def test_global_pools():
+    x = _x(2, 3, 5, 5)
+    g1 = nn.GlobalAvgPool2D()(x)
+    g2 = nn.GlobalMaxPool2D()(x)
+    assert g1.shape == (2, 3, 1, 1)
+    onp.testing.assert_allclose(g1.asnumpy()[..., 0, 0],
+                                x.asnumpy().mean(axis=(2, 3)), rtol=1e-5)
+    onp.testing.assert_allclose(g2.asnumpy()[..., 0, 0],
+                                x.asnumpy().max(axis=(2, 3)), rtol=1e-5)
+
+
+# -- Norms -------------------------------------------------------------------
+
+def test_batchnorm_train_normalizes():
+    l = nn.BatchNorm(in_channels=4)
+    l.initialize()
+    x = _x(64, 4, 3, 3)
+    with autograd.record():
+        out = l(x)
+    o = out.asnumpy()
+    onp.testing.assert_allclose(o.mean(axis=(0, 2, 3)), 0.0, atol=0.05)
+    onp.testing.assert_allclose(o.var(axis=(0, 2, 3)), 1.0, atol=0.1)
+
+
+def test_batchnorm_eval_uses_running_stats():
+    l = nn.BatchNorm(in_channels=2)
+    l.initialize()
+    x = _x(8, 2, 2, 2)
+    for _ in range(10):
+        with autograd.record():
+            l(x)
+    out_eval = l(x).asnumpy()      # eval mode: running stats
+    assert onp.isfinite(out_eval).all()
+    rm = l.running_mean.data().asnumpy()
+    assert not onp.allclose(rm, 0.0)    # stats actually updated
+
+
+def test_layernorm_normalizes_last_axis():
+    l = nn.LayerNorm(in_channels=6)
+    l.initialize()
+    x = _x(4, 6)
+    o = l(x).asnumpy()
+    onp.testing.assert_allclose(o.mean(axis=-1), 0.0, atol=1e-5)
+    onp.testing.assert_allclose(o.var(axis=-1), 1.0, atol=1e-3)
+
+
+def test_groupnorm_shape():
+    l = nn.GroupNorm(num_groups=2, in_channels=4)
+    l.initialize()
+    out = l(_x(2, 4, 5, 5))
+    assert out.shape == (2, 4, 5, 5)
+
+
+def test_instancenorm_normalizes_spatial():
+    l = nn.InstanceNorm(in_channels=3)
+    l.initialize()
+    x = _x(2, 3, 8, 8)
+    o = l(x).asnumpy()
+    onp.testing.assert_allclose(o.mean(axis=(2, 3)), 0.0, atol=1e-4)
+
+
+# -- Activations / Dropout / Embedding ---------------------------------------
+
+def test_activation_kinds():
+    x = _x(3, 4)
+    for kind, ref in [("relu", lambda v: onp.maximum(v, 0)),
+                      ("sigmoid", lambda v: 1 / (1 + onp.exp(-v))),
+                      ("tanh", onp.tanh),
+                      ("softrelu", lambda v: onp.log1p(onp.exp(v)))]:
+        out = nn.Activation(kind)(x).asnumpy()
+        onp.testing.assert_allclose(out, ref(x.asnumpy()), rtol=1e-4,
+                                    atol=1e-5)
+
+
+def test_leaky_relu():
+    l = nn.LeakyReLU(0.1)
+    x = np.array(onp.array([-2.0, 3.0], "float32"))
+    onp.testing.assert_allclose(l(x).asnumpy(), [-0.2, 3.0], rtol=1e-6)
+
+
+def test_prelu_learns_slope():
+    l = nn.PReLU()
+    l.initialize()
+    x = np.array(onp.array([[-1.0, 2.0]], "float32"))
+    out = l(x).asnumpy()
+    assert out[0, 1] == pytest.approx(2.0)
+
+
+def test_elu_selu_gelu_swish():
+    x = _x(4, 4)
+    for layer in (nn.ELU(), nn.SELU(), nn.GELU(), nn.Swish()):
+        out = layer(x)
+        assert out.shape == x.shape
+        assert onp.isfinite(out.asnumpy()).all()
+
+
+def test_dropout_eval_identity():
+    l = nn.Dropout(0.5)
+    x = _x(8, 8)
+    onp.testing.assert_array_equal(l(x).asnumpy(), x.asnumpy())
+
+
+def test_dropout_train_zeroes_and_scales():
+    mx.random.seed(3)
+    l = nn.Dropout(0.5)
+    x = np.array(onp.ones((64, 64), "float32"))
+    with autograd.record():
+        out = l(x)
+    o = out.asnumpy()
+    zero_frac = (o == 0).mean()
+    assert 0.3 < zero_frac < 0.7
+    kept = o[o != 0]
+    onp.testing.assert_allclose(kept, 2.0, rtol=1e-5)
+
+
+def test_embedding_lookup_rows():
+    l = nn.Embedding(10, 4)
+    l.initialize()
+    idx = np.array(onp.array([1, 7, 1], "float32"))
+    out = l(idx).asnumpy()
+    w = l.weight.data().asnumpy()
+    onp.testing.assert_array_equal(out, w[[1, 7, 1]])
+
+
+def test_flatten_layer():
+    out = nn.Flatten()(_x(2, 3, 4, 5))
+    assert out.shape == (2, 60)
+
+
+def test_identity_layer():
+    x = _x(3, 3)
+    onp.testing.assert_array_equal(nn.Identity()(x).asnumpy(), x.asnumpy())
+
+
+# -- containers --------------------------------------------------------------
+
+def test_hybridsequential_composes():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(8, activation="relu"), nn.Dense(2))
+    net.initialize()
+    out = net(_x(4, 6))
+    assert out.shape == (4, 2)
+    assert len(net) == 2
+
+
+def test_sequential_getitem_slice():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(8), nn.Dense(4), nn.Dense(2))
+    net.initialize()
+    sub = net[1:]
+    assert len(sub) == 2
+
+
+def test_collect_params_prefix_regex():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(8, in_units=4), nn.Dense(2, in_units=8))
+    net.initialize()
+    allp = net.collect_params()
+    assert len(allp) == 4
+    w_only = net.collect_params(".*weight")
+    assert len(w_only) == 2
+
+
+def test_named_children_and_repr():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(3))
+    assert "Dense" in repr(net)
+
+
+# -- parameter mechanics -----------------------------------------------------
+
+def test_deferred_init_resolves_on_first_call():
+    l = nn.Dense(5)
+    l.initialize()
+    assert l.weight.shape[1] == 0         # unknown until data flows
+    l(_x(2, 7))
+    assert l.weight.shape == (5, 7)
+
+
+def test_uninitialized_use_raises():
+    l = nn.Dense(5, in_units=3)
+    from incubator_mxnet_tpu.gluon.parameter import DeferredInitializationError
+
+    del DeferredInitializationError
+    with pytest.raises(Exception):
+        l(_x(2, 3))                        # not initialized
+
+
+def test_setattr_replaces_child():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(4, in_units=4))
+    net.initialize()
+    ref = net(_x(2, 4))
+    net._children["0"] = nn.Identity()
+    out = net(_x(2, 4))
+    assert out.shape == (2, 4)
+    del ref
+
+
+def test_share_parameters_between_blocks():
+    a = nn.Dense(4, in_units=6)
+    a.initialize()
+    b = nn.Dense(4, in_units=6)
+    b.share_parameters(a.collect_params())
+    x = _x(3, 6)
+    onp.testing.assert_array_equal(a(x).asnumpy(), b(x).asnumpy())
+
+
+def test_save_load_parameters_roundtrip(tmp_path):
+    net = nn.HybridSequential()
+    net.add(nn.Dense(8, in_units=4), nn.Dense(2, in_units=8))
+    net.initialize()
+    x = _x(2, 4)
+    ref = net(x).asnumpy()
+    p = str(tmp_path / "m.params")
+    net.save_parameters(p)
+    net2 = nn.HybridSequential()
+    net2.add(nn.Dense(8, in_units=4), nn.Dense(2, in_units=8))
+    net2.load_parameters(p)
+    onp.testing.assert_array_equal(net2(x).asnumpy(), ref)
+
+
+def test_zero_grad_clears():
+    l = nn.Dense(3, in_units=4)
+    l.initialize()
+    x = _x(2, 4)
+    with autograd.record():
+        l(x).sum().backward()
+    l.collect_params().zero_grad()
+    g = l.weight.data()._grad
+    assert g is None or not g.asnumpy().any()
+
+
+def test_grad_req_null_skips_grad():
+    l = nn.Dense(3, in_units=4)
+    l.initialize()
+    l.weight.grad_req = "null"
+    x = _x(2, 4)
+    with autograd.record():
+        y = l(x).sum()
+    y.backward()
+    assert l.weight.data()._grad is None
+
+
+def test_cast_block_dtype():
+    l = nn.Dense(4, in_units=4)
+    l.initialize()
+    l.cast("float16")
+    assert "float16" in str(l.weight.data().dtype)

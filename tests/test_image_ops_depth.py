@@ -1,0 +1,168 @@
+"""Image op depth: npx.image resize/crop/normalize/flip semantics plus
+the imperative mx.image augmenter helpers (reference:
+`src/operator/image/image_random-inl.h`, `python/mxnet/image/`)."""
+import numpy as onp
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import image as mximage
+from incubator_mxnet_tpu import np, npx
+
+RNG = onp.random.RandomState(41)
+
+
+def _img(h=8, w=10, c=3):
+    return RNG.randint(0, 255, (h, w, c)).astype(onp.uint8)
+
+
+def test_to_tensor_scales_and_transposes():
+    im = _img()
+    got = npx.image.to_tensor(np.array(im)).asnumpy()
+    assert got.shape == (3, 8, 10)
+    onp.testing.assert_allclose(got, im.transpose(2, 0, 1) / 255.0,
+                                rtol=1e-6)
+
+
+def test_normalize_channelwise():
+    x = np.array(onp.ones((3, 4, 4), "float32"))
+    got = npx.image.normalize(x, mean=(0.5, 0.0, 1.0),
+                              std=(0.5, 1.0, 2.0)).asnumpy()
+    onp.testing.assert_allclose(got[0], 1.0, rtol=1e-5)
+    onp.testing.assert_allclose(got[1], 1.0, rtol=1e-5)
+    onp.testing.assert_allclose(got[2], 0.0, atol=1e-6)
+
+
+def test_resize_shape_and_dtype():
+    im = _img(8, 10)
+    got = npx.image.resize(np.array(im), size=(20, 16))  # (w, h)
+    assert got.shape == (16, 20, 3)
+
+
+def test_resize_identity_when_same_size():
+    im = _img(8, 8)
+    got = npx.image.resize(np.array(im), size=(8, 8)).asnumpy()
+    onp.testing.assert_allclose(got.astype("float32"),
+                                im.astype("float32"), atol=1.0)
+
+
+def test_crop_exact_region():
+    im = _img(10, 12)
+    got = npx.image.crop(np.array(im), 2, 3, 5, 4).asnumpy()  # x,y,w,h
+    onp.testing.assert_array_equal(got, im[3:7, 2:7])
+
+
+def test_fixed_crop_matches_slice():
+    im = _img(10, 12)
+    got = mximage.fixed_crop(np.array(im), 1, 2, 6, 5).asnumpy()
+    onp.testing.assert_array_equal(got, im[2:7, 1:7])
+
+
+def test_flip_left_right():
+    im = _img()
+    got = npx.image.flip_left_right(np.array(im)).asnumpy()
+    onp.testing.assert_array_equal(got, im[:, ::-1])
+
+
+def test_flip_top_bottom():
+    im = _img()
+    got = npx.image.flip_top_bottom(np.array(im)).asnumpy()
+    onp.testing.assert_array_equal(got, im[::-1])
+
+
+def test_resize_short_keeps_aspect():
+    im = _img(8, 16)
+    out = mximage.resize_short(np.array(im), 4)
+    assert out.shape == (4, 8, 3)
+
+
+def test_center_crop_shape():
+    im = _img(10, 12)
+    out, (x0, y0, w, h) = mximage.center_crop(np.array(im), (6, 4))
+    assert out.shape == (4, 6, 3)
+    assert (x0, y0, w, h) == (3, 3, 6, 4)
+
+
+def test_random_crop_within_bounds():
+    mx.random.seed(3)
+    im = _img(10, 12)
+    out, (x0, y0, w, h) = mximage.random_crop(np.array(im), (5, 5))
+    assert out.shape == (5, 5, 3)
+    assert 0 <= x0 <= 7 and 0 <= y0 <= 5
+
+
+def test_color_normalize_helper():
+    im = onp.full((4, 4, 3), 128, "uint8")
+    out = mximage.color_normalize(
+        np.array(im).astype("float32") / 255.0,
+        np.array(onp.array([0.5, 0.5, 0.5], "float32")),
+        np.array(onp.array([0.5, 0.5, 0.5], "float32"))).asnumpy()
+    onp.testing.assert_allclose(out, (128 / 255 - 0.5) / 0.5, rtol=1e-4)
+
+
+def test_imdecode_imencode_roundtrip():
+    cv2 = pytest.importorskip("cv2")
+    im = _img(16, 16)
+    ok, buf = cv2.imencode(".png", im)     # png = lossless
+    assert ok
+    got = mximage.imdecode(buf.tobytes()).asnumpy()
+    onp.testing.assert_array_equal(got, im[:, :, ::-1])  # BGR→RGB parity
+
+
+def test_hue_brightness_augmenters_change_image():
+    mx.random.seed(4)
+    im = np.array(_img().astype("float32"))
+    aug = mximage.BrightnessJitterAug(0.5)
+    out = aug(im).asnumpy()
+    assert out.shape == im.shape
+    assert not onp.allclose(out, im.asnumpy())
+
+
+def test_horizontal_flip_aug_deterministic_p1():
+    aug = mximage.HorizontalFlipAug(1.0)
+    im = np.array(_img().astype("float32"))
+    out = aug(im).asnumpy()
+    onp.testing.assert_array_equal(out, im.asnumpy()[:, ::-1])
+
+
+def test_cast_aug():
+    aug = mximage.CastAug()
+    im = np.array(_img())
+    assert "float32" in str(aug(im).dtype)
+
+
+def test_resize_aug_sequence():
+    aug = mximage.ResizeAug(6)
+    im = np.array(_img(8, 12).astype("float32"))
+    out = aug(im)
+    assert min(out.shape[:2]) == 6
+
+
+def test_augmenter_list_compose():
+    augs = mximage.CreateAugmenter((3, 6, 6), resize=8, rand_mirror=True)
+    assert len(augs) >= 2
+    im = np.array(_img(10, 10).astype("float32"))
+    out = im
+    for a in augs:
+        out = a(out)
+    assert out.shape[-1] == 3 or out.shape[0] == 3
+
+
+def test_gluon_transforms_pipeline():
+    from incubator_mxnet_tpu.gluon.data.vision import transforms
+
+    tf = transforms.Compose([transforms.Resize(6),
+                             transforms.CenterCrop(4),
+                             transforms.ToTensor(),
+                             transforms.Normalize(0.5, 0.5)])
+    out = tf(np.array(_img(10, 12)))
+    assert out.shape == (3, 4, 4)
+    assert float(out.asnumpy().max()) <= 1.0
+
+
+def test_random_resized_crop_transform():
+    from incubator_mxnet_tpu.gluon.data.vision import transforms
+
+    mx.random.seed(5)
+    tf = transforms.RandomResizedCrop(6)
+    out = tf(np.array(_img(12, 12)))
+    assert out.shape[:2] == (6, 6)

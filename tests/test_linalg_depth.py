@@ -1,0 +1,188 @@
+"""np.linalg depth: factorizations round-trip, solves, norms, spectra —
+golden against numpy.linalg (reference: `src/operator/numpy/linalg/` +
+test_numpy_op.py linalg blocks)."""
+import numpy as onp
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import np
+from incubator_mxnet_tpu.numpy import linalg
+
+RNG = onp.random.RandomState(37)
+
+
+def _m(n=4, batch=None):
+    shape = (n, n) if batch is None else (batch, n, n)
+    return RNG.uniform(-1, 1, shape).astype("float32")
+
+
+def _spd(n=4):
+    a = _m(n)
+    return a @ a.T + n * onp.eye(n, dtype="float32")
+
+
+def test_norm_fro():
+    a = _m()
+    got = float(linalg.norm(np.array(a)).asnumpy())
+    assert got == pytest.approx(float(onp.linalg.norm(a)), rel=1e-5)
+
+
+def test_norm_orders():
+    a = _m()
+    for ordv in (1, 2, onp.inf, "fro"):
+        got = float(linalg.norm(np.array(a), ord=ordv).asnumpy())
+        assert got == pytest.approx(float(onp.linalg.norm(a, ord=ordv)),
+                                    rel=1e-4)
+
+
+def test_vector_norm_axis():
+    a = _m()
+    got = linalg.norm(np.array(a), axis=1).asnumpy()
+    onp.testing.assert_allclose(got, onp.linalg.norm(a, axis=1), rtol=1e-5)
+
+
+def test_det_and_slogdet_consistent():
+    a = _spd()
+    d = float(linalg.det(np.array(a)).asnumpy())
+    sign, logdet = linalg.slogdet(np.array(a))
+    assert d == pytest.approx(float(onp.linalg.det(a)), rel=1e-3)
+    assert float(sign.asnumpy()) * onp.exp(float(logdet.asnumpy())) == \
+        pytest.approx(d, rel=1e-3)
+
+
+def test_inv_roundtrip():
+    a = _spd()
+    inv = linalg.inv(np.array(a)).asnumpy()
+    onp.testing.assert_allclose(a @ inv, onp.eye(4), atol=1e-3)
+
+
+def test_pinv_rectangular():
+    a = RNG.uniform(-1, 1, (5, 3)).astype("float32")
+    p = linalg.pinv(np.array(a)).asnumpy()
+    onp.testing.assert_allclose(a @ p @ a, a, atol=1e-3)
+
+
+def test_solve_matches_numpy():
+    a = _spd()
+    b = RNG.uniform(-1, 1, (4, 2)).astype("float32")
+    x = linalg.solve(np.array(a), np.array(b)).asnumpy()
+    onp.testing.assert_allclose(a @ x, b, atol=1e-3)
+
+
+def test_lstsq_overdetermined():
+    a = RNG.uniform(-1, 1, (6, 3)).astype("float32")
+    b = RNG.uniform(-1, 1, (6,)).astype("float32")
+    x = linalg.lstsq(np.array(a), np.array(b), rcond=None)[0].asnumpy()
+    ref = onp.linalg.lstsq(a, b, rcond=None)[0]
+    onp.testing.assert_allclose(x, ref, atol=1e-3)
+
+
+def test_cholesky_roundtrip():
+    a = _spd()
+    chol = linalg.cholesky(np.array(a)).asnumpy()
+    onp.testing.assert_allclose(chol @ chol.T, a, rtol=1e-3, atol=1e-3)
+    assert onp.allclose(chol, onp.tril(chol))
+
+
+def test_qr_roundtrip_orthonormal():
+    a = _m()
+    qm, r = linalg.qr(np.array(a))
+    qv, rv = qm.asnumpy(), r.asnumpy()
+    onp.testing.assert_allclose(qv @ rv, a, atol=1e-4)
+    onp.testing.assert_allclose(qv.T @ qv, onp.eye(4), atol=1e-4)
+    assert onp.allclose(rv, onp.triu(rv), atol=1e-5)
+
+
+def test_svd_roundtrip_and_singular_values():
+    a = RNG.uniform(-1, 1, (5, 3)).astype("float32")
+    u, s, vt = linalg.svd(np.array(a))
+    uv, sv, vtv = u.asnumpy(), s.asnumpy(), vt.asnumpy()
+    onp.testing.assert_allclose((uv[:, :3] * sv) @ vtv, a, atol=1e-4)
+    onp.testing.assert_allclose(sv, onp.linalg.svd(a, compute_uv=False),
+                                rtol=1e-4)
+
+
+def test_eigh_reconstruction():
+    a = _spd()
+    w, v = linalg.eigh(np.array(a))
+    wv, vv = w.asnumpy(), v.asnumpy()
+    onp.testing.assert_allclose(vv @ onp.diag(wv) @ vv.T, a, atol=1e-3)
+    ref = onp.linalg.eigvalsh(a)
+    onp.testing.assert_allclose(onp.sort(wv), onp.sort(ref), rtol=1e-4)
+
+
+def test_eigvalsh_matches():
+    a = _spd()
+    got = linalg.eigvalsh(np.array(a)).asnumpy()
+    onp.testing.assert_allclose(onp.sort(got),
+                                onp.sort(onp.linalg.eigvalsh(a)),
+                                rtol=1e-4)
+
+
+def test_matrix_rank():
+    a = onp.zeros((4, 4), "float32")
+    a[0, 0] = a[1, 1] = 1.0
+    assert int(linalg.matrix_rank(np.array(a)).asnumpy()) == 2
+
+
+def test_matrix_power():
+    a = _m(3)
+    got = linalg.matrix_power(np.array(a), 3).asnumpy()
+    onp.testing.assert_allclose(got, a @ a @ a, rtol=1e-3, atol=1e-4)
+
+
+def test_multi_dot():
+    a, b, c = _m(3), _m(3), _m(3)
+    got = linalg.multi_dot([np.array(a), np.array(b),
+                            np.array(c)]).asnumpy()
+    onp.testing.assert_allclose(got, a @ b @ c, rtol=1e-4, atol=1e-4)
+
+
+def test_batched_inv():
+    a = onp.stack([_spd(), _spd()])
+    inv = linalg.inv(np.array(a)).asnumpy()
+    for i in range(2):
+        onp.testing.assert_allclose(a[i] @ inv[i], onp.eye(4), atol=1e-3)
+
+
+def test_batched_cholesky():
+    a = onp.stack([_spd(), _spd()])
+    c = linalg.cholesky(np.array(a)).asnumpy()
+    for i in range(2):
+        onp.testing.assert_allclose(c[i] @ c[i].T, a[i], atol=1e-3)
+
+
+def test_tensorsolve_tensorinv_if_present():
+    if not hasattr(linalg, "tensorsolve"):
+        pytest.skip("tensorsolve not exposed")
+    a = RNG.uniform(-1, 1, (2, 2, 2, 2)).astype("float32") \
+        + onp.eye(4).reshape(2, 2, 2, 2).astype("float32") * 2
+    b = RNG.uniform(-1, 1, (2, 2)).astype("float32")
+    x = linalg.tensorsolve(np.array(a), np.array(b)).asnumpy()
+    ref = onp.linalg.tensorsolve(a, b)
+    onp.testing.assert_allclose(x, ref, atol=1e-3)
+
+
+def test_solve_grad_flows():
+    from incubator_mxnet_tpu import autograd
+
+    a = np.array(_spd())
+    b = np.array(RNG.uniform(-1, 1, (4,)).astype("float32"))
+    a.attach_grad()
+    with autograd.record():
+        x = linalg.solve(a, b)
+        s = np.sum(x)
+    s.backward()
+    assert a.grad is not None
+    assert onp.isfinite(a.grad.asnumpy()).all()
+
+
+def test_norm_grad_unit_direction():
+    from incubator_mxnet_tpu import autograd
+
+    v = np.array(onp.array([3.0, 4.0], "float32"))
+    v.attach_grad()
+    with autograd.record():
+        n = linalg.norm(v)
+    n.backward()
+    onp.testing.assert_allclose(v.grad.asnumpy(), [0.6, 0.8], rtol=1e-5)

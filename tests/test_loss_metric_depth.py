@@ -1,0 +1,243 @@
+"""Loss and metric depth: closed-form values on tiny inputs, weighting
+and batch-axis semantics, metric update/reset cycles (reference:
+`tests/python/unittest/test_loss.py`, `test_metric.py`)."""
+import numpy as onp
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import autograd, gluon, np
+from incubator_mxnet_tpu.gluon import loss as gloss
+from incubator_mxnet_tpu.gluon import metric as gmetric
+
+RNG = onp.random.RandomState(31)
+
+
+def _a(*shape):
+    return onp.array(RNG.uniform(-1, 1, shape), "float32")
+
+
+# -- losses ------------------------------------------------------------------
+
+def test_l2_loss_value():
+    p, y = _a(4, 3), _a(4, 3)
+    got = gloss.L2Loss()(np.array(p), np.array(y)).asnumpy()
+    onp.testing.assert_allclose(got, ((p - y) ** 2).mean(axis=1) / 2,
+                                rtol=1e-5)
+
+
+def test_l1_loss_value():
+    p, y = _a(4, 3), _a(4, 3)
+    got = gloss.L1Loss()(np.array(p), np.array(y)).asnumpy()
+    onp.testing.assert_allclose(got, onp.abs(p - y).mean(axis=1),
+                                rtol=1e-5)
+
+
+def test_softmax_ce_sparse_value():
+    logits = onp.array([[2.0, 1.0, 0.0]], "float32")
+    got = float(gloss.SoftmaxCrossEntropyLoss()(
+        np.array(logits), np.array(onp.array([0.0], "float32"))).asnumpy())
+    ref = -onp.log(onp.exp(2.0) / onp.exp([2.0, 1.0, 0.0]).sum())
+    assert got == pytest.approx(float(ref), rel=1e-5)
+
+
+def test_softmax_ce_dense_label():
+    logits = _a(2, 4)
+    dense = onp.array([[0.25, 0.25, 0.25, 0.25],
+                       [1.0, 0.0, 0.0, 0.0]], "float32")
+    l = gloss.SoftmaxCrossEntropyLoss(sparse_label=False)
+    got = l(np.array(logits), np.array(dense)).asnumpy()
+    logp = onp.log(onp.exp(logits) / onp.exp(logits).sum(-1, keepdims=True))
+    onp.testing.assert_allclose(got, -(logp * dense).sum(-1), rtol=1e-4)
+
+
+def test_sigmoid_bce_from_logits_stable():
+    x = onp.array([[100.0, -100.0]], "float32")
+    y = onp.array([[1.0, 0.0]], "float32")
+    got = gloss.SigmoidBinaryCrossEntropyLoss(from_sigmoid=False)(
+        np.array(x), np.array(y)).asnumpy()
+    onp.testing.assert_allclose(got, 0.0, atol=1e-5)  # no overflow
+
+
+def test_kl_div_value():
+    logp = onp.log(onp.array([[0.5, 0.5]], "float32"))
+    q = onp.array([[0.9, 0.1]], "float32")
+    got = float(gloss.KLDivLoss(from_logits=True)(
+        np.array(logp), np.array(q)).asnumpy())
+    ref = (q * (onp.log(q) - logp)).sum() / 2   # batch-mean over axis 1
+    assert got == pytest.approx(float(ref), rel=1e-4)
+
+
+def test_huber_switches_at_rho():
+    l = gloss.HuberLoss(rho=1.0)
+    p = onp.array([[0.5], [3.0]], "float32")
+    y = onp.zeros((2, 1), "float32")
+    got = l(np.array(p), np.array(y)).asnumpy()
+    onp.testing.assert_allclose(got[0], 0.5 * 0.25, rtol=1e-5)  # quadratic
+    onp.testing.assert_allclose(got[1], 3.0 - 0.5, rtol=1e-5)   # linear
+
+
+def test_hinge_loss_value():
+    l = gloss.HingeLoss()
+    p = onp.array([[0.5], [2.0]], "float32")
+    y = onp.array([[1.0], [-1.0]], "float32")
+    got = l(np.array(p), np.array(y)).asnumpy()
+    onp.testing.assert_allclose(got.reshape(-1), [0.5, 3.0], rtol=1e-5)
+
+
+def test_triplet_loss_margin():
+    l = gloss.TripletLoss(margin=1.0)
+    a = onp.zeros((1, 2), "float32")
+    pos = onp.zeros((1, 2), "float32")
+    neg = onp.full((1, 2), 2.0, "float32")
+    got = float(l(np.array(a), np.array(pos), np.array(neg)).asnumpy())
+    assert got == pytest.approx(0.0)       # clamped: neg far enough
+
+
+def test_cosine_embedding_loss():
+    l = gloss.CosineEmbeddingLoss()
+    a = onp.array([[1.0, 0.0]], "float32")
+    b = onp.array([[1.0, 0.0]], "float32")
+    got = float(l(np.array(a), np.array(b),
+                  np.array(onp.array([1.0], "float32"))).asnumpy())
+    assert got == pytest.approx(0.0, abs=1e-5)
+
+
+def test_sample_weight_scales_loss():
+    p, y = _a(4, 3), _a(4, 3)
+    base = gloss.L2Loss()(np.array(p), np.array(y)).asnumpy()
+    w = onp.array([1.0, 0.0, 2.0, 1.0], "float32").reshape(4, 1)
+    got = gloss.L2Loss()(np.array(p), np.array(y),
+                         np.array(w)).asnumpy()
+    onp.testing.assert_allclose(got, base * w[:, 0], rtol=1e-5)
+
+
+def test_loss_weight_constructor():
+    p, y = _a(3, 2), _a(3, 2)
+    base = gloss.L2Loss()(np.array(p), np.array(y)).asnumpy()
+    got = gloss.L2Loss(weight=3.0)(np.array(p), np.array(y)).asnumpy()
+    onp.testing.assert_allclose(got, base * 3.0, rtol=1e-5)
+
+
+def test_ctc_loss_runs_and_is_positive():
+    N, T, C = 2, 8, 5                      # default layout NTC
+    logits = np.array(_a(N, T, C))
+    labels = np.array(onp.array([[1, 2], [3, 4]], "float32"))
+    got = gloss.CTCLoss()(logits, labels).asnumpy()
+    assert got.shape == (N,)
+    assert (got > 0).all()
+
+
+def test_loss_grad_flows():
+    p = np.array(_a(4, 3))
+    p.attach_grad()
+    y = np.array(_a(4, 3))
+    with autograd.record():
+        out = gloss.L2Loss()(p, y).sum()
+    out.backward()
+    onp.testing.assert_allclose(p.grad.asnumpy(),
+                                (p.asnumpy() - y.asnumpy()) / 3,
+                                rtol=1e-4)
+
+
+# -- metrics -----------------------------------------------------------------
+
+def test_accuracy_metric():
+    m = gmetric.Accuracy()
+    pred = np.array(onp.array([[0.1, 0.9], [0.8, 0.2]], "float32"))
+    lab = np.array(onp.array([1, 1], "float32"))
+    m.update(lab, pred)
+    assert m.get()[1] == pytest.approx(0.5)
+
+
+def test_accuracy_accumulates_and_resets():
+    m = gmetric.Accuracy()
+    pred = np.array(onp.array([[0.9, 0.1]], "float32"))
+    m.update(np.array(onp.array([0.0], "float32")), pred)
+    m.update(np.array(onp.array([1.0], "float32")), pred)
+    assert m.get()[1] == pytest.approx(0.5)
+    m.reset()
+    import math
+
+    assert math.isnan(m.get()[1]) or m.get()[1] == 0.0
+
+
+def test_topk_accuracy():
+    m = gmetric.TopKAccuracy(top_k=2)
+    pred = np.array(onp.array([[0.1, 0.2, 0.7],
+                               [0.5, 0.4, 0.1]], "float32"))
+    lab = np.array(onp.array([1, 2], "float32"))
+    m.update(lab, pred)
+    assert m.get()[1] == pytest.approx(0.5)
+
+
+def test_mae_mse_rmse():
+    p = onp.array([[1.0], [3.0]], "float32")
+    y = onp.array([[2.0], [1.0]], "float32")
+    mae = gmetric.MAE()
+    mae.update(np.array(y), np.array(p))
+    assert mae.get()[1] == pytest.approx(1.5)
+    mse = gmetric.MSE()
+    mse.update(np.array(y), np.array(p))
+    assert mse.get()[1] == pytest.approx(2.5)
+    rmse = gmetric.RMSE()
+    rmse.update(np.array(y), np.array(p))
+    assert rmse.get()[1] == pytest.approx(onp.sqrt(2.5), rel=1e-5)
+
+
+def test_f1_binary():
+    m = gmetric.F1()
+    pred = np.array(onp.array([[0.2, 0.8], [0.8, 0.2], [0.3, 0.7]],
+                              "float32"))
+    lab = np.array(onp.array([1.0, 0.0, 0.0], "float32"))
+    m.update(lab, pred)
+    # tp=1 fp=1 fn=0 → precision 0.5, recall 1 → f1 = 2/3
+    assert m.get()[1] == pytest.approx(2 / 3, rel=1e-5)
+
+
+def test_mcc_perfect_and_inverse():
+    m = gmetric.MCC()
+    pred = np.array(onp.array([[0.1, 0.9], [0.9, 0.1]], "float32"))
+    lab = np.array(onp.array([1.0, 0.0], "float32"))
+    m.update(lab, pred)
+    assert m.get()[1] == pytest.approx(1.0)
+
+
+def test_pearson_correlation():
+    m = gmetric.PearsonCorrelation()
+    y = onp.array([1.0, 2.0, 3.0, 4.0], "float32")
+    p = onp.array([1.1, 1.9, 3.2, 3.8], "float32")
+    m.update(np.array(y), np.array(p))
+    ref = onp.corrcoef(y, p)[0, 1]
+    assert m.get()[1] == pytest.approx(float(ref), rel=1e-4)
+
+
+def test_perplexity_metric():
+    m = gmetric.Perplexity()
+    prob = onp.array([[0.5, 0.5], [0.25, 0.75]], "float32")
+    lab = onp.array([0.0, 1.0], "float32")
+    m.update(np.array(lab), np.array(prob))
+    ref = onp.exp(-(onp.log(0.5) + onp.log(0.75)) / 2)
+    assert m.get()[1] == pytest.approx(float(ref), rel=1e-4)
+
+
+def test_cross_entropy_metric():
+    m = gmetric.CrossEntropy()
+    prob = onp.array([[0.5, 0.5]], "float32")
+    m.update(np.array(onp.array([0.0], "float32")), np.array(prob))
+    assert m.get()[1] == pytest.approx(-onp.log(0.5), rel=1e-5)
+
+
+def test_composite_metric():
+    c = gmetric.CompositeEvalMetric()
+    c.add(gmetric.Accuracy())
+    c.add(gmetric.CrossEntropy())      # both take (class-idx, prob) pairs
+    pred = np.array(onp.array([[0.9, 0.1]], "float32"))
+    lab = np.array(onp.array([0.0], "float32"))
+    c.update(lab, pred)
+    names, vals = c.get()
+    assert len(names) == 2 and len(vals) == 2
+
+
+def test_metric_create_by_name():
+    m = gmetric.create("acc")
+    assert isinstance(m, gmetric.Accuracy)

@@ -1,0 +1,300 @@
+"""npx extension-op depth: activations, softmax family, norm ops,
+convolution/pooling parameterizations, sequence ops — golden values and
+grads (reference: `src/operator/nn/` + npx blocks of test_numpy_op.py)."""
+import numpy as onp
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import autograd, np, npx
+
+RNG = onp.random.RandomState(29)
+
+
+def _x(*shape):
+    return np.array(RNG.uniform(-2, 2, shape).astype("float32"))
+
+
+# -- activation family -------------------------------------------------------
+
+def test_activation_relu_golden():
+    x = _x(3, 4)
+    onp.testing.assert_allclose(
+        npx.activation(x, act_type="relu").asnumpy(),
+        onp.maximum(x.asnumpy(), 0), rtol=1e-6)
+
+
+def test_activation_sigmoid_golden():
+    x = _x(3, 4)
+    onp.testing.assert_allclose(
+        npx.activation(x, act_type="sigmoid").asnumpy(),
+        1 / (1 + onp.exp(-x.asnumpy())), rtol=1e-5)
+
+
+def test_activation_softsign():
+    x = _x(3, 4)
+    onp.testing.assert_allclose(
+        npx.activation(x, act_type="softsign").asnumpy(),
+        x.asnumpy() / (1 + onp.abs(x.asnumpy())), rtol=1e-5)
+
+
+def test_leaky_relu_modes():
+    x = _x(4, 4)
+    got = npx.leaky_relu(x, act_type="leaky", slope=0.2).asnumpy()
+    xv = x.asnumpy()
+    onp.testing.assert_allclose(got, onp.where(xv > 0, xv, 0.2 * xv),
+                                rtol=1e-5)
+
+
+def test_leaky_relu_elu():
+    x = _x(4, 4)
+    got = npx.leaky_relu(x, act_type="elu", slope=1.0).asnumpy()
+    xv = x.asnumpy()
+    onp.testing.assert_allclose(got, onp.where(xv > 0, xv,
+                                               onp.expm1(xv)), rtol=1e-4,
+                                atol=1e-5)
+
+
+def test_gelu_exact_vs_tanh():
+    x = _x(4, 4)
+    a = npx.gelu(x, approximate=True).asnumpy()
+    b = npx.gelu(x, approximate=False).asnumpy()
+    onp.testing.assert_allclose(a, b, atol=5e-3)
+    assert not onp.array_equal(a, b)
+
+
+def test_relu_grad_mask():
+    x = np.array(onp.array([-1.0, 2.0], "float32"))
+    x.attach_grad()
+    with autograd.record():
+        y = npx.relu(x)
+    y.backward()
+    onp.testing.assert_array_equal(x.grad.asnumpy(), [0.0, 1.0])
+
+
+# -- softmax family ----------------------------------------------------------
+
+def test_softmax_rows_sum_to_one():
+    x = _x(5, 9)
+    s = npx.softmax(x, axis=-1).asnumpy()
+    onp.testing.assert_allclose(s.sum(-1), 1.0, rtol=1e-5)
+
+
+def test_softmax_temperature():
+    x = _x(2, 6)
+    hot = npx.softmax(x, axis=-1, temperature=0.1).asnumpy()
+    cold = npx.softmax(x, axis=-1, temperature=10.0).asnumpy()
+    assert hot.max() > cold.max()          # low T sharpens
+
+
+def test_log_softmax_matches_log_of_softmax():
+    x = _x(4, 7)
+    onp.testing.assert_allclose(
+        npx.log_softmax(x, axis=-1).asnumpy(),
+        onp.log(npx.softmax(x, axis=-1).asnumpy()), rtol=1e-4, atol=1e-5)
+
+
+def test_softmin_is_softmax_of_neg():
+    x = _x(3, 5)
+    onp.testing.assert_allclose(
+        npx.softmin(x, axis=-1).asnumpy(),
+        npx.softmax(-x, axis=-1).asnumpy(), rtol=1e-5)
+
+
+def test_masked_softmax_zeroes_masked():
+    x = _x(2, 4)
+    mask = np.array(onp.array([[1, 1, 0, 0], [1, 0, 1, 0]], "float32"))
+    s = npx.masked_softmax(x, mask).asnumpy()
+    assert (s[0, 2:] == 0).all() and s[1, 1] == 0 and s[1, 3] == 0
+    onp.testing.assert_allclose(s.sum(-1), 1.0, rtol=1e-5)
+
+
+def test_softmax_grad_is_jacobian_action():
+    x = np.array(onp.array([[1.0, 2.0, 3.0]], "float32"))
+    x.attach_grad()
+    with autograd.record():
+        y = npx.softmax(x, axis=-1)[0, 0]
+    y.backward()
+    s = onp.exp([1.0, 2.0, 3.0])
+    s = s / s.sum()
+    ref = s[0] * (onp.array([1.0, 0, 0]) - s)
+    onp.testing.assert_allclose(x.grad.asnumpy()[0], ref, rtol=1e-4)
+
+
+# -- norms -------------------------------------------------------------------
+
+def test_batch_norm_inference_formula():
+    x = _x(4, 3, 2, 2)
+    g = np.array(onp.array([1.0, 2.0, 0.5], "float32"))
+    b = np.array(onp.array([0.1, -0.1, 0.0], "float32"))
+    mean = np.array(onp.array([0.2, -0.3, 0.0], "float32"))
+    var = np.array(onp.array([1.5, 0.5, 2.0], "float32"))
+    got = npx.batch_norm(x, g, b, mean, var, eps=1e-3,
+                         fix_gamma=False).asnumpy()
+    xv = x.asnumpy()
+    ref = ((xv - mean.asnumpy()[None, :, None, None])
+           / onp.sqrt(var.asnumpy()[None, :, None, None] + 1e-3)
+           * g.asnumpy()[None, :, None, None]
+           + b.asnumpy()[None, :, None, None])
+    onp.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_layer_norm_eps_respected():
+    x = np.array(onp.ones((2, 3), "float32"))  # zero variance
+    g = np.array(onp.ones((3,), "float32"))
+    b = np.array(onp.zeros((3,), "float32"))
+    out = npx.layer_norm(x, g, b, eps=1e-2).asnumpy()
+    assert onp.isfinite(out).all()
+
+
+def test_l2_normalization_unit_norm():
+    x = _x(4, 6)
+    out = npx.l2_normalization(x, mode="instance").asnumpy()
+    onp.testing.assert_allclose(onp.linalg.norm(out, axis=1), 1.0,
+                                rtol=1e-4)
+
+
+def test_rms_norm_if_present():
+    if not hasattr(npx, "rms_norm"):
+        pytest.skip("rms_norm not exposed")
+    x = _x(3, 8)
+    g = np.array(onp.ones((8,), "float32"))
+    out = npx.rms_norm(x, g).asnumpy()
+    xv = x.asnumpy()
+    ref = xv / onp.sqrt((xv ** 2).mean(-1, keepdims=True) + 1e-5)
+    onp.testing.assert_allclose(out, ref, rtol=1e-3, atol=1e-4)
+
+
+# -- convolution parameterizations -------------------------------------------
+
+def test_convolution_1x1_is_channel_mix():
+    x = _x(1, 3, 5, 5)
+    w = _x(2, 3, 1, 1)
+    out = npx.convolution(x, w, None, kernel=(1, 1), num_filter=2,
+                          no_bias=True).asnumpy()
+    ref = onp.einsum("nchw,kc->nkhw", x.asnumpy(),
+                     w.asnumpy()[:, :, 0, 0])
+    onp.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_convolution_stride_pad():
+    x = _x(1, 1, 8, 8)
+    w = _x(1, 1, 3, 3)
+    out = npx.convolution(x, w, None, kernel=(3, 3), num_filter=1,
+                          stride=(2, 2), pad=(1, 1), no_bias=True)
+    assert out.shape == (1, 1, 4, 4)
+
+
+def test_pooling_avg_include_pad_semantics():
+    # valid convention (the reference default): floor((3+2*1-2)/2)+1 = 2
+    x = np.array(onp.ones((1, 1, 3, 3), "float32"))
+    out = npx.pooling(x, kernel=(2, 2), stride=(2, 2), pad=(1, 1),
+                      pool_type="avg").asnumpy()
+    assert out.shape == (1, 1, 2, 2)
+    assert onp.isfinite(out).all()
+
+
+def test_pooling_global():
+    x = _x(2, 3, 6, 6)
+    out = npx.pooling(x, global_pool=True, pool_type="max").asnumpy()
+    onp.testing.assert_allclose(out[..., 0, 0],
+                                x.asnumpy().max(axis=(2, 3)), rtol=1e-6)
+
+
+# -- sequence ops ------------------------------------------------------------
+
+def test_sequence_last_picks_by_length():
+    x = _x(5, 3, 2)          # (T, N, C)
+    vl = np.array(onp.array([2, 5, 1], "float32"))
+    out = npx.sequence_last(x, vl, use_sequence_length=True).asnumpy()
+    xv = x.asnumpy()
+    onp.testing.assert_array_equal(out[0], xv[1, 0])
+    onp.testing.assert_array_equal(out[1], xv[4, 1])
+    onp.testing.assert_array_equal(out[2], xv[0, 2])
+
+
+def test_sequence_reverse_respects_length():
+    x = _x(4, 2, 1)
+    vl = np.array(onp.array([2, 4], "float32"))
+    out = npx.sequence_reverse(x, vl, use_sequence_length=True).asnumpy()
+    xv = x.asnumpy()
+    onp.testing.assert_array_equal(out[0, 0], xv[1, 0])
+    onp.testing.assert_array_equal(out[1, 0], xv[0, 0])
+    onp.testing.assert_array_equal(out[2, 0], xv[2, 0])  # beyond len: kept
+    onp.testing.assert_array_equal(out[0, 1], xv[3, 1])
+
+
+# -- misc npx ----------------------------------------------------------------
+
+def test_reshape_like():
+    a = _x(6, 2)
+    b = _x(3, 4)
+    assert npx.reshape_like(a, b).shape == (3, 4)
+
+
+def test_slice_like():
+    a = _x(5, 6)
+    b = _x(3, 4)
+    out = npx.slice_like(a, b)
+    assert out.shape == (3, 4)
+    onp.testing.assert_array_equal(out.asnumpy(), a.asnumpy()[:3, :4])
+
+
+def test_broadcast_like():
+    a = _x(1, 4)
+    b = _x(3, 4)
+    assert npx.broadcast_like(a, b).shape == (3, 4)
+
+
+def test_cast_dtype():
+    x = _x(2, 2)
+    assert "float16" in str(npx.cast(x, dtype="float16").dtype)
+
+
+def test_fully_connected_golden():
+    x = _x(3, 5)
+    w = _x(4, 5)
+    b = _x(4)
+    out = npx.fully_connected(x, w, b, num_hidden=4).asnumpy()
+    onp.testing.assert_allclose(
+        out, x.asnumpy() @ w.asnumpy().T + b.asnumpy(), rtol=1e-5)
+
+
+def test_embedding_grad_is_row_scatter():
+    w = _x(6, 3)
+    w.attach_grad()
+    idx = np.array(onp.array([1, 1, 4], "float32"))
+    with autograd.record():
+        y = npx.embedding(idx, w, input_dim=6, output_dim=3)
+    y.backward()
+    g = w.grad.asnumpy()
+    onp.testing.assert_allclose(g[1], 2.0, rtol=1e-6)
+    onp.testing.assert_allclose(g[4], 1.0, rtol=1e-6)
+    assert (g[[0, 2, 3, 5]] == 0).all()
+
+
+def test_topk_indices_and_both():
+    x = np.array(onp.array([[3.0, 1.0, 4.0, 1.0, 5.0]], "float32"))
+    idx = npx.topk(x, k=2, ret_typ="indices", axis=-1).asnumpy()
+    onp.testing.assert_array_equal(idx[0], [4, 2])
+    both = npx.topk(x, k=2, ret_typ="both", axis=-1)
+    onp.testing.assert_allclose(both[0].asnumpy()[0], [5.0, 4.0])
+
+
+def test_arange_like():
+    x = _x(4, 7)
+    out = npx.arange_like(x, axis=1).asnumpy()
+    onp.testing.assert_array_equal(out, onp.arange(7, dtype="float32"))
+
+
+def test_shape_array():
+    x = _x(3, 5)
+    onp.testing.assert_array_equal(npx.shape_array(x).asnumpy(), [3, 5])
+
+
+def test_stop_gradient_blocks():
+    x = _x(2, 2)
+    x.attach_grad()
+    with autograd.record():
+        y = (npx.stop_gradient(x) * x).sum()
+    y.backward()
+    onp.testing.assert_allclose(x.grad.asnumpy(), x.asnumpy(), rtol=1e-6)

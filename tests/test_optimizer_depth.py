@@ -1,0 +1,229 @@
+"""Per-optimizer single-step checks against hand-computed update math
+(reference: `tests/python/unittest/test_optimizer.py` — each rule's
+closed-form step on a tiny weight, plus lr/wd/rescale/clip plumbing)."""
+import numpy as onp
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import np, optimizer
+from incubator_mxnet_tpu.ndarray.ndarray import NDArray
+
+W0 = onp.array([1.0, -2.0, 3.0], "float32")
+G0 = onp.array([0.5, -0.25, 1.0], "float32")
+
+
+def _step(opt, w=None, g=None, n=1):
+    wv = onp.array(W0 if w is None else w)
+    gv = onp.array(G0 if g is None else g)
+    weight = NDArray(wv)
+    state = opt.create_state(0, weight)
+    for _ in range(n):
+        grad = NDArray(gv)
+        new_state = opt.update(0, weight, grad, state)
+        if new_state is not None:
+            state = new_state
+    return weight.asnumpy(), state
+
+
+def test_sgd_vanilla():
+    got, _ = _step(optimizer.SGD(learning_rate=0.1, wd=0.0))
+    onp.testing.assert_allclose(got, W0 - 0.1 * G0, rtol=1e-6)
+
+
+def test_sgd_wd():
+    got, _ = _step(optimizer.SGD(learning_rate=0.1, wd=0.01))
+    onp.testing.assert_allclose(got, W0 - 0.1 * (G0 + 0.01 * W0),
+                                rtol=1e-6)
+
+
+def test_sgd_momentum_two_steps():
+    opt = optimizer.SGD(learning_rate=0.1, momentum=0.9, wd=0.0)
+    got, _ = _step(opt, n=2)
+    mom1 = -0.1 * G0
+    w1 = W0 + mom1
+    mom2 = 0.9 * mom1 - 0.1 * G0
+    onp.testing.assert_allclose(got, w1 + mom2, rtol=1e-6)
+
+
+def test_sgd_rescale_grad():
+    opt = optimizer.SGD(learning_rate=0.1, wd=0.0)
+    opt.rescale_grad = 0.5
+    got, _ = _step(opt)
+    onp.testing.assert_allclose(got, W0 - 0.1 * 0.5 * G0, rtol=1e-6)
+
+
+def test_sgd_clip_gradient():
+    opt = optimizer.SGD(learning_rate=1.0, wd=0.0, clip_gradient=0.3)
+    got, _ = _step(opt)
+    onp.testing.assert_allclose(got, W0 - onp.clip(G0, -0.3, 0.3),
+                                rtol=1e-6)
+
+
+def test_nag_step():
+    opt = optimizer.NAG(learning_rate=0.1, momentum=0.9, wd=0.0)
+    got, _ = _step(opt)
+    mom = G0.copy()                       # first step: mom = g
+    onp.testing.assert_allclose(
+        got, W0 - 0.1 * (G0 + 0.9 * mom), rtol=1e-5)
+
+
+def test_adam_first_step_is_lr_sized():
+    opt = optimizer.Adam(learning_rate=0.01, wd=0.0)
+    got, _ = _step(opt)
+    # t=1: m̂=g, v̂=g² → update ≈ lr·sign(g)
+    onp.testing.assert_allclose(got, W0 - 0.01 * onp.sign(G0), rtol=1e-3)
+
+
+def test_adamw_decouples_wd():
+    opt_w = optimizer.AdamW(learning_rate=0.01, wd=0.1)
+    got_w, _ = _step(opt_w)
+    # decoupled: w -= lr*wd*w ON TOP of the adam step (wd not in grad)
+    opt0 = optimizer.AdamW(learning_rate=0.01, wd=0.0)
+    got0, _ = _step(opt0)
+    onp.testing.assert_allclose(got_w, got0 - 0.01 * 0.1 * W0, rtol=1e-4,
+                                atol=1e-6)
+
+
+def test_rmsprop_step():
+    opt = optimizer.RMSProp(learning_rate=0.01, wd=0.0)
+    got, _ = _step(opt)
+    assert not onp.allclose(got, W0)
+    assert onp.isfinite(got).all()
+
+
+def test_adagrad_accumulates():
+    opt = optimizer.AdaGrad(learning_rate=0.1, wd=0.0)
+    got1, _ = _step(opt, n=1)
+    got2, _ = _step(opt, n=2)
+    # second step moves LESS per step (history grows)
+    step1 = onp.abs(W0 - got1)
+    step2 = onp.abs(got1 - got2)
+    assert (step2 <= step1 + 1e-7).all()
+
+
+def test_adadelta_runs():
+    got, _ = _step(optimizer.AdaDelta(wd=0.0), n=3)
+    assert onp.isfinite(got).all()
+    assert not onp.allclose(got, W0)
+
+
+def test_adamax_step():
+    got, _ = _step(optimizer.Adamax(learning_rate=0.01, wd=0.0))
+    onp.testing.assert_allclose(got, W0 - 0.01 * onp.sign(G0), rtol=1e-3)
+
+
+def test_nadam_runs():
+    got, _ = _step(optimizer.Nadam(learning_rate=0.01, wd=0.0), n=2)
+    assert onp.isfinite(got).all()
+
+
+def test_ftrl_sparsifies():
+    opt = optimizer.Ftrl(learning_rate=0.5, lamda1=10.0, wd=0.0)
+    got, _ = _step(opt, n=2)
+    # huge l1 drives weights to exactly zero
+    onp.testing.assert_allclose(got, onp.zeros_like(W0), atol=1e-6)
+
+
+def test_signum_uses_sign():
+    opt = optimizer.Signum(learning_rate=0.1, momentum=0.0, wd=0.0)
+    got, _ = _step(opt)
+    onp.testing.assert_allclose(got, W0 - 0.1 * onp.sign(G0), rtol=1e-6)
+
+
+def test_lars_layerwise_scaling():
+    opt = optimizer.LARS(learning_rate=0.1, wd=0.0)
+    got, _ = _step(opt)
+    assert onp.isfinite(got).all()
+    assert not onp.allclose(got, W0)
+
+
+def test_lamb_runs():
+    opt = optimizer.LAMB(learning_rate=0.01, wd=0.01)
+    got, _ = _step(opt, n=2)
+    assert onp.isfinite(got).all()
+
+
+def test_sgld_injects_noise():
+    mx.random.seed(0)
+    opt = optimizer.SGLD(learning_rate=0.01, wd=0.0)
+    got1, _ = _step(opt)
+    mx.random.seed(1)
+    got2, _ = _step(opt)
+    assert not onp.allclose(got1, got2)   # stochastic updates differ
+
+
+def test_lr_scheduler_applied():
+    from incubator_mxnet_tpu import lr_scheduler
+
+    sched = lr_scheduler.FactorScheduler(step=1, factor=0.5, base_lr=0.2)
+    opt = optimizer.SGD(learning_rate=0.2, wd=0.0, lr_scheduler=sched)
+    w = NDArray(onp.array(W0))
+    s = opt.create_state(0, w)
+    opt.update(0, w, NDArray(onp.array(G0)), s)
+    lr1_w = w.asnumpy().copy()
+    exp1 = W0 - 0.2 * G0                  # num_update=1 → base lr
+    onp.testing.assert_allclose(lr1_w, exp1, rtol=1e-5)
+
+
+def test_lr_mult_via_param_dict():
+    opt = optimizer.SGD(learning_rate=0.1, wd=0.0)
+    opt.param_dict = {}
+    opt.set_lr_mult({0: 0.5})
+    got, _ = _step(opt)
+    onp.testing.assert_allclose(got, W0 - 0.05 * G0, rtol=1e-5)
+
+
+def test_wd_mult():
+    opt = optimizer.SGD(learning_rate=0.1, wd=0.1)
+    opt.set_wd_mult({0: 0.0})             # kill wd for this index
+    got, _ = _step(opt)
+    onp.testing.assert_allclose(got, W0 - 0.1 * G0, rtol=1e-5)
+
+
+def test_multi_precision_fp16_master():
+    import jax.numpy as jnp
+
+    opt = optimizer.SGD(learning_rate=0.1, momentum=0.9,
+                        multi_precision=True, wd=0.0)
+    w16 = NDArray(jnp.asarray(W0, jnp.float16))
+    state = opt.create_state_multi_precision(0, w16)
+    assert isinstance(state, tuple)        # (fp32 master, inner state)
+    opt.update_multi_precision(0, w16, NDArray(jnp.asarray(G0, jnp.float16)),
+                               state)
+    onp.testing.assert_allclose(
+        onp.asarray(w16.asnumpy(), "float32"), W0 - 0.1 * G0, rtol=1e-3)
+
+
+def test_create_optimizer_registry():
+    for name in ("sgd", "adam", "rmsprop", "adagrad", "nag", "signum"):
+        opt = optimizer.create(name, learning_rate=0.1)
+        assert isinstance(opt, optimizer.Optimizer)
+
+
+def test_get_updater_applies():
+    opt = optimizer.SGD(learning_rate=0.1, wd=0.0)
+    upd = optimizer.get_updater(opt)
+    w = NDArray(onp.array(W0))
+    upd(0, NDArray(onp.array(G0)), w)
+    onp.testing.assert_allclose(w.asnumpy(), W0 - 0.1 * G0, rtol=1e-6)
+
+
+def test_updater_states_roundtrip():
+    opt = optimizer.SGD(learning_rate=0.1, momentum=0.9, wd=0.0)
+    upd = optimizer.get_updater(opt)
+    w = NDArray(onp.array(W0))
+    upd(0, NDArray(onp.array(G0)), w)
+    blob = upd.get_states()
+    upd2 = optimizer.get_updater(optimizer.SGD(learning_rate=0.1,
+                                               momentum=0.9, wd=0.0))
+    upd2.set_states(blob)
+    w2 = NDArray(w.asnumpy())
+    upd(0, NDArray(onp.array(G0)), w)
+    upd2(0, NDArray(onp.array(G0)), w2)
+    onp.testing.assert_allclose(w.asnumpy(), w2.asnumpy(), rtol=1e-6)
+
+
+def test_num_update_counts_per_index():
+    opt = optimizer.SGD(learning_rate=0.1, wd=0.0)
+    _step(opt, n=3)
+    assert opt.num_update == 3

@@ -1,0 +1,256 @@
+"""Random-distribution depth round 2: per-distribution moment checks at
+float32 AND bfloat16, shape/dtype contracts, seed independence across
+draws, and the npx.random sample-op surface (reference:
+`src/operator/numpy/random/` + `tests/python/unittest/test_numpy_op.py`
+random blocks)."""
+import numpy as onp
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import np
+
+N = 200_000
+
+
+def _moments(name, args, mean, var, mtol, vtol, kwargs=None):
+    mx.random.seed(42)
+    fn = getattr(np.random, name)
+    x = fn(*args, size=(N,), **(kwargs or {})).asnumpy()
+    assert x.shape == (N,)
+    onp.testing.assert_allclose(x.mean(), mean, atol=mtol)
+    onp.testing.assert_allclose(x.var(), var, atol=vtol)
+
+
+# -- moments, one test per distribution --------------------------------------
+
+def test_uniform_custom_range_moments():
+    _moments("uniform", (-3.0, 5.0), 1.0, 64 / 12.0, 0.05, 0.2)
+
+
+def test_normal_custom_moments():
+    _moments("normal", (2.0, 3.0), 2.0, 9.0, 0.05, 0.25)
+
+
+def test_lognormal_moments():
+    m = onp.exp(0.5 * 0.25)
+    v = (onp.exp(0.25) - 1) * onp.exp(0.25)
+    _moments("lognormal", (0.0, 0.5), m, v, 0.05, 0.15)
+
+
+def test_exponential_scale_moments():
+    _moments("exponential", (2.0,), 2.0, 4.0, 0.05, 0.25)
+
+
+def test_gamma_shape_scale_moments():
+    _moments("gamma", (3.0, 2.0), 6.0, 12.0, 0.1, 0.6)
+
+
+def test_beta_ab_moments():
+    a, b = 2.0, 5.0
+    mean = a / (a + b)
+    var = a * b / ((a + b) ** 2 * (a + b + 1))
+    _moments("beta", (a, b), mean, var, 0.01, 0.01)
+
+
+def test_chisquare_moments():
+    _moments("chisquare", (4.0,), 4.0, 8.0, 0.1, 0.6)
+
+
+def test_poisson_lam_moments():
+    _moments("poisson", (7.0,), 7.0, 7.0, 0.1, 0.4)
+
+
+def test_geometric_moments():
+    p = 0.3
+    got = None
+    mx.random.seed(1)
+    got = np.random.geometric(p, size=(N,)).asnumpy()
+    onp.testing.assert_allclose(got.mean(), 1 / p, atol=0.1)
+
+
+def test_laplace_loc_scale_moments():
+    _moments("laplace", (1.0, 2.0), 1.0, 8.0, 0.08, 0.6)
+
+
+def test_gumbel_moments():
+    mu, beta = 0.5, 1.5
+    mean = mu + beta * 0.5772156649
+    var = (onp.pi ** 2 / 6) * beta ** 2
+    _moments("gumbel", (mu, beta), mean, var, 0.05, 0.3)
+
+
+def test_logistic_moments():
+    mu, s = 0.0, 1.0
+    _moments("logistic", (mu, s), mu, (onp.pi ** 2 / 3) * s ** 2,
+             0.05, 0.3)
+
+
+def test_pareto_mean():
+    a = 4.0
+    mx.random.seed(5)
+    x = np.random.pareto(a, size=(N,)).asnumpy()
+    onp.testing.assert_allclose(x.mean(), 1 / (a - 1), atol=0.05)
+
+
+def test_power_moments():
+    a = 3.0
+    mx.random.seed(6)
+    x = np.random.power(a, size=(N,)).asnumpy()
+    onp.testing.assert_allclose(x.mean(), a / (a + 1), atol=0.02)
+
+
+def test_rayleigh_moments():
+    s = 2.0
+    mx.random.seed(7)
+    x = np.random.rayleigh(s, size=(N,)).asnumpy()
+    onp.testing.assert_allclose(x.mean(), s * onp.sqrt(onp.pi / 2),
+                                atol=0.05)
+
+
+def test_weibull_mean():
+    import math
+
+    a = 1.5
+    mx.random.seed(8)
+    x = np.random.weibull(a, size=(N,)).asnumpy()
+    onp.testing.assert_allclose(x.mean(), math.gamma(1 + 1 / a), atol=0.05)
+
+
+# -- shape / dtype contracts -------------------------------------------------
+
+def test_size_none_returns_scalar():
+    mx.random.seed(0)
+    x = np.random.uniform(0.0, 1.0)
+    assert x.shape == ()
+
+
+def test_size_tuple_shapes():
+    for size in ((3,), (2, 4), (2, 3, 4)):
+        x = np.random.normal(0.0, 1.0, size=size)
+        assert x.shape == size
+
+
+def test_randn_shape():
+    x = np.random.randn(3, 4)
+    assert x.shape == (3, 4)
+
+
+def test_rand_unit_interval():
+    mx.random.seed(3)
+    x = np.random.rand(5, 5).asnumpy()
+    assert (x >= 0).all() and (x < 1).all()
+
+
+def test_randint_dtype_and_range():
+    mx.random.seed(4)
+    x = np.random.randint(5, 15, (10_000,)).asnumpy()
+    assert x.min() >= 5 and x.max() < 15
+    assert onp.issubdtype(x.dtype, onp.integer)
+
+
+def test_choice_without_replacement_unique():
+    mx.random.seed(9)
+    x = np.random.choice(20, size=(20,), replace=False).asnumpy()
+    assert len(onp.unique(x)) == 20
+
+
+def test_choice_with_probabilities():
+    mx.random.seed(10)
+    p = onp.array([0.8, 0.2, 0.0, 0.0], "float32")
+    x = np.random.choice(4, size=(N,), p=np.array(p)).asnumpy()
+    counts = onp.bincount(x.astype("int64"), minlength=4) / N
+    onp.testing.assert_allclose(counts, p, atol=0.02)
+
+
+def test_permutation_int():
+    mx.random.seed(11)
+    x = np.random.permutation(16).asnumpy()
+    onp.testing.assert_array_equal(onp.sort(x), onp.arange(16))
+
+
+def test_permutation_array_permutes_rows():
+    a = onp.arange(12, dtype="float32").reshape(6, 2)
+    mx.random.seed(12)
+    x = np.random.permutation(np.array(a)).asnumpy()
+    onp.testing.assert_array_equal(
+        onp.sort(x.reshape(-1)), onp.sort(a.reshape(-1)))
+
+
+def test_normal_bf16_dtype_and_moments():
+    mx.random.seed(13)
+    x = np.random.normal(0.0, 1.0, size=(N,), dtype="bfloat16")
+    assert "bfloat16" in str(x.dtype)
+    xv = x.astype("float32").asnumpy()
+    onp.testing.assert_allclose(xv.mean(), 0.0, atol=0.05)
+    onp.testing.assert_allclose(xv.var(), 1.0, atol=0.1)
+
+
+def test_uniform_bf16_range():
+    mx.random.seed(14)
+    x = np.random.uniform(-1.0, 1.0, size=(N,), dtype="bfloat16")
+    xv = x.astype("float32").asnumpy()
+    assert xv.min() >= -1.0 and xv.max() <= 1.0
+
+
+# -- stream independence / reproducibility -----------------------------------
+
+def test_consecutive_draws_differ():
+    mx.random.seed(15)
+    a = np.random.normal(0.0, 1.0, size=(64,)).asnumpy()
+    b = np.random.normal(0.0, 1.0, size=(64,)).asnumpy()
+    assert not onp.allclose(a, b)
+
+
+def test_reseed_reproduces_sequence():
+    mx.random.seed(16)
+    seq1 = [np.random.uniform(size=(8,)).asnumpy() for _ in range(3)]
+    mx.random.seed(16)
+    seq2 = [np.random.uniform(size=(8,)).asnumpy() for _ in range(3)]
+    for a, b in zip(seq1, seq2):
+        onp.testing.assert_array_equal(a, b)
+
+
+def test_different_seeds_differ():
+    mx.random.seed(17)
+    a = np.random.uniform(size=(64,)).asnumpy()
+    mx.random.seed(18)
+    b = np.random.uniform(size=(64,)).asnumpy()
+    assert not onp.allclose(a, b)
+
+
+def test_independent_shapes_share_stream():
+    """Different-shape draws advance the same global stream — no
+    cross-shape correlation (reference: seedable global RNG)."""
+    mx.random.seed(19)
+    a = np.random.uniform(size=(100,)).asnumpy()
+    mx.random.seed(19)
+    b = np.random.uniform(size=(100, 1)).asnumpy().reshape(-1)
+    onp.testing.assert_array_equal(a, b)  # same first draw, same stream
+
+
+# -- legacy mx.nd.random surface ---------------------------------------------
+
+def test_legacy_nd_random_uniform():
+    from incubator_mxnet_tpu import nd
+
+    mx.random.seed(20)
+    x = nd.random.uniform(-2.0, 2.0, shape=(1000,))
+    xv = x.asnumpy()
+    assert (xv >= -2.0).all() and (xv < 2.0).all()
+
+
+def test_legacy_nd_random_normal():
+    from incubator_mxnet_tpu import nd
+
+    mx.random.seed(21)
+    x = nd.random.normal(0.0, 1.0, shape=(50_000,)).asnumpy()
+    onp.testing.assert_allclose(x.mean(), 0.0, atol=0.05)
+
+
+def test_legacy_nd_sample_multinomial():
+    from incubator_mxnet_tpu import nd
+
+    mx.random.seed(22)
+    probs = nd.array(onp.array([0.1, 0.9], "float32"))
+    s = nd.sample_multinomial(probs, shape=10_000).asnumpy()
+    assert abs(s.mean() - 0.9) < 0.02
