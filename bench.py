@@ -237,8 +237,10 @@ def bench_resnet50_infer(batch=64, iters=20, warmup=2, int8=False):
     """images/sec inference, fp32 or post-training INT8 (BASELINE.json
     config 5: 'INT8 quantized ResNet inference ... on TPU int8 matmul').
     batch 64 = the serving shape of the reference's quantization README;
-    int8 runs with conv+BN folding and requantize chaining (measured
-    1.70x fp32 at batch 64 on one v5e chip)."""
+    int8 runs with conv+BN folding and requantize chaining. The stable
+    statistic is the SAME-process int8/fp32 ratio (2.56-2.69x across
+    round-4 runs); absolute img/s varies with the tunnel (see
+    _bench_input_pipeline_subprocess note)."""
     from incubator_mxnet_tpu import np
     from incubator_mxnet_tpu.gluon.model_zoo.vision import resnet50_v1
 
@@ -295,18 +297,24 @@ def bench_gpt_decode(batch=8, prompt=32, new=224, iters=3):
     full = np.array(rng.randint(
         0, 32000, (batch, prompt + new)).astype("int32"))
     net(full).asnumpy()                     # warm the eager funnel
-    t0 = time.perf_counter()
-    net(full).asnumpy()
-    loop_tokens_s = batch / (time.perf_counter() - t0)
+    best = float("inf")
+    for _ in range(3):                      # min-of-3: tunnel latency
+        t0 = time.perf_counter()            # spikes would otherwise
+        net(full).asnumpy()                 # inflate the ratio 10x+
+        best = min(best, time.perf_counter() - t0)
+    loop_tokens_s = batch / best
     return tokens_s, tokens_s / loop_tokens_s
 
 
 def _bench_input_pipeline_subprocess():
     """Run the input-pipeline bench in its OWN process: the host has one
     CPU core, so its cv2-decode/prefetch thread pool and the main
-    process's jax dispatch threads poison each other's numbers in either
-    order (round 3 measured fp32 inference 2365 img/s contended vs 4772
-    clean). A subprocess isolates both directions."""
+    process's jax dispatch threads can contend in either direction. NOTE
+    on variance: controlled A/B runs (round 4) showed the tunneled chip's
+    throughput itself drifts run-to-run (fp32 inference measured
+    2.1-4.8k img/s for the identical workload at different times), so
+    cross-round comparisons of serving numbers carry that error bar —
+    only SAME-process ratios (e.g. int8/fp32) are stable."""
     import subprocess
 
     out = subprocess.run(

@@ -57,8 +57,6 @@ _LEGACY_TO_NPX = {
 
 # legacy names resolving to np-namespace ops under a different name
 _LEGACY_TO_NP = {
-    "Concat": "concatenate",
-    "concat": "concatenate",
     "Reshape": "reshape",
     "ElementWiseSum": "add_n",
     "SwapAxis": "swapaxes",
@@ -87,6 +85,60 @@ def add_n(*args):
     for a in args[1:]:
         out = _np.add(out, a)
     return out
+
+
+def concat(*args, dim=0, **kwargs):  # noqa: ARG001
+    """Legacy varargs Concat (reference `mx.nd.Concat(*arrays, dim=)`)."""
+    from .. import numpy as _np
+
+    arrays = args[0] if len(args) == 1 and isinstance(args[0],
+                                                      (list, tuple)) else args
+    return _np.concatenate(list(arrays), axis=dim)
+
+
+Concat = concat
+
+
+def stack(*args, axis=0, **kwargs):  # noqa: ARG001
+    """Legacy varargs stack (reference `mx.nd.stack(*arrays, axis=)`)."""
+    from .. import numpy as _np
+
+    arrays = args[0] if len(args) == 1 and isinstance(args[0],
+                                                      (list, tuple)) else args
+    return _np.stack(list(arrays), axis=axis)
+
+
+def SwapAxis(data, dim1=0, dim2=0, **kwargs):  # noqa: N802, ARG001
+    """Legacy SwapAxis with dim1/dim2 kwargs (reference swapaxes op)."""
+    from .. import numpy as _np
+
+    return _np.swapaxes(data, dim1, dim2)
+
+
+swapaxes = SwapAxis
+
+
+def take(a, indices, axis=0, mode="clip", **kwargs):  # noqa: ARG001
+    """Legacy nd.take: axis defaults to 0 (row gather — reference
+    `src/operator/tensor/indexing_op.h` TakeParam), unlike numpy's
+    flattening default."""
+    arr = a if isinstance(a, NDArray) else NDArray(a)
+    return arr.take(indices if isinstance(indices, NDArray)
+                    else NDArray(indices), axis=axis, mode=mode)
+
+
+def norm(data, ord=2, axis=None, keepdims=False, **kwargs):  # noqa: A002, ARG001
+    """Legacy nd.norm — ENTRYWISE L-p reduction (reference:
+    `src/operator/tensor/broadcast_reduce_op_value.cc` norm — never the
+    matrix/operator norms jnp.linalg.norm computes for 2-D inputs)."""
+    from .. import numpy as _np
+
+    if ord == 1:
+        return _np.sum(_np.abs(data), axis=axis, keepdims=keepdims)
+    if ord == 2:
+        return _np.sqrt(_np.sum(_np.square(data), axis=axis,
+                                keepdims=keepdims))
+    raise ValueError(f"nd.norm supports ord 1 or 2, got {ord!r}")
 
 
 def sample_multinomial(data, shape=None, get_prob=False, dtype="int32"):

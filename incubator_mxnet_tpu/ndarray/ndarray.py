@@ -315,6 +315,18 @@ class NDArray:
     def argsort(self, axis=-1):
         return apply_op("argsort", lambda x: _jnp().argsort(x, axis=axis), (self,))
 
+    def square(self):
+        return apply_op("square", lambda x: x * x, (self,))
+
+    def slice_axis(self, axis=0, begin=0, end=None):
+        """Slice along ONE axis (reference `mx.nd.slice_axis`)."""
+        def f(x):
+            idx = [slice(None)] * x.ndim
+            idx[axis] = slice(begin, end)
+            return x[tuple(idx)]
+
+        return apply_op("slice_axis", f, (self,))
+
     def sort(self, axis=-1):
         return apply_op("sort", lambda x: _jnp().sort(x, axis=axis), (self,))
 
@@ -339,8 +351,15 @@ class NDArray:
                                                              keepdims=keepdims), (self,))
 
     def take(self, indices, axis=None, mode="clip"):
-        return apply_op("take", lambda x, i: _jnp().take(x, i, axis=axis, mode=mode),
-                        (self, indices))
+        # legacy surface: index arrays default to float32 (reference mx.nd
+        # semantics) — cast to integer for the gather
+        def f(x, i):
+            jnp = _jnp()
+            if not jnp.issubdtype(i.dtype, jnp.integer):
+                i = i.astype(jnp.int32)
+            return jnp.take(x, i, axis=axis, mode=mode)
+
+        return apply_op("take", f, (self, indices))
 
     def zeros_like(self):
         return NDArray(_jnp().zeros_like(self._data))

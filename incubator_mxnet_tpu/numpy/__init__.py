@@ -304,17 +304,11 @@ def put_along_axis(arr, indices, values, axis):
         def f(x, idx):
             return _jnp().put_along_axis(x, idx.astype(idx_dt), values,
                                          axis, inplace=False)
-    if big:
-        import contextlib
+    import contextlib
 
-        import jax
+    import jax
 
-        cm = jax.enable_x64(True)
-    else:
-        import contextlib
-
-        cm = contextlib.nullcontext()
-    with cm:
+    with jax.enable_x64(True) if big else contextlib.nullcontext():
         out = apply_op_flat("put_along_axis", f, tuple(args))
     arr._adopt(out)
     return None

@@ -201,6 +201,13 @@ def softmax(data, axis=-1, length=None, temperature=None, use_length=False,
                     static_info={"axis": axis})
 
 
+def batch_flatten(data, **kwargs):  # noqa: ARG001
+    """Collapse all non-batch dims to 2-D (reference `Flatten` op,
+    `src/operator/tensor/matrix_op.cc` — output (batch, -1))."""
+    return apply_op("batch_flatten",
+                    lambda x: x.reshape(x.shape[0], -1), (data,))
+
+
 def softmin(data, axis=-1, temperature=None, dtype=None, **kwargs):  # noqa: ARG001
     """softmax of the negated input (reference: `src/operator/nn/softmax.cc`
     softmin registration)."""

@@ -13,7 +13,11 @@ from ..ndarray.ndarray import NDArray, apply_op
 
 __all__ = ["to_tensor", "normalize", "resize", "crop", "flip_left_right",
            "flip_top_bottom", "random_flip_left_right",
-           "random_flip_top_bottom", "random_crop", "random_resized_crop"]
+           "random_flip_top_bottom"]
+# NOTE: random_crop / random_size_crop stay the IMPERATIVE helpers
+# (`incubator_mxnet_tpu.image`) in the merged npx.image namespace — their
+# (src, size) signature predates this module and shadowing it with the
+# reference op's (data, xrange, ...) form silently mis-parsed old calls.
 
 
 def _jnp():
@@ -49,12 +53,15 @@ def normalize(data, mean=0.0, std=1.0):
 
 
 def resize(data, size, keep_ratio=False, interp=1):  # noqa: ARG001
-    """Resize (H, W, C) to `size` — int (short edge when keep_ratio, else
-    square) or (w, h) tuple (the reference's cv2 convention)."""
+    """Resize (H, W, C) or batched (N, H, W, C) to `size` — int (short
+    edge when keep_ratio, else square) or (w, h) tuple (the reference's
+    cv2 convention)."""
     import jax
 
     jnp = _jnp()
-    h, w = int(data.shape[0]), int(data.shape[1])
+    batched = data.ndim == 4
+    h_ax = 1 if batched else 0
+    h, w = int(data.shape[h_ax]), int(data.shape[h_ax + 1])
     if isinstance(size, int):
         if keep_ratio:
             if h < w:
@@ -67,8 +74,9 @@ def resize(data, size, keep_ratio=False, interp=1):  # noqa: ARG001
         new_w, new_h = int(size[0]), int(size[1])
 
     def f(x):
-        y = jax.image.resize(x.astype(jnp.float32),
-                             (new_h, new_w) + tuple(x.shape[2:]),
+        shape = ((x.shape[0], new_h, new_w) + tuple(x.shape[3:])) \
+            if batched else ((new_h, new_w) + tuple(x.shape[2:]))
+        y = jax.image.resize(x.astype(jnp.float32), shape,
                              method="bilinear")
         return jnp.clip(jnp.rint(y), 0, 255).astype(x.dtype) \
             if jnp.issubdtype(x.dtype, jnp.integer) else y.astype(x.dtype)
@@ -109,22 +117,3 @@ def random_flip_top_bottom(data, p=0.5):
         (data if isinstance(data, NDArray) else NDArray(data))
 
 
-def random_crop(data, xrange=(0.0, 1.0), yrange=(0.0, 1.0), width=1,
-                height=1, **kwargs):  # noqa: ARG001
-    """Random (width, height) crop; returns (cropped, (x0, y0, w, h)) like
-    the imperative helper."""
-    from ..image import random_crop as _rc
-
-    return _rc(data if isinstance(data, NDArray) else NDArray(data),
-               (width, height))
-
-
-def random_resized_crop(data, size, area=(0.08, 1.0),
-                        ratio=(3 / 4, 4 / 3), **kwargs):  # noqa: ARG001
-    from ..image import random_size_crop as _rsc
-
-    if isinstance(size, int):
-        size = (size, size)
-    out, _ = _rsc(data if isinstance(data, NDArray) else NDArray(data),
-                  size, area, ratio)
-    return out

@@ -1,0 +1,292 @@
+"""Autograd depth: recording scopes, train/predict modes, custom
+Functions, head gradients, retain/create_graph, multi-output and
+mutation interactions (reference: `tests/python/unittest/
+test_autograd.py` + `test_higher_order_grad.py` patterns)."""
+import numpy as onp
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import autograd, np
+
+RNG = onp.random.RandomState(43)
+
+
+def _a(*shape):
+    return np.array(RNG.uniform(0.5, 2.0, shape).astype("float32"))
+
+
+# -- recording scopes --------------------------------------------------------
+
+def test_no_record_no_grad():
+    x = _a(3)
+    x.attach_grad()
+    y = (x * x).sum()
+    with pytest.raises(Exception):
+        y.backward()
+
+
+def test_is_recording_flag():
+    assert not autograd.is_recording()
+    with autograd.record():
+        assert autograd.is_recording()
+    assert not autograd.is_recording()
+
+
+def test_is_training_flag():
+    with autograd.record():
+        assert autograd.is_training()
+    with autograd.record(train_mode=False):
+        assert not autograd.is_training()
+
+
+def test_pause_stops_taping():
+    x = _a(3)
+    x.attach_grad()
+    with autograd.record():
+        y = x * 2
+        with autograd.pause():
+            z = y * 10          # not taped
+        w = (y + z).sum()
+    w.backward()
+    # dz/dx contributes nothing: grad = d(y)/dx = 2
+    onp.testing.assert_allclose(x.grad.asnumpy(), 2.0, rtol=1e-6)
+
+
+def test_train_mode_inside_predict():
+    with autograd.record(train_mode=False):
+        with autograd.train_mode():
+            assert autograd.is_training()
+        assert not autograd.is_training()
+
+
+def test_predict_mode_scope():
+    with autograd.record():
+        with autograd.predict_mode():
+            assert not autograd.is_training()
+        assert autograd.is_training()
+
+
+# -- backward mechanics ------------------------------------------------------
+
+def test_head_gradient():
+    x = _a(3)
+    x.attach_grad()
+    with autograd.record():
+        y = x * x
+    y.backward(np.array(onp.array([1.0, 2.0, 3.0], "float32")))
+    onp.testing.assert_allclose(
+        x.grad.asnumpy(),
+        2 * x.asnumpy() * onp.array([1.0, 2.0, 3.0]), rtol=1e-5)
+
+
+def test_backward_twice_without_retain_fresh_graphs():
+    x = _a(3)
+    x.attach_grad()
+    for _ in range(2):           # two separate records: both must work
+        with autograd.record():
+            y = (x * x).sum()
+        y.backward()
+    onp.testing.assert_allclose(x.grad.asnumpy(), 2 * x.asnumpy(),
+                                rtol=1e-5)
+
+
+def test_grad_add_accumulates_across_backwards():
+    x = _a(3)
+    x.attach_grad(grad_req="add")
+    with autograd.record():
+        y = (x * 2).sum()
+    y.backward()
+    with autograd.record():
+        z = (x * 3).sum()
+    z.backward()
+    onp.testing.assert_allclose(x.grad.asnumpy(), 5.0, rtol=1e-5)
+
+
+def test_multi_output_op_backward():
+    x = _a(4)
+    x.attach_grad()
+    with autograd.record():
+        a, b = np.split(x, 2)
+        s = (a * 2).sum() + (b * 3).sum()
+    s.backward()
+    onp.testing.assert_allclose(x.grad.asnumpy(), [2, 2, 3, 3], rtol=1e-5)
+
+
+def test_diamond_graph_sums_paths():
+    x = _a(3)
+    x.attach_grad()
+    with autograd.record():
+        y = x * 2
+        z = (y + y * y).sum()    # two paths through y
+    z.backward()
+    ref = 2 + 8 * x.asnumpy()    # d/dx (2x + 4x²)
+    onp.testing.assert_allclose(x.grad.asnumpy(), ref, rtol=1e-5)
+
+
+def test_grad_of_intermediate_via_autograd_grad():
+    x = _a(3)
+    x.attach_grad()
+    with autograd.record():
+        y = x * x
+        g = autograd.grad(y.sum(), [x], create_graph=False)[0]
+    onp.testing.assert_allclose(g.asnumpy(), 2 * x.asnumpy(), rtol=1e-5)
+
+
+def test_higher_order_via_create_graph():
+    x = _a(3)
+    x.attach_grad()
+    with autograd.record():
+        y = (x ** 3).sum()
+        gx = autograd.grad(y, [x], create_graph=True)[0]
+        s = gx.sum()
+    s.backward()
+    onp.testing.assert_allclose(x.grad.asnumpy(), 6 * x.asnumpy(),
+                                rtol=1e-4)
+
+
+def test_stop_gradient_detach():
+    x = _a(3)
+    x.attach_grad()
+    with autograd.record():
+        y = x * 2
+        d = y.detach()
+        z = (d * x).sum()
+    z.backward()
+    onp.testing.assert_allclose(x.grad.asnumpy(), 2 * x.asnumpy(),
+                                rtol=1e-5)
+
+
+# -- custom Function ---------------------------------------------------------
+
+def test_custom_function_fwd_bwd():
+    class Square(autograd.Function):
+        def forward(self, x):
+            self.save_for_backward(x)
+            return x * x
+
+        def backward(self, dy):
+            (x,) = self.saved_tensors
+            return 2 * x * dy
+
+    x = _a(4)
+    x.attach_grad()
+    f = Square()
+    with autograd.record():
+        y = f(x).sum()
+    y.backward()
+    onp.testing.assert_allclose(x.grad.asnumpy(), 2 * x.asnumpy(),
+                                rtol=1e-5)
+
+
+def test_custom_function_multi_input():
+    class Mul(autograd.Function):
+        def forward(self, a, b):
+            self.save_for_backward(a, b)
+            return a * b
+
+        def backward(self, dy):
+            a, b = self.saved_tensors
+            return dy * b, dy * a
+
+    a, b = _a(3), _a(3)
+    a.attach_grad()
+    b.attach_grad()
+    with autograd.record():
+        y = Mul()(a, b).sum()
+    y.backward()
+    onp.testing.assert_allclose(a.grad.asnumpy(), b.asnumpy(), rtol=1e-5)
+    onp.testing.assert_allclose(b.grad.asnumpy(), a.asnumpy(), rtol=1e-5)
+
+
+# -- mutation interactions ---------------------------------------------------
+
+def test_setitem_then_backward():
+    x = _a(4)
+    x.attach_grad()
+    with autograd.record():
+        y = x * 3
+        s = y.sum()
+    # mutate x AFTER the graph is built; grads still flow to the old value
+    s.backward()
+    onp.testing.assert_allclose(x.grad.asnumpy(), 3.0, rtol=1e-6)
+
+
+def test_inplace_add_outside_record():
+    x = _a(3)
+    x.attach_grad()
+    x += 1.0                      # eager mutation, no tape
+    with autograd.record():
+        y = (x * x).sum()
+    y.backward()
+    onp.testing.assert_allclose(x.grad.asnumpy(), 2 * x.asnumpy(),
+                                rtol=1e-5)
+
+
+# -- shape/dtype propagation through grads -----------------------------------
+
+def test_grad_dtype_matches_input():
+    x = _a(3).astype("float16")
+    x.attach_grad()
+    with autograd.record():
+        y = (x * x).sum()
+    y.backward()
+    assert "float16" in str(x.grad.dtype)
+
+
+def test_grad_through_reshape_transpose():
+    x = _a(2, 6)
+    x.attach_grad()
+    with autograd.record():
+        y = x.reshape(3, 4).T
+        s = (y * 2).sum()
+    s.backward()
+    onp.testing.assert_allclose(x.grad.asnumpy(), 2.0, rtol=1e-6)
+
+
+def test_grad_through_concat():
+    a, b = _a(2, 3), _a(2, 3)
+    a.attach_grad()
+    b.attach_grad()
+    with autograd.record():
+        y = np.concatenate([a * 1.0, b * 2.0], axis=0).sum()
+    y.backward()
+    onp.testing.assert_allclose(a.grad.asnumpy(), 1.0, rtol=1e-6)
+    onp.testing.assert_allclose(b.grad.asnumpy(), 2.0, rtol=1e-6)
+
+
+def test_grad_through_broadcasting_chain():
+    a = _a(1, 4)
+    b = _a(3, 1)
+    a.attach_grad()
+    b.attach_grad()
+    with autograd.record():
+        y = (a * b).sum()
+    y.backward()
+    onp.testing.assert_allclose(a.grad.asnumpy(),
+                                onp.full((1, 4), b.asnumpy().sum()),
+                                rtol=1e-5)
+    onp.testing.assert_allclose(b.grad.asnumpy(),
+                                onp.full((3, 1), a.asnumpy().sum()),
+                                rtol=1e-5)
+
+
+def test_mark_variables_api():
+    x = np.array(onp.ones(3, "float32"))
+    g = np.zeros((3,))
+    autograd.mark_variables([x], [g])
+    with autograd.record():
+        y = (x * 4).sum()
+    y.backward()
+    onp.testing.assert_allclose(x.grad.asnumpy(), 4.0, rtol=1e-6)
+
+
+def test_grad_none_for_untouched_input():
+    x = _a(3)
+    z = _a(3)
+    x.attach_grad()
+    z.attach_grad()
+    with autograd.record():
+        y = (x * 2).sum()         # z not involved
+    y.backward()
+    g = z.grad
+    assert g is None or not g.asnumpy().any()

@@ -1,0 +1,212 @@
+"""Dtype, device, and util-surface depth: casts across every supported
+dtype, device API parity, util switches, DLPack/numpy interop edges
+(reference: `tests/python/unittest/test_ndarray.py` dtype blocks +
+`test_utils`/device tests)."""
+import numpy as onp
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import np
+from incubator_mxnet_tpu.device import Device, cpu, current_device
+
+RNG = onp.random.RandomState(61)
+
+FLOATS = ["float16", "float32", "bfloat16"]
+INTS = ["int8", "int16", "int32", "uint8"]
+
+
+def _a(*shape):
+    return np.array(RNG.uniform(-2, 2, shape).astype("float32"))
+
+
+# -- casts -------------------------------------------------------------------
+
+def test_cast_f32_to_each_float():
+    a = _a(3, 3)
+    for dt in FLOATS:
+        b = a.astype(dt)
+        assert dt in str(b.dtype)
+        onp.testing.assert_allclose(b.astype("float32").asnumpy(),
+                                    a.asnumpy(), rtol=2e-2, atol=2e-2)
+
+
+def test_cast_f32_to_each_int_truncates():
+    a = np.array(onp.array([1.9, -1.9, 100.4], "float32"))
+    for dt in ("int8", "int16", "int32"):
+        b = a.astype(dt).asnumpy()
+        onp.testing.assert_array_equal(b, [1, -1, 100])
+
+
+def test_cast_int_to_float_exact():
+    a = np.array(onp.array([1, -7, 120], "int32"))
+    for dt in ("float16", "float32"):
+        onp.testing.assert_array_equal(a.astype(dt).asnumpy(),
+                                       [1.0, -7.0, 120.0])
+
+
+def test_cast_roundtrip_uint8():
+    a = np.array(onp.array([0, 255, 128], "uint8"))
+    b = a.astype("float32").astype("uint8")
+    onp.testing.assert_array_equal(b.asnumpy(), [0, 255, 128])
+
+
+def test_bool_array_dtype():
+    a = np.array(onp.array([True, False]))
+    assert "bool" in str(a.dtype)
+    assert int(a.sum().asnumpy()) == 1
+
+
+def test_dtype_preserved_through_arithmetic():
+    for dt in ("float16", "float32"):
+        a = _a(2, 2).astype(dt)
+        assert dt in str((a + a).dtype)
+        assert dt in str((a * 2).dtype)
+
+
+def test_arange_dtypes():
+    for dt in ("int32", "float32"):
+        out = np.arange(5, dtype=dt)
+        assert dt in str(out.dtype)
+
+
+def test_zeros_ones_dtypes():
+    for dt in FLOATS + ["int32"]:
+        assert dt in str(np.zeros((2,), dtype=dt).dtype)
+        assert dt in str(np.ones((2,), dtype=dt).dtype)
+
+
+def test_float64_downcasts_to_float32():
+    # jax default config: f64 inputs land as f32 (documented divergence
+    # from the reference's true float64 support)
+    a = np.array(onp.ones((2,), "float64"))
+    assert "float32" in str(a.dtype)
+
+
+# -- device API --------------------------------------------------------------
+
+def test_cpu_device_constructor():
+    d = cpu()
+    assert d.device_type in ("cpu", "tpu")  # platform default may map
+
+
+def test_device_equality_and_repr():
+    assert Device("cpu", 0) == Device("cpu", 0)
+    assert "cpu" in repr(Device("cpu", 0))
+
+
+def test_current_device_exists():
+    assert current_device() is not None
+
+
+def test_array_device_attribute():
+    a = _a(2)
+    assert a.device is not None
+
+
+def test_as_in_context_noop_single_device():
+    a = _a(2, 2)
+    b = a.as_in_context(a.context)
+    onp.testing.assert_array_equal(b.asnumpy(), a.asnumpy())
+
+
+def test_gpu_memory_info_shape():
+    from incubator_mxnet_tpu import device as device_mod
+
+    if not hasattr(device_mod, "gpu_memory_info"):
+        pytest.skip("gpu_memory_info not exposed")
+    free, total = device_mod.gpu_memory_info(0)
+    assert total >= free >= 0
+
+
+# -- util switches -----------------------------------------------------------
+
+def test_np_shape_scope():
+    from incubator_mxnet_tpu import util
+
+    assert util.is_np_shape()          # always-on in the TPU build
+    with util.np_shape(True):
+        assert util.is_np_shape()
+
+
+def test_np_array_scope():
+    from incubator_mxnet_tpu import util
+
+    assert util.is_np_array()
+    util.set_np()
+    assert util.is_np_array()
+
+
+def test_getenv_setenv_roundtrip():
+    from incubator_mxnet_tpu import util
+
+    if not hasattr(util, "getenv"):
+        pytest.skip("env helpers not exposed")
+    util.setenv("MXNET_TEST_ENV_X", "1")
+    assert util.getenv("MXNET_TEST_ENV_X") == "1"
+
+
+# -- interop edges -----------------------------------------------------------
+
+def test_numpy_protocol_ufunc():
+    a = _a(2, 3)
+    out = onp.exp(a)               # __array_ufunc__ path
+    got = out.asnumpy() if hasattr(out, "asnumpy") else onp.asarray(out)
+    onp.testing.assert_allclose(got, onp.exp(a.asnumpy()), rtol=1e-5)
+
+
+def test_numpy_protocol_function():
+    a = _a(2, 3)
+    out = onp.concatenate([a, a])  # __array_function__ path
+    got = out.asnumpy() if hasattr(out, "asnumpy") else onp.asarray(out)
+    assert got.shape == (4, 3)
+
+
+def test_dlpack_roundtrip():
+    a = _a(3, 4)
+    assert hasattr(a, "__dlpack__") and hasattr(a, "__dlpack_device__")
+    import jax.numpy as jnp
+
+    back = jnp.from_dlpack(a)      # protocol-object form (new-style)
+    onp.testing.assert_allclose(onp.asarray(back), a.asnumpy(),
+                                rtol=1e-6)
+
+
+def test_asnumpy_never_aliases_device_value():
+    a = _a(4)
+    n = a.asnumpy()
+    try:
+        n[0] = 999.0               # either read-only (zero-copy view)...
+    except ValueError:
+        return
+    assert float(a.asnumpy()[0]) != 999.0   # ...or a true copy
+
+
+def test_tolist():
+    a = np.array(onp.array([[1.0, 2.0]], "float32"))
+    assert a.tolist() == [[1.0, 2.0]]
+
+
+def test_len_and_iter():
+    a = _a(3, 2)
+    assert len(a) == 3
+    rows = list(a)
+    assert len(rows) == 3 and rows[0].shape == (2,)
+
+
+def test_bool_of_scalar():
+    assert bool(np.array(onp.array(1.0, "float32")))
+    assert not bool(np.array(onp.array(0.0, "float32")))
+
+
+def test_int_float_conversion():
+    a = np.array(onp.array(2.7, "float32"))
+    assert float(a) == pytest.approx(2.7, rel=1e-6)
+    assert int(np.array(onp.array(5, "int32"))) == 5
+
+
+def test_hashable_shapes_api():
+    a = _a(2, 3)
+    assert a.ndim == 2
+    assert a.size == 6
+    assert a.shape == (2, 3)
+    assert a.T.shape == (3, 2)

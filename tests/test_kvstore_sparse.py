@@ -124,3 +124,137 @@ def test_sparse_embedding_step_matches_dense_exactly():
     assert dense.keys() == sp.keys()
     for k in dense:
         onp.testing.assert_array_equal(dense[k], sp[k]), k
+
+
+# ---------------------------------------------------------------------------
+# dense kvstore depth (reference: tests/python/unittest/test_kvstore.py)
+# ---------------------------------------------------------------------------
+
+def test_init_and_pull_single_key():
+    kv = kvstore.create("local")
+    kv.init("w", np.array(onp.full((3,), 2.0, "float32")))
+    out = np.zeros((3,))
+    kv.pull("w", out=out)
+    onp.testing.assert_allclose(A(out), 2.0)
+
+
+def test_init_list_keys():
+    kv = kvstore.create("local")
+    kv.init(["a", "b"], [np.ones((2,)), np.full((2,), 3.0)])
+    oa, ob = np.zeros((2,)), np.zeros((2,))
+    kv.pull(["a", "b"], out=[oa, ob])
+    onp.testing.assert_allclose(A(oa), 1.0)
+    onp.testing.assert_allclose(A(ob), 3.0)
+
+
+def test_push_aggregates_copies_dense():
+    kv = kvstore.create("device")
+    kv.init("g", np.zeros((4,)))
+    kv.push("g", [np.ones((4,)), np.full((4,), 2.0)])
+    out = np.zeros((4,))
+    kv.pull("g", out=out)
+    onp.testing.assert_allclose(A(out), 3.0)
+
+
+def test_pushpull_fused_matches_push_then_pull():
+    kv = kvstore.create("device")
+    g = np.array(onp.arange(4, dtype="float32"))
+    out = np.zeros((4,))
+    kv.pushpull("k", g, out=out)
+    onp.testing.assert_allclose(A(out), A(g))
+
+
+def test_pull_to_multiple_targets():
+    kv = kvstore.create("local")
+    kv.init("w", np.full((2,), 5.0))
+    t1, t2 = np.zeros((2,)), np.zeros((2,))
+    kv.pull("w", out=[t1, t2])
+    onp.testing.assert_allclose(A(t1), 5.0)
+    onp.testing.assert_allclose(A(t2), 5.0)
+
+
+def test_updater_applied_on_push():
+    kv = kvstore.create("local")
+    kv.init("w", np.full((3,), 1.0))
+    seen = []
+
+    def upd(key, grad, weight):
+        seen.append(key)
+        weight -= 0.1 * grad
+
+    kv.set_updater(upd)
+    kv.push("w", np.full((3,), 1.0))
+    out = np.zeros((3,))
+    kv.pull("w", out=out)
+    onp.testing.assert_allclose(A(out), 0.9, rtol=1e-6)
+    assert seen == ["w"]
+
+
+def test_optimizer_on_kvstore_state():
+    from incubator_mxnet_tpu import optimizer
+
+    kv = kvstore.create("local")
+    kv.init("w", np.full((2,), 1.0))
+    kv.set_optimizer(optimizer.SGD(learning_rate=0.5))
+    kv.push("w", np.full((2,), 1.0))
+    kv.push("w", np.full((2,), 1.0))
+    out = np.zeros((2,))
+    kv.pull("w", out=out)
+    onp.testing.assert_allclose(A(out), 0.0, atol=1e-6)
+
+
+def test_broadcast_writes_out():
+    kv = kvstore.create("device")
+    out = np.zeros((3,))
+    kv.broadcast("b", np.full((3,), 4.0), out=out)
+    onp.testing.assert_allclose(A(out), 4.0)
+
+
+def test_save_load_optimizer_states(tmp_path):
+    from incubator_mxnet_tpu import optimizer
+
+    kv = kvstore.create("local")
+    kv.init("w", np.full((2,), 1.0))
+    kv.set_optimizer(optimizer.SGD(learning_rate=0.1, momentum=0.9))
+    kv.push("w", np.ones((2,)))
+    f = str(tmp_path / "states.bin")
+    kv.save_optimizer_states(f)
+    kv2 = kvstore.create("local")
+    cur = np.zeros((2,))
+    kv.pull("w", out=cur)
+    kv2.init("w", cur)          # same WEIGHT as kv (states file holds
+    kv2.set_optimizer(optimizer.SGD(learning_rate=0.1, momentum=0.9))
+    kv2.load_optimizer_states(f)  # ...only the optimizer state)
+    # same state => identical next update
+    kv.push("w", np.ones((2,)))
+    kv2.push("w", np.ones((2,)))
+    o1, o2 = np.zeros((2,)), np.zeros((2,))
+    kv.pull("w", out=o1)
+    kv2.pull("w", out=o2)
+    onp.testing.assert_allclose(A(o1), A(o2), rtol=1e-6)
+
+
+def test_gradient_compression_roundtrip_error_bounded():
+    kv = kvstore.create("device")
+    kv.set_gradient_compression({"type": "2bit", "threshold": 0.5})
+    kv.init("w", np.zeros((64,)))
+    g = np.array(onp.random.RandomState(0).uniform(
+        -1, 1, (64,)).astype("float32"))
+    out = np.zeros((64,))
+    kv.pushpull("w", g, out=out)
+    # quantized: values collapse toward {-t, 0, +t}; error feedback keeps
+    # the long-run average unbiased, single-step error bounded by t
+    assert onp.abs(A(out) - A(g)).max() <= 0.5 + 1e-6
+
+
+def test_type_registry_create_names():
+    for name in ("local", "device", "nccl", "horovod", "byteps"):
+        kv = kvstore.create(name)
+        assert kv is not None
+
+
+def test_invalid_type_raises():
+    import pytest
+
+    with pytest.raises(Exception):
+        kvstore.create("definitely_not_a_store")

@@ -1,0 +1,287 @@
+"""Legacy `mx.nd` namespace depth: CamelCase op aliases, broadcast_*
+family, NDArray methods and conversions (reference:
+`tests/python/unittest/test_ndarray.py` / `test_operator.py` legacy
+surface)."""
+import numpy as onp
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd, np
+
+RNG = onp.random.RandomState(59)
+
+
+def _a(*shape):
+    return nd.array(RNG.uniform(-2, 2, shape).astype("float32"))
+
+
+# -- CamelCase aliases -------------------------------------------------------
+
+def test_fullyconnected_alias():
+    x, w, b = _a(2, 5), _a(3, 5), _a(3)
+    out = nd.FullyConnected(x, w, b, num_hidden=3)
+    onp.testing.assert_allclose(
+        out.asnumpy(), x.asnumpy() @ w.asnumpy().T + b.asnumpy(),
+        rtol=1e-5)
+
+
+def test_activation_alias():
+    x = _a(3, 3)
+    out = nd.Activation(x, act_type="relu").asnumpy()
+    onp.testing.assert_allclose(out, onp.maximum(x.asnumpy(), 0),
+                                rtol=1e-6)
+
+
+def test_convolution_alias():
+    x, w = _a(1, 2, 6, 6), _a(3, 2, 3, 3)
+    out = nd.Convolution(x, w, None, kernel=(3, 3), num_filter=3,
+                         no_bias=True)
+    assert out.shape == (1, 3, 4, 4)
+
+
+def test_pooling_alias():
+    x = _a(1, 2, 4, 4)
+    out = nd.Pooling(x, kernel=(2, 2), stride=(2, 2), pool_type="max")
+    assert out.shape == (1, 2, 2, 2)
+
+
+def test_flatten_alias():
+    assert nd.Flatten(_a(2, 3, 4)).shape == (2, 12)
+
+
+def test_concat_alias():
+    a, b = _a(2, 3), _a(2, 3)
+    out = nd.Concat(a, b, dim=1)
+    assert out.shape == (2, 6)
+
+
+def test_reshape_alias():
+    assert nd.Reshape(_a(4, 3), shape=(3, 4)).shape == (3, 4)
+
+
+def test_swapaxis_alias():
+    assert nd.SwapAxis(_a(2, 3, 4), dim1=0, dim2=2).shape == (4, 3, 2)
+
+
+def test_cast_alias():
+    out = nd.Cast(_a(2, 2), dtype="float16")
+    assert "float16" in str(out.dtype)
+
+
+def test_embedding_alias():
+    w = _a(5, 3)
+    idx = nd.array(onp.array([0, 4], "float32"))
+    out = nd.Embedding(idx, w, input_dim=5, output_dim=3)
+    onp.testing.assert_array_equal(out.asnumpy(),
+                                   w.asnumpy()[[0, 4]])
+
+
+def test_batchnorm_alias_inference():
+    x = _a(2, 3, 4, 4)
+    out = nd.BatchNorm(x, nd.ones((3,)), nd.zeros((3,)),
+                       nd.zeros((3,)), nd.ones((3,)))
+    assert out.shape == x.shape
+
+
+# -- broadcast_* family ------------------------------------------------------
+
+def test_broadcast_add():
+    a, b = _a(3, 4), _a(1, 4)
+    onp.testing.assert_allclose(nd.broadcast_add(a, b).asnumpy(),
+                                a.asnumpy() + b.asnumpy(), rtol=1e-6)
+
+
+def test_broadcast_mul_div():
+    a, b = _a(3, 1), _a(1, 4)
+    onp.testing.assert_allclose(nd.broadcast_mul(a, b).asnumpy(),
+                                a.asnumpy() * b.asnumpy(), rtol=1e-6)
+    c = nd.array(onp.abs(b.asnumpy()) + 0.5)
+    onp.testing.assert_allclose(nd.broadcast_div(a, c).asnumpy(),
+                                a.asnumpy() / c.asnumpy(), rtol=1e-5)
+
+
+def test_broadcast_maximum_minimum():
+    a, b = _a(3, 4), _a(3, 4)
+    onp.testing.assert_allclose(nd.broadcast_maximum(a, b).asnumpy(),
+                                onp.maximum(a.asnumpy(), b.asnumpy()))
+    onp.testing.assert_allclose(nd.broadcast_minimum(a, b).asnumpy(),
+                                onp.minimum(a.asnumpy(), b.asnumpy()))
+
+
+def test_elemwise_family():
+    a, b = _a(3, 3), _a(3, 3)
+    onp.testing.assert_allclose(nd.elemwise_add(a, b).asnumpy(),
+                                a.asnumpy() + b.asnumpy(), rtol=1e-6)
+    onp.testing.assert_allclose(nd.elemwise_mul(a, b).asnumpy(),
+                                a.asnumpy() * b.asnumpy(), rtol=1e-6)
+
+
+def test_add_n_sums_all():
+    a, b, c = _a(2, 2), _a(2, 2), _a(2, 2)
+    onp.testing.assert_allclose(
+        nd.add_n(a, b, c).asnumpy(),
+        a.asnumpy() + b.asnumpy() + c.asnumpy(), rtol=1e-6)
+
+
+def test_elementwisesum_alias():
+    a, b = _a(2, 2), _a(2, 2)
+    onp.testing.assert_allclose(nd.ElementWiseSum(a, b).asnumpy(),
+                                a.asnumpy() + b.asnumpy(), rtol=1e-6)
+
+
+# -- creation + conversion ---------------------------------------------------
+
+def test_nd_zeros_ones_full():
+    assert nd.zeros((2, 3)).asnumpy().sum() == 0
+    assert nd.ones((2, 3)).asnumpy().sum() == 6
+    onp.testing.assert_array_equal(nd.full((2, 2), 7.0).asnumpy(),
+                                   onp.full((2, 2), 7.0))
+
+
+def test_nd_array_from_list():
+    out = nd.array([[1, 2], [3, 4]])
+    assert out.shape == (2, 2)
+
+
+def test_asnumpy_roundtrip():
+    a = RNG.uniform(-1, 1, (3, 3)).astype("float32")
+    onp.testing.assert_array_equal(nd.array(a).asnumpy(), a)
+
+
+def test_asscalar():
+    assert nd.array(onp.array([3.5], "float32")).asscalar() == \
+        pytest.approx(3.5)
+
+
+def test_astype_copy():
+    a = _a(2, 2)
+    b = a.astype("float64" if False else "float16")
+    assert "float16" in str(b.dtype)
+
+
+def test_copyto():
+    a = _a(2, 2)
+    b = nd.zeros((2, 2))
+    a.copyto(b)
+    onp.testing.assert_array_equal(b.asnumpy(), a.asnumpy())
+
+
+def test_wait_to_read_and_waitall():
+    a = _a(8, 8)
+    b = nd.dot(a, a)
+    b.wait_to_read()
+    nd.waitall()
+    assert b.shape == (8, 8)
+
+
+def test_context_attribute():
+    a = _a(2)
+    assert a.context is not None
+    assert a.ctx is not None
+
+
+# -- methods -----------------------------------------------------------------
+
+def test_method_reductions():
+    a = _a(3, 4)
+    av = a.asnumpy()
+    assert a.sum().asscalar() == pytest.approx(av.sum(), rel=1e-5)
+    assert a.max().asscalar() == pytest.approx(av.max(), rel=1e-5)
+    assert a.min().asscalar() == pytest.approx(av.min(), rel=1e-5)
+    assert a.mean().asscalar() == pytest.approx(av.mean(), rel=1e-5)
+
+
+def test_method_elementwise():
+    a = _a(3, 3)
+    onp.testing.assert_allclose(a.abs().asnumpy(), onp.abs(a.asnumpy()),
+                                rtol=1e-6)
+    onp.testing.assert_allclose(a.square().asnumpy(),
+                                a.asnumpy() ** 2, rtol=1e-6)
+
+
+def test_method_clip_round():
+    a = _a(3, 3)
+    onp.testing.assert_allclose(a.clip(-1, 1).asnumpy(),
+                                onp.clip(a.asnumpy(), -1, 1), rtol=1e-6)
+
+
+def test_method_expand_dims_squeeze():
+    a = _a(3, 4)
+    e = a.expand_dims(0)
+    assert e.shape == (1, 3, 4)
+    assert e.squeeze().shape == (3, 4)
+
+
+def test_method_slice_ops():
+    a = _a(6, 4)
+    onp.testing.assert_array_equal(a.slice_axis(axis=0, begin=1,
+                                                end=4).asnumpy(),
+                                   a.asnumpy()[1:4])
+    # legacy nd.take: axis defaults to 0 (row gather), unlike numpy's
+    # flattening .take method default
+    onp.testing.assert_array_equal(nd.take(a, nd.array(
+        onp.array([0, 5], "float32"))).asnumpy(),
+        a.asnumpy()[[0, 5]])
+
+
+def test_tile_repeat_methods():
+    a = _a(2, 2)
+    assert a.tile((2, 2)).shape == (4, 4)
+    assert a.repeat(2, axis=0).shape == (4, 2)
+
+
+def test_sequence_ops_via_nd():
+    x = _a(4, 2)          # (T, N)
+    vl = nd.array(onp.array([2, 3], "float32"))
+    out = nd.SequenceMask(x, vl, use_sequence_length=True).asnumpy()
+    assert out[2, 0] == 0 and out[3, 1] == 0
+
+
+def test_one_hot_alias():
+    out = nd.one_hot(nd.array(onp.array([1, 0], "float32")), 3)
+    onp.testing.assert_array_equal(
+        out.asnumpy(), [[0, 1, 0], [1, 0, 0]])
+
+
+def test_topk_pick():
+    a = nd.array(onp.array([[1.0, 5.0, 3.0]], "float32"))
+    v = nd.topk(a, k=1, ret_typ="value", axis=-1)
+    assert float(v.asnumpy()[0, 0]) == 5.0
+    p = nd.pick(a, nd.array(onp.array([2.0], "float32")))
+    assert float(p.asnumpy()[0]) == 3.0
+
+
+def test_norm_l2():
+    a = _a(3, 3)
+    got = float(nd.norm(a).asscalar())
+    assert got == pytest.approx(float(onp.linalg.norm(a.asnumpy())),
+                                rel=1e-5)
+
+
+def test_dot_matches():
+    a, b = _a(3, 4), _a(4, 5)
+    onp.testing.assert_allclose(nd.dot(a, b).asnumpy(),
+                                a.asnumpy() @ b.asnumpy(), rtol=1e-4)
+
+
+def test_stack_concat_free_functions():
+    a, b = _a(2, 3), _a(2, 3)
+    assert nd.stack(a, b).shape == (2, 2, 3)
+    assert nd.concat(a, b, dim=0).shape == (4, 3)
+
+
+def test_save_load_roundtrip(tmp_path):
+    a, b = _a(2, 3), _a(4)
+    p = str(tmp_path / "arrays.nd")
+    nd.save(p, {"a": a, "b": b})
+    loaded = nd.load(p)
+    onp.testing.assert_array_equal(loaded["a"].asnumpy(), a.asnumpy())
+    onp.testing.assert_array_equal(loaded["b"].asnumpy(), b.asnumpy())
+
+
+def test_save_load_list(tmp_path):
+    a = _a(3)
+    p = str(tmp_path / "list.nd")
+    nd.save(p, [a])
+    loaded = nd.load(p)
+    onp.testing.assert_array_equal(loaded[0].asnumpy(), a.asnumpy())

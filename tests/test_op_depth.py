@@ -139,15 +139,25 @@ def test_reductions_large(name):
 # finite-difference gradient checks over the npx nn corpus
 # ---------------------------------------------------------------------------
 
+def _stable_seed(tag, s):
+    # zlib.crc32, NOT hash(): python string hashing is salted per process,
+    # so hash-derived seeds silently vary between runs — max-pool FD checks
+    # then hit near-ties in some runs only (caught as a once-in-a-suite
+    # flake in round 4)
+    import zlib
+
+    return zlib.crc32(repr((tag,) + tuple(s)).encode()) % (2 ** 31)
+
+
 def _u(*s):
     # order-independent inputs: seeded per shape, not from the shared
     # module stream (tests must not change behavior with execution order)
-    r = onp.random.RandomState(abs(hash(("u",) + s)) % (2**31))
+    r = onp.random.RandomState(_stable_seed("u", s))
     return NDArray(r.uniform(-0.9, 0.9, s).astype("float32"))
 
 
 def _up(*s):
-    r = onp.random.RandomState(abs(hash(("up",) + s)) % (2**31))
+    r = onp.random.RandomState(_stable_seed("up", s))
     return NDArray(r.uniform(0.3, 1.5, s).astype("float32"))
 
 

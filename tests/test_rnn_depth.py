@@ -1,0 +1,249 @@
+"""RNN depth: fused layers vs cell unrolls, state shapes/carry,
+bidirectional concat, layouts, grads (reference:
+`tests/python/unittest/test_gluon_rnn.py`)."""
+import numpy as onp
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import autograd, np
+from incubator_mxnet_tpu.gluon import rnn
+
+RNG = onp.random.RandomState(53)
+
+T, N, I, H = 5, 3, 4, 6
+
+
+def _x(layout="TNC"):
+    shape = (T, N, I) if layout == "TNC" else (N, T, I)
+    return np.array(RNG.uniform(-1, 1, shape).astype("float32"))
+
+
+# -- fused layers ------------------------------------------------------------
+
+def test_rnn_layer_output_shape():
+    l = rnn.RNN(H, 1)
+    l.initialize()
+    out = l(_x())
+    assert out.shape == (T, N, H)
+
+
+def test_lstm_layer_output_and_state():
+    l = rnn.LSTM(H, 1)
+    l.initialize()
+    x = _x()
+    s0 = l.begin_state(batch_size=N)
+    out, s = l(x, s0)
+    assert out.shape == (T, N, H)
+    assert s[0].shape == (1, N, H) and s[1].shape == (1, N, H)
+
+
+def test_gru_layer_output():
+    l = rnn.GRU(H, 1)
+    l.initialize()
+    assert l(_x()).shape == (T, N, H)
+
+
+def test_two_layer_stack_shapes():
+    l = rnn.LSTM(H, 2)
+    l.initialize()
+    s0 = l.begin_state(batch_size=N)
+    out, s = l(_x(), s0)
+    assert out.shape == (T, N, H)
+    assert s[0].shape == (2, N, H)
+
+
+def test_bidirectional_doubles_features():
+    l = rnn.LSTM(H, 1, bidirectional=True)
+    l.initialize()
+    out = l(_x())
+    assert out.shape == (T, N, 2 * H)
+
+
+def test_nTC_layout():
+    l = rnn.LSTM(H, 1, layout="NTC")
+    l.initialize()
+    out = l(_x("NTC"))
+    assert out.shape == (N, T, H)
+
+
+def test_state_carry_changes_output():
+    l = rnn.LSTM(H, 1)
+    l.initialize()
+    x = _x()
+    s0 = l.begin_state(batch_size=N)
+    out1, s1 = l(x, s0)
+    out2, _ = l(x, s1)          # different initial state → different out
+    assert not onp.allclose(out1.asnumpy(), out2.asnumpy())
+
+
+def test_fused_lstm_grads_flow():
+    l = rnn.LSTM(H, 1)
+    l.initialize()
+    x = _x()
+    x.attach_grad()
+    with autograd.record():
+        y = l(x).sum()
+    y.backward()
+    g = x.grad.asnumpy()
+    assert g.shape == x.shape and onp.abs(g).sum() > 0
+
+
+# -- cells -------------------------------------------------------------------
+
+def test_lstm_cell_single_step():
+    c = rnn.LSTMCell(H, input_size=I)
+    c.initialize()
+    x = np.array(RNG.uniform(-1, 1, (N, I)).astype("float32"))
+    s = c.begin_state(batch_size=N)
+    out, s2 = c(x, s)
+    assert out.shape == (N, H)
+    assert len(s2) == 2
+
+
+def test_gru_cell_single_step():
+    c = rnn.GRUCell(H, input_size=I)
+    c.initialize()
+    x = np.array(RNG.uniform(-1, 1, (N, I)).astype("float32"))
+    out, s2 = c(x, c.begin_state(batch_size=N))
+    assert out.shape == (N, H)
+    assert len(s2) == 1
+
+
+def test_rnn_cell_tanh_formula():
+    c = rnn.RNNCell(H, input_size=I, activation="tanh")
+    c.initialize()
+    x = np.array(RNG.uniform(-1, 1, (N, I)).astype("float32"))
+    s = c.begin_state(batch_size=N)
+    out, _ = c(x, s)
+    i2h_w = c.i2h_weight.data().asnumpy()
+    i2h_b = c.i2h_bias.data().asnumpy()
+    h2h_w = c.h2h_weight.data().asnumpy()
+    h2h_b = c.h2h_bias.data().asnumpy()
+    ref = onp.tanh(x.asnumpy() @ i2h_w.T + i2h_b
+                   + s[0].asnumpy() @ h2h_w.T + h2h_b)
+    onp.testing.assert_allclose(out.asnumpy(), ref, rtol=1e-4, atol=1e-5)
+
+
+def test_cell_unroll_matches_manual_loop():
+    c = rnn.LSTMCell(H, input_size=I)
+    c.initialize()
+    x = _x()
+    outs, state = c.unroll(T, x, layout="TNC", merge_outputs=True)
+    s = c.begin_state(batch_size=N)
+    manual = []
+    for t in range(T):
+        o, s = c(x[t], s)
+        manual.append(o.asnumpy())
+    onp.testing.assert_allclose(outs.asnumpy(), onp.stack(manual),
+                                rtol=1e-5, atol=1e-6)
+
+
+def test_sequential_rnn_cell_stack():
+    stack = rnn.SequentialRNNCell()
+    stack.add(rnn.LSTMCell(H, input_size=I))
+    stack.add(rnn.LSTMCell(H, input_size=H))
+    stack.initialize()
+    outs, _ = stack.unroll(T, _x(), layout="TNC", merge_outputs=True)
+    assert outs.shape == (T, N, H)
+
+
+def test_dropout_cell_eval_identity():
+    c = rnn.DropoutCell(0.5)
+    x = np.array(RNG.uniform(-1, 1, (N, I)).astype("float32"))
+    out, _ = c(x, [])
+    onp.testing.assert_array_equal(out.asnumpy(), x.asnumpy())
+
+
+def test_zoneout_cell_wraps():
+    base = rnn.GRUCell(H, input_size=I)
+    c = rnn.ZoneoutCell(base, zoneout_states=0.1)
+    c.initialize()
+    x = np.array(RNG.uniform(-1, 1, (N, I)).astype("float32"))
+    out, _ = c(x, c.begin_state(batch_size=N))
+    assert out.shape == (N, H)
+
+
+def test_residual_cell_adds_input():
+    base = rnn.RNNCell(I, input_size=I)   # same width for the residual
+    c = rnn.ResidualCell(base)
+    c.initialize()
+    x = np.array(RNG.uniform(-1, 1, (N, I)).astype("float32"))
+    s = c.begin_state(batch_size=N)
+    out, _ = c(x, s)
+    inner, _ = base(x, base.begin_state(batch_size=N))
+    onp.testing.assert_allclose(out.asnumpy(),
+                                inner.asnumpy() + x.asnumpy(), rtol=1e-5)
+
+
+def test_bidirectional_cell_concat():
+    l = rnn.BidirectionalCell(rnn.GRUCell(H, input_size=I),
+                              rnn.GRUCell(H, input_size=I))
+    l.initialize()
+    outs, _ = l.unroll(T, _x(), layout="TNC", merge_outputs=True)
+    assert outs.shape == (T, N, 2 * H)
+
+
+def test_cell_reset_clears_counters():
+    c = rnn.LSTMCell(H, input_size=I)
+    c.initialize()
+    c.unroll(T, _x(), layout="TNC")
+    c.reset()
+    outs, _ = c.unroll(T, _x(), layout="TNC", merge_outputs=True)
+    assert outs.shape == (T, N, H)
+
+
+def test_fused_vs_cell_parity_rnn_relu():
+    """Single-layer relu RNN: fused layer output == cell unroll with the
+    SAME weights (the reference's fused-kernel-vs-cell contract)."""
+    mx.random.seed(7)
+    layer = rnn.RNN(H, 1, activation="relu")
+    layer.initialize()
+    x = _x()
+    fused = layer(x).asnumpy()
+
+    cell = rnn.RNNCell(H, input_size=I, activation="relu")
+    cell.initialize()
+    # pack the CELL's weights into the fused layer's flat vector layout
+    # (w_i2h.ravel() + w_h2h.ravel() then b_i2h + b_h2h — the layout
+    # _unpack_rnn_params parses)
+    packed = onp.concatenate([
+        cell.i2h_weight.data().asnumpy().ravel(),
+        cell.h2h_weight.data().asnumpy().ravel(),
+        cell.i2h_bias.data().asnumpy(),
+        cell.h2h_bias.data().asnumpy()])
+    layer.parameters.set_data(np.array(packed.astype("float32")))
+    fused = layer(x).asnumpy()
+    outs, _ = cell.unroll(T, x, layout="TNC", merge_outputs=True)
+    onp.testing.assert_allclose(outs.asnumpy(), fused, rtol=1e-4,
+                                atol=1e-5)
+
+
+def test_train_step_reduces_loss():
+    mx.random.seed(1)
+    from incubator_mxnet_tpu import gluon, optimizer
+    from incubator_mxnet_tpu.parallel.sharded import DataParallel
+
+    net = gluon.nn.HybridSequential()
+
+    class Tail(gluon.HybridBlock):
+        def __init__(self):
+            super().__init__()
+            self.l = rnn.LSTM(H, 1)
+            self.out = gluon.nn.Dense(1)
+
+        def forward(self, x):
+            y = self.l(x)
+            return self.out(y[-1])
+
+    net = Tail()
+    net.initialize()
+    x = _x()
+    net(x)                      # resolve deferred shapes before tracing
+    l2 = gluon.loss.L2Loss()
+    dp = DataParallel(net, lambda o, y: l2(o, y).mean(),
+                      optimizer.Adam(learning_rate=1e-2))
+    y = np.array(RNG.uniform(-1, 1, (N, 1)).astype("float32"))
+    first = float(dp.step(x, y).asnumpy())
+    for _ in range(15):
+        last = float(dp.step(x, y).asnumpy())
+    assert last < first, (first, last)
