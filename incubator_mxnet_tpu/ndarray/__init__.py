@@ -59,7 +59,6 @@ _LEGACY_TO_NPX = {
 _LEGACY_TO_NP = {
     "Reshape": "reshape",
     "ElementWiseSum": "add_n",
-    "SwapAxis": "swapaxes",
     "flip": "flip",
     "sum_axis": "sum",
     "max_axis": "max",
@@ -87,13 +86,15 @@ def add_n(*args):
     return out
 
 
-def concat(*args, dim=0, **kwargs):  # noqa: ARG001
-    """Legacy varargs Concat (reference `mx.nd.Concat(*arrays, dim=)`)."""
+def concat(*args, dim=None, axis=None, **kwargs):  # noqa: ARG001
+    """Legacy varargs Concat (reference `mx.nd.Concat(*arrays, dim=)`);
+    numpy-style axis= accepted as an alias."""
     from .. import numpy as _np
 
     arrays = args[0] if len(args) == 1 and isinstance(args[0],
                                                       (list, tuple)) else args
-    return _np.concatenate(list(arrays), axis=dim)
+    ax = dim if dim is not None else (axis if axis is not None else 0)
+    return _np.concatenate(list(arrays), axis=ax)
 
 
 Concat = concat
@@ -108,14 +109,21 @@ def stack(*args, axis=0, **kwargs):  # noqa: ARG001
     return _np.stack(list(arrays), axis=axis)
 
 
-def SwapAxis(data, dim1=0, dim2=0, **kwargs):  # noqa: N802, ARG001
-    """Legacy SwapAxis with dim1/dim2 kwargs (reference swapaxes op)."""
+def SwapAxis(data, dim1=None, dim2=None, axis1=None, axis2=None,
+             **kwargs):  # noqa: N802, ARG001
+    """Legacy SwapAxis with dim1/dim2 kwargs (reference swapaxes op);
+    numpy-style axis1/axis2 accepted so pre-existing nd.swapaxes callers
+    keep transposing instead of silently no-opping."""
     from .. import numpy as _np
 
-    return _np.swapaxes(data, dim1, dim2)
+    a1 = dim1 if dim1 is not None else (axis1 if axis1 is not None else 0)
+    a2 = dim2 if dim2 is not None else (axis2 if axis2 is not None else 0)
+    return _np.swapaxes(data, a1, a2)
 
 
-swapaxes = SwapAxis
+def swapaxes(data, axis1=None, axis2=None, dim1=None, dim2=None, **kwargs):
+    return SwapAxis(data, dim1=dim1, dim2=dim2, axis1=axis1, axis2=axis2,
+                    **kwargs)
 
 
 def take(a, indices, axis=0, mode="clip", **kwargs):  # noqa: ARG001

@@ -44,6 +44,13 @@ def _jitted(cls, fn_name):
 class Optimizer:
     """Base optimizer (reference: `python/mxnet/optimizer/optimizer.py:29`)."""
 
+    #: True when `step` is a purely per-element rule — the compiled
+    #: DataParallel step may then CONCATENATE small parameters into one
+    #: fused update (reference aggregate_num multi-tensor kernels).
+    #: Rules taking per-TENSOR statistics (LARS/LAMB trust ratios) must
+    #: opt out.
+    elementwise = True
+
     opt_registry: dict = {}
 
     def __init__(self, rescale_grad=1.0, param_idx2name=None, wd=0.0,
@@ -416,6 +423,8 @@ class FTRL(Optimizer):
 
 @register
 class LAMB(Optimizer):
+    elementwise = False   # per-tensor trust ratio
+
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
                  epsilon=1e-6, lower_bound=None, upper_bound=None,
                  bias_correction=True, **kwargs):
@@ -462,6 +471,8 @@ class LANS(LAMB):
 
 @register
 class LARS(Optimizer):
+    elementwise = False   # per-tensor trust ratio
+
     def __init__(self, learning_rate=0.1, momentum=0.9, eta=0.001,
                  epsilon=1e-8, **kwargs):
         super().__init__(learning_rate=learning_rate, **kwargs)

@@ -61,7 +61,46 @@ def _build_pure_step(net, loss_fn, optimizer, remat_spec=None):
 
     forward_loss = _remat.wrap(forward_loss, remat_spec)
 
+    # Multi-tensor fusion for SMALL parameters (the reference's
+    # aggregate_num fused updates, `src/operator/optimizer_op.cc`
+    # multi-sgd/multi-adam): BERT-base has ~150 LN gammas/betas/biases of
+    # a few KB each — updating them as one concatenated vector collapses
+    # ~150 tiny per-param fusions into one kernel. Safe only for
+    # ELEMENTWISE rules (LARS/LAMB take per-tensor norms) over plain
+    # list-of-like-shaped states.
+    _SMALL = 1 << 14
+
+    def _fusable(i):
+        a = param_arrays[i]
+        # cheap filters FIRST: create_state allocates real device buffers
+        # (Adam m/v), which must not happen for every multi-MB weight
+        if not getattr(optimizer, "elementwise", False):
+            return False
+        if a.size > _SMALL or str(a.dtype) != "float32":
+            return False
+        try:
+            s = optimizer.create_state(i, a)
+        except Exception:
+            return False
+        return (isinstance(s, list)
+                and all(getattr(x, "shape", None) == a._data.shape
+                        for x in s))
+
+    fused_idx = [i for i in range(len(param_arrays)) if _fusable(i)]
+    if len(fused_idx) < 2:
+        fused_idx = []
+    fused_set = frozenset(fused_idx)
+    fused_sizes = [int(param_arrays[i].size) for i in fused_idx]
+    fused_shapes = [tuple(param_arrays[i].shape) for i in fused_idx]
+    fused_bounds = []
+    off = 0
+    for n in fused_sizes[:-1]:
+        off += n
+        fused_bounds.append(off)
+
     def step(param_vals, frozen_vals, opt_states, t, lr, wd, base_key, x, y):
+        import jax.numpy as jnp
+
         # t arrives as a device scalar and the per-step RNG key derives
         # from (base_key, t) ON DEVICE: the host never uploads a counter
         # or splits a key eagerly, so a steady-state step costs ONE
@@ -70,11 +109,30 @@ def _build_pure_step(net, loss_fn, optimizer, remat_spec=None):
         key = jax.random.fold_in(base_key, t)
         (loss, aux_new), grads = jax.value_and_grad(
             forward_loss, has_aux=True)(param_vals, frozen_vals, key, x, y)
-        new_params, new_states = [], []
-        for w, g, s in zip(param_vals, grads, opt_states):
+        new_params = [None] * len(param_vals)
+        new_states = [None] * len(param_vals)
+        if fused_idx:
+            w_cat = jnp.concatenate([param_vals[i].ravel()
+                                     for i in fused_idx])
+            g_cat = jnp.concatenate([grads[i].ravel() for i in fused_idx])
+            n_slots = len(opt_states[fused_idx[0]])
+            s_cat = [jnp.concatenate([opt_states[i][k].ravel()
+                                      for i in fused_idx])
+                     for k in range(n_slots)]
+            nw_cat, ns_cat = optimizer.step(w_cat, g_cat, s_cat, lr, wd, t)
+            w_parts = jnp.split(nw_cat, fused_bounds)
+            s_parts = [jnp.split(ns_cat[k], fused_bounds)
+                       for k in range(n_slots)]
+            for j, i in enumerate(fused_idx):
+                new_params[i] = w_parts[j].reshape(fused_shapes[j])
+                new_states[i] = [s_parts[k][j].reshape(fused_shapes[j])
+                                 for k in range(n_slots)]
+        for i, (w, g, s) in enumerate(zip(param_vals, grads, opt_states)):
+            if i in fused_set:
+                continue
             nw, ns = optimizer.step(w, g, s, lr, wd, t)
-            new_params.append(nw)
-            new_states.append(ns)
+            new_params[i] = nw
+            new_states[i] = ns
         return loss, new_params, new_states, aux_new, t + 1
 
     return step, params, param_arrays, frozen_arrays, aux_arrays_cell

@@ -290,3 +290,40 @@ def test_gluon_bert_tp_dataparallel_matches_replicated():
         onp.testing.assert_allclose(p1.data().asnumpy(), p2.data().asnumpy(),
                                     rtol=3e-3, atol=3e-4, err_msg=n1)
     assert losses_tp[-1] < losses_tp[0]  # it actually learns
+
+
+def test_fused_small_param_update_matches_unfused():
+    """Multi-tensor fused small-param updates (reference aggregate_num
+    role) are EXACT: the same net trained with fusion enabled (Adam,
+    elementwise) and disabled must land on identical weights."""
+    import numpy as onp
+
+    from incubator_mxnet_tpu import gluon, np, optimizer
+    from incubator_mxnet_tpu.parallel.sharded import DataParallel
+
+    def train(elementwise):
+        mx.random.seed(5)
+        net = gluon.nn.HybridSequential()
+        net.add(gluon.nn.Dense(16, activation="relu", in_units=8),
+                gluon.nn.LayerNorm(in_channels=16),
+                gluon.nn.Dense(4, in_units=16))
+        net.initialize()
+        opt = optimizer.Adam(learning_rate=1e-2)
+        opt.elementwise = elementwise   # False => per-param path
+        dp = DataParallel(net, gluon.loss.L2Loss(), opt)
+        rng = onp.random.RandomState(2)
+        x = np.array(rng.uniform(-1, 1, (8, 8)).astype("float32"))
+        y = np.array(rng.uniform(-1, 1, (8, 4)).astype("float32"))
+        for _ in range(5):
+            dp.step(x, y)
+        return {k: p.data().asnumpy()
+                for k, p in net.collect_params().items()}
+
+    fused = train(True)
+    plain = train(False)
+    assert fused.keys() == plain.keys()
+    for k in fused:
+        # identical math; XLA reassociation in the fused kernel shifts
+        # the last ulp (~4e-9 observed)
+        onp.testing.assert_allclose(fused[k], plain[k], rtol=1e-6,
+                                    atol=1e-7, err_msg=k)
