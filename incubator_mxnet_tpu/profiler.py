@@ -192,6 +192,14 @@ def device_events():
         return list(_DEVICE_EVENTS)
 
 
+def device_op_totals():
+    """{op name: (count, total_us)} aggregated from the /device: lanes
+    only — true on-chip execution time, no host/launch events (what the
+    aggregate table in dumps() prints)."""
+    with _LOCK:
+        return {k: (v[0], v[1]) for k, v in _DEVICE_AGG.items()}
+
+
 def pause(profile_process="worker"):  # noqa: ARG001
     _STATE["running"] = False
 
