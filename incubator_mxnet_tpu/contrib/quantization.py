@@ -739,6 +739,20 @@ def chain_residual_blocks(net, calib_data=None, num_calib_batches=10,
                 if not isinstance(ds_first, (QuantizedConv2D,
                                              QuantizedDense)):
                     continue
+                # body[0] and the downsample decode the SAME emitted
+                # codes with independently calibrated thresholds; they
+                # agree today because both see the same tensor, but
+                # calib-mode or exclusion changes could split them —
+                # skip the chain rather than silently mis-decode
+                t_in = float(cons._in_threshold.data().asnumpy())
+                t_ds = float(ds_first.qthreshold.data().asnumpy())
+                if abs(t_in - t_ds) > 1e-5 * max(t_in, t_ds, 1e-6):
+                    if logger:
+                        logger.warning(
+                            "residual chain skipped at %s: body/downsample "
+                            "thresholds diverge (%.6g vs %.6g)",
+                            type(cons).__name__, t_in, t_ds)
+                    continue
             prod.__dict__["_out_threshold"] = cons._in_threshold
             prod.__dict__["_chain_consumer"] = \
                 cons.body._children[list(cons.body._children)[0]]

@@ -78,6 +78,18 @@ def create(name="local"):
     if not isinstance(name, str):
         raise TypeError("name must be a string")
     key = name.lower()
+    if key.startswith("dist_async"):
+        # straggler semantics change: reference dist_async applies each
+        # worker's push immediately (kvstore_dist_server.h ASyncMode);
+        # here every update is a synchronous collective
+        import warnings
+
+        warnings.warn(
+            f"KVStore type {name!r} degrades to synchronous on TPU: "
+            "XLA collectives have no async parameter-server mode, so "
+            "updates are globally ordered (no stale gradients). Port "
+            "scripts relying on async staleness semantics accordingly.",
+            UserWarning, stacklevel=2)
     aliases = {
         "nccl": "device",
         "dist_sync": "dist",

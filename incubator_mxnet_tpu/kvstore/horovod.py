@@ -11,6 +11,8 @@ reduce of the device store.
 """
 from __future__ import annotations
 
+import os
+
 from .base import register
 from .kvstore import KVStoreDevice
 
@@ -37,6 +39,21 @@ class Horovod(KVStoreDevice):
 
     @property
     def local_rank(self):
+        """Rank within the host (reference horovod facade semantics —
+        used for per-host device/file assignment). Honors the launcher's
+        local-rank env (our tools/launch.py, OpenMPI, torchrun) when
+        present; a single jax process owns all of a host's chips, so
+        absent those env vars the process IS host-local rank 0... unless
+        several ranks share the host, where global rank is the only
+        (documented, possibly wrong) fallback left."""
+        for name in ("MXNET_LOCAL_RANK", "HOROVOD_LOCAL_RANK",
+                     "OMPI_COMM_WORLD_LOCAL_RANK", "LOCAL_RANK"):
+            v = os.environ.get(name)
+            if v:
+                try:
+                    return int(v)
+                except ValueError:
+                    continue   # malformed export (e.g. 'LOCAL_RANK=')
         return self._dist.rank() if self._dist else 0
 
     @property

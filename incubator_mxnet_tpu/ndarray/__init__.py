@@ -88,12 +88,13 @@ def add_n(*args):
 
 def concat(*args, dim=None, axis=None, **kwargs):  # noqa: ARG001
     """Legacy varargs Concat (reference `mx.nd.Concat(*arrays, dim=)`);
-    numpy-style axis= accepted as an alias."""
+    numpy-style axis= accepted as an alias. Default dim=1 matches the
+    reference's ConcatParam (src/operator/nn/concat-inl.h set_default(1))."""
     from .. import numpy as _np
 
     arrays = args[0] if len(args) == 1 and isinstance(args[0],
                                                       (list, tuple)) else args
-    ax = dim if dim is not None else (axis if axis is not None else 0)
+    ax = dim if dim is not None else (axis if axis is not None else 1)
     return _np.concatenate(list(arrays), axis=ax)
 
 

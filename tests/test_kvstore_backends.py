@@ -98,3 +98,31 @@ def test_kvstore_device_identity_reduce_contract():
     out = np.array(onp.zeros((3, 3), "float32"))
     store.pushpull("k", copies, out=out)
     onp.testing.assert_allclose(out.asnumpy(), 7.0 * onp.ones((3, 3)))
+
+
+def test_dist_async_warns_sync_degradation():
+    """`create('dist_async')` must tell the user their straggler
+    semantics changed (reference ASyncMode applies pushes immediately;
+    here every update is a synchronous collective)."""
+    import warnings
+
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        try:
+            kv.create("dist_async")
+        except Exception:
+            pass  # dist init may fail single-process; the warning fires first
+    assert any("synchronous" in str(x.message) for x in w)
+
+
+def test_horovod_local_rank_env(monkeypatch):
+    """local_rank honors the launcher's per-host rank env (our
+    tools/launch.py exports MXNET_LOCAL_RANK) instead of echoing the
+    global rank."""
+    store = kv.create("horovod")
+    monkeypatch.setenv("MXNET_LOCAL_RANK", "3")
+    assert store.local_rank == 3
+    for name in ("MXNET_LOCAL_RANK", "HOROVOD_LOCAL_RANK",
+                 "OMPI_COMM_WORLD_LOCAL_RANK", "LOCAL_RANK"):
+        monkeypatch.delenv(name, raising=False)
+    assert store.local_rank == store.rank

@@ -55,6 +55,21 @@ def test_concat_alias():
     assert out.shape == (2, 6)
 
 
+def test_concat_default_dim_is_1():
+    # reference ConcatParam: dim defaults to 1 (concat-inl.h set_default(1))
+    a, b = _a(2, 3), _a(2, 3)
+    assert nd.concat(a, b).shape == (2, 6)
+    assert nd.Concat(a, b).shape == (2, 6)
+
+    from incubator_mxnet_tpu import symbol as sym
+
+    va = sym.Variable("a")
+    vb = sym.Variable("b")
+    out = sym.Concat(va, vb)
+    ex = out.bind(args={"a": a, "b": b})
+    assert ex.forward()[0].shape == (2, 6)
+
+
 def test_reshape_alias():
     assert nd.Reshape(_a(4, 3), shape=(3, 4)).shape == (3, 4)
 

@@ -87,7 +87,9 @@ def main():
             env = dict(os.environ, **extra)
             env.update(COORDINATOR_ADDRESS=coordinator,
                        NUM_PROCESSES=str(args.num_workers),
-                       PROCESS_ID=str(rank))
+                       PROCESS_ID=str(rank),
+                       # all local-launcher ranks share this host
+                       MXNET_LOCAL_RANK=str(rank))
             procs.append(subprocess.Popen(args.command, env=env,
                                           start_new_session=True))
         sys.exit(_wait_fail_fast(procs))
@@ -103,7 +105,10 @@ def main():
     for rank in range(args.num_workers):
         envs = " ".join(
             [f"COORDINATOR_ADDRESS={shlex.quote(coordinator)}",
-             f"NUM_PROCESSES={args.num_workers}", f"PROCESS_ID={rank}"]
+             f"NUM_PROCESSES={args.num_workers}", f"PROCESS_ID={rank}",
+             # rank within the host: a hostfile may repeat a host to
+             # place several ranks on it
+             f"MXNET_LOCAL_RANK={hosts[:rank].count(hosts[rank])}"]
             + [f"{k}={shlex.quote(v)}" for k, v in extra.items()])
         cmd = " ".join(shlex.quote(c) for c in args.command)
         procs.append(subprocess.Popen(
