@@ -104,6 +104,25 @@ def bench_dot_rawjax(n=1024, iters=100, warmup=10):
     return (time.perf_counter() - t0) / iters * 1000.0
 
 
+def bench_dot_pair(rounds=3):
+    """Framework-vs-raw dot in INTERLEAVED rounds with a median-of-ratios
+    statistic, like the int8/fp32 pair: per-op latency here is dominated
+    by the tunnel's dispatch RPC, whose rate drifts on ~minute timescales
+    — benching the two paths minutes apart measures the link, not the
+    funnel (round 4's 2.09-vs-1.51 'regression' was partly this: the
+    second bench in a process consistently reads ~0.4 ms/op slower)."""
+    ratios = []
+    fw_best, raw_best = float("inf"), float("inf")
+    for _ in range(rounds):
+        fw = bench_dot_framework(iters=50)
+        raw = bench_dot_rawjax(iters=50)
+        fw_best = min(fw_best, fw)
+        raw_best = min(raw_best, raw)
+        ratios.append(fw / raw)
+    ratios.sort()
+    return fw_best, raw_best, ratios[len(ratios) // 2]
+
+
 def bench_dispatch_floor(iters=100):
     """Per-program dispatch+execute floor: a trivial chained jitted op.
     On the tunneled chip this is ~1 ms — the lower bound every per-op
@@ -439,13 +458,14 @@ def main():
         raise err
 
     try:
-        extras["dot_framework_ms"] = round(bench_dot_framework(), 4)
+        fw, raw, med_ratio = _retry(bench_dot_pair)
+        extras["dot_framework_ms"] = round(fw, 4)
+        extras["dot_rawjax_ms"] = round(raw, 4)
+        # link-immune eager-dispatch statistic (median of per-round
+        # ratios over interleaved rounds); the r5 target is ≤1.05
+        extras["dot_framework_vs_rawjax"] = round(med_ratio, 3)
     except Exception as e:  # pragma: no cover
-        _fail("dot_framework", e)
-    try:
-        extras["dot_rawjax_ms"] = round(bench_dot_rawjax(), 4)
-    except Exception as e:  # pragma: no cover
-        _fail("dot_rawjax", e)
+        _fail("dot_pair", e)
     try:
         extras["dispatch_floor_ms"] = round(bench_dispatch_floor(), 4)
     except Exception as e:  # pragma: no cover
