@@ -8,7 +8,10 @@ attributes lazily forward to the numpy namespace so the long tail of
 from __future__ import annotations
 
 from .ndarray import NDArray, apply_op, apply_op_flat, array, from_jax, waitall  # noqa: F401
+from . import contrib  # noqa: F401  (mx.nd.contrib namespace)
 from . import sparse  # noqa: F401  (mx.nd.sparse namespace)
+from .optim_ops import *  # noqa: F401,F403  (functional optimizer-update ops)
+from .legacy_ops import *  # noqa: F401,F403  (legacy op long tail)
 
 # legacy CamelCase op names → npx equivalents
 _LEGACY_TO_NPX = {
@@ -53,6 +56,23 @@ _LEGACY_TO_NPX = {
     "broadcast_like": "broadcast_like",
     "sequence_mask": "sequence_mask",
     "erfinv": "erfinv",
+    "gamma": "gamma",          # Γ function (elemwise_unary_op_basic.cc)
+    "gammaln": "gammaln",
+    "digamma": "digamma",
+    # contrib corpus (npx._contrib_misc / _transformer)
+    "slice": "slice",
+    "SliceChannel": "slice_channel",
+    "slice_channel": "slice_channel",
+    "softsign": "softsign",
+    "Pad": "pad",
+    "pad": "pad",
+    "add_n": "add_n",
+    "ElementWiseSum": "add_n",
+    "CTCLoss": "ctc_loss",
+    "ctc_loss": "ctc_loss",
+    "boolean_mask": "boolean_mask",
+    "AdaptiveAvgPooling2D": "adaptive_avg_pooling2d",
+    "BilinearResize2D": "bilinear_resize2d",
 }
 
 # legacy names resolving to np-namespace ops under a different name
@@ -73,17 +93,31 @@ _LEGACY_TO_NP = {
     "elemwise_sub": "subtract",
     "elemwise_mul": "multiply",
     "elemwise_div": "true_divide",
+    # legacy broadcast_* spellings (reference elemwise_binary_broadcast_*)
+    "broadcast_plus": "add",
+    "broadcast_minus": "subtract",
+    "broadcast_mod": "mod",
+    "broadcast_power": "power",
+    "broadcast_equal": "equal",
+    "broadcast_not_equal": "not_equal",
+    "broadcast_greater": "greater",
+    "broadcast_greater_equal": "greater_equal",
+    "broadcast_lesser": "less",
+    "broadcast_lesser_equal": "less_equal",
+    "broadcast_logical_and": "logical_and",
+    "broadcast_logical_or": "logical_or",
+    "broadcast_logical_xor": "logical_xor",
+    "broadcast_hypot": "hypot",
 }
 
 
 def add_n(*args):
-    """Sum of all inputs (reference: `src/operator/tensor/elemwise_sum.cc`)."""
-    from .. import numpy as _np
+    """Sum of all inputs in ONE fused funnel call (reference:
+    `src/operator/tensor/elemwise_sum.cc`) — same path as
+    nd.ElementWiseSum."""
+    from ..numpy_extension import add_n as _npx_add_n
 
-    out = args[0]
-    for a in args[1:]:
-        out = _np.add(out, a)
-    return out
+    return _npx_add_n(*args)
 
 
 def concat(*args, dim=None, axis=None, **kwargs):  # noqa: ARG001

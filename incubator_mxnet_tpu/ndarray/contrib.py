@@ -1,0 +1,72 @@
+"""`mx.nd.contrib` namespace (reference `python/mxnet/ndarray/contrib.py`
+plus the generated `_contrib_*` registrations).
+
+Thin forwarding layer: every contrib op lives in `numpy_extension`
+(`_contrib_misc` / `_transformer` / `_graph` / `_boxes` / `_spatial`);
+this module maps the legacy `mx.nd.contrib.<name>` spellings onto them so
+reference scripts (`nd.contrib.dgl_subgraph`, `nd.contrib.ctc_loss`,
+`nd.contrib.count_sketch`, …) run unchanged.
+"""
+from __future__ import annotations
+
+_FORWARD = {
+    # graph family (dgl_graph.cc)
+    "edge_id", "getnnz", "dgl_adjacency", "dgl_subgraph",
+    "dgl_csr_neighbor_uniform_sample",
+    "dgl_csr_neighbor_non_uniform_sample", "dgl_graph_compact",
+    # misc contrib
+    "quadratic", "index_copy", "index_array", "gradientmultiplier",
+    "dynamic_reshape", "count_sketch", "hawkesll", "round_ste",
+    "sign_ste", "ctc_loss", "boolean_mask",
+    # transformer family
+    "interleaved_matmul_selfatt_qk", "interleaved_matmul_selfatt_valatt",
+    "interleaved_matmul_encdec_qk", "interleaved_matmul_encdec_valatt",
+    "div_sqrt_dim", "sldwin_atten_score", "sldwin_atten_context",
+    "sldwin_atten_mask_like",
+    # detection / vision
+    "box_iou", "box_nms", "box_encode", "box_decode", "proposal",
+    "multi_proposal", "psroi_pooling", "deformable_psroi_pooling",
+    "rroi_align", "mrcnn_mask_target",
+    "bipartite_matching", "MultiBoxPrior", "MultiBoxDetection",
+    "MultiBoxTarget", "ROIAlign", "AdaptiveAvgPooling2D",
+    "BilinearResize2D", "BatchNormWithReLU", "SyncBatchNorm",
+    "DeformableConvolution", "ModulatedDeformableConvolution",
+    "allclose", "arange_like", "fft", "ifft",
+}
+
+_RENAME = {
+    "MultiBoxPrior": "multibox_prior",
+    "MultiBoxDetection": "multibox_detection",
+    "MultiBoxTarget": "multibox_target",
+    "ROIAlign": "roi_align",
+    "AdaptiveAvgPooling2D": "adaptive_avg_pooling2d",
+    "BilinearResize2D": "bilinear_resize2d",
+    "BatchNormWithReLU": "batch_norm_with_relu",
+    "SyncBatchNorm": "sync_batch_norm",
+    "DeformableConvolution": "deformable_convolution",
+    "ModulatedDeformableConvolution": "modulated_deformable_convolution",
+    "PSROIPooling": "psroi_pooling",
+    "DeformablePSROIPooling": "deformable_psroi_pooling",
+    "RROIAlign": "rroi_align",
+    "Proposal": "proposal",
+    "MultiProposal": "multi_proposal",
+}
+
+
+def __getattr__(name):
+    if name in _FORWARD or name in _RENAME:
+        from .. import numpy_extension as npx
+
+        target = _RENAME.get(name, name)
+        fn = getattr(npx, target, None)
+        if fn is not None:
+            return fn
+        from .. import numpy as _np
+
+        if hasattr(_np, target):
+            return getattr(_np, target)
+    raise AttributeError(f"module 'nd.contrib' has no attribute {name!r}")
+
+
+def __dir__():
+    return sorted(_FORWARD | set(_RENAME))

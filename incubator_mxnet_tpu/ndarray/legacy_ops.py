@@ -1,0 +1,548 @@
+"""Legacy `mx.nd` operator long tail (reference `src/operator/` root:
+regression outputs, LRN, UpSampling, im2col/col2im, moments, activation
+variants, storage casts, legacy random distributions).
+
+These are the remaining named ops reference-era scripts call on `mx.nd`
+that have no modern `np`/`npx` spelling. Each is one funnel call;
+training-only ops whose reference backward ignores the forward value
+(`*RegressionOutput`, `SVMOutput`) use `jax.custom_vjp` to reproduce the
+reference gradient exactly.
+"""
+from __future__ import annotations
+
+import numpy as onp
+
+from .ndarray import NDArray, apply_op
+
+__all__ = [
+    "slice_axis", "crop", "reverse", "depth_to_space", "space_to_depth",
+    "im2col", "col2im", "moments", "hard_sigmoid", "mish", "log_sigmoid",
+    "rcbrt", "rsqrt", "softmax_cross_entropy", "make_loss", "MakeLoss",
+    "BlockGrad", "LRN", "UpSampling", "SoftmaxActivation",
+    "LinearRegressionOutput", "LogisticRegressionOutput",
+    "MAERegressionOutput", "SVMOutput", "IdentityAttachKLSparseReg",
+    "argmax_channel", "choose_element_0index", "size_array", "shuffle",
+    "cast_storage", "broadcast_axis", "broadcast_axes",
+    "normal", "uniform", "poisson", "exponential",
+    "negative_binomial", "generalized_negative_binomial",
+    "random_normal", "random_uniform", "random_poisson",
+    "random_exponential", "random_gamma",
+    "normal_like", "uniform_like", "poisson_like", "exponential_like",
+    "gamma_like", "negative_binomial_like",
+    "generalized_negative_binomial_like",
+]
+
+
+def _jnp():
+    import jax.numpy as jnp
+
+    return jnp
+
+
+def _jax():
+    import jax
+
+    return jax
+
+
+def slice_axis(data, axis=0, begin=0, end=None):
+    """Reference `slice_axis` (matrix_op.cc): one-axis slice."""
+    import builtins
+
+    key = [builtins.slice(None)] * data.ndim
+    key[axis] = builtins.slice(begin, end)
+    key = tuple(key)
+    return apply_op("slice_axis", lambda x: x[key], (data,),
+                    static_info=("k", axis, begin, end))
+
+
+def crop(data, begin=None, end=None, **kwargs):
+    """Deprecated alias of `slice` (reference Crop → slice)."""
+    from ..numpy_extension import slice as _slice
+
+    return _slice(data, begin=begin, end=end)
+
+
+def reverse(data, axis=0):
+    """Reference `reverse` (matrix_op.cc): flip along axis/axes."""
+    ax = tuple(axis) if isinstance(axis, (list, tuple)) else (int(axis),)
+    return apply_op("reverse", lambda x: _jnp().flip(x, axis=ax),
+                    (data,), static_info=("ax", ax))
+
+
+def depth_to_space(data, block_size):
+    """NCHW depth→space (reference depth_to_space, matrix_op.cc): DCR
+    mode like the reference kernel."""
+    b = int(block_size)
+
+    def fn(x):
+        n, c, h, w = x.shape
+        x = x.reshape(n, b, b, c // (b * b), h, w)
+        x = x.transpose(0, 3, 4, 1, 5, 2)
+        return x.reshape(n, c // (b * b), h * b, w * b)
+
+    return apply_op("depth_to_space", fn, (data,), static_info=("b", b))
+
+
+def space_to_depth(data, block_size):
+    b = int(block_size)
+
+    def fn(x):
+        n, c, h, w = x.shape
+        x = x.reshape(n, c, h // b, b, w // b, b)
+        x = x.transpose(0, 3, 5, 1, 2, 4)
+        return x.reshape(n, c * b * b, h // b, w // b)
+
+    return apply_op("space_to_depth", fn, (data,), static_info=("b", b))
+
+
+def _tup(v, n=2):
+    if v is None:
+        return (1,) * n if n == 2 else (0,) * n
+    return tuple(int(x) for x in v) if not isinstance(v, int) \
+        else (int(v),) * n
+
+
+def im2col(data, kernel, stride=(1, 1), dilate=(1, 1), pad=(0, 0)):
+    """Unfold NCHW into conv patches (reference im2col.cc): output
+    (N, C·kh·kw, L). XLA's conv_general_dilated_patches emits the same
+    gather the reference's hand-written kernel does."""
+    kh, kw = _tup(kernel)
+    sh, sw = _tup(stride)
+    dh, dw = _tup(dilate)
+    ph, pw = _tup(pad, 2) if not isinstance(pad, int) else (pad, pad)
+
+    def fn(x):
+        import jax.lax as lax
+
+        jnp = _jnp()
+        n, c = x.shape[:2]
+        patches = lax.conv_general_dilated_patches(
+            x, (kh, kw), (sh, sw), [(ph, ph), (pw, pw)],
+            rhs_dilation=(dh, dw))          # (N, C·kh·kw, OH, OW)
+        return patches.reshape(n, c * kh * kw, -1)
+
+    return apply_op("im2col", fn, (data,),
+                    static_info=("k", kh, kw, sh, sw, dh, dw, ph, pw))
+
+
+def col2im(data, output_size, kernel, stride=(1, 1), dilate=(1, 1),
+           pad=(0, 0)):
+    """Fold patches back, summing overlaps (reference col2im — the
+    transpose of im2col, here the VJP of the same XLA gather)."""
+    kh, kw = _tup(kernel)
+    sh, sw = _tup(stride)
+    dh, dw = _tup(dilate)
+    ph, pw = _tup(pad, 2) if not isinstance(pad, int) else (pad, pad)
+    oh, ow = (int(v) for v in output_size)
+
+    def fn(cols):
+        import jax
+        import jax.lax as lax
+
+        jnp = _jnp()
+        n, ckk = cols.shape[:2]
+        c = ckk // (kh * kw)
+
+        def unfold(img):
+            p = lax.conv_general_dilated_patches(
+                img, (kh, kw), (sh, sw), [(ph, ph), (pw, pw)],
+                rhs_dilation=(dh, dw))
+            return p.reshape(n, ckk, -1)
+
+        zero = jnp.zeros((n, c, oh, ow), cols.dtype)
+        _, vjp = jax.vjp(unfold, zero)
+        return vjp(cols)[0]
+
+    return apply_op("col2im", fn, (data,),
+                    static_info=("k", oh, ow, kh, kw, sh, sw, dh, dw,
+                                 ph, pw))
+
+
+def moments(data, axes=None, keepdims=False):
+    """(mean, variance) in one call (reference nn/moments-inl.h)."""
+    ax = None if axes is None else tuple(int(a) for a in axes)
+
+    def fn(x):
+        m = x.mean(axis=ax, keepdims=keepdims)
+        mk = x.mean(axis=ax, keepdims=True)
+        v = ((x - mk) ** 2).mean(axis=ax, keepdims=keepdims)
+        return m, v
+
+    return apply_op("moments", fn, (data,), n_outputs=2,
+                    static_info=("ax", ax, keepdims))
+
+
+def hard_sigmoid(data, alpha=0.2, beta=0.5):
+    return apply_op(
+        "hard_sigmoid",
+        lambda x: _jnp().clip(alpha * x + beta, 0.0, 1.0), (data,),
+        static_info=("ab", float(alpha), float(beta)))
+
+
+def mish(data):
+    """x·tanh(softplus(x)) (reference mshadow_op.h mish)."""
+    def fn(x):
+        jnp = _jnp()
+        return x * jnp.tanh(_jax().nn.softplus(x))
+
+    return apply_op("mish", fn, (data,))
+
+
+def log_sigmoid(data):
+    return apply_op("log_sigmoid", lambda x: _jax().nn.log_sigmoid(x),
+                    (data,))
+
+
+def rcbrt(data):
+    """1/∛x (reference mshadow_op.h rcbrt)."""
+    return apply_op("rcbrt", lambda x: 1.0 / _jnp().cbrt(x), (data,))
+
+
+def rsqrt(data):
+    return apply_op("rsqrt", lambda x: 1.0 / _jnp().sqrt(x), (data,))
+
+
+def softmax_cross_entropy(data, label):
+    """Total CE over the batch, (1,)-shaped (reference
+    loss_binary_op.cc)."""
+    def fn(x, y):
+        jnp = _jnp()
+        lp = _jax().nn.log_softmax(x, axis=-1)
+        picked = jnp.take_along_axis(
+            lp, y.astype("int32")[:, None], axis=1)[:, 0]
+        return -picked.sum().reshape(1)
+
+    return apply_op("softmax_cross_entropy", fn, (data, label))
+
+
+def make_loss(data, grad_scale=1.0, **kwargs):  # noqa: ARG001
+    """Gradient source marker (reference make_loss / MakeLoss): forward
+    identity, backward seeds grad_scale."""
+    jax = _jax()
+    s = float(grad_scale)
+
+    @jax.custom_vjp
+    def f(x):
+        return x
+
+    f.defvjp(lambda x: (x, None),
+             lambda _, g: (g * s,))
+    return apply_op("make_loss", f, (data,), static_info=("s", s))
+
+
+MakeLoss = make_loss
+
+
+def BlockGrad(data, **kwargs):  # noqa: N802, ARG001
+    """stop_gradient under its legacy name."""
+    return apply_op("BlockGrad",
+                    lambda x: _jax().lax.stop_gradient(x), (data,))
+
+
+def LRN(data, alpha=1e-4, beta=0.75, knorm=2.0, nsize=5):  # noqa: N802
+    """Local response normalization across channels (reference
+    nn/lrn.cc): x / (k + α/n·Σ_{window} x²)^β."""
+    n = int(nsize)
+
+    def fn(x):
+        jnp = _jnp()
+        sq = x * x
+        half = n // 2
+        pad = jnp.pad(sq, ((0, 0), (half, half), (0, 0), (0, 0)))
+        acc = sum(pad[:, i:i + x.shape[1]] for i in range(n))
+        return x / (knorm + (alpha / n) * acc) ** beta
+
+    return apply_op("LRN", fn, (data,),
+                    static_info=("p", float(alpha), float(beta),
+                                 float(knorm), n))
+
+
+def UpSampling(*data, scale=1, sample_type="nearest", num_args=1,  # noqa: N802, ARG001
+               num_filter=0, multi_input_mode="concat", **kwargs):  # noqa: ARG001
+    """NCHW upsampling (reference nn/upsampling.cc): nearest repeats;
+    bilinear resamples on the align-corners grid. With several inputs,
+    every input is upsampled to the FIRST input's output size
+    (out = shape(data[0]) · scale, per-input factor out/in), then
+    channel-concatenated or summed per `multi_input_mode`."""
+    x = data[0]
+    s = int(scale)
+    oh, ow = x.shape[2] * s, x.shape[3] * s
+    if sample_type == "nearest":
+        outs = []
+        for d in data:
+            ri, rj = oh // d.shape[2], ow // d.shape[3]
+
+            def fn(v, ri=ri, rj=rj):
+                jnp = _jnp()
+                return jnp.repeat(jnp.repeat(v, ri, axis=2), rj, axis=3)
+
+            outs.append(apply_op("UpSampling", fn, (d,),
+                                 static_info=("s", ri, rj)))
+        if len(outs) == 1:
+            return outs[0]
+        from .. import numpy as _np
+
+        if multi_input_mode == "sum":
+            total = outs[0]
+            for o in outs[1:]:
+                total = _np.add(total, o)
+            return total
+        return _np.concatenate(outs, axis=1)
+    from ..numpy_extension import bilinear_resize2d
+
+    return bilinear_resize2d(x, height=oh, width=ow)
+
+
+def SoftmaxActivation(data, mode="instance"):  # noqa: N802
+    """Deprecated SoftmaxActivation (nn/softmax_activation.cc):
+    instance → softmax over trailing dims flattened; channel → softmax
+    over axis 1."""
+    def fn(x):
+        jax = _jax()
+        if mode == "channel":
+            return jax.nn.softmax(x, axis=1)
+        flat = x.reshape(x.shape[0], -1)
+        return jax.nn.softmax(flat, axis=-1).reshape(x.shape)
+
+    return apply_op("SoftmaxActivation", fn, (data,),
+                    static_info=("m", mode))
+
+
+def _regression_output(name, fwd, bwd):
+    """Reference *RegressionOutput pattern (regression_output-inl.h):
+    forward transforms data, backward is (transform(data) − label)·scale
+    / batch regardless of the incoming gradient (the op IS the loss)."""
+    def op(data, label, grad_scale=1.0, **kwargs):  # noqa: ARG001
+        jax = _jax()
+        s = float(grad_scale)
+
+        @jax.custom_vjp
+        def f(x, y):
+            return fwd(x)
+
+        def f_fwd(x, y):
+            return fwd(x), (x, y)
+
+        def f_bwd(res, g):
+            x, y = res
+            jnp = _jnp()
+            n = x.shape[0] if x.ndim > 0 else 1
+            gx = bwd(x, y.reshape(x.shape)) * (s / max(n, 1))
+            return gx, jnp.zeros_like(y)
+
+        f.defvjp(f_fwd, f_bwd)
+        return apply_op(name, f, (data, label), static_info=("s", s))
+
+    return op
+
+
+def _sign_diff(x, y):
+    return _jnp().sign(x - y)
+
+
+LinearRegressionOutput = _regression_output(
+    "LinearRegressionOutput", lambda x: x, lambda x, y: x - y)
+MAERegressionOutput = _regression_output(
+    "MAERegressionOutput", lambda x: x, _sign_diff)
+
+
+def _sigmoid_fwd(x):
+    import jax
+
+    return jax.nn.sigmoid(x)
+
+
+LogisticRegressionOutput = _regression_output(
+    "LogisticRegressionOutput", _sigmoid_fwd,
+    lambda x, y: _sigmoid_fwd(x) - y)
+
+
+def SVMOutput(data, label, margin=1.0, regularization_coefficient=1.0,  # noqa: N802
+              use_linear=False, **kwargs):  # noqa: ARG001
+    """Reference svm_output.cc: forward identity; backward hinge (L1) or
+    squared-hinge (L2) gradient on the true-class margin."""
+    jax = _jax()
+    m = float(margin)
+    reg = float(regularization_coefficient)
+    linear = bool(use_linear)
+
+    @jax.custom_vjp
+    def f(x, y):
+        return x
+
+    def f_fwd(x, y):
+        return x, (x, y)
+
+    def f_bwd(res, g):
+        x, y = res
+        jnp = _jnp()
+        yi = y.astype("int32")
+        onehot = jax.nn.one_hot(yi, x.shape[1], dtype=x.dtype)
+        score_y = jnp.take_along_axis(x, yi[:, None], axis=1)
+        viol = (m - (score_y - x)) * (1 - onehot)   # margin violations
+        if linear:
+            mask = (viol > 0).astype(x.dtype)
+            gx = reg * (mask - mask.sum(axis=1, keepdims=True) * onehot)
+        else:
+            v = jnp.maximum(viol, 0)
+            gx = 2 * reg * (v - v.sum(axis=1, keepdims=True) * onehot)
+        return gx, jnp.zeros_like(y)
+
+    f.defvjp(f_fwd, f_bwd)
+    return apply_op("SVMOutput", f, (data, label),
+                    static_info=("p", m, reg, linear))
+
+
+def IdentityAttachKLSparseReg(data, sparseness_target=0.1, penalty=0.001,  # noqa: N802
+                              momentum=0.9, **kwargs):  # noqa: ARG001
+    """Identity with a KL sparseness regularizer attached to the
+    gradient (reference identity_attach_KL_sparse_reg.cc)."""
+    jax = _jax()
+    rho = float(sparseness_target)
+    pen = float(penalty)
+
+    @jax.custom_vjp
+    def f(x):
+        return x
+
+    def f_bwd(x, g):
+        jnp = _jnp()
+        rho_hat = jnp.mean(x, axis=0, keepdims=True)
+        kl_grad = pen * (-rho / (rho_hat + 1e-12)
+                         + (1 - rho) / (1 - rho_hat + 1e-12))
+        return (g + kl_grad,)
+
+    f.defvjp(lambda x: (x, x), f_bwd)
+    return apply_op("IdentityAttachKLSparseReg", f, (data,),
+                    static_info=("p", rho, pen))
+
+
+def argmax_channel(data):
+    """argmax over axis 1, float output (reference
+    broadcast_reduce_op_index.cc)."""
+    return apply_op(
+        "argmax_channel",
+        lambda x: _jnp().argmax(x, axis=1).astype("float32"), (data,))
+
+
+def choose_element_0index(lhs, rhs):
+    """lhs[i, rhs[i]] (reference choose_element_0index — the old pick)."""
+    def fn(x, idx):
+        jnp = _jnp()
+        return jnp.take_along_axis(
+            x, idx.astype("int32")[:, None], axis=1)[:, 0]
+
+    return apply_op("choose_element_0index", fn, (lhs, rhs))
+
+
+def size_array(data):
+    """(1,) int64 element count (reference size_array op)."""
+    return NDArray(_jnp().asarray(
+        onp.array([int(onp.prod(data.shape)) if data.shape else 1],
+                  "int64")))
+
+
+def shuffle(data, **kwargs):  # noqa: ARG001
+    """Random permutation along axis 0 (reference shuffle_op.cc), drawn
+    from the framework RNG."""
+    from ..random import next_key
+
+    key = next_key()
+
+    def fn(x):
+        import jax.random as jr
+
+        return jr.permutation(key, x, axis=0)
+
+    return apply_op("shuffle", fn, (data,))
+
+
+def cast_storage(data, stype):
+    """Convert between default/row_sparse/csr storage (reference
+    cast_storage.cc) — delegates to NDArray.tostype."""
+    return data.tostype(stype)
+
+
+def broadcast_axis(data, axis=0, size=1):
+    """Tile a 1-sized axis to `size` (reference broadcast_axis)."""
+    axes = axis if isinstance(axis, (list, tuple)) else (axis,)
+    sizes = size if isinstance(size, (list, tuple)) else (size,)
+
+    def fn(x):
+        jnp = _jnp()
+        shape = list(x.shape)
+        for a, s in zip(axes, sizes):
+            shape[a] = int(s)
+        return jnp.broadcast_to(x, shape)
+
+    return apply_op("broadcast_axis", fn, (data,),
+                    static_info=("a", tuple(axes), tuple(sizes)))
+
+
+broadcast_axes = broadcast_axis
+
+
+# ------------------------------------------------------ legacy random names
+
+def _legacy_random(np_name):
+    def op(*args, shape=None, dtype=None, **kwargs):
+        from ..numpy import random as nprandom
+
+        kwargs.pop("ctx", None)
+        if shape is not None:
+            kwargs["size"] = shape if not isinstance(shape, int) \
+                else (shape,)
+        out = getattr(nprandom, np_name)(*args, **kwargs)
+        if dtype is not None and str(out.dtype) != str(dtype):
+            out = out.astype(dtype)
+        return out
+
+    op.__name__ = np_name
+    op.__doc__ = (f"Legacy mx.nd.{np_name} (reference "
+                  f"src/operator/random/sample_op.cc) → np.random."
+                  f"{np_name}.")
+    return op
+
+
+normal = random_normal = _legacy_random("normal")
+uniform = random_uniform = _legacy_random("uniform")
+poisson = random_poisson = _legacy_random("poisson")
+exponential = random_exponential = _legacy_random("exponential")
+# NO bare `gamma` alias: reference `nd.gamma` is the Γ FUNCTION
+# (elemwise_unary_op_basic.cc); only random_gamma/sample_gamma draw
+random_gamma = _legacy_random("gamma")
+negative_binomial = _legacy_random("negative_binomial")
+
+
+def generalized_negative_binomial(mu=1.0, alpha=1.0, shape=None,
+                                  dtype=None, **kwargs):  # noqa: ARG001
+    """Gamma-Poisson mixture (reference sample_op.cc GNB): draw
+    λ ~ Gamma(1/α, α·μ), then Poisson(λ)."""
+    from ..numpy import random as nprandom
+
+    size = shape if shape is None or not isinstance(shape, int) \
+        else (shape,)
+    lam = nprandom.gamma(1.0 / alpha, alpha * mu, size=size)
+    out = nprandom.poisson(lam=lam)
+    if dtype is not None:
+        out = out.astype(dtype)
+    return out
+
+
+def _like(fn):
+    def op(data, *args, **kwargs):
+        kwargs.pop("ctx", None)
+        return fn(*args, shape=tuple(data.shape), **kwargs)
+
+    op.__name__ = fn.__name__ + "_like"
+    return op
+
+
+normal_like = _like(normal)
+uniform_like = _like(uniform)
+poisson_like = _like(poisson)
+exponential_like = _like(exponential)
+gamma_like = _like(random_gamma)
+negative_binomial_like = _like(negative_binomial)
+generalized_negative_binomial_like = _like(generalized_negative_binomial)

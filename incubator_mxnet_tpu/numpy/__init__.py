@@ -200,6 +200,14 @@ for _name in _ELEMWISE_AND_FRIENDS:
         if _name not in _g:  # don't clobber hand-written versions
             _g[_name] = _make(_name)
 
+# deprecated numpy spellings the reference still registers
+# (_np_product / _np_sometrue, np_matrix_op.cc)
+_g["product"] = _g["prod"]
+_g["sometrue"] = _g["any"]
+# array-API shift spellings (_npi_bitwise_left/right_shift)
+_g["bitwise_left_shift"] = _g["left_shift"]
+_g["bitwise_right_shift"] = _g["right_shift"]
+
 del _g, _name, _jnp_mod
 
 

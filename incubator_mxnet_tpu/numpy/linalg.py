@@ -15,7 +15,8 @@ __all__ = [
     "norm", "svd", "cholesky", "qr", "inv", "pinv", "det", "slogdet",
     "solve", "lstsq", "eig", "eigh", "eigvals", "eigvalsh", "matrix_rank",
     "matrix_power", "multi_dot", "tensorinv", "tensorsolve", "cond",
-    "gemm2", "potrf", "potri", "trsm", "trmm", "syrk", "gelqf",
+    "gemm", "gemm2", "syevd", "potrf", "potri", "trsm", "trmm", "syrk",
+    "gelqf",
     "sumlogdiag", "extractdiag", "makediag", "extracttrian", "maketrian",
     "inverse",
 ]
@@ -78,6 +79,43 @@ def gemm2(A, B, transpose_a=False, transpose_b=False, alpha=1.0, axis=-2):
         return out
 
     return apply_op_flat("linalg_gemm2", fn, (A, B), {})
+
+
+def gemm(A, B, C, transpose_a=False, transpose_b=False, alpha=1.0,
+         beta=1.0, axis=-2):
+    """alpha · op(A) @ op(B) + beta · C (reference: la_op.cc
+    linalg_gemm — the 3-operand BLAS form)."""
+    def fn(a, b, c):
+        import jax.numpy as jnp
+
+        if axis != -2:
+            a = jnp.moveaxis(a, (axis, axis + 1), (-2, -1))
+            b = jnp.moveaxis(b, (axis, axis + 1), (-2, -1))
+            c = jnp.moveaxis(c, (axis, axis + 1), (-2, -1))
+        if transpose_a:
+            a = jnp.swapaxes(a, -1, -2)
+        if transpose_b:
+            b = jnp.swapaxes(b, -1, -2)
+        out = alpha * jnp.matmul(a, b) + beta * c
+        if axis != -2:
+            out = jnp.moveaxis(out, (-2, -1), (axis, axis + 1))
+        return out
+
+    return apply_op_flat("linalg_gemm", fn, (A, B, C), {})
+
+
+def syevd(A):
+    """Symmetric eigendecomposition (reference: la_op.cc linalg_syevd):
+    returns (U, L) with A = Uᵀ·diag(L)·U — NOTE the reference stores
+    eigenvectors in ROWS of U, the transpose of jnp.linalg.eigh's
+    column convention."""
+    def fn(a):
+        import jax.numpy as jnp
+
+        w, v = jnp.linalg.eigh(a)
+        return jnp.swapaxes(v, -1, -2), w
+
+    return apply_op_flat("linalg_syevd", fn, (A,), {}, n_outputs=2)
 
 
 def potrf(A, lower=True):

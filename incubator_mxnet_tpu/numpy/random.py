@@ -212,3 +212,28 @@ def f(dfnum, dfden, size=None):
     n1 = chisquare(dfnum, size)._data / _val(dfnum)
     n2 = chisquare(dfden, size)._data / _val(dfden)
     return NDArray(n1 / n2)
+
+
+def categorical(logits, size=None, axis=-1):
+    """Draw category indices from (log-)probability rows (reference
+    `_npx__random_categorical`, src/operator/random — jax-native
+    jr.categorical)."""
+    val = logits._data if isinstance(logits, NDArray) else logits
+    shp = _shape(size) or None
+    return NDArray(_jr().categorical(next_key(), val, axis=axis,
+                                     shape=shp))
+
+
+def dirichlet(alpha, size=None):
+    """Dirichlet draw via normalized gammas (reference
+    sample_op.cc dirichlet)."""
+    import jax.numpy as jnp
+
+    a = alpha._data if isinstance(alpha, NDArray) else jnp.asarray(alpha)
+    shp = _shape(size)
+    full = (tuple(shp) + a.shape) if shp else a.shape
+    g = _jr().gamma(next_key(), a, full)
+    return NDArray(g / g.sum(axis=-1, keepdims=True))
+
+
+__all__ += ["categorical", "dirichlet"]

@@ -39,6 +39,16 @@ __all__ = [
     "gelu", "smooth_l1", "clip_global_norm",
     "box_iou", "box_nms", "box_encode", "box_decode", "bipartite_matching",
     "roi_align", "slice_like", "broadcast_like", "batch_take",
+    # contrib corpus (_contrib_misc / _transformer)
+    "quadratic", "index_copy", "index_array", "gradientmultiplier",
+    "dynamic_reshape", "count_sketch", "hawkesll", "round_ste", "sign_ste",
+    "all_finite", "multi_all_finite", "ctc_loss", "adaptive_avg_pooling2d",
+    "bilinear_resize2d", "batch_norm_with_relu", "sync_batch_norm",
+    "softsign", "pad", "norm", "slice", "slice_channel", "add_n",
+    "interleaved_matmul_selfatt_qk", "interleaved_matmul_selfatt_valatt",
+    "interleaved_matmul_encdec_qk", "interleaved_matmul_encdec_valatt",
+    "div_sqrt_dim", "sldwin_atten_score", "sldwin_atten_context",
+    "sldwin_atten_mask_like",
 ]
 
 
@@ -47,9 +57,32 @@ from ._boxes import (  # noqa: F401
     box_nms, broadcast_like, multibox_detection, multibox_prior,
     multibox_target, roi_align, slice_like,
 )
+from ._contrib_misc import (  # noqa: F401
+    adaptive_avg_pooling2d, add_n, all_finite, batch_norm_with_relu,
+    bilinear_resize2d, count_sketch, ctc_loss, dynamic_reshape,
+    gradientmultiplier, hawkesll, index_array, index_copy,
+    multi_all_finite, norm, pad, quadratic, round_ste, sign_ste,
+    slice, slice_channel, softsign, sync_batch_norm,
+)
+from ._detection import (  # noqa: F401
+    deformable_psroi_pooling, mrcnn_mask_target, multi_proposal,
+    proposal, psroi_pooling, rroi_align,
+)
+from ._graph import (  # noqa: F401
+    dgl_adjacency, dgl_csr_neighbor_non_uniform_sample,
+    dgl_csr_neighbor_uniform_sample, dgl_graph_compact, dgl_subgraph,
+    edge_id, getnnz,
+)
 from ._spatial import (  # noqa: F401
     bilinear_sampler, correlation, deformable_convolution, fft,
-    grid_generator, ifft, roi_pooling, spatial_transformer,
+    grid_generator, ifft, modulated_deformable_convolution, roi_pooling,
+    spatial_transformer,
+)
+from ._transformer import (  # noqa: F401
+    div_sqrt_dim, interleaved_matmul_encdec_qk,
+    interleaved_matmul_encdec_valatt, interleaved_matmul_selfatt_qk,
+    interleaved_matmul_selfatt_valatt, sldwin_atten_context,
+    sldwin_atten_mask_like, sldwin_atten_score,
 )
 
 

@@ -1,0 +1,465 @@
+"""Contrib operator corpus: the reference's `src/operator/contrib/` long
+tail re-implemented as jax lowerings.
+
+Reference files cited per op. Backward passes the reference hand-writes
+(`_backward_hawkesll`, `_backward_index_copy`, STE grads, …) come from
+`jax.vjp` or `jax.custom_vjp` here.
+"""
+from __future__ import annotations
+
+import math
+
+from ..ndarray.ndarray import NDArray, apply_op, apply_op_flat
+
+__all__ = [
+    "quadratic", "index_copy", "index_array", "gradientmultiplier",
+    "dynamic_reshape", "count_sketch", "hawkesll", "round_ste", "sign_ste",
+    "all_finite", "multi_all_finite", "ctc_loss", "adaptive_avg_pooling2d",
+    "bilinear_resize2d", "batch_norm_with_relu", "sync_batch_norm",
+    "softsign", "pad", "norm", "slice", "slice_channel", "add_n",
+]
+
+
+def _jnp():
+    import jax.numpy as jnp
+
+    return jnp
+
+
+def _jax():
+    import jax
+
+    return jax
+
+
+def quadratic(data, a=0.0, b=0.0, c=0.0):
+    """a·x² + b·x + c (reference `contrib/quadratic_op.cc` — the tutorial
+    op; kept for script parity)."""
+    return apply_op("quadratic",
+                    lambda x: a * x * x + b * x + c, (data,),
+                    static_info=("abc", float(a), float(b), float(c)))
+
+
+def index_copy(old_tensor, index_vector, new_tensor):
+    """Functional row copy: out = old with rows at `index_vector`
+    replaced by `new_tensor` (reference `contrib/index_copy.cc`)."""
+    def fn(old, idx, new):
+        return old.at[idx.astype("int32")].set(new)
+
+    return apply_op("index_copy", fn,
+                    (old_tensor, index_vector, new_tensor))
+
+
+def index_array(data, axes=None):
+    """Index grid of `data`: output shape data.shape + (len(axes),)
+    holding each position's coordinates (reference
+    `contrib/index_array.cc`)."""
+    axes_t = None if axes is None else tuple(int(a) for a in axes)
+
+    def fn(x):
+        jnp = _jnp()
+        sel = axes_t if axes_t is not None else tuple(range(x.ndim))
+        grids = jnp.meshgrid(*[jnp.arange(n) for n in x.shape],
+                             indexing="ij")
+        return jnp.stack([grids[a] for a in sel], axis=-1).astype("int64")
+
+    return apply_op("index_array", fn, (data,),
+                    static_info=("axes", axes_t))
+
+
+def gradientmultiplier(data, scalar=1.0):
+    """Identity forward, gradient scaled by `scalar` (reference
+    `contrib/gradient_multiplier_op.cc` — GRL / DANN training)."""
+    jax = _jax()
+    s = float(scalar)
+
+    @jax.custom_vjp
+    def f(x):
+        return x
+
+    f.defvjp(lambda x: (x, None), lambda _, g: (g * s,))
+    return apply_op("gradientmultiplier", f, (data,),
+                    static_info=("scalar", s))
+
+
+def dynamic_reshape(data, shape_like):
+    """Reshape `data` to the VALUES held in `shape_like` (reference
+    `contrib/dynamic_shape_ops.cc`). Eager-only by nature: the target
+    shape is data-dependent, which XLA cannot trace — same reason the
+    reference marks it FComputeEx-only."""
+    target = tuple(int(v) for v in shape_like.asnumpy().astype("int64"))
+    return apply_op("dynamic_reshape", lambda x: x.reshape(target),
+                    (data,), static_info=("shape", target))
+
+
+def count_sketch(data, h, s, out_dim, processing_batch_size=32):  # noqa: ARG001
+    """Count sketch projection (reference `contrib/count_sketch.cc`):
+    out[n, h[j]] += s[j] · data[n, j], h/s the hash index/sign vectors."""
+    od = int(out_dim)
+
+    def fn(x, hh, ss):
+        jnp = _jnp()
+        n = x.shape[0]
+        out = jnp.zeros((n, od), x.dtype)
+        idx = hh.astype("int32")
+        return out.at[:, idx].add(x * ss[None, :].astype(x.dtype))
+
+    return apply_op("count_sketch", fn, (data, h, s),
+                    static_info=("out_dim", od))
+
+
+def hawkesll(lda, alpha, beta, state, lags, marks, valid_length, max_time):
+    """Hawkes process log-likelihood (reference `contrib/hawkes_ll.cc`,
+    kernel at `hawkes_ll-inl.h:120`): returns (loglike (N,), out_state
+    (N, K)). lax.scan replaces the per-sample sequential CPU kernel —
+    the T-loop carries (state, last-event-time, t, ll) per sample, and
+    jax.vjp provides the gradients the reference hand-derives."""
+    def fn(mu, a, b, st0, lg, mk, vlen, mtime):
+        jnp = _jnp()
+        jax = _jax()
+        n, t_len = lg.shape
+        k = st0.shape[1]
+        mk = mk.astype("int32")
+
+        def step(carry, inp):
+            state, last, t, ll, j = carry
+            lag_j, mark_j = inp            # (N,), (N,) int
+            t = t + lag_j
+            onehot = jax.nn.one_hot(mark_j, k, dtype=st0.dtype)  # (N,K)
+            d = t - jnp.sum(last * onehot, axis=1)               # (N,)
+            bk = b[mark_j]
+            ed = jnp.exp(-bk * d)
+            mu_k = jnp.sum(mu * onehot, axis=1)
+            st_k = jnp.sum(state * onehot, axis=1)
+            lam = mu_k + a[mark_j] * bk * st_k * ed
+            comp = mu_k * d + a[mark_j] * st_k * (1.0 - ed)
+            valid = (j < vlen).astype(st0.dtype)                 # (N,)
+            ll = ll + valid * (jnp.log(lam) - comp)
+            new_state = state + onehot * ((1.0 + st_k * ed)[:, None]
+                                          - state)
+            new_last = last + onehot * (t[:, None] - last)
+            state = jnp.where((valid > 0)[:, None], new_state, state)
+            last = jnp.where((valid > 0)[:, None], new_last, last)
+            return (state, last, t, ll, j + 1), None
+
+        init = (st0, jnp.zeros((n, k), st0.dtype),
+                jnp.zeros((n,), st0.dtype), jnp.zeros((n,), st0.dtype),
+                jnp.zeros((n,), "int32"))
+        (state, last, _t, ll, _j), _ = jax.lax.scan(
+            step, init, (lg.T, mk.T))
+        # remaining compensator to max_time + state decay
+        # (hawkesll_forward_compensator, hawkes_ll-inl.h:169)
+        d = mtime[:, None] - last                               # (N,K)
+        ed = jnp.exp(-b[None, :] * d)
+        rem = mu * d + a[None, :] * state * (1.0 - ed)
+        return ll - jnp.sum(rem, axis=1), state * ed
+
+    return apply_op("hawkesll", fn,
+                    (lda, alpha, beta, state, lags, marks,
+                     valid_length, max_time), n_outputs=2)
+
+
+def _ste(name, fwd):
+    jax = _jax()
+
+    @jax.custom_vjp
+    def f(x):
+        return fwd(x)
+
+    f.defvjp(lambda x: (fwd(x), None), lambda _, g: (g,))
+    f.__name__ = name
+    return f
+
+
+def round_ste(data):
+    """round() with straight-through gradient (reference
+    `contrib/stes_op.cc` — quantization-aware training)."""
+    return apply_op("round_ste", _ste("round_ste", lambda x: _jnp().round(x)),
+                    (data,))
+
+
+def sign_ste(data):
+    """sign() with straight-through gradient (reference
+    `contrib/stes_op.cc`)."""
+    return apply_op("sign_ste", _ste("sign_ste", lambda x: _jnp().sign(x)),
+                    (data,))
+
+
+def all_finite(data, init_output=True):  # noqa: ARG001
+    """1 iff every element is finite (reference
+    `contrib/all_finite.cc` — AMP overflow check)."""
+    return apply_op(
+        "all_finite",
+        lambda x: _jnp().isfinite(x).all().astype("float32").reshape(1),
+        (data,))
+
+
+def multi_all_finite(*arrays, num_arrays=None, init_output=True):  # noqa: ARG001
+    """AND of all_finite over a list of arrays (reference
+    `contrib/all_finite.cc`)."""
+    arrs = list(arrays[0]) if len(arrays) == 1 \
+        and isinstance(arrays[0], (list, tuple)) else list(arrays)
+
+    def fn(xs):
+        jnp = _jnp()
+        ok = jnp.array(True)
+        for x in xs:
+            ok = ok & jnp.isfinite(x).all()
+        return ok.astype("float32").reshape(1)
+
+    return apply_op_flat("multi_all_finite", fn, (arrs,))
+
+
+def ctc_loss(data, label, data_lengths=None, label_lengths=None,
+             use_data_lengths=False, use_label_lengths=False,
+             blank_label="first"):
+    """Connectionist Temporal Classification loss (reference
+    `src/operator/nn/ctc_loss.cc`; the reference wraps warp-ctc /
+    cuDNN-CTC — here the standard log-domain alpha recursion runs as a
+    `lax.scan` over time, so XLA vectorizes over batch and the gradient
+    is `jax.vjp` of the recursion).
+
+    data (T, B, C) unnormalized activations (softmax applied inside,
+    like the reference), label (B, L). Returns (B,) negative
+    log-likelihood. `blank_label` 'first' → blank=0 (labels 1-based) or
+    'last' → blank=C-1."""
+    if blank_label not in ("first", "last"):
+        raise ValueError("blank_label must be 'first' or 'last'")
+
+    def fn(x, lab, dlen, llen):
+        jnp = _jnp()
+        jax = _jax()
+        t_max, b, c = x.shape
+        l_max = lab.shape[1]
+        blank = 0 if blank_label == "first" else c - 1
+        logp = jax.nn.log_softmax(x.astype("float32"), axis=-1)
+        lab = lab.astype("int32")
+        s_len = 2 * l_max + 1
+        neg_inf = jnp.float32(-1e30)
+
+        # extended label: [blank, l1, blank, l2, ..., blank]
+        ext = jnp.full((b, s_len), blank, dtype="int32")
+        ext = ext.at[:, 1::2].set(lab)
+        pos = jnp.arange(s_len)[None, :]
+        valid_s = pos < (2 * llen[:, None] + 1)
+        # skip transition allowed where ext[s] != blank and != ext[s-2]
+        ext_m2 = jnp.pad(ext, ((0, 0), (2, 0)),
+                         constant_values=blank)[:, :s_len]
+        can_skip = (ext != blank) & (ext != ext_m2) & (pos >= 2)
+
+        alpha0 = jnp.full((b, s_len), neg_inf)
+        alpha0 = alpha0.at[:, 0].set(logp[0, :, blank])
+        first_lab = ext[:, 1]
+        alpha0 = alpha0.at[:, 1].set(
+            jnp.take_along_axis(logp[0], first_lab[:, None],
+                                axis=1)[:, 0])
+        alpha0 = jnp.where(valid_s & (pos <= 1), alpha0, neg_inf)
+
+        def step(alpha, inp):
+            logp_t, t = inp
+            a_m1 = jnp.pad(alpha, ((0, 0), (1, 0)),
+                           constant_values=-1e30)[:, :s_len]
+            a_m2 = jnp.pad(alpha, ((0, 0), (2, 0)),
+                           constant_values=-1e30)[:, :s_len]
+            a_m2 = jnp.where(can_skip, a_m2, neg_inf)
+            merged = jnp.logaddexp(jnp.logaddexp(alpha, a_m1), a_m2)
+            emit = jnp.take_along_axis(logp_t, ext, axis=1)
+            new = jnp.where(valid_s, merged + emit, neg_inf)
+            # past this sample's input length the lattice is frozen
+            new = jnp.where((t < dlen)[:, None], new, alpha)
+            return new, None
+
+        ts = jnp.arange(1, t_max)
+        alpha, _ = jax.lax.scan(step, alpha0, (logp[1:], ts))
+        end = 2 * llen[:, None]                 # final blank position
+        a_end = jnp.take_along_axis(alpha, end, axis=1)[:, 0]
+        a_last = jnp.take_along_axis(
+            alpha, jnp.maximum(end - 1, 0), axis=1)[:, 0]
+        # empty label: only the all-blank path exists
+        a_last = jnp.where(llen > 0, a_last, neg_inf)
+        return -jnp.logaddexp(a_end, a_last)
+
+    import numpy as onp
+
+    t_max, b, _c = data.shape
+    l_max = label.shape[1]
+    if data_lengths is None or not use_data_lengths:
+        data_lengths = NDArray(_jnp().full((b,), t_max, dtype="int32"))
+    if label_lengths is None or not use_label_lengths:
+        # reference convention without explicit lengths: count labels
+        # until the first padding value (-1 or 0 for blank='first')
+        pad_v = 0 if blank_label == "first" else -1
+        lab_np = label.asnumpy().astype("int64")
+        lens = onp.full((b,), l_max, dtype="int32")
+        for i in range(b):
+            nz = onp.where(lab_np[i] == pad_v)[0]
+            if nz.size:
+                lens[i] = nz[0]
+        label_lengths = NDArray(_jnp().asarray(lens))
+    return apply_op("ctc_loss", fn,
+                    (data, label, data_lengths, label_lengths),
+                    static_info=("blank", blank_label))
+
+
+def adaptive_avg_pooling2d(data, output_size=1):
+    """NCHW adaptive average pooling (reference
+    `contrib/adaptive_avg_pooling.cc`): bin i covers
+    [floor(i·H/out), ceil((i+1)·H/out)) — exact reference binning."""
+    if isinstance(output_size, int):
+        oh = ow = int(output_size)
+    else:
+        oh, ow = (int(v) for v in output_size)
+
+    def fn(x):
+        jnp = _jnp()
+        n, c, h, w = x.shape
+        rows = []
+        for i in range(oh):
+            h0, h1 = (i * h) // oh, -((-(i + 1) * h) // oh)
+            cols = []
+            for j in range(ow):
+                w0, w1 = (j * w) // ow, -((-(j + 1) * w) // ow)
+                cols.append(x[:, :, h0:h1, w0:w1].mean(axis=(2, 3)))
+            rows.append(jnp.stack(cols, axis=-1))
+        return jnp.stack(rows, axis=-2)
+
+    return apply_op("adaptive_avg_pooling2d", fn, (data,),
+                    static_info=("out", oh, ow))
+
+
+def bilinear_resize2d(data, height=None, width=None, scale_height=None,
+                      scale_width=None, mode="size"):  # noqa: ARG001
+    """NCHW bilinear resize with align-corners sampling (reference
+    `contrib/bilinear_resize.cc` uses the (in-1)/(out-1) grid)."""
+    def fn(x):
+        jnp = _jnp()
+        n, c, h, w = x.shape
+        oh = int(height) if height else int(round(h * scale_height))
+        ow = int(width) if width else int(round(w * scale_width))
+        ys = (jnp.arange(oh) * ((h - 1) / max(oh - 1, 1))
+              if oh > 1 else jnp.zeros((1,)))
+        xs = (jnp.arange(ow) * ((w - 1) / max(ow - 1, 1))
+              if ow > 1 else jnp.zeros((1,)))
+        y0 = jnp.floor(ys).astype("int32")
+        x0 = jnp.floor(xs).astype("int32")
+        y1 = jnp.minimum(y0 + 1, h - 1)
+        x1 = jnp.minimum(x0 + 1, w - 1)
+        wy = (ys - y0).astype(x.dtype)[None, None, :, None]
+        wx = (xs - x0).astype(x.dtype)[None, None, None, :]
+        g = lambda yy, xx: x[:, :, yy, :][:, :, :, xx]  # noqa: E731
+        top = g(y0, x0) * (1 - wx) + g(y0, x1) * wx
+        bot = g(y1, x0) * (1 - wx) + g(y1, x1) * wx
+        return top * (1 - wy) + bot * wy
+
+    return apply_op("bilinear_resize2d", fn, (data,),
+                    static_info=("hw", height, width,
+                                 scale_height, scale_width))
+
+
+def batch_norm_with_relu(x, gamma, beta, running_mean, running_var,
+                         **kwargs):
+    """BatchNorm fused with ReLU (reference `contrib/batch_norm_relu.cc`);
+    XLA fuses the relu epilogue into the normalization kernel."""
+    from . import batch_norm, relu
+
+    return relu(batch_norm(x, gamma, beta, running_mean, running_var,
+                           **kwargs))
+
+
+def sync_batch_norm(x, gamma, beta, moving_mean, moving_var, key=None,
+                    ndev=1, **kwargs):  # noqa: ARG001
+    """Cross-device BatchNorm (reference `contrib/sync_batch_norm.cc`).
+    Under pjit with a batch-sharded input, XLA computes the GLOBAL batch
+    statistics automatically (reductions span the sharded axis), so this
+    lowers to plain batch_norm — the `key`/`ndev` machinery the
+    reference needs for explicit cross-GPU reduction has no analogue.
+    For explicit shard_map code, `gluon.nn.SyncBatchNorm` inserts the
+    psum."""
+    from . import batch_norm
+
+    return batch_norm(x, gamma, beta, moving_mean, moving_var, **kwargs)
+
+
+def softsign(data):
+    """x / (1 + |x|) (reference `mshadow_op.h` softsign)."""
+    return apply_op("softsign", lambda x: x / (1 + _jnp().abs(x)), (data,))
+
+
+def pad(data, mode="constant", pad_width=None, constant_value=0.0):
+    """Reference `src/operator/pad.cc`: pad_width is a flat 2·ndim tuple
+    (before, after per axis); modes constant/edge/reflect."""
+    pw = tuple(int(v) for v in pad_width)
+    pairs = tuple((pw[2 * i], pw[2 * i + 1]) for i in range(len(pw) // 2))
+    jmode = {"constant": "constant", "edge": "edge",
+             "reflect": "reflect"}[mode]
+
+    def fn(x):
+        jnp = _jnp()
+        if jmode == "constant":
+            return jnp.pad(x, pairs, mode="constant",
+                           constant_values=constant_value)
+        return jnp.pad(x, pairs, mode=jmode)
+
+    return apply_op("pad", fn, (data,),
+                    static_info=("pw", pairs, mode, float(constant_value)))
+
+
+def norm(data, ord=2, axis=None, keepdims=False, out=None):  # noqa: A002
+    """Matrix/vector norm op (reference `src/operator/tensor/broadcast_
+    reduce_norm_value.cc`)."""
+    ax = axis if axis is None or isinstance(axis, int) \
+        else tuple(int(a) for a in axis)
+
+    def fn(x):
+        jnp = _jnp()
+        if ord == 1:
+            return jnp.abs(x).sum(axis=ax, keepdims=keepdims)
+        return jnp.sqrt((x * x).sum(axis=ax, keepdims=keepdims))
+
+    return apply_op("norm", fn, (data,),
+                    static_info=("ord", ord, ax, keepdims), out=out)
+
+
+def slice(data, begin, end, step=None):  # noqa: A001
+    """Reference `slice` op (src/operator/tensor/matrix_op.cc): None
+    entries in begin/end mean 'from the edge'."""
+    import builtins
+
+    begin = tuple(begin)
+    end = tuple(end)
+    step = tuple(step) if step is not None else (None,) * len(begin)
+    keys = tuple(builtins.slice(b, e, s)
+                 for b, e, s in zip(begin, end, step))
+    return apply_op("slice", lambda x: x[keys], (data,),
+                    static_info=("bes", begin, end, step))
+
+
+def slice_channel(data, num_outputs, axis=1, squeeze_axis=False):
+    """SliceChannel / split into num_outputs along axis (reference
+    `src/operator/slice_channel.cc`). Returns a list."""
+    n = int(num_outputs)
+
+    def fn(x):
+        jnp = _jnp()
+        parts = jnp.split(x, n, axis=axis)
+        if squeeze_axis:
+            parts = [p.squeeze(axis) for p in parts]
+        return tuple(parts)
+
+    return list(apply_op("slice_channel", fn, (data,), n_outputs=n,
+                         static_info=("n", n, axis, bool(squeeze_axis))))
+
+
+def add_n(*args):
+    """Sum of a list of arrays in one fused kernel (reference
+    `src/operator/tensor/elemwise_sum.cc`)."""
+    arrs = list(args[0]) if len(args) == 1 \
+        and isinstance(args[0], (list, tuple)) else list(args)
+
+    def fn(xs):
+        out = xs[0]
+        for x in xs[1:]:
+            out = out + x
+        return out
+
+    return apply_op_flat("add_n", fn, (arrs,))

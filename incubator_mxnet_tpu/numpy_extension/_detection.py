@@ -1,0 +1,426 @@
+"""Region-proposal / RoI detection op family (reference
+`src/operator/contrib/proposal.cc`, `multi_proposal.cc`,
+`psroi_pooling.cc`, `deformable_psroi_pooling.cc`, `rroi_align.cc`,
+`mrcnn_mask_target.cu`).
+
+TPU-native shape discipline: every stage is fixed-size — proposals are
+top-k'd and NMS'd at static counts (matching the reference's
+rpn_pre/post_nms_top_n parameters, which already impose static sizes),
+so the whole RPN head stays jit-compilable. Bilinear sampling reuses
+the vectorized gather pattern from `_spatial.py`.
+"""
+from __future__ import annotations
+
+import math
+
+from ..ndarray.ndarray import apply_op
+
+__all__ = [
+    "proposal", "multi_proposal", "psroi_pooling",
+    "deformable_psroi_pooling", "rroi_align", "mrcnn_mask_target",
+]
+
+
+def _jnp():
+    import jax.numpy as jnp
+
+    return jnp
+
+
+def _gen_anchors(jnp, base_size, scales, ratios):
+    """Reference anchor enumeration (proposal-inl.h:200): ratios first,
+    then scales, centered on the base box."""
+    anchors = []
+    cx = cy = (base_size - 1) / 2.0
+    size = base_size * base_size
+    for r in ratios:
+        size_ratio = math.floor(size / r)
+        w = round(math.sqrt(size_ratio))
+        h = round(w * r)
+        for s in scales:
+            ws, hs = w * s, h * s
+            anchors.append([cx - (ws - 1) / 2, cy - (hs - 1) / 2,
+                            cx + (ws - 1) / 2, cy + (hs - 1) / 2])
+    return jnp.asarray(anchors, "float32")
+
+
+def _nms_keep(jnp, boxes, scores, thresh, max_out):
+    """Static-shape greedy NMS: returns `max_out` indices (padded with
+    -1). O(max_out · N) like the reference kernel."""
+    import jax
+
+    n = boxes.shape[0]
+    x1, y1, x2, y2 = (boxes[:, i] for i in range(4))
+    area = jnp.maximum(x2 - x1 + 1, 0) * jnp.maximum(y2 - y1 + 1, 0)
+
+    def body(carry, _):
+        alive, keep_i = carry
+        masked = jnp.where(alive, scores, -jnp.inf)
+        best = jnp.argmax(masked)
+        valid = masked[best] > -jnp.inf
+        bx1, by1, bx2, by2 = (boxes[best, i] for i in range(4))
+        ix1 = jnp.maximum(x1, bx1)
+        iy1 = jnp.maximum(y1, by1)
+        ix2 = jnp.minimum(x2, bx2)
+        iy2 = jnp.minimum(y2, by2)
+        inter = jnp.maximum(ix2 - ix1 + 1, 0) * \
+            jnp.maximum(iy2 - iy1 + 1, 0)
+        iou = inter / (area + area[best] - inter + 1e-12)
+        alive = alive & (iou <= thresh)
+        alive = alive.at[best].set(False)
+        return (alive, 0), jnp.where(valid, best, -1)
+
+    (_, _), kept = jax.lax.scan(body, (jnp.ones((n,), bool), 0),
+                                None, length=max_out)
+    return kept
+
+
+def _proposal_one(jnp, cls_prob, bbox_pred, im_info, anchors, stride,
+                  pre_nms, post_nms, thresh, min_size):
+    import jax
+
+    a = anchors.shape[0]
+    h, w = cls_prob.shape[-2:]
+    # foreground scores are the second half of the 2A channel block
+    scores = cls_prob[a:].reshape(a, h, w).transpose(1, 2, 0).reshape(-1)
+    deltas = bbox_pred.reshape(a, 4, h, w).transpose(2, 3, 0, 1) \
+        .reshape(-1, 4)
+    shift_x = jnp.arange(w) * stride
+    shift_y = jnp.arange(h) * stride
+    grid = jnp.stack(jnp.meshgrid(shift_y, shift_x, indexing="ij"), -1)
+    shifts = jnp.concatenate(
+        [grid[..., 1:2], grid[..., 0:1]] * 2, axis=-1)   # (H,W,4) x1y1x2y2
+    boxes = (anchors[None, None] + shifts[:, :, None]).reshape(-1, 4)
+    # bbox transform (proposal-inl.h BBoxTransformInv)
+    ws = boxes[:, 2] - boxes[:, 0] + 1
+    hs = boxes[:, 3] - boxes[:, 1] + 1
+    cx = boxes[:, 0] + ws * 0.5
+    cy = boxes[:, 1] + hs * 0.5
+    pcx = deltas[:, 0] * ws + cx
+    pcy = deltas[:, 1] * hs + cy
+    pw = jnp.exp(jnp.clip(deltas[:, 2], -10, 10)) * ws
+    ph = jnp.exp(jnp.clip(deltas[:, 3], -10, 10)) * hs
+    prop = jnp.stack([pcx - pw * 0.5, pcy - ph * 0.5,
+                      pcx + pw * 0.5, pcy + ph * 0.5], axis=1)
+    im_h, im_w, im_scale = im_info[0], im_info[1], im_info[2]
+    prop = jnp.stack([jnp.clip(prop[:, 0], 0, im_w - 1),
+                      jnp.clip(prop[:, 1], 0, im_h - 1),
+                      jnp.clip(prop[:, 2], 0, im_w - 1),
+                      jnp.clip(prop[:, 3], 0, im_h - 1)], axis=1)
+    msz = min_size * im_scale
+    keep = ((prop[:, 2] - prop[:, 0] + 1) >= msz) & \
+        ((prop[:, 3] - prop[:, 1] + 1) >= msz)
+    scores = jnp.where(keep, scores, -jnp.inf)
+    k = min(pre_nms, scores.shape[0])
+    top_s, top_i = jax.lax.top_k(scores, k)
+    top_boxes = prop[top_i]
+    kept = _nms_keep(jnp, top_boxes, top_s, thresh, post_nms)
+    safe = jnp.maximum(kept, 0)
+    out_boxes = jnp.where((kept >= 0)[:, None], top_boxes[safe], 0.0)
+    out_scores = jnp.where(kept >= 0, top_s[safe], 0.0)
+    return out_boxes, out_scores
+
+
+def proposal(cls_prob, bbox_pred, im_info, rpn_pre_nms_top_n=6000,
+             rpn_post_nms_top_n=300, threshold=0.7, rpn_min_size=16,
+             scales=(4, 8, 16, 32), ratios=(0.5, 1, 2),
+             feature_stride=16, output_score=False, iou_loss=False):  # noqa: ARG001
+    """RPN proposal generation (reference contrib/proposal.cc): anchors
+    → bbox deltas → clip → min-size filter → top-k → NMS. Output
+    (post_nms_top_n, 5) rois [batch_idx, x1, y1, x2, y2]."""
+    sc = tuple(float(s) for s in scales)
+    ra = tuple(float(r) for r in ratios)
+
+    def fn(cp, bp, info):
+        jnp = _jnp()
+        anchors = _gen_anchors(jnp, feature_stride, sc, ra)
+        boxes, scores = _proposal_one(
+            jnp, cp[0], bp[0], info[0], anchors, feature_stride,
+            int(rpn_pre_nms_top_n), int(rpn_post_nms_top_n),
+            float(threshold), float(rpn_min_size))
+        rois = jnp.concatenate(
+            [jnp.zeros((boxes.shape[0], 1), boxes.dtype), boxes], axis=1)
+        if output_score:
+            return rois, scores[:, None]
+        return rois
+
+    return apply_op("proposal", fn, (cls_prob, bbox_pred, im_info),
+                    n_outputs=2 if output_score else 1,
+                    static_info=("p", rpn_pre_nms_top_n,
+                                 rpn_post_nms_top_n, threshold,
+                                 rpn_min_size, sc, ra, feature_stride,
+                                 bool(output_score)))
+
+
+def multi_proposal(cls_prob, bbox_pred, im_info, **kwargs):
+    """Batched RPN proposals (reference contrib/multi_proposal.cc):
+    per-image proposal with the batch index in column 0."""
+    output_score = kwargs.get("output_score", False)
+    sc = tuple(float(s)
+               for s in kwargs.get("scales", (4, 8, 16, 32)))
+    ra = tuple(float(r) for r in kwargs.get("ratios", (0.5, 1, 2)))
+    stride = kwargs.get("feature_stride", 16)
+    pre = int(kwargs.get("rpn_pre_nms_top_n", 6000))
+    post = int(kwargs.get("rpn_post_nms_top_n", 300))
+    thr = float(kwargs.get("threshold", 0.7))
+    msz = float(kwargs.get("rpn_min_size", 16))
+
+    def fn(cp, bp, info):
+        jnp = _jnp()
+        anchors = _gen_anchors(jnp, stride, sc, ra)
+        all_rois, all_scores = [], []
+        for b in range(cp.shape[0]):
+            boxes, scores = _proposal_one(jnp, cp[b], bp[b], info[b],
+                                          anchors, stride, pre, post,
+                                          thr, msz)
+            idx = jnp.full((boxes.shape[0], 1), float(b), boxes.dtype)
+            all_rois.append(jnp.concatenate([idx, boxes], axis=1))
+            all_scores.append(scores[:, None])
+        rois = jnp.concatenate(all_rois, axis=0)
+        if output_score:
+            return rois, jnp.concatenate(all_scores, axis=0)
+        return rois
+
+    return apply_op("multi_proposal", fn, (cls_prob, bbox_pred, im_info),
+                    n_outputs=2 if output_score else 1,
+                    static_info=("p", pre, post, thr, msz, sc, ra,
+                                 stride, bool(output_score)))
+
+
+def psroi_pooling(data, rois, spatial_scale, output_dim, pooled_size,
+                  group_size=0):
+    """Position-sensitive RoI pooling (reference contrib/
+    psroi_pooling.cc): bin (i,j) of output channel c averages input
+    channel (c·group² + i·group + j) over the bin's region."""
+    od = int(output_dim)
+    ps = int(pooled_size)
+    gs = int(group_size) or ps
+
+    def fn(x, r):
+        jnp = _jnp()
+        n_rois = r.shape[0]
+        h, w = x.shape[-2:]
+        batch = r[:, 0].astype("int32")
+        x1 = jnp.round(r[:, 1]) * spatial_scale
+        y1 = jnp.round(r[:, 2]) * spatial_scale
+        x2 = (jnp.round(r[:, 3]) + 1) * spatial_scale
+        y2 = (jnp.round(r[:, 4]) + 1) * spatial_scale
+        rw = jnp.maximum(x2 - x1, 0.1)
+        rh = jnp.maximum(y2 - y1, 0.1)
+        bin_w, bin_h = rw / ps, rh / ps
+        imgs = x[batch]                        # (R, C, H, W)
+        ys = jnp.arange(h, dtype="float32")
+        xs = jnp.arange(w, dtype="float32")
+        outs = []
+        for i in range(ps):
+            for j in range(ps):
+                hs = jnp.floor(y1 + i * bin_h)
+                he = jnp.ceil(y1 + (i + 1) * bin_h)
+                wss = jnp.floor(x1 + j * bin_w)
+                wee = jnp.ceil(x1 + (j + 1) * bin_w)
+                my = ((ys[None, :] >= hs[:, None])
+                      & (ys[None, :] < he[:, None])).astype(x.dtype)
+                mxx = ((xs[None, :] >= wss[:, None])
+                       & (xs[None, :] < wee[:, None])).astype(x.dtype)
+                mask = my[:, :, None] * mxx[:, None, :]     # (R,H,W)
+                cnt = jnp.maximum(mask.sum(axis=(1, 2)), 1.0)
+                gi = (i * gs) // ps
+                gj = (j * gs) // ps
+                chans = jnp.arange(od) * gs * gs + gi * gs + gj
+                sel = imgs[:, chans]                        # (R,od,H,W)
+                pooled = (sel * mask[:, None]).sum(axis=(2, 3)) \
+                    / cnt[:, None]
+                outs.append(pooled)
+        out = jnp.stack(outs, axis=-1).reshape(n_rois, od, ps, ps)
+        return out
+
+    return apply_op("psroi_pooling", fn, (data, rois),
+                    static_info=("p", float(spatial_scale), od, ps, gs))
+
+
+def deformable_psroi_pooling(data, rois, trans, spatial_scale,
+                             output_dim, group_size, pooled_size,
+                             part_size=0, sample_per_part=1,
+                             trans_std=0.0, no_trans=False):
+    """Deformable PS-RoI pooling (reference contrib/
+    deformable_psroi_pooling.cc): PSROI bins shifted by learned
+    normalized offsets, values bilinearly sampled."""
+    od = int(output_dim)
+    ps = int(pooled_size)
+    gs = int(group_size) or ps
+    pt = int(part_size) or ps
+    spp = max(int(sample_per_part), 1)
+
+    def fn(x, r, tr):
+        jnp = _jnp()
+        n_rois = r.shape[0]
+        h, w = x.shape[-2:]
+        batch = r[:, 0].astype("int32")
+        x1 = jnp.round(r[:, 1]) * spatial_scale - 0.5
+        y1 = jnp.round(r[:, 2]) * spatial_scale - 0.5
+        x2 = (jnp.round(r[:, 3]) + 1) * spatial_scale - 0.5
+        y2 = (jnp.round(r[:, 4]) + 1) * spatial_scale - 0.5
+        rw = jnp.maximum(x2 - x1, 0.1)
+        rh = jnp.maximum(y2 - y1, 0.1)
+        bin_w, bin_h = rw / ps, rh / ps
+        sub_w, sub_h = bin_w / spp, bin_h / spp
+        imgs = x[batch]
+        outs = []
+        for i in range(ps):
+            for j in range(ps):
+                if no_trans:
+                    dy = jnp.zeros((n_rois,))
+                    dx = jnp.zeros((n_rois,))
+                else:
+                    pi = (i * pt) // ps
+                    pj = (j * pt) // ps
+                    cls = 0   # class-agnostic offsets (reference default)
+                    dy = tr[:, cls * 2, pi, pj] * trans_std * rh
+                    dx = tr[:, cls * 2 + 1, pi, pj] * trans_std * rw
+                acc = 0.0
+                for si in range(spp):
+                    for sj in range(spp):
+                        yy = y1 + i * bin_h + (si + 0.5) * sub_h + dy
+                        xx = x1 + j * bin_w + (sj + 0.5) * sub_w + dx
+                        y0 = jnp.floor(jnp.clip(yy, 0, h - 1))
+                        x0 = jnp.floor(jnp.clip(xx, 0, w - 1))
+                        y1i = jnp.clip(y0 + 1, 0, h - 1).astype("int32")
+                        x1i = jnp.clip(x0 + 1, 0, w - 1).astype("int32")
+                        y0i = y0.astype("int32")
+                        x0i = x0.astype("int32")
+                        wy = (jnp.clip(yy, 0, h - 1) - y0)[:, None]
+                        wx = (jnp.clip(xx, 0, w - 1) - x0)[:, None]
+                        gi = (i * gs) // ps
+                        gj = (j * gs) // ps
+                        chans = jnp.arange(od) * gs * gs + gi * gs + gj
+                        sel = imgs[:, chans]                # (R,od,H,W)
+                        ridx = jnp.arange(n_rois)
+                        v00 = sel[ridx, :, y0i, x0i]
+                        v01 = sel[ridx, :, y0i, x1i]
+                        v10 = sel[ridx, :, y1i, x0i]
+                        v11 = sel[ridx, :, y1i, x1i]
+                        acc = acc + ((1 - wy) * (1 - wx) * v00
+                                     + (1 - wy) * wx * v01
+                                     + wy * (1 - wx) * v10
+                                     + wy * wx * v11)
+                outs.append(acc / (spp * spp))
+        return jnp.stack(outs, axis=-1).reshape(n_rois, od, ps, ps)
+
+    args = (data, rois, trans)
+    return apply_op("deformable_psroi_pooling", fn, args,
+                    static_info=("p", float(spatial_scale), od, gs, ps,
+                                 pt, spp, float(trans_std),
+                                 bool(no_trans)))
+
+
+def rroi_align(data, rois, pooled_size, spatial_scale):
+    """Rotated RoI align (reference contrib/rroi_align.cc): rois
+    (R, 6) = [batch, cx, cy, w, h, angle°]; bilinear samples on the
+    rotated grid."""
+    ph, pw = (pooled_size, pooled_size) if isinstance(pooled_size, int) \
+        else tuple(pooled_size)
+
+    def fn(x, r):
+        jnp = _jnp()
+        n_rois = r.shape[0]
+        h, w = x.shape[-2:]
+        batch = r[:, 0].astype("int32")
+        cx = r[:, 1] * spatial_scale
+        cy = r[:, 2] * spatial_scale
+        rw = jnp.maximum(r[:, 3] * spatial_scale, 1.0)
+        rh = jnp.maximum(r[:, 4] * spatial_scale, 1.0)
+        theta = r[:, 5] * jnp.pi / 180.0
+        imgs = x[batch]
+        # normalized bin centers in roi frame
+        gy = (jnp.arange(ph) + 0.5) / ph - 0.5
+        gx = (jnp.arange(pw) + 0.5) / pw - 0.5
+        gyy, gxx = jnp.meshgrid(gy, gx, indexing="ij")   # (ph,pw)
+        cosT = jnp.cos(theta)[:, None, None]
+        sinT = jnp.sin(theta)[:, None, None]
+        lx = gxx[None] * rw[:, None, None]
+        ly = gyy[None] * rh[:, None, None]
+        sx = cx[:, None, None] + lx * cosT - ly * sinT
+        sy = cy[:, None, None] + lx * sinT + ly * cosT
+        sx = jnp.clip(sx, 0, w - 1)
+        sy = jnp.clip(sy, 0, h - 1)
+        x0 = jnp.floor(sx)
+        y0 = jnp.floor(sy)
+        x1 = jnp.clip(x0 + 1, 0, w - 1).astype("int32")
+        y1 = jnp.clip(y0 + 1, 0, h - 1).astype("int32")
+        wx = (sx - x0)[..., None]            # (R,ph,pw,1)
+        wy = (sy - y0)[..., None]
+        x0 = x0.astype("int32")
+        y0 = y0.astype("int32")
+        ridx = jnp.arange(n_rois)[:, None, None]
+
+        def g(yi, xi):
+            # advanced indexing broadcast → (R, ph, pw, C)
+            return imgs[ridx, :, yi, xi]
+
+        v00 = g(y0, x0)
+        v01 = g(y0, x1)
+        v10 = g(y1, x0)
+        v11 = g(y1, x1)
+        out = ((1 - wy) * (1 - wx) * v00 + (1 - wy) * wx * v01
+               + wy * (1 - wx) * v10 + wy * wx * v11)
+        return out.transpose(0, 3, 1, 2)
+
+    return apply_op("rroi_align", fn, (data, rois),
+                    static_info=("p", ph, pw, float(spatial_scale)))
+
+
+def mrcnn_mask_target(rois, gt_masks, matches, cls_targets,
+                      num_rois=None, num_classes=None, mask_size=(14, 14),
+                      sample_ratio=2, aligned=False):  # noqa: ARG001
+    """Mask R-CNN training-target generator (reference contrib/
+    mrcnn_mask_target.cu — GPU-only there; host-free jax here).
+
+    rois (B, R, 4) corner format, gt_masks (B, M, H, W), matches (B, R)
+    gt index per roi, cls_targets (B, R) class ids. Returns
+    (mask_targets (B, R, C, ms, ms), mask_cls (B, R, C, ms, ms))."""
+    ms = (mask_size, mask_size) if isinstance(mask_size, int) \
+        else tuple(mask_size)
+    mh, mw = int(ms[0]), int(ms[1])
+
+    def fn(r, gm, mt, ct):
+        import jax
+
+        jnp = _jnp()
+        b, n_r = r.shape[:2]
+        hh, ww = gm.shape[-2:]
+        # roi_align each matched gt mask down to (mh, mw)
+        gy = (jnp.arange(mh) + 0.5) / mh
+        gx = (jnp.arange(mw) + 0.5) / mw
+
+        def one(roi, mask):
+            x1, y1, x2, y2 = roi[0], roi[1], roi[2], roi[3]
+            sy = y1 + gy * jnp.maximum(y2 - y1, 1.0)
+            sx = x1 + gx * jnp.maximum(x2 - x1, 1.0)
+            sy = jnp.clip(sy, 0, hh - 1)
+            sx = jnp.clip(sx, 0, ww - 1)
+            y0 = jnp.floor(sy)
+            x0 = jnp.floor(sx)
+            y1i = jnp.clip(y0 + 1, 0, hh - 1).astype("int32")
+            x1i = jnp.clip(x0 + 1, 0, ww - 1).astype("int32")
+            wy = (sy - y0)[:, None]
+            wx = (sx - x0)[None, :]
+            y0i, x0i = y0.astype("int32"), x0.astype("int32")
+            v00 = mask[y0i][:, x0i]
+            v01 = mask[y0i][:, x1i]
+            v10 = mask[y1i][:, x0i]
+            v11 = mask[y1i][:, x1i]
+            return ((1 - wy) * (1 - wx) * v00 + (1 - wy) * wx * v01
+                    + wy * (1 - wx) * v10 + wy * wx * v11)
+
+        sampled = jax.vmap(jax.vmap(one))(
+            r, gm[jnp.arange(b)[:, None], mt.astype("int32")])
+        onehot = jax.nn.one_hot(ct.astype("int32"), num_classes,
+                                dtype=r.dtype)       # (B,R,C)
+        targets = sampled[:, :, None] * onehot[..., None, None]
+        weights = jnp.broadcast_to(onehot[..., None, None],
+                                   (b, n_r, num_classes, mh, mw))
+        return targets, weights
+
+    return apply_op("mrcnn_mask_target", fn,
+                    (rois, gt_masks, matches, cls_targets), n_outputs=2,
+                    static_info=("p", mh, mw, int(num_classes or 0)))

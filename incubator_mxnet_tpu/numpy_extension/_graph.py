@@ -1,0 +1,251 @@
+"""Graph-sampling contrib ops (reference `src/operator/contrib/
+dgl_graph.cc` — the DGL integration surface) plus `edge_id` / `getnnz` /
+`dgl_adjacency`.
+
+Design note: the reference registers every one of these CPU-only
+(`FComputeEx<cpu>`) — they are data-preparation ops that walk ragged CSR
+structure, the part of a GNN pipeline that stays on host while the dense
+message-passing math runs on the accelerator. The TPU-native translation
+keeps that split: host numpy over the CSR fields, results wrapped back
+into `CSRNDArray`/`NDArray` for the device compute that follows.
+"""
+from __future__ import annotations
+
+import numpy as onp
+
+from ..ndarray.ndarray import NDArray
+from ..ndarray.sparse import CSRNDArray
+
+__all__ = [
+    "edge_id", "getnnz", "dgl_adjacency", "dgl_subgraph",
+    "dgl_csr_neighbor_uniform_sample", "dgl_csr_neighbor_non_uniform_sample",
+    "dgl_graph_compact",
+]
+
+
+def _csr_fields(g):
+    if not isinstance(g, CSRNDArray):
+        raise TypeError("graph must be a CSRNDArray")
+    return (onp.asarray(g._sp_data), onp.asarray(g._sp_col_indices),
+            onp.asarray(g._sp_indptr), g._sp_shape)
+
+
+def _jnp():
+    import jax.numpy as jnp
+
+    return jnp
+
+
+def edge_id(data, u, v):
+    """edge_id(csr, u, v)[i] = csr[u[i], v[i]] if the edge exists else -1
+    (reference dgl_graph.cc:1326)."""
+    vals, cols, indptr, _shape = _csr_fields(data)
+    un = onp.asarray(u.asnumpy(), onp.int64)
+    vn = onp.asarray(v.asnumpy(), onp.int64)
+    out = onp.full(un.shape, -1.0, onp.float32)
+    for i, (r, c) in enumerate(zip(un, vn)):
+        lo, hi = indptr[r], indptr[r + 1]
+        hit = onp.where(cols[lo:hi] == c)[0]
+        if hit.size:
+            out[i] = vals[lo + hit[0]]
+    return NDArray(_jnp().asarray(out))
+
+
+def getnnz(data, axis=None):
+    """Stored-value count of a CSR matrix, total or per row/column
+    (reference `src/operator/contrib/nnz.cc`)."""
+    _vals, cols, indptr, shape = _csr_fields(data)
+    if axis is None:
+        return NDArray(_jnp().asarray(
+            onp.array([indptr[-1]], onp.int64)))
+    if axis == 0:   # per column
+        cnt = onp.zeros(shape[1], onp.int64)
+        onp.add.at(cnt, cols, 1)
+        return NDArray(_jnp().asarray(cnt))
+    if axis == 1:   # per row
+        return NDArray(_jnp().asarray(onp.diff(indptr).astype(onp.int64)))
+    raise ValueError("axis must be None, 0 or 1")
+
+
+def dgl_adjacency(data):
+    """Adjacency matrix of a graph CSR: same structure, data all 1.0
+    (reference dgl_graph.cc:1402)."""
+    _vals, cols, indptr, shape = _csr_fields(data)
+    return CSRNDArray(onp.ones(len(cols), onp.float32), cols, indptr,
+                      shape)
+
+
+def _induced_subgraph(vals, cols, indptr, vids):
+    """Rows/cols restricted to `vids` (renumbered by position). Returns
+    (new_data 1..n row-major, orig_data, new_cols, new_indptr)."""
+    vset = {int(v): i for i, v in enumerate(vids)}
+    new_data, orig_data, new_cols = [], [], []
+    new_indptr = [0]
+    eid = 1
+    for v in vids:
+        lo, hi = indptr[v], indptr[v + 1]
+        for k in range(lo, hi):
+            c = int(cols[k])
+            if c in vset:
+                new_data.append(eid)
+                orig_data.append(vals[k])
+                new_cols.append(vset[c])
+                eid += 1
+        new_indptr.append(len(new_cols))
+    return (onp.asarray(new_data, onp.float32),
+            onp.asarray(orig_data, onp.float32),
+            onp.asarray(new_cols, onp.int32),
+            onp.asarray(new_indptr, onp.int32))
+
+
+def dgl_subgraph(graph, *varrays, return_mapping=False, num_args=None):  # noqa: ARG001
+    """Induced subgraph per vertex set (reference dgl_graph.cc:1130):
+    first output per set has renumbered edge ids 1..n, and with
+    `return_mapping` a second CSR carries the original edge ids."""
+    vals, cols, indptr, _shape = _csr_fields(graph)
+    outs, mappings = [], []
+    for va in varrays:
+        vids = onp.asarray(va.asnumpy(), onp.int64).reshape(-1)
+        nd, od, nc, ni = _induced_subgraph(vals, cols, indptr, vids)
+        n = len(vids)
+        outs.append(CSRNDArray(nd, nc, ni, (n, n)))
+        mappings.append(CSRNDArray(od, nc, ni, (n, n)))
+    return outs + mappings if return_mapping else outs
+
+
+def _neighbor_sample(vals, cols, indptr, seeds, num_hops, num_neighbor,
+                     max_num_vertices, prob=None, rng=None):
+    rng = rng or onp.random
+    sampled = list(dict.fromkeys(int(s) for s in seeds))
+    layer = {v: 0 for v in sampled}
+    edges = {}                      # (src, dst) -> orig edge value
+    frontier = list(sampled)
+    for hop in range(1, num_hops + 1):
+        nxt = []
+        for v in frontier:
+            lo, hi = int(indptr[v]), int(indptr[v + 1])
+            nbrs = onp.arange(lo, hi)
+            if len(nbrs) > num_neighbor:
+                if prob is not None:
+                    p = prob[cols[lo:hi]].astype(onp.float64)
+                    p = p / p.sum()
+                    nbrs = rng.choice(nbrs, num_neighbor, replace=False,
+                                      p=p)
+                else:
+                    nbrs = rng.choice(nbrs, num_neighbor, replace=False)
+                nbrs = onp.sort(nbrs)
+            for k in nbrs:
+                c = int(cols[k])
+                if len(sampled) >= max_num_vertices and c not in layer:
+                    continue
+                if c not in layer:
+                    layer[c] = hop
+                    sampled.append(c)
+                    nxt.append(c)
+                edges[(v, c)] = vals[k]
+        frontier = nxt
+    sampled = sampled[:max_num_vertices]
+    return sampled, layer, edges
+
+
+def _sample_outputs(sampled, layer, edges, max_num_vertices, prob=None):
+    jnp = _jnp()
+    n = len(sampled)
+    verts = onp.zeros(max_num_vertices + 1, onp.int64)
+    verts[:n] = sampled
+    verts[-1] = n
+    ren = {v: i for i, v in enumerate(sampled)}
+    rows = [[] for _ in range(max_num_vertices)]
+    for (s, d), val in edges.items():
+        if s in ren and d in ren:
+            rows[ren[s]].append((ren[d], val))
+    data, cidx = [], []
+    indptr = [0]
+    for r in rows:
+        for c, val in sorted(r):
+            cidx.append(c)
+            data.append(val)
+        indptr.append(len(cidx))
+    sub = CSRNDArray(onp.asarray(data, onp.float32),
+                     onp.asarray(cidx, onp.int32),
+                     onp.asarray(indptr, onp.int32),
+                     (max_num_vertices, max_num_vertices))
+    layers = onp.full(max_num_vertices, -1, onp.int64)
+    for i, v in enumerate(sampled):
+        layers[i] = layer[v]
+    out = [NDArray(jnp.asarray(verts)), sub]
+    if prob is not None:
+        pr = onp.zeros(max_num_vertices, onp.float32)
+        for i, v in enumerate(sampled):
+            pr[i] = prob[v]
+        out.append(NDArray(jnp.asarray(pr)))
+    out.append(NDArray(jnp.asarray(layers)))
+    return out
+
+
+def dgl_csr_neighbor_uniform_sample(csr, *seed_arrays, num_args=None,  # noqa: ARG001
+                                    num_hops=1, num_neighbor=2,
+                                    max_num_vertices=100):
+    """Uniform neighborhood sampling for DGL (dgl_graph.cc:738). Per
+    seed array returns [vertices (max+1, last = count), sampled-edge
+    CSR, layer ids]."""
+    vals, cols, indptr, _shape = _csr_fields(csr)
+    outs = [[], [], []]
+    for sa in seed_arrays:
+        seeds = onp.asarray(sa.asnumpy(), onp.int64).reshape(-1)
+        sampled, layer, edges = _neighbor_sample(
+            vals, cols, indptr, seeds, int(num_hops), int(num_neighbor),
+            int(max_num_vertices))
+        o = _sample_outputs(sampled, layer, edges, int(max_num_vertices))
+        for i in range(3):
+            outs[i].append(o[i])
+    flat = outs[0] + outs[1] + outs[2]
+    return flat if len(seed_arrays) > 1 else \
+        [outs[0][0], outs[1][0], outs[2][0]]
+
+
+def dgl_csr_neighbor_non_uniform_sample(csr, prob, *seed_arrays,
+                                        num_args=None, num_hops=1,  # noqa: ARG001
+                                        num_neighbor=2,
+                                        max_num_vertices=100):
+    """Probability-weighted neighborhood sampling (dgl_graph.cc:842):
+    adds a per-vertex probability output after the edge CSR."""
+    vals, cols, indptr, _shape = _csr_fields(csr)
+    pn = onp.asarray(prob.asnumpy(), onp.float32).reshape(-1)
+    outs = [[], [], [], []]
+    for sa in seed_arrays:
+        seeds = onp.asarray(sa.asnumpy(), onp.int64).reshape(-1)
+        sampled, layer, edges = _neighbor_sample(
+            vals, cols, indptr, seeds, int(num_hops), int(num_neighbor),
+            int(max_num_vertices), prob=pn)
+        o = _sample_outputs(sampled, layer, edges, int(max_num_vertices),
+                            prob=pn)
+        for i in range(4):
+            outs[i].append(o[i])
+    flat = outs[0] + outs[1] + outs[2] + outs[3]
+    return flat if len(seed_arrays) > 1 else \
+        [outs[0][0], outs[1][0], outs[2][0], outs[3][0]]
+
+
+def dgl_graph_compact(*args, graph_sizes=None, return_mapping=False,
+                      num_args=None):  # noqa: ARG001
+    """Strip the trailing empty rows/columns a neighbor-sample CSR
+    carries (dgl_graph.cc compact op). Inputs: N sampled CSRs followed
+    by their N vertex arrays; `graph_sizes` the true vertex counts."""
+    n_graphs = len(args) // 2
+    graphs = args[:n_graphs]
+    vert_arrays = args[n_graphs:]
+    sizes = graph_sizes if isinstance(graph_sizes, (list, tuple)) \
+        else [graph_sizes] * n_graphs
+    outs, mappings = [], []
+    for g, _va, size in zip(graphs, vert_arrays, sizes):
+        vals, cols, indptr, _shape = _csr_fields(g)
+        size = int(size)
+        keep = indptr[size]
+        nd = onp.arange(1, keep + 1, dtype=onp.float32)
+        outs.append(CSRNDArray(nd, cols[:keep], indptr[:size + 1],
+                               (size, size)))
+        mappings.append(CSRNDArray(vals[:keep], cols[:keep],
+                                   indptr[:size + 1], (size, size)))
+    return outs + mappings if return_mapping else \
+        (outs if n_graphs > 1 else outs[0])

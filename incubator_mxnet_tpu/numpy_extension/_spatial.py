@@ -335,3 +335,23 @@ def ifft(data, compute_size=None):  # noqa: ARG001
         return (jnp.fft.ifft(comp, axis=-1).real * n).astype(x.dtype)
 
     return apply_op_flat("ifft", fn, (data,), {})
+
+
+def modulated_deformable_convolution(data, offset, mask, weight,
+                                     bias=None, kernel=(3, 3),
+                                     stride=(1, 1), pad=(0, 0),
+                                     dilate=(1, 1), num_filter=None,
+                                     num_deformable_group=1,
+                                     no_bias=False, **kwargs):  # noqa: ARG001
+    """Deformable convolution v2 (reference
+    `contrib/modulated_deformable_convolution.cc`): v1 plus a learned
+    per-tap modulation mask — delegates to `deformable_convolution`,
+    which already implements the modulated sampling path."""
+    return deformable_convolution(
+        data, offset, weight, bias=bias, kernel=kernel, stride=stride,
+        pad=pad, dilate=dilate, num_filter=num_filter,
+        num_deformable_group=num_deformable_group, no_bias=no_bias,
+        mask=mask)
+
+
+__all__.append("modulated_deformable_convolution")

@@ -1,0 +1,202 @@
+"""Transformer contrib ops: interleaved projection matmuls and Longformer
+sliding-window attention.
+
+Reference: `src/operator/contrib/transformer.cc` —
+`_contrib_interleaved_matmul_selfatt_qk/valatt` (:200 CPU kernel,
+strided batch gemm over the interleaved [q|k|v]-per-head layout),
+`_contrib_interleaved_matmul_encdec_qk/valatt`, `_contrib_div_sqrt_dim`,
+and `_contrib_sldwin_atten_{score,context,mask_like}` (:887-1100,
+mask math at `transformer-inl.h:71`).
+
+TPU-native design: the strided-gemm tricks exist to avoid CUDA transpose
+kernels; here each op is a reshape + einsum that XLA lays out onto the
+MXU directly, and jax.vjp provides the backward that the reference
+hand-writes. The sliding-window ops gather the (2w+1)-wide band with
+`take_along_axis` — O(T·w) memory like the reference, not the O(T²)
+dense score matrix.
+"""
+from __future__ import annotations
+
+import math
+
+from ..ndarray.ndarray import apply_op
+
+__all__ = [
+    "interleaved_matmul_selfatt_qk", "interleaved_matmul_selfatt_valatt",
+    "interleaved_matmul_encdec_qk", "interleaved_matmul_encdec_valatt",
+    "div_sqrt_dim", "sldwin_atten_score", "sldwin_atten_context",
+    "sldwin_atten_mask_like",
+]
+
+
+def _jnp():
+    import jax.numpy as jnp
+
+    return jnp
+
+
+def interleaved_matmul_selfatt_qk(queries_keys_values, heads):
+    """scale·Q@Kᵀ over an interleaved QKV projection.
+
+    Input (seq, batch, 3·embed) where the last dim is per-head blocks
+    [q(hd) | k(hd) | v(hd)]; output (batch·heads, seq, seq), batch-major
+    attention batches (b·heads + h), scale = 1/sqrt(head_dim).
+    """
+    def fn(qkv):
+        jnp = _jnp()
+        t, b, e3 = qkv.shape
+        hd = e3 // 3 // heads
+        x = qkv.reshape(t, b, heads, 3, hd)
+        q, k = x[..., 0, :], x[..., 1, :]
+        att = jnp.einsum("tbhd,sbhd->bhts", q, k) / math.sqrt(hd)
+        return att.reshape(b * heads, t, t)
+
+    return apply_op("interleaved_matmul_selfatt_qk", fn,
+                    (queries_keys_values,), static_info=("heads", heads))
+
+
+def interleaved_matmul_selfatt_valatt(queries_keys_values, attention, heads):
+    """attention @ V over the interleaved QKV projection.
+
+    Inputs (seq, batch, 3·embed) and (batch·heads, seq, seq); output
+    (seq, batch, embed)."""
+    def fn(qkv, att):
+        jnp = _jnp()
+        t, b, e3 = qkv.shape
+        hd = e3 // 3 // heads
+        v = qkv.reshape(t, b, heads, 3, hd)[..., 2, :]
+        a = att.reshape(b, heads, t, t)
+        out = jnp.einsum("bhts,sbhd->tbhd", a, v)
+        return out.reshape(t, b, heads * hd)
+
+    return apply_op("interleaved_matmul_selfatt_valatt", fn,
+                    (queries_keys_values, attention),
+                    static_info=("heads", heads))
+
+
+def interleaved_matmul_encdec_qk(queries, keys_values, heads):
+    """Encoder-decoder attention scores over an interleaved KV projection.
+
+    queries (seq_q, batch, embed), keys_values (seq_kv, batch, 2·embed)
+    with per-head [k(hd) | v(hd)]; output (batch·heads, seq_q, seq_kv)."""
+    def fn(q, kv):
+        jnp = _jnp()
+        tq, b, e = q.shape
+        hd = e // heads
+        qh = q.reshape(tq, b, heads, hd)
+        k = kv.reshape(kv.shape[0], b, heads, 2, hd)[..., 0, :]
+        att = jnp.einsum("tbhd,sbhd->bhts", qh, k) / math.sqrt(hd)
+        return att.reshape(b * heads, tq, kv.shape[0])
+
+    return apply_op("interleaved_matmul_encdec_qk", fn,
+                    (queries, keys_values), static_info=("heads", heads))
+
+
+def interleaved_matmul_encdec_valatt(keys_values, attention, heads):
+    """attention @ V for encoder-decoder attention; output
+    (seq_q, batch, embed)."""
+    def fn(kv, att):
+        jnp = _jnp()
+        tk, b, e2 = kv.shape
+        hd = e2 // 2 // heads
+        v = kv.reshape(tk, b, heads, 2, hd)[..., 1, :]
+        tq = att.shape[1]
+        a = att.reshape(b, heads, tq, tk)
+        out = jnp.einsum("bhts,sbhd->tbhd", a, v)
+        return out.reshape(tq, b, heads * hd)
+
+    return apply_op("interleaved_matmul_encdec_valatt", fn,
+                    (keys_values, attention), static_info=("heads", heads))
+
+
+def div_sqrt_dim(data):
+    """data / sqrt(data.shape[-1]) (reference transformer.cc
+    `_contrib_div_sqrt_dim`)."""
+    return apply_op(
+        "div_sqrt_dim",
+        lambda x: x / math.sqrt(x.shape[-1]), (data,))
+
+
+def _band_positions(jnp, t, w, w_len, dilation):
+    """pos[i, h, j] = i + (j - w)·dilation[h] — the key position that
+    window slot j of query i addresses (slot w is the diagonal; causal
+    mode simply truncates to the left half [0..w])."""
+    i = jnp.arange(t)[:, None, None]
+    j = jnp.arange(w_len)[None, None, :]
+    return i + (j - w) * dilation.astype("int32")[None, :, None]
+
+
+def sldwin_atten_score(query, key, dilation, w=None, symmetric=True):
+    """Longformer sliding-window attention scores.
+
+    query/key (batch, seq, heads, hd), dilation (heads,); output
+    (batch, seq, heads, 2w+1) (symmetric) or (batch, seq, heads, w+1)
+    (causal). Out-of-range slots are 0 — `sldwin_atten_mask_like`
+    produces the matching mask."""
+    w = int(w)
+    # causal w_len = w+1 truncates the band to slots [-w..0] — the same
+    # j - w offset formula covers both modes
+    w_len = 2 * w + 1 if symmetric else w + 1
+
+    def fn(q, k, dil):
+        jnp = _jnp()
+        b, t, h, hd = q.shape
+        pos = _band_positions(jnp, t, w, w_len, dil)
+        valid = (pos >= 0) & (pos < t)
+        posc = jnp.clip(pos, 0, t - 1)
+        k5 = k[:, :, :, None, :]                     # (b,t,h,1,hd)
+        ind = posc[None, :, :, :, None]              # (1,t,h,wl,1)
+        kg = jnp.take_along_axis(k5, ind, axis=1)    # (b,t,h,wl,hd)
+        score = jnp.einsum("bihd,bihjd->bihj", q, kg)
+        return score * valid[None].astype(score.dtype)
+
+    return apply_op("sldwin_atten_score", fn, (query, key, dilation),
+                    static_info=("w", w, "sym", bool(symmetric)))
+
+
+def sldwin_atten_context(score, value, dilation, w=None, symmetric=True):
+    """Context vectors from sliding-window scores: output
+    (batch, seq, heads, hd)."""
+    w = int(w)
+    w_len = 2 * w + 1 if symmetric else w + 1
+
+    def fn(s, v, dil):
+        jnp = _jnp()
+        b, t, h, hd = v.shape
+        pos = _band_positions(jnp, t, w, w_len, dil)
+        valid = (pos >= 0) & (pos < t)
+        posc = jnp.clip(pos, 0, t - 1)
+        v5 = v[:, :, :, None, :]
+        ind = posc[None, :, :, :, None]
+        vg = jnp.take_along_axis(v5, ind, axis=1)    # (b,t,h,wl,hd)
+        s = s * valid[None].astype(s.dtype)
+        return jnp.einsum("bihj,bihjd->bihd", s, vg)
+
+    return apply_op("sldwin_atten_context", fn, (score, value, dilation),
+                    static_info=("w", w, "sym", bool(symmetric)))
+
+
+def sldwin_atten_mask_like(score, dilation, valid_length, w=None,
+                           symmetric=True):
+    """0/1 mask matching `sldwin_atten_score`'s output — exact port of
+    the reference mask math (`transformer-inl.h:71` SldWinAttenMaskLike,
+    including the integer-division dilation boundaries)."""
+    w = int(w)
+    w_len = 2 * w + 1 if symmetric else w + 1
+
+    def fn(s, dil, vlen):
+        jnp = _jnp()
+        b, t, h, _ = s.shape
+        i = jnp.arange(t)[None, :, None, None]           # seq idx
+        j = jnp.arange(w_len)[None, None, None, :]       # win idx
+        d = dil.astype("int32")[None, None, :, None]
+        vl = vlen.astype("int32")[:, None, None, None]
+        is_zero = (j < (w - i // d)) | (i >= vl)
+        if symmetric:
+            is_zero = is_zero | ((w_len - j - 1) < (w - (vl - i - 1) // d))
+        return jnp.where(is_zero, 0.0, 1.0).astype(s.dtype) \
+            * jnp.ones_like(s)
+
+    return apply_op("sldwin_atten_mask_like", fn,
+                    (score, dilation, valid_length),
+                    static_info=("w", w, "sym", bool(symmetric)))

@@ -117,3 +117,157 @@ def random_flip_top_bottom(data, p=0.5):
         (data if isinstance(data, NDArray) else NDArray(data))
 
 
+
+
+def _uniform_factor(lo, hi):
+    import numpy as onp
+
+    return float(onp.random.uniform(lo, hi))
+
+
+def random_brightness(data, min_factor, max_factor):
+    """Scale pixel values by U(min,max) (reference
+    `src/operator/image/image_random.cc` RandomBrightness)."""
+    f = _uniform_factor(min_factor, max_factor)
+    return apply_op("image_random_brightness", lambda x: x * f, (data,),
+                    static_info=("f", f))
+
+
+def random_contrast(data, min_factor, max_factor):
+    """Blend with the mean gray value (reference RandomContrast)."""
+    f = _uniform_factor(min_factor, max_factor)
+
+    def fn(x):
+        jnp = _jnp()
+        coef = jnp.asarray([0.299, 0.587, 0.114], x.dtype)
+        gray = (x * coef).sum(axis=-1, keepdims=True).mean()
+        return f * x + (1.0 - f) * gray
+
+    return apply_op("image_random_contrast", fn, (data,),
+                    static_info=("f", f))
+
+
+def random_saturation(data, min_factor, max_factor):
+    """Blend with the per-pixel gray image (reference
+    RandomSaturation)."""
+    f = _uniform_factor(min_factor, max_factor)
+
+    def fn(x):
+        jnp = _jnp()
+        coef = jnp.asarray([0.299, 0.587, 0.114], x.dtype)
+        gray = (x * coef).sum(axis=-1, keepdims=True)
+        return f * x + (1.0 - f) * gray
+
+    return apply_op("image_random_saturation", fn, (data,),
+                    static_info=("f", f))
+
+
+def random_hue(data, min_factor, max_factor):
+    """Rotate hue via the YIQ linear approximation the reference kernel
+    uses (image_random-inl.h RandomHue)."""
+    import math
+
+    f = _uniform_factor(min_factor, max_factor)
+    alpha = math.pi * f
+
+    def fn(x):
+        jnp = _jnp()
+        u, w = math.cos(alpha), math.sin(alpha)
+        t_yiq = jnp.asarray([[0.299, 0.587, 0.114],
+                             [0.596, -0.274, -0.321],
+                             [0.211, -0.523, 0.311]], x.dtype)
+        t_rgb = jnp.asarray([[1.0, 0.956, 0.621],
+                             [1.0, -0.272, -0.647],
+                             [1.0, -1.107, 1.705]], x.dtype)
+        rot = jnp.asarray([[1.0, 0.0, 0.0],
+                           [0.0, u, -w],
+                           [0.0, w, u]], x.dtype)
+        m = t_rgb @ rot @ t_yiq
+        return x @ m.T
+
+    return apply_op("image_random_hue", fn, (data,),
+                    static_info=("f", f))
+
+
+def random_color_jitter(data, brightness=0.0, contrast=0.0,
+                        saturation=0.0, hue=0.0):
+    """Brightness/contrast/saturation/hue jitter in random order
+    (reference RandomColorJitter)."""
+    import numpy as onp
+
+    augs = []
+    if brightness > 0:
+        augs.append(lambda d: random_brightness(
+            d, 1 - brightness, 1 + brightness))
+    if contrast > 0:
+        augs.append(lambda d: random_contrast(d, 1 - contrast,
+                                              1 + contrast))
+    if saturation > 0:
+        augs.append(lambda d: random_saturation(d, 1 - saturation,
+                                                1 + saturation))
+    if hue > 0:
+        augs.append(lambda d: random_hue(d, -hue, hue))
+    for i in onp.random.permutation(len(augs)):
+        data = augs[int(i)](data)
+    return data
+
+
+def adjust_lighting(data, alpha):
+    """AlexNet-style PCA lighting shift (reference AdjustLighting):
+    alpha (3,) weights on the fixed RGB eigenbasis."""
+    def fn(x, al):
+        jnp = _jnp()
+        eigval = jnp.asarray([55.46, 4.794, 1.148], x.dtype)
+        eigvec = jnp.asarray([[-0.5675, 0.7192, 0.4009],
+                              [-0.5808, -0.0045, -0.8140],
+                              [-0.5836, -0.6948, 0.4203]], x.dtype)
+        shift = (eigvec * (al * eigval)).sum(axis=1)
+        return x + shift
+
+    return apply_op("image_adjust_lighting", fn, (data, alpha))
+
+
+def random_lighting(data, alpha_std=0.05):
+    """adjust_lighting with alpha ~ N(0, alpha_std) (reference
+    RandomLighting)."""
+    import numpy as onp
+
+    al = NDArray(_jnp().asarray(
+        onp.random.normal(0.0, alpha_std, 3).astype("float32")))
+    return adjust_lighting(data, al)
+
+
+def random_resized_crop(data, size, scale=(0.08, 1.0),
+                        ratio=(3 / 4, 4 / 3), interp=1):
+    """Random area+aspect crop then resize (reference
+    `_image_random_resized_crop` / gluon RandomResizedCrop semantics)."""
+    import math
+
+    import numpy as onp
+
+    h, w = data.shape[0], data.shape[1]
+    area = h * w
+    out_w, out_h = (size, size) if isinstance(size, int) else size
+    for _ in range(10):
+        target = onp.random.uniform(*scale) * area
+        log_r = onp.random.uniform(math.log(ratio[0]),
+                                   math.log(ratio[1]))
+        ar = math.exp(log_r)
+        cw = int(round(math.sqrt(target * ar)))
+        ch = int(round(math.sqrt(target / ar)))
+        if cw <= w and ch <= h:
+            x0 = onp.random.randint(0, w - cw + 1)
+            y0 = onp.random.randint(0, h - ch + 1)
+            patch = crop(data, x0, y0, cw, ch)
+            return resize(patch, (out_w, out_h), interp=interp)
+    # fallback: center crop at the valid aspect closest to requested
+    cw, ch = min(w, h * ratio[1]), min(h, w / ratio[0])
+    cw, ch = int(cw), int(ch)
+    x0, y0 = (w - cw) // 2, (h - ch) // 2
+    return resize(crop(data, x0, y0, cw, ch), (out_w, out_h),
+                  interp=interp)
+
+
+__all__ += ["random_brightness", "random_contrast", "random_saturation",
+            "random_hue", "random_color_jitter", "adjust_lighting",
+            "random_lighting", "random_resized_crop"]

@@ -1,0 +1,338 @@
+"""Contrib operator corpus depth tests (reference:
+`src/operator/contrib/` — transformer interleaved matmuls, Longformer
+sliding-window attention, CTC, Hawkes, count_sketch, STE, index ops).
+
+CTC is validated against torch.nn.functional.ctc_loss (an independent
+implementation of the same recursion); the attention ops against
+plain-numpy einsum oracles.
+"""
+import numpy as onp
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import autograd, np, npx
+
+
+def _r(*shape, seed=0, scale=1.0):
+    return np.array((onp.random.RandomState(seed)
+                     .uniform(-1, 1, shape) * scale).astype("float32"))
+
+
+def test_quadratic():
+    x = _r(2, 3)
+    out = npx.quadratic(x, a=2.0, b=-1.0, c=0.5)
+    xn = x.asnumpy()
+    onp.testing.assert_allclose(out.asnumpy(), 2 * xn * xn - xn + 0.5,
+                                rtol=1e-6)
+
+
+def test_index_copy():
+    old = np.zeros((5, 3))
+    new = _r(2, 3)
+    idx = np.array(onp.array([1, 3], "int32"))
+    out = npx.index_copy(old, idx, new)
+    expect = onp.zeros((5, 3), "float32")
+    expect[[1, 3]] = new.asnumpy()
+    onp.testing.assert_allclose(out.asnumpy(), expect)
+
+
+def test_index_array():
+    x = np.zeros((2, 3))
+    out = npx.index_array(x)
+    assert out.shape == (2, 3, 2)
+    assert out.asnumpy()[1, 2].tolist() == [1, 2]
+    out2 = npx.index_array(x, axes=(1,))
+    assert out2.shape == (2, 3, 1)
+    assert out2.asnumpy()[1, 2, 0] == 2
+
+
+def test_gradientmultiplier_scales_grad_only():
+    x = _r(3)
+    x.attach_grad()
+    with autograd.record():
+        y = npx.gradientmultiplier(x, scalar=-0.5)
+        loss = (y * y).sum()
+    loss.backward()
+    onp.testing.assert_allclose(y.asnumpy(), x.asnumpy(), rtol=1e-6)
+    onp.testing.assert_allclose(x.grad.asnumpy(), -0.5 * 2 * x.asnumpy(),
+                                rtol=1e-5)
+
+
+def test_ste_ops():
+    x = np.array(onp.array([-1.4, -0.2, 0.6, 2.3], "float32"))
+    x.attach_grad()
+    with autograd.record():
+        y = npx.round_ste(x)
+        y.backward()
+    onp.testing.assert_allclose(y.asnumpy(), [-1, 0, 1, 2])
+    onp.testing.assert_allclose(x.grad.asnumpy(), onp.ones(4))
+    x.attach_grad()
+    with autograd.record():
+        z = npx.sign_ste(x)
+        z.backward()
+    onp.testing.assert_allclose(z.asnumpy(), [-1, -1, 1, 1])
+    onp.testing.assert_allclose(x.grad.asnumpy(), onp.ones(4))
+
+
+def test_count_sketch():
+    d, od = 6, 4
+    x = _r(3, d)
+    h = np.array(onp.array([0, 1, 1, 3, 0, 2], "int32"))
+    s = np.array(onp.array([1, -1, 1, 1, -1, 1], "float32"))
+    out = npx.count_sketch(x, h, s, out_dim=od)
+    expect = onp.zeros((3, od), "float32")
+    xn, hn, sn = x.asnumpy(), h.asnumpy(), s.asnumpy()
+    for j in range(d):
+        expect[:, hn[j]] += sn[j] * xn[:, j]
+    onp.testing.assert_allclose(out.asnumpy(), expect, rtol=1e-5,
+                                atol=1e-6)
+
+
+def test_all_finite():
+    ok = npx.all_finite(_r(3, 3))
+    assert float(ok.asnumpy()[0]) == 1.0
+    bad = np.array(onp.array([1.0, onp.inf], "float32"))
+    assert float(npx.all_finite(bad).asnumpy()[0]) == 0.0
+    both = npx.multi_all_finite([_r(2), bad])
+    assert float(both.asnumpy()[0]) == 0.0
+
+
+def test_dynamic_reshape():
+    x = _r(2, 6)
+    shp = np.array(onp.array([3, 4], "int64"))
+    assert npx.dynamic_reshape(x, shp).shape == (3, 4)
+
+
+def test_softsign_pad_norm_slice_add_n():
+    x = _r(2, 3)
+    onp.testing.assert_allclose(
+        npx.softsign(x).asnumpy(),
+        x.asnumpy() / (1 + onp.abs(x.asnumpy())), rtol=1e-6)
+    p = npx.pad(_r(1, 1, 2, 2), mode="constant",
+                pad_width=(0, 0, 0, 0, 1, 1, 1, 1), constant_value=9.0)
+    assert p.shape == (1, 1, 4, 4)
+    assert p.asnumpy()[0, 0, 0, 0] == 9.0
+    n = npx.norm(x, ord=2, axis=1)
+    onp.testing.assert_allclose(
+        n.asnumpy(), onp.linalg.norm(x.asnumpy(), axis=1), rtol=1e-5)
+    s = npx.slice(x, begin=(0, 1), end=(2, 3))
+    onp.testing.assert_allclose(s.asnumpy(), x.asnumpy()[0:2, 1:3])
+    parts = npx.slice_channel(_r(2, 4), num_outputs=2, axis=1)
+    assert len(parts) == 2 and parts[0].shape == (2, 2)
+    tot = npx.add_n(x, x, x)
+    onp.testing.assert_allclose(tot.asnumpy(), 3 * x.asnumpy(), rtol=1e-6)
+
+
+def test_adaptive_avg_pooling2d():
+    x = _r(1, 2, 6, 6)
+    out = npx.adaptive_avg_pooling2d(x, output_size=2)
+    assert out.shape == (1, 2, 2, 2)
+    onp.testing.assert_allclose(
+        out.asnumpy()[0, 0, 0, 0],
+        x.asnumpy()[0, 0, :3, :3].mean(), rtol=1e-5)
+    # global pooling
+    g = npx.adaptive_avg_pooling2d(x, output_size=1)
+    onp.testing.assert_allclose(
+        g.asnumpy()[0, 1, 0, 0], x.asnumpy()[0, 1].mean(), rtol=1e-5)
+
+
+def test_bilinear_resize2d_align_corners():
+    x = np.array(onp.arange(4, dtype="float32").reshape(1, 1, 2, 2))
+    out = npx.bilinear_resize2d(x, height=3, width=3)
+    expect = onp.array([[0, .5, 1], [1, 1.5, 2], [2, 2.5, 3]], "float32")
+    onp.testing.assert_allclose(out.asnumpy()[0, 0], expect, rtol=1e-5)
+
+
+def test_interleaved_matmul_selfatt_roundtrip():
+    t, b, h, hd = 5, 2, 3, 4
+    qkv = _r(t, b, 3 * h * hd)
+    att = npx.interleaved_matmul_selfatt_qk(qkv, heads=h)
+    assert att.shape == (b * h, t, t)
+    # oracle
+    x = qkv.asnumpy().reshape(t, b, h, 3, hd)
+    q, k, v = x[..., 0, :], x[..., 1, :], x[..., 2, :]
+    expect = onp.einsum("tbhd,sbhd->bhts", q, k) / onp.sqrt(hd)
+    onp.testing.assert_allclose(att.asnumpy(),
+                                expect.reshape(b * h, t, t), rtol=1e-4,
+                                atol=1e-5)
+    out = npx.interleaved_matmul_selfatt_valatt(qkv, att, heads=h)
+    assert out.shape == (t, b, h * hd)
+    ctx = onp.einsum("bhts,sbhd->tbhd", expect, v).reshape(t, b, h * hd)
+    onp.testing.assert_allclose(out.asnumpy(), ctx, rtol=1e-4, atol=1e-5)
+
+
+def test_interleaved_matmul_encdec():
+    tq, tk, b, h, hd = 4, 6, 2, 2, 3
+    q = _r(tq, b, h * hd)
+    kv = _r(tk, b, 2 * h * hd, seed=1)
+    att = npx.interleaved_matmul_encdec_qk(q, kv, heads=h)
+    assert att.shape == (b * h, tq, tk)
+    qn = q.asnumpy().reshape(tq, b, h, hd)
+    kvn = kv.asnumpy().reshape(tk, b, h, 2, hd)
+    expect = onp.einsum("tbhd,sbhd->bhts", qn,
+                        kvn[..., 0, :]) / onp.sqrt(hd)
+    onp.testing.assert_allclose(att.asnumpy(),
+                                expect.reshape(b * h, tq, tk),
+                                rtol=1e-4, atol=1e-5)
+    out = npx.interleaved_matmul_encdec_valatt(kv, att, heads=h)
+    assert out.shape == (tq, b, h * hd)
+
+
+def test_div_sqrt_dim():
+    x = _r(2, 8)
+    onp.testing.assert_allclose(npx.div_sqrt_dim(x).asnumpy(),
+                                x.asnumpy() / onp.sqrt(8), rtol=1e-6)
+
+
+def _sldwin_oracle_score(q, k, dil, w, symmetric):
+    b, t, h, hd = q.shape
+    wl = 2 * w + 1 if symmetric else w + 1
+    out = onp.zeros((b, t, h, wl), "float32")
+    for bi in range(b):
+        for i in range(t):
+            for hi in range(h):
+                for j in range(wl):
+                    pos = i + (j - w) * dil[hi]
+                    if 0 <= pos < t:
+                        out[bi, i, hi, j] = q[bi, i, hi] @ k[bi, pos, hi]
+    return out
+
+
+@pytest.mark.parametrize("symmetric", [True, False])
+def test_sldwin_atten(symmetric):
+    b, t, h, hd, w = 2, 7, 2, 3, 2
+    q, k, v = _r(b, t, h, hd), _r(b, t, h, hd, seed=1), \
+        _r(b, t, h, hd, seed=2)
+    dil = np.array(onp.array([1, 2], "int32"))
+    score = npx.sldwin_atten_score(q, k, dil, w=w, symmetric=symmetric)
+    expect = _sldwin_oracle_score(q.asnumpy(), k.asnumpy(),
+                                  dil.asnumpy(), w, symmetric)
+    onp.testing.assert_allclose(score.asnumpy(), expect, rtol=1e-4,
+                                atol=1e-5)
+    ctx = npx.sldwin_atten_context(score, v, dil, w=w,
+                                   symmetric=symmetric)
+    assert ctx.shape == (b, t, h, hd)
+    # oracle context
+    wl = score.shape[-1]
+    exp_ctx = onp.zeros((b, t, h, hd), "float32")
+    vn, sn = v.asnumpy(), score.asnumpy()
+    for bi in range(b):
+        for i in range(t):
+            for hi in range(h):
+                for j in range(wl):
+                    pos = i + (j - w) * int(dil.asnumpy()[hi])
+                    if 0 <= pos < t:
+                        exp_ctx[bi, i, hi] += sn[bi, i, hi, j] * \
+                            vn[bi, pos, hi]
+    onp.testing.assert_allclose(ctx.asnumpy(), exp_ctx, rtol=1e-4,
+                                atol=1e-5)
+    mask = npx.sldwin_atten_mask_like(
+        score, dil, np.array(onp.array([t, t - 2], "int32")), w=w,
+        symmetric=symmetric)
+    assert mask.shape == score.shape
+    mn = mask.asnumpy()
+    # reference mask formula spot checks: row 0 head 0 masks the w left
+    # out-of-range slots; rows past valid_length are fully masked
+    assert mn[0, 0, 0, 0] == 0.0
+    assert mn[1, t - 1].max() == 0.0
+
+
+def test_ctc_loss_vs_torch():
+    torch = pytest.importorskip("torch")
+    t, b, c, l = 8, 3, 5, 3
+    rng = onp.random.RandomState(0)
+    logits = rng.uniform(-2, 2, (t, b, c)).astype("float32")
+    labels = rng.randint(1, c, (b, l)).astype("int32")  # blank='first'=0
+    out = npx.ctc_loss(np.array(logits), np.array(labels))
+    tl = torch.nn.functional.ctc_loss(
+        torch.log_softmax(torch.tensor(logits), dim=-1),
+        torch.tensor(labels.astype("int64")),
+        input_lengths=torch.full((b,), t, dtype=torch.long),
+        target_lengths=torch.full((b,), l, dtype=torch.long),
+        blank=0, reduction="none")
+    onp.testing.assert_allclose(out.asnumpy(), tl.numpy(), rtol=1e-4,
+                                atol=1e-4)
+
+
+def test_ctc_loss_variable_lengths_vs_torch():
+    torch = pytest.importorskip("torch")
+    t, b, c, l = 10, 2, 6, 4
+    rng = onp.random.RandomState(1)
+    logits = rng.uniform(-2, 2, (t, b, c)).astype("float32")
+    labels = rng.randint(1, c, (b, l)).astype("int32")
+    dlen = onp.array([10, 7], "int32")
+    llen = onp.array([4, 2], "int32")
+    out = npx.ctc_loss(np.array(logits), np.array(labels),
+                       data_lengths=np.array(dlen),
+                       label_lengths=np.array(llen),
+                       use_data_lengths=True, use_label_lengths=True)
+    tl = torch.nn.functional.ctc_loss(
+        torch.log_softmax(torch.tensor(logits), dim=-1),
+        torch.tensor(labels.astype("int64")),
+        input_lengths=torch.tensor(dlen.astype("int64")),
+        target_lengths=torch.tensor(llen.astype("int64")),
+        blank=0, reduction="none")
+    onp.testing.assert_allclose(out.asnumpy(), tl.numpy(), rtol=1e-4,
+                                atol=1e-4)
+
+
+def test_ctc_loss_grad_flows():
+    x = _r(6, 2, 5, scale=2.0)
+    lab = np.array(onp.array([[1, 2], [3, 1]], "int32"))
+    x.attach_grad()
+    with autograd.record():
+        loss = npx.ctc_loss(x, lab).sum()
+    loss.backward()
+    g = x.grad.asnumpy()
+    assert onp.isfinite(g).all() and onp.abs(g).max() > 0
+
+
+def test_hawkesll_matches_loop_oracle():
+    n, t, k = 2, 4, 3
+    rng = onp.random.RandomState(0)
+    mu = rng.uniform(0.5, 1.5, (n, k)).astype("float32")
+    alpha = rng.uniform(0.1, 0.4, (k,)).astype("float32")
+    beta = rng.uniform(0.5, 2.0, (k,)).astype("float32")
+    state = onp.zeros((n, k), "float32")
+    lags = rng.uniform(0.1, 0.6, (n, t)).astype("float32")
+    marks = rng.randint(0, k, (n, t)).astype("int32")
+    vlen = onp.array([4, 2], "float32")
+    mtime = onp.array([3.0, 2.5], "float32")
+
+    ll, out_state = npx.hawkesll(
+        np.array(mu), np.array(alpha), np.array(beta), np.array(state),
+        np.array(lags), np.array(marks), np.array(vlen), np.array(mtime))
+
+    # direct port of hawkes_ll-inl.h:120 as the oracle
+    exp_ll = onp.zeros(n)
+    exp_state = state.copy().astype("float64")
+    for i in range(n):
+        last = onp.zeros(k)
+        tt = 0.0
+        for j in range(int(vlen[i])):
+            ci = marks[i, j]
+            tt += lags[i, j]
+            d = tt - last[ci]
+            ed = onp.exp(-beta[ci] * d)
+            lam = mu[i, ci] + alpha[ci] * beta[ci] * exp_state[i, ci] * ed
+            comp = mu[i, ci] * d + alpha[ci] * exp_state[i, ci] * (1 - ed)
+            exp_ll[i] += onp.log(lam) - comp
+            exp_state[i, ci] = 1 + exp_state[i, ci] * ed
+            last[ci] = tt
+        d = mtime[i] - last
+        ed = onp.exp(-beta * d)
+        exp_ll[i] -= (mu[i] * d + alpha * exp_state[i] * (1 - ed)).sum()
+        exp_state[i] *= ed
+    onp.testing.assert_allclose(ll.asnumpy(), exp_ll, rtol=1e-4)
+    onp.testing.assert_allclose(out_state.asnumpy(), exp_state,
+                                rtol=1e-4)
+
+
+def test_batch_norm_with_relu_and_sync_alias():
+    x = _r(4, 3)
+    gamma, beta = np.ones((3,)), np.zeros((3,))
+    rm, rv = np.zeros((3,)), np.ones((3,))
+    out = npx.batch_norm_with_relu(x, gamma, beta, rm, rv)
+    assert float(out.min().asnumpy()) >= 0.0
+    out2 = npx.sync_batch_norm(x, gamma, beta, rm, rv)
+    assert out2.shape == x.shape

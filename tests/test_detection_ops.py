@@ -1,0 +1,148 @@
+"""RPN / RoI detection op family (reference `src/operator/contrib/
+proposal.cc`, `psroi_pooling.cc`, `deformable_psroi_pooling.cc`,
+`rroi_align.cc`, `mrcnn_mask_target.cu`)."""
+import numpy as onp
+
+from incubator_mxnet_tpu import np, npx
+
+
+def _r(*shape, seed=0, lo=-1.0, hi=1.0):
+    return np.array(onp.random.RandomState(seed)
+                    .uniform(lo, hi, shape).astype("float32"))
+
+
+def test_proposal_shapes_and_validity():
+    a = 3 * 4          # ratios x scales... 3 ratios x 4 scales = 12
+    h = w = 8
+    cls = _r(1, 2 * a, h, w, lo=0, hi=1)
+    bbox = _r(1, 4 * a, h, w, seed=1, lo=-0.2, hi=0.2)
+    im_info = np.array(onp.array([[128.0, 128.0, 1.0]], "float32"))
+    rois = npx.proposal(cls, bbox, im_info, rpn_pre_nms_top_n=200,
+                        rpn_post_nms_top_n=20, feature_stride=16)
+    assert rois.shape == (20, 5)
+    rn = rois.asnumpy()
+    assert (rn[:, 0] == 0).all()                   # batch index
+    assert (rn[:, 1] >= 0).all() and (rn[:, 3] <= 127.0).all()
+    assert (rn[:, 3] >= rn[:, 1]).all() and (rn[:, 4] >= rn[:, 2]).all()
+
+
+def test_proposal_output_score():
+    a = 12
+    cls = _r(1, 2 * a, 4, 4, lo=0, hi=1)
+    bbox = _r(1, 4 * a, 4, 4, seed=1, lo=-0.1, hi=0.1)
+    im_info = np.array(onp.array([[64.0, 64.0, 1.0]], "float32"))
+    rois, scores = npx.proposal(cls, bbox, im_info,
+                                rpn_pre_nms_top_n=50,
+                                rpn_post_nms_top_n=10,
+                                output_score=True)
+    assert rois.shape == (10, 5) and scores.shape == (10, 1)
+    sn = scores.asnumpy().ravel()
+    assert (onp.diff(sn[sn > 0]) <= 1e-6).all()    # sorted descending
+
+
+def test_multi_proposal_batch_indices():
+    a = 12
+    cls = _r(2, 2 * a, 4, 4, lo=0, hi=1)
+    bbox = _r(2, 4 * a, 4, 4, seed=1, lo=-0.1, hi=0.1)
+    im_info = np.array(onp.array([[64.0, 64.0, 1.0]] * 2, "float32"))
+    rois = npx.multi_proposal(cls, bbox, im_info,
+                              rpn_pre_nms_top_n=50,
+                              rpn_post_nms_top_n=8)
+    assert rois.shape == (16, 5)
+    rn = rois.asnumpy()
+    assert set(rn[:, 0]) <= {0.0, 1.0}
+    assert (rn[:8, 0] == 0).all() and (rn[8:, 0] == 1).all()
+
+
+def test_psroi_pooling_uniform_input():
+    od, ps, gs = 2, 2, 2
+    # constant per-channel data → pooled value == channel constant
+    x = onp.zeros((1, od * gs * gs, 8, 8), "float32")
+    for c in range(od * gs * gs):
+        x[0, c] = c
+    rois = np.array(onp.array([[0, 0, 0, 7, 7]], "float32"))
+    out = npx.psroi_pooling(np.array(x), rois, spatial_scale=1.0,
+                            output_dim=od, pooled_size=ps,
+                            group_size=gs)
+    assert out.shape == (1, od, ps, ps)
+    on = out.asnumpy()[0]
+    # bin (i,j) of output channel c reads input channel c*4 + i*2 + j
+    for c in range(od):
+        for i in range(ps):
+            for j in range(ps):
+                assert on[c, i, j] == c * 4 + i * 2 + j
+
+
+def test_deformable_psroi_pooling_no_trans_matches_psroi_shape():
+    od, ps = 2, 3
+    x = _r(1, od * ps * ps, 12, 12, lo=0, hi=1)
+    rois = np.array(onp.array([[0, 1, 1, 10, 10]], "float32"))
+    trans = np.zeros((1, 2, ps, ps))
+    out = npx.deformable_psroi_pooling(
+        x, rois, trans, spatial_scale=1.0, output_dim=od,
+        group_size=ps, pooled_size=ps, trans_std=0.1, no_trans=True)
+    assert out.shape == (1, od, ps, ps)
+    assert onp.isfinite(out.asnumpy()).all()
+    # nonzero offsets change the result
+    trans2 = np.array(onp.full((1, 2, ps, ps), 2.0, "float32"))
+    out2 = npx.deformable_psroi_pooling(
+        x, rois, trans2, spatial_scale=1.0, output_dim=od,
+        group_size=ps, pooled_size=ps, trans_std=0.5, no_trans=False)
+    assert not onp.allclose(out.asnumpy(), out2.asnumpy())
+
+
+def test_rroi_align_axis_aligned_matches_crop():
+    x = onp.arange(64, dtype="float32").reshape(1, 1, 8, 8)
+    # axis-aligned roi centered at (3.5, 3.5), 8x8, no rotation
+    rois = np.array(onp.array([[0, 3.5, 3.5, 8, 8, 0.0]], "float32"))
+    out = npx.rroi_align(np.array(x), rois, pooled_size=2,
+                         spatial_scale=1.0)
+    assert out.shape == (1, 1, 2, 2)
+    on = out.asnumpy()[0, 0]
+    # 2x2 bins sample at (±2, ±2) around center: symmetric values
+    assert on[0, 0] < on[0, 1] and on[0, 0] < on[1, 0]
+    # +90° rotation maps local (lx,ly) → (−ly,lx): bin (0,0) now samples
+    # where bin (0,1) sampled in the unrotated roi
+    rois90 = np.array(onp.array([[0, 3.5, 3.5, 8, 8, 90.0]], "float32"))
+    out90 = npx.rroi_align(np.array(x), rois90, pooled_size=2,
+                           spatial_scale=1.0)
+    onp.testing.assert_allclose(out90.asnumpy()[0, 0, 0, 0],
+                                on[0, 1], rtol=1e-4)
+
+
+def test_mrcnn_mask_target():
+    b, r, m, hh, ww, c = 1, 2, 3, 16, 16, 4
+    rois = np.array(onp.array(
+        [[[0, 0, 15, 15], [4, 4, 12, 12]]], "float32"))
+    gt = onp.zeros((b, m, hh, ww), "float32")
+    gt[0, 1, :, :] = 1.0                 # mask 1 is all-ones
+    matches = np.array(onp.array([[1, 0]], "int32"))
+    cls_t = np.array(onp.array([[2, 1]], "int32"))
+    targets, weights = npx.mrcnn_mask_target(
+        rois, np.array(gt), matches, cls_t, num_rois=r,
+        num_classes=c, mask_size=(7, 7))
+    assert targets.shape == (b, r, c, 7, 7)
+    assert weights.shape == (b, r, c, 7, 7)
+    tn, wn = targets.asnumpy(), weights.asnumpy()
+    # roi 0 matched all-ones mask, class 2 → its slice is 1, others 0
+    onp.testing.assert_allclose(tn[0, 0, 2], onp.ones((7, 7)))
+    assert tn[0, 0, 1].max() == 0.0
+    onp.testing.assert_allclose(wn[0, 0, 2], onp.ones((7, 7)))
+    assert wn[0, 0, 0].max() == 0.0
+    # roi 1 matched all-zeros mask → target zero, weight on class 1
+    assert tn[0, 1].max() == 0.0
+    onp.testing.assert_allclose(wn[0, 1, 1], onp.ones((7, 7)))
+
+
+def test_modulated_deformable_convolution():
+    x = _r(1, 4, 6, 6)
+    wgt = _r(2, 4, 3, 3, seed=1)
+    off = np.zeros((1, 2 * 9, 4, 4))
+    mask = np.ones((1, 9, 4, 4))
+    out = npx.modulated_deformable_convolution(
+        x, off, mask, wgt, kernel=(3, 3), num_filter=2, no_bias=True)
+    # zero offsets + unit mask == plain convolution
+    ref = npx.convolution(x, wgt, kernel=(3, 3), num_filter=2,
+                          no_bias=True)
+    onp.testing.assert_allclose(out.asnumpy(), ref.asnumpy(),
+                                rtol=1e-4, atol=1e-5)

@@ -1,0 +1,117 @@
+"""DGL graph-sampling op family (reference `src/operator/contrib/
+dgl_graph.cc` — examples from its op docstrings are the oracles here)."""
+import numpy as onp
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import np
+from incubator_mxnet_tpu.ndarray.sparse import csr_matrix
+
+
+def _k5():
+    """The 5-vertex complete graph from dgl_graph.cc:753 (edge ids
+    1..20)."""
+    data = onp.arange(1, 21, dtype=onp.float32)
+    indices = onp.array([1, 2, 3, 4, 0, 2, 3, 4, 0, 1, 3, 4,
+                         0, 1, 2, 4, 0, 1, 2, 3], onp.int32)
+    indptr = onp.array([0, 4, 8, 12, 16, 20], onp.int32)
+    return csr_matrix((data, indices, indptr), shape=(5, 5))
+
+
+def test_edge_id():
+    # dgl_graph.cc:1341 example graph
+    x = csr_matrix((onp.array([1, 2, 3], "float32"),
+                    onp.array([0, 2, 1], "int32"),
+                    onp.array([0, 1, 2, 3], "int32")), shape=(3, 3))
+    u = np.array(onp.array([0, 0, 1, 1, 2, 2], "int64"))
+    v = np.array(onp.array([0, 1, 1, 2, 0, 1], "int64"))
+    out = mx.nd.contrib.edge_id(x, u, v)
+    onp.testing.assert_array_equal(out.asnumpy(), [1, -1, -1, 2, -1, 3])
+
+
+def test_getnnz():
+    g = _k5()
+    assert int(mx.nd.contrib.getnnz(g).asnumpy()[0]) == 20
+    onp.testing.assert_array_equal(
+        mx.nd.contrib.getnnz(g, axis=1).asnumpy(), [4] * 5)
+    onp.testing.assert_array_equal(
+        mx.nd.contrib.getnnz(g, axis=0).asnumpy(), [4] * 5)
+
+
+def test_dgl_adjacency():
+    adj = mx.nd.contrib.dgl_adjacency(_k5())
+    dense = adj.asnumpy()
+    assert dense.sum() == 20
+    assert set(onp.unique(dense)) == {0.0, 1.0}
+
+
+def test_dgl_subgraph_reference_example():
+    # dgl_graph.cc:1130 example
+    x = csr_matrix((onp.array([1, 2, 3, 4, 5, 6, 7], "float32"),
+                    onp.array([0, 3, 0, 2, 1, 1, 2], "int32"),
+                    onp.array([0, 2, 4, 5, 7], "int32")), shape=(4, 4))
+    v = np.array(onp.array([0, 1, 2], "int64"))
+    sub, mapping = mx.nd.contrib.dgl_subgraph(x, v, return_mapping=True)
+    onp.testing.assert_array_equal(
+        sub.asnumpy(), [[1, 0, 0], [2, 0, 3], [0, 4, 0]])
+    onp.testing.assert_array_equal(
+        mapping.asnumpy(), [[1, 0, 0], [3, 0, 4], [0, 5, 0]])
+
+
+def test_neighbor_uniform_sample_structure():
+    g = _k5()
+    seed = np.array(onp.arange(5, dtype=onp.int64))
+    verts, sub, layer = mx.nd.contrib.dgl_csr_neighbor_uniform_sample(
+        g, seed, num_args=2, num_hops=1, num_neighbor=2,
+        max_num_vertices=5)
+    vn = verts.asnumpy()
+    assert vn.shape == (6,)
+    assert vn[-1] == 5                     # all 5 vertices sampled
+    onp.testing.assert_array_equal(sorted(vn[:5]), onp.arange(5))
+    sn = sub.asnumpy()
+    assert sn.shape == (5, 5)
+    # each row sampled ≤ num_neighbor edges, values are original edge ids
+    assert ((sn > 0).sum(axis=1) <= 2).all()
+    assert set(onp.unique(sn)) <= set(range(21))
+    onp.testing.assert_array_equal(layer.asnumpy(), onp.zeros(5))
+
+
+def test_neighbor_non_uniform_sample_prob_output():
+    g = _k5()
+    prob = np.array(onp.array([0.9, 0.8, 0.2, 0.4, 0.1], "float32"))
+    seed = np.array(onp.arange(5, dtype=onp.int64))
+    verts, sub, p, layer = \
+        mx.nd.contrib.dgl_csr_neighbor_non_uniform_sample(
+            g, prob, seed, num_args=3, num_hops=1, num_neighbor=2,
+            max_num_vertices=5)
+    onp.testing.assert_allclose(p.asnumpy(),
+                                [0.9, 0.8, 0.2, 0.4, 0.1], rtol=1e-6)
+    assert sub.asnumpy().shape == (5, 5)
+    assert int(verts.asnumpy()[-1]) == 5
+
+
+def test_graph_compact():
+    g = _k5()
+    seed = np.array(onp.array([0, 1], "int64"))
+    verts, sub, _layer = mx.nd.contrib.dgl_csr_neighbor_uniform_sample(
+        g, seed, num_args=2, num_hops=1, num_neighbor=2,
+        max_num_vertices=6)
+    n = int(verts.asnumpy()[-1])
+    compact = mx.nd.contrib.dgl_graph_compact(
+        sub, verts, graph_sizes=n, return_mapping=False)
+    assert compact.shape == (n, n)
+    # compacted edges renumbered 1..nnz
+    cn = compact.asnumpy()
+    nnz = (cn > 0).sum()
+    assert set(cn[cn > 0]) == set(range(1, nnz + 1))
+
+
+def test_multi_seed_arrays():
+    g = _k5()
+    s1 = np.array(onp.array([0], "int64"))
+    s2 = np.array(onp.array([3], "int64"))
+    out = mx.nd.contrib.dgl_csr_neighbor_uniform_sample(
+        g, s1, s2, num_args=3, num_hops=1, num_neighbor=3,
+        max_num_vertices=5)
+    assert len(out) == 6                   # 2 x (verts, csr, layer)
+    v1, v2 = out[0].asnumpy(), out[1].asnumpy()
+    assert v1[0] == 0 and v2[0] == 3

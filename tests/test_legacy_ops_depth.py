@@ -1,0 +1,247 @@
+"""Legacy op long-tail depth tests (reference `src/operator/` root ops:
+regression outputs, LRN, UpSampling, im2col/col2im, storage casts,
+legacy random distributions)."""
+import numpy as onp
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import autograd, np
+
+nd = mx.nd
+
+
+def _r(*shape, seed=0):
+    return np.array(onp.random.RandomState(seed)
+                    .uniform(-1, 1, shape).astype("float32"))
+
+
+def test_slice_axis_reverse_crop():
+    x = _r(3, 5)
+    onp.testing.assert_allclose(
+        nd.slice_axis(x, axis=1, begin=1, end=4).asnumpy(),
+        x.asnumpy()[:, 1:4])
+    onp.testing.assert_allclose(
+        nd.reverse(x, axis=1).asnumpy(), x.asnumpy()[:, ::-1])
+    onp.testing.assert_allclose(
+        nd.crop(x, begin=(0, 1), end=(2, 3)).asnumpy(),
+        x.asnumpy()[0:2, 1:3])
+
+
+def test_depth_space_roundtrip():
+    x = _r(2, 8, 4, 4)
+    d = nd.depth_to_space(x, 2)
+    assert d.shape == (2, 2, 8, 8)
+    onp.testing.assert_allclose(
+        nd.space_to_depth(d, 2).asnumpy(), x.asnumpy())
+
+
+def test_im2col_matches_manual_patch():
+    x = _r(1, 1, 4, 4)
+    c = nd.im2col(x, kernel=(2, 2))
+    assert c.shape == (1, 4, 9)
+    # first output column = top-left 2x2 patch, row-major
+    xn = x.asnumpy()[0, 0]
+    onp.testing.assert_allclose(
+        c.asnumpy()[0, :, 0],
+        [xn[0, 0], xn[0, 1], xn[1, 0], xn[1, 1]], rtol=1e-6)
+
+
+def test_col2im_sums_overlaps():
+    x = np.ones((1, 1, 3, 3))
+    c = nd.im2col(x, kernel=(2, 2))
+    back = nd.col2im(c, (3, 3), kernel=(2, 2))
+    # center pixel belongs to all 4 patches
+    assert back.asnumpy()[0, 0, 1, 1] == 4.0
+    assert back.asnumpy()[0, 0, 0, 0] == 1.0
+
+
+def test_moments():
+    x = _r(4, 3)
+    m, v = nd.moments(x, axes=(0,))
+    onp.testing.assert_allclose(m.asnumpy(), x.asnumpy().mean(0),
+                                rtol=1e-5)
+    onp.testing.assert_allclose(v.asnumpy(), x.asnumpy().var(0),
+                                rtol=1e-4)
+
+
+def test_activation_variants():
+    x = _r(3, 4)
+    xn = x.asnumpy()
+    onp.testing.assert_allclose(
+        nd.hard_sigmoid(x).asnumpy(),
+        onp.clip(0.2 * xn + 0.5, 0, 1), rtol=1e-5)
+    sp = onp.log1p(onp.exp(xn))
+    onp.testing.assert_allclose(nd.mish(x).asnumpy(),
+                                xn * onp.tanh(sp), rtol=1e-4)
+    onp.testing.assert_allclose(
+        nd.log_sigmoid(x).asnumpy(),
+        -onp.log1p(onp.exp(-xn)), rtol=1e-4)
+    y = np.array(onp.array([8.0, 27.0], "float32"))
+    onp.testing.assert_allclose(nd.rcbrt(y).asnumpy(), [0.5, 1 / 3],
+                                rtol=1e-5)
+    onp.testing.assert_allclose(nd.rsqrt(y).asnumpy(),
+                                1 / onp.sqrt([8.0, 27.0]), rtol=1e-5)
+
+
+def test_softmax_cross_entropy():
+    x = _r(4, 5)
+    y = np.array(onp.array([0, 2, 1, 4], "int32"))
+    out = nd.softmax_cross_entropy(x, y)
+    xn = x.asnumpy()
+    lp = xn - xn.max(1, keepdims=True)
+    lp = lp - onp.log(onp.exp(lp).sum(1, keepdims=True))
+    expect = -lp[onp.arange(4), y.asnumpy()].sum()
+    onp.testing.assert_allclose(out.asnumpy(), [expect], rtol=1e-4)
+
+
+def test_lrn_formula():
+    x = _r(1, 5, 2, 2)
+    out = nd.LRN(x, alpha=1e-2, beta=0.5, knorm=1.0, nsize=3)
+    xn = x.asnumpy()
+    expect = onp.zeros_like(xn)
+    for c in range(5):
+        lo, hi = max(0, c - 1), min(5, c + 2)
+        acc = (xn[:, lo:hi] ** 2).sum(axis=1)
+        expect[:, c] = xn[:, c] / (1.0 + (1e-2 / 3) * acc) ** 0.5
+    onp.testing.assert_allclose(out.asnumpy(), expect, rtol=1e-4)
+
+
+def test_upsampling():
+    x = _r(1, 2, 3, 3)
+    out = nd.UpSampling(x, scale=2, sample_type="nearest")
+    assert out.shape == (1, 2, 6, 6)
+    onp.testing.assert_allclose(out.asnumpy()[0, 0, :2, :2],
+                                onp.full((2, 2),
+                                         x.asnumpy()[0, 0, 0, 0]))
+    bil = nd.UpSampling(x, scale=2, sample_type="bilinear",
+                        num_filter=2)
+    assert bil.shape == (1, 2, 6, 6)
+
+
+def test_regression_outputs_grads():
+    x, y = _r(4, 1), _r(4, 1, seed=1)
+    x.attach_grad()
+    with autograd.record():
+        out = nd.LinearRegressionOutput(x, y)
+        out.backward()
+    onp.testing.assert_allclose(out.asnumpy(), x.asnumpy())
+    onp.testing.assert_allclose(x.grad.asnumpy(),
+                                (x.asnumpy() - y.asnumpy()) / 4,
+                                rtol=1e-5)
+    x.attach_grad()
+    with autograd.record():
+        out = nd.MAERegressionOutput(x, y)
+        out.backward()
+    onp.testing.assert_allclose(
+        x.grad.asnumpy(),
+        onp.sign(x.asnumpy() - y.asnumpy()) / 4, rtol=1e-5)
+    lab = np.array((onp.random.RandomState(2).uniform(0, 1, (4, 1)) > .5)
+                   .astype("float32"))
+    x.attach_grad()
+    with autograd.record():
+        out = nd.LogisticRegressionOutput(x, lab)
+        out.backward()
+    sig = 1 / (1 + onp.exp(-x.asnumpy()))
+    onp.testing.assert_allclose(out.asnumpy(), sig, rtol=1e-5)
+    onp.testing.assert_allclose(x.grad.asnumpy(),
+                                (sig - lab.asnumpy()) / 4, rtol=1e-4)
+
+
+def test_svm_output_identity_forward_and_grad():
+    x = _r(3, 4)
+    y = np.array(onp.array([1, 0, 3], "float32"))
+    x.attach_grad()
+    with autograd.record():
+        out = nd.SVMOutput(x, y, margin=1.0)
+        out.backward()
+    onp.testing.assert_allclose(out.asnumpy(), x.asnumpy())
+    g = x.grad.asnumpy()
+    assert onp.isfinite(g).all()
+    # gradient pushes the true class up (negative grad on true logit)
+    assert (g[onp.arange(3), y.asnumpy().astype(int)] <= 0).all()
+
+
+def test_block_grad_and_make_loss():
+    x = _r(3)
+    x.attach_grad()
+    with autograd.record():
+        out = (nd.BlockGrad(x) * x).sum()
+    out.backward()
+    onp.testing.assert_allclose(x.grad.asnumpy(), x.asnumpy(),
+                                rtol=1e-5)  # only the live branch
+    x.attach_grad()
+    with autograd.record():
+        loss = nd.make_loss(x, grad_scale=2.0)
+        loss.backward()
+    onp.testing.assert_allclose(x.grad.asnumpy(), 2 * onp.ones(3))
+
+
+def test_argmax_channel_choose_size():
+    x = _r(3, 4)
+    onp.testing.assert_allclose(nd.argmax_channel(x).asnumpy(),
+                                x.asnumpy().argmax(1).astype("float32"))
+    idx = np.array(onp.array([1, 0, 3], "float32"))
+    onp.testing.assert_allclose(
+        nd.choose_element_0index(x, idx).asnumpy(),
+        x.asnumpy()[onp.arange(3), [1, 0, 3]])
+    assert nd.size_array(x).asnumpy().tolist() == [12]
+
+
+def test_shuffle_is_permutation():
+    x = np.array(onp.arange(32, dtype="float32"))
+    mx.random.seed(7)
+    out = nd.shuffle(x)
+    onp.testing.assert_allclose(sorted(out.asnumpy()), x.asnumpy())
+
+
+def test_cast_storage():
+    x = _r(4, 3)
+    rs = nd.cast_storage(x, "row_sparse")
+    assert rs.stype == "row_sparse"
+    back = nd.cast_storage(rs, "default")
+    onp.testing.assert_allclose(back.asnumpy(), x.asnumpy())
+
+
+def test_broadcast_axis():
+    x = _r(1, 3)
+    out = nd.broadcast_axis(x, axis=0, size=4)
+    assert out.shape == (4, 3)
+    onp.testing.assert_allclose(out.asnumpy(),
+                                onp.tile(x.asnumpy(), (4, 1)))
+
+
+def test_legacy_random_family():
+    mx.random.seed(3)
+    a = nd.normal(0, 1, shape=(100,))
+    assert abs(float(a.asnumpy().mean())) < 0.5
+    assert nd.uniform(0, 1, shape=(5, 2)).shape == (5, 2)
+    assert nd.poisson(lam=3.0, shape=(10,)).shape == (10,)
+    assert nd.exponential(shape=(4,)).shape == (4,)
+    x = _r(2, 3)
+    assert nd.normal_like(x).shape == (2, 3)
+    assert nd.uniform_like(x).shape == (2, 3)
+    g = nd.generalized_negative_binomial(mu=2.0, alpha=0.4, shape=(50,))
+    assert g.shape == (50,)
+    assert (g.asnumpy() >= 0).all()
+    assert nd.generalized_negative_binomial_like(x, mu=1.0,
+                                                 alpha=0.3).shape == \
+        (2, 3)
+
+
+def test_upsampling_multi_input():
+    a = _r(1, 2, 8, 8)
+    b = _r(1, 2, 4, 4, seed=1)
+    out = nd.UpSampling(a, b, scale=2, sample_type="nearest",
+                        num_args=2)
+    assert out.shape == (1, 4, 16, 16)     # both land at 16x16, concat
+    summed = nd.UpSampling(a, b, scale=2, sample_type="nearest",
+                           num_args=2, multi_input_mode="sum")
+    assert summed.shape == (1, 2, 16, 16)
+
+
+def test_multi_sgd_single_out_ndarray():
+    w, g = _r(3, 2), _r(3, 2, seed=1)
+    wn = w.asnumpy().copy()
+    nd.multi_sgd_update(w, g, lrs=(0.1,), wds=(0.0,), num_weights=1,
+                        out=w)
+    onp.testing.assert_allclose(w.asnumpy(), wn - 0.1 * g.asnumpy(),
+                                rtol=1e-5)

@@ -1,0 +1,277 @@
+"""Functional optimizer-update ops (reference `src/operator/
+optimizer_op.cc` + contrib multi/preloaded/adamw/lamb/lans families).
+
+Each rule is pinned against a plain-numpy oracle of the reference
+kernel math; in-place state mutation and `out=` semantics are checked
+explicitly.
+"""
+import numpy as onp
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import np
+
+nd = mx.nd
+
+
+def _w(shape=(4, 3), seed=0):
+    return np.array(onp.random.RandomState(seed)
+                    .uniform(-1, 1, shape).astype("float32"))
+
+
+def test_sgd_update_out_semantics():
+    w, g = _w(), _w(seed=1)
+    wn, gn = w.asnumpy(), g.asnumpy()
+    out = nd.sgd_update(w, g, lr=0.1, wd=0.01, out=w)
+    assert out is w
+    onp.testing.assert_allclose(
+        w.asnumpy(), wn - 0.1 * (gn + 0.01 * wn), rtol=1e-5)
+
+
+def test_sgd_update_clip_and_rescale():
+    w, g = _w(), _w(seed=1)
+    wn, gn = w.asnumpy(), g.asnumpy()
+    nd.sgd_update(w, g, lr=1.0, rescale_grad=4.0, clip_gradient=0.5,
+                  out=w)
+    expect = wn - onp.clip(4.0 * gn, -0.5, 0.5)
+    onp.testing.assert_allclose(w.asnumpy(), expect, rtol=1e-5)
+
+
+def test_sgd_mom_update_state_mutation():
+    w, g, m = _w(), _w(seed=1), np.zeros((4, 3))
+    wn, gn = w.asnumpy(), g.asnumpy()
+    nd.sgd_mom_update(w, g, m, lr=0.1, momentum=0.9, out=w)
+    m1 = -0.1 * gn
+    onp.testing.assert_allclose(m.asnumpy(), m1, rtol=1e-5)
+    onp.testing.assert_allclose(w.asnumpy(), wn + m1, rtol=1e-5)
+    # second step uses the mutated momentum
+    nd.sgd_mom_update(w, g, m, lr=0.1, momentum=0.9, out=w)
+    m2 = 0.9 * m1 - 0.1 * gn
+    onp.testing.assert_allclose(m.asnumpy(), m2, rtol=1e-5)
+
+
+def test_mp_sgd_update_master_weights():
+    w32 = _w()
+    w16 = np.array(w32.asnumpy().astype("float16"))
+    g = _w(seed=1)
+    nd.mp_sgd_update(w16, g, w32, lr=0.1, out=w16)
+    onp.testing.assert_allclose(
+        w32.asnumpy(),
+        _w().asnumpy() - 0.1 * g.asnumpy(), rtol=1e-5)
+    onp.testing.assert_allclose(w16.asnumpy(),
+                                w32.asnumpy().astype("float16"),
+                                rtol=1e-3)
+    assert str(w16.dtype).endswith("float16")
+
+
+def test_adam_update_oracle():
+    w, g = _w(), _w(seed=1)
+    m, v = np.zeros((4, 3)), np.zeros((4, 3))
+    wn, gn = w.asnumpy(), g.asnumpy()
+    nd.adam_update(w, g, m, v, lr=0.01, beta1=0.9, beta2=0.999,
+                   epsilon=1e-8, out=w)
+    m1 = 0.1 * gn
+    v1 = 0.001 * gn * gn
+    onp.testing.assert_allclose(m.asnumpy(), m1, rtol=1e-5)
+    onp.testing.assert_allclose(v.asnumpy(), v1, rtol=1e-4)
+    onp.testing.assert_allclose(
+        w.asnumpy(), wn - 0.01 * m1 / (onp.sqrt(v1) + 1e-8), rtol=1e-5)
+
+
+def test_adamw_nan_scale_skips_update():
+    w, g = _w(), _w(seed=1)
+    m, v = np.zeros((4, 3)), np.zeros((4, 3))
+    wn = w.asnumpy()
+    scale = np.array(onp.array(onp.nan, "float32"))
+    nd.adamw_update(w, g, m, v, scale, lr=0.01, eta=1.0, out=w)
+    onp.testing.assert_allclose(w.asnumpy(), wn)   # untouched
+    onp.testing.assert_allclose(m.asnumpy(), 0 * wn)
+
+
+def test_adamw_decoupled_decay():
+    w, g = _w(), _w(seed=1)
+    m, v = np.zeros((4, 3)), np.zeros((4, 3))
+    wn, gn = w.asnumpy(), g.asnumpy()
+    nd.adamw_update(w, g, m, v, 1.0, lr=0.01, eta=1.0, wd=0.1, out=w)
+    m1, v1 = 0.1 * gn, 0.001 * gn * gn
+    expect = wn - (0.01 * m1 / (onp.sqrt(v1) + 1e-8) + 0.1 * wn)
+    onp.testing.assert_allclose(w.asnumpy(), expect, rtol=1e-5)
+
+
+def test_signsgd_signum():
+    w, g = _w(), _w(seed=1)
+    wn, gn = w.asnumpy(), g.asnumpy()
+    nd.signsgd_update(w, g, lr=0.1, out=w)
+    onp.testing.assert_allclose(w.asnumpy(), wn - 0.1 * onp.sign(gn),
+                                rtol=1e-5)
+    w2, m = _w(seed=2), np.zeros((4, 3))
+    w2n = w2.asnumpy()
+    nd.signum_update(w2, g, m, lr=0.1, momentum=0.9, out=w2)
+    m1 = -0.1 * gn
+    onp.testing.assert_allclose(m.asnumpy(), m1, rtol=1e-5)
+    onp.testing.assert_allclose(w2.asnumpy(),
+                                w2n + 0.1 * onp.sign(m1), rtol=1e-5)
+
+
+def test_ftrl_sparsifies():
+    w = np.array(onp.full((4, 3), 0.5, "float32"))
+    g = np.array(onp.full((4, 3), 1e-4, "float32"))
+    z, n = np.zeros((4, 3)), np.zeros((4, 3))
+    nd.ftrl_update(w, g, z, n, lr=0.1, lamda1=1.0, out=w)
+    # tiny gradient + strong l1 → weights snap to exactly 0
+    assert onp.abs(w.asnumpy()).max() == 0.0
+
+
+def test_rmsprop_update():
+    w, g = _w(), _w(seed=1)
+    n = np.zeros((4, 3))
+    wn, gn = w.asnumpy(), g.asnumpy()
+    nd.rmsprop_update(w, g, n, lr=0.01, gamma1=0.9, epsilon=1e-8,
+                      out=w)
+    n1 = 0.1 * gn * gn
+    onp.testing.assert_allclose(n.asnumpy(), n1, rtol=1e-4)
+    onp.testing.assert_allclose(
+        w.asnumpy(), wn - 0.01 * gn / onp.sqrt(n1 + 1e-8), rtol=1e-4)
+
+
+def test_rmspropalex_update_runs():
+    w, g = _w(), _w(seed=1)
+    n, gb, d = np.zeros((4, 3)), np.zeros((4, 3)), np.zeros((4, 3))
+    before = w.asnumpy().copy()
+    nd.rmspropalex_update(w, g, n, gb, d, lr=0.01, out=w)
+    assert not onp.allclose(w.asnumpy(), before)
+    assert onp.isfinite(w.asnumpy()).all()
+
+
+def test_ftml_update_runs():
+    w, g = _w(), _w(seed=1)
+    d, v, z = np.zeros((4, 3)), np.zeros((4, 3)), np.zeros((4, 3))
+    before = w.asnumpy().copy()
+    nd.ftml_update(w, g, d, v, z, lr=0.01, t=1, out=w)
+    assert not onp.allclose(w.asnumpy(), before)
+    assert onp.isfinite(w.asnumpy()).all()
+
+
+def test_lamb_phases():
+    w, g = _w(), _w(seed=1)
+    m, v = np.zeros((4, 3)), np.zeros((4, 3))
+    gdir = nd.lamb_update_phase1(w, g, m, v, t=1, wd=0.01)
+    assert onp.isfinite(gdir.asnumpy()).all()
+    r1 = np.array(onp.array(
+        onp.linalg.norm(w.asnumpy()), "float32"))
+    r2 = np.array(onp.array(
+        onp.linalg.norm(gdir.asnumpy()), "float32"))
+    wn = w.asnumpy().copy()
+    nd.lamb_update_phase2(w, gdir, r1, r2, lr=0.01, out=w)
+    ratio = float(r1.asnumpy()) / float(r2.asnumpy())
+    onp.testing.assert_allclose(
+        w.asnumpy(), wn - 0.01 * ratio * gdir.asnumpy(), rtol=1e-4)
+
+
+def test_multi_sgd_update():
+    ws = [_w(seed=i) for i in range(2)]
+    gs = [_w(seed=10 + i) for i in range(2)]
+    before = [w.asnumpy().copy() for w in ws]
+    nd.multi_sgd_update(ws[0], gs[0], ws[1], gs[1],
+                        lrs=(0.1, 0.2), wds=(0.0, 0.0),
+                        num_weights=2, out=ws)
+    for i, (w, g) in enumerate(zip(ws, gs)):
+        onp.testing.assert_allclose(
+            w.asnumpy(), before[i] - (0.1, 0.2)[i] * g.asnumpy(),
+            rtol=1e-5)
+
+
+def test_preloaded_multi_sgd():
+    ws = [_w(seed=i) for i in range(2)]
+    gs = [_w(seed=10 + i) for i in range(2)]
+    before = [w.asnumpy().copy() for w in ws]
+    lrs = np.array(onp.array([0.1, 0.2], "float32"))
+    wds = np.array(onp.array([0.0, 0.0], "float32"))
+    nd.preloaded_multi_sgd_update(ws[0], gs[0], ws[1], gs[1], lrs, wds,
+                                  num_weights=2, out=ws)
+    for i, (w, g) in enumerate(zip(ws, gs)):
+        onp.testing.assert_allclose(
+            w.asnumpy(), before[i] - (0.1, 0.2)[i] * g.asnumpy(),
+            rtol=1e-5)
+
+
+def test_multi_sum_sq_and_lars():
+    a, b = _w(), _w(seed=1)
+    ss = nd.multi_sum_sq(a, b, num_arrays=2)
+    onp.testing.assert_allclose(
+        ss.asnumpy(),
+        [(a.asnumpy() ** 2).sum(), (b.asnumpy() ** 2).sum()], rtol=1e-4)
+    lrs = np.array(onp.array([0.1, 0.1], "float32"))
+    wds = np.array(onp.array([0.0, 0.0], "float32"))
+    g2 = nd.multi_sum_sq(b, a, num_arrays=2)
+    new = nd.multi_lars(lrs, ss, g2, wds, eta=0.01)
+    assert new.shape == (2,)
+    assert (new.asnumpy() > 0).all()
+
+
+def test_reset_arrays():
+    a, b = _w(), _w(seed=1)
+    nd.reset_arrays(a, b, num_arrays=2)
+    assert onp.abs(a.asnumpy()).max() == 0.0
+    assert onp.abs(b.asnumpy()).max() == 0.0
+
+
+def test_sparse_adagrad_update_rowsparse():
+    from incubator_mxnet_tpu.ndarray.sparse import RowSparseNDArray
+
+    w = _w((5, 3))
+    h = np.zeros((5, 3))
+    wn = w.asnumpy().copy()
+    vals = onp.ones((2, 3), "float32")
+    idx = onp.array([1, 3], "int32")
+    g = RowSparseNDArray(vals, idx, (5, 3))
+    nd.sparse_adagrad_update(w, g, h, lr=0.1, epsilon=1e-7, out=w)
+    touched = w.asnumpy()[[1, 3]]
+    onp.testing.assert_allclose(
+        touched, wn[[1, 3]] - 0.1 * 1.0 / (onp.sqrt(1.0) + 1e-7),
+        rtol=1e-5)
+    onp.testing.assert_allclose(w.asnumpy()[[0, 2, 4]],
+                                wn[[0, 2, 4]])  # untouched rows
+    onp.testing.assert_allclose(h.asnumpy()[[1, 3]],
+                                onp.ones((2, 3)), rtol=1e-6)
+
+
+def test_group_adagrad_update():
+    w, g = _w(), _w(seed=1)
+    h = np.zeros((4,))
+    wn, gn = w.asnumpy(), g.asnumpy()
+    nd.group_adagrad_update(w, g, h, lr=0.1, out=w)
+    h1 = (gn * gn).mean(axis=1)
+    onp.testing.assert_allclose(h.asnumpy(), h1, rtol=1e-4)
+    onp.testing.assert_allclose(
+        w.asnumpy(), wn - 0.1 * gn / onp.sqrt(h1 + 1e-5)[:, None],
+        rtol=1e-4)
+
+
+def test_square_sum():
+    x = _w()
+    out = nd.square_sum(x, axis=1)
+    onp.testing.assert_allclose(out.asnumpy(),
+                                (x.asnumpy() ** 2).sum(axis=1),
+                                rtol=1e-5)
+
+
+def test_multi_lamb_and_lans_run():
+    ws = [_w(seed=i) for i in range(2)]
+    gs = [_w(seed=10 + i) for i in range(2)]
+    ms = [np.zeros((4, 3)) for _ in range(2)]
+    vs = [np.zeros((4, 3)) for _ in range(2)]
+    before = [w.asnumpy().copy() for w in ws]
+    nd.multi_lamb_update(
+        ws[0], gs[0], ms[0], vs[0], ws[1], gs[1], ms[1], vs[1],
+        learning_rates=(0.01, 0.01), wds=(0.0, 0.0),
+        step_count=(1, 1), num_tensors=2, out=ws)
+    for i, w in enumerate(ws):
+        assert not onp.allclose(w.asnumpy(), before[i])
+        assert onp.isfinite(w.asnumpy()).all()
+    ws2 = [_w(seed=i) for i in range(2)]
+    nd.multi_lans_update(
+        ws2[0], gs[0], ms[0], vs[0], ws2[1], gs[1], ms[1], vs[1],
+        learning_rates=(0.01, 0.01), wds=(0.0, 0.0),
+        step_count=(1, 1), num_tensors=2, out=ws2)
+    for w in ws2:
+        assert onp.isfinite(w.asnumpy()).all()
