@@ -78,7 +78,6 @@ _LEGACY_TO_NPX = {
 # legacy names resolving to np-namespace ops under a different name
 _LEGACY_TO_NP = {
     "Reshape": "reshape",
-    "ElementWiseSum": "add_n",
     "flip": "flip",
     "sum_axis": "sum",
     "max_axis": "max",
@@ -243,8 +242,6 @@ def __getattr__(name):
 
         return getattr(npx, _LEGACY_TO_NPX[name])
     if name in _LEGACY_TO_NP:
-        if _LEGACY_TO_NP[name] == "add_n":
-            return add_n
         from .. import numpy as _np
 
         return getattr(_np, _LEGACY_TO_NP[name])

@@ -289,6 +289,21 @@ def UpSampling(*data, scale=1, sample_type="nearest", num_args=1,  # noqa: N802,
                 total = _np.add(total, o)
             return total
         return _np.concatenate(outs, axis=1)
+    # bilinear: the reference implements this as a (typically
+    # bilinear-initialized, learnable) grouped deconvolution
+    # (upsampling-inl.h kBilinear) — data[1] is that weight when given
+    if len(data) > 1:
+        from .. import numpy as _np
+        from ..numpy_extension import deconvolution
+
+        wgt = data[1]                       # (C, 1, k, k) depthwise
+        k = wgt.shape[-1]
+        chans = [deconvolution(x[:, c:c + 1], wgt[c:c + 1],
+                               kernel=(k, k), stride=(s, s),
+                               pad=((k - s) // 2, (k - s) // 2),
+                               num_filter=1, no_bias=True)
+                 for c in range(x.shape[1])]
+        return _np.concatenate(chans, axis=1)
     from ..numpy_extension import bilinear_resize2d
 
     return bilinear_resize2d(x, height=oh, width=ow)

@@ -957,6 +957,15 @@ def _cached_jit(name, key, pure_fn, call_vals):
     return None
 
 
+def unwrap_arrays(args):
+    """Varargs-or-single-list unwrap shared by the list-consuming ops
+    (`add_n(a, b)` == `add_n([a, b])` — the reference's Ellipsis-arity
+    contract)."""
+    if len(args) == 1 and isinstance(args[0], (list, tuple)):
+        return list(args[0])
+    return list(args)
+
+
 def apply_op_flat(name, jfn, args, kwargs=None, n_outputs=None,
                   cacheable=False):
     """Like apply_op but flattens NDArrays nested one level inside list/tuple

@@ -10,13 +10,15 @@ are updated in place via the buffer-rebind mutation discipline, and the
 updated weight lands in `out` (conventionally the weight itself).
 
 Multi-tensor variants (`multi_sgd_update`, `preloaded_*`) consume the
-reference's interleaved argument layout and update every tensor in one
-funnel call — the same batching the round-4 fused small-parameter path
-uses inside DataParallel.
+reference's interleaved argument layout; they dispatch one funnel call
+PER TENSOR (each individually XLA-fused). The single-program fused
+multi-tensor batching lives in the compiled train step
+(`parallel/sharded.py` small-parameter path) where it belongs — these
+eager ops exist for script-level API parity, not as the fast path.
 """
 from __future__ import annotations
 
-from .ndarray import NDArray, apply_op, apply_op_flat
+from .ndarray import NDArray, apply_op, apply_op_flat, unwrap_arrays
 
 __all__ = [
     "sgd_update", "sgd_mom_update", "mp_sgd_update", "mp_sgd_mom_update",
@@ -787,8 +789,7 @@ def multi_mp_adabelief_update(*args, learning_rates=None, wds=None,
 def multi_sum_sq(*arrays, num_arrays=None):  # noqa: ARG001
     """Per-tensor Σx² in one fused call (contrib multi_sum_sq.cc —
     feeds multi_lars)."""
-    arrs = list(arrays[0]) if len(arrays) == 1 \
-        and isinstance(arrays[0], (list, tuple)) else list(arrays)
+    arrs = unwrap_arrays(arrays)
 
     def fn(xs):
         jnp = _jnp()
@@ -818,8 +819,7 @@ def multi_lars(lrs, weights_sum_sq, grads_sum_sq, wds, eta=0.001,
 def reset_arrays(*arrays, num_arrays=None):  # noqa: ARG001
     """Zero every array in place (contrib reset_arrays.cc — gradient
     clearing)."""
-    arrs = list(arrays[0]) if len(arrays) == 1 \
-        and isinstance(arrays[0], (list, tuple)) else list(arrays)
+    arrs = unwrap_arrays(arrays)
     jnp = _jnp()
     for a in arrs:
         a._set_data(jnp.zeros_like(a._data))

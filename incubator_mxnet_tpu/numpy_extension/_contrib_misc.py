@@ -9,7 +9,9 @@ from __future__ import annotations
 
 import math
 
-from ..ndarray.ndarray import NDArray, apply_op, apply_op_flat
+from ..ndarray.ndarray import (
+    NDArray, apply_op, apply_op_flat, unwrap_arrays,
+)
 
 __all__ = [
     "quadratic", "index_copy", "index_array", "gradientmultiplier",
@@ -197,8 +199,7 @@ def all_finite(data, init_output=True):  # noqa: ARG001
 def multi_all_finite(*arrays, num_arrays=None, init_output=True):  # noqa: ARG001
     """AND of all_finite over a list of arrays (reference
     `contrib/all_finite.cc`)."""
-    arrs = list(arrays[0]) if len(arrays) == 1 \
-        and isinstance(arrays[0], (list, tuple)) else list(arrays)
+    arrs = unwrap_arrays(arrays)
 
     def fn(xs):
         jnp = _jnp()
@@ -407,6 +408,8 @@ def pad(data, mode="constant", pad_width=None, constant_value=0.0):
 def norm(data, ord=2, axis=None, keepdims=False, out=None):  # noqa: A002
     """Matrix/vector norm op (reference `src/operator/tensor/broadcast_
     reduce_norm_value.cc`)."""
+    if ord not in (1, 2):
+        raise ValueError(f"npx.norm supports ord 1 or 2, got {ord!r}")
     ax = axis if axis is None or isinstance(axis, int) \
         else tuple(int(a) for a in axis)
 
@@ -453,8 +456,7 @@ def slice_channel(data, num_outputs, axis=1, squeeze_axis=False):
 def add_n(*args):
     """Sum of a list of arrays in one fused kernel (reference
     `src/operator/tensor/elemwise_sum.cc`)."""
-    arrs = list(args[0]) if len(args) == 1 \
-        and isinstance(args[0], (list, tuple)) else list(args)
+    arrs = unwrap_arrays(args)
 
     def fn(xs):
         out = xs[0]

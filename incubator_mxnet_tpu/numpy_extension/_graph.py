@@ -109,7 +109,8 @@ def dgl_subgraph(graph, *varrays, return_mapping=False, num_args=None):  # noqa:
         nd, od, nc, ni = _induced_subgraph(vals, cols, indptr, vids)
         n = len(vids)
         outs.append(CSRNDArray(nd, nc, ni, (n, n)))
-        mappings.append(CSRNDArray(od, nc, ni, (n, n)))
+        if return_mapping:
+            mappings.append(CSRNDArray(od, nc, ni, (n, n)))
     return outs + mappings if return_mapping else outs
 
 

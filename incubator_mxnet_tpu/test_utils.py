@@ -15,6 +15,7 @@ from .ndarray.ndarray import NDArray
 __all__ = [
     "assert_almost_equal", "almost_equal", "same", "rand_ndarray", "rand_shape_2d",
     "rand_shape_3d", "rand_shape_nd", "check_numeric_gradient", "check_consistency",
+    "check_symbolic_forward", "check_symbolic_backward",
     "environment", "default_device", "default_context", "effective_dtype",
     "assert_allclose",
 ]
@@ -130,6 +131,93 @@ def check_numeric_gradient(fn, inputs, eps=1e-3, rtol=1e-2, atol=1e-4):
             num_flat[j] = (fp - fm) / (2 * eps)
         assert_almost_equal(analytic[i], num, rtol=rtol, atol=atol,
                             names=(f"analytic[{i}]", f"numeric[{i}]"))
+
+
+def _parse_location(sym, location):
+    """list-or-dict location → {arg_name: NDArray} (reference:
+    test_utils.py:932 _parse_location)."""
+    arg_names = sym.list_arguments()
+    if isinstance(location, dict):
+        missing = set(arg_names) - set(location)
+        if missing:
+            raise ValueError(f"location is missing arguments {sorted(missing)}")
+        items = [(k, location[k]) for k in arg_names]
+    else:
+        if len(location) != len(arg_names):
+            raise ValueError(
+                f"location has {len(location)} entries for "
+                f"{len(arg_names)} arguments {arg_names}")
+        items = list(zip(arg_names, location))
+    return {k: v if isinstance(v, NDArray) else NDArray(onp.asarray(v))
+            for k, v in items}
+
+
+def _parse_aux(sym, aux_states):
+    if aux_states is None:
+        return None
+    aux_names = sym.list_auxiliary_states()
+    if isinstance(aux_states, dict):
+        items = [(k, aux_states[k]) for k in aux_names]
+    else:
+        items = list(zip(aux_names, aux_states))
+    return {k: v if isinstance(v, NDArray) else NDArray(onp.asarray(v))
+            for k, v in items}
+
+
+def check_symbolic_forward(sym, location, expected, rtol=1e-4, atol=1e-6,
+                           aux_states=None, ctx=None, equal_nan=False,
+                           dtype=None):  # noqa: ARG001
+    """Bind `sym` at `location`, run forward, compare every output with
+    `expected` (reference: test_utils.py:1194 — same list-or-dict
+    contracts). Returns the executor outputs."""
+    loc = _parse_location(sym, location)
+    ex = sym.bind(device=ctx, args=loc, aux_states=_parse_aux(sym, aux_states))
+    outputs = ex.forward(is_train=False)
+    if isinstance(expected, dict):
+        expected = [expected[k] for k in sym.list_outputs()]
+    for name, expect, out in zip(sym.list_outputs(), expected, outputs):
+        assert_almost_equal(out, expect, rtol=rtol, atol=atol,
+                            names=(f"FORWARD_{name}", f"EXPECTED_{name}"),
+                            equal_nan=equal_nan)
+    return outputs
+
+
+def check_symbolic_backward(sym, location, out_grads, expected, rtol=1e-4,
+                            atol=1e-6, aux_states=None, grad_req="write",
+                            ctx=None, equal_nan=False, dtype=None):  # noqa: ARG001
+    """Bind `sym` at `location`, backprop `out_grads`, compare each input
+    gradient with `expected` (reference: test_utils.py:1277). `grad_req`
+    may be a string or a per-argument dict; 'null' entries are skipped.
+    Returns the gradient arrays."""
+    loc = _parse_location(sym, location)
+    arg_names = sym.list_arguments()
+    grads = {k: NDArray(onp.zeros(v.shape, "float32"))
+             for k, v in loc.items()}
+    ex = sym.bind(device=ctx, args=loc, args_grad=grads,
+                  grad_req=grad_req, aux_states=_parse_aux(sym, aux_states))
+    ex.forward(is_train=True)
+    if out_grads is not None and not isinstance(out_grads, (list, tuple)):
+        out_grads = [out_grads]
+    if out_grads is not None:
+        out_grads = [g if isinstance(g, NDArray) else NDArray(onp.asarray(g))
+                     for g in out_grads]
+    ex.backward(out_grads)
+    if isinstance(expected, dict):
+        expected_items = expected.items()
+    else:
+        expected_items = zip(arg_names, expected)
+    for name, expect in expected_items:
+        if expect is None:
+            continue
+        req = grad_req.get(name, "write") if isinstance(grad_req, dict) \
+            else grad_req
+        if req == "null":
+            continue
+        assert_almost_equal(ex.grad_dict[name], expect, rtol=rtol,
+                            atol=atol,
+                            names=(f"BACKWARD_{name}", f"EXPECTED_{name}"),
+                            equal_nan=equal_nan)
+    return [ex.grad_dict.get(n) for n in arg_names]
 
 
 def check_consistency(fn, inputs, devices=None, rtol=1e-4, atol=1e-5):

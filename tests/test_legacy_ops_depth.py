@@ -245,3 +245,14 @@ def test_multi_sgd_single_out_ndarray():
                         out=w)
     onp.testing.assert_allclose(w.asnumpy(), wn - 0.1 * g.asnumpy(),
                                 rtol=1e-5)
+
+
+def test_upsampling_bilinear_with_weight():
+    # reference kBilinear mode: grouped deconv with the provided kernel
+    x = _r(1, 2, 4, 4)
+    s = 2
+    k = 2 * s - s % 2
+    w = np.ones((2, 1, k, k))
+    out = nd.UpSampling(x, w, scale=s, sample_type="bilinear",
+                        num_filter=2, num_args=2)
+    assert out.shape == (1, 2, 8, 8)

@@ -1,0 +1,89 @@
+"""Test-harness parity (reference `python/mxnet/test_utils.py`):
+check_symbolic_forward (:1194), check_symbolic_backward (:1277) — the
+reference's primary per-op correctness instruments — driven through the
+Symbol executor exactly like reference op tests do."""
+import numpy as onp
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import symbol as sym
+from incubator_mxnet_tpu.test_utils import (
+    check_consistency, check_symbolic_backward, check_symbolic_forward,
+)
+
+
+@pytest.mark.quick
+def test_check_symbolic_forward_dot():
+    # the reference docstring's own example (test_utils.py:1240)
+    lhs = sym.Variable("lhs")
+    rhs = sym.Variable("rhs")
+    sym_dot = sym.dot(lhs, rhs)
+    mat1 = onp.array([[1, 2], [3, 4]], "float32")
+    mat2 = onp.array([[5, 6], [7, 8]], "float32")
+    expected = onp.array([[19, 22], [43, 50]], "float32")
+    outs = check_symbolic_forward(sym_dot, [mat1, mat2], [expected])
+    assert len(outs) == 1
+
+
+def test_check_symbolic_forward_dict_location():
+    a = sym.Variable("a")
+    out = sym.exp(a)
+    x = onp.random.RandomState(0).uniform(-1, 1, (3, 4)).astype("float32")
+    check_symbolic_forward(out, {"a": x}, [onp.exp(x)])
+
+
+def test_check_symbolic_forward_mismatch_raises():
+    a = sym.Variable("a")
+    out = sym.exp(a)
+    x = onp.ones((2, 2), "float32")
+    with pytest.raises(AssertionError, match="FORWARD"):
+        check_symbolic_forward(out, [x], [onp.zeros((2, 2), "float32")])
+
+
+def test_check_symbolic_backward_dot():
+    lhs = sym.Variable("lhs")
+    rhs = sym.Variable("rhs")
+    sym_dot = sym.dot(lhs, rhs)
+    rng = onp.random.RandomState(0)
+    a = rng.uniform(-1, 1, (3, 4)).astype("float32")
+    b = rng.uniform(-1, 1, (4, 2)).astype("float32")
+    og = rng.uniform(-1, 1, (3, 2)).astype("float32")
+    grads = check_symbolic_backward(
+        sym_dot, [a, b], [og], [og @ b.T, a.T @ og])
+    assert len(grads) == 2
+
+
+def test_check_symbolic_backward_grad_req_null():
+    lhs = sym.Variable("lhs")
+    rhs = sym.Variable("rhs")
+    prod = lhs * rhs
+    rng = onp.random.RandomState(1)
+    a = rng.uniform(1, 2, (2, 3)).astype("float32")
+    b = rng.uniform(1, 2, (2, 3)).astype("float32")
+    og = onp.ones((2, 3), "float32")
+    # rhs gradient suppressed: only lhs compared
+    check_symbolic_backward(prod, [a, b], [og], {"lhs": b},
+                            grad_req={"lhs": "write", "rhs": "null"})
+
+
+def test_check_symbolic_backward_mismatch_raises():
+    a = sym.Variable("a")
+    out = a * a
+    x = onp.full((2, 2), 3.0, "float32")
+    og = onp.ones((2, 2), "float32")
+    with pytest.raises(AssertionError, match="BACKWARD"):
+        check_symbolic_backward(out, [x], [og],
+                                [onp.zeros((2, 2), "float32")])
+
+
+@pytest.mark.quick
+def test_check_consistency_across_virtual_devices():
+    """On the CPU test mesh this compares cpu(0) against the default
+    device — the same helper the real-chip gate
+    (test_tpu_consistency.py) uses against the accelerator."""
+    from incubator_mxnet_tpu import np
+
+    x = np.array(onp.random.RandomState(0)
+                 .uniform(-1, 1, (8, 8)).astype("float32"))
+    check_consistency(lambda a: np.dot(a, a.T), [x],
+                      devices=[mx.cpu(0), mx.cpu(0)])
