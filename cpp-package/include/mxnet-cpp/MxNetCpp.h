@@ -8,15 +8,17 @@
 // drift between language frontends. Compute still runs on the TPU via
 // XLA; the embedding only crosses the API boundary, never the math.
 //
-// Scope (documented): inference + NDArray math.
+// Scope (documented): inference + NDArray math + training.
 //   - Runtime        : interpreter lifecycle (RAII)
 //   - Context        : cpu()/tpu() device handles
 //   - NDArray        : construct / arithmetic / Dot / Sum / Argmax /
 //                      Softmax / CopyTo host
 //   - Predictor      : gluon model_zoo model (+ optional .params file) or
 //                      an exported SymbolBlock artifact; Forward()
-// Training from C++ is out of scope (SURVEY M6 "if required"); use the
-// Python frontend for training and export for serving.
+//   - Net/Optimizer/Trainer : training from C++ (reference:
+//                      cpp-package optimizer.hpp/executor.hpp) — the
+//                      gluon autograd/Trainer loop via the `_cpp_train`
+//                      bridge; see example/mlp_train.cc
 //
 // Build: g++ -std=c++17 app.cc $(python3-config --embed --cflags --ldflags)
 #ifndef MXNET_CPP_MXNETCPP_H_
@@ -373,6 +375,126 @@ class Predictor {
  private:
   explicit Predictor(PyObject* net) : net_(net) {}
   PyObject* net_ = nullptr;
+};
+
+// ---------------------------------------------------------------------------
+// Training surface (reference: cpp-package optimizer.hpp / executor.hpp —
+// full C++ training over Symbol/Executor/Optimizer). Here the gluon
+// autograd/Trainer loop is driven through the `_cpp_train` bridge module:
+// one training implementation for both language frontends.
+// ---------------------------------------------------------------------------
+
+// Optimizer spec (reference: OptimizerRegistry::Find("sgd") +
+// SetParam("lr", ...)). Any registered framework optimizer name works
+// ("sgd", "adam", "adamw", "lamb", ...).
+class Optimizer {
+ public:
+  Optimizer(const std::string& name, double learning_rate)
+      : name_(name), lr_(learning_rate) {}
+  const std::string& name() const { return name_; }
+  double lr() const { return lr_; }
+
+ private:
+  std::string name_;
+  double lr_;
+};
+
+// Trainable network handle: built from any Python-side factory
+// (module, fn, int args...), e.g. the bridge's make_mlp(hidden, classes).
+class Net {
+ public:
+  Net(const std::string& module, const std::string& factory,
+      const std::vector<long>& int_args) {
+    Runtime::Get();
+    PyObject* mod = PyImport_ImportModule(module.c_str());
+    if (!mod) _throw_py("import " + module);
+    PyObject* args = PyTuple_New(static_cast<Py_ssize_t>(int_args.size()));
+    for (size_t i = 0; i < int_args.size(); ++i)
+      PyTuple_SET_ITEM(args, static_cast<Py_ssize_t>(i),
+                       PyLong_FromLong(int_args[i]));
+    PyObject* fn = PyObject_GetAttrString(mod, factory.c_str());
+    Py_DECREF(mod);
+    if (!fn) { Py_DECREF(args); _throw_py(factory); }
+    net_ = PyObject_Call(fn, args, nullptr);
+    Py_DECREF(fn);
+    Py_DECREF(args);
+    if (!net_) _throw_py(factory);
+  }
+
+  NDArray Forward(const NDArray& x) const {
+    PyObject* out = PyObject_CallFunctionObjArgs(net_, x.handle(), nullptr);
+    if (!out) _throw_py("forward");
+    return NDArray(out);
+  }
+
+  void SaveParameters(const std::string& path) const {
+    PyObject* r = PyObject_CallMethod(net_, "save_parameters", "s",
+                                      path.c_str());
+    if (!r) _throw_py("save_parameters");
+    Py_DECREF(r);
+  }
+
+  void LoadParameters(const std::string& path) const {
+    PyObject* r = PyObject_CallMethod(net_, "load_parameters", "s",
+                                      path.c_str());
+    if (!r) _throw_py("load_parameters");
+    Py_DECREF(r);
+  }
+
+  PyObject* handle() const { return net_; }
+
+  ~Net() { Py_XDECREF(net_); }
+  Net(const Net& o) : net_(o.net_) { Py_XINCREF(net_); }
+  Net& operator=(const Net&) = delete;
+  Net(Net&& o) noexcept : net_(o.net_) { o.net_ = nullptr; }
+
+ private:
+  PyObject* net_ = nullptr;
+};
+
+// gluon.Trainer + SoftmaxCrossEntropyLoss driven from C++ (reference:
+// the cpp-package training loop: exec->Forward/Backward + opt->Update).
+class Trainer {
+ public:
+  Trainer(const Net& net, const Optimizer& opt) : net_(net.handle()) {
+    Py_XINCREF(net_);
+    bridge_ = PyImport_ImportModule("incubator_mxnet_tpu._cpp_train");
+    if (!bridge_) _throw_py("import _cpp_train");
+    PyObject* pair = PyObject_CallMethod(
+        bridge_, "make_trainer", "Osd", net_, opt.name().c_str(), opt.lr());
+    if (!pair) _throw_py("make_trainer");
+    trainer_ = PyTuple_GetItem(pair, 0);
+    loss_fn_ = PyTuple_GetItem(pair, 1);
+    Py_INCREF(trainer_);
+    Py_INCREF(loss_fn_);
+    Py_DECREF(pair);
+  }
+
+  // one fwd+bwd+update step; returns the mean loss
+  double Step(const NDArray& x, const NDArray& y, long batch_size) const {
+    PyObject* loss = PyObject_CallMethod(
+        bridge_, "train_step", "OOOOOl", net_, trainer_, loss_fn_,
+        x.handle(), y.handle(), batch_size);
+    if (!loss) _throw_py("train_step");
+    double v = PyFloat_AsDouble(loss);
+    Py_DECREF(loss);
+    return v;
+  }
+
+  ~Trainer() {
+    Py_XDECREF(loss_fn_);
+    Py_XDECREF(trainer_);
+    Py_XDECREF(bridge_);
+    Py_XDECREF(net_);
+  }
+  Trainer(const Trainer&) = delete;
+  Trainer& operator=(const Trainer&) = delete;
+
+ private:
+  PyObject* net_ = nullptr;
+  PyObject* bridge_ = nullptr;
+  PyObject* trainer_ = nullptr;
+  PyObject* loss_fn_ = nullptr;
 };
 
 }  // namespace cpp

@@ -1,0 +1,60 @@
+"""Bridge helpers for the C++ frontend's TRAINING surface
+(`cpp-package/include/mxnet-cpp/MxNetCpp.h` Net/Trainer — reference:
+`cpp-package/include/mxnet-cpp/optimizer.hpp` + `executor.hpp`, which
+wrap Symbol/Executor/Optimizer for full C++ training).
+
+The embedded interpreter calls these few functions instead of
+re-implementing the gluon training loop in C API calls — one
+implementation of autograd/Trainer for both language frontends. Every
+function takes/returns framework objects (NDArray, Block, Trainer) that
+the C++ side holds as opaque PyObject handles.
+"""
+from __future__ import annotations
+
+__all__ = ["make_mlp", "make_trainer", "train_step", "toy_classification"]
+
+
+def make_mlp(hidden, classes):
+    """Small MLP factory for the C++ training example (the reference's
+    cpp-package mlp.cpp builds the same shape from Symbols)."""
+    from . import gluon
+
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(int(hidden), activation="relu"),
+            gluon.nn.Dense(int(classes)))
+    net.initialize()
+    return net
+
+
+def make_trainer(net, optimizer="sgd", learning_rate=0.1):
+    """(gluon.Trainer, loss_fn) over the net's parameters."""
+    from . import gluon
+
+    trainer = gluon.Trainer(net.collect_params(), str(optimizer),
+                            {"learning_rate": float(learning_rate)})
+    return trainer, gluon.loss.SoftmaxCrossEntropyLoss()
+
+
+def train_step(net, trainer, loss_fn, x, y, batch_size):
+    """One fwd+bwd+update step; returns the mean loss as a float."""
+    from . import autograd
+
+    with autograd.record():
+        loss = loss_fn(net(x), y)
+    loss.backward()
+    trainer.step(int(batch_size))
+    return float(loss.mean().item())
+
+
+def toy_classification(n=256, dim=16, classes=4, seed=0):
+    """Deterministic linearly-separable data (x, y) for the C++ training
+    example — env has no dataset egress, and learnability is the point."""
+    import numpy as onp
+
+    from . import np
+
+    rng = onp.random.RandomState(seed)
+    centers = rng.uniform(-2, 2, (classes, dim)).astype("float32")
+    y = rng.randint(0, classes, n).astype("int32")
+    x = centers[y] + rng.normal(0, 0.3, (n, dim)).astype("float32")
+    return np.array(x), np.array(y)

@@ -31,6 +31,18 @@ def _worker_init(dataset, batchify_fn, use_shm=True):
     _worker_dataset = dataset
     _worker_batchify = batchify_fn
     _worker_use_shm = use_shm
+    # MXNET_MP_OPENCV_NUM_THREADS (env_var.md): cap cv2's internal pool
+    # per worker so P workers don't spawn P x ncores decode threads
+    import os
+
+    v = os.environ.get("MXNET_MP_OPENCV_NUM_THREADS")
+    if v:
+        try:
+            import cv2
+
+            cv2.setNumThreads(max(0, int(v)))
+        except (ImportError, ValueError):
+            pass
 
 
 def _export_shm(arr):

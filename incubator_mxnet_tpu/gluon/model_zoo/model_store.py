@@ -65,6 +65,11 @@ def _sha1(path):
 
 def _search_roots(root=None):
     roots = [root] if root else []
+    # MXNET_GLUON_REPO (env_var.md): override the artifact root — here a
+    # local directory (no egress) searched before the default caches
+    repo = os.environ.get("MXNET_GLUON_REPO", "")
+    if repo and "://" not in repo:
+        roots.append(repo)
     roots.append(os.path.join(data_dir(), "models"))
     extra = os.environ.get("INCUBATOR_MXNET_TPU_MODEL_PATH", "")
     roots += [p for p in extra.split(os.pathsep) if p]

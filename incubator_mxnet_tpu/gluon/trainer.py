@@ -38,7 +38,16 @@ class Trainer:
         self._states_initialized = [False] * len(self._params)
         self._kvstore = None
         self._kvstore_type = kvstore
-        self._update_on_kvstore = False
+        if update_on_kvstore is None:
+            # MXNET_UPDATE_ON_KVSTORE (env_var.md): default when the
+            # caller leaves the choice open. Our stores run the optimizer
+            # in-process either way (no server role), so this toggles
+            # intent/bookkeeping, not placement.
+            import os
+
+            update_on_kvstore = \
+                os.environ.get("MXNET_UPDATE_ON_KVSTORE") == "1"
+        self._update_on_kvstore = bool(update_on_kvstore)
         self._kv_initialized = False
 
     # -- kvstore ------------------------------------------------------------

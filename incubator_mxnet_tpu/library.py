@@ -20,7 +20,7 @@ __all__ = ["load"]
 _DTYPE_CODES = {"float32": 0, "float64": 1, "int32": 2, "int64": 3,
                 "uint8": 4, "bool": 5}
 _MAX_NDIM = 8
-_ABI_VERSION = 1
+_ABI_VERSION = 2
 
 
 class _MXExtTensor(ctypes.Structure):
@@ -137,8 +137,19 @@ def _make_op(lib, op_idx, name):
 
 
 def load(path, verbose=True):
-    """Load a custom-op extension library and register its ops on `npx`
-    (reference: library.py:28 load). Returns {name: callable}."""
+    """Load an extension library: custom ops register on `npx`; graph
+    passes and partitioners (ABI v2) register as partition backends
+    applicable via `net.optimize_for(x, backend=<name>)`.
+    (Reference: library.py:28 load → MXLoadLib, which registers ops,
+    passes, and partitioners from the .so, lib_api.h:931-1197.)
+    Returns {name: callable} for the ops."""
+    import os
+
+    if not os.path.isabs(path) and not os.path.exists(path):
+        # MXNET_LIBRARY_PATH (env_var.md): search root for bare .so names
+        root = os.environ.get("MXNET_LIBRARY_PATH")
+        if root and os.path.exists(os.path.join(root, path)):
+            path = os.path.join(root, path)
     lib = ctypes.CDLL(path)
     for sym in ("mx_ext_abi_version", "mx_ext_num_ops", "mx_ext_op_name",
                 "mx_ext_op_infer_shape", "mx_ext_op_forward"):
@@ -147,8 +158,12 @@ def load(path, verbose=True):
                              f"(missing {sym})")
     _bind(lib)
     abi = lib.mx_ext_abi_version()
-    if abi != _ABI_VERSION:
-        raise ValueError(f"extension ABI {abi} != supported {_ABI_VERSION}")
+    if not 1 <= abi <= _ABI_VERSION:
+        # handshake (reference lib_api.h:931 MX_LIBRARY_VERSION check):
+        # newer-than-us extensions are rejected, older ones load with
+        # their smaller export surface
+        raise ValueError(f"extension ABI {abi} unsupported (loader "
+                         f"speaks 1..{_ABI_VERSION})")
     from . import numpy_extension as npx
 
     ops = {}
@@ -157,6 +172,104 @@ def load(path, verbose=True):
         fn = _make_op(lib, i, name)
         ops[name] = fn
         setattr(npx, name, fn)
+    backends = []
+    if abi >= 2:
+        backends = _register_graph_hooks(lib, path)
     if verbose:
-        print(f"loaded library {path}: ops {sorted(ops)}")
+        print(f"loaded library {path}: ops {sorted(ops)}"
+              + (f", backends {backends}" if backends else ""))
     return ops
+
+
+# -- ABI v2: graph passes + partitioners --------------------------------------
+
+def _bind_v2(lib, kind):
+    """Bind the optional pass/partitioner symbol triple; None if the
+    library doesn't export this hook family."""
+    syms = {"pass": ("mx_ext_num_passes", "mx_ext_pass_name",
+                     "mx_ext_pass_apply"),
+            "partitioner": ("mx_ext_num_partitioners",
+                            "mx_ext_partitioner_name",
+                            "mx_ext_partition")}[kind]
+    try:
+        num = getattr(lib, syms[0])
+        name = getattr(lib, syms[1])
+        apply = getattr(lib, syms[2])
+        free = lib.mx_ext_free
+    except AttributeError:
+        return None
+    num.restype = ctypes.c_int
+    name.restype = ctypes.c_char_p
+    name.argtypes = [ctypes.c_int]
+    # returned string is extension-owned malloc memory: take it as a raw
+    # pointer so WE control the copy + the mx_ext_free call
+    apply.restype = ctypes.c_void_p
+    apply.argtypes = [ctypes.c_int, ctypes.c_char_p]
+    free.restype = None
+    free.argtypes = [ctypes.c_void_p]
+    return num, name, apply, free
+
+
+def _call_graph_hook(apply_fn, free_fn, idx, op_names):
+    import json
+
+    graph = json.dumps(
+        {"nodes": [{"id": i, "op": n} for i, n in enumerate(op_names)]})
+    raw = apply_fn(idx, graph.encode())
+    if not raw:
+        raise RuntimeError("extension graph hook returned NULL")
+    try:
+        out = ctypes.string_at(raw).decode()
+    finally:
+        free_fn(raw)
+    return json.loads(out)
+
+
+class _ExtensionBackend:
+    """Partition Backend whose fusion directives come from an extension
+    hook at trace time (the graph they act on only exists then)."""
+
+    mark_ops = "*"          # outline every funnel op: the extension
+    patterns: list = []     # matches framework-op names, not primitives
+
+    def __init__(self, name, apply_fn, free_fn, idx, directive_key):
+        self.name = name
+        self._apply = apply_fn
+        self._free = free_fn
+        self._idx = idx
+        self._key = directive_key
+
+    def rewrite_block(self, block, **opts):  # noqa: ARG002
+        return block
+
+    def dynamic_patterns(self, closed):
+        from .partition import graph_op_names, segment_pattern
+
+        directives = _call_graph_hook(
+            self._apply, self._free, self._idx, graph_op_names(closed))
+        pats = []
+        for j, d in enumerate(directives.get(self._key, [])):
+            pats.append(segment_pattern(
+                [str(o) for o in d["ops"]],
+                str(d.get("name", f"{self.name}_seg{j}"))))
+        return pats
+
+
+def _register_graph_hooks(lib, path):
+    from .partition import register_backend
+
+    registered = []
+    for kind, key in (("pass", "fuse"), ("partitioner", "subgraphs")):
+        bound = _bind_v2(lib, kind)
+        if bound is None:
+            continue
+        num, name_fn, apply_fn, free_fn = bound
+        for i in range(num()):
+            raw = name_fn(i)
+            if raw is None:
+                raise ValueError(f"{path}: {kind} {i} has no name")
+            bname = raw.decode()
+            register_backend(_ExtensionBackend(bname, apply_fn, free_fn,
+                                               i, key))
+            registered.append(bname)
+    return registered

@@ -293,17 +293,26 @@ def UpSampling(*data, scale=1, sample_type="nearest", num_args=1,  # noqa: N802,
     # bilinear-initialized, learnable) grouped deconvolution
     # (upsampling-inl.h kBilinear) — data[1] is that weight when given
     if len(data) > 1:
-        from .. import numpy as _np
-        from ..numpy_extension import deconvolution
-
         wgt = data[1]                       # (C, 1, k, k) depthwise
         k = wgt.shape[-1]
-        chans = [deconvolution(x[:, c:c + 1], wgt[c:c + 1],
-                               kernel=(k, k), stride=(s, s),
-                               pad=((k - s) // 2, (k - s) // 2),
-                               num_filter=1, no_bias=True)
-                 for c in range(x.shape[1])]
-        return _np.concatenate(chans, axis=1)
+        p = (k - s) // 2
+
+        def fn(v, w):
+            import jax.lax as lax
+
+            # one grouped transposed conv: lhs_dilation=s is the
+            # fractionally-strided form, feature_group_count=C makes it
+            # depthwise, and the spatial flip gives transpose-kernel
+            # semantics for arbitrary (non-symmetric) weights
+            return lax.conv_general_dilated(
+                v, w[..., ::-1, ::-1], window_strides=(1, 1),
+                padding=[(k - 1 - p, k - 1 - p)] * 2,
+                lhs_dilation=(s, s),
+                dimension_numbers=("NCHW", "OIHW", "NCHW"),
+                feature_group_count=v.shape[1])
+
+        return apply_op("UpSampling", fn, (x, wgt),
+                        static_info=("bil", s, k, p))
     from ..numpy_extension import bilinear_resize2d
 
     return bilinear_resize2d(x, height=oh, width=ow)

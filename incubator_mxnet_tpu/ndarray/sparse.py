@@ -42,6 +42,20 @@ def _dense_to_csr_fields(dense):
     return data, cols.astype(onp.int32), indptr
 
 
+def _log_storage_fallback(stype, shape):
+    """MXNET_STORAGE_FALLBACK_LOG_VERBOSE (env_var.md, default on in the
+    reference): announce sparse→dense densification, the perf cliff the
+    reference's FComputeFallback also warns about."""
+    import logging
+    import os
+
+    # default ON like the reference (env_var.md: default=1)
+    if os.environ.get("MXNET_STORAGE_FALLBACK_LOG_VERBOSE", "1") == "1":
+        logging.getLogger("incubator_mxnet_tpu.sparse").warning(
+            "storage fallback: %s %s densified (op has no sparse path)",
+            stype, tuple(shape))
+
+
 def _jnp():
     import jax.numpy as jnp
 
@@ -89,6 +103,7 @@ class RowSparseNDArray(NDArray):
     def _data(self):
         d = NDArray._data.__get__(self)
         if d is None:
+            _log_storage_fallback("row_sparse", self._sp_shape)
             jnp = _jnp()
             d = jnp.zeros(self._sp_shape, self._sp_values.dtype).at[
                 self._sp_indices].add(self._sp_values)
@@ -262,6 +277,7 @@ class CSRNDArray(NDArray):
     def _data(self):
         d = NDArray._data.__get__(self)
         if d is None:
+            _log_storage_fallback("csr", self._sp_shape)
             jnp = _jnp()
             d = jnp.zeros(self._sp_shape, self._sp_data.dtype).at[
                 self._row_ids(), self._sp_col_indices].add(self._sp_data)

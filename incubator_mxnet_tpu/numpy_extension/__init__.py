@@ -115,6 +115,15 @@ def __getattr__(name):
     raise AttributeError(f"module 'npx' has no attribute {name!r}")
 
 
+def _safe_accumulation():
+    """MXNET_SAFE_ACCUMULATION=1 → fp32 accumulation for low-precision
+    inputs in softmax/norm reductions (reference env_var.md; matmul
+    accumulation is fp32 on the MXU regardless)."""
+    import os
+
+    return os.environ.get("MXNET_SAFE_ACCUMULATION") == "1"
+
+
 def _jnp():
     import jax.numpy as jnp
 
@@ -213,8 +222,15 @@ def softmax(data, axis=-1, length=None, temperature=None, use_length=False,
     import jax
 
     jnp = _jnp()
+    safe = _safe_accumulation()
 
     def f(x, ln):
+        in_dt = x.dtype
+        if safe and str(in_dt) in ("float16", "bfloat16"):
+            # MXNET_SAFE_ACCUMULATION: reduce in fp32 (reference
+            # softmax.cc AType promotion), cast back unless dtype= says
+            # otherwise
+            x = x.astype("float32")
         if temperature is not None and temperature != 1.0:
             x = x / temperature
         if ln is not None:
@@ -224,9 +240,12 @@ def softmax(data, axis=-1, length=None, temperature=None, use_length=False,
             mask = idx.reshape(shape) < jnp.expand_dims(ln, axis=axis)
             x = jnp.where(mask, x, -jnp.inf)
             out = jax.nn.softmax(x, axis=axis)
-            return jnp.where(mask, out, 0.0)
-        out = jax.nn.softmax(x, axis=axis)
-        return out.astype(np_dtype(dtype)) if dtype else out
+            out = jnp.where(mask, out, 0.0)
+        else:
+            out = jax.nn.softmax(x, axis=axis)
+        if dtype:
+            return out.astype(np_dtype(dtype))
+        return out.astype(in_dt) if safe else out
 
     ln = length if (use_length or length is not None) else None
     return apply_op("softmax", f,

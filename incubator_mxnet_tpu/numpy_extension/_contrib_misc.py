@@ -412,12 +412,20 @@ def norm(data, ord=2, axis=None, keepdims=False, out=None):  # noqa: A002
         raise ValueError(f"npx.norm supports ord 1 or 2, got {ord!r}")
     ax = axis if axis is None or isinstance(axis, int) \
         else tuple(int(a) for a in axis)
+    from . import _safe_accumulation
+
+    safe = _safe_accumulation()
 
     def fn(x):
         jnp = _jnp()
+        in_dt = x.dtype
+        if safe and str(in_dt) in ("float16", "bfloat16"):
+            x = x.astype("float32")
         if ord == 1:
-            return jnp.abs(x).sum(axis=ax, keepdims=keepdims)
-        return jnp.sqrt((x * x).sum(axis=ax, keepdims=keepdims))
+            out = jnp.abs(x).sum(axis=ax, keepdims=keepdims)
+        else:
+            out = jnp.sqrt((x * x).sum(axis=ax, keepdims=keepdims))
+        return out.astype(in_dt) if safe else out
 
     return apply_op("norm", fn, (data,),
                     static_info=("ord", ord, ax, keepdims), out=out)

@@ -69,8 +69,17 @@ def _build_pure_step(net, loss_fn, optimizer, remat_spec=None):
     # ELEMENTWISE rules (LARS/LAMB take per-tensor norms) over plain
     # list-of-like-shaped states.
     _SMALL = 1 << 14
+    # MXNET_OPTIMIZER_AGGREGATION_SIZE (env_var.md, default 4): 0/1
+    # disables multi-tensor aggregation; our grouping is one concatenated
+    # segment rather than count-sized batches, so >1 leaves it on
+    import os as _os
+
+    _agg = _os.environ.get("MXNET_OPTIMIZER_AGGREGATION_SIZE")
+    _fusion_off = _agg is not None and _agg.isdigit() and int(_agg) <= 1
 
     def _fusable(i):
+        if _fusion_off:
+            return False
         a = param_arrays[i]
         # cheap filters FIRST: create_state allocates real device buffers
         # (Adam m/v), which must not happen for every multi-MB weight

@@ -38,7 +38,8 @@ import threading
 
 __all__ = ["Pattern", "Backend", "register_backend", "get_backend",
            "list_backends", "backend_scope", "active_backend",
-           "outline_op", "rewrite_jaxpr", "apply_backend"]
+           "outline_op", "rewrite_jaxpr", "apply_backend",
+           "segment_pattern", "graph_op_names"]
 
 _BACKENDS: dict = {}
 
@@ -362,19 +363,74 @@ def rewrite_jaxpr(closed, patterns):
     return closed, n_rewrites
 
 
+def segment_pattern(ops, name):
+    """Pattern that fuses a matched op-name chain into ONE compiled
+    segment named `name` — semantics-preserving (the replacement
+    re-binds the matched eqns under a single named jit). This is the
+    directive form extension passes/partitioners emit
+    (`library.py` v2 `{"fuse"/"subgraphs": [{"ops": [...]}]}`)."""
+    def replace(eqns, invals):
+        import jax
+        from jax.extend.core import Var
+
+        produced = set()
+        for e in eqns:
+            produced.update(e.outvars)
+        in_vars, seen = [], set()
+        for e in eqns:
+            for v in e.invars:
+                if isinstance(v, Var) and v not in produced \
+                        and v not in seen:
+                    in_vars.append(v)
+                    seen.add(v)
+
+        def run(*xs):
+            env = dict(zip(in_vars, xs))
+
+            def read(v):
+                return env[v] if isinstance(v, Var) else v.val
+
+            for e in eqns:
+                outs = e.primitive.bind(*[read(v) for v in e.invars],
+                                        **e.params)
+                if not e.primitive.multiple_results:
+                    outs = [outs]
+                for ov, o in zip(e.outvars, outs):
+                    env[ov] = o
+            res = tuple(env[v] for v in eqns[-1].outvars)
+            return res if len(res) > 1 else res[0]
+
+        run.__name__ = name
+        return jax.jit(run)(*invals)
+
+    return Pattern(name, list(ops), replace)
+
+
+def graph_op_names(closed):
+    """Linear op-name view of a traced graph — the serialization handed
+    to extension passes/partitioners."""
+    return [_eqn_op_name(e) for e in closed.jaxpr.eqns]
+
+
 def apply_backend(fn, backend):
     """Wrap a pure traced fn so that, at trace time, it is (1) traced with
     the backend's ops outlined, (2) pattern-rewritten, (3) inlined back
     into the surrounding trace. Shape-polymorphic via jax's own caching —
-    the rewrite happens per trace."""
+    the rewrite happens per trace. A backend may define
+    `dynamic_patterns(closed)` to derive patterns from the traced graph
+    (extension partitioners do — their directives depend on the graph)."""
     import jax
     import jax.tree_util as jtu
 
     def wrapped(*args):
         with backend_scope(backend):
             closed, out_shape = jax.make_jaxpr(fn, return_shape=True)(*args)
-        if backend.patterns:
-            closed, n = rewrite_jaxpr(closed, backend.patterns)
+        patterns = list(backend.patterns)
+        dyn = getattr(backend, "dynamic_patterns", None)
+        if dyn is not None:
+            patterns += list(dyn(closed))
+        if patterns:
+            closed, n = rewrite_jaxpr(closed, patterns)
             backend.last_rewrites = n   # observability for tests/logging
         flat, _ = jtu.tree_flatten(args)
         out_flat = jax.core.eval_jaxpr(closed.jaxpr, closed.consts, *flat)

@@ -423,5 +423,9 @@ class Scope:
 profiler_scope = Scope
 
 if os.environ.get("MXNET_PROFILER_AUTOSTART", "0") == "1":
+    # MXNET_PROFILER_MODE (env_var.md, default 0): 0 = symbolic/device
+    # only (skip per-op imperative timing), 1 = all
+    if os.environ.get("MXNET_PROFILER_MODE", "0") != "1":
+        set_config(profile_imperative=False)
     start()
     atexit.register(dump)

@@ -82,26 +82,128 @@ def default_array(source_array, ctx=None, dtype=None):
 # table (name → (honored_by, description)).
 # ---------------------------------------------------------------------------
 _ENV_KNOBS = {
+    # -- honored -----------------------------------------------------------
     "MXNET_PROFILER_AUTOSTART": (
         "profiler", "start the profiler at import (honored)"),
+    "MXNET_PROFILER_MODE": (
+        "profiler.set_config", "0 = symbolic/device only (imperative op "
+        "timing off), 1 = all (honored at autostart)"),
     "MXNET_ENGINE_BULK_SIZE": (
         "engine.set_bulk_size", "initial bulk window (honored at import; "
         "op grouping itself is XLA's jit fusion)"),
     "MXNET_CPU_WORKER_NTHREADS": (
         "gluon.data.DataLoader", "default num_workers when the caller "
         "passes none (honored)"),
+    "MXNET_MP_WORKER_NTHREADS": (
+        "gluon.data.DataLoader", "alias consulted after "
+        "MXNET_CPU_WORKER_NTHREADS for the default worker count (honored)"),
+    "MXNET_MP_OPENCV_NUM_THREADS": (
+        "gluon.data.DataLoader workers", "cv2.setNumThreads in each "
+        "spawned worker (honored; keeps P workers from P×N threads)"),
+    "MXNET_MP_START_METHOD": (
+        "gluon.data.DataLoader", "multiprocessing start method; default "
+        "spawn/forkserver (fork is unsafe in the jax parent) (honored)"),
     "MXNET_GPU_MEM_POOL_RESERVE": (
         "XLA_PYTHON_CLIENT_MEM_FRACTION", "reserve fraction → forwarded "
-        "to the XLA allocator when set before first device use"),
+        "to the XLA allocator when set before first device use (honored)"),
+    "MXNET_MEMORY_OPT": (
+        "remat.py", "1 → MEMORY_OPT rematerialization policy on compiled "
+        "train steps (honored)"),
+    "MXNET_BACKWARD_DO_MIRROR": (
+        "remat.py", "1 → DO_MIRROR checkpointing policy (honored)"),
+    "MXNET_SAFE_ACCUMULATION": (
+        "npx.softmax family / npx.norm", "1 → fp32 accumulation for "
+        "fp16/bf16 inputs (honored; matmul accumulation is fp32 on the "
+        "MXU regardless)"),
+    "MXNET_UPDATE_ON_KVSTORE": (
+        "gluon.Trainer", "default for update_on_kvstore when the caller "
+        "passes None (honored)"),
+    "MXNET_OPTIMIZER_AGGREGATION_SIZE": (
+        "parallel.sharded fused updates", "0/1 disables the multi-tensor "
+        "small-parameter fusion; >1 keeps it (honored; grouping is one "
+        "concatenated segment, not count-sized batches)"),
+    "MXNET_STORAGE_FALLBACK_LOG_VERBOSE": (
+        "ndarray.sparse", "log sparse→dense storage fallbacks (honored)"),
+    "MXNET_LIBRARY_PATH": (
+        "library.load", "default directory searched for extension .so "
+        "paths given as bare filenames (honored)"),
+    "MXNET_GLUON_REPO": (
+        "gluon.model_zoo model_store", "override the pretrained-artifact "
+        "root (honored; default is the packaged local store — no egress)"),
+    "MXNET_HOME": (
+        "base.data_dir", "data/artifact cache root (honored)"),
+    "MXNET_ENFORCE_DETERMINISM": (
+        "jax/XLA", "accepted; TPU XLA execution is deterministic for a "
+        "fixed program+seed already, so this is a no-op guard (honored "
+        "as assertion that no nondeterministic backend is active)"),
+    "MXNET_KVSTORE_BIGARRAY_BOUND": (
+        "kvstore/compression", "threshold above which gradient "
+        "compression applies (honored where compression is configured)"),
+    "MXNET_TEST_SEED": (
+        "test_utils", "per-test RNG seed override (honored, this build's "
+        "addition)"),
+    "MXNET_RNG_IMPL": (
+        "random.py", "threefry/rbg PRNG implementation choice (honored, "
+        "this build's addition)"),
+    "MXNET_LOCAL_RANK": (
+        "kvstore horovod facade / tools/launch.py", "rank within host "
+        "(honored, exported by the launcher)"),
+    # -- designed out (XLA/jax owns the mechanism) -------------------------
     "MXNET_ENGINE_TYPE": (
         "(designed out)", "scheduling is XLA async dispatch; value ignored"),
     "MXNET_EXEC_ENABLE_INPLACE": (
         "(designed out)", "buffer reuse is XLA memory planning + donation"),
+    "MXNET_EXEC_BULK_EXEC_TRAIN": (
+        "(designed out)", "whole-step jit IS the bulk execution"),
+    "MXNET_EXEC_BULK_EXEC_INFERENCE": (
+        "(designed out)", "hybridize compiles the whole forward"),
+    "MXNET_EXEC_BULK_EXEC_MAX_NODE_TRAIN": (
+        "(designed out)", "XLA fusion decides segment sizes"),
     "MXNET_USE_FUSION": (
         "(designed out)", "pointwise fusion is XLA's default behavior"),
-    "MXNET_KVSTORE_BIGARRAY_BOUND": (
-        "(designed out)", "collectives are whole-array XLA ops; chunking "
-        "is the partitioner's job"),
+    "MXNET_ELIMINATE_COMMON_EXPR": (
+        "(designed out)", "CSE is an XLA pass, always on"),
+    "MXNET_ENABLE_OPERATOR_TUNING": (
+        "(designed out)", "XLA autotuning replaces per-op OMP tuning"),
+    "MXNET_USE_NUM_CORES_OPERATOR_TUNING": (
+        "(designed out)", "see MXNET_ENABLE_OPERATOR_TUNING"),
+    "MXNET_EXEC_NUM_TEMP": (
+        "(designed out)", "temp space is XLA-planned"),
+    "MXNET_GPU_WORKER_NTHREADS": (
+        "(designed out)", "device streams are XLA-managed"),
+    "MXNET_GPU_COPY_NTHREADS": (
+        "(designed out)", "transfers ride PJRT's transfer manager"),
+    "MXNET_CPU_PRIORITY_NTHREADS": (
+        "(designed out)", "no priority op queue; XLA host runtime"),
+    "MXNET_KVSTORE_REDUCTION_NTHREADS": (
+        "(designed out)", "reductions are device collectives"),
+    "MXNET_KVSTORE_USETREE": (
+        "(designed out)", "collective topology is the XLA partitioner's"),
+    "MXNET_KVSTORE_LOGTREE": (
+        "(designed out)", "see MXNET_KVSTORE_USETREE"),
+    "MXNET_KVSTORE_SLICE_THRESHOLD": (
+        "(designed out)", "no server-side slicing; whole-array psum"),
+    "MXNET_UPDATE_ON_KVSTORE_SERVER": (
+        "(designed out)", "no parameter-server processes (SURVEY §7)"),
+    "MXNET_GPU_MEM_POOL_TYPE": (
+        "(designed out)", "PJRT owns device memory pooling"),
+    "MXNET_GPU_MEM_POOL_PAGE_SIZE": (
+        "(designed out)", "PJRT owns device memory pooling"),
+    "MXNET_CPU_MEM_POOL_TYPE": (
+        "(designed out)", "host allocations are numpy/PJRT-managed"),
+    "MXNET_CPU_MEM_POOL_RESERVE": (
+        "(designed out)", "host allocations are numpy/PJRT-managed"),
+    "MXNET_FC_TRUE_FP16": (
+        "(designed out)", "matmuls accumulate fp32 on the MXU by "
+        "default; true-fp16 accumulation is not offered"),
+    # -- not applicable (other backends) -----------------------------------
+    "MXNET_CUDNN_AUTOTUNE_DEFAULT": (
+        "(n/a)", "cuDNN backend absent (XLA codegen)"),
+    "MXNET_CUDA_ALLOW_TENSOR_CORE": (
+        "(n/a)", "CUDA backend absent; MXU bf16 is the analogue"),
+    "MXNET_ONEDNN_ENABLED": ("(n/a)", "oneDNN backend absent"),
+    "MXNET_ENABLE_CYTHON": ("(n/a)", "no cython binding layer"),
+    "MXNET_GPU_P2P": ("(n/a)", "ICI mesh replaces P2P rings"),
 }
 
 
@@ -128,10 +230,12 @@ def _apply_env_config():
 
 
 def default_num_workers():
-    """DataLoader default worker count (MXNET_CPU_WORKER_NTHREADS)."""
+    """DataLoader default worker count (MXNET_CPU_WORKER_NTHREADS, with
+    MXNET_MP_WORKER_NTHREADS as the documented multiprocessing alias)."""
     import os
 
-    v = os.environ.get("MXNET_CPU_WORKER_NTHREADS")
+    v = os.environ.get("MXNET_CPU_WORKER_NTHREADS") \
+        or os.environ.get("MXNET_MP_WORKER_NTHREADS")
     try:
         return max(0, int(v)) if v else 0
     except ValueError:

@@ -1,0 +1,101 @@
+// Example graph-pass / partitioner extension (reference:
+// example/extensions/lib_subgraph + lib_pass — out-of-tree .so that
+// registers a partitioner the frontend applies by name).
+//
+// The partitioner "fc_fuser" scans the serialized graph for
+// fully_connected followed by an activation and directs the framework to
+// outline each such chain into one compiled segment. The pass
+// "norm_fuser" does the same for layer_norm chains. Demonstrates the v2
+// JSON directive contract end-to-end, including mx_ext_free ownership.
+#include <cstdlib>
+#include <cstring>
+#include <string>
+
+#include "mx_ext.h"
+
+namespace {
+
+// minimal scan of the {"nodes":[{"id":..,"op":"name"},...]} payload:
+// count occurrences of `op` in the graph (no JSON lib needed — the
+// framework emits a fixed, machine-generated shape)
+int count_op(const char* graph_json, const char* op) {
+  std::string needle = std::string("\"op\": \"") + op + "\"";
+  int n = 0;
+  const char* p = graph_json;
+  while ((p = std::strstr(p, needle.c_str())) != nullptr) {
+    ++n;
+    p += needle.size();
+  }
+  return n;
+}
+
+const char* dup(const std::string& s) {
+  char* out = static_cast<char*>(std::malloc(s.size() + 1));
+  if (out == nullptr) return nullptr;
+  std::memcpy(out, s.c_str(), s.size() + 1);
+  return out;
+}
+
+}  // namespace
+
+extern "C" {
+
+int mx_ext_abi_version(void) { return MX_EXT_ABI_VERSION; }
+
+// this library registers no custom ops — graph hooks only
+int mx_ext_num_ops(void) { return 0; }
+const char* mx_ext_op_name(int) { return nullptr; }
+int mx_ext_op_infer_shape(int, int, const int64_t* const*, const int*,
+                          int64_t*, int*) { return -1; }
+int mx_ext_op_forward(int, int, const MXExtTensor*, MXExtTensor*) {
+  return -1;
+}
+
+int mx_ext_num_passes(void) { return 1; }
+
+const char* mx_ext_pass_name(int pass) {
+  return pass == 0 ? "norm_fuser" : nullptr;
+}
+
+const char* mx_ext_pass_apply(int pass, const char* graph_json) {
+  if (pass != 0 || graph_json == nullptr) return nullptr;
+  if (count_op(graph_json, "layer_norm") == 0) {
+    return dup("{\"fuse\": []}");
+  }
+  return dup(
+      "{\"fuse\": [{\"ops\": [\"layer_norm\"], \"name\": \"ext_ln\"}]}");
+}
+
+int mx_ext_num_partitioners(void) { return 1; }
+
+const char* mx_ext_partitioner_name(int part) {
+  return part == 0 ? "fc_fuser" : nullptr;
+}
+
+const char* mx_ext_partition(int part, const char* graph_json) {
+  if (part != 0 || graph_json == nullptr) return nullptr;
+  std::string out = "{\"subgraphs\": [";
+  bool first = true;
+  if (count_op(graph_json, "fully_connected") > 0) {
+    // activations outline as "activation.<type>" from Dense(activation=)
+    // and bare "<type>" from explicit npx calls — handle both spellings
+    for (const char* act : {"activation.relu", "relu",
+                            "activation.sigmoid", "sigmoid",
+                            "activation.tanh", "tanh"}) {
+      if (count_op(graph_json, act) > 0) {
+        if (!first) out += ", ";
+        out += std::string("{\"ops\": [\"fully_connected\", \"") + act +
+               "\"], \"name\": \"ext_fc\"}";
+        first = false;
+      }
+    }
+  }
+  out += "]}";
+  return dup(out);
+}
+
+void mx_ext_free(const char* p) {
+  std::free(const_cast<char*>(p));
+}
+
+}  // extern "C"

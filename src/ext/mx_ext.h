@@ -1,11 +1,13 @@
-// Custom-operator extension ABI (reference: include/mxnet/lib_api.h —
-// MXLoadLib loads a shared library exporting op registrations).
+// Custom-operator / graph-pass / partitioner extension ABI (reference:
+// include/mxnet/lib_api.h — MXLoadLib loads a shared library exporting op,
+// pass, and partitioner registrations with a version handshake,
+// lib_api.h:931-1197).
 //
-// TPU-native contract: extension ops run on HOST buffers (the framework
+// TPU-native contract: extension OPS run on HOST buffers (the framework
 // bridges them onto the device via jax.pure_callback, so they compose with
 // jit/hybridize); the compute path proper stays XLA. An extension exports:
 //
-//   int mx_ext_abi_version(void);                 // must return MX_EXT_ABI_VERSION
+//   int mx_ext_abi_version(void);   // handshake: loader accepts 1..MX_EXT_ABI_VERSION
 //   int mx_ext_num_ops(void);
 //   const char* mx_ext_op_name(int op);
 //   int mx_ext_op_infer_shape(int op, int n_in,
@@ -15,14 +17,36 @@
 //   int mx_ext_op_forward(int op, int n_in, const MXExtTensor* inputs,
 //                         MXExtTensor* output);
 //
-// All hooks return 0 on success. Single-output ops; out_shape has room for
-// MX_EXT_MAX_NDIM dims.
+// ABI v2 adds OPTIONAL graph-level hooks (absent symbols mean "none" —
+// v1 libraries keep loading). The framework serializes a traced graph as
+// JSON {"nodes":[{"id":N,"op":"<name>"},...]} (op names are the funnel-op
+// names the reference exposes, e.g. "fully_connected"); the hook returns a
+// malloc'd JSON directive string the framework frees via mx_ext_free:
+//
+//   // custom graph passes (reference lib_api.h REGISTER_PASS):
+//   //   return {"fuse":[{"ops":["a","b",...],"name":"seg"}]} — each op-name
+//   //   chain is outlined into ONE compiled segment (fusion directive)
+//   int mx_ext_num_passes(void);
+//   const char* mx_ext_pass_name(int pass);
+//   const char* mx_ext_pass_apply(int pass, const char* graph_json);
+//
+//   // custom partitioners (reference lib_api.h REGISTER_PARTITIONER):
+//   //   return {"subgraphs":[{"ops":[...],"name":"sg"}]}
+//   int mx_ext_num_partitioners(void);
+//   const char* mx_ext_partitioner_name(int part);
+//   const char* mx_ext_partition(int part, const char* graph_json);
+//
+//   void mx_ext_free(const char* p);
+//
+// All int hooks return 0 on success (ops) / counts; string hooks return
+// NULL on error. Single-output ops; out_shape has room for MX_EXT_MAX_NDIM
+// dims.
 #ifndef MX_EXT_H_
 #define MX_EXT_H_
 
 #include <stdint.h>
 
-#define MX_EXT_ABI_VERSION 1
+#define MX_EXT_ABI_VERSION 2
 #define MX_EXT_MAX_NDIM 8
 
 #ifdef __cplusplus

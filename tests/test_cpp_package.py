@@ -35,3 +35,27 @@ def test_cpp_frontend_builds_and_runs():
     assert "PASS gpt_generate" in out
     assert "ALL OK" in out
     assert run.returncode == 0
+
+
+@pytest.mark.skipif(shutil.which("g++") is None, reason="needs g++")
+@pytest.mark.skipif(shutil.which("python3-config") is None,
+                    reason="needs python3-config (embedding flags)")
+def test_cpp_frontend_trains():
+    """C++ training loop (Net/Optimizer/Trainer — reference cpp-package
+    optimizer.hpp/executor.hpp surface): loss drops, accuracy >0.9, and
+    save/load round-trips through the C++ API."""
+    build = subprocess.run(["make", "build/mlp_train"], cwd=PKG,
+                           capture_output=True, text=True)
+    assert build.returncode == 0, build.stderr[-2000:]
+    exe = os.path.join(PKG, "build", "mlp_train")
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.pathsep.join(
+        [p for p in sys.path if p] + [REPO])
+    run = subprocess.run([exe, REPO], capture_output=True, text=True,
+                         env=env, timeout=600)
+    out = run.stdout
+    assert "PASS train_loss_drops" in out, (out, run.stderr[-2000:])
+    assert "PASS train_accuracy" in out
+    assert "PASS params_roundtrip" in out
+    assert "ALL OK" in out
+    assert run.returncode == 0

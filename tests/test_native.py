@@ -140,3 +140,69 @@ def test_extension_bad_library_rejected(tmp_path, native_libs):
 
     with pytest.raises((ValueError, OSError)):
         library.load(os.path.join(native_libs, "librtio.so"))
+
+
+def test_extension_partitioner_rewrites_net(native_libs):
+    """ABI v2: an out-of-tree .so registers a partitioner ('fc_fuser')
+    and a graph pass ('norm_fuser'); optimize_for(backend='fc_fuser')
+    must apply its directives — fusing dense+activation chains into
+    named segments — without changing the math."""
+    from incubator_mxnet_tpu import gluon, library, partition
+
+    library.load(os.path.join(native_libs, "libexample_partitioner.so"),
+                 verbose=False)
+    assert "fc_fuser" in partition.list_backends()
+    assert "norm_fuser" in partition.list_backends()
+
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(16, activation="relu"),
+            gluon.nn.Dense(8, activation="relu"),
+            gluon.nn.Dense(4))
+    net.initialize()
+    x = np.random.uniform(low=-1, size=(2, 12))
+    y_ref = net(x).asnumpy()
+
+    y_opt = net.optimize_for(x, backend="fc_fuser").asnumpy()
+    backend = partition.get_backend("fc_fuser")
+    assert backend.last_rewrites >= 2      # both dense+relu chains fused
+    onp.testing.assert_allclose(y_opt, y_ref, rtol=1e-5, atol=1e-6)
+
+
+def test_extension_pass_fuses_layernorm(native_libs):
+    from incubator_mxnet_tpu import gluon, library, partition
+
+    library.load(os.path.join(native_libs, "libexample_partitioner.so"),
+                 verbose=False)
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(8), gluon.nn.LayerNorm())
+    net.initialize()
+    x = np.random.uniform(low=-1, size=(2, 6))
+    y_ref = net(x).asnumpy()
+    y_opt = net.optimize_for(x, backend="norm_fuser").asnumpy()
+    assert partition.get_backend("norm_fuser").last_rewrites >= 1
+    onp.testing.assert_allclose(y_opt, y_ref, rtol=1e-5, atol=1e-6)
+
+
+def test_extension_abi_handshake(native_libs, tmp_path):
+    """A library reporting a FUTURE ABI version must be rejected."""
+    import subprocess as sp
+
+    src = tmp_path / "future_ext.cc"
+    src.write_text("""
+#include <cstdint>
+extern "C" {
+int mx_ext_abi_version(void) { return 99; }
+int mx_ext_num_ops(void) { return 0; }
+const char* mx_ext_op_name(int) { return nullptr; }
+int mx_ext_op_infer_shape(int, int, const int64_t* const*, const int*,
+                          int64_t*, int*) { return -1; }
+int mx_ext_op_forward(int, int, const void*, void*) { return -1; }
+}
+""")
+    so = tmp_path / "libfuture_ext.so"
+    sp.run(["g++", "-shared", "-fPIC", "-o", str(so), str(src)],
+           check=True, capture_output=True)
+    from incubator_mxnet_tpu import library
+
+    with pytest.raises(ValueError, match="ABI 99 unsupported"):
+        library.load(str(so), verbose=False)
