@@ -25,7 +25,13 @@ import numpy as onp
 from .ndarray import NDArray, apply_op
 
 __all__ = ["RowSparseNDArray", "CSRNDArray", "row_sparse_array",
-           "csr_matrix", "zeros", "array", "retain", "dot"]
+           "csr_matrix", "zeros", "array", "retain", "dot",
+           "add", "subtract", "multiply", "divide", "add_n", "clip",
+           "sum", "mean", "norm", "square_sum", "where",
+           "abs", "sign", "square", "sqrt", "relu", "negative",
+           "floor", "ceil", "trunc", "rint", "sin", "tan", "sinh",
+           "tanh", "arcsin", "arctan", "arcsinh", "arctanh",
+           "expm1", "log1p", "degrees", "radians"]
 
 
 def _dense_to_csr_fields(dense):
@@ -342,6 +348,31 @@ class CSRNDArray(NDArray):
     def asnumpy(self):
         return onp.asarray(self._data)
 
+    def __getitem__(self, key):
+        """Row indexing stays CSR (reference: `SliceCsrImpl`,
+        `src/operator/tensor/matrix_op.cc` slice on kCSRStorage) — indptr
+        arithmetic only, no densify. Anything fancier falls back to the
+        dense path."""
+        if isinstance(key, int):
+            if not -self._sp_shape[0] <= key < self._sp_shape[0]:
+                raise IndexError(
+                    f"index {key} out of bounds for axis 0 with size "
+                    f"{self._sp_shape[0]}")
+            if key < 0:
+                key += self._sp_shape[0]
+            key = slice(key, key + 1)
+        if isinstance(key, slice) and key.step in (None, 1):
+            self._sp_refresh()
+            start, stop, _ = key.indices(self._sp_shape[0])
+            stop = max(stop, start)
+            lo = int(self._sp_indptr[start])
+            hi = int(self._sp_indptr[stop])
+            return CSRNDArray(self._sp_data[lo:hi],
+                              self._sp_col_indices[lo:hi],
+                              self._sp_indptr[start:stop + 1] - lo,
+                              (stop - start, self._sp_shape[1]))
+        return NDArray.__getitem__(NDArray(self._data), key)
+
     def __repr__(self):
         return (f"\n<CSRNDArray {self._sp_shape} "
                 f"({self._sp_data.shape[0]} stored elements)>")
@@ -449,13 +480,40 @@ def retain(rsp, indices):
     return RowSparseNDArray(kept_vals, kept_idx, rsp._sp_shape)
 
 
-def dot(lhs, rhs, transpose_a=False, transpose_b=False):
+def dot(lhs, rhs, transpose_a=False, transpose_b=False, forward_stype=None):
     """Sparse-aware dot (reference: `src/operator/tensor/dot-inl.h`):
-    csr @ dense and csr.T @ dense run through jax BCOO without densifying;
-    other combinations fall back to dense. Either way the op is recorded on
-    the autograd tape, so gradients flow to dense (tracked) operands."""
+
+    - `csr @ dense` and `csr.T @ dense` run through jax BCOO without
+      densifying either operand,
+    - `csr.T @ dense` with `forward_stype='row_sparse'` emits a
+      RowSparseNDArray whose stored rows are the csr's live columns — the
+      reference's `DotCsrDnsRspImpl`, i.e. the embedding-gradient shape,
+    - `dense @ csr` contracts against the BCOO from the right
+      (`DotDnsCsrDnsImpl`),
+    - everything else falls back to dense.
+
+    Dense operands of the dense-output branches pass through `apply_op`
+    tracked, so autograd reaches them; sparse operands carry no tape
+    (reference semantics: no gradient w.r.t. sparse inputs of dot). The
+    `forward_stype='row_sparse'` branch is forward-only — it exists to
+    *compute* gradients (the reference uses DotCsrDnsRspImpl inside
+    backward passes), not to be differentiated through."""
     if isinstance(lhs, CSRNDArray) and isinstance(rhs, NDArray) \
             and not isinstance(rhs, (CSRNDArray, RowSparseNDArray)):
+        if transpose_a and forward_stype == "row_sparse":
+            if transpose_b:
+                raise ValueError("transpose_b unsupported with "
+                                 "forward_stype='row_sparse'")
+            jnp = _jnp()
+            lhs._sp_refresh()
+            rows, cols, data = lhs._row_ids(), lhs._sp_col_indices, lhs._sp_data
+            # contribution of nnz (r, c, v): out[c] += v * dense[r]
+            contrib = data[:, None] * rhs._data[rows]
+            u, inv = jnp.unique(cols, return_inverse=True)
+            vals = jnp.zeros((u.shape[0], rhs._data.shape[1]),
+                             contrib.dtype).at[inv.reshape(-1)].add(contrib)
+            return RowSparseNDArray(vals, u.astype(jnp.int32),
+                                    (lhs.shape[1], rhs._data.shape[1]))
         m = lhs._bcoo()
         if transpose_a:
             m = m.T
@@ -464,6 +522,16 @@ def dot(lhs, rhs, transpose_a=False, transpose_b=False):
             return m @ (y.T if transpose_b else y)
 
         return apply_op("sparse_dot", spmm, (rhs,))
+    if isinstance(rhs, CSRNDArray) and isinstance(lhs, NDArray) \
+            and not isinstance(lhs, (CSRNDArray, RowSparseNDArray)):
+        m = rhs._bcoo()
+        if transpose_b:
+            m = m.T
+
+        def dns_csr(x):
+            return (x.T if transpose_a else x) @ m
+
+        return apply_op("sparse_dot", dns_csr, (lhs,))
     # dense fallback: sparse operands densify (they carry no tape), dense
     # operands pass through tracked so backward reaches them
     a = lhs.tostype("default") \
@@ -477,7 +545,356 @@ def dot(lhs, rhs, transpose_a=False, transpose_b=False):
     return apply_op("dot", dense_dot, (a, b))
 
 
+def _csr_coo(c):
+    """(row_ids, cols, data) jax arrays for a CSRNDArray."""
+    c._sp_refresh()
+    return c._row_ids(), c._sp_col_indices, c._sp_data
+
+
+def _csr_from_coo(rows, cols, data, shape):
+    """Canonical CSR from (possibly duplicated) COO — duplicates sum, the
+    gradient-accumulation convention shared with RowSparseNDArray."""
+    jnp = _jnp()
+    rows = onp.asarray(rows)
+    cols = onp.asarray(cols)
+    data = onp.asarray(data)
+    key = rows.astype(onp.int64) * shape[1] + cols
+    uniq, inv = onp.unique(key, return_inverse=True)
+    summed = onp.zeros(uniq.shape[0], data.dtype)
+    onp.add.at(summed, inv, data)
+    u_rows = (uniq // shape[1]).astype(onp.int32)
+    u_cols = (uniq % shape[1]).astype(onp.int32)
+    indptr = onp.zeros(shape[0] + 1, onp.int32)
+    onp.add.at(indptr, u_rows + 1, 1)
+    indptr = onp.cumsum(indptr).astype(onp.int32)
+    return CSRNDArray(jnp.asarray(summed), jnp.asarray(u_cols),
+                      jnp.asarray(indptr), shape)
+
+
+# -- stype-preserving elementwise binary ------------------------------------
+
+def _binary_sparse(name, lhs, rhs, dense_fn, val_scalar_fn=None,
+                   structural=None):
+    """Storage-type dispatch for elementwise binary ops (reference:
+    `ElemwiseBinaryOp::...Ex` + FInferStorageType in
+    `src/operator/tensor/elemwise_binary_op_basic.cc`):
+
+    - sparse ∘ scalar with a zero-preserving `val_scalar_fn` (mul/div)
+      keeps the structure and touches only stored values,
+    - sparse ∘ sparse with a `structural` handler stays sparse,
+    - everything else densifies (with the storage-fallback log)."""
+    for a, b in ((lhs, rhs), (rhs, lhs)):
+        if isinstance(a, (CSRNDArray, RowSparseNDArray)) \
+                and onp.isscalar(b) and val_scalar_fn is not None:
+            if isinstance(a, CSRNDArray):
+                a._sp_refresh()
+                return CSRNDArray(val_scalar_fn(a._sp_data, b, a is lhs),
+                                  a._sp_col_indices, a._sp_indptr, a._sp_shape)
+            u, vals = a._canonical()
+            return RowSparseNDArray(val_scalar_fn(vals, b, a is lhs), u,
+                                    a._sp_shape)
+    if structural is not None \
+            and isinstance(lhs, CSRNDArray) and isinstance(rhs, CSRNDArray):
+        out = structural(lhs, rhs)
+        if out is not None:
+            return out
+    if structural is not None and isinstance(lhs, RowSparseNDArray) \
+            and isinstance(rhs, RowSparseNDArray):
+        out = structural(lhs, rhs)
+        if out is not None:
+            return out
+    a = lhs._data if isinstance(lhs, NDArray) else lhs
+    b = rhs._data if isinstance(rhs, NDArray) else rhs
+    return NDArray(dense_fn(a, b))
+
+
 def add(lhs, rhs):
-    if isinstance(lhs, RowSparseNDArray) and isinstance(rhs, RowSparseNDArray):
-        return lhs + rhs
-    return NDArray(lhs._data + rhs._data)
+    def structural(a, b):
+        if a._sp_shape != b._sp_shape:
+            raise ValueError("shape mismatch")
+        if isinstance(a, RowSparseNDArray):
+            return a + b
+        ra, ca, da = _csr_coo(a)
+        rb, cb, db = _csr_coo(b)
+        jnp = _jnp()
+        dt = jnp.promote_types(da.dtype, db.dtype)
+        return _csr_from_coo(jnp.concatenate([ra, rb]),
+                             jnp.concatenate([ca, cb]),
+                             jnp.concatenate([da.astype(dt), db.astype(dt)]),
+                             a._sp_shape)
+
+    return _binary_sparse("add", lhs, rhs, lambda a, b: a + b,
+                          structural=structural)
+
+
+def subtract(lhs, rhs):
+    def structural(a, b):
+        if a._sp_shape != b._sp_shape:
+            raise ValueError("shape mismatch")
+        jnp = _jnp()
+        if isinstance(a, RowSparseNDArray):
+            return a + RowSparseNDArray(-b._sp_values, b._sp_indices,
+                                        b._sp_shape)
+        ra, ca, da = _csr_coo(a)
+        rb, cb, db = _csr_coo(b)
+        dt = jnp.promote_types(da.dtype, db.dtype)
+        return _csr_from_coo(jnp.concatenate([ra, rb]),
+                             jnp.concatenate([ca, cb]),
+                             jnp.concatenate([da.astype(dt),
+                                              -db.astype(dt)]),
+                             a._sp_shape)
+
+    return _binary_sparse("subtract", lhs, rhs, lambda a, b: a - b,
+                          structural=structural)
+
+
+def multiply(lhs, rhs):
+    def val_scalar(vals, scalar, _vals_is_lhs):
+        return vals * scalar
+
+    def structural(a, b):
+        # intersection semantics: a nonzero only where BOTH are stored
+        if a._sp_shape != b._sp_shape:
+            raise ValueError("shape mismatch")
+        jnp = _jnp()
+        if isinstance(a, RowSparseNDArray):
+            ua, va = a._canonical()
+            ub, vb = b._canonical()
+            ua_n, va_n = onp.asarray(ua), onp.asarray(va)
+            ub_n, vb_n = onp.asarray(ub), onp.asarray(vb)
+            common, ia, ib = onp.intersect1d(ua_n, ub_n, return_indices=True)
+            return RowSparseNDArray(jnp.asarray(va_n[ia] * vb_n[ib]),
+                                    jnp.asarray(common.astype(onp.int32)),
+                                    a._sp_shape)
+        ra, ca, da = (onp.asarray(x) for x in _csr_coo(a))
+        rb, cb, db = (onp.asarray(x) for x in _csr_coo(b))
+        ka = ra.astype(onp.int64) * a._sp_shape[1] + ca
+        kb = rb.astype(onp.int64) * a._sp_shape[1] + cb
+        common, ia, ib = onp.intersect1d(ka, kb, return_indices=True)
+        return _csr_from_coo(common // a._sp_shape[1],
+                             common % a._sp_shape[1],
+                             da[ia] * db[ib], a._sp_shape)
+
+    return _binary_sparse("multiply", lhs, rhs, lambda a, b: a * b,
+                          val_scalar_fn=val_scalar, structural=structural)
+
+
+def divide(lhs, rhs):
+    def val_scalar(vals, scalar, vals_is_lhs):
+        # sparse / scalar keeps structure; scalar / sparse would divide by
+        # the implicit zeros -> dense (handled by returning None upstream
+        # is not possible here, so densify explicitly)
+        if vals_is_lhs:
+            return vals / scalar
+        raise _DenseFallback
+
+    try:
+        return _binary_sparse("divide", lhs, rhs, lambda a, b: a / b,
+                              val_scalar_fn=val_scalar)
+    except _DenseFallback:
+        a = lhs._data if isinstance(lhs, NDArray) else lhs
+        b = rhs._data if isinstance(rhs, NDArray) else rhs
+        return NDArray(a / b)
+
+
+class _DenseFallback(Exception):
+    pass
+
+
+def add_n(*args):
+    """Sum a list of arrays (reference `ElementWiseSum` with sparse inputs,
+    `src/operator/tensor/elemwise_sum.cc`): all-row_sparse stays
+    row_sparse (the gradient-aggregation path); any dense operand
+    densifies the result."""
+    arrs = args[0] if len(args) == 1 and isinstance(args[0], (list, tuple)) \
+        else args
+    if arrs and all(isinstance(a, RowSparseNDArray) for a in arrs):
+        out = arrs[0]
+        for a in arrs[1:]:
+            out = out + a
+        return out
+    jnp = _jnp()
+    total = arrs[0]._data
+    for a in arrs[1:]:
+        total = total + a._data
+    return NDArray(jnp.asarray(total))
+
+
+# -- zero-preserving unary ops ----------------------------------------------
+
+def _sparse_unary(name, fn):
+    """Factory for value-wise unary ops that map 0 -> 0, so they apply to
+    the stored values only (reference:
+    `MXNET_OPERATOR_REGISTER_UNARY_WITH_RSP_CSR`,
+    `src/operator/tensor/elemwise_unary_op_basic.cc`)."""
+
+    def op(arr, **kwargs):  # noqa: ARG001
+        jnp = _jnp()
+        if isinstance(arr, CSRNDArray):
+            arr._sp_refresh()
+            return CSRNDArray(fn(jnp, arr._sp_data), arr._sp_col_indices,
+                              arr._sp_indptr, arr._sp_shape)
+        if isinstance(arr, RowSparseNDArray):
+            u, vals = arr._canonical()
+            return RowSparseNDArray(fn(jnp, vals), u, arr._sp_shape)
+        return apply_op(name, lambda x: fn(_jnp(), x), (arr,))
+
+    op.__name__ = name
+    op.__qualname__ = name
+    op.__doc__ = (f"Elementwise {name} preserving sparse storage "
+                  "(zero-preserving: stored values only).")
+    return op
+
+
+abs = _sparse_unary("abs", lambda jnp, x: jnp.abs(x))            # noqa: A001
+sign = _sparse_unary("sign", lambda jnp, x: jnp.sign(x))
+square = _sparse_unary("square", lambda jnp, x: jnp.square(x))
+sqrt = _sparse_unary("sqrt", lambda jnp, x: jnp.sqrt(x))
+relu = _sparse_unary("relu", lambda jnp, x: jnp.maximum(x, 0))
+negative = _sparse_unary("negative", lambda jnp, x: -x)
+floor = _sparse_unary("floor", lambda jnp, x: jnp.floor(x))
+ceil = _sparse_unary("ceil", lambda jnp, x: jnp.ceil(x))
+trunc = _sparse_unary("trunc", lambda jnp, x: jnp.trunc(x))
+rint = _sparse_unary("rint", lambda jnp, x: jnp.rint(x))
+sin = _sparse_unary("sin", lambda jnp, x: jnp.sin(x))
+tan = _sparse_unary("tan", lambda jnp, x: jnp.tan(x))
+sinh = _sparse_unary("sinh", lambda jnp, x: jnp.sinh(x))
+tanh = _sparse_unary("tanh", lambda jnp, x: jnp.tanh(x))
+arcsin = _sparse_unary("arcsin", lambda jnp, x: jnp.arcsin(x))
+arctan = _sparse_unary("arctan", lambda jnp, x: jnp.arctan(x))
+arcsinh = _sparse_unary("arcsinh", lambda jnp, x: jnp.arcsinh(x))
+arctanh = _sparse_unary("arctanh", lambda jnp, x: jnp.arctanh(x))
+expm1 = _sparse_unary("expm1", lambda jnp, x: jnp.expm1(x))
+log1p = _sparse_unary("log1p", lambda jnp, x: jnp.log1p(x))
+degrees = _sparse_unary("degrees", lambda jnp, x: jnp.degrees(x))
+radians = _sparse_unary("radians", lambda jnp, x: jnp.radians(x))
+
+
+def clip(arr, a_min, a_max):
+    """Clip; stays sparse when the range keeps zero fixed
+    (reference `clip` FInferStorageType, `src/operator/tensor/matrix_op.cc`:
+    sparse only when a_min <= 0 <= a_max)."""
+    jnp = _jnp()
+    if isinstance(arr, (CSRNDArray, RowSparseNDArray)) \
+            and a_min <= 0.0 <= a_max:
+        if isinstance(arr, CSRNDArray):
+            arr._sp_refresh()
+            return CSRNDArray(jnp.clip(arr._sp_data, a_min, a_max),
+                              arr._sp_col_indices, arr._sp_indptr,
+                              arr._sp_shape)
+        u, vals = arr._canonical()
+        return RowSparseNDArray(jnp.clip(vals, a_min, a_max), u,
+                                arr._sp_shape)
+    a = arr._data if isinstance(arr, NDArray) else jnp.asarray(arr)
+    return NDArray(jnp.clip(a, a_min, a_max))
+
+
+# -- reductions (no densify) ------------------------------------------------
+
+def sum(arr, axis=None, keepdims=False):  # noqa: A001
+    """Sum over sparse storage without materializing the dense tensor
+    (reference: `sum` on kCSRStorage axis 0/1,
+    `src/operator/tensor/broadcast_reduce_sum_value.cc`). Output is dense
+    (reductions destroy sparsity)."""
+    jnp = _jnp()
+    if isinstance(arr, CSRNDArray):
+        rows, cols, data = _csr_coo(arr)
+        r, c = arr._sp_shape
+        if axis is None:
+            out = jnp.sum(data)
+            return NDArray(out.reshape(1, 1) if keepdims else out)
+        if axis in (0, -2):
+            out = jnp.zeros((c,), data.dtype).at[cols].add(data)
+            return NDArray(out.reshape(1, c) if keepdims else out)
+        if axis in (1, -1):
+            out = jnp.zeros((r,), data.dtype).at[rows].add(data)
+            return NDArray(out.reshape(r, 1) if keepdims else out)
+        raise ValueError(f"axis {axis} out of range for 2-D csr")
+    if isinstance(arr, RowSparseNDArray):
+        u, vals = arr._canonical()
+        if axis is None:
+            out = jnp.sum(vals)
+            return NDArray(out.reshape((1,) * arr.ndim) if keepdims else out)
+        nd_ = arr.ndim
+        ax = axis % nd_
+        if ax == 0:
+            out = jnp.sum(vals, axis=0)
+            return NDArray(out[None] if keepdims else out)
+        # reduce the stored value-rows first, then scatter the per-row
+        # results — never materialize the (num_rows, ...) dense tensor
+        red_rows = jnp.sum(vals, axis=ax, keepdims=keepdims)
+        out_shape = tuple(1 if (keepdims and i == ax) else s
+                          for i, s in enumerate(arr._sp_shape)
+                          if keepdims or i != ax)
+        out = jnp.zeros(out_shape, vals.dtype).at[u].set(red_rows)
+        return NDArray(out)
+    return apply_op("sum", lambda x: jnp.sum(x, axis=axis,
+                                             keepdims=keepdims), (arr,))
+
+
+def mean(arr, axis=None, keepdims=False):
+    jnp = _jnp()
+    s = sum(arr, axis=axis, keepdims=keepdims)
+    if axis is None:
+        denom = float(onp.prod(arr.shape))
+    elif isinstance(axis, (tuple, list)):
+        denom = float(onp.prod([arr.shape[a % len(arr.shape)]
+                                for a in axis]))
+    else:
+        denom = float(arr.shape[axis % len(arr.shape)])
+    return NDArray(s._data / jnp.asarray(denom, s._data.dtype))
+
+
+def norm(arr, ord=2):  # noqa: A002
+    """Frobenius/L2 norm from stored values only (zeros contribute 0) —
+    reference `norm` on sparse storage,
+    `src/operator/tensor/broadcast_reduce_norm_value.cc`."""
+    jnp = _jnp()
+    if ord != 2:
+        raise ValueError("sparse norm supports ord=2 only (reference parity)")
+    if isinstance(arr, CSRNDArray):
+        arr._sp_refresh()
+        vals = arr._sp_data
+    elif isinstance(arr, RowSparseNDArray):
+        _, vals = arr._canonical()
+    else:
+        vals = arr._data
+    return NDArray(jnp.sqrt(jnp.sum(jnp.square(vals.astype(jnp.float32)))))
+
+
+def square_sum(arr, axis=None, keepdims=False):
+    """Fused square + sum on row_sparse (reference `_square_sum`,
+    `src/operator/tensor/square_sum.cc` — the lazy-L2 building block).
+    axis=1 with keepdims on row_sparse emits row_sparse (only stored rows
+    have nonzero sums)."""
+    jnp = _jnp()
+    if isinstance(arr, RowSparseNDArray):
+        u, vals = arr._canonical()
+        sq = jnp.square(vals)
+        if axis is None:
+            out = jnp.sum(sq)
+            return NDArray(out.reshape((1,) * arr.ndim) if keepdims else out)
+        ax = axis % arr.ndim
+        if ax == 0:
+            out = jnp.sum(sq, axis=0)
+            return NDArray(out[None] if keepdims else out)
+        row_sums = jnp.sum(sq.reshape(sq.shape[0], -1), axis=1)
+        if keepdims:
+            shape = (arr._sp_shape[0],) + (1,) * (arr.ndim - 1)
+            return RowSparseNDArray(
+                row_sums.reshape(-1, *([1] * (arr.ndim - 1))), u, shape)
+        out = jnp.zeros((arr._sp_shape[0],), sq.dtype).at[u].set(row_sums)
+        return NDArray(out)
+    return sum(square(arr), axis=axis, keepdims=keepdims)
+
+
+def where(condition, x, y):
+    """Ternary select with a csr condition (reference `where` on
+    kCSRStorage, `src/operator/tensor/control_flow_op.cc`): the condition
+    densifies (it is boolean structure, cheap), outputs are dense."""
+    jnp = _jnp()
+    c = condition._data if isinstance(condition, NDArray) \
+        else jnp.asarray(condition)
+    xa = x._data if isinstance(x, NDArray) else jnp.asarray(x)
+    ya = y._data if isinstance(y, NDArray) else jnp.asarray(y)
+    return NDArray(jnp.where(c != 0, xa, ya))

@@ -1,0 +1,173 @@
+"""Sparse compute depth (reference strategy: `test_sparse_operator.py` —
+stype-preserving unary/binary ops, the dot family of
+`src/operator/tensor/dot-inl.h`, sparse reductions, csr slicing)."""
+import numpy as onp
+import pytest
+
+from incubator_mxnet_tpu.ndarray import NDArray, sparse
+
+
+def A(x):
+    return onp.asarray(x.asnumpy() if hasattr(x, "asnumpy") else x)
+
+
+@pytest.fixture
+def mats():
+    rng = onp.random.RandomState(7)
+    d1 = rng.randn(6, 5).astype("float32") * (rng.rand(6, 5) < 0.4)
+    d2 = rng.randn(6, 5).astype("float32") * (rng.rand(6, 5) < 0.4)
+    return d1, d2
+
+
+# -- elementwise binary ------------------------------------------------------
+
+def test_csr_add_subtract_stay_csr(mats):
+    d1, d2 = mats
+    c1, c2 = sparse.csr_matrix(d1), sparse.csr_matrix(d2)
+    for fn, ref in ((sparse.add, d1 + d2), (sparse.subtract, d1 - d2)):
+        out = fn(c1, c2)
+        assert out.stype == "csr"
+        onp.testing.assert_allclose(A(out), ref, rtol=1e-6)
+
+
+def test_csr_multiply_intersection(mats):
+    d1, d2 = mats
+    out = sparse.multiply(sparse.csr_matrix(d1), sparse.csr_matrix(d2))
+    assert out.stype == "csr"
+    onp.testing.assert_allclose(A(out), d1 * d2, rtol=1e-6)
+
+
+def test_rsp_multiply_intersection(mats):
+    d1, d2 = mats
+    out = sparse.multiply(sparse.row_sparse_array(d1),
+                          sparse.row_sparse_array(d2))
+    assert out.stype == "row_sparse"
+    onp.testing.assert_allclose(A(out), d1 * d2, rtol=1e-6)
+
+
+def test_scalar_mul_div_keep_structure(mats):
+    d1, _ = mats
+    c = sparse.csr_matrix(d1)
+    out = sparse.multiply(c, 3.0)
+    assert out.stype == "csr"
+    onp.testing.assert_allclose(A(out), d1 * 3.0, rtol=1e-6)
+    out = sparse.divide(c, 2.0)
+    assert out.stype == "csr"
+    onp.testing.assert_allclose(A(out), d1 / 2.0, rtol=1e-6)
+    # scalar / sparse divides the implicit zeros -> dense fallback
+    out = sparse.divide(2.0, c)
+    assert not isinstance(out, sparse.CSRNDArray)
+
+
+def test_sparse_add_n(mats):
+    d1, d2 = mats
+    r1, r2 = sparse.row_sparse_array(d1), sparse.row_sparse_array(d2)
+    out = sparse.add_n(r1, r2, r1)
+    assert out.stype == "row_sparse"
+    onp.testing.assert_allclose(A(out), 2 * d1 + d2, rtol=1e-6)
+
+
+# -- zero-preserving unary ---------------------------------------------------
+
+@pytest.mark.parametrize("name,ref_fn", [
+    ("abs", onp.abs), ("sign", onp.sign), ("square", onp.square),
+    ("relu", lambda x: onp.maximum(x, 0)), ("negative", onp.negative),
+    ("floor", onp.floor), ("ceil", onp.ceil), ("rint", onp.rint),
+    ("sin", onp.sin), ("tanh", onp.tanh), ("arctan", onp.arctan),
+    ("expm1", onp.expm1),
+])
+def test_unary_preserves_storage(mats, name, ref_fn):
+    d1, _ = mats
+    for make, stype in ((sparse.csr_matrix, "csr"),
+                        (sparse.row_sparse_array, "row_sparse")):
+        out = getattr(sparse, name)(make(d1))
+        assert out.stype == stype
+        onp.testing.assert_allclose(A(out), ref_fn(d1), rtol=1e-5, atol=1e-6)
+
+
+def test_clip_sparse_when_zero_fixed(mats):
+    d1, _ = mats
+    c = sparse.csr_matrix(d1)
+    out = sparse.clip(c, -0.5, 0.5)
+    assert out.stype == "csr"
+    onp.testing.assert_allclose(A(out), onp.clip(d1, -0.5, 0.5), rtol=1e-6)
+    # range excluding zero must densify (implicit zeros clip to a_min)
+    out = sparse.clip(c, 0.1, 0.5)
+    assert not isinstance(out, sparse.CSRNDArray)
+    onp.testing.assert_allclose(A(out), onp.clip(d1, 0.1, 0.5), rtol=1e-6)
+
+
+# -- dot family --------------------------------------------------------------
+
+def test_dot_csr_dense(mats):
+    d1, _ = mats
+    rhs = onp.random.RandomState(1).randn(5, 3).astype("float32")
+    out = sparse.dot(sparse.csr_matrix(d1), NDArray(rhs))
+    onp.testing.assert_allclose(A(out), d1 @ rhs, rtol=1e-5)
+
+
+def test_dot_csrT_dense_rsp_output(mats):
+    """DotCsrDnsRspImpl: csr.T @ dense emits row_sparse whose stored rows
+    are the csr's live columns (the embedding-gradient shape)."""
+    d1, _ = mats
+    rhs = onp.random.RandomState(2).randn(6, 4).astype("float32")
+    out = sparse.dot(sparse.csr_matrix(d1), NDArray(rhs),
+                     transpose_a=True, forward_stype="row_sparse")
+    assert out.stype == "row_sparse"
+    onp.testing.assert_allclose(A(out), d1.T @ rhs, rtol=1e-5)
+    live_cols = set(onp.nonzero(d1.any(axis=0))[0].tolist())
+    assert set(A(out.indices).tolist()) <= live_cols | set()
+
+
+def test_dot_dense_csr(mats):
+    d1, _ = mats
+    lhs = onp.random.RandomState(3).randn(4, 6).astype("float32")
+    out = sparse.dot(NDArray(lhs), sparse.csr_matrix(d1))
+    onp.testing.assert_allclose(A(out), lhs @ d1, rtol=1e-5)
+
+
+# -- csr slicing -------------------------------------------------------------
+
+def test_csr_row_slice_structural(mats):
+    d1, _ = mats
+    c = sparse.csr_matrix(d1)
+    s = c[1:4]
+    assert isinstance(s, sparse.CSRNDArray)
+    assert s.shape == (3, 5)
+    onp.testing.assert_allclose(A(s), d1[1:4], rtol=1e-6)
+    row = c[2]
+    assert isinstance(row, sparse.CSRNDArray)
+    onp.testing.assert_allclose(A(row), d1[2:3], rtol=1e-6)
+
+
+# -- reductions --------------------------------------------------------------
+
+def test_csr_reductions(mats):
+    d1, _ = mats
+    c = sparse.csr_matrix(d1)
+    onp.testing.assert_allclose(A(sparse.sum(c)), d1.sum(), rtol=1e-5)
+    onp.testing.assert_allclose(A(sparse.sum(c, axis=0)), d1.sum(0), rtol=1e-5)
+    onp.testing.assert_allclose(A(sparse.sum(c, axis=1)), d1.sum(1), rtol=1e-5)
+    onp.testing.assert_allclose(A(sparse.sum(c, axis=1, keepdims=True)),
+                                d1.sum(1, keepdims=True), rtol=1e-5)
+    onp.testing.assert_allclose(A(sparse.mean(c, axis=0)), d1.mean(0),
+                                rtol=1e-5)
+    onp.testing.assert_allclose(A(sparse.norm(c)), onp.linalg.norm(d1),
+                                rtol=1e-5)
+
+
+def test_square_sum_rsp(mats):
+    d1, _ = mats
+    r = sparse.row_sparse_array(d1)
+    out = sparse.square_sum(r, axis=1, keepdims=True)
+    assert out.stype == "row_sparse"
+    onp.testing.assert_allclose(A(out), (d1 ** 2).sum(1, keepdims=True),
+                                rtol=1e-5)
+    onp.testing.assert_allclose(A(sparse.square_sum(r)), (d1 ** 2).sum(),
+                                rtol=1e-5)
+
+
+def test_where_csr_condition(mats):
+    d1, d2 = mats
+    out = sparse.where(sparse.csr_matrix(d1), NDArray(d2), NDArray(d1))
+    onp.testing.assert_allclose(A(out), onp.where(d1 != 0, d2, d1), rtol=1e-6)
