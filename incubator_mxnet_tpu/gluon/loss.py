@@ -101,6 +101,67 @@ class SigmoidBinaryCrossEntropyLoss(Loss):
 SigmoidBCELoss = SigmoidBinaryCrossEntropyLoss
 
 
+def _sparse_softmax_ce(axis):
+    """Sparse-label softmax CE with a hand-written vjp.
+
+    Why not plain autodiff: XLA differentiates take(log_softmax) into
+    several full passes over the (…, vocab) logits (materialized
+    softmax, then softmax-minus-scatter, then the grad scale — measured
+    ~10-19 ms/step on BERT's (B, T, 30522) MLM head). The custom
+    backward emits d_logits = (exp(x - lse) - onehot(l)) · g as ONE
+    elementwise fusion: a single read of the logits and a single write
+    of the gradient. Forward is lse - pick (never materializes
+    log-probs). The reference fuses the same pair as a softmax+pick
+    kernel (`src/operator/nn/softmax.cc` SoftmaxCrossEntropy)."""
+    import functools
+
+    import jax
+
+    jnp = _jnp()
+
+    def _clamped(l, n):
+        # take_along_axis clamps out-of-range gathers; clamp explicitly so
+        # forward pick and backward onehot agree on the SAME class for
+        # OOB labels (e.g. a stray -1) instead of silently dropping the
+        # -onehot term from the gradient
+        return jnp.clip(l.astype(jnp.int32), 0, n - 1)
+
+    @functools.partial(jax.custom_vjp, nondiff_argnums=())
+    def ce(x, l):
+        ax = axis % x.ndim
+        lse = jax.scipy.special.logsumexp(x.astype(jnp.float32), axis=axis)
+        li = jnp.expand_dims(_clamped(l, x.shape[ax]), axis)
+        pick = jnp.squeeze(jnp.take_along_axis(x, li, axis=axis), axis=axis)
+        return lse - pick.astype(jnp.float32)
+
+    def fwd(x, l):
+        ax = axis % x.ndim
+        lse = jax.scipy.special.logsumexp(x.astype(jnp.float32), axis=axis)
+        li = jnp.expand_dims(_clamped(l, x.shape[ax]), axis)
+        pick = jnp.squeeze(jnp.take_along_axis(x, li, axis=axis), axis=axis)
+        return lse - pick.astype(jnp.float32), (x, l, lse)
+
+    def bwd(res, g):
+        x, l, lse = res
+        ax = axis % x.ndim
+        p = jnp.exp(x.astype(jnp.float32)
+                    - jnp.expand_dims(lse, ax))
+        onehot = (jax.lax.broadcasted_iota(jnp.int32, x.shape, ax)
+                  == jnp.expand_dims(_clamped(l, x.shape[ax]), ax))
+        dx = (p - onehot.astype(jnp.float32)) * jnp.expand_dims(g, ax)
+        if jnp.issubdtype(l.dtype, jnp.integer) \
+                or jnp.issubdtype(l.dtype, jnp.bool_):
+            import numpy as _onp
+
+            dl = _onp.zeros(l.shape, jax.dtypes.float0)
+        else:
+            dl = jnp.zeros_like(l)
+        return dx.astype(x.dtype), dl
+
+    ce.defvjp(fwd, bwd)
+    return ce
+
+
 class SoftmaxCrossEntropyLoss(Loss):
     """(reference: loss.py SoftmaxCrossEntropyLoss; sparse_label picks the
     label logit; fused as one XLA graph instead of the reference's
@@ -122,6 +183,8 @@ class SoftmaxCrossEntropyLoss(Loss):
         from_logits = self._from_logits
 
         def f(p, l):
+            if sparse and not from_logits:
+                return _sparse_softmax_ce(axis)(p, l)
             logp = p if from_logits else jax.nn.log_softmax(p, axis=axis)
             if sparse:
                 li = jnp.expand_dims(l.astype(jnp.int32), axis)

@@ -540,8 +540,34 @@ def batch_norm(x, gamma, beta, running_mean, running_var, eps=1e-5,
     return out
 
 
+def _placed_on_cpu(a):
+    """True when an EAGER jax array is committed to cpu devices (the
+    check_consistency cpu leg on a chip host); tracers follow the
+    process default backend."""
+    try:
+        return all(d.platform == "cpu" for d in a.devices())
+    except Exception:
+        return False
+
+
 def layer_norm(data, gamma=None, beta=None, axis=-1, eps=1e-5, **kwargs):  # noqa: ARG001
     jnp = _jnp()
+
+    if gamma is not None and beta is not None:
+        import jax as _jax
+
+        from ..ops import layer_norm as _ln
+
+        xv = data._data if isinstance(data, NDArray) else data
+        if (_jax.default_backend() == "tpu" and not _placed_on_cpu(xv)
+                and _ln.supports(xv.shape, axis, xv.shape[-1])
+                and jnp.issubdtype(xv.dtype, jnp.floating)):
+            # fused pallas path: one HBM pass fwd, fused bwd with row-stat
+            # residuals (see ops/layer_norm.py)
+            return apply_op(
+                "layer_norm",
+                lambda x, g, b: _ln.layer_norm(x, g, b, eps=eps),
+                (data, gamma, beta))
 
     def f(x, g, b):
         # dtype-preserving with f32 internal math: the statistics and the
@@ -768,11 +794,14 @@ def topk(data, axis=-1, k=1, ret_typ="indices", is_ascend=False, dtype="float32"
 
 
 def flash_attention(query, key, value, valid_length=None, causal=False,
-                    sm_scale=None):
-    """Fused memory-linear attention over (B, H, T, D) tensors — the pallas
-    kernel in `ops/flash_attention.py` (reference role:
+                    sm_scale=None, layout="bhtd"):
+    """Fused memory-linear attention — the pallas kernel in
+    `ops/flash_attention.py` (reference role:
     `src/operator/subgraph/dnnl/dnnl_transformer_qk_property.h`).
 
+    `layout`: "bhtd" for (B, H, T, D) tensors, "bthd" for (B, T, H, D) —
+    the fused-qkv projection layout; passing it directly avoids
+    materializing head transposes on the XLA path.
     `valid_length`: (B,) valid sequence lengths (replaces a dense mask).
     Differentiable (flash backward kernels via custom_vjp)."""
     from ..ops.flash_attention import flash_attention as _flash
@@ -780,13 +809,53 @@ def flash_attention(query, key, value, valid_length=None, causal=False,
     if valid_length is None:
         return apply_op(
             "flash_attention",
-            lambda q, k, v: _flash(q, k, v, causal=causal, sm_scale=sm_scale),
+            lambda q, k, v: _flash(q, k, v, causal=causal, sm_scale=sm_scale,
+                                   layout=layout),
             (query, key, value))
     return apply_op(
         "flash_attention",
         lambda q, k, v, vl: _flash(q, k, v, lengths=vl, causal=causal,
-                                   sm_scale=sm_scale),
+                                   sm_scale=sm_scale, layout=layout),
         (query, key, value, valid_length))
+
+
+def residual_dropout_ln(x, h, gamma, beta, p=0.0, eps=1e-5, axis=-1):
+    """``layer_norm(x + dropout_p(h))`` — the post-LN transformer residual
+    site, fused into ONE pallas pass on TPU (`ops/fused_block.py`; 24
+    such sites in BERT-base cost ~45 ms/step unfused at seq 512). Off
+    TPU, or for unsupported layouts, falls back to the composed ops with
+    identical semantics."""
+    import jax as _jax
+
+    from .. import autograd
+    from ..ops import fused_block as _fb
+
+    jnp = _jnp()
+    p_eff = float(p) if autograd.is_training() else 0.0
+    xv = x._data if isinstance(x, NDArray) else x
+    ndim = len(xv.shape)
+    if (_jax.default_backend() == "tpu" and axis in (-1, ndim - 1)
+            and not _placed_on_cpu(xv)
+            and _fb.supports(xv.shape, xv.shape[-1])
+            and jnp.issubdtype(xv.dtype, jnp.floating)):
+        if p_eff > 0:
+            key = next_key()
+            raw = _jax.random.key_data(key) if jnp.issubdtype(
+                getattr(key, "dtype", None), _jax.dtypes.prng_key) else key
+            seeds = raw.reshape(-1)[:2].astype(jnp.int32)
+        else:
+            # no key consumed when nothing is random — keeps seeded runs
+            # bit-identical with the composed fallback (which also draws
+            # none) across backends and across eval passes
+            seeds = jnp.zeros((2,), jnp.int32)
+
+        def f(xa, ha, g, b, s):
+            return _fb.residual_dropout_ln(xa, ha, g, b, p_eff, s, eps=eps)
+
+        return apply_op("residual_dropout_ln", f,
+                        (x, h, gamma, beta, NDArray(seeds)))
+    d = dropout(h, p=p) if p else h
+    return layer_norm(x + d, gamma, beta, axis=axis, eps=eps)
 
 
 def sharding_constraint(data, spec):

@@ -367,9 +367,33 @@ _flash_core.defvjp(_flash_core_fwd, _flash_core_bwd)
 _XLA_ATTN_BYTES_LIMIT = 2 << 30
 
 
-def _xla_attention(q, k, v, lengths, causal, sm_scale):
+def _xla_attention(q, k, v, lengths, causal, sm_scale, layout="bhtd"):
     """Same semantics as the pallas kernel, expressed as plain jnp ops —
-    XLA fuses the softmax(QKᵀ)V pipeline itself."""
+    XLA fuses the softmax(QKᵀ)V pipeline itself.
+
+    `layout="bthd"` contracts directly from the projection layout
+    (batch, seq, heads, head_dim) — the head/seq "transpose" folds into
+    the dot_general instead of materializing a relayout copy of the
+    (B, T, C)-sized tensor (measured ~13 ms/step of `copy` ops in the
+    seq-512 BERT profile with explicit transposes)."""
+    if layout == "bthd":
+        b, tq, h, d = q.shape
+        tk = k.shape[1]
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * sm_scale
+        neg = jnp.asarray(jnp.finfo(s.dtype).min / 2, s.dtype)
+        if causal:
+            mask = jnp.tril(jnp.ones((tq, tk), bool))
+            s = jnp.where(mask, s, neg)
+        if lengths is not None:
+            lens = jnp.asarray(lengths, jnp.int32).reshape(b)
+            kmask = jnp.arange(tk)[None, :] < lens[:, None]
+            s = jnp.where(kmask[:, None, None, :], s, neg)
+        p = jax.nn.softmax(s, axis=-1)
+        o = jnp.einsum("bhqk,bkhd->bqhd", p, v)
+        if lengths is not None:
+            qmask = jnp.arange(tq)[None, :] < lens[:, None]
+            o = jnp.where(qmask[:, :, None, None], o, 0.0)
+        return o
     b, h, tq, d = q.shape
     tk = k.shape[2]
     s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * sm_scale
@@ -393,9 +417,14 @@ def _xla_attention(q, k, v, lengths, causal, sm_scale):
 
 
 def flash_attention(q, k, v, lengths=None, causal=False, sm_scale=None,
-                    block_q=512, block_k=512, interpret=None, impl="auto"):
-    """Fused scaled-dot-product attention over (B, H, T, D) tensors.
+                    block_q=512, block_k=512, interpret=None, impl="auto",
+                    layout="bhtd"):
+    """Fused scaled-dot-product attention.
 
+    - `layout`: "bhtd" (B, H, T, D) or "bthd" (B, T, H, D — the natural
+      output of a fused qkv projection; the XLA path contracts it
+      directly so no head transpose is ever materialized, and the
+      output comes back in (B, T, H, D) ready to collapse to (B, T, C)).
     - `lengths`: optional (B,) int32 valid sequence lengths (key padding AND
       query-row masking, self-attention semantics — the flash replacement
       for `npx.masked_softmax` with a valid_length mask).
@@ -406,18 +435,35 @@ def flash_attention(q, k, v, lengths=None, causal=False, sm_scale=None,
     - Differentiable on both paths (pallas via custom_vjp backward
       kernels, XLA via ordinary autodiff of the fused graph).
     """
-    b, h, tq, d = q.shape
+    if layout == "bthd":
+        b, t_q, h, d = q.shape
+        t_k = k.shape[1]
+    else:
+        b, h, t_q, d = q.shape
+        t_k = k.shape[2]
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(d)
     if impl == "auto":
-        attn_bytes = b * h * tq * k.shape[2] * jnp.dtype(q.dtype).itemsize
+        attn_bytes = b * h * t_q * t_k * jnp.dtype(q.dtype).itemsize
         impl = "xla" if attn_bytes <= _XLA_ATTN_BYTES_LIMIT else "pallas"
     if impl == "xla":
         return _xla_attention(q, k, v, lengths, bool(causal),
-                              float(sm_scale))
+                              float(sm_scale), layout=layout)
     if impl != "pallas":
         raise ValueError(f"flash_attention: unknown impl {impl!r}")
-    tk = k.shape[2]
+    if layout == "bthd":
+        # the streaming kernel wants heads-major blocks; one relayout is
+        # noise next to the O(T²) compute that forces the pallas path
+        q = q.transpose(0, 2, 1, 3)
+        k = k.transpose(0, 2, 1, 3)
+        v = v.transpose(0, 2, 1, 3)
+        o = flash_attention(q, k, v, lengths=lengths, causal=causal,
+                            sm_scale=sm_scale, block_q=block_q,
+                            block_k=block_k, interpret=interpret,
+                            impl="pallas", layout="bhtd")
+        return o.transpose(0, 2, 1, 3)
+    tq = t_q
+    tk = t_k
     if interpret is None:
         interpret = _interpret_default()
 

@@ -1,0 +1,197 @@
+"""Fused LayerNorm as pallas TPU kernels (forward + backward).
+
+Why a kernel: XLA lowers layer norm to a stats reduction followed by a
+broadcast-consuming normalize — two full HBM passes over the activation
+forward and four-plus backward (measured ~0.7 ms per LN on a
+(32, 512, 768) bf16 BERT activation; 24 LNs ≈ 17 ms of a 143 ms train
+step, the single largest non-matmul block after the funnel fusions).
+The reference has the same fusion as a handwritten CPU/GPU kernel
+(`src/operator/nn/layer_norm.cc` LayerNormCompute, with the oneDNN and
+GPU fused paths); the TPU-native answer keeps a row-block of the
+activation in VMEM, computes mean/variance there, and writes the
+normalized output in the same pass — ONE read + ONE write forward.
+
+Backward recomputes x̂ from the saved (mean, rstd) row stats — tiny
+(R,) f32 residuals instead of a second activation-sized buffer — and
+emits dx in one fused pass plus per-block partial sums for
+dgamma/dbeta (summed by a cheap XLA reduce over the block axis).
+
+Layout contract: normalization over the LAST axis, feature size a
+multiple of 128 (the VPU lane width); anything else falls back to the
+XLA path in `npx.layer_norm`.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _interpret_default():
+    return jax.default_backend() != "tpu"
+
+
+def supports(shape, axis, feat):
+    """Kernel eligibility: last-axis norm, lane-aligned feature dim."""
+    ndim = len(shape)
+    if axis not in (-1, ndim - 1):
+        return False
+    return feat % 128 == 0 and feat <= 8192
+
+
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
+
+def _fwd_kernel(x_ref, g_ref, b_ref, y_ref, m_ref, r_ref, *, eps):
+    x = x_ref[...].astype(jnp.float32)                 # (bR, C)
+    c = x.shape[1]
+    mean = jnp.sum(x, axis=1, keepdims=True) / c
+    xc = x - mean
+    var = jnp.sum(xc * xc, axis=1, keepdims=True) / c
+    rstd = jax.lax.rsqrt(var + eps)
+    y = xc * rstd * g_ref[...].astype(jnp.float32) \
+        + b_ref[...].astype(jnp.float32)
+    y_ref[...] = y.astype(y_ref.dtype)
+    m_ref[...] = mean
+    r_ref[...] = rstd
+
+
+def _fwd(x2d, gamma, beta, eps, block_r, interpret):
+    rows, feat = x2d.shape
+    n_blocks = rows // block_r
+    y, mean, rstd = pl.pallas_call(
+        functools.partial(_fwd_kernel, eps=eps),
+        grid=(n_blocks,),
+        in_specs=[
+            pl.BlockSpec((block_r, feat), lambda i: (i, 0)),
+            pl.BlockSpec((1, feat), lambda i: (0, 0)),
+            pl.BlockSpec((1, feat), lambda i: (0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((block_r, feat), lambda i: (i, 0)),
+            pl.BlockSpec((block_r, 1), lambda i: (i, 0)),
+            pl.BlockSpec((block_r, 1), lambda i: (i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((rows, feat), x2d.dtype),
+            jax.ShapeDtypeStruct((rows, 1), jnp.float32),
+            jax.ShapeDtypeStruct((rows, 1), jnp.float32),
+        ],
+        interpret=interpret,
+    )(x2d, gamma.reshape(1, feat), beta.reshape(1, feat))
+    return y, mean, rstd
+
+
+# ---------------------------------------------------------------------------
+# backward
+# ---------------------------------------------------------------------------
+
+def _bwd_kernel(x_ref, dy_ref, m_ref, r_ref, g_ref,
+                dx_ref, dgb_ref, acc_scr, *, n_blocks):
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _init():
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    x = x_ref[...].astype(jnp.float32)
+    dy = dy_ref[...].astype(jnp.float32)
+    mean, rstd = m_ref[...], r_ref[...]
+    g = g_ref[...].astype(jnp.float32)
+    c = x.shape[1]
+    xhat = (x - mean) * rstd
+    wdy = dy * g
+    c1 = jnp.sum(wdy, axis=1, keepdims=True) / c
+    c2 = jnp.sum(wdy * xhat, axis=1, keepdims=True) / c
+    dx = (wdy - c1 - xhat * c2) * rstd
+    dx_ref[...] = dx.astype(dx_ref.dtype)
+    # param-grad partials accumulate in VMEM across the (sequential) grid:
+    # row 0 holds dgamma, row 1 dbeta; spilled to HBM once at the end
+    acc_scr[0:1, :] += jnp.sum(dy * xhat, axis=0, keepdims=True)
+    acc_scr[1:2, :] += jnp.sum(dy, axis=0, keepdims=True)
+
+    @pl.when(i == n_blocks - 1)
+    def _fini():
+        dgb_ref[...] = acc_scr[...]
+
+
+def _bwd(x2d, dy2d, mean, rstd, gamma, block_r, interpret):
+    rows, feat = x2d.shape
+    n_blocks = rows // block_r
+    dx, dgb = pl.pallas_call(
+        functools.partial(_bwd_kernel, n_blocks=n_blocks),
+        grid=(n_blocks,),
+        in_specs=[
+            pl.BlockSpec((block_r, feat), lambda i: (i, 0)),
+            pl.BlockSpec((block_r, feat), lambda i: (i, 0)),
+            pl.BlockSpec((block_r, 1), lambda i: (i, 0)),
+            pl.BlockSpec((block_r, 1), lambda i: (i, 0)),
+            pl.BlockSpec((1, feat), lambda i: (0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((block_r, feat), lambda i: (i, 0)),
+            pl.BlockSpec((8, feat), lambda i: (0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((rows, feat), x2d.dtype),
+            jax.ShapeDtypeStruct((8, feat), jnp.float32),
+        ],
+        scratch_shapes=[pltpu.VMEM((8, feat), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",)),
+        interpret=interpret,
+    )(x2d, dy2d, mean, rstd, gamma.reshape(1, feat))
+    return dx, dgb[0], dgb[1]
+
+
+# ---------------------------------------------------------------------------
+# public op
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _ln_core(x2d, gamma, beta, eps, block_r, interpret):
+    y, _, _ = _fwd(x2d, gamma, beta, eps, block_r, interpret)
+    return y
+
+
+def _ln_core_fwd(x2d, gamma, beta, eps, block_r, interpret):
+    y, mean, rstd = _fwd(x2d, gamma, beta, eps, block_r, interpret)
+    return y, (x2d, gamma, mean, rstd)
+
+
+def _ln_core_bwd(eps, block_r, interpret, res, dy):
+    x2d, gamma, mean, rstd = res
+    dx, dg, db = _bwd(x2d, dy, mean, rstd, gamma, block_r, interpret)
+    return dx, dg.astype(gamma.dtype), db.astype(gamma.dtype)
+
+
+_ln_core.defvjp(_ln_core_fwd, _ln_core_bwd)
+
+
+def layer_norm(x, gamma, beta, eps=1e-5, block_r=256, interpret=None):
+    """Fused last-axis layer norm over an arbitrary-rank tensor.
+
+    Leading axes collapse to rows; rows pad up to the block size (padded
+    rows normalize garbage that is sliced away — their stats never touch
+    real rows). Differentiable via the fused backward kernels.
+    """
+    if interpret is None:
+        interpret = _interpret_default()
+    shape = x.shape
+    feat = shape[-1]
+    rows = 1
+    for s in shape[:-1]:
+        rows *= s
+    x2d = x.reshape(rows, feat)
+    block = min(block_r, rows) if rows else block_r
+    pad = (-rows) % block if block else 0
+    if pad:
+        x2d = jnp.pad(x2d, ((0, pad), (0, 0)))
+    y = _ln_core(x2d, gamma, beta, float(eps), int(block), bool(interpret))
+    if pad:
+        y = y[:rows]
+    return y.reshape(shape)

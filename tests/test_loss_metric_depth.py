@@ -241,3 +241,27 @@ def test_composite_metric():
 def test_metric_create_by_name():
     m = gmetric.create("acc")
     assert isinstance(m, gmetric.Accuracy)
+
+def test_softmax_ce_oob_label_grad_consistent():
+    """Out-of-range sparse labels (stray -1 padding): the custom-vjp CE
+    must keep forward and backward on the SAME clamped class."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as onp
+    from incubator_mxnet_tpu.gluon.loss import _sparse_softmax_ce
+
+    ce = _sparse_softmax_ce(-1)
+    x = jnp.asarray(onp.random.RandomState(0).randn(4, 6), jnp.float32)
+    l = jnp.asarray([-1, 0, 5, 9], jnp.int32)       # -1 and 9 are OOB
+    lc = jnp.clip(l, 0, 5)
+    loss = ce(x, l)
+    ref = (jax.scipy.special.logsumexp(x, -1)
+           - jnp.take_along_axis(x, lc[:, None], -1)[:, 0])
+    onp.testing.assert_allclose(onp.asarray(loss), onp.asarray(ref),
+                                rtol=1e-5)
+    g = jax.grad(lambda x: ce(x, l).sum())(x)
+    p = jax.nn.softmax(x, -1)
+    want = onp.array(p, copy=True)
+    for i, li in enumerate(onp.asarray(lc)):
+        want[i, li] -= 1.0
+    onp.testing.assert_allclose(onp.asarray(g), want, atol=1e-5)

@@ -116,3 +116,28 @@ def test_train_step_consistency():
     y = mx.np.array(onp.random.RandomState(3)
                     .randint(0, 4, (8,)).astype("int32"))
     _pair(step, [x, y])
+
+
+def test_fused_residual_ln_consistency():
+    """ops/fused_block.py kernel on-chip vs the composed cpu path (p=0:
+    the dropout mask is generator-specific, so the deterministic part of
+    the contract is what cross-device consistency can pin)."""
+    from incubator_mxnet_tpu import npx
+
+    x = _r(2, 64, 256)
+    h = _r(2, 64, 256, seed=1)
+    g = _r(256, seed=2)
+    b = _r(256, seed=3)
+    _pair(lambda x, h, g, b: npx.residual_dropout_ln(x, h, g, b, p=0.0),
+          [x, h, g, b], rtol=1e-2, atol=1e-2)
+
+
+def test_fused_layer_norm_consistency():
+    """ops/layer_norm.py kernel on-chip vs the XLA lowering on cpu."""
+    from incubator_mxnet_tpu import npx
+
+    x = _r(4, 32, 384)
+    g = _r(384, seed=1)
+    b = _r(384, seed=2)
+    _pair(lambda x, g, b: npx.layer_norm(x, g, b), [x, g, b],
+          rtol=1e-2, atol=1e-2)
