@@ -792,6 +792,108 @@ def _replace_child(parent, name, old, new):
                     val[i] = new
 
 
+def _first_resblock(b):
+    if isinstance(b, QuantizedResidualBlock):
+        return b
+    if isinstance(b, nn.HybridSequential) and b._children:
+        return _first_resblock(b._children[next(iter(b._children))])
+    return None
+
+
+def _last_resblock(b):
+    if isinstance(b, QuantizedResidualBlock):
+        return b
+    if isinstance(b, nn.HybridSequential) and b._children:
+        return _last_resblock(b._children[list(b._children)[-1]])
+    return None
+
+
+def _res_in_threshold(cons):
+    """The shared decode threshold a producer may emit at, or None when
+    the block's body and downsample would decode at diverging scales
+    (the same agreement check `chain_residual_blocks.link` applies)."""
+    t = cons.__dict__.get("_in_threshold")
+    if t is None:
+        return None
+    if cons.downsample is not None:
+        ds_first = cons.downsample._children[
+            list(cons.downsample._children)[0]]
+        if not isinstance(ds_first, (QuantizedConv2D, QuantizedDense)):
+            return None
+        t_in = float(t.data().asnumpy())
+        t_ds = float(ds_first.qthreshold.data().asnumpy())
+        if abs(t_in - t_ds) > 1e-5 * max(t_in, t_ds, 1e-6):
+            return None
+    return t
+
+
+def chain_boundaries(net, logger=None):
+    """Extend int8 requantize chains across the edges the per-container
+    passes can't see (reference analogue: the oneDNN subgraph pass
+    rewrites the WHOLE graph so its int8 chains cross pooling and stage
+    boundaries naturally, `src/operator/subgraph/dnnl/`):
+
+    - producer -> [MaxPool2D / Identity / relu Activation]* -> consumer:
+      max pooling on int8 CODES commutes with the monotone per-tensor
+      quantization, so the stem conv can emit int8 straight through the
+      pool (the stem activations are the largest tensors in the net —
+      (64, 64, 112, 112) f32 is a 205 MB round trip per inference).
+    - stage_i[-1] residual block -> stage_{i+1}[0] residual block, where
+      the stages are ADJACENT nested sequentials.
+
+    Producers: QuantizedConv2D/Dense (fused act relu/None only) or a
+    QuantizedResidualBlock; consumers: a residual block whose body and
+    downsample agree on the decode scale. Existing chains are never
+    overwritten. Returns the number of new links."""
+    n_linked = 0
+    stack = [net]
+    while stack:
+        block = stack.pop()
+        if isinstance(block, nn.HybridSequential):
+            kids = [block._children[n] for n in block._children]
+            for i, holder in enumerate(kids):
+                if isinstance(holder, (QuantizedConv2D, QuantizedDense)):
+                    prod = holder
+                    if prod.act is not None and getattr(
+                            prod.act, "_act_type", None) != "relu":
+                        continue
+                else:
+                    prod = _last_resblock(holder)
+                if prod is None \
+                        or prod.__dict__.get("_out_threshold") is not None:
+                    continue
+                j = i + 1
+                while j < len(kids) and (
+                        isinstance(kids[j], (nn.Identity, nn.MaxPool2D))
+                        or (isinstance(kids[j], nn.Activation)
+                            and kids[j]._act_type == "relu")):
+                    j += 1
+                if j >= len(kids):
+                    continue
+                cons = _first_resblock(kids[j])
+                if cons is None or cons is prod:
+                    continue
+                t_in = _res_in_threshold(cons)
+                if t_in is None:
+                    continue
+                prod.__dict__["_out_threshold"] = t_in
+                prod.__dict__["_chain_consumer"] = cons.body._children[
+                    list(cons.body._children)[0]]
+                n_linked += 1
+                if logger:
+                    logger.info("boundary-chained %s -> %s",
+                                type(prod).__name__, type(cons).__name__)
+        stack.extend(c for c in block._children.values()
+                     if isinstance(c, HybridBlock))
+    if n_linked:
+        # _out_threshold is read at TRACE time: stale cached graphs would
+        # keep emitting f32 at the new links (chain_residual_blocks has
+        # the same invalidation)
+        for b in _hybrid_blocks(net):
+            b._cached_graph = None
+    return n_linked
+
+
 def quantize_net(net, calib_data=None, calib_mode="entropy",
                  quantized_dtype="int8", exclude_layers_match=None,
                  num_calib_batches=10, fold_bn=True, requantize=True,
@@ -839,6 +941,11 @@ def quantize_net(net, calib_data=None, calib_mode="entropy",
         chain_residual_blocks(net, calib_data,
                               num_calib_batches=num_calib_batches,
                               logger=logger)
+        # stem->stage and stage->stage boundaries: int8 codes flow THROUGH
+        # max pools (max commutes with the monotone quantization) and
+        # across nested-sequential edges — the biggest remaining f32 round
+        # trips sit on the early 200 MB activations
+        chain_boundaries(net, logger=logger)
     # stale traced graphs still reference the fp32 layers — force re-trace
     for b in _hybrid_blocks(net):
         b._cached_graph = None

@@ -29,6 +29,7 @@ __all__ = [
     "activation", "relu", "sigmoid", "softmax", "log_softmax", "masked_softmax",
     "masked_log_softmax", "leaky_relu", "fully_connected", "convolution",
     "deconvolution", "pooling", "batch_norm", "layer_norm", "group_norm",
+    "residual_dropout_ln",
     "instance_norm", "l2_normalization", "dropout", "embedding", "one_hot",
     "pick", "topk", "batch_dot", "flash_attention", "sharding_constraint",
     "gather_nd", "scatter_nd", "sequence_mask",
@@ -475,7 +476,11 @@ def pooling(data, kernel=None, stride=None, pad=None, pool_type="max",
 
     if pool_type == "max":
         def f(x):
-            return lax.reduce_window(x, -jnp.inf, lax.max, tuple(window),
+            # integer identity for int inputs (int8 requantize chains pool
+            # their CODES — max commutes with the monotone quantization)
+            init = (-jnp.inf if jnp.issubdtype(x.dtype, jnp.floating)
+                    else jnp.asarray(jnp.iinfo(x.dtype).min, x.dtype))
+            return lax.reduce_window(x, init, lax.max, tuple(window),
                                      tuple(strides), padding)
     elif pool_type in ("avg", "sum"):
         def f(x):
@@ -833,10 +838,13 @@ def residual_dropout_ln(x, h, gamma, beta, p=0.0, eps=1e-5, axis=-1):
     jnp = _jnp()
     p_eff = float(p) if autograd.is_training() else 0.0
     xv = x._data if isinstance(x, NDArray) else x
+    hv = h._data if isinstance(h, NDArray) else h
     ndim = len(xv.shape)
     if (_jax.default_backend() == "tpu" and axis in (-1, ndim - 1)
             and not _placed_on_cpu(xv)
             and _fb.supports(xv.shape, xv.shape[-1])
+            and tuple(xv.shape) == tuple(hv.shape)  # kernel can't broadcast
+            and p_eff < 1.0                         # p=1: composed path
             and jnp.issubdtype(xv.dtype, jnp.floating)):
         if p_eff > 0:
             key = next_key()
