@@ -249,7 +249,14 @@ def _chain_dtype(layer, x):
         chain_dt = x_dt
     consumer = layer.__dict__.get("_chain_consumer")
     if layer._out_threshold is not None and consumer is not None:
-        consumer.__dict__["_chain_in_dt"] = chain_dt
+        # a producer may feed SEVERAL decoders of the same codes (a
+        # residual block's body[0] AND its downsample both consume the
+        # boundary producer's emit): seed every one, or the later-traced
+        # branch would clobber the dtype with the float32 default
+        consumers = consumer if isinstance(consumer, (tuple, list)) \
+            else (consumer,)
+        for c in consumers:
+            c.__dict__["_chain_in_dt"] = chain_dt
     return chain_dt
 
 
@@ -731,31 +738,21 @@ def chain_residual_blocks(net, calib_data=None, num_calib_batches=10,
                 continue
             # EVERY consumer of the emitted int8 codes must decode them:
             # body[0] (the _in_threshold check) AND, when present, the
-            # downsample's first layer (an excluded fp32 downsample would
-            # convolve raw codes)
+            # downsample's first layer — with AGREEING scales (shared
+            # check: _res_in_threshold)
+            t_in = _res_in_threshold(cons)
+            if t_in is None:
+                if logger:
+                    logger.warning(
+                        "residual chain skipped at %s: downsample cannot "
+                        "decode at the body scale", type(cons).__name__)
+                continue
+            prod.__dict__["_out_threshold"] = t_in
+            decoders = [cons.body._children[list(cons.body._children)[0]]]
             if cons.downsample is not None:
-                ds_first = cons.downsample._children[
-                    list(cons.downsample._children)[0]]
-                if not isinstance(ds_first, (QuantizedConv2D,
-                                             QuantizedDense)):
-                    continue
-                # body[0] and the downsample decode the SAME emitted
-                # codes with independently calibrated thresholds; they
-                # agree today because both see the same tensor, but
-                # calib-mode or exclusion changes could split them —
-                # skip the chain rather than silently mis-decode
-                t_in = float(cons._in_threshold.data().asnumpy())
-                t_ds = float(ds_first.qthreshold.data().asnumpy())
-                if abs(t_in - t_ds) > 1e-5 * max(t_in, t_ds, 1e-6):
-                    if logger:
-                        logger.warning(
-                            "residual chain skipped at %s: body/downsample "
-                            "thresholds diverge (%.6g vs %.6g)",
-                            type(cons).__name__, t_in, t_ds)
-                    continue
-            prod.__dict__["_out_threshold"] = cons._in_threshold
-            prod.__dict__["_chain_consumer"] = \
-                cons.body._children[list(cons.body._children)[0]]
+                decoders.append(cons.downsample._children[
+                    list(cons.downsample._children)[0]])
+            prod.__dict__["_chain_consumer"] = tuple(decoders)
         for c in block._children.values():
             if isinstance(c, HybridBlock):
                 link(c)
@@ -877,8 +874,15 @@ def chain_boundaries(net, logger=None):
                 if t_in is None:
                     continue
                 prod.__dict__["_out_threshold"] = t_in
-                prod.__dict__["_chain_consumer"] = cons.body._children[
-                    list(cons.body._children)[0]]
+                # BOTH decoders of the emitted codes need the chain dtype
+                # seeded (see _chain_dtype): body[0] and, when present,
+                # the downsample's first layer
+                decoders = [cons.body._children[
+                    list(cons.body._children)[0]]]
+                if cons.downsample is not None:
+                    decoders.append(cons.downsample._children[
+                        list(cons.downsample._children)[0]])
+                prod.__dict__["_chain_consumer"] = tuple(decoders)
                 n_linked += 1
                 if logger:
                     logger.info("boundary-chained %s -> %s",

@@ -474,6 +474,21 @@ def pooling(data, kernel=None, stride=None, pad=None, pool_type="max",
         strides[ax] = s
         padding[ax] = (p, p)
 
+    def _pad_for(x):
+        # 'full' = ceil-mode output shape (reference PoolingParam
+        # pooling_convention, `src/operator/nn/pooling-inl.h`): extend the
+        # high-side padding so a partial final window is still emitted
+        if pooling_convention != "full":
+            return padding
+        padl = list(padding)
+        for ax, k, s, p in zip(spatial_axes, kernel, stride, pad):
+            span = x.shape[ax] + 2 * p - k
+            rem = span % s
+            if rem:
+                lo, hi = padl[ax]
+                padl[ax] = (lo, hi + (s - rem))
+        return tuple(padl)
+
     if pool_type == "max":
         def f(x):
             # integer identity for int inputs (int8 requantize chains pool
@@ -481,18 +496,19 @@ def pooling(data, kernel=None, stride=None, pad=None, pool_type="max",
             init = (-jnp.inf if jnp.issubdtype(x.dtype, jnp.floating)
                     else jnp.asarray(jnp.iinfo(x.dtype).min, x.dtype))
             return lax.reduce_window(x, init, lax.max, tuple(window),
-                                     tuple(strides), padding)
+                                     tuple(strides), _pad_for(x))
     elif pool_type in ("avg", "sum"):
         def f(x):
-            s = lax.reduce_window(x, 0.0, lax.add, tuple(window), tuple(strides),
-                                  padding)
+            pads = _pad_for(x)
+            s = lax.reduce_window(x, 0.0, lax.add, tuple(window),
+                                  tuple(strides), pads)
             if pool_type == "sum":
                 return s
             if count_include_pad:
                 return s / float(onp.prod(kernel))
             ones = jnp.ones(x.shape, x.dtype)
             cnt = lax.reduce_window(ones, 0.0, lax.add, tuple(window),
-                                    tuple(strides), padding)
+                                    tuple(strides), pads)
             return s / cnt
     else:
         raise ValueError(f"unsupported pool_type {pool_type!r}")

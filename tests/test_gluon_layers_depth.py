@@ -371,3 +371,17 @@ def test_cast_block_dtype():
     l.initialize()
     l.cast("float16")
     assert "float16" in str(l.weight.data().dtype)
+
+def test_maxpool_ceil_mode_full_convention():
+    """pooling_convention='full' (ceil_mode): partial final windows emit
+    (reference PoolingParam, src/operator/nn/pooling-inl.h)."""
+    import numpy as onp
+
+    from incubator_mxnet_tpu import np
+    from incubator_mxnet_tpu.gluon import nn
+
+    x = np.array(onp.arange(50).reshape(1, 2, 5, 5).astype("float32"))
+    assert nn.MaxPool2D(2, 2, ceil_mode=False)(x).shape == (1, 2, 2, 2)
+    out = nn.MaxPool2D(2, 2, ceil_mode=True)(x)
+    assert out.shape == (1, 2, 3, 3)
+    assert float(out.asnumpy()[0, 0, 2, 2]) == 24.0  # partial 1x1 window

@@ -62,3 +62,40 @@ def test_checksum_mismatch_detected(tmp_path):
         f.write(b"\xff\xff\xff\xff")
     with pytest.raises(ValueError, match="checksum"):
         model_store.get_model_file("mobilenet0.25_digits", root=root)
+
+def test_mobilenetv2_artifact_loads_and_classifies():
+    """Second vision artifact (mobilenetv2_0.25_digits): loads from the
+    packaged store and classifies the held-out digits split well above
+    chance (training: tools/train_store_artifacts.py)."""
+    from incubator_mxnet_tpu.gluon.model_zoo.vision import mobilenet_v2_0_25
+
+    Xte, Yte = _digits_test_split()
+    net = mobilenet_v2_0_25(classes=10)
+    net.load_parameters(model_store.get_model_file("mobilenetv2_0.25_digits"))
+    pred = onp.argmax(net(np.array(Xte[:120])).asnumpy(), axis=1)
+    acc = (pred == Yte[:120]).mean()
+    assert acc >= 0.9, acc
+
+
+def test_charlm_artifact_loads_rnn_family():
+    """RNN-family artifact (lstm_charlm_tiny): embed + LSTM + dense head
+    round-trip through the store registry (serde breadth beyond CNNs)."""
+    from incubator_mxnet_tpu import gluon, np as mxnp
+
+    class CharLM(gluon.HybridBlock):
+        def __init__(self):
+            super().__init__()
+            self.embed = gluon.nn.Embedding(28, 32)
+            self.lstm = gluon.rnn.LSTM(64, num_layers=1, layout="NTC")
+            self.head = gluon.nn.Dense(28, flatten=False)
+
+        def forward(self, x):
+            return self.head(self.lstm(self.embed(x)))
+
+    net = CharLM()
+    net.initialize()
+    net(mxnp.array(onp.zeros((1, 8), "int32")))
+    net.load_parameters(model_store.get_model_file("lstm_charlm_tiny"))
+    out = net(mxnp.array(onp.zeros((2, 16), "int32")))
+    assert out.shape == (2, 16, 28)
+    assert onp.isfinite(out.asnumpy()).all()
