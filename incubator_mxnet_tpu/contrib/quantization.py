@@ -748,11 +748,7 @@ def chain_residual_blocks(net, calib_data=None, num_calib_batches=10,
                         "decode at the body scale", type(cons).__name__)
                 continue
             prod.__dict__["_out_threshold"] = t_in
-            decoders = [cons.body._children[list(cons.body._children)[0]]]
-            if cons.downsample is not None:
-                decoders.append(cons.downsample._children[
-                    list(cons.downsample._children)[0]])
-            prod.__dict__["_chain_consumer"] = tuple(decoders)
+            prod.__dict__["_chain_consumer"] = tuple(_res_decoders(cons))
         for c in block._children.values():
             if isinstance(c, HybridBlock):
                 link(c)
@@ -805,6 +801,18 @@ def _last_resblock(b):
     return None
 
 
+def _res_decoders(cons):
+    """Every layer that decodes a producer's int8 codes when chaining
+    INTO a residual block: body[0] and, when present, the downsample's
+    first layer. Single source of truth for 'who consumes the emit' —
+    used by both chaining passes and the scale-agreement check."""
+    decoders = [cons.body._children[list(cons.body._children)[0]]]
+    if cons.downsample is not None:
+        decoders.append(cons.downsample._children[
+            list(cons.downsample._children)[0]])
+    return decoders
+
+
 def _res_in_threshold(cons):
     """The shared decode threshold a producer may emit at, or None when
     the block's body and downsample would decode at diverging scales
@@ -812,9 +820,9 @@ def _res_in_threshold(cons):
     t = cons.__dict__.get("_in_threshold")
     if t is None:
         return None
-    if cons.downsample is not None:
-        ds_first = cons.downsample._children[
-            list(cons.downsample._children)[0]]
+    decoders = _res_decoders(cons)
+    if len(decoders) > 1:
+        ds_first = decoders[1]
         if not isinstance(ds_first, (QuantizedConv2D, QuantizedDense)):
             return None
         t_in = float(t.data().asnumpy())
@@ -875,14 +883,8 @@ def chain_boundaries(net, logger=None):
                     continue
                 prod.__dict__["_out_threshold"] = t_in
                 # BOTH decoders of the emitted codes need the chain dtype
-                # seeded (see _chain_dtype): body[0] and, when present,
-                # the downsample's first layer
-                decoders = [cons.body._children[
-                    list(cons.body._children)[0]]]
-                if cons.downsample is not None:
-                    decoders.append(cons.downsample._children[
-                        list(cons.downsample._children)[0]])
-                prod.__dict__["_chain_consumer"] = tuple(decoders)
+                # seeded (see _chain_dtype / _res_decoders)
+                prod.__dict__["_chain_consumer"] = tuple(_res_decoders(cons))
                 n_linked += 1
                 if logger:
                     logger.info("boundary-chained %s -> %s",

@@ -504,11 +504,28 @@ def pooling(data, kernel=None, stride=None, pad=None, pool_type="max",
                                   tuple(strides), pads)
             if pool_type == "sum":
                 return s
-            if count_include_pad:
+            if count_include_pad and pooling_convention != "full":
                 return s / float(onp.prod(kernel))
             ones = jnp.ones(x.shape, x.dtype)
-            cnt = lax.reduce_window(ones, 0.0, lax.add, tuple(window),
-                                    tuple(strides), pads)
+            if count_include_pad:
+                # 'full' + include_pad: the reference divides a partial
+                # final window by its size CLIPPED to height+pad
+                # (pool.h hend=min(hstart+k, height+pad)), so pad cells
+                # count but the ceil-extension does not — pre-pad the
+                # ones with the REAL padding and reduce with only the
+                # ceil extension as window padding
+                np_pad = [(0, 0)] * x.ndim
+                extra = [(0, 0)] * x.ndim
+                for ax, (lo, hi) in enumerate(pads):
+                    rl, rh = padding[ax]
+                    np_pad[ax] = (rl, rh)
+                    extra[ax] = (lo - rl, hi - rh)
+                ones = jnp.pad(ones, np_pad, constant_values=1)
+                cnt = lax.reduce_window(ones, 0.0, lax.add, tuple(window),
+                                        tuple(strides), tuple(extra))
+            else:
+                cnt = lax.reduce_window(ones, 0.0, lax.add, tuple(window),
+                                        tuple(strides), pads)
             return s / cnt
     else:
         raise ValueError(f"unsupported pool_type {pool_type!r}")

@@ -385,3 +385,21 @@ def test_maxpool_ceil_mode_full_convention():
     out = nn.MaxPool2D(2, 2, ceil_mode=True)(x)
     assert out.shape == (1, 2, 3, 3)
     assert float(out.asnumpy()[0, 0, 2, 2]) == 24.0  # partial 1x1 window
+
+
+def test_avgpool_ceil_mode_clipped_divisor():
+    """Ceil-mode avg pool divides partial windows by their CLIPPED size
+    (reference pool.h: hend = min(hstart+k, height+pad)), not the full
+    kernel area."""
+    import numpy as onp
+
+    from incubator_mxnet_tpu import np
+    from incubator_mxnet_tpu.gluon import nn
+
+    x = np.array(onp.arange(25).reshape(1, 1, 5, 5).astype("float32"))
+    out = nn.AvgPool2D(2, 2, ceil_mode=True)(x)
+    assert out.shape == (1, 1, 3, 3)
+    # bottom-right ceil window covers only element [4,4]=24 -> avg = 24
+    assert float(out.asnumpy()[0, 0, 2, 2]) == 24.0
+    # bottom edge window covers [4,2],[4,3] -> (22+23)/2
+    assert float(out.asnumpy()[0, 0, 2, 1]) == 22.5

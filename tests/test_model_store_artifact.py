@@ -14,17 +14,20 @@ from incubator_mxnet_tpu.gluon.model_zoo.vision import mobilenet0_25
 
 
 def _digits_test_split():
-    sklearn = pytest.importorskip("sklearn.datasets")
-    d = sklearn.load_digits()
-    X = d.images.astype("float32") / 16.0
-    Y = d.target.astype("int32")
-    # the exact permutation/split the training script used
-    idx = onp.random.RandomState(0).permutation(len(X))
-    X, Y = X[idx], Y[idx]
-    n_tr = int(0.8 * len(X))
-    X = onp.repeat(onp.repeat(X, 4, axis=1), 4, axis=2)
-    X = onp.stack([X] * 3, axis=1)
-    return X[n_tr:], Y[n_tr:]
+    pytest.importorskip("sklearn.datasets")
+    # IMPORT the training tool's split so test and training can never
+    # drift apart (a diverging copy would silently evaluate artifacts on
+    # their own training data)
+    import importlib.util
+    import os
+
+    tool = os.path.join(os.path.dirname(__file__), "..", "tools",
+                        "train_store_artifacts.py")
+    spec = importlib.util.spec_from_file_location("_train_artifacts", tool)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    (_, _), (Xte, Yte) = mod._digits()
+    return Xte, Yte
 
 
 def test_packaged_artifact_resolves_and_verifies():
