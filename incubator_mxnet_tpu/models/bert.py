@@ -127,6 +127,7 @@ class PositionwiseFFN(HybridBlock):
         self.ffn1 = nn.Dense(hidden_size, flatten=False, in_units=units)
         self.ffn2 = nn.Dense(units, flatten=False, in_units=hidden_size)
         self._activation = activation
+        self._drop_rate = dropout
         self.dropout = nn.Dropout(dropout) if dropout else None
 
     def forward(self, x):

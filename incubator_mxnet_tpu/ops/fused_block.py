@@ -254,6 +254,139 @@ def _emulate(x, h, gamma, beta, seeds, p, eps):
     return y.astype(x.dtype)
 
 
+# ---------------------------------------------------------------------------
+# fused gelu + dropout (the FFN hidden-activation site)
+# ---------------------------------------------------------------------------
+#
+# dropout(gelu(u)) on the (B·T, 4C) FFN hidden is the largest dropout in a
+# transformer (402 MB bf16 at BERT-base seq-512); XLA's path writes and
+# re-reads the RNG bit tensor through HBM (~200 MB per site) and saves the
+# keep mask for backward. The kernel draws bits in VMEM and backward
+# re-seeds the same stream — the bit/mask tensors never touch HBM.
+# erf has no pallas TPU lowering, so Φ uses the Abramowitz–Stegun 7.1.26
+# rational approximation (|err| < 1.5e-7 — below bf16 resolution).
+
+_AS_P = 0.3275911
+_AS_A = (0.254829592, -0.284496736, 1.421413741, -1.453152027, 1.061405429)
+
+
+def _erf_approx(z):
+    s = jnp.sign(z)
+    za = jnp.abs(z)
+    t = 1.0 / (1.0 + _AS_P * za)
+    poly = t * (_AS_A[0] + t * (_AS_A[1] + t * (
+        _AS_A[2] + t * (_AS_A[3] + t * _AS_A[4]))))
+    return s * (1.0 - poly * jnp.exp(-za * za))
+
+
+def _gelu_parts(u):
+    """(gelu(u), gelu'(u)) in f32: Φ(u) via erf approx; φ(u) closed-form."""
+    phi_cdf = 0.5 * (1.0 + _erf_approx(u * 0.7071067811865476))
+    pdf = jnp.exp(-0.5 * u * u) * 0.3989422804014327
+    return u * phi_cdf, phi_cdf + u * pdf
+
+
+def _gd_fwd_kernel(seed_ref, u_ref, h_ref, *, threshold, scale, use_rng):
+    u = u_ref[...].astype(jnp.float32)
+    g, _ = _gelu_parts(u)
+    if use_rng:
+        keep = _mask(seed_ref, u_ref.shape, threshold)
+        g = jnp.where(keep, g * scale, 0.0)
+    h_ref[...] = g.astype(h_ref.dtype)
+
+
+def _gd_bwd_kernel(seed_ref, u_ref, dy_ref, du_ref, *,
+                   threshold, scale, use_rng):
+    u = u_ref[...].astype(jnp.float32)
+    dy = dy_ref[...].astype(jnp.float32)
+    _, dg = _gelu_parts(u)
+    if use_rng:
+        keep = _mask(seed_ref, u_ref.shape, threshold)
+        du = jnp.where(keep, dy * dg * scale, 0.0)
+    else:
+        du = dy * dg
+    du_ref[...] = du.astype(du_ref.dtype)
+
+
+def _gd_call(kernel, out_dtype, x2d, seeds, extra, p, interpret):
+    rows, feat = x2d.shape
+    block = _block_rows(rows, feat, x2d.dtype.itemsize)
+    n_blocks = rows // block
+    k = functools.partial(kernel, threshold=_threshold(p),
+                          scale=1.0 / (1.0 - p) if p else 1.0,
+                          use_rng=p > 0)
+    in_specs = [pl.BlockSpec((block, feat), lambda i, s: (i, 0))
+                for _ in range(1 + len(extra))]
+    return pl.pallas_call(
+        k,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(n_blocks,),
+            in_specs=in_specs,
+            out_specs=pl.BlockSpec((block, feat), lambda i, s: (i, 0)),
+        ),
+        out_shape=jax.ShapeDtypeStruct((rows, feat), out_dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",)),
+        interpret=interpret,
+    )(seeds, x2d, *extra)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+def _gd_core(u2d, seeds, p, interpret):
+    return _gd_call(_gd_fwd_kernel, u2d.dtype, u2d, seeds, (), p, interpret)
+
+
+def _gd_core_fwd(u2d, seeds, p, interpret):
+    return _gd_core(u2d, seeds, p, interpret), (u2d, seeds)
+
+
+def _gd_core_bwd(p, interpret, res, dy):
+    import numpy as onp
+
+    u2d, seeds = res
+    du = _gd_call(_gd_bwd_kernel, u2d.dtype, u2d, seeds, (dy,), p, interpret)
+    return du, onp.zeros(seeds.shape, jax.dtypes.float0)
+
+
+_gd_core.defvjp(_gd_core_fwd, _gd_core_bwd)
+
+
+def _gd_emulate(u, seeds, p):
+    import jax.random as jr
+
+    g = jax.nn.gelu(u.astype(jnp.float32), approximate=False)
+    if p > 0:
+        key = jr.fold_in(jr.PRNGKey(seeds[0]), seeds[1])
+        keep = jr.bits(key, u.shape, jnp.uint32) >= jnp.uint32(_threshold(p))
+        g = jnp.where(keep, g / (1.0 - p), 0.0)
+    return g.astype(u.dtype)
+
+
+def gelu_dropout(u, p, seeds, interpret=None):
+    """``dropout_p(gelu(u))`` over the last axis, one fused pass with
+    in-VMEM RNG (backward re-seeds the stream; no mask/bit residuals)."""
+    if interpret is None:
+        interpret = _interpret_default()
+    if interpret:
+        return _gd_emulate(u, seeds, float(p))
+    shape = u.shape
+    feat = shape[-1]
+    rows = 1
+    for s_ in shape[:-1]:
+        rows *= s_
+    u2d = u.reshape(rows, feat)
+    block = _block_rows(rows, feat, u2d.dtype.itemsize)
+    pad = (-rows) % block
+    if pad:
+        u2d = jnp.pad(u2d, ((0, pad), (0, 0)))
+    h = _gd_core(u2d, jnp.asarray(seeds, jnp.int32), float(p),
+                 bool(interpret))
+    if pad:
+        h = h[:rows]
+    return h.reshape(shape)
+
+
 def residual_dropout_ln(x, h, gamma, beta, p, seeds, eps=1e-5,
                         interpret=None):
     """``layer_norm(x + dropout_p(h))`` over the last axis, one fused pass.
