@@ -147,6 +147,35 @@ def bench_dispatch_floor(iters=100):
     return (time.perf_counter() - t0) / iters * 1000.0
 
 
+def bench_flash_long_context(T=32768, B=1, H=8, D=64, iters=3):
+    """Streaming flash attention in its HOME regime: T=32k, where the
+    (B, H, T, T) score matrix is ~17 GB bf16 / ~34 GB f32 and the XLA
+    path cannot compile at all (see ops/flash_attention.py
+    _XLA_ATTN_BYTES_LIMIT) — the pallas kernel's O(T) memory is the only
+    option. Forward-only tokens/s; the long-context capability anchor
+    (reference has NO attention kernel at any length — SURVEY §2.4)."""
+    import jax
+    import jax.numpy as jnp
+
+    from incubator_mxnet_tpu.ops.flash_attention import flash_attention
+
+    rng = onp.random.RandomState(0)
+    q = jnp.asarray(rng.randn(B, H, T, D), jnp.bfloat16)
+    k = jnp.asarray(rng.randn(B, H, T, D), jnp.bfloat16)
+    v = jnp.asarray(rng.randn(B, H, T, D), jnp.bfloat16)
+    f = jax.jit(lambda q, k, v: flash_attention(q, k, v, causal=True,
+                                                impl="pallas"))
+    o = f(q, k, v)
+    float(jax.device_get(o[0, 0, 0, 0].astype(jnp.float32)))  # compile+sync
+    t0 = time.perf_counter()
+    acc = q
+    for _ in range(iters):
+        acc = f(acc, k, v)           # o is (B,H,T,D): chain it as q
+    float(jax.device_get(acc[0, 0, 0, 0].astype(jnp.float32)))
+    dt = (time.perf_counter() - t0) / iters
+    return B * T / dt
+
+
 def bench_input_pipeline(n_images=512, batch=64, epochs=2):
     """Real-JPEG input pipeline images/sec: RecordIO pack → ImageRecordIter
     (cv2 decode, crop/mirror augment, uint8 batch upload, device-side
@@ -484,6 +513,11 @@ def main():
         extras["bert_mfu_seq512"] = round(mfu512, 4)
     except Exception as e:  # pragma: no cover
         _fail("bert_seq512", e)
+    try:
+        extras["flash_T32k_fwd_tokens_s"] = round(
+            _retry(bench_flash_long_context), 1)
+    except Exception as e:  # pragma: no cover
+        _fail("flash_long_context", e)
     try:
         dec_tokens_s, dec_speedup = _retry(bench_gpt_decode)
         extras["gpt_decode_tokens_s"] = round(dec_tokens_s, 1)
