@@ -179,3 +179,50 @@ def test_gelu_dropout_erf_approximation_accuracy():
     got = onp.asarray(fb._erf_approx(z))
     want = sp.erf(onp.asarray(z, onp.float64))
     assert onp.abs(got - want).max() < 1e-6
+
+
+def test_bert_cell_matches_reference_composition():
+    """TransformerEncoderCell (post-LN, fused residual sites) equals the
+    hand-composed ln(x + h) reference in eval mode — pins the fused-op
+    integration, not just the op."""
+    from incubator_mxnet_tpu import np as mxnp
+    from incubator_mxnet_tpu.models.bert import TransformerEncoderCell
+
+    cell = TransformerEncoderCell(units=128, hidden_size=256, num_heads=4,
+                                  dropout=0.3)
+    cell.initialize()
+    x = mxnp.array(onp.random.RandomState(0)
+                   .randn(2, 16, 128).astype("float32"))
+    out = cell(x)  # eval mode: dropout inactive
+
+    h = cell.attention(x, None, None)
+    x1 = _ref_ln(jnp.asarray((x + h).asnumpy()),
+                 jnp.asarray(cell.ln1.gamma.data().asnumpy()),
+                 jnp.asarray(cell.ln1.beta.data().asnumpy()))
+    h2 = cell.ffn(mxnp.array(onp.asarray(x1)))
+    want = _ref_ln(jnp.asarray(onp.asarray(x1) + h2.asnumpy()),
+                   jnp.asarray(cell.ln2.gamma.data().asnumpy()),
+                   jnp.asarray(cell.ln2.beta.data().asnumpy()))
+    onp.testing.assert_allclose(onp.asarray(out.asnumpy()),
+                                onp.asarray(want), atol=2e-5, rtol=2e-5)
+
+
+def test_bert_cell_training_dropout_active():
+    """In training mode the fused residual sites actually drop (outputs
+    differ between draws) and stay finite."""
+    from incubator_mxnet_tpu import autograd
+    from incubator_mxnet_tpu import np as mxnp
+    from incubator_mxnet_tpu.models.bert import TransformerEncoderCell
+
+    cell = TransformerEncoderCell(units=128, hidden_size=256, num_heads=4,
+                                  dropout=0.5)
+    cell.initialize()
+    x = mxnp.array(onp.random.RandomState(1)
+                   .randn(2, 16, 128).astype("float32"))
+    with autograd.record(train_mode=True):
+        o1 = cell(x)
+    with autograd.record(train_mode=True):
+        o2 = cell(x)
+    a1, a2 = o1.asnumpy(), o2.asnumpy()
+    assert onp.isfinite(a1).all() and onp.isfinite(a2).all()
+    assert not onp.allclose(a1, a2)  # different dropout draws
