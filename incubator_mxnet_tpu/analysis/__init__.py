@@ -1,0 +1,20 @@
+"""Static-analysis subsystem: program auditor + hazard findings.
+
+The reference stack rejects bad programs in C++ static machinery (nnvm
+graph passes, shape/dtype inference, dmlc parameter checking) before they
+run; the TPU-native analogue is this module — `mx.analysis.audit` inspects
+a program the way the op-call jit cache / `hybridize()` will see it and
+reports recompilation, host-sync, promotion-drift and buffer-aliasing
+hazards as structured findings (see ANALYSIS.md).
+
+The companion *framework lint* (`tools/framework_lint.py`) statically
+checks the framework source itself for invariants learned from real bugs;
+it is pure-AST and lives in tools/ so it can run without importing jax.
+
+Env knob: ``MXNET_ANALYSIS=warn|raise`` (see `util.env_knobs()`).
+"""
+from .auditor import audit, jit_cache_report  # noqa: F401
+from .findings import HAZARD_KINDS, AuditReport, Finding  # noqa: F401
+
+__all__ = ["audit", "jit_cache_report", "AuditReport", "Finding",
+           "HAZARD_KINDS"]

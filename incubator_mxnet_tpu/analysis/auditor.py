@@ -1,0 +1,429 @@
+"""Program auditor: hazard detection over imperative/jit-cached programs.
+
+`audit(fn_or_block, *args)` runs the target twice:
+
+1. an **instrumented eager pass** with the real inputs — NDArray host-sync
+   entry points (`asnumpy`/`item`/`__bool__`/`__int__`/`__float__`/
+   `__index__`) are patched to record call sites, the op funnel
+   (`ndarray.apply_op` / `apply_op_flat`) feeds every executed op through
+   `_observe_op` for dtype-promotion drift and cache-key hazards, and
+   input/parameter buffer versions are compared before/after to catch
+   in-place rebinds (`NDArray._set_data` mutation semantics);
+2. an **abstract trace** (`jax.make_jaxpr`) of the same program — the
+   definitive "reachable from a cached program" check: a host sync that
+   survives the eager pass (because values were concrete) aborts the trace
+   with a tracer error and is reported as an ``error`` finding. When the
+   trace succeeds the jaxpr is attached to the report for inspection.
+
+Call-signature hazards (python scalars baked into jit-cache keys, weak-typed
+inputs, unhashable statics) are scanned statically from the arguments —
+exactly what `ndarray._op_cache_key`/`jax.jit` would key on.
+
+The `MXNET_ANALYSIS` env knob (see `util.env_knobs()`) escalates findings:
+``warn`` logs each finding, ``raise`` raises `MXNetError` when any warn- or
+error-severity finding survives. Unset/empty returns the report silently.
+"""
+from __future__ import annotations
+
+import logging
+import threading
+
+from ..base import MXNetError
+from .findings import AuditReport, Finding  # noqa: F401  (re-exported)
+
+__all__ = ["audit", "jit_cache_report"]
+
+_LOG = logging.getLogger("incubator_mxnet_tpu.analysis")
+
+# NDArray entry points that force a device→host round trip. `item`,
+# `asscalar`, `tolist`, `__bool__`, `__int__`, `__float__` all funnel into
+# `asnumpy`; the depth counter below attributes the sync to the OUTERMOST
+# entry point so one user-level sync yields one finding.
+_SYNC_METHODS = ("asnumpy", "item", "asscalar", "tolist",
+                 "__bool__", "__int__", "__float__", "__index__")
+
+# Binary ops checked against the reference promotion table. The expected
+# dtype is computed by running the same-named numpy function on 1-element
+# operands — numpy IS the reference table (the reference's np namespace is
+# numpy-official by contract, SURVEY §2).
+_PROMO_OPS = frozenset({
+    "add", "subtract", "multiply", "divide", "true_divide", "floor_divide",
+    "mod", "remainder", "power", "maximum", "minimum", "hypot", "arctan2",
+    "logaddexp", "logaddexp2", "matmul", "dot",
+})
+
+_EXPECTED_DTYPE_CACHE: dict = {}
+
+
+def _expected_dtype(name, dt_a, dt_b):
+    """Reference promotion result for `name(dt_a, dt_b)`, or None when the
+    table has no opinion (exotic dtypes, numpy lacks the op)."""
+    import numpy as onp
+
+    key = (name, str(dt_a), str(dt_b))
+    if key in _EXPECTED_DTYPE_CACHE:
+        return _EXPECTED_DTYPE_CACHE[key]
+    fn = getattr(onp, name, None)
+    expected = None
+    if fn is not None:
+        try:
+            if name in ("matmul", "dot"):
+                a, b = onp.ones((1, 1), dt_a), onp.ones((1, 1), dt_b)
+            else:
+                a, b = onp.ones(1, dt_a), onp.ones(1, dt_b)
+            with onp.errstate(all="ignore"):
+                expected = fn(a, b).dtype
+        except Exception:
+            expected = None
+    _EXPECTED_DTYPE_CACHE[key] = expected
+    return expected
+
+
+def _checkable_dtype(dt):
+    import numpy as onp
+
+    try:
+        return onp.dtype(dt).kind in "biuf"
+    except TypeError:
+        return False    # bfloat16, float0, key dtypes: no numpy analogue
+
+
+def _user_site():
+    """file:line of the audited program's own frame (first caller outside
+    the framework's ndarray/analysis internals)."""
+    import traceback
+
+    for frame in reversed(traceback.extract_stack()[:-2]):
+        f = frame.filename.replace("\\", "/")
+        if not (f.endswith("analysis/auditor.py")
+                or "/ndarray/" in f or f.endswith("autograd.py")):
+            return f"{frame.filename}:{frame.lineno}"
+    return None
+
+
+class _Recorder:
+    """Collects findings during one audited run (sync hooks + op funnel)."""
+
+    def __init__(self, report: AuditReport):
+        self.report = report
+        self._tls = threading.local()
+
+    # -- host syncs ---------------------------------------------------------
+    def enter_sync(self, method):
+        depth = getattr(self._tls, "depth", 0)
+        if depth == 0:
+            site = _user_site()
+            self.report.note(
+                "host-sync",
+                f"`{method}` forces a device->host sync inside the audited "
+                f"program{f' at {site}' if site else ''}; under jit/hybridize "
+                "this either fails to trace or silently fences the pipeline",
+                severity="warn", op=method, site=site)
+        self._tls.depth = depth + 1
+
+    def exit_sync(self):
+        self._tls.depth = getattr(self._tls, "depth", 1) - 1
+
+    # -- op funnel ----------------------------------------------------------
+    def observe_op(self, name, in_vals, out_vals, meta):
+        if meta.get("uncacheable"):
+            self.report.note(
+                "recompile-unhashable-static",
+                f"op `{name}` was called with unhashable static arguments; "
+                "the op-call jit cache cannot key it and every call re-traces",
+                op=name)
+        if meta.get("denied"):
+            self.report.note(
+                "eager-fallback",
+                f"op `{name}` is deny-listed from the op-call jit cache "
+                "(dynamic shape or repeated compile failure); it runs "
+                "eagerly on every call", severity="info", op=name)
+        if name in _PROMO_OPS and len(in_vals) >= 2 and out_vals:
+            dt_a, dt_b = in_vals[0].dtype, in_vals[1].dtype
+            out_dt = out_vals[0].dtype
+            if (_checkable_dtype(dt_a) and _checkable_dtype(dt_b)
+                    and _checkable_dtype(out_dt)):
+                expected = _expected_dtype(name, dt_a, dt_b)
+                if expected is not None and expected != out_dt:
+                    self.report.note(
+                        "dtype-promotion-drift",
+                        f"`{name}({dt_a}, {dt_b})` produced {out_dt} but the "
+                        f"reference promotion table gives {expected} "
+                        "(jax weak-type/x64 rules drifting from the "
+                        "reference's numpy semantics)", op=name)
+
+
+class _Instrumented:
+    """Scope that patches NDArray sync entry points and installs the op
+    funnel hook. Patching happens only while an audit is running — the hot
+    paths carry a single `is not None` check otherwise."""
+
+    def __init__(self, recorder):
+        self.recorder = recorder
+        self._saved = {}
+
+    def __enter__(self):
+        from ..ndarray import ndarray as nd_mod
+
+        cls = nd_mod.NDArray
+        rec = self.recorder
+        for meth in _SYNC_METHODS:
+            orig = cls.__dict__.get(meth)
+            if orig is None:
+                continue
+            self._saved[meth] = orig
+
+            def wrapper(self_, *a, _orig=orig, _meth=meth, **kw):
+                rec.enter_sync(_meth)
+                try:
+                    return _orig(self_, *a, **kw)
+                finally:
+                    rec.exit_sync()
+
+            wrapper.__name__ = meth
+            setattr(cls, meth, wrapper)
+        self._prev_hook = nd_mod._ANALYSIS_HOOK
+        nd_mod._ANALYSIS_HOOK = rec.observe_op
+        return self
+
+    def __exit__(self, *exc):
+        from ..ndarray import ndarray as nd_mod
+
+        for meth, orig in self._saved.items():
+            setattr(nd_mod.NDArray, meth, orig)
+        nd_mod._ANALYSIS_HOOK = self._prev_hook
+        return False
+
+
+def _scan_signature(report, args, kwargs):
+    """Static hazards visible from the call signature alone — the values
+    `ndarray._op_cache_key` / `jax.jit` would bake into cache keys."""
+    from ..ndarray.ndarray import NDArray
+
+    def scan_one(label, a):
+        if isinstance(a, bool):
+            return              # mode flags: static by design
+        if isinstance(a, (int, float)):
+            report.note(
+                "recompile-python-scalar",
+                f"{label} is a python scalar ({a!r}); it is baked into the "
+                "jit-cache key as a static value, so every distinct value "
+                "compiles a separate program — pass a 0-d array for values "
+                "that change per step")
+            return
+        if isinstance(a, NDArray):
+            if getattr(a._data, "weak_type", False):
+                report.note(
+                    "recompile-weak-type",
+                    f"{label} carries a weak-typed buffer; mixing weak and "
+                    "strong types churns the jit cache (one recompile per "
+                    "weak/strong flip) — canonicalize with jnp.asarray(x, "
+                    "dtype)")
+            return
+        try:
+            hash(a)
+        except TypeError:
+            report.note(
+                "recompile-unhashable-static",
+                f"{label} ({type(a).__name__}) is unhashable; it cannot key "
+                "the op-call jit cache and forces eager re-tracing — pass a "
+                "tuple or a hashable config object")
+
+    for i, a in enumerate(args):
+        scan_one(f"positional arg {i}", a)
+    for k, v in kwargs.items():
+        scan_one(f"keyword arg {k!r}", v)
+
+
+def _run_eager(report, call, watched):
+    """Instrumented eager pass; returns True when the program executed."""
+    versions = [(label, arr, arr._version) for label, arr in watched]
+    rec = _Recorder(report)
+    try:
+        with _Instrumented(rec):
+            call()
+    except Exception as e:  # noqa: BLE001 — auditing must not mask the error
+        report.note(
+            "not-jittable",
+            f"audited program raised {type(e).__name__}: {e}",
+            severity="error")
+        return False
+    for label, arr, v0 in versions:
+        if arr._version != v0:
+            report.note(
+                "aliased-buffer-mutation",
+                f"{label} was mutated in place during the audited call "
+                f"(buffer rebind, version {v0} -> {arr._version}); a "
+                "compiled/hybridized program would bake the stale buffer or "
+                "invalidate donation — return new arrays instead")
+    return True
+
+
+def _run_trace(report, pure_fn, in_avals):
+    """Abstract trace: the definitive in-trace host-sync check."""
+    import jax
+
+    sync_errors = tuple(
+        e for e in (
+            getattr(jax.errors, "TracerBoolConversionError", None),
+            getattr(jax.errors, "TracerArrayConversionError", None),
+            getattr(jax.errors, "TracerIntegerConversionError", None),
+            getattr(jax.errors, "ConcretizationTypeError", None))
+        if e is not None)
+    rec = _Recorder(report)
+    try:
+        with _Instrumented(rec):
+            report.jaxpr = jax.make_jaxpr(pure_fn)(*in_avals)
+    except sync_errors as e:
+        report.note(
+            "host-sync",
+            "definite in-trace host sync: abstract tracing aborted with "
+            f"{type(e).__name__} — this program cannot compile as written",
+            severity="error")
+    except Exception as e:  # noqa: BLE001
+        report.note(
+            "not-jittable",
+            f"abstract trace failed with {type(e).__name__}: {e}",
+            severity="info")
+
+
+def _is_block(target):
+    try:
+        from ..gluon.block import Block
+
+        return isinstance(target, Block)
+    except Exception:
+        return False
+
+
+def audit(fn_or_block, *args, train_mode=None, **kwargs):
+    """Audit a callable or gluon Block for compile-time hazards.
+
+    Runs the target eagerly with instrumentation, then traces it
+    abstractly, and returns an :class:`AuditReport`. ``train_mode`` pins
+    the autograd training flag for both passes (default: the current
+    mode, i.e. eval outside `autograd.record()`). Remaining positional/
+    keyword args are forwarded to the target.
+    """
+    import jax
+
+    from .. import autograd, util
+    from ..ndarray.ndarray import NDArray
+
+    is_block = _is_block(fn_or_block)
+    name = (type(fn_or_block).__name__ if is_block
+            else getattr(fn_or_block, "__name__", repr(fn_or_block)))
+    report = AuditReport(name)
+    training = autograd.is_training() if train_mode is None else bool(train_mode)
+
+    _scan_signature(report, args, kwargs)
+
+    # -- build the eager call and the traceable pure function ---------------
+    nd_pos = [i for i, a in enumerate(args) if isinstance(a, NDArray)]
+    nd_args = [args[i] for i in nd_pos]
+    watched = [(f"positional arg {i}", args[i]) for i in nd_pos]
+
+    if is_block:
+        from ..gluon.block import Block
+        from ..random import next_key, trace_key_scope
+        from ..utils.trace import TraceContext
+
+        for pname, p in fn_or_block.collect_params().items():
+            if p._data is not None:
+                watched.append((f"parameter {pname!r}", p.data()))
+
+        def call():
+            with autograd._Scope(training=training):
+                Block.__call__(fn_or_block, *args, **kwargs)
+
+        def pure_fn(*vals):
+            import jax.tree_util as jtu
+
+            call_args = list(args)
+            for i, v in zip(nd_pos, vals):
+                call_args[i] = NDArray(v)
+            with TraceContext() as tc, trace_key_scope(next_key()), \
+                    autograd.pause(train_mode=training):
+                out = fn_or_block.forward(*call_args, **kwargs)
+            flat, _ = jtu.tree_flatten(
+                out, is_leaf=lambda x: isinstance(x, NDArray))
+            out_vals = tuple(o._data if isinstance(o, NDArray) else o
+                             for o in flat)
+            return out_vals + tuple(nv for _, nv in tc.updates.values())
+    else:
+        def call():
+            with autograd._Scope(training=training):
+                fn_or_block(*args, **kwargs)
+
+        def pure_fn(*vals):
+            import jax.tree_util as jtu
+
+            call_args = list(args)
+            for i, v in zip(nd_pos, vals):
+                call_args[i] = NDArray(v)
+            with autograd._Scope(training=training):
+                out = fn_or_block(*call_args, **kwargs)
+            flat, _ = jtu.tree_flatten(
+                out, is_leaf=lambda x: isinstance(x, NDArray))
+            return tuple(o._data if isinstance(o, NDArray) else o
+                         for o in flat)
+
+    ran = _run_eager(report, call, watched)
+    if ran:
+        in_avals = [jax.ShapeDtypeStruct(tuple(a._data.shape), a._data.dtype)
+                    for a in nd_args]
+        _run_trace(report, pure_fn, in_avals)
+
+    _apply_mode(report, util.getenv("MXNET_ANALYSIS"))
+    return report
+
+
+def jit_cache_report(threshold=8):
+    """Inspect the live op-call jit cache for recompile churn: one op
+    holding `threshold`+ compiled variants means its static arguments (for
+    scalars: their VALUES) keep changing — the silent-cache-miss pattern
+    behind the eager-dispatch regression. Returns an AuditReport."""
+    from ..ndarray import ndarray as nd_mod
+
+    report = AuditReport("jit-cache")
+    info = nd_mod.jit_cache_info()
+    per_op: dict = {}
+    for key in info["keys"]:
+        jfn = key[0]
+        per_op.setdefault(jfn, []).append(key)
+    for jfn, keys in per_op.items():
+        if len(keys) >= threshold:
+            opname = getattr(jfn, "__name__", repr(jfn))
+            report.note(
+                "recompile-cache-churn",
+                f"op `{opname}` holds {len(keys)} compiled variants in the "
+                "op-call jit cache; a static argument is changing per call "
+                "(python-scalar churn) — hoist it into a 0-d array",
+                op=opname)
+    for name in sorted(info["denied"]):
+        report.note(
+            "eager-fallback",
+            f"op `{name}` is deny-listed (eager-only)", severity="info",
+            op=name)
+    from .. import autograd
+
+    vinfo = autograd.vjp_cache_info()
+    for key in sorted(vinfo["denied"], key=repr):
+        # vjp keys are ("vjp", jfn, amp_mode, statics, kwargs)
+        jfn = key[1] if isinstance(key, tuple) and len(key) > 1 else None
+        opname = getattr(jfn, "__name__", repr(key))
+        report.note(
+            "eager-fallback",
+            f"backward of `{opname}` is deny-listed from the vjp-applier "
+            "cache (re-runs the forward eagerly every backward pass)",
+            severity="info", op=str(opname))
+    return report
+
+
+def _apply_mode(report, mode):
+    mode = (mode or "").strip().lower()
+    if mode == "warn":
+        for f in report.findings:
+            _LOG.warning("MXNET_ANALYSIS: %r", f)
+    elif mode == "raise" and report.findings:
+        raise MXNetError("MXNET_ANALYSIS=raise\n" + report.summary())

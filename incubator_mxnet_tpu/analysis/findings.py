@@ -1,0 +1,108 @@
+"""Structured findings for the static-analysis subsystem.
+
+The reference rejects bad programs in C++ static machinery (nnvm shape/
+dtype inference passes, dmlc parameter checking) before anything runs;
+this build's analogue reports *hazards* — programs that run but recompile,
+host-sync, or promote dtypes away from the reference table — as structured
+`Finding` records grouped in an `AuditReport`.
+
+Severity contract:
+- ``error``   — the program cannot compile as written (e.g. a definite
+  host sync inside a traced region).
+- ``warn``    — the program runs but violates a performance/semantics
+  invariant (recompilation churn, promotion drift, buffer mutation).
+- ``info``    — advisory notes (deny-listed eager ops, trace skips) that
+  depend on global session state; not counted as findings.
+"""
+from __future__ import annotations
+
+__all__ = ["Finding", "AuditReport", "HAZARD_KINDS"]
+
+# The hazard classes the auditor knows about (ANALYSIS.md documents each).
+HAZARD_KINDS = (
+    "host-sync",                 # __bool__/__int__/.item()/asnumpy in a
+                                 # would-be-compiled region
+    "recompile-python-scalar",   # python int/float arg baked into cache keys
+    "recompile-weak-type",       # weak-typed input: cache misses on churn
+    "recompile-unhashable-static",  # static kwarg that can't key a cache
+    "recompile-cache-churn",     # one op holding many compiled variants
+    "dtype-promotion-drift",     # jax result dtype != reference table
+    "aliased-buffer-mutation",   # input/param rebound during the call
+    "not-jittable",              # abstract trace failed (eager-only op)
+    "eager-fallback",            # op deny-listed from the op-call jit cache
+)
+
+
+class Finding:
+    """One hazard: (kind, message) plus where it was seen."""
+
+    __slots__ = ("kind", "message", "severity", "op", "site")
+
+    def __init__(self, kind, message, severity="warn", op=None, site=None):
+        self.kind = kind
+        self.message = message
+        self.severity = severity
+        self.op = op
+        self.site = site
+
+    def __repr__(self):
+        where = f" [{self.op}]" if self.op else ""
+        return f"<{self.severity}:{self.kind}{where} {self.message}>"
+
+    def _key(self):
+        return (self.kind, self.op, self.message)
+
+
+class AuditReport:
+    """Findings from one `audit()` call.
+
+    ``findings`` (and iteration/len) cover warn+error severities — the
+    contract a clean program must satisfy. ``notes`` carries info-severity
+    advisories that depend on global session state (deny lists fill as the
+    process runs) and therefore don't count against cleanliness.
+    """
+
+    def __init__(self, target_name):
+        self.target_name = target_name
+        self._all = []
+        self._seen = set()
+        self.jaxpr = None            # populated when the abstract trace ran
+
+    # -- recording ----------------------------------------------------------
+    def add(self, finding: Finding):
+        k = finding._key()
+        if k in self._seen:
+            return
+        self._seen.add(k)
+        self._all.append(finding)
+
+    def note(self, kind, message, severity="warn", op=None, site=None):
+        self.add(Finding(kind, message, severity=severity, op=op, site=site))
+
+    # -- reading ------------------------------------------------------------
+    @property
+    def findings(self):
+        return [f for f in self._all if f.severity in ("warn", "error")]
+
+    @property
+    def notes(self):
+        return [f for f in self._all if f.severity == "info"]
+
+    def by_kind(self, kind):
+        return [f for f in self._all if f.kind == kind]
+
+    def __iter__(self):
+        return iter(self.findings)
+
+    def __len__(self):
+        return len(self.findings)
+
+    def summary(self):
+        head = (f"audit({self.target_name}): {len(self.findings)} finding(s)"
+                f", {len(self.notes)} note(s)")
+        lines = [head]
+        for f in self._all:
+            lines.append(f"  {f!r}")
+        return "\n".join(lines)
+
+    __repr__ = summary

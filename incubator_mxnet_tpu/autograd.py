@@ -172,6 +172,14 @@ _VJP_FAILS: dict = {}
 _VJP_MAX_FAILS = 3  # transient remote-compile drops shouldn't deny forever
 
 
+def vjp_cache_info():
+    """Introspection for `analysis.jit_cache_report`: backward-applier
+    cache size and the denied keys (nodes whose backward re-runs the
+    forward eagerly every pass)."""
+    return {"size": len(_VJP_CACHE), "keys": list(_VJP_CACHE.keys()),
+            "denied": set(_VJP_DENY)}
+
+
 def _apply_vjp(node, arg):
     """Compute a node's input cotangents. For ops with a stable cache key
     (the numpy mapper path), the whole linearize+transpose is jit-compiled

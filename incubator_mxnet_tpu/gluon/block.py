@@ -146,6 +146,16 @@ class Block:
         for p in self.collect_params().values():
             p.zero_grad()
 
+    def audit(self, *args, train_mode=None, **kwargs):
+        """Audit this block's forward for compile-time hazards (host
+        syncs, recompilation churn, promotion drift, parameter mutation)
+        — `mx.analysis.audit(self, ...)`. Run a warmup forward first so
+        deferred parameter initialization doesn't show up as activity
+        inside the audited program."""
+        from .. import analysis
+
+        return analysis.audit(self, *args, train_mode=train_mode, **kwargs)
+
     # -- checkpointing (reference: block.py:340 save_parameters / :379) -----
     def save_parameters(self, filename, deduplicate=False):  # noqa: ARG002
         params = self.collect_params()

@@ -681,7 +681,10 @@ def _needs_static_big_index(key, shape):
     any_big = False
     for i, k in enumerate(keys):
         dim = shape[i] if i < len(shape) else 0
-        if isinstance(k, int):
+        if isinstance(k, int) and not isinstance(k, bool):
+            # bool excluded: True/False are numpy NEW-AXIS indexing, not
+            # row 1/0 — letting them leak into the int path silently
+            # reinterprets the index
             if abs(k) > _INT32_SAFE or (k < 0 and dim > _INT32_SAFE):
                 any_big = True
         elif isinstance(k, slice):
@@ -730,7 +733,7 @@ def _static_big_index(x, key):
     starts, stops, squeeze = [], [], []
     for ax, k in enumerate(keys):
         n = x.shape[ax]
-        if isinstance(k, int):
+        if isinstance(k, int) and not isinstance(k, bool):
             i = k + n if k < 0 else k
             starts.append(i)
             stops.append(i + 1)
@@ -843,6 +846,9 @@ def apply_op(name, jfn, args, kwargs=None, n_outputs=1, out=None,
     outs = _call_profiled(name, pure_fn, tensor_vals)
     tuple_out = isinstance(outs, tuple)
     out_list = list(outs) if tuple_out else [outs]
+    if _ANALYSIS_HOOK is not None:
+        _ANALYSIS_HOOK(name, tensor_vals, out_list,
+                       {"denied": name in _JIT_DENY})
 
     record = autograd.is_recording() and any(
         p._node is not None or p._grad is not None for p in parents)
@@ -870,6 +876,19 @@ _JIT_CACHE_CAP = 2048
 _JIT_DENY: set = set()
 _JIT_FAILS: dict = {}
 _JIT_MAX_FAILS = 3
+
+# Audit hook (analysis.audit): when set, every funnel invocation reports
+# (name, input values, output values, cache metadata) to the auditor. A
+# single `is not None` check is the entire hot-path cost when no audit is
+# running.
+_ANALYSIS_HOOK = None
+
+
+def jit_cache_info():
+    """Introspection for `analysis.jit_cache_report`: live cache keys and
+    the deny list (names that fell back to eager)."""
+    return {"size": len(_JIT_CACHE), "keys": list(_JIT_CACHE.keys()),
+            "denied": set(_JIT_DENY)}
 
 
 def _static_marker(a):
@@ -1022,6 +1041,10 @@ def apply_op_flat(name, jfn, args, kwargs=None, n_outputs=None,
         outs = _call_profiled(name, pure_fn, tensor_vals)
     tuple_out = isinstance(outs, tuple)
     out_list = list(outs) if tuple_out else [outs]
+    if _ANALYSIS_HOOK is not None:
+        _ANALYSIS_HOOK(name, tensor_vals, out_list,
+                       {"uncacheable": cacheable_now and cache_key is None,
+                        "denied": name in _JIT_DENY})
     wrapped = [NDArray(o) for o in out_list]
 
     if autograd.is_recording() and any(

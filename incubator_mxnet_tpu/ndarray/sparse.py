@@ -20,6 +20,8 @@ lowering), everything else falls back to dense compute.
 """
 from __future__ import annotations
 
+import numbers
+
 import numpy as onp
 
 from .ndarray import NDArray, apply_op
@@ -353,7 +355,12 @@ class CSRNDArray(NDArray):
         `src/operator/tensor/matrix_op.cc` slice on kCSRStorage) — indptr
         arithmetic only, no densify. Anything fancier falls back to the
         dense path."""
-        if isinstance(key, int):
+        # numbers.Integral admits numpy int scalars (onp.integer) into the
+        # indptr path alongside python int; bool is EXCLUDED — True/False
+        # are numpy new-axis indexing, not rows 1/0, and bool is an int
+        # subclass so a bare int check would leak them here (lint FL002)
+        if isinstance(key, numbers.Integral) and not isinstance(key, bool):
+            key = int(key)
             if not -self._sp_shape[0] <= key < self._sp_shape[0]:
                 raise IndexError(
                     f"index {key} out of bounds for axis 0 with size "
@@ -834,6 +841,16 @@ def sum(arr, axis=None, keepdims=False):  # noqa: A001
 
 def mean(arr, axis=None, keepdims=False):
     jnp = _jnp()
+    if isinstance(axis, (tuple, list)) \
+            and isinstance(arr, (CSRNDArray, RowSparseNDArray)):
+        # tuple-axis reduction has no sparse path (the reference's sparse
+        # sum kernels are single-axis too): take the dense storage
+        # fallback — `_data` logs the densify via
+        # MXNET_STORAGE_FALLBACK_LOG_VERBOSE — instead of letting the
+        # single-axis arithmetic below fail with a confusing TypeError
+        out = jnp.mean(arr._data, axis=tuple(int(a) for a in axis),
+                       keepdims=keepdims)
+        return NDArray(out)
     s = sum(arr, axis=axis, keepdims=keepdims)
     if axis is None:
         denom = float(onp.prod(arr.shape))

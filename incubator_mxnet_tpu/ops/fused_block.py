@@ -375,9 +375,13 @@ def gelu_dropout(u, p, seeds, interpret=None):
     rows = 1
     for s_ in shape[:-1]:
         rows *= s_
+    if rows == 0:
+        # empty batch: no grid to launch (block would be 0 → pad divides
+        # by zero); the contract output is just the empty input shape
+        return u
     u2d = u.reshape(rows, feat)
     block = _block_rows(rows, feat, u2d.dtype.itemsize)
-    pad = (-rows) % block
+    pad = (-rows) % block if block else 0
     if pad:
         u2d = jnp.pad(u2d, ((0, pad), (0, 0)))
     h = _gd_core(u2d, jnp.asarray(seeds, jnp.int32), float(p),
@@ -404,10 +408,14 @@ def residual_dropout_ln(x, h, gamma, beta, p, seeds, eps=1e-5,
     rows = 1
     for s_ in shape[:-1]:
         rows *= s_
+    if rows == 0:
+        # empty batch: no grid to launch (block would be 0 → pad divides
+        # by zero); ln of nothing is nothing
+        return x
     x2d = x.reshape(rows, feat)
     h2d = h.reshape(rows, feat)
     block = _block_rows(rows, feat, x2d.dtype.itemsize)
-    pad = (-rows) % block
+    pad = (-rows) % block if block else 0
     if pad:
         x2d = jnp.pad(x2d, ((0, pad), (0, 0)))
         h2d = jnp.pad(h2d, ((0, pad), (0, 0)))

@@ -145,6 +145,10 @@ _ENV_KNOBS = {
     "MXNET_RNG_IMPL": (
         "random.py", "threefry/rbg PRNG implementation choice (honored, "
         "this build's addition)"),
+    "MXNET_ANALYSIS": (
+        "analysis.audit", "warn|raise: program-auditor findings are logged "
+        "as warnings or raised as MXNetError; unset returns reports "
+        "silently (honored, this build's addition — see ANALYSIS.md)"),
     "MXNET_LOCAL_RANK": (
         "kvstore horovod facade / tools/launch.py", "rank within host "
         "(honored, exported by the launcher)"),

@@ -62,6 +62,10 @@ _QUICK = {
     "test_graph_ops.py::test_edge_id",
     "test_contrib_ops_depth.py::test_quadratic",
     "test_legacy_ops_depth.py::test_slice_axis_reverse_crop",
+    # static-analysis subsystem: whole-tree framework lint + auditor smoke
+    # on a hybridized model_zoo block (ISSUE 1 CI gates)
+    "test_analysis.py::test_framework_lint_tree_is_clean",
+    "test_analysis.py::test_audit_hybridized_model_zoo_clean",
 }
 
 

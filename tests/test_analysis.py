@@ -1,0 +1,394 @@
+"""Static-analysis subsystem: auditor hazard classes (one positive + one
+clean case per class), framework-lint rules (fixture snippets that must
+trip each rule + the real pre-fix hazards), regression tests for the
+advisor-found fixes that seeded the lint rules, and the tier-1 smokes
+(lint over the whole package, audit of a hybridized model_zoo block)."""
+import os
+import subprocess
+import sys
+
+import numpy as onp
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import np as mnp
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _lint():
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import framework_lint
+    finally:
+        sys.path.pop(0)
+    return framework_lint
+
+
+def _kinds(report):
+    return {f.kind for f in report}
+
+
+# ---------------------------------------------------------------------------
+# auditor: host-sync
+# ---------------------------------------------------------------------------
+
+def test_audit_host_sync_positive():
+    def f(x):
+        if float(x.sum()) > 0:      # device->host sync in the hot path
+            return x + 1
+        return x
+
+    rep = mx.analysis.audit(f, mnp.ones((4, 4)))
+    assert "host-sync" in _kinds(rep)
+    # the abstract trace must also catch it as a definite error
+    assert any(f_.severity == "error" for f_ in rep.by_kind("host-sync"))
+
+
+def test_audit_host_sync_clean():
+    def f(x):
+        return (x * 2 + 1).sum()
+
+    rep = mx.analysis.audit(f, mnp.ones((4, 4)))
+    assert len(rep) == 0
+    assert rep.jaxpr is not None     # traced clean end-to-end
+
+
+def test_audit_host_sync_in_block_forward():
+    from incubator_mxnet_tpu import gluon
+
+    class Syncy(gluon.HybridBlock):
+        def forward(self, x):
+            return x * float(x.max())        # sync inside forward
+
+    net = Syncy()
+    net.initialize()
+    x = mnp.ones((2, 3))
+    net(x)
+    rep = net.audit(x)
+    assert "host-sync" in _kinds(rep)
+
+
+# ---------------------------------------------------------------------------
+# auditor: recompilation hazards
+# ---------------------------------------------------------------------------
+
+def test_audit_python_scalar_arg_positive_and_clean():
+    def f(x, s):
+        return x * s
+
+    rep = mx.analysis.audit(f, mnp.ones((2, 2)), 3.14)
+    assert "recompile-python-scalar" in _kinds(rep)
+
+    rep2 = mx.analysis.audit(f, mnp.ones((2, 2)), mnp.array(3.14))
+    assert "recompile-python-scalar" not in _kinds(rep2)
+
+
+def test_audit_weak_type_positive_and_clean():
+    import jax.numpy as jnp
+
+    def f(x):
+        return x + 1
+
+    weak = mx.nd.NDArray(jnp.asarray(2.0))          # weak-typed buffer
+    assert weak._data.weak_type
+    rep = mx.analysis.audit(f, weak)
+    assert "recompile-weak-type" in _kinds(rep)
+
+    strong = mnp.array([2.0], dtype="float32")
+    rep2 = mx.analysis.audit(f, strong)
+    assert "recompile-weak-type" not in _kinds(rep2)
+
+
+def test_audit_unhashable_static_kwarg_positive_and_clean():
+    def f(x, cfg=None):
+        return x * 2 if cfg else x
+
+    rep = mx.analysis.audit(f, mnp.ones((2, 2)), cfg=[1, 2])
+    assert "recompile-unhashable-static" in _kinds(rep)
+
+    rep2 = mx.analysis.audit(f, mnp.ones((2, 2)), cfg=(1, 2))
+    assert "recompile-unhashable-static" not in _kinds(rep2)
+
+
+def test_jit_cache_report_flags_scalar_churn():
+    x = mnp.ones((4,))
+    for i in range(10):
+        mnp.add(x, 0.125 + i)        # distinct static scalar per call
+    rep = mx.analysis.jit_cache_report(threshold=8)
+    assert any(f.kind == "recompile-cache-churn" and f.op == "add"
+               for f in rep)
+
+
+# ---------------------------------------------------------------------------
+# auditor: dtype promotion drift + buffer mutation
+# ---------------------------------------------------------------------------
+
+def test_audit_promotion_drift_positive_and_clean():
+    def f(a, b):
+        return a / b
+
+    a = mnp.array([1, 2], dtype="int32")
+    b = mnp.array([2, 2], dtype="int32")
+    rep = mx.analysis.audit(f, a, b)
+    # reference table: true_divide(int32, int32) -> float64; jax -> float32
+    assert "dtype-promotion-drift" in _kinds(rep)
+
+    def g(a, b):
+        return a + b
+
+    af = mnp.array([1.0, 2.0], dtype="float32")
+    rep2 = mx.analysis.audit(g, af, af)
+    assert len(rep2) == 0
+
+
+def test_audit_buffer_mutation_positive_and_clean():
+    def f(x):
+        x += 1                       # in-place rebind of the input buffer
+        return x
+
+    rep = mx.analysis.audit(f, mnp.ones((2, 2)))
+    assert "aliased-buffer-mutation" in _kinds(rep)
+
+    def g(x):
+        return x + 1
+
+    rep2 = mx.analysis.audit(g, mnp.ones((2, 2)))
+    assert "aliased-buffer-mutation" not in _kinds(rep2)
+
+
+# ---------------------------------------------------------------------------
+# auditor: MXNET_ANALYSIS knob
+# ---------------------------------------------------------------------------
+
+def _sync_fn(x):
+    return x + float(x.sum())
+
+
+def test_analysis_knob_raise(monkeypatch):
+    monkeypatch.setenv("MXNET_ANALYSIS", "raise")
+    with pytest.raises(mx.MXNetError, match="MXNET_ANALYSIS=raise"):
+        mx.analysis.audit(_sync_fn, mnp.ones((2, 2)))
+
+
+def test_analysis_knob_warn_logs(monkeypatch, caplog):
+    import logging
+
+    monkeypatch.setenv("MXNET_ANALYSIS", "warn")
+    with caplog.at_level(logging.WARNING, "incubator_mxnet_tpu.analysis"):
+        rep = mx.analysis.audit(_sync_fn, mnp.ones((2, 2)))
+    assert len(rep) > 0
+    assert any("host-sync" in r.message for r in caplog.records)
+
+
+def test_analysis_knob_documented():
+    from incubator_mxnet_tpu import util
+
+    how, doc = util.env_knobs()["MXNET_ANALYSIS"]
+    assert "analysis" in how and "raise" in doc
+
+
+# ---------------------------------------------------------------------------
+# framework lint: rule fixtures
+# ---------------------------------------------------------------------------
+
+def test_lint_fl001_pad_guard():
+    fl = _lint()
+    bad = ("def pick(rows, block):\n"
+           "    pad = (-rows) % block\n"
+           "    return pad\n")
+    hits = fl.lint_source(bad, "x.py")
+    assert [h.rule for h in hits] == ["FL001"]
+    good = ("def pick(rows, block):\n"
+            "    pad = (-rows) % block if block else 0\n"
+            "    return pad\n")
+    assert fl.lint_source(good, "x.py") == []
+
+
+def test_lint_fl002_bool_leak():
+    fl = _lint()
+    bad = ("class A:\n"
+           "    def __getitem__(self, key):\n"
+           "        if isinstance(key, int):\n"
+           "            return key\n"
+           "        return None\n")
+    hits = fl.lint_source(bad, "x.py")
+    assert [h.rule for h in hits] == ["FL002"]
+    guarded = ("class A:\n"
+               "    def __getitem__(self, key):\n"
+               "        if isinstance(key, int) and not "
+               "isinstance(key, bool):\n"
+               "            return key\n"
+               "        return None\n")
+    assert fl.lint_source(guarded, "x.py") == []
+    # same pattern outside an indexing-path function: not the rule's scope
+    other = ("def compute(key):\n"
+             "    return isinstance(key, int)\n")
+    assert fl.lint_source(other, "x.py") == []
+
+
+def test_lint_fl003_host_numpy_in_ops():
+    fl = _lint()
+    bad = ("import numpy as onp\n"
+           "def _fwd_kernel(x):\n"
+           "    return onp.zeros((2, 2))\n")
+    hits = fl.lint_source(bad, "incubator_mxnet_tpu/ops/fake.py")
+    assert [h.rule for h in hits] == ["FL003"]
+    # float0 cotangent zeros are the jax-mandated exemption
+    exempt = ("import numpy as onp\n"
+              "import jax\n"
+              "def _bwd(seeds):\n"
+              "    return onp.zeros(seeds.shape, jax.dtypes.float0)\n")
+    assert fl.lint_source(exempt, "incubator_mxnet_tpu/ops/fake.py") == []
+    # outside ops/: not the rule's scope
+    assert fl.lint_source(bad, "incubator_mxnet_tpu/image.py") == []
+
+
+def test_lint_fl004_ops_ledger():
+    fl = _lint()
+    src = 'register_op_meta("bogus_xyz_op", "np", None)\n'
+    hits = fl.lint_source(src, "x.py", coverage_text="| `add` | ... |")
+    assert [h.rule for h in hits] == ["FL004"]
+    assert fl.lint_source(src, "x.py",
+                          coverage_text="| `bogus_xyz_op` | ... |") == []
+    # no coverage text available -> rule is skipped, not spuriously firing
+    assert fl.lint_source(src, "x.py") == []
+
+
+# ---------------------------------------------------------------------------
+# framework lint: the real pre-fix hazards must trip, the fixed tree not
+# ---------------------------------------------------------------------------
+
+def test_lint_flags_prefix_fused_block_pad():
+    fl = _lint()
+    path = os.path.join(REPO, "incubator_mxnet_tpu/ops/fused_block.py")
+    fixed = open(path).read()
+    assert "pad = (-rows) % block if block else 0" in fixed
+    assert fl.lint_file(path) == []
+    prefix = fixed.replace("pad = (-rows) % block if block else 0",
+                           "pad = (-rows) % block")
+    hits = fl.lint_source(prefix, path)
+    assert [h.rule for h in hits] == ["FL001", "FL001"]
+
+
+def test_lint_flags_prefix_sparse_isinstance_int():
+    fl = _lint()
+    path = os.path.join(REPO, "incubator_mxnet_tpu/ndarray/sparse.py")
+    fixed = open(path).read()
+    assert fl.lint_file(path) == []
+    prefix = fixed.replace(
+        "if isinstance(key, numbers.Integral) and not isinstance(key, bool):"
+        "\n            key = int(key)",
+        "if isinstance(key, int):")
+    assert prefix != fixed
+    hits = fl.lint_source(prefix, path)
+    assert [h.rule for h in hits] == ["FL002"]
+
+
+def test_framework_lint_tree_is_clean():
+    """Tier-1 gate: the committed tree passes its own lint."""
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "framework_lint.py"),
+         "incubator_mxnet_tpu/"],
+        cwd=REPO, capture_output=True, text=True)
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "clean" in out.stdout
+
+
+def test_framework_lint_list_rules():
+    fl = _lint()
+    assert set(fl.RULES) == {"FL001", "FL002", "FL003", "FL004"}
+
+
+# ---------------------------------------------------------------------------
+# regressions: the fixes the lint rules were learned from
+# ---------------------------------------------------------------------------
+
+def test_fused_block_empty_batch():
+    import jax.numpy as jnp
+
+    from incubator_mxnet_tpu.ops import fused_block as fb
+
+    # interpret=False exercises the padding path that used to divide by 0
+    for interpret in (False, None):
+        out = fb.gelu_dropout(jnp.zeros((0, 256)), 0.1, (0, 1),
+                              interpret=interpret)
+        assert out.shape == (0, 256)
+        out2 = fb.residual_dropout_ln(
+            jnp.zeros((0, 256)), jnp.zeros((0, 256)), jnp.ones(256),
+            jnp.zeros(256), 0.1, (0, 1), interpret=interpret)
+        assert out2.shape == (0, 256)
+    # 3-D empty leading axes collapse to zero rows too
+    out3 = fb.gelu_dropout(jnp.zeros((2, 0, 128)), 0.5, (3, 4),
+                           interpret=False)
+    assert out3.shape == (2, 0, 128)
+
+
+def test_sparse_mean_tuple_axis():
+    from incubator_mxnet_tpu.ndarray import sparse
+
+    d = onp.arange(12, dtype="float32").reshape(3, 4)
+    d[d % 3 == 0] = 0
+    csr = sparse.csr_matrix(d)
+    onp.testing.assert_allclose(
+        sparse.mean(csr, axis=(0, 1)).asnumpy(), d.mean(axis=(0, 1)),
+        rtol=1e-6)
+    onp.testing.assert_allclose(
+        sparse.mean(csr, axis=(0, 1), keepdims=True).asnumpy(),
+        d.mean(axis=(0, 1), keepdims=True), rtol=1e-6)
+    rsp = mx.nd.NDArray(d).tostype("row_sparse")
+    onp.testing.assert_allclose(
+        sparse.mean(rsp, axis=[0, 1]).asnumpy(), d.mean(axis=(0, 1)),
+        rtol=1e-6)
+    # single-axis path unchanged
+    onp.testing.assert_allclose(
+        sparse.mean(csr, axis=0).asnumpy(), d.mean(axis=0), rtol=1e-6)
+
+
+def test_csr_getitem_numpy_int_takes_indptr_path():
+    from incubator_mxnet_tpu.ndarray.ndarray import NDArray
+    from incubator_mxnet_tpu.ndarray import sparse
+
+    d = onp.arange(12, dtype="float32").reshape(3, 4)
+    d[d % 3 == 0] = 0
+    csr = sparse.csr_matrix(d)
+    for key in (onp.int64(1), onp.int32(1), 1):
+        row = csr[key]
+        assert isinstance(row, sparse.CSRNDArray)
+        onp.testing.assert_allclose(row.asnumpy(), d[1:2])
+    # negative numpy int: same normalization as python int
+    row = csr[onp.int64(-1)]
+    assert isinstance(row, sparse.CSRNDArray)
+    onp.testing.assert_allclose(row.asnumpy(), d[-1:])
+    # the integer path never touched the dense buffer
+    fresh = sparse.csr_matrix(d)
+    _ = fresh[onp.int64(0)]
+    assert NDArray._data.__get__(fresh) is None
+    # bool is NOT an integer index (numpy new-axis semantics): dense path
+    out = csr[True]
+    assert not isinstance(out, sparse.CSRNDArray)
+
+
+def test_big_index_helpers_exclude_bool():
+    from incubator_mxnet_tpu.ndarray.ndarray import _needs_static_big_index
+
+    big = 2 ** 40
+    assert not _needs_static_big_index(True, (big,))
+    assert _needs_static_big_index(2 ** 35, (big,))
+
+
+# ---------------------------------------------------------------------------
+# tier-1 smoke: hybridized model_zoo block audits clean in eval mode
+# ---------------------------------------------------------------------------
+
+def test_audit_hybridized_model_zoo_clean():
+    from incubator_mxnet_tpu.gluon.model_zoo import vision as zoo
+
+    net = zoo.squeezenet1_1()
+    net.initialize()
+    net.hybridize()
+    x = mnp.ones((1, 3, 64, 64), dtype="float32")
+    net(x)                           # warmup: deferred init + cached graph
+    rep = net.audit(x)               # eval mode (no record scope)
+    assert len(rep) == 0, rep.summary()
+    assert rep.jaxpr is not None

@@ -1,0 +1,328 @@
+"""Framework lint: AST-based invariant checks over the framework source.
+
+Companion to `incubator_mxnet_tpu.analysis` (the *program* auditor): this
+tool audits the *framework source itself* for invariants learned from real
+bugs, without importing anything it scans (pure `ast` — safe to run in CI
+before the package can even import).
+
+Rules
+-----
+FL001  pallas pad guard: ``pad = (-rows) % block`` must carry the
+       ``if block else 0`` guard (``layer_norm.py`` idiom). An unguarded
+       negate-mod ZeroDivisionErrors on empty inputs (the advisor-found
+       `ops/fused_block.py` empty-batch crash).
+FL002  bool leak: bare ``isinstance(key, int)`` in indexing-path functions
+       (name contains getitem/setitem/index/slice). `bool` is a subclass of
+       `int`, and True/False are numpy NEW-AXIS indexing — an int check
+       without a bool exclusion silently reinterprets the index. Use
+       ``numbers.Integral`` with an explicit ``isinstance(x, bool)`` guard.
+FL003  host numpy in kernel-reachable op bodies: ``numpy.*`` calls inside
+       function bodies of ``ops/`` modules force host constant-folding in
+       traced code. Exemption: `jax.dtypes.float0` cotangent zeros, which
+       jax REQUIRES to be numpy arrays.
+FL004  ledger completeness: every statically-registered op name
+       (literal `register_op_meta(...)` calls and the
+       `_ELEMWISE_AND_FRIENDS` generation list) must appear in
+       OPS_COVERAGE.md — the audit trail must not silently lag the code.
+
+Usage
+-----
+    python tools/framework_lint.py incubator_mxnet_tpu/ [more paths...]
+                                   [--coverage OPS_COVERAGE.md]
+                                   [--list-rules]
+
+Exit status 0 when clean, 1 when any rule fires.
+"""
+from __future__ import annotations
+
+import argparse
+import ast
+import os
+import sys
+
+RULES = {
+    "FL001": "pallas pad computation must be guarded: "
+             "`pad = (-rows) % block if block else 0`",
+    "FL002": "bare isinstance(x, int) in an indexing-path function "
+             "(bool leaks into the int path)",
+    "FL003": "host numpy call inside an ops/ kernel-reachable body "
+             "(float0 cotangents exempt)",
+    "FL004": "registered op name missing from OPS_COVERAGE.md",
+}
+
+_INDEXING_NAME_PARTS = ("getitem", "setitem", "index", "slice")
+
+
+class LintFinding:
+    __slots__ = ("path", "line", "rule", "message")
+
+    def __init__(self, path, line, rule, message):
+        self.path = path
+        self.line = line
+        self.rule = rule
+        self.message = message
+
+    def __repr__(self):
+        return f"{self.path}:{self.line}: {self.rule} {self.message}"
+
+
+# ---------------------------------------------------------------------------
+# FL001 — pad guard
+# ---------------------------------------------------------------------------
+
+def _is_neg_mod(node):
+    """Matches `(-X) % Y`."""
+    return (isinstance(node, ast.BinOp) and isinstance(node.op, ast.Mod)
+            and isinstance(node.left, ast.UnaryOp)
+            and isinstance(node.left.op, ast.USub))
+
+
+def _check_pad_guard(tree, path, findings):
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+            continue
+        value = node.value
+        if value is None:
+            continue
+        if isinstance(value, ast.IfExp):
+            continue                      # guarded form: `... if block else 0`
+        if _is_neg_mod(value):
+            findings.append(LintFinding(
+                path, value.lineno, "FL001",
+                f"unguarded `{ast.unparse(value)}`: ZeroDivisionError when "
+                "the block size is 0 (empty input); write "
+                f"`{ast.unparse(value)} if "
+                f"{ast.unparse(value.right)} else 0` and early-return the "
+                "empty result (see ops/layer_norm.py)"))
+
+
+# ---------------------------------------------------------------------------
+# FL002 — isinstance-int bool leak in indexing paths
+# ---------------------------------------------------------------------------
+
+def _isinstance_target_types(call):
+    """For `isinstance(x, T)` return the set of plain type names tested."""
+    names = set()
+    t = call.args[1]
+    elts = t.elts if isinstance(t, ast.Tuple) else [t]
+    for e in elts:
+        if isinstance(e, ast.Name):
+            names.add(e.id)
+    return names
+
+
+def _check_bool_leak(tree, path, findings):
+    seen = set()
+    for fn in ast.walk(tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        lowered = fn.name.lower()
+        if not any(part in lowered for part in _INDEXING_NAME_PARTS):
+            continue
+        int_checks = []      # (call node, var source)
+        bool_checked = set()  # var sources with an isinstance(x, bool) test
+        for node in ast.walk(fn):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Name)
+                    and node.func.id == "isinstance"
+                    and len(node.args) == 2):
+                continue
+            var = ast.unparse(node.args[0])
+            types = _isinstance_target_types(node)
+            if "bool" in types:
+                bool_checked.add(var)
+            elif "int" in types:
+                int_checks.append((node, var))
+        for node, var in int_checks:
+            if var in bool_checked:
+                continue
+            key = (path, node.lineno)
+            if key in seen:
+                continue
+            seen.add(key)
+            findings.append(LintFinding(
+                path, node.lineno, "FL002",
+                f"`isinstance({var}, int)` in indexing path `{fn.name}`: "
+                "bool is a subclass of int, so True/False (numpy new-axis "
+                "indices) leak into the integer path — exclude bool "
+                "explicitly or test numbers.Integral with a bool guard"))
+
+
+# ---------------------------------------------------------------------------
+# FL003 — host numpy inside ops/ kernel-reachable bodies
+# ---------------------------------------------------------------------------
+
+def _numpy_aliases(tree):
+    aliases = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == "numpy":
+                    aliases.add(a.asname or "numpy")
+    return aliases
+
+
+def _mentions_float0(node):
+    return any(isinstance(n, ast.Attribute) and n.attr == "float0"
+               for n in ast.walk(node))
+
+
+def _check_host_numpy(tree, path, findings):
+    norm = path.replace(os.sep, "/")
+    if "/ops/" not in norm:
+        return
+    aliases = _numpy_aliases(tree)
+    if not aliases:
+        return
+    for fn in ast.walk(tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        for node in ast.walk(fn):
+            if (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and isinstance(node.func.value, ast.Name)
+                    and node.func.value.id in aliases
+                    and not _mentions_float0(node)):
+                findings.append(LintFinding(
+                    path, node.lineno, "FL003",
+                    f"host numpy call `{ast.unparse(node.func)}` inside "
+                    f"`{fn.name}` in an ops/ module: traced code would "
+                    "constant-fold on host (or fail); use jnp, or keep "
+                    "host math out of kernel-reachable bodies"))
+
+
+# ---------------------------------------------------------------------------
+# FL004 — registered op names present in OPS_COVERAGE.md
+# ---------------------------------------------------------------------------
+
+def collect_registered_ops(tree):
+    """Statically-visible op registrations: literal first args of
+    `register_op_meta(...)` plus the `_ELEMWISE_AND_FRIENDS` generation
+    list (the two registration idioms of this codebase)."""
+    names = set()
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.Call) and isinstance(node.func, ast.Name)
+                and node.func.id == "register_op_meta" and node.args
+                and isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, str)):
+            names.add((node.args[0].value, node.args[0].lineno))
+        if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and node.targets[0].id == "_ELEMWISE_AND_FRIENDS"
+                and isinstance(node.value, (ast.List, ast.Tuple))):
+            for e in node.value.elts:
+                if isinstance(e, ast.Constant) and isinstance(e.value, str):
+                    names.add((e.value, e.lineno))
+    return names
+
+
+def _check_ops_ledger(tree, path, findings, coverage_text):
+    if coverage_text is None:
+        return
+    for name, lineno in sorted(collect_registered_ops(tree)):
+        if name not in coverage_text:
+            findings.append(LintFinding(
+                path, lineno, "FL004",
+                f"registered op `{name}` is not recorded in "
+                "OPS_COVERAGE.md — regenerate/extend the ledger so the "
+                "audit trail tracks the code"))
+
+
+# ---------------------------------------------------------------------------
+# driver
+# ---------------------------------------------------------------------------
+
+def lint_source(src, path, coverage_text=None):
+    """Lint one source string; `path` is used for reporting and for the
+    ops/-scoped rules. Returns a list of LintFinding."""
+    findings = []
+    try:
+        tree = ast.parse(src, filename=path)
+    except SyntaxError as e:
+        findings.append(LintFinding(path, e.lineno or 0, "FL000",
+                                    f"syntax error: {e.msg}"))
+        return findings
+    _check_pad_guard(tree, path, findings)
+    _check_bool_leak(tree, path, findings)
+    _check_host_numpy(tree, path, findings)
+    _check_ops_ledger(tree, path, findings, coverage_text)
+    return findings
+
+
+def lint_file(path, coverage_text=None):
+    with open(path, encoding="utf-8") as f:
+        return lint_source(f.read(), path, coverage_text=coverage_text)
+
+
+def _iter_py_files(paths):
+    for p in paths:
+        if os.path.isfile(p):
+            if p.endswith(".py"):
+                yield p
+            continue
+        for root, dirs, files in os.walk(p):
+            dirs[:] = [d for d in dirs
+                       if d not in ("__pycache__", ".git", "build")]
+            for f in sorted(files):
+                if f.endswith(".py"):
+                    yield os.path.join(root, f)
+
+
+def _find_coverage(paths, explicit):
+    if explicit:
+        return explicit
+    candidates = [os.getcwd()]
+    candidates += [os.path.abspath(p) for p in paths]
+    candidates.append(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    for c in candidates:
+        d = c if os.path.isdir(c) else os.path.dirname(c)
+        while True:
+            probe = os.path.join(d, "OPS_COVERAGE.md")
+            if os.path.isfile(probe):
+                return probe
+            parent = os.path.dirname(d)
+            if parent == d:
+                break
+            d = parent
+    return None
+
+
+def lint_paths(paths, coverage_path=None):
+    coverage_text = None
+    cov = _find_coverage(paths, coverage_path)
+    if cov is not None:
+        with open(cov, encoding="utf-8") as f:
+            coverage_text = f.read()
+    findings = []
+    for path in _iter_py_files(paths):
+        findings.extend(lint_file(path, coverage_text=coverage_text))
+    return findings
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="AST-based framework lint (see module docstring)")
+    ap.add_argument("paths", nargs="*", default=["incubator_mxnet_tpu"],
+                    help="files or directories to lint")
+    ap.add_argument("--coverage", default=None,
+                    help="path to OPS_COVERAGE.md (default: auto-discover)")
+    ap.add_argument("--list-rules", action="store_true")
+    args = ap.parse_args(argv)
+    if args.list_rules:
+        for rid, doc in sorted(RULES.items()):
+            print(f"{rid}  {doc}")
+        return 0
+    findings = lint_paths(args.paths or ["incubator_mxnet_tpu"],
+                          coverage_path=args.coverage)
+    for f in findings:
+        print(f)
+    if findings:
+        print(f"framework_lint: {len(findings)} finding(s)")
+        return 1
+    print("framework_lint: clean")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
