@@ -320,10 +320,13 @@ class KVStoreDist(_SingleProcessStore):
         waitall()
         # sync point doubles as the command channel: queued
         # profile_process='server' commands ship and apply here
-        # (reference: KVStoreServerProfilerCommand on ps-lite messages)
+        # (reference: KVStoreServerProfilerCommand on ps-lite messages),
+        # and telemetry rank-stat summaries ride the same collective
         from .. import profiler
+        from ..telemetry import monitor as _telem_monitor
 
         profiler.sync_remote_commands()
+        _telem_monitor.sync_rank_stats()
         self._dist.barrier()
 
 

@@ -37,6 +37,10 @@ def _jnp():
 
 _TRACER_T = None
 
+# host->device byte accounting (telemetry.registry installs
+# `add_h2d_bytes` here at import; None = off, one is-None check per inlet)
+_H2D_HOOK = None
+
 
 def _is_tracer(x) -> bool:
     global _TRACER_T
@@ -74,12 +78,19 @@ class NDArray:
         if isinstance(data, NDArray):
             data = data._data
         if dtype is not None:
+            from_host = not isinstance(data, _jax_array_t())
             data = _jnp().asarray(data, dtype=np_dtype(dtype))
         elif not isinstance(data, _jax_array_t()):
             # hot path: op outputs are already jax arrays/tracers —
             # re-running asarray per wrap costs an eager
             # convert_element_type dispatch (VERDICT r4 weak #2)
+            from_host = True
             data = _jnp().asarray(data)
+        else:
+            from_host = False
+        if from_host and _H2D_HOOK is not None and not _is_tracer(data):
+            # host->device inlet: telemetry mx_h2d_bytes_total
+            _H2D_HOOK(data.nbytes)
         if device is not None and not _is_tracer(data):
             import jax
 
@@ -193,6 +204,8 @@ class NDArray:
 
         if _is_tracer(self._data):
             return self
+        if _H2D_HOOK is not None:
+            _H2D_HOOK(self._data.nbytes)
         out = NDArray(jax.device_put(self._data, Device(device).jax_device))
         out._device = Device(device)
         return out
@@ -821,12 +834,18 @@ def apply_op(name, jfn, args, kwargs=None, n_outputs=1, out=None,
     call is timed and fed to `profiler.record_op` — dispatch+trace time, since
     execution itself is async on the device stream.
     """
+    sh = _STAGE_HOOK     # stage trace: dead branches when None (the default)
+    t = time.perf_counter_ns() if sh is not None else 0
     kwargs = kwargs or {}
     tensor_idx = [i for i, a in enumerate(args) if isinstance(a, NDArray)]
     parents = [args[i] for i in tensor_idx]
     tensor_vals = [p._data for p in parents]
     static_args = [None if isinstance(a, NDArray) else a for a in args]
+    if sh is not None:
+        t = sh("prologue", t)
     amp_mode = _amp_mode(name)
+    if sh is not None:
+        t = sh("amp_lookup", t)
 
     def pure_fn(*tvals):
         if amp_mode is not None:
@@ -844,15 +863,21 @@ def apply_op(name, jfn, args, kwargs=None, n_outputs=1, out=None,
         pure_fn = _outline_op(name, pure_fn, static_info)
 
     outs = _call_profiled(name, pure_fn, tensor_vals)
+    if sh is not None:
+        t = sh("dispatch", t)
     tuple_out = isinstance(outs, tuple)
     out_list = list(outs) if tuple_out else [outs]
     if _ANALYSIS_HOOK is not None:
         _ANALYSIS_HOOK(name, tensor_vals, out_list,
                        {"denied": name in _JIT_DENY})
+    if _MONITOR_HOOK is not None:
+        _MONITOR_HOOK(name, out_list)
 
     record = autograd.is_recording() and any(
         p._node is not None or p._grad is not None for p in parents)
     wrapped = [NDArray(o) if not isinstance(o, NDArray) else o for o in out_list]
+    if sh is not None:
+        t = sh("wrap", t)
     if record:
         node = TapeNode(pure_fn, tensor_vals, parents, len(out_list), name)
         node.out_avals = [_ShapeDtype(o) for o in out_list]
@@ -860,6 +885,8 @@ def apply_op(name, jfn, args, kwargs=None, n_outputs=1, out=None,
         for i, w in enumerate(wrapped):
             w._node = node
             w._out_idx = i
+        if sh is not None:
+            sh("tape", t)
 
     if out is not None:
         targets = out if isinstance(out, (list, tuple)) else [out]
@@ -876,6 +903,8 @@ _JIT_CACHE_CAP = 2048
 _JIT_DENY: set = set()
 _JIT_FAILS: dict = {}
 _JIT_MAX_FAILS = 3
+_JIT_HITS = 0
+_JIT_MISSES = 0
 
 # Audit hook (analysis.audit): when set, every funnel invocation reports
 # (name, input values, output values, cache metadata) to the auditor. A
@@ -883,12 +912,28 @@ _JIT_MAX_FAILS = 3
 # running.
 _ANALYSIS_HOOK = None
 
+# Telemetry hooks (telemetry/): same discipline as _ANALYSIS_HOOK — the
+# off state is None and every probe site is one load + `is not None`.
+# _STAGE_HOOK: stages._record(stage, t0_ns) -> now_ns (funnel breakdown)
+# _MONITOR_HOOK: monitor._observe(name, out_vals) (health stats/NaN guard)
+_STAGE_HOOK = None
+_MONITOR_HOOK = None
+
+
+def _telemetry_registry():
+    """The telemetry registry iff imported — rare-event call sites only
+    (first-compile timing, host->device transfers), never the per-op path."""
+    mod = sys.modules.get("incubator_mxnet_tpu.telemetry.registry")
+    return mod
+
 
 def jit_cache_info():
-    """Introspection for `analysis.jit_cache_report`: live cache keys and
-    the deny list (names that fell back to eager)."""
+    """Introspection for `analysis.jit_cache_report` and the telemetry
+    registry: live cache keys, the deny list (names that fell back to
+    eager), and cumulative hit/miss counts."""
     return {"size": len(_JIT_CACHE), "keys": list(_JIT_CACHE.keys()),
-            "denied": set(_JIT_DENY)}
+            "denied": set(_JIT_DENY), "hits": _JIT_HITS,
+            "misses": _JIT_MISSES}
 
 
 def _static_marker(a):
@@ -940,10 +985,13 @@ def _cached_jit(name, key, pure_fn, call_vals):
     closed over in the jfn MUST NOT opt in."""
     if name in _JIT_DENY:
         return None
+    global _JIT_HITS, _JIT_MISSES
     import jax
 
     jitted = _JIT_CACHE.get(key)
-    if jitted is None:
+    fresh = jitted is None
+    if fresh:
+        _JIT_MISSES += 1
         if len(_JIT_CACHE) >= _JIT_CACHE_CAP:
             # scalar-valued keys can be unbounded (e.g. x * python_scalar
             # with a per-step value) — drop the oldest half, insertion order
@@ -951,10 +999,20 @@ def _cached_jit(name, key, pure_fn, call_vals):
                 _JIT_CACHE.pop(stale, None)
         jitted = jax.jit(pure_fn)
         _JIT_CACHE[key] = jitted
+        t0 = time.perf_counter()
+    else:
+        _JIT_HITS += 1
     try:
         outs = jitted(*call_vals)
         leaves = outs if isinstance(outs, tuple) else (outs,)
         if all(isinstance(o, jax.Array) for o in leaves):
+            if fresh:
+                telem = _telemetry_registry()
+                if telem is not None:
+                    # first call = trace+compile (per (op, static-key)
+                    # program; jax's own aval cache makes later shape
+                    # recompiles invisible here — documented in TELEMETRY.md)
+                    telem.observe_compile(name, time.perf_counter() - t0)
             return outs
     except (jax.errors.JAXTypeError, TypeError):
         # dynamic-shape ops (unique, nonzero, boolean indexing…) trace-fail
@@ -989,6 +1047,8 @@ def apply_op_flat(name, jfn, args, kwargs=None, n_outputs=None,
                   cacheable=False):
     """Like apply_op but flattens NDArrays nested one level inside list/tuple
     positional args (e.g. ``concatenate([a, b], axis=0)``)."""
+    sh = _STAGE_HOOK     # stage trace: dead branches when None (the default)
+    t = time.perf_counter_ns() if sh is not None else 0
     kwargs = kwargs or {}
     paths = []       # (i,) or (i, j) positions of NDArray leaves
     parents = []
@@ -1008,8 +1068,12 @@ def apply_op_flat(name, jfn, args, kwargs=None, n_outputs=None,
                    else ([None if isinstance(b, NDArray) else b for b in a]
                          if isinstance(a, (list, tuple)) else a)
                    for a in args]
+    if sh is not None:
+        t = sh("prologue", t)
 
     amp_mode = _amp_mode(name)
+    if sh is not None:
+        t = sh("amp_lookup", t)
 
     def pure_fn(*tvals):
         if amp_mode is not None:
@@ -1031,6 +1095,8 @@ def apply_op_flat(name, jfn, args, kwargs=None, n_outputs=None,
             cache_key = _op_cache_key(jfn, name, args, kwargs, amp_mode)
         except TypeError:
             cache_key = None
+    if sh is not None:
+        t = sh("cache_key", t)
     if cache_key is not None:
         prof = _active_profiler()
         t0 = time.perf_counter() if prof is not None else 0
@@ -1039,13 +1105,19 @@ def apply_op_flat(name, jfn, args, kwargs=None, n_outputs=None,
             prof.record_op(name, time.perf_counter() - t0)
     if outs is None:
         outs = _call_profiled(name, pure_fn, tensor_vals)
+    if sh is not None:
+        t = sh("dispatch", t)
     tuple_out = isinstance(outs, tuple)
     out_list = list(outs) if tuple_out else [outs]
     if _ANALYSIS_HOOK is not None:
         _ANALYSIS_HOOK(name, tensor_vals, out_list,
                        {"uncacheable": cacheable_now and cache_key is None,
                         "denied": name in _JIT_DENY})
+    if _MONITOR_HOOK is not None:
+        _MONITOR_HOOK(name, out_list)
     wrapped = [NDArray(o) for o in out_list]
+    if sh is not None:
+        t = sh("wrap", t)
 
     if autograd.is_recording() and any(
             p._node is not None or p._grad is not None for p in parents):
@@ -1060,6 +1132,8 @@ def apply_op_flat(name, jfn, args, kwargs=None, n_outputs=None,
         for i, w in enumerate(wrapped):
             w._node = node
             w._out_idx = i
+        if sh is not None:
+            sh("tape", t)
     if tuple_out:
         return tuple(wrapped) if n_outputs is None else list(wrapped)
     return wrapped[0]

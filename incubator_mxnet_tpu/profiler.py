@@ -112,6 +112,7 @@ def start(profile_process="worker"):  # noqa: ARG001
     with _LOCK:
         _DEVICE_EVENTS.clear()
         _DEVICE_AGG.clear()
+    del _PAUSED_INTERVALS[:]
     logdir = _CONFIG.get("tensorboard_logdir")
     if logdir:
         _STATE["trace_dir"] = logdir
@@ -178,6 +179,11 @@ def _ingest_device_trace(trace_dir):
                 # rebase trace-relative µs onto the host epoch clock so
                 # host dispatch and device execution correlate in one view
                 kept["ts"] = float(kept["ts"]) + t0
+                # honor pause()/resume(): the device trace records through
+                # a pause, so filter its events out at ingest (metadata
+                # rows carry no timestamp and always survive)
+                if e.get("ph") != "M" and _in_paused_interval(kept["ts"]):
+                    continue
             _DEVICE_EVENTS.append(kept)
             if e.get("ph") == "X" and lanes[pid].startswith("/device:"):
                 agg = _DEVICE_AGG[e.get("name", "?")]
@@ -200,12 +206,36 @@ def device_op_totals():
         return {k: (v[0], v[1]) for k, v in _DEVICE_AGG.items()}
 
 
+_PAUSED_INTERVALS: list = []   # [start_us, end_us|None] epoch-µs, host clock
+
+
 def pause(profile_process="worker"):  # noqa: ARG001
+    """Stop host-side op recording AND mark the paused interval so device
+    events are suppressed too.
+
+    Scope: the host flag takes effect immediately (`record_op` checks it
+    per op). The jax/XLA DEVICE trace cannot be paused mid-flight — it
+    keeps recording until `stop()` — so instead the paused window
+    [pause(), resume()] is remembered and `_ingest_device_trace` drops
+    device events whose (rebased) timestamp falls inside it. Metadata
+    rows (process/thread names) are always kept."""
     _STATE["running"] = False
+    _PAUSED_INTERVALS.append([time.time() * 1e6, None])
 
 
 def resume(profile_process="worker"):  # noqa: ARG001
+    """Resume host-side op recording and close the paused interval (see
+    `pause` for the device-trace suppression semantics)."""
     _STATE["running"] = True
+    if _PAUSED_INTERVALS and _PAUSED_INTERVALS[-1][1] is None:
+        _PAUSED_INTERVALS[-1][1] = time.time() * 1e6
+
+
+def _in_paused_interval(ts_us):
+    for start, end in _PAUSED_INTERVALS:
+        if ts_us >= start and (end is None or ts_us <= end):
+            return True
+    return False
 
 
 def is_running():
@@ -345,14 +375,18 @@ def dump(finished=True, profile_process="worker"):  # noqa: ARG001
 
 
 def dumps(reset=False, format="table", sort_by="total", ascending=False,
-          memory=False):  # noqa: ARG001
+          memory=False):
     """Aggregate per-op stats (reference: profiler.py:154): host dispatch
     table, then the device-timeline table when a trace was captured;
     `memory=True` appends the memory section (per-device allocator stats,
     observed live-bytes peak + the op at peak when
     `set_config(profile_memory=True)` sampled during the run, and the
     largest live buffers — the reference's kMemory mode +
-    storage-profiler table)."""
+    storage-profiler table). `format="json"` returns the same aggregates
+    as a JSON string (host/device rows + optional memory section) instead
+    of the text tables; `"table"` is the default text path."""
+    if format not in ("table", "json"):
+        raise ValueError(f"format must be 'table' or 'json', got {format!r}")
     with _LOCK:
         rows = [(name, c, tot * 1000, mn * 1000, mx * 1000)
                 for name, (c, tot, mn, mx) in _AGG.items()]
@@ -369,6 +403,25 @@ def dumps(reset=False, format="table", sort_by="total", ascending=False,
             _MEM_STATE.update(peak=0, peak_op=None)
     key = {"total": 2, "count": 1, "min": 3, "max": 4}.get(sort_by, 2)
     rows.sort(key=lambda r: r[key], reverse=not ascending)
+    if format == "json":
+        payload = {
+            "host": [{"name": n, "count": c, "total_ms": tot, "min_ms": mn,
+                      "max_ms": mx} for n, c, tot, mn, mx in rows],
+            "device": sorted(
+                ({"name": n, "count": c, "total_ms": tot}
+                 for n, c, tot in dev_rows),
+                key=lambda r: r["total_ms"], reverse=not ascending),
+        }
+        if memory:
+            payload["memory"] = {
+                "devices": memory_stats(),
+                "observed_peak": mem_peak,
+                "op_peak_live_bytes": {n: p for n, p in mem_rows},
+                "largest_live_buffers": [
+                    {"shape": list(shape), "dtype": dtype, "nbytes": nb}
+                    for shape, dtype, nb in live_buffer_table(10)],
+            }
+        return json.dumps(payload)
     lines = [f"{'Name':<40}{'Count':>8}{'Total(ms)':>12}{'Min(ms)':>10}"
              f"{'Max(ms)':>10}", "=" * 80]
     for name, c, tot, mn, mx in rows:

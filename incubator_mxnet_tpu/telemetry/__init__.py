@@ -1,0 +1,38 @@
+"""Runtime telemetry: structured metrics, funnel stage-tracing, roofline
+analysis, and a training-health monitor (see TELEMETRY.md).
+
+Four connected parts:
+
+- `registry`  — process-wide counters/gauges/histograms (lock-free
+  thread-shard fast path), `report()`/`dump()`/`exposition()`, built-in
+  step/compile/jit-cache/transfer series;
+- `stages`    — per-stage µs accounting inside the `apply_op` funnel
+  behind the MXNET_TELEMETRY knob (dead branches when off);
+- `roofline`  — post-process the profiler's XPlane device trace into
+  per-phase bytes vs time vs peak-HBM-bandwidth tables;
+- `monitor`   — reference-parity `Monitor` (per-tensor health stats,
+  batched host sync), `install_nan_hook()` non-finite guard (eager +
+  compiled via jax.debug.callback), per-rank aggregation at kvstore sync
+  points, and the estimator `TelemetryHandler`.
+
+Env knobs (registered in `util._ENV_KNOBS`): ``MXNET_TELEMETRY``
+(``1`` = stage tracing on, ``raise`` = + NaN guard raising at the first
+non-finite output, ``0``/unset = off — zero per-op cost),
+``MXNET_TELEMETRY_INTERVAL`` (batches between estimator registry logs).
+"""
+from __future__ import annotations
+
+from . import registry  # noqa: F401
+from . import roofline  # noqa: F401
+from . import stages  # noqa: F401
+from . import monitor  # noqa: F401
+from .monitor import Monitor, install_nan_hook  # noqa: F401
+
+# arm the host->device byte inlet (a counter inc per transfer — rare
+# events, so always on once telemetry is imported)
+from ..ndarray import ndarray as _nd_mod
+
+_nd_mod._H2D_HOOK = registry.add_h2d_bytes
+
+__all__ = ["registry", "stages", "roofline", "monitor", "Monitor",
+           "install_nan_hook"]

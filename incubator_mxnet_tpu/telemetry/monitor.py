@@ -1,0 +1,356 @@
+"""Training-health monitor (reference: `python/mxnet/monitor.py` —
+`Monitor(interval, stat_func, pattern, sort)` printing per-layer output
+stats; the NaN watcher role of `tests/python/unittest/test_monitor.py`).
+
+TPU-native differences from the reference:
+
+- the tap point is the op funnel (`ndarray.apply_op`), not executor
+  output arrays — every eager op whose name matches ``pattern`` is
+  observed, hybridized interiors are covered by the NaN hook below;
+- stats (l2 norm, mean, max|.|, NaN count, Inf count) are computed
+  ON-DEVICE as 0-dim jax arrays and the host sync is BATCHED: nothing
+  blocks until `toc()` pulls the whole collected batch in one
+  `device_get` (the reference syncs per-array via asnumpy).
+
+NaN hook (`install_nan_hook`): catches the FIRST non-finite op output.
+
+- eager op: the finite-flag is synced per op (a debugging mode — the cost
+  is the point) and `mode="raise"` raises `MXNetError` at the faulting op;
+- under jit (hybridized blocks): the check is embedded into the traced
+  program via `jax.debug.callback`, so compiled replays keep the guard;
+  the callback records the finding asynchronously and the next funnel
+  entry (or an explicit `check()` / `nan_findings()`) surfaces it.
+  Blocks hybridized BEFORE the hook was installed keep their compiled
+  program — re-hybridize (or install the hook first) to instrument them.
+
+`MXNET_TELEMETRY=raise` installs the raising hook at import
+(`util._apply_env_config`).
+
+Per-rank aggregation: `queue_rank_stats()` + `sync_rank_stats()` exchange
+each rank's scalar summary at kvstore sync points (kvstore.barrier rides
+the same collective channel as `profiler.sync_remote_commands`) and
+`rank_aggregate()` exposes min/max/mean across ranks. The 1-process path
+degenerates to the local summary.
+"""
+from __future__ import annotations
+
+import json
+import os
+import re
+import time
+
+from ..base import MXNetError
+from ..gluon.contrib.estimator.event_handler import (BatchBegin, BatchEnd,
+                                                     EpochEnd, TrainBegin)
+from . import registry
+
+__all__ = ["Monitor", "install_nan_hook", "uninstall_nan_hook",
+           "nan_findings", "clear_nan_findings", "check",
+           "queue_rank_stats", "sync_rank_stats", "rank_aggregate",
+           "TelemetryHandler"]
+
+_ACTIVE_MONITORS: list = []
+_NAN_MODE = None                 # None | "warn" | "raise"
+_NAN_FINDINGS: list = []
+
+
+def _jnp():
+    import jax.numpy as jnp
+
+    return jnp
+
+
+def _install_funnel_hook():
+    from ..ndarray import ndarray as nd_mod
+
+    nd_mod._MONITOR_HOOK = _observe if (_ACTIVE_MONITORS or _NAN_MODE) \
+        else None
+
+
+def default_stats(x):
+    """Per-tensor health stats as 0-dim device arrays (no host sync)."""
+    jnp = _jnp()
+    xf = x.astype(jnp.float32) if x.dtype != jnp.float32 else x
+    return {"norm": jnp.sqrt((xf * xf).sum()),
+            "mean": xf.mean(),
+            "max_abs": jnp.abs(xf).max(),
+            "nan": jnp.isnan(xf).sum(),
+            "inf": jnp.isinf(xf).sum()}
+
+
+class Monitor:
+    """Observe matching op outputs between `tic()` and `toc()`.
+
+    Parameters mirror the reference: `interval` (observe every N-th
+    tic/toc cycle), `stat_func` (array -> 0-dim device array or dict of
+    them; default `default_stats`), `pattern` (op-name regex), `sort`
+    (sort `toc()` results by name). `callback` additionally receives the
+    synced `(step, name, stat, value)` rows at each `toc()`.
+    """
+
+    def __init__(self, interval=1, stat_func=None, pattern=".*", sort=False,
+                 callback=None):
+        self.interval = max(1, int(interval))
+        self.stat_func = stat_func or default_stats
+        self.re_pattern = re.compile(pattern)
+        self.sort = sort
+        self.callback = callback
+        self.step = 0
+        self.activated = False
+        self.queue: list = []            # (step, op name, stat, device val)
+
+    # -- reference surface -------------------------------------------------
+    def install(self, block=None):  # noqa: ARG002 - funnel-level tap
+        """Reference parity shim: the funnel tap needs no per-executor
+        install; accepted so reference scripts run unchanged."""
+        return self
+
+    def tic(self):
+        if self.step % self.interval == 0:
+            self.queue = []
+            self.activated = True
+            if self not in _ACTIVE_MONITORS:
+                _ACTIVE_MONITORS.append(self)
+                _install_funnel_hook()
+        self.step += 1
+
+    def toc(self):
+        """Deactivate and return `[(step, name, stat, value), ...]` with
+        ONE batched host sync for everything observed since `tic()`."""
+        if not self.activated:
+            return []
+        self.activated = False
+        if self in _ACTIVE_MONITORS:
+            _ACTIVE_MONITORS.remove(self)
+            _install_funnel_hook()
+        queue, self.queue = self.queue, []
+        import jax
+
+        values = jax.device_get([v for (_, _, _, v) in queue])
+        rows = [(step, name, stat, float(v))
+                for (step, name, stat, _), v in zip(queue, values)]
+        if self.sort:
+            rows.sort(key=lambda r: (r[1], r[2]))
+        if self.callback is not None:
+            self.callback(rows)
+        return rows
+
+    def toc_print(self):
+        for step, name, stat, value in self.toc():
+            print(f"Batch: {step:7d} {name + '_' + stat:30s} {value:.6g}")
+
+    def __enter__(self):
+        self.tic()
+        return self
+
+    def __exit__(self, *exc):
+        self.toc_print()
+        return False
+
+    # -- funnel side -------------------------------------------------------
+    def _observe(self, name, out_vals):
+        if not self.activated or not self.re_pattern.search(name):
+            return
+        for val in out_vals:
+            stats = self.stat_func(val)
+            if not isinstance(stats, dict):
+                stats = {"stat": stats}
+            for stat, v in stats.items():
+                self.queue.append((self.step - 1, name, stat, v))
+
+
+# ---------------------------------------------------------------------------
+# funnel hook (shared by monitors and the NaN guard)
+# ---------------------------------------------------------------------------
+
+def _is_tracer(x):
+    import jax
+
+    return isinstance(x, jax.core.Tracer)
+
+
+def _record_finding(name, where):
+    _NAN_FINDINGS.append({"op": name, "where": where,
+                          "time": time.time()})
+
+
+def _observe(name, out_vals):
+    """Installed as `ndarray._MONITOR_HOOK`; receives every funnel op's
+    name and raw output values (jax arrays, or tracers inside a jit
+    trace)."""
+    if _NAN_FINDINGS and _NAN_MODE == "raise":
+        # async finding from a compiled program's debug callback: surface
+        # it at the next op instead of losing it in the runtime thread
+        f = _NAN_FINDINGS[0]
+        raise MXNetError(
+            f"non-finite output detected at op '{f['op']}' ({f['where']}) "
+            "— raising at the next funnel entry (MXNET_TELEMETRY=raise)")
+    jnp = _jnp()
+    if _NAN_MODE is not None:
+        for val in out_vals:
+            if not hasattr(val, "dtype") or \
+                    not jnp.issubdtype(val.dtype, jnp.inexact):
+                continue
+            if _is_tracer(val):
+                import jax
+                from functools import partial
+
+                jax.debug.callback(partial(_nan_callback, name),
+                                   jnp.isfinite(val).all())
+            else:
+                if not bool(jnp.isfinite(val).all()):
+                    _record_finding(name, "eager")
+                    if _NAN_MODE == "raise":
+                        raise MXNetError(
+                            f"non-finite output detected at op '{name}' "
+                            "(eager, MXNET_TELEMETRY=raise)")
+    tracer_free = None
+    for mon in list(_ACTIVE_MONITORS):
+        if tracer_free is None:
+            tracer_free = not any(_is_tracer(v) for v in out_vals)
+        if tracer_free:       # monitors observe the eager funnel only
+            mon._observe(name, out_vals)
+
+
+def _nan_callback(name, finite):
+    """Runs at EXECUTION time inside compiled programs (jax.debug.callback)
+    — `finite` is the concrete all-finite flag for one op output."""
+    try:
+        ok = bool(finite)
+    except Exception:
+        ok = True
+    if not ok:
+        _record_finding(name, "jit")
+
+
+def install_nan_hook(mode="raise"):
+    """Arm the non-finite guard on every funnel op output. `mode="raise"`
+    raises `MXNetError` at the first finding (eager: at the faulting op;
+    jit: at the next funnel entry after the async callback lands);
+    `mode="warn"` only records into `nan_findings()`."""
+    global _NAN_MODE
+    if mode not in ("warn", "raise"):
+        raise ValueError(f"mode must be 'warn' or 'raise', got {mode!r}")
+    _NAN_MODE = mode
+    _install_funnel_hook()
+
+
+def uninstall_nan_hook():
+    global _NAN_MODE
+    _NAN_MODE = None
+    _install_funnel_hook()
+
+
+def nan_findings():
+    return list(_NAN_FINDINGS)
+
+
+def clear_nan_findings():
+    del _NAN_FINDINGS[:]
+
+
+def check():
+    """Raise if any non-finite finding is pending (call after a sync point
+    — e.g. `mx.waitall()` — to surface async jit-path findings)."""
+    if _NAN_FINDINGS:
+        f = _NAN_FINDINGS[0]
+        raise MXNetError(
+            f"non-finite output detected at op '{f['op']}' ({f['where']})")
+
+
+# ---------------------------------------------------------------------------
+# per-rank aggregation (kvstore sync-point channel)
+# ---------------------------------------------------------------------------
+
+_RANK_SUMMARY: dict = {}
+_RANK_AGGREGATE: dict = {}
+
+
+def queue_rank_stats(stats):
+    """Queue this rank's scalar summary ({name: float}) for the next
+    kvstore sync point. Keep it small: the exchange rides the 4 KiB
+    command slot of `dist.exchange_objs`."""
+    for k, v in stats.items():
+        _RANK_SUMMARY[str(k)] = float(v)
+
+
+def sync_rank_stats():
+    """Collective min/max/mean of queued rank summaries — called from
+    `kvstore.barrier()` on EVERY rank (same channel as
+    `profiler.sync_remote_commands`). Single-process degenerates to the
+    local summary. Returns the aggregate and caches it for
+    `rank_aggregate()`."""
+    global _RANK_SUMMARY
+    mine, _RANK_SUMMARY = _RANK_SUMMARY, {}
+    from ..parallel import dist
+
+    if dist.is_initialized():
+        all_stats = [s or {} for s in dist.exchange_objs(mine)]
+    else:
+        all_stats = [mine]
+    merged = {}
+    for stats in all_stats:
+        for k, v in stats.items():
+            merged.setdefault(k, []).append(v)
+    _RANK_AGGREGATE.clear()
+    for k, vals in merged.items():
+        _RANK_AGGREGATE[k] = {"min": min(vals), "max": max(vals),
+                              "mean": sum(vals) / len(vals),
+                              "ranks": len(vals)}
+    return dict(_RANK_AGGREGATE)
+
+
+def rank_aggregate():
+    """Last synced cross-rank aggregate: {name: {min, max, mean, ranks}}."""
+    return dict(_RANK_AGGREGATE)
+
+
+# ---------------------------------------------------------------------------
+# estimator integration
+# ---------------------------------------------------------------------------
+
+class TelemetryHandler(TrainBegin, BatchBegin, BatchEnd, EpochEnd):
+    """Estimator event handler feeding the metrics registry: per-batch
+    step time + example counts into `mx_step_time_seconds` /
+    `mx_examples_total`, and a registry report logged at every epoch end
+    (plus every MXNET_TELEMETRY_INTERVAL batches when that knob is set)."""
+
+    def __init__(self, interval=None, priority=-100):
+        if interval is None:
+            try:
+                interval = int(os.environ.get("MXNET_TELEMETRY_INTERVAL",
+                                              "0"))
+            except ValueError:
+                interval = 0
+        self.interval = interval          # batches between log lines
+        self.priority = priority
+        self._t0 = None
+        self._batches = 0
+
+    def train_begin(self, estimator, *args, **kwargs):
+        self._batches = 0
+
+    def batch_begin(self, estimator, *args, **kwargs):
+        self._t0 = time.perf_counter()
+
+    def batch_end(self, estimator, *args, **kwargs):
+        if self._t0 is None:
+            return
+        dt = time.perf_counter() - self._t0
+        batch = kwargs.get("batch")
+        n = 0
+        try:
+            n = int(batch[0].shape[0])
+        except Exception:
+            pass
+        registry.step(dt, examples=n)
+        self._batches += 1
+        if self.interval and self._batches % self.interval == 0:
+            estimator.logger.info("telemetry[batch %d]: %s", self._batches,
+                                  json.dumps(registry.report(),
+                                             sort_keys=True, default=str))
+
+    def epoch_end(self, estimator, *args, **kwargs):
+        estimator.logger.info("telemetry: %s",
+                              json.dumps(registry.report(), sort_keys=True,
+                                         default=str))

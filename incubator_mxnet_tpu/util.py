@@ -152,6 +152,15 @@ _ENV_KNOBS = {
     "MXNET_LOCAL_RANK": (
         "kvstore horovod facade / tools/launch.py", "rank within host "
         "(honored, exported by the launcher)"),
+    "MXNET_TELEMETRY": (
+        "telemetry", "1 = funnel stage-tracing on; raise = + NaN guard "
+        "raising at the first non-finite op output; 0/unset = off with "
+        "zero per-op cost (honored, this build's addition — see "
+        "TELEMETRY.md)"),
+    "MXNET_TELEMETRY_INTERVAL": (
+        "telemetry.monitor.TelemetryHandler", "batches between registry "
+        "log lines in the estimator loop; 0/unset = epoch-end only "
+        "(honored, this build's addition)"),
     # -- designed out (XLA/jax owns the mechanism) -------------------------
     "MXNET_ENGINE_TYPE": (
         "(designed out)", "scheduling is XLA async dispatch; value ignored"),
@@ -229,6 +238,15 @@ def _apply_env_config():
             engine.set_bulk_size(int(bulk))
         except (ImportError, ValueError):
             pass
+    telem = os.environ.get("MXNET_TELEMETRY", "0")
+    if telem and telem != "0":
+        from .telemetry import monitor, stages
+
+        stages.enable()
+        if telem == "raise":
+            monitor.install_nan_hook(mode="raise")
+        elif telem == "warn":
+            monitor.install_nan_hook(mode="warn")
     # NOTE: MXNET_GPU_MEM_POOL_RESERVE is forwarded at the TOP of package
     # __init__ (must precede any XLA backend init), not here.
 

@@ -104,6 +104,80 @@ def test_device_trace_can_be_disabled(tmp_path):
     profiler.dumps(reset=True)
 
 
+def test_dumps_json_format(tmp_path):
+    """ISSUE 2 satellite: `dumps(format="json")` must return the
+    aggregate tables as JSON instead of silently ignoring the arg."""
+    profiler.dumps(reset=True)
+    profiler.set_config(filename=str(tmp_path / "p.json"),
+                        profile_device=False)
+    profiler.set_state("run")
+    try:
+        a = np.array([[1.0, 2.0], [3.0, 4.0]])
+        np.dot(a, a).wait_to_read()
+    finally:
+        profiler.set_state("stop")
+    payload = json.loads(profiler.dumps(format="json"))
+    assert any(r["name"] == "dot" for r in payload["host"])
+    row = next(r for r in payload["host"] if r["name"] == "dot")
+    assert set(row) == {"name", "count", "total_ms", "min_ms", "max_ms"}
+    assert "memory" not in payload
+    mem = json.loads(profiler.dumps(format="json", memory=True, reset=True))
+    assert "devices" in mem["memory"]
+    import pytest
+
+    with pytest.raises(ValueError):
+        profiler.dumps(format="csv")
+    profiler.set_config(profile_device=True)
+
+
+def test_pause_suppresses_device_trace_events(tmp_path):
+    """ISSUE 2 satellite: pause() must not only flip the host flag — the
+    device trace keeps recording, so events whose timestamp falls in a
+    paused window are dropped at ingest (deterministic synthetic trace)."""
+    import gzip
+
+    events = {"traceEvents": [
+        {"ph": "M", "name": "process_name", "pid": 7,
+         "args": {"name": "/device:TPU:0"}},
+        {"ph": "X", "pid": 7, "name": "before_pause", "ts": 100, "dur": 10},
+        {"ph": "X", "pid": 7, "name": "during_pause", "ts": 5000, "dur": 10},
+        {"ph": "X", "pid": 7, "name": "after_resume", "ts": 9000, "dur": 10},
+    ]}
+    d = tmp_path / "trace"
+    d.mkdir()
+    with gzip.open(str(d / "x.trace.json.gz"), "wt") as f:
+        json.dump(events, f)
+    # host epoch anchor 0 so trace ts == epoch µs; paused window [4000, 8000]
+    profiler.dumps(reset=True)
+    profiler._STATE["trace_t0_us"] = 0.0
+    del profiler._PAUSED_INTERVALS[:]
+    profiler._PAUSED_INTERVALS.append([4000.0, 8000.0])
+    try:
+        profiler._ingest_device_trace(str(d))
+        names = {e["name"] for e in profiler.device_events()
+                 if e.get("ph") == "X"}
+        assert names == {"before_pause", "after_resume"}, names
+        # metadata rows always survive the filter
+        assert any(e.get("ph") == "M" for e in profiler.device_events())
+    finally:
+        del profiler._PAUSED_INTERVALS[:]
+        profiler.dumps(reset=True)
+
+
+def test_pause_resume_flags_and_intervals():
+    profiler.start()
+    try:
+        profiler.pause()
+        assert not profiler.is_running()
+        assert profiler._PAUSED_INTERVALS[-1][1] is None   # open interval
+        profiler.resume()
+        assert profiler.is_running()
+        assert profiler._PAUSED_INTERVALS[-1][1] is not None
+    finally:
+        profiler.stop()
+        profiler.dumps(reset=True)
+
+
 # ---------------------------------------------------------------------------
 # memory profiler (round 4: VERDICT #7 — reference
 # `src/profiler/storage_profiler.h:130` + kMemory mode)

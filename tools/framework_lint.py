@@ -24,6 +24,13 @@ FL004  ledger completeness: every statically-registered op name
        (literal `register_op_meta(...)` calls and the
        `_ELEMWISE_AND_FRIENDS` generation list) must appear in
        OPS_COVERAGE.md — the audit trail must not silently lag the code.
+FL005  ad-hoc timing in kernel bodies: ``time.time()`` /
+       ``time.perf_counter()`` / ``time.perf_counter_ns()`` calls inside
+       function bodies of ``ops/`` modules bypass the telemetry API
+       (`incubator_mxnet_tpu.telemetry`). Kernel-local wall clocks (a)
+       measure dispatch, not device execution, on an async backend, and
+       (b) produce numbers nobody owns (the VERDICT r5 drift class) —
+       route timing through `telemetry.registry` / `profiler.Scope`.
 
 Usage
 -----
@@ -48,6 +55,8 @@ RULES = {
     "FL003": "host numpy call inside an ops/ kernel-reachable body "
              "(float0 cotangents exempt)",
     "FL004": "registered op name missing from OPS_COVERAGE.md",
+    "FL005": "ad-hoc time.time()/perf_counter() in an ops/ kernel body "
+             "(bypasses the telemetry API)",
 }
 
 _INDEXING_NAME_PARTS = ("getitem", "setitem", "index", "slice")
@@ -192,6 +201,61 @@ def _check_host_numpy(tree, path, findings):
 
 
 # ---------------------------------------------------------------------------
+# FL005 — ad-hoc wall clocks inside ops/ kernel bodies
+# ---------------------------------------------------------------------------
+
+_TIMING_FUNCS = ("time", "perf_counter", "perf_counter_ns", "monotonic",
+                 "monotonic_ns", "process_time")
+
+
+def _time_aliases(tree):
+    """Names the `time` module is bound to (`import time [as t]`) plus
+    direct `from time import perf_counter [as pc]` bindings."""
+    mod_aliases, fn_aliases = set(), set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == "time":
+                    mod_aliases.add(a.asname or "time")
+        elif isinstance(node, ast.ImportFrom) and node.module == "time":
+            for a in node.names:
+                if a.name in _TIMING_FUNCS:
+                    fn_aliases.add(a.asname or a.name)
+    return mod_aliases, fn_aliases
+
+
+def _check_adhoc_timing(tree, path, findings):
+    norm = path.replace(os.sep, "/")
+    if "/ops/" not in norm:
+        return
+    mod_aliases, fn_aliases = _time_aliases(tree)
+    if not mod_aliases and not fn_aliases:
+        return
+    for fn in ast.walk(tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            hit = None
+            if (isinstance(node.func, ast.Attribute)
+                    and isinstance(node.func.value, ast.Name)
+                    and node.func.value.id in mod_aliases
+                    and node.func.attr in _TIMING_FUNCS):
+                hit = f"{node.func.value.id}.{node.func.attr}"
+            elif (isinstance(node.func, ast.Name)
+                    and node.func.id in fn_aliases):
+                hit = node.func.id
+            if hit:
+                findings.append(LintFinding(
+                    path, node.lineno, "FL005",
+                    f"ad-hoc `{hit}()` inside `{fn.name}` in an ops/ "
+                    "module: kernel-local wall clocks measure dispatch "
+                    "(async backend) and create metrics nobody owns — "
+                    "use telemetry.registry / profiler.Scope instead"))
+
+
+# ---------------------------------------------------------------------------
 # FL004 — registered op names present in OPS_COVERAGE.md
 # ---------------------------------------------------------------------------
 
@@ -245,6 +309,7 @@ def lint_source(src, path, coverage_text=None):
     _check_pad_guard(tree, path, findings)
     _check_bool_leak(tree, path, findings)
     _check_host_numpy(tree, path, findings)
+    _check_adhoc_timing(tree, path, findings)
     _check_ops_ledger(tree, path, findings, coverage_text)
     return findings
 
