@@ -8,6 +8,7 @@
 // prints PASS lines the test asserts on (loss must drop >30% and final
 // train accuracy must beat 0.9).
 #include <cstdio>
+#include <stdexcept>
 #include <vector>
 
 #include "mxnet-cpp/MxNetCpp.h"
@@ -37,6 +38,17 @@ int main(int argc, char** argv) {
   Py_INCREF(y.handle());
   Py_DECREF(pair);
   Py_DECREF(bridge);
+
+  // fail-fast contract: a typo'd optimizer name must throw at Optimizer
+  // CONSTRUCTION, not at the first training step
+  bool threw = false;
+  try {
+    Optimizer bogus("definitely_not_an_optimizer", 0.1);
+  } catch (const std::runtime_error&) {
+    threw = true;
+    PyErr_Clear();
+  }
+  if (threw) std::printf("PASS optimizer_failfast\n");
 
   Trainer trainer(net, Optimizer("sgd", 0.1));
   double first = 0, last = 0;

@@ -386,11 +386,23 @@ class Predictor {
 
 // Optimizer spec (reference: OptimizerRegistry::Find("sgd") +
 // SetParam("lr", ...)). Any registered framework optimizer name works
-// ("sgd", "adam", "adamw", "lamb", ...).
+// ("sgd", "adam", "adamw", "lamb", ...). The name is validated against
+// the registry AT CONSTRUCTION (reference parity: OptimizerRegistry::
+// Find returns nullptr immediately) — a typo throws here, not minutes
+// later when the Trainer takes its first step.
 class Optimizer {
  public:
   Optimizer(const std::string& name, double learning_rate)
-      : name_(name), lr_(learning_rate) {}
+      : name_(name), lr_(learning_rate) {
+    Runtime::Get();
+    PyObject* bridge = PyImport_ImportModule("incubator_mxnet_tpu._cpp_train");
+    if (!bridge) _throw_py("import _cpp_train");
+    PyObject* ok = PyObject_CallMethod(bridge, "check_optimizer", "s",
+                                       name.c_str());
+    Py_DECREF(bridge);
+    if (!ok) _throw_py("unknown optimizer '" + name + "'");
+    Py_DECREF(ok);
+  }
   const std::string& name() const { return name_; }
   double lr() const { return lr_; }
 

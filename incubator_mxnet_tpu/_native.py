@@ -191,7 +191,7 @@ class NativeRecordFile:
     def __del__(self):
         try:
             self.close()
-        except Exception:
+        except Exception:  # noqa: FL006 — interpreter teardown: nothing left to log to
             pass
 
 
@@ -253,7 +253,7 @@ class NativePrefetchPipeline:
     def __del__(self):
         try:
             self.close()
-        except Exception:
+        except Exception:  # noqa: FL006 — interpreter teardown: nothing left to log to
             pass
 
 

@@ -172,6 +172,6 @@ def live_array_bytes():
     for a in jax.live_arrays():
         try:
             total += a.nbytes
-        except Exception:
+        except Exception:  # noqa: FL006 — deleted/donated buffer racing the sweep
             continue
     return total

@@ -1,0 +1,59 @@
+"""Fault tolerance: deterministic fault injection, retry/backoff policies,
+and the self-healing training loop (see RESILIENCE.md).
+
+Reference role: ps-lite gives the reference implicit resilience — message
+retries (`resender.h`), worker churn tolerance — and SURVEY §5.3 names
+elasticity/preemption as first-class. The TPU build's failure surfaces are
+different (jax.distributed rendezvous, XLA collectives, DataLoader worker
+pools, checkpoint I/O), so resilience is rebuilt as an explicit subsystem
+with three connected parts:
+
+- `injection`  — seeded chaos schedules (``MXNET_FAULT_INJECT=
+  "seam:prob[:seed[:limit]]"``) firing :class:`FaultInjected` at probe
+  points threaded through the real seams: DataLoader worker bodies,
+  kvstore push/pull/barrier, distributed init, the NDArray host→device
+  inlet, checkpoint writes, and the Estimator step body. Off = dead
+  branches (same discipline as `telemetry/stages.py`);
+- `retry`      — :class:`RetryPolicy` (jittered exponential backoff,
+  deadline, retryable-vs-fatal classification) applied to distributed
+  rendezvous, kvstore sync, checkpoint I/O, and DataLoader worker
+  recovery; `suppressed()` is the logged replacement for silent
+  ``except Exception: pass`` (lint FL006);
+- `resilience` — :class:`ResilienceHandler` for the Estimator: skip
+  non-finite-loss steps (with AMP loss-scale backoff), auto-resume from
+  the last good checkpoint after a mid-step crash, checkpoint cadence.
+
+Every recovery is measured through the PR-2 telemetry registry:
+``mx_faults_injected_total``, ``mx_retries_total``,
+``mx_steps_skipped_nonfinite_total``, ``mx_resumes_total``,
+``mx_checkpoint_fallbacks_total``, ``mx_dataloader_fallbacks_total``.
+"""
+from __future__ import annotations
+
+from . import injection  # noqa: F401
+from . import retry  # noqa: F401
+from .injection import (FaultInjected, SEAMS, clear_injection,  # noqa: F401
+                        configure_from_env, configure_injection, inject_at,
+                        injection_enabled, schedule_info)
+from .retry import (RetryExhausted, RetryPolicy,  # noqa: F401
+                    classify_exception, retry_call, suppressed)
+
+__all__ = ["injection", "retry", "resilience", "FaultInjected", "SEAMS",
+           "inject_at", "injection_enabled", "configure_injection",
+           "configure_from_env", "clear_injection", "schedule_info",
+           "RetryPolicy", "RetryExhausted", "classify_exception",
+           "retry_call", "suppressed", "ResilienceHandler"]
+
+
+def __getattr__(name):
+    # `resilience` imports gluon's estimator handlers; gluon is mid-import
+    # when the package first imports `fault`, so the handler half loads
+    # lazily (PEP 562) on first touch
+    if name in ("ResilienceHandler", "resilience"):
+        import importlib
+
+        mod = importlib.import_module(".resilience", __name__)
+        if name == "resilience":
+            return mod
+        return mod.ResilienceHandler
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,228 @@
+"""Deterministic fault injection (the chaos half of the fault subsystem).
+
+Reference role: the reference stack is *implicitly* hardened — ps-lite
+retries messages and tolerates worker churn — but offers no way to TEST
+that hardening. This module makes failure a first-class, reproducible
+input: a seeded schedule armed by the ``MXNET_FAULT_INJECT`` knob fires
+:class:`FaultInjected` at named probe points ("seams") threaded through
+the real failure surfaces of the framework.
+
+Schedule grammar (comma-separated entries)::
+
+    MXNET_FAULT_INJECT="seam:prob[:seed[:limit]],..."
+
+- ``seam``  — one of :data:`SEAMS` (below);
+- ``prob``  — per-draw fire probability in [0, 1];
+- ``seed``  — per-seam PRNG seed (default 0). The draw sequence is
+  ``random.Random(seed)`` — identical across runs/platforms, so a chaos
+  run REPLAYS exactly;
+- ``limit`` — optional max number of fires (``prob=1.0, limit=N`` fails
+  exactly the first N draws then goes quiet — the deterministic form the
+  test suites use).
+
+Seams (where the probes live):
+
+===========================  ==============================================
+``dataloader_worker``        `gluon/data/dataloader._worker_fn` (in the
+                             worker process — arms from the inherited env)
+``dataloader_worker_exit``   same site, but the worker hard-exits
+                             (``os._exit``) instead of raising: simulates
+                             an OOM-killed/segfaulted worker
+``kvstore_push``             `_SingleProcessStore.push` / `pushpull`
+``kvstore_pull``             `_SingleProcessStore.pull`
+``kvstore_barrier``          `KVStore*.barrier`
+``dist_init``                `parallel/dist.initialize` rendezvous attempt
+``h2d``                      NDArray host→device inlet (module-global
+                             ``ndarray._FAULT_HOOK``, None when off —
+                             the same dead-branch discipline as
+                             `telemetry/stages.py`)
+``checkpoint_write``         `preemption.atomic_save` write body
+``estimator_step``           `Estimator.fit` batch body (mid-step crash)
+===========================  ==============================================
+
+Off-path contract: when no schedule is configured, ``_SCHEDULE is None``
+and every probe is a global load + ``is None`` check (the h2d seam doesn't
+even pay the call — the hook global in `ndarray.py` stays None).
+`tests/test_fault.py` measures this against the PR-2 funnel harness.
+"""
+from __future__ import annotations
+
+import os
+import threading
+
+__all__ = ["FaultInjected", "SEAMS", "inject_at", "injection_enabled",
+           "configure_injection", "configure_from_env", "clear_injection",
+           "schedule_info"]
+
+SEAMS = ("dataloader_worker", "dataloader_worker_exit", "kvstore_push",
+         "kvstore_pull", "kvstore_barrier", "dist_init", "h2d",
+         "checkpoint_write", "estimator_step")
+
+
+class FaultInjected(RuntimeError):
+    """Raised by an armed probe point. Carries the seam name and the
+    1-based draw index so a failing schedule can be replayed exactly."""
+
+    def __init__(self, seam, draw):
+        super().__init__(
+            f"injected fault at seam '{seam}' (draw #{draw}, "
+            f"MXNET_FAULT_INJECT)")
+        self.seam = seam
+        self.draw = draw
+
+    def __reduce__(self):
+        # default exception pickling replays __init__ with self.args (the
+        # formatted message) — wrong arity; a DataLoader worker's fault
+        # must cross the pool's result pipe intact
+        return (FaultInjected, (self.seam, self.draw))
+
+
+class _SeamState:
+    __slots__ = ("prob", "seed", "limit", "rng", "draws", "fired")
+
+    def __init__(self, prob, seed=0, limit=None):
+        import random
+
+        self.prob = float(prob)
+        self.seed = int(seed)
+        self.limit = None if limit is None else int(limit)
+        self.rng = random.Random(self.seed)
+        self.draws = 0
+        self.fired = 0
+
+
+_SCHEDULE = None                 # None = off (every probe a dead branch)
+_LOCK = threading.Lock()
+
+
+def _parse_spec(spec):
+    sched = {}
+    for part in str(spec).split(","):
+        part = part.strip()
+        if not part:
+            continue
+        bits = part.split(":")
+        if not 2 <= len(bits) <= 4:
+            raise ValueError(
+                f"MXNET_FAULT_INJECT entry {part!r}: expected "
+                "'seam:prob[:seed[:limit]]'")
+        seam = bits[0].strip()
+        if seam not in SEAMS:
+            raise ValueError(
+                f"MXNET_FAULT_INJECT: unknown seam {seam!r} "
+                f"(valid: {', '.join(SEAMS)})")
+        prob = float(bits[1])
+        if not 0.0 <= prob <= 1.0:
+            raise ValueError(
+                f"MXNET_FAULT_INJECT seam {seam!r}: prob {prob} ∉ [0, 1]")
+        seed = int(bits[2]) if len(bits) >= 3 else 0
+        limit = int(bits[3]) if len(bits) == 4 else None
+        sched[seam] = _SeamState(prob, seed, limit)
+    return sched
+
+
+def configure_injection(spec):
+    """Arm the chaos schedule. `spec` is the ``MXNET_FAULT_INJECT`` grammar
+    string or a ``{seam: (prob[, seed[, limit]])}`` dict. Empty/None
+    clears. Returns the armed seam names."""
+    global _SCHEDULE
+    if not spec:
+        clear_injection()
+        return ()
+    if isinstance(spec, str):
+        sched = _parse_spec(spec)
+    else:
+        sched = {}
+        for seam, cfg in dict(spec).items():
+            if seam not in SEAMS:
+                raise ValueError(f"unknown seam {seam!r} "
+                                 f"(valid: {', '.join(SEAMS)})")
+            cfg = (cfg,) if isinstance(cfg, (int, float)) else tuple(cfg)
+            sched[seam] = _SeamState(*cfg)
+    with _LOCK:
+        _SCHEDULE = sched or None
+    _arm_hot_hooks()
+    return tuple(sched)
+
+
+def configure_from_env():
+    """Arm from ``MXNET_FAULT_INJECT`` if set (called from
+    `util._apply_env_config` at import — including inside spawned
+    DataLoader worker processes, which inherit the env)."""
+    spec = os.environ.get("MXNET_FAULT_INJECT")
+    if spec:
+        return configure_injection(spec)
+    return ()
+
+
+def clear_injection():
+    """Disarm every seam; probes return to dead branches."""
+    global _SCHEDULE
+    with _LOCK:
+        _SCHEDULE = None
+    _arm_hot_hooks()
+
+
+def injection_enabled(seam=None):
+    sched = _SCHEDULE
+    if sched is None:
+        return False
+    return True if seam is None else seam in sched
+
+
+def _arm_hot_hooks():
+    """The NDArray host→device inlet is the one per-op-hot seam: it uses
+    a module-global hook (`ndarray._FAULT_HOOK`) that stays None unless
+    the schedule names 'h2d' — an is-None check is the whole off-path."""
+    import sys
+
+    nd_mod = sys.modules.get("incubator_mxnet_tpu.ndarray.ndarray")
+    if nd_mod is None:        # early arming (worker bootstrap): ndarray
+        return                # installs the hook itself at import
+    sched = _SCHEDULE
+    nd_mod._FAULT_HOOK = _h2d_probe if (sched and "h2d" in sched) else None
+
+
+def _h2d_probe(nbytes):  # noqa: ARG001 — hook signature shared with telemetry
+    inject_at("h2d")
+
+
+def inject_at(seam):
+    """Probe point: no-op unless the armed schedule names `seam`, in which
+    case a seeded Bernoulli draw decides whether to raise
+    :class:`FaultInjected`. Draw order is deterministic per seam."""
+    sched = _SCHEDULE
+    if sched is None:                 # the dead branch
+        return
+    st = sched.get(seam)
+    if st is None:
+        return
+    with _LOCK:
+        st.draws += 1
+        draw = st.draws
+        fire = (st.limit is None or st.fired < st.limit) \
+            and st.rng.random() < st.prob
+        if fire:
+            st.fired += 1
+    if fire:
+        from ..telemetry import registry
+
+        registry.counter("mx_faults_injected_total",
+                         "faults fired by the MXNET_FAULT_INJECT "
+                         "schedule").inc()
+        registry.counter("mx_faults_injected_total",
+                         "faults fired by the MXNET_FAULT_INJECT schedule",
+                         labels={"seam": seam}).inc()
+        raise FaultInjected(seam, draw)
+
+
+def schedule_info():
+    """Introspection: {seam: {prob, seed, limit, draws, fired}} (empty when
+    disarmed) — what a chaos run reports next to the registry dump."""
+    sched = _SCHEDULE
+    if sched is None:
+        return {}
+    with _LOCK:
+        return {seam: {"prob": st.prob, "seed": st.seed, "limit": st.limit,
+                       "draws": st.draws, "fired": st.fired}
+                for seam, st in sched.items()}

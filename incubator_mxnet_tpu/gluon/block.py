@@ -163,12 +163,16 @@ class Block:
         for name, p in params.items():
             if p._data is not None:
                 payload[name] = p.data().asnumpy()
-        onp.savez(filename + ".npz" if not filename.endswith(".npz") else filename,
-                  **payload)
-        import os
+        # tmp-write + atomic rename + checksum sidecar (fault subsystem):
+        # a preemption mid-save can never corrupt the last good .params,
+        # and loads can detect truncation (preemption.verify_checkpoint)
+        from .. import preemption
 
-        if not filename.endswith(".npz") and os.path.exists(filename + ".npz"):
-            os.replace(filename + ".npz", filename)
+        def _write(tmp):
+            with open(tmp, "wb") as f:
+                onp.savez(f, **payload)
+
+        preemption.atomic_save(filename, _write)
 
     def load_parameters(self, filename, device=None, ctx=None,
                         allow_missing=False, ignore_extra=False,
@@ -176,9 +180,21 @@ class Block:
         """Load parameters from npz (native) or the reference's binary
         .params container (auto-detected; `ndarray/legacy_io.py`).
         Reference checkpoints with `arg:`/`aux:` name prefixes load
-        transparently (reference: block.py:419)."""
+        transparently (reference: block.py:419). Files written by
+        `save_parameters` carry a `.crc32` sidecar; a checksum mismatch
+        (truncated/corrupt file) raises MXNetError before any parameter
+        is touched."""
         params = self.collect_params()
+        from .. import preemption
+        from ..base import MXNetError
         from ..ndarray import legacy_io
+
+        if preemption.verify_checkpoint(filename) is False:
+            raise MXNetError(
+                f"parameter file {filename} failed checksum validation "
+                "(truncated or corrupt) — restore a previous checkpoint "
+                "generation (preemption.TrainingCheckpointer.resume does "
+                "this automatically)")
 
         if legacy_io.is_legacy_file(filename):
             raw = legacy_io.load(filename)

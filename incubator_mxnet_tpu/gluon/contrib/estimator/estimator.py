@@ -15,9 +15,9 @@ from ... import loss as gluon_loss
 from ... import metric as metric_mod
 from ...trainer import Trainer
 from .event_handler import (BatchBegin, BatchEnd, EpochBegin, EpochEnd,
-                            LoggingHandler, MetricHandler, StoppingHandler,
-                            TrainBegin, TrainEnd, ValidationHandler,
-                            _check_event_handlers)
+                            LoggingHandler, MetricHandler, StepGuard,
+                            StoppingHandler, TrainBegin, TrainEnd,
+                            ValidationHandler, _check_event_handlers)
 
 __all__ = ["Estimator"]
 
@@ -196,6 +196,8 @@ class Estimator:
             val_data, event_handlers)
         train_begin, epoch_begin, batch_begin, batch_end, epoch_end, \
             train_end = self._categorize_handlers(event_handlers)
+        step_guards = [h for h in event_handlers if isinstance(h, StepGuard)]
+        from ....fault.injection import inject_at
 
         for handler in train_begin:
             handler.train_begin(self)
@@ -208,9 +210,28 @@ class Estimator:
                 n_batches += 1
                 for handler in batch_begin:
                     handler.batch_begin(self, batch=batch)
-                data, label, pred, loss = self.fit_batch(batch, batch_axis)
-                n = data.shape[batch_axis] if hasattr(data, "shape") else 1
-                self.trainer.step(n)
+                # the step body is the self-healing boundary (fault
+                # subsystem): StepGuards may veto the optimizer update
+                # (non-finite loss) or absorb a mid-step crash after
+                # restoring a consistent state (ResilienceHandler resumes
+                # from the last good checkpoint); without a guard, every
+                # exception propagates exactly as before
+                try:
+                    inject_at("estimator_step")       # chaos seam
+                    data, label, pred, loss = self.fit_batch(batch,
+                                                             batch_axis)
+                    n = data.shape[batch_axis] \
+                        if hasattr(data, "shape") else 1
+                    if any(g.pre_step(self, loss, batch)
+                           for g in step_guards):
+                        # vetoed (e.g. non-finite loss): neither the update
+                        # nor the batch_end metrics see the poisoned batch
+                        continue
+                    self.trainer.step(n)
+                except Exception as e:
+                    if not any(g.on_crash(self, e) for g in step_guards):
+                        raise
+                    continue                # recovered: next batch
                 for handler in batch_end:
                     handler.batch_end(self, batch=batch, pred=pred,
                                       label=label, loss=loss)

@@ -16,9 +16,9 @@ import warnings
 import numpy as onp
 
 __all__ = ["EventHandler", "TrainBegin", "TrainEnd", "EpochBegin", "EpochEnd",
-           "BatchBegin", "BatchEnd", "StoppingHandler", "MetricHandler",
-           "ValidationHandler", "LoggingHandler", "CheckpointHandler",
-           "EarlyStoppingHandler"]
+           "BatchBegin", "BatchEnd", "StepGuard", "StoppingHandler",
+           "MetricHandler", "ValidationHandler", "LoggingHandler",
+           "CheckpointHandler", "EarlyStoppingHandler"]
 
 
 class EventHandler:
@@ -63,6 +63,23 @@ class BatchBegin(EventHandler):
 class BatchEnd(EventHandler):
     def batch_end(self, estimator, *args, **kwargs):
         pass
+
+
+class StepGuard(EventHandler):
+    """Handlers that sit INSIDE the train-step body (this build's
+    fault-tolerance seam; no reference analogue — the reference's loop has
+    no recovery story beyond checkpoint-restart). `pre_step` runs between
+    backward and `trainer.step` and may veto the parameter update (return
+    True to SKIP — e.g. a non-finite loss); `on_crash` sees any exception
+    the step body raised and may absorb it (return True after restoring a
+    consistent training state — e.g. `fault.ResilienceHandler` reloading
+    the last good checkpoint)."""
+
+    def pre_step(self, estimator, loss, batch):  # noqa: ARG002
+        return False
+
+    def on_crash(self, estimator, exc):  # noqa: ARG002
+        return False
 
 
 class StoppingHandler(TrainBegin, BatchEnd, EpochEnd):

@@ -12,12 +12,22 @@ dominate).
 """
 from __future__ import annotations
 
+import logging
 import multiprocessing as mp
 
 from .batchify import default_batchify_fn
 from .sampler import BatchSampler, RandomSampler, SequentialSampler
 
 __all__ = ["DataLoader"]
+
+_LOG = logging.getLogger("incubator_mxnet_tpu.gluon.data")
+
+
+def _suppressed(where, exc):
+    """Classified, logged swallow (replaces bare `except: pass` — FL006)."""
+    from ...fault.retry import suppressed
+
+    suppressed("dataloader." + where, exc)
 
 _worker_dataset = None
 _worker_batchify = None
@@ -59,8 +69,8 @@ def _export_shm(arr):
     # reporting the segment as leaked at pool shutdown
     try:
         resource_tracker.unregister(shm._name, "shared_memory")  # noqa: SLF001
-    except Exception:
-        pass
+    except Exception as e:
+        _suppressed("shm.unregister", e)   # cosmetic tracker noise only
     return (_SHM_TAG, name, arr.shape, str(arr.dtype))
 
 
@@ -73,8 +83,8 @@ def _import_shm(desc):
     shm = shared_memory.SharedMemory(name=name)
     try:
         resource_tracker.unregister(shm._name, "shared_memory")  # noqa: SLF001
-    except Exception:
-        pass
+    except Exception as e:
+        _suppressed("shm.unregister", e)   # cosmetic tracker noise only
     try:
         arr = onp.array(onp.ndarray(shape, dtype, buffer=shm.buf))
     finally:
@@ -95,8 +105,8 @@ def _unlink_shm_tree(b):
             return
         try:
             resource_tracker.unregister(shm._name, "shared_memory")  # noqa: SLF001
-        except Exception:
-            pass
+        except Exception as e:
+            _suppressed("shm.unregister", e)
         shm.close()
         shm.unlink()
     elif isinstance(b, (tuple, list)):
@@ -106,6 +116,22 @@ def _unlink_shm_tree(b):
 
 def _worker_fn(samples):
     import numpy as onp
+
+    # chaos seams (armed from the inherited MXNET_FAULT_INJECT env by the
+    # worker's own package import): 'dataloader_worker' raises — the
+    # consumer's bounded retry/fallback path handles it; '..._exit' kills
+    # the process outright (an OOM-kill/segfault stand-in) — the pool
+    # respawns the worker and the consumer re-times-out the lost task
+    from ...fault import injection
+
+    injection.inject_at("dataloader_worker")
+    if injection.injection_enabled("dataloader_worker_exit"):
+        try:
+            injection.inject_at("dataloader_worker_exit")
+        except injection.FaultInjected:
+            import os
+
+            os._exit(3)
 
     batch = _worker_batchify([_worker_dataset[i] for i in samples])
 
@@ -157,6 +183,9 @@ class DataLoader:
                                          last_batch or "keep")
         self._batch_sampler = batch_sampler
         self._batchify_fn = batchify_fn or default_batchify_fn
+        from ...util import default_worker_retries
+
+        self._worker_retries = default_worker_retries()
         if num_workers is None:
             # env-config default ONLY when the caller didn't choose:
             # explicit num_workers=0 must stay worker-free (reference
@@ -182,8 +211,8 @@ class DataLoader:
         try:
             pool.terminate()
             pool.join()
-        except Exception:
-            pass
+        except Exception as e:
+            _suppressed("pool.terminate", e)   # best-effort atexit teardown
 
     def _start_pool(self, ctx, dataset, use_shared_memory):
         import os
@@ -238,26 +267,51 @@ class DataLoader:
                                               for i in batch_idx]))
             return
 
-        # pipelined: keep `prefetch` batches in flight in the pool
+        # pipelined: keep `prefetch` batches in flight in the pool.
+        # Self-healing (fault subsystem): a failed/timed-out task is
+        # retried `_worker_retries` times (the pool respawns dead worker
+        # processes on its own; the resubmit is what re-schedules the lost
+        # work), then falls back — LOUDLY — to computing that one batch in
+        # this process. Fatal-class errors (a dataset bug raising the same
+        # ValueError on every attempt would burn the budget silently)
+        # propagate immediately with their classification logged.
         batches = iter(self._batch_sampler)
-        in_flight = []
+        in_flight = []       # entries: [samples, AsyncResult, attempts]
+        abandoned = []       # timed-out futures: drain their shm at close
+
+        def submit(samples, attempts=0, front=False):
+            entry = [samples, self._pool.apply_async(_worker_fn, (samples,)),
+                     attempts]
+            if front:
+                in_flight.insert(0, entry)
+            else:
+                in_flight.append(entry)
+
         try:
             for _ in range(self._prefetch):
                 b = next(batches, None)
                 if b is None:
                     break
-                in_flight.append(self._pool.apply_async(_worker_fn, (b,)))
+                submit(b)
             while in_flight:
+                samples, fut, attempts = in_flight[0]
                 try:
-                    result = in_flight[0].get(self._timeout)
-                except mp.TimeoutError as e:
-                    raise RuntimeError(
-                        f"DataLoader worker timed out after "
-                        f"{self._timeout}s") from e
-                in_flight.pop(0)
+                    result = fut.get(self._timeout)
+                except Exception as e:
+                    in_flight.pop(0)
+                    if isinstance(e, mp.TimeoutError):
+                        # the task may still complete later (stuck worker):
+                        # keep the future so its shm gets drained at close
+                        abandoned.append([samples, fut, attempts])
+                    result = self._recover_batch(samples, attempts, e)
+                    if result is None:       # resubmitted (ordered: front)
+                        submit(samples, attempts + 1, front=True)
+                        continue
+                else:
+                    in_flight.pop(0)
                 b = next(batches, None)
                 if b is not None:
-                    in_flight.append(self._pool.apply_async(_worker_fn, (b,)))
+                    submit(b)
                 yield wrap(result)
         finally:
             # consumer abandoned the iterator (generator close / exception /
@@ -268,12 +322,49 @@ class DataLoader:
             import time
 
             deadline = time.monotonic() + 5.0
-            for fut in in_flight:
+            for _samples, fut, _attempts in in_flight + abandoned:
                 try:
                     _unlink_shm_tree(
                         fut.get(max(0.0, deadline - time.monotonic())))
-                except Exception:
-                    pass
+                except Exception as e:
+                    _suppressed("shm.drain", e)   # abandoned-iterator sweep
+
+    def _recover_batch(self, samples, attempts, exc):
+        """Worker-task failure policy: classify, then retry (return None —
+        the caller resubmits at the queue front to preserve batch order)
+        or compute the batch in-process as the loud last resort. Fatal
+        errors re-raise: a deterministic dataset bug must not be laundered
+        through the retry budget."""
+        from ...fault.retry import classify_exception
+        from ...telemetry import registry
+
+        kind = classify_exception(exc)
+        if kind == "fatal":
+            _LOG.error(
+                "DataLoader worker task failed with fatal %s (samples "
+                "%s..): %s — propagating, not retrying",
+                type(exc).__name__, list(samples)[:4], exc)
+            raise exc
+        if attempts < self._worker_retries:
+            registry.counter("mx_retries_total",
+                             "retries taken by fault.RetryPolicy").inc()
+            registry.counter("mx_retries_total",
+                             "retries taken by fault.RetryPolicy",
+                             labels={"policy": "dataloader"}).inc()
+            _LOG.warning(
+                "DataLoader worker task failed with retryable %s (attempt "
+                "%d/%d): %s — resubmitting to the (respawned) pool",
+                type(exc).__name__, attempts + 1, self._worker_retries, exc)
+            return None
+        registry.counter(
+            "mx_dataloader_fallbacks_total",
+            "batches recomputed in-process after worker retries").inc()
+        _LOG.error(
+            "DataLoader worker retries exhausted (%d) for %s: %s — "
+            "falling back to single-process batchify for this batch "
+            "(slow but correct)", self._worker_retries,
+            type(exc).__name__, exc)
+        return self._batchify_fn([self._dataset[i] for i in samples])
 
     def __len__(self):
         return len(self._batch_sampler)

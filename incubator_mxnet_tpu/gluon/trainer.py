@@ -149,20 +149,36 @@ class Trainer:
                                 [onp.asarray(x) for x in s[1]]))
             else:
                 payload.append(onp.asarray(s))
-        with open(fname, "wb") as f:
-            pickle.dump({"states": payload,
-                         "num_update": self._optimizer.num_update,
-                         # per-param update counts drive Adam-family bias
-                         # correction: losing them resets t and inflates
-                         # the post-resume step size
-                         "index_update_count":
-                             dict(self._optimizer._index_update_count)}, f)
+        blob = {"states": payload,
+                "num_update": self._optimizer.num_update,
+                # per-param update counts drive Adam-family bias
+                # correction: losing them resets t and inflates
+                # the post-resume step size
+                "index_update_count":
+                    dict(self._optimizer._index_update_count)}
+        # crash-safe + checksummed (fault subsystem): optimizer momenta are
+        # part of the loss trajectory — a torn states file silently resets
+        # Adam bias correction on resume
+        from .. import preemption
+
+        def _write(tmp):
+            with open(tmp, "wb") as f:
+                pickle.dump(blob, f)
+
+        preemption.atomic_save(fname, _write)
 
     def load_states(self, fname):
         import pickle
 
         import jax.numpy as jnp
 
+        from .. import preemption
+        from ..base import MXNetError
+
+        if preemption.verify_checkpoint(fname) is False:
+            raise MXNetError(
+                f"trainer state file {fname} failed checksum validation "
+                "(truncated or corrupt)")
         with open(fname, "rb") as f:
             payload = pickle.load(f)
         states = []

@@ -783,7 +783,7 @@ class ImageIter:
     def __del__(self):
         try:
             self.close()
-        except Exception:  # interpreter teardown
+        except Exception:  # noqa: FL006 — interpreter teardown: nothing left to log to
             pass
 
     def reset(self):

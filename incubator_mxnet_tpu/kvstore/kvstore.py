@@ -30,6 +30,21 @@ class _SingleProcessStore(KVStoreBase):
         self._optimizer = None
         self._compression = None
 
+    @staticmethod
+    def _chaos_probe(seam):
+        """Fault-injection probe at the sync-point entry, RETRIED under the
+        'kvstore' policy: the probe sits before any store mutation, so a
+        retry is always safe (idempotent), and an injected fault that
+        outlives the budget surfaces as RetryExhausted — the shape a real
+        flaky collective would take. Dead branch when chaos is off."""
+        from ..fault import injection
+
+        if not injection.injection_enabled(seam):
+            return
+        from ..fault.retry import RetryPolicy
+
+        RetryPolicy.from_env("kvstore").call(injection.inject_at, seam)
+
     def set_gradient_compression(self, compression_params):
         """Enable gradient compression on the push leg (reference:
         kvstore.py set_gradient_compression → gradient_compression.cc)."""
@@ -77,6 +92,7 @@ class _SingleProcessStore(KVStoreBase):
     def push(self, key, value, priority=0):  # noqa: ARG002
         from ..ndarray.sparse import RowSparseNDArray
 
+        self._chaos_probe("kvstore_push")
         if isinstance(key, (list, tuple)):
             keys, values = key, value
         else:
@@ -157,6 +173,7 @@ class _SingleProcessStore(KVStoreBase):
         return results if isinstance(key, (list, tuple)) else results[0]
 
     def pull(self, key, out=None, priority=0, ignore_sparse=True):  # noqa: ARG002
+        self._chaos_probe("kvstore_pull")
         if isinstance(key, (list, tuple)):
             keys, outs = key, out if out is not None else [None] * len(key)
         else:
@@ -181,6 +198,7 @@ class _SingleProcessStore(KVStoreBase):
         written to every entry of `out`."""
         from ..ndarray.sparse import RowSparseNDArray
 
+        self._chaos_probe("kvstore_push")
         if not isinstance(key, (list, tuple)):
             key, value, out = [key], [value], [out]
         elif out is None:
@@ -223,6 +241,9 @@ class _SingleProcessStore(KVStoreBase):
 
     def _reduce(self, value):
         return value
+
+    def barrier(self):
+        self._chaos_probe("kvstore_barrier")
 
     # -- optimizer on kvstore ----------------------------------------------
     def set_optimizer(self, optimizer):
@@ -303,7 +324,13 @@ class KVStoreDist(_SingleProcessStore):
     def _reduce(self, value):
         if self._dist.num_processes() == 1 or not isinstance(value, NDArray):
             return value
-        return NDArray(self._dist.allreduce(value._data, op="sum"))
+        # the cross-host collective is the real pushpull wire hop (ps-lite
+        # retried these at the message layer via Resender); allreduce is
+        # idempotent, so a transient DCN failure is safely retried here
+        from ..fault.retry import RetryPolicy
+
+        return NDArray(RetryPolicy.from_env("kvstore").call(
+            self._dist.allreduce, value._data, op="sum"))
 
     def init(self, key, value):
         keys = key if isinstance(key, (list, tuple)) else [key]
@@ -318,16 +345,18 @@ class KVStoreDist(_SingleProcessStore):
         from ..ndarray.ndarray import waitall
 
         waitall()
+        self._chaos_probe("kvstore_barrier")
         # sync point doubles as the command channel: queued
         # profile_process='server' commands ship and apply here
         # (reference: KVStoreServerProfilerCommand on ps-lite messages),
         # and telemetry rank-stat summaries ride the same collective
         from .. import profiler
+        from ..fault.retry import RetryPolicy
         from ..telemetry import monitor as _telem_monitor
 
         profiler.sync_remote_commands()
         _telem_monitor.sync_rank_stats()
-        self._dist.barrier()
+        RetryPolicy.from_env("kvstore").call(self._dist.barrier)
 
 
 KVStore = KVStoreLocal

@@ -41,6 +41,11 @@ _TRACER_T = None
 # `add_h2d_bytes` here at import; None = off, one is-None check per inlet)
 _H2D_HOOK = None
 
+# fault-injection probe for the same inlet (fault.injection arms this only
+# when the MXNET_FAULT_INJECT schedule names the 'h2d' seam; None = off —
+# the dead-branch discipline the <3% funnel-overhead gate measures)
+_FAULT_HOOK = None
+
 
 def _is_tracer(x) -> bool:
     global _TRACER_T
@@ -91,6 +96,8 @@ class NDArray:
         if from_host and _H2D_HOOK is not None and not _is_tracer(data):
             # host->device inlet: telemetry mx_h2d_bytes_total
             _H2D_HOOK(data.nbytes)
+        if from_host and _FAULT_HOOK is not None and not _is_tracer(data):
+            _FAULT_HOOK(data.nbytes)          # chaos seam 'h2d'
         if device is not None and not _is_tracer(data):
             import jax
 
@@ -206,6 +213,8 @@ class NDArray:
             return self
         if _H2D_HOOK is not None:
             _H2D_HOOK(self._data.nbytes)
+        if _FAULT_HOOK is not None:
+            _FAULT_HOOK(self._data.nbytes)    # chaos seam 'h2d'
         out = NDArray(jax.device_put(self._data, Device(device).jax_device))
         out._device = Device(device)
         return out

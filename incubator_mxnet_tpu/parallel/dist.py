@@ -16,12 +16,31 @@ program sums over that axis with replicated output.
 """
 from __future__ import annotations
 
+import logging
 import os
 
 __all__ = ["initialize", "is_initialized", "rank", "num_processes",
            "allreduce", "broadcast", "barrier"]
 
+_LOG = logging.getLogger("incubator_mxnet_tpu.parallel.dist")
+
 _STATE = {"initialized": False, "mesh": None, "reducers": {}}
+
+
+def _transient_rendezvous(exc):
+    """Retryable filter for the rendezvous policy: injected faults and
+    connection/timeout-shaped transport errors only — a double-init
+    RuntimeError is a STATE, not a fault, and must surface immediately."""
+    from ..fault.injection import FaultInjected
+
+    if isinstance(exc, FaultInjected):
+        return True
+    if isinstance(exc, (ConnectionError, TimeoutError, OSError)):
+        return True
+    msg = str(exc).lower()
+    return isinstance(exc, RuntimeError) and any(
+        s in msg for s in ("unavailable", "deadline", "timed out",
+                           "timeout", "connect", "refused", "unreachable"))
 
 
 def _env(*names, default=None):
@@ -58,28 +77,51 @@ def initialize(coordinator_address=None, num_processes=None, process_id=None):
         return  # single-process: nothing to join
     import jax
 
-    try:
+    from ..fault import injection
+    from ..fault.retry import RetryExhausted, RetryPolicy
+
+    def _join():
+        injection.inject_at("dist_init")      # chaos seam
         jax.distributed.initialize(coordinator_address=coordinator_address,
                                    num_processes=num_processes,
                                    process_id=process_id)
+
+    try:
+        # rendezvous is the flakiest moment of a multi-host launch (peers
+        # race the coordinator's bind): retry TRANSPORT failures with
+        # backoff, but never a double-init complaint — that must fall
+        # through to the already-up classification below
+        RetryPolicy.from_env("dist_init",
+                             retryable=_transient_rendezvous).call(_join)
     except RuntimeError as e:
         # Recoverable: the runtime is already up (double-init — jax raises
         # "...should only be called once", or the backend reports multiple
         # processes). Anything else (coordinator unreachable, rendezvous
-        # timeout) must FAIL LOUDLY when a coordinator was configured —
-        # degrading to process_count()==1 would silently train with
-        # unreduced gradients. Explicit num_processes==1 is the only
-        # single-process escape hatch.
-        msg = str(e).lower()
+        # timeout — including after the retry budget) must FAIL LOUDLY
+        # when a coordinator was configured — degrading to
+        # process_count()==1 would silently train with unreduced
+        # gradients. Explicit num_processes==1 is the only single-process
+        # escape hatch.
+        last = e.last if isinstance(e, RetryExhausted) else e
+        msg = str(last).lower()
         already_up = ("already" in msg or "only be called once" in msg
                       or jax.process_count() > 1)
         if not already_up:
             if num_processes == 1:
+                _LOG.warning(
+                    "dist.initialize: rendezvous failed but "
+                    "num_processes=1 — continuing single-process: %s", last)
                 return
+            _LOG.error(
+                "dist.initialize: rendezvous failed FATALLY (coordinator "
+                "%s, num_processes=%s): %s", coordinator_address,
+                num_processes, last)
             raise RuntimeError(
                 f"jax.distributed.initialize failed (coordinator "
                 f"{coordinator_address}, num_processes={num_processes}): "
-                f"{e}") from e
+                f"{last}") from e
+        _LOG.info("dist.initialize: runtime already up (%s) — reusing it",
+                  type(last).__name__)
     _STATE["initialized"] = True
 
 

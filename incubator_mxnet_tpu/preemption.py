@@ -25,6 +25,7 @@ import threading
 
 __all__ = ["on_preemption", "remove_preemption_hook",
            "clear_preemption_hooks", "trigger", "preempted", "atomic_save",
+           "checkpoint_checksum", "verify_checkpoint", "CheckpointCorrupt",
            "CheckpointManager", "TrainingCheckpointer"]
 
 _HOOKS: list = []
@@ -39,8 +40,14 @@ def _run_hooks(signum=None, frame=None):  # noqa: ARG001
     for fn in hooks:
         try:
             fn()
-        except Exception:
-            pass  # a failing hook must not mask the shutdown path
+        except Exception as e:
+            # a failing hook must not mask the shutdown path — but it
+            # must be SEEN (the checkpoint it was saving did not happen)
+            import logging
+
+            logging.getLogger("incubator_mxnet_tpu.fault").error(
+                "preemption hook %r failed: %s: %s", fn,
+                type(e).__name__, e)
     # chain to the previously-installed handler (graceful frameworks
     # layering on top of us keep working); if the previous disposition was
     # the DEFAULT terminating action, re-deliver so the process actually
@@ -96,12 +103,83 @@ def preempted() -> bool:
     return _STATE["preempted"]
 
 
-def atomic_save(path, write_fn):
-    """Crash-safe write: `write_fn(tmp_path)` then atomic rename. A kill
-    mid-write leaves the previous checkpoint intact."""
+_CRC_SUFFIX = ".crc32"
+
+
+class CheckpointCorrupt(OSError):
+    """A checkpoint file failed checksum validation (truncated or
+    corrupt). Retryable-classified: loaders fall back to the previous
+    generation (`TrainingCheckpointer.resume`)."""
+
+
+def checkpoint_checksum(path):
+    """CRC32 of a file's bytes (streamed, 1 MiB chunks)."""
+    import zlib
+
+    crc = 0
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            crc = zlib.crc32(chunk, crc)
+    return crc & 0xFFFFFFFF
+
+
+def _write_checksum(path):
+    """Sidecar `<path>.crc32` holding 'crc_hex size' — written through the
+    same tmp+rename dance so the pair can never half-update."""
+    crc = checkpoint_checksum(path)
+    size = os.path.getsize(path)
+    tmp = f"{path}{_CRC_SUFFIX}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        f.write(f"{crc:08x} {size}\n")
+    os.replace(tmp, path + _CRC_SUFFIX)
+
+
+def verify_checkpoint(path):
+    """Validate `path` against its checksum sidecar. Returns True
+    (verified), False (MISMATCH — truncated/corrupt), or None (no sidecar
+    — unverifiable legacy file, callers decide)."""
+    side = path + _CRC_SUFFIX
+    if not os.path.exists(side):
+        return None
+    try:
+        with open(side) as f:
+            crc_hex, size = f.read().split()
+        return (os.path.getsize(path) == int(size)
+                and checkpoint_checksum(path) == int(crc_hex, 16))
+    except (OSError, ValueError):
+        return False
+
+
+def atomic_save(path, write_fn, checksum=True):
+    """Crash-safe write: `write_fn(tmp_path)` then atomic rename, plus a
+    `<path>.crc32` sidecar for load-time validation. A kill mid-write
+    leaves the previous checkpoint intact. The write body carries the
+    'checkpoint_write' chaos seam and runs under the 'checkpoint' retry
+    policy (MXNET_RETRY_*): a transient I/O failure re-runs `write_fn`
+    from scratch on the same tmp path — idempotent by construction."""
     tmp = f"{path}.tmp.{os.getpid()}"
-    write_fn(tmp)
+
+    def _write():
+        from .fault import injection
+
+        injection.inject_at("checkpoint_write")
+        write_fn(tmp)
+
+    from .fault.retry import RetryExhausted, RetryPolicy
+
+    try:
+        RetryPolicy.from_env("checkpoint").call(_write)
+    except Exception as e:
+        try:
+            os.remove(tmp)                    # no orphaned partial tmp
+        except OSError:
+            pass
+        if isinstance(e, RetryExhausted):
+            raise e.last from e   # callers keep seeing the writer's error
+        raise
     os.replace(tmp, path)
+    if checksum:
+        _write_checksum(path)
     return path
 
 
@@ -153,19 +231,24 @@ class CheckpointManager:
             self._saved.append(path)
             while len(self._saved) > self._keep:
                 old = self._saved.pop(0)
-                try:
-                    os.remove(old)
-                except OSError:
-                    pass
+                for p in (old, old + _CRC_SUFFIX):
+                    try:
+                        os.remove(p)
+                    except OSError:
+                        pass
             return path
         finally:
             self._saving = False
 
-    def latest(self):
-        """Most recent checkpoint path on disk (None if none)."""
+    def generations(self):
+        """Every on-disk checkpoint generation, oldest first."""
         import glob
 
-        found = sorted(glob.glob(f"{self._prefix}-*.ckpt"))
+        return sorted(glob.glob(f"{self._prefix}-*.ckpt"))
+
+    def latest(self):
+        """Most recent checkpoint path on disk (None if none)."""
+        found = self.generations()
         return found[-1] if found else None
 
 
@@ -220,17 +303,63 @@ class TrainingCheckpointer:
     def save_now(self):
         return self._mgr.save_now()
 
-    def resume(self):
-        """Load the most recent checkpoint if any; returns the step to
-        continue from (0 when starting fresh)."""
+    def _load_blob(self, path):
+        """Checksum-validated unpickle: CheckpointCorrupt on a truncated
+        or bit-flipped file (the sidecar catches corruption pickle can't),
+        so `resume` can fall back to the previous generation."""
         import pickle
+
+        if verify_checkpoint(path) is False:
+            raise CheckpointCorrupt(
+                f"checkpoint {path} failed checksum validation "
+                "(truncated or corrupt)")
+        try:
+            with open(path, "rb") as f:
+                return pickle.load(f)
+        except (EOFError, pickle.UnpicklingError, OSError) as e:
+            raise CheckpointCorrupt(
+                f"checkpoint {path} is unreadable: "
+                f"{type(e).__name__}: {e}") from e
+
+    def resume(self):
+        """Load the most recent VALID checkpoint; returns the step to
+        continue from (0 when starting fresh). A corrupted or truncated
+        newest generation raises a clear error internally, is logged, and
+        resume automatically falls back to the previous generation
+        (counted in ``mx_checkpoint_fallbacks_total``); only when every
+        generation fails does resume raise."""
+        import logging
         import tempfile
 
-        path = self._mgr.latest()
-        if path is None:
+        log = logging.getLogger("incubator_mxnet_tpu.fault")
+        paths = self._mgr.generations()
+        blob, path, errors = None, None, []
+        for candidate in reversed(paths):
+            try:
+                blob = self._load_blob(candidate)
+                path = candidate
+                break
+            except Exception as e:
+                errors.append(f"{candidate}: {e}")
+                log.error(
+                    "checkpoint resume: %s — falling back to the previous "
+                    "generation", e)
+                from .telemetry import registry
+
+                registry.counter(
+                    "mx_checkpoint_fallbacks_total",
+                    "corrupt checkpoint generations skipped at "
+                    "resume").inc()
+        if blob is None:
+            if paths:
+                from .base import MXNetError
+
+                raise MXNetError(
+                    "checkpoint resume: all %d generation(s) under prefix "
+                    "%r failed validation:\n  %s" % (
+                        len(paths), self._mgr._prefix,  # noqa: SLF001
+                        "\n  ".join(errors)))
             return 0
-        with open(path, "rb") as f:
-            blob = pickle.load(f)
         with tempfile.TemporaryDirectory() as d:
             p = os.path.join(d, "net.params")
             with open(p, "wb") as f:

@@ -143,8 +143,10 @@ def stop(profile_process="worker"):  # noqa: ARG001
         try:
             jax.profiler.stop_trace()
             _ingest_device_trace(_STATE["trace_dir"])
-        except Exception:
-            pass
+        except Exception as e:
+            from .fault.retry import suppressed
+
+            suppressed("profiler.stop_trace", e)   # device trace lost
         finally:
             if _STATE.get("own_trace_dir") and _STATE.get("trace_dir"):
                 shutil.rmtree(_STATE["trace_dir"], ignore_errors=True)
@@ -280,7 +282,7 @@ def _live_bytes():
     for a in jax.live_arrays():
         try:
             total += a.nbytes
-        except Exception:
+        except Exception:  # noqa: FL006 — deleted/donated buffer racing the sweep
             pass
     return total
 
@@ -318,7 +320,7 @@ def live_buffer_table(top=20):
     for a in jax.live_arrays():
         try:
             rows.append((tuple(a.shape), str(a.dtype), int(a.nbytes)))
-        except Exception:
+        except Exception:  # noqa: FL006 — deleted/donated buffer racing the sweep
             continue
     rows.sort(key=lambda r: -r[2])
     return rows[:top]

@@ -97,7 +97,7 @@ class MXRecordIO:
     def __del__(self):
         try:
             self.close()
-        except Exception:
+        except Exception:  # noqa: FL006 — interpreter teardown: nothing left to log to
             pass
 
     def __getstate__(self):
@@ -234,8 +234,10 @@ class IndexCreator:
             n = build_index(self.reader.uri, self.idx_path)
             if n is not None:
                 return
-        except Exception:
-            pass
+        except Exception as e:
+            from .fault.retry import suppressed
+
+            suppressed("recordio.native_index", e)  # python-index fallback
         entries = []
         i = 0
         while True:

@@ -34,8 +34,10 @@ def _probe():
     platforms = set()
     try:
         platforms = {d.platform for d in jax.devices()}
-    except Exception:
-        pass
+    except Exception as e:
+        from .fault.retry import suppressed
+
+        suppressed("runtime.platform_probe", e)  # no backend yet
     feats["TPU"] = "tpu" in platforms
     feats["CPU"] = True
     feats["CUDA"] = "gpu" in platforms or "cuda" in platforms

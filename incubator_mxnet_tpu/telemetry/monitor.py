@@ -341,8 +341,8 @@ class TelemetryHandler(TrainBegin, BatchBegin, BatchEnd, EpochEnd):
         n = 0
         try:
             n = int(batch[0].shape[0])
-        except Exception:
-            pass
+        except (TypeError, AttributeError, IndexError, KeyError):
+            pass                      # batch without a leading array leaf
         registry.step(dt, examples=n)
         self._batches += 1
         if self.interval and self._batches % self.interval == 0:

@@ -272,6 +272,16 @@ def _jit_cache_collector():
 # reading
 # ---------------------------------------------------------------------------
 
+def _log_collector_failure(fn, exc):
+    """A broken pull-collector drops its series from reports — that must
+    be visible (classified logging; FL006 discipline), not a blind skip."""
+    import logging
+
+    logging.getLogger("incubator_mxnet_tpu.telemetry").warning(
+        "registry collector %r failed: %s: %s",
+        getattr(fn, "__name__", fn), type(exc).__name__, exc)
+
+
 def report():
     """Merged view of every series: {series name: {type, value, ...}}."""
     with _LOCK:
@@ -292,7 +302,8 @@ def report():
         try:
             for name, v in (fn() or {}).items():
                 out[name] = {"type": "gauge", "value": v}
-        except Exception:
+        except Exception as e:
+            _log_collector_failure(fn, e)
             continue
     return out
 
@@ -341,7 +352,8 @@ def exposition():
             for name, v in (fn() or {}).items():
                 lines.append(f"# TYPE {name} gauge")
                 lines.append(f"{name} {v}")
-        except Exception:
+        except Exception as e:
+            _log_collector_failure(fn, e)
             continue
     return "\n".join(lines) + "\n"
 

@@ -161,6 +161,26 @@ _ENV_KNOBS = {
         "telemetry.monitor.TelemetryHandler", "batches between registry "
         "log lines in the estimator loop; 0/unset = epoch-end only "
         "(honored, this build's addition)"),
+    "MXNET_FAULT_INJECT": (
+        "fault.injection", "seeded chaos schedule 'seam:prob[:seed"
+        "[:limit]],...' armed at import (incl. spawned DataLoader "
+        "workers); unset = every probe a dead branch (honored, this "
+        "build's addition — see RESILIENCE.md)"),
+    "MXNET_RETRY_MAX": (
+        "fault.RetryPolicy.from_env", "default max retries for the "
+        "kvstore/dist_init/checkpoint policies (default 3) (honored, "
+        "this build's addition)"),
+    "MXNET_RETRY_BASE_DELAY_MS": (
+        "fault.RetryPolicy.from_env", "first backoff delay in ms "
+        "(default 50; doubles per retry, jittered) (honored, this "
+        "build's addition)"),
+    "MXNET_RETRY_DEADLINE_S": (
+        "fault.RetryPolicy.from_env", "optional wall-clock retry budget "
+        "per call (honored, this build's addition)"),
+    "MXNET_WORKER_RETRIES": (
+        "gluon.data.DataLoader", "worker-task retry budget before the "
+        "loud single-process fallback (default 2) (honored, this "
+        "build's addition)"),
     # -- designed out (XLA/jax owns the mechanism) -------------------------
     "MXNET_ENGINE_TYPE": (
         "(designed out)", "scheduling is XLA async dispatch; value ignored"),
@@ -247,6 +267,13 @@ def _apply_env_config():
             monitor.install_nan_hook(mode="raise")
         elif telem == "warn":
             monitor.install_nan_hook(mode="warn")
+    if os.environ.get("MXNET_FAULT_INJECT"):
+        # arm the chaos schedule (also runs inside spawned DataLoader
+        # worker processes, which re-import the package with the
+        # inherited env — that is how the dataloader_worker seam arms)
+        from .fault import injection
+
+        injection.configure_from_env()
     # NOTE: MXNET_GPU_MEM_POOL_RESERVE is forwarded at the TOP of package
     # __init__ (must precede any XLA backend init), not here.
 
@@ -262,3 +289,15 @@ def default_num_workers():
         return max(0, int(v)) if v else 0
     except ValueError:
         return 0
+
+
+def default_worker_retries():
+    """DataLoader worker-task retry budget before the loud in-process
+    fallback (MXNET_WORKER_RETRIES, default 2)."""
+    import os
+
+    v = os.environ.get("MXNET_WORKER_RETRIES")
+    try:
+        return max(0, int(v)) if v else 2
+    except ValueError:
+        return 2

@@ -66,6 +66,12 @@ _QUICK = {
     # on a hybridized model_zoo block (ISSUE 1 CI gates)
     "test_analysis.py::test_framework_lint_tree_is_clean",
     "test_analysis.py::test_audit_hybridized_model_zoo_clean",
+    # fault-tolerance subsystem (ISSUE 3 gates): worker-death + kvstore
+    # retry suites, checkpoint fallback, and the chaos-convergence gate
+    "test_fault.py::test_kvstore_push_retries_injected_fault",
+    "test_fault.py::test_dataloader_worker_fault_retry",
+    "test_fault.py::test_checkpoint_checksum_fallback",
+    "test_fault.py::test_estimator_chaos_convergence",
 }
 
 

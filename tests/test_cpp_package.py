@@ -54,6 +54,7 @@ def test_cpp_frontend_trains():
     run = subprocess.run([exe, REPO], capture_output=True, text=True,
                          env=env, timeout=600)
     out = run.stdout
+    assert "PASS optimizer_failfast" in out, (out, run.stderr[-2000:])
     assert "PASS train_loss_drops" in out, (out, run.stderr[-2000:])
     assert "PASS train_accuracy" in out
     assert "PASS params_roundtrip" in out

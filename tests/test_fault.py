@@ -1,0 +1,642 @@
+"""Fault-tolerance subsystem (ISSUE 3): deterministic injection, retry
+policies, checksummed checkpoint fallback, DataLoader self-healing, and
+the Estimator chaos-convergence acceptance gate (RESILIENCE.md)."""
+import logging
+import os
+import sys
+import time
+
+import numpy as onp
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import fault, gluon, np, preemption
+from incubator_mxnet_tpu.fault import injection, retry
+from incubator_mxnet_tpu.telemetry import registry
+from incubator_mxnet_tpu.test_utils import environment
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _counter(name):
+    rep = registry.report()
+    return rep.get(name, {}).get("value", 0) or 0
+
+
+@pytest.fixture(autouse=True)
+def _clear_schedule():
+    injection.clear_injection()
+    yield
+    injection.clear_injection()
+
+
+@pytest.fixture()
+def _fast_retries():
+    with environment("MXNET_RETRY_BASE_DELAY_MS", "1"):
+        yield
+
+
+# ---------------------------------------------------------------------------
+# injection
+# ---------------------------------------------------------------------------
+
+def test_injection_spec_parse_and_determinism():
+    def fire_pattern():
+        injection.configure_injection("kvstore_push:0.5:42")
+        fired = []
+        for i in range(100):
+            try:
+                injection.inject_at("kvstore_push")
+            except fault.FaultInjected:
+                fired.append(i)
+        return fired
+
+    a = fire_pattern()
+    b = fire_pattern()
+    assert a and a == b                     # seeded: replays exactly
+    assert 20 < len(a) < 80                 # ~Bernoulli(0.5)
+    info = injection.schedule_info()
+    assert info["kvstore_push"]["draws"] == 100
+    assert info["kvstore_push"]["fired"] == len(b)
+
+
+def test_injection_limit_caps_fires():
+    injection.configure_injection("estimator_step:1.0:0:2")
+    outcomes = []
+    for _ in range(5):
+        try:
+            injection.inject_at("estimator_step")
+            outcomes.append("ok")
+        except fault.FaultInjected:
+            outcomes.append("boom")
+    assert outcomes == ["boom", "boom", "ok", "ok", "ok"]
+
+
+def test_injection_bad_spec_raises():
+    with pytest.raises(ValueError, match="unknown seam"):
+        injection.configure_injection("not_a_seam:0.5")
+    with pytest.raises(ValueError, match="prob"):
+        injection.configure_injection("h2d:1.5")
+    with pytest.raises(ValueError, match="expected"):
+        injection.configure_injection("h2d")
+    # a schedule never half-arms after a bad spec
+    assert not injection.injection_enabled()
+
+
+def test_h2d_seam_arms_the_ndarray_hook():
+    from incubator_mxnet_tpu.ndarray import ndarray as nd_mod
+
+    assert nd_mod._FAULT_HOOK is None
+    injection.configure_injection("h2d:1.0:0:1")
+    assert nd_mod._FAULT_HOOK is not None
+    with pytest.raises(fault.FaultInjected, match="seam 'h2d'"):
+        np.array([1.0, 2.0])
+    ok = np.array([1.0, 2.0])               # limit reached: next is clean
+    assert onp.allclose(ok.asnumpy(), [1.0, 2.0])
+    injection.clear_injection()
+    assert nd_mod._FAULT_HOOK is None
+
+
+def test_injection_off_is_dead_branch():
+    """MXNET_FAULT_INJECT-unset contract (the ISSUE 3 overhead gate,
+    reusing the PR-2 stage-trace harness shape): the h2d probe is one
+    global-load + is-None check per NDArray inlet — measured <3% of a
+    funnel op."""
+    from incubator_mxnet_tpu.ndarray import ndarray as nd_mod
+
+    assert nd_mod._FAULT_HOOK is None       # off by default
+    assert injection.schedule_info() == {}
+    a = np.array(onp.random.RandomState(0).uniform(-1, 1, (16, 16))
+                 .astype("float32"))
+    np.dot(a, a).wait_to_read()             # warm compile caches
+    iters = 300
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        np.dot(a, a)
+    mx.waitall()
+    per_op = (time.perf_counter() - t0) / iters
+    fh = nd_mod._FAULT_HOOK
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        if fh is not None:                  # the literal off-path pattern
+            pass
+    probe_per_op = (time.perf_counter() - t0) / iters
+    assert probe_per_op < 0.03 * per_op, (probe_per_op, per_op)
+
+
+def test_env_knob_arms_injection():
+    from incubator_mxnet_tpu import util
+
+    assert "MXNET_FAULT_INJECT" in util.env_knobs()
+    assert "MXNET_RETRY_MAX" in util.env_knobs()
+    with environment("MXNET_FAULT_INJECT", "h2d:0.0:7"):
+        util._apply_env_config()
+        assert injection.injection_enabled("h2d")
+        assert not injection.injection_enabled("kvstore_push")
+
+
+# ---------------------------------------------------------------------------
+# retry policies
+# ---------------------------------------------------------------------------
+
+def test_classify_exception():
+    assert retry.classify_exception(ConnectionResetError()) == "retryable"
+    assert retry.classify_exception(TimeoutError()) == "retryable"
+    assert retry.classify_exception(fault.FaultInjected("h2d", 1)) \
+        == "retryable"
+    assert retry.classify_exception(RuntimeError("fabric")) == "retryable"
+    assert retry.classify_exception(ValueError("bug")) == "fatal"
+    assert retry.classify_exception(TypeError("bug")) == "fatal"
+    import multiprocessing as mp
+
+    assert retry.classify_exception(mp.TimeoutError()) == "retryable"
+
+
+def test_retry_policy_backoff_and_success():
+    sleeps = []
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise ConnectionError("transient")
+        return "ok"
+
+    pol = fault.RetryPolicy(max_retries=3, base_delay=0.05, multiplier=2.0,
+                            jitter=0.0, sleep=sleeps.append, name="t")
+    before = _counter("mx_retries_total")
+    assert pol.call(flaky) == "ok"
+    assert len(calls) == 3
+    assert sleeps == [0.05, 0.1]            # deterministic exp backoff
+    assert _counter("mx_retries_total") == before + 2
+
+
+def test_retry_policy_fatal_not_retried():
+    calls = []
+
+    def buggy():
+        calls.append(1)
+        raise ValueError("deterministic bug")
+
+    pol = fault.RetryPolicy(max_retries=5, jitter=0.0, sleep=lambda d: None)
+    with pytest.raises(ValueError):
+        pol.call(buggy)
+    assert len(calls) == 1                  # no budget burned on a bug
+
+
+def test_retry_policy_exhaustion_and_deadline():
+    pol = fault.RetryPolicy(max_retries=2, base_delay=0.0, jitter=0.0,
+                            sleep=lambda d: None, name="x")
+
+    def always():
+        raise ConnectionError("down")
+
+    with pytest.raises(fault.RetryExhausted) as ei:
+        pol.call(always)
+    assert ei.value.attempts == 3
+    assert isinstance(ei.value.last, ConnectionError)
+
+    hard = fault.RetryPolicy(max_retries=100, base_delay=0.0, jitter=0.0,
+                             deadline=0.0, sleep=lambda d: None)
+    with pytest.raises(fault.RetryExhausted):
+        hard.call(always)                   # deadline, not attempts
+
+
+def test_retry_from_env_and_suppressed(caplog):
+    import logging
+
+    with environment({"MXNET_RETRY_MAX": "7",
+                      "MXNET_RETRY_BASE_DELAY_MS": "125",
+                      "MXNET_RETRY_DEADLINE_S": "9"}):
+        pol = fault.RetryPolicy.from_env("envtest")
+    assert pol.max_retries == 7
+    assert pol.base_delay == 0.125
+    assert pol.deadline == 9.0
+    with caplog.at_level(logging.DEBUG, "incubator_mxnet_tpu.fault"):
+        kind = fault.suppressed("test.site", ConnectionError("noise"))
+    assert kind == "retryable"
+    assert any("suppressed@test.site" in r.getMessage()
+               for r in caplog.records)
+
+
+# ---------------------------------------------------------------------------
+# kvstore retry suite (quick-marked for tier-1)
+# ---------------------------------------------------------------------------
+
+def test_kvstore_push_retries_injected_fault(_fast_retries):
+    injection.configure_injection("kvstore_push:1.0:0:2")
+    before_r = _counter("mx_retries_total")
+    before_f = _counter("mx_faults_injected_total")
+    kv = mx.kv.create("local")
+    kv.init("w", np.array([1.0, 2.0]))      # init is probe-free
+    kv.push("w", np.array([0.5, 0.5]))      # fails twice, succeeds on 3rd
+    assert _counter("mx_retries_total") == before_r + 2
+    assert _counter("mx_faults_injected_total") == before_f + 2
+    out = kv.pull("w")
+    assert out is not None                  # store intact after retries
+
+
+def test_kvstore_retry_exhaustion_surfaces(_fast_retries):
+    injection.configure_injection("kvstore_pull:1.0:0:99")
+    kv = mx.kv.create("local")
+    kv.init("w", np.array([1.0]))
+    with pytest.raises(fault.RetryExhausted):
+        kv.pull("w")
+
+
+def test_kvstore_barrier_probe(_fast_retries):
+    injection.configure_injection("kvstore_barrier:1.0:0:1")
+    before = _counter("mx_retries_total")
+    kv = mx.kv.create("local")
+    kv.barrier()                            # one fault, one retry, success
+    assert _counter("mx_retries_total") == before + 1
+
+
+# ---------------------------------------------------------------------------
+# checkpoint checksum + generation fallback
+# ---------------------------------------------------------------------------
+
+def _make_checkpointer(tmp_path, every_n=1, keep=3):
+    net = gluon.nn.Dense(4)
+    net.initialize()
+    net(np.array(onp.ones((2, 3), "float32")))
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1})
+    ck = preemption.TrainingCheckpointer(
+        str(tmp_path / "ck"), net, trainer, every_n=every_n, keep=keep,
+        register_signal=False)
+    return net, trainer, ck
+
+
+def test_checkpoint_checksum_fallback(tmp_path, caplog):
+    """ISSUE 3 satellite: a corrupted/truncated newest checkpoint raises a
+    clear error (logged), then resume auto-falls-back to the prior
+    generation."""
+    import logging
+
+    _net, _trainer, ck = _make_checkpointer(tmp_path)
+    ck.step()
+    ck.step()
+    ck.step()
+    gens = ck._mgr.generations()
+    assert len(gens) == 3
+    assert preemption.verify_checkpoint(gens[-1]) is True
+    with open(gens[-1], "r+b") as f:
+        f.truncate(10)                      # torn write
+    assert preemption.verify_checkpoint(gens[-1]) is False
+    before = _counter("mx_checkpoint_fallbacks_total")
+    with caplog.at_level(logging.ERROR, "incubator_mxnet_tpu.fault"):
+        step = ck.resume()
+    assert step == 2                        # prior generation restored
+    assert _counter("mx_checkpoint_fallbacks_total") == before + 1
+    joined = " ".join(r.getMessage() for r in caplog.records)
+    assert "checksum validation" in joined
+    assert "falling back" in joined
+
+
+def test_checkpoint_all_corrupt_raises_clear_error(tmp_path):
+    _net, _trainer, ck = _make_checkpointer(tmp_path)
+    ck.step()
+    ck.step()
+    for g in ck._mgr.generations():
+        with open(g, "r+b") as f:
+            f.truncate(5)
+    with pytest.raises(mx.base.MXNetError, match="all 2 generation"):
+        ck.resume()
+
+
+def test_atomic_save_retries_injected_write_fault(tmp_path, _fast_retries):
+    injection.configure_injection("checkpoint_write:1.0:0:1")
+    before = _counter("mx_retries_total")
+    path = preemption.atomic_save(
+        str(tmp_path / "x.bin"), lambda t: open(t, "wb").write(b"hello"))
+    assert open(path, "rb").read() == b"hello"
+    assert preemption.verify_checkpoint(path) is True
+    assert _counter("mx_retries_total") == before + 1
+
+
+def test_save_parameters_checksum_roundtrip(tmp_path):
+    net = gluon.nn.Dense(3)
+    net.initialize()
+    net(np.array(onp.ones((2, 4), "float32")))
+    p = str(tmp_path / "net.params")
+    net.save_parameters(p)
+    assert preemption.verify_checkpoint(p) is True
+    net.load_parameters(p)                  # clean load passes validation
+    with open(p, "r+b") as f:
+        f.truncate(max(1, os.path.getsize(p) // 2))
+    with pytest.raises(mx.base.MXNetError, match="checksum"):
+        net.load_parameters(p)
+
+
+def test_trainer_states_checksum_roundtrip(tmp_path):
+    net = gluon.nn.Dense(2)
+    net.initialize()
+    net(np.array(onp.ones((2, 3), "float32")))
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 1e-3})
+    s = str(tmp_path / "trainer.states")
+    trainer.save_states(s)
+    assert preemption.verify_checkpoint(s) is True
+    trainer.load_states(s)
+    with open(s, "r+b") as f:
+        f.truncate(3)
+    with pytest.raises(mx.base.MXNetError, match="checksum"):
+        trainer.load_states(s)
+
+
+# ---------------------------------------------------------------------------
+# DataLoader self-healing (worker-death suite, quick-marked for tier-1)
+# ---------------------------------------------------------------------------
+
+class _BadItemDataset:
+    """Deterministic dataset bug: index 3 always raises ValueError."""
+
+    def __init__(self, n=16):
+        self._n = n
+
+    def __len__(self):
+        return self._n
+
+    def __getitem__(self, i):
+        if i == 3:
+            raise ValueError("index 3 is broken, every time")
+        return onp.full((4,), i, "float32")
+
+
+def test_dataloader_worker_fault_retry():
+    """Injected worker faults (env-armed in the spawned workers) are
+    retried against the pool; every batch arrives, in order."""
+    from incubator_mxnet_tpu.gluon.data.dataloader import DataLoader
+    from incubator_mxnet_tpu.gluon.data.dataset import ArrayDataset
+
+    X = onp.arange(64, dtype="float32").reshape(16, 4)
+    before = _counter("mx_retries_total")
+    # spawn (not forkserver): the forkserver freezes its env at first
+    # use, so per-test MXNET_FAULT_INJECT would never reach the workers
+    with environment({"MXNET_FAULT_INJECT": "dataloader_worker:1.0:0:2",
+                      "MXNET_MP_START_METHOD": "spawn"}):
+        injection.clear_injection()         # parent probes stay dead
+        loader = DataLoader(ArrayDataset(X), batch_size=4, num_workers=2,
+                            timeout=120)
+        got = [b.asnumpy() for b in loader]
+    assert len(got) == 4
+    assert onp.allclose(onp.concatenate(got), X)   # order preserved
+    assert _counter("mx_retries_total") > before
+
+
+def test_dataloader_retries_exhausted_falls_back_inprocess():
+    """A worker seam hot enough to outlive the retry budget degrades to
+    the loud single-process fallback — data still correct and ordered."""
+    from incubator_mxnet_tpu.gluon.data.dataloader import DataLoader
+    from incubator_mxnet_tpu.gluon.data.dataset import ArrayDataset
+
+    X = onp.arange(32, dtype="float32").reshape(8, 4)
+    before = _counter("mx_dataloader_fallbacks_total")
+    with environment({"MXNET_FAULT_INJECT": "dataloader_worker:1.0:0:99",
+                      "MXNET_WORKER_RETRIES": "1",
+                      "MXNET_MP_START_METHOD": "spawn"}):
+        injection.clear_injection()
+        loader = DataLoader(ArrayDataset(X), batch_size=4, num_workers=1,
+                            timeout=120)
+        got = [b.asnumpy() for b in loader]
+    assert onp.allclose(onp.concatenate(got), X)
+    assert _counter("mx_dataloader_fallbacks_total") > before
+
+
+def test_dataloader_fatal_error_propagates():
+    """A deterministic dataset bug is classified fatal and re-raised —
+    not laundered through the retry budget."""
+    from incubator_mxnet_tpu.gluon.data.dataloader import DataLoader
+
+    loader = DataLoader(_BadItemDataset(), batch_size=4, num_workers=1,
+                        timeout=120)
+    with pytest.raises(ValueError, match="index 3 is broken"):
+        list(loader)
+
+
+# ---------------------------------------------------------------------------
+# estimator resilience + the chaos-convergence acceptance gate
+# ---------------------------------------------------------------------------
+
+def _fit_linear(X, Y, tmp_path, tag, handlers_extra=(), epochs=2):
+    from incubator_mxnet_tpu.gluon.contrib.estimator import Estimator
+    from incubator_mxnet_tpu.gluon.data.dataloader import DataLoader
+    from incubator_mxnet_tpu.gluon.data.dataset import ArrayDataset
+
+    onp.random.seed(0)
+    mx.random.seed(0)
+    net = gluon.nn.Dense(1)
+    net.initialize()
+    net(np.array(X[:2]))
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1})
+    ck = preemption.TrainingCheckpointer(
+        str(tmp_path / f"ck_{tag}"), net, trainer, every_n=1, keep=3,
+        register_signal=False)
+    handler = fault.ResilienceHandler(checkpointer=ck)
+    est = Estimator(net, gluon.loss.L2Loss(), trainer=trainer,
+                    train_metrics=[gluon.metric.MAE()])
+    est.logger.setLevel(logging.ERROR)                 # quiet: recovery still counted
+    loader = DataLoader(ArrayDataset(X, Y), batch_size=8, num_workers=0)
+    est.fit(loader, epochs=epochs,
+            event_handlers=[handler, *handlers_extra])
+    return net, ck
+
+
+def test_estimator_chaos_convergence(tmp_path, _fast_retries):
+    """ISSUE 3 acceptance gate: an Estimator run under an
+    MXNET_FAULT_INJECT schedule (worker faults + one mid-step crash + one
+    corrupted checkpoint generation) auto-recovers and lands within
+    tolerance of the unfaulted run's final loss, with the recovery
+    metrics nonzero in the registry dump."""
+    rng = onp.random.RandomState(7)
+    X = rng.uniform(-1, 1, (128, 8)).astype("float32")
+    w = rng.uniform(-1, 1, (8, 1)).astype("float32")
+    Y = X @ w
+    X[5] = onp.nan                          # one non-finite batch per epoch
+    Xv = rng.uniform(-1, 1, (64, 8)).astype("float32")
+    Yv = Xv @ w
+
+    def val_loss(net):
+        d = net(np.array(Xv)).asnumpy() - Yv
+        return float(0.5 * (d * d).mean())
+
+    # -- unfaulted reference run (same data, same guard, no chaos) --
+    net_a, _ = _fit_linear(X, Y, tmp_path, "clean", epochs=4)
+    loss_a = val_loss(net_a)
+
+    # -- chaos run --
+    skipped0 = _counter("mx_steps_skipped_nonfinite_total")
+    resumes0 = _counter("mx_resumes_total")
+    retries0 = _counter("mx_retries_total")
+    fallback0 = _counter("mx_checkpoint_fallbacks_total")
+
+    spec = ("dataloader_worker:1.0:0:1,"    # worker fault (env-armed)
+            "estimator_step:1.0:0:1")       # one mid-step crash (batch 1)
+    with environment({"MXNET_FAULT_INJECT": spec,
+                      "MXNET_MP_START_METHOD": "spawn"}):
+        injection.configure_injection(spec)
+
+        # the crash fires on the FIRST batch — pre-seed two checkpoint
+        # generations (init state) and corrupt the newest so the resume
+        # path must checksum-fail it and fall back to the older one
+        from incubator_mxnet_tpu.gluon.contrib.estimator import Estimator
+        from incubator_mxnet_tpu.gluon.data.dataloader import DataLoader
+        from incubator_mxnet_tpu.gluon.data.dataset import ArrayDataset
+
+        onp.random.seed(0)
+        mx.random.seed(0)
+        net_b = gluon.nn.Dense(1)
+        net_b.initialize()
+        net_b(np.array(X[:2]))
+        trainer_b = gluon.Trainer(net_b.collect_params(), "sgd",
+                                  {"learning_rate": 0.1})
+        ck_b = preemption.TrainingCheckpointer(
+            str(tmp_path / "ck_chaos"), net_b, trainer_b, every_n=1,
+            keep=3, register_signal=False)
+        ck_b.step()
+        ck_b.step()                         # two pre-run generations
+        newest = ck_b._mgr.generations()[-1]
+        with open(newest, "r+b") as f:
+            f.truncate(8)                   # the "one corrupted checkpoint"
+
+        handler = fault.ResilienceHandler(checkpointer=ck_b)
+        est = Estimator(net_b, gluon.loss.L2Loss(), trainer=trainer_b,
+                        train_metrics=[gluon.metric.MAE()])
+        est.logger.setLevel(logging.ERROR)
+        loader = DataLoader(ArrayDataset(X, Y), batch_size=8,
+                            num_workers=1, timeout=120)
+        est.fit(loader, epochs=4, event_handlers=[handler])
+    loss_b = val_loss(net_b)
+
+    # auto-recovery happened, and it was measured (the gate's metrics)
+    assert _counter("mx_steps_skipped_nonfinite_total") > skipped0
+    assert _counter("mx_resumes_total") > resumes0
+    assert _counter("mx_retries_total") > retries0
+    assert _counter("mx_checkpoint_fallbacks_total") > fallback0
+    info = injection.schedule_info()
+    assert info["estimator_step"]["fired"] == 1
+
+    # ...and the chaos run converged to the unfaulted run's loss
+    assert loss_a < 0.05, loss_a            # both actually learned
+    assert loss_b < 0.05, loss_b
+    assert abs(loss_a - loss_b) <= 0.02, (loss_a, loss_b)
+
+
+def test_resilience_consecutive_skip_bound(tmp_path):
+    """An always-NaN model fails loudly instead of spinning forever."""
+    rng = onp.random.RandomState(0)
+    X = onp.full((32, 4), onp.nan, "float32")
+    Y = rng.uniform(-1, 1, (32, 1)).astype("float32")
+    from incubator_mxnet_tpu.gluon.contrib.estimator import Estimator
+    from incubator_mxnet_tpu.gluon.data.dataloader import DataLoader
+    from incubator_mxnet_tpu.gluon.data.dataset import ArrayDataset
+
+    net = gluon.nn.Dense(1)
+    net.initialize()
+    net(np.array(X[:2]))
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1})
+    handler = fault.ResilienceHandler(max_consecutive_skips=2)
+    est = Estimator(net, gluon.loss.L2Loss(), trainer=trainer,
+                    train_metrics=[gluon.metric.MAE()])
+    est.logger.setLevel(logging.ERROR)
+    loader = DataLoader(ArrayDataset(X, Y), batch_size=8, num_workers=0)
+    with pytest.raises(mx.base.MXNetError, match="non-finite-loss steps"):
+        est.fit(loader, epochs=5, event_handlers=[handler])
+
+
+def test_resilience_amp_backoff(tmp_path):
+    """A skipped non-finite step halves the live AMP loss scale."""
+    from incubator_mxnet_tpu import amp
+    from incubator_mxnet_tpu.gluon.contrib.estimator import Estimator
+    from incubator_mxnet_tpu.gluon.data.dataloader import DataLoader
+    from incubator_mxnet_tpu.gluon.data.dataset import ArrayDataset
+
+    rng = onp.random.RandomState(0)
+    X = rng.uniform(-1, 1, (32, 4)).astype("float32")
+    Y = (X @ rng.uniform(-1, 1, (4, 1)).astype("float32"))
+    X[1] = onp.nan
+    net = gluon.nn.Dense(1)
+    net.initialize()
+    net(np.array(X[:2]))
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.01})
+    amp.init("bfloat16")
+    try:
+        amp.scale_loss._scaler = None       # fresh scaler for the assert
+        with amp.scale_loss(np.array([1.0]), trainer):
+            pass                            # instantiates the scaler
+        scale0 = amp.scale_loss._scaler.loss_scale
+        handler = fault.ResilienceHandler()
+        est = Estimator(net, gluon.loss.L2Loss(), trainer=trainer,
+                        train_metrics=[gluon.metric.MAE()])
+        est.logger.setLevel(logging.ERROR)
+        loader = DataLoader(ArrayDataset(X, Y), batch_size=8,
+                            num_workers=0)
+        est.fit(loader, epochs=1, event_handlers=[handler])
+        assert amp.scale_loss._scaler.loss_scale < scale0
+    finally:
+        amp.deinit()
+
+
+# ---------------------------------------------------------------------------
+# lint FL006
+# ---------------------------------------------------------------------------
+
+def _lint():
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import framework_lint
+    finally:
+        sys.path.pop(0)
+    return framework_lint
+
+
+def test_lint_fl006_flags_silent_swallows():
+    fl = _lint()
+    bad = ("def f():\n"
+           "    try:\n"
+           "        g()\n"
+           "    except Exception:\n"
+           "        pass\n")
+    rules = {x.rule for x in fl.lint_source(bad, "pkg/mod.py")}
+    assert "FL006" in rules
+    bare = bad.replace("except Exception:", "except:")
+    assert "FL006" in {x.rule for x in fl.lint_source(bare, "pkg/mod.py")}
+
+
+def test_lint_fl006_escapes():
+    fl = _lint()
+    noqa = ("def f():\n"
+            "    try:\n"
+            "        g()\n"
+            "    except Exception:  # noqa: FL006 — teardown\n"
+            "        pass\n")
+    assert "FL006" not in {x.rule
+                           for x in fl.lint_source(noqa, "pkg/mod.py")}
+    logged = ("def f():\n"
+              "    try:\n"
+              "        g()\n"
+              "    except Exception as e:\n"
+              "        log(e)\n")
+    assert "FL006" not in {x.rule
+                           for x in fl.lint_source(logged, "pkg/mod.py")}
+    narrow = ("def f():\n"
+              "    try:\n"
+              "        g()\n"
+              "    except OSError:\n"
+              "        pass\n")
+    assert "FL006" not in {x.rule
+                           for x in fl.lint_source(narrow, "pkg/mod.py")}
+
+
+def test_cpp_bridge_optimizer_failfast():
+    """VERDICT Weak #9 satellite: the C++ Optimizer ctor validates via
+    `_cpp_train.check_optimizer` — unknown names raise at construction."""
+    from incubator_mxnet_tpu._cpp_train import check_optimizer
+
+    assert check_optimizer("SGD") == "sgd"
+    with pytest.raises(ValueError, match="unknown optimizer"):
+        check_optimizer("definitely_not_an_optimizer")

@@ -50,9 +50,14 @@ def test_manager_cadence_rotation_and_trigger(tmp_path):
                           register_signal=True)
     for _ in range(35):
         m.step()
-    # cadence saves at 10/20/30, rotation keeps the last 2
-    kept = sorted(os.listdir(tmp_path))
+    # cadence saves at 10/20/30, rotation keeps the last 2 (each with its
+    # .crc32 checksum sidecar — the fault subsystem's validation trail)
+    kept = sorted(f for f in os.listdir(tmp_path) if f.endswith(".ckpt"))
     assert kept == ["run-0000020.ckpt", "run-0000030.ckpt"], kept
+    sidecars = sorted(f for f in os.listdir(tmp_path)
+                      if f.endswith(".crc32"))
+    assert sidecars == ["run-0000020.ckpt.crc32",
+                        "run-0000030.ckpt.crc32"], sidecars
     # preemption triggers an immediate save of step 35
     trigger()
     assert preempted()

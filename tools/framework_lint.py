@@ -31,6 +31,13 @@ FL005  ad-hoc timing in kernel bodies: ``time.time()`` /
        measure dispatch, not device execution, on an async backend, and
        (b) produce numbers nobody owns (the VERDICT r5 drift class) —
        route timing through `telemetry.registry` / `profiler.Scope`.
+FL006  silent swallow: a broad handler (``except Exception:`` /
+       ``except BaseException:`` / bare ``except:``) whose body does
+       NOTHING (only pass/continue/break/...). Silent swallows hid the
+       DataLoader and dist failure modes ISSUE 3 is about — log and
+       classify instead (`fault.retry.suppressed`), or, where silence is
+       genuinely required (interpreter teardown), annotate the handler
+       line with ``# noqa: FL006`` and a justifying comment.
 
 Usage
 -----
@@ -57,6 +64,8 @@ RULES = {
     "FL004": "registered op name missing from OPS_COVERAGE.md",
     "FL005": "ad-hoc time.time()/perf_counter() in an ops/ kernel body "
              "(bypasses the telemetry API)",
+    "FL006": "silent `except Exception: pass` swallow (log/classify via "
+             "fault.retry.suppressed, or `# noqa: FL006` with a reason)",
 }
 
 _INDEXING_NAME_PARTS = ("getitem", "setitem", "index", "slice")
@@ -256,6 +265,53 @@ def _check_adhoc_timing(tree, path, findings):
 
 
 # ---------------------------------------------------------------------------
+# FL006 — silent broad-exception swallows
+# ---------------------------------------------------------------------------
+
+_BROAD_EXC_NAMES = ("Exception", "BaseException")
+
+
+def _is_broad_handler(handler):
+    t = handler.type
+    if t is None:                               # bare `except:`
+        return True
+    if isinstance(t, ast.Name):
+        return t.id in _BROAD_EXC_NAMES
+    if isinstance(t, ast.Tuple):
+        return any(isinstance(e, ast.Name) and e.id in _BROAD_EXC_NAMES
+                   for e in t.elts)
+    return False
+
+
+def _is_silent_body(body):
+    """True when the handler body cannot possibly record the error: only
+    pass/continue/break/... statements (a docstring-only body counts)."""
+    return all(
+        isinstance(s, (ast.Pass, ast.Continue, ast.Break))
+        or (isinstance(s, ast.Expr) and isinstance(s.value, ast.Constant))
+        for s in body)
+
+
+def _check_silent_swallow(tree, path, findings, src_lines):
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        if not _is_broad_handler(node) or not _is_silent_body(node.body):
+            continue
+        last = getattr(node.body[-1], "end_lineno", node.body[-1].lineno)
+        span = src_lines[node.lineno - 1:last] if src_lines else []
+        if any("noqa: FL006" in ln for ln in span):
+            continue
+        caught = "bare except" if node.type is None \
+            else f"except {ast.unparse(node.type)}"
+        findings.append(LintFinding(
+            path, node.lineno, "FL006",
+            f"silent `{caught}` swallow: the error vanishes without a "
+            "trace — log+classify it (fault.retry.suppressed) or mark "
+            "the handler `# noqa: FL006` with a justifying comment"))
+
+
+# ---------------------------------------------------------------------------
 # FL004 — registered op names present in OPS_COVERAGE.md
 # ---------------------------------------------------------------------------
 
@@ -310,6 +366,7 @@ def lint_source(src, path, coverage_text=None):
     _check_bool_leak(tree, path, findings)
     _check_host_numpy(tree, path, findings)
     _check_adhoc_timing(tree, path, findings)
+    _check_silent_swallow(tree, path, findings, src.splitlines())
     _check_ops_ledger(tree, path, findings, coverage_text)
     return findings
 
