@@ -23,6 +23,11 @@ extras:
   ratio compares against the per-token full re-forward the serving path
   used before round 4 (directly measured once at 1152x; the in-bench
   proxy times one eager forward, min-of-3).
+- gpt_serve_tokens_s + gpt_serve_ttft_p50/p99_ms: mx.serve continuous
+  batching under a seeded Poisson arrival trace (32 requests, 8 slots,
+  varied prompts/budgets) — aggregate serving throughput incl. queueing
+  and per-request time-to-first-token, with mean slot occupancy read
+  from the telemetry registry (see SERVING.md).
 - resnet50_fp32/int8_infer_img_s: batch-64 serving, interleaved
   fp32/int8 rounds (best-of-rounds wall rates + median wall ratio).
   Wall numbers on THIS deployment are LINK-bound (the tunnel's RPC rate
@@ -445,6 +450,83 @@ def bench_gpt_decode(batch=8, prompt=32, new_tokens=224):
     return tokens_s, nocache_tokens_s, vs_nocache, eager_est_ratio
 
 
+def bench_gpt_serve(requests=32, max_slots=8, prompt_max=64, new_max=96,
+                    mean_interarrival_s=0.03, seed=0):
+    """Continuous-batching serving (mx.serve) under a SEEDED Poisson
+    arrival trace: 32 requests with varied prompt lengths and token
+    budgets arrive at exp(λ)-spaced times and share `max_slots` decode
+    slots of one persistent compiled program pair (SERVING.md).
+
+    Reported: aggregate generated tokens/s over the whole trace (first
+    submit → last completion — includes queueing, so it is a SERVING
+    number, not the batch-decode ceiling `gpt_decode_tokens_s`), TTFT
+    p50/p99 (submit → first token, prefill-bound + queue wait), and the
+    mean slot occupancy sampled from the telemetry registry after every
+    step (the registry owns the series; the bench just reads it).
+
+    Loud-failure contract: a degenerate run (any failed request, zero
+    tokens, non-finite rate) raises — it must land in extras["errors"],
+    never pass as a small number."""
+    from incubator_mxnet_tpu import serve
+    from incubator_mxnet_tpu.models.gpt import GPTModel
+    from incubator_mxnet_tpu.telemetry import registry as _telem
+
+    vocab = 8000
+    max_len = 192                       # prompt (≤64) + budget (≤96) + slack
+    net = GPTModel(vocab, 512, 2048, 8, 8, max_length=max_len, dropout=0.0)
+    net.initialize()
+    rng = onp.random.RandomState(seed)
+    prompts = [rng.randint(0, vocab, (int(rng.randint(8, prompt_max)),))
+               .astype(onp.int32) for _ in range(requests)]
+    budgets = [int(rng.randint(new_max // 2, new_max))
+               for _ in range(requests)]
+    arrivals = onp.cumsum(rng.exponential(mean_interarrival_s, requests))
+
+    engine = serve.ServeEngine(net, max_slots=max_slots, max_len=max_len)
+    # warm every program the trace will touch (prefill buckets 32 and 64
+    # + the decode program) so compile time stays out of the clock
+    for warm_len in (16, 48):
+        engine.generate(onp.resize(prompts[0], warm_len), 2)
+    occ_gauge = _telem.gauge("mx_serve_slot_occupancy")
+
+    handles = []
+    occ_samples = []
+    i = 0
+    t0 = time.perf_counter()
+    while i < requests or not all(h.done for h in handles):
+        now = time.perf_counter() - t0
+        while i < requests and arrivals[i] <= now:
+            handles.append(engine.submit(prompts[i], budgets[i]))
+            i += 1
+        progressed = engine.step()
+        if handles:
+            occ_samples.append(float(occ_gauge.value or 0.0))
+        if not progressed and i < requests:
+            time.sleep(min(0.001, arrivals[i] - (time.perf_counter() - t0)
+                           if arrivals[i] > now else 0.001))
+    t_total = time.perf_counter() - t0
+    engine.shutdown(drain=True)
+
+    failed = [h for h in handles if h.error is not None]
+    if failed:
+        raise RuntimeError(
+            f"{len(failed)}/{requests} serve requests failed; first: "
+            f"{type(failed[0].error).__name__}: {failed[0].error}")
+    total_new = sum(len(h.tokens) for h in handles)
+    ttfts = [h.ttft for h in handles]
+    if total_new == 0 or any(t is None for t in ttfts) or t_total <= 0:
+        raise RuntimeError(
+            f"degenerate serve run: tokens={total_new}, ttfts={ttfts[:4]}")
+    tokens_s = total_new / t_total
+    if not (tokens_s > 0 and tokens_s == tokens_s
+            and tokens_s != float("inf")):
+        raise RuntimeError(f"degenerate serve rate {tokens_s!r}")
+    p50 = float(onp.percentile(ttfts, 50)) * 1e3
+    p99 = float(onp.percentile(ttfts, 99)) * 1e3
+    mean_occ = float(onp.mean(occ_samples)) if occ_samples else 0.0
+    return tokens_s, p50, p99, mean_occ
+
+
 def bench_resnet50_infer_pair(batch=64, iters=10, rounds=3):
     """fp32 AND int8 inference measured in INTERLEAVED rounds
     (fp32,int8,fp32,int8,...) with best-of-rounds throughput and the
@@ -603,6 +685,17 @@ def main():
             "by gpt_decode_vs_nocache_compiled")
     except Exception as e:  # pragma: no cover
         _fail("gpt_decode", e)
+
+    try:
+        s_tok, s_p50, s_p99, s_occ = _retry(bench_gpt_serve)
+        # the serving story next to the batch-decode ceiling: aggregate
+        # tokens/s + TTFT under a seeded Poisson trace (32 reqs, 8 slots)
+        extras["gpt_serve_tokens_s"] = round(s_tok, 1)
+        extras["gpt_serve_ttft_p50_ms"] = round(s_p50, 1)
+        extras["gpt_serve_ttft_p99_ms"] = round(s_p99, 1)
+        extras["gpt_serve_mean_slot_occupancy"] = round(s_occ, 3)
+    except Exception as e:  # pragma: no cover
+        _fail("gpt_serve", e)
 
     try:
         (fp32_rate, int8_rate, ratio, dev32, dev8,

@@ -103,6 +103,7 @@ from . import util  # noqa: F401
 from . import analysis  # noqa: F401
 from . import telemetry  # noqa: F401
 from . import fault  # noqa: F401
+from . import serve  # noqa: F401
 
 util._apply_env_config()  # honor MXNET_* knobs (SURVEY §5.6)
 from . import test_utils  # noqa: F401

@@ -12,8 +12,9 @@ with three connected parts:
   "seam:prob[:seed[:limit]]"``) firing :class:`FaultInjected` at probe
   points threaded through the real seams: DataLoader worker bodies,
   kvstore push/pull/barrier, distributed init, the NDArray host→device
-  inlet, checkpoint writes, and the Estimator step body. Off = dead
-  branches (same discipline as `telemetry/stages.py`);
+  inlet, checkpoint writes, the Estimator step body, and the serving
+  engine's step loop (``serve_step``). Off = dead branches (same
+  discipline as `telemetry/stages.py`);
 - `retry`      — :class:`RetryPolicy` (jittered exponential backoff,
   deadline, retryable-vs-fatal classification) applied to distributed
   rendezvous, kvstore sync, checkpoint I/O, and DataLoader worker

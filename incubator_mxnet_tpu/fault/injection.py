@@ -38,6 +38,8 @@ Seams (where the probes live):
                              `telemetry/stages.py`)
 ``checkpoint_write``         `preemption.atomic_save` write body
 ``estimator_step``           `Estimator.fit` batch body (mid-step crash)
+``serve_step``               `serve.Scheduler.step` entry (serving-loop
+                             crash mid-flight; see SERVING.md)
 ===========================  ==============================================
 
 Off-path contract: when no schedule is configured, ``_SCHEDULE is None``
@@ -56,7 +58,7 @@ __all__ = ["FaultInjected", "SEAMS", "inject_at", "injection_enabled",
 
 SEAMS = ("dataloader_worker", "dataloader_worker_exit", "kvstore_push",
          "kvstore_pull", "kvstore_barrier", "dist_init", "h2d",
-         "checkpoint_write", "estimator_step")
+         "checkpoint_write", "estimator_step", "serve_step")
 
 
 class FaultInjected(RuntimeError):

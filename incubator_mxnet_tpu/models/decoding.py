@@ -24,9 +24,57 @@ eager full-forward loop, asserted by `tests/test_gpt.py`.
 from __future__ import annotations
 
 import functools
+import logging
 import math
 
-__all__ = ["GPTDecoder"]
+__all__ = ["GPTDecoder", "bucket_prompt", "PROMPT_BUCKETS"]
+
+_LOG = logging.getLogger("incubator_mxnet_tpu.models")
+
+#: Default pad-to-bucket prompt lengths. Ad-hoc prompt lengths each
+#: compile their own XLA program (the signature includes the prompt
+#: width); snapping to power-of-two buckets bounds the program count at
+#: len(PROMPT_BUCKETS) per (batch, max_new) — the waste is padding
+#: tokens, which `mx_decode_bucket_pad_tokens_total` makes visible.
+PROMPT_BUCKETS = (32, 64, 128, 256, 512)
+
+
+def bucket_prompt(ids, buckets=PROMPT_BUCKETS, max_len=None, pad_id=0):
+    """Pad token ids (N, T) to the smallest bucket >= T.
+
+    Returns ``(padded_ids, t0)`` where ``t0`` is the true prompt length.
+    Padding goes on the RIGHT with `pad_id`; the padded positions' K/V
+    are causally invisible to the last real token and are overwritten by
+    decode before the attention mask ever reaches them, so any valid
+    token id works as filler. Prompts longer than every bucket are
+    returned unpadded (exact-length compile, the pre-bucketing
+    behavior); `max_len` (when given) caps the chosen bucket.
+
+    Pads with host/device-agnostic `jnp.pad`; the padding waste is
+    accounted in the ``mx_decode_bucket_pad_tokens_total`` counter.
+    """
+    jnp = _j().numpy
+    ids = jnp.asarray(ids)
+    if ids.ndim != 2:
+        raise ValueError(f"bucket_prompt expects (N, T) ids, got "
+                         f"shape {ids.shape}")
+    n, t0 = ids.shape
+    fits = sorted(b for b in buckets
+                  if b >= t0 and (max_len is None or b <= max_len))
+    if not fits:
+        return ids, t0
+    bucket = fits[0]
+    if bucket == t0:
+        return ids, t0
+    padded = jnp.pad(ids, ((0, 0), (0, bucket - t0)),
+                     constant_values=pad_id)
+    from ..telemetry import registry
+
+    registry.counter(
+        "mx_decode_bucket_pad_tokens_total",
+        "prompt tokens added by pad-to-bucket in the decode/serving "
+        "path (padding waste)").inc(int(n * (bucket - t0)))
+    return padded, t0
 
 
 def _j():
@@ -81,6 +129,7 @@ class GPTDecoder:
         self._tie = model._tie
         self._max_length = int(model.position_embed.shape[0])
         self._param_ids = None
+        self._warned_stale = False
         self.refresh()
 
     # -- parameters ---------------------------------------------------------
@@ -218,20 +267,29 @@ class GPTDecoder:
         jnp = jax.numpy
         lax = jax.lax
 
-        def generate(params, tokens, key, temperature, *, max_new, top_k,
-                     do_sample, cache_len):
-            N, T0 = tokens.shape
+        def generate(params, tokens, t0, key, temperature, *, max_new,
+                     top_k, do_sample, cache_len):
+            # `tokens` is the BUCKET-padded prompt (N, B); `t0` is the
+            # true prompt length, a traced scalar so every length in the
+            # bucket shares one program. Padded positions write junk K/V
+            # beyond t0, but decode overwrites position p before the
+            # `arange <= pos` mask ever admits it, so the junk is never
+            # attended.
+            N, B = tokens.shape
             L = params["layers"]["ln1_g"].shape[0]
 
-            # ---- prefill: full causal pass over the prompt ----
-            x = params["embed"][tokens] + params["pos"][:T0]
+            # ---- prefill: full causal pass over the padded prompt ----
+            x = params["embed"][tokens] + params["pos"][:B]
 
             def pre_layer(x, lp):
                 x, k, v = self._prefill_layer(x, lp, cache_len)
                 return x, (k, v)
 
             x, (ck, cv) = lax.scan(pre_layer, x, params["layers"])
-            logits0 = self._logits(params, x[:, -1])     # (N, V)
+            # last REAL token (causal: its row never saw the padding)
+            logits0 = self._logits(
+                params, lax.dynamic_slice_in_dim(x, t0 - 1, 1,
+                                                 axis=1)[:, 0])  # (N, V)
 
             # ---- decode: one scan step per new token ----
             def step(carry, step_key):
@@ -260,7 +318,7 @@ class GPTDecoder:
             keys = jax.random.split(jax.random.fold_in(key, 1),
                                     max_new)[1:]
             (_, _, _, last), toks = lax.scan(
-                step, (ck, cv, jnp.int32(T0), first), keys)
+                step, (ck, cv, t0.astype(jnp.int32), first), keys)
             # toks holds the CARRIED token per step; append the final
             # sample to complete max_new outputs
             out = jnp.concatenate(
@@ -270,6 +328,23 @@ class GPTDecoder:
         return jax.jit(generate, static_argnames=("max_new", "top_k",
                                                   "do_sample", "cache_len"))
 
+    def _auto_refresh(self):
+        """Re-stack parameters if the model was updated since the last
+        read. `refresh()` after a parameter update is easy to forget, so
+        `generate` calls this on every entry (cheap identity walk): stale
+        params are re-read automatically, with a one-time warning so the
+        missing `refresh()` call gets fixed at the source."""
+        ids = self._current_ids()
+        if ids != self._param_ids:
+            if self._param_ids is not None and not self._warned_stale:
+                self._warned_stale = True
+                _LOG.warning(
+                    "GPTDecoder: model parameters changed since the last "
+                    "refresh(); auto-refreshing. Call refresh() after "
+                    "parameter updates to make the re-stack explicit.")
+            self._params = self._extract_params(self._model)
+            self._param_ids = ids
+
     def generate(self, tokens, max_new_tokens, temperature=1.0, top_k=None,
                  do_sample=False, seed=None):
         """Generate `max_new_tokens` continuations of `tokens` (N, T0).
@@ -277,12 +352,19 @@ class GPTDecoder:
         Greedy by default; `do_sample=True` draws from the
         temperature-scaled (optionally top-k-truncated) distribution
         using the framework RNG (`mx.random.seed` reproduces runs).
+
+        The prompt is padded to a :data:`PROMPT_BUCKETS` length bucket
+        before compile, so ad-hoc prompt lengths share one XLA program
+        per (batch, bucket, max_new) signature instead of one per exact
+        length. Parameters are auto-refreshed if the model changed since
+        the last read (see :meth:`_auto_refresh`).
         """
         jax = _j()
         jnp = jax.numpy
         from .. import random as mxrandom
         from ..ndarray.ndarray import NDArray
 
+        self._auto_refresh()
         toks = tokens._data if isinstance(tokens, NDArray) else \
             jnp.asarray(tokens)
         toks = toks.astype(jnp.int32)
@@ -294,15 +376,16 @@ class GPTDecoder:
             raise ValueError(
                 f"prompt ({T0}) + max_new_tokens ({max_new_tokens}) "
                 f"exceeds max_length ({self._max_length})")
+        padded, t0 = bucket_prompt(toks, max_len=self._max_length)
         if seed is not None:
             key = jax.random.PRNGKey(seed)
         else:
             key = mxrandom.next_key()
         new = self._generate_fn(
-            self._params, toks, key,
+            self._params, padded, jnp.int32(t0), key,
             jnp.float32(max(temperature, 1e-6)),
             max_new=max_new_tokens,
             top_k=None if top_k is None else int(top_k),
             do_sample=bool(do_sample),
-            cache_len=total)
+            cache_len=padded.shape[1] + max_new_tokens)
         return NDArray(jnp.concatenate([toks, new], axis=1))

@@ -1,0 +1,60 @@
+"""TPU-native continuous-batching inference serving (see SERVING.md).
+
+The framework's decode story before this subsystem was a single-job
+loop: one fixed batch, all requests starting and stopping together
+(`bench_gpt_decode`). Real serving is the opposite — requests arrive and
+finish at different times — and the known technique is continuous
+(iteration-level) batching with slot-based KV-cache management (Orca,
+OSDI '22; vLLM/PagedAttention, SOSP '23), adapted here to the TPU
+constraint that XLA programs are fixed-shape: instead of dynamic
+tensors, ONE compiled decode program stays alive and requests swap in
+and out of static batch slots.
+
+Three connected parts:
+
+- `engine`    — :class:`SlotDecoder`: the persistent device-side
+  ``(L, max_slots, H, max_len, d)`` KV cache and the two compiled
+  program families against it (bucketed prefill-into-slot, batched
+  masked single-step decode), both with donated cache buffers — zero
+  steady-state recompiles and no per-step allocation;
+- `scheduler` — :class:`Scheduler`: bounded admission queue (FIFO or
+  shortest-prompt-first), loud :class:`QueueFull` backpressure,
+  per-request deadlines (:class:`DeadlineExceeded`, retryable under
+  `fault.retry.classify_exception`), and the ``step()`` loop that
+  interleaves prefill of waiting requests with decode of running slots,
+  retiring slots on EOS/length mid-flight;
+- `api`       — :class:`ServeEngine`: thread-safe blocking
+  ``generate``, streaming ``submit``/``iter_tokens``, batch
+  ``generate_many``, background driver thread, graceful
+  ``shutdown(drain=True)``.
+
+Observability and chaos ride the existing subsystems: the registry
+carries ``mx_serve_ttft_seconds``, ``mx_serve_tokens_total``,
+``mx_serve_queue_depth``, ``mx_serve_slot_occupancy`` and
+``mx_serve_evictions_total``; `MXNET_FAULT_INJECT` gained a
+``serve_step`` seam. Env knobs: ``MXNET_SERVE_MAX_QUEUE``,
+``MXNET_SERVE_POLICY``, ``MXNET_SERVE_DEADLINE_S``.
+
+Typical use::
+
+    import incubator_mxnet_tpu as mx
+
+    engine = mx.serve.ServeEngine(model, max_slots=8).start()
+    h = engine.submit(prompt_ids, max_new_tokens=128)
+    for tok in engine.iter_tokens(h):
+        ...
+    engine.shutdown(drain=True)
+"""
+from __future__ import annotations
+
+from . import api  # noqa: F401
+from . import engine  # noqa: F401
+from . import scheduler  # noqa: F401
+from .api import ServeEngine  # noqa: F401
+from .engine import SlotDecoder  # noqa: F401
+from .scheduler import (DeadlineExceeded, EngineClosed,  # noqa: F401
+                        QueueFull, Request, Scheduler)
+
+__all__ = ["ServeEngine", "SlotDecoder", "Scheduler", "Request",
+           "QueueFull", "DeadlineExceeded", "EngineClosed",
+           "api", "engine", "scheduler"]

@@ -1,0 +1,297 @@
+"""Slot-cache compiled decode programs (the device half of `mx.serve`).
+
+XLA programs are fixed-shape, so continuous batching cannot grow or
+shrink tensors as requests come and go. Instead this module keeps ONE
+persistent KV cache of static shape ``(L, max_slots, H, max_len, d)`` on
+the device and compiles exactly two program families against it:
+
+- **prefill** — one causal pass over a single request's prompt (padded
+  to a power-of-two length bucket, `models.decoding.bucket_prompt`) that
+  writes the prompt's K/V into an assigned slot via one
+  ``dynamic_update_slice`` and samples the request's first token. One
+  program per bucket length — a small, bounded set.
+- **decode** — ONE step for ALL slots at once: every slot advances one
+  token against its own cache rows at its own position (per-slot
+  ``vmap`` scatter + an ``arange <= pos`` validity mask); a per-slot
+  ``active`` mask keeps retired/free slots from contributing anything.
+  One program, ever.
+
+Both programs donate the cache buffers (``donate_argnums``) so XLA
+updates them in place — steady-state serving allocates nothing and never
+recompiles: slot insert/evict is pure device-side index arithmetic, and
+the host merely rebinds the donated outputs.
+
+Correctness of slot reuse: a freed slot's stale K/V (from the previous
+occupant or from bucket padding) is never attended, because position
+``p`` only enters the attention mask once the slot's ``pos`` reaches
+``p`` — and the decode step writes the new token's K/V at ``p`` in the
+same program before attending. The per-request token stream is therefore
+bit-identical to a one-at-a-time `GPTDecoder.generate` (asserted by
+`tests/test_serve.py`).
+"""
+from __future__ import annotations
+
+import math
+
+from ..models.decoding import (GPTDecoder, PROMPT_BUCKETS, _dense, _ln,
+                               _split_qkv, bucket_prompt)
+
+__all__ = ["SlotDecoder"]
+
+
+def _j():
+    import jax
+
+    return jax
+
+
+class SlotDecoder:
+    """Persistent slot-cache decoder over a `GPTDecoder` (or the
+    `GPTModel`-shaped Block it wraps).
+
+    Parameters
+    ----------
+    source : GPTDecoder or Block
+        The model to serve. A Block is wrapped in a `GPTDecoder`
+        (zero-copy parameter references, auto-refreshed on update).
+    max_slots : int
+        Static batch width of the decode program — the number of
+        requests that can be in flight simultaneously.
+    max_len : int
+        Static sequence capacity of every slot (prompt + generated).
+        Defaults to the model's position-embedding length and may not
+        exceed it.
+    do_sample / top_k : sampling mode, STATIC per engine (baked into the
+        compiled programs — per-request values would recompile).
+        Temperature stays a runtime argument and may vary per request.
+    """
+
+    def __init__(self, source, max_slots=8, max_len=None,
+                 buckets=PROMPT_BUCKETS, do_sample=False, top_k=None):
+        if isinstance(source, GPTDecoder):
+            self._dec = source
+        elif hasattr(source, "blocks") and hasattr(source, "position_embed"):
+            self._dec = GPTDecoder(source)
+        else:
+            raise TypeError(
+                "SlotDecoder needs a GPTDecoder or a GPT-shaped Block "
+                f"(blocks + position_embed), got {type(source).__name__}")
+        model_max = self._dec._max_length
+        self.max_len = int(max_len) if max_len is not None else model_max
+        if self.max_len > model_max:
+            raise ValueError(
+                f"max_len ({self.max_len}) exceeds the model's position "
+                f"table ({model_max})")
+        self.max_slots = int(max_slots)
+        if self.max_slots < 1:
+            raise ValueError("max_slots must be >= 1")
+        # always top out at max_len so every admissible prompt has a
+        # bucket — the program count stays bounded by len(buckets)
+        self.buckets = tuple(sorted(
+            {b for b in buckets if b < self.max_len} | {self.max_len}))
+        self._do_sample = bool(do_sample)
+        self._top_k = None if top_k is None else int(top_k)
+        self._ck = self._cv = None
+        self._prefill_jit = None
+        self._decode_jit = None
+
+    # -- cache --------------------------------------------------------------
+
+    def _ensure_cache(self):
+        if self._ck is not None:
+            return
+        jnp = _j().numpy
+        params = self._dec._params
+        layers = params["layers"]
+        L = layers["ln1_g"].shape[0]
+        H = self._dec._n_heads
+        d = self._dec._units // H
+        dtype = layers["qkv_w"].dtype
+        shape = (L, self.max_slots, H, self.max_len, d)
+        self._ck = jnp.zeros(shape, dtype)
+        self._cv = jnp.zeros(shape, dtype)
+
+    def release(self):
+        """Drop the device cache (shutdown); the next prefill reallocates."""
+        self._ck = self._cv = None
+
+    @property
+    def cache_bytes(self):
+        """Device bytes held by the persistent KV cache (0 if released)."""
+        if self._ck is None:
+            return 0
+        return 2 * self._ck.size * self._ck.dtype.itemsize
+
+    # -- compiled programs --------------------------------------------------
+
+    def _build_prefill(self):
+        jax = _j()
+        jnp = jax.numpy
+        lax = jax.lax
+        dec = self._dec
+
+        def prefill(params, ck, cv, tokens, slot, t0, key, temperature,
+                    *, top_k, do_sample):
+            B = tokens.shape[1]
+            x = params["embed"][tokens] + params["pos"][:B]
+
+            def pre_layer(x, lp):
+                x, k, v = dec._prefill_layer(x, lp, B)
+                return x, (k, v)
+
+            x, (k, v) = lax.scan(pre_layer, x, params["layers"])
+            # k/v: (L, 1, H, B, d) — one write drops the whole prompt
+            # into the slot's rows [0, B)
+            ck = lax.dynamic_update_slice(ck, k.astype(ck.dtype),
+                                          (0, slot, 0, 0, 0))
+            cv = lax.dynamic_update_slice(cv, v.astype(cv.dtype),
+                                          (0, slot, 0, 0, 0))
+            # last REAL token (bucket padding sits beyond t0-1 and is
+            # causally invisible to it)
+            h_last = lax.dynamic_slice_in_dim(x, t0 - 1, 1, axis=1)[:, 0]
+            logits = dec._logits(params, h_last)                  # (1, V)
+            first = dec._sample(logits, key, temperature, top_k, do_sample)
+            return ck, cv, first[0]
+
+        return jax.jit(prefill, static_argnames=("top_k", "do_sample"),
+                       donate_argnums=(1, 2))
+
+    def _slot_decode_layer(self, x, lp, ck, cv, pos):
+        """One-token forward for every slot against its own cache rows.
+
+        Unlike `GPTDecoder._decode_layer` (one shared scalar position),
+        each slot writes and masks at its OWN ``pos[s]`` — the whole
+        point of continuous batching.
+        """
+        jax = _j()
+        jnp = jax.numpy
+        lax = jax.lax
+
+        H = self._dec._n_heads
+        h = _ln(x, lp["ln1_g"], lp["ln1_b"])
+        q, k, v = _split_qkv(_dense(h, lp["qkv_w"], lp["qkv_b"]), H)
+        d = q.shape[-1]
+        # per-slot scatter of this token's k/v at the slot's position
+        write = jax.vmap(
+            lambda c, u, p: lax.dynamic_update_slice(c, u, (0, p, 0)))
+        ck = write(ck, k.astype(ck.dtype), pos)
+        cv = write(cv, v.astype(cv.dtype), pos)
+        s = jnp.einsum("shqd,shkd->shqk", q, ck,
+                       preferred_element_type=jnp.float32)
+        s = s / math.sqrt(d)
+        # each slot attends to its own 0..pos[s]; everything beyond is
+        # stale (previous occupant / bucket padding) and masked out
+        mask = jnp.arange(ck.shape[2])[None, :] <= pos[:, None]
+        s = jnp.where(mask[:, None, None, :], s, -jnp.inf)
+        p = jax.nn.softmax(s, axis=-1).astype(cv.dtype)
+        o = jnp.einsum("shqk,shkd->shqd", p, cv)
+        S = x.shape[0]
+        o = jnp.transpose(o, (0, 2, 1, 3)).reshape(S, 1, H * d)
+        x = x + _dense(o, lp["proj_w"], lp["proj_b"])
+        h = _ln(x, lp["ln2_g"], lp["ln2_b"])
+        ffn = _dense(jax.nn.gelu(_dense(h, lp["ffn1_w"], lp["ffn1_b"])),
+                     lp["ffn2_w"], lp["ffn2_b"])
+        return x + ffn, ck, cv
+
+    def _sample_slots(self, logits, key, temperature, top_k, do_sample):
+        """`GPTDecoder._sample` with a PER-SLOT temperature vector."""
+        jax = _j()
+        jnp = jax.numpy
+        if not do_sample:
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        logits = logits.astype(jnp.float32) / temperature[:, None]
+        if top_k is not None:
+            vals, idx = jax.lax.top_k(logits, top_k)
+            choice = jax.random.categorical(key, vals, axis=-1)
+            return jnp.take_along_axis(
+                idx, choice[:, None], axis=-1)[:, 0].astype(jnp.int32)
+        return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
+
+    def _build_decode(self):
+        jax = _j()
+        jnp = jax.numpy
+        lax = jax.lax
+        dec = self._dec
+
+        def decode(params, ck, cv, last_tok, pos, active, key, temperature,
+                   *, top_k, do_sample):
+            x = (params["embed"][last_tok][:, None, :]
+                 + params["pos"][pos][:, None, :])        # (S, 1, C)
+
+            def dec_layer(x, layer):
+                lp, ck_l, cv_l = layer
+                x, ck_l, cv_l = self._slot_decode_layer(x, lp, ck_l, cv_l,
+                                                        pos)
+                return x, (ck_l, cv_l)
+
+            x, (ck, cv) = lax.scan(dec_layer, x,
+                                   (params["layers"], ck, cv))
+            logits = dec._logits(params, x[:, 0])          # (S, V)
+            nxt = self._sample_slots(logits, key, temperature, top_k,
+                                     do_sample)
+            # free/retired slots carry their last token forward — the
+            # host never reads them, but a defined value keeps the
+            # program deterministic
+            nxt = jnp.where(active, nxt, last_tok)
+            return ck, cv, nxt
+
+        return jax.jit(decode, static_argnames=("top_k", "do_sample"),
+                       donate_argnums=(1, 2))
+
+    # -- host-facing steps --------------------------------------------------
+
+    def prefill(self, slot, prompt_ids, key, temperature=1.0):
+        """Prefill `prompt_ids` (1D int32) into `slot`; returns the
+        request's first sampled token (host int)."""
+        jnp = _j().numpy
+        self._dec._auto_refresh()
+        self._ensure_cache()
+        if self._prefill_jit is None:
+            self._prefill_jit = self._build_prefill()
+        ids = jnp.asarray(prompt_ids, jnp.int32)[None, :]
+        padded, t0 = bucket_prompt(ids, buckets=self.buckets,
+                                   max_len=self.max_len)
+        self._ck, self._cv, first = self._prefill_jit(
+            self._dec._params, self._ck, self._cv, padded,
+            jnp.int32(slot), jnp.int32(t0), key,
+            jnp.float32(max(float(temperature), 1e-6)),
+            top_k=self._top_k, do_sample=self._do_sample)
+        return int(first)
+
+    def decode_step(self, last_tok, pos, active, key, temperature):
+        """One decode step for every slot. `last_tok`/`pos`/`active`/
+        `temperature` are HOST arrays (shape ``(max_slots,)``) — the
+        scheduler owns them, so the step loop never branches on device
+        values. Returns the next token per slot as a host numpy array
+        (the one host sync per step; the tokens go back to clients
+        anyway)."""
+        import numpy as onp
+
+        jnp = _j().numpy
+        self._dec._auto_refresh()
+        self._ensure_cache()
+        if self._decode_jit is None:
+            self._decode_jit = self._build_decode()
+        self._ck, self._cv, nxt = self._decode_jit(
+            self._dec._params, self._ck, self._cv,
+            jnp.asarray(last_tok, jnp.int32),
+            jnp.asarray(pos, jnp.int32),
+            jnp.asarray(active, bool),
+            key,
+            jnp.asarray(temperature, jnp.float32),
+            top_k=self._top_k, do_sample=self._do_sample)
+        return onp.asarray(nxt)
+
+    def xla_program_count(self):
+        """Number of compiled programs across the prefill family (one
+        per bucket actually seen) and the decode program — the
+        recompile-count gate of `tests/test_serve.py` asserts this stays
+        constant in steady state."""
+        n = 0
+        for f in (self._prefill_jit, self._decode_jit):
+            if f is None:
+                continue
+            size = getattr(f, "_cache_size", None)
+            if size is not None:
+                n += int(size())
+        return n

@@ -33,6 +33,14 @@ Built-in series (all `mx_`-prefixed):
                                              observed at the NDArray inlet
 ==============================  ===========  ==============================
 
+Subsystem-owned series registered elsewhere but part of the same
+contract: the serving engine (`serve/scheduler.py`, SERVING.md) owns
+``mx_serve_ttft_seconds`` / ``mx_serve_tokens_total`` /
+``mx_serve_queue_depth`` / ``mx_serve_slot_occupancy`` /
+``mx_serve_evictions_total``, and the decode path owns
+``mx_decode_bucket_pad_tokens_total`` (pad-to-bucket waste,
+`models/decoding.py`).
+
 `report()` -> plain dict; `dump(path)` -> JSON file; `exposition()` ->
 Prometheus text format for scraping.
 """

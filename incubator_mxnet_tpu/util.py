@@ -181,6 +181,17 @@ _ENV_KNOBS = {
         "gluon.data.DataLoader", "worker-task retry budget before the "
         "loud single-process fallback (default 2) (honored, this "
         "build's addition)"),
+    "MXNET_SERVE_MAX_QUEUE": (
+        "serve.ServeEngine", "admission-queue depth before submit() "
+        "raises QueueFull (default 128) (honored, this build's "
+        "addition — see SERVING.md)"),
+    "MXNET_SERVE_POLICY": (
+        "serve.ServeEngine", "admission order: fifo (default) or sjf "
+        "(shortest-prompt-first) (honored, this build's addition)"),
+    "MXNET_SERVE_DEADLINE_S": (
+        "serve.ServeEngine", "default per-request deadline in seconds; "
+        "expiry fails the request with DeadlineExceeded (retryable "
+        "class); unset = no deadline (honored, this build's addition)"),
     # -- designed out (XLA/jax owns the mechanism) -------------------------
     "MXNET_ENGINE_TYPE": (
         "(designed out)", "scheduling is XLA async dispatch; value ignored"),

@@ -72,6 +72,13 @@ _QUICK = {
     "test_fault.py::test_dataloader_worker_fault_retry",
     "test_fault.py::test_checkpoint_checksum_fallback",
     "test_fault.py::test_estimator_chaos_convergence",
+    # serving subsystem (ISSUE 4 gates): stub-scheduler logic runs with
+    # no XLA compile, so these certify backpressure/deadline/drain fast
+    "test_serve.py::test_queue_backpressure_raises",
+    "test_serve.py::test_deadline_expiry_classifies_retryable",
+    "test_serve.py::test_drain_semantics_scheduler",
+    "test_serve.py::test_serve_step_fault_seam",
+    "test_tools.py::test_fl007_tree_is_clean",
 }
 
 

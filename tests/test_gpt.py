@@ -138,6 +138,82 @@ def test_kv_cache_respects_max_length(spicy_net):
         spicy_net.generate(x, 8, use_cache=True)   # 68 > max_length 64
 
 
+def test_bucket_prompt_helper():
+    """bucket_prompt pads to the smallest fitting bucket, accounts the
+    waste, and passes through prompts beyond every bucket."""
+    from incubator_mxnet_tpu.models.decoding import bucket_prompt
+    from incubator_mxnet_tpu.telemetry import registry
+
+    ctr = registry.counter(
+        "mx_decode_bucket_pad_tokens_total",
+        "prompt tokens added by pad-to-bucket in the decode/serving "
+        "path (padding waste)")
+    before = ctr.value
+    ids = onp.arange(10, dtype=onp.int32).reshape(2, 5)
+    padded, t0 = bucket_prompt(ids, buckets=(8, 16))
+    assert padded.shape == (2, 8) and t0 == 5
+    onp.testing.assert_array_equal(onp.asarray(padded)[:, :5], ids)
+    assert ctr.value == before + 2 * 3      # 2 rows x 3 pad tokens
+    # exact-bucket and beyond-every-bucket prompts pass through unpadded
+    p8, t8 = bucket_prompt(onp.zeros((1, 8), onp.int32), buckets=(8, 16))
+    assert p8.shape == (1, 8) and t8 == 8
+    p20, t20 = bucket_prompt(onp.zeros((1, 20), onp.int32), buckets=(8, 16))
+    assert p20.shape == (1, 20) and t20 == 20
+    # max_len caps the candidate buckets
+    p5, _ = bucket_prompt(onp.zeros((1, 5), onp.int32), buckets=(8, 16),
+                          max_len=8)
+    assert p5.shape == (1, 8)
+    with pytest.raises(ValueError):
+        bucket_prompt(onp.zeros((5,), onp.int32))
+
+
+def test_generate_buckets_share_one_program(spicy_net):
+    """Ad-hoc prompt lengths inside one bucket must NOT compile one XLA
+    program each — the pre-bucketing behavior this satellite kills."""
+    from incubator_mxnet_tpu.models.decoding import GPTDecoder
+
+    dec = GPTDecoder(spicy_net)
+    for t0 in (3, 7, 11, 18):              # all land in the 32 bucket
+        dec.generate(_tok(1, t0, seed=t0), 4)
+    size = getattr(dec._generate_fn, "_cache_size", None)
+    if size is not None:                   # jax-version-dependent probe
+        assert size() == 1, "one bucket must mean one compiled program"
+
+
+def test_decoder_auto_refresh_without_explicit_refresh(spicy_net, caplog):
+    """Forgetting refresh() after a parameter update must no longer
+    produce stale logits: the decoder fingerprints the source Block's
+    parameter buffers and auto-refreshes (warning once)."""
+    import logging
+
+    from incubator_mxnet_tpu.models.decoding import GPTDecoder
+
+    dec = GPTDecoder(spicy_net)
+    x = _tok(1, 6, seed=21)
+    before = dec.generate(x, 8).asnumpy()
+    p = spicy_net.word_embed.weight
+    old = p.data().asnumpy()
+    try:
+        r = onp.random.RandomState(321)
+        p.set_data(np.array(r.normal(0, 0.35, p.shape).astype("float32")))
+        with caplog.at_level(logging.WARNING,
+                             logger="incubator_mxnet_tpu.models"):
+            after = dec.generate(x, 8).asnumpy()   # NO refresh() call
+        assert any("auto-refreshing" in m for m in caplog.messages)
+        ref = spicy_net.generate(x, 8, use_cache=False).asnumpy()
+        onp.testing.assert_array_equal(after, ref)
+        assert not (before == after).all()
+        # the warning fires once, not per call
+        caplog.clear()
+        with caplog.at_level(logging.WARNING,
+                             logger="incubator_mxnet_tpu.models"):
+            p.set_data(np.array(old))
+            dec.generate(x, 8)
+        assert not any("auto-refreshing" in m for m in caplog.messages)
+    finally:
+        p.set_data(np.array(old))
+
+
 def test_kv_cache_sees_updated_params(spicy_net):
     """generate() after a parameter change must reflect the new weights
     (the decoder re-reads parameters per call)."""

@@ -82,3 +82,78 @@ def test_diagnose_runs():
         capture_output=True, text=True, timeout=300)
     assert proc.returncode == 0
     assert "Framework Info" in proc.stdout
+
+
+# ---------------------------------------------------------------------------
+# framework_lint FL007 — serving-loop TPU hazards (scoped to serve/)
+# ---------------------------------------------------------------------------
+
+def _lint(src, path):
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import framework_lint
+    finally:
+        sys.path.pop(0)
+    return framework_lint.lint_source(src, path)
+
+
+_SERVE_PATH = "incubator_mxnet_tpu/serve/engine.py"
+
+
+def test_fl007_flags_undonated_jit_in_serve():
+    src = ("import jax\n"
+           "def build(fn):\n"
+           "    return jax.jit(fn, static_argnames=('k',))\n")
+    hits = [f for f in _lint(src, _SERVE_PATH) if f.rule == "FL007"]
+    assert len(hits) == 1
+    assert "donate" in hits[0].message
+
+
+def test_fl007_accepts_donated_jit_and_other_paths():
+    donated = ("import jax\n"
+               "def build(fn):\n"
+               "    return jax.jit(fn, donate_argnums=(1, 2))\n")
+    assert not [f for f in _lint(donated, _SERVE_PATH)
+                if f.rule == "FL007"]
+    by_name = ("import jax\n"
+               "def build(fn):\n"
+               "    return jax.jit(fn, donate_argnames=('ck', 'cv'))\n")
+    assert not [f for f in _lint(by_name, _SERVE_PATH)
+                if f.rule == "FL007"]
+    # the rule is scoped: the same undonated jit OUTSIDE serve/ is fine
+    undonated = ("import jax\n"
+                 "def build(fn):\n"
+                 "    return jax.jit(fn)\n")
+    assert not [f for f in _lint(undonated,
+                                 "incubator_mxnet_tpu/models/decoding.py")
+                if f.rule == "FL007"]
+
+
+def test_fl007_flags_device_branching_in_step_loop():
+    src = ("def step(active, engine):\n"
+           "    if active.any():\n"
+           "        engine.decode()\n"
+           "    while engine.mask.all():\n"
+           "        engine.decode()\n")
+    hits = [f for f in _lint(src, _SERVE_PATH) if f.rule == "FL007"]
+    assert len(hits) == 2
+    assert all("host" in f.message for f in hits)
+    # host-side control flow (ints, lens) stays clean
+    clean = ("def step(self):\n"
+             "    if self.n_active == 0:\n"
+             "        return False\n"
+             "    while self.queue:\n"
+             "        self.admit()\n")
+    assert not [f for f in _lint(clean, _SERVE_PATH) if f.rule == "FL007"]
+
+
+def test_fl007_tree_is_clean():
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import framework_lint
+    finally:
+        sys.path.pop(0)
+    serve_dir = os.path.join(REPO, "incubator_mxnet_tpu", "serve")
+    findings = [f for f in framework_lint.lint_paths([serve_dir])
+                if f.rule == "FL007"]
+    assert not findings, findings

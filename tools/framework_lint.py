@@ -38,6 +38,15 @@ FL006  silent swallow: a broad handler (``except Exception:`` /
        classify instead (`fault.retry.suppressed`), or, where silence is
        genuinely required (interpreter teardown), annotate the handler
        line with ``# noqa: FL006`` and a justifying comment.
+FL007  serving-loop TPU hazards (scoped to ``serve/`` modules): (a) a
+       ``jax.jit`` call without ``donate_argnums``/``donate_argnames`` —
+       the serving programs carry the persistent KV cache, and an
+       undonated cache is copied whole every step; (b) an ``if``/
+       ``while`` condition calling ``.any()``/``.all()``/``.item()``/
+       ``.block_until_ready()`` — data-dependent Python branching on a
+       device value blocks the step loop on a host sync (and invites
+       shape-dependent recompiles). Keep slot state host-side and fetch
+       device results once per step (`serve/scheduler.py` idiom).
 
 Usage
 -----
@@ -66,6 +75,10 @@ RULES = {
              "(bypasses the telemetry API)",
     "FL006": "silent `except Exception: pass` swallow (log/classify via "
              "fault.retry.suppressed, or `# noqa: FL006` with a reason)",
+    "FL007": "serve/ TPU-serving hazard: jax.jit without donate_argnums "
+             "(KV cache copied every step) or if/while branching on a "
+             "device value (.any()/.all()/.item() host sync in the step "
+             "loop)",
 }
 
 _INDEXING_NAME_PARTS = ("getitem", "setitem", "index", "slice")
@@ -312,6 +325,55 @@ def _check_silent_swallow(tree, path, findings, src_lines):
 
 
 # ---------------------------------------------------------------------------
+# FL007 — serving-loop TPU hazards (serve/ modules only)
+# ---------------------------------------------------------------------------
+
+_DEVICE_SYNC_METHODS = ("any", "all", "item", "block_until_ready")
+
+
+def _is_jit_call(node):
+    """Matches `jax.jit(...)` / `<alias>.jit(...)` / bare `jit(...)`."""
+    if not isinstance(node, ast.Call):
+        return False
+    f = node.func
+    if isinstance(f, ast.Attribute) and f.attr == "jit":
+        return True
+    return isinstance(f, ast.Name) and f.id == "jit"
+
+
+def _check_serve_hazards(tree, path, findings):
+    norm = path.replace(os.sep, "/")
+    if "/serve/" not in norm:
+        return
+    for node in ast.walk(tree):
+        # (a) undonated jit: the serving programs thread the persistent
+        # KV cache through every call — without donation XLA copies the
+        # whole cache each step instead of aliasing it in place
+        if _is_jit_call(node):
+            kw = {k.arg for k in node.keywords}
+            if not kw & {"donate_argnums", "donate_argnames"}:
+                findings.append(LintFinding(
+                    path, node.lineno, "FL007",
+                    "`jax.jit` without donate_argnums in a serve/ module: "
+                    "the persistent KV-cache buffers must be donated or "
+                    "XLA copies them whole on every serving step"))
+        # (b) device-value branching: .any()/.all()/.item() in an
+        # if/while condition forces a host sync inside the step loop
+        if isinstance(node, (ast.If, ast.While)):
+            for sub in ast.walk(node.test):
+                if (isinstance(sub, ast.Call)
+                        and isinstance(sub.func, ast.Attribute)
+                        and sub.func.attr in _DEVICE_SYNC_METHODS):
+                    findings.append(LintFinding(
+                        path, sub.lineno, "FL007",
+                        f"branching on `.{sub.func.attr}()` in a serve/ "
+                        "step path: data-dependent Python control flow on "
+                        "a device value stalls the loop on a host sync — "
+                        "keep slot state host-side (numpy) and fetch "
+                        "device results once per step"))
+
+
+# ---------------------------------------------------------------------------
 # FL004 — registered op names present in OPS_COVERAGE.md
 # ---------------------------------------------------------------------------
 
@@ -367,6 +429,7 @@ def lint_source(src, path, coverage_text=None):
     _check_host_numpy(tree, path, findings)
     _check_adhoc_timing(tree, path, findings)
     _check_silent_swallow(tree, path, findings, src.splitlines())
+    _check_serve_hazards(tree, path, findings)
     _check_ops_ledger(tree, path, findings, coverage_text)
     return findings
 
