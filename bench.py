@@ -28,6 +28,11 @@ extras:
   varied prompts/budgets) — aggregate serving throughput incl. queueing
   and per-request time-to-first-token, with mean slot occupancy read
   from the telemetry registry (see SERVING.md).
+- gpt_serve_traced/untraced_tokens_s + gpt_serve_tracing_overhead_pct:
+  the same reduced serve trace with span tracing off then on (adjacent
+  runs) — the measured cost of per-request tracing on the serving hot
+  path (TELEMETRY.md; the off-path cost with MXNET_TELEMETRY unset is
+  gated <3% separately in tests/test_tracing.py).
 - resnet50_fp32/int8_infer_img_s: batch-64 serving, interleaved
   fp32/int8 rounds (best-of-rounds wall rates + median wall ratio).
   Wall numbers on THIS deployment are LINK-bound (the tunnel's RPC rate
@@ -502,8 +507,11 @@ def bench_gpt_serve(requests=32, max_slots=8, prompt_max=64, new_max=96,
         if handles:
             occ_samples.append(float(occ_gauge.value or 0.0))
         if not progressed and i < requests:
-            time.sleep(min(0.001, arrivals[i] - (time.perf_counter() - t0)
-                           if arrivals[i] > now else 0.001))
+            # clamp: the next arrival may have passed between the `now`
+            # snapshot above and this recompute (negative sleep raises)
+            wait = arrivals[i] - (time.perf_counter() - t0) \
+                if arrivals[i] > now else 0.001
+            time.sleep(min(0.001, max(0.0, wait)))
     t_total = time.perf_counter() - t0
     engine.shutdown(drain=True)
 
@@ -525,6 +533,38 @@ def bench_gpt_serve(requests=32, max_slots=8, prompt_max=64, new_max=96,
     p99 = float(onp.percentile(ttfts, 99)) * 1e3
     mean_occ = float(onp.mean(occ_samples)) if occ_samples else 0.0
     return tokens_s, p50, p99, mean_occ
+
+
+def bench_gpt_serve_traced(requests=12, max_slots=4, prompt_max=48,
+                           new_max=48, mean_interarrival_s=0.02, seed=0):
+    """Tracing-overhead pair: the SAME reduced serve trace twice,
+    span tracing off then on (adjacent runs — the interleaved-pair
+    methodology of `bench_dot_pair`, because the tunnel drifts on
+    ~minute timescales). Reports (tokens/s traced, tokens/s untraced,
+    overhead %). The loud-failure contract rides on `bench_gpt_serve`
+    itself: any failed request / degenerate rate raises out of here and
+    lands in extras["errors"]."""
+    from incubator_mxnet_tpu.telemetry import tracing
+
+    kw = dict(requests=requests, max_slots=max_slots,
+              prompt_max=prompt_max, new_max=new_max,
+              mean_interarrival_s=mean_interarrival_s, seed=seed)
+    assert not tracing.is_enabled(), \
+        "tracing already armed: the off-leg would measure the on-path"
+    off_tok_s = bench_gpt_serve(**kw)[0]
+    tracing.enable()
+    try:
+        on_tok_s = bench_gpt_serve(**kw)[0]
+        n_spans = len(tracing.finished_spans())
+    finally:
+        tracing.disable()
+        tracing.reset()
+    if n_spans == 0:
+        raise RuntimeError(
+            "traced serve run recorded zero spans — the tracer was not "
+            "armed through the request path")
+    overhead_pct = (off_tok_s - on_tok_s) / off_tok_s * 100.0
+    return on_tok_s, off_tok_s, overhead_pct
 
 
 def bench_resnet50_infer_pair(batch=64, iters=10, rounds=3):
@@ -696,6 +736,16 @@ def main():
         extras["gpt_serve_mean_slot_occupancy"] = round(s_occ, 3)
     except Exception as e:  # pragma: no cover
         _fail("gpt_serve", e)
+
+    try:
+        on_tok, off_tok, ovh = _retry(bench_gpt_serve_traced)
+        # span-tracing cost on the serving hot path (TELEMETRY.md):
+        # same reduced trace, adjacent off/on runs
+        extras["gpt_serve_traced_tokens_s"] = round(on_tok, 1)
+        extras["gpt_serve_untraced_tokens_s"] = round(off_tok, 1)
+        extras["gpt_serve_tracing_overhead_pct"] = round(ovh, 2)
+    except Exception as e:  # pragma: no cover
+        _fail("gpt_serve_traced", e)
 
     try:
         (fp32_rate, int8_rate, ratio, dev32, dev8,

@@ -207,7 +207,7 @@ def inject_at(seam):
         if fire:
             st.fired += 1
     if fire:
-        from ..telemetry import registry
+        from ..telemetry import registry, tracing
 
         registry.counter("mx_faults_injected_total",
                          "faults fired by the MXNET_FAULT_INJECT "
@@ -215,6 +215,9 @@ def inject_at(seam):
         registry.counter("mx_faults_injected_total",
                          "faults fired by the MXNET_FAULT_INJECT schedule",
                          labels={"seam": seam}).inc()
+        # annotate the enclosing span (serve.step, estimator.step, ...)
+        # so the flight-recorder dump shows WHERE the chaos landed
+        tracing.event("fault.injected", seam=seam, draw=draw)
         raise FaultInjected(seam, draw)
 
 

@@ -45,6 +45,12 @@ def _registry():
     return registry
 
 
+def _tracing():
+    from ..telemetry import tracing
+
+    return tracing
+
+
 class ResilienceHandler(StepGuard, TrainBegin, BatchEnd):
     """Self-healing Estimator handler (see module docstring).
 
@@ -93,6 +99,8 @@ class ResilienceHandler(StepGuard, TrainBegin, BatchEnd):
         _registry().counter(
             "mx_steps_skipped_nonfinite_total",
             "optimizer steps vetoed on a non-finite loss").inc()
+        _tracing().event("resilience.skip_nonfinite",
+                         consecutive=self._consecutive_skips)
         self._amp_backoff(estimator)
         self._clear_nan_findings()
         estimator.logger.warning(
@@ -152,11 +160,17 @@ class ResilienceHandler(StepGuard, TrainBegin, BatchEnd):
                 "resilience: resume budget (%d) exhausted; re-raising %s",
                 self.max_resumes, type(exc).__name__)
             return False
+        # postmortem context BEFORE the resume rewinds state: the dump
+        # carries the crashed step's spans and the fault that fired
+        _tracing().maybe_flight_dump("estimator_crash", exc)
         step = self.checkpointer.resume()
         self._resumes += 1
         _registry().counter(
             "mx_resumes_total",
             "auto-resumes from the last good checkpoint").inc()
+        _tracing().event("resilience.resume", step=step,
+                         resume=self._resumes,
+                         error=type(exc).__name__)
         estimator.logger.warning(
             "resilience: %s mid-step (%s) — resumed from checkpoint step "
             "%d (resume %d/%d)", type(exc).__name__, exc, step,

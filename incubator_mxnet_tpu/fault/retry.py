@@ -180,7 +180,7 @@ class RetryPolicy:
                 d = self.delay(attempt)
                 if self.deadline is not None:
                     d = min(d, max(0.0, self.deadline - elapsed))
-                from ..telemetry import registry
+                from ..telemetry import registry, tracing
 
                 registry.counter(
                     "mx_retries_total",
@@ -189,6 +189,9 @@ class RetryPolicy:
                     "mx_retries_total",
                     "retries taken by fault.RetryPolicy",
                     labels={"policy": self.name}).inc()
+                tracing.event("retry", policy=self.name, attempt=attempt,
+                              error=type(e).__name__,
+                              backoff_ms=round(d * 1e3, 1))
                 _LOG.warning(
                     "fault[%s]: retryable %s (attempt %d/%d), backing off "
                     "%.0f ms: %s", self.name, type(e).__name__, attempt,

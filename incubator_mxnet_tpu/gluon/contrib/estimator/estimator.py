@@ -198,51 +198,59 @@ class Estimator:
             train_end = self._categorize_handlers(event_handlers)
         step_guards = [h for h in event_handlers if isinstance(h, StepGuard)]
         from ....fault.injection import inject_at
+        from ....telemetry import tracing
 
         for handler in train_begin:
             handler.train_begin(self)
 
+        epoch = 0
         while not self.stop_training:
-            for handler in epoch_begin:
-                handler.epoch_begin(self)
-            n_batches = 0
-            for batch in train_data:
-                n_batches += 1
-                for handler in batch_begin:
-                    handler.batch_begin(self, batch=batch)
-                # the step body is the self-healing boundary (fault
-                # subsystem): StepGuards may veto the optimizer update
-                # (non-finite loss) or absorb a mid-step crash after
-                # restoring a consistent state (ResilienceHandler resumes
-                # from the last good checkpoint); without a guard, every
-                # exception propagates exactly as before
-                try:
-                    inject_at("estimator_step")       # chaos seam
-                    data, label, pred, loss = self.fit_batch(batch,
-                                                             batch_axis)
-                    n = data.shape[batch_axis] \
-                        if hasattr(data, "shape") else 1
-                    if any(g.pre_step(self, loss, batch)
-                           for g in step_guards):
-                        # vetoed (e.g. non-finite loss): neither the update
-                        # nor the batch_end metrics see the poisoned batch
-                        continue
-                    self.trainer.step(n)
-                except Exception as e:
-                    if not any(g.on_crash(self, e) for g in step_guards):
-                        raise
-                    continue                # recovered: next batch
-                for handler in batch_end:
-                    handler.batch_end(self, batch=batch, pred=pred,
-                                      label=label, loss=loss)
-                if self.stop_training:
-                    break
-            if n_batches == 0:
-                raise ValueError(
-                    "Estimator.fit: train_data yielded no batches "
-                    "(an empty loader would loop forever)")
-            for handler in epoch_end:
-                handler.epoch_end(self)
+            with tracing.span("estimator.epoch", epoch=epoch):
+                for handler in epoch_begin:
+                    handler.epoch_begin(self)
+                n_batches = 0
+                for batch in train_data:
+                    n_batches += 1
+                    for handler in batch_begin:
+                        handler.batch_begin(self, batch=batch)
+                    # the step body is the self-healing boundary (fault
+                    # subsystem): StepGuards may veto the optimizer update
+                    # (non-finite loss) or absorb a mid-step crash after
+                    # restoring a consistent state (ResilienceHandler
+                    # resumes from the last good checkpoint); without a
+                    # guard, every exception propagates exactly as before
+                    try:
+                        with tracing.span("estimator.step",
+                                          batch=n_batches):
+                            inject_at("estimator_step")   # chaos seam
+                            data, label, pred, loss = self.fit_batch(
+                                batch, batch_axis)
+                            n = data.shape[batch_axis] \
+                                if hasattr(data, "shape") else 1
+                            if any(g.pre_step(self, loss, batch)
+                                   for g in step_guards):
+                                # vetoed (e.g. non-finite loss): neither
+                                # the update nor the batch_end metrics see
+                                # the poisoned batch
+                                continue
+                            self.trainer.step(n)
+                    except Exception as e:
+                        if not any(g.on_crash(self, e)
+                                   for g in step_guards):
+                            raise
+                        continue            # recovered: next batch
+                    for handler in batch_end:
+                        handler.batch_end(self, batch=batch, pred=pred,
+                                          label=label, loss=loss)
+                    if self.stop_training:
+                        break
+                if n_batches == 0:
+                    raise ValueError(
+                        "Estimator.fit: train_data yielded no batches "
+                        "(an empty loader would loop forever)")
+                for handler in epoch_end:
+                    handler.epoch_end(self)
+            epoch += 1
 
         for handler in train_end:
             handler.train_end(self)

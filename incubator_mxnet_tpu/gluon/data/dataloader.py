@@ -251,6 +251,7 @@ class DataLoader:
 
     def __iter__(self):
         from ...ndarray.ndarray import NDArray
+        from ...telemetry import tracing
 
         def wrap(b):
             if isinstance(b, tuple) and len(b) == 4 and b[0] == _SHM_TAG:
@@ -262,9 +263,11 @@ class DataLoader:
             return NDArray(b)
 
         if self._pool is None:
-            for batch_idx in self._batch_sampler:
-                yield wrap(self._batchify_fn([self._dataset[i]
-                                              for i in batch_idx]))
+            for n, batch_idx in enumerate(self._batch_sampler):
+                with tracing.span("dataloader.batch", batch=n, workers=0):
+                    out = wrap(self._batchify_fn([self._dataset[i]
+                                                  for i in batch_idx]))
+                yield out
             return
 
         # pipelined: keep `prefetch` batches in flight in the pool.
@@ -293,26 +296,34 @@ class DataLoader:
                 if b is None:
                     break
                 submit(b)
+            n_yielded = 0
             while in_flight:
-                samples, fut, attempts = in_flight[0]
-                try:
-                    result = fut.get(self._timeout)
-                except Exception as e:
-                    in_flight.pop(0)
-                    if isinstance(e, mp.TimeoutError):
-                        # the task may still complete later (stuck worker):
-                        # keep the future so its shm gets drained at close
-                        abandoned.append([samples, fut, attempts])
-                    result = self._recover_batch(samples, attempts, e)
-                    if result is None:       # resubmitted (ordered: front)
-                        submit(samples, attempts + 1, front=True)
-                        continue
-                else:
-                    in_flight.pop(0)
-                b = next(batches, None)
-                if b is not None:
-                    submit(b)
-                yield wrap(result)
+                # the batch-fetch segment of the trace: wait on the
+                # worker's future (+ any retries) through NDArray wrap
+                with tracing.span("dataloader.batch", batch=n_yielded,
+                                  workers=self._num_workers):
+                    samples, fut, attempts = in_flight[0]
+                    try:
+                        result = fut.get(self._timeout)
+                    except Exception as e:
+                        in_flight.pop(0)
+                        if isinstance(e, mp.TimeoutError):
+                            # the task may still complete later (stuck
+                            # worker): keep the future so its shm gets
+                            # drained at close
+                            abandoned.append([samples, fut, attempts])
+                        result = self._recover_batch(samples, attempts, e)
+                        if result is None:   # resubmitted (ordered: front)
+                            submit(samples, attempts + 1, front=True)
+                            continue
+                    else:
+                        in_flight.pop(0)
+                    b = next(batches, None)
+                    if b is not None:
+                        submit(b)
+                    out = wrap(result)
+                n_yielded += 1
+                yield out
         finally:
             # consumer abandoned the iterator (generator close / exception /
             # timeout) with batches still in flight: import-and-unlink their

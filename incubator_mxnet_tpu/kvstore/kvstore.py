@@ -18,6 +18,7 @@ from __future__ import annotations
 import pickle
 
 from ..ndarray.ndarray import NDArray
+from ..telemetry import tracing
 from .base import KVStoreBase, register
 
 __all__ = ["KVStore", "KVStoreLocal", "KVStoreDevice", "KVStoreDist"]
@@ -90,6 +91,10 @@ class _SingleProcessStore(KVStoreBase):
         return RowSparseNDArray(v, u, sp[0].shape)
 
     def push(self, key, value, priority=0):  # noqa: ARG002
+        with tracing.span("kvstore.push"):
+            self._push_impl(key, value)
+
+    def _push_impl(self, key, value):
         from ..ndarray.sparse import RowSparseNDArray
 
         self._chaos_probe("kvstore_push")
@@ -173,21 +178,24 @@ class _SingleProcessStore(KVStoreBase):
         return results if isinstance(key, (list, tuple)) else results[0]
 
     def pull(self, key, out=None, priority=0, ignore_sparse=True):  # noqa: ARG002
-        self._chaos_probe("kvstore_pull")
-        if isinstance(key, (list, tuple)):
-            keys, outs = key, out if out is not None else [None] * len(key)
-        else:
-            # scalar key: a list out is the per-device TARGETS for that key
-            keys, outs = [key], [out]
-        results = []
-        for k, o in zip(keys, outs):
-            v = self._store[k]
-            targets = o if isinstance(o, (list, tuple)) else [o]
-            for t in targets:
-                if t is not None:
-                    t._set_data(v._data)
-            results.append(v)
-        return results if isinstance(key, (list, tuple)) else results[0]
+        with tracing.span("kvstore.pull"):
+            self._chaos_probe("kvstore_pull")
+            if isinstance(key, (list, tuple)):
+                keys, outs = key, out if out is not None \
+                    else [None] * len(key)
+            else:
+                # scalar key: a list out is the per-device TARGETS for
+                # that key
+                keys, outs = [key], [out]
+            results = []
+            for k, o in zip(keys, outs):
+                v = self._store[k]
+                targets = o if isinstance(o, (list, tuple)) else [o]
+                for t in targets:
+                    if t is not None:
+                        t._set_data(v._data)
+                results.append(v)
+            return results if isinstance(key, (list, tuple)) else results[0]
 
     def pushpull(self, key, value, out=None, priority=0):
         """Allreduce: the fused push+pull path (reference: kvstore.h:58).
@@ -196,6 +204,10 @@ class _SingleProcessStore(KVStoreBase):
         copies (the reference's `CommDevice::Reduce` input shape,
         `src/kvstore/comm.h:482`): they are summed, then the result is
         written to every entry of `out`."""
+        with tracing.span("kvstore.pushpull"):
+            self._pushpull_impl(key, value, out)
+
+    def _pushpull_impl(self, key, value, out):
         from ..ndarray.sparse import RowSparseNDArray
 
         self._chaos_probe("kvstore_push")
@@ -243,7 +255,8 @@ class _SingleProcessStore(KVStoreBase):
         return value
 
     def barrier(self):
-        self._chaos_probe("kvstore_barrier")
+        with tracing.span("kvstore.barrier"):
+            self._chaos_probe("kvstore_barrier")
 
     # -- optimizer on kvstore ----------------------------------------------
     def set_optimizer(self, optimizer):
@@ -344,19 +357,21 @@ class KVStoreDist(_SingleProcessStore):
     def barrier(self):
         from ..ndarray.ndarray import waitall
 
-        waitall()
-        self._chaos_probe("kvstore_barrier")
-        # sync point doubles as the command channel: queued
-        # profile_process='server' commands ship and apply here
-        # (reference: KVStoreServerProfilerCommand on ps-lite messages),
-        # and telemetry rank-stat summaries ride the same collective
-        from .. import profiler
-        from ..fault.retry import RetryPolicy
-        from ..telemetry import monitor as _telem_monitor
+        with tracing.span("kvstore.barrier", dist=True):
+            waitall()
+            self._chaos_probe("kvstore_barrier")
+            # sync point doubles as the command channel: queued
+            # profile_process='server' commands ship and apply here
+            # (reference: KVStoreServerProfilerCommand on ps-lite
+            # messages), and telemetry rank-stat summaries ride the same
+            # collective
+            from .. import profiler
+            from ..fault.retry import RetryPolicy
+            from ..telemetry import monitor as _telem_monitor
 
-        profiler.sync_remote_commands()
-        _telem_monitor.sync_rank_stats()
-        RetryPolicy.from_env("kvstore").call(self._dist.barrier)
+            profiler.sync_remote_commands()
+            _telem_monitor.sync_rank_stats()
+            RetryPolicy.from_env("kvstore").call(self._dist.barrier)
 
 
 KVStore = KVStoreLocal

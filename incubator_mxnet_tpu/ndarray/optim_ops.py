@@ -70,11 +70,44 @@ def _mutate(state, new_val):
                     else new_val)
 
 
+_WARNED_IGNORED: set = set()
+
+
+def _ignored_arg(op, arg, value):
+    """An accepted-but-IGNORED argument is a dishonest surface (VERDICT):
+    reference scripts passing it believe they changed behavior. The TPU
+    build's dense updates have no lazy/standard split (row_sparse grads
+    take the sparse path regardless), so `lazy_update=` is meaningless
+    here — say so ONCE per arg, and count every occurrence in
+    ``mx_ignored_arg_total{arg=...}`` so the registry owns the number."""
+    if value is None:                 # not passed: nothing to disclose
+        return
+    from ..telemetry import registry
+
+    registry.counter(
+        "mx_ignored_arg_total",
+        "explicitly-passed arguments this build accepts but ignores",
+        labels={"arg": arg}).inc()
+    if arg not in _WARNED_IGNORED:
+        _WARNED_IGNORED.add(arg)
+        import warnings
+
+        warnings.warn(
+            f"{op}: argument '{arg}={value!r}' is accepted for reference "
+            "API compatibility but IGNORED by this build (dense updates "
+            "have no lazy/standard split; row_sparse gradients always "
+            "take the sparse path). Counted in "
+            "mx_ignored_arg_total{arg=\"" + arg + "\"}.",
+            stacklevel=3)
+
+
 # --------------------------------------------------------------- SGD family
 
 def sgd_update(weight, grad, lr, wd=0.0, rescale_grad=1.0,
-               clip_gradient=-1.0, lazy_update=True, out=None):  # noqa: ARG001
+               clip_gradient=-1.0, lazy_update=None, out=None):
     """w ← w − lr·(rescale·clip(g) + wd·w) (optimizer_op.cc SGDUpdate)."""
+    _ignored_arg("sgd_update", "lazy_update", lazy_update)
+
     def fn(w, g):
         return w - lr * (_pg(g, rescale_grad, clip_gradient) + wd * w)
 
@@ -86,8 +119,10 @@ def sgd_update(weight, grad, lr, wd=0.0, rescale_grad=1.0,
 
 def sgd_mom_update(weight, grad, mom, lr, momentum=0.0, wd=0.0,
                    rescale_grad=1.0, clip_gradient=-1.0,
-                   lazy_update=True, out=None):  # noqa: ARG001
+                   lazy_update=None, out=None):
     """m ← μ·m − lr·(g + wd·w); w ← w + m."""
+    _ignored_arg("sgd_mom_update", "lazy_update", lazy_update)
+
     def fn(w, g, m):
         m2 = momentum * m - lr * (_pg(g, rescale_grad, clip_gradient)
                                   + wd * w)
@@ -102,9 +137,11 @@ def sgd_mom_update(weight, grad, mom, lr, momentum=0.0, wd=0.0,
 
 
 def mp_sgd_update(weight, grad, weight32, lr, wd=0.0, rescale_grad=1.0,
-                  clip_gradient=-1.0, lazy_update=True, out=None):  # noqa: ARG001
+                  clip_gradient=-1.0, lazy_update=None, out=None):
     """Mixed-precision SGD: fp32 master `weight32` updated, low-precision
     weight is its cast."""
+    _ignored_arg("mp_sgd_update", "lazy_update", lazy_update)
+
     def fn(w, g, w32):
         g32 = _pg(g.astype("float32"), rescale_grad, clip_gradient)
         w32n = w32 - lr * (g32 + wd * w32)
@@ -120,7 +157,9 @@ def mp_sgd_update(weight, grad, weight32, lr, wd=0.0, rescale_grad=1.0,
 
 def mp_sgd_mom_update(weight, grad, mom, weight32, lr, momentum=0.0,
                       wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
-                      lazy_update=True, out=None):  # noqa: ARG001
+                      lazy_update=None, out=None):
+    _ignored_arg("mp_sgd_mom_update", "lazy_update", lazy_update)
+
     def fn(w, g, m, w32):
         g32 = _pg(g.astype("float32"), rescale_grad, clip_gradient)
         m2 = momentum * m - lr * (g32 + wd * w32)
@@ -210,10 +249,12 @@ def signum_update(weight, grad, mom, lr, momentum=0.0, wd=0.0,
 
 def adam_update(weight, grad, mean, var, lr, beta1=0.9, beta2=0.999,
                 epsilon=1e-8, wd=0.0, rescale_grad=1.0,
-                clip_gradient=-1.0, lazy_update=True, out=None):  # noqa: ARG001
+                clip_gradient=-1.0, lazy_update=None, out=None):
     """optimizer_op.cc AdamUpdate — bias correction is the CALLER's job
     (the Python Optimizer folds it into lr), exactly like the
     reference."""
+    _ignored_arg("adam_update", "lazy_update", lazy_update)
+
     def fn(w, g, m, v):
         jnp = _jnp()
         gr = _pg(g, rescale_grad, clip_gradient) + wd * w

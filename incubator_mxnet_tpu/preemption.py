@@ -166,20 +166,22 @@ def atomic_save(path, write_fn, checksum=True):
         write_fn(tmp)
 
     from .fault.retry import RetryExhausted, RetryPolicy
+    from .telemetry import tracing
 
-    try:
-        RetryPolicy.from_env("checkpoint").call(_write)
-    except Exception as e:
+    with tracing.span("checkpoint.write", path=str(path)):
         try:
-            os.remove(tmp)                    # no orphaned partial tmp
-        except OSError:
-            pass
-        if isinstance(e, RetryExhausted):
-            raise e.last from e   # callers keep seeing the writer's error
-        raise
-    os.replace(tmp, path)
-    if checksum:
-        _write_checksum(path)
+            RetryPolicy.from_env("checkpoint").call(_write)
+        except Exception as e:
+            try:
+                os.remove(tmp)                # no orphaned partial tmp
+            except OSError:
+                pass
+            if isinstance(e, RetryExhausted):
+                raise e.last from e   # callers keep seeing the writer's
+            raise                     # error
+        os.replace(tmp, path)
+        if checksum:
+            _write_checksum(path)
     return path
 
 
@@ -331,7 +333,14 @@ class TrainingCheckpointer:
         import logging
         import tempfile
 
+        from .telemetry import tracing
+
         log = logging.getLogger("incubator_mxnet_tpu.fault")
+        with tracing.span("checkpoint.resume",
+                          prefix=self._mgr._prefix):  # noqa: SLF001
+            return self._resume_impl(log, tempfile)
+
+    def _resume_impl(self, log, tempfile):
         paths = self._mgr.generations()
         blob, path, errors = None, None, []
         for candidate in reversed(paths):

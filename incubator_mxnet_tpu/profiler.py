@@ -123,10 +123,13 @@ def start(profile_process="worker"):  # noqa: ARG001
     import jax
 
     try:
-        jax.profiler.start_trace(_STATE["trace_dir"])
-        # wall-clock anchor: XPlane event timestamps are relative to trace
-        # start; dump() rebases them onto the host lane's epoch-µs clock
+        # wall-clock anchor: XPlane event timestamps are relative to the
+        # MOMENT start_trace is called (session setup time included), so
+        # the anchor must be captured BEFORE the call — capturing it
+        # after used to shear the device lanes by the multi-second
+        # profiler-session init on some backends
         _STATE["trace_t0_us"] = time.time() * 1e6
+        jax.profiler.start_trace(_STATE["trace_dir"])
         _STATE["jax_tracing"] = True
     except Exception:
         _STATE["jax_tracing"] = False
@@ -362,15 +365,21 @@ def analyze_memory(fn, *args, static_argnums=None):
 
 def dump(finished=True, profile_process="worker"):  # noqa: ARG001
     """Write ONE chrome://tracing JSON holding the host dispatch lane
-    (pid 0) and the device/runtime lanes from the jax trace
-    (reference: profiler.py:125 writes the C++ profiler's chrome trace)."""
+    (pid 0), the device/runtime lanes from the jax trace (reference:
+    profiler.py:125 writes the C++ profiler's chrome trace), and — when
+    span tracing is armed — the request/step span lanes from
+    `telemetry.tracing` (all three share the epoch-µs clock base)."""
     path = _CONFIG["filename"]
     with _LOCK:
         merged = [{"name": "process_name", "ph": "M", "pid": 0,
                    "args": {"name": "host: op dispatch"}}]
         merged += list(_EVENTS)
         merged += list(_DEVICE_EVENTS)
-        payload = {"traceEvents": merged, "displayTimeUnit": "ms"}
+    from .telemetry import tracing
+
+    if tracing.is_enabled():
+        merged += tracing.chrome_events()
+    payload = {"traceEvents": merged, "displayTimeUnit": "ms"}
     with open(path, "w") as f:
         json.dump(payload, f)
     return path

@@ -26,12 +26,14 @@ import threading
 import numpy as onp
 
 from ..models.decoding import PROMPT_BUCKETS
+from ..telemetry import tracing
 from .engine import SlotDecoder
 from .scheduler import EngineClosed, Request, Scheduler, _DONE
 
 __all__ = ["ServeEngine"]
 
 _IDLE_SLEEP_S = 0.002     # driver backoff when there is nothing to do
+_DRIVER_MAX_CONSECUTIVE_FAILURES = 3
 
 
 def _env_int(name, default):
@@ -168,9 +170,17 @@ class ServeEngine:
 
     def step(self):
         """One scheduling iteration (admit + one decode step for every
-        occupied slot). Returns True if progress was made."""
-        with self._lock:
-            return self._sched.step()
+        occupied slot). Returns True if progress was made.
+
+        A crash (including an injected ``serve_step`` fault) leaves a
+        flight-recorder dump behind — the postmortem carries the active
+        requests' spans — and then propagates unchanged."""
+        try:
+            with self._lock:
+                return self._sched.step()
+        except Exception as e:
+            tracing.maybe_flight_dump("serve_step", e)
+            raise
 
     def _driver_running(self):
         d = self._driver
@@ -268,8 +278,32 @@ class ServeEngine:
         self._stop.clear()
 
         def _loop():
+            import logging
+
+            log = logging.getLogger("incubator_mxnet_tpu.serve")
+            failures = 0
             while not self._stop.is_set():
-                progressed = self.step()
+                try:
+                    progressed = self.step()
+                    failures = 0
+                except Exception as e:
+                    # step() already flight-dumped; a transient fault
+                    # (chaos seam, retryable fabric error) must not
+                    # silently kill the driver thread — but a
+                    # deterministic bug must not spin it forever either
+                    failures += 1
+                    log.error(
+                        "serve driver: step failed (%d consecutive): "
+                        "%s: %s", failures, type(e).__name__, e)
+                    if failures >= _DRIVER_MAX_CONSECUTIVE_FAILURES:
+                        log.error(
+                            "serve driver: stopping after %d consecutive "
+                            "step failures — in-flight requests need a "
+                            "manual step()/start() after the cause is "
+                            "fixed", failures)
+                        break
+                    time.sleep(_IDLE_SLEEP_S)
+                    continue
                 if not progressed:
                     # nothing queued, nothing running — idle backoff
                     time.sleep(_IDLE_SLEEP_S)

@@ -35,6 +35,7 @@ import math
 
 from ..models.decoding import (GPTDecoder, PROMPT_BUCKETS, _dense, _ln,
                                _split_qkv, bucket_prompt)
+from ..telemetry import tracing
 
 __all__ = ["SlotDecoder"]
 
@@ -251,6 +252,10 @@ class SlotDecoder:
         ids = jnp.asarray(prompt_ids, jnp.int32)[None, :]
         padded, t0 = bucket_prompt(ids, buckets=self.buckets,
                                    max_len=self.max_len)
+        # host-side annotation onto the scheduler's serve.prefill span:
+        # which compiled bucket program served this prompt
+        tracing.annotate(bucket=int(padded.shape[1]),
+                         pad_tokens=int(padded.shape[1]) - int(t0))
         self._ck, self._cv, first = self._prefill_jit(
             self._dec._params, self._ck, self._cv, padded,
             jnp.int32(slot), jnp.int32(t0), key,

@@ -1,30 +1,45 @@
-"""Runtime telemetry: structured metrics, funnel stage-tracing, roofline
-analysis, and a training-health monitor (see TELEMETRY.md).
+"""Runtime telemetry: structured metrics, funnel stage-tracing, span
+tracing + flight recorder, SLO tracking, roofline analysis, and a
+training-health monitor (see TELEMETRY.md).
 
-Four connected parts:
+Six connected parts:
 
 - `registry`  — process-wide counters/gauges/histograms (lock-free
   thread-shard fast path), `report()`/`dump()`/`exposition()`, built-in
-  step/compile/jit-cache/transfer series;
+  step/compile/jit-cache/transfer series; ``MXNET_TELEMETRY_DUMP``
+  periodic Prometheus-textfile snapshots;
 - `stages`    — per-stage µs accounting inside the `apply_op` funnel
   behind the MXNET_TELEMETRY knob (dead branches when off);
+- `tracing`   — Dapper-style span tracer (trace/correlation IDs, ambient
+  context, per-thread rings) threaded through serve requests, estimator
+  steps, dataloader fetches, kvstore syncs, and checkpoint I/O; flight
+  recorder dumping the last spans on crash/injected fault; Chrome-trace
+  export sharing the profiler's clock base (same off-path dead-branch
+  discipline as `stages`);
+- `slo`       — declarative objectives over registry series with
+  error-budget burn as ``mx_slo_*`` gauges and a loud `monitor.check()`
+  hook;
 - `roofline`  — post-process the profiler's XPlane device trace into
   per-phase bytes vs time vs peak-HBM-bandwidth tables;
 - `monitor`   — reference-parity `Monitor` (per-tensor health stats,
   batched host sync), `install_nan_hook()` non-finite guard (eager +
   compiled via jax.debug.callback), per-rank aggregation at kvstore sync
-  points, and the estimator `TelemetryHandler`.
+  points, pluggable health checks, and the estimator `TelemetryHandler`.
 
 Env knobs (registered in `util._ENV_KNOBS`): ``MXNET_TELEMETRY``
-(``1`` = stage tracing on, ``raise`` = + NaN guard raising at the first
-non-finite output, ``0``/unset = off — zero per-op cost),
-``MXNET_TELEMETRY_INTERVAL`` (batches between estimator registry logs).
+(``1`` = stage + span tracing on, ``raise`` = + NaN guard raising at the
+first non-finite output, ``0``/unset = off — zero per-op cost),
+``MXNET_TELEMETRY_INTERVAL`` (batches between estimator registry logs),
+``MXNET_TELEMETRY_DUMP=<path>[:interval_s]`` (periodic exposition
+snapshots for node-exporter textfile scraping).
 """
 from __future__ import annotations
 
 from . import registry  # noqa: F401
 from . import roofline  # noqa: F401
 from . import stages  # noqa: F401
+from . import tracing  # noqa: F401
+from . import slo  # noqa: F401
 from . import monitor  # noqa: F401
 from .monitor import Monitor, install_nan_hook  # noqa: F401
 
@@ -34,5 +49,5 @@ from ..ndarray import ndarray as _nd_mod
 
 _nd_mod._H2D_HOOK = registry.add_h2d_bytes
 
-__all__ = ["registry", "stages", "roofline", "monitor", "Monitor",
-           "install_nan_hook"]
+__all__ = ["registry", "stages", "tracing", "slo", "roofline", "monitor",
+           "Monitor", "install_nan_hook"]

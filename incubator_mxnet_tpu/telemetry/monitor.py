@@ -46,6 +46,7 @@ from . import registry
 
 __all__ = ["Monitor", "install_nan_hook", "uninstall_nan_hook",
            "nan_findings", "clear_nan_findings", "check",
+           "add_health_check", "remove_health_check",
            "queue_rank_stats", "sync_rank_stats", "rank_aggregate",
            "TelemetryHandler"]
 
@@ -249,13 +250,33 @@ def clear_nan_findings():
     del _NAN_FINDINGS[:]
 
 
+_HEALTH_CHECKS: dict = {}     # name -> callable raising on violation
+
+
+def add_health_check(fn, name=None):
+    """Register an extra health probe run by `check()` — `fn()` raises
+    on violation (e.g. `telemetry.slo.install_health_check()` routes the
+    SLO tracker's burned-budget check here). Re-registering a name
+    replaces the previous probe (idempotent installs)."""
+    _HEALTH_CHECKS[name or getattr(fn, "__name__", repr(fn))] = fn
+    return fn
+
+
+def remove_health_check(name):
+    _HEALTH_CHECKS.pop(name, None)
+
+
 def check():
     """Raise if any non-finite finding is pending (call after a sync point
-    — e.g. `mx.waitall()` — to surface async jit-path findings)."""
+    — e.g. `mx.waitall()` — to surface async jit-path findings), then run
+    every registered health probe (`add_health_check`) — SLO budget burns
+    surface here too."""
     if _NAN_FINDINGS:
         f = _NAN_FINDINGS[0]
         raise MXNetError(
             f"non-finite output detected at op '{f['op']}' ({f['where']})")
+    for fn in list(_HEALTH_CHECKS.values()):
+        fn()
 
 
 # ---------------------------------------------------------------------------

@@ -51,6 +51,7 @@ import threading
 
 __all__ = ["counter", "gauge", "histogram", "report", "dump", "exposition",
            "reset", "step", "Counter", "Gauge", "Histogram",
+           "arm_textfile_dump", "stop_textfile_dump",
            "STEP_TIME", "EXAMPLES", "JIT_COMPILE", "H2D_BYTES"]
 
 _LOCK = threading.Lock()
@@ -364,6 +365,83 @@ def exposition():
             _log_collector_failure(fn, e)
             continue
     return "\n".join(lines) + "\n"
+
+
+# ---------------------------------------------------------------------------
+# MXNET_TELEMETRY_DUMP — periodic Prometheus-textfile snapshots
+# ---------------------------------------------------------------------------
+
+_TEXTFILE = {"path": None, "interval": None, "thread": None,
+             "stop": None}
+
+
+def _write_textfile(path):
+    """One atomic exposition() snapshot (tmp + os.replace, so a scraper
+    never reads a half-written file)."""
+    import os
+
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        f.write(exposition())
+    os.replace(tmp, path)
+    return path
+
+
+def arm_textfile_dump(spec):
+    """Arm the ``MXNET_TELEMETRY_DUMP=<path>[:interval_s]`` knob: write
+    `exposition()` to `path` now and, when an interval is given, keep
+    refreshing it from a daemon thread — the Prometheus node-exporter
+    *textfile collector* pattern (the scraper reads the file; no HTTP
+    endpoint needed inside training jobs). Returns (path, interval).
+    Re-arming replaces the previous schedule."""
+    import logging
+    import threading as _threading
+
+    spec = str(spec)
+    path, interval = spec, None
+    if ":" in spec:
+        head, _, tail = spec.rpartition(":")
+        try:
+            interval = float(tail)
+            path = head
+        except ValueError:
+            path, interval = spec, None   # a colon inside the path itself
+    if interval is not None and interval <= 0:
+        interval = None
+    stop_textfile_dump()
+    _write_textfile(path)
+    log = logging.getLogger("incubator_mxnet_tpu.telemetry")
+    if interval is None:
+        log.info("telemetry dump: one-shot exposition snapshot at %s", path)
+        _TEXTFILE.update(path=path, interval=None)
+        return path, None
+    stop = _threading.Event()
+
+    def _loop():
+        while not stop.wait(interval):
+            try:
+                _write_textfile(path)
+            except OSError as e:
+                log.warning("telemetry dump to %s failed: %s", path, e)
+
+    t = _threading.Thread(target=_loop, name="mx-telemetry-dump",
+                          daemon=True)
+    t.start()
+    _TEXTFILE.update(path=path, interval=interval, thread=t, stop=stop)
+    log.info("telemetry dump: exposition snapshots at %s every %.3gs",
+             path, interval)
+    return path, interval
+
+
+def stop_textfile_dump():
+    """Stop the periodic dump thread (tests / re-arming)."""
+    stop = _TEXTFILE.get("stop")
+    if stop is not None:
+        stop.set()
+        t = _TEXTFILE.get("thread")
+        if t is not None:
+            t.join(timeout=2.0)
+    _TEXTFILE.update(path=None, interval=None, thread=None, stop=None)
 
 
 def reset():

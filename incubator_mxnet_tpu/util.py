@@ -153,14 +153,23 @@ _ENV_KNOBS = {
         "kvstore horovod facade / tools/launch.py", "rank within host "
         "(honored, exported by the launcher)"),
     "MXNET_TELEMETRY": (
-        "telemetry", "1 = funnel stage-tracing on; raise = + NaN guard "
-        "raising at the first non-finite op output; 0/unset = off with "
-        "zero per-op cost (honored, this build's addition — see "
-        "TELEMETRY.md)"),
+        "telemetry", "1 = funnel stage-tracing + span tracing on; raise "
+        "= + NaN guard raising at the first non-finite op output; "
+        "0/unset = off with zero per-op cost (honored, this build's "
+        "addition — see TELEMETRY.md)"),
     "MXNET_TELEMETRY_INTERVAL": (
         "telemetry.monitor.TelemetryHandler", "batches between registry "
         "log lines in the estimator loop; 0/unset = epoch-end only "
         "(honored, this build's addition)"),
+    "MXNET_TELEMETRY_DUMP": (
+        "telemetry.registry.arm_textfile_dump", "<path>[:interval_s] — "
+        "atomic Prometheus exposition() snapshots to a textfile for "
+        "node-exporter scraping, refreshed every interval_s when given "
+        "(honored, this build's addition — see TELEMETRY.md)"),
+    "MXNET_FLIGHTREC_DIR": (
+        "telemetry.tracing.flight_dump", "directory for crash "
+        "flight-recorder dumps (default: benchmark/ when present, else "
+        "cwd) (honored, this build's addition)"),
     "MXNET_FAULT_INJECT": (
         "fault.injection", "seeded chaos schedule 'seam:prob[:seed"
         "[:limit]],...' armed at import (incl. spawned DataLoader "
@@ -271,13 +280,26 @@ def _apply_env_config():
             pass
     telem = os.environ.get("MXNET_TELEMETRY", "0")
     if telem and telem != "0":
-        from .telemetry import monitor, stages
+        from .telemetry import monitor, stages, tracing
 
         stages.enable()
+        tracing.enable()
         if telem == "raise":
             monitor.install_nan_hook(mode="raise")
         elif telem == "warn":
             monitor.install_nan_hook(mode="warn")
+    dump_spec = os.environ.get("MXNET_TELEMETRY_DUMP")
+    if dump_spec:
+        from .telemetry import registry as _telem_registry
+
+        try:
+            _telem_registry.arm_textfile_dump(dump_spec)
+        except OSError as e:
+            import logging
+
+            logging.getLogger("incubator_mxnet_tpu.telemetry").warning(
+                "MXNET_TELEMETRY_DUMP=%r could not be armed: %s",
+                dump_spec, e)
     if os.environ.get("MXNET_FAULT_INJECT"):
         # arm the chaos schedule (also runs inside spawned DataLoader
         # worker processes, which re-import the package with the

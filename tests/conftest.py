@@ -79,6 +79,13 @@ _QUICK = {
     "test_serve.py::test_drain_semantics_scheduler",
     "test_serve.py::test_serve_step_fault_seam",
     "test_tools.py::test_fl007_tree_is_clean",
+    # observability round 2 (ISSUE 5 gates): span tracer mechanics, one
+    # trace per serve request (stub scheduler — no XLA), SLO burn math,
+    # and the FL008 span-hygiene tree sweep
+    "test_tracing.py::test_span_nesting_and_ids",
+    "test_tracing.py::test_serve_request_trace_stub",
+    "test_tracing.py::test_slo_latency_burn_math",
+    "test_tools.py::test_fl008_tree_is_clean",
 }
 
 

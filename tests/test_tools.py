@@ -157,3 +157,101 @@ def test_fl007_tree_is_clean():
     findings = [f for f in framework_lint.lint_paths([serve_dir])
                 if f.rule == "FL007"]
     assert not findings, findings
+
+
+# ---------------------------------------------------------------------------
+# framework_lint FL008 — span-tracing hygiene
+# ---------------------------------------------------------------------------
+
+_ANY_PATH = "incubator_mxnet_tpu/gluon/trainer.py"
+
+
+def test_fl008_flags_bare_start_span():
+    src = ("from incubator_mxnet_tpu.telemetry import tracing\n"
+           "t = tracing.Tracer()\n"
+           "def f():\n"
+           "    s = t.start_span('work')\n"
+           "    return s\n")
+    hits = [f for f in _lint(src, _ANY_PATH) if f.rule == "FL008"]
+    assert len(hits) == 1
+    assert "with" in hits[0].message
+
+
+def test_fl008_accepts_with_and_open_span():
+    good = ("from incubator_mxnet_tpu.telemetry import tracing\n"
+            "t = tracing.Tracer()\n"
+            "def f(req):\n"
+            "    with t.start_span('work'):\n"
+            "        pass\n"
+            "    with tracing.span('other', x=1):\n"
+            "        pass\n"
+            "    req.span = tracing.open_span('request')\n"
+            "    req.span.close()\n")
+    assert not [f for f in _lint(good, _ANY_PATH) if f.rule == "FL008"]
+
+
+def test_fl008_flags_span_creation_in_ops_bodies():
+    src = ("from ..telemetry import tracing\n"
+           "def kernel(x):\n"
+           "    with tracing.span('k'):\n"
+           "        return x\n")
+    hits = [f for f in _lint(src, "incubator_mxnet_tpu/ops/k.py")
+            if f.rule == "FL008"]
+    assert len(hits) == 1
+    assert "jit-traced" in hits[0].message
+    # the same source OUTSIDE ops/ is fine
+    assert not [f for f in _lint(src, _ANY_PATH) if f.rule == "FL008"]
+    # module-level span use in ops/ (not in a function body) is not
+    # kernel-reachable — same scoping as FL003/FL005
+    top = ("from ..telemetry import tracing\n"
+           "with tracing.span('import'):\n"
+           "    pass\n")
+    assert not [f for f in _lint(top, "incubator_mxnet_tpu/ops/k.py")
+                if f.rule == "FL008"]
+
+
+def test_fl008_ignores_unrelated_span_names():
+    # .span()/.start_span-free code and foreign attrs named 'span' on
+    # non-tracing receivers must not fire (only start_span is
+    # unambiguous by name alone)
+    src = ("def f(soup):\n"
+           "    return soup.span('x')\n")
+    assert not [f for f in _lint(src, _ANY_PATH) if f.rule == "FL008"]
+
+
+def test_fl008_tree_is_clean():
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import framework_lint
+    finally:
+        sys.path.pop(0)
+    findings = [f for f in framework_lint.lint_paths(
+        [os.path.join(REPO, "incubator_mxnet_tpu"),
+         os.path.join(REPO, "tools"),
+         os.path.join(REPO, "bench.py")]) if f.rule == "FL008"]
+    assert not findings, findings
+
+
+# ---------------------------------------------------------------------------
+# run-metadata stamping (VERDICT Weak #5: stale-rerun detectability)
+# ---------------------------------------------------------------------------
+
+def test_run_metadata_stamps_sha_and_round():
+    sys.path.insert(0, REPO)
+    try:
+        import __graft_entry__ as ge
+    finally:
+        sys.path.pop(0)
+    meta = ge.run_metadata(round_id=7)
+    assert meta["round"] == "7"
+    assert meta["git_sha"] and " " not in meta["git_sha"]
+    # env fallback, and 'unset' (never a wall clock) when absent
+    old = os.environ.pop("MXNET_RUN_ROUND", None)
+    try:
+        os.environ["MXNET_RUN_ROUND"] = "r42"
+        assert ge.run_metadata()["round"] == "r42"
+        del os.environ["MXNET_RUN_ROUND"]
+        assert ge.run_metadata()["round"] == "unset"
+    finally:
+        if old is not None:
+            os.environ["MXNET_RUN_ROUND"] = old

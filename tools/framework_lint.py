@@ -47,6 +47,16 @@ FL007  serving-loop TPU hazards (scoped to ``serve/`` modules): (a) a
        device value blocks the step loop on a host sync (and invites
        shape-dependent recompiles). Keep slot state host-side and fetch
        device results once per step (`serve/scheduler.py` idiom).
+FL008  span-tracing hygiene (`telemetry/tracing.py`): (a) a
+       ``start_span(...)`` call used anywhere but directly as a ``with``
+       item — a bare start_span leaks an open span into the ambient
+       stack and the duration never stamps; use ``with ...start_span()``
+       (or `open_span()`, the EXPLICIT-lifecycle API, when the span must
+       cross function/thread boundaries); (b) any span creation
+       (``span``/``open_span``/``start_span`` via a tracing import)
+       inside function bodies of ``ops/`` modules — kernel-reachable
+       bodies get traced by XLA, where a host-side span is at best a
+       constant-folded lie and at worst a recompile-per-call hazard.
 
 Usage
 -----
@@ -79,6 +89,9 @@ RULES = {
              "(KV cache copied every step) or if/while branching on a "
              "device value (.any()/.all()/.item() host sync in the step "
              "loop)",
+    "FL008": "span hygiene: start_span() must be a `with` item (use "
+             "open_span() for explicit lifecycle), and no span creation "
+             "inside ops/ kernel-reachable bodies (jit-traced code)",
 }
 
 _INDEXING_NAME_PARTS = ("getitem", "setitem", "index", "slice")
@@ -374,6 +387,96 @@ def _check_serve_hazards(tree, path, findings):
 
 
 # ---------------------------------------------------------------------------
+# FL008 — span-tracing hygiene
+# ---------------------------------------------------------------------------
+
+_SPAN_MAKERS = ("span", "open_span", "start_span")
+
+
+def _tracing_aliases(tree):
+    """Names bound to the tracing module (`from ..telemetry import
+    tracing [as t]`, `import ...telemetry.tracing as t`) and to span
+    constructors imported directly from it (`from ...tracing import
+    span [as s]`)."""
+    mod_aliases, fn_aliases = set(), set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name.endswith("telemetry.tracing"):
+                    mod_aliases.add(a.asname or a.name.split(".")[-1])
+        elif isinstance(node, ast.ImportFrom) and node.module:
+            if node.module.endswith("telemetry") or node.module == "telemetry":
+                for a in node.names:
+                    if a.name == "tracing":
+                        mod_aliases.add(a.asname or "tracing")
+            if node.module.endswith("tracing"):
+                for a in node.names:
+                    if a.name in _SPAN_MAKERS:
+                        fn_aliases.add(a.asname or a.name)
+    return mod_aliases, fn_aliases
+
+
+def _span_call_kind(node, mod_aliases, fn_aliases):
+    """'start_span' / 'span' / 'open_span' when `node` creates a span
+    through a known tracing binding (or any `X.start_span(...)` — the
+    Tracer method is unambiguous by name); else None."""
+    if not isinstance(node, ast.Call):
+        return None
+    f = node.func
+    if isinstance(f, ast.Attribute):
+        if f.attr == "start_span":       # Tracer.start_span: name is enough
+            return "start_span"
+        if (f.attr in _SPAN_MAKERS and isinstance(f.value, ast.Name)
+                and f.value.id in mod_aliases):
+            return f.attr
+    elif isinstance(f, ast.Name) and f.id in fn_aliases:
+        # direct-import form: resolve through the alias's original name
+        return "start_span" if f.id == "start_span" else f.id
+    return None
+
+
+def _check_span_hygiene(tree, path, findings):
+    mod_aliases, fn_aliases = _tracing_aliases(tree)
+    with_items = set()
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            for item in node.items:
+                with_items.add(id(item.context_expr))
+    norm = path.replace(os.sep, "/")
+    in_ops = "/ops/" in norm
+    ops_body_calls = set()
+    if in_ops:
+        for fn in ast.walk(tree):
+            if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for sub in ast.walk(fn):
+                    ops_body_calls.add(id(sub))
+    for node in ast.walk(tree):
+        kind = _span_call_kind(node, mod_aliases, fn_aliases)
+        if kind is None:
+            continue
+        # (a) start_span is the context-manager API: anywhere but a
+        # `with` item, the span never closes (and pollutes the ambient
+        # stack) — explicit lifecycles go through open_span()
+        if kind == "start_span" and id(node) not in with_items:
+            findings.append(LintFinding(
+                path, node.lineno, "FL008",
+                "`start_span(...)` outside a `with` item: the span is "
+                "never closed and stays on the ambient stack — write "
+                "`with ...start_span(...):`, or use open_span()/"
+                "Span.close() for an explicit cross-scope lifecycle"))
+        # (b) no span creation in kernel-reachable ops/ bodies (same
+        # function-body scoping as FL003/FL005)
+        if id(node) in ops_body_calls:
+            findings.append(LintFinding(
+                path, node.lineno, "FL008",
+                f"span creation `{kind}(...)` inside a function body in "
+                "an ops/ module: these bodies are jit-traced — a "
+                "host-side span inside a traced body measures nothing "
+                "and invites trace-time side effects; put spans at the "
+                "call sites instead"))
+
+
+# ---------------------------------------------------------------------------
 # FL004 — registered op names present in OPS_COVERAGE.md
 # ---------------------------------------------------------------------------
 
@@ -430,6 +533,7 @@ def lint_source(src, path, coverage_text=None):
     _check_adhoc_timing(tree, path, findings)
     _check_silent_swallow(tree, path, findings, src.splitlines())
     _check_serve_hazards(tree, path, findings)
+    _check_span_hygiene(tree, path, findings)
     _check_ops_ledger(tree, path, findings, coverage_text)
     return findings
 
