@@ -32,7 +32,8 @@ from ..ndarray.ndarray import NDArray, apply_op
 
 __all__ = ["quantize_net", "quantize_model", "QuantizedDense",
            "QuantizedConv2D", "optimal_threshold_entropy",
-           "collect_thresholds", "fold_conv_bn"]
+           "collect_thresholds", "fold_conv_bn",
+           "quantize_symmetric", "dequantize_symmetric"]
 
 
 # ---------------------------------------------------------------------------
@@ -215,6 +216,35 @@ def _quantize_weight(w, axes):
     scale = absmax / 127.0
     wq = onp.clip(onp.round(w / scale), -127, 127).astype(onp.int8)
     return wq, scale.astype(onp.float32)
+
+
+def quantize_symmetric(x, axes, scale=None):
+    """Traceable symmetric int8 quantization (the jax-side twin of
+    `_quantize_weight`, same ±127 convention) for in-graph consumers like
+    the serving int8 KV cache (`serve.SlotDecoder`,
+    ``MXNET_SERVE_KV_DTYPE=int8``).
+
+    `axes` are the reduction axes of the absmax group (e.g. a KV page's
+    token×head_dim block); `scale` overrides the derived absmax/127 scale
+    (used when re-quantizing into an existing page's scale). Returns
+    ``(q_int8, scale)`` with `scale` keeping the reduced axes as size-1
+    dims so ``q * scale`` dequantizes by broadcast.
+    """
+    import jax.numpy as jnp
+
+    if scale is None:
+        absmax = jnp.max(jnp.abs(x), axis=axes, keepdims=True)
+        scale = jnp.maximum(absmax, 1e-8).astype(jnp.float32) / 127.0
+    q = jnp.clip(jnp.round(x.astype(jnp.float32) / scale),
+                 -127, 127).astype(jnp.int8)
+    return q, scale
+
+
+def dequantize_symmetric(q, scale, dtype=None):
+    """Inverse of `quantize_symmetric`: broadcast-multiply back to real
+    values (`dtype` defaults to the scale's float dtype)."""
+    x = q.astype(scale.dtype) * scale
+    return x if dtype is None else x.astype(dtype)
 
 
 def _int8_contract(contract):

@@ -27,7 +27,8 @@ import functools
 import logging
 import math
 
-__all__ = ["GPTDecoder", "bucket_prompt", "PROMPT_BUCKETS"]
+__all__ = ["GPTDecoder", "bucket_prompt", "PROMPT_BUCKETS",
+           "chunk_buckets", "bucket_chunk"]
 
 _LOG = logging.getLogger("incubator_mxnet_tpu.models")
 
@@ -75,6 +76,41 @@ def bucket_prompt(ids, buckets=PROMPT_BUCKETS, max_len=None, pad_id=0):
         "prompt tokens added by pad-to-bucket in the decode/serving "
         "path (padding waste)").inc(int(n * (bucket - t0)))
     return padded, t0
+
+
+def chunk_buckets(page_tokens, prefill_chunk):
+    """Static chunk-length buckets for the paged serving prefill
+    (`serve.SlotDecoder`): power-of-two multiples of `page_tokens` up to
+    `prefill_chunk`, plus `prefill_chunk` itself. Every chunk is a whole
+    number of pages, so chunk writes land on page boundaries and the
+    compiled chunk-prefill family stays bounded at len(buckets) programs.
+    """
+    pt = int(page_tokens)
+    chunk = int(prefill_chunk)
+    if pt < 1:
+        raise ValueError(f"page_tokens must be >= 1, got {pt}")
+    if chunk % pt:
+        raise ValueError(
+            f"prefill_chunk ({chunk}) must be a multiple of page_tokens "
+            f"({pt}) so chunks stay page-aligned")
+    out = set()
+    b = pt
+    while b < chunk:
+        out.add(b)
+        b *= 2
+    out.add(chunk)
+    return tuple(sorted(out))
+
+
+def bucket_chunk(n, buckets):
+    """Smallest chunk bucket >= n (the last prefill chunk of a prompt is
+    padded up to it; the waste rides the same
+    ``mx_decode_bucket_pad_tokens_total`` counter as prompt bucketing)."""
+    fits = [b for b in buckets if b >= n]
+    if not fits:
+        raise ValueError(f"chunk of {n} tokens exceeds every bucket "
+                         f"{tuple(buckets)}")
+    return min(fits)
 
 
 def _j():

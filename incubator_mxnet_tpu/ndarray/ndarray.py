@@ -785,17 +785,28 @@ def _unwrap_index(key):
 # Imperative::Invoke → Engine::PushAsync, src/imperative/imperative.cc:105).
 # ---------------------------------------------------------------------------
 
+_PROF_MOD = None
+
+
 def _active_profiler():
     """The profiler module iff it is imported AND running (cheap hot-path
-    check: no import cost when profiling was never enabled)."""
-    mod = sys.modules.get("incubator_mxnet_tpu.profiler")
-    if mod is not None and mod._STATE["running"] \
+    check: no import cost when profiling was never enabled; the module
+    ref is cached after the first sight — modules never unload)."""
+    global _PROF_MOD
+    mod = _PROF_MOD
+    if mod is None:
+        mod = sys.modules.get("incubator_mxnet_tpu.profiler")
+        if mod is None:
+            return None
+        _PROF_MOD = mod
+    if mod._STATE["running"] \
             and mod._CONFIG.get("profile_imperative", True):
         return mod
     return None
 
 
 _AMP_MOD = None
+_AMP_STATE = None
 
 
 def _amp_mod():
@@ -807,11 +818,22 @@ def _amp_mod():
     return _AMP_MOD
 
 
+def _amp_state():
+    """The AMP module's mutable state object (cached ref: the funnel
+    reads ``.active`` per op and must not pay an import/function call)."""
+    global _AMP_STATE
+    if _AMP_STATE is None:
+        _AMP_STATE = _amp_mod()._STATE
+    return _AMP_STATE
+
+
 def _amp_mode(name):
     """AMP participation for op `name` (None when AMP is off). Funnel-level
     so every listed op participates (reference: low_precision_pass.cc cast
     insertion; here the cast happens inside each op's pure function)."""
-    return _amp_mod().op_cast_mode(name)
+    if not (_AMP_STATE or _amp_state()).active:
+        return None
+    return _AMP_MOD.op_cast_mode(name)
 
 
 def _amp_cast(mode, tvals):
@@ -827,6 +849,22 @@ def _call_profiled(name, pure_fn, tensor_vals):
     outs = pure_fn(*tensor_vals)
     prof.record_op(name, time.perf_counter() - t0)
     return outs
+
+
+def _fast_wrap(data):
+    """Funnel-internal NDArray constructor for values KNOWN to be jax
+    arrays (compiled-op outputs): skips every `__init__` host-conversion
+    branch — the fast path's replacement for the ~2.7 µs/op `wrap`
+    stage."""
+    a = NDArray.__new__(NDArray)
+    a._data = data
+    a._device = None
+    a._version = 0
+    a._grad = None
+    a._grad_req = "write"
+    a._node = None
+    a._out_idx = 0
+    return a
 
 
 def apply_op(name, jfn, args, kwargs=None, n_outputs=1, out=None,
@@ -908,6 +946,11 @@ def apply_op(name, jfn, args, kwargs=None, n_outputs=1, out=None,
 
 
 _JIT_CACHE: dict = {}
+# Precomputed cache keys for the all-tensor/no-kwargs fast path, keyed
+# (jfn, n_args): identical tuples to `_op_cache_key` with AMP off, built
+# once instead of per call (the funnel's former ~3 µs/op `cache_key`
+# stage — see benchmark/funnel_breakdown.md).
+_FAST_KEYS: dict = {}
 _JIT_CACHE_CAP = 2048
 _JIT_DENY: set = set()
 _JIT_FAILS: dict = {}
@@ -1055,7 +1098,60 @@ def unwrap_arrays(args):
 def apply_op_flat(name, jfn, args, kwargs=None, n_outputs=None,
                   cacheable=False):
     """Like apply_op but flattens NDArrays nested one level inside list/tuple
-    positional args (e.g. ``concatenate([a, b], axis=0)``)."""
+    positional args (e.g. ``concatenate([a, b], axis=0)``).
+
+    Fast path (ROADMAP speed gap (a), ISSUE 6): a cacheable all-tensor
+    call with NO kwargs while telemetry/analysis/monitor hooks, AMP, the
+    profiler and autograd recording are ALL inactive dispatches straight
+    through the op-call jit cache under a PREcomputed key — the
+    prologue/amp_lookup/cache_key/wrap stages of the funnel breakdown
+    collapse to a few dict lookups. Any condition failing (including a
+    cache miss — the general path below populates the shared entry)
+    falls through to the general path unchanged.
+    """
+    if (cacheable and not kwargs and _STAGE_HOOK is None
+            and _ANALYSIS_HOOK is None and _MONITOR_HOOK is None
+            and not autograd._STATE.recording
+            and name not in _JIT_DENY):
+        fast = True
+        for a in args:
+            if type(a) is not NDArray:
+                fast = False
+                break
+        if fast and not (_AMP_STATE or _amp_state()).active \
+                and _active_profiler() is None:
+            n = len(args)
+            key = _FAST_KEYS.get((jfn, n))
+            if key is None:
+                # identical to _op_cache_key(jfn, ., all-tensor, {}, None)
+                # so fast and general paths SHARE cache entries
+                key = (jfn, None, ("<T>",) * n, ())
+                _FAST_KEYS[(jfn, n)] = key
+            jitted = _JIT_CACHE.get(key)
+            if jitted is not None:
+                vals = [a._data for a in args]
+                tracer = False
+                for v in vals:
+                    if _is_tracer(v):
+                        tracer = True
+                        break
+                if not tracer:
+                    outs = None
+                    try:
+                        outs = jitted(*vals)
+                    except Exception:
+                        # errors re-raise identically on the general path
+                        outs = None
+                    if outs is not None:
+                        global _JIT_HITS
+                        _JIT_HITS += 1
+                        if type(outs) is tuple:
+                            wrapped = tuple(
+                                _fast_wrap(o) for o in outs)
+                            return (wrapped if n_outputs is None
+                                    else list(wrapped))
+                        return _fast_wrap(outs)
+
     sh = _STAGE_HOOK     # stage trace: dead branches when None (the default)
     t = time.perf_counter_ns() if sh is not None else 0
     kwargs = kwargs or {}

@@ -121,9 +121,19 @@ def empty_like(a, dtype=None):
 
 def _make(name, jnp_name=None):
     jnp_name = jnp_name or name
+    cell = []        # the jnp function, resolved once (stable identity —
+    #                  it doubles as the op-call jit-cache key)
 
     def op(*args, **kwargs):
-        jnp = _jnp()
+        if cell:
+            jfn = cell[0]
+        else:
+            jfn = getattr(_jnp(), jnp_name)
+            cell.append(jfn)
+        if not kwargs:
+            # hot path: positional-only call — no kwarg normalization to
+            # do, straight into the funnel's fast path
+            return apply_op_flat(name, jfn, args, cacheable=True)
         if "dtype" in kwargs and kwargs["dtype"] is not None:
             kwargs["dtype"] = np_dtype(kwargs["dtype"])
         kwargs.pop("out", None)
@@ -132,8 +142,7 @@ def _make(name, jnp_name=None):
                   for k, v in kwargs.items()}
         # jnp functions have stable identity and fully-explicit statics →
         # eligible for the eager op-call jit cache
-        return apply_op_flat(name, getattr(jnp, jnp_name), args, kwargs,
-                             cacheable=True)
+        return apply_op_flat(name, jfn, args, kwargs, cacheable=True)
 
     op.__name__ = name
     register_op_meta(name, "np", op)

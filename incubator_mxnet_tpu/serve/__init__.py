@@ -4,25 +4,29 @@ The framework's decode story before this subsystem was a single-job
 loop: one fixed batch, all requests starting and stopping together
 (`bench_gpt_decode`). Real serving is the opposite — requests arrive and
 finish at different times — and the known technique is continuous
-(iteration-level) batching with slot-based KV-cache management (Orca,
+(iteration-level) batching with paged KV-cache management (Orca,
 OSDI '22; vLLM/PagedAttention, SOSP '23), adapted here to the TPU
 constraint that XLA programs are fixed-shape: instead of dynamic
-tensors, ONE compiled decode program stays alive and requests swap in
-and out of static batch slots.
+tensors, ONE compiled decode program stays alive and requests map their
+token ranges onto pool pages through a static-shape page table.
 
 Three connected parts:
 
-- `engine`    — :class:`SlotDecoder`: the persistent device-side
-  ``(L, max_slots, H, max_len, d)`` KV cache and the two compiled
-  program families against it (bucketed prefill-into-slot, batched
-  masked single-step decode), both with donated cache buffers — zero
-  steady-state recompiles and no per-step allocation;
+- `engine`    — :class:`SlotDecoder`: the persistent paged device pool
+  ``(L, n_pages, H, page_tokens, d)``, the host-side
+  :class:`PageAllocator` (refcounts, loud :class:`PagePoolExhausted`)
+  and :class:`PrefixCache` (shared system prompts prefilled once), and
+  the two compiled program families against the pool (page-aligned
+  chunked prefill, batched gather-by-page-table decode), both with
+  donated buffers — zero steady-state recompiles and no per-step
+  allocation. Optional int8 KV storage
+  (``MXNET_SERVE_KV_DTYPE=int8``) halves resident KV bytes per slot;
 - `scheduler` — :class:`Scheduler`: bounded admission queue (FIFO or
-  shortest-prompt-first), loud :class:`QueueFull` backpressure,
+  remaining-chunk SJF), loud :class:`QueueFull` backpressure,
   per-request deadlines (:class:`DeadlineExceeded`, retryable under
   `fault.retry.classify_exception`), and the ``step()`` loop that
-  interleaves prefill of waiting requests with decode of running slots,
-  retiring slots on EOS/length mid-flight;
+  interleaves prefill CHUNKS of waiting requests with decode of running
+  slots, retiring slots on EOS/length mid-flight;
 - `api`       — :class:`ServeEngine`: thread-safe blocking
   ``generate``, streaming ``submit``/``iter_tokens``, batch
   ``generate_many``, background driver thread, graceful
@@ -30,10 +34,13 @@ Three connected parts:
 
 Observability and chaos ride the existing subsystems: the registry
 carries ``mx_serve_ttft_seconds``, ``mx_serve_tokens_total``,
-``mx_serve_queue_depth``, ``mx_serve_slot_occupancy`` and
-``mx_serve_evictions_total``; `MXNET_FAULT_INJECT` gained a
-``serve_step`` seam. Env knobs: ``MXNET_SERVE_MAX_QUEUE``,
-``MXNET_SERVE_POLICY``, ``MXNET_SERVE_DEADLINE_S``.
+``mx_serve_queue_depth``, ``mx_serve_slot_occupancy``,
+``mx_serve_page_occupancy``, ``mx_serve_prefix_hits_total``,
+``mx_serve_prefill_chunks_total`` and ``mx_serve_evictions_total``;
+`MXNET_FAULT_INJECT` has the ``serve_step`` seam. Env knobs:
+``MXNET_SERVE_MAX_QUEUE``, ``MXNET_SERVE_POLICY``,
+``MXNET_SERVE_DEADLINE_S``, ``MXNET_SERVE_PAGE_TOKENS``,
+``MXNET_SERVE_PREFILL_CHUNK``, ``MXNET_SERVE_KV_DTYPE``.
 
 Typical use::
 
@@ -51,10 +58,12 @@ from . import api  # noqa: F401
 from . import engine  # noqa: F401
 from . import scheduler  # noqa: F401
 from .api import ServeEngine  # noqa: F401
-from .engine import SlotDecoder  # noqa: F401
+from .engine import (PageAllocator, PagePoolExhausted,  # noqa: F401
+                     PrefixCache, SlotDecoder)
 from .scheduler import (DeadlineExceeded, EngineClosed,  # noqa: F401
                         QueueFull, Request, Scheduler)
 
 __all__ = ["ServeEngine", "SlotDecoder", "Scheduler", "Request",
+           "PageAllocator", "PrefixCache", "PagePoolExhausted",
            "QueueFull", "DeadlineExceeded", "EngineClosed",
            "api", "engine", "scheduler"]

@@ -25,8 +25,9 @@ import threading
 
 import numpy as onp
 
-from ..models.decoding import PROMPT_BUCKETS
 from ..telemetry import tracing
+from ..util import env_float as _env_float
+from ..util import env_int as _env_int
 from .engine import SlotDecoder
 from .scheduler import EngineClosed, Request, Scheduler, _DONE
 
@@ -34,38 +35,6 @@ __all__ = ["ServeEngine"]
 
 _IDLE_SLEEP_S = 0.002     # driver backoff when there is nothing to do
 _DRIVER_MAX_CONSECUTIVE_FAILURES = 3
-
-
-def _env_int(name, default):
-    import os
-
-    v = os.environ.get(name)
-    if v is None:
-        return default
-    try:
-        return int(v)
-    except ValueError:
-        import logging
-
-        logging.getLogger("incubator_mxnet_tpu.serve").warning(
-            "%s=%r is not an int; using %r", name, v, default)
-        return default
-
-
-def _env_float(name, default):
-    import os
-
-    v = os.environ.get(name)
-    if v is None:
-        return default
-    try:
-        return float(v)
-    except ValueError:
-        import logging
-
-        logging.getLogger("incubator_mxnet_tpu.serve").warning(
-            "%s=%r is not a number; using %r", name, v, default)
-        return default
 
 
 class ServeEngine:
@@ -81,6 +50,10 @@ class ServeEngine:
     max_len : int, optional
         Per-slot sequence capacity; defaults to the model's position
         table length.
+    page_tokens / prefill_chunk / n_pages / kv_dtype / prefix_reuse
+        Paged-KV knobs, forwarded to `SlotDecoder` (defaults ride
+        ``MXNET_SERVE_PAGE_TOKENS`` / ``MXNET_SERVE_PREFILL_CHUNK`` /
+        ``MXNET_SERVE_KV_DTYPE``; see SERVING.md).
     policy : "fifo" | "sjf", optional
         Admission order (default ``MXNET_SERVE_POLICY`` or fifo).
     max_queue : int, optional
@@ -99,13 +72,16 @@ class ServeEngine:
     """
 
     def __init__(self, block_or_decoder, max_slots=8, max_len=None,
-                 buckets=PROMPT_BUCKETS, policy=None, max_queue=None,
-                 deadline_s=None, eos_id=None, do_sample=False, top_k=None,
-                 temperature=1.0, seed=0):
+                 page_tokens=None, prefill_chunk=None, n_pages=None,
+                 kv_dtype=None, prefix_reuse=True, policy=None,
+                 max_queue=None, deadline_s=None, eos_id=None,
+                 do_sample=False, top_k=None, temperature=1.0, seed=0):
         import os
 
         slots = SlotDecoder(block_or_decoder, max_slots=max_slots,
-                            max_len=max_len, buckets=buckets,
+                            max_len=max_len, page_tokens=page_tokens,
+                            prefill_chunk=prefill_chunk, n_pages=n_pages,
+                            kv_dtype=kv_dtype, prefix_reuse=prefix_reuse,
                             do_sample=do_sample, top_k=top_k)
         if policy is None:
             policy = os.environ.get("MXNET_SERVE_POLICY", "fifo")
@@ -144,6 +120,18 @@ class ServeEngine:
     @property
     def closed(self):
         return self._sched.closed
+
+    @property
+    def page_occupancy(self):
+        """Fraction of usable KV pool pages referenced (shared pages
+        counted once)."""
+        a = self._sched.slots.allocator
+        return a.used_pages / a.usable_pages if a.usable_pages else 0.0
+
+    @property
+    def kv_bytes_per_slot(self):
+        """Resident KV pool bytes per decode slot (0 before first use)."""
+        return self._sched.slots.kv_bytes_per_slot
 
     def xla_program_count(self):
         """Compiled XLA programs currently live (prefill buckets + the
@@ -350,6 +338,9 @@ class ServeEngine:
                     time.sleep(0.01)
         self.stop()
         with self._lock:
+            # drop the prefix cache's page references before the pool
+            # itself: a clean shutdown leaves the allocator empty
+            self._sched.slots.prefix_cache.clear()
             self._sched.slots.release()
 
     def __enter__(self):

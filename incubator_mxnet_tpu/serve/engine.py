@@ -1,43 +1,91 @@
-"""Slot-cache compiled decode programs (the device half of `mx.serve`).
+"""Paged slot-cache compiled decode programs (the device half of `mx.serve`).
 
-XLA programs are fixed-shape, so continuous batching cannot grow or
-shrink tensors as requests come and go. Instead this module keeps ONE
-persistent KV cache of static shape ``(L, max_slots, H, max_len, d)`` on
-the device and compiles exactly two program families against it:
+PR 4's engine kept one monolithic KV slot per request — shape
+``(L, max_slots, H, max_len, d)`` — so every slot reserved ``max_len``
+HBM regardless of actual request length and every prompt paid a full
+prefill. This module replaces it with a **paged** pool, the
+vLLM/PagedAttention block-allocation idea re-expressed TPU-natively
+(static shapes, gather-by-page-table, zero steady-state recompiles):
 
-- **prefill** — one causal pass over a single request's prompt (padded
-  to a power-of-two length bucket, `models.decoding.bucket_prompt`) that
-  writes the prompt's K/V into an assigned slot via one
-  ``dynamic_update_slice`` and samples the request's first token. One
-  program per bucket length — a small, bounded set.
-- **decode** — ONE step for ALL slots at once: every slot advances one
-  token against its own cache rows at its own position (per-slot
-  ``vmap`` scatter + an ``arange <= pos`` validity mask); a per-slot
-  ``active`` mask keeps retired/free slots from contributing anything.
-  One program, ever.
+- **page pool** — ONE persistent device array per K and V of shape
+  ``(L, n_pages, H, page_tokens, d)``. Page 0 is a reserved *trash*
+  page: unallocated page-table entries and inactive-slot writes land
+  there, and its contents are never attended (the validity mask excludes
+  them before softmax).
+- **page table** — a host-side ``(max_slots, pages_per_slot)`` int32
+  array mapping each slot's token range to pool pages (mirrored to the
+  device lazily, refreshed only when allocation changes). Decode gathers
+  a slot's logical KV view with a static-shape ``jnp.take`` over the
+  table row; prefill writes whole pages with a static-shape scatter.
+- **allocator + prefix cache** — `PageAllocator` (host-only free list +
+  refcounts; OOM raises the loud `PagePoolExhausted`, nothing is ever
+  silently evicted while referenced) and `PrefixCache` (hash of the
+  page-aligned token prefix → page list). A common system prompt is
+  prefilled once and its pages attached read-only to every later request
+  with the same prefix; "copy-on-extend" is structural: a request only
+  ever *writes* pages past its shared prefix (partial tail pages are
+  re-prefilled privately, and decode's first write position provably
+  lands beyond every shared page), so shared pages need no copies and no
+  write-protection machinery.
 
-Both programs donate the cache buffers (``donate_argnums``) so XLA
-updates them in place — steady-state serving allocates nothing and never
-recompiles: slot insert/evict is pure device-side index arithmetic, and
-the host merely rebinds the donated outputs.
+Two compiled program families, exactly as before:
 
-Correctness of slot reuse: a freed slot's stale K/V (from the previous
-occupant or from bucket padding) is never attended, because position
-``p`` only enters the attention mask once the slot's ``pos`` reaches
-``p`` — and the decode step writes the new token's K/V at ``p`` in the
-same program before attending. The per-request token stream is therefore
-bit-identical to a one-at-a-time `GPTDecoder.generate` (asserted by
-`tests/test_serve.py`).
+- **chunked prefill** (one program per chunk-length bucket,
+  `models.decoding.chunk_buckets`): one page-aligned chunk of ONE
+  request's prompt — embeds the chunk at its true positions (traced
+  ``t_start``), writes the chunk's K/V pages into the pool, attends the
+  chunk's queries against the slot's gathered view (prefix pages +
+  itself) under a causal-with-offset mask, and samples a first token
+  from the chunk's last real row (used by the host only on the final
+  chunk). Splitting long prompts into chunks lets the scheduler
+  interleave decode steps between chunks, so a long-prompt arrival no
+  longer stalls every running request for a whole monolithic prefill.
+- **decode** (ONE program): one token for ALL slots — per-slot scatter
+  of the new K/V at ``page_table[s, pos//page_tokens]`` (inactive slots
+  are redirected to the trash page), gather of each slot's view, masked
+  attention, per-slot sampling.
+
+Both donate the pool buffers (``donate_argnums``) so XLA updates them in
+place. Optional **int8 KV** (``MXNET_SERVE_KV_DTYPE=int8``) stores the
+pool as int8 with one scale per (layer, page, head) — the symmetric
+±127 convention of `contrib.quantization` (`quantize_symmetric`) —
+halving resident KV bytes per slot; decode re-quantizes only the single
+page it writes (grow-only per-page scale).
+
+Stale-row safety (unchanged argument, now per page): position ``p`` of a
+slot only enters the attention mask once the slot's ``pos`` reaches
+``p``, and the program that advances ``pos`` to ``p`` writes ``p``'s K/V
+first — so a freed-and-reused page's previous contents, chunk padding,
+and generation headroom are all dead by construction.
 """
 from __future__ import annotations
 
+import hashlib
 import math
+import os
+import weakref
 
-from ..models.decoding import (GPTDecoder, PROMPT_BUCKETS, _dense, _ln,
-                               _split_qkv, bucket_prompt)
-from ..telemetry import tracing
+import numpy as onp
 
-__all__ = ["SlotDecoder"]
+from ..models.decoding import (GPTDecoder, bucket_chunk, chunk_buckets)
+from ..telemetry import registry
+
+__all__ = ["SlotDecoder", "PageAllocator", "PrefixCache",
+           "PagePoolExhausted", "DEFAULT_PAGE_TOKENS",
+           "DEFAULT_PREFILL_CHUNK"]
+
+#: Tokens per KV page (MXNET_SERVE_PAGE_TOKENS). Smaller pages pack
+#: tighter and share more; larger pages shrink the page table and the
+#: gather fan-in.
+DEFAULT_PAGE_TOKENS = 16
+#: Prefill chunk ceiling in tokens (MXNET_SERVE_PREFILL_CHUNK); must be
+#: a multiple of the page size (rounded up if not).
+DEFAULT_PREFILL_CHUNK = 64
+
+PAD_TOKENS = registry.counter(
+    "mx_decode_bucket_pad_tokens_total",
+    "prompt tokens added by pad-to-bucket in the decode/serving "
+    "path (padding waste)")
 
 
 def _j():
@@ -46,29 +94,267 @@ def _j():
     return jax
 
 
+class PagePoolExhausted(RuntimeError):
+    """The KV page pool cannot satisfy an allocation — loud, like
+    `QueueFull`: pages referenced by live requests or the prefix cache
+    are NEVER silently evicted to make room. Shed load, shrink
+    max_new_tokens, raise ``n_pages``, or let running requests retire."""
+
+
+class PageAllocator:
+    """Host-side page accounting for the paged KV pool.
+
+    Pure bookkeeping — it never touches device memory. Page 0 is
+    reserved as the trash page (write target for inactive slots and
+    padding; never allocated, never read through a mask). Shared pages
+    are reference-counted: a page returns to the free list only when its
+    LAST reference (requests + prefix-cache entries) drops it.
+    """
+
+    def __init__(self, n_pages, page_tokens):
+        if n_pages < 2:
+            raise ValueError(f"n_pages must be >= 2 (page 0 is reserved), "
+                             f"got {n_pages}")
+        self.n_pages = int(n_pages)
+        self.page_tokens = int(page_tokens)
+        # LIFO free list: hot pages get reused while their tiles are warm
+        self._free = list(range(self.n_pages - 1, 0, -1))
+        self._ref = onp.zeros(self.n_pages, onp.int64)
+
+    @property
+    def usable_pages(self):
+        """Allocatable pages (total minus the reserved trash page)."""
+        return self.n_pages - 1
+
+    @property
+    def free_pages(self):
+        return len(self._free)
+
+    @property
+    def used_pages(self):
+        """Pages currently referenced — shared pages counted ONCE."""
+        return self.usable_pages - len(self._free)
+
+    def refcount(self, page):
+        return int(self._ref[page])
+
+    def alloc(self, n):
+        """Take `n` fresh pages (refcount 1 each). Raises the loud
+        `PagePoolExhausted` when the pool cannot satisfy the request —
+        the caller decides whether to evict unused prefix-cache entries
+        and retry, or to keep the request queued."""
+        n = int(n)
+        if n < 0:
+            raise ValueError(f"alloc({n})")
+        if n > len(self._free):
+            raise PagePoolExhausted(
+                f"KV page pool exhausted: need {n} pages, "
+                f"{len(self._free)}/{self.usable_pages} free "
+                f"({self.used_pages} referenced by live requests or the "
+                "prefix cache) — shed load, raise n_pages, or wait for "
+                "running requests to retire; shared pages are never "
+                "silently evicted")
+        pages = [self._free.pop() for _ in range(n)]
+        for p in pages:
+            self._ref[p] = 1
+        return pages
+
+    def incref(self, pages):
+        for p in pages:
+            if self._ref[p] <= 0:
+                raise RuntimeError(
+                    f"incref on free page {p} — a shared page was dropped "
+                    "while still mapped (allocator bookkeeping bug)")
+            self._ref[p] += 1
+
+    def decref(self, pages):
+        """Release one reference per page; pages whose count reaches zero
+        return to the free list."""
+        for p in pages:
+            self._ref[p] -= 1
+            if self._ref[p] == 0:
+                self._free.append(p)
+            elif self._ref[p] < 0:
+                raise RuntimeError(
+                    f"double free of page {p} (refcount went negative) — "
+                    "allocator bookkeeping bug")
+
+
+class _PrefixEntry:
+    __slots__ = ("pages", "tokens", "last_used")
+
+    def __init__(self, pages, tokens, last_used):
+        self.pages = pages
+        self.tokens = tokens
+        self.last_used = last_used
+
+
+class PrefixCache:
+    """Shared-prefix page reuse: hash(page-aligned token prefix) → pages.
+
+    Entries hold their OWN page references, so a cached prefix outlives
+    the request that prefilled it; `evict_unused` drops
+    least-recently-used entries (their references only — pages still
+    mapped into live requests stay allocated, which is the "no silent
+    eviction of shared pages" contract).
+
+    Every page boundary of a registered prompt gets its own entry, so a
+    later prompt matching any page-aligned prefix reuses the longest
+    match. Lookups always leave ≥ 1 prompt token uncovered: the final
+    token must run through prefill compute to produce the first sampled
+    token.
+    """
+
+    def __init__(self, allocator, enabled=True):
+        self._alloc = allocator
+        self._entries = {}
+        self._clock = 0
+        self.enabled = bool(enabled)
+
+    def __len__(self):
+        return len(self._entries)
+
+    @property
+    def cached_pages(self):
+        """Pages referenced by at least one cache entry (counted once)."""
+        seen = set()
+        for e in self._entries.values():
+            seen.update(e.pages)
+        return len(seen)
+
+    def _page_digests(self, prompt, n_pages):
+        """Rolling blake2b digest at each of the first `n_pages` page
+        boundaries of `prompt` (one pass over the token bytes)."""
+        pt = self._alloc.page_tokens
+        arr = onp.ascontiguousarray(onp.asarray(prompt, onp.int32))
+        h = hashlib.blake2b(digest_size=16)
+        out = []
+        for jj in range(n_pages):
+            h.update(arr[jj * pt:(jj + 1) * pt].tobytes())
+            out.append(h.digest())
+        return out
+
+    def shared_tokens(self, prompt):
+        """Length of the longest cached page-aligned proper prefix of
+        `prompt`, in tokens (0 when nothing matches). Read-only probe —
+        no LRU touch — for the scheduler's remaining-chunk SJF key."""
+        tokens, _ = self._match(prompt, touch=False)
+        return tokens
+
+    def lookup(self, prompt):
+        """Longest cached page-aligned proper prefix → ``(tokens,
+        pages)``. Does NOT take page references — the caller increfs the
+        returned pages if (and only if) it maps them into a request."""
+        return self._match(prompt, touch=True)
+
+    def _match(self, prompt, touch):
+        if not self.enabled or not self._entries:
+            return 0, []
+        pt = self._alloc.page_tokens
+        max_pages = (len(prompt) - 1) // pt
+        if max_pages < 1:
+            return 0, []
+        digests = self._page_digests(prompt, max_pages)
+        for jj in range(max_pages, 0, -1):
+            e = self._entries.get(digests[jj - 1])
+            if e is not None:
+                if touch:
+                    self._clock += 1
+                    e.last_used = self._clock
+                return jj * pt, list(e.pages)
+        return 0, []
+
+    def register(self, prompt, pages):
+        """Make the prompt's full pages shareable. `pages` is the
+        request's page list (its prefill must be COMPLETE — the pool
+        holds valid K/V for every full prompt page). Returns the number
+        of new entries. Idempotent per prefix."""
+        if not self.enabled:
+            return 0
+        pt = self._alloc.page_tokens
+        n_full = len(prompt) // pt
+        if n_full < 1:
+            return 0
+        digests = self._page_digests(prompt, n_full)
+        added = 0
+        for jj in range(1, n_full + 1):
+            d = digests[jj - 1]
+            if d in self._entries:
+                continue
+            entry_pages = tuple(int(p) for p in pages[:jj])
+            self._alloc.incref(entry_pages)
+            self._clock += 1
+            self._entries[d] = _PrefixEntry(entry_pages, jj * pt,
+                                            self._clock)
+            added += 1
+        return added
+
+    def evict_unused(self, pages_needed):
+        """Drop least-recently-used entries until at least `pages_needed`
+        pages are free or no entries remain. Only cache references are
+        dropped: a page still mapped into a live request keeps a nonzero
+        refcount and is NEVER reused from under it. Returns entries
+        dropped."""
+        if self._alloc.free_pages >= pages_needed:
+            return 0
+        dropped = 0
+        for d, e in sorted(self._entries.items(),
+                           key=lambda kv: kv[1].last_used):
+            if self._alloc.free_pages >= pages_needed:
+                break
+            self._alloc.decref(e.pages)
+            del self._entries[d]
+            dropped += 1
+        if dropped:
+            registry.counter(
+                "mx_serve_prefix_evictions_total",
+                "prefix-cache entries dropped to free pages (cache refs "
+                "only — live requests keep their pages)").inc(dropped)
+        return dropped
+
+    def clear(self):
+        for e in self._entries.values():
+            self._alloc.decref(e.pages)
+        self._entries.clear()
+
+
 class SlotDecoder:
-    """Persistent slot-cache decoder over a `GPTDecoder` (or the
+    """Paged slot-cache decoder over a `GPTDecoder` (or the
     `GPTModel`-shaped Block it wraps).
 
     Parameters
     ----------
     source : GPTDecoder or Block
-        The model to serve. A Block is wrapped in a `GPTDecoder`
-        (zero-copy parameter references, auto-refreshed on update).
+        The model to serve.
     max_slots : int
-        Static batch width of the decode program — the number of
-        requests that can be in flight simultaneously.
+        Static batch width of the decode program.
     max_len : int
-        Static sequence capacity of every slot (prompt + generated).
-        Defaults to the model's position-embedding length and may not
-        exceed it.
-    do_sample / top_k : sampling mode, STATIC per engine (baked into the
-        compiled programs — per-request values would recompile).
-        Temperature stays a runtime argument and may vary per request.
+        Per-slot sequence capacity (prompt + generated); defaults to the
+        model's position-embedding length.
+    page_tokens : int
+        Tokens per KV page (default ``MXNET_SERVE_PAGE_TOKENS`` or 16).
+    prefill_chunk : int
+        Prefill chunk ceiling in tokens (default
+        ``MXNET_SERVE_PREFILL_CHUNK`` or 64); rounded up to a multiple
+        of `page_tokens` and capped at the slot view.
+    n_pages : int
+        Total pool pages INCLUDING the reserved trash page 0. Defaults
+        to full backing for every slot (``max_slots * pages_per_slot``
+        + 1); smaller values trade HBM for admission pressure
+        (`PagePoolExhausted` is the loud limit).
+    kv_dtype : "fp" | "int8"
+        KV storage (default ``MXNET_SERVE_KV_DTYPE`` or the parameter
+        dtype). int8 halves resident KV bytes with one scale per
+        (layer, page, head).
+    prefix_reuse : bool
+        Arm the shared-prefix cache (default True).
+    do_sample / top_k : sampling mode, STATIC per engine; `temperature`
+        stays a runtime per-request argument.
     """
 
-    def __init__(self, source, max_slots=8, max_len=None,
-                 buckets=PROMPT_BUCKETS, do_sample=False, top_k=None):
+    def __init__(self, source, max_slots=8, max_len=None, page_tokens=None,
+                 prefill_chunk=None, n_pages=None, kv_dtype=None,
+                 prefix_reuse=True, do_sample=False, top_k=None):
         if isinstance(source, GPTDecoder):
             self._dec = source
         elif hasattr(source, "blocks") and hasattr(source, "position_embed"):
@@ -86,20 +372,84 @@ class SlotDecoder:
         self.max_slots = int(max_slots)
         if self.max_slots < 1:
             raise ValueError("max_slots must be >= 1")
-        # always top out at max_len so every admissible prompt has a
-        # bucket — the program count stays bounded by len(buckets)
-        self.buckets = tuple(sorted(
-            {b for b in buckets if b < self.max_len} | {self.max_len}))
+
+        from ..util import env_int
+
+        pt = int(page_tokens) if page_tokens is not None else \
+            env_int("MXNET_SERVE_PAGE_TOKENS", DEFAULT_PAGE_TOKENS)
+        if pt < 1:
+            raise ValueError(f"page_tokens must be >= 1, got {pt}")
+        self.page_tokens = pt
+        self.pages_per_slot = -(-self.max_len // pt)          # ceil
+        self.view_tokens = self.pages_per_slot * pt
+        chunk = int(prefill_chunk) if prefill_chunk is not None else \
+            env_int("MXNET_SERVE_PREFILL_CHUNK", DEFAULT_PREFILL_CHUNK)
+        chunk = max(pt, -(-chunk // pt) * pt)                 # page-align up
+        self.prefill_chunk = min(chunk, self.view_tokens)
+        self.chunk_buckets = chunk_buckets(pt, self.prefill_chunk)
+
+        if kv_dtype is None:
+            kv_dtype = os.environ.get("MXNET_SERVE_KV_DTYPE", "fp")
+        if kv_dtype not in ("fp", "int8"):
+            raise ValueError(
+                f"kv_dtype must be 'fp' or 'int8', got {kv_dtype!r} "
+                "(MXNET_SERVE_KV_DTYPE)")
+        self.kv_dtype = kv_dtype
+        self._int8 = kv_dtype == "int8"
+
+        default_pages = self.max_slots * self.pages_per_slot + 1
+        self.n_pages = int(n_pages) if n_pages is not None else default_pages
+        self.allocator = PageAllocator(self.n_pages, pt)
+        self.prefix_cache = PrefixCache(self.allocator,
+                                        enabled=bool(prefix_reuse))
+        registry.register_pull_gauge(
+            "mx_serve_page_occupancy",
+            _occupancy_probe(self.allocator),
+            "fraction of usable KV pool pages referenced (shared pages "
+            "counted once) [0, 1]")
+
         self._do_sample = bool(do_sample)
         self._top_k = None if top_k is None else int(top_k)
-        self._ck = self._cv = None
+
+        # host page table + lazy device mirror (refreshed only when an
+        # allocation changes it — steady-state decode re-sends nothing)
+        self._table = onp.zeros((self.max_slots, self.pages_per_slot),
+                                onp.int32)
+        self._table_dev = None
+        self._table_dirty = True
+
+        self._pk = self._pv = None          # paged K/V device arrays
+        self._sk = self._sv = None          # int8 per-(L, page, H) scales
         self._prefill_jit = None
         self._decode_jit = None
 
-    # -- cache --------------------------------------------------------------
+    # -- page table ---------------------------------------------------------
 
-    def _ensure_cache(self):
-        if self._ck is not None:
+    def set_slot_pages(self, slot, pages):
+        """Bind `pages` (host ints) as `slot`'s logical token range;
+        entries past the list point at the trash page."""
+        if len(pages) > self.pages_per_slot:
+            raise ValueError(
+                f"{len(pages)} pages exceed the slot view "
+                f"({self.pages_per_slot})")
+        self._table[slot, :] = 0
+        self._table[slot, :len(pages)] = pages
+        self._table_dirty = True
+
+    def clear_slot(self, slot):
+        self._table[slot, :] = 0
+        self._table_dirty = True
+
+    def _table_device(self):
+        if self._table_dirty or self._table_dev is None:
+            self._table_dev = _j().numpy.asarray(self._table)
+            self._table_dirty = False
+        return self._table_dev
+
+    # -- pool ---------------------------------------------------------------
+
+    def _ensure_pool(self):
+        if self._pk is not None:
             return
         jnp = _j().numpy
         params = self._dec._params
@@ -107,92 +457,230 @@ class SlotDecoder:
         L = layers["ln1_g"].shape[0]
         H = self._dec._n_heads
         d = self._dec._units // H
-        dtype = layers["qkv_w"].dtype
-        shape = (L, self.max_slots, H, self.max_len, d)
-        self._ck = jnp.zeros(shape, dtype)
-        self._cv = jnp.zeros(shape, dtype)
+        shape = (L, self.n_pages, H, self.page_tokens, d)
+        if self._int8:
+            self._pk = jnp.zeros(shape, jnp.int8)
+            self._pv = jnp.zeros(shape, jnp.int8)
+            self._sk = jnp.zeros((L, self.n_pages, H), jnp.float32)
+            self._sv = jnp.zeros((L, self.n_pages, H), jnp.float32)
+        else:
+            dtype = layers["qkv_w"].dtype
+            self._pk = jnp.zeros(shape, dtype)
+            self._pv = jnp.zeros(shape, dtype)
 
     def release(self):
-        """Drop the device cache (shutdown); the next prefill reallocates."""
-        self._ck = self._cv = None
+        """Drop the device pool (shutdown); the next prefill reallocates."""
+        self._pk = self._pv = self._sk = self._sv = None
+        self._table_dev = None
+        self._table_dirty = True
 
     @property
     def cache_bytes(self):
-        """Device bytes held by the persistent KV cache (0 if released)."""
-        if self._ck is None:
+        """Device bytes held by the persistent KV pool (0 if released)."""
+        if self._pk is None:
             return 0
-        return 2 * self._ck.size * self._ck.dtype.itemsize
+        n = 2 * self._pk.size * self._pk.dtype.itemsize
+        if self._sk is not None:
+            n += 2 * self._sk.size * self._sk.dtype.itemsize
+        return n
 
-    # -- compiled programs --------------------------------------------------
+    @property
+    def kv_bytes_per_slot(self):
+        """Resident pool bytes per decode slot — the HBM cost a slot
+        actually pays under paging (int8 halves it)."""
+        if self._pk is None:
+            return 0
+        return self.cache_bytes / self.max_slots
+
+    # -- shared attention helpers (traced) ----------------------------------
+
+    def _dequant_view(self, pool_l, scale_l, idx):
+        """Gather pages `idx` from one layer's pool and return the real-
+        valued view ``(..., n_idx * page_tokens, d)`` (leading dims follow
+        `idx`'s shape). fp pools gather straight through."""
+        jnp = _j().numpy
+        v = jnp.take(pool_l, idx, axis=0)
+        if self._int8:
+            sc = jnp.take(scale_l, idx, axis=0)
+            v = v.astype(jnp.float32) * sc[..., None, None]
+        return v
+
+    # -- chunked prefill ----------------------------------------------------
 
     def _build_prefill(self):
         jax = _j()
         jnp = jax.numpy
         lax = jax.lax
         dec = self._dec
+        H = dec._n_heads
+        pt = self.page_tokens
+        int8 = self._int8
 
-        def prefill(params, ck, cv, tokens, slot, t0, key, temperature,
-                    *, top_k, do_sample):
-            B = tokens.shape[1]
-            x = params["embed"][tokens] + params["pos"][:B]
+        from ..contrib.quantization import quantize_symmetric
+        from ..models.decoding import _dense, _ln, _split_qkv
 
-            def pre_layer(x, lp):
-                x, k, v = dec._prefill_layer(x, lp, B)
-                return x, (k, v)
+        def to_pages(t):
+            # (1, H, C, d) -> (C//pt pages, H, pt, d)
+            _, _, C, d = t.shape
+            return jnp.transpose(
+                t[0].transpose(1, 0, 2).reshape(C // pt, pt, H, d),
+                (0, 2, 1, 3))
 
-            x, (k, v) = lax.scan(pre_layer, x, params["layers"])
-            # k/v: (L, 1, H, B, d) — one write drops the whole prompt
-            # into the slot's rows [0, B)
-            ck = lax.dynamic_update_slice(ck, k.astype(ck.dtype),
-                                          (0, slot, 0, 0, 0))
-            cv = lax.dynamic_update_slice(cv, v.astype(cv.dtype),
-                                          (0, slot, 0, 0, 0))
-            # last REAL token (bucket padding sits beyond t0-1 and is
-            # causally invisible to it)
-            h_last = lax.dynamic_slice_in_dim(x, t0 - 1, 1, axis=1)[:, 0]
-            logits = dec._logits(params, h_last)                  # (1, V)
+        def run(params, pk, pv, sk, sv, tokens, pages_row, chunk_pages,
+                t_start, t_len, key, temperature, top_k, do_sample):
+            C = tokens.shape[1]
+            PT = pages_row.shape[0] * pt
+            pos_tab = params["pos"]
+            pos_idx = jnp.clip(t_start + jnp.arange(C), 0,
+                               pos_tab.shape[0] - 1)
+            x = params["embed"][tokens] + pos_tab[pos_idx]
+            qpos = t_start + jnp.arange(C)
+            # causal-with-offset validity: key position j is visible to
+            # chunk row i iff j <= t_start + i — this covers BOTH the
+            # prefix pages (j < t_start) and in-chunk causality, and
+            # masks stale/trash/padding pages in one stroke
+            mask = jnp.arange(PT)[None, :] <= qpos[:, None]
+            sm_scale = 1.0 / math.sqrt(dec._units // H)
+            d = dec._units // H
+
+            def layer(x, packed):
+                if int8:
+                    lp, pk_l, pv_l, sk_l, sv_l = packed
+                else:
+                    lp, pk_l, pv_l = packed
+                    sk_l = sv_l = None
+                h = _ln(x, lp["ln1_g"], lp["ln1_b"])
+                q, k, v = _split_qkv(_dense(h, lp["qkv_w"], lp["qkv_b"]), H)
+                kp, vp = to_pages(k), to_pages(v)
+                if int8:
+                    kq, ks = quantize_symmetric(kp, axes=(2, 3))
+                    vq, vs = quantize_symmetric(vp, axes=(2, 3))
+                    pk_l = pk_l.at[chunk_pages].set(kq)
+                    pv_l = pv_l.at[chunk_pages].set(vq)
+                    sk_l = sk_l.at[chunk_pages].set(ks[:, :, 0, 0])
+                    sv_l = sv_l.at[chunk_pages].set(vs[:, :, 0, 0])
+                else:
+                    pk_l = pk_l.at[chunk_pages].set(kp.astype(pk_l.dtype))
+                    pv_l = pv_l.at[chunk_pages].set(vp.astype(pv_l.dtype))
+                # slot view: (P, H, pt, d) -> (1, H, P*pt, d)
+                vk = self._dequant_view(pk_l, sk_l, pages_row)
+                vv = self._dequant_view(pv_l, sv_l, pages_row)
+                vk = jnp.transpose(vk, (1, 0, 2, 3)).reshape(H, PT, d)[None]
+                vv = jnp.transpose(vv, (1, 0, 2, 3)).reshape(H, PT, d)[None]
+                if int8:
+                    # the chunk attends to its OWN K/V exactly (pre-
+                    # quantization) — only the prefix pays quantization
+                    vk = lax.dynamic_update_slice(vk, k.astype(vk.dtype),
+                                                  (0, 0, t_start, 0))
+                    vv = lax.dynamic_update_slice(vv, v.astype(vv.dtype),
+                                                  (0, 0, t_start, 0))
+                # mirror ops/flash_attention._xla_attention exactly (the
+                # impl the unpaged GPTDecoder prefill resolves to at
+                # serving sizes) so paged output stays bit-identical
+                s = jnp.einsum("bhqd,bhkd->bhqk", q, vk) * sm_scale
+                neg = jnp.asarray(jnp.finfo(s.dtype).min / 2, s.dtype)
+                s = jnp.where(mask[None, None], s, neg)
+                p = jax.nn.softmax(s, axis=-1)
+                o = jnp.einsum("bhqk,bhkd->bhqd", p, vv)
+                o = jnp.transpose(o, (0, 2, 1, 3)).reshape(1, C, H * d)
+                x = x + _dense(o, lp["proj_w"], lp["proj_b"])
+                h = _ln(x, lp["ln2_g"], lp["ln2_b"])
+                ffn = _dense(
+                    jax.nn.gelu(_dense(h, lp["ffn1_w"], lp["ffn1_b"])),
+                    lp["ffn2_w"], lp["ffn2_b"])
+                if int8:
+                    return x + ffn, (pk_l, pv_l, sk_l, sv_l)
+                return x + ffn, (pk_l, pv_l)
+
+            if int8:
+                x, (pk, pv, sk, sv) = lax.scan(
+                    layer, x, (params["layers"], pk, pv, sk, sv))
+            else:
+                x, (pk, pv) = lax.scan(layer, x, (params["layers"], pk, pv))
+            # the chunk's last REAL row (padding beyond t_len is causally
+            # downstream of it and cannot touch it)
+            h_last = lax.dynamic_slice_in_dim(x, t_len - 1, 1,
+                                              axis=1)[:, 0]
+            logits = dec._logits(params, h_last)               # (1, V)
             first = dec._sample(logits, key, temperature, top_k, do_sample)
-            return ck, cv, first[0]
+            return pk, pv, sk, sv, first[0]
+
+        # the int8 pools carry per-page scale planes as extra donated
+        # state; the fp signature omits them entirely (donating an
+        # unused placeholder would invalidate its buffer)
+        if int8:
+            def prefill(params, pk, pv, sk, sv, tokens, pages_row,
+                        chunk_pages, t_start, t_len, key, temperature, *,
+                        top_k, do_sample):
+                return run(params, pk, pv, sk, sv, tokens, pages_row,
+                           chunk_pages, t_start, t_len, key, temperature,
+                           top_k, do_sample)
+
+            return jax.jit(prefill, static_argnames=("top_k", "do_sample"),
+                           donate_argnums=(1, 2, 3, 4))
+
+        def prefill(params, pk, pv, tokens, pages_row, chunk_pages,
+                    t_start, t_len, key, temperature, *, top_k, do_sample):
+            pk, pv, _, _, first = run(params, pk, pv, None, None, tokens,
+                                      pages_row, chunk_pages, t_start,
+                                      t_len, key, temperature, top_k,
+                                      do_sample)
+            return pk, pv, first
 
         return jax.jit(prefill, static_argnames=("top_k", "do_sample"),
                        donate_argnums=(1, 2))
 
-    def _slot_decode_layer(self, x, lp, ck, cv, pos):
-        """One-token forward for every slot against its own cache rows.
+    def prefill_chunk_step(self, slot, chunk_tokens, t_start, key,
+                           temperature=1.0):
+        """Run ONE page-aligned prefill chunk for `slot`.
 
-        Unlike `GPTDecoder._decode_layer` (one shared scalar position),
-        each slot writes and masks at its OWN ``pos[s]`` — the whole
-        point of continuous batching.
+        `chunk_tokens` is the 1D host slice ``prompt[t_start:t_start+n]``
+        with ``t_start`` page-aligned (0 or a multiple of `page_tokens`,
+        e.g. the shared-prefix boundary). Returns ``(first_token, bucket,
+        pad)`` — the sampled token is meaningful only when this was the
+        prompt's final chunk; `bucket`/`pad` feed the caller's span
+        annotations.
         """
-        jax = _j()
-        jnp = jax.numpy
-        lax = jax.lax
+        jnp = _j().numpy
+        self._dec._auto_refresh()
+        self._ensure_pool()
+        if self._prefill_jit is None:
+            self._prefill_jit = self._build_prefill()
+        pt = self.page_tokens
+        if t_start % pt:
+            raise ValueError(
+                f"chunk start {t_start} is not page-aligned (page_tokens="
+                f"{pt})")
+        chunk = onp.asarray(chunk_tokens, onp.int32).reshape(-1)
+        n = chunk.size
+        bucket = bucket_chunk(n, self.chunk_buckets)
+        pad = bucket - n
+        if pad:
+            chunk = onp.pad(chunk, (0, pad))
+            PAD_TOKENS.inc(pad)
+        # the chunk's pages, padded with the trash page where the bucket
+        # overshoots the slot's mapped range (pad-token K/V is discarded)
+        first_page = t_start // pt
+        row = self._table[slot]
+        cp = bucket // pt
+        chunk_pages = onp.zeros(cp, onp.int32)
+        avail = row[first_page:first_page + cp]
+        chunk_pages[:avail.size] = avail
+        args = (jnp.asarray(chunk)[None, :], jnp.asarray(row),
+                jnp.asarray(chunk_pages), jnp.int32(t_start), jnp.int32(n),
+                key, jnp.float32(max(float(temperature), 1e-6)))
+        if self._int8:
+            (self._pk, self._pv, self._sk, self._sv,
+             first) = self._prefill_jit(
+                self._dec._params, self._pk, self._pv, self._sk, self._sv,
+                *args, top_k=self._top_k, do_sample=self._do_sample)
+        else:
+            self._pk, self._pv, first = self._prefill_jit(
+                self._dec._params, self._pk, self._pv, *args,
+                top_k=self._top_k, do_sample=self._do_sample)
+        return int(first), bucket, pad
 
-        H = self._dec._n_heads
-        h = _ln(x, lp["ln1_g"], lp["ln1_b"])
-        q, k, v = _split_qkv(_dense(h, lp["qkv_w"], lp["qkv_b"]), H)
-        d = q.shape[-1]
-        # per-slot scatter of this token's k/v at the slot's position
-        write = jax.vmap(
-            lambda c, u, p: lax.dynamic_update_slice(c, u, (0, p, 0)))
-        ck = write(ck, k.astype(ck.dtype), pos)
-        cv = write(cv, v.astype(cv.dtype), pos)
-        s = jnp.einsum("shqd,shkd->shqk", q, ck,
-                       preferred_element_type=jnp.float32)
-        s = s / math.sqrt(d)
-        # each slot attends to its own 0..pos[s]; everything beyond is
-        # stale (previous occupant / bucket padding) and masked out
-        mask = jnp.arange(ck.shape[2])[None, :] <= pos[:, None]
-        s = jnp.where(mask[:, None, None, :], s, -jnp.inf)
-        p = jax.nn.softmax(s, axis=-1).astype(cv.dtype)
-        o = jnp.einsum("shqk,shkd->shqd", p, cv)
-        S = x.shape[0]
-        o = jnp.transpose(o, (0, 2, 1, 3)).reshape(S, 1, H * d)
-        x = x + _dense(o, lp["proj_w"], lp["proj_b"])
-        h = _ln(x, lp["ln2_g"], lp["ln2_b"])
-        ffn = _dense(jax.nn.gelu(_dense(h, lp["ffn1_w"], lp["ffn1_b"])),
-                     lp["ffn2_w"], lp["ffn2_b"])
-        return x + ffn, ck, cv
+    # -- decode -------------------------------------------------------------
 
     def _sample_slots(self, logits, key, temperature, top_k, do_sample):
         """`GPTDecoder._sample` with a PER-SLOT temperature vector."""
@@ -213,85 +701,172 @@ class SlotDecoder:
         jnp = jax.numpy
         lax = jax.lax
         dec = self._dec
+        H = dec._n_heads
+        pt = self.page_tokens
+        int8 = self._int8
+        S = self.max_slots
 
-        def decode(params, ck, cv, last_tok, pos, active, key, temperature,
-                   *, top_k, do_sample):
+        from ..contrib.quantization import quantize_symmetric
+        from ..models.decoding import _dense, _ln, _split_qkv
+
+        def write_token(pool_l, scale_l, wpage, woff, t):
+            """Scatter one token's K or V (S, H, d) at each slot's write
+            page/offset; int8 re-quantizes just the written page under a
+            grow-only scale."""
+            if not int8:
+                return pool_l.at[wpage, :, woff].set(
+                    t.astype(pool_l.dtype)), scale_l
+            old = jnp.take(scale_l, wpage, axis=0)             # (S, H)
+            amax = jnp.max(jnp.abs(t), axis=-1)                # (S, H)
+            new = jnp.maximum(old, jnp.maximum(amax, 1e-8) / 127.0)
+            page = jnp.take(pool_l, wpage, axis=0)             # (S,H,pt,d)
+            page = jnp.clip(
+                jnp.round(page.astype(jnp.float32)
+                          * (old / new)[:, :, None, None]),
+                -127, 127)
+            tq, _ = quantize_symmetric(t, axes=(), scale=new[:, :, None])
+            page = page.at[jnp.arange(S), :, woff].set(tq)
+            pool_l = pool_l.at[wpage].set(page.astype(jnp.int8))
+            scale_l = scale_l.at[wpage].set(new)
+            return pool_l, scale_l
+
+        def run(params, pk, pv, sk, sv, table, last_tok, pos, active,
+                key, temperature, top_k, do_sample):
+            PT = table.shape[1] * pt
             x = (params["embed"][last_tok][:, None, :]
-                 + params["pos"][pos][:, None, :])        # (S, 1, C)
+                 + params["pos"][pos][:, None, :])              # (S, 1, C)
+            # each slot writes at its own page/offset; slots that are
+            # free or still prefilling are redirected to the trash page
+            wpage = table[jnp.arange(S), pos // pt]
+            wpage = jnp.where(active, wpage, 0)
+            woff = pos % pt
+            mask = jnp.arange(PT)[None, :] <= pos[:, None]
+            d = dec._units // H
 
-            def dec_layer(x, layer):
-                lp, ck_l, cv_l = layer
-                x, ck_l, cv_l = self._slot_decode_layer(x, lp, ck_l, cv_l,
-                                                        pos)
-                return x, (ck_l, cv_l)
+            def layer(x, packed):
+                if int8:
+                    lp, pk_l, pv_l, sk_l, sv_l = packed
+                else:
+                    lp, pk_l, pv_l = packed
+                    sk_l = sv_l = None
+                h = _ln(x, lp["ln1_g"], lp["ln1_b"])
+                q, k, v = _split_qkv(_dense(h, lp["qkv_w"], lp["qkv_b"]), H)
+                pk_l, sk_l = write_token(pk_l, sk_l, wpage, woff,
+                                         k[:, :, 0])
+                pv_l, sv_l = write_token(pv_l, sv_l, wpage, woff,
+                                         v[:, :, 0])
+                # per-slot logical view via the page table: one gather,
+                # static index shape (S, P)
+                vk = self._dequant_view(pk_l, sk_l, table)
+                vv = self._dequant_view(pv_l, sv_l, table)
+                vk = jnp.transpose(vk, (0, 2, 1, 3, 4)).reshape(S, H, PT, d)
+                vv = jnp.transpose(vv, (0, 2, 1, 3, 4)).reshape(S, H, PT, d)
+                s = jnp.einsum("shqd,shkd->shqk", q, vk,
+                               preferred_element_type=jnp.float32)
+                s = s / math.sqrt(d)
+                s = jnp.where(mask[:, None, None, :], s, -jnp.inf)
+                p = jax.nn.softmax(s, axis=-1).astype(vv.dtype)
+                o = jnp.einsum("shqk,shkd->shqd", p, vv)
+                o = jnp.transpose(o, (0, 2, 1, 3)).reshape(S, 1, H * d)
+                x = x + _dense(o, lp["proj_w"], lp["proj_b"])
+                h = _ln(x, lp["ln2_g"], lp["ln2_b"])
+                ffn = _dense(
+                    jax.nn.gelu(_dense(h, lp["ffn1_w"], lp["ffn1_b"])),
+                    lp["ffn2_w"], lp["ffn2_b"])
+                if int8:
+                    return x + ffn, (pk_l, pv_l, sk_l, sv_l)
+                return x + ffn, (pk_l, pv_l)
 
-            x, (ck, cv) = lax.scan(dec_layer, x,
-                                   (params["layers"], ck, cv))
-            logits = dec._logits(params, x[:, 0])          # (S, V)
+            if int8:
+                x, (pk, pv, sk, sv) = lax.scan(
+                    layer, x, (params["layers"], pk, pv, sk, sv))
+            else:
+                x, (pk, pv) = lax.scan(layer, x, (params["layers"], pk, pv))
+            logits = dec._logits(params, x[:, 0])               # (S, V)
             nxt = self._sample_slots(logits, key, temperature, top_k,
                                      do_sample)
-            # free/retired slots carry their last token forward — the
+            # free/prefilling slots carry their last token forward — the
             # host never reads them, but a defined value keeps the
             # program deterministic
             nxt = jnp.where(active, nxt, last_tok)
-            return ck, cv, nxt
+            return pk, pv, sk, sv, nxt
+
+        if int8:
+            def decode(params, pk, pv, sk, sv, table, last_tok, pos,
+                       active, key, temperature, *, top_k, do_sample):
+                return run(params, pk, pv, sk, sv, table, last_tok, pos,
+                           active, key, temperature, top_k, do_sample)
+
+            return jax.jit(decode, static_argnames=("top_k", "do_sample"),
+                           donate_argnums=(1, 2, 3, 4))
+
+        def decode(params, pk, pv, table, last_tok, pos, active, key,
+                   temperature, *, top_k, do_sample):
+            pk, pv, _, _, nxt = run(params, pk, pv, None, None, table,
+                                    last_tok, pos, active, key,
+                                    temperature, top_k, do_sample)
+            return pk, pv, nxt
 
         return jax.jit(decode, static_argnames=("top_k", "do_sample"),
                        donate_argnums=(1, 2))
 
-    # -- host-facing steps --------------------------------------------------
-
-    def prefill(self, slot, prompt_ids, key, temperature=1.0):
-        """Prefill `prompt_ids` (1D int32) into `slot`; returns the
-        request's first sampled token (host int)."""
-        jnp = _j().numpy
-        self._dec._auto_refresh()
-        self._ensure_cache()
-        if self._prefill_jit is None:
-            self._prefill_jit = self._build_prefill()
-        ids = jnp.asarray(prompt_ids, jnp.int32)[None, :]
-        padded, t0 = bucket_prompt(ids, buckets=self.buckets,
-                                   max_len=self.max_len)
-        # host-side annotation onto the scheduler's serve.prefill span:
-        # which compiled bucket program served this prompt
-        tracing.annotate(bucket=int(padded.shape[1]),
-                         pad_tokens=int(padded.shape[1]) - int(t0))
-        self._ck, self._cv, first = self._prefill_jit(
-            self._dec._params, self._ck, self._cv, padded,
-            jnp.int32(slot), jnp.int32(t0), key,
-            jnp.float32(max(float(temperature), 1e-6)),
-            top_k=self._top_k, do_sample=self._do_sample)
-        return int(first)
-
     def decode_step(self, last_tok, pos, active, key, temperature):
-        """One decode step for every slot. `last_tok`/`pos`/`active`/
-        `temperature` are HOST arrays (shape ``(max_slots,)``) — the
-        scheduler owns them, so the step loop never branches on device
-        values. Returns the next token per slot as a host numpy array
-        (the one host sync per step; the tokens go back to clients
-        anyway)."""
-        import numpy as onp
-
+        """One decode step for every DECODE-ACTIVE slot. `last_tok` /
+        `pos` / `active` / `temperature` are HOST arrays (shape
+        ``(max_slots,)``) owned by the scheduler — the step loop never
+        branches on device values. Slots still mid-prefill must have
+        ``active=False`` (their writes are redirected to the trash page).
+        Returns the next token per slot as host numpy (the one host sync
+        per step)."""
         jnp = _j().numpy
         self._dec._auto_refresh()
-        self._ensure_cache()
+        self._ensure_pool()
         if self._decode_jit is None:
             self._decode_jit = self._build_decode()
-        self._ck, self._cv, nxt = self._decode_jit(
-            self._dec._params, self._ck, self._cv,
-            jnp.asarray(last_tok, jnp.int32),
-            jnp.asarray(pos, jnp.int32),
-            jnp.asarray(active, bool),
-            key,
-            jnp.asarray(temperature, jnp.float32),
-            top_k=self._top_k, do_sample=self._do_sample)
+        args = (self._table_device(),
+                jnp.asarray(last_tok, jnp.int32),
+                jnp.asarray(pos, jnp.int32),
+                jnp.asarray(active, bool),
+                key,
+                jnp.asarray(temperature, jnp.float32))
+        if self._int8:
+            (self._pk, self._pv, self._sk, self._sv,
+             nxt) = self._decode_jit(
+                self._dec._params, self._pk, self._pv, self._sk, self._sv,
+                *args, top_k=self._top_k, do_sample=self._do_sample)
+        else:
+            self._pk, self._pv, nxt = self._decode_jit(
+                self._dec._params, self._pk, self._pv, *args,
+                top_k=self._top_k, do_sample=self._do_sample)
         return onp.asarray(nxt)
 
+    # -- debug / tests ------------------------------------------------------
+
+    def slot_kv(self, slot, n_tokens):
+        """Host copy of a slot's first `n_tokens` of K and V (dequantized
+        under int8) — parity/tolerance checks in tests, not a hot path."""
+        jnp = _j().numpy
+        self._ensure_pool()
+        idx = jnp.asarray(self._table[slot])
+        outs = []
+        for pool, scale in ((self._pk, self._sk), (self._pv, self._sv)):
+            views = []
+            L = pool.shape[0]
+            for layer in range(L):
+                v = self._dequant_view(pool[layer],
+                                       None if scale is None
+                                       else scale[layer], idx)
+                P, H, pt, d = v.shape
+                views.append(jnp.transpose(v, (1, 0, 2, 3))
+                             .reshape(H, P * pt, d)[:, :n_tokens])
+            outs.append(onp.asarray(jnp.stack(views), onp.float32))
+        return outs[0], outs[1]
+
     def xla_program_count(self):
-        """Number of compiled programs across the prefill family (one
-        per bucket actually seen) and the decode program — the
-        recompile-count gate of `tests/test_serve.py` asserts this stays
-        constant in steady state."""
+        """Number of compiled programs across the chunk-prefill family
+        (one per chunk bucket actually seen) and the decode program —
+        the recompile-count gate of `tests/test_serve.py` asserts this
+        stays constant in steady state."""
         n = 0
         for f in (self._prefill_jit, self._decode_jit):
             if f is None:
@@ -300,3 +875,18 @@ class SlotDecoder:
             if size is not None:
                 n += int(size())
         return n
+
+
+def _occupancy_probe(allocator):
+    """Weakly-bound pull probe for the page-occupancy gauge (engines come
+    and go in tests; a dead allocator must not pin memory or poison the
+    collector)."""
+    ref = weakref.ref(allocator)
+
+    def probe():
+        a = ref()
+        if a is None or a.usable_pages == 0:
+            return None
+        return a.used_pages / a.usable_pages
+
+    return probe

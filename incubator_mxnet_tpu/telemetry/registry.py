@@ -231,6 +231,29 @@ def register_collector(fn):
     return fn
 
 
+def register_pull_gauge(name, probe, help=""):  # noqa: ARG001 — help is doc
+    """A gauge-typed series whose value is pulled from ``probe()`` at
+    every `report()` / `exposition()` — for occupancy-style series whose
+    source of truth is live host state in another subsystem (e.g.
+    ``mx_serve_page_occupancy`` over the serving KV page allocator), so
+    readers always see the current value instead of the last pushed one.
+
+    ``probe`` returns a number, or None to omit the series this round
+    (the idiom for weakly-bound sources that may be gone). Collector-
+    only on purpose: registering a push `Gauge` under the same name
+    would emit the series twice per exposition."""
+
+    def _pull():
+        v = probe()
+        if v is None:
+            return {}
+        return {name: float(v)}
+
+    _pull.__name__ = f"pull_gauge[{name}]"
+    register_collector(_pull)
+    return _pull
+
+
 # ---------------------------------------------------------------------------
 # built-in series
 # ---------------------------------------------------------------------------

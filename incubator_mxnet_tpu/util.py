@@ -5,7 +5,8 @@ from __future__ import annotations
 import functools
 
 __all__ = ["is_np_array", "is_np_shape", "set_np", "reset_np", "use_np",
-           "np_shape", "np_array", "getenv", "setenv", "default_array"]
+           "np_shape", "np_array", "getenv", "setenv", "default_array",
+           "env_int", "env_float"]
 
 
 def is_np_array():
@@ -72,6 +73,33 @@ def default_array(source_array, ctx=None, dtype=None):
     from .ndarray.ndarray import NDArray
 
     return NDArray(source_array, device=ctx, dtype=dtype)
+
+
+def _env_number(name, default, parse):
+    import os
+
+    v = os.environ.get(name)
+    if v is None:
+        return default
+    try:
+        return parse(v)
+    except ValueError:
+        import logging
+
+        logging.getLogger("incubator_mxnet_tpu").warning(
+            "%s=%r is not a number; using %r", name, v, default)
+        return default
+
+
+def env_int(name, default):
+    """Integer env knob with a logged fallback on junk values (the shared
+    reader behind the MXNET_SERVE_* and similar numeric knobs)."""
+    return _env_number(name, default, int)
+
+
+def env_float(name, default):
+    """Float env knob with a logged fallback on junk values."""
+    return _env_number(name, default, float)
 
 
 # ---------------------------------------------------------------------------
@@ -201,6 +229,21 @@ _ENV_KNOBS = {
         "serve.ServeEngine", "default per-request deadline in seconds; "
         "expiry fails the request with DeadlineExceeded (retryable "
         "class); unset = no deadline (honored, this build's addition)"),
+    "MXNET_SERVE_PAGE_TOKENS": (
+        "serve.SlotDecoder", "tokens per KV-cache page in the paged "
+        "serving pool (default 16): smaller pages pack/share tighter, "
+        "larger pages shrink the page table (honored, this build's "
+        "addition — see SERVING.md)"),
+    "MXNET_SERVE_PREFILL_CHUNK": (
+        "serve.SlotDecoder", "prefill chunk ceiling in tokens (default "
+        "64, rounded up to a page multiple): long prompts prefill in "
+        "chunks interleaved with decode steps so arrivals stop spiking "
+        "TTFT p99 (honored, this build's addition)"),
+    "MXNET_SERVE_KV_DTYPE": (
+        "serve.SlotDecoder", "fp (default) or int8: int8 stores the KV "
+        "pool quantized with one scale per (layer, page, head) — half "
+        "the resident KV bytes per slot, parity within tolerance "
+        "(honored, this build's addition)"),
     # -- designed out (XLA/jax owns the mechanism) -------------------------
     "MXNET_ENGINE_TYPE": (
         "(designed out)", "scheduling is XLA async dispatch; value ignored"),

@@ -78,6 +78,15 @@ _QUICK = {
     "test_serve.py::test_deadline_expiry_classifies_retryable",
     "test_serve.py::test_drain_semantics_scheduler",
     "test_serve.py::test_serve_step_fault_seam",
+    # paged serving (ISSUE 6 gates): allocator/prefix-cache host logic,
+    # remaining-chunk SJF accounting, chunk/decode interleave, FL009 —
+    # all stub-level, no XLA compile
+    "test_serve.py::test_page_allocator_alloc_free_refcount",
+    "test_serve.py::test_page_allocator_oom_loud",
+    "test_serve.py::test_prefix_cache_register_lookup_evict",
+    "test_serve.py::test_sjf_orders_by_remaining_prefill_chunks",
+    "test_serve.py::test_chunked_prefill_interleaves_with_decode",
+    "test_tools.py::test_fl009_tree_is_clean",
     "test_tools.py::test_fl007_tree_is_clean",
     # observability round 2 (ISSUE 5 gates): span tracer mechanics, one
     # trace per serve request (stub scheduler — no XLA), SLO burn math,

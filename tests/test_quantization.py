@@ -419,3 +419,23 @@ def _walk_blocks(net):
         stack.extend(c for c in b._children.values()
                      if hasattr(c, "_children"))
     return out
+
+
+def test_quantize_symmetric_jax_roundtrip():
+    """The jax-side twin of _quantize_weight (ISSUE 6: int8 KV pages):
+    per-group symmetric ±127 quantization round-trips within the one-LSB
+    bound, and an imposed (grow-only page) scale is honored."""
+    import jax.numpy as jnp
+
+    rng = onp.random.RandomState(0)
+    x = jnp.asarray(rng.normal(0, 2.0, (4, 8, 16, 32)).astype("float32"))
+    qv, scale = q.quantize_symmetric(x, axes=(2, 3))
+    assert qv.dtype == jnp.int8 and scale.shape == (4, 8, 1, 1)
+    back = q.dequantize_symmetric(qv, scale)
+    err = float(jnp.max(jnp.abs(back - x)))
+    assert err <= float(jnp.max(scale)) * 0.5 + 1e-6   # half-LSB rounding
+    # imposed scale (requantization into an existing page's scale)
+    qv2, s2 = q.quantize_symmetric(x, axes=(), scale=scale * 2)
+    assert float(jnp.max(jnp.abs(qv2.astype(jnp.float32)))) <= 127
+    back2 = q.dequantize_symmetric(qv2, s2)
+    assert float(jnp.max(jnp.abs(back2 - x))) <= float(jnp.max(s2))

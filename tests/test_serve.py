@@ -1,15 +1,20 @@
-"""mx.serve — continuous-batching inference engine (ISSUE 4).
+"""mx.serve — continuous batching over the PAGED KV cache (ISSUE 4 + 6).
 
-Two layers of coverage, both deterministic on CPU:
+Three layers of coverage, all deterministic on CPU:
 
-- scheduler-logic tests run against a stub slot decoder (pure host
-  arithmetic, no XLA compile — these are the `quick`-marked ones):
-  backpressure, policies, deadlines, drain semantics, the fault seam;
-- engine tests run a tiny 2-layer GPT through the real compiled
-  slot-cache programs: per-request parity with one-at-a-time
-  `GPTDecoder.generate`, slot reuse after EOS retirement, out-of-order
-  completion, streaming order, and the recompile-count gate (program
-  count constant across 3× more requests than slots).
+- host-only unit tests for the paging machinery (`PageAllocator`,
+  `PrefixCache`): alloc/free/refcount, loud `PagePoolExhausted` OOM, and
+  the no-silent-eviction-of-shared-pages contract;
+- scheduler-logic tests against a stub slot decoder (pure host
+  arithmetic, no XLA compile — the `quick`-marked ones): backpressure,
+  remaining-chunk SJF, deadlines, drain semantics, the fault seam;
+- engine tests running a tiny 2-layer GPT through the real compiled
+  paged programs: per-request parity with one-at-a-time
+  `GPTDecoder.generate` WITH paging + shared-prefix reuse + chunked
+  prefill all active, int8-KV parity within tolerance, slot/page reuse
+  after EOS retirement, and the recompile-count gate (program count
+  constant across 3× more requests than slots; the traced twin lives in
+  test_tracing.py).
 """
 import time
 
@@ -20,6 +25,9 @@ import incubator_mxnet_tpu as mx
 from incubator_mxnet_tpu import np, serve
 from incubator_mxnet_tpu.models.decoding import GPTDecoder
 from incubator_mxnet_tpu.models.gpt import gpt_tiny
+from incubator_mxnet_tpu.serve.engine import (PageAllocator,
+                                              PagePoolExhausted,
+                                              PrefixCache)
 from incubator_mxnet_tpu.serve.scheduler import (DeadlineExceeded,
                                                  EngineClosed, QueueFull,
                                                  Scheduler)
@@ -28,21 +36,122 @@ VOCAB = 97
 
 
 # ---------------------------------------------------------------------------
+# paging machinery — host-only unit tests (quick)
+# ---------------------------------------------------------------------------
+
+def test_page_allocator_alloc_free_refcount():
+    a = PageAllocator(n_pages=9, page_tokens=16)        # 8 usable, 0 = trash
+    assert a.usable_pages == 8 and a.free_pages == 8 and a.used_pages == 0
+    pages = a.alloc(3)
+    assert len(pages) == 3 and 0 not in pages           # trash never handed out
+    assert a.free_pages == 5 and a.used_pages == 3
+    # sharing: a second holder increfs; the first decref keeps the page
+    a.incref(pages[:1])
+    a.decref(pages[:1])
+    assert a.free_pages == 5                            # still referenced
+    a.decref(pages)
+    assert a.free_pages == 8 and a.used_pages == 0
+    # double free is loud
+    with pytest.raises(RuntimeError):
+        a.decref(pages[:1])
+    # incref on a free page is loud (shared page dropped while mapped)
+    with pytest.raises(RuntimeError):
+        a.incref([pages[0]])
+
+
+def test_page_allocator_oom_loud():
+    a = PageAllocator(n_pages=5, page_tokens=8)         # 4 usable
+    held = a.alloc(3)
+    with pytest.raises(PagePoolExhausted) as ei:
+        a.alloc(2)
+    assert "never" in str(ei.value)                     # no silent eviction
+    from incubator_mxnet_tpu.fault.retry import classify_exception
+
+    assert classify_exception(ei.value) in ("retryable", "fatal")
+    a.decref(held)
+    assert len(a.alloc(4)) == 4
+
+
+def test_prefix_cache_register_lookup_evict():
+    a = PageAllocator(n_pages=17, page_tokens=4)        # 16 usable
+    cache = PrefixCache(a)
+    prompt = onp.arange(11, dtype=onp.int32)            # 2 full pages + tail
+    pages = a.alloc(3)
+    cache.register(prompt, pages)                       # entries for pages 1,2
+    assert len(cache) == 2
+    # longest page-aligned PROPER prefix: 8 of 11 tokens
+    tokens, shared = cache.lookup(prompt)
+    assert tokens == 8 and shared == pages[:2]
+    # a prompt extending the same prefix matches it too
+    longer = onp.concatenate([prompt[:8], onp.full(6, 90, onp.int32)])
+    tokens2, shared2 = cache.lookup(longer)
+    assert tokens2 == 8 and shared2 == pages[:2]
+    # an identical-length prompt with a different first page misses
+    other = onp.concatenate([onp.full(4, 91, onp.int32), prompt[4:]])
+    assert cache.lookup(other)[0] == 0
+    # the request retires: ITS refs drop, the cache's refs keep pages live
+    a.decref(pages)
+    assert a.used_pages == 2                            # page 3 freed
+    # eviction drops cache refs only — a page shared into a live request
+    # survives eviction (refcount stays positive, page NOT reused)
+    t, sp = cache.lookup(prompt)
+    a.incref(sp)                                        # "live request"
+    cache.evict_unused(a.usable_pages)                  # evict everything
+    assert len(cache) == 0
+    assert a.refcount(sp[0]) == 1 and a.refcount(sp[1]) == 1
+    free_before = a.free_pages
+    got = a.alloc(free_before)
+    assert not set(got) & set(sp)                       # never reused
+    a.decref(got)
+    a.decref(sp)
+    assert a.free_pages == a.usable_pages
+
+
+def test_prefix_cache_leaves_one_token_for_compute():
+    """A fully page-aligned identical prompt still prefills >= 1 token —
+    the final token's forward pass produces the first sampled token."""
+    a = PageAllocator(n_pages=9, page_tokens=4)
+    cache = PrefixCache(a)
+    prompt = onp.arange(8, dtype=onp.int32)             # exactly 2 pages
+    pages = a.alloc(2)
+    cache.register(prompt, pages)                       # both pages cached
+    tokens, shared = cache.lookup(prompt)
+    assert tokens == 4 and shared == pages[:1]          # proper prefix only
+
+
+# ---------------------------------------------------------------------------
 # scheduler logic against a stub decoder (no XLA, quick)
 # ---------------------------------------------------------------------------
 
 class _StubSlots:
-    """Slot-decoder stand-in: prefill emits the prompt's length as the
-    first token, decode increments — fully deterministic host math."""
+    """Paged-interface stand-in: pure host arithmetic over a REAL
+    allocator/prefix cache (host-only classes). The final prefill chunk
+    emits the prompt's length as the first token, decode increments —
+    fully deterministic host math."""
 
-    def __init__(self, max_slots=2, max_len=64):
+    def __init__(self, max_slots=2, max_len=64, page_tokens=16,
+                 prefill_chunk=64):
         self.max_slots = max_slots
         self.max_len = max_len
-        self.prefills = []
+        self.page_tokens = page_tokens
+        self.prefill_chunk = prefill_chunk
+        pages_per_slot = -(-max_len // page_tokens)
+        self.allocator = PageAllocator(max_slots * pages_per_slot + 1,
+                                       page_tokens)
+        self.prefix_cache = PrefixCache(self.allocator)
+        self.chunks = []                  # (slot, t_start, n) per chunk
 
-    def prefill(self, slot, prompt_ids, key, temperature=1.0):
-        self.prefills.append((slot, len(prompt_ids)))
-        return int(len(prompt_ids))
+    def set_slot_pages(self, slot, pages):
+        pass
+
+    def clear_slot(self, slot):
+        pass
+
+    def prefill_chunk_step(self, slot, chunk_tokens, t_start, key,
+                           temperature=1.0):
+        n = len(chunk_tokens)
+        self.chunks.append((slot, int(t_start), n))
+        return int(t_start) + n, n, 0
 
     def decode_step(self, last_tok, pos, active, key, temperature):
         return onp.where(active, last_tok + 1, last_tok).astype(onp.int32)
@@ -85,6 +194,17 @@ def test_submit_validation():
         Scheduler(_StubSlots(), policy="weird")
 
 
+def test_submit_page_budget_loud():
+    """A request that could never fit the pool is rejected at submit
+    with the loud PagePoolExhausted, not deferred forever."""
+    stub = _StubSlots(max_slots=2, max_len=64, page_tokens=16)
+    stub.allocator = PageAllocator(3, 16)   # 2 usable pages = 32 tokens
+    sched = Scheduler(stub, max_queue=4)
+    with pytest.raises(PagePoolExhausted):
+        sched.submit(_prompt(30), 20)       # needs 4 pages, pool has 2
+    sched.submit(_prompt(10), 10)           # 2 pages: fits
+
+
 def test_sjf_policy_admits_shortest_first():
     sched = Scheduler(_StubSlots(max_slots=1), policy="sjf", max_queue=8)
     long = sched.submit(_prompt(12), 6)
@@ -99,6 +219,57 @@ def test_sjf_policy_admits_shortest_first():
     b = sched2.submit(_prompt(3), 6)
     sched2.step()
     assert a.state == "running" and b.state == "queued"
+
+
+def test_sjf_orders_by_remaining_prefill_chunks():
+    """ISSUE 6 accounting fix: a LONG prompt whose prefix is cached
+    needs fewer remaining chunks than a shorter cold prompt — SJF must
+    admit it first."""
+    stub = _StubSlots(max_slots=1, max_len=64, page_tokens=8,
+                      prefill_chunk=8)
+    sched = Scheduler(stub, policy="sjf", max_queue=8)
+    long_prompt = _prompt(33, seed=3)       # 5 chunks cold
+    short_prompt = _prompt(17, seed=4)      # 3 chunks cold
+    # cache the long prompt's first 4 pages: remaining = 1 chunk
+    pages = stub.allocator.alloc(4)
+    stub.prefix_cache.register(long_prompt[:32], pages)
+    h_long = sched.submit(long_prompt, 5)
+    h_short = sched.submit(short_prompt, 5)
+    sched.step()
+    assert h_long.state == "running" and h_short.state == "queued"
+    assert h_long.shared_tokens == 32
+    # and without the cache entry, plain shortest-first still wins
+    stub2 = _StubSlots(max_slots=1, max_len=64, page_tokens=8,
+                       prefill_chunk=8)
+    sched2 = Scheduler(stub2, policy="sjf", max_queue=8)
+    a = sched2.submit(_prompt(33, seed=3), 2)
+    b = sched2.submit(_prompt(17, seed=4), 2)
+    sched2.step()
+    assert b.state == "running" and a.state == "queued"
+
+
+def test_chunked_prefill_interleaves_with_decode():
+    """A long prompt prefills across several steps; an already-running
+    request keeps producing a token EVERY step in between (the TTFT-p99
+    fix chunking exists for)."""
+    stub = _StubSlots(max_slots=2, max_len=64, page_tokens=8,
+                      prefill_chunk=8)
+    sched = Scheduler(stub, max_queue=8)
+    runner = sched.submit(_prompt(4), 20)
+    sched.step()      # admit + single-chunk prefill + first decode step
+    assert runner.state == "running" and len(runner.tokens) == 2
+    long_req = sched.submit(_prompt(33, seed=5), 2)   # 5 chunks
+    produced_during_prefill = []
+    for _ in range(4):                      # chunks 1..4: still prefilling
+        before = len(runner.tokens)
+        sched.step()
+        produced_during_prefill.append(len(runner.tokens) - before)
+        assert long_req.first_token_t is None
+    assert all(n == 1 for n in produced_during_prefill)
+    sched.step()                            # final chunk: first token
+    assert long_req.first_token_t is not None
+    assert long_req.tokens[0] == 33         # stub: prompt length
+    assert len(stub.chunks) >= 5 + 1
 
 
 def test_deadline_expiry_classifies_retryable():
@@ -117,6 +288,8 @@ def test_deadline_expiry_classifies_retryable():
     time.sleep(0.03)
     sched.step()
     assert r2.state == "failed" and sched.n_active == 0
+    # pages went back with the slot
+    assert sched.slots.allocator.used_pages == 0
 
 
 def test_drain_semantics_scheduler():
@@ -178,7 +351,7 @@ def test_serve_step_fault_seam():
 
 
 # ---------------------------------------------------------------------------
-# real engine over a tiny 2-layer GPT (compiled slot-cache programs)
+# real engine over a tiny 2-layer GPT (compiled paged programs)
 # ---------------------------------------------------------------------------
 
 @pytest.fixture(scope="module")
@@ -220,16 +393,17 @@ def _mixed_requests(n, seed=0, lo=3, hi=18, budget_lo=2, budget_hi=12):
 
 def test_serve_matches_one_at_a_time_and_never_recompiles(eng, ref_dec):
     """The acceptance gate: 3× more requests than slots, varied prompt
-    lengths and budgets, all flowing through slot reuse — per-request
-    output identical to one-at-a-time GPTDecoder.generate, with ZERO
-    steady-state recompiles."""
+    lengths and budgets, all flowing through paged slot reuse —
+    per-request output identical to one-at-a-time GPTDecoder.generate,
+    with ZERO steady-state recompiles (the traced twin of this gate is
+    test_tracing.test_real_engine_traced_requests_and_recompile_gate)."""
     prompts, budgets = _mixed_requests(9, seed=1)
-    # warmup: one request per prefill bucket in play (32 and 64) plus
-    # the decode program
+    # warmup: one prompt per chunk bucket in play (16/32/64) + decode
     eng.generate(_prompt(5, seed=9), 3)
+    eng.generate(onp.resize(_prompt(5, seed=9), 20), 3)
     eng.generate(onp.resize(_prompt(5, seed=9), 40), 3)
     warm_count = eng.xla_program_count()
-    assert warm_count >= 2                 # ≥1 prefill bucket + decode
+    assert warm_count >= 2                 # ≥1 chunk bucket + decode
 
     handles = [eng.submit(p, b) for p, b in zip(prompts, budgets)]
     eng._drive_until(handles)
@@ -239,6 +413,78 @@ def test_serve_matches_one_at_a_time_and_never_recompiles(eng, ref_dec):
         onp.testing.assert_array_equal(got, ref)
     # steady state: same program count, no matter how many requests
     assert eng.xla_program_count() == warm_count
+
+
+def test_paged_prefix_reuse_and_chunking_parity(net, ref_dec):
+    """The tentpole end-to-end: small pages, multi-chunk prefill, and a
+    SHARED system prompt across requests — outputs stay bit-identical to
+    the unpaged reference while the prefix cache takes real hits and the
+    program count stays flat."""
+    from incubator_mxnet_tpu.telemetry import registry
+
+    e = serve.ServeEngine(net, max_slots=3, max_len=64, max_queue=32,
+                          page_tokens=8, prefill_chunk=16)
+    try:
+        system = _prompt(24, seed=42)               # 3 shared pages
+        tails = [_prompt(int(onp.random.RandomState(i).randint(2, 8)),
+                         seed=100 + i) for i in range(8)]
+        prompts = [onp.concatenate([system, t]) for t in tails]
+        # warm the chunk buckets (8 and 16) + decode out of the gate
+        e.generate(prompts[0][:19], 2)
+        e.generate(prompts[0][:16], 2)
+        warm = e.xla_program_count()
+        hits0 = registry.counter("mx_serve_prefix_hits_total").value
+        chunks0 = registry.counter("mx_serve_prefill_chunks_total").value
+        handles = [e.submit(p, 6) for p in prompts]
+        e._drive_until(handles)
+        for p, h in zip(prompts, handles):
+            ref = ref_dec.generate(p[None, :], 6).asnumpy()[0]
+            got = onp.concatenate([p, onp.asarray(h.result(), onp.int32)])
+            onp.testing.assert_array_equal(got, ref)
+        hits = registry.counter("mx_serve_prefix_hits_total").value - hits0
+        chunks = registry.counter(
+            "mx_serve_prefill_chunks_total").value - chunks0
+        assert hits >= 4                   # later waves reuse the prefix
+        assert chunks >= len(prompts)      # chunked prefill really ran
+        assert e.xla_program_count() == warm
+        # paged accounting: shared pages counted once, gauge is live
+        rep = registry.report()
+        assert 0 < rep["mx_serve_page_occupancy"]["value"] <= 1
+    finally:
+        e.shutdown(drain=False)
+    # a drained engine returns every page (cache cleared at shutdown)
+    assert e._sched.slots.allocator.used_pages == 0
+
+
+def test_int8_kv_parity_within_tolerance(net, ref_dec):
+    """MXNET_SERVE_KV_DTYPE=int8 equivalent: half the resident KV bytes,
+    greedy outputs within tolerance — first token EXACT for single-chunk
+    prompts (the chunk attends to its own pre-quantization K/V), and the
+    divergence-free prefix covers most of each generation."""
+    e8 = serve.ServeEngine(net, max_slots=3, max_len=64, max_queue=32,
+                           kv_dtype="int8")
+    efp = serve.ServeEngine(net, max_slots=3, max_len=64, max_queue=32)
+    try:
+        prompts, budgets = _mixed_requests(9, seed=1)
+        match, total = 0, 0
+        for p, b in zip(prompts, budgets):
+            out = e8.generate(p, b)[p.size:]
+            ref = ref_dec.generate(p[None, :], b).asnumpy()[0][p.size:]
+            assert out[0] == ref[0]        # single-chunk first token exact
+            k = 0
+            for x, y in zip(out, ref):
+                if x != y:
+                    break
+                k += 1
+            match += k
+            total += len(ref)
+        assert match / total >= 0.5, f"int8 drift too large: {match}/{total}"
+        # the headline economics: ~4x fewer KV bytes resident per slot
+        efp.generate(prompts[0], 2)        # materialize the fp pool
+        assert e8.kv_bytes_per_slot < 0.3 * efp.kv_bytes_per_slot
+    finally:
+        e8.shutdown(drain=False)
+        efp.shutdown(drain=False)
 
 
 def test_out_of_order_completion(eng, ref_dec):
@@ -256,8 +502,8 @@ def test_out_of_order_completion(eng, ref_dec):
 
 
 def test_slot_reuse_after_eos_retirement(eng, ref_dec):
-    """EOS retires a slot mid-flight; the freed slot serves the next
-    queued request, and its stale cache rows never leak into it."""
+    """EOS retires a slot mid-flight; the freed slot (and its pages)
+    serve the next queued request, and stale cache rows never leak."""
     prompts, _ = _mixed_requests(6, seed=4)
     budget = 10
     # pick a real EOS: the token the reference generates 3rd for the
@@ -314,13 +560,18 @@ def test_serve_telemetry_series(eng):
     assert rep["mx_serve_evictions_total"]["value"] > 0
     assert "mx_serve_queue_depth" in rep
     assert "mx_serve_slot_occupancy" in rep
+    # ISSUE 6 series: paged allocation + chunked prefill accounting
+    assert "mx_serve_page_occupancy" in rep
+    assert rep["mx_serve_prefill_chunks_total"]["value"] > 0
+    assert "mx_serve_prefix_hits_total" in rep
     # bucketed prefill accounts its padding waste
     assert rep["mx_decode_bucket_pad_tokens_total"]["value"] > 0
 
 
 def test_engine_drain_finishes_running_rejects_new(net, ref_dec):
-    """shutdown(drain=True): requests in slots finish completely, the
-    never-admitted queue and new submits are rejected loudly."""
+    """shutdown(drain=True): requests in slots finish completely (also
+    mid-prefill ones), the never-admitted queue and new submits are
+    rejected loudly."""
     e = serve.ServeEngine(net, max_slots=2, max_len=64, max_queue=8)
     prompts, _ = _mixed_requests(3, seed=7)
     h1 = e.submit(prompts[0], 8)
@@ -361,3 +612,26 @@ def test_bench_gpt_serve_contract():
     assert tok_s > 0
     assert p99 >= p50 > 0
     assert 0 < occ <= 1
+
+
+@pytest.mark.slow
+def test_bench_gpt_serve_prefix_contract():
+    """Reduced shared-prefix bench: reuse beats the cold path and the
+    hit-rate/occupancy extras come back sane (the committed extras run
+    the full workload)."""
+    import os
+    import sys
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    try:
+        import bench
+    finally:
+        sys.path.pop(0)
+
+    res = bench.bench_gpt_serve_prefix(requests=8, max_slots=2,
+                                       prefix_len=96, tail_max=8,
+                                       new_max=6)
+    assert res["reuse_tokens_s"] > 0 and res["base_tokens_s"] > 0
+    assert res["hit_rate"] > 0
+    assert res["kv_bytes_per_slot"] > 0

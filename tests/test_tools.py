@@ -233,6 +233,57 @@ def test_fl008_tree_is_clean():
 
 
 # ---------------------------------------------------------------------------
+# FL009 — paged-serving hazards (ISSUE 6: page-table gather discipline)
+# ---------------------------------------------------------------------------
+
+def test_fl009_flags_host_iteration_over_pool():
+    src = ("def drain(self):\n"
+           "    for page in self._pool_k:\n"
+           "        self.copy_out(page)\n")
+    hits = [f for f in _lint(src, _SERVE_PATH) if f.rule == "FL009"]
+    assert len(hits) == 1 and "gather" in hits[0].message
+    # host page LISTS iterate freely (allocator bookkeeping)
+    clean = ("def free(self, pages):\n"
+             "    for p in pages:\n"
+             "        self.refs[p] -= 1\n")
+    assert not [f for f in _lint(clean, _SERVE_PATH) if f.rule == "FL009"]
+
+
+def test_fl009_flags_dynamic_shape_take_and_scatter():
+    take = ("import jax.numpy as jnp\n"
+            "def view(pool, pages):\n"
+            "    return jnp.take(pool, [int(p) for p in pages], axis=0)\n")
+    hits = [f for f in _lint(take, _SERVE_PATH) if f.rule == "FL009"]
+    assert len(hits) == 1 and "static-shape" in hits[0].message
+    scatter = ("def write(pool, pages, vals):\n"
+               "    return pool.at[list(pages)].set(vals)\n")
+    hits = [f for f in _lint(scatter, _SERVE_PATH) if f.rule == "FL009"]
+    assert len(hits) == 1
+    # static-shape arrays (the page table) pass; constant literals pass
+    clean = ("import jax.numpy as jnp\n"
+             "def view(pool, table, vals):\n"
+             "    v = jnp.take(pool, table, axis=0)\n"
+             "    return pool.at[table].set(vals), v\n")
+    assert not [f for f in _lint(clean, _SERVE_PATH) if f.rule == "FL009"]
+    # scoped to serve/: the same code elsewhere is not the rule's business
+    assert not [f for f in _lint(take, "incubator_mxnet_tpu/ops/take.py")
+                if f.rule == "FL009"]
+
+
+def test_fl009_tree_is_clean():
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import framework_lint
+    finally:
+        sys.path.pop(0)
+    findings = [f for f in framework_lint.lint_paths(
+        [os.path.join(REPO, "incubator_mxnet_tpu"),
+         os.path.join(REPO, "tools"),
+         os.path.join(REPO, "bench.py")]) if f.rule == "FL009"]
+    assert not findings, findings
+
+
+# ---------------------------------------------------------------------------
 # run-metadata stamping (VERDICT Weak #5: stale-rerun detectability)
 # ---------------------------------------------------------------------------
 

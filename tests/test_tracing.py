@@ -194,10 +194,32 @@ def test_off_path_records_nothing_and_is_cheap():
 # ---------------------------------------------------------------------------
 
 class _StubSlots:
-    max_slots, max_len = 2, 64
+    """Paged-interface stub: pure host arithmetic over a REAL page
+    allocator + prefix cache (host-only classes); the final prefill
+    chunk emits the prompt's length as the first token, decode
+    increments."""
 
-    def prefill(self, slot, prompt_ids, key, temperature=1.0):
-        return int(len(prompt_ids))
+    max_slots, max_len = 2, 64
+    page_tokens, prefill_chunk = 16, 64
+
+    def __init__(self):
+        from incubator_mxnet_tpu import serve
+
+        pages_per_slot = -(-self.max_len // self.page_tokens)
+        self.allocator = serve.PageAllocator(
+            self.max_slots * pages_per_slot + 1, self.page_tokens)
+        self.prefix_cache = serve.PrefixCache(self.allocator)
+
+    def set_slot_pages(self, slot, pages):
+        pass
+
+    def clear_slot(self, slot):
+        pass
+
+    def prefill_chunk_step(self, slot, chunk_tokens, t_start, key,
+                           temperature=1.0):
+        n = len(chunk_tokens)
+        return int(t_start) + n, n, 0
 
     def decode_step(self, last, pos, active, key, temps):
         return onp.where(active, last + 1, last).astype(onp.int32)
@@ -301,8 +323,10 @@ def test_real_engine_traced_requests_and_recompile_gate(net):
                              "serve.queue", "serve.request"], names
             prefill = [s for s in tracing.finished_spans(h.trace_id)
                        if s.name == "serve.prefill"][0]
-            # engine annotated the bucket program that served the prompt
-            assert prefill.attrs["bucket"] in (32, 64)
+            # the chunk-bucket program that served the prompt's last
+            # chunk, annotated by the scheduler
+            assert prefill.attrs["bucket"] in (16, 32, 64)
+            assert prefill.attrs["chunks"] >= 1
         # zero steady-state recompiles WITH tracing enabled
         assert eng.xla_program_count() == warm_count
     finally:

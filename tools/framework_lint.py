@@ -47,6 +47,17 @@ FL007  serving-loop TPU hazards (scoped to ``serve/`` modules): (a) a
        device value blocks the step loop on a host sync (and invites
        shape-dependent recompiles). Keep slot state host-side and fetch
        device results once per step (`serve/scheduler.py` idiom).
+FL009  paged-serving hazards (scoped to ``serve/`` modules): (a) a
+       ``for`` loop iterating a device KV *pool* value (identifier
+       containing "pool") — host-side iteration over per-page device
+       values syncs once per page and defeats the single
+       gather-by-page-table design; (b) a ``jnp.take``/``.take`` call or
+       an ``.at[...]`` scatter whose index operand is built host-side
+       with a dynamic shape (list/tuple literal of non-constants, list
+       comprehension, ``list(...)``/``range(...)`` call) — every
+       distinct index shape compiles a fresh program, breaking the
+       zero-steady-state-recompile invariant. Pass indices as
+       static-shape arrays (the page table) instead.
 FL008  span-tracing hygiene (`telemetry/tracing.py`): (a) a
        ``start_span(...)`` call used anywhere but directly as a ``with``
        item — a bare start_span leaks an open span into the ambient
@@ -92,6 +103,10 @@ RULES = {
     "FL008": "span hygiene: start_span() must be a `with` item (use "
              "open_span() for explicit lifecycle), and no span creation "
              "inside ops/ kernel-reachable bodies (jit-traced code)",
+    "FL009": "serve/ paged-KV hazard: host iteration over a device pool "
+             "value, or jnp.take/.at[] scatter with host-built "
+             "dynamic-shape indices (recompile per index shape) — use "
+             "static-shape page-table arrays",
 }
 
 _INDEXING_NAME_PARTS = ("getitem", "setitem", "index", "slice")
@@ -387,6 +402,89 @@ def _check_serve_hazards(tree, path, findings):
 
 
 # ---------------------------------------------------------------------------
+# FL009 — paged-serving hazards (serve/ modules only)
+# ---------------------------------------------------------------------------
+
+def _mentions_pool(node):
+    """True when `node` (or a sub-expression) names a device pool —
+    identifiers containing 'pool' are reserved for device-resident KV
+    pool arrays in serve/ (host page lists are 'pages'/'free'/'table')."""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Name) and "pool" in sub.id.lower():
+            return True
+        if isinstance(sub, ast.Attribute) and "pool" in sub.attr.lower():
+            return True
+    return False
+
+
+def _dynamic_shape_index(node):
+    """True for index operands whose SHAPE is host-built and call-varying:
+    list/tuple literals with non-constant elements, comprehensions, and
+    list()/range() calls. Constant literals (e.g. `[0, 1]`) are static."""
+    if isinstance(node, (ast.ListComp, ast.GeneratorExp)):
+        return True
+    if isinstance(node, (ast.List, ast.Tuple)):
+        return any(not isinstance(e, ast.Constant) for e in node.elts)
+    if isinstance(node, ast.Call) and isinstance(node.func, ast.Name) \
+            and node.func.id in ("list", "range"):
+        return True
+    return False
+
+
+def _take_index_arg(call):
+    """The indices operand of a `*.take(...)` call, or None."""
+    if len(call.args) >= 2:
+        return call.args[1]
+    for kw in call.keywords:
+        if kw.arg == "indices":
+            return kw.value
+    return None
+
+
+def _check_paged_hazards(tree, path, findings):
+    norm = path.replace(os.sep, "/")
+    if "/serve/" not in norm:
+        return
+    for node in ast.walk(tree):
+        # (a) host-side iteration over a device pool value: one implicit
+        # device->host sync per page instead of one gather per step
+        if isinstance(node, (ast.For, ast.AsyncFor)) \
+                and _mentions_pool(node.iter):
+            findings.append(LintFinding(
+                path, node.lineno, "FL009",
+                f"`for` over `{ast.unparse(node.iter)}`: host iteration "
+                "over per-page device values syncs per page — gather the "
+                "slot view with one static-shape jnp.take over the page "
+                "table instead"))
+        # (b) take/scatter with host-built dynamic-shape indices: every
+        # distinct length compiles a fresh program
+        if isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Attribute) \
+                and node.func.attr == "take":
+            idx = _take_index_arg(node)
+            if idx is not None and _dynamic_shape_index(idx):
+                findings.append(LintFinding(
+                    path, node.lineno, "FL009",
+                    f"`take` with host-built indices "
+                    f"`{ast.unparse(idx)}`: the index SHAPE varies per "
+                    "call, recompiling the program — pass a static-shape "
+                    "index array (the page table)"))
+        if isinstance(node, ast.Subscript) \
+                and isinstance(node.value, ast.Attribute) \
+                and node.value.attr == "at":
+            sl = node.slice
+            parts = sl.elts if isinstance(sl, ast.Tuple) else [sl]
+            for part in parts:
+                if _dynamic_shape_index(part):
+                    findings.append(LintFinding(
+                        path, part.lineno, "FL009",
+                        f"`.at[...]` scatter with host-built index "
+                        f"`{ast.unparse(part)}`: dynamic index shapes "
+                        "recompile per call — scatter through a "
+                        "static-shape page array"))
+
+
+# ---------------------------------------------------------------------------
 # FL008 — span-tracing hygiene
 # ---------------------------------------------------------------------------
 
@@ -533,6 +631,7 @@ def lint_source(src, path, coverage_text=None):
     _check_adhoc_timing(tree, path, findings)
     _check_silent_swallow(tree, path, findings, src.splitlines())
     _check_serve_hazards(tree, path, findings)
+    _check_paged_hazards(tree, path, findings)
     _check_span_hygiene(tree, path, findings)
     _check_ops_ledger(tree, path, findings, coverage_text)
     return findings

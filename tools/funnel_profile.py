@@ -130,11 +130,16 @@ def write_breakdown(res, path):
         "Weak #3 ratio, this backend)",
         f"- stage-trace observer cost: {on - off:+.2f} µs/op "
         f"({(on / off - 1) * 100:+.1f}%) — paid only when "
-        "MXNET_TELEMETRY=1",
-        "- telemetry OFF funnel cost: the probes are "
-        "`_STAGE_HOOK is None` checks (6 per op, no allocation, no "
-        "call) — see `tests/test_telemetry.py::"
-        "test_stage_trace_off_path_no_alloc_and_cheap` which pins the "
+        "MXNET_TELEMETRY=1 (arming the stage hook also routes ops off "
+        "the fast path below, so this delta includes the general-path "
+        "prologue/key/wrap stages, not just the clock reads)",
+        "- telemetry OFF funnel cost: with every optional subsystem "
+        "inactive, cacheable all-tensor calls take the `apply_op_flat` "
+        "fast path (ISSUE 6 / ROADMAP speed gap (a)) — precomputed "
+        "cache key, direct jitted dispatch, slot-wise NDArray wrap; the "
+        "remaining probes are `is None` checks. See "
+        "`tests/test_telemetry.py::"
+        "test_stage_trace_off_path_no_alloc_and_cheap`, which pins the "
         "off path to zero stages-module allocations and <3% overhead.",
     ]
     with open(path, "w") as f:
