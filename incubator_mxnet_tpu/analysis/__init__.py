@@ -11,10 +11,19 @@ The companion *framework lint* (`tools/framework_lint.py`) statically
 checks the framework source itself for invariants learned from real bugs;
 it is pure-AST and lives in tools/ so it can run without importing jax.
 
-Env knob: ``MXNET_ANALYSIS=warn|raise`` (see `util.env_knobs()`).
+The mesh-level companion is `mx.analysis.shardcheck` — a static
+sharding/partition-spec pre-flight (rules SC001-SC006) that validates a
+program's PartitionSpec layout against a simulated mesh before any pod
+job launches (see analysis/shardcheck.py and ANALYSIS.md).
+
+Env knobs: ``MXNET_ANALYSIS=warn|raise``, ``MXNET_SHARDCHECK=warn|raise``,
+``MXNET_SHARDCHECK_HBM_GB`` (see `util.env_knobs()`).
 """
 from .auditor import audit, jit_cache_report  # noqa: F401
-from .findings import HAZARD_KINDS, AuditReport, Finding  # noqa: F401
+from .findings import (HAZARD_KINDS, SHARD_RULES, AuditReport,  # noqa: F401
+                       Finding, ShardFinding, ShardReport)
+from .shardcheck import shardcheck  # noqa: F401
 
 __all__ = ["audit", "jit_cache_report", "AuditReport", "Finding",
-           "HAZARD_KINDS"]
+           "HAZARD_KINDS", "shardcheck", "ShardReport", "ShardFinding",
+           "SHARD_RULES"]

@@ -16,7 +16,8 @@ Severity contract:
 """
 from __future__ import annotations
 
-__all__ = ["Finding", "AuditReport", "HAZARD_KINDS"]
+__all__ = ["Finding", "AuditReport", "HAZARD_KINDS",
+           "ShardFinding", "ShardReport", "SHARD_RULES"]
 
 # The hazard classes the auditor knows about (ANALYSIS.md documents each).
 HAZARD_KINDS = (
@@ -103,6 +104,95 @@ class AuditReport:
         lines = [head]
         for f in self._all:
             lines.append(f"  {f!r}")
+        return "\n".join(lines)
+
+    __repr__ = summary
+
+
+# ---------------------------------------------------------------------------
+# Mesh-level findings (shardcheck — see analysis/shardcheck.py)
+# ---------------------------------------------------------------------------
+
+# Rule catalogue for the sharding pre-flight pass. ANALYSIS.md documents
+# each with the seeded-defect fixture that demonstrates it.
+SHARD_RULES = {
+    "SC001": "unconstrained param: silently fully replicated on every device",
+    "SC002": "shard-divisibility violation: dim % mesh-axis size != 0",
+    "SC003": "spec names a mesh axis that does not exist",
+    "SC004": "donation lost under sharding: donated arg's spec differs from "
+             "the output it should alias (silent copy per step)",
+    "SC005": "implicit cross-shard transfer: collective re-materializes a "
+             "full sharded operand inside the step",
+    "SC006": "per-device HBM estimate exceeds the budget",
+}
+
+
+class ShardFinding(Finding):
+    """One sharding hazard: a Finding whose ``kind`` is an SC rule id,
+    carrying the byte weight that ranks it in the report table."""
+
+    __slots__ = ("nbytes",)
+
+    def __init__(self, rule, message, severity="warn", site=None, nbytes=0):
+        super().__init__(rule, message, severity=severity, site=site)
+        self.nbytes = int(nbytes)
+
+    @property
+    def rule(self):
+        return self.kind
+
+
+class ShardReport(AuditReport):
+    """Findings from one `shardcheck()` call, plus the mesh-level numbers
+    the CLI table prints: per-device byte estimate, collective census, and
+    the budget the estimate was judged against."""
+
+    def __init__(self, target_name, mesh_axes=None):
+        super().__init__(target_name)
+        self.mesh_axes = dict(mesh_axes or {})   # axis name -> size
+        self.per_device_bytes = 0      # static HBM estimate per device
+        self.donated_bytes = 0         # bytes returned to XLA via aliasing
+        self.budget_bytes = None       # MXNET_SHARDCHECK_HBM_GB (resolved)
+        self.collectives = {}          # hlo op -> {"count": n, "bytes": b}
+        self.n_leaves = 0
+        self.tiers = []                # which analysis tiers actually ran
+
+    def add_rule(self, rule, message, severity="warn", site=None, nbytes=0):
+        assert rule in SHARD_RULES, rule
+        self.add(ShardFinding(rule, message, severity=severity,
+                              site=site, nbytes=nbytes))
+
+    def by_rule(self, rule):
+        return self.by_kind(rule)
+
+    def stamp(self):
+        """One-line machine-greppable summary — the multichip dryrun
+        prints this into its metadata tail, and `tools/shardcheck.py
+        --dryrun` emits the same line."""
+        rules = ",".join(sorted({f.kind for f in self.findings})) or "none"
+        cols = ",".join(f"{op}:{rec['count']}"
+                        for op, rec in sorted(self.collectives.items())) \
+            or "none"
+        return (f"shardcheck[{self.target_name}] "
+                f"findings={len(self.findings)} rules={rules} "
+                f"per_device_mb={self.per_device_bytes / 2**20:.1f} "
+                f"collectives={cols}")
+
+    def summary(self):
+        mesh = "x".join(f"{a}={s}" for a, s in self.mesh_axes.items()) or "-"
+        head = (f"shardcheck({self.target_name}): {len(self.findings)} "
+                f"finding(s) | mesh {mesh} | "
+                f"per-device ~{self.per_device_bytes / 2**20:.1f} MiB"
+                + (f" (budget {self.budget_bytes / 2**30:.2f} GiB)"
+                   if self.budget_bytes else ""))
+        lines = [head]
+        for f in sorted(self._all, key=lambda f: -getattr(f, "nbytes", 0)):
+            lines.append(f"  {f!r}")
+        if self.collectives:
+            lines.append("  collectives per step:")
+            for op, rec in sorted(self.collectives.items()):
+                lines.append(f"    {op:<20} x{rec['count']:<3} "
+                             f"~{rec['bytes'] / 2**20:.2f} MiB moved")
         return "\n".join(lines)
 
     __repr__ = summary

@@ -22,22 +22,42 @@ def Mesh(devices, axis_names):
     return jax.sharding.Mesh(devices, axis_names)
 
 
-def make_mesh(axis_shapes: dict, devices=None):
-    """Build a mesh from {'axis': size}; e.g. {'dp': 2, 'tp': 4}.
+def make_mesh(axis_shapes, devices=None):
+    """Build a mesh from {'axis': size} (or ordered (axis, size) pairs);
+    e.g. {'dp': 2, 'tp': 4}.
 
     Uses all available devices by default. Sizes must multiply to the device
-    count (a -1 wildcard axis is allowed)."""
+    count (one -1 wildcard axis is allowed and must divide evenly)."""
     import numpy as onp
 
     import jax
 
     devices = devices if devices is not None else jax.devices()
-    names = list(axis_shapes)
+    if not isinstance(axis_shapes, dict):
+        axis_shapes = [(a, s) for a, s in axis_shapes]
+        names = [a for a, _ in axis_shapes]
+        axis_shapes = dict(axis_shapes)
+    else:
+        names = list(axis_shapes)
+    if len(set(names)) != len(names):
+        dupes = sorted({a for a in names if names.count(a) > 1})
+        raise ValueError(f"mesh axis names must be unique, got duplicate "
+                         f"{dupes} in {names}")
     sizes = list(axis_shapes.values())
     n = len(devices)
+    if sizes.count(-1) > 1:
+        raise ValueError(f"at most one -1 wildcard axis allowed, got "
+                         f"{dict(zip(names, sizes))}")
     if -1 in sizes:
+        wild = sizes.index(-1)
         known = int(onp.prod([s for s in sizes if s != -1]))
-        sizes[sizes.index(-1)] = n // known
+        if known <= 0 or n % known:
+            raise ValueError(
+                f"cannot infer wildcard axis {names[wild]!r}: {n} devices "
+                f"not divisible by the known axes "
+                f"{ {a: s for a, s in zip(names, sizes) if s != -1} } "
+                f"(product {known})")
+        sizes[wild] = n // known
     total = int(onp.prod(sizes))
     if total != n:
         raise ValueError(f"mesh {dict(zip(names, sizes))} needs {total} devices, "

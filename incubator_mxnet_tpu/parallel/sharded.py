@@ -10,6 +10,7 @@ ICI and overlaps them with compute — subsuming the reference's P3
 priority-overlap scheme (`src/kvstore/p3store_dist.h`)."""
 from __future__ import annotations
 
+from .. import util
 from ..ndarray.ndarray import NDArray
 
 __all__ = ["DataParallel", "shard_train_step"]
@@ -193,6 +194,12 @@ class DataParallel:
                  param_shardings=None, remat=None):
         import jax
 
+        from .mesh import current_mesh
+
+        if mesh is None:
+            # honor an ambient `with mesh_scope(...)` — callers installing
+            # a mesh for sharding_constraint expect the trainer to see it
+            mesh = current_mesh()
         self.net = net
         self.optimizer = optimizer
         self.mesh = mesh
@@ -274,6 +281,77 @@ class DataParallel:
         self._wd_dev = (None, None)
         self._base_key = None
         self._key_epoch = None
+        # kept for the sharding pre-flight (shardcheck_report)
+        self._step_fn = step
+        self._data_axis = data_axis
+        self._param_specs = (list(param_shardings)
+                             if param_shardings is not None else None)
+        mode = (util.getenv("MXNET_SHARDCHECK") or "").strip().lower()
+        if mode in ("warn", "raise") and mesh is not None:
+            # pre-flight the declared layout before the first step can
+            # commit it to chips; batch shapes are unknown here, so this
+            # is the spec tier only (call shardcheck_report(x, y) for the
+            # full simulated-mesh pass)
+            self.shardcheck_report(mode=mode)
+
+    def shardcheck_report(self, x=None, y=None, hbm_budget_gb=None,
+                          mode=None, compile=True):
+        """Static sharding pre-flight over this trainer's step program
+        (`mx.analysis.shardcheck`). With a sample batch ``(x, y)`` the
+        step is abstract-traced and — given a real mesh — compiled under
+        the declared shardings for the collective-cost audit; without one
+        only the param/optimizer-state layout is checked."""
+        import contextlib
+
+        import jax
+
+        from ..analysis.shardcheck import shardcheck
+        from .mesh import mesh_scope
+
+        P = jax.sharding.PartitionSpec
+        param_vals = [a._data for a in self.param_arrays]
+        frozen_vals = [a._data for a in self.frozen_arrays]
+        p_specs = (self._param_specs if self._param_specs is not None
+                   else [None] * len(param_vals))
+        # state leaves shaped like their param shard like the param;
+        # everything else (scalars, counters) is unconstrained
+        s_specs = [
+            jax.tree.map(
+                lambda leaf, _sp=sp, _shape=tuple(a.shape):
+                    (_sp if tuple(getattr(leaf, "shape", ())) == _shape
+                     else None), s)
+            for s, sp, a in zip(self.opt_states, p_specs, self.param_arrays)
+        ]
+        mesh_kw = dict(mesh=self.mesh, hbm_budget_gb=hbm_budget_gb,
+                       mode=mode, compile=compile,
+                       name="DataParallel.step")
+        if x is None or y is None:
+            return shardcheck(None, param_vals, frozen_vals,
+                              self.opt_states,
+                              specs=(p_specs, None, s_specs), **mesh_kw)
+
+        from ..random import next_key
+
+        xv = x._data if isinstance(x, NDArray) else x
+        yv = y._data if isinstance(y, NDArray) else y
+        batch_spec = P(self._data_axis) if self.mesh is not None else None
+        scalar = jax.ShapeDtypeStruct((), "int32")
+        fscalar = jax.ShapeDtypeStruct((), "float32")
+        step = self._step_fn
+
+        def fn(*args):
+            with (mesh_scope(self.mesh) if self.mesh is not None
+                  else contextlib.nullcontext()):
+                return step(*args)
+
+        fn.__name__ = "DataParallel.step"
+        return shardcheck(
+            fn, param_vals, frozen_vals, self.opt_states, scalar, fscalar,
+            fscalar, next_key(), xv, yv,
+            specs=(p_specs, None, s_specs, None, None, None, P(),
+                   batch_spec, batch_spec),
+            out_specs=(None, p_specs, s_specs, None, None),
+            donate_argnums=(0, 2, 3), **mesh_kw)
 
     def _dev_scalar(self, value, cache_name, dtype):
         """Upload a python scalar only when it CHANGED since the last step —

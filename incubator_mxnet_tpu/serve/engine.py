@@ -876,6 +876,64 @@ class SlotDecoder:
                 n += int(size())
         return n
 
+    def shardcheck_report(self, mesh=None, hbm_budget_gb=None,
+                          bucket=None):
+        """Static sharding pre-flight (`mx.analysis.shardcheck`) over the
+        engine's two compiled program families: the chunked-prefill jit
+        (analyzed at `bucket`, default the largest chunk bucket) and the
+        decode jit, which is audited as a latency hot path.
+
+        The engine runs single-chip today, so with the default
+        ``mesh=None`` this is a per-device byte budget (SC006) plus the
+        donation audit (SC004); pass a mesh once pod-scale serving lands
+        and the same call re-validates the layout against it. Returns
+        ``{"prefill": ShardReport, "decode": ShardReport}``.
+        """
+        import functools
+
+        from ..analysis.shardcheck import shardcheck
+        from ..random import next_key
+
+        jax = _j()
+        sds = jax.ShapeDtypeStruct
+        self._dec._auto_refresh()
+        self._ensure_pool()
+        if self._prefill_jit is None:
+            self._prefill_jit = self._build_prefill()
+        if self._decode_jit is None:
+            self._decode_jit = self._build_decode()
+        params = self._dec._params
+        pools = (self._pk, self._pv) + ((self._sk, self._sv)
+                                        if self._int8 else ())
+        donate = (1, 2, 3, 4) if self._int8 else (1, 2)
+        S = self.max_slots
+        key = next_key()
+        i32, f32 = _j().numpy.int32, _j().numpy.float32
+        statics = {"top_k": self._top_k, "do_sample": self._do_sample}
+
+        bucket = int(bucket) if bucket is not None else self.chunk_buckets[-1]
+        prefill_args = (params,) + pools + (
+            sds((1, bucket), i32),                      # tokens
+            sds((self.pages_per_slot,), i32),           # pages_row
+            sds((bucket // self.page_tokens,), i32),    # chunk_pages
+            sds((), i32), sds((), i32),                 # t_start, t_len
+            key, sds((), f32))                          # key, temperature
+        prefill = shardcheck(
+            functools.partial(self._prefill_jit, **statics), *prefill_args,
+            mesh=mesh, donate_argnums=donate, hbm_budget_gb=hbm_budget_gb,
+            name=f"SlotDecoder.prefill[b{bucket}]")
+
+        decode_args = (params,) + pools + (
+            sds((S, self.pages_per_slot), i32),         # page table
+            sds((S,), i32), sds((S,), i32),             # last_tok, pos
+            sds((S,), bool),                            # active
+            key, sds((S,), f32))                        # key, temperature
+        decode = shardcheck(
+            functools.partial(self._decode_jit, **statics), *decode_args,
+            mesh=mesh, donate_argnums=donate, hbm_budget_gb=hbm_budget_gb,
+            hot_path=True, name="SlotDecoder.decode")
+        return {"prefill": prefill, "decode": decode}
+
 
 def _occupancy_probe(allocator):
     """Weakly-bound pull probe for the page-occupancy gauge (engines come

@@ -177,6 +177,15 @@ _ENV_KNOBS = {
         "analysis.audit", "warn|raise: program-auditor findings are logged "
         "as warnings or raised as MXNetError; unset returns reports "
         "silently (honored, this build's addition — see ANALYSIS.md)"),
+    "MXNET_SHARDCHECK": (
+        "analysis.shardcheck / parallel.sharded.DataParallel",
+        "warn|raise: trainers run the static sharding pre-flight (rules "
+        "SC001-SC006) at construction and log or raise on findings; "
+        "unset = off (honored, this build's addition — see ANALYSIS.md)"),
+    "MXNET_SHARDCHECK_HBM_GB": (
+        "analysis.shardcheck", "per-device HBM budget in GiB for the "
+        "SC006 static OOM check; unset/0 disables the budget comparison "
+        "(honored, this build's addition)"),
     "MXNET_LOCAL_RANK": (
         "kvstore horovod facade / tools/launch.py", "rank within host "
         "(honored, exported by the launcher)"),

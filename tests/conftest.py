@@ -95,6 +95,16 @@ _QUICK = {
     "test_tracing.py::test_serve_request_trace_stub",
     "test_tracing.py::test_slo_latency_burn_math",
     "test_tools.py::test_fl008_tree_is_clean",
+    # shardcheck (ISSUE 8 gates): spec-tier rule fixtures are pure host
+    # math over avals (no trace, no compile) and the static meta-gate
+    # runs framework lint + AST/eval_shape shardcheck over the tree
+    "test_shardcheck.py::test_sc001_unconstrained_param_flagged",
+    "test_shardcheck.py::test_sc002_divisibility_violation_flagged",
+    "test_shardcheck.py::test_sc003_unknown_axis_flagged",
+    "test_shardcheck.py::test_sc006_budget_exceeded_flagged",
+    "test_shardcheck.py::test_rule_catalogue_complete",
+    "test_shardcheck.py::test_static_gates_meta",
+    "test_tools.py::test_fl010_tree_is_clean",
 }
 
 

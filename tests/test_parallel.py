@@ -27,6 +27,53 @@ def test_make_mesh():
     assert mesh.shape["tp"] == 4
     mesh2 = make_mesh({"dp": -1})
     assert mesh2.shape["dp"] == 8
+    # (axis, size) pairs are accepted too
+    mesh3 = make_mesh([("a", 2), ("b", -1)])
+    assert mesh3.shape["a"] == 2 and mesh3.shape["b"] == 4
+
+
+def test_make_mesh_wildcard_divisibility_error():
+    """8 devices with a known axis of 3: the wildcard cannot divide
+    evenly — the error must name the wildcard axis and the divisor, not
+    the misleading truncated 'needs N devices' message (ISSUE 8)."""
+    _need_8()
+    with pytest.raises(ValueError, match="wildcard axis 'dp'.*divisible"):
+        make_mesh({"dp": -1, "tp": 3})
+    with pytest.raises(ValueError, match="at most one -1"):
+        make_mesh({"dp": -1, "tp": -1})
+
+
+def test_make_mesh_duplicate_axis_error():
+    _need_8()
+    with pytest.raises(ValueError, match="unique.*dp"):
+        make_mesh([("dp", 2), ("dp", 4)])
+
+
+def test_data_parallel_picks_up_ambient_mesh_scope():
+    """DataParallel(mesh=None) under `with mesh_scope(m)` must train on
+    m, not silently single-chip (ISSUE 8 satellite)."""
+    _need_8()
+    from incubator_mxnet_tpu import gluon
+    from incubator_mxnet_tpu.parallel import DataParallel
+
+    mesh = make_mesh({"dp": 8})
+    net = gluon.nn.Dense(1, in_units=4)
+    net.initialize()
+    o = mx.optimizer.SGD(learning_rate=0.5)
+    with mesh_scope(mesh):
+        dp = DataParallel(net, gluon.loss.L2Loss(), o)
+    assert dp.mesh is mesh
+    # the batch sharding the jit was built with spans the ambient mesh
+    assert dp._batch_sharding is not None
+    assert dp._batch_sharding.mesh is mesh
+    X = onp.zeros((8, 4), "float32")
+    loss = dp.step(np.array(X), np.array(X[:, :1]))
+    assert onp.isfinite(float(loss.item()))
+    # outside any scope, mesh=None still means single-chip
+    net2 = gluon.nn.Dense(1, in_units=4)
+    net2.initialize()
+    dp2 = DataParallel(net2, gluon.loss.L2Loss(), mx.optimizer.SGD())
+    assert dp2.mesh is None
 
 
 def test_allreduce_shard_map():

@@ -1,0 +1,371 @@
+"""`mx.analysis.shardcheck` — the static sharding pre-flight (ISSUE 8).
+
+One seeded-defect fixture per rule SC001-SC006, each detected under the
+forced 8-device CPU platform (conftest.py), plus clean-pass gates on the
+real sharded programs: the DataParallel trainer step (the multichip-
+dryrun BERT configuration) and both serve decoder program families.
+The meta-test at the bottom is the CI gate: framework lint + the
+spec/eval_shape tiers of shardcheck over the tree must stay at zero
+findings.
+"""
+import os
+import sys
+
+import numpy as onp
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import np
+from incubator_mxnet_tpu.analysis import SHARD_RULES, shardcheck
+from incubator_mxnet_tpu.parallel import make_mesh
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _need_8():
+    import jax
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual devices")
+
+
+def _sds(shape, dtype="float32"):
+    import jax
+
+    return jax.ShapeDtypeStruct(shape, onp.dtype(dtype))
+
+
+def _rules(report):
+    return sorted({f.kind for f in report})
+
+
+# ---------------------------------------------------------------------------
+# seeded-defect fixtures, one per rule
+# ---------------------------------------------------------------------------
+
+def test_sc001_unconstrained_param_flagged():
+    # 2 MiB param with no spec on an 8-way mesh: silently replicated
+    r = shardcheck(None, _sds((1024, 512)), mesh={"dp": 8}, specs=(None,))
+    assert _rules(r) == ["SC001"], r.summary()
+    f = r.by_rule("SC001")[0]
+    assert f.nbytes == 1024 * 512 * 4
+    assert "replicated" in f.message
+    # per-device cost is the FULL size — nothing was sharded
+    assert r.per_device_bytes == 1024 * 512 * 4
+    # explicit P() is deliberate replication, small arrays are noise:
+    # neither fires
+    import jax
+
+    P = jax.sharding.PartitionSpec
+    assert len(shardcheck(None, _sds((1024, 512)), mesh={"dp": 8},
+                          specs=(P(),))) == 0
+    assert len(shardcheck(None, _sds((8, 4)), mesh={"dp": 8},
+                          specs=(None,))) == 0
+
+
+def test_sc002_divisibility_violation_flagged():
+    import jax
+
+    P = jax.sharding.PartitionSpec
+    r = shardcheck(None, _sds((10, 4)), mesh={"dp": 8},
+                   specs=(P("dp", None),))
+    assert _rules(r) == ["SC002"], r.summary()
+    msg = r.by_rule("SC002")[0].message
+    assert "dim 0" in msg and "10" in msg and "dp" in msg
+    # rank overflow is the same rule
+    r = shardcheck(None, _sds((16,)), mesh={"dp": 8},
+                   specs=(P("dp", None),))
+    assert _rules(r) == ["SC002"], r.summary()
+
+
+def test_sc003_unknown_axis_flagged():
+    import jax
+
+    P = jax.sharding.PartitionSpec
+    r = shardcheck(None, _sds((16, 4)), mesh={"dp": 8},
+                   specs=(P("zz", None),))
+    assert _rules(r) == ["SC003"], r.summary()
+    assert "'zz'" in r.by_rule("SC003")[0].message
+    # severity error: the layout cannot be materialized at all
+    assert r.by_rule("SC003")[0].severity == "error"
+
+
+def test_sc004_donation_lost_flagged():
+    _need_8()
+    import jax
+
+    P = jax.sharding.PartitionSpec
+    mesh = make_mesh({"dp": 8})
+
+    def step(w):
+        return (w * 2.0,)
+
+    r = shardcheck(step, _sds((128, 64)), mesh=mesh,
+                   specs=(P("dp", None),), out_specs=(P(),),
+                   donate_argnums=(0,))
+    assert "SC004" in _rules(r), r.summary()
+    assert "alias" in r.by_rule("SC004")[0].message
+    # same specs both sides -> donation holds, no finding
+    r = shardcheck(step, _sds((128, 64)), mesh=mesh,
+                   specs=(P("dp", None),), out_specs=(P("dp", None),),
+                   donate_argnums=(0,))
+    assert "SC004" not in _rules(r), r.summary()
+    assert r.donated_bytes == 128 * 64 * 4
+
+
+def test_sc005_full_param_allgather_flagged():
+    _need_8()
+    import jax
+
+    P = jax.sharding.PartitionSpec
+    mesh = make_mesh({"dp": 8})
+
+    # sharded input, replicated output: GSPMD must all-gather the full
+    # operand every step — the compiled-HLO census catches it
+    r = shardcheck(lambda w: w * 1.0, _sds((128, 64)), mesh=mesh,
+                   specs=(P("dp", None),), out_specs=P())
+    assert "SC005" in _rules(r), r.summary()
+    assert "compile" in r.tiers
+    ag = r.collectives.get("all-gather")
+    assert ag and ag["count"] >= 1 and ag["bytes"] == 128 * 64 * 4
+    # sharded end-to-end: no collective, no finding
+    r = shardcheck(lambda w: w * 1.0, _sds((128, 64)), mesh=mesh,
+                   specs=(P("dp", None),), out_specs=P("dp", None))
+    assert len(r) == 0 and not r.collectives, r.summary()
+
+
+def test_sc005_jaxpr_tier_sees_explicit_collectives():
+    _need_8()
+    import jax
+    from jax.experimental.shard_map import shard_map
+
+    P = jax.sharding.PartitionSpec
+    mesh = make_mesh({"dp": 8})
+    fn = shard_map(lambda x: jax.lax.psum(x, "dp"), mesh=mesh,
+                   in_specs=P("dp"), out_specs=P())
+    # compile=False: the census must come from the jaxpr walk alone
+    r = shardcheck(fn, _sds((8, 4)), mesh=mesh, specs=(P("dp"),),
+                   out_specs=P(), compile=False)
+    assert "jaxpr" in r.tiers and "compile" not in r.tiers
+    assert r.collectives.get("all-reduce", {}).get("count") == 1, \
+        r.collectives
+
+
+def test_sc006_budget_exceeded_flagged():
+    import jax
+
+    P = jax.sharding.PartitionSpec
+    r = shardcheck(None, _sds((1024, 512)), mesh={"dp": 8},
+                   specs=(P("dp", None),), hbm_budget_gb=1e-6)
+    assert _rules(r) == ["SC006"], r.summary()
+    assert r.budget_bytes == int(1e-6 * 2**30)
+    # sharding is accounted: the per-device estimate is total/8
+    assert r.per_device_bytes == 1024 * 512 * 4 // 8
+    # same layout under a sane budget is clean
+    r = shardcheck(None, _sds((1024, 512)), mesh={"dp": 8},
+                   specs=(P("dp", None),), hbm_budget_gb=16.0)
+    assert len(r) == 0
+
+
+def test_sc006_env_knob_budget():
+    os.environ["MXNET_SHARDCHECK_HBM_GB"] = "0.0000001"
+    try:
+        r = shardcheck(None, _sds((1024, 512)), mesh={"dp": 8},
+                       specs=(None,))
+        assert "SC006" in _rules(r), r.summary()
+    finally:
+        del os.environ["MXNET_SHARDCHECK_HBM_GB"]
+
+
+def test_rule_catalogue_complete():
+    assert sorted(SHARD_RULES) == ["SC001", "SC002", "SC003", "SC004",
+                                   "SC005", "SC006"]
+    # telemetry: findings increment the per-rule counter
+    from incubator_mxnet_tpu.telemetry import registry
+
+    c = registry.counter("mx_shardcheck_findings_total",
+                         labels={"rule": "SC003"})
+    before = c.value
+    import jax
+
+    P = jax.sharding.PartitionSpec
+    shardcheck(None, _sds((16, 4)), mesh={"dp": 8}, specs=(P("nope"),))
+    assert c.value == before + 1
+
+
+# ---------------------------------------------------------------------------
+# clean-pass gates on the real sharded programs
+# ---------------------------------------------------------------------------
+
+def test_trainer_dryrun_config_passes_clean():
+    """The multichip-dryrun BERT (TP param shardings, dp-sharded batch)
+    must pre-flight clean through spec+eval_shape tiers — the same
+    report `__graft_entry__.dryrun_multichip` stamps into its tail."""
+    _need_8()
+    from incubator_mxnet_tpu import gluon, optimizer
+    from incubator_mxnet_tpu.models.bert import (bert_small,
+                                                 tp_param_shardings)
+    from incubator_mxnet_tpu.parallel.sharded import DataParallel
+
+    mesh = make_mesh({"dp": 2, "tp": 4})
+    net = bert_small(vocab_size=256, max_length=32, dropout=0.1,
+                     seq_shard_axis="tp")
+    net.initialize()
+    ce = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    def mlm_loss(out, y):
+        mlm_scores, _ = out
+        return ce(mlm_scores.reshape(-1, 256), y.reshape(-1))
+
+    dpar = DataParallel(net, mlm_loss, optimizer.Adam(learning_rate=1e-4),
+                        mesh=mesh, param_shardings=tp_param_shardings(net))
+    # construction-level (spec tier): params + optimizer states
+    rep = dpar.shardcheck_report()
+    assert len(rep) == 0, rep.summary()
+    # full abstract trace with a batch (compile=False keeps tier-1 fast;
+    # the compiled-tier collective census runs in tools/shardcheck.py)
+    rng = onp.random.RandomState(0)
+    tokens = np.array(rng.randint(0, 256, (4, 16)).astype("int32"))
+    labels = np.array(rng.randint(0, 256, (4, 16)).astype("int32"))
+    rep = dpar.shardcheck_report(tokens, labels, compile=False)
+    assert len(rep) == 0, rep.summary()
+    assert "eval_shape" in rep.tiers
+    assert rep.stamp().startswith("shardcheck[DataParallel.step]")
+
+
+def test_trainer_full_compile_tier_clean_and_audits_collectives():
+    """Small trainer through ALL tiers incl. the simulated-mesh compile:
+    clean, and the census shows the DP gradient all-reduce."""
+    _need_8()
+    from incubator_mxnet_tpu import gluon, optimizer
+    from incubator_mxnet_tpu.parallel.sharded import DataParallel
+
+    mesh = make_mesh({"dp": 8})
+    net = gluon.nn.Dense(1, in_units=4)
+    net.initialize()
+    dp = DataParallel(net, gluon.loss.L2Loss(),
+                      optimizer.SGD(learning_rate=0.5), mesh=mesh)
+    X = onp.zeros((64, 4), "float32")
+    Y = onp.zeros((64, 1), "float32")
+    rep = dp.shardcheck_report(np.array(X), np.array(Y))
+    assert len(rep) == 0, rep.summary()
+    assert "compile" in rep.tiers
+    assert rep.collectives.get("all-reduce", {}).get("count", 0) >= 1, \
+        rep.collectives
+
+
+def test_trainer_construction_knob_raises_on_seeded_defect():
+    """MXNET_SHARDCHECK=raise catches a divisibility defect at trainer
+    CONSTRUCTION — before jit would fail cryptically at the first step."""
+    _need_8()
+    import jax
+
+    from incubator_mxnet_tpu import gluon, optimizer
+    from incubator_mxnet_tpu.base import MXNetError
+    from incubator_mxnet_tpu.parallel.sharded import DataParallel
+
+    P = jax.sharding.PartitionSpec
+    mesh = make_mesh({"dp": 8})
+    net = gluon.nn.Dense(3, in_units=4)   # weight (3, 4): 3 % 8 != 0
+    net.initialize()
+    os.environ["MXNET_SHARDCHECK"] = "raise"
+    try:
+        with pytest.raises(MXNetError, match="SC002"):
+            DataParallel(net, gluon.loss.L2Loss(), optimizer.SGD(),
+                         mesh=mesh,
+                         param_shardings=[P("dp", None), P()])
+    finally:
+        del os.environ["MXNET_SHARDCHECK"]
+
+
+def test_serve_decoder_families_pass_clean_and_budget_accurate():
+    """Both serve program families pre-flight clean, and the SC006
+    per-device estimate for the decode program lands within 15% of the
+    measured live-buffer bytes (acceptance criterion)."""
+    import jax
+
+    from incubator_mxnet_tpu.models.gpt import gpt_tiny
+    from incubator_mxnet_tpu.serve.engine import SlotDecoder
+
+    mx.random.seed(0)
+    m = gpt_tiny(vocab_size=97, max_length=64, dropout=0.0)
+    m.initialize()
+    sd = SlotDecoder(m, max_slots=3, max_len=64)
+    reps = sd.shardcheck_report()
+    assert sorted(reps) == ["decode", "prefill"]
+    for fam, rep in reps.items():
+        assert len(rep) == 0, (fam, rep.summary())
+        assert "eval_shape" in rep.tiers, (fam, rep.tiers)
+        # the whole KV pool is donated back in both families
+        assert rep.donated_bytes >= sd.cache_bytes, (fam, rep.donated_bytes)
+    measured = (sum(v.nbytes for v in
+                    jax.tree_util.tree_leaves(sd._dec._params))
+                + sd.cache_bytes + sd._table_device().nbytes)
+    est = reps["decode"].per_device_bytes
+    assert abs(est - measured) / measured < 0.15, (est, measured)
+    # a budget below the estimate trips SC006 on the same programs
+    tiny = sd.shardcheck_report(hbm_budget_gb=measured / 2 / 2**30)
+    assert any(f.kind == "SC006" for f in tiny["decode"]), \
+        tiny["decode"].summary()
+
+
+def test_serve_int8_family_passes_clean():
+    from incubator_mxnet_tpu.models.gpt import gpt_tiny
+    from incubator_mxnet_tpu.serve.engine import SlotDecoder
+
+    mx.random.seed(0)
+    m = gpt_tiny(vocab_size=97, max_length=64, dropout=0.0)
+    m.initialize()
+    sd = SlotDecoder(m, max_slots=3, max_len=64, kv_dtype="int8")
+    for fam, rep in sd.shardcheck_report().items():
+        assert len(rep) == 0, (fam, rep.summary())
+
+
+# ---------------------------------------------------------------------------
+# CI meta-gate: both static passes stay at zero findings over the tree
+# ---------------------------------------------------------------------------
+
+def test_static_gates_meta():
+    """Framework lint (incl. FL010) over the tree + the spec/eval_shape
+    tier of shardcheck over the real entry points: all zero findings.
+    Every future PR inherits this gate."""
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import framework_lint
+    finally:
+        sys.path.pop(0)
+    lint = framework_lint.lint_paths(
+        [os.path.join(REPO, "incubator_mxnet_tpu"),
+         os.path.join(REPO, "tools"),
+         os.path.join(REPO, "bench.py"),
+         os.path.join(REPO, "__graft_entry__.py")])
+    assert not lint, lint
+
+    # shardcheck spec tier over a TP-sharded trainer layout (no compile)
+    import jax
+
+    from incubator_mxnet_tpu import gluon, optimizer
+    from incubator_mxnet_tpu.models.bert import (bert_small,
+                                                 tp_param_shardings)
+    from incubator_mxnet_tpu.parallel.sharded import DataParallel
+
+    if len(jax.devices()) >= 8:
+        mesh = make_mesh({"dp": 2, "tp": 4})
+        net = bert_small(vocab_size=256, max_length=32, dropout=0.0,
+                         seq_shard_axis="tp")
+        net.initialize()
+        dpar = DataParallel(net, gluon.loss.L2Loss(), optimizer.SGD(),
+                            mesh=mesh,
+                            param_shardings=tp_param_shardings(net))
+        rep = dpar.shardcheck_report()
+        assert len(rep) == 0, rep.summary()
+
+    # eval_shape tier over the serve decoder programs
+    from incubator_mxnet_tpu.models.gpt import gpt_tiny
+    from incubator_mxnet_tpu.serve.engine import SlotDecoder
+
+    m = gpt_tiny(vocab_size=97, max_length=32, dropout=0.0)
+    m.initialize()
+    for fam, rep in SlotDecoder(m, max_slots=2,
+                                max_len=32).shardcheck_report().items():
+        assert len(rep) == 0, (fam, rep.summary())

@@ -58,6 +58,19 @@ FL009  paged-serving hazards (scoped to ``serve/`` modules): (a) a
        distinct index shape compiles a fresh program, breaking the
        zero-steady-state-recompile invariant. Pass indices as
        static-shape arrays (the page table) instead.
+FL010  sharding-spec hygiene (scoped to ``parallel/`` and ``serve/``
+       modules): (a) a string axis name inside a ``PartitionSpec``/
+       ``NamedSharding`` literal that is not drawn from any mesh in
+       scope in that file — ``make_mesh``/``Mesh`` axis names, or a
+       function parameter default whose name contains "axis" — is a
+       typo'd or phantom axis that GSPMD silently treats as absent
+       (the layout quietly degrades to replicated; `mx.analysis
+       .shardcheck` rule SC003 is the runtime-level twin); (b) a
+       ``with_sharding_constraint`` call whose spec is a bare
+       ``PartitionSpec`` outside any ``mesh_scope``/``Mesh`` context
+       manager — without an active mesh the constraint either throws or
+       no-ops depending on the jax version. Pass a ``NamedSharding``
+       (mesh attached) or move the call under the mesh scope.
 FL008  span-tracing hygiene (`telemetry/tracing.py`): (a) a
        ``start_span(...)`` call used anywhere but directly as a ``with``
        item — a bare start_span leaks an open span into the ambient
@@ -107,6 +120,11 @@ RULES = {
              "value, or jnp.take/.at[] scatter with host-built "
              "dynamic-shape indices (recompile per index shape) — use "
              "static-shape page-table arrays",
+    "FL010": "parallel//serve/ sharding hygiene: PartitionSpec/"
+             "NamedSharding axis-name string not drawn from any mesh in "
+             "scope (make_mesh/Mesh axis names or *axis* param "
+             "defaults), or with_sharding_constraint with a bare "
+             "PartitionSpec outside a mesh_scope/Mesh context",
 }
 
 _INDEXING_NAME_PARTS = ("getitem", "setitem", "index", "slice")
@@ -402,6 +420,153 @@ def _check_serve_hazards(tree, path, findings):
 
 
 # ---------------------------------------------------------------------------
+# FL010 — sharding-spec hygiene (parallel/ and serve/ modules)
+
+_SPEC_CTOR_NAMES = ("PartitionSpec", "NamedSharding")
+
+
+def _spec_ctor_aliases(tree):
+    """Local names bound to PartitionSpec / NamedSharding (imports and
+    `P = jax.sharding.PartitionSpec`-style assignments)."""
+    aliases = set(_SPEC_CTOR_NAMES)
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom):
+            for a in node.names:
+                if a.name in _SPEC_CTOR_NAMES:
+                    aliases.add(a.asname or a.name)
+        elif isinstance(node, ast.Assign):
+            v = node.value
+            if (isinstance(v, ast.Attribute)
+                    and v.attr in _SPEC_CTOR_NAMES):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        aliases.add(t.id)
+    return aliases
+
+
+def _call_name(node):
+    if isinstance(node.func, ast.Attribute):
+        return node.func.attr
+    if isinstance(node.func, ast.Name):
+        return node.func.id
+    return None
+
+
+def _axis_universe(tree):
+    """Every axis name a mesh in this file could carry: make_mesh dict
+    keys / (axis, size) pairs, Mesh(..., axis_names) strings, and string
+    defaults of parameters whose name mentions 'axis'."""
+    axes = set()
+
+    def add_strings(node):
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Constant) and isinstance(sub.value, str):
+                axes.add(sub.value)
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            name = _call_name(node)
+            if name == "make_mesh" and node.args:
+                add_strings(node.args[0])
+            elif name == "Mesh":
+                if len(node.args) >= 2:
+                    add_strings(node.args[1])
+                for kw in node.keywords:
+                    if kw.arg == "axis_names":
+                        add_strings(kw.value)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            a = node.args
+            params = a.posonlyargs + a.args + a.kwonlyargs
+            defaults = a.defaults + a.kw_defaults
+            for arg, d in zip(params[len(params) - len(defaults):],
+                              defaults):
+                if (d is not None and "axis" in arg.arg
+                        and isinstance(d, ast.Constant)
+                        and isinstance(d.value, str)):
+                    axes.add(d.value)
+    return axes
+
+
+def _mesh_context_ranges(tree):
+    """(lineno, end_lineno) of every `with` whose context expression
+    involves mesh_scope(...) or Mesh(...) — incl. conditional forms like
+    `with (mesh_scope(m) if m else nullcontext()):`."""
+    ranges = []
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.With, ast.AsyncWith)):
+            continue
+        for item in node.items:
+            hit = any(isinstance(sub, ast.Call)
+                      and _call_name(sub) in ("mesh_scope", "Mesh")
+                      for sub in ast.walk(item.context_expr))
+            if hit:
+                ranges.append((node.lineno, node.end_lineno or node.lineno))
+                break
+    return ranges
+
+
+def _check_sharding_hygiene(tree, path, findings):
+    norm = path.replace(os.sep, "/")
+    if "/parallel/" not in norm and "/serve/" not in norm:
+        return
+    aliases = _spec_ctor_aliases(tree)
+    axes = _axis_universe(tree)
+    mesh_ranges = _mesh_context_ranges(tree)
+
+    def spec_ctor(node):
+        return (isinstance(node, ast.Call)
+                and (_call_name(node) in aliases
+                     or _call_name(node) in _SPEC_CTOR_NAMES))
+
+    def literal_axes(call):
+        """String constants in a spec-constructor call, skipping nested
+        spec constructors (they are visited on their own)."""
+        out = []
+        stack = list(call.args) + [kw.value for kw in call.keywords]
+        while stack:
+            sub = stack.pop()
+            if spec_ctor(sub):
+                continue
+            if isinstance(sub, ast.Constant) and isinstance(sub.value, str):
+                out.append(sub)
+            else:
+                stack.extend(ast.iter_child_nodes(sub))
+        return out
+
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if spec_ctor(node):
+            for const in literal_axes(node):
+                if const.value not in axes:
+                    findings.append(LintFinding(
+                        path, const.lineno, "FL010",
+                        f"axis name {const.value!r} in a "
+                        f"{_call_name(node)} literal is not drawn from "
+                        "any mesh in scope in this file (make_mesh/Mesh "
+                        "axis names or an *axis* parameter default) — a "
+                        "typo'd axis silently degrades the layout to "
+                        "replicated (shardcheck SC003 is the runtime "
+                        "twin)"))
+        elif _call_name(node) == "with_sharding_constraint":
+            spec_arg = node.args[1] if len(node.args) >= 2 else None
+            if spec_arg is None or not spec_ctor(spec_arg):
+                continue
+            if _call_name(spec_arg) == "NamedSharding":
+                continue          # carries its own mesh
+            in_scope = any(lo <= node.lineno <= hi
+                           for lo, hi in mesh_ranges)
+            if not in_scope:
+                findings.append(LintFinding(
+                    path, node.lineno, "FL010",
+                    "with_sharding_constraint with a bare PartitionSpec "
+                    "outside any mesh_scope/Mesh context manager: "
+                    "without an active mesh the constraint throws or "
+                    "silently no-ops — pass a NamedSharding or move the "
+                    "call under the mesh scope"))
+
+
+# ---------------------------------------------------------------------------
 # FL009 — paged-serving hazards (serve/ modules only)
 # ---------------------------------------------------------------------------
 
@@ -631,6 +796,7 @@ def lint_source(src, path, coverage_text=None):
     _check_adhoc_timing(tree, path, findings)
     _check_silent_swallow(tree, path, findings, src.splitlines())
     _check_serve_hazards(tree, path, findings)
+    _check_sharding_hygiene(tree, path, findings)
     _check_paged_hazards(tree, path, findings)
     _check_span_hygiene(tree, path, findings)
     _check_ops_ledger(tree, path, findings, coverage_text)
