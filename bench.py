@@ -38,6 +38,12 @@ extras:
   (MXNET_SERVE_PREFILL_CHUNK) vs whole-prompt prefill on the same
   arrival trace — chunking bounds how long one long prompt can stall
   everyone else's first token.
+- gpt_gateway_{high,normal,low}_ttft_p50/p99_ms + gpt_gateway_preemptions
+  + gpt_gateway_<tenant>_tokens_s: multi-tenant gateway trace replay —
+  two co-resident GPT models behind one serve.Gateway, three tenants
+  across three priority tiers on a seeded bursty (Markov-modulated)
+  trace from tools/loadgen; per-tier TTFT, preemption total, per-tenant
+  token rates (SERVING.md §gateway).
 - gpt_serve_traced/untraced_tokens_s + gpt_serve_tracing_overhead_pct:
   the same reduced serve trace with span tracing off then on (adjacent
   runs) — the measured cost of per-request tracing on the serving hot
@@ -714,6 +720,82 @@ def bench_gpt_serve_longprompt(shorts=24, longs=1, max_slots=8,
             "unchunked_all_p99_ms": unchunked_all}
 
 
+def bench_gpt_gateway(requests=30, seed=0):
+    """Multi-tenant gateway trace replay (SERVING.md §gateway): two
+    co-resident GPT models behind one `serve.Gateway`, three tenants
+    across the three priority tiers, driven by a SEEDED bursty trace
+    from tools/loadgen (two-state Markov-modulated arrivals, lognormal
+    prompt lengths — recorded-traffic shape, not Poisson).
+
+    Reported per tier: TTFT p50/p99 (gateway submit → first token,
+    queue wait and preemptions included); plus the preemption total and
+    per-tenant tokens/s — the fairness/priority numbers the gateway
+    exists to produce.
+
+    Loud-failure contract: any failed request, zero completions, or a
+    steady-state recompile (per-engine program counts must be constant
+    across the replay) raises — it lands in extras["errors"], never
+    passes as a small number."""
+    from incubator_mxnet_tpu import serve
+    from incubator_mxnet_tpu.models.gpt import GPTModel
+
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "tools"))
+    try:
+        import loadgen
+    finally:
+        sys.path.pop(0)
+
+    vocab, max_len = 8000, 128
+    reg = serve.ModelRegistry(total_pages=120)
+    for name, share in (("gpt-a", 2.0), ("gpt-b", 1.0)):
+        net = GPTModel(vocab, 256, 1024, 4, 8, max_length=max_len,
+                       dropout=0.0)
+        net.initialize()
+        reg.add(name, net, share=share, max_slots=4, max_len=max_len)
+    gw = serve.Gateway(reg, tenants={
+        "acme": {"weight": 3.0}, "beta": {"weight": 2.0},
+        "crawl": {"weight": 1.0}})
+    rng = onp.random.RandomState(seed)
+    # warm every program the trace will touch (prefill chunk buckets
+    # 16/32/64 + decode per model) so compile time stays out of the clock
+    for name in ("gpt-a", "gpt-b"):
+        for warm_len in (12, 24, 48):
+            gw.generate(name, rng.randint(0, vocab, (warm_len,)), 2)
+    programs_warm = gw.xla_program_counts()
+
+    events = loadgen.synth_trace(
+        requests, models={"gpt-a": 2.0, "gpt-b": 1.0},
+        tenants={"acme": (3.0, "high"), "beta": (2.0, "normal"),
+                 "crawl": (1.0, "low")},
+        seed=seed, duration_s=0.8, prompt_mean=20, prompt_max=60,
+        max_new_range=(4, 12))
+    report = loadgen.replay(gw, events, vocab, timeout=120.0)
+    programs_end = gw.xla_program_counts()
+    gw.shutdown(drain=True)
+
+    if report["failed"]:
+        raise RuntimeError(
+            f"{len(report['failed'])}/{requests} gateway requests "
+            f"failed; first: {report['failed'][0]}")
+    if report["completed"] == 0 or report["wall_s"] <= 0:
+        raise RuntimeError(f"degenerate gateway run: {report}")
+    if programs_end != programs_warm:
+        raise RuntimeError(
+            "steady-state recompile during gateway replay: "
+            f"{programs_warm} -> {programs_end}")
+    out = {"tiers": {}, "preemptions": report["preemptions"],
+           "tenants": {}}
+    for tier, t in report["per_tier"].items():
+        out["tiers"][tier] = {
+            "p50_ms": 1e3 * (loadgen.percentile(t["ttft"], 50) or 0.0),
+            "p99_ms": 1e3 * (loadgen.percentile(t["ttft"], 99) or 0.0),
+            "count": t["count"]}
+    for tenant, t in report["per_tenant"].items():
+        out["tenants"][tenant] = t["tokens"] / report["wall_s"]
+    return out
+
+
 def bench_gpt_serve_traced(requests=12, max_slots=4, prompt_max=48,
                            new_max=48, mean_interarrival_s=0.02, seed=0):
     """Tracing-overhead pair: the SAME reduced serve trace twice,
@@ -944,6 +1026,20 @@ def main():
             round(lp["unchunked_p99_ms"], 1)
     except Exception as e:  # pragma: no cover
         _fail("gpt_serve_longprompt", e)
+    try:
+        gwr = _retry(bench_gpt_gateway)
+        # the multi-tenant story: per-tier TTFT under a bursty recorded
+        # trace, preemption count, per-tenant token rates (SERVING.md)
+        for tier, t in gwr["tiers"].items():
+            extras[f"gpt_gateway_{tier}_ttft_p50_ms"] = \
+                round(t["p50_ms"], 1)
+            extras[f"gpt_gateway_{tier}_ttft_p99_ms"] = \
+                round(t["p99_ms"], 1)
+        extras["gpt_gateway_preemptions"] = int(gwr["preemptions"])
+        for tenant, rate in gwr["tenants"].items():
+            extras[f"gpt_gateway_{tenant}_tokens_s"] = round(rate, 1)
+    except Exception as e:  # pragma: no cover
+        _fail("gpt_gateway", e)
 
     try:
         (fp32_rate, int8_rate, ratio, dev32, dev8,

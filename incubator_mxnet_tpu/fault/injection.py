@@ -40,6 +40,9 @@ Seams (where the probes live):
 ``estimator_step``           `Estimator.fit` batch body (mid-step crash)
 ``serve_step``               `serve.Scheduler.step` entry (serving-loop
                              crash mid-flight; see SERVING.md)
+``gateway_step``             `serve.Gateway.step` entry (multi-tenant
+                             front door crash with tiered queues live;
+                             the flight recorder snapshots queue state)
 ===========================  ==============================================
 
 Off-path contract: when no schedule is configured, ``_SCHEDULE is None``
@@ -58,7 +61,8 @@ __all__ = ["FaultInjected", "SEAMS", "inject_at", "injection_enabled",
 
 SEAMS = ("dataloader_worker", "dataloader_worker_exit", "kvstore_push",
          "kvstore_pull", "kvstore_barrier", "dist_init", "h2d",
-         "checkpoint_write", "estimator_step", "serve_step")
+         "checkpoint_write", "estimator_step", "serve_step",
+         "gateway_step")
 
 
 class FaultInjected(RuntimeError):

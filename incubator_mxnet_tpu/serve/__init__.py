@@ -30,17 +30,30 @@ Three connected parts:
 - `api`       — :class:`ServeEngine`: thread-safe blocking
   ``generate``, streaming ``submit``/``iter_tokens``, batch
   ``generate_many``, background driver thread, graceful
-  ``shutdown(drain=True)``.
+  ``shutdown(drain=True)``;
+- `tenancy` + `gateway` — the multi-tenant front door:
+  :class:`ModelRegistry` (co-resident models sharing one HBM page
+  budget) behind :class:`Gateway` — priority-tiered admission (higher
+  tiers preempt lower-tier running slots, preempted work resumes warm
+  off its cached KV pages), per-tenant token-rate quotas and weighted
+  deficit-round-robin fairness (`TokenBucket`, `WDRRQueue`), driven
+  against recorded traces by `tools/loadgen.py`.
 
 Observability and chaos ride the existing subsystems: the registry
 carries ``mx_serve_ttft_seconds``, ``mx_serve_tokens_total``,
 ``mx_serve_queue_depth``, ``mx_serve_slot_occupancy``,
 ``mx_serve_page_occupancy``, ``mx_serve_prefix_hits_total``,
-``mx_serve_prefill_chunks_total`` and ``mx_serve_evictions_total``;
-`MXNET_FAULT_INJECT` has the ``serve_step`` seam. Env knobs:
+``mx_serve_prefill_chunks_total``, ``mx_serve_evictions_total``
+(``reason="preempted"`` included), the gateway's ``model``/``tenant``/
+``priority``-labeled views of TTFT and tokens, and
+``mx_gateway_queue_depth{priority=}``; `MXNET_FAULT_INJECT` has the
+``serve_step`` and ``gateway_step`` seams. Env knobs:
 ``MXNET_SERVE_MAX_QUEUE``, ``MXNET_SERVE_POLICY``,
 ``MXNET_SERVE_DEADLINE_S``, ``MXNET_SERVE_PAGE_TOKENS``,
-``MXNET_SERVE_PREFILL_CHUNK``, ``MXNET_SERVE_KV_DTYPE``.
+``MXNET_SERVE_PREFILL_CHUNK``, ``MXNET_SERVE_KV_DTYPE``,
+``MXNET_SERVE_PRIORITY_TIERS``, ``MXNET_SERVE_TENANT_QUOTA``,
+``MXNET_GATEWAY_MAX_QUEUE``, ``MXNET_GATEWAY_QUANTUM``,
+``MXNET_GATEWAY_PREEMPT``.
 
 Typical use::
 
@@ -56,14 +69,20 @@ from __future__ import annotations
 
 from . import api  # noqa: F401
 from . import engine  # noqa: F401
+from . import gateway  # noqa: F401
 from . import scheduler  # noqa: F401
+from . import tenancy  # noqa: F401
 from .api import ServeEngine  # noqa: F401
 from .engine import (PageAllocator, PagePoolExhausted,  # noqa: F401
                      PrefixCache, SlotDecoder)
+from .gateway import Gateway, GatewayRequest, ModelRegistry  # noqa: F401
 from .scheduler import (DeadlineExceeded, EngineClosed,  # noqa: F401
                         QueueFull, Request, Scheduler)
+from .tenancy import Tenant, TokenBucket, WDRRQueue  # noqa: F401
 
 __all__ = ["ServeEngine", "SlotDecoder", "Scheduler", "Request",
            "PageAllocator", "PrefixCache", "PagePoolExhausted",
            "QueueFull", "DeadlineExceeded", "EngineClosed",
-           "api", "engine", "scheduler"]
+           "Gateway", "GatewayRequest", "ModelRegistry",
+           "Tenant", "TokenBucket", "WDRRQueue",
+           "api", "engine", "gateway", "scheduler", "tenancy"]

@@ -1,0 +1,844 @@
+"""Multi-tenant serving gateway: many models, many tenants, three
+priority tiers, one front door (SERVING.md §gateway).
+
+`ServeEngine` serves ONE model for ONE implicit tenant at ONE priority.
+Production traffic is none of those things — this module is the
+missing multiplexing layer, in the spirit of model-co-residence serving
+systems (AlpaServe) and predictable-SLO schedulers (Clockwork):
+
+- :class:`ModelRegistry` — co-resident models. Each entry builds its
+  own `SlotDecoder` + `Scheduler` pair (its own two compiled program
+  families — the per-engine zero-steady-state-recompile guarantee is
+  untouched), but the HBM page budget is ONE number split across the
+  per-model pools proportional to each entry's ``share``.
+
+- :class:`Gateway` — ``submit(model, prompt, max_new, tenant=...,
+  priority=...)``. Requests land in one WDRR queue per priority tier
+  (`serve.tenancy`); every ``step()`` expires deadlines, dispatches
+  tier-by-tier (highest first, weighted deficit round robin across
+  tenants inside a tier, token-rate quotas deferring over-quota
+  tenants), steps every engine once, and pumps generated tokens back
+  into the gateway-level handles.
+
+- **preemption** — when a higher-tier request cannot dispatch because
+  its model's slots are full, the lowest-tier / least-progressed
+  running request is PREEMPTED via `Scheduler.preempt`: its page-
+  aligned resident KV pages are registered in the prefix cache (kept
+  while the page budget allows), and the request re-enters the gateway
+  queue as *remaining-chunk work* — the resumed segment's prompt is
+  ``original prompt + tokens so far``, so the cached pages re-attach
+  and only the unaligned tail re-prefills. Preempted work is never
+  silently dropped: it finishes later, or fails LOUDLY (deadline while
+  re-queued ⇒ `DeadlineExceeded`, retryable — never an eviction error).
+
+Observability: gateway spans join the per-request trace
+(``gateway.request`` → ``gateway.admit`` → ``serve.request``), the
+flight recorder snapshots gateway queue state on crash
+(`tracing.register_flight_context`), `mx_serve_ttft_seconds` /
+`mx_serve_tokens_total` gain ``model``/``priority``/``tenant``-labeled
+series, evictions gain ``reason="preempted"``, and
+``mx_gateway_queue_depth{priority=}`` is a pull gauge over the live
+queues. Chaos rides the ``gateway_step`` fault seam. Knobs:
+``MXNET_SERVE_PRIORITY_TIERS``, ``MXNET_SERVE_TENANT_QUOTA``,
+``MXNET_GATEWAY_MAX_QUEUE``, ``MXNET_GATEWAY_QUANTUM``,
+``MXNET_GATEWAY_PREEMPT``.
+"""
+from __future__ import annotations
+
+import os
+import queue as _queue
+import threading
+import time
+import weakref
+
+import numpy as onp
+
+from ..telemetry import registry, tracing
+from ..util import env_int as _env_int
+from . import tenancy
+from .engine import PagePoolExhausted, SlotDecoder
+from .scheduler import (_DONE, _NULL, DeadlineExceeded, EngineClosed,
+                        QueueFull, Scheduler)
+
+__all__ = ["ModelRegistry", "Gateway", "GatewayRequest"]
+
+_IDLE_SLEEP_S = 0.002
+_DRIVER_MAX_CONSECUTIVE_FAILURES = 3
+_FLIGHT_QUEUE_SAMPLE = 64     # queued requests snapshotted per dump
+
+
+def _q_help():
+    return ("gateway admission-queue depth per priority tier "
+            "(pull gauge over the live WDRR queues)")
+
+
+class _Model:
+    """One co-resident engine: its own SlotDecoder pool + Scheduler,
+    plus the gateway-side list of live (dispatched) requests."""
+
+    __slots__ = ("name", "slots", "sched", "share", "live")
+
+    def __init__(self, name, slots, sched, share):
+        self.name = name
+        self.slots = slots
+        self.sched = sched
+        self.share = share
+        self.live = []                    # dispatched GatewayRequests
+
+
+class ModelRegistry:
+    """Declares the co-resident model set and splits one HBM page
+    budget across their pools.
+
+    ``total_pages`` is the SHARED budget (pool pages, incl. each pool's
+    reserved trash page); each model gets
+    ``max(4, floor(total * share / sum_shares))`` pages. With
+    ``total_pages=None`` every engine sizes its own pool (the
+    single-model `SlotDecoder` default) — co-residence without a joint
+    budget."""
+
+    def __init__(self, total_pages=None):
+        self.total_pages = None if total_pages is None else int(total_pages)
+        self._specs = {}
+
+    def add(self, name, block_or_decoder, share=1.0, **engine_kwargs):
+        """Register `name` → model. ``share`` weights this model's cut
+        of the page budget; ``engine_kwargs`` forward to `SlotDecoder`
+        (max_slots, max_len, page_tokens, kv_dtype, ...)."""
+        name = str(name)
+        if name in self._specs:
+            raise ValueError(f"model {name!r} already registered")
+        share = float(share)
+        if share <= 0:
+            raise ValueError(
+                f"model {name!r}: share must be > 0, got {share}")
+        self._specs[name] = (block_or_decoder, share, dict(engine_kwargs))
+        return self
+
+    def __len__(self):
+        return len(self._specs)
+
+    def __contains__(self, name):
+        return name in self._specs
+
+    def names(self):
+        return list(self._specs)
+
+    def _build(self, policy, max_queue, default_deadline, eos_id, seed):
+        if not self._specs:
+            raise ValueError("ModelRegistry is empty — add() a model "
+                             "before constructing the Gateway")
+        total_share = sum(s for _, s, _ in self._specs.values())
+        models = {}
+        for i, (name, (block, share, kw)) in enumerate(self._specs.items()):
+            if hasattr(block, "prefill_chunk_step") \
+                    and hasattr(block, "allocator"):
+                if kw:
+                    raise ValueError(
+                        f"model {name!r}: engine kwargs {sorted(kw)} "
+                        "cannot apply to a pre-built decoder — configure "
+                        "it at construction instead")
+                slots = block     # pre-built SlotDecoder (or a test stub)
+            else:
+                kw = dict(kw)
+                if self.total_pages is not None and "n_pages" not in kw:
+                    kw["n_pages"] = max(
+                        4, int(self.total_pages * share / total_share))
+                slots = SlotDecoder(block, **kw)
+            sched = Scheduler(slots, max_queue=max_queue, policy=policy,
+                              default_deadline=default_deadline,
+                              eos_id=eos_id, seed=seed + i)
+            models[name] = _Model(name, slots, sched, share)
+        return models
+
+
+class GatewayRequest:
+    """The tenant-facing handle: same surface as the engine `Request`
+    (``done`` / ``ttft`` / ``wait`` / ``result`` / token stream) but
+    survives preemption — tokens accumulate across engine segments."""
+
+    __slots__ = ("id", "model", "tenant", "priority", "tier", "prompt",
+                 "max_new", "temperature", "eos_id", "deadline",
+                 "submit_t", "first_token_t", "finish_t", "tokens",
+                 "state", "error", "error_class", "preemptions",
+                 "est_cost", "trace_id", "_spans", "_segment",
+                 "_resume_prompt", "_remaining", "_charged", "_stream",
+                 "_done")
+
+    def __init__(self, rid, model, tenant, priority, tier, prompt,
+                 max_new, temperature, eos_id, deadline):
+        self.id = rid
+        self.model = model
+        self.tenant = tenant
+        self.priority = priority          # tier NAME
+        self.tier = tier                  # tier INDEX (0 = highest)
+        self.prompt = prompt
+        self.max_new = max_new
+        self.temperature = temperature
+        self.eos_id = eos_id
+        self.deadline = deadline          # absolute monotonic, or None
+        self.submit_t = None
+        self.first_token_t = None
+        self.finish_t = None
+        self.tokens = []
+        self.state = "queued"             # queued|dispatched|done|failed
+        self.error = None
+        self.error_class = None
+        self.preemptions = 0
+        self.est_cost = int(prompt.size) + int(max_new)
+        self._segment = None              # live engine Request, or None
+        self._resume_prompt = None        # set after a preemption
+        self._remaining = int(max_new)
+        self._charged = False             # quota debited once, ever
+        root = tracing.open_span("gateway.request", lane=f"greq {rid}",
+                                 request=rid, model=model, tenant=tenant,
+                                 priority=priority,
+                                 prompt_len=int(prompt.size),
+                                 max_new=max_new)
+        self.trace_id = root.trace_id
+        self._spans = {"request": root,
+                       "admit": tracing.open_span("gateway.admit",
+                                                  parent=root)}
+        # bounded by max_new tokens + one sentinel per request
+        self._stream = _queue.Queue()   # noqa: FL011
+        self._done = threading.Event()
+
+    # -- handle surface ----------------------------------------------------
+
+    @property
+    def done(self):
+        return self._done.is_set()
+
+    @property
+    def ttft(self):
+        """Seconds from GATEWAY submit to first token (queue wait at the
+        gateway + engine admission + prefill)."""
+        if self.submit_t is None or self.first_token_t is None:
+            return None
+        return self.first_token_t - self.submit_t
+
+    def wait(self, timeout=None):
+        return self._done.wait(timeout)
+
+    def result(self):
+        if not self._done.is_set():
+            raise RuntimeError(
+                f"gateway request {self.id} not finished "
+                f"(state={self.state}); wait() on it or drive the gateway")
+        if self.error is not None:
+            raise self.error
+        return list(self.tokens)
+
+    # -- gateway side ------------------------------------------------------
+
+    def _emit(self, tok, now):
+        if self.first_token_t is None:
+            self.first_token_t = now
+            ttft = now - self.submit_t
+            # one labeled VIEW per dimension (this registry has no
+            # query-time aggregation, so {priority=} and {model=} are
+            # separate series — slo.gateway_ttft reads the tier view)
+            for labels in ({"priority": self.priority},
+                           {"model": self.model}):
+                registry.histogram(
+                    "mx_serve_ttft_seconds",
+                    "time-to-first-token: submit() to the final prefill "
+                    "chunk's sampled token",
+                    labels=labels).observe(ttft)
+        self.tokens.append(tok)
+        self._stream.put(tok)
+        for labels in ({"tenant": self.tenant}, {"model": self.model}):
+            registry.counter(
+                "mx_serve_tokens_total",
+                "tokens generated by the serving engine",
+                labels=labels).inc()
+
+    def _close_spans(self, error=None):
+        self._spans.pop("admit", _NULL).close(error=error)
+        self._spans.pop("request", _NULL).annotate(
+            tokens=len(self.tokens), state=self.state,
+            preemptions=self.preemptions).close(error=error)
+
+    def _finish(self, now):
+        self.state = "done"
+        self.finish_t = now
+        self._close_spans()
+        self._stream.put(_DONE)
+        self._done.set()
+
+    def _fail(self, exc, now):
+        from ..fault.retry import classify_exception
+
+        self.state = "failed"
+        self.error = exc
+        self.error_class = classify_exception(exc)
+        self.finish_t = now
+        self._close_spans(error=exc)
+        self._stream.put(_DONE)
+        self._done.set()
+
+
+class Gateway:
+    """The multi-tenant front door over a `ModelRegistry`.
+
+    Parameters
+    ----------
+    models : ModelRegistry
+        The co-resident model set (page budget already declared there).
+    tiers : str | sequence, optional
+        Priority tier names, highest first (default
+        ``MXNET_SERVE_PRIORITY_TIERS`` or ``high,normal,low``).
+    tenants : dict, optional
+        ``{name: {"weight": w, "rate": r, "burst": b}}`` profiles.
+        Unknown tenants are auto-created at first submit with weight 1
+        and the default quota.
+    quota : (rate, burst), optional
+        Default per-tenant token-rate quota (``MXNET_SERVE_TENANT_QUOTA``
+        fallback; None = unmetered).
+    quantum : float, optional
+        WDRR quantum in tokens (``MXNET_GATEWAY_QUANTUM`` or 256).
+    max_queue : int, optional
+        Gateway admission bound across all tiers
+        (``MXNET_GATEWAY_MAX_QUEUE`` or 256); full ⇒ `QueueFull`.
+    preempt : bool, optional
+        Allow higher-tier arrivals to preempt lower-tier running slots
+        (``MXNET_GATEWAY_PREEMPT``, default on).
+    policy / engine_max_queue / deadline_s / eos_id / seed
+        Forwarded to each per-model `Scheduler`.
+    """
+
+    def __init__(self, models, tiers=None, tenants=None, quota=None,
+                 quantum=None, max_queue=None, preempt=None, policy="fifo",
+                 engine_max_queue=64, deadline_s=None, eos_id=None,
+                 seed=0):
+        if not isinstance(models, ModelRegistry):
+            raise TypeError("Gateway takes a ModelRegistry (got "
+                            f"{type(models).__name__})")
+        if tiers is None:
+            tiers = os.environ.get("MXNET_SERVE_PRIORITY_TIERS")
+        self.tiers = tenancy.parse_tiers(
+            tiers if tiers is None or isinstance(tiers, str)
+            else ",".join(tiers))
+        if quota is None:
+            quota = tenancy.parse_quota(
+                os.environ.get("MXNET_SERVE_TENANT_QUOTA"))
+        self._default_rate, self._default_burst = quota
+        if quantum is None:
+            quantum = _env_int("MXNET_GATEWAY_QUANTUM", 256)
+        if max_queue is None:
+            max_queue = _env_int("MXNET_GATEWAY_MAX_QUEUE", 256)
+        self.max_queue = int(max_queue)
+        if preempt is None:
+            preempt = bool(_env_int("MXNET_GATEWAY_PREEMPT", 1))
+        self.preempt_enabled = bool(preempt)
+        self._models = models._build(policy, engine_max_queue, deadline_s,
+                                     eos_id, seed)
+        self._queues = {t: tenancy.WDRRQueue(quantum) for t in self.tiers}
+        self._tenants = {}
+        for name, prof in (tenants or {}).items():
+            prof = dict(prof)
+            self._tenants[name] = tenancy.Tenant(
+                name, weight=prof.get("weight", 1.0),
+                rate=prof.get("rate", self._default_rate),
+                burst=prof.get("burst", self._default_burst))
+        self._next_id = 0
+        self.closed = False
+        self._lock = threading.RLock()
+        self._driver = None
+        self._stop = threading.Event()
+        self.preemptions_total = 0
+        self._arm_probes()
+
+    # -- observability probes (weakly bound: a collected gateway drops
+    # -- its series instead of being kept alive by the registry) ----------
+
+    def _arm_probes(self):
+        ref = weakref.ref(self)
+        for tier in self.tiers:
+            def _probe(tier=tier, ref=ref):
+                gw = ref()
+                if gw is None:
+                    return None
+                return len(gw._queues[tier])
+            registry.register_pull_gauge(
+                "mx_gateway_queue_depth", _probe, _q_help(),
+                labels={"priority": tier})
+
+        def _flight(ref=ref):
+            gw = ref()
+            return None if gw is None else gw._flight_state()
+        tracing.register_flight_context("gateway", _flight)
+
+    def _flight_state(self):
+        """Queue/slot snapshot for the flight recorder: what was queued
+        where, and what each model was running, at crash time."""
+        queued = []
+        for tier in self.tiers:
+            for r in self._queues[tier].items()[:_FLIGHT_QUEUE_SAMPLE]:
+                queued.append({
+                    "id": r.id, "model": r.model, "tenant": r.tenant,
+                    "priority": r.priority, "state": r.state,
+                    "preemptions": r.preemptions,
+                    "tokens": len(r.tokens)})
+        return {
+            "tiers": {t: len(self._queues[t]) for t in self.tiers},
+            "queued": queued,
+            "live": {m.name: [
+                {"id": r.id, "tenant": r.tenant, "priority": r.priority,
+                 "tokens": len(r.tokens),
+                 "segment_state": None if r._segment is None
+                 else r._segment.state}
+                for r in m.live] for m in self._models.values()},
+            "preemptions_total": self.preemptions_total,
+            "closed": self.closed,
+        }
+
+    # -- introspection ------------------------------------------------------
+
+    def models(self):
+        return list(self._models)
+
+    def tenant(self, name):
+        """The (auto-created) tenant record — counters, quota bucket."""
+        with self._lock:
+            return self._get_tenant(name)
+
+    @property
+    def queue_depth(self):
+        with self._lock:
+            return sum(len(q) for q in self._queues.values())
+
+    def queue_depths(self):
+        """Per-tier gateway queue depth {tier: n}."""
+        with self._lock:
+            return {t: len(self._queues[t]) for t in self.tiers}
+
+    def xla_program_counts(self):
+        """Live compiled-program count per model — the per-engine
+        zero-steady-state-recompile gate, gateway edition."""
+        with self._lock:
+            return {n: m.slots.xla_program_count()
+                    for n, m in self._models.items()}
+
+    # -- admission ----------------------------------------------------------
+
+    def _get_tenant(self, name):
+        t = self._tenants.get(name)
+        if t is None:
+            t = tenancy.Tenant(name, rate=self._default_rate,
+                               burst=self._default_burst)
+            self._tenants[name] = t
+        return t
+
+    def submit(self, model, prompt_ids, max_new_tokens, tenant="default",
+               priority=None, temperature=1.0, eos_id=None,
+               deadline_s=None):
+        """Enqueue one request for `model` on behalf of `tenant` at
+        `priority` (a tier name; default = the middle tier). Returns a
+        `GatewayRequest` handle.
+
+        Loud rejections: unknown model/priority (`ValueError`), gateway
+        at capacity (`QueueFull`), a request that could never fit the
+        model's page pool (`PagePoolExhausted`), shutdown
+        (`EngineClosed`)."""
+        with self._lock:
+            if self.closed:
+                raise EngineClosed("gateway is shut down; new work is "
+                                   "rejected")
+            m = self._models.get(model)
+            if m is None:
+                raise ValueError(
+                    f"unknown model {model!r} (registered: "
+                    f"{', '.join(sorted(self._models))})")
+            if priority is None:
+                priority = self.tiers[len(self.tiers) // 2]
+            if priority not in self.tiers:
+                raise ValueError(
+                    f"unknown priority {priority!r} (tiers, highest "
+                    f"first: {', '.join(self.tiers)})")
+            prompt = onp.asarray(prompt_ids, onp.int32).reshape(-1)
+            if prompt.size == 0:
+                raise ValueError("empty prompt")
+            max_new = int(max_new_tokens)
+            if max_new < 1:
+                raise ValueError(
+                    f"max_new_tokens must be >= 1, got {max_new}")
+            if prompt.size + max_new > m.slots.max_len:
+                raise ValueError(
+                    f"prompt ({prompt.size}) + max_new_tokens ({max_new}) "
+                    f"exceeds model {model!r}'s max_len "
+                    f"({m.slots.max_len})")
+            pt = m.slots.page_tokens
+            need = -(-(prompt.size + max_new - 1) // pt)
+            if need > m.slots.allocator.usable_pages:
+                raise PagePoolExhausted(
+                    f"request needs {need} KV pages but model {model!r}'s "
+                    f"pool only has {m.slots.allocator.usable_pages} — "
+                    "raise its share/total_pages or shrink the request")
+            if sum(len(q) for q in self._queues.values()) >= self.max_queue:
+                raise QueueFull(
+                    f"gateway admission queue at capacity "
+                    f"({self.max_queue} waiting) — shed load, raise "
+                    "MXNET_GATEWAY_MAX_QUEUE, or retry with backoff")
+            now = time.monotonic()
+            tier = self.tiers.index(priority)
+            req = GatewayRequest(
+                self._next_id, model, str(tenant), priority, tier, prompt,
+                max_new, float(temperature), eos_id,
+                None if deadline_s is None else now + float(deadline_s))
+            self._next_id += 1
+            req.submit_t = now
+            self._get_tenant(req.tenant)
+            self._queues[priority].push(req.tenant, req)
+            return req
+
+    # -- the step loop ------------------------------------------------------
+
+    def step(self):
+        """One gateway iteration: expire → dispatch (tier order, WDRR,
+        quotas, preemption) → one engine step per model → pump tokens.
+        Returns True if any progress was made. A crash leaves a flight-
+        recorder dump carrying the gateway queue snapshot."""
+        try:
+            with self._lock:
+                return self._step()
+        except Exception as e:
+            tracing.maybe_flight_dump("gateway_step", e)
+            raise
+
+    def _step(self):
+        from ..fault.injection import inject_at
+
+        with tracing.span("gateway.step", queued=self.queue_depth):
+            inject_at("gateway_step")
+            now = time.monotonic()
+            expired = self._expire(now)
+            dispatched = self._dispatch(now)
+            stepped = False
+            for m in self._models.values():
+                if m.live or not m.sched.idle:
+                    stepped |= bool(m.sched.step())
+            pumped = self._pump(time.monotonic())
+        return bool(expired or dispatched or stepped or pumped)
+
+    def _expire(self, now):
+        """Fail gateway-queued requests past their deadline — INCLUDING
+        preempted ones waiting to resume: a deadline that passes while
+        re-queued is `DeadlineExceeded` (retryable), never an eviction
+        error."""
+        n = 0
+        for tier in self.tiers:
+            q = self._queues[tier]
+            for req in [r for r in q.items()
+                        if r.deadline is not None and now > r.deadline]:
+                q.remove(req)
+                req._fail(DeadlineExceeded(
+                    f"gateway request {req.id} expired after "
+                    f"{now - req.submit_t:.3f}s "
+                    f"({req.preemptions} preemption(s), "
+                    f"{len(req.tokens)}/{req.max_new} tokens)"), now)
+                n += 1
+        return n
+
+    def _capacity(self, m):
+        """Slots this model can still absorb this step: free slots minus
+        work already staged in its engine queue (the engine admits those
+        first)."""
+        return m.sched.free_slots - m.sched.queue_depth
+
+    def _pick_victim(self, m, tier):
+        """Lowest-priority / least-progressed running request on `m`
+        with a tier strictly below `tier`, or None."""
+        best = None
+        for r in m.live:
+            seg = r._segment
+            if seg is None or seg.slot is None or r.tier <= tier:
+                continue
+            key = (-r.tier, len(r.tokens), -r.id)
+            if best is None or key < best[0]:
+                best = (key, r)
+        return None if best is None else best[1]
+
+    def _can_dispatch(self, req, now):
+        m = self._models[req.model]
+        if self._capacity(m) <= 0:
+            if not (self.preempt_enabled
+                    and self._pick_victim(m, req.tier) is not None):
+                return False
+        if not req._charged:
+            t = self._tenants[req.tenant]
+            lvl = t.bucket.level(now)
+            if lvl is not None and lvl < req.est_cost:
+                return False              # over quota: defer, never drop
+        return True
+
+    def _dispatch(self, now):
+        weights = {n: t.weight for n, t in self._tenants.items()}
+        n = 0
+        for tier_idx, tier in enumerate(self.tiers):
+            q = self._queues[tier]
+            while len(q):
+                req = q.pop_next(weights, lambda r: r.est_cost,
+                                 lambda r: self._can_dispatch(r, now))
+                if req is None:
+                    break
+                self._do_dispatch(req, tier_idx, now)
+                n += 1
+        return n
+
+    def _do_dispatch(self, req, tier_idx, now):
+        m = self._models[req.model]
+        if self._capacity(m) <= 0 and self.preempt_enabled:
+            victim = self._pick_victim(m, tier_idx)
+            if victim is not None:
+                self._preempt_one(m, victim, now)
+        t = self._tenants[req.tenant]
+        if not req._charged:
+            t.bucket.try_debit(req.est_cost, now)   # checked in _can_dispatch
+            req._charged = True
+        prompt = req.prompt if req._resume_prompt is None \
+            else req._resume_prompt
+        deadline_s = None if req.deadline is None \
+            else max(req.deadline - now, 1e-6)
+        seg = m.sched.submit(prompt, req._remaining,
+                             temperature=req.temperature,
+                             eos_id=req.eos_id, deadline_s=deadline_s,
+                             parent_span=req._spans.get("request", _NULL))
+        req._segment = seg
+        req.state = "dispatched"
+        req._spans.pop("admit", _NULL).annotate(
+            engine_request=seg.id, resumed=req._resume_prompt is not None,
+            preemptions=req.preemptions).close()
+        m.live.append(req)
+        t.dispatched += 1
+        registry.counter(
+            "mx_gateway_dispatch_total",
+            "requests handed to a model engine (resumed segments "
+            "included)",
+            labels={"model": req.model, "priority": req.priority}).inc()
+
+    def _preempt_one(self, m, victim, now):
+        """Evict `victim`'s slot for a higher-tier arrival and re-queue
+        its remaining work (tokens survive; resident page-aligned KV
+        stays warm in the prefix cache)."""
+        seg = victim._segment
+        self._drain_segment(victim, seg, now)
+        m.sched.preempt(seg.slot, now)
+        m.live.remove(victim)
+        victim._segment = None
+        gen = onp.asarray(victim.tokens, onp.int32)
+        victim._resume_prompt = onp.concatenate([victim.prompt, gen])
+        victim._remaining = victim.max_new - len(victim.tokens)
+        victim.preemptions += 1
+        victim.state = "queued"
+        self.preemptions_total += 1
+        self._tenants[victim.tenant].preempted += 1
+        tracing.event("gateway.preempt", request=victim.id,
+                      model=m.name, tenant=victim.tenant,
+                      priority=victim.priority,
+                      preemptions=victim.preemptions,
+                      tokens_kept=len(victim.tokens))
+        victim._spans["admit"] = tracing.open_span(
+            "gateway.admit", parent=victim._spans.get("request", _NULL),
+            resumed=True, preemptions=victim.preemptions)
+        self._queues[victim.priority].push(victim.tenant, victim)
+
+    def _drain_segment(self, req, seg, now):
+        """Forward every token the engine segment has produced so far
+        into the gateway handle (idempotent; `_DONE` is left to the
+        finish/fail paths)."""
+        moved = 0
+        while True:
+            try:
+                item = seg._stream.get_nowait()
+            except _queue.Empty:
+                return moved
+            if item is _DONE:
+                return moved
+            req._emit(item, now)
+            self._tenants[req.tenant].tokens_out += 1
+            moved += 1
+
+    def _pump(self, now):
+        """Move tokens from engine segments into gateway handles and
+        fold finished segments (done → done, failed → failed — engine
+        errors propagate with their own class)."""
+        moved = 0
+        for m in self._models.values():
+            for req in list(m.live):
+                seg = req._segment
+                if seg is None:
+                    m.live.remove(req)
+                    continue
+                moved += self._drain_segment(req, seg, now)
+                if not seg.done:
+                    continue
+                m.live.remove(req)
+                req._segment = None
+                t = self._tenants[req.tenant]
+                if seg.error is not None:
+                    req._fail(seg.error, now)
+                else:
+                    t.bucket.credit(req.est_cost - int(req.prompt.size)
+                                    - len(req.tokens))
+                    req._finish(now)
+                moved += 1
+        return moved
+
+    # -- driving ------------------------------------------------------------
+
+    def _driver_running(self):
+        d = self._driver
+        return d is not None and d.is_alive()
+
+    def _drive_until(self, reqs, timeout=None):
+        t_end = None if timeout is None else time.monotonic() + timeout
+        for req in reqs:
+            while not req.done:
+                if t_end is not None and time.monotonic() > t_end:
+                    raise TimeoutError(
+                        f"gateway request {req.id} still {req.state} "
+                        f"after {timeout}s")
+                if self._driver_running():
+                    req.wait(0.05)
+                else:
+                    progressed = self.step()
+                    if not progressed and not req.done:
+                        raise RuntimeError(
+                            f"gateway stalled: request {req.id} is "
+                            f"{req.state} but nothing is progressing "
+                            "(this is a bug — please report)")
+
+    def generate(self, model, prompt_ids, max_new_tokens, tenant="default",
+                 priority=None, temperature=1.0, eos_id=None,
+                 deadline_s=None, timeout=None):
+        """Blocking convenience: submit + drive; returns the FULL
+        sequence (prompt + generated) as 1D int32 numpy."""
+        req = self.submit(model, prompt_ids, max_new_tokens, tenant=tenant,
+                          priority=priority, temperature=temperature,
+                          eos_id=eos_id, deadline_s=deadline_s)
+        self._drive_until([req], timeout=timeout)
+        toks = req.result()
+        return onp.concatenate([onp.asarray(req.prompt, onp.int32),
+                                onp.asarray(toks, onp.int32)])
+
+    def iter_tokens(self, handle, timeout=30.0):
+        """Stream `handle`'s tokens (across preemptions — the handle's
+        stream is continuous even when the slot moves)."""
+        while True:
+            try:
+                item = handle._stream.get_nowait()
+            except _queue.Empty:
+                if self._driver_running() or handle.done:
+                    try:
+                        item = handle._stream.get(timeout=timeout)
+                    except _queue.Empty:
+                        raise TimeoutError(
+                            f"no token from gateway request {handle.id} "
+                            f"in {timeout}s (state={handle.state})") \
+                            from None
+                else:
+                    self.step()
+                    continue
+            if item is _DONE:
+                if handle.error is not None:
+                    raise handle.error
+                return
+            yield item
+
+    # -- driver thread -------------------------------------------------------
+
+    def start(self):
+        """Background driver thread owning the step loop. Idempotent."""
+        if self._driver_running():
+            return self
+        self._stop.clear()
+
+        def _loop():
+            import logging
+
+            log = logging.getLogger("incubator_mxnet_tpu.serve")
+            failures = 0
+            while not self._stop.is_set():
+                try:
+                    progressed = self.step()
+                    failures = 0
+                except Exception as e:
+                    failures += 1
+                    log.error(
+                        "gateway driver: step failed (%d consecutive): "
+                        "%s: %s", failures, type(e).__name__, e)
+                    if failures >= _DRIVER_MAX_CONSECUTIVE_FAILURES:
+                        log.error(
+                            "gateway driver: stopping after %d "
+                            "consecutive step failures — drive manually "
+                            "after the cause is fixed", failures)
+                        break
+                    time.sleep(_IDLE_SLEEP_S)
+                    continue
+                if not progressed:
+                    time.sleep(_IDLE_SLEEP_S)
+
+        self._driver = threading.Thread(target=_loop,
+                                        name="mx-gateway-driver",
+                                        daemon=True)
+        self._driver.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        d = self._driver
+        if d is not None:
+            d.join(timeout=5.0)
+        self._driver = None
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def shutdown(self, drain=True, timeout=None):
+        """Stop the gateway. ``drain=True`` finishes dispatched work;
+        gateway-queued (never-dispatched) requests fail with
+        `EngineClosed` either way — loudly, never silently dropped."""
+        with self._lock:
+            self.closed = True
+            now = time.monotonic()
+            for tier in self.tiers:
+                q = self._queues[tier]
+                for req in q.items():
+                    q.remove(req)
+                    req._fail(EngineClosed(
+                        f"gateway shut down before request {req.id} was "
+                        "dispatched"), now)
+            for m in self._models.values():
+                m.sched.close(drain=drain)
+            self._pump(now)
+        if drain:
+            t_end = None if timeout is None else time.monotonic() + timeout
+            while True:
+                with self._lock:
+                    busy = any(m.sched.n_active
+                               for m in self._models.values())
+                    if busy:
+                        if not self._driver_running():
+                            for m in self._models.values():
+                                if m.sched.n_active:
+                                    m.sched.step()
+                            self._pump(time.monotonic())
+                if not busy:
+                    break
+                if t_end is not None and time.monotonic() > t_end:
+                    raise TimeoutError(
+                        f"gateway drain did not finish in {timeout}s")
+                if self._driver_running():
+                    time.sleep(0.01)
+        self.stop()
+        with self._lock:
+            self._pump(time.monotonic())
+            for m in self._models.values():
+                m.sched.slots.prefix_cache.clear()
+                m.sched.slots.release()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        self.shutdown(drain=exc_type is None)

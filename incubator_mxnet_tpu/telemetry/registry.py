@@ -231,25 +231,29 @@ def register_collector(fn):
     return fn
 
 
-def register_pull_gauge(name, probe, help=""):  # noqa: ARG001 — help is doc
+def register_pull_gauge(name, probe, help="", labels=None):  # noqa: ARG001
     """A gauge-typed series whose value is pulled from ``probe()`` at
     every `report()` / `exposition()` — for occupancy-style series whose
     source of truth is live host state in another subsystem (e.g.
     ``mx_serve_page_occupancy`` over the serving KV page allocator), so
     readers always see the current value instead of the last pushed one.
 
-    ``probe`` returns a number, or None to omit the series this round
-    (the idiom for weakly-bound sources that may be gone). Collector-
-    only on purpose: registering a push `Gauge` under the same name
-    would emit the series twice per exposition."""
+    ``labels`` attaches a fixed label set to the series (one collector
+    per label combination — e.g. ``mx_gateway_queue_depth{priority=}``
+    registers once per tier). ``probe`` returns a number, or None to
+    omit the series this round (the idiom for weakly-bound sources that
+    may be gone). Collector-only on purpose: registering a push `Gauge`
+    under the same name would emit the series twice per exposition."""
+    series = name + _label_str(tuple(sorted(labels.items()))
+                               if labels else ())
 
     def _pull():
         v = probe()
         if v is None:
             return {}
-        return {name: float(v)}
+        return {series: float(v)}
 
-    _pull.__name__ = f"pull_gauge[{name}]"
+    _pull.__name__ = f"pull_gauge[{series}]"
     register_collector(_pull)
     return _pull
 
@@ -382,7 +386,12 @@ def exposition():
     for fn in collectors:
         try:
             for name, v in (fn() or {}).items():
-                lines.append(f"# TYPE {name} gauge")
+                # collector keys may carry a label suffix; the TYPE
+                # declaration names only the base series, once
+                base = name.split("{", 1)[0]
+                if base not in typed:
+                    typed.add(base)
+                    lines.append(f"# TYPE {base} gauge")
                 lines.append(f"{name} {v}")
         except Exception as e:
             _log_collector_failure(fn, e)

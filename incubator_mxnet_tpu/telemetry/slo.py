@@ -39,7 +39,7 @@ from . import registry
 __all__ = ["SLO", "SLOTracker", "tracker", "latency", "throughput",
            "gauge_max", "evaluate", "violations", "check",
            "install_health_check", "serve_ttft", "serve_throughput",
-           "step_time"]
+           "step_time", "gateway_ttft"]
 
 
 class SLO:
@@ -93,16 +93,19 @@ class SLO:
 
 class LatencySLO(SLO):
     """`target` fraction of `series` (a histogram) observations must be
-    ≤ `threshold_s`."""
+    ≤ `threshold_s`. ``labels`` selects ONE labeled series (e.g. the
+    gateway's per-tier TTFT view) instead of the unlabeled aggregate."""
 
     kind = "latency"
 
-    def __init__(self, name, series, threshold_s, target=0.99):
+    def __init__(self, name, series, threshold_s, target=0.99,
+                 labels=None):
         super().__init__(name, series, target)
         self.threshold_s = float(threshold_s)
+        self.labels = dict(labels) if labels else None
 
     def _measure(self):
-        h = registry.histogram(self.series)
+        h = registry.histogram(self.series, labels=self.labels)
         snap = h.snapshot()
         total = snap["count"]
         if not total:
@@ -211,8 +214,9 @@ class SLOTracker:
 
     # -- constructors --------------------------------------------------------
 
-    def latency(self, name, series, threshold_s, target=0.99):
-        return self.add(LatencySLO(name, series, threshold_s, target))
+    def latency(self, name, series, threshold_s, target=0.99, labels=None):
+        return self.add(LatencySLO(name, series, threshold_s, target,
+                                   labels=labels))
 
     def throughput(self, name, series, min_rate, target=0.99):
         return self.add(ThroughputSLO(name, series, min_rate, target))
@@ -254,8 +258,9 @@ def tracker():
     return _DEFAULT
 
 
-def latency(name, series, threshold_s, target=0.99):
-    return _DEFAULT.latency(name, series, threshold_s, target)
+def latency(name, series, threshold_s, target=0.99, labels=None):
+    return _DEFAULT.latency(name, series, threshold_s, target,
+                            labels=labels)
 
 
 def throughput(name, series, min_rate, target=0.99):
@@ -307,3 +312,15 @@ def step_time(threshold_s, target=0.99, name="step_time"):
     """Train-step latency objective over `mx_step_time_seconds`."""
     return _DEFAULT.latency(name, "mx_step_time_seconds", threshold_s,
                             target)
+
+
+def gateway_ttft(tier, threshold_s=0.5, target=0.99, name=None):
+    """Per-tier TTFT objective over the gateway's tier-labeled TTFT view
+    (``mx_serve_ttft_seconds{priority=<tier>}`` — gateway submit to
+    first token, queue wait and preemptions included). The trace-replay
+    acceptance gate (`tools/loadgen.py` + tests/test_gateway.py) holds
+    the high tier to this one."""
+    if name is None:
+        name = f"gateway_ttft_{tier}"
+    return _DEFAULT.latency(name, "mx_serve_ttft_seconds", threshold_s,
+                            target, labels={"priority": str(tier)})

@@ -63,7 +63,7 @@ __all__ = ["Span", "Tracer", "enable", "disable", "is_enabled", "span",
            "current_trace_id", "new_trace_id", "finished_spans",
            "open_spans", "reset", "chrome_events", "chrome_trace",
            "dump_chrome", "flight_dump", "maybe_flight_dump",
-           "RING_CAPACITY"]
+           "register_flight_context", "RING_CAPACITY"]
 
 RING_CAPACITY = 4096          # finished spans kept per writer thread
 _FLIGHT_SPANS = 256           # most-recent spans a flight dump carries
@@ -454,6 +454,31 @@ def _flight_dir():
     return "benchmark" if os.path.isdir("benchmark") else "."
 
 
+_FLIGHT_CONTEXT = {}          # name -> probe() returning a JSON-able dict
+
+
+def register_flight_context(name, probe):
+    """Attach a subsystem state probe to every flight dump: ``probe()``
+    returns a JSON-able dict (or None to skip — the weakly-bound-source
+    idiom) snapshotted into ``payload["context"][name]`` at crash time.
+    The serving gateway registers its queue/slot state here so a crash
+    dump shows WHAT was queued where, not just which spans were open.
+    Re-registering a name replaces the previous probe."""
+    _FLIGHT_CONTEXT[str(name)] = probe
+
+
+def _flight_context():
+    out = {}
+    for name, probe in list(_FLIGHT_CONTEXT.items()):
+        try:
+            state = probe()
+        except Exception as e:  # noqa: FL006 — best-effort context, never mask the dump
+            state = {"probe_error": f"{type(e).__name__}: {e}"[:200]}
+        if state is not None:
+            out[name] = state
+    return out
+
+
 def flight_dump(reason, exc=None, path=None):
     """Snapshot the last `_FLIGHT_SPANS` finished spans, every still-open
     span (the in-flight work at crash time), orphan events, and the armed
@@ -471,6 +496,7 @@ def flight_dump(reason, exc=None, path=None):
         "spans": [s.to_dict() for s in spans],
         "orphan_events": [{"name": n, "ts_us": t, "attrs": a}
                           for n, t, a in list(_ORPHAN_EVENTS)],
+        "context": _flight_context(),
     }
     try:
         from ..fault.injection import schedule_info

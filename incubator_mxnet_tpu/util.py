@@ -253,6 +253,29 @@ _ENV_KNOBS = {
         "pool quantized with one scale per (layer, page, head) — half "
         "the resident KV bytes per slot, parity within tolerance "
         "(honored, this build's addition)"),
+    "MXNET_SERVE_PRIORITY_TIERS": (
+        "serve.Gateway", "comma-separated priority tier names, highest "
+        "first (default high,normal,low); the gateway keeps one WDRR "
+        "queue per tier and higher tiers may preempt lower ones "
+        "(honored, this build's addition — see SERVING.md)"),
+    "MXNET_SERVE_TENANT_QUOTA": (
+        "serve.Gateway", "default per-tenant token-rate quota as "
+        "rate[:burst] tokens/s (burst defaults to 4x rate); unset/0 = "
+        "unmetered — over-quota tenants are deferred, never dropped "
+        "(honored, this build's addition)"),
+    "MXNET_GATEWAY_MAX_QUEUE": (
+        "serve.Gateway", "gateway admission bound across all priority "
+        "tiers before submit() raises QueueFull (default 256) (honored, "
+        "this build's addition)"),
+    "MXNET_GATEWAY_QUANTUM": (
+        "serve.Gateway", "WDRR quantum in tokens granted per tenant "
+        "visit (default 256): larger = coarser fairness granularity, "
+        "lower rotation overhead (honored, this build's addition)"),
+    "MXNET_GATEWAY_PREEMPT": (
+        "serve.Gateway", "1 (default) lets higher-tier arrivals preempt "
+        "lower-tier running slots (page-aligned KV kept warm in the "
+        "prefix cache for the resume); 0 disables preemption "
+        "(honored, this build's addition)"),
     # -- designed out (XLA/jax owns the mechanism) -------------------------
     "MXNET_ENGINE_TYPE": (
         "(designed out)", "scheduling is XLA async dispatch; value ignored"),

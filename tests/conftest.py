@@ -105,6 +105,16 @@ _QUICK = {
     "test_shardcheck.py::test_rule_catalogue_complete",
     "test_shardcheck.py::test_static_gates_meta",
     "test_tools.py::test_fl010_tree_is_clean",
+    # multi-tenant gateway (ISSUE 9 gates): WDRR fairness, preemption
+    # with token survival, the deadline-while-preempted classification,
+    # quota deferral, and the gateway fault seam — all stub-level, no
+    # XLA compile — plus the FL011 boundedness tree sweep
+    "test_gateway.py::test_wdrr_weighted_share",
+    "test_gateway.py::test_preemption_resumes_with_tokens_intact",
+    "test_gateway.py::test_preempted_deadline_expiry_classifies_retryable",
+    "test_gateway.py::test_tenant_quota_defers_never_drops",
+    "test_gateway.py::test_gateway_step_fault_seam",
+    "test_tools.py::test_fl011_tree_is_clean",
 }
 
 

@@ -356,6 +356,69 @@ def test_fl010_tree_is_clean():
 
 
 # ---------------------------------------------------------------------------
+# FL011 — gateway/serving boundedness (ISSUE 9)
+# ---------------------------------------------------------------------------
+
+def test_fl011_flags_unbounded_queues_in_serve():
+    src = ("import collections\n"
+           "import queue\n"
+           "pending = collections.deque()\n"
+           "stream = queue.Queue()\n"
+           "sq = queue.SimpleQueue()\n")
+    hits = [f for f in _lint(src, _SERVE_PATH) if f.rule == "FL011"]
+    assert len(hits) == 3
+    assert any("deque" in f.message for f in hits)
+    assert any("Queue" in f.message for f in hits)
+    assert any("SimpleQueue" in f.message for f in hits)
+
+
+def test_fl011_accepts_bounded_noqa_and_other_paths():
+    bounded = (
+        "import collections\n"
+        "import queue\n"
+        "a = collections.deque(maxlen=64)\n"
+        "b = collections.deque([], 64)\n"
+        "c = queue.Queue(8)\n"
+        "d = queue.Queue(maxsize=8)\n"
+        "e = collections.deque()  # noqa: FL011 - admission-bounded\n")
+    assert not [f for f in _lint(bounded, _SERVE_PATH)
+                if f.rule == "FL011"]
+    # the rule is scoped: the same unbounded deque OUTSIDE serve/ is fine
+    outside = "import collections\nq = collections.deque()\n"
+    assert not [f for f in _lint(outside,
+                                 "incubator_mxnet_tpu/gluon/trainer.py")
+                if f.rule == "FL011"]
+
+
+def test_fl011_flags_timeoutless_blocking_waits():
+    src = ("def pump(q, ev):\n"
+           "    tok = q.get()\n"
+           "    ev.wait()\n")
+    hits = [f for f in _lint(src, _SERVE_PATH) if f.rule == "FL011"]
+    assert len(hits) == 2
+    assert all("timeout" in f.message for f in hits)
+    clean = ("def pump(q, ev):\n"
+             "    tok = q.get(timeout=1.0)\n"
+             "    ev.wait(0.5)\n"
+             "    tok2 = q.get_nowait()\n")
+    assert not [f for f in _lint(clean, _SERVE_PATH)
+                if f.rule == "FL011"]
+
+
+def test_fl011_tree_is_clean():
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import framework_lint
+    finally:
+        sys.path.pop(0)
+    findings = [f for f in framework_lint.lint_paths(
+        [os.path.join(REPO, "incubator_mxnet_tpu"),
+         os.path.join(REPO, "tools"),
+         os.path.join(REPO, "bench.py")]) if f.rule == "FL011"]
+    assert not findings, findings
+
+
+# ---------------------------------------------------------------------------
 # run-metadata stamping (VERDICT Weak #5: stale-rerun detectability)
 # ---------------------------------------------------------------------------
 

@@ -81,6 +81,18 @@ FL008  span-tracing hygiene (`telemetry/tracing.py`): (a) a
        inside function bodies of ``ops/`` modules — kernel-reachable
        bodies get traced by XLA, where a host-side span is at best a
        constant-folded lie and at worst a recompile-per-call hazard.
+FL011  serving-queue bounds (scoped to ``serve/`` modules): (a) an
+       unbounded ``deque()`` / ``Queue()`` / ``LifoQueue()`` /
+       ``PriorityQueue()`` / ``SimpleQueue()`` construction without a
+       ``maxlen``/``maxsize`` — gateway/scheduler queues grow without
+       limit under load unless admission bounds them, and OOM-by-queue
+       is the classic serving outage; (b) a zero-argument blocking wait
+       (``.get()`` / ``.wait()`` / ``.join()`` / ``.acquire()``) —
+       forever-blocking waits wedge the driver/step loop when the
+       producer dies. Where the bound genuinely lives elsewhere (the
+       loud `QueueFull` admission check; a stream bounded by max_new),
+       annotate the line with ``# noqa: FL011`` and the justifying
+       comment.
 
 Usage
 -----
@@ -125,6 +137,11 @@ RULES = {
              "scope (make_mesh/Mesh axis names or *axis* param "
              "defaults), or with_sharding_constraint with a bare "
              "PartitionSpec outside a mesh_scope/Mesh context",
+    "FL011": "serve/ queue bounds: unbounded deque()/Queue() without "
+             "maxlen/maxsize (OOM-by-queue under load) or a "
+             "zero-argument blocking .get()/.wait()/.join()/.acquire() "
+             "(wedges the step loop) — bound it, pass a timeout, or "
+             "`# noqa: FL011` with the admission-bound justification",
 }
 
 _INDEXING_NAME_PARTS = ("getitem", "setitem", "index", "slice")
@@ -417,6 +434,75 @@ def _check_serve_hazards(tree, path, findings):
                         "a device value stalls the loop on a host sync — "
                         "keep slot state host-side (numpy) and fetch "
                         "device results once per step"))
+
+
+# ---------------------------------------------------------------------------
+# FL011 — serving-queue bounds (serve/ modules only)
+# ---------------------------------------------------------------------------
+
+_UNBOUNDED_QUEUE_CTORS = ("Queue", "LifoQueue", "PriorityQueue",
+                          "SimpleQueue")
+_BLOCKING_WAIT_METHODS = ("get", "wait", "join", "acquire")
+
+
+def _ctor_name(func):
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    if isinstance(func, ast.Name):
+        return func.id
+    return None
+
+
+def _check_gateway_bounds(tree, path, findings, src_lines):
+    norm = path.replace(os.sep, "/")
+    if "/serve/" not in norm:
+        return
+
+    def _noqa(node):
+        last = getattr(node, "end_lineno", node.lineno)
+        span = src_lines[node.lineno - 1:last] if src_lines else []
+        return any("noqa: FL011" in ln for ln in span)
+
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = _ctor_name(node.func)
+        kwargs = {k.arg for k in node.keywords}
+        # (a) unbounded queue construction: a queue nothing bounds is an
+        # OOM waiting for a load spike — the serving contract is a LOUD
+        # admission bound (QueueFull) or an explicit maxlen/maxsize
+        if name == "deque":
+            # deque(iterable, maxlen): 2nd positional arg IS the bound
+            if len(node.args) < 2 and "maxlen" not in kwargs \
+                    and not _noqa(node):
+                findings.append(LintFinding(
+                    path, node.lineno, "FL011",
+                    "unbounded deque() in a serve/ module: bound it with "
+                    "maxlen=, or `# noqa: FL011` with a comment naming "
+                    "the admission check that bounds it"))
+        elif name in _UNBOUNDED_QUEUE_CTORS:
+            # Queue(maxsize): 1st positional arg is the bound;
+            # SimpleQueue can never be bounded, so it always needs the
+            # justifying noqa
+            if (name == "SimpleQueue"
+                    or (not node.args and "maxsize" not in kwargs)) \
+                    and not _noqa(node):
+                findings.append(LintFinding(
+                    path, node.lineno, "FL011",
+                    f"unbounded {name}() in a serve/ module: bound it "
+                    "with maxsize=, or `# noqa: FL011` with a comment "
+                    "naming what bounds it"))
+        # (b) forever-blocking waits: when the producer thread dies, a
+        # timeout-less wait wedges the caller instead of failing loudly
+        elif isinstance(node.func, ast.Attribute) \
+                and node.func.attr in _BLOCKING_WAIT_METHODS \
+                and not node.args and not node.keywords \
+                and not _noqa(node):
+            findings.append(LintFinding(
+                path, node.lineno, "FL011",
+                f"zero-argument blocking `.{node.func.attr}()` in a "
+                "serve/ module waits forever if the producer dies — "
+                "pass a timeout and handle expiry loudly"))
 
 
 # ---------------------------------------------------------------------------
@@ -796,6 +882,7 @@ def lint_source(src, path, coverage_text=None):
     _check_adhoc_timing(tree, path, findings)
     _check_silent_swallow(tree, path, findings, src.splitlines())
     _check_serve_hazards(tree, path, findings)
+    _check_gateway_bounds(tree, path, findings, src.splitlines())
     _check_sharding_hygiene(tree, path, findings)
     _check_paged_hazards(tree, path, findings)
     _check_span_hygiene(tree, path, findings)

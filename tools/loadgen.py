@@ -1,0 +1,253 @@
+#!/usr/bin/env python
+"""Trace-replay load harness for the multi-tenant serving gateway.
+
+Poisson arrivals (bench_gpt_serve) are the kind traffic; production is
+not kind. This harness drives `serve.Gateway` with RECORDED traces —
+explicit per-request (arrival time, model, tenant, priority, prompt
+length, token budget) tuples — so bursty arrivals, heavy-tailed prompt
+lengths and skewed tenant mixes are replayed exactly, run to run, and
+the declarative SLOs in `telemetry/slo.py` are evaluated against the
+result as a CI-gated acceptance test (tests/test_gateway.py).
+
+Three layers, importable without a CLI:
+
+- :class:`TraceEvent` + ``save_trace``/``load_trace`` — the JSONL trace
+  format (one event per line; absolute seconds from replay start);
+- :func:`synth_trace` — a seeded generator of REALISTICALLY unkind
+  traffic: two-state Markov-modulated arrivals (calm/burst phases, not
+  memoryless Poisson), lognormal prompt lengths, weighted tenant and
+  model mixes, per-tenant priority profiles;
+- :func:`replay` — release events against a gateway on a (scalable)
+  wall clock while stepping it, wait for every request to complete OR
+  fail loudly, and return the report: per-tier TTFT lists, per-tenant
+  token counts, preemption totals, failure list, wall time.
+
+``python tools/loadgen.py --out trace.jsonl`` writes a synthetic trace;
+replay against a live model needs a constructed gateway, so the replay
+entry point lives in tests/bench, not the CLI.
+"""
+from __future__ import annotations
+
+import json
+
+__all__ = ["TraceEvent", "synth_trace", "save_trace", "load_trace",
+           "replay", "percentile"]
+
+
+class TraceEvent:
+    """One recorded arrival. ``t`` is seconds from replay start;
+    ``seed`` makes the prompt CONTENT reproducible (prompt tokens are
+    drawn from it at replay time, so traces stay tiny)."""
+
+    __slots__ = ("t", "model", "tenant", "priority", "prompt_len",
+                 "max_new", "seed")
+
+    def __init__(self, t, model, tenant, priority, prompt_len, max_new,
+                 seed=0):
+        self.t = float(t)
+        self.model = str(model)
+        self.tenant = str(tenant)
+        self.priority = str(priority)
+        self.prompt_len = int(prompt_len)
+        self.max_new = int(max_new)
+        self.seed = int(seed)
+
+    def to_dict(self):
+        return {k: getattr(self, k) for k in self.__slots__}
+
+    @classmethod
+    def from_dict(cls, d):
+        return cls(**d)
+
+    def __repr__(self):
+        return (f"TraceEvent(t={self.t:.3f}, model={self.model!r}, "
+                f"tenant={self.tenant!r}, priority={self.priority!r}, "
+                f"prompt_len={self.prompt_len}, max_new={self.max_new})")
+
+
+def save_trace(path, events):
+    """Write events as JSONL (one event per line). Returns the path."""
+    with open(path, "w") as f:
+        for e in events:
+            f.write(json.dumps(e.to_dict()) + "\n")
+    return path
+
+
+def load_trace(path):
+    """Read a JSONL trace back into TraceEvents (sorted by arrival)."""
+    events = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                events.append(TraceEvent.from_dict(json.loads(line)))
+    events.sort(key=lambda e: e.t)
+    return events
+
+
+def synth_trace(n, models, tenants, seed=0, duration_s=2.0,
+                burst_factor=6.0, p_enter_burst=0.15, p_exit_burst=0.4,
+                prompt_mean=24, prompt_sigma=0.6, prompt_max=None,
+                max_new_range=(4, 24)):
+    """A seeded, REALISTICALLY unkind trace.
+
+    Arrivals follow a two-state Markov-modulated process: the clock
+    alternates between a calm phase and a burst phase whose rate is
+    ``burst_factor``× higher — recorded production traffic is bursty,
+    and burstiness (not mean load) is what exposes preemption and
+    fairness bugs. Prompt lengths are lognormal (heavy right tail),
+    clipped to ``prompt_max``.
+
+    ``models``: {name: weight}. ``tenants``: {name: (weight, priority)}
+    — each tenant submits at its fixed priority, so tier contention is
+    deterministic given the seed.
+    """
+    import numpy as onp
+
+    rng = onp.random.RandomState(seed)
+    model_names = sorted(models)
+    model_p = onp.array([models[m] for m in model_names], float)
+    model_p /= model_p.sum()
+    tenant_names = sorted(tenants)
+    tenant_p = onp.array([tenants[t][0] for t in tenant_names], float)
+    tenant_p /= tenant_p.sum()
+    # base rate so ~n arrivals fit in duration_s across both phases
+    base_rate = n / max(duration_s, 1e-9)
+    events, t, burst = [], 0.0, False
+    for i in range(int(n)):
+        rate = base_rate * (burst_factor if burst else 0.5)
+        t += float(rng.exponential(1.0 / rate))
+        if rng.rand() < (p_exit_burst if burst else p_enter_burst):
+            burst = not burst
+        plen = int(onp.clip(rng.lognormal(onp.log(prompt_mean),
+                                          prompt_sigma), 1,
+                            prompt_max or 4 * prompt_mean))
+        tenant = tenant_names[rng.choice(len(tenant_names), p=tenant_p)]
+        events.append(TraceEvent(
+            t=t,
+            model=model_names[rng.choice(len(model_names), p=model_p)],
+            tenant=tenant,
+            priority=tenants[tenant][1],
+            prompt_len=plen,
+            max_new=int(rng.randint(max_new_range[0],
+                                    max_new_range[1] + 1)),
+            seed=int(rng.randint(0, 2**31 - 1))))
+    return events
+
+
+def percentile(values, q):
+    """Nearest-rank percentile (q in [0, 100]); None on empty input."""
+    if not values:
+        return None
+    xs = sorted(values)
+    i = min(len(xs) - 1, max(0, int(round(q / 100.0 * (len(xs) - 1)))))
+    return xs[i]
+
+
+def _prompt_for(event, vocab):
+    import numpy as onp
+
+    return onp.random.RandomState(event.seed).randint(
+        0, vocab, size=(event.prompt_len,)).astype(onp.int32)
+
+
+def replay(gw, events, vocab, time_scale=1.0, deadline_s=None,
+           timeout=60.0):
+    """Release `events` against gateway `gw` on a scaled wall clock,
+    stepping the gateway between arrivals, then drive until every
+    request completes or fails.
+
+    The contract is the acceptance gate's: every submitted request ends
+    in exactly one of {completed, failed-with-a-classified-error} — a
+    request that silently vanishes raises RuntimeError here.
+
+    Returns the report dict::
+
+        {"completed": int, "failed": [(id, tenant, error type, class)],
+         "per_tier": {tier: {"count", "ttft": [...], "tokens": int}},
+         "per_tenant": {tenant: {"tokens", "completed", "preempted"}},
+         "preemptions": int, "wall_s": float,
+         "resumed_completed": int}   # preempted requests that finished
+    """
+    import time
+
+    events = sorted(events, key=lambda e: e.t)
+    handles = []
+    t0 = time.monotonic()
+    i = 0
+    while i < len(events):
+        now = time.monotonic() - t0
+        due = events[i].t * time_scale
+        if now < due:
+            if not gw.step():
+                time.sleep(0.0005)
+            continue
+        e = events[i]
+        handles.append((e, gw.submit(
+            e.model, _prompt_for(e, vocab), e.max_new, tenant=e.tenant,
+            priority=e.priority, deadline_s=deadline_s)))
+        i += 1
+    t_end = time.monotonic() + timeout
+    for _, h in handles:
+        while not h.done:
+            if time.monotonic() > t_end:
+                raise TimeoutError(
+                    f"replay: request {h.id} ({h.tenant}/{h.priority}) "
+                    f"still {h.state} after {timeout}s — "
+                    f"{gw.queue_depths()} queued")
+            if not gw.step():
+                time.sleep(0.001)
+    wall = time.monotonic() - t0
+    report = {"completed": 0, "failed": [], "per_tier": {},
+              "per_tenant": {}, "preemptions": gw.preemptions_total,
+              "wall_s": wall, "resumed_completed": 0}
+    for e, h in handles:
+        tier = report["per_tier"].setdefault(
+            h.priority, {"count": 0, "ttft": [], "tokens": 0})
+        ten = report["per_tenant"].setdefault(
+            h.tenant, {"tokens": 0, "completed": 0, "preempted": 0})
+        tier["count"] += 1
+        ten["preempted"] += h.preemptions
+        if h.state == "done":
+            report["completed"] += 1
+            ten["completed"] += 1
+            tier["tokens"] += len(h.tokens)
+            ten["tokens"] += len(h.tokens)
+            if h.ttft is not None:
+                tier["ttft"].append(h.ttft)
+            if h.preemptions:
+                report["resumed_completed"] += 1
+        elif h.state == "failed":
+            report["failed"].append(
+                (h.id, h.tenant, type(h.error).__name__, h.error_class))
+        else:
+            raise RuntimeError(
+                f"replay: request {h.id} ended in state {h.state!r} — "
+                "every request must complete or fail loudly")
+    return report
+
+
+def main(argv=None):
+    import argparse
+
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--out", required=True, help="JSONL trace path")
+    ap.add_argument("--n", type=int, default=64, help="arrival count")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--duration", type=float, default=2.0,
+                    help="trace span in seconds")
+    args = ap.parse_args(argv)
+    events = synth_trace(
+        args.n,
+        models={"gpt-a": 2.0, "gpt-b": 1.0},
+        tenants={"acme": (3.0, "high"), "beta": (2.0, "normal"),
+                 "crawl": (1.0, "low")},
+        seed=args.seed, duration_s=args.duration)
+    save_trace(args.out, events)
+    print(f"wrote {len(events)} events to {args.out} "
+          f"(span {events[-1].t:.2f}s)")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
