@@ -382,10 +382,40 @@ def jit_cache_report(threshold=8):
     """Inspect the live op-call jit cache for recompile churn: one op
     holding `threshold`+ compiled variants means its static arguments (for
     scalars: their VALUES) keep changing — the silent-cache-miss pattern
-    behind the eager-dispatch regression. Returns an AuditReport."""
+    behind the eager-dispatch regression. Returns an AuditReport.
+
+    When the compile observatory (`telemetry.compiles`) has ledger data,
+    the report joins it: ``report.ledger`` maps each program family to
+    ``{compiles, seconds, flops, bytes_accessed, peak_bytes, causes}``
+    (XLA's own cost/memory accounting, not just cache sizes), and any
+    family with recompiles past the first gets a `recompile-forensics`
+    note naming the dominant cause."""
     from ..ndarray import ndarray as nd_mod
 
     report = AuditReport("jit-cache")
+    report.ledger = {}
+    try:
+        from ..telemetry import compiles as _compiles
+
+        report.ledger = _compiles.ledger_report()
+    except Exception:  # noqa: FL006 — the ledger join is best-effort
+        # garnish on the cache report; a telemetry import/shape problem
+        # must not break the audit itself
+        report.note("recompile-forensics",
+                    "compile ledger unavailable (telemetry.compiles "
+                    "failed to import or report)", severity="info")
+    for fam, row in sorted(report.ledger.items()):
+        if row["compiles"] <= 1 or not row["causes"]:
+            continue
+        cause = max(row["causes"].items(), key=lambda kv: kv[1])[0]
+        secs = row["seconds"]
+        report.note(
+            "recompile-forensics",
+            f"program `{fam}` compiled {row['compiles']}x "
+            f"({secs:.2f}s total); dominant cause: {cause} "
+            f"({row['causes']})",
+            severity="info" if cause == "new_bucket" else "warn",
+            op=fam)
     info = nd_mod.jit_cache_info()
     per_op: dict = {}
     for key in info["keys"]:

@@ -9,7 +9,7 @@ the real failure surfaces of the framework.
 
 Schedule grammar (comma-separated entries)::
 
-    MXNET_FAULT_INJECT="seam:prob[:seed[:limit]],..."
+    MXNET_FAULT_INJECT="seam:prob[:seed[:limit[:kind]]],..."
 
 - ``seam``  — one of :data:`SEAMS` (below);
 - ``prob``  — per-draw fire probability in [0, 1];
@@ -18,7 +18,13 @@ Schedule grammar (comma-separated entries)::
   run REPLAYS exactly;
 - ``limit`` — optional max number of fires (``prob=1.0, limit=N`` fails
   exactly the first N draws then goes quiet — the deterministic form the
-  test suites use).
+  test suites use);
+- ``kind``  — the failure flavor: ``fault`` (default,
+  :class:`FaultInjected`) or ``oom``
+  (:class:`InjectedResourceExhausted`, whose message carries the XLA
+  ``RESOURCE_EXHAUSTED`` marker so the HBM observatory's OOM post-mortem
+  seams treat it as a real allocator failure — the fixture behind
+  `telemetry/hbm.py`'s flight-dump test).
 
 Seams (where the probes live):
 
@@ -55,9 +61,9 @@ from __future__ import annotations
 import os
 import threading
 
-__all__ = ["FaultInjected", "SEAMS", "inject_at", "injection_enabled",
-           "configure_injection", "configure_from_env", "clear_injection",
-           "schedule_info"]
+__all__ = ["FaultInjected", "InjectedResourceExhausted", "SEAMS",
+           "inject_at", "injection_enabled", "configure_injection",
+           "configure_from_env", "clear_injection", "schedule_info"]
 
 SEAMS = ("dataloader_worker", "dataloader_worker_exit", "kvstore_push",
          "kvstore_pull", "kvstore_barrier", "dist_init", "h2d",
@@ -83,15 +89,39 @@ class FaultInjected(RuntimeError):
         return (FaultInjected, (self.seam, self.draw))
 
 
-class _SeamState:
-    __slots__ = ("prob", "seed", "limit", "rng", "draws", "fired")
+class InjectedResourceExhausted(FaultInjected):
+    """The ``oom`` flavor: message carries XLA's ``RESOURCE_EXHAUSTED``
+    marker, so every is-this-an-OOM classifier (e.g.
+    `telemetry.hbm.is_resource_exhausted`) treats it as the real thing."""
 
-    def __init__(self, prob, seed=0, limit=None):
+    def __init__(self, seam, draw):
+        RuntimeError.__init__(
+            self,
+            f"RESOURCE_EXHAUSTED: Out of memory (injected fault at seam "
+            f"'{seam}', draw #{draw}, MXNET_FAULT_INJECT)")
+        self.seam = seam
+        self.draw = draw
+
+    def __reduce__(self):
+        return (InjectedResourceExhausted, (self.seam, self.draw))
+
+
+_KINDS = {"fault": FaultInjected, "oom": InjectedResourceExhausted}
+
+
+class _SeamState:
+    __slots__ = ("prob", "seed", "limit", "kind", "rng", "draws", "fired")
+
+    def __init__(self, prob, seed=0, limit=None, kind="fault"):
         import random
 
         self.prob = float(prob)
         self.seed = int(seed)
         self.limit = None if limit is None else int(limit)
+        if kind not in _KINDS:
+            raise ValueError(f"unknown fault kind {kind!r} "
+                             f"(valid: {', '.join(_KINDS)})")
+        self.kind = kind
         self.rng = random.Random(self.seed)
         self.draws = 0
         self.fired = 0
@@ -108,10 +138,10 @@ def _parse_spec(spec):
         if not part:
             continue
         bits = part.split(":")
-        if not 2 <= len(bits) <= 4:
+        if not 2 <= len(bits) <= 5:
             raise ValueError(
                 f"MXNET_FAULT_INJECT entry {part!r}: expected "
-                "'seam:prob[:seed[:limit]]'")
+                "'seam:prob[:seed[:limit[:kind]]]'")
         seam = bits[0].strip()
         if seam not in SEAMS:
             raise ValueError(
@@ -122,15 +152,17 @@ def _parse_spec(spec):
             raise ValueError(
                 f"MXNET_FAULT_INJECT seam {seam!r}: prob {prob} ∉ [0, 1]")
         seed = int(bits[2]) if len(bits) >= 3 else 0
-        limit = int(bits[3]) if len(bits) == 4 else None
-        sched[seam] = _SeamState(prob, seed, limit)
+        limit = int(bits[3]) if len(bits) >= 4 and bits[3] else None
+        kind = bits[4].strip().lower() if len(bits) == 5 else "fault"
+        sched[seam] = _SeamState(prob, seed, limit, kind)
     return sched
 
 
 def configure_injection(spec):
     """Arm the chaos schedule. `spec` is the ``MXNET_FAULT_INJECT`` grammar
-    string or a ``{seam: (prob[, seed[, limit]])}`` dict. Empty/None
-    clears. Returns the armed seam names."""
+    string or a ``{seam: (prob[, seed[, limit[, kind]]])}`` dict (kind
+    ``fault`` | ``oom``). Empty/None clears. Returns the armed seam
+    names."""
     global _SCHEDULE
     if not spec:
         clear_injection()
@@ -221,8 +253,9 @@ def inject_at(seam):
                          labels={"seam": seam}).inc()
         # annotate the enclosing span (serve.step, estimator.step, ...)
         # so the flight-recorder dump shows WHERE the chaos landed
-        tracing.event("fault.injected", seam=seam, draw=draw)
-        raise FaultInjected(seam, draw)
+        tracing.event("fault.injected", seam=seam, draw=draw,
+                      kind=st.kind)
+        raise _KINDS[st.kind](seam, draw)
 
 
 def schedule_info():
@@ -233,5 +266,6 @@ def schedule_info():
         return {}
     with _LOCK:
         return {seam: {"prob": st.prob, "seed": st.seed, "limit": st.limit,
-                       "draws": st.draws, "fired": st.fired}
+                       "kind": st.kind, "draws": st.draws,
+                       "fired": st.fired}
                 for seam, st in sched.items()}

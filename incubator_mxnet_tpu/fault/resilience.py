@@ -162,7 +162,12 @@ class ResilienceHandler(StepGuard, TrainBegin, BatchEnd):
             return False
         # postmortem context BEFORE the resume rewinds state: the dump
         # carries the crashed step's spans and the fault that fired
-        _tracing().maybe_flight_dump("estimator_crash", exc)
+        # (RESOURCE_EXHAUSTED upgrades to the OOM post-mortem with the
+        # HBM census + compile ledger in the payload)
+        from ..telemetry import hbm as _hbm
+
+        if _hbm.maybe_oom_postmortem("estimator_step", exc) is None:
+            _tracing().maybe_flight_dump("estimator_crash", exc)
         step = self.checkpointer.resume()
         self._resumes += 1
         _registry().counter(

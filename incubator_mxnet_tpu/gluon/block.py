@@ -352,12 +352,21 @@ class _CachedGraph:
 
             _t0 = _time.perf_counter()
             mode["jitted"](tuple(param_vals), key, *input_vals)
+            _dt = _time.perf_counter() - _t0
             _telem = _sys.modules.get(
                 "incubator_mxnet_tpu.telemetry.registry")
             if _telem is not None:
                 _telem.observe_compile(
-                    f"cached_op:{type(self.block).__name__}",
-                    _time.perf_counter() - _t0)
+                    f"cached_op:{type(self.block).__name__}", _dt)
+            _comp = _sys.modules.get(
+                "incubator_mxnet_tpu.telemetry.compiles")
+            if _comp is not None:
+                # compile-observatory ledger entry (per training mode —
+                # the second mode's compile diffs against the first)
+                _comp.record_compile(
+                    f"cached_op:{type(self.block).__name__}", _dt,
+                    args=(tuple(param_vals), key) + tuple(input_vals),
+                    fn=mode["jitted"], observe=False)
             probe = mode["probe"]
             mode["aux_arrays"] = probe["aux_arrays"]
             mode["treedef"] = probe["treedef"]

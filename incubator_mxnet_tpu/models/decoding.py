@@ -361,8 +361,12 @@ class GPTDecoder:
                 [jnp.transpose(toks, (1, 0)), last[:, None]], axis=1)
             return out
 
-        return jax.jit(generate, static_argnames=("max_new", "top_k",
-                                                  "do_sample", "cache_len"))
+        from ..telemetry.compiles import ledgered_jit
+
+        return ledgered_jit(generate,
+                            family="gpt.generate",
+                            static_argnames=("max_new", "top_k",
+                                             "do_sample", "cache_len"))
 
     def _auto_refresh(self):
         """Re-stack parameters if the model was updated since the last

@@ -213,6 +213,10 @@ def make_train_step(cfg: BertConfig, mesh, lr=1e-3, use_sp=True):
     param_sh = jax.tree.unflatten(treedef, [NS(s) for s in spec_leaves])
     opt_sh = {"m": param_sh, "v": param_sh, "t": NS(P())}
     batch_sh = NS(P("dp", None))
-    jitted = jax.jit(step, in_shardings=(param_sh, opt_sh, batch_sh, batch_sh),
-                     donate_argnums=(0, 1))
+    from ..telemetry.compiles import ledgered_jit
+
+    jitted = ledgered_jit(step, family="train.sharded_bert.step",
+                          in_shardings=(param_sh, opt_sh, batch_sh,
+                                        batch_sh),
+                          donate_argnums=(0, 1))
     return jitted, params, opt_state

@@ -970,6 +970,14 @@ _ANALYSIS_HOOK = None
 # _MONITOR_HOOK: monitor._observe(name, out_vals) (health stats/NaN guard)
 _STAGE_HOOK = None
 _MONITOR_HOOK = None
+# _COMPILE_HOOK: compiles._ndarray_compile_hook(name, key, call_vals,
+# seconds, jitted) — compile-observatory ledger entry on a fresh op-cache
+# compile (fires only on cache misses, never the steady-state path)
+_COMPILE_HOOK = None
+# _OOM_HOOK: hbm.maybe_oom_postmortem(where, exc) — fires only on the
+# already-exceptional dispatch fallback path (a RESOURCE_EXHAUSTED here
+# is about to be silently retried eagerly; the post-mortem documents it)
+_OOM_HOOK = None
 
 
 def _telemetry_registry():
@@ -1059,23 +1067,30 @@ def _cached_jit(name, key, pure_fn, call_vals):
         leaves = outs if isinstance(outs, tuple) else (outs,)
         if all(isinstance(o, jax.Array) for o in leaves):
             if fresh:
+                dt = time.perf_counter() - t0
                 telem = _telemetry_registry()
                 if telem is not None:
                     # first call = trace+compile (per (op, static-key)
                     # program; jax's own aval cache makes later shape
                     # recompiles invisible here — documented in TELEMETRY.md)
-                    telem.observe_compile(name, time.perf_counter() - t0)
+                    telem.observe_compile(name, dt)
+                hook = _COMPILE_HOOK
+                if hook is not None:
+                    hook(name, key, call_vals, dt, jitted)
             return outs
     except (jax.errors.JAXTypeError, TypeError):
         # dynamic-shape ops (unique, nonzero, boolean indexing…) trace-fail
         # under jit: run this op eagerly from now on
         _jit_deny(name, key)
         return None
-    except Exception:
+    except Exception as e:
         # transient failure (dropped remote compile, OOM…) or a genuine
         # user error: evict and fall back to eager — user errors re-raise
         # identically there. Repeated deterministic failures stop paying
         # the trace cost via the deny list.
+        hook = _OOM_HOOK
+        if hook is not None:
+            hook("dispatch", e)
         _JIT_CACHE.pop(key, None)
         _JIT_FAILS[name] = _JIT_FAILS.get(name, 0) + 1
         if _JIT_FAILS[name] >= _JIT_MAX_FAILS:

@@ -33,11 +33,12 @@ _JIT_CACHE: dict = {}
 def _jitted(cls, fn_name):
     key = (cls, fn_name)
     if key not in _JIT_CACHE:
-        import jax
+        from ..telemetry.compiles import ledgered_jit
 
-        _JIT_CACHE[key] = jax.jit(getattr(cls, fn_name).__func__
-                                  if hasattr(getattr(cls, fn_name), "__func__")
-                                  else getattr(cls, fn_name))
+        fn = getattr(cls, fn_name)
+        _JIT_CACHE[key] = ledgered_jit(
+            fn.__func__ if hasattr(fn, "__func__") else fn,
+            family=f"optimizer.{cls.__name__}.{fn_name}")
     return _JIT_CACHE[key]
 
 

@@ -160,7 +160,10 @@ def _reducer(op):
         repl = jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec())
         fn = {"sum": lambda x: x.sum(axis=0),
               "max": lambda x: x.max(axis=0)}[op]
-        _STATE["reducers"][op] = jax.jit(fn, out_shardings=repl)
+        from ..telemetry.compiles import ledgered_jit
+
+        _STATE["reducers"][op] = ledgered_jit(
+            fn, family=f"dist.reduce_{op}", out_shardings=repl)
     return _STATE["reducers"][op]
 
 

@@ -171,10 +171,12 @@ class PipelineParallel:
                     new_s)
 
         psp = P(axis_name)
-        self._jit = jax.jit(jax.shard_map(
+        from ..telemetry.compiles import ledgered_jit
+
+        self._jit = ledgered_jit(jax.shard_map(
             device_fn, mesh=mesh,
             in_specs=(psp, psp, P(), P(), P()),
-            out_specs=(P(), psp, psp)))
+            out_specs=(P(), psp, psp)), family="train.pipeline.step")
 
     def step(self, x, y):
         """One GPipe train step; returns the scalar loss (NDArray)."""

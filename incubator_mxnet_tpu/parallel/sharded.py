@@ -166,6 +166,16 @@ def _build_pure_step(net, loss_fn, optimizer, remat_spec=None):
             stacked_mask_cell)
 
 
+def _observed_step_jit(fn):
+    """Compile-observatory wrapper for the train-step program family: the
+    warmup compile and any later recompile (shape/dtype churn in the batch,
+    a static-arg change) land in the ledger with forensics."""
+    from ..telemetry import compiles
+
+    return compiles.instrument_jit(fn, "train.DataParallel.step",
+                                   donate=(0, 2, 3))
+
+
 def _stack_state(s):
     """Stack a per-param [slot, slot, ...] optimizer state (same-shaped
     slots, e.g. adam's m/v) into ONE (n_slots, *shape) array; anything
@@ -265,16 +275,18 @@ class DataParallel:
             # donate params + optimizer states: they are consumed and
             # rebound every step, so XLA updates them in place instead of
             # materializing copies
-            self._jit = jax.jit(
+            self._jit = _observed_step_jit(jax.jit(
                 step,
                 in_shardings=(param_sh, None, state_sh,
                               None, None, None, repl, batch_sh, batch_sh),
                 out_shardings=(None, param_sh, state_sh, None, None),
-                donate_argnums=(0, 2, 3))
+                donate_argnums=(0, 2, 3)))
             self._batch_sharding = batch_sh
         else:
-            self._jit = jax.jit(step, donate_argnums=(0, 2, 3))
+            self._jit = _observed_step_jit(
+                jax.jit(step, donate_argnums=(0, 2, 3)))
             self._batch_sharding = None
+        self._register_hbm_owners()
         # device-resident step counter + cached lr/wd uploads (see step())
         self._t_dev = None
         self._lr_dev = (None, None)
@@ -293,6 +305,36 @@ class DataParallel:
             # is the spec tier only (call shardcheck_report(x, y) for the
             # full simulated-mesh pass)
             self.shardcheck_report(mode=mode)
+
+    def _register_hbm_owners(self):
+        """HBM-census attribution (`telemetry.hbm`): params (incl. frozen)
+        and optimizer state. Donation re-binds these arrays every step, so
+        the probes read the live handles through a trainer weakref rather
+        than capturing the construction-time arrays."""
+        import weakref
+
+        import jax.tree_util as jtu
+
+        ref = weakref.ref(self)
+
+        def _params_probe():
+            tr = ref()
+            if tr is None:
+                return None
+            return {"arrays": [a._data for a in tr.param_arrays]
+                    + [a._data for a in tr.frozen_arrays]}
+
+        def _opt_probe():
+            tr = ref()
+            if tr is None:
+                return None
+            return {"arrays": [leaf for leaf in jtu.tree_leaves(
+                tr.opt_states) if hasattr(leaf, "nbytes")]}
+
+        from ..telemetry import hbm
+
+        hbm.register_owner("train.params", _params_probe)
+        hbm.register_owner("train.optimizer", _opt_probe)
 
     def shardcheck_report(self, x=None, y=None, hbm_budget_gb=None,
                           mode=None, compile=True):
@@ -422,5 +464,9 @@ def shard_train_step(step_fn, mesh, in_specs, out_specs):
                      for s in in_specs)
     out_specs = (out_specs if isinstance(out_specs, P)
                  else P(*out_specs) if out_specs else P())
-    return jax.jit(shard_map(step_fn, mesh=mesh, in_specs=in_specs,
-                             out_specs=out_specs))
+    from ..telemetry import compiles
+
+    return compiles.ledgered_jit(
+        shard_map(step_fn, mesh=mesh, in_specs=in_specs,
+                  out_specs=out_specs),
+        family="train.shard_map_step")

@@ -171,7 +171,9 @@ def outline_op(name, pure_fn, static_info=None):
         suffix = "|" + ",".join(f"{k}={static_info[k]}"
                                 for k in sorted(static_info))
     _outlined.__name__ = _OUTLINED_PREFIX + name + suffix
-    return jax.jit(_outlined)
+    # trace-time outlining shim, inlined into the enclosing cached-graph
+    # program — never a standalone runtime program family
+    return jax.jit(_outlined)  # noqa: FL012
 
 
 def _eqn_op_name(eqn):
@@ -401,7 +403,9 @@ def segment_pattern(ops, name):
             return res if len(res) > 1 else res[0]
 
         run.__name__ = name
-        return jax.jit(run)(*invals)
+        # pattern-replacement body, traced inline with tracer invals —
+        # not a runtime program family
+        return jax.jit(run)(*invals)  # noqa: FL012
 
     return Pattern(name, list(ops), replace)
 

@@ -349,7 +349,8 @@ def analyze_memory(fn, *args, static_argnums=None):
     compile-time analysis, nothing is executed."""
     import jax
 
-    jitted = jax.jit(fn, static_argnums=static_argnums or ())
+    # AOT memory estimator: lower+compile for analysis only, nothing runs
+    jitted = jax.jit(fn, static_argnums=static_argnums or ())  # noqa: FL012
     compiled = jitted.lower(*args).compile()
     an = compiled.memory_analysis()
     if an is None:                 # pragma: no cover - backend-dependent

@@ -167,7 +167,13 @@ class ServeEngine:
             with self._lock:
                 return self._sched.step()
         except Exception as e:
-            tracing.maybe_flight_dump("serve_step", e)
+            from ..telemetry import hbm
+
+            # RESOURCE_EXHAUSTED gets the OOM post-mortem (census +
+            # compile ledger in the dump context); the generic dump is
+            # skipped when the post-mortem already wrote one
+            if hbm.maybe_oom_postmortem("serve_step", e) is None:
+                tracing.maybe_flight_dump("serve_step", e)
             raise
 
     def _driver_running(self):

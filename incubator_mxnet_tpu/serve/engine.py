@@ -68,6 +68,8 @@ import weakref
 import numpy as onp
 
 from ..models.decoding import (GPTDecoder, bucket_chunk, chunk_buckets)
+from ..telemetry import compiles as _compiles
+from ..telemetry import hbm as _hbm
 from ..telemetry import registry
 
 __all__ = ["SlotDecoder", "PageAllocator", "PrefixCache",
@@ -423,6 +425,11 @@ class SlotDecoder:
         self._prefill_jit = None
         self._decode_jit = None
 
+        # compile-ledger / HBM-census attribution label; the gateway
+        # overrides this per model BEFORE the first prefill so ledger
+        # families and census owners carry the tenant name
+        self.census_name = "serve"
+
     # -- page table ---------------------------------------------------------
 
     def set_slot_pages(self, slot, pages):
@@ -467,6 +474,42 @@ class SlotDecoder:
             dtype = layers["qkv_w"].dtype
             self._pk = jnp.zeros(shape, dtype)
             self._pv = jnp.zeros(shape, dtype)
+        self._register_hbm_owners()
+
+    def _register_hbm_owners(self):
+        """Attribute this engine's device memory to named HBM-census
+        owners (`telemetry.hbm`): the KV pool (+ page table, with the
+        prefix cache's share as derived page math — cached pages live
+        inside the pool arrays) and the decoder params. Probes hold a
+        weakref so a released engine silently drops out of the census."""
+        ref = weakref.ref(self)
+
+        def _pool_probe():
+            eng = ref()
+            if eng is None or eng._pk is None:
+                return None
+            arrays = [eng._pk, eng._pv, eng._sk, eng._sv, eng._table_dev]
+            page_bytes = eng.cache_bytes / eng.n_pages if eng.n_pages else 0
+            cached = eng.prefix_cache.cached_pages
+            return {
+                "arrays": [a for a in arrays if a is not None],
+                "detail": {"kv_dtype": eng.kv_dtype,
+                           "n_pages": eng.n_pages,
+                           "pages_used": eng.allocator.used_pages,
+                           "prefix_cached_pages": cached},
+                "derived": {"prefix_cache": int(cached * page_bytes)},
+            }
+
+        def _params_probe():
+            eng = ref()
+            if eng is None:
+                return None
+            import jax.tree_util as jtu
+
+            return {"arrays": jtu.tree_leaves(eng._dec._params)}
+
+        _hbm.register_owner(f"{self.census_name}.kv_pool", _pool_probe)
+        _hbm.register_owner(f"{self.census_name}.params", _params_probe)
 
     def release(self):
         """Drop the device pool (shutdown); the next prefill reallocates."""
@@ -616,8 +659,10 @@ class SlotDecoder:
                            chunk_pages, t_start, t_len, key, temperature,
                            top_k, do_sample)
 
-            return jax.jit(prefill, static_argnames=("top_k", "do_sample"),
-                           donate_argnums=(1, 2, 3, 4))
+            return self._observed(
+                jax.jit(prefill, static_argnames=("top_k", "do_sample"),
+                        donate_argnums=(1, 2, 3, 4)),
+                "prefill", donate=(1, 2, 3, 4), tokens_idx=5)
 
         def prefill(params, pk, pv, tokens, pages_row, chunk_pages,
                     t_start, t_len, key, temperature, *, top_k, do_sample):
@@ -627,8 +672,24 @@ class SlotDecoder:
                                       do_sample)
             return pk, pv, first
 
-        return jax.jit(prefill, static_argnames=("top_k", "do_sample"),
-                       donate_argnums=(1, 2))
+        return self._observed(
+            jax.jit(prefill, static_argnames=("top_k", "do_sample"),
+                    donate_argnums=(1, 2)),
+            "prefill", donate=(1, 2), tokens_idx=3)
+
+    def _observed(self, fn, kind, donate, tokens_idx=None):
+        """Compile-observatory wrapper for a program family: recompiles
+        past the first get forensics, and bucketed prefill growth (a new
+        chunk bucket seen at `tokens_idx`) is classified `new_bucket`.
+        `instrument_jit` passes `_cache_size` through, so
+        `xla_program_count` and the shardcheck pre-flight see the raw
+        jitted object's introspection surface."""
+        bucket = None
+        if tokens_idx is not None:
+            def bucket(args, kwargs, _i=tokens_idx):  # noqa: ARG001
+                return int(args[_i].shape[1])
+        return _compiles.instrument_jit(
+            fn, f"{self.census_name}.{kind}", bucket=bucket, donate=donate)
 
     def prefill_chunk_step(self, slot, chunk_tokens, t_start, key,
                            temperature=1.0):
@@ -797,8 +858,10 @@ class SlotDecoder:
                 return run(params, pk, pv, sk, sv, table, last_tok, pos,
                            active, key, temperature, top_k, do_sample)
 
-            return jax.jit(decode, static_argnames=("top_k", "do_sample"),
-                           donate_argnums=(1, 2, 3, 4))
+            return self._observed(
+                jax.jit(decode, static_argnames=("top_k", "do_sample"),
+                        donate_argnums=(1, 2, 3, 4)),
+                "decode", donate=(1, 2, 3, 4))
 
         def decode(params, pk, pv, table, last_tok, pos, active, key,
                    temperature, *, top_k, do_sample):
@@ -807,8 +870,10 @@ class SlotDecoder:
                                     temperature, top_k, do_sample)
             return pk, pv, nxt
 
-        return jax.jit(decode, static_argnames=("top_k", "do_sample"),
-                       donate_argnums=(1, 2))
+        return self._observed(
+            jax.jit(decode, static_argnames=("top_k", "do_sample"),
+                    donate_argnums=(1, 2)),
+            "decode", donate=(1, 2))
 
     def decode_step(self, last_tok, pos, active, key, temperature):
         """One decode step for every DECODE-ACTIVE slot. `last_tok` /
@@ -933,6 +998,24 @@ class SlotDecoder:
             mesh=mesh, donate_argnums=donate, hbm_budget_gb=hbm_budget_gb,
             hot_path=True, name="SlotDecoder.decode")
         return {"prefill": prefill, "decode": decode}
+
+    def hbm_crosscheck(self, mesh=None):
+        """Runtime-vs-static HBM accounting: compare the live-buffer
+        census bytes attributed to THIS engine (KV pool + params owners)
+        against shardcheck's SC006 per-device estimate for the decode
+        program. The two are independent derivations — census sweeps
+        ``jax.live_arrays()``, SC006 sums abstract avals — so agreement
+        (the acceptance gate asks within 15%) validates both. Returns
+        ``{"census_bytes", "sc006_bytes", "ratio", "owners"}``."""
+        report = self.shardcheck_report(mesh=mesh)
+        sc006 = int(report["decode"].per_device_bytes)
+        c = _hbm.census(top_k=0)
+        mine = {k: v for k, v in c["owners"].items()
+                if k.startswith(f"{self.census_name}.")}
+        total = sum(mine.values())
+        return {"census_bytes": total, "sc006_bytes": sc006,
+                "ratio": (total / sc006) if sc006 else None,
+                "owners": mine}
 
 
 def _occupancy_probe(allocator):

@@ -145,6 +145,10 @@ class ModelRegistry:
                     kw["n_pages"] = max(
                         4, int(self.total_pages * share / total_share))
                 slots = SlotDecoder(block, **kw)
+            # compile-ledger families and HBM-census owners carry the
+            # tenant name (serve:<model>.prefill, serve:<model>.kv_pool…)
+            if hasattr(slots, "census_name"):
+                slots.census_name = f"serve:{name}"
             sched = Scheduler(slots, max_queue=max_queue, policy=policy,
                               default_deadline=default_deadline,
                               eos_id=eos_id, seed=seed + i)
@@ -503,7 +507,10 @@ class Gateway:
             with self._lock:
                 return self._step()
         except Exception as e:
-            tracing.maybe_flight_dump("gateway_step", e)
+            from ..telemetry import hbm
+
+            if hbm.maybe_oom_postmortem("gateway_step", e) is None:
+                tracing.maybe_flight_dump("gateway_step", e)
             raise
 
     def _step(self):

@@ -84,7 +84,9 @@ class Executor:
                 outs = sym._eval(env)
             return tuple(o._data for o in outs)
 
-        fn = jax.jit(run)
+        from ..telemetry.compiles import ledgered_jit
+
+        fn = ledgered_jit(run, family="symbol.executor.fwd")
         self._jit[(train, "fwd")] = fn
         return fn
 
@@ -118,7 +120,9 @@ class Executor:
             (grads,) = vjp(cot)
             return grads
 
-        fn = jax.jit(run)
+        from ..telemetry.compiles import ledgered_jit
+
+        fn = ledgered_jit(run, family="symbol.executor.bwd")
         self._jit[(train, "bwd")] = fn
         return fn
 

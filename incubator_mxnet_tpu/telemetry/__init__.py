@@ -24,7 +24,13 @@ Six connected parts:
 - `monitor`   — reference-parity `Monitor` (per-tensor health stats,
   batched host sync), `install_nan_hook()` non-finite guard (eager +
   compiled via jax.debug.callback), per-rank aggregation at kvstore sync
-  points, pluggable health checks, and the estimator `TelemetryHandler`.
+  points, pluggable health checks, and the estimator `TelemetryHandler`;
+- `compiles`  — per-program XLA compile ledger (cost/memory analysis,
+  HLO fingerprints) with recompile forensics naming the offending
+  argument (``mx_jit_recompiles_total{program=,cause=}``);
+- `hbm`       — subsystem-attributed live-buffer census over
+  ``jax.live_arrays()``, growth watchdog (``MXNET_MEMWATCH_INTERVAL``),
+  and the RESOURCE_EXHAUSTED post-mortem (``MXNET_OOM_POSTMORTEM``).
 
 Env knobs (registered in `util._ENV_KNOBS`): ``MXNET_TELEMETRY``
 (``1`` = stage + span tracing on, ``raise`` = + NaN guard raising at the
@@ -41,6 +47,8 @@ from . import stages  # noqa: F401
 from . import tracing  # noqa: F401
 from . import slo  # noqa: F401
 from . import monitor  # noqa: F401
+from . import compiles  # noqa: F401
+from . import hbm  # noqa: F401
 from .monitor import Monitor, install_nan_hook  # noqa: F401
 
 # arm the host->device byte inlet (a counter inc per transfer — rare
@@ -50,4 +58,4 @@ from ..ndarray import ndarray as _nd_mod
 _nd_mod._H2D_HOOK = registry.add_h2d_bytes
 
 __all__ = ["registry", "stages", "tracing", "slo", "roofline", "monitor",
-           "Monitor", "install_nan_hook"]
+           "compiles", "hbm", "Monitor", "install_nan_hook"]

@@ -1,0 +1,356 @@
+"""HBM observatory: subsystem-attributed live-buffer census, growth
+watchdog, and OOM post-mortem.
+
+Reference role: shardcheck's SC006 gives a *static* per-device byte
+estimate; this module is its runtime counterpart — who actually owns
+device memory right now. Subsystems register **owners** (serve KV pool,
+prefix cache, params, optimizer state, ...) as weakly-bound probes; a
+:func:`census` sweeps ``jax.live_arrays()`` and attributes every buffer to
+the first owner claiming it, leaving the rest as ``unattributed``. The
+census is exposed three ways:
+
+- pull gauges ``mx_hbm_live_bytes_total`` / ``mx_hbm_live_bytes{owner=}``
+  / ``mx_hbm_unattributed_bytes`` (collector — swept at report time only);
+- the :func:`census` report dict (also `tools/memwatch.py`);
+- a flight-recorder context probe, so EVERY crash dump carries the
+  memory map at crash time.
+
+**Growth watchdog**: :func:`watchdog_observe` tracks unattributed bytes
+across steps and warns (log + ``mx_hbm_watchdog_warnings_total`` +
+trace event) on sustained growth over the window — the leak signature a
+page-budgeted serving host cares about. ``MXNET_MEMWATCH_INTERVAL=<sec>``
+arms a daemon thread that observes on a timer.
+
+**OOM post-mortem**: :func:`maybe_oom_postmortem` is threaded through the
+dispatch/serve/estimator failure seams; on a RESOURCE_EXHAUSTED it dumps
+census + top-K buffers + the compile ledger through the flight recorder
+(the census/ledger context probes registered here and in `compiles.py`).
+Armed by ``MXNET_TELEMETRY`` or standalone via ``MXNET_OOM_POSTMORTEM=1``.
+
+Off-path contract: owner registration is a dict write; nothing sweeps
+``jax.live_arrays()`` unless a census is actually requested (report pull,
+watchdog tick, crash dump, or explicit call).
+"""
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+
+from . import registry, tracing
+
+__all__ = ["enable", "disable", "is_enabled", "reset", "register_owner",
+           "unregister_owner", "census", "watchdog_observe",
+           "arm_memwatch", "disarm_memwatch", "is_resource_exhausted",
+           "maybe_oom_postmortem"]
+
+logger = logging.getLogger("incubator_mxnet_tpu.telemetry.hbm")
+
+_ENABLED = False
+_LOCK = threading.Lock()
+_OWNERS: dict = {}            # name -> probe() (registration order wins ties)
+
+# growth watchdog state
+_WD_WINDOW = 5                # default N sustained-growth steps
+_WD_MIN_GROWTH = 1 << 20      # ignore jitter below 1 MiB over the window
+_WD_SAMPLES: list = []        # (unattributed bytes) ring, newest last
+_WD_WARNED_STREAK = False
+_MEMWATCH_THREAD = None
+_MEMWATCH_STOP = None
+
+
+def _arm_dispatch_hook(on):
+    """The one per-op-adjacent seam (ndarray's eager-fallback except
+    path) uses the module-global-None dead-branch discipline."""
+    import sys
+
+    nd = sys.modules.get("incubator_mxnet_tpu.ndarray.ndarray")
+    if nd is not None:
+        nd._OOM_HOOK = maybe_oom_postmortem if on else None
+
+
+def enable():
+    global _ENABLED
+    _ENABLED = True
+    _arm_dispatch_hook(True)
+
+
+def disable():
+    global _ENABLED
+    _ENABLED = False
+    _arm_dispatch_hook(False)
+
+
+def is_enabled():
+    return _ENABLED
+
+
+def reset():
+    """Drop owners and watchdog state (tests). Leaves arming alone."""
+    global _WD_WARNED_STREAK
+    with _LOCK:
+        _OWNERS.clear()
+        del _WD_SAMPLES[:]
+        _WD_WARNED_STREAK = False
+
+
+# --------------------------------------------------------------------------
+# owners + census
+# --------------------------------------------------------------------------
+
+def register_owner(name, probe):
+    """Register a subsystem as a buffer owner. ``probe()`` returns the
+    jax arrays it currently owns — either an iterable, or a dict
+    ``{"arrays": [...], "detail": {...}, "derived": {sub: bytes}}`` where
+    `detail` is free-form context for the census report and `derived`
+    attributes byte counts WITHIN the owner's arrays (e.g. the prefix
+    cache's share of the KV pool pages) without double-counting them
+    against the live sweep. Probes follow the weakly-bound-source idiom:
+    return None once the subsystem is gone (the owner is then skipped).
+    Re-registering a name replaces the probe."""
+    with _LOCK:
+        _OWNERS[str(name)] = probe
+
+
+def unregister_owner(name):
+    with _LOCK:
+        _OWNERS.pop(str(name), None)
+
+
+def _nbytes(a):
+    n = getattr(a, "nbytes", None)
+    if n is None:
+        return 0
+    return int(n)
+
+
+def census(top_k=8):
+    """Sweep ``jax.live_arrays()`` and attribute every buffer to a
+    registered owner (first claim wins). Returns::
+
+        {"total": bytes, "n_arrays": int,
+         "owners": {name: bytes}, "derived": {name.sub: bytes},
+         "detail": {name: {...}},  # owner-provided context
+         "unattributed": bytes,
+         "top": [{"bytes", "shape", "dtype", "owner"}, ...],  # largest K
+         "ts": unix time}
+
+    This is the runtime counterpart of shardcheck's SC006 static
+    estimate; `SlotDecoder.hbm_crosscheck()` compares the two."""
+    import jax
+
+    with _LOCK:
+        owners = list(_OWNERS.items())
+    claim: dict = {}              # id(array) -> owner name
+    owner_bytes: dict = {}
+    derived: dict = {}
+    detail: dict = {}
+    for name, probe in owners:
+        try:
+            got = probe()
+        except Exception:
+            got = None
+        if got is None:
+            continue
+        if isinstance(got, dict):
+            arrays = got.get("arrays") or ()
+            if got.get("detail"):
+                detail[name] = got["detail"]
+            for sub, b in (got.get("derived") or {}).items():
+                derived[f"{name}.{sub}"] = int(b)
+        else:
+            arrays = got
+        owner_bytes.setdefault(name, 0)
+        for a in arrays:
+            if a is not None and id(a) not in claim:
+                claim[id(a)] = name
+    total = 0
+    n = 0
+    tops = []
+    try:
+        live = jax.live_arrays()
+    except Exception:
+        live = []
+    for a in live:
+        b = _nbytes(a)
+        total += b
+        n += 1
+        who = claim.get(id(a))
+        if who is not None:
+            owner_bytes[who] = owner_bytes.get(who, 0) + b
+        if top_k:
+            tops.append((b, a, who))
+    attributed = sum(owner_bytes.values())
+    report = {
+        "total": total,
+        "n_arrays": n,
+        "owners": owner_bytes,
+        "derived": derived,
+        "detail": detail,
+        "unattributed": max(0, total - attributed),
+        "ts": time.time(),
+    }
+    if top_k:
+        tops.sort(key=lambda t: -t[0])
+        report["top"] = [
+            {"bytes": b, "shape": tuple(getattr(a, "shape", ())),
+             "dtype": str(getattr(a, "dtype", "?")),
+             "owner": who or "unattributed"}
+            for b, a, who in tops[:int(top_k)]]
+    return report
+
+
+def _collector():
+    """Registry pull collector: the census as gauges, swept only at
+    report()/exposition() time and only while armed."""
+    if not _ENABLED:
+        return {}
+    try:
+        c = census(top_k=0)
+    except Exception:
+        return {}
+    out = {
+        "mx_hbm_live_bytes_total": c["total"],
+        "mx_hbm_live_arrays": c["n_arrays"],
+        "mx_hbm_unattributed_bytes": c["unattributed"],
+    }
+    for name, b in c["owners"].items():
+        out[f'mx_hbm_live_bytes{{owner="{name}"}}'] = b
+    return out
+
+
+def _flight_probe():
+    """Flight-recorder context: census + top buffers in every crash dump
+    (the OOM post-mortem payload). Swept at dump time regardless of
+    arming — a crash dump should always carry the memory map."""
+    try:
+        return census(top_k=8)
+    except Exception:
+        return None
+
+
+# --------------------------------------------------------------------------
+# growth watchdog
+# --------------------------------------------------------------------------
+
+def watchdog_observe(window=None, min_growth=None):
+    """Record one unattributed-bytes sample; warn when every step across
+    the window grew and the total growth clears `min_growth` (default
+    1 MiB over 5 samples). One warning per streak — the streak re-arms
+    when growth pauses. Returns True when this observation warned."""
+    global _WD_WARNED_STREAK
+    window = int(window or _WD_WINDOW)
+    floor = int(_WD_MIN_GROWTH if min_growth is None else min_growth)
+    try:
+        c = census(top_k=0)
+    except Exception:
+        return False
+    with _LOCK:
+        _WD_SAMPLES.append(c["unattributed"])
+        del _WD_SAMPLES[:max(0, len(_WD_SAMPLES) - window)]
+        samples = list(_WD_SAMPLES)
+    if len(samples) < window:
+        return False
+    growing = all(b > a for a, b in zip(samples, samples[1:]))
+    if not growing:
+        _WD_WARNED_STREAK = False
+        return False
+    if samples[-1] - samples[0] < floor or _WD_WARNED_STREAK:
+        return False
+    _WD_WARNED_STREAK = True
+    mb = (samples[-1] - samples[0]) / 2**20
+    logger.warning(
+        "HBM watchdog: unattributed live bytes grew %d steps in a row "
+        "(+%.1f MiB, now %.1f MiB) — possible leak outside registered "
+        "owners; run mx.telemetry.hbm.census() or tools/memwatch.py",
+        window, mb, samples[-1] / 2**20)
+    registry.counter("mx_hbm_watchdog_warnings_total",
+                     "sustained unattributed HBM growth warnings").inc()
+    tracing.event("hbm.growth", steps=window, grew_bytes=int(mb * 2**20),
+                  unattributed=samples[-1])
+    return True
+
+
+def arm_memwatch(interval_s):
+    """Start (or replace) the daemon sampling thread behind
+    ``MXNET_MEMWATCH_INTERVAL`` — one watchdog observation every
+    `interval_s` seconds. Returns the thread."""
+    global _MEMWATCH_THREAD, _MEMWATCH_STOP
+    disarm_memwatch()
+    stop = threading.Event()
+
+    def _loop():
+        while not stop.wait(float(interval_s)):
+            try:
+                watchdog_observe()
+            except Exception as e:  # noqa: FL006 — a broken owner probe
+                # must not kill the watchdog timer thread; surface once
+                # per tick at debug so a bad probe is still discoverable
+                logger.debug("memwatch tick failed: %s", e)
+
+    t = threading.Thread(target=_loop, name="mx-memwatch", daemon=True)
+    _MEMWATCH_STOP = stop
+    _MEMWATCH_THREAD = t
+    t.start()
+    return t
+
+
+def disarm_memwatch():
+    global _MEMWATCH_THREAD, _MEMWATCH_STOP
+    if _MEMWATCH_STOP is not None:
+        _MEMWATCH_STOP.set()
+    _MEMWATCH_THREAD = None
+    _MEMWATCH_STOP = None
+
+
+# --------------------------------------------------------------------------
+# OOM post-mortem
+# --------------------------------------------------------------------------
+
+def is_resource_exhausted(exc):
+    """True for XLA RESOURCE_EXHAUSTED / out-of-memory shaped failures
+    (matched on type name + message — the runtime's error classes aren't
+    importable on every backend)."""
+    if exc is None:
+        return False
+    try:
+        s = f"{type(exc).__name__}: {exc}"
+    except Exception:
+        return False
+    return ("RESOURCE_EXHAUSTED" in s or "Out of memory" in s
+            or "out of memory" in s)
+
+
+def _postmortem_armed():
+    v = os.environ.get("MXNET_OOM_POSTMORTEM")
+    if v is not None:
+        return v.strip().lower() not in ("", "0", "false", "off", "no")
+    return _ENABLED
+
+
+def maybe_oom_postmortem(where, exc):
+    """Failure-seam hook (dispatch / serve / estimator): when `exc` is
+    RESOURCE_EXHAUSTED-shaped and the post-mortem is armed, dump the
+    flight recorder — the census and compile-ledger context probes put
+    the memory map and program history in the payload. Returns the dump
+    path (None when not an OOM, disarmed, or the dump itself failed —
+    a broken post-mortem must never mask the OOM)."""
+    if not is_resource_exhausted(exc) or not _postmortem_armed():
+        return None
+    try:
+        registry.counter("mx_oom_postmortems_total",
+                         "RESOURCE_EXHAUSTED post-mortem flight dumps").inc()
+        registry.counter("mx_oom_postmortems_total",
+                         "RESOURCE_EXHAUSTED post-mortem flight dumps",
+                         labels={"where": str(where)}).inc()
+        return tracing.flight_dump(f"oom_{where}", exc=exc)
+    except Exception:
+        return None
+
+
+# census gauges + crash-dump context ride along from import: collectors
+# are pull-only (dead until a report is actually read) and the flight
+# probe only runs at dump time
+registry.register_collector(_collector)
+tracing.register_flight_context("hbm_census", _flight_probe)

@@ -88,6 +88,24 @@ def _event_bytes(e):
     return None
 
 
+_WARNED_DEVICES: set = set()
+
+
+def _warn_unknown_device(device):
+    """One warning per unknown device name: peak_fraction silently
+    missing from every row looks like a data bug, not a lookup miss."""
+    key = device.lower()
+    if key in _WARNED_DEVICES:
+        return
+    _WARNED_DEVICES.add(key)
+    import logging
+
+    logging.getLogger("incubator_mxnet_tpu.telemetry.roofline").warning(
+        "roofline: no PEAK_HBM_GBS entry for device %r — peak_fraction "
+        "will be omitted; known devices: %s (pass peak_gbs= explicitly "
+        "to override)", device, ", ".join(sorted(PEAK_HBM_GBS)))
+
+
 def _classify(name, compiled_phases):
     low = name.lower()
     for phase, rx in compiled_phases:
@@ -111,6 +129,8 @@ def analyze(trace_events, mem_analysis=None, phases=None, peak_gbs=None,
     everything)."""
     if peak_gbs is None and device is not None:
         peak_gbs = PEAK_HBM_GBS.get(str(device).lower())
+        if peak_gbs is None:
+            _warn_unknown_device(str(device))
     compiled = [(p, re.compile(rx)) for p, rx in (phases or DEFAULT_PHASES)]
     rx_excl = re.compile(exclude) if exclude else None
     lane_pids = _device_lane_pids(trace_events)

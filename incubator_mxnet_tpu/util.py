@@ -203,13 +203,25 @@ _ENV_KNOBS = {
         "atomic Prometheus exposition() snapshots to a textfile for "
         "node-exporter scraping, refreshed every interval_s when given "
         "(honored, this build's addition — see TELEMETRY.md)"),
+    "MXNET_MEMWATCH_INTERVAL": (
+        "telemetry.hbm.arm_memwatch", "seconds between HBM growth-"
+        "watchdog census samples (daemon thread); warns on sustained "
+        "unattributed live-buffer growth; 0/unset = no sampler "
+        "(honored, this build's addition — see TELEMETRY.md)"),
+    "MXNET_OOM_POSTMORTEM": (
+        "telemetry.hbm.maybe_oom_postmortem", "1 = flight-dump census + "
+        "top buffers + compile ledger when RESOURCE_EXHAUSTED crosses a "
+        "dispatch/serve/estimator seam, even with MXNET_TELEMETRY off; "
+        "0 = force off; unset = follows MXNET_TELEMETRY (honored, this "
+        "build's addition — see TELEMETRY.md)"),
     "MXNET_FLIGHTREC_DIR": (
         "telemetry.tracing.flight_dump", "directory for crash "
         "flight-recorder dumps (default: benchmark/ when present, else "
         "cwd) (honored, this build's addition)"),
     "MXNET_FAULT_INJECT": (
         "fault.injection", "seeded chaos schedule 'seam:prob[:seed"
-        "[:limit]],...' armed at import (incl. spawned DataLoader "
+        "[:limit[:kind]]],...' (kind: fault | oom) armed at import "
+        "(incl. spawned DataLoader "
         "workers); unset = every probe a dead branch (honored, this "
         "build's addition — see RESILIENCE.md)"),
     "MXNET_RETRY_MAX": (
@@ -355,14 +367,33 @@ def _apply_env_config():
             pass
     telem = os.environ.get("MXNET_TELEMETRY", "0")
     if telem and telem != "0":
-        from .telemetry import monitor, stages, tracing
+        from .telemetry import compiles, hbm, monitor, stages, tracing
 
         stages.enable()
         tracing.enable()
+        compiles.enable()       # per-program compile ledger + forensics
+        hbm.enable()            # live-buffer census gauges + OOM seams
         if telem == "raise":
             monitor.install_nan_hook(mode="raise")
         elif telem == "warn":
             monitor.install_nan_hook(mode="warn")
+    watch = os.environ.get("MXNET_MEMWATCH_INTERVAL")
+    if watch:
+        try:
+            interval = float(watch)
+        except ValueError:
+            interval = 0.0
+        if interval > 0:
+            from .telemetry import hbm as _hbm
+
+            _hbm.arm_memwatch(interval)
+    if os.environ.get("MXNET_OOM_POSTMORTEM", "0") not in ("0", ""):
+        # standalone arming (post-mortem without the rest of telemetry):
+        # install the dispatch-seam hook; the serve/estimator seams read
+        # the knob at exception time
+        from .telemetry import hbm as _hbm2
+
+        _hbm2._arm_dispatch_hook(True)
     dump_spec = os.environ.get("MXNET_TELEMETRY_DUMP")
     if dump_spec:
         from .telemetry import registry as _telem_registry

@@ -115,6 +115,14 @@ _QUICK = {
     "test_gateway.py::test_tenant_quota_defers_never_drops",
     "test_gateway.py::test_gateway_step_fault_seam",
     "test_tools.py::test_fl011_tree_is_clean",
+    # compile & HBM observatory (ISSUE 10 gates): recompile forensics
+    # on a tiny jit, census attribution (host-side sweep), the FL012
+    # observatory-coverage tree sweep, and the bench trajectory gate on
+    # the committed BENCH_r*.json history
+    "test_telemetry_observatory.py::test_recompile_cause_shape",
+    "test_telemetry_observatory.py::test_census_attribution_first_claim_and_weak_binding",
+    "test_tools.py::test_fl012_tree_is_clean",
+    "test_tools.py::test_bench_regress_green_on_committed_history",
 }
 
 

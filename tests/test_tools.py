@@ -1,5 +1,6 @@
 """Tools tests: parse_log, bandwidth measure (reference model: the tools/
 utilities shipped alongside the framework)."""
+import json
 import os
 import subprocess
 import sys
@@ -441,3 +442,131 @@ def test_run_metadata_stamps_sha_and_round():
     finally:
         if old is not None:
             os.environ["MXNET_RUN_ROUND"] = old
+
+
+# ---------------------------------------------------------------------------
+# FL012 — compile-observatory coverage (ISSUE 10)
+# ---------------------------------------------------------------------------
+
+_OPS_PATH = "incubator_mxnet_tpu/ops/linalg.py"
+
+
+def test_fl012_flags_raw_jit_outside_entry_points():
+    src = ("import jax\n"
+           "f = jax.jit(lambda x: x + 1)\n"
+           "g = jit(lambda x: x * 2)\n")
+    hits = [f for f in _lint(src, _OPS_PATH) if f.rule == "FL012"]
+    assert len(hits) == 2
+    assert all("ledger" in f.message for f in hits)
+
+
+def test_fl012_accepts_entry_points_noqa_and_outside_tree():
+    src = "import jax\nf = jax.jit(lambda x: x + 1)\n"
+    # every registered observatory entry point is exempt
+    for ep in ("incubator_mxnet_tpu/ndarray/ndarray.py",
+               "incubator_mxnet_tpu/gluon/block.py",
+               "incubator_mxnet_tpu/serve/engine.py",
+               "incubator_mxnet_tpu/parallel/sharded.py",
+               "incubator_mxnet_tpu/telemetry/compiles.py"):
+        assert not [f for f in _lint(src, ep) if f.rule == "FL012"], ep
+    # the noqa escape carries a justification
+    noqa = ("import jax\n"
+            "f = jax.jit(fn)  # noqa: FL012 - trace-time inner jit\n")
+    assert not [f for f in _lint(noqa, _OPS_PATH) if f.rule == "FL012"]
+    # scoped to the framework tree: tools/ and tests/ are not flagged
+    assert not [f for f in _lint(src, "tools/bench_something.py")
+                if f.rule == "FL012"]
+    # ledgered_jit is the sanctioned spelling and is not a jit call
+    ok = ("from incubator_mxnet_tpu.telemetry.compiles import ledgered_jit\n"
+          "f = ledgered_jit(lambda x: x, family='ops.f')\n")
+    assert not [f for f in _lint(ok, _OPS_PATH) if f.rule == "FL012"]
+
+
+def test_fl012_mirror_matches_compiles_registry():
+    """The lint's entry-point list is a mirror of
+    telemetry.compiles.OBSERVATORY_ENTRY_POINTS — drift would silently
+    widen or narrow the rule."""
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import framework_lint
+    finally:
+        sys.path.pop(0)
+    from incubator_mxnet_tpu.telemetry import compiles
+
+    assert tuple(framework_lint._OBSERVATORY_ENTRY_POINTS) \
+        == tuple(compiles.OBSERVATORY_ENTRY_POINTS)
+
+
+def test_fl012_tree_is_clean():
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import framework_lint
+    finally:
+        sys.path.pop(0)
+    findings = [f for f in framework_lint.lint_paths(
+        [os.path.join(REPO, "incubator_mxnet_tpu")])
+        if f.rule == "FL012"]
+    assert not findings, findings
+
+
+# ---------------------------------------------------------------------------
+# bench_regress — trajectory regression gate (ISSUE 10)
+# ---------------------------------------------------------------------------
+
+def _bench_regress():
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import bench_regress
+    finally:
+        sys.path.pop(0)
+    return bench_regress
+
+
+def test_bench_regress_green_on_committed_history(capsys):
+    br = _bench_regress()
+    assert br.main([]) == 0
+    out = capsys.readouterr().out
+    assert "clean" in out and "resnet50_train_img_s_per_chip" in out
+
+
+def test_bench_regress_catches_both_polarities(tmp_path):
+    br = _bench_regress()
+    a = tmp_path / "BENCH_r01.json"
+    b = tmp_path / "BENCH_r02.json"
+    a.write_text(json.dumps({"n": 1, "parsed": {
+        "metric": "tput_img_s", "value": 1000.0,
+        "extras": {"step_latency_ms": 2.0, "mfu": 0.5}}}))
+    # throughput -20% AND latency +50%: both directions must gate
+    b.write_text(json.dumps({"n": 2, "parsed": {
+        "metric": "tput_img_s", "value": 800.0,
+        "extras": {"step_latency_ms": 3.0, "mfu": 0.5}}}))
+    assert br.main(["--root", str(tmp_path)]) == 1
+    rows = br.compare(br.flatten(json.loads(a.read_text())["parsed"]),
+                      br.flatten(json.loads(b.read_text())["parsed"]))
+    status = {r["metric"]: r["status"] for r in rows}
+    assert status["tput_img_s"] == "REGRESS"
+    assert status["step_latency_ms"] == "REGRESS"
+    assert status["mfu"] == "ok"
+    # within threshold is clean
+    b.write_text(json.dumps({"n": 2, "parsed": {
+        "metric": "tput_img_s", "value": 950.0,
+        "extras": {"step_latency_ms": 2.1, "mfu": 0.51}}}))
+    assert br.main(["--root", str(tmp_path)]) == 0
+
+
+def test_bench_regress_direction_and_edge_cases(tmp_path):
+    br = _bench_regress()
+    # direction heuristic: _ms/latency lower-better, _vs_ report-only
+    assert br.direction("decode_latency_us") == "lower"
+    assert br.direction("dot_framework_ms") == "lower"
+    assert br.direction("bert_base_train_tokens_s") == "higher"
+    assert br.direction("resnet50_int8_vs_fp32_wall") is None
+    assert br.direction("vs_baseline") == "higher"
+    # <2 rounds: nothing to compare, clean exit
+    (tmp_path / "BENCH_r01.json").write_text(json.dumps(
+        {"n": 1, "parsed": {"metric": "m", "value": 1.0}}))
+    assert br.main(["--root", str(tmp_path)]) == 0
+    # empty dir: usage error
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    assert br.main(["--root", str(empty)]) == 2

@@ -1,0 +1,201 @@
+"""Bench-trajectory regression gate over the committed ``BENCH_r*.json``
+history.
+
+Each bench round commits one ``BENCH_rNN.json`` at the repo root with a
+``parsed`` block: the headline metric (``metric``/``value``/``unit``/
+``vs_baseline``) plus an ``extras`` map of named float series. This tool
+loads the trajectory in round order, compares the LATEST round against
+the PREVIOUS one per metric, and exits nonzero when any gated metric
+moved the wrong way by more than the threshold (default 10%).
+
+Direction per metric is inferred from the name:
+
+- lower-is-better: name ends with ``_ms`` or contains ``latency``
+  (wall/device times);
+- report-only (never gated): name contains ``_vs_`` — those ratios mix
+  both polarities in the committed history (``resnet50_int8_vs_fp32_wall``
+  is a speedup, ``dot_framework_vs_rawjax`` an overhead), so a wrong
+  guess would gate backwards;
+- higher-is-better: everything else (throughputs, MFU, ``vs_baseline``).
+
+Known-noisy skip-list: the absolute sub-3ms wall-clock microbenchmarks
+(``dot_framework_ms``, ``dot_rawjax_ms``, ``dispatch_floor_ms``) are
+reported but NOT gated by default — rounds run on whatever shared CPU
+runner the session got, and the committed history shows the raw-jax
+CONTROL series moving >15% round-over-round, i.e. cross-round machine
+variance exceeds any real signal at that scale. The meaningful committed
+series for dispatch overhead is the ratio ``dot_framework_vs_rawjax``.
+Override with ``--skip REGEX`` (empty string gates everything).
+
+Usage::
+
+    python tools/bench_regress.py [--threshold 10] [--skip REGEX]
+                                  [--root DIR | FILES...]
+
+Exit status: 0 clean (or nothing to compare), 1 regression(s), 2 bad
+invocation / unreadable history.
+"""
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# absolute wall-time microbenchmarks whose cross-round noise (different
+# shared runners per round) drowns the signal — see module docstring
+DEFAULT_SKIP = r"^(dot_framework_ms|dot_rawjax_ms|dispatch_floor_ms)$"
+
+
+def load_history(paths):
+    """[(round_n, path, parsed_dict)] sorted by round number; rounds
+    without a ``parsed`` block (crashed bench runs) are dropped."""
+    rounds = []
+    for p in paths:
+        with open(p, encoding="utf-8") as f:
+            d = json.load(f)
+        parsed = d.get("parsed")
+        if not isinstance(parsed, dict):
+            continue
+        n = d.get("n")
+        if n is None:
+            m = re.search(r"r(\d+)", os.path.basename(p))
+            n = int(m.group(1)) if m else 0
+        rounds.append((int(n), p, parsed))
+    rounds.sort(key=lambda t: t[0])
+    return rounds
+
+
+def flatten(parsed):
+    """One flat {metric_name: float} map: the headline metric, its
+    vs_baseline series, and every extras entry."""
+    out = {}
+    name, value = parsed.get("metric"), parsed.get("value")
+    if name and isinstance(value, (int, float)):
+        out[str(name)] = float(value)
+    vs = parsed.get("vs_baseline")
+    if isinstance(vs, (int, float)):
+        out["vs_baseline"] = float(vs)
+    for k, v in (parsed.get("extras") or {}).items():
+        if isinstance(v, (int, float)):
+            out[str(k)] = float(v)
+    return out
+
+
+def direction(metric):
+    """'lower' | 'higher' | None (None = report-only, never gated)."""
+    if metric != "vs_baseline" and "_vs_" in metric:
+        return None
+    if metric.endswith("_ms") or "latency" in metric:
+        return "lower"
+    return "higher"
+
+
+def compare(prev, latest, threshold_pct=10.0, skip_rx=DEFAULT_SKIP):
+    """Rows comparing two flat metric maps. Each row:
+    {metric, prev, latest, delta_pct, direction, status} with status in
+    ok | improved | REGRESS | noisy-skip | report-only | new | gone."""
+    skip = re.compile(skip_rx) if skip_rx else None
+    rows = []
+    for m in sorted(set(prev) | set(latest)):
+        if m not in latest:
+            rows.append({"metric": m, "prev": prev[m], "latest": None,
+                         "delta_pct": None, "direction": direction(m),
+                         "status": "gone"})
+            continue
+        if m not in prev:
+            rows.append({"metric": m, "prev": None, "latest": latest[m],
+                         "delta_pct": None, "direction": direction(m),
+                         "status": "new"})
+            continue
+        p, l = prev[m], latest[m]
+        delta = ((l - p) / abs(p) * 100.0) if p else 0.0
+        d = direction(m)
+        if d is None:
+            status = "report-only"
+        elif skip is not None and skip.search(m):
+            status = "noisy-skip"
+        else:
+            worse = delta < -threshold_pct if d == "higher" \
+                else delta > threshold_pct
+            better = delta > threshold_pct if d == "higher" \
+                else delta < -threshold_pct
+            status = "REGRESS" if worse else (
+                "improved" if better else "ok")
+        rows.append({"metric": m, "prev": p, "latest": l,
+                     "delta_pct": delta, "direction": d, "status": status})
+    return rows
+
+
+def _fmt(v):
+    if v is None:
+        return "-"
+    return f"{v:,.4g}" if abs(v) < 100 else f"{v:,.1f}"
+
+
+def format_table(rows, prev_n, latest_n):
+    w = max([len(r["metric"]) for r in rows] + [6])
+    lines = [f"{'metric':<{w}}  {f'r{prev_n:02d}':>12}  "
+             f"{f'r{latest_n:02d}':>12}  {'delta':>8}  status",
+             "-" * (w + 46)]
+    for r in rows:
+        delta = "-" if r["delta_pct"] is None else f"{r['delta_pct']:+.1f}%"
+        lines.append(f"{r['metric']:<{w}}  {_fmt(r['prev']):>12}  "
+                     f"{_fmt(r['latest']):>12}  {delta:>8}  {r['status']}")
+    return "\n".join(lines)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="gate the latest bench round against the previous one")
+    ap.add_argument("files", nargs="*",
+                    help="BENCH_r*.json files (default: glob under --root)")
+    ap.add_argument("--root", default=REPO,
+                    help="repo root to glob BENCH_r*.json from")
+    ap.add_argument("--threshold", type=float, default=10.0,
+                    help="regression threshold in percent (default 10)")
+    ap.add_argument("--skip", default=DEFAULT_SKIP,
+                    help="regex of metrics to report but not gate "
+                         "('' gates everything)")
+    args = ap.parse_args(argv)
+
+    paths = args.files or sorted(
+        glob.glob(os.path.join(args.root, "BENCH_r*.json")))
+    if not paths:
+        print("bench_regress: no BENCH_r*.json history found", file=sys.stderr)
+        return 2
+    try:
+        rounds = load_history(paths)
+    except (OSError, ValueError) as e:
+        print(f"bench_regress: unreadable history: {e}", file=sys.stderr)
+        return 2
+    if len(rounds) < 2:
+        print("bench_regress: <2 parsed rounds — nothing to compare")
+        return 0
+
+    (prev_n, _, prev_parsed), (latest_n, _, latest_parsed) = rounds[-2:]
+    rows = compare(flatten(prev_parsed), flatten(latest_parsed),
+                   threshold_pct=args.threshold, skip_rx=args.skip)
+    print(format_table(rows, prev_n, latest_n))
+    bad = [r for r in rows if r["status"] == "REGRESS"]
+    skipped = [r for r in rows if r["status"] == "noisy-skip"]
+    print()
+    if skipped:
+        print(f"not gated (noisy skip-list): "
+              f"{', '.join(r['metric'] for r in skipped)}")
+    if bad:
+        print(f"bench_regress: {len(bad)} regression(s) beyond "
+              f"{args.threshold:g}%: "
+              f"{', '.join(r['metric'] for r in bad)}")
+        return 1
+    print(f"bench_regress: clean (r{prev_n:02d} -> r{latest_n:02d}, "
+          f"threshold {args.threshold:g}%)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

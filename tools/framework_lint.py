@@ -93,6 +93,19 @@ FL011  serving-queue bounds (scoped to ``serve/`` modules): (a) an
        loud `QueueFull` admission check; a stream bounded by max_new),
        annotate the line with ``# noqa: FL011`` and the justifying
        comment.
+FL012  compile-observatory coverage (scoped to ``incubator_mxnet_tpu/``
+       modules): a direct ``jax.jit(`` / ``<alias>.jit(`` call site
+       outside the registered observatory entry points
+       (`telemetry.compiles.OBSERVATORY_ENTRY_POINTS`). Every jitted
+       program family is supposed to appear in the per-program compile
+       ledger with recompile forensics; a raw ``jax.jit`` creates a
+       family the observatory never sees, so steady-state recompiles in
+       it are invisible. Wrap the callable with ``telemetry.compiles
+       .ledgered_jit(fn, family=...)`` (or ``instrument_jit`` for an
+       existing jitted object), or — where the program genuinely cannot
+       be ledgered (trace-time inner jits, analysis tooling that
+       compiles programs about programs) — annotate the line with
+       ``# noqa: FL012`` and the justifying comment.
 
 Usage
 -----
@@ -142,6 +155,12 @@ RULES = {
              "zero-argument blocking .get()/.wait()/.join()/.acquire() "
              "(wedges the step loop) — bound it, pass a timeout, or "
              "`# noqa: FL011` with the admission-bound justification",
+    "FL012": "direct jax.jit( in an incubator_mxnet_tpu/ module outside "
+             "the registered compile-observatory entry points — the "
+             "program family silently bypasses the compile ledger and "
+             "recompile forensics; route through telemetry.compiles."
+             "ledgered_jit/instrument_jit, or `# noqa: FL012` with a "
+             "comment saying why the program can't be ledgered",
 }
 
 _INDEXING_NAME_PARTS = ("getitem", "setitem", "index", "slice")
@@ -503,6 +522,46 @@ def _check_gateway_bounds(tree, path, findings, src_lines):
                 f"zero-argument blocking `.{node.func.attr}()` in a "
                 "serve/ module waits forever if the producer dies — "
                 "pass a timeout and handle expiry loudly"))
+
+
+# ---------------------------------------------------------------------------
+# FL012 — compile-observatory coverage (incubator_mxnet_tpu/ modules)
+# ---------------------------------------------------------------------------
+
+# Mirror of telemetry.compiles.OBSERVATORY_ENTRY_POINTS (path suffixes).
+# The lint must not import the framework, so the list is duplicated here —
+# keep the two in sync (compiles.py carries the matching comment).
+_OBSERVATORY_ENTRY_POINTS = (
+    "ndarray/ndarray.py",
+    "gluon/block.py",
+    "serve/engine.py",
+    "parallel/sharded.py",
+    "telemetry/compiles.py",
+)
+
+
+def _check_observatory_coverage(tree, path, findings, src_lines):
+    norm = path.replace(os.sep, "/")
+    if "incubator_mxnet_tpu/" not in norm:
+        return
+    if norm.endswith(_OBSERVATORY_ENTRY_POINTS):
+        return
+
+    def _noqa(node):
+        last = getattr(node, "end_lineno", node.lineno)
+        span = src_lines[node.lineno - 1:last] if src_lines else []
+        return any("noqa: FL012" in ln for ln in span)
+
+    for node in ast.walk(tree):
+        if _is_jit_call(node) and not _noqa(node):
+            findings.append(LintFinding(
+                path, node.lineno, "FL012",
+                "direct `jax.jit(` outside the registered observatory "
+                "entry points: this program family bypasses the compile "
+                "ledger/recompile forensics — wrap with telemetry."
+                "compiles.ledgered_jit(fn, family=...) (or "
+                "instrument_jit), or `# noqa: FL012` with a comment "
+                "saying why it can't be ledgered"))
 
 
 # ---------------------------------------------------------------------------
@@ -883,6 +942,7 @@ def lint_source(src, path, coverage_text=None):
     _check_silent_swallow(tree, path, findings, src.splitlines())
     _check_serve_hazards(tree, path, findings)
     _check_gateway_bounds(tree, path, findings, src.splitlines())
+    _check_observatory_coverage(tree, path, findings, src.splitlines())
     _check_sharding_hygiene(tree, path, findings)
     _check_paged_hazards(tree, path, findings)
     _check_span_hygiene(tree, path, findings)

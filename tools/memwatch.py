@@ -1,0 +1,155 @@
+"""HBM census viewer: live-buffer accounting by subsystem, and the
+reader for OOM post-mortem flight-recorder dumps.
+
+Modes
+-----
+``--demo`` (default when no input is given)
+    Run a small serving workload with telemetry armed and print the live
+    census (per-owner bytes, unattributed remainder, top buffers) plus
+    the per-program compile ledger — the same two tables an OOM
+    post-mortem freezes into its dump::
+
+        python tools/memwatch.py
+
+``--postmortem FILE``
+    Render an OOM post-mortem dump (``benchmark/flightrec_oom_*.json``,
+    written by `telemetry.hbm.maybe_oom_postmortem`) — the error, the
+    frozen HBM census, and the compile ledger at crash time::
+
+        python tools/memwatch.py --postmortem benchmark/flightrec_oom_serve_step_1234.json
+
+``--watch SECONDS`` (with ``--demo``)
+    Also arm the growth watchdog at the given interval for the demo run
+    (`MXNET_MEMWATCH_INTERVAL` is the production knob; see TELEMETRY.md).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def _fmt_bytes(n):
+    if n >= 2**30:
+        return f"{n / 2**30:.2f} GiB"
+    if n >= 2**20:
+        return f"{n / 2**20:.2f} MiB"
+    if n >= 2**10:
+        return f"{n / 2**10:.1f} KiB"
+    return f"{int(n)} B"
+
+
+def format_census(census):
+    """Readable per-owner table of an `hbm.census()` dict (live or from
+    a post-mortem's ``context.hbm_census`` block)."""
+    lines = [f"live buffers: {census.get('n_arrays', 0)} arrays, "
+             f"{_fmt_bytes(census.get('total', 0))} total"]
+    owners = dict(census.get("owners") or {})
+    owners["(unattributed)"] = census.get("unattributed", 0)
+    w = max([len(k) for k in owners] + [10])
+    total = census.get("total", 0) or 1
+    for name, nbytes in sorted(owners.items(), key=lambda kv: -kv[1]):
+        lines.append(f"  {name:<{w}}  {_fmt_bytes(nbytes):>12}  "
+                     f"{nbytes / total * 100:5.1f}%")
+    derived = census.get("derived") or {}
+    for name, nbytes in sorted(derived.items(), key=lambda kv: -kv[1]):
+        lines.append(f"  {name:<{w}}  {_fmt_bytes(nbytes):>12}  (derived)")
+    top = census.get("top") or []
+    if top:
+        lines.append("top buffers:")
+        for t in top:
+            lines.append(f"  {_fmt_bytes(t['bytes']):>12}  "
+                         f"{t['dtype']}{list(t['shape'])}  "
+                         f"owner={t.get('owner') or '?'}")
+    return "\n".join(lines)
+
+
+def format_ledger(report):
+    """Readable rollup of a `compiles.ledger_report()` dict."""
+    if not report:
+        return "compile ledger: empty"
+    w = max(len(f) for f in report)
+    lines = [f"{'program':<{w}}  compiles  seconds    peak HBM  causes"]
+    for fam, row in sorted(report.items()):
+        causes = ",".join(f"{c}x{n}" for c, n in
+                          sorted(row.get("causes", {}).items())) or "-"
+        peak = row.get("peak_bytes")
+        lines.append(f"{fam:<{w}}  {row['compiles']:>8}  "
+                     f"{row['seconds']:>7.3f}  "
+                     f"{_fmt_bytes(peak) if peak else '-':>10}  {causes}")
+    return "\n".join(lines)
+
+
+def render_postmortem(path):
+    with open(path, encoding="utf-8") as f:
+        dump = json.load(f)
+    err = dump.get("error") or {}
+    print(f"post-mortem: {dump.get('reason')} (pid {dump.get('pid')})")
+    if err:
+        print(f"error: {err.get('type')}: {err.get('message')}")
+    ctx = dump.get("context") or {}
+    census = ctx.get("hbm_census")
+    print()
+    print(format_census(census) if census
+          else "no hbm_census context in dump (hbm telemetry was off)")
+    ledger = ctx.get("compile_ledger") or {}
+    print()
+    print(format_ledger(ledger.get("report") or {}))
+    return 0
+
+
+def run_demo(watch_interval=None):
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import numpy as np
+
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu.telemetry import compiles, hbm
+
+    compiles.enable()
+    hbm.enable()
+    if watch_interval:
+        hbm.arm_memwatch(watch_interval)
+
+    from incubator_mxnet_tpu.models.gpt import gpt_tiny
+    from incubator_mxnet_tpu.serve import ServeEngine
+
+    mx.random.seed(0)
+    net = gpt_tiny(vocab_size=128, max_length=64, dropout=0.0)
+    net.initialize()
+    eng = ServeEngine(net, max_slots=2, max_len=64, max_queue=8)
+    rng = np.random.default_rng(0)
+    reqs = [eng.submit(rng.integers(0, 128, size=(5 + i,))
+                       .astype(np.int32), 4) for i in range(2)]
+    while not all(r.done for r in reqs):
+        eng.step()
+    print(format_census(hbm.census()))
+    print()
+    print(format_ledger(compiles.ledger_report()))
+    if watch_interval:
+        hbm.disarm_memwatch()
+    return 0
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="HBM census viewer / OOM post-mortem reader")
+    ap.add_argument("--postmortem", metavar="FILE",
+                    help="render a flightrec_oom_*.json dump")
+    ap.add_argument("--demo", action="store_true",
+                    help="run a tiny serving workload and print the live "
+                         "census + compile ledger (default)")
+    ap.add_argument("--watch", type=float, default=None, metavar="SECONDS",
+                    help="arm the growth watchdog during --demo")
+    args = ap.parse_args(argv)
+
+    if args.postmortem:
+        return render_postmortem(args.postmortem)
+    return run_demo(watch_interval=args.watch)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
