@@ -27,8 +27,10 @@ import functools
 import logging
 import math
 
-__all__ = ["GPTDecoder", "bucket_prompt", "PROMPT_BUCKETS",
-           "chunk_buckets", "bucket_chunk"]
+import numpy as onp
+
+__all__ = ["GPTDecoder", "NgramProposer", "bucket_prompt",
+           "PROMPT_BUCKETS", "chunk_buckets", "bucket_chunk"]
 
 _LOG = logging.getLogger("incubator_mxnet_tpu.models")
 
@@ -429,3 +431,52 @@ class GPTDecoder:
             do_sample=bool(do_sample),
             cache_len=padded.shape[1] + max_new_tokens)
         return NDArray(jnp.concatenate([toks, new], axis=1))
+
+
+class NgramProposer:
+    """Model-free draft source for speculative decoding.
+
+    Proposes the ``k`` tokens that followed the most recent earlier
+    occurrence of the sequence's longest matching suffix n-gram —
+    greedy decode of small models (and structured output in general)
+    is highly repetitive, so a pure host-numpy suffix match drafts
+    useful continuations with ZERO extra device programs. When nothing
+    matches, it proposes a repeat of the last token (the cheapest
+    guess that is still sometimes right for degenerate loops).
+
+    The proposal is only ever a *hint*: the target model verifies every
+    drafted token, so a bad draft costs acceptance rate, never
+    correctness (see `serve.SlotDecoder` spec decode).
+    """
+
+    def __init__(self, k, max_ngram=3):
+        self.k = int(k)
+        if self.k < 1:
+            raise ValueError(f"k must be >= 1, got {k}")
+        self.max_ngram = int(max_ngram)
+        if self.max_ngram < 1:
+            raise ValueError(f"max_ngram must be >= 1, got {max_ngram}")
+
+    def propose(self, seq):
+        """Draft ``k`` tokens continuing 1-D token id array ``seq``
+        (prompt + everything generated so far). Returns ``(k,)`` int32
+        host numpy."""
+        seq = onp.asarray(seq, onp.int32).reshape(-1)
+        if seq.size == 0:
+            return onp.zeros(self.k, onp.int32)
+        out = onp.full(self.k, seq[-1], onp.int32)     # fallback: repeat
+        for n in range(min(self.max_ngram, seq.size - 1), 0, -1):
+            pat = seq[-n:]
+            # candidate windows strictly BEFORE the suffix itself
+            wins = onp.lib.stride_tricks.sliding_window_view(seq, n)[:-1]
+            hits = onp.flatnonzero((wins == pat).all(axis=1))
+            if hits.size == 0:
+                continue
+            i = int(hits[-1])                          # most recent match
+            cont = seq[i + n:i + n + self.k]
+            if cont.size == 0:
+                continue
+            out[:cont.size] = cont
+            out[cont.size:] = cont[-1]
+            return out
+        return out

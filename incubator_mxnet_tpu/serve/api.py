@@ -69,20 +69,31 @@ class ServeEngine:
         variation would recompile); `temperature` stays per-request.
     seed : int
         Base PRNG seed for sampled decode (greedy ignores it).
+    spec_k : int, optional
+        Speculative-decoding draft length (default
+        ``MXNET_SERVE_SPEC_K`` or 0 = off). Requires greedy decoding;
+        output stays token-for-token identical to ``spec_k=0``.
+    draft : str | Block | GPTDecoder, optional
+        Draft source when ``spec_k > 0``: ``"ngram"`` (host n-gram
+        proposer, no extra device programs — the default, also via
+        ``MXNET_SERVE_SPEC_DRAFT``) or a small model that shares the
+        target's tokenizer/vocab.
     """
 
     def __init__(self, block_or_decoder, max_slots=8, max_len=None,
                  page_tokens=None, prefill_chunk=None, n_pages=None,
                  kv_dtype=None, prefix_reuse=True, policy=None,
                  max_queue=None, deadline_s=None, eos_id=None,
-                 do_sample=False, top_k=None, temperature=1.0, seed=0):
+                 do_sample=False, top_k=None, temperature=1.0, seed=0,
+                 spec_k=None, draft=None):
         import os
 
         slots = SlotDecoder(block_or_decoder, max_slots=max_slots,
                             max_len=max_len, page_tokens=page_tokens,
                             prefill_chunk=prefill_chunk, n_pages=n_pages,
                             kv_dtype=kv_dtype, prefix_reuse=prefix_reuse,
-                            do_sample=do_sample, top_k=top_k)
+                            do_sample=do_sample, top_k=top_k,
+                            spec_k=spec_k, draft=draft)
         if policy is None:
             policy = os.environ.get("MXNET_SERVE_POLICY", "fifo")
         if max_queue is None:
@@ -132,6 +143,11 @@ class ServeEngine:
     def kv_bytes_per_slot(self):
         """Resident KV pool bytes per decode slot (0 before first use)."""
         return self._sched.slots.kv_bytes_per_slot
+
+    def spec_stats(self):
+        """Speculative-decoding counters: ``{"k", "draft", "drafted",
+        "accepted", "accept_rate"}`` (all zero when ``spec_k=0``)."""
+        return self._sched.slots.spec_stats()
 
     def xla_program_count(self):
         """Compiled XLA programs currently live (prefill buckets + the

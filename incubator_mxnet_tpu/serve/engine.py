@@ -7,11 +7,23 @@ prefill. This module replaces it with a **paged** pool, the
 vLLM/PagedAttention block-allocation idea re-expressed TPU-natively
 (static shapes, gather-by-page-table, zero steady-state recompiles):
 
-- **page pool** — ONE persistent device array per K and V of shape
-  ``(L, n_pages, H, page_tokens, d)``. Page 0 is a reserved *trash*
-  page: unallocated page-table entries and inactive-slot writes land
-  there, and its contents are never attended (the validity mask excludes
-  them before softmax).
+- **page pool** — one persistent device array per K and V **per
+  layer**: a tuple of L arrays of shape ``(n_pages, H, page_tokens,
+  d)``. Page 0 is a reserved *trash* page: unallocated page-table
+  entries and inactive-slot writes land there, and its contents are
+  never attended (the validity mask excludes them before softmax).
+  The per-layer split is load-bearing for cost, not cosmetics: with a
+  single stacked ``(L, ...)`` array threaded through a
+  ``lax.scan``-over-layers, XLA re-stacks the scan's per-layer outputs
+  into a FRESH pool buffer every step — ``memory_analysis`` temp bytes
+  ~ the whole pool, i.e. per-token cost O(L × n_pages). With per-layer
+  leaves and a Python-unrolled layer loop, every leaf aliases its
+  donated input in place (``input_output_alias`` covers all 2L pool
+  leaves) and a step's temp bytes are O(active slots × page) — the
+  compile ledger (`telemetry.compiles`) records both facts per
+  program. The layout is also the pod-sharding-friendly one: each leaf
+  can carry its own `PartitionSpec` (heads sharded, pages replicated)
+  without resharding a fused 5-D array.
 - **page table** — a host-side ``(max_slots, pages_per_slot)`` int32
   array mapping each slot's token range to pool pages (mirrored to the
   device lazily, refreshed only when allocation changes). Decode gathers
@@ -28,7 +40,7 @@ vLLM/PagedAttention block-allocation idea re-expressed TPU-natively
   lands beyond every shared page), so shared pages need no copies and no
   write-protection machinery.
 
-Two compiled program families, exactly as before:
+Two compiled program families in the base configuration:
 
 - **chunked prefill** (one program per chunk-length bucket,
   `models.decoding.chunk_buckets`): one page-aligned chunk of ONE
@@ -45,12 +57,37 @@ Two compiled program families, exactly as before:
   are redirected to the trash page), gather of each slot's view, masked
   attention, per-slot sampling.
 
-Both donate the pool buffers (``donate_argnums``) so XLA updates them in
-place. Optional **int8 KV** (``MXNET_SERVE_KV_DTYPE=int8``) stores the
-pool as int8 with one scale per (layer, page, head) — the symmetric
-±127 convention of `contrib.quantization` (`quantize_symmetric`) —
-halving resident KV bytes per slot; decode re-quantizes only the single
-page it writes (grow-only per-page scale).
+With **speculative decoding** armed (``spec_k > 0``), decode is
+replaced by two more families that advance up to ``k + 1`` tokens per
+round instead of one per launch:
+
+- **verify** (ONE program): the target model runs ``k + 1`` token rows
+  for ALL slots in one batched pass — row ``i`` consumes
+  ``[last, d_1..d_k][i]`` at position ``pos + i``, writes its K/V to
+  the slot's pages (beyond-budget rows are redirected to the trash
+  page) and emits the greedy next token. Because row ``i`` only
+  attends positions ``<= pos + i``, the batched pass is mathematically
+  identical to ``k + 1`` sequential decode steps — the same identity
+  chunked prefill already relies on — which is what makes greedy spec
+  decode token-for-token equal to the non-spec engine.
+- **draft** (ONE program, model drafts only): ``k`` unrolled greedy
+  decode steps of the small draft model against its OWN per-layer pool
+  (same page table and allocator, so draft pages track target pages
+  exactly). The ``draft="ngram"`` fallback drafts on the host
+  (`models.decoding.NgramProposer`) and adds NO device program.
+
+Acceptance runs on host numpy in the scheduler: the longest drafted
+prefix matching the verify row outputs commits (plus the bonus token
+from the first mismatching row), and pages speculatively extended for
+rejected suffixes roll back through `PageAllocator.decref`.
+
+All families donate the pool buffers (``donate_argnums``) so XLA
+updates them in place. Optional **int8 KV**
+(``MXNET_SERVE_KV_DTYPE=int8``) stores each layer's pool as int8 with
+one scale per (page, head) — the symmetric ±127 convention of
+`contrib.quantization` (`quantize_symmetric`) — halving resident KV
+bytes per slot; decode re-quantizes only the single page it writes
+(grow-only per-page scale).
 
 Stale-row safety (unchanged argument, now per page): position ``p`` of a
 slot only enters the attention mask once the slot's ``pos`` reaches
@@ -67,7 +104,8 @@ import weakref
 
 import numpy as onp
 
-from ..models.decoding import (GPTDecoder, bucket_chunk, chunk_buckets)
+from ..models.decoding import (GPTDecoder, NgramProposer, bucket_chunk,
+                               chunk_buckets)
 from ..telemetry import compiles as _compiles
 from ..telemetry import hbm as _hbm
 from ..telemetry import registry
@@ -352,11 +390,22 @@ class SlotDecoder:
         Arm the shared-prefix cache (default True).
     do_sample / top_k : sampling mode, STATIC per engine; `temperature`
         stays a runtime per-request argument.
+    spec_k : int
+        Speculative decoding draft length (default
+        ``MXNET_SERVE_SPEC_K`` or 0 = off). Greedy engines only
+        (``do_sample=False``): greedy verification is what makes spec
+        output token-for-token identical to plain decode.
+    draft : "ngram" | GPTDecoder | Block
+        Draft source when ``spec_k > 0`` (default
+        ``MXNET_SERVE_SPEC_DRAFT`` or ``"ngram"``): the host n-gram
+        proposer, or a small GPT whose vocabulary matches the target
+        (drafted ids index the target embedding).
     """
 
     def __init__(self, source, max_slots=8, max_len=None, page_tokens=None,
                  prefill_chunk=None, n_pages=None, kv_dtype=None,
-                 prefix_reuse=True, do_sample=False, top_k=None):
+                 prefix_reuse=True, do_sample=False, top_k=None,
+                 spec_k=None, draft=None):
         if isinstance(source, GPTDecoder):
             self._dec = source
         elif hasattr(source, "blocks") and hasattr(source, "position_embed"):
@@ -420,10 +469,59 @@ class SlotDecoder:
         self._table_dev = None
         self._table_dirty = True
 
-        self._pk = self._pv = None          # paged K/V device arrays
-        self._sk = self._sv = None          # int8 per-(L, page, H) scales
+        # per-layer paged K/V: tuples of L arrays (n_pages, H, pt, d)
+        self._pk = self._pv = None
+        self._sk = self._sv = None          # int8 per-(page, H) scales
         self._prefill_jit = None
         self._decode_jit = None
+
+        # -- speculative decoding --------------------------------------
+        sk_env = env_int("MXNET_SERVE_SPEC_K", 0)
+        self.spec_k = int(spec_k) if spec_k is not None else sk_env
+        if self.spec_k < 0:
+            raise ValueError(f"spec_k must be >= 0, got {self.spec_k}")
+        if draft is None:
+            draft = os.environ.get("MXNET_SERVE_SPEC_DRAFT", "ngram")
+        self.draft_kind = "off"
+        self._draft_dec = None
+        self._ngram = None
+        if self.spec_k:
+            if self._do_sample:
+                raise ValueError(
+                    "speculative decoding (spec_k > 0) requires greedy "
+                    "decoding (do_sample=False): greedy verification is "
+                    "what makes spec output token-for-token identical")
+            if isinstance(draft, str):
+                if draft not in ("ngram",):
+                    raise ValueError(
+                        f"unknown draft source {draft!r} "
+                        "(MXNET_SERVE_SPEC_DRAFT): expected 'ngram', a "
+                        "GPTDecoder, or a GPT-shaped Block")
+                self.draft_kind = "ngram"
+                self._ngram = NgramProposer(self.spec_k)
+            else:
+                dd = draft if isinstance(draft, GPTDecoder) \
+                    else GPTDecoder(draft)
+                if dd._max_length < self.max_len:
+                    raise ValueError(
+                        f"draft model position table ({dd._max_length}) "
+                        f"is shorter than max_len ({self.max_len})")
+                dv = dd._params["embed"].shape[0]
+                tv = self._dec._params["embed"].shape[0]
+                if dv != tv:
+                    raise ValueError(
+                        f"draft vocab ({dv}) != target vocab ({tv}) — "
+                        "drafted token ids index the target embedding")
+                self.draft_kind = "model"
+                self._draft_dec = dd
+        self._dpk = self._dpv = None        # draft-model per-layer pools
+        self._dsk = self._dsv = None
+        self._verify_jit = None
+        self._draft_jit = None
+        self._draft_prefill_jit = None
+        self._spec_drafted = 0              # lifetime drafted tokens
+        self._spec_accepted = 0             # lifetime accepted drafts
+        self._spec_gauge = False
 
         # compile-ledger / HBM-census attribution label; the gateway
         # overrides this per model BEFORE the first prefill so ledger
@@ -455,25 +553,39 @@ class SlotDecoder:
 
     # -- pool ---------------------------------------------------------------
 
+    def _make_pools(self, dec):
+        """Per-layer page pools for `dec`: TUPLES of L device arrays of
+        shape ``(n_pages, H, page_tokens, d)`` (int8 adds per-layer
+        ``(n_pages, H)`` scale planes). Separate leaves — not one
+        stacked 5-D array — so every compiled program's donation map
+        aliases each layer's pool in place; see the module docstring
+        for why the stacked layout forces an O(L × n_pages) rewrite."""
+        jnp = _j().numpy
+        layers = dec._params["layers"]
+        L = layers["ln1_g"].shape[0]
+        H = dec._n_heads
+        d = dec._units // H
+        shape = (self.n_pages, H, self.page_tokens, d)
+        if self._int8:
+            pk = tuple(jnp.zeros(shape, jnp.int8) for _ in range(L))
+            pv = tuple(jnp.zeros(shape, jnp.int8) for _ in range(L))
+            sk = tuple(jnp.zeros((self.n_pages, H), jnp.float32)
+                       for _ in range(L))
+            sv = tuple(jnp.zeros((self.n_pages, H), jnp.float32)
+                       for _ in range(L))
+            return pk, pv, sk, sv
+        dtype = layers["qkv_w"].dtype
+        pk = tuple(jnp.zeros(shape, dtype) for _ in range(L))
+        pv = tuple(jnp.zeros(shape, dtype) for _ in range(L))
+        return pk, pv, None, None
+
     def _ensure_pool(self):
         if self._pk is not None:
             return
-        jnp = _j().numpy
-        params = self._dec._params
-        layers = params["layers"]
-        L = layers["ln1_g"].shape[0]
-        H = self._dec._n_heads
-        d = self._dec._units // H
-        shape = (L, self.n_pages, H, self.page_tokens, d)
-        if self._int8:
-            self._pk = jnp.zeros(shape, jnp.int8)
-            self._pv = jnp.zeros(shape, jnp.int8)
-            self._sk = jnp.zeros((L, self.n_pages, H), jnp.float32)
-            self._sv = jnp.zeros((L, self.n_pages, H), jnp.float32)
-        else:
-            dtype = layers["qkv_w"].dtype
-            self._pk = jnp.zeros(shape, dtype)
-            self._pv = jnp.zeros(shape, dtype)
+        self._pk, self._pv, self._sk, self._sv = self._make_pools(self._dec)
+        if self._draft_dec is not None:
+            (self._dpk, self._dpv,
+             self._dsk, self._dsv) = self._make_pools(self._draft_dec)
         self._register_hbm_owners()
 
     def _register_hbm_owners(self):
@@ -488,7 +600,12 @@ class SlotDecoder:
             eng = ref()
             if eng is None or eng._pk is None:
                 return None
-            arrays = [eng._pk, eng._pv, eng._sk, eng._sv, eng._table_dev]
+            arrays = []
+            for leaves in (eng._pk, eng._pv, eng._sk, eng._sv,
+                           eng._dpk, eng._dpv, eng._dsk, eng._dsv):
+                if leaves is not None:
+                    arrays.extend(leaves)
+            arrays.append(eng._table_dev)
             page_bytes = eng.cache_bytes / eng.n_pages if eng.n_pages else 0
             cached = eng.prefix_cache.cached_pages
             return {
@@ -514,17 +631,21 @@ class SlotDecoder:
     def release(self):
         """Drop the device pool (shutdown); the next prefill reallocates."""
         self._pk = self._pv = self._sk = self._sv = None
+        self._dpk = self._dpv = self._dsk = self._dsv = None
         self._table_dev = None
         self._table_dirty = True
 
     @property
     def cache_bytes(self):
-        """Device bytes held by the persistent KV pool (0 if released)."""
+        """Device bytes held by the persistent KV pools — target and
+        (when a model draft is armed) draft — 0 if released."""
         if self._pk is None:
             return 0
-        n = 2 * self._pk.size * self._pk.dtype.itemsize
-        if self._sk is not None:
-            n += 2 * self._sk.size * self._sk.dtype.itemsize
+        n = 0
+        for leaves in (self._pk, self._pv, self._sk, self._sv,
+                       self._dpk, self._dpv, self._dsk, self._dsv):
+            if leaves is not None:
+                n += sum(a.size * a.dtype.itemsize for a in leaves)
         return n
 
     @property
@@ -550,11 +671,13 @@ class SlotDecoder:
 
     # -- chunked prefill ----------------------------------------------------
 
-    def _build_prefill(self):
+    def _build_prefill(self, dec=None, kind="prefill"):
+        """Chunked-prefill program family for `dec` (default the target;
+        the draft model gets its own family writing its own pools)."""
         jax = _j()
         jnp = jax.numpy
         lax = jax.lax
-        dec = self._dec
+        dec = self._dec if dec is None else dec
         H = dec._n_heads
         pt = self.page_tokens
         int8 = self._int8
@@ -586,12 +709,19 @@ class SlotDecoder:
             sm_scale = 1.0 / math.sqrt(dec._units // H)
             d = dec._units // H
 
-            def layer(x, packed):
-                if int8:
-                    lp, pk_l, pv_l, sk_l, sv_l = packed
-                else:
-                    lp, pk_l, pv_l = packed
-                    sk_l = sv_l = None
+            # Python-unrolled over layers: each iteration reads/writes
+            # ITS OWN donated pool leaf, so XLA's donation map aliases
+            # every leaf in place (a scan over a stacked pool re-stacks
+            # the whole pool per call — the O(L × n_pages) rewrite this
+            # layout exists to remove)
+            L = len(pk)
+            pk, pv = list(pk), list(pv)
+            sk = list(sk) if int8 else [None] * L
+            sv = list(sv) if int8 else [None] * L
+            for li in range(L):
+                lp = {n: a[li] for n, a in params["layers"].items()}
+                pk_l, pv_l = pk[li], pv[li]
+                sk_l, sv_l = sk[li], sv[li]
                 h = _ln(x, lp["ln1_g"], lp["ln1_b"])
                 q, k, v = _split_qkv(_dense(h, lp["qkv_w"], lp["qkv_b"]), H)
                 kp, vp = to_pages(k), to_pages(v)
@@ -631,15 +761,12 @@ class SlotDecoder:
                 ffn = _dense(
                     jax.nn.gelu(_dense(h, lp["ffn1_w"], lp["ffn1_b"])),
                     lp["ffn2_w"], lp["ffn2_b"])
-                if int8:
-                    return x + ffn, (pk_l, pv_l, sk_l, sv_l)
-                return x + ffn, (pk_l, pv_l)
-
-            if int8:
-                x, (pk, pv, sk, sv) = lax.scan(
-                    layer, x, (params["layers"], pk, pv, sk, sv))
-            else:
-                x, (pk, pv) = lax.scan(layer, x, (params["layers"], pk, pv))
+                x = x + ffn
+                pk[li], pv[li] = pk_l, pv_l
+                sk[li], sv[li] = sk_l, sv_l
+            pk, pv = tuple(pk), tuple(pv)
+            sk = tuple(sk) if int8 else None
+            sv = tuple(sv) if int8 else None
             # the chunk's last REAL row (padding beyond t_len is causally
             # downstream of it and cannot touch it)
             h_last = lax.dynamic_slice_in_dim(x, t_len - 1, 1,
@@ -662,7 +789,7 @@ class SlotDecoder:
             return self._observed(
                 jax.jit(prefill, static_argnames=("top_k", "do_sample"),
                         donate_argnums=(1, 2, 3, 4)),
-                "prefill", donate=(1, 2, 3, 4), tokens_idx=5)
+                kind, donate=(1, 2, 3, 4), tokens_idx=5)
 
         def prefill(params, pk, pv, tokens, pages_row, chunk_pages,
                     t_start, t_len, key, temperature, *, top_k, do_sample):
@@ -675,7 +802,7 @@ class SlotDecoder:
         return self._observed(
             jax.jit(prefill, static_argnames=("top_k", "do_sample"),
                     donate_argnums=(1, 2)),
-            "prefill", donate=(1, 2), tokens_idx=3)
+            kind, donate=(1, 2), tokens_idx=3)
 
     def _observed(self, fn, kind, donate, tokens_idx=None):
         """Compile-observatory wrapper for a program family: recompiles
@@ -739,6 +866,24 @@ class SlotDecoder:
             self._pk, self._pv, first = self._prefill_jit(
                 self._dec._params, self._pk, self._pv, *args,
                 top_k=self._top_k, do_sample=self._do_sample)
+        if self._draft_dec is not None:
+            # the draft model prefills the SAME chunk into its own
+            # pools (same pages — table/allocator are shared), so spec
+            # drafting starts from a warm draft KV for every request
+            self._draft_dec._auto_refresh()
+            if self._draft_prefill_jit is None:
+                self._draft_prefill_jit = self._build_prefill(
+                    self._draft_dec, "draft_prefill")
+            if self._int8:
+                (self._dpk, self._dpv, self._dsk, self._dsv,
+                 _) = self._draft_prefill_jit(
+                    self._draft_dec._params, self._dpk, self._dpv,
+                    self._dsk, self._dsv, *args, top_k=self._top_k,
+                    do_sample=self._do_sample)
+            else:
+                self._dpk, self._dpv, _ = self._draft_prefill_jit(
+                    self._draft_dec._params, self._dpk, self._dpv, *args,
+                    top_k=self._top_k, do_sample=self._do_sample)
         return int(first), bucket, pad
 
     # -- decode -------------------------------------------------------------
@@ -757,23 +902,18 @@ class SlotDecoder:
                 idx, choice[:, None], axis=-1)[:, 0].astype(jnp.int32)
         return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
 
-    def _build_decode(self):
-        jax = _j()
-        jnp = jax.numpy
-        lax = jax.lax
-        dec = self._dec
-        H = dec._n_heads
-        pt = self.page_tokens
+    def _make_write_token(self):
+        """Traced helper shared by the decode/verify/draft programs:
+        scatter one token's K or V ``(S, H, d)`` at each slot's write
+        page/offset; int8 re-quantizes just the written page under a
+        grow-only scale."""
+        jnp = _j().numpy
         int8 = self._int8
         S = self.max_slots
 
         from ..contrib.quantization import quantize_symmetric
-        from ..models.decoding import _dense, _ln, _split_qkv
 
         def write_token(pool_l, scale_l, wpage, woff, t):
-            """Scatter one token's K or V (S, H, d) at each slot's write
-            page/offset; int8 re-quantizes just the written page under a
-            grow-only scale."""
             if not int8:
                 return pool_l.at[wpage, :, woff].set(
                     t.astype(pool_l.dtype)), scale_l
@@ -791,6 +931,56 @@ class SlotDecoder:
             scale_l = scale_l.at[wpage].set(new)
             return pool_l, scale_l
 
+        return write_token
+
+    def _decode_layer_step(self, dec, lp, x, pools, table, wpage, woff,
+                           mask, write_token):
+        """One layer of the single-token decode body — shared verbatim
+        by the decode program and each unrolled step of the draft
+        program so all three stay bit-identical. `pools` is the layer's
+        ``(pk_l, pv_l, sk_l, sv_l)``; returns updated ``(x, pools)``."""
+        jax = _j()
+        jnp = jax.numpy
+        from ..models.decoding import _dense, _ln, _split_qkv
+
+        H = dec._n_heads
+        d = dec._units // H
+        S = self.max_slots
+        PT = table.shape[1] * self.page_tokens
+        pk_l, pv_l, sk_l, sv_l = pools
+        h = _ln(x, lp["ln1_g"], lp["ln1_b"])
+        q, k, v = _split_qkv(_dense(h, lp["qkv_w"], lp["qkv_b"]), H)
+        pk_l, sk_l = write_token(pk_l, sk_l, wpage, woff, k[:, :, 0])
+        pv_l, sv_l = write_token(pv_l, sv_l, wpage, woff, v[:, :, 0])
+        # per-slot logical view via the page table: one gather,
+        # static index shape (S, P)
+        vk = self._dequant_view(pk_l, sk_l, table)
+        vv = self._dequant_view(pv_l, sv_l, table)
+        vk = jnp.transpose(vk, (0, 2, 1, 3, 4)).reshape(S, H, PT, d)
+        vv = jnp.transpose(vv, (0, 2, 1, 3, 4)).reshape(S, H, PT, d)
+        s = jnp.einsum("shqd,shkd->shqk", q, vk,
+                       preferred_element_type=jnp.float32)
+        s = s / math.sqrt(d)
+        s = jnp.where(mask[:, None, None, :], s, -jnp.inf)
+        p = jax.nn.softmax(s, axis=-1).astype(vv.dtype)
+        o = jnp.einsum("shqk,shkd->shqd", p, vv)
+        o = jnp.transpose(o, (0, 2, 1, 3)).reshape(S, 1, H * d)
+        x = x + _dense(o, lp["proj_w"], lp["proj_b"])
+        h = _ln(x, lp["ln2_g"], lp["ln2_b"])
+        ffn = _dense(
+            jax.nn.gelu(_dense(h, lp["ffn1_w"], lp["ffn1_b"])),
+            lp["ffn2_w"], lp["ffn2_b"])
+        return x + ffn, (pk_l, pv_l, sk_l, sv_l)
+
+    def _build_decode(self):
+        jax = _j()
+        jnp = jax.numpy
+        dec = self._dec
+        pt = self.page_tokens
+        int8 = self._int8
+        S = self.max_slots
+        write_token = self._make_write_token()
+
         def run(params, pk, pv, sk, sv, table, last_tok, pos, active,
                 key, temperature, top_k, do_sample):
             PT = table.shape[1] * pt
@@ -802,47 +992,22 @@ class SlotDecoder:
             wpage = jnp.where(active, wpage, 0)
             woff = pos % pt
             mask = jnp.arange(PT)[None, :] <= pos[:, None]
-            d = dec._units // H
 
-            def layer(x, packed):
-                if int8:
-                    lp, pk_l, pv_l, sk_l, sv_l = packed
-                else:
-                    lp, pk_l, pv_l = packed
-                    sk_l = sv_l = None
-                h = _ln(x, lp["ln1_g"], lp["ln1_b"])
-                q, k, v = _split_qkv(_dense(h, lp["qkv_w"], lp["qkv_b"]), H)
-                pk_l, sk_l = write_token(pk_l, sk_l, wpage, woff,
-                                         k[:, :, 0])
-                pv_l, sv_l = write_token(pv_l, sv_l, wpage, woff,
-                                         v[:, :, 0])
-                # per-slot logical view via the page table: one gather,
-                # static index shape (S, P)
-                vk = self._dequant_view(pk_l, sk_l, table)
-                vv = self._dequant_view(pv_l, sv_l, table)
-                vk = jnp.transpose(vk, (0, 2, 1, 3, 4)).reshape(S, H, PT, d)
-                vv = jnp.transpose(vv, (0, 2, 1, 3, 4)).reshape(S, H, PT, d)
-                s = jnp.einsum("shqd,shkd->shqk", q, vk,
-                               preferred_element_type=jnp.float32)
-                s = s / math.sqrt(d)
-                s = jnp.where(mask[:, None, None, :], s, -jnp.inf)
-                p = jax.nn.softmax(s, axis=-1).astype(vv.dtype)
-                o = jnp.einsum("shqk,shkd->shqd", p, vv)
-                o = jnp.transpose(o, (0, 2, 1, 3)).reshape(S, 1, H * d)
-                x = x + _dense(o, lp["proj_w"], lp["proj_b"])
-                h = _ln(x, lp["ln2_g"], lp["ln2_b"])
-                ffn = _dense(
-                    jax.nn.gelu(_dense(h, lp["ffn1_w"], lp["ffn1_b"])),
-                    lp["ffn2_w"], lp["ffn2_b"])
-                if int8:
-                    return x + ffn, (pk_l, pv_l, sk_l, sv_l)
-                return x + ffn, (pk_l, pv_l)
-
-            if int8:
-                x, (pk, pv, sk, sv) = lax.scan(
-                    layer, x, (params["layers"], pk, pv, sk, sv))
-            else:
-                x, (pk, pv) = lax.scan(layer, x, (params["layers"], pk, pv))
+            # unrolled over layers — each pool leaf aliases its donated
+            # input (see _make_pools)
+            L = len(pk)
+            pk, pv = list(pk), list(pv)
+            sk = list(sk) if int8 else [None] * L
+            sv = list(sv) if int8 else [None] * L
+            for li in range(L):
+                lp = {n: a[li] for n, a in params["layers"].items()}
+                x, (pk[li], pv[li], sk[li], sv[li]) = \
+                    self._decode_layer_step(
+                        dec, lp, x, (pk[li], pv[li], sk[li], sv[li]),
+                        table, wpage, woff, mask, write_token)
+            pk, pv = tuple(pk), tuple(pv)
+            sk = tuple(sk) if int8 else None
+            sv = tuple(sv) if int8 else None
             logits = dec._logits(params, x[:, 0])               # (S, V)
             nxt = self._sample_slots(logits, key, temperature, top_k,
                                      do_sample)
@@ -905,6 +1070,283 @@ class SlotDecoder:
                 top_k=self._top_k, do_sample=self._do_sample)
         return onp.asarray(nxt)
 
+    # -- speculative decoding ----------------------------------------------
+
+    def _build_verify(self):
+        """ONE batched target program: consume ``[last, d_1..d_k]`` per
+        slot (k+1 rows at positions ``pos..pos+k``), write each row's
+        K/V to the slot's pages, and emit the greedy next token per row.
+        Row ``i`` attends only positions ``<= pos + i``, so the batch is
+        mathematically identical to k+1 sequential decode steps — the
+        identity that makes greedy spec decode bit-equal to plain
+        decode. Rows past a slot's mapped pages (``p > limit``) are
+        redirected to the trash page; the scheduler never commits their
+        outputs."""
+        jax = _j()
+        jnp = jax.numpy
+        dec = self._dec
+        H = dec._n_heads
+        pt = self.page_tokens
+        int8 = self._int8
+        S = self.max_slots
+        K1 = self.spec_k + 1
+        write_token = self._make_write_token()
+
+        from ..models.decoding import _dense, _ln, _split_qkv
+
+        def run(params, pk, pv, sk, sv, table, toks, pos, active, limit):
+            P = table.shape[1]
+            PT = P * pt
+            d = dec._units // H
+            offs = jnp.arange(K1)
+            p_abs = pos[:, None] + offs[None, :]               # (S, K1)
+            pmax = params["pos"].shape[0]
+            x = (params["embed"][toks]
+                 + params["pos"][jnp.clip(p_abs, 0, pmax - 1)])
+            writable = active[:, None] & (p_abs <= limit[:, None])
+            wpage = jnp.take_along_axis(
+                table, jnp.clip(p_abs // pt, 0, P - 1), axis=1)
+            wpage = jnp.where(writable, wpage, 0)
+            woff = p_abs % pt
+            # (S, K1, PT) causal-per-row validity
+            mask = jnp.arange(PT)[None, None, :] <= p_abs[:, :, None]
+
+            L = len(pk)
+            pk, pv = list(pk), list(pv)
+            sk = list(sk) if int8 else [None] * L
+            sv = list(sv) if int8 else [None] * L
+            for li in range(L):
+                lp = {n: a[li] for n, a in params["layers"].items()}
+                pk_l, pv_l = pk[li], pv[li]
+                sk_l, sv_l = sk[li], sv[li]
+                h = _ln(x, lp["ln1_g"], lp["ln1_b"])
+                q, k, v = _split_qkv(
+                    _dense(h, lp["qkv_w"], lp["qkv_b"]), H)    # (S,H,K1,d)
+                kt = jnp.transpose(k, (0, 2, 1, 3))            # (S,K1,H,d)
+                vt = jnp.transpose(v, (0, 2, 1, 3))
+                # column-at-a-time writes reuse the decode write_token
+                # exactly (int8 grow-only rescale order preserved)
+                for i in range(K1):
+                    pk_l, sk_l = write_token(pk_l, sk_l, wpage[:, i],
+                                             woff[:, i], kt[:, i])
+                    pv_l, sv_l = write_token(pv_l, sv_l, wpage[:, i],
+                                             woff[:, i], vt[:, i])
+                vk = self._dequant_view(pk_l, sk_l, table)
+                vv = self._dequant_view(pv_l, sv_l, table)
+                vk = jnp.transpose(vk, (0, 2, 1, 3, 4)).reshape(S, H, PT, d)
+                vv = jnp.transpose(vv, (0, 2, 1, 3, 4)).reshape(S, H, PT, d)
+                s = jnp.einsum("shqd,shkd->shqk", q, vk,
+                               preferred_element_type=jnp.float32)
+                s = s / math.sqrt(d)
+                s = jnp.where(mask[:, None, :, :], s, -jnp.inf)
+                p = jax.nn.softmax(s, axis=-1).astype(vv.dtype)
+                o = jnp.einsum("shqk,shkd->shqd", p, vv)
+                o = jnp.transpose(o, (0, 2, 1, 3)).reshape(S, K1, H * d)
+                x = x + _dense(o, lp["proj_w"], lp["proj_b"])
+                h = _ln(x, lp["ln2_g"], lp["ln2_b"])
+                ffn = _dense(
+                    jax.nn.gelu(_dense(h, lp["ffn1_w"], lp["ffn1_b"])),
+                    lp["ffn2_w"], lp["ffn2_b"])
+                x = x + ffn
+                pk[li], pv[li] = pk_l, pv_l
+                sk[li], sv[li] = sk_l, sv_l
+            pk, pv = tuple(pk), tuple(pv)
+            sk = tuple(sk) if int8 else None
+            sv = tuple(sv) if int8 else None
+            logits = dec._logits(
+                params, x.reshape(S * K1, -1)).reshape(S, K1, -1)
+            tgt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            tgt = jnp.where(active[:, None], tgt, toks)
+            return pk, pv, sk, sv, tgt
+
+        if int8:
+            def verify(params, pk, pv, sk, sv, table, toks, pos, active,
+                       limit):
+                return run(params, pk, pv, sk, sv, table, toks, pos,
+                           active, limit)
+
+            return self._observed(
+                jax.jit(verify, donate_argnums=(1, 2, 3, 4)),
+                "verify", donate=(1, 2, 3, 4))
+
+        def verify(params, pk, pv, table, toks, pos, active, limit):
+            pk, pv, _, _, tgt = run(params, pk, pv, None, None, table,
+                                    toks, pos, active, limit)
+            return pk, pv, tgt
+
+        return self._observed(
+            jax.jit(verify, donate_argnums=(1, 2)),
+            "verify", donate=(1, 2))
+
+    def _build_draft(self):
+        """ONE draft-model program: k unrolled greedy decode steps
+        (each step identical in structure to the decode program, against
+        the draft's own per-layer pools) — k drafted tokens per launch,
+        feeding the target's verify program."""
+        jax = _j()
+        jnp = jax.numpy
+        dec = self._draft_dec
+        pt = self.page_tokens
+        int8 = self._int8
+        S = self.max_slots
+        K = self.spec_k
+        write_token = self._make_write_token()
+
+        def run(params, pk, pv, sk, sv, table, last_tok, pos, active,
+                limit):
+            P = table.shape[1]
+            PT = P * pt
+            pmax = params["pos"].shape[0]
+            L = len(pk)
+            pk, pv = list(pk), list(pv)
+            sk = list(sk) if int8 else [None] * L
+            sv = list(sv) if int8 else [None] * L
+            cur = last_tok
+            outs = []
+            for i in range(K):
+                p_i = pos + i
+                wpage = table[jnp.arange(S), jnp.clip(p_i // pt, 0, P - 1)]
+                wpage = jnp.where(active & (p_i <= limit), wpage, 0)
+                woff = p_i % pt
+                mask = jnp.arange(PT)[None, :] <= p_i[:, None]
+                x = (params["embed"][cur][:, None, :]
+                     + params["pos"][jnp.clip(p_i, 0, pmax - 1)][:, None, :])
+                for li in range(L):
+                    lp = {n: a[li] for n, a in params["layers"].items()}
+                    x, (pk[li], pv[li], sk[li], sv[li]) = \
+                        self._decode_layer_step(
+                            dec, lp, x, (pk[li], pv[li], sk[li], sv[li]),
+                            table, wpage, woff, mask, write_token)
+                logits = dec._logits(params, x[:, 0])
+                nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                cur = jnp.where(active, nxt, cur)
+                outs.append(cur)
+            pk, pv = tuple(pk), tuple(pv)
+            sk = tuple(sk) if int8 else None
+            sv = tuple(sv) if int8 else None
+            return pk, pv, sk, sv, jnp.stack(outs, axis=1)      # (S, K)
+
+        if int8:
+            def draft(params, pk, pv, sk, sv, table, last_tok, pos,
+                      active, limit):
+                return run(params, pk, pv, sk, sv, table, last_tok, pos,
+                           active, limit)
+
+            return self._observed(
+                jax.jit(draft, donate_argnums=(1, 2, 3, 4)),
+                "draft", donate=(1, 2, 3, 4))
+
+        def draft(params, pk, pv, table, last_tok, pos, active, limit):
+            pk, pv, _, _, toks = run(params, pk, pv, None, None, table,
+                                     last_tok, pos, active, limit)
+            return pk, pv, toks
+
+        return self._observed(
+            jax.jit(draft, donate_argnums=(1, 2)),
+            "draft", donate=(1, 2))
+
+    def spec_propose(self, seqs):
+        """Host n-gram drafts: `seqs` is a per-slot list (None for
+        slots not decoding) of 1-D prompt+generated token arrays.
+        Returns ``(max_slots, spec_k)`` int32 host numpy. No device
+        program — the ngram draft's entire cost is this call."""
+        out = onp.zeros((self.max_slots, self.spec_k), onp.int32)
+        for s, seq in enumerate(seqs):
+            if seq is not None:
+                out[s] = self._ngram.propose(seq)
+        return out
+
+    def spec_draft_step(self, last_tok, pos, active, limit):
+        """Run the draft model's k-step program; returns drafted tokens
+        ``(max_slots, spec_k)`` as host numpy."""
+        jnp = _j().numpy
+        self._draft_dec._auto_refresh()
+        self._ensure_pool()
+        if self._draft_jit is None:
+            self._draft_jit = self._build_draft()
+        args = (self._table_device(),
+                jnp.asarray(last_tok, jnp.int32),
+                jnp.asarray(pos, jnp.int32),
+                jnp.asarray(active, bool),
+                jnp.asarray(limit, jnp.int32))
+        if self._int8:
+            (self._dpk, self._dpv, self._dsk, self._dsv,
+             toks) = self._draft_jit(
+                self._draft_dec._params, self._dpk, self._dpv,
+                self._dsk, self._dsv, *args)
+        else:
+            self._dpk, self._dpv, toks = self._draft_jit(
+                self._draft_dec._params, self._dpk, self._dpv, *args)
+        return onp.asarray(toks)
+
+    def spec_verify_step(self, last_tok, drafts, pos, active, limit):
+        """Verify ``drafts`` (host ``(max_slots, spec_k)``) for every
+        decoding slot in ONE batched target program. Returns the greedy
+        target token per row as host numpy ``(max_slots, spec_k + 1)``:
+        row ``i`` is the token the target emits after consuming
+        ``[last, d_1..d_i]`` — the scheduler accepts the longest drafted
+        prefix matching rows ``0..m-1`` plus row ``m`` as the bonus
+        token (>= 1 token of guaranteed progress per round)."""
+        jnp = _j().numpy
+        self._dec._auto_refresh()
+        self._ensure_pool()
+        if self._verify_jit is None:
+            self._verify_jit = self._build_verify()
+        if not self._spec_gauge:
+            self._register_spec_gauge()
+        toks = onp.concatenate(
+            [onp.asarray(last_tok, onp.int32)[:, None],
+             onp.asarray(drafts, onp.int32)], axis=1)
+        args = (self._table_device(),
+                jnp.asarray(toks),
+                jnp.asarray(pos, jnp.int32),
+                jnp.asarray(active, bool),
+                jnp.asarray(limit, jnp.int32))
+        if self._int8:
+            (self._pk, self._pv, self._sk, self._sv,
+             tgt) = self._verify_jit(
+                self._dec._params, self._pk, self._pv, self._sk,
+                self._sv, *args)
+        else:
+            self._pk, self._pv, tgt = self._verify_jit(
+                self._dec._params, self._pk, self._pv, *args)
+        return onp.asarray(tgt)
+
+    def spec_count(self, drafted, accepted):
+        """Scheduler callback: fold one slot-round's drafted/accepted
+        token counts into the engine's lifetime acceptance stats."""
+        self._spec_drafted += int(drafted)
+        self._spec_accepted += int(accepted)
+
+    def spec_stats(self):
+        """Lifetime speculative-decoding stats for this engine —
+        surfaced per model in the gateway flight-recorder context."""
+        drafted = self._spec_drafted
+        return {"k": self.spec_k, "draft": self.draft_kind,
+                "drafted": drafted, "accepted": self._spec_accepted,
+                "accept_rate": (self._spec_accepted / drafted)
+                if drafted else None}
+
+    def _register_spec_gauge(self):
+        """Per-model pull gauge for the lifetime acceptance rate;
+        registered on first verify so the gateway's census_name
+        override has already landed. Weakref probe, like the HBM
+        owners."""
+        self._spec_gauge = True
+        ref = weakref.ref(self)
+
+        def probe():
+            eng = ref()
+            if eng is None or not eng._spec_drafted:
+                return None
+            return eng._spec_accepted / eng._spec_drafted
+
+        registry.register_pull_gauge(
+            "mx_serve_spec_accept_rate", probe,
+            "accepted draft tokens / drafted tokens since engine start "
+            "[0, 1] (speculative decoding)",
+            labels={"model": self.census_name})
+
     # -- debug / tests ------------------------------------------------------
 
     def slot_kv(self, slot, n_tokens):
@@ -916,7 +1358,7 @@ class SlotDecoder:
         outs = []
         for pool, scale in ((self._pk, self._sk), (self._pv, self._sv)):
             views = []
-            L = pool.shape[0]
+            L = len(pool)
             for layer in range(L):
                 v = self._dequant_view(pool[layer],
                                        None if scale is None
@@ -928,12 +1370,14 @@ class SlotDecoder:
         return outs[0], outs[1]
 
     def xla_program_count(self):
-        """Number of compiled programs across the chunk-prefill family
-        (one per chunk bucket actually seen) and the decode program —
-        the recompile-count gate of `tests/test_serve.py` asserts this
+        """Number of compiled programs across every family this engine
+        owns: chunk-prefill (one per chunk bucket actually seen), decode,
+        and — with spec decode armed — verify, draft, and draft-prefill.
+        The recompile-count gate of `tests/test_serve.py` asserts this
         stays constant in steady state."""
         n = 0
-        for f in (self._prefill_jit, self._decode_jit):
+        for f in (self._prefill_jit, self._decode_jit, self._verify_jit,
+                  self._draft_jit, self._draft_prefill_jit):
             if f is None:
                 continue
             size = getattr(f, "_cache_size", None)

@@ -394,6 +394,9 @@ class Gateway:
                  else r._segment.state}
                 for r in m.live] for m in self._models.values()},
             "preemptions_total": self.preemptions_total,
+            "spec": {m.name: m.slots.spec_stats()
+                     for m in self._models.values()
+                     if getattr(m.slots, "spec_k", 0)},
             "closed": self.closed,
         }
 

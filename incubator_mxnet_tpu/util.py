@@ -265,6 +265,18 @@ _ENV_KNOBS = {
         "pool quantized with one scale per (layer, page, head) — half "
         "the resident KV bytes per slot, parity within tolerance "
         "(honored, this build's addition)"),
+    "MXNET_SERVE_SPEC_K": (
+        "serve.SlotDecoder", "speculative-decoding draft length "
+        "(default 0 = off): each decode round drafts k tokens and "
+        "verifies all k+1 rows in one batched target program; greedy "
+        "output stays token-for-token identical (honored, this "
+        "build's addition — see SERVING.md)"),
+    "MXNET_SERVE_SPEC_DRAFT": (
+        "serve.SlotDecoder", "draft source when SPEC_K > 0: ngram "
+        "(default, host n-gram proposer — zero extra device programs); "
+        "a draft *model* is passed programmatically via "
+        "ServeEngine(draft=...) or Gateway registry.add(..., draft=...) "
+        "(honored, this build's addition — see SERVING.md)"),
     "MXNET_SERVE_PRIORITY_TIERS": (
         "serve.Gateway", "comma-separated priority tier names, highest "
         "first (default high,normal,low); the gateway keeps one WDRR "

@@ -635,3 +635,183 @@ def test_bench_gpt_serve_prefix_contract():
     assert res["reuse_tokens_s"] > 0 and res["base_tokens_s"] > 0
     assert res["hit_rate"] > 0
     assert res["kv_bytes_per_slot"] > 0
+
+
+# ---------------------------------------------------------------------------
+# speculative decoding + per-layer pool layout (ISSUE 11)
+# ---------------------------------------------------------------------------
+
+def test_ngram_proposer_unit():
+    """Host n-gram drafting: longest-suffix continuation lookup with a
+    repeat-last fallback, always exactly k tokens."""
+    from incubator_mxnet_tpu.models.decoding import NgramProposer
+
+    p = NgramProposer(3, max_ngram=3)
+    # the suffix [7, 8] occurred earlier, continued by [9, 1, 2]
+    seq = onp.array([7, 8, 9, 1, 2, 7, 8], onp.int32)
+    assert list(p.propose(seq)) == [9, 1, 2]
+    # the suffix [5, 6] recurs with a full continuation window
+    seq = onp.array([5, 6, 1, 5, 6], onp.int32)
+    assert list(p.propose(seq)) == [1, 5, 6]
+    # a short continuation pads with its own last token
+    seq = onp.array([9, 5, 6, 5, 6], onp.int32)
+    assert list(p.propose(seq)) == [5, 6, 6]
+    # no suffix recurs: repeat the last token
+    seq = onp.array([1, 2, 3], onp.int32)
+    assert list(p.propose(seq)) == [3, 3, 3]
+    with pytest.raises(ValueError):
+        NgramProposer(0)
+
+
+def test_spec_engine_validation_and_env_knobs(net, monkeypatch):
+    """spec_k rides MXNET_SERVE_SPEC_K; sampling and an undersized or
+    vocab-mismatched draft model fail loudly at construction."""
+    from incubator_mxnet_tpu.serve.engine import SlotDecoder
+
+    monkeypatch.setenv("MXNET_SERVE_SPEC_K", "2")
+    s = SlotDecoder(net, max_slots=2, max_len=64)
+    assert s.spec_k == 2 and s.draft_kind == "ngram"
+    monkeypatch.delenv("MXNET_SERVE_SPEC_K")
+    s = SlotDecoder(net, max_slots=2, max_len=64)
+    assert s.spec_k == 0 and s.draft_kind == "off"
+    with pytest.raises(ValueError, match="greedy"):
+        SlotDecoder(net, max_slots=2, max_len=64, spec_k=3,
+                    do_sample=True)
+    with pytest.raises(ValueError, match="spec_k"):
+        SlotDecoder(net, max_slots=2, max_len=64, spec_k=-1)
+    small = gpt_tiny(vocab_size=VOCAB, max_length=32, dropout=0.0)
+    small.initialize()
+    with pytest.raises(ValueError, match="position table"):
+        SlotDecoder(net, max_slots=2, max_len=64, spec_k=3, draft=small)
+    other_vocab = gpt_tiny(vocab_size=31, max_length=64, dropout=0.0)
+    other_vocab.initialize()
+    with pytest.raises(ValueError, match="vocab"):
+        SlotDecoder(net, max_slots=2, max_len=64, spec_k=3,
+                    draft=other_vocab)
+
+
+def test_spec_decode_parity_ngram_and_never_recompiles(net, ref_dec):
+    """The spec acceptance gate: with the n-gram draft armed, every
+    request's output is token-for-token identical to non-speculative
+    greedy decode, program count stays flat in steady state, and the
+    drafted/accepted counters move."""
+    from incubator_mxnet_tpu.telemetry import registry
+
+    e = serve.ServeEngine(net, max_slots=3, max_len=64, max_queue=32,
+                          spec_k=3, draft="ngram")
+    try:
+        drafted0 = registry.counter(
+            "mx_serve_spec_drafted_tokens_total").value
+        e.generate(_prompt(5, seed=9), 3)          # warm bucket + verify
+        warm = e.xla_program_count()
+        prompts, budgets = _mixed_requests(9, seed=1)
+        handles = [e.submit(p, b) for p, b in zip(prompts, budgets)]
+        e._drive_until(handles)
+        for p, b, h in zip(prompts, budgets, handles):
+            ref = ref_dec.generate(p[None, :], b).asnumpy()[0]
+            got = onp.concatenate([p, onp.asarray(h.result(), onp.int32)])
+            onp.testing.assert_array_equal(got, ref)
+        assert e.xla_program_count() == warm       # zero steady-state
+        st = e.spec_stats()
+        assert st["k"] == 3 and st["draft"] == "ngram"
+        assert st["drafted"] > 0
+        drafted = registry.counter(
+            "mx_serve_spec_drafted_tokens_total").value - drafted0
+        assert drafted == st["drafted"]
+        # the per-model acceptance gauge is exported
+        rep = registry.report()
+        key = 'mx_serve_spec_accept_rate{model="serve"}'
+        assert key in rep
+        assert rep[key]["value"] == pytest.approx(st["accept_rate"])
+    finally:
+        e.shutdown(drain=False)
+
+
+def test_spec_self_draft_parity_and_acceptance(net, ref_dec):
+    """Drafting with the target model itself must accept ~everything
+    (the draft pool tracks the committed prefix exactly) while output
+    stays bit-identical — the canary for draft-pool KV holes."""
+    e = serve.ServeEngine(net, max_slots=3, max_len=64, max_queue=32,
+                          spec_k=3, draft=GPTDecoder(net))
+    try:
+        prompts = [_prompt(int(onp.random.RandomState(i).randint(4, 12)),
+                           seed=50 + i) for i in range(6)]
+        handles = [e.submit(p, 40) for p in prompts]
+        e._drive_until(handles)
+        for p, h in zip(prompts, handles):
+            ref = ref_dec.generate(p[None, :], 40).asnumpy()[0]
+            got = onp.concatenate([p, onp.asarray(h.result(), onp.int32)])
+            onp.testing.assert_array_equal(got, ref)
+        st = e.spec_stats()
+        assert st["draft"] == "model"
+        assert st["accept_rate"] > 0.9, st
+    finally:
+        e.shutdown(drain=False)
+
+
+def test_spec_page_rollback_refcounts(net):
+    """The reservation ledger under rejection pressure: after every
+    step each decoding slot holds exactly the pages its committed
+    position needs (rejected-suffix pages rolled back), reservations
+    never exceed the free pool, and a drained engine returns every
+    page."""
+    e = serve.ServeEngine(net, max_slots=2, max_len=64, max_queue=32,
+                          page_tokens=8, spec_k=4, draft="ngram")
+    sched = e._sched
+    alloc = sched.slots.allocator
+    pt = sched.slots.page_tokens
+    try:
+        prompts, budgets = _mixed_requests(6, seed=3, budget_lo=10,
+                                           budget_hi=24)
+        handles = [e.submit(p, b) for p, b in zip(prompts, budgets)]
+        while not all(h.done for h in handles):
+            e.step()
+            assert alloc.free_pages >= sched._spec_reserved_total()
+            for s, req in enumerate(sched._in_slot):
+                if req is None or not sched._active[s]:
+                    continue
+                # post-trim: pages cover the committed position exactly
+                assert len(req.pages) == int(sched._pos[s]) // pt + 1
+                assert req.spec_reserved >= 0
+        assert sched._spec_reserved_total() == 0
+    finally:
+        e.shutdown(drain=False)
+    assert alloc.used_pages == 0                   # cache cleared too
+
+
+def test_per_layer_pool_ledger_decode_cost_flat(net):
+    """Tentpole (a) evidence, asserted from XLA's own accounting: the
+    decode program's temp allocation is a small constant — it does NOT
+    scale with the pool as it grows 4x (the old stacked-pool layout
+    re-materialized the whole pool per step) — and every per-layer
+    pool leaf appears in the donation map (aliased in place)."""
+    from incubator_mxnet_tpu.telemetry import compiles
+
+    temps, pools, aliased = [], [], []
+    compiles.enable()
+    try:
+        for n_pages in (12, 48):
+            compiles.reset()
+            e = serve.ServeEngine(net, max_slots=3, max_len=64,
+                                  max_queue=8, n_pages=n_pages)
+            try:
+                e.generate(_prompt(5, seed=1), 3)
+                mem = compiles.ledger("serve.decode")[-1]["memory"]
+                assert mem is not None and mem["temp"]
+                temps.append(mem["temp"])
+                pools.append(e._sched.slots.cache_bytes)
+                aliased.append(mem.get("aliased_params"))
+            finally:
+                e.shutdown(drain=False)
+    finally:
+        compiles.disable()
+        compiles.reset()
+    assert pools[1] >= 3.5 * pools[0]              # the pool really grew
+    # decode scratch is a fraction of the pool it updates, and FLAT
+    assert temps[0] < 0.5 * pools[0]
+    assert temps[1] < 0.15 * pools[1]
+    assert temps[1] <= 1.5 * temps[0], (temps, pools)
+    # all 2L per-layer pool leaves alias an output (donation held)
+    n_layers = 2                                   # gpt_tiny
+    assert aliased[0] is not None
+    assert len(aliased[0]) >= 2 * n_layers, aliased[0]

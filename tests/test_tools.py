@@ -510,6 +510,79 @@ def test_fl012_tree_is_clean():
 
 
 # ---------------------------------------------------------------------------
+# FL013 — serve/ KV-pool aliasing (ISSUE 11)
+# ---------------------------------------------------------------------------
+
+def test_fl013_flags_undonated_pool_param():
+    src = ("import jax\n"
+           "def decode(params, pk, pv, table, tok):\n"
+           "    return tok\n"
+           "f = jax.jit(decode, donate_argnums=(1,))\n")
+    hits = [f for f in _lint(src, _SERVE_PATH) if f.rule == "FL013"]
+    assert len(hits) == 1
+    assert "`pv`" in hits[0].message and "donate" in hits[0].message
+
+
+def test_fl013_flags_scan_over_pool():
+    src = ("from jax import lax\n"
+           "def step(c, xs):\n"
+           "    return c, None\n"
+           "def run(x, pk, pv):\n"
+           "    out, _ = lax.scan(step, x, (pk, pv))\n"
+           "    return out\n")
+    hits = [f for f in _lint(src, _SERVE_PATH) if f.rule == "FL013"]
+    assert len(hits) == 1
+    assert "re-stacks" in hits[0].message
+
+
+def test_fl013_accepts_donated_noqa_and_outside_serve():
+    # fully donated pools (fp and int8 signatures) are the idiom
+    ok = ("import jax\n"
+          "def decode(params, pk, pv, sk, sv, table):\n"
+          "    return table\n"
+          "f = jax.jit(decode, donate_argnums=(1, 2, 3, 4))\n")
+    assert not [f for f in _lint(ok, _SERVE_PATH) if f.rule == "FL013"]
+    # the noqa escape carries a justification
+    noqa = ("import jax\n"
+            "def audit(pk, pv):\n"
+            "    return pk\n"
+            "f = jax.jit(audit)  # noqa: FL013 - read-only analysis pass\n")
+    assert not [f for f in _lint(noqa, _SERVE_PATH) if f.rule == "FL013"]
+    # scans whose xs carries no pool are untouched
+    scan_ok = ("from jax import lax\n"
+               "def run(x, layers):\n"
+               "    out, _ = lax.scan(lambda c, l: (c, None), x, layers)\n"
+               "    return out\n")
+    assert not [f for f in _lint(scan_ok, _SERVE_PATH)
+                if f.rule == "FL013"]
+    # scoped to serve/: the same source outside serve/ is not flagged
+    bad = ("import jax\n"
+           "def decode(params, pk, pv):\n"
+           "    return params\n"
+           "f = jax.jit(decode, donate_argnums=(1,))\n")
+    assert not [f for f in _lint(bad, _OPS_PATH) if f.rule == "FL013"]
+    # non-literal donate_argnums can't be checked statically: no flag
+    dyn = ("import jax\n"
+           "def decode(params, pk, pv):\n"
+           "    return params\n"
+           "donate = (1, 2)\n"
+           "f = jax.jit(decode, donate_argnums=donate)\n")
+    assert not [f for f in _lint(dyn, _SERVE_PATH) if f.rule == "FL013"]
+
+
+def test_fl013_tree_is_clean():
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import framework_lint
+    finally:
+        sys.path.pop(0)
+    findings = [f for f in framework_lint.lint_paths(
+        [os.path.join(REPO, "incubator_mxnet_tpu")])
+        if f.rule == "FL013"]
+    assert not findings, findings
+
+
+# ---------------------------------------------------------------------------
 # bench_regress — trajectory regression gate (ISSUE 10)
 # ---------------------------------------------------------------------------
 

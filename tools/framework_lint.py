@@ -106,6 +106,19 @@ FL012  compile-observatory coverage (scoped to ``incubator_mxnet_tpu/``
        be ledgered (trace-time inner jits, analysis tooling that
        compiles programs about programs) — annotate the line with
        ``# noqa: FL012`` and the justifying comment.
+FL013  KV-pool aliasing (scoped to ``serve/`` modules): (a) a
+       ``jax.jit`` whose wrapped function takes a KV-pool parameter
+       (``pk``/``pv``/``sk``/``sv``, ``*pool*``, ``kv*``) at a
+       position NOT covered by its ``donate_argnums`` — an undonated
+       pool input cannot alias the output, so XLA materializes a full
+       pool copy every step and the decode cost scales with
+       ``n_pages`` instead of active tokens; (b) a ``lax.scan`` whose
+       ``xs`` carries a pool name — scanning over a stacked pool
+       re-stacks the whole carry on every step for the same O(pool)
+       cost (the per-layer-pool layout exists precisely to avoid
+       this). Where the pool argument genuinely must not be donated
+       (a read-only analysis pass), annotate with ``# noqa: FL013``
+       and the justifying comment.
 
 Usage
 -----
@@ -161,6 +174,13 @@ RULES = {
              "recompile forensics; route through telemetry.compiles."
              "ledgered_jit/instrument_jit, or `# noqa: FL012` with a "
              "comment saying why the program can't be ledgered",
+    "FL013": "serve/ KV-pool aliasing: jax.jit whose wrapped function "
+             "takes a pool parameter (pk/pv/sk/sv, *pool*, kv*) not "
+             "covered by donate_argnums (XLA copies the whole pool "
+             "every step — decode cost O(n_pages) instead of O(active "
+             "tokens)), or lax.scan carrying a pool in xs (re-stacks "
+             "the pool per step) — donate the pool / unroll the layer "
+             "loop, or `# noqa: FL013` with a reason",
 }
 
 _INDEXING_NAME_PARTS = ("getitem", "setitem", "index", "slice")
@@ -565,6 +585,112 @@ def _check_observatory_coverage(tree, path, findings, src_lines):
 
 
 # ---------------------------------------------------------------------------
+# FL013 — KV-pool aliasing (serve/ modules only)
+# ---------------------------------------------------------------------------
+
+_POOL_PARAM_EXACT = ("pk", "pv", "sk", "sv")
+
+
+def _is_pool_name(name):
+    if not isinstance(name, str):
+        return False
+    low = name.lower()
+    return (low in _POOL_PARAM_EXACT or "pool" in low
+            or low.startswith("kv"))
+
+
+def _donated_positions(call):
+    """The literal donate_argnums of a jit call, or None when absent or
+    not statically evaluable (a variable — give the benefit of the
+    doubt rather than false-positive)."""
+    for k in call.keywords:
+        if k.arg != "donate_argnums":
+            continue
+        v = k.value
+        if isinstance(v, ast.Constant) and isinstance(v.value, int):
+            return {v.value}
+        if isinstance(v, (ast.Tuple, ast.List)):
+            out = set()
+            for el in v.elts:
+                if not (isinstance(el, ast.Constant)
+                        and isinstance(el.value, int)):
+                    return None
+                out.add(el.value)
+            return out
+        return None
+    return set()
+
+
+def _check_pool_aliasing(tree, path, findings, src_lines):
+    norm = path.replace(os.sep, "/")
+    if "/serve/" not in norm:
+        return
+
+    def _noqa(node):
+        last = getattr(node, "end_lineno", node.lineno)
+        span = src_lines[node.lineno - 1:last] if src_lines else []
+        return any("noqa: FL013" in ln for ln in span)
+
+    defs = [n for n in ast.walk(tree) if isinstance(
+        n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+
+    def _resolve(name, before_line):
+        """The nearest preceding def with this name (the one a
+        `jax.jit(fn, ...)` call site closes over)."""
+        best = None
+        for d in defs:
+            if d.name == name and d.lineno < before_line:
+                if best is None or d.lineno > best.lineno:
+                    best = d
+        return best
+
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        # (a) pool parameter outside the donation map: the input can't
+        # alias the output, so every call rewrites the whole pool
+        if _is_jit_call(node) and node.args \
+                and isinstance(node.args[0], ast.Name):
+            fn = _resolve(node.args[0].id, node.lineno)
+            donated = _donated_positions(node)
+            if fn is not None and donated is not None and not _noqa(node):
+                params = [a.arg for a in fn.args.posonlyargs + fn.args.args]
+                named = {k.arg for k in node.keywords
+                         if k.arg == "donate_argnames"}
+                for i, p in enumerate(params):
+                    if _is_pool_name(p) and i not in donated and not named:
+                        findings.append(LintFinding(
+                            path, node.lineno, "FL013",
+                            f"jitted `{fn.name}` takes KV-pool parameter "
+                            f"`{p}` (position {i}) outside donate_argnums"
+                            f"={sorted(donated)}: an undonated pool can't "
+                            "alias the output, so XLA copies the whole "
+                            "pool every step — donate it, or `# noqa: "
+                            "FL013` with a reason"))
+        # (b) scanning over a stacked pool: the carry re-stacks the
+        # whole pool on every layer step (the pre-per-layer layout bug)
+        if isinstance(node.func, ast.Attribute) \
+                and node.func.attr == "scan":
+            xs = node.args[2] if len(node.args) > 2 else None
+            if xs is None:
+                for k in node.keywords:
+                    if k.arg == "xs":
+                        xs = k.value
+            if xs is not None and not _noqa(node):
+                for sub in ast.walk(xs):
+                    if isinstance(sub, ast.Name) and _is_pool_name(sub.id):
+                        findings.append(LintFinding(
+                            path, node.lineno, "FL013",
+                            f"lax.scan carries pool `{sub.id}` in xs: "
+                            "scanning over a stacked pool re-stacks the "
+                            "whole buffer every step (O(n_pages) per "
+                            "token) — unroll the layer loop over "
+                            "per-layer pools, or `# noqa: FL013` with a "
+                            "reason"))
+                        break
+
+
+# ---------------------------------------------------------------------------
 # FL010 — sharding-spec hygiene (parallel/ and serve/ modules)
 
 _SPEC_CTOR_NAMES = ("PartitionSpec", "NamedSharding")
@@ -943,6 +1069,7 @@ def lint_source(src, path, coverage_text=None):
     _check_serve_hazards(tree, path, findings)
     _check_gateway_bounds(tree, path, findings, src.splitlines())
     _check_observatory_coverage(tree, path, findings, src.splitlines())
+    _check_pool_aliasing(tree, path, findings, src.splitlines())
     _check_sharding_hygiene(tree, path, findings)
     _check_paged_hazards(tree, path, findings)
     _check_span_hygiene(tree, path, findings)
