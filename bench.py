@@ -57,6 +57,12 @@ extras:
   runs) — the measured cost of per-request tracing on the serving hot
   path (TELEMETRY.md; the off-path cost with MXNET_TELEMETRY unset is
   gated <3% separately in tests/test_tracing.py).
+- collective_step_off/fleet_ms + collective_wrapper_overhead_pct: one
+  jitted shard_map step through the `parallel.collectives` wrappers
+  (all_reduce + ring_permute) with fleet telemetry off vs armed,
+  adjacent legs — the fleet census is a trace-time count, so the armed
+  program must execute as a dead branch (<3% contract, TELEMETRY.md
+  §fleet; gated structurally in tests/test_fleet.py).
 - resnet50_fp32/int8_infer_img_s: batch-64 serving, interleaved
   fp32/int8 rounds (best-of-rounds wall rates + median wall ratio).
   Wall numbers on THIS deployment are LINK-bound (the tunnel's RPC rate
@@ -901,6 +907,83 @@ def bench_gpt_serve_traced(requests=12, max_slots=4, prompt_max=48,
     return on_tok_s, off_tok_s, overhead_pct
 
 
+def bench_collective_overhead(n=256, iters=40, warmup=5, rounds=2):
+    """Fleet-telemetry cost on a jitted collective step: the SAME
+    shard_map program (wrapper all_reduce + ring_permute over the local
+    mesh) with fleet off vs armed, in INTERLEAVED (off,on) rounds with
+    min-of-rounds per leg — the `bench_resnet50_infer_pair` rationale:
+    each leg freshly traces+compiles, and on a shared CPU runner the
+    off-leg's own round-to-round wall variance exceeds 3%, so adjacent
+    rounds + min reject load spikes that adjacent single legs cannot.
+    Each leg re-jits so the armed leg's program embeds anything the
+    census might have inserted at trace time — it must price as a dead
+    branch at execution (TELEMETRY.md's <3% wrapper contract, gated
+    structurally in tests/test_fleet.py; this is the measured
+    end-to-end figure). Returns (off_ms, on_ms, overhead_pct)."""
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import Mesh
+    from jax.sharding import PartitionSpec as P
+
+    from incubator_mxnet_tpu.parallel import collectives
+    from incubator_mxnet_tpu.telemetry import fleet, registry
+
+    devs = jax.devices()
+    mesh = Mesh(onp.asarray(devs), ("dp",))
+    rng = onp.random.RandomState(0)
+    x = jnp.asarray(
+        rng.uniform(-1, 1, (len(devs) * n, n)).astype("float32"))
+
+    def leg(armed):
+        if armed:
+            fleet.enable()
+        try:
+            # fresh jit per leg: no program reuse across legs
+            @jax.jit
+            @functools.partial(shard_map, mesh=mesh, in_specs=P("dp"),
+                               out_specs=P("dp"), check_rep=False)
+            def step(a):
+                g = collectives.all_reduce(a.sum(axis=0), "dp")
+                h = collectives.ring_permute(a, "dp")
+                return a + 0.1 * h + g / collectives.axis_size("dp")
+
+            y = step(x)
+            for _ in range(warmup):
+                y = step(y)
+            y.block_until_ready()
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                y = step(y)
+            y.block_until_ready()
+            return (time.perf_counter() - t0) * 1e3 / iters
+        finally:
+            if armed:
+                fleet.disable()
+
+    assert not fleet.is_enabled(), \
+        "fleet already armed: the off-legs would measure the on-path"
+    offs, ons = [], []
+    try:
+        for _ in range(rounds):
+            offs.append(leg(False))
+            ons.append(leg(True))
+        counted = any(k.startswith("mx_collective_trace_calls_total")
+                      for k in registry.report())
+    finally:
+        fleet.disable()
+        fleet.reset()
+    if not counted:
+        raise RuntimeError(
+            "armed legs recorded no collective census counts — the "
+            "fleet hook was not live through the wrappers")
+    off_ms, on_ms = min(offs), min(ons)
+    overhead_pct = (on_ms - off_ms) / off_ms * 100.0
+    return off_ms, on_ms, overhead_pct
+
+
 def bench_resnet50_infer_pair(batch=64, iters=10, rounds=3):
     """fp32 AND int8 inference measured in INTERLEAVED rounds
     (fp32,int8,fp32,int8,...) with best-of-rounds throughput and the
@@ -1028,6 +1111,15 @@ def _collect_serve_extras(extras, _retry, _fail):
         extras["gpt_serve_tracing_overhead_pct"] = round(ovh, 2)
     except Exception as e:  # pragma: no cover
         _fail("gpt_serve_traced", e)
+    try:
+        coff, con, covh = _retry(bench_collective_overhead)
+        # fleet collective-wrapper cost (TELEMETRY.md §fleet): same
+        # jitted shard_map step, fleet census off then armed
+        extras["collective_step_off_ms"] = round(coff, 3)
+        extras["collective_step_fleet_ms"] = round(con, 3)
+        extras["collective_wrapper_overhead_pct"] = round(covh, 2)
+    except Exception as e:  # pragma: no cover
+        _fail("collective_overhead", e)
     try:
         pr = _retry(bench_gpt_serve_prefix)
         extras["gpt_serve_prefix_tokens_s"] = round(pr["reuse_tokens_s"], 1)

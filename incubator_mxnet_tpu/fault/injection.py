@@ -9,9 +9,14 @@ the real failure surfaces of the framework.
 
 Schedule grammar (comma-separated entries)::
 
-    MXNET_FAULT_INJECT="seam:prob[:seed[:limit[:kind]]],..."
+    MXNET_FAULT_INJECT="seam[@rank]:prob[:seed[:limit[:kind]]],..."
 
-- ``seam``  — one of :data:`SEAMS` (below);
+- ``seam``  — one of :data:`SEAMS` (below). An optional ``@rank`` suffix
+  (``collective_delay@1:1.0``) restricts the seam to ONE process of a
+  multi-rank launch: the probe compares against ``PROCESS_ID`` /
+  ``DMLC_RANK`` (what `tools/launch.py` exports), falling back to
+  ``jax.process_index()`` — the deterministic-straggler fixture for the
+  fleet observability plane;
 - ``prob``  — per-draw fire probability in [0, 1];
 - ``seed``  — per-seam PRNG seed (default 0). The draw sequence is
   ``random.Random(seed)`` — identical across runs/platforms, so a chaos
@@ -20,11 +25,14 @@ Schedule grammar (comma-separated entries)::
   exactly the first N draws then goes quiet — the deterministic form the
   test suites use);
 - ``kind``  — the failure flavor: ``fault`` (default,
-  :class:`FaultInjected`) or ``oom``
+  :class:`FaultInjected`), ``oom``
   (:class:`InjectedResourceExhausted`, whose message carries the XLA
   ``RESOURCE_EXHAUSTED`` marker so the HBM observatory's OOM post-mortem
   seams treat it as a real allocator failure — the fixture behind
-  `telemetry/hbm.py`'s flight-dump test).
+  `telemetry/hbm.py`'s flight-dump test), or ``delay`` (SLEEP
+  ``MXNET_FAULT_DELAY_MS`` milliseconds, default 50, instead of raising
+  — a slow rank, not a dead one; the default kind for the
+  ``collective_delay`` seam).
 
 Seams (where the probes live):
 
@@ -49,6 +57,13 @@ Seams (where the probes live):
 ``gateway_step``             `serve.Gateway.step` entry (multi-tenant
                              front door crash with tiered queues live;
                              the flight recorder snapshots queue state)
+``collective_delay``         `parallel/dist.allreduce` entry — the choke
+                             point broadcast/barrier/exchange_objs ride
+                             (module-global ``dist._FAULT_HOOK``, the
+                             h2d dead-branch discipline). Default kind
+                             ``delay``: with ``@rank`` targeting it
+                             turns one process into a reproducible
+                             straggler for `telemetry/fleet.py`
 ===========================  ==============================================
 
 Off-path contract: when no schedule is configured, ``_SCHEDULE is None``
@@ -68,7 +83,7 @@ __all__ = ["FaultInjected", "InjectedResourceExhausted", "SEAMS",
 SEAMS = ("dataloader_worker", "dataloader_worker_exit", "kvstore_push",
          "kvstore_pull", "kvstore_barrier", "dist_init", "h2d",
          "checkpoint_write", "estimator_step", "serve_step",
-         "gateway_step")
+         "gateway_step", "collective_delay")
 
 
 class FaultInjected(RuntimeError):
@@ -107,24 +122,68 @@ class InjectedResourceExhausted(FaultInjected):
 
 
 _KINDS = {"fault": FaultInjected, "oom": InjectedResourceExhausted}
+_DELAY_KIND = "delay"            # sleeps instead of raising (slow, not dead)
 
 
 class _SeamState:
-    __slots__ = ("prob", "seed", "limit", "kind", "rng", "draws", "fired")
+    __slots__ = ("prob", "seed", "limit", "kind", "rng", "draws", "fired",
+                 "rank")
 
-    def __init__(self, prob, seed=0, limit=None, kind="fault"):
+    def __init__(self, prob, seed=0, limit=None, kind="fault", rank=None):
         import random
 
         self.prob = float(prob)
         self.seed = int(seed)
         self.limit = None if limit is None else int(limit)
-        if kind not in _KINDS:
-            raise ValueError(f"unknown fault kind {kind!r} "
-                             f"(valid: {', '.join(_KINDS)})")
+        if kind not in _KINDS and kind != _DELAY_KIND:
+            raise ValueError(
+                f"unknown fault kind {kind!r} "
+                f"(valid: {', '.join((*_KINDS, _DELAY_KIND))})")
         self.kind = kind
+        self.rank = None if rank is None else int(rank)
         self.rng = random.Random(self.seed)
         self.draws = 0
         self.fired = 0
+
+
+def _split_rank(seam):
+    """``seam@rank`` → (seam, rank). No suffix → (seam, None)."""
+    if "@" in seam:
+        base, _, r = seam.partition("@")
+        try:
+            return base.strip(), int(r)
+        except ValueError:
+            raise ValueError(
+                f"MXNET_FAULT_INJECT: bad rank suffix in {seam!r} "
+                "(expected 'seam@<int>')") from None
+    return seam, None
+
+
+def _self_rank():
+    """This process's rank for ``@rank`` targeting: launch.py env first
+    (usable before jax import), live runtime second, else 0."""
+    import sys
+
+    v = os.environ.get("PROCESS_ID") or os.environ.get("DMLC_RANK")
+    if v is not None:
+        try:
+            return int(v)
+        except ValueError:
+            pass
+    jax = sys.modules.get("jax")
+    if jax is not None:
+        try:
+            return int(jax.process_index())
+        except Exception:   # noqa: FL006 - no runtime yet: rank filter falls back to 0
+            pass
+    return 0
+
+
+def _delay_seconds():
+    try:
+        return float(os.environ.get("MXNET_FAULT_DELAY_MS", "50")) / 1000.0
+    except ValueError:
+        return 0.05
 
 
 _SCHEDULE = None                 # None = off (every probe a dead branch)
@@ -142,7 +201,7 @@ def _parse_spec(spec):
             raise ValueError(
                 f"MXNET_FAULT_INJECT entry {part!r}: expected "
                 "'seam:prob[:seed[:limit[:kind]]]'")
-        seam = bits[0].strip()
+        seam, rank = _split_rank(bits[0].strip())
         if seam not in SEAMS:
             raise ValueError(
                 f"MXNET_FAULT_INJECT: unknown seam {seam!r} "
@@ -153,16 +212,22 @@ def _parse_spec(spec):
                 f"MXNET_FAULT_INJECT seam {seam!r}: prob {prob} ∉ [0, 1]")
         seed = int(bits[2]) if len(bits) >= 3 else 0
         limit = int(bits[3]) if len(bits) >= 4 and bits[3] else None
-        kind = bits[4].strip().lower() if len(bits) == 5 else "fault"
-        sched[seam] = _SeamState(prob, seed, limit, kind)
+        kind = (bits[4].strip().lower() if len(bits) == 5
+                else _default_kind(seam))
+        sched[seam] = _SeamState(prob, seed, limit, kind, rank)
     return sched
+
+
+def _default_kind(seam):
+    # collective_delay exists to make a rank SLOW, not to kill it
+    return _DELAY_KIND if seam == "collective_delay" else "fault"
 
 
 def configure_injection(spec):
     """Arm the chaos schedule. `spec` is the ``MXNET_FAULT_INJECT`` grammar
-    string or a ``{seam: (prob[, seed[, limit[, kind]]])}`` dict (kind
-    ``fault`` | ``oom``). Empty/None clears. Returns the armed seam
-    names."""
+    string or a ``{seam[@rank]: (prob[, seed[, limit[, kind]]])}`` dict
+    (kind ``fault`` | ``oom`` | ``delay``). Empty/None clears. Returns
+    the armed seam names."""
     global _SCHEDULE
     if not spec:
         clear_injection()
@@ -172,11 +237,14 @@ def configure_injection(spec):
     else:
         sched = {}
         for seam, cfg in dict(spec).items():
+            seam, rank = _split_rank(seam)
             if seam not in SEAMS:
                 raise ValueError(f"unknown seam {seam!r} "
                                  f"(valid: {', '.join(SEAMS)})")
             cfg = (cfg,) if isinstance(cfg, (int, float)) else tuple(cfg)
-            sched[seam] = _SeamState(*cfg)
+            if len(cfg) < 4:
+                cfg = cfg + (0, None, _default_kind(seam))[len(cfg) - 1:]
+            sched[seam] = _SeamState(*cfg, rank=rank)
     with _LOCK:
         _SCHEDULE = sched or None
     _arm_hot_hooks()
@@ -214,26 +282,40 @@ def _arm_hot_hooks():
     the schedule names 'h2d' — an is-None check is the whole off-path."""
     import sys
 
-    nd_mod = sys.modules.get("incubator_mxnet_tpu.ndarray.ndarray")
-    if nd_mod is None:        # early arming (worker bootstrap): ndarray
-        return                # installs the hook itself at import
     sched = _SCHEDULE
-    nd_mod._FAULT_HOOK = _h2d_probe if (sched and "h2d" in sched) else None
+    nd_mod = sys.modules.get("incubator_mxnet_tpu.ndarray.ndarray")
+    if nd_mod is not None:    # else early arming (worker bootstrap):
+        nd_mod._FAULT_HOOK = (_h2d_probe     # ndarray self-arms at import
+                              if (sched and "h2d" in sched) else None)
+    dist_mod = sys.modules.get("incubator_mxnet_tpu.parallel.dist")
+    if dist_mod is not None:  # dist self-arms at import too (_rearm_hooks)
+        dist_mod._FAULT_HOOK = (
+            _collective_probe
+            if (sched and "collective_delay" in sched) else None)
 
 
 def _h2d_probe(nbytes):  # noqa: ARG001 — hook signature shared with telemetry
     inject_at("h2d")
 
 
+def _collective_probe():
+    inject_at("collective_delay")
+
+
 def inject_at(seam):
     """Probe point: no-op unless the armed schedule names `seam`, in which
-    case a seeded Bernoulli draw decides whether to raise
-    :class:`FaultInjected`. Draw order is deterministic per seam."""
+    case a seeded Bernoulli draw decides whether to fire — raising
+    :class:`FaultInjected` (kinds ``fault``/``oom``) or sleeping
+    ``MXNET_FAULT_DELAY_MS`` (kind ``delay``). Draw order is
+    deterministic per seam; an ``@rank``-targeted seam draws only on
+    that rank (so each rank's sequence stays deterministic)."""
     sched = _SCHEDULE
     if sched is None:                 # the dead branch
         return
     st = sched.get(seam)
     if st is None:
+        return
+    if st.rank is not None and st.rank != _self_rank():
         return
     with _LOCK:
         st.draws += 1
@@ -255,6 +337,15 @@ def inject_at(seam):
         # so the flight-recorder dump shows WHERE the chaos landed
         tracing.event("fault.injected", seam=seam, draw=draw,
                       kind=st.kind)
+        if st.kind == _DELAY_KIND:
+            import time
+
+            d = _delay_seconds()
+            registry.counter("mx_fault_delay_seconds_total",
+                             "seconds slept by delay-kind injected "
+                             "faults", labels={"seam": seam}).inc(d)
+            time.sleep(d)
+            return
         raise _KINDS[st.kind](seam, draw)
 
 
@@ -266,6 +357,6 @@ def schedule_info():
         return {}
     with _LOCK:
         return {seam: {"prob": st.prob, "seed": st.seed, "limit": st.limit,
-                       "kind": st.kind, "draws": st.draws,
-                       "fired": st.fired}
+                       "kind": st.kind, "rank": st.rank,
+                       "draws": st.draws, "fired": st.fired}
                 for seam, st in sched.items()}

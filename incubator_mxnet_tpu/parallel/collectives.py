@@ -3,11 +3,51 @@ trees and NCCL/ps-lite: `psum`/`all_gather`/`ppermute` ride ICI links and XLA
 overlaps them with compute — the latency-hiding the reference built P3 for).
 
 These are meant to be called INSIDE a shard_map'ed/pjit'ed function; thin
-wrappers around jax.lax so user code never imports jax directly."""
+wrappers around jax.lax so user code never imports jax directly. They are
+also the fleet profiler's census point: when `telemetry.fleet` is enabled,
+every wrapper reports its op/axis/payload-bytes through the module-global
+`_CENSUS` hook (a trace-time count — host wall time inside a traced body
+would measure tracing, not execution; `fleet.probe_collectives` owns honest
+per-op seconds). Lint FL014 keeps raw `lax` collectives in `parallel/` and
+`serve/` routed through here so the census can't be bypassed."""
 from __future__ import annotations
 
 __all__ = ["all_reduce", "all_gather", "reduce_scatter", "broadcast",
-           "ring_permute"]
+           "ring_permute", "all_to_all", "axis_size", "pvary"]
+
+
+def pvary(x, axis_name):
+    """Mark a value device-varying over `axis_name` — shard_map's
+    replication-typing escape hatch for loop carries whose body outputs
+    are varying (ppermute/axis_index inside). `jax.lax.pvary` where the
+    pinned jax has it; otherwise adding a zeroed `axis_index` term gives
+    the checker a varying operand and XLA folds the arithmetic away.
+    Not a comms op, so no census."""
+    import jax
+
+    from ..ndarray.ndarray import NDArray
+
+    v = x._data if isinstance(x, NDArray) else x
+    names = (axis_name,) if isinstance(axis_name, str) else tuple(axis_name)
+    if hasattr(jax.lax, "pvary"):
+        out = jax.lax.pvary(v, names)
+    else:
+        out = v
+        for ax in names:
+            zero = jax.lax.convert_element_type(
+                jax.lax.axis_index(ax) * 0, v.dtype)
+            out = out + zero
+    return NDArray(out) if isinstance(x, NDArray) else out
+
+
+def axis_size(axis_name):
+    """Static size of a mapped axis (a Python int inside shard_map/pjit).
+    `lax.psum` of the literal 1 constant-folds to the axis size — the
+    portable spelling (`jax.lax.axis_size` is newer than this build's
+    pinned jax). Not a comms op, so no census."""
+    import jax
+
+    return jax.lax.psum(1, axis_name)
 
 
 def all_reduce(x, axis_name, op="sum"):
@@ -16,6 +56,9 @@ def all_reduce(x, axis_name, op="sum"):
     from ..ndarray.ndarray import NDArray
 
     v = x._data if isinstance(x, NDArray) else x
+    c = _CENSUS
+    if c is not None:
+        c("all_reduce", axis_name, v)
     if op == "sum":
         out = jax.lax.psum(v, axis_name)
     elif op == "mean":
@@ -35,6 +78,9 @@ def all_gather(x, axis_name, axis=0, tiled=True):
     from ..ndarray.ndarray import NDArray
 
     v = x._data if isinstance(x, NDArray) else x
+    c = _CENSUS
+    if c is not None:
+        c("all_gather", axis_name, v)
     out = jax.lax.all_gather(v, axis_name, axis=axis, tiled=tiled)
     return NDArray(out) if isinstance(x, NDArray) else out
 
@@ -45,6 +91,9 @@ def reduce_scatter(x, axis_name, axis=0):
     from ..ndarray.ndarray import NDArray
 
     v = x._data if isinstance(x, NDArray) else x
+    c = _CENSUS
+    if c is not None:
+        c("reduce_scatter", axis_name, v)
     out = jax.lax.psum_scatter(v, axis_name, scatter_dimension=axis, tiled=True)
     return NDArray(out) if isinstance(x, NDArray) else out
 
@@ -55,11 +104,12 @@ def broadcast(x, axis_name, src=0):
     from ..ndarray.ndarray import NDArray
 
     v = x._data if isinstance(x, NDArray) else x
+    c = _CENSUS
+    if c is not None:
+        c("broadcast", axis_name, v)
     idx = jax.lax.axis_index(axis_name)
-    n = jax.lax.psum(1, axis_name)
     mask = (idx == src).astype(v.dtype)
     out = jax.lax.psum(v * mask, axis_name)
-    del n
     return NDArray(out) if isinstance(x, NDArray) else out
 
 
@@ -71,7 +121,41 @@ def ring_permute(x, axis_name, shift=1):
     from ..ndarray.ndarray import NDArray
 
     v = x._data if isinstance(x, NDArray) else x
-    n = jax.lax.psum(1, axis_name)
+    c = _CENSUS
+    if c is not None:
+        c("ring_permute", axis_name, v)
+    n = axis_size(axis_name)
     perm = [(i, (i + shift) % n) for i in range(n)]
     out = jax.lax.ppermute(v, axis_name, perm)
     return NDArray(out) if isinstance(x, NDArray) else out
+
+
+def all_to_all(x, axis_name, split_axis, concat_axis, tiled=True):
+    """Expert-parallel dispatch/return primitive: every device scatters
+    `split_axis` slices to its peers and concatenates what it receives
+    along `concat_axis` (the MoE all-to-all; see `parallel/moe.py`)."""
+    import jax
+
+    from ..ndarray.ndarray import NDArray
+
+    v = x._data if isinstance(x, NDArray) else x
+    c = _CENSUS
+    if c is not None:
+        c("all_to_all", axis_name, v)
+    out = jax.lax.all_to_all(v, axis_name, split_axis=split_axis,
+                             concat_axis=concat_axis, tiled=tiled)
+    return NDArray(out) if isinstance(x, NDArray) else out
+
+
+_CENSUS = None   # armed by telemetry.fleet.enable(): (op, axis, value) hook
+
+
+def _rearm_hooks():
+    import sys
+
+    fleet = sys.modules.get(__name__.rsplit(".", 2)[0] + ".telemetry.fleet")
+    if fleet is not None and fleet.is_enabled():
+        fleet._arm()
+
+
+_rearm_hooks()

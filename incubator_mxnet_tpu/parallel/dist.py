@@ -13,18 +13,32 @@ processes because allreduce subsumes the push/pull round trip.
 outside a pjit'ed train step: each process contributes its host-local
 value as one shard of a global array along a 'host' axis, and a tiny jit
 program sums over that axis with replicated output.
+
+Transports: the XLA path above is the production one (ICI/DCN). jaxlib
+implements cross-process XLA computations only for TPU/GPU backends, so
+on a CPU fleet (multi-process tests, `tools/launch.py` dev runs) every
+dist op instead rides the **coordination-service host transport**: an
+allgather over the rendezvous server's gRPC key-value store
+(`key_value_set_bytes` + barriers), reduced host-side. Selection is
+automatic (CPU backend, or first XLA "Multiprocess computations aren't
+implemented" error); ``MXNET_DIST_TRANSPORT=xla|host`` forces a side.
 """
 from __future__ import annotations
 
 import logging
 import os
+import threading
 
 __all__ = ["initialize", "is_initialized", "rank", "num_processes",
            "allreduce", "broadcast", "barrier"]
 
 _LOG = logging.getLogger("incubator_mxnet_tpu.parallel.dist")
 
-_STATE = {"initialized": False, "mesh": None, "reducers": {}}
+_STATE = {"initialized": False, "mesh": None, "reducers": {},
+          "transport": None,     # None=undecided, "xla" | "host"
+          "host_seq": 0}
+_HOST_SEQ_LOCK = threading.Lock()
+_HOST_TIMEOUT_MS = 120_000
 
 
 def _transient_rendezvous(exc):
@@ -169,12 +183,143 @@ def _reducer(op):
 
 def allreduce(x, op="sum"):
     """Reduce a host-local array across all processes; every process gets
-    the full result. Single-process: returns x unchanged."""
+    the full result. Single-process: returns x unchanged.
+
+    The multi-process path is the choke point every other dist op rides
+    (broadcast/barrier/exchange_objs), so it carries the
+    ``collective_delay`` chaos seam (`_FAULT_HOOK`, armed by
+    `fault.injection`) and the fleet profiler (`_PROF`, armed by
+    `telemetry.fleet.enable()`) — both module-global is-None dead
+    branches when off."""
     import jax
     import jax.numpy as jnp
 
+    fh = _FAULT_HOOK
+    if fh is not None:
+        fh()          # fires single-process too: deterministic chaos units
     if jax.process_count() == 1:
         return jnp.asarray(x)
+    prof = _PROF
+    if prof is None:
+        return _allreduce_any(x, op)
+    x = jnp.asarray(x)
+    with prof.dist_op("allreduce", x.size * x.dtype.itemsize, red=op):
+        return _allreduce_any(x, op)
+
+
+def _use_host_transport():
+    forced = os.environ.get("MXNET_DIST_TRANSPORT")
+    if forced in ("host", "xla"):
+        return forced == "host"
+    if _STATE["transport"] is not None:
+        return _STATE["transport"] == "host"
+    import jax
+
+    # jaxlib's CPU backend has no cross-process computations at all —
+    # decide proactively instead of paying a failed compile per call
+    host = jax.devices()[0].platform == "cpu"
+    _STATE["transport"] = "host" if host else "xla"
+    if host:
+        _LOG.info("dist: CPU backend — collectives ride the "
+                  "coordination-service host transport")
+    return host
+
+
+def _is_no_multiprocess_backend(e):
+    return "multiprocess computations aren't implemented" in str(e).lower()
+
+
+def _allreduce_any(x, op):
+    if _use_host_transport():
+        return _host_allreduce(x, op)
+    try:
+        return _allreduce_impl(x, op)
+    except Exception as e:
+        if not _is_no_multiprocess_backend(e):
+            raise
+        _LOG.warning(
+            "dist.allreduce: XLA cross-process collectives unavailable on "
+            "this backend (%s) — falling back to the coordination-service "
+            "host transport", e)
+        _STATE["transport"] = "host"
+        return _host_allreduce(x, op)
+
+
+def _coord_client():
+    from jax._src import distributed
+
+    client = getattr(distributed.global_state, "client", None)
+    if client is None:
+        raise RuntimeError(
+            "dist: coordination-service client unavailable — initialize() "
+            "must join the multi-process runtime before host-transport "
+            "collectives")
+    return client
+
+
+def _host_allgather_bytes(payload, tag):
+    """Allgather raw bytes over the rendezvous server's gRPC key-value
+    store: each rank posts its payload under a per-collective sequence
+    key, a barrier orders post→read, and a second barrier keeps deletes
+    from racing slower readers. Every rank issues collectives in the
+    same order, so the local counter agrees fleet-wide. Returns every
+    rank's payload, index = rank."""
+    import jax
+
+    client = _coord_client()
+    nproc = jax.process_count()
+    me = jax.process_index()
+    with _HOST_SEQ_LOCK:
+        _STATE["host_seq"] += 1
+        seq = _STATE["host_seq"]
+    pfx = f"mx/hostcoll/{tag}/{seq}"
+    key = f"{pfx}/{me:03d}"
+    try:
+        client.key_value_set_bytes(key, bytes(payload))
+    except Exception:
+        # a retried collective can collide with its own stale key
+        client.key_value_delete(key)
+        client.key_value_set_bytes(key, bytes(payload))
+    client.wait_at_barrier(f"{pfx}/post", _HOST_TIMEOUT_MS)
+    blobs = [client.blocking_key_value_get_bytes(f"{pfx}/{r:03d}",
+                                                 _HOST_TIMEOUT_MS)
+             for r in range(nproc)]
+    client.wait_at_barrier(f"{pfx}/done", _HOST_TIMEOUT_MS)
+    client.key_value_delete(key)
+    return blobs
+
+
+def _host_allreduce(x, op):
+    import numpy as onp
+
+    import jax.numpy as jnp
+
+    arr = onp.asarray(x)
+    blobs = _host_allgather_bytes(arr.tobytes(), "allreduce")
+    vals = [onp.frombuffer(b, dtype=arr.dtype).reshape(arr.shape)
+            for b in blobs]
+    stack = onp.stack(vals)
+    if op in ("sum", "mean"):
+        # widen integer accumulation (the XLA path's jnp.sum promotes
+        # too), then return the input dtype like the jit reducer does
+        acc = stack.sum(axis=0, dtype=(arr.dtype if arr.dtype.kind == "f"
+                                       else onp.int64))
+        if op == "mean":
+            out = (acc / len(vals)).astype(
+                arr.dtype if arr.dtype.kind == "f" else onp.float32)
+        else:
+            out = acc.astype(arr.dtype)
+    elif op == "max":
+        out = stack.max(axis=0)
+    else:
+        raise ValueError(f"dist.allreduce: unknown op {op!r}")
+    return jnp.asarray(out)
+
+
+def _allreduce_impl(x, op):
+    import jax
+    import jax.numpy as jnp
+
     mesh = _host_mesh()
     P = jax.sharding.PartitionSpec
     sh = jax.sharding.NamedSharding(mesh, P(("host", "local")))
@@ -207,6 +352,17 @@ def broadcast(x, root=0):
     if jax.process_count() == 1:
         return jnp.asarray(x)
     x = jnp.asarray(x)
+    prof = _PROF
+    if prof is None:
+        return _broadcast_impl(x, root)
+    with prof.dist_op("broadcast", x.size * x.dtype.itemsize, root=root):
+        return _broadcast_impl(x, root)
+
+
+def _broadcast_impl(x, root):
+    import jax
+    import jax.numpy as jnp
+
     contrib = x if jax.process_index() == root else jnp.zeros_like(x)
     return allreduce(contrib, op="sum")
 
@@ -215,7 +371,20 @@ def barrier(tag="barrier"):
     import jax
 
     if jax.process_count() > 1:
-        allreduce(jax.numpy.zeros((1,), "float32")).block_until_ready()
+        prof = _PROF
+        if prof is None:
+            _barrier_impl()
+        else:
+            # fleet wraps the barrier in a coll_seq-stamped span and
+            # (sampled) exchanges arrival timestamps — the straggler
+            # signal (see telemetry/fleet.py)
+            prof.barrier_probe(tag, _barrier_impl)
+
+
+def _barrier_impl():
+    import jax
+
+    allreduce(jax.numpy.zeros((1,), "float32")).block_until_ready()
 
 
 _EXCHANGE_OVERSIZE = "__exchange_objs_oversize__"
@@ -229,6 +398,19 @@ def exchange_objs(obj, max_bytes=4096):
     command channel for remote-process profiler control (reference:
     `KVStoreServerProfilerCommand`, `include/mxnet/kvstore.h:48` —
     commands ride ps-lite messages there, collectives here)."""
+    import jax
+
+    if not is_initialized() or jax.process_count() == 1:
+        return [obj]
+    prof = _PROF
+    if prof is None:
+        return _exchange_objs_impl(obj, max_bytes)
+    with prof.dist_op("exchange_objs",
+                      jax.process_count() * max_bytes):
+        return _exchange_objs_impl(obj, max_bytes)
+
+
+def _exchange_objs_impl(obj, max_bytes):
     import pickle
 
     import numpy as onp
@@ -236,8 +418,6 @@ def exchange_objs(obj, max_bytes=4096):
     import jax
     import jax.numpy as jnp
 
-    if not is_initialized() or jax.process_count() == 1:
-        return [obj]
     payload = pickle.dumps(obj)
     oversize = len(payload) > max_bytes - 4
     if oversize:
@@ -265,3 +445,24 @@ def exchange_objs(obj, max_bytes=4096):
             f"exchange_objs: a rank's object exceeded the {max_bytes}-byte "
             "command slot (all ranks raised after the collective)")
     return out
+
+
+# hot hooks (module-global is-None dead branches, re-armed on import so
+# arming order vs import order doesn't matter):
+_FAULT_HOOK = None   # fault.injection._arm_hot_hooks: collective_delay seam
+_PROF = None         # telemetry.fleet.enable(): collective profiler
+
+
+def _rearm_hooks():
+    import sys
+
+    pkg = __name__.rsplit(".", 2)[0]
+    inj = sys.modules.get(pkg + ".fault.injection")
+    if inj is not None:
+        inj._arm_hot_hooks()
+    fleet = sys.modules.get(pkg + ".telemetry.fleet")
+    if fleet is not None and fleet.is_enabled():
+        fleet._arm()
+
+
+_rearm_hooks()

@@ -110,10 +110,11 @@ def moe_dispatch_combine(x, gate_logits, expert_fn, capacity_factor=1.25,
     Returns (out (T_local, D), aux_loss).
     """
     import jax.numpy as jnp
-    from jax import lax
+
+    from . import collectives
 
     t, e = gate_logits.shape
-    n_groups = 1 if axis_name is None else lax.axis_size(axis_name)
+    n_groups = 1 if axis_name is None else collectives.axis_size(axis_name)
     if e % n_groups:
         raise ValueError(f"{e} experts not divisible over {n_groups} "
                          "expert-parallel groups")
@@ -134,14 +135,15 @@ def moe_dispatch_combine(x, gate_logits, expert_fn, capacity_factor=1.25,
         # dispatch: each device keeps slots for ITS experts and receives
         # the matching slots from every peer — expert axis splits G-ways,
         # peers' contributions concatenate along the capacity axis
-        slots = lax.all_to_all(slots, axis_name, split_axis=0,
-                               concat_axis=1, tiled=True)
+        slots = collectives.all_to_all(slots, axis_name, split_axis=0,
+                                       concat_axis=1, tiled=True)
         # -> (E/G, G*C, D)
     out_slots = expert_fn(slots)
     if axis_name is not None:
         # return: inverse permutation
-        out_slots = lax.all_to_all(out_slots, axis_name, split_axis=1,
-                                   concat_axis=0, tiled=True)
+        out_slots = collectives.all_to_all(out_slots, axis_name,
+                                           split_axis=1, concat_axis=0,
+                                           tiled=True)
         # -> (E, C, D), rows for OUR tokens back home
     out = jnp.einsum("ecd,tec->td", out_slots, combine)
     return out, aux

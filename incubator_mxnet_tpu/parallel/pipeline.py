@@ -56,11 +56,12 @@ def pipeline_apply(stage_fn, stage_params, x, axis_name="pp"):
     import jax.numpy as jnp
     from jax import lax
 
-    n_stages = lax.axis_size(axis_name)
+    from . import collectives
+
+    n_stages = collectives.axis_size(axis_name)
     stage = lax.axis_index(axis_name)
     n_micro = x.shape[0]
     ticks = n_stages + n_micro - 1
-    perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
 
     def tick(carry, t):
         recv, outputs = carry
@@ -79,14 +80,14 @@ def pipeline_apply(stage_fn, stage_params, x, axis_name="pp"):
         banked = jnp.where(take, act_out, current)
         outputs = lax.dynamic_update_index_in_dim(outputs, banked,
                                                   out_idx, 0)
-        sent = lax.ppermute(act_out, axis_name, perm)
+        sent = collectives.ring_permute(act_out, axis_name)
         return (sent, outputs), None
 
     # the carry becomes device-varying (ppermute/axis_index inside the
     # body); under shard_map's varying-manual-axes typing the INITIAL
     # carry must be marked varying too
-    zero = lax.pvary(jnp.zeros_like(x[0]), axis_name)
-    outputs0 = lax.pvary(jnp.zeros_like(x), axis_name)
+    zero = collectives.pvary(jnp.zeros_like(x[0]), axis_name)
+    outputs0 = collectives.pvary(jnp.zeros_like(x), axis_name)
     (_, outputs), _ = lax.scan(tick, (zero, outputs0),
                                jnp.arange(ticks))
     return outputs
@@ -124,6 +125,7 @@ class PipelineParallel:
         from jax import lax
         from jax.sharding import NamedSharding, PartitionSpec as P
 
+        from . import collectives
         from ..ndarray.ndarray import NDArray
 
         self.mesh = mesh
@@ -151,12 +153,15 @@ class PipelineParallel:
                 outs = pipeline_apply(stage_fn, p_local, x, axis_name)
                 stage_loss = loss_fn(outs, y)
                 last = lax.axis_index(axis_name) == n_stages - 1
-                # only the LAST stage banked real outputs; psum makes the
-                # scalar (and its cotangent) global
-                return lax.psum(
-                    jnp.where(last, stage_loss, 0.0), axis_name)
+                # only the LAST stage banked real outputs; keep the
+                # scalar per-device here — this build's shard_map psum
+                # transpose over-counts the cotangent by the axis size,
+                # so the global reduce happens OUTSIDE value_and_grad
+                # (ppermute transposes already route stage cotangents)
+                return jnp.where(last, stage_loss, 0.0)
 
             loss, grads = jax.value_and_grad(loss_of)(params)
+            loss = collectives.all_reduce(loss, axis_name)
             p_leaves = jax.tree.leaves(params)
             g_leaves = jax.tree.leaves(grads)
             new_p, new_s = [], []
@@ -171,9 +176,11 @@ class PipelineParallel:
                     new_s)
 
         psp = P(axis_name)
+        from jax.experimental.shard_map import shard_map
+
         from ..telemetry.compiles import ledgered_jit
 
-        self._jit = ledgered_jit(jax.shard_map(
+        self._jit = ledgered_jit(shard_map(
             device_fn, mesh=mesh,
             in_specs=(psp, psp, P(), P(), P()),
             out_specs=(P(), psp, psp)), family="train.pipeline.step")

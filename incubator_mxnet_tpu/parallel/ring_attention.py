@@ -34,10 +34,12 @@ def ring_attention(q, k, v, axis_name, causal=False, sm_scale=None):
     import jax.numpy as jnp
     from jax import lax
 
+    from . import collectives
+
     b, h, t_local, d = q.shape
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(d)
-    n = lax.psum(1, axis_name)
+    n = collectives.axis_size(axis_name)
     my = lax.axis_index(axis_name)
 
     neg_inf = jnp.asarray(-jnp.inf, jnp.float32)
@@ -75,17 +77,18 @@ def ring_attention(q, k, v, axis_name, causal=False, sm_scale=None):
         # rotate K/V to the next device (skippable on the last step, but a
         # static-trip fori_loop keeps the loop body uniform; XLA overlaps
         # the permute with the next block's einsum)
-        src_dst = [(j, (j + 1) % n) for j in range(n)]
-        k_blk = lax.ppermute(k_blk, axis_name, src_dst)
-        v_blk = lax.ppermute(v_blk, axis_name, src_dst)
+        k_blk = collectives.ring_permute(k_blk, axis_name)
+        v_blk = collectives.ring_permute(v_blk, axis_name)
         return o, m, l, k_blk, v_blk
 
     # initial accumulators must carry the shard_map device-varying type of
     # the loop outputs (they depend on axis_index after one trip)
-    o0 = lax.pvary(jnp.zeros((b, h, t_local, d), jnp.float32), (axis_name,))
-    m0 = lax.pvary(jnp.full((b, h, t_local), -jnp.inf, jnp.float32),
-                   (axis_name,))
-    l0 = lax.pvary(jnp.zeros((b, h, t_local), jnp.float32), (axis_name,))
+    o0 = collectives.pvary(jnp.zeros((b, h, t_local, d), jnp.float32),
+                           (axis_name,))
+    m0 = collectives.pvary(jnp.full((b, h, t_local), -jnp.inf, jnp.float32),
+                           (axis_name,))
+    l0 = collectives.pvary(jnp.zeros((b, h, t_local), jnp.float32),
+                           (axis_name,))
     o, m, l, _, _ = lax.fori_loop(0, n, body, (o0, m0, l0, k, v))
     out = o / jnp.maximum(l, 1e-30)[..., None]
     return out.astype(q.dtype)
@@ -96,7 +99,7 @@ def ring_self_attention(q, k, v, mesh=None, axis="sp", causal=False,
     """NDArray-level ring attention: shards the sequence dim of
     (B, H, T, D) inputs over `axis` of the active mesh and runs
     `ring_attention` under shard_map."""
-    import jax
+    from jax.experimental.shard_map import shard_map
     from jax.sharding import PartitionSpec as P
 
     from ..ndarray.ndarray import NDArray
@@ -111,7 +114,7 @@ def ring_self_attention(q, k, v, mesh=None, axis="sp", causal=False,
     vv = v._data if isinstance(v, NDArray) else v
 
     spec = P(None, None, axis, None)  # shard T of (B, H, T, D)
-    fn = jax.shard_map(
+    fn = shard_map(
         partial(ring_attention, axis_name=axis, causal=causal,
                 sm_scale=sm_scale),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)

@@ -30,7 +30,13 @@ Six connected parts:
   argument (``mx_jit_recompiles_total{program=,cause=}``);
 - `hbm`       — subsystem-attributed live-buffer census over
   ``jax.live_arrays()``, growth watchdog (``MXNET_MEMWATCH_INTERVAL``),
-  and the RESOURCE_EXHAUSTED post-mortem (``MXNET_OOM_POSTMORTEM``).
+  and the RESOURCE_EXHAUSTED post-mortem (``MXNET_OOM_POSTMORTEM``);
+- `fleet`     — the cross-rank plane: collective profiler over
+  `parallel/dist.py` + `parallel/collectives.py` (``mx_collective_*``,
+  barrier-arrival skew), `fleet_report()` per-rank/aggregate registry
+  views with a straggler z-score, clock-offset estimation + stitched
+  multi-rank timelines (``tools/trace_timeline.py --fleet``), and the
+  crash-fanout flight recorder merged by ``tools/fleetwatch.py``.
 
 Env knobs (registered in `util._ENV_KNOBS`): ``MXNET_TELEMETRY``
 (``1`` = stage + span tracing on, ``raise`` = + NaN guard raising at the
@@ -49,6 +55,7 @@ from . import slo  # noqa: F401
 from . import monitor  # noqa: F401
 from . import compiles  # noqa: F401
 from . import hbm  # noqa: F401
+from . import fleet  # noqa: F401
 from .monitor import Monitor, install_nan_hook  # noqa: F401
 
 # arm the host->device byte inlet (a counter inc per transfer — rare
@@ -58,4 +65,4 @@ from ..ndarray import ndarray as _nd_mod
 _nd_mod._H2D_HOOK = registry.add_h2d_bytes
 
 __all__ = ["registry", "stages", "tracing", "slo", "roofline", "monitor",
-           "compiles", "hbm", "Monitor", "install_nan_hook"]
+           "compiles", "hbm", "fleet", "Monitor", "install_nan_hook"]

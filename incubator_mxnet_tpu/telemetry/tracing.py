@@ -455,6 +455,9 @@ def _flight_dir():
 
 
 _FLIGHT_CONTEXT = {}          # name -> probe() returning a JSON-able dict
+_RANK_STAMP = None            # set by telemetry.fleet on multi-rank runs:
+                              # rank-stamps default flightrec filenames so
+                              # a shared dir keeps every rank's dump apart
 
 
 def register_flight_context(name, probe):
@@ -507,8 +510,10 @@ def flight_dump(reason, exc=None, path=None):
     if path is None:
         safe = "".join(c if c.isalnum() or c in "-_." else "_"
                        for c in str(reason))[:60]
+        stamp = (f"rank{_RANK_STAMP:03d}_" if _RANK_STAMP is not None
+                 else "")
         path = os.path.join(_flight_dir(),
-                            f"flightrec_{safe}_{os.getpid()}.json")
+                            f"flightrec_{safe}_{stamp}{os.getpid()}.json")
     tmp = f"{path}.tmp.{os.getpid()}"
     with open(tmp, "w") as f:
         json.dump(payload, f, indent=1)

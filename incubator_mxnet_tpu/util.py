@@ -219,11 +219,46 @@ _ENV_KNOBS = {
         "flight-recorder dumps (default: benchmark/ when present, else "
         "cwd) (honored, this build's addition)"),
     "MXNET_FAULT_INJECT": (
-        "fault.injection", "seeded chaos schedule 'seam:prob[:seed"
-        "[:limit[:kind]]],...' (kind: fault | oom) armed at import "
+        "fault.injection", "seeded chaos schedule 'seam[@rank]:prob"
+        "[:seed[:limit[:kind]]],...' (kind: fault | oom | delay; @rank "
+        "targets one process of a multi-rank launch) armed at import "
         "(incl. spawned DataLoader "
         "workers); unset = every probe a dead branch (honored, this "
         "build's addition — see RESILIENCE.md)"),
+    "MXNET_FAULT_DELAY_MS": (
+        "fault.injection", "milliseconds a delay-kind injected fault "
+        "sleeps (default 50) — the deterministic-straggler magnitude "
+        "for the collective_delay seam (honored, this build's addition "
+        "— see TELEMETRY.md)"),
+    "MXNET_FLEET": (
+        "telemetry.fleet", "1 = arm the cross-rank fleet plane alone "
+        "(collective profiler, barrier skew, flightrec rank stamp + "
+        "crash fanout); also armed by MXNET_TELEMETRY; enable on EVERY "
+        "rank or none (honored, this build's addition — see "
+        "TELEMETRY.md)"),
+    "MXNET_FLEET_SKEW_EVERY": (
+        "telemetry.fleet", "sample the barrier arrival-skew exchange "
+        "every Nth barrier (default 1 = every barrier; 0 = off — the "
+        "exchange adds one collective per sampled barrier) (honored, "
+        "this build's addition — see TELEMETRY.md)"),
+    "MXNET_FLEET_CHUNK_BYTES": (
+        "telemetry.fleet.exchange_large", "chunk size for registry-"
+        "snapshot exchange past the 4 KiB exchange_objs slot (default "
+        "3000) (honored, this build's addition)"),
+    "MXNET_FLEET_STRAGGLER_Z": (
+        "telemetry.fleet.install_health_check", "straggler z-score "
+        "above which monitor.check() raises (default 2.5) (honored, "
+        "this build's addition — see TELEMETRY.md)"),
+    "MXNET_FLEET_TRACE_DIR": (
+        "telemetry.fleet.dump_rank_trace", "directory for per-rank "
+        "fleet span dumps (default: the flightrec dir) (honored, this "
+        "build's addition)"),
+    "MXNET_DIST_TRANSPORT": (
+        "parallel.dist", "force the multi-process collective transport: "
+        "'xla' (global-mesh jit reduce, the TPU/GPU production path) or "
+        "'host' (coordination-service allgather — what CPU fleets use); "
+        "unset = auto-detect per backend (honored, this build's "
+        "addition)"),
     "MXNET_RETRY_MAX": (
         "fault.RetryPolicy.from_env", "default max retries for the "
         "kvstore/dist_init/checkpoint policies (default 3) (honored, "
@@ -379,16 +414,24 @@ def _apply_env_config():
             pass
     telem = os.environ.get("MXNET_TELEMETRY", "0")
     if telem and telem != "0":
-        from .telemetry import compiles, hbm, monitor, stages, tracing
+        from .telemetry import compiles, fleet, hbm, monitor, stages, tracing
 
         stages.enable()
         tracing.enable()
         compiles.enable()       # per-program compile ledger + forensics
         hbm.enable()            # live-buffer census gauges + OOM seams
+        fleet.enable()          # cross-rank collective profiler + fanout
         if telem == "raise":
             monitor.install_nan_hook(mode="raise")
         elif telem == "warn":
             monitor.install_nan_hook(mode="warn")
+    if os.environ.get("MXNET_FLEET", "0") not in ("0", ""):
+        # standalone arming (fleet plane without the rest of telemetry);
+        # must be set on EVERY rank or none — the barrier skew exchange
+        # is itself a collective
+        from .telemetry import fleet as _fleet
+
+        _fleet.enable()
     watch = os.environ.get("MXNET_MEMWATCH_INTERVAL")
     if watch:
         try:

@@ -123,6 +123,16 @@ _QUICK = {
     "test_telemetry_observatory.py::test_census_attribution_first_claim_and_weak_binding",
     "test_tools.py::test_fl012_tree_is_clean",
     "test_tools.py::test_bench_regress_green_on_committed_history",
+    # fleet observability (ISSUE 12 gates): straggler z-score math,
+    # chunked snapshot transport, collective_delay seam, clock-offset
+    # stitching and flightrec merge on synthetic dumps, and the FL014
+    # collective-hygiene tree sweep — all host-side, no multi-process
+    "test_fleet.py::test_straggler_scores_slow_rank_wins",
+    "test_fleet.py::test_exchange_large_chunks_past_command_slot",
+    "test_fleet.py::test_collective_delay_sleeps_not_raises",
+    "test_fleet.py::test_stitch_traces_rebases_by_clock_offset",
+    "test_fleet.py::test_merge_flight_dumps_groups_by_rank",
+    "test_tools.py::test_fl014_tree_is_clean",
 }
 
 

@@ -1,0 +1,513 @@
+"""Fleet observability plane (ISSUE 12 gates): collective profiler +
+census, straggler scoring, chunked snapshot transport, clock-offset /
+stitching math, flight-recorder fanout merge, the collective_delay fault
+seam, and the 2-process end-to-end gate (launch.py recipe from
+test_kvstore_dist.py: an injected slow rank must win the straggler score
+and the stitched timeline must align barrier spans within the estimated
+clock-offset bound)."""
+import json
+import os
+import subprocess
+import sys
+import textwrap
+import time
+
+import numpy as onp
+import pytest
+
+import incubator_mxnet_tpu as mx  # noqa: F401 - package init (env knobs)
+from incubator_mxnet_tpu.fault import injection
+from incubator_mxnet_tpu.telemetry import fleet, registry, tracing
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_fleet():
+    fleet.reset()
+    injection.clear_injection()
+    yield
+    fleet.disable()
+    fleet.reset()
+    injection.clear_injection()
+
+
+# ---------------------------------------------------------------------------
+# straggler score math
+# ---------------------------------------------------------------------------
+
+def test_straggler_scores_slow_rank_wins():
+    samples = {0: {"step_time_mean": 0.10, "barrier_lateness_mean": 0.001},
+               1: {"step_time_mean": 0.10, "barrier_lateness_mean": 0.002},
+               2: {"step_time_mean": 0.45, "barrier_lateness_mean": 0.300}}
+    scores = fleet.straggler_scores(samples)
+    assert max(scores, key=scores.get) == 2
+    assert scores[2] > 1.0          # well above the mean on both signals
+    assert scores[0] <= 0.0 or scores[0] < scores[2]
+
+
+def test_straggler_scores_two_ranks_signed():
+    # n=2: the slow rank sits at z=+1, the fast at -1 — the SIGNED max
+    # keeps the argmax on the slow one
+    scores = fleet.straggler_scores(
+        {0: {"step_time_mean": 0.1}, 1: {"step_time_mean": 0.4}})
+    assert scores[1] == pytest.approx(1.0)
+    assert max(scores, key=scores.get) == 1
+
+
+def test_straggler_scores_ignores_sparse_and_flat_signals():
+    samples = {0: {"a": None, "flat": 5.0, "lone": 1.0},
+               1: {"a": None, "flat": 5.0}}
+    scores = fleet.straggler_scores(samples)
+    # None everywhere, zero spread, and single-rank signals contribute 0
+    assert scores == {0: 0.0, 1: 0.0}
+
+
+# ---------------------------------------------------------------------------
+# chunked snapshot transport
+# ---------------------------------------------------------------------------
+
+def _fake_fleet_transport(n_ranks, payloads, max_bytes=4096):
+    """A dist.exchange_objs stand-in: every rank sends the same thing the
+    local caller sends (identical code path on each rank), and the pickled
+    size contract of the real 4 KiB command slot is enforced."""
+    import pickle
+
+    def exchange(obj):
+        assert len(pickle.dumps(obj)) <= max_bytes - 4, "slot overflow"
+        return [obj for _ in range(n_ranks)]
+
+    return exchange
+
+
+def test_exchange_large_chunks_past_command_slot():
+    big = {"rank": 0, "blob": "x" * 50_000,
+           "nested": {str(i): float(i) for i in range(300)}}
+    out = fleet.exchange_large(
+        big, chunk=1000, _exchange=_fake_fleet_transport(3, big))
+    assert len(out) == 3
+    assert all(o == big for o in out)
+
+
+def test_exchange_large_small_object_single_round():
+    calls = []
+
+    def exchange(obj):
+        calls.append(obj)
+        return [obj, obj]
+
+    out = fleet.exchange_large({"ok": 1}, chunk=3000, _exchange=exchange)
+    assert out == [{"ok": 1}, {"ok": 1}]
+    # one metadata round (the count) + one piece round
+    assert len(calls) == 2 and calls[0] == 1
+
+
+def test_exchange_large_single_process_short_circuit():
+    obj = {"r": 0}
+    assert fleet.exchange_large(obj) == [obj]
+
+
+# ---------------------------------------------------------------------------
+# collective_delay fault seam (satellite 1)
+# ---------------------------------------------------------------------------
+
+def test_collective_delay_sleeps_not_raises(monkeypatch):
+    from incubator_mxnet_tpu.parallel import dist
+
+    monkeypatch.setenv("MXNET_FAULT_DELAY_MS", "60")
+    injection.configure_injection({"collective_delay": (1.0, 0, 2)})
+    assert dist._FAULT_HOOK is not None
+    x = onp.ones((4,), "float32")
+    dist.allreduce(x)                      # warm (fires once)
+    t0 = time.perf_counter()
+    out = dist.allreduce(x)                # fires again: sleep, no raise
+    dt = time.perf_counter() - t0
+    assert dt >= 0.055, dt
+    onp.testing.assert_allclose(onp.asarray(out), x)
+    rep = registry.report()
+    cell = rep.get('mx_fault_delay_seconds_total{seam="collective_delay"}')
+    assert cell and cell["value"] >= 0.11  # two 60 ms sleeps
+    # limit=2 exhausted: the third call is clean and fast
+    t0 = time.perf_counter()
+    dist.allreduce(x)
+    assert time.perf_counter() - t0 < 0.05
+
+
+def test_collective_delay_rank_targeting(monkeypatch):
+    from incubator_mxnet_tpu.parallel import dist
+
+    monkeypatch.setenv("MXNET_FAULT_DELAY_MS", "60")
+    monkeypatch.setenv("PROCESS_ID", "0")
+    injection.configure_injection({"collective_delay@1": (1.0, 0, 8)})
+    info = injection.schedule_info()["collective_delay"]
+    assert info["rank"] == 1 and info["kind"] == "delay"
+    x = onp.ones((2,), "float32")
+    dist.allreduce(x)                      # warm
+    t0 = time.perf_counter()
+    dist.allreduce(x)                      # we are rank 0: no delay
+    assert time.perf_counter() - t0 < 0.05
+    # retarget to OUR rank: the delay fires
+    injection.configure_injection({"collective_delay@0": (1.0, 0, 8)})
+    t0 = time.perf_counter()
+    dist.allreduce(x)
+    assert time.perf_counter() - t0 >= 0.055
+
+
+def test_collective_delay_env_spec_round_trip(monkeypatch):
+    monkeypatch.setenv("MXNET_FAULT_INJECT", "collective_delay@1:0.5:7:3")
+    injection.configure_from_env()
+    info = injection.schedule_info()["collective_delay"]
+    assert info == {"prob": 0.5, "seed": 7, "limit": 3, "kind": "delay",
+                    "rank": 1, "draws": 0, "fired": 0}
+
+
+# ---------------------------------------------------------------------------
+# census + probe (tentpole: in-graph wrappers)
+# ---------------------------------------------------------------------------
+
+def test_census_counts_traced_collective_bytes():
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from incubator_mxnet_tpu.parallel import collectives
+
+    fleet.enable()
+    assert collectives._CENSUS is not None
+    mesh = Mesh(onp.array(jax.devices()[:2]), ("dp",))
+
+    def f(v):
+        return collectives.all_reduce(v, "dp")
+
+    jf = jax.jit(shard_map(f, mesh=mesh, in_specs=(P("dp"),),
+                           out_specs=P(), check_rep=False))
+    jf(jnp.zeros((8, 4), jnp.float32)).block_until_ready()
+    rep = registry.report()
+    calls = rep.get('mx_collective_trace_calls_total'
+                    '{axis="dp",op="all_reduce"}')
+    assert calls and calls["value"] >= 1
+    nbytes = rep.get('mx_collective_bytes_total{axis="dp",op="all_reduce"}')
+    # per-shard payload at trace time: (4, 4) float32 = 64 B
+    assert nbytes and nbytes["value"] >= 64
+
+
+def test_census_off_is_dead_branch():
+    """PR-2 dead-branch contract for the wrapper hook: telemetry off,
+    the census probe is one global load + is-None check — <3% of even a
+    tiny traced op (the bench.py overhead gate measures the full wrapper;
+    this is the unit-level floor)."""
+    from incubator_mxnet_tpu.parallel import collectives
+
+    fleet.disable()
+    assert collectives._CENSUS is None
+    import jax.numpy as jnp
+
+    a = jnp.ones((16, 16), jnp.float32)
+    (a @ a).block_until_ready()
+    iters = 300
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        a @ a
+    import jax
+
+    jax.block_until_ready(a)
+    per_op = (time.perf_counter() - t0) / iters
+    c = collectives._CENSUS
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        if c is not None:                   # the literal off-path pattern
+            pass
+    probe_per_op = (time.perf_counter() - t0) / iters
+    assert probe_per_op < 0.03 * per_op, (probe_per_op, per_op)
+
+
+def test_probe_collectives_emits_series_for_every_op():
+    fleet.enable()
+    res = fleet.probe_collectives(nbytes=1 << 12, iters=1)
+    ops = [op for op in res if op != "_meta"]
+    assert set(ops) == {"all_reduce", "all_gather", "reduce_scatter",
+                        "broadcast", "ring_permute", "all_to_all"}
+    rep = registry.report()
+    axis = res["_meta"]["axis"]
+    for op in ops:
+        assert "error" not in res[op], (op, res[op])
+        assert res[op]["seconds"] > 0
+        key = f'mx_collective_seconds{{axis="{axis}",op="{op}"}}'
+        assert key in rep, key
+
+
+# ---------------------------------------------------------------------------
+# fleet report + health hook (single-process shape)
+# ---------------------------------------------------------------------------
+
+def test_fleet_report_single_process_and_gauges():
+    fleet.enable()
+    registry.step(0.02, examples=8)
+    rep = fleet.fleet_report()
+    assert rep["n_ranks"] == 1 and rep["rank"] == 0
+    assert 0 in rep["ranks"] and "registry" in rep["ranks"][0]
+    assert rep["straggler"]["rank"] == 0
+    g = registry.report()
+    assert g["mx_fleet_ranks"]["value"] == 1
+    assert g["mx_fleet_straggler_rank"]["value"] == 0
+
+
+def test_straggler_health_check_raises_past_threshold():
+    from incubator_mxnet_tpu.base import MXNetError
+
+    fleet.enable()
+    check = fleet.install_health_check(threshold=2.0)
+    fleet._LAST_REPORT = None
+    check()                                  # no report: silent
+    fleet._LAST_REPORT = {"straggler": {"rank": 3, "score": 2.6,
+                                        "signals": {3: {"step": 9.0}}}}
+    with pytest.raises(MXNetError, match="rank 3"):
+        check()
+    fleet._LAST_REPORT = {"straggler": {"rank": 1, "score": 0.4,
+                                        "signals": {}}}
+    check()                                  # under threshold: silent
+
+
+# ---------------------------------------------------------------------------
+# clock offsets, trace stitching, flightrec merge (host-side math)
+# ---------------------------------------------------------------------------
+
+def test_clock_offsets_single_process_zero():
+    out = fleet.estimate_clock_offsets()
+    assert out["offsets"] == [0.0] and out["bound_s"] == 0.0
+
+
+def _write_rank_dump(d, rank, offset_s, ts0_us, n_ranks=2):
+    spans = [{"trace_id": "t" * 32, "span_id": f"s{rank}{i}",
+              "parent_id": None, "name": "dist.barrier",
+              "ts_us": ts0_us + i * 10_000, "dur_us": 500.0,
+              "thread": 1, "lane": "dist",
+              "attrs": {"coll_seq": i + 1, "op": "barrier"}, "events": []}
+             for i in range(3)]
+    path = os.path.join(d, f"fleet_spans_rank{rank:03d}.json")
+    with open(path, "w") as fh:
+        json.dump({"rank": rank, "n_ranks": n_ranks, "host": f"h{rank}",
+                   "pid": 100 + rank, "clock_offset_s": offset_s,
+                   "offset_bound_s": 0.002, "fleet_trace": "t" * 32,
+                   "barrier": {}, "spans": spans}, fh)
+    return path
+
+
+def test_stitch_traces_rebases_by_clock_offset(tmp_path):
+    d = str(tmp_path)
+    # rank 1's clock runs 5 ms ahead: raw timestamps disagree by 5000 µs,
+    # the stitcher subtracts the estimated offset and realigns
+    _write_rank_dump(d, 0, 0.0, ts0_us=1_000_000.0)
+    _write_rank_dump(d, 1, 0.005, ts0_us=1_005_000.0)
+    out = fleet.stitch_traces(d)
+    assert out["fleet"] == {"n_ranks": 2, "files": 2, "n_spans": 6,
+                            "offset_bound_s": 0.002}
+    lanes = {e["pid"] for e in out["traceEvents"] if e["ph"] == "X"}
+    assert lanes == {3000, 3001}
+    by_seq: dict = {}
+    for e in out["traceEvents"]:
+        if e["ph"] != "X":
+            continue
+        by_seq.setdefault(e["args"]["coll_seq"], []).append(e["ts"])
+    for seq, ts in by_seq.items():
+        assert len(ts) == 2
+        assert abs(ts[0] - ts[1]) <= 0.002 * 1e6, (seq, ts)
+
+
+def test_stitch_traces_empty_dir_is_loud(tmp_path):
+    with pytest.raises(FileNotFoundError):
+        fleet.stitch_traces(str(tmp_path))
+
+
+def test_merge_flight_dumps_groups_by_rank(tmp_path):
+    d = str(tmp_path)
+    for rank, reason in ((0, "peer_crash"), (1, "crash")):
+        with open(os.path.join(
+                d, f"flightrec_{reason}_rank{rank:03d}_42.json"), "w") as fh:
+            json.dump({"reason": reason, "pid": 100 + rank,
+                       "error": {"type": "RuntimeError", "message": "boom"}
+                       if reason == "crash" else None,
+                       "context": {"fleet": {"rank": rank, "n_ranks": 2}},
+                       "spans": [{"name": "dist.barrier"}]}, fh)
+    with open(os.path.join(d, "fleet_crash_rank001.marker"), "w") as fh:
+        json.dump({"rank": 1, "pid": 101, "error": "RuntimeError: boom"},
+                  fh)
+    merged = fleet.merge_flight_dumps(d)
+    assert merged["n_ranks"] == 2 and merged["n_dumps"] == 2
+    assert merged["ranks"]["1"][0]["reason"] == "crash"
+    assert merged["ranks"]["0"][0]["reason"] == "peer_crash"
+    assert merged["markers"][0]["rank"] == 1
+    # the CLI formatter renders it without blowing up
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import fleetwatch
+    finally:
+        sys.path.pop(0)
+    text = fleetwatch.format_postmortem(merged)
+    assert "rank   1" in text and "peer_crash" in text
+
+
+def test_rank_stamped_flightrec_filename(tmp_path, monkeypatch):
+    monkeypatch.setenv("MXNET_FLIGHTREC_DIR", str(tmp_path))
+    tracing.enable()
+    old = tracing._RANK_STAMP
+    tracing._RANK_STAMP = 5
+    try:
+        with tracing.span("work"):
+            pass
+        path = tracing.flight_dump("unit")
+    finally:
+        tracing._RANK_STAMP = old
+        tracing.disable()
+        tracing.reset()
+    assert "rank005_" in os.path.basename(path)
+
+
+# ---------------------------------------------------------------------------
+# 2-process end-to-end gate (the multichip-dryrun recipe on CPU)
+# ---------------------------------------------------------------------------
+
+FLEET_WORKER = textwrap.dedent("""
+    import json, os, sys, time
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["JAX_PLATFORM_NAME"] = "cpu"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as onp
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu.fault import injection
+    from incubator_mxnet_tpu.parallel import dist
+    from incubator_mxnet_tpu.telemetry import fleet, registry, tracing
+
+    out_dir = os.environ["FLEET_TEST_DIR"]
+    dist.initialize()
+    rank, n = dist.rank(), dist.num_processes()
+    assert n == 2, n
+    assert fleet.is_enabled()            # armed by MXNET_TELEMETRY=1
+
+    dist.barrier("warmup")               # compiles the barrier program
+    fleet.estimate_clock_offsets(rounds=3)
+
+    # rank 1 is the straggler: slow local "steps" make it genuinely
+    # LATE at every barrier (the skew exchange sees real arrival gaps)
+    for i in range(4):
+        t0 = time.perf_counter()
+        time.sleep(0.25 if rank == 1 else 0.01)
+        registry.step(time.perf_counter() - t0, examples=8)
+        dist.barrier(f"step{i}")
+
+    # the injected collective_delay@1 fired on rank 1 ONLY (the @rank
+    # filter, live in a real multi-rank launch)
+    info = injection.schedule_info()["collective_delay"]
+    assert (info["fired"] > 0) == (rank == 1), (rank, info)
+
+    rep = fleet.fleet_report()
+    assert rep["n_ranks"] == 2, rep["n_ranks"]
+    assert rep["straggler"]["rank"] == 1, rep["straggler"]
+    assert rep["straggler"]["score"] > 0.5, rep["straggler"]
+    bs = fleet.barrier_stats()
+    if rank == 1:
+        assert bs["lateness_max"] >= 0.05, bs   # arrived late for real
+
+    series = registry.report()
+    for op in ("allreduce", "barrier", "exchange_objs"):
+        key = 'mx_collective_seconds{axis="host",op="%s"}' % op
+        assert key in series, (rank, key, sorted(series)[:10])
+
+    fleet.dump_rank_trace(out_dir)
+    with open(os.path.join(out_dir, f"report_rank{rank}.json"), "w") as fh:
+        json.dump({"straggler": rep["straggler"]["rank"],
+                   "clock": rep["clock"],
+                   "lateness_max": bs["lateness_max"]}, fh)
+    dist.barrier("final")
+    print(f"fleetworker {rank} ok straggler={rep['straggler']['rank']}",
+          flush=True)
+    if rank == 1 and os.environ.get("FLEET_TEST_CRASH") == "1":
+        raise RuntimeError("injected fleet crash (rank 1)")
+    if rank == 0 and os.environ.get("FLEET_TEST_CRASH") == "1":
+        time.sleep(4.0)   # outlive rank 1's crash; launch.py's SIGTERM
+                          # lands here and the fleet handler converts it
+                          # to SystemExit so atexit dumps peer_crash
+""")
+
+
+def _free_port():
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _run_fleet_workers(tmp_path, crash):
+    script = tmp_path / "fleet_worker.py"
+    script.write_text(FLEET_WORKER)
+    share = tmp_path / "share"
+    share.mkdir()
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)           # children: real 1-device CPU
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["MXNET_TELEMETRY"] = "1"
+    env["MXNET_FAULT_INJECT"] = "collective_delay@1:1.0:0:64"
+    env["MXNET_FAULT_DELAY_MS"] = "120"
+    env["MXNET_FLIGHTREC_DIR"] = str(share)
+    env["FLEET_TEST_DIR"] = str(share)
+    env["FLEET_TEST_CRASH"] = "1" if crash else "0"
+    res = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "launch.py"),
+         "-n", "2", "--port", str(_free_port()), sys.executable,
+         str(script)],
+        env=env, cwd=REPO, capture_output=True, text=True, timeout=280)
+    return res, share
+
+
+def test_fleet_two_process_straggler_and_stitch(tmp_path):
+    """The ISSUE 12 dryrun gate on CPU: collective_delay armed on rank 1
+    → every rank's fleet_report names rank 1 the straggler; both ranks'
+    span dumps stitch into one timeline whose matching coll_seq barrier
+    spans align within the estimated clock-offset bound."""
+    res, share = _run_fleet_workers(tmp_path, crash=False)
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "fleetworker 0 ok straggler=1" in res.stdout
+    assert "fleetworker 1 ok straggler=1" in res.stdout
+
+    stitched = fleet.stitch_traces(str(share))
+    assert stitched["fleet"]["n_ranks"] == 2
+    bound_us = max(stitched["fleet"]["offset_bound_s"], 0.005) * 1e6
+    barriers: dict = {}
+    for e in stitched["traceEvents"]:
+        if e.get("ph") == "X" and e["name"] == "dist.barrier":
+            barriers.setdefault(e["args"].get("coll_seq"),
+                                {})[e["args"]["rank"]] = e["ts"]
+    both = {s: t for s, t in barriers.items() if len(t) == 2}
+    assert both, barriers
+    # barrier EXIT instants coincide fleet-wide; rank 1 arrives late but
+    # the span ends (ts+dur ~ exit) within skew+offset of rank 0's
+    for seq, ts in both.items():
+        assert abs(ts[0] - ts[1]) < 1e6, (seq, ts)  # same second, sane
+
+    # the per-rank reports agree (every rank saw the same straggler)
+    reports = [json.loads((share / f"report_rank{r}.json").read_text())
+               for r in range(2)]
+    assert all(r["straggler"] == 1 for r in reports)
+    assert reports[0]["clock"]["offsets"] is not None
+
+
+def test_fleet_two_process_crash_fanout(tmp_path):
+    """Rank 1 crashes after the final barrier: its excepthook drops a
+    crash marker + rank-stamped flightrec, surviving rank 0's atexit
+    sees the marker and dumps peer_crash — merge_flight_dumps shows
+    BOTH ranks in one post-mortem."""
+    res, share = _run_fleet_workers(tmp_path, crash=True)
+    assert res.returncode != 0        # rank 1 died loudly
+    assert "fleetworker 0 ok" in res.stdout
+    assert "fleetworker 1 ok" in res.stdout
+    merged = fleet.merge_flight_dumps(str(share))
+    assert merged["markers"], "crashing rank left no marker"
+    assert merged["markers"][0]["rank"] == 1
+    ranks = merged["ranks"]
+    assert "1" in ranks, (sorted(ranks), merged["markers"])
+    assert any(d["reason"] == "crash" for d in ranks["1"])
+    assert "0" in ranks, (sorted(ranks), merged["markers"])
+    assert any(d["reason"] == "peer_crash" for d in ranks["0"])

@@ -5,6 +5,7 @@ import pytest
 
 import jax
 import jax.numpy as jnp
+from jax.experimental.shard_map import shard_map
 
 from incubator_mxnet_tpu.parallel.mesh import make_mesh, mesh_scope
 from incubator_mxnet_tpu.parallel.ring_attention import (ring_attention,
@@ -53,7 +54,7 @@ def test_ring_attention_gradients_match(sp_mesh):
 
     q, k, v = _qkv()
     spec = P(None, None, "sp", None)
-    ring = jax.shard_map(partial(ring_attention, axis_name="sp",
+    ring = shard_map(partial(ring_attention, axis_name="sp",
                                  causal=True),
                          mesh=sp_mesh, in_specs=(spec, spec, spec),
                          out_specs=spec)

@@ -583,6 +583,86 @@ def test_fl013_tree_is_clean():
 
 
 # ---------------------------------------------------------------------------
+# FL014 — collective hygiene (ISSUE 12)
+# ---------------------------------------------------------------------------
+
+_PAR_PATH = "incubator_mxnet_tpu/parallel/moe.py"
+_COLL_PATH = "incubator_mxnet_tpu/parallel/collectives.py"
+
+
+def test_fl014_flags_raw_lax_collectives():
+    # every import spelling: `from jax import lax`, `jax.lax.`, and a
+    # direct prim import
+    src = ("import jax\n"
+           "from jax import lax\n"
+           "from jax.lax import all_gather as ag\n"
+           "def f(x):\n"
+           "    a = lax.psum(x, 'dp')\n"
+           "    b = jax.lax.ppermute(x, 'dp', [(0, 1)])\n"
+           "    c = ag(x, 'dp')\n"
+           "    return a + b + c\n")
+    hits = [f for f in _lint(src, _PAR_PATH) if f.rule == "FL014"]
+    assert len(hits) == 3
+    assert all("census" in h.message for h in hits)
+
+
+def test_fl014_flags_adhoc_clock_around_dist():
+    src = ("import time\n"
+           "from . import dist\n"
+           "def sync(x):\n"
+           "    t0 = time.perf_counter()\n"
+           "    out = dist.allreduce(x)\n"
+           "    return out, time.perf_counter() - t0\n")
+    hits = [f for f in _lint(src, _PAR_PATH) if f.rule == "FL014"]
+    assert len(hits) == 2
+    assert "mx_collective_seconds" in hits[0].message
+
+
+def test_fl014_accepts_wrappers_noqa_and_scoping():
+    # collectives.py itself is the census point: raw prims allowed
+    raw = ("import jax\n"
+           "def all_reduce(v, axis_name):\n"
+           "    return jax.lax.psum(v, axis_name)\n")
+    assert not [f for f in _lint(raw, _COLL_PATH) if f.rule == "FL014"]
+    # routed through the wrappers: clean
+    ok = ("from . import collectives\n"
+          "def f(x):\n"
+          "    return collectives.all_reduce(x, 'dp')\n")
+    assert not [f for f in _lint(ok, _PAR_PATH) if f.rule == "FL014"]
+    # axis_index / axis_size are queries, not comms: never flagged
+    q = ("from jax import lax\n"
+         "def f(x):\n"
+         "    return lax.axis_index('dp')\n")
+    assert not [f for f in _lint(q, _PAR_PATH) if f.rule == "FL014"]
+    # noqa escape with a reason
+    noqa = ("from jax import lax\n"
+            "def f(x):\n"
+            "    return lax.psum(x, 'dp')  # noqa: FL014 - rep typing\n")
+    assert not [f for f in _lint(noqa, _PAR_PATH) if f.rule == "FL014"]
+    # scoped to parallel//serve/: ops/ modules are out of scope
+    assert not [f for f in _lint(
+        "from jax import lax\ndef f(x):\n    return lax.psum(x, 'd')\n",
+        _OPS_PATH) if f.rule == "FL014"]
+    # a clock in a function with no dist calls is FL014-silent
+    clock = ("import time\n"
+             "def f():\n"
+             "    return time.perf_counter()\n")
+    assert not [f for f in _lint(clock, _PAR_PATH) if f.rule == "FL014"]
+
+
+def test_fl014_tree_is_clean():
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import framework_lint
+    finally:
+        sys.path.pop(0)
+    findings = [f for f in framework_lint.lint_paths(
+        [os.path.join(REPO, "incubator_mxnet_tpu")])
+        if f.rule == "FL014"]
+    assert not findings, findings
+
+
+# ---------------------------------------------------------------------------
 # bench_regress — trajectory regression gate (ISSUE 10)
 # ---------------------------------------------------------------------------
 
@@ -599,7 +679,11 @@ def test_bench_regress_green_on_committed_history(capsys):
     br = _bench_regress()
     assert br.main([]) == 0
     out = capsys.readouterr().out
-    assert "clean" in out and "resnet50_train_img_s_per_chip" in out
+    # the latest committed round's headline metric must be in the table
+    latest = sorted(br.glob.glob(os.path.join(REPO, "BENCH_r*.json")))[-1]
+    with open(latest, encoding="utf-8") as f:
+        headline = json.load(f)["parsed"]["metric"]
+    assert "clean" in out and headline in out
 
 
 def test_bench_regress_catches_both_polarities(tmp_path):
@@ -627,6 +711,37 @@ def test_bench_regress_catches_both_polarities(tmp_path):
     assert br.main(["--root", str(tmp_path)]) == 0
 
 
+def test_bench_regress_family_drift_normalization(tmp_path):
+    """Fleet-wide runner drift on a serving family is tolerated, but a
+    single member regressing beyond the family's median delta still
+    gates (the identical-code control case from the module docstring)."""
+    br = _bench_regress()
+    base = {"gpt_serve_ttft_p50_ms": 100.0,
+            "gpt_serve_ttft_p99_ms": 300.0,
+            "gpt_serve_longprompt_ttft_p99_ms": 400.0,
+            "gpt_gateway_high_ttft_p99_ms": 60.0,
+            "gpt_gateway_low_ttft_p99_ms": 350.0}
+    # whole family +30% (slower runner): every member tracks the median
+    drifted = {k: v * 1.30 for k, v in base.items()}
+    rows = br.compare(base, drifted)
+    status = {r["metric"]: r["status"] for r in rows}
+    assert all(s == "ok" for s in status.values()), status
+    assert all(r["drift_pct"] is not None for r in rows)
+    # same drift, but ONE member blows 60% past it: that member gates
+    drifted["gpt_serve_ttft_p99_ms"] = base["gpt_serve_ttft_p99_ms"] * 1.90
+    rows = br.compare(base, drifted)
+    status = {r["metric"]: r["status"] for r in rows}
+    assert status["gpt_serve_ttft_p99_ms"] == "REGRESS"
+    assert status["gpt_serve_ttft_p50_ms"] == "ok"
+    # below MIN_FAMILY members the estimate is untrusted: absolute gate
+    small = {k: base[k] for k in list(base)[:2]}
+    rows = br.compare(small, {k: v * 1.30 for k, v in small.items()})
+    assert {r["status"] for r in rows} == {"REGRESS"}
+    # skip-listed gateway p50s inform the median but are never gated
+    assert br.re.compile(br.DEFAULT_SKIP).search(
+        "gpt_gateway_high_ttft_p50_ms")
+
+
 def test_bench_regress_direction_and_edge_cases(tmp_path):
     br = _bench_regress()
     # direction heuristic: _ms/latency lower-better, _vs_ report-only
@@ -634,6 +749,8 @@ def test_bench_regress_direction_and_edge_cases(tmp_path):
     assert br.direction("dot_framework_ms") == "lower"
     assert br.direction("bert_base_train_tokens_s") == "higher"
     assert br.direction("resnet50_int8_vs_fp32_wall") is None
+    assert br.direction("gpt_serve_tracing_overhead_pct") is None
+    assert br.direction("collective_wrapper_overhead_pct") is None
     assert br.direction("vs_baseline") == "higher"
     # <2 rounds: nothing to compare, clean exit
     (tmp_path / "BENCH_r01.json").write_text(json.dumps(

@@ -1,0 +1,223 @@
+"""Fleet observability viewer: cross-rank report, stitched timelines,
+and merged crash post-mortems (the CLI face of `telemetry.fleet`; the
+memwatch.py of the cross-rank plane — see TELEMETRY.md "fleet").
+
+Modes
+-----
+``--report [FILE]`` (default when no mode is given)
+    Without FILE: run a small single-process demo — arm the fleet plane,
+    exercise the dist facade and `probe_collectives()` over the local
+    devices, and print the formatted `fleet_report()` (per-rank signals,
+    straggler score, collective roofline rows). With FILE: render a
+    saved report JSON (``json.dump(fleet.fleet_report(), f)`` on any
+    rank — every rank gets the same report)::
+
+        python tools/fleetwatch.py --report
+        python tools/fleetwatch.py --report /shared/fleet_report.json
+
+``--stitch DIR``
+    Merge per-rank span dumps (``fleet_spans_rank*.json``, written by
+    `fleet.dump_rank_trace()` on every rank) into one Perfetto timeline
+    with a lane per rank, clock-offset corrected (same output as
+    ``tools/trace_timeline.py --fleet``)::
+
+        python tools/fleetwatch.py --stitch /shared/fleet_traces -o fleet.json
+
+``--postmortem DIR``
+    Collect every rank's flight-recorder dump from a shared directory
+    (rank-stamped ``flightrec_*_rank*_*.json`` plus the crash markers the
+    fanout hook drops) into one merged post-mortem and print who crashed
+    first, who dumped ``peer_crash``, and each rank's last spans::
+
+        python tools/fleetwatch.py --postmortem /shared/flightrec
+
+The committed example ``benchmark/fleetwatch_report_example.json`` is
+produced by ``--report --save benchmark/fleetwatch_report_example.json``.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def _fmt_bytes(n):
+    if n >= 2**30:
+        return f"{n / 2**30:.2f} GiB"
+    if n >= 2**20:
+        return f"{n / 2**20:.2f} MiB"
+    if n >= 2**10:
+        return f"{n / 2**10:.1f} KiB"
+    return f"{int(n)} B"
+
+
+def format_report(rep):
+    """Readable rollup of a `fleet.fleet_report()` dict."""
+    lines = [f"fleet report: {rep.get('n_ranks')} rank(s), "
+             f"viewed from rank {rep.get('rank')}"]
+    st = rep.get("straggler") or {}
+    lines.append(f"straggler: rank {st.get('rank')} "
+                 f"(z-score {st.get('score', 0.0):+.2f})")
+    signals = st.get("signals") or {}
+    if signals:
+        names = sorted({k for sig in signals.values() for k in sig})
+        w = max(len(n) for n in names) if names else 8
+        header = "  rank  score  " + "  ".join(f"{n:>{w}}" for n in names)
+        lines.append(header)
+        scores = st.get("scores") or {}
+        for r in sorted(signals, key=lambda k: int(k)):
+            row = signals[r]
+            cells = "  ".join(
+                f"{row.get(n):>{w}.4f}" if isinstance(row.get(n), float)
+                else f"{'-':>{w}}" for n in names)
+            lines.append(f"  {int(r):>4}  {scores.get(r, 0.0):>+5.2f}  "
+                         + cells)
+    clock = rep.get("clock") or {}
+    if clock.get("offsets") is not None:
+        lines.append(f"clock offsets (s): {clock['offsets']} "
+                     f"(bound {clock.get('bound_s')})")
+    agg = rep.get("aggregate") or {}
+    coll = {k: v for k, v in agg.items()
+            if k.startswith("mx_collective_seconds")}
+    if coll:
+        lines.append("collectives (fleet-pooled):")
+        w = max(len(k) for k in coll)
+        for key in sorted(coll):
+            c = coll[key]
+            lines.append(
+                f"  {key:<{w}}  n={c.get('count', 0):<5} "
+                f"mean={c.get('mean', 0.0):.6f}s  "
+                f"max={c.get('max') if c.get('max') is not None else '-'}")
+    byt = {k: v for k, v in agg.items()
+           if k.startswith("mx_collective_bytes_total")}
+    for key in sorted(byt):
+        lines.append(f"  {key}: {_fmt_bytes(byt[key].get('value', 0))}")
+    return "\n".join(lines)
+
+
+def format_probe(probe):
+    """Readable table of a `fleet.probe_collectives()` result."""
+    meta = probe.get("_meta") or {}
+    peak = meta.get("peak_gbs")
+    lines = [f"collective probe: axis '{meta.get('axis')}' over "
+             f"{meta.get('n')} device(s) ({meta.get('device')}), "
+             f"{_fmt_bytes(meta.get('per_shard_bytes', 0))}/shard"
+             + (f", peak {peak} GB/s" if peak else "")]
+    ops = [(op, row) for op, row in probe.items() if op != "_meta"]
+    w = max((len(op) for op, _ in ops), default=8)
+    for op, row in ops:
+        if "error" in row:
+            lines.append(f"  {op:<{w}}  ERROR {row['error']}")
+            continue
+        frac = (f"  ({row['peak_frac'] * 100:.1f}% of peak)"
+                if row.get("peak_frac") else "")
+        lines.append(f"  {op:<{w}}  {row['seconds'] * 1e6:>9.1f} µs  "
+                     f"{row.get('gbs') or 0:>8.3f} GB/s{frac}")
+    return "\n".join(lines)
+
+
+def format_postmortem(merged):
+    """Readable rollup of a `fleet.merge_flight_dumps()` dict."""
+    lines = [f"fleet post-mortem: {merged.get('n_dumps')} dump(s) from "
+             f"{merged.get('n_ranks')} rank(s)"]
+    for m in merged.get("markers") or []:
+        lines.append(f"  crash marker: rank {m.get('rank')} "
+                     f"pid {m.get('pid')} — {m.get('error')}")
+    ranks = merged.get("ranks") or {}
+    for r in sorted(ranks, key=lambda k: int(k)):
+        for d in ranks[r]:
+            err = d.get("error")
+            lines.append(
+                f"  rank {int(r):>3}  {str(d.get('reason')):<12} "
+                f"{d.get('n_spans', 0):>4} span(s)  "
+                f"{os.path.basename(d.get('path', ''))}"
+                + (f"  [{err}]" if err else ""))
+    if not ranks:
+        lines.append("  (no flightrec dumps found)")
+    return "\n".join(lines)
+
+
+def run_report(path=None, save=None):
+    if path:
+        with open(path, encoding="utf-8") as f:
+            rep = json.load(f)
+        print(format_report(rep))
+        return 0
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import numpy as np
+
+    from incubator_mxnet_tpu.parallel import dist
+    from incubator_mxnet_tpu.telemetry import fleet, registry, tracing
+
+    fleet.enable()
+    tracing.enable()
+    # exercise the host facade (single-process: profiled no-ops) and the
+    # in-graph wrappers (eager probe over the local devices)
+    dist.allreduce(np.ones((1024,), "float32"))
+    dist.barrier("fleetwatch_demo")
+    registry.step(0.01, examples=32)
+    probe = fleet.probe_collectives(nbytes=1 << 16, iters=3)
+    print(format_probe(probe))
+    print()
+    rep = fleet.fleet_report()
+    print(format_report(rep))
+    if save:
+        with open(save, "w", encoding="utf-8") as f:
+            json.dump({"report": rep, "probe": probe}, f, indent=1,
+                      sort_keys=True, default=str)
+        print(f"\nsaved to {save}")
+    return 0
+
+
+def run_stitch(span_dir, out):
+    from incubator_mxnet_tpu.telemetry import fleet
+
+    payload = fleet.stitch_traces(span_dir)
+    with open(out, "w", encoding="utf-8") as f:
+        json.dump(payload, f)
+    meta = payload.get("fleet") or {}
+    print(f"stitched {meta.get('n_ranks')} rank(s), "
+          f"{meta.get('n_spans')} span(s) -> {out} "
+          f"(clock-offset bound {meta.get('offset_bound_s')}s) — "
+          "open at https://ui.perfetto.dev")
+    return 0
+
+
+def run_postmortem(dump_dir):
+    from incubator_mxnet_tpu.telemetry import fleet
+
+    merged = fleet.merge_flight_dumps(dump_dir)
+    print(format_postmortem(merged))
+    return 0
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="fleet observability viewer (see module docstring)")
+    ap.add_argument("--report", nargs="?", const="", default=None,
+                    metavar="FILE",
+                    help="render a saved fleet report, or run the "
+                         "single-process demo when FILE is omitted")
+    ap.add_argument("--stitch", metavar="DIR",
+                    help="merge per-rank fleet_spans_rank*.json dumps")
+    ap.add_argument("--postmortem", metavar="DIR",
+                    help="merge per-rank flightrec dumps from DIR")
+    ap.add_argument("-o", "--out", default="fleet_timeline.json",
+                    help="output path for --stitch")
+    ap.add_argument("--save", default=None, metavar="FILE",
+                    help="with --report demo: also save the JSON")
+    args = ap.parse_args(argv)
+
+    if args.stitch:
+        return run_stitch(args.stitch, args.out)
+    if args.postmortem:
+        return run_postmortem(args.postmortem)
+    return run_report(args.report or None, save=args.save)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -119,6 +119,23 @@ FL013  KV-pool aliasing (scoped to ``serve/`` modules): (a) a
        this). Where the pool argument genuinely must not be donated
        (a read-only analysis pass), annotate with ``# noqa: FL013``
        and the justifying comment.
+FL014  collective hygiene (scoped to ``parallel/`` and ``serve/``
+       modules): (a) a raw in-graph collective (``lax.psum`` /
+       ``pmean`` / ``pmax`` / ``pmin`` / ``all_gather`` /
+       ``psum_scatter`` / ``ppermute`` / ``all_to_all`` /
+       ``pshuffle`` / ``pvary``) anywhere except
+       ``parallel/collectives.py`` — the wrappers there are the fleet
+       profiler's census point (payload bytes + call counts per
+       op/axis), so a raw ``lax`` call is comms traffic the
+       cross-rank observability plane never sees; (b) an ad-hoc
+       ``time.*`` wall clock inside a function that also issues a
+       host-level dist collective (``dist.allreduce`` / ``broadcast``
+       / ``barrier`` / ``exchange_objs``) — the fleet profiler owns
+       collective timing (``mx_collective_seconds``), and a local
+       stopwatch around a blocking collective double-counts peer skew
+       as local cost. Where a raw primitive is genuinely required
+       (the wrappers themselves, rep-typing internals), annotate the
+       line with ``# noqa: FL014`` and the justifying comment.
 
 Usage
 -----
@@ -181,6 +198,12 @@ RULES = {
              "tokens)), or lax.scan carrying a pool in xs (re-stacks "
              "the pool per step) — donate the pool / unroll the layer "
              "loop, or `# noqa: FL013` with a reason",
+    "FL014": "parallel//serve/ collective hygiene: raw lax collective "
+             "outside parallel/collectives.py bypasses the fleet "
+             "census (route through the wrappers), and ad-hoc time.* "
+             "around dist collectives double-counts peer skew (the "
+             "profiler owns mx_collective_seconds); `# noqa: FL014` "
+             "with a reason where a raw primitive is required",
 }
 
 _INDEXING_NAME_PARTS = ("getitem", "setitem", "index", "slice")
@@ -1048,6 +1071,121 @@ def _check_ops_ledger(tree, path, findings, coverage_text):
 
 
 # ---------------------------------------------------------------------------
+# FL014 — collective hygiene (parallel/ and serve/ modules)
+# ---------------------------------------------------------------------------
+
+_COLLECTIVE_PRIMS = ("psum", "pmean", "pmax", "pmin", "all_gather",
+                     "psum_scatter", "ppermute", "all_to_all", "pshuffle",
+                     "pvary")
+_DIST_OPS = ("allreduce", "broadcast", "barrier", "exchange_objs")
+
+
+def _lax_aliases(tree):
+    """Names bound to the lax module (`from jax import lax [as l]`,
+    `import jax.lax as jl`), names bound to jax itself (for
+    `jax.lax.psum`), and collective prims imported directly
+    (`from jax.lax import psum [as p]`)."""
+    lax_names, jax_names, prim_names = set(), set(), set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == "jax":
+                    jax_names.add(a.asname or "jax")
+                elif a.name == "jax.lax" and a.asname:
+                    lax_names.add(a.asname)
+        elif isinstance(node, ast.ImportFrom):
+            if node.module == "jax":
+                for a in node.names:
+                    if a.name == "lax":
+                        lax_names.add(a.asname or "lax")
+            elif node.module == "jax.lax":
+                for a in node.names:
+                    if a.name in _COLLECTIVE_PRIMS:
+                        prim_names.add(a.asname or a.name)
+    return lax_names, jax_names, prim_names
+
+
+def _raw_collective_hit(node, lax_names, jax_names, prim_names):
+    """`lax.psum` / `jax.lax.psum` / bare `psum` (imported from jax.lax)
+    call → the dotted name, else None."""
+    f = node.func
+    if isinstance(f, ast.Name) and f.id in prim_names:
+        return f.id
+    if not (isinstance(f, ast.Attribute) and f.attr in _COLLECTIVE_PRIMS):
+        return None
+    v = f.value
+    if isinstance(v, ast.Name) and v.id in lax_names:
+        return f"{v.id}.{f.attr}"
+    if (isinstance(v, ast.Attribute) and v.attr == "lax"
+            and isinstance(v.value, ast.Name)
+            and v.value.id in jax_names):
+        return f"{v.value.id}.lax.{f.attr}"
+    return None
+
+
+def _check_collective_hygiene(tree, path, findings, src_lines):
+    norm = path.replace(os.sep, "/")
+    if "/parallel/" not in norm and "/serve/" not in norm:
+        return
+    if norm.endswith("parallel/collectives.py"):
+        return      # the census point itself — raw prims live here
+
+    def noqa(lineno):
+        line = src_lines[lineno - 1] if lineno - 1 < len(src_lines) else ""
+        return "noqa: FL014" in line
+
+    # (a) raw in-graph collectives bypassing the census wrappers
+    lax_names, jax_names, prim_names = _lax_aliases(tree)
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        hit = _raw_collective_hit(node, lax_names, jax_names, prim_names)
+        if hit and not noqa(node.lineno):
+            findings.append(LintFinding(
+                path, node.lineno, "FL014",
+                f"raw `{hit}` bypasses the fleet census — route through "
+                "parallel/collectives.py (all_reduce/all_gather/"
+                "reduce_scatter/broadcast/ring_permute/all_to_all/pvary) "
+                "so payload bytes and call counts reach "
+                "mx_collective_*, or `# noqa: FL014` with a reason"))
+
+    # (b) ad-hoc wall clocks in functions that issue dist collectives
+    mod_aliases, fn_aliases = _time_aliases(tree)
+    for fn in ast.walk(tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        calls_dist = any(
+            isinstance(n, ast.Call) and isinstance(n.func, ast.Attribute)
+            and n.func.attr in _DIST_OPS
+            and isinstance(n.func.value, ast.Name)
+            and n.func.value.id == "dist"
+            for n in ast.walk(fn))
+        if not calls_dist:
+            continue
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            hit = None
+            if (isinstance(node.func, ast.Attribute)
+                    and isinstance(node.func.value, ast.Name)
+                    and node.func.value.id in mod_aliases
+                    and node.func.attr in _TIMING_FUNCS):
+                hit = f"{node.func.value.id}.{node.func.attr}"
+            elif (isinstance(node.func, ast.Name)
+                    and node.func.id in fn_aliases):
+                hit = node.func.id
+            if hit and not noqa(node.lineno):
+                findings.append(LintFinding(
+                    path, node.lineno, "FL014",
+                    f"ad-hoc `{hit}()` inside `{fn.name}`, which issues "
+                    "dist collectives: a local stopwatch around a "
+                    "blocking collective charges peer skew to this rank "
+                    "— the fleet profiler owns mx_collective_seconds; "
+                    "`# noqa: FL014` with a reason if this clock is not "
+                    "timing the collective"))
+
+
+# ---------------------------------------------------------------------------
 # driver
 # ---------------------------------------------------------------------------
 
@@ -1073,6 +1211,7 @@ def lint_source(src, path, coverage_text=None):
     _check_sharding_hygiene(tree, path, findings)
     _check_paged_hazards(tree, path, findings)
     _check_span_hygiene(tree, path, findings)
+    _check_collective_hygiene(tree, path, findings, src.splitlines())
     _check_ops_ledger(tree, path, findings, coverage_text)
     return findings
 

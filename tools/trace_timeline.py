@@ -29,6 +29,16 @@ Modes
     Export whatever the CURRENT process recorded (for use from a REPL /
     notebook after a traced run; from a fresh CLI process this is empty
     — prefer the API: ``tracing.dump_chrome(path)``).
+
+``--fleet DIR``
+    Stitch a directory of per-rank span dumps
+    (``fleet_spans_rank*.json``, written by
+    ``telemetry.fleet.dump_rank_trace()`` on every rank) into ONE
+    timeline with a process lane per rank, timestamps rebased by each
+    rank's estimated clock offset. Collective spans carry a
+    ``coll_seq`` arg — barrier #N lines up vertically across lanes::
+
+        python tools/trace_timeline.py --fleet /shared/fleet_traces -o fleet.json
 """
 from __future__ import annotations
 
@@ -181,6 +191,9 @@ def main(argv=None):
                          "running the demo workload")
     ap.add_argument("--live", action="store_true",
                     help="export this process's recorded spans as-is")
+    ap.add_argument("--fleet", default=None, metavar="DIR",
+                    help="stitch per-rank fleet_spans_rank*.json dumps "
+                         "from DIR into one multi-lane timeline")
     ap.add_argument("--demo", action="store_true",
                     help="run the traced tiny-GPT serving demo (default)")
     ap.add_argument("--requests", type=int, default=6)
@@ -189,7 +202,18 @@ def main(argv=None):
                          "(demo mode clips them by default)")
     args = ap.parse_args(argv)
 
-    if args.flightrec:
+    if args.fleet:
+        sys.path.insert(0, REPO)
+        try:
+            from incubator_mxnet_tpu.telemetry import fleet
+        finally:
+            sys.path.pop(0)
+        payload = fleet.stitch_traces(args.fleet)
+        meta = payload.get("fleet", {})
+        print(f"stitched {meta.get('n_ranks')} rank(s), "
+              f"{meta.get('n_spans')} spans, clock-offset bound "
+              f"{meta.get('offset_bound_s')}s")
+    elif args.flightrec:
         with open(args.flightrec) as f:
             payload = _chrome_from_flightrec(json.load(f))
     elif args.live:
