@@ -22,7 +22,12 @@ with three connected parts:
   ``except Exception: pass`` (lint FL006);
 - `resilience` — :class:`ResilienceHandler` for the Estimator: skip
   non-finite-loss steps (with AMP loss-scale backoff), auto-resume from
-  the last good checkpoint after a mid-step crash, checkpoint cadence.
+  the last good checkpoint after a mid-step crash, checkpoint cadence;
+- `elastic`    — :class:`~.elastic.ElasticController`: survive a TOPOLOGY
+  change (preemption, rank crash, the ``topology_change`` chaos seam)
+  via a membership-epoch rendezvous (`parallel.dist.rendezvous`),
+  shardcheck-pre-flighted checkpoint resharding, and a trainer rebuild
+  on the shrunk mesh (see RESILIENCE.md "Elastic topology").
 
 Every recovery is measured through the PR-2 telemetry registry:
 ``mx_faults_injected_total``, ``mx_retries_total``,
@@ -39,11 +44,13 @@ from .injection import (FaultInjected, SEAMS, clear_injection,  # noqa: F401
 from .retry import (RetryExhausted, RetryPolicy,  # noqa: F401
                     classify_exception, retry_call, suppressed)
 
-__all__ = ["injection", "retry", "resilience", "FaultInjected", "SEAMS",
+__all__ = ["injection", "retry", "resilience", "elastic",
+           "FaultInjected", "SEAMS",
            "inject_at", "injection_enabled", "configure_injection",
            "configure_from_env", "clear_injection", "schedule_info",
            "RetryPolicy", "RetryExhausted", "classify_exception",
-           "retry_call", "suppressed", "ResilienceHandler"]
+           "retry_call", "suppressed", "ResilienceHandler",
+           "ElasticController"]
 
 
 def __getattr__(name):
@@ -57,4 +64,13 @@ def __getattr__(name):
         if name == "resilience":
             return mod
         return mod.ResilienceHandler
+    if name in ("ElasticController", "elastic"):
+        # same late-binding discipline: `elastic` pulls in parallel/ and
+        # analysis/, which are mid-import on first package touch
+        import importlib
+
+        mod = importlib.import_module(".elastic", __name__)
+        if name == "elastic":
+            return mod
+        return mod.ElasticController
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

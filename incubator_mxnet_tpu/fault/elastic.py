@@ -1,0 +1,566 @@
+"""Elastic training: survive a topology change without losing the run.
+
+A TPU slice does not shrink gracefully — a preempted host or a crashed
+rank normally kills the whole SPMD program, and a checkpoint written on
+N devices refuses to load on M. This module turns those events into a
+coordinated **membership-epoch transition** (SURVEY §5.3 elasticity,
+rebuilt on the jax runtime):
+
+::
+
+    STABLE ──trigger──▶ DRAINING ──▶ RENDEZVOUS ──▶ RESHARD ──▶ STABLE'
+      ▲                 (save_now)   (dist.rendezvous,  (preflight +     (generation
+      └────────────────────────────── generation+1)     rebuild/resume)   N+1)
+
+- **triggers** (`ElasticController.poll`, called at a drained train-step
+  boundary): the ``topology_change`` chaos seam (`fault.injection`), a
+  SIGTERM preemption notice (`preemption.preempted`), a peer's departure
+  marker (`dist.pending_departures`), or a fleet-plane crash marker
+  (`telemetry.fleet`);
+- **drain**: the current step has completed; `poll` commits a checkpoint
+  (``save_now``) so a rank that restarts — instead of resharding in
+  place — resumes across the change via the layout sidecar;
+- **rendezvous**: `parallel.dist.rendezvous` agrees on the surviving
+  roster and bumps the membership generation; a rank still holding the
+  old epoch fails its next collective with
+  :class:`~..parallel.dist.StaleGenerationError` (non-retryable, loud)
+  instead of deadlocking the fleet;
+- **reshard**: the post-shrink layout is pre-flighted through the
+  `analysis.shardcheck` spec tier BEFORE anything commits — a layout
+  that would silently replicate (SC001) or blow the HBM budget (SC006)
+  aborts the transition with :class:`ElasticTransitionAborted` naming
+  the finding; then `DataParallel.rebuild` re-compiles the step on the
+  shrunk mesh carrying params + optimizer momenta host-side, and
+  `gluon.data.ElasticSampler.reshard` re-strides the unconsumed data.
+
+Checkpoints round-trip through the same machinery: `checkpoint_layout`
+is the rich ``layout_fn`` for `preemption.TrainingCheckpointer` (mesh
+axes + per-leaf PartitionSpec fingerprints), and `reshard_net` /
+`reshard_state` re-partition loaded values onto the live topology when
+`resume` detects a device-count change.
+
+Env knobs (registered in `util._ENV_KNOBS`): ``MXNET_ELASTIC`` (default
+on; ``0`` turns a cross-topology resume into a clear
+`preemption.LayoutMismatch`), ``MXNET_ELASTIC_MIN_RANKS``,
+``MXNET_ELASTIC_DRAIN_S``.
+"""
+from __future__ import annotations
+
+import logging
+import os
+import time
+
+__all__ = ["elastic_enabled", "mesh_layout", "checkpoint_layout",
+           "spec_fingerprint", "reshard_state", "reshard_net",
+           "ElasticTransitionAborted", "ElasticController"]
+
+_LOG = logging.getLogger("incubator_mxnet_tpu.fault")
+
+
+def elastic_enabled():
+    """``MXNET_ELASTIC`` gate (default ON). Off = a checkpoint written
+    under a different device count raises `preemption.LayoutMismatch`
+    instead of resharding, and `ElasticController.poll` is a no-op."""
+    v = (os.environ.get("MXNET_ELASTIC") or "").strip().lower()
+    return v not in ("0", "false", "off", "no")
+
+
+# -- layout sidecar ----------------------------------------------------------
+
+def spec_fingerprint(sharding):
+    """JSON-able fingerprint of an array's PartitionSpec (or of a bare
+    `PartitionSpec`): one entry per dim — ``None`` (unconstrained), an
+    axis name, or a list of axis names. ``[]`` = explicitly replicated;
+    ``None`` (the whole fingerprint) = unknown/uncommitted sharding."""
+    import jax
+
+    if sharding is None:
+        return None
+    if isinstance(sharding, jax.sharding.PartitionSpec):
+        spec = sharding
+    else:
+        spec = getattr(sharding, "spec", None)
+        if spec is None:
+            # SingleDeviceSharding etc.: replicated as far as a mesh cares
+            return ([] if getattr(sharding, "is_fully_replicated", True)
+                    else None)
+    out = []
+    for e in tuple(spec):
+        if e is None:
+            out.append(None)
+        elif isinstance(e, (tuple, list)):
+            out.append([str(a) for a in e])
+        else:
+            out.append(str(e))
+    while out and out[-1] is None:
+        out.pop()
+    return out
+
+
+def _spec_from_fingerprint(fp, mesh):
+    """Fingerprint -> (PartitionSpec-or-None, degraded). Axes the target
+    mesh no longer has are dropped; `degraded` is True when any were —
+    the pre-flight surfaces a FULLY-degraded large param as
+    unconstrained so the spec tier's SC001 names it instead of letting
+    it silently replicate."""
+    import jax
+
+    P = jax.sharding.PartitionSpec
+    if fp is None:
+        return None, False
+    live = ({str(n) for n in mesh.axis_names}
+            if mesh is not None else set())
+    entries, degraded = [], False
+    for e in fp:
+        if e is None:
+            entries.append(None)
+        elif isinstance(e, (list, tuple)):
+            kept = tuple(a for a in e if a in live)
+            degraded = degraded or len(kept) != len(e)
+            entries.append(kept if len(kept) > 1
+                           else (kept[0] if kept else None))
+        elif e in live:
+            entries.append(e)
+        else:
+            entries.append(None)
+            degraded = True
+    while entries and entries[-1] is None:
+        entries.pop()
+    return P(*entries), degraded
+
+
+def mesh_layout(mesh):
+    """``{"axes": [[name, size], ...]}`` for a mesh (None for no mesh)."""
+    if mesh is None:
+        return None
+    return {"axes": [[str(n), int(s)] for n, s in
+                     zip(mesh.axis_names, mesh.devices.shape)]}
+
+
+def checkpoint_layout(trainer):
+    """Rich layout sidecar for a `parallel.DataParallel` trainer: the
+    minimal `preemption._runtime_layout` fingerprint plus mesh axes and
+    per-leaf spec fingerprints (``param/<i>`` in trainable-param order,
+    ``opt/<i>/<j>`` per optimizer-state leaf). Install it as the
+    checkpointer's layout_fn::
+
+        ckpt = TrainingCheckpointer(
+            prefix, net, layout_fn=lambda: elastic.checkpoint_layout(dp))
+    """
+    import sys
+
+    from ..parallel import dist
+    from .retry import suppressed
+
+    layout = {"format": 2, "generation": dist.generation()}
+    jax = sys.modules.get("jax")
+    if jax is None:
+        return layout
+    try:
+        layout["device_count"] = int(jax.device_count())
+        layout["process_count"] = int(jax.process_count())
+    except Exception as e:
+        suppressed("elastic.checkpoint_layout", e)
+        return layout
+    layout["mesh"] = mesh_layout(getattr(trainer, "mesh", None))
+    leaves = {}
+    declared = getattr(trainer, "_param_specs", None)
+    for i, a in enumerate(getattr(trainer, "param_arrays", ()) or ()):
+        src = (declared[i] if declared is not None
+               and declared[i] is not None
+               else getattr(a._data, "sharding", None))
+        leaves[f"param/{i}"] = spec_fingerprint(src)
+    for i, s in enumerate(getattr(trainer, "opt_states", ()) or ()):
+        for j, leaf in enumerate(jax.tree.leaves(s)):
+            leaves[f"opt/{i}/{j}"] = spec_fingerprint(
+                getattr(leaf, "sharding", None))
+    layout["leaves"] = leaves
+    return layout
+
+
+# -- host-side resharding ----------------------------------------------------
+
+def reshard_state(tree, old_layout, new_mesh, specs=None,
+                  key_prefix="param"):
+    """Re-partition a pytree of arrays onto ``new_mesh``, HOST-side (a
+    device-to-device reshard has nothing to read from after a real
+    shrink). Target specs come from ``specs`` (one fingerprint per leaf,
+    flatten order) or the layout sidecar's ``leaves`` map
+    (``<key_prefix>/<i>``); axes the new mesh lost degrade to
+    replicated. Non-array leaves and a None mesh pass through."""
+    import numpy as onp
+
+    import jax
+
+    P = jax.sharding.PartitionSpec
+    NS = jax.sharding.NamedSharding
+    leaves, treedef = jax.tree.flatten(tree)
+    lmap = (old_layout or {}).get("leaves") or {}
+    out, degraded_n = [], 0
+    for i, leaf in enumerate(leaves):
+        if new_mesh is None or not hasattr(leaf, "shape"):
+            out.append(leaf)
+            continue
+        fp = (specs[i] if specs is not None
+              else lmap.get(f"{key_prefix}/{i}"))
+        spec, degraded = _spec_from_fingerprint(fp, new_mesh)
+        degraded_n += bool(degraded)
+        out.append(jax.device_put(onp.asarray(leaf),
+                                  NS(new_mesh, spec if spec is not None
+                                     else P())))
+    if degraded_n:
+        _LOG.warning(
+            "elastic.reshard_state: %d leaf spec(s) named axes the new "
+            "mesh does not have — degraded to replicated", degraded_n)
+    return jax.tree.unflatten(treedef, out)
+
+
+def reshard_net(net, old_layout, mesh=None):
+    """Re-partition a net's (freshly loaded) parameters onto the live
+    topology — the `TrainingCheckpointer.resume` half of an elastic
+    resume across a device-count change. Trainable params take their
+    sidecar fingerprint (``param/<i>`` in `collect_params` trainable
+    order, the order `DataParallel` builds ``param_arrays`` in); frozen
+    params replicate. With no ambient/explicit mesh the values simply
+    round-trip through the host, clearing any committed sharding from
+    the dead topology."""
+    import numpy as onp
+
+    import jax
+
+    from ..parallel.mesh import current_mesh
+    from ..telemetry import registry, tracing
+    from .retry import suppressed
+
+    P = jax.sharding.PartitionSpec
+    NS = jax.sharding.NamedSharding
+    mesh = mesh if mesh is not None else current_mesh()
+    lmap = (old_layout or {}).get("leaves") or {}
+    t0 = time.perf_counter()
+    n = trainable_i = 0
+    with tracing.span("elastic.reshard_net",
+                      devices=int(mesh.devices.size) if mesh is not None
+                      else 1):
+        for p in net.collect_params().values():
+            try:
+                a = p.data()
+            except Exception as e:      # deferred-init param: nothing to move
+                suppressed("elastic.reshard_net", e)
+                continue
+            if p.grad_req != "null":
+                fp = lmap.get(f"param/{trainable_i}")
+                trainable_i += 1
+            else:
+                fp = []
+            host = onp.asarray(a._data)
+            if mesh is None:
+                a._set_data(jax.device_put(host))
+            else:
+                spec, _ = _spec_from_fingerprint(fp, mesh)
+                a._set_data(jax.device_put(
+                    host, NS(mesh, spec if spec is not None else P())))
+            n += 1
+    registry.gauge(
+        "mx_elastic_reshard_seconds",
+        "wall seconds of the last host-side elastic reshard").set(
+            time.perf_counter() - t0)
+    _LOG.info("elastic.reshard_net: re-partitioned %d params onto the "
+              "live topology", n)
+    return n
+
+
+# -- the controller ----------------------------------------------------------
+
+class ElasticTransitionAborted(RuntimeError):
+    """The shardcheck pre-flight rejected the post-shrink layout — the
+    transition did NOT commit (the fleet stays on the old generation and
+    the old mesh). Non-retryable: the same layout would fail again;
+    shrink differently or raise the HBM budget."""
+
+    non_retryable = True
+
+    def __init__(self, findings, report=None):
+        self.findings = list(findings)
+        self.report = report
+        named = "; ".join(
+            f"{f.rule} @ {f.site}: {f.message}" for f in self.findings)
+        super().__init__(
+            f"elastic transition aborted by shardcheck pre-flight: {named}")
+
+
+class ElasticController:
+    """Membership-epoch state machine (see module docstring).
+
+    Call :meth:`poll` at every DRAINED train-step boundary (no step in
+    flight); it returns ``"stable"`` (nothing happened), ``"shrunk"``
+    (this rank survived a transition — the trainer was rebuilt on the
+    new mesh, the sampler re-strided, `dist.generation` bumped), or
+    ``"leave"`` (THIS rank departed: its state was checkpointed, its
+    membership marked stale — exit 0 and let the survivors carry on).
+
+    Parameters
+    ----------
+    trainer : parallel.DataParallel, optional
+        Rebuilt on the shrunk mesh across a transition (single-process
+        simulation; multi-process fleets keep their local devices).
+    checkpointer : preemption.TrainingCheckpointer, optional
+        Drain target: `save_now` before every transition/departure.
+    sampler : gluon.data.ElasticSampler, optional
+        Re-strided over the surviving roster (multi-process).
+    min_ranks : int
+        Floor for the rendezvous roster (``MXNET_ELASTIC_MIN_RANKS``).
+    drain_s : float
+        Rendezvous settle/timeout budget (``MXNET_ELASTIC_DRAIN_S``).
+    hbm_budget_gb : float, optional
+        Per-device budget for the SC006 pre-flight check
+        (``MXNET_SHARDCHECK_HBM_GB`` when unset).
+    on_leave : callable, optional
+        Called with the trigger after a clean departure (the place to
+        ``sys.exit(0)`` — `tools.launcher` kills the whole fleet on the
+        first NON-zero exit).
+    """
+
+    def __init__(self, trainer=None, checkpointer=None, sampler=None,
+                 min_ranks=None, drain_s=None, hbm_budget_gb=None,
+                 on_leave=None):
+        self.trainer = trainer
+        self.checkpointer = checkpointer
+        self.sampler = sampler
+        self.min_ranks = int(min_ranks if min_ranks is not None
+                             else os.environ.get(
+                                 "MXNET_ELASTIC_MIN_RANKS", "1"))
+        self.drain_s = (float(drain_s) if drain_s is not None else None)
+        self.hbm_budget_gb = hbm_budget_gb
+        self.on_leave = on_leave
+
+    # -- triggers ------------------------------------------------------------
+    def _crashed_ranks(self):
+        """Fleet-plane crash markers naming a still-active peer."""
+        import glob
+        import re
+
+        from ..parallel import dist
+        from ..telemetry import tracing
+        from .retry import suppressed
+
+        try:
+            d = tracing._flight_dir()
+        except Exception as e:
+            suppressed("elastic._crashed_ranks", e)
+            return ()
+        gone = set()
+        for p in glob.glob(os.path.join(d, "fleet_crash_rank*.marker")):
+            m = re.search(r"rank(\d+)\.marker$", p)
+            if m:
+                gone.add(int(m.group(1)))
+        me = dist.rank()
+        return tuple(sorted(r for r in gone
+                            if r != me and r in dist.active_ranks()))
+
+    def _pending_trigger(self):
+        """(kind, detail) or None. ``leave`` = this rank departs;
+        ``shrink`` = this rank survives a fleet shrink."""
+        import jax
+
+        from .. import preemption
+        from ..parallel import dist
+        from .injection import TopologyChanged, inject_at
+
+        multi = dist.is_initialized() and jax.process_count() > 1
+        try:
+            inject_at("topology_change")
+        except TopologyChanged as e:
+            # multi-process: the seam firing HERE (e.g. @rank-targeted)
+            # means this rank is the departure; peers see our marker.
+            # single-process: simulate the fleet shrinking to e.shrink
+            # local devices.
+            return ("leave", e) if multi else ("shrink", e.shrink)
+        if multi and preemption.preempted():
+            return ("leave", "preemption")
+        if multi and dist.pending_departures():
+            return ("shrink", None)
+        if multi and self._crashed_ranks():
+            return ("shrink", None)
+        return None
+
+    # -- state machine -------------------------------------------------------
+    def poll(self):
+        """Run one trigger check at a drained step boundary; transition
+        if one fired. Returns ``"stable" | "shrunk" | "leave"``."""
+        if not elastic_enabled():
+            return "stable"
+        trig = self._pending_trigger()
+        if trig is None:
+            return "stable"
+        kind, detail = trig
+        if kind == "leave":
+            return self._leave(detail)
+        return self.transition(shrink=detail)
+
+    def _leave(self, why):
+        from ..parallel import dist
+        from ..telemetry import registry, tracing
+
+        if self.checkpointer is not None:
+            self.checkpointer.save_now()
+        gen, _ = dist.rendezvous(leave=True)
+        registry.counter(
+            "mx_elastic_departures_total",
+            "clean elastic departures (this rank left the fleet)").inc()
+        tracing.event("elastic.leave", generation=gen, reason=str(why))
+        _LOG.warning("elastic: departing the fleet at generation %d (%s) "
+                     "— exit 0 so the launcher keeps the survivors up",
+                     gen, why)
+        if self.on_leave is not None:
+            self.on_leave(why)
+        return "leave"
+
+    def transition(self, shrink=None):
+        """Drain -> pre-flight -> rendezvous -> reshard. Raises
+        :class:`ElasticTransitionAborted` (pre-flight) BEFORE any state
+        commits; afterwards the fleet is on generation N+1."""
+        from ..parallel import dist
+        from ..telemetry import registry, tracing
+
+        t0 = time.perf_counter()
+        with tracing.span("elastic.transition", shrink=int(shrink or 0)):
+            new_mesh = self._shrunk_mesh(shrink)
+            if new_mesh is not None and self.trainer is not None:
+                specs = self._preflight(new_mesh)   # raises on SC001/SC006
+            else:
+                specs = None
+            if self.checkpointer is not None:
+                # drain point: a rank that restarts instead of resharding
+                # in place resumes from here across the layout change
+                self.checkpointer.save_now()
+            gen, members = dist.rendezvous(min_ranks=self.min_ranks,
+                                           timeout_s=self.drain_s)
+            if new_mesh is not None and self.trainer is not None:
+                self.trainer.rebuild(new_mesh, param_shardings=specs)
+            self._reshard_sampler(members)
+            elapsed = time.perf_counter() - t0
+            registry.counter(
+                "mx_elastic_transitions_total",
+                "committed elastic membership-epoch transitions").inc()
+            registry.gauge(
+                "mx_elastic_generation",
+                "current membership epoch (dist.generation)").set(gen)
+            registry.gauge(
+                "mx_elastic_reshard_seconds",
+                "wall seconds of the last host-side elastic "
+                "reshard").set(elapsed)
+            tracing.event("elastic.transition", generation=gen,
+                          members=len(members or ()),
+                          devices=(int(new_mesh.devices.size)
+                                   if new_mesh is not None else 0),
+                          seconds=round(elapsed, 3))
+        _LOG.warning(
+            "elastic: transition committed — generation %d, %d member(s)"
+            "%s, %.3fs", gen, len(members or ()),
+            (f", {int(new_mesh.devices.size)} local device(s)"
+             if new_mesh is not None else ""), elapsed)
+        return "shrunk"
+
+    def _reshard_sampler(self, members):
+        import jax
+
+        from ..parallel import dist
+
+        if (self.sampler is None or not members
+                or not dist.is_initialized() or jax.process_count() == 1):
+            return
+        me = dist.rank()
+        if me in members:
+            self.sampler.reshard(len(members),
+                                 list(members).index(me))
+
+    def _shrunk_mesh(self, shrink):
+        """Post-shrink LOCAL mesh, or None when no trainer rebuild
+        applies. Single-process runs simulate the fleet: the data axis
+        shrinks onto the first ``shrink`` devices (default: half).
+        Multi-process fleets return None — each surviving process keeps
+        its local devices; only the roster changed."""
+        import jax
+
+        from ..parallel.mesh import make_mesh
+
+        tr = self.trainer
+        if tr is None or getattr(tr, "mesh", None) is None:
+            return None
+        if jax.process_count() > 1:
+            return None
+        old = tr.mesh
+        n_old = int(old.devices.size)
+        names = list(old.axis_names)
+        shape = dict(zip(names, old.devices.shape))
+        da = tr._data_axis if tr._data_axis in shape else names[0]
+        other = 1
+        for nm, s in shape.items():
+            if nm != da:
+                other *= int(s)
+        n_new = int(shrink) if shrink else max(other, n_old // 2)
+        dp_new = max(1, n_new // other)
+        n_new = dp_new * other
+        if n_new >= n_old:
+            return None
+        shape[da] = dp_new
+        devs = list(old.devices.flatten())[:n_new]
+        return make_mesh([(nm, shape[nm]) for nm in names], devices=devs)
+
+    def _preflight(self, new_mesh):
+        """Spec-tier shardcheck of the post-shrink layout BEFORE any
+        commit: the target spec per param is its CURRENT sharding's
+        fingerprint mapped onto the new mesh; a large param whose spec
+        fully degraded (its axes are gone) is passed unconstrained so
+        SC001 names it, and the per-device byte estimate drives SC006.
+        Returns the rebuild-ready spec list; raises
+        :class:`ElasticTransitionAborted` on a blocking finding."""
+        import jax
+
+        from ..analysis.shardcheck import shardcheck
+
+        P = jax.sharding.PartitionSpec
+        tr = self.trainer
+        declared = getattr(tr, "_param_specs", None)
+        param_specs, rebuild_specs = [], []
+        for i, a in enumerate(tr.param_arrays):
+            # the DECLARED spec is the intent (live shardings only exist
+            # after the first step commits the params to the mesh)
+            src = (declared[i] if declared is not None
+                   and declared[i] is not None
+                   else getattr(a._data, "sharding", None))
+            fp = spec_fingerprint(src)
+            spec, degraded = _spec_from_fingerprint(fp, new_mesh)
+            replicated = spec is None or not len(tuple(spec))
+            if degraded and replicated:
+                # silently-degraded-to-replicated: let SC001 judge it
+                param_specs.append(None)
+                rebuild_specs.append(P())
+            else:
+                param_specs.append(spec if spec is not None else P())
+                rebuild_specs.append(spec if spec is not None else P())
+        state_specs = [
+            jax.tree.map(
+                lambda leaf, _sp=sp, _shape=tuple(a.shape):
+                    (_sp if tuple(getattr(leaf, "shape", ())) == _shape
+                     else P()),
+                s)
+            for s, sp, a in zip(tr.opt_states, param_specs,
+                                tr.param_arrays)
+        ]
+        report = shardcheck(
+            None, [a._data for a in tr.param_arrays], tr.opt_states,
+            mesh=new_mesh, specs=(param_specs, state_specs),
+            hbm_budget_gb=self.hbm_budget_gb, name="elastic.preflight")
+        blocking = [f for f in report.findings
+                    if f.rule in ("SC001", "SC006")
+                    or f.severity == "error"]
+        if blocking:
+            from ..telemetry import registry
+
+            registry.counter(
+                "mx_elastic_aborts_total",
+                "elastic transitions aborted by the shardcheck "
+                "pre-flight").inc()
+            raise ElasticTransitionAborted(blocking, report)
+        return rebuild_specs

@@ -29,10 +29,14 @@ Schedule grammar (comma-separated entries)::
   (:class:`InjectedResourceExhausted`, whose message carries the XLA
   ``RESOURCE_EXHAUSTED`` marker so the HBM observatory's OOM post-mortem
   seams treat it as a real allocator failure — the fixture behind
-  `telemetry/hbm.py`'s flight-dump test), or ``delay`` (SLEEP
+  `telemetry/hbm.py`'s flight-dump test), ``delay`` (SLEEP
   ``MXNET_FAULT_DELAY_MS`` milliseconds, default 50, instead of raising
   — a slow rank, not a dead one; the default kind for the
-  ``collective_delay`` seam).
+  ``collective_delay`` seam), or ``shrink=N`` (the ``topology_change``
+  seam's payload: raise :class:`TopologyChanged` carrying the
+  post-transition world size ``N`` — the deterministic membership-loss
+  fixture `fault/elastic.py`'s chaos gate replays; with ``@rank``
+  targeting, that one rank "dies" and its survivors re-rendezvous).
 
 Seams (where the probes live):
 
@@ -64,6 +68,12 @@ Seams (where the probes live):
                              ``delay``: with ``@rank`` targeting it
                              turns one process into a reproducible
                              straggler for `telemetry/fleet.py`
+``topology_change``          `fault/elastic.ElasticController.poll` step
+                             boundary — deterministic mid-run membership
+                             loss. Default kind ``topology``
+                             (:class:`TopologyChanged`); ``shrink=N``
+                             names the post-transition world size, and
+                             ``@rank`` makes ONE specific process die
 ===========================  ==============================================
 
 Off-path contract: when no schedule is configured, ``_SCHEDULE is None``
@@ -76,14 +86,15 @@ from __future__ import annotations
 import os
 import threading
 
-__all__ = ["FaultInjected", "InjectedResourceExhausted", "SEAMS",
-           "inject_at", "injection_enabled", "configure_injection",
-           "configure_from_env", "clear_injection", "schedule_info"]
+__all__ = ["FaultInjected", "InjectedResourceExhausted", "TopologyChanged",
+           "SEAMS", "inject_at", "injection_enabled",
+           "configure_injection", "configure_from_env", "clear_injection",
+           "schedule_info"]
 
 SEAMS = ("dataloader_worker", "dataloader_worker_exit", "kvstore_push",
          "kvstore_pull", "kvstore_barrier", "dist_init", "h2d",
          "checkpoint_write", "estimator_step", "serve_step",
-         "gateway_step", "collective_delay")
+         "gateway_step", "collective_delay", "topology_change")
 
 
 class FaultInjected(RuntimeError):
@@ -121,26 +132,55 @@ class InjectedResourceExhausted(FaultInjected):
         return (InjectedResourceExhausted, (self.seam, self.draw))
 
 
+class TopologyChanged(FaultInjected):
+    """The ``topology_change`` seam fired: the membership is about to
+    shrink. NOT a transient (``non_retryable``): retry policies must let
+    it surface to `fault.elastic.ElasticController`, which turns it into
+    an epoch transition. ``shrink`` is the post-transition world size
+    (``None`` = lose exactly the ``@rank``-targeted process)."""
+
+    non_retryable = True
+
+    def __init__(self, seam, draw, shrink=None):
+        RuntimeError.__init__(
+            self,
+            f"injected topology change at seam '{seam}' (draw #{draw}, "
+            f"shrink={shrink}, MXNET_FAULT_INJECT)")
+        self.seam = seam
+        self.draw = draw
+        self.shrink = shrink
+
+    def __reduce__(self):
+        return (TopologyChanged, (self.seam, self.draw, self.shrink))
+
+
 _KINDS = {"fault": FaultInjected, "oom": InjectedResourceExhausted}
 _DELAY_KIND = "delay"            # sleeps instead of raising (slow, not dead)
+_TOPOLOGY_KIND = "topology"      # raises TopologyChanged (with .shrink)
 
 
 class _SeamState:
     __slots__ = ("prob", "seed", "limit", "kind", "rng", "draws", "fired",
-                 "rank")
+                 "rank", "shrink")
 
-    def __init__(self, prob, seed=0, limit=None, kind="fault", rank=None):
+    def __init__(self, prob, seed=0, limit=None, kind="fault", rank=None,
+                 shrink=None):
         import random
 
         self.prob = float(prob)
         self.seed = int(seed)
         self.limit = None if limit is None else int(limit)
-        if kind not in _KINDS and kind != _DELAY_KIND:
+        kind, _, arg = str(kind).partition("=")
+        if kind == "shrink":      # "shrink=N" sugar for kind topology
+            kind, shrink = _TOPOLOGY_KIND, arg
+        if kind not in _KINDS and kind not in (_DELAY_KIND, _TOPOLOGY_KIND):
             raise ValueError(
-                f"unknown fault kind {kind!r} "
-                f"(valid: {', '.join((*_KINDS, _DELAY_KIND))})")
+                f"unknown fault kind {kind!r} (valid: "
+                f"{', '.join((*_KINDS, _DELAY_KIND, _TOPOLOGY_KIND))}"
+                ", shrink=N)")
         self.kind = kind
         self.rank = None if rank is None else int(rank)
+        self.shrink = None if shrink in (None, "") else int(shrink)
         self.rng = random.Random(self.seed)
         self.draws = 0
         self.fired = 0
@@ -219,8 +259,13 @@ def _parse_spec(spec):
 
 
 def _default_kind(seam):
-    # collective_delay exists to make a rank SLOW, not to kill it
-    return _DELAY_KIND if seam == "collective_delay" else "fault"
+    # collective_delay exists to make a rank SLOW, not to kill it;
+    # topology_change exists to make the MEMBERSHIP smaller
+    if seam == "collective_delay":
+        return _DELAY_KIND
+    if seam == "topology_change":
+        return _TOPOLOGY_KIND
+    return "fault"
 
 
 def configure_injection(spec):
@@ -346,6 +391,8 @@ def inject_at(seam):
                              "faults", labels={"seam": seam}).inc(d)
             time.sleep(d)
             return
+        if st.kind == _TOPOLOGY_KIND:
+            raise TopologyChanged(seam, draw, st.shrink)
         raise _KINDS[st.kind](seam, draw)
 
 
@@ -356,7 +403,10 @@ def schedule_info():
     if sched is None:
         return {}
     with _LOCK:
-        return {seam: {"prob": st.prob, "seed": st.seed, "limit": st.limit,
-                       "kind": st.kind, "rank": st.rank,
-                       "draws": st.draws, "fired": st.fired}
+        return {seam: dict({"prob": st.prob, "seed": st.seed,
+                            "limit": st.limit, "kind": st.kind,
+                            "rank": st.rank,
+                            "draws": st.draws, "fired": st.fired},
+                           **({"shrink": st.shrink}
+                              if st.kind == _TOPOLOGY_KIND else {}))
                 for seam, st in sched.items()}

@@ -66,15 +66,21 @@ class ResilienceHandler(StepGuard, TrainBegin, BatchEnd):
         Crash-resume budget per `fit` call; the next crash re-raises.
     max_consecutive_skips : int
         Loud-failure bound on back-to-back non-finite steps.
+    elastic : fault.elastic.ElasticController, optional
+        Polled at every `batch_end` — the drained step boundary an
+        elastic topology transition needs. A ``"leave"`` verdict stops
+        the fit loop cleanly (this rank departed the fleet).
     """
 
     def __init__(self, checkpointer=None, skip_nonfinite=True,
-                 max_resumes=2, max_consecutive_skips=50, priority=-90):
+                 max_resumes=2, max_consecutive_skips=50, priority=-90,
+                 elastic=None):
         self.checkpointer = checkpointer
         self.skip_nonfinite = skip_nonfinite
         self.max_resumes = int(max_resumes)
         self.max_consecutive_skips = int(max_consecutive_skips)
         self.priority = priority
+        self.elastic = elastic
         self._resumes = 0
         self._consecutive_skips = 0
 
@@ -86,6 +92,14 @@ class ResilienceHandler(StepGuard, TrainBegin, BatchEnd):
     def batch_end(self, estimator, *args, **kwargs):
         if self.checkpointer is not None:
             self.checkpointer.step()
+        if self.elastic is not None:
+            if self.elastic.poll() == "leave":
+                # departed: stop feeding steps; the process should exit 0
+                # (tools.launcher kills the fleet on a non-zero exit)
+                estimator.logger.warning(
+                    "resilience: this rank left the fleet (elastic "
+                    "departure) — ending fit")
+                estimator.stop_training = True
 
     # -- step guard ---------------------------------------------------------
     def pre_step(self, estimator, loss, batch):  # noqa: ARG002

@@ -50,6 +50,11 @@ def classify_exception(exc):
     ``'fatal'`` (deterministic — retrying replays the same bug)."""
     from .injection import FaultInjected
 
+    if getattr(exc, "non_retryable", False):
+        # explicit opt-out (dist.StaleGenerationError: a rank that missed
+        # a membership epoch replays the same stale view forever;
+        # TopologyChanged: a signal to transition, not a transient)
+        return "fatal"
     if isinstance(exc, FaultInjected):
         return "retryable"
     if isinstance(exc, _FATAL_TYPES):

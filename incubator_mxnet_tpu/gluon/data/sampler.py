@@ -4,7 +4,7 @@ from __future__ import annotations
 import numpy as onp
 
 __all__ = ["Sampler", "SequentialSampler", "RandomSampler", "BatchSampler",
-           "FilterSampler"]
+           "FilterSampler", "ElasticSampler"]
 
 
 class Sampler:
@@ -47,6 +47,70 @@ class FilterSampler(Sampler):
 
     def __len__(self):
         return len(self._indices)
+
+
+class ElasticSampler(Sampler):
+    """Rank-sharded sampler whose shard assignment can change MID-epoch.
+
+    Every rank holds the same seeded epoch permutation and takes the
+    interleaved stride ``perm[base + pos*num_shards + index]`` (the same
+    striding as ``Dataset.shard``). When an elastic topology transition
+    shrinks the fleet (`fault.elastic.ElasticController`), survivors call
+    :meth:`reshard` at the drained step boundary: the CONSUMED prefix of
+    the permutation is frozen and only the unconsumed remainder is
+    re-strided across the new world — no sample is double-fed (the prefix
+    never re-enters) and none is dropped (the remainder is covered
+    exactly once by the new stride).
+
+    The consumed-prefix arithmetic assumes lockstep SPMD consumption:
+    every rank has drawn the same number of samples when the transition
+    runs (true at a drained train-step boundary, which is the only place
+    the controller reshards).
+    """
+
+    def __init__(self, length, num_shards=1, index=0, shuffle=False,
+                 seed=0):
+        if not 0 <= index < num_shards:
+            raise ValueError(
+                f"ElasticSampler: index {index} ∉ [0, {num_shards})")
+        self._perm = (onp.random.RandomState(seed).permutation(length)
+                      if shuffle else onp.arange(length)).tolist()
+        self._num_shards = int(num_shards)
+        self._index = int(index)
+        self._base = 0          # global offset of the unconsumed remainder
+        self._pos = 0           # samples THIS rank drew since last reshard
+
+    def __iter__(self):
+        while True:
+            g = self._base + self._pos * self._num_shards + self._index
+            if g >= len(self._perm):
+                return
+            self._pos += 1
+            yield self._perm[g]
+
+    def __len__(self):
+        # what a fresh __iter__ will still yield for THIS rank
+        total = len(self._perm) - self._base - self._index
+        mine = -(-total // self._num_shards) if total > 0 else 0
+        return max(0, mine - self._pos)
+
+    def reshard(self, num_shards, index):
+        """Re-partition the unconsumed remainder across a new world.
+        Call at a drained step boundary (all ranks consumed equally)."""
+        if not 0 <= index < num_shards:
+            raise ValueError(
+                f"ElasticSampler.reshard: index {index} ∉ [0, {num_shards})")
+        consumed = min(len(self._perm) - self._base,
+                       self._pos * self._num_shards)
+        self._base += consumed
+        self._num_shards = int(num_shards)
+        self._index = int(index)
+        self._pos = 0
+
+    def remaining(self):
+        """Unconsumed samples fleet-wide (the remainder reshard splits)."""
+        return max(0, len(self._perm) - self._base
+                   - self._pos * self._num_shards)
 
 
 class BatchSampler(Sampler):

@@ -22,6 +22,22 @@ allgather over the rendezvous server's gRPC key-value store
 (`key_value_set_bytes` + barriers), reduced host-side. Selection is
 automatic (CPU backend, or first XLA "Multiprocess computations aren't
 implemented" error); ``MXNET_DIST_TRANSPORT=xla|host`` forces a side.
+
+Membership epochs (elastic topology, see `fault/elastic.py` +
+RESILIENCE.md §7): the live world is a *generation-numbered membership*
+— ``generation()`` counts epoch transitions and ``active_ranks()`` names
+the surviving processes. A topology change (preemption, crash marker,
+injected ``topology_change`` seam) re-rendezvouses via
+:func:`rendezvous`: survivors post join keys under the NEXT generation's
+KV prefix, poll the roster until it settles, and commit over a
+subset barrier. Every collective takes a ``generation=`` kwarg; a rank
+holding a superseded generation (or one that already left) raises
+:class:`StaleGenerationError` — classified NON-retryable — *before*
+entering the transport, so a stale rank fails loudly instead of
+deadlocking the survivors' collective (lint FL015 keeps in-tree
+fault/parallel call sites threading the guard). Subset collectives ride
+the host transport only: the XLA global-array path needs every process's
+devices, which is exactly what a shrunk membership no longer has.
 """
 from __future__ import annotations
 
@@ -30,15 +46,40 @@ import os
 import threading
 
 __all__ = ["initialize", "is_initialized", "rank", "num_processes",
-           "allreduce", "broadcast", "barrier"]
+           "allreduce", "broadcast", "barrier", "exchange_objs",
+           "generation", "active_ranks", "world_size", "is_active",
+           "check_generation", "rendezvous", "pending_departures",
+           "StaleGenerationError"]
 
 _LOG = logging.getLogger("incubator_mxnet_tpu.parallel.dist")
 
 _STATE = {"initialized": False, "mesh": None, "reducers": {},
           "transport": None,     # None=undecided, "xla" | "host"
-          "host_seq": 0}
+          "host_seq": 0,
+          "generation": 0,       # membership epoch counter
+          "members": None}       # None = every process; tuple after shrink
 _HOST_SEQ_LOCK = threading.Lock()
 _HOST_TIMEOUT_MS = 120_000
+
+
+class StaleGenerationError(RuntimeError):
+    """A collective was entered under a membership generation that has
+    been superseded (or by a rank no longer in the membership). The rank
+    missed an epoch transition: its peers have re-rendezvoused and will
+    never show up for this collective, so blocking would deadlock —
+    fail loudly instead. NON-retryable by classification
+    (`fault.retry.classify_exception` honors ``non_retryable``): a retry
+    replays the same stale view."""
+
+    non_retryable = True
+
+    def __init__(self, held, current, why="generation superseded"):
+        super().__init__(
+            f"dist: stale membership — {why} (held generation {held}, "
+            f"current {current}); the fleet re-rendezvoused without this "
+            "rank. Re-join via dist.rendezvous() or exit cleanly.")
+        self.held = held
+        self.current = current
 
 
 def _transient_rendezvous(exc):
@@ -155,6 +196,48 @@ def num_processes():
     return jax.process_count()
 
 
+def generation():
+    """Current membership-epoch number (0 until the first transition)."""
+    return _STATE["generation"]
+
+
+def active_ranks():
+    """Ranks in the current membership, sorted. Before any elastic
+    transition this is every process."""
+    if _STATE["members"] is not None:
+        return _STATE["members"]
+    return tuple(range(num_processes()))
+
+
+def world_size():
+    """Size of the current membership (== num_processes() until a
+    topology change shrinks it)."""
+    return len(active_ranks())
+
+
+def is_active():
+    """Is THIS process part of the current membership? False after it
+    left via ``rendezvous(leave=True)``."""
+    members = _STATE["members"]
+    return members is None or rank() in members
+
+
+def check_generation(generation_, op="collective"):
+    """Membership guard every collective runs before touching the
+    transport. ``generation_=None`` tolerates legacy callers (the
+    membership check still applies); a mismatched generation or a
+    departed rank raises :class:`StaleGenerationError` — loudly, before
+    a peer could be left blocked waiting for this rank."""
+    cur = _STATE["generation"]
+    if generation_ is not None and int(generation_) != cur:
+        raise StaleGenerationError(int(generation_), cur,
+                                   why=f"{op} under a superseded epoch")
+    if not is_active():
+        raise StaleGenerationError(
+            cur if generation_ is None else int(generation_), cur,
+            why=f"{op} from a rank outside the membership")
+
+
 def _host_mesh():
     """Global 1-axis-per-scope mesh: ('host', 'local') over every device."""
     if _STATE["mesh"] is None:
@@ -181,9 +264,13 @@ def _reducer(op):
     return _STATE["reducers"][op]
 
 
-def allreduce(x, op="sum"):
-    """Reduce a host-local array across all processes; every process gets
-    the full result. Single-process: returns x unchanged.
+def allreduce(x, op="sum", generation=None):
+    """Reduce a host-local array across the current membership; every
+    surviving process gets the full result. Single-process: returns x
+    unchanged. ``generation=`` is the membership-epoch guard
+    (:func:`check_generation`): pass ``dist.generation()`` captured at
+    step start so a rank that missed an elastic transition fails loudly
+    here instead of deadlocking its peers (lint FL015).
 
     The multi-process path is the choke point every other dist op rides
     (broadcast/barrier/exchange_objs), so it carries the
@@ -194,6 +281,7 @@ def allreduce(x, op="sum"):
     import jax
     import jax.numpy as jnp
 
+    check_generation(generation, op="allreduce")
     fh = _FAULT_HOOK
     if fh is not None:
         fh()          # fires single-process too: deterministic chaos units
@@ -230,6 +318,14 @@ def _is_no_multiprocess_backend(e):
 
 
 def _allreduce_any(x, op):
+    if _STATE["members"] is not None and _use_host_transport() is False:
+        # a shrunk membership can't ride the XLA global-array path: it
+        # builds arrays over EVERY process's devices, and the departed
+        # ranks' devices are exactly what the fleet no longer has
+        _LOG.warning("dist: membership is a subset (%s) — forcing the "
+                     "coordination-service host transport",
+                     _STATE["members"])
+        _STATE["transport"] = "host"
     if _use_host_transport():
         return _host_allreduce(x, op)
     try:
@@ -257,22 +353,70 @@ def _coord_client():
     return client
 
 
+_ELASTIC_PFX = "mx/elastic"
+
+
+def _fleet_generation(client):
+    """Highest membership generation any rank has committed to the
+    coordination service (None when no transition happened / the
+    service lacks directory reads). Non-blocking: ``key_value_dir_get``
+    returns immediately with whatever exists."""
+    try:
+        entries = client.key_value_dir_get(f"{_ELASTIC_PFX}/commit/")
+    except Exception as e:
+        from ..fault.retry import suppressed
+
+        suppressed("dist._fleet_generation", e)
+        return None
+    gens = []
+    for k, _v in entries:
+        tail = str(k).rsplit("/", 1)[-1]
+        if tail.startswith("g"):
+            try:
+                gens.append(int(tail[1:]))
+            except ValueError:
+                pass
+    return max(gens) if gens else None
+
+
+def _subset_barrier(client, barrier_id, timeout_ms=None):
+    """Coordination-service barrier over the CURRENT membership only —
+    a shrunk fleet must not wait for ranks that already left."""
+    timeout_ms = _HOST_TIMEOUT_MS if timeout_ms is None else timeout_ms
+    members = _STATE["members"]
+    if members is None:
+        client.wait_at_barrier(barrier_id, timeout_ms)
+    else:
+        client.wait_at_barrier(barrier_id, timeout_ms,
+                               process_ids=list(members))
+
+
 def _host_allgather_bytes(payload, tag):
     """Allgather raw bytes over the rendezvous server's gRPC key-value
-    store: each rank posts its payload under a per-collective sequence
+    store: each member posts its payload under a per-collective sequence
     key, a barrier orders post→read, and a second barrier keeps deletes
-    from racing slower readers. Every rank issues collectives in the
-    same order, so the local counter agrees fleet-wide. Returns every
-    rank's payload, index = rank."""
+    from racing slower readers. Every member issues collectives in the
+    same order, so the local counter agrees fleet-wide; keys carry the
+    membership generation so a cross-epoch straggler can never collide.
+    Returns one payload per member of ``active_ranks()``, in rank
+    order."""
     import jax
 
     client = _coord_client()
-    nproc = jax.process_count()
     me = jax.process_index()
+    members = active_ranks()
+    # a rank that missed an epoch transition would post under a dead
+    # prefix and block at a barrier no survivor will ever join — probe
+    # the fleet's committed generation and fail loudly instead
+    fleet_gen = _fleet_generation(client)
+    if fleet_gen is not None and fleet_gen > _STATE["generation"]:
+        raise StaleGenerationError(
+            _STATE["generation"], fleet_gen,
+            why="the fleet committed a newer membership epoch")
     with _HOST_SEQ_LOCK:
         _STATE["host_seq"] += 1
         seq = _STATE["host_seq"]
-    pfx = f"mx/hostcoll/{tag}/{seq}"
+    pfx = f"mx/hostcoll/g{_STATE['generation']}/{tag}/{seq}"
     key = f"{pfx}/{me:03d}"
     try:
         client.key_value_set_bytes(key, bytes(payload))
@@ -280,11 +424,11 @@ def _host_allgather_bytes(payload, tag):
         # a retried collective can collide with its own stale key
         client.key_value_delete(key)
         client.key_value_set_bytes(key, bytes(payload))
-    client.wait_at_barrier(f"{pfx}/post", _HOST_TIMEOUT_MS)
+    _subset_barrier(client, f"{pfx}/post")
     blobs = [client.blocking_key_value_get_bytes(f"{pfx}/{r:03d}",
                                                  _HOST_TIMEOUT_MS)
-             for r in range(nproc)]
-    client.wait_at_barrier(f"{pfx}/done", _HOST_TIMEOUT_MS)
+             for r in members]
+    _subset_barrier(client, f"{pfx}/done")
     client.key_value_delete(key)
     return blobs
 
@@ -344,11 +488,12 @@ def _allreduce_impl(x, op):
     return out
 
 
-def broadcast(x, root=0):
-    """Send root's host-local array to every process."""
+def broadcast(x, root=0, generation=None):
+    """Send root's host-local array to every member process."""
     import jax
     import jax.numpy as jnp
 
+    check_generation(generation, op="broadcast")
     if jax.process_count() == 1:
         return jnp.asarray(x)
     x = jnp.asarray(x)
@@ -367,9 +512,10 @@ def _broadcast_impl(x, root):
     return allreduce(contrib, op="sum")
 
 
-def barrier(tag="barrier"):
+def barrier(tag="barrier", generation=None):
     import jax
 
+    check_generation(generation, op="barrier")
     if jax.process_count() > 1:
         prof = _PROF
         if prof is None:
@@ -390,16 +536,18 @@ def _barrier_impl():
 _EXCHANGE_OVERSIZE = "__exchange_objs_oversize__"
 
 
-def exchange_objs(obj, max_bytes=4096):
-    """Collectively exchange one small picklable object per process;
-    returns the list of every rank's object (index = rank). Rides the
-    same allreduce transport as the data path — each rank fills ITS slot
-    of a (P, max_bytes) byte matrix, the sum concatenates them. The
-    command channel for remote-process profiler control (reference:
+def exchange_objs(obj, max_bytes=4096, generation=None):
+    """Collectively exchange one small picklable object per member;
+    returns the list of every rank's object (index = rank; ``None`` at
+    ranks outside the membership). Rides the same allreduce transport as
+    the data path — each rank fills ITS slot of a (P, max_bytes) byte
+    matrix, the sum concatenates them. The command channel for
+    remote-process profiler control (reference:
     `KVStoreServerProfilerCommand`, `include/mxnet/kvstore.h:48` —
     commands ride ps-lite messages there, collectives here)."""
     import jax
 
+    check_generation(generation, op="exchange_objs")
     if not is_initialized() or jax.process_count() == 1:
         return [obj]
     prof = _PROF
@@ -445,6 +593,155 @@ def _exchange_objs_impl(obj, max_bytes):
             f"exchange_objs: a rank's object exceeded the {max_bytes}-byte "
             "command slot (all ranks raised after the collective)")
     return out
+
+
+def rendezvous(min_ranks=1, timeout_s=None, settle_s=None, leave=False):
+    """Membership-epoch re-rendezvous: agree on the surviving world after
+    a topology change and bump :func:`generation`.
+
+    Survivors post join keys under the NEXT generation's KV prefix
+    (``mx/elastic/g<N>/join/<rank>``), poll the roster via directory
+    reads until it is STABLE for ``settle_s`` (and ≥ ``min_ranks``),
+    then align the commit with a subset barrier over exactly the settled
+    roster — rosters that disagree time out there and the whole attempt
+    retries under the ``elastic_rendezvous`` policy (``MXNET_RETRY_*``).
+    A committed generation is also recorded fleet-wide so a rank that
+    missed the transition fails with :class:`StaleGenerationError` at
+    its next collective instead of hanging it.
+
+    ``leave=True`` is the departing side: post nothing, mark the local
+    membership stale (any later collective raises), return immediately —
+    the survivors' roster settles without us. Single-process runs turn
+    the epoch over in place (the in-process chaos tests drive the same
+    state machine).
+
+    Returns ``(generation, members)``.
+    """
+    import time
+
+    import jax
+
+    if timeout_s is None:
+        timeout_s = float(os.environ.get("MXNET_ELASTIC_DRAIN_S", "20"))
+    if settle_s is None:
+        settle_s = min(0.5, max(0.05, timeout_s / 8))
+    next_gen = _STATE["generation"] + 1
+    if not is_initialized() or jax.process_count() == 1:
+        _STATE["generation"] = next_gen
+        _STATE["members"] = () if leave else None
+        return next_gen, (() if leave else active_ranks())
+    client = _coord_client()
+    me = jax.process_index()
+    pfx = f"{_ELASTIC_PFX}/g{next_gen}"
+    if leave:
+        from ..fault.retry import suppressed as _suppressed
+
+        try:
+            # departure marker: survivors whose trigger did not fire
+            # locally (an @rank-targeted seam, a preemption notice only
+            # this host saw) discover the shrink via pending_departures()
+            client.key_value_set_bytes(f"{_ELASTIC_PFX}/leave/{me:03d}",
+                                       b"1")
+        except Exception as e:
+            _suppressed("dist.rendezvous.leave_marker", e)
+        _STATE["generation"] = next_gen
+        _STATE["members"] = ()
+        _LOG.info("dist.rendezvous: rank %d leaving at generation %d",
+                  me, next_gen)
+        return next_gen, ()
+
+    from ..fault.retry import RetryPolicy, suppressed
+    from ..telemetry import tracing
+
+    def _attempt():
+        key = f"{pfx}/join/{me:03d}"
+        try:
+            client.key_value_set_bytes(key, b"1")
+        except Exception:
+            # a retried attempt collides with its own earlier join key
+            client.key_value_delete(key)
+            client.key_value_set_bytes(key, b"1")
+        deadline = time.monotonic() + timeout_s
+        roster, stable_since = None, None
+        while True:
+            try:
+                entries = client.key_value_dir_get(f"{pfx}/join/")
+            except Exception as e:
+                suppressed("dist.rendezvous.dir_get", e)
+                entries = []
+            ranks = set()
+            for k, _v in entries:
+                try:
+                    ranks.add(int(str(k).rsplit("/", 1)[-1]))
+                except ValueError:
+                    pass
+            ranks = tuple(sorted(ranks))
+            now = time.monotonic()
+            if ranks != roster:
+                roster, stable_since = ranks, now
+            elif (len(roster) >= max(1, int(min_ranks))
+                  and now - stable_since >= settle_s):
+                break
+            if now >= deadline:
+                raise TimeoutError(
+                    f"dist.rendezvous: generation {next_gen} roster did "
+                    f"not settle within {timeout_s}s (last seen {roster}"
+                    f", min_ranks={min_ranks})")
+            time.sleep(0.02)
+        # commit alignment over exactly the settled roster: a rank that
+        # settled on a DIFFERENT roster times out here, and the retry
+        # policy re-runs the whole attempt from the join post
+        client.wait_at_barrier(f"{pfx}/commit",
+                               int(max(1.0, timeout_s) * 1000),
+                               process_ids=list(roster))
+        return roster
+
+    with tracing.span("elastic.rendezvous", generation=next_gen):
+        roster = RetryPolicy.from_env(
+            "elastic_rendezvous",
+            retryable=_transient_rendezvous).call(_attempt)
+    _STATE["generation"] = next_gen
+    _STATE["members"] = roster
+    try:
+        client.key_value_set_bytes(f"{_ELASTIC_PFX}/commit/g{next_gen}",
+                                   b"1")
+    except Exception as e:
+        suppressed("dist.rendezvous.commit", e)   # peers raced the marker
+    _LOG.info("dist.rendezvous: generation %d committed, members=%s",
+              next_gen, roster)
+    return next_gen, roster
+
+
+def pending_departures():
+    """Ranks that posted a departure marker but are still in the active
+    membership — the survivor-side trigger for an elastic transition
+    whose cause (an ``@rank``-targeted fault, a single-host preemption
+    notice) fired somewhere else. Returns a sorted tuple; empty when not
+    multi-process or nothing is pending."""
+    import jax
+
+    if not is_initialized() or jax.process_count() == 1:
+        return ()
+    from ..fault.retry import suppressed
+
+    try:
+        entries = _coord_client().key_value_dir_get(f"{_ELASTIC_PFX}/leave/")
+    except Exception as e:
+        suppressed("dist.pending_departures", e)
+        return ()
+    gone = set()
+    for k, _v in entries:
+        try:
+            gone.add(int(str(k).rsplit("/", 1)[-1]))
+        except ValueError:
+            pass
+    return tuple(sorted(gone & set(active_ranks())))
+
+
+def _reset_membership():
+    """Test hook: restore the pristine epoch-0 full membership."""
+    _STATE["generation"] = 0
+    _STATE["members"] = None
 
 
 # hot hooks (module-global is-None dead branches, re-armed on import so

@@ -214,6 +214,10 @@ class DataParallel:
         self.optimizer = optimizer
         self.mesh = mesh
         self._t = 0
+        # kept so rebuild() can re-run this constructor on a NEW mesh
+        # after an elastic topology transition (fault/elastic.py)
+        self._loss_fn = loss_fn
+        self._remat = remat
         (step, params, param_arrays, frozen_arrays,
          aux_arrays_cell, stacked_mask_cell) = _build_pure_step(
             net, loss_fn, optimizer, remat_spec=remat)
@@ -451,6 +455,66 @@ class DataParallel:
             a._set_data(nv)
         self.opt_states = new_states
         return NDArray(loss)
+
+    def rebuild(self, mesh, data_axis=None, param_shardings=None):
+        """Re-construct the compiled step on a NEW mesh, carrying
+        parameters, optimizer state (momenta), and the step counter
+        across — the trainer half of an elastic topology transition
+        (`fault.elastic.ElasticController`). Values round-trip through
+        HOST memory: after a real shrink the departed ranks' devices are
+        gone, so a device-to-device reshard has nothing to read from.
+        The optimizer state tree is value-restored after the constructor
+        re-creates it (a bare ``create_state`` would silently zero adam
+        momenta and dent the loss trajectory)."""
+        import jax
+        import numpy as onp
+
+        from ..telemetry import tracing
+
+        if mesh is None:
+            raise ValueError("DataParallel.rebuild requires a target mesh")
+        t = self._t
+        specs = (list(param_shardings) if param_shardings is not None
+                 else self._param_specs)
+        with tracing.span("elastic.rebuild",
+                          devices=int(mesh.devices.size)):
+            old_states = jax.tree.map(
+                lambda leaf: (onp.asarray(leaf)
+                              if hasattr(leaf, "shape") else leaf),
+                self.opt_states)
+            # re-commit trainable params onto the new mesh under their
+            # declared specs BEFORE the constructor re-collects them —
+            # arrays committed to the old mesh would fail the new jit's
+            # in_shardings
+            P = jax.sharding.PartitionSpec
+            NS = jax.sharding.NamedSharding
+            for i, a in enumerate(self.param_arrays):
+                spec = specs[i] if specs is not None else None
+                sh = NS(mesh, spec if spec is not None else P())
+                a._set_data(jax.device_put(onp.asarray(a._data), sh))
+            for a in self.frozen_arrays:
+                a._set_data(jax.device_put(onp.asarray(a._data),
+                                           NS(mesh, P())))
+            self.__init__(self.net, self._loss_fn, self.optimizer,
+                          mesh=mesh,
+                          data_axis=data_axis or self._data_axis,
+                          param_shardings=specs, remat=self._remat)
+            if (jax.tree.structure(old_states)
+                    == jax.tree.structure(self.opt_states)):
+                self.opt_states = jax.tree.map(
+                    lambda old, new: (jax.device_put(old, new.sharding)
+                                      if hasattr(new, "sharding")
+                                      else old),
+                    old_states, self.opt_states)
+            else:
+                import logging
+
+                logging.getLogger("incubator_mxnet_tpu.parallel").warning(
+                    "DataParallel.rebuild: optimizer-state layout changed "
+                    "across the mesh transition — state re-initialized")
+        self._t = t
+        self._t_dev = None          # re-upload on the next step
+        return self
 
 
 def shard_train_step(step_fn, mesh, in_specs, out_specs):

@@ -26,7 +26,8 @@ import threading
 __all__ = ["on_preemption", "remove_preemption_hook",
            "clear_preemption_hooks", "trigger", "preempted", "atomic_save",
            "checkpoint_checksum", "verify_checkpoint", "CheckpointCorrupt",
-           "CheckpointManager", "TrainingCheckpointer"]
+           "LayoutMismatch", "load_layout", "CheckpointManager",
+           "TrainingCheckpointer"]
 
 _HOOKS: list = []
 _LOCK = threading.Lock()
@@ -104,12 +105,25 @@ def preempted() -> bool:
 
 
 _CRC_SUFFIX = ".crc32"
+_LAYOUT_SUFFIX = ".layout.json"
 
 
 class CheckpointCorrupt(OSError):
     """A checkpoint file failed checksum validation (truncated or
     corrupt). Retryable-classified: loaders fall back to the previous
     generation (`TrainingCheckpointer.resume`)."""
+
+
+class LayoutMismatch(RuntimeError):
+    """A checkpoint's layout sidecar names a different device topology
+    than the resuming runtime, and elastic resharding is disabled
+    (``MXNET_ELASTIC=0``). NON-retryable, and deliberately NOT a
+    generation-fallback trigger: every older generation was written under
+    the same dead topology, so `resume` raises instead of walking the
+    rotation. Re-enable ``MXNET_ELASTIC`` (default) to route the resume
+    through `fault.elastic.reshard_state` instead."""
+
+    non_retryable = True
 
 
 def checkpoint_checksum(path):
@@ -150,13 +164,42 @@ def verify_checkpoint(path):
         return False
 
 
-def atomic_save(path, write_fn, checksum=True):
+def _write_layout(path, layout):
+    """Sidecar `<path>.layout.json` recording the device topology the
+    checkpoint was written under (device/process count, mesh axes,
+    per-leaf PartitionSpec fingerprints — see `fault.elastic`), written
+    through the same tmp+rename dance as the checksum so the pair can
+    never half-update. Resume compares it against the live runtime to
+    detect a topology change."""
+    import json
+
+    tmp = f"{path}{_LAYOUT_SUFFIX}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(layout, f, sort_keys=True)
+    os.replace(tmp, path + _LAYOUT_SUFFIX)
+
+
+def load_layout(path):
+    """The layout sidecar written next to checkpoint `path` (None when
+    absent or unreadable — a pre-elastic legacy checkpoint)."""
+    import json
+
+    try:
+        with open(path + _LAYOUT_SUFFIX) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def atomic_save(path, write_fn, checksum=True, layout=None):
     """Crash-safe write: `write_fn(tmp_path)` then atomic rename, plus a
-    `<path>.crc32` sidecar for load-time validation. A kill mid-write
-    leaves the previous checkpoint intact. The write body carries the
-    'checkpoint_write' chaos seam and runs under the 'checkpoint' retry
-    policy (MXNET_RETRY_*): a transient I/O failure re-runs `write_fn`
-    from scratch on the same tmp path — idempotent by construction."""
+    `<path>.crc32` sidecar for load-time validation (and, when `layout`
+    is given, a `<path>.layout.json` topology sidecar for elastic
+    resume). A kill mid-write leaves the previous checkpoint intact. The
+    write body carries the 'checkpoint_write' chaos seam and runs under
+    the 'checkpoint' retry policy (MXNET_RETRY_*): a transient I/O
+    failure re-runs `write_fn` from scratch on the same tmp path —
+    idempotent by construction."""
     tmp = f"{path}.tmp.{os.getpid()}"
 
     def _write():
@@ -182,6 +225,8 @@ def atomic_save(path, write_fn, checksum=True):
         os.replace(tmp, path)
         if checksum:
             _write_checksum(path)
+        if layout is not None:
+            _write_layout(path, layout)
     return path
 
 
@@ -193,7 +238,7 @@ class CheckpointManager:
     directory)."""
 
     def __init__(self, prefix, save_state, every_n=100, keep=3,
-                 register_signal=True):
+                 register_signal=True, layout_fn=None):
         self._prefix = prefix
         self._save_state = save_state
         self._every_n = max(1, int(every_n))
@@ -202,6 +247,9 @@ class CheckpointManager:
         self._saved: list = []
         self._last_saved_step = None
         self._saving = False
+        # layout_fn() -> the topology sidecar dict written next to every
+        # checkpoint (e.g. fault.elastic.checkpoint_layout(trainer))
+        self._layout_fn = layout_fn
         if register_signal:
             on_preemption(self.save_now)
 
@@ -228,12 +276,14 @@ class CheckpointManager:
         self._saving = True
         try:
             path = self.path_for(self._step)
-            atomic_save(path, self._save_state)
+            layout = self._layout_fn() if self._layout_fn is not None \
+                else None
+            atomic_save(path, self._save_state, layout=layout)
             self._last_saved_step = self._step
             self._saved.append(path)
             while len(self._saved) > self._keep:
                 old = self._saved.pop(0)
-                for p in (old, old + _CRC_SUFFIX):
+                for p in (old, old + _CRC_SUFFIX, old + _LAYOUT_SUFFIX):
                     try:
                         os.remove(p)
                     except OSError:
@@ -252,6 +302,35 @@ class CheckpointManager:
         """Most recent checkpoint path on disk (None if none)."""
         found = self.generations()
         return found[-1] if found else None
+
+
+def _runtime_layout():
+    """Minimal topology fingerprint of the live runtime — the default
+    layout sidecar (`fault.elastic.checkpoint_layout` is the rich
+    per-leaf-spec version elastic trainers install instead)."""
+    layout = {"format": 1}
+    import sys
+
+    jax = sys.modules.get("jax")
+    if jax is None:
+        return layout
+    try:
+        layout["device_count"] = int(jax.device_count())
+        layout["process_count"] = int(jax.process_count())
+    except Exception as e:
+        from .fault.retry import suppressed
+
+        suppressed("preemption._runtime_layout", e)
+        return layout
+    from .parallel import dist
+    from .parallel.mesh import current_mesh
+
+    layout["generation"] = dist.generation()
+    m = current_mesh()
+    if m is not None:
+        layout["mesh"] = {"axes": [[str(n), int(s)] for n, s in
+                                   zip(m.axis_names, m.devices.shape)]}
+    return layout
 
 
 class TrainingCheckpointer:
@@ -274,12 +353,17 @@ class TrainingCheckpointer:
     """
 
     def __init__(self, prefix, net, trainer=None, every_n=100, keep=3,
-                 register_signal=True):
+                 register_signal=True, layout_fn=None):
         self._net = net
         self._trainer = trainer
+        self._reshard_layout = None
+        # every checkpoint gets at least the minimal topology sidecar so
+        # resume can detect a device-count change; elastic trainers pass
+        # fault.elastic.checkpoint_layout for the per-leaf spec version
         self._mgr = CheckpointManager(prefix, self._write, every_n=every_n,
                                       keep=keep,
-                                      register_signal=register_signal)
+                                      register_signal=register_signal,
+                                      layout_fn=layout_fn or _runtime_layout)
 
     def _write(self, path):
         import pickle
@@ -340,6 +424,40 @@ class TrainingCheckpointer:
                           prefix=self._mgr._prefix):  # noqa: SLF001
             return self._resume_impl(log, tempfile)
 
+    def _check_layout(self, side, path, log):
+        """Layout-sidecar guard: a checkpoint written under a different
+        device count either routes through elastic resharding (default)
+        or raises a clear :class:`LayoutMismatch` (``MXNET_ELASTIC=0``)
+        — never a shape error deep inside jax."""
+        if side is None:            # pre-elastic legacy checkpoint
+            return
+        import jax
+
+        saved = side.get("device_count")
+        live = int(jax.device_count())
+        if saved is None or int(saved) == live:
+            return
+        from .fault.elastic import elastic_enabled
+
+        if not elastic_enabled():
+            raise LayoutMismatch(
+                f"checkpoint {path} was written on {saved} device(s) but "
+                f"the runtime has {live}, and elastic resharding is "
+                "disabled (MXNET_ELASTIC=0) — restore the original "
+                "topology or re-enable MXNET_ELASTIC to reshard on "
+                "resume")
+        from .telemetry import registry
+
+        registry.counter(
+            "mx_elastic_layout_resumes_total",
+            "checkpoint resumes that crossed a device-count change "
+            "(resharded via fault.elastic)").inc()
+        log.warning(
+            "checkpoint resume: device count changed %s -> %s — params "
+            "will be resharded onto the live topology (fault.elastic)",
+            saved, live)
+        self._reshard_layout = side
+
     def _resume_impl(self, log, tempfile):
         paths = self._mgr.generations()
         blob, path, errors = None, None, []
@@ -369,6 +487,7 @@ class TrainingCheckpointer:
                         len(paths), self._mgr._prefix,  # noqa: SLF001
                         "\n  ".join(errors)))
             return 0
+        self._check_layout(load_layout(path), path, log)
         with tempfile.TemporaryDirectory() as d:
             p = os.path.join(d, "net.params")
             with open(p, "wb") as f:
@@ -379,6 +498,15 @@ class TrainingCheckpointer:
                 with open(t, "wb") as f:
                     f.write(blob["trainer"])
                 self._trainer.load_states(t)
+        if self._reshard_layout is not None:
+            # the checkpoint crossed a device-count change: re-partition
+            # the freshly-loaded params onto the live topology instead of
+            # letting jax throw a committed-sharding error deep inside
+            # the first train step's device_put
+            from .fault import elastic
+
+            elastic.reshard_net(self._net, self._reshard_layout)
+            self._reshard_layout = None
         import glob
 
         step = int(blob["step"])

@@ -220,7 +220,8 @@ _ENV_KNOBS = {
         "cwd) (honored, this build's addition)"),
     "MXNET_FAULT_INJECT": (
         "fault.injection", "seeded chaos schedule 'seam[@rank]:prob"
-        "[:seed[:limit[:kind]]],...' (kind: fault | oom | delay; @rank "
+        "[:seed[:limit[:kind]]],...' (kind: fault | oom | delay | "
+        "topology, or shrink=N for a sized topology shrink; @rank "
         "targets one process of a multi-rank launch) armed at import "
         "(incl. spawned DataLoader "
         "workers); unset = every probe a dead branch (honored, this "
@@ -230,6 +231,27 @@ _ENV_KNOBS = {
         "sleeps (default 50) — the deterministic-straggler magnitude "
         "for the collective_delay seam (honored, this build's addition "
         "— see TELEMETRY.md)"),
+    "MXNET_ELASTIC": (
+        "fault.elastic + preemption", "elastic-topology master switch "
+        "(default ON): 0 = ElasticController.poll() is a dead branch "
+        "and a checkpoint whose layout sidecar disagrees with the live "
+        "topology raises LayoutMismatch instead of resharding (honored, "
+        "this build's addition — see RESILIENCE.md)"),
+    "MXNET_ELASTIC_MIN_RANKS": (
+        "fault.elastic.ElasticController", "smallest membership a "
+        "re-rendezvous may commit (default 1); a roster below this "
+        "fails the transition instead of limping (honored, this "
+        "build's addition — see RESILIENCE.md)"),
+    "MXNET_ELASTIC_DRAIN_S": (
+        "parallel.dist.rendezvous", "seconds the membership-epoch "
+        "rendezvous waits for the roster to settle before committing "
+        "the survivor set (default 20) (honored, this build's addition "
+        "— see RESILIENCE.md)"),
+    "MXNET_DRYRUN_ELASTIC": (
+        "__graft_entry__ dryrun_multichip", "1 = force the 2-process "
+        "elastic-departure subphase (rank-1 topology_change seam, "
+        "survivor re-rendezvous); 0 = skip; unset = runs only in the "
+        "spawned dryrun child (honored, this build's addition)"),
     "MXNET_FLEET": (
         "telemetry.fleet", "1 = arm the cross-rank fleet plane alone "
         "(collective profiler, barrier skew, flightrec rank stamp + "

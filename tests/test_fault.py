@@ -582,6 +582,261 @@ def test_resilience_amp_backoff(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# elastic topology (ISSUE 13): membership epochs, checkpoint resharding,
+# the topology_change seam, and the shrink chaos-convergence gate
+# ---------------------------------------------------------------------------
+
+def _gauge(name):
+    rep = registry.report()
+    return rep.get(name, {}).get("value", 0) or 0
+
+
+@pytest.fixture(autouse=True)
+def _pristine_membership():
+    from incubator_mxnet_tpu.parallel import dist
+    dist._reset_membership()
+    yield
+    dist._reset_membership()
+
+
+def test_topology_seam_parse_and_classification():
+    from incubator_mxnet_tpu.fault.injection import TopologyChanged
+
+    injection.configure_injection("topology_change:1.0:3:2:shrink=4")
+    info = injection.schedule_info()["topology_change"]
+    assert info["kind"] == "topology"
+    assert info["shrink"] == 4
+    with pytest.raises(TopologyChanged) as ei:
+        injection.inject_at("topology_change")
+    assert ei.value.shrink == 4
+    # a topology change is NOT a transient fault: retrying the step
+    # cannot bring the departed rank back
+    assert ei.value.non_retryable
+    assert retry.classify_exception(ei.value) == "fatal"
+    # pickles across process boundaries (worker pools)
+    import pickle
+    e2 = pickle.loads(pickle.dumps(ei.value))
+    assert isinstance(e2, TopologyChanged) and e2.shrink == 4
+
+
+def test_topology_seam_rank_targeting():
+    # @rank matches this process (rank 0 single-process): fires
+    injection.configure_injection("topology_change@0:1.0:0:1")
+    with pytest.raises(fault.FaultInjected):
+        injection.inject_at("topology_change")
+    injection.clear_injection()
+    # targeted at another rank: this process never fires it
+    injection.configure_injection("topology_change@5:1.0:0:9")
+    for _ in range(16):
+        injection.inject_at("topology_change")
+
+
+def test_stale_generation_fails_loudly():
+    """A rank that missed the membership transition must FAIL its next
+    collective (non-retryable), not hang the surviving fleet."""
+    import jax.numpy as jnp
+
+    from incubator_mxnet_tpu.parallel import dist
+
+    gen0 = dist.generation()
+    # single-process rendezvous turns the epoch in place
+    gen1, members = dist.rendezvous()
+    assert gen1 == gen0 + 1 and dist.generation() == gen1
+    # a collective still holding the OLD epoch fails loudly...
+    with pytest.raises(dist.StaleGenerationError) as ei:
+        dist.allreduce(jnp.ones(2), generation=gen0)
+    assert retry.classify_exception(ei.value) == "fatal"
+    # ...and the CURRENT epoch passes
+    out = dist.allreduce(jnp.ones(2), generation=gen1)
+    assert float(out.sum()) == 2.0
+    # a departed rank is fenced out of every later collective
+    dist.rendezvous(leave=True)
+    with pytest.raises(dist.StaleGenerationError):
+        dist.barrier()
+
+
+def test_elastic_sampler_covers_exactly_once():
+    from incubator_mxnet_tpu.gluon.data import ElasticSampler
+
+    # two ranks, lockstep: each draws 3 of 16, then rank 1 departs and
+    # rank 0 reshards to a 1-shard world — every index appears EXACTLY
+    # once across what was consumed and what remains
+    s0 = ElasticSampler(16, num_shards=2, index=0, shuffle=True, seed=5)
+    s1 = ElasticSampler(16, num_shards=2, index=1, shuffle=True, seed=5)
+    it0, it1 = iter(s0), iter(s1)
+    drawn = [next(it0) for _ in range(3)] + [next(it1) for _ in range(3)]
+    s0.reshard(num_shards=1, index=0)
+    rest = list(s0)
+    assert sorted(drawn + rest) == list(range(16))
+    assert len(s0) == 0 and s0.remaining() == 0
+
+
+def _make_dp(mesh, seed=0, units=1, in_units=4, param_shardings=None):
+    from incubator_mxnet_tpu import optimizer as opt
+    from incubator_mxnet_tpu.parallel import DataParallel
+
+    mx.random.seed(seed)
+    net = gluon.nn.Dense(units, in_units=in_units)
+    net.initialize()
+    dp = DataParallel(net, lambda o, y: ((o - y) ** 2),
+                      opt.SGD(learning_rate=0.1), mesh=mesh,
+                      param_shardings=param_shardings)
+    return net, dp
+
+
+def test_elastic_chaos_shrink_convergence(_fast_retries):
+    """ISSUE 13 acceptance gate: a seeded mid-run topology shrink
+    (8 -> 4 devices at a drained step boundary) converges to the SAME
+    final loss as the unfaulted run, the transition metrics are nonzero,
+    and the post-shrink layout passes shardcheck clean."""
+    from incubator_mxnet_tpu.fault.elastic import ElasticController
+    from incubator_mxnet_tpu.parallel import dist
+    from incubator_mxnet_tpu.parallel.mesh import make_mesh
+
+    rng = onp.random.RandomState(0)
+    X = rng.uniform(-1, 1, (64, 4)).astype("float32")
+    w = rng.uniform(-1, 1, (4, 1)).astype("float32")
+    Y = X @ w
+
+    def run(chaos):
+        dist._reset_membership()
+        injection.clear_injection()
+        net, dp = _make_dp(make_mesh({"dp": 8}))
+        ctl = ElasticController(trainer=dp)
+        if chaos:
+            injection.configure_injection(
+                "topology_change:1.0:11:1:shrink=4")
+        losses = []
+        for step in range(12):
+            losses.append(float(dp.step(X, Y)))
+            verdict = ctl.poll()            # drained step boundary
+            if chaos and step == 0:
+                assert verdict == "shrunk"
+        injection.clear_injection()
+        return losses, dp
+
+    losses_a, _ = run(chaos=False)
+    t0 = _counter("mx_elastic_transitions_total")
+    losses_b, dp_b = run(chaos=True)
+
+    # the shrink kept the global batch: the trajectory is preserved
+    assert abs(losses_a[-1] - losses_b[-1]) <= 0.02, (
+        losses_a[-1], losses_b[-1])
+    assert int(dp_b.mesh.devices.size) == 4
+    assert dist.generation() == 1
+    # transition was measured
+    assert _counter("mx_elastic_transitions_total") == t0 + 1
+    assert _gauge("mx_elastic_reshard_seconds") > 0
+    assert _gauge("mx_elastic_generation") == 1
+    # post-shrink layout is shardcheck-clean (no error-severity findings)
+    rep = dp_b.shardcheck_report()
+    assert not [f for f in rep.findings if f.severity == "error"], (
+        rep.findings)
+
+
+def test_elastic_preflight_aborts_on_silent_replication():
+    """A shrink that would silently replicate a large sharded param
+    (its mesh axis is gone) aborts BEFORE the epoch turns, naming the
+    SC001 finding."""
+    import jax
+
+    from incubator_mxnet_tpu.fault.elastic import (
+        ElasticController, ElasticTransitionAborted)
+    from incubator_mxnet_tpu.parallel import dist
+    from incubator_mxnet_tpu.parallel.mesh import make_mesh
+
+    P = jax.sharding.PartitionSpec
+    mesh = make_mesh({"dp": 2, "tp": 4})
+    # Dense(512, in_units=512): the 1 MiB weight rides 'tp', bias repl
+    net, dp = _make_dp(mesh, units=512, in_units=512,
+                       param_shardings=[P(None, "tp"), P()])
+    ctl = ElasticController(trainer=dp)
+    gen0 = dist.generation()
+    new_mesh = make_mesh({"dp": 2}, devices=jax.devices()[:2])  # no 'tp'
+    with pytest.raises(ElasticTransitionAborted) as ei:
+        ctl._preflight(new_mesh)
+    assert any(f.rule == "SC001" for f in ei.value.findings)
+    assert "SC001" in str(ei.value)
+    assert retry.classify_exception(ei.value) == "fatal"
+    assert dist.generation() == gen0        # nothing committed
+
+
+def test_elastic_resume_across_device_count(tmp_path):
+    """Acceptance: save under mesh A (8 devices), resume under mesh B
+    (4 devices) — the layout sidecar routes the load through
+    reshard_net and the next-step loss matches the uninterrupted run."""
+    import json
+
+    from incubator_mxnet_tpu.fault import elastic
+    from incubator_mxnet_tpu.parallel.mesh import make_mesh
+
+    rng = onp.random.RandomState(3)
+    X = rng.uniform(-1, 1, (64, 4)).astype("float32")
+    w = rng.uniform(-1, 1, (4, 1)).astype("float32")
+    Y = X @ w
+
+    # -- run A: train 3 steps on dp=8, checkpoint, take step-4 loss --
+    net_a, dp_a = _make_dp(make_mesh({"dp": 8}), seed=9)
+    ck_a = preemption.TrainingCheckpointer(
+        str(tmp_path / "el"), net_a, every_n=1, register_signal=False,
+        layout_fn=lambda: elastic.checkpoint_layout(dp_a))
+    for _ in range(3):
+        dp_a.step(X, Y)
+        ck_a.step()
+    path = ck_a._mgr.latest()               # every_n=1: step 3 is on disk
+    loss_ref = float(dp_a.step(X, Y))
+
+    side = preemption.load_layout(path)
+    assert side["format"] == 2
+    assert side["mesh"] == {"axes": [["dp", 8]]}
+    assert any(k.startswith("param/") for k in side["leaves"])
+
+    # -- resume under a SHRUNK topology: fake the device-count delta the
+    # sidecar would carry across real machines (same host here) --
+    side["device_count"] = 999
+    with open(path + preemption._LAYOUT_SUFFIX, "w") as f:
+        json.dump(side, f)
+
+    # disabled elastic = a clear LayoutMismatch, not a jax shape error
+    net_b = gluon.nn.Dense(1, in_units=4)
+    net_b.initialize()
+    ck_b = preemption.TrainingCheckpointer(
+        str(tmp_path / "el"), net_b, register_signal=False)
+    with environment("MXNET_ELASTIC", "0"):
+        with pytest.raises(preemption.LayoutMismatch):
+            ck_b.resume()
+
+    # enabled (default): resume reshards onto the live topology...
+    r0 = _counter("mx_elastic_layout_resumes_total")
+    step = ck_b.resume()
+    assert step == 3
+    assert _counter("mx_elastic_layout_resumes_total") == r0 + 1
+
+    # ...and the next step on mesh B reproduces run A's step-4 loss
+    import jax
+
+    from incubator_mxnet_tpu import optimizer as opt
+    from incubator_mxnet_tpu.parallel import DataParallel
+    dp_b = DataParallel(net_b, lambda o, y: ((o - y) ** 2),
+                        opt.SGD(learning_rate=0.1),
+                        mesh=make_mesh({"dp": 4},
+                                       devices=jax.devices()[:4]))
+    loss_b = float(dp_b.step(X, Y))
+    assert abs(loss_b - loss_ref) <= 1e-4, (loss_b, loss_ref)
+
+
+def test_elastic_controller_disabled_is_noop():
+    from incubator_mxnet_tpu.fault.elastic import ElasticController
+
+    injection.configure_injection("topology_change:1.0:0:9:shrink=4")
+    ctl = ElasticController()
+    with environment("MXNET_ELASTIC", "0"):
+        # the seam is armed but elastic is off: no transition, no raise
+        assert ctl.poll() == "stable"
+    assert injection.schedule_info()["topology_change"]["fired"] == 0
+
+
+# ---------------------------------------------------------------------------
 # lint FL006
 # ---------------------------------------------------------------------------
 

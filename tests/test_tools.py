@@ -663,6 +663,72 @@ def test_fl014_tree_is_clean():
 
 
 # ---------------------------------------------------------------------------
+# FL015 — membership-epoch guard (ISSUE 13)
+# ---------------------------------------------------------------------------
+
+_FAULT_PATH = "incubator_mxnet_tpu/fault/elastic.py"
+_DIST_PATH = "incubator_mxnet_tpu/parallel/dist.py"
+
+
+def test_fl015_flags_unguarded_dist_collectives():
+    src = ("from ..parallel import dist\n"
+           "def sync(x, gen):\n"
+           "    a = dist.allreduce(x)\n"
+           "    dist.barrier()\n"
+           "    b = dist.broadcast(x, root=0)\n"
+           "    objs = dist.exchange_objs({'r': 0})\n"
+           "    return a, b, objs\n")
+    hits = [f for f in _lint(src, _FAULT_PATH) if f.rule == "FL015"]
+    assert len(hits) == 4
+    assert all("StaleGenerationError" in h.message for h in hits)
+    # parallel/ modules are in scope too
+    hits = [f for f in _lint(src, _PAR_PATH) if f.rule == "FL015"]
+    assert len(hits) == 4
+
+
+def test_fl015_accepts_threaded_generation_noqa_and_scoping():
+    # generation= threaded: clean
+    ok = ("from ..parallel import dist\n"
+          "def sync(x, gen):\n"
+          "    dist.barrier(generation=gen)\n"
+          "    return dist.allreduce(x, generation=dist.generation())\n")
+    assert not [f for f in _lint(ok, _FAULT_PATH) if f.rule == "FL015"]
+    # a **kwargs splat can't be seen through statically: no flag
+    splat = ("from ..parallel import dist\n"
+             "def sync(x, **kw):\n"
+             "    return dist.allreduce(x, **kw)\n")
+    assert not [f for f in _lint(splat, _FAULT_PATH) if f.rule == "FL015"]
+    # noqa escape with a reason
+    noqa = ("from ..parallel import dist\n"
+            "def sync(x):\n"
+            "    return dist.allreduce(x)  # noqa: FL015 - single-epoch\n")
+    assert not [f for f in _lint(noqa, _FAULT_PATH) if f.rule == "FL015"]
+    # dist.py itself (the guard's home) is exempt
+    bare = ("def barrier(tag='b'):\n"
+            "    pass\n"
+            "def _probe():\n"
+            "    return dist.barrier()\n")
+    assert not [f for f in _lint(bare, _DIST_PATH) if f.rule == "FL015"]
+    # out-of-scope modules (telemetry/, ops/) are untouched
+    out = ("from ..parallel import dist\n"
+           "def sync(x):\n"
+           "    return dist.allreduce(x)\n")
+    assert not [f for f in _lint(out, _OPS_PATH) if f.rule == "FL015"]
+
+
+def test_fl015_tree_is_clean():
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import framework_lint
+    finally:
+        sys.path.pop(0)
+    findings = [f for f in framework_lint.lint_paths(
+        [os.path.join(REPO, "incubator_mxnet_tpu")])
+        if f.rule == "FL015"]
+    assert not findings, findings
+
+
+# ---------------------------------------------------------------------------
 # bench_regress — trajectory regression gate (ISSUE 10)
 # ---------------------------------------------------------------------------
 

@@ -136,6 +136,20 @@ FL014  collective hygiene (scoped to ``parallel/`` and ``serve/``
        as local cost. Where a raw primitive is genuinely required
        (the wrappers themselves, rep-typing internals), annotate the
        line with ``# noqa: FL014`` and the justifying comment.
+FL015  membership-epoch guard (scoped to ``fault/`` and ``parallel/``
+       modules, excluding ``parallel/dist.py`` — the guard's home): a
+       host-level dist collective call (``dist.allreduce`` /
+       ``broadcast`` / ``barrier`` / ``exchange_objs``) without a
+       ``generation=`` argument. After an elastic topology transition
+       (RESILIENCE.md "Elastic topology") the fleet is on membership
+       epoch N+1; an unguarded collective issued by a rank still
+       holding epoch N hangs the survivors instead of failing loudly
+       with ``StaleGenerationError``. Thread the generation the caller
+       observed at its drained step boundary
+       (``dist.allreduce(x, generation=gen)``). Where the ambient
+       membership check alone is provably sufficient (single-epoch
+       tooling, test scaffolding), annotate the line with
+       ``# noqa: FL015`` and the justifying comment.
 
 Usage
 -----
@@ -204,6 +218,12 @@ RULES = {
              "around dist collectives double-counts peer skew (the "
              "profiler owns mx_collective_seconds); `# noqa: FL014` "
              "with a reason where a raw primitive is required",
+    "FL015": "fault//parallel/ membership-epoch guard: dist collective "
+             "call without a generation= argument — a rank holding a "
+             "stale epoch after an elastic transition hangs the fleet "
+             "instead of raising StaleGenerationError; thread the "
+             "generation observed at the drained step boundary, or "
+             "`# noqa: FL015` with a reason",
 }
 
 _INDEXING_NAME_PARTS = ("getitem", "setitem", "index", "slice")
@@ -1186,6 +1206,43 @@ def _check_collective_hygiene(tree, path, findings, src_lines):
 
 
 # ---------------------------------------------------------------------------
+# FL015 — membership-epoch guard (fault/ and parallel/ modules)
+# ---------------------------------------------------------------------------
+
+def _check_generation_guard(tree, path, findings, src_lines):
+    norm = path.replace(os.sep, "/")
+    if "/fault/" not in norm and "/parallel/" not in norm:
+        return
+    if norm.endswith("parallel/dist.py"):
+        return      # the guard's own home: check_generation lives here
+
+    def noqa(lineno):
+        line = src_lines[lineno - 1] if lineno - 1 < len(src_lines) else ""
+        return "noqa: FL015" in line
+
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in _DIST_OPS
+                and isinstance(node.func.value, ast.Name)
+                and node.func.value.id == "dist"):
+            continue
+        # generation= threaded, or a **kwargs splat we can't see through
+        if any(kw.arg == "generation" or kw.arg is None
+               for kw in node.keywords):
+            continue
+        if noqa(node.lineno):
+            continue
+        findings.append(LintFinding(
+            path, node.lineno, "FL015",
+            f"`dist.{node.func.attr}(...)` without `generation=`: after "
+            "an elastic membership transition a stale rank must fail "
+            "loudly (StaleGenerationError), not hang the fleet — thread "
+            "the epoch observed at the drained step boundary "
+            "(`dist.generation()`), or `# noqa: FL015` with a reason"))
+
+
+# ---------------------------------------------------------------------------
 # driver
 # ---------------------------------------------------------------------------
 
@@ -1212,6 +1269,7 @@ def lint_source(src, path, coverage_text=None):
     _check_paged_hazards(tree, path, findings)
     _check_span_hygiene(tree, path, findings)
     _check_collective_hygiene(tree, path, findings, src.splitlines())
+    _check_generation_guard(tree, path, findings, src.splitlines())
     _check_ops_ledger(tree, path, findings, coverage_text)
     return findings
 
