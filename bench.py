@@ -90,6 +90,7 @@ import numpy as onp
 BASELINE_V100_DOT_MS = 0.215
 BASELINE_V100_RESNET50_IMG_S = 370.0
 PEAK_BF16_TFLOPS = 197.0  # TPU v5e
+BENCH_CHIP = "v5e"        # roofline key for telemetry.kernels/roofline
 
 
 def _sync():
@@ -314,7 +315,8 @@ def bench_resnet50_train(batch=128, iters=20, warmup=2):
     return batch / dt
 
 
-def bench_bert_train(batch=64, seq=128, iters=20, warmup=2):
+def bench_bert_train(batch=64, seq=128, iters=20, warmup=2,
+                     trace_check=False):
     """tokens/sec + MFU: compiled train step on gluon BERT-base (flash),
     funnel-level AMP bf16 (activations bf16, fp32 master params).
 
@@ -362,7 +364,96 @@ def bench_bert_train(batch=64, seq=128, iters=20, warmup=2):
     flops_per_token = (6.0 * float(n_params)
                        + 12.0 * n_layers * seq * units)
     mfu = flops_per_token * tokens_s / (PEAK_BF16_TFLOPS * 1e12)
+    if trace_check:
+        amp.init("bfloat16")
+        try:
+            _TRACE_CHECK[seq] = _bert_trace_crosscheck(
+                dp, tokens, labels, flops_per_token, batch, seq)
+        finally:
+            amp.deinit()
     return tokens_s, mfu
+
+
+# per-seq results of the last _bert_trace_crosscheck (main() reads them
+# into extras after bench_bert_train returns)
+_TRACE_CHECK: dict = {}
+
+
+def _bert_trace_crosscheck(dp, tokens, labels, flops_per_token, batch,
+                           seq, iters=3):
+    """Trace-measured MFU vs the hand-derived formula: re-run a few
+    steps under the device profiler and divide the formula's FLOPs by
+    MEASURED device time (`telemetry.kernels.program_mfu`) — the
+    cross-check that catches the formula drifting from what the chip
+    actually executes. Returns {"trace_mfu", "top_kernel_gbs",
+    "attributed_frac"} or None when the backend yields no ``/device:``
+    trace lane (CPU hosts: wall-clock MFU is the only claim there)."""
+    from incubator_mxnet_tpu import profiler
+    from incubator_mxnet_tpu.telemetry import kernels
+
+    profiler.start()
+    try:
+        loss = None
+        for _ in range(iters):
+            loss = dp.step(tokens, labels)
+        float(loss.asnumpy())
+    finally:
+        profiler.stop()
+    events = profiler.device_events()
+    has_device_lane = any(
+        e.get("ph") == "M" and e.get("name") == "process_name"
+        and str((e.get("args") or {}).get("name", ""))
+        .startswith("/device:") for e in events)
+    if not has_device_lane:
+        return None
+    c = kernels.census(events, device=BENCH_CHIP)
+    dev_s = c["meta"]["named_us"] * 1e-6
+    trace_mfu = kernels.program_mfu(
+        flops_per_token * batch * seq, iters, dev_s,
+        peak_tflops=PEAK_BF16_TFLOPS)
+    top = kernels.top_bandwidth_bound(c, 1)
+    return {"trace_mfu": trace_mfu,
+            "top_kernel_gbs": top[0]["achieved_gbs"] if top else None,
+            "attributed_frac": c["meta"]["attributed_frac"]}
+
+
+def bench_train_goodput(steps=24, batch=16):
+    """train_goodput_frac: fraction of wall seconds the goodput ledger
+    attributes to compute over a short REAL estimator fit (dense net,
+    in-memory dataset through the DataLoader) — exercises the lease
+    seams end-to-end exactly as production wiring does, so the number
+    regressing means the ledger or the loop changed, not the model."""
+    from incubator_mxnet_tpu import gluon, np
+    from incubator_mxnet_tpu.gluon.contrib.estimator import Estimator
+    from incubator_mxnet_tpu.gluon.data.dataloader import DataLoader
+    from incubator_mxnet_tpu.gluon.data.dataset import ArrayDataset
+    from incubator_mxnet_tpu.telemetry import goodput
+
+    rng = onp.random.RandomState(3)
+    X = rng.uniform(-1, 1, (steps * batch, 32)).astype("float32")
+    Y = (X @ rng.uniform(-1, 1, (32, 1)).astype("float32"))
+    net = gluon.nn.Dense(1)
+    net.initialize()
+    net(np.array(X[:2]))
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.05})
+    est = Estimator(net, gluon.loss.L2Loss(), trainer=trainer)
+    import logging
+
+    est.logger.setLevel(logging.ERROR)   # keep the bench output clean
+    loader = DataLoader(ArrayDataset(X, Y), batch_size=batch,
+                        num_workers=0)
+    was_enabled = goodput.is_enabled()
+    goodput.reset()
+    goodput.enable()
+    try:
+        est.fit(loader, epochs=1)
+        rep = goodput.report()
+    finally:
+        if not was_enabled:
+            goodput.disable()
+        goodput.reset()
+    return rep["goodput_frac"]
 
 
 def _bench_input_pipeline_subprocess(timeout=900):
@@ -1249,11 +1340,34 @@ def main():
     try:
         # flash attention's regime: the T² term is 8.6% of total FLOPs
         tokens_s512, mfu512 = _retry(
-            lambda: bench_bert_train(batch=32, seq=512, iters=10))
+            lambda: bench_bert_train(batch=32, seq=512, iters=10,
+                                     trace_check=True))
         extras["bert_seq512_train_tokens_s"] = round(tokens_s512, 1)
         extras["bert_mfu_seq512"] = round(mfu512, 4)
+        tc = _TRACE_CHECK.get(512)
+        if tc and tc.get("trace_mfu") is not None:
+            extras["bert_trace_mfu_seq512"] = round(tc["trace_mfu"], 4)
+            drift = abs(tc["trace_mfu"] - mfu512) / max(mfu512, 1e-12)
+            extras["bench_mfu_formula_drift"] = round(drift, 4)
+            if drift > 0.10:
+                print(f"WARNING: bert seq512 MFU formula "
+                      f"({mfu512:.4f}) disagrees with the trace-"
+                      f"measured MFU ({tc['trace_mfu']:.4f}) by "
+                      f"{drift * 100:.1f}% — the hand-derived FLOPs "
+                      "formula has drifted from what the chip executes",
+                      file=sys.stderr)
+        if tc and tc.get("top_kernel_gbs") is not None:
+            # achieved GB/s of the top bandwidth-bound kernel — the
+            # number the seq512 fusion work should push toward the roof
+            extras["bert_seq512_top_kernel_gbs"] = \
+                round(tc["top_kernel_gbs"], 1)
     except Exception as e:  # pragma: no cover
         _fail("bert_seq512", e)
+    try:
+        extras["train_goodput_frac"] = round(
+            _retry(bench_train_goodput), 4)
+    except Exception as e:  # pragma: no cover
+        _fail("train_goodput", e)
     try:
         extras["flash_T32k_fwd_tokens_s"] = round(
             _retry(bench_flash_long_context), 1)

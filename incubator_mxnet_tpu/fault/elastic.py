@@ -399,11 +399,12 @@ class ElasticController:
 
     def _leave(self, why):
         from ..parallel import dist
-        from ..telemetry import registry, tracing
+        from ..telemetry import goodput, registry, tracing
 
         if self.checkpointer is not None:
-            self.checkpointer.save_now()
-        gen, _ = dist.rendezvous(leave=True)
+            self.checkpointer.save_now()   # checkpoint lease inside
+        with goodput.lease("drain"):
+            gen, _ = dist.rendezvous(leave=True)
         registry.counter(
             "mx_elastic_departures_total",
             "clean elastic departures (this rank left the fleet)").inc()
@@ -420,10 +421,15 @@ class ElasticController:
         :class:`ElasticTransitionAborted` (pre-flight) BEFORE any state
         commits; afterwards the fleet is on generation N+1."""
         from ..parallel import dist
-        from ..telemetry import registry, tracing
+        from ..telemetry import goodput, registry, tracing
 
         t0 = time.perf_counter()
-        with tracing.span("elastic.transition", shrink=int(shrink or 0)):
+        # goodput attribution: the whole transition is `reshard` except
+        # the rendezvous wait (`drain`) and the drain-point checkpoint
+        # write (`checkpoint`, leased inside atomic_save) — inner leases
+        # win, the outer lease keeps the preflight/rebuild remainder
+        with tracing.span("elastic.transition", shrink=int(shrink or 0)), \
+                goodput.lease("reshard"):
             new_mesh = self._shrunk_mesh(shrink)
             if new_mesh is not None and self.trainer is not None:
                 specs = self._preflight(new_mesh)   # raises on SC001/SC006
@@ -433,8 +439,9 @@ class ElasticController:
                 # drain point: a rank that restarts instead of resharding
                 # in place resumes from here across the layout change
                 self.checkpointer.save_now()
-            gen, members = dist.rendezvous(min_ranks=self.min_ranks,
-                                           timeout_s=self.drain_s)
+            with goodput.lease("drain"):
+                gen, members = dist.rendezvous(min_ranks=self.min_ranks,
+                                               timeout_s=self.drain_s)
             if new_mesh is not None and self.trainer is not None:
                 self.trainer.rebuild(new_mesh, param_shardings=specs)
             self._reshard_sampler(members)
@@ -454,6 +461,10 @@ class ElasticController:
                           devices=(int(new_mesh.devices.size)
                                    if new_mesh is not None else 0),
                           seconds=round(elapsed, 3))
+        # transition flight record: the registered context probes
+        # (goodput ledger, kernel census, compile ledger...) snapshot
+        # what the topology change cost, per rank
+        tracing.maybe_flight_dump("elastic_transition")
         _LOG.warning(
             "elastic: transition committed — generation %d, %d member(s)"
             "%s, %.3fs", gen, len(members or ()),

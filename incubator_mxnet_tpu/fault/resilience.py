@@ -178,11 +178,16 @@ class ResilienceHandler(StepGuard, TrainBegin, BatchEnd):
         # carries the crashed step's spans and the fault that fired
         # (RESOURCE_EXHAUSTED upgrades to the OOM post-mortem with the
         # HBM census + compile ledger in the payload)
+        from ..telemetry import goodput
         from ..telemetry import hbm as _hbm
 
-        if _hbm.maybe_oom_postmortem("estimator_step", exc) is None:
-            _tracing().maybe_flight_dump("estimator_crash", exc)
-        step = self.checkpointer.resume()
+        # the whole crash-recovery tail is goodput `recovery` time (the
+        # checkpointer.resume() below holds its own recovery lease too —
+        # same state, so nesting is a no-op attribution-wise)
+        with goodput.lease("recovery"):
+            if _hbm.maybe_oom_postmortem("estimator_step", exc) is None:
+                _tracing().maybe_flight_dump("estimator_crash", exc)
+            step = self.checkpointer.resume()
         self._resumes += 1
         _registry().counter(
             "mx_resumes_total",

@@ -198,7 +198,7 @@ class Estimator:
             train_end = self._categorize_handlers(event_handlers)
         step_guards = [h for h in event_handlers if isinstance(h, StepGuard)]
         from ....fault.injection import inject_at
-        from ....telemetry import tracing
+        from ....telemetry import goodput, tracing
 
         for handler in train_begin:
             handler.train_begin(self)
@@ -221,7 +221,8 @@ class Estimator:
                     # guard, every exception propagates exactly as before
                     try:
                         with tracing.span("estimator.step",
-                                          batch=n_batches):
+                                          batch=n_batches), \
+                                goodput.lease("compute"):
                             inject_at("estimator_step")   # chaos seam
                             data, label, pred, loss = self.fit_batch(
                                 batch, batch_axis)

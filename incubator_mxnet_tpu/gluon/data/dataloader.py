@@ -251,7 +251,7 @@ class DataLoader:
 
     def __iter__(self):
         from ...ndarray.ndarray import NDArray
-        from ...telemetry import tracing
+        from ...telemetry import goodput, tracing
 
         def wrap(b):
             if isinstance(b, tuple) and len(b) == 4 and b[0] == _SHM_TAG:
@@ -264,7 +264,8 @@ class DataLoader:
 
         if self._pool is None:
             for n, batch_idx in enumerate(self._batch_sampler):
-                with tracing.span("dataloader.batch", batch=n, workers=0):
+                with tracing.span("dataloader.batch", batch=n, workers=0), \
+                        goodput.lease("data_wait"):
                     out = wrap(self._batchify_fn([self._dataset[i]
                                                   for i in batch_idx]))
                 yield out
@@ -301,7 +302,8 @@ class DataLoader:
                 # the batch-fetch segment of the trace: wait on the
                 # worker's future (+ any retries) through NDArray wrap
                 with tracing.span("dataloader.batch", batch=n_yielded,
-                                  workers=self._num_workers):
+                                  workers=self._num_workers), \
+                        goodput.lease("data_wait"):
                     samples, fut, attempts = in_flight[0]
                     try:
                         result = fut.get(self._timeout)

@@ -209,9 +209,10 @@ def atomic_save(path, write_fn, checksum=True, layout=None):
         write_fn(tmp)
 
     from .fault.retry import RetryExhausted, RetryPolicy
-    from .telemetry import tracing
+    from .telemetry import goodput, tracing
 
-    with tracing.span("checkpoint.write", path=str(path)):
+    with tracing.span("checkpoint.write", path=str(path)), \
+            goodput.lease("checkpoint"):
         try:
             RetryPolicy.from_env("checkpoint").call(_write)
         except Exception as e:
@@ -417,11 +418,12 @@ class TrainingCheckpointer:
         import logging
         import tempfile
 
-        from .telemetry import tracing
+        from .telemetry import goodput, tracing
 
         log = logging.getLogger("incubator_mxnet_tpu.fault")
         with tracing.span("checkpoint.resume",
-                          prefix=self._mgr._prefix):  # noqa: SLF001
+                          prefix=self._mgr._prefix), \
+                goodput.lease("recovery"):   # noqa: SLF001 (mgr prefix)
             return self._resume_impl(log, tempfile)
 
     def _check_layout(self, side, path, log):

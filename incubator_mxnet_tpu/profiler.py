@@ -31,6 +31,7 @@ from collections import defaultdict
 
 __all__ = ["set_config", "set_state", "start", "stop", "dump", "dumps",
            "pause", "resume", "Scope", "profiler_scope", "device_events",
+           "event_stat_bytes", "event_stat_flops",
            "memory_stats", "live_buffer_table", "memory_snapshot",
            "analyze_memory"]
 
@@ -189,6 +190,18 @@ def _ingest_device_trace(trace_dir):
                 # rows carry no timestamp and always survive)
                 if e.get("ph") != "M" and _in_paused_interval(kept["ts"]):
                     continue
+            if e.get("ph") == "X":
+                # normalize the per-version XPlane stat spellings into
+                # canonical arg keys so every downstream consumer
+                # (roofline, kernel census) reads one name
+                b, fl = event_stat_bytes(kept), event_stat_flops(kept)
+                if b is not None or fl is not None:
+                    args = dict(kept.get("args") or {})
+                    if b is not None:
+                        args["bytes_accessed"] = b
+                    if fl is not None:
+                        args["flops"] = fl
+                    kept["args"] = args
             _DEVICE_EVENTS.append(kept)
             if e.get("ph") == "X" and lanes[pid].startswith("/device:"):
                 agg = _DEVICE_AGG[e.get("name", "?")]
@@ -196,9 +209,44 @@ def _ingest_device_trace(trace_dir):
                 agg[1] += float(e.get("dur", 0))
 
 
+def event_stat_bytes(e):
+    """Bytes accessed by one trace event, from its XPlane stat args, or
+    None when the trace carries no byte accounting for it. THE extraction
+    path: `telemetry.roofline` and `telemetry.kernels` both route through
+    here, so a new jax/XLA stat spelling (``bytes accessed`` vs
+    ``bytes_accessed`` vs bare ``bytes``) is fixed in one place."""
+    args = e.get("args") or {}
+    for k, v in args.items():
+        lk = k.lower()
+        if "bytes" in lk and ("access" in lk or lk == "bytes"):
+            try:
+                return int(float(v))
+            except (TypeError, ValueError):
+                continue
+    return None
+
+
+def event_stat_flops(e):
+    """FLOPs of one trace event from its XPlane stat args (``flops`` /
+    ``model_flops`` / ``device_flops`` spellings), or None."""
+    args = e.get("args") or {}
+    for k, v in args.items():
+        lk = k.lower().replace(" ", "_")
+        if lk in ("flops", "model_flops", "device_flops",
+                  "estimated_flops"):
+            try:
+                return int(float(v))
+            except (TypeError, ValueError):
+                continue
+    return None
+
+
 def device_events():
     """Parsed device-timeline events from the last stop() (list of chrome
-    trace events; empty before any device trace completes)."""
+    trace events; empty before any device trace completes). Events whose
+    XPlane stats carry byte/FLOP accounting additionally expose the
+    canonical ``bytes_accessed``/``flops`` arg keys (normalized at
+    ingest), so consumers need not know the per-version stat spellings."""
     with _LOCK:
         return list(_DEVICE_EVENTS)
 

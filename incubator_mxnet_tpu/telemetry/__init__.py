@@ -36,7 +36,16 @@ Six connected parts:
   barrier-arrival skew), `fleet_report()` per-rank/aggregate registry
   views with a straggler z-score, clock-offset estimation + stitched
   multi-rank timelines (``tools/trace_timeline.py --fleet``), and the
-  crash-fanout flight recorder merged by ``tools/fleetwatch.py``.
+  crash-fanout flight recorder merged by ``tools/fleetwatch.py``;
+- `kernels`   — per-HLO kernel census over the profiler's device trace,
+  roofline placement per kernel (``bound_by`` with honest unknown-bytes
+  coverage), compile-ledger join, and `diff_census` fusion forensics
+  (``mx_kernel_fusion_delta``; rendered by ``tools/kernelscope.py``);
+- `goodput`   — training goodput ledger attributing every wall second to
+  compute / data_wait / checkpoint / reshard / drain / recovery / idle
+  via `lease()` seams in the estimator, dataloader, checkpointer, and
+  `ElasticController` (``mx_goodput_seconds_total{state=}``,
+  ``mx_goodput_frac``; fleet-aggregated in `fleet_report()`).
 
 Env knobs (registered in `util._ENV_KNOBS`): ``MXNET_TELEMETRY``
 (``1`` = stage + span tracing on, ``raise`` = + NaN guard raising at the
@@ -56,6 +65,8 @@ from . import monitor  # noqa: F401
 from . import compiles  # noqa: F401
 from . import hbm  # noqa: F401
 from . import fleet  # noqa: F401
+from . import kernels  # noqa: F401
+from . import goodput  # noqa: F401
 from .monitor import Monitor, install_nan_hook  # noqa: F401
 
 # arm the host->device byte inlet (a counter inc per transfer — rare
@@ -65,4 +76,5 @@ from ..ndarray import ndarray as _nd_mod
 _nd_mod._H2D_HOOK = registry.add_h2d_bytes
 
 __all__ = ["registry", "stages", "tracing", "slo", "roofline", "monitor",
-           "compiles", "hbm", "fleet", "Monitor", "install_nan_hook"]
+           "compiles", "hbm", "fleet", "kernels", "goodput", "Monitor",
+           "install_nan_hook"]

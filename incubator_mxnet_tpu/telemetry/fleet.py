@@ -536,7 +536,11 @@ def _hist_mean(report, name):
 
 def _local_snapshot():
     from ..fault import injection
+    from . import goodput
 
+    # close the goodput ledger's open interval so the counters in this
+    # registry snapshot are current to the instant of the exchange
+    goodput.goodput_frac()
     return {"rank": _rank(), "host": socket.gethostname(),
             "pid": os.getpid(), "wall_time": time.time(),
             "registry": registry.report(),
@@ -587,12 +591,49 @@ def _aggregate_registries(reports):
     return agg
 
 
+def _goodput_view(ranks):
+    """Fleet goodput rollup from each rank's
+    ``mx_goodput_seconds_total{state=}`` counters: per-rank state seconds
+    + goodput fraction, fleet-summed states, and the rank losing the most
+    time to data_wait (a straggling input pipeline's usual signature).
+    None when no rank has leased any goodput time yet."""
+    per_rank = {}
+    fleet: dict = {}
+    for r, s in ranks.items():
+        states = {}
+        for key, cell in (s.get("registry") or {}).items():
+            if not key.startswith("mx_goodput_seconds_total{"):
+                continue
+            m = re.search(r'state="([^"]+)"', key)
+            if m and isinstance(cell, dict):
+                states[m.group(1)] = float(cell.get("value") or 0.0)
+        if not states:
+            continue
+        wall = sum(states.values())
+        per_rank[r] = {
+            "states": states, "wall_s": wall,
+            "goodput_frac": ((states.get("compute", 0.0) / wall)
+                             if wall > 0 else 0.0)}
+        for st, v in states.items():
+            fleet[st] = fleet.get(st, 0.0) + v
+    if not per_rank:
+        return None
+    tot = sum(fleet.values())
+    worst = max(per_rank,
+                key=lambda r: per_rank[r]["states"].get("data_wait", 0.0))
+    return {"per_rank": per_rank, "fleet_states": fleet,
+            "fleet_goodput_frac": ((fleet.get("compute", 0.0) / tot)
+                                   if tot > 0 else 0.0),
+            "worst_data_wait_rank": int(worst)}
+
+
 def fleet_report():
     """Gather every rank's snapshot (registry report + barrier stats +
     fault schedule) into per-rank and fleet-aggregate views, score the
-    straggler, and refresh the `mx_fleet_*` gauges. Collective: every
-    rank must call it (each gets the same report). Single-process: a
-    1-rank report over the local registry."""
+    straggler, refresh the `mx_fleet_*` gauges, and roll up the per-rank
+    goodput ledgers (``report["goodput"]``). Collective: every rank must
+    call it (each gets the same report). Single-process: a 1-rank report
+    over the local registry."""
     global _LAST_REPORT
 
     snaps = exchange_large(_local_snapshot())
@@ -626,6 +667,7 @@ def fleet_report():
                          "scores": {int(r): round(v, 4)
                                     for r, v in scores.items()},
                          "signals": samples},
+           "goodput": _goodput_view(ranks),
            "clock": {"offsets": _CLOCK.get("offsets"),
                      "bound_s": _CLOCK.get("bound_s")}}
     _LAST_REPORT = rep

@@ -74,18 +74,13 @@ def _device_lane_pids(events):
 
 
 def _event_bytes(e):
-    """bytes accessed by one HLO event, from its XPlane stat args (several
-    spellings across jax/XLA versions), or None when the trace has no
-    byte accounting for it."""
-    args = e.get("args") or {}
-    for k, v in args.items():
-        lk = k.lower()
-        if "bytes" in lk and ("access" in lk or lk == "bytes"):
-            try:
-                return int(float(v))
-            except (TypeError, ValueError):
-                continue
-    return None
+    """bytes accessed by one HLO event, or None when the trace has no
+    byte accounting for it — delegates to `profiler.event_stat_bytes`,
+    the single extraction path shared with `telemetry.kernels` (stat-name
+    spellings across jax/XLA versions are fixed there, once)."""
+    from .. import profiler
+
+    return profiler.event_stat_bytes(e)
 
 
 _WARNED_DEVICES: set = set()

@@ -252,6 +252,18 @@ _ENV_KNOBS = {
         "elastic-departure subphase (rank-1 topology_change seam, "
         "survivor re-rendezvous); 0 = skip; unset = runs only in the "
         "spawned dryrun child (honored, this build's addition)"),
+    "MXNET_DRYRUN_GOODPUT": (
+        "__graft_entry__ dryrun_multichip", "1 = force the 2-process "
+        "goodput-ledger subphase (chaos shrink + checkpoint + resume; "
+        "asserts the ledger accounts >=98% of wall time with nonzero "
+        "reshard/recovery); 0 = skip; unset = runs only in the spawned "
+        "dryrun child (honored, this build's addition)"),
+    "MXNET_GOODPUT": (
+        "telemetry.goodput", "1 = arm the training goodput ledger alone "
+        "(lease seams in estimator/dataloader/checkpoint/elastic, "
+        "mx_goodput_seconds_total{state=} + mx_goodput_frac); also "
+        "armed by MXNET_TELEMETRY (honored, this build's addition — "
+        "see TELEMETRY.md)"),
     "MXNET_FLEET": (
         "telemetry.fleet", "1 = arm the cross-rank fleet plane alone "
         "(collective profiler, barrier skew, flightrec rank stamp + "
@@ -436,13 +448,15 @@ def _apply_env_config():
             pass
     telem = os.environ.get("MXNET_TELEMETRY", "0")
     if telem and telem != "0":
-        from .telemetry import compiles, fleet, hbm, monitor, stages, tracing
+        from .telemetry import (compiles, fleet, goodput, hbm, monitor,
+                                stages, tracing)
 
         stages.enable()
         tracing.enable()
         compiles.enable()       # per-program compile ledger + forensics
         hbm.enable()            # live-buffer census gauges + OOM seams
         fleet.enable()          # cross-rank collective profiler + fanout
+        goodput.enable()        # training goodput ledger (lease seams)
         if telem == "raise":
             monitor.install_nan_hook(mode="raise")
         elif telem == "warn":
@@ -454,6 +468,12 @@ def _apply_env_config():
         from .telemetry import fleet as _fleet
 
         _fleet.enable()
+    if os.environ.get("MXNET_GOODPUT", "0") not in ("0", ""):
+        # standalone arming (goodput ledger without the rest of
+        # telemetry — the lease seams are cheap host-side accounting)
+        from .telemetry import goodput as _goodput
+
+        _goodput.enable()
     watch = os.environ.get("MXNET_MEMWATCH_INTERVAL")
     if watch:
         try:

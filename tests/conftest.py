@@ -133,6 +133,17 @@ _QUICK = {
     "test_fleet.py::test_stitch_traces_rebases_by_clock_offset",
     "test_fleet.py::test_merge_flight_dumps_groups_by_rank",
     "test_tools.py::test_fl014_tree_is_clean",
+    # kernel & goodput observatory (ISSUE 14 gates): roofline census
+    # math + honest coverage on the committed fixture, the seeded
+    # quantize-fusion diff, goodput lease/sum-to-wall semantics, the
+    # kernelscope --demo render, and the FL016 series-index tree sweep
+    "test_kernels.py::test_census_fixture_roofline_placement",
+    "test_kernels.py::test_census_unknown_bytes_never_reads_fast",
+    "test_kernels.py::test_diff_census_names_seeded_fusion",
+    "test_kernels.py::test_goodput_states_sum_to_wall",
+    "test_kernels.py::test_goodput_waterfall_renders_fixture",
+    "test_kernels.py::test_kernelscope_demo_renders",
+    "test_tools.py::test_fl016_tree_is_clean",
 }
 
 

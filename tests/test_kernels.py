@@ -1,0 +1,438 @@
+"""Kernel & goodput observatory (ISSUE 14): per-HLO census + roofline
+placement over the committed demo fixture, compile-ledger join, fusion
+forensics on the seeded quantize-boundary fusion, and the training
+goodput ledger — lease nesting, states-sum-to-wall, the chaos-elastic
+attribution gate, the fleet rollup, the off-path cost bound, and the
+`tools/kernelscope.py --demo` meta-gate."""
+import json
+import logging
+import os
+import subprocess
+import sys
+import time
+
+import numpy as onp
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import gluon, np, preemption
+from incubator_mxnet_tpu.fault import injection
+from incubator_mxnet_tpu.telemetry import goodput, kernels, registry, tracing
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURE = os.path.join(REPO, "benchmark", "kernelscope_demo_trace.json")
+
+
+def _fixture():
+    with open(FIXTURE, encoding="utf-8") as f:
+        return json.load(f)
+
+
+def _counter(name):
+    return registry.report().get(name, {}).get("value", 0) or 0
+
+
+@pytest.fixture(autouse=True)
+def _clean_observatory():
+    goodput.disable()
+    goodput.reset()
+    kernels.reset()
+    yield
+    goodput.disable()
+    goodput.reset()
+    kernels.reset()
+    injection.clear_injection()
+
+
+def _rows_by_name(result):
+    return {r["name"]: r for r in result["rows"]}
+
+
+# ---------------------------------------------------------------------------
+# census: roofline placement + honest coverage (the committed fixture)
+# ---------------------------------------------------------------------------
+
+def test_census_fixture_roofline_placement():
+    doc = _fixture()
+    res = kernels.census(doc["before"]["traceEvents"], device="v5e")
+    rows = _rows_by_name(res)
+
+    # fusion.1: 4 x 1000 µs, 250 MB + 1e11 flops each -> 250 GB/s,
+    # 100 TFLOP/s; flops_frac 100/197 beats hbm_frac 250/819 -> compute
+    f1 = rows["fusion.1"]
+    assert f1["count"] == 4 and f1["time_us"] == pytest.approx(4000.0)
+    assert f1["achieved_gbs"] == pytest.approx(250.0)
+    assert f1["achieved_tflops"] == pytest.approx(100.0)
+    assert f1["bound_by"] == "compute"
+
+    # fusion.2: 8 x 300 µs, 180 MB each -> 600 GB/s, 73% of the 819
+    # GB/s v5e roof with negligible flops -> memory
+    f2 = rows["fusion.2"]
+    assert f2["achieved_gbs"] == pytest.approx(600.0)
+    assert f2["hbm_frac"] == pytest.approx(600.0 / 819.0)
+    assert f2["bound_by"] == "memory"
+
+    # quantize/dequantize boundaries are present pre-fusion
+    assert rows["quantize.1"]["count"] == 8
+    assert rows["dequantize.1"]["bound_by"] == "memory"
+
+
+def test_census_meta_attribution_is_honest():
+    doc = _fixture()
+    res = kernels.census(doc["before"]["traceEvents"], device="v5e")
+    meta = res["meta"]
+    # runtime lanes (tsl::AsyncExec, program_interpreter) count toward
+    # total device time but are excluded from the named rows: 8720 µs
+    # named of 9520 µs total
+    assert meta["total_device_us"] == pytest.approx(9520.0)
+    assert meta["named_us"] == pytest.approx(8720.0)
+    assert meta["attributed_frac"] == pytest.approx(8720.0 / 9520.0)
+    assert "tsl::AsyncExec" not in _rows_by_name(res)
+    # 30 of 32 named events carry a bytes stat (convert.1 doesn't)
+    assert meta["bytes_coverage"] == pytest.approx(30.0 / 32.0)
+    # census parks its meta for the flight-context block
+    assert kernels.last_census()["attributed_frac"] == pytest.approx(
+        meta["attributed_frac"])
+
+
+def test_census_unknown_bytes_never_reads_fast():
+    doc = _fixture()
+    res = kernels.census(doc["before"]["traceEvents"], device="v5e")
+    conv = _rows_by_name(res)["convert.1"]
+    # no bytes stat: no bandwidth claim, no roofline verdict
+    assert conv["bytes_known"] == 0
+    assert conv["achieved_gbs"] is None
+    assert conv["bound_by"] == "unknown"
+    # ...and it is excluded from the fusion-target ranking (never
+    # ranked as fast OR slow)
+    bb = kernels.top_bandwidth_bound(res, n=10)
+    names = [r["name"] for r in bb]
+    assert "convert.1" not in names and "fusion.1" not in names
+    # ranking is by device time: fusion.2 dominates
+    assert names[0] == "fusion.2"
+    assert all(r["bound_by"] == "memory" for r in bb)
+
+
+def test_census_ledger_join_balance_point():
+    doc = _fixture()
+    res = kernels.census(doc["before"]["traceEvents"],
+                         ledger=doc["ledger"], device="v5e")
+    progs = res["programs"]
+    balance = 197e12 / 819e9          # v5e machine balance, flop/B
+    train = progs["train.DataParallel.step"]
+    assert train["balance_flops_per_byte"] == pytest.approx(balance)
+    # AI 400 flop/B > 240.5 -> compute-bound per the cost model
+    assert train["arith_intensity"] == pytest.approx(400.0)
+    assert train["bound_by"] == "compute"
+    assert train["compiles"] == 2
+    # eager.dot: AI ~82 flop/B < balance -> memory-bound
+    assert progs["eager.dot"]["bound_by"] == "memory"
+
+
+def test_program_mfu_math_and_honesty():
+    # 2.4e12 flops x 10 executions over 1 s on a 197 TFLOP/s chip
+    mfu = kernels.program_mfu(2.4e12, 10, 1.0, device="v5e")
+    assert mfu == pytest.approx(2.4e13 / 197e12)
+    # the honesty rule: any missing input -> None, never a guess
+    assert kernels.program_mfu(None, 10, 1.0, device="v5e") is None
+    assert kernels.program_mfu(2.4e12, 0, 1.0, device="v5e") is None
+    assert kernels.program_mfu(2.4e12, 10, 0.0, device="v5e") is None
+    assert kernels.program_mfu(2.4e12, 10, 1.0) is None  # no peak known
+
+
+def test_census_over_live_profiler_trace():
+    from incubator_mxnet_tpu import profiler
+
+    a = np.ones((64, 64))
+    (np.dot(a, a) + 1.0).asnumpy()          # compile outside the window
+    profiler.start()
+    (np.dot(a, a) + 1.0).asnumpy()
+    profiler.stop()
+    res = kernels.census(profiler.device_events(), device="v5e")
+    meta = res["meta"]
+    assert meta["total_device_us"] > 0
+    assert 0.0 <= meta["attributed_frac"] <= 1.0
+    # CPU traces carry no per-kernel byte stats: everything must read
+    # unknown, nothing may claim a roofline placement
+    assert all(r["bound_by"] == "unknown" for r in res["rows"]
+               if not r["bytes_known"])
+
+
+# ---------------------------------------------------------------------------
+# fusion forensics
+# ---------------------------------------------------------------------------
+
+def test_diff_census_names_seeded_fusion(tmp_path):
+    doc = _fixture()
+    before = kernels.census(doc["before"]["traceEvents"], device="v5e")
+    after = kernels.census(doc["after"]["traceEvents"], device="v5e")
+    v0 = _counter('mx_kernel_fusion_delta{kind="vanished"}')
+
+    diff = kernels.diff_census(before, after)
+    # the quantize boundaries vanished into the consumer fusion
+    assert diff["vanished"] == ["dequantize.1", "quantize.1"]
+    assert diff["appeared"] == [] and diff["split"] == []
+    assert diff["verdict"] == "fused"
+    # 8720 µs named before, 6480 after: the fusion bought 2240 µs
+    assert diff["time_delta_us"] == pytest.approx(-2240.0)
+    # the delta is a series...
+    assert _counter('mx_kernel_fusion_delta{kind="vanished"}') == v0 + 2
+    # ...and rides every flight record via the context probe
+    path = tracing.flight_dump("test_fusion",
+                               path=str(tmp_path / "flight.json"))
+    with open(path, encoding="utf-8") as f:
+        payload = json.load(f)
+    blk = payload["context"]["kernels"]
+    assert blk["fusion_delta"]["verdict"] == "fused"
+    assert blk["census"]["n_kernels"] == after["meta"]["n_kernels"]
+
+
+def test_diff_census_split_and_unchanged():
+    rows = [{"name": "fusion.1", "time_us": 10.0}]
+    two = [{"name": "fusion.1", "time_us": 6.0},
+           {"name": "fusion.2", "time_us": 6.0}]
+    d = kernels.diff_census(rows, two)
+    assert d["verdict"] == "split" and d["split"] == ["fusion"]
+    assert kernels.diff_census(rows, rows)["verdict"] == "unchanged"
+
+
+def test_format_census_and_diff_render():
+    doc = _fixture()
+    res = kernels.census(doc["before"]["traceEvents"],
+                         ledger=doc["ledger"], device="v5e")
+    s = kernels.format_census(res, top=5)
+    assert "fusion.1" in s and "bound by" in s
+    assert "never *fast*" in s                  # the honesty footnote
+    assert "program `train.DataParallel.step`" in s
+    after = kernels.census(doc["after"]["traceEvents"], device="v5e")
+    d = kernels.format_diff(kernels.diff_census(res, after))
+    assert "fusion delta: fused" in d
+    assert "vanished: dequantize.1, quantize.1" in d
+
+
+# ---------------------------------------------------------------------------
+# goodput ledger: lease semantics
+# ---------------------------------------------------------------------------
+
+def test_goodput_states_sum_to_wall():
+    goodput.enable()
+    with goodput.lease("compute"):
+        time.sleep(0.05)
+    time.sleep(0.02)                            # unleased -> idle
+    with goodput.lease("data_wait"):
+        time.sleep(0.01)
+    rep = goodput.report()
+    assert rep["enabled"] and rep["active_lease"] is None
+    # idle is a real state, so the states sum to wall EXACTLY
+    assert sum(rep["states"].values()) == pytest.approx(
+        rep["wall_s"], rel=1e-9)
+    assert rep["states"]["compute"] >= 0.05
+    assert rep["states"]["data_wait"] >= 0.01
+    assert rep["states"]["idle"] >= 0.015
+    assert rep["accounted_s"] == pytest.approx(
+        rep["wall_s"] - rep["states"]["idle"], rel=1e-9)
+    assert 0.0 < rep["goodput_frac"] < 1.0
+
+
+def test_goodput_nesting_innermost_wins():
+    goodput.enable()
+    with goodput.lease("reshard"):
+        time.sleep(0.02)
+        with goodput.lease("checkpoint"):       # e.g. drain checkpoint
+            time.sleep(0.03)
+        time.sleep(0.01)
+    rep = goodput.report()
+    # the inner lease takes its interval; the rest stays reshard
+    assert rep["states"]["checkpoint"] >= 0.03
+    assert rep["states"]["reshard"] >= 0.03
+    assert rep["states"]["reshard"] < rep["wall_s"] - 0.025
+    assert sum(rep["states"].values()) == pytest.approx(
+        rep["wall_s"], rel=1e-9)
+
+
+def test_goodput_series_and_pull_gauge():
+    goodput.enable()
+    c0 = _counter('mx_goodput_seconds_total{state="compute"}')
+    with goodput.lease("compute"):
+        time.sleep(0.03)
+    rep = registry.report()
+    key = 'mx_goodput_seconds_total{state="compute"}'
+    assert key in rep and rep[key]["value"] >= c0 + 0.03
+    gf = goodput.goodput_frac()                 # the pull-gauge probe
+    assert gf is not None and 0.0 < gf <= 1.0
+
+
+def test_goodput_off_is_null_and_unknown_state_raises():
+    assert not goodput.is_enabled()
+    # disabled: every lease is the SAME shared null context manager
+    assert goodput.lease("compute") is goodput.lease("reshard")
+    with goodput.lease("compute"):
+        time.sleep(0.005)
+    rep = goodput.report()
+    assert rep["wall_s"] == 0.0 and not any(rep["states"].values())
+    goodput.enable()
+    with pytest.raises(ValueError, match="unknown goodput state"):
+        goodput.lease("productive")
+    # reset drops attribution and the ledger epoch
+    with goodput.lease("compute"):
+        pass
+    goodput.reset()
+    assert goodput.report()["wall_s"] == 0.0
+    assert goodput.goodput_frac() is None       # honest: no epoch yet
+
+
+def test_goodput_off_path_is_cheap():
+    assert not goodput.is_enabled()
+    a = np.array(onp.random.RandomState(0).uniform(-1, 1, (16, 16))
+                 .astype("float32"))
+    np.dot(a, a).wait_to_read()                 # warm the jit cache
+    iters = 300
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        np.dot(a, a)
+    mx.waitall()
+    per_op = (time.perf_counter() - t0) / iters
+    # the literal instrumented-seam pattern, disabled
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        with goodput.lease("compute"):
+            pass
+    probe = (time.perf_counter() - t0) / iters
+    assert probe < 0.03 * per_op, (probe, per_op)
+
+
+def test_goodput_waterfall_renders_fixture():
+    rep = _fixture()["goodput"]
+    s = goodput.format_waterfall(rep)
+    assert "goodput waterfall" in s
+    assert "goodput 80.8%" in s and "accounted 99.1%" in s
+    for state in goodput.STATES:
+        assert state in s
+
+
+# ---------------------------------------------------------------------------
+# goodput ledger: the real seams
+# ---------------------------------------------------------------------------
+
+def test_estimator_fit_feeds_the_ledger():
+    from incubator_mxnet_tpu.gluon.contrib.estimator import Estimator
+
+    goodput.enable()
+    X = np.random.uniform(size=(64, 4))
+    Y = X @ np.random.uniform(size=(4, 1))
+    loader = gluon.data.DataLoader(gluon.data.ArrayDataset(X, Y),
+                                   batch_size=16)
+    net = gluon.nn.Dense(1)
+    net.initialize()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.05})
+    est = Estimator(net, loss=gluon.loss.L2Loss(), trainer=trainer)
+    est.logger.setLevel(logging.ERROR)
+    est.fit(loader, epochs=2)
+    rep = goodput.report()
+    # the fit_batch seam leased compute; the dataloader leased data_wait
+    assert rep["states"]["compute"] > 0.0
+    assert rep["states"]["data_wait"] > 0.0
+    assert rep["goodput_frac"] > 0.0
+    assert sum(rep["states"].values()) == pytest.approx(
+        rep["wall_s"], rel=1e-9)
+
+
+def _make_dp(mesh, seed=0):
+    from incubator_mxnet_tpu import optimizer as opt
+    from incubator_mxnet_tpu.parallel import DataParallel
+
+    mx.random.seed(seed)
+    net = gluon.nn.Dense(1, in_units=4)
+    net.initialize()
+    dp = DataParallel(net, lambda o, y: ((o - y) ** 2),
+                      opt.SGD(learning_rate=0.1), mesh=mesh)
+    return net, dp
+
+
+def test_goodput_chaos_elastic_attribution(tmp_path):
+    """ISSUE 14 acceptance gate: across a chaos run with a seeded
+    topology shrink plus a checkpoint/resume cycle, the ledger's states
+    sum to wall within 2%, reshard and recovery are nonzero, and the
+    fleet rollup carries the view."""
+    from incubator_mxnet_tpu.fault.elastic import ElasticController
+    from incubator_mxnet_tpu.parallel import dist
+    from incubator_mxnet_tpu.parallel.mesh import make_mesh
+    from incubator_mxnet_tpu.telemetry import fleet
+
+    rng = onp.random.RandomState(0)
+    X = rng.uniform(-1, 1, (64, 4)).astype("float32")
+    Y = X @ rng.uniform(-1, 1, (4, 1)).astype("float32")
+
+    dist._reset_membership()
+    injection.clear_injection()
+    net, dp = _make_dp(make_mesh({"dp": 8}))
+    ctl = ElasticController(trainer=dp)
+    goodput.enable()
+    goodput.reset()
+    injection.configure_injection("topology_change:1.0:11:1:shrink=4")
+    for step in range(4):
+        with goodput.lease("compute"):
+            float(dp.step(X, Y))
+        verdict = ctl.poll()                    # drained step boundary
+        if step == 0:
+            assert verdict == "shrunk"          # transition leased reshard
+    injection.clear_injection()
+
+    # the checkpoint write + resume seams lease checkpoint/recovery
+    trainer = gluon.Trainer(net.collect_params(), "sgd")
+    ck = preemption.TrainingCheckpointer(
+        str(tmp_path / "ck"), net, trainer, every_n=1000, keep=2,
+        register_signal=False)
+    assert ck.save_now() is not None
+    ck.resume()                                 # step 0: resumed fresh
+
+    rep = goodput.report()
+    states = rep["states"]
+    assert states["compute"] > 0.0
+    assert states["reshard"] > 0.0, states
+    assert states["checkpoint"] > 0.0, states
+    assert states["recovery"] > 0.0, states
+    # every wall second attributed: within 2% of wall (exact by
+    # construction; the tolerance is the acceptance claim)
+    assert abs(sum(states.values()) - rep["wall_s"]) <= max(
+        0.02 * rep["wall_s"], 1e-6), rep
+    assert int(dp.mesh.devices.size) == 4       # the shrink really ran
+
+    # fleet rollup: single-process fleet_report carries the ledger
+    g = fleet.fleet_report()["goodput"]
+    assert g is not None
+    assert g["fleet_states"]["reshard"] > 0.0
+    assert 0.0 <= g["fleet_goodput_frac"] <= 1.0
+    assert 0 in g["per_rank"]
+    assert g["worst_data_wait_rank"] == 0
+
+
+def test_goodput_rides_flight_records(tmp_path):
+    goodput.enable()
+    with goodput.lease("compute"):
+        time.sleep(0.01)
+    path = tracing.flight_dump("test_goodput",
+                               path=str(tmp_path / "flight.json"))
+    with open(path, encoding="utf-8") as f:
+        payload = json.load(f)
+    blk = payload["context"]["goodput"]
+    assert blk["states"]["compute"] >= 0.01
+    assert blk["goodput_frac"] > 0.0
+
+
+# ---------------------------------------------------------------------------
+# kernelscope CLI (the committed-artifact meta-gate)
+# ---------------------------------------------------------------------------
+
+def test_kernelscope_demo_renders():
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "kernelscope.py"),
+         "--demo"], capture_output=True, text=True, timeout=180, env=env)
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "kernel census: before" in out.stdout
+    assert "fusion delta: fused" in out.stdout
+    assert "vanished: dequantize.1, quantize.1" in out.stdout
+    assert "goodput waterfall" in out.stdout
+    assert "unknown" in out.stdout              # convert.1 stays honest

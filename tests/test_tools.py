@@ -729,6 +729,75 @@ def test_fl015_tree_is_clean():
 
 
 # ---------------------------------------------------------------------------
+# framework_lint FL016 — telemetry series index (ISSUE 14)
+# ---------------------------------------------------------------------------
+
+_TELE_PATH = "incubator_mxnet_tpu/telemetry/fleet.py"
+
+
+def _lint_doc(src, path, telemetry_text):
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import framework_lint
+    finally:
+        sys.path.pop(0)
+    return framework_lint.lint_source(src, path,
+                                      telemetry_text=telemetry_text)
+
+
+def test_fl016_flags_undocumented_series():
+    src = ("from . import registry\n"
+           "c = registry.counter('mx_widget_total', 'widgets')\n"
+           "g = registry.gauge('mx_widget_depth', 'depth')\n")
+    doc = "## Series index\n\n`mx_widget_depth` — queue depth\n"
+    hits = [f for f in _lint_doc(src, _TELE_PATH, doc)
+            if f.rule == "FL016"]
+    assert len(hits) == 1
+    assert "mx_widget_total" in hits[0].message
+    assert hits[0].line == 2
+
+
+def test_fl016_accepts_documented_noqa_and_scoping():
+    # documented: clean
+    src = "registry.counter('mx_widget_total', 'w')\n"
+    doc = "mx_widget_total is counted here"
+    assert not [f for f in _lint_doc(src, _TELE_PATH, doc)
+                if f.rule == "FL016"]
+    # noqa escape on the registration line
+    noqa = "registry.counter('mx_widget_total', 'w')  # noqa: FL016\n"
+    assert not [f for f in _lint_doc(noqa, _TELE_PATH, "nothing")
+                if f.rule == "FL016"]
+    # non-mx_ series and dynamic names are out of scope
+    other = ("registry.counter('t_reqs_total', 'n')\n"
+             "registry.counter(name, 'n')\n")
+    assert not [f for f in _lint_doc(other, _TELE_PATH, "nothing")
+                if f.rule == "FL016"]
+    # the registry factory itself is exempt (helpers build names there)
+    reg = "registry.counter('mx_widget_total', 'w')\n"
+    assert not [f for f in _lint_doc(
+        reg, "incubator_mxnet_tpu/telemetry/registry.py", "nothing")
+        if f.rule == "FL016"]
+    # modules outside the package are out of scope
+    assert not [f for f in _lint_doc(reg, "tools/bench.py", "nothing")
+                if f.rule == "FL016"]
+    # no TELEMETRY.md found -> the rule stays silent, never guesses
+    assert not [f for f in _lint_doc(reg, _TELE_PATH, None)
+                if f.rule == "FL016"]
+
+
+def test_fl016_tree_is_clean():
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import framework_lint
+    finally:
+        sys.path.pop(0)
+    findings = [f for f in framework_lint.lint_paths(
+        [os.path.join(REPO, "incubator_mxnet_tpu")])
+        if f.rule == "FL016"]
+    assert not findings, findings
+
+
+# ---------------------------------------------------------------------------
 # bench_regress — trajectory regression gate (ISSUE 10)
 # ---------------------------------------------------------------------------
 

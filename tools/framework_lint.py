@@ -150,11 +150,25 @@ FL015  membership-epoch guard (scoped to ``fault/`` and ``parallel/``
        membership check alone is provably sufficient (single-epoch
        tooling, test scaffolding), annotate the line with
        ``# noqa: FL015`` and the justifying comment.
+FL016  telemetry series index (scoped to ``incubator_mxnet_tpu/``
+       modules, excluding ``telemetry/registry.py`` — the factory's
+       home): every statically-registered metric series — a literal
+       ``mx_*`` first argument to ``.counter(`` / ``.gauge(`` /
+       ``.histogram(`` / ``.register_pull_gauge(`` — must appear in
+       TELEMETRY.md (the FL004 ledger rule, applied to the metrics
+       plane). An undocumented series is a number nobody owns:
+       dashboards can't be built against it, renames break consumers
+       silently, and telemetry drift starts exactly here. Add the
+       series to the TELEMETRY.md index (what it measures, labels, who
+       reads it), or — for a genuinely private/test-scaffolding series
+       — annotate the line with ``# noqa: FL016`` and the justifying
+       comment.
 
 Usage
 -----
     python tools/framework_lint.py incubator_mxnet_tpu/ [more paths...]
                                    [--coverage OPS_COVERAGE.md]
+                                   [--telemetry-doc TELEMETRY.md]
                                    [--list-rules]
 
 Exit status 0 when clean, 1 when any rule fires.
@@ -224,6 +238,11 @@ RULES = {
              "instead of raising StaleGenerationError; thread the "
              "generation observed at the drained step boundary, or "
              "`# noqa: FL015` with a reason",
+    "FL016": "registered metric series name (literal mx_* first arg of "
+             ".counter/.gauge/.histogram/.register_pull_gauge) missing "
+             "from TELEMETRY.md — document the series (what it "
+             "measures, labels, who reads it), or `# noqa: FL016` with "
+             "a reason",
 }
 
 _INDEXING_NAME_PARTS = ("getitem", "setitem", "index", "slice")
@@ -1091,6 +1110,56 @@ def _check_ops_ledger(tree, path, findings, coverage_text):
 
 
 # ---------------------------------------------------------------------------
+# FL016 — telemetry series index (TELEMETRY.md)
+# ---------------------------------------------------------------------------
+
+_SERIES_FACTORIES = ("counter", "gauge", "histogram", "register_pull_gauge")
+
+
+def collect_registered_series(tree):
+    """Statically-visible metric registrations: literal ``mx_*`` first
+    args of ``<x>.counter/gauge/histogram/register_pull_gauge(...)``
+    calls (the registry's four factory idioms). The ``mx_`` prefix
+    filter keeps unrelated ``.counter(...)`` methods (itertools-style
+    helpers, third-party objects) out of scope."""
+    names = set()
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in _SERIES_FACTORIES
+                and node.args
+                and isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, str)
+                and node.args[0].value.startswith("mx_")):
+            names.add((node.args[0].value, node.args[0].lineno))
+    return names
+
+
+def _check_series_doc(tree, path, findings, src_lines, telemetry_text):
+    if telemetry_text is None:
+        return
+    norm = path.replace(os.sep, "/")
+    if "incubator_mxnet_tpu/" not in norm:
+        return
+    if norm.endswith("telemetry/registry.py"):
+        return      # the factory itself — docstring examples, not series
+
+    def noqa(lineno):
+        line = src_lines[lineno - 1] if lineno - 1 < len(src_lines) else ""
+        return "noqa: FL016" in line
+
+    for name, lineno in sorted(collect_registered_series(tree)):
+        if name in telemetry_text or noqa(lineno):
+            continue
+        findings.append(LintFinding(
+            path, lineno, "FL016",
+            f"metric series `{name}` is not documented in TELEMETRY.md "
+            "— an undocumented series is a number nobody owns; add it "
+            "to the series index (what it measures, labels, who reads "
+            "it), or `# noqa: FL016` with a reason"))
+
+
+# ---------------------------------------------------------------------------
 # FL014 — collective hygiene (parallel/ and serve/ modules)
 # ---------------------------------------------------------------------------
 
@@ -1246,7 +1315,7 @@ def _check_generation_guard(tree, path, findings, src_lines):
 # driver
 # ---------------------------------------------------------------------------
 
-def lint_source(src, path, coverage_text=None):
+def lint_source(src, path, coverage_text=None, telemetry_text=None):
     """Lint one source string; `path` is used for reporting and for the
     ops/-scoped rules. Returns a list of LintFinding."""
     findings = []
@@ -1271,12 +1340,15 @@ def lint_source(src, path, coverage_text=None):
     _check_collective_hygiene(tree, path, findings, src.splitlines())
     _check_generation_guard(tree, path, findings, src.splitlines())
     _check_ops_ledger(tree, path, findings, coverage_text)
+    _check_series_doc(tree, path, findings, src.splitlines(),
+                      telemetry_text)
     return findings
 
 
-def lint_file(path, coverage_text=None):
+def lint_file(path, coverage_text=None, telemetry_text=None):
     with open(path, encoding="utf-8") as f:
-        return lint_source(f.read(), path, coverage_text=coverage_text)
+        return lint_source(f.read(), path, coverage_text=coverage_text,
+                           telemetry_text=telemetry_text)
 
 
 def _iter_py_files(paths):
@@ -1293,7 +1365,9 @@ def _iter_py_files(paths):
                     yield os.path.join(root, f)
 
 
-def _find_coverage(paths, explicit):
+def _find_doc(paths, explicit, filename):
+    """Walk up from cwd / the linted paths / the repo root until
+    `filename` is found (the FL004/FL016 ledger-discovery rule)."""
     if explicit:
         return explicit
     candidates = [os.getcwd()]
@@ -1303,7 +1377,7 @@ def _find_coverage(paths, explicit):
     for c in candidates:
         d = c if os.path.isdir(c) else os.path.dirname(c)
         while True:
-            probe = os.path.join(d, "OPS_COVERAGE.md")
+            probe = os.path.join(d, filename)
             if os.path.isfile(probe):
                 return probe
             parent = os.path.dirname(d)
@@ -1313,15 +1387,25 @@ def _find_coverage(paths, explicit):
     return None
 
 
-def lint_paths(paths, coverage_path=None):
-    coverage_text = None
-    cov = _find_coverage(paths, coverage_path)
-    if cov is not None:
-        with open(cov, encoding="utf-8") as f:
-            coverage_text = f.read()
+def _find_coverage(paths, explicit):
+    return _find_doc(paths, explicit, "OPS_COVERAGE.md")
+
+
+def _read_doc(paths, explicit, filename):
+    doc = _find_doc(paths, explicit, filename)
+    if doc is None:
+        return None
+    with open(doc, encoding="utf-8") as f:
+        return f.read()
+
+
+def lint_paths(paths, coverage_path=None, telemetry_path=None):
+    coverage_text = _read_doc(paths, coverage_path, "OPS_COVERAGE.md")
+    telemetry_text = _read_doc(paths, telemetry_path, "TELEMETRY.md")
     findings = []
     for path in _iter_py_files(paths):
-        findings.extend(lint_file(path, coverage_text=coverage_text))
+        findings.extend(lint_file(path, coverage_text=coverage_text,
+                                  telemetry_text=telemetry_text))
     return findings
 
 
@@ -1332,6 +1416,8 @@ def main(argv=None):
                     help="files or directories to lint")
     ap.add_argument("--coverage", default=None,
                     help="path to OPS_COVERAGE.md (default: auto-discover)")
+    ap.add_argument("--telemetry-doc", default=None,
+                    help="path to TELEMETRY.md (default: auto-discover)")
     ap.add_argument("--list-rules", action="store_true")
     args = ap.parse_args(argv)
     if args.list_rules:
@@ -1339,7 +1425,8 @@ def main(argv=None):
             print(f"{rid}  {doc}")
         return 0
     findings = lint_paths(args.paths or ["incubator_mxnet_tpu"],
-                          coverage_path=args.coverage)
+                          coverage_path=args.coverage,
+                          telemetry_path=args.telemetry_doc)
     for f in findings:
         print(f)
     if findings:

@@ -1,0 +1,225 @@
+"""Kernel & goodput observatory viewer: per-HLO census tables, fusion
+diffs, and the training-goodput waterfall — from a live traced run or a
+committed Chrome-trace JSON.
+
+Modes
+-----
+``--demo`` (default when no input is given)
+    Render the committed fixture (``benchmark/kernelscope_demo_trace
+    .json``): the before/after kernel censuses of a seeded int8
+    quantize-boundary fusion, the fusion diff naming what vanished, the
+    compile-ledger join, and the goodput waterfall::
+
+        python tools/kernelscope.py
+
+``--trace FILE [--ledger FILE] [--device v5e] [--top N]``
+    Census over a committed trace (``profiler.dump()`` output, a raw
+    ``{"traceEvents": [...]}`` Chrome trace, or the demo fixture — the
+    ``before``/``after``/``ledger`` blocks are auto-detected; pick a
+    block explicitly with ``--key before|after``)::
+
+        python tools/kernelscope.py --trace benchmark/trace.json --device v5e
+
+``--diff BEFORE AFTER``
+    Fusion forensics between two traces: appeared / vanished / split /
+    merged kernel names plus the device-time delta::
+
+        python tools/kernelscope.py --diff base.json fused.json
+
+``--goodput [FILE]``
+    Waterfall of a goodput ledger report (``telemetry.goodput.report()``
+    JSON, a flight record carrying a ``goodput`` context block, or the
+    demo fixture). Without FILE, reads the live in-process ledger —
+    meaningful only after a run with ``MXNET_GOODPUT=1``.
+
+``--live``
+    Trace a small eager workload in-process and census it (attribution
+    is low on CPU — the backend emits few named kernel events; on
+    TPU/GPU this is the real per-HLO table).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+FIXTURE = os.path.join(REPO, "benchmark", "kernelscope_demo_trace.json")
+
+
+def _load(path):
+    with open(path, encoding="utf-8") as f:
+        return json.load(f)
+
+
+def _events(doc, key=None):
+    """Chrome-trace events from any of the accepted shapes: a bare event
+    list, ``{"traceEvents": [...]}``, or a fixture with ``before``/
+    ``after`` blocks (``key`` picks one; default ``after``)."""
+    if isinstance(doc, list):
+        return doc
+    if key and key in doc:
+        return _events(doc[key])
+    if "traceEvents" in doc:
+        return doc["traceEvents"]
+    for k in ("after", "before"):
+        if k in doc:
+            return _events(doc[k])
+    raise SystemExit("kernelscope: no traceEvents found in input")
+
+
+def _goodput_report(doc):
+    """A goodput report dict from a report JSON, a fixture, or a flight
+    record (``context.goodput`` block)."""
+    if "states" in doc and "wall_s" in doc:
+        return doc
+    if isinstance(doc.get("goodput"), dict):
+        return doc["goodput"]
+    ctx = doc.get("context") or {}
+    if isinstance(ctx.get("goodput"), dict):
+        return ctx["goodput"]
+    raise SystemExit("kernelscope: no goodput report found in input")
+
+
+def _render_census(events, ledger, device, top):
+    from incubator_mxnet_tpu.telemetry import kernels
+
+    result = kernels.census(events, ledger=ledger, device=device)
+    print(kernels.format_census(result, top=top))
+    bb = kernels.top_bandwidth_bound(result, n=min(top, 5))
+    if bb:
+        print("\ntop bandwidth-bound (fusion targets):")
+        for r in bb:
+            print(f"  {r['name']:<32} {r['time_us']:9.1f} µs  "
+                  f"{r['achieved_gbs']:.0f} GB/s "
+                  f"({r['hbm_frac'] * 100:.0f}% of roof)")
+    return result
+
+
+def _render_diff(b_events, a_events, device):
+    from incubator_mxnet_tpu.telemetry import kernels
+
+    before = kernels.census(b_events, device=device)
+    after = kernels.census(a_events, device=device)
+    print(kernels.format_diff(kernels.diff_census(before, after)))
+
+
+def _render_goodput(rep):
+    from incubator_mxnet_tpu.telemetry import goodput
+
+    print(goodput.format_waterfall(rep))
+
+
+def _demo(args):
+    doc = _load(args.trace or FIXTURE)
+    device = args.device or doc.get("device")
+    ledger = doc.get("ledger")
+    print("== kernel census: before (standalone quantize boundaries) ==")
+    _render_census(_events(doc, "before"), ledger, device, args.top)
+    print("\n== kernel census: after (boundaries fused) ==")
+    _render_census(_events(doc, "after"), ledger, device, args.top)
+    print("\n== fusion forensics ==")
+    _render_diff(_events(doc, "before"), _events(doc, "after"), device)
+    if "goodput" in doc:
+        print("\n== goodput waterfall ==")
+        _render_goodput(_goodput_report(doc))
+    return 0
+
+
+def _live(args):
+    os.environ.setdefault("MXNET_TELEMETRY", "1")
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import profiler
+
+    a = mx.np.ones((256, 256))
+    b = mx.np.ones((256, 256))
+    (mx.np.dot(a, b) + 1.0).asnumpy()      # warm/compile out of the window
+    profiler.start()
+    for _ in range(8):
+        c = mx.np.dot(a, b) + 1.0
+    c.asnumpy()
+    profiler.stop()
+    from incubator_mxnet_tpu.telemetry import compiles
+
+    _render_census(profiler.device_events(),
+                   _cost_ledger(compiles.ledger()), args.device, args.top)
+    return 0
+
+
+def _cost_ledger(ledger):
+    """Flatten a `compiles.ledger()` dict to the {family: {flops,
+    bytes_accessed, compiles}} shape `kernels.census(ledger=)` joins."""
+    out = {}
+    for fam, entries in (ledger or {}).items():
+        if not entries:
+            continue
+        last = entries[-1]
+        cost = last.get("cost_analysis") or {}
+        out[fam] = {"flops": cost.get("flops"),
+                    "bytes_accessed": cost.get("bytes_accessed"),
+                    "compiles": len(entries)}
+    return out
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="per-HLO kernel census, fusion diff, goodput "
+                    "waterfall (see module docstring)")
+    ap.add_argument("--trace", help="Chrome-trace JSON to census")
+    ap.add_argument("--key", choices=("before", "after"),
+                    help="block to census when --trace is a demo fixture")
+    ap.add_argument("--ledger",
+                    help="compile-ledger JSON to join (family -> "
+                         "{flops, bytes_accessed})")
+    ap.add_argument("--diff", nargs=2, metavar=("BEFORE", "AFTER"),
+                    help="fusion diff between two trace JSONs")
+    ap.add_argument("--goodput", nargs="?", const="", metavar="FILE",
+                    help="goodput waterfall from a report JSON (no FILE "
+                         "= the live in-process ledger)")
+    ap.add_argument("--device", default=None,
+                    help="chip generation for the roofs (v3/v4/v5e/v5p/"
+                         "v6e); default: the fixture's, else explicit "
+                         "peaks only")
+    ap.add_argument("--top", type=int, default=15)
+    ap.add_argument("--demo", action="store_true",
+                    help="render the committed demo fixture")
+    ap.add_argument("--live", action="store_true",
+                    help="trace a small eager workload and census it")
+    args = ap.parse_args(argv)
+
+    if args.diff:
+        _render_diff(_events(_load(args.diff[0])),
+                     _events(_load(args.diff[1])), args.device)
+        return 0
+    if args.goodput is not None:
+        if args.goodput:
+            _render_goodput(_goodput_report(_load(args.goodput)))
+        else:
+            from incubator_mxnet_tpu.telemetry import goodput
+
+            rep = goodput.report()
+            if not rep.get("enabled"):
+                print("goodput ledger is not armed (set MXNET_GOODPUT=1 "
+                      "or MXNET_TELEMETRY=1) — pass a FILE to render a "
+                      "committed report")
+                return 1
+            _render_goodput(rep)
+        return 0
+    if args.live:
+        return _live(args)
+    if args.trace and not args.demo:
+        doc = _load(args.trace)
+        ledger = _load(args.ledger) if args.ledger else (
+            doc.get("ledger") if isinstance(doc, dict) else None)
+        device = args.device or (doc.get("device")
+                                 if isinstance(doc, dict) else None)
+        _render_census(_events(doc, args.key), ledger, device, args.top)
+        return 0
+    return _demo(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
