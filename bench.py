@@ -52,6 +52,18 @@ extras:
   across three priority tiers on a seeded bursty (Markov-modulated)
   trace from tools/loadgen; per-tier TTFT, preemption total, per-tenant
   token rates (SERVING.md §gateway).
+- gpt_serve_sharded_tokens_s vs _1dev_tokens_s (+ _ttft_p50/p99_ms,
+  _replicas): the same seeded trace through 2 replicas x tp=4
+  mesh-sharded engines behind the gateway router vs one unsharded
+  single-device replica, in a child process that self-provisions a
+  virtual 8-device CPU platform (--serve-sharded-only). Wall rates
+  there are layout evidence (1 vCPU drives all 8 virtual devices), so
+  they're report-only; the durable numbers are
+  gpt_serve_sharded_kv_bytes_per_device (measured: each device holds
+  1/tp of the paged KV pools — the HBM-capacity scaling story) and
+  gpt_serve_sharded_collective_bytes_per_token (static decode-HLO
+  collective traffic — the cost the row/column-parallel layout
+  minimizes; gated lower-is-better).
 - gpt_serve_traced/untraced_tokens_s + gpt_serve_tracing_overhead_pct:
   the same reduced serve trace with span tracing off then on (adjacent
   runs) — the measured cost of per-request tracing on the serving hot
@@ -503,6 +515,37 @@ def _bench_input_pipeline_subprocess(timeout=900):
     if not (rate > 0.0 and rate == rate and rate != float("inf")):
         raise RuntimeError(f"degenerate pipeline rate {rate!r}")
     return rate, cores
+
+
+def _bench_serve_sharded_subprocess(timeout=1500):
+    """Run the pod-scale sharded-serving bench in a FRESH process
+    (bench.py --serve-sharded-only) that self-provisions a virtual
+    8-device CPU platform: the parent typically sees ONE tunneled chip,
+    and `--xla_force_host_platform_device_count` only takes effect
+    before the child's jax backend initializes (the
+    `__graft_entry__.dryrun_multichip` child recipe — the env rewrite
+    happens INSIDE the child's dispatch branch, after any sitecustomize
+    has run, so a host-pinned JAX_PLATFORMS cannot override it). Parses
+    the child's single JSON line and returns its extras dict."""
+    import subprocess
+
+    out = subprocess.run(
+        [sys.executable, os.path.abspath(__file__),
+         "--serve-sharded-only"],
+        capture_output=True, text=True, timeout=timeout)
+    if out.returncode != 0:
+        raise RuntimeError(
+            f"serve-sharded subprocess rc={out.returncode}: "
+            f"{out.stderr[-800:]}")
+    for line in reversed(out.stdout.strip().splitlines()):
+        try:
+            d = json.loads(line)
+        except ValueError:
+            continue
+        if d.get("metric") == "gpt_serve_sharded_tokens_s":
+            return d.get("extras", {})
+    raise RuntimeError(
+        f"no sharded-serve JSON in child output: {out.stdout[-400:]}")
 
 
 def bench_gpt_decode(batch=8, prompt=32, new_tokens=224):
@@ -966,6 +1009,142 @@ def bench_gpt_gateway(requests=30, seed=0):
     return out
 
 
+def bench_gpt_serve_sharded(requests=16, max_slots=4, prompt_max=40,
+                            new_max=20, tp=4, n_replicas=2, seed=0):
+    """Pod-scale sharded serving (SERVING.md §pod-scale): the SAME
+    seeded closed-loop request trace replayed through (a) one unsharded
+    single-device replica and (b) ``n_replicas`` mesh-sharded
+    `ShardedSlotDecoder` replicas (tp=4 each) behind the gateway's
+    `ReplicaRouter` — identical model weights, identical prompts and
+    budgets, identical pool sizing.
+
+    Runs ONLY on a >= tp*n_replicas-device process (the
+    ``--serve-sharded-only`` child self-provisions a virtual 8-device
+    CPU platform — see `_bench_serve_sharded_subprocess`). On that
+    1-vCPU virtual mesh the wall rates are LAYOUT evidence (the sharded
+    program pays real collective dispatch), not chip numbers, so they
+    are report-only in bench_regress; the durable metrics are the
+    HBM-capacity story (measured per-device KV pool bytes: the pools
+    shard tp-way, so each device holds 1/tp of the cache) and the
+    static per-token collective bytes read from the decode program's
+    own HLO — the cost the row/column-parallel layout was chosen to
+    minimize (3 tiny all-reduces per layer, zero hot-path all-gathers).
+
+    Loud-failure contract: any failed request, zero tokens, non-finite
+    rate, a steady-state recompile during either replay, traffic that
+    never reaches one of the replicas, or a dirty `shardcheck_report`
+    on the sharded decode family raises — it lands in
+    extras["errors"], never passes as a small number."""
+    import jax
+
+    from incubator_mxnet_tpu import serve
+    from incubator_mxnet_tpu.models.gpt import GPTModel
+
+    need = tp * n_replicas
+    if len(jax.devices()) < need:
+        raise RuntimeError(
+            f"bench_gpt_serve_sharded needs >= {need} devices, have "
+            f"{len(jax.devices())} — run via the --serve-sharded-only "
+            "child (_bench_serve_sharded_subprocess)")
+
+    vocab, max_len = 8000, 80
+    # d_model 256 / 4 heads / ffn 1024: every sharded axis divides tp=4
+    net = GPTModel(vocab, 256, 1024, 4, 4, max_length=max_len,
+                   dropout=0.0)
+    net.initialize()
+    rng = onp.random.RandomState(seed)
+    prompts = [rng.randint(0, vocab, (int(rng.randint(8, prompt_max)),))
+               .astype(onp.int32) for _ in range(requests)]
+    budgets = [int(rng.randint(new_max // 2, new_max))
+               for _ in range(requests)]
+
+    def run(mesh, replicas):
+        reg = serve.ModelRegistry()
+        reg.add("m", net, replicas=replicas, mesh=mesh,
+                max_slots=max_slots, max_len=max_len, n_pages=32)
+        gw = serve.Gateway(reg, seed=seed)
+        try:
+            # warm every program the trace will touch on EVERY replica
+            # (prefill chunk buckets 16/32/64 + decode) through each
+            # replica's own scheduler — router spread during warmup is
+            # not guaranteed, and a cold replica would compile inside
+            # the timed window
+            wrng = onp.random.RandomState(seed + 1)
+            for rep in gw._models["m"].replicas:
+                warm = [rep.sched.submit(
+                    wrng.randint(0, vocab, (n,)).astype(onp.int32), 2,
+                    temperature=1.0) for n in (12, 24, 40)]
+                for _ in range(2000):
+                    rep.sched.step()
+                    if all(w.done for w in warm):
+                        break
+                if not all(w.done for w in warm):
+                    raise RuntimeError("replica warmup did not complete")
+            programs_warm = gw.xla_program_counts()
+
+            t0 = time.perf_counter()
+            reqs = [gw.submit("m", p, b)
+                    for p, b in zip(prompts, budgets)]
+            while not all(r.done for r in reqs):
+                gw.step()
+                if time.perf_counter() - t0 > 600:
+                    raise RuntimeError("sharded serve replay timed out")
+            t_total = time.perf_counter() - t0
+
+            if gw.xla_program_counts() != programs_warm:
+                raise RuntimeError(
+                    "steady-state recompile during sharded replay: "
+                    f"{programs_warm} -> {gw.xla_program_counts()}")
+            total_new = sum(len(r.result()) for r in reqs)  # raises on err
+            ttfts = [r.ttft for r in reqs]
+            if total_new == 0 or any(t is None for t in ttfts) \
+                    or t_total <= 0:
+                raise RuntimeError(
+                    f"degenerate sharded serve run: tokens={total_new}")
+            tokens_s = total_new / t_total
+            if not (tokens_s > 0 and tokens_s == tokens_s
+                    and tokens_s != float("inf")):
+                raise RuntimeError(f"degenerate serve rate {tokens_s!r}")
+            out = {
+                "tokens_s": tokens_s,
+                "p50_ms": float(onp.percentile(ttfts, 50)) * 1e3,
+                "p99_ms": float(onp.percentile(ttfts, 99)) * 1e3,
+                "replicas_used": len({r.replica for r in reqs}),
+            }
+            if replicas > 1 and out["replicas_used"] < replicas:
+                raise RuntimeError(
+                    f"router starved a replica: {out['replicas_used']}"
+                    f"/{replicas} saw traffic")
+            if mesh is not None:
+                eng = gw._models["m"].replicas[0].slots
+                report = eng.shardcheck_report()
+                for fam in ("prefill", "decode"):
+                    if report[fam].findings:
+                        raise RuntimeError(
+                            f"dirty shardcheck on sharded {fam}: "
+                            f"{[(f.rule, f.message) for f in report[fam].findings]}")
+                # static HLO truth: bytes every decode step moves through
+                # collectives, / max_slots = per-token at full occupancy
+                step_bytes = sum(
+                    rec["bytes"]
+                    for rec in report["decode"].collectives.values())
+                out["collective_bytes_per_token"] = step_bytes / max_slots
+                # HBM-capacity story: each device holds 1/tp of the pools
+                pools = list(eng._pk) + list(eng._pv)
+                out["kv_bytes_total"] = sum(x.nbytes for x in pools)
+                out["kv_bytes_per_device"] = sum(
+                    x.addressable_shards[0].data.nbytes for x in pools)
+            return out
+        finally:
+            gw.shutdown(drain=False)
+
+    base = run(mesh=None, replicas=1)
+    shard = run(mesh=f"tp={tp}", replicas=n_replicas)
+    shard["1dev_tokens_s"] = base["tokens_s"]
+    shard["vs_1dev"] = shard["tokens_s"] / base["tokens_s"]
+    return shard
+
+
 def bench_gpt_serve_traced(requests=12, max_slots=4, prompt_max=48,
                            new_max=48, mean_interarrival_s=0.02, seed=0):
     """Tracing-overhead pair: the SAME reduced serve trace twice,
@@ -1244,6 +1423,18 @@ def _collect_serve_extras(extras, _retry, _fail):
             extras[f"gpt_gateway_{tenant}_tokens_s"] = round(rate, 1)
     except Exception as e:  # pragma: no cover
         _fail("gpt_gateway", e)
+    try:
+        # pod-scale replicated+sharded serving, in its own 8-device
+        # child process (see _bench_serve_sharded_subprocess): wall
+        # rates are layout evidence on the virtual CPU mesh; the
+        # per-device KV bytes and static collective bytes are the
+        # durable numbers
+        sx = _retry(_bench_serve_sharded_subprocess)
+        for name, msg in (sx.pop("errors", {}) or {}).items():
+            extras.setdefault("errors", {})[name] = msg  # pragma: no cover
+        extras.update(sx)
+    except Exception as e:  # pragma: no cover
+        _fail("gpt_serve_sharded", e)
 
 
 def _fail_into(extras):
@@ -1282,6 +1473,44 @@ def serve_main():
         raise SystemExit(1)
     print(json.dumps({
         "metric": "gpt_serve_tokens_s",
+        "value": headline,
+        "unit": "tokens/sec",
+        "extras": extras,
+    }))
+
+
+def serve_sharded_main():
+    """``--serve-sharded-only``: the pod-scale sharded serving bench
+    alone, inside the child whose dispatch branch already forced the
+    virtual 8-device CPU platform. Emits ONE JSON line with
+    gpt_serve_sharded_tokens_s as the headline for
+    `_bench_serve_sharded_subprocess` to parse."""
+    extras = {}
+    _fail = _fail_into(extras)
+    try:
+        sh = _retry(bench_gpt_serve_sharded)
+        extras["gpt_serve_sharded_tokens_s"] = round(sh["tokens_s"], 1)
+        extras["gpt_serve_sharded_1dev_tokens_s"] = \
+            round(sh["1dev_tokens_s"], 1)
+        extras["gpt_serve_sharded_vs_1dev"] = round(sh["vs_1dev"], 3)
+        extras["gpt_serve_sharded_ttft_p50_ms"] = round(sh["p50_ms"], 1)
+        extras["gpt_serve_sharded_ttft_p99_ms"] = round(sh["p99_ms"], 1)
+        extras["gpt_serve_sharded_replicas"] = int(sh["replicas_used"])
+        extras["gpt_serve_sharded_collective_bytes_per_token"] = \
+            int(sh["collective_bytes_per_token"])
+        extras["gpt_serve_sharded_kv_bytes_per_device"] = \
+            int(sh["kv_bytes_per_device"])
+        extras["gpt_serve_sharded_kv_bytes_total"] = \
+            int(sh["kv_bytes_total"])
+    except Exception as e:  # pragma: no cover
+        _fail("gpt_serve_sharded", e)
+    headline = extras.get("gpt_serve_sharded_tokens_s")
+    if headline is None:  # pragma: no cover - loud-failure contract
+        print(json.dumps({"metric": "bench_failed", "value": 0,
+                          "extras": extras}))
+        raise SystemExit(1)
+    print(json.dumps({
+        "metric": "gpt_serve_sharded_tokens_s",
         "value": headline,
         "unit": "tokens/sec",
         "extras": extras,
@@ -1459,5 +1688,22 @@ if __name__ == "__main__":
         print("REGISTRY " + json.dumps(_series))
     elif "--serve-only" in sys.argv:
         serve_main()
+    elif "--serve-sharded-only" in sys.argv:
+        # self-provision the virtual 8-device CPU platform BEFORE the
+        # framework touches jax — this runs after sitecustomize (which
+        # may pin JAX_PLATFORMS to the TPU plugin and may already have
+        # imported jax), so both the env rewrite and the config update
+        # are needed (the __graft_entry__.dryrun_multichip child recipe)
+        import re as _re
+        _flags = _re.sub(r"--xla_force_host_platform_device_count=\d+",
+                         "", os.environ.get("XLA_FLAGS", ""))
+        os.environ["XLA_FLAGS"] = \
+            _flags + " --xla_force_host_platform_device_count=8"
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        os.environ["JAX_PLATFORM_NAME"] = "cpu"
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        serve_sharded_main()
     else:
         main()

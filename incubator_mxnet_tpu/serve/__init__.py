@@ -37,7 +37,15 @@ Three connected parts:
   tiers preempt lower-tier running slots, preempted work resumes warm
   off its cached KV pages), per-tenant token-rate quotas and weighted
   deficit-round-robin fairness (`TokenBucket`, `WDRRQueue`), driven
-  against recorded traces by `tools/loadgen.py`.
+  against recorded traces by `tools/loadgen.py`;
+- `sharded` + `router` — pod-scale: :class:`ServeLayout` partition
+  rules place a :class:`ShardedSlotDecoder`'s params and per-layer KV
+  pools onto a device mesh (heads-sharded attention pools, Megatron
+  fsdp×tp matmuls, every single-chip invariant preserved), and
+  ``ModelRegistry.add(..., replicas=N, mesh=...)`` fronts N replica
+  engines behind :class:`ReplicaRouter` least-loaded + prefix-affinity
+  dispatch with drain-free `Gateway.hot_swap` weight rolls
+  (SERVING.md §pod-scale).
 
 Observability and chaos ride the existing subsystems: the registry
 carries ``mx_serve_ttft_seconds``, ``mx_serve_tokens_total``,
@@ -53,7 +61,8 @@ carries ``mx_serve_ttft_seconds``, ``mx_serve_tokens_total``,
 ``MXNET_SERVE_PREFILL_CHUNK``, ``MXNET_SERVE_KV_DTYPE``,
 ``MXNET_SERVE_PRIORITY_TIERS``, ``MXNET_SERVE_TENANT_QUOTA``,
 ``MXNET_GATEWAY_MAX_QUEUE``, ``MXNET_GATEWAY_QUANTUM``,
-``MXNET_GATEWAY_PREEMPT``.
+``MXNET_GATEWAY_PREEMPT``, ``MXNET_SERVE_MESH``,
+``MXNET_SERVE_REPLICAS``, ``MXNET_SERVE_AFFINITY``.
 
 Typical use::
 
@@ -70,19 +79,27 @@ from __future__ import annotations
 from . import api  # noqa: F401
 from . import engine  # noqa: F401
 from . import gateway  # noqa: F401
+from . import router  # noqa: F401
 from . import scheduler  # noqa: F401
+from . import sharded  # noqa: F401
 from . import tenancy  # noqa: F401
 from .api import ServeEngine  # noqa: F401
 from .engine import (PageAllocator, PagePoolExhausted,  # noqa: F401
                      PrefixCache, SlotDecoder)
 from .gateway import Gateway, GatewayRequest, ModelRegistry  # noqa: F401
+from .router import ReplicaRouter, replica_meshes  # noqa: F401
 from .scheduler import (DeadlineExceeded, EngineClosed,  # noqa: F401
                         QueueFull, Request, Scheduler)
+from .sharded import (ServeLayout, ShardedSlotDecoder,  # noqa: F401
+                      serve_mesh)
 from .tenancy import Tenant, TokenBucket, WDRRQueue  # noqa: F401
 
 __all__ = ["ServeEngine", "SlotDecoder", "Scheduler", "Request",
            "PageAllocator", "PrefixCache", "PagePoolExhausted",
            "QueueFull", "DeadlineExceeded", "EngineClosed",
            "Gateway", "GatewayRequest", "ModelRegistry",
+           "ServeLayout", "ShardedSlotDecoder", "ReplicaRouter",
+           "serve_mesh", "replica_meshes",
            "Tenant", "TokenBucket", "WDRRQueue",
-           "api", "engine", "gateway", "scheduler", "tenancy"]
+           "api", "engine", "gateway", "router", "scheduler",
+           "sharded", "tenancy"]

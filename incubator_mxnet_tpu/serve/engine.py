@@ -579,6 +579,36 @@ class SlotDecoder:
         pv = tuple(jnp.zeros(shape, dtype) for _ in range(L))
         return pk, pv, None, None
 
+    # -- sharding seams (overridden by serve.sharded.ShardedSlotDecoder) ----
+
+    def _refresh_params(self):
+        """Hot-swap seam: re-read decoder params when the source block's
+        weights changed (cheap id-fingerprint walk). The sharded engine
+        overrides this to re-place refreshed params onto its mesh —
+        every program entry point routes through here, so a weight swap
+        lands without draining the engine."""
+        self._dec._auto_refresh()
+
+    def _constrain_pools(self, pk, pv, sk, sv):
+        """Traced seam at the tail of every pool-updating program: the
+        base engine is layout-free (identity), the sharded engine pins
+        each updated pool leaf to its input sharding so XLA's donation
+        map still aliases all ``2L`` leaves in place."""
+        return pk, pv, sk, sv
+
+    def _shardcheck_specs(self):
+        """Per-argument shardcheck spec entries for ``(params, *pools)``,
+        or None (unconstrained — the single-chip default). The sharded
+        engine returns its `ServeLayout`-derived entries so SC001 sees
+        every ≥1 MiB leaf explicitly placed."""
+        return None
+
+    def _shardcheck_out_specs(self):
+        """Spec entries for the builders' ``(pk, pv[, sk, sv], tok)``
+        outputs, or None. The sharded engine pins the pool outputs so
+        the SC004 donation audit sees matching in/out placements."""
+        return None
+
     def _ensure_pool(self):
         if self._pk is not None:
             return
@@ -773,6 +803,7 @@ class SlotDecoder:
                                               axis=1)[:, 0]
             logits = dec._logits(params, h_last)               # (1, V)
             first = dec._sample(logits, key, temperature, top_k, do_sample)
+            pk, pv, sk, sv = self._constrain_pools(pk, pv, sk, sv)
             return pk, pv, sk, sv, first[0]
 
         # the int8 pools carry per-page scale planes as extra donated
@@ -830,7 +861,7 @@ class SlotDecoder:
         annotations.
         """
         jnp = _j().numpy
-        self._dec._auto_refresh()
+        self._refresh_params()
         self._ensure_pool()
         if self._prefill_jit is None:
             self._prefill_jit = self._build_prefill()
@@ -1015,6 +1046,7 @@ class SlotDecoder:
             # host never reads them, but a defined value keeps the
             # program deterministic
             nxt = jnp.where(active, nxt, last_tok)
+            pk, pv, sk, sv = self._constrain_pools(pk, pv, sk, sv)
             return pk, pv, sk, sv, nxt
 
         if int8:
@@ -1049,7 +1081,7 @@ class SlotDecoder:
         Returns the next token per slot as host numpy (the one host sync
         per step)."""
         jnp = _j().numpy
-        self._dec._auto_refresh()
+        self._refresh_params()
         self._ensure_pool()
         if self._decode_jit is None:
             self._decode_jit = self._build_decode()
@@ -1157,6 +1189,7 @@ class SlotDecoder:
                 params, x.reshape(S * K1, -1)).reshape(S, K1, -1)
             tgt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
             tgt = jnp.where(active[:, None], tgt, toks)
+            pk, pv, sk, sv = self._constrain_pools(pk, pv, sk, sv)
             return pk, pv, sk, sv, tgt
 
         if int8:
@@ -1224,6 +1257,7 @@ class SlotDecoder:
             pk, pv = tuple(pk), tuple(pv)
             sk = tuple(sk) if int8 else None
             sv = tuple(sv) if int8 else None
+            pk, pv, sk, sv = self._constrain_pools(pk, pv, sk, sv)
             return pk, pv, sk, sv, jnp.stack(outs, axis=1)      # (S, K)
 
         if int8:
@@ -1288,7 +1322,7 @@ class SlotDecoder:
         prefix matching rows ``0..m-1`` plus row ``m`` as the bonus
         token (>= 1 token of guaranteed progress per round)."""
         jnp = _j().numpy
-        self._dec._auto_refresh()
+        self._refresh_params()
         self._ensure_pool()
         if self._verify_jit is None:
             self._verify_jit = self._build_verify()
@@ -1405,7 +1439,7 @@ class SlotDecoder:
 
         jax = _j()
         sds = jax.ShapeDtypeStruct
-        self._dec._auto_refresh()
+        self._refresh_params()
         self._ensure_pool()
         if self._prefill_jit is None:
             self._prefill_jit = self._build_prefill()
@@ -1421,15 +1455,20 @@ class SlotDecoder:
         statics = {"top_k": self._top_k, "do_sample": self._do_sample}
 
         bucket = int(bucket) if bucket is not None else self.chunk_buckets[-1]
+        head_specs = self._shardcheck_specs()
+        out_specs = self._shardcheck_out_specs()
         prefill_args = (params,) + pools + (
             sds((1, bucket), i32),                      # tokens
             sds((self.pages_per_slot,), i32),           # pages_row
             sds((bucket // self.page_tokens,), i32),    # chunk_pages
             sds((), i32), sds((), i32),                 # t_start, t_len
             key, sds((), f32))                          # key, temperature
+        pf_specs = None if head_specs is None else head_specs + (
+            (None,) * (len(prefill_args) - len(head_specs)))
         prefill = shardcheck(
             functools.partial(self._prefill_jit, **statics), *prefill_args,
-            mesh=mesh, donate_argnums=donate, hbm_budget_gb=hbm_budget_gb,
+            mesh=mesh, specs=pf_specs, out_specs=out_specs,
+            donate_argnums=donate, hbm_budget_gb=hbm_budget_gb,
             name=f"SlotDecoder.prefill[b{bucket}]")
 
         decode_args = (params,) + pools + (
@@ -1437,9 +1476,12 @@ class SlotDecoder:
             sds((S,), i32), sds((S,), i32),             # last_tok, pos
             sds((S,), bool),                            # active
             key, sds((S,), f32))                        # key, temperature
+        dc_specs = None if head_specs is None else head_specs + (
+            (None,) * (len(decode_args) - len(head_specs)))
         decode = shardcheck(
             functools.partial(self._decode_jit, **statics), *decode_args,
-            mesh=mesh, donate_argnums=donate, hbm_budget_gb=hbm_budget_gb,
+            mesh=mesh, specs=dc_specs, out_specs=out_specs,
+            donate_argnums=donate, hbm_budget_gb=hbm_budget_gb,
             hot_path=True, name="SlotDecoder.decode")
         return {"prefill": prefill, "decode": decode}
 

@@ -42,6 +42,14 @@ queues. Chaos rides the ``gateway_step`` fault seam. Knobs:
 ``MXNET_SERVE_PRIORITY_TIERS``, ``MXNET_SERVE_TENANT_QUOTA``,
 ``MXNET_GATEWAY_MAX_QUEUE``, ``MXNET_GATEWAY_QUANTUM``,
 ``MXNET_GATEWAY_PREEMPT``.
+
+Pod-scale: ``add(..., replicas=N, mesh=...)`` fronts a model with N
+independent engines (optionally mesh-sharded via
+`serve.sharded.ShardedSlotDecoder`) behind least-loaded +
+prefix-affinity routing (`serve.router.ReplicaRouter`;
+``MXNET_SERVE_REPLICAS`` / ``MXNET_SERVE_MESH`` /
+``MXNET_SERVE_AFFINITY``), with `Gateway.hot_swap` rolling refreshed
+weights one replica at a time, drain-free — SERVING.md §pod-scale.
 """
 from __future__ import annotations
 
@@ -72,18 +80,50 @@ def _q_help():
             "(pull gauge over the live WDRR queues)")
 
 
-class _Model:
-    """One co-resident engine: its own SlotDecoder pool + Scheduler,
-    plus the gateway-side list of live (dispatched) requests."""
+class _Replica:
+    """One serving engine instance: a SlotDecoder (possibly a mesh-
+    sharded `serve.sharded.ShardedSlotDecoder`) + Scheduler pair, plus
+    the gateway-side list of live (dispatched) requests. ``label`` is
+    the metric/census identity — ``"<model>"`` for a single-replica
+    model (the pre-replica series names), ``"<model>#<i>"`` otherwise."""
 
-    __slots__ = ("name", "slots", "sched", "share", "live")
+    __slots__ = ("model", "index", "label", "slots", "sched", "live")
 
-    def __init__(self, name, slots, sched, share):
-        self.name = name
+    def __init__(self, model, index, label, slots, sched):
+        self.model = model
+        self.index = index
+        self.label = label
         self.slots = slots
         self.sched = sched
-        self.share = share
         self.live = []                    # dispatched GatewayRequests
+
+
+class _Model:
+    """One co-resident model: N replica engines behind one
+    `serve.router.ReplicaRouter`. The single-replica accessors
+    (``slots``/``sched``/``live`` → replica 0) keep the pre-replica
+    surface working for introspection and config reads — every replica
+    of a model is built with identical engine kwargs."""
+
+    __slots__ = ("name", "replicas", "share", "router")
+
+    def __init__(self, name, replicas, share, router):
+        self.name = name
+        self.replicas = replicas
+        self.share = share
+        self.router = router
+
+    @property
+    def slots(self):
+        return self.replicas[0].slots
+
+    @property
+    def sched(self):
+        return self.replicas[0].sched
+
+    @property
+    def live(self):
+        return self.replicas[0].live
 
 
 class ModelRegistry:
@@ -101,10 +141,21 @@ class ModelRegistry:
         self.total_pages = None if total_pages is None else int(total_pages)
         self._specs = {}
 
-    def add(self, name, block_or_decoder, share=1.0, **engine_kwargs):
+    def add(self, name, block_or_decoder, share=1.0, replicas=None,
+            mesh=None, **engine_kwargs):
         """Register `name` → model. ``share`` weights this model's cut
         of the page budget; ``engine_kwargs`` forward to `SlotDecoder`
-        (max_slots, max_len, page_tokens, kv_dtype, ...)."""
+        (max_slots, max_len, page_tokens, kv_dtype, ...).
+
+        ``replicas`` fronts the model with N independent engines behind
+        least-loaded + prefix-affinity routing (default: the
+        ``MXNET_SERVE_REPLICAS`` knob, else 1); the model's page cut is
+        split evenly across them. ``mesh`` makes each replica a
+        mesh-sharded `ShardedSlotDecoder`: a spec (``"tp=4"`` / dict /
+        int) is carved into disjoint per-replica device slices via
+        `serve.router.replica_meshes`; a list supplies one prebuilt
+        mesh per replica. A list of pre-built decoders is also accepted
+        as ``block_or_decoder`` (one per replica)."""
         name = str(name)
         if name in self._specs:
             raise ValueError(f"model {name!r} already registered")
@@ -112,7 +163,12 @@ class ModelRegistry:
         if share <= 0:
             raise ValueError(
                 f"model {name!r}: share must be > 0, got {share}")
-        self._specs[name] = (block_or_decoder, share, dict(engine_kwargs))
+        if replicas is not None and int(replicas) < 1:
+            raise ValueError(
+                f"model {name!r}: replicas must be >= 1, got {replicas}")
+        self._specs[name] = (block_or_decoder, share, dict(engine_kwargs),
+                             None if replicas is None else int(replicas),
+                             mesh)
         return self
 
     def __len__(self):
@@ -124,35 +180,86 @@ class ModelRegistry:
     def names(self):
         return list(self._specs)
 
+    @staticmethod
+    def _is_engine(obj):
+        return hasattr(obj, "prefill_chunk_step") \
+            and hasattr(obj, "allocator")
+
     def _build(self, policy, max_queue, default_deadline, eos_id, seed):
+        from .router import ReplicaRouter, replica_meshes
+
         if not self._specs:
             raise ValueError("ModelRegistry is empty — add() a model "
                              "before constructing the Gateway")
-        total_share = sum(s for _, s, _ in self._specs.values())
+        total_share = sum(s for _, s, _, _, _ in self._specs.values())
         models = {}
-        for i, (name, (block, share, kw)) in enumerate(self._specs.items()):
-            if hasattr(block, "prefill_chunk_step") \
-                    and hasattr(block, "allocator"):
-                if kw:
+        for i, (name, (block, share, kw,
+                       n_rep, mesh)) in enumerate(self._specs.items()):
+            prebuilt = None
+            if isinstance(block, (list, tuple)) \
+                    and all(self._is_engine(b) for b in block):
+                prebuilt = list(block)   # one pre-built engine per replica
+                if n_rep is not None and n_rep != len(prebuilt):
                     raise ValueError(
-                        f"model {name!r}: engine kwargs {sorted(kw)} "
-                        "cannot apply to a pre-built decoder — configure "
-                        "it at construction instead")
-                slots = block     # pre-built SlotDecoder (or a test stub)
+                        f"model {name!r}: replicas={n_rep} but "
+                        f"{len(prebuilt)} pre-built decoders were given")
+                n_rep = len(prebuilt)
+            elif self._is_engine(block):
+                prebuilt = [block]       # pre-built SlotDecoder / stub
+                if n_rep is not None and n_rep != 1:
+                    raise ValueError(
+                        f"model {name!r}: replicas={n_rep} needs a list "
+                        "of pre-built decoders (one per replica)")
+                n_rep = 1
+            if n_rep is None:
+                n_rep = max(1, _env_int("MXNET_SERVE_REPLICAS", 1))
+            if prebuilt is not None and kw:
+                raise ValueError(
+                    f"model {name!r}: engine kwargs {sorted(kw)} "
+                    "cannot apply to a pre-built decoder — configure "
+                    "it at construction instead")
+            if mesh is None:
+                meshes = [None] * n_rep
+            elif isinstance(mesh, (list, tuple)):
+                if len(mesh) != n_rep:
+                    raise ValueError(
+                        f"model {name!r}: {len(mesh)} meshes for "
+                        f"{n_rep} replicas")
+                meshes = list(mesh)
+            elif hasattr(mesh, "devices") and hasattr(mesh, "shape"):
+                meshes = [mesh] * n_rep  # one shared mesh: caller's call
             else:
-                kw = dict(kw)
-                if self.total_pages is not None and "n_pages" not in kw:
-                    kw["n_pages"] = max(
-                        4, int(self.total_pages * share / total_share))
-                slots = SlotDecoder(block, **kw)
-            # compile-ledger families and HBM-census owners carry the
-            # tenant name (serve:<model>.prefill, serve:<model>.kv_pool…)
-            if hasattr(slots, "census_name"):
-                slots.census_name = f"serve:{name}"
-            sched = Scheduler(slots, max_queue=max_queue, policy=policy,
-                              default_deadline=default_deadline,
-                              eos_id=eos_id, seed=seed + i)
-            models[name] = _Model(name, slots, sched, share)
+                meshes = replica_meshes(mesh, n_rep)
+            replicas = []
+            for j in range(n_rep):
+                if prebuilt is not None:
+                    slots = prebuilt[j]
+                else:
+                    rkw = dict(kw)
+                    if self.total_pages is not None \
+                            and "n_pages" not in rkw:
+                        cut = int(self.total_pages * share / total_share)
+                        rkw["n_pages"] = max(4, cut // n_rep)
+                    if meshes[j] is not None:
+                        from .sharded import ShardedSlotDecoder
+
+                        slots = ShardedSlotDecoder(block, mesh=meshes[j],
+                                                   **rkw)
+                    else:
+                        slots = SlotDecoder(block, **rkw)
+                label = name if n_rep == 1 else f"{name}#{j}"
+                # compile-ledger families and HBM-census owners carry
+                # the replica label (serve:<model>#<j>.prefill, …)
+                if hasattr(slots, "census_name"):
+                    slots.census_name = f"serve:{label}"
+                # replica 0 keeps the pre-replica seed stream so
+                # single-replica traces stay reproducible round-over-round
+                sched = Scheduler(slots, max_queue=max_queue,
+                                  policy=policy,
+                                  default_deadline=default_deadline,
+                                  eos_id=eos_id, seed=seed + i + 997 * j)
+                replicas.append(_Replica(name, j, label, slots, sched))
+            models[name] = _Model(name, replicas, share, ReplicaRouter())
         return models
 
 
@@ -165,7 +272,7 @@ class GatewayRequest:
                  "max_new", "temperature", "eos_id", "deadline",
                  "submit_t", "first_token_t", "finish_t", "tokens",
                  "state", "error", "error_class", "preemptions",
-                 "est_cost", "trace_id", "_spans", "_segment",
+                 "est_cost", "trace_id", "replica", "_spans", "_segment",
                  "_resume_prompt", "_remaining", "_charged", "_stream",
                  "_done")
 
@@ -189,6 +296,7 @@ class GatewayRequest:
         self.error = None
         self.error_class = None
         self.preemptions = 0
+        self.replica = None               # replica label once dispatched
         self.est_cost = int(prompt.size) + int(max_new)
         self._segment = None              # live engine Request, or None
         self._resume_prompt = None        # set after a preemption
@@ -241,9 +349,12 @@ class GatewayRequest:
             ttft = now - self.submit_t
             # one labeled VIEW per dimension (this registry has no
             # query-time aggregation, so {priority=} and {model=} are
-            # separate series — slo.gateway_ttft reads the tier view)
-            for labels in ({"priority": self.priority},
-                           {"model": self.model}):
+            # separate series — slo.gateway_ttft reads the tier view;
+            # the {replica=} view shows routing skew across replicas)
+            views = [{"priority": self.priority}, {"model": self.model}]
+            if self.replica is not None and self.replica != self.model:
+                views.append({"replica": self.replica})
+            for labels in views:
                 registry.histogram(
                     "mx_serve_ttft_seconds",
                     "time-to-first-token: submit() to the final prefill "
@@ -251,7 +362,10 @@ class GatewayRequest:
                     labels=labels).observe(ttft)
         self.tokens.append(tok)
         self._stream.put(tok)
-        for labels in ({"tenant": self.tenant}, {"model": self.model}):
+        views = [{"tenant": self.tenant}, {"model": self.model}]
+        if self.replica is not None and self.replica != self.model:
+            views.append({"replica": self.replica})
+        for labels in views:
             registry.counter(
                 "mx_serve_tokens_total",
                 "tokens generated by the serving engine",
@@ -368,6 +482,23 @@ class Gateway:
                 "mx_gateway_queue_depth", _probe, _q_help(),
                 labels={"priority": tier})
 
+        for m in self._models.values():
+            for rep in m.replicas:
+                sref = weakref.ref(rep.slots)
+
+                def _free(sref=sref):
+                    s = sref()
+                    alloc = None if s is None \
+                        else getattr(s, "allocator", None)
+                    if alloc is None:
+                        return None
+                    return alloc.free_pages
+                registry.register_pull_gauge(
+                    "mx_serve_replica_free_pages", _free,
+                    "free KV pool pages per serving replica (the "
+                    "router's least-loaded signal)",
+                    labels={"replica": rep.label})
+
         def _flight(ref=ref):
             gw = ref()
             return None if gw is None else gw._flight_state()
@@ -387,16 +518,18 @@ class Gateway:
         return {
             "tiers": {t: len(self._queues[t]) for t in self.tiers},
             "queued": queued,
-            "live": {m.name: [
+            "live": {rep.label: [
                 {"id": r.id, "tenant": r.tenant, "priority": r.priority,
                  "tokens": len(r.tokens),
                  "segment_state": None if r._segment is None
                  else r._segment.state}
-                for r in m.live] for m in self._models.values()},
+                for r in rep.live]
+                for m in self._models.values() for rep in m.replicas},
             "preemptions_total": self.preemptions_total,
-            "spec": {m.name: m.slots.spec_stats()
+            "spec": {rep.label: rep.slots.spec_stats()
                      for m in self._models.values()
-                     if getattr(m.slots, "spec_k", 0)},
+                     for rep in m.replicas
+                     if getattr(rep.slots, "spec_k", 0)},
             "closed": self.closed,
         }
 
@@ -420,11 +553,17 @@ class Gateway:
         with self._lock:
             return {t: len(self._queues[t]) for t in self.tiers}
 
-    def xla_program_counts(self):
-        """Live compiled-program count per model — the per-engine
-        zero-steady-state-recompile gate, gateway edition."""
+    def xla_program_counts(self, per_replica=False):
+        """Live compiled-program count per model (summed across its
+        replicas; ``per_replica=True`` keys by replica label) — the
+        per-engine zero-steady-state-recompile gate, gateway edition."""
         with self._lock:
-            return {n: m.slots.xla_program_count()
+            if per_replica:
+                return {rep.label: rep.slots.xla_program_count()
+                        for m in self._models.values()
+                        for rep in m.replicas}
+            return {n: sum(rep.slots.xla_program_count()
+                           for rep in m.replicas)
                     for n, m in self._models.items()}
 
     # -- admission ----------------------------------------------------------
@@ -526,8 +665,9 @@ class Gateway:
             dispatched = self._dispatch(now)
             stepped = False
             for m in self._models.values():
-                if m.live or not m.sched.idle:
-                    stepped |= bool(m.sched.step())
+                for rep in m.replicas:
+                    if rep.live or not rep.sched.idle:
+                        stepped |= bool(rep.sched.step())
             pumped = self._pump(time.monotonic())
         return bool(expired or dispatched or stepped or pumped)
 
@@ -550,30 +690,38 @@ class Gateway:
                 n += 1
         return n
 
+    def _rep_capacity(self, rep):
+        """Slots this replica can still absorb this step: free slots
+        minus work already staged in its engine queue (the engine
+        admits those first)."""
+        return rep.sched.free_slots - rep.sched.queue_depth
+
     def _capacity(self, m):
-        """Slots this model can still absorb this step: free slots minus
-        work already staged in its engine queue (the engine admits those
-        first)."""
-        return m.sched.free_slots - m.sched.queue_depth
+        """Best replica headroom for `m` (the model can dispatch if ANY
+        replica can)."""
+        return max(self._rep_capacity(rep) for rep in m.replicas)
 
     def _pick_victim(self, m, tier):
-        """Lowest-priority / least-progressed running request on `m`
-        with a tier strictly below `tier`, or None."""
+        """Lowest-priority / least-progressed running request across
+        `m`'s replicas with a tier strictly below `tier`, as
+        ``(replica, request)`` — ``(None, None)`` when nothing is
+        preemptable."""
         best = None
-        for r in m.live:
-            seg = r._segment
-            if seg is None or seg.slot is None or r.tier <= tier:
-                continue
-            key = (-r.tier, len(r.tokens), -r.id)
-            if best is None or key < best[0]:
-                best = (key, r)
-        return None if best is None else best[1]
+        for rep in m.replicas:
+            for r in rep.live:
+                seg = r._segment
+                if seg is None or seg.slot is None or r.tier <= tier:
+                    continue
+                key = (-r.tier, len(r.tokens), -r.id)
+                if best is None or key < best[0]:
+                    best = (key, rep, r)
+        return (None, None) if best is None else (best[1], best[2])
 
     def _can_dispatch(self, req, now):
         m = self._models[req.model]
         if self._capacity(m) <= 0:
             if not (self.preempt_enabled
-                    and self._pick_victim(m, req.tier) is not None):
+                    and self._pick_victim(m, req.tier)[1] is not None):
                 return False
         if not req._charged:
             t = self._tenants[req.tenant]
@@ -598,28 +746,40 @@ class Gateway:
 
     def _do_dispatch(self, req, tier_idx, now):
         m = self._models[req.model]
-        if self._capacity(m) <= 0 and self.preempt_enabled:
-            victim = self._pick_victim(m, tier_idx)
+        prompt = req.prompt if req._resume_prompt is None \
+            else req._resume_prompt
+        # route: affinity (warm prefix pages — a resumed preemptee's
+        # registered KV naturally pulls it back to its old replica),
+        # then least-loaded among replicas with capacity
+        rep = m.router.pick(m.replicas, prompt=prompt, tenant=req.tenant,
+                            viable=lambda r: self._rep_capacity(r) > 0)
+        if rep is None and self.preempt_enabled:
+            vrep, victim = self._pick_victim(m, tier_idx)
             if victim is not None:
-                self._preempt_one(m, victim, now)
+                self._preempt_one(vrep, victim, now)
+                rep = vrep
+        if rep is None:               # _can_dispatch said yes; be loud
+            raise RuntimeError(
+                f"gateway: no dispatchable replica for model "
+                f"{req.model!r} (this is a bug — please report)")
         t = self._tenants[req.tenant]
         if not req._charged:
             t.bucket.try_debit(req.est_cost, now)   # checked in _can_dispatch
             req._charged = True
-        prompt = req.prompt if req._resume_prompt is None \
-            else req._resume_prompt
         deadline_s = None if req.deadline is None \
             else max(req.deadline - now, 1e-6)
-        seg = m.sched.submit(prompt, req._remaining,
-                             temperature=req.temperature,
-                             eos_id=req.eos_id, deadline_s=deadline_s,
-                             parent_span=req._spans.get("request", _NULL))
+        seg = rep.sched.submit(prompt, req._remaining,
+                               temperature=req.temperature,
+                               eos_id=req.eos_id, deadline_s=deadline_s,
+                               parent_span=req._spans.get("request", _NULL))
         req._segment = seg
+        req.replica = rep.label
         req.state = "dispatched"
         req._spans.pop("admit", _NULL).annotate(
-            engine_request=seg.id, resumed=req._resume_prompt is not None,
+            engine_request=seg.id, replica=rep.label,
+            resumed=req._resume_prompt is not None,
             preemptions=req.preemptions).close()
-        m.live.append(req)
+        rep.live.append(req)
         t.dispatched += 1
         registry.counter(
             "mx_gateway_dispatch_total",
@@ -627,24 +787,27 @@ class Gateway:
             "included)",
             labels={"model": req.model, "priority": req.priority}).inc()
 
-    def _preempt_one(self, m, victim, now):
-        """Evict `victim`'s slot for a higher-tier arrival and re-queue
-        its remaining work (tokens survive; resident page-aligned KV
-        stays warm in the prefix cache)."""
+    def _preempt_one(self, rep, victim, now):
+        """Evict `victim`'s slot (on replica `rep`) for a higher-tier
+        arrival and re-queue its remaining work (tokens survive;
+        resident page-aligned KV stays warm in THAT replica's prefix
+        cache — prefix affinity later resumes it there)."""
         seg = victim._segment
         self._drain_segment(victim, seg, now)
-        m.sched.preempt(seg.slot, now)
-        m.live.remove(victim)
+        rep.sched.preempt(seg.slot, now)
+        rep.live.remove(victim)
         victim._segment = None
         gen = onp.asarray(victim.tokens, onp.int32)
         victim._resume_prompt = onp.concatenate([victim.prompt, gen])
         victim._remaining = victim.max_new - len(victim.tokens)
         victim.preemptions += 1
         victim.state = "queued"
+        victim.replica = None
         self.preemptions_total += 1
         self._tenants[victim.tenant].preempted += 1
         tracing.event("gateway.preempt", request=victim.id,
-                      model=m.name, tenant=victim.tenant,
+                      model=rep.model, replica=rep.label,
+                      tenant=victim.tenant,
                       priority=victim.priority,
                       preemptions=victim.preemptions,
                       tokens_kept=len(victim.tokens))
@@ -675,24 +838,26 @@ class Gateway:
         errors propagate with their own class)."""
         moved = 0
         for m in self._models.values():
-            for req in list(m.live):
-                seg = req._segment
-                if seg is None:
-                    m.live.remove(req)
-                    continue
-                moved += self._drain_segment(req, seg, now)
-                if not seg.done:
-                    continue
-                m.live.remove(req)
-                req._segment = None
-                t = self._tenants[req.tenant]
-                if seg.error is not None:
-                    req._fail(seg.error, now)
-                else:
-                    t.bucket.credit(req.est_cost - int(req.prompt.size)
-                                    - len(req.tokens))
-                    req._finish(now)
-                moved += 1
+            for rep in m.replicas:
+                for req in list(rep.live):
+                    seg = req._segment
+                    if seg is None:
+                        rep.live.remove(req)
+                        continue
+                    moved += self._drain_segment(req, seg, now)
+                    if not seg.done:
+                        continue
+                    rep.live.remove(req)
+                    req._segment = None
+                    t = self._tenants[req.tenant]
+                    if seg.error is not None:
+                        req._fail(seg.error, now)
+                    else:
+                        t.bucket.credit(req.est_cost
+                                        - int(req.prompt.size)
+                                        - len(req.tokens))
+                        req._finish(now)
+                    moved += 1
         return moved
 
     # -- driving ------------------------------------------------------------
@@ -804,6 +969,43 @@ class Gateway:
 
     # -- lifecycle ----------------------------------------------------------
 
+    def hot_swap(self, model=None):
+        """Roll refreshed weights across serving replicas ONE AT A
+        TIME, drain-free.
+
+        After the source block's parameters are updated in place
+        (``set_data`` / an optimizer step), each engine's
+        param-fingerprint auto-refresh would pick the change up lazily
+        at its next program entry; this makes the roll explicit and
+        STAGGERED: the gateway lock is taken per replica and released
+        between them, so the driver keeps stepping the other replicas
+        while one re-reads (and, for sharded engines, re-places onto
+        its mesh) its weights. In-flight requests keep their slots and
+        KV — decode simply continues under the new weights. Returns
+        ``{replica_label: changed}``."""
+        with self._lock:
+            if model is not None and model not in self._models:
+                raise ValueError(
+                    f"unknown model {model!r} (registered: "
+                    f"{', '.join(sorted(self._models))})")
+            groups = [self._models[model]] if model is not None \
+                else list(self._models.values())
+            reps = [rep for g in groups for rep in g.replicas]
+        out = {}
+        for rep in reps:
+            with self._lock:
+                slots = rep.slots
+                dec = getattr(slots, "_dec", None)
+                before = getattr(dec, "_param_ids", None)
+                if hasattr(slots, "_refresh_params"):
+                    slots._refresh_params()
+                changed = (dec is not None
+                           and getattr(dec, "_param_ids", None) != before)
+                out[rep.label] = changed
+            tracing.event("gateway.hot_swap", replica=rep.label,
+                          changed=changed)
+        return out
+
     def shutdown(self, drain=True, timeout=None):
         """Stop the gateway. ``drain=True`` finishes dispatched work;
         gateway-queued (never-dispatched) requests fail with
@@ -819,19 +1021,22 @@ class Gateway:
                         f"gateway shut down before request {req.id} was "
                         "dispatched"), now)
             for m in self._models.values():
-                m.sched.close(drain=drain)
+                for rep in m.replicas:
+                    rep.sched.close(drain=drain)
             self._pump(now)
         if drain:
             t_end = None if timeout is None else time.monotonic() + timeout
             while True:
                 with self._lock:
-                    busy = any(m.sched.n_active
-                               for m in self._models.values())
+                    busy = any(rep.sched.n_active
+                               for m in self._models.values()
+                               for rep in m.replicas)
                     if busy:
                         if not self._driver_running():
                             for m in self._models.values():
-                                if m.sched.n_active:
-                                    m.sched.step()
+                                for rep in m.replicas:
+                                    if rep.sched.n_active:
+                                        rep.sched.step()
                             self._pump(time.monotonic())
                 if not busy:
                     break
@@ -844,8 +1049,9 @@ class Gateway:
         with self._lock:
             self._pump(time.monotonic())
             for m in self._models.values():
-                m.sched.slots.prefix_cache.clear()
-                m.sched.slots.release()
+                for rep in m.replicas:
+                    rep.sched.slots.prefix_cache.clear()
+                    rep.sched.slots.release()
 
     def __enter__(self):
         return self

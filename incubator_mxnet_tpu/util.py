@@ -258,6 +258,12 @@ _ENV_KNOBS = {
         "asserts the ledger accounts >=98% of wall time with nonzero "
         "reshard/recovery); 0 = skip; unset = runs only in the spawned "
         "dryrun child (honored, this build's addition)"),
+    "MXNET_DRYRUN_SHARDED_SERVE": (
+        "__graft_entry__ dryrun_multichip", "1 = force the "
+        "sharded-serving subphase (2x tp replica meshes: greedy parity "
+        "vs the unsharded engine, clean shardcheck, pool aliasing, "
+        "gateway hot-swap); 0 = skip; unset = runs only in the spawned "
+        "dryrun child (honored, this build's addition)"),
     "MXNET_GOODPUT": (
         "telemetry.goodput", "1 = arm the training goodput ledger alone "
         "(lease seams in estimator/dataloader/checkpoint/elastic, "
@@ -356,6 +362,22 @@ _ENV_KNOBS = {
         "rate[:burst] tokens/s (burst defaults to 4x rate); unset/0 = "
         "unmetered — over-quota tenants are deferred, never dropped "
         "(honored, this build's addition)"),
+    "MXNET_SERVE_MESH": (
+        "serve.sharded.serve_mesh", "default device mesh for sharded "
+        "decode replicas as axis=size pairs (\"tp=4\" or \"fsdp=2,tp=4\") "
+        "or a bare int meaning tp=N; unset = single-device engines "
+        "(honored, this build's addition — see SERVING.md)"),
+    "MXNET_SERVE_REPLICAS": (
+        "serve.ModelRegistry", "decode replicas per registered model "
+        "behind the gateway router (default 1); each replica owns its "
+        "own mesh slice, KV pool, and prefix cache (honored, this "
+        "build's addition — see SERVING.md)"),
+    "MXNET_SERVE_AFFINITY": (
+        "serve.ReplicaRouter", "replica-routing affinity: prefix "
+        "(default, route to the replica whose prefix cache scores the "
+        "warmest match), tenant (stable hash of the tenant id), or off "
+        "(pure least-loaded) (honored, this build's addition — see "
+        "SERVING.md)"),
     "MXNET_GATEWAY_MAX_QUEUE": (
         "serve.Gateway", "gateway admission bound across all priority "
         "tiers before submit() raises QueueFull (default 256) (honored, "

@@ -144,6 +144,13 @@ _QUICK = {
     "test_kernels.py::test_goodput_waterfall_renders_fixture",
     "test_kernels.py::test_kernelscope_demo_renders",
     "test_tools.py::test_fl016_tree_is_clean",
+    # pod-scale sharded serving (ISSUE 15 gates): layout rule coverage,
+    # 1-device-mesh parity with the unsharded engine, replica routing,
+    # and the FL017 placement-provenance tree sweep — all host/CPU-mesh
+    "test_sharded_serve.py::test_every_param_leaf_matches_exactly_one_rule",
+    "test_sharded_serve.py::test_one_device_mesh_greedy_parity",
+    "test_sharded_serve.py::test_router_prefers_warm_prefix_replica",
+    "test_tools.py::test_fl017_tree_is_clean",
 }
 
 

@@ -798,6 +798,79 @@ def test_fl016_tree_is_clean():
 
 
 # ---------------------------------------------------------------------------
+# framework_lint FL017 — serve/ placement-spec provenance (ISSUE 15)
+# ---------------------------------------------------------------------------
+
+_SERVE_PATH = "incubator_mxnet_tpu/serve/sharded.py"
+
+
+def _lint_src(src, path):
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import framework_lint
+    finally:
+        sys.path.pop(0)
+    return framework_lint.lint_source(src, path)
+
+
+def test_fl017_flags_bare_spec_literals_at_placement_sites():
+    src = ("import jax\n"
+           "from jax.sharding import NamedSharding, PartitionSpec as P\n"
+           "def place(x, mesh):\n"
+           "    return jax.device_put(x, NamedSharding(mesh, P('tp')))\n"
+           "def pin(x, mesh):\n"
+           "    return jax.lax.with_sharding_constraint(\n"
+           "        x, NamedSharding(mesh, P(None, 'tp')))\n")
+    hits = [f for f in _lint_src(src, _SERVE_PATH) if f.rule == "FL017"]
+    assert len(hits) == 2
+    assert "ServeLayout" in hits[0].message
+    assert {h.line for h in hits} == {4, 6}
+
+
+def test_fl017_accepts_layout_derived_noqa_and_scoping():
+    # specs flowing through a layout: clean
+    good = ("import jax\n"
+            "def place(x, layout, path):\n"
+            "    s = layout.sharding(layout.spec_for(path))\n"
+            "    return jax.device_put(x, s)\n")
+    assert not [f for f in _lint_src(good, _SERVE_PATH)
+                if f.rule == "FL017"]
+    # noqa escape with a reason
+    noqa = ("import jax\n"
+            "from jax.sharding import NamedSharding as NS\n"
+            "def stage(x, mesh, p):\n"
+            "    return jax.device_put(x, NS(mesh, p))  "
+            "# noqa: FL017 — host staging, layout-free\n")
+    assert not [f for f in _lint_src(noqa, _SERVE_PATH)
+                if f.rule == "FL017"]
+    # keyword form is still caught
+    kw = ("import jax\n"
+          "from jax.sharding import PartitionSpec\n"
+          "def f(x):\n"
+          "    return jax.device_put(x, device=PartitionSpec('tp'))\n")
+    assert [f for f in _lint_src(kw, _SERVE_PATH) if f.rule == "FL017"]
+    # outside serve/ the rule is silent (parallel/ owns its own idiom)
+    bad = ("import jax\n"
+           "from jax.sharding import PartitionSpec\n"
+           "def f(x):\n"
+           "    return jax.device_put(x, PartitionSpec('tp'))\n")
+    assert not [f for f in _lint_src(
+        bad, "incubator_mxnet_tpu/parallel/mesh.py") if f.rule == "FL017"]
+
+
+def test_fl017_tree_is_clean():
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import framework_lint
+    finally:
+        sys.path.pop(0)
+    findings = [f for f in framework_lint.lint_paths(
+        [os.path.join(REPO, "incubator_mxnet_tpu")])
+        if f.rule == "FL017"]
+    assert not findings, findings
+
+
+# ---------------------------------------------------------------------------
 # bench_regress — trajectory regression gate (ISSUE 10)
 # ---------------------------------------------------------------------------
 

@@ -150,6 +150,16 @@ def direction(metric):
         return "higher"
     if metric == "train_goodput_frac":
         return "higher"
+    if metric.startswith("gpt_serve_sharded_"):
+        # forced-CPU 8-device child (bench.py --serve-sharded-only):
+        # wall rates measure 1 vCPU driving a virtual mesh, not the
+        # chip — layout evidence, report-only. The exception is the
+        # static per-token collective traffic read from the decode
+        # program's HLO: a layout change that re-materializes sharded
+        # operands on the hot path must gate.
+        if metric.endswith("_collective_bytes_per_token"):
+            return "lower"
+        return None
     if metric != "vs_baseline" and "_vs_" in metric:
         return None
     if "overhead" in metric:

@@ -163,6 +163,20 @@ FL016  telemetry series index (scoped to ``incubator_mxnet_tpu/``
        reads it), or — for a genuinely private/test-scaffolding series
        — annotate the line with ``# noqa: FL016`` and the justifying
        comment.
+FL017  serve/ placement-spec provenance (scoped to ``serve/``
+       modules): a ``device_put`` / ``with_sharding_constraint`` call
+       whose sharding argument is a direct ``PartitionSpec`` /
+       ``NamedSharding`` constructor call. Pod-scale serving places
+       params and KV pools via the `serve.sharded.ServeLayout` rule
+       table — ONE audited source of truth that shardcheck, the
+       hot-swap path, and the replica builder all share. An inline
+       spec literal at a placement site is a second, unaudited layout
+       opinion: it drifts from the rule table silently and the
+       SC001/SC004 pre-flight never sees it. Derive the sharding from
+       a layout (``layout.sharding(layout.spec_for(...))``,
+       ``pool_spec()``, ...) or — for genuinely layout-free plumbing
+       (host staging buffers, tests) — annotate the line with
+       ``# noqa: FL017`` and the justifying comment.
 
 Usage
 -----
@@ -243,6 +257,13 @@ RULES = {
              "from TELEMETRY.md — document the series (what it "
              "measures, labels, who reads it), or `# noqa: FL016` with "
              "a reason",
+    "FL017": "serve/ placement-spec provenance: device_put/"
+             "with_sharding_constraint handed a bare PartitionSpec/"
+             "NamedSharding literal — serving placements must flow "
+             "from the ServeLayout rule table (the audited source of "
+             "truth shardcheck pre-flights), not inline spec opinions; "
+             "derive via layout.sharding/spec_for/pool_spec, or "
+             "`# noqa: FL017` with a reason",
 }
 
 _INDEXING_NAME_PARTS = ("getitem", "setitem", "index", "slice")
@@ -900,6 +921,50 @@ def _check_sharding_hygiene(tree, path, findings):
 
 
 # ---------------------------------------------------------------------------
+# FL017 — serve/ placement-spec provenance
+# ---------------------------------------------------------------------------
+
+_PLACEMENT_CALLS = ("device_put", "with_sharding_constraint")
+
+
+def _check_placement_provenance(tree, path, findings, src_lines):
+    norm = path.replace(os.sep, "/")
+    if "/serve/" not in norm:
+        return
+    aliases = _spec_ctor_aliases(tree)
+
+    def noqa(lineno):
+        line = src_lines[lineno - 1] if lineno - 1 < len(src_lines) else ""
+        return "noqa: FL017" in line
+
+    def spec_ctor(node):
+        return isinstance(node, ast.Call) and _call_name(node) in aliases
+
+    for node in ast.walk(tree):
+        if (not isinstance(node, ast.Call)
+                or _call_name(node) not in _PLACEMENT_CALLS):
+            continue
+        # the sharding operand: 2nd positional, or the keyword forms
+        # jax uses (device_put(x, device=...), wsc(x, shardings=...))
+        cand = node.args[1] if len(node.args) >= 2 else None
+        if cand is None:
+            for kw in node.keywords:
+                if kw.arg in ("device", "shardings", "sharding"):
+                    cand = kw.value
+                    break
+        if cand is None or not spec_ctor(cand) or noqa(node.lineno):
+            continue
+        findings.append(LintFinding(
+            path, node.lineno, "FL017",
+            f"`{_call_name(node)}` handed a bare `{_call_name(cand)}` "
+            "literal — serve/ placements must derive their specs from "
+            "the ServeLayout rule table (layout.sharding/spec_for/"
+            "pool_spec), the one layout shardcheck pre-flights; an "
+            "inline spec is a second unaudited layout opinion, or "
+            "`# noqa: FL017` with a reason"))
+
+
+# ---------------------------------------------------------------------------
 # FL009 — paged-serving hazards (serve/ modules only)
 # ---------------------------------------------------------------------------
 
@@ -1335,6 +1400,7 @@ def lint_source(src, path, coverage_text=None, telemetry_text=None):
     _check_observatory_coverage(tree, path, findings, src.splitlines())
     _check_pool_aliasing(tree, path, findings, src.splitlines())
     _check_sharding_hygiene(tree, path, findings)
+    _check_placement_provenance(tree, path, findings, src.splitlines())
     _check_paged_hazards(tree, path, findings)
     _check_span_hygiene(tree, path, findings)
     _check_collective_hygiene(tree, path, findings, src.splitlines())
