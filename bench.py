@@ -1177,6 +1177,49 @@ def bench_gpt_serve_traced(requests=12, max_slots=4, prompt_max=48,
     return on_tok_s, off_tok_s, overhead_pct
 
 
+def bench_gpt_serve_lockwitness(requests=12, max_slots=4, prompt_max=48,
+                                new_max=48, mean_interarrival_s=0.02,
+                                seed=0):
+    """Lock-order-witness cost on the serving hot path (ANALYSIS.md
+    §racecheck): the SAME reduced serve trace twice, witness disarmed
+    then armed, adjacent runs (the `bench_gpt_serve_traced`
+    methodology). Arming must happen BEFORE the on-leg constructs its
+    engine — `tracked_lock` decides raw-vs-instrumented at the factory,
+    so the off-leg's engine lock is the raw primitive (zero overhead by
+    construction) and the on-leg's is tracked. The armed leg must also
+    finish with zero witnessed RC005 inversions — this doubles as the
+    under-load clean gate. Returns (tokens/s armed, tokens/s disarmed,
+    overhead %)."""
+    from incubator_mxnet_tpu.telemetry import locks
+
+    kw = dict(requests=requests, max_slots=max_slots,
+              prompt_max=prompt_max, new_max=new_max,
+              mean_interarrival_s=mean_interarrival_s, seed=seed)
+    assert not locks.is_enabled(), \
+        "witness already armed: the off-leg would measure the on-path"
+    off_tok_s = bench_gpt_serve(**kw)[0]
+    locks.enable()
+    locks.reset()
+    try:
+        on_tok_s = bench_gpt_serve(**kw)[0]
+        inversions = locks.inversions()
+        tracked = [n for n in locks.known_locks()
+                   if n.startswith("serve.")]
+    finally:
+        locks.reset()
+        locks.disable()
+    if not tracked:
+        raise RuntimeError(
+            "armed serve run tracked no serve.* locks — the engine "
+            "lock did not go through tracked_lock")
+    if inversions:
+        raise RuntimeError(
+            f"armed serve run witnessed lock-order inversions: "
+            f"{[i['pair'] for i in inversions]}")
+    overhead_pct = (off_tok_s - on_tok_s) / off_tok_s * 100.0
+    return on_tok_s, off_tok_s, overhead_pct
+
+
 def bench_collective_overhead(n=256, iters=40, warmup=5, rounds=2):
     """Fleet-telemetry cost on a jitted collective step: the SAME
     shard_map program (wrapper all_reduce + ring_permute over the local
@@ -1381,6 +1424,16 @@ def _collect_serve_extras(extras, _retry, _fail):
         extras["gpt_serve_tracing_overhead_pct"] = round(ovh, 2)
     except Exception as e:  # pragma: no cover
         _fail("gpt_serve_traced", e)
+    try:
+        won, woff, wovh = _retry(bench_gpt_serve_lockwitness)
+        # lock-order-witness cost on the serving hot path (ANALYSIS.md
+        # §racecheck): same reduced trace, witness disarmed then armed;
+        # the armed leg also gates zero RC005 inversions under load
+        extras["gpt_serve_lockwitness_tokens_s"] = round(won, 1)
+        extras["gpt_serve_unwitnessed_tokens_s"] = round(woff, 1)
+        extras["gpt_serve_lockwitness_overhead_pct"] = round(wovh, 2)
+    except Exception as e:  # pragma: no cover
+        _fail("gpt_serve_lockwitness", e)
     try:
         coff, con, covh = _retry(bench_collective_overhead)
         # fleet collective-wrapper cost (TELEMETRY.md §fleet): same

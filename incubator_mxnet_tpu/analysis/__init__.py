@@ -16,14 +16,26 @@ sharding/partition-spec pre-flight (rules SC001-SC006) that validates a
 program's PartitionSpec layout against a simulated mesh before any pod
 job launches (see analysis/shardcheck.py and ANALYSIS.md).
 
+The concurrency companion is `mx.analysis.racecheck_report` — a static
+lock/shared-state pass (rules RC001-RC004) over the host control plane
+(serve/ fault/ telemetry/ parallel/) plus the runtime lock-order witness
+in `telemetry/locks.py` (RC005); see analysis/racecheck.py and
+ANALYSIS.md.
+
 Env knobs: ``MXNET_ANALYSIS=warn|raise``, ``MXNET_SHARDCHECK=warn|raise``,
-``MXNET_SHARDCHECK_HBM_GB`` (see `util.env_knobs()`).
+``MXNET_SHARDCHECK_HBM_GB``, ``MXNET_RACECHECK=warn|raise`` (see
+`util.env_knobs()`).
 """
 from .auditor import audit, jit_cache_report  # noqa: F401
-from .findings import (HAZARD_KINDS, SHARD_RULES, AuditReport,  # noqa: F401
-                       Finding, ShardFinding, ShardReport)
+from .findings import (HAZARD_KINDS, RACE_RULES, SHARD_RULES,  # noqa: F401
+                       AuditReport, Finding, RaceFinding, RaceReport,
+                       ShardFinding, ShardReport)
+from .racecheck import (racecheck_paths, racecheck_report,  # noqa: F401
+                        racecheck_source, runtime_report)
 from .shardcheck import shardcheck  # noqa: F401
 
 __all__ = ["audit", "jit_cache_report", "AuditReport", "Finding",
            "HAZARD_KINDS", "shardcheck", "ShardReport", "ShardFinding",
-           "SHARD_RULES"]
+           "SHARD_RULES", "racecheck_report", "racecheck_paths",
+           "racecheck_source", "runtime_report", "RaceReport",
+           "RaceFinding", "RACE_RULES"]

@@ -17,7 +17,8 @@ Severity contract:
 from __future__ import annotations
 
 __all__ = ["Finding", "AuditReport", "HAZARD_KINDS",
-           "ShardFinding", "ShardReport", "SHARD_RULES"]
+           "ShardFinding", "ShardReport", "SHARD_RULES",
+           "RaceFinding", "RaceReport", "RACE_RULES"]
 
 # The hazard classes the auditor knows about (ANALYSIS.md documents each).
 HAZARD_KINDS = (
@@ -193,6 +194,97 @@ class ShardReport(AuditReport):
             for op, rec in sorted(self.collectives.items()):
                 lines.append(f"    {op:<20} x{rec['count']:<3} "
                              f"~{rec['bytes'] / 2**20:.2f} MiB moved")
+        return "\n".join(lines)
+
+    __repr__ = summary
+
+
+# ---------------------------------------------------------------------------
+# Concurrency findings (racecheck — see analysis/racecheck.py)
+# ---------------------------------------------------------------------------
+
+# Rule catalogue for the host-control-plane concurrency pass. RC001-RC004
+# come from the static tier (AST dataflow over serve//fault//telemetry//
+# parallel/); RC005 is witnessed at runtime by the telemetry/locks.py
+# instrumented-lock registry. ANALYSIS.md documents each with its
+# seeded-defect fixture.
+RACE_RULES = {
+    "RC001": "unguarded shared write: state reachable from >1 thread "
+             "mutated outside any lock scope",
+    "RC002": "read-check-act without the guarding lock: test and mutation "
+             "of shared state can interleave with a peer thread",
+    "RC003": "static lock-order cycle: two code paths acquire the same "
+             "locks in opposite orders (potential deadlock)",
+    "RC004": "blocking call (.join()/.get()/collective/long sleep) while "
+             "holding a lock",
+    "RC005": "runtime-witnessed lock-order inversion (cycle in the "
+             "tracked-lock acquisition graph, even without a hang)",
+}
+
+
+class RaceFinding(Finding):
+    """One concurrency hazard: a Finding whose ``kind`` is an RC rule id,
+    carrying the attribute/lock pair and the witness path(s) that let a
+    reader reproduce the interleaving."""
+
+    __slots__ = ("state", "lock", "witness")
+
+    def __init__(self, rule, message, severity="warn", site=None,
+                 state=None, lock=None, witness=None):
+        super().__init__(rule, message, severity=severity, site=site)
+        self.state = state          # the attribute / global at stake
+        self.lock = lock            # the lock (pair) involved, if any
+        self.witness = tuple(witness or ())   # human-readable path lines
+
+    @property
+    def rule(self):
+        return self.kind
+
+
+class RaceReport(AuditReport):
+    """Findings from one `racecheck_report()` call (static tier over a
+    file set, plus any runtime-tier RC005 witnesses folded in)."""
+
+    def __init__(self, target_name):
+        super().__init__(target_name)
+        self.n_files = 0
+        self.n_entry_points = 0      # thread entry points discovered
+        self.n_shared = 0            # shared attributes/globals mapped
+        self.lock_graph = {}         # (lock_a, lock_b) -> witness line
+        self.tiers = []              # which tiers contributed ("static",
+                                     # "runtime")
+
+    def add_rule(self, rule, message, severity="warn", site=None,
+                 state=None, lock=None, witness=None):
+        assert rule in RACE_RULES, rule
+        self.add(RaceFinding(rule, message, severity=severity, site=site,
+                             state=state, lock=lock, witness=witness))
+
+    def by_rule(self, rule):
+        return self.by_kind(rule)
+
+    def stamp(self):
+        """One-line machine-greppable summary (the dryrun meta-gate and
+        `tools/racecheck.py --tree` both emit this)."""
+        rules = ",".join(sorted({f.kind for f in self.findings})) or "none"
+        return (f"racecheck[{self.target_name}] "
+                f"findings={len(self.findings)} rules={rules} "
+                f"files={self.n_files} shared={self.n_shared} "
+                f"lock_edges={len(self.lock_graph)}")
+
+    def summary(self):
+        head = (f"racecheck({self.target_name}): {len(self.findings)} "
+                f"finding(s) | {self.n_files} file(s), "
+                f"{self.n_entry_points} thread entry point(s), "
+                f"{self.n_shared} shared attr(s), "
+                f"{len(self.lock_graph)} lock-order edge(s)"
+                + (f" | tiers: {'+'.join(self.tiers)}" if self.tiers
+                   else ""))
+        lines = [head]
+        for f in self._all:
+            lines.append(f"  {f!r}")
+            for w in getattr(f, "witness", ()):
+                lines.append(f"      {w}")
         return "\n".join(lines)
 
     __repr__ = summary

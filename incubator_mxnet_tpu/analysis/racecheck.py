@@ -1,0 +1,1001 @@
+"""Concurrency correctness analyzer for the host control plane.
+
+PRs 8-14 made the host side genuinely concurrent — serve/gateway driver
+threads, preemption, drain-free hot_swap, telemetry dump/memwatch
+daemons, excepthook flight fanout — while the analysis subsystem only
+audited *device programs*. This pass audits the threads that schedule
+them, in the shardcheck mold: find the defect before the unlucky
+interleaving does.
+
+Two cooperating tiers (ANALYSIS.md has the full model):
+
+**Static tier** (this module; pure AST over ``serve/ fault/ telemetry/
+parallel/``). Not a line lint: it builds
+
+- a *thread-entry map* — functions that run off the main thread
+  (``threading.Thread(target=...)``, ``sys.excepthook``/signal/atexit
+  handlers, pull-gauge/collector/flight-context probes) plus their
+  intra-module call closure;
+- a *shared-state map* — ``self._*`` attributes and module-level
+  mutables reachable from more than one thread root;
+- a *lock model* — which ``with <lock>`` scope guards each access,
+  including a caller-holds-lock propagation (a ``_private`` function
+  whose every call site holds lock L is guarded by L — iterated to a
+  small fixpoint) and the documented contract escape (a class/function
+  docstring saying the *caller holds its lock* is treated as a held
+  contract lock, e.g. ``serve.Scheduler``);
+- a *static lock-order graph* from nested acquisitions (one call level
+  deep), whose cycles are potential deadlocks.
+
+Rules:
+
+- **RC001** unguarded shared write — mutation of shared state outside
+  any lock scope;
+- **RC002** read-check-act without the guarding lock — ``if
+  self._free: self._free.pop()`` style test+mutate pairs that a peer
+  thread can interleave;
+- **RC003** static lock-order cycle (both witness paths named);
+- **RC004** blocking call (``.join()``, queue ``.get()``, collective,
+  ``time.sleep`` ≥ ``MXNET_RACECHECK_SLEEP_S``) while holding a lock.
+
+**Runtime tier** (`telemetry/locks.py`): tracked locks witness the
+acquisition orders that actually happen; a cycle in the runtime graph is
+**RC005** even if nothing ever hung. `runtime_report()` folds those
+witnesses into the same report shape.
+
+Suppressions: ``# noqa: RC00x`` on the offending line (comment the
+reason), or the docstring contract above. Every finding increments
+``mx_racecheck_findings_total{rule=}``; ``MXNET_RACECHECK=warn|raise``
+logs or raises on a dirty report (same semantics as MXNET_ANALYSIS).
+"""
+from __future__ import annotations
+
+import ast
+import logging
+import os
+
+from .. import util
+from ..base import MXNetError
+from .findings import RACE_RULES, RaceReport  # noqa: F401
+
+__all__ = ["racecheck_report", "racecheck_paths", "racecheck_source",
+           "runtime_report", "DEFAULT_SUBDIRS"]
+
+_LOG = logging.getLogger("mxnet.analysis")
+
+DEFAULT_SUBDIRS = ("serve", "fault", "telemetry", "parallel")
+
+# threading factory names (raw or tracked) whose result is a lock object
+_LOCK_FACTORIES = {"Lock", "RLock", "Condition", "tracked_lock"}
+# self-synchronized objects: mutating them needs no external lock
+_SYNC_FACTORIES = {"Event", "Queue", "SimpleQueue", "LifoQueue",
+                   "PriorityQueue", "Semaphore", "BoundedSemaphore",
+                   "Barrier"}
+# container methods that mutate the receiver in place
+_MUTATORS = {"append", "appendleft", "add", "remove", "discard", "clear",
+             "update", "extend", "insert", "pop", "popleft", "popitem",
+             "setdefault", "rotate"}
+# call names that are cross-host collectives (blocking by design)
+_COLLECTIVES = {"barrier", "allreduce", "all_reduce", "allgather",
+                "all_gather", "broadcast", "psum", "pmean", "all_to_all"}
+# registrar calls whose function argument becomes a cross-thread probe
+_PROBE_REGISTRARS = {"register_pull_gauge", "register_collector",
+                     "register_flight_context"}
+
+_CONTRACT_MARKERS = ("caller holds", "callers hold", "racecheck: "
+                     "caller-holds-lock")
+
+
+def _sleep_threshold_s():
+    return util.env_float("MXNET_RACECHECK_SLEEP_S", 0.05)
+
+
+# ---------------------------------------------------------------------------
+# per-function facts
+# ---------------------------------------------------------------------------
+
+class _Func:
+    """Everything the cross-function phase needs to know about one
+    function: state accesses with the lexically-held lock set, lock
+    acquisitions, resolvable calls, spawned entry points."""
+
+    def __init__(self, qname, node, cls=None):
+        self.qname = qname
+        self.node = node
+        self.cls = cls                  # enclosing class name or None
+        self.accesses = set()           # state ids touched (read or write)
+        self.writes = []                # (state, line, frozenset(held), how)
+        self.rc002 = []                 # (state, line, frozenset(held))
+        self.blocking = []              # (desc, line, frozenset(held), recv)
+        self.acquires = []              # lock ids acquired anywhere in body
+        self.edges = []                 # (lock_a, lock_b, line) lexical
+        self.calls = []                 # (kind, name, frozenset(held), line)
+        self.inherited = frozenset()    # caller-holds locks (fixpoint)
+        self.contract = False           # docstring caller-holds-lock
+        self.is_entry = False           # runs on a non-main thread
+        self.roots = set()              # which thread roots reach it
+
+
+def _docstring_contract(node):
+    doc = ast.get_docstring(node) or ""
+    low = doc.lower()
+    return any(m in low for m in _CONTRACT_MARKERS)
+
+
+def _const_store(value):
+    """True for atomic flag publishes (= True/False/None/number/str):
+    a single STORE_GLOBAL/STORE_ATTR of an immutable is not a data race
+    under the GIL — read-check-act on it still is (RC002 covers that)."""
+    return isinstance(value, ast.Constant)
+
+
+def _dotted(expr):
+    """Best-effort dotted-name text for receiver classification."""
+    parts = []
+    while isinstance(expr, ast.Attribute):
+        parts.append(expr.attr)
+        expr = expr.value
+    if isinstance(expr, ast.Name):
+        parts.append(expr.id)
+    return ".".join(reversed(parts))
+
+
+class _ModuleFacts:
+    """One analyzed source file: function index, lock table, globals."""
+
+    def __init__(self, path, src):
+        self.path = path
+        self.base = os.path.splitext(os.path.basename(path))[0]
+        self.src_lines = src.splitlines()
+        self.tree = ast.parse(src, filename=path)
+        self.funcs = {}                 # qname -> _Func
+        self.classes = {}               # cls -> [qnames]
+        self.module_locks = set()       # global names bound to locks
+        self.class_locks = {}           # cls -> set of self attr names
+        self.module_sync = set()        # globals bound to Event/Queue/...
+        self.class_sync = {}            # cls -> self-synchronized attrs
+        self.mutable_globals = set()    # module-level mutable bindings
+        self.rebound_globals = set()    # names rebound via `global`
+        self.entry_names = []           # human-readable entry descriptions
+        self.contract_classes = set()
+
+    def noqa(self, line, rule):
+        if 1 <= line <= len(self.src_lines):
+            text = self.src_lines[line - 1]
+            return (f"noqa: {rule}" in text or "racecheck: ok" in text)
+        return False
+
+    def lock_id(self, name_or_attr, cls=None):
+        if cls is not None:
+            return f"{self.base}.{cls}.{name_or_attr}"
+        return f"{self.base}.{name_or_attr}"
+
+
+def _call_name(call):
+    f = call.func
+    return f.attr if isinstance(f, ast.Attribute) else (
+        f.id if isinstance(f, ast.Name) else None)
+
+
+def _is_lock_factory(call):
+    return _call_name(call) in _LOCK_FACTORIES
+
+
+def _is_sync_factory(call):
+    return _call_name(call) in _SYNC_FACTORIES
+
+
+def _index_module(path, src):
+    """Phase A over one file: find functions, locks, globals."""
+    m = _ModuleFacts(path, src)
+
+    # module-level bindings
+    for node in m.tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name):
+            name = node.targets[0].id
+            v = node.value
+            if isinstance(v, ast.Call) and _is_lock_factory(v):
+                m.module_locks.add(name)
+            elif isinstance(v, ast.Call) and _is_sync_factory(v):
+                m.module_sync.add(name)
+            elif isinstance(v, (ast.List, ast.Dict, ast.Set)) or (
+                    isinstance(v, ast.Call)
+                    and isinstance(v.func, ast.Name)
+                    and v.func.id in ("list", "dict", "set", "deque",
+                                      "defaultdict", "OrderedDict")):
+                m.mutable_globals.add(name)
+
+    def index_fn(node, qprefix, cls):
+        qname = f"{qprefix}{node.name}"
+        fn = _Func(qname, node, cls=cls)
+        fn.contract = _docstring_contract(node) or (
+            cls in m.contract_classes)
+        m.funcs[qname] = fn
+        if cls is not None:
+            m.classes.setdefault(cls, []).append(qname)
+        # nested defs (daemon loop bodies) get their own entries,
+        # resolvable by bare name from the enclosing function
+        for child in node.body:
+            _index_nested(child, qname + ".", cls, fn)
+        return fn
+
+    def _index_nested(stmt, qprefix, cls, parent):
+        for child in ast.walk(stmt):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                q = f"{qprefix}{child.name}"
+                if q not in m.funcs:
+                    sub = _Func(q, child, cls=cls)
+                    sub.contract = parent.contract
+                    m.funcs[q] = sub
+
+    for node in m.tree.body:
+        if isinstance(node, ast.ClassDef):
+            if _docstring_contract(node):
+                m.contract_classes.add(node.name)
+            for item in node.body:
+                if isinstance(item, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                    index_fn(item, f"{node.name}.", node.name)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            index_fn(node, "", None)
+
+    # instance locks: any `self.X = Lock()/tracked_lock()` in any method
+    for fn in list(m.funcs.values()):
+        if fn.cls is None:
+            continue
+        for child in ast.walk(fn.node):
+            if isinstance(child, ast.Assign) and len(child.targets) == 1:
+                t = child.targets[0]
+                if isinstance(t, ast.Attribute) \
+                        and isinstance(t.value, ast.Name) \
+                        and t.value.id == "self" \
+                        and isinstance(child.value, ast.Call):
+                    if _is_lock_factory(child.value):
+                        m.class_locks.setdefault(fn.cls, set()).add(t.attr)
+                    elif _is_sync_factory(child.value):
+                        m.class_sync.setdefault(fn.cls, set()).add(t.attr)
+        for child in ast.walk(fn.node):
+            if isinstance(child, ast.Global):
+                m.rebound_globals.update(child.names)
+    return m
+
+
+# ---------------------------------------------------------------------------
+# phase B: walk each function with a held-lock stack
+# ---------------------------------------------------------------------------
+
+class _FnWalker:
+    def __init__(self, m, fn):
+        self.m = m
+        self.fn = fn
+        self.held = []                  # lock-id stack (lexical)
+
+    # -- lock identification ------------------------------------------------
+    def _as_lock(self, expr):
+        """Lock id for a with-context expression, else None."""
+        m, fn = self.m, self.fn
+        if isinstance(expr, ast.Call):   # with lock.acquire_timeout() etc
+            return None
+        if isinstance(expr, ast.Name):
+            if expr.id in m.module_locks or "lock" in expr.id.lower() \
+                    or expr.id in ("_G", "_CV"):
+                return m.lock_id(expr.id)
+            return None
+        if isinstance(expr, ast.Attribute):
+            base = expr.value
+            if isinstance(base, ast.Name) and base.id == "self" \
+                    and fn.cls is not None:
+                known = m.class_locks.get(fn.cls, ())
+                if expr.attr in known or "lock" in expr.attr.lower() \
+                        or "cv" in expr.attr.lower() \
+                        or "cond" in expr.attr.lower():
+                    return m.lock_id(expr.attr, fn.cls)
+                return None
+            dotted = _dotted(expr)
+            if "lock" in dotted.lower():
+                # another object's lock (e.g. eng._lock): id by text
+                return f"{m.base}.{dotted}"
+        return None
+
+    # -- state identification -----------------------------------------------
+    def _as_state(self, expr):
+        m, fn = self.m, self.fn
+        if isinstance(expr, ast.Attribute) \
+                and isinstance(expr.value, ast.Name) \
+                and expr.value.id == "self" and fn.cls is not None:
+            if expr.attr in m.class_locks.get(fn.cls, ()) \
+                    or expr.attr in m.class_sync.get(fn.cls, ()):
+                return None     # locks/Events/Queues sync themselves
+            return f"{fn.cls}.{expr.attr}"
+        if isinstance(expr, ast.Name):
+            n = expr.id
+            if n in m.module_locks or n in m.module_sync:
+                return None
+            if n in m.mutable_globals or n in m.rebound_globals:
+                return f"g:{n}"
+        return None
+
+    # -- recording ------------------------------------------------------------
+    def _heldset(self):
+        return frozenset(self.held)
+
+    def _note_access(self, state):
+        self.fn.accesses.add(state)
+
+    def _note_write(self, state, line, how):
+        self._note_access(state)
+        self.fn.writes.append((state, line, self._heldset(), how))
+
+    # -- walking --------------------------------------------------------------
+    def walk(self):
+        node = self.fn.node
+        for stmt in node.body:
+            self._stmt(stmt)
+
+    def _stmt(self, node):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            return                       # nested defs walked separately
+        if isinstance(node, ast.With):
+            acquired = []
+            for item in node.items:
+                lock = self._as_lock(item.context_expr)
+                if lock is not None:
+                    for h in self.held:
+                        if h != lock:
+                            self.fn.edges.append((h, lock, node.lineno))
+                    self.held.append(lock)
+                    acquired.append(lock)
+                    self.fn.acquires.append(lock)
+                else:
+                    self._expr(item.context_expr)
+            for s in node.body:
+                self._stmt(s)
+            for _ in acquired:
+                self.held.pop()
+            return
+        if isinstance(node, ast.If):
+            self._maybe_rc002(node)
+            self._expr(node.test)
+            for s in node.body + node.orelse:
+                self._stmt(s)
+            return
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                self._target(t, node.value, node.lineno)
+            self._expr(node.value)
+            return
+        if isinstance(node, ast.AugAssign):
+            state = self._as_state(node.target) or (
+                self._as_state(node.target.value)
+                if isinstance(node.target, ast.Subscript) else None)
+            if state:
+                self._note_write(state, node.lineno, "augmented assignment")
+            self._expr(node.value)
+            return
+        if isinstance(node, ast.Delete):
+            for t in node.targets:
+                base = t.value if isinstance(t, ast.Subscript) else t
+                state = self._as_state(base)
+                if state:
+                    self._note_write(state, node.lineno, "del")
+            return
+        if isinstance(node, (ast.For, ast.AsyncFor)):
+            self._expr(node.iter)
+            for s in node.body + node.orelse:
+                self._stmt(s)
+            return
+        if isinstance(node, ast.While):
+            self._expr(node.test)
+            for s in node.body + node.orelse:
+                self._stmt(s)
+            return
+        if isinstance(node, ast.Try):
+            for s in (node.body + node.orelse + node.finalbody):
+                self._stmt(s)
+            for h in node.handlers:
+                for s in h.body:
+                    self._stmt(s)
+            return
+        if isinstance(node, (ast.Expr, ast.Return)):
+            if node.value is not None:
+                self._expr(node.value)
+            return
+        # everything else: walk expressions generically
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.expr):
+                self._expr(child)
+            elif isinstance(child, ast.stmt):
+                self._stmt(child)
+
+    def _target(self, t, value, line):
+        if isinstance(t, (ast.Tuple, ast.List)):
+            for e in t.elts:
+                self._target(e, value, line)
+            return
+        if isinstance(t, ast.Subscript):
+            state = self._as_state(t.value)
+            if state:
+                self._note_write(state, line, "item assignment")
+            return
+        state = self._as_state(t)
+        if state is None:
+            return
+        if state.startswith("g:") and _const_store(value):
+            self._note_access(state)     # atomic flag publish: not RC001
+            return
+        # rebinding self.X = <lock factory> in __init__ is construction
+        if isinstance(value, ast.Call) and _is_lock_factory(value):
+            return
+        self._note_write(state, line, "assignment")
+
+    def _maybe_rc002(self, node):
+        """`if <reads S>: <mutates S>` outside a lock — the classic
+        read-check-act window."""
+        if self.held:
+            return
+        test_states = set()
+        for child in ast.walk(node.test):
+            s = self._as_state(child) if isinstance(
+                child, (ast.Attribute, ast.Name)) else None
+            if s:
+                test_states.add(s)
+        if not test_states:
+            return
+        for stmt in node.body:
+            for child in ast.walk(stmt):
+                s = None
+                if isinstance(child, (ast.Assign, ast.AugAssign)):
+                    tgt = (child.targets[0] if isinstance(child, ast.Assign)
+                           else child.target)
+                    base = tgt.value if isinstance(tgt, ast.Subscript) \
+                        else tgt
+                    s = self._as_state(base)
+                elif isinstance(child, ast.Call) \
+                        and isinstance(child.func, ast.Attribute) \
+                        and child.func.attr in _MUTATORS:
+                    s = self._as_state(child.func.value)
+                if s and s in test_states:
+                    self.fn.rc002.append((s, child.lineno,
+                                          self._heldset()))
+                    return
+
+    def _expr(self, node):
+        for child in ast.walk(node):
+            if isinstance(child, ast.Call):
+                self._call(child)
+            elif isinstance(child, (ast.Attribute, ast.Name)) \
+                    and isinstance(getattr(child, "ctx", None), ast.Load):
+                s = self._as_state(child)
+                if s:
+                    self._note_access(s)
+
+    def _call(self, call):
+        fn, m = self.fn, self.m
+        f = call.func
+        held = self._heldset()
+        # container mutation through a method call
+        if isinstance(f, ast.Attribute):
+            state = self._as_state(f.value)
+            if state and f.attr in _MUTATORS:
+                self._note_write(state, call.lineno, f".{f.attr}()")
+            elif state:
+                self._note_access(state)
+        # spawned threads / registered handlers => entry points
+        self._maybe_entry(call)
+        # resolvable callees for the one-level propagation
+        if isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name) \
+                and f.value.id == "self":
+            fn.calls.append(("method", f.attr, held, call.lineno))
+        elif isinstance(f, ast.Name):
+            fn.calls.append(("func", f.id, held, call.lineno))
+        # blocking-while-locked candidates (RC004 raw events; filtered
+        # against effective held sets in the cross-function phase)
+        self._maybe_blocking(call, f, held)
+
+    def _maybe_entry(self, call):
+        m, fn = self.m, self.fn
+
+        def mark(target_expr, why):
+            q = None
+            if isinstance(target_expr, ast.Attribute) \
+                    and isinstance(target_expr.value, ast.Name) \
+                    and target_expr.value.id == "self" and fn.cls:
+                q = f"{fn.cls}.{target_expr.attr}"
+            elif isinstance(target_expr, ast.Name):
+                # nested def in this function shadows a module-level name
+                nested = f"{fn.qname}.{target_expr.id}"
+                q = nested if nested in m.funcs else target_expr.id
+            elif isinstance(target_expr, ast.Lambda):
+                # mark every self-method the lambda body calls
+                for child in ast.walk(target_expr.body):
+                    if isinstance(child, ast.Call):
+                        self._maybe_entry_lambda(child, why)
+                return
+            if q and q in m.funcs:
+                m.funcs[q].is_entry = True
+                m.entry_names.append(f"{why}:{q}")
+
+        f = call.func
+        name = f.attr if isinstance(f, ast.Attribute) else (
+            f.id if isinstance(f, ast.Name) else "")
+        if name == "Thread":
+            for kw in call.keywords:
+                if kw.arg == "target":
+                    mark(kw.value, "thread")
+        elif name in _PROBE_REGISTRARS:
+            for arg in list(call.args) + [kw.value
+                                          for kw in call.keywords]:
+                if isinstance(arg, (ast.Name, ast.Attribute, ast.Lambda)):
+                    mark(arg, "probe")
+        elif name == "signal" and isinstance(f, ast.Attribute) \
+                and len(call.args) == 2:
+            mark(call.args[1], "signal")
+        elif name == "register" and isinstance(f, ast.Attribute) \
+                and _dotted(f.value) == "atexit" and call.args:
+            mark(call.args[0], "atexit")
+        elif name == "Timer" and len(call.args) >= 2:
+            mark(call.args[1], "timer")
+
+    def _maybe_entry_lambda(self, call, why):
+        m, fn = self.m, self.fn
+        f = call.func
+        if isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name) \
+                and f.value.id == "self" and fn.cls:
+            q = f"{fn.cls}.{f.attr}"
+            if q in m.funcs:
+                m.funcs[q].is_entry = True
+                m.entry_names.append(f"{why}:{q}")
+
+    def _maybe_blocking(self, call, f, held):
+        fn = self.fn
+        recv = None
+        name = None
+        if isinstance(f, ast.Attribute):
+            name = f.attr
+            recv = _dotted(f.value)
+            if isinstance(f.value, ast.Constant):
+                return                   # "sep".join(...)
+        elif isinstance(f, ast.Name):
+            name = f.id
+        if name is None:
+            return
+        low = (recv or "").lower()
+        if name == "join":
+            if recv in ("os.path", "posixpath", "ntpath") or not recv:
+                return
+            fn.blocking.append((f"{recv}.join()", call.lineno, held, recv))
+        elif name == "sleep" and low in ("time", ""):
+            thr = _sleep_threshold_s()
+            dur = None
+            if call.args and isinstance(call.args[0], ast.Constant) \
+                    and isinstance(call.args[0].value, (int, float)):
+                dur = float(call.args[0].value)
+            if dur is None or dur >= thr:
+                amount = "variable" if dur is None else f"{dur:g}s"
+                fn.blocking.append((f"time.sleep({amount})", call.lineno,
+                                    held, recv))
+        elif name == "get" and recv and (
+                "queue" in low or low.endswith("_q") or low == "q"
+                or any(kw.arg in ("block", "timeout")
+                       for kw in call.keywords)):
+            fn.blocking.append((f"{recv}.get()", call.lineno, held, recv))
+        elif name == "wait" and recv:
+            fn.blocking.append((f"{recv}.wait()", call.lineno, held, recv))
+        elif name == "result" and recv and (
+                "fut" in low or any(kw.arg == "timeout"
+                                    for kw in call.keywords)):
+            fn.blocking.append((f"{recv}.result()", call.lineno, held,
+                                recv))
+        elif name in _COLLECTIVES:
+            fn.blocking.append((f"{name}()", call.lineno, held, recv))
+
+
+# ---------------------------------------------------------------------------
+# phase C: cross-function/global analysis + finding emission
+# ---------------------------------------------------------------------------
+
+def _resolve(m, fn, kind, name):
+    """Resolve a recorded call to a _Func in the same module, or None."""
+    if kind == "method" and fn.cls is not None:
+        return m.funcs.get(f"{fn.cls}.{name}")
+    if kind == "func":
+        nested = f"{fn.qname}.{name}"
+        return m.funcs.get(nested) or m.funcs.get(name)
+    return None
+
+
+def _thread_closure(m):
+    """Mark everything reachable from an entry function (intra-module
+    transitive closure) as thread-side; record per-function roots."""
+    roots = [f for f in m.funcs.values() if f.is_entry]
+    for root in roots:
+        seen = set()
+        frontier = [root]
+        while frontier:
+            cur = frontier.pop()
+            if cur.qname in seen:
+                continue
+            seen.add(cur.qname)
+            cur.roots.add(root.qname)
+            for kind, name, _held, _line in cur.calls:
+                callee = _resolve(m, cur, kind, name)
+                if callee is not None and callee.qname not in seen:
+                    frontier.append(callee)
+    # every non-entry-reachable function is (potentially) main-thread
+    for f in m.funcs.values():
+        if not f.roots:
+            f.roots.add("main")
+        elif not f.is_entry and not f.qname.startswith("_"):
+            # a public method reachable from a thread is also user-callable
+            f.roots.add("main")
+
+
+def _construction_only(m):
+    """Methods only ever called from __init__ (pre-thread-start): their
+    writes are constructor work, not shared mutation."""
+    callers = {}                         # qname -> set(caller qnames)
+    for f in m.funcs.values():
+        for kind, name, _held, _line in f.calls:
+            callee = _resolve(m, f, kind, name)
+            if callee is not None:
+                callers.setdefault(callee.qname, set()).add(f.qname)
+    out = set()
+    for q, cs in callers.items():
+        fn = m.funcs[q]
+        if fn.is_entry or not fn.node.name.startswith("_"):
+            continue
+        if cs and all(c.endswith(".__init__") or c.endswith("__new__")
+                      for c in cs):
+            out.add(q)
+    return out
+
+
+def _shared_states(m):
+    """State ids reachable from >1 thread root within this module."""
+    by_state = {}
+    for f in m.funcs.values():
+        if f.node.name in ("__init__", "__new__"):
+            continue
+        for s in f.accesses:
+            by_state.setdefault(s, set()).update(f.roots)
+    return {s for s, roots in by_state.items() if len(roots) > 1}
+
+
+def _propagate_inherited(m):
+    """Caller-holds-lock fixpoint: a ``_private`` function whose every
+    intra-module call site holds lock L effectively runs under L.
+    Iterated so guards flow through private helper chains (the gateway
+    dispatch path is step -> _step -> _dispatch -> _do_dispatch ->
+    _preempt_one, all under the lock `step` takes)."""
+    for _round in range(8):
+        changed = False
+        sites = {}                       # qname -> [frozenset(eff held)]
+        for f in m.funcs.values():
+            contract = frozenset(
+                {f"contract:{m.base}.{f.cls or f.qname}"}) \
+                if f.contract else frozenset()
+            for kind, name, held, _line in f.calls:
+                callee = _resolve(m, f, kind, name)
+                if callee is None:
+                    continue
+                eff = held | f.inherited | contract
+                sites.setdefault(callee.qname, []).append(eff)
+        for q, effs in sites.items():
+            fn = m.funcs[q]
+            if fn.is_entry or not fn.node.name.startswith("_"):
+                continue                 # externally callable: no trust
+            inter = frozenset.intersection(*effs) if effs else frozenset()
+            if inter and inter != fn.inherited:
+                fn.inherited = inter
+                changed = True
+        if not changed:
+            break
+
+
+def _effective(fn, held, m):
+    eff = set(held) | set(fn.inherited)
+    if fn.contract:
+        eff.add(f"contract:{m.base}.{fn.cls or fn.qname}")
+    return eff
+
+
+def _guard_of(modules, state_full):
+    """The lock most often held at guarded accesses of this state —
+    named in RC001/RC002 messages as the attribute/lock pair."""
+    votes = {}
+    for m in modules:
+        for f in m.funcs.values():
+            for s, _line, held, _how in f.writes:
+                if f"{m.base}.{s}" == state_full:
+                    for lk in _effective(f, held, m):
+                        votes[lk] = votes.get(lk, 0) + 1
+    if not votes:
+        return None
+    return max(votes.items(), key=lambda kv: kv[1])[0]
+
+
+def _rel(path):
+    for marker in ("incubator_mxnet_tpu", "tools", "tests"):
+        i = path.find(marker)
+        if i >= 0:
+            return path[i:]
+    return os.path.basename(path)
+
+
+def _analyze_modules(modules, report):
+    """Emit RC001-RC004 over a list of _ModuleFacts into `report`."""
+    lock_edges = {}                      # (a, b) -> witness string
+
+    for m in modules:
+        for f in m.funcs.values():
+            w = _FnWalker(m, f)
+            w.walk()
+        _thread_closure(m)
+        _propagate_inherited(m)
+        report.n_files += 1
+        report.n_entry_points += len(m.entry_names)
+
+    for m in modules:
+        shared = _shared_states(m)
+        report.n_shared += len(shared)
+        ctor_only = _construction_only(m)
+        rel = _rel(m.path)
+
+        rc002_lines = set()
+        for f in m.funcs.values():
+            in_ctor = (f.node.name in ("__init__", "__new__")
+                       or f.qname in ctor_only)
+
+            # RC002 first (more specific than RC001 at the same site)
+            for s, line, held in f.rc002:
+                if s not in shared or in_ctor:
+                    continue
+                if _effective(f, held, m):
+                    continue
+                if m.noqa(line, "RC002"):
+                    continue
+                guard = _guard_of([m], f"{m.base}.{s}")
+                roots = sorted({r for fn2 in m.funcs.values()
+                                if s in fn2.accesses for r in fn2.roots})
+                report.add_rule(
+                    "RC002",
+                    f"read-check-act on {s} without "
+                    f"{guard or 'its lock'} in {f.qname} "
+                    f"({rel}:{line}): the test and the mutation can "
+                    f"interleave with a peer thread",
+                    site=f"{rel}:{line}", state=s, lock=guard,
+                    witness=[f"thread roots: {', '.join(roots)}"])
+                rc002_lines.add((s, line))
+
+            # RC001 unguarded shared writes
+            for s, line, held, how in f.writes:
+                if s not in shared or in_ctor:
+                    continue
+                if _effective(f, held, m):
+                    continue
+                if (s, line) in rc002_lines:
+                    continue
+                if m.noqa(line, "RC001"):
+                    continue
+                guard = _guard_of([m], f"{m.base}.{s}")
+                roots = sorted({r for fn2 in m.funcs.values()
+                                if s in fn2.accesses for r in fn2.roots})
+                report.add_rule(
+                    "RC001",
+                    f"unguarded write ({how}) to shared {s} in "
+                    f"{f.qname} ({rel}:{line}); reachable from "
+                    f"{', '.join(roots)}"
+                    + (f" — guard with {guard}" if guard else ""),
+                    site=f"{rel}:{line}", state=s, lock=guard,
+                    witness=[f"thread roots: {', '.join(roots)}"])
+
+            # RC004 blocking while holding a lock
+            for desc, line, held, recv in f.blocking:
+                eff = _effective(f, held, m)
+                if not eff:
+                    continue
+                # waiting on a lock/condition you hold is the CV idiom
+                if recv and any(lk.endswith(recv.split(".")[-1])
+                                for lk in eff):
+                    continue
+                if m.noqa(line, "RC004"):
+                    continue
+                report.add_rule(
+                    "RC004",
+                    f"blocking call {desc} while holding "
+                    f"{', '.join(sorted(eff))} in {f.qname} "
+                    f"({rel}:{line}) — every peer thread stalls behind "
+                    f"this critical section",
+                    site=f"{rel}:{line}", lock=", ".join(sorted(eff)))
+
+            # lexical lock-order edges
+            for a, b, line in f.edges:
+                lock_edges.setdefault(
+                    (a, b), f"{rel}:{line} in {f.qname}")
+            # one-level cross-function edges: call under L to a callee
+            # that acquires M
+            for kind, name, held, line in f.calls:
+                if not held:
+                    continue
+                callee = _resolve(m, f, kind, name)
+                if callee is None:
+                    continue
+                for lk in callee.acquires:
+                    for h in held:
+                        if h != lk:
+                            lock_edges.setdefault(
+                                (h, lk),
+                                f"{rel}:{line} in {f.qname} -> "
+                                f"{callee.qname}")
+
+    report.lock_graph = dict(lock_edges)
+    _emit_rc003(modules, lock_edges, report)
+
+
+def _emit_rc003(modules, edges, report):
+    """Cycles in the static lock-order graph, both witness paths named."""
+    adj = {}
+    for (a, b) in edges:
+        adj.setdefault(a, set()).add(b)
+
+    # pairwise inversions first (the common real case), then longer
+    # cycles via bounded DFS
+    reported = set()
+    for (a, b) in sorted(edges):
+        if (b, a) in edges and frozenset((a, b)) not in reported:
+            reported.add(frozenset((a, b)))
+            report.add_rule(
+                "RC003",
+                f"lock-order cycle between {a} and {b}: "
+                f"{a} -> {b} at {edges[(a, b)]} but "
+                f"{b} -> {a} at {edges[(b, a)]} — two threads taking "
+                f"these in opposite orders deadlock",
+                lock=f"{a}<->{b}",
+                witness=[f"{a} -> {b}: {edges[(a, b)]}",
+                         f"{b} -> {a}: {edges[(b, a)]}"])
+
+    def dfs_cycle(start):
+        stack = [(start, (start,))]
+        while stack:
+            node, path = stack.pop()
+            for nxt in adj.get(node, ()):
+                if nxt == start and len(path) > 2:
+                    return path + (start,)
+                if nxt not in path and len(path) < 5:
+                    stack.append((nxt, path + (nxt,)))
+        return None
+
+    for start in sorted(adj):
+        cyc = dfs_cycle(start)
+        if not cyc:
+            continue
+        key = frozenset(cyc)
+        if key in reported or any(key >= r for r in reported):
+            continue
+        reported.add(key)
+        hops = list(zip(cyc, cyc[1:]))
+        report.add_rule(
+            "RC003",
+            "lock-order cycle " + " -> ".join(cyc)
+            + " (each hop witnessed; see witness lines)",
+            lock="<->".join(cyc[:-1]),
+            witness=[f"{a} -> {b}: {edges[(a, b)]}" for a, b in hops])
+
+
+# ---------------------------------------------------------------------------
+# public API
+# ---------------------------------------------------------------------------
+
+def racecheck_source(src, path="<fixture>.py", report=None):
+    """Static tier over one source string (tests/fixtures)."""
+    if report is None:   # not `or`: an empty report is len()==0 falsy
+        report = RaceReport(os.path.basename(path))
+    report.tiers = sorted(set(report.tiers) | {"static"})
+    _analyze_modules([_index_module(path, src)], report)
+    return report
+
+
+def racecheck_paths(paths, target_name="paths"):
+    """Static tier over a list of .py files (one shared lock graph)."""
+    report = RaceReport(target_name)
+    report.tiers = ["static"]
+    modules = []
+    for p in paths:
+        with open(p, encoding="utf-8") as fh:
+            modules.append(_index_module(p, fh.read()))
+    _analyze_modules(modules, report)
+    return report
+
+
+def _tree_files(root, subdirs):
+    out = []
+    for sub in subdirs:
+        d = os.path.join(root, sub)
+        if not os.path.isdir(d):
+            continue
+        for dirpath, _dirs, files in os.walk(d):
+            for f in sorted(files):
+                if f.endswith(".py"):
+                    out.append(os.path.join(dirpath, f))
+    return out
+
+
+def runtime_report(target_name="runtime"):
+    """Fold the `telemetry/locks.py` witness state into a RaceReport:
+    every runtime-witnessed order inversion is an RC005 finding with
+    both acquisition stacks attached."""
+    from ..telemetry import locks
+
+    report = RaceReport(target_name)
+    report.tiers = ["runtime"]
+    for inv in locks.inversions():
+        fwd, rev = inv["witness_fwd"], inv["witness_rev"]
+        report.add_rule(
+            "RC005",
+            f"witnessed lock-order inversion {inv['pair']}: "
+            f"{fwd['order']} at {fwd['line']} (thread {fwd['thread']}) "
+            f"vs {rev['order']} at {rev['line']} (thread "
+            f"{rev['thread']}) — deadlock possible under preemption",
+            lock=inv["pair"],
+            witness=([f"fwd {fwd['order']} [{fwd['thread']}]"]
+                     + [f"  {s}" for s in fwd["stack"]]
+                     + [f"rev {rev['order']} [{rev['thread']}]"]
+                     + [f"  {s}" for s in rev["stack"]]))
+    report.lock_graph = {k: v["line"]
+                         for k, v in locks.order_graph().items()}
+    return report
+
+
+def racecheck_report(root=None, subdirs=DEFAULT_SUBDIRS,
+                     include_runtime=True, name=None):
+    """Run the concurrency pass: static tier over the control-plane
+    tree (+ the runtime witness state when any exists), increment
+    ``mx_racecheck_findings_total{rule=}``, and honor
+    ``MXNET_RACECHECK=warn|raise``. Returns the `RaceReport`."""
+    if root is None:
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    files = _tree_files(root, subdirs)
+    report = RaceReport(name or "+".join(subdirs))
+    report.tiers = ["static"]
+    modules = []
+    for p in files:
+        with open(p, encoding="utf-8") as fh:
+            modules.append(_index_module(p, fh.read()))
+    _analyze_modules(modules, report)
+
+    if include_runtime:
+        from ..telemetry import locks
+
+        if locks.inversions():
+            report.tiers.append("runtime")
+            rt = runtime_report()
+            for f in rt._all:
+                report.add(f)
+            report.lock_graph.update(
+                {k: v["line"] for k, v in locks.order_graph().items()})
+
+    _count_findings(report)
+    _maybe_escalate(report)
+    return report
+
+
+def _count_findings(report):
+    from ..telemetry import registry
+
+    for f in report.findings:
+        registry.counter("mx_racecheck_findings_total",
+                         "concurrency findings by rule (see ANALYSIS.md)",
+                         labels={"rule": f.kind}).inc()
+
+
+def _maybe_escalate(report):
+    """Honor ``MXNET_RACECHECK``: ``warn`` logs every finding, ``raise``
+    fails loudly; unset/other = report-only."""
+    mode = (os.environ.get("MXNET_RACECHECK") or "").strip().lower()
+    if report.findings and mode == "warn":
+        for f in report.findings:
+            _LOG.warning("MXNET_RACECHECK: %r", f)
+    elif report.findings and mode == "raise":
+        raise MXNetError("MXNET_RACECHECK=raise\n" + report.summary())

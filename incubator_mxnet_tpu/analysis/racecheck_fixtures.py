@@ -1,0 +1,237 @@
+"""Seeded-defect fixtures for `analysis.racecheck` (rules RC001-RC005).
+
+Each static rule gets a pair of source fixtures: ``RCxxx_BAD`` (fires —
+a minimal control-plane module seeded with exactly that defect) and
+``RCxxx_OK`` (the corrected twin — must analyze clean). The runtime
+rule RC005 gets `run_abba()`: a REAL two-thread ABBA acquisition
+inversion, Event-sequenced so the two critical sections never overlap —
+the witness must report the cycle with both stacks *without* the demo
+ever deadlocking. `tools/racecheck.py --demo` and
+`tests/test_racecheck.py` consume the same fixtures, so what the docs
+cite is what the gates run.
+
+Fixture paths are passed as ``serve/<name>.py`` so the sources are
+analyzed under the control-plane scoping rules.
+"""
+from __future__ import annotations
+
+import threading
+
+__all__ = [
+    "RC001_BAD", "RC001_OK", "RC002_BAD", "RC002_OK",
+    "RC003_BAD", "RC003_OK", "RC004_BAD", "RC004_OK",
+    "STATIC_FIXTURES", "run_abba",
+]
+
+# --------------------------------------------------------------------------
+# RC001 — unguarded shared write: `Pump._worker` (a thread target)
+# appends to `self._items` without `self._lock`, while the main-thread
+# `push` path mutates the same list under the lock.
+# --------------------------------------------------------------------------
+
+RC001_BAD = '''\
+import threading
+
+
+class Pump:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._items = []
+        self._thread = None
+
+    def start(self):
+        self._thread = threading.Thread(target=self._worker, daemon=True)
+        self._thread.start()
+
+    def push(self, item):
+        with self._lock:
+            self._items.append(item)
+
+    def _worker(self):
+        while True:
+            self._items.append(object())   # seeded RC001: no self._lock
+'''
+
+RC001_OK = RC001_BAD.replace(
+    """        while True:
+            self._items.append(object())   # seeded RC001: no self._lock
+""",
+    """        while True:
+            with self._lock:
+                self._items.append(object())
+""")
+
+# --------------------------------------------------------------------------
+# RC002 — read-check-act without the lock: `Alloc.take` checks
+# `self._free` then pops it outside `self._lock`, though every other
+# access holds the lock (classic TOCTOU on the free list).
+# --------------------------------------------------------------------------
+
+RC002_BAD = '''\
+import threading
+
+
+class Alloc:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._free = [1, 2, 3]
+        self._thread = None
+
+    def start(self):
+        self._thread = threading.Thread(target=self._reaper, daemon=True)
+        self._thread.start()
+
+    def give(self, page):
+        with self._lock:
+            self._free.append(page)
+
+    def take(self):
+        if self._free:                    # seeded RC002: check ...
+            return self._free.pop()       # ... then act, lock-free
+        return None
+
+    def _reaper(self):
+        while True:
+            with self._lock:
+                self._free.append(0)
+'''
+
+RC002_OK = RC002_BAD.replace(
+    """        if self._free:                    # seeded RC002: check ...
+            return self._free.pop()       # ... then act, lock-free
+        return None
+""",
+    """        with self._lock:
+            if self._free:
+                return self._free.pop()
+        return None
+""")
+
+# --------------------------------------------------------------------------
+# RC003 — static lock-order inversion: `swap` nests a->b while `route`
+# nests b->a; both orders are reachable, so the pair can deadlock.
+# --------------------------------------------------------------------------
+
+RC003_BAD = '''\
+import threading
+
+
+class Router:
+    def __init__(self):
+        self._table_lock = threading.Lock()
+        self._stats_lock = threading.Lock()
+        self._table = {}
+        self._stats = {}
+
+    def swap(self, table):
+        with self._table_lock:
+            with self._stats_lock:        # seeded RC003: a -> b
+                self._table = table
+                self._stats.clear()
+
+    def route(self, key):
+        with self._stats_lock:
+            with self._table_lock:        # seeded RC003: b -> a
+                self._stats[key] = self._stats.get(key, 0) + 1
+                return self._table.get(key)
+'''
+
+RC003_OK = RC003_BAD.replace(
+    """        with self._stats_lock:
+            with self._table_lock:        # seeded RC003: b -> a
+                self._stats[key] = self._stats.get(key, 0) + 1
+                return self._table.get(key)
+""",
+    """        with self._table_lock:
+            with self._stats_lock:
+                self._stats[key] = self._stats.get(key, 0) + 1
+                return self._table.get(key)
+""")
+
+# --------------------------------------------------------------------------
+# RC004 — blocking call while holding a lock: `drain` joins the worker
+# thread inside `with self._lock`, starving every other path that needs
+# the lock for the worker's full lifetime.
+# --------------------------------------------------------------------------
+
+RC004_BAD = '''\
+import threading
+
+
+class Drainer:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+
+    def drain(self):
+        with self._lock:
+            self._thread.join()           # seeded RC004: join under lock
+
+    def _run(self):
+        pass
+'''
+
+RC004_OK = RC004_BAD.replace(
+    """        with self._lock:
+            self._thread.join()           # seeded RC004: join under lock
+""",
+    """        with self._lock:
+            t = self._thread
+        t.join()
+""")
+
+#: rule -> (firing fixture, clean twin) — the CLI demo and the tests
+#: iterate this table so every static rule keeps both halves.
+STATIC_FIXTURES = {
+    "RC001": (RC001_BAD, RC001_OK),
+    "RC002": (RC002_BAD, RC002_OK),
+    "RC003": (RC003_BAD, RC003_OK),
+    "RC004": (RC004_BAD, RC004_OK),
+}
+
+
+# --------------------------------------------------------------------------
+# RC005 — runtime ABBA witnessed without a deadlock
+# --------------------------------------------------------------------------
+
+def run_abba(prefix="demo.abba"):
+    """Run a REAL two-thread ABBA inversion against two tracked locks.
+
+    Thread 1 acquires A then B and fully releases; only then (Event-
+    sequenced) does thread 2 acquire B then A — the critical sections
+    never overlap, so the demo cannot deadlock, but the witness has now
+    seen both orders and must report the RC005 cycle with both stacks.
+
+    Returns ``(lock_a_name, lock_b_name)``. Caller arms the witness
+    (`locks.enable()` / ``MXNET_TELEMETRY=1``) before calling and reads
+    `locks.inversions()` / `analysis.runtime_report()` after.
+    """
+    from ..telemetry import locks
+
+    a = locks.tracked_lock(f"{prefix}.a", kind="lock")
+    b = locks.tracked_lock(f"{prefix}.b", kind="lock")
+    first_done = threading.Event()
+
+    def order_ab():
+        with a:
+            with b:
+                pass
+        first_done.set()
+
+    def order_ba():
+        first_done.wait(timeout=5.0)
+        with b:
+            with a:
+                pass
+
+    t1 = threading.Thread(target=order_ab, daemon=True)
+    t2 = threading.Thread(target=order_ba, daemon=True)
+    t1.start()
+    t2.start()
+    t1.join(timeout=5.0)
+    t2.join(timeout=5.0)
+    if t1.is_alive() or t2.is_alive():
+        raise RuntimeError("ABBA demo threads did not finish — the "
+                           "Event sequencing should make this impossible")
+    return (getattr(a, "_tl_name", f"{prefix}.a"),
+            getattr(b, "_tl_name", f"{prefix}.b"))

@@ -84,7 +84,8 @@ even pay the call — the hook global in `ndarray.py` stays None).
 from __future__ import annotations
 
 import os
-import threading
+
+from ..telemetry.locks import tracked_lock
 
 __all__ = ["FaultInjected", "InjectedResourceExhausted", "TopologyChanged",
            "SEAMS", "inject_at", "injection_enabled",
@@ -227,7 +228,7 @@ def _delay_seconds():
 
 
 _SCHEDULE = None                 # None = off (every probe a dead branch)
-_LOCK = threading.Lock()
+_LOCK = tracked_lock("fault.injection", kind="lock")
 
 
 def _parse_spec(spec):

@@ -26,6 +26,7 @@ import threading
 import numpy as onp
 
 from ..telemetry import tracing
+from ..telemetry.locks import tracked_lock
 from ..util import env_float as _env_float
 from ..util import env_int as _env_int
 from .engine import SlotDecoder
@@ -104,7 +105,7 @@ class ServeEngine:
                                 default_deadline=deadline_s, eos_id=eos_id,
                                 seed=seed)
         self._default_temperature = float(temperature)
-        self._lock = threading.RLock()
+        self._lock = tracked_lock("serve.engine")
         self._driver = None
         self._stop = threading.Event()
 

@@ -62,6 +62,7 @@ import weakref
 import numpy as onp
 
 from ..telemetry import registry, tracing
+from ..telemetry.locks import tracked_lock
 from ..util import env_int as _env_int
 from . import tenancy
 from .engine import PagePoolExhausted, SlotDecoder
@@ -461,7 +462,7 @@ class Gateway:
                 burst=prof.get("burst", self._default_burst))
         self._next_id = 0
         self.closed = False
-        self._lock = threading.RLock()
+        self._lock = tracked_lock("serve.gateway")
         self._driver = None
         self._stop = threading.Event()
         self.preemptions_total = 0

@@ -56,6 +56,7 @@ snapshots for node-exporter textfile scraping).
 """
 from __future__ import annotations
 
+from . import locks  # noqa: F401  (first: tracked_lock feeds the rest)
 from . import registry  # noqa: F401
 from . import roofline  # noqa: F401
 from . import stages  # noqa: F401
@@ -76,5 +77,5 @@ from ..ndarray import ndarray as _nd_mod
 _nd_mod._H2D_HOOK = registry.add_h2d_bytes
 
 __all__ = ["registry", "stages", "tracing", "slo", "roofline", "monitor",
-           "compiles", "hbm", "fleet", "kernels", "goodput", "Monitor",
-           "install_nan_hook"]
+           "compiles", "hbm", "fleet", "kernels", "goodput", "locks",
+           "Monitor", "install_nan_hook"]

@@ -88,6 +88,7 @@ import time
 import zlib
 
 from . import registry, tracing
+from .locks import tracked_lock
 
 __all__ = [
     "enable", "disable", "is_enabled", "probe_collectives",
@@ -99,7 +100,7 @@ __all__ = [
 _PKG = __name__.rsplit(".", 2)[0]
 
 _ENABLED = False
-_LOCK = threading.Lock()
+_LOCK = tracked_lock("telemetry.fleet", kind="lock")
 
 # approximate aggregate ICI bandwidth per chip, GB/s one direction
 # (vendor-published figures; the comms sibling of roofline.PEAK_HBM_GBS).
@@ -113,7 +114,7 @@ SKEW_BUCKETS = (1e-5, 1e-4, 5e-4, 1e-3, 5e-3, 1e-2, 5e-2, 0.1, 0.5,
                 1.0, 5.0)
 
 _SEQ: dict = {}               # op -> issue sequence (matches across ranks)
-_SEQ_LOCK = threading.Lock()
+_SEQ_LOCK = tracked_lock("telemetry.fleet.seq", kind="lock")
 
 _BARRIER = {"count": 0, "lateness_sum": 0.0, "lateness_max": 0.0,
             "skew_sum": 0.0, "skew_max": 0.0}
@@ -229,15 +230,19 @@ def reset():
     global _LAST_REPORT
     with _SEQ_LOCK:
         _SEQ.clear()
-    _BARRIER.update(count=0, lateness_sum=0.0, lateness_max=0.0,
-                    skew_sum=0.0, skew_max=0.0)
-    _CLOCK.update(offsets=None, bound_s=None)
+    with _LOCK:
+        # the flight-recorder fanout reads these from the crash thread
+        # (racecheck RC001): update under the module lock
+        _BARRIER.update(count=0, lateness_sum=0.0, lateness_max=0.0,
+                        skew_sum=0.0, skew_max=0.0)
+        _CLOCK.update(offsets=None, bound_s=None)
     _FLEET_TRACE["id"] = None
     _LAST_REPORT = None
 
 
 def barrier_stats():
-    b = dict(_BARRIER)
+    with _LOCK:
+        b = dict(_BARRIER)
     n = b.pop("count")
     return {"count": n,
             "lateness_mean": (b["lateness_sum"] / n) if n else 0.0,
@@ -339,11 +344,14 @@ def _exchange_arrival(dist, t_arrive):
     registry.histogram("mx_barrier_skew_seconds",
                        "arrival spread at dist.barrier",
                        buckets=SKEW_BUCKETS).observe(skew)
-    _BARRIER["count"] += 1
-    _BARRIER["lateness_sum"] += lateness
-    _BARRIER["lateness_max"] = max(_BARRIER["lateness_max"], lateness)
-    _BARRIER["skew_sum"] += skew
-    _BARRIER["skew_max"] = max(_BARRIER["skew_max"], skew)
+    with _LOCK:
+        # guarded: the crash-fanout flight context snapshots these from
+        # another thread (racecheck RC001)
+        _BARRIER["count"] += 1
+        _BARRIER["lateness_sum"] += lateness
+        _BARRIER["lateness_max"] = max(_BARRIER["lateness_max"], lateness)
+        _BARRIER["skew_sum"] += skew
+        _BARRIER["skew_max"] = max(_BARRIER["skew_max"], skew)
     tracing.annotate(skew_s=round(skew, 6), lateness_s=round(lateness, 6),
                      fleet_trace=_FLEET_TRACE["id"])
 

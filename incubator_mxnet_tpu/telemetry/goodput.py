@@ -39,6 +39,8 @@ from __future__ import annotations
 import threading
 import time
 
+from .locks import tracked_lock
+
 from . import registry, tracing
 
 __all__ = ["STATES", "lease", "report", "goodput_frac", "format_waterfall",
@@ -49,7 +51,7 @@ STATES = ("compute", "data_wait", "checkpoint", "reshard", "drain",
           "recovery", "idle")
 
 _ENABLED = False
-_LOCK = threading.Lock()
+_LOCK = tracked_lock("telemetry.goodput", kind="lock")
 _SECONDS: dict = {}          # state -> attributed seconds
 _STACK: list = []            # active lease states, innermost last
 _T_BEGIN = None              # perf_counter at first lease (ledger epoch)

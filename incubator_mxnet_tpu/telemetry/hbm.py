@@ -38,6 +38,8 @@ import os
 import threading
 import time
 
+from .locks import tracked_lock
+
 from . import registry, tracing
 
 __all__ = ["enable", "disable", "is_enabled", "reset", "register_owner",
@@ -48,7 +50,7 @@ __all__ = ["enable", "disable", "is_enabled", "reset", "register_owner",
 logger = logging.getLogger("incubator_mxnet_tpu.telemetry.hbm")
 
 _ENABLED = False
-_LOCK = threading.Lock()
+_LOCK = tracked_lock("telemetry.hbm", kind="lock")
 _OWNERS: dict = {}            # name -> probe() (registration order wins ties)
 
 # growth watchdog state

@@ -54,7 +54,7 @@ __all__ = ["counter", "gauge", "histogram", "report", "dump", "exposition",
            "arm_textfile_dump", "stop_textfile_dump",
            "STEP_TIME", "EXAMPLES", "JIT_COMPILE", "H2D_BYTES"]
 
-_LOCK = threading.Lock()
+_LOCK = threading.Lock()  # noqa: FL018 - the metric cells back the tracked-lock telemetry itself
 _METRICS: dict = {}          # (name, labels frozenset) -> metric
 _COLLECTORS: list = []       # callables returning {series name: value}
 

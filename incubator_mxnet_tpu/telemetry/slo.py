@@ -34,6 +34,8 @@ from __future__ import annotations
 import threading
 import time
 
+from .locks import tracked_lock
+
 from . import registry
 
 __all__ = ["SLO", "SLOTracker", "tracker", "latency", "throughput",
@@ -191,7 +193,7 @@ class SLOTracker:
 
     def __init__(self):
         self._slos: list = []
-        self._lock = threading.Lock()
+        self._lock = tracked_lock("telemetry.slo", kind="lock")
 
     def add(self, slo):
         with self._lock:

@@ -35,7 +35,10 @@ from __future__ import annotations
 
 import threading
 import time
+
 from collections import defaultdict
+
+from .locks import tracked_lock
 
 __all__ = ["enable", "disable", "is_enabled", "stage_report", "reset",
            "STAGE_ORDER"]
@@ -43,7 +46,7 @@ __all__ = ["enable", "disable", "is_enabled", "stage_report", "reset",
 STAGE_ORDER = ("prologue", "amp_lookup", "cache_key", "dispatch", "wrap",
                "tape")
 
-_LOCK = threading.Lock()
+_LOCK = tracked_lock("telemetry.stages", kind="lock")
 _STATS = defaultdict(lambda: [0, 0])     # stage -> [count, total_ns]
 _ENABLED = False
 

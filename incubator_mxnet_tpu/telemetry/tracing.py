@@ -56,7 +56,10 @@ import random
 import sys
 import threading
 import time
+
 from collections import deque
+
+from .locks import tracked_lock
 
 __all__ = ["Span", "Tracer", "enable", "disable", "is_enabled", "span",
            "open_span", "event", "annotate", "current_span",
@@ -69,7 +72,7 @@ RING_CAPACITY = 4096          # finished spans kept per writer thread
 _FLIGHT_SPANS = 256           # most-recent spans a flight dump carries
 
 _ENABLED = False
-_LOCK = threading.Lock()
+_LOCK = tracked_lock("telemetry.tracing", kind="lock")
 _RINGS: list = []             # one deque per writer thread (merged reads)
 _OPEN: dict = {}              # span_id -> still-open Span (flight recorder)
 _ORPHAN_EVENTS: deque = deque(maxlen=512)   # events with no current span

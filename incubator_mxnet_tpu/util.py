@@ -264,6 +264,27 @@ _ENV_KNOBS = {
         "vs the unsharded engine, clean shardcheck, pool aliasing, "
         "gateway hot-swap); 0 = skip; unset = runs only in the spawned "
         "dryrun child (honored, this build's addition)"),
+    "MXNET_RACECHECK": (
+        "analysis.racecheck", "warn = log every concurrency finding "
+        "from racecheck_report(); raise = fail loudly on any finding; "
+        "unset = report only (honored, this build's addition — see "
+        "ANALYSIS.md)"),
+    "MXNET_RACECHECK_SLEEP_S": (
+        "analysis.racecheck", "time.sleep threshold in seconds above "
+        "which sleeping while holding a lock is an RC004 finding "
+        "(default 0.05) (honored, this build's addition — see "
+        "ANALYSIS.md)"),
+    "MXNET_RACECHECK_HOLD_S": (
+        "telemetry.locks", "armed tracked-lock hold time in seconds "
+        "above which a one-shot long-hold warning names the lock "
+        "(default 1.0) (honored, this build's addition — see "
+        "TELEMETRY.md)"),
+    "MXNET_DRYRUN_RACECHECK": (
+        "__graft_entry__ dryrun_multichip", "1 = force the racecheck "
+        "subphase (static sweep over serve/+fault/ must be clean; "
+        "gateway-under-load with the lock witness armed must see zero "
+        "RC005 inversions); 0 = skip; unset = runs only in the spawned "
+        "dryrun child (honored, this build's addition)"),
     "MXNET_GOODPUT": (
         "telemetry.goodput", "1 = arm the training goodput ledger alone "
         "(lease seams in estimator/dataloader/checkpoint/elastic, "
@@ -470,11 +491,15 @@ def _apply_env_config():
             pass
     telem = os.environ.get("MXNET_TELEMETRY", "0")
     if telem and telem != "0":
-        from .telemetry import (compiles, fleet, goodput, hbm, monitor,
-                                stages, tracing)
+        from .telemetry import (compiles, fleet, goodput, hbm, locks,
+                                monitor, stages, tracing)
 
         stages.enable()
         tracing.enable()
+        locks.enable()          # lock-order witness + contention series
+                                # (locks created earlier stay raw — the
+                                # module also self-arms at import, which
+                                # is the path that catches them all)
         compiles.enable()       # per-program compile ledger + forensics
         hbm.enable()            # live-buffer census gauges + OOM seams
         fleet.enable()          # cross-rank collective profiler + fanout

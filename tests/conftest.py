@@ -151,6 +151,15 @@ _QUICK = {
     "test_sharded_serve.py::test_one_device_mesh_greedy_parity",
     "test_sharded_serve.py::test_router_prefers_warm_prefix_replica",
     "test_tools.py::test_fl017_tree_is_clean",
+    # concurrency correctness (ISSUE 16 gates): the whole-tree static
+    # racecheck sweep, the audited suspect seams, the ABBA the runtime
+    # witness must catch without deadlocking, the by-construction
+    # off-path guarantee, and the FL018 tracked-lock provenance sweep
+    "test_racecheck.py::test_tree_static_sweep_is_clean",
+    "test_racecheck.py::test_suspect_seam_analyzes_clean",
+    "test_racecheck.py::test_abba_witnessed_without_deadlock",
+    "test_racecheck.py::test_disarmed_tracked_lock_is_raw_primitive",
+    "test_tools.py::test_fl018_tree_is_clean",
 }
 
 

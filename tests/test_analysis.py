@@ -300,7 +300,8 @@ def test_framework_lint_list_rules():
     assert set(fl.RULES) == {"FL001", "FL002", "FL003", "FL004", "FL005",
                              "FL006", "FL007", "FL008", "FL009", "FL010",
                              "FL011", "FL012", "FL013",
-                             "FL014", "FL015", "FL016", "FL017"}
+                             "FL014", "FL015", "FL016", "FL017",
+                             "FL018"}
 
 
 # ---------------------------------------------------------------------------

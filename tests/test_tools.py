@@ -871,6 +871,63 @@ def test_fl017_tree_is_clean():
 
 
 # ---------------------------------------------------------------------------
+# framework_lint FL018 — control-plane tracked-lock provenance (ISSUE 16)
+# ---------------------------------------------------------------------------
+
+def test_fl018_flags_raw_locks_in_control_plane():
+    src = ("import threading\n"
+           "class Engine:\n"
+           "    def __init__(self):\n"
+           "        self._lock = threading.RLock()\n"
+           "        self._cv = threading.Condition()\n"
+           "_MOD_LOCK = threading.Lock()\n")
+    for path in ("incubator_mxnet_tpu/serve/api.py",
+                 "incubator_mxnet_tpu/fault/retry.py",
+                 "incubator_mxnet_tpu/telemetry/stages.py"):
+        hits = [f for f in _lint_src(src, path) if f.rule == "FL018"]
+        assert len(hits) == 3, (path, hits)
+        assert "tracked_lock" in hits[0].message
+        assert {h.line for h in hits} == {4, 5, 6}
+
+
+def test_fl018_accepts_tracked_noqa_registry_and_scoping():
+    # tracked_lock construction: clean
+    good = ("from ..telemetry.locks import tracked_lock\n"
+            "class Engine:\n"
+            "    def __init__(self):\n"
+            "        self._lock = tracked_lock('serve.engine')\n")
+    assert not [f for f in _lint_src(
+        good, "incubator_mxnet_tpu/serve/api.py") if f.rule == "FL018"]
+    # noqa escape with a reason
+    noqa = ("import threading\n"
+            "_CELLS = threading.Lock()  "
+            "# noqa: FL018 - backs the tracked locks themselves\n")
+    assert not [f for f in _lint_src(
+        noqa, "incubator_mxnet_tpu/telemetry/registry.py")
+        if f.rule == "FL018"]
+    # the tracked-lock registry module is exempt (it wraps raw locks)
+    raw = "import threading\n_G = threading.Lock()\n"
+    assert not [f for f in _lint_src(
+        raw, "incubator_mxnet_tpu/telemetry/locks.py")
+        if f.rule == "FL018"]
+    # outside serve//fault//telemetry/ the rule is silent
+    assert not [f for f in _lint_src(
+        raw, "incubator_mxnet_tpu/parallel/dist.py") if f.rule == "FL018"]
+
+
+def test_fl018_tree_is_clean():
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import framework_lint
+    finally:
+        sys.path.pop(0)
+    findings = [f for f in framework_lint.lint_paths(
+        [os.path.join(REPO, "incubator_mxnet_tpu")])
+        if f.rule == "FL018"]
+    assert not findings, findings
+
+
+# ---------------------------------------------------------------------------
 # bench_regress — trajectory regression gate (ISSUE 10)
 # ---------------------------------------------------------------------------
 

@@ -177,6 +177,20 @@ FL017  serve/ placement-spec provenance (scoped to ``serve/``
        ``pool_spec()``, ...) or — for genuinely layout-free plumbing
        (host staging buffers, tests) — annotate the line with
        ``# noqa: FL017`` and the justifying comment.
+FL018  tracked-lock provenance (scoped to ``serve/`` / ``fault/`` /
+       ``telemetry/`` module bodies, excluding
+       ``telemetry/locks.py`` — the registry cannot be built out of
+       itself): a raw ``threading.Lock()`` / ``RLock()`` /
+       ``Condition()`` construction instead of
+       ``telemetry.locks.tracked_lock(name)``. A raw lock is invisible
+       to the racecheck runtime witness — its acquisition order never
+       reaches the lock-order graph, so an ABBA inversion through it
+       (RC005) cannot be caught before it deadlocks a pod, and its
+       contention never shows in ``mx_lock_wait_seconds``. Construct
+       control-plane locks through the registry, or — where a raw
+       primitive is structurally required (the metric cells backing
+       the tracked locks themselves) — annotate the line with
+       ``# noqa: FL018`` and the justifying comment.
 
 Usage
 -----
@@ -264,6 +278,12 @@ RULES = {
              "truth shardcheck pre-flights), not inline spec opinions; "
              "derive via layout.sharding/spec_for/pool_spec, or "
              "`# noqa: FL017` with a reason",
+    "FL018": "serve//fault//telemetry/ lock provenance: raw "
+             "threading.Lock()/RLock()/Condition() construction — "
+             "invisible to the racecheck runtime witness (RC005) and "
+             "the mx_lock_* contention series; use telemetry.locks."
+             "tracked_lock(name) (telemetry/locks.py itself exempt), "
+             "or `# noqa: FL018` with a reason",
 }
 
 _INDEXING_NAME_PARTS = ("getitem", "setitem", "index", "slice")
@@ -965,6 +985,50 @@ def _check_placement_provenance(tree, path, findings, src_lines):
 
 
 # ---------------------------------------------------------------------------
+# FL018 — tracked-lock provenance (serve/ + fault/ + telemetry/ bodies)
+# ---------------------------------------------------------------------------
+
+_RAW_LOCK_CTORS = ("Lock", "RLock", "Condition")
+
+
+def _check_tracked_locks(tree, path, findings, src_lines):
+    norm = path.replace(os.sep, "/")
+    if not any(d in norm for d in ("/serve/", "/fault/", "/telemetry/")):
+        return
+    if norm.endswith("telemetry/locks.py"):
+        return  # the registry builds the tracked wrappers out of raw locks
+
+    def noqa(lineno):
+        line = src_lines[lineno - 1] if lineno - 1 < len(src_lines) else ""
+        return "noqa: FL018" in line
+
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        if isinstance(fn, ast.Attribute):
+            if not (isinstance(fn.value, ast.Name)
+                    and fn.value.id == "threading"
+                    and fn.attr in _RAW_LOCK_CTORS):
+                continue
+            name = f"threading.{fn.attr}"
+        elif isinstance(fn, ast.Name) and fn.id in _RAW_LOCK_CTORS:
+            name = fn.id
+        else:
+            continue
+        if noqa(node.lineno):
+            continue
+        findings.append(LintFinding(
+            path, node.lineno, "FL018",
+            f"raw `{name}()` in a control-plane module — invisible to "
+            "the racecheck runtime witness (no lock-order edges, no "
+            "RC005 inversion detection) and to the mx_lock_wait/"
+            "held_seconds contention series; construct it via "
+            "telemetry.locks.tracked_lock(name), or `# noqa: FL018` "
+            "with a reason"))
+
+
+# ---------------------------------------------------------------------------
 # FL009 — paged-serving hazards (serve/ modules only)
 # ---------------------------------------------------------------------------
 
@@ -1401,6 +1465,7 @@ def lint_source(src, path, coverage_text=None, telemetry_text=None):
     _check_pool_aliasing(tree, path, findings, src.splitlines())
     _check_sharding_hygiene(tree, path, findings)
     _check_placement_provenance(tree, path, findings, src.splitlines())
+    _check_tracked_locks(tree, path, findings, src.splitlines())
     _check_paged_hazards(tree, path, findings)
     _check_span_hygiene(tree, path, findings)
     _check_collective_hygiene(tree, path, findings, src.splitlines())

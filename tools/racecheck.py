@@ -1,0 +1,211 @@
+#!/usr/bin/env python
+"""Concurrency-correctness CLI over the host control plane.
+
+Runs `mx.analysis.racecheck` (rules RC001-RC005, see ANALYSIS.md) in
+three modes:
+
+``--tree``  static sweep over ``serve/`` + ``fault/`` + ``telemetry/``
+            + ``parallel/`` (the default set): shared-state map, lock
+            discipline (RC001/RC002), static lock-order graph (RC003),
+            blocking-under-lock (RC004). Prints the stamp + findings;
+            exits 1 if any finding survives.
+``--live``  arms the runtime lock-order witness (`telemetry.locks`),
+            drives a synthetic contended workload across the tracked
+            serve/gateway/telemetry locks, then dumps the runtime
+            order graph, the contention table
+            (mx_lock_wait/held_seconds), and any RC005 inversions.
+``--demo``  the committed seeded-defect fixtures: each static rule's
+            firing + clean source pair, then the REAL two-thread ABBA
+            inversion the witness reports — with both stacks — without
+            the demo ever deadlocking.
+
+Usage::
+
+    python tools/racecheck.py [--tree] [--live] [--demo] [--json PATH]
+
+Default (no flags) is ``--tree``. ``--json`` additionally writes a
+machine-readable report (the shape committed as
+``benchmark/racecheck_report_example.json``).
+"""
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def _finding_dict(f):
+    return {"rule": f.rule, "site": f.site, "message": f.message,
+            "state": f.state, "lock": f.lock,
+            "witness": bool(f.witness)}
+
+
+def run_tree(out):
+    from incubator_mxnet_tpu import analysis
+
+    rep = analysis.racecheck_report(include_runtime=False, name="tree")
+    print(rep.summary())
+    out["tree"] = {
+        "stamp": rep.stamp(),
+        "files": rep.n_files,
+        "entry_points": rep.n_entry_points,
+        "shared_states": rep.n_shared,
+        "lock_edges": len(rep.lock_graph),
+        "findings": [_finding_dict(f) for f in rep.findings],
+    }
+    return len(rep.findings)
+
+
+def run_live(out):
+    import threading
+    import time
+
+    from incubator_mxnet_tpu import serve
+    from incubator_mxnet_tpu.analysis import runtime_report
+    from incubator_mxnet_tpu.telemetry import locks
+
+    locks.enable()
+    locks.reset()
+
+    # Synthetic contended workload: hammer the tracked control-plane
+    # locks from a few threads the way the gateway does — engine lock
+    # nested inside gateway lock, telemetry locks standalone.
+    gw = locks.tracked_lock("live.gateway")
+    eng = locks.tracked_lock("live.engine")
+    tel = locks.tracked_lock("live.telemetry", kind="lock")
+    stop = threading.Event()
+
+    def dispatcher():
+        while not stop.is_set():
+            with gw:
+                with eng:
+                    time.sleep(0.0002)
+
+    def prober():
+        while not stop.is_set():
+            with tel:
+                time.sleep(0.0001)
+            with eng:
+                pass
+
+    threads = [threading.Thread(target=dispatcher, daemon=True)
+               for _ in range(3)]
+    threads += [threading.Thread(target=prober, daemon=True)
+                for _ in range(2)]
+    for t in threads:
+        t.start()
+    time.sleep(1.0)
+    stop.set()
+    for t in threads:
+        t.join(timeout=5.0)
+
+    rep = runtime_report("live")
+    print(rep.summary())
+    print("lock-order graph (runtime):")
+    graph = locks.order_graph()
+    for (a, b), w in sorted(graph.items()):
+        print(f"  {a} -> {b}  (x{w['count']}, first: {w['line']})")
+    if not graph:
+        print("  (no nested acquisitions witnessed)")
+    print()
+    rows = locks.contention_table()
+    print(f"{'lock':<28} {'acq':>8} {'wait_sum_s':>11} {'wait_max_s':>11} "
+          f"{'held_sum_s':>11} {'held_max_s':>11}")
+    for name in sorted(rows):
+        r = rows[name]
+        print(f"{name:<28} {r['acquisitions']:>8} {r['wait_sum_s']:>11.4f} "
+              f"{r['wait_max_s']:>11.6f} {r['held_sum_s']:>11.4f} "
+              f"{r['held_max_s']:>11.6f}")
+    out["live"] = {
+        "stamp": rep.stamp(),
+        "order_graph": [{"edge": f"{a} -> {b}", "count": w["count"],
+                         "first_witness": w["line"]}
+                        for (a, b), w in sorted(graph.items())],
+        "contention": rows,
+        "inversions": [_finding_dict(f) for f in rep.findings],
+    }
+    # a healthy control plane shows contention but no inversions
+    return len(rep.findings)
+
+
+def run_demo(out):
+    from incubator_mxnet_tpu.analysis import (racecheck_fixtures,
+                                              racecheck_source,
+                                              runtime_report)
+    from incubator_mxnet_tpu.telemetry import locks
+
+    demo = {"static": [], "runtime": None}
+    bad_total = 0
+    print("static seeded fixtures (firing / clean twin):")
+    for rule, (bad, ok) in racecheck_fixtures.STATIC_FIXTURES.items():
+        rb = racecheck_source(bad, f"serve/{rule.lower()}_bad.py")
+        ro = racecheck_source(ok, f"serve/{rule.lower()}_ok.py")
+        fired = sorted({f.rule for f in rb.findings})
+        ok_clean = not ro.findings
+        status = "OK" if (fired == [rule] and ok_clean) else "UNEXPECTED"
+        print(f"  {rule}: seeded fires {fired or ['nothing']}, "
+              f"clean twin {'clean' if ok_clean else 'DIRTY'}  [{status}]")
+        for f in rb.findings:
+            print(f"    {f.message}")
+        demo["static"].append({"rule": rule, "fired": fired,
+                               "clean_twin_clean": ok_clean})
+        if status != "OK":
+            bad_total += 1
+
+    print("\nruntime ABBA (two threads, Event-sequenced — cannot "
+          "deadlock, must still be witnessed):")
+    locks.enable()
+    locks.reset()
+    a, b = racecheck_fixtures.run_abba()
+    rep = runtime_report("demo")
+    inv = [f for f in rep.findings if f.rule == "RC005"]
+    print(f"  locks {a} / {b}: {len(inv)} RC005 inversion(s) witnessed")
+    for f in inv:
+        print(f"    {f.message.splitlines()[0]}")
+    demo["runtime"] = {"locks": [a, b], "rc005": len(inv),
+                       "pairs": [f.lock for f in inv]}
+    if len(inv) != 1:
+        bad_total += 1
+    locks.reset()
+    out["demo"] = demo
+    return bad_total
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--tree", action="store_true",
+                    help="static sweep over the control-plane tree "
+                         "(exit 1 on findings)")
+    ap.add_argument("--live", action="store_true",
+                    help="arm the runtime witness, drive a contended "
+                         "workload, dump order graph + contention")
+    ap.add_argument("--demo", action="store_true",
+                    help="run the committed seeded-defect fixtures "
+                         "(each rule firing + clean, ABBA witness)")
+    ap.add_argument("--json", metavar="PATH", default=None,
+                    help="also write a machine-readable report")
+    args = ap.parse_args(argv)
+    if not (args.tree or args.live or args.demo):
+        args.tree = True
+
+    out = {}
+    failures = 0
+    if args.tree:
+        failures += run_tree(out)
+    if args.live:
+        failures += run_live(out)
+    if args.demo:
+        # demo counts *unexpected* outcomes, not the seeded findings
+        failures += run_demo(out)
+    if args.json:
+        with open(args.json, "w", encoding="utf-8") as f:
+            json.dump(out, f, indent=2, sort_keys=True)
+        print(f"\nwrote {args.json}")
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
