@@ -1177,6 +1177,50 @@ def bench_gpt_serve_traced(requests=12, max_slots=4, prompt_max=48,
     return on_tok_s, off_tok_s, overhead_pct
 
 
+def bench_gpt_serve_timeseries(requests=12, max_slots=4, prompt_max=48,
+                               new_max=48, mean_interarrival_s=0.02,
+                               seed=0):
+    """Capacity-observatory cost on the serving hot path (TELEMETRY.md
+    §capacity observatory): the SAME reduced serve trace twice —
+    history sampler + cost ledger disarmed, then armed with an
+    aggressive 10 ms sampling interval (100× the default rate, so the
+    measured figure bounds the production cost from above). Adjacent
+    runs, `bench_gpt_serve_traced` methodology. The armed leg must
+    actually observe the run: nonzero history samples AND nonzero
+    per-tenant device-seconds, else the observatory wasn't wired
+    through the step loop. Returns (tokens/s armed, tokens/s disarmed,
+    overhead %)."""
+    from incubator_mxnet_tpu.telemetry import capacity, timeseries
+
+    kw = dict(requests=requests, max_slots=max_slots,
+              prompt_max=prompt_max, new_max=new_max,
+              mean_interarrival_s=mean_interarrival_s, seed=seed)
+    assert not timeseries.is_enabled() and not capacity.is_enabled(), \
+        "observatory already armed: the off-leg would measure the on-path"
+    off_tok_s = bench_gpt_serve(**kw)[0]
+    timeseries.enable(interval_s=0.01, samples=4096)
+    capacity.enable()
+    try:
+        on_tok_s = bench_gpt_serve(**kw)[0]
+        n_samples = timeseries.sample_count()
+        ledger = capacity.ledger_report()
+    finally:
+        timeseries.disable()
+        timeseries.reset()
+        capacity.disable()
+        capacity.reset()
+    if n_samples == 0:
+        raise RuntimeError(
+            "armed serve run recorded zero history samples — the "
+            "sampler thread never ticked")
+    if ledger["device_seconds_sum"] <= 0:
+        raise RuntimeError(
+            "armed serve run attributed zero device-seconds — the cost "
+            "ledger is not wired through the scheduler step loop")
+    overhead_pct = (off_tok_s - on_tok_s) / off_tok_s * 100.0
+    return on_tok_s, off_tok_s, overhead_pct
+
+
 def bench_gpt_serve_lockwitness(requests=12, max_slots=4, prompt_max=48,
                                 new_max=48, mean_interarrival_s=0.02,
                                 seed=0):
@@ -1424,6 +1468,16 @@ def _collect_serve_extras(extras, _retry, _fail):
         extras["gpt_serve_tracing_overhead_pct"] = round(ovh, 2)
     except Exception as e:  # pragma: no cover
         _fail("gpt_serve_traced", e)
+    try:
+        ts_on, ts_off, ts_ovh = _retry(bench_gpt_serve_timeseries)
+        # capacity-observatory cost (TELEMETRY.md §capacity
+        # observatory): same reduced trace, history sampler + cost
+        # ledger disarmed then armed at a 100×-production sampling rate
+        extras["gpt_serve_timeseries_tokens_s"] = round(ts_on, 1)
+        extras["gpt_serve_unsampled_tokens_s"] = round(ts_off, 1)
+        extras["gpt_serve_timeseries_overhead_pct"] = round(ts_ovh, 2)
+    except Exception as e:  # pragma: no cover
+        _fail("gpt_serve_timeseries", e)
     try:
         won, woff, wovh = _retry(bench_gpt_serve_lockwitness)
         # lock-order-witness cost on the serving hot path (ANALYSIS.md

@@ -61,7 +61,7 @@ import weakref
 
 import numpy as onp
 
-from ..telemetry import registry, tracing
+from ..telemetry import capacity, registry, tracing
 from ..telemetry.locks import tracked_lock
 from ..util import env_int as _env_int
 from . import tenancy
@@ -259,6 +259,7 @@ class ModelRegistry:
                                   policy=policy,
                                   default_deadline=default_deadline,
                                   eos_id=eos_id, seed=seed + i + 997 * j)
+                sched.capacity_model = name   # cost-ledger attribution
                 replicas.append(_Replica(name, j, label, slots, sched))
             models[name] = _Model(name, replicas, share, ReplicaRouter())
         return models
@@ -363,6 +364,7 @@ class GatewayRequest:
                     labels=labels).observe(ttft)
         self.tokens.append(tok)
         self._stream.put(tok)
+        capacity.charge_tokens(self.tenant, self.model)
         views = [{"tenant": self.tenant}, {"model": self.model}]
         if self.replica is not None and self.replica != self.model:
             views.append({"replica": self.replica})
@@ -466,7 +468,46 @@ class Gateway:
         self._driver = None
         self._stop = threading.Event()
         self.preemptions_total = 0
+        self._advisors = {}
+        self._advisor_period = None
+        self._advisor_next_t = None
+        adv = os.environ.get("MXNET_ADVISOR", "")
+        if adv not in ("", "0"):
+            self._arm_advisor(5.0 if adv == "1" else float(adv))
         self._arm_probes()
+
+    def _arm_advisor(self, period_s):
+        """One observe-only `serve.advisor.AutoscaleAdvisor` per model,
+        evaluated every ``period_s`` seconds on the driver thread
+        (``MXNET_ADVISOR``). Arms the timeseries history layer if the
+        caller hasn't — the advisor is blind without it."""
+        from ..telemetry import timeseries
+        from .advisor import AutoscaleAdvisor
+
+        if not timeseries.is_enabled():
+            timeseries.enable()
+        self._advisor_period = float(period_s)
+        self._advisor_next_t = None
+        for name in self._models:
+            self._advisors[name] = AutoscaleAdvisor(name)
+
+    def _advise(self, now):
+        """Periodic advisor tick (driver loop / manual step cadence)."""
+        if not self._advisors:
+            return
+        if self._advisor_next_t is not None \
+                and now < self._advisor_next_t:
+            return
+        self._advisor_next_t = now + self._advisor_period
+        for adv in self._advisors.values():
+            adv.evaluate()
+
+    def advisor_log(self, tail=None):
+        """Merged advisor decision log across models (time-ordered)."""
+        recs = [r for adv in self._advisors.values()
+                for r in adv.decision_log()]
+        recs.sort(key=lambda r: r["t"])
+        return recs if tail is None else recs[-int(tail):]
 
     # -- observability probes (weakly bound: a collected gateway drops
     # -- its series instead of being kept alive by the registry) ----------
@@ -670,6 +711,7 @@ class Gateway:
                     if rep.live or not rep.sched.idle:
                         stepped |= bool(rep.sched.step())
             pumped = self._pump(time.monotonic())
+            self._advise(now)
         return bool(expired or dispatched or stepped or pumped)
 
     def _expire(self, now):
@@ -767,12 +809,23 @@ class Gateway:
         if not req._charged:
             t.bucket.try_debit(req.est_cost, now)   # checked in _can_dispatch
             req._charged = True
+        if req._resume_prompt is None and req.submit_t is not None:
+            # first dispatch only — resumed segments would double-count
+            # the wait (their delay is preemption, not admission)
+            wait = max(now - req.submit_t, 0.0)
+            registry.histogram(
+                "mx_serve_queue_wait_seconds",
+                "gateway admission-queue wait: submit() to first "
+                "dispatch into an engine",
+                labels={"tenant": req.tenant}).observe(wait)
+            capacity.charge_queue_wait(req.tenant, req.model, wait)
         deadline_s = None if req.deadline is None \
             else max(req.deadline - now, 1e-6)
         seg = rep.sched.submit(prompt, req._remaining,
                                temperature=req.temperature,
                                eos_id=req.eos_id, deadline_s=deadline_s,
-                               parent_span=req._spans.get("request", _NULL))
+                               parent_span=req._spans.get("request", _NULL),
+                               tenant=req.tenant)
         req._segment = seg
         req.replica = rep.label
         req.state = "dispatched"

@@ -45,7 +45,17 @@ Six connected parts:
   compute / data_wait / checkpoint / reshard / drain / recovery / idle
   via `lease()` seams in the estimator, dataloader, checkpointer, and
   `ElasticController` (``mx_goodput_seconds_total{state=}``,
-  ``mx_goodput_frac``; fleet-aggregated in `fleet_report()`).
+  ``mx_goodput_frac``; fleet-aggregated in `fleet_report()`);
+- `timeseries` — opt-in ring-buffer history over every registry series
+  (``MXNET_TS_INTERVAL``/``MXNET_TS_SAMPLES``) with windowed queries
+  (`rate`/`delta`/`percentile_over_time`/`window_frac`) — the signal
+  layer the burn-rate alerter and autoscale advisor read;
+- `burnrate`  — SRE-style multi-window multi-burn-rate alerts over the
+  SLO burn gauges (``mx_alert_firing{alert=}``, hysteresis so steady
+  traces never flap; ``MXNET_BURN_WINDOWS``);
+- `capacity`  — per-tenant/per-model cost ledger at the serving seams
+  (tokens, prefill/decode device-seconds, KV page-seconds, queue-wait
+  as ``mx_capacity_*``; rolled up in `fleet_report()`).
 
 Env knobs (registered in `util._ENV_KNOBS`): ``MXNET_TELEMETRY``
 (``1`` = stage + span tracing on, ``raise`` = + NaN guard raising at the
@@ -68,6 +78,9 @@ from . import hbm  # noqa: F401
 from . import fleet  # noqa: F401
 from . import kernels  # noqa: F401
 from . import goodput  # noqa: F401
+from . import timeseries  # noqa: F401
+from . import burnrate  # noqa: F401
+from . import capacity  # noqa: F401
 from .monitor import Monitor, install_nan_hook  # noqa: F401
 
 # arm the host->device byte inlet (a counter inc per transfer — rare
@@ -78,4 +91,5 @@ _nd_mod._H2D_HOOK = registry.add_h2d_bytes
 
 __all__ = ["registry", "stages", "tracing", "slo", "roofline", "monitor",
            "compiles", "hbm", "fleet", "kernels", "goodput", "locks",
+           "timeseries", "burnrate", "capacity",
            "Monitor", "install_nan_hook"]

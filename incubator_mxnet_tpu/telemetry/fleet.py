@@ -635,11 +635,36 @@ def _goodput_view(ranks):
             "worst_data_wait_rank": int(worst)}
 
 
+def _capacity_view(ranks):
+    """Fleet capacity-ledger rollup from each rank's ``mx_capacity_*``
+    series: per-tenant/per-model cost rows summed across ranks (tokens,
+    prefill/decode device-seconds, KV page-seconds, queue-wait). None
+    when no rank has charged any cost yet."""
+    from . import capacity as _capacity
+
+    fleet: dict = {}
+    for s in ranks.values():
+        view = _capacity.capacity_view(s.get("registry") or {})
+        for tenant, per_model in view.items():
+            for model, row in per_model.items():
+                agg = fleet.setdefault(tenant, {}).setdefault(
+                    model, {"tokens": 0, "device_s": {},
+                            "kv_page_s": 0.0, "queue_wait_s": 0.0})
+                agg["tokens"] += row["tokens"]
+                agg["kv_page_s"] += row["kv_page_s"]
+                agg["queue_wait_s"] += row["queue_wait_s"]
+                for phase, v in row["device_s"].items():
+                    agg["device_s"][phase] = \
+                        agg["device_s"].get(phase, 0.0) + v
+    return fleet or None
+
+
 def fleet_report():
     """Gather every rank's snapshot (registry report + barrier stats +
     fault schedule) into per-rank and fleet-aggregate views, score the
     straggler, refresh the `mx_fleet_*` gauges, and roll up the per-rank
-    goodput ledgers (``report["goodput"]``). Collective: every rank must
+    goodput ledgers (``report["goodput"]``) and capacity cost ledgers
+    (``report["capacity"]``). Collective: every rank must
     call it (each gets the same report). Single-process: a 1-rank report
     over the local registry."""
     global _LAST_REPORT
@@ -676,6 +701,7 @@ def fleet_report():
                                     for r, v in scores.items()},
                          "signals": samples},
            "goodput": _goodput_view(ranks),
+           "capacity": _capacity_view(ranks),
            "clock": {"offsets": _CLOCK.get("offsets"),
                      "bound_s": _CLOCK.get("bound_s")}}
     _LAST_REPORT = rep

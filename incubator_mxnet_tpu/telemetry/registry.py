@@ -47,6 +47,7 @@ Prometheus text format for scraping.
 from __future__ import annotations
 
 import json
+import re
 import threading
 
 __all__ = ["counter", "gauge", "histogram", "report", "dump", "exposition",
@@ -57,6 +58,7 @@ __all__ = ["counter", "gauge", "histogram", "report", "dump", "exposition",
 _LOCK = threading.Lock()  # noqa: FL018 - the metric cells back the tracked-lock telemetry itself
 _METRICS: dict = {}          # (name, labels frozenset) -> metric
 _COLLECTORS: list = []       # callables returning {series name: value}
+_PULL_HELP: dict = {}        # pull-gauge base name -> HELP text
 
 # step-time buckets: 100µs .. ~2min in roughly-log steps (seconds)
 _DEFAULT_BUCKETS = (1e-4, 5e-4, 1e-3, 5e-3, 1e-2, 5e-2, 0.1, 0.25, 0.5,
@@ -72,6 +74,11 @@ def _label_str(labels):
         return ""
     inner = ",".join(f'{k}="{v}"' for k, v in labels)
     return "{" + inner + "}"
+
+
+# parses the label suffix a collector bakes into its series keys
+# (built by _label_str, so values never contain an unescaped quote)
+_PULL_LABEL_RE = re.compile(r'(\w+)="([^"]*)"')
 
 
 class _Metric:
@@ -231,7 +238,7 @@ def register_collector(fn):
     return fn
 
 
-def register_pull_gauge(name, probe, help="", labels=None):  # noqa: ARG001
+def register_pull_gauge(name, probe, help="", labels=None):
     """A gauge-typed series whose value is pulled from ``probe()`` at
     every `report()` / `exposition()` — for occupancy-style series whose
     source of truth is live host state in another subsystem (e.g.
@@ -243,9 +250,13 @@ def register_pull_gauge(name, probe, help="", labels=None):  # noqa: ARG001
     registers once per tier). ``probe`` returns a number, or None to
     omit the series this round (the idiom for weakly-bound sources that
     may be gone). Collector-only on purpose: registering a push `Gauge`
-    under the same name would emit the series twice per exposition."""
+    under the same name would emit the series twice per exposition.
+    ``help`` becomes the family's ``# HELP`` line in `exposition()`."""
     series = name + _label_str(tuple(sorted(labels.items()))
                                if labels else ())
+    if help:
+        with _LOCK:
+            _PULL_HELP.setdefault(name, str(help))
 
     def _pull():
         v = probe()
@@ -351,51 +362,100 @@ def dump(path):
     return path
 
 
+def _escape_help(text):
+    return str(text).replace("\\", "\\\\").replace("\n", "\\n")
+
+
+def _escape_label_value(v):
+    return str(v).replace("\\", "\\\\").replace('"', '\\"') \
+        .replace("\n", "\\n")
+
+
+def _escaped_label_str(labels):
+    if not labels:
+        return ""
+    inner = ",".join(f'{k}="{_escape_label_value(v)}"' for k, v in labels)
+    return "{" + inner + "}"
+
+
 def exposition():
-    """Prometheus text exposition (v0.0.4) of every series, for scraping
-    or pushing to a gateway."""
+    """Prometheus text exposition (format v0.0.4) of every series.
+
+    Grammar-compliant for a stock Prometheus scraper (the
+    ``MXNET_TELEMETRY_DUMP`` textfile rides this): one contiguous
+    family per base name — ``# HELP`` (escaped: ``\\`` and newline),
+    ``# TYPE``, then every sample of that family, label values escaped
+    (``\\``, ``"``, newline) and histograms expanded to cumulative
+    ``_bucket{le=}`` rows (closing ``le="+Inf"``) plus ``_sum`` /
+    ``_count``. Pull gauges registered with a ``help`` get a family
+    HELP like push metrics."""
     with _LOCK:
         metrics = list(_METRICS.values())
         collectors = list(_COLLECTORS)
-    typed = set()
-    lines = []
+        pull_help = dict(_PULL_HELP)
+    # families keyed by base name, insertion-ordered: every sample of a
+    # family is emitted under ONE # TYPE header (the text-format
+    # grammar requires families to be contiguous)
+    families = {}                  # base -> {"kind", "help", "rows": []}
+
+    def family(base, kind, help=""):
+        fam = families.get(base)
+        if fam is None:
+            fam = {"kind": kind, "help": help, "rows": []}
+            families[base] = fam
+        elif help and not fam["help"]:
+            fam["help"] = help
+        return fam
+
     for m in metrics:
-        if m.name not in typed:
-            typed.add(m.name)
-            if m.help:
-                lines.append(f"# HELP {m.name} {m.help}")
-            lines.append(f"# TYPE {m.name} {m.kind}")
-        ls = _label_str(m.labels)
+        fam = family(m.name, m.kind, m.help)
+        ls = _escaped_label_str(m.labels)
         snap = m.snapshot()
         if m.kind == "histogram":
             cum = 0
-            base = dict(m.labels)
+            base_labels = list(m.labels)
             for b, c in snap["buckets"].items():
                 cum += c
-                bl = _label_str(tuple(sorted(
-                    list(base.items()) + [("le", repr(b))])))
-                lines.append(f"{m.name}_bucket{bl} {cum}")
-            bl = _label_str(tuple(sorted(
-                list(base.items()) + [("le", "+Inf")])))
-            lines.append(f"{m.name}_bucket{bl} {cum + snap['inf']}")
-            lines.append(f"{m.name}_sum{ls} {snap['sum']}")
-            lines.append(f"{m.name}_count{ls} {snap['count']}")
+                bl = _escaped_label_str(tuple(sorted(
+                    base_labels + [("le", repr(float(b)))])))
+                fam["rows"].append((f"{m.name}_bucket", bl, cum))
+            bl = _escaped_label_str(tuple(sorted(
+                base_labels + [("le", "+Inf")])))
+            fam["rows"].append((f"{m.name}_bucket", bl,
+                                cum + snap["inf"]))
+            fam["rows"].append((f"{m.name}_sum", ls, snap["sum"]))
+            fam["rows"].append((f"{m.name}_count", ls, snap["count"]))
         else:
             v = snap
-            lines.append(f"{m.name}{ls} {0 if v is None else v}")
+            fam["rows"].append((m.name, ls, 0 if v is None else v))
     for fn in collectors:
         try:
-            for name, v in (fn() or {}).items():
-                # collector keys may carry a label suffix; the TYPE
-                # declaration names only the base series, once
-                base = name.split("{", 1)[0]
-                if base not in typed:
-                    typed.add(base)
-                    lines.append(f"# TYPE {base} gauge")
-                lines.append(f"{name} {v}")
+            out = fn() or {}
         except Exception as e:
             _log_collector_failure(fn, e)
             continue
+        for name, v in out.items():
+            # collector keys may carry a baked-in label suffix; the
+            # family is the base name (labels re-escaped for the text
+            # format — report() keys keep the raw form)
+            base, sep, label_part = name.partition("{")
+            fam = family(base, "gauge", pull_help.get(base, ""))
+            if sep:
+                pairs = tuple(
+                    (k, val) for k, val in _PULL_LABEL_RE.findall(
+                        label_part[:-1] if label_part.endswith("}")
+                        else label_part))
+                ls = _escaped_label_str(pairs)
+            else:
+                ls = ""
+            fam["rows"].append((base, ls, v))
+    lines = []
+    for base, fam in families.items():
+        if fam["help"]:
+            lines.append(f"# HELP {base} {_escape_help(fam['help'])}")
+        lines.append(f"# TYPE {base} {fam['kind']}")
+        for name, ls, v in fam["rows"]:
+            lines.append(f"{name}{ls} {v}")
     return "\n".join(lines) + "\n"
 
 

@@ -412,6 +412,32 @@ _ENV_KNOBS = {
         "lower-tier running slots (page-aligned KV kept warm in the "
         "prefix cache for the resume); 0 disables preemption "
         "(honored, this build's addition)"),
+    "MXNET_TS_INTERVAL": (
+        "telemetry.timeseries", "sampling interval in seconds for the "
+        "registry time-series history layer; any value but ''/0 also "
+        "self-arms the sampler at import (default 1.0 once enabled) "
+        "(honored, this build's addition — see TELEMETRY.md)"),
+    "MXNET_TS_SAMPLES": (
+        "telemetry.timeseries", "ring-buffer capacity per series for "
+        "the time-series history layer (default 512 samples; memory is "
+        "bounded at ~16 bytes x samples x series) (honored, this "
+        "build's addition)"),
+    "MXNET_BURN_WINDOWS": (
+        "telemetry.burnrate", "multi-window burn-rate alert spec as "
+        "'<window_s>@<factor>[,...]' (default '300@14.4,3600@6' — the "
+        "SRE fast-5m/slow-1h pair) consumed by burnrate.arm_default() "
+        "(honored, this build's addition)"),
+    "MXNET_ADVISOR": (
+        "serve.Gateway", "arm one observe-only AutoscaleAdvisor per "
+        "gateway model: 1 = evaluate every 5 s on the driver thread, a "
+        "float = that period in seconds; recommendations land in "
+        "Gateway.advisor_log() and mx_advisor_recommendation{action=} "
+        "(honored, this build's addition)"),
+    "MXNET_DRYRUN_CAPACITY": (
+        "__graft_entry__", "opt-out knob for the capacity-observatory "
+        "dry-run subphase (timeseries history + burn alerts + advisor "
+        "diurnal sequence + per-tenant cost attribution); 0 skips it "
+        "(honored, this build's addition)"),
     # -- designed out (XLA/jax owns the mechanism) -------------------------
     "MXNET_ENGINE_TYPE": (
         "(designed out)", "scheduling is XLA async dispatch; value ignored"),

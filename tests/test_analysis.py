@@ -301,7 +301,45 @@ def test_framework_lint_list_rules():
                              "FL006", "FL007", "FL008", "FL009", "FL010",
                              "FL011", "FL012", "FL013",
                              "FL014", "FL015", "FL016", "FL017",
-                             "FL018"}
+                             "FL018", "FL019"}
+
+
+def test_lint_fl019_wallclock_durations():
+    fl = _lint()
+    path = "incubator_mxnet_tpu/telemetry/fake.py"
+    direct = ("import time\n"
+              "def f(t0):\n"
+              "    return time.time() - t0\n")
+    hits = fl.lint_source(direct, path)
+    assert [h.rule for h in hits] == ["FL019"]
+    assigned = ("import time\n"
+                "def f():\n"
+                "    t0 = time.time()\n"
+                "    work()\n"
+                "    return time.time() - t0\n")
+    # both the assigned-name use and the direct subtraction flag
+    assert {h.rule for h in fl.lint_source(assigned, path)} == {"FL019"}
+    # timestamps (no subtraction) are legitimate wall-clock uses
+    stamp = ("import time\n"
+             "def f(rec):\n"
+             "    rec['at'] = time.time()\n"
+             "    return rec\n")
+    assert fl.lint_source(stamp, path) == []
+    # monotonic/perf_counter durations are the sanctioned idiom
+    good = ("import time\n"
+            "def f():\n"
+            "    t0 = time.perf_counter()\n"
+            "    work()\n"
+            "    return time.perf_counter() - t0\n")
+    assert fl.lint_source(good, path) == []
+    # scope: ops/ modules are FL005's turf, not FL019's
+    assert all(h.rule != "FL019" for h in fl.lint_source(
+        direct, "incubator_mxnet_tpu/ops/fake.py"))
+    # noqa escape with a reason
+    excused = ("import time\n"
+               "def f(epoch):\n"
+               "    return time.time() - epoch  # noqa: FL019 - x-host\n")
+    assert fl.lint_source(excused, path) == []
 
 
 # ---------------------------------------------------------------------------

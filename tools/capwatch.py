@@ -1,0 +1,317 @@
+#!/usr/bin/env python
+"""Capacity-observatory viewer: time-series histories, burn-rate alert
+state, the per-tenant cost ledger, and the autoscale advisor's decision
+log (the CLI face of `telemetry.timeseries` / `telemetry.burnrate` /
+`telemetry.capacity` / `serve.advisor` — see TELEMETRY.md "capacity
+observatory").
+
+Modes
+-----
+``--demo`` (default when no mode is given)
+    Run the seeded, wall-clock-free capacity demo: a synthetic diurnal
+    day (trough → steady → surge → flash burst) driven on a VIRTUAL
+    clock through the real observatory stack — registry gauges sampled
+    by `timeseries.sample_now(now=t)`, the default fast/slow burn-rate
+    alerts, per-tenant cost charges, and one `AutoscaleAdvisor`
+    evaluated per tick. Prints occupancy/burn sparklines, the alert
+    transitions, the collapsed recommendation sequence, and the tenant
+    ledger. ``--save FILE`` writes the full report as JSON::
+
+        python tools/capwatch.py --demo --save benchmark/capwatch_demo.json
+
+    The committed fixture ``benchmark/capwatch_demo.json`` is exactly
+    that command's output (virtual clock ⇒ byte-stable).
+
+``--live FILE``
+    Render the capacity view of a Prometheus exposition snapshot — the
+    file ``MXNET_TELEMETRY_DUMP=<path>[:interval]`` keeps fresh, or any
+    saved ``registry.exposition()`` text: firing alerts, the current
+    advisor recommendation, and the per-tenant ``mx_capacity_*``
+    rollup. Re-renders every ``--interval`` seconds until Ctrl-C
+    (``--once`` for a single frame)::
+
+        python tools/capwatch.py --live /var/lib/node_exporter/mx.prom
+
+``--advisor FILE``
+    Tail the advisor decision log from a saved demo/report JSON
+    (``--tail N`` rows, default 12): timestamp, action, and the full
+    evidence-naming reason per recommendation::
+
+        python tools/capwatch.py --advisor benchmark/capwatch_demo.json
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+_SPARKS = "▁▂▃▄▅▆▇█"
+
+
+def sparkline(values, width=48):
+    """Unicode sparkline of `values`, resampled to `width` columns."""
+    vals = [v for v in values if v is not None]
+    if not vals:
+        return "(no data)"
+    if len(vals) > width:
+        step = len(vals) / width
+        vals = [vals[int(i * step)] for i in range(width)]
+    lo, hi = min(vals), max(vals)
+    span = (hi - lo) or 1.0
+    return "".join(_SPARKS[int((v - lo) / span * (len(_SPARKS) - 1))]
+                   for v in vals)
+
+
+# ---------------------------------------------------------------------------
+# --demo: the seeded virtual-clock diurnal run
+# ---------------------------------------------------------------------------
+
+# (segment, span_s, occupancy, queue_depth, burn_rate) — the synthetic
+# day. Burn > 14.4 during the flash burst trips the fast window; the
+# surge pins occupancy above the advisor's up threshold with queue.
+_DEMO_DAY = [
+    ("trough", 420.0, 0.10, 0.0, 0.2),
+    ("steady", 420.0, 0.55, 1.0, 0.8),
+    ("surge", 420.0, 0.92, 6.0, 4.0),
+    ("burst", 240.0, 0.99, 24.0, 20.0),
+    ("recovery", 300.0, 0.50, 0.5, 0.6),
+]
+_DEMO_TENANTS = {"acme": 0.6, "beta": 0.3, "crawl": 0.1}
+_DEMO_DT = 5.0
+
+
+def run_demo():
+    """Drive the REAL observatory stack on a virtual clock; return the
+    report dict (what ``--save`` writes and the fixture commits)."""
+    from incubator_mxnet_tpu.serve.advisor import AutoscaleAdvisor
+    from incubator_mxnet_tpu.telemetry import (burnrate, capacity,
+                                               registry, timeseries)
+
+    registry.reset()
+    timeseries.reset()
+    burnrate.clear()
+    capacity.reset()
+    capacity.enable()
+    timeseries.enable(interval_s=_DEMO_DT, samples=1024, thread=False)
+    burnrate.add("burn_demo", "demo")
+    adv = AutoscaleAdvisor("gpt-demo", fast_window_s=60.0,
+                           slow_window_s=300.0, cooldown_s=120.0,
+                           burst_queue=16, log_len=4096)
+
+    occ = registry.gauge("mx_serve_slot_occupancy",
+                         "decode-slot occupancy fraction")
+    qd = registry.gauge("mx_gateway_queue_depth",
+                        "gateway admission-queue depth",
+                        labels={"priority": "normal"})
+    burn = registry.gauge("mx_slo_error_budget_burn",
+                          "error-budget burn rate",
+                          labels={"slo": "demo"})
+
+    alert_log, occ_hist, burn_hist, seg_of = [], [], [], []
+    t = 0.0
+    for seg, span, o, q, b in _DEMO_DAY:
+        end = t + span
+        while t < end:
+            occ.set(o)
+            qd.set(q)
+            burn.set(b)
+            # the demo's cost ledger: device-seconds track occupancy,
+            # tokens track queue pressure, split across the tenant mix
+            for tenant, w in _DEMO_TENANTS.items():
+                capacity.charge_device_seconds(
+                    tenant, "gpt-demo", "decode", o * _DEMO_DT * w)
+                capacity.charge_device_seconds(
+                    tenant, "gpt-demo", "prefill", 0.2 * o * _DEMO_DT * w)
+                capacity.charge_kv_page_seconds(
+                    tenant, "gpt-demo", 8.0 * o * _DEMO_DT * w)
+                for _ in range(int(1 + q * w)):
+                    capacity.charge_tokens(tenant, "gpt-demo")
+            timeseries.sample_now(now=t)
+            before = set(burnrate.firing())
+            burnrate.evaluate_all(now=t)
+            after = set(burnrate.firing())
+            for name in sorted(after - before):
+                alert_log.append({"t": t, "alert": name, "event": "fire"})
+            for name in sorted(before - after):
+                alert_log.append({"t": t, "alert": name, "event": "clear"})
+            adv.evaluate(now=t)
+            occ_hist.append(o)
+            burn_hist.append(b)
+            seg_of.append(seg)
+            t += _DEMO_DT
+    report = {
+        "mode": "capwatch-demo",
+        "virtual_clock": True,
+        "dt_s": _DEMO_DT,
+        "segments": [{"name": s, "span_s": sp} for s, sp, *_ in _DEMO_DAY],
+        "occupancy": occ_hist,
+        "burn": burn_hist,
+        "segment_of_tick": seg_of,
+        "alerts": alert_log,
+        "alert_state": {a.name: a.state() for a in burnrate.alerts()},
+        "recommendations": adv.recommendations(),
+        "decision_log": adv.decision_log(),
+        "ledger": capacity.ledger_report(),
+        "sample_count": timeseries.sample_count(),
+    }
+    timeseries.disable()
+    burnrate.clear()
+    capacity.disable()
+    return report
+
+
+def format_demo(rep):
+    lines = ["capacity observatory demo — one synthetic day "
+             f"({rep['sample_count']} samples @ {rep['dt_s']:g}s virtual)"]
+    segs = " → ".join(s["name"] for s in rep["segments"])
+    lines.append(f"  segments : {segs}")
+    lines.append(f"  occupancy: {sparkline(rep['occupancy'])}")
+    lines.append(f"  burn rate: {sparkline(rep['burn'])}")
+    lines.append("  alerts:")
+    if not rep["alerts"]:
+        lines.append("    (none fired)")
+    for a in rep["alerts"]:
+        lines.append(f"    t={a['t']:7.1f}s  {a['alert']:<12} {a['event']}")
+    lines.append("  advisor recommendation sequence (collapsed): "
+                 + " → ".join(rep["recommendations"]))
+    lines.append("  tenant ledger:")
+    led = rep["ledger"]
+    for tenant in sorted(led["tenants"]):
+        models = led["tenants"][tenant]
+        for model in sorted(models):
+            c = models[model]
+            dev = sum(c["device_s"].values())
+            lines.append(
+                f"    {tenant:<8} {model:<10} tokens={c['tokens']:>7.0f} "
+                f"device_s={dev:8.1f} kv_page_s={c['kv_page_s']:9.1f}")
+    lines.append(f"  device-seconds sum: {led['device_seconds_sum']:.1f} "
+                 f"(measured wall {led['measured_wall_s']:.1f}s)")
+    return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# --live: render a Prometheus exposition snapshot
+# ---------------------------------------------------------------------------
+
+_SAMPLE_RE = re.compile(
+    r'^(?P<name>[A-Za-z_:][\w:]*)(?:\{(?P<labels>[^}]*)\})?\s+'
+    r'(?P<value>[^\s]+)\s*$')
+_LABEL_RE = re.compile(r'(\w+)="((?:[^"\\]|\\.)*)"')
+
+
+def parse_exposition(text):
+    """Exposition text → [(name, {label: value}, float)], comments
+    skipped (shared with the round-trip grammar test)."""
+    out = []
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        m = _SAMPLE_RE.match(line)
+        if m is None:
+            raise ValueError(f"unparseable exposition line: {line!r}")
+        labels = {k: v.replace('\\"', '"').replace("\\n", "\n")
+                  .replace("\\\\", "\\")
+                  for k, v in _LABEL_RE.findall(m.group("labels") or "")}
+        out.append((m.group("name"), labels, float(m.group("value"))))
+    return out
+
+
+def format_live(text):
+    samples = parse_exposition(text)
+    firing = sorted(l.get("alert", "?") for n, l, v in samples
+                    if n == "mx_alert_firing" and v >= 1)
+    rec = sorted(l.get("action", "?") for n, l, v in samples
+                 if n == "mx_advisor_recommendation" and v >= 1)
+    tenants = {}
+    for name, labels, value in samples:
+        if not name.startswith("mx_capacity_"):
+            continue
+        t = labels.get("tenant", "anon")
+        tenants.setdefault(t, {})[name.replace("mx_capacity_", "")
+                                  ] = tenants.get(t, {}).get(
+            name.replace("mx_capacity_", ""), 0.0) + value
+    lines = ["capacity observatory (exposition snapshot)"]
+    lines.append("  alerts firing : "
+                 + (", ".join(firing) if firing else "(none)"))
+    lines.append("  advisor says  : "
+                 + (", ".join(rec) if rec else "(not armed)"))
+    if tenants:
+        lines.append("  tenants:")
+        for t in sorted(tenants):
+            row = tenants[t]
+            lines.append(
+                f"    {t:<10} "
+                + "  ".join(f"{k}={v:.1f}" for k, v in sorted(row.items())))
+    else:
+        lines.append("  (no mx_capacity_* series in snapshot — is the "
+                     "cost ledger armed?)")
+    return "\n".join(lines)
+
+
+def format_advisor(rep, tail=12):
+    log = rep.get("decision_log") or []
+    lines = [f"advisor decision log ({len(log)} recommendations, "
+             f"showing last {min(tail, len(log))}):"]
+    for r in log[-tail:]:
+        lines.append(f"  t={r['t']:8.1f}s  {r['action']:<10} "
+                     f"n={r['n']}  {r['reason']}")
+    lines.append("collapsed sequence: "
+                 + " → ".join(rep.get("recommendations") or []))
+    return "\n".join(lines)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--demo", action="store_true",
+                    help="seeded virtual-clock diurnal demo (default)")
+    ap.add_argument("--live", metavar="FILE",
+                    help="render a Prometheus exposition snapshot file")
+    ap.add_argument("--advisor", metavar="FILE",
+                    help="render the advisor decision log from a saved "
+                         "demo/report JSON")
+    ap.add_argument("--save", metavar="FILE",
+                    help="(--demo) also write the report JSON here")
+    ap.add_argument("--tail", type=int, default=12,
+                    help="(--advisor) rows to show (default 12)")
+    ap.add_argument("--interval", type=float, default=2.0,
+                    help="(--live) seconds between re-renders")
+    ap.add_argument("--once", action="store_true",
+                    help="(--live) render a single frame and exit")
+    args = ap.parse_args(argv)
+
+    if args.live:
+        import time
+        while True:
+            with open(args.live) as f:
+                print(format_live(f.read()))
+            if args.once:
+                return 0
+            try:
+                time.sleep(args.interval)
+            except KeyboardInterrupt:
+                return 0
+            print()
+    if args.advisor:
+        with open(args.advisor) as f:
+            print(format_advisor(json.load(f), tail=args.tail))
+        return 0
+    # default: demo
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    rep = run_demo()
+    print(format_demo(rep))
+    if args.save:
+        with open(args.save, "w") as f:
+            json.dump(rep, f, indent=1, sort_keys=True)
+            f.write("\n")
+        print(f"saved report to {args.save}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

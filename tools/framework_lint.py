@@ -191,6 +191,22 @@ FL018  tracked-lock provenance (scoped to ``serve/`` / ``fault/`` /
        primitive is structurally required (the metric cells backing
        the tracked locks themselves) — annotate the line with
        ``# noqa: FL018`` and the justifying comment.
+FL019  wall-clock durations (scoped to ``telemetry/`` / ``serve/``
+       module bodies): a duration computed by subtracting
+       ``time.time()`` readings — either a direct
+       ``time.time() - x`` / ``x - time.time()`` expression or a
+       subtraction of names assigned from ``time.time()`` in the same
+       function. ``time.time()`` is NOT monotonic: NTP slews and step
+       corrections make such a "duration" occasionally negative or
+       wildly wrong, which silently corrupts latency histograms, the
+       cost ledger's device-second attribution, and every burn-rate
+       window computed over them. Use ``time.perf_counter()`` (or
+       ``time.monotonic()`` for coarse scheduling deadlines) for
+       anything subtracted; ``time.time()`` stays legitimate as an
+       absolute wall-clock TIMESTAMP (log lines, snapshot metadata).
+       Where a wall-clock delta is genuinely wanted (cross-host epoch
+       math), annotate the line with ``# noqa: FL019`` and the
+       justifying comment.
 
 Usage
 -----
@@ -284,6 +300,12 @@ RULES = {
              "the mx_lock_* contention series; use telemetry.locks."
              "tracked_lock(name) (telemetry/locks.py itself exempt), "
              "or `# noqa: FL018` with a reason",
+    "FL019": "telemetry//serve/ wall-clock duration: subtracting "
+             "time.time() readings — NTP slew makes the delta "
+             "non-monotonic, corrupting latency histograms and the "
+             "capacity cost ledger; use time.perf_counter() (or "
+             "time.monotonic()) for durations, keep time.time() for "
+             "absolute timestamps, or `# noqa: FL019` with a reason",
 }
 
 _INDEXING_NAME_PARTS = ("getitem", "setitem", "index", "slice")
@@ -1029,6 +1051,69 @@ def _check_tracked_locks(tree, path, findings, src_lines):
 
 
 # ---------------------------------------------------------------------------
+# FL019 — wall-clock durations (telemetry/ + serve/ modules)
+# ---------------------------------------------------------------------------
+
+def _is_time_time_call(node):
+    return (isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "time"
+            and isinstance(node.func.value, ast.Name)
+            and node.func.value.id == "time")
+
+
+def _check_wallclock_durations(tree, path, findings, src_lines):
+    norm = path.replace(os.sep, "/")
+    if not any(d in norm for d in ("/serve/", "/telemetry/")):
+        return
+
+    def noqa(lineno):
+        line = src_lines[lineno - 1] if lineno - 1 < len(src_lines) else ""
+        return "noqa: FL019" in line
+
+    def flag(node, what):
+        if noqa(node.lineno):
+            return
+        findings.append(LintFinding(
+            path, node.lineno, "FL019",
+            f"duration from wall-clock time.time() ({what}) — NTP "
+            "slew/step makes the delta non-monotonic, silently "
+            "corrupting latency/cost series; use time.perf_counter() "
+            "(or time.monotonic()), or `# noqa: FL019` with a reason"))
+
+    # pass 1: direct `time.time() - x` / `x - time.time()`
+    for node in ast.walk(tree):
+        if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Sub) \
+                and (_is_time_time_call(node.left)
+                     or _is_time_time_call(node.right)):
+            flag(node, "direct subtraction of a time.time() reading")
+
+    # pass 2: per function, names assigned from time.time() later used
+    # as a Sub operand in the same function body
+    for fn in ast.walk(tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        wall_names = set()
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign) \
+                    and _is_time_time_call(node.value):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        wall_names.add(tgt.id)
+        if not wall_names:
+            continue
+        for node in ast.walk(fn):
+            if isinstance(node, ast.BinOp) \
+                    and isinstance(node.op, ast.Sub):
+                for side in (node.left, node.right):
+                    if isinstance(side, ast.Name) \
+                            and side.id in wall_names:
+                        flag(node, f"`{side.id}` was assigned from "
+                                   "time.time() in this function")
+                        break
+
+
+# ---------------------------------------------------------------------------
 # FL009 — paged-serving hazards (serve/ modules only)
 # ---------------------------------------------------------------------------
 
@@ -1466,6 +1551,7 @@ def lint_source(src, path, coverage_text=None, telemetry_text=None):
     _check_sharding_hygiene(tree, path, findings)
     _check_placement_provenance(tree, path, findings, src.splitlines())
     _check_tracked_locks(tree, path, findings, src.splitlines())
+    _check_wallclock_durations(tree, path, findings, src.splitlines())
     _check_paged_hazards(tree, path, findings)
     _check_span_hygiene(tree, path, findings)
     _check_collective_hygiene(tree, path, findings, src.splitlines())

@@ -30,8 +30,8 @@ from __future__ import annotations
 
 import json
 
-__all__ = ["TraceEvent", "synth_trace", "save_trace", "load_trace",
-           "replay", "percentile"]
+__all__ = ["TraceEvent", "synth_trace", "diurnal_trace", "save_trace",
+           "load_trace", "replay", "percentile"]
 
 
 class TraceEvent:
@@ -133,6 +133,63 @@ def synth_trace(n, models, tenants, seed=0, duration_s=2.0,
                                     max_new_range[1] + 1)),
             seed=int(rng.randint(0, 2**31 - 1))))
     return events
+
+
+def diurnal_trace(models, tenants, seed=0, trough_s=2.0, steady_s=2.0,
+                  surge_s=2.0, burst_s=0.5, trough_rate=2.0,
+                  steady_rate=8.0, surge_rate=40.0, burst_rate=160.0,
+                  prompt_mean=24, prompt_sigma=0.4, prompt_max=None,
+                  max_new_range=(4, 16)):
+    """A seeded DIURNAL trace: trough → steady → surge → flash burst —
+    the capacity observatory's acceptance fixture (ISSUE 17).
+
+    Four contiguous segments with fixed per-segment Poisson rates (req/s
+    of trace time; replay scales them with ``time_scale``). Unlike
+    `synth_trace`'s Markov-modulated phases, segment boundaries here are
+    NAMED and deterministic, so a test can assert the autoscale
+    advisor's recommendation per segment: scale_down (or hold) in the
+    trough, zero flaps across steady, scale_up through the surge, and a
+    bigger scale_up on the flash burst.
+
+    Returns ``(events, segments)`` where ``segments`` is
+    ``[(name, t_start, t_end), ...]`` in trace time.
+    """
+    import numpy as onp
+
+    rng = onp.random.RandomState(seed)
+    model_names = sorted(models)
+    model_p = onp.array([models[m] for m in model_names], float)
+    model_p /= model_p.sum()
+    tenant_names = sorted(tenants)
+    tenant_p = onp.array([tenants[t][0] for t in tenant_names], float)
+    tenant_p /= tenant_p.sum()
+    plan = [("trough", trough_s, trough_rate),
+            ("steady", steady_s, steady_rate),
+            ("surge", surge_s, surge_rate),
+            ("burst", burst_s, burst_rate)]
+    events, segments, t0 = [], [], 0.0
+    for name, span, rate in plan:
+        segments.append((name, t0, t0 + span))
+        t = t0 + float(rng.exponential(1.0 / rate))
+        while t < t0 + span:
+            plen = int(onp.clip(rng.lognormal(onp.log(prompt_mean),
+                                              prompt_sigma), 1,
+                                prompt_max or 4 * prompt_mean))
+            tenant = tenant_names[rng.choice(len(tenant_names),
+                                             p=tenant_p)]
+            events.append(TraceEvent(
+                t=t,
+                model=model_names[rng.choice(len(model_names),
+                                             p=model_p)],
+                tenant=tenant,
+                priority=tenants[tenant][1],
+                prompt_len=plen,
+                max_new=int(rng.randint(max_new_range[0],
+                                        max_new_range[1] + 1)),
+                seed=int(rng.randint(0, 2**31 - 1))))
+            t += float(rng.exponential(1.0 / rate))
+        t0 += span
+    return events, segments
 
 
 def percentile(values, q):
