@@ -52,6 +52,13 @@ extras:
   across three priority tiers on a seeded bursty (Markov-modulated)
   trace from tools/loadgen; per-tier TTFT, preemption total, per-tenant
   token rates (SERVING.md §gateway).
+- gpt_serve_elastic_chips_hours_ratio (+ _scale_events,
+  _ttft_compliance, _tokens_s): the elastic replica control plane on a
+  seeded diurnal day — controller-live (AutoscaleAdvisor →
+  ReplicaSetController spawns/drains mid-replay, every spawn warmed
+  before routing) vs a static peak fleet; the ratio is live
+  replica-seconds over the static fleet's, gated < 1 (SERVING.md
+  §elastic replicas).
 - gpt_serve_sharded_tokens_s vs _1dev_tokens_s (+ _ttft_p50/p99_ms,
   _replicas): the same seeded trace through 2 replicas x tp=4
   mesh-sharded engines behind the gateway router vs one unsharded
@@ -1009,6 +1016,167 @@ def bench_gpt_gateway(requests=30, seed=0):
     return out
 
 
+def bench_gpt_serve_elastic(seed=0, max_replicas=2):
+    """Elastic replica control plane on the diurnal day (SERVING.md
+    §elastic replicas, ISSUE 18): the SAME seeded
+    `loadgen.diurnal_trace` day (trough → steady → surge → flash burst)
+    replayed through one tiny GPT model two ways — (a) CONTROLLER-LIVE:
+    the fleet starts at ``min_replicas=1`` and the `AutoscaleAdvisor`'s
+    recommendations (evaluated over real short-window occupancy/queue
+    history) drive `ReplicaSetController` spawns and drains mid-replay;
+    (b) STATIC PEAK: ``max_replicas`` engines pinned for the whole day.
+
+    Durable metrics: the **chips·hours ratio** — live replica-seconds
+    integrated from the controller's scale-event journal over the
+    static fleet's ``max_replicas × wall`` — the capacity the
+    controller hands back outside the surge; the live leg's high-tier
+    `slo.gateway_ttft` compliance (riding the curve must not melt
+    latency — threshold is CPU-generous because a spawn's warmup
+    compiles on the step thread here; on TPU the programs come from the
+    compile cache); the scale-event count; and the per-replica
+    zero-post-publication-compile gate (every spawned replica had BOTH
+    program families warmed BEFORE it took traffic).
+
+    Loud-failure contract: failed requests on either leg, zero scale
+    events, a post-publication compile on any live replica, SLO
+    non-compliance, or a chips·hours ratio that doesn't clear the
+    static fleet raises — it lands in extras["errors"], never passes
+    as a small number."""
+    from incubator_mxnet_tpu import serve
+    from incubator_mxnet_tpu.models.gpt import gpt_tiny
+    from incubator_mxnet_tpu.serve.advisor import AutoscaleAdvisor
+    from incubator_mxnet_tpu.telemetry import slo
+    from incubator_mxnet_tpu.telemetry import timeseries as ts
+
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "tools"))
+    try:
+        import loadgen
+    finally:
+        sys.path.pop(0)
+
+    vocab, max_len = 1000, 64
+    rng = onp.random.RandomState(seed)
+
+    def make_gateway(replicas):
+        net = gpt_tiny(vocab_size=vocab, max_length=max_len, dropout=0.0)
+        net.initialize()
+        reg = serve.ModelRegistry(total_pages=24 * max_replicas)
+        reg.add("gpt", net, max_slots=2, max_len=max_len,
+                replicas=replicas)
+        return serve.Gateway(reg, tenants={"acme": {"weight": 2.0},
+                                           "beta": {"weight": 1.0}})
+
+    def warm_all(gw):
+        # drive every prefill chunk bucket + decode through EVERY
+        # replica directly (the router won't round-robin reliably), out
+        # of the measured window — the same families the controller's
+        # own warmup covers for spawned replicas
+        for rep in gw._models["gpt"].replicas:
+            for warm_len in (12, 24, 48):
+                seg = rep.sched.submit(
+                    rng.randint(0, vocab, (warm_len,)).astype(onp.int32),
+                    2)
+                while not seg.done:
+                    rep.sched.step()
+
+    events, _segments = loadgen.diurnal_trace(
+        models={"gpt": 1.0},
+        tenants={"acme": (2.0, "high"), "beta": (1.0, "normal")},
+        seed=seed, trough_s=4.0, steady_s=4.0, surge_s=4.0, burst_s=1.5,
+        trough_rate=0.5, steady_rate=2.0, surge_rate=30.0,
+        burst_rate=80.0, prompt_mean=16, prompt_max=36,
+        max_new_range=(16, 26))
+
+    # -- leg (a): controller-live from one replica --------------------------
+    gw = make_gateway(1)
+    ts.enable(interval_s=0.25, samples=8192)
+    gw._advisor_period = 0.5
+    gw._advisor_next_t = None
+    gw._advisors = {"gpt": AutoscaleAdvisor(
+        "gpt", up_occupancy=0.65, down_occupancy=0.25, fast_window_s=1.5,
+        slow_window_s=4.0, cooldown_s=3.0, burst_queue=6)}
+    ctl = gw.enable_elastic(min_replicas=1, max_replicas=max_replicas,
+                            warm_lens=(12, 24, 48), warm_new=2)
+    warm_all(gw)
+    base_programs = {r.label: r.slots.xla_program_count()
+                    for r in gw._models["gpt"].replicas}
+    obj = slo.gateway_ttft("high", threshold_s=20.0, target=0.6,
+                           name="elastic_live_high")
+    try:
+        t0 = time.monotonic()
+        live = loadgen.replay(gw, events, vocab, timeout=300.0)
+        t1 = time.monotonic()
+        for _ in range(8):
+            gw.step()                    # retire finished drains
+        if live["failed"]:
+            raise RuntimeError(
+                f"{len(live['failed'])} live-leg requests failed; "
+                f"first: {live['failed'][0]}")
+        journal = ctl.scale_log()
+        if not journal:
+            raise RuntimeError(
+                "controller produced zero scale events across the "
+                "diurnal day — the advisor loop never closed")
+        # zero post-publication compiles: every live replica's program
+        # count still equals its publication/warmup snapshot
+        for rep in gw._models["gpt"].replicas:
+            want = ctl.warm_programs.get(rep.label,
+                                         base_programs.get(rep.label))
+            got = rep.slots.xla_program_count()
+            if want is None or got != want:
+                raise RuntimeError(
+                    f"replica {rep.label} compiled after publication: "
+                    f"{want} -> {got}")
+        res = obj.evaluate()
+        if not res["ok"]:
+            raise RuntimeError(
+                f"live-leg high-tier TTFT SLO violated: {res}")
+        slo_compliance = res["compliance"]
+        # chips·seconds: integrate replica count over the replay wall
+        # from the journal (each entry's n is the post-mutation count)
+        chip_s, n_prev, t_prev = 0.0, 1, t0
+        for ev in journal:
+            t = min(max(ev["t"], t0), t1)
+            chip_s += n_prev * (t - t_prev)
+            n_prev, t_prev = ev["n"], t
+        chip_s += n_prev * (t1 - t_prev)
+        live_wall = t1 - t0
+    finally:
+        slo.tracker().remove("elastic_live_high")
+        gw.shutdown(drain=False)
+
+    # -- leg (b): static peak fleet -----------------------------------------
+    gw2 = make_gateway(max_replicas)
+    try:
+        warm_all(gw2)
+        static = loadgen.replay(gw2, events, vocab, timeout=300.0)
+        if static["failed"]:
+            raise RuntimeError(
+                f"{len(static['failed'])} static-leg requests failed; "
+                f"first: {static['failed'][0]}")
+    finally:
+        gw2.shutdown(drain=False)
+
+    ratio = (chip_s / live_wall) / float(max_replicas)
+    if not (0.0 < ratio < 1.0):
+        raise RuntimeError(
+            f"elastic chips·hours ratio {ratio:.3f} does not clear the "
+            f"static {max_replicas}-replica fleet (mean live replicas "
+            f"{chip_s / live_wall:.2f})")
+    return {
+        "chips_hours_ratio": ratio,
+        "scale_events": len(journal),
+        "scale_ups": sum(1 for e in journal if e["direction"] == "up"),
+        "ttft_compliance": slo_compliance,
+        "live_completed": live["completed"],
+        "static_completed": static["completed"],
+        "live_tokens_s": sum(t["tokens"]
+                             for t in live["per_tier"].values())
+        / live["wall_s"],
+    }
+
+
 def bench_gpt_serve_sharded(requests=16, max_slots=4, prompt_max=40,
                             new_max=20, tp=4, n_replicas=2, seed=0):
     """Pod-scale sharded serving (SERVING.md §pod-scale): the SAME
@@ -1530,6 +1698,21 @@ def _collect_serve_extras(extras, _retry, _fail):
             extras[f"gpt_gateway_{tenant}_tokens_s"] = round(rate, 1)
     except Exception as e:  # pragma: no cover
         _fail("gpt_gateway", e)
+    try:
+        el = _retry(bench_gpt_serve_elastic)
+        # the elastic control plane on the diurnal day: capacity handed
+        # back vs a static peak fleet, with the live leg's latency SLO
+        # and the zero-post-publication-compile gate (SERVING.md
+        # §elastic replicas)
+        extras["gpt_serve_elastic_chips_hours_ratio"] = \
+            round(el["chips_hours_ratio"], 3)
+        extras["gpt_serve_elastic_scale_events"] = int(el["scale_events"])
+        extras["gpt_serve_elastic_ttft_compliance"] = \
+            round(el["ttft_compliance"], 3)
+        extras["gpt_serve_elastic_tokens_s"] = \
+            round(el["live_tokens_s"], 1)
+    except Exception as e:  # pragma: no cover
+        _fail("gpt_serve_elastic", e)
     try:
         # pod-scale replicated+sharded serving, in its own 8-device
         # child process (see _bench_serve_sharded_subprocess): wall

@@ -25,13 +25,24 @@ rebuilt on the jax runtime):
   old epoch fails its next collective with
   :class:`~..parallel.dist.StaleGenerationError` (non-retryable, loud)
   instead of deadlocking the fleet;
-- **reshard**: the post-shrink layout is pre-flighted through the
+- **reshard**: the post-transition layout is pre-flighted through the
   `analysis.shardcheck` spec tier BEFORE anything commits — a layout
   that would silently replicate (SC001) or blow the HBM budget (SC006)
   aborts the transition with :class:`ElasticTransitionAborted` naming
   the finding; then `DataParallel.rebuild` re-compiles the step on the
-  shrunk mesh carrying params + optimizer momenta host-side, and
+  new mesh carrying params + optimizer momenta host-side, and
   `gluon.data.ElasticSampler.reshard` re-strides the unconsumed data.
+
+The machinery is direction-agnostic: :meth:`ElasticController.transition`
+``grow=N`` is the exact REVERSE of shrink — recovered/new ranks
+rendezvous into a *larger* roster at a later membership epoch
+(`dist.rendezvous` auto-detects re-admission and adopts the fleet's
+committed generation), the wider layout is shardcheck-pre-flighted,
+checkpoints reshard UP across device counts via the same layout
+sidecar, and the sampler re-strides its unconsumed remainder
+exactly-once. Survivors discover pending re-admissions via
+`dist.pending_rejoins`; the chaos fixture is the ``topology_change``
+seam's ``grow=N`` kind.
 
 Checkpoints round-trip through the same machinery: `checkpoint_layout`
 is the rich ``layout_fn`` for `preemption.TrainingCheckpointer` (mesh
@@ -373,10 +384,14 @@ class ElasticController:
             # multi-process: the seam firing HERE (e.g. @rank-targeted)
             # means this rank is the departure; peers see our marker.
             # single-process: simulate the fleet shrinking to e.shrink
-            # local devices.
+            # (or growing to e.grow) local devices.
+            if e.grow is not None:
+                return ("grow", e.grow)
             return ("leave", e) if multi else ("shrink", e.shrink)
         if multi and preemption.preempted():
             return ("leave", "preemption")
+        if multi and dist.pending_rejoins():
+            return ("grow", None)
         if multi and dist.pending_departures():
             return ("shrink", None)
         if multi and self._crashed_ranks():
@@ -386,7 +401,8 @@ class ElasticController:
     # -- state machine -------------------------------------------------------
     def poll(self):
         """Run one trigger check at a drained step boundary; transition
-        if one fired. Returns ``"stable" | "shrunk" | "leave"``."""
+        if one fired. Returns ``"stable" | "shrunk" | "grown" |
+        "leave"``."""
         if not elastic_enabled():
             return "stable"
         trig = self._pending_trigger()
@@ -395,7 +411,15 @@ class ElasticController:
         kind, detail = trig
         if kind == "leave":
             return self._leave(detail)
+        if kind == "grow":
+            return self.transition(grow=(detail if detail else True))
         return self.transition(shrink=detail)
+
+    def rejoin(self):
+        """Departed/new-rank side of a grow: rendezvous back into the
+        fleet at its next membership epoch (`dist.rendezvous` handles
+        the re-admission bookkeeping). Returns ``"grown"``."""
+        return self.transition(grow=True)
 
     def _leave(self, why):
         from ..parallel import dist
@@ -416,21 +440,29 @@ class ElasticController:
             self.on_leave(why)
         return "leave"
 
-    def transition(self, shrink=None):
-        """Drain -> pre-flight -> rendezvous -> reshard. Raises
+    def transition(self, shrink=None, grow=None):
+        """Drain -> pre-flight -> rendezvous -> reshard, in either
+        direction: ``shrink=N`` contracts the membership, ``grow=N``
+        (or ``True`` for the default doubling) widens it — recovered
+        ranks re-admit at a later epoch and checkpoints/params reshard
+        UP through the same layout sidecar. Raises
         :class:`ElasticTransitionAborted` (pre-flight) BEFORE any state
         commits; afterwards the fleet is on generation N+1."""
         from ..parallel import dist
         from ..telemetry import goodput, registry, tracing
 
+        growing = grow is not None
+        was_active = dist.is_active()
         t0 = time.perf_counter()
         # goodput attribution: the whole transition is `reshard` except
         # the rendezvous wait (`drain`) and the drain-point checkpoint
         # write (`checkpoint`, leased inside atomic_save) — inner leases
         # win, the outer lease keeps the preflight/rebuild remainder
-        with tracing.span("elastic.transition", shrink=int(shrink or 0)), \
+        with tracing.span("elastic.transition", shrink=int(shrink or 0),
+                          grow=int(grow or 0)), \
                 goodput.lease("reshard"):
-            new_mesh = self._shrunk_mesh(shrink)
+            new_mesh = (self._grown_mesh(grow) if growing
+                        else self._shrunk_mesh(shrink))
             if new_mesh is not None and self.trainer is not None:
                 specs = self._preflight(new_mesh)   # raises on SC001/SC006
             else:
@@ -439,8 +471,15 @@ class ElasticController:
                 # drain point: a rank that restarts instead of resharding
                 # in place resumes from here across the layout change
                 self.checkpointer.save_now()
+            min_ranks = self.min_ranks
+            if growing:
+                # the wider roster must include every pending re-admission
+                # or the settle could commit without the very ranks this
+                # transition exists to welcome back
+                min_ranks = max(min_ranks, len(dist.active_ranks())
+                                + len(dist.pending_rejoins()))
             with goodput.lease("drain"):
-                gen, members = dist.rendezvous(min_ranks=self.min_ranks,
+                gen, members = dist.rendezvous(min_ranks=min_ranks,
                                                timeout_s=self.drain_s)
             if new_mesh is not None and self.trainer is not None:
                 self.trainer.rebuild(new_mesh, param_shardings=specs)
@@ -449,6 +488,14 @@ class ElasticController:
             registry.counter(
                 "mx_elastic_transitions_total",
                 "committed elastic membership-epoch transitions").inc()
+            registry.counter(
+                "mx_elastic_scale_events_total",
+                "committed elastic scale events by direction",
+                labels={"direction": "up" if growing else "down"}).inc()
+            if growing and was_active:
+                # the survivor-side count; a re-admitting rank counts
+                # itself inside dist.rendezvous instead
+                dist._count_readmission()
             registry.gauge(
                 "mx_elastic_generation",
                 "current membership epoch (dist.generation)").set(gen)
@@ -458,6 +505,7 @@ class ElasticController:
                 "reshard").set(elapsed)
             tracing.event("elastic.transition", generation=gen,
                           members=len(members or ()),
+                          direction="up" if growing else "down",
                           devices=(int(new_mesh.devices.size)
                                    if new_mesh is not None else 0),
                           seconds=round(elapsed, 3))
@@ -470,7 +518,7 @@ class ElasticController:
             "%s, %.3fs", gen, len(members or ()),
             (f", {int(new_mesh.devices.size)} local device(s)"
              if new_mesh is not None else ""), elapsed)
-        return "shrunk"
+        return "grown" if growing else "shrunk"
 
     def _reshard_sampler(self, members):
         import jax
@@ -516,6 +564,43 @@ class ElasticController:
             return None
         shape[da] = dp_new
         devs = list(old.devices.flatten())[:n_new]
+        return make_mesh([(nm, shape[nm]) for nm in names], devices=devs)
+
+    def _grown_mesh(self, grow):
+        """Post-grow LOCAL mesh — the exact mirror of `_shrunk_mesh`:
+        single-process runs widen the data axis back onto the first
+        ``grow`` devices of the process (the shrink kept the device
+        prefix, so growing re-extends it; default: doubling, capped at
+        the device count). Multi-process fleets return None — only the
+        roster changes."""
+        import jax
+
+        from ..parallel.mesh import make_mesh
+
+        tr = self.trainer
+        if tr is None or getattr(tr, "mesh", None) is None:
+            return None
+        if jax.process_count() > 1:
+            return None
+        old = tr.mesh
+        n_old = int(old.devices.size)
+        n_avail = len(jax.devices())
+        names = list(old.axis_names)
+        shape = dict(zip(names, old.devices.shape))
+        da = tr._data_axis if tr._data_axis in shape else names[0]
+        other = 1
+        for nm, s in shape.items():
+            if nm != da:
+                other *= int(s)
+        n_new = (int(grow) if grow and grow is not True
+                 else min(n_avail, n_old * 2))
+        n_new = min(n_new, n_avail)
+        dp_new = max(1, n_new // other)
+        n_new = dp_new * other
+        if n_new <= n_old:
+            return None
+        shape[da] = dp_new
+        devs = list(jax.devices())[:n_new]
         return make_mesh([(nm, shape[nm]) for nm in names], devices=devs)
 
     def _preflight(self, new_mesh):

@@ -36,7 +36,10 @@ Schedule grammar (comma-separated entries)::
   seam's payload: raise :class:`TopologyChanged` carrying the
   post-transition world size ``N`` — the deterministic membership-loss
   fixture `fault/elastic.py`'s chaos gate replays; with ``@rank``
-  targeting, that one rank "dies" and its survivors re-rendezvous).
+  targeting, that one rank "dies" and its survivors re-rendezvous), or
+  ``grow=N`` (the reverse direction: :class:`TopologyChanged` carrying
+  the LARGER post-transition world size — recovered/new ranks re-admit
+  at the next membership epoch, `fault/elastic.py`'s scale-UP fixture).
 
 Seams (where the probes live):
 
@@ -70,10 +73,23 @@ Seams (where the probes live):
                              straggler for `telemetry/fleet.py`
 ``topology_change``          `fault/elastic.ElasticController.poll` step
                              boundary — deterministic mid-run membership
-                             loss. Default kind ``topology``
-                             (:class:`TopologyChanged`); ``shrink=N``
-                             names the post-transition world size, and
+                             change. Default kind ``topology``
+                             (:class:`TopologyChanged`); ``shrink=N`` /
+                             ``grow=N`` name the post-transition world
+                             size (smaller / larger roster), and
                              ``@rank`` makes ONE specific process die
+``replica_crash``            `serve/elastic.ReplicaSetController.tick`
+                             per-replica liveness probe — the serve-plane
+                             analogue of ``topology_change``. ``@N``
+                             targets the REPLICA INDEX (not the process
+                             rank): replica ``model#N`` "dies" and the
+                             controller must replace it with its queued
+                             work re-dispatched
+``replica_spawn``            `serve/elastic.ReplicaSetController` spawn
+                             body, AFTER the engine is built but BEFORE
+                             registration — the failed-spawn rollback
+                             fixture (fleet must stay at N replicas, no
+                             half-registered replica)
 ===========================  ==============================================
 
 Off-path contract: when no schedule is configured, ``_SCHEDULE is None``
@@ -95,7 +111,8 @@ __all__ = ["FaultInjected", "InjectedResourceExhausted", "TopologyChanged",
 SEAMS = ("dataloader_worker", "dataloader_worker_exit", "kvstore_push",
          "kvstore_pull", "kvstore_barrier", "dist_init", "h2d",
          "checkpoint_write", "estimator_step", "serve_step",
-         "gateway_step", "collective_delay", "topology_change")
+         "gateway_step", "collective_delay", "topology_change",
+         "replica_crash", "replica_spawn")
 
 
 class FaultInjected(RuntimeError):
@@ -135,24 +152,28 @@ class InjectedResourceExhausted(FaultInjected):
 
 class TopologyChanged(FaultInjected):
     """The ``topology_change`` seam fired: the membership is about to
-    shrink. NOT a transient (``non_retryable``): retry policies must let
+    change. NOT a transient (``non_retryable``): retry policies must let
     it surface to `fault.elastic.ElasticController`, which turns it into
-    an epoch transition. ``shrink`` is the post-transition world size
-    (``None`` = lose exactly the ``@rank``-targeted process)."""
+    an epoch transition. ``shrink`` is the smaller post-transition world
+    size (``None`` = lose exactly the ``@rank``-targeted process);
+    ``grow`` is the LARGER one (re-admission / scale-up direction) —
+    at most one of the two is set."""
 
     non_retryable = True
 
-    def __init__(self, seam, draw, shrink=None):
+    def __init__(self, seam, draw, shrink=None, grow=None):
         RuntimeError.__init__(
             self,
             f"injected topology change at seam '{seam}' (draw #{draw}, "
-            f"shrink={shrink}, MXNET_FAULT_INJECT)")
+            f"shrink={shrink}, grow={grow}, MXNET_FAULT_INJECT)")
         self.seam = seam
         self.draw = draw
         self.shrink = shrink
+        self.grow = grow
 
     def __reduce__(self):
-        return (TopologyChanged, (self.seam, self.draw, self.shrink))
+        return (TopologyChanged,
+                (self.seam, self.draw, self.shrink, self.grow))
 
 
 _KINDS = {"fault": FaultInjected, "oom": InjectedResourceExhausted}
@@ -162,10 +183,10 @@ _TOPOLOGY_KIND = "topology"      # raises TopologyChanged (with .shrink)
 
 class _SeamState:
     __slots__ = ("prob", "seed", "limit", "kind", "rng", "draws", "fired",
-                 "rank", "shrink")
+                 "rank", "shrink", "grow")
 
     def __init__(self, prob, seed=0, limit=None, kind="fault", rank=None,
-                 shrink=None):
+                 shrink=None, grow=None):
         import random
 
         self.prob = float(prob)
@@ -174,14 +195,17 @@ class _SeamState:
         kind, _, arg = str(kind).partition("=")
         if kind == "shrink":      # "shrink=N" sugar for kind topology
             kind, shrink = _TOPOLOGY_KIND, arg
+        elif kind == "grow":      # "grow=N": the scale-UP direction
+            kind, grow = _TOPOLOGY_KIND, arg
         if kind not in _KINDS and kind not in (_DELAY_KIND, _TOPOLOGY_KIND):
             raise ValueError(
                 f"unknown fault kind {kind!r} (valid: "
                 f"{', '.join((*_KINDS, _DELAY_KIND, _TOPOLOGY_KIND))}"
-                ", shrink=N)")
+                ", shrink=N, grow=N)")
         self.kind = kind
         self.rank = None if rank is None else int(rank)
         self.shrink = None if shrink in (None, "") else int(shrink)
+        self.grow = None if grow in (None, "") else int(grow)
         self.rng = random.Random(self.seed)
         self.draws = 0
         self.fired = 0
@@ -348,20 +372,24 @@ def _collective_probe():
     inject_at("collective_delay")
 
 
-def inject_at(seam):
+def inject_at(seam, index=None):
     """Probe point: no-op unless the armed schedule names `seam`, in which
     case a seeded Bernoulli draw decides whether to fire — raising
     :class:`FaultInjected` (kinds ``fault``/``oom``) or sleeping
     ``MXNET_FAULT_DELAY_MS`` (kind ``delay``). Draw order is
     deterministic per seam; an ``@rank``-targeted seam draws only on
-    that rank (so each rank's sequence stays deterministic)."""
+    that rank (so each rank's sequence stays deterministic). When the
+    caller passes ``index`` (the serve plane's per-replica probes), the
+    ``@N`` suffix targets THAT index instead of the process rank —
+    ``replica_crash@1`` kills replica #1 wherever it lives."""
     sched = _SCHEDULE
     if sched is None:                 # the dead branch
         return
     st = sched.get(seam)
     if st is None:
         return
-    if st.rank is not None and st.rank != _self_rank():
+    if st.rank is not None and st.rank != (
+            _self_rank() if index is None else int(index)):
         return
     with _LOCK:
         st.draws += 1
@@ -393,7 +421,7 @@ def inject_at(seam):
             time.sleep(d)
             return
         if st.kind == _TOPOLOGY_KIND:
-            raise TopologyChanged(seam, draw, st.shrink)
+            raise TopologyChanged(seam, draw, st.shrink, st.grow)
         raise _KINDS[st.kind](seam, draw)
 
 
@@ -408,6 +436,6 @@ def schedule_info():
                             "limit": st.limit, "kind": st.kind,
                             "rank": st.rank,
                             "draws": st.draws, "fired": st.fired},
-                           **({"shrink": st.shrink}
+                           **({"shrink": st.shrink, "grow": st.grow}
                               if st.kind == _TOPOLOGY_KIND else {}))
                 for seam, st in sched.items()}

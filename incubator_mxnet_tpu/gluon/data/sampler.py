@@ -94,15 +94,25 @@ class ElasticSampler(Sampler):
         mine = -(-total // self._num_shards) if total > 0 else 0
         return max(0, mine - self._pos)
 
-    def reshard(self, num_shards, index):
+    def reshard(self, num_shards, index, consumed=None):
         """Re-partition the unconsumed remainder across a new world.
-        Call at a drained step boundary (all ranks consumed equally)."""
+        Call at a drained step boundary (all ranks consumed equally).
+
+        `consumed` is the re-admission path (the GROW direction of an
+        elastic transition): a rank joining mid-epoch holds a FRESH
+        sampler that drew nothing locally, so the frozen prefix cannot
+        be derived from ``_pos`` — the survivors broadcast the
+        fleet-wide consumed count (``length - survivor.remaining()``)
+        and the rejoiner passes it here. Survivors leave it None."""
         if not 0 <= index < num_shards:
             raise ValueError(
                 f"ElasticSampler.reshard: index {index} ∉ [0, {num_shards})")
-        consumed = min(len(self._perm) - self._base,
-                       self._pos * self._num_shards)
-        self._base += consumed
+        if consumed is None:
+            consumed = min(len(self._perm) - self._base,
+                           self._pos * self._num_shards)
+            self._base += consumed
+        else:
+            self._base = min(len(self._perm), max(0, int(consumed)))
         self._num_shards = int(num_shards)
         self._index = int(index)
         self._pos = 0

@@ -49,7 +49,7 @@ __all__ = ["initialize", "is_initialized", "rank", "num_processes",
            "allreduce", "broadcast", "barrier", "exchange_objs",
            "generation", "active_ranks", "world_size", "is_active",
            "check_generation", "rendezvous", "pending_departures",
-           "StaleGenerationError"]
+           "pending_rejoins", "StaleGenerationError"]
 
 _LOG = logging.getLogger("incubator_mxnet_tpu.parallel.dist")
 
@@ -615,6 +615,14 @@ def rendezvous(min_ranks=1, timeout_s=None, settle_s=None, leave=False):
     the epoch over in place (the in-process chaos tests drive the same
     state machine).
 
+    The reverse direction is automatic: a rank that previously left
+    (``not is_active()``) calling ``rendezvous(leave=False)`` is a
+    RE-ADMISSION — it adopts the fleet's committed generation (so its
+    next epoch lands after every transition it missed), clears its stale
+    departure marker, and posts a ``mx/elastic/rejoin/<rank>`` marker so
+    survivors discover the grow via :func:`pending_rejoins` and meet it
+    at the wider roster's commit barrier.
+
     Returns ``(generation, members)``.
     """
     import time
@@ -626,12 +634,34 @@ def rendezvous(min_ranks=1, timeout_s=None, settle_s=None, leave=False):
     if settle_s is None:
         settle_s = min(0.5, max(0.05, timeout_s / 8))
     next_gen = _STATE["generation"] + 1
+    rejoin = not leave and not is_active()
     if not is_initialized() or jax.process_count() == 1:
         _STATE["generation"] = next_gen
         _STATE["members"] = () if leave else None
+        if rejoin:
+            _count_readmission()
         return next_gen, (() if leave else active_ranks())
     client = _coord_client()
     me = jax.process_index()
+    if rejoin:
+        from ..fault.retry import suppressed as _sup
+
+        fleet_gen = _fleet_generation(client)
+        if fleet_gen is not None:
+            next_gen = max(next_gen, int(fleet_gen) + 1)
+        try:
+            # the departure marker is ours to retract — survivors must
+            # stop seeing this rank as a pending shrink
+            client.key_value_delete(f"{_ELASTIC_PFX}/leave/{me:03d}")
+        except Exception as e:
+            _sup("dist.rendezvous.clear_leave", e)
+        try:
+            client.key_value_set_bytes(f"{_ELASTIC_PFX}/rejoin/{me:03d}",
+                                       b"1")
+        except Exception as e:
+            _sup("dist.rendezvous.rejoin_marker", e)
+        _LOG.info("dist.rendezvous: rank %d re-admitting at generation %d",
+                  me, next_gen)
     pfx = f"{_ELASTIC_PFX}/g{next_gen}"
     if leave:
         from ..fault.retry import suppressed as _suppressed
@@ -707,6 +737,12 @@ def rendezvous(min_ranks=1, timeout_s=None, settle_s=None, leave=False):
                                    b"1")
     except Exception as e:
         suppressed("dist.rendezvous.commit", e)   # peers raced the marker
+    if rejoin:
+        try:
+            client.key_value_delete(f"{_ELASTIC_PFX}/rejoin/{me:03d}")
+        except Exception as e:
+            suppressed("dist.rendezvous.clear_rejoin", e)
+        _count_readmission()
     _LOG.info("dist.rendezvous: generation %d committed, members=%s",
               next_gen, roster)
     return next_gen, roster
@@ -736,6 +772,43 @@ def pending_departures():
         except ValueError:
             pass
     return tuple(sorted(gone & set(active_ranks())))
+
+
+def pending_rejoins():
+    """Ranks that posted a re-admission marker but are not yet in the
+    active membership — the survivor-side trigger for a GROW-direction
+    elastic transition (`fault/elastic.ElasticController` turns it into
+    ``transition(grow=...)``, the reverse of :func:`pending_departures`).
+    Returns a sorted tuple; empty when not multi-process or nothing is
+    pending."""
+    import jax
+
+    if not is_initialized() or jax.process_count() == 1:
+        return ()
+    from ..fault.retry import suppressed
+
+    try:
+        entries = _coord_client().key_value_dir_get(
+            f"{_ELASTIC_PFX}/rejoin/")
+    except Exception as e:
+        suppressed("dist.pending_rejoins", e)
+        return ()
+    back = set()
+    for k, _v in entries:
+        try:
+            back.add(int(str(k).rsplit("/", 1)[-1]))
+        except ValueError:
+            pass
+    return tuple(sorted(back - set(active_ranks())))
+
+
+def _count_readmission():
+    from ..telemetry import registry
+
+    registry.counter(
+        "mx_elastic_readmissions_total",
+        "ranks re-admitted into a larger membership at a later epoch "
+        "(the grow direction of an elastic transition)").inc()
 
 
 def _reset_membership():

@@ -45,7 +45,17 @@ Three connected parts:
   ``ModelRegistry.add(..., replicas=N, mesh=...)`` fronts N replica
   engines behind :class:`ReplicaRouter` least-loaded + prefix-affinity
   dispatch with drain-free `Gateway.hot_swap` weight rolls
-  (SERVING.md §pod-scale).
+  (SERVING.md §pod-scale);
+- `elastic`   — the closed loop over the capacity observatory:
+  :class:`ReplicaSetController` (armed by ``MXNET_ELASTIC_SERVE``)
+  consumes `AutoscaleAdvisor` recommendations and resizes the LIVE
+  replica set — scale-up spawns, warms (both program families, zero
+  cold compiles on the request path) and publishes a new replica on a
+  rebalanced page budget; scale-down drains and retires; a replica
+  killed by the ``replica_crash`` chaos seam is replaced with its
+  in-flight work re-queued (zero failed requests); a fault mid-spawn
+  (``replica_spawn`` seam) rolls back to exactly N replicas
+  (SERVING.md §elastic replicas, RESILIENCE.md §8).
 
 Observability and chaos ride the existing subsystems: the registry
 carries ``mx_serve_ttft_seconds``, ``mx_serve_tokens_total``,
@@ -77,6 +87,7 @@ Typical use::
 from __future__ import annotations
 
 from . import api  # noqa: F401
+from . import elastic  # noqa: F401
 from . import engine  # noqa: F401
 from . import gateway  # noqa: F401
 from . import router  # noqa: F401
@@ -84,6 +95,7 @@ from . import scheduler  # noqa: F401
 from . import sharded  # noqa: F401
 from . import tenancy  # noqa: F401
 from .api import ServeEngine  # noqa: F401
+from .elastic import ReplicaScaleError, ReplicaSetController  # noqa: F401
 from .engine import (PageAllocator, PagePoolExhausted,  # noqa: F401
                      PrefixCache, SlotDecoder)
 from .gateway import Gateway, GatewayRequest, ModelRegistry  # noqa: F401
@@ -100,6 +112,7 @@ __all__ = ["ServeEngine", "SlotDecoder", "Scheduler", "Request",
            "Gateway", "GatewayRequest", "ModelRegistry",
            "ServeLayout", "ShardedSlotDecoder", "ReplicaRouter",
            "serve_mesh", "replica_meshes",
+           "ReplicaSetController", "ReplicaScaleError",
            "Tenant", "TokenBucket", "WDRRQueue",
-           "api", "engine", "gateway", "router", "scheduler",
-           "sharded", "tenancy"]
+           "api", "elastic", "engine", "gateway", "router",
+           "scheduler", "sharded", "tenancy"]

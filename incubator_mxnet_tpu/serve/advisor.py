@@ -165,3 +165,16 @@ class AutoscaleAdvisor:
             if not seq or seq[-1] != rec["action"]:
                 seq.append(rec["action"])
         return seq
+
+    def pending_action(self, since_t=None):
+        """The newest non-hold recommendation STRICTLY newer than
+        ``since_t`` — the `serve.elastic.ReplicaSetController` consume
+        surface (the controller remembers the timestamp it acted on, so
+        one recommendation is never acted on twice). Returns the
+        decision dict or None."""
+        for rec in reversed(self._log):
+            if since_t is not None and rec["t"] <= since_t:
+                return None
+            if rec["action"] != "hold":
+                return rec
+        return None

@@ -86,9 +86,13 @@ class _Replica:
     sharded `serve.sharded.ShardedSlotDecoder`) + Scheduler pair, plus
     the gateway-side list of live (dispatched) requests. ``label`` is
     the metric/census identity — ``"<model>"`` for a single-replica
-    model (the pre-replica series names), ``"<model>#<i>"`` otherwise."""
+    model (the pre-replica series names), ``"<model>#<i>"`` otherwise.
+    ``draining`` marks a replica the elastic controller is retiring:
+    the router stops dispatching to it while its in-flight work
+    finishes (`serve/elastic.py` owns the flag and the replica list)."""
 
-    __slots__ = ("model", "index", "label", "slots", "sched", "live")
+    __slots__ = ("model", "index", "label", "slots", "sched", "live",
+                 "draining")
 
     def __init__(self, model, index, label, slots, sched):
         self.model = model
@@ -97,6 +101,7 @@ class _Replica:
         self.slots = slots
         self.sched = sched
         self.live = []                    # dispatched GatewayRequests
+        self.draining = False
 
 
 class _Model:
@@ -186,13 +191,67 @@ class ModelRegistry:
         return hasattr(obj, "prefill_chunk_step") \
             and hasattr(obj, "allocator")
 
+    def rebalance_pages(self, name, n_replicas):
+        """THE page-budget split: per-replica page count for model
+        `name` at `n_replicas` replicas — used both at construction
+        (`_build`) and by `serve.elastic.ReplicaSetController` every
+        time the replica count changes, so the two can never disagree.
+        Returns None when there is no joint budget (``total_pages``
+        unset). Raises `PagePoolExhausted` LOUDLY when the model's cut
+        cannot fund that many replicas (< 4 pages each) — a replica the
+        budget cannot pay for must be refused, never silently
+        over-committed."""
+        if self.total_pages is None:
+            return None
+        spec = self._specs.get(name)
+        if spec is None:
+            raise ValueError(f"unknown model {name!r} (registered: "
+                             f"{', '.join(sorted(self._specs))})")
+        total_share = sum(s for _, s, _, _, _ in self._specs.values())
+        cut = int(self.total_pages * spec[1] / total_share)
+        per = cut // max(1, int(n_replicas))
+        if per < 4:
+            raise PagePoolExhausted(
+                f"model {name!r}: {n_replicas} replica(s) cannot be "
+                f"funded from its {cut}-page cut of the "
+                f"{self.total_pages}-page budget (every replica needs "
+                ">= 4 pages) — lower the replica count, raise "
+                "total_pages, or raise the model's share")
+        return per
+
+    def build_engine(self, name, mesh=None, n_pages=None):
+        """Construct ONE fresh engine for `name` from its registered
+        spec — the elastic controller's scale-up path (the construction
+        path is `_build`). Pre-built-decoder entries carry no recipe to
+        rebuild from; scaling those needs a factory passed to the
+        controller."""
+        spec = self._specs.get(name)
+        if spec is None:
+            raise ValueError(f"unknown model {name!r} (registered: "
+                             f"{', '.join(sorted(self._specs))})")
+        block, _share, kw, _n_rep, _mesh = spec
+        if self._is_engine(block) or (
+                isinstance(block, (list, tuple))
+                and all(self._is_engine(b) for b in block)):
+            raise ValueError(
+                f"model {name!r} was registered with pre-built "
+                "decoder(s) — there is no recipe to build another; "
+                "pass factories={...} to the elastic controller")
+        rkw = dict(kw)
+        if n_pages is not None:
+            rkw["n_pages"] = int(n_pages)
+        if mesh is not None:
+            from .sharded import ShardedSlotDecoder
+
+            return ShardedSlotDecoder(block, mesh=mesh, **rkw)
+        return SlotDecoder(block, **rkw)
+
     def _build(self, policy, max_queue, default_deadline, eos_id, seed):
         from .router import ReplicaRouter, replica_meshes
 
         if not self._specs:
             raise ValueError("ModelRegistry is empty — add() a model "
                              "before constructing the Gateway")
-        total_share = sum(s for _, s, _, _, _ in self._specs.values())
         models = {}
         for i, (name, (block, share, kw,
                        n_rep, mesh)) in enumerate(self._specs.items()):
@@ -239,8 +298,7 @@ class ModelRegistry:
                     rkw = dict(kw)
                     if self.total_pages is not None \
                             and "n_pages" not in rkw:
-                        cut = int(self.total_pages * share / total_share)
-                        rkw["n_pages"] = max(4, cut // n_rep)
+                        rkw["n_pages"] = self.rebalance_pages(name, n_rep)
                     if meshes[j] is not None:
                         from .sharded import ShardedSlotDecoder
 
@@ -452,6 +510,13 @@ class Gateway:
         if preempt is None:
             preempt = bool(_env_int("MXNET_GATEWAY_PREEMPT", 1))
         self.preempt_enabled = bool(preempt)
+        self._registry = models
+        # the controller rebuilds schedulers for spawned replicas with
+        # the same knobs the construction path used
+        self._build_params = {"policy": policy,
+                              "max_queue": engine_max_queue,
+                              "default_deadline": deadline_s,
+                              "eos_id": eos_id, "seed": seed}
         self._models = models._build(policy, engine_max_queue, deadline_s,
                                      eos_id, seed)
         self._queues = {t: tenancy.WDRRQueue(quantum) for t in self.tiers}
@@ -474,7 +539,26 @@ class Gateway:
         adv = os.environ.get("MXNET_ADVISOR", "")
         if adv not in ("", "0"):
             self._arm_advisor(5.0 if adv == "1" else float(adv))
+        self._elastic = None
+        es = os.environ.get("MXNET_ELASTIC_SERVE", "")
+        if es not in ("", "0"):
+            self.enable_elastic()
         self._arm_probes()
+
+    def enable_elastic(self, **kwargs):
+        """Arm the `serve.elastic.ReplicaSetController` (the
+        ``MXNET_ELASTIC_SERVE=1`` path does this automatically): the
+        controller is ticked from every `step()` and acts on advisor
+        recommendations, drains/spawns replicas, and replaces dead
+        ones. kwargs forward to the controller ctor (min_replicas,
+        max_replicas, factories, warm_lens...). Returns the
+        controller."""
+        from .elastic import ReplicaSetController
+
+        ctl = ReplicaSetController(self, **kwargs)
+        with self._lock:
+            self._elastic = ctl
+        return ctl
 
     def _arm_advisor(self, period_s):
         """One observe-only `serve.advisor.AutoscaleAdvisor` per model,
@@ -526,25 +610,43 @@ class Gateway:
 
         for m in self._models.values():
             for rep in m.replicas:
-                sref = weakref.ref(rep.slots)
+                self._arm_replica_probe(rep)
 
-                def _free(sref=sref):
-                    s = sref()
-                    alloc = None if s is None \
-                        else getattr(s, "allocator", None)
-                    if alloc is None:
-                        return None
-                    return alloc.free_pages
-                registry.register_pull_gauge(
-                    "mx_serve_replica_free_pages", _free,
-                    "free KV pool pages per serving replica (the "
-                    "router's least-loaded signal)",
-                    labels={"replica": rep.label})
+        for name in self._models:
+            def _nrep(name=name, ref=ref):
+                gw = ref()
+                if gw is None:
+                    return None
+                m = gw._models.get(name)
+                return None if m is None else len(m.replicas)
+            registry.register_pull_gauge(
+                "mx_serve_replicas", _nrep,
+                "live replica count per served model (moves when the "
+                "elastic controller scales/replaces)",
+                labels={"model": name})
 
         def _flight(ref=ref):
             gw = ref()
             return None if gw is None else gw._flight_state()
         tracing.register_flight_context("gateway", _flight)
+
+    def _arm_replica_probe(self, rep):
+        """Per-replica free-page pull gauge — also called by the
+        elastic controller for every replica it spawns."""
+        sref = weakref.ref(rep.slots)
+
+        def _free(sref=sref):
+            s = sref()
+            alloc = None if s is None \
+                else getattr(s, "allocator", None)
+            if alloc is None:
+                return None
+            return alloc.free_pages
+        registry.register_pull_gauge(
+            "mx_serve_replica_free_pages", _free,
+            "free KV pool pages per serving replica (the "
+            "router's least-loaded signal)",
+            labels={"replica": rep.label})
 
     def _flight_state(self):
         """Queue/slot snapshot for the flight recorder: what was queued
@@ -712,7 +814,9 @@ class Gateway:
                         stepped |= bool(rep.sched.step())
             pumped = self._pump(time.monotonic())
             self._advise(now)
-        return bool(expired or dispatched or stepped or pumped)
+            scaled = (self._elastic.tick(now)
+                      if self._elastic is not None else 0)
+        return bool(expired or dispatched or stepped or pumped or scaled)
 
     def _expire(self, now):
         """Fail gateway-queued requests past their deadline — INCLUDING
@@ -736,13 +840,19 @@ class Gateway:
     def _rep_capacity(self, rep):
         """Slots this replica can still absorb this step: free slots
         minus work already staged in its engine queue (the engine
-        admits those first)."""
+        admits those first). A draining replica absorbs nothing — the
+        router must never dispatch to it."""
+        if rep.draining:
+            return 0
         return rep.sched.free_slots - rep.sched.queue_depth
 
     def _capacity(self, m):
         """Best replica headroom for `m` (the model can dispatch if ANY
-        replica can)."""
-        return max(self._rep_capacity(rep) for rep in m.replicas)
+        replica can). ``default=0``: a model transiently at zero
+        replicas (a crash whose replacement spawn failed) queues its
+        work instead of crashing the step loop."""
+        return max((self._rep_capacity(rep) for rep in m.replicas),
+                   default=0)
 
     def _pick_victim(self, m, tier):
         """Lowest-priority / least-progressed running request across

@@ -124,8 +124,12 @@ class ReplicaRouter:
     def pick(self, replicas, prompt=None, tenant=None, viable=None):
         """The replica to dispatch to, or None when `replicas` is empty
         / nothing passes `viable`. `viable` is the gateway's capacity
-        (or capacity-after-preemption) predicate."""
-        cands = [r for r in replicas if viable is None or viable(r)]
+        (or capacity-after-preemption) predicate. A replica marked
+        ``draining`` by the elastic controller is never picked, even
+        for callers routing without a viability predicate."""
+        cands = [r for r in replicas
+                 if not getattr(r, "draining", False)
+                 and (viable is None or viable(r))]
         if not cands:
             return None
         if len(cands) == 1:

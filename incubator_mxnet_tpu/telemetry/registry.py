@@ -215,6 +215,13 @@ def _get_or_make(cls, name, help, labels, **kwargs):
         elif not isinstance(m, cls):
             raise TypeError(
                 f"metric {name!r} already registered as {m.kind}")
+        elif kwargs.get("buckets") is not None \
+                and tuple(kwargs["buckets"]) != m.buckets:
+            # an explicit spec that silently loses to an earlier
+            # registration corrupts every downstream bucket read
+            raise ValueError(
+                f"histogram {name!r} already registered with buckets "
+                f"{m.buckets}, conflicting with {tuple(kwargs['buckets'])}")
     return m
 
 

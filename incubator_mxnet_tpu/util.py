@@ -247,11 +247,36 @@ _ENV_KNOBS = {
         "rendezvous waits for the roster to settle before committing "
         "the survivor set (default 20) (honored, this build's addition "
         "— see RESILIENCE.md)"),
+    "MXNET_ELASTIC_SERVE": (
+        "serve.Gateway", "1 = arm a serve.elastic.ReplicaSetController "
+        "on the gateway driver loop: AutoscaleAdvisor recommendations "
+        "are ACTED on (spawn/drain replicas), crashed replicas are "
+        "replaced with their queued work re-dispatched (default off — "
+        "the advisor stays observe-only) (honored, this build's "
+        "addition — see SERVING.md)"),
+    "MXNET_ELASTIC_MIN_REPLICAS": (
+        "serve.elastic.ReplicaSetController", "smallest per-model "
+        "replica count the controller may drain to, and the floor it "
+        "heals back up to after a crash (default 1) (honored, this "
+        "build's addition — see SERVING.md)"),
+    "MXNET_ELASTIC_MAX_REPLICAS": (
+        "serve.elastic.ReplicaSetController", "largest per-model "
+        "replica count a scale-up may commit — the page budget is "
+        "rebalanced against this ceiling before any engine is built "
+        "(default 8) (honored, this build's addition — see "
+        "SERVING.md)"),
     "MXNET_DRYRUN_ELASTIC": (
         "__graft_entry__ dryrun_multichip", "1 = force the 2-process "
         "elastic-departure subphase (rank-1 topology_change seam, "
         "survivor re-rendezvous); 0 = skip; unset = runs only in the "
         "spawned dryrun child (honored, this build's addition)"),
+    "MXNET_DRYRUN_ELASTIC_UP": (
+        "__graft_entry__ dryrun_multichip", "1 = force the 2-process "
+        "elastic scale-UP subphase (rank-1 departs via the "
+        "topology_change seam, then re-admits at generation 2 and a "
+        "generation-threaded collective runs over the re-widened "
+        "roster); 0 = skip; unset = runs only in the spawned dryrun "
+        "child (honored, this build's addition)"),
     "MXNET_DRYRUN_GOODPUT": (
         "__graft_entry__ dryrun_multichip", "1 = force the 2-process "
         "goodput-ledger subphase (chaos shrink + checkpoint + resume; "

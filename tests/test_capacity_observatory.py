@@ -122,14 +122,14 @@ def test_window_frac_and_avg():
 
 def test_histogram_series_expand_to_count_and_sum():
     timeseries.enable(interval_s=1.0, samples=16, thread=False)
-    h = registry.histogram("t_lat_seconds", "test latencies")
+    h = registry.histogram("t_cap_lat_seconds", "test latencies")
     h.observe(0.1)
     timeseries.sample_now(now=0.0)
     h.observe(0.3)
     h.observe(0.5)
     timeseries.sample_now(now=1.0)
-    assert timeseries.delta("t_lat_seconds:count", 10.0, now=1.0) == 2
-    assert timeseries.delta("t_lat_seconds:sum", 10.0, now=1.0) == \
+    assert timeseries.delta("t_cap_lat_seconds:count", 10.0, now=1.0) == 2
+    assert timeseries.delta("t_cap_lat_seconds:sum", 10.0, now=1.0) == \
         pytest.approx(0.8)
 
 

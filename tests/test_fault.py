@@ -836,6 +836,119 @@ def test_elastic_controller_disabled_is_noop():
     assert injection.schedule_info()["topology_change"]["fired"] == 0
 
 
+def test_topology_seam_grow_parse_and_classification():
+    from incubator_mxnet_tpu.fault.injection import TopologyChanged
+
+    injection.configure_injection("topology_change:1.0:3:2:grow=8")
+    info = injection.schedule_info()["topology_change"]
+    assert info["kind"] == "topology"
+    assert info["grow"] == 8 and info["shrink"] is None
+    with pytest.raises(TopologyChanged) as ei:
+        injection.inject_at("topology_change")
+    assert ei.value.grow == 8 and ei.value.shrink is None
+    # a grow is still a membership event, not a transient fault
+    assert ei.value.non_retryable
+    assert retry.classify_exception(ei.value) == "fatal"
+    import pickle
+    e2 = pickle.loads(pickle.dumps(ei.value))
+    assert isinstance(e2, TopologyChanged) and e2.grow == 8
+
+
+def test_elastic_chaos_grow_roundtrip_convergence(_fast_retries):
+    """ISSUE 18 acceptance gate: a seeded 8 -> 4 -> 8 round-trip
+    (shrink at step 0, grow back at step 1, both at drained step
+    boundaries) converges to the SAME final loss as the unfaulted run,
+    lands at membership generation 2 with a readmission counted, fails
+    a stale-generation collective loudly, and the goodput ledger's
+    states sum to wall."""
+    import jax.numpy as jnp
+
+    from incubator_mxnet_tpu.fault.elastic import ElasticController
+    from incubator_mxnet_tpu.parallel import dist
+    from incubator_mxnet_tpu.parallel.mesh import make_mesh
+    from incubator_mxnet_tpu.telemetry import goodput
+
+    rng = onp.random.RandomState(0)
+    X = rng.uniform(-1, 1, (64, 4)).astype("float32")
+    w = rng.uniform(-1, 1, (4, 1)).astype("float32")
+    Y = X @ w
+
+    def run(chaos):
+        dist._reset_membership()
+        injection.clear_injection()
+        net, dp = _make_dp(make_mesh({"dp": 8}))
+        ctl = ElasticController(trainer=dp)
+        losses = []
+        if chaos:
+            injection.configure_injection(
+                "topology_change:1.0:11:1:shrink=4")
+        for step in range(12):
+            losses.append(float(dp.step(X, Y)))
+            verdict = ctl.poll()            # drained step boundary
+            if chaos and step == 0:
+                assert verdict == "shrunk"
+                injection.configure_injection(
+                    "topology_change:1.0:7:1:grow=8")
+            elif chaos and step == 1:
+                assert verdict == "grown"
+                injection.clear_injection()
+        injection.clear_injection()
+        return losses, dp
+
+    losses_a, _ = run(chaos=False)
+    r0 = _counter("mx_elastic_readmissions_total")
+    goodput.enable()
+    goodput.reset()
+    try:
+        losses_b, dp_b = run(chaos=True)
+        gp = goodput.report()
+    finally:
+        goodput.disable()
+        goodput.reset()
+
+    # the round-trip preserved the trajectory and the full device set
+    assert abs(losses_a[-1] - losses_b[-1]) <= 0.02, (
+        losses_a[-1], losses_b[-1])
+    assert int(dp_b.mesh.devices.size) == 8
+    assert dist.generation() == 2
+    assert _gauge("mx_elastic_generation") == 2
+    # the grow was attributed: a readmission, an up scale event
+    assert _counter("mx_elastic_readmissions_total") >= r0 + 1
+    # a collective still holding generation 1 (pre-grow) fails loudly
+    with pytest.raises(dist.StaleGenerationError):
+        dist.allreduce(jnp.ones(2), generation=1)
+    # the goodput ledger accounted the transitions: states sum to wall
+    assert gp["wall_s"] > 0
+    assert abs(sum(gp["states"].values()) - gp["wall_s"]) \
+        <= 0.05 * gp["wall_s"] + 1e-3
+    assert gp["states"]["reshard"] > 0
+    # post-grow layout is shardcheck-clean
+    rep = dp_b.shardcheck_report()
+    assert not [f for f in rep.findings if f.severity == "error"], (
+        rep.findings)
+
+
+def test_elastic_sampler_exactly_once_across_shrink_then_grow():
+    from incubator_mxnet_tpu.gluon.data import ElasticSampler
+
+    # two ranks draw, the world shrinks to 1, draws more, then grows
+    # back to 2 — every index appears EXACTLY once across all phases
+    s0 = ElasticSampler(24, num_shards=2, index=0, shuffle=True, seed=7)
+    s1 = ElasticSampler(24, num_shards=2, index=1, shuffle=True, seed=7)
+    it0, it1 = iter(s0), iter(s1)
+    drawn = [next(it0) for _ in range(3)] + [next(it1) for _ in range(3)]
+    s0.reshard(num_shards=1, index=0)           # shrink: rank 1 departed
+    it0 = iter(s0)
+    drawn += [next(it0) for _ in range(4)]
+    consumed = 24 - s0.remaining()              # what survivors broadcast
+    s0.reshard(num_shards=2, index=0)           # grow: a rank re-admitted
+    s1b = ElasticSampler(24, num_shards=2, index=1, shuffle=True, seed=7)
+    s1b.reshard(num_shards=2, index=1, consumed=consumed)
+    rest = list(s0) + list(s1b)
+    assert sorted(drawn + rest) == list(range(24))
+    assert s0.remaining() == 0 and s1b.remaining() == 0
+
+
 # ---------------------------------------------------------------------------
 # lint FL006
 # ---------------------------------------------------------------------------

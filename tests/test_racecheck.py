@@ -240,27 +240,37 @@ def test_disarmed_tracked_lock_is_raw_primitive():
 
 def test_disarmed_acquire_release_within_3pct():
     """The committed <3% gate. Both sides are the same class when
-    disarmed, so this measures measurement noise — min-of-N makes it
-    stable."""
+    disarmed, so this measures measurement noise — min-of-N with the
+    two sides INTERLEAVED makes it stable: back-to-back phases let a
+    CPU-frequency or scheduler shift land entirely on one side and bias
+    the ratio on busy single-core runners."""
     was = locks.is_enabled()
     locks.disable()
     try:
         tracked = locks.tracked_lock("test.offpath.timing", kind="lock")
         raw = threading.Lock()
 
-        def bench(lk):
+        def rep(lk):
             acquire, release = lk.acquire, lk.release
-            best = float("inf")
-            for _ in range(7):
-                t0 = time.perf_counter()
-                for _ in range(20000):
-                    acquire()
-                    release()
-                best = min(best, time.perf_counter() - t0)
-            return best
+            t0 = time.perf_counter()
+            for _ in range(50000):
+                acquire()
+                release()
+            return time.perf_counter() - t0
 
-        bench(raw), bench(tracked)          # warm both paths
-        ratio = bench(tracked) / bench(raw)
+        rep(raw), rep(tracked)              # warm both paths
+        # min-of-N converges on the true floor (noise only ever adds
+        # time), so a genuine >3% overhead fails every attempt while a
+        # scheduler hiccup fails at most one — retry is sound here
+        ratio = float("inf")
+        for _attempt in range(3):
+            best_raw = best_tracked = float("inf")
+            for _ in range(9):
+                best_raw = min(best_raw, rep(raw))
+                best_tracked = min(best_tracked, rep(tracked))
+            ratio = min(ratio, best_tracked / best_raw)
+            if ratio < 1.03:
+                break
         assert ratio < 1.03, f"disarmed overhead ratio {ratio:.4f}"
     finally:
         if was:

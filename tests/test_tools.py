@@ -928,6 +928,76 @@ def test_fl018_tree_is_clean():
 
 
 # ---------------------------------------------------------------------------
+# framework_lint FL020 — serve/ replica-set choke point (ISSUE 18)
+# ---------------------------------------------------------------------------
+
+def test_fl020_flags_replica_list_mutations_outside_choke_point():
+    src = ("class Gateway:\n"
+           "    def grow(self, m, rep):\n"
+           "        m.replicas.append(rep)\n"
+           "    def shrink(self, m):\n"
+           "        m.replicas.pop()\n"
+           "    def reset(self, m):\n"
+           "        m.replicas = []\n"
+           "    def merge(self, m, more):\n"
+           "        m.replicas += more\n")
+    hits = [f for f in _lint_src(
+        src, "incubator_mxnet_tpu/serve/gateway.py") if f.rule == "FL020"]
+    assert len(hits) == 4, hits
+    assert "ReplicaSetController" in hits[0].message
+    assert {h.line for h in hits} == {3, 5, 7, 9}
+
+
+def test_fl020_accepts_init_noqa_choke_point_and_scoping():
+    # construction-time assignment in __init__: the sanctioned exception
+    good = ("class _Model:\n"
+            "    def __init__(self, replicas):\n"
+            "        self.replicas = replicas\n"
+            "    def read(self):\n"
+            "        return list(self.replicas)\n")
+    assert not [f for f in _lint_src(
+        good, "incubator_mxnet_tpu/serve/gateway.py")
+        if f.rule == "FL020"]
+    # noqa escape with a reason
+    noqa = ("def retire(m, rep):\n"
+            "    m.replicas.remove(rep)  "
+            "# noqa: FL020 - test-only fixture teardown\n")
+    assert not [f for f in _lint_src(
+        noqa, "incubator_mxnet_tpu/serve/gateway.py")
+        if f.rule == "FL020"]
+    # the choke point itself is exempt (mutations hold the tracked lock)
+    raw = "def spawn(m, rep):\n    m.replicas.append(rep)\n"
+    assert not [f for f in _lint_src(
+        raw, "incubator_mxnet_tpu/serve/elastic.py")
+        if f.rule == "FL020"]
+    # outside serve/ the rule is silent (no routers there)
+    assert not [f for f in _lint_src(
+        raw, "incubator_mxnet_tpu/parallel/dist.py")
+        if f.rule == "FL020"]
+    # a local list named `replicas` (gateway construction) is not an
+    # attribute mutation and stays clean
+    local = ("def build():\n"
+             "    replicas = []\n"
+             "    replicas.append(1)\n"
+             "    return replicas\n")
+    assert not [f for f in _lint_src(
+        local, "incubator_mxnet_tpu/serve/gateway.py")
+        if f.rule == "FL020"]
+
+
+def test_fl020_tree_is_clean():
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import framework_lint
+    finally:
+        sys.path.pop(0)
+    findings = [f for f in framework_lint.lint_paths(
+        [os.path.join(REPO, "incubator_mxnet_tpu")])
+        if f.rule == "FL020"]
+    assert not findings, findings
+
+
+# ---------------------------------------------------------------------------
 # bench_regress — trajectory regression gate (ISSUE 10)
 # ---------------------------------------------------------------------------
 

@@ -207,6 +207,22 @@ FL019  wall-clock durations (scoped to ``telemetry/`` / ``serve/``
        Where a wall-clock delta is genuinely wanted (cross-host epoch
        math), annotate the line with ``# noqa: FL019`` and the
        justifying comment.
+FL020  replica-set choke point (scoped to ``serve/`` module bodies,
+       excluding ``serve/elastic.py`` — the choke point itself): a
+       mutation of a ReplicaRouter replica list — a mutating method
+       call on a ``.replicas`` attribute (``append``/``remove``/
+       ``pop``/``insert``/``extend``/``clear``/``sort``/``reverse``)
+       or an assignment/augmented assignment to one outside an
+       ``__init__`` body. Every replica-set mutation must go through
+       `serve.elastic.ReplicaSetController`'s single ``tracked_lock``
+       choke point: a mutation anywhere else races the controller's
+       reap/drain/heal/advice tick (the router iterates that list
+       lock-free under the gateway lock), skips the warm-before-
+       dispatch and page-budget funding gates, and never lands in the
+       scale-event journal the bench audits. Construction-time
+       assignment in ``__init__`` is the one sanctioned exception;
+       anywhere else route through the controller, or annotate the
+       line with ``# noqa: FL020`` and the justifying comment.
 
 Usage
 -----
@@ -306,6 +322,14 @@ RULES = {
              "capacity cost ledger; use time.perf_counter() (or "
              "time.monotonic()) for durations, keep time.time() for "
              "absolute timestamps, or `# noqa: FL019` with a reason",
+    "FL020": "serve/ replica-set choke point: mutating a `.replicas` "
+             "list outside serve/elastic.py — races the elastic "
+             "controller's tick (reap/drain/heal/advice mutate under "
+             "ONE tracked_lock) and skips the warm-before-dispatch "
+             "and page-funding gates; route through "
+             "ReplicaSetController (scale_up/scale_down), keep "
+             "construction-time assignment in __init__, or "
+             "`# noqa: FL020` with a reason",
 }
 
 _INDEXING_NAME_PARTS = ("getitem", "setitem", "index", "slice")
@@ -1051,6 +1075,62 @@ def _check_tracked_locks(tree, path, findings, src_lines):
 
 
 # ---------------------------------------------------------------------------
+# FL020 — replica-set choke point (serve/ modules, except the choke point)
+# ---------------------------------------------------------------------------
+
+_LIST_MUTATORS = ("append", "remove", "pop", "insert", "extend", "clear",
+                  "sort", "reverse")
+
+
+def _check_replica_choke_point(tree, path, findings, src_lines):
+    norm = path.replace(os.sep, "/")
+    if "/serve/" not in norm:
+        return
+    if norm.endswith("serve/elastic.py"):
+        return  # THE choke point: its mutations hold the tracked lock
+
+    def noqa(lineno):
+        line = src_lines[lineno - 1] if lineno - 1 < len(src_lines) else ""
+        return "noqa: FL020" in line
+
+    # construction-time `self.replicas = ...` in an __init__ body is the
+    # sanctioned exception (the object is not yet published to a router)
+    init_assigns = set()
+    for fn in ast.walk(tree):
+        if isinstance(fn, ast.FunctionDef) and fn.name == "__init__":
+            for sub in ast.walk(fn):
+                if isinstance(sub, (ast.Assign, ast.AugAssign)):
+                    init_assigns.add(id(sub))
+
+    def is_replicas_attr(node):
+        return isinstance(node, ast.Attribute) and node.attr == "replicas"
+
+    for node in ast.walk(tree):
+        what = None
+        if isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Attribute) \
+                and node.func.attr in _LIST_MUTATORS \
+                and is_replicas_attr(node.func.value):
+            what = f".replicas.{node.func.attr}(...)"
+        elif isinstance(node, ast.Assign) and id(node) not in init_assigns \
+                and any(is_replicas_attr(t) for t in node.targets):
+            what = ".replicas = ..."
+        elif isinstance(node, ast.AugAssign) \
+                and id(node) not in init_assigns \
+                and is_replicas_attr(node.target):
+            what = ".replicas += ..."
+        if what is None or noqa(node.lineno):
+            continue
+        findings.append(LintFinding(
+            path, node.lineno, "FL020",
+            f"`{what}` outside serve/elastic.py — replica-set mutations "
+            "must go through ReplicaSetController's tracked_lock choke "
+            "point (scale_up/scale_down/tick): anywhere else races the "
+            "controller and skips the warm-before-dispatch and "
+            "page-funding gates, or `# noqa: FL020` with a reason"))
+
+
+# ---------------------------------------------------------------------------
 # FL019 — wall-clock durations (telemetry/ + serve/ modules)
 # ---------------------------------------------------------------------------
 
@@ -1551,6 +1631,7 @@ def lint_source(src, path, coverage_text=None, telemetry_text=None):
     _check_sharding_hygiene(tree, path, findings)
     _check_placement_provenance(tree, path, findings, src.splitlines())
     _check_tracked_locks(tree, path, findings, src.splitlines())
+    _check_replica_choke_point(tree, path, findings, src.splitlines())
     _check_wallclock_durations(tree, path, findings, src.splitlines())
     _check_paged_hazards(tree, path, findings)
     _check_span_hygiene(tree, path, findings)
