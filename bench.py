@@ -1177,6 +1177,204 @@ def bench_gpt_serve_elastic(seed=0, max_replicas=2):
     }
 
 
+def bench_gpt_serve_disagg(seed=0, requests=20):
+    """Disaggregated prefill/decode serving on the mixed-length trace
+    (SERVING.md §disaggregated serving, ISSUE 19): the SAME seeded
+    `loadgen.mixed_length_trace` blend — long-prompt/short-budget
+    ``archive`` arrivals interleaved with short-prompt/long-budget
+    ``chat`` arrivals — replayed through one tiny GPT at EQUAL
+    hardware two ways: (a) DISAGGREGATED: 1 prefill + 1 decode
+    replica, KV pages migrating at the prefill/decode boundary;
+    (b) HOMOGENEOUS: 2 ``role="both"`` replicas with chunked prefill
+    interleaving, same total page budget, same per-replica slots.
+
+    Durable metrics: the **decode residency ratio** — the decode
+    replica's time-mean resident decoding slot count over the
+    homogeneous leg's per-replica mean (the split's whole point: the
+    decode side's ~3x page share and prefill-free step loop hold more
+    concurrent decodes on the same chips; gate ≥ 1.5x); the ``chat``
+    tier's victim TTFT p99 (short requests must not pay for the long
+    prompts ahead of them; gate: no worse than the chunked-prefill
+    baseline with a CPU-noise allowance — on TPU the margin is real);
+    the exact migration byte audit (bytes counter == pages counter x
+    `SlotDecoder.page_bytes`); and the zero-steady-state-recompile
+    gate on BOTH legs (per-replica program counts frozen after warmup,
+    and the decode replica's ledger shows zero prefill families).
+
+    Loud-failure contract: failed requests on either leg, a residency
+    ratio under 1.5x, victim TTFT worse than the allowance, any
+    steady-state recompile, a byte-audit mismatch, zero migrations, or
+    prefill evidence on the decode replica raises — it lands in
+    extras["errors"], never passes as a small number."""
+    from incubator_mxnet_tpu import serve
+    from incubator_mxnet_tpu.models.gpt import gpt_tiny
+    from incubator_mxnet_tpu.serve import disagg
+    from incubator_mxnet_tpu.telemetry import registry
+
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "tools"))
+    try:
+        import loadgen
+    finally:
+        sys.path.pop(0)
+
+    vocab, max_len, max_slots = 1000, 64, 8
+    total_pages = 72            # equal budget both legs
+
+    def make_gateway(disaggregated):
+        net = gpt_tiny(vocab_size=vocab, max_length=max_len, dropout=0.0)
+        net.initialize()
+        reg = serve.ModelRegistry(total_pages=total_pages)
+        if disaggregated:
+            reg.add("gpt", net, prefill_replicas=1, decode_replicas=1,
+                    max_slots=max_slots, max_len=max_len)
+        else:
+            reg.add("gpt", net, replicas=2, max_slots=max_slots,
+                    max_len=max_len)
+        return serve.Gateway(reg, tenants={"archive": {"weight": 1.0},
+                                           "chat": {"weight": 2.0}})
+
+    rng = onp.random.RandomState(seed)
+
+    def warm(gw, disaggregated):
+        # freeze every program family BEFORE the measured window. The
+        # homogeneous replicas and the prefill replica warm both
+        # families directly (a co-located fallback must not compile);
+        # the decode replica warms ONLY through the migration plane so
+        # its ledger stays prefill-free.
+        m = gw._models["gpt"]
+        reps = ([r for r in m.replicas if r.role != "decode"]
+                if disaggregated else m.replicas)
+        for rep in reps:
+            for warm_len in (8, 20, 36):
+                seg = rep.sched.submit(
+                    rng.randint(0, vocab, (warm_len,)).astype(onp.int32),
+                    2)
+                while not seg.done:
+                    rep.sched.step()
+        if disaggregated:
+            for warm_len in (8, 20, 36):
+                h = gw.submit("gpt", rng.randint(
+                    0, vocab, (warm_len,)).astype(onp.int32), 3)
+                gw._drive_until([h], timeout=60.0)
+
+    events = loadgen.mixed_length_trace(
+        requests, "gpt", seed=seed, duration_s=0.25, long_frac=0.3,
+        long_prompt=30, long_jitter=0.1, long_new_range=(2, 4),
+        chat_prompt_mean=8, chat_new_range=(20, 28))
+
+    def run_leg(gw, disaggregated):
+        m = gw._models["gpt"]
+        decode_reps = (m.role_replicas("decode") if disaggregated
+                       else m.replicas)
+        programs0 = gw.xla_program_counts(per_replica=True)
+        handles, samples = [], []
+        t0 = time.monotonic()
+        i = 0
+        while i < len(events) or not all(h.done for _, h in handles):
+            now = time.monotonic() - t0
+            while i < len(events) and events[i].t <= now:
+                e = events[i]
+                plen = min(e.prompt_len, max_len - e.max_new - 1)
+                handles.append((e, gw.submit(
+                    "gpt", onp.random.RandomState(e.seed).randint(
+                        0, vocab, (plen,)).astype(onp.int32),
+                    e.max_new, tenant=e.tenant, priority=e.priority)))
+                i += 1
+            gw.step()
+            # decoding-resident slots per decode-capable replica (the
+            # scheduler's decode-lane census, sampled every step)
+            samples.append(sum(r.sched._n_decoding for r in decode_reps)
+                           / len(decode_reps))
+        wall = time.monotonic() - t0
+        failed = [(h.id, h.state) for _, h in handles
+                  if h.state != "done"]
+        if failed:
+            raise RuntimeError(
+                f"{'disagg' if disaggregated else 'homogeneous'} leg: "
+                f"{len(failed)} requests failed: {failed[:3]}")
+        if gw.xla_program_counts(per_replica=True) != programs0:
+            raise RuntimeError(
+                f"{'disagg' if disaggregated else 'homogeneous'} leg: "
+                f"steady-state recompile: {programs0} -> "
+                f"{gw.xla_program_counts(per_replica=True)}")
+        chat_ttft = [h.ttft for e, h in handles if e.tenant == "chat"
+                     and h.ttft is not None]
+        tokens = sum(len(h.tokens) for _, h in handles)
+        return {
+            "resident_mean": (sum(samples) / len(samples)) if samples
+            else 0.0,
+            "chat_ttft_p99_ms": loadgen.percentile(chat_ttft, 99) * 1e3,
+            "tokens_s": tokens / wall,
+        }
+
+    def counter(name):
+        return registry.report().get(name, {}).get("value", 0) or 0
+
+    # -- leg (a): disaggregated 1p+1d ---------------------------------------
+    gw = make_gateway(True)
+    try:
+        warm(gw, True)
+        p0 = counter('mx_serve_page_migration_pages_total{model="gpt"}')
+        b0 = counter('mx_serve_page_migration_bytes_total{model="gpt"}')
+        dis = run_leg(gw, True)
+        moved = counter(
+            'mx_serve_page_migration_pages_total{model="gpt"}') - p0
+        moved_b = counter(
+            'mx_serve_page_migration_bytes_total{model="gpt"}') - b0
+        if moved <= 0:
+            raise RuntimeError(
+                "disagg leg moved zero pages — the migration plane "
+                "never engaged")
+        page_bytes = gw._models["gpt"].replicas[0].slots.page_bytes
+        if moved_b != moved * page_bytes:
+            raise RuntimeError(
+                f"migration byte audit failed: {moved_b} bytes != "
+                f"{moved} pages x {page_bytes} B/page")
+        families = disagg.decode_prefill_families(gw, "gpt")
+        if families:
+            raise RuntimeError(
+                f"decode replica compiled prefill programs: {families}")
+    finally:
+        gw.shutdown(drain=False)
+
+    # -- leg (b): homogeneous chunked-prefill baseline ----------------------
+    gw2 = make_gateway(False)
+    try:
+        warm(gw2, False)
+        hom = run_leg(gw2, False)
+    finally:
+        gw2.shutdown(drain=False)
+
+    ratio = dis["resident_mean"] / max(hom["resident_mean"], 1e-9)
+    if ratio < 1.5:
+        raise RuntimeError(
+            f"decode residency ratio {ratio:.2f} < 1.5x (disagg "
+            f"{dis['resident_mean']:.2f} vs homogeneous per-replica "
+            f"{hom['resident_mean']:.2f})")
+    # victim TTFT: "no worse" with a CPU-generous allowance — here ONE
+    # core runs the prefill replica's step loop serially while the
+    # homogeneous leg spreads prefills over two, so the disagg leg
+    # pays a host-serialization tax the TPU target doesn't have; the
+    # gate still catches pathological regressions (queued-behind-long
+    # TTFT blowups are order-of-magnitude, not 2x)
+    if dis["chat_ttft_p99_ms"] > hom["chat_ttft_p99_ms"] * 2.0:
+        raise RuntimeError(
+            f"chat victim TTFT p99 regressed under disagg: "
+            f"{dis['chat_ttft_p99_ms']:.1f}ms vs baseline "
+            f"{hom['chat_ttft_p99_ms']:.1f}ms")
+    return {
+        "decode_resident_ratio": ratio,
+        "decode_resident_mean": dis["resident_mean"],
+        "baseline_resident_mean": hom["resident_mean"],
+        "chat_ttft_p99_ms": dis["chat_ttft_p99_ms"],
+        "baseline_chat_ttft_p99_ms": hom["chat_ttft_p99_ms"],
+        "pages_migrated": moved,
+        "bytes_migrated": moved_b,
+        "tokens_s": dis["tokens_s"],
+    }
+
+
 def bench_gpt_serve_sharded(requests=16, max_slots=4, prompt_max=40,
                             new_max=20, tp=4, n_replicas=2, seed=0):
     """Pod-scale sharded serving (SERVING.md §pod-scale): the SAME
@@ -1713,6 +1911,23 @@ def _collect_serve_extras(extras, _retry, _fail):
             round(el["live_tokens_s"], 1)
     except Exception as e:  # pragma: no cover
         _fail("gpt_serve_elastic", e)
+    try:
+        dg = _retry(bench_gpt_serve_disagg)
+        # disaggregated prefill/decode pod on the mixed-length trace:
+        # decode residency vs the homogeneous chunked-prefill baseline
+        # at equal hardware, the chat tier's victim TTFT, and the
+        # exact migration byte audit (SERVING.md §disaggregated)
+        extras["gpt_serve_disagg_resident_ratio"] = \
+            round(dg["decode_resident_ratio"], 2)
+        extras["gpt_serve_disagg_chat_ttft_p99_ms"] = \
+            round(dg["chat_ttft_p99_ms"], 1)
+        extras["gpt_serve_disagg_baseline_ttft_p99_ms"] = \
+            round(dg["baseline_chat_ttft_p99_ms"], 1)
+        extras["gpt_serve_disagg_pages_migrated"] = \
+            int(dg["pages_migrated"])
+        extras["gpt_serve_disagg_tokens_s"] = round(dg["tokens_s"], 1)
+    except Exception as e:  # pragma: no cover
+        _fail("gpt_serve_disagg", e)
     try:
         # pod-scale replicated+sharded serving, in its own 8-device
         # child process (see _bench_serve_sharded_subprocess): wall

@@ -90,6 +90,13 @@ Seams (where the probes live):
                              registration — the failed-spawn rollback
                              fixture (fleet must stay at N replicas, no
                              half-registered replica)
+``page_migration``           `serve/disagg._migrate` handoff body, AFTER
+                             destination pages are allocated but BEFORE
+                             the copy — the mid-migration rollback
+                             fixture: destination refs roll back, the
+                             request falls back to co-located serving on
+                             its prefill replica, and allocator
+                             refcounts return to baseline (no page leak)
 ===========================  ==============================================
 
 Off-path contract: when no schedule is configured, ``_SCHEDULE is None``
@@ -112,7 +119,7 @@ SEAMS = ("dataloader_worker", "dataloader_worker_exit", "kvstore_push",
          "kvstore_pull", "kvstore_barrier", "dist_init", "h2d",
          "checkpoint_write", "estimator_step", "serve_step",
          "gateway_step", "collective_delay", "topology_change",
-         "replica_crash", "replica_spawn")
+         "replica_crash", "replica_spawn", "page_migration")
 
 
 class FaultInjected(RuntimeError):

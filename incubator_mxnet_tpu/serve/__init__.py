@@ -46,6 +46,16 @@ Three connected parts:
   engines behind :class:`ReplicaRouter` least-loaded + prefix-affinity
   dispatch with drain-free `Gateway.hot_swap` weight rolls
   (SERVING.md §pod-scale);
+- `disagg`    — disaggregated prefill/decode serving (SERVING.md
+  §disaggregation): ``ModelRegistry.add(..., prefill_replicas=,
+  decode_replicas=)`` splits a pod into compute-bound prefill replicas
+  and bandwidth-bound decode replicas; a finished prefill's KV pages
+  migrate as a content-addressed `PrefixCache` fill (refcounts handed
+  off, ``mx_serve_page_migration_{pages,bytes}_total`` accounted) and
+  the request is adopted mid-decode on the far side — decode replicas
+  never compile a prefill program (compile-ledger gated), with
+  rollback to co-located serving when the handoff faults
+  (``page_migration`` seam) or the decode side is page-exhausted;
 - `elastic`   — the closed loop over the capacity observatory:
   :class:`ReplicaSetController` (armed by ``MXNET_ELASTIC_SERVE``)
   consumes `AutoscaleAdvisor` recommendations and resizes the LIVE
@@ -87,6 +97,7 @@ Typical use::
 from __future__ import annotations
 
 from . import api  # noqa: F401
+from . import disagg  # noqa: F401
 from . import elastic  # noqa: F401
 from . import engine  # noqa: F401
 from . import gateway  # noqa: F401
@@ -95,6 +106,7 @@ from . import scheduler  # noqa: F401
 from . import sharded  # noqa: F401
 from . import tenancy  # noqa: F401
 from .api import ServeEngine  # noqa: F401
+from .disagg import MigrationAborted  # noqa: F401
 from .elastic import ReplicaScaleError, ReplicaSetController  # noqa: F401
 from .engine import (PageAllocator, PagePoolExhausted,  # noqa: F401
                      PrefixCache, SlotDecoder)
@@ -113,6 +125,7 @@ __all__ = ["ServeEngine", "SlotDecoder", "Scheduler", "Request",
            "ServeLayout", "ShardedSlotDecoder", "ReplicaRouter",
            "serve_mesh", "replica_meshes",
            "ReplicaSetController", "ReplicaScaleError",
+           "MigrationAborted",
            "Tenant", "TokenBucket", "WDRRQueue",
-           "api", "elastic", "engine", "gateway", "router",
+           "api", "disagg", "elastic", "engine", "gateway", "router",
            "scheduler", "sharded", "tenancy"]

@@ -235,7 +235,10 @@ class ReplicaSetController:
             "routing set, %d live request(s) re-queued", rep.label,
             reason, requeued)
         try:
-            self._spawn(m, now, reason=f"replace {rep.label}")
+            # role-aware replacement: a dead decode replica is replaced
+            # by a decode replica — the pod's role split survives crashes
+            self._spawn(m, now, reason=f"replace {rep.label}",
+                        role=getattr(rep, "role", "both"))
         except Exception as e:   # noqa: FL006 - degraded fleet beats a dead step loop
             _LOG.error(
                 "serve.elastic: replacement spawn for %s failed (%s: %s)"
@@ -285,6 +288,18 @@ class ReplicaSetController:
             alive = [r for r in m.replicas if not r.draining]
             if len(alive) <= self.min_replicas:
                 break
+            if m.disagg:
+                # a disaggregated pod must keep >= 1 live replica of
+                # each role: no prefill replica means no admission, no
+                # decode replica means every migration falls back
+                from collections import Counter
+
+                by_role = Counter(getattr(r, "role", "both")
+                                  for r in alive)
+                alive = [r for r in alive
+                         if by_role[getattr(r, "role", "both")] > 1]
+                if not alive:
+                    break
             # retire the least-loaded, newest replica first
             rep = min(alive, key=lambda r: (len(r.live)
                                             + r.sched.queue_depth,
@@ -368,20 +383,40 @@ class ReplicaSetController:
                 break
         return added if not best_effort else len(added)
 
-    def _spawn(self, m, now, reason):
+    def _spawn(self, m, now, reason, role=None):
         """Build → load weights → warm → publish, with rollback: an
         exception ANYWHERE before publication (the ``replica_spawn``
         chaos seam included) releases the partial engine and leaves the
-        fleet exactly as it was."""
+        fleet exactly as it was. `role` pins the new replica's
+        disaggregation role (crash replacement preserves it); a
+        disaggregated pod scales up on the DECODE side by default —
+        resident decode slots, not prefill throughput, are what
+        saturates first."""
         from ..fault.injection import inject_at
         from ..fault.retry import suppressed
         from .gateway import _Replica
 
         gw = self._gw
         name = m.name
+        if role is None:
+            role = "decode" if m.disagg else "both"
         # funded before built: the per-replica cut for the NEW count —
         # raises PagePoolExhausted loudly when the budget can't pay
-        n_pages = gw._registry.rebalance_pages(name, len(m.replicas) + 1)
+        if m.disagg or role != "both":
+            n_p = sum(1 for r in m.replicas
+                      if getattr(r, "role", "both") == "prefill")
+            n_d = sum(1 for r in m.replicas
+                      if getattr(r, "role", "both") == "decode")
+            if role == "prefill":
+                n_p += 1
+            else:
+                n_d += 1
+            per_p, per_d = gw._registry.rebalance_pages_disagg(
+                name, max(1, n_p), max(1, n_d))
+            n_pages = per_p if role == "prefill" else per_d
+        else:
+            n_pages = gw._registry.rebalance_pages(name,
+                                                   len(m.replicas) + 1)
         j = self._next_index.get(name)
         if j is None:
             j = max((r.index for r in m.replicas), default=-1) + 1
@@ -410,7 +445,7 @@ class ReplicaSetController:
                               eos_id=bp["eos_id"],
                               seed=bp["seed"] + i + 997 * j)
             sched.capacity_model = name
-            rep = _Replica(name, j, label, slots, sched)
+            rep = _Replica(name, j, label, slots, sched, role=role)
             self._warm(rep)
         except Exception:
             # failed-spawn rollback: nothing was published; the fleet
@@ -460,9 +495,25 @@ class ReplicaSetController:
             "move the live replicas' devices)")
 
     def _warm(self, rep):
-        """Drive BOTH program families (prefill chunks + decode)
-        through a fresh replica while it is still outside the routing
-        set — zero cold compiles on the request path."""
+        """Drive a fresh replica's program families while it is still
+        outside the routing set — zero cold compiles on the request
+        path. Role-aware: a decode-role replica warms via adopted
+        segments (`serve.disagg.warm_decode_replica`) so its ledger
+        never grows a prefill family; everything else warms BOTH
+        families through ordinary submits."""
+        if getattr(rep, "role", "both") == "decode":
+            from . import disagg
+
+            try:
+                disagg.warm_decode_replica(rep, self.warm_lens,
+                                           self.warm_new)
+            except ReplicaScaleError:
+                raise
+            except Exception as e:
+                raise ReplicaScaleError(
+                    f"replica {rep.label}: decode warmup failed: "
+                    f"{type(e).__name__}: {e}") from e
+            return
         max_len = int(getattr(rep.slots, "max_len", 1 << 30))
         for i, L in enumerate(self.warm_lens):
             L = max(1, min(int(L), max_len - self.warm_new - 1))

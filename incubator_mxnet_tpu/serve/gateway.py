@@ -64,7 +64,7 @@ import numpy as onp
 from ..telemetry import capacity, registry, tracing
 from ..telemetry.locks import tracked_lock
 from ..util import env_int as _env_int
-from . import tenancy
+from . import disagg, tenancy
 from .engine import PagePoolExhausted, SlotDecoder
 from .scheduler import (_DONE, _NULL, DeadlineExceeded, EngineClosed,
                         QueueFull, Scheduler)
@@ -74,6 +74,10 @@ __all__ = ["ModelRegistry", "Gateway", "GatewayRequest"]
 _IDLE_SLEEP_S = 0.002
 _DRIVER_MAX_CONSECUTIVE_FAILURES = 3
 _FLIGHT_QUEUE_SAMPLE = 64     # queued requests snapshotted per dump
+# disaggregated page split: prefill replicas hold only transient prompt
+# pages, so they share this fraction of a model's page cut and decode
+# replicas get the rest (ModelRegistry.rebalance_pages_disagg)
+_PREFILL_PAGE_FRAC = 0.25
 
 
 def _q_help():
@@ -89,12 +93,18 @@ class _Replica:
     model (the pre-replica series names), ``"<model>#<i>"`` otherwise.
     ``draining`` marks a replica the elastic controller is retiring:
     the router stops dispatching to it while its in-flight work
-    finishes (`serve/elastic.py` owns the flag and the replica list)."""
+    finishes (`serve/elastic.py` owns the flag and the replica list).
+    ``role`` is the disaggregation assignment (SERVING.md
+    §disaggregation): ``"both"`` (homogeneous default) serves the full
+    request; ``"prefill"`` runs only chunked prefill and hands finished
+    segments to the migration plane; ``"decode"`` only ever receives
+    already-prefilled requests via `Scheduler.adopt` and never compiles
+    a prefill program."""
 
     __slots__ = ("model", "index", "label", "slots", "sched", "live",
-                 "draining")
+                 "draining", "role")
 
-    def __init__(self, model, index, label, slots, sched):
+    def __init__(self, model, index, label, slots, sched, role="both"):
         self.model = model
         self.index = index
         self.label = label
@@ -102,6 +112,7 @@ class _Replica:
         self.sched = sched
         self.live = []                    # dispatched GatewayRequests
         self.draining = False
+        self.role = role                  # "prefill" | "decode" | "both"
 
 
 class _Model:
@@ -131,6 +142,17 @@ class _Model:
     def live(self):
         return self.replicas[0].live
 
+    @property
+    def disagg(self):
+        """True when the pod is role-split — the gateway then runs
+        two-stage dispatch and the migration pump for this model."""
+        return any(getattr(r, "role", "both") != "both"
+                   for r in self.replicas)
+
+    def role_replicas(self, *roles):
+        return [r for r in self.replicas
+                if getattr(r, "role", "both") in roles]
+
 
 class ModelRegistry:
     """Declares the co-resident model set and splits one HBM page
@@ -148,7 +170,8 @@ class ModelRegistry:
         self._specs = {}
 
     def add(self, name, block_or_decoder, share=1.0, replicas=None,
-            mesh=None, **engine_kwargs):
+            mesh=None, prefill_replicas=None, decode_replicas=None,
+            **engine_kwargs):
         """Register `name` → model. ``share`` weights this model's cut
         of the page budget; ``engine_kwargs`` forward to `SlotDecoder`
         (max_slots, max_len, page_tokens, kv_dtype, ...).
@@ -161,7 +184,17 @@ class ModelRegistry:
         int) is carved into disjoint per-replica device slices via
         `serve.router.replica_meshes`; a list supplies one prebuilt
         mesh per replica. A list of pre-built decoders is also accepted
-        as ``block_or_decoder`` (one per replica)."""
+        as ``block_or_decoder`` (one per replica).
+
+        ``prefill_replicas``/``decode_replicas`` make the pod
+        DISAGGREGATED (SERVING.md §disaggregation): the first
+        ``prefill_replicas`` engines take role ``"prefill"`` (chunked
+        prefill only, ~25% of the model's page cut between them), the
+        next ``decode_replicas`` take role ``"decode"`` (adopt-only;
+        the remaining pages). Mutually exclusive with ``replicas``.
+        Under a truthy ``MXNET_DISAGG`` every freshly-built model
+        defaults to disaggregation with ``MXNET_SERVE_PREFILL_REPLICAS``
+        / ``MXNET_SERVE_DECODE_REPLICAS`` (1/1) roles."""
         name = str(name)
         if name in self._specs:
             raise ValueError(f"model {name!r} already registered")
@@ -172,9 +205,26 @@ class ModelRegistry:
         if replicas is not None and int(replicas) < 1:
             raise ValueError(
                 f"model {name!r}: replicas must be >= 1, got {replicas}")
+        n_p = None if prefill_replicas is None else int(prefill_replicas)
+        n_d = None if decode_replicas is None else int(decode_replicas)
+        if (n_p is None) != (n_d is None):
+            raise ValueError(
+                f"model {name!r}: prefill_replicas and decode_replicas "
+                "come as a pair — pass both or neither")
+        if n_p is not None:
+            if replicas is not None:
+                raise ValueError(
+                    f"model {name!r}: replicas= is mutually exclusive "
+                    "with prefill_replicas=/decode_replicas= (the role "
+                    "split IS the replica count)")
+            if n_p < 1 or n_d < 1:
+                raise ValueError(
+                    f"model {name!r}: a disaggregated pod needs >= 1 "
+                    f"replica of each role, got prefill={n_p} "
+                    f"decode={n_d}")
         self._specs[name] = (block_or_decoder, share, dict(engine_kwargs),
                              None if replicas is None else int(replicas),
-                             mesh)
+                             mesh, n_p, n_d)
         return self
 
     def __len__(self):
@@ -207,7 +257,7 @@ class ModelRegistry:
         if spec is None:
             raise ValueError(f"unknown model {name!r} (registered: "
                              f"{', '.join(sorted(self._specs))})")
-        total_share = sum(s for _, s, _, _, _ in self._specs.values())
+        total_share = sum(s[1] for s in self._specs.values())
         cut = int(self.total_pages * spec[1] / total_share)
         per = cut // max(1, int(n_replicas))
         if per < 4:
@@ -219,6 +269,38 @@ class ModelRegistry:
                 "total_pages, or raise the model's share")
         return per
 
+    def rebalance_pages_disagg(self, name, n_prefill, n_decode):
+        """The DISAGGREGATED page split: ``(per_prefill, per_decode)``
+        pages for model `name`. Prefill replicas hold only transient
+        prompt pages (a handoff segment releases them the moment its
+        pages migrate), so they share a `_PREFILL_PAGE_FRAC` sliver of
+        the model's cut and the decode side gets everything else — the
+        tilt that buys disaggregation's higher resident decode slot
+        count at equal hardware. Returns ``(None, None)`` without a
+        joint budget; raises `PagePoolExhausted` when either role
+        cannot be funded (>= 4 pages per replica)."""
+        if self.total_pages is None:
+            return None, None
+        spec = self._specs.get(name)
+        if spec is None:
+            raise ValueError(f"unknown model {name!r} (registered: "
+                             f"{', '.join(sorted(self._specs))})")
+        n_prefill = max(1, int(n_prefill))
+        n_decode = max(1, int(n_decode))
+        total_share = sum(s[1] for s in self._specs.values())
+        cut = int(self.total_pages * spec[1] / total_share)
+        per_p = max(4, int(cut * _PREFILL_PAGE_FRAC) // n_prefill)
+        per_d = (cut - per_p * n_prefill) // n_decode
+        if per_d < 4:
+            raise PagePoolExhausted(
+                f"model {name!r}: a {n_prefill}-prefill/{n_decode}-"
+                f"decode pod cannot be funded from its {cut}-page cut "
+                f"of the {self.total_pages}-page budget (every replica "
+                f">= 4 pages; decode side got {per_d}) — lower the "
+                "replica counts, raise total_pages, or raise the "
+                "model's share")
+        return per_p, per_d
+
     def build_engine(self, name, mesh=None, n_pages=None):
         """Construct ONE fresh engine for `name` from its registered
         spec — the elastic controller's scale-up path (the construction
@@ -229,7 +311,7 @@ class ModelRegistry:
         if spec is None:
             raise ValueError(f"unknown model {name!r} (registered: "
                              f"{', '.join(sorted(self._specs))})")
-        block, _share, kw, _n_rep, _mesh = spec
+        block, _share, kw = spec[0], spec[1], spec[2]
         if self._is_engine(block) or (
                 isinstance(block, (list, tuple))
                 and all(self._is_engine(b) for b in block)):
@@ -253,8 +335,8 @@ class ModelRegistry:
             raise ValueError("ModelRegistry is empty — add() a model "
                              "before constructing the Gateway")
         models = {}
-        for i, (name, (block, share, kw,
-                       n_rep, mesh)) in enumerate(self._specs.items()):
+        for i, (name, (block, share, kw, n_rep, mesh,
+                       n_p, n_d)) in enumerate(self._specs.items()):
             prebuilt = None
             if isinstance(block, (list, tuple)) \
                     and all(self._is_engine(b) for b in block):
@@ -263,6 +345,12 @@ class ModelRegistry:
                     raise ValueError(
                         f"model {name!r}: replicas={n_rep} but "
                         f"{len(prebuilt)} pre-built decoders were given")
+                if n_p is not None and n_p + n_d != len(prebuilt):
+                    raise ValueError(
+                        f"model {name!r}: prefill_replicas={n_p} + "
+                        f"decode_replicas={n_d} but {len(prebuilt)} "
+                        "pre-built decoders were given (first "
+                        "prefill_replicas are the prefill side)")
                 n_rep = len(prebuilt)
             elif self._is_engine(block):
                 prebuilt = [block]       # pre-built SlotDecoder / stub
@@ -270,7 +358,20 @@ class ModelRegistry:
                     raise ValueError(
                         f"model {name!r}: replicas={n_rep} needs a list "
                         "of pre-built decoders (one per replica)")
+                if n_p is not None:
+                    raise ValueError(
+                        f"model {name!r}: a disaggregated pod needs a "
+                        "list of pre-built decoders (one per replica), "
+                        "or a block to build them from")
                 n_rep = 1
+            if n_p is None and n_rep is None and prebuilt is None \
+                    and _env_int("MXNET_DISAGG", 0):
+                # opt-in default: every freshly-built model splits into
+                # dedicated prefill/decode replicas (SERVING.md)
+                n_p = max(1, _env_int("MXNET_SERVE_PREFILL_REPLICAS", 1))
+                n_d = max(1, _env_int("MXNET_SERVE_DECODE_REPLICAS", 1))
+            if n_p is not None:
+                n_rep = n_p + n_d
             if n_rep is None:
                 n_rep = max(1, _env_int("MXNET_SERVE_REPLICAS", 1))
             if prebuilt is not None and kw:
@@ -290,15 +391,25 @@ class ModelRegistry:
                 meshes = [mesh] * n_rep  # one shared mesh: caller's call
             else:
                 meshes = replica_meshes(mesh, n_rep)
+            if n_p is not None:
+                per_role_pages = self.rebalance_pages_disagg(name, n_p,
+                                                             n_d)
             replicas = []
             for j in range(n_rep):
+                role = "both" if n_p is None \
+                    else ("prefill" if j < n_p else "decode")
                 if prebuilt is not None:
                     slots = prebuilt[j]
                 else:
                     rkw = dict(kw)
                     if self.total_pages is not None \
                             and "n_pages" not in rkw:
-                        rkw["n_pages"] = self.rebalance_pages(name, n_rep)
+                        if n_p is not None:
+                            rkw["n_pages"] = per_role_pages[
+                                0 if role == "prefill" else 1]
+                        else:
+                            rkw["n_pages"] = self.rebalance_pages(name,
+                                                                  n_rep)
                     if meshes[j] is not None:
                         from .sharded import ShardedSlotDecoder
 
@@ -318,7 +429,8 @@ class ModelRegistry:
                                   default_deadline=default_deadline,
                                   eos_id=eos_id, seed=seed + i + 997 * j)
                 sched.capacity_model = name   # cost-ledger attribution
-                replicas.append(_Replica(name, j, label, slots, sched))
+                replicas.append(_Replica(name, j, label, slots, sched,
+                                         role=role))
             models[name] = _Model(name, replicas, share, ReplicaRouter())
         return models
 
@@ -760,7 +872,26 @@ class Gateway:
                     f"({m.slots.max_len})")
             pt = m.slots.page_tokens
             need = -(-(prompt.size + max_new - 1) // pt)
-            if need > m.slots.allocator.usable_pages:
+            if m.disagg:
+                # the footprint splits across roles: the prompt's pages
+                # must fit some prefill-capable pool, the full decode
+                # budget some decode-capable pool (replica 0 is a
+                # prefill replica with a deliberately small pool — it
+                # is NOT the viability bar)
+                p_need = -(-prompt.size // pt)
+                p_max = max((r.slots.allocator.usable_pages
+                             for r in m.role_replicas("prefill", "both")),
+                            default=0)
+                d_max = max((r.slots.allocator.usable_pages
+                             for r in m.role_replicas("decode", "both")),
+                            default=0)
+                if p_need > p_max or need > d_max:
+                    raise PagePoolExhausted(
+                        f"request needs {p_need} prefill / {need} decode "
+                        f"KV pages but model {model!r}'s largest pools "
+                        f"hold {p_max} / {d_max} — raise its share/"
+                        "total_pages or shrink the request")
+            elif need > m.slots.allocator.usable_pages:
                 raise PagePoolExhausted(
                     f"request needs {need} KV pages but model {model!r}'s "
                     f"pool only has {m.slots.allocator.usable_pages} — "
@@ -812,6 +943,14 @@ class Gateway:
                 for rep in m.replicas:
                     if rep.live or not rep.sched.idle:
                         stepped |= bool(rep.sched.step())
+            # disaggregation: move freshly-prefilled segments to decode
+            # replicas before pumping (the pump would otherwise see a
+            # segment with no live stream progress)
+            for m in self._models.values():
+                if m.disagg:
+                    stepped |= bool(
+                        disagg.pump_migrations(self, m,
+                                               time.monotonic()))
             pumped = self._pump(time.monotonic())
             self._advise(now)
             scaled = (self._elastic.tick(now)
@@ -846,21 +985,32 @@ class Gateway:
             return 0
         return rep.sched.free_slots - rep.sched.queue_depth
 
+    def _dispatch_reps(self, m):
+        """Replicas a fresh (or resumed) submit may land on: everything
+        for a homogeneous model, prefill-capable replicas for a
+        disaggregated one — decode replicas only ever receive work via
+        `Scheduler.adopt` (the migration plane), which keeps their
+        compile ledger prefill-free."""
+        if not m.disagg:
+            return m.replicas
+        return m.role_replicas("prefill", "both")
+
     def _capacity(self, m):
         """Best replica headroom for `m` (the model can dispatch if ANY
         replica can). ``default=0``: a model transiently at zero
         replicas (a crash whose replacement spawn failed) queues its
         work instead of crashing the step loop."""
-        return max((self._rep_capacity(rep) for rep in m.replicas),
-                   default=0)
+        return max((self._rep_capacity(rep)
+                    for rep in self._dispatch_reps(m)), default=0)
 
     def _pick_victim(self, m, tier):
         """Lowest-priority / least-progressed running request across
         `m`'s replicas with a tier strictly below `tier`, as
         ``(replica, request)`` — ``(None, None)`` when nothing is
-        preemptable."""
+        preemptable. Scoped to dispatch-capable replicas: preempting on
+        a decode replica would push the arrival's prefill onto it."""
         best = None
-        for rep in m.replicas:
+        for rep in self._dispatch_reps(m):
             for r in rep.live:
                 seg = r._segment
                 if seg is None or seg.slot is None or r.tier <= tier:
@@ -903,9 +1053,17 @@ class Gateway:
             else req._resume_prompt
         # route: affinity (warm prefix pages — a resumed preemptee's
         # registered KV naturally pulls it back to its old replica),
-        # then least-loaded among replicas with capacity
-        rep = m.router.pick(m.replicas, prompt=prompt, tenant=req.tenant,
-                            viable=lambda r: self._rep_capacity(r) > 0)
+        # then least-loaded among replicas with capacity. Disaggregated
+        # models dispatch stage 1 only: least chunk-backlog among
+        # prefill-capable replicas; the migration plane places stage 2.
+        if m.disagg:
+            rep = m.router.pick_prefill(
+                m.replicas, viable=lambda r: self._rep_capacity(r) > 0)
+        else:
+            rep = m.router.pick(m.replicas, prompt=prompt,
+                                tenant=req.tenant,
+                                viable=lambda r:
+                                self._rep_capacity(r) > 0)
         if rep is None and self.preempt_enabled:
             vrep, victim = self._pick_victim(m, tier_idx)
             if victim is not None:
@@ -935,7 +1093,8 @@ class Gateway:
                                temperature=req.temperature,
                                eos_id=req.eos_id, deadline_s=deadline_s,
                                parent_span=req._spans.get("request", _NULL),
-                               tenant=req.tenant)
+                               tenant=req.tenant,
+                               prefill_only=m.disagg)
         req._segment = seg
         req.replica = rep.label
         req.state = "dispatched"

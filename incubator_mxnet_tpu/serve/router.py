@@ -145,3 +145,45 @@ class ReplicaRouter:
             if any(r is preferred for r in cands):
                 return preferred
         return max(cands, key=self.load_score)
+
+    # -- two-stage disaggregated dispatch (serve/disagg.py) -----------------
+
+    def pick_prefill(self, replicas, viable=None):
+        """Stage 1 of disaggregated dispatch: the prefill-capable
+        replica (role ``prefill`` or ``both``) with the SHALLOWEST
+        chunk backlog — prefill replicas are compute-bound, so queued
+        prompt chunks (not pages) are the contended resource. Ties
+        break by load score."""
+        cands = [r for r in replicas
+                 if not getattr(r, "draining", False)
+                 and getattr(r, "role", "both") != "decode"
+                 and (viable is None or viable(r))]
+        if not cands:
+            return None
+        def backlog(r):
+            return int(getattr(r.sched, "prefill_backlog",
+                               r.sched.queue_depth))
+        return min(cands, key=lambda r: (backlog(r),
+                                         -self.load_score(r)))
+
+    def pick_decode(self, replicas, prompt=None, viable=None):
+        """Stage 2 of disaggregated dispatch: the decode-capable
+        replica (role ``decode`` or ``both``) a prefilled request's
+        pages migrate to. Prefix warmth first — a replica already
+        holding this prompt's page-aligned digests adopts the request
+        with fewer (or zero) pages to copy — then free pages, the
+        decode-side scarce resource."""
+        cands = [r for r in replicas
+                 if not getattr(r, "draining", False)
+                 and getattr(r, "role", "both") != "prefill"
+                 and (viable is None or viable(r))]
+        if not cands:
+            return None
+        if len(cands) == 1:
+            return cands[0]
+        if self.affinity == "prefix":
+            warm = [(self.warm_tokens(r, prompt), r) for r in cands]
+            best = max(w for w, _ in warm)
+            if best > 0:
+                cands = [r for w, r in warm if w == best]
+        return max(cands, key=self.load_score)

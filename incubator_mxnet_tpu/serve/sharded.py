@@ -362,6 +362,19 @@ class ShardedSlotDecoder(SlotDecoder):
     def _constrain_pools(self, pk, pv, sk, sv):
         return self.layout.constrain_pools(pk, pv, sk, sv)
 
+    def _place_migrated(self, leaves, name):
+        """A disagg page-migration scatter runs eagerly, so its outputs
+        carry whatever sharding the eager op picked — re-pin them to the
+        pool layout, or the next donated program would see mismatched
+        input placements (the same trap `ServeLayout.sharding` closes
+        for fresh pools)."""
+        import jax
+
+        spec = self.layout.scale_spec() if name in ("sk", "sv") \
+            else self.layout.pool_spec()
+        s = self.layout.sharding(spec)
+        return tuple(jax.device_put(x, s) for x in leaves)
+
     def _shardcheck_specs(self):
         """Explicit spec entries for ``(params, *pools)`` so the
         shardcheck pre-flight judges the REAL layout (SC001 silent

@@ -289,6 +289,14 @@ _ENV_KNOBS = {
         "vs the unsharded engine, clean shardcheck, pool aliasing, "
         "gateway hot-swap); 0 = skip; unset = runs only in the spawned "
         "dryrun child (honored, this build's addition)"),
+    "MXNET_DRYRUN_DISAGG": (
+        "__graft_entry__ dryrun_multichip", "1 = force the "
+        "disaggregated-serving subphase (1 prefill + 1 decode replica "
+        "on split mesh slices: greedy parity vs a role=both pod, "
+        "nonzero migration counters with the bytes audit exact, decode "
+        "compile ledger free of prefill families); 0 = skip; unset = "
+        "runs only in the spawned dryrun child (honored, this build's "
+        "addition)"),
     "MXNET_RACECHECK": (
         "analysis.racecheck", "warn = log every concurrency finding "
         "from racecheck_report(); raise = fail loudly on any finding; "
@@ -418,6 +426,24 @@ _ENV_KNOBS = {
         "behind the gateway router (default 1); each replica owns its "
         "own mesh slice, KV pool, and prefix cache (honored, this "
         "build's addition — see SERVING.md)"),
+    "MXNET_DISAGG": (
+        "serve.ModelRegistry", "1 = every freshly-built gateway model "
+        "defaults to a DISAGGREGATED pod: dedicated prefill replicas "
+        "hand finished prompts' KV pages to dedicated decode replicas "
+        "through the serve/disagg.py migration plane (default off; "
+        "explicit prefill_replicas=/decode_replicas= per model wins) "
+        "(honored, this build's addition — see SERVING.md)"),
+    "MXNET_SERVE_PREFILL_REPLICAS": (
+        "serve.ModelRegistry", "prefill-role replicas per model under "
+        "MXNET_DISAGG=1 (default 1): chunked-prefill only, ~25% of the "
+        "model's page cut, slots turn over per prompt (honored, this "
+        "build's addition — see SERVING.md)"),
+    "MXNET_SERVE_DECODE_REPLICAS": (
+        "serve.ModelRegistry", "decode-role replicas per model under "
+        "MXNET_DISAGG=1 (default 1): adopt-only gather-by-table decode "
+        "— never compile a prefill program (compile-ledger gated) and "
+        "carry the decode side's page budget (honored, this build's "
+        "addition — see SERVING.md)"),
     "MXNET_SERVE_AFFINITY": (
         "serve.ReplicaRouter", "replica-routing affinity: prefix "
         "(default, route to the replica whose prefix cache scores the "

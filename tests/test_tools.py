@@ -998,6 +998,74 @@ def test_fl020_tree_is_clean():
 
 
 # ---------------------------------------------------------------------------
+# framework_lint FL021 — serve/ migration choke point (ISSUE 19)
+# ---------------------------------------------------------------------------
+
+def test_fl021_flags_cross_replica_pool_access():
+    src = ("def steal(dst, src, pages, payload, prompt):\n"
+           "    k = src.slots._pk\n"
+           "    payload = src.slots.copy_pages_out(pages)\n"
+           "    dst.slots.copy_pages_in(pages, payload)\n"
+           "    dst.slots.allocator.alloc(3)\n"
+           "    dst.slots.allocator.incref(pages)\n"
+           "    src.slots.allocator.decref(pages)\n"
+           "    dst.slots.prefix_cache.register(prompt, pages)\n")
+    hits = [f for f in _lint_src(
+        src, "incubator_mxnet_tpu/serve/gateway.py") if f.rule == "FL021"]
+    assert len(hits) == 7, hits
+    assert "serve/disagg.py" in hits[0].message
+    assert {h.line for h in hits} == {2, 3, 4, 5, 6, 7, 8}
+
+
+def test_fl021_exempts_choke_point_self_and_reads():
+    raw = ("def move(dst, src, pages, payload):\n"
+           "    payload = src.slots.copy_pages_out(pages)\n"
+           "    dst.slots.copy_pages_in(pages, payload)\n")
+    # serve/disagg.py IS the choke point
+    assert not [f for f in _lint_src(
+        raw, "incubator_mxnet_tpu/serve/disagg.py") if f.rule == "FL021"]
+    # outside serve/ the rule is silent
+    assert not [f for f in _lint_src(
+        raw, "incubator_mxnet_tpu/parallel/dist.py") if f.rule == "FL021"]
+    # an engine touching ITS OWN pool is the normal serving path
+    own = ("class SlotDecoder:\n"
+           "    def _gather(self, pages):\n"
+           "        k = self.slots._pk\n"
+           "        self.slots.allocator.decref(pages)\n")
+    assert not [f for f in _lint_src(
+        own, "incubator_mxnet_tpu/serve/gateway.py") if f.rule == "FL021"]
+    # read-only probes + lifecycle calls stay clean (gateway shutdown,
+    # elastic release, capacity accounting all use these)
+    reads = ("def probe(rep):\n"
+             "    n = rep.slots.allocator.free_pages\n"
+             "    m = rep.slots.allocator.usable_pages\n"
+             "    rep.slots.prefix_cache.clear()\n"
+             "    rep.slots.prefix_cache.evict_unused(4)\n"
+             "    w = rep.slots.prefix_cache.shared_tokens([1])\n"
+             "    rep.slots.release()\n")
+    assert not [f for f in _lint_src(
+        reads, "incubator_mxnet_tpu/serve/elastic.py") if f.rule == "FL021"]
+    # noqa escape with a reason
+    noqa = ("def fixture(rep, pages):\n"
+            "    rep.slots.allocator.decref(pages)  "
+            "# noqa: FL021 - test fixture teardown\n")
+    assert not [f for f in _lint_src(
+        noqa, "incubator_mxnet_tpu/serve/gateway.py") if f.rule == "FL021"]
+
+
+def test_fl021_tree_is_clean():
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import framework_lint
+    finally:
+        sys.path.pop(0)
+    findings = [f for f in framework_lint.lint_paths(
+        [os.path.join(REPO, "incubator_mxnet_tpu")])
+        if f.rule == "FL021"]
+    assert not findings, findings
+
+
+# ---------------------------------------------------------------------------
 # bench_regress — trajectory regression gate (ISSUE 10)
 # ---------------------------------------------------------------------------
 

@@ -223,6 +223,26 @@ FL020  replica-set choke point (scoped to ``serve/`` module bodies,
        assignment in ``__init__`` is the one sanctioned exception;
        anywhere else route through the controller, or annotate the
        line with ``# noqa: FL020`` and the justifying comment.
+FL021  migration choke point (scoped to ``serve/`` module bodies,
+       excluding ``serve/disagg.py`` — the choke point itself):
+       cross-replica KV pool access — reading or writing a pool leaf
+       through ``<other>.slots._pk/_pv/_sk/_sv``, calling
+       ``<other>.slots.copy_pages_out/copy_pages_in``, mutating
+       refcounts via ``<other>.slots.allocator.alloc/incref/decref``,
+       or filling a prefix cache via
+       ``<other>.slots.prefix_cache.register`` where the receiver is
+       not the engine's own ``self``. Page migration is the ONE
+       sanctioned cross-replica data path and `serve/disagg.py` is its
+       choke point: it owns the alloc-copy-register-adopt-decref
+       ordering, the mid-copy rollback (``page_migration`` seam), and
+       the ``mx_serve_page_migration_*`` byte accounting — a pool
+       touch anywhere else can leak pages, double-free them, or move
+       bytes the audit never sees. Read-only capacity probes
+       (``free_pages``, ``shared_tokens``, ``usable_pages``) and
+       lifecycle calls (``clear``, ``release``, ``evict_unused``) stay
+       clean; a genuinely needed new path routes through
+       serve.disagg or annotates with ``# noqa: FL021`` and the
+       justifying comment.
 
 Usage
 -----
@@ -330,6 +350,16 @@ RULES = {
              "ReplicaSetController (scale_up/scale_down), keep "
              "construction-time assignment in __init__, or "
              "`# noqa: FL020` with a reason",
+    "FL021": "serve/ cross-replica pool access outside the "
+             "serve/disagg.py migration choke point: touching another "
+             "replica's pool leaves (`.slots._pk/_pv/_sk/_sv`), page "
+             "copies (`.slots.copy_pages_out/copy_pages_in`), allocator "
+             "refcounts (`.slots.allocator.alloc/incref/decref`) or "
+             "prefix-cache fills (`.slots.prefix_cache.register`) "
+             "bypasses the migration plane's rollback + byte accounting "
+             "and can leak or double-free pages; route through "
+             "serve.disagg (an engine's OWN `self.slots...` is exempt), "
+             "or `# noqa: FL021` with a reason",
 }
 
 _INDEXING_NAME_PARTS = ("getitem", "setitem", "index", "slice")
@@ -1131,6 +1161,72 @@ def _check_replica_choke_point(tree, path, findings, src_lines):
 
 
 # ---------------------------------------------------------------------------
+# FL021 — migration choke point (serve/ modules, except serve/disagg.py)
+# ---------------------------------------------------------------------------
+
+_MIGRATION_POOL_LEAVES = ("_pk", "_pv", "_sk", "_sv")
+_MIGRATION_COPY_CALLS = ("copy_pages_out", "copy_pages_in")
+_MIGRATION_REFCOUNT_CALLS = ("alloc", "incref", "decref")
+
+
+def _check_migration_choke_point(tree, path, findings, src_lines):
+    norm = path.replace(os.sep, "/")
+    if "/serve/" not in norm:
+        return
+    if norm.endswith("serve/disagg.py"):
+        return  # THE migration choke point: rollback + byte accounting
+
+    def noqa(lineno):
+        line = src_lines[lineno - 1] if lineno - 1 < len(src_lines) else ""
+        return "noqa: FL021" in line
+
+    def base_is_self(node):
+        # an engine/scheduler touching its OWN pool (`self.slots...`)
+        # is the sanctioned intra-replica path
+        return isinstance(node, ast.Name) and node.id == "self"
+
+    def slots_attr(node):
+        return isinstance(node, ast.Attribute) and node.attr == "slots"
+
+    for node in ast.walk(tree):
+        what = None
+        if isinstance(node, ast.Attribute) \
+                and node.attr in _MIGRATION_POOL_LEAVES \
+                and slots_attr(node.value) \
+                and not base_is_self(node.value.value):
+            what = f".slots.{node.attr}"
+        elif isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Attribute):
+            f = node.func
+            if f.attr in _MIGRATION_COPY_CALLS \
+                    and slots_attr(f.value) \
+                    and not base_is_self(f.value.value):
+                what = f".slots.{f.attr}(...)"
+            elif f.attr in _MIGRATION_REFCOUNT_CALLS \
+                    and isinstance(f.value, ast.Attribute) \
+                    and f.value.attr == "allocator" \
+                    and slots_attr(f.value.value) \
+                    and not base_is_self(f.value.value.value):
+                what = f".slots.allocator.{f.attr}(...)"
+            elif f.attr == "register" \
+                    and isinstance(f.value, ast.Attribute) \
+                    and f.value.attr == "prefix_cache" \
+                    and slots_attr(f.value.value) \
+                    and not base_is_self(f.value.value.value):
+                what = ".slots.prefix_cache.register(...)"
+        if what is None or noqa(node.lineno):
+            continue
+        findings.append(LintFinding(
+            path, node.lineno, "FL021",
+            f"`{what}` outside serve/disagg.py — cross-replica pool "
+            "access must go through the migration choke point (it owns "
+            "the alloc-copy-register-adopt-decref ordering, mid-copy "
+            "rollback and mx_serve_page_migration_* accounting; a pool "
+            "touch anywhere else can leak or double-free pages), or "
+            "`# noqa: FL021` with a reason"))
+
+
+# ---------------------------------------------------------------------------
 # FL019 — wall-clock durations (telemetry/ + serve/ modules)
 # ---------------------------------------------------------------------------
 
@@ -1632,6 +1728,7 @@ def lint_source(src, path, coverage_text=None, telemetry_text=None):
     _check_placement_provenance(tree, path, findings, src.splitlines())
     _check_tracked_locks(tree, path, findings, src.splitlines())
     _check_replica_choke_point(tree, path, findings, src.splitlines())
+    _check_migration_choke_point(tree, path, findings, src.splitlines())
     _check_wallclock_durations(tree, path, findings, src.splitlines())
     _check_paged_hazards(tree, path, findings)
     _check_span_hygiene(tree, path, findings)

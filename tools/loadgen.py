@@ -30,8 +30,9 @@ from __future__ import annotations
 
 import json
 
-__all__ = ["TraceEvent", "synth_trace", "diurnal_trace", "save_trace",
-           "load_trace", "replay", "percentile"]
+__all__ = ["TraceEvent", "synth_trace", "diurnal_trace",
+           "mixed_length_trace", "save_trace", "load_trace", "replay",
+           "percentile"]
 
 
 class TraceEvent:
@@ -190,6 +191,66 @@ def diurnal_trace(models, tenants, seed=0, trough_s=2.0, steady_s=2.0,
             t += float(rng.exponential(1.0 / rate))
         t0 += span
     return events, segments
+
+
+def mixed_length_trace(n, model, seed=0, duration_s=2.0,
+                       long_frac=0.25, long_prompt=96, long_jitter=0.25,
+                       long_new_range=(8, 16),
+                       chat_prompt_mean=12, chat_prompt_sigma=0.5,
+                       chat_new_range=(8, 24),
+                       long_tenant="archive", chat_tenant="chat",
+                       long_priority="normal", chat_priority="high"):
+    """The DISAGGREGATION acceptance trace (SERVING.md): a seeded blend
+    of two tenant populations whose requests stress opposite ends of
+    the roofline —
+
+    - ``archive`` submits LONG prompts (``long_prompt`` tokens ±
+      ``long_jitter`` lognormal jitter; production analogue: ~32k
+      document-context requests) with short token budgets: nearly all
+      of their cost is prefill compute, and on a homogeneous pod each
+      one monopolizes a replica's step loop while chat requests behind
+      it wait;
+    - ``chat`` submits short conversational prompts with longer decode
+      budgets: nearly all of their cost is bandwidth-bound decode, and
+      their TTFT p99 is the victim metric the disaggregated pod must
+      protect (prefill replicas absorb the long prompts; decode
+      replicas never run a prefill chunk).
+
+    ``long_frac`` is the long-request share of the ``n`` arrivals.
+    Arrival times interleave the two populations uniformly over
+    ``duration_s`` so every window contains both. The defaults are
+    sized for CI stubs (hundreds-of-token pools); scale ``long_prompt``
+    up for hardware benches. Returns events sorted by arrival."""
+    import numpy as onp
+
+    rng = onp.random.RandomState(seed)
+    n = int(n)
+    n_long = max(1, int(round(n * float(long_frac))))
+    events = []
+    for i in range(n):
+        t = float(rng.uniform(0.0, duration_s))
+        if i < n_long:
+            plen = max(1, int(round(long_prompt
+                                    * float(rng.lognormal(
+                                        0.0, long_jitter)))))
+            events.append(TraceEvent(
+                t=t, model=model, tenant=long_tenant,
+                priority=long_priority, prompt_len=plen,
+                max_new=int(rng.randint(long_new_range[0],
+                                        long_new_range[1] + 1)),
+                seed=int(rng.randint(0, 2**31 - 1))))
+        else:
+            plen = int(onp.clip(
+                rng.lognormal(onp.log(chat_prompt_mean),
+                              chat_prompt_sigma), 1, 4 * chat_prompt_mean))
+            events.append(TraceEvent(
+                t=t, model=model, tenant=chat_tenant,
+                priority=chat_priority, prompt_len=plen,
+                max_new=int(rng.randint(chat_new_range[0],
+                                        chat_new_range[1] + 1)),
+                seed=int(rng.randint(0, 2**31 - 1))))
+    events.sort(key=lambda e: e.t)
+    return events
 
 
 def percentile(values, q):
