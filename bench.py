@@ -1587,6 +1587,50 @@ def bench_gpt_serve_timeseries(requests=12, max_slots=4, prompt_max=48,
     return on_tok_s, off_tok_s, overhead_pct
 
 
+def bench_gpt_serve_anatomy(requests=12, max_slots=4, prompt_max=48,
+                            new_max=48, mean_interarrival_s=0.02,
+                            seed=0):
+    """Request-anatomy ledger cost on the serving hot path
+    (TELEMETRY.md §request anatomy): the SAME reduced serve trace
+    twice — anatomy disarmed, then armed at sample rate 1.0 (every
+    request archived, 20× the default rate, so the figure bounds the
+    production cost from above). Adjacent runs, the
+    `bench_gpt_serve_traced` methodology. The armed leg must actually
+    observe the run: nonzero completed requests in the ledger AND a
+    non-empty archive, else the anatomy seams are not wired through
+    the gateway/scheduler. Returns (tokens/s armed, tokens/s
+    disarmed, overhead %)."""
+    from incubator_mxnet_tpu.telemetry import anatomy
+
+    kw = dict(requests=requests, max_slots=max_slots,
+              prompt_max=prompt_max, new_max=new_max,
+              mean_interarrival_s=mean_interarrival_s, seed=seed)
+    assert not anatomy.is_enabled(), \
+        "anatomy already armed: the off-leg would measure the on-path"
+    off_tok_s = bench_gpt_serve(**kw)[0]
+    sample0 = anatomy.sample_rate()
+    anatomy.enable()
+    anatomy.reset()
+    anatomy.set_sample(1.0)
+    try:
+        on_tok_s = bench_gpt_serve(**kw)[0]
+        rep = anatomy.report()
+    finally:
+        anatomy.set_sample(sample0)
+        anatomy.disable()
+        anatomy.reset()
+    if rep["requests_completed"] == 0:
+        raise RuntimeError(
+            "armed serve run completed zero anatomy records — the "
+            "begin/complete seams are not wired through the gateway")
+    if not rep["archive"]:
+        raise RuntimeError(
+            "armed serve run archived nothing at sample rate 1.0 — "
+            "the tail-sampling ring is not wired")
+    overhead_pct = (off_tok_s - on_tok_s) / off_tok_s * 100.0
+    return on_tok_s, off_tok_s, overhead_pct
+
+
 def bench_gpt_serve_lockwitness(requests=12, max_slots=4, prompt_max=48,
                                 new_max=48, mean_interarrival_s=0.02,
                                 seed=0):
@@ -1844,6 +1888,16 @@ def _collect_serve_extras(extras, _retry, _fail):
         extras["gpt_serve_timeseries_overhead_pct"] = round(ts_ovh, 2)
     except Exception as e:  # pragma: no cover
         _fail("gpt_serve_timeseries", e)
+    try:
+        an_on, an_off, an_ovh = _retry(bench_gpt_serve_anatomy)
+        # request-anatomy ledger cost (TELEMETRY.md §request anatomy):
+        # same reduced trace, anatomy disarmed then armed at sample
+        # rate 1.0 — the acceptance gate wants this under 3%
+        extras["gpt_serve_anatomy_tokens_s"] = round(an_on, 1)
+        extras["gpt_serve_unanatomized_tokens_s"] = round(an_off, 1)
+        extras["gpt_serve_anatomy_overhead_pct"] = round(an_ovh, 2)
+    except Exception as e:  # pragma: no cover
+        _fail("gpt_serve_anatomy", e)
     try:
         won, woff, wovh = _retry(bench_gpt_serve_lockwitness)
         # lock-order-witness cost on the serving hot path (ANALYSIS.md

@@ -17,6 +17,17 @@ and emits timestamped, *reasoned* recommendations:
 - ``hold``                 — anything else, including "no history yet"
   (an observatory outage must never drive scaling).
 
+On a disaggregated pod a plain ``scale_up`` is refined with the
+anatomy ledger's role-residency evidence
+(``mx_replica_residency_seconds_total{replica=,role=,state=}``,
+`telemetry.anatomy.residency_report`): when the model's prefill-role
+replicas are markedly busier than its decode-role replicas (or vice
+versa, by more than ``role_margin`` of wall), the recommendation
+becomes ``scale_up_prefill`` / ``scale_up_decode`` and the ``reason``
+names the residency series — the ROADMAP's "roofline-driven role-count
+autoscaling" evidence plane. `serve.elastic.ReplicaSetController`
+consumes the role-aware actions by pinning the spawned replica's role.
+
 Every recommendation names its evidence (series, window, value vs
 threshold) in the ``reason`` string, lands in a bounded decision log
 (what the future actuating controller will replay), is published as
@@ -43,10 +54,12 @@ from ..telemetry import burnrate, registry, timeseries, tracing
 
 __all__ = ["AutoscaleAdvisor", "ACTIONS"]
 
-ACTIONS = ("scale_up", "scale_down", "hold")
+ACTIONS = ("scale_up", "scale_up_prefill", "scale_up_decode",
+           "scale_down", "hold")
 
 OCCUPANCY_SERIES = "mx_serve_slot_occupancy"
 QUEUE_PREFIX = "mx_gateway_queue_depth"
+RESIDENCY_SERIES = "mx_replica_residency_seconds_total"
 
 
 class AutoscaleAdvisor:
@@ -56,7 +69,8 @@ class AutoscaleAdvisor:
                  fast_window_s=60.0, slow_window_s=300.0,
                  cooldown_s=120.0, burst_queue=16,
                  occupancy_series=OCCUPANCY_SERIES,
-                 queue_prefix=QUEUE_PREFIX, log_len=256):
+                 queue_prefix=QUEUE_PREFIX, log_len=256,
+                 role_margin=0.1):
         self.model = str(model)
         self.up_occupancy = float(up_occupancy)
         self.down_occupancy = float(down_occupancy)
@@ -66,6 +80,7 @@ class AutoscaleAdvisor:
         self.burst_queue = int(burst_queue)
         self.occupancy_series = occupancy_series
         self.queue_prefix = queue_prefix
+        self.role_margin = float(role_margin)
         self._log = collections.deque(maxlen=int(log_len))
         self._last_action = None
         self._last_scale_up_t = None
@@ -80,6 +95,32 @@ class AutoscaleAdvisor:
                 for n in names]
         vals = [v for v in vals if v is not None]
         return sum(vals) if vals else None
+
+    def _role_refine(self, now):
+        """Residency evidence for a role-aware scale-up: mean busy
+        fraction (1 - idle share) of this model's prefill-role vs
+        decode-role replicas from the anatomy ledger. Returns
+        ``(action, busy_hot, busy_cold)`` when one role is busier by
+        more than ``role_margin`` of wall, else None (homogeneous pods
+        have no dedicated roles, so they always return None)."""
+        from ..telemetry import anatomy
+
+        busy = {"prefill": [], "decode": []}
+        for label, row in anatomy.residency_report(now=now).items():
+            if label.split("#", 1)[0] != self.model:
+                continue
+            role = row.get("role")
+            if role in busy:
+                busy[role].append(1.0 - row["frac"].get("idle", 0.0))
+        if not busy["prefill"] or not busy["decode"]:
+            return None
+        bp = sum(busy["prefill"]) / len(busy["prefill"])
+        bd = sum(busy["decode"]) / len(busy["decode"])
+        if bp >= bd + self.role_margin:
+            return "scale_up_prefill", bp, bd
+        if bd >= bp + self.role_margin:
+            return "scale_up_decode", bd, bp
+        return None
 
     def _publish(self, action):
         for a in ACTIONS:
@@ -139,6 +180,18 @@ class AutoscaleAdvisor:
                           f"with empty queue over {fast_w:g}s and no "
                           "burn alerts")
         if action == "scale_up":
+            refined = self._role_refine(now)
+            if refined is not None:
+                action, hot, cold = refined
+                role = ("prefill" if action == "scale_up_prefill"
+                        else "decode")
+                evidence[f"{RESIDENCY_SERIES} busy[{role}]"] = hot
+                evidence[f"{RESIDENCY_SERIES} busy[other]"] = cold
+                reason += (
+                    f"; {RESIDENCY_SERIES} shows {role}-role replicas "
+                    f"{hot:.0%} busy vs {cold:.0%} for the other role — "
+                    f"scale the {role} side")
+        if action.startswith("scale_up"):
             self._last_scale_up_t = now
         rec = {"t": now, "action": action, "model": self.model, "n": n,
                "reason": reason, "evidence": evidence}

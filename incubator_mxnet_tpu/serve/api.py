@@ -25,7 +25,7 @@ import threading
 
 import numpy as onp
 
-from ..telemetry import tracing
+from ..telemetry import anatomy, tracing
 from ..telemetry.locks import tracked_lock
 from ..util import env_float as _env_float
 from ..util import env_int as _env_int
@@ -167,9 +167,19 @@ class ServeEngine:
         if temperature is None:
             temperature = self._default_temperature
         with self._lock:
-            return self._sched.submit(prompt_ids, max_new_tokens,
-                                      temperature=temperature,
-                                      eos_id=eos_id, deadline_s=deadline_s)
+            req = self._sched.submit(prompt_ids, max_new_tokens,
+                                     temperature=temperature,
+                                     eos_id=eos_id, deadline_s=deadline_s)
+            # standalone-engine anatomy: request == segment here, so the
+            # engine owns the record end to end (the gateway attaches
+            # its own records to segments AFTER its dispatch instead)
+            rec = anatomy.begin(
+                req.id, req.tenant, self._sched.capacity_model,
+                "normal", req.submit_t, deadline=req.deadline)
+            if rec is not None:
+                rec.owner = "engine"
+                req.anatomy = rec
+            return req
 
     # -- driving ------------------------------------------------------------
 

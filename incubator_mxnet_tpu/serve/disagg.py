@@ -72,10 +72,12 @@ SERVING.md has the full table.
 """
 from __future__ import annotations
 
+import time
+
 import numpy as onp
 
 from ..fault.injection import FaultInjected
-from ..telemetry import registry, tracing
+from ..telemetry import anatomy, registry, tracing
 from .engine import PagePoolExhausted
 from .scheduler import _NULL
 
@@ -210,6 +212,7 @@ def _migrate(gw, m, src, greq, seg, now):
             f"{seg.id} needs {need} pages, {alloc.free_pages} free")
     # full decode budget up front — the adopted request can never hit
     # a mid-flight page OOM, same rule as local admission
+    mig_t0 = time.perf_counter() if anatomy._ENABLED else None
     dst_pages = alloc.alloc(physical)
     try:
         from ..fault.injection import inject_at
@@ -246,6 +249,14 @@ def _migrate(gw, m, src, greq, seg, now):
     dst.live.append(greq)
     greq._segment = new_seg
     greq.replica = dst.label
+    if mig_t0 is not None:
+        # the copy+adopt window is migration residency on the ADOPTING
+        # side (it funds the pages and runs the adopt)
+        anatomy.on_migration(dst.sched, mig_t0, time.perf_counter())
+    rec = greq._anatomy
+    if rec is not None:
+        new_seg.anatomy = rec
+        rec.adopted(now, migrated=True)
     tracing.event("serve.disagg.migrate", request=greq.id,
                   src=src.label, dst=dst.label, pages=content,
                   bytes=content * page_bytes)
@@ -285,6 +296,10 @@ def _fallback_colocate(gw, src, greq, seg, now, reason):
         tenant=greq.tenant)
     sched.finish_handoff(seg)
     greq._segment = new_seg     # stays in src.live, same replica label
+    rec = greq._anatomy
+    if rec is not None:
+        new_seg.anatomy = rec
+        rec.adopted(now, migrated=False)
     tracing.event("serve.disagg.fallback", request=greq.id,
                   replica=src.label, reason=str(reason))
 
@@ -307,6 +322,8 @@ def _requeue(gw, src, greq, seg, now, reason):
     greq.preemptions += 1
     greq.state = "queued"
     greq.replica = None
+    if greq._anatomy is not None:
+        greq._anatomy.requeued(now, "migration_fallback")
     gw.preemptions_total += 1
     greq._spans["admit"] = tracing.open_span(
         "gateway.admit", parent=greq._spans.get("request", _NULL),
@@ -329,6 +346,7 @@ def warm_decode_replica(rep, warm_lens=(8,), warm_new=2):
     live request."""
     sched = rep.sched
     alloc = rep.slots.allocator
+    warm_tok = anatomy.warmup_begin(sched)
     max_len = int(getattr(rep.slots, "max_len", 1 << 30))
     warm_new = max(2, int(warm_new))    # >= 1 real decode step
     for i, L in enumerate(warm_lens):
@@ -350,6 +368,7 @@ def warm_decode_replica(rep, warm_lens=(8,), warm_new=2):
             raise RuntimeError(
                 f"replica {rep.label}: decode warmup (len {L}) failed: "
                 f"{type(seg.error).__name__}: {seg.error}")
+    anatomy.warmup_end(sched, warm_tok)
 
 
 def decode_prefill_families(gw, model):

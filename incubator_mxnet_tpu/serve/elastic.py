@@ -221,6 +221,8 @@ class ReplicaSetController:
             req._remaining = req.max_new - len(req.tokens)
             req.state = "queued"
             req.replica = None
+            if req._anatomy is not None:
+                req._anatomy.requeued(now, "crash_resume")
             req._spans["admit"] = tracing.open_span(
                 "gateway.admit", parent=req._spans.get("request", _NULL),
                 resumed=True, crash=rep.label)
@@ -346,22 +348,30 @@ class ReplicaSetController:
                 continue
             self._consumed_t[name] = rec["t"]
             want = max(1, int(rec.get("n", 1)))
-            if rec["action"] == "scale_up":
+            act = rec["action"]
+            if act in ("scale_up", "scale_up_prefill", "scale_up_decode"):
+                # role-aware advice (anatomy residency evidence) pins
+                # the new replicas' disaggregation role
+                role = {"scale_up_prefill": "prefill",
+                        "scale_up_decode": "decode"}.get(act)
                 n += self._scale_up(m, want, now,
                                     reason=rec.get("reason", "advisor"),
-                                    best_effort=True)
-            elif rec["action"] == "scale_down":
+                                    best_effort=True, role=role)
+            elif act == "scale_down":
                 n += self._scale_down(m, want, now,
                                       reason=rec.get("reason", "advisor"))
         return n
 
     # -- scale-up ------------------------------------------------------------
 
-    def _scale_up(self, m, n, now, reason, best_effort=False):
+    def _scale_up(self, m, n, now, reason, best_effort=False, role=None):
         added = []
         for _ in range(int(n)):
-            # cheapest capacity first: cancel a drain in progress
-            draining = [r for r in m.replicas if r.draining]
+            # cheapest capacity first: cancel a drain in progress (of
+            # the requested role, when the advice is role-aware)
+            draining = [r for r in m.replicas if r.draining
+                        and (role is None
+                             or getattr(r, "role", "both") == role)]
             if draining:
                 rep = max(draining, key=lambda r: r.index)
                 rep.draining = False
@@ -372,7 +382,8 @@ class ReplicaSetController:
             if len(m.replicas) >= self.max_replicas:
                 break
             try:
-                added.append(self._spawn(m, now, reason=reason))
+                added.append(self._spawn(m, now, reason=reason,
+                                         role=role))
             except Exception as e:
                 if not best_effort:
                     raise

@@ -61,7 +61,7 @@ import weakref
 
 import numpy as onp
 
-from ..telemetry import capacity, registry, tracing
+from ..telemetry import anatomy, capacity, registry, tracing
 from ..telemetry.locks import tracked_lock
 from ..util import env_int as _env_int
 from . import disagg, tenancy
@@ -113,6 +113,9 @@ class _Replica:
         self.live = []                    # dispatched GatewayRequests
         self.draining = False
         self.role = role                  # "prefill" | "decode" | "both"
+        # residency identity for the anatomy ledger: the scheduler's
+        # compute seams charge this replica's role-residency series
+        sched.anatomy_replica = (label, role)
 
 
 class _Model:
@@ -445,8 +448,8 @@ class GatewayRequest:
                  "submit_t", "first_token_t", "finish_t", "tokens",
                  "state", "error", "error_class", "preemptions",
                  "est_cost", "trace_id", "replica", "_spans", "_segment",
-                 "_resume_prompt", "_remaining", "_charged", "_stream",
-                 "_done")
+                 "_resume_prompt", "_remaining", "_charged", "_anatomy",
+                 "_stream", "_done")
 
     def __init__(self, rid, model, tenant, priority, tier, prompt,
                  max_new, temperature, eos_id, deadline):
@@ -474,6 +477,7 @@ class GatewayRequest:
         self._resume_prompt = None        # set after a preemption
         self._remaining = int(max_new)
         self._charged = False             # quota debited once, ever
+        self._anatomy = None              # latency-anatomy record, or None
         root = tracing.open_span("gateway.request", lane=f"greq {rid}",
                                  request=rid, model=model, tenant=tenant,
                                  priority=priority,
@@ -553,6 +557,9 @@ class GatewayRequest:
     def _finish(self, now):
         self.state = "done"
         self.finish_t = now
+        if self._anatomy is not None:
+            anatomy.complete(self._anatomy, now, "ok",
+                             tokens=len(self.tokens))
         self._close_spans()
         self._stream.put(_DONE)
         self._done.set()
@@ -564,6 +571,12 @@ class GatewayRequest:
         self.error = exc
         self.error_class = classify_exception(exc)
         self.finish_t = now
+        if self._anatomy is not None:
+            anatomy.complete(
+                self._anatomy, now,
+                "expired" if isinstance(exc, DeadlineExceeded)
+                else "failed",
+                tokens=len(self.tokens))
         self._close_spans(error=exc)
         self._stream.put(_DONE)
         self._done.set()
@@ -909,6 +922,9 @@ class Gateway:
                 None if deadline_s is None else now + float(deadline_s))
             self._next_id += 1
             req.submit_t = now
+            req._anatomy = anatomy.begin(req.id, req.tenant, model,
+                                         priority, now,
+                                         deadline=req.deadline)
             self._get_tenant(req.tenant)
             self._queues[priority].push(req.tenant, req)
             return req
@@ -1098,6 +1114,12 @@ class Gateway:
         req._segment = seg
         req.replica = rep.label
         req.state = "dispatched"
+        if req._anatomy is not None:
+            # closes queue_wait on first dispatch, `preempted` on a
+            # resumed one (satellite: re-queued wall is attributed to
+            # the preempted state, never dropped)
+            req._anatomy.dispatched(now, rep.label)
+            seg.anatomy = req._anatomy
         req._spans.pop("admit", _NULL).annotate(
             engine_request=seg.id, replica=rep.label,
             resumed=req._resume_prompt is not None,
@@ -1126,6 +1148,8 @@ class Gateway:
         victim.preemptions += 1
         victim.state = "queued"
         victim.replica = None
+        if victim._anatomy is not None:
+            victim._anatomy.requeued(now, "preempted")
         self.preemptions_total += 1
         self._tenants[victim.tenant].preempted += 1
         tracing.event("gateway.preempt", request=victim.id,

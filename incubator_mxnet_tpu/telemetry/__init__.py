@@ -55,7 +55,14 @@ Six connected parts:
   traces never flap; ``MXNET_BURN_WINDOWS``);
 - `capacity`  — per-tenant/per-model cost ledger at the serving seams
   (tokens, prefill/decode device-seconds, KV page-seconds, queue-wait
-  as ``mx_capacity_*``; rolled up in `fleet_report()`).
+  as ``mx_capacity_*``; rolled up in `fleet_report()`);
+- `anatomy`   — per-request latency anatomy (request wall decomposed
+  into queue_wait / preempted / prefill_wait / prefill_compute /
+  handoff_migration / decode_compute / spec_overhead, sum-to-wall per
+  request), per-replica role residency
+  (``mx_replica_residency_seconds_total{replica=,role=,state=}``), and
+  the tail-sampled request archive (``MXNET_ANATOMY_SAMPLE`` /
+  ``MXNET_ANATOMY_RING``; rendered by ``tools/reqscope.py``).
 
 Env knobs (registered in `util._ENV_KNOBS`): ``MXNET_TELEMETRY``
 (``1`` = stage + span tracing on, ``raise`` = + NaN guard raising at the
@@ -81,6 +88,7 @@ from . import goodput  # noqa: F401
 from . import timeseries  # noqa: F401
 from . import burnrate  # noqa: F401
 from . import capacity  # noqa: F401
+from . import anatomy  # noqa: F401
 from .monitor import Monitor, install_nan_hook  # noqa: F401
 
 # arm the host->device byte inlet (a counter inc per transfer — rare
@@ -91,5 +99,5 @@ _nd_mod._H2D_HOOK = registry.add_h2d_bytes
 
 __all__ = ["registry", "stages", "tracing", "slo", "roofline", "monitor",
            "compiles", "hbm", "fleet", "kernels", "goodput", "locks",
-           "timeseries", "burnrate", "capacity",
+           "timeseries", "burnrate", "capacity", "anatomy",
            "Monitor", "install_nan_hook"]

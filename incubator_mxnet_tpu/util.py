@@ -489,6 +489,21 @@ _ENV_KNOBS = {
         "dry-run subphase (timeseries history + burn alerts + advisor "
         "diurnal sequence + per-tenant cost attribution); 0 skips it "
         "(honored, this build's addition)"),
+    "MXNET_ANATOMY_SAMPLE": (
+        "telemetry.anatomy", "fraction of NORMAL request completions "
+        "archived in the sampled ring (default 0.05, clamped to [0,1]); "
+        "flagged requests (SLO violation / preempted / migrated / crash "
+        "resume) are always retained regardless "
+        "(honored, this build's addition)"),
+    "MXNET_ANATOMY_RING": (
+        "telemetry.anatomy", "depth of EACH request-archive ring (tail "
+        "+ sampled; default 256, min 1) — bounds the goodput "
+        "observatory's memory (honored, this build's addition)"),
+    "MXNET_DRYRUN_ANATOMY": (
+        "__graft_entry__", "opt-out knob for the serving-goodput "
+        "dry-run subphase (2-tenant stub pod with one preemption + one "
+        "migration: sum-to-wall <=2% + flagged-archive retention); 0 "
+        "skips it (honored, this build's addition)"),
     # -- designed out (XLA/jax owns the mechanism) -------------------------
     "MXNET_ENGINE_TYPE": (
         "(designed out)", "scheduling is XLA async dispatch; value ignored"),

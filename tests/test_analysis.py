@@ -301,7 +301,8 @@ def test_framework_lint_list_rules():
                              "FL006", "FL007", "FL008", "FL009", "FL010",
                              "FL011", "FL012", "FL013",
                              "FL014", "FL015", "FL016", "FL017",
-                             "FL018", "FL019", "FL020"}
+                             "FL018", "FL019", "FL020", "FL021",
+                             "FL022"}
 
 
 def test_lint_fl019_wallclock_durations():

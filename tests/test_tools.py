@@ -1066,6 +1066,92 @@ def test_fl021_tree_is_clean():
 
 
 # ---------------------------------------------------------------------------
+# framework_lint FL022 — serve/ duration-accounting choke point (ISSUE 20)
+# ---------------------------------------------------------------------------
+
+def test_fl022_flags_adhoc_perf_counter_durations():
+    # a direct subtraction outside any charge call
+    direct = ("import time\n"
+              "def step(self):\n"
+              "    t0 = time.perf_counter()\n"
+              "    work()\n"
+              "    dur = time.perf_counter() - t0\n")
+    hits = [f for f in _lint_src(
+        direct, "incubator_mxnet_tpu/serve/scheduler.py")
+        if f.rule == "FL022"]
+    assert len(hits) == 1 and hits[0].line == 5, hits
+    assert "charge call" in hits[0].message
+    # an assigned duration that never feeds a charge call
+    stray = ("import time\n"
+             "def step(self, t0):\n"
+             "    dt = time.perf_counter() - t0\n"
+             "    self.stats.append(dt)\n")
+    hits = [f for f in _lint_src(
+        stray, "incubator_mxnet_tpu/serve/gateway.py")
+        if f.rule == "FL022"]
+    assert len(hits) == 1 and hits[0].line == 3, hits
+
+
+def test_fl022_exempts_charge_fed_durations():
+    # the sanctioned shape: the subtraction is an argument of the
+    # capacity/anatomy charge call itself
+    inline = ("import time\n"
+              "def step(self, t0):\n"
+              "    capacity.split_device_seconds(\n"
+              "        ('t',), 'm', 'decode',\n"
+              "        time.perf_counter() - t0)\n"
+              "    anatomy.on_decode_step(self, t0,\n"
+              "                           time.perf_counter())\n")
+    assert not [f for f in _lint_src(
+        inline, "incubator_mxnet_tpu/serve/scheduler.py")
+        if f.rule == "FL022"]
+    # an assigned dt whose name feeds a charge call is sanctioned too
+    fed = ("import time\n"
+           "def accrue(self, req, last):\n"
+           "    t = time.perf_counter()\n"
+           "    dt = t - last\n"
+           "    capacity.charge_kv_page_seconds(\n"
+           "        req.tenant, self.model, len(req.pages) * dt)\n")
+    assert not [f for f in _lint_src(
+        fed, "incubator_mxnet_tpu/serve/scheduler.py")
+        if f.rule == "FL022"]
+    # the choke points themselves own the subtraction
+    own = ("import time\n"
+           "def _transition(self, t0):\n"
+           "    dur = time.perf_counter() - t0\n")
+    assert not [f for f in _lint_src(
+        own, "incubator_mxnet_tpu/telemetry/anatomy.py")
+        if f.rule == "FL022"]
+    assert not [f for f in _lint_src(
+        own, "incubator_mxnet_tpu/telemetry/capacity.py")
+        if f.rule == "FL022"]
+    # outside serve/ the rule is silent
+    assert not [f for f in _lint_src(
+        own, "incubator_mxnet_tpu/parallel/dist.py")
+        if f.rule == "FL022"]
+    # noqa escape with a reason
+    noqa = ("import time\n"
+            "def step(self, t0):\n"
+            "    dur = time.perf_counter() - t0  "
+            "# noqa: FL022 - bench-only probe\n")
+    assert not [f for f in _lint_src(
+        noqa, "incubator_mxnet_tpu/serve/scheduler.py")
+        if f.rule == "FL022"]
+
+
+def test_fl022_tree_is_clean():
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import framework_lint
+    finally:
+        sys.path.pop(0)
+    findings = [f for f in framework_lint.lint_paths(
+        [os.path.join(REPO, "incubator_mxnet_tpu")])
+        if f.rule == "FL022"]
+    assert not findings, findings
+
+
+# ---------------------------------------------------------------------------
 # bench_regress — trajectory regression gate (ISSUE 10)
 # ---------------------------------------------------------------------------
 

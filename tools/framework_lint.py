@@ -360,6 +360,14 @@ RULES = {
              "and can leak or double-free pages; route through "
              "serve.disagg (an engine's OWN `self.slots...` is exempt), "
              "or `# noqa: FL021` with a reason",
+    "FL022": "serve/ ad-hoc perf_counter duration accounting outside "
+             "the telemetry charge choke points: a time.perf_counter() "
+             "delta computed in serve/ but not handed to a "
+             "capacity.*/anatomy.* charge call is wall time the cost "
+             "ledger and the request-anatomy sum-to-wall invariant "
+             "never see; pass the reading into the charge call "
+             "(telemetry/capacity.py + telemetry/anatomy.py own the "
+             "subtraction), or `# noqa: FL022` with a reason",
 }
 
 _INDEXING_NAME_PARTS = ("getitem", "setitem", "index", "slice")
@@ -1290,6 +1298,118 @@ def _check_wallclock_durations(tree, path, findings, src_lines):
 
 
 # ---------------------------------------------------------------------------
+# FL022 — serve/ duration-accounting choke point
+# ---------------------------------------------------------------------------
+
+def _is_perf_counter_call(node):
+    return (isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "perf_counter"
+            and isinstance(node.func.value, ast.Name)
+            and node.func.value.id == "time")
+
+
+def _charge_call_base(node):
+    """The leading dotted name of a Call's func ('capacity' for
+    `capacity.split_device_seconds(...)`), or None."""
+    func = node.func if isinstance(node, ast.Call) else None
+    while isinstance(func, ast.Attribute):
+        func = func.value
+    return func.id if isinstance(func, ast.Name) else None
+
+
+def _check_duration_choke_point(tree, path, findings, src_lines):
+    """FL022: a perf_counter delta computed in serve/ must be an
+    argument of a `capacity.*`/`anatomy.*` charge call (directly, or
+    via a name whose value feeds one) — anywhere else it is duration
+    accounting the telemetry ledgers never see. The telemetry modules
+    that OWN the choke points are exempt."""
+    norm = path.replace(os.sep, "/")
+    if "/serve/" not in norm:
+        return
+    if norm.endswith(("telemetry/anatomy.py", "telemetry/capacity.py")):
+        return
+
+    def noqa(lineno):
+        line = src_lines[lineno - 1] if lineno - 1 < len(src_lines) else ""
+        return "noqa: FL022" in line
+
+    def flag(node, what):
+        if noqa(node.lineno):
+            return
+        findings.append(LintFinding(
+            path, node.lineno, "FL022",
+            f"ad-hoc perf_counter duration accounting ({what}) — wall "
+            "time the capacity ledger and the request-anatomy "
+            "sum-to-wall invariant never see; hand the readings to a "
+            "capacity.*/anatomy.* charge call (the telemetry module "
+            "owns the subtraction), or `# noqa: FL022` with a reason"))
+
+    # nodes living inside the args of a charge call are sanctioned
+    sanctioned_ids = set()
+    for node in ast.walk(tree):
+        if _charge_call_base(node) in ("capacity", "anatomy"):
+            for arg in list(node.args) + [k.value for k in node.keywords]:
+                for sub in ast.walk(arg):
+                    sanctioned_ids.add(id(sub))
+
+    # pass 1: direct `time.perf_counter() - x` subtraction
+    for node in ast.walk(tree):
+        if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Sub) \
+                and (_is_perf_counter_call(node.left)
+                     or _is_perf_counter_call(node.right)) \
+                and id(node) not in sanctioned_ids:
+            flag(node, "direct subtraction of a time.perf_counter() "
+                       "reading outside a charge call")
+
+    # pass 2: per function — Subs over names read from perf_counter,
+    # unless the delta's own name feeds a charge call in the function
+    for fn in ast.walk(tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        perf_names = set()
+        charge_fed_names = set()
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign) \
+                    and _is_perf_counter_call(node.value):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        perf_names.add(tgt.id)
+            if _charge_call_base(node) in ("capacity", "anatomy"):
+                args = list(node.args) + [k.value for k in node.keywords]
+                for arg in args:
+                    for sub in ast.walk(arg):
+                        if isinstance(sub, ast.Name):
+                            charge_fed_names.add(sub.id)
+        if not perf_names:
+            continue
+        # `dt = t - last` is fine when `dt` feeds a charge call in the
+        # same function — sanction the Subs inside such assignments
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Assign):
+                continue
+            tgts = [t.id for t in node.targets
+                    if isinstance(t, ast.Name)]
+            if tgts and all(t in charge_fed_names for t in tgts):
+                for sub in ast.walk(node.value):
+                    sanctioned_ids.add(id(sub))
+        for sub in ast.walk(fn):
+            if not (isinstance(sub, ast.BinOp)
+                    and isinstance(sub.op, ast.Sub)) \
+                    or id(sub) in sanctioned_ids:
+                continue
+            if _is_perf_counter_call(sub.left) \
+                    or _is_perf_counter_call(sub.right):
+                continue               # pass 1 owns direct subtractions
+            for side in (sub.left, sub.right):
+                if isinstance(side, ast.Name) and side.id in perf_names:
+                    flag(sub, f"`{side.id}` was read from time."
+                              "perf_counter() and the delta never "
+                              "reaches a charge call")
+                    break
+
+
+# ---------------------------------------------------------------------------
 # FL009 — paged-serving hazards (serve/ modules only)
 # ---------------------------------------------------------------------------
 
@@ -1730,6 +1850,7 @@ def lint_source(src, path, coverage_text=None, telemetry_text=None):
     _check_replica_choke_point(tree, path, findings, src.splitlines())
     _check_migration_choke_point(tree, path, findings, src.splitlines())
     _check_wallclock_durations(tree, path, findings, src.splitlines())
+    _check_duration_choke_point(tree, path, findings, src.splitlines())
     _check_paged_hazards(tree, path, findings)
     _check_span_hygiene(tree, path, findings)
     _check_collective_hygiene(tree, path, findings, src.splitlines())

@@ -1,0 +1,287 @@
+#!/usr/bin/env python
+"""Request-anatomy viewer: per-request latency waterfalls, percentile
+anatomy per tier/tenant/model, replica role residency, and the
+tail-sampled request archive (the CLI face of `telemetry.anatomy` —
+see TELEMETRY.md "request anatomy").
+
+Modes
+-----
+``--demo`` (default when no mode is given)
+    Run the seeded, wall-clock-free anatomy demo: a scripted request
+    mix (two tenants, two tiers, a preemption, a disagg migration with
+    its fallback, a deadline blowout, a crash resume, spec-decode
+    waste) driven through the REAL anatomy ledger on a VIRTUAL clock —
+    every state transition and compute carve uses scripted timestamps,
+    so the archive, percentiles, and residency table are byte-stable.
+    Prints per-group percentile waterfalls, the tail archive, and the
+    replica residency table. ``--save FILE`` writes the report JSON::
+
+        python tools/reqscope.py --demo --save benchmark/reqscope_demo.json
+
+    The committed fixture ``benchmark/reqscope_demo.json`` is exactly
+    that command's output (virtual clock ⇒ byte-stable).
+
+``--live FILE``
+    Render a saved `telemetry.anatomy.report()` JSON — a ``--save``
+    file, a flight-recorder ``anatomy`` context block's parent report,
+    or anything a harness dumped with ``json.dump(anatomy.report())``.
+    Re-renders every ``--interval`` seconds until Ctrl-C (``--once``
+    for a single frame)::
+
+        python tools/reqscope.py --live /tmp/anatomy.json --once
+
+``--tail N``
+    Archive rows to show in the tail listing (default 8).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+STATES = ("queue_wait", "preempted", "prefill_wait", "prefill_compute",
+          "handoff_migration", "decode_compute", "spec_overhead")
+
+_GLYPH = {"queue_wait": "q", "preempted": "P", "prefill_wait": "w",
+          "prefill_compute": "F", "handoff_migration": "M",
+          "decode_compute": "D", "spec_overhead": "s"}
+
+
+def bar(states, wall, width=44):
+    """One-line stacked waterfall: each state's share of `wall` as a
+    run of its glyph (states under half a column are dropped)."""
+    if wall <= 0.0:
+        return "(zero wall)"
+    out = []
+    for s in STATES:
+        v = states.get(s, 0.0)
+        n = int(round(v / wall * width))
+        if n > 0:
+            out.append(_GLYPH[s] * n)
+    return "".join(out)[:width]
+
+
+def percentile(sorted_vals, q):
+    if not sorted_vals:
+        return 0.0
+    i = min(int(q * (len(sorted_vals) - 1) + 0.5), len(sorted_vals) - 1)
+    return sorted_vals[i]
+
+
+# ---------------------------------------------------------------------------
+# --demo: the scripted virtual-clock request mix
+# ---------------------------------------------------------------------------
+
+_DEMO_MODEL = "gpt-demo"
+
+
+def _plain(anatomy, rid, tenant, tier, t, queue, pwait, pcomp, decode,
+           spec_waste=0.0, tokens=24):
+    """One well-behaved request: queue → prefill → decode → done."""
+    rec = anatomy.begin(rid, tenant, _DEMO_MODEL, tier, t)
+    t += queue
+    rec.dispatched(t, _DEMO_MODEL + "#0")
+    t += pwait + pcomp
+    rec.carve("prefill_compute", pcomp)
+    rec.prefill_done(t)
+    t += decode
+    if spec_waste:
+        rec.carve("spec_overhead", spec_waste)
+    anatomy.complete(rec, t, "ok", tokens=tokens)
+    return t
+
+
+def run_demo():
+    """Drive the REAL anatomy ledger on a virtual clock; return the
+    report dict (what ``--save`` writes and the fixture commits)."""
+    from incubator_mxnet_tpu.telemetry import anatomy, registry
+
+    registry.reset()
+    anatomy.reset()
+    was_enabled = anatomy.is_enabled()
+    sample0 = anatomy.sample_rate()
+    anatomy.enable()
+    anatomy.set_sample(0.5)     # every 2nd NORMAL request is archived
+
+    # -- the request mix (all timestamps virtual seconds) -------------
+    # plain interactive + batch traffic across two tenants
+    _plain(anatomy, 0, "acme", "high", 0.0, 0.004, 0.010, 0.055, 0.210)
+    _plain(anatomy, 1, "beta", "normal", 0.3, 0.028, 0.022, 0.140, 0.710)
+    _plain(anatomy, 2, "acme", "high", 0.9, 0.003, 0.008, 0.050, 0.190)
+    _plain(anatomy, 3, "acme", "normal", 1.2, 0.051, 0.030, 0.120, 0.540)
+    _plain(anatomy, 4, "beta", "normal", 1.8, 0.033, 0.025, 0.150, 0.820)
+    _plain(anatomy, 5, "acme", "high", 2.2, 0.002, 0.007, 0.045, 0.180)
+    # spec decode: half the drafts rejected — waste carved out
+    _plain(anatomy, 6, "beta", "normal", 2.5, 0.020, 0.018, 0.130, 0.600,
+           spec_waste=0.140)
+
+    # preempted: a high-tier arrival evicts it mid-decode; the re-queued
+    # wall lands in the `preempted` state (the satellite fix)
+    rec = anatomy.begin(7, "beta", _DEMO_MODEL, "low", 3.0)
+    rec.dispatched(3.050, _DEMO_MODEL + "#0")
+    rec.carve("prefill_compute", 0.120)
+    rec.prefill_done(3.240)
+    rec.requeued(3.600, "preempted")         # 0.36 s of decode done
+    rec.dispatched(4.450, _DEMO_MODEL + "#0")   # 0.85 s re-queued
+    rec.carve("prefill_compute", 0.060)      # warm re-prefill of the tail
+    rec.prefill_done(4.540)
+    anatomy.complete(rec, 5.110, "ok", tokens=48)
+
+    # disagg migration: prefill on #0, pages moved, decode on #1
+    rec = anatomy.begin(8, "acme", _DEMO_MODEL, "normal", 3.4)
+    rec.dispatched(3.420, _DEMO_MODEL + "#0")
+    rec.carve("prefill_compute", 0.180)
+    rec.prefill_done(3.660, handoff=True)
+    rec.adopted(3.705, migrated=True)        # 45 ms parked + moving
+    anatomy.complete(rec, 4.300, "ok", tokens=32)
+
+    # migration fallback: decode side exhausted, re-queued, co-located
+    rec = anatomy.begin(9, "beta", _DEMO_MODEL, "normal", 3.9)
+    rec.dispatched(3.960, _DEMO_MODEL + "#0")
+    rec.carve("prefill_compute", 0.150)
+    rec.prefill_done(4.170, handoff=True)
+    rec.requeued(4.230, "migration_fallback")
+    rec.dispatched(4.900, _DEMO_MODEL + "#0")
+    rec.carve("prefill_compute", 0.080)
+    rec.prefill_done(5.010)
+    anatomy.complete(rec, 5.640, "ok", tokens=28)
+
+    # SLO blowout: expires in the gateway queue under the surge
+    rec = anatomy.begin(10, "acme", _DEMO_MODEL, "low", 4.0,
+                        deadline=4.5)
+    anatomy.complete(rec, 4.520, "expired", tokens=0)
+
+    # crash resume: replica died mid-decode, remainder re-dispatched
+    rec = anatomy.begin(11, "acme", _DEMO_MODEL, "normal", 4.1)
+    rec.dispatched(4.140, _DEMO_MODEL + "#0")
+    rec.carve("prefill_compute", 0.090)
+    rec.prefill_done(4.280)
+    rec.requeued(4.680, "crash_resume")
+    rec.dispatched(5.300, _DEMO_MODEL + "#0")
+    rec.carve("prefill_compute", 0.050)
+    rec.prefill_done(5.380)
+    anatomy.complete(rec, 5.900, "ok", tokens=40)
+
+    # -- replica residency (same virtual clock) -----------------------
+    p, d = _DEMO_MODEL + "#0", _DEMO_MODEL + "#1"
+    anatomy.charge_replica(p, "prefill", "prefill", 1.35, now=5.4)
+    anatomy.charge_replica(p, "prefill", "prefill", 0.45, now=6.0)
+    anatomy.charge_replica(d, "decode", "warmup", 0.30, now=0.5)
+    anatomy.charge_replica(d, "decode", "migration", 0.08, now=3.7)
+    anatomy.charge_replica(d, "decode", "decode", 3.90, now=5.9)
+    anatomy.charge_replica(d, "decode", "decode", 0.70, now=6.0)
+
+    rep = anatomy.report(now=6.0)
+    rep["mode"] = "reqscope-demo"
+    rep["virtual_clock"] = True
+    anatomy.reset()
+    anatomy.set_sample(sample0)
+    if not was_enabled:
+        anatomy.disable()
+    return rep
+
+
+# ---------------------------------------------------------------------------
+# rendering (shared by --demo and --live)
+# ---------------------------------------------------------------------------
+
+def _groups(archive):
+    by = {}
+    for r in archive:
+        by.setdefault((r["model"], r["tier"], r["tenant"]), []).append(r)
+    return by
+
+
+def format_report(rep, tail=8):
+    archive = rep.get("archive") or []
+    lines = [f"request anatomy — {rep.get('requests_completed', 0)} "
+             f"completed, {len(archive)} archived "
+             f"(tail {rep.get('archive_depth', {}).get('tail', 0)} + "
+             f"sampled {rep.get('archive_depth', {}).get('sampled', 0)} "
+             f"@ rate {rep.get('sample_rate', 0):g})"]
+    lines.append("  legend: " + " ".join(
+        f"{_GLYPH[s]}={s}" for s in STATES))
+    lines.append("  percentile waterfall per model/tier/tenant:")
+    for key in sorted(_groups(archive)):
+        rows = _groups(archive)[key]
+        walls = sorted(r["wall_s"] for r in rows)
+        p50, p95 = percentile(walls, 0.5), percentile(walls, 0.95)
+        mean = {s: sum(r["states"].get(s, 0.0) for r in rows) / len(rows)
+                for s in STATES}
+        wall = sum(mean.values()) or 1.0
+        lines.append(
+            f"    {key[0]}/{key[1]}/{key[2]:<6} n={len(rows):<3} "
+            f"p50={p50 * 1e3:7.1f}ms p95={p95 * 1e3:7.1f}ms "
+            f"|{bar(mean, wall)}|")
+    lines.append(f"  archive tail (last {tail}):")
+    for r in archive[-tail:]:
+        flags = ",".join(r["flags"]) if r["flags"] else "-"
+        lines.append(
+            f"    #{r['id']:<4} {r['tenant']:<6} {r['tier']:<7} "
+            f"{r['outcome']:<8} wall={r['wall_s'] * 1e3:8.1f}ms "
+            f"[{flags}] |{bar(r['states'], r['wall_s'], width=30)}|")
+    reps = rep.get("replicas") or {}
+    if reps:
+        lines.append("  replica residency (fraction of wall):")
+        for label in sorted(reps):
+            row = reps[label]
+            frac = row["frac"]
+            cells = "  ".join(f"{s}={frac.get(s, 0.0):5.1%}"
+                              for s in ("prefill", "decode", "migration",
+                                        "warmup", "idle"))
+            lines.append(f"    {label:<12} role={row['role']:<8} "
+                         f"wall={row['wall_s']:6.1f}s  {cells}")
+    audit = rep.get("device_audit") or {}
+    lines.append(
+        f"  device audit: residency prefill+decode "
+        f"{audit.get('residency_device_s', 0.0):.2f}s vs capacity "
+        f"measured wall {audit.get('capacity_wall_s', 0.0):.2f}s")
+    return "\n".join(lines)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--demo", action="store_true",
+                    help="seeded virtual-clock request-mix demo (default)")
+    ap.add_argument("--live", metavar="FILE",
+                    help="render a saved anatomy.report() JSON")
+    ap.add_argument("--save", metavar="FILE",
+                    help="(--demo) also write the report JSON here")
+    ap.add_argument("--tail", type=int, default=8,
+                    help="archive rows to show (default 8)")
+    ap.add_argument("--interval", type=float, default=2.0,
+                    help="(--live) seconds between re-renders")
+    ap.add_argument("--once", action="store_true",
+                    help="(--live) render a single frame and exit")
+    args = ap.parse_args(argv)
+
+    if args.live:
+        import time
+        while True:
+            with open(args.live) as f:
+                print(format_report(json.load(f), tail=args.tail))
+            if args.once:
+                return 0
+            try:
+                time.sleep(args.interval)
+            except KeyboardInterrupt:
+                return 0
+            print()
+    # default: demo
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    rep = run_demo()
+    print(format_report(rep, tail=args.tail))
+    if args.save:
+        with open(args.save, "w") as f:
+            json.dump(rep, f, indent=1, sort_keys=True)
+            f.write("\n")
+        print(f"saved report to {args.save}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
